@@ -1,0 +1,84 @@
+"""pint_tpu — a TPU-native pulsar-timing framework.
+
+A from-scratch re-design of the capabilities of PINT (reference:
+``/root/reference``, see ``src/pint/__init__.py``) around JAX/XLA:
+
+* time is carried as **double-double** ("two-float") pairs of float64 on
+  device instead of x87 ``np.longdouble`` (reference ``pulsar_mjd.py``),
+* pulse phase is an explicit (integer, fractional) pair (reference
+  ``phase.py:7``) backed by double-double arithmetic,
+* delay/phase/design-matrix evaluation is a pure, jit-compiled function of a
+  flat parameter vector — derivatives come from ``jax.jacfwd`` instead of
+  thousands of lines of hand-registered partials,
+* fits/grids/samplers batch via ``vmap`` and shard over a
+  ``jax.sharding.Mesh`` (TOA axis + grid/walker axis) with XLA collectives.
+
+Host-side ingestion (par/tim parsing, clock chains, time scales, solar-system
+ephemerides, Earth rotation) is numpy/C++ and runs once; everything downstream
+consumes a frozen :class:`pint_tpu.toa.TOABatch` of device arrays.
+"""
+
+import os as _os
+
+# Double precision is required for timing math everywhere.  This must happen
+# before any jax.numpy array is created.
+_os.environ.setdefault("JAX_ENABLE_X64", "1")
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+# ---------------------------------------------------------------------------
+# Physical constants (SI / conventional pulsar-timing values).
+# Mirrors the constant surface of reference src/pint/__init__.py:55-110 but as
+# plain floats in documented units (no astropy).
+# ---------------------------------------------------------------------------
+
+#: Speed of light [m/s]
+c = 299792458.0
+#: One light-second [m]
+ls = c * 1.0
+#: Astronomical unit [m]
+AU = 1.495978707e11
+#: AU expressed in light-seconds [s]
+AU_LS = AU / c
+#: Seconds per day
+SECS_PER_DAY = 86400.0
+#: Days per Julian year
+DAYS_PER_YEAR = 365.25
+#: Seconds per Julian year
+SECS_PER_YEAR = SECS_PER_DAY * DAYS_PER_YEAR
+#: J2000 epoch as MJD (TT)
+J2000_MJD = 51544.5
+#: MJD of the JD origin offset: JD = MJD + 2400000.5
+MJD_TO_JD_OFFSET = 2400000.5
+
+#: Dispersion constant K [s MHz^2 cm^3 / pc]: delay = K * DM / f_MHz^2.
+#: Pulsar-timing convention (fixed value, reference __init__.py:92-110):
+#: K = 1/(2.41e-4) MHz^2 pc^-1 cm^3 s
+DMconst = 1.0 / 2.41e-4
+
+#: Solar mass in geometrized time units T_sun = G*Msun/c^3 [s]
+Tsun = 4.925490947641267e-06
+#: Geometrized masses of planets [s] (G*M/c^3), for planet Shapiro delays
+Tmercury = 8.176988758067153e-13
+Tvenus = 1.2052652550219583e-11
+Tearth = 1.4766034811726626e-11
+Tmars = 1.5897344765543475e-12
+Tjupiter = 4.702799555505529e-09
+Tsaturn = 1.408128810019423e-09
+Turanus = 2.1505895513637613e-10
+Tneptune = 2.5374099721577516e-10
+
+#: GM of the Sun [m^3/s^2] (DE-series conventional value)
+GMsun = 1.32712440041e20
+
+#: Obliquity of the ecliptic, IERS2010 [rad] (reference data/runtime/ecliptic.dat)
+OBL_IERS2010_ARCSEC = 84381.406
+OBL_IERS2010_RAD = OBL_IERS2010_ARCSEC * (1.0 / 3600.0) * 3.141592653589793 / 180.0
+
+#: parsec [m]
+parsec = 3.0856775814913673e16
+
+from pint_tpu import logging as logging  # noqa: E402  (lightweight)

@@ -1,0 +1,132 @@
+"""Exception taxonomy for pint_tpu (reference: ``src/pint/exceptions.py``)."""
+
+from __future__ import annotations
+
+__all__ = [
+    "PintError",
+    "ModelError",
+    "MissingParameter",
+    "MissingComponent",
+    "MissingTOAs",
+    "UnknownParameter",
+    "UnknownBinaryModel",
+    "TimingModelError",
+    "PrefixError",
+    "InvalidModelParameters",
+    "AliasConflict",
+    "ConvergenceFailure",
+    "MaxiterReached",
+    "StepProblem",
+    "CorrelatedErrors",
+    "DegeneracyWarning",
+    "ClockCorrectionError",
+    "ClockCorrectionOutOfRange",
+    "NoClockCorrections",
+    "PintFileError",
+    "PrecisionError",
+]
+
+
+class PintError(Exception):
+    """Base class for all pint_tpu exceptions."""
+
+
+class ModelError(PintError):
+    """Generic problem with a timing model."""
+
+
+class TimingModelError(ModelError):
+    """Invalid timing-model structure or configuration."""
+
+
+class MissingParameter(ModelError):
+    """A parameter required by a component is absent or unset."""
+
+    def __init__(self, module: str = "", param: str = "", msg: str | None = None):
+        self.module, self.param = module, param
+        super().__init__(msg or f"{module} requires parameter {param}")
+
+
+class MissingComponent(ModelError):
+    """A required component is not present in the model."""
+
+
+class MissingTOAs(ModelError):
+    """Some mask parameter selects no TOAs."""
+
+    def __init__(self, parameter_names=()):
+        if isinstance(parameter_names, str):
+            parameter_names = [parameter_names]
+        self.parameter_names = list(parameter_names)
+        super().__init__(f"Parameters {self.parameter_names} select no TOAs")
+
+
+class UnknownParameter(ModelError):
+    """A par-file key cannot be mapped to any known parameter."""
+
+
+class UnknownBinaryModel(ModelError):
+    """The BINARY line names a model this framework does not provide."""
+
+    def __init__(self, message, suggestion=None):
+        super().__init__(message + (f" Perhaps use {suggestion}?" if suggestion else ""))
+        self.suggestion = suggestion
+
+
+class PrefixError(ModelError):
+    """Malformed prefix parameter name (e.g. F0003x)."""
+
+
+class InvalidModelParameters(ModelError):
+    """Parameter values are outside their physically meaningful domain."""
+
+
+class AliasConflict(ModelError):
+    """Two components claim the same parameter alias."""
+
+
+class ConvergenceFailure(PintError):
+    """An iterative fitter failed to converge."""
+
+
+class MaxiterReached(ConvergenceFailure):
+    """Fitter hit the iteration limit before meeting tolerance."""
+
+
+class StepProblem(ConvergenceFailure):
+    """A fitter step failed to decrease chi2 even after lambda-halving."""
+
+
+class CorrelatedErrors(PintError):
+    """A fitter that assumes uncorrelated errors was given correlated noise."""
+
+    def __init__(self, model):
+        trouble = [c.__class__.__name__ for c in getattr(model, "noise_components", [])
+                   if getattr(c, "introduces_correlated_errors", False)]
+        super().__init__(
+            f"Model has correlated errors ({trouble}); use a GLS-family fitter"
+        )
+
+
+class DegeneracyWarning(UserWarning):
+    """The design matrix has (near-)degenerate directions."""
+
+
+class ClockCorrectionError(PintError):
+    """Problem applying observatory clock corrections."""
+
+
+class ClockCorrectionOutOfRange(ClockCorrectionError):
+    """TOAs fall outside the span of the available clock files."""
+
+
+class NoClockCorrections(ClockCorrectionError):
+    """No clock file is available for an observatory."""
+
+
+class PintFileError(PintError):
+    """Malformed par/tim/clock/ephemeris file."""
+
+
+class PrecisionError(PintError):
+    """An operation would silently lose required time precision."""

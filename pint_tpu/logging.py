@@ -1,0 +1,88 @@
+"""Logging setup for pint_tpu.
+
+The reference uses loguru with per-message dedup filters
+(reference ``src/pint/logging.py:1-60``).  loguru is not a dependency here;
+this module provides the same surface — ``setup(level)``, dedup of repeated
+messages, warning capture — on top of the stdlib ``logging`` module.
+"""
+
+from __future__ import annotations
+
+import logging as _logging
+import sys
+import warnings
+
+__all__ = ["setup", "log", "levels", "LogFilter"]
+
+levels = ["TRACE", "DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"]
+
+log = _logging.getLogger("pint_tpu")
+
+
+class LogFilter(_logging.Filter):
+    """Filter that suppresses duplicate messages.
+
+    Mirrors the reference's ``LogFilter`` dedup behaviour: messages listed in
+    ``onlyonce`` (or, if ``onlyonce_level`` is set, every message at or below
+    that level) are emitted a single time per process.
+    """
+
+    def __init__(self, onlyonce: list[str] | None = None, dedup_all: bool = False):
+        super().__init__()
+        self.onlyonce = set(onlyonce or [])
+        self.dedup_all = dedup_all
+        self._seen: set[str] = set()
+
+    def filter(self, record: _logging.LogRecord) -> bool:  # noqa: A003
+        msg = record.getMessage()
+        if self.dedup_all or any(msg.startswith(o) for o in self.onlyonce):
+            if msg in self._seen:
+                return False
+            self._seen.add(msg)
+        return True
+
+
+_DEFAULT_ONLYONCE = [
+    "Using EPHEM =",
+    "Using CLK =",
+    "Using UNITS =",
+    "No pulse number flags found",
+    "SSB obs pos",
+    "Setting pulse numbers",
+    "Clock file",
+    "Using built-in analytic solar-system ephemeris",
+]
+
+_configured = False
+
+
+def setup(level: str = "INFO", usecolors: bool = True, dedup: bool = True) -> int:
+    """Configure the pint_tpu logger; returns a handler id for parity."""
+    global _configured
+    for h in list(log.handlers):
+        log.removeHandler(h)
+    handler = _logging.StreamHandler(sys.stderr)
+    fmt = "%(asctime)s %(levelname)-8s %(name)s %(message)s"
+    handler.setFormatter(_logging.Formatter(fmt, datefmt="%H:%M:%S"))
+    if dedup:
+        handler.addFilter(LogFilter(onlyonce=_DEFAULT_ONLYONCE))
+    log.addHandler(handler)
+    log.setLevel(getattr(_logging, level if level != "TRACE" else "DEBUG"))
+    log.propagate = False
+    if not _configured:
+        _logging.captureWarnings(False)
+        _configured = True
+    return id(handler)
+
+
+def capture_warnings(enable: bool = True) -> None:
+    """Route Python warnings through the pint_tpu logger."""
+    if enable:
+        def _showwarning(message, category, filename, lineno, file=None, line=None):
+            log.warning(f"{category.__name__}: {message} ({filename}:{lineno})")
+        warnings.showwarning = _showwarning
+    else:
+        warnings.showwarning = warnings._showwarning_orig  # type: ignore[attr-defined]
+
+
+setup("WARNING")

@@ -1,0 +1,211 @@
+"""Observatory registry: ground sites, special locations, clock chains, TDB.
+
+Native counterpart of reference ``src/pint/observatory/`` (registry +
+``TopoObs`` + special locations).  Each observatory provides:
+
+* ``clock_corrections(utc_mjd, ...)`` — site clock chain -> UTC(GPS) -> UTC
+  [+ TT(BIPM)-TT(TAI) when requested], in seconds (reference
+  ``observatory/__init__.py:387``),
+* ``get_TDBs(utc_mjd)`` — corrected UTC -> TDB MJD, longdouble (reference
+  ``observatory/__init__.py:443``),
+* ``posvel(utc_mjd, tdb_mjd, ephem)`` — site position/velocity wrt the SSB in
+  km, km/s (reference ``observatory/__init__.py:507``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pint_tpu import ephemeris as ephem_mod
+from pint_tpu.earth import gcrs_posvel_from_itrf
+from pint_tpu.exceptions import NoClockCorrections
+from pint_tpu.logging import log
+from pint_tpu.observatory.clock_file import ClockFile, find_clock_file
+from pint_tpu.observatory.sites import SITES
+from pint_tpu.timescales import utc_to_tdb_mjd, utc_to_tt_mjd
+from pint_tpu.utils import PosVel
+
+__all__ = ["Observatory", "TopoObs", "BarycenterObs", "GeocenterObs",
+           "get_observatory", "list_observatories"]
+
+_registry: Dict[str, "Observatory"] = {}
+_alias_map: Dict[str, str] = {}
+
+
+class Observatory:
+    """Base observatory: named location with clock chain and SSB posvel."""
+
+    def __init__(self, name: str, aliases: List[str] = (), include_gps=True,
+                 include_bipm=True, bipm_version="BIPM2021"):
+        self.name = name.lower()
+        self.aliases = [a.lower() for a in aliases]
+        self.include_gps = include_gps
+        self.include_bipm = include_bipm
+        self.bipm_version = bipm_version
+        _registry[self.name] = self
+        _alias_map[self.name] = self.name
+        for a in self.aliases:
+            _alias_map.setdefault(a, self.name)
+
+    # -- registry ----------------------------------------------------------
+    @classmethod
+    def get(cls, name: str) -> "Observatory":
+        key = name.lower().strip()
+        if key in _alias_map:
+            return _registry[_alias_map[key]]
+        raise KeyError(f"Unknown observatory {name!r}")
+
+    # -- clock chain -------------------------------------------------------
+    def _site_clock_files(self) -> List[ClockFile]:
+        return []
+
+    def clock_corrections(self, utc_mjd, include_gps=None, include_bipm=None,
+                          bipm_version=None, limits="warn") -> np.ndarray:
+        """Total additive clock correction [s] bringing site TOAs to UTC
+        (+ optionally TT(BIPM)-TT(TAI))."""
+        utc_mjd = np.atleast_1d(np.asarray(utc_mjd, dtype=np.float64))
+        include_gps = self.include_gps if include_gps is None else include_gps
+        include_bipm = self.include_bipm if include_bipm is None else include_bipm
+        bipm_version = bipm_version or self.bipm_version
+        corr = np.zeros_like(utc_mjd)
+        for cf in self._site_clock_files():
+            if cf is not None:
+                corr = corr + cf.evaluate(utc_mjd, limits=limits)
+        if include_gps:
+            gps = find_clock_file("gps2utc.clk", fmt="tempo2", limits=limits)
+            if gps is not None:
+                corr = corr + gps.evaluate(utc_mjd, limits=limits)
+        if include_bipm:
+            bipm = find_clock_file(f"tai2tt_{bipm_version.lower()}.clk",
+                                   fmt="tempo2", limits=limits)
+            if bipm is not None:
+                # file gives TT(BIPM)-ideal TAI+32.184s; subtract the constant
+                corr = corr + bipm.evaluate(utc_mjd, limits=limits) - 32.184
+        return corr
+
+    # -- time scales -------------------------------------------------------
+    def get_TDBs(self, utc_mjd, method="default", ephem=None):
+        """Corrected-UTC MJD -> TDB MJD (longdouble)."""
+        return utc_to_tdb_mjd(utc_mjd)
+
+    # -- geometry ----------------------------------------------------------
+    def earth_location_itrf(self):
+        return None
+
+    def get_gcrs(self, utc_mjd, tt_mjd=None):
+        raise NotImplementedError
+
+    def posvel(self, utc_mjd, tdb_mjd, ephem="DE440") -> PosVel:
+        raise NotImplementedError
+
+
+class TopoObs(Observatory):
+    """Ground-based observatory at fixed ITRF coordinates (reference
+    ``topo_obs.py:65``)."""
+
+    def __init__(self, name, itrf_xyz_m, tempo_code="", itoa_code="",
+                 aliases=(), clock_files=(), clock_fmt="tempo", **kw):
+        al = list(aliases)
+        if tempo_code:
+            al.append(tempo_code)
+        if itoa_code:
+            al += [itoa_code.lower()]
+        super().__init__(name, al, **kw)
+        self.itrf_xyz = np.asarray(itrf_xyz_m, dtype=np.float64)
+        self.tempo_code = tempo_code
+        self.itoa_code = itoa_code
+        self.clock_file_names = list(clock_files)
+        self.clock_fmt = clock_fmt
+
+    def earth_location_itrf(self):
+        return self.itrf_xyz
+
+    def _site_clock_files(self):
+        return [
+            find_clock_file(n, fmt=self.clock_fmt)
+            for n in self.clock_file_names
+        ]
+
+    def get_gcrs(self, utc_mjd, tt_mjd=None):
+        """Site GCRS posvel: ([m], [m/s])."""
+        return gcrs_posvel_from_itrf(self.itrf_xyz, utc_mjd, tt_mjd)
+
+    def posvel(self, utc_mjd, tdb_mjd, ephem="DE440") -> PosVel:
+        eph = ephem_mod.load_ephemeris(ephem)
+        epos, evel = eph.posvel_ssb("earth", tdb_mjd)  # km, km/s
+        gpos, gvel = self.get_gcrs(utc_mjd)  # m, m/s
+        return PosVel(epos + gpos / 1e3, evel + gvel / 1e3, obj=self.name, origin="ssb")
+
+
+class GeocenterObs(Observatory):
+    """Earth geocenter pseudo-observatory (reference ``special_locations.py:117``)."""
+
+    def __init__(self):
+        super().__init__("geocenter", aliases=["0", "o", "coe", "geo"])
+
+    def get_gcrs(self, utc_mjd, tt_mjd=None):
+        utc_mjd = np.atleast_1d(np.asarray(utc_mjd, dtype=np.float64))
+        z = np.zeros(utc_mjd.shape + (3,))
+        return z, z
+
+    def posvel(self, utc_mjd, tdb_mjd, ephem="DE440") -> PosVel:
+        eph = ephem_mod.load_ephemeris(ephem)
+        epos, evel = eph.posvel_ssb("earth", tdb_mjd)
+        return PosVel(epos, evel, obj=self.name, origin="ssb")
+
+
+class BarycenterObs(Observatory):
+    """SSB pseudo-observatory: TOAs already barycentred (reference
+    ``special_locations.py:71``)."""
+
+    def __init__(self):
+        super().__init__("barycenter", aliases=["@", "bat", "ssb", "bary"],
+                         include_gps=False, include_bipm=False)
+
+    def clock_corrections(self, utc_mjd, **kw):
+        return np.zeros_like(np.atleast_1d(np.asarray(utc_mjd, dtype=np.float64)))
+
+    def get_TDBs(self, utc_mjd, method="default", ephem=None):
+        # barycentric TOAs are already TDB
+        return np.asarray(utc_mjd, dtype=np.longdouble)
+
+    def posvel(self, utc_mjd, tdb_mjd, ephem="DE440") -> PosVel:
+        tdb_mjd = np.atleast_1d(np.asarray(tdb_mjd, dtype=np.float64))
+        z = np.zeros(tdb_mjd.shape + (3,))
+        return PosVel(z, z, obj=self.name, origin="ssb")
+
+
+def _ensure_builtin():
+    if "gbt" in _registry:
+        return
+    GeocenterObs()
+    BarycenterObs()
+    for name, (x, y, z, tc, ic, aliases, clk, fmt) in SITES.items():
+        TopoObs(name, (x, y, z), tempo_code=tc, itoa_code=ic, aliases=aliases,
+                clock_files=clk, clock_fmt=fmt)
+
+
+def get_observatory(name: str, include_gps=None, include_bipm=None,
+                    bipm_version=None) -> Observatory:
+    """Reference-parity accessor (``observatory/__init__.py:519``).
+
+    Clock-chain options are only applied when passed explicitly, so a default
+    lookup never clobbers an earlier caller's configuration of the shared
+    registry entry.
+    """
+    _ensure_builtin()
+    obs = Observatory.get(name)
+    if include_gps is not None:
+        obs.include_gps = include_gps
+    if include_bipm is not None:
+        obs.include_bipm = include_bipm
+    if bipm_version is not None:
+        obs.bipm_version = bipm_version
+    return obs
+
+
+def list_observatories() -> List[str]:
+    _ensure_builtin()
+    return sorted(_registry)

@@ -1,0 +1,186 @@
+"""Clock-correction file readers: tempo ``time.dat`` and tempo2 ``.clk``.
+
+Native counterpart of reference ``observatory/clock_file.py:25,441,566``.
+A :class:`ClockFile` holds (mjd, clock_correction_us) samples and evaluates
+by linear interpolation, with a configurable out-of-range policy.  The
+global-repository download machinery of the reference
+(``global_clock_corrections.py``) is replaced by a search over local
+directories (``$PINT_CLOCK_DIR``, package data) since deployment targets are
+zero-egress; :func:`find_clock_file` returns a zero correction with a
+one-time warning when no file is found.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from pint_tpu.exceptions import ClockCorrectionOutOfRange, NoClockCorrections
+from pint_tpu.logging import log
+
+__all__ = ["ClockFile", "read_tempo_clock_file", "read_tempo2_clock_file", "find_clock_file"]
+
+
+class ClockFile:
+    """Measured clock offsets vs MJD with linear-interpolation evaluation."""
+
+    def __init__(self, mjd, clock_us, filename="", hdrline="", valid_beyond_ends=False):
+        self.mjd = np.asarray(mjd, dtype=np.float64)
+        self.clock_us = np.asarray(clock_us, dtype=np.float64)
+        order = np.argsort(self.mjd, kind="stable")
+        self.mjd, self.clock_us = self.mjd[order], self.clock_us[order]
+        self.filename = filename
+        self.hdrline = hdrline
+        self.valid_beyond_ends = valid_beyond_ends
+
+    @classmethod
+    def read(cls, path: str, fmt: str = "tempo", **kw) -> "ClockFile":
+        if fmt == "tempo2":
+            return read_tempo2_clock_file(path, **kw)
+        return read_tempo_clock_file(path, **kw)
+
+    def evaluate(self, mjd, limits: str = "warn") -> np.ndarray:
+        """Clock correction in seconds at the given MJD(s)."""
+        mjd = np.atleast_1d(np.asarray(mjd, dtype=np.float64))
+        if len(self.mjd) == 0:
+            return np.zeros_like(mjd)
+        out_of_range = (mjd < self.mjd[0]) | (mjd > self.mjd[-1])
+        if np.any(out_of_range) and not self.valid_beyond_ends:
+            msg = (
+                f"Clock file {self.filename or '<unnamed>'} does not cover "
+                f"MJD {mjd[out_of_range].min():.1f}..{mjd[out_of_range].max():.1f}"
+            )
+            if limits == "error":
+                raise ClockCorrectionOutOfRange(msg)
+            log.warning(msg)
+        return np.interp(mjd, self.mjd, self.clock_us) * 1e-6
+
+    def last_correction_mjd(self) -> float:
+        return float(self.mjd[-1]) if len(self.mjd) else -np.inf
+
+    def __add__(self, other: "ClockFile") -> "ClockFile":
+        """Merge two clock files by summing corrections on the union grid."""
+        mjds = np.union1d(self.mjd, other.mjd)
+        tot = self.evaluate(mjds, limits="warn") + other.evaluate(mjds, limits="warn")
+        return ClockFile(mjds, tot * 1e6, filename=f"{self.filename}+{other.filename}")
+
+    def write_tempo2_clock_file(self, path: str, hdrline: Optional[str] = None):
+        with open(path, "w") as f:
+            f.write((hdrline or self.hdrline or "# UTC(obs) UTC") + "\n")
+            for m, c in zip(self.mjd, self.clock_us):
+                f.write(f"{m:.5f} {c * 1e-6:.12e}\n")
+
+    def write_tempo_clock_file(self, path: str, obscode: str = "1"):
+        with open(path, "w") as f:
+            f.write("# fake header\n   MJD       EECO-REF    NIST-REF NS      DATE    COMMENTS\n")
+            for m, c in zip(self.mjd, self.clock_us):
+                f.write(f"{m:9.2f} {0.0:9.3f} {c:9.3f} {obscode}\n")
+
+
+def read_tempo_clock_file(path: str, obscode: Optional[str] = None, **kw) -> ClockFile:
+    """Parse a TEMPO-format ``time*.dat`` file (reference ``clock_file.py:25``).
+
+    Layout: columns MJD, EECO-REF offset [us], NIST-REF offset [us], obscode
+    flag; the correction applied to TOAs is col3 - col2.  Lines starting with
+    '#' or header text are skipped; a line beginning with 'MJD' is the header.
+    """
+    mjds: List[float] = []
+    corr: List[float] = []
+    with open(path) as f:
+        for ln in f:
+            s = ln.strip()
+            if not s or s.startswith("#") or s[0].isalpha():
+                continue
+            # 'si' special lines and comments
+            fields = s.split()
+            try:
+                mjd = float(fields[0])
+            except ValueError:
+                continue
+            if not (15000 < mjd < 100000):
+                continue
+            try:
+                c1 = float(fields[1])
+                c2 = float(fields[2]) if len(fields) > 2 else 0.0
+            except (ValueError, IndexError):
+                continue
+            code = fields[3] if len(fields) > 3 else None
+            if obscode is not None and code is not None and code.lower() != obscode.lower():
+                continue
+            mjds.append(mjd)
+            corr.append(c2 - c1)
+    return ClockFile(mjds, corr, filename=os.path.basename(path), **kw)
+
+
+def read_tempo2_clock_file(path: str, **kw) -> ClockFile:
+    """Parse a TEMPO2 ``.clk`` file (reference ``clock_file.py:441``).
+
+    First non-comment line is the header ``TIMEFROM TIMETO [flags]``; data
+    lines are ``MJD offset_seconds``.
+    """
+    mjds: List[float] = []
+    corr: List[float] = []
+    hdrline = ""
+    with open(path) as f:
+        for ln in f:
+            s = ln.strip()
+            if not s or s.startswith("#"):
+                continue
+            if not hdrline:
+                hdrline = s
+                continue
+            fields = s.split()
+            try:
+                mjds.append(float(fields[0]))
+                corr.append(float(fields[1]) * 1e6)  # seconds -> us
+            except (ValueError, IndexError):
+                continue
+    return ClockFile(mjds, corr, filename=os.path.basename(path), hdrline=hdrline, **kw)
+
+
+_warned: set = set()
+_cache: dict = {}
+
+
+def _clock_search_paths() -> List[str]:
+    paths = []
+    for env in ("PINT_CLOCK_OVERRIDE", "PINT_CLOCK_DIR"):
+        if os.environ.get(env):
+            paths.append(os.environ[env])
+    for env in ("TEMPO", "TEMPO2"):
+        if os.environ.get(env):
+            paths.append(os.path.join(os.environ[env], "clock"))
+    paths.append(os.path.join(os.path.dirname(__file__), "..", "data", "clock"))
+    return [p for p in paths if os.path.isdir(p)]
+
+
+def find_clock_file(name: str, fmt: str = "tempo", limits: str = "warn",
+                    valid_beyond_ends: bool = False) -> Optional[ClockFile]:
+    """Locate and parse the named clock file, searching local directories.
+
+    Returns None (with a one-time warning) when the file cannot be found —
+    the zero-egress analogue of the reference's warn-and-continue policy for
+    missing global clock corrections (``observatory/__init__.py:387``).
+    With ``limits="error"`` a missing file always raises, cached or not.
+    """
+    key = (name, fmt, valid_beyond_ends)
+    if key in _cache:
+        cf = _cache[key]
+        if cf is None and limits == "error":
+            raise NoClockCorrections(f"Clock file {name} not found")
+        return cf
+    for d in _clock_search_paths():
+        cand = os.path.join(d, name)
+        if os.path.exists(cand):
+            cf = ClockFile.read(cand, fmt=fmt, valid_beyond_ends=valid_beyond_ends)
+            _cache[key] = cf
+            return cf
+    _cache[key] = None
+    if limits == "error":
+        raise NoClockCorrections(f"Clock file {name} not found")
+    if name not in _warned:
+        _warned.add(name)
+        log.warning(f"Clock file {name} not found; assuming zero correction")
+    return None

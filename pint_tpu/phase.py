@@ -1,0 +1,84 @@
+"""Exact pulse phase as an (integer, fractional) pair of float64 arrays.
+
+Device-side counterpart of the reference's ``Phase`` namedtuple
+(``phase.py:7``): the integer part is an integral-valued float64 (exact up to
+2**53 cycles, far beyond any pulsar dataset) and the fractional part is kept
+in [-0.5, 0.5) with carry arithmetic (``phase.py:80-87``).  Keeping the split
+explicit means residuals (the fractional part) never suffer catastrophic
+cancellation against ~1e11-cycle absolute phases.
+
+Phase is a NamedTuple, hence a JAX pytree: it flows through jit/vmap/grad.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from pint_tpu.dd import DD, dd_add, dd_round_split
+
+__all__ = ["Phase", "phase_from_dd"]
+
+
+def _split(value):
+    """Normalize a float64 phase into (int, frac) with frac in [-0.5, 0.5)."""
+    k = jnp.round(value)
+    return k, value - k
+
+
+class Phase(NamedTuple):
+    """Pulse phase split as ``int_ + frac`` with ``frac`` in [-0.5, 0.5)."""
+
+    int_: jnp.ndarray
+    frac: jnp.ndarray
+
+    @classmethod
+    def from_float(cls, value) -> "Phase":
+        k, f = _split(jnp.asarray(value, dtype=jnp.float64))
+        return cls(k, f)
+
+    @classmethod
+    def make(cls, int_, frac) -> "Phase":
+        """Build from separate parts, re-normalizing the carry."""
+        int_ = jnp.asarray(int_, dtype=jnp.float64)
+        k, f = _split(jnp.asarray(frac, dtype=jnp.float64))
+        return cls(int_ + k, f)
+
+    def __add__(self, other: "Phase") -> "Phase":
+        if not isinstance(other, Phase):
+            other = Phase.from_float(other)
+        return Phase.make(self.int_ + other.int_, self.frac + other.frac)
+
+    __radd__ = __add__
+
+    def __sub__(self, other: "Phase") -> "Phase":
+        if not isinstance(other, Phase):
+            other = Phase.from_float(other)
+        return Phase.make(self.int_ - other.int_, self.frac - other.frac)
+
+    def __neg__(self) -> "Phase":
+        return Phase(-self.int_, -self.frac)
+
+    def to_float(self) -> jnp.ndarray:
+        """Collapse to a single float64 (loses sub-cycle precision at ~1e11)."""
+        return self.int_ + self.frac
+
+    @property
+    def quantity(self):
+        return self.to_float()
+
+    def __getitem__(self, idx):
+        return Phase(self.int_[idx], self.frac[idx])
+
+
+def phase_from_dd(x: DD) -> Phase:
+    """Exact split of a double-double cycle count into a Phase."""
+    k, f = dd_round_split(x)
+    return Phase(k, f)
+
+
+def phase_add_dd(p: Phase, x: DD) -> Phase:
+    """Add a dd-valued phase increment to a Phase without losing precision."""
+    k, f = dd_round_split(dd_add(x, p.frac))
+    return Phase.make(p.int_ + k, f)

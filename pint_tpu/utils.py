@@ -1,0 +1,176 @@
+"""Shared numerical utilities (counterpart of reference ``src/pint/utils.py``).
+
+Only the math core lives here; everything is jax.numpy and jit-friendly.
+Covers: Taylor/Horner series (``utils.py:411,441``), PosVel (``utils.py:181``),
+weighted statistics (``utils.py:1990``), design-matrix normalization
+(``utils.py:2872``), Woodbury/Sherman–Morrison products (``utils.py:3069,3019``),
+model-selection statistics (``utils.py:2907,2115``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "taylor_horner",
+    "taylor_horner_deriv",
+    "PosVel",
+    "weighted_mean",
+    "normalize_designmatrix",
+    "woodbury_dot",
+    "sherman_morrison_dot",
+    "FTest",
+    "akaike_information_criterion",
+    "bayesian_information_criterion",
+]
+
+
+def taylor_horner(x, coeffs: Sequence):
+    """Evaluate sum_i coeffs[i] * x**i / i! by Horner's method (float64).
+
+    Matches reference ``utils.py:411``: taylor_horner(2.0, [10, 3, 4, 12])
+    = 10 + 3*2 + 4*2^2/2 + 12*2^3/6.
+    """
+    return taylor_horner_deriv(x, coeffs, deriv_order=0)
+
+
+def taylor_horner_deriv(x, coeffs: Sequence, deriv_order: int = 1):
+    """d^k/dx^k of :func:`taylor_horner` (reference ``utils.py:441``)."""
+    x = jnp.asarray(x)
+    result = jnp.zeros_like(x, dtype=jnp.float64)
+    if len(coeffs) <= deriv_order:
+        return result
+    der_coeffs = [
+        jnp.asarray(c, dtype=jnp.float64) / math.factorial(i)
+        for i, c in enumerate(coeffs[deriv_order:])
+    ]
+    for c in reversed(der_coeffs):
+        result = result * x + c
+    return result
+
+
+class PosVel(NamedTuple):
+    """A position+velocity pair with provenance labels (reference ``utils.py:181``).
+
+    ``pos``/``vel`` are (..., 3) arrays; units are the caller's convention
+    (host pipeline uses km and km/s).  obj/origin give the vector's endpoints;
+    addition composes frames like the reference: (obj=B, origin=A) + (obj=C,
+    origin=B) = (obj=C, origin=A).
+    """
+
+    pos: jnp.ndarray
+    vel: jnp.ndarray
+    obj: str = ""
+    origin: str = ""
+
+    def __add__(self, other: "PosVel") -> "PosVel":
+        obj, origin = self.obj, self.origin
+        if self.obj and other.origin == self.obj:
+            obj, origin = other.obj, self.origin
+        elif other.obj and self.origin == other.obj:
+            obj, origin = self.obj, other.origin
+        return PosVel(self.pos + other.pos, self.vel + other.vel, obj, origin)
+
+    def __sub__(self, other: "PosVel") -> "PosVel":
+        return PosVel(self.pos - other.pos, self.vel - other.vel, self.obj, other.obj or self.origin)
+
+    def __neg__(self) -> "PosVel":
+        return PosVel(-self.pos, -self.vel, self.origin, self.obj)
+
+
+def weighted_mean(arr, weights, axis=None):
+    """Weighted mean and error (reference ``utils.py:1990``)."""
+    arr = jnp.asarray(arr)
+    weights = jnp.asarray(weights)
+    w = weights / jnp.sum(weights, axis=axis, keepdims=axis is not None)
+    mean = jnp.sum(arr * w, axis=axis)
+    err = jnp.sqrt(1.0 / jnp.sum(weights, axis=axis))
+    return mean, err
+
+
+def normalize_designmatrix(M, params=None):
+    """Scale each design-matrix column to unit L2 norm (reference ``utils.py:2872``).
+
+    Returns (M_normalized, norms).  Zero columns are left untouched (norm 1)
+    so downstream SVD thresholding can flag them as degenerate.
+    """
+    M = jnp.asarray(M)
+    norms = jnp.linalg.norm(M, axis=0)
+    safe = jnp.where(norms == 0, 1.0, norms)
+    return M / safe, norms
+
+
+def woodbury_dot(Ndiag, U, Phidiag, x, y):
+    """Compute x^T C^-1 y, logdet(C) for C = diag(N) + U diag(Phi) U^T.
+
+    Reference ``utils.py:3069``: the GLS chi2/likelihood kernel.  Uses the
+    Woodbury identity so only an (nbasis x nbasis) Cholesky is needed.
+    Returns (dot, logdet).
+    """
+    Ndiag = jnp.asarray(Ndiag)
+    Ninv_x = x / Ndiag
+    Ninv_y = y / Ndiag
+    Ut_Ninv_x = U.T @ Ninv_x
+    Ut_Ninv_y = U.T @ Ninv_y
+    Sigma = jnp.diag(1.0 / Phidiag) + U.T @ (U / Ndiag[:, None])
+    cf = jnp.linalg.cholesky(Sigma)
+    z = jnp.linalg.solve(cf, Ut_Ninv_y)
+    zx = jnp.linalg.solve(cf, Ut_Ninv_x)
+    dot = x @ Ninv_y - zx @ z
+    logdet = (
+        jnp.sum(jnp.log(Ndiag))
+        + jnp.sum(jnp.log(Phidiag))
+        + 2.0 * jnp.sum(jnp.log(jnp.diag(cf)))
+    )
+    return dot, logdet
+
+
+def sherman_morrison_dot(Ndiag, U, weights, x, y):
+    """x^T C^-1 y, logdet(C) for ECORR-only covariance (reference ``utils.py:3019``).
+
+    C = diag(N) + sum_k w_k u_k u_k^T with *disjoint* 0/1 basis vectors u_k
+    (epoch membership), so each rank-1 update applies Sherman–Morrison
+    independently.
+    """
+    Ninv_x = x / Ndiag
+    Ninv_y = y / Ndiag
+    dot = jnp.sum(x * Ninv_y)
+    logdet = jnp.sum(jnp.log(Ndiag))
+    # For disjoint columns: denominator 1 + w_k * sum(u_k^2/N)
+    ux = U.T @ Ninv_x
+    uy = U.T @ Ninv_y
+    uu = jnp.sum(U * U / Ndiag[:, None], axis=0)
+    denom = 1.0 + weights * uu
+    dot = dot - jnp.sum(weights * ux * uy / denom)
+    logdet = logdet + jnp.sum(jnp.log(denom))
+    return dot, logdet
+
+
+def FTest(chi2_1, dof_1, chi2_2, dof_2):
+    """F-test probability that the dof_2<dof_1 model improvement is by chance.
+
+    Reference ``utils.py:2115``.  Returns the p-value; small means the extra
+    parameters are significant.
+    """
+    from scipy.stats import f as fdist
+
+    delta_chi2 = chi2_1 - chi2_2
+    delta_dof = dof_1 - dof_2
+    if delta_chi2 <= 0 or delta_dof <= 0 or dof_2 <= 0:
+        return 1.0
+    F = (delta_chi2 / delta_dof) / (chi2_2 / dof_2)
+    return float(fdist.sf(F, delta_dof, dof_2))
+
+
+def akaike_information_criterion(lnlike: float, k: int) -> float:
+    """AIC = 2k - 2 ln L (reference ``utils.py:2907`` family)."""
+    return 2.0 * k - 2.0 * lnlike
+
+
+def bayesian_information_criterion(lnlike: float, k: int, n: int) -> float:
+    """BIC = k ln n - 2 ln L."""
+    return k * math.log(n) - 2.0 * lnlike

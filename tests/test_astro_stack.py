@@ -1,0 +1,167 @@
+"""Tests for the host astronomy stack: timescales, earth rotation, ephemerides,
+observatories.  Validation is against independent closed-form facts (leap
+seconds, equinox geometry, orbital invariants), not against the reference
+implementation (which cannot run here)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.earth import gcrs_posvel_from_itrf, itrf_to_gcrs_matrix
+from pint_tpu.ephemeris import AnalyticEphemeris, _EPS_J2000, load_ephemeris
+from pint_tpu.observatory import get_observatory, list_observatories
+from pint_tpu.observatory.clock_file import ClockFile, read_tempo2_clock_file
+from pint_tpu.timescales import (
+    tai_minus_utc,
+    tdb_minus_tt,
+    utc_to_tdb_mjd,
+    utc_to_tt_mjd,
+)
+
+AU_KM = 1.495978707e8
+
+
+class TestTimescales:
+    def test_leap_seconds_known_epochs(self):
+        assert tai_minus_utc(41317.0)[0] == 10.0
+        assert tai_minus_utc(50000.0)[0] == 29.0  # 1995
+        assert tai_minus_utc(53750.0)[0] == 33.0  # 2006
+        assert tai_minus_utc(58849.0)[0] == 37.0  # 2020
+        assert tai_minus_utc(60000.0)[0] == 37.0  # no leaps since 2017
+
+    def test_pre_1972_raises(self):
+        with pytest.raises(ValueError):
+            tai_minus_utc(41000.0)
+
+    def test_tt_offset(self):
+        tt = utc_to_tt_mjd(np.longdouble(53750.0))
+        assert float((tt - np.longdouble(53750.0)) * 86400) == pytest.approx(65.184)
+
+    def test_tdb_tt_bounded_and_annual(self):
+        mjds = np.arange(50000.0, 60000.0, 10.0)
+        d = tdb_minus_tt(mjds)
+        assert np.all(np.abs(d) < 2e-3)  # amplitude ~1.7 ms
+        assert np.max(d) > 1.2e-3 and np.min(d) < -1.2e-3
+
+    def test_tdb_precision_longdouble(self):
+        tdb = utc_to_tdb_mjd(np.longdouble("53478.2858714192189"))
+        # longdouble carries ~1e-13 day precision through the conversion
+        assert np.finfo(np.longdouble).eps < 2e-19
+        assert abs(float(tdb) - 53478.2866) < 1e-3
+
+
+class TestEarthRotation:
+    def test_matrix_orthonormal(self):
+        M = itrf_to_gcrs_matrix(np.array([53750.0, 58849.25]))
+        for m in M:
+            np.testing.assert_allclose(m @ m.T, np.eye(3), atol=1e-12)
+
+    def test_sidereal_rotation_rate(self):
+        # one sidereal day later the matrix should nearly repeat
+        M0 = itrf_to_gcrs_matrix(np.array([53750.0]))[0]
+        M1 = itrf_to_gcrs_matrix(np.array([53750.0 + 0.9972695663]))[0]
+        np.testing.assert_allclose(M0, M1, atol=5e-5)
+
+    def test_site_velocity_magnitude(self):
+        # GBT latitude ~38.4 deg: v = omega * R * cos(lat) ~ 0.365 km/s
+        itrf = [882589.289, -4924872.368, 3943729.418]
+        pos, vel = gcrs_posvel_from_itrf(itrf, np.array([53750.0]))
+        assert np.linalg.norm(pos) == pytest.approx(6.37e6, rel=0.01)
+        assert np.linalg.norm(vel) == pytest.approx(365.0, rel=0.02)
+
+    def test_precession_direction(self):
+        # The mean pole of date, expressed in J2000 coordinates, drifts toward
+        # +x at ~2004.3 arcsec/century (the date->J2000 matrix applied to
+        # (0,0,1) must have a POSITIVE x component ~ theta).
+        from pint_tpu.earth import _precession_matrix
+
+        T = 0.24
+        p = _precession_matrix(T) @ np.array([0.0, 0.0, 1.0])
+        theta = 2004.3109 * T * np.pi / (180 * 3600)
+        assert p[0] == pytest.approx(theta, rel=1e-3)
+        assert abs(p[1]) < 1e-4
+
+    def test_pole_stays_polar(self):
+        # a vector along the ITRF z-axis maps near the celestial pole
+        M = itrf_to_gcrs_matrix(np.array([55000.0]))[0]
+        z = M @ np.array([0.0, 0.0, 1.0])
+        assert z[2] > 0.99998
+
+
+class TestAnalyticEphemeris:
+    def setup_method(self):
+        self.eph = AnalyticEphemeris()
+
+    def test_earth_orbit_scale(self):
+        mjd = np.arange(50000.0, 60000.0, 50.0)
+        pos, vel = self.eph.posvel_ssb("earth", mjd)
+        r = np.linalg.norm(pos, axis=-1) / AU_KM
+        assert 0.975 < r.min() < 0.985
+        assert 1.012 < r.max() < 1.022
+        v = np.linalg.norm(vel, axis=-1)
+        assert 29.0 < v.min() and v.max() < 30.8
+
+    def test_equinox_solar_longitude(self):
+        # 2020-03-20 03:50 UTC equinox: apparent solar lon (of date) == 0,
+        # i.e. J2000 geometric lon == -precession(20.2 yr) ~ -0.2824 deg.
+        ep, _ = self.eph.posvel_ssb("earth", [58928.1597])
+        sp, _ = self.eph.posvel_ssb("sun", [58928.1597])
+        v = (sp - ep)[0]
+        c, s = np.cos(_EPS_J2000), np.sin(_EPS_J2000)
+        lon = np.degrees(np.arctan2(c * v[1] + s * v[2], v[0])) % 360
+        assert lon == pytest.approx(360.0 - 0.2824, abs=0.02)
+
+    def test_velocity_consistent_with_finite_difference(self):
+        mjd = np.array([55000.0])
+        pos0, vel = self.eph.posvel_ssb("earth", mjd)
+        dp = (self.eph.posvel_ssb("earth", mjd + 0.05)[0]
+              - self.eph.posvel_ssb("earth", mjd - 0.05)[0]) / (0.1 * 86400.0)
+        np.testing.assert_allclose(vel, dp, rtol=2e-3, atol=1e-4)
+
+    def test_moon_distance_range(self):
+        mjd = np.arange(53000.0, 54000.0, 1.0)
+        em, _ = self.eph.posvel_ssb("earth", mjd)
+        mm, _ = self.eph.posvel_ssb("moon", mjd)
+        d = np.linalg.norm(mm - em, axis=-1)
+        assert 354000 < d.min() < 372000
+        assert 398000 < d.max() < 410000
+
+    def test_ssb_is_mass_weighted_origin(self):
+        # Sun offset from SSB is dominated by Jupiter: ~ 0.005 AU scale
+        sp, _ = self.eph.posvel_ssb("sun", [55000.0])
+        r = np.linalg.norm(sp) / AU_KM
+        assert 0.001 < r < 0.012
+
+
+class TestObservatories:
+    def test_registry_and_aliases(self):
+        gbt = get_observatory("gbt")
+        assert get_observatory("1") is gbt  # tempo code
+        assert get_observatory("GB") is gbt  # itoa code
+        assert get_observatory("ao").name == "arecibo"
+        assert get_observatory("@").name == "barycenter"
+        assert get_observatory("coe").name == "geocenter"
+        assert len(list_observatories()) > 100
+
+    def test_site_posvel_near_earth(self):
+        gbt = get_observatory("gbt")
+        pv = gbt.posvel(np.array([53750.0]), np.array([53750.0]))
+        ep, _ = AnalyticEphemeris().posvel_ssb("earth", [53750.0])
+        assert np.linalg.norm(pv.pos - ep) < 7000.0  # within an Earth radius [km]
+
+    def test_barycenter_zero(self):
+        b = get_observatory("bat")
+        pv = b.posvel([53750.0], [53750.0])
+        assert np.all(pv.pos == 0)
+        assert np.all(b.clock_corrections([53750.0]) == 0)
+
+    def test_clock_file_tempo2_roundtrip(self, tmp_path):
+        p = tmp_path / "test2gps.clk"
+        p.write_text("# comment\nUTC(test) UTC\n50000.0 1.0e-6\n51000.0 3.0e-6\n")
+        cf = read_tempo2_clock_file(str(p))
+        assert cf.evaluate([50500.0])[0] == pytest.approx(2.0e-6)
+
+    def test_clock_file_out_of_range_warns_not_raises(self, tmp_path):
+        cf = ClockFile([50000.0, 51000.0], [1.0, 3.0], filename="x")
+        cf.evaluate([52000.0], limits="warn")
+        with pytest.raises(Exception):
+            cf.evaluate([52000.0], limits="error")

@@ -1,0 +1,186 @@
+"""Precision-layer tests: double-double arithmetic, Phase, taylor_horner.
+
+Mirrors the *strategy* of reference ``tests/test_precision.py`` (hypothesis
+round-trips of error-free transforms) against our DD/Phase implementation.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from pint_tpu import dd as ddm
+from pint_tpu.dd import (
+    DD,
+    dd_add,
+    dd_div,
+    dd_from_float,
+    dd_from_longdouble,
+    dd_from_string,
+    dd_mul,
+    dd_round_split,
+    dd_sub,
+    dd_to_longdouble,
+    taylor_horner_dd,
+    two_prod,
+    two_sum,
+)
+from pint_tpu.phase import Phase, phase_from_dd
+from pint_tpu.utils import taylor_horner, taylor_horner_deriv
+
+finite = st.floats(
+    allow_nan=False, allow_infinity=False, min_value=-1e15, max_value=1e15
+).filter(lambda x: x == 0 or abs(x) > 1e-140)
+
+
+@given(finite, finite)
+@settings(max_examples=200, deadline=None)
+def test_two_sum_exact(a, b):
+    s, e = two_sum(jnp.float64(a), jnp.float64(b))
+    # error-free: s + e == a + b in extended precision
+    assert np.longdouble(float(s)) + np.longdouble(float(e)) == np.longdouble(a) + np.longdouble(b)
+
+
+normalish = st.floats(
+    allow_nan=False, allow_infinity=False, min_value=-1e12, max_value=1e12
+).filter(lambda x: x == 0 or abs(x) > 1e-140)
+
+
+@given(normalish, normalish)
+@settings(max_examples=200, deadline=None)
+def test_two_prod_exact(a, b):
+    p, e = two_prod(jnp.float64(a), jnp.float64(b))
+    lhs = np.longdouble(float(p)) + np.longdouble(float(e))
+    rhs = np.longdouble(a) * np.longdouble(b)
+    # longdouble has less precision than exact product; allow 1 ulp of rhs
+    assert abs(lhs - rhs) <= np.abs(rhs) * np.finfo(np.longdouble).eps * 2 + np.finfo(np.float64).tiny
+
+
+def test_longdouble_roundtrip():
+    x = np.longdouble("53478.2858714192189")
+    d = dd_from_longdouble(x)
+    back = dd_to_longdouble(d)
+    assert back == x
+
+
+def test_string_mjd_precision():
+    # An MJD string with more digits than float64 can hold
+    s = "53801.38605120074849"
+    d = dd_from_string(s)
+    # hi alone loses the tail; hi+lo must recover it at the ~1e-16 day (10 ps) level
+    from fractions import Fraction
+
+    v = Fraction(s)
+    err = abs((Fraction(float(d.hi)) + Fraction(float(d.lo))) - v)
+    assert err < Fraction(1, 10**15)
+
+
+@given(finite, finite, finite)
+@settings(max_examples=100, deadline=None)
+def test_dd_add_associative_precision(a, b, c):
+    # Ground truth is exact rational arithmetic: double-double addition keeps
+    # ~106 bits, which can exceed x87 longdouble (64-bit mantissa).
+    from fractions import Fraction
+
+    x = dd_add(dd_from_float(a), dd_from_float(b))
+    y = dd_add(x, dd_from_float(c))
+    exact = Fraction(a) + Fraction(b) + Fraction(c)
+    got = Fraction(float(y.hi)) + Fraction(float(y.lo))
+    tol = Fraction(max(abs(a), abs(b), abs(c), 1.0)) * Fraction(2) ** -102
+    assert abs(got - exact) <= tol
+
+
+def test_dd_mul_div_roundtrip():
+    x = dd_from_string("12345.678901234567890123")
+    y = dd_from_string("0.37")
+    z = dd_div(dd_mul(x, y), y)
+    assert abs(dd_to_longdouble(z) - dd_to_longdouble(x)) < 1e-25 * 12345
+
+
+def test_dd_round_split_large():
+    # phase ~ 1e11 cycles: frac must survive to ~1e-12 cycles
+    from fractions import Fraction
+
+    v = Fraction(123456789012) + Fraction(1, 4) + Fraction(1, 10**11)
+    hi = float(v)
+    lo = float(v - Fraction(hi))
+    k, f = dd_round_split(DD(jnp.float64(hi), jnp.float64(lo)))
+    assert float(k) == 123456789012.0
+    assert abs(float(f) - (0.25 + 1e-11)) < 1e-13
+
+
+def test_phase_carry():
+    p = Phase.make(jnp.float64(10.0), jnp.float64(0.75))
+    assert float(p.int_) == 11.0
+    assert abs(float(p.frac) - (-0.25)) < 1e-15
+    q = p + Phase.make(0.0, -0.5)
+    assert float(q.int_) + float(q.frac) == pytest.approx(10.25)
+    assert -0.5 <= float(q.frac) < 0.5 or abs(float(q.frac) - 0.5) < 1e-12
+
+
+def test_phase_from_dd_spindown_scale():
+    # F0 * dt with dt ~ 3e8 s, F0 ~ 61.5 Hz -> ~2e10 cycles; check frac accuracy
+    F0 = "61.485476554"
+    dt_s = "300000000.0001"
+    from fractions import Fraction
+
+    exact = Fraction(F0) * Fraction(dt_s)
+    prod = dd_mul(dd_from_string(F0), dd_from_string(dt_s))
+    ph = phase_from_dd(prod)
+    exact_int = round(exact)
+    exact_frac = float(exact - exact_int)
+    assert float(ph.int_) == float(exact_int)
+    assert abs(float(ph.frac) - exact_frac) < 1e-10
+
+
+def test_taylor_horner_reference_value():
+    # reference utils.py:411 docstring example
+    assert float(taylor_horner(2.0, [10.0, 3.0, 4.0, 12.0])) == pytest.approx(
+        10 + 3 * 2 + 4 * 2**2 / 2 + 12 * 2**3 / 6
+    )
+
+
+def test_taylor_horner_deriv_matches_fd():
+    coeffs = [1.0, 0.5, -0.25, 0.125, 0.0625]
+    x = 1.7
+    h = 1e-6
+    fd = (float(taylor_horner(x + h, coeffs)) - float(taylor_horner(x - h, coeffs))) / (2 * h)
+    an = float(taylor_horner_deriv(x, coeffs, deriv_order=1))
+    assert an == pytest.approx(fd, rel=1e-8)
+
+
+def test_taylor_horner_dd_matches_fraction():
+    from fractions import Fraction
+
+    coeffs = ["61.485476554", "-1.181e-15", "0.0"]
+    x_s = "100000000.5"
+    got = taylor_horner_dd(dd_from_string(x_s), [float(c) for c in coeffs])
+    exact = sum(
+        Fraction(float(c)) * Fraction(x_s) ** i / math.factorial(i)
+        for i, c in enumerate(coeffs)
+    )
+    err = abs(Fraction(float(got.hi)) + Fraction(float(got.lo)) - exact)
+    # ~6e9 cycles; demand < 1e-10 cycle error
+    assert err < Fraction(1, 10**10)
+
+
+def test_dd_ops_jit_and_grad():
+    @jax.jit
+    def f(a):
+        x = dd_mul(dd_from_float(a), dd_from_string("61.485476554"))
+        ph = phase_from_dd(x)
+        return ph.frac
+
+    g = jax.grad(lambda a: f(a))(1234.000001)
+    # d(frac)/da == F0 (round() has zero derivative)
+    assert float(g) == pytest.approx(61.485476554, rel=1e-12)
+
+
+def test_dd_vmap():
+    xs = jnp.linspace(0.0, 1e8, 16)
+    out = jax.vmap(lambda x: dd_mul(dd_from_float(x), 3.0).to_float())(xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(xs) * 3.0, rtol=1e-15)
