@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Benchmark: chisq-grid fit throughput (the reference's headline workload).
+
+Reproduces the semantics of reference ``profiling/bench_chisq_grid_WLSFitter.py``
+(NGC6440E, WLS fit per grid point over an F0 x F1 grid; see BASELINE.md) and
+prints ONE JSON line:
+
+    {"metric": "chisq_grid_evals_per_sec", "value": N, "unit": "fits/s",
+     "vs_baseline": N / 0.057}
+
+Baseline: 0.057 fits/s (i7-6700K single core, BASELINE.md "Derived headline").
+Runs on whatever accelerator jax's default backend exposes (TPU under axon).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_FITS_PER_SEC = 0.057
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+NGC_TIM = "/root/reference/src/pint/data/examples/NGC6440E.tim"
+
+FALLBACK_PAR = """\
+PSR              BENCH6440E
+RAJ       17:48:52.75  1
+DECJ      -20:21:29.0  1
+F0       61.485476554  1
+F1         -1.181D-15  1
+PEPOCH        53750.000000
+POSEPOCH      53750.000000
+DM              223.9  1
+EPHEM               DE421
+CLK              TT(BIPM2019)
+UNITS               TDB
+TZRMJD  53801.38605120074849
+TZRFRQ            1949.609
+TZRSITE                  1
+"""
+
+
+def main():
+    t_setup = time.time()
+    import jax
+
+    # persistent XLA compilation cache: repeat bench runs skip the (slow,
+    # possibly remote) TPU compile
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.grid import grid_chisq
+    from pint_tpu.models import get_model, get_model_and_toas
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    rng = np.random.default_rng(12345)
+    if os.path.exists(NGC_PAR) and os.path.exists(NGC_TIM):
+        model, toas = get_model_and_toas(NGC_PAR, NGC_TIM)
+    else:
+        model = get_model([ln + "\n" for ln in FALLBACK_PAR.splitlines()])
+        toas = make_fake_toas_uniform(53400, 54800, 62, model, error_us=20.0,
+                                      add_noise=True, rng=rng)
+
+    # initial WLS fit (as the reference benchmark does before the grid)
+    f = WLSFitter(toas, model)
+    f.fit_toas(maxiter=3)
+
+    npts = 16  # 16x16 = 256 grid fits
+    # scale the grid span by sqrt(reduced chi2): with the built-in analytic
+    # ephemeris real-data residuals are systematics-dominated and formal
+    # errors understate the chi2 surface's scale
+    escale = max(1.0, np.sqrt(f.resids.reduced_chi2))
+    dF0 = 3 * escale * f.errors.get("F0", 1e-10)
+    dF1 = 3 * escale * f.errors.get("F1", 1e-18)
+    g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, npts)
+    g1 = np.linspace(f.model.F1.value - dF1, f.model.F1.value + dF1, npts)
+
+    # compile warmup at the full batch shape (vmap retraces per point count)
+    chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+    setup_s = time.time() - t_setup
+
+    t0 = time.time()
+    chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+    chi2 = np.asarray(chi2)
+    elapsed = time.time() - t0
+
+    # sanity: the grid minimum should be interior and near the fitted point
+    imin = np.unravel_index(np.argmin(chi2), chi2.shape)
+    ok = bool(np.isfinite(chi2).all()) and 0 < imin[0] < npts - 1 and 0 < imin[1] < npts - 1
+
+    fits_per_sec = chi2.size / elapsed
+    result = {
+        "metric": "chisq_grid_evals_per_sec",
+        "value": round(fits_per_sec, 3),
+        "unit": "fits/s",
+        "vs_baseline": round(fits_per_sec / BASELINE_FITS_PER_SEC, 1),
+    }
+    print(json.dumps(result))
+    if not ok:
+        print(f"WARNING: grid sanity check failed (argmin {imin})", file=sys.stderr)
+    print(
+        f"# {chi2.size} grid fits in {elapsed:.3f}s on {jax.devices()[0].platform} "
+        f"({len(toas)} TOAs; setup+compile {setup_s:.1f}s; "
+        f"min chi2 {chi2.min():.1f} at {imin})",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,273 @@
+"""Fitters: WLS (SVD), downhill iteration, auto dispatch.
+
+Counterpart of reference ``fitter.py`` (class map at SURVEY §2):
+``Fitter.auto`` (``fitter.py:193``), one-shot ``WLSFitter`` SVD solve
+(``fitter.py:1821,2645``), ``DownhillWLSFitter`` lambda-halving state machine
+(``fitter.py:843,919,1281``).  GLS-family fitters live in
+:mod:`pint_tpu.gls_fitter` once noise models are present.
+
+The linear algebra is jax/XLA (device-executable); the outer iteration is
+Python (data-dependent control flow stays off the trace, SURVEY §7 "hard
+parts").
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import numpy as np
+
+from pint_tpu.exceptions import (
+    ConvergenceFailure,
+    CorrelatedErrors,
+    DegeneracyWarning,
+    MaxiterReached,
+    StepProblem,
+)
+from pint_tpu.logging import log
+from pint_tpu.residuals import Residuals
+from pint_tpu.utils import normalize_designmatrix
+
+__all__ = ["Fitter", "WLSFitter", "DownhillFitter", "DownhillWLSFitter"]
+
+
+class Fitter:
+    """Base fitter: holds a model copy, TOAs, residuals, and fit products."""
+
+    def __init__(self, toas, model, residuals: Optional[Residuals] = None,
+                 track_mode: Optional[str] = None):
+        self.toas = toas
+        self.model_init = model
+        self.model = copy.deepcopy(model)
+        self.track_mode = track_mode
+        self.resids_init = Residuals(toas, self.model, track_mode=track_mode)
+        self.resids = residuals or Residuals(toas, self.model, track_mode=track_mode)
+        self.method = "base"
+        self.converged = False
+        self.parameter_covariance_matrix = None
+        self.errors = {}
+
+    # -- reference-parity constructor dispatch ------------------------------
+    @staticmethod
+    def auto(toas, model, downhill: bool = True, **kw) -> "Fitter":
+        """Choose the appropriate fitter for the model/TOAs (reference
+        ``fitter.py:193``)."""
+        wideband = getattr(toas, "wideband", False) or (
+            any("pp_dm" in fl for fl in toas.flags)
+        )
+        if wideband:
+            from pint_tpu.wideband import WidebandDownhillFitter, WidebandTOAFitter
+
+            return (WidebandDownhillFitter if downhill else WidebandTOAFitter)(toas, model, **kw)
+        if model.has_correlated_errors:
+            from pint_tpu.gls_fitter import DownhillGLSFitter, GLSFitter
+
+            return (DownhillGLSFitter if downhill else GLSFitter)(toas, model, **kw)
+        return (DownhillWLSFitter if downhill else WLSFitter)(toas, model, **kw)
+
+    # -- helpers ------------------------------------------------------------
+    def update_resids(self):
+        self.resids = Residuals(self.toas, self.model, track_mode=self.track_mode)
+        return self.resids
+
+    def get_fitparams(self) -> dict:
+        return {p: getattr(self.model, p).value for p in self.model.free_params}
+
+    def get_designmatrix(self):
+        return self.model.designmatrix(self.toas)
+
+    def get_parameter_correlation_matrix(self):
+        cov = self.parameter_covariance_matrix
+        if cov is None:
+            return None
+        d = np.sqrt(np.diag(cov))
+        return cov / np.outer(d, d)
+
+    def ftest(self, other_chi2: float, other_dof: int):
+        from pint_tpu.utils import FTest
+
+        return FTest(other_chi2, other_dof, self.resids.chi2, self.resids.dof)
+
+    def print_summary(self):
+        print(self.get_summary())
+
+    def get_summary(self, nodmx: bool = True) -> str:
+        """Human-readable fit report (reference ``fitter.py:295,442``)."""
+        r = self.resids
+        lines = [
+            f"Fitted model using {self.method} with {len(self.model.free_params)} free parameters to {len(self.toas)} TOAs",
+            f"Prefit residuals Wrms = {self.resids_init.rms_weighted() * 1e6:.4f} us, "
+            f"Postfit residuals Wrms = {r.rms_weighted() * 1e6:.4f} us",
+            f"Chisq = {r.chi2:.3f} for {r.dof} d.o.f. for reduced Chisq of {r.reduced_chi2:.3f}",
+            "",
+            f"{'PAR':<12} {'Prefit':>20} {'Postfit':>20} {'Uncertainty':>14} {'Units':>10}",
+        ]
+        for p in self.model.free_params:
+            if nodmx and p.startswith("DMX"):
+                continue
+            pre = getattr(self.model_init, p).value
+            post = getattr(self.model, p).value
+            unc = self.errors.get(p)
+            lines.append(
+                f"{p:<12} {str(pre):>20} {str(post):>20} "
+                f"{(f'{unc:.3g}' if unc is not None else '-'):>14} "
+                f"{getattr(self.model, p).units:>10}"
+            )
+        return "\n".join(lines)
+
+    def fit_toas(self, maxiter: int = 1, **kw) -> float:
+        raise NotImplementedError
+
+    # minimal API parity with reference fitters
+    def minimize_func(self, values: List[float], params: List[str]) -> float:
+        for v, p in zip(values, params):
+            getattr(self.model, p).value = v
+        self.update_resids()
+        return self.resids.chi2
+
+
+def _wls_step(M: np.ndarray, params: List[str], r: np.ndarray, sigma: np.ndarray,
+              threshold: Optional[float] = None):
+    """One whitened, normalized SVD least-squares solve.
+
+    Returns (dpars, cov, singular_values).  Mirrors reference
+    ``fitter.py:2645 fit_wls_svd`` incl. the singular-value threshold
+    (``fitter.py:2621 apply_Sdiag_threshold``).
+    """
+    Mw = M / sigma[:, None]
+    rw = r / sigma
+    Mn, norms = normalize_designmatrix(Mw)
+    U, S, Vt = np.linalg.svd(np.asarray(Mn), full_matrices=False)
+    if threshold is None:
+        threshold = np.finfo(np.float64).eps * max(M.shape)
+    Smax = S.max() if len(S) else 1.0
+    bad = S <= threshold * Smax
+    if np.any(bad):
+        import warnings
+
+        badp = [params[i] for i in np.argsort(np.abs(Vt[bad]).max(0))[::-1][:3]]
+        warnings.warn(
+            f"Degenerate parameter directions found (involving e.g. {badp}); "
+            "their singular values were zeroed",
+            DegeneracyWarning,
+        )
+    Sinv = np.where(bad, 0.0, 1.0 / np.where(S == 0, 1.0, S))
+    dpars = (Vt.T * Sinv) @ (U.T @ rw)
+    cov = (Vt.T * Sinv**2) @ Vt
+    norms = np.asarray(norms)
+    dpars = dpars / norms
+    cov = cov / np.outer(norms, norms)
+    return dpars, cov, S
+
+
+class WLSFitter(Fitter):
+    """One-shot weighted-least-squares fitter (reference ``fitter.py:1821``)."""
+
+    def __init__(self, toas, model, **kw):
+        super().__init__(toas, model, **kw)
+        if model.has_correlated_errors:
+            raise CorrelatedErrors(model)
+        self.method = "weighted_least_square"
+
+    def fit_toas(self, maxiter: int = 1, threshold: Optional[float] = None,
+                 debug: bool = False) -> float:
+        chi2 = self.resids.chi2
+        for _ in range(max(1, maxiter)):
+            r = self.resids.time_resids
+            sigma = self.resids.get_data_error()
+            M, params, units = self.get_designmatrix()
+            dpars, cov, S = _wls_step(M, params, r, sigma, threshold)
+            for dp, p in zip(dpars, params):
+                if p == "Offset":
+                    continue
+                par = getattr(self.model, p)
+                par.value = float(par.value or 0.0) + float(dp)
+            self.update_resids()
+            chi2 = self.resids.chi2
+            self.parameter_covariance_matrix = cov
+            self.fitted_params = params
+            for i, p in enumerate(params):
+                if p == "Offset":
+                    continue
+                err = float(np.sqrt(cov[i, i]))
+                self.errors[p] = err
+                getattr(self.model, p).uncertainty = err
+        self.converged = True
+        self.model.CHI2.value = chi2
+        return chi2
+
+
+class DownhillFitter(Fitter):
+    """Iterative fitter with lambda-halving line search (reference
+    ``fitter.py:843 ModelState`` / ``fitter.py:919 step``)."""
+
+    def __init__(self, toas, model, **kw):
+        super().__init__(toas, model, **kw)
+        self.method = "downhill"
+
+    def _solve_step(self):
+        r = self.resids.time_resids
+        sigma = self.resids.get_data_error()
+        M, params, units = self.get_designmatrix()
+        dpars, cov, S = _wls_step(M, params, r, sigma)
+        return dpars, params, cov
+
+    def fit_toas(self, maxiter: int = 20, required_chi2_decrease: float = 1e-2,
+                 max_chi2_increase: float = 1e-2, min_lambda: float = 1e-3,
+                 debug: bool = False) -> float:
+        best_chi2 = self.resids.chi2
+        self.converged = False
+        for it in range(maxiter):
+            dpars, params, cov = self._solve_step()
+            base_vals = {p: float(getattr(self.model, p).value or 0.0)
+                         for p in params if p != "Offset"}
+            lam = 1.0
+            improved = False
+            while lam >= min_lambda:
+                for dp, p in zip(dpars, params):
+                    if p == "Offset":
+                        continue
+                    getattr(self.model, p).value = base_vals[p] + lam * float(dp)
+                self.update_resids()
+                chi2 = self.resids.chi2
+                if chi2 < best_chi2 + max_chi2_increase:
+                    improved = True
+                    break
+                lam *= 0.5
+            if not improved:
+                # restore and stop
+                for p, v in base_vals.items():
+                    getattr(self.model, p).value = v
+                self.update_resids()
+                if it == 0:
+                    raise StepProblem(
+                        f"chi2 would not decrease from {best_chi2:.3f}")
+                break
+            decrease = best_chi2 - chi2
+            best_chi2 = chi2
+            self.parameter_covariance_matrix = cov
+            self.fitted_params = params
+            for i, p in enumerate(params):
+                if p == "Offset":
+                    continue
+                err = float(np.sqrt(cov[i, i]))
+                self.errors[p] = err
+                getattr(self.model, p).uncertainty = err
+            if decrease < required_chi2_decrease and lam == 1.0:
+                self.converged = True
+                break
+        else:
+            log.warning(f"Downhill fit hit maxiter={maxiter}")
+        self.model.CHI2.value = best_chi2
+        return best_chi2
+
+
+class DownhillWLSFitter(DownhillFitter):
+    """Reference ``fitter.py:1281``."""
+
+    def __init__(self, toas, model, **kw):
+        if model.has_correlated_errors:
+            raise CorrelatedErrors(model)
+        super().__init__(toas, model, **kw)
+        self.method = "downhill_wls"

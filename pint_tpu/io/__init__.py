@@ -1,0 +1,4 @@
+"""File-format IO: par files, tim files (tempo/tempo2/Princeton/Parkes)."""
+
+from pint_tpu.io.par import parse_parfile, format_parfile  # noqa: F401
+from pint_tpu.io.tim import read_tim_file, format_toa_line  # noqa: F401

@@ -1,0 +1,85 @@
+"""Par-file parsing and formatting.
+
+Counterpart of reference ``model_builder.py:53 parse_parfile`` /
+``timing_model.py:2862 as_parfile``, with fortran-style ``D`` exponents,
+repeated keys (JUMP/EFAC lines), fit flags, and uncertainties.  The result is
+an ordered multi-dict of raw string fields; interpretation (units, aliases,
+component mapping) happens in :mod:`pint_tpu.models.model_builder`.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+__all__ = ["parse_parfile", "format_parfile", "fortran_float", "ParLine"]
+
+_FORTRAN_RE = re.compile(r"([0-9.+\-]+)[DdE]([+\-]?[0-9]+)")
+
+
+def fortran_float(s: str) -> float:
+    """Parse a float allowing fortran 'D' exponents (e.g. -1.181D-15)."""
+    return float(s.translate(str.maketrans("Dd", "Ee")))
+
+
+class ParLine:
+    """One par-file entry: key + raw fields (value, fit flag, uncertainty)."""
+
+    __slots__ = ("key", "fields")
+
+    def __init__(self, key: str, fields: List[str]):
+        self.key = key
+        self.fields = fields
+
+    @property
+    def value(self) -> Optional[str]:
+        return self.fields[0] if self.fields else None
+
+    @property
+    def fit(self) -> bool:
+        """True when the tempo-style fit flag ('1') is present."""
+        return len(self.fields) >= 2 and self.fields[1] == "1"
+
+    @property
+    def uncertainty(self) -> Optional[str]:
+        if len(self.fields) >= 3:
+            return self.fields[2]
+        # two-field form "KEY value uncertainty" only when field2 is not a flag
+        if len(self.fields) == 2 and self.fields[1] not in ("0", "1"):
+            return self.fields[1]
+        return None
+
+    def __repr__(self):
+        return f"ParLine({self.key}, {self.fields})"
+
+
+def parse_parfile(path_or_lines) -> "OrderedDict[str, List[ParLine]]":
+    """Parse a par file into an ordered {KEY: [ParLine, ...]} multi-dict.
+
+    Accepts a filesystem path or an iterable of lines.  Keys are uppercased;
+    repeated keys (JUMP, EFAC, multiple glitches) accumulate in order.
+    """
+    if isinstance(path_or_lines, str):
+        with open(path_or_lines) as f:
+            lines = f.readlines()
+    else:
+        lines = list(path_or_lines)
+    out: "OrderedDict[str, List[ParLine]]" = OrderedDict()
+    for raw in lines:
+        line = raw.split("#")[0].strip()
+        if not line or line.startswith(("C ", "%")):
+            continue
+        fields = line.split()
+        key = fields[0].upper()
+        out.setdefault(key, []).append(ParLine(key, fields[1:]))
+    return out
+
+
+def format_parfile(entries: Dict[str, List[List[str]]]) -> str:
+    """Format {KEY: [[fields...], ...]} back into par-file text."""
+    lines = []
+    for key, rows in entries.items():
+        for fields in rows:
+            lines.append(" ".join([f"{key:<15}"] + [str(f) for f in fields]).rstrip())
+    return "\n".join(lines) + "\n"

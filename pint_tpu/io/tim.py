@@ -1,0 +1,227 @@
+"""Tim-file reading/writing: tempo2, Princeton, Parkes formats + commands.
+
+Behavior-compatible with reference ``toa.py:471 _parse_TOA_line`` /
+``toa.py:701 read_toa_file`` / ``toa.py:566 format_toa_line``: supported
+commands are FORMAT, MODE, TIME, PHASE, EFAC, EQUAD, EMIN, EMAX, FMIN, FMAX,
+SKIP/NOSKIP, INFO, JUMP (toggle pairs -> per-TOA 'jump'/'tim_jump' flags),
+INCLUDE (recursive), END.  MJDs are carried as exact (int day, decimal
+fraction string) pairs so no precision is lost before the double-double
+conversion.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import PintFileError
+from pint_tpu.logging import log
+
+__all__ = ["RawTOA", "read_tim_file", "format_toa_line"]
+
+_COMMANDS = {
+    "FORMAT", "MODE", "TIME", "PHASE", "EFAC", "EQUAD", "EMIN", "EMAX",
+    "FMIN", "FMAX", "SKIP", "NOSKIP", "INFO", "JUMP", "INCLUDE", "END",
+    "TRACK", "PHA1", "PHA2",
+}
+
+
+@dataclass
+class RawTOA:
+    """One TOA as read from disk, before any corrections."""
+
+    mjd_int: int
+    mjd_frac_str: str  # decimal fraction as string, full precision
+    error_us: float
+    freq_mhz: float
+    obs: str
+    name: str = ""
+    flags: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def mjd_float(self) -> float:
+        return self.mjd_int + float("0." + self.mjd_frac_str)
+
+    def mjd_longdouble(self) -> np.longdouble:
+        return np.longdouble(self.mjd_int) + np.longdouble("0." + self.mjd_frac_str)
+
+
+def _split_mjd(field_str: str) -> Tuple[int, str]:
+    if "." in field_str:
+        ii, ff = field_str.split(".")
+        return int(ii), ff or "0"
+    return int(field_str), "0"
+
+
+def _classify(line: str, current_fmt: str) -> str:
+    s = line.strip()
+    if not s:
+        return "Blank"
+    if line.startswith(("#", "%", "CC ")) or line.startswith("C "):
+        return "Comment"
+    first = s.split()[0].upper()
+    if first in _COMMANDS:
+        return "Command"
+    if current_fmt == "Tempo2":
+        return "Tempo2"
+    # Princeton: single-char observatory code in column 1, column 2 blank
+    if len(line) > 45 and line[1] == " " and not line[0].isspace():
+        return "Princeton"
+    if len(line) > 71 and line[0] == " " and line[41] == ".":
+        return "Parkes"
+    return "Unknown"
+
+
+def _parse_tempo2(line: str) -> RawTOA:
+    fields = line.split()
+    if len(fields) < 5:
+        raise PintFileError(f"Malformed tempo2 TOA line: {line!r}")
+    ii, ff = _split_mjd(fields[2])
+    toa = RawTOA(
+        mjd_int=ii, mjd_frac_str=ff, error_us=float(fields[3]),
+        freq_mhz=float(fields[1]), obs=fields[4], name=fields[0],
+    )
+    flagfields = fields[5:]
+    if len(flagfields) % 2 != 0:
+        raise PintFileError(f"Flags must come in -key value pairs: {flagfields}")
+    for i in range(0, len(flagfields), 2):
+        k = flagfields[i].lstrip("-")
+        if not k or not flagfields[i].startswith("-"):
+            raise PintFileError(f"Invalid flag {flagfields[i]!r}")
+        if k in ("error", "freq", "scale", "MJD", "flags", "obs", "name"):
+            raise PintFileError(f"TOA flag {k!r} would overwrite a TOA column")
+        toa.flags[k] = flagfields[i + 1]
+    return toa
+
+
+def _parse_princeton(line: str) -> RawTOA:
+    ii_str, ff = line[24:44].strip().split(".")
+    ii = int(ii_str)
+    if ii < 40000:  # two-digit-year era convention
+        ii += 39126
+    toa = RawTOA(
+        mjd_int=ii, mjd_frac_str=ff or "0",
+        error_us=float(line[44:53]), freq_mhz=float(line[15:24]),
+        obs=line[0].upper(),
+    )
+    try:
+        ddm = float(line[68:78])
+        if ddm != 0.0:
+            toa.flags["ddm"] = str(ddm)
+    except (ValueError, IndexError):
+        pass
+    return toa
+
+
+def _parse_parkes(line: str) -> RawTOA:
+    ii = int(line[34:41])
+    ff = line[42:55].strip()
+    phaseoffset = float(line[55:62])
+    if phaseoffset != 0:
+        raise PintFileError("Parkes-format phase offsets are not supported")
+    return RawTOA(
+        mjd_int=ii, mjd_frac_str=ff or "0",
+        error_us=float(line[63:71]), freq_mhz=float(line[25:34]),
+        obs=line[79].upper(), name=line[1:25].strip(),
+    )
+
+
+def read_tim_file(path: str, process_includes: bool = True,
+                  _state: Optional[dict] = None) -> Tuple[List[RawTOA], List]:
+    """Read a tim file, applying commands; returns (toas, commands)."""
+    top = _state is None
+    cd = _state if _state is not None else {
+        "FORMAT": "Unknown", "EFAC": 1.0, "EQUAD": 0.0, "EMIN": 0.0,
+        "EMAX": np.inf, "FMIN": 0.0, "FMAX": np.inf, "INFO": None,
+        "SKIP": False, "TIME": 0.0, "PHASE": 0.0, "JUMP": [False, 0],
+        "END": False,
+    }
+    toas: List[RawTOA] = []
+    commands: List = []
+    with open(path) as f:
+        lines = f.readlines()
+    for line in lines:
+        kind = _classify(line, cd["FORMAT"])
+        if kind in ("Blank", "Comment"):
+            continue
+        if kind == "Command":
+            fields = line.split()
+            cmd = fields[0].upper()
+            commands.append((fields, len(toas)))
+            if cmd == "SKIP":
+                cd["SKIP"] = True
+            elif cmd == "NOSKIP":
+                cd["SKIP"] = False
+            elif cmd == "END":
+                cd["END"] = True
+                if top:
+                    break
+            elif cmd in ("TIME", "PHASE"):
+                cd[cmd] += float(fields[1])
+            elif cmd in ("EMIN", "EMAX", "FMIN", "FMAX", "EFAC", "EQUAD"):
+                cd[cmd] = float(fields[1])
+            elif cmd == "INFO":
+                cd[cmd] = fields[1]
+            elif cmd == "FORMAT":
+                cd[cmd] = "Tempo2" if fields[1] == "1" else "Unknown"
+            elif cmd == "JUMP":
+                if cd["JUMP"][0]:
+                    cd["JUMP"] = [False, cd["JUMP"][1] + 1]
+                else:
+                    cd["JUMP"] = [True, cd["JUMP"][1]]
+            elif cmd == "MODE":
+                if fields[1] != "1":
+                    log.warning("MODE %s is not supported; ignored" % fields[1])
+            elif cmd == "INCLUDE" and process_includes:
+                sub = os.path.join(os.path.dirname(path), fields[1])
+                fmt_save, cd["FORMAT"] = cd["FORMAT"], "Unknown"
+                sub_toas, sub_cmds = read_tim_file(sub, _state=cd)
+                toas.extend(sub_toas)
+                commands.extend(sub_cmds)
+                cd["FORMAT"] = fmt_save
+            else:
+                log.warning(f"Unknown tim command ignored: {line.strip()}")
+            continue
+        if cd["SKIP"] or cd["END"] or kind == "Unknown":
+            continue
+        if kind == "Tempo2":
+            toa = _parse_tempo2(line)
+        elif kind == "Princeton":
+            toa = _parse_princeton(line)
+        else:
+            toa = _parse_parkes(line)
+        if not (cd["EMIN"] <= toa.error_us <= cd["EMAX"]):
+            continue
+        if not (cd["FMIN"] <= toa.freq_mhz <= cd["FMAX"]):
+            continue
+        toa.error_us = float(np.hypot(toa.error_us * cd["EFAC"], cd["EQUAD"]))
+        if cd["INFO"]:
+            toa.flags.setdefault("info", cd["INFO"])
+        if cd["JUMP"][0]:
+            toa.flags["jump"] = str(cd["JUMP"][1] + 1)
+            toa.flags["tim_jump"] = str(cd["JUMP"][1] + 1)
+        if cd["PHASE"] != 0:
+            toa.flags["phase"] = str(cd["PHASE"])
+        if cd["TIME"] != 0.0:
+            toa.flags["to"] = str(cd["TIME"])
+        toas.append(toa)
+    return toas, commands
+
+
+def format_toa_line(mjd_int: int, mjd_frac_str: str, error_us: float,
+                    freq_mhz: float, obs: str, name: str = "unk",
+                    flags: Optional[Dict[str, str]] = None,
+                    fmt: str = "tempo2") -> str:
+    """Format one TOA line (reference ``toa.py:566``)."""
+    if fmt.lower() in ("tempo2", "1"):
+        mjd_str = f"{mjd_int}.{mjd_frac_str}"
+        out = f"{name or 'unk'} {freq_mhz:.6f} {mjd_str} {error_us:.3f} {obs}"
+        for k, v in (flags or {}).items():
+            out += f" -{k} {v}"
+        return out + "\n"
+    # Princeton
+    mjd_str = f"{mjd_int}.{mjd_frac_str[:13]:<13}"
+    return f"{obs:1s}{'':14s}{freq_mhz:9.3f} {mjd_str:<20s}{error_us:8.2f}\n"

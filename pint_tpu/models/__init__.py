@@ -1,0 +1,26 @@
+"""Timing models: parameters, components, model builder.
+
+Public surface mirrors the reference (``pint.models``): ``get_model``,
+``get_model_and_toas``, ``TimingModel``, component classes.
+"""
+
+from pint_tpu.models.timing_model import TimingModel, Component  # noqa: F401
+from pint_tpu.models.parameter import (  # noqa: F401
+    Parameter,
+    floatParameter,
+    strParameter,
+    boolParameter,
+    intParameter,
+    MJDParameter,
+    AngleParameter,
+    prefixParameter,
+    maskParameter,
+)
+from pint_tpu.models import spindown  # noqa: F401
+from pint_tpu.models import astrometry  # noqa: F401
+from pint_tpu.models import dispersion_model  # noqa: F401
+from pint_tpu.models import solar_system_shapiro  # noqa: F401
+from pint_tpu.models import absolute_phase  # noqa: F401
+from pint_tpu.models import phase_offset  # noqa: F401
+from pint_tpu.models import jump  # noqa: F401
+from pint_tpu.models.model_builder import get_model, get_model_and_toas  # noqa: F401

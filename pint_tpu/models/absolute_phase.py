@@ -1,0 +1,58 @@
+"""Absolute phase reference (TZR): TZRMJD/TZRSITE/TZRFRQ.
+
+Reference ``absolute_phase.py:12``: the model phase is referenced to the
+pulse arriving at TZRSITE at TZRMJD observed at TZRFRQ; ``TimingModel.phase``
+with ``abs_phase=True`` subtracts the phase of that single reference TOA.
+The TZR TOA is built once on the host (``make_TZR_toa`` parity,
+``absolute_phase.py:130``) and cached.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import MJDParameter, floatParameter, strParameter
+from pint_tpu.models.timing_model import Component
+
+__all__ = ["AbsPhase"]
+
+
+class AbsPhase(Component):
+    register = True
+    category = "absolute_phase"
+    kind = "tzr"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("TZRMJD", description="Epoch of the zero phase TOA"))
+        self.add_param(strParameter("TZRSITE", description="Observatory of the zero phase TOA"))
+        self.add_param(floatParameter("TZRFRQ", units="MHz",
+                                      description="Frequency of the zero phase TOA"))
+        self._tzr_toas = None
+
+    def validate(self):
+        if self.TZRMJD.value is None:
+            raise MissingParameter("AbsPhase", "TZRMJD")
+
+    def get_TZR_toas(self, model):
+        """One-TOA TOAs at the TZR epoch (cached)."""
+        if self._tzr_toas is not None:
+            return self._tzr_toas
+        from pint_tpu.toa import make_single_toa
+
+        site = self.TZRSITE.value or "ssb"
+        freq = self.TZRFRQ.value if self.TZRFRQ.value else np.inf
+        ephem = None
+        if model is not None and getattr(model, "EPHEM", None) is not None:
+            ephem = model.EPHEM.value
+        planets = bool(getattr(model, "PLANET_SHAPIRO", None)
+                       and model.PLANET_SHAPIRO.value)
+        self._tzr_toas = make_single_toa(
+            np.longdouble(self.TZRMJD.value), site, freq_mhz=freq,
+            ephem=ephem or "DE440", planets=planets,
+        )
+        return self._tzr_toas
+
+    def clear_cache(self):
+        self._tzr_toas = None

@@ -1,0 +1,192 @@
+"""Astrometry: sky position, proper motion, parallax -> geometric delay.
+
+Reference ``astrometry.py:155 solar_system_geometric_delay`` convention:
+delay = -r_obs . n_psr  +  (PX term)  [seconds, positions in light-seconds].
+Equatorial (RAJ/DECJ/PMRA/PMDEC) and ecliptic (ELONG/ELAT/PMELONG/PMELAT)
+variants; the ecliptic frame uses the IERS2010 obliquity
+(reference ``pulsar_ecliptic.py``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import OBL_IERS2010_RAD
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import AngleParameter, MJDParameter, floatParameter
+from pint_tpu.models.timing_model import DAY_S, DelayComponent
+
+__all__ = ["AstrometryEquatorial", "AstrometryEcliptic"]
+
+#: mas/yr -> rad/day
+_MASYR_TO_RADDAY = (np.pi / 180.0 / 3600.0 / 1000.0) / 365.25
+#: kpc expressed in light-seconds
+_KPC_LS = 3.0856775814913673e19 / 299792458.0
+#: arcsec -> rad
+_MAS_RAD = np.pi / 180.0 / 3600.0 / 1000.0
+
+
+class Astrometry(DelayComponent):
+    category = "astrometry"
+
+    def ssb_to_psb_xyz(self, pv, epoch_mjd):
+        """Unit vector(s) to the pulsar in ICRS at given float64 MJD(s)."""
+        raise NotImplementedError
+
+    def barycentric_radio_freq(self, pv, batch):
+        """Observed frequency corrected for observatory motion (MHz)."""
+        L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
+        v_dot_L = jnp.sum(batch.ssb_obs_vel * L_hat, axis=1)
+        return batch.freq * (1.0 - v_dot_L)
+
+    def _geometric_delay(self, pv, batch, L_hat, px_mas):
+        r = batch.ssb_obs_pos  # (N,3) light-seconds
+        re_dot_L = jnp.sum(r * L_hat, axis=1)
+        delay = -re_dot_L
+        # parallax: 0.5 * re^2/L * (1 - (re.L)^2/re^2)   (ref astrometry.py:172-183)
+        # written as a smooth multiple of PX so the PX design-matrix column is
+        # nonzero even at PX == 0 (matching the reference's analytic partial)
+        re_sqr = jnp.sum(r * r, axis=1)
+        px_delay = (0.5 * re_sqr * (px_mas / _KPC_LS)
+                    * (1.0 - re_dot_L**2 / jnp.maximum(re_sqr, 1e-30)))
+        return delay + px_delay
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
+        return self._geometric_delay(pv, batch, L_hat, pv.get("PX", 0.0))
+
+
+class AstrometryEquatorial(Astrometry):
+    """Reference ``astrometry.py:272``."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(AngleParameter("RAJ", angle_type="hms", aliases=["RA"],
+                                      description="Right ascension (J2000)"))
+        self.add_param(AngleParameter("DECJ", angle_type="dms", aliases=["DEC"],
+                                      description="Declination (J2000)"))
+        self.add_param(floatParameter("PMRA", value=0.0, units="mas/yr",
+                                      description="Proper motion in RA (mu_alpha* = mu_alpha cos(dec))"))
+        self.add_param(floatParameter("PMDEC", value=0.0, units="mas/yr",
+                                      description="Proper motion in DEC"))
+        self.add_param(floatParameter("PX", value=0.0, units="mas", description="Parallax"))
+        self.add_param(MJDParameter("POSEPOCH", description="Epoch of position"))
+
+    def validate(self):
+        if self.RAJ.value is None or self.DECJ.value is None:
+            raise MissingParameter("AstrometryEquatorial", "RAJ/DECJ")
+        if self.POSEPOCH.value is None and (self.PMRA.value or self.PMDEC.value):
+            # fall back to PEPOCH like the reference
+            pep = getattr(self._parent, "PEPOCH", None)
+            if pep is not None and pep.value is not None:
+                self.POSEPOCH.value = pep.value
+
+    def _posepoch_mjd(self, batch):
+        pe = self.POSEPOCH.value
+        if pe is None and self._parent is not None:
+            pep = getattr(self._parent, "PEPOCH", None)
+            pe = pep.value if pep is not None else None
+        return float(pe) if pe is not None else float(batch.tdb0)
+
+    def ssb_to_psb_xyz(self, pv, epoch_mjd):
+        ra0 = pv["RAJ"]
+        dec0 = pv["DECJ"]
+        # proper motion applied linearly from POSEPOCH (traced value; the
+        # *presence* decision is structural, made at trace time)
+        if self.POSEPOCH.value is not None and "POSEPOCH" in pv:
+            pe = pv["POSEPOCH"]
+            pe = pe.to_float() if hasattr(pe, "to_float") else pe
+            dt_day = epoch_mjd - pe
+        else:
+            dt_day = jnp.zeros_like(epoch_mjd)
+        dec = dec0 + pv.get("PMDEC", 0.0) * _MASYR_TO_RADDAY * dt_day
+        ra = ra0 + pv.get("PMRA", 0.0) * _MASYR_TO_RADDAY * dt_day / jnp.cos(dec0)
+        cd = jnp.cos(dec)
+        return jnp.stack([cd * jnp.cos(ra), cd * jnp.sin(ra), jnp.sin(dec)], axis=-1)
+
+    def build_context(self, toas):
+        self._pe_cache = (float(self.POSEPOCH.value)
+                          if self.POSEPOCH.value is not None else None)
+        return {}
+
+    def coords_as_ICRS(self):
+        return float(self.RAJ.value), float(self.DECJ.value)
+
+    def sun_angle(self, pv, batch):
+        """Pulsar-Sun elongation angle at each TOA (rad)."""
+        L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
+        sun = batch.obs_sun_pos
+        sun_hat = sun / jnp.linalg.norm(sun, axis=1, keepdims=True)
+        return jnp.arccos(jnp.clip(jnp.sum(sun_hat * L_hat, axis=1), -1.0, 1.0))
+
+
+# rotation: ecliptic (IERS2010) -> equatorial
+_COS_OBL = np.cos(OBL_IERS2010_RAD)
+_SIN_OBL = np.sin(OBL_IERS2010_RAD)
+
+
+class AstrometryEcliptic(Astrometry):
+    """Reference ``astrometry.py:753`` (PulsarEcliptic frame, ``pulsar_ecliptic.py:20``)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(AngleParameter("ELONG", angle_type="deg", aliases=["LAMBDA"],
+                                      description="Ecliptic longitude"))
+        self.add_param(AngleParameter("ELAT", angle_type="deg", aliases=["BETA"],
+                                      description="Ecliptic latitude"))
+        self.add_param(floatParameter("PMELONG", value=0.0, units="mas/yr",
+                                      aliases=["PMLAMBDA"], description="PM in ecliptic longitude"))
+        self.add_param(floatParameter("PMELAT", value=0.0, units="mas/yr",
+                                      aliases=["PMBETA"], description="PM in ecliptic latitude"))
+        self.add_param(floatParameter("PX", value=0.0, units="mas", description="Parallax"))
+        self.add_param(MJDParameter("POSEPOCH", description="Epoch of position"))
+        from pint_tpu.models.parameter import strParameter
+
+        self.add_param(strParameter("ECL", value="IERS2010", description="Ecliptic convention"))
+
+    def validate(self):
+        if self.ELONG.value is None or self.ELAT.value is None:
+            raise MissingParameter("AstrometryEcliptic", "ELONG/ELAT")
+
+    def build_context(self, toas):
+        self._pe_cache = (float(self.POSEPOCH.value)
+                          if self.POSEPOCH.value is not None else None)
+        return {}
+
+    def ssb_to_psb_xyz(self, pv, epoch_mjd):
+        if self.POSEPOCH.value is not None and "POSEPOCH" in pv:
+            pe = pv["POSEPOCH"]
+            pe = pe.to_float() if hasattr(pe, "to_float") else pe
+            dt_day = epoch_mjd - pe
+        else:
+            dt_day = jnp.zeros_like(epoch_mjd)
+        lat = pv["ELAT"] + pv.get("PMELAT", 0.0) * _MASYR_TO_RADDAY * dt_day
+        lon = pv["ELONG"] + pv.get("PMELONG", 0.0) * _MASYR_TO_RADDAY * dt_day / jnp.cos(pv["ELAT"])
+        cb = jnp.cos(lat)
+        x_e = cb * jnp.cos(lon)
+        y_e = cb * jnp.sin(lon)
+        z_e = jnp.sin(lat)
+        # rotate ecliptic -> equatorial about x
+        y = _COS_OBL * y_e - _SIN_OBL * z_e
+        z = _SIN_OBL * y_e + _COS_OBL * z_e
+        return jnp.stack([x_e, y, z], axis=-1)
+
+    def coords_as_ICRS(self):
+        v = np.asarray(self.ssb_to_psb_xyz(
+            {"ELONG": self.ELONG.value, "ELAT": self.ELAT.value,
+             "PMELONG": 0.0, "PMELAT": 0.0},
+            np.array([0.0])))[0]
+        ra = float(np.arctan2(v[1], v[0]) % (2 * np.pi))
+        dec = float(np.arcsin(v[2]))
+        return ra, dec
+
+    def sun_angle(self, pv, batch):
+        L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
+        sun = batch.obs_sun_pos
+        sun_hat = sun / jnp.linalg.norm(sun, axis=1, keepdims=True)
+        return jnp.arccos(jnp.clip(jnp.sum(sun_hat * L_hat, axis=1), -1.0, 1.0))

@@ -1,0 +1,190 @@
+"""Dispersion delays: DM Taylor series, DMX piecewise, DMJUMP.
+
+Reference ``dispersion_model.py``: delay = K * DM(t) / f^2 with
+K = 1/2.41e-4 s MHz^2 cm^3/pc (``pint.DMconst``); DM(t) is a Taylor series in
+*years* about DMEPOCH (``dispersion_model.py:214 base_dm``).  Frequencies are
+barycentric when an astrometry component is present
+(``dispersion_model.py:51``).  DMX epochs are mask parameters resolved to
+per-range boolean arrays on the host.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DMconst
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import MJDParameter, maskParameter, prefixParameter
+from pint_tpu.models.timing_model import DelayComponent
+
+__all__ = ["Dispersion", "DispersionDM", "DispersionDMX", "DispersionJump"]
+
+_DAY_PER_YEAR = 365.25
+
+
+class Dispersion(DelayComponent):
+    category = "dispersion_constant"
+
+    def dispersion_time_delay(self, dm, freq):
+        return dm * DMconst / freq**2
+
+    def _freq(self, pv, batch):
+        parent = self._parent
+        if parent is not None:
+            for comp in parent.components.values():
+                if hasattr(comp, "barycentric_radio_freq"):
+                    return comp.barycentric_radio_freq(pv, batch)
+        return batch.freq
+
+
+class DispersionDM(Dispersion):
+    """Reference ``dispersion_model.py:129``."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter("DM0", units="pc/cm3", description="Dispersion measure"))
+        # DM is the canonical name for index 0
+        dm0 = self._params_dict.pop("DM0")
+        self.params.remove("DM0")
+        dm0.name = "DM"
+        dm0.prefix, dm0.index = "DM", 0
+        self.add_param(dm0)
+        self.add_param(prefixParameter("DM1", units="pc/cm3/yr", value=0.0,
+                                       description="DM derivative"))
+        self.add_param(MJDParameter("DMEPOCH", description="Epoch of DM measurement"))
+        self.num_dm_terms = 2
+
+    def setup(self):
+        idxs = [0] + sorted(
+            int(name[2:]) for name in self.params
+            if name.startswith("DM") and name[2:].isdigit() and name != "DM"
+        )
+        self.num_dm_terms = len(idxs)
+
+    def validate(self):
+        if self.DM.value is None:
+            raise MissingParameter("DispersionDM", "DM")
+        higher = any((self._params_dict.get(f"DM{i}") is not None
+                      and self._params_dict[f"DM{i}"].value)
+                     for i in range(1, self.num_dm_terms))
+        if higher and self.DMEPOCH.value is None:
+            pep = getattr(self._parent, "PEPOCH", None)
+            if pep is not None and pep.value is not None:
+                self.DMEPOCH.value = pep.value
+            else:
+                raise MissingParameter("DispersionDM", "DMEPOCH")
+
+    def get_dm_terms(self, pv):
+        return [pv.get("DM", 0.0)] + [pv.get(f"DM{i}", 0.0)
+                                      for i in range(1, self.num_dm_terms)]
+
+    def base_dm(self, pv, batch):
+        terms = self.get_dm_terms(pv)
+        if len(terms) == 1:
+            return terms[0] * jnp.ones_like(batch.freq)
+        if self.DMEPOCH.value is not None and "DMEPOCH" in pv:
+            dmepoch = pv["DMEPOCH"]
+            dmepoch = dmepoch.to_float() if hasattr(dmepoch, "to_float") else dmepoch
+        else:
+            dmepoch = batch.tdb0
+        dt_yr = (batch.tdb.hi - dmepoch) / _DAY_PER_YEAR
+        import math
+
+        acc = jnp.zeros_like(dt_yr)
+        for i in range(len(terms) - 1, -1, -1):
+            acc = acc * dt_yr + terms[i] / math.factorial(i)
+        return acc
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        freq = self._freq(pv, batch)
+        return self.dispersion_time_delay(self.base_dm(pv, batch), freq)
+
+
+class DispersionDMX(Dispersion):
+    """Piecewise-epoch DM offsets (reference ``dispersion_model.py:307``)."""
+
+    register = True
+    category = "dispersion_dmx"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter("DMX_0001", units="pc/cm3", value=0.0,
+                                       description="DM offset in range"))
+        self.add_param(prefixParameter("DMXR1_0001", units="MJD",
+                                       description="Range start MJD"))
+        self.add_param(prefixParameter("DMXR2_0001", units="MJD",
+                                       description="Range end MJD"))
+        self.dmx_indices = [1]
+
+    def setup(self):
+        self.dmx_indices = sorted(
+            int(name[4:]) for name in self.params if name.startswith("DMX_")
+        )
+
+    def validate(self):
+        for i in self.dmx_indices:
+            for pre in ("DMXR1_", "DMXR2_"):
+                nm = f"{pre}{i:04d}"
+                if nm not in self._params_dict or self._params_dict[nm].value is None:
+                    raise MissingParameter("DispersionDMX", nm)
+
+    def build_context(self, toas):
+        mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+        masks = []
+        for i in self.dmx_indices:
+            r1 = float(self._params_dict[f"DMXR1_{i:04d}"].value)
+            r2 = float(self._params_dict[f"DMXR2_{i:04d}"].value)
+            masks.append(((mjds >= r1) & (mjds <= r2)).astype(np.float64))
+        return {"masks": jnp.asarray(np.array(masks)) if masks else None}
+
+    def dmx_dm(self, pv, batch, ctx):
+        if ctx.get("masks") is None:
+            return jnp.zeros_like(batch.freq)
+        vals = jnp.stack([pv.get(f"DMX_{i:04d}", 0.0) for i in self.dmx_indices])
+        return jnp.sum(vals[:, None] * ctx["masks"], axis=0)
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        freq = self._freq(pv, batch)
+        return self.dispersion_time_delay(self.dmx_dm(pv, batch, ctx), freq)
+
+
+class DispersionJump(Dispersion):
+    """System-dependent DM offsets DMJUMP (reference ``dispersion_model.py:727``).
+
+    Note: DMJUMP applies only to wideband DM measurements, not to the TOA
+    delay (reference behavior); the delay contribution is zero.
+    """
+
+    register = True
+    category = "dispersion_jump"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(maskParameter("DMJUMP", index=1, units="pc/cm3", value=0.0,
+                                     description="DM offset for selected TOAs"))
+        self.dm_jumps = ["DMJUMP1"]
+
+    def setup(self):
+        self.dm_jumps = [p for p in self.params if p.startswith("DMJUMP")]
+
+    def build_context(self, toas):
+        n = len(toas)
+        masks = {}
+        for j in self.dm_jumps:
+            idx = self._params_dict[j].select_toa_mask(toas)
+            m = np.zeros(n)
+            m[idx] = 1.0
+            masks[j] = jnp.asarray(m)
+        return {"masks": masks}
+
+    def jump_dm(self, pv, batch, ctx):
+        out = jnp.zeros_like(batch.freq)
+        for j in self.dm_jumps:
+            out = out - pv.get(j, 0.0) * ctx["masks"][j]
+        return out
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        return jnp.zeros_like(batch.freq)

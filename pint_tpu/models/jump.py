@@ -1,0 +1,88 @@
+"""Phase and delay jumps between instrument/receiver groups.
+
+Reference ``jump.py:78 PhaseJump`` (phase += JUMP * F0 on the selected TOAs)
+and ``jump.py:11 DelayJump`` (delay -= JUMP).  JUMPs are mask parameters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import maskParameter
+from pint_tpu.models.timing_model import DelayComponent, PhaseComponent
+from pint_tpu.phase import Phase
+
+__all__ = ["PhaseJump", "DelayJump"]
+
+
+class PhaseJump(PhaseComponent):
+    register = True
+    category = "phase_jump"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(maskParameter("JUMP", index=1, units="s", value=0.0,
+                                     description="Phase jump (seconds) for selected TOAs"))
+        self.jumps = ["JUMP1"]
+
+    def setup(self):
+        self.jumps = [p for p in self.params if p.startswith("JUMP")]
+
+    def build_context(self, toas):
+        n = len(toas)
+        masks = {}
+        for j in self.jumps:
+            idx = self._params_dict[j].select_toa_mask(toas)
+            m = np.zeros(n)
+            m[idx] = 1.0
+            masks[j] = jnp.asarray(m)
+        return {"masks": masks}
+
+    def phase_func(self, pv, batch, ctx, delay):
+        jphase = jnp.zeros(batch.ntoas)
+        F0 = pv.get("F0", 0.0)
+        for j in self.jumps:
+            jphase = jphase + pv.get(j, 0.0) * F0 * ctx["masks"][j]
+        return Phase.from_float(jphase)
+
+    def get_number_of_jumps(self) -> int:
+        return len(self.jumps)
+
+    def jump_params_to_flags(self, toas):
+        """Stamp -jump flags onto selected TOAs (pintk parity helper)."""
+        for i, j in enumerate(self.jumps):
+            for k in self._params_dict[j].select_toa_mask(toas):
+                toas.flags[k]["jump"] = str(i + 1)
+
+
+class DelayJump(DelayComponent):
+    """Tempo-style delay jumps (reference ``jump.py:11``)."""
+
+    register = True
+    category = "jump_delay"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(maskParameter("JUMP", index=1, units="s", value=0.0,
+                                     description="Delay jump (seconds)"))
+        self.jumps = ["JUMP1"]
+
+    def setup(self):
+        self.jumps = [p for p in self.params if p.startswith("JUMP")]
+
+    def build_context(self, toas):
+        n = len(toas)
+        masks = {}
+        for j in self.jumps:
+            idx = self._params_dict[j].select_toa_mask(toas)
+            m = np.zeros(n)
+            m[idx] = 1.0
+            masks[j] = jnp.asarray(m)
+        return {"masks": masks}
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        d = jnp.zeros(batch.ntoas)
+        for j in self.jumps:
+            d = d - pv.get(j, 0.0) * ctx["masks"][j]
+        return d

@@ -1,0 +1,406 @@
+"""Typed model parameters (counterpart of reference ``parameter.py``).
+
+Values are stored in canonical par-file units as plain floats (F0 in Hz,
+DM in pc/cm^3, PMRA in mas/yr, JUMP in s, angles in **radians**, epochs as
+numpy longdouble MJD).  No astropy Quantities: the unit is metadata used at
+the par-file boundary and for display; jitted evaluation consumes the raw
+float (or a DD pair for epochs).
+
+Parameter kinds: float/str/bool/int/MJD/Angle plus
+* :class:`prefixParameter` — indexed families (F0, F1, ..., DMX_0001),
+* :class:`maskParameter` — parameters selecting TOA subsets
+  (JUMP -fe 430, EFAC -f L-wide, DMX ranges) with host-side mask resolution,
+* :class:`pairParameter`, :class:`funcParameter` for completeness.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from pint_tpu.exceptions import PrefixError
+from pint_tpu.io.par import fortran_float
+
+__all__ = [
+    "Parameter",
+    "floatParameter",
+    "strParameter",
+    "boolParameter",
+    "intParameter",
+    "MJDParameter",
+    "AngleParameter",
+    "prefixParameter",
+    "maskParameter",
+    "pairParameter",
+    "funcParameter",
+    "split_prefixed_name",
+]
+
+_PREFIX_RE = re.compile(r"^([A-Za-z][A-Za-z0-9]*?_?)(\d+)$")
+
+
+def split_prefixed_name(name: str):
+    """Split 'F12' -> ('F', 12), 'DMX_0001' -> ('DMX_', 1); raise otherwise."""
+    m = _PREFIX_RE.match(name)
+    if m is None:
+        raise PrefixError(f"Not a prefixed parameter name: {name!r}")
+    return m.group(1), int(m.group(2))
+
+
+def parse_angle(s: str, is_ra: bool = False) -> float:
+    """Parse 'hh:mm:ss.s' / 'dd:mm:ss.s' / decimal degrees -> radians."""
+    s = s.strip()
+    if ":" in s:
+        sign = -1.0 if s.lstrip().startswith("-") else 1.0
+        parts = s.lstrip("+-").split(":")
+        val = abs(float(parts[0]))
+        if len(parts) > 1:
+            val += float(parts[1]) / 60.0
+        if len(parts) > 2:
+            val += float(parts[2]) / 3600.0
+        val *= sign
+        deg = val * 15.0 if is_ra else val
+    else:
+        deg = fortran_float(s)
+        if is_ra and abs(deg) <= 24.0 and ":" not in s:
+            # bare number for RA is in hours by tempo convention
+            deg = deg * 15.0
+    return deg * np.pi / 180.0
+
+
+def format_angle(rad: float, is_ra: bool = False, ndp: int = 8) -> str:
+    deg = rad * 180.0 / np.pi
+    if is_ra:
+        hours = deg / 15.0 % 24.0
+        h = int(hours)
+        m = int((hours - h) * 60)
+        s = (hours - h - m / 60.0) * 3600.0
+        return f"{h:02d}:{m:02d}:{s:0{3 + ndp}.{ndp}f}"
+    sign = "-" if deg < 0 else ""
+    deg = abs(deg)
+    d = int(deg)
+    m = int((deg - d) * 60)
+    s = (deg - d - m / 60.0) * 3600.0
+    return f"{sign}{d:d}:{m:02d}:{s:0{3 + ndp}.{ndp}f}"
+
+
+class Parameter:
+    """Base parameter: name, value, units metadata, frozen flag, aliases."""
+
+    def __init__(self, name: str, value=None, units: str = "", description: str = "",
+                 frozen: bool = True, aliases: Optional[List[str]] = None,
+                 uncertainty=None, continuous: bool = True, **kw):
+        self.name = name
+        self.units = units
+        self.description = description
+        self.frozen = frozen
+        self.aliases = aliases or []
+        self.uncertainty = uncertainty
+        self.continuous = continuous
+        self.value = value
+        self._component = None  # set by Component.add_param
+
+    # -- par-file boundary -------------------------------------------------
+    def str2value(self, s: str):
+        return fortran_float(s)
+
+    def value2str(self, v) -> str:
+        return repr(v)
+
+    def from_parfile_fields(self, fields: List[str]):
+        """Set value/fit/uncertainty from raw par-file fields."""
+        if not fields:
+            return
+        self.value = self.str2value(fields[0])
+        if len(fields) >= 2:
+            f1 = fields[1]
+            if f1 in ("0", "1"):
+                self.frozen = f1 != "1"
+                if len(fields) >= 3:
+                    try:
+                        self.uncertainty = self.str2value(fields[2])
+                    except ValueError:
+                        pass
+            else:
+                try:
+                    self.uncertainty = self.str2value(f1)
+                except ValueError:
+                    pass
+
+    def as_parfile_line(self) -> str:
+        if self.value is None:
+            return ""
+        line = f"{self.name:<15} {self.value2str(self.value):>25}"
+        if not self.frozen:
+            line += " 1"
+        if self.uncertainty is not None:
+            if self.frozen:
+                line += " 0"
+            line += f" {self.value2str(self.uncertainty)}"
+        return line + "\n"
+
+    @property
+    def quantity(self):
+        return self.value
+
+    def __repr__(self):
+        fit = "" if self.frozen else " fit"
+        return f"{type(self).__name__}({self.name}={self.value}{fit})"
+
+    def name_matches(self, key: str) -> bool:
+        key = key.upper()
+        return key == self.name.upper() or key in (a.upper() for a in self.aliases)
+
+
+class floatParameter(Parameter):
+    def str2value(self, s):
+        return fortran_float(s)
+
+    def value2str(self, v):
+        return f"{v:.15g}"
+
+
+class strParameter(Parameter):
+    def str2value(self, s):
+        return s
+
+    def value2str(self, v):
+        return str(v)
+
+
+class boolParameter(Parameter):
+    def str2value(self, s):
+        return s.upper() in ("Y", "YES", "T", "TRUE", "1")
+
+    def value2str(self, v):
+        return "Y" if v else "N"
+
+
+class intParameter(Parameter):
+    def str2value(self, s):
+        return int(float(s))
+
+    def value2str(self, v):
+        return str(int(v))
+
+
+class MJDParameter(Parameter):
+    """Epoch parameter: value is numpy longdouble MJD (full precision)."""
+
+    def __init__(self, *a, **kw):
+        kw.setdefault("units", "MJD")
+        super().__init__(*a, **kw)
+
+    def str2value(self, s):
+        return np.longdouble(s.translate(str.maketrans("Dd", "Ee")))
+
+    def value2str(self, v):
+        return str(np.longdouble(v))
+
+    @property
+    def value_float(self) -> float:
+        return float(self.value) if self.value is not None else None
+
+
+class AngleParameter(Parameter):
+    """Angle parameter stored in radians; par IO in h:m:s or d:m:s."""
+
+    def __init__(self, *a, angle_type: str = "dms", **kw):
+        self.angle_type = angle_type  # 'hms' (RA), 'dms' (DEC), 'deg', 'rad'
+        kw.setdefault("units", {"hms": "hourangle", "dms": "deg"}.get(angle_type, angle_type))
+        super().__init__(*a, **kw)
+
+    def str2value(self, s):
+        if self.angle_type == "hms":
+            return parse_angle(s, is_ra=True)
+        if self.angle_type == "dms":
+            return parse_angle(s, is_ra=False)
+        if self.angle_type == "deg":
+            return fortran_float(s) * np.pi / 180.0
+        return fortran_float(s)
+
+    def value2str(self, v):
+        if self.angle_type == "hms":
+            return format_angle(v, is_ra=True)
+        if self.angle_type == "dms":
+            return format_angle(v, is_ra=False)
+        if self.angle_type == "deg":
+            return f"{v * 180.0 / np.pi:.13f}"
+        return f"{v:.15g}"
+
+    def from_parfile_fields(self, fields):
+        # uncertainties on angles come in arcsec (dms) / s-of-time (hms)
+        if not fields:
+            return
+        self.value = self.str2value(fields[0])
+        rest = fields[1:]
+        if rest and rest[0] in ("0", "1"):
+            self.frozen = rest[0] != "1"
+            rest = rest[1:]
+        if rest:
+            try:
+                err = fortran_float(rest[0])
+                scale = np.pi / (180.0 * 3600.0)
+                if self.angle_type == "hms":
+                    scale *= 15.0
+                self.uncertainty = err * scale
+            except ValueError:
+                pass
+
+
+class prefixParameter(floatParameter):
+    """One member of an indexed family (F2, DMX_0017, GLF0_2...).
+
+    ``prefix`` and ``index`` are derived from the name; components create new
+    members on demand while reading par files (reference ``parameter.py:1063``).
+    """
+
+    def __init__(self, name: str, *a, **kw):
+        self.prefix, self.index = split_prefixed_name(name)
+        self.unit_template: Optional[Callable[[int], str]] = kw.pop("unit_template", None)
+        self.description_template = kw.pop("description_template", None)
+        super().__init__(name, *a, **kw)
+
+    def new_param(self, index: int, **overrides) -> "prefixParameter":
+        if self.index >= 0 and "_" in self.prefix:
+            nm = f"{self.prefix}{index:04d}"
+        else:
+            nm = f"{self.prefix}{index}"
+        kw = dict(units=self.units, description=self.description, frozen=True)
+        kw.update(overrides)
+        p = prefixParameter(nm, **kw)
+        if self.unit_template:
+            p.units = self.unit_template(index)
+        return p
+
+
+class maskParameter(floatParameter):
+    """Parameter applying to a flag/observatory/MJD/frequency-selected TOA
+    subset (reference ``parameter.py:1433``).
+
+    Par syntax: ``JUMP -fe 430 0.0 1`` or ``JUMP MJD 57000 57100 0.0`` etc.
+    ``select_toa_mask(toas)`` resolves to integer indices on the host; the
+    jitted evaluator consumes the baked boolean array.
+    """
+
+    def __init__(self, name: str, index: int = 1, key: Optional[str] = None,
+                 key_value: Optional[list] = None, **kw):
+        self.prefix = name
+        self.index = index
+        self.key = key
+        self.key_value = list(key_value) if key_value else []
+        self.origin_name = name
+        super().__init__(f"{name}{index}", **kw)
+
+    def from_parfile_fields(self, fields: List[str]):
+        # forms: [key, key_value..., value, (fit), (uncertainty)]
+        if not fields:
+            return
+        key = fields[0].lower()
+        if key.startswith("-"):
+            self.key = key
+            self.key_value = [fields[1]]
+            rest = fields[2:]
+        elif key in ("mjd", "freq"):
+            self.key = key
+            self.key_value = [fortran_float(fields[1]), fortran_float(fields[2])]
+            rest = fields[3:]
+        elif key in ("tel", "name"):
+            self.key = key
+            self.key_value = [fields[1]]
+            rest = fields[2:]
+        else:
+            # tempo-style "JUMP value" with no selector (rare; tim-file jumps)
+            self.key = None
+            rest = fields
+        if rest:
+            self.value = self.str2value(rest[0])
+            rest = rest[1:]
+        if rest and rest[0] in ("0", "1"):
+            self.frozen = rest[0] != "1"
+            rest = rest[1:]
+        if rest:
+            try:
+                self.uncertainty = self.str2value(rest[0])
+            except ValueError:
+                pass
+
+    def as_parfile_line(self) -> str:
+        if self.value is None:
+            return ""
+        if self.key is None:
+            sel = ""
+        elif self.key in ("mjd", "freq"):
+            sel = f" {self.key.upper()} {self.key_value[0]} {self.key_value[1]}"
+        elif self.key in ("tel", "name"):
+            sel = f" {self.key.upper()} {self.key_value[0]}"
+        else:
+            sel = f" {self.key} {' '.join(str(v) for v in self.key_value)}"
+        line = f"{self.origin_name}{sel} {self.value2str(self.value)}"
+        if not self.frozen:
+            line += " 1"
+        if self.uncertainty is not None:
+            line += f" {self.value2str(self.uncertainty)}"
+        return line + "\n"
+
+    def select_toa_mask(self, toas) -> np.ndarray:
+        """Integer indices of the TOAs this parameter applies to."""
+        n = len(toas)
+        if self.key is None:
+            return np.arange(n)
+        if self.key == "mjd":
+            m = np.asarray(toas.get_mjds(), dtype=np.float64)
+            lo, hi = float(self.key_value[0]), float(self.key_value[1])
+            return np.nonzero((m >= lo) & (m <= hi))[0]
+        if self.key == "freq":
+            f = toas.get_freqs()
+            lo, hi = float(self.key_value[0]), float(self.key_value[1])
+            return np.nonzero((f >= lo) & (f <= hi))[0]
+        if self.key == "tel":
+            from pint_tpu.observatory import get_observatory
+
+            want = get_observatory(str(self.key_value[0])).name
+            return np.nonzero(toas.get_obss() == want)[0]
+        if self.key == "name":
+            names = np.array([fl.get("name", "") for fl in toas.flags])
+            return np.nonzero(names == str(self.key_value[0]))[0]
+        # flag key, e.g. -fe 430
+        flag = self.key.lstrip("-")
+        want = str(self.key_value[0])
+        sel = np.array([fl.get(flag) == want for fl in toas.flags])
+        return np.nonzero(sel)[0]
+
+    def new_param(self, index: int, **overrides) -> "maskParameter":
+        kw = dict(units=self.units, description=self.description, frozen=True)
+        kw.update(overrides)
+        return maskParameter(self.origin_name, index=index, **kw)
+
+
+class pairParameter(floatParameter):
+    """Parameter whose value is a pair of floats (reference ``parameter.py:1781``)."""
+
+    def str2value(self, s):
+        return [fortran_float(x) for x in s.split()]
+
+    def from_parfile_fields(self, fields):
+        if len(fields) >= 2:
+            self.value = [fortran_float(fields[0]), fortran_float(fields[1])]
+
+    def value2str(self, v):
+        return f"{v[0]:.15g} {v[1]:.15g}"
+
+
+class funcParameter(floatParameter):
+    """Read-only parameter derived from others (reference ``parameter.py``)."""
+
+    def __init__(self, name: str, func: Callable = None, params: List[str] = (), **kw):
+        super().__init__(name, **kw)
+        self.func = func
+        self.source_params = list(params)
+        self.frozen = True
+
+    def evaluate(self, model):
+        vals = [getattr(model, p).value for p in self.source_params]
+        return self.func(*vals) if self.func else None

@@ -1,0 +1,28 @@
+"""Explicit fitted overall phase offset PHOFF (reference ``phase_offset.py:10``).
+
+When present, the implicit 'Offset' design-matrix column is dropped and PHOFF
+is fit like any other parameter; phase contribution is -PHOFF on every TOA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.parameter import floatParameter
+from pint_tpu.models.timing_model import PhaseComponent
+from pint_tpu.phase import Phase
+
+__all__ = ["PhaseOffset"]
+
+
+class PhaseOffset(PhaseComponent):
+    register = True
+    category = "phase_offset"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("PHOFF", value=0.0, units="",
+                                      description="Overall phase offset"))
+
+    def phase_func(self, pv, batch, ctx, delay):
+        return Phase.from_float(-pv.get("PHOFF", 0.0) * jnp.ones(batch.ntoas))

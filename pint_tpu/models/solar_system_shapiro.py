@@ -1,0 +1,54 @@
+"""Solar-system Shapiro delay (reference ``solar_system_shapiro.py``).
+
+delay = -2 T_obj ln((r - r.n_psr)/AU) per body, Sun always, planets when
+PLANET_SHAPIRO is set (reference ``solar_system_shapiro.py:59,83``).
+Positions come in as obs->object vectors in light-seconds.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import pint_tpu
+from pint_tpu.models.timing_model import DelayComponent
+
+__all__ = ["SolarSystemShapiro"]
+
+_AU_LS = pint_tpu.AU_LS
+
+_T_PLANET = {
+    "jupiter": pint_tpu.Tjupiter,
+    "saturn": pint_tpu.Tsaturn,
+    "venus": pint_tpu.Tvenus,
+    "uranus": pint_tpu.Turanus,
+    "neptune": pint_tpu.Tneptune,
+}
+
+
+class SolarSystemShapiro(DelayComponent):
+    register = True
+    category = "solar_system_shapiro"
+
+    @staticmethod
+    def ss_obj_shapiro_delay(obj_pos_ls, psr_dir, T_obj):
+        """-2 T ln((r - r.n)/AU); obj_pos is obs->object in light-seconds."""
+        r = jnp.linalg.norm(obj_pos_ls, axis=1)
+        rcostheta = jnp.sum(obj_pos_ls * psr_dir, axis=1)
+        return -2.0 * T_obj * jnp.log((r - rcostheta) / _AU_LS)
+
+    def _psr_dir(self, pv, batch):
+        for comp in self._parent.components.values():
+            if hasattr(comp, "ssb_to_psb_xyz"):
+                return comp.ssb_to_psb_xyz(pv, batch.tdb.hi)
+        raise ValueError("SolarSystemShapiro requires an astrometry component")
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        psr_dir = self._psr_dir(pv, batch)
+        delay = self.ss_obj_shapiro_delay(batch.obs_sun_pos, psr_dir, pint_tpu.Tsun)
+        planet_shapiro = getattr(self._parent, "PLANET_SHAPIRO", None)
+        if planet_shapiro is not None and planet_shapiro.value:
+            for name, T in _T_PLANET.items():
+                if name in batch.planet_pos:
+                    delay = delay + self.ss_obj_shapiro_delay(
+                        batch.planet_pos[name], psr_dir, T)
+        return delay

@@ -1,0 +1,89 @@
+"""Spindown: Taylor-series pulse phase F0, F1, ... (reference ``spindown.py``).
+
+Phase = sum_n F_n dt^(n+1)/(n+1)!  evaluated in **double-double** Horner form
+(the one place absolute precision matters: F0*dt ~ 1e9-1e12 cycles).  dt is
+(TOA_tdb - delay) - PEPOCH in seconds, assembled without precision loss from
+the batch's dd time and the dd PEPOCH offset.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.dd import dd_from_longdouble, dd_sub, taylor_horner_dd
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import MJDParameter, prefixParameter
+from pint_tpu.models.timing_model import DAY_S, PhaseComponent
+from pint_tpu.phase import phase_from_dd
+
+__all__ = ["Spindown"]
+
+
+class Spindown(PhaseComponent):
+    """Reference: ``spindown.py:21``; phase at ``spindown.py:142``."""
+
+    register = True
+    category = "spindown"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter("F0", units="Hz", description="Spin frequency"))
+        self.add_param(prefixParameter("F1", units="Hz/s", description="Spin frequency derivative"))
+        self.add_param(MJDParameter("PEPOCH", description="Epoch of spin parameters"))
+        self.num_spin_terms = 2
+
+    def setup(self):
+        # contiguity check for F-terms added by the builder
+        idxs = sorted(
+            int(name[1:]) for name in self.params
+            if name.startswith("F") and name[1:].isdigit()
+        )
+        self.num_spin_terms = len(idxs)
+        if idxs != list(range(len(idxs))):
+            missing = min(set(range(max(idxs) + 1)) - set(idxs))
+            raise MissingParameter("Spindown", f"F{missing}",
+                                   "Spin terms F0..Fn must be contiguous")
+
+    def validate(self):
+        if self.F0.value is None:
+            raise MissingParameter("Spindown", "F0")
+
+    def get_spin_terms(self, pv):
+        return [pv.get(f"F{i}", 0.0) for i in range(self.num_spin_terms)]
+
+    def build_context(self, toas):
+        return {}
+
+    def get_dt_dd(self, pv, batch, delay):
+        """(tdb - delay - PEPOCH) seconds as DD.
+
+        PEPOCH flows in as a traced DD scalar (pv["PEPOCH"]); when unset, the
+        batch reference epoch tdb0 stands in (reference ``spindown.py:125``
+        uses the first TOA).
+        """
+        from pint_tpu.dd import dd_mul
+
+        t = dd_sub(batch.tdb_seconds(), delay)
+        if self.PEPOCH.value is None:
+            return t
+        offset = dd_mul(dd_sub(pv["PEPOCH"], batch.tdb0), DAY_S)
+        return dd_sub(t, offset)
+
+    def phase_func(self, pv, batch, ctx, delay):
+        dt = self.get_dt_dd(pv, batch, delay)
+        coeffs = [jnp.float64(0.0)] + self.get_spin_terms(pv)
+        return phase_from_dd(taylor_horner_dd(dt, coeffs))
+
+    def change_pepoch(self, new_epoch, toas=None, delay=None):
+        """Shift PEPOCH, adjusting F-terms (reference ``spindown.py`` PEPOCH move)."""
+        from pint_tpu.utils import taylor_horner_deriv
+
+        old = np.longdouble(self.PEPOCH.value)
+        dt = float((np.longdouble(new_epoch) - old) * np.longdouble(DAY_S))
+        terms = [float(self._params_dict[f"F{i}"].value or 0.0)
+                 for i in range(self.num_spin_terms)]
+        for i in range(self.num_spin_terms):
+            newv = float(taylor_horner_deriv(dt, terms, deriv_order=i))
+            self._params_dict[f"F{i}"].value = newv
+        self.PEPOCH.value = np.longdouble(new_epoch)

@@ -1,0 +1,163 @@
+"""Residuals: observed-minus-model phase/time with chi2 and likelihood.
+
+Counterpart of reference ``residuals.py:40 Residuals``: phase residuals with
+'nearest' or pulse-number tracking (``residuals.py:331``), optional
+(weighted-)mean subtraction, time residuals (``residuals.py:500``), chi2 with
+WLS/ECORR/GLS dispatch (``residuals.py:686,655,608,584``), lnlikelihood.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.exceptions import CorrelatedErrors
+from pint_tpu.logging import log
+from pint_tpu.utils import sherman_morrison_dot, weighted_mean, woodbury_dot
+
+__all__ = ["Residuals"]
+
+
+class Residuals:
+    def __init__(self, toas, model, subtract_mean: bool = True,
+                 use_weighted_mean: bool = True,
+                 track_mode: Optional[str] = None):
+        self.toas = toas
+        self.model = model
+        self.subtract_mean = subtract_mean and "PhaseOffset" not in model.components
+        self.use_weighted_mean = use_weighted_mean
+        if track_mode is None:
+            pn = toas.get_pulse_numbers()
+            track_mode = "use_pulse_numbers" if pn is not None else "nearest"
+        self.track_mode = track_mode
+        self._phase_resids = None
+        self._time_resids = None
+
+    # ------------------------------------------------------------------
+    def calc_phase_resids(self) -> np.ndarray:
+        """Residual pulse phase in cycles (float64)."""
+        abs_phase = "AbsPhase" in self.model.components
+        ph = self.model.phase(self.toas, abs_phase=abs_phase)
+        int_, frac = np.asarray(ph.int_), np.asarray(ph.frac)
+        if self.track_mode == "use_pulse_numbers":
+            pn = self.toas.get_pulse_numbers()
+            if pn is None:
+                raise ValueError("track_mode=use_pulse_numbers but no pulse numbers")
+            dpn = (self.toas.delta_pulse_number
+                   if self.toas.delta_pulse_number is not None else 0.0)
+            resids = (int_ - pn + dpn) + frac
+        else:
+            resids = frac.copy()
+            dpn = self.toas.delta_pulse_number
+            if dpn is not None:
+                resids = resids + dpn
+        if self.subtract_mean:
+            if self.use_weighted_mean:
+                err = self.toas.get_errors()
+                if np.any(err == 0):
+                    mean = np.mean(resids)
+                else:
+                    w = 1.0 / (err * err)
+                    mean, _ = weighted_mean(resids, w)
+                    mean = float(mean)
+            else:
+                mean = np.mean(resids)
+            resids = resids - mean
+        self._phase_resids = resids
+        return resids
+
+    @property
+    def phase_resids(self) -> np.ndarray:
+        if self._phase_resids is None:
+            self.calc_phase_resids()
+        return self._phase_resids
+
+    def calc_time_resids(self) -> np.ndarray:
+        """Residuals in seconds (phase / F0)."""
+        self._time_resids = self.phase_resids / float(self.model.F0.value)
+        return self._time_resids
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        if self._time_resids is None:
+            self.calc_time_resids()
+        return self._time_resids
+
+    @property
+    def resids(self) -> np.ndarray:
+        return self.time_resids
+
+    # ------------------------------------------------------------------
+    def get_data_error(self, scaled: bool = True) -> np.ndarray:
+        """TOA uncertainties in seconds (EFAC/EQUAD scaled when requested)."""
+        if scaled:
+            return self.model.scaled_toa_uncertainty(self.toas)
+        return np.asarray(self.toas.get_errors()) * 1e-6
+
+    def calc_chi2(self) -> float:
+        """chi2 with the same dispatch as the reference (``residuals.py:686``):
+        diagonal WLS, Sherman-Morrison for ECORR-only, Woodbury otherwise."""
+        r = self.time_resids
+        sigma = self.get_data_error()
+        if np.any(sigma == 0):
+            return np.inf
+        if not self.model.has_correlated_errors:
+            return float(np.sum((r / sigma) ** 2))
+        U, w = self.model.noise_model_basis_weight(self.toas)
+        ecorr_only = all(
+            getattr(c, "is_ecorr", False)
+            for c in self.model.noise_components
+            if getattr(c, "introduces_correlated_errors", False)
+        )
+        if ecorr_only:
+            dot, _ = sherman_morrison_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
+        else:
+            dot, _ = woodbury_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
+        return float(dot)
+
+    @property
+    def chi2(self) -> float:
+        return self.calc_chi2()
+
+    @property
+    def dof(self) -> int:
+        return len(self.toas) - len(self.model.free_params) - 1
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
+
+    @property
+    def chi2_reduced(self) -> float:
+        return self.reduced_chi2
+
+    def rms_weighted(self) -> float:
+        """Weighted RMS of time residuals, seconds."""
+        err = self.get_data_error(scaled=False)
+        if np.any(err == 0):
+            return float(np.sqrt(np.mean(self.time_resids**2)))
+        w = 1.0 / err**2
+        mean, _ = weighted_mean(self.time_resids, w)
+        return float(np.sqrt(np.sum(w * (self.time_resids - float(mean)) ** 2) / np.sum(w)))
+
+    def calc_whitened_resids(self) -> np.ndarray:
+        return self.time_resids / self.get_data_error()
+
+    def lnlikelihood(self) -> float:
+        """Gaussian log-likelihood including the noise log-determinant
+        (reference ``residuals.py:730``)."""
+        r = self.time_resids
+        sigma = self.get_data_error()
+        if not self.model.has_correlated_errors:
+            chi2 = np.sum((r / sigma) ** 2)
+            logdet = np.sum(np.log(sigma**2))
+            return float(-0.5 * (chi2 + logdet + len(r) * np.log(2 * np.pi)))
+        U, w = self.model.noise_model_basis_weight(self.toas)
+        dot, logdet = woodbury_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
+        return float(-0.5 * (dot + logdet + len(r) * np.log(2 * np.pi)))
+
+    def update(self):
+        self._phase_resids = None
+        self._time_resids = None
+        return self
