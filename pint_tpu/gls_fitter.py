@@ -1,0 +1,197 @@
+"""Generalized-least-squares fitters for correlated noise models.
+
+Counterpart of reference ``fitter.py:1939 GLSFitter`` / ``fitter.py:1399
+DownhillGLSFitter``.  Two equivalent paths (reference ``fitter.py:2003-2025``):
+
+* ``full_cov=False`` (default): augmented design matrix ``[M | U]`` with
+  diagonal white noise ``Nvec`` and basis priors ``phiinv`` — the Woodbury
+  form, linear in N_toa memory.
+* ``full_cov=True``: dense N x N TOA covariance, Cholesky-factored.
+
+The normal-equation solves run on device through ``jax.scipy.linalg``
+(Cholesky first, SVD fallback with singular-value thresholding, reference
+``fitter.py:2030-2037,2621``); basis matrices are host-built constants.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+import numpy as np
+
+from pint_tpu.exceptions import CorrelatedErrors, DegeneracyWarning
+from pint_tpu.fitter import DownhillFitter, Fitter
+from pint_tpu.logging import log
+from pint_tpu.utils import normalize_designmatrix
+
+__all__ = ["GLSFitter", "DownhillGLSFitter"]
+
+
+def _solve_cholesky(mtcm: np.ndarray, mtcy: np.ndarray):
+    """xvar, xhat from M^T C^-1 M via device Cholesky (reference
+    ``fitter.py:2759``).  Raises on a non-positive-definite system."""
+    L = np.asarray(jsl.cholesky(jnp.asarray(mtcm), lower=True))
+    if not np.all(np.isfinite(L)):
+        raise np.linalg.LinAlgError("Cholesky factorization failed")
+    xhat = np.asarray(jsl.cho_solve((jnp.asarray(L), True), jnp.asarray(mtcy)))
+    xvar = np.asarray(jsl.cho_solve((jnp.asarray(L), True),
+                                    jnp.eye(len(mtcy))))
+    return xvar, xhat
+
+
+def _solve_svd(mtcm: np.ndarray, mtcy: np.ndarray, threshold: float,
+               params: List[str]):
+    """SVD solve with degenerate directions removed (reference
+    ``fitter.py:2729`` + ``apply_Sdiag_threshold`` ``fitter.py:2621``)."""
+    U, s, Vt = (np.asarray(x) for x in jnp.linalg.svd(jnp.asarray(mtcm),
+                                                      full_matrices=False))
+    if threshold > 0:
+        bad = s < threshold * s.max()
+        if bad.any():
+            # columns beyond len(params) are unnamed noise-basis columns
+            badp = [params[i] if i < len(params) else f"<noise basis {i}>"
+                    for i in np.argsort(np.abs(Vt[bad]).max(0))[::-1][:3]]
+            warnings.warn(
+                f"Degenerate parameter directions (e.g. {badp}) removed",
+                DegeneracyWarning)
+        s = np.where(bad, np.inf, s)
+    xvar = (Vt.T / s) @ Vt
+    xhat = Vt.T @ ((U.T @ mtcy) / s)
+    return xvar, xhat
+
+
+def gls_normal_equations(M: np.ndarray, r: np.ndarray,
+                         Nvec: Optional[np.ndarray] = None,
+                         phiinv: Optional[np.ndarray] = None,
+                         cov: Optional[np.ndarray] = None):
+    """mtcm, mtcy for either GLS path (reference ``fitter.py:2696,2712``)."""
+    if cov is not None:
+        cf = np.asarray(jsl.cholesky(jnp.asarray(cov), lower=True))
+        cm = np.asarray(jsl.cho_solve((jnp.asarray(cf), True), jnp.asarray(M)))
+        mtcm = M.T @ cm
+        mtcy = cm.T @ r
+    else:
+        cinv = 1.0 / Nvec
+        mtcm = M.T @ (cinv[:, None] * M)
+        mtcm += np.diag(phiinv)
+        mtcy = M.T @ (cinv * r)
+    return mtcm, mtcy
+
+
+class GLSFitter(Fitter):
+    """One-shot GLS fitter (reference ``fitter.py:1939``)."""
+
+    def __init__(self, toas, model, residuals=None, track_mode=None):
+        super().__init__(toas, model, residuals=residuals, track_mode=track_mode)
+        self.method = "generalized_least_square"
+
+    def _gls_step(self, threshold: float = 0.0, full_cov: bool = False):
+        """One linearized GLS solve; returns (dpars, errs, cov, params).
+
+        Builds the timing design matrix and each noise basis exactly once
+        per step; ``self._noise_dims`` records the (offset, size) column
+        layout for noise-amplitude extraction.
+        """
+        r = np.asarray(self.resids.time_resids)
+        M_tm, params, units = self.get_designmatrix()
+        self._noise_dims = None
+        if full_cov:
+            M, norm = normalize_designmatrix(M_tm, params)
+            M, norm = np.asarray(M), np.asarray(norm)
+            cov = self.model.toa_covariance_matrix(self.toas)
+            mtcm, mtcy = gls_normal_equations(M, r, cov=cov)
+        else:
+            Us, ws, dims = self.model.noise_basis_by_component(self.toas)
+            self._noise_dims = dims
+            M = np.hstack([M_tm] + Us) if Us else M_tm
+            weights = np.concatenate(
+                [np.full(M_tm.shape[1], 1e40)] + ws) if ws else \
+                np.full(M_tm.shape[1], 1e40)
+            M, norm = normalize_designmatrix(M, params)
+            M, norm = np.asarray(M), np.asarray(norm)
+            phiinv = 1.0 / weights / norm**2
+            Nvec = self.model.scaled_toa_uncertainty(self.toas) ** 2
+            mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
+        if threshold <= 0:
+            try:
+                xvar, xhat = _solve_cholesky(mtcm, mtcy)
+            except np.linalg.LinAlgError:
+                xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+        else:
+            xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+        dpars = xhat / norm
+        errs = np.sqrt(np.diag(xvar)) / norm
+        covmat = (xvar / norm).T / norm
+        return dpars, errs, covmat, params
+
+    def _apply_step(self, dpars, errs, covmat, params):
+        for i, p in enumerate(params):
+            if p == "Offset":
+                continue
+            par = getattr(self.model, p)
+            par.value = float(par.value or 0.0) + float(dpars[i])
+            par.uncertainty = float(errs[i])
+            self.errors[p] = float(errs[i])
+        ntm = len(params)
+        self.parameter_covariance_matrix = covmat[:ntm, :ntm]
+        self.fitted_params = params
+
+    def _store_noise_ampls(self, dpars, ntm):
+        """Maximum-likelihood GP amplitudes for each correlated component
+        (reference ``fitter.py:2070-2085``)."""
+        if self._noise_dims is None:
+            return
+        self.resids.noise_ampls = {
+            comp: dpars[ntm + off:ntm + off + size]
+            for comp, (off, size) in self._noise_dims.items()
+        }
+
+    def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
+                 full_cov: bool = False, debug: bool = False) -> float:
+        self.model.validate()
+        self.model.validate_toas(self.toas)
+        self.update_resids()
+        for _ in range(max(1, maxiter)):
+            dpars, errs, covmat, params = self._gls_step(
+                threshold=threshold, full_cov=full_cov)
+            self._apply_step(dpars, errs, covmat, params)
+            self.update_resids()
+            if not full_cov:
+                self._store_noise_ampls(dpars, len(params))
+        chi2 = self.resids.calc_chi2()
+        self.converged = True
+        self.model.CHI2.value = chi2
+        return chi2
+
+
+class DownhillGLSFitter(DownhillFitter):
+    """Iterative GLS with lambda-halving line search (reference
+    ``fitter.py:1399``)."""
+
+    def __init__(self, toas, model, **kw):
+        super().__init__(toas, model, **kw)
+        self.method = "downhill_gls"
+        self.full_cov = False
+        self.threshold = 0.0
+
+    def _solve_step(self):
+        dpars, errs, covmat, params = GLSFitter._gls_step(
+            self, threshold=self.threshold, full_cov=self.full_cov)
+        self._last_step = (dpars, len(params))
+        ntm = len(params)
+        return dpars[:ntm], params, covmat[:ntm, :ntm]
+
+    def fit_toas(self, maxiter: int = 20, full_cov: bool = False,
+                 threshold: float = 0.0, **kw) -> float:
+        self.full_cov = full_cov
+        self.threshold = threshold
+        chi2 = super().fit_toas(maxiter=maxiter, **kw)
+        if not full_cov and getattr(self, "_last_step", None) is not None:
+            GLSFitter._store_noise_ampls(self, *self._last_step)
+        return chi2
+
+    def _chi2_func(self):
+        return self.resids.calc_chi2()

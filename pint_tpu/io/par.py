@@ -57,12 +57,16 @@ class ParLine:
 def parse_parfile(path_or_lines) -> "OrderedDict[str, List[ParLine]]":
     """Parse a par file into an ordered {KEY: [ParLine, ...]} multi-dict.
 
-    Accepts a filesystem path or an iterable of lines.  Keys are uppercased;
-    repeated keys (JUMP, EFAC, multiple glitches) accumulate in order.
+    Accepts a filesystem path, a multi-line par-file string, or an iterable
+    of lines.  Keys are uppercased; repeated keys (JUMP, EFAC, multiple
+    glitches) accumulate in order.
     """
     if isinstance(path_or_lines, str):
-        with open(path_or_lines) as f:
-            lines = f.readlines()
+        if "\n" in path_or_lines:
+            lines = path_or_lines.splitlines()
+        else:
+            with open(path_or_lines) as f:
+                lines = f.readlines()
     else:
         lines = list(path_or_lines)
     out: "OrderedDict[str, List[ParLine]]" = OrderedDict()

@@ -372,8 +372,16 @@ class maskParameter(floatParameter):
         sel = np.array([fl.get(flag) == want for fl in toas.flags])
         return np.nonzero(sel)[0]
 
+    def name_matches(self, key: str) -> bool:
+        # a bare par-file key ("EFAC", "JUMP") matches the indexed exemplar
+        key = key.upper()
+        if key == self.origin_name.upper() or key == self.name.upper():
+            return True
+        return key in (a.upper() for a in self.aliases)
+
     def new_param(self, index: int, **overrides) -> "maskParameter":
-        kw = dict(units=self.units, description=self.description, frozen=True)
+        kw = dict(units=self.units, description=self.description, frozen=True,
+                  aliases=list(self.aliases))
         kw.update(overrides)
         return maskParameter(self.origin_name, index=index, **kw)
 

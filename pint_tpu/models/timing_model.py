@@ -435,12 +435,7 @@ class TimingModel:
         phase function — covering every continuous parameter with no
         hand-registered partials (reference ``timing_model.py:2174``).
         """
-        free = tuple(p for p in self.params
-                     if p not in self.top_level_params
-                     and (incfrozen or not getattr(self, p).frozen)
-                     and getattr(self, p).continuous
-                     and not isinstance(getattr(self, p), MJDParameter)
-                     and not self._is_noise_param(p))
+        free = self.design_param_names(incfrozen=incfrozen)
         c = self._get_compiled(toas, free)
         J = np.asarray(c["jac_frac"](self._free_values(free)))  # (N, nfree)
         F0 = float(self.F0.value)
@@ -456,6 +451,16 @@ class TimingModel:
         units = ["s/s"] + [f"s/({getattr(self, p).units})" for p in free] if incoffset \
             else [f"s/({getattr(self, p).units})" for p in free]
         return M, names, units
+
+    def design_param_names(self, incfrozen: bool = False) -> tuple:
+        """Parameters that get design-matrix columns: continuous, non-epoch,
+        non-noise (noise params enter via GP bases, not the timing M)."""
+        return tuple(p for p in self.params
+                     if p not in self.top_level_params
+                     and (incfrozen or not getattr(self, p).frozen)
+                     and getattr(self, p).continuous
+                     and not isinstance(getattr(self, p), MJDParameter)
+                     and not self._is_noise_param(p))
 
     def _is_noise_param(self, name: str) -> bool:
         par = getattr(self, name)
@@ -514,23 +519,58 @@ class TimingModel:
         return cov
 
     def noise_model_designmatrix(self, toas):
-        Us = []
-        for c in self.noise_components:
-            if hasattr(c, "basis_weight_pair"):
-                U, w = c.basis_weight_pair(self, toas)
-                Us.append(U)
+        Us, _, _ = self.noise_basis_by_component(toas)
         return np.hstack(Us) if Us else None
 
     def noise_model_basis_weight(self, toas):
-        Us, ws = [], []
-        for c in self.noise_components:
-            if hasattr(c, "basis_weight_pair"):
-                U, w = c.basis_weight_pair(self, toas)
-                Us.append(U)
-                ws.append(w)
+        Us, ws, _ = self.noise_basis_by_component(toas)
         if not Us:
             return None, None
         return np.hstack(Us), np.concatenate(ws)
+
+    def full_designmatrix(self, toas):
+        """[timing M | noise basis] (reference ``timing_model.py:1752``)."""
+        M, names, units = self.designmatrix(toas)
+        U = self.noise_model_designmatrix(toas)
+        if U is None:
+            return M, names, units
+        return np.hstack([M, U]), names, units
+
+    def full_basis_weight(self, toas) -> np.ndarray:
+        """Weights for the full design matrix: 1e40 (uninformative, matching
+        enterprise) for timing columns, GP weights for noise columns
+        (reference ``timing_model.py:1777``)."""
+        phi_tm = np.full(self.ntmpar, 1e40)
+        _, w = self.noise_model_basis_weight(toas)
+        return phi_tm if w is None else np.concatenate([phi_tm, w])
+
+    def noise_basis_by_component(self, toas):
+        """One host pass over the correlated-noise components: returns
+        (bases list, weights list, {component: (offset, size)}).  Single
+        source of truth for the column layout used by
+        ``noise_model_basis_weight``/``noise_model_dimensions``."""
+        Us, ws, dims = [], [], {}
+        off = 0
+        for name, c in self.components.items():
+            if getattr(c, "kind", None) == "noise" and hasattr(c, "basis_weight_pair"):
+                U, w = c.basis_weight_pair(self, toas)
+                Us.append(U)
+                ws.append(w)
+                dims[name] = (off, U.shape[1])
+                off += U.shape[1]
+        return Us, ws, dims
+
+    def noise_model_dimensions(self, toas) -> Dict[str, tuple]:
+        """(offset, size) of each correlated-noise component's basis columns
+        within the noise design matrix (reference ``timing_model.py:1792``)."""
+        return self.noise_basis_by_component(toas)[2]
+
+    @property
+    def ntmpar(self) -> int:
+        """Number of timing-model design-matrix columns incl. the implicit
+        offset (reference ``timing_model.py:2285``; noise parameters have no
+        design column)."""
+        return len(self.design_param_names()) + int("PhaseOffset" not in self.components)
 
     @property
     def has_correlated_errors(self) -> bool:
