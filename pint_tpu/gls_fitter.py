@@ -180,7 +180,6 @@ class DownhillGLSFitter(DownhillFitter):
     def _solve_step(self):
         dpars, errs, covmat, params = GLSFitter._gls_step(
             self, threshold=self.threshold, full_cov=self.full_cov)
-        self._last_step = (dpars, len(params))
         ntm = len(params)
         return dpars[:ntm], params, covmat[:ntm, :ntm]
 
@@ -189,8 +188,13 @@ class DownhillGLSFitter(DownhillFitter):
         self.full_cov = full_cov
         self.threshold = threshold
         chi2 = super().fit_toas(maxiter=maxiter, **kw)
-        if not full_cov and getattr(self, "_last_step", None) is not None:
-            GLSFitter._store_noise_ampls(self, *self._last_step)
+        if not full_cov:
+            # noise amplitudes must describe the *accepted* parameter state:
+            # re-solve once at the converged point (a lambda-scaled or
+            # rejected last step would otherwise leak in)
+            dpars, _, _, params = GLSFitter._gls_step(
+                self, threshold=threshold, full_cov=False)
+            GLSFitter._store_noise_ampls(self, dpars, len(params))
         return chi2
 
     def _chi2_func(self):

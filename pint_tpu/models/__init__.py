@@ -24,4 +24,5 @@ from pint_tpu.models import absolute_phase  # noqa: F401
 from pint_tpu.models import phase_offset  # noqa: F401
 from pint_tpu.models import jump  # noqa: F401
 from pint_tpu.models import noise_model  # noqa: F401
+from pint_tpu.models import binary  # noqa: F401
 from pint_tpu.models.model_builder import get_model, get_model_and_toas  # noqa: F401

@@ -1,0 +1,15 @@
+"""Binary models: jnp delay engines + par-facing components."""
+
+from pint_tpu.models.binary import engines  # noqa: F401
+from pint_tpu.models.binary.components import (  # noqa: F401
+    BinaryBT,
+    BinaryDD,
+    BinaryDDGR,
+    BinaryDDH,
+    BinaryDDK,
+    BinaryDDS,
+    BinaryELL1,
+    BinaryELL1H,
+    BinaryELL1k,
+    PulsarBinary,
+)

@@ -1,0 +1,355 @@
+"""Par-facing binary components bridging the timing model to the engines.
+
+Counterpart of reference ``pulsar_binary.py:36 PulsarBinary`` and the
+per-model classes (``binary_bt.py``, ``binary_dd.py``, ``binary_ell1.py``,
+``binary_ddk.py``).  Each component:
+
+* declares the par-file parameters (canonical units: PB days, A1 lt-s,
+  OM/OMDOT deg & deg/yr, M2 Msun, epochs as MJDParameters, tempo 1e-12
+  scaling on the DOT parameters),
+* computes the barycentric time tt0 = (TDB - T0|TASC)*86400 - acc_delay in
+  double-double then hands a float64 tt0 to the pure engine function
+  (engines are smooth in t: the ~2e-8 s dd->f64 rounding enters the delay
+  suppressed by the orbital velocity ~1e-4),
+* resolves static structure (FBX vs PB orbits, H3/H4 vs H3/STIGMA, K96) at
+  trace time so the jitted graph has no data-dependent branches.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.dd import dd_mul, dd_sub
+from pint_tpu.exceptions import MissingParameter, TimingModelError
+from pint_tpu.models.binary import engines as eng
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    boolParameter,
+    floatParameter,
+    intParameter,
+    prefixParameter,
+)
+from pint_tpu.models.timing_model import DelayComponent
+
+__all__ = [
+    "PulsarBinary", "BinaryBT", "BinaryDD", "BinaryDDS", "BinaryDDH",
+    "BinaryDDGR", "BinaryDDK", "BinaryELL1", "BinaryELL1H", "BinaryELL1k",
+]
+
+DAY_S = 86400.0
+
+
+class PulsarBinary(DelayComponent):
+    """Shared Keplerian parameter set + barycentric-time plumbing."""
+
+    category = "pulsar_system"
+    binary_model_name = "base"
+    epoch_param = "T0"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("PB", units="d", description="Orbital period"))
+        self.add_param(floatParameter("PBDOT", units="s/s", unit_scale=True,
+                                      description="Orbital period derivative"))
+        self.add_param(floatParameter("XPBDOT", units="s/s", unit_scale=True,
+                                      description="Excess PBDOT over GR"))
+        self.add_param(floatParameter("A1", units="ls",
+                                      description="Projected semi-major axis"))
+        self.add_param(floatParameter("A1DOT", units="ls/s", aliases=["XDOT"],
+                                      unit_scale=True,
+                                      description="d(A1)/dt"))
+        self.add_param(MJDParameter("T0", description="Epoch of periastron"))
+        self.add_param(floatParameter("ECC", units="", aliases=["E"],
+                                      description="Eccentricity"))
+        self.add_param(floatParameter("EDOT", units="1/s", unit_scale=True,
+                                      description="Eccentricity derivative"))
+        self.add_param(floatParameter("OM", units="deg",
+                                      description="Longitude of periastron"))
+        self.add_param(floatParameter("OMDOT", units="deg/yr",
+                                      description="Periastron advance rate"))
+        self.add_param(floatParameter("M2", units="Msun", description="Companion mass"))
+        self.add_param(floatParameter("SINI", units="", description="Sine of inclination"))
+        self.add_param(floatParameter("GAMMA", units="s",
+                                      description="Einstein-delay amplitude"))
+        self.add_param(prefixParameter("FB0", units="1/s", aliases=["FB"],
+                                       description="Orbital frequency"))
+        self._nfb = 0
+
+    def setup(self):
+        idxs = sorted(int(p[2:]) for p in self.params
+                      if p.startswith("FB") and p[2:].isdigit()
+                      and self._params_dict[p].value is not None)
+        self._nfb = (max(idxs) + 1) if idxs else 0
+
+    def validate(self):
+        uses_fb = self._nfb > 0
+        if not uses_fb and self.PB.value is None:
+            raise MissingParameter(type(self).__name__, "PB (or FB0)")
+        ep = self._params_dict[self.epoch_param]
+        if ep.value is None:
+            raise MissingParameter(type(self).__name__, self.epoch_param)
+        if self.A1.value is None:
+            raise MissingParameter(type(self).__name__, "A1")
+        sini = self.SINI.value
+        if sini is not None and not -1.0 <= sini <= 1.0:
+            raise TimingModelError(f"SINI = {sini} must be within [-1, 1]")
+        ecc = getattr(self, "ECC", None)
+        if ecc is not None and ecc.value is not None and not 0 <= ecc.value < 1:
+            raise TimingModelError(f"ECC = {ecc.value} must be within [0, 1)")
+
+    # -- engine plumbing ----------------------------------------------------
+    def _orbits_fn(self):
+        """Static choice of orbit parameterization (reference
+        ``binary_orbits.py``): FBX when any FBn is set, else PB."""
+        if self._nfb:
+            names = [f"FB{i}" for i in range(self._nfb)]
+
+            def fn(pv, tt0):
+                return eng.orbits_fbx([pv.get(n, 0.0) for n in names], tt0)
+
+            return fn
+        return eng.orbits_pb
+
+    def _tt0(self, pv, batch, acc_delay):
+        epoch = pv[self.epoch_param]
+        d = dd_mul(dd_sub(batch.tdb, epoch), DAY_S)
+        return (d.hi + d.lo) - acc_delay
+
+    def binary_delay(self, pv, tt0):
+        """Engine dispatch; subclasses override."""
+        raise NotImplementedError
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        return self.binary_delay(pv, self._tt0(pv, batch, acc_delay))
+
+
+class BinaryBT(PulsarBinary):
+    """Blandford & Teukolsky model (reference ``binary_bt.py:17``)."""
+
+    register = True
+    binary_model_name = "BT"
+
+    def binary_delay(self, pv, tt0):
+        return eng.bt_delay(pv, tt0, orbits_fn=self._orbits_fn(),
+                            use_pb=self._nfb == 0)
+
+
+class BinaryDD(PulsarBinary):
+    """Damour & Deruelle model (reference ``binary_dd.py:34``)."""
+
+    register = True
+    binary_model_name = "DD"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("A0", units="s", description="DD aberration A0"))
+        self.add_param(floatParameter("B0", units="s", description="DD aberration B0"))
+        self.add_param(floatParameter("DR", units="", description="Relativistic deformation of the orbit"))
+        self.add_param(floatParameter("DTH", units="", aliases=["DTHETA"],
+                                      description="Relativistic deformation of the orbit"))
+
+    def binary_delay(self, pv, tt0):
+        return eng.dd_delay(pv, tt0, orbits_fn=self._orbits_fn())
+
+
+class BinaryDDS(BinaryDD):
+    """DD with SHAPMAX = -log(1-SINI) (reference ``binary_dd.py:135``)."""
+
+    register = True
+    binary_model_name = "DDS"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("SHAPMAX", units="", description="-log(1-SINI)"))
+
+    def validate(self):
+        super().validate()
+        sm = self.SHAPMAX.value
+        if sm is not None and sm < -np.log(2):
+            raise TimingModelError(f"SHAPMAX = {sm} must be > -log(2)")
+
+    def binary_delay(self, pv, tt0):
+        return eng.dds_delay(pv, tt0, orbits_fn=self._orbits_fn())
+
+
+class BinaryDDH(BinaryDD):
+    """DD with orthometric H3/STIGMA Shapiro parameters (reference
+    ``binary_dd.py:211``)."""
+
+    register = True
+    binary_model_name = "DDH"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("H3", units="s", description="Orthometric Shapiro amplitude"))
+        self.add_param(floatParameter("STIGMA", units="", aliases=["VARSIGMA", "STIG"],
+                                      description="Orthometric Shapiro ratio"))
+
+    def validate(self):
+        super().validate()
+        if self.H3.value is None or self.STIGMA.value is None:
+            raise MissingParameter("BinaryDDH", "H3/STIGMA")
+
+    def binary_delay(self, pv, tt0):
+        return eng.ddh_delay(pv, tt0, orbits_fn=self._orbits_fn())
+
+
+class BinaryDDGR(BinaryDD):
+    """GR-constrained DD: PK parameters from (MTOT, M2) (reference
+    ``binary_dd.py:382``)."""
+
+    register = True
+    binary_model_name = "DDGR"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("MTOT", units="Msun", description="Total system mass"))
+        self.add_param(floatParameter("XOMDOT", units="deg/yr",
+                                      description="Excess periastron advance over GR"))
+
+    def validate(self):
+        super().validate()
+        if self.MTOT.value is None or self.M2.value is None:
+            raise MissingParameter("BinaryDDGR", "MTOT/M2")
+
+    def binary_delay(self, pv, tt0):
+        return eng.ddgr_delay(pv, tt0, orbits_fn=self._orbits_fn())
+
+
+class BinaryDDK(BinaryDD):
+    """DD with Kopeikin annual/secular parallax corrections (reference
+    ``binary_ddk.py:45``)."""
+
+    register = True
+    binary_model_name = "DDK"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("KIN", units="deg", description="Orbital inclination"))
+        self.add_param(floatParameter("KOM", units="deg",
+                                      description="Longitude of ascending node"))
+        self.add_param(boolParameter("K96", value=True,
+                                     description="Apply proper-motion (Kopeikin 1996) corrections"))
+
+    def validate(self):
+        super().validate()
+        if self.KIN.value is None or self.KOM.value is None:
+            raise MissingParameter("BinaryDDK", "KIN/KOM")
+        if self._parent is not None:
+            if "PX" not in self._parent or self._parent.PX.value in (None, 0.0):
+                raise TimingModelError("DDK needs a non-zero PX (Kopeikin parallax terms)")
+            if "SINI" in self._parent and self._parent.SINI.value is not None:
+                raise TimingModelError("DDK uses KIN; remove SINI from the par file")
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        tt0 = self._tt0(pv, batch, acc_delay)
+        astro = next((c for c in self._parent.components.values()
+                      if hasattr(c, "ssb_to_psb_xyz")), None)
+        if astro is None:
+            raise TimingModelError("DDK requires an astrometry component")
+        psr_pos = astro.ssb_to_psb_xyz(pv, batch.tdb.hi)
+        pv2 = dict(pv)
+        pv2["K96"] = 1.0 if self.K96.value else 0.0
+        return eng.ddk_delay(pv2, tt0, psr_pos, batch.ssb_obs_pos,
+                             orbits_fn=self._orbits_fn())
+
+
+class BinaryELL1(PulsarBinary):
+    """Low-eccentricity Lange et al. (2001) model (reference
+    ``binary_ell1.py:57``)."""
+
+    register = True
+    binary_model_name = "ELL1"
+    epoch_param = "TASC"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("TASC", description="Epoch of ascending node"))
+        self.add_param(floatParameter("EPS1", units="", description="First Laplace-Lagrange parameter"))
+        self.add_param(floatParameter("EPS2", units="", description="Second Laplace-Lagrange parameter"))
+        self.add_param(floatParameter("EPS1DOT", units="1/s", unit_scale=True,
+                                      description="EPS1 derivative"))
+        self.add_param(floatParameter("EPS2DOT", units="1/s", unit_scale=True,
+                                      description="EPS2 derivative"))
+
+    def validate(self):
+        uses_fb = self._nfb > 0
+        if not uses_fb and self.PB.value is None:
+            raise MissingParameter(type(self).__name__, "PB (or FB0)")
+        if self.TASC.value is None:
+            if self.T0.value is not None and (self.EPS1.value or 0.0) == 0.0 \
+                    and (self.EPS2.value or 0.0) == 0.0 \
+                    and (self.ECC.value or 0.0) == 0.0:
+                # circular orbit given with T0: TASC == T0
+                self.TASC.value = self.T0.value
+            else:
+                raise MissingParameter(type(self).__name__, "TASC")
+        if self.A1.value is None:
+            raise MissingParameter(type(self).__name__, "A1")
+        if self.EPS1.value is None:
+            self.EPS1.value = 0.0
+        if self.EPS2.value is None:
+            self.EPS2.value = 0.0
+
+    def binary_delay(self, pv, tt0):
+        return eng.ell1_delay(pv, tt0, orbits_fn=self._orbits_fn())
+
+    # convenience conversions (reference ``ELL1_model.py:209-222``)
+    def ell1_ecc(self) -> float:
+        return float(np.hypot(self.EPS1.value or 0.0, self.EPS2.value or 0.0))
+
+    def ell1_om_deg(self) -> float:
+        return float(np.degrees(np.arctan2(self.EPS1.value or 0.0,
+                                           self.EPS2.value or 0.0)) % 360.0)
+
+
+class BinaryELL1H(BinaryELL1):
+    """ELL1 with orthometric H3/H4/STIGMA Shapiro delay (reference
+    ``binary_ell1.py:310``)."""
+
+    register = True
+    binary_model_name = "ELL1H"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("H3", units="s", description="Orthometric Shapiro amplitude"))
+        self.add_param(floatParameter("H4", units="s", description="Fourth Shapiro harmonic"))
+        self.add_param(floatParameter("STIGMA", units="", aliases=["VARSIGMA", "STIG"],
+                                      description="Orthometric Shapiro ratio"))
+        self.add_param(intParameter("NHARMS", value=7,
+                                    description="Number of Shapiro harmonics"))
+
+    def validate(self):
+        super().validate()
+        if self.H3.value is None:
+            raise MissingParameter("BinaryELL1H", "H3")
+        if self.H4.value is not None and self.STIGMA.value is not None:
+            raise TimingModelError("Provide H4 or STIGMA, not both")
+
+    def binary_delay(self, pv, tt0):
+        use_h4 = self.H4.value is not None and self.STIGMA.value is None
+        # exact form for H3/STIGMA with significant STIGMA (Freire & Wex
+        # 2010 eq 28); harmonic sum otherwise
+        exact = self.STIGMA.value is not None and self.STIGMA.value != 0.0
+        return eng.ell1h_delay(pv, tt0, orbits_fn=self._orbits_fn(),
+                               nharms=int(self.NHARMS.value or 7),
+                               exact=exact, use_h4=use_h4)
+
+
+class BinaryELL1k(BinaryELL1):
+    """ELL1 with exponential eccentricity evolution and periastron advance
+    (Susobhanan+ 2018; reference ``binary_ell1.py:423``)."""
+
+    register = True
+    binary_model_name = "ELL1k"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("LNEDOT", units="1/yr",
+                                      description="Relative eccentricity derivative"))
+
+    def binary_delay(self, pv, tt0):
+        return eng.ell1k_delay(pv, tt0, orbits_fn=self._orbits_fn())

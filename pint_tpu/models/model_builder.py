@@ -32,7 +32,7 @@ __all__ = ["ModelBuilder", "get_model", "get_model_and_toas", "parse_parfile"]
 #: par keys silently ignored (reference ``timing_model.py:96 ignore_params``)
 IGNORE_PARAMS = {
     "NITS", "IBOOT", "MODE", "PLANET_SHAPIRO2", "GAIN", "EPHVER",
-    "DMMODEL", "DMOFF", "DM_SERIES", "T2EFAC", "TRACK",
+    "DMMODEL", "DMOFF", "DM_SERIES", "TRACK",
 }
 
 IGNORE_PREFIX = {"DMXF1_", "DMXF2_", "DMXEP_", "DMXCM_"}
@@ -51,7 +51,7 @@ class ModelBuilder:
                 log.warning(f"Could not instantiate component {name}: {e}")
 
     # -- component choice ---------------------------------------------------
-    def choose_components(self, entries) -> List[str]:
+    def choose_components(self, entries, allow_T2: bool = False) -> List[str]:
         keys = set(entries.keys())
         chosen: List[str] = []
 
@@ -131,29 +131,64 @@ class ModelBuilder:
         # binary
         if "BINARY" in keys:
             binary_name = entries["BINARY"][0].value
-            comp = self.binary_component_for(binary_name)
+            comp = self.binary_component_for(binary_name, keys, allow_T2=allow_T2)
             chosen.append(comp)
         # PiecewiseSpindown
         if any(k.startswith("PWF0_") for k in keys) and "PiecewiseSpindown" in self.templates:
             chosen.append("PiecewiseSpindown")
         return chosen
 
-    def binary_component_for(self, binary_name: str) -> str:
+    def binary_component_for(self, binary_name: str, keys=(),
+                             allow_T2: bool = False) -> str:
         want = f"Binary{binary_name}"
         if want in self.templates:
             return want
-        # tempo2 T2 model: guess the closest implemented model
+        # case-insensitive (par files write ELL1K for ELL1k etc.)
+        for t in self.templates:
+            if t.lower() == want.lower():
+                return t
+        if binary_name.upper() == "T2":
+            if not allow_T2:
+                raise UnknownBinaryModel(
+                    "BINARY T2 is not directly supported; pass allow_T2=True "
+                    "to substitute the closest implemented model")
+            guess = self.guess_t2_model(keys)
+            log.warning(f"BINARY T2 approximated by {guess} (allow_T2)")
+            return guess
         available = sorted(t for t in self.templates if t.startswith("Binary"))
         raise UnknownBinaryModel(
             f"BINARY {binary_name} is not supported (available: {available})"
         )
+
+    def guess_t2_model(self, keys) -> str:
+        """Map a tempo2 'T2' binary to the closest implemented model from
+        the parameters present (reference ``model_builder.py:969
+        guess_binary_model``)."""
+        keys = set(keys)
+        if "EPS1" in keys or "TASC" in keys:
+            if "H3" in keys or "H4" in keys or "STIGMA" in keys:
+                return "BinaryELL1H"
+            if "LNEDOT" in keys:
+                return "BinaryELL1k"
+            return "BinaryELL1"
+        if "KIN" in keys or "KOM" in keys:
+            return "BinaryDDK"
+        if "SHAPMAX" in keys:
+            return "BinaryDDS"
+        if "MTOT" in keys:
+            return "BinaryDDGR"
+        if "H3" in keys or "STIGMA" in keys:
+            return "BinaryDDH"
+        if "OMDOT" in keys or "M2" in keys or "GAMMA" in keys:
+            return "BinaryDD"
+        return "BinaryBT"
 
     # -- main ---------------------------------------------------------------
     def __call__(self, parfile, allow_tcb: bool = False,
                  allow_T2: bool = False) -> TimingModel:
         entries = parse_parfile(parfile) if not isinstance(parfile, dict) else parfile
         tm = TimingModel()
-        chosen = self.choose_components(entries)
+        chosen = self.choose_components(entries, allow_T2=allow_T2)
         for cname in chosen:
             cls = Component.component_types[cname]
             tm.add_component(cls(), validate=False)

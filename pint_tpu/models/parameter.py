@@ -155,8 +155,23 @@ class Parameter:
 
 
 class floatParameter(Parameter):
+    """Float parameter; optional tempo-style unit scaling: par values with
+    magnitude above ``scale_threshold`` are multiplied by ``scale_factor``
+    (e.g. XDOT given in 1e-12 ls/s; reference ``parameter.py`` unit_scale).
+    """
+
+    def __init__(self, *a, unit_scale: bool = False, scale_factor: float = 1e-12,
+                 scale_threshold: float = 1e-7, **kw):
+        self.unit_scale = unit_scale
+        self.scale_factor = scale_factor
+        self.scale_threshold = scale_threshold
+        super().__init__(*a, **kw)
+
     def str2value(self, s):
-        return fortran_float(s)
+        v = fortran_float(s)
+        if self.unit_scale and abs(v) > self.scale_threshold:
+            v *= self.scale_factor
+        return v
 
     def value2str(self, v):
         return f"{v:.15g}"

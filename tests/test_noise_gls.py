@@ -54,6 +54,13 @@ class TestScaleToaError:
         raw = toas.get_errors() * 1e-6
         assert np.allclose(sig, np.hypot(raw, 10 ** -5.52), rtol=1e-12)
 
+    def test_t2efac_alias(self, toas):
+        """tempo2-style T2EFAC lines must set a real EFAC (not be ignored)."""
+        m = _model_with_lines(["T2EFAC mjd 52000 60000 1.7"])
+        sig = m.scaled_toa_uncertainty(toas)
+        raw = toas.get_errors() * 1e-6
+        assert np.allclose(sig, 1.7 * raw, rtol=1e-12)
+
     def test_tneq_with_unrelated_equad(self, toas):
         """A TNEQ must not clobber an EQUAD with a different selection."""
         m = _model_with_lines(["EQUAD mjd 52000 53500 0.5",
