@@ -25,4 +25,14 @@ from pint_tpu.models import phase_offset  # noqa: F401
 from pint_tpu.models import jump  # noqa: F401
 from pint_tpu.models import noise_model  # noqa: F401
 from pint_tpu.models import binary  # noqa: F401
+from pint_tpu.models import glitch  # noqa: F401
+from pint_tpu.models import wave  # noqa: F401
+from pint_tpu.models import wavex  # noqa: F401
+from pint_tpu.models import frequency_dependent  # noqa: F401
+from pint_tpu.models import fdjump  # noqa: F401
+from pint_tpu.models import solar_wind  # noqa: F401
+from pint_tpu.models import chromatic  # noqa: F401
+from pint_tpu.models import troposphere  # noqa: F401
+from pint_tpu.models import ifunc  # noqa: F401
+from pint_tpu.models import piecewise  # noqa: F401
 from pint_tpu.models.model_builder import get_model, get_model_and_toas  # noqa: F401

@@ -42,6 +42,36 @@ __all__ = [
 DAY_S = 86400.0
 
 
+def _ecliptic_pm_to_equatorial(elong, elat, pm_elong, pm_elat):
+    """Rotate proper motion from ecliptic (lambda*, beta) components to
+    equatorial (alpha*, delta) components at the source position.
+
+    Both inputs and outputs use the cos(lat)-scaled longitude convention
+    (PMELONG ~ PMRA*).  All quantities may be traced scalars.
+    """
+    from pint_tpu import OBL_IERS2010_RAD
+
+    ce, se = jnp.cos(OBL_IERS2010_RAD), jnp.sin(OBL_IERS2010_RAD)
+    cb, sb = jnp.cos(elat), jnp.sin(elat)
+    cl, sl = jnp.cos(elong), jnp.sin(elong)
+    # source unit vector and local (e_lon, e_lat) basis, ecliptic frame
+    n_ecl = jnp.array([cb * cl, cb * sl, sb])
+    e_lon = jnp.array([-sl, cl, 0.0])
+    e_lat = jnp.array([-sb * cl, -sb * sl, cb])
+
+    def to_eq(v):
+        return jnp.array([v[0], ce * v[1] - se * v[2], se * v[1] + ce * v[2]])
+
+    n = to_eq(n_ecl)
+    pm_vec = pm_elong * to_eq(e_lon) + pm_elat * to_eq(e_lat)
+    ra = jnp.arctan2(n[1], n[0])
+    dec = jnp.arcsin(jnp.clip(n[2], -1.0, 1.0))
+    e_ra = jnp.array([-jnp.sin(ra), jnp.cos(ra), 0.0])
+    e_dec = jnp.array([-jnp.sin(dec) * jnp.cos(ra),
+                       -jnp.sin(dec) * jnp.sin(ra), jnp.cos(dec)])
+    return jnp.dot(pm_vec, e_ra), jnp.dot(pm_vec, e_dec)
+
+
 class PulsarBinary(DelayComponent):
     """Shared Keplerian parameter set + barycentric-time plumbing."""
 
@@ -214,6 +244,10 @@ class BinaryDDGR(BinaryDD):
         super().validate()
         if self.MTOT.value is None or self.M2.value is None:
             raise MissingParameter("BinaryDDGR", "MTOT/M2")
+        if self.PB.value is None:
+            # the GR constraint equations are written in terms of PB
+            raise MissingParameter("BinaryDDGR", "PB",
+                                   "DDGR requires PB (FB parameterization unsupported)")
 
     def binary_delay(self, pv, tt0):
         return eng.ddgr_delay(pv, tt0, orbits_fn=self._orbits_fn())
@@ -253,6 +287,13 @@ class BinaryDDK(BinaryDD):
         psr_pos = astro.ssb_to_psb_xyz(pv, batch.tdb.hi)
         pv2 = dict(pv)
         pv2["K96"] = 1.0 if self.K96.value else 0.0
+        if "PMELONG" in pv and "PMRA" not in pv:
+            # psr_pos (and the Kopeikin I0/J0 basis built from it) is
+            # equatorial; rotate ecliptic proper motion into equatorial
+            # (RA*, DEC) components so frames agree
+            pv2["PMRA"], pv2["PMDEC"] = _ecliptic_pm_to_equatorial(
+                pv["ELONG"], pv["ELAT"], pv.get("PMELONG", 0.0),
+                pv.get("PMELAT", 0.0))
         return eng.ddk_delay(pv2, tt0, psr_pos, batch.ssb_obs_pos,
                              orbits_fn=self._orbits_fn())
 
@@ -276,9 +317,6 @@ class BinaryELL1(PulsarBinary):
                                       description="EPS2 derivative"))
 
     def validate(self):
-        uses_fb = self._nfb > 0
-        if not uses_fb and self.PB.value is None:
-            raise MissingParameter(type(self).__name__, "PB (or FB0)")
         if self.TASC.value is None:
             if self.T0.value is not None and (self.EPS1.value or 0.0) == 0.0 \
                     and (self.EPS2.value or 0.0) == 0.0 \
@@ -287,8 +325,7 @@ class BinaryELL1(PulsarBinary):
                 self.TASC.value = self.T0.value
             else:
                 raise MissingParameter(type(self).__name__, "TASC")
-        if self.A1.value is None:
-            raise MissingParameter(type(self).__name__, "A1")
+        super().validate()  # PB/A1 presence, SINI/ECC range checks
         if self.EPS1.value is None:
             self.EPS1.value = 0.0
         if self.EPS2.value is None:

@@ -192,7 +192,6 @@ def dd_delay(pv, tt0, orbits_fn=orbits_pb):
 def dds_delay(pv, tt0, orbits_fn=orbits_pb):
     """DDS: SHAPMAX = -log(1 - sini) parameterization (reference
     ``DDS_model.py:61``)."""
-    pv = dict(pv)
     sini = 1.0 - jnp.exp(-pv.get("SHAPMAX", 0.0))
     st = dd_state(pv, tt0, orbits_fn)
     return dd_delay_core(

@@ -1,0 +1,139 @@
+"""Chromatic (nu^-alpha) delays: ChromaticCM Taylor series + CMX piecewise.
+
+Reference ``chromatic_model.py:30,118,313``: delay = CM(t) * DMconst *
+(f/1 MHz)^(-TNCHROMIDX) with CM a Taylor series in years about CMEPOCH,
+plus piecewise CMX_XXXX offsets in [CMXR1, CMXR2] ranges.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DMconst
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import MJDParameter, floatParameter, prefixParameter
+from pint_tpu.models.timing_model import DelayComponent
+
+__all__ = ["ChromaticCM", "ChromaticCMX"]
+
+_DAY_PER_YEAR = 365.25
+
+
+class Chromatic(DelayComponent):
+    category = "chromatic_constant"
+
+    def _bary_freq(self, pv, batch):
+        parent = self._parent
+        if parent is not None:
+            for comp in parent.components.values():
+                if hasattr(comp, "barycentric_radio_freq"):
+                    return comp.barycentric_radio_freq(pv, batch)
+        return batch.freq
+
+    def chromatic_time_delay(self, cm, alpha, freq):
+        return cm * DMconst * jnp.power(freq, -alpha)
+
+
+class ChromaticCM(Chromatic):
+    """Reference ``chromatic_model.py:118``."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        p = prefixParameter("CM0", units="pc/cm3", value=0.0,
+                            description="Chromatic measure")
+        self._params_dict.pop("CM0", None)
+        p.name, p.prefix, p.index = "CM", "CM", 0
+        self.add_param(p)
+        self.add_param(prefixParameter("CM1", units="pc/cm3/yr", value=0.0,
+                                       description="Chromatic measure derivative"))
+        self.add_param(floatParameter("TNCHROMIDX", units="", value=4.0,
+                                      description="Chromatic index alpha"))
+        self.add_param(MJDParameter("CMEPOCH", description="Epoch of CM measurement"))
+        self.num_cm_terms = 2
+
+    def setup(self):
+        idxs = [0] + sorted(int(n[2:]) for n in self.params
+                            if n.startswith("CM") and n[2:].isdigit() and n != "CM")
+        self.num_cm_terms = len(idxs)
+
+    def validate(self):
+        higher = any((self._params_dict.get(f"CM{i}") is not None
+                      and self._params_dict[f"CM{i}"].value)
+                     for i in range(1, self.num_cm_terms))
+        if higher and self.CMEPOCH.value is None:
+            pep = getattr(self._parent, "PEPOCH", None)
+            if pep is not None and pep.value is not None:
+                self.CMEPOCH.value = pep.value
+            else:
+                raise MissingParameter("ChromaticCM", "CMEPOCH")
+
+    def base_cm(self, pv, batch):
+        terms = [pv.get("CM", 0.0)] + [pv.get(f"CM{i}", 0.0)
+                                       for i in range(1, self.num_cm_terms)]
+        if len(terms) == 1:
+            return terms[0] * jnp.ones_like(batch.freq)
+        if self.CMEPOCH.value is not None and "CMEPOCH" in pv:
+            ep = pv["CMEPOCH"]
+            ep = ep.to_float() if hasattr(ep, "to_float") else ep
+        else:
+            ep = batch.tdb0
+        dt_yr = (batch.tdb.hi - ep) / _DAY_PER_YEAR
+        acc = jnp.zeros_like(dt_yr)
+        for i in range(len(terms) - 1, -1, -1):
+            acc = acc * dt_yr + terms[i] / math.factorial(i)
+        return acc
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        freq = self._bary_freq(pv, batch)
+        return self.chromatic_time_delay(self.base_cm(pv, batch),
+                                         pv.get("TNCHROMIDX", 4.0), freq)
+
+
+class ChromaticCMX(Chromatic):
+    """Piecewise chromatic offsets (reference ``chromatic_model.py:313``)."""
+
+    register = True
+    category = "chromatic_cmx"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter("CMX_0001", units="pc/cm3", value=0.0,
+                                       description="CM offset in range"))
+        self.add_param(prefixParameter("CMXR1_0001", units="MJD",
+                                       description="Range start MJD"))
+        self.add_param(prefixParameter("CMXR2_0001", units="MJD",
+                                       description="Range end MJD"))
+        self.cmx_indices = [1]
+
+    def setup(self):
+        self.cmx_indices = sorted(int(n[4:]) for n in self.params
+                                  if n.startswith("CMX_"))
+
+    def validate(self):
+        for i in self.cmx_indices:
+            for pre in ("CMXR1_", "CMXR2_"):
+                nm = f"{pre}{i:04d}"
+                if nm not in self._params_dict or self._params_dict[nm].value is None:
+                    raise MissingParameter("ChromaticCMX", nm)
+
+    def build_context(self, toas):
+        mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+        masks = []
+        for i in self.cmx_indices:
+            r1 = float(self._params_dict[f"CMXR1_{i:04d}"].value)
+            r2 = float(self._params_dict[f"CMXR2_{i:04d}"].value)
+            masks.append(((mjds >= r1) & (mjds <= r2)).astype(np.float64))
+        return {"masks": jnp.asarray(np.array(masks)) if masks else None}
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        if ctx.get("masks") is None:
+            return jnp.zeros(batch.ntoas)
+        vals = jnp.stack([pv.get(f"CMX_{i:04d}", 0.0) for i in self.cmx_indices])
+        cm = jnp.sum(vals[:, None] * ctx["masks"], axis=0)
+        freq = self._bary_freq(pv, batch)
+        return self.chromatic_time_delay(cm, pv.get("TNCHROMIDX", 4.0), freq)

@@ -1,0 +1,74 @@
+"""FDJUMP: system-dependent frequency-dependent profile delays.
+
+Reference ``fdjump.py:15,152``: for each mask parameter FDpJUMPq,
+delay += c * y^p on the selected TOAs, where y = ln(f/1 GHz) when
+FDJUMPLOG is true (NANOGrav convention) or (f/1 GHz) when false
+(tempo2 convention, the default there).
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import boolParameter, maskParameter
+from pint_tpu.models.timing_model import DelayComponent
+
+__all__ = ["FDJump"]
+
+fdjump_max_index = 20
+
+_FDJ_RE = re.compile(r"^FD(\d+)JUMP(\d+)")
+
+
+class FDJump(DelayComponent):
+    register = True
+    category = "fdjump"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(boolParameter(
+            "FDJUMPLOG", value=True,
+            description="Use log-frequency (Y) or linear frequency (N) for FDJUMPs"))
+        for j in range(1, fdjump_max_index + 1):
+            self.add_param(maskParameter(
+                f"FD{j}JUMP", index=1, units="s", value=0.0,
+                description=f"System-dependent FD delay of polynomial index {j}"))
+        self.fdjumps = []
+
+    def setup(self):
+        self.fdjumps = [p for p in self.params if _FDJ_RE.match(p)]
+
+    def get_fd_index(self, par: str) -> int:
+        m = _FDJ_RE.match(par)
+        if not m:
+            raise ValueError(f"{par} is not an FDJUMP parameter")
+        return int(m.group(1))
+
+    def build_context(self, toas):
+        n = len(toas)
+        masks = {}
+        for p in self.fdjumps:
+            par = self._params_dict[p]
+            if par.key is None and par.value in (None, 0.0):
+                continue
+            m = np.zeros(n)
+            m[par.select_toa_mask(toas)] = 1.0
+            masks[p] = jnp.asarray(m)
+        return {"masks": masks}
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        f_ghz = batch.freq / 1000.0
+        if bool(self.FDJUMPLOG.value):
+            y = jnp.log(f_ghz)
+            y = jnp.where(jnp.isfinite(y), y, 0.0)
+        else:
+            y = f_ghz
+        d = jnp.zeros(batch.ntoas)
+        for p in self.fdjumps:
+            if p not in ctx["masks"]:
+                continue
+            d = d + pv.get(p, 0.0) * y ** self.get_fd_index(p) * ctx["masks"][p]
+        return d

@@ -1,0 +1,52 @@
+"""FD: polynomial-in-log-frequency profile-evolution delay.
+
+Reference ``frequency_dependent.py:13,88``:
+delay = sum_{i>=1} FD_i * ln(f_bary/1 GHz)^i  [seconds].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import prefixParameter
+from pint_tpu.models.timing_model import DelayComponent
+
+__all__ = ["FD"]
+
+
+class FD(DelayComponent):
+    register = True
+    category = "frequency_dependent"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter("FD1", units="s", value=0.0,
+                                       description="Log-frequency polynomial delay coefficient"))
+        self.num_FD_terms = 1
+
+    def setup(self):
+        terms = sorted(int(p[2:]) for p in self.params
+                       if p.startswith("FD") and p[2:].isdigit())
+        self.num_FD_terms = len(terms)
+        if terms and terms != list(range(1, max(terms) + 1)):
+            missing = min(set(range(1, max(terms) + 1)) - set(terms))
+            raise MissingParameter("FD", f"FD{missing}")
+
+    def _bary_freq(self, pv, batch):
+        parent = self._parent
+        if parent is not None:
+            for comp in parent.components.values():
+                if hasattr(comp, "barycentric_radio_freq"):
+                    return comp.barycentric_radio_freq(pv, batch)
+        return batch.freq
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        freq = self._bary_freq(pv, batch)
+        log_f = jnp.log(freq / 1000.0)  # MHz -> GHz
+        log_f = jnp.where(jnp.isfinite(log_f), log_f, 0.0)
+        # Horner over FD_n ... FD_1, zero constant term
+        acc = jnp.zeros(batch.ntoas)
+        for i in range(self.num_FD_terms, 0, -1):
+            acc = (acc + pv.get(f"FD{i}", 0.0)) * log_f
+        return acc

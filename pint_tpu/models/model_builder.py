@@ -22,6 +22,7 @@ from pint_tpu.io.par import ParLine, parse_parfile
 from pint_tpu.logging import log
 from pint_tpu.models.parameter import (
     maskParameter,
+    pairParameter,
     prefixParameter,
     split_prefixed_name,
 )
@@ -83,7 +84,9 @@ class ModelBuilder:
             chosen.append("SolarWindDispersion")
         if any(k.startswith("SWXDM_") for k in keys) and "SolarWindDispersionX" in self.templates:
             chosen.append("SolarWindDispersionX")
-        if has("CM") and "ChromaticCM" in self.templates:
+        if (has("CM", "TNCHROMIDX")
+                or any(k.startswith("CM") and k[2:].isdigit() for k in keys)) \
+                and "ChromaticCM" in self.templates:
             chosen.append("ChromaticCM")
         if any(k.startswith("CMX_") for k in keys) and "ChromaticCMX" in self.templates:
             chosen.append("ChromaticCMX")
@@ -101,6 +104,10 @@ class ModelBuilder:
         if has("CMWXEPOCH") or any(k.startswith("CMWXSIN_") for k in keys):
             if "CMWaveX" in self.templates:
                 chosen.append("CMWaveX")
+                # TNCHROMIDX lives on ChromaticCM (reference ``cmwavex.py``
+                # validates it exists in the model)
+                if "ChromaticCM" not in chosen and "ChromaticCM" in self.templates:
+                    chosen.append("ChromaticCM")
         if any(k.startswith("FD") and k[2:].isdigit() for k in keys) \
                 and "FD" in self.templates:
             chosen.append("FD")
@@ -243,7 +250,9 @@ class ModelBuilder:
             exemplar = None
             for pname in comp.params:
                 par = comp._params_dict[pname]
-                if isinstance(par, prefixParameter) and par.prefix == prefix:
+                if (isinstance(par, prefixParameter)
+                        or (isinstance(par, pairParameter) and par.index >= 0)) \
+                        and par.prefix == prefix:
                     exemplar = par
                     break
             if exemplar is not None:

@@ -38,7 +38,7 @@ __all__ = [
     "split_prefixed_name",
 ]
 
-_PREFIX_RE = re.compile(r"^([A-Za-z][A-Za-z0-9]*?_?)(\d+)$")
+_PREFIX_RE = re.compile(r"^([A-Za-z][A-Za-z0-9_]*?_?)(\d+)$")
 
 
 def split_prefixed_name(name: str):
@@ -402,7 +402,17 @@ class maskParameter(floatParameter):
 
 
 class pairParameter(floatParameter):
-    """Parameter whose value is a pair of floats (reference ``parameter.py:1781``)."""
+    """Parameter whose value is a pair of floats (reference ``parameter.py:1781``).
+
+    Pairs that end in digits (WAVE1, IFUNC3) form prefix families the model
+    builder grows on demand, like :class:`prefixParameter`."""
+
+    def __init__(self, name: str, *a, **kw):
+        try:
+            self.prefix, self.index = split_prefixed_name(name)
+        except Exception:
+            self.prefix, self.index = name, -1
+        super().__init__(name, *a, **kw)
 
     def str2value(self, s):
         return [fortran_float(x) for x in s.split()]
@@ -413,6 +423,12 @@ class pairParameter(floatParameter):
 
     def value2str(self, v):
         return f"{v[0]:.15g} {v[1]:.15g}"
+
+    def new_param(self, index: int, **overrides) -> "pairParameter":
+        kw = dict(units=self.units, description=self.description, frozen=True,
+                  continuous=self.continuous)
+        kw.update(overrides)
+        return pairParameter(f"{self.prefix}{index}", **kw)
 
 
 class funcParameter(floatParameter):

@@ -1,0 +1,69 @@
+"""Piecewise-constant spindown solutions (PWF0/PWF1/PWF2 in MJD ranges).
+
+Reference ``piecewise.py:12``: for each solution index i, TOAs with
+PWSTART_i <= t <= PWSTOP_i pick up phase = taylor(dt; 0, PWF0, PWF1, PWF2)
+with dt = (t_bary - PWEP_i) seconds.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import prefixParameter
+from pint_tpu.models.timing_model import DAY_S, PhaseComponent
+from pint_tpu.phase import Phase
+
+__all__ = ["PiecewiseSpindown"]
+
+
+class PiecewiseSpindown(PhaseComponent):
+    register = True
+    category = "piecewise_spindown"
+
+    def __init__(self):
+        super().__init__()
+        for name, units, desc in [
+            ("PWEP_1", "MJD", "Piecewise solution reference epoch"),
+            ("PWSTART_1", "MJD", "Piecewise solution range start"),
+            ("PWSTOP_1", "MJD", "Piecewise solution range stop"),
+            ("PWPH_1", "pulse phase", "Piecewise solution phase offset"),
+            ("PWF0_1", "Hz", "Piecewise solution frequency offset"),
+            ("PWF1_1", "Hz/s", "Piecewise solution frequency-derivative offset"),
+            ("PWF2_1", "Hz/s^2", "Piecewise solution second-derivative offset"),
+        ]:
+            self.add_param(prefixParameter(name, units=units, description=desc,
+                                           value=0.0))
+        self.pw_indices = [1]
+
+    def setup(self):
+        idx_all = sorted({int(n.split("_")[1]) for n in self.params if "_" in n})
+        for i in idx_all:
+            for pre in ("PWEP_", "PWSTART_", "PWSTOP_", "PWPH_", "PWF0_", "PWF1_", "PWF2_"):
+                nm = f"{pre}{i}"
+                if nm not in self._params_dict:
+                    self.add_param(self._params_dict[f"{pre}1"].new_param(i, value=0.0))
+        self.pw_indices = idx_all
+
+    def validate(self):
+        for i in self.pw_indices:
+            for pre in ("PWEP_", "PWSTART_", "PWSTOP_"):
+                if (self._params_dict[f"{pre}{i}"].value or 0.0) == 0.0:
+                    raise MissingParameter("PiecewiseSpindown", f"{pre}{i}")
+
+    def phase_func(self, pv, batch, ctx, delay):
+        t_s = batch.tdb_seconds()
+        t_mjd = batch.tdb.hi + batch.tdb.lo - delay / DAY_S
+        phase = jnp.zeros(batch.ntoas)
+        for i in self.pw_indices:
+            ep = pv.get(f"PWEP_{i}", 0.0)
+            dt = (t_s.hi - (ep - batch.tdb0) * DAY_S) + t_s.lo - delay
+            on = (t_mjd >= pv.get(f"PWSTART_{i}", 0.0)) & \
+                 (t_mjd <= pv.get(f"PWSTOP_{i}", 0.0))
+            dtp = jnp.where(on, dt, 0.0)
+            poly = pv.get(f"PWPH_{i}", 0.0) + dtp * (
+                pv.get(f"PWF0_{i}", 0.0)
+                + dtp * (0.5 * pv.get(f"PWF1_{i}", 0.0)
+                         + dtp * pv.get(f"PWF2_{i}", 0.0) / 6.0))
+            phase = phase + jnp.where(on, poly, 0.0)
+        return Phase.from_float(phase)

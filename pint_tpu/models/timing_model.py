@@ -53,16 +53,24 @@ DEFAULT_ORDER = [
     "troposphere",
     "solar_system_shapiro",
     "solar_wind",
+    "solar_windx",
     "dispersion_constant",
     "dispersion_dmx",
     "dispersion_jump",
+    "chromatic_constant",
+    "chromatic_cmx",
     "pulsar_system",
     "frequency_dependent",
+    "fdjump",
     "absolute_phase",
     "spindown",
+    "glitch",
+    "piecewise_spindown",
     "phase_jump",
     "wave",
     "wavex",
+    "dmwavex",
+    "cmwavex",
     "ifunc",
 ]
 
@@ -399,6 +407,8 @@ class TimingModel:
                 if isinstance(par, MJDParameter):
                     out[p] = dd_from_longdouble(
                         np.longdouble(v) if v is not None else np.longdouble(0.0))
+                elif isinstance(v, (list, tuple)):
+                    out[p] = jnp.asarray(v, dtype=jnp.float64)
                 elif isinstance(v, (int, float)) or v is None:
                     out[p] = float(v) if v is not None else 0.0
         return out

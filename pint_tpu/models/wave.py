@@ -1,0 +1,64 @@
+"""Tempo-style WAVE sinusoidal timing-noise model (phase component).
+
+Reference ``wave.py:11,148``: phase = F0 * sum_k [a_k sin(k*om*dt) +
+b_k cos(k*om*dt)], om = WAVE_OM [rad/day], dt = t_bary - WAVEEPOCH [days],
+(a_k, b_k) = WAVEk [seconds] pair parameters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import MJDParameter, floatParameter, pairParameter
+from pint_tpu.models.timing_model import DAY_S, PhaseComponent
+from pint_tpu.phase import Phase
+
+__all__ = ["Wave"]
+
+
+class Wave(PhaseComponent):
+    register = True
+    category = "wave"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("WAVEEPOCH",
+                                    description="Reference epoch for wave solution"))
+        self.add_param(floatParameter("WAVE_OM", units="rad/d",
+                                      description="Base frequency of wave solution"))
+        self.add_param(pairParameter("WAVE1", units="s", continuous=False,
+                                     description="Wave sin/cos amplitudes"))
+        self.num_wave_terms = 1
+
+    def setup(self):
+        terms = sorted(int(p[4:]) for p in self.params
+                       if p.startswith("WAVE") and p[4:].isdigit())
+        self.num_wave_terms = len(terms)
+        if terms and terms != list(range(1, max(terms) + 1)):
+            missing = min(set(range(1, max(terms) + 1)) - set(terms))
+            raise MissingParameter("Wave", f"WAVE{missing}")
+
+    def validate(self):
+        if self.WAVE_OM.value is None:
+            raise MissingParameter("Wave", "WAVE_OM")
+        if self.WAVEEPOCH.value is None:
+            pep = getattr(self._parent, "PEPOCH", None)
+            if pep is None or pep.value is None:
+                raise MissingParameter("Wave", "WAVEEPOCH",
+                                       "WAVEEPOCH or PEPOCH required")
+            self.WAVEEPOCH.value = pep.value
+
+    def phase_func(self, pv, batch, ctx, delay):
+        epoch = pv["WAVEEPOCH"]
+        epoch = epoch.to_float() if hasattr(epoch, "to_float") else epoch
+        dt_day = (batch.tdb.hi - epoch) + batch.tdb.lo - delay / DAY_S
+        base = pv.get("WAVE_OM", 0.0) * dt_day
+        times = jnp.zeros(batch.ntoas)
+        for k in range(1, self.num_wave_terms + 1):
+            ab = pv.get(f"WAVE{k}")
+            if ab is None:
+                continue
+            arg = k * base
+            times = times + ab[0] * jnp.sin(arg) + ab[1] * jnp.cos(arg)
+        return Phase.from_float(times * pv.get("F0", 0.0))

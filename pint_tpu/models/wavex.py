@@ -1,0 +1,149 @@
+"""Fourier-basis red-noise approximants: WaveX, DMWaveX, CMWaveX.
+
+Reference ``wavex.py:14`` (delay = sum_i WXSIN_i sin(2 pi f_i dt) +
+WXCOS_i cos(...), f_i [1/d], dt = t_bary - WXEPOCH [days]),
+``dmwavex.py:15`` (same series builds a DM, delay = DMconst*DM/f^2) and
+``cmwavex.py:15`` (series builds a chromatic measure, delay =
+DMconst*CM*(f/MHz)^-TNCHROMIDX).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DMconst
+from pint_tpu.exceptions import MissingParameter
+from pint_tpu.models.parameter import MJDParameter, prefixParameter
+from pint_tpu.models.timing_model import DAY_S, DelayComponent
+
+__all__ = ["WaveX", "DMWaveX", "CMWaveX"]
+
+_TWO_PI = 2.0 * np.pi
+
+
+class _WaveXBase(DelayComponent):
+    """Shared machinery for the three Fourier series components."""
+
+    #: prefix triplet, e.g. ("WXFREQ_", "WXSIN_", "WXCOS_")
+    prefixes = ("WXFREQ_", "WXSIN_", "WXCOS_")
+    epoch_name = "WXEPOCH"
+
+    def setup(self):
+        pf = self.prefixes[0]
+        self.indices = sorted(int(p[len(pf):]) for p in self.params
+                              if p.startswith(pf))
+        # grow missing sin/cos partners with zero amplitude
+        for i in self.indices:
+            for pre in self.prefixes[1:]:
+                nm = f"{pre}{i:04d}"
+                if nm not in self._params_dict:
+                    self.add_param(self._params_dict[f"{pre}0001"].new_param(i, value=0.0))
+
+    def validate(self):
+        if getattr(self, self.epoch_name).value is None:
+            pep = getattr(self._parent, "PEPOCH", None)
+            if pep is None or pep.value is None:
+                raise MissingParameter(type(self).__name__, self.epoch_name)
+            getattr(self, self.epoch_name).value = pep.value
+        pf = self.prefixes[0]
+        for i in self.indices:
+            if self._params_dict[f"{pf}{i:04d}"].value in (None, 0.0):
+                raise MissingParameter(type(self).__name__, f"{pf}{i:04d}")
+
+    def series(self, pv, batch, acc_delay):
+        """sum_i [ SIN_i sin(2 pi f_i dt) + COS_i cos(2 pi f_i dt) ]."""
+        epoch = pv[self.epoch_name]
+        epoch = epoch.to_float() if hasattr(epoch, "to_float") else epoch
+        dt_day = (batch.tdb.hi - epoch) + batch.tdb.lo - acc_delay / DAY_S
+        fpre, spre, cpre = self.prefixes
+        out = jnp.zeros(batch.ntoas)
+        for i in self.indices:
+            arg = _TWO_PI * pv.get(f"{fpre}{i:04d}", 0.0) * dt_day
+            out = out + pv.get(f"{spre}{i:04d}", 0.0) * jnp.sin(arg) \
+                      + pv.get(f"{cpre}{i:04d}", 0.0) * jnp.cos(arg)
+        return out
+
+    def _bary_freq(self, pv, batch):
+        parent = self._parent
+        if parent is not None:
+            for comp in parent.components.values():
+                if hasattr(comp, "barycentric_radio_freq"):
+                    return comp.barycentric_radio_freq(pv, batch)
+        return batch.freq
+
+
+class WaveX(_WaveXBase):
+    """Achromatic Fourier delay (reference ``wavex.py:14``)."""
+
+    register = True
+    category = "wavex"
+    prefixes = ("WXFREQ_", "WXSIN_", "WXCOS_")
+    epoch_name = "WXEPOCH"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("WXEPOCH", description="WaveX reference epoch"))
+        self.add_param(prefixParameter("WXFREQ_0001", units="1/d",
+                                       description="WaveX component frequency"))
+        self.add_param(prefixParameter("WXSIN_0001", units="s", value=0.0,
+                                       description="WaveX sine amplitude"))
+        self.add_param(prefixParameter("WXCOS_0001", units="s", value=0.0,
+                                       description="WaveX cosine amplitude"))
+        self.indices = [1]
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        return self.series(pv, batch, acc_delay)
+
+
+class DMWaveX(_WaveXBase):
+    """Fourier DM-noise: the series is a DM in pc/cm^3
+    (reference ``dmwavex.py:15``)."""
+
+    register = True
+    category = "dmwavex"
+    prefixes = ("DMWXFREQ_", "DMWXSIN_", "DMWXCOS_")
+    epoch_name = "DMWXEPOCH"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("DMWXEPOCH", description="DMWaveX reference epoch"))
+        self.add_param(prefixParameter("DMWXFREQ_0001", units="1/d",
+                                       description="DMWaveX component frequency"))
+        self.add_param(prefixParameter("DMWXSIN_0001", units="pc/cm3", value=0.0,
+                                       description="DMWaveX sine amplitude"))
+        self.add_param(prefixParameter("DMWXCOS_0001", units="pc/cm3", value=0.0,
+                                       description="DMWaveX cosine amplitude"))
+        self.indices = [1]
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        dm = self.series(pv, batch, acc_delay)
+        freq = self._bary_freq(pv, batch)
+        return dm * DMconst / freq**2
+
+
+class CMWaveX(_WaveXBase):
+    """Fourier chromatic-noise; needs TNCHROMIDX (from ChromaticCM)
+    (reference ``cmwavex.py:15``)."""
+
+    register = True
+    category = "cmwavex"
+    prefixes = ("CMWXFREQ_", "CMWXSIN_", "CMWXCOS_")
+    epoch_name = "CMWXEPOCH"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("CMWXEPOCH", description="CMWaveX reference epoch"))
+        self.add_param(prefixParameter("CMWXFREQ_0001", units="1/d",
+                                       description="CMWaveX component frequency"))
+        self.add_param(prefixParameter("CMWXSIN_0001", units="pc/cm3", value=0.0,
+                                       description="CMWaveX sine amplitude"))
+        self.add_param(prefixParameter("CMWXCOS_0001", units="pc/cm3", value=0.0,
+                                       description="CMWaveX cosine amplitude"))
+        self.indices = [1]
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        cm = self.series(pv, batch, acc_delay)
+        freq = self._bary_freq(pv, batch)
+        alpha = pv.get("TNCHROMIDX", 4.0)
+        return cm * DMconst * jnp.power(freq, -alpha)

@@ -81,8 +81,15 @@ def make_fake_toas_fromMJDs(mjds, model, freq: float = 1400.0, obs: str = "gbt",
 
     mjds = np.asarray(mjds)
     n = len(mjds)
-    freqs = np.broadcast_to(np.atleast_1d(freq), (n,)).astype(float)
-    errs = np.broadcast_to(np.atleast_1d(error_us), (n,)).astype(float)
+    # scalar -> constant; shorter array -> tiled over TOAs (the reference
+    # tiles multi-frequency patterns the same way, simulation.py:371)
+    freqs = np.atleast_1d(freq).astype(float)
+    errs = np.atleast_1d(error_us).astype(float)
+    for nm, arr in (("freq", freqs), ("error_us", errs)):
+        if len(arr) not in (1, n) and n % len(arr) != 0:
+            raise ValueError(f"{nm} length {len(arr)} does not divide ntoas {n}")
+    freqs = np.resize(freqs, n)
+    errs = np.resize(errs, n)
     obsname = get_observatory(obs).name
     ts = TOAs(
         utc_mjd=np.asarray(mjds, dtype=np.longdouble),

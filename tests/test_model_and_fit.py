@@ -78,9 +78,11 @@ class TestIO:
 
 class TestModelBuild:
     def test_components(self, model):
+        # SOLARN0 0.00 in the par selects SolarWindDispersion (as in the
+        # reference, where SOLARN0 is an NE_SW alias)
         assert set(model.components) == {
             "AstrometryEquatorial", "Spindown", "SolarSystemShapiro",
-            "DispersionDM", "AbsPhase"}
+            "DispersionDM", "AbsPhase", "SolarWindDispersion"}
 
     def test_free_params(self, model):
         assert set(model.free_params) == {"RAJ", "DECJ", "F0", "F1", "DM"}
