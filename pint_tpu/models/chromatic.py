@@ -26,12 +26,7 @@ class Chromatic(DelayComponent):
     category = "chromatic_constant"
 
     def _bary_freq(self, pv, batch):
-        parent = self._parent
-        if parent is not None:
-            for comp in parent.components.values():
-                if hasattr(comp, "barycentric_radio_freq"):
-                    return comp.barycentric_radio_freq(pv, batch)
-        return batch.freq
+        return self.barycentric_freq(pv, batch)
 
     def chromatic_time_delay(self, cm, alpha, freq):
         return cm * DMconst * jnp.power(freq, -alpha)
@@ -59,6 +54,10 @@ class ChromaticCM(Chromatic):
     def setup(self):
         idxs = [0] + sorted(int(n[2:]) for n in self.params
                             if n.startswith("CM") and n[2:].isdigit() and n != "CM")
+        if idxs != list(range(len(idxs))):
+            missing = min(set(range(max(idxs) + 1)) - set(idxs))
+            raise MissingParameter("ChromaticCM", f"CM{missing}",
+                                   "CM Taylor terms must be contiguous")
         self.num_cm_terms = len(idxs)
 
     def validate(self):

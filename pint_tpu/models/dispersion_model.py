@@ -18,7 +18,8 @@ from pint_tpu.exceptions import MissingParameter
 from pint_tpu.models.parameter import MJDParameter, maskParameter, prefixParameter
 from pint_tpu.models.timing_model import DelayComponent
 
-__all__ = ["Dispersion", "DispersionDM", "DispersionDMX", "DispersionJump"]
+__all__ = ["Dispersion", "DispersionDM", "DispersionDMX", "DispersionJump",
+           "FDJumpDM"]
 
 _DAY_PER_YEAR = 365.25
 
@@ -30,12 +31,7 @@ class Dispersion(DelayComponent):
         return dm * DMconst / freq**2
 
     def _freq(self, pv, batch):
-        parent = self._parent
-        if parent is not None:
-            for comp in parent.components.values():
-                if hasattr(comp, "barycentric_radio_freq"):
-                    return comp.barycentric_radio_freq(pv, batch)
-        return batch.freq
+        return self.barycentric_freq(pv, batch)
 
 
 class DispersionDM(Dispersion):
@@ -62,6 +58,10 @@ class DispersionDM(Dispersion):
             int(name[2:]) for name in self.params
             if name.startswith("DM") and name[2:].isdigit() and name != "DM"
         )
+        if idxs != list(range(len(idxs))):
+            missing = min(set(range(max(idxs) + 1)) - set(idxs))
+            raise MissingParameter("DispersionDM", f"DM{missing}",
+                                   "DM Taylor terms must be contiguous")
         self.num_dm_terms = len(idxs)
 
     def validate(self):
@@ -97,6 +97,9 @@ class DispersionDM(Dispersion):
         for i in range(len(terms) - 1, -1, -1):
             acc = acc * dt_yr + terms[i] / math.factorial(i)
         return acc
+
+    def dm_func(self, pv, batch, ctx):
+        return self.base_dm(pv, batch)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         freq = self._freq(pv, batch)
@@ -146,6 +149,9 @@ class DispersionDMX(Dispersion):
         vals = jnp.stack([pv.get(f"DMX_{i:04d}", 0.0) for i in self.dmx_indices])
         return jnp.sum(vals[:, None] * ctx["masks"], axis=0)
 
+    def dm_func(self, pv, batch, ctx):
+        return self.dmx_dm(pv, batch, ctx)
+
     def delay_func(self, pv, batch, ctx, acc_delay):
         freq = self._freq(pv, batch)
         return self.dispersion_time_delay(self.dmx_dm(pv, batch, ctx), freq)
@@ -186,5 +192,53 @@ class DispersionJump(Dispersion):
             out = out - pv.get(j, 0.0) * ctx["masks"][j]
         return out
 
+    def dm_func(self, pv, batch, ctx):
+        return self.jump_dm(pv, batch, ctx)
+
     def delay_func(self, pv, batch, ctx, acc_delay):
         return jnp.zeros_like(batch.freq)
+
+
+class FDJumpDM(Dispersion):
+    """System-dependent DM offsets for narrowband datasets, with the
+    corresponding dispersion delay (reference ``dispersion_model.py:808``).
+
+    Unlike DMJUMP (wideband DM measurements only, zero delay), FDJUMPDM
+    offsets *do* disperse the TOAs: delay = K * dm / f^2 with
+    dm = -FDJUMPDM on the selected TOAs.
+    """
+
+    register = True
+    category = "fdjumpdm"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(maskParameter("FDJUMPDM", index=1, units="pc/cm3", value=0.0,
+                                     description="System-dependent DM offset"))
+        self.fdjump_dms = ["FDJUMPDM1"]
+
+    def setup(self):
+        self.fdjump_dms = [p for p in self.params if p.startswith("FDJUMPDM")]
+
+    def build_context(self, toas):
+        n = len(toas)
+        masks = {}
+        for j in self.fdjump_dms:
+            idx = self._params_dict[j].select_toa_mask(toas)
+            m = np.zeros(n)
+            m[idx] = 1.0
+            masks[j] = jnp.asarray(m)
+        return {"masks": masks}
+
+    def fdjump_dm(self, pv, batch, ctx):
+        out = jnp.zeros_like(batch.freq)
+        for j in self.fdjump_dms:
+            out = out - pv.get(j, 0.0) * ctx["masks"][j]
+        return out
+
+    def dm_func(self, pv, batch, ctx):
+        return self.fdjump_dm(pv, batch, ctx)
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        freq = self._freq(pv, batch)
+        return self.dispersion_time_delay(self.fdjump_dm(pv, batch, ctx), freq)

@@ -32,9 +32,11 @@ class FDJump(DelayComponent):
         self.add_param(boolParameter(
             "FDJUMPLOG", value=True,
             description="Use log-frequency (Y) or linear frequency (N) for FDJUMPs"))
+        # exemplars carry value=None so unset indices never reach the par
+        # file (as_parfile_line skips None) or the TOA selection
         for j in range(1, fdjump_max_index + 1):
             self.add_param(maskParameter(
-                f"FD{j}JUMP", index=1, units="s", value=0.0,
+                f"FD{j}JUMP", index=1, units="s",
                 description=f"System-dependent FD delay of polynomial index {j}"))
         self.fdjumps = []
 

@@ -33,16 +33,8 @@ class FD(DelayComponent):
             missing = min(set(range(1, max(terms) + 1)) - set(terms))
             raise MissingParameter("FD", f"FD{missing}")
 
-    def _bary_freq(self, pv, batch):
-        parent = self._parent
-        if parent is not None:
-            for comp in parent.components.values():
-                if hasattr(comp, "barycentric_radio_freq"):
-                    return comp.barycentric_radio_freq(pv, batch)
-        return batch.freq
-
     def delay_func(self, pv, batch, ctx, acc_delay):
-        freq = self._bary_freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         log_f = jnp.log(freq / 1000.0)  # MHz -> GHz
         log_f = jnp.where(jnp.isfinite(log_f), log_f, 0.0)
         # Horner over FD_n ... FD_1, zero constant term

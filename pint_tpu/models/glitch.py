@@ -37,29 +37,30 @@ class Glitch(PhaseComponent):
             ("GLF0D_1", "Hz", "Decaying glitch frequency increment"),
             ("GLTD_1", "day", "Glitch decay time constant"),
         ]:
-            p = prefixParameter(name, units=units, description=desc, value=0.0)
+            # value=None: the index-1 exemplar must not register as a real
+            # glitch when par files number glitches starting at >= 2
+            p = prefixParameter(name, units=units, description=desc)
             self.add_param(p)
         self.glitch_indices = [1]
 
     def setup(self):
-        self.glitch_indices = sorted(
-            int(name.split("_")[1]) for name in self.params if name.startswith("GLEP_")
-        )
-        # any glitch quantity mentioned without its epoch is an error; also
-        # grow the family so every index has the full parameter set
-        idx_all = sorted({int(n.split("_")[1]) for n in self.params if "_" in n})
+        # a glitch index exists iff some GL*_i parameter has a set value;
+        # grow the family so every live index has the full parameter set
+        idx_all = sorted({int(n.split("_")[1]) for n in self.params
+                          if "_" in n and self._params_dict[n].value is not None})
         for i in idx_all:
             for pre in ("GLEP_", "GLPH_", "GLF0_", "GLF1_", "GLF2_", "GLF0D_", "GLTD_"):
                 nm = f"{pre}{i}"
                 if nm not in self._params_dict:
                     ex = self._params_dict[f"{pre}1"]
                     newp = ex.new_param(i, value=0.0)
+                    newp.name = nm  # glitch indices are unpadded
                     self.add_param(newp)
         self.glitch_indices = idx_all
 
     def validate(self):
         for i in self.glitch_indices:
-            if self._params_dict[f"GLEP_{i}"].value in (None, 0.0):
+            if (self._params_dict[f"GLEP_{i}"].value or 0.0) == 0.0:
                 raise MissingParameter("Glitch", f"GLEP_{i}")
             if (self._params_dict[f"GLF0D_{i}"].value or 0.0) != 0.0 and \
                     (self._params_dict[f"GLTD_{i}"].value or 0.0) == 0.0:

@@ -111,8 +111,11 @@ class ModelBuilder:
         if any(k.startswith("FD") and k[2:].isdigit() for k in keys) \
                 and "FD" in self.templates:
             chosen.append("FD")
-        if any(k.startswith("FD") and "JUMP" in k for k in keys) \
-                and "FDJump" in self.templates:
+        if any(k.startswith("FDJUMPDM") for k in keys) \
+                and "FDJumpDM" in self.templates:
+            chosen.append("FDJumpDM")
+        if any(k.startswith("FD") and "JUMP" in k and not k.startswith("FDJUMPDM")
+               for k in keys) and "FDJump" in self.templates:
             chosen.append("FDJump")
         if has("SIFUNC") and "IFunc" in self.templates:
             chosen.append("IFunc")

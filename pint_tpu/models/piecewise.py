@@ -32,17 +32,20 @@ class PiecewiseSpindown(PhaseComponent):
             ("PWF1_1", "Hz/s", "Piecewise solution frequency-derivative offset"),
             ("PWF2_1", "Hz/s^2", "Piecewise solution second-derivative offset"),
         ]:
-            self.add_param(prefixParameter(name, units=units, description=desc,
-                                           value=0.0))
+            # value=None exemplars: see Glitch — ranges may start at index >= 2
+            self.add_param(prefixParameter(name, units=units, description=desc))
         self.pw_indices = [1]
 
     def setup(self):
-        idx_all = sorted({int(n.split("_")[1]) for n in self.params if "_" in n})
+        idx_all = sorted({int(n.split("_")[1]) for n in self.params
+                          if "_" in n and self._params_dict[n].value is not None})
         for i in idx_all:
             for pre in ("PWEP_", "PWSTART_", "PWSTOP_", "PWPH_", "PWF0_", "PWF1_", "PWF2_"):
                 nm = f"{pre}{i}"
                 if nm not in self._params_dict:
-                    self.add_param(self._params_dict[f"{pre}1"].new_param(i, value=0.0))
+                    newp = self._params_dict[f"{pre}1"].new_param(i, value=0.0)
+                    newp.name = nm  # piecewise indices are unpadded
+                    self.add_param(newp)
         self.pw_indices = idx_all
 
     def validate(self):

@@ -87,10 +87,7 @@ class _SolarWindBase(DelayComponent):
         return theta, r
 
     def _freq(self, pv, batch):
-        for comp in self._parent.components.values():
-            if hasattr(comp, "barycentric_radio_freq"):
-                return comp.barycentric_radio_freq(pv, batch)
-        return batch.freq
+        return self.barycentric_freq(pv, batch)
 
     def _theta0(self):
         """Minimum elongation (conjunction), from the pulsar's ecliptic
@@ -132,6 +129,10 @@ class SolarWindDispersion(_SolarWindBase):
     def setup(self):
         idxs = [0] + sorted(int(n[5:]) for n in self.params
                             if n.startswith("NE_SW") and n[5:].isdigit() and n != "NE_SW")
+        if idxs != list(range(len(idxs))):
+            missing = min(set(range(max(idxs) + 1)) - set(idxs))
+            raise MissingParameter("SolarWindDispersion", f"NE_SW{missing}",
+                                   "NE_SW Taylor terms must be contiguous")
         self.num_ne_sw_terms = len(idxs)
 
     def validate(self):
@@ -167,6 +168,9 @@ class SolarWindDispersion(_SolarWindBase):
         else:
             geom = solar_wind_geometry_pl(r, theta, pv.get("SWP", 2.0))
         return self.ne_sw(pv, batch) * geom
+
+    def dm_func(self, pv, batch, ctx):
+        return self.solar_wind_dm(pv, batch)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         freq = self._freq(pv, batch)
@@ -228,6 +232,11 @@ class SolarWindDispersionX(_SolarWindBase):
             scale = (geom - g_opp) / (g_conj - g_opp)
             dm = dm + pv.get(f"SWXDM_{i:04d}", 0.0) * scale * ctx["masks"][k]
         return dm
+
+    def dm_func(self, pv, batch, ctx):
+        if ctx.get("masks") is None:
+            return jnp.zeros(batch.ntoas)
+        return self.swx_dm(pv, batch, ctx)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         if ctx.get("masks") is None:

@@ -140,6 +140,17 @@ class Component:
 class DelayComponent(Component):
     kind = "delay"
 
+    def barycentric_freq(self, pv, batch):
+        """Observing frequency Doppler-shifted to the SSB when an astrometry
+        component provides it; topocentric otherwise.  Single shared
+        implementation for every frequency-dependent delay component."""
+        parent = self._parent
+        if parent is not None:
+            for comp in parent.components.values():
+                if hasattr(comp, "barycentric_radio_freq"):
+                    return comp.barycentric_radio_freq(pv, batch)
+        return batch.freq
+
     def delay_func(self, pv, batch, ctx, acc_delay):
         """Return (N,) float64 delay seconds. ``acc_delay`` is the summed
         delay of all earlier components (barycentring chain)."""
@@ -476,6 +487,79 @@ class TimingModel:
         par = getattr(self, name)
         comp = par._component
         return comp is not None and getattr(comp, "kind", None) == "noise"
+
+    # -- wideband DM evaluation ---------------------------------------------
+    def _dm_components(self) -> List[Component]:
+        return [c for c in self.delay_components if hasattr(c, "dm_func")]
+
+    def _get_compiled_dm(self, toas, free_names: Tuple[str, ...]) -> dict:
+        """Compiled total-DM bundle, structured like ``_get_compiled`` but
+        summing component ``dm_func`` contributions (reference
+        ``timing_model.py:1645 total_dm``)."""
+        base = self._get_compiled(toas, free_names)  # reuses batch/ctx caches
+        fn_key = (free_names, len(toas))
+        if fn_key not in self._cache.setdefault("dm_fns", {}):
+            dm_comps = self._dm_components()
+            comp_names = {id(c): n for n, c in self.components.items()}
+
+            def dm_fn(values, const_pv, batch, ctx):
+                pv = dict(const_pv)
+                for i, nm in enumerate(free_names):
+                    pv[nm] = values[i]
+                dm = jnp.zeros(batch.ntoas)
+                for comp in dm_comps:
+                    dm = dm + comp.dm_func(pv, batch, ctx[comp_names[id(comp)]])
+                return dm
+
+            self._cache["dm_fns"][fn_key] = {
+                "dm": jax.jit(dm_fn),
+                "jac_dm": jax.jit(jax.jacfwd(dm_fn, argnums=0)),
+            }
+        fns = self._cache["dm_fns"][fn_key]
+        const_pv = self._const_pv()
+        batch, ctx = base["batch"], base["ctx"]
+        return {
+            "dm": lambda v: fns["dm"](v, const_pv, batch, ctx),
+            "jac_dm": lambda v: fns["jac_dm"](v, const_pv, batch, ctx),
+            "free_names": free_names,
+        }
+
+    def total_dm(self, toas) -> np.ndarray:
+        """Model DM at each TOA in pc/cm^3 (reference ``timing_model.py:1645``)."""
+        c = self._get_compiled_dm(toas, tuple(self.free_params))
+        return np.asarray(c["dm"](self._free_values(c["free_names"])))
+
+    def d_dm_d_param(self, toas, param: str) -> np.ndarray:
+        """d(total_dm)/d(param) via autodiff (reference ``timing_model.py:2140``)."""
+        c = self._get_compiled_dm(toas, (param,))
+        return np.asarray(c["jac_dm"](self._free_values((param,))))[:, 0]
+
+    def dm_designmatrix(self, toas, incfrozen: bool = False, incoffset: bool = True):
+        """(Md, names, units): DM-residual design matrix rows, column-aligned
+        with :meth:`designmatrix` (zero Offset column; zero columns for
+        parameters that do not affect DM)."""
+        free = self.design_param_names(incfrozen=incfrozen)
+        c = self._get_compiled_dm(toas, free)
+        J = np.asarray(c["jac_dm"](self._free_values(free)))  # (N, nfree)
+        incoffset = incoffset and "PhaseOffset" not in self.components
+        names = (["Offset"] if incoffset else []) + list(free)
+        M = np.zeros((len(toas), len(names)))
+        M[:, 1 if incoffset else 0:] = J
+        units = (["pc/cm3"] if incoffset else []) + \
+            [f"pc/cm3/({getattr(self, p).units})" for p in free]
+        return M, names, units
+
+    def scaled_dm_uncertainty(self, toas) -> np.ndarray:
+        """DMEFAC/DMEQUAD-scaled wideband DM uncertainties in pc/cm^3
+        (reference ``timing_model.py:1722``)."""
+        err = toas.get_dm_errors()
+        if err is None:
+            raise ValueError("TOAs have no wideband DM errors (-pp_dme flags)")
+        err = np.asarray(err, dtype=np.float64)
+        for c in self.noise_components:
+            if hasattr(c, "scale_dm_sigma"):
+                err = c.scale_dm_sigma(self, toas, err)
+        return err
 
     def d_phase_d_param(self, toas, delay, param: str) -> np.ndarray:
         """Numerical-free analytic derivative via autodiff (for reference-API

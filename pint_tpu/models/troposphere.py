@@ -94,7 +94,7 @@ def pressure_from_altitude_kpa(h_m: float) -> float:
     gph = EARTH_R_KM * h_km / (EARTH_R_KM + h_km)
     if gph > 11.0:
         log.warning("Pressure approximation invalid above 11 km")
-    T = 288.15 - 0.0065 * h_m
+    T = 288.15 - 0.0065 * gph * 1e3
     return 101.325 * (288.15 / T) ** -5.25575
 
 

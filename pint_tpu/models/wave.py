@@ -56,9 +56,11 @@ class Wave(PhaseComponent):
         base = pv.get("WAVE_OM", 0.0) * dt_day
         times = jnp.zeros(batch.ntoas)
         for k in range(1, self.num_wave_terms + 1):
-            ab = pv.get(f"WAVE{k}")
-            if ab is None:
+            # value check on the host parameter: an unset pair exemplar is
+            # mapped to scalar 0.0 by _const_pv and must be skipped here
+            if self._params_dict[f"WAVE{k}"].value is None:
                 continue
+            ab = pv.get(f"WAVE{k}")
             arg = k * base
             times = times + ab[0] * jnp.sin(arg) + ab[1] * jnp.cos(arg)
         return Phase.from_float(times * pv.get("F0", 0.0))

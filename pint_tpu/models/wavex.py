@@ -65,12 +65,7 @@ class _WaveXBase(DelayComponent):
         return out
 
     def _bary_freq(self, pv, batch):
-        parent = self._parent
-        if parent is not None:
-            for comp in parent.components.values():
-                if hasattr(comp, "barycentric_radio_freq"):
-                    return comp.barycentric_radio_freq(pv, batch)
-        return batch.freq
+        return self.barycentric_freq(pv, batch)
 
 
 class WaveX(_WaveXBase):
@@ -115,6 +110,9 @@ class DMWaveX(_WaveXBase):
         self.add_param(prefixParameter("DMWXCOS_0001", units="pc/cm3", value=0.0,
                                        description="DMWaveX cosine amplitude"))
         self.indices = [1]
+
+    def dm_func(self, pv, batch, ctx):
+        return self.series(pv, batch, jnp.zeros(batch.ntoas))
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         dm = self.series(pv, batch, acc_delay)

@@ -20,6 +20,9 @@ __all__ = ["Residuals"]
 
 
 class Residuals:
+    residual_type = "toa"
+    unit = "s"
+
     def __init__(self, toas, model, subtract_mean: bool = True,
                  use_weighted_mean: bool = True,
                  track_mode: Optional[str] = None):

@@ -51,11 +51,22 @@ def zero_residuals(ts: TOAs, model, maxiter: int = 10,
 
 
 def make_fake_toas(ts: TOAs, model, add_noise: bool = False,
+                   wideband: bool = False, wideband_dm_error: float = 1e-4,
                    rng: Optional[np.random.Generator] = None) -> TOAs:
-    """Zero the residuals of *ts* under *model* (+ optional Gaussian noise)."""
+    """Zero the residuals of *ts* under *model* (+ optional Gaussian noise).
+
+    With ``wideband=True`` each TOA also gets -pp_dm/-pp_dme flags set to the
+    model-predicted DM (+ noise), mirroring reference ``simulation.py:126``
+    ``update_fake_dms``."""
     zero_residuals(ts, model)
+    rng = rng or np.random.default_rng()
+    if wideband:
+        dm = model.total_dm(ts)
+        dme = np.full(len(ts), float(wideband_dm_error))
+        if add_noise:
+            dm = dm + rng.standard_normal(len(ts)) * dme
+        ts.update_dms(dm, dme)
     if add_noise:
-        rng = rng or np.random.default_rng()
         err_s = model.scaled_toa_uncertainty(ts)
         ts.adjust_TOAs(rng.standard_normal(len(ts)) * err_s)
     return ts
@@ -70,11 +81,12 @@ def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int, model,
     mjds = np.linspace(startMJD, endMJD, ntoas)
     return make_fake_toas_fromMJDs(mjds, model, freq=freq, obs=obs,
                                    error_us=error_us, add_noise=add_noise,
-                                   name=name, rng=rng)
+                                   wideband=wideband, name=name, rng=rng)
 
 
 def make_fake_toas_fromMJDs(mjds, model, freq: float = 1400.0, obs: str = "gbt",
                             error_us: float = 1.0, add_noise: bool = False,
+                            wideband: bool = False,
                             name: str = "fake", rng=None) -> TOAs:
     """Synthetic TOAs at the given MJDs (reference ``simulation.py:371``)."""
     from pint_tpu.observatory import get_observatory
@@ -104,7 +116,8 @@ def make_fake_toas_fromMJDs(mjds, model, freq: float = 1400.0, obs: str = "gbt",
     ts.apply_clock_corrections(include_bipm=include_bipm)
     ts.compute_TDBs()
     ts.compute_posvels(ephem=ephem, planets=planets)
-    return make_fake_toas(ts, model, add_noise=add_noise, rng=rng)
+    return make_fake_toas(ts, model, add_noise=add_noise, wideband=wideband,
+                          rng=rng)
 
 
 def make_fake_toas_fromtim(timfile: str, model, add_noise: bool = False,

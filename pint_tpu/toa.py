@@ -237,6 +237,34 @@ class TOAs:
                 vals.append(fill_value)
         return vals, valid
 
+    # -- wideband DM data (reference ``residuals.py:1062 get_dm_data``) -----
+    @property
+    def wideband(self) -> bool:
+        """True when every TOA carries a wideband DM measurement flag."""
+        return len(self) > 0 and all("pp_dm" in fl for fl in self.flags)
+
+    def get_dms(self) -> Optional[np.ndarray]:
+        """Wideband DM measurements (pc/cm^3) from -pp_dm flags, or None."""
+        vals, valid = self.get_flag_value("pp_dm", as_type=float)
+        if len(valid) != len(self):
+            return None
+        return np.asarray(vals, dtype=np.float64)
+
+    def get_dm_errors(self) -> Optional[np.ndarray]:
+        """Wideband DM uncertainties (pc/cm^3) from -pp_dme flags, or None."""
+        vals, valid = self.get_flag_value("pp_dme", as_type=float)
+        if len(valid) != len(self):
+            return None
+        return np.asarray(vals, dtype=np.float64)
+
+    def update_dms(self, dms: np.ndarray, errors: Optional[np.ndarray] = None):
+        """Set the wideband DM flags on every TOA (simulation uses this)."""
+        for i, fl in enumerate(self.flags):
+            fl["pp_dm"] = repr(float(dms[i]))
+            if errors is not None:
+                fl["pp_dme"] = repr(float(errors[i]))
+        self._version = getattr(self, "_version", 0) + 1
+
     def get_pulse_numbers(self) -> Optional[np.ndarray]:
         if self.pulse_number is not None:
             return self.pulse_number

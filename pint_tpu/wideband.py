@@ -1,0 +1,364 @@
+"""Wideband (TOA + DM) residuals and fitters.
+
+Counterpart of reference ``residuals.py:925 WidebandDMResiduals``,
+``residuals.py:1096 CombinedResiduals``, ``residuals.py:1170
+WidebandTOAResiduals`` and ``fitter.py:2093 WidebandTOAFitter`` /
+``fitter.py:1678 WidebandDownhillFitter``.
+
+Wideband TOAs carry an independent DM measurement per TOA (``-pp_dm`` /
+``-pp_dme`` flags).  The fit solves one linear system over the stacked
+residual vector ``[time_resids (s); dm_resids (pc/cm3)]`` with the stacked
+design matrix ``[[M_toa], [M_dm]]`` — columns aligned per parameter, the DM
+block zero for parameters that do not affect DM (autodiff produces both
+blocks from the same parameter vector).  Correlated-noise bases span only
+the TOA rows; the DM block is diagonal.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pint_tpu.fitter import DownhillFitter, Fitter
+from pint_tpu.gls_fitter import _solve_cholesky, _solve_svd, gls_normal_equations
+from pint_tpu.logging import log
+from pint_tpu.residuals import Residuals
+from pint_tpu.utils import normalize_designmatrix, weighted_mean, woodbury_dot
+
+__all__ = [
+    "WidebandDMResiduals",
+    "CombinedResiduals",
+    "WidebandTOAResiduals",
+    "WidebandTOAFitter",
+    "WidebandDownhillFitter",
+]
+
+
+class WidebandDMResiduals:
+    """DM residuals: measured wideband DM minus model total DM
+    (reference ``residuals.py:925``)."""
+
+    residual_type = "dm"
+    unit = "pc/cm3"
+
+    def __init__(self, toas, model, subtract_mean: bool = False,
+                 use_weighted_mean: bool = True):
+        self.toas = toas
+        self.model = model
+        self.subtract_mean = subtract_mean
+        self.use_weighted_mean = use_weighted_mean
+        self.dm_data = toas.get_dms()
+        if self.dm_data is None:
+            raise ValueError(
+                "Input TOAs do not have wideband DM values (-pp_dm flags)")
+        self.dm_error = toas.get_dm_errors()
+        self._resids = None
+
+    def calc_resids(self) -> np.ndarray:
+        resids = self.dm_data - self.model.total_dm(self.toas)
+        if self.subtract_mean:
+            if self.use_weighted_mean:
+                if self.dm_error is None or np.any(self.dm_error == 0):
+                    raise ValueError("Zero DM errors: cannot weight DM residuals")
+                mean, _ = weighted_mean(resids, 1.0 / self.dm_error**2)
+                resids = resids - float(mean)
+            else:
+                resids = resids - resids.mean()
+        self._resids = resids
+        return resids
+
+    @property
+    def resids(self) -> np.ndarray:
+        if self._resids is None:
+            self.calc_resids()
+        return self._resids
+
+    resids_value = resids
+
+    def get_data_error(self, scaled: bool = True) -> np.ndarray:
+        if scaled:
+            return self.model.scaled_dm_uncertainty(self.toas)
+        return self.dm_error
+
+    def calc_chi2(self) -> float:
+        err = self.get_data_error()
+        if np.any(err == 0.0):
+            return np.inf
+        return float(np.sum((self.resids / err) ** 2))
+
+    @property
+    def chi2(self) -> float:
+        return self.calc_chi2()
+
+    @property
+    def dof(self) -> int:
+        from pint_tpu.models.dispersion_model import Dispersion
+
+        nfree = sum(len(c.free_params_component)
+                    for c in self.model.components.values()
+                    if isinstance(c, Dispersion))
+        return len(self.dm_data) - nfree - 1
+
+    def rms_weighted(self) -> float:
+        err = self.get_data_error()
+        if np.any(err == 0):
+            raise ValueError("Zero DM errors: cannot compute weighted RMS")
+        w = 1.0 / err**2
+        mean, _ = weighted_mean(self.resids, w)
+        return float(np.sqrt(np.sum(w * (self.resids - float(mean)) ** 2) / np.sum(w)))
+
+    def update(self):
+        self._resids = None
+        return self
+
+
+class CombinedResiduals:
+    """Residuals of several data types stacked unitless
+    (reference ``residuals.py:1096``)."""
+
+    def __init__(self, residuals: List):
+        self.residual_objs: Dict[str, object] = {
+            r.residual_type: r for r in residuals}
+
+    @property
+    def _combined_resids(self) -> np.ndarray:
+        return np.hstack([np.asarray(r.resids)
+                          for r in self.residual_objs.values()])
+
+    @property
+    def _combined_data_error(self) -> np.ndarray:
+        return np.hstack([np.asarray(r.get_data_error())
+                          for r in self.residual_objs.values()])
+
+    @property
+    def chi2(self) -> float:
+        return sum(r.chi2 for r in self.residual_objs.values())
+
+    def rms_weighted(self) -> Dict[str, float]:
+        return {k: r.rms_weighted() for k, r in self.residual_objs.items()}
+
+
+class WidebandTOAResiduals(CombinedResiduals):
+    """TOA + DM residuals for one wideband dataset
+    (reference ``residuals.py:1170``)."""
+
+    def __init__(self, toas, model, toa_resid_args: Optional[dict] = None,
+                 dm_resid_args: Optional[dict] = None):
+        self.toas = toas
+        self._model = model
+        toa_resid = Residuals(toas, model, **(toa_resid_args or {}))
+        toa_resid.residual_type = "toa"
+        dm_resid = WidebandDMResiduals(toas, model, **(dm_resid_args or {}))
+        super().__init__([toa_resid, dm_resid])
+        self._chi2 = None
+
+    @property
+    def model(self):
+        return self._model
+
+    @property
+    def toa(self) -> Residuals:
+        return self.residual_objs["toa"]
+
+    @property
+    def dm(self) -> WidebandDMResiduals:
+        return self.residual_objs["dm"]
+
+    @property
+    def time_resids(self) -> np.ndarray:
+        return self.toa.time_resids
+
+    @property
+    def chi2(self) -> float:
+        if self._chi2 is None:
+            self._chi2 = self.calc_chi2()
+        return self._chi2
+
+    def calc_chi2(self) -> float:
+        """Joint chi2 of the stacked system.  With correlated noise the TOA
+        block uses the Woodbury identity over the noise basis (DM rows have
+        no basis support), which is exactly the GLS chi2 the reference gets
+        by running a frozen one-step WidebandTOAFitter
+        (``residuals.py:1240``)."""
+        if not self.model.has_correlated_errors:
+            return self.toa.calc_chi2() + self.dm.calc_chi2()
+        r = self.toa.time_resids
+        sigma = self.toa.get_data_error()
+        U, w = self.model.noise_model_basis_weight(self.toas)
+        dot, _ = woodbury_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
+        return float(dot) + self.dm.calc_chi2()
+
+    @property
+    def dof(self) -> int:
+        return len(self._combined_resids) - len(self.model.free_params) - 1
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
+
+    def update(self):
+        for r in self.residual_objs.values():
+            r.update()
+        self._chi2 = None
+        return self
+
+
+class WidebandTOAFitter(Fitter):
+    """GLS fit over the stacked TOA+DM system (reference ``fitter.py:2093``)."""
+
+    def __init__(self, toas, model, track_mode: Optional[str] = None,
+                 additional_args: Optional[dict] = None):
+        self.toas = toas
+        self.model_init = model
+        self.model = copy.deepcopy(model)
+        self.track_mode = track_mode
+        self.additional_args = additional_args or {}
+        if track_mode is not None:
+            self.additional_args.setdefault("toa", {})["track_mode"] = track_mode
+        self.resids_init = self._make_resids()
+        self.resids = self._make_resids()
+        self.method = "General_Data_Fitter"
+        self.is_wideband = True
+        self.converged = False
+        self.parameter_covariance_matrix = None
+        self.errors: Dict[str, float] = {}
+
+    def _make_resids(self) -> WidebandTOAResiduals:
+        return WidebandTOAResiduals(
+            self.toas, self.model,
+            toa_resid_args=self.additional_args.get("toa", {}),
+            dm_resid_args=self.additional_args.get("dm", {}))
+
+    def update_resids(self):
+        self.resids = self._make_resids()
+        return self.resids
+
+    def _wideband_step(self, threshold: float = 0.0, full_cov: bool = False):
+        """One linearized solve of the stacked system; returns
+        (dpars, errs, covmat, params, chi2_linear)."""
+        r = self.resids._combined_resids
+        M_toa, params, units = self.model.designmatrix(self.toas)
+        M_dm, _, _ = self.model.dm_designmatrix(self.toas)
+        M = np.vstack([M_toa, M_dm])
+        n_toa = M_toa.shape[0]
+        self._noise_dims = None
+        sigma_all = np.concatenate([
+            self.model.scaled_toa_uncertainty(self.toas),
+            self.model.scaled_dm_uncertainty(self.toas),
+        ])
+        if full_cov:
+            M, norm = normalize_designmatrix(M, params)
+            M, norm = np.asarray(M), np.asarray(norm)
+            cov_toa = self.model.toa_covariance_matrix(self.toas)
+            cov = np.zeros((M.shape[0], M.shape[0]))
+            cov[:n_toa, :n_toa] = cov_toa
+            dm_sig = sigma_all[n_toa:]
+            cov[n_toa:, n_toa:] = np.diag(dm_sig**2)
+            mtcm, mtcy = gls_normal_equations(M, r, cov=cov)
+            phiinv = None
+        else:
+            Us, ws, dims = self.model.noise_basis_by_component(self.toas)
+            self._noise_dims = dims
+            if Us:
+                # noise bases span the TOA rows only
+                U = np.vstack([np.hstack(Us),
+                               np.zeros((M.shape[0] - n_toa, sum(u.shape[1] for u in Us)))])
+                M = np.hstack([M, U])
+                weights = np.concatenate([np.full(len(params), 1e40)] + ws)
+            else:
+                weights = np.full(len(params), 1e40)
+            M, norm = normalize_designmatrix(M, params)
+            M, norm = np.asarray(M), np.asarray(norm)
+            phiinv = 1.0 / weights / norm**2
+            mtcm, mtcy = gls_normal_equations(M, r, Nvec=sigma_all**2,
+                                              phiinv=phiinv)
+        if threshold <= 0:
+            try:
+                xvar, xhat = _solve_cholesky(mtcm, mtcy)
+            except np.linalg.LinAlgError:
+                xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+        else:
+            xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+        newres = r - M @ xhat
+        if full_cov:
+            chi2_lin = float(newres @ np.linalg.solve(cov, newres))
+        else:
+            cinv = 1.0 / sigma_all**2
+            chi2_lin = float(newres @ (cinv * newres) + xhat @ (phiinv * xhat))
+        dpars = xhat / norm
+        errs = np.sqrt(np.diag(xvar)) / norm
+        covmat = (xvar / norm).T / norm
+        return dpars, errs, covmat, params, chi2_lin
+
+    def _apply_step(self, dpars, errs, covmat, params):
+        for i, p in enumerate(params):
+            if p == "Offset":
+                continue
+            par = getattr(self.model, p)
+            par.value = float(par.value or 0.0) + float(dpars[i])
+            par.uncertainty = float(errs[i])
+            self.errors[p] = float(errs[i])
+        ntm = len(params)
+        self.parameter_covariance_matrix = covmat[:ntm, :ntm]
+        self.fitted_params = params
+
+    def _store_noise_ampls(self, dpars, ntm):
+        if self._noise_dims:
+            self.resids.noise_ampls = {
+                comp: dpars[ntm + off:ntm + off + size]
+                for comp, (off, size) in self._noise_dims.items()}
+
+    def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
+                 full_cov: bool = False, debug: bool = False) -> float:
+        self.model.validate()
+        self.model.validate_toas(self.toas)
+        self.update_resids()
+        chi2 = np.inf
+        for _ in range(max(1, maxiter)):
+            dpars, errs, covmat, params, chi2 = self._wideband_step(
+                threshold=threshold, full_cov=full_cov)
+            self._apply_step(dpars, errs, covmat, params)
+            self.update_resids()
+            if not full_cov:
+                self._store_noise_ampls(dpars, len(params))
+        chi2 = self.resids.calc_chi2()
+        self.converged = True
+        self.model.CHI2.value = chi2
+        return chi2
+
+
+class WidebandDownhillFitter(DownhillFitter):
+    """Iterative wideband fit with lambda-halving (reference ``fitter.py:1678``)."""
+
+    def __init__(self, toas, model, track_mode: Optional[str] = None,
+                 additional_args: Optional[dict] = None):
+        WidebandTOAFitter.__init__(self, toas, model, track_mode=track_mode,
+                                   additional_args=additional_args)
+        self.method = "downhill_wideband"
+        self.threshold = 0.0
+        self.full_cov = False
+
+    def _make_resids(self):
+        return WidebandTOAFitter._make_resids(self)
+
+    def update_resids(self):
+        return WidebandTOAFitter.update_resids(self)
+
+    def _solve_step(self):
+        dpars, errs, covmat, params, _ = WidebandTOAFitter._wideband_step(
+            self, threshold=self.threshold, full_cov=self.full_cov)
+        ntm = len(params)
+        return dpars[:ntm], params, covmat[:ntm, :ntm]
+
+    def fit_toas(self, maxiter: int = 20, full_cov: bool = False,
+                 threshold: float = 0.0, **kw) -> float:
+        self.full_cov = full_cov
+        self.threshold = threshold
+        chi2 = super().fit_toas(maxiter=maxiter, **kw)
+        if not full_cov:
+            dpars, _, _, params, _ = WidebandTOAFitter._wideband_step(
+                self, threshold=threshold, full_cov=False)
+            WidebandTOAFitter._store_noise_ampls(self, dpars, len(params))
+        return chi2

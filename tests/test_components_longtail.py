@@ -289,3 +289,36 @@ class TestParfileRoundtrip:
         assert m2.WXSIN_0001.value == 1e-5
         assert m2.FD1.value == 1e-4
         assert m2.NE_SW.value == 10.0
+
+
+class TestReviewRegressions:
+    """Regressions for review findings: gap detection, index >= 2 families,
+    unset exemplars."""
+
+    def test_glitch_index_2_only(self, toas):
+        m = _model("GLEP_2 55000\nGLF0_2 1e-7\n")
+        assert m.components["Glitch"].glitch_indices == [2]
+        d = np.asarray(m.phase(toas).frac) - np.asarray(_model("").phase(toas).frac)
+        assert np.any(np.abs(d) > 0)
+
+    def test_wave_without_pairs_evaluates(self, toas):
+        m = _model("WAVEEPOCH 55000\nWAVE_OM 0.005\n")
+        m.phase(toas)  # must not crash on the unset WAVE1 exemplar
+
+    def test_cm_taylor_gap_raises(self):
+        from pint_tpu.exceptions import MissingParameter
+
+        with pytest.raises(MissingParameter):
+            _model("CM 0.01\nCM3 1e-4\nCMEPOCH 55000\n")
+
+    def test_dm_taylor_gap_raises(self):
+        from pint_tpu.exceptions import MissingParameter
+
+        with pytest.raises(MissingParameter):
+            _model("DM3 1e-4\n")
+
+    def test_fdjump_parfile_no_spurious_lines(self):
+        m = _model("FD1JUMP -fe 430 1e-4\n")
+        text = m.as_parfile()
+        assert "FD2JUMP" not in text
+        assert "FD1JUMP" in text

@@ -1,0 +1,194 @@
+"""Wideband (TOA+DM) residuals and fitters — simulation-as-fixture tests
+mirroring the reference strategy (SURVEY §4; reference tests
+test_wideband_dm_data.py / test_widebandTOA_fitting.py)."""
+
+import numpy as np
+import pytest
+
+PAR_WB = """
+PSR  J1234+5678
+RAJ  12:34:00.0
+DECJ 56:78:00.0  # parsed as degrees:arcmin (test value)
+POSEPOCH 55000
+F0   123.456789012345 1
+F1   -1.0e-14 1
+PEPOCH 55000
+DM   12.345 1
+DM1  1e-4 1
+DMEPOCH 55000
+DMJUMP -fe L-wide 0.002 1
+EPHEM DE440
+CLOCK TT(BIPM2021)
+UNITS TDB
+"""
+
+
+def _get_model(text):
+    from pint_tpu.models import get_model
+
+    return get_model([ln + "\n" for ln in text.strip().splitlines()])
+
+
+@pytest.fixture(scope="module")
+def wb_model():
+    return _get_model(PAR_WB)
+
+
+@pytest.fixture(scope="module")
+def wb_toas(wb_model):
+    from pint_tpu.simulation import make_fake_toas, make_fake_toas_uniform
+
+    ts = make_fake_toas_uniform(
+        54000, 56000, 60, wb_model, freq=np.array([430.0, 1400.0]),
+        error_us=2.0, rng=np.random.default_rng(42))
+    # put half the TOAs in the DMJUMP system *before* simulating DM data
+    for i, fl in enumerate(ts.flags):
+        fl["fe"] = "L-wide" if i % 2 else "430"
+    ts._version += 1
+    return make_fake_toas(ts, wb_model, add_noise=True, wideband=True,
+                          rng=np.random.default_rng(42))
+
+
+class TestWidebandData:
+    def test_flags_roundtrip(self, wb_toas, tmp_path):
+        from pint_tpu.toa import get_TOAs
+
+        assert wb_toas.wideband
+        p = tmp_path / "wb.tim"
+        wb_toas.write_TOA_file(str(p))
+        t2 = get_TOAs(str(p))
+        assert t2.wideband
+        np.testing.assert_allclose(t2.get_dms(), wb_toas.get_dms(), rtol=1e-12)
+        np.testing.assert_allclose(t2.get_dm_errors(), wb_toas.get_dm_errors())
+
+    def test_total_dm_matches_injection(self, wb_model, wb_toas):
+        # simulated DMs = model DM + noise(1e-4): residual scatter ~ pp_dme
+        dm_model = wb_model.total_dm(wb_toas)
+        r = wb_toas.get_dms() - dm_model
+        assert np.std(r) < 5e-4
+        # DMJUMP shifts the *model* DM (negative sign, reference
+        # dispersion_model.py:782) on the selected system only
+        mask = np.array([fl["fe"] == "L-wide" for fl in wb_toas.flags])
+        m2 = _get_model(PAR_WB.replace("DMJUMP -fe L-wide 0.002", "DMJUMP -fe L-wide 0.0"))
+        dm_nojump = m2.total_dm(wb_toas)
+        d = dm_model - dm_nojump
+        np.testing.assert_allclose(d[mask], -0.002, rtol=1e-10)
+        np.testing.assert_allclose(d[~mask], 0.0, atol=1e-14)
+
+    def test_dm_jacobian_vs_finite_difference(self, wb_model, wb_toas):
+        for par, scale in [("DM", 1e-6), ("DM1", 1e-8), ("DMJUMP1", 1e-6)]:
+            a = wb_model.d_dm_d_param(wb_toas, par)
+            p = getattr(wb_model, par)
+            v0 = float(p.value)
+            p.value = v0 + scale
+            hi = wb_model.total_dm(wb_toas)
+            p.value = v0 - scale
+            lo = wb_model.total_dm(wb_toas)
+            p.value = v0
+            num = (hi - lo) / (2 * scale)
+            np.testing.assert_allclose(a, num, atol=1e-6)
+
+    def test_dmjump_no_delay(self, wb_model, wb_toas):
+        # DMJUMP must not disperse the TOAs (reference dispersion_model.py:737)
+        d1 = wb_model.delay(wb_toas)
+        m2 = _get_model(PAR_WB.replace("DMJUMP -fe L-wide 0.002", "DMJUMP -fe L-wide 0.05"))
+        d2 = m2.delay(wb_toas)
+        np.testing.assert_allclose(d1, d2, atol=1e-12)
+
+
+class TestWidebandResiduals:
+    def test_residual_objects(self, wb_model, wb_toas):
+        from pint_tpu.wideband import WidebandTOAResiduals
+
+        r = WidebandTOAResiduals(wb_toas, wb_model)
+        assert len(r._combined_resids) == 2 * len(wb_toas)
+        assert r.dm.resids.std() < 5e-4
+        assert np.isfinite(r.chi2)
+        # dm chi2 roughly ~ N for correctly-scaled noise
+        assert 0.3 < r.dm.calc_chi2() / len(wb_toas) < 3.0
+
+    def test_dmefac_scaling(self, wb_model, wb_toas):
+        m = _get_model(PAR_WB + "\nDMEFAC -fe L-wide 2.0\nDMEQUAD -fe 430 0.001\n")
+        base = np.asarray(wb_toas.get_dm_errors())
+        scaled = m.scaled_dm_uncertainty(wb_toas)
+        mask = np.array([fl["fe"] == "L-wide" for fl in wb_toas.flags])
+        np.testing.assert_allclose(scaled[mask], 2.0 * base[mask], rtol=1e-12)
+        np.testing.assert_allclose(scaled[~mask],
+                                   np.sqrt(base[~mask] ** 2 + 0.001**2), rtol=1e-12)
+
+
+class TestWidebandFitter:
+    def test_recovers_perturbed_params(self, wb_model, wb_toas):
+        from pint_tpu.wideband import WidebandTOAFitter
+
+        m = _get_model(PAR_WB)
+        m.F0.value = m.F0.value + 2e-10
+        m.DM.value = m.DM.value + 5e-3
+        m.DMJUMP1.value = 0.0
+        f = WidebandTOAFitter(wb_toas, m)
+        chi2 = f.fit_toas(maxiter=3)
+        assert abs(f.model.F0.value - wb_model.F0.value) < 5 * f.errors["F0"]
+        assert abs(f.model.DM.value - wb_model.DM.value) < 5 * f.errors["DM"]
+        # DMJUMP is constrained by the DM data block
+        assert abs(f.model.DMJUMP1.value - 0.002) < 5 * f.errors["DMJUMP1"]
+        assert 0.5 < chi2 / f.resids.dof < 2.0
+
+    def test_downhill_matches_oneshot(self, wb_toas):
+        from pint_tpu.wideband import WidebandDownhillFitter, WidebandTOAFitter
+
+        m1 = _get_model(PAR_WB)
+        m1.F0.value += 1e-10
+        m2 = _get_model(PAR_WB)
+        m2.F0.value += 1e-10
+        f1 = WidebandTOAFitter(wb_toas, m1)
+        c1 = f1.fit_toas(maxiter=4)
+        f2 = WidebandDownhillFitter(wb_toas, m2)
+        c2 = f2.fit_toas(maxiter=15)
+        assert abs(c1 - c2) / c1 < 1e-3
+        assert abs(f1.model.F0.value - f2.model.F0.value) < 1e-13
+
+    def test_full_cov_matches_woodbury(self, wb_toas):
+        from pint_tpu.wideband import WidebandTOAFitter
+
+        m1 = _get_model(PAR_WB)
+        m2 = _get_model(PAR_WB)
+        f1 = WidebandTOAFitter(wb_toas, m1)
+        c1 = f1.fit_toas(maxiter=2, full_cov=False)
+        f2 = WidebandTOAFitter(wb_toas, m2)
+        c2 = f2.fit_toas(maxiter=2, full_cov=True)
+        assert abs(c1 - c2) / c1 < 1e-6
+        assert abs(f1.model.F0.value - f2.model.F0.value) < 1e-14
+
+    def test_auto_dispatch(self, wb_model, wb_toas):
+        from pint_tpu.fitter import Fitter
+        from pint_tpu.wideband import WidebandDownhillFitter, WidebandTOAFitter
+
+        f = Fitter.auto(wb_toas, wb_model)
+        assert isinstance(f, WidebandDownhillFitter)
+        f = Fitter.auto(wb_toas, wb_model, downhill=False)
+        assert isinstance(f, WidebandTOAFitter)
+
+
+class TestFDJumpDM:
+    def test_fdjumpdm_has_delay_and_dm(self):
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = PAR_WB.replace("DMJUMP -fe L-wide 0.002 1",
+                             "FDJUMPDM -fe L-wide 0.01 1")
+        m = _get_model(par)
+        assert "FDJumpDM" in m.components
+        ts = make_fake_toas_uniform(54000, 55000, 20, m, freq=1400.0,
+                                    error_us=1.0, wideband=True,
+                                    rng=np.random.default_rng(0))
+        for i, fl in enumerate(ts.flags):
+            fl["fe"] = "L-wide" if i % 2 else "430"
+        ts._version += 1
+        mask = np.array([fl["fe"] == "L-wide" for fl in ts.flags])
+        # DM value offset is -FDJUMPDM on selected TOAs
+        m0 = _get_model(par.replace("FDJUMPDM -fe L-wide 0.01", "FDJUMPDM -fe L-wide 0.0"))
+        ddm = m.total_dm(ts) - m0.total_dm(ts)
+        np.testing.assert_allclose(ddm[mask], -0.01, rtol=1e-10)
+        # and unlike DMJUMP it does delay the TOAs
+        dd = m.delay(ts) - m0.delay(ts)
+        assert np.all(np.abs(dd[mask]) > 1e-7)
+        np.testing.assert_allclose(dd[~mask], 0.0, atol=1e-12)
