@@ -6,11 +6,15 @@ Replaces the reference's jplephem+astropy pipeline
 * :class:`SPKEphemeris` — a from-scratch reader for JPL SPK/DAF ``.bsp``
   kernels (Chebyshev types 2 and 3), used whenever a kernel file for the
   requested ``EPHEM`` (DE405/DE421/DE440...) can be found on disk.
-* :class:`AnalyticEphemeris` — a built-in closed-form ephemeris (Standish
-  mean Keplerian elements for the planets/EMB + truncated lunar theory for
-  the Earth-Moon split + mass-weighted Sun-SSB offset).  Accuracy ~1e-5 AU
-  for the Earth (a few ms of Roemer delay) — sufficient for internally
-  consistent simulation/fit cycles and clearly logged as approximate.
+* :class:`AnalyticEphemeris` — a built-in closed-form ephemeris: truncated
+  VSOP87D series for the Earth (~1 arcsec ~ 700 km ~ 2 ms of Roemer delay;
+  1 arcsec at 1 AU is 499 s x 4.85e-6 rad), Standish mean Keplerian
+  elements for the planets, truncated lunar theory for the Moon,
+  mass-weighted Sun-SSB offset.  Sufficient for internally consistent
+  simulation/fit cycles and order-ms absolute work.  Microsecond-level
+  absolute timing of real data fundamentally requires a numerical JPL
+  kernel on disk (the reference downloads one at runtime for the same
+  reason); golden-parity tests are gated on kernel availability.
 
 All outputs are barycentric ICRS/J2000-equatorial, km and km/s, matching the
 units of the reference's TOA table columns (``toa.py:2323``).
@@ -126,6 +130,161 @@ _MOON_DIST = [
     (104.755, 0, 1, 1, 0), (10.321, 2, 0, 0, -2),
 ]
 
+# ---------------------------------------------------------------------------
+# Truncated VSOP87D Earth series (heliocentric, mean ecliptic+equinox of
+# date).  Terms A*cos(B + C*tau), tau = Julian millennia TDB from J2000.0;
+# A in 1e-8 rad (L, B) / 1e-8 AU (R).  This is the standard ~"1 arcsecond"
+# abridgement of VSOP87 (Bretagnon & Francou 1988); it replaces the mean
+# Keplerian EMB orbit (error up to ~1e-4 rad, tens of ms of Roemer delay)
+# with a ~5e-6 rad / ~2e-6 AU model (~2 ms worst-case Roemer error).
+_VSOP_EARTH_L = [
+    # L0
+    [(175347046.0, 0.0, 0.0),
+     (3341656.0, 4.6692568, 6283.0758500),
+     (34894.0, 4.6261024, 12566.1517000),
+     (3497.0, 2.7441, 5753.3849), (3418.0, 2.8289, 3.5231),
+     (3136.0, 3.6277, 77713.7715), (2676.0, 4.4181, 7860.4194),
+     (2343.0, 6.1352, 3930.2097), (1324.0, 0.7425, 11506.7698),
+     (1273.0, 2.0371, 529.6910), (1199.0, 1.1096, 1577.3435),
+     (990.0, 5.233, 5884.927), (902.0, 2.045, 26.298),
+     (857.0, 3.508, 398.149), (780.0, 1.179, 5223.694),
+     (753.0, 2.533, 5507.553), (505.0, 4.583, 18849.228),
+     (492.0, 4.205, 775.523), (357.0, 2.920, 0.067),
+     (317.0, 5.849, 11790.629), (284.0, 1.899, 796.298),
+     (271.0, 0.315, 10977.079), (243.0, 0.345, 5486.778),
+     (206.0, 4.806, 2544.314), (205.0, 1.869, 5573.143),
+     (202.0, 2.458, 6069.777), (156.0, 0.833, 213.299),
+     (132.0, 3.411, 2942.463), (126.0, 1.083, 20.775),
+     (115.0, 0.645, 0.980), (103.0, 0.636, 4694.003),
+     (102.0, 0.976, 15720.839), (102.0, 4.267, 7.114),
+     (99.0, 6.21, 2146.17), (98.0, 0.68, 155.42),
+     (86.0, 5.98, 161000.69), (85.0, 1.30, 6275.96),
+     (85.0, 3.67, 71430.70), (80.0, 1.81, 17260.15),
+     (79.0, 3.04, 12036.46), (75.0, 1.76, 5088.63),
+     (74.0, 3.50, 3154.69), (74.0, 4.68, 801.82),
+     (70.0, 0.83, 9437.76), (62.0, 3.98, 8827.39),
+     (61.0, 1.82, 7084.90), (57.0, 2.78, 6286.60),
+     (56.0, 4.39, 14143.50), (56.0, 3.47, 6279.55),
+     (52.0, 0.19, 12139.55), (52.0, 1.33, 1748.02),
+     (51.0, 0.28, 5856.48), (49.0, 0.49, 1194.45),
+     (41.0, 5.37, 8429.24), (41.0, 2.40, 19651.05),
+     (39.0, 6.17, 10447.39), (37.0, 6.04, 10213.29),
+     (37.0, 2.57, 1059.38), (36.0, 1.71, 2352.87),
+     (36.0, 1.78, 6812.77), (33.0, 0.59, 17789.85),
+     (30.0, 0.44, 83996.85), (30.0, 2.74, 1349.87),
+     (25.0, 3.16, 4690.48)],
+    # L1
+    [(628331966747.0, 0.0, 0.0),
+     (206059.0, 2.678235, 6283.0758500),
+     (4303.0, 2.6351, 12566.1517), (425.0, 1.590, 3.523),
+     (119.0, 5.796, 26.298), (109.0, 2.966, 1577.344),
+     (93.0, 2.59, 18849.23), (72.0, 1.14, 529.69),
+     (68.0, 1.87, 398.15), (67.0, 4.41, 5507.55),
+     (59.0, 2.89, 5223.69), (56.0, 2.17, 155.42),
+     (45.0, 0.40, 796.30), (36.0, 0.47, 775.52),
+     (29.0, 2.65, 7.11), (21.0, 5.34, 0.98),
+     (19.0, 1.85, 5486.78), (19.0, 4.97, 213.30),
+     (17.0, 2.99, 6275.96), (16.0, 0.03, 2544.31),
+     (16.0, 1.43, 2146.17), (15.0, 1.21, 10977.08),
+     (12.0, 2.83, 1748.02), (12.0, 3.26, 5088.63),
+     (12.0, 5.27, 1194.45), (12.0, 2.08, 4694.00),
+     (11.0, 0.77, 553.57), (10.0, 1.30, 6286.60),
+     (10.0, 4.24, 1349.87), (9.0, 2.70, 242.73),
+     (9.0, 5.64, 951.72), (8.0, 5.30, 2352.87)],
+    # L2
+    [(52919.0, 0.0, 0.0), (8720.0, 1.0721, 6283.0758),
+     (309.0, 0.867, 12566.152), (27.0, 0.05, 3.52),
+     (16.0, 5.19, 26.30), (16.0, 3.68, 155.42),
+     (10.0, 0.76, 18849.23), (9.0, 2.06, 77713.77),
+     (7.0, 0.83, 775.52), (5.0, 4.66, 1577.34),
+     (4.0, 1.03, 7.11), (4.0, 3.44, 5573.14),
+     (3.0, 5.14, 796.30), (3.0, 6.05, 5507.55),
+     (3.0, 1.19, 242.73), (3.0, 6.12, 529.69),
+     (3.0, 0.31, 398.15), (3.0, 2.28, 553.57),
+     (2.0, 4.38, 5223.69), (2.0, 3.75, 0.98)],
+    # L3
+    [(289.0, 5.844, 6283.076), (35.0, 0.0, 0.0),
+     (17.0, 5.49, 12566.15), (3.0, 5.20, 155.42),
+     (1.0, 4.72, 3.52), (1.0, 5.30, 18849.23), (1.0, 5.97, 242.73)],
+    # L4
+    [(114.0, 3.142, 0.0), (8.0, 4.13, 6283.08), (1.0, 3.84, 12566.15)],
+    # L5
+    [(1.0, 3.14, 0.0)],
+]
+
+_VSOP_EARTH_B = [
+    # B0
+    [(280.0, 3.199, 84334.662), (102.0, 5.422, 5507.553),
+     (80.0, 3.88, 5223.69), (44.0, 3.70, 2352.87), (32.0, 4.00, 1577.34)],
+    # B1
+    [(9.0, 3.90, 5507.55), (6.0, 1.73, 5223.69)],
+]
+
+_VSOP_EARTH_R = [
+    # R0
+    [(100013989.0, 0.0, 0.0),
+     (1670700.0, 3.0984635, 6283.0758500),
+     (13956.0, 3.05525, 12566.15170),
+     (3084.0, 5.1985, 77713.7715), (1628.0, 1.1739, 5753.3849),
+     (1576.0, 2.8469, 7860.4194), (925.0, 5.453, 11506.770),
+     (542.0, 4.564, 3930.210), (472.0, 3.661, 5884.927),
+     (346.0, 0.964, 5507.553), (329.0, 5.900, 5223.694),
+     (307.0, 0.299, 5573.143), (243.0, 4.273, 11790.629),
+     (212.0, 5.847, 1577.344), (186.0, 5.022, 10977.079),
+     (175.0, 3.012, 18849.228), (110.0, 5.055, 5486.778),
+     (98.0, 0.89, 6069.78), (86.0, 5.69, 15720.84),
+     (86.0, 1.27, 161000.69), (65.0, 0.27, 17260.15),
+     (63.0, 0.92, 529.69), (57.0, 2.01, 83996.85),
+     (56.0, 5.24, 71430.70), (49.0, 3.25, 2544.31),
+     (47.0, 2.58, 775.52), (45.0, 5.54, 9437.76),
+     (43.0, 6.01, 6275.96), (39.0, 5.36, 4694.00),
+     (38.0, 2.39, 8827.39), (37.0, 0.83, 19651.05),
+     (37.0, 4.90, 12139.55), (36.0, 1.67, 12036.46),
+     (35.0, 1.84, 2942.46), (33.0, 0.24, 7084.90),
+     (32.0, 0.18, 5088.63), (32.0, 1.78, 398.15),
+     (28.0, 1.21, 6286.60), (28.0, 1.90, 6279.55),
+     (26.0, 4.59, 10447.39)],
+    # R1
+    [(103019.0, 1.107490, 6283.075850),
+     (1721.0, 1.0644, 12566.1517), (702.0, 3.142, 0.0),
+     (32.0, 1.02, 18849.23), (31.0, 2.84, 5507.55),
+     (25.0, 1.32, 5223.69), (18.0, 1.42, 1577.34),
+     (10.0, 5.91, 10977.08), (9.0, 1.42, 6275.96),
+     (9.0, 0.27, 5486.78)],
+    # R2
+    [(4359.0, 5.7846, 6283.0758), (124.0, 5.579, 12566.152),
+     (12.0, 3.14, 0.0), (9.0, 3.63, 77713.77),
+     (6.0, 1.87, 5573.14), (3.0, 5.47, 18849.23)],
+    # R3
+    [(145.0, 4.273, 6283.076), (7.0, 3.92, 12566.15)],
+    # R4
+    [(4.0, 2.56, 6283.08)],
+]
+
+
+def _vsop_series(tables, tau):
+    """Sum_k tau^k * sum_i A cos(B + C*tau) for one coordinate [1e-8 units]."""
+    total = np.zeros_like(tau)
+    for k, table in enumerate(tables):
+        arr = np.asarray(table)  # (n, 3)
+        s = np.sum(arr[:, 0] * np.cos(arr[:, 1] + arr[:, 2] * tau[..., None]),
+                   axis=-1)
+        total = total + s * tau**k
+    return total * 1e-8
+
+
+def _rotz_vec(v, a):
+    """Rotate vectors (..., 3) about +z by angle(s) a."""
+    c, s = np.cos(a), np.sin(a)
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    return np.stack([c * x - s * y, s * x + c * y, z], axis=-1)
+
+
+def _roty_vec(v, a):
+    c, s = np.cos(a), np.sin(a)
+    x, y, z = v[..., 0], v[..., 1], v[..., 2]
+    return np.stack([c * x + s * z, y, -s * x + c * z], axis=-1)
+
 
 def _kepler_E(M, e, iters=10):
     """Solve Kepler's equation by Newton iteration (vectorized)."""
@@ -202,6 +361,45 @@ class AnalyticEphemeris(Ephemeris):
         v = (self._moon_geo_ecl(T + dT) - self._moon_geo_ecl(T - dT)) / 1.0  # km/day
         return pos, v
 
+    @staticmethod
+    def _earth_helio_ecl_j2000(T):
+        """Heliocentric J2000-ecliptic position of the Earth [AU] from the
+        truncated VSOP87D series (includes the ~4700 km lunar wobble, so this
+        is the Earth itself, not the EMB).
+
+        The series give (lon, lat, R) in the mean ecliptic/equinox of date;
+        the result is rotated of-date ecliptic -> of-date equatorial
+        (mean obliquity) -> J2000 equatorial (IAU1976 precession) -> J2000
+        ecliptic, all per-epoch.
+        """
+        tau = np.asarray(T, dtype=np.float64) / 10.0  # Julian millennia
+        lon = _vsop_series(_VSOP_EARTH_L, tau)
+        lat = _vsop_series(_VSOP_EARTH_B, tau)
+        R = _vsop_series(_VSOP_EARTH_R, tau)
+        cl, sl = np.cos(lon), np.sin(lon)
+        cb, sb = np.cos(lat), np.sin(lat)
+        v = np.stack([R * cb * cl, R * cb * sl, R * sb], axis=-1)
+        # mean obliquity of date (IAU 1980), arcsec
+        eps = (84381.448 - 46.8150 * T - 0.00059 * T**2 + 0.001813 * T**3) \
+            * np.pi / (180.0 * 3600.0)
+        v = _rot_x(v, eps)  # ecliptic of date -> equatorial of date
+        # IAU1976 precession, mean-of-date -> J2000: in passive notation
+        # R3(zeta) R2(-theta) R3(z); _rot*_vec are ACTIVE rotations, i.e.
+        # R3(a) == _rotz_vec(., -a), R2(a) == _roty_vec(., -a)
+        asec = np.pi / (180.0 * 3600.0)
+        zeta = (2306.2181 * T + 0.30188 * T**2 + 0.017998 * T**3) * asec
+        z = (2306.2181 * T + 1.09468 * T**2 + 0.018203 * T**3) * asec
+        theta = (2004.3109 * T - 0.42665 * T**2 - 0.041833 * T**3) * asec
+        v = _rotz_vec(_roty_vec(_rotz_vec(v, -z), theta), -zeta)
+        return _rot_x(v, -_EPS_J2000)  # equatorial J2000 -> ecliptic J2000
+
+    def _earth_helio_posvel(self, T):
+        """Heliocentric J2000-ecliptic posvel of the Earth [AU, AU/day]."""
+        pos = self._earth_helio_ecl_j2000(T)
+        dT = 0.5 / 36525.0
+        vel = self._earth_helio_ecl_j2000(T + dT) - self._earth_helio_ecl_j2000(T - dT)
+        return pos, vel
+
     def posvel_ssb(self, body: str, tdb_mjd) -> Tuple[np.ndarray, np.ndarray]:
         body = body.lower()
         tdb_mjd = np.atleast_1d(np.asarray(tdb_mjd, dtype=np.float64))
@@ -216,19 +414,20 @@ class AnalyticEphemeris(Ephemeris):
 
         if body == "sun":
             pos_au, vel_aud = sun_pos, sun_vel
-        elif body in ("emb",) or body in _ELEMENTS:
-            pos_au = sun_pos + helio[body if body in _ELEMENTS else "emb"][0]
-            vel_aud = sun_vel + helio[body if body in _ELEMENTS else "emb"][1]
-        elif body in ("earth", "moon"):
-            emb_pos = sun_pos + helio["emb"][0]
-            emb_vel = sun_vel + helio["emb"][1]
-            mpos_km, mvel_kmd = self._moon_geo_ecl_posvel(T)
-            if body == "earth":
-                pos_au = emb_pos - _MOON_FRAC * mpos_km / AU_KM
-                vel_aud = emb_vel - _MOON_FRAC * mvel_kmd / AU_KM
-            else:
-                pos_au = emb_pos + (1.0 - _MOON_FRAC) * mpos_km / AU_KM
-                vel_aud = emb_vel + (1.0 - _MOON_FRAC) * mvel_kmd / AU_KM
+        elif body in ("earth", "moon", "emb"):
+            # VSOP87-truncated Earth (~arcsec, ~2 ms Roemer accuracy);
+            # moon/EMB are derived from it via the geocentric lunar theory
+            epos, evel = self._earth_helio_posvel(T)
+            pos_au = sun_pos + epos
+            vel_aud = sun_vel + evel
+            if body != "earth":
+                mpos_km, mvel_kmd = self._moon_geo_ecl_posvel(T)
+                frac = 1.0 if body == "moon" else _MOON_FRAC
+                pos_au = pos_au + frac * mpos_km / AU_KM
+                vel_aud = vel_aud + frac * mvel_kmd / AU_KM
+        elif body in _ELEMENTS:
+            pos_au = sun_pos + helio[body][0]
+            vel_aud = sun_vel + helio[body][1]
         else:
             raise KeyError(f"Unknown body for analytic ephemeris: {body}")
         # ecliptic J2000 -> equatorial ICRS, AU -> km, AU/day -> km/s

@@ -15,7 +15,7 @@ import numpy as np
 from pint_tpu import DMconst
 from pint_tpu.exceptions import MissingParameter
 from pint_tpu.models.parameter import MJDParameter, floatParameter, prefixParameter
-from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.models.timing_model import DelayComponent, check_contiguous_indices
 
 __all__ = ["ChromaticCM", "ChromaticCMX"]
 
@@ -24,9 +24,6 @@ _DAY_PER_YEAR = 365.25
 
 class Chromatic(DelayComponent):
     category = "chromatic_constant"
-
-    def _bary_freq(self, pv, batch):
-        return self.barycentric_freq(pv, batch)
 
     def chromatic_time_delay(self, cm, alpha, freq):
         return cm * DMconst * jnp.power(freq, -alpha)
@@ -54,10 +51,7 @@ class ChromaticCM(Chromatic):
     def setup(self):
         idxs = [0] + sorted(int(n[2:]) for n in self.params
                             if n.startswith("CM") and n[2:].isdigit() and n != "CM")
-        if idxs != list(range(len(idxs))):
-            missing = min(set(range(max(idxs) + 1)) - set(idxs))
-            raise MissingParameter("ChromaticCM", f"CM{missing}",
-                                   "CM Taylor terms must be contiguous")
+        check_contiguous_indices(idxs, "ChromaticCM", "CM")
         self.num_cm_terms = len(idxs)
 
     def validate(self):
@@ -88,7 +82,7 @@ class ChromaticCM(Chromatic):
         return acc
 
     def delay_func(self, pv, batch, ctx, acc_delay):
-        freq = self._bary_freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return self.chromatic_time_delay(self.base_cm(pv, batch),
                                          pv.get("TNCHROMIDX", 4.0), freq)
 
@@ -134,5 +128,5 @@ class ChromaticCMX(Chromatic):
             return jnp.zeros(batch.ntoas)
         vals = jnp.stack([pv.get(f"CMX_{i:04d}", 0.0) for i in self.cmx_indices])
         cm = jnp.sum(vals[:, None] * ctx["masks"], axis=0)
-        freq = self._bary_freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return self.chromatic_time_delay(cm, pv.get("TNCHROMIDX", 4.0), freq)

@@ -16,7 +16,7 @@ import numpy as np
 from pint_tpu import DMconst
 from pint_tpu.exceptions import MissingParameter
 from pint_tpu.models.parameter import MJDParameter, maskParameter, prefixParameter
-from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.models.timing_model import DelayComponent, check_contiguous_indices
 
 __all__ = ["Dispersion", "DispersionDM", "DispersionDMX", "DispersionJump",
            "FDJumpDM"]
@@ -29,9 +29,6 @@ class Dispersion(DelayComponent):
 
     def dispersion_time_delay(self, dm, freq):
         return dm * DMconst / freq**2
-
-    def _freq(self, pv, batch):
-        return self.barycentric_freq(pv, batch)
 
 
 class DispersionDM(Dispersion):
@@ -58,10 +55,7 @@ class DispersionDM(Dispersion):
             int(name[2:]) for name in self.params
             if name.startswith("DM") and name[2:].isdigit() and name != "DM"
         )
-        if idxs != list(range(len(idxs))):
-            missing = min(set(range(max(idxs) + 1)) - set(idxs))
-            raise MissingParameter("DispersionDM", f"DM{missing}",
-                                   "DM Taylor terms must be contiguous")
+        check_contiguous_indices(idxs, "DispersionDM", "DM")
         self.num_dm_terms = len(idxs)
 
     def validate(self):
@@ -102,7 +96,7 @@ class DispersionDM(Dispersion):
         return self.base_dm(pv, batch)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
-        freq = self._freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return self.dispersion_time_delay(self.base_dm(pv, batch), freq)
 
 
@@ -153,7 +147,7 @@ class DispersionDMX(Dispersion):
         return self.dmx_dm(pv, batch, ctx)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
-        freq = self._freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return self.dispersion_time_delay(self.dmx_dm(pv, batch, ctx), freq)
 
 
@@ -240,5 +234,5 @@ class FDJumpDM(Dispersion):
         return self.fdjump_dm(pv, batch, ctx)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
-        freq = self._freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return self.dispersion_time_delay(self.fdjump_dm(pv, batch, ctx), freq)

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from pint_tpu.exceptions import MissingParameter
 from pint_tpu.models.parameter import prefixParameter
-from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.models.timing_model import DelayComponent, check_contiguous_indices
 
 __all__ = ["FD"]
 
@@ -29,9 +29,8 @@ class FD(DelayComponent):
         terms = sorted(int(p[2:]) for p in self.params
                        if p.startswith("FD") and p[2:].isdigit())
         self.num_FD_terms = len(terms)
-        if terms and terms != list(range(1, max(terms) + 1)):
-            missing = min(set(range(1, max(terms) + 1)) - set(terms))
-            raise MissingParameter("FD", f"FD{missing}")
+        if terms:
+            check_contiguous_indices(terms, "FD", "FD", start=1)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         freq = self.barycentric_freq(pv, batch)

@@ -32,7 +32,7 @@ from pint_tpu.models.parameter import (
     floatParameter,
     prefixParameter,
 )
-from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.models.timing_model import DelayComponent, check_contiguous_indices
 
 __all__ = ["SolarWindDispersion", "SolarWindDispersionX"]
 
@@ -86,9 +86,6 @@ class _SolarWindBase(DelayComponent):
         r = jnp.linalg.norm(batch.obs_sun_pos, axis=1)
         return theta, r
 
-    def _freq(self, pv, batch):
-        return self.barycentric_freq(pv, batch)
-
     def _theta0(self):
         """Minimum elongation (conjunction), from the pulsar's ecliptic
         latitude assuming a circular Earth orbit (reference
@@ -129,10 +126,7 @@ class SolarWindDispersion(_SolarWindBase):
     def setup(self):
         idxs = [0] + sorted(int(n[5:]) for n in self.params
                             if n.startswith("NE_SW") and n[5:].isdigit() and n != "NE_SW")
-        if idxs != list(range(len(idxs))):
-            missing = min(set(range(max(idxs) + 1)) - set(idxs))
-            raise MissingParameter("SolarWindDispersion", f"NE_SW{missing}",
-                                   "NE_SW Taylor terms must be contiguous")
+        check_contiguous_indices(idxs, "SolarWindDispersion", "NE_SW")
         self.num_ne_sw_terms = len(idxs)
 
     def validate(self):
@@ -173,7 +167,7 @@ class SolarWindDispersion(_SolarWindBase):
         return self.solar_wind_dm(pv, batch)
 
     def delay_func(self, pv, batch, ctx, acc_delay):
-        freq = self._freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return self.solar_wind_dm(pv, batch) * DMconst / freq**2
 
 
@@ -241,5 +235,5 @@ class SolarWindDispersionX(_SolarWindBase):
     def delay_func(self, pv, batch, ctx, acc_delay):
         if ctx.get("masks") is None:
             return jnp.zeros(batch.ntoas)
-        freq = self._freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return self.swx_dm(pv, batch, ctx) * DMconst / freq**2

@@ -77,6 +77,20 @@ DEFAULT_ORDER = [
 DAY_S = 86400.0
 
 
+def check_contiguous_indices(idxs, component: str, prefix: str, start: int = 0):
+    """Raise MissingParameter unless *idxs* is exactly [start, start+1, ...]
+    — gaps (or duplicates) in a Taylor/prefix family silently renumber which
+    coefficients are used, so they must be an error."""
+    from pint_tpu.exceptions import MissingParameter as _MP
+
+    expected = list(range(start, start + len(idxs)))
+    if sorted(idxs) != expected:
+        missing = sorted(set(range(start, max(idxs) + 1)) - set(idxs))
+        bad = missing[0] if missing else max(idxs)
+        raise _MP(component, f"{prefix}{bad}",
+                  f"{prefix} terms must be contiguous from {prefix}{start}")
+
+
 class Component:
     """Base class: a set of parameters + delay/phase/noise contributions."""
 

@@ -64,9 +64,6 @@ class _WaveXBase(DelayComponent):
                       + pv.get(f"{cpre}{i:04d}", 0.0) * jnp.cos(arg)
         return out
 
-    def _bary_freq(self, pv, batch):
-        return self.barycentric_freq(pv, batch)
-
 
 class WaveX(_WaveXBase):
     """Achromatic Fourier delay (reference ``wavex.py:14``)."""
@@ -116,7 +113,7 @@ class DMWaveX(_WaveXBase):
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         dm = self.series(pv, batch, acc_delay)
-        freq = self._bary_freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         return dm * DMconst / freq**2
 
 
@@ -142,6 +139,6 @@ class CMWaveX(_WaveXBase):
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         cm = self.series(pv, batch, acc_delay)
-        freq = self._bary_freq(pv, batch)
+        freq = self.barycentric_freq(pv, batch)
         alpha = pv.get("TNCHROMIDX", 4.0)
         return cm * DMconst * jnp.power(freq, -alpha)

@@ -25,7 +25,7 @@ from pint_tpu.fitter import DownhillFitter, Fitter
 from pint_tpu.gls_fitter import _solve_cholesky, _solve_svd, gls_normal_equations
 from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
-from pint_tpu.utils import normalize_designmatrix, weighted_mean, woodbury_dot
+from pint_tpu.utils import normalize_designmatrix, weighted_mean
 
 __all__ = [
     "WidebandDMResiduals",
@@ -177,18 +177,13 @@ class WidebandTOAResiduals(CombinedResiduals):
         return self._chi2
 
     def calc_chi2(self) -> float:
-        """Joint chi2 of the stacked system.  With correlated noise the TOA
-        block uses the Woodbury identity over the noise basis (DM rows have
-        no basis support), which is exactly the GLS chi2 the reference gets
-        by running a frozen one-step WidebandTOAFitter
+        """Joint chi2 of the stacked system.  The noise basis spans only the
+        TOA rows, so the joint chi2 separates exactly into the TOA chi2
+        (which already dispatches WLS/ECORR/Woodbury and guards zero sigma,
+        ``residuals.py``) plus the diagonal DM chi2 — matching the GLS chi2
+        the reference gets by running a frozen one-step WidebandTOAFitter
         (``residuals.py:1240``)."""
-        if not self.model.has_correlated_errors:
-            return self.toa.calc_chi2() + self.dm.calc_chi2()
-        r = self.toa.time_resids
-        sigma = self.toa.get_data_error()
-        U, w = self.model.noise_model_basis_weight(self.toas)
-        dot, _ = woodbury_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
-        return float(dot) + self.dm.calc_chi2()
+        return self.toa.calc_chi2() + self.dm.calc_chi2()
 
     @property
     def dof(self) -> int:
@@ -281,16 +276,10 @@ class WidebandTOAFitter(Fitter):
                 xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
         else:
             xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
-        newres = r - M @ xhat
-        if full_cov:
-            chi2_lin = float(newres @ np.linalg.solve(cov, newres))
-        else:
-            cinv = 1.0 / sigma_all**2
-            chi2_lin = float(newres @ (cinv * newres) + xhat @ (phiinv * xhat))
         dpars = xhat / norm
         errs = np.sqrt(np.diag(xvar)) / norm
         covmat = (xvar / norm).T / norm
-        return dpars, errs, covmat, params, chi2_lin
+        return dpars, errs, covmat, params
 
     def _apply_step(self, dpars, errs, covmat, params):
         for i, p in enumerate(params):
@@ -315,9 +304,8 @@ class WidebandTOAFitter(Fitter):
         self.model.validate()
         self.model.validate_toas(self.toas)
         self.update_resids()
-        chi2 = np.inf
         for _ in range(max(1, maxiter)):
-            dpars, errs, covmat, params, chi2 = self._wideband_step(
+            dpars, errs, covmat, params = self._wideband_step(
                 threshold=threshold, full_cov=full_cov)
             self._apply_step(dpars, errs, covmat, params)
             self.update_resids()
@@ -347,7 +335,7 @@ class WidebandDownhillFitter(DownhillFitter):
         return WidebandTOAFitter.update_resids(self)
 
     def _solve_step(self):
-        dpars, errs, covmat, params, _ = WidebandTOAFitter._wideband_step(
+        dpars, errs, covmat, params = WidebandTOAFitter._wideband_step(
             self, threshold=self.threshold, full_cov=self.full_cov)
         ntm = len(params)
         return dpars[:ntm], params, covmat[:ntm, :ntm]
@@ -358,7 +346,7 @@ class WidebandDownhillFitter(DownhillFitter):
         self.threshold = threshold
         chi2 = super().fit_toas(maxiter=maxiter, **kw)
         if not full_cov:
-            dpars, _, _, params, _ = WidebandTOAFitter._wideband_step(
+            dpars, _, _, params = WidebandTOAFitter._wideband_step(
                 self, threshold=threshold, full_cov=False)
             WidebandTOAFitter._store_noise_ampls(self, dpars, len(params))
         return chi2

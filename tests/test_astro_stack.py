@@ -165,3 +165,67 @@ class TestObservatories:
         cf.evaluate([52000.0], limits="warn")
         with pytest.raises(Exception):
             cf.evaluate([52000.0], limits="error")
+
+
+class TestVSOPEarth:
+    """The truncated-VSOP87 Earth series, validated against independent
+    astronomical facts (equinox/perihelion almanac times)."""
+
+    def setup_method(self):
+        self.eph = AnalyticEphemeris()
+
+    def test_equinox_2020_of_date_longitude(self):
+        # 2020 Mar 20 03:50 UTC: apparent solar lon (of date) == 0.
+        # geometric-of-date lon = aberration (+20.5") - nutation dpsi (~ -17")
+        # => expect ~ +38" +/- a few arcsec of series truncation
+        from pint_tpu.ephemeris import _VSOP_EARTH_L, _vsop_series
+
+        mjd_tdb = 58928.0 + (3 * 3600 + 50 * 60 + 69.2) / 86400.0
+        tau = np.atleast_1d((mjd_tdb - 51544.5) / 365250.0)
+        lon_sun = (_vsop_series(_VSOP_EARTH_L, tau)[0] + np.pi) % (2 * np.pi)
+        arcsec = np.degrees(lon_sun) * 3600
+        assert 25 < arcsec < 50
+
+    def test_perihelion_2020_distance(self):
+        from pint_tpu.ephemeris import _VSOP_EARTH_R, _vsop_series
+
+        mjd = 58853.0 + (7 * 3600 + 48 * 60) / 86400.0  # 2020 Jan 5 07:48 UTC
+        tau = np.atleast_1d((mjd - 51544.5) / 365250.0)
+        R = _vsop_series(_VSOP_EARTH_R, tau)[0]
+        assert R == pytest.approx(0.9832436, abs=5e-6)
+
+    def test_earth_vs_emb_lunar_wobble(self):
+        # earth and emb differ by the ~4670 km barycenter offset
+        mjd = np.arange(54000.0, 54060.0, 1.0)
+        e, _ = self.eph.posvel_ssb("earth", mjd)
+        emb, _ = self.eph.posvel_ssb("emb", mjd)
+        d = np.linalg.norm(e - emb, axis=-1)
+        assert 4000 < d.mean() < 5300
+
+    def test_precession_consistent_with_earth_module(self):
+        # the inline date->J2000 rotation must match earth.py's matrix
+        from pint_tpu.earth import _precession_matrix
+        from pint_tpu.ephemeris import _roty_vec, _rotz_vec
+
+        T = 0.21
+        asec = np.pi / (180.0 * 3600.0)
+        zeta = (2306.2181 * T + 0.30188 * T**2 + 0.017998 * T**3) * asec
+        z = (2306.2181 * T + 1.09468 * T**2 + 0.018203 * T**3) * asec
+        theta = (2004.3109 * T - 0.42665 * T**2 - 0.041833 * T**3) * asec
+        rng = np.random.default_rng(0)
+        v = rng.standard_normal(3)
+        got = _rotz_vec(_roty_vec(_rotz_vec(v[None, :], -z), theta), -zeta)[0]
+        want = _precession_matrix(T) @ v
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_annual_parallax_geometry_sun_earth(self):
+        # sun-earth vector should equal minus earth heliocentric: check
+        # round-trip closure |earth_ssb - sun_ssb| ~ R series
+        mjd = np.array([55000.0, 55100.0, 55200.0])
+        e, ev = self.eph.posvel_ssb("earth", mjd)
+        s, sv = self.eph.posvel_ssb("sun", mjd)
+        r = np.linalg.norm(e - s, axis=-1) / AU_KM
+        assert np.all((r > 0.97) & (r < 1.02))
+        # radial velocity of earth wrt sun bounded by e*v_orb ~ 0.5 km/s
+        rv = np.sum((e - s) * (ev - sv), axis=-1) / np.linalg.norm(e - s, axis=-1)
+        assert np.all(np.abs(rv) < 0.6)

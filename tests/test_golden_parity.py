@@ -1,0 +1,85 @@
+"""Golden-file parity against tempo2 residual dumps (reference test
+strategy pillar (a), SURVEY §4: ``tests/test_B1855.py:34-46``).
+
+Exact parity (3e-8 s) requires a numerical JPL ephemeris kernel and clock
+files, neither of which ship in this zero-egress image — 1 arcsec of Earth
+position is already 2.4 ms of Roemer delay, so no analytic series can reach
+it.  The exact-parity tests therefore skip unless a ``.bsp`` kernel is found
+on the ephemeris search path; the structural smoke tests (real NANOGrav
+par/tim at scale) always run.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+DATADIR = "/root/reference/tests/datafile"
+B1855_PAR = f"{DATADIR}/B1855+09_NANOGrav_dfg+12_TAI_FB90.par"
+B1855_TIM = f"{DATADIR}/B1855+09_NANOGrav_dfg+12.tim"
+
+
+def _kernel_available() -> bool:
+    from pint_tpu.ephemeris import _search_paths
+
+    return any(glob.glob(os.path.join(d, "*.bsp")) for d in _search_paths()
+               if os.path.isdir(d))
+
+
+needs_kernel = pytest.mark.skipif(
+    not _kernel_available(),
+    reason="no JPL .bsp kernel on the ephemeris search path; analytic "
+    "fallback is ~2 ms (1 arcsec at 1 AU), far above the 3e-8 s golden bar")
+
+
+@pytest.fixture(scope="module")
+def b1855():
+    from pint_tpu.models import get_model_and_toas
+
+    if not os.path.exists(B1855_TIM):
+        pytest.skip("reference datafiles unavailable")
+    return get_model_and_toas(B1855_PAR, B1855_TIM)
+
+
+class TestRealDataSmoke:
+    """Full pipeline on real NANOGrav data (no kernel needed): parse,
+    evaluate, design matrix — structure and finiteness, not absolute ns."""
+
+    def test_load_and_residuals(self, b1855):
+        from pint_tpu.residuals import Residuals
+
+        model, toas = b1855
+        assert len(toas) > 600  # dfg+12 dataset: 702 TOAs
+        r = Residuals(toas, model)
+        res = r.time_resids
+        assert np.all(np.isfinite(res))
+        # bounded by the pulse period (phase wraps to +/- P/2, then mean
+        # subtraction can shift the window by up to P/2 again)
+        P = 1.0 / float(model.F0.value)
+        assert np.max(np.abs(res)) <= P
+
+    def test_designmatrix_scales(self, b1855):
+        model, toas = b1855
+        M, names, units = model.designmatrix(toas)
+        assert M.shape[0] == len(toas)
+        assert M.shape[1] == len(names)
+        assert np.all(np.isfinite(M))
+
+    def test_binary_component_present(self, b1855):
+        model, _ = b1855
+        assert model.BINARY.value is not None
+
+
+class TestGoldenParity:
+    @needs_kernel
+    def test_b1855_tempo2_residuals(self, b1855):
+        """Reference asserts |pint - tempo2| < 3e-8 s
+        (``tests/test_B1855.py:43-46``)."""
+        from pint_tpu.residuals import Residuals
+
+        model, toas = b1855
+        ltres = np.genfromtxt(f"{B1855_PAR}.tempo2_test", skip_header=1,
+                              unpack=True)
+        res = Residuals(toas, model, use_weighted_mean=False).time_resids
+        assert np.all(np.abs(res - ltres) < 3e-8)
