@@ -29,7 +29,8 @@ from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
 from pint_tpu.utils import normalize_designmatrix
 
-__all__ = ["Fitter", "WLSFitter", "DownhillFitter", "DownhillWLSFitter"]
+__all__ = ["Fitter", "WLSFitter", "DownhillFitter", "DownhillWLSFitter",
+           "LMFitter", "PowellFitter"]
 
 
 class Fitter:
@@ -271,3 +272,130 @@ class DownhillWLSFitter(DownhillFitter):
             raise CorrelatedErrors(model)
         super().__init__(toas, model, **kw)
         self.method = "downhill_wls"
+
+
+class LMFitter(Fitter):
+    """Levenberg-Marquardt fitter (reference ``fitter.py:2426``): damped
+    normal equations A = M^T C^-1 M + phiinv + lambda*diag(M^T C^-1 M),
+    with the reference's lambda schedule (decrease on success, increase x3
+    on a chi2 increase, x10 when ill-conditioned)."""
+
+    def __init__(self, toas, model, **kw):
+        super().__init__(toas, model, **kw)
+        self.method = "levenberg_marquardt"
+
+    #: WidebandLMFitter flips this to stack the DM rows
+    wideband_system = False
+
+    def _residual_vector(self) -> np.ndarray:
+        return np.asarray(self.resids.time_resids)
+
+    def _normal_system(self):
+        """(mtcm_plain, phiinv, mtcy, norm, params) at the current model."""
+        from pint_tpu.gls_fitter import build_augmented_system
+
+        r = self._residual_vector()
+        M, params, norm, phiinv, Nvec, dims = build_augmented_system(
+            self.model, self.toas, wideband=self.wideband_system)
+        self._noise_dims = dims
+        cinv = 1.0 / Nvec
+        mtcm_plain = M.T @ (cinv[:, None] * M)
+        mtcy = M.T @ (cinv * r)
+        return mtcm_plain, phiinv, mtcy, norm, params
+
+    def _current_chi2(self) -> float:
+        return self.resids.calc_chi2()
+
+    def fit_toas(self, maxiter: int = 50, min_chi2_decrease: float = 1e-3,
+                 lambda_factor_decrease: float = 2.0,
+                 lambda_factor_increase: float = 3.0,
+                 min_lambda: float = 0.5, threshold: float = 1e-14,
+                 debug: bool = False) -> float:
+        from pint_tpu.gls_fitter import _solve_svd
+
+        self.update_resids()
+        chi2 = self._current_chi2()
+        lam = min_lambda
+        self.converged = False
+        for it in range(maxiter):
+            mtcm_plain, phiinv, mtcy, norm, params = self._normal_system()
+            mtcm = mtcm_plain + np.diag(phiinv)
+            lf = lam if lam > min_lambda else 0.0
+            A = mtcm + lf * np.diag(np.diag(mtcm_plain))
+            xvar, xhat = _solve_svd(A, mtcy, threshold, params)
+            step = xhat / norm
+            base = {p: float(getattr(self.model, p).value or 0.0)
+                    for p in params if p != "Offset"}
+            for dp, p in zip(step[:len(params)], params):
+                if p != "Offset":
+                    getattr(self.model, p).value = base[p] + float(dp)
+            self.update_resids()
+            new_chi2 = self._current_chi2()
+            decrease = chi2 - new_chi2
+            if not np.isfinite(new_chi2) or decrease < -min_chi2_decrease:
+                # reject: restore and raise damping
+                for p, v in base.items():
+                    getattr(self.model, p).value = v
+                self.update_resids()
+                lam *= lambda_factor_increase
+                if lam > 1e9:
+                    raise ConvergenceFailure("LM damping diverged")
+                continue
+            # accept
+            chi2 = new_chi2
+            if 0 <= decrease < min_chi2_decrease:
+                self.converged = True
+                break
+            lam = max(lam / lambda_factor_decrease, min_lambda)
+        else:
+            log.warning(f"LM fit hit maxiter={maxiter}")
+        # uncertainties/covariance from the UNDAMPED curvature at the final
+        # parameters — inv(mtcm + lambda*diag) would be biased low by the
+        # damping state at exit
+        mtcm_plain, phiinv, mtcy, norm, params = self._normal_system()
+        xvar, _ = _solve_svd(mtcm_plain + np.diag(phiinv), mtcy, threshold,
+                             params)
+        errs = np.sqrt(np.diag(xvar)) / norm
+        covmat = (xvar / norm).T / norm
+        ntm = len(params)
+        self.parameter_covariance_matrix = covmat[:ntm, :ntm]
+        self.fitted_params = params
+        for i, p in enumerate(params):
+            if p != "Offset":
+                self.errors[p] = float(errs[i])
+                getattr(self.model, p).uncertainty = float(errs[i])
+        self.model.CHI2.value = chi2
+        return chi2
+
+
+class PowellFitter(Fitter):
+    """Derivative-free scipy Powell minimization over the free parameters
+    (reference ``fitter.py:1777``; legacy/backstop fitter)."""
+
+    def __init__(self, toas, model, **kw):
+        super().__init__(toas, model, **kw)
+        self.method = "Powell"
+
+    def fit_toas(self, maxiter: int = 20, **kw) -> float:
+        from scipy.optimize import minimize
+
+        params = list(self.model.free_params)
+        x0 = np.array([float(getattr(self.model, p).value or 0.0)
+                       for p in params])
+        # scale: parameter uncertainties when available, else 1e-8 relative
+        scale = np.array([
+            float(getattr(self.model, p).uncertainty or 0.0) or
+            (abs(x) * 1e-8 if x else 1e-10) for p, x in zip(params, x0)])
+
+        def fun(z):
+            return self.minimize_func(list(x0 + z * scale), params)
+
+        res = minimize(fun, np.zeros(len(params)), method="Powell",
+                       options={"maxiter": maxiter, "xtol": 1e-10,
+                                "ftol": 1e-10})
+        self.minimize_func(list(x0 + res.x * scale), params)
+        self.fitted_params = params
+        self.converged = bool(res.success)
+        chi2 = self.resids.chi2
+        self.model.CHI2.value = chi2
+        return chi2

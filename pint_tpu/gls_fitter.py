@@ -63,6 +63,40 @@ def _solve_svd(mtcm: np.ndarray, mtcy: np.ndarray, threshold: float,
     return xvar, xhat
 
 
+def build_augmented_system(model, toas, wideband: bool = False):
+    """Shared Woodbury-form system builder for every GLS-family fitter:
+    normalized ``[M_timing | noise basis]`` (wideband: timing rows are the
+    stacked [toa; dm] blocks, noise basis padded with zero DM rows), plus
+    (params, norm, phiinv, Nvec, noise_dims).  Single source of truth for
+    the 1e40 timing-prior weighting and basis padding."""
+    M_tm, params, units = model.designmatrix(toas)
+    if wideband:
+        M_dm, _, _ = model.dm_designmatrix(toas)
+        M_q = np.vstack([M_tm, M_dm])
+    else:
+        M_q = M_tm
+    n_rows, n_toa = M_q.shape[0], M_tm.shape[0]
+    Us, ws, dims = model.noise_basis_by_component(toas)
+    if Us:
+        U = np.hstack(Us)
+        if n_rows > n_toa:
+            U = np.vstack([U, np.zeros((n_rows - n_toa, U.shape[1]))])
+        M = np.hstack([M_q, U])
+        weights = np.concatenate([np.full(len(params), 1e40)] + ws)
+    else:
+        M = M_q
+        weights = np.full(len(params), 1e40)
+    M, norm = normalize_designmatrix(M, params)
+    M, norm = np.asarray(M), np.asarray(norm)
+    phiinv = 1.0 / weights / norm**2
+    if wideband:
+        Nvec = np.concatenate([model.scaled_toa_uncertainty(toas),
+                               model.scaled_dm_uncertainty(toas)]) ** 2
+    else:
+        Nvec = model.scaled_toa_uncertainty(toas) ** 2
+    return M, params, norm, phiinv, Nvec, dims
+
+
 def gls_normal_equations(M: np.ndarray, r: np.ndarray,
                          Nvec: Optional[np.ndarray] = None,
                          phiinv: Optional[np.ndarray] = None,
@@ -96,24 +130,17 @@ class GLSFitter(Fitter):
         layout for noise-amplitude extraction.
         """
         r = np.asarray(self.resids.time_resids)
-        M_tm, params, units = self.get_designmatrix()
         self._noise_dims = None
         if full_cov:
+            M_tm, params, units = self.get_designmatrix()
             M, norm = normalize_designmatrix(M_tm, params)
             M, norm = np.asarray(M), np.asarray(norm)
             cov = self.model.toa_covariance_matrix(self.toas)
             mtcm, mtcy = gls_normal_equations(M, r, cov=cov)
         else:
-            Us, ws, dims = self.model.noise_basis_by_component(self.toas)
+            M, params, norm, phiinv, Nvec, dims = build_augmented_system(
+                self.model, self.toas)
             self._noise_dims = dims
-            M = np.hstack([M_tm] + Us) if Us else M_tm
-            weights = np.concatenate(
-                [np.full(M_tm.shape[1], 1e40)] + ws) if ws else \
-                np.full(M_tm.shape[1], 1e40)
-            M, norm = normalize_designmatrix(M, params)
-            M, norm = np.asarray(M), np.asarray(norm)
-            phiinv = 1.0 / weights / norm**2
-            Nvec = self.model.scaled_toa_uncertainty(self.toas) ** 2
             mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         if threshold <= 0:
             try:

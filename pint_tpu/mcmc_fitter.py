@@ -1,0 +1,121 @@
+"""MCMC fitter: posterior sampling of timing-model parameters.
+
+Counterpart of reference ``mcmc_fitter.py:109 MCMCFitter`` (emcee-based
+posterior fit with lnprior + lnlike over residual chi2 or photon templates).
+The sampling engine is :class:`pint_tpu.sampler.EnsembleSampler` by default
+— the walker ensemble is advanced with *batched* lnposterior evaluations
+(jit+vmap via ``BayesianTiming.lnposterior_batch``), the TPU mapping of the
+reference's one-process-per-walker pattern (SURVEY §2c row 2).
+
+``MCMCFitterBinnedTemplate`` / ``MCMCFitterAnalyticTemplate`` (photon-domain
+template likelihoods, reference ``mcmc_fitter.py:441,485``) live in
+:mod:`pint_tpu.event_fitter` with the template machinery.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from pint_tpu.bayesian import BayesianTiming
+from pint_tpu.fitter import Fitter
+from pint_tpu.logging import log
+from pint_tpu.residuals import Residuals
+from pint_tpu.sampler import EnsembleSampler, MCMCSampler
+
+__all__ = ["MCMCFitter"]
+
+
+class MCMCFitter(Fitter):
+    """Posterior sampling fit (reference ``mcmc_fitter.py:109``).
+
+    Parameters mirror the reference: a sampler object (default: jax-native
+    :class:`EnsembleSampler` with 32 walkers), optional prior_info, phase
+    tracking via pulse numbers.  ``fit_toas(maxiter=N)`` runs N ensemble
+    steps and sets the model to the maximum-posterior sample.
+    """
+
+    def __init__(self, toas, model, sampler: Optional[MCMCSampler] = None,
+                 prior_info: Optional[dict] = None,
+                 use_pulse_numbers: bool = False, nwalkers: int = 32,
+                 errfact: float = 0.1, **kw):
+        super().__init__(toas, model, **kw)
+        self.method = "MCMC"
+        self.sampler = sampler or EnsembleSampler(nwalkers)
+        self.errfact = errfact
+        self.bt = BayesianTiming(self.model, toas,
+                                 use_pulse_numbers=use_pulse_numbers,
+                                 prior_info=prior_info)
+        self.fitkeys = self.bt.param_labels
+        self.n_fit_params = len(self.fitkeys)
+        self.maxpost = -np.inf
+        self.maxpost_fitvals = None
+
+    def get_fitvals(self) -> np.ndarray:
+        return np.array([float(getattr(self.model, p).value or 0.0)
+                         for p in self.fitkeys])
+
+    def get_fiterrs(self) -> np.ndarray:
+        return np.array([float(getattr(self.model, p).uncertainty or 0.0)
+                         for p in self.fitkeys])
+
+    def lnposterior(self, theta) -> float:
+        return self.bt.lnposterior(theta)
+
+    def fit_toas(self, maxiter: int = 100, pos=None, seed: Optional[int] = None,
+                 burn_frac: float = 0.25, **kw) -> float:
+        """Run the ensemble for *maxiter* steps; model is set to the
+        maximum-posterior sample and chi2 at that point is returned."""
+        self.sampler.initialize_batched(self.bt.lnposterior_batch,
+                                        self.n_fit_params) \
+            if isinstance(self.sampler, EnsembleSampler) else \
+            self.sampler.initialize_sampler(self.bt.lnposterior,
+                                            self.n_fit_params)
+        if pos is None:
+            pos = self.sampler.get_initial_pos(
+                self.fitkeys, self.get_fitvals(), self.get_fiterrs(),
+                self.errfact, seed=seed)
+            # clip the initial ball inside the prior support
+            lp = self.bt.lnposterior_batch(pos)
+            bad = ~np.isfinite(lp)
+            if bad.any():
+                pos[bad] = self.get_fitvals()
+        self.sampler.run_mcmc(pos, maxiter)
+        chain = self.sampler.get_chain(flat=True,
+                                       discard=int(maxiter * burn_frac))
+        lnp = self.sampler.get_log_prob(flat=True,
+                                        discard=int(maxiter * burn_frac))
+        imax = int(np.argmax(lnp))
+        self.maxpost = float(lnp[imax])
+        self.maxpost_fitvals = chain[imax]
+        stds = chain.std(axis=0)
+        for i, p in enumerate(self.fitkeys):
+            getattr(self.model, p).value = float(self.maxpost_fitvals[i])
+            getattr(self.model, p).uncertainty = float(stds[i])
+            self.errors[p] = float(stds[i])
+        self.fitted_params = list(self.fitkeys)
+        self.update_resids()
+        chi2 = self.resids.chi2
+        self.model.CHI2.value = chi2
+        self.converged = True
+        return chi2
+
+    def get_posterior_samples(self, burn_frac: float = 0.25) -> np.ndarray:
+        n = self.sampler.get_chain().shape[0]
+        return self.sampler.get_chain(flat=True, discard=int(n * burn_frac))
+
+    def get_fit_summary(self, burn_frac: float = 0.25) -> str:
+        samples = self.get_posterior_samples(burn_frac)
+        nsteps = self.sampler.get_chain().shape[0]
+        lines = [f"MCMC fit: {self.sampler.nwalkers} walkers x "
+                 f"{nsteps} steps, acceptance "
+                 f"{self.sampler.acceptance_fraction:.2f}",
+                 f"{'PAR':<12} {'median':>20} {'std':>12} {'maxpost':>20}"]
+        med = np.median(samples, axis=0)
+        std = np.std(samples, axis=0)
+        for i, p in enumerate(self.fitkeys):
+            lines.append(f"{p:<12} {med[i]:>20.12g} {std[i]:>12.3g} "
+                         f"{self.maxpost_fitvals[i]:>20.12g}")
+        return "\n".join(lines)

@@ -101,6 +101,25 @@ class Parameter:
         self.continuous = continuous
         self.value = value
         self._component = None  # set by Component.add_param
+        self._prior = None  # lazily defaults to the unbounded uniform
+
+    @property
+    def prior(self):
+        """Prior distribution for Bayesian inference (reference
+        ``parameter.py`` prior hook); defaults to an improper flat prior."""
+        if self._prior is None:
+            from pint_tpu.models.priors import Prior, UniformUnboundedRV
+
+            self._prior = Prior(UniformUnboundedRV())
+        return self._prior
+
+    @prior.setter
+    def prior(self, p):
+        self._prior = p
+
+    def prior_pdf(self, value=None, logpdf: bool = False):
+        v = self.value if value is None else value
+        return self.prior.logpdf(v) if logpdf else self.prior.pdf(v)
 
     # -- par-file boundary -------------------------------------------------
     def str2value(self, s: str):

@@ -1,0 +1,101 @@
+"""Parameter priors for Bayesian inference.
+
+Counterpart of reference ``models/priors.py:14 Prior`` (a thin wrapper over
+scipy ``rv_continuous``/``rv_frozen``) with the same surface: ``pdf``,
+``logpdf``, ``ppf``, ``rvs``.  Adds jax-evaluable fast paths for the two
+distributions the samplers vectorize over (uniform, normal), so a batched
+lnprior can run inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "Prior",
+    "UniformUnboundedRV",
+    "UniformBoundedRV",
+    "GaussianBoundedRV",
+]
+
+
+class UniformUnboundedRV:
+    """Improper flat prior over the whole real line
+    (reference ``priors.py:119`` region)."""
+
+    kind = "uniform_unbounded"
+
+    def pdf(self, x):
+        return np.ones_like(np.asarray(x, dtype=float))
+
+    def logpdf(self, x):
+        return np.zeros_like(np.asarray(x, dtype=float))
+
+    def ppf(self, q):
+        raise NotImplementedError("Unbounded uniform prior has no ppf")
+
+    def rvs(self, size=None, random_state=None):
+        raise NotImplementedError("Cannot sample an unbounded uniform prior")
+
+
+def UniformBoundedRV(lower_bound: float, upper_bound: float):
+    """Frozen scipy uniform on [lower, upper] (reference parity helper)."""
+    from scipy.stats import uniform
+
+    return uniform(lower_bound, upper_bound - lower_bound)
+
+
+def GaussianBoundedRV(loc: float = 0.0, scale: float = 1.0,
+                      lower_bound: float = -np.inf, upper_bound: float = np.inf):
+    """Frozen scipy truncated normal (reference ``GaussianRV_gen``)."""
+    from scipy.stats import truncnorm
+
+    a = (lower_bound - loc) / scale
+    b = (upper_bound - loc) / scale
+    return truncnorm(a, b, loc=loc, scale=scale)
+
+
+class Prior:
+    """Prior distribution attached to a Parameter (reference ``priors.py:14``).
+
+    Wraps any scipy frozen distribution (or :class:`UniformUnboundedRV`).
+    ``jax_spec`` returns ("uniform", lo, hi) / ("normal", mu, sigma) / None,
+    letting the ensemble sampler evaluate simple priors inside jit.
+    """
+
+    def __init__(self, rv):
+        self._rv = rv
+
+    def pdf(self, value):
+        return self._rv.pdf(value)
+
+    def logpdf(self, value):
+        return self._rv.logpdf(value)
+
+    def ppf(self, q):
+        return self._rv.ppf(q)
+
+    def rvs(self, size=None, random_state=None):
+        return self._rv.rvs(size=size, random_state=random_state)
+
+    @property
+    def is_unbounded(self) -> bool:
+        return isinstance(self._rv, UniformUnboundedRV)
+
+    def jax_spec(self) -> Optional[tuple]:
+        """("uniform", lo, hi) or ("normal", mu, sigma) when the wrapped rv
+        is one of the two vectorizable families, else None."""
+        rv = self._rv
+        name = getattr(getattr(rv, "dist", None), "name", None)
+        if name == "uniform":
+            lo = float(rv.ppf(0.0))
+            hi = float(rv.ppf(1.0))
+            return ("uniform", lo, hi)
+        if name == "norm":
+            return ("normal", float(rv.mean()), float(rv.std()))
+        return None
+
+    def __repr__(self):
+        return f"Prior({self._rv!r})"

@@ -21,7 +21,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from pint_tpu.fitter import DownhillFitter, Fitter
+from pint_tpu.fitter import DownhillFitter, Fitter, LMFitter
 from pint_tpu.gls_fitter import _solve_cholesky, _solve_svd, gls_normal_equations
 from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
@@ -33,6 +33,7 @@ __all__ = [
     "WidebandTOAResiduals",
     "WidebandTOAFitter",
     "WidebandDownhillFitter",
+    "WidebandLMFitter",
 ]
 
 
@@ -233,42 +234,27 @@ class WidebandTOAFitter(Fitter):
     def _wideband_step(self, threshold: float = 0.0, full_cov: bool = False):
         """One linearized solve of the stacked system; returns
         (dpars, errs, covmat, params, chi2_linear)."""
+        from pint_tpu.gls_fitter import build_augmented_system
+
         r = self.resids._combined_resids
-        M_toa, params, units = self.model.designmatrix(self.toas)
-        M_dm, _, _ = self.model.dm_designmatrix(self.toas)
-        M = np.vstack([M_toa, M_dm])
-        n_toa = M_toa.shape[0]
         self._noise_dims = None
-        sigma_all = np.concatenate([
-            self.model.scaled_toa_uncertainty(self.toas),
-            self.model.scaled_dm_uncertainty(self.toas),
-        ])
         if full_cov:
+            M_toa, params, units = self.model.designmatrix(self.toas)
+            M_dm, _, _ = self.model.dm_designmatrix(self.toas)
+            M = np.vstack([M_toa, M_dm])
+            n_toa = M_toa.shape[0]
             M, norm = normalize_designmatrix(M, params)
             M, norm = np.asarray(M), np.asarray(norm)
-            cov_toa = self.model.toa_covariance_matrix(self.toas)
             cov = np.zeros((M.shape[0], M.shape[0]))
-            cov[:n_toa, :n_toa] = cov_toa
-            dm_sig = sigma_all[n_toa:]
+            cov[:n_toa, :n_toa] = self.model.toa_covariance_matrix(self.toas)
+            dm_sig = self.model.scaled_dm_uncertainty(self.toas)
             cov[n_toa:, n_toa:] = np.diag(dm_sig**2)
             mtcm, mtcy = gls_normal_equations(M, r, cov=cov)
-            phiinv = None
         else:
-            Us, ws, dims = self.model.noise_basis_by_component(self.toas)
+            M, params, norm, phiinv, Nvec, dims = build_augmented_system(
+                self.model, self.toas, wideband=True)
             self._noise_dims = dims
-            if Us:
-                # noise bases span the TOA rows only
-                U = np.vstack([np.hstack(Us),
-                               np.zeros((M.shape[0] - n_toa, sum(u.shape[1] for u in Us)))])
-                M = np.hstack([M, U])
-                weights = np.concatenate([np.full(len(params), 1e40)] + ws)
-            else:
-                weights = np.full(len(params), 1e40)
-            M, norm = normalize_designmatrix(M, params)
-            M, norm = np.asarray(M), np.asarray(norm)
-            phiinv = 1.0 / weights / norm**2
-            mtcm, mtcy = gls_normal_equations(M, r, Nvec=sigma_all**2,
-                                              phiinv=phiinv)
+            mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         if threshold <= 0:
             try:
                 xvar, xhat = _solve_cholesky(mtcm, mtcy)
@@ -350,3 +336,24 @@ class WidebandDownhillFitter(DownhillFitter):
                 self, threshold=threshold, full_cov=False)
             WidebandTOAFitter._store_noise_ampls(self, dpars, len(params))
         return chi2
+
+
+class WidebandLMFitter(LMFitter, WidebandTOAFitter):
+    """Levenberg-Marquardt over the stacked TOA+DM system
+    (reference ``fitter.py:2530``)."""
+
+    def __init__(self, toas, model, track_mode=None, additional_args=None):
+        WidebandTOAFitter.__init__(self, toas, model, track_mode=track_mode,
+                                   additional_args=additional_args)
+        self.method = "lm_wideband"
+
+    def update_resids(self):
+        return WidebandTOAFitter.update_resids(self)
+
+    wideband_system = True
+
+    def _current_chi2(self) -> float:
+        return self.resids.calc_chi2()
+
+    def _residual_vector(self) -> np.ndarray:
+        return self.resids._combined_resids

@@ -1,0 +1,165 @@
+"""Bayesian interface + ensemble MCMC tests: prior machinery, vectorized
+lnposterior consistency, posterior recovery on simulated data (reference
+``tests/test_bayesian.py`` strategy)."""
+
+import io
+
+import numpy as np
+import pytest
+
+PAR = """
+PSR  J1234+5678
+RAJ  12:34:00.0
+DECJ 56:10:00.0
+POSEPOCH 55000
+F0   61.485476554 1
+F1   -1.181e-15 1
+PEPOCH 55000
+DM   223.9 1
+EPHEM DE440
+UNITS TDB
+"""
+
+
+def _model():
+    from pint_tpu.models import get_model
+
+    return get_model(io.StringIO(PAR))
+
+
+@pytest.fixture(scope="module")
+def data():
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = _model()
+    t = make_fake_toas_uniform(54000, 55500, 50, m, freq=1400.0, error_us=2.0,
+                               add_noise=True, rng=np.random.default_rng(11))
+    return m, t
+
+
+def _prior_info(m):
+    info = {}
+    for p in ("F0", "F1", "DM"):
+        par = getattr(m, p)
+        v = float(par.value)
+        w = max(abs(v) * 1e-8, 1e-18)
+        info[p] = {"distr": "uniform", "pmin": v - 1e5 * w, "pmax": v + 1e5 * w}
+    return info
+
+
+class TestPriors:
+    def test_default_prior_unbounded(self):
+        m = _model()
+        assert m.F0.prior.is_unbounded
+        assert m.F0.prior_pdf(logpdf=True) == 0.0
+
+    def test_prior_families(self):
+        from pint_tpu.models.priors import (GaussianBoundedRV, Prior,
+                                            UniformBoundedRV)
+
+        p = Prior(UniformBoundedRV(1.0, 3.0))
+        assert p.jax_spec() == ("uniform", 1.0, 3.0)
+        assert p.pdf(2.0) == pytest.approx(0.5)
+        assert p.ppf(0.5) == pytest.approx(2.0)
+        g = Prior(GaussianBoundedRV(0.0, 1.0, -2, 2))
+        assert g.jax_spec() is None  # truncnorm: host path
+        assert g.pdf(0.0) > g.pdf(1.9)
+
+    def test_unbounded_rejected(self, data):
+        from pint_tpu.bayesian import BayesianTiming
+
+        m, t = data
+        with pytest.raises(NotImplementedError):
+            BayesianTiming(m, t)  # no priors set
+
+
+class TestBayesianTiming:
+    def test_vectorized_matches_scalar(self, data):
+        from pint_tpu.bayesian import BayesianTiming
+
+        m, t = data
+        bt = BayesianTiming(m, t, prior_info=_prior_info(m))
+        x0 = np.array([float(getattr(bt.model, p).value)
+                       for p in bt.param_labels])
+        rng = np.random.default_rng(0)
+        pts = x0 + x0 * 1e-11 * rng.standard_normal((8, len(x0)))
+        batch = bt.lnposterior_batch(pts)
+        scalar = np.array([bt.lnposterior(p) for p in pts])
+        np.testing.assert_allclose(batch, scalar, rtol=1e-9, atol=1e-6)
+
+    def test_prior_transform(self, data):
+        from pint_tpu.bayesian import BayesianTiming
+
+        m, t = data
+        info = _prior_info(m)
+        bt = BayesianTiming(m, t, prior_info=info)
+        lo = bt.prior_transform(np.zeros(bt.nparams))
+        hi = bt.prior_transform(np.ones(bt.nparams))
+        for i, p in enumerate(bt.param_labels):
+            assert lo[i] == pytest.approx(info[p]["pmin"])
+            assert hi[i] == pytest.approx(info[p]["pmax"])
+
+    def test_out_of_prior_is_minus_inf(self, data):
+        from pint_tpu.bayesian import BayesianTiming
+
+        m, t = data
+        bt = BayesianTiming(m, t, prior_info=_prior_info(m))
+        x0 = np.array([float(getattr(bt.model, p).value)
+                       for p in bt.param_labels])
+        x0[0] *= 2  # far outside the uniform box
+        assert bt.lnposterior(x0) == -np.inf
+        assert bt.lnposterior_batch(x0[None, :])[0] == -np.inf
+
+
+class TestEnsembleSampler:
+    def test_samples_gaussian(self):
+        from pint_tpu.sampler import EnsembleSampler
+
+        mu = np.array([1.0, -2.0])
+        sig = np.array([0.5, 2.0])
+
+        def lnpost(pts):
+            pts = np.atleast_2d(pts)
+            return -0.5 * np.sum(((pts - mu) / sig) ** 2, axis=1)
+
+        s = EnsembleSampler(40, seed=1)
+        s.initialize_batched(lnpost, 2)
+        pos = mu + 0.1 * np.random.default_rng(2).standard_normal((40, 2))
+        s.run_mcmc(pos, 400)
+        chain = s.get_chain(flat=True, discard=150)
+        assert 0.2 < s.acceptance_fraction < 0.9
+        np.testing.assert_allclose(chain.mean(0), mu, atol=0.15)
+        np.testing.assert_allclose(chain.std(0), sig, rtol=0.2)
+
+    def test_chains_to_dict_layout(self):
+        from pint_tpu.sampler import EnsembleSampler
+
+        s = EnsembleSampler(10, seed=0)
+        s.initialize_batched(lambda p: -0.5 * np.sum(np.atleast_2d(p)**2, axis=1), 3)
+        s.run_mcmc(np.zeros((10, 3)) + 0.1, 5)
+        d = s.chains_to_dict(["a", "b", "c"])
+        assert d["a"].shape == (5, 10)
+
+
+class TestMCMCFitter:
+    def test_recovers_f0(self, data):
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.mcmc_fitter import MCMCFitter
+
+        m, t = data
+        # WLS first for errors, then MCMC around it
+        w = WLSFitter(t, _model())
+        w.fit_toas(maxiter=2)
+        info = {}
+        for p in ("F0", "F1", "DM"):
+            v = float(getattr(w.model, p).value)
+            e = float(getattr(w.model, p).uncertainty)
+            info[p] = {"distr": "uniform", "pmin": v - 20 * e, "pmax": v + 20 * e}
+        f = MCMCFitter(t, w.model, nwalkers=16, prior_info=info, errfact=0.5)
+        chi2 = f.fit_toas(maxiter=150, seed=4)
+        assert f.sampler.acceptance_fraction > 0.1
+        # max-posterior within a few sigma of the WLS solution
+        assert abs(float(f.model.F0.value) - float(w.model.F0.value)) \
+            < 5 * float(w.model.F0.uncertainty)
+        assert chi2 / f.resids.dof < 2.5
+        assert "F0" in f.get_fit_summary()

@@ -1,0 +1,176 @@
+"""Labeled matrices (pint_matrix) and the LM/Powell fitter family —
+cross-fitter consistency in the reference's style
+(``tests/test_fitter_compare.py``, SURVEY §4)."""
+
+import io
+
+import numpy as np
+import pytest
+
+PAR = """
+PSR  J0000+0000
+RAJ  04:37:00.0
+DECJ -47:15:00.0
+POSEPOCH 55000
+F0   173.6879489990983 1
+F1   -1.728e-15 1
+PEPOCH 55000
+DM   2.64476 1
+EPHEM DE440
+UNITS TDB
+"""
+
+
+def _model(extra=""):
+    from pint_tpu.models import get_model
+
+    return get_model(io.StringIO(PAR + extra))
+
+
+@pytest.fixture(scope="module")
+def sim():
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = _model()
+    t = make_fake_toas_uniform(54000, 55500, 60, m, freq=1400.0, error_us=1.0,
+                               add_noise=True, rng=np.random.default_rng(3))
+    return m, t
+
+
+class TestPintMatrix:
+    def test_design_matrix_labels(self, sim):
+        from pint_tpu.pint_matrix import DesignMatrixMaker
+
+        m, t = sim
+        d = DesignMatrixMaker("toa", "s")(t, m, m.free_params)
+        # RAJ/DECJ carry no fit flag in PAR, so they are frozen
+        assert d.derivative_params == ["Offset", "F0", "F1", "DM"]
+        assert d.shape == (len(t), 4)
+        assert d.get_label_size("F0", axis=1) == 1
+        assert d.derivative_quantity == ["toa"]
+
+    def test_label_matrix_subset(self, sim):
+        from pint_tpu.pint_matrix import DesignMatrixMaker
+
+        m, t = sim
+        d = DesignMatrixMaker("toa", "s")(t, m, m.free_params)
+        sub = d.get_label_matrix(["F0", "F1"])
+        assert sub.matrix.shape[1] == 2
+        i0 = d.derivative_params.index("F0")
+        np.testing.assert_array_equal(sub.matrix[:, 0], d.matrix[:, i0])
+
+    def test_combine_by_quantity(self, sim):
+        from pint_tpu.pint_matrix import (DesignMatrixMaker,
+                                          combine_design_matrices_by_quantity)
+
+        m, t = sim
+        dt = DesignMatrixMaker("toa", "s")(t, m, m.free_params)
+        # make a dm-quantity matrix with matching columns
+        t.update_dms(m.total_dm(t), np.full(len(t), 1e-4))
+        dd = DesignMatrixMaker("dm", "pc/cm3")(t, m, m.free_params)
+        c = combine_design_matrices_by_quantity([dt, dd])
+        assert c.shape == (2 * len(t), 4)
+        assert c.get_label("toa", 0)[0][2:4] == (0, len(t))
+        assert c.get_label("dm", 0)[0][2:4] == (len(t), 2 * len(t))
+
+    def test_combine_by_param_and_covariance(self, sim):
+        from pint_tpu.pint_matrix import (CovarianceMatrixMaker,
+                                          DesignMatrixMaker,
+                                          combine_covariance_matrix,
+                                          combine_design_matrices_by_param)
+
+        m, t = sim
+        d1 = DesignMatrixMaker("toa", "s")(t, m, m.free_params)
+        d2 = DesignMatrixMaker("toa", "s")(t, m, m.free_params)
+        # rename columns of d2 to avoid collision
+        d2.axis_labels[1] = {f"B_{k}": v for k, v in d2.axis_labels[1].items()}
+        c = combine_design_matrices_by_param(d1, d2)
+        assert c.shape == (len(t), 8)
+        cov = CovarianceMatrixMaker("toa", "s")(t, m)
+        cc = combine_covariance_matrix([cov, cov])
+        assert cc.shape == (2 * len(t), 2 * len(t))
+        corr = cov.to_correlation_matrix()
+        np.testing.assert_allclose(np.diag(corr.matrix), 1.0)
+
+    def test_overlap_rejected(self):
+        from pint_tpu.pint_matrix import PintMatrix
+
+        with pytest.raises(ValueError):
+            PintMatrix(np.zeros((4, 2)),
+                       [{"a": (0, 3, "s"), "b": (2, 4, "s")},
+                        {"x": (0, 2, "")}])
+
+    def test_covariance_prettyprint(self, sim):
+        m, t = sim
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.pint_matrix import CovarianceMatrix
+
+        f = WLSFitter(t, m)
+        f.fit_toas()
+        names = f.fitted_params
+        labels = {n: (i, i + 1, "") for i, n in enumerate(names)}
+        cm = CovarianceMatrix(f.parameter_covariance_matrix, [labels, labels])
+        s = cm.prettyprint()
+        assert "F0" in s and "Offset" not in s
+
+
+class TestLMFitter:
+    def test_lm_matches_wls(self, sim):
+        from pint_tpu.fitter import LMFitter, WLSFitter
+
+        m, t = sim
+        m1 = _model(); m1.F0.value += 3e-10
+        m2 = _model(); m2.F0.value += 3e-10
+        f1 = WLSFitter(t, m1); c1 = f1.fit_toas(maxiter=3)
+        f2 = LMFitter(t, m2); c2 = f2.fit_toas()
+        assert f2.converged
+        assert abs(c1 - c2) / c1 < 1e-6
+        assert abs(f1.model.F0.value - f2.model.F0.value) < 1e-13
+        # uncertainties agree at the few-percent level
+        assert f2.errors["F0"] == pytest.approx(f1.errors["F0"], rel=0.05)
+
+    def test_lm_with_noise_model(self, sim):
+        from pint_tpu.fitter import LMFitter
+        from pint_tpu.gls_fitter import GLSFitter
+
+        _, t = sim
+        extra = "EFAC -fe 430 1.3\nECORR -fe 430 0.5\n"
+        for fl in t.flags:
+            fl.setdefault("fe", "430")
+        t._version += 1
+        m1 = _model(extra)
+        m2 = _model(extra)
+        f1 = GLSFitter(t, m1); c1 = f1.fit_toas(maxiter=2)
+        f2 = LMFitter(t, m2); c2 = f2.fit_toas()
+        assert abs(c1 - c2) / c1 < 1e-3
+        assert abs(f1.model.F0.value - f2.model.F0.value) < 5e-13
+
+    def test_wideband_lm(self, sim):
+        from pint_tpu.wideband import WidebandLMFitter, WidebandTOAFitter
+
+        m, t = sim
+        t.update_dms(m.total_dm(t) + 1e-4 * np.random.default_rng(0).standard_normal(len(t)),
+                     np.full(len(t), 1e-4))
+        m1 = _model(); m1.DM.value += 2e-3
+        m2 = _model(); m2.DM.value += 2e-3
+        f1 = WidebandTOAFitter(t, m1); c1 = f1.fit_toas(maxiter=3)
+        f2 = WidebandLMFitter(t, m2); c2 = f2.fit_toas()
+        assert abs(c1 - c2) / c1 < 1e-4
+        assert abs(f1.model.DM.value - f2.model.DM.value) < 1e-7
+
+
+class TestPowellFitter:
+    def test_powell_refines_f0(self, sim):
+        from pint_tpu.fitter import PowellFitter, WLSFitter
+
+        _, t = sim
+        # seed Powell from a WLS fit (uncertainty-scaled steps), nudge F0
+        m0 = _model(); m0.F0.value += 2e-10
+        w = WLSFitter(t, m0)
+        cw = w.fit_toas(maxiter=2)
+        m1 = w.model
+        m1.F0.value += 5e-11  # perturb after fit; Powell should pull it back
+        f = PowellFitter(t, m1)
+        c = f.fit_toas(maxiter=8)
+        assert c <= WLSFitter(t, m1).resids.chi2 + 1e-9
+        assert abs(c - cw) / cw < 0.05
