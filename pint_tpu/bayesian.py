@@ -20,7 +20,23 @@ from pint_tpu.logging import log
 from pint_tpu.models.priors import Prior
 from pint_tpu.residuals import Residuals
 
-__all__ = ["BayesianTiming"]
+__all__ = ["BayesianTiming", "apply_prior_info"]
+
+
+def apply_prior_info(model, prior_info: Dict[str, dict]):
+    """Install uniform/normal priors from a prior_info dict onto the model's
+    parameters (shared by BayesianTiming and the photon MCMC fitters)."""
+    from scipy.stats import norm, uniform
+
+    for par, info in prior_info.items():
+        if info["distr"] == "uniform":
+            getattr(model, par).prior = Prior(
+                uniform(info["pmin"], info["pmax"] - info["pmin"]))
+        elif info["distr"] == "normal":
+            getattr(model, par).prior = Prior(norm(info["mu"], info["sigma"]))
+        else:
+            raise NotImplementedError(
+                "Only uniform and normal priors supported in prior_info")
 
 
 class BayesianTiming:
@@ -37,18 +53,7 @@ class BayesianTiming:
         self.nparams = len(self.param_labels)
 
         if prior_info is not None:
-            from scipy.stats import norm, uniform
-
-            for par, info in prior_info.items():
-                if info["distr"] == "uniform":
-                    getattr(self.model, par).prior = Prior(
-                        uniform(info["pmin"], info["pmax"] - info["pmin"]))
-                elif info["distr"] == "normal":
-                    getattr(self.model, par).prior = Prior(
-                        norm(info["mu"], info["sigma"]))
-                else:
-                    raise NotImplementedError(
-                        "Only uniform and normal priors supported in prior_info")
+            apply_prior_info(self.model, prior_info)
         self._validate_priors()
         self.likelihood_method = self._decide_likelihood_method()
         self._batch_fn = None
@@ -118,7 +123,12 @@ class BayesianTiming:
         free = tuple(self.param_labels)
         c = self.model._get_compiled(self.toas, free)
         sigma = jnp.asarray(self.model.scaled_toa_uncertainty(self.toas))
-        w = 1.0 / sigma**2
+        # mean subtraction weights by RAW errors, matching the scalar path
+        # (Residuals.calc_phase_resids uses toas.get_errors, not the
+        # EFAC/EQUAD-scaled sigmas)
+        raw_err = np.asarray(self.toas.get_errors(), dtype=np.float64)
+        w = jnp.asarray(1.0 / raw_err**2) if np.all(raw_err > 0) else \
+            jnp.ones(len(self.toas))
         lognorm = float(np.sum(np.log(np.asarray(sigma))))
         pn = self.toas.get_pulse_numbers()
         use_pn = self.track_mode == "use_pulse_numbers" and pn is not None
@@ -166,7 +176,13 @@ class BayesianTiming:
                 chi2 = chi2 + jnp.sum(((dm_data - dm_model) / dm_sig) ** 2)
             return lnpr - 0.5 * chi2 - lognorm
 
-        return jax.jit(jax.vmap(lnpost_one))
+        # vmap WITHOUT an outer jit: wrapping in jit would inline the inner
+        # jitted eval_fn and let XLA re-optimize (reassociate / contract)
+        # across the whole graph, which degrades the double-double
+        # error-free transforms by ~1e-7 cycles and breaks exact parity
+        # with the scalar path.  The inner jit boundary is preserved under
+        # plain vmap, so the heavy phase evaluation stays compiled.
+        return jax.vmap(lnpost_one)
 
     def lnposterior_batch(self, points: np.ndarray) -> np.ndarray:
         """Vectorized lnposterior over (N, ndim) points — jit + vmap on

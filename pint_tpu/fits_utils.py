@@ -1,0 +1,195 @@
+"""Minimal FITS reader for photon-event files.
+
+Counterpart of reference ``fits_utils.py`` (which wraps astropy.io.fits —
+not available in this deployment, so the container format is implemented
+directly from the FITS 4.0 standard): 2880-byte blocks of 80-char header
+cards, BINTABLE extensions with big-endian columns described by
+TTYPEn/TFORMn.  Covers what event files need — L (logical), B, I, J, K
+integers, E/D floats, A strings, and repeat counts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FITSHDU", "read_fits", "read_fits_event_mjds",
+           "read_fits_event_mjds_tuples"]
+
+BLOCK = 2880
+CARD = 80
+
+_TFORM_DTYPE = {
+    "L": "u1", "X": "u1", "B": "u1", "I": ">i2", "J": ">i4", "K": ">i8",
+    "E": ">f4", "D": ">f8", "C": ">c8", "M": ">c16", "A": "S",
+}
+
+
+def _parse_header(block_iter) -> Optional[Dict[str, object]]:
+    """Read header blocks until END; returns card dict or None at EOF."""
+    cards: Dict[str, object] = {}
+    done = False
+    got_any = False
+    while not done:
+        block = block_iter(BLOCK)
+        if len(block) < BLOCK:
+            return cards if got_any else None
+        got_any = True
+        for i in range(0, BLOCK, CARD):
+            card = block[i:i + CARD].decode("ascii", errors="replace")
+            key = card[:8].strip()
+            if key == "END":
+                done = True
+                break
+            if not key or key in ("COMMENT", "HISTORY") or card[8] != "=":
+                continue
+            val = card[10:]
+            # strip trailing comment (not inside a quoted string)
+            if val.lstrip().startswith("'"):
+                q = val.find("'", val.find("'") + 1)
+                sval = val[val.find("'") + 1:q]
+                cards[key] = sval.strip()
+            else:
+                val = val.split("/")[0].strip()
+                if val in ("T", "F"):
+                    cards[key] = val == "T"
+                elif val:
+                    try:
+                        cards[key] = int(val)
+                    except ValueError:
+                        try:
+                            cards[key] = float(val.replace("D", "E"))
+                        except ValueError:
+                            cards[key] = val
+    return cards
+
+
+def _data_size(hdr: Dict[str, object]) -> int:
+    naxis = int(hdr.get("NAXIS", 0))
+    if naxis == 0:
+        return 0
+    size = 1
+    for i in range(1, naxis + 1):
+        size *= int(hdr.get(f"NAXIS{i}", 0))
+    bitpix = abs(int(hdr.get("BITPIX", 8)))
+    size *= bitpix // 8
+    # heap (variable-length arrays) follows the main table in extensions
+    if "XTENSION" in hdr:
+        size += int(hdr.get("PCOUNT", 0))
+    return size
+
+
+def _tform_to_dtype(tform: str) -> Tuple[str, int]:
+    """TFORM string -> (numpy dtype string, repeat)."""
+    tform = tform.strip()
+    i = 0
+    while i < len(tform) and tform[i].isdigit():
+        i += 1
+    repeat = int(tform[:i]) if i else 1
+    code = tform[i] if i < len(tform) else "E"
+    if code == "A":
+        return f"S{repeat}", 1
+    if code not in _TFORM_DTYPE:
+        raise ValueError(f"Unsupported TFORM {tform!r}")
+    return _TFORM_DTYPE[code], repeat
+
+
+class FITSHDU:
+    def __init__(self, header: Dict[str, object], data: Optional[bytes]):
+        self.header = header
+        self._data = data
+
+    @property
+    def name(self) -> str:
+        return str(self.header.get("EXTNAME", "")).strip()
+
+    @property
+    def is_bintable(self) -> bool:
+        return str(self.header.get("XTENSION", "")).strip() == "BINTABLE"
+
+    def columns(self) -> List[str]:
+        n = int(self.header.get("TFIELDS", 0))
+        return [str(self.header.get(f"TTYPE{i}", f"col{i}")).strip()
+                for i in range(1, n + 1)]
+
+    def data(self) -> Dict[str, np.ndarray]:
+        """Parse the BINTABLE into {column: array} (native byte order)."""
+        if not self.is_bintable:
+            raise ValueError("Not a binary-table HDU")
+        hdr = self.header
+        nrows = int(hdr["NAXIS2"])
+        rowbytes = int(hdr["NAXIS1"])
+        nfields = int(hdr["TFIELDS"])
+        fields = []
+        for i in range(1, nfields + 1):
+            name = str(hdr.get(f"TTYPE{i}", f"col{i}")).strip()
+            dt, rep = _tform_to_dtype(str(hdr[f"TFORM{i}"]))
+            fields.append((name, dt, (rep,) if rep > 1 else ()))
+        dtype = np.dtype([(n, d, s) for n, d, s in fields])
+        if dtype.itemsize != rowbytes:
+            raise ValueError(
+                f"Row size mismatch: dtype {dtype.itemsize} vs NAXIS1 {rowbytes}")
+        arr = np.frombuffer(self._data[:nrows * rowbytes], dtype=dtype)
+        out = {}
+        for n, d, s in fields:
+            col = arr[n]
+            if d.startswith(">") or d.startswith("<"):
+                col = col.astype(d[1:])
+            out[n] = col
+        return out
+
+
+def read_fits(path: str) -> List[FITSHDU]:
+    hdus: List[FITSHDU] = []
+    with open(path, "rb") as f:
+        while True:
+            hdr = _parse_header(f.read)
+            if hdr is None:
+                break
+            size = _data_size(hdr)
+            padded = ((size + BLOCK - 1) // BLOCK) * BLOCK
+            data = f.read(padded)[:size] if size else None
+            hdus.append(FITSHDU(hdr, data))
+            if size and len(data) < size:
+                break
+    return hdus
+
+
+def get_hdu(hdus: List[FITSHDU], extname: str) -> FITSHDU:
+    for h in hdus:
+        if h.name.upper() == extname.upper():
+            return h
+    raise KeyError(f"No HDU named {extname!r}; have "
+                   f"{[h.name for h in hdus]}")
+
+
+def _mjdref(hdr: Dict[str, object]):
+    """(MJDREFI, MJDREFF) from the header, longdouble-safe
+    (reference ``fits_utils.py``)."""
+    if "MJDREFI" in hdr:
+        return np.longdouble(hdr["MJDREFI"]) + np.longdouble(str(hdr.get("MJDREFF", 0)))
+    if "MJDREF" in hdr:
+        return np.longdouble(str(hdr["MJDREF"]))
+    raise KeyError("No MJDREF in FITS header")
+
+
+def read_fits_event_mjds_tuples(hdu: FITSHDU, timecolumn: str = "TIME"):
+    """Event times as (mjd_int, mjd_frac) tuples
+    (reference ``fits_utils.py read_fits_event_mjds_tuples``)."""
+    hdr = hdu.header
+    mjdref = _mjdref(hdr)
+    timezero = np.longdouble(str(hdr.get("TIMEZERO", 0.0)))
+    met = hdu.data()[timecolumn].astype(np.float64)
+    mjds = mjdref + (np.asarray(met, dtype=np.longdouble) + timezero) / np.longdouble(86400.0)
+    ints = np.floor(mjds)
+    return ints.astype(np.int64), np.asarray(mjds - ints, dtype=np.float64)
+
+
+def read_fits_event_mjds(hdu: FITSHDU, timecolumn: str = "TIME") -> np.ndarray:
+    """Event times as longdouble MJDs (reference ``read_fits_event_mjds``)."""
+    hdr = hdu.header
+    mjdref = _mjdref(hdr)
+    timezero = np.longdouble(str(hdr.get("TIMEZERO", 0.0)))
+    met = hdu.data()[timecolumn].astype(np.float64)
+    return mjdref + (np.asarray(met, dtype=np.longdouble) + timezero) / np.longdouble(86400.0)

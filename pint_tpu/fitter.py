@@ -341,9 +341,10 @@ class LMFitter(Fitter):
                 if lam > 1e9:
                     raise ConvergenceFailure("LM damping diverged")
                 continue
-            # accept
+            # accept; a small change of either sign means convergence (small
+            # increases within the tolerance were accepted above)
             chi2 = new_chi2
-            if 0 <= decrease < min_chi2_decrease:
+            if decrease < min_chi2_decrease:
                 self.converged = True
                 break
             lam = max(lam / lambda_factor_decrease, min_lambda)

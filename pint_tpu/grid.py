@@ -82,6 +82,12 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
             r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
             return jnp.sum(w * r * r)
 
+        # NOTE: the outer jit inlines the inner jitted eval/jac and lets XLA
+        # re-optimize across the graph, which relaxes the dd error-free
+        # transforms to ~1e-7 cycles (see bayesian.py _build_batch_fn).
+        # For chi2 GRID SEARCH that is ~ns-level — far below TOA errors —
+        # and the fused executable is what delivers the batched-fit
+        # throughput, so the tradeoff goes the other way here.
         model._cache[grid_key] = jax.jit(jax.vmap(
             chi2_point, in_axes=(0, None, None, None, None, None, None, None)))
     vfn = model._cache[grid_key]

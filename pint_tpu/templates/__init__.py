@@ -1,0 +1,24 @@
+"""Pulse-profile template machinery for photon-domain likelihoods
+(counterpart of reference ``templates/``; SURVEY §2 "templates (photon)")."""
+
+from pint_tpu.templates.lcfitters import LCFitter
+from pint_tpu.templates.lcnorm import NormAngles
+from pint_tpu.templates.lcprimitives import (
+    LCGaussian,
+    LCLorentzian,
+    LCPrimitive,
+    LCTopHat,
+    LCVonMises,
+)
+from pint_tpu.templates.lctemplate import (
+    LCTemplate,
+    gauss_template_from_file,
+    make_twoside_gaussian,
+    prim_io,
+)
+
+__all__ = [
+    "LCFitter", "NormAngles", "LCGaussian", "LCLorentzian", "LCPrimitive",
+    "LCTopHat", "LCVonMises", "LCTemplate", "gauss_template_from_file",
+    "make_twoside_gaussian", "prim_io",
+]

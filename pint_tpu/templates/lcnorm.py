@@ -1,0 +1,72 @@
+"""Normalization of light-curve component weights.
+
+Counterpart of reference ``templates/lcnorm.py NormAngles``: the n component
+weights (each in [0,1], summing to <= 1, remainder = uniform background) are
+parameterized by n angles so unconstrained optimizers can fit them.  Using
+the same spherical parameterization as the reference:
+
+    norm_i = cos^2(a_1) ... cos^2(a_{i-1}) sin^2(a_i) ... (product chain)
+
+which maps R^n -> the simplex interior.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NormAngles"]
+
+
+class NormAngles:
+    def __init__(self, norms):
+        norms = np.asarray(norms, dtype=np.float64)
+        if norms.sum() > 1.0:
+            raise ValueError("Provided norms sum to > 1")
+        self.dim = len(norms)
+        self.p = self._norms_to_angles(norms)
+        self.free = np.ones(self.dim, dtype=bool)
+
+    # -- mapping -------------------------------------------------------------
+    @staticmethod
+    def _angles_to_norms(angles):
+        """sin^2(a_i) * prod_{j<i} cos^2(a_j)."""
+        s2 = np.sin(angles) ** 2
+        c2 = np.cos(angles) ** 2
+        prod = np.concatenate([[1.0], np.cumprod(c2)[:-1]])
+        return s2 * prod
+
+    @staticmethod
+    def _norms_to_angles(norms):
+        angles = np.empty(len(norms))
+        rem = 1.0
+        for i, n in enumerate(norms):
+            frac = 0.0 if rem <= 0 else min(n / rem, 1.0)
+            angles[i] = np.arcsin(np.sqrt(frac))
+            rem -= n
+        return angles
+
+    # -- API -----------------------------------------------------------------
+    def __call__(self) -> np.ndarray:
+        return self._angles_to_norms(self.p)
+
+    def get_parameters(self, free: bool = True) -> np.ndarray:
+        return self.p[self.free] if free else self.p.copy()
+
+    def set_parameters(self, p, free: bool = True):
+        if free:
+            self.p[self.free] = p
+        else:
+            self.p[:] = p
+
+    def num_parameters(self, free: bool = True) -> int:
+        return int(self.free.sum()) if free else self.dim
+
+    def set_single_norm(self, index: int, value: float):
+        norms = self()
+        norms[index] = value
+        if norms.sum() > 1:
+            raise ValueError("norms would sum to > 1")
+        self.p = self._norms_to_angles(norms)
+
+    def __repr__(self):
+        return f"NormAngles(norms={self()!r})"

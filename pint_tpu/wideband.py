@@ -347,13 +347,9 @@ class WidebandLMFitter(LMFitter, WidebandTOAFitter):
                                    additional_args=additional_args)
         self.method = "lm_wideband"
 
-    def update_resids(self):
-        return WidebandTOAFitter.update_resids(self)
+    # update_resids resolves to WidebandTOAFitter's via the MRO
 
     wideband_system = True
-
-    def _current_chi2(self) -> float:
-        return self.resids.calc_chi2()
 
     def _residual_vector(self) -> np.ndarray:
         return self.resids._combined_resids

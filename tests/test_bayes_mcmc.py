@@ -163,3 +163,26 @@ class TestMCMCFitter:
             < 5 * float(w.model.F0.uncertainty)
         assert chi2 / f.resids.dof < 2.5
         assert "F0" in f.get_fit_summary()
+
+
+class TestBatchScalarParityWithEFAC:
+    def test_nonuniform_efac(self, data):
+        """Regression: lnposterior_batch must match the scalar path when
+        EFAC scaling is non-uniform (mean subtraction weights by RAW
+        errors in both)."""
+        import io as _io
+
+        from pint_tpu.bayesian import BayesianTiming
+        from pint_tpu.models import get_model
+
+        _, t = data
+        for i, fl in enumerate(t.flags):
+            fl["fe"] = "430" if i % 2 else "Lband"
+        t._version += 1
+        m = get_model(_io.StringIO(PAR + "EFAC -fe 430 2.5\n"))
+        bt = BayesianTiming(m, t, prior_info=_prior_info(m))
+        x0 = np.array([float(getattr(bt.model, p).value)
+                       for p in bt.param_labels])
+        pts = x0[None, :] * (1 + 1e-12)
+        np.testing.assert_allclose(bt.lnposterior_batch(pts)[0],
+                                   bt.lnposterior(pts[0]), rtol=1e-9, atol=1e-6)
