@@ -1,0 +1,258 @@
+"""Conversions between binary-model parameterizations.
+
+Counterpart of reference ``binaryconvert.py`` (``convert_binary``): build a
+new TimingModel with a different BINARY component, transforming the
+parameters (ELL1 <-> DD families, SINI <-> SHAPMAX, M2/SINI <-> H3/STIG(M),
+ELL1 <-> ELL1k, DDGR -> DD post-Keplerians).  First-order uncertainty
+propagation is done with a numerical Jacobian of each transform (the
+reference uses the ``uncertainties`` package for the same effect).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.derived_quantities import TSUN_S, dr, dth, gamma, omdot, pbdot, sini
+from pint_tpu.logging import log
+
+__all__ = ["convert_binary"]
+
+SECPERDAY = 86400.0
+
+_ELL1_FAMILY = {"ELL1", "ELL1H", "ELL1k"}
+_DD_FAMILY = {"DD", "DDS", "DDH", "DDGR", "DDK", "BT"}
+
+
+def _propagate(transform, values: np.ndarray, errors: np.ndarray,
+               rel_step: float = 1e-7):
+    """y = transform(x) with sigma_y from the numerical Jacobian."""
+    values = np.asarray(values, dtype=np.float64)
+    y0 = np.asarray(transform(values), dtype=np.float64)
+    J = np.zeros((len(y0), len(values)))
+    for j, v in enumerate(values):
+        h = abs(v) * rel_step if v != 0 else 1e-12
+        xp = values.copy(); xp[j] += h
+        xm = values.copy(); xm[j] -= h
+        J[:, j] = (np.asarray(transform(xp)) - np.asarray(transform(xm))) / (2 * h)
+    var = J @ np.diag(np.asarray(errors, dtype=np.float64) ** 2) @ J.T
+    return y0, np.sqrt(np.diag(var))
+
+
+def _getv(model, name, default=0.0):
+    p = getattr(model, name, None)
+    if p is None or p.value is None:
+        return default
+    return float(p.value)
+
+
+def _gete(model, name):
+    p = getattr(model, name, None)
+    if p is None or p.uncertainty is None:
+        return 0.0
+    return float(p.uncertainty)
+
+
+def _pb_days(model) -> float:
+    pb = _getv(model, "PB", 0.0)
+    if pb:
+        return pb
+    fb0 = _getv(model, "FB0", 0.0)
+    return 1.0 / (fb0 * SECPERDAY) if fb0 else 0.0
+
+
+# -- elementary transforms ---------------------------------------------------
+
+def _eps_to_ecc_om_t0(eps1, eps2, tasc, pb_d):
+    ecc = np.hypot(eps1, eps2)
+    om = np.arctan2(eps1, eps2)  # rad
+    t0 = tasc + (om / (2 * np.pi)) * pb_d
+    return ecc, np.degrees(om) % 360.0, t0
+
+
+def _ecc_om_t0_to_eps(ecc, om_deg, t0, pb_d):
+    om = np.radians(om_deg)
+    eps1 = ecc * np.sin(om)
+    eps2 = ecc * np.cos(om)
+    tasc = t0 - (om / (2 * np.pi)) * pb_d
+    return eps1, eps2, tasc
+
+
+def _m2sini_to_h3stig(m2_msun, sini_):
+    cbar = np.sqrt(1.0 - sini_**2)
+    stig = sini_ / (1.0 + cbar)
+    h3 = TSUN_S * m2_msun * stig**3
+    return h3, stig
+
+
+def _h3stig_to_m2sini(h3, stig):
+    m2 = h3 / (TSUN_S * stig**3)
+    sini_ = 2.0 * stig / (1.0 + stig**2)
+    return m2, sini_
+
+
+def _sini_to_shapmax(sini_):
+    return -np.log(1.0 - sini_)
+
+
+def _shapmax_to_sini(shapmax):
+    return 1.0 - np.exp(-shapmax)
+
+
+# -- driver ------------------------------------------------------------------
+
+def convert_binary(model, output: str, **kw):
+    """Return a new TimingModel with the binary component converted to
+    *output* (reference ``binaryconvert.py convert_binary``)."""
+    from pint_tpu.models.binary.components import PulsarBinary
+    from pint_tpu.models.timing_model import Component
+
+    output = output.upper().replace("ELL1K", "ELL1k")
+    binary_comp = None
+    for c in model.components.values():
+        if isinstance(c, PulsarBinary):
+            binary_comp = c
+            break
+    if binary_comp is None:
+        raise ValueError("Model has no binary component to convert")
+    current = binary_comp.binary_model_name
+    if current == output:
+        return copy.deepcopy(model)
+    cls_name = f"Binary{output}"
+    if cls_name not in Component.component_types:
+        raise ValueError(f"Unknown binary model {output!r}")
+
+    new_model = copy.deepcopy(model)
+    new_model.remove_component(type(binary_comp).__name__)
+    new_comp = Component.component_types[cls_name]()
+    new_model.add_component(new_comp, validate=False)
+    new_model.BINARY.value = output
+
+    # copy every parameter both models share
+    for pname in binary_comp.params:
+        if pname in new_comp.params:
+            src = binary_comp._params_dict[pname]
+            dst = new_comp._params_dict[pname]
+            dst.value = src.value
+            dst.uncertainty = src.uncertainty
+            dst.frozen = src.frozen
+
+    pb_d = _pb_days(model)
+
+    cur_ell1 = current in _ELL1_FAMILY
+    out_ell1 = output in _ELL1_FAMILY
+
+    if cur_ell1 and not out_ell1:
+        # EPS1/EPS2/TASC -> ECC/OM/T0 (reference _from_ELL1)
+        x = [_getv(model, "EPS1"), _getv(model, "EPS2"), _getv(model, "TASC")]
+        e = [_gete(model, "EPS1"), _gete(model, "EPS2"), _gete(model, "TASC")]
+        (vals, errs) = _propagate(
+            lambda v: _eps_to_ecc_om_t0(v[0], v[1], v[2], pb_d), x, e)
+        for nm, v, s in zip(("ECC", "OM", "T0"), vals, errs):
+            par = new_comp._params_dict[nm]
+            par.value = float(v)
+            par.uncertainty = float(s) or None
+            par.frozen = getattr(model, "EPS1").frozen
+    elif out_ell1 and not cur_ell1:
+        # ECC/OM/T0 -> EPS1/EPS2/TASC (reference _to_ELL1)
+        ecc = _getv(model, "ECC")
+        if ecc > 0.01:
+            log.warning(f"ECC={ecc}: the ELL1 small-eccentricity expansion "
+                        "is inaccurate above ~0.01")
+        x = [ecc, _getv(model, "OM"), _getv(model, "T0")]
+        e = [_gete(model, "ECC"), _gete(model, "OM"), _gete(model, "T0")]
+        (vals, errs) = _propagate(
+            lambda v: _ecc_om_t0_to_eps(v[0], v[1], v[2], pb_d), x, e)
+        for nm, v, s in zip(("EPS1", "EPS2", "TASC"), vals, errs):
+            par = new_comp._params_dict[nm]
+            par.value = float(v)
+            par.uncertainty = float(s) or None
+            par.frozen = getattr(model, "ECC").frozen
+
+    # Shapiro parameterizations
+    if output == "DDS" and current != "DDS":
+        s = _getv(model, "SINI")
+        if s:
+            (v,), (sg,) = _propagate(lambda x: [_sini_to_shapmax(x[0])],
+                                     [s], [_gete(model, "SINI")])
+            new_comp.SHAPMAX.value = float(v)
+            new_comp.SHAPMAX.uncertainty = float(sg) or None
+            new_comp.SHAPMAX.frozen = model.SINI.frozen
+            new_comp.SINI.value = None
+    elif current == "DDS" and output != "DDS":
+        sh = _getv(model, "SHAPMAX")
+        if sh and "SINI" in new_comp.params:
+            (v,), (sg,) = _propagate(lambda x: [_shapmax_to_sini(x[0])],
+                                     [sh], [_gete(model, "SHAPMAX")])
+            new_comp.SINI.value = float(v)
+            new_comp.SINI.uncertainty = float(sg) or None
+            new_comp.SINI.frozen = model.SHAPMAX.frozen
+
+    ortho_out = output in ("DDH", "ELL1H")
+    ortho_cur = current in ("DDH", "ELL1H")
+    if ortho_out and not ortho_cur:
+        m2, s = _getv(model, "M2"), _getv(model, "SINI")
+        if m2 and s:
+            stig_name = "STIGMA" if "STIGMA" in new_comp.params else "STIG"
+            vals, errs = _propagate(
+                lambda x: _m2sini_to_h3stig(x[0], x[1]),
+                [m2, s], [_gete(model, "M2"), _gete(model, "SINI")])
+            new_comp._params_dict["H3"].value = float(vals[0])
+            new_comp._params_dict["H3"].uncertainty = float(errs[0]) or None
+            new_comp._params_dict[stig_name].value = float(vals[1])
+            new_comp._params_dict[stig_name].uncertainty = float(errs[1]) or None
+            for nm in ("M2", "SINI"):
+                if nm in new_comp.params:
+                    new_comp._params_dict[nm].value = None
+    elif ortho_cur and not ortho_out:
+        stig_name = "STIGMA" if "STIGMA" in binary_comp.params else "STIG"
+        h3, stig = _getv(model, "H3"), _getv(model, stig_name)
+        if h3 and stig and "M2" in new_comp.params:
+            vals, errs = _propagate(
+                lambda x: _h3stig_to_m2sini(x[0], x[1]),
+                [h3, stig], [_gete(model, "H3"), _gete(model, stig_name)])
+            new_comp.M2.value = float(vals[0])
+            new_comp.M2.uncertainty = float(errs[0]) or None
+            new_comp.SINI.value = float(vals[1])
+            new_comp.SINI.uncertainty = float(errs[1]) or None
+
+    # ELL1k: OMDOT/LNEDOT <-> EPS1DOT/EPS2DOT
+    if output == "ELL1k" and current in ("ELL1", "ELL1H"):
+        e1, e2 = _getv(new_model, "EPS1"), _getv(new_model, "EPS2")
+        e1d, e2d = _getv(model, "EPS1DOT"), _getv(model, "EPS2DOT")
+        ecc2 = e1**2 + e2**2
+        if ecc2 > 0:
+            omdot_rad_s = (e2 * e1d - e1 * e2d) / ecc2
+            lnedot_s = (e1 * e1d + e2 * e2d) / ecc2
+            new_comp.OMDOT.value = np.degrees(omdot_rad_s) * 365.25 * SECPERDAY
+            new_comp.LNEDOT.value = lnedot_s * 365.25 * SECPERDAY  # 1/s -> 1/yr
+    elif current == "ELL1k" and output in ("ELL1", "ELL1H"):
+        e1, e2 = _getv(model, "EPS1"), _getv(model, "EPS2")
+        omd = np.radians(_getv(model, "OMDOT")) / (365.25 * SECPERDAY)
+        lnedot_s = _getv(model, "LNEDOT") / (365.25 * SECPERDAY)  # 1/yr -> 1/s
+        new_comp.EPS1DOT.value = lnedot_s * e1 + omd * e2
+        new_comp.EPS2DOT.value = lnedot_s * e2 - omd * e1
+
+    # DDGR -> explicit post-Keplerians (reference _DDGR_to_PK)
+    if current == "DDGR" and output != "DDGR":
+        mtot, m2 = _getv(model, "MTOT"), _getv(model, "M2")
+        if mtot and m2:
+            mp = mtot - m2
+            ecc = _getv(new_model, "ECC") or np.hypot(
+                _getv(new_model, "EPS1"), _getv(new_model, "EPS2"))
+            x = _getv(model, "A1")
+            new_comp._params_dict["OMDOT"].value = omdot(mp, m2, pb_d, ecc)
+            new_comp._params_dict["GAMMA"].value = gamma(mp, m2, pb_d, ecc)
+            new_comp._params_dict["PBDOT"].value = pbdot(mp, m2, pb_d, ecc)
+            if "SINI" in new_comp.params:
+                new_comp._params_dict["SINI"].value = min(sini(mp, m2, pb_d, x), 1.0)
+                new_comp._params_dict["M2"].value = m2
+            if "DR" in new_comp.params:
+                new_comp._params_dict["DR"].value = dr(mp, m2, pb_d)
+                new_comp._params_dict["DTH"].value = dth(mp, m2, pb_d)
+
+    new_model.setup()
+    new_model.validate()
+    return new_model

@@ -1,0 +1,28 @@
+"""Runtime/example data-path accessors (reference ``config.py``)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["datadir", "examplefile", "runtimefile"]
+
+
+def datadir() -> str:
+    """Directory holding packaged data files."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def examplefile(filename: str) -> str:
+    """Full path of a packaged example file (reference ``config.py:34``)."""
+    path = os.path.join(datadir(), "examples", filename)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    return path
+
+
+def runtimefile(filename: str) -> str:
+    """Full path of a packaged runtime file (reference ``config.py:46``)."""
+    path = os.path.join(datadir(), "runtime", filename)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    return path

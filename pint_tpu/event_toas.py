@@ -103,6 +103,12 @@ def get_fits_TOAs(eventname: str, mission: str = "generic", weights=None,
         eventname, mission=mission, weights=weights, extension=extension,
         timesys=timesys, timeref=timeref, minmjd=minmjd, maxmjd=maxmjd,
         errors=errors)
+    if ts == "TT" and tr != "SOLARSYSTEM":
+        # the ingestion pipeline expects UTC; TT event times must be
+        # converted or the UTC->TT chain would be applied twice (~69 s)
+        from pint_tpu.timescales import tt_to_utc_mjd
+
+        mjds = tt_to_utc_mjd(mjds)
     n = len(mjds)
     cfg = MISSION_CONFIG.get(mission.lower(), MISSION_CONFIG["generic"])
     if tr == "SOLARSYSTEM":

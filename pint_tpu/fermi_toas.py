@@ -98,6 +98,12 @@ def get_Fermi_TOAs(ft1name: str, weightcolumn: Optional[str] = None,
         logeref=logeref, logesig=logesig, minweight=minweight,
         minmjd=minmjd, maxmjd=maxmjd, errors=errors)
     timeref = str(hdr.get("TIMEREF", "LOCAL")).strip().upper()
+    timesys = str(hdr.get("TIMESYS", "TT")).strip().upper()
+    if timesys == "TT" and timeref != "SOLARSYSTEM":
+        # see event_toas.get_fits_TOAs: the pipeline expects UTC input
+        from pint_tpu.timescales import tt_to_utc_mjd
+
+        mjds = tt_to_utc_mjd(mjds)
     n = len(mjds)
     flags = []
     for i in range(n):

@@ -90,6 +90,10 @@ def _tform_to_dtype(tform: str) -> Tuple[str, int]:
     code = tform[i] if i < len(tform) else "E"
     if code == "A":
         return f"S{repeat}", 1
+    if code == "X":
+        # bit arrays are stored packed: ceil(r/8) bytes on disk; exposed as
+        # the raw packed bytes
+        return "u1", (repeat + 7) // 8
     if code not in _TFORM_DTYPE:
         raise ValueError(f"Unsupported TFORM {tform!r}")
     return _TFORM_DTYPE[code], repeat
@@ -99,6 +103,7 @@ class FITSHDU:
     def __init__(self, header: Dict[str, object], data: Optional[bytes]):
         self.header = header
         self._data = data
+        self._parsed: Optional[Dict[str, np.ndarray]] = None
 
     @property
     def name(self) -> str:
@@ -114,7 +119,10 @@ class FITSHDU:
                 for i in range(1, n + 1)]
 
     def data(self) -> Dict[str, np.ndarray]:
-        """Parse the BINTABLE into {column: array} (native byte order)."""
+        """Parse the BINTABLE into {column: array} (native byte order);
+        cached — multi-million-row event tables are parsed once."""
+        if self._parsed is not None:
+            return self._parsed
         if not self.is_bintable:
             raise ValueError("Not a binary-table HDU")
         hdr = self.header
@@ -137,6 +145,7 @@ class FITSHDU:
             if d.startswith(">") or d.startswith("<"):
                 col = col.astype(d[1:])
             out[n] = col
+        self._parsed = out
         return out
 
 

@@ -1,0 +1,118 @@
+"""Model frame transforms: ecliptic <-> equatorial astrometry.
+
+Counterpart of reference ``modelutils.py:13 model_ecliptic_to_equatorial``
+and ``model_equatorial_to_ecliptic``: swap the astrometry component,
+converting the sky position/proper motion between ICRS and the IERS2010
+ecliptic frame.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from pint_tpu import OBL_IERS2010_RAD
+from pint_tpu.logging import log
+
+__all__ = ["model_ecliptic_to_equatorial", "model_equatorial_to_ecliptic"]
+
+
+def _ecl_to_eq(elong_rad, elat_rad):
+    ce, se = np.cos(OBL_IERS2010_RAD), np.sin(OBL_IERS2010_RAD)
+    cl, sl = np.cos(elong_rad), np.sin(elong_rad)
+    cb, sb = np.cos(elat_rad), np.sin(elat_rad)
+    x, y, z = cb * cl, cb * sl, sb
+    xe, ye, ze = x, ce * y - se * z, se * y + ce * z
+    ra = np.arctan2(ye, xe) % (2 * np.pi)
+    dec = np.arcsin(np.clip(ze, -1, 1))
+    return ra, dec
+
+
+def _eq_to_ecl(ra_rad, dec_rad):
+    ce, se = np.cos(OBL_IERS2010_RAD), np.sin(OBL_IERS2010_RAD)
+    cr, sr = np.cos(ra_rad), np.sin(ra_rad)
+    cd, sd = np.cos(dec_rad), np.sin(dec_rad)
+    x, y, z = cd * cr, cd * sr, sd
+    xl, yl, zl = x, ce * y + se * z, -se * y + ce * z
+    elong = np.arctan2(yl, xl) % (2 * np.pi)
+    elat = np.arcsin(np.clip(zl, -1, 1))
+    return elong, elat
+
+
+def _pm_jacobian(fwd, lon, lat, eps: float = 1e-8):
+    """Local rotation between tangent-plane PM components: maps
+    (mu_lon* = mu_lon cos lat, mu_lat) in the source frame to the target
+    frame.  Uses proper orthonormal differentials — cos(lat2)*d(lon2), NOT
+    d(lon2*cos(lat2)) — so the matrix is an exact rotation."""
+    lon2, lat2 = fwd(lon, lat)
+
+    def delta(dlon, dlat):
+        a, b = fwd(lon + dlon, lat + dlat)
+        dl = (a - lon2 + np.pi) % (2 * np.pi) - np.pi
+        return np.array([np.cos(lat2) * dl, b - lat2]) / eps
+
+    J = np.column_stack([delta(eps / np.cos(lat), 0.0), delta(0.0, eps)])
+    return J, lon2, lat2
+
+
+def model_ecliptic_to_equatorial(model):
+    """AstrometryEcliptic -> AstrometryEquatorial (reference
+    ``modelutils.py:13``)."""
+    from pint_tpu.models.astrometry import AstrometryEquatorial
+
+    if "AstrometryEcliptic" not in model.components:
+        raise ValueError("Model does not use ecliptic astrometry")
+    new = copy.deepcopy(model)
+    old = new.components["AstrometryEcliptic"]
+    # AngleParameter values are radians
+    elong = float(old.ELONG.value)
+    elat = float(old.ELAT.value)
+    J, ra, dec = _pm_jacobian(_ecl_to_eq, elong, elat)
+    comp = AstrometryEquatorial()
+    comp.RAJ.value = ra
+    comp.DECJ.value = dec
+    comp.POSEPOCH.value = old.POSEPOCH.value
+    comp.PX.value = old.PX.value
+    comp.PX.frozen = old.PX.frozen
+    pmelong = float(old.PMELONG.value or 0.0)
+    pmelat = float(old.PMELAT.value or 0.0)
+    pm = J @ np.array([pmelong, pmelat])
+    comp.PMRA.value, comp.PMDEC.value = float(pm[0]), float(pm[1])
+    for a, b in (("RAJ", "ELONG"), ("DECJ", "ELAT"),
+                 ("PMRA", "PMELONG"), ("PMDEC", "PMELAT")):
+        comp._params_dict[a].frozen = old._params_dict[b].frozen
+    new.remove_component("AstrometryEcliptic")
+    new.add_component(comp, validate=False)
+    new.setup()
+    return new
+
+
+def model_equatorial_to_ecliptic(model):
+    """AstrometryEquatorial -> AstrometryEcliptic."""
+    from pint_tpu.models.astrometry import AstrometryEcliptic
+
+    if "AstrometryEquatorial" not in model.components:
+        raise ValueError("Model does not use equatorial astrometry")
+    new = copy.deepcopy(model)
+    old = new.components["AstrometryEquatorial"]
+    ra = float(old.RAJ.value)
+    dec = float(old.DECJ.value)
+    J, elong, elat = _pm_jacobian(_eq_to_ecl, ra, dec)
+    comp = AstrometryEcliptic()
+    comp.ELONG.value = elong
+    comp.ELAT.value = elat
+    comp.POSEPOCH.value = old.POSEPOCH.value
+    comp.PX.value = old.PX.value
+    comp.PX.frozen = old.PX.frozen
+    pmra = float(old.PMRA.value or 0.0)
+    pmdec = float(old.PMDEC.value or 0.0)
+    pm = J @ np.array([pmra, pmdec])
+    comp.PMELONG.value, comp.PMELAT.value = float(pm[0]), float(pm[1])
+    for a, b in (("ELONG", "RAJ"), ("ELAT", "DECJ"),
+                 ("PMELONG", "PMRA"), ("PMELAT", "PMDEC")):
+        comp._params_dict[a].frozen = old._params_dict[b].frozen
+    new.remove_component("AstrometryEquatorial")
+    new.add_component(comp, validate=False)
+    new.setup()
+    return new

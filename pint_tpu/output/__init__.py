@@ -1,0 +1,1 @@
+"""Publication outputs (counterpart of reference ``output/``)."""

@@ -1,0 +1,89 @@
+"""Machine-generated LaTeX publication tables for a fitted timing model.
+
+Counterpart of reference ``output/publish.py:318 publish``: emit a LaTeX
+table of measured (fitted) parameters with uncertainties, set (frozen)
+parameters, and fit summary statistics (chi2, dof, RMS, data span).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["publish"]
+
+
+def _fmt_uncertainty(value: float, err: Optional[float]) -> str:
+    """PSRCAT-style value(err-in-last-digit) formatting: the parenthesized
+    number is the uncertainty in units of the last displayed digit."""
+    if err is None or err == 0 or not np.isfinite(err):
+        return f"{value:g}"
+    expo = int(np.floor(np.log10(abs(err))))
+    digits = max(0, -expo + 1)  # decimal places shown (two err digits)
+    scaled_err = round(err * 10**digits)
+    if scaled_err >= 100 and digits > 0:
+        digits -= 1
+        scaled_err = round(err * 10**digits)
+    return f"{value:.{digits}f}({scaled_err})"
+
+
+def publish(model, toas=None, fitter=None, include_dmx: bool = False,
+            include_noise: bool = True) -> str:
+    """Return a LaTeX table summarizing the timing solution
+    (reference ``output/publish.py``)."""
+    lines = [
+        r"\begin{table}",
+        rf"\caption{{Timing solution for {model.PSR.value or 'PSR'}}}",
+        r"\begin{tabular}{ll}",
+        r"\hline\hline",
+        r"\multicolumn{2}{c}{Fit summary} \\",
+        r"\hline",
+    ]
+    if toas is not None:
+        mjds = np.asarray(toas.get_mjds(), dtype=float)
+        lines += [
+            rf"Number of TOAs \dotfill & {len(toas)} \\",
+            rf"MJD range \dotfill & {mjds.min():.1f}---{mjds.max():.1f} \\",
+        ]
+    if fitter is not None:
+        r = fitter.resids
+        lines += [
+            rf"$\chi^2$ \dotfill & {r.chi2:.2f} \\",
+            rf"Degrees of freedom \dotfill & {r.dof} \\",
+            rf"Reduced $\chi^2$ \dotfill & {r.reduced_chi2:.3f} \\",
+        ]
+        try:
+            lines.append(
+                rf"Weighted RMS residual ($\mu$s) \dotfill & "
+                rf"{r.rms_weighted() * 1e6:.3f} \\")
+        except (AttributeError, TypeError):
+            pass
+    lines += [r"\hline", r"\multicolumn{2}{c}{Measured quantities} \\",
+              r"\hline"]
+    for p in model.free_params:
+        if not include_dmx and p.startswith(("DMX_", "DMXR")):
+            continue
+        par = getattr(model, p)
+        if not include_noise and model._is_noise_param(p):
+            continue
+        name = p.replace("_", r"\_")
+        val = _fmt_uncertainty(float(par.value or 0.0), par.uncertainty)
+        unit = str(par.units).replace("^", r"\^{}") if par.units else ""
+        lines.append(rf"{name} ({unit}) \dotfill & {val} \\")
+    lines += [r"\hline", r"\multicolumn{2}{c}{Set quantities} \\", r"\hline"]
+    for p in ("PSR", "EPHEM", "CLOCK", "UNITS", "NTOA"):
+        par = getattr(model, p, None)
+        if par is not None and par.value not in (None, ""):
+            lines.append(rf"{p} \dotfill & {par.value} \\")
+    for p in model.params:
+        if p in model.top_level_params:
+            continue
+        par = getattr(model, p)
+        if par.frozen and par.value not in (None, 0.0, False) \
+                and not p.startswith(("DMX", "JUMP", "EFAC", "EQUAD", "ECORR")):
+            if isinstance(par.value, (int, float)):
+                name = p.replace("_", r"\_")
+                lines.append(rf"{name} \dotfill & {par.value:g} \\")
+    lines += [r"\hline", r"\end{tabular}", r"\end{table}"]
+    return "\n".join(lines) + "\n"

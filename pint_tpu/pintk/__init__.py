@@ -1,0 +1,6 @@
+"""Interactive timing interface (counterpart of reference ``pintk/``).
+
+The model/TOA manipulation core (:mod:`pint_tpu.pintk.pulsar`) is GUI-free
+and fully scriptable/testable; the Tk widget layer (:mod:`pint_tpu.pintk.plk`)
+loads only when tkinter + matplotlib are available.
+"""

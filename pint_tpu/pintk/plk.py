@@ -1,0 +1,110 @@
+"""Tk plotting widget for interactive timing (reference ``pintk/plk.py``).
+
+A compact Tk+matplotlib residual editor over :class:`pint_tpu.pintk.pulsar
+.Pulsar`: residual plot with error bars, rectangle TOA selection, fit
+button, parameter freeze/thaw checkboxes, phase-wrap and jump actions.
+Imports of tkinter/matplotlib happen at call time so headless deployments
+(and the --test CI path) never touch them.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["launch_gui"]
+
+
+def launch_gui(psr):
+    import tkinter as tk
+    from tkinter import ttk
+
+    import matplotlib
+
+    matplotlib.use("TkAgg")
+    from matplotlib.backends.backend_tkagg import FigureCanvasTkAgg
+    from matplotlib.figure import Figure
+    from matplotlib.widgets import RectangleSelector
+
+    root = tk.Tk()
+    root.title(f"pintk: {psr.name}")
+    fig = Figure(figsize=(9, 5.5))
+    ax = fig.add_subplot(111)
+    canvas = FigureCanvasTkAgg(fig, master=root)
+    canvas.get_tk_widget().pack(side=tk.TOP, fill=tk.BOTH, expand=1)
+    state = {"selected": np.zeros(len(psr.all_toas), dtype=bool)}
+
+    def redraw():
+        ax.clear()
+        r = psr.resids()
+        mjds = np.asarray(psr.all_toas.get_mjds(), dtype=float)
+        res_us = np.asarray(r.time_resids) * 1e6
+        errs = np.asarray(psr.all_toas.get_errors())
+        sel = state["selected"]
+        ax.errorbar(mjds[~sel], res_us[~sel], yerr=errs[~sel], fmt=".",
+                    color="#2060a0", ecolor="0.8")
+        if sel.any():
+            ax.errorbar(mjds[sel], res_us[sel], yerr=errs[sel], fmt=".",
+                        color="#d03020", ecolor="0.8")
+        ax.axhline(0, color="0.5", lw=0.8)
+        ax.set_xlabel("MJD")
+        ax.set_ylabel("Residual (us)")
+        ax.set_title(f"{psr.name}  chi2={r.chi2:.2f}/{r.dof}")
+        canvas.draw()
+
+    def on_select(eclick, erelease):
+        mjds = np.asarray(psr.all_toas.get_mjds(), dtype=float)
+        res_us = np.asarray(psr.resids().time_resids) * 1e6
+        x1, x2 = sorted([eclick.xdata, erelease.xdata])
+        y1, y2 = sorted([eclick.ydata, erelease.ydata])
+        state["selected"] |= ((mjds >= x1) & (mjds <= x2)
+                              & (res_us >= y1) & (res_us <= y2))
+        redraw()
+
+    selector = RectangleSelector(ax, on_select, useblit=True, button=[1])
+
+    bar = ttk.Frame(root)
+    bar.pack(side=tk.BOTTOM, fill=tk.X)
+
+    def do_fit():
+        psr.fit()
+        redraw()
+
+    def do_reset():
+        psr.reset_model()
+        state["selected"][:] = False
+        redraw()
+
+    def do_clear_sel():
+        state["selected"][:] = False
+        redraw()
+
+    def do_jump():
+        if state["selected"].any():
+            psr.add_jump(state["selected"])
+            redraw()
+
+    def do_wrap(sign):
+        if state["selected"].any():
+            psr.add_phase_wrap(state["selected"], sign)
+            redraw()
+
+    for label, cmd in [("Fit", do_fit), ("Reset", do_reset),
+                       ("Clear sel", do_clear_sel), ("Jump sel", do_jump),
+                       ("Wrap +1", lambda: do_wrap(1)),
+                       ("Wrap -1", lambda: do_wrap(-1))]:
+        ttk.Button(bar, text=label, command=cmd).pack(side=tk.LEFT)
+
+    # parameter fit checkboxes
+    parbar = ttk.Frame(root)
+    parbar.pack(side=tk.BOTTOM, fill=tk.X)
+    for p in psr.model.fittable_params[:14]:
+        var = tk.BooleanVar(value=not getattr(psr.model, p).frozen)
+
+        def mk(pn, v):
+            return lambda: psr.set_fit_state(pn, v.get())
+
+        ttk.Checkbutton(parbar, text=p, variable=var,
+                        command=mk(p, var)).pack(side=tk.LEFT)
+
+    redraw()
+    root.mainloop()

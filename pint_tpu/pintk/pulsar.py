@@ -1,0 +1,179 @@
+"""Pulsar: the model+TOAs wrapper behind the interactive GUI.
+
+Counterpart of reference ``pintk/pulsar.py`` (700 LoC): owns the timing
+model, the full and selected TOAs, pre/post-fit residuals, and the editing
+operations the GUI exposes — fitting, parameter freeze/thaw, phase wraps,
+jump add/remove on selections, random-model draws.  Entirely GUI-free so it
+doubles as a scripting convenience ("the pintk workflow without Tk").
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import numpy as np
+
+from pint_tpu.fitter import Fitter
+from pint_tpu.logging import log
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.toa import get_TOAs
+
+__all__ = ["Pulsar"]
+
+#: fitter-name -> constructor used by the GUI fitter selector
+FITTER_NAMES = ["auto", "WLS", "GLS", "downhill WLS", "downhill GLS",
+                "Wideband"]
+
+
+class Pulsar:
+    def __init__(self, parfile: str, timfile: str, ephem: Optional[str] = None,
+                 fitter: str = "auto"):
+        self.parfile = parfile
+        self.timfile = timfile
+        self.model_init = get_model(parfile)
+        self.model = copy.deepcopy(self.model_init)
+        self.all_toas = get_TOAs(timfile, model=self.model, ephem=ephem)
+        self.selected_toas = self.all_toas
+        self.fit_method = fitter
+        self.fitted = False
+        self.track_added = False
+        self.fitter: Optional[Fitter] = None
+        self.prefit_resids = Residuals(self.all_toas, self.model)
+        self.postfit_resids: Optional[Residuals] = None
+
+    # -- basic info ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return str(self.model.PSR.value or "")
+
+    def __getitem__(self, key):
+        return getattr(self.model, key)
+
+    def __contains__(self, key) -> bool:
+        return key in self.model.params
+
+    # -- residuals -----------------------------------------------------------
+    def resids(self, selected: bool = False) -> Residuals:
+        toas = self.selected_toas if selected else self.all_toas
+        return Residuals(toas, self.model)
+
+    def update_resids(self):
+        self.prefit_resids = Residuals(self.all_toas, self.model_init)
+        if self.fitted:
+            self.postfit_resids = Residuals(self.all_toas, self.model)
+
+    # -- selection -----------------------------------------------------------
+    def select_toas(self, mask) -> None:
+        """Restrict the working set (boolean mask or index array)."""
+        self.selected_toas = self.all_toas[mask]
+
+    def reset_selection(self):
+        self.selected_toas = self.all_toas
+
+    def delete_TOAs(self, indices) -> None:
+        keep = np.ones(len(self.all_toas), dtype=bool)
+        keep[np.asarray(indices)] = False
+        self.all_toas = self.all_toas[keep]
+        self.reset_selection()
+        self.update_resids()
+
+    # -- model editing -------------------------------------------------------
+    def set_fit_state(self, param: str, fit: bool):
+        getattr(self.model, param).frozen = not fit
+
+    def free_params(self) -> List[str]:
+        return self.model.free_params
+
+    def add_phase_wrap(self, selected_mask, phase: int):
+        """Add integer phase wraps to the selected TOAs (reference
+        ``pintk/pulsar.py add_phase_wrap``)."""
+        toas = self.all_toas
+        if toas.pulse_number is None:
+            toas.compute_pulse_numbers(self.model)
+        dpn = toas.delta_pulse_number
+        if dpn is None:
+            dpn = np.zeros(len(toas))
+        dpn = np.asarray(dpn, dtype=np.float64).copy()
+        dpn[np.asarray(selected_mask)] += phase
+        toas.delta_pulse_number = dpn
+        toas._version += 1
+        self.update_resids()
+
+    def add_jump(self, selected_mask) -> str:
+        """JUMP the selected TOAs: flags them with -gui_jump and adds the
+        mask parameter (reference ``pintk/pulsar.py add_jump``)."""
+        from pint_tpu.models.jump import PhaseJump
+        from pint_tpu.models.parameter import maskParameter
+
+        if "PhaseJump" not in self.model.components:
+            self.model.add_component(PhaseJump(), validate=False)
+        comp = self.model.components["PhaseJump"]
+        idx = 1 + sum(1 for p in comp.params if p.startswith("JUMP"))
+        flagval = str(idx)
+        for i in np.nonzero(np.asarray(selected_mask))[0]:
+            self.all_toas.flags[i]["gui_jump"] = flagval
+        self.all_toas._version += 1
+        name = f"JUMP{idx}"
+        if name not in comp.params:
+            par = maskParameter("JUMP", index=idx, key="-gui_jump",
+                               key_value=[flagval], units="s", value=0.0,
+                               frozen=False)
+            comp.add_param(par)
+        self.model.setup()
+        return name
+
+    def getDefaultFitter(self) -> str:
+        if getattr(self.all_toas, "wideband", False):
+            return "Wideband"
+        return "downhill GLS" if self.model.has_correlated_errors \
+            else "downhill WLS"
+
+    # -- fitting -------------------------------------------------------------
+    def fit(self, selected: bool = False, iters: int = 4) -> float:
+        toas = self.selected_toas if selected else self.all_toas
+        self.fitter = Fitter.auto(toas, self.model) \
+            if self.fit_method == "auto" else self._make_fitter(toas)
+        chi2 = self.fitter.fit_toas(maxiter=iters)
+        self.model = self.fitter.model
+        self.fitted = True
+        self.update_resids()
+        return chi2
+
+    def _make_fitter(self, toas):
+        from pint_tpu.fitter import DownhillWLSFitter, WLSFitter
+        from pint_tpu.gls_fitter import DownhillGLSFitter, GLSFitter
+
+        table = {"WLS": WLSFitter, "GLS": GLSFitter,
+                 "downhill WLS": DownhillWLSFitter,
+                 "downhill GLS": DownhillGLSFitter}
+        if self.fit_method == "Wideband":
+            from pint_tpu.wideband import WidebandTOAFitter
+
+            return WidebandTOAFitter(toas, self.model)
+        return table[self.fit_method](toas, self.model)
+
+    def reset_model(self):
+        self.model = copy.deepcopy(self.model_init)
+        self.fitted = False
+        self.postfit_resids = None
+        self.update_resids()
+
+    def reset_TOAs(self):
+        self.all_toas = get_TOAs(self.timfile, model=self.model)
+        self.reset_selection()
+        self.update_resids()
+
+    def write_fit_summary(self) -> str:
+        return self.fitter.get_summary() if self.fitter else "(not fitted)"
+
+    def random_models(self, nmodels: int = 30, rng=None):
+        """Random model phase predictions for the GUI overlay
+        (reference ``pintk/pulsar.py random_models``)."""
+        from pint_tpu.simulation import calculate_random_models
+
+        if self.fitter is None:
+            raise ValueError("Fit first")
+        return calculate_random_models(self.fitter, self.all_toas,
+                                       Nmodels=nmodels, rng=rng)

@@ -1,0 +1,89 @@
+"""Plotting helpers: phaseograms and residual plots (matplotlib-gated).
+
+Counterpart of reference ``plot_utils.py`` (``phaseogram``,
+``phaseogram_binned``, ``plot_priors``).  Matplotlib is imported lazily so
+headless/compute-only deployments never pay for it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["phaseogram", "phaseogram_binned", "plot_residuals_time"]
+
+
+def _mpl():
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def phaseogram(mjds, phases, weights=None, bins: int = 100, rotate: float = 0.0,
+               size: int = 5, alpha: float = 0.25, plotfile: Optional[str] = None):
+    """Photon phaseogram: scatter of phase vs time + summed profile
+    (reference ``plot_utils.py phaseogram``).  Returns the figure."""
+    plt = _mpl()
+    mjds = np.asarray(mjds, dtype=np.float64)
+    ph = (np.asarray(phases) + rotate) % 1.0
+    fig, (ax1, ax2) = plt.subplots(
+        2, 1, sharex=True, figsize=(6, 8),
+        gridspec_kw={"height_ratios": [1, 3]})
+    ph2 = np.concatenate([ph, ph + 1.0])
+    w2 = None if weights is None else np.concatenate([weights, weights])
+    ax1.hist(ph2, bins=2 * bins, range=(0, 2), weights=w2,
+             histtype="step", color="k")
+    ax1.set_ylabel("Counts")
+    ax2.scatter(ph2, np.concatenate([mjds, mjds]), s=size, alpha=alpha,
+                c="k" if weights is None else np.concatenate([weights, weights]))
+    ax2.set_xlim(0, 2)
+    ax2.set_xlabel("Pulse phase")
+    ax2.set_ylabel("MJD")
+    if plotfile:
+        fig.savefig(plotfile)
+        plt.close(fig)
+    return fig
+
+
+def phaseogram_binned(mjds, phases, weights=None, bins: int = 64,
+                      time_bins: int = 32, rotate: float = 0.0,
+                      plotfile: Optional[str] = None):
+    """2D binned phaseogram (reference ``plot_utils.py phaseogram_binned``)."""
+    plt = _mpl()
+    mjds = np.asarray(mjds, dtype=np.float64)
+    ph = (np.asarray(phases) + rotate) % 1.0
+    H, xe, ye = np.histogram2d(ph, mjds, bins=[bins, time_bins],
+                               range=[[0, 1], [mjds.min(), mjds.max()]],
+                               weights=weights)
+    H2 = np.vstack([H, H])
+    fig, ax = plt.subplots(figsize=(6, 6))
+    ax.imshow(H2.T, origin="lower", aspect="auto", cmap="magma",
+              extent=[0, 2, mjds.min(), mjds.max()])
+    ax.set_xlabel("Pulse phase")
+    ax.set_ylabel("MJD")
+    if plotfile:
+        fig.savefig(plotfile)
+        plt.close(fig)
+    return fig
+
+
+def plot_residuals_time(toas, residuals, errors_us=None,
+                        plotfile: Optional[str] = None):
+    """Residuals-vs-time errorbar plot (the pintk main view, headless)."""
+    plt = _mpl()
+    mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+    r_us = np.asarray(residuals) * 1e6
+    err = errors_us if errors_us is not None else np.asarray(toas.get_errors())
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.errorbar(mjds, r_us, yerr=err, fmt=".", color="#2060a0", ecolor="0.7")
+    ax.axhline(0.0, color="0.4", lw=0.8)
+    ax.set_xlabel("MJD")
+    ax.set_ylabel(r"Residual ($\mu$s)")
+    if plotfile:
+        fig.savefig(plotfile)
+        plt.close(fig)
+    return fig

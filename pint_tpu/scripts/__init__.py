@@ -1,0 +1,5 @@
+"""Console entry points (counterpart of reference ``scripts/``; SURVEY L7).
+
+Each module exposes ``main(argv=None)`` so tests can invoke it in-process
+(the reference's own CLI test strategy, SURVEY §4).
+"""

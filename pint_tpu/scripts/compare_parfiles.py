@@ -1,0 +1,25 @@
+"""Tabular comparison of two par files
+(reference ``scripts/compare_parfiles.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(description="Compare two par files")
+    ap.add_argument("parfile1")
+    ap.add_argument("parfile2")
+    ap.add_argument("--verbosity", default="max",
+                    choices=["max", "med", "min"])
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models import get_model
+
+    m1 = get_model(args.parfile1, allow_tcb=True)
+    m2 = get_model(args.parfile2, allow_tcb=True)
+    print(m1.compare(m2, verbosity=args.verbosity))
+    return 0

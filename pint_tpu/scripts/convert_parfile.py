@@ -1,0 +1,43 @@
+"""Convert/normalize a par file (reference ``scripts/convert_parfile.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="Read a par file and write it out, optionally converting "
+        "binary model or units")
+    ap.add_argument("input")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output par file (default stdout)")
+    ap.add_argument("--binary", default=None,
+                    help="convert to this binary model (e.g. DD, ELL1)")
+    ap.add_argument("--units", default=None, choices=["TDB", "TCB"],
+                    help="convert timescale units")
+    ap.add_argument("--allow-tcb", action="store_true")
+    ap.add_argument("--allow-T2", action="store_true")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models import get_model
+
+    model = get_model(args.input, allow_tcb=True, allow_T2=args.allow_T2)
+    if args.units and model.UNITS.value != args.units:
+        from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+        convert_tcb_tdb(model, backwards=args.units == "TCB")
+    if args.binary:
+        from pint_tpu.binaryconvert import convert_binary
+
+        model = convert_binary(model, args.binary)
+    text = model.as_parfile()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text, end="")
+    return 0

@@ -1,0 +1,39 @@
+"""Joint template MCMC over multiple event datasets
+(reference ``scripts/event_optimize_multiple.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="Run event_optimize over several event files listed in "
+        "a text file (eventfile template [weightcol] per line)")
+    ap.add_argument("eventfiles", help="text file listing datasets")
+    ap.add_argument("parfile")
+    ap.add_argument("--nwalkers", type=int, default=32)
+    ap.add_argument("--nsteps", type=int, default=250)
+    ap.add_argument("--outbase", default="event_optimize_multiple")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.scripts import event_optimize
+
+    results = []
+    with open(args.eventfiles) as f:
+        datasets = [ln.split() for ln in f if ln.strip()
+                    and not ln.startswith("#")]
+    for i, row in enumerate(datasets):
+        ev, tmpl = row[0], row[1]
+        sub = [ev, args.parfile, tmpl,
+               "--nwalkers", str(args.nwalkers),
+               "--nsteps", str(args.nsteps),
+               "--outbase", f"{args.outbase}_{i}"]
+        if len(row) > 2:
+            sub += ["--weightcol", row[2]]
+        print(f"=== dataset {i}: {ev} ===")
+        results.append(event_optimize.main(sub))
+    return max(results) if results else 0

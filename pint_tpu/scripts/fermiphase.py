@@ -1,0 +1,57 @@
+"""Compute phases for Fermi-LAT photons (reference ``scripts/fermiphase.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="Phase-fold Fermi LAT photons with a timing model")
+    ap.add_argument("ft1file")
+    ap.add_argument("parfile")
+    ap.add_argument("weightcol", nargs="?", default=None,
+                    help="FT1 weight column name, or CALC")
+    ap.add_argument("--minweight", type=float, default=0.0)
+    ap.add_argument("--plot", action="store_true")
+    ap.add_argument("--plotfile", default=None)
+    ap.add_argument("--outfile", default=None)
+    args = ap.parse_args(argv)
+
+    from pint_tpu.eventstats import h2sig, hmw, hm, sf_hm
+    from pint_tpu.fermi_toas import get_Fermi_TOAs
+    from pint_tpu.models import get_model
+
+    model = get_model(args.parfile)
+    target = None
+    if args.weightcol == "CALC":
+        ra = getattr(model, "RAJ", None)
+        dec = getattr(model, "DECJ", None)
+        if ra is not None and ra.value is not None:
+            target = (np.degrees(float(ra.value)),
+                      np.degrees(float(dec.value)))
+    ts = get_Fermi_TOAs(args.ft1file, weightcolumn=args.weightcol,
+                        targetcoord=target, minweight=args.minweight)
+    ph = model.phase(ts)
+    phases = np.asarray(ph.frac) % 1.0
+    wv, valid = ts.get_flag_value("weight", as_type=float)
+    weights = np.asarray(wv, dtype=np.float64) \
+        if len(valid) == len(ts) else None
+    h = hmw(phases, weights) if weights is not None else hm(phases)
+    print(f"Htest : {h:.2f}  ({h2sig(h):.2f} sigma, p={sf_hm(h):.3g})")
+    if args.outfile:
+        mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+        cols = [mjds, phases] + ([weights] if weights is not None else [])
+        np.savetxt(args.outfile, np.column_stack(cols))
+    if args.plot or args.plotfile:
+        from pint_tpu.plot_utils import phaseogram
+
+        mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+        phaseogram(mjds, phases, weights=weights,
+                   plotfile=args.plotfile or "fermiphase.png")
+    return 0

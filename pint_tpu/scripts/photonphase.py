@@ -1,0 +1,62 @@
+"""Compute pulse phases for X-ray photon events
+(reference ``scripts/photonphase.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="Assign model phases to FITS photon events and compute "
+        "pulsation statistics")
+    ap.add_argument("eventfile")
+    ap.add_argument("parfile")
+    ap.add_argument("--mission", default="generic")
+    ap.add_argument("--absphase", action="store_true")
+    ap.add_argument("--plot", action="store_true")
+    ap.add_argument("--plotfile", default=None)
+    ap.add_argument("--outfile", default=None,
+                    help="write MJD/phase text table")
+    ap.add_argument("--maxMJD", type=float, default=np.inf)
+    ap.add_argument("--minMJD", type=float, default=-np.inf)
+    ap.add_argument("--polycos", action="store_true",
+                    help="predict with generated polycos instead of the "
+                    "full model (faster for huge event lists)")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.event_toas import get_fits_TOAs
+    from pint_tpu.eventstats import h2sig, hm, sf_hm
+    from pint_tpu.models import get_model
+
+    model = get_model(args.parfile)
+    ts = get_fits_TOAs(args.eventfile, mission=args.mission,
+                       minmjd=args.minMJD, maxmjd=args.maxMJD)
+    if args.polycos:
+        from pint_tpu.polycos import Polycos
+
+        mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+        p = Polycos.generate_polycos(model, mjds.min() - 0.01,
+                                     mjds.max() + 0.01, ts.obs[0])
+        phases = p.eval_phase(mjds)
+    else:
+        ph = model.phase(ts, abs_phase=args.absphase and
+                         "AbsPhase" in model.components)
+        phases = np.asarray(ph.frac) % 1.0
+    h = hm(phases)
+    print(f"Htest : {h:.2f}  ({h2sig(h):.2f} sigma, p={sf_hm(h):.3g})")
+    if args.outfile:
+        mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+        np.savetxt(args.outfile, np.column_stack([mjds, phases]),
+                   fmt="%.12f %.9f")
+    if args.plot or args.plotfile:
+        from pint_tpu.plot_utils import phaseogram
+
+        mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+        phaseogram(mjds, phases, plotfile=args.plotfile or "photonphase.png")
+    return 0

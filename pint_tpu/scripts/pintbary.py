@@ -1,0 +1,45 @@
+"""Barycentring of a single time (reference ``scripts/pintbary.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="Convert a topocentric MJD to barycentric (TDB at SSB)")
+    ap.add_argument("time", type=float, help="topocentric UTC MJD")
+    ap.add_argument("--obs", default="geocenter")
+    ap.add_argument("--freq", type=float, default=np.inf, help="MHz")
+    ap.add_argument("--parfile", default=None)
+    ap.add_argument("--ra", default=None, help="e.g. 12:34:56.7 (hms)")
+    ap.add_argument("--dec", default=None, help="e.g. -12:34:56.7 (dms)")
+    ap.add_argument("--dm", type=float, default=0.0)
+    ap.add_argument("--ephem", default="DE440")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import make_single_toa
+
+    if args.parfile:
+        model = get_model(args.parfile)
+    else:
+        if args.ra is None or args.dec is None:
+            ap.error("need --parfile or --ra/--dec")
+        par = (f"PSR BARY\nRAJ {args.ra}\nDECJ {args.dec}\nPOSEPOCH 55000\n"
+               f"F0 1.0\nPEPOCH 55000\nDM {args.dm}\nUNITS TDB\n")
+        import io
+
+        model = get_model(io.StringIO(par))
+    ts = make_single_toa(args.time, args.obs, freq_mhz=args.freq,
+                         ephem=args.ephem)
+    delay = float(np.asarray(model.delay(ts))[0])
+    tdb = np.longdouble(ts.tdb[0])
+    bat = tdb - np.longdouble(delay) / np.longdouble(86400.0)
+    print(f"{float(bat):.15f}")
+    return 0

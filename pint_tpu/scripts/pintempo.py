@@ -1,0 +1,44 @@
+"""tempo-like command-line fitting (reference ``scripts/pintempo.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="PINT-tpu: fit a timing model to TOAs (tempo-style)")
+    ap.add_argument("parfile")
+    ap.add_argument("timfile")
+    ap.add_argument("--outfile", default=None, help="write post-fit par file")
+    ap.add_argument("--plot", action="store_true", help="plot residuals")
+    ap.add_argument("--plotfile", default=None)
+    ap.add_argument("--gls", action="store_true", help="force GLS fitter")
+    ap.add_argument("--usepickle", action="store_true")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.fitter import Fitter
+    from pint_tpu.models import get_model_and_toas
+
+    model, toas = get_model_and_toas(args.parfile, args.timfile,
+                                     usepickle=args.usepickle)
+    if args.gls:
+        from pint_tpu.gls_fitter import GLSFitter
+
+        f = GLSFitter(toas, model)
+    else:
+        f = Fitter.auto(toas, model)
+    f.fit_toas()
+    print(f.get_summary())
+    if args.outfile:
+        f.model.write_parfile(args.outfile)
+        print(f"Post-fit model written to {args.outfile}")
+    if args.plot or args.plotfile:
+        from pint_tpu.plot_utils import plot_residuals_time
+
+        plot_residuals_time(toas, f.resids.time_resids,
+                            plotfile=args.plotfile or "pintempo.png")
+    return 0

@@ -1,0 +1,37 @@
+"""Launcher for the interactive residual-editing GUI
+(reference ``scripts/pintk.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(description="Interactive timing GUI")
+    ap.add_argument("parfile")
+    ap.add_argument("timfile")
+    ap.add_argument("--test", action="store_true",
+                    help="build everything headless and exit (CI smoke test, "
+                    "reference parity)")
+    ap.add_argument("--fit", action="store_true",
+                    help="(with --test) also run one fit")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.pintk.pulsar import Pulsar
+
+    psr = Pulsar(args.parfile, args.timfile)
+    if args.test:
+        if args.fit:
+            psr.fit()
+        print(f"pintk --test: {psr.name}: {len(psr.all_toas)} TOAs, "
+              f"chi2 {psr.resids().chi2:.2f}")
+        return 0
+    try:
+        from pint_tpu.pintk.plk import launch_gui
+    except ImportError as e:
+        ap.error(f"GUI unavailable ({e}); use --test for the headless path")
+    launch_gui(psr)
+    return 0

@@ -1,0 +1,34 @@
+"""Publication-table generation CLI (reference ``scripts/pintpublish.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(description="Generate a LaTeX timing table")
+    ap.add_argument("parfile")
+    ap.add_argument("timfile")
+    ap.add_argument("-o", "--out", default=None)
+    ap.add_argument("--no-fit", action="store_true",
+                    help="summarize without refitting")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.fitter import Fitter
+    from pint_tpu.models import get_model_and_toas
+    from pint_tpu.output.publish import publish
+
+    model, toas = get_model_and_toas(args.parfile, args.timfile)
+    f = Fitter.auto(toas, model)
+    if not args.no_fit:
+        f.fit_toas()
+    tex = publish(f.model, toas, f)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(tex)
+    else:
+        print(tex, end="")
+    return 0

@@ -1,0 +1,27 @@
+"""Convert tempo2-flavored binary par files to native-compatible form
+(reference ``scripts/t2binary2pint.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="Convert a par file using the tempo2 T2 binary model to "
+        "the closest supported model (ELL1/DD/DDK guessing)")
+    ap.add_argument("input")
+    ap.add_argument("output")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models import get_model
+
+    # guess_binary_model runs inside the builder under allow_T2
+    model = get_model(args.input, allow_tcb=True, allow_T2=True)
+    model.write_parfile(args.output)
+    print(f"Converted par file written to {args.output} "
+          f"(BINARY {model.BINARY.value})")
+    return 0

@@ -1,0 +1,24 @@
+"""TCB -> TDB par conversion CLI (reference ``scripts/tcb2tdb.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(description="Convert a TCB par file to TDB")
+    ap.add_argument("input")
+    ap.add_argument("output")
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models import get_model
+    from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+    model = get_model(args.input, allow_tcb=True)
+    convert_tcb_tdb(model)
+    model.write_parfile(args.output)
+    print(f"TDB par file written to {args.output}")
+    return 0

@@ -1,0 +1,47 @@
+"""Simulate TOAs ("zima" = simaz backwards; reference ``scripts/zima.py``)."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(description="Simulate fake TOAs from a model")
+    ap.add_argument("parfile")
+    ap.add_argument("timfile", help="output tim file")
+    ap.add_argument("--inputtim", default=None,
+                    help="copy epochs/errors/freqs from this tim file")
+    ap.add_argument("--startMJD", type=float, default=56000.0)
+    ap.add_argument("--duration", type=float, default=400.0, help="days")
+    ap.add_argument("--ntoa", type=int, default=100)
+    ap.add_argument("--error", type=float, default=1.0, help="TOA error (us)")
+    ap.add_argument("--freq", type=float, nargs="+", default=[1400.0])
+    ap.add_argument("--obs", default="gbt")
+    ap.add_argument("--addnoise", action="store_true")
+    ap.add_argument("--wideband", action="store_true")
+    ap.add_argument("--dmerror", type=float, default=1e-4)
+    ap.add_argument("--seed", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import (make_fake_toas_fromtim,
+                                     make_fake_toas_uniform)
+
+    model = get_model(args.parfile)
+    rng = np.random.default_rng(args.seed)
+    if args.inputtim:
+        ts = make_fake_toas_fromtim(args.inputtim, model,
+                                    add_noise=args.addnoise, rng=rng)
+    else:
+        ts = make_fake_toas_uniform(
+            args.startMJD, args.startMJD + args.duration, args.ntoa, model,
+            freq=np.array(args.freq), obs=args.obs, error_us=args.error,
+            add_noise=args.addnoise, wideband=args.wideband, rng=rng)
+    ts.write_TOA_file(args.timfile)
+    print(f"Wrote {len(ts)} simulated TOAs to {args.timfile}")
+    return 0

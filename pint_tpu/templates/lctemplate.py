@@ -132,9 +132,13 @@ def prim_io(template: str):
     if not (len(phass) == len(ampls) == len(fwhms)) or not phass:
         raise ValueError(f"Malformed gaussian template file {template}")
     prims = [LCGaussian([f / 2.35482, ph % 1.0]) for ph, f in zip(phass, fwhms)]
-    total = sum(ampls)
-    norms = [a / max(total, 1.0) if total > 1 else a for a in ampls]
-    return prims, norms
+    norms = np.asarray(ampls, dtype=np.float64)
+    total = norms.sum()
+    if total > 1.0:
+        # renormalize with a 1-ulp margin: a/total can still sum above 1.0
+        # in float64, which NormAngles rightly rejects
+        norms = norms / (total * (1.0 + 1e-12))
+    return prims, list(norms)
 
 
 def gauss_template_from_file(fname: str) -> LCTemplate:

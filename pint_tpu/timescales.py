@@ -73,6 +73,15 @@ def utc_to_tt_mjd(utc_mjd):
     return utc_mjd + np.asarray(dt, dtype=np.longdouble) / np.longdouble(86400.0)
 
 
+def tt_to_utc_mjd(tt_mjd):
+    """TT MJD -> UTC MJD (inverse of utc_to_tt_mjd; TT-UTC evaluated at the
+    TT epoch is exact away from a leap-second boundary, where the offset is
+    constant over the ~69 s difference anyway)."""
+    tt_mjd = np.asarray(tt_mjd, dtype=np.longdouble)
+    dt = tt_minus_utc(np.asarray(tt_mjd, dtype=np.float64)).reshape(tt_mjd.shape)
+    return tt_mjd - np.asarray(dt, dtype=np.longdouble) / np.longdouble(86400.0)
+
+
 # Truncated analytic TDB-TT series (geocentric).  Terms: (amplitude_s,
 # frequency_rad_per_julian_century, phase_rad); the classic leading terms of
 # the Fairhead & Bretagnon (1990) series as tabulated in the Astronomical

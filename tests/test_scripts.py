@@ -1,0 +1,250 @@
+"""CLI entry points invoked in-process (the reference's CLI test strategy,
+SURVEY §4: "each script has a test invoking main(argv)")."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+NGC_TIM = "/root/reference/src/pint/data/examples/NGC6440E.tim"
+
+PAR = """
+PSR  J0030+0451
+RAJ  00:30:27.4 1
+DECJ 04:51:39.7 1
+POSEPOCH 55000
+F0   205.53069 1
+F1   -4.3e-16 1
+PEPOCH 55000
+DM   4.33 1
+UNITS TDB
+"""
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    par = d / "sim.par"
+    par.write_text(PAR)
+    # simulate a tim file via zima
+    from pint_tpu.scripts import zima
+
+    tim = d / "sim.tim"
+    assert zima.main([str(par), str(tim), "--startMJD", "55000",
+                      "--duration", "200", "--ntoa", "40",
+                      "--error", "1.5", "--addnoise", "--seed", "42"]) == 0
+    assert tim.exists()
+    return d
+
+
+class TestFitAndConvertCLIs:
+    def test_pintempo(self, workdir, capsys):
+        from pint_tpu.scripts import pintempo
+
+        out = workdir / "post.par"
+        assert pintempo.main([str(workdir / "sim.par"),
+                              str(workdir / "sim.tim"),
+                              "--outfile", str(out)]) == 0
+        cap = capsys.readouterr().out
+        assert "Postfit residuals" in cap
+        assert out.exists()
+        from pint_tpu.models import get_model
+
+        m = get_model(str(out))
+        assert abs(float(m.F0.value) - 205.53069) < 1e-6
+
+    def test_pintbary(self, capsys):
+        from pint_tpu.scripts import pintbary
+
+        assert pintbary.main(["55500.0", "--obs", "gbt",
+                              "--ra", "00:30:27.4", "--dec", "04:51:39.7",
+                              "--freq", "1400", "--dm", "4.33"]) == 0
+        val = float(capsys.readouterr().out.strip())
+        # barycentric time within +/-10 min of topocentric (Roemer + TDB)
+        assert abs(val - 55500.0) < 0.01
+
+    def test_convert_parfile_binary(self, workdir, tmp_path):
+        from pint_tpu.scripts import convert_parfile
+
+        bpar = tmp_path / "bin.par"
+        bpar.write_text(PAR + "BINARY ELL1\nPB 4.5\nA1 8.2\nTASC 54999.1\n"
+                        "EPS1 2e-6\nEPS2 -1e-6\n")
+        out = tmp_path / "dd.par"
+        assert convert_parfile.main([str(bpar), "-o", str(out),
+                                     "--binary", "DD"]) == 0
+        text = out.read_text()
+        assert "BINARY" in text and "DD" in text
+        assert "ECC" in text and "T0" in text
+
+    def test_compare_parfiles(self, workdir, capsys):
+        from pint_tpu.scripts import compare_parfiles
+
+        assert compare_parfiles.main([str(workdir / "sim.par"),
+                                      str(workdir / "sim.par")]) == 0
+        assert "F0" in capsys.readouterr().out
+
+    def test_tcb2tdb(self, tmp_path, capsys):
+        from pint_tpu.scripts import tcb2tdb
+
+        tcb = tmp_path / "tcb.par"
+        tcb.write_text(PAR.replace("UNITS TDB", "UNITS TCB"))
+        out = tmp_path / "tdb.par"
+        assert tcb2tdb.main([str(tcb), str(out)]) == 0
+        from pint_tpu.models import get_model
+
+        m = get_model(str(out))
+        assert m.UNITS.value == "TDB"
+        # F0 scaled by 1/IFTE_K (n=1): relative change 1.55e-8
+        assert float(m.F0.value) / 205.53069 == pytest.approx(
+            1 - 1.55051979176e-8, rel=1e-12)
+
+    def test_pintpublish(self, workdir, capsys):
+        from pint_tpu.scripts import pintpublish
+
+        assert pintpublish.main([str(workdir / "sim.par"),
+                                 str(workdir / "sim.tim")]) == 0
+        assert r"\begin{table}" in capsys.readouterr().out
+
+
+class TestPhotonCLIs:
+    @pytest.fixture(scope="class")
+    def eventfile(self, tmp_path_factory):
+        from test_photon_domain import make_event_fits
+
+        d = tmp_path_factory.mktemp("events")
+        p = d / "events.fits"
+        # pulsed photons for the NGC-like model: uniform MET, phases pulled
+        # to a peak by construction below is unnecessary; H-test just runs
+        rng = np.random.default_rng(1)
+        met = np.sort(rng.random(400)) * 86400 * 10
+        make_event_fits(str(p), met, rng.random(400) * 1000)
+        par = d / "phot.par"
+        par.write_text(PAR)
+        gauss = d / "template.gauss"
+        gauss.write_text("const = 0.4\nphas1 = 0.5\nfwhm1 = 0.1\nampl1 = 0.6\n")
+        return d
+
+    def test_photonphase(self, eventfile, capsys, tmp_path):
+        from pint_tpu.scripts import photonphase
+
+        out = tmp_path / "phases.txt"
+        assert photonphase.main([str(eventfile / "events.fits"),
+                                 str(eventfile / "phot.par"),
+                                 "--outfile", str(out)]) == 0
+        assert "Htest" in capsys.readouterr().out
+        assert out.exists()
+
+    def test_event_optimize(self, eventfile, capsys, tmp_path):
+        from pint_tpu.scripts import event_optimize
+
+        os.chdir(tmp_path)
+        assert event_optimize.main(
+            [str(eventfile / "events.fits"), str(eventfile / "phot.par"),
+             str(eventfile / "template.gauss"),
+             "--nwalkers", "8", "--nsteps", "12", "--burnin", "4",
+             "--seed", "3", "--outbase", str(tmp_path / "eo")]) == 0
+        assert (tmp_path / "eo.par").exists()
+        assert (tmp_path / "eo_chain.npy").exists()
+
+    def test_read_gaussfitfile(self, eventfile):
+        from pint_tpu.scripts.event_optimize import read_gaussfitfile
+
+        tmpl = read_gaussfitfile(str(eventfile / "template.gauss"), 64)
+        assert len(tmpl) == 64
+        # peak rotated to phase 0
+        assert np.argmax(tmpl) in (0, 63)
+
+
+class TestPintkCore:
+    def test_pulsar_wrapper(self, workdir):
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        psr = Pulsar(str(workdir / "sim.par"), str(workdir / "sim.tim"))
+        assert psr.name == "J0030+0451"
+        assert len(psr.all_toas) == 40
+        c0 = psr.resids().chi2
+        chi2 = psr.fit()
+        assert chi2 <= c0 + 1e-6
+        assert psr.fitted
+        assert "F0" in psr.write_fit_summary()
+
+    def test_phase_wrap_and_jump(self, workdir):
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        psr = Pulsar(str(workdir / "sim.par"), str(workdir / "sim.tim"))
+        mask = np.zeros(len(psr.all_toas), dtype=bool)
+        mask[:10] = True
+        r0 = np.asarray(psr.resids().time_resids)
+        psr.add_phase_wrap(mask, 1)
+        r1 = np.asarray(psr.resids().time_resids)
+        P = 1.0 / 205.53069
+        assert np.allclose(np.abs(r1[:10] - r0[:10]).mean(), P, rtol=0.3)
+        name = psr.add_jump(mask)
+        assert name in psr.model.params
+        assert name in psr.model.free_params
+
+    def test_pintk_cli_test_mode(self, workdir, capsys):
+        from pint_tpu.scripts import pintk
+
+        assert pintk.main([str(workdir / "sim.par"),
+                           str(workdir / "sim.tim"), "--test", "--fit"]) == 0
+        assert "pintk --test" in capsys.readouterr().out
+
+    def test_delete_and_select(self, workdir):
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        psr = Pulsar(str(workdir / "sim.par"), str(workdir / "sim.tim"))
+        psr.select_toas(np.arange(5))
+        assert len(psr.selected_toas) == 5
+        psr.delete_TOAs([0, 1])
+        assert len(psr.all_toas) == 38
+
+
+class TestReviewRegressions:
+    def test_tt_geocentric_events_not_double_converted(self, tmp_path):
+        """TIMESYS=TT + TIMEREF=GEOCENTRIC events must not get the UTC->TT
+        chain applied twice (~69 s error)."""
+        from test_photon_domain import make_event_fits
+
+        from pint_tpu.event_toas import get_fits_TOAs
+        from pint_tpu.timescales import utc_to_tt_mjd
+
+        p = str(tmp_path / "geo.fits")
+        met = np.array([0.0, 86400.0])
+        make_event_fits(p, met, np.zeros(2), timesys="TT",
+                        timeref="GEOCENTRIC")
+        ts = get_fits_TOAs(p, mission="nicer")
+        # TT(utc_mjd) must reproduce the original TT event times
+        tt = utc_to_tt_mjd(ts.utc_mjd)
+        expect = 56658.000777592592593 + met / 86400.0
+        np.testing.assert_allclose(np.asarray(tt, dtype=float), expect,
+                                   rtol=0, atol=2e-9)
+
+    def test_fmt_uncertainty_large_error(self):
+        from pint_tpu.output.publish import _fmt_uncertainty
+
+        assert _fmt_uncertainty(1234.5, 300.0) == "1234(300)"
+        assert _fmt_uncertainty(1.234567, 0.00012) == "1.23457(12)"
+
+    def test_polyco_writer_negative_frac(self, tmp_path):
+        from pint_tpu.polycos import PolycoEntry, Polycos
+
+        e = PolycoEntry(55000.5, 60.0, 12345, -0.3, 100.0, 3,
+                        [0.0, 0.0, 0.0], obs="gbt")
+        f = str(tmp_path / "p.dat")
+        Polycos([e]).write_polyco_file(f)
+        p2 = Polycos.read_polyco_file(f)
+        got = p2.entries[0].rphase_int + p2.entries[0].rphase_frac
+        assert got == pytest.approx(12344.7, abs=1e-6)
+
+    def test_gauss_template_overnormalized(self, tmp_path):
+        from pint_tpu.templates import gauss_template_from_file
+
+        p = tmp_path / "g.txt"
+        p.write_text("phas1 = 0.40181682221254356\nfwhm1 = 0.05\n"
+                     "ampl1 = 0.40181682221254356\n"
+                     "phas2 = 0.2\nfwhm2 = 0.08\nampl2 = 0.6785150052419683\n")
+        t = gauss_template_from_file(str(p))  # must not raise
+        assert t.norms().sum() <= 1.0
