@@ -390,3 +390,71 @@ class BinaryELL1k(BinaryELL1):
 
     def binary_delay(self, pv, tt0):
         return eng.ell1k_delay(pv, tt0, orbits_fn=self._orbits_fn())
+
+
+class BinaryBT_piecewise(BinaryBT):
+    """BT with piecewise orbital parameters: per-range T0X_xxxx/A1X_xxxx
+    overrides selected by [XR1_xxxx, XR2_xxxx] MJD windows (reference
+    ``binary_bt.py:85 BinaryBTPiecewise``).
+
+    Piece epochs are float64 MJD (sub-us T0 resolution), applied as exact
+    float differences against the dd-precision global T0.
+    """
+
+    register = True
+    binary_model_name = "BT_piecewise"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter("T0X_0001", units="MJD",
+                                       description="Piecewise T0 override"))
+        self.add_param(prefixParameter("A1X_0001", units="ls",
+                                       description="Piecewise A1 override"))
+        self.add_param(prefixParameter("XR1_0001", units="MJD",
+                                       description="Piece start MJD"))
+        self.add_param(prefixParameter("XR2_0001", units="MJD",
+                                       description="Piece end MJD"))
+        self.piece_indices = []
+
+    def setup(self):
+        super().setup()
+        self.piece_indices = sorted(
+            int(p[4:]) for p in self.params
+            if p.startswith("T0X_") and self._params_dict[p].value is not None)
+
+    def validate(self):
+        super().validate()
+        for i in self.piece_indices:
+            for pre in ("XR1_", "XR2_"):
+                nm = f"{pre}{i:04d}"
+                if nm not in self._params_dict or \
+                        self._params_dict[nm].value is None:
+                    raise MissingParameter("BinaryBT_piecewise", nm)
+
+    def build_context(self, toas):
+        mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+        masks = []
+        for i in self.piece_indices:
+            r1 = float(self._params_dict[f"XR1_{i:04d}"].value)
+            r2 = float(self._params_dict[f"XR2_{i:04d}"].value)
+            masks.append(((mjds >= r1) & (mjds < r2)).astype(np.float64))
+        return {"masks": jnp.asarray(np.array(masks)) if masks else None}
+
+    def delay_func(self, pv, batch, ctx, acc_delay):
+        tt0 = self._tt0(pv, batch, acc_delay)
+        if ctx.get("masks") is None:
+            return self.binary_delay(pv, tt0)
+        t0 = pv["T0"]
+        t0_hi = t0.hi if hasattr(t0, "hi") else t0
+        t0_lo = t0.lo if hasattr(t0, "lo") else 0.0
+        a1 = pv.get("A1", 0.0) * jnp.ones_like(tt0)
+        for k, i in enumerate(self.piece_indices):
+            m = ctx["masks"][k]
+            # exact float difference against the dd global T0 (values are
+            # close, so the subtraction cancels without rounding)
+            dt_days = (t0_hi - pv.get(f"T0X_{i:04d}", 0.0)) + t0_lo
+            tt0 = tt0 + m * dt_days * DAY_S
+            a1 = a1 + m * (pv.get(f"A1X_{i:04d}", 0.0) - pv.get("A1", 0.0))
+        pv2 = dict(pv)
+        pv2["A1"] = a1
+        return self.binary_delay(pv2, tt0)

@@ -1,0 +1,74 @@
+"""Global clock-correction repository access.
+
+Counterpart of reference ``global_clock_corrections.py:40,150,229``
+(``get_clock_correction_file``/``Index``/``update_all``).  The reference
+downloads versioned clock files from the IPTA github repository; this
+deployment is zero-egress, so files are resolved from local mirrors instead:
+``$PINT_CLOCK_DIR``, ``$TEMPO2/clock``, ``$TEMPO/clock`` — the same override
+mechanism the reference honors before downloading.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from pint_tpu.logging import log
+
+__all__ = ["Index", "get_clock_correction_file", "update_all",
+           "clock_search_dirs"]
+
+
+def clock_search_dirs() -> List[str]:
+    dirs = []
+    if os.environ.get("PINT_CLOCK_DIR"):
+        dirs.append(os.environ["PINT_CLOCK_DIR"])
+    if os.environ.get("TEMPO2"):
+        dirs.append(os.path.join(os.environ["TEMPO2"], "clock"))
+    if os.environ.get("TEMPO"):
+        dirs.append(os.path.join(os.environ["TEMPO"], "clock"))
+    return [d for d in dirs if os.path.isdir(d)]
+
+
+class Index:
+    """Parser for the repository's index.txt: file -> (update interval,
+    invalid-if-older-than) rows (reference ``global_clock_corrections.py:150``)."""
+
+    def __init__(self, path: str):
+        self.files: Dict[str, dict] = {}
+        with open(path) as f:
+            for line in f:
+                if line.startswith("#") or not line.strip():
+                    continue
+                parts = line.split()
+                if len(parts) >= 2:
+                    self.files[parts[0]] = {
+                        "update_interval_days": float(parts[1]),
+                        "invalid_if_older_than": (parts[2] if len(parts) > 2
+                                                  else None),
+                    }
+
+
+def get_clock_correction_file(filename: str,
+                              download_policy: str = "if_missing",
+                              url_base: Optional[str] = None) -> Optional[str]:
+    """Resolve a named clock file from the local mirror directories
+    (reference ``get_file``; downloading is unavailable in zero-egress
+    deployments, so a missing file returns None with a warning)."""
+    for d in clock_search_dirs():
+        cand = os.path.join(d, filename)
+        if os.path.exists(cand):
+            return cand
+    if download_policy != "never":
+        log.warning(
+            f"Clock file {filename} not found locally and this deployment "
+            "cannot download (zero egress); set $PINT_CLOCK_DIR to a mirror "
+            "of https://ipta.github.io/pulsar-clock-corrections/")
+    return None
+
+
+def update_all(export_dir: Optional[str] = None, **kw):
+    """Reference parity stub: refreshes would require network access."""
+    log.warning("update_all: no network access in this deployment; clock "
+                "files must be mirrored via $PINT_CLOCK_DIR")

@@ -1,0 +1,141 @@
+"""Orbiting observatories: spacecraft position from orbit files.
+
+Counterpart of reference ``satellite_obs.py:283 SatelliteObs`` /
+``:87 load_FPorbit`` / ``:427 get_satellite_observatory``: load a Fermi FT2,
+generic FPorbit, or nuSTAR orbit file, spline-interpolate the geocentric ECI
+(J2000) position to TOA epochs, and compose with the Earth's SSB position.
+
+Orbit files are FITS BINTABLEs read with the native
+:mod:`pint_tpu.fits_utils` reader (no astropy in this deployment).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+from scipy.interpolate import CubicSpline
+
+from pint_tpu import ephemeris as ephem_mod
+from pint_tpu.fits_utils import FITSHDU, read_fits
+from pint_tpu.logging import log
+from pint_tpu.observatory import Observatory, _registry
+from pint_tpu.utils import PosVel
+
+__all__ = ["SatelliteObs", "load_FT2", "load_FPorbit", "load_nustar_orbit",
+           "get_satellite_observatory"]
+
+
+def _find_orbit_hdu(hdus) -> FITSHDU:
+    for name in ("SC_DATA", "ORBIT", "PREFILTER", "ORBIT_DATA"):
+        for h in hdus:
+            if h.name.upper() == name:
+                return h
+    for h in hdus[1:]:
+        if h.is_bintable:
+            return h
+    raise ValueError("No orbit extension found")
+
+
+def _mjds_of(hdu: FITSHDU, timecol: str) -> np.ndarray:
+    from pint_tpu.fits_utils import _mjdref
+
+    hdr = hdu.header
+    mjdref = _mjdref(hdr)
+    tz = float(hdr.get("TIMEZERO", 0.0))
+    met = hdu.data()[timecol].astype(np.float64)
+    return np.asarray(mjdref, dtype=np.float64) + (met + tz) / 86400.0
+
+
+def load_FT2(ft2name: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(mjds_tt, positions_km) from a Fermi FT2 file (SC_POSITION in m,
+    ECI J2000; reference ``satellite_obs.py:39 load_FT2``)."""
+    hdu = _find_orbit_hdu(read_fits(ft2name))
+    data = hdu.data()
+    mjds = _mjds_of(hdu, "START")
+    pos_km = np.asarray(data["SC_POSITION"], dtype=np.float64) / 1e3
+    return mjds, pos_km
+
+
+def load_FPorbit(orbit_filename: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(mjds_tt, positions_km) from an FPorbit file (X/Y/Z in m;
+    reference ``satellite_obs.py:87``)."""
+    hdu = _find_orbit_hdu(read_fits(orbit_filename))
+    data = hdu.data()
+    mjds = _mjds_of(hdu, "TIME")
+    pos_km = np.column_stack([data["X"], data["Y"], data["Z"]]) \
+        .astype(np.float64) / 1e3
+    order = np.argsort(mjds)
+    return mjds[order], pos_km[order]
+
+
+def load_nustar_orbit(orb_filename: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(mjds_tt, positions_km) from a nuSTAR .orb file (POSITION in km;
+    reference ``satellite_obs.py:~200``)."""
+    hdu = _find_orbit_hdu(read_fits(orb_filename))
+    data = hdu.data()
+    mjds = _mjds_of(hdu, "TIME")
+    colname = "POSITION" if "POSITION" in data else "SC_POSITION"
+    pos_km = np.asarray(data[colname], dtype=np.float64)
+    return mjds, pos_km
+
+
+_LOADERS = {"FT2": load_FT2, "FPORBIT": load_FPorbit, "ORB": load_nustar_orbit}
+
+
+class SatelliteObs(Observatory):
+    """Observatory on an orbit file: geocentric ECI position splined to TOA
+    epochs (reference ``satellite_obs.py:283``)."""
+
+    def __init__(self, name: str, ft2name: str, fmt: str = "FT2",
+                 maxextrap: float = 2.0):
+        super().__init__(name, include_gps=False, include_bipm=False)
+        loader = _LOADERS.get(fmt.upper(), load_FPorbit)
+        self._mjds, self._pos_km = loader(ft2name)
+        if len(self._mjds) < 4:
+            raise ValueError("Orbit file has too few rows to interpolate")
+        self.maxextrap = maxextrap / 1440.0  # minutes -> days
+        self._spline = CubicSpline(self._mjds, self._pos_km, axis=0)
+        self._dspline = self._spline.derivative()
+
+    def clock_corrections(self, utc_mjd, **kw):
+        # spacecraft event times carry no ground-clock chain
+        return np.zeros_like(np.atleast_1d(np.asarray(utc_mjd,
+                                                      dtype=np.float64)))
+
+    def _check_bounds(self, t):
+        lo, hi = self._mjds[0], self._mjds[-1]
+        if np.any(t < lo - self.maxextrap) or np.any(t > hi + self.maxextrap):
+            raise ValueError(
+                f"TOA epochs outside orbit file span [{lo:.3f}, {hi:.3f}] "
+                f"(+/- {self.maxextrap * 1440:.0f} min)")
+
+    def get_gcrs(self, utc_mjd, tt_mjd=None):
+        """Geocentric position/velocity [m, m/s] at the given epochs."""
+        t = np.atleast_1d(np.asarray(tt_mjd if tt_mjd is not None
+                                     else utc_mjd, dtype=np.float64))
+        self._check_bounds(t)
+        pos_m = self._spline(t) * 1e3
+        vel_ms = self._dspline(t) * 1e3 / 86400.0
+        return pos_m, vel_ms
+
+    def posvel(self, utc_mjd, tdb_mjd, ephem: str = "DE440") -> PosVel:
+        eph = ephem_mod.load_ephemeris(ephem)
+        tdb = np.atleast_1d(np.asarray(tdb_mjd, dtype=np.float64))
+        epos, evel = eph.posvel_ssb("earth", tdb)
+        spos_m, svel_ms = self.get_gcrs(utc_mjd, tt_mjd=tdb)
+        return PosVel(epos + spos_m / 1e3, evel + svel_ms / 1e3,
+                      obj=self.name, origin="ssb")
+
+
+def get_satellite_observatory(name: str, ft2name: str, fmt: str = "FT2",
+                              overwrite: bool = False, **kw) -> SatelliteObs:
+    """Create and register a satellite observatory
+    (reference ``satellite_obs.py:427``)."""
+    key = name.lower()
+    if key in _registry and not overwrite:
+        log.warning(f"Observatory {name} already registered; returning it "
+                    "(pass overwrite=True to reload)")
+        return _registry[key]
+    obs = SatelliteObs(name, ft2name, fmt=fmt, **kw)
+    return obs

@@ -100,13 +100,11 @@ class TOAs:
     @classmethod
     def from_raw(cls, raw: List[RawTOA], commands=None, filename=None) -> "TOAs":
         n = len(raw)
-        utc = np.empty(n, dtype=np.longdouble)
         err = np.empty(n, dtype=np.float64)
         freq = np.empty(n, dtype=np.float64)
         obs = np.empty(n, dtype=object)
         flags = []
         for i, t in enumerate(raw):
-            utc[i] = t.mjd_longdouble()
             err[i] = t.error_us
             freq[i] = t.freq_mhz if t.freq_mhz > 0 else np.inf
             obs[i] = get_observatory(t.obs).name
@@ -114,7 +112,28 @@ class TOAs:
             if t.name:
                 fl.setdefault("name", t.name)
             flags.append(fl)
+        utc = cls._mjds_from_raw(raw)
         return cls(utc, err, freq, obs, flags, commands or [], filename)
+
+    @staticmethod
+    def _mjds_from_raw(raw: List[RawTOA]) -> np.ndarray:
+        """MJD strings -> longdouble.
+
+        Platforms whose longdouble is just double (arm64: eps > 2e-19, the
+        check the reference makes at ``pulsar_mjd.py:47-59`` before
+        refusing to run) route through the native C++ dd parser instead
+        (exact to 2^-106); x87 platforms use the numpy longdouble parser,
+        which is both adequate and faster."""
+        from pint_tpu import native
+
+        longdouble_ok = np.finfo(np.longdouble).eps < 2e-19
+        if not longdouble_ok and native.available():
+            hi, lo = native.str2dd_batch(
+                [f"{t.mjd_int}.{t.mjd_frac_str}" for t in raw])
+            return (np.asarray(hi, dtype=np.longdouble)
+                    + np.asarray(lo, dtype=np.longdouble))
+        return np.array([t.mjd_longdouble() for t in raw],
+                        dtype=np.longdouble)
 
     def __len__(self) -> int:
         return len(self.utc_mjd)

@@ -1,0 +1,207 @@
+"""Long-tail infrastructure: TOASelect caching, satellite observatories,
+global clock corrections, BT_piecewise binary, TCB conversion."""
+
+import io
+import struct
+
+import numpy as np
+import pytest
+
+
+class TestTOASelect:
+    def test_range_and_nonrange(self):
+        from pint_tpu.toa_select import TOASelect
+
+        mjds = np.array([100.0, 200.0, 300.0, 400.0])
+        sel = TOASelect(is_range=True)
+        r = sel.get_select_index({"DMX_0001": (150, 350)}, mjds)
+        np.testing.assert_array_equal(r["DMX_0001"], [1, 2])
+        names = np.array(["430", "Lband", "430", "820"], dtype=object)
+        sel2 = TOASelect(is_range=False)
+        r2 = sel2.get_select_index({"JUMP1": "430"}, names)
+        np.testing.assert_array_equal(r2["JUMP1"], [0, 2])
+
+    def test_cache_hits(self):
+        from pint_tpu.toa_select import TOASelect
+
+        mjds = np.arange(1000.0)
+        sel = TOASelect(is_range=True)
+        r1 = sel.get_select_index({"a": (10, 20)}, mjds)
+        r2 = sel.get_select_index({"a": (10, 20)}, mjds)
+        assert r1 is r2  # cached object returned
+        r3 = sel.get_select_index({"a": (10, 30)}, mjds)
+        assert len(r3["a"]) > len(r1["a"])
+
+
+def _orbit_fits(path, mjds_tt, pos_km):
+    """Minimal FPorbit-style FITS (TIME, X, Y, Z in meters)."""
+    from test_photon_domain import _card, _pad
+
+    met = (np.asarray(mjds_tt) - 50000.0) * 86400.0
+    hdr0 = b"".join([_card("SIMPLE", True), _card("BITPIX", 8),
+                     _card("NAXIS", 0), b"END".ljust(80)])
+    rows = b"".join(struct.pack(">dddd", t, *(p * 1e3))
+                    for t, p in zip(met, pos_km))
+    hdr1 = b"".join([
+        _card("XTENSION", "BINTABLE"), _card("BITPIX", 8), _card("NAXIS", 2),
+        _card("NAXIS1", 32), _card("NAXIS2", len(met)), _card("PCOUNT", 0),
+        _card("GCOUNT", 1), _card("TFIELDS", 4),
+        _card("TTYPE1", "TIME"), _card("TFORM1", "D"),
+        _card("TTYPE2", "X"), _card("TFORM2", "D"),
+        _card("TTYPE3", "Y"), _card("TFORM3", "D"),
+        _card("TTYPE4", "Z"), _card("TFORM4", "D"),
+        _card("EXTNAME", "ORBIT"), _card("MJDREFI", 50000),
+        _card("MJDREFF", 0.0), _card("TIMESYS", "TT"), b"END".ljust(80),
+    ])
+    data = rows + b"\0" * ((len(rows) + 2879) // 2880 * 2880 - len(rows))
+    with open(path, "wb") as f:
+        f.write(_pad(hdr0).replace(b"\0", b" "))
+        f.write(_pad(hdr1).replace(b"\0", b" "))
+        f.write(data)
+
+
+class TestSatelliteObs:
+    def test_orbit_interpolation(self, tmp_path):
+        from pint_tpu.observatory.satellite_obs import get_satellite_observatory
+
+        # circular LEO: 7000 km radius, 98-min period
+        t = 55000.0 + np.linspace(0, 0.2, 200)
+        w = 2 * np.pi / (98.0 / 1440.0)
+        pos = 7000.0 * np.column_stack([
+            np.cos(w * (t - t[0])), np.sin(w * (t - t[0])), np.zeros_like(t)])
+        f = str(tmp_path / "orbit.fits")
+        _orbit_fits(f, t, pos)
+        obs = get_satellite_observatory("TESTSAT", f, fmt="FPORBIT")
+        tq = np.array([55000.05, 55000.1])
+        p_m, v_ms = obs.get_gcrs(tq, tt_mjd=tq)
+        # radius preserved by the spline
+        np.testing.assert_allclose(np.linalg.norm(p_m, axis=1), 7.0e6,
+                                   rtol=1e-4)
+        # orbital speed = w * r
+        np.testing.assert_allclose(np.linalg.norm(v_ms, axis=1),
+                                   w * 7.0e6 / 86400.0, rtol=1e-3)
+        # ssb posvel composes the Earth position
+        pv = obs.posvel(tq, tq)
+        assert np.all(np.linalg.norm(pv.pos, axis=1) > 1e8)  # ~1 AU in km
+        with pytest.raises(ValueError, match="outside orbit"):
+            obs.get_gcrs(np.array([55010.0]))
+
+    def test_registry(self, tmp_path):
+        from pint_tpu.observatory import get_observatory
+        from pint_tpu.observatory.satellite_obs import get_satellite_observatory
+
+        t = 55000.0 + np.linspace(0, 0.1, 50)
+        pos = np.tile([7000.0, 0, 0], (50, 1))
+        f = str(tmp_path / "o.fits")
+        _orbit_fits(f, t, pos)
+        get_satellite_observatory("TESTSAT2", f, fmt="FPORBIT")
+        assert get_observatory("testsat2").name == "testsat2"
+
+
+class TestGlobalClock:
+    def test_local_mirror(self, tmp_path, monkeypatch):
+        from pint_tpu.observatory.global_clock_corrections import (
+            Index, clock_search_dirs, get_clock_correction_file)
+
+        d = tmp_path / "mirror"
+        d.mkdir()
+        (d / "time_gbt.dat").write_text("# clock\n")
+        (d / "index.txt").write_text(
+            "# file interval invalid\ntime_gbt.dat 7.0\n")
+        monkeypatch.setenv("PINT_CLOCK_DIR", str(d))
+        assert str(d) in clock_search_dirs()
+        assert get_clock_correction_file("time_gbt.dat") is not None
+        assert get_clock_correction_file("missing.dat") is None
+        idx = Index(str(d / "index.txt"))
+        assert idx.files["time_gbt.dat"]["update_interval_days"] == 7.0
+
+
+class TestBTPiecewise:
+    PAR = """
+PSR  J1023+0038
+RAJ  10:23:47.68 1
+DECJ 00:38:40.8
+POSEPOCH 55000
+F0   592.42145 1
+PEPOCH 55000
+DM   14.325
+BINARY BT_piecewise
+PB   0.1980963 1
+A1   0.343356 1
+T0   55000.02 1
+ECC  0.0
+OM   0.0
+T0X_0001 55000.0200002
+A1X_0001 0.343360
+XR1_0001 55010.0
+XR2_0001 55020.0
+UNITS TDB
+"""
+
+    def test_piecewise_applies_in_range(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(io.StringIO(self.PAR))
+        assert "BinaryBT_piecewise" in m.components
+        ts = make_fake_toas_uniform(55005, 55025, 40, m, error_us=1.0)
+        # same par without the piece
+        base = self.PAR
+        for ln in ("T0X_0001 55000.0200002\n", "A1X_0001 0.343360\n",
+                   "XR1_0001 55010.0\n", "XR2_0001 55020.0\n"):
+            base = base.replace(ln, "")
+        m0 = get_model(io.StringIO(base.replace("BT_piecewise", "BT")))
+        d1 = np.asarray(m.delay(ts))
+        d0 = np.asarray(m0.delay(ts))
+        mjds = np.asarray(ts.get_mjds(), dtype=float)
+        inr = (mjds >= 55010.0) & (mjds < 55020.0)
+        # outside the piece the two models agree exactly
+        np.testing.assert_allclose(d1[~inr], d0[~inr], atol=1e-12)
+        # inside, the A1/T0 overrides shift the delay (the shift oscillates
+        # with orbital phase, so test the aggregate, not every epoch)
+        dd = np.abs(d1[inr] - d0[inr])
+        assert dd.max() > 1e-6
+        assert dd.mean() > 3e-7
+
+    def test_fit_recovers_piece_a1(self):
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(io.StringIO(self.PAR))
+        ts = make_fake_toas_uniform(55005, 55025, 60, m, error_us=1.0,
+                                    add_noise=True,
+                                    rng=np.random.default_rng(0))
+        m2 = get_model(io.StringIO(self.PAR))
+        m2.A1X_0001.value = 0.343356  # forget the override
+        m2.free_params = ["A1X_0001"]
+        f = WLSFitter(ts, m2)
+        f.fit_toas(maxiter=3)
+        assert float(f.model.A1X_0001.value) == pytest.approx(0.343360,
+                                                              abs=3e-6)
+
+
+class TestTCBConversion:
+    def test_roundtrip(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.models.tcb_conversion import IFTE_K, convert_tcb_tdb
+
+        par = ("PSR J0\nRAJ 10:00:00\nDECJ 10:00:00\nPOSEPOCH 55000\n"
+               "F0 100.0 1\nF1 -1e-14\nPEPOCH 55000\nDM 10.0\nUNITS TCB\n")
+        m = get_model(io.StringIO(par), allow_tcb=True)
+        f0_tcb = float(m.F0.value)
+        pepoch_tcb = float(m.PEPOCH.value)
+        convert_tcb_tdb(m)
+        assert m.UNITS.value == "TDB"
+        assert float(m.F0.value) == pytest.approx(f0_tcb / float(IFTE_K),
+                                                  rel=1e-14)
+        assert float(m.PEPOCH.value) < pepoch_tcb  # pulled toward IFTE_MJD0
+        # F1 scales by K^-2
+        assert float(m.F1.value) == pytest.approx(-1e-14 / float(IFTE_K) ** 2,
+                                                  rel=1e-12)
+        # DM scales by K^-1
+        assert float(m.DM.value) == pytest.approx(10.0 / float(IFTE_K),
+                                                  rel=1e-14)
+        convert_tcb_tdb(m, backwards=True)
+        assert float(m.F0.value) == pytest.approx(f0_tcb, rel=1e-14)
+        assert float(m.PEPOCH.value) == pytest.approx(pepoch_tcb, abs=1e-9)
