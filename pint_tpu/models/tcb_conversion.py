@@ -23,11 +23,13 @@ IFTE_MJD0 = np.longdouble("43144.0003725")
 IFTE_KM1 = np.longdouble("1.55051979176e-8")
 IFTE_K = np.longdouble(1.0) + IFTE_KM1
 
-#: effective time-dimensionality rules: exact names, then regex families.
-#: x appears in the model as x * (time)^dim, so x_tdb = x_tcb * K^(-dim)...
-#: following the reference convention n_eff listed here equals the parameter's
-#: frequency-dimensionality (F0 -> 1, F1 -> 2, A1 -> -1 because it enters
-#: as a time).
+#: effective dimensionality rules: exact names, then regex families.
+#: The table lists each parameter's frequency-dimensionality (F0 -> 1,
+#: F1 -> 2, A1 -> -1 because it enters as a time).  TCB seconds are shorter
+#: than TDB seconds by IFTE_K, so frequencies grow under TCB->TDB:
+#: x_tdb = x_tcb * K^dim (equivalently x_tcb / K^n with n the
+#: time-dimensionality, reference ``tcb_conversion.py`` +
+#: ``docs/tcb2tdb-factors.rst``): F0 and DM multiply by K, A1 divides by K.
 _EXACT_DIM = {
     "PX": 1, "PMRA": 1, "PMDEC": 1, "PMELONG": 1, "PMELAT": 1,
     "A1": -1, "PB": -1, "OMDOT": 1, "EDOT": 1, "M2": -1, "MTOT": -1,
@@ -111,7 +113,7 @@ def convert_tcb_tdb(model, backwards: bool = False):
             continue
         dim = _effective_dim(name)
         if dim:
-            scale_parameter(model, name, -dim, backwards)
+            scale_parameter(model, name, dim, backwards)
     model.UNITS.value = target
     model.validate(allow_tcb=backwards)
     return model

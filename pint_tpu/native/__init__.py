@@ -7,13 +7,16 @@ dependence (SURVEY §2b row 1): the dd pair carries ~106 mantissa bits (vs
 longdouble == double.  Falls back transparently to the pure-Python dd path
 when no C++ toolchain is available (``available()`` reports which).
 
-Build: ``g++/cc -O2 -fPIC -shared`` into ``_build/pint_native.so``, rebuilt
-whenever the source is newer than the cached object.
+Build: ``g++/cc -O2 -fPIC -shared`` into ``_build/pint_native_<hash>.so``,
+keyed on a SHA-256 of the source so a stale or wrong-architecture cached
+object can never be loaded (the build dir is gitignored; nothing compiled
+is committed).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 from typing import List, Optional, Tuple
@@ -28,7 +31,13 @@ __all__ = ["available", "dd_add_batch", "dd_mul_batch", "dd_div_batch",
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "_src", "pint_native.cpp")
 _BUILD_DIR = os.path.join(_HERE, "_build")
-_SO = os.path.join(_BUILD_DIR, "pint_native.so")
+
+
+def _so_path() -> str:
+    """Cache path keyed on source hash: rebuilds exactly when source changes."""
+    with open(_SRC, "rb") as f:
+        h = hashlib.sha256(f.read()).hexdigest()[:12]
+    return os.path.join(_BUILD_DIR, f"pint_native_{h}.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -37,16 +46,23 @@ _D = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
 _I64 = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 
 
-def _build() -> bool:
+def _build(so: str) -> bool:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     for cc in ("g++", "c++", "clang++"):
         try:
             r = subprocess.run(
-                [cc, "-O2", "-fPIC", "-shared", "-std=c++14", "-o", _SO, _SRC],
+                [cc, "-O2", "-fPIC", "-shared", "-std=c++14", "-o", so, _SRC],
                 capture_output=True, text=True, timeout=120)
         except (FileNotFoundError, subprocess.TimeoutExpired):
             continue
         if r.returncode == 0:
+            for old in os.listdir(_BUILD_DIR):  # drop superseded objects
+                if (old.startswith("pint_native") and old.endswith(".so")
+                        and os.path.join(_BUILD_DIR, old) != so):
+                    try:
+                        os.unlink(os.path.join(_BUILD_DIR, old))
+                    except OSError:
+                        pass
             return True
         log.warning(f"native build with {cc} failed: {r.stderr[:500]}")
     return False
@@ -58,12 +74,11 @@ def _load() -> Optional[ctypes.CDLL]:
         return _lib
     _tried = True
     try:
-        need_build = (not os.path.exists(_SO)
-                      or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
-        if need_build and not _build():
+        so = _so_path()
+        if not os.path.exists(so) and not _build(so):
             log.info("no C++ toolchain: using the pure-Python dd path")
             return None
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(so)
     except OSError as e:
         log.warning(f"could not load native kernels: {e}")
         return None
@@ -86,6 +101,16 @@ def available() -> bool:
     return _load() is not None
 
 
+def _require() -> ctypes.CDLL:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native dd kernels unavailable — no C++ toolchain could build "
+            "pint_native.cpp; call pint_tpu.native.available() first and "
+            "fall back to the pure-Python dd path (pint_tpu.dd)")
+    return lib
+
+
 def _pair(x):
     hi = np.ascontiguousarray(x[0], dtype=np.float64)
     lo = np.ascontiguousarray(x[1], dtype=np.float64)
@@ -93,7 +118,7 @@ def _pair(x):
 
 
 def _binop(name, a, b):
-    lib = _load()
+    lib = _require()
     ah, al = _pair(a)
     bh, bl = _pair(b)
     ah, bh = np.broadcast_arrays(ah, bh)
@@ -122,7 +147,7 @@ def dd_div_batch(a, b):
 
 def dd_horner_batch(coeffs: List[Tuple[float, float]], x):
     """sum_k c_k x^k with dd coefficients and dd x (batched over x)."""
-    lib = _load()
+    lib = _require()
     ch = np.ascontiguousarray([c[0] for c in coeffs], dtype=np.float64)
     cl = np.ascontiguousarray([c[1] for c in coeffs], dtype=np.float64)
     xh, xl = _pair(x)
@@ -150,7 +175,7 @@ def str2dd_batch(strings: List[str]):
     """Decimal strings -> (hi, lo) double-double, exact to 2^-106
     (the reference's ``str_to_mjds``, ``pulsar_mjd.py:488``, without
     longdouble).  Invalid entries become NaN."""
-    lib = _load()
+    lib = _require()
     buf, offsets = _pack_strings(strings)
     n = len(strings)
     oh = np.empty(n, dtype=np.float64)
@@ -163,7 +188,7 @@ def str2dd_batch(strings: List[str]):
 
 def parse_double_batch(strings: List[str]) -> np.ndarray:
     """Fast batch float parsing (fortran D exponents tolerated)."""
-    lib = _load()
+    lib = _require()
     buf, offsets = _pack_strings(strings)
     out = np.empty(len(strings), dtype=np.float64)
     bad = lib.parse_double_batch(buf, offsets, len(strings), out)

@@ -90,6 +90,13 @@ class Observatory:
         """Corrected-UTC MJD -> TDB MJD (longdouble)."""
         return utc_to_tdb_mjd(utc_mjd)
 
+    def get_TDB_offset_seconds(self, utc_mjd, method="default", ephem=None):
+        """(TDB - corrected UTC) in seconds, float64 — offset form used by
+        the degraded-longdouble pair pipeline (no absolute-MJD rounding)."""
+        from pint_tpu.timescales import utc_to_tdb_offset_seconds
+
+        return utc_to_tdb_offset_seconds(utc_mjd)
+
     # -- geometry ----------------------------------------------------------
     def earth_location_itrf(self):
         return None
@@ -170,6 +177,10 @@ class BarycenterObs(Observatory):
     def get_TDBs(self, utc_mjd, method="default", ephem=None):
         # barycentric TOAs are already TDB
         return np.asarray(utc_mjd, dtype=np.longdouble)
+
+    def get_TDB_offset_seconds(self, utc_mjd, method="default", ephem=None):
+        return np.zeros_like(np.atleast_1d(np.asarray(utc_mjd,
+                                                      dtype=np.float64)))
 
     def posvel(self, utc_mjd, tdb_mjd, ephem="DE440") -> PosVel:
         tdb_mjd = np.atleast_1d(np.asarray(tdb_mjd, dtype=np.float64))

@@ -73,6 +73,19 @@ def utc_to_tt_mjd(utc_mjd):
     return utc_mjd + np.asarray(dt, dtype=np.longdouble) / np.longdouble(86400.0)
 
 
+def utc_to_tdb_offset_seconds(utc_mjd) -> np.ndarray:
+    """(TDB - UTC) in seconds at the given UTC epochs, float64.
+
+    Computed without forming absolute-MJD sums, so degraded-longdouble
+    platforms can apply the offset to a (hi, lo) pair with an error-free
+    transform instead of rounding at ulp(MJD) ~ 0.3 us.
+    """
+    utc64 = np.asarray(utc_mjd, dtype=np.float64)
+    dt = tt_minus_utc(utc64)
+    tt64 = utc64 + dt / 86400.0
+    return dt + _tdb_provider(tt64)
+
+
 def tt_to_utc_mjd(tt_mjd):
     """TT MJD -> UTC MJD (inverse of utc_to_tt_mjd; TT-UTC evaluated at the
     TT epoch is exact away from a leap-second boundary, where the offset is

@@ -18,13 +18,14 @@ from __future__ import annotations
 import hashlib
 import pickle
 from dataclasses import dataclass, field, replace
+from fractions import Fraction
 from typing import Dict, List, NamedTuple, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import c as C_M_S
-from pint_tpu.dd import DD
+from pint_tpu.dd import DD, two_sum as _two_sum_np
 from pint_tpu.io.tim import RawTOA, format_toa_line, read_tim_file
 from pint_tpu.logging import log
 from pint_tpu.observatory import get_observatory
@@ -82,6 +83,11 @@ class TOAs:
     # pipeline products
     clock_corr_s: Optional[np.ndarray] = None
     tdb: Optional[np.ndarray] = None  # longdouble MJD
+    #: low-order float64 residual of utc_mjd/tdb on platforms where
+    #: longdouble is just double (arm64) — carries the sub-double part of
+    #: the parsed MJD so the device-side DD keeps 2^-106 precision.
+    utc_mjd_lo: Optional[np.ndarray] = None
+    tdb_lo: Optional[np.ndarray] = None
     ssb_obs_pos_km: Optional[np.ndarray] = None
     ssb_obs_vel_kms: Optional[np.ndarray] = None
     obs_sun_pos_km: Optional[np.ndarray] = None
@@ -112,31 +118,54 @@ class TOAs:
             if t.name:
                 fl.setdefault("name", t.name)
             flags.append(fl)
-        utc = cls._mjds_from_raw(raw)
-        return cls(utc, err, freq, obs, flags, commands or [], filename)
+        utc, utc_lo = cls._mjds_from_raw(raw)
+        t = cls(utc, err, freq, obs, flags, commands or [], filename)
+        t.utc_mjd_lo = utc_lo
+        return t
 
     @staticmethod
-    def _mjds_from_raw(raw: List[RawTOA]) -> np.ndarray:
-        """MJD strings -> longdouble.
+    def _mjds_from_raw(raw: List[RawTOA]):
+        """MJD strings -> (longdouble hi, optional float64 lo).
 
         Platforms whose longdouble is just double (arm64: eps > 2e-19, the
         check the reference makes at ``pulsar_mjd.py:47-59`` before
         refusing to run) route through the native C++ dd parser instead
-        (exact to 2^-106); x87 platforms use the numpy longdouble parser,
-        which is both adequate and faster."""
+        (exact to 2^-106) and keep the low-order part as a separate float64
+        array — collapsing it into a degraded longdouble would quantize
+        TOAs at ~1 us.  x87 platforms use the numpy longdouble parser,
+        which is both adequate and faster, and return lo=None."""
         from pint_tpu import native
 
         longdouble_ok = np.finfo(np.longdouble).eps < 2e-19
-        if not longdouble_ok and native.available():
-            hi, lo = native.str2dd_batch(
-                [f"{t.mjd_int}.{t.mjd_frac_str}" for t in raw])
-            return (np.asarray(hi, dtype=np.longdouble)
-                    + np.asarray(lo, dtype=np.longdouble))
+        if not longdouble_ok:
+            if not native.available():
+                log.warning(
+                    "longdouble on this platform is only double precision "
+                    "and the native dd parser is unavailable; TOA times "
+                    "will be quantized at ~1 us (the reference refuses to "
+                    "run on such platforms, pulsar_mjd.py:47-59)")
+            else:
+                hi, lo = native.str2dd_batch(
+                    [f"{t.mjd_int}.{t.mjd_frac_str}" for t in raw])
+                return (np.asarray(hi, dtype=np.longdouble),
+                        np.asarray(lo, dtype=np.float64))
         return np.array([t.mjd_longdouble() for t in raw],
-                        dtype=np.longdouble)
+                        dtype=np.longdouble), None
 
     def __len__(self) -> int:
         return len(self.utc_mjd)
+
+    def __setstate__(self, state):
+        """Tolerate pickles written before fields were added (unpickling
+        bypasses __init__, so dataclass defaults don't apply)."""
+        self.__dict__.update(state)
+        from dataclasses import MISSING, fields
+        for f_ in fields(type(self)):
+            if f_.name not in self.__dict__:
+                if f_.default is not MISSING:
+                    self.__dict__[f_.name] = f_.default
+                elif f_.default_factory is not MISSING:
+                    self.__dict__[f_.name] = f_.default_factory()
 
     @property
     def ntoas(self) -> int:
@@ -152,7 +181,8 @@ class TOAs:
             obs=self.obs[idx],
             flags=[self.flags[i] for i in idx],
         )
-        for name in ("clock_corr_s", "tdb", "ssb_obs_pos_km", "ssb_obs_vel_kms",
+        for name in ("clock_corr_s", "tdb", "utc_mjd_lo", "tdb_lo",
+                     "ssb_obs_pos_km", "ssb_obs_vel_kms",
                      "obs_sun_pos_km", "pulse_number", "delta_pulse_number"):
             v = getattr(self, name)
             if v is not None:
@@ -192,12 +222,32 @@ class TOAs:
 
     def compute_TDBs(self, method="default", ephem=None):
         """Corrected UTC -> TDB longdouble MJD (reference ``toa.py:2251``)."""
-        utc = self.corrected_utc_mjd()
-        tdb = np.empty_like(utc)
-        for site in np.unique(self.obs):
-            m = self.obs == site
-            tdb[m] = get_observatory(site).get_TDBs(utc[m], method=method, ephem=ephem)
-        self.tdb = tdb
+        if self.utc_mjd_lo is not None:
+            # pair path (degraded longdouble): apply clock corr + TDB offset
+            # in seconds via an error-free transform so no absolute-MJD
+            # rounding (ulp(55000) ~ 0.3 us) lands in the hi word
+            utc64 = np.asarray(self.utc_mjd, dtype=np.float64)
+            cc = (self.clock_corr_s if self.clock_corr_s is not None
+                  else np.zeros_like(utc64))
+            corr64 = utc64 + cc / DAY_S  # argument precision only
+            off = np.empty_like(utc64)
+            for site in np.unique(self.obs):
+                m = self.obs == site
+                off[m] = get_observatory(site).get_TDB_offset_seconds(
+                    corr64[m], method=method, ephem=ephem)
+            hi, err = _two_sum_np(utc64, (cc + off) / DAY_S)
+            hi, lo = _two_sum_np(hi, err + self.utc_mjd_lo)
+            self.tdb = np.asarray(hi, dtype=np.longdouble)
+            self.tdb_lo = lo
+        else:
+            utc = self.corrected_utc_mjd()
+            tdb = np.empty_like(utc)
+            for site in np.unique(self.obs):
+                m = self.obs == site
+                tdb[m] = get_observatory(site).get_TDBs(utc[m], method=method,
+                                                        ephem=ephem)
+            self.tdb = tdb
+            self.tdb_lo = None
         self._version += 1
         return self
 
@@ -302,9 +352,25 @@ class TOAs:
 
     def adjust_TOAs(self, delta_seconds: np.ndarray):
         """Shift arrival times in place (simulation uses this)."""
-        self.utc_mjd = self.utc_mjd + np.asarray(delta_seconds, dtype=np.longdouble) / np.longdouble(DAY_S)
-        if self.tdb is not None:
-            self.tdb = self.tdb + np.asarray(delta_seconds, dtype=np.longdouble) / np.longdouble(DAY_S)
+        delta_day = np.asarray(delta_seconds, dtype=np.float64) / DAY_S
+        if self.utc_mjd_lo is not None:
+            # pair path (degraded longdouble): error-free two_sum keeps the
+            # shifted time exact to 2^-106
+            hi, lo = _two_sum_np(np.asarray(self.utc_mjd, np.float64),
+                                 delta_day)
+            hi, lo = _two_sum_np(hi, lo + self.utc_mjd_lo)
+            self.utc_mjd = np.asarray(hi, dtype=np.longdouble)
+            self.utc_mjd_lo = lo
+            if self.tdb is not None:
+                hi, lo = _two_sum_np(np.asarray(self.tdb, np.float64),
+                                     delta_day)
+                hi, lo = _two_sum_np(hi, lo + self.tdb_lo)
+                self.tdb = np.asarray(hi, dtype=np.longdouble)
+                self.tdb_lo = lo
+        else:
+            self.utc_mjd = self.utc_mjd + np.asarray(delta_seconds, dtype=np.longdouble) / np.longdouble(DAY_S)
+            if self.tdb is not None:
+                self.tdb = self.tdb + np.asarray(delta_seconds, dtype=np.longdouble) / np.longdouble(DAY_S)
         self._version += 1
         return self
 
@@ -333,8 +399,15 @@ class TOAs:
         }
         pn = None if self.pulse_number is None else jnp.asarray(self.pulse_number)
         dpn = None if self.delta_pulse_number is None else jnp.asarray(self.delta_pulse_number)
+        if self.tdb_lo is not None:
+            # degraded-longdouble platform: rebuild the exact pair carried
+            # from the native parser instead of the (lossy) longdouble column
+            hi, lo = _two_sum_np(np.asarray(self.tdb, np.float64), self.tdb_lo)
+            tdb_dd = DD(jnp.asarray(hi), jnp.asarray(lo))
+        else:
+            tdb_dd = dd_from_longdouble(self.tdb)
         return TOABatch(
-            tdb=dd_from_longdouble(self.tdb),
+            tdb=tdb_dd,
             tdb0=jnp.float64(tdb0),
             freq=jnp.asarray(self.freq_mhz),
             error_us=jnp.asarray(self.error_us),
@@ -355,8 +428,21 @@ class TOAs:
             for i in range(len(self)):
                 mjd = self.utc_mjd[i]
                 ii = int(np.floor(mjd))
-                ff = np.format_float_positional(mjd - ii, precision=16, trim="-")
-                frac = ff.split(".")[1] if "." in ff else "0"
+                if self.utc_mjd_lo is not None:
+                    # pair path: emit the full (hi, lo) value so a write/read
+                    # round trip through the native dd parser is lossless
+                    fr = (Fraction(float(mjd)) - ii
+                          + Fraction(float(self.utc_mjd_lo[i])))
+                    if fr < 0:  # lo may push just below the floor of hi
+                        ii -= 1
+                        fr += 1
+                    digits = 25
+                    q = round(fr * 10**digits)
+                    frac = f"{q:0{digits}d}".rstrip("0")
+                else:
+                    ff = np.format_float_positional(mjd - ii, precision=16,
+                                                    trim="-")
+                    frac = ff.split(".")[1] if "." in ff else "0"
                 fl = dict(self.flags[i])
                 nm = fl.pop("name", name)
                 f.write(format_toa_line(
@@ -415,19 +501,52 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
     return t
 
 
+def _merge_time_pair(toas_list, hi_name, lo_name):
+    """Merged (hi, lo) columns under the invariant: when a lo column is
+    present, hi is exactly a double.  Inputs lacking a lo column (x87
+    longdouble builds) contribute the sub-double part of their longdouble as
+    lo and a truncated hi, so no precision is lost on either side."""
+    new_hi, new_lo = [], []
+    for t in toas_list:
+        h, v = getattr(t, hi_name), getattr(t, lo_name)
+        if v is not None:
+            new_hi.append(h)
+            new_lo.append(v)
+        else:
+            h64 = np.asarray(h, np.float64)
+            new_hi.append(h64.astype(np.longdouble))
+            new_lo.append(np.asarray(h - h64.astype(np.longdouble),
+                                     dtype=np.float64))
+    return np.concatenate(new_hi), np.concatenate(new_lo)
+
+
 def merge_TOAs(toas_list: List[TOAs]) -> TOAs:
     """Concatenate TOAs containers (reference ``toa.py merge_TOAs``)."""
     first = toas_list[0]
+    utc_pair = any(t.utc_mjd_lo is not None for t in toas_list)
+    if utc_pair:
+        utc_hi, utc_lo = _merge_time_pair(toas_list, "utc_mjd", "utc_mjd_lo")
+    else:
+        utc_hi = np.concatenate([t.utc_mjd for t in toas_list])
+        utc_lo = None
     out = replace(
         first,
-        utc_mjd=np.concatenate([t.utc_mjd for t in toas_list]),
+        utc_mjd=utc_hi,
         error_us=np.concatenate([t.error_us for t in toas_list]),
         freq_mhz=np.concatenate([t.freq_mhz for t in toas_list]),
         obs=np.concatenate([t.obs for t in toas_list]),
         flags=[fl for t in toas_list for fl in t.flags],
     )
-    for name in ("clock_corr_s", "tdb", "ssb_obs_pos_km", "ssb_obs_vel_kms",
-                 "obs_sun_pos_km", "pulse_number", "delta_pulse_number"):
+    out.utc_mjd_lo = utc_lo
+    tdb_pair = (any(t.tdb_lo is not None for t in toas_list)
+                and all(t.tdb is not None for t in toas_list))
+    if tdb_pair:
+        out.tdb, out.tdb_lo = _merge_time_pair(toas_list, "tdb", "tdb_lo")
+    else:
+        out.tdb_lo = None
+    for name in ("clock_corr_s", "ssb_obs_pos_km", "ssb_obs_vel_kms",
+                 "obs_sun_pos_km", "pulse_number", "delta_pulse_number") \
+            + (() if tdb_pair else ("tdb",)):
         vals = [getattr(t, name) for t in toas_list]
         setattr(out, name, np.concatenate(vals) if all(v is not None for v in vals) else None)
     out.planet_pos_km = {}

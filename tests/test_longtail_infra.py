@@ -193,14 +193,14 @@ class TestTCBConversion:
         pepoch_tcb = float(m.PEPOCH.value)
         convert_tcb_tdb(m)
         assert m.UNITS.value == "TDB"
-        assert float(m.F0.value) == pytest.approx(f0_tcb / float(IFTE_K),
+        assert float(m.F0.value) == pytest.approx(f0_tcb * float(IFTE_K),
                                                   rel=1e-14)
         assert float(m.PEPOCH.value) < pepoch_tcb  # pulled toward IFTE_MJD0
-        # F1 scales by K^-2
-        assert float(m.F1.value) == pytest.approx(-1e-14 / float(IFTE_K) ** 2,
+        # F1 scales by K^2
+        assert float(m.F1.value) == pytest.approx(-1e-14 * float(IFTE_K) ** 2,
                                                   rel=1e-12)
-        # DM scales by K^-1
-        assert float(m.DM.value) == pytest.approx(10.0 / float(IFTE_K),
+        # DM scales by K
+        assert float(m.DM.value) == pytest.approx(10.0 * float(IFTE_K),
                                                   rel=1e-14)
         convert_tcb_tdb(m, backwards=True)
         assert float(m.F0.value) == pytest.approx(f0_tcb, rel=1e-14)

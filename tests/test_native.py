@@ -87,13 +87,115 @@ class TestTOAIngestionParity:
         raw, _ = read_tim_file(
             "/root/reference/src/pint/data/examples/B1855+09_NANOGrav_9yv1.tim")
         raw = raw[:500]
-        native_mjds = TOAs._mjds_from_raw(raw)
+        pipeline_mjds, pipeline_lo = TOAs._mjds_from_raw(raw)
         python_mjds = np.array([t.mjd_longdouble() for t in raw],
                                dtype=np.longdouble)
-        dt_ns = np.abs(np.asarray(native_mjds - python_mjds, dtype=np.float64)) \
-            * 86400e9
+        dt_ns = np.abs(np.asarray(pipeline_mjds - python_mjds,
+                                  dtype=np.float64)) * 86400e9
         assert dt_ns.max() < 0.1  # sub-0.1ns agreement
+        # the native dd parser itself must match longdouble too
+        hi, lo = native.str2dd_batch(
+            [f"{t.mjd_int}.{t.mjd_frac_str}" for t in raw])
+        dd_mjds = hi.astype(np.longdouble) + lo.astype(np.longdouble)
+        dt_ns = np.abs(np.asarray(dd_mjds - python_mjds,
+                                  dtype=np.float64)) * 86400e9
+        assert dt_ns.max() < 0.1
+        if pipeline_lo is not None:  # degraded-longdouble platforms only
+            np.testing.assert_array_equal(np.asarray(pipeline_mjds,
+                                                     np.float64), hi)
+            np.testing.assert_array_equal(pipeline_lo, lo)
 
     def test_parse_double_batch(self):
         vals = native.parse_double_batch(["1.5", "-2.25e3", "1.0D-3"])
         np.testing.assert_allclose(vals, [1.5, -2250.0, 1e-3])
+
+
+@pytest.mark.skipif(np.finfo(np.longdouble).eps >= 2e-19,
+                    reason="needs a true-longdouble platform for the baseline")
+class TestDegradedLongdoublePairPath:
+    """Drive the (hi, lo) pair pipeline that degraded-longdouble platforms
+    (arm64) use, and check it is bit-equivalent to the x87 longdouble path.
+    (On an actual degraded platform the longdouble baseline itself would go
+    through the pair path, so this comparison only makes sense on x87.)"""
+
+    @pytest.fixture(scope="class")
+    def pair_and_ld(self):
+        from pint_tpu.io.tim import read_tim_file
+        from pint_tpu.toa import TOAs
+
+        raw, _ = read_tim_file(
+            "/root/reference/src/pint/data/examples/NGC6440E.tim")
+        t_ld = TOAs.from_raw(raw)
+        t_pair = TOAs.from_raw(raw)
+        hi, lo = native.str2dd_batch(
+            [f"{r.mjd_int}.{r.mjd_frac_str}" for r in raw])
+        t_pair.utc_mjd = hi.astype(np.longdouble)
+        t_pair.utc_mjd_lo = lo
+        for t in (t_ld, t_pair):
+            t.apply_clock_corrections()
+            t.compute_TDBs()
+        return t_pair, t_ld
+
+    def test_compute_tdbs_matches_longdouble(self, pair_and_ld):
+        t_pair, t_ld = pair_and_ld
+        assert t_pair.tdb_lo is not None and t_ld.tdb_lo is None
+        tdb_pair = (t_pair.tdb.astype(np.longdouble)
+                    + t_pair.tdb_lo.astype(np.longdouble))
+        err_ns = np.abs(np.asarray(tdb_pair - t_ld.tdb, np.float64)) * 86400e9
+        # the x87 longdouble path itself rounds at ulp(55000) ~ 0.6 ns per
+        # absolute-MJD addition; the pair path is exact, so agreement is
+        # bounded by the longdouble path's own rounding
+        assert err_ns.max() < 1.0
+
+    def test_adjust_toas_exact(self, pair_and_ld):
+        t_pair, _ = pair_and_ld
+        import copy
+
+        t = copy.deepcopy(t_pair)
+        # measure in exact rational arithmetic: longdouble would round at
+        # ulp(55000) ~ 0.6 ns and mask the pair path's exactness
+        before = [Fraction(float(h)) + Fraction(float(l))
+                  for h, l in zip(np.asarray(t.utc_mjd, np.float64),
+                                  t.utc_mjd_lo)]
+        delta = np.full(len(t), 1.25e-7)  # 125 ns
+        t.adjust_TOAs(delta)
+        after = [Fraction(float(h)) + Fraction(float(l))
+                 for h, l in zip(np.asarray(t.utc_mjd, np.float64),
+                                 t.utc_mjd_lo)]
+        shift_ns = np.array([float((a - b) * 86400 * 10**9)
+                             for a, b in zip(after, before)])
+        np.testing.assert_allclose(shift_ns, 125.0, rtol=1e-12)
+
+    def test_write_read_roundtrip_lossless(self, pair_and_ld, tmp_path):
+        t_pair, _ = pair_and_ld
+        path = tmp_path / "pair.tim"
+        t_pair.write_TOA_file(str(path))
+        from pint_tpu.io.tim import read_tim_file
+
+        raw2, _ = read_tim_file(str(path))
+        hi2, lo2 = native.str2dd_batch(
+            [f"{r.mjd_int}.{r.mjd_frac_str}" for r in raw2])
+        orig = (t_pair.utc_mjd.astype(np.longdouble)
+                + t_pair.utc_mjd_lo.astype(np.longdouble))
+        back = hi2.astype(np.longdouble) + lo2.astype(np.longdouble)
+        err_ns = np.abs(np.asarray(back - orig, np.float64)) * 86400e9
+        assert err_ns.max() < 1e-4  # lossless to well below 0.1 ps
+
+    def test_merge_mixed_lo(self, pair_and_ld):
+        from pint_tpu.toa import merge_TOAs
+
+        t_pair, t_ld = pair_and_ld
+        merged = merge_TOAs([t_pair, t_ld])
+        assert merged.utc_mjd_lo is not None
+        n = len(t_pair)
+        # pair rows keep their lo; x87 rows contribute their sub-double part
+        np.testing.assert_array_equal(merged.utc_mjd_lo[:n], t_pair.utc_mjd_lo)
+        # invariant: hi is exactly a double wherever lo is present
+        np.testing.assert_array_equal(
+            merged.utc_mjd,
+            np.asarray(merged.utc_mjd, np.float64).astype(np.longdouble))
+        total = (merged.utc_mjd.astype(np.longdouble)
+                 + merged.utc_mjd_lo.astype(np.longdouble))
+        err_ns = np.abs(np.asarray(total[n:] - t_ld.utc_mjd, np.float64)) \
+            * 86400e9
+        assert err_ns.max() < 1e-4

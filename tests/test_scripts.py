@@ -96,9 +96,9 @@ class TestFitAndConvertCLIs:
 
         m = get_model(str(out))
         assert m.UNITS.value == "TDB"
-        # F0 scaled by 1/IFTE_K (n=1): relative change 1.55e-8
+        # F0 scaled by IFTE_K (frequencies grow): relative change +1.55e-8
         assert float(m.F0.value) / 205.53069 == pytest.approx(
-            1 - 1.55051979176e-8, rel=1e-12)
+            1 + 1.55051979176e-8, rel=1e-12)
 
     def test_pintpublish(self, workdir, capsys):
         from pint_tpu.scripts import pintpublish
