@@ -335,23 +335,32 @@ def ell1_eps(pv, ttasc, ell1k: bool = False):
     return eps1, eps2
 
 
-def ell1_roemer_terms(phi, eps1, eps2):
+def ell1_roemer_terms(phi, eps1, eps2, first_order_dre: bool = False):
     """(Dre, Drep, Drepp)/a1: the third-order-in-e expansion of the ELL1
     Roemer delay and its Phi-derivatives (Zhu et al. 2019 eq 1 /
-    Fiore et al. 2023 eq 4; reference ``ELL1_model.py:223,257,288``)."""
+    Fiore et al. 2023 eq 4; reference ``ELL1_model.py:223,257,288``).
+
+    ``first_order_dre`` (static flag): replace Dre with the first-order
+    Susobhanan+ 2018 eq 6 form carrying an extra -3/2 eps1 constant term —
+    the ELL1k convention (reference ``ELL1k_model.py:120 delayR``, which
+    overrides only Dre and inherits the third-order Drep/Drepp).
+    """
     s1, c1 = jnp.sin(phi), jnp.cos(phi)
     s2, c2 = jnp.sin(2 * phi), jnp.cos(2 * phi)
     s3, c3 = jnp.sin(3 * phi), jnp.cos(3 * phi)
     s4, c4 = jnp.sin(4 * phi), jnp.cos(4 * phi)
     e1, e2 = eps1, eps2
-    dre = (s1 + 0.5 * (e2 * s2 - e1 * c2)
-           - (1.0 / 8.0) * (5 * e2**2 * s1 - 3 * e2**2 * s3
-                            - 2 * e2 * e1 * c1 + 6 * e2 * e1 * c3
-                            + 3 * e1**2 * s1 + 3 * e1**2 * s3)
-           - (1.0 / 12.0) * (5 * e2**3 * s2 + 3 * e1**2 * e2 * s2
-                             - 6 * e1 * e2**2 * c2 - 4 * e1**3 * c2
-                             - 4 * e2**3 * s4 + 12 * e1**2 * e2 * s4
-                             + 12 * e1 * e2**2 * c4 - 4 * e1**3 * c4))
+    if first_order_dre:
+        dre = s1 + 0.5 * (e2 * s2 - e1 * (c2 + 3.0))
+    else:
+        dre = (s1 + 0.5 * (e2 * s2 - e1 * c2)
+               - (1.0 / 8.0) * (5 * e2**2 * s1 - 3 * e2**2 * s3
+                                - 2 * e2 * e1 * c1 + 6 * e2 * e1 * c3
+                                + 3 * e1**2 * s1 + 3 * e1**2 * s3)
+               - (1.0 / 12.0) * (5 * e2**3 * s2 + 3 * e1**2 * e2 * s2
+                                 - 6 * e1 * e2**2 * c2 - 4 * e1**3 * c2
+                                 - 4 * e2**3 * s4 + 12 * e1**2 * e2 * s4
+                                 + 12 * e1 * e2**2 * c4 - 4 * e1**3 * c4))
     drep = (c1 + e1 * s2 + e2 * c2
             - (1.0 / 8.0) * (5 * e2**2 * c1 - 9 * e2**2 * c3
                              + 2 * e1 * e2 * s1 - 18 * e1 * e2 * s3
@@ -373,12 +382,19 @@ def ell1_roemer_terms(phi, eps1, eps2):
 
 def ell1_inverse_delay(pv, ttasc, orbits_fn=orbits_pb, ell1k: bool = False):
     """Inverse-timing Roemer part shared by the ELL1 family (reference
-    ``ELL1_model.py:143 delayI``).  Returns (delayI, phi, pbprime)."""
+    ``ELL1_model.py:143 delayI``).  Returns (delayI, phi, pbprime).
+
+    ELL1k replaces Dre with the first-order Susobhanan+ 2018 eq 6 form,
+    which carries an extra -3/2 eps1 constant term (reference
+    ``ELL1k_model.py:120 delayR``) while keeping the third-order
+    Drep/Drepp of the base model (not overridden there).
+    """
     orbits, pbprime = orbits_fn(pv, ttasc)
     phi = mean_anomaly(orbits)
     eps1, eps2 = ell1_eps(pv, ttasc, ell1k=ell1k)
     a1 = a1_at(pv, ttasc)
-    dre_u, drep_u, drepp_u = ell1_roemer_terms(phi, eps1, eps2)
+    dre_u, drep_u, drepp_u = ell1_roemer_terms(phi, eps1, eps2,
+                                               first_order_dre=ell1k)
     Dre, Drep, Drepp = a1 * dre_u, a1 * drep_u, a1 * drepp_u
     nhat = TWO_PI / pbprime
     delayI = Dre * (1.0 - nhat * Drep + (nhat * Drep) ** 2

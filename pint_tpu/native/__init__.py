@@ -78,7 +78,19 @@ def _load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(so) and not _build(so):
             log.info("no C++ toolchain: using the pure-Python dd path")
             return None
-        lib = ctypes.CDLL(so)
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            # corrupt or wrong-architecture cached object (e.g. a _build dir
+            # shared across machines): drop it and rebuild once
+            try:
+                os.unlink(so)
+            except OSError:
+                pass
+            if not _build(so):
+                log.info("no C++ toolchain: using the pure-Python dd path")
+                return None
+            lib = ctypes.CDLL(so)
     except OSError as e:
         log.warning(f"could not load native kernels: {e}")
         return None

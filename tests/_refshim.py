@@ -1,0 +1,609 @@
+"""Run the reference's numpy-only stand-alone binary engines in-process.
+
+The reference engines (``/root/reference/src/pint/models/stand_alone_psr_binaries``)
+are deliberately astropy-light numpy code, but they import ``astropy.units``,
+``astropy.constants``, ``erfa`` (one constant), ``loguru`` and a few ``pint``
+top-level names.  None of those packages exist in this image, so this module
+installs *minimal but dimensionally-correct* stand-ins sufficient to execute
+the engines unmodified, then imports them by path as parity oracles.
+
+Nothing from the reference is copied; it is executed as an external oracle the
+way the reference's own tests execute it (e.g. ref ``tests/test_dd.py``).
+
+The mini unit system: a ``Unit`` is (scale-to-SI, dimension-exponent vector
+over (m, s, kg, rad)); a ``Quantity`` wraps a numpy array + Unit and
+implements ``__array_ufunc__`` for the ufuncs the engines use.  Equivalencies
+supported: ``dimensionless_angles`` (drop rad dims) and ``parallax``
+(angle <-> length reciprocal), matching the two the engines request.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+import types
+import warnings
+from fractions import Fraction
+
+import numpy as np
+
+REF = "/root/reference/src/pint/models/stand_alone_psr_binaries"
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+_DIMS = ("m", "s", "kg", "rad")
+
+# global equivalency context (u.set_enabled_equivalencies)
+_CONTEXT = []
+
+
+class UnitConversionError(Exception):
+    pass
+
+
+def _dims(**kw):
+    return tuple(Fraction(kw.get(d, 0)) for d in _DIMS)
+
+
+class Unit:
+    __slots__ = ("scale", "dims", "name")
+
+    def __init__(self, scale=1.0, dims=_dims(), name=None):
+        self.scale = float(scale)
+        self.dims = tuple(Fraction(d) for d in dims)
+        self.name = name
+
+    # -- algebra -----------------------------------------------------------
+    def __mul__(self, other):
+        if isinstance(other, Unit):
+            return Unit(self.scale * other.scale,
+                        tuple(a + b for a, b in zip(self.dims, other.dims)))
+        return Quantity(other, self)  # number * unit handled via __rmul__
+
+    def __rmul__(self, other):
+        if isinstance(other, Unit):
+            return other.__mul__(self)
+        return Quantity(other, self)
+
+    def __truediv__(self, other):
+        if isinstance(other, Unit):
+            return Unit(self.scale / other.scale,
+                        tuple(a - b for a, b in zip(self.dims, other.dims)))
+        return Quantity(1.0 / np.asanyarray(other), self)
+
+    def __rtruediv__(self, other):
+        inv = Unit(1.0 / self.scale, tuple(-d for d in self.dims))
+        if isinstance(other, Unit):
+            return other * inv
+        return Quantity(other, inv)
+
+    def __pow__(self, n):
+        return Unit(self.scale ** float(n),
+                    tuple(d * Fraction(n).limit_denominator(16)
+                          for d in self.dims))
+
+    def __eq__(self, other):
+        if not isinstance(other, Unit):
+            return NotImplemented
+        return self.dims == other.dims and np.isclose(self.scale, other.scale,
+                                                      rtol=1e-14)
+
+    def __hash__(self):
+        return hash(self.dims)
+
+    def __repr__(self):
+        return self.name or f"Unit(scale={self.scale}, dims={self.dims})"
+
+    def to_string(self):
+        return repr(self)
+
+    @property
+    def physical_type(self):
+        return "dimensionless" if all(d == 0 for d in self.dims) else "?"
+
+    # numpy must defer to our operators
+    __array_ufunc__ = None
+
+    def decompose(self):
+        return self
+
+    def to(self, other, equivalencies=()):
+        return _convert(1.0, self, _as_unit(other), equivalencies)
+
+
+def _as_unit(x):
+    if isinstance(x, Unit):
+        return x
+    if x is None or x == "":
+        return dimensionless
+    if isinstance(x, str):
+        return _parse_unit(x)
+    raise TypeError(f"not a unit: {x!r}")
+
+
+def _strip_rad(u_: Unit) -> Unit:
+    """dimensionless_angles: treat rad exponents as dimensionless."""
+    d = list(u_.dims)
+    d[3] = Fraction(0)
+    return Unit(u_.scale, tuple(d))
+
+
+def _equiv_active(equivalencies, name):
+    if isinstance(equivalencies, str):
+        equivalencies = (equivalencies,)
+    ctx = tuple(c if not isinstance(c, str) else c for c in _CONTEXT)
+    for e in tuple(equivalencies) + ctx:
+        if e == name or (isinstance(e, (list, tuple)) and name in e):
+            return True
+    return False
+
+
+def _convert(value, from_u: Unit, to_u: Unit, equivalencies=()):
+    if from_u.dims == to_u.dims:
+        return value * (from_u.scale / to_u.scale)
+    # rad <-> dimensionless is always free: the engines assume the
+    # dimensionless_angles equivalency throughout (see the commented-out
+    # set_enabled_equivalencies blocks, e.g. ref DDK_model.py:178)
+    f, t = _strip_rad(from_u), _strip_rad(to_u)
+    if f.dims == t.dims:
+        return value * (f.scale / t.scale)
+    if _equiv_active(equivalencies, "parallax"):
+        # angle <-> length: d[pc] = 1 / px[arcsec]
+        if from_u.dims == rad.dims and to_u.dims == m.dims:
+            as_arcsec = value * (from_u.scale / arcsec.scale)
+            return (1.0 / as_arcsec) * (pc.scale / to_u.scale)
+        if from_u.dims == m.dims and to_u.dims == rad.dims:
+            as_pc = value * (from_u.scale / pc.scale)
+            return (1.0 / as_pc) * (arcsec.scale / to_u.scale)
+    raise UnitConversionError(f"cannot convert {from_u!r} to {to_u!r}")
+
+
+# base + derived units (SI scales; exact definitions)
+dimensionless = Unit(1.0, _dims(), "")
+m = Unit(1.0, _dims(m=1), "m")
+km = Unit(1e3, _dims(m=1), "km")
+s = second = sec = Unit(1.0, _dims(s=1), "s")
+Hz = Unit(1.0, _dims(s=-1), "Hz")
+day = d = Unit(86400.0, _dims(s=1), "d")
+yr = year = Unit(365.25 * 86400.0, _dims(s=1), "yr")  # Julian year
+kg = Unit(1.0, _dims(kg=1), "kg")
+rad = radian = Unit(1.0, _dims(rad=1), "rad")
+deg = degree = Unit(np.pi / 180.0, _dims(rad=1), "deg")
+hourangle = Unit(np.pi / 12.0, _dims(rad=1), "hourangle")
+arcsec = Unit(np.pi / 180.0 / 3600.0, _dims(rad=1), "arcsec")
+mas = Unit(np.pi / 180.0 / 3600.0e3, _dims(rad=1), "mas")
+AU = Unit(1.495978707e11, _dims(m=1), "AU")  # IAU 2012 exact
+pc = Unit(648000.0 / np.pi * 1.495978707e11, _dims(m=1), "pc")
+kpc = Unit(1e3 * pc.scale, _dims(m=1), "kpc")
+# solar mass via IAU nominal GM / CODATA G (what astropy does)
+_GMSUN = 1.32712440018e20  # m^3/s^2 (ref pint/__init__.py:75)
+_G = 6.6743e-11
+Msun = M_sun = Unit(_GMSUN / _G, _dims(kg=1), "Msun")
+# light-second (ref pint/__init__.py:59: ls = c * 1 s)
+_C = 299792458.0
+ls = Unit(_C, _dims(m=1), "ls")
+
+_UNIT_NAMES = {
+    "": dimensionless, "1": dimensionless, "m": m, "km": km,
+    "s": s, "second": s, "sec": s, "Hz": Hz, "hz": Hz,
+    "d": day, "day": day, "yr": yr, "year": yr, "kg": kg,
+    "rad": rad, "radian": rad, "deg": deg, "degree": deg,
+    "hourangle": hourangle, "arcsec": arcsec, "mas": mas,
+    "AU": AU, "au": AU, "pc": pc, "kpc": kpc,
+    "Msun": Msun, "M_sun": Msun, "solMass": Msun, "ls": ls,
+}
+
+
+def _parse_atom(tok: str) -> Unit:
+    tok = tok.strip()
+    if "**" in tok:
+        base, p = tok.split("**")
+        return _parse_atom(base) ** Fraction(p.strip("() "))
+    if "^" in tok:
+        base, p = tok.split("^")
+        return _parse_atom(base) ** Fraction(p.strip("() "))
+    # trailing integer exponent like "s2"
+    if tok and tok[-1].isdigit() and tok[:-1] in _UNIT_NAMES:
+        return _UNIT_NAMES[tok[:-1]] ** int(tok[-1])
+    if tok in _UNIT_NAMES:
+        return _UNIT_NAMES[tok]
+    raise ValueError(f"unknown unit {tok!r}")
+
+
+def _parse_unit(spec: str) -> Unit:
+    spec = spec.strip()
+    if spec == "":
+        return dimensionless
+    out = dimensionless
+    num, _, den = spec.partition("/")
+    for part in num.split("*"):
+        if part.strip():
+            out = out * _parse_atom(part)
+    if den:
+        for part in den.split("/"):
+            out = out / _parse_atom(part)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Quantity
+# ---------------------------------------------------------------------------
+
+class _ValueArray(np.ndarray):
+    """Plain ndarray that also answers .value (dimensionless passthrough)."""
+
+    @property
+    def value(self):
+        return np.asarray(self)
+
+
+_TRIG = {"sin": np.sin, "cos": np.cos, "tan": np.tan}
+_INVTRIG = {"arcsin": np.arcsin, "arccos": np.arccos, "arctan": np.arctan}
+
+
+class Quantity:
+    __slots__ = ("value", "unit")
+
+    def __init__(self, value, unit=dimensionless, dtype=None):
+        if isinstance(unit, str):
+            unit = _parse_unit(unit)
+        if isinstance(value, Quantity):
+            value = value.to(unit).value
+        self.value = np.asanyarray(value, dtype=dtype) if dtype \
+            else np.asanyarray(value)
+        self.unit = unit
+
+    # -- core --------------------------------------------------------------
+    def to(self, unit, equivalencies=()):
+        unit = _as_unit(unit)
+        return Quantity(_convert(self.value, self.unit, unit, equivalencies),
+                        unit)
+
+    def to_value(self, unit, equivalencies=()):
+        return self.to(unit, equivalencies).value
+
+    def decompose(self):
+        return Quantity(self.value * self.unit.scale,
+                        Unit(1.0, self.unit.dims))
+
+    @property
+    def si(self):
+        return self.decompose()
+
+    def __len__(self):
+        return len(self.value)
+
+    @property
+    def shape(self):
+        return np.shape(self.value)
+
+    @property
+    def size(self):
+        return np.size(self.value)
+
+    def __getitem__(self, idx):
+        return Quantity(self.value[idx], self.unit)
+
+    def __setitem__(self, idx, val):
+        v = self._coerce(val)
+        self.value[idx] = _convert(v.value, v.unit, self.unit, _CONTEXT or ())
+
+    def __iter__(self):
+        for v in np.atleast_1d(self.value):
+            yield Quantity(v, self.unit)
+
+    def __repr__(self):
+        return f"<Quantity {self.value} {self.unit!r}>"
+
+    def __float__(self):
+        return float(self.to(dimensionless).value)
+
+    def __array__(self, dtype=None, copy=None):
+        # astropy's Quantity is an ndarray subclass, so np.array()/np.<type>()
+        # on it keeps the RAW values and silently drops the unit — mimic
+        # that.  The engines do np.longdouble(quantity).value
+        # (binary_generic.py:353): hand back a view with a .value property.
+        return np.asarray(self.value, dtype=dtype).view(_ValueArray)
+
+    def item(self):
+        return Quantity(self.value.item(), self.unit)
+
+    def copy(self):
+        return Quantity(np.copy(self.value), self.unit)
+
+    def astype(self, dtype):
+        return Quantity(self.value.astype(dtype), self.unit)
+
+    # -- arithmetic --------------------------------------------------------
+    def _coerce(self, other):
+        if isinstance(other, Quantity):
+            return other
+        if isinstance(other, Unit):
+            return Quantity(1.0, other)
+        return Quantity(other, dimensionless)
+
+    def __add__(self, other):
+        o = self._coerce(other)
+        return Quantity(self.value
+                        + _convert(o.value, o.unit, self.unit, _CONTEXT or ()),
+                        self.unit)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        o = self._coerce(other)
+        return Quantity(self.value
+                        - _convert(o.value, o.unit, self.unit, _CONTEXT or ()),
+                        self.unit)
+
+    def __rsub__(self, other):
+        return (-self).__add__(other)
+
+    def __mul__(self, other):
+        if isinstance(other, Unit):
+            return Quantity(self.value, self.unit * other)
+        o = self._coerce(other)
+        return Quantity(self.value * o.value, self.unit * o.unit)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, Unit):
+            return Quantity(self.value, self.unit / other)
+        o = self._coerce(other)
+        return Quantity(self.value / o.value, self.unit / o.unit)
+
+    def __rtruediv__(self, other):
+        o = self._coerce(other)
+        return o.__truediv__(self)
+
+    def __pow__(self, n):
+        return Quantity(self.value ** n, self.unit ** n)
+
+    def __neg__(self):
+        return Quantity(-self.value, self.unit)
+
+    def __abs__(self):
+        return Quantity(np.abs(self.value), self.unit)
+
+    def _cmp(self, other, op):
+        o = self._coerce(other)
+        return op(self.value, _convert(o.value, o.unit, self.unit,
+                                       _CONTEXT or ()))
+
+    def __lt__(self, o): return self._cmp(o, np.less)
+    def __le__(self, o): return self._cmp(o, np.less_equal)
+    def __gt__(self, o): return self._cmp(o, np.greater)
+    def __ge__(self, o): return self._cmp(o, np.greater_equal)
+
+    def __eq__(self, o):
+        try:
+            return self._cmp(o, np.equal)
+        except UnitConversionError:
+            return False
+
+    def __ne__(self, o):
+        eq = self.__eq__(o)
+        return ~eq if isinstance(eq, np.ndarray) else not eq
+
+    def __hash__(self):
+        return id(self)
+
+    # -- numpy ufunc dispatch ---------------------------------------------
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        name = ufunc.__name__
+        if method == "reduce":
+            (inp,) = inputs
+            if name == "add":
+                return Quantity(np.add.reduce(inp.value, **kwargs), inp.unit)
+            if name in ("maximum", "minimum"):
+                return Quantity(getattr(np, name).reduce(inp.value, **kwargs),
+                                inp.unit)
+            return NotImplemented
+        if method != "__call__":
+            return NotImplemented
+        if name in _TRIG:
+            (x,) = inputs
+            xr = x.to(rad, equivalencies=("dimensionless_angles",))
+            return Quantity(_TRIG[name](xr.value), dimensionless)
+        if name in _INVTRIG:
+            (x,) = inputs
+            xd = x.to(dimensionless, equivalencies=("dimensionless_angles",))
+            return Quantity(_INVTRIG[name](xd.value), rad)
+        if name == "arctan2":
+            y, x = (self._coerce(i) for i in inputs)
+            xc = _convert(x.value, x.unit, y.unit, ("dimensionless_angles",))
+            return Quantity(np.arctan2(y.value, xc), rad)
+        if name == "sqrt":
+            (x,) = inputs
+            return Quantity(np.sqrt(x.value), x.unit ** Fraction(1, 2))
+        if name in ("exp", "log", "log10", "expm1", "log1p"):
+            (x,) = inputs
+            xd = x.to(dimensionless, equivalencies=("dimensionless_angles",))
+            return Quantity(getattr(np, name)(xd.value), dimensionless)
+        if name in ("multiply", "divide", "true_divide"):
+            a, b = (self._coerce(i) for i in inputs)
+            return a.__mul__(b) if name == "multiply" else a.__truediv__(b)
+        if name in ("add", "subtract"):
+            a, b = (self._coerce(i) for i in inputs)
+            return a.__add__(b) if name == "add" else a.__sub__(b)
+        if name == "power":
+            a, n = inputs
+            return self._coerce(a).__pow__(n)
+        if name in ("negative",):
+            return -inputs[0]
+        if name in ("absolute", "fabs"):
+            return abs(self._coerce(inputs[0]))
+        if name in ("greater", "less", "greater_equal", "less_equal",
+                    "equal", "not_equal"):
+            a, b = (self._coerce(i) for i in inputs)
+            return a._cmp(b, getattr(np, name))
+        if name in ("maximum", "minimum"):
+            a, b = (self._coerce(i) for i in inputs)
+            bv = _convert(b.value, b.unit, a.unit, _CONTEXT or ())
+            return Quantity(getattr(np, name)(a.value, bv), a.unit)
+        if name in ("isfinite", "isnan", "isinf"):
+            return getattr(np, name)(self._coerce(inputs[0]).value)
+        if name == "sign":
+            return np.sign(self._coerce(inputs[0]).value)
+        if name == "floor":
+            x = self._coerce(inputs[0])
+            return Quantity(np.floor(x.value), x.unit)
+        return NotImplemented
+
+
+# ---------------------------------------------------------------------------
+# astropy.units / astropy.constants / erfa / loguru / pint stubs
+# ---------------------------------------------------------------------------
+
+
+class _EquivContext:
+    def __init__(self, equivs):
+        self.equivs = equivs
+
+    def __enter__(self):
+        _CONTEXT.append(self.equivs)
+        return self
+
+    def __exit__(self, *exc):
+        _CONTEXT.pop()
+        return False
+
+
+def _make_units_module():
+    u_ = types.ModuleType("astropy.units")
+    for nm, un in _UNIT_NAMES.items():
+        if nm:
+            setattr(u_, nm, un)
+    u_.M_sun = Msun
+    u_.Quantity = Quantity
+    u_.Unit = _as_unit
+    u_.UnitConversionError = UnitConversionError
+
+    def dimensionless_angles():
+        return "dimensionless_angles"
+
+    def parallax():
+        return "parallax"
+
+    u_.dimensionless_angles = dimensionless_angles
+    u_.parallax = parallax
+    u_.set_enabled_equivalencies = lambda eq: _EquivContext(eq)
+    u_.quantity_input = lambda *a, **k: (a[0] if (a and callable(a[0]))
+                                         else (lambda f: f))
+    u_.def_unit = lambda name, rep=None: (
+        Unit(rep.unit.scale * float(np.asarray(rep.value)), rep.unit.dims,
+             name) if isinstance(rep, Quantity) else Unit(1.0, _dims(), name))
+    u_.dimensionless_unscaled = dimensionless
+    return u_
+
+
+def _make_constants_module():
+    c_ = types.ModuleType("astropy.constants")
+    c_.c = Quantity(_C, m / s)
+    c_.G = Quantity(_G, m ** 3 / (kg * s ** 2))
+    c_.M_sun = Quantity(Msun.scale, kg)
+    c_.au = Quantity(AU.scale, m)
+    c_.pc = Quantity(pc.scale, m)
+    return c_
+
+
+def install_and_import():
+    """Install stub modules and import the reference engines.
+
+    Returns the package module holding DDmodel, ELL1model, etc.
+    """
+    if "pint.models.stand_alone_psr_binaries" in sys.modules:
+        return sys.modules["pint.models.stand_alone_psr_binaries"]
+
+    u_mod = _make_units_module()
+    c_mod = _make_constants_module()
+    astropy = types.ModuleType("astropy")
+    astropy.units = u_mod
+    astropy.constants = c_mod
+    sys.modules.setdefault("astropy", astropy)
+    sys.modules["astropy.units"] = u_mod
+    sys.modules["astropy.constants"] = c_mod
+
+    erfa_mod = types.ModuleType("erfa")
+    erfa_mod.DAYSEC = 86400.0
+    sys.modules.setdefault("erfa", erfa_mod)
+
+    loguru_mod = types.ModuleType("loguru")
+
+    class _Log:
+        def __getattr__(self, nm):
+            return lambda *a, **k: None
+
+    loguru_mod.logger = _Log()
+    sys.modules.setdefault("loguru", loguru_mod)
+
+    # pint top-level names the engines import (values per ref
+    # pint/__init__.py:59,75,78)
+    pint_mod = types.ModuleType("pint")
+    pint_mod.Tsun = Quantity(_GMSUN / _C ** 3, s)
+    pint_mod.ls = ls
+    pint_mod.__path__ = []
+    models_mod = types.ModuleType("pint.models")
+    models_mod.__path__ = []
+    param_mod = types.ModuleType("pint.models.parameter")
+
+    class InvalidModelParameters(ValueError):
+        pass
+
+    class floatParameter:  # only referenced, engines don't construct in hot path
+        def __init__(self, *a, **k):
+            self.__dict__.update(k)
+
+    param_mod.InvalidModelParameters = InvalidModelParameters
+    param_mod.floatParameter = floatParameter
+
+    utils_mod = types.ModuleType("pint.utils")
+
+    def taylor_horner(x, coeffs):
+        """sum_i coeffs[i] x^i / i! (same contract as ref utils.py:411)."""
+        res = 0.0 * (coeffs[0] if len(coeffs) else 0.0)
+        fact = float(len(coeffs))
+        for coeff in coeffs[::-1]:
+            res = coeff + x / fact * res
+            fact -= 1.0
+        return res
+
+    def taylor_horner_deriv(x, coeffs, deriv_order=1):
+        der = list(coeffs)
+        for _ in range(deriv_order):
+            der = [c * (i + 1) for i, c in enumerate(der[1:])] if len(der) > 1 \
+                else [0.0 * der[0]]
+        # taylor series derivative: d/dx sum c_i x^i/i! = sum c_{i+1} x^i/i!
+        return taylor_horner(x, coeffs[deriv_order:]) if deriv_order < len(coeffs) \
+            else 0.0 * x
+
+    utils_mod.taylor_horner = taylor_horner
+    utils_mod.taylor_horner_deriv = taylor_horner_deriv
+
+    pkg = types.ModuleType("pint.models.stand_alone_psr_binaries")
+    pkg.__path__ = [REF]
+
+    sys.modules["pint"] = pint_mod
+    sys.modules["pint.models"] = models_mod
+    sys.modules["pint.models.parameter"] = param_mod
+    sys.modules["pint.utils"] = utils_mod
+    sys.modules["pint.models.stand_alone_psr_binaries"] = pkg
+    pint_mod.models = models_mod
+    models_mod.parameter = param_mod
+    models_mod.stand_alone_psr_binaries = pkg
+
+    for name in ("binary_orbits", "binary_generic", "BT_model", "DD_model",
+                 "DDS_model", "DDH_model", "DDK_model", "DDGR_model",
+                 "ELL1_model", "ELL1H_model", "ELL1k_model"):
+        full = f"pint.models.stand_alone_psr_binaries.{name}"
+        spec = importlib.util.spec_from_file_location(full, f"{REF}/{name}.py")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[full] = mod
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            spec.loader.exec_module(mod)
+        setattr(pkg, name, mod)
+    return pkg

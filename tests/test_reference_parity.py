@@ -1,0 +1,371 @@
+"""Differential parity: our jnp binary engines vs the reference's numpy
+engines executed in-process (VERDICT round 1, item 1).
+
+The reference's stand-alone engines are numpy-only and run here through the
+minimal unit shim in ``_refshim`` — no ephemeris kernel needed.  Every binary
+model delay is asserted to agree at <=1 ns over dense (tt0, params) sweeps.
+
+Reference oracles: ``stand_alone_psr_binaries/binary_generic.py:335``,
+``DD_model.py:854``, ``ELL1_model.py:143``, ``DDS_model.py``,
+``DDH_model.py``, ``DDGR_model.py``, ``DDK_model.py``, ``ELL1H_model.py``,
+``ELL1k_model.py``, ``BT_model.py:141``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import _refshim
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_refshim.REF), reason="reference tree not present")
+
+NS = 1e-9  # parity tolerance [s]
+
+# Dense time coverage: several orbits finely + a decade span coarsely.
+T0 = 54100.0
+T_FINE = np.linspace(T0 + 50.0, T0 + 51.0, 400)       # ~3 orbits at PB=0.3
+T_WIDE = np.linspace(T0 - 1800.0, T0 + 1800.0, 400)   # ~10 yr
+TIMES = np.concatenate([T_FINE, T_WIDE])
+
+
+@pytest.fixture(scope="module")
+def ref():
+    return _refshim.install_and_import()
+
+
+def ref_delay(ref_pkg, model_attr, pars, t=TIMES, psr_pos=None, obs_pos=None,
+              fit_params=None):
+    mod_name, cls_name = model_attr
+    cls = getattr(getattr(ref_pkg, mod_name), cls_name)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = cls()
+        m.update_input(barycentric_toa=t, **pars)
+        if fit_params is not None:
+            # the PINT wrapper normally sets this from the par file (ref
+            # binary_ell1h.py); the engine default ['H3'] zeroes STIGMA
+            m.fit_params = fit_params
+        if psr_pos is not None:
+            m.psr_pos = psr_pos
+        if obs_pos is not None:
+            m.obs_pos = _refshim.Quantity(obs_pos, _refshim.km)
+        return np.asarray(m.binary_delay().to("second").value,
+                          dtype=np.float64)
+
+
+def my_delay(fn, pars, t=TIMES, t0_key="T0", **kw):
+    import jax
+
+    tt0 = (t - pars[t0_key]) * 86400.0
+    pv = {k: v for k, v in pars.items() if k not in ("T0", "TASC")}
+    out = fn(pv, tt0, **kw)
+    return np.asarray(jax.device_get(out), dtype=np.float64)
+
+
+def assert_parity(mine, theirs, label, tol=NS):
+    err = np.abs(mine - theirs)
+    assert np.isfinite(theirs).all(), f"{label}: reference non-finite"
+    assert err.max() < tol, (
+        f"{label}: max |delta| = {err.max():.3e} s at "
+        f"i={int(err.argmax())} (mine={mine[err.argmax()]!r}, "
+        f"ref={theirs[err.argmax()]!r})")
+
+
+# ---------------------------------------------------------------------------
+# parameter sweeps per model
+# ---------------------------------------------------------------------------
+
+BT_CASES = [
+    dict(PB=0.3, A1=2.0, ECC=0.1, OM=30.0, T0=T0, GAMMA=1e-4),
+    dict(PB=0.3, A1=2.0, ECC=0.6, OM=123.4, T0=T0, GAMMA=2e-3,
+         PBDOT=1e-11, OMDOT=3.0, EDOT=1e-14, A1DOT=1e-13),
+    dict(PB=40.0, A1=25.0, ECC=0.01, OM=271.0, T0=T0, GAMMA=0.0),
+]
+
+DD_CASES = [
+    dict(PB=0.3, A1=2.0, ECC=0.1, OM=30.0, T0=T0, M2=0.3, SINI=0.9,
+         GAMMA=1e-4),
+    dict(PB=0.3, A1=2.0, ECC=0.6, OM=200.0, T0=T0, M2=1.2, SINI=0.99,
+         GAMMA=4e-3, OMDOT=4.2, PBDOT=2e-11, EDOT=1e-14, A1DOT=-1e-13),
+    dict(PB=67.8, A1=32.3, ECC=0.27, OM=243.0, T0=T0, M2=0.25, SINI=0.96,
+         GAMMA=2e-3, OMDOT=0.01),
+]
+
+DDS_CASES = [
+    dict(PB=0.3, A1=2.0, ECC=0.1, OM=30.0, T0=T0, M2=0.3, SHAPMAX=1.2,
+         GAMMA=1e-4),
+    dict(PB=8.7, A1=14.0, ECC=0.18, OM=310.0, T0=T0, M2=1.0, SHAPMAX=3.5,
+         GAMMA=1e-3, OMDOT=0.3),
+]
+
+DDH_CASES = [
+    dict(PB=0.3, A1=2.0, ECC=0.1, OM=30.0, T0=T0, H3=1e-6, STIGMA=0.7,
+         GAMMA=1e-4),
+    dict(PB=5.0, A1=9.0, ECC=0.4, OM=77.0, T0=T0, H3=4e-7, STIGMA=0.3,
+         OMDOT=0.5),
+]
+
+DDGR_CASES = [
+    dict(PB=0.3, A1=0.5, ECC=0.1, OM=30.0, T0=T0, M2=0.3, MTOT=1.6),
+    dict(PB=0.323, A1=2.34, ECC=0.617, OM=226.0, T0=T0, M2=1.39, MTOT=2.83),
+]
+
+ELL1_CASES = [
+    dict(PB=0.3, A1=2.0, TASC=T0, EPS1=1e-5, EPS2=-2e-5, M2=0.2, SINI=0.8),
+    dict(PB=12.3, A1=21.0, TASC=T0, EPS1=4e-4, EPS2=3e-4, M2=0.25,
+         SINI=0.995, PBDOT=1e-12, EPS1DOT=1e-15, EPS2DOT=-1e-15,
+         A1DOT=2e-14),
+]
+
+ELL1H_CASES = [
+    dict(PB=0.3, A1=2.0, TASC=T0, EPS1=1e-5, EPS2=-2e-5, H3=1e-6,
+         STIGMA=0.7, NHARMS=7),
+    dict(PB=4.07, A1=8.8, TASC=T0, EPS1=2e-4, EPS2=-9e-5, H3=2.5e-7,
+         STIGMA=0.31, NHARMS=4),
+]
+
+ELL1K_CASES = [
+    dict(PB=0.3, A1=2.0, TASC=T0, EPS1=1e-5, EPS2=-2e-5, M2=0.2, SINI=0.8,
+         OMDOT=1.0, LNEDOT=1e-10),
+    dict(PB=1.53, A1=3.2, TASC=T0, EPS1=7e-4, EPS2=2e-4, M2=0.3, SINI=0.9,
+         OMDOT=10.0, LNEDOT=5e-10),
+]
+
+
+class TestBinaryEngineParity:
+    @pytest.mark.parametrize("pars", BT_CASES)
+    def test_bt(self, ref, pars):
+        from pint_tpu.models.binary.engines import bt_delay
+
+        assert_parity(my_delay(bt_delay, pars),
+                      ref_delay(ref, ("BT_model", "BTmodel"), pars), "BT")
+
+    @pytest.mark.parametrize("pars", DD_CASES)
+    def test_dd(self, ref, pars):
+        from pint_tpu.models.binary.engines import dd_delay
+
+        assert_parity(my_delay(dd_delay, pars),
+                      ref_delay(ref, ("DD_model", "DDmodel"), pars), "DD")
+
+    @pytest.mark.parametrize("pars", DDS_CASES)
+    def test_dds(self, ref, pars):
+        from pint_tpu.models.binary.engines import dds_delay
+
+        assert_parity(my_delay(dds_delay, pars),
+                      ref_delay(ref, ("DDS_model", "DDSmodel"), pars), "DDS")
+
+    @pytest.mark.parametrize("pars", DDH_CASES)
+    def test_ddh(self, ref, pars):
+        from pint_tpu.models.binary.engines import ddh_delay
+
+        assert_parity(my_delay(ddh_delay, pars),
+                      ref_delay(ref, ("DDH_model", "DDHmodel"), pars), "DDH")
+
+    @pytest.mark.parametrize("pars", DDGR_CASES)
+    def test_ddgr(self, ref, pars):
+        from pint_tpu.models.binary.engines import ddgr_delay
+
+        assert_parity(my_delay(ddgr_delay, pars),
+                      ref_delay(ref, ("DDGR_model", "DDGRmodel"), pars),
+                      "DDGR")
+
+    @pytest.mark.parametrize("pars", ELL1_CASES)
+    def test_ell1(self, ref, pars):
+        from pint_tpu.models.binary.engines import ell1_delay
+
+        assert_parity(my_delay(ell1_delay, pars, t0_key="TASC"),
+                      ref_delay(ref, ("ELL1_model", "ELL1model"), pars),
+                      "ELL1")
+
+    @pytest.mark.parametrize("pars", ELL1H_CASES)
+    def test_ell1h(self, ref, pars):
+        from pint_tpu.models.binary.engines import ell1h_delay
+
+        nharms = pars["NHARMS"]
+        mypars = {k: v for k, v in pars.items() if k != "NHARMS"}
+        assert_parity(
+            my_delay(ell1h_delay, mypars, t0_key="TASC", nharms=nharms),
+            ref_delay(ref, ("ELL1H_model", "ELL1Hmodel"), pars,
+                      fit_params=["H3", "STIGMA"]), "ELL1H")
+
+    @pytest.mark.parametrize("pars", ELL1K_CASES)
+    def test_ell1k(self, ref, pars):
+        from pint_tpu.models.binary.engines import ell1k_delay
+
+        assert_parity(my_delay(ell1k_delay, pars, t0_key="TASC"),
+                      ref_delay(ref, ("ELL1k_model", "ELL1kmodel"), pars),
+                      "ELL1k")
+
+    def test_ddk(self, ref):
+        from pint_tpu.models.binary.engines import ddk_delay
+
+        # reference engine names the proper-motion inputs PMLONG_DDK /
+        # PMLAT_DDK (ref DDK_model.py:68); ours maps PMRA/PMDEC onto them
+        pars = dict(PB=0.3, A1=2.0, ECC=0.1, OM=30.0, T0=T0, M2=0.3,
+                    KIN=60.0, KOM=40.0, PX=1.5,
+                    PMLONG_DDK=3.0, PMLAT_DDK=-2.0)
+        n = len(TIMES)
+        psr_pos = np.tile([0.3, 0.4, np.sqrt(1 - 0.09 - 0.16)], (n, 1))
+        ang = 2 * np.pi * (TIMES - 54000.0) / 365.25
+        obs_pos_km = 1.496e8 * np.stack(
+            [np.cos(ang), np.sin(ang), 0.3 * np.sin(ang)], axis=1)
+        theirs = ref_delay(ref, ("DDK_model", "DDKmodel"), pars,
+                           psr_pos=psr_pos, obs_pos=obs_pos_km)
+        mypars = dict(pars)
+        mypars["PMRA"] = mypars.pop("PMLONG_DDK")
+        mypars["PMDEC"] = mypars.pop("PMLAT_DDK")
+        mine = my_delay(ddk_delay, mypars, psr_pos=psr_pos,
+                        obs_pos_ls=obs_pos_km / 299792.458)
+        assert_parity(mine, theirs, "DDK")
+
+
+# ---------------------------------------------------------------------------
+# component formula parity: our pure functions vs 50-digit mpmath
+# implementations of the reference's formulas with identical inputs
+# (VERDICT item 1, non-binary half; no ephemeris needed)
+# ---------------------------------------------------------------------------
+
+import mpmath  # noqa: E402
+
+mpmath.mp.dps = 50
+
+
+class TestComponentFormulaParity:
+    def test_dispersion_delay(self):
+        """delay = DM / (2.41e-4 f^2)  (ref dispersion_model.py:28 +
+        pint/__init__.py:66 DMconst)."""
+        from pint_tpu.models.dispersion_model import Dispersion
+
+        rng = np.random.default_rng(1)
+        dm = rng.uniform(2.0, 400.0, 64)
+        f = rng.uniform(300.0, 3000.0, 64)  # MHz
+        mine = np.asarray(Dispersion.dispersion_time_delay(None, dm, f))
+        for i in range(64):
+            truth = mpmath.mpf(dm[i]) / (mpmath.mpf("2.41e-4")
+                                         * mpmath.mpf(f[i]) ** 2)
+            # delays up to ~7 ms; agreement must be sub-ns
+            assert abs(mine[i] - float(truth)) < 1e-12
+
+    def test_solar_system_shapiro(self):
+        """-2 T_sun ln((r - r.n)/AU)  (ref solar_system_shapiro.py:59)."""
+        from pint_tpu import AU_LS, Tsun
+        from pint_tpu.models.solar_system_shapiro import SolarSystemShapiro
+
+        rng = np.random.default_rng(2)
+        n = 64
+        obj = rng.normal(0.0, 500.0, (n, 3))
+        psr = rng.normal(0.0, 1.0, (n, 3))
+        psr /= np.linalg.norm(psr, axis=1)[:, None]
+        mine = np.asarray(SolarSystemShapiro.ss_obj_shapiro_delay(
+            obj, psr, Tsun))
+        for i in range(n):
+            r = mpmath.sqrt(sum(mpmath.mpf(x) ** 2 for x in obj[i]))
+            rcos = sum(mpmath.mpf(a) * mpmath.mpf(b)
+                       for a, b in zip(obj[i], psr[i]))
+            truth = -2 * mpmath.mpf(Tsun) * mpmath.log(
+                (r - rcos) / mpmath.mpf(AU_LS))
+            assert abs(mine[i] - float(truth)) < 1e-12
+
+    def test_solar_wind_spherical(self):
+        """Edwards et al. 2006 eq 29-30 geometry (ref
+        solar_wind_dispersion.py:370, SWM=0): AU^2 rho / (r sin rho) with
+        rho = pi - elongation, expressed in pc."""
+        from pint_tpu import AU_LS, c as C
+        from pint_tpu.models.solar_wind import solar_wind_geometry_spherical
+
+        pc_ls = 3.0856775814913673e16 / C
+        rng = np.random.default_rng(3)
+        r = rng.uniform(480.0, 520.0, 32)          # ls (~1 AU)
+        elong = rng.uniform(0.05, 3.0, 32)         # rad
+        mine = np.asarray(solar_wind_geometry_spherical(r, elong))
+        for i in range(32):
+            rho = mpmath.pi - mpmath.mpf(elong[i])
+            truth = (mpmath.mpf(AU_LS) ** 2 * rho
+                     / (mpmath.mpf(r[i]) * mpmath.sin(rho))
+                     / mpmath.mpf(pc_ls))
+            assert abs(mine[i] - float(truth)) < abs(float(truth)) * 1e-12
+
+    def test_solar_wind_powerlaw_geometry(self):
+        """Hazboun et al. 2022 eq 11 (ref solar_wind_dispersion.py:664,
+        SWM=1): (AU/b)^p b [I_inf(p) + I(z/b, p)] against direct mpmath
+        quadrature of integral (1+t^2)^(-p/2)."""
+        from pint_tpu import AU_LS, c as C
+        from pint_tpu.models.solar_wind import solar_wind_geometry_pl
+
+        pc_ls = 3.0856775814913673e16 / C
+        for p, r, theta in [(2.0, 500.0, 1.0), (2.5, 490.0, 0.3),
+                            (3.0, 510.0, 2.5), (1.8, 500.0, 1.9)]:
+            mine = float(np.asarray(solar_wind_geometry_pl(
+                np.array([r]), np.array([theta]), p))[0])
+            b = mpmath.mpf(r) * mpmath.sin(mpmath.mpf(theta))
+            z = mpmath.mpf(r) * mpmath.cos(mpmath.mpf(theta))
+            integ = mpmath.quad(lambda t: (1 + t ** 2) ** (-mpmath.mpf(p) / 2),
+                                [0, z / b]) if z != 0 else mpmath.mpf(0)
+            i_inf = (mpmath.sqrt(mpmath.pi) / 2 * mpmath.gamma((p - 1) / 2)
+                     / mpmath.gamma(mpmath.mpf(p) / 2))
+            truth = ((mpmath.mpf(AU_LS) / b) ** p * b * (i_inf + integ)
+                     / mpmath.mpf(pc_ls))
+            assert abs(mine - float(truth)) < abs(float(truth)) * 1e-9, p
+
+    def test_fd_delay(self):
+        """delay = sum_i FD_i ln(f/GHz)^i  (ref frequency_dependent.py:13)."""
+        from pint_tpu.models import get_model
+        import io
+
+        par = ("PSR TEST\nRAJ 10:00:00\nDECJ 10:00:00\nF0 100\nPEPOCH 55000\n"
+               "FD1 1e-4\nFD2 -3e-5\nFD3 5e-6\n")
+        m = get_model(io.StringIO(par))
+        comp = m.components["FD"]
+        pv = m._const_pv()
+        freq = np.array([327.0, 1400.0, 2300.0, 430.0])
+        import jax.numpy as jnp
+        mine = np.asarray(comp.delay_func(
+            dict(pv), _FreqBatch(freq), {}, jnp.zeros(4)))
+        for i in range(4):
+            lf = mpmath.log(mpmath.mpf(freq[i]) / 1000)
+            truth = (mpmath.mpf("1e-4") * lf + mpmath.mpf("-3e-5") * lf ** 2
+                     + mpmath.mpf("5e-6") * lf ** 3)
+            assert abs(mine[i] - float(truth)) < 1e-13
+
+    def test_spindown_phase_dd(self):
+        """phase = F0 dt + F1 dt^2/2 + F2 dt^3/6 in double-double vs exact
+        rational arithmetic (ref spindown.py:142 / tempo2 paper eq 120)."""
+        from fractions import Fraction
+
+        from pint_tpu.dd import DD, taylor_horner_dd
+        import jax.numpy as jnp
+
+        F0, F1, F2 = 339.31568728824463, -1.6141632533e-14, 1.2e-24
+        dts = [86400.0 * d + off for d in (-3650.0, -1.0, 0.5, 2000.0)
+               for off in (0.0, 1e-6)]
+        x = DD(jnp.asarray(dts), jnp.zeros(len(dts)))
+        ph = taylor_horner_dd(x, [0.0, F0, F1, F2])  # /i! applied inside
+        got = np.asarray(ph.hi, dtype=np.float64), np.asarray(ph.lo,
+                                                              dtype=np.float64)
+        for i, dt in enumerate(dts):
+            d = Fraction(dt)
+            truth = (Fraction(F0) * d + Fraction(F1) / 2 * d ** 2
+                     + Fraction(F2) / 6 * d ** 3)
+            mine = Fraction(float(got[0][i])) + Fraction(float(got[1][i]))
+            # |phase| ~ 1e11 cycles; require < 1e-9 cycle agreement
+            assert abs(float(mine - truth)) < 1e-9, dt
+
+
+class _FreqBatch:
+    """Minimal stand-in carrying what FD.delay_func reads (zero observatory
+    velocity => barycentric frequency == topocentric frequency)."""
+
+    def __init__(self, freq):
+        import jax.numpy as jnp
+
+        from pint_tpu.dd import dd_from_float
+
+        n = len(freq)
+        self.freq = jnp.asarray(freq)
+        self.ntoas = n
+        self.tdb = dd_from_float(jnp.full(n, 55000.0))
+        self.ssb_obs_vel = jnp.zeros((n, 3))
