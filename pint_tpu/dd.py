@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -47,9 +48,25 @@ __all__ = [
 _SPLITTER = 134217729.0  # 2**27 + 1, Dekker/Veltkamp splitter for float64
 
 
+def _opaque(x):
+    """Hide a rounded intermediate from XLA's algebraic simplifier.
+
+    The error-free transforms below depend on exact IEEE rounding of specific
+    intermediate expressions.  Under ``--xla_allow_excess_precision=true``
+    (forced by some TPU compile environments) XLA may fold patterns like
+    ``(a + b) - a`` to ``b``, silently collapsing the error terms to zero and
+    degrading double-double to plain float64 (~1e-5 cycles of absolute pulse
+    phase; measured 2.7e-3 cycles on a v5e).  An ``optimization_barrier`` on
+    the rounded value makes the cancellation structurally invisible.
+    """
+    from jax import lax
+
+    return lax.optimization_barrier(x)
+
+
 def two_sum(a, b):
     """Error-free transform: a + b = s + e exactly (Knuth, branch-free)."""
-    s = a + b
+    s = _opaque(a + b)
     bb = s - a
     e = (a - (s - bb)) + (b - bb)
     return s, e
@@ -57,13 +74,13 @@ def two_sum(a, b):
 
 def quick_two_sum(a, b):
     """Error-free a + b = s + e, requiring |a| >= |b| (Dekker)."""
-    s = a + b
+    s = _opaque(a + b)
     e = b - (s - a)
     return s, e
 
 
 def _split(a):
-    t = _SPLITTER * a
+    t = _opaque(_SPLITTER * a)
     hi = t - (t - a)
     lo = a - hi
     return hi, lo
@@ -71,7 +88,7 @@ def _split(a):
 
 def two_prod(a, b):
     """Error-free transform: a * b = p + e exactly (Dekker, FMA-free)."""
-    p = a * b
+    p = _opaque(a * b)
     ah, al = _split(a)
     bh, bl = _split(b)
     e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
@@ -258,6 +275,141 @@ def dd_round_split(x: DD):
     f = (x.hi - k) + x.lo
     extra = jnp.round(f)
     return k + extra, f - extra
+
+
+def two_sum_np(a, b):
+    """Host-side (pure numpy, IEEE-correct on CPU) error-free a + b = s + e.
+
+    The jnp :func:`two_sum` must never be used for host-side table building:
+    under a TPU default backend it executes on-device, where f64 excess
+    precision breaks the transform (see below)."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def two_prod_np(a, b):
+    """Host-side error-free a * b = p + e (Dekker split, pure numpy)."""
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    p = a * b
+    t = np.float64(_SPLITTER) * a
+    ah = t - (t - a)
+    al = a - ah
+    t = np.float64(_SPLITTER) * b
+    bh = t - (t - b)
+    bl = b - bh
+    e = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, e
+
+
+# ---------------------------------------------------------------------------
+# Exact-by-construction folded products (TPU-safe).
+#
+# TPU f64 runs with excess-precision semantics (`--xla_allow_excess_precision`
+# is forced by some compile environments, and the hardware emulation is not
+# IEEE-correctly-rounded): the classic error-free transforms above silently
+# degrade to plain float64 there (measured: two_sum's error term collapses,
+# costing ~2.7e-3 cycles of absolute pulse phase on a v5e).  The functions
+# below never rely on rounding behavior: every intermediate product/difference
+# is *exactly representable* in float64 (bit-mask splits keep partial products
+# <= 53 significant bits), so any arithmetic that is at least as precise as
+# IEEE — including excess precision — returns the exact value.
+# ---------------------------------------------------------------------------
+
+# Static magnitude bounds (powers of two).  The *decomposition* below stays
+# correct for any values; only the headline product's exactness needs the
+# bounds, and they are generous: |F0| < 2**12 Hz (fastest known pulsar is
+# 716 Hz), |t| < 2**35 s (~1000 years of data span), |d| < 2**15 days.
+_C_POW = 12
+_T_POW = 35
+_D_POW = 15
+_SPLIT_BITS = 25
+
+
+def _scaled_split(x, pow_bound, bits=_SPLIT_BITS):
+    """Split ``x = hi + lo`` with ``hi`` a multiple of 2**(pow_bound-bits).
+
+    Given |x| < 2**pow_bound, ``hi`` carries at most ``bits+1`` significant
+    bits.  Uses only power-of-two scaling (exact in binary fp) and round —
+    no error-free transforms, so it cannot be broken by excess-precision or
+    non-IEEE f64 (TPU).  ``lo = x - hi`` is exact whenever representable and
+    otherwise off by <= ulp — harmless, since the decomposition error only
+    enters the final result multiplied by the *other* factor's low part."""
+    s = 2.0 ** (pow_bound - bits)
+    hi = jnp.round(x * (1.0 / s)) * s
+    return hi, x - hi
+
+
+def _fold(k, f, p):
+    """Accumulate p into the (integer, fraction) accumulator pair."""
+    kp = jnp.round(p)
+    return k + kp, f + (p - kp)
+
+
+def _mul_mod1_impl(c, t):
+    """(k, f) with ``c * t = k + f``, |error| <~ 2**-31 cycles, ``k``
+    integral.  The dominant partial product ch*th (<= 2**47, both factors
+    <= 26 bits) is exactly representable, so its mod-1 fold is exact under
+    any arithmetic at least as accurate as IEEE; the three small partials
+    (<= 2**21 cycles) contribute only their own rounding error."""
+    ch, cl = _scaled_split(c, _C_POW)
+    th, tl = _scaled_split(t, _T_POW)
+    k = jnp.zeros_like(t)
+    f = jnp.zeros_like(t)
+    k, f = _fold(k, f, ch * th)   # exact: 26 x 26 bits
+    k, f = _fold(k, f, ch * tl)   # <= 2**21 cycles: abs err <= 2**-31
+    k, f = _fold(k, f, cl * th)   # <= 2**21 cycles
+    f = f + cl * tl               # <= 2**-5 cycles
+    kp = jnp.round(f)
+    return k + kp, f - kp
+
+
+@jax.custom_jvp
+def mul_mod1(c, t):
+    """Folded product: ``c * t = k + f`` with ``k`` integral float64 and
+    ``f`` in [-0.5, 0.5], absolute error <~ 2**-31 cycles for |c| < 2**12,
+    |t| < 2**35.  Built only from power-of-two scaling, round, multiply and
+    benign adds — safe on TPUs whose f64 is emulated / excess-precise, where
+    the classic double-double transforms silently degrade.  The JVP routes
+    the full derivative into ``f`` (phase derivatives live in the fractional
+    part)."""
+    return _mul_mod1_impl(c, t)
+
+
+@mul_mod1.defjvp
+def _mul_mod1_jvp(primals, tangents):
+    c, t = primals
+    dc, dt = tangents
+    k, f = _mul_mod1_impl(c, t)
+    return (k, f), (jnp.zeros_like(k), t * dc + c * dt)
+
+
+_DAY_S_F = 86400.0
+
+
+def _day2sec_impl(d):
+    """``d`` days -> two float64 second-components summing to d*86400 with
+    <= ~2**-45 s error.  86400 has 10 significant bits, so the high split
+    product (<= 26+10 bits) is exact."""
+    dh, dl = _scaled_split(d, _D_POW)
+    return dh * _DAY_S_F, dl * _DAY_S_F
+
+
+@jax.custom_jvp
+def day2sec_exact(d):
+    """Day->second conversion as an unevaluated 2-term sum (TPU-safe)."""
+    return _day2sec_impl(d)
+
+
+@day2sec_exact.defjvp
+def _day2sec_jvp(primals, tangents):
+    (d,), (dd_,) = primals, tangents
+    e1, e2 = _day2sec_impl(d)
+    return (e1, e2), (dd_ * _DAY_S_F, jnp.zeros_like(d))
 
 
 def taylor_horner_dd(x: DD, coeffs: Sequence) -> DD:

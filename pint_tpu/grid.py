@@ -20,6 +20,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
 import numpy as np
 
 __all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived", "tuple_chisq"]
@@ -33,7 +34,15 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     ``fn`` refits ``fit_params`` at each grid point with ``niter`` Gauss-
     Newton steps (linearized WLS, mirroring one-shot-WLS-per-point semantics
     of the reference benchmark) and returns the resulting chi2 values.
+
+    If the model carries correlated-noise components (ECORR / PL red noise)
+    the per-point solve and chi2 switch to the GLS/Woodbury form
+    automatically (reference ``gridutils.py`` runs whatever fitter class it
+    was handed; ours dispatches on the noise structure).
     """
+    if model.noise_basis_by_component(toas)[0]:
+        return build_grid_gls_chi2_fn(model, toas, grid_params,
+                                      fit_params=fit_params, niter=niter)
     grid_params = tuple(grid_params)
     if fit_params is None:
         fit_params = tuple(p for p in model.free_params if p not in grid_params)
@@ -68,17 +77,22 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
 
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w, F0):
             v = jnp.concatenate([free_init[:nfit], gvals])
+            ones = jnp.ones((len(w), 1))
             for _ in range(niter):
                 r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
                 J = jac_fn(v, const_pv, batch, ctx)[:, :nfit]  # dfrac/dp
                 M = -J / F0  # design matrix, seconds per unit param
-                Mw = M * jnp.sqrt(w)[:, None]
+                # explicit offset column: without it the step converges to a
+                # stationary point of the UNPROFILED objective, not the joint
+                # (offset, params) minimum the reference's Offset column finds
+                A = jnp.concatenate([ones, M], axis=1)
+                Aw = A * jnp.sqrt(w)[:, None]
                 rw = r * jnp.sqrt(w)
                 # normalized least squares for conditioning
-                norms = jnp.linalg.norm(Mw, axis=0)
+                norms = jnp.linalg.norm(Aw, axis=0)
                 norms = jnp.where(norms == 0, 1.0, norms)
-                dpar, *_ = jnp.linalg.lstsq(Mw / norms, rw)
-                v = v.at[:nfit].add(dpar / norms)
+                dpar, *_ = jnp.linalg.lstsq(Aw / norms, rw)
+                v = v.at[:nfit].add(dpar[1:] / norms[1:])
             r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
             return jnp.sum(w * r * r)
 
@@ -98,6 +112,109 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     return fn, free_init
 
 
+def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
+                           fit_params: Optional[Sequence[str]] = None,
+                           niter: int = 4, chunk: int = 32):
+    """GLS counterpart of :func:`build_grid_chi2_fn` for correlated-noise
+    models (reference benchmark ``profiling/bench_chisq_grid.py`` semantics:
+    a ``GLSFitter`` refit per grid point).
+
+    Per point, each Gauss-Newton iteration solves the Woodbury-form
+    augmented normal equations ``(A^T N^-1 A + diag(phiinv)) x = A^T N^-1 r``
+    with ``A = [1 | M_timing | U_noise]`` (reference ``fitter.py:2712``) via
+    Cholesky, then the final chi2 is ``r^T C^-1 r`` with
+    ``C = diag(N) + U phi U^T`` (reference ``residuals.py:584`` →
+    ``utils.py:3069``).  Points are processed in fixed-size chunks so one
+    compiled executable covers any grid size with bounded memory.
+    """
+    grid_params = tuple(grid_params)
+    if fit_params is None:
+        fit_params = tuple(p for p in model.free_params if p not in grid_params)
+    else:
+        fit_params = tuple(fit_params)
+    all_names = fit_params + grid_params
+    model._get_compiled(toas, all_names)
+    fns = model._cache["fns"][(all_names, len(toas))]
+    eval_fn, jac_fn = fns["eval"], fns["jac_frac"]
+    entry = model._cache["data"][toas]
+    batch, ctx = entry[1], entry[2]
+    const_pv = model._const_pv()
+    nfit = len(fit_params)
+    F0 = float(model.F0.value)
+    sigma = np.asarray(model.scaled_toa_uncertainty(toas))
+    w = jnp.asarray(1.0 / sigma**2)
+    Us, ws, _ = model.noise_basis_by_component(toas)
+    U = jnp.asarray(np.hstack(Us))
+    phi = jnp.asarray(np.concatenate(ws))
+    free_init = jnp.array([float(getattr(model, p).value or 0.0) for p in all_names])
+
+    ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
+    int0 = ph0.int_
+
+    grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk)
+    if grid_key not in model._cache:
+
+        def resid_seconds(values, const_pv, batch, ctx, int0, w, F0):
+            ph, _ = eval_fn(values, const_pv, batch, ctx)
+            r = (ph.int_ - int0) + ph.frac
+            r = r - jnp.sum(r * w) / jnp.sum(w)
+            return r / F0
+
+        def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w,
+                       U, phi, F0):
+            from pint_tpu.utils import woodbury_dot
+
+            v = jnp.concatenate([free_init[:nfit], gvals])
+            ones = jnp.ones((U.shape[0], 1))
+            for _ in range(niter):
+                r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
+                J = jac_fn(v, const_pv, batch, ctx)[:, :nfit]
+                M = -J / F0
+                A = jnp.concatenate([ones, M, U], axis=1)
+                norms = jnp.linalg.norm(A, axis=0)
+                norms = jnp.where(norms == 0, 1.0, norms)
+                A = A / norms
+                phiinv = jnp.concatenate(
+                    [jnp.full(1 + nfit, 1e-40), 1.0 / phi]) / norms**2
+                mtcm = A.T @ (w[:, None] * A) + jnp.diag(phiinv)
+                mtcy = A.T @ (w * r)
+                L = jnp.linalg.cholesky(mtcm)
+                x = jsl.cho_solve((L, True), mtcy)
+                v = v.at[:nfit].add(x[1:1 + nfit] / norms[1:1 + nfit])
+            r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
+            dot, _ = woodbury_dot(1.0 / w, U, phi, r, r)
+            return dot
+
+        model._cache[grid_key] = jax.jit(jax.vmap(
+            chi2_point,
+            in_axes=(0, None, None, None, None, None, None, None, None,
+                     None)))
+    vfn = model._cache[grid_key]
+
+    def fn(points, sharding=None):
+        points = jnp.asarray(points)
+        npts = points.shape[0]
+        blk_size = chunk
+        if sharding is not None:
+            # the fixed chunk must tile evenly onto the mesh axis
+            ndev = sharding.mesh.devices.size
+            blk_size = max(chunk, ndev) // ndev * ndev
+        out = []
+        for i in range(0, npts, blk_size):
+            blk = points[i:i + blk_size]
+            pad = blk_size - blk.shape[0]
+            if pad:
+                blk = jnp.concatenate([blk, jnp.tile(blk[-1:], (pad, 1))])
+            if sharding is not None:
+                blk = jax.device_put(blk, sharding)
+            c2 = vfn(blk, free_init, const_pv, batch, ctx, int0, w, U,
+                     phi, F0)
+            out.append(c2[:blk_size - pad] if pad else c2)
+        return jnp.concatenate(out)
+
+    return fn, free_init
+
+
 def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
                niter: int = 4, mesh=None, **fitargs) -> Tuple[np.ndarray, dict]:
@@ -112,18 +229,24 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     grids = [np.asarray(v, dtype=np.float64) for v in parvalues]
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
+    gls = bool(model.noise_basis_by_component(toas)[0])
     fn, _ = build_grid_chi2_fn(model, toas, parnames, niter=niter)
     pts = jnp.asarray(mesh_pts)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        npts = pts.shape[0]
-        ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-        pad = (-npts) % ndev
-        if pad:
-            pts = jnp.concatenate([pts, jnp.tile(pts[-1:], (pad, 1))])
-        pts = jax.device_put(pts, NamedSharding(mesh, P(mesh.axis_names[0])))
-        chi2 = np.asarray(fn(pts))[:npts]
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        if gls:
+            # chunked path: each fixed-size chunk is sharded on entry
+            chi2 = np.asarray(fn(pts, sharding=sharding))
+        else:
+            npts = pts.shape[0]
+            ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+            pad = (-npts) % ndev
+            if pad:
+                pts = jnp.concatenate([pts, jnp.tile(pts[-1:], (pad, 1))])
+            pts = jax.device_put(pts, sharding)
+            chi2 = np.asarray(fn(pts))[:npts]
     else:
         chi2 = np.asarray(fn(pts))
     return chi2.reshape(shape), {}

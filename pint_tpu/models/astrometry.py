@@ -152,6 +152,11 @@ class AstrometryEcliptic(Astrometry):
     def validate(self):
         if self.ELONG.value is None or self.ELAT.value is None:
             raise MissingParameter("AstrometryEcliptic", "ELONG/ELAT")
+        if self.POSEPOCH.value is None and (self.PMELONG.value or self.PMELAT.value):
+            # fall back to PEPOCH like the reference (astrometry.py:753 family)
+            pep = getattr(self._parent, "PEPOCH", None)
+            if pep is not None and pep.value is not None:
+                self.POSEPOCH.value = pep.value
 
     def build_context(self, toas):
         self._pe_cache = (float(self.POSEPOCH.value)

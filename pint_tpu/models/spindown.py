@@ -11,11 +11,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.dd import dd_from_longdouble, dd_sub, taylor_horner_dd
+from pint_tpu.dd import day2sec_exact, mul_mod1
 from pint_tpu.exceptions import MissingParameter
 from pint_tpu.models.parameter import MJDParameter, prefixParameter
 from pint_tpu.models.timing_model import DAY_S, PhaseComponent
-from pint_tpu.phase import phase_from_dd
+from pint_tpu.phase import Phase
 
 __all__ = ["Spindown"]
 
@@ -55,25 +55,60 @@ class Spindown(PhaseComponent):
     def build_context(self, toas):
         return {}
 
-    def get_dt_dd(self, pv, batch, delay):
-        """(tdb - delay - PEPOCH) seconds as DD.
+    def _time_components(self, pv, batch, delay):
+        """Decompose dt = (tdb - delay - PEPOCH) seconds into exact float64
+        "fold" components plus a small float64 tail (TPU-safe: no error-free
+        transforms — see dd.py on f64 excess precision).
 
-        PEPOCH flows in as a traced DD scalar (pv["PEPOCH"]); when unset, the
-        batch reference epoch tdb0 stands in (reference ``spindown.py:125``
-        uses the first TOA).
+        Returns ``(folds, tail)``: dt = sum(folds) + tail (to <= ~2**-45 s),
+        where each fold term is an exact float64 the F0 product must be
+        folded mod 1 against.  ``tail`` is dominated by the accumulated
+        delay (up to ~500 s Roemer), so ``F0 * tail`` reaches ~1e5 cycles —
+        but it is a *single float64 product* (one rounding, ~1e-11 cycles
+        absolute) added to the fold fraction, and ``Phase.make`` renormalizes
+        the carry, so no precision argument rests on |tail| being small.
         """
-        from pint_tpu.dd import dd_mul
-
-        t = dd_sub(batch.tdb_seconds(), delay)
-        if self.PEPOCH.value is None:
-            return t
-        offset = dd_mul(dd_sub(pv["PEPOCH"], batch.tdb0), DAY_S)
-        return dd_sub(t, offset)
+        T = batch.tdb_seconds()  # exact host-built pair
+        folds = [T.hi]
+        tail = T.lo - delay
+        if self.PEPOCH.value is not None and "PEPOCH" in pv:
+            pe = pv["PEPOCH"]
+            # same-scale MJDs: the hi difference is Sterbenz-exact, the
+            # day->sec scaling splits into two exact products
+            e1, e2 = day2sec_exact(pe.hi - batch.tdb0)
+            folds += [-e1, -e2]
+            tail = tail - pe.lo * DAY_S
+        return folds, tail
 
     def phase_func(self, pv, batch, ctx, delay):
-        dt = self.get_dt_dd(pv, batch, delay)
-        coeffs = [jnp.float64(0.0)] + self.get_spin_terms(pv)
-        return phase_from_dd(taylor_horner_dd(dt, coeffs))
+        """Phase = sum_n F_n dt^(n+1)/(n+1)!.
+
+        The dominant F0*dt term (~1e10 cycles needing 1e-9) is evaluated by
+        folding each exact time component mod 1 (``mul_mod1``); every other
+        contribution is orders of magnitude below float64's ~1e-11-cycle
+        error at these magnitudes and uses plain arithmetic (reference
+        ``spindown.py:142`` semantics).
+        """
+        import math
+
+        folds, tail = self._time_components(pv, batch, delay)
+        terms = self.get_spin_terms(pv)
+        F0 = jnp.float64(terms[0])
+        k = jnp.zeros(batch.ntoas)
+        f = jnp.zeros(batch.ntoas)
+        for t in folds:
+            ki, fi = mul_mod1(F0, jnp.broadcast_to(jnp.asarray(t), (batch.ntoas,)))
+            k = k + ki
+            f = f + fi
+        dt64 = sum(folds) + tail  # collapsed dt: fine for the F1+ terms
+        f = f + F0 * tail
+        if len(terms) > 1:
+            acc = jnp.zeros(batch.ntoas)
+            for i in range(len(terms) - 1, 0, -1):
+                c = jnp.asarray(terms[i], dtype=jnp.float64) / math.factorial(i + 1)
+                acc = acc * dt64 + c
+            f = f + acc * dt64 * dt64
+        return Phase.make(k, f)
 
     def change_pepoch(self, new_epoch, toas=None, delay=None):
         """Shift PEPOCH, adjusting F-terms (reference ``spindown.py`` PEPOCH move)."""

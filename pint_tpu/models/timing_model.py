@@ -656,16 +656,35 @@ class TimingModel:
         """One host pass over the correlated-noise components: returns
         (bases list, weights list, {component: (offset, size)}).  Single
         source of truth for the column layout used by
-        ``noise_model_basis_weight``/``noise_model_dimensions``."""
+        ``noise_model_basis_weight``/``noise_model_dimensions``.
+
+        Cached per (TOAs version, noise parameter values): fitters and the
+        grid rebuild these bases several times per call, and the ECORR
+        quantization + Fourier matrices are O(N_toa * n_basis) host work.
+        """
+        import weakref
+
+        comps = [(n, c) for n, c in self.components.items()
+                 if getattr(c, "kind", None) == "noise"
+                 and hasattr(c, "basis_weight_pair")]
+        pkey = tuple(
+            (name, p, str(c._params_dict[p].value))
+            for name, c in comps for p in c.params
+        )
+        cache = self._cache.setdefault("noise_basis", weakref.WeakKeyDictionary())
+        ver = getattr(toas, "_version", 0)
+        hit = cache.get(toas)
+        if hit is not None and hit[0] == (ver, pkey):
+            return hit[1]
         Us, ws, dims = [], [], {}
         off = 0
-        for name, c in self.components.items():
-            if getattr(c, "kind", None) == "noise" and hasattr(c, "basis_weight_pair"):
-                U, w = c.basis_weight_pair(self, toas)
-                Us.append(U)
-                ws.append(w)
-                dims[name] = (off, U.shape[1])
-                off += U.shape[1]
+        for name, c in comps:
+            U, w = c.basis_weight_pair(self, toas)
+            Us.append(U)
+            ws.append(w)
+            dims[name] = (off, U.shape[1])
+            off += U.shape[1]
+        cache[toas] = ((ver, pkey), (Us, ws, dims))
         return Us, ws, dims
 
     def noise_model_dimensions(self, toas) -> Dict[str, tuple]:

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu import c as C_M_S
-from pint_tpu.dd import DD, two_sum as _two_sum_np
+from pint_tpu.dd import DD, two_prod_np as _two_prod_np, two_sum_np as _two_sum_np
 from pint_tpu.io.tim import RawTOA, format_toa_line, read_tim_file
 from pint_tpu.logging import log
 from pint_tpu.observatory import get_observatory
@@ -48,6 +48,7 @@ class TOABatch(NamedTuple):
 
     tdb: DD          # (N,) MJD, double-double
     tdb0: jnp.ndarray  # scalar reference MJD (integer-valued)
+    tdb_s: DD        # (N,) seconds since tdb0, exact host-built pair
     freq: jnp.ndarray  # (N,) MHz
     error_us: jnp.ndarray  # (N,) microseconds
     ssb_obs_pos: jnp.ndarray  # (N,3) light-seconds
@@ -62,10 +63,9 @@ class TOABatch(NamedTuple):
         return self.freq.shape[0]
 
     def tdb_seconds(self) -> DD:
-        """Seconds since tdb0 as double-double."""
-        from pint_tpu.dd import dd_mul, dd_sub
-
-        return dd_mul(dd_sub(self.tdb, self.tdb0), DAY_S)
+        """Seconds since tdb0 as a double-double pair (host-precomputed:
+        in-trace day->sec dd arithmetic is not TPU-safe, see dd.py)."""
+        return self.tdb_s
 
 
 @dataclass(eq=False)  # identity hash: TOAs are weak-cache keys in TimingModel
@@ -386,8 +386,6 @@ class TOAs:
     # ------------------------------------------------------------------
     def to_batch(self, tdb0: Optional[float] = None) -> TOABatch:
         """Freeze into a device pytree (light-second units, dd times)."""
-        from pint_tpu.dd import dd_from_longdouble
-
         if self.tdb is None:
             raise ValueError("Run compute_TDBs/compute_posvels before to_batch()")
         if self.ssb_obs_pos_km is None:
@@ -403,12 +401,21 @@ class TOAs:
             # degraded-longdouble platform: rebuild the exact pair carried
             # from the native parser instead of the (lossy) longdouble column
             hi, lo = _two_sum_np(np.asarray(self.tdb, np.float64), self.tdb_lo)
-            tdb_dd = DD(jnp.asarray(hi), jnp.asarray(lo))
         else:
-            tdb_dd = dd_from_longdouble(self.tdb)
+            hi = np.asarray(self.tdb, dtype=np.float64)
+            lo = np.asarray(self.tdb - hi.astype(np.longdouble), dtype=np.float64)
+        tdb_dd = DD(jnp.asarray(hi), jnp.asarray(lo))
+        # seconds since tdb0 as an exact host-built pair (pure-numpy EFTs:
+        # device-side day->sec dd conversion is unsafe under TPU f64 excess
+        # precision, see dd.py)
+        d_hi = hi - tdb0  # same-scale MJDs: Sterbenz-exact
+        s_hi, s_err = _two_prod_np(d_hi, DAY_S)
+        s_hi, s_err2 = _two_sum_np(s_hi, s_err + lo * DAY_S)
+        tdb_s = DD(jnp.asarray(s_hi), jnp.asarray(s_err2))
         return TOABatch(
             tdb=tdb_dd,
             tdb0=jnp.float64(tdb0),
+            tdb_s=tdb_s,
             freq=jnp.asarray(self.freq_mhz),
             error_us=jnp.asarray(self.error_us),
             ssb_obs_pos=jnp.asarray(self.ssb_obs_pos_km / C_KM_S),

@@ -13,6 +13,7 @@ import math
 from typing import NamedTuple, Sequence
 
 import jax.numpy as jnp
+import jax.scipy.linalg as jsl
 import numpy as np
 
 __all__ = [
@@ -95,13 +96,14 @@ def weighted_mean(arr, weights, axis=None):
 def normalize_designmatrix(M, params=None):
     """Scale each design-matrix column to unit L2 norm (reference ``utils.py:2872``).
 
-    Returns (M_normalized, norms).  Zero columns are left untouched (norm 1)
-    so downstream SVD thresholding can flag them as degenerate.
+    Returns (M_normalized, norms).  Zero (degenerate) columns get norm 1 so
+    no caller divides by zero; they surface as near-zero singular values in
+    the downstream SVD threshold instead.
     """
     M = jnp.asarray(M)
     norms = jnp.linalg.norm(M, axis=0)
     safe = jnp.where(norms == 0, 1.0, norms)
-    return M / safe, norms
+    return M / safe, safe
 
 
 def woodbury_dot(Ndiag, U, Phidiag, x, y):
@@ -118,8 +120,10 @@ def woodbury_dot(Ndiag, U, Phidiag, x, y):
     Ut_Ninv_y = U.T @ Ninv_y
     Sigma = jnp.diag(1.0 / Phidiag) + U.T @ (U / Ndiag[:, None])
     cf = jnp.linalg.cholesky(Sigma)
-    z = jnp.linalg.solve(cf, Ut_Ninv_y)
-    zx = jnp.linalg.solve(cf, Ut_Ninv_x)
+    # triangular solves, not jnp.linalg.solve: XLA's LU decomposition has no
+    # f64 TPU lowering, while Cholesky + solve_triangular do
+    z = jsl.solve_triangular(cf, Ut_Ninv_y, lower=True)
+    zx = jsl.solve_triangular(cf, Ut_Ninv_x, lower=True)
     dot = x @ Ninv_y - zx @ z
     logdet = (
         jnp.sum(jnp.log(Ndiag))

@@ -1,0 +1,203 @@
+"""grid.py under test: per-point parity with converged fitters, mesh
+sharding equivalence, and the sharded GLS solve.
+
+Reference semantics: ``gridutils.py:112 doonefit`` runs a full fitter at
+each grid point with the grid parameters frozen; ``grid_chisq``
+(``gridutils.py:164``) fans points over an executor.  Here the per-point
+refit happens inside one jitted batch, so these tests pin (a) agreement
+with an honest per-point fit, (b) that sharding the point axis over a
+device mesh changes nothing but the layout.
+"""
+
+import numpy as np
+import pytest
+
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+
+
+@pytest.fixture(scope="module")
+def ngc_fit():
+    import os
+
+    if not os.path.exists(NGC_PAR):
+        pytest.skip("reference example par unavailable")
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    model = get_model(NGC_PAR)
+    toas = make_fake_toas_uniform(53400, 54800, 60, model, error_us=10.0,
+                                  add_noise=True, rng=np.random.default_rng(7))
+    f = WLSFitter(toas, model)
+    f.fit_toas(maxiter=4)
+    return f
+
+
+def _grids(f, npts):
+    dF0 = 4 * f.errors.get("F0", 1e-10)
+    dF1 = 4 * f.errors.get("F1", 1e-18)
+    g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, npts)
+    g1 = np.linspace(f.model.F1.value - dF1, f.model.F1.value + dF1, npts)
+    return g0, g1
+
+
+class TestGridVsPerPointFit:
+    def test_grid_matches_converged_wls_per_point(self, ngc_fit):
+        """grid_chisq == a converged per-point WLSFitter with grid params
+        frozen (reference ``gridutils.py:112`` semantics).
+
+        Pulse numbers are pinned at the best-fit model for the per-point
+        fits: with ``nearest`` tracking a frozen-F0 offset lets the fitter
+        slide into phase-wrap-aliased minima (e.g. DM shifted by ~1200),
+        which the grid's coherent fixed-numbering objective rightly
+        excludes — the same distinction the reference draws between its
+        track modes (``residuals.py:331``)."""
+        import copy
+
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.grid import grid_chisq
+
+        f = ngc_fit
+        toas = copy.deepcopy(f.toas)
+        toas.compute_pulse_numbers(f.model)
+        g0, g1 = _grids(f, 3)
+        chi2_grid, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        for i, v0 in enumerate(g0):
+            for j, v1 in enumerate(g1):
+                m = copy.deepcopy(f.model)
+                m.F0.value = float(v0)
+                m.F0.frozen = True
+                m.F1.value = float(v1)
+                m.F1.frozen = True
+                ff = WLSFitter(toas, m, track_mode="use_pulse_numbers")
+                chi2_pt = ff.fit_toas(maxiter=6)
+                assert chi2_grid[i, j] == pytest.approx(chi2_pt, rel=1e-6), \
+                    f"grid point ({i},{j})"
+
+    def test_tuple_chisq_matches_grid(self, ngc_fit):
+        from pint_tpu.grid import grid_chisq, tuple_chisq
+
+        f = ngc_fit
+        g0, g1 = _grids(f, 3)
+        chi2_grid, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        pts = [(v0, v1) for v0 in g0 for v1 in g1]
+        chi2_t, _ = tuple_chisq(f, ("F0", "F1"), pts)
+        assert np.allclose(np.asarray(chi2_t).reshape(3, 3), chi2_grid,
+                           rtol=1e-9)
+
+    def test_grid_chisq_derived(self, ngc_fit):
+        """Derived-parameter grid: F0 = g/(2pi) style mapping
+        (reference ``gridutils.py:390``)."""
+        from pint_tpu.grid import grid_chisq, grid_chisq_derived
+
+        f = ngc_fit
+        g0, g1 = _grids(f, 3)
+        chi2_ref, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        chi2_d, out_grids, _ = grid_chisq_derived(
+            f, ("F0", "F1"),
+            (lambda x, y: 2.0 * x, lambda x, y: y),
+            (g0 / 2.0, g1))
+        assert np.allclose(chi2_d, chi2_ref, rtol=1e-6)
+        assert out_grids[0].shape == (3, 3)
+
+
+class TestMeshSharding:
+    def test_grid_chisq_mesh_matches_unsharded(self, ngc_fit, eight_devices):
+        """Sharding grid points over a 2x4 mesh must be layout-only
+        (SURVEY §2c mechanism 1: the reference's process-pool axis)."""
+        from jax.sharding import Mesh
+
+        from pint_tpu.grid import grid_chisq
+
+        f = ngc_fit
+        g0, g1 = _grids(f, 4)
+        chi2_plain, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        mesh = Mesh(np.array(eight_devices).reshape(2, 4), ("grid", "aux"))
+        chi2_mesh, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), mesh=mesh)
+        assert np.allclose(chi2_mesh, chi2_plain, rtol=1e-12, atol=1e-9)
+
+    def test_grid_point_count_not_multiple_of_devices(self, ngc_fit,
+                                                      eight_devices):
+        """Padding: 3x3=9 points on 8 devices."""
+        from jax.sharding import Mesh
+
+        from pint_tpu.grid import grid_chisq
+
+        f = ngc_fit
+        g0, g1 = _grids(f, 3)
+        chi2_plain, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        mesh = Mesh(np.array(eight_devices), ("grid",))
+        chi2_mesh, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), mesh=mesh)
+        assert np.allclose(chi2_mesh, chi2_plain, rtol=1e-12, atol=1e-9)
+
+
+@pytest.fixture(scope="module")
+def gls_fit():
+    """Small correlated-noise workload: EFAC+EQUAD+ECORR+red noise."""
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = [
+        "PSR TESTGLS\n", "RAJ 05:00:00 1\n", "DECJ 15:00:00 1\n",
+        "F0 99.123456789 1\n", "F1 -1.1e-14 1\n", "PEPOCH 55500\n",
+        "DM 12.5 1\n",
+        "EFAC mjd 53000 58000 1.1\n",
+        "EQUAD mjd 53000 58000 0.5\n",
+        "ECORR mjd 53000 58000 0.8\n",
+        "TNRedAmp -13.5\n", "TNRedGam 3.5\n", "TNRedC 10\n",
+        "UNITS TDB\n",
+    ]
+    model = get_model(par)
+    # clustered epochs so ECORR's quantization basis is non-trivial
+    rng = np.random.default_rng(3)
+    base = np.linspace(55000, 56000, 25)
+    mjds = np.sort(np.concatenate([base, base + 20 / 1440.0]))
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=1.0, add_noise=True,
+                                   rng=rng)
+    f = GLSFitter(toas, model)
+    f.fit_toas(maxiter=2)
+    return f
+
+
+class TestGLSGrid:
+    def test_gls_grid_matches_per_point_gls(self, gls_fit):
+        """The correlated-noise grid path: each point equals a converged
+        per-point GLSFitter with the grid params frozen."""
+        import copy
+
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.grid import grid_chisq
+
+        f = gls_fit
+        dF0 = 3 * f.errors.get("F0", 1e-10)
+        g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 3)
+        g1 = np.array([f.model.F1.value])
+        chi2_grid, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=4)
+        for i, v0 in enumerate(g0):
+            m = copy.deepcopy(f.model)
+            m.F0.value = float(v0)
+            m.F0.frozen = True
+            m.F1.frozen = True
+            ff = GLSFitter(f.toas, m)
+            chi2_pt = ff.fit_toas(maxiter=4)
+            assert chi2_grid[i, 0] == pytest.approx(chi2_pt, rel=1e-4), \
+                f"GLS grid point {i}"
+
+    def test_gls_grid_mesh_matches_unsharded(self, gls_fit, eight_devices):
+        """Sharded GLS solve: the chunked Woodbury grid under a device mesh
+        equals the single-device result."""
+        from jax.sharding import Mesh
+
+        from pint_tpu.grid import grid_chisq
+
+        f = gls_fit
+        dF0 = 3 * f.errors.get("F0", 1e-10)
+        g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 4)
+        g1 = np.linspace(f.model.F1.value - 1e-16, f.model.F1.value + 1e-16, 4)
+        chi2_plain, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        mesh = Mesh(np.array(eight_devices), ("grid",))
+        chi2_mesh, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), mesh=mesh)
+        assert np.allclose(chi2_mesh, chi2_plain, rtol=1e-10, atol=1e-8)
