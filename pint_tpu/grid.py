@@ -25,6 +25,8 @@ import numpy as np
 
 __all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived", "tuple_chisq"]
 
+_warned_executor = False
+
 
 def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                        fit_params: Optional[Sequence[str]] = None,
@@ -221,9 +223,18 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     """Chi2 over an outer-product grid (reference ``gridutils.py:164`` API).
 
     ``executor``/``ncpu``/``chunksize`` are accepted for signature parity but
-    unused — batching happens on-device.  Pass ``mesh`` (a
+    are no-ops — points are batched on-device, which replaces the reference's
+    process pool (warned once at runtime).  Pass ``mesh`` (a
     ``jax.sharding.Mesh`` with a 'grid' axis) to shard points across devices.
     """
+    global _warned_executor
+    if (executor is not None or ncpu not in (None, 1)) and not _warned_executor:
+        from pint_tpu.logging import log
+
+        _warned_executor = True
+        log.warning("grid_chisq: executor/ncpu are no-ops here - grid points "
+                    "are batched on-device (pass mesh= to use multiple "
+                    "devices)")
     model, toas = ftr.model, ftr.toas
     parnames = tuple(parnames)
     grids = [np.asarray(v, dtype=np.float64) for v in parvalues]

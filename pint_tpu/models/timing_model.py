@@ -443,10 +443,34 @@ class TimingModel:
 
     # -- public evaluation API ---------------------------------------------
     def delay(self, toas, cutoff_component: str = "", include_last: bool = True):
-        """Total delay in seconds (float64 ndarray)."""
-        c = self._get_compiled(toas, tuple(self.free_params))
-        _, d = c["eval"](self._free_values(c["free_names"]))
-        return np.asarray(d)
+        """Total delay in seconds (float64 ndarray).
+
+        ``cutoff_component`` truncates the ordered accumulation at the named
+        component — the partial delay earlier components have produced when
+        that component runs (reference ``timing_model.py:1565``'s
+        cutoff/include_last semantics, used e.g. for barycentering: the
+        delay *before* the binary model).
+        """
+        if not cutoff_component:
+            c = self._get_compiled(toas, tuple(self.free_params))
+            _, d = c["eval"](self._free_values(c["free_names"]))
+            return np.asarray(d)
+        comps = self.delay_components
+        by_id = {id(cc): n for n, cc in self.components.items()}
+        names = [by_id[id(cc)] for cc in comps]  # in evaluation order
+        if cutoff_component not in names:
+            raise ValueError(f"No delay component named {cutoff_component!r}")
+        stop = names.index(cutoff_component) + (1 if include_last else 0)
+        self._get_compiled(toas, tuple(self.free_params))  # warm batch/ctx
+        entry = self._cache["data"][toas]
+        batch, ctx = entry[1], entry[2]
+        pv = dict(self._const_pv())
+        for nm in self.free_params:
+            pv[nm] = float(getattr(self, nm).value or 0.0)
+        acc = jnp.zeros(batch.ntoas)
+        for name, comp in list(zip(names, comps))[:stop]:
+            acc = acc + comp.delay_func(pv, batch, ctx[name], acc)
+        return np.asarray(acc)
 
     def phase(self, toas, abs_phase: bool = False) -> Phase:
         """Model phase at each TOA (Phase pytree on host)."""

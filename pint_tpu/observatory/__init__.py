@@ -58,7 +58,7 @@ class Observatory:
         raise KeyError(f"Unknown observatory {name!r}")
 
     # -- clock chain -------------------------------------------------------
-    def _site_clock_files(self) -> List[ClockFile]:
+    def _site_clock_files(self, limits: str = "warn") -> List[ClockFile]:
         return []
 
     def clock_corrections(self, utc_mjd, include_gps=None, include_bipm=None,
@@ -70,7 +70,7 @@ class Observatory:
         include_bipm = self.include_bipm if include_bipm is None else include_bipm
         bipm_version = bipm_version or self.bipm_version
         corr = np.zeros_like(utc_mjd)
-        for cf in self._site_clock_files():
+        for cf in self._site_clock_files(limits=limits):
             if cf is not None:
                 corr = corr + cf.evaluate(utc_mjd, limits=limits)
         if include_gps:
@@ -129,9 +129,9 @@ class TopoObs(Observatory):
     def earth_location_itrf(self):
         return self.itrf_xyz
 
-    def _site_clock_files(self):
+    def _site_clock_files(self, limits: str = "warn"):
         return [
-            find_clock_file(n, fmt=self.clock_fmt)
+            find_clock_file(n, fmt=self.clock_fmt, limits=limits)
             for n in self.clock_file_names
         ]
 

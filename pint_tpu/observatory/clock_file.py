@@ -117,8 +117,10 @@ def read_tempo_clock_file(path: str, obscode: Optional[str] = None, **kw) -> Clo
 def read_tempo2_clock_file(path: str, **kw) -> ClockFile:
     """Parse a TEMPO2 ``.clk`` file (reference ``clock_file.py:441``).
 
-    First non-comment line is the header ``TIMEFROM TIMETO [flags]``; data
-    lines are ``MJD offset_seconds``.
+    The header is the first ``#``-prefixed line (``# UTC(obs) UTC(GPS)``
+    style); ``##`` lines and later ``#`` lines are comments.  Data lines are
+    ``MJD offset_seconds [uncertainty flags...]``; unparseable lines are
+    skipped (a bare-text header line therefore also falls through safely).
     """
     mjds: List[float] = []
     corr: List[float] = []
@@ -126,17 +128,19 @@ def read_tempo2_clock_file(path: str, **kw) -> ClockFile:
     with open(path) as f:
         for ln in f:
             s = ln.strip()
-            if not s or s.startswith("#"):
+            if not s:
                 continue
-            if not hdrline:
-                hdrline = s
+            if s.startswith("#"):
+                if not hdrline and not s.startswith("##"):
+                    hdrline = s
                 continue
             fields = s.split()
             try:
-                mjds.append(float(fields[0]))
-                corr.append(float(fields[1]) * 1e6)  # seconds -> us
+                m_, c_ = float(fields[0]), float(fields[1])
             except (ValueError, IndexError):
-                continue
+                continue  # bare-text header or malformed line
+            mjds.append(m_)
+            corr.append(c_ * 1e6)  # seconds -> us
     return ClockFile(mjds, corr, filename=os.path.basename(path), hdrline=hdrline, **kw)
 
 

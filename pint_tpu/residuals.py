@@ -125,7 +125,10 @@ class Residuals:
 
     @property
     def dof(self) -> int:
-        return len(self.toas) - len(self.model.free_params) - 1
+        """N_toa - n_free - (1 for the implicit mean offset, only when one is
+        actually being subtracted; an explicit PhaseOffset's PHOFF is already
+        counted in free_params).  Reference ``residuals.py`` dof accounting."""
+        return len(self.toas) - len(self.model.free_params) - int(self.subtract_mean)
 
     @property
     def reduced_chi2(self) -> float:

@@ -16,6 +16,7 @@ Counterpart of reference ``toa.py`` (``get_TOAs`` ``toa.py:109``, ``TOAs``
 from __future__ import annotations
 
 import hashlib
+import os
 import pickle
 from dataclasses import dataclass, field, replace
 from fractions import Fraction
@@ -495,6 +496,13 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
             planets = bool(model.PLANET_SHAPIRO.value)
     if include_bipm is None:
         include_bipm = True
+    pickle_key = (ephem, planets, include_gps, include_bipm, bipm_version,
+                  limits)
+    if usepickle:
+        t = _load_toa_pickle(timfile, pickle_key)
+        if t is not None:
+            log.info(f"Loaded {len(t)} TOAs from pickle cache for {timfile}")
+            return t
     raw, commands = read_tim_file(timfile)
     if not raw:
         raise ValueError(f"No TOAs found in {timfile}")
@@ -505,7 +513,67 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
     t.compute_posvels(ephem=ephem or "DE440", planets=planets)
     log.info(f"Loaded {len(t)} TOAs from {timfile} "
              f"(ephem={t.ephem}, planets={planets}, bipm={include_bipm})")
+    if usepickle:
+        _save_toa_pickle(timfile, pickle_key, t)
     return t
+
+
+PICKLE_SUFFIX = ".pint_tpu_toas.pickle"
+
+
+def _tim_file_set(timfile: str, _seen=None) -> List[str]:
+    """The tim file plus every (recursively) INCLUDEd file, resolved the
+    same way the parser resolves them (reference ``check_hashes`` covers all
+    constituent files, ``toa.py:1856``)."""
+    _seen = _seen if _seen is not None else []
+    if timfile in _seen or not os.path.exists(timfile):
+        return _seen
+    _seen.append(timfile)
+    with open(timfile) as f:
+        for ln in f:
+            fields = ln.split()
+            if len(fields) >= 2 and fields[0].upper() == "INCLUDE":
+                _tim_file_set(os.path.join(os.path.dirname(timfile),
+                                           fields[1]), _seen)
+    return _seen
+
+
+def _tim_hashes(timfile: str) -> Dict[str, str]:
+    return {p: _file_hash(p) for p in _tim_file_set(timfile)}
+
+
+def _load_toa_pickle(timfile: str, key) -> Optional[TOAs]:
+    """Hash-invalidated TOA pickle cache (reference ``toa.py:333,373`` load
+    path + ``check_hashes`` ``toa.py:1856``): the cache is served only when
+    the SHA256 of the tim file *and every INCLUDEd file* and the pipeline
+    settings all match."""
+    import pickle
+
+    path = timfile + PICKLE_SUFFIX
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            d = pickle.load(f)
+        if d.get("tim_sha") != _tim_hashes(timfile) or d.get("key") != key:
+            log.info(f"TOA pickle cache for {timfile} is stale; rebuilding")
+            return None
+        return d["toas"]
+    except Exception as e:
+        log.warning(f"Failed to read TOA pickle {path}: {e}")
+        return None
+
+
+def _save_toa_pickle(timfile: str, key, t: TOAs) -> None:
+    import pickle
+
+    path = timfile + PICKLE_SUFFIX
+    try:
+        with open(path, "wb") as f:
+            pickle.dump({"tim_sha": _tim_hashes(timfile), "key": key,
+                         "toas": t}, f)
+    except OSError as e:  # read-only data dir: cache is best-effort
+        log.warning(f"Could not write TOA pickle {path}: {e}")
 
 
 def _merge_time_pair(toas_list, hi_name, lo_name):

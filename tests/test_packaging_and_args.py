@@ -1,0 +1,105 @@
+"""Packaging metadata + formerly-dead arguments (VERDICT r2 directive #6).
+
+- pyproject.toml declares the same 14 console scripts as the reference
+  (``pyproject.toml:60-74``) and every entry point resolves.
+- ``get_TOAs(usepickle=True)`` is a real hash-invalidated cache
+  (reference ``toa.py:333,373,1856``).
+- ``TimingModel.delay(cutoff_component=...)`` truncates the ordered delay
+  accumulation (reference ``timing_model.py:1565``).
+- ``Residuals.dof`` counts the implicit offset only when one is fitted.
+"""
+
+import importlib
+import os
+import tomllib
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+NGC_TIM = "/root/reference/src/pint/data/examples/NGC6440E.tim"
+
+
+class TestPackaging:
+    def test_console_scripts_resolve(self):
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            meta = tomllib.load(f)
+        scripts = meta["project"]["scripts"]
+        assert len(scripts) == 14
+        for name, target in scripts.items():
+            mod, func = target.split(":")
+            m = importlib.import_module(mod)
+            assert callable(getattr(m, func)), name
+
+    def test_package_metadata(self):
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            meta = tomllib.load(f)
+        assert meta["project"]["name"] == "pint-tpu"
+        assert "jax" in meta["project"]["dependencies"]
+
+
+@pytest.mark.skipif(not os.path.exists(NGC_TIM), reason="no reference data")
+class TestUsepickle:
+    def test_pickle_roundtrip_and_invalidation(self, tmp_path):
+        import shutil
+
+        from pint_tpu.toa import PICKLE_SUFFIX, get_TOAs
+
+        timf = str(tmp_path / "t.tim")
+        shutil.copy(NGC_TIM, timf)
+        t1 = get_TOAs(timf, usepickle=True)
+        cache = timf + PICKLE_SUFFIX
+        assert os.path.exists(cache)
+        t2 = get_TOAs(timf, usepickle=True)
+        assert np.array_equal(np.asarray(t2.tdb, np.float64),
+                              np.asarray(t1.tdb, np.float64))
+        # different settings -> cache miss (not wrong data)
+        t3 = get_TOAs(timf, usepickle=True, planets=True)
+        assert "jupiter" in {k.lower() for k in (t3.planet_pos_km or {})}
+        # edit the tim file -> hash invalidation (append a copy of the last
+        # TOA line with a shifted MJD, preserving the file's own format)
+        with open(timf) as f:
+            last = [ln for ln in f if ln.strip()][-1]
+        old_mjd = last.split()[2]
+        new_mjd = str(float(old_mjd) + 1.0).ljust(len(old_mjd), "0")[:len(old_mjd)]
+        with open(timf, "a") as f:
+            f.write(last.rstrip("\n").replace(old_mjd, new_mjd) + "\n")
+        t4 = get_TOAs(timf, usepickle=True)
+        assert len(t4) == len(t1) + 1
+
+
+@pytest.mark.skipif(not os.path.exists(NGC_TIM), reason="no reference data")
+class TestCutoffDelay:
+    def test_cutoff_component(self):
+        from pint_tpu.models import get_model_and_toas
+
+        m, t = get_model_and_toas(NGC_PAR, NGC_TIM)
+        full = m.delay(t)
+        # delay up to (excluding) the dispersion component = astrometry+shapiro
+        part = m.delay(t, cutoff_component="DispersionDM", include_last=False)
+        withdm = m.delay(t, cutoff_component="DispersionDM", include_last=True)
+        dm_delay = withdm - part
+        assert np.all(dm_delay > 0)  # dispersion always delays
+        assert not np.allclose(part, full)
+        # last delay component (in EVALUATION order) inclusive == full delay
+        by_id = {id(c): n for n, c in m.components.items()}
+        names = [by_id[id(c)] for c in m.delay_components]
+        again = m.delay(t, cutoff_component=names[-1], include_last=True)
+        assert np.allclose(again, full, atol=1e-12)
+        with pytest.raises(ValueError):
+            m.delay(t, cutoff_component="NoSuchComponent")
+
+
+@pytest.mark.skipif(not os.path.exists(NGC_TIM), reason="no reference data")
+class TestDofAccounting:
+    def test_dof_counts_offset_only_when_subtracted(self):
+        from pint_tpu.models import get_model_and_toas
+        from pint_tpu.residuals import Residuals
+
+        m, t = get_model_and_toas(NGC_PAR, NGC_TIM)
+        r_mean = Residuals(t, m, subtract_mean=True)
+        r_nomean = Residuals(t, m, subtract_mean=False)
+        nfree = len(m.free_params)
+        assert r_mean.dof == len(t) - nfree - 1
+        assert r_nomean.dof == len(t) - nfree

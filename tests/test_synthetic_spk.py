@@ -1,0 +1,195 @@
+"""Synthetic SPK kernels: the test writes its own DAF/SPK type-2/3 files
+from the public format spec and asserts :class:`SPKEphemeris` reproduces the
+Chebyshev polynomials exactly (VERDICT r2 directive #4a — unblocks the SPK
+path in a kernel-less image; reference ``solar_system_ephemerides.py:201``).
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+DAY_S = 86400.0
+J2000_MJD = 51544.5
+
+
+def _cheb_records(rng, n_rec, ncoef, init, intlen, ncomp=3, scale=1e8):
+    """Random smooth Chebyshev records: (n_rec, 2 + ncomp*ncoef) doubles."""
+    recs = np.zeros((n_rec, 2 + ncomp * ncoef))
+    for i in range(n_rec):
+        mid = init + (i + 0.5) * intlen
+        radius = intlen / 2.0
+        recs[i, 0] = mid
+        recs[i, 1] = radius
+        # decaying coefficients so the polynomial is smooth
+        decay = scale * 0.5 ** np.arange(ncoef)
+        recs[i, 2:] = (rng.standard_normal(ncomp * ncoef)
+                       * np.tile(decay, ncomp))
+    return recs
+
+
+def _write_spk(path, segments, little_endian=True):
+    """Minimal DAF/SPK writer: one summary record, data after it.
+
+    ``segments``: list of dicts with target/center/dtype/records/init/intlen.
+    """
+    endian = "<" if little_endian else ">"
+    nd, ni = 2, 6
+    ss = nd + (ni + 1) // 2  # 5 doubles per summary
+
+    # layout: record 1 = file record, record 2 = summary record,
+    # record 3 = name record (required spacing by the spec), data from rec 4
+    data_words = []  # doubles
+    seg_meta = []
+    word_ptr = 3 * 128 + 1  # first data word (1-based), after 3 records
+    for seg in segments:
+        recs = seg["records"]
+        n_rec, rsize = recs.shape
+        arr = list(recs.ravel())
+        trailer = [seg["init"], seg["intlen"], float(rsize), float(n_rec)]
+        start = word_ptr
+        end = start + len(arr) + 4 - 1
+        et0 = seg["init"]
+        et1 = seg["init"] + n_rec * seg["intlen"]
+        seg_meta.append((et0, et1, seg["target"], seg["center"], 1,
+                         seg["dtype"], start, end))
+        data_words += arr + trailer
+        word_ptr = end + 1
+
+    nrec_total = (word_ptr - 1 + 127) // 128 + 1
+    buf = bytearray(1024 * max(4, nrec_total))
+    # file record
+    buf[0:8] = b"DAF/SPK "
+    struct.pack_into(endian + "ii", buf, 8, nd, ni)
+    buf[16:76] = b"synthetic test kernel".ljust(60)
+    struct.pack_into(endian + "iii", buf, 76, 2, 2, word_ptr)  # fward bward free
+    buf[88:96] = b"LTL-IEEE" if little_endian else b"BIG-IEEE"
+    # summary record (record 2)
+    base = 1024
+    struct.pack_into(endian + "ddd", buf, base, 0.0, 0.0, float(len(seg_meta)))
+    for i, (et0, et1, tgt, ctr, frame, dtype, start, end) in enumerate(seg_meta):
+        off = base + 24 + i * ss * 8
+        struct.pack_into(endian + "dd", buf, off, et0, et1)
+        struct.pack_into(endian + "6i", buf, off + nd * 8, tgt, ctr, frame,
+                         dtype, start, end)
+    # data
+    for i, w in enumerate(data_words):
+        struct.pack_into(endian + "d", buf, (3 * 128 + i) * 8, w)
+    with open(path, "wb") as f:
+        f.write(bytes(buf))
+
+
+def _cheb_eval(recs, et, ncomp=3):
+    """Oracle: evaluate the Chebyshev records with numpy.polynomial."""
+    from numpy.polynomial import chebyshev as C
+
+    et = np.atleast_1d(et)
+    mids, radii = recs[:, 0], recs[:, 1]
+    ncoef = (recs.shape[1] - 2) // ncomp
+    pos = np.zeros(et.shape + (ncomp,))
+    dpos = np.zeros(et.shape + (ncomp,))
+    for j, t in enumerate(et):
+        i = int(np.argmin(np.abs(mids - t)))
+        x = (t - mids[i]) / radii[i]
+        for c in range(ncomp):
+            coef = recs[i, 2 + c * ncoef:2 + (c + 1) * ncoef]
+            pos[j, c] = C.chebval(x, coef)
+            dpos[j, c] = C.chebval(x, C.chebder(coef)) / radii[i]
+    return pos, dpos
+
+
+@pytest.fixture
+def kernel(tmp_path):
+    rng = np.random.default_rng(42)
+    init = (55000.0 - J2000_MJD) * DAY_S
+    intlen = 16.0 * DAY_S
+    n_rec = 32  # covers 512 days
+    segs = []
+    recs = {}
+    # EMB wrt SSB (3/0), Earth wrt EMB (399/3), Sun wrt SSB (10/0): type 2
+    for tgt, ctr, scale in ((3, 0, 1.5e8), (399, 3, 4.5e5), (10, 0, 1e6)):
+        r = _cheb_records(rng, n_rec, 8, init, intlen, ncomp=3, scale=scale)
+        recs[(tgt, ctr)] = r
+        segs.append(dict(target=tgt, center=ctr, dtype=2, records=r,
+                         init=init, intlen=intlen))
+    # Jupiter barycenter wrt SSB: type 3 (position+velocity coefficients)
+    r5 = _cheb_records(rng, n_rec, 6, init, intlen, ncomp=6, scale=7.5e8)
+    recs[(5, 0)] = r5
+    segs.append(dict(target=5, center=0, dtype=3, records=r5,
+                     init=init, intlen=intlen))
+    path = str(tmp_path / "de999.bsp")
+    _write_spk(path, segs)
+    return path, recs, init, intlen
+
+
+class TestSyntheticSPK:
+    def test_type2_exact(self, kernel):
+        from pint_tpu.ephemeris import SPKEphemeris
+
+        path, recs, init, intlen = kernel
+        eph = SPKEphemeris(path)
+        et = init + np.linspace(0.25, 31.75, 40) * intlen
+        mjd = et / DAY_S + J2000_MJD
+        pos, vel = eph.posvel_ssb("sun", mjd)
+        want_p, want_v = _cheb_eval(recs[(10, 0)], et)
+        assert np.allclose(pos, want_p, rtol=1e-14, atol=1e-6)
+        assert np.allclose(vel, want_v, rtol=1e-12, atol=1e-10)
+
+    def test_chained_pairs(self, kernel):
+        """Earth = EMB/SSB + Earth/EMB via the BFS chain."""
+        from pint_tpu.ephemeris import SPKEphemeris
+
+        path, recs, init, intlen = kernel
+        eph = SPKEphemeris(path)
+        et = init + np.array([3.3, 17.9, 30.1]) * intlen
+        mjd = et / DAY_S + J2000_MJD
+        pos, vel = eph.posvel_ssb("earth", mjd)
+        p1, v1 = _cheb_eval(recs[(3, 0)], et)
+        p2, v2 = _cheb_eval(recs[(399, 3)], et)
+        # forward-recurrence vs Clenshaw rounding on ~1.5e8-scale random
+        # coefficients: allow ~1e-12 relative (real kernels are smoother)
+        assert np.allclose(pos, p1 + p2, rtol=1e-11, atol=1e-4)
+        assert np.allclose(vel, v1 + v2, rtol=1e-9, atol=1e-8)
+
+    def test_type3_posvel(self, kernel):
+        from pint_tpu.ephemeris import SPKEphemeris
+
+        path, recs, init, intlen = kernel
+        eph = SPKEphemeris(path)
+        et = init + np.array([8.5]) * intlen
+        mjd = et / DAY_S + J2000_MJD
+        pos, vel = eph.posvel_ssb("jupiter", mjd)
+        full, _ = _cheb_eval(recs[(5, 0)], et, ncomp=6)
+        assert np.allclose(pos, full[..., :3], rtol=1e-14, atol=1e-6)
+        assert np.allclose(vel, full[..., 3:], rtol=1e-14, atol=1e-10)
+
+    def test_out_of_coverage_raises(self, kernel):
+        from pint_tpu.ephemeris import SPKEphemeris
+
+        path, _, init, intlen = kernel
+        eph = SPKEphemeris(path)
+        with pytest.raises(ValueError, match="coverage"):
+            eph.posvel_ssb("sun", np.array([40000.0]))
+
+    def test_pipeline_uses_kernel(self, kernel, tmp_path, monkeypatch):
+        """End-to-end: get_TOAs resolves the kernel through PINT_EPHEM_DIR
+        and the posvel columns match the kernel's polynomials."""
+        import pint_tpu.ephemeris as em
+        from pint_tpu.toa import get_TOAs
+
+        path, recs, init, intlen = kernel
+        monkeypatch.setenv("PINT_EPHEM_DIR", os.path.dirname(path))
+        monkeypatch.setitem(em._loaded, "de999", em.SPKEphemeris(path))
+        lines = ["FORMAT 1\n"]
+        mjds = 55100.0 + np.array([0.125, 40.375, 200.625])
+        for i, m in enumerate(mjds):
+            lines.append(f"s{i} 1400.0 {m:.13f} 1.0 bat\n")  # barycenter site
+        timf = tmp_path / "bat.tim"
+        timf.write_text("".join(lines))
+        t = get_TOAs(str(timf), ephem="DE999", include_gps=False,
+                     include_bipm=False)
+        et = (np.asarray(t.tdb, np.float64) - J2000_MJD) * DAY_S
+        sun_p, _ = _cheb_eval(recs[(10, 0)], et)
+        # barycentric observer: obs->sun == sun(SSB)
+        assert np.allclose(t.obs_sun_pos_km, sun_p, rtol=1e-12, atol=1e-3)
