@@ -1,0 +1,163 @@
+"""DMX tooling: initial range generation and dmxparse (NANOGrav workflow).
+
+Counterpart of reference ``utils.py:778 dmx_ranges`` and ``utils.py:1075
+dmxparse`` (itself modeled on tempo's util/dmxparse by P. Demorest).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.logging import log
+
+__all__ = ["DMXRange", "dmx_ranges", "dmxparse"]
+
+
+class DMXRange:
+    """One DMX bin: the low- and high-frequency TOA MJDs it covers
+    (reference ``utils.py`` dmxrange helper)."""
+
+    def __init__(self, lofreqs: List[float], hifreqs: List[float],
+                 buffer_d: float = 0.001):
+        self.los = sorted(lofreqs)
+        self.his = sorted(hifreqs)
+        self.min = min(self.los + self.his) - buffer_d
+        self.max = max(self.los + self.his) + buffer_d
+
+    def sum_print(self) -> str:
+        return (f"DMXR1: {self.min:.4f} DMXR2: {self.max:.4f} "
+                f"{len(self.los)} low-freq TOAs, {len(self.his)} high-freq TOAs")
+
+
+def dmx_ranges(toas, divide_freq: float = 1000.0, binwidth: float = 15.0,
+               verbose: bool = False):
+    """Compute initial DMX ranges for a set of TOAs (reference
+    ``utils.py:778``): greedy forward binning; a bin is kept only when it
+    contains TOAs both below and above ``divide_freq`` (MHz) within
+    ``binwidth`` days.
+
+    Returns ``(mask, component)``: a bool array marking TOAs assigned to a
+    bin, and a :class:`DispersionDMX` component populated with the ranges.
+    """
+    from pint_tpu.models.dispersion_model import DispersionDMX
+    from pint_tpu.models.parameter import prefixParameter
+
+    mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+    freqs = np.asarray(toas.freq_mhz, dtype=np.float64)
+
+    ranges: List[DMXRange] = []
+    prev_r2 = mjds.min() - 0.001
+    while np.any(mjds > prev_r2):
+        start = mjds[mjds > prev_r2].min()
+        binidx = (mjds > prev_r2) & (mjds <= start + binwidth)
+        if not np.any(binidx):
+            break
+        bin_mjds, bin_freqs = mjds[binidx], freqs[binidx]
+        lo = bin_mjds[bin_freqs < divide_freq]
+        hi = bin_mjds[bin_freqs >= divide_freq]
+        if len(lo) and len(hi):
+            ranges.append(DMXRange(list(lo), list(hi)))
+        prev_r2 = bin_mjds.max()
+
+    if not ranges:
+        raise ValueError(
+            f"dmx_ranges: no bin has TOAs on both sides of "
+            f"{divide_freq} MHz within {binwidth} d - cannot build DMX")
+    mask = np.zeros(len(mjds), dtype=bool)
+    comp = DispersionDMX()
+    for i, rng in enumerate(ranges, start=1):
+        mask |= (mjds >= rng.min) & (mjds <= rng.max)
+        if i > 1:
+            comp.add_param(prefixParameter(f"DMX_{i:04d}", units="pc/cm3",
+                                           value=0.0,
+                                           description="DM offset in range"))
+            comp.add_param(prefixParameter(f"DMXR1_{i:04d}", units="MJD",
+                                           description="Range start MJD"))
+            comp.add_param(prefixParameter(f"DMXR2_{i:04d}", units="MJD",
+                                           description="Range end MJD"))
+        getattr(comp, f"DMX_{i:04d}").value = 0.0
+        getattr(comp, f"DMX_{i:04d}").frozen = False
+        getattr(comp, f"DMXR1_{i:04d}").value = rng.min
+        getattr(comp, f"DMXR2_{i:04d}").value = rng.max
+        if verbose:
+            log.info(rng.sum_print())
+    comp.setup()
+    log.info(f"dmx_ranges: {len(ranges)} bins cover {mask.sum()}/{len(mjds)} "
+             f"TOAs")
+    return mask, comp
+
+
+def dmxparse(fitter, save=False) -> Dict[str, np.ndarray]:
+    """Mean-subtracted DMX time series with covariance-corrected errors
+    (reference ``utils.py:1075``; tempo's dmxparse semantics).
+
+    Returns dict with ``dmxs`` (mean-subtracted values), ``dmx_verrs``
+    (variance errors from the projected covariance), ``dmxeps`` (bin center
+    MJDs), ``r1s``/``r2s``, ``bins`` (parameter names), ``mean_dmx``,
+    ``avg_dm_err``.
+    """
+    model = fitter.model
+    keys = sorted(p for p in model.params if p.startswith("DMX_"))
+    if not keys:
+        raise RuntimeError("No DMX values in model!")
+    epochs = [k.split("_")[1] for k in keys]
+    vals = np.array([float(getattr(model, k).value or 0.0) for k in keys])
+    errs = np.array([float(getattr(model, k).uncertainty or 0.0) for k in keys])
+    frozen = np.array([bool(getattr(model, k).frozen) for k in keys])
+    r1 = np.array([float(getattr(model, f"DMXR1_{e}").value) for e in epochs])
+    r2 = np.array([float(getattr(model, f"DMXR2_{e}").value) for e in epochs])
+    centers = (r1 + r2) / 2.0
+
+    cov = getattr(fitter, "parameter_covariance_matrix", None)
+    fitted = list(getattr(fitter, "fitted_params", []) or [])
+    fit_keys = [k for k in keys if k in fitted]
+    if cov is not None and fit_keys:
+        idx = [fitted.index(k) for k in fit_keys]
+        cc = np.asarray(cov)[np.ix_(idx, idx)]
+        n = len(fit_keys)
+        mean_dmx = float(np.mean(vals[~frozen])) if np.any(~frozen) \
+            else float(np.mean(vals))
+        mean_err = float(np.sqrt(cc.sum()) / n)
+        # project out the mean: errors of the mean-subtracted series
+        m = np.identity(n) - np.ones((n, n)) / n
+        cc = m @ cc @ m
+        verrs_fit = np.sqrt(np.diag(cc))
+        verrs = np.full(len(keys), np.nan)
+        j = 0
+        for i, k in enumerate(keys):
+            if k in fit_keys:
+                verrs[i] = verrs_fit[j]
+                j += 1
+        if np.any(frozen):
+            log.warning("Some DMX bins were not fit for; their variance "
+                        "errors are NaN")
+    else:
+        log.warning("Fitter has no covariance matrix; returning per-bin "
+                    "uncertainties unprojected")
+        mean_dmx = float(np.mean(vals))
+        mean_err = float(np.mean(errs))
+        verrs = errs.copy()
+
+    out = {
+        "dmxs": vals - mean_dmx,
+        "dmx_verrs": verrs,
+        "dmxeps": centers,
+        "r1s": r1,
+        "r2s": r2,
+        "bins": keys,
+        "mean_dmx": mean_dmx,
+        "avg_dm_err": mean_err,
+    }
+    if save:
+        path = "dmxparse.out" if save is True else save
+        with open(path, "w") as f:
+            f.write(f"# Mean DMX value = {mean_dmx:+.6e} \n")
+            f.write(f"# Uncertainty in average DM = {mean_err:.5e} \n")
+            f.write("# Columns: DMXEP DMX_value DMX_var_err DMXR1 DMXR2 "
+                    "DMX_bin \n")
+            for k in range(len(keys)):
+                f.write(f"{centers[k]:.4f} {out['dmxs'][k]:+.7e} "
+                        f"{verrs[k]:.3e} {r1[k]:.4f} {r2[k]:.4f} {keys[k]} \n")
+    return out

@@ -51,7 +51,7 @@ class _PhotonMCMCFitter(Fitter):
     def __init__(self, toas, model, template, weights=None,
                  sampler: Optional[EnsembleSampler] = None, nwalkers: int = 32,
                  prior_info: Optional[dict] = None, errfact: float = 0.1,
-                 minMJD=None, maxMJD=None, **kw):
+                 minMJD=None, maxMJD=None, backend=None, seed=None, **kw):
         if weights is not None:
             weights = np.asarray(weights, dtype=np.float64)
         if minMJD is not None or maxMJD is not None:
@@ -74,7 +74,8 @@ class _PhotonMCMCFitter(Fitter):
             self.weights = np.asarray(wv, dtype=np.float64)
         else:
             self.weights = None
-        self.sampler = sampler or EnsembleSampler(nwalkers)
+        self.sampler = sampler or EnsembleSampler(nwalkers, seed=seed,
+                                                  backend=backend)
         self.errfact = errfact
         if prior_info is not None:
             from pint_tpu.bayesian import apply_prior_info
@@ -144,16 +145,23 @@ class _PhotonMCMCFitter(Fitter):
                          for p in self.fitkeys])
 
     def fit_toas(self, maxiter: int = 200, pos=None, seed=None,
-                 burn_frac: float = 0.25, **kw) -> float:
+                 burn_frac: float = 0.25, resume: bool = False, **kw) -> float:
         self.sampler.initialize_batched(self.lnposterior_batch,
                                         self.n_fit_params)
-        if pos is None:
+        if resume:
+            # continue the chain from the backend checkpoint (bit-identical
+            # to an uninterrupted run; reference event_optimize --backend)
+            pos = self.sampler.resume()
+            maxiter = max(0, maxiter - len(self.sampler._chain))
+        elif pos is None:
             pos = self.sampler.get_initial_pos(
                 self.fitkeys, self.get_fitvals(), self.get_fiterrs(),
                 self.errfact, seed=seed)
             lp = self.lnposterior_batch(pos)
             pos[~np.isfinite(lp)] = self.get_fitvals()
-        self.sampler.run_mcmc(pos, maxiter)
+        if maxiter > 0:
+            self.sampler.run_mcmc(pos, maxiter)
+        maxiter = len(self.sampler._chain)
         chain = self.sampler.get_chain(flat=True,
                                        discard=int(maxiter * burn_frac))
         lnp = self.sampler.get_log_prob(flat=True,
@@ -193,6 +201,19 @@ class MCMCFitterBinnedTemplate(_PhotonMCMCFitter):
         self.template_bins = template_bins
         self.nbins = nbins
         super().__init__(toas, model, template, **kw)
+
+    def set_template(self, template):
+        """Replace the template (e.g. after an FFTFIT start-phase rotation):
+        rebuilds the binned lookup AND the jitted likelihood, which bakes
+        the bins in as constants."""
+        self.template = template
+        if isinstance(template, LCTemplate):
+            grid = (np.arange(self.nbins) + 0.5) / self.nbins
+            self.template_bins = np.asarray(template(grid), dtype=np.float64)
+        else:
+            tb = np.asarray(template, dtype=np.float64)
+            self.template_bins = tb / tb.mean()
+        self._batch_fn = None
 
     def _template_density(self, phifrac):
         import jax.numpy as jnp

@@ -163,6 +163,58 @@ class Residuals:
         dot, logdet = woodbury_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
         return float(-0.5 * (dot + logdet + len(r) * np.log(2 * np.pi)))
 
+    def noise_resids(self) -> dict:
+        """Per-component correlated-noise realizations in seconds: the
+        maximum-likelihood GP amplitudes a GLS fit stored (``noise_ampls``)
+        projected back through each component's basis (reference
+        ``residuals.py`` noise_resids)."""
+        ampls = getattr(self, "noise_ampls", None)
+        if not ampls:
+            return {}
+        Us, _, dims = self.model.noise_basis_by_component(self.toas)
+        out = {}
+        for (comp, (off, size)), U in zip(dims.items(), Us):
+            a = np.asarray(ampls.get(comp, np.zeros(size)))
+            out[comp] = np.asarray(U) @ a
+        return out
+
+    def ecorr_average(self, use_noise_model: bool = True) -> dict:
+        """Epoch-averaged residuals using the ECORR time binning (reference
+        ``residuals.py:859``).
+
+        Returns dict with ``mjds``, ``freqs``, ``time_resids``,
+        ``noise_resids`` (per component), ``errors`` (including the ECORR
+        variance when ``use_noise_model``), and ``indices`` (TOA indices per
+        segment)."""
+        ecorrs = [c for c in self.model.noise_components
+                  if getattr(c, "is_ecorr", False)]
+        if not ecorrs:
+            raise ValueError("ECORR not present in noise model")
+        U, ecorr_err2 = ecorrs[0].basis_weight_pair(self.model, self.toas)
+        U = np.asarray(U)
+        ecorr_err2 = np.asarray(ecorr_err2)
+        if use_noise_model:
+            err = np.asarray(self.model.scaled_toa_uncertainty(self.toas))
+        else:
+            err = np.asarray(self.toas.get_errors()) * 1e-6
+            ecorr_err2 = ecorr_err2 * 0.0
+        wt = 1.0 / (err * err)
+        a_norm = U.T @ wt
+
+        def wtsum(x):
+            return (U.T @ (wt * np.asarray(x))) / a_norm
+
+        avg = {
+            "mjds": wtsum(np.asarray(self.toas.get_mjds(), np.float64)),
+            "freqs": wtsum(self.toas.freq_mhz),
+            "time_resids": wtsum(self.time_resids),
+            "noise_resids": {k: wtsum(v)
+                             for k, v in self.noise_resids().items()},
+            "errors": np.sqrt(1.0 / a_norm + ecorr_err2),
+            "indices": [list(np.where(U[:, i])[0]) for i in range(U.shape[1])],
+        }
+        return avg
+
     def update(self):
         self._phase_resids = None
         self._time_resids = None

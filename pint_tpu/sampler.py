@@ -18,7 +18,66 @@ import numpy as np
 
 from pint_tpu.logging import log
 
-__all__ = ["MCMCSampler", "EnsembleSampler", "EmceeSampler"]
+__all__ = ["MCMCSampler", "EnsembleSampler", "EmceeSampler", "NpzBackend"]
+
+
+class NpzBackend:
+    """Checkpoint/resume backend for :class:`EnsembleSampler` — the
+    zero-dependency analogue of the emcee HDF5 backend the reference uses
+    for long photon-MCMC runs (reference ``scripts/event_optimize.py:900-910``).
+
+    Stores chain, log-probs, acceptance counters and the exact RNG state, so
+    a resumed run continues the Markov chain *bit-identically* to an
+    uninterrupted one.
+    """
+
+    def __init__(self, path: str):
+        # np.savez appends '.npz' to bare names; normalize so save and
+        # load always address the same file
+        path = str(path)
+        self.path = path if path.endswith(".npz") else path + ".npz"
+
+    def exists(self) -> bool:
+        import os
+
+        return os.path.exists(self.path)
+
+    def save(self, sampler: "EnsembleSampler") -> None:
+        import pickle
+
+        np.savez(
+            self.path,
+            chain=np.asarray(sampler._chain),
+            lnprob=np.asarray(sampler._lnprob),
+            naccepted=sampler.naccepted,
+            ntotal=sampler.ntotal,
+            nwalkers=sampler.nwalkers,
+            a=sampler.a,
+            ndim=sampler.ndim if sampler.ndim is not None else -1,
+            rng_state=np.frombuffer(
+                pickle.dumps(sampler.rng.bit_generator.state), dtype=np.uint8),
+        )
+
+    def load_into(self, sampler: "EnsembleSampler") -> np.ndarray:
+        """Restore state; returns the last walker positions to resume from."""
+        import pickle
+
+        with np.load(self.path, allow_pickle=False) as d:
+            if int(d["nwalkers"]) != sampler.nwalkers:
+                raise ValueError(
+                    f"backend has {int(d['nwalkers'])} walkers, sampler has "
+                    f"{sampler.nwalkers}")
+            sampler._chain = list(d["chain"])
+            sampler._lnprob = list(d["lnprob"])
+            sampler.naccepted = int(d["naccepted"])
+            sampler.ntotal = int(d["ntotal"])
+            if int(d["ndim"]) >= 0:
+                sampler.ndim = int(d["ndim"])
+            sampler.rng.bit_generator.state = pickle.loads(
+                d["rng_state"].tobytes())
+        if not sampler._chain:
+            raise ValueError("backend contains no steps")
+        return sampler._chain[-1]
 
 
 class MCMCSampler:
@@ -54,7 +113,8 @@ class EnsembleSampler(MCMCSampler):
     """
 
     def __init__(self, nwalkers: int, a: float = 2.0,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, backend=None,
+                 checkpoint_every: int = 50):
         super().__init__()
         if nwalkers % 2:
             raise ValueError("nwalkers must be even (half-ensemble updates)")
@@ -68,6 +128,19 @@ class EnsembleSampler(MCMCSampler):
         self._lnprob: List[np.ndarray] = []
         self.naccepted = 0
         self.ntotal = 0
+        self.backend = (NpzBackend(backend) if isinstance(backend, str)
+                        else backend)
+        self.checkpoint_every = checkpoint_every
+
+    def resume(self) -> np.ndarray:
+        """Restore chain + RNG state from the backend; returns the walker
+        positions to continue from."""
+        if self.backend is None:
+            raise ValueError("no backend configured")
+        pos = self.backend.load_into(self)
+        log.info(f"Resumed {len(self._chain)} steps from "
+                 f"{self.backend.path}")
+        return pos
 
     def initialize_sampler(self, lnpostfn, ndim: int):
         """``lnpostfn`` may be scalar (point -> float) or batched
@@ -113,6 +186,15 @@ class EnsembleSampler(MCMCSampler):
                 self.ntotal += half
             self._chain.append(x.copy())
             self._lnprob.append(lp.copy())
+            if (self.backend is not None
+                    and (step + 1) % self.checkpoint_every == 0):
+                self.backend.save(self)
+                # each save rewrites the whole chain; grow the interval so
+                # cumulative checkpoint I/O stays ~linear in chain length
+                if len(self._chain) >= 20 * self.checkpoint_every:
+                    self.checkpoint_every *= 2
+        if self.backend is not None:
+            self.backend.save(self)
         return x
 
     @property

@@ -61,6 +61,13 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--maxMJD", type=float, default=None)
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--outbase", default="event_optimize")
+    ap.add_argument("--backend", default=None,
+                    help="npz checkpoint file enabling kill-and-resume "
+                    "(reference --backend HDF5 chains)")
+    ap.add_argument("--resume", action="store_true",
+                    help="continue the chain from --backend")
+    ap.add_argument("--no-fitstart", dest="fitstart", action="store_false",
+                    help="skip the FFTFIT template start-phase alignment")
     args = ap.parse_args(argv)
 
     from pint_tpu.event_fitter import MCMCFitterBinnedTemplate
@@ -88,8 +95,25 @@ def main(argv: Optional[list] = None):
     f = MCMCFitterBinnedTemplate(
         ts, model, template, nbins=args.nbins, nwalkers=args.nwalkers,
         prior_info=prior_info or None, errfact=args.errfact,
-        minMJD=args.minMJD, maxMJD=args.maxMJD)
-    f.fit_toas(maxiter=args.nsteps, seed=args.seed,
+        minMJD=args.minMJD, maxMJD=args.maxMJD, backend=args.backend,
+        seed=args.seed)
+    if args.fitstart and not args.resume:
+        # FFTFIT start phase: align the template with the folded profile
+        # (replaces the reference's PRESTO fftfit import,
+        # event_optimize.py:119-133)
+        from pint_tpu.fftfit import fftfit_full
+
+        phases = f.phaseogram_phases()
+        prof, _ = np.histogram(phases, bins=args.nbins, range=(0.0, 1.0),
+                               weights=f.weights)
+        grid = (np.arange(args.nbins) + 0.5) / args.nbins
+        shift, eshift, _, _ = fftfit_full(np.asarray(template(grid)),
+                                          prof.astype(np.float64))
+        template.rotate(shift)
+        f.set_template(template)  # rebuild bins + jitted likelihood
+        print(f"FFTFIT start phase: rotated template by {shift:.4f} "
+              f"+/- {eshift:.4f} cycles")
+    f.fit_toas(maxiter=args.nsteps, seed=args.seed, resume=args.resume,
                burn_frac=args.burnin / max(args.nsteps, 1))
     print(f"Max posterior: {f.maxpost:.2f}  acceptance "
           f"{f.sampler.acceptance_fraction:.2f}")

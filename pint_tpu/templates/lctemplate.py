@@ -27,15 +27,42 @@ class LCTemplate:
         if self.norms.dim != len(self.primitives):
             raise ValueError("One norm per primitive required")
 
+    def is_energy_dependent(self) -> bool:
+        return any(getattr(x, "is_energy_dependent", lambda: False)()
+                   for x in list(self.primitives) + [self.norms])
+
     # -- evaluation ----------------------------------------------------------
-    def __call__(self, phases, suppress_bg: bool = False):
-        norms = self.norms()
-        bg = 1.0 - norms.sum()
-        out = bg if not suppress_bg else 0.0
-        for n, prim in zip(norms, self.primitives):
-            out = out + n * prim(phases)
+    def __call__(self, phases, log10_ens=None, suppress_bg: bool = False):
+        """Template density at the given phases; with ``log10_ens`` each
+        photon is evaluated at its own energy (energy-dependent primitives /
+        norms drift their parameters; reference ``lceprimitives.py`` /
+        ``lcenorm.py`` semantics)."""
+        if log10_ens is None:
+            norms = self.norms()
+            bg = 1.0 - norms.sum()
+            out = bg if not suppress_bg else 0.0
+            for n, prim in zip(norms, self.primitives):
+                out = out + n * prim(phases)
+            if suppress_bg:
+                out = out / norms.sum()
+            return out
+        phases = np.atleast_1d(np.asarray(phases, dtype=np.float64))
+        try:
+            norms = self.norms(log10_ens)  # (N, ncomp) if energy-dependent
+        except TypeError:
+            norms = np.broadcast_to(self.norms(), (len(phases),
+                                                   self.norms.dim))
+        norms = np.atleast_2d(norms)
+        bgsum = norms.sum(axis=1)
+        out = np.zeros(len(phases)) if suppress_bg else 1.0 - bgsum
+        for i, prim in enumerate(self.primitives):
+            try:
+                dens = np.asarray(prim(phases, log10_ens))
+            except TypeError:  # energy-independent component
+                dens = np.asarray(prim(phases))
+            out = out + norms[:, i] * dens
         if suppress_bg:
-            out = out / norms.sum()
+            out = out / bgsum
         return out
 
     def gradient_phases(self, phases, eps: float = 1e-7):
