@@ -72,6 +72,15 @@ def _classify(line: str, current_fmt: str) -> str:
         return "Princeton"
     if len(line) > 71 and line[0] == " " and line[41] == ".":
         return "Parkes"
+    if len(line) > 80:
+        # long lines are tempo2 even without FORMAT 1 (reference toa.py:462,
+        # checked BEFORE the ITOA heuristic so it cannot over-match)
+        return "Tempo2"
+    # ITOA: two-char site code then MJD with the decimal point at col 15
+    # (reference ``toa.py:464``; the reference also refuses these lines)
+    if (len(line) > 14 and line[14] == "." and len(s) > 1
+            and not line[0].isspace() and not line[1].isspace()):
+        return "ITOA"
     return "Unknown"
 
 
@@ -187,6 +196,10 @@ def read_tim_file(path: str, process_includes: bool = True,
             continue
         if cd["SKIP"] or cd["END"] or kind == "Unknown":
             continue
+        if kind == "ITOA":
+            # explicit refusal, matching the reference (``toa.py:557-558``)
+            raise PintFileError(
+                f"ITOA-format TOA lines are not implemented: {line.strip()!r}")
         if kind == "Tempo2":
             toa = _parse_tempo2(line)
         elif kind == "Princeton":

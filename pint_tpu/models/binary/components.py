@@ -106,18 +106,51 @@ class PulsarBinary(DelayComponent):
                                       description="Einstein-delay amplitude"))
         self.add_param(prefixParameter("FB0", units="1/s", aliases=["FB"],
                                        description="Orbital frequency"))
+        # ORBWAVES Fourier orbital-phase modulation (reference
+        # pulsar_binary.py:62-72, binary_orbits.py:243)
+        self.add_param(prefixParameter("ORBWAVEC0", units="",
+                                       aliases=["ORBWAVEC"],
+                                       description="ORBWAVE cosine amplitude"))
+        self.add_param(prefixParameter("ORBWAVES0", units="",
+                                       aliases=["ORBWAVES"],
+                                       description="ORBWAVE sine amplitude"))
+        self.add_param(floatParameter("ORBWAVE_OM", units="rad/s",
+                                      description="Base ORBWAVE frequency"))
+        self.add_param(MJDParameter("ORBWAVE_EPOCH",
+                                    description="ORBWAVE reference epoch"))
         self._nfb = 0
+        self._nwaves = 0
 
     def setup(self):
         idxs = sorted(int(p[2:]) for p in self.params
                       if p.startswith("FB") and p[2:].isdigit()
                       and self._params_dict[p].value is not None)
         self._nfb = (max(idxs) + 1) if idxs else 0
+        nc = sorted(int(p[8:]) for p in self.params
+                    if p.startswith("ORBWAVEC") and p[8:].isdigit()
+                    and self._params_dict[p].value is not None)
+        ns = sorted(int(p[8:]) for p in self.params
+                    if p.startswith("ORBWAVES") and p[8:].isdigit()
+                    and self._params_dict[p].value is not None)
+        if nc or ns:
+            if nc != list(range(len(nc))) or ns != list(range(len(ns))):
+                raise TimingModelError(
+                    f"ORBWAVE indices must be 0..k without gaps: {nc}/{ns}")
+            if len(nc) != len(ns):
+                raise TimingModelError(
+                    f"Equal numbers of ORBWAVEC/ORBWAVES required "
+                    f"({len(nc)} vs {len(ns)})")
+        self._nwaves = len(nc)
 
     def validate(self):
         uses_fb = self._nfb > 0
         if not uses_fb and self.PB.value is None:
             raise MissingParameter(type(self).__name__, "PB (or FB0)")
+        if self._nwaves:
+            if self.ORBWAVE_OM.value is None:
+                raise MissingParameter(type(self).__name__, "ORBWAVE_OM")
+            if self.ORBWAVE_EPOCH.value is None:
+                raise MissingParameter(type(self).__name__, "ORBWAVE_EPOCH")
         ep = self._params_dict[self.epoch_param]
         if ep.value is None:
             raise MissingParameter(type(self).__name__, self.epoch_param)
@@ -133,12 +166,27 @@ class PulsarBinary(DelayComponent):
     # -- engine plumbing ----------------------------------------------------
     def _orbits_fn(self):
         """Static choice of orbit parameterization (reference
-        ``binary_orbits.py``): FBX when any FBn is set, else PB."""
-        if self._nfb:
-            names = [f"FB{i}" for i in range(self._nfb)]
+        ``binary_orbits.py``): ORBWAVES (on a PB or FBX base) when wave
+        amplitudes are set, else FBX when any FBn is set, else PB."""
+        fb_names = ([f"FB{i}" for i in range(self._nfb)]
+                    if self._nfb else None)
+        if self._nwaves:
+            c_names = [f"ORBWAVEC{i}" for i in range(self._nwaves)]
+            s_names = [f"ORBWAVES{i}" for i in range(self._nwaves)]
+            ep_name = self.epoch_param
 
             def fn(pv, tt0):
-                return eng.orbits_fbx([pv.get(n, 0.0) for n in names], tt0)
+                # tw = t - ORBWAVE_EPOCH = tt0 + (epoch - ORBWAVE_EPOCH)
+                off = dd_mul(dd_sub(pv[ep_name], pv["ORBWAVE_EPOCH"]), DAY_S)
+                tw = tt0 + (off.hi + off.lo)
+                return eng.orbits_waves(pv, tt0, tw, c_names, s_names,
+                                        fb_names=fb_names)
+
+            return fn
+        if fb_names:
+
+            def fn(pv, tt0):
+                return eng.orbits_fbx([pv.get(n, 0.0) for n in fb_names], tt0)
 
             return fn
         return eng.orbits_pb

@@ -73,6 +73,34 @@ def orbits_fbx(fb_values, tt0):
     return orbits, 1.0 / freq
 
 
+def orbits_waves(pv, tt0, tw, c_names, s_names, fb_names=None):
+    """ORBWAVES orbital-phase Fourier modulation (reference
+    ``binary_orbits.py:243 OrbitWaves`` / ``:455 OrbitWavesFBX``):
+
+        orbits = base(tt0) + sum_k [C_k cos((k+1) OM tw) + S_k sin(...)]
+
+    with ``tw = t - ORBWAVE_EPOCH`` seconds and OM = ORBWAVE_OM [rad/s].
+    The PB base deliberately ignores PBDOT/XPBDOT (the reference's
+    OrbitWaves parameter list excludes them); pbprime comes from the
+    instantaneous frequency 1/pbprime_base + d(dphi)/dt.
+    """
+    om = pv.get("ORBWAVE_OM", 0.0)
+    dphi = jnp.zeros_like(tt0)
+    dphi_dot = jnp.zeros_like(tt0)
+    for k, (cn, sn) in enumerate(zip(c_names, s_names)):
+        c = pv.get(cn, 0.0)
+        s = pv.get(sn, 0.0)
+        w = (k + 1) * om
+        ph = w * tw
+        dphi = dphi + c * jnp.cos(ph) + s * jnp.sin(ph)
+        dphi_dot = dphi_dot + w * (s * jnp.cos(ph) - c * jnp.sin(ph))
+    if fb_names is not None:
+        orbits0, pbp0 = orbits_fbx([pv.get(n, 0.0) for n in fb_names], tt0)
+        return orbits0 + dphi, 1.0 / (1.0 / pbp0 + dphi_dot)
+    pb_s = pv["PB"] * 86400.0
+    return tt0 / pb_s + dphi, 1.0 / (1.0 / pb_s + dphi_dot)
+
+
 def mean_anomaly(orbits):
     """Orbital phase in [0, 2pi) (reference ``binary_orbits.py:26``)."""
     return (orbits - jnp.floor(orbits)) * TWO_PI

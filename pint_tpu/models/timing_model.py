@@ -748,18 +748,68 @@ class TimingModel:
         with open(path, "w") as f:
             f.write(self.as_parfile(comment))
 
-    def compare(self, other: "TimingModel", verbosity: str = "max") -> str:
-        """Tabular parameter comparison (reference ``timing_model.py:2293``)."""
-        rows = [f"{'PARAMETER':<15} {'SELF':>25} {'OTHER':>25}"]
+    def compare(self, other: "TimingModel", nodmx: bool = False,
+                threshold_sigma: float = 3.0, verbosity: str = "max") -> str:
+        """Tabular parameter comparison with sigma-change columns
+        (reference ``timing_model.py:2293``).
+
+        Columns: value1 (+/- unc), value2 (+/- unc), Diff_Sigma1 = (v2-v1)
+        in units of self's uncertainty, Diff_Sigma2 in units of other's.
+        Verbosity: "max" = every parameter, "med" = changed or significant,
+        "min" = |Diff_Sigma| >= threshold only, "check" = only the names of
+        parameters that cross the threshold.
+        """
+        def _fmt(par):
+            if par is None or par.value is None:
+                return "--"
+            try:
+                v = float(par.value)
+            except (TypeError, ValueError):
+                return str(par.value)
+            u = par.uncertainty
+            return f"{v:.10g}" + (f" +/- {float(u):.2g}" if u else "")
+
+        rows = [f"{'PARAMETER':<15} {'SELF':>28} {'OTHER':>28} "
+                f"{'Diff_Sigma1':>12} {'Diff_Sigma2':>12}"]
+        flagged = []
         names = [p for p in self.params if p not in self.top_level_params]
         for p in names:
-            v1 = getattr(self, p).value
-            v2 = getattr(other, p).value if p in other else None
+            if nodmx and p.startswith("DMX"):
+                continue
+            par1 = getattr(self, p)
+            par2 = getattr(other, p) if p in other else None
+            v1, v2 = par1.value, par2.value if par2 is not None else None
             if v1 is None and v2 is None:
                 continue
-            if verbosity != "max" and v1 == v2:
+            sig1 = sig2 = None
+            try:
+                d = float(v2) - float(v1)
+                if par1.uncertainty:
+                    sig1 = d / float(par1.uncertainty)
+                if par2 is not None and par2.uncertainty:
+                    sig2 = d / float(par2.uncertainty)
+            except (TypeError, ValueError):
+                pass
+            crossed = any(s is not None and abs(s) >= threshold_sigma
+                          for s in (sig1, sig2))
+            if crossed:
+                flagged.append(p)
+            if verbosity == "min" and not crossed:
                 continue
-            rows.append(f"{p:<15} {str(v1):>25} {str(v2):>25}")
+            if verbosity == "med" and v1 == v2 and not crossed:
+                continue
+            if verbosity == "check":
+                continue
+            s1 = f"{sig1:12.3f}" if sig1 is not None else f"{'--':>12}"
+            s2 = f"{sig2:12.3f}" if sig2 is not None else f"{'--':>12}"
+            mark = " !" if crossed else ""
+            rows.append(f"{p:<15} {_fmt(par1):>28} {_fmt(par2):>28} "
+                        f"{s1} {s2}{mark}")
+        if verbosity == "check":
+            return "\n".join(flagged)
+        if flagged:
+            rows.append(f"# parameters changed by >= {threshold_sigma} "
+                        f"sigma: {', '.join(flagged)}")
         return "\n".join(rows)
 
     def __repr__(self):

@@ -28,6 +28,7 @@ from pint_tpu.timescales import utc_to_tdb_mjd, utc_to_tt_mjd
 from pint_tpu.utils import PosVel
 
 __all__ = ["Observatory", "TopoObs", "BarycenterObs", "GeocenterObs",
+           "T2SpacecraftObs",
            "get_observatory", "list_observatories"]
 
 _registry: Dict[str, "Observatory"] = {}
@@ -163,6 +164,47 @@ class GeocenterObs(Observatory):
         return PosVel(epos, evel, obj=self.name, origin="ssb")
 
 
+class T2SpacecraftObs(Observatory):
+    """Spacecraft whose GCRS position rides in per-TOA tim-file flags
+    (tempo2 -telx/-tely/-telz [km], -vx/-vy/-vz [km/s]; reference
+    ``special_locations.py:161``).  GPS clock corrections are not applied —
+    the spacecraft's time source is unknown."""
+
+    needs_flags = True
+
+    def __init__(self, name="stl_geo", aliases=("spacecraft",)):
+        super().__init__(name, aliases=list(aliases), include_gps=False)
+
+    def clock_corrections(self, utc_mjd, include_gps=None, **kw):
+        # site policy wins over the pipeline's include_gps=True default: the
+        # spacecraft's time source is not GPS-steered (reference
+        # special_locations.py:170 apply_gps2utc=False)
+        return super().clock_corrections(utc_mjd, include_gps=False, **kw)
+
+    @staticmethod
+    def _flag_vec(flags, keys, what):
+        try:
+            return np.array([[float(fl[k]) for k in keys] for fl in flags])
+        except KeyError as e:
+            raise ValueError(
+                f"TOA line must carry {'/'.join(keys)} flags for the GCRS "
+                f"{what} of a spacecraft observatory") from e
+
+    def posvel_flags(self, utc_mjd, tdb_mjd, flags, ephem="DE440") -> PosVel:
+        eph = ephem_mod.load_ephemeris(ephem)
+        epos, evel = eph.posvel_ssb("earth", np.atleast_1d(
+            np.asarray(tdb_mjd, dtype=np.float64)))
+        pos_km = self._flag_vec(flags, ("telx", "tely", "telz"), "position")
+        vel_kms = self._flag_vec(flags, ("vx", "vy", "vz"), "velocity")
+        return PosVel(epos + pos_km, evel + vel_kms, obj=self.name,
+                      origin="ssb")
+
+    def posvel(self, utc_mjd, tdb_mjd, ephem="DE440") -> PosVel:
+        raise ValueError(
+            "T2SpacecraftObs needs per-TOA flags; use posvel_flags "
+            "(compute_posvels routes here automatically)")
+
+
 class BarycenterObs(Observatory):
     """SSB pseudo-observatory: TOAs already barycentred (reference
     ``special_locations.py:71``)."""
@@ -193,6 +235,7 @@ def _ensure_builtin():
         return
     GeocenterObs()
     BarycenterObs()
+    T2SpacecraftObs()
     for name, (x, y, z, tc, ic, aliases, clk, fmt) in SITES.items():
         TopoObs(name, (x, y, z), tempo_code=tc, itoa_code=ic, aliases=aliases,
                 clock_files=clk, clock_fmt=fmt)

@@ -1,0 +1,116 @@
+"""Par-file editor behind the pintk GUI (reference ``pintk/paredit.py``).
+
+The core is GUI-free: it holds the editable par text, validates it by
+building a model, and applies it back to the :class:`Pulsar`.  A Tk text
+widget wrapping is provided when tkinter is importable, mirroring the
+reference's edit/apply/reset/open/write button row.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from pint_tpu.logging import log
+
+__all__ = ["ParEditor", "ParChoiceWidget"]
+
+
+class ParEditor:
+    """Editable par text bound to a Pulsar (apply/reset/load/write)."""
+
+    def __init__(self, psr, updates_cb: Optional[Callable] = None):
+        self.psr = psr
+        self.updates_cb = updates_cb
+        self.text = self._render()
+
+    def _render(self) -> str:
+        return self.psr.model.as_parfile()
+
+    # -- actions (the reference's button row) -------------------------------
+    def reset(self) -> str:
+        """Discard edits: re-render from the current model."""
+        self.text = self._render()
+        return self.text
+
+    def set_text(self, text: str) -> None:
+        self.text = text
+
+    def check(self):
+        """Parse the edited text; returns the would-be model (raises on
+        invalid par content without touching the Pulsar)."""
+        from pint_tpu.models import get_model
+
+        return get_model(self.text.splitlines(keepends=True))
+
+    def apply(self) -> None:
+        """Validate + swap the edited model into the Pulsar (reference
+        paredit 'Apply Changes')."""
+        model = self.check()
+        self.psr.model = model
+        self.psr.fitted = False
+        self.psr.update_resids()
+        if self.updates_cb:
+            self.updates_cb()
+        log.info("Applied edited par file to the model")
+
+    def load(self, path: str) -> str:
+        with open(path) as f:
+            self.text = f.read()
+        return self.text
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.text)
+        log.info(f"Wrote par file to {path}")
+
+
+class ParChoiceWidget:
+    """Tk window with the par text + Apply/Reset/Open/Write buttons."""
+
+    def __init__(self, master, psr, updates_cb=None):
+        import tkinter as tk
+        from tkinter import filedialog
+
+        self.editor = ParEditor(psr, updates_cb=updates_cb)
+        self.win = tk.Toplevel(master)
+        self.win.title("pintk: par editor")
+        self.textbox = tk.Text(self.win, width=80, height=40)
+        self.textbox.pack(side=tk.TOP, fill=tk.BOTH, expand=True)
+        self.textbox.insert("1.0", self.editor.text)
+        row = tk.Frame(self.win)
+        row.pack(side=tk.BOTTOM, fill=tk.X)
+        tk.Button(row, text="Apply Changes", command=self._apply).pack(
+            side=tk.LEFT)
+        tk.Button(row, text="Reset Changes", command=self._reset).pack(
+            side=tk.LEFT)
+        tk.Button(row, text="Open Par...", command=self._open).pack(
+            side=tk.LEFT)
+        tk.Button(row, text="Write Par...", command=self._write).pack(
+            side=tk.LEFT)
+        self._filedialog = filedialog
+
+    def _sync(self):
+        self.editor.set_text(self.textbox.get("1.0", "end-1c"))
+
+    def _apply(self):
+        self._sync()
+        try:
+            self.editor.apply()
+        except Exception as e:  # surface parse errors in the title bar
+            self.win.title(f"pintk: par editor - ERROR: {e}")
+
+    def _reset(self):
+        self.textbox.delete("1.0", "end")
+        self.textbox.insert("1.0", self.editor.reset())
+
+    def _open(self):
+        path = self._filedialog.askopenfilename(title="Open par file")
+        if path:
+            self.textbox.delete("1.0", "end")
+            self.textbox.insert("1.0", self.editor.load(path))
+
+    def _write(self):
+        path = self._filedialog.asksaveasfilename(title="Write par file")
+        if path:
+            self._sync()
+            self.editor.write(path)

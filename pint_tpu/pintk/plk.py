@@ -31,7 +31,8 @@ def launch_gui(psr):
     ax = fig.add_subplot(111)
     canvas = FigureCanvasTkAgg(fig, master=root)
     canvas.get_tk_widget().pack(side=tk.TOP, fill=tk.BOTH, expand=1)
-    state = {"selected": np.zeros(len(psr.all_toas), dtype=bool)}
+    state = {"selected": np.zeros(len(psr.all_toas), dtype=bool),
+             "random_overlay": False}
 
     def redraw():
         ax.clear()
@@ -39,12 +40,34 @@ def launch_gui(psr):
         mjds = np.asarray(psr.all_toas.get_mjds(), dtype=float)
         res_us = np.asarray(r.time_resids) * 1e6
         errs = np.asarray(psr.all_toas.get_errors())
+        if len(state["selected"]) != len(psr.all_toas):
+            # tim edits change the TOA count; a stale mask kills every redraw
+            state["selected"] = np.zeros(len(psr.all_toas), dtype=bool)
+            state.pop("overlay_cache", None)
         sel = state["selected"]
         ax.errorbar(mjds[~sel], res_us[~sel], yerr=errs[~sel], fmt=".",
                     color="#2060a0", ecolor="0.8")
         if sel.any():
             ax.errorbar(mjds[sel], res_us[sel], yerr=errs[sel], fmt=".",
                         color="#d03020", ecolor="0.8")
+        if state["random_overlay"] and psr.fitted:
+            # random-model overlay (reference pintk random models): draws
+            # from the post-fit covariance shown as residual-delta curves.
+            # Cached per fit: recomputing re-jits 12 model copies per click.
+            try:
+                if state.get("overlay_cache") is None:
+                    state["overlay_cache"] = psr.random_models(
+                        nmodels=12, keep_models=False)
+                dphase = state["overlay_cache"]
+                order = np.argsort(mjds)
+                F0 = float(psr.model.F0.value)
+                for k in range(dphase.shape[0]):
+                    ax.plot(mjds[order], (res_us + dphase[k] / F0 * 1e6)[order],
+                            color="#f0a030", alpha=0.35, lw=0.7, zorder=0)
+            except Exception as e:
+                from pint_tpu.logging import log
+
+                log.warning(f"random-model overlay unavailable: {e}")
         ax.axhline(0, color="0.5", lw=0.8)
         ax.set_xlabel("MJD")
         ax.set_ylabel("Residual (us)")
@@ -67,6 +90,7 @@ def launch_gui(psr):
 
     def do_fit():
         psr.fit()
+        state.pop("overlay_cache", None)  # new covariance -> new draws
         redraw()
 
     def do_reset():
@@ -88,10 +112,27 @@ def launch_gui(psr):
             psr.add_phase_wrap(state["selected"], sign)
             redraw()
 
+    def do_random():
+        state["random_overlay"] = not state["random_overlay"]
+        redraw()
+
+    def do_paredit():
+        from pint_tpu.pintk.paredit import ParChoiceWidget
+
+        ParChoiceWidget(root, psr, updates_cb=redraw)
+
+    def do_timedit():
+        from pint_tpu.pintk.timedit import TimChoiceWidget
+
+        TimChoiceWidget(root, psr, updates_cb=redraw)
+
     for label, cmd in [("Fit", do_fit), ("Reset", do_reset),
                        ("Clear sel", do_clear_sel), ("Jump sel", do_jump),
                        ("Wrap +1", lambda: do_wrap(1)),
-                       ("Wrap -1", lambda: do_wrap(-1))]:
+                       ("Wrap -1", lambda: do_wrap(-1)),
+                       ("Random models", do_random),
+                       ("Edit par...", do_paredit),
+                       ("Edit tim...", do_timedit)]:
         ttk.Button(bar, text=label, command=cmd).pack(side=tk.LEFT)
 
     # parameter fit checkboxes

@@ -168,7 +168,8 @@ class Pulsar:
     def write_fit_summary(self) -> str:
         return self.fitter.get_summary() if self.fitter else "(not fitted)"
 
-    def random_models(self, nmodels: int = 30, rng=None):
+    def random_models(self, nmodels: int = 30, rng=None,
+                      keep_models: bool = True):
         """Random model phase predictions for the GUI overlay
         (reference ``pintk/pulsar.py random_models``)."""
         from pint_tpu.simulation import calculate_random_models
@@ -176,4 +177,5 @@ class Pulsar:
         if self.fitter is None:
             raise ValueError("Fit first")
         return calculate_random_models(self.fitter, self.all_toas,
-                                       Nmodels=nmodels, rng=rng)
+                                       Nmodels=nmodels, rng=rng,
+                                       keep_models=keep_models)

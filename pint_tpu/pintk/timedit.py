@@ -1,0 +1,126 @@
+"""Tim-file editor behind the pintk GUI (reference ``pintk/timedit.py``).
+
+GUI-free core (edit text, validate by parsing, apply to the Pulsar) plus an
+optional Tk wrapping, parallel to :mod:`pint_tpu.pintk.paredit`.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Optional
+
+from pint_tpu.logging import log
+
+__all__ = ["TimEditor", "TimChoiceWidget"]
+
+
+class TimEditor:
+    """Editable tim text bound to a Pulsar (apply/reset/load/write)."""
+
+    def __init__(self, psr, updates_cb: Optional[Callable] = None):
+        self.psr = psr
+        self.updates_cb = updates_cb
+        self.text = self._render()
+
+    def _render(self) -> str:
+        tmp = tempfile.NamedTemporaryFile("w", suffix=".tim", delete=False)
+        tmp.close()
+        try:
+            self.psr.all_toas.write_TOA_file(tmp.name)
+            with open(tmp.name) as f:
+                return f.read()
+        finally:
+            os.unlink(tmp.name)
+
+    def reset(self) -> str:
+        self.text = self._render()
+        return self.text
+
+    def set_text(self, text: str) -> None:
+        self.text = text
+
+    def check(self):
+        """Parse the edited text; returns the would-be TOAs (raises on
+        invalid tim content without touching the Pulsar)."""
+        from pint_tpu.toa import get_TOAs
+
+        tmp = tempfile.NamedTemporaryFile("w", suffix=".tim", delete=False)
+        tmp.write(self.text)
+        tmp.close()
+        try:
+            return get_TOAs(tmp.name, model=self.psr.model)
+        finally:
+            os.unlink(tmp.name)
+
+    def apply(self) -> None:
+        toas = self.check()
+        self.psr.all_toas = toas
+        self.psr.selected_toas = toas
+        self.psr.fitted = False
+        self.psr.update_resids()
+        if self.updates_cb:
+            self.updates_cb()
+        log.info(f"Applied edited tim file: {len(toas)} TOAs")
+
+    def load(self, path: str) -> str:
+        with open(path) as f:
+            self.text = f.read()
+        return self.text
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.text)
+        log.info(f"Wrote tim file to {path}")
+
+
+class TimChoiceWidget:
+    """Tk window with the tim text + Apply/Reset/Open/Write buttons."""
+
+    def __init__(self, master, psr, updates_cb=None):
+        import tkinter as tk
+        from tkinter import filedialog
+
+        self.editor = TimEditor(psr, updates_cb=updates_cb)
+        self.win = tk.Toplevel(master)
+        self.win.title("pintk: tim editor")
+        self.textbox = tk.Text(self.win, width=100, height=40)
+        self.textbox.pack(side=tk.TOP, fill=tk.BOTH, expand=True)
+        self.textbox.insert("1.0", self.editor.text)
+        row = tk.Frame(self.win)
+        row.pack(side=tk.BOTTOM, fill=tk.X)
+        tk.Button(row, text="Apply Changes", command=self._apply).pack(
+            side=tk.LEFT)
+        tk.Button(row, text="Reset Changes", command=self._reset).pack(
+            side=tk.LEFT)
+        tk.Button(row, text="Open Tim...", command=self._open).pack(
+            side=tk.LEFT)
+        tk.Button(row, text="Write Tim...", command=self._write).pack(
+            side=tk.LEFT)
+        self._filedialog = filedialog
+
+    def _sync(self):
+        self.editor.set_text(self.textbox.get("1.0", "end-1c"))
+
+    def _apply(self):
+        self._sync()
+        try:
+            self.editor.apply()
+        except Exception as e:
+            self.win.title(f"pintk: tim editor - ERROR: {e}")
+
+    def _reset(self):
+        self.textbox.delete("1.0", "end")
+        self.textbox.insert("1.0", self.editor.reset())
+
+    def _open(self):
+        path = self._filedialog.askopenfilename(title="Open tim file")
+        if path:
+            self.textbox.delete("1.0", "end")
+            self.textbox.insert("1.0", self.editor.load(path))
+
+    def _write(self):
+        path = self._filedialog.asksaveasfilename(title="Write tim file")
+        if path:
+            self._sync()
+            self.editor.write(path)

@@ -269,7 +269,13 @@ class TOAs:
         vel = np.empty((n, 3))
         for site in np.unique(self.obs):
             m = self.obs == site
-            pv = get_observatory(site).posvel(utc64[m], tdb64[m], ephem=self.ephem)
+            ob = get_observatory(site)
+            if getattr(ob, "needs_flags", False):
+                # spacecraft: GCRS position rides in per-TOA flags
+                fl = [self.flags[i] for i in np.where(m)[0]]
+                pv = ob.posvel_flags(utc64[m], tdb64[m], fl, ephem=self.ephem)
+            else:
+                pv = ob.posvel(utc64[m], tdb64[m], ephem=self.ephem)
             pos[m], vel[m] = pv.pos, pv.vel
         self.ssb_obs_pos_km, self.ssb_obs_vel_kms = pos, vel
         sun_pos, _ = eph.posvel_ssb("sun", tdb64)

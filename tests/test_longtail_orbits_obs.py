@@ -1,0 +1,170 @@
+"""Long-tail completeness (VERDICT r2 directive #9): ORBWAVES orbital-phase
+Fourier modulation, ITOA tim-format refusal, T2SpacecraftObs flag positions.
+
+Reference: ``binary_orbits.py:243 OrbitWaves`` (+ ``pulsar_binary.py:62-72``
+published formula), ``toa.py:557`` (ITOA raises), ``special_locations.py:161``.
+"""
+
+import numpy as np
+import pytest
+
+
+class TestORBWAVES:
+    PAR_BASE = [
+        "PSR ORBW\n", "RAJ 07:00:00 1\n", "DECJ 12:00:00 1\n",
+        "F0 300.5 1\n", "PEPOCH 55400\n", "DM 9.0\n",
+        "BINARY ELL1\n", "PB 0.4 1\n", "A1 2.1 1\n", "TASC 55399.5 1\n",
+        "EPS1 1e-6\n", "EPS2 -2e-6\n", "UNITS TDB\n",
+    ]
+    WAVES = [
+        "ORBWAVE_OM 1.5e-7\n", "ORBWAVE_EPOCH 55400\n",
+        "ORBWAVEC0 2e-4 1\n", "ORBWAVES0 -1e-4 1\n",
+        "ORBWAVEC1 5e-5 1\n", "ORBWAVES1 3e-5 1\n",
+    ]
+
+    def _delay(self, par_lines, mjds):
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        m = get_model(par_lines)
+        t = make_fake_toas_fromMJDs(mjds, m, obs="bat", error_us=1.0)
+        comp = next(c for n, c in m.components.items()
+                    if n.startswith("Binary"))
+        m._get_compiled(t, tuple(m.free_params))
+        entry = m._cache["data"][t]
+        batch, ctx = entry[1], entry[2]
+        pv = dict(m._const_pv())
+        for nm in m.free_params:
+            pv[nm] = float(getattr(m, nm).value or 0.0)
+        import jax.numpy as jnp
+
+        name = next(n for n, c in m.components.items() if c is comp)
+        return m, np.asarray(comp.delay_func(pv, batch, ctx[name],
+                                             jnp.zeros(batch.ntoas)))
+
+    def test_waves_modulate_orbital_phase(self):
+        """delay(waves) == delay evaluated at a time shifted so the base
+        orbital phase equals base-phase + dphi (published formula,
+        reference pulsar_binary.py:71-72)."""
+        mjds = np.linspace(55350, 55450, 40)
+        m0, d0 = self._delay(self.PAR_BASE, mjds)
+        mw, dw = self._delay(self.PAR_BASE + self.WAVES, mjds)
+        assert not np.allclose(d0, dw)
+        # clean-room oracle: shift each TOA's time by dphi * PB so the
+        # unmodulated model lands on the same orbital phase
+        om = 1.5e-7
+        pb_d = 0.4
+        tw = (mjds - 55400.0) * 86400.0  # TASC/epoch offsets cancel? no:
+        tw = tw + (55399.5 - 55400.0) * 86400.0  # t - ORBWAVE_EPOCH, t ~ tasc
+        # tw must be (t - ORBWAVE_EPOCH); t here = mjd (barycentric site)
+        tw = (mjds - 55400.0) * 86400.0
+        dphi = (2e-4 * np.cos(om * tw) + -1e-4 * np.sin(om * tw)
+                + 5e-5 * np.cos(2 * om * tw) + 3e-5 * np.sin(2 * om * tw))
+        mjds_shift = mjds + dphi * pb_d
+        _, d0_shifted = self._delay(self.PAR_BASE, mjds_shift)
+        # the Roemer delay at the shifted phase matches the waves delay to
+        # the size of second-order terms (dphi ~ 2e-4 orbits)
+        assert np.allclose(dw, d0_shifted, atol=5e-7)
+        assert np.max(np.abs(dw - d0)) > 1e-4  # modulation is resolvable
+
+    def test_zero_amplitude_waves_match_base(self):
+        mjds = np.linspace(55350, 55450, 16)
+        _, d0 = self._delay(self.PAR_BASE, mjds)
+        zero = ["ORBWAVE_OM 1.5e-7\n", "ORBWAVE_EPOCH 55400\n",
+                "ORBWAVEC0 0.0 1\n", "ORBWAVES0 0.0 1\n"]
+        _, dz = self._delay(self.PAR_BASE + zero, mjds)
+        assert np.allclose(d0, dz, atol=1e-12)
+
+    def test_waves_params_roundtrip_parfile(self):
+        from pint_tpu.models import get_model
+
+        m = get_model(self.PAR_BASE + self.WAVES)
+        text = m.as_parfile()
+        m2 = get_model(text.splitlines(keepends=True))
+        assert float(m2.ORBWAVEC1.value) == 5e-5
+        assert float(m2.ORBWAVE_OM.value) == 1.5e-7
+
+    def test_gapped_indices_rejected(self):
+        from pint_tpu.exceptions import TimingModelError
+        from pint_tpu.models import get_model
+
+        bad = self.PAR_BASE + ["ORBWAVE_OM 1e-7\n", "ORBWAVE_EPOCH 55400\n",
+                               "ORBWAVEC0 1e-4\n", "ORBWAVES0 1e-4\n",
+                               "ORBWAVEC2 1e-5\n", "ORBWAVES2 1e-5\n"]
+        with pytest.raises(TimingModelError, match="without gaps"):
+            get_model(bad)
+
+
+class TestITOA:
+    def test_itoa_line_raises(self, tmp_path):
+        from pint_tpu.exceptions import PintFileError
+        from pint_tpu.io.tim import read_tim_file
+
+        # two-char site code, decimal point in column 15 (0-based 14)
+        line = "AO 1400.00 500.1234567890123  1.00\n"
+        assert line[14] == "."
+        p = tmp_path / "itoa.tim"
+        p.write_text(line)
+        with pytest.raises(PintFileError, match="ITOA"):
+            read_tim_file(str(p))
+
+
+class TestT2SpacecraftObs:
+    def test_flag_positions_flow_to_posvel(self, tmp_path):
+        from pint_tpu.toa import get_TOAs
+
+        lines = ["FORMAT 1\n"]
+        tel = [(1234.5, -2345.6, 3456.7), (2000.0, 1000.0, -500.0)]
+        vel = [(1.5, -2.5, 0.5), (-1.0, 0.25, 2.0)]
+        for i, m in enumerate((55000.25, 55001.75)):
+            tx, ty, tz = tel[i]
+            vx, vy, vz = vel[i]
+            lines.append(
+                f"sc{i} 1400.0 {m:.13f} 1.0 stl_geo -telx {tx} -tely {ty} "
+                f"-telz {tz} -vx {vx} -vy {vy} -vz {vz}\n")
+        p = tmp_path / "sc.tim"
+        p.write_text("".join(lines))
+        t = get_TOAs(str(p), include_bipm=False)
+        from pint_tpu.ephemeris import load_ephemeris
+
+        eph = load_ephemeris(t.ephem)
+        epos, evel = eph.posvel_ssb("earth", np.asarray(t.tdb, np.float64))
+        assert np.allclose(t.ssb_obs_pos_km - epos, np.asarray(tel),
+                           atol=1e-9)
+        assert np.allclose(t.ssb_obs_vel_kms - evel, np.asarray(vel),
+                           atol=1e-12)
+
+    def test_missing_flags_raise(self, tmp_path):
+        from pint_tpu.toa import get_TOAs
+
+        p = tmp_path / "bad.tim"
+        p.write_text("FORMAT 1\nsc 1400.0 55000.2500000000000 1.0 stl_geo\n")
+        with pytest.raises(ValueError, match="telx"):
+            get_TOAs(str(p), include_bipm=False)
+
+    def test_no_gps_correction(self, tmp_path, monkeypatch):
+        """Even when the pipeline asks for GPS corrections (its default),
+        the spacecraft site's policy wins (reference
+        ``special_locations.py:170`` apply_gps2utc=False)."""
+        import numpy as np
+
+        from pint_tpu.observatory import clock_file as cfmod
+        from pint_tpu.observatory import get_observatory
+
+        ob = get_observatory("stl_geo")
+        assert ob.include_gps is False
+        assert get_observatory("spacecraft") is ob
+        # plant a gps2utc.clk with a huge correction; spacecraft must ignore
+        (tmp_path / "gps2utc.clk").write_text(
+            "# UTC(GPS) UTC\n40000 1.0\n60000 1.0\n")
+        monkeypatch.setenv("PINT_CLOCK_DIR", str(tmp_path))
+        saved = dict(cfmod._cache)
+        cfmod._cache.clear()
+        try:
+            mjd = np.array([55000.5])
+            assert ob.clock_corrections(mjd, include_gps=True)[0] == 0.0
+            gbt = get_observatory("gbt")
+            assert gbt.clock_corrections(mjd, include_gps=True)[0] == 1.0
+        finally:
+            cfmod._cache.clear()
+            cfmod._cache.update(saved)
