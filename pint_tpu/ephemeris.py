@@ -44,6 +44,10 @@ _EPS_J2000 = 84381.448 * np.pi / (180.0 * 3600.0)
 AU_KM = 1.495978707e8
 DAY_S = 86400.0
 
+#: NAIF ids of the time-ephemeris (TDB-TT) segment in 't' kernels
+TDB_TT_TARGET = 1000000001
+TDB_TT_CENTER = 1000000000
+
 #: NAIF integer codes used by SPK kernels
 BODY_IDS = {
     "ssb": 0, "mercury_bary": 1, "venus_bary": 2, "emb": 3, "mars_bary": 4,
@@ -529,7 +533,10 @@ class SPKEphemeris(Ephemeris):
         rec = recs[idx]  # (..., rsize)
         mid, radius = rec[..., 0], rec[..., 1]
         x = (et - mid) / radius  # in [-1, 1]
-        ncomp = 3 if s.dtype == 2 else 6
+        if (s.target, s.center) == (TDB_TT_TARGET, TDB_TT_CENTER):
+            ncomp = 1  # time-ephemeris segment: scalar TDB-TT [s]
+        else:
+            ncomp = 3 if s.dtype == 2 else 6
         ncoef = (s.rsize - 2) // ncomp
         coeffs = rec[..., 2:2 + ncoef * ncomp].reshape(rec.shape[:-1] + (ncomp, ncoef))
         # Chebyshev recurrence; the derivative recurrence is only needed for
@@ -587,6 +594,33 @@ class SPKEphemeris(Ephemeris):
             pos = pos + sign * p
             vel = vel + sign * v
         return pos, vel
+
+    def has_tdb_tt(self) -> bool:
+        """True when the kernel carries a time-ephemeris segment (the 't'
+        kernels DE430t/DE440t; target 1000000001 wrt 1000000000)."""
+        return (TDB_TT_TARGET, TDB_TT_CENTER) in self._by_pair
+
+    def tdb_minus_tt(self, tt_mjd) -> np.ndarray:
+        """TDB-TT [s] from the kernel's integrated time ephemeris — the
+        ns-exact source the reference reaches via ERFA's analytic series
+        (``observatory/__init__.py:443``); a 't' kernel beats the series.
+
+        The argument difference (evaluating at TT vs TDB epochs, ~1.7 ms)
+        changes the result by < d(TDB-TT)/dt * 1.7 ms ~ 3e-14 s: ignorable.
+        """
+        if not self.has_tdb_tt():
+            raise KeyError(f"{self.path} has no TDB-TT time-ephemeris segment")
+        shape = np.shape(tt_mjd)
+        tt = np.atleast_1d(np.asarray(tt_mjd, dtype=np.float64))
+        et = (tt - 51544.5) * DAY_S
+        val, _ = self._eval_pair(TDB_TT_TARGET, TDB_TT_CENTER, et)
+        return val[..., 0].reshape(shape)
+
+    def coverage_mjd(self) -> Tuple[float, float]:
+        """(lo, hi) MJD range covered by every segment simultaneously."""
+        lo = max(s.et0 for s in self.segments) / DAY_S + 51544.5
+        hi = min(s.et1 for s in self.segments) / DAY_S + 51544.5
+        return lo, hi
 
 
 # ---------------------------------------------------------------------------

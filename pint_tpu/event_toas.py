@@ -146,11 +146,11 @@ def get_fits_TOAs(eventname: str, mission: str = "generic", weights=None,
     if tr == "SOLARSYSTEM":
         # already barycentric: TDB = given times, site at SSB
         ts_obj.clock_corr_s = np.zeros(n)
-        ts_obj.compute_TDBs()
+        ts_obj.compute_TDBs(ephem=ephem or "DE440")
         ts_obj.compute_posvels(ephem=ephem or "DE440", planets=planets)
     else:
         ts_obj.apply_clock_corrections(include_bipm=False)
-        ts_obj.compute_TDBs()
+        ts_obj.compute_TDBs(ephem=ephem or "DE440")
         ts_obj.compute_posvels(ephem=ephem or "DE440", planets=planets)
     return ts_obj
 

@@ -137,6 +137,6 @@ def get_Fermi_TOAs(ft1name: str, weightcolumn: Optional[str] = None,
         ts.clock_corr_s = np.zeros(n)
     else:
         ts.apply_clock_corrections(include_bipm=False)
-    ts.compute_TDBs()
+    ts.compute_TDBs(ephem=ephem or "DE440")
     ts.compute_posvels(ephem=ephem or "DE440", planets=planets)
     return ts

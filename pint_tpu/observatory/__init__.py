@@ -89,14 +89,14 @@ class Observatory:
     # -- time scales -------------------------------------------------------
     def get_TDBs(self, utc_mjd, method="default", ephem=None):
         """Corrected-UTC MJD -> TDB MJD (longdouble)."""
-        return utc_to_tdb_mjd(utc_mjd)
+        return utc_to_tdb_mjd(utc_mjd, ephem=ephem)
 
     def get_TDB_offset_seconds(self, utc_mjd, method="default", ephem=None):
         """(TDB - corrected UTC) in seconds, float64 — offset form used by
         the degraded-longdouble pair pipeline (no absolute-MJD rounding)."""
         from pint_tpu.timescales import utc_to_tdb_offset_seconds
 
-        return utc_to_tdb_offset_seconds(utc_mjd)
+        return utc_to_tdb_offset_seconds(utc_mjd, ephem=ephem)
 
     # -- geometry ----------------------------------------------------------
     def earth_location_itrf(self):
@@ -145,6 +145,33 @@ class TopoObs(Observatory):
         epos, evel = eph.posvel_ssb("earth", tdb_mjd)  # km, km/s
         gpos, gvel = self.get_gcrs(utc_mjd)  # m, m/s
         return PosVel(epos + gpos / 1e3, evel + gvel / 1e3, obj=self.name, origin="ssb")
+
+    # -- topocentric TDB ---------------------------------------------------
+    def _topocentric_tdb_seconds(self, utc64, ephem=None) -> np.ndarray:
+        """(v_earth . r_site_GCRS)/c^2 — the ~2.1 us diurnal part of TDB-TT
+        at the observatory, which the geocentric series omits (the reference
+        gets it from ERFA dtdb's (u, v) observer terms,
+        ``observatory/__init__.py:443``)."""
+        c_km_s = 299792.458
+        tdb64 = utc64 + 69.184 / 86400.0  # minute-level epoch is plenty
+        _, evel = ephem_mod.load_ephemeris(ephem or "DE440").posvel_ssb(
+            "earth", tdb64)  # km/s
+        gpos_m, _ = self.get_gcrs(utc64)
+        return np.sum(evel * (gpos_m / 1e3), axis=-1) / c_km_s**2
+
+    def get_TDBs(self, utc_mjd, method="default", ephem=None):
+        utc64 = np.atleast_1d(np.asarray(utc_mjd, dtype=np.float64))
+        base = utc_to_tdb_mjd(utc_mjd, ephem=ephem)
+        topo = self._topocentric_tdb_seconds(utc64, ephem=ephem)
+        return base + np.asarray(topo, dtype=np.longdouble).reshape(
+            np.shape(base)) / np.longdouble(86400.0)
+
+    def get_TDB_offset_seconds(self, utc_mjd, method="default", ephem=None):
+        from pint_tpu.timescales import utc_to_tdb_offset_seconds
+
+        utc64 = np.atleast_1d(np.asarray(utc_mjd, dtype=np.float64))
+        return (utc_to_tdb_offset_seconds(utc_mjd, ephem=ephem)
+                + self._topocentric_tdb_seconds(utc64, ephem=ephem))
 
 
 class GeocenterObs(Observatory):

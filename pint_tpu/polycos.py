@@ -140,7 +140,7 @@ class Polycos:
             ts.apply_clock_corrections(include_bipm=include_bipm)
         else:
             ts.clock_corr_s = np.zeros(n)
-        ts.compute_TDBs()
+        ts.compute_TDBs(ephem=model.EPHEM.value or "DE440")
         ts.compute_posvels(ephem=model.EPHEM.value or "DE440",
                            planets=bool(model.PLANET_SHAPIRO.value))
         ph = model.phase(ts, abs_phase="AbsPhase" in model.components)

@@ -42,7 +42,7 @@ def zero_residuals(ts: TOAs, model, maxiter: int = 10,
         # positions/TDB change negligibly for sub-ms shifts; recompute time-dep
         # columns only when shifts are large
         if worst > 1.0:
-            ts.compute_TDBs()
+            ts.compute_TDBs(ephem=ts.ephem or "DE440")
             ts.compute_posvels(ephem=ts.ephem or "DE440", planets=ts.planets)
     else:
         log.warning(f"zero_residuals did not converge below {tolerance_s} s "
@@ -114,7 +114,7 @@ def make_fake_toas_fromMJDs(mjds, model, freq: float = 1400.0, obs: str = "gbt",
     planets = bool(model.PLANET_SHAPIRO.value)
     include_bipm = str(model.CLOCK.value or "").upper().startswith("TT(BIPM")
     ts.apply_clock_corrections(include_bipm=include_bipm)
-    ts.compute_TDBs()
+    ts.compute_TDBs(ephem=ephem)
     ts.compute_posvels(ephem=ephem, planets=planets)
     return make_fake_toas(ts, model, add_noise=add_noise, wideband=wideband,
                           rng=rng)

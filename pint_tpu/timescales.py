@@ -26,6 +26,8 @@ __all__ = [
     "tt_minus_utc",
     "utc_to_tt_mjd",
     "tdb_minus_tt",
+    "tdb_minus_tt_series",
+    "set_tdb_provider",
     "utc_to_tdb_mjd",
     "gps_to_utc_seconds",
 ]
@@ -73,7 +75,7 @@ def utc_to_tt_mjd(utc_mjd):
     return utc_mjd + np.asarray(dt, dtype=np.longdouble) / np.longdouble(86400.0)
 
 
-def utc_to_tdb_offset_seconds(utc_mjd) -> np.ndarray:
+def utc_to_tdb_offset_seconds(utc_mjd, ephem: "str | None" = None) -> np.ndarray:
     """(TDB - UTC) in seconds at the given UTC epochs, float64.
 
     Computed without forming absolute-MJD sums, so degraded-longdouble
@@ -83,7 +85,7 @@ def utc_to_tdb_offset_seconds(utc_mjd) -> np.ndarray:
     utc64 = np.asarray(utc_mjd, dtype=np.float64)
     dt = tt_minus_utc(utc64)
     tt64 = utc64 + dt / 86400.0
-    return dt + _tdb_provider(tt64)
+    return dt + tdb_minus_tt(tt64, ephem=ephem)
 
 
 def tt_to_utc_mjd(tt_mjd):
@@ -121,12 +123,9 @@ _TDB_TERMS = np.array(
 _TDB_SECULAR = (1.02e-8, 628.3075850, 4.249032)
 
 
-def tdb_minus_tt(tt_mjd) -> np.ndarray:
-    """TDB-TT in seconds (geocentric analytic series), float64.
-
-    Pluggable precision point: replace via :func:`set_tdb_provider` with a
-    full-series or ephemeris-based provider when available.
-    """
+def tdb_minus_tt_series(tt_mjd) -> np.ndarray:
+    """TDB-TT in seconds from the truncated analytic series (geocentric,
+    ~10 us accuracy 1980-2050)."""
     tt_mjd = np.asarray(tt_mjd, dtype=np.float64)
     T = ((tt_mjd - 51544.5) / 36525.0).reshape(-1)
     amp = _TDB_TERMS[:, 0][:, None]
@@ -138,17 +137,56 @@ def tdb_minus_tt(tt_mjd) -> np.ndarray:
     return out.reshape(tt_mjd.shape)
 
 
-_tdb_provider = tdb_minus_tt
+_tdb_provider = None  # explicit user override via set_tdb_provider
+_warned_tdb_fallback = False
+
+
+def tdb_minus_tt(tt_mjd, ephem: "str | None" = None) -> np.ndarray:
+    """TDB-TT in seconds (geocentric), float64.
+
+    Source priority: (1) an explicitly installed provider
+    (:func:`set_tdb_provider`); (2) the loaded kernel's own time-ephemeris
+    segment when present (DE430t/DE440t 't' kernels — ns-exact, better than
+    the reference's ERFA analytic series); (3) direct integration of the
+    defining rate equation with the loaded ephemeris
+    (:mod:`pint_tpu.tdb_integrated` — timing-relevant variation exact to
+    ephemeris quality); (4) the truncated analytic series (~10 us).
+    """
+    global _warned_tdb_fallback
+    if _tdb_provider is not None:
+        return _tdb_provider(np.asarray(tt_mjd, dtype=np.float64))
+    try:
+        from pint_tpu.ephemeris import load_ephemeris
+
+        eph = load_ephemeris(ephem or "DE440")
+        if getattr(eph, "has_tdb_tt", lambda: False)():
+            return eph.tdb_minus_tt(tt_mjd)
+        from pint_tpu.tdb_integrated import integrated_tdb_minus_tt
+
+        return integrated_tdb_minus_tt(tt_mjd, ephem=ephem)
+    except (FileNotFoundError, ImportError, KeyError, ValueError) as e:
+        # expected degradations only (missing kernel/scipy, epochs outside
+        # kernel coverage); programming errors must surface, not silently
+        # downgrade precision by 4 orders of magnitude
+        if not _warned_tdb_fallback:
+            _warned_tdb_fallback = True
+            from pint_tpu.logging import log
+
+            log.warning(f"Integrated TDB-TT unavailable ({e}); using the "
+                        "truncated analytic series (~10 us)")
+        return tdb_minus_tt_series(np.asarray(tt_mjd, dtype=np.float64))
 
 
 def set_tdb_provider(fn) -> None:
-    """Install an alternative TDB-TT provider (signature: tt_mjd -> seconds)."""
+    """Install an alternative TDB-TT provider (signature: tt_mjd -> seconds);
+    pass None to restore the kernel/series default."""
     global _tdb_provider
     _tdb_provider = fn
 
 
-def utc_to_tdb_mjd(utc_mjd):
+def utc_to_tdb_mjd(utc_mjd, ephem: "str | None" = None):
     """UTC MJD -> TDB MJD, longdouble precision end to end."""
     tt = utc_to_tt_mjd(utc_mjd)
-    dt = _tdb_provider(np.asarray(tt, dtype=np.float64)).reshape(np.shape(tt))
+    dt = tdb_minus_tt(np.asarray(tt, dtype=np.float64),
+                      ephem=ephem).reshape(np.shape(tt))
     return tt + np.asarray(dt, dtype=np.longdouble) / np.longdouble(86400.0)

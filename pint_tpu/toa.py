@@ -258,7 +258,7 @@ class TOAs:
         from pint_tpu.ephemeris import load_ephemeris
 
         if self.tdb is None:
-            self.compute_TDBs()
+            self.compute_TDBs(ephem=ephem or "DE440")
         self.ephem = ephem or "DE440"
         self.planets = planets
         eph = load_ephemeris(self.ephem)
@@ -515,7 +515,7 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
     t = TOAs.from_raw(raw, commands, filename=timfile)
     t.apply_clock_corrections(include_gps=include_gps, include_bipm=include_bipm,
                               bipm_version=bipm_version, limits=limits)
-    t.compute_TDBs()
+    t.compute_TDBs(ephem=ephem or "DE440")
     t.compute_posvels(ephem=ephem or "DE440", planets=planets)
     log.info(f"Loaded {len(t)} TOAs from {timfile} "
              f"(ephem={t.ephem}, planets={planets}, bipm={include_bipm})")
@@ -653,6 +653,6 @@ def make_single_toa(mjd, obs: str, freq_mhz: float = np.inf,
     )
     t.apply_clock_corrections(include_gps=include_gps, include_bipm=include_bipm,
                               bipm_version=bipm_version)
-    t.compute_TDBs()
+    t.compute_TDBs(ephem=ephem)
     t.compute_posvels(ephem=ephem, planets=planets)
     return t

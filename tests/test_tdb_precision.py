@@ -1,0 +1,228 @@
+"""TDB precision (VERDICT r2 directive #3): kernel time-ephemeris segments
+and the topocentric TDB term.
+
+No ERFA exists in this image to generate true dtdb values, so precision is
+pinned differentially: (a) a synthetic SPK 't' kernel with a KNOWN TDB-TT
+function must round-trip through ``SPKEphemeris.tdb_minus_tt`` and the full
+``get_TDBs`` chain at the ns level (this is the ns-exact production path —
+DE430t/DE440t kernels carry the integrated time ephemeris, better than the
+reference's ERFA analytic series); (b) the observatory topocentric term
+(v_earth . r_site / c^2, ~2.1 us diurnal — reference gets it inside ERFA
+dtdb, ``observatory/__init__.py:443``) must match an independent evaluation
+and show the right amplitude/diurnal signature.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_synthetic_spk import _write_spk  # noqa: E402
+
+DAY_S = 86400.0
+J2000 = 51544.5
+
+
+def _tdbtt_truth(et):
+    """A known smooth TDB-TT-like function [s] of TDB seconds past J2000."""
+    w = 2 * np.pi / (365.25 * DAY_S)
+    return (1.657e-3 * np.sin(w * et + 1.2)
+            + 2.2e-5 * np.sin(2 * w * et + 0.4) - 7.3e-5)
+
+
+@pytest.fixture
+def t_kernel(tmp_path):
+    """Synthetic kernel: planets (type 2) + a TDB-TT segment fitted to the
+    known truth function with degree-12 Chebyshev records."""
+    from numpy.polynomial import chebyshev as C
+
+    init = (54000.0 - J2000) * DAY_S
+    intlen = 32.0 * DAY_S
+    n_rec, ncoef = 40, 13
+    recs = np.zeros((n_rec, 2 + ncoef))
+    for i in range(n_rec):
+        mid = init + (i + 0.5) * intlen
+        radius = intlen / 2.0
+        recs[i, 0], recs[i, 1] = mid, radius
+        xs = np.cos(np.pi * (np.arange(2 * ncoef) + 0.5) / (2 * ncoef))
+        recs[i, 2:] = C.chebfit(xs, _tdbtt_truth(mid + radius * xs), ncoef - 1)
+    # a minimal earth/sun set so the kernel also serves posvel
+    rng = np.random.default_rng(1)
+    from test_synthetic_spk import _cheb_records
+
+    segs = [
+        dict(target=3, center=0, dtype=2, init=init, intlen=intlen,
+             records=_cheb_records(rng, n_rec, 8, init, intlen, scale=1.5e8)),
+        dict(target=399, center=3, dtype=2, init=init, intlen=intlen,
+             records=_cheb_records(rng, n_rec, 8, init, intlen, scale=4.5e5)),
+        dict(target=10, center=0, dtype=2, init=init, intlen=intlen,
+             records=_cheb_records(rng, n_rec, 8, init, intlen, scale=1e6)),
+        dict(target=1000000001, center=1000000000, dtype=2, init=init,
+             intlen=intlen, records=recs),
+    ]
+    path = str(tmp_path / "de998t.bsp")
+    _write_spk(path, segs)
+    return path
+
+
+class TestKernelTDB:
+    def test_segment_roundtrip_ns(self, t_kernel):
+        from pint_tpu.ephemeris import SPKEphemeris
+
+        eph = SPKEphemeris(t_kernel)
+        assert eph.has_tdb_tt()
+        tt = 54100.0 + np.linspace(0, 1000, 300)
+        got = eph.tdb_minus_tt(tt)
+        want = _tdbtt_truth((tt - J2000) * DAY_S)
+        assert np.max(np.abs(got - want)) < 1e-9  # ns-level round trip
+
+    def test_timescales_prefers_kernel(self, t_kernel, monkeypatch):
+        import pint_tpu.ephemeris as em
+        from pint_tpu.timescales import tdb_minus_tt, tdb_minus_tt_series
+
+        monkeypatch.setitem(em._loaded, "de998t", em.SPKEphemeris(t_kernel))
+        tt = np.array([54321.0, 54700.5])
+        got = tdb_minus_tt(tt, ephem="DE998T")
+        want = _tdbtt_truth((tt - J2000) * DAY_S)
+        assert np.allclose(got, want, atol=1e-9)
+        # and it really is a different source than the series
+        assert not np.allclose(got, tdb_minus_tt_series(tt), atol=1e-6)
+
+    def test_full_chain_uses_kernel(self, t_kernel, monkeypatch, tmp_path):
+        """get_TOAs -> compute_TDBs picks up the kernel's time ephemeris."""
+        import pint_tpu.ephemeris as em
+        from pint_tpu.timescales import tt_minus_utc, utc_to_tt_mjd
+        from pint_tpu.toa import get_TOAs
+
+        monkeypatch.setitem(em._loaded, "de998t", em.SPKEphemeris(t_kernel))
+        mjds = np.array([54200.3, 54800.7])
+        lines = ["FORMAT 1\n"] + [
+            f"k{i} 1400.0 {m:.13f} 1.0 geocenter\n" for i, m in enumerate(mjds)]
+        p = tmp_path / "k.tim"
+        p.write_text("".join(lines))
+        t = get_TOAs(str(p), ephem="DE998T", include_gps=False,
+                     include_bipm=False)
+        tt = np.asarray(utc_to_tt_mjd(mjds), np.float64)
+        want = _tdbtt_truth((tt - J2000) * DAY_S)
+        got = (np.asarray(t.tdb, np.longdouble)
+               - np.asarray(utc_to_tt_mjd(mjds), np.longdouble)) * 86400.0
+        assert np.allclose(np.asarray(got, np.float64), want, atol=1e-8)
+
+    def test_explicit_provider_wins(self, t_kernel, monkeypatch):
+        import pint_tpu.ephemeris as em
+        from pint_tpu.timescales import set_tdb_provider, tdb_minus_tt
+
+        monkeypatch.setitem(em._loaded, "de998t", em.SPKEphemeris(t_kernel))
+        set_tdb_provider(lambda tt: np.full(np.shape(tt), 42.0))
+        try:
+            assert tdb_minus_tt(np.array([54300.0]), ephem="DE998T")[0] == 42.0
+        finally:
+            set_tdb_provider(None)
+
+
+class TestTopocentricTDB:
+    def test_matches_independent_formula(self):
+        from pint_tpu.ephemeris import load_ephemeris
+        from pint_tpu.observatory import get_observatory
+
+        gbt = get_observatory("gbt")
+        utc = np.linspace(55000.0, 55001.0, 25)  # one day, hourly
+        topo = gbt._topocentric_tdb_seconds(utc)
+        # independent evaluation
+        eph = load_ephemeris("DE440")
+        _, evel = eph.posvel_ssb("earth", utc + 69.184 / 86400.0)
+        gpos_m, _ = gbt.get_gcrs(utc)
+        want = np.sum(evel * gpos_m / 1e3, axis=1) / 299792.458**2
+        assert np.allclose(topo, want, rtol=0, atol=1e-12)
+        # ~2.1 us amplitude, diurnal sign change
+        assert 1e-6 < np.max(np.abs(topo)) < 2.3e-6
+        assert np.min(topo) < 0 < np.max(topo)
+
+    def test_get_tdbs_includes_topo(self):
+        from pint_tpu.observatory import get_observatory
+        from pint_tpu.timescales import utc_to_tdb_mjd
+
+        gbt = get_observatory("gbt")
+        utc = np.array([55123.25, 55123.75])
+        with_topo = gbt.get_TDBs(utc)
+        base = utc_to_tdb_mjd(utc)
+        diff_s = np.asarray((with_topo - base) * 86400.0, np.float64)
+        want = gbt._topocentric_tdb_seconds(utc)
+        assert np.allclose(diff_s, want, atol=2e-10)  # longdouble MJD ulp ~5e-10 s
+        # offset-seconds (pair pipeline) path carries the same term
+        off = gbt.get_TDB_offset_seconds(utc)
+        from pint_tpu.timescales import utc_to_tdb_offset_seconds
+
+        assert np.allclose(off - utc_to_tdb_offset_seconds(utc), want,
+                           atol=1e-12)
+
+    def test_geocenter_and_barycenter_have_no_topo(self):
+        from pint_tpu.observatory import get_observatory
+        from pint_tpu.timescales import utc_to_tdb_mjd
+
+        utc = np.array([55123.3])
+        ob = get_observatory("geocenter")
+        assert np.all(ob.get_TDBs(utc) == utc_to_tdb_mjd(utc))
+        # barycentric TOAs are already TDB: identity, no conversion, no topo
+        bat = get_observatory("barycenter")
+        assert np.all(np.asarray(bat.get_TDBs(utc), np.float64) == utc)
+
+
+class TestIntegratedTDB:
+    def test_close_to_series_but_sharper(self):
+        """The integral tracks the 14-term series within its ~10 us
+        truncation error, and the anchored offset+rate are ~zero."""
+        from pint_tpu.tdb_integrated import IntegratedTDB
+        from pint_tpu.timescales import tdb_minus_tt_series
+
+        integ = IntegratedTDB()
+        tt = np.linspace(54000.0, 56000.0, 400)
+        got = integ(tt)
+        d = got - tdb_minus_tt_series(tt)
+        assert np.max(np.abs(d)) < 3e-5  # series truncation scale
+        # anchoring removed offset and rate
+        assert abs(np.mean(d)) < 1e-6
+        slope = np.polyfit(tt - tt.mean(), d, 1)[0]
+        assert abs(slope * 2000) < 1e-6  # linear drift across the window
+
+    def test_quadrature_converged(self):
+        """Halving the integration step changes nothing at the ns level."""
+        from pint_tpu.tdb_integrated import IntegratedTDB
+
+        a = IntegratedTDB()
+        b = IntegratedTDB()
+        b.STEP = 0.0625
+        tt = np.linspace(55000.0, 55400.0, 60)
+        assert np.max(np.abs(a(tt) - b(tt))) < 1e-9
+
+    def test_window_extension_consistent(self):
+        """Extending the window must (a) keep previously served values
+        unchanged (a re-anchored offset would act like an inter-site jump)
+        and (b) agree with a fresh wide-window integrator up to the
+        unobservable offset+rate ambiguity."""
+        from pint_tpu.tdb_integrated import IntegratedTDB
+
+        a = IntegratedTDB()
+        narrow = np.linspace(55000.0, 55100.0, 21)
+        before = a(narrow)
+        wide = np.linspace(54500.0, 55600.0, 50)
+        got = a(wide)
+        assert np.max(np.abs(a(narrow) - before)) < 1e-10  # continuity
+        fresh = IntegratedTDB()(wide)
+        d = got - fresh
+        resid = d - np.polyval(np.polyfit(wide - wide.mean(), d, 1),
+                               wide - wide.mean())
+        assert np.max(np.abs(resid)) < 2e-9  # equal modulo offset+rate
+
+    def test_default_chain_uses_integrator(self):
+        from pint_tpu.timescales import tdb_minus_tt, tdb_minus_tt_series
+
+        tt = np.array([55200.25])
+        got = tdb_minus_tt(tt)
+        from pint_tpu.tdb_integrated import integrated_tdb_minus_tt
+
+        assert got[0] == integrated_tdb_minus_tt(tt)[0]
+        # and that differs (sub-series-error but nonzero) from the series
+        assert got[0] != tdb_minus_tt_series(tt)[0]
