@@ -522,8 +522,10 @@ class SPKEphemeris(Ephemeris):
         recs = self._seg_coeffs(s)
         # refuse to extrapolate outside the segment's coverage (1 s tolerance)
         if np.any(et < s.et0 - 1.0) or np.any(et > s.et1 + 1.0):
+            from pint_tpu.exceptions import EphemCoverageError
+
             bad = et[(et < s.et0 - 1.0) | (et > s.et1 + 1.0)]
-            raise ValueError(
+            raise EphemCoverageError(
                 f"{self.path}: epoch(s) MJD "
                 f"{bad.min() / DAY_S + 51544.5:.1f}..{bad.max() / DAY_S + 51544.5:.1f} "
                 f"outside kernel coverage for segment {target}/{center} "
@@ -531,6 +533,7 @@ class SPKEphemeris(Ephemeris):
             )
         idx = np.clip(((et - s.init) / s.intlen).astype(int), 0, s.n - 1)
         rec = recs[idx]  # (..., rsize)
+        # (note: the out-of-coverage check above raises EphemCoverageError)
         mid, radius = rec[..., 0], rec[..., 1]
         x = (et - mid) / radius  # in [-1, 1]
         if (s.target, s.center) == (TDB_TT_TARGET, TDB_TT_CENTER):
@@ -605,6 +608,12 @@ class SPKEphemeris(Ephemeris):
         ns-exact source the reference reaches via ERFA's analytic series
         (``observatory/__init__.py:443``); a 't' kernel beats the series.
 
+        Kernel conventions differ on whether the segment stores TDB-TT or
+        TT-TDB; the sign is self-calibrated once per kernel by correlating
+        against the analytic series' 1.7 ms annual term (any real kernel
+        agrees with the series at the ~10 us level, so the correlation sign
+        is unambiguous).
+
         The argument difference (evaluating at TT vs TDB epochs, ~1.7 ms)
         changes the result by < d(TDB-TT)/dt * 1.7 ms ~ 3e-14 s: ignorable.
         """
@@ -614,7 +623,24 @@ class SPKEphemeris(Ephemeris):
         tt = np.atleast_1d(np.asarray(tt_mjd, dtype=np.float64))
         et = (tt - 51544.5) * DAY_S
         val, _ = self._eval_pair(TDB_TT_TARGET, TDB_TT_CENTER, et)
-        return val[..., 0].reshape(shape)
+        return self._tdbtt_sign() * val[..., 0].reshape(shape)
+
+    def _tdbtt_sign(self) -> float:
+        if getattr(self, "_tdbtt_sign_cached", None) is None:
+            from pint_tpu.timescales import tdb_minus_tt_series
+
+            s = self._by_pair[(TDB_TT_TARGET, TDB_TT_CENTER)]
+            et = np.linspace(s.et0, min(s.et1, s.et0 + 366 * DAY_S), 73)
+            raw, _ = self._eval_pair(TDB_TT_TARGET, TDB_TT_CENTER, et)
+            raw = raw[..., 0] - raw[..., 0].mean()
+            ref = tdb_minus_tt_series(et / DAY_S + 51544.5)
+            ref = ref - ref.mean()
+            corr = float(np.sum(raw * ref))
+            self._tdbtt_sign_cached = 1.0 if corr >= 0 else -1.0
+            if corr < 0:
+                log.info(f"{self.path}: time-ephemeris segment stores TT-TDB"
+                         " (sign flipped to the TDB-TT convention)")
+        return self._tdbtt_sign_cached
 
     def coverage_mjd(self) -> Tuple[float, float]:
         """(lo, hi) MJD range covered by every segment simultaneously."""

@@ -85,6 +85,10 @@ class AliasConflict(ModelError):
     """Two components claim the same parameter alias."""
 
 
+class EphemCoverageError(PintError, ValueError):
+    """Requested epochs fall outside the loaded ephemeris kernel."""
+
+
 class ConvergenceFailure(PintError):
     """An iterative fitter failed to converge."""
 

@@ -1,0 +1,208 @@
+"""WaveX <-> power-law red-noise conversions + WaveX setup helpers.
+
+Counterpart of reference ``utils.py:1449 wavex_setup``, ``utils.py:3216
+plrednoise_from_wavex`` / ``pldmnoise_from_dmwavex`` and ``utils.py:3370
+find_optimal_nharms``: a Fourier (WaveX-family) representation of red noise
+can be refit into the equivalent ``PLRedNoise``/``PLDMNoise`` spectral
+parameters by maximizing the likelihood of the sin/cos amplitudes under the
+power-law prior.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.logging import log
+from pint_tpu.models.noise_model import FYR, powerlaw
+from pint_tpu.models.parameter import prefixParameter
+
+__all__ = ["wavex_setup", "dmwavex_setup", "plrednoise_from_wavex",
+           "pldmnoise_from_dmwavex", "find_optimal_nharms"]
+
+DAY_S = 86400.0
+
+
+def _wavex_family_setup(model, component_cls, prefixes, units, T_span_d,
+                        freqs=None, n_freqs=None, freeze_params=False):
+    if (freqs is None) == (n_freqs is None):
+        raise ValueError("Specify exactly one of freqs or n_freqs")
+    if freqs is None:
+        freqs = [(k + 1) / float(T_span_d) for k in range(int(n_freqs))]
+    freqs = sorted(float(f) for f in freqs)
+    nyquist = None if n_freqs is not None else None
+    comp = component_cls()
+    fpre, spre, cpre = prefixes
+    for i, f in enumerate(freqs, start=1):
+        if i > 1:
+            comp.add_param(prefixParameter(f"{fpre}{i:04d}", units="1/d",
+                                           description="WaveX frequency"))
+            comp.add_param(prefixParameter(f"{spre}{i:04d}", units=units,
+                                           value=0.0,
+                                           description="Sine amplitude"))
+            comp.add_param(prefixParameter(f"{cpre}{i:04d}", units=units,
+                                           value=0.0,
+                                           description="Cosine amplitude"))
+        getattr(comp, f"{fpre}{i:04d}").value = f
+        for pre in (spre, cpre):
+            par = getattr(comp, f"{pre}{i:04d}")
+            par.value = 0.0
+            par.frozen = freeze_params
+    comp.setup()
+    model.add_component(comp)
+    model.setup()
+    return list(range(1, len(freqs) + 1))
+
+
+def wavex_setup(model, T_span_d: float, freqs=None, n_freqs=None,
+                freeze_params: bool = False) -> List[int]:
+    """Attach a WaveX component with evenly spaced (or explicit) frequencies
+    (reference ``utils.py:1449``).  Returns the assigned indices."""
+    from pint_tpu.models.wavex import WaveX
+
+    return _wavex_family_setup(model, WaveX, ("WXFREQ_", "WXSIN_", "WXCOS_"),
+                               "s", T_span_d, freqs, n_freqs, freeze_params)
+
+
+def dmwavex_setup(model, T_span_d: float, freqs=None, n_freqs=None,
+                  freeze_params: bool = False) -> List[int]:
+    from pint_tpu.models.wavex import DMWaveX
+
+    return _wavex_family_setup(model, DMWaveX,
+                               ("DMWXFREQ_", "DMWXSIN_", "DMWXCOS_"),
+                               "pc/cm3", T_span_d, freqs, n_freqs,
+                               freeze_params)
+
+
+def _wx2pl_lnlike(model, component: str, ignore_fyr: bool = True):
+    """Negative log-likelihood of the WaveX amplitudes under a power-law
+    spectrum (reference ``utils.py:3140 _get_wx2pl_lnlike``)."""
+    comp = model.components[component]
+    fpre, spre, cpre = comp.prefixes
+    idxs = comp.indices if hasattr(comp, "indices") else sorted(
+        int(p[len(fpre):]) for p in comp.params if p.startswith(fpre))
+    fs_d = np.array([float(getattr(model, f"{fpre}{i:04d}").value)
+                     for i in idxs])
+    fs = fs_d / DAY_S  # Hz
+    if ignore_fyr:
+        keep = np.abs(fs - FYR) > 0.5 * np.min(np.diff(np.sort(fs))) \
+            if len(fs) > 1 else np.ones(len(fs), bool)
+        fs_d, fs = fs_d[keep], fs[keep]
+        idxs = [i for i, k in zip(idxs, keep) if k]
+    f0 = np.min(fs)
+    if component == "DMWaveX":
+        from pint_tpu import DMconst
+
+        scale = DMconst / 1400.0**2
+    else:
+        scale = 1.0
+
+    def grab(pre, unc=False):
+        out = []
+        for i in idxs:
+            p = getattr(model, f"{pre}{i:04d}")
+            v = (p.uncertainty if unc else p.value) or 0.0
+            out.append(scale * float(v))
+        return np.array(out)
+
+    a, da = grab(spre), grab(spre, unc=True)
+    b, db = grab(cpre), grab(cpre, unc=True)
+
+    def mlnlike(params):
+        gamma, log10_A = params
+        sig2 = powerlaw(fs, 10.0**log10_A, gamma) * f0
+        return 0.5 * float(np.sum(a**2 / (sig2 + da**2)
+                                  + b**2 / (sig2 + db**2)
+                                  + np.log(sig2 + da**2)
+                                  + np.log(sig2 + db**2)))
+
+    return mlnlike, len(idxs)
+
+
+def _hessian2(fn, x, h=(1e-4, 1e-4)) -> np.ndarray:
+    """2x2 central-difference Hessian (numdifftools is not in the image)."""
+    H = np.zeros((2, 2))
+    for i in range(2):
+        for j in range(2):
+            e_i = np.eye(2)[i] * h[i]
+            e_j = np.eye(2)[j] * h[j]
+            H[i, j] = (fn(x + e_i + e_j) - fn(x + e_i - e_j)
+                       - fn(x - e_i + e_j) + fn(x - e_i - e_j)) \
+                / (4 * h[i] * h[j])
+    return H
+
+
+def _pl_from_wavex(model, component: str, noise_cls, amp_par: str,
+                   gam_par: str, c_par: str, ignore_fyr: bool):
+    from scipy.optimize import minimize
+
+    mlnlike, nharm = _wx2pl_lnlike(model, component, ignore_fyr=ignore_fyr)
+    result = minimize(mlnlike, [4.0, -13.0], method="Nelder-Mead")
+    if not result.success:
+        raise ValueError("Log-likelihood maximization failed to converge")
+    gamma, log10_A = result.x
+    try:
+        cov = np.linalg.pinv(_hessian2(mlnlike, result.x))
+        gamma_err, log10_A_err = np.sqrt(np.maximum(np.diag(cov), 0.0))
+    except np.linalg.LinAlgError:
+        gamma_err = log10_A_err = 0.0
+
+    out = copy.deepcopy(model)
+    out.remove_component(component)
+    out.add_component(noise_cls())
+    getattr(out, amp_par).value = float(log10_A)
+    getattr(out, amp_par).uncertainty = float(log10_A_err)
+    getattr(out, gam_par).value = float(gamma)
+    getattr(out, gam_par).uncertainty = float(gamma_err)
+    getattr(out, c_par).value = nharm
+    out.setup()
+    log.info(f"{component} -> {noise_cls.__name__}: log10_A = "
+             f"{log10_A:.3f} +/- {log10_A_err:.3f}, gamma = {gamma:.3f} "
+             f"+/- {gamma_err:.3f} ({nharm} harmonics)")
+    return out
+
+
+def plrednoise_from_wavex(model, ignore_fyr: bool = True):
+    """WaveX red noise -> PLRedNoise spectral parameters (reference
+    ``utils.py:3216``)."""
+    from pint_tpu.models.noise_model import PLRedNoise
+
+    return _pl_from_wavex(model, "WaveX", PLRedNoise, "TNREDAMP", "TNREDGAM",
+                          "TNREDC", ignore_fyr)
+
+
+def pldmnoise_from_dmwavex(model, ignore_fyr: bool = False):
+    """DMWaveX -> PLDMNoise (reference ``utils.py:3264``)."""
+    from pint_tpu.models.noise_model import PLDMNoise
+
+    return _pl_from_wavex(model, "DMWaveX", PLDMNoise, "TNDMAMP",
+                          "TNDMGAM", "TNDMC", ignore_fyr)
+
+
+def find_optimal_nharms(model, toas, component: str = "WaveX",
+                        nharms_max: int = 45) -> Tuple[int, np.ndarray]:
+    """Optimal WaveX harmonic count by AIC over successive fits (reference
+    ``utils.py:3370``)."""
+    from pint_tpu.fitter import Fitter
+    from pint_tpu.utils import akaike_information_criterion
+
+    if component in model.components:
+        raise ValueError(f"{component} already present")
+    T_span = float(np.max(toas.get_mjds()) - np.min(toas.get_mjds()))
+    aics = []
+    for n in range(nharms_max + 1):
+        m = copy.deepcopy(model)
+        if n:
+            (wavex_setup if component == "WaveX" else dmwavex_setup)(
+                m, T_span, n_freqs=n, freeze_params=False)
+        f = Fitter.auto(toas, m, downhill=False)
+        f.fit_toas(maxiter=5)
+        k = len(m.free_params)
+        lnlike = -0.5 * f.resids.calc_chi2()
+        aics.append(akaike_information_criterion(lnlike, k))
+    aics = np.asarray(aics)
+    if not np.all(np.isfinite(aics)):
+        raise ValueError("Infs/NaNs found in AICs")
+    return int(np.argmin(aics)), aics - aics.min()

@@ -152,7 +152,9 @@ class TopoObs(Observatory):
         at the observatory, which the geocentric series omits (the reference
         gets it from ERFA dtdb's (u, v) observer terms,
         ``observatory/__init__.py:443``)."""
-        c_km_s = 299792.458
+        from pint_tpu import c as _C_M_S
+
+        c_km_s = _C_M_S / 1e3
         tdb64 = utc64 + 69.184 / 86400.0  # minute-level epoch is plenty
         _, evel = ephem_mod.load_ephemeris(ephem or "DE440").posvel_ssb(
             "earth", tdb64)  # km/s
@@ -170,8 +172,9 @@ class TopoObs(Observatory):
         from pint_tpu.timescales import utc_to_tdb_offset_seconds
 
         utc64 = np.atleast_1d(np.asarray(utc_mjd, dtype=np.float64))
-        return (utc_to_tdb_offset_seconds(utc_mjd, ephem=ephem)
-                + self._topocentric_tdb_seconds(utc64, ephem=ephem))
+        out = (utc_to_tdb_offset_seconds(utc_mjd, ephem=ephem)
+               + self._topocentric_tdb_seconds(utc64, ephem=ephem))
+        return np.asarray(out).reshape(np.shape(utc_mjd))
 
 
 class GeocenterObs(Observatory):

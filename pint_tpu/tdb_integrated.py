@@ -30,7 +30,9 @@ from pint_tpu.logging import log
 
 __all__ = ["IntegratedTDB", "integrated_tdb_minus_tt"]
 
-C_KM_S = 299792.458
+from pint_tpu import c as _C_M_S
+
+C_KM_S = _C_M_S / 1e3
 DAY_S = 86400.0
 #: GM [km^3/s^2] (IAU/DE nominal values); Earth excluded (external potential)
 GM = {
@@ -82,10 +84,7 @@ class IntegratedTDB:
         eph = load_ephemeris(self.ephem or "DE440")
         # never sample outside a kernel's coverage: the padding is a
         # convenience, not worth losing the kernel path at the span edges
-        cov = getattr(eph, "coverage_mjd", None)
-        if cov is not None:
-            clo, chi = cov()
-            lo, hi = max(lo, clo + self.STEP), min(hi, chi - self.STEP)
+        lo, hi = self._clamp(lo, hi)
         grid = np.arange(lo, hi + self.STEP, self.STEP)
         rate = _rate(eph, grid)
         P = np.zeros(len(grid))
@@ -113,13 +112,41 @@ class IntegratedTDB:
                  f"({len(grid)} samples, ephem={self.ephem or 'DE440'})")
 
     def __call__(self, tt_mjd) -> np.ndarray:
+        from pint_tpu.exceptions import EphemCoverageError
+
         tt = np.atleast_1d(np.asarray(tt_mjd, dtype=np.float64))
         lo, hi = float(tt.min()) - self.PAD, float(tt.max()) + self.PAD
         if self._range is None:
             self._build(lo, hi)
         elif lo < self._range[0] or hi > self._range[1]:
-            self._build(min(lo, self._range[0]), max(hi, self._range[1]))
+            # skip the rebuild when the built window is already pinned at the
+            # kernel's coverage edge (rebuilding would re-integrate the whole
+            # grid on every call and change nothing)
+            want_lo = min(lo, self._range[0])
+            want_hi = max(hi, self._range[1])
+            if (want_lo, want_hi) != self._clamp(want_lo, want_hi):
+                want_lo, want_hi = self._clamp(want_lo, want_hi)
+            if (want_lo, want_hi) != self._range:
+                self._build(want_lo, want_hi)
+        # never silently cubic-extrapolate beyond the integration grid: the
+        # requested epochs are outside the kernel's coverage
+        if tt.min() < self._range[0] or tt.max() > self._range[1]:
+            bad = tt[(tt < self._range[0]) | (tt > self._range[1])]
+            raise EphemCoverageError(
+                f"TDB-TT integration window MJD {self._range[0]:.1f}.."
+                f"{self._range[1]:.1f} (kernel coverage) does not include "
+                f"MJD {bad.min():.1f}..{bad.max():.1f}")
         return np.asarray(self._spline(tt)).reshape(np.shape(tt_mjd))
+
+    def _clamp(self, lo: float, hi: float) -> Tuple[float, float]:
+        from pint_tpu.ephemeris import load_ephemeris
+
+        eph = load_ephemeris(self.ephem or "DE440")
+        cov = getattr(eph, "coverage_mjd", None)
+        if cov is None:
+            return lo, hi
+        clo, chi = cov()
+        return max(lo, clo + self.STEP), min(hi, chi - self.STEP)
 
 
 _integrators: Dict[str, IntegratedTDB] = {}

@@ -137,6 +137,8 @@ def tdb_minus_tt_series(tt_mjd) -> np.ndarray:
     return out.reshape(tt_mjd.shape)
 
 
+from pint_tpu.exceptions import EphemCoverageError as _EphemCoverageError
+
 _tdb_provider = None  # explicit user override via set_tdb_provider
 _warned_tdb_fallback = False
 
@@ -164,7 +166,8 @@ def tdb_minus_tt(tt_mjd, ephem: "str | None" = None) -> np.ndarray:
         from pint_tpu.tdb_integrated import integrated_tdb_minus_tt
 
         return integrated_tdb_minus_tt(tt_mjd, ephem=ephem)
-    except (FileNotFoundError, ImportError, KeyError, ValueError) as e:
+    except (FileNotFoundError, ImportError, KeyError,
+            _EphemCoverageError) as e:
         # expected degradations only (missing kernel/scipy, epochs outside
         # kernel coverage); programming errors must surface, not silently
         # downgrade precision by 4 orders of magnitude

@@ -27,6 +27,11 @@ def _kernel_available() -> bool:
                if os.path.isdir(d))
 
 
+analytic_only = pytest.mark.skipif(
+    _kernel_available(),
+    reason="asserts calibrated to the analytic-ephemeris error budget; a "
+    "real kernel changes the residual scale entirely")
+
 needs_kernel = pytest.mark.skipif(
     not _kernel_available(),
     reason="no JPL .bsp kernel on the ephemeris search path; analytic "
@@ -46,18 +51,48 @@ class TestRealDataSmoke:
     """Full pipeline on real NANOGrav data (no kernel needed): parse,
     evaluate, design matrix — structure and finiteness, not absolute ns."""
 
+    @analytic_only
     def test_load_and_residuals(self, b1855):
         from pint_tpu.residuals import Residuals
 
         model, toas = b1855
         assert len(toas) > 600  # dfg+12 dataset: 702 TOAs
         r = Residuals(toas, model)
-        res = r.time_resids
+        res = np.asarray(r.time_resids)
         assert np.all(np.isfinite(res))
-        # bounded by the pulse period (phase wraps to +/- P/2, then mean
-        # subtraction can shift the window by up to P/2 again)
+        # with the analytic ephemeris the error budget is dominated by
+        # ~1 arcsec of Earth position = up to ~2.4 ms of Roemer delay; a
+        # *badly* wrong ephemeris (or a broken delay chain) blows well past
+        # this, and a correct one cannot sit below the real data scatter
+        assert 1e-6 < np.sqrt(np.mean(res**2)) < 2.5e-3
         P = 1.0 / float(model.F0.value)
         assert np.max(np.abs(res)) <= P
+
+    @analytic_only
+    def test_fit_reduces_chi2(self, b1855):
+        """A WLS fit on the real data must substantially reduce chi2 and
+        converge to a stationary point (catches broken design matrices that
+        finiteness checks miss)."""
+        import copy
+
+        from pint_tpu.fitter import WLSFitter
+
+        model, toas = b1855
+        m = copy.deepcopy(model)
+        # the ~2 ms analytic-ephemeris systematics alias into the binary and
+        # parallax parameters (SINI walks past 1); freeze them and fit the
+        # spin/astrometry/DM subspace, which is what this smoke test pins
+        for p in m.free_params:
+            if p not in ("F0", "F1", "RAJ", "DECJ", "ELONG", "ELAT", "DM"):
+                getattr(m, p).frozen = True
+        f = WLSFitter(toas, m)
+        chi2_pre = f.resids_init.calc_chi2()
+        chi2_post = f.fit_toas(maxiter=4)
+        assert np.isfinite(chi2_post)
+        assert chi2_post < 0.9 * chi2_pre
+        # another iteration changes chi2 only marginally (stationarity)
+        chi2_again = f.fit_toas(maxiter=1)
+        assert abs(chi2_again - chi2_post) < 0.05 * chi2_post
 
     def test_designmatrix_scales(self, b1855):
         model, toas = b1855
@@ -65,6 +100,13 @@ class TestRealDataSmoke:
         assert M.shape[0] == len(toas)
         assert M.shape[1] == len(names)
         assert np.all(np.isfinite(M))
+        # no degenerate (zero) columns and a usable normalized condition
+        from pint_tpu.utils import normalize_designmatrix
+
+        Mn, norms = normalize_designmatrix(M, names)
+        assert np.all(np.asarray(norms)[1:] > 0)  # [0] is the Offset column
+        s = np.linalg.svd(np.asarray(Mn), compute_uv=False)
+        assert s[-1] > 1e-12 * s[0]
 
     def test_binary_component_present(self, b1855):
         model, _ = b1855

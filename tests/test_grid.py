@@ -149,10 +149,11 @@ def gls_fit():
         "UNITS TDB\n",
     ]
     model = get_model(par)
-    # clustered epochs so ECORR's quantization basis is non-trivial
+    # clustered epochs within the 1 s ECORR quantization threshold so the
+    # quantization basis is non-trivial (25 epochs x 2 TOAs)
     rng = np.random.default_rng(3)
     base = np.linspace(55000, 56000, 25)
-    mjds = np.sort(np.concatenate([base, base + 20 / 1440.0]))
+    mjds = np.sort(np.concatenate([base, base + 0.5 / 86400.0]))
     from pint_tpu.simulation import make_fake_toas_fromMJDs
 
     toas = make_fake_toas_fromMJDs(mjds, model, error_us=1.0, add_noise=True,

@@ -1,0 +1,98 @@
+"""WaveX <-> power-law noise conversions (reference ``utils.py:1449,3216,3370``)."""
+
+import numpy as np
+import pytest
+
+BASE_PAR = ["PSR NC\n", "RAJ 01:00:00 1\n", "DECJ 10:00:00 1\n",
+            "F0 100.0 1\n", "F1 -1e-14 1\n", "PEPOCH 55000\n", "DM 10\n",
+            "UNITS TDB\n"]
+
+
+def _model():
+    from pint_tpu.models import get_model
+
+    return get_model(BASE_PAR)
+
+
+class TestWavexSetup:
+    def test_n_freqs(self):
+        from pint_tpu.noise_convert import wavex_setup
+
+        m = _model()
+        idx = wavex_setup(m, 1000.0, n_freqs=5)
+        assert idx == [1, 2, 3, 4, 5]
+        assert "WaveX" in m.components
+        fs = [float(getattr(m, f"WXFREQ_{i:04d}").value) for i in idx]
+        assert np.allclose(fs, np.arange(1, 6) / 1000.0)
+
+    def test_explicit_freqs_and_errors(self):
+        from pint_tpu.noise_convert import wavex_setup
+
+        m = _model()
+        idx = wavex_setup(m, 1000.0, freqs=[0.003, 0.001])
+        fs = [float(getattr(m, f"WXFREQ_{i:04d}").value) for i in idx]
+        assert fs == [0.001, 0.003]  # sorted
+        with pytest.raises(ValueError):
+            wavex_setup(_model(), 1000.0)
+        with pytest.raises(ValueError):
+            wavex_setup(_model(), 1000.0, freqs=[0.1], n_freqs=3)
+
+
+class TestPLFromWavex:
+    def test_exact_recovery(self):
+        """Amplitudes placed exactly at the power-law sigma must recover the
+        spectral parameters (the ML estimator is exact there)."""
+        from pint_tpu.models.noise_model import powerlaw
+        from pint_tpu.noise_convert import plrednoise_from_wavex, wavex_setup
+
+        m = _model()
+        idx = wavex_setup(m, 1000.0, n_freqs=12)
+        A, g = 10**-13.2, 3.7
+        fs = np.array([float(getattr(m, f"WXFREQ_{i:04d}").value)
+                       for i in idx]) / 86400.0
+        sig = np.sqrt(powerlaw(fs, A, g) * fs.min())
+        for i, s in zip(idx, sig):
+            getattr(m, f"WXSIN_{i:04d}").value = float(s)
+            getattr(m, f"WXCOS_{i:04d}").value = float(s)
+        m2 = plrednoise_from_wavex(m, ignore_fyr=False)
+        assert "WaveX" not in m2.components
+        assert "PLRedNoise" in m2.components
+        assert float(m2.TNREDAMP.value) == pytest.approx(-13.2, abs=1e-3)
+        assert float(m2.TNREDGAM.value) == pytest.approx(3.7, abs=1e-3)
+        assert int(m2.TNREDC.value) == 12
+
+    def test_dmwavex_roundtrip(self):
+        from pint_tpu import DMconst
+        from pint_tpu.models.noise_model import powerlaw
+        from pint_tpu.noise_convert import (dmwavex_setup,
+                                            pldmnoise_from_dmwavex)
+
+        m = _model()
+        idx = dmwavex_setup(m, 1200.0, n_freqs=8)
+        A, g = 10**-13.8, 2.5
+        fs = np.array([float(getattr(m, f"DMWXFREQ_{i:04d}").value)
+                       for i in idx]) / 86400.0
+        sig = np.sqrt(powerlaw(fs, A, g) * fs.min()) / (DMconst / 1400.0**2)
+        for i, s in zip(idx, sig):
+            getattr(m, f"DMWXSIN_{i:04d}").value = float(s)
+            getattr(m, f"DMWXCOS_{i:04d}").value = float(s)
+        m2 = pldmnoise_from_dmwavex(m, ignore_fyr=False)
+        assert "PLDMNoise" in m2.components
+        assert float(m2.TNDMAMP.value) == pytest.approx(-13.8, abs=1e-3)
+        assert float(m2.TNDMGAM.value) == pytest.approx(2.5, abs=1e-3)
+
+
+class TestOptimalNharms:
+    def test_flat_data_prefers_zero(self):
+        """White-noise-only data: AIC must pick 0 harmonics."""
+        from pint_tpu.noise_convert import find_optimal_nharms
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = _model()
+        t = make_fake_toas_uniform(54500, 55500, 40, m, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(3))
+        n, aics = find_optimal_nharms(m, t, nharms_max=3)
+        assert n == 0
+        assert aics[0] == 0.0
+        assert len(aics) == 4

@@ -110,6 +110,37 @@ class TestKernelTDB:
                - np.asarray(utc_to_tt_mjd(mjds), np.longdouble)) * 86400.0
         assert np.allclose(np.asarray(got, np.float64), want, atol=1e-8)
 
+    def test_tt_minus_tdb_convention_autodetected(self, tmp_path):
+        """A kernel storing TT-TDB (the opposite convention) must come out
+        sign-corrected: the annual-term correlation against the analytic
+        series disambiguates (kernels agree with the series at ~10 us)."""
+        from numpy.polynomial import chebyshev as C
+
+        from pint_tpu.ephemeris import SPKEphemeris
+        from pint_tpu.timescales import tdb_minus_tt_series
+
+        init = (54000.0 - J2000) * DAY_S
+        intlen = 32.0 * DAY_S
+        n_rec, ncoef = 40, 13
+        recs = np.zeros((n_rec, 2 + ncoef))
+        for i in range(n_rec):
+            mid = init + (i + 0.5) * intlen
+            recs[i, 0], recs[i, 1] = mid, intlen / 2.0
+            xs = np.cos(np.pi * (np.arange(2 * ncoef) + 0.5) / (2 * ncoef))
+            # store MINUS the true TDB-TT (i.e. TT-TDB); use the real series
+            # so the annual phase matches physical kernels
+            recs[i, 2:] = C.chebfit(
+                xs, -tdb_minus_tt_series(
+                    (mid + intlen / 2.0 * xs) / DAY_S + J2000), ncoef - 1)
+        path = str(tmp_path / "flip.bsp")
+        _write_spk(path, [dict(target=1000000001, center=1000000000, dtype=2,
+                               init=init, intlen=intlen, records=recs)])
+        eph = SPKEphemeris(path)
+        tt = 54100.0 + np.linspace(0, 800, 50)
+        got = eph.tdb_minus_tt(tt)
+        want = tdb_minus_tt_series(tt)
+        assert np.allclose(got, want, atol=1e-7)  # sign came out corrected
+
     def test_explicit_provider_wins(self, t_kernel, monkeypatch):
         import pint_tpu.ephemeris as em
         from pint_tpu.timescales import set_tdb_provider, tdb_minus_tt
