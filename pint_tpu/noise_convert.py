@@ -32,7 +32,6 @@ def _wavex_family_setup(model, component_cls, prefixes, units, T_span_d,
     if freqs is None:
         freqs = [(k + 1) / float(T_span_d) for k in range(int(n_freqs))]
     freqs = sorted(float(f) for f in freqs)
-    nyquist = None if n_freqs is not None else None
     comp = component_cls()
     fpre, spre, cpre = prefixes
     for i, f in enumerate(freqs, start=1):

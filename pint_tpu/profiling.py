@@ -1,0 +1,88 @@
+"""Per-stage timing + device trace capture (SURVEY §5 aux subsystem).
+
+The reference has no in-library tracer; its ``profiling/`` harness runs
+benchmark scripts under cProfile and prints a per-function table
+(``profiling/high_level_benchmark.py:22-60``).  The TPU-native equivalent
+here is (a) a lightweight stage timer whose table the bench prints, and
+(b) a hook into the JAX profiler for full device traces viewable in
+TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Optional, Tuple
+
+__all__ = ["StageTimer", "device_trace", "profile_fit"]
+
+
+class StageTimer:
+    """Accumulates named wall-time stages; prints an aligned table."""
+
+    def __init__(self):
+        self.rows: List[Tuple[str, float]] = []
+        self._t = time.time()
+
+    def mark(self, name: str) -> float:
+        """Close the current stage under *name*; returns its duration."""
+        now = time.time()
+        dt = now - self._t
+        self.rows.append((name, dt))
+        self._t = now
+        return dt
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.rows.append((name, time.time() - t0))
+            self._t = time.time()
+
+    @property
+    def total(self) -> float:
+        return sum(dt for _, dt in self.rows)
+
+    def table(self, title: str = "stage timings") -> str:
+        lines = [f"--- {title} ---"]
+        tot = self.total or 1.0
+        for name, dt in self.rows:
+            lines.append(f"  {name:<32s} {dt:9.3f} s  {100 * dt / tot:5.1f}%")
+        lines.append(f"  {'TOTAL':<32s} {self.total:9.3f} s")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture a JAX device trace (XLA ops, HBM, fusion) under *logdir*;
+    inspect with TensorBoard's profile plugin or Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_fit(fitter, maxiter: int = 2, trace_dir: Optional[str] = None):
+    """Time the canonical fit phases (the reference harness' named stages:
+    designmatrix / update resids / solve; ``profiling/README.txt:46-54``).
+
+    Returns (chi2, StageTimer).  With ``trace_dir`` the whole fit also runs
+    under the JAX profiler.
+    """
+    st = StageTimer()
+    ctx = device_trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    with ctx:
+        with st.stage("validate"):
+            fitter.model.validate()
+        with st.stage("designmatrix (incl. compile)"):
+            fitter.get_designmatrix()
+        with st.stage("update resids"):
+            fitter.update_resids()
+        with st.stage(f"fit_toas(maxiter={maxiter})"):
+            chi2 = fitter.fit_toas(maxiter=maxiter)
+    return chi2, st

@@ -85,6 +85,12 @@ class IntegratedTDB:
         # never sample outside a kernel's coverage: the padding is a
         # convenience, not worth losing the kernel path at the span edges
         lo, hi = self._clamp(lo, hi)
+        if hi - lo < 2 * self.STEP:
+            from pint_tpu.exceptions import EphemCoverageError
+
+            raise EphemCoverageError(
+                f"requested TDB-TT window lies outside the kernel coverage "
+                f"of {self.ephem or 'DE440'}")
         grid = np.arange(lo, hi + self.STEP, self.STEP)
         rate = _rate(eph, grid)
         P = np.zeros(len(grid))
@@ -124,8 +130,7 @@ class IntegratedTDB:
             # grid on every call and change nothing)
             want_lo = min(lo, self._range[0])
             want_hi = max(hi, self._range[1])
-            if (want_lo, want_hi) != self._clamp(want_lo, want_hi):
-                want_lo, want_hi = self._clamp(want_lo, want_hi)
+            want_lo, want_hi = self._clamp(want_lo, want_hi)
             if (want_lo, want_hi) != self._range:
                 self._build(want_lo, want_hi)
         # never silently cubic-extrapolate beyond the integration grid: the

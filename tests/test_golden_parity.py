@@ -113,6 +113,42 @@ class TestRealDataSmoke:
         assert model.BINARY.value is not None
 
 
+#: (par, tim) pairs covering different model families: FB90 binaries
+#: (B1953), GLS + ELL1 (J0023), GLS + ELL1H (J0613) — same smoke contract
+MORE_PULSARS = [
+    ("B1953+29_NANOGrav_dfg+12_TAI_FB90.par", "B1953+29_NANOGrav_dfg+12.tim"),
+    ("J0023+0923_NANOGrav_11yv0.gls.par", "J0023+0923_NANOGrav_11yv0.tim"),
+    ("J0613-0200_NANOGrav_9yv1.gls.par", "J0613-0200_NANOGrav_9yv1.tim"),
+]
+
+
+class TestMorePulsarsSmoke:
+    """The full pipeline contract across model families on real NANOGrav
+    data: parse, evaluate, residual bounds, finite design matrix."""
+
+    @pytest.mark.parametrize("par,tim", MORE_PULSARS,
+                             ids=[p.split("_")[0] for p, _ in MORE_PULSARS])
+    def test_pipeline_smoke(self, par, tim):
+        from pint_tpu.models import get_model_and_toas
+        from pint_tpu.residuals import Residuals
+
+        parf, timf = f"{DATADIR}/{par}", f"{DATADIR}/{tim}"
+        if not os.path.exists(timf):
+            pytest.skip("datafile unavailable")
+        model, toas = get_model_and_toas(parf, timf)
+        assert len(toas) > 100
+        res = np.asarray(Residuals(toas, model).time_resids)
+        assert np.all(np.isfinite(res))
+        P = 1.0 / float(model.F0.value)
+        assert np.max(np.abs(res)) <= P
+        if not _kernel_available():
+            # analytic-ephemeris error budget (see TestRealDataSmoke)
+            assert np.sqrt(np.mean(res**2)) < 2.5e-3
+        M, names, units = model.designmatrix(toas)
+        assert np.all(np.isfinite(M))
+        assert M.shape == (len(toas), len(names))
+
+
 class TestGoldenParity:
     @needs_kernel
     def test_b1855_tempo2_residuals(self, b1855):

@@ -1,0 +1,44 @@
+"""Profiling harness (SURVEY §5: the reference's per-function timing table,
+``profiling/high_level_benchmark.py``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+
+
+class TestStageTimer:
+    def test_table_and_stages(self):
+        import time
+
+        from pint_tpu.profiling import StageTimer
+
+        st = StageTimer()
+        with st.stage("alpha"):
+            time.sleep(0.01)
+        st.mark("beta")
+        out = st.table("unit")
+        assert "alpha" in out and "beta" in out and "TOTAL" in out
+        assert st.total >= 0.01
+        assert len(st.rows) == 2
+
+    def test_profile_fit(self):
+        if not os.path.exists(NGC_PAR):
+            pytest.skip("reference data unavailable")
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models import get_model
+        from pint_tpu.profiling import profile_fit
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(NGC_PAR)
+        t = make_fake_toas_uniform(53400, 54200, 30, m, error_us=5.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(1))
+        f = WLSFitter(t, m)
+        chi2, st = profile_fit(f, maxiter=2)
+        assert np.isfinite(chi2)
+        names = [n for n, _ in st.rows]
+        assert any("designmatrix" in n for n in names)
+        assert any("fit_toas" in n for n in names)
