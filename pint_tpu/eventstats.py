@@ -11,8 +11,10 @@ from __future__ import annotations
 import numpy as np
 from scipy.stats import chi2, norm
 
-__all__ = ["z2m", "sf_z2m", "hm", "hmw", "sf_hm", "h2sig", "sig2sigma",
-           "sigma2sig", "sf_stackedh"]
+__all__ = ["z2m", "z2mw", "sf_z2m", "cosm", "best_m", "em_four", "em_lc",
+           "hm", "hmw", "sf_hm", "sf_h20_dj1989", "sf_h20_dj2010",
+           "sig2h20", "sigma_trials", "h2sig", "sig2sigma", "sigma2sig",
+           "sf_stackedh"]
 
 TWOPI = 2 * np.pi
 
@@ -89,3 +91,82 @@ def sf_stackedh(k: int, h: float, l: float = 0.398405) -> float:
     c = l * h
     p = sum(c**i / math.factorial(i) for i in range(k))
     return float(p * np.exp(-c)) if c < 700 else 0.0
+
+
+def z2mw(phases, weights, m: int = 2):
+    """Weighted Z^2_m (CLT-calibrated when weights are well distributed;
+    reference ``eventstats.py:157``)."""
+    ph = np.asarray(phases) * TWOPI
+    w = np.asarray(weights, dtype=np.float64)
+    ks = np.arange(1, m + 1)[:, None]
+    s = (np.cos(ks * ph) * w).sum(axis=1) ** 2 \
+        + (np.sin(ks * ph) * w).sum(axis=1) ** 2
+    return np.cumsum(s) * (2.0 / np.sum(w * w))
+
+
+def cosm(phases, m: int = 2):
+    """Cosine test per harmonic (de Jager et al. 1994; reference
+    ``eventstats.py:176``)."""
+    ph = np.asarray(phases) * TWOPI
+    ks = np.arange(1, m + 1)[:, None]
+    return (2.0 / len(ph)) * np.cumsum(np.cos(ks * ph).sum(axis=1))
+
+
+def best_m(phases, weights=None, m: int = 100) -> int:
+    """Harmonic count maximizing the H statistic's penalized Z^2
+    (reference ``eventstats.py:204``)."""
+    w = np.ones(len(phases)) if weights is None else np.asarray(weights)
+    z = z2mw(phases, w, m=m)
+    return int(np.arange(1, m + 1)[np.argmax(z - 4 * np.arange(0, m))])
+
+
+def em_four(phases, m: int = 2, weights=None):
+    """Empirical Fourier coefficients (a_k, b_k) up to harmonic m
+    (reference ``eventstats.py:209``)."""
+    ph = np.asarray(phases) * TWOPI
+    n = len(ph) if weights is None else np.sum(weights)
+    w = 1.0 if weights is None else np.asarray(weights)
+    ks = np.arange(1, m + 1)[:, None]
+    aks = (w * np.cos(ks * ph)).sum(axis=-1) / n
+    bks = (w * np.sin(ks * ph)).sum(axis=-1) / n
+    return aks, bks
+
+
+def em_lc(coeffs, dom):
+    """Evaluate the light curve from empirical Fourier coefficients at
+    phases in [0, 1) (reference ``eventstats.py:228``)."""
+    dom = np.asarray(dom) * TWOPI
+    aks, bks = coeffs
+    out = np.ones_like(dom)
+    for i in range(1, len(aks) + 1):
+        out = out + 2 * (aks[i - 1] * np.cos(i * dom)
+                         + bks[i - 1] * np.sin(i * dom))
+    return out
+
+
+def sf_h20_dj1989(h: float) -> float:
+    """H-test chance probability, de Jager et al. 1989 calibration
+    (reference ``eventstats.py:319``; kept for parity — the quadratic term
+    is known to be approximate)."""
+    if h <= 23:
+        return 0.9999755 * np.exp(-0.39802 * h)
+    return 4e-8 if h > 50 else 1.210597 * np.exp(-0.45901 * h + 0.00229 * h**2)
+
+
+def sf_h20_dj2010(h: float) -> float:
+    """H-test chance probability, de Jager & Busching 2010 asymptotic."""
+    return float(np.exp(-0.4 * h))
+
+
+def sig2h20(sig: float) -> float:
+    """Invert the 2010 calibration: H for a given chance probability."""
+    return float(-np.log(sig) / 0.4)
+
+
+def sigma_trials(sigma: float, trials: float) -> float:
+    """Correct a significance for a trials factor (reference
+    ``eventstats.py:125``)."""
+    if sigma >= 20:
+        return float((sigma**2 - 2 * np.log(trials)) ** 0.5)
+    p = sigma2sig(sigma) * trials
+    return 0.0 if p >= 1 else sig2sigma(p)

@@ -20,7 +20,8 @@ from pint_tpu.models.noise_model import FYR, powerlaw
 from pint_tpu.models.parameter import prefixParameter
 
 __all__ = ["wavex_setup", "dmwavex_setup", "plrednoise_from_wavex",
-           "pldmnoise_from_dmwavex", "find_optimal_nharms"]
+           "pldmnoise_from_dmwavex", "find_optimal_nharms",
+           "translate_wave_to_wavex", "translate_wavex_to_wave"]
 
 DAY_S = 86400.0
 
@@ -178,6 +179,68 @@ def pldmnoise_from_dmwavex(model, ignore_fyr: bool = False):
 
     return _pl_from_wavex(model, "DMWaveX", PLDMNoise, "TNDMAMP",
                           "TNDMGAM", "TNDMC", ignore_fyr)
+
+
+def translate_wave_to_wavex(model):
+    """Wave (phase sinusoids at harmonics of WAVE_OM) -> the equivalent
+    WaveX delay representation (reference ``utils.py:1782``):
+    ``WXFREQ_000k = WAVE_OM (k+1) / 2 pi`` [1/d], amplitudes negated (a
+    positive phase term is a negative delay term)."""
+    new = copy.deepcopy(model)
+    wave = new.components["Wave"]
+    n = wave.num_wave_terms
+    om = float(wave.WAVE_OM.value)  # rad/d
+    epoch = wave.WAVEEPOCH.value
+    amps = [tuple(getattr(wave, f"WAVE{i}").value)
+            if getattr(wave, f"WAVE{i}").value is not None else (0.0, 0.0)
+            for i in range(1, n + 1)]
+    new.remove_component("Wave")
+    freqs = [om * (k + 1) / (2 * np.pi) for k in range(n)]
+    idx = wavex_setup(new, 1.0, freqs=freqs)
+    new.WXEPOCH.value = epoch
+    for i, (a, b) in zip(idx, amps):
+        getattr(new, f"WXSIN_{i:04d}").value = -float(a)
+        getattr(new, f"WXCOS_{i:04d}").value = -float(b)
+    new.setup()
+    return new
+
+
+def translate_wavex_to_wave(model, rtol: float = 1e-9):
+    """WaveX -> Wave, requiring every WXFREQ to sit on a consistent
+    harmonic grid ``WAVE_OM = 2 pi WXFREQ_000k / (k+1)`` (reference
+    ``utils.py:1945``; raises otherwise)."""
+    from pint_tpu.models.wave import Wave
+    from pint_tpu.models.parameter import pairParameter
+
+    new = copy.deepcopy(model)
+    wx = new.components["WaveX"]
+    idxs = wx.indices
+    freqs = np.array([float(getattr(new, f"WXFREQ_{i:04d}").value)
+                      for i in idxs])
+    order = np.argsort(freqs)
+    freqs = freqs[order]
+    oms = 2 * np.pi * freqs / (np.arange(len(freqs)) + 1)
+    if np.ptp(oms) > rtol * np.abs(oms).max():
+        raise ValueError(
+            "WaveX frequencies are not harmonics of a single WAVE_OM; "
+            "cannot translate to a Wave model")
+    amps = [(-float(getattr(new, f"WXSIN_{idxs[j]:04d}").value),
+             -float(getattr(new, f"WXCOS_{idxs[j]:04d}").value))
+            for j in order]
+    epoch = new.WXEPOCH.value
+    new.remove_component("WaveX")
+    wave = Wave()
+    for k in range(2, len(amps) + 1):
+        wave.add_param(pairParameter(f"WAVE{k}", units="s", continuous=False,
+                                     description="Wave sin/cos amplitudes"))
+    wave.WAVEEPOCH.value = epoch
+    wave.WAVE_OM.value = float(oms.mean())
+    for k, ab in enumerate(amps, start=1):
+        getattr(wave, f"WAVE{k}").value = list(ab)
+    wave.setup()
+    new.add_component(wave)
+    new.setup()
+    return new
 
 
 def find_optimal_nharms(model, toas, component: str = "WaveX",

@@ -200,6 +200,13 @@ class Polycos:
         t = np.atleast_1d(np.asarray(t_mjd, dtype=np.float64))
         return np.array([float(self.find_entry(ti).evalfreq(ti)) for ti in t])
 
+    def eval_spin_freq_derivative(self, t_mjd) -> np.ndarray:
+        """Spin frequency derivative [Hz/s] at each time (reference
+        ``polycos.py:1008``)."""
+        t = np.atleast_1d(np.asarray(t_mjd, dtype=np.float64))
+        return np.array([float(self.find_entry(ti).evalfreqderiv(ti))
+                         for ti in t])
+
     # -- IO ------------------------------------------------------------------
     def write_polyco_file(self, filename: str):
         tempo_polyco_table_writer(self.entries, filename)
@@ -207,6 +214,9 @@ class Polycos:
     @classmethod
     def read_polyco_file(cls, filename: str) -> "Polycos":
         return cls(tempo_polyco_table_reader(filename))
+
+    #: reference-parity alias (``polycos.py:549``)
+    read = read_polyco_file
 
 
 def tempo_polyco_table_writer(entries: List[PolycoEntry], filename: str):

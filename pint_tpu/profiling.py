@@ -22,11 +22,11 @@ class StageTimer:
 
     def __init__(self):
         self.rows: List[Tuple[str, float]] = []
-        self._t = time.time()
+        self._t = time.perf_counter()
 
     def mark(self, name: str) -> float:
         """Close the current stage under *name*; returns its duration."""
-        now = time.time()
+        now = time.perf_counter()
         dt = now - self._t
         self.rows.append((name, dt))
         self._t = now
@@ -34,12 +34,12 @@ class StageTimer:
 
     @contextlib.contextmanager
     def stage(self, name: str):
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.rows.append((name, time.time() - t0))
-            self._t = time.time()
+            self.rows.append((name, time.perf_counter() - t0))
+            self._t = time.perf_counter()
 
     @property
     def total(self) -> float:

@@ -22,6 +22,8 @@ __all__ = [
     "make_fake_toas_uniform",
     "make_fake_toas_fromMJDs",
     "make_fake_toas_fromtim",
+    "get_fake_toa_clock_versions",
+    "update_fake_dms",
     "calculate_random_models",
 ]
 
@@ -47,6 +49,39 @@ def zero_residuals(ts: TOAs, model, maxiter: int = 10,
     else:
         log.warning(f"zero_residuals did not converge below {tolerance_s} s "
                     f"(worst {worst:.3g} s)")
+    return ts
+
+
+def get_fake_toa_clock_versions(model, include_bipm=None,
+                                include_gps=None) -> dict:
+    """Clock-correction settings implied by the model's CLOCK value
+    (reference ``simulation.py`` helper of the same name)."""
+    from pint_tpu.toa import parse_clock_bipm
+
+    bipm_version = "BIPM2021"
+    if include_bipm is None:
+        clk_val = getattr(model, "CLOCK", None) and model.CLOCK.value
+        include_bipm, ver = parse_clock_bipm(clk_val)
+        include_bipm = bool(include_bipm)
+        if ver:
+            bipm_version = ver
+    return {
+        "include_bipm": include_bipm,
+        "bipm_version": bipm_version,
+        "include_gps": True if include_gps is None else include_gps,
+    }
+
+
+def update_fake_dms(model, ts: TOAs, dm_error: float = 1e-4,
+                    add_noise: bool = False, rng=None) -> TOAs:
+    """Set wideband -pp_dm/-pp_dme flags to the model-predicted DM
+    (reference ``simulation.py:126``)."""
+    rng = rng or np.random.default_rng()
+    dm = np.asarray(model.total_dm(ts))
+    dme = np.full(len(ts), float(dm_error))
+    if add_noise:
+        dm = dm + rng.standard_normal(len(ts)) * dme
+    ts.update_dms(dm, dme)
     return ts
 
 

@@ -480,6 +480,19 @@ def _file_hash(path: str) -> str:
     return h.hexdigest()
 
 
+def parse_clock_bipm(clock_value):
+    """(include_bipm, bipm_version|None) implied by a par-file CLOCK value
+    (reference model_builder/toa CLK handling).  include_bipm is None when
+    the CLOCK string decides nothing."""
+    clk = str(clock_value or "").upper()
+    if clk.startswith("TT(BIPM"):
+        ver = clk[3:].rstrip(")")
+        return True, (ver if ver and ver != "BIPM" else None)
+    if clk in ("TT(TAI)", "UTC(NIST)", "TT"):
+        return False, None
+    return None, None
+
+
 def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
              include_gps: bool = True, include_bipm: Optional[bool] = None,
              bipm_version: str = "BIPM2021", model=None, limits: str = "warn",
@@ -490,14 +503,9 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
         if ephem is None and getattr(model, "EPHEM", None) is not None:
             ephem = str(model.EPHEM.value)
         if include_bipm is None and getattr(model, "CLOCK", None) is not None:
-            clk = str(model.CLOCK.value or "")
-            if clk.upper().startswith("TT(BIPM"):
-                include_bipm = True
-                ver = clk.upper()[3:].rstrip(")")
-                if ver and ver != "BIPM":
-                    bipm_version = ver
-            elif clk.upper() in ("TT(TAI)", "UTC(NIST)", "TT"):
-                include_bipm = False
+            include_bipm, ver = parse_clock_bipm(model.CLOCK.value)
+            if ver:
+                bipm_version = ver
         if planets is False and getattr(model, "PLANET_SHAPIRO", None) is not None:
             planets = bool(model.PLANET_SHAPIRO.value)
     if include_bipm is None:

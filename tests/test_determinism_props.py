@@ -120,7 +120,7 @@ class TestClockFileProperties:
     @settings(max_examples=60, deadline=None)
     @given(n=st.integers(min_value=2, max_value=40),
            seed=st.integers(min_value=0, max_value=2**31))
-    def test_interpolation_brackets_extremes(self, n, seed, tmp_path_factory):
+    def test_interpolation_brackets_extremes(self, n, seed):
         """Interpolated clock corrections never leave the sample range."""
         from pint_tpu.observatory.clock_file import ClockFile
 

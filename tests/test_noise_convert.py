@@ -96,3 +96,45 @@ class TestOptimalNharms:
         assert n == 0
         assert aics[0] == 0.0
         assert len(aics) == 4
+
+
+class TestWaveWavexTranslation:
+    WAVE_PAR = BASE_PAR + [
+        "WAVEEPOCH 55000\n", "WAVE_OM 0.00423 1\n",
+        "WAVE1 0.0021 -0.0013\n", "WAVE2 -0.0008 0.0004\n",
+        "WAVE3 0.0003 0.0002\n",
+    ]
+
+    def test_roundtrip_preserves_residuals(self):
+        """Wave -> WaveX -> Wave keeps the model's residuals to sub-ns
+        (the two representations are algebraically equivalent)."""
+        from pint_tpu.models import get_model
+        from pint_tpu.noise_convert import (translate_wave_to_wavex,
+                                            translate_wavex_to_wave)
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(self.WAVE_PAR)
+        t = make_fake_toas_uniform(54500, 55500, 50, m, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(11))
+        r0 = np.asarray(Residuals(t, m).time_resids)
+        mx = translate_wave_to_wavex(m)
+        assert "Wave" not in mx.components and "WaveX" in mx.components
+        rx = np.asarray(Residuals(t, mx).time_resids)
+        assert np.max(np.abs(rx - r0)) < 1e-9
+        mw = translate_wavex_to_wave(mx)
+        assert "WaveX" not in mw.components and "Wave" in mw.components
+        rw = np.asarray(Residuals(t, mw).time_resids)
+        assert np.max(np.abs(rw - r0)) < 1e-9
+        assert float(mw.WAVE_OM.value) == pytest.approx(0.00423, rel=1e-12)
+
+    def test_non_harmonic_wavex_rejected(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.noise_convert import (translate_wavex_to_wave,
+                                            wavex_setup)
+
+        m = get_model(BASE_PAR)
+        wavex_setup(m, 1000.0, freqs=[0.001, 0.0025])  # not harmonics
+        with pytest.raises(ValueError, match="harmonics"):
+            translate_wavex_to_wave(m)

@@ -276,3 +276,56 @@ class TestPlotUtils:
 
         assert os.path.getsize(f1) > 1000
         assert os.path.getsize(f2) > 1000
+
+
+class TestEventstatsExtended:
+    def test_z2mw_reduces_to_z2m(self):
+        from pint_tpu.eventstats import z2m, z2mw
+
+        rng = np.random.default_rng(0)
+        ph = rng.random(300)
+        assert np.allclose(z2mw(ph, np.ones(300), m=4), np.asarray(z2m(ph, m=4)),
+                           rtol=1e-12)
+
+    def test_best_m_finds_injected_harmonics(self):
+        """A single-harmonic signal: the H-test penalty (4 per extra
+        harmonic) must pick m=1 — higher harmonics only add chi2(2) noise."""
+        from pint_tpu.eventstats import best_m
+
+        rng = np.random.default_rng(1)
+        ph = []
+        while len(ph) < 500:
+            x = rng.random()
+            if rng.random() < (1 + 0.5 * np.cos(2 * np.pi * x)) / 1.5:
+                ph.append(x)
+        assert best_m(np.asarray(ph), m=10) == 1
+
+    def test_em_four_lc_roundtrip(self):
+        from pint_tpu.eventstats import em_four, em_lc
+
+        rng = np.random.default_rng(2)
+        ph = []
+        while len(ph) < 5000:
+            x = rng.random()
+            if rng.random() < (1 + 0.9 * np.cos(2 * np.pi * (x - 0.3))) / 1.9:
+                ph.append(x)
+        coeffs = em_four(np.asarray(ph), m=1)
+        grid = np.linspace(0, 1, 50, endpoint=False)
+        lc = em_lc(coeffs, grid)
+        # reconstructed light curve peaks near 0.3 and integrates to ~1
+        assert abs(grid[np.argmax(lc)] - 0.3) < 0.05
+        assert np.mean(lc) == pytest.approx(1.0, abs=1e-12)
+
+    def test_h20_calibrations(self):
+        from pint_tpu.eventstats import sf_h20_dj1989, sf_h20_dj2010, sig2h20
+
+        assert sf_h20_dj2010(20.0) == pytest.approx(np.exp(-8.0))
+        assert sig2h20(np.exp(-8.0)) == pytest.approx(20.0)
+        assert 0 < sf_h20_dj1989(10.0) < 1
+        assert sf_h20_dj1989(60.0) == 4e-8
+
+    def test_sigma_trials_monotonic(self):
+        from pint_tpu.eventstats import sigma_trials
+
+        assert sigma_trials(5.0, 100) < 5.0
+        assert sigma_trials(25.0, 100) < 25.0
