@@ -12,7 +12,10 @@ import numpy as np
 
 from pint_tpu.logging import log
 
-__all__ = ["DMXRange", "dmx_ranges", "dmxparse"]
+__all__ = ["DMXRange", "dmx_ranges", "dmxparse", "xxxselections",
+           "dmxselections", "dmxstats", "get_prefix_timerange",
+           "get_prefix_timeranges", "find_prefix_bytime", "merge_dmx",
+           "split_dmx", "split_swx"]
 
 
 class DMXRange:
@@ -87,6 +90,174 @@ def dmx_ranges(toas, divide_freq: float = 1000.0, binwidth: float = 15.0,
     log.info(f"dmx_ranges: {len(ranges)} bins cover {mask.sum()}/{len(mjds)} "
              f"TOAs")
     return mask, comp
+
+
+def xxxselections(model, toas, prefix: str = "DM") -> Dict[str, np.ndarray]:
+    """Map ``<prefix>X`` range selections (DMX/SWX/CMX) to TOA indices
+    (reference ``utils.py:974``): {param name: indices of TOAs it covers}."""
+    from pint_tpu.toa_select import TOASelect
+
+    if not any(p.startswith(f"{prefix}X") for p in model.params):
+        return {}
+    # SWX amplitudes are SWXDM_ but ranges are SWXR1_/SWXR2_
+    amp_prefix = f"{prefix}XDM_" if prefix == "SW" else f"{prefix}X_"
+    x = model.get_prefix_mapping(amp_prefix)
+    r1 = model.get_prefix_mapping(f"{prefix}XR1_")
+    r2 = model.get_prefix_mapping(f"{prefix}XR2_")
+    condition = {}
+    for ii in x:
+        condition[x[ii]] = (float(getattr(model, r1[ii]).value),
+                            float(getattr(model, r2[ii]).value))
+    selector = TOASelect(is_range=True)
+    mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+    return selector.get_select_index(condition, mjds)
+
+
+def dmxselections(model, toas) -> Dict[str, np.ndarray]:
+    """Map DMX selections to TOA indices (reference ``utils.py:1005``)."""
+    return xxxselections(model, toas, prefix="DM")
+
+
+def dmxstats(model, toas, file=None) -> None:
+    """Print per-DMX-bin statistics (reference ``utils.py:1032``; after
+    tempo's dmxparse by P. Demorest)."""
+    import sys
+
+    file = file or sys.stdout
+    mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+    freqs = np.asarray(toas.freq_mhz, dtype=np.float64)
+    selected = np.zeros(len(mjds), dtype=bool)
+    select_idx = dmxselections(model, toas)
+    for ii in model.get_prefix_mapping("DMX_"):
+        name = f"DMX_{ii:04d}"
+        sel = select_idx.get(name, np.array([], dtype=int))
+        if len(sel):
+            selected[sel] = True
+            print(f"{name}: NTOAS={len(sel):5d}, "
+                  f"MJDSpan={mjds[sel].max() - mjds[sel].min():14.4f} d, "
+                  f"FreqSpan={freqs[sel].min():8.3f}-{freqs[sel].max():8.3f} MHz",
+                  file=file)
+        else:
+            print(f"{name}: NTOAS={0:5d}, MJDSpan={0.0:14.4f} d, "
+                  f"FreqSpan={0.0:8.3f}-{0.0:8.3f} MHz", file=file)
+    if not np.all(selected):
+        print(f"{(~selected).sum()} TOAs not selected in any DMX window",
+              file=file)
+
+
+def _range_base(prefix: str) -> str:
+    """Amplitude prefix -> range-parameter base: ``DMX_`` -> ``DMX``,
+    ``SWXDM_`` -> ``SWX`` (the SWX family names its ranges SWXR1_/SWXR2_)."""
+    base = prefix.rstrip("_")
+    return base[:-2] if base.endswith("XDM") else base
+
+
+def get_prefix_timerange(model, prefixname: str) -> Tuple[float, float]:
+    """(start, end) MJDs for one range parameter like ``DMX_0001``,
+    ``SWXDM_0005``, or ``CMX_0002`` (reference ``utils.py:1216``)."""
+    from pint_tpu.models.parameter import split_prefixed_name
+
+    prefix, _ = split_prefixed_name(prefixname)
+    index = prefixname[len(prefix):]
+    base = _range_base(prefix)
+    r1 = f"{base}R1_{index}"
+    r2 = f"{base}R2_{index}"
+    return float(getattr(model, r1).value), float(getattr(model, r2).value)
+
+
+def get_prefix_timeranges(model, prefixname: str):
+    """(indices, starts, ends) arrays for a whole prefix family like ``DMX``
+    or ``SWX`` (reference ``utils.py:1246``)."""
+    if prefixname.endswith("_"):
+        prefixname = prefixname[:-1]
+    try:
+        mapping = model.get_prefix_mapping(f"{prefixname}_")
+    except ValueError:
+        # SWX amplitudes are named SWXDM_#### while ranges are SWXR1_/R2_
+        mapping = model.get_prefix_mapping(f"{prefixname}DM_")
+    idxs, r1s, r2s = [], [], []
+    for index in mapping:
+        p1 = getattr(model, f"{prefixname}R1_{index:04d}", None)
+        p2 = getattr(model, f"{prefixname}R2_{index:04d}", None)
+        if p1 is not None and p2 is not None \
+                and p1.value is not None and p2.value is not None:
+            idxs.append(index)
+            r1s.append(float(p1.value))
+            r2s.append(float(p2.value))
+    return (np.asarray(idxs, dtype=np.int32), np.asarray(r1s),
+            np.asarray(r2s))
+
+
+def find_prefix_bytime(model, prefixname: str, t):
+    """Indices of the prefix ranges containing MJD ``t`` (reference
+    ``utils.py:1285``); an int when exactly one matches."""
+    t = float(getattr(t, "mjd", t))
+    indices, r1, r2 = get_prefix_timeranges(model, prefixname)
+    matches = np.where((t >= r1) & (t < r2))[0]
+    out = indices[matches]
+    return int(out[0]) if len(out) == 1 else out
+
+
+def merge_dmx(model, index1: int, index2: int, value: str = "mean",
+              frozen: bool = True) -> int:
+    """Merge two DMX bins into one spanning both (reference
+    ``utils.py:1312``).  Returns the new index."""
+    if value.lower() not in ("first", "second", "mean"):
+        raise ValueError(f"Unknown merge value {value!r}")
+    t1a, t1b = get_prefix_timerange(model, f"DMX_{index1:04d}")
+    t2a, t2b = get_prefix_timerange(model, f"DMX_{index2:04d}")
+    tstart, tend = min(t1a, t2a), max(t1b, t2b)
+    intervening = np.atleast_1d(
+        find_prefix_bytime(model, "DMX", (tstart + tend) / 2))
+    for k in np.setdiff1d(intervening, [index1, index2]):
+        log.warning(f"Attempting to merge DMX_{index1:04d} and "
+                    f"DMX_{index2:04d}, but DMX_{k:04d} is in between")
+    v1 = float(getattr(model, f"DMX_{index1:04d}").value or 0.0)
+    v2 = float(getattr(model, f"DMX_{index2:04d}").value or 0.0)
+    dmx = {"first": v1, "second": v2, "mean": (v1 + v2) / 2}[value.lower()]
+    # add before removing so the component always keeps >= 1 bin
+    newindex = model.add_DMX_range(tstart, tend, dmx=dmx, frozen=frozen)
+    model.remove_DMX_range([index1, index2])
+    return newindex
+
+
+def _split_range(model, time_mjd: float, amp_prefix: str, range_prefix: str,
+                 add_method: str, amp_kw: str, extra_kw=None) -> Tuple[int, int]:
+    mapping = model.get_prefix_mapping(amp_prefix)
+    idxs = sorted(mapping)
+    r1 = np.array([float(getattr(model, f"{range_prefix}R1_{i:04d}").value)
+                   for i in idxs])
+    r2 = np.array([float(getattr(model, f"{range_prefix}R2_{i:04d}").value)
+                   for i in idxs])
+    hit = np.where((time_mjd > r1) & (time_mjd < r2))[0]
+    if len(hit) == 0:
+        raise ValueError(f"Time {time_mjd} not in any {range_prefix} bins")
+    index = idxs[hit[0]]
+    old_end = r2[hit[0]]
+    amp = getattr(model, f"{amp_prefix}{index:04d}")
+    getattr(model, f"{range_prefix}R2_{index:04d}").value = time_mjd
+    kw = {amp_kw: float(amp.value or 0.0), "frozen": amp.frozen}
+    if extra_kw:
+        kw.update(extra_kw(model, index))
+    newindex = getattr(model, add_method)(time_mjd, old_end, **kw)
+    return index, newindex
+
+
+def split_dmx(model, time) -> Tuple[int, int]:
+    """Split the DMX bin containing ``time`` (MJD float or Time) in two
+    (reference ``utils.py:1361``).  Returns (old index, new index)."""
+    return _split_range(model, float(getattr(time, "mjd", time)),
+                        "DMX_", "DMX", "add_DMX_range", "dmx")
+
+
+def split_swx(model, time) -> Tuple[int, int]:
+    """Split the SWX bin containing ``time`` in two (reference
+    ``utils.py:1405``); the new bin inherits the split bin's SWXP."""
+    return _split_range(
+        model, float(getattr(time, "mjd", time)),
+        "SWXDM_", "SWX", "add_swx_range", "swxdm",
+        extra_kw=lambda m, i: {
+            "swxp": float(getattr(m, f"SWXP_{i:04d}").value or 2.0)})
 
 
 def dmxparse(fitter, save=False) -> Dict[str, np.ndarray]:

@@ -711,3 +711,24 @@ def objPosVel_wrt_SSB(objname: str, tdb_mjd, ephem: str = "DE440"):
     eph = load_ephemeris(ephem)
     pos, vel = eph.posvel_ssb(objname, tdb_mjd)
     return PosVel(pos, vel, obj=objname, origin="ssb")
+
+
+def sun_ecliptic_longitude_deg(mjd, precision: str = "low"):
+    """Geocentric ecliptic (J2000) longitude of the Sun [deg].
+
+    ``"low"``: the classical mean-Sun expression (~0.01 deg), matching the
+    reference's analytic branch (``utils.py:2668 get_conjunction``).
+    ``"high"``: -Earth heliocentric position from the VSOP87 series.
+    """
+    mjd = np.asarray(mjd, dtype=np.float64)
+    if precision == "low":
+        n = mjd - 51544.5
+        L = 280.460 + 0.9856474 * n
+        g = np.deg2rad(357.528 + 0.9856003 * n)
+        lam = L + 1.915 * np.sin(g) + 0.020 * np.sin(2.0 * g)
+        return np.asarray(lam % 360.0)[()]
+    T = (mjd - 51544.5) / 36525.0
+    pos = AnalyticEphemeris._earth_helio_ecl_j2000(T)
+    # geocentric Sun = -heliocentric Earth
+    lam = np.arctan2(-pos[..., 1], -pos[..., 0])
+    return np.asarray(np.rad2deg(lam) % 360.0)[()]

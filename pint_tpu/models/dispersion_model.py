@@ -137,6 +137,58 @@ class DispersionDMX(Dispersion):
             masks.append(((mjds >= r1) & (mjds <= r2)).astype(np.float64))
         return {"masks": jnp.asarray(np.array(masks)) if masks else None}
 
+    def add_DMX_range(self, mjd_start, mjd_end, index=None, dmx=0.0,
+                      frozen: bool = True) -> int:
+        """Add one DMX bin (reference ``dispersion_model.py add_DMX_range``).
+        Returns the assigned index."""
+        if mjd_end is not None and mjd_start is not None \
+                and float(mjd_end) < float(mjd_start):
+            raise ValueError("Starting MJD is greater than ending MJD.")
+        if index is None:
+            index = max(self.dmx_indices, default=0) + 1
+        index = int(index)
+        if f"DMX_{index:04d}" in self._params_dict:
+            raise ValueError(
+                f"Index '{index}' is already in use in this model. "
+                f"Please choose another.")
+        if self.dmx_indices:
+            # template from ANY surviving bin (bin 1 may have been merged away)
+            i0 = self.dmx_indices[0]
+            self.add_param(self._params_dict[f"DMX_{i0:04d}"].new_param(
+                index, value=float(dmx), frozen=frozen))
+            self.add_param(self._params_dict[f"DMXR1_{i0:04d}"].new_param(
+                index, value=float(mjd_start)))
+            self.add_param(self._params_dict[f"DMXR2_{i0:04d}"].new_param(
+                index, value=float(mjd_end)))
+        else:
+            self.add_param(prefixParameter(
+                f"DMX_{index:04d}", units="pc/cm3", value=float(dmx),
+                frozen=frozen, description="DM offset in range"))
+            self.add_param(prefixParameter(
+                f"DMXR1_{index:04d}", units="MJD", value=float(mjd_start),
+                description="Range start MJD"))
+            self.add_param(prefixParameter(
+                f"DMXR2_{index:04d}", units="MJD", value=float(mjd_end),
+                description="Range end MJD"))
+        self.setup()
+        if self._parent is not None:
+            self._parent.setup()
+        return index
+
+    def remove_DMX_range(self, index) -> None:
+        """Remove one or more DMX bins by index (reference
+        ``dispersion_model.py remove_DMX_range``)."""
+        indices = [index] if isinstance(index, (int, np.integer)) else list(index)
+        for i in indices:
+            i = int(i)
+            if f"DMX_{i:04d}" not in self._params_dict:
+                raise ValueError(f"Index {i} not in DMX model")
+            for pre in ("DMX_", "DMXR1_", "DMXR2_"):
+                self.remove_param(f"{pre}{i:04d}")
+        self.setup()
+        if self._parent is not None:
+            self._parent.setup()
+
     def dmx_dm(self, pv, batch, ctx):
         if ctx.get("masks") is None:
             return jnp.zeros_like(batch.freq)

@@ -203,6 +203,61 @@ class SolarWindDispersionX(_SolarWindBase):
                 if nm not in self._params_dict or self._params_dict[nm].value is None:
                     raise MissingParameter("SolarWindDispersionX", nm)
 
+    def add_swx_range(self, mjd_start, mjd_end, index=None, swxdm=0.0,
+                      swxp=2.0, frozen: bool = True) -> int:
+        """Add one SWX bin (reference ``solar_wind_dispersion.py
+        add_swx_range``).  Returns the assigned index."""
+        if float(mjd_end) < float(mjd_start):
+            raise ValueError("Starting MJD is greater than ending MJD.")
+        if index is None:
+            index = max(self.swx_indices, default=0) + 1
+        index = int(index)
+        if f"SWXDM_{index:04d}" in self._params_dict:
+            raise ValueError(
+                f"Index '{index}' is already in use in this model. "
+                f"Please choose another.")
+        if self.swx_indices:
+            # template from ANY surviving bin (bin 1 may have been removed)
+            i0 = self.swx_indices[0]
+            self.add_param(self._params_dict[f"SWXDM_{i0:04d}"].new_param(
+                index, value=float(swxdm), frozen=frozen))
+            self.add_param(self._params_dict[f"SWXP_{i0:04d}"].new_param(
+                index, value=float(swxp)))
+            self.add_param(self._params_dict[f"SWXR1_{i0:04d}"].new_param(
+                index, value=float(mjd_start)))
+            self.add_param(self._params_dict[f"SWXR2_{i0:04d}"].new_param(
+                index, value=float(mjd_end)))
+        else:
+            self.add_param(prefixParameter(
+                f"SWXDM_{index:04d}", units="pc/cm3", value=float(swxdm),
+                frozen=frozen, description="Max solar-wind DM in range"))
+            self.add_param(prefixParameter(
+                f"SWXP_{index:04d}", units="", value=float(swxp),
+                description="Radial power-law index in range"))
+            self.add_param(prefixParameter(
+                f"SWXR1_{index:04d}", units="MJD", value=float(mjd_start),
+                description="Range start MJD"))
+            self.add_param(prefixParameter(
+                f"SWXR2_{index:04d}", units="MJD", value=float(mjd_end),
+                description="Range end MJD"))
+        self.setup()
+        if self._parent is not None:
+            self._parent.setup()
+        return index
+
+    def remove_swx_range(self, index) -> None:
+        """Remove one or more SWX bins by index."""
+        indices = [index] if isinstance(index, (int, np.integer)) else list(index)
+        for i in indices:
+            i = int(i)
+            if f"SWXDM_{i:04d}" not in self._params_dict:
+                raise ValueError(f"Index {i} not in SWX model")
+            for pre in ("SWXDM_", "SWXP_", "SWXR1_", "SWXR2_"):
+                self.remove_param(f"{pre}{i:04d}")
+        self.setup()
+        if self._parent is not None:
+            self._parent.setup()
+
     def build_context(self, toas):
         mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
         masks = []

@@ -145,6 +145,15 @@ class Component:
                 return name
         return None
 
+    def get_prefix_mapping_component(self, prefix: str) -> Dict[int, str]:
+        """{index: parameter name} for every ``PREFIX<idx>`` parameter on this
+        component (reference ``timing_model.py get_prefix_mapping_component``)."""
+        out = {}
+        for name in self.params:
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                out[int(name[len(prefix):])] = name
+        return dict(sorted(out.items()))
+
     # -- host-side evaluation context ---------------------------------------
     def build_context(self, toas) -> dict:
         """Precompute static per-TOAs data (masks, selections) for the trace."""
@@ -281,6 +290,17 @@ class TimingModel:
         for comp in d.get("components", {}).values():
             if name in comp._params_dict:
                 return comp._params_dict[name]
+        # forward component *methods* (add_DMX_range, add_swx_range, ...) the
+        # way the reference TimingModel does (reference ``timing_model.py``
+        # __getattr__ component delegation) — but only methods a subclass
+        # introduces; base-class machinery (add_param, build_context, ...)
+        # must not silently bind to an arbitrary component
+        for comp in d.get("components", {}).values():
+            if callable(getattr(type(comp), name, None)) \
+                    and getattr(Component, name, None) is None \
+                    and getattr(DelayComponent, name, None) is None \
+                    and getattr(PhaseComponent, name, None) is None:
+                return getattr(comp, name)
         raise AttributeError(f"TimingModel has no parameter or attribute {name!r}")
 
     def __getitem__(self, name) -> Parameter:
@@ -341,6 +361,17 @@ class TimingModel:
             out.append(p.value if p.value is not None else 0.0)
             i += 1
         return out
+
+    def get_prefix_mapping(self, prefix: str) -> Dict[int, str]:
+        """{index: name} over all components for ``PREFIX<idx>`` parameters
+        (reference ``timing_model.py get_prefix_mapping``); raises ValueError
+        when no component carries the prefix."""
+        out: Dict[int, str] = {}
+        for comp in self.components.values():
+            out.update(comp.get_prefix_mapping_component(prefix))
+        if not out:
+            raise ValueError(f"Cannot find prefix {prefix!r} in the model")
+        return dict(sorted(out.items()))
 
     def match_param_aliases(self, key: str) -> str:
         for p in self.top_level_params:

@@ -19,9 +19,11 @@ from pint_tpu.logging import log
 from pint_tpu.models.noise_model import FYR, powerlaw
 from pint_tpu.models.parameter import prefixParameter
 
-__all__ = ["wavex_setup", "dmwavex_setup", "plrednoise_from_wavex",
-           "pldmnoise_from_dmwavex", "find_optimal_nharms",
-           "translate_wave_to_wavex", "translate_wavex_to_wave"]
+__all__ = ["wavex_setup", "dmwavex_setup", "cmwavex_setup",
+           "plrednoise_from_wavex", "pldmnoise_from_dmwavex",
+           "plchromnoise_from_cmwavex", "find_optimal_nharms",
+           "translate_wave_to_wavex", "translate_wavex_to_wave",
+           "get_wavex_freqs", "get_wavex_amps"]
 
 DAY_S = 86400.0
 
@@ -76,6 +78,57 @@ def dmwavex_setup(model, T_span_d: float, freqs=None, n_freqs=None,
                                freeze_params)
 
 
+def cmwavex_setup(model, T_span_d: float, freqs=None, n_freqs=None,
+                  freeze_params: bool = False) -> List[int]:
+    """Attach a CMWaveX chromatic-noise Fourier component (reference
+    ``utils.py:1637``)."""
+    from pint_tpu.models.wavex import CMWaveX
+
+    return _wavex_family_setup(model, CMWaveX,
+                               ("CMWXFREQ_", "CMWXSIN_", "CMWXCOS_"),
+                               "pc/cm3", T_span_d, freqs, n_freqs,
+                               freeze_params)
+
+
+def get_wavex_freqs(model, index=None, quantity: bool = False):
+    """WXFREQ_ parameters (or their float values with ``quantity=True``)
+    for the given index/indices, or all (reference ``utils.py:1829``)."""
+    comp = model.components["WaveX"]
+    if index is None:
+        idxs = sorted(comp.get_prefix_mapping_component("WXFREQ_"))
+    elif isinstance(index, (int, float, np.integer)):
+        idxs = [int(index)]
+    elif isinstance(index, (list, set, tuple, np.ndarray)):
+        idxs = [int(i) for i in index]
+    else:
+        raise TypeError(f"index must be int, float, iterable, or None - "
+                        f"not {type(index)}")
+    values = [getattr(comp, f"WXFREQ_{i:04d}") for i in idxs]
+    if quantity:
+        values = [float(v.value) for v in values]
+    return values
+
+
+def get_wavex_amps(model, index=None, quantity: bool = False):
+    """(WXSIN_, WXCOS_) parameter pairs (or float-value pairs) for the given
+    index/indices, or all (reference ``utils.py:1879``)."""
+    comp = model.components["WaveX"]
+    if index is None:
+        idxs = sorted(comp.get_prefix_mapping_component("WXSIN_"))
+    elif isinstance(index, (int, float, np.integer)):
+        idxs = [int(index)]
+    elif isinstance(index, (list, set, tuple, np.ndarray)):
+        idxs = [int(i) for i in index]
+    else:
+        raise TypeError(f"index must be int, float, iterable, or None - "
+                        f"not {type(index)}")
+    values = [(getattr(comp, f"WXSIN_{i:04d}"),
+               getattr(comp, f"WXCOS_{i:04d}")) for i in idxs]
+    if quantity:
+        values = [(float(s.value), float(c.value)) for s, c in values]
+    return values
+
+
 def _wx2pl_lnlike(model, component: str, ignore_fyr: bool = True):
     """Negative log-likelihood of the WaveX amplitudes under a power-law
     spectrum (reference ``utils.py:3140 _get_wx2pl_lnlike``)."""
@@ -96,6 +149,14 @@ def _wx2pl_lnlike(model, component: str, ignore_fyr: bool = True):
         from pint_tpu import DMconst
 
         scale = DMconst / 1400.0**2
+    elif component == "CMWaveX":
+        from pint_tpu import DMconst
+
+        # chromatic amplitudes scale with the (model-wide) chromatic index;
+        # default 4 when no ChromaticCM component carries TNCHROMIDX
+        idx_val = (model.TNCHROMIDX.value
+                   if "TNCHROMIDX" in model else None)
+        scale = DMconst / 1400.0**float(idx_val if idx_val is not None else 4.0)
     else:
         scale = 1.0
 
@@ -181,6 +242,14 @@ def pldmnoise_from_dmwavex(model, ignore_fyr: bool = False):
                           "TNDMGAM", "TNDMC", ignore_fyr)
 
 
+def plchromnoise_from_cmwavex(model, ignore_fyr: bool = False):
+    """CMWaveX -> PLChromNoise (reference ``utils.py:3317``)."""
+    from pint_tpu.models.noise_model import PLChromNoise
+
+    return _pl_from_wavex(model, "CMWaveX", PLChromNoise, "TNCHROMAMP",
+                          "TNCHROMGAM", "TNCHROMC", ignore_fyr)
+
+
 def translate_wave_to_wavex(model):
     """Wave (phase sinusoids at harmonics of WAVE_OM) -> the equivalent
     WaveX delay representation (reference ``utils.py:1782``):
@@ -257,8 +326,9 @@ def find_optimal_nharms(model, toas, component: str = "WaveX",
     for n in range(nharms_max + 1):
         m = copy.deepcopy(model)
         if n:
-            (wavex_setup if component == "WaveX" else dmwavex_setup)(
-                m, T_span, n_freqs=n, freeze_params=False)
+            setup_fn = {"WaveX": wavex_setup, "DMWaveX": dmwavex_setup,
+                        "CMWaveX": cmwavex_setup}[component]
+            setup_fn(m, T_span, n_freqs=n, freeze_params=False)
         f = Fitter.auto(toas, m, downhill=False)
         f.fit_toas(maxiter=5)
         k = len(m.free_params)

@@ -23,3 +23,9 @@ def main(argv: Optional[list] = None):
     m2 = get_model(args.parfile2, allow_tcb=True)
     print(m1.compare(m2, verbosity=args.verbosity))
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

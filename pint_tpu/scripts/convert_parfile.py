@@ -41,3 +41,9 @@ def main(argv: Optional[list] = None):
     else:
         print(text, end="")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -125,3 +125,9 @@ def main(argv: Optional[list] = None):
     print(f"Post-fit model written to {outpar}")
     np.save(f"{args.outbase}_chain.npy", f.sampler.get_chain())
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

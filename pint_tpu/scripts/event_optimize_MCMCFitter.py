@@ -48,3 +48,9 @@ def main(argv: Optional[list] = None):
     f.model.write_parfile(f"{args.outbase}.par")
     print(f"Post-fit model written to {args.outbase}.par")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -37,3 +37,9 @@ def main(argv: Optional[list] = None):
         print(f"=== dataset {i}: {ev} ===")
         results.append(event_optimize.main(sub))
     return max(results) if results else 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

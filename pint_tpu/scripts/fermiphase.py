@@ -55,3 +55,9 @@ def main(argv: Optional[list] = None):
         phaseogram(mjds, phases, weights=weights,
                    plotfile=args.plotfile or "fermiphase.png")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -60,3 +60,9 @@ def main(argv: Optional[list] = None):
         mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
         phaseogram(mjds, phases, plotfile=args.plotfile or "photonphase.png")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

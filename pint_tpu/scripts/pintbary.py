@@ -43,3 +43,9 @@ def main(argv: Optional[list] = None):
     bat = tdb - np.longdouble(delay) / np.longdouble(86400.0)
     print(f"{float(bat):.15f}")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

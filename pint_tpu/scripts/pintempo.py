@@ -42,3 +42,9 @@ def main(argv: Optional[list] = None):
         plot_residuals_time(toas, f.resids.time_resids,
                             plotfile=args.plotfile or "pintempo.png")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -35,3 +35,9 @@ def main(argv: Optional[list] = None):
         ap.error(f"GUI unavailable ({e}); use --test for the headless path")
     launch_gui(psr)
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -32,3 +32,9 @@ def main(argv: Optional[list] = None):
     else:
         print(tex, end="")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -25,3 +25,9 @@ def main(argv: Optional[list] = None):
     print(f"Converted par file written to {args.output} "
           f"(BINARY {model.BINARY.value})")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -22,3 +22,9 @@ def main(argv: Optional[list] = None):
     model.write_parfile(args.output)
     print(f"TDB par file written to {args.output}")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -45,3 +45,9 @@ def main(argv: Optional[list] = None):
     ts.write_TOA_file(args.timfile)
     print(f"Wrote {len(ts)} simulated TOAs to {args.timfile}")
     return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -62,7 +62,10 @@ def get_fake_toa_clock_versions(model, include_bipm=None,
     if include_bipm is None:
         clk_val = getattr(model, "CLOCK", None) and model.CLOCK.value
         include_bipm, ver = parse_clock_bipm(clk_val)
-        include_bipm = bool(include_bipm)
+        if include_bipm is None:
+            # undecided (no/unrecognized CLOCK) defaults True, matching
+            # get_TOAs (toa.py) so simulated and real TOAs agree
+            include_bipm = True
         if ver:
             bipm_version = ver
     return {

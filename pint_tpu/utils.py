@@ -27,7 +27,61 @@ __all__ = [
     "FTest",
     "akaike_information_criterion",
     "bayesian_information_criterion",
+    # host-side helpers (reference utils.py surface)
+    "open_or_use",
+    "lines_of",
+    "interesting_lines",
+    "colorize",
+    "print_color_examples",
+    "group_iterator",
+    "compute_hash",
+    "has_astropy_unit",
+    "split_prefixed_name",
+    "pmtot",
+    "ELL1_check",
+    "numeric_partial",
+    "numeric_partials",
+    "check_all_partials",
+    "parse_time",
+    "get_unit",
+    "list_parameters",
+    "info_string",
+    "get_conjunction",
+    "divide_times",
+    "convert_dispersion_measure",
+    "check_longdouble_precision",
+    "require_longdouble_precision",
 ]
+
+# names served lazily from sibling modules so ``pint_tpu.utils`` carries the
+# reference's full utils surface without import cycles (PEP 562)
+_LAZY = {
+    "dmx_ranges": "pint_tpu.dmx", "dmxparse": "pint_tpu.dmx",
+    "dmxstats": "pint_tpu.dmx", "dmxselections": "pint_tpu.dmx",
+    "xxxselections": "pint_tpu.dmx", "get_prefix_timerange": "pint_tpu.dmx",
+    "get_prefix_timeranges": "pint_tpu.dmx",
+    "find_prefix_bytime": "pint_tpu.dmx", "merge_dmx": "pint_tpu.dmx",
+    "split_dmx": "pint_tpu.dmx", "split_swx": "pint_tpu.dmx",
+    "wavex_setup": "pint_tpu.noise_convert",
+    "dmwavex_setup": "pint_tpu.noise_convert",
+    "cmwavex_setup": "pint_tpu.noise_convert",
+    "get_wavex_freqs": "pint_tpu.noise_convert",
+    "get_wavex_amps": "pint_tpu.noise_convert",
+    "translate_wave_to_wavex": "pint_tpu.noise_convert",
+    "translate_wavex_to_wave": "pint_tpu.noise_convert",
+    "plrednoise_from_wavex": "pint_tpu.noise_convert",
+    "pldmnoise_from_dmwavex": "pint_tpu.noise_convert",
+    "plchromnoise_from_cmwavex": "pint_tpu.noise_convert",
+    "find_optimal_nharms": "pint_tpu.noise_convert",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def taylor_horner(x, coeffs: Sequence):
@@ -178,3 +232,387 @@ def akaike_information_criterion(lnlike: float, k: int) -> float:
 def bayesian_information_criterion(lnlike: float, k: int, n: int) -> float:
     """BIC = k ln n - 2 ln L."""
     return k * math.log(n) - 2.0 * lnlike
+
+
+# ---------------------------------------------------------------------------
+# host-side helpers (reference utils.py long tail)
+# ---------------------------------------------------------------------------
+
+import contextlib
+import hashlib
+from pathlib import Path
+
+DAY_PER_YEAR = 365.25
+
+COLOR_NAMES = ["black", "red", "green", "yellow", "blue", "magenta", "cyan",
+               "white"]
+TEXT_ATTRIBUTES = ["normal", "bold", "subdued", "italic", "underscore",
+                   "blink", "reverse", "concealed"]
+
+
+@contextlib.contextmanager
+def open_or_use(f, mode: str = "r"):
+    """Open a path, or pass a file-like object straight through (reference
+    ``utils.py:487``)."""
+    if isinstance(f, (str, bytes, Path)):
+        with open(f, mode) as fh:
+            yield fh
+    else:
+        yield f
+
+
+def lines_of(f):
+    """Iterate over lines of a path or open file (reference ``utils.py:502``)."""
+    with open_or_use(f) as fh:
+        yield from fh
+
+
+def interesting_lines(lines, comments=None):
+    """Iterate over stripped non-blank lines, skipping comment prefixes
+    (reference ``utils.py:515``)."""
+    if comments is None:
+        cs = []
+    elif isinstance(comments, (str, bytes)):
+        cs = [comments]
+    else:
+        cs = list(comments)
+    for c in cs:
+        if c.strip() != c or not c:
+            raise ValueError(
+                f"Unable to deal with comment string {c!r}: must be "
+                "non-empty with no leading/trailing whitespace")
+    for line in lines:
+        ln = line.strip()
+        if not ln:
+            continue
+        if any(ln.startswith(c) for c in cs):
+            continue
+        yield ln
+
+
+def colorize(text: str, fg_color=None, bg_color=None, attribute=None) -> str:
+    """ANSI-colorize a string for terminal output (reference
+    ``utils.py:2569``)."""
+    fg = dict(zip(COLOR_NAMES, range(30, 38))).get(fg_color, 39)
+    bg = dict(zip(COLOR_NAMES, range(40, 48))).get(bg_color, 49)
+    att = dict(zip(TEXT_ATTRIBUTES, [0, 1, 2, 3, 4, 5, 7, 8])).get(attribute, 0)
+    return f"\033[{att}m\033[{bg};{fg}m{text}\033[0m"
+
+
+def print_color_examples() -> None:
+    """Print a table of every color/attribute combination (reference
+    ``utils.py:2610``)."""
+    for att in TEXT_ATTRIBUTES:
+        for fg in COLOR_NAMES:
+            for bg in COLOR_NAMES:
+                print(colorize(f"{fg:>8} {att:<11}", fg, bg_color=bg,
+                               attribute=att), end="")
+            print("")
+
+
+def group_iterator(items):
+    """Yield (value, indices) for each distinct value in *items* (reference
+    ``utils.py:2622``)."""
+    items = np.asarray(items)
+    for item in np.unique(items):
+        yield item, np.where(items == item)[0]
+
+
+def compute_hash(filename) -> bytes:
+    """SHA-256 digest of a file's contents, for change detection (reference
+    ``utils.py:2639``; used by the TOA pickle cache)."""
+    h = hashlib.sha256()
+    with open_or_use(filename, "rb") as f:
+        while block := f.read(128 * h.block_size):
+            h.update(block)
+    return h.digest()
+
+
+def has_astropy_unit(x) -> bool:
+    """True when *x* carries an astropy unit (reference ``utils.py:345``).
+    Our core is unit-light (floats in documented canonical units), so this
+    is primarily for interop with astropy-carrying user code."""
+    return hasattr(x, "unit") or hasattr(x, "to_value")
+
+
+def split_prefixed_name(name: str):
+    """Split a prefixed parameter name; re-exported from
+    :mod:`pint_tpu.models.parameter` (reference ``utils.py:364``).  Note the
+    return is ``(prefix, index_int)``."""
+    from pint_tpu.models.parameter import split_prefixed_name as _spn
+
+    return _spn(name)
+
+
+def pmtot(model) -> float:
+    """Total proper motion [mas/yr] from the model's astrometry component
+    (reference ``utils.py:545``).  PMRA/PMELONG already include the
+    cos(latitude) factor by pulsar-timing convention, so this is a plain
+    quadrature sum."""
+    comps = model.components
+    if "AstrometryEcliptic" in comps:
+        return float(np.hypot(model.PMELONG.value or 0.0,
+                              model.PMELAT.value or 0.0))
+    if "AstrometryEquatorial" in comps:
+        return float(np.hypot(model.PMRA.value or 0.0,
+                              model.PMDEC.value or 0.0))
+    raise AttributeError("No Astrometry component found")
+
+
+def ELL1_check(A1_ls: float, E: float, TRES_us: float, NTOA: int,
+               outstring: bool = True):
+    """Check the ELL1 small-eccentricity approximation's validity:
+    asini/c * ecc^4 << TRES / sqrt(NTOA) (reference ``utils.py:2054``).
+
+    ``A1_ls`` in light-seconds, ``TRES_us`` in microseconds.
+    """
+    lhs_us = float(A1_ls) * float(E) ** 4 * 1e6
+    rhs_us = float(TRES_us) / math.sqrt(NTOA)
+    if outstring:
+        s = (
+            "Checking applicability of ELL1 model -- \n"
+            "    Condition is asini/c * ecc**4 << timing precision / "
+            "sqrt(# TOAs) to use ELL1\n"
+            f"    asini/c * ecc**4    = {lhs_us:.3g} us\n"
+            f"    TRES / sqrt(# TOAs) = {rhs_us:.3g} us\n"
+        )
+    if lhs_us * 50.0 < rhs_us:
+        return s + "    Should be fine.\n" if outstring else True
+    if lhs_us * 5.0 < rhs_us:
+        return s + "    Should be OK, but not optimal.\n" if outstring else True
+    return (s + "    *** WARNING*** Should probably use BT or DD instead!\n"
+            if outstring else False)
+
+
+def numeric_partial(f, args, ix: int = 0, delta: float = 1e-6) -> float:
+    """Central-difference partial derivative of ``f(*args)`` w.r.t. argument
+    *ix* (reference ``utils.py:283``)."""
+    args = list(args)
+    args[ix] = args[ix] + delta / 2.0
+    hi = f(*args)
+    args[ix] = args[ix] - delta
+    lo = f(*args)
+    return (hi - lo) / delta
+
+
+def numeric_partials(f, args, delta: float = 1e-6) -> np.ndarray:
+    """Matrix of numeric partials of ``f(*args)`` (reference ``utils.py:303``)."""
+    r = [numeric_partial(f, args, i, delta) for i in range(len(args))]
+    return np.array(r).T
+
+
+def check_all_partials(f, args, delta: float = 1e-6, atol: float = 1e-4,
+                       rtol: float = 1e-4) -> None:
+    """Assert that ``f(*args) = (value, jacobian)`` returns a jacobian
+    matching numeric differencing (reference ``utils.py:316``)."""
+    _, jac = f(*args)
+    jac = np.asarray(jac)
+    njac = numeric_partials(lambda *a: f(*a)[0], args, delta)
+    d = np.abs(jac - njac) / (atol + rtol * np.abs(njac))
+    if not np.all(d < 1):
+        (worst_i, worst_j) = np.unravel_index(np.argmax(d), d.shape)
+        raise ValueError(
+            f"Mismatch between analytic and numeric partials: worst is "
+            f"d[{worst_i},{worst_j}] = {d[worst_i, worst_j]} "
+            f"(analytic {jac[worst_i, worst_j]}, numeric "
+            f"{njac[worst_i, worst_j]})")
+
+
+def parse_time(value, scale: str = "tdb"):
+    """Parse a float / int / str / array / Time-like object into MJD float(s)
+    (reference ``utils.py:2812``; the reference returns an astropy ``Time``,
+    but this package's time convention is MJD floats — astropy ``Time``
+    inputs are accepted via their ``.mjd``, converted to *scale* first when
+    they expose it)."""
+    if hasattr(value, "mjd"):  # astropy Time (when available) or Time-like
+        v = getattr(value, scale, value)
+        return np.asarray(getattr(v, "mjd"), dtype=np.float64)[()]
+    if isinstance(value, str):
+        return float(value)
+    if isinstance(value, (int, float, np.floating, np.integer)):
+        return float(value)
+    if isinstance(value, (np.ndarray, list, tuple)):
+        return np.asarray(value, dtype=np.float64)
+    if has_astropy_unit(value):
+        return np.asarray(value.to_value("d") if hasattr(value, "to_value")
+                          else value, dtype=np.float64)[()]
+    raise TypeError(f"Do not know how to parse times from {type(value)}")
+
+
+def _param_metadata():
+    """{NAME/ALIAS (upper): (units, description)} over every registered
+    component plus the TimingModel top-level parameters (cached)."""
+    cache = getattr(_param_metadata, "_cache", None)
+    if cache is not None:
+        return cache
+    import pint_tpu.models  # ensures the component registry is populated
+    from pint_tpu.models.timing_model import Component, TimingModel
+
+    mapping = {}
+
+    def add(p):
+        mapping.setdefault(p.name.upper(), (p.units, p.description))
+        for a in p.aliases:
+            mapping.setdefault(a.upper(), (p.units, p.description))
+
+    for p in TimingModel()._top_params_dict.values():
+        add(p)
+    for cls in Component.component_types.values():
+        comp = cls()
+        for pname in comp.params:
+            add(comp._params_dict[pname])
+    _param_metadata._cache = mapping
+    return mapping
+
+
+def get_unit(parname: str) -> str:
+    """Unit string for a parameter name or alias, including indexed
+    prefix/mask parameters beyond any instantiated model (reference
+    ``utils.py:2846``)."""
+    mapping = _param_metadata()
+    key = parname.upper()
+    if key in mapping:
+        return mapping[key][0]
+    from pint_tpu.models.parameter import split_prefixed_name as _spn
+
+    prefix, _ = _spn(key)
+    for cand in (f"{prefix}0001", f"{prefix}1", f"{prefix}0", prefix,
+                 prefix.rstrip("_")):
+        if cand in mapping:
+            return mapping[cand][0]
+    raise KeyError(f"Unknown parameter {parname!r}")
+
+
+def list_parameters(class_=None):
+    """List metadata dicts for every known parameter, or those of one
+    component class (reference ``utils.py:2490``)."""
+    if class_ is not None:
+        comp = class_()
+        out = []
+        for pname in comp.params:
+            p = comp._params_dict[pname]
+            out.append({"name": p.name, "aliases": list(p.aliases),
+                        "description": p.description, "units": p.units,
+                        "class": class_.__name__})
+        return out
+    import pint_tpu.models
+    from pint_tpu.models.timing_model import Component
+
+    seen = {}
+    for cls in Component.component_types.values():
+        for row in list_parameters(cls):
+            seen.setdefault(row["name"], row)
+    return sorted(seen.values(), key=lambda r: r["name"])
+
+
+def info_string(prefix_string: str = "# ", comment=None) -> str:
+    """Provenance block (version, run platform, date) for output files
+    (reference ``utils.py:2306``)."""
+    import datetime
+    import getpass
+    import platform
+
+    import pint_tpu
+
+    s = (
+        f"Created: {datetime.datetime.now().isoformat()}\n"
+        f"PINT_TPU_version: {pint_tpu.__version__}\n"
+    )
+    try:
+        s += f"User: {getpass.getuser()}\n"
+    except Exception:  # pragma: no cover - no passwd entry in some images
+        pass
+    s += (f"Host: {platform.node()}\n"
+          f"OS: {platform.platform()}\n"
+          f"Python: {platform.python_version()}\n")
+    if comment is not None:
+        s += "Comment:\n" + "\n".join(
+            f"    {ln}" for ln in str(comment).splitlines()) + "\n"
+    if prefix_string:
+        s = "\n".join(prefix_string + ln for ln in s.splitlines()) + "\n"
+    return s
+
+
+def get_conjunction(elong_deg: float, t0_mjd: float,
+                    precision: str = "low"):
+    """First solar conjunction (Sun's ecliptic longitude = pulsar's) after
+    ``t0_mjd`` (reference ``utils.py:2668``).
+
+    Takes the pulsar's ecliptic longitude in degrees; returns (mjd,
+    elongation_deg at conjunction).  ``precision="low"`` uses the analytic
+    mean-Sun longitude; ``"high"`` refines with the package ephemeris's
+    Earth position (reference interpolates astropy ``get_sun``).
+    """
+    from pint_tpu.ephemeris import sun_ecliptic_longitude_deg
+
+    elong_deg = float(elong_deg) % 360.0
+
+    def delta(mjd):
+        return (sun_ecliptic_longitude_deg(mjd, precision) - elong_deg + 180.0) \
+            % 360.0 - 180.0
+
+    # bracket the zero crossing with daily steps, then bisect
+    lo = float(t0_mjd)
+    d_lo = delta(lo)
+    hi = lo
+    for _ in range(400):
+        hi += 1.0
+        d_hi = delta(hi)
+        if d_lo < 0 <= d_hi and d_hi - d_lo < 180.0:
+            break
+        d_lo, lo = d_hi, hi
+    else:
+        raise ValueError("No conjunction found within 400 days")
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        if delta(mid) < 0:
+            lo = mid
+        else:
+            hi = mid
+    t = 0.5 * (lo + hi)
+    return t, abs(delta(t))
+
+
+def divide_times(t_mjd, t0_mjd: float, offset: float = 0.5) -> np.ndarray:
+    """Group times into year-long intervals around ``t0`` (reference
+    ``utils.py:2742``); returns the interval index of each time."""
+    t_mjd = np.asarray(t_mjd, dtype=np.float64)
+    values = (t_mjd - float(t0_mjd)) / DAY_PER_YEAR + offset
+    values = np.floor(values)
+    return np.digitize(values, np.unique(values), right=True)
+
+
+def convert_dispersion_measure(dm: float, dmconst=None) -> float:
+    """Re-scale a DM [pc/cm^3] quoted with the conventional constant
+    1/2.41e-4 MHz^2 pc^-1 cm^3 s to the CODATA-exact constant (reference
+    ``utils.py:2779``)."""
+    import pint_tpu
+
+    if dmconst is None:
+        e = 1.602176634e-19       # C (exact, SI-2019)
+        eps0 = 8.8541878128e-12   # F/m (CODATA 2018)
+        me = 9.1093837015e-31     # kg (CODATA 2018)
+        c_si = 299792458.0        # m/s (exact)
+        pc_m = 3.0856775814913673e16  # m
+        k_si = e**2 / (8 * math.pi**2 * c_si * eps0 * me)
+        # DM in pc/cm^3 = pc_m/1e-6 m^-2; frequencies in MHz -> Hz^2 = 1e12
+        dmconst = k_si * (pc_m * 1e6) / 1e12  # s MHz^2 cm^3 / pc
+    return float(dm) * pint_tpu.DMconst / dmconst
+
+
+def check_longdouble_precision() -> bool:
+    """True when numpy longdouble is genuinely extended-precision
+    (reference ``utils.py:160``).  Informational only here: the package
+    carries (hi, lo) double-double pairs end-to-end and does not depend on
+    x87 longdouble."""
+    return np.finfo(np.longdouble).eps < 1e-18
+
+
+def require_longdouble_precision() -> None:
+    """Reference ``utils.py:169`` raises on degraded longdouble platforms;
+    the dd pipeline makes that unnecessary, so this only logs."""
+    if not check_longdouble_precision():
+        from pint_tpu.logging import log
+
+        log.info("numpy longdouble is degraded on this platform; "
+                 "pint_tpu uses (hi,lo) double-double pairs instead")

@@ -1,0 +1,402 @@
+"""Long-tail ``pint_tpu.utils`` surface: the reference ``utils.py`` helpers
+beyond the math core (reference ``src/pint/utils.py`` throughout)."""
+
+import io
+import math
+
+import numpy as np
+import pytest
+
+
+def _simple_model(extra=()):
+    from pint_tpu.models import get_model
+
+    par = ["PSR UTILTEST\n", "RAJ 05:00:00\n", "DECJ 15:00:00\n",
+           "PMRA 3.0\n", "PMDEC -4.0\n", "POSEPOCH 55000\n",
+           "F0 100.0 1\n", "PEPOCH 55000\n", "DM 10\n", "UNITS TDB\n"]
+    return get_model(par + list(extra))
+
+
+def _dmx_model_and_toas(nbins=3):
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ["PSR DMXTEST\n", "RAJ 02:00:00\n", "DECJ 20:00:00\n",
+           "F0 150.0 1\n", "PEPOCH 55200\n", "DM 15\n", "UNITS TDB\n"]
+    for i in range(1, nbins + 1):
+        lo = 55000 + 100 * (i - 1)
+        par += [f"DMX_{i:04d} 0.00{i} 1\n",
+                f"DMXR1_{i:04d} {lo}\n", f"DMXR2_{i:04d} {lo + 50}\n"]
+    m = get_model(par)
+    mjds = np.sort(np.concatenate(
+        [np.linspace(55000 + 100 * k + 5, 55000 + 100 * k + 45, 4)
+         for k in range(nbins)]))
+    freqs = np.resize([430.0, 1410.0], len(mjds))
+    t = make_fake_toas_fromMJDs(mjds, m, freq=freqs, error_us=1.0)
+    return m, t, mjds
+
+
+class TestIOHelpers:
+    def test_open_or_use_path_and_file(self, tmp_path):
+        from pint_tpu.utils import open_or_use
+
+        p = tmp_path / "x.txt"
+        p.write_text("hello\n")
+        with open_or_use(p) as f:
+            assert f.read() == "hello\n"
+        with open_or_use(io.StringIO("inline")) as f:
+            assert f.read() == "inline"
+
+    def test_lines_and_interesting_lines(self, tmp_path):
+        from pint_tpu.utils import interesting_lines, lines_of
+
+        p = tmp_path / "y.txt"
+        p.write_text("# comment\n\n  data 1 \nC another\ndata 2\n")
+        got = list(interesting_lines(lines_of(p), comments="#"))
+        assert got == ["data 1", "C another", "data 2"]
+        got = list(interesting_lines(lines_of(p), comments=("#", "C")))
+        assert got == ["data 1", "data 2"]
+
+    def test_interesting_lines_rejects_padded_comment(self):
+        from pint_tpu.utils import interesting_lines
+
+        with pytest.raises(ValueError):
+            list(interesting_lines(["a"], comments=" #"))
+
+    def test_compute_hash(self, tmp_path):
+        from pint_tpu.utils import compute_hash
+
+        p1 = tmp_path / "a.bin"
+        p2 = tmp_path / "b.bin"
+        p1.write_bytes(b"12345")
+        p2.write_bytes(b"12345")
+        assert compute_hash(p1) == compute_hash(p2)
+        p2.write_bytes(b"12346")
+        assert compute_hash(p1) != compute_hash(p2)
+
+
+class TestTextHelpers:
+    def test_colorize_wraps_ansi(self):
+        from pint_tpu.utils import colorize
+
+        s = colorize("hi", "red", bg_color="white", attribute="bold")
+        assert s.startswith("\033[1m") and s.endswith("\033[0m") and "hi" in s
+
+    def test_group_iterator(self):
+        from pint_tpu.utils import group_iterator
+
+        items = np.array(["gbt", "ao", "gbt", "gbt"])
+        groups = {k: list(v) for k, v in group_iterator(items)}
+        assert groups == {"ao": [1], "gbt": [0, 2, 3]}
+
+    def test_info_string(self):
+        from pint_tpu.utils import info_string
+
+        s = info_string(prefix_string="# ", comment="two\nlines")
+        assert all(ln.startswith("# ") for ln in s.splitlines())
+        assert "PINT_TPU_version" in s and "lines" in s
+        assert not info_string(prefix_string="").startswith("#")
+
+
+class TestModelHelpers:
+    def test_pmtot_equatorial(self):
+        from pint_tpu.utils import pmtot
+
+        m = _simple_model()
+        assert pmtot(m) == pytest.approx(5.0)
+
+    def test_pmtot_requires_astrometry(self):
+        from pint_tpu.models.spindown import Spindown
+        from pint_tpu.models.timing_model import TimingModel
+        from pint_tpu.utils import pmtot
+
+        with pytest.raises(AttributeError):
+            pmtot(TimingModel("X", [Spindown()]))
+
+    def test_ell1_check_boundaries(self):
+        from pint_tpu.utils import ELL1_check
+
+        # tiny asini*e^4 -> fine
+        assert ELL1_check(1.0, 1e-3, 1.0, 100, outstring=False) is True
+        assert "fine" in ELL1_check(1.0, 1e-3, 1.0, 100)
+        # huge eccentricity -> not OK
+        assert ELL1_check(10.0, 0.5, 0.1, 10000, outstring=False) is False
+        assert "WARNING" in ELL1_check(10.0, 0.5, 0.1, 10000)
+
+    def test_get_unit_direct_alias_and_indexed(self):
+        from pint_tpu.utils import get_unit
+
+        assert get_unit("F0") == "Hz"
+        assert get_unit("DM") == "pc/cm3"
+        # indexed beyond any instantiated component
+        assert get_unit("DMX_0027") == "pc/cm3"
+        assert get_unit("F2") == get_unit("F1")
+        with pytest.raises(Exception):
+            get_unit("NOT_A_PARAM_XX")
+
+    def test_list_parameters(self):
+        from pint_tpu.models.spindown import Spindown
+        from pint_tpu.utils import list_parameters
+
+        rows = list_parameters(Spindown)
+        names = {r["name"] for r in rows}
+        assert "F0" in names and "PEPOCH" in names
+        allrows = list_parameters()
+        assert {"F0", "RAJ", "DM"} <= {r["name"] for r in allrows}
+        f0 = next(r for r in allrows if r["name"] == "F0")
+        assert f0["units"] == "Hz"
+
+
+class TestNumericPartials:
+    def test_numeric_partials_match_analytic(self):
+        from pint_tpu.utils import check_all_partials, numeric_partials
+
+        def f(x, y):
+            return np.array([x * y, x + y**2])
+
+        J = numeric_partials(f, [2.0, 3.0], delta=1e-6)
+        assert np.allclose(J, [[3.0, 2.0], [1.0, 6.0]], atol=1e-5)
+
+        def f2(x, y):
+            val = np.array([math.sin(x) * y])
+            jac = np.array([[math.cos(x) * y, math.sin(x)]])
+            return val, jac
+
+        check_all_partials(f2, [0.3, 1.7])
+
+        def f_bad(x, y):
+            return np.array([x * y]), np.array([[y + 0.5, x]])
+
+        with pytest.raises(ValueError):
+            check_all_partials(f_bad, [2.0, 3.0])
+
+
+class TestTimeHelpers:
+    def test_parse_time_forms(self):
+        from pint_tpu.utils import parse_time
+
+        assert parse_time(55000.0) == 55000.0
+        assert parse_time("55000.25") == pytest.approx(55000.25)
+        assert parse_time([55000.0, 55001.0]).tolist() == [55000.0, 55001.0]
+
+        class TimeLike:
+            mjd = 55002.5
+
+        assert parse_time(TimeLike()) == 55002.5
+        with pytest.raises(TypeError):
+            parse_time(object())
+
+    def test_divide_times(self):
+        from pint_tpu.utils import divide_times
+
+        t0 = 55000.0
+        t = t0 + np.array([-100.0, 0.0, 100.0, 300.0, 500.0, 700.0])
+        idx = divide_times(t, t0)
+        # -100..100 are within +/- half a year of t0 -> same group
+        assert idx[0] == idx[1] == idx[2]
+        # 300 and 500 d fall in the next year-long interval, 700 d the one after
+        assert idx[3] == idx[4] == idx[2] + 1
+        assert idx[5] == idx[3] + 1
+
+    def test_convert_dispersion_measure(self):
+        from pint_tpu.utils import convert_dispersion_measure
+
+        out = convert_dispersion_measure(10.0)
+        # conventional 2.41e-4 constant vs CODATA: ~1.4e-4 relative shift
+        assert out == pytest.approx(10.0 * 4149.3776 / 4148.8066, rel=1e-5)
+
+    def test_get_conjunction(self):
+        from pint_tpu.ephemeris import sun_ecliptic_longitude_deg
+        from pint_tpu.utils import get_conjunction
+
+        t, elong = get_conjunction(100.0, 55000.0)
+        assert 55000.0 < t < 55400.0
+        assert elong < 0.01
+        assert sun_ecliptic_longitude_deg(t) == pytest.approx(100.0, abs=0.02)
+        t_hi, _ = get_conjunction(100.0, 55000.0, precision="high")
+        assert abs(t_hi - t) < 1.0  # low/high agree to < a day
+
+    def test_longdouble_checks_never_raise(self):
+        from pint_tpu.utils import (check_longdouble_precision,
+                                    require_longdouble_precision)
+
+        assert check_longdouble_precision() in (True, False)
+        require_longdouble_precision()
+
+
+class TestPrefixRangeTools:
+    def test_get_prefix_mapping_and_timeranges(self):
+        from pint_tpu.dmx import get_prefix_timerange, get_prefix_timeranges
+
+        m, _, _ = _dmx_model_and_toas(3)
+        mapping = m.get_prefix_mapping("DMX_")
+        assert mapping == {1: "DMX_0001", 2: "DMX_0002", 3: "DMX_0003"}
+        with pytest.raises(ValueError):
+            m.get_prefix_mapping("SWXDM_")
+        assert get_prefix_timerange(m, "DMX_0002") == (55100.0, 55150.0)
+        idx, r1, r2 = get_prefix_timeranges(m, "DMX")
+        assert idx.tolist() == [1, 2, 3]
+        assert r1.tolist() == [55000.0, 55100.0, 55200.0]
+        assert r2.tolist() == [55050.0, 55150.0, 55250.0]
+
+    def test_find_prefix_bytime(self):
+        from pint_tpu.dmx import find_prefix_bytime
+
+        m, _, _ = _dmx_model_and_toas(3)
+        assert find_prefix_bytime(m, "DMX", 55120.0) == 2
+        assert len(np.atleast_1d(find_prefix_bytime(m, "DMX", 55075.0))) == 0
+
+    def test_selections_and_stats(self):
+        from pint_tpu.dmx import dmxselections, dmxstats, xxxselections
+
+        m, t, mjds = _dmx_model_and_toas(3)
+        sel = dmxselections(m, t)
+        assert set(sel) == {"DMX_0001", "DMX_0002", "DMX_0003"}
+        total = sum(len(v) for v in sel.values())
+        assert total == len(mjds)
+        for name, idxs in sel.items():
+            i = int(name.split("_")[1])
+            lo, hi = 55000 + 100 * (i - 1), 55000 + 100 * (i - 1) + 50
+            assert np.all((mjds[idxs] >= lo) & (mjds[idxs] <= hi))
+        assert xxxselections(m, t, prefix="CM") == {}
+        buf = io.StringIO()
+        dmxstats(m, t, file=buf)
+        out = buf.getvalue()
+        assert "DMX_0001" in out and "NTOAS=    4" in out
+
+    def test_add_remove_split_merge_dmx(self):
+        from pint_tpu.dmx import merge_dmx, split_dmx
+
+        m, _, _ = _dmx_model_and_toas(3)
+        comp = m.components["DispersionDMX"]
+        # split bin 2 at its midpoint
+        old, new = split_dmx(m, 55125.0)
+        assert old == 2 and new == 4
+        assert float(m.DMXR2_0002.value) == 55125.0
+        assert float(m.DMXR1_0004.value) == 55125.0
+        assert float(m.DMXR2_0004.value) == 55150.0
+        assert float(m.DMX_0004.value) == float(m.DMX_0002.value)
+        # merge them back
+        newidx = merge_dmx(m, 2, 4, value="mean")
+        assert newidx == 5
+        assert 2 not in comp.dmx_indices and 4 not in comp.dmx_indices
+        assert float(m.DMXR1_0005.value) == 55100.0
+        assert float(m.DMXR2_0005.value) == 55150.0
+        with pytest.raises(ValueError):
+            comp.add_DMX_range(55400.0, 55300.0)
+        with pytest.raises(ValueError):
+            comp.add_DMX_range(55300.0, 55400.0, index=5)
+
+    def test_add_dmx_after_bin1_removed(self):
+        """Regression: template lookup must survive bin 1 being merged away."""
+        from pint_tpu.dmx import merge_dmx
+
+        m, _, _ = _dmx_model_and_toas(3)
+        comp = m.components["DispersionDMX"]
+        merge_dmx(m, 1, 2)  # removes DMX_0001
+        assert 1 not in comp.dmx_indices
+        idx = comp.add_DMX_range(55300.0, 55350.0, dmx=0.01)
+        assert float(m[f"DMX_{idx:04d}"].value) == 0.01
+        # and even after removing every bin
+        comp.remove_DMX_range(list(comp.dmx_indices))
+        idx = comp.add_DMX_range(55400.0, 55450.0)
+        assert comp.dmx_indices == [idx]
+
+    def test_model_does_not_forward_component_base_methods(self):
+        m, _, _ = _dmx_model_and_toas(1)
+        for name in ("add_param", "remove_param", "build_context",
+                     "match_param_alias"):
+            with pytest.raises(AttributeError):
+                getattr(m, name)
+
+    def test_swx_prefix_timeranges(self):
+        from pint_tpu.dmx import (find_prefix_bytime, get_prefix_timerange,
+                                  get_prefix_timeranges)
+        from pint_tpu.models import get_model
+
+        par = ["PSR SWXU\n", "RAJ 02:00:00\n", "DECJ 20:00:00\n",
+               "F0 150.0 1\n", "PEPOCH 55200\n", "DM 15\n", "UNITS TDB\n",
+               "SWXDM_0001 2.0 1\n", "SWXP_0001 1.5\n",
+               "SWXR1_0001 55000\n", "SWXR2_0001 55400\n"]
+        m = get_model(par)
+        assert get_prefix_timerange(m, "SWXDM_0001") == (55000.0, 55400.0)
+        idx, r1, r2 = get_prefix_timeranges(m, "SWX")
+        assert idx.tolist() == [1] and r1.tolist() == [55000.0]
+        assert find_prefix_bytime(m, "SWX", 55100.0) == 1
+
+    def test_split_swx(self):
+        from pint_tpu.dmx import split_swx
+        from pint_tpu.models import get_model
+
+        par = ["PSR SWXT\n", "RAJ 02:00:00\n", "DECJ 20:00:00\n",
+               "F0 150.0 1\n", "PEPOCH 55200\n", "DM 15\n", "UNITS TDB\n",
+               "SWXDM_0001 2.0 1\n", "SWXP_0001 1.5\n",
+               "SWXR1_0001 55000\n", "SWXR2_0001 55400\n"]
+        m = get_model(par)
+        old, new = split_swx(m, 55200.0)
+        assert (old, new) == (1, 2)
+        assert float(m.SWXR2_0001.value) == 55200.0
+        assert float(m.SWXR1_0002.value) == 55200.0
+        assert float(m.SWXDM_0002.value) == 2.0
+        # the new bin inherits the split bin's power-law index, not the default
+        assert float(m.SWXP_0002.value) == 1.5
+
+
+class TestWaveXHelpers:
+    def test_cmwavex_setup_and_getters(self):
+        from pint_tpu.noise_convert import (cmwavex_setup, get_wavex_amps,
+                                            get_wavex_freqs, wavex_setup)
+
+        m = _simple_model(["TNCHROMIDX 4.0\n", "CM 0.1 1\n", "CMEPOCH 55000\n"])
+        idx = cmwavex_setup(m, 400.0, n_freqs=3)
+        assert idx == [1, 2, 3]
+        freqs = [float(m[f"CMWXFREQ_{i:04d}"].value) for i in idx]
+        assert freqs == pytest.approx([1 / 400, 2 / 400, 3 / 400])
+
+        m2 = _simple_model()
+        wavex_setup(m2, 400.0, n_freqs=2)
+        fs = get_wavex_freqs(m2, quantity=True)
+        assert fs == pytest.approx([1 / 400, 2 / 400])
+        assert float(get_wavex_freqs(m2, index=2)[0].value) == \
+            pytest.approx(2 / 400)
+        amps = get_wavex_amps(m2, quantity=True)
+        assert amps == [(0.0, 0.0), (0.0, 0.0)]
+        with pytest.raises(TypeError):
+            get_wavex_freqs(m2, index="nope")
+
+    def test_plchromnoise_from_cmwavex(self):
+        from pint_tpu.noise_convert import cmwavex_setup, plchromnoise_from_cmwavex
+
+        rng = np.random.default_rng(7)
+        m = _simple_model(["TNCHROMIDX 4.0\n", "CM 0.1 1\n", "CMEPOCH 55000\n"])
+        cmwavex_setup(m, 1000.0, n_freqs=8)
+        # inject a steep power-law spectrum into the amplitudes
+        from pint_tpu import DMconst
+
+        scale = DMconst / 1400.0**4
+        for k in range(1, 9):
+            f = k / 1000.0 / 86400.0
+            sig = 1e-7 * (f * 86400.0 * 365.25) ** (-1.5) / scale
+            m[f"CMWXSIN_{k:04d}"].value = float(rng.normal(0, sig))
+            m[f"CMWXCOS_{k:04d}"].value = float(rng.normal(0, sig))
+            m[f"CMWXSIN_{k:04d}"].uncertainty = sig * 0.01
+            m[f"CMWXCOS_{k:04d}"].uncertainty = sig * 0.01
+        out = plchromnoise_from_cmwavex(m, ignore_fyr=False)
+        assert "PLChromNoise" in out.components
+        assert "CMWaveX" not in out.components
+        assert out.TNCHROMC.value == 8
+        assert np.isfinite(float(out.TNCHROMAMP.value))
+        assert np.isfinite(float(out.TNCHROMGAM.value))
+
+
+class TestUtilsLazyReexports:
+    def test_reference_surface_importable(self):
+        import pint_tpu.utils as u
+
+        for name in ["dmx_ranges", "dmxparse", "dmxstats", "split_dmx",
+                     "merge_dmx", "wavex_setup", "cmwavex_setup",
+                     "plrednoise_from_wavex", "get_wavex_freqs",
+                     "find_optimal_nharms"]:
+            assert callable(getattr(u, name)), name
+        with pytest.raises(AttributeError):
+            u.no_such_helper
