@@ -1,0 +1,192 @@
+"""TOA coloring modes for the pintk residual plot (reference
+``pintk/colormodes.py``: DefaultMode, FreqMode, NameMode, ObsMode,
+JumpMode).
+
+Redesigned headless-first: each mode maps a :class:`pint_tpu.pintk.pulsar
+.Pulsar` (+ selection mask) to a per-TOA color array and a {label: color}
+legend, so the logic is testable without tkinter; the plk widget just
+scatters with the returned colors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+__all__ = ["ColorMode", "DefaultMode", "FreqMode", "NameMode", "ObsMode",
+           "JumpMode", "COLOR_MODES", "get_color_mode"]
+
+SELECTED_COLOR = "#d03020"
+
+
+class ColorMode:
+    """Base: compute per-TOA plot colors for one coloring scheme.
+
+    Modes implement ``_groups(psr) -> [(label, color, mask)]``; later groups
+    override earlier ones where masks overlap (jump layering).  Labels are
+    unique even when palette colors repeat, so plotting by *group* never
+    double-draws points the way color-equality grouping would.
+    """
+
+    mode_name = "base"
+
+    def _groups(self, psr):
+        raise NotImplementedError
+
+    def get_groups(self, psr, selected=None):
+        """[(label, color, mask)] with overlaps resolved (each TOA belongs
+        to exactly one group) and the selection appended last."""
+        n = len(psr.all_toas)
+        raw = self._groups(psr)
+        claimed = np.zeros(n, dtype=bool)
+        out = []
+        # later groups take precedence: walk in reverse, keep first claim
+        for label, color, mask in reversed(raw):
+            mask = np.asarray(mask, dtype=bool) & ~claimed
+            claimed |= mask
+            out.append((label, color, mask))
+        out.reverse()
+        if selected is not None and np.any(selected):
+            sel = np.asarray(selected, dtype=bool)
+            out = [(lbl, c, m & ~sel) for lbl, c, m in out]
+            out.append(("selected", SELECTED_COLOR, sel))
+        return [(lbl, c, m) for lbl, c, m in out if m.any()]
+
+    def get_colors(self, psr, selected=None) -> Tuple[np.ndarray, Dict[str, str]]:
+        """(colors (N,) of str, legend {label: color}); ``selected`` TOAs
+        override with the selection color."""
+        n = len(psr.all_toas)
+        colors = np.full(n, DefaultMode.color, dtype=object)
+        legend = {}
+        for label, color, mask in self.get_groups(psr, selected):
+            colors[mask] = color
+            legend[label] = color
+        return colors, legend
+
+    def display_info(self, psr) -> str:
+        _, legend = self.get_colors(psr)
+        lines = [f'"{self.mode_name}" mode:']
+        lines += [f"  {lbl:<12s} {col}" for lbl, col in legend.items()]
+        return "\n".join(lines)
+
+
+class DefaultMode(ColorMode):
+    """All TOAs one color (reference ``colormodes.py:45``)."""
+
+    mode_name = "default"
+    color = "#2060a0"
+
+    def _groups(self, psr):
+        n = len(psr.all_toas)
+        return [("TOA", self.color, np.ones(n, dtype=bool))]
+
+
+class FreqMode(ColorMode):
+    """Color by radio frequency band (reference ``colormodes.py:92`` band
+    edges: 300/400/500/700/1000/1800/3000/8000 MHz)."""
+
+    mode_name = "freq"
+    edges = [300.0, 400.0, 500.0, 700.0, 1000.0, 1800.0, 3000.0, 8000.0]
+    band_colors = ["#8b0000", "#e50000", "#f97306", "#ffff14", "#15b01a",
+                   "#0343df", "#380282", "#000000", "#929591"]
+    band_labels = ["<300", "300-400", "400-500", "500-700", "700-1000",
+                   "1000-1800", "1800-3000", "3000-8000", ">8000"]
+
+    def _groups(self, psr):
+        freqs = np.asarray(psr.all_toas.freq_mhz, dtype=np.float64)
+        band = np.digitize(freqs, self.edges)
+        return [(f"{lbl} MHz", self.band_colors[b], band == b)
+                for b, lbl in enumerate(self.band_labels)
+                if np.any(band == b)]
+
+
+_CYCLE = ["#e50000", "#15b01a", "#0343df", "#f97306", "#7e1e9c", "#00ffff",
+          "#653700", "#ff81c0", "#929591", "#000000"]
+
+
+class NameMode(ColorMode):
+    """Color by the TOA's source name flag (``-name`` / tim file), cycling a
+    fixed palette (reference ``colormodes.py:177``)."""
+
+    mode_name = "name"
+
+    def _groups(self, psr):
+        toas = psr.all_toas
+        names = np.asarray([fl.get("name", toas.filename or "?")
+                            for fl in toas.flags], dtype=object)
+        return [(str(nm), _CYCLE[i % len(_CYCLE)], names == nm)
+                for i, nm in enumerate(sorted(set(names)))]
+
+
+class ObsMode(ColorMode):
+    """Color by observatory, with the reference's site grouping (any gb* is
+    Green Bank, jb* is Jodrell, *stl* is space; reference
+    ``colormodes.py:237``)."""
+
+    mode_name = "obs"
+    obs_colors = {
+        "parkes": "#e50000", "gb": "#15b01a", "jodrell": "#00ffff",
+        "arecibo": "#0343df", "chime": "#c04e01", "gmrt": "#653700",
+        "vla": "#380282", "effelsberg": "#7e1e9c", "fast": "#00035b",
+        "nancay": "#96f97b", "srt": "#033500", "wsrt": "#95d0fc",
+        "lofar": "#840000", "lwa": "#840000", "mwa": "#840000",
+        "meerkat": "#c20078", "barycenter": "#929591", "space": "#000000",
+        "other": "#d8dcd6",
+    }
+
+    @staticmethod
+    def _group(site: str) -> str:
+        s = site.lower()
+        if "stl" in s:
+            return "space"
+        if s.startswith("gb"):
+            return "gb"
+        if s.startswith("jb"):
+            return "jodrell"
+        if "ncy" in s:
+            return "nancay"
+        return s if s in ObsMode.obs_colors else "other"
+
+    def _groups(self, psr):
+        obs = np.asarray(psr.all_toas.obs, dtype=object)
+        groups = np.asarray([self._group(str(o)) for o in obs], dtype=object)
+        return [(g, self.obs_colors[g], groups == g)
+                for g in sorted(set(groups))]
+
+
+class JumpMode(ColorMode):
+    """Color TOAs by which JUMP selects them (reference
+    ``colormodes.py:345``); un-jumped TOAs keep the default color."""
+
+    mode_name = "jump"
+    base_color = DefaultMode.color
+
+    def _groups(self, psr):
+        toas = psr.all_toas
+        n = len(toas)
+        out = [("no jump", self.base_color, np.ones(n, dtype=bool))]
+        comp = psr.model.components.get("PhaseJump")
+        if comp is not None:
+            k = 0
+            for jname in comp.jumps:
+                par = comp._params_dict[jname]
+                if par.key is None and not par.key_value:
+                    continue  # unconfigured placeholder selects everything
+                mask = np.zeros(n, dtype=bool)
+                mask[np.asarray(par.select_toa_mask(toas), dtype=int)] = True
+                out.append((jname, _CYCLE[k % len(_CYCLE)], mask))
+                k += 1
+        return out
+
+
+COLOR_MODES = {cls.mode_name: cls for cls in
+               (DefaultMode, FreqMode, NameMode, ObsMode, JumpMode)}
+
+
+def get_color_mode(name: str) -> ColorMode:
+    try:
+        return COLOR_MODES[name]()
+    except KeyError:
+        raise ValueError(f"Unknown color mode {name!r}; "
+                         f"choose from {sorted(COLOR_MODES)}")

@@ -32,7 +32,7 @@ def launch_gui(psr):
     canvas = FigureCanvasTkAgg(fig, master=root)
     canvas.get_tk_widget().pack(side=tk.TOP, fill=tk.BOTH, expand=1)
     state = {"selected": np.zeros(len(psr.all_toas), dtype=bool),
-             "random_overlay": False}
+             "random_overlay": False, "colormode": "default"}
 
     def redraw():
         ax.clear()
@@ -45,11 +45,14 @@ def launch_gui(psr):
             state["selected"] = np.zeros(len(psr.all_toas), dtype=bool)
             state.pop("overlay_cache", None)
         sel = state["selected"]
-        ax.errorbar(mjds[~sel], res_us[~sel], yerr=errs[~sel], fmt=".",
-                    color="#2060a0", ecolor="0.8")
-        if sel.any():
-            ax.errorbar(mjds[sel], res_us[sel], yerr=errs[sel], fmt=".",
-                        color="#d03020", ecolor="0.8")
+        from pint_tpu.pintk.colormodes import get_color_mode
+
+        groups = get_color_mode(state["colormode"]).get_groups(psr, sel)
+        for lbl, col, m in groups:
+            ax.errorbar(mjds[m], res_us[m], yerr=errs[m], fmt=".",
+                        color=col, ecolor="0.8", label=lbl)
+        if len(groups) > 1:
+            ax.legend(loc="upper right", fontsize=7)
         if state["random_overlay"] and psr.fitted:
             # random-model overlay (reference pintk random models): draws
             # from the post-fit covariance shown as residual-delta curves.
@@ -125,6 +128,21 @@ def launch_gui(psr):
         from pint_tpu.pintk.timedit import TimChoiceWidget
 
         TimChoiceWidget(root, psr, updates_cb=redraw)
+
+    # color-mode selector (reference pintk colormodes)
+    from pint_tpu.pintk.colormodes import COLOR_MODES
+
+    ttk.Label(bar, text="Color:").pack(side=tk.RIGHT)
+    mode_var = tk.StringVar(value="default")
+
+    def on_mode(_ev=None):
+        state["colormode"] = mode_var.get()
+        redraw()
+
+    combo = ttk.Combobox(bar, textvariable=mode_var, width=8,
+                         values=sorted(COLOR_MODES), state="readonly")
+    combo.bind("<<ComboboxSelected>>", on_mode)
+    combo.pack(side=tk.RIGHT)
 
     for label, cmd in [("Fit", do_fit), ("Reset", do_reset),
                        ("Clear sel", do_clear_sel), ("Jump sel", do_jump),

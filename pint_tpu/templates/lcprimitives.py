@@ -14,10 +14,14 @@ approach of wrapping narrow peaks.
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
-__all__ = ["LCPrimitive", "LCGaussian", "LCLorentzian", "LCVonMises",
-           "LCTopHat"]
+__all__ = ["LCPrimitive", "LCGaussian", "LCGaussian2", "LCLorentzian",
+           "LCLorentzian2", "LCVonMises", "LCTopHat", "LCKing", "LCHarmonic",
+           "LCEmpiricalFourier", "LCKernelDensity", "convert_primitive",
+           "approx_gradient", "check_gradient"]
 
 _NWRAP = 6  # image terms each side; adequate for width > ~0.005
 
@@ -33,6 +37,10 @@ class LCPrimitive:
 
     name = "base"
     pnames: list = []
+    #: False for shapes whose component pdf can go negative (Fourier
+    #: harmonics): they are not standalone densities, so mixture
+    #: (per-component) sampling is invalid for them
+    mixture_safe = True
 
     def __init__(self, p=None):
         self.p = np.asarray(p if p is not None else self.p0, dtype=np.float64)
@@ -65,6 +73,29 @@ class LCPrimitive:
 
     def __call__(self, phases):
         return self._pdf(phases, self.p)
+
+    def hwhm(self, right: bool = False) -> float:
+        """Half width at half maximum; subclasses with non-gaussian shapes
+        override (reference ``lcprimitives.py hwhm``)."""
+        return float(self.p[int(right) if self.is_two_sided() else 0]) \
+            * math.sqrt(2 * math.log(2))
+
+    def is_two_sided(self) -> bool:
+        return False
+
+    def random(self, n: int, rng=None) -> np.ndarray:
+        """Draw n phases from this primitive (rejection fallback; analytic
+        subclasses override)."""
+        rng = rng or np.random.default_rng()
+        grid = np.linspace(0.0, 1.0, 1024)
+        fmax = float(np.max(np.asarray(self(grid)))) * 1.05
+        out = np.empty(0)
+        while len(out) < n:
+            m = int((n - len(out)) * 1.5 * fmax) + 16
+            x = rng.random(m)
+            keep = rng.random(m) * fmax < np.asarray(self(x))
+            out = np.concatenate([out, x[keep]])
+        return out[:n]
 
     def integrate(self, x1: float = 0.0, x2: float = 1.0, simps: int = 512) -> float:
         """Numerical integral over [x1, x2] (analytic not needed at the
@@ -102,6 +133,44 @@ class LCGaussian(LCPrimitive):
             out = out + xp.exp(-0.5 * ((z + k) / sigma) ** 2)
         return out / (sigma * np.sqrt(2 * np.pi))
 
+    def random(self, n, rng=None):
+        rng = rng or np.random.default_rng()
+        return (self.p[1] + self.p[0] * rng.standard_normal(n)) % 1.0
+
+
+class LCGaussian2(LCPrimitive):
+    """Wrapped two-sided Gaussian: p = [sigma_left, sigma_right, location]
+    (reference ``lcprimitives.py:794 LCGaussian2``): each side is a half
+    normal with its own width, continuous at the mode, integral 1."""
+
+    name = "Gaussian2"
+    pnames = ["Width1", "Width2", "Location"]
+    p0 = [0.03, 0.03, 0.5]
+
+    def is_two_sided(self):
+        return True
+
+    def _pdf(self, phases, p):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(phases, np.ndarray) else np
+        w1, w2, loc = p[0], p[1], p[2]
+        amp = math.sqrt(2.0 / np.pi)  # 2/sqrt(2 pi), shared peak height scale
+        z0 = xp.asarray(phases) - loc
+        out = 0.0
+        for k in range(-_NWRAP, _NWRAP + 1):
+            z = z0 + k
+            zz = z * xp.where(z <= 0, 1.0 / w1, 1.0 / w2)
+            out = out + xp.exp(-0.5 * zz**2)
+        return out * (amp / (w1 + w2))
+
+    def random(self, n, rng=None):
+        rng = rng or np.random.default_rng()
+        w1, w2, loc = self.p
+        left = rng.random(n) < w1 / (w1 + w2)
+        draw = np.abs(rng.standard_normal(n))
+        return (loc + np.where(left, -w1 * draw, w2 * draw)) % 1.0
+
 
 class LCLorentzian(LCPrimitive):
     """Periodized Lorentzian: p = [gamma (HWHM), location]."""
@@ -121,6 +190,49 @@ class LCLorentzian(LCPrimitive):
         a = 2 * np.pi * gamma
         z = 2 * np.pi * (xp.asarray(phases) - loc)
         return xp.sinh(a) / (xp.cosh(a) - xp.cos(z))
+
+    def hwhm(self, right=False):
+        return float(self.p[0])
+
+    def random(self, n, rng=None):
+        rng = rng or np.random.default_rng()
+        return (self.p[1] + self.p[0] * rng.standard_cauchy(n)) % 1.0
+
+
+class LCLorentzian2(LCPrimitive):
+    """Wrapped two-sided Lorentzian: p = [gamma_left, gamma_right, location]
+    (reference ``lcprimitives.py:1086 LCLorentzian2``)."""
+
+    name = "Lorentzian2"
+    pnames = ["Width1", "Width2", "Location"]
+    p0 = [0.03, 0.03, 0.5]
+
+    def is_two_sided(self):
+        return True
+
+    def hwhm(self, right=False):
+        return float(self.p[int(right)])
+
+    def _pdf(self, phases, p):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(phases, np.ndarray) else np
+        g1, g2, loc = p[0], p[1], p[2]
+        amp = 2.0 / np.pi / (g1 + g2)  # shared peak height, integral 1
+        z0 = (xp.asarray(phases) - loc + 0.5) % 1.0 - 0.5
+        out = 0.0
+        for k in range(-_NWRAP, _NWRAP + 1):
+            z = z0 + k
+            zz = z * xp.where(z <= 0, 1.0 / g1, 1.0 / g2)
+            out = out + amp / (1.0 + zz * zz)
+        return out
+
+    def random(self, n, rng=None):
+        rng = rng or np.random.default_rng()
+        g1, g2, loc = self.p
+        left = rng.random(n) < g1 / (g1 + g2)
+        draw = np.abs(rng.standard_cauchy(n))
+        return (loc + np.where(left, -g1 * draw, g2 * draw)) % 1.0
 
 
 class LCVonMises(LCPrimitive):
@@ -147,6 +259,12 @@ class LCVonMises(LCPrimitive):
             return np.exp(kappa * (np.cos(z) - 1.0)) / np_i0e(kappa)
         return jnp.exp(kappa * (jnp.cos(z) - 1.0)) / i0e(kappa)
 
+    def random(self, n, rng=None):
+        rng = rng or np.random.default_rng()
+        kappa = 1.0 / (2 * np.pi * self.p[0]) ** 2
+        draw = rng.vonmises(0.0, kappa, n) / (2 * np.pi)
+        return (self.p[1] + draw) % 1.0
+
 
 class LCTopHat(LCPrimitive):
     """Top hat of given width centered at location (host-side only shape)."""
@@ -155,6 +273,9 @@ class LCTopHat(LCPrimitive):
     pnames = ["Width", "Location"]
     p0 = [0.1, 0.5]
 
+    def hwhm(self, right=False):
+        return float(self.p[0]) / 2
+
     def _pdf(self, phases, p):
         import jax.numpy as jnp
 
@@ -162,3 +283,259 @@ class LCTopHat(LCPrimitive):
         width, loc = p[0], p[1]
         z = (xp.asarray(phases) - loc + 0.5) % 1.0 - 0.5
         return xp.where(xp.abs(z) <= width / 2, 1.0 / width, 0.0)
+
+    def random(self, n, rng=None):
+        rng = rng or np.random.default_rng()
+        w, loc = self.p
+        return (loc + (rng.random(n) - 0.5) * w) % 1.0
+
+
+class LCKing(LCPrimitive):
+    """Wrapped King-function peak: p = [sigma, gamma, location] (reference
+    ``lcprimitives.py:1250 LCKing``): (1+z^2/(2 s^2 g))^-g with the
+    (g-1)/g normalization of the unwrapped profile."""
+
+    name = "King"
+    pnames = ["Sigma", "Gamma", "Location"]
+    p0 = [0.03, 5.0, 0.5]
+
+    def hwhm(self, right=False):
+        s, g, _ = self.p
+        # solve (1+u/g)^-g = 1/2 for u = z^2/(2 s^2)
+        u = g * (2.0 ** (1.0 / g) - 1.0)
+        return float(np.sqrt(2.0 * u) * s)
+
+    def _pdf(self, phases, p):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(phases, np.ndarray) else np
+        s, g, loc = p[0], p[1], p[2]
+        z0 = (xp.asarray(phases) - loc + 0.5) % 1.0 - 0.5
+        out = 0.0
+        for k in range(-_NWRAP, _NWRAP + 1):
+            u = 0.5 * ((z0 + k) / s) ** 2
+            out = out + (1.0 + u / g) ** (-g)
+        # normalize the infinite-domain profile: int (1+u/g)^-g dz
+        # = s sqrt(2 pi g) Gamma(g-1/2)/Gamma(g)  (exact); gammaln from the
+        # active backend so traced parameters stay jit/grad-compatible
+        if xp is np:
+            from scipy.special import gammaln
+        else:
+            from jax.scipy.special import gammaln
+
+        norm = s * xp.sqrt(2 * np.pi * g) * xp.exp(
+            gammaln(g - 0.5) - gammaln(g))
+        return out / norm
+
+
+class LCHarmonic(LCPrimitive):
+    """A single Fourier harmonic, 1 + 2 cos(2 pi k (phi - loc)): p = [loc]
+    (reference ``lcprimitives.py:1336 LCHarmonic``).  Integrates to 1 over a
+    cycle by construction; ``order`` selects the harmonic number."""
+
+    name = "Harmonic"
+    pnames = ["Location"]
+    p0 = [0.0]
+    mixture_safe = False  # pdf dips negative; only the sum is a density
+
+    def __init__(self, p=None, order: int = 1):
+        super().__init__(p)
+        self.order = int(order)
+
+    def hwhm(self, right=False):
+        return 0.25 / self.order
+
+    def _pdf(self, phases, p):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(phases, np.ndarray) else np
+        loc = p[0]
+        return 1.0 + 2.0 * xp.cos((2 * np.pi * self.order)
+                                  * (xp.asarray(phases) - loc))
+
+
+class LCEmpiricalFourier(LCPrimitive):
+    """Empirical Fourier light-curve representation; only parameter is an
+    overall phase shift (reference ``lcprimitives.py:1361``).  Cannot be
+    mixed with other primitives.  Build from photon phases or a stored
+    two-column (alpha, beta) coefficient file."""
+
+    name = "EmpiricalFourier"
+    pnames = ["Shift"]
+    p0 = [0.0]
+    mixture_safe = False  # truncated Fourier sums can dip negative
+
+    def __init__(self, phases=None, input_file=None, nharm: int = 20):
+        super().__init__([0.0])
+        self.nharm = int(nharm)
+        self.alphas = np.zeros(self.nharm)
+        self.betas = np.zeros(self.nharm)
+        if input_file is not None:
+            self.from_file(input_file)
+        if phases is not None:
+            self.from_phases(phases)
+
+    def from_phases(self, phases):
+        phases = np.asarray(phases, dtype=np.float64)
+        ks = 2 * np.pi * np.arange(1, self.nharm + 1)
+        self.alphas = np.cos(ks[:, None] * phases[None, :]).mean(axis=1)
+        self.betas = np.sin(ks[:, None] * phases[None, :]).mean(axis=1)
+
+    def from_file(self, input_file):
+        rows = []
+        with open(input_file) as f:
+            for line in f:
+                ln = line.strip()
+                if not ln or ln.startswith("#"):
+                    continue
+                tok = ln.split()
+                if len(tok) == 2:
+                    rows.append((float(tok[0]), float(tok[1])))
+        if not rows:
+            raise ValueError(f"No Fourier coefficients in {input_file}")
+        arr = np.asarray(rows)
+        self.alphas, self.betas = arr[:, 0], arr[:, 1]
+        self.nharm = len(rows)
+
+    def to_file(self, output_file):
+        with open(output_file, "w") as f:
+            f.write("# fourier\n")
+            for a, b in zip(self.alphas, self.betas):
+                f.write(f"{a}\t{b}\n")
+
+    def _pdf(self, phases, p):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(phases, np.ndarray) else np
+        shift = p[0]
+        ks = xp.asarray(2 * np.pi * np.arange(1, self.nharm + 1))
+        # shift theorem on the real coefficient pairs (xp ops so a traced
+        # shift parameter stays jit/grad-compatible)
+        c, s = xp.cos(ks * shift), xp.sin(ks * shift)
+        a = c * xp.asarray(self.alphas) - s * xp.asarray(self.betas)
+        b = s * xp.asarray(self.alphas) + c * xp.asarray(self.betas)
+        ph = xp.asarray(phases)
+        out = 1.0 + 2.0 * xp.sum(a[:, None] * xp.cos(ks[:, None] * ph[None, :])
+                                 + b[:, None] * xp.sin(ks[:, None] * ph[None, :]),
+                                 axis=0)
+        return out
+
+    def integrate(self, x1=0.0, x2=1.0, simps=512):
+        if (x1, x2) == (0.0, 1.0):
+            return 1.0  # Fourier norm is exact by construction
+        return super().integrate(x1, x2, simps)
+
+
+class LCKernelDensity(LCPrimitive):
+    """Wrapped gaussian kernel-density estimate of the light curve; only
+    parameter is an overall phase shift (reference ``lcprimitives.py:1456``).
+    Cannot be mixed with other primitives.  The empirical bandwidth follows
+    Silverman's rule on the circular standard deviation, floored to resolve
+    narrow peaks; the grid-sampled estimate is renormalized exactly."""
+
+    name = "KernelDensity"
+    pnames = ["Shift"]
+    p0 = [0.0]
+
+    def __init__(self, phases=None, bw: float = None, ngrid: int = 512):
+        super().__init__([0.0])
+        self.ngrid = int(ngrid)
+        self.bw = bw  # user-supplied bandwidth, or None for per-fit auto
+        self.bw_used = None  # bandwidth of the latest from_phases fit
+        self.grid = np.linspace(0.0, 1.0, self.ngrid, endpoint=False)
+        self.vals = np.ones(self.ngrid)
+        if phases is not None:
+            self.from_phases(phases)
+
+    def from_phases(self, phases):
+        phases = np.asarray(phases, dtype=np.float64) % 1.0
+        n = len(phases)
+        bw = self.bw
+        if bw is None:
+            # circular std via resultant length; re-estimated per dataset
+            C = np.cos(2 * np.pi * phases).mean()
+            S = np.sin(2 * np.pi * phases).mean()
+            R = np.hypot(C, S)
+            circ_std = np.sqrt(-2 * np.log(max(R, 1e-12))) / (2 * np.pi)
+            bw = max(1.06 * circ_std * n ** (-0.2), 0.5 / self.ngrid)
+        self.bw_used = bw
+        # wrapped-gaussian KDE evaluated on the grid (vectorized, 3 wraps)
+        d = (self.grid[:, None] - phases[None, :] + 0.5) % 1.0 - 0.5
+        k = np.exp(-0.5 * (d / bw) ** 2)
+        for w in (-1.0, 1.0):
+            k += np.exp(-0.5 * ((d + w) / bw) ** 2)
+        vals = k.sum(axis=1) / (n * bw * np.sqrt(2 * np.pi))
+        self.vals = vals / np.mean(vals)  # exact unit integral on the grid
+
+    def _pdf(self, phases, p):
+        import jax.numpy as jnp
+
+        xp = jnp if not isinstance(phases, np.ndarray) else np
+        z = (xp.asarray(phases) - p[0]) % 1.0
+        idx = z * self.ngrid
+        i0 = xp.floor(idx).astype(int) % self.ngrid
+        i1 = (i0 + 1) % self.ngrid
+        frac = idx - xp.floor(idx)
+        vals = xp.asarray(self.vals)
+        return vals[i0] * (1 - frac) + vals[i1] * frac
+
+
+def convert_primitive(p1: LCPrimitive, ptype=LCLorentzian) -> LCPrimitive:
+    """Build a primitive of another type with matched location and HWHM
+    (reference ``lcprimitives.py:1607 convert_primitive``).  Supported
+    targets are the width+location families (Gaussian/Lorentzian/VonMises/
+    TopHat and the two-sided variants); anything else raises."""
+    one_sided = (LCGaussian, LCLorentzian, LCVonMises, LCTopHat)
+    two_sided = (LCGaussian2, LCLorentzian2)
+    if ptype not in one_sided + two_sided:
+        raise ValueError(
+            f"convert_primitive cannot target {ptype.__name__}: only "
+            "width+location shapes have a well-defined HWHM mapping")
+    loc = p1.get_location()
+    if p1.is_two_sided():
+        h1, h2 = p1.hwhm(False), p1.hwhm(True)
+    else:
+        h1 = h2 = p1.hwhm()
+
+    def width_from_hwhm(h):
+        if ptype in (LCLorentzian, LCLorentzian2):
+            return h  # gamma is the HWHM
+        if ptype is LCTopHat:
+            return 2 * h
+        return h / math.sqrt(2 * math.log(2))  # gaussian-like sigma
+
+    if ptype in two_sided:
+        return ptype([width_from_hwhm(h1), width_from_hwhm(h2), loc])
+    return ptype([width_from_hwhm(0.5 * (h1 + h2)), loc])
+
+
+def approx_gradient(prim: LCPrimitive, phases, eps: float = 1e-6) -> np.ndarray:
+    """Numeric d(pdf)/d(params) matrix (nparam, nphase) (reference
+    ``lcprimitives.py:74``)."""
+    phases = np.asarray(phases, dtype=np.float64)
+    out = []
+    for i in range(len(prim.p)):
+        hi = prim.p.copy()
+        lo = prim.p.copy()
+        hi[i] += eps / 2
+        lo[i] -= eps / 2
+        out.append((np.asarray(prim._pdf(phases, hi))
+                    - np.asarray(prim._pdf(phases, lo))) / eps)
+    return np.asarray(out)
+
+
+def check_gradient(prim: LCPrimitive, n: int = 100, seed: int = 0,
+                   atol: float = 1e-5, rtol: float = 1e-4) -> bool:
+    """Cross-check the jax autodiff gradient of the pdf against numeric
+    differencing (reference ``lcprimitives.py:146 check_gradient``; here the
+    analytic side is jacfwd of the same jnp evaluation core)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    phases = rng.random(n)
+    num = approx_gradient(prim, phases)
+    ana = jax.jacfwd(lambda p: prim._pdf(jnp.asarray(phases), p))(
+        jnp.asarray(prim.p))
+    ana = np.asarray(ana).T
+    return np.allclose(ana, num, atol=atol, rtol=rtol)

@@ -110,8 +110,28 @@ class LCTemplate:
 
     # -- sampling ------------------------------------------------------------
     def random(self, n: int, rng=None) -> np.ndarray:
-        """Draw n photon phases from the template (rejection sampling)."""
+        """Draw n photon phases from the template: multinomial split over
+        (background, components), each primitive drawing analytically where
+        it can (reference ``lctemplate.py random`` technique); rejection
+        sampling is the per-primitive fallback."""
         rng = rng or np.random.default_rng()
+        if not all(getattr(p, "mixture_safe", True) for p in self.primitives):
+            # Fourier-style components are not standalone densities (their
+            # pdfs dip negative); only whole-template rejection is valid
+            return self._random_rejection(n, rng)
+        norms = np.asarray(self.norms(), dtype=np.float64)
+        probs = np.concatenate([[max(1.0 - norms.sum(), 0.0)], norms])
+        probs = probs / probs.sum()
+        counts = rng.multinomial(n, probs)
+        parts = [rng.random(counts[0])]  # uniform background
+        for c, prim in zip(counts[1:], self.primitives):
+            if c:
+                parts.append(np.asarray(prim.random(int(c), rng=rng)))
+        out = np.concatenate(parts)
+        rng.shuffle(out)
+        return out
+
+    def _random_rejection(self, n: int, rng) -> np.ndarray:
         grid = np.linspace(0, 1, 2048)
         fmax = float(np.max(self(grid))) * 1.05
         out = np.empty(0)

@@ -111,3 +111,78 @@ class TestRandomOverlayData:
         assert len(models) == 5
         # draws scatter roughly like the parameter covariance: nonzero
         assert np.any(np.abs(dphase) > 0)
+
+
+class TestColorModes:
+    def test_default_and_freq(self, psr):
+        from pint_tpu.pintk.colormodes import (DefaultMode, FreqMode,
+                                               get_color_mode)
+
+        n = len(psr.all_toas)
+        colors, legend = DefaultMode().get_colors(psr)
+        assert len(colors) == n and len(set(colors)) == 1
+        colors, legend = FreqMode().get_colors(psr)
+        assert len(colors) == n
+        # every color used appears in the legend
+        assert set(colors) <= set(legend.values())
+        # NGC6440E is ~1400-2000 MHz: bands restricted to those edges
+        freqs = np.asarray(psr.all_toas.freq_mhz)
+        assert all(("1000-1800" in l or "1800-3000" in l or "MHz" in l)
+                   for l in legend)
+
+    def test_selected_overrides(self, psr):
+        from pint_tpu.pintk.colormodes import SELECTED_COLOR, DefaultMode
+
+        sel = np.zeros(len(psr.all_toas), dtype=bool)
+        sel[:5] = True
+        colors, legend = DefaultMode().get_colors(psr, sel)
+        assert (colors[:5] == SELECTED_COLOR).all()
+        assert (colors[5:] != SELECTED_COLOR).all()
+        assert legend["selected"] == SELECTED_COLOR
+
+    def test_obs_and_name_modes(self, psr):
+        from pint_tpu.pintk.colormodes import NameMode, ObsMode
+
+        colors, legend = ObsMode().get_colors(psr)
+        # NGC6440E TOAs are all GBT -> single "gb" group, green
+        assert set(legend) == {"gb"}
+        assert len(set(colors)) == 1
+        colors, legend = NameMode().get_colors(psr)
+        assert set(colors) <= set(legend.values())
+
+    def test_jump_mode_colors_jumped_toas(self, psr):
+        from pint_tpu.pintk.colormodes import JumpMode
+
+        sel = np.zeros(len(psr.all_toas), dtype=bool)
+        sel[10:20] = True
+        name = psr.add_jump(sel)
+        try:
+            colors, legend = JumpMode().get_colors(psr)
+            assert name in legend
+            # the unconfigured placeholder JUMP1 must not appear
+            assert "JUMP1" not in legend
+            jumped = np.asarray([c == legend[name] for c in colors])
+            assert jumped.sum() == 10 and jumped[10:20].all()
+        finally:
+            psr.reset_model()
+
+    def test_groups_partition_toas(self, psr):
+        """get_groups masks are disjoint and cover every TOA even when
+        palette colors repeat across labels."""
+        from pint_tpu.pintk.colormodes import COLOR_MODES
+
+        n = len(psr.all_toas)
+        sel = np.zeros(n, dtype=bool)
+        sel[::7] = True
+        for name, cls in COLOR_MODES.items():
+            total = np.zeros(n, dtype=int)
+            for _lbl, _c, m in cls().get_groups(psr, sel):
+                total += m.astype(int)
+            assert (total == 1).all(), name
+
+    def test_unknown_mode_raises(self):
+        from pint_tpu.pintk.colormodes import get_color_mode
+        import pytest as _pt
+
+        with _pt.raises(ValueError):
+            get_color_mode("nope")

@@ -1,0 +1,163 @@
+"""Global clock-correction repository semantics (reference
+``observatory/global_clock_corrections.py``): index parsing, download
+policies against a local mirror, expiry, invalid-if-older-than, export."""
+
+import os
+import time
+
+import pytest
+
+INDEX = """\
+# File                    Update (days)  Invalid if older than
+index.txt                 1.0            ---
+gps2utc.clk               7.0            ---  GPS to UTC
+T2runtime/clock/wsrt2gps.clk  30.0       2021-09-14  WSRT
+time_gbt.dat              0.5            ---  GBT
+"""
+
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+    r = tmp_path / "repo"
+    (r / "T2runtime" / "clock").mkdir(parents=True)
+    (r / "index.txt").write_text(INDEX)
+    (r / "gps2utc.clk").write_text("# UTC(GPS) UTC\n50000.0 0.0\n51000.0 1e-8\n")
+    (r / "T2runtime" / "clock" / "wsrt2gps.clk").write_text(
+        "# UTC(WSRT) UTC(GPS)\n50000.0 0.0\n")
+    (r / "time_gbt.dat").write_text("   50000.00 0.00\n")
+    cache = tmp_path / "cache"
+    monkeypatch.setenv("PINT_CLOCK_REPO", str(r))
+    monkeypatch.setenv("PINT_CLOCK_CACHE", str(cache))
+    monkeypatch.delenv("PINT_CLOCK_DIR", raising=False)
+    return r, cache
+
+
+class TestIndex:
+    def test_parse(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import Index
+
+        idx = Index()
+        assert set(idx.files) == {"index.txt", "gps2utc.clk", "wsrt2gps.clk",
+                                  "time_gbt.dat"}
+        e = idx.files["wsrt2gps.clk"]
+        assert e.file == "T2runtime/clock/wsrt2gps.clk"
+        assert e.update_interval_days == 30.0
+        assert e.invalid_if_older_than is not None  # 2021-09-14 stamp
+        assert idx.files["gps2utc.clk"].invalid_if_older_than is None
+
+
+class TestPolicies:
+    def test_if_missing_copies_once(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        r, cache = repo
+        p = get_file("gps2utc.clk", download_policy="if_missing")
+        assert p.exists() and p.parent == cache
+        mtime = p.stat().st_mtime
+        # repo copy changes, but if_missing keeps the cached one
+        (r / "gps2utc.clk").write_text("changed\n")
+        p2 = get_file("gps2utc.clk", download_policy="if_missing")
+        assert p2.read_text().startswith("# UTC(GPS)")
+        assert p2.stat().st_mtime == mtime
+
+    def test_never_requires_cache(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        with pytest.raises(FileNotFoundError):
+            get_file("gps2utc.clk", download_policy="never")
+        get_file("gps2utc.clk", download_policy="if_missing")
+        assert get_file("gps2utc.clk", download_policy="never").exists()
+
+    def test_if_expired_refreshes_old_copy(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        r, cache = repo
+        p = get_file("gps2utc.clk")  # if_expired, fresh copy
+        (r / "gps2utc.clk").write_text("v2\n")
+        # young copy: not refreshed
+        assert get_file("gps2utc.clk").read_text().startswith("# UTC")
+        # age the cache copy past the interval: refreshed
+        old = time.time() - 8 * 86400
+        os.utime(p, (old, old))
+        assert get_file("gps2utc.clk", update_interval_days=7.0
+                        ).read_text() == "v2\n"
+
+    def test_invalid_if_older_than(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        r, cache = repo
+        name = "T2runtime/clock/wsrt2gps.clk"
+        p = get_file(name, update_interval_days=1e9)
+        (r / name).write_text("v2\n")
+        # fresh enough for the interval, but force-invalidate via stamp
+        assert get_file(name, update_interval_days=1e9).read_text() != "v2\n"
+        assert get_file(name, update_interval_days=1e9,
+                        invalid_if_older_than=time.time() + 10
+                        ).read_text() == "v2\n"
+
+    def test_always_refreshes(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        r, _ = repo
+        get_file("time_gbt.dat", download_policy="always")
+        (r / "time_gbt.dat").write_text("v2\n")
+        assert get_file("time_gbt.dat", download_policy="always"
+                        ).read_text() == "v2\n"
+
+    def test_unknown_policy(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        with pytest.raises(ValueError):
+            get_file("gps2utc.clk", download_policy="sometimes")
+
+    def test_stale_cache_survives_missing_repo_file(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        r, _ = repo
+        p = get_file("time_gbt.dat")
+        (r / "time_gbt.dat").unlink()
+        old = time.time() - 86400
+        os.utime(p, (old, old))
+        # due for refresh but repo copy is gone: stale cache returned
+        assert get_file("time_gbt.dat", update_interval_days=0.5).exists()
+
+
+class TestLookupAndUpdateAll:
+    def test_lookup_via_index(self, repo):
+        from pint_tpu.observatory.global_clock_corrections import (
+            get_clock_correction_file)
+
+        p = get_clock_correction_file("wsrt2gps.clk")
+        assert p is not None and p.endswith("wsrt2gps.clk")
+        with pytest.raises(KeyError):
+            get_clock_correction_file("unknown.clk")
+
+    def test_lookup_without_repo_falls_back(self, tmp_path, monkeypatch):
+        from pint_tpu.observatory.global_clock_corrections import (
+            get_clock_correction_file)
+
+        monkeypatch.delenv("PINT_CLOCK_REPO", raising=False)
+        d = tmp_path / "plain"
+        d.mkdir()
+        (d / "x.clk").write_text("data\n")
+        monkeypatch.setenv("PINT_CLOCK_DIR", str(d))
+        assert get_clock_correction_file("x.clk") == str(d / "x.clk")
+        assert get_clock_correction_file("y.clk") is None
+
+    def test_update_all_exports(self, repo, tmp_path):
+        from pint_tpu.observatory.global_clock_corrections import update_all
+
+        out = tmp_path / "export"
+        done = update_all(export_to=str(out))
+        assert set(done) == {"index.txt", "gps2utc.clk", "wsrt2gps.clk",
+                             "time_gbt.dat"}
+        assert (out / "wsrt2gps.clk").exists()
+
+    def test_http_repo_rejected(self, repo, monkeypatch):
+        from pint_tpu.observatory.global_clock_corrections import (
+            get_clock_correction_file)
+
+        monkeypatch.setenv("PINT_CLOCK_REPO", "https://example.com/repo")
+        monkeypatch.delenv("PINT_CLOCK_DIR", raising=False)
+        # network repos are refused in zero-egress; falls back to None
+        assert get_clock_correction_file("gps2utc.clk") is None

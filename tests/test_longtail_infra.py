@@ -109,11 +109,13 @@ class TestGlobalClock:
         (d / "index.txt").write_text(
             "# file interval invalid\ntime_gbt.dat 7.0\n")
         monkeypatch.setenv("PINT_CLOCK_DIR", str(d))
+        monkeypatch.delenv("PINT_CLOCK_REPO", raising=False)
+        monkeypatch.setenv("PINT_CLOCK_CACHE", str(tmp_path / "cache"))
         assert str(d) in clock_search_dirs()
         assert get_clock_correction_file("time_gbt.dat") is not None
         assert get_clock_correction_file("missing.dat") is None
-        idx = Index(str(d / "index.txt"))
-        assert idx.files["time_gbt.dat"]["update_interval_days"] == 7.0
+        idx = Index(url_base=str(d))
+        assert idx.files["time_gbt.dat"].update_interval_days == 7.0
 
 
 class TestBTPiecewise:

@@ -1,0 +1,176 @@
+"""Extended light-curve primitive set (reference ``templates/lcprimitives.py``
+long tail: two-sided shapes, King, Harmonic, empirical Fourier/KDE profiles,
+primitive conversion, gradient checks)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.templates.lcprimitives import (LCEmpiricalFourier, LCGaussian,
+                                             LCGaussian2, LCHarmonic,
+                                             LCKernelDensity, LCKing,
+                                             LCLorentzian, LCLorentzian2,
+                                             LCTopHat, LCVonMises,
+                                             approx_gradient, check_gradient,
+                                             convert_primitive)
+from pint_tpu.templates.lctemplate import LCTemplate
+
+GRID = np.linspace(0.0, 1.0, 4001)
+
+
+def _integral(prim):
+    return float(np.trapezoid(np.asarray(prim(GRID)), GRID))
+
+
+class TestNewPrimitives:
+    @pytest.mark.parametrize("prim", [
+        LCGaussian2([0.02, 0.05, 0.4]),
+        LCLorentzian2([0.02, 0.05, 0.4]),
+        LCKing([0.03, 5.0, 0.4]),
+        LCHarmonic([0.3], order=2),
+        LCGaussian([0.03, 0.5]),
+        LCVonMises([0.03, 0.5]),
+    ])
+    def test_unit_integral(self, prim):
+        assert _integral(prim) == pytest.approx(1.0, abs=5e-3)
+
+    def test_two_sided_asymmetry(self):
+        g2 = LCGaussian2([0.01, 0.05, 0.5])
+        # right side falls slower than the left
+        assert float(g2(np.array([0.55]))[0]) > float(g2(np.array([0.45]))[0])
+        l2 = LCLorentzian2([0.01, 0.05, 0.5])
+        assert float(l2(np.array([0.55]))[0]) > float(l2(np.array([0.45]))[0])
+        # peak continuity: values just left/right of the mode agree
+        eps = 1e-6
+        lo, hi = g2(np.array([0.5 - eps]))[0], g2(np.array([0.5 + eps]))[0]
+        assert float(lo) == pytest.approx(float(hi), rel=1e-3)
+
+    def test_hwhm(self):
+        g = LCGaussian([0.03, 0.5])
+        assert g.hwhm() == pytest.approx(0.03 * np.sqrt(2 * np.log(2)))
+        l = LCLorentzian([0.03, 0.5])
+        # HWHM of the Lorentzian is gamma by definition
+        peak = float(l(np.array([0.5]))[0])
+        half = float(l(np.array([0.5 + l.hwhm()]))[0])
+        assert half == pytest.approx(peak / 2, rel=5e-2)
+        k = LCKing([0.03, 5.0, 0.5])
+        peak = float(k(np.array([0.5]))[0])
+        half = float(k(np.array([0.5 + k.hwhm()]))[0])
+        assert half == pytest.approx(peak / 2, rel=5e-2)
+
+    def test_harmonic_orthonormality(self):
+        h = LCHarmonic([0.2], order=3)
+        assert _integral(h) == pytest.approx(1.0, abs=1e-6)
+        # peak at the location
+        assert float(h(np.array([0.2]))[0]) == pytest.approx(3.0)
+
+    def test_gradients_match_autodiff(self):
+        for prim in (LCGaussian([0.04, 0.3]), LCGaussian2([0.03, 0.06, 0.3]),
+                     LCLorentzian([0.04, 0.3]),
+                     LCLorentzian2([0.03, 0.06, 0.3]),
+                     LCVonMises([0.04, 0.3])):
+            assert check_gradient(prim, n=50), type(prim).__name__
+
+    def test_approx_gradient_shape(self):
+        g = LCGaussian2([0.03, 0.06, 0.3])
+        J = approx_gradient(g, np.linspace(0, 1, 17))
+        assert J.shape == (3, 17)
+
+
+class TestEmpiricalProfiles:
+    def test_empirical_fourier_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(3)
+        truth = LCGaussian([0.05, 0.6])
+        phases = truth.random(20000, rng=rng)
+        ef = LCEmpiricalFourier(phases=phases, nharm=16)
+        assert _integral(ef) == pytest.approx(1.0, abs=1e-3)
+        # reconstructed profile peaks near the truth peak
+        assert abs(GRID[np.argmax(np.asarray(ef(GRID)))] - 0.6) < 0.02
+        # file round trip
+        f = tmp_path / "fourier.txt"
+        ef.to_file(f)
+        ef2 = LCEmpiricalFourier(input_file=str(f))
+        assert np.allclose(ef2.alphas, ef.alphas)
+        assert np.allclose(np.asarray(ef2(GRID)), np.asarray(ef(GRID)))
+        # shift parameter rotates the profile
+        ef.p[0] = 0.25
+        assert abs((GRID[np.argmax(np.asarray(ef(GRID)))] - 0.85) % 1.0) < 0.02
+
+    def test_kernel_density(self):
+        rng = np.random.default_rng(5)
+        truth = LCGaussian([0.04, 0.3])
+        kde = LCKernelDensity(phases=truth.random(20000, rng=rng))
+        assert _integral(kde) == pytest.approx(1.0, abs=5e-3)
+        assert abs(GRID[np.argmax(np.asarray(kde(GRID)))] - 0.3) < 0.03
+        # density tracks the truth to a few percent at the peak
+        tr = np.asarray(truth(GRID))
+        est = np.asarray(kde(GRID))
+        assert np.max(np.abs(est - tr)) / np.max(tr) < 0.15
+
+
+class TestConvertPrimitive:
+    def test_location_and_hwhm_preserved(self):
+        g = LCGaussian([0.03, 0.4])
+        l = convert_primitive(g, LCLorentzian)
+        assert isinstance(l, LCLorentzian)
+        assert l.get_location() == pytest.approx(0.4)
+        assert l.hwhm() == pytest.approx(g.hwhm(), rel=1e-12)
+        g2 = convert_primitive(LCLorentzian2([0.02, 0.05, 0.4]), LCGaussian2)
+        assert isinstance(g2, LCGaussian2)
+        assert g2.hwhm(False) == pytest.approx(0.02 * 0 + LCLorentzian2(
+            [0.02, 0.05, 0.4]).hwhm(False), rel=1e-12)
+        back = convert_primitive(g2, LCGaussian)
+        assert back.get_location() == pytest.approx(0.4)
+
+
+class TestSampling:
+    def test_primitive_random_matches_pdf(self):
+        rng = np.random.default_rng(11)
+        for prim in (LCGaussian([0.05, 0.5]), LCVonMises([0.05, 0.5]),
+                     LCGaussian2([0.03, 0.08, 0.5]), LCTopHat([0.2, 0.5])):
+            draws = prim.random(40000, rng=rng)
+            assert ((draws >= 0) & (draws < 1)).all()
+            hist, edges = np.histogram(draws, bins=50, range=(0, 1),
+                                       density=True)
+            centers = 0.5 * (edges[:-1] + edges[1:])
+            pdf = np.asarray(prim(centers))
+            # chi-like agreement: generous 10% of peak
+            assert np.max(np.abs(hist - pdf)) < 0.12 * np.max(pdf), \
+                type(prim).__name__
+
+    def test_king_is_jit_and_grad_compatible(self):
+        assert check_gradient(LCKing([0.03, 5.0, 0.4]), n=40)
+
+    def test_convert_rejects_unsupported_targets(self):
+        g = LCGaussian([0.03, 0.4])
+        with pytest.raises(ValueError):
+            convert_primitive(g, LCKing)
+        with pytest.raises(ValueError):
+            convert_primitive(g, LCHarmonic)
+
+    def test_kde_bandwidth_reestimated_per_fit(self):
+        rng = np.random.default_rng(17)
+        kde = LCKernelDensity(phases=rng.random(5000))  # broad -> big bw
+        broad_bw = kde.bw_used
+        kde.from_phases(LCGaussian([0.01, 0.5]).random(5000, rng=rng))
+        assert kde.bw_used < broad_bw / 3  # narrow data -> narrow bandwidth
+        assert kde.bw is None  # auto mode preserved
+
+    def test_harmonic_template_sampling_uses_rejection(self):
+        rng = np.random.default_rng(19)
+        t = LCTemplate([LCHarmonic([0.3], order=1)], [0.5])
+        draws = t.random(40000, rng=rng)
+        hist, edges = np.histogram(draws, bins=40, range=(0, 1), density=True)
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        pdf = np.asarray(t(centers))
+        assert (pdf >= 0).all()
+        assert np.max(np.abs(hist - pdf)) < 0.1 * np.max(pdf)
+
+    def test_template_multinomial_sampling(self):
+        rng = np.random.default_rng(13)
+        t = LCTemplate([LCGaussian([0.02, 0.25]), LCGaussian([0.04, 0.7])],
+                       [0.35, 0.35])
+        draws = t.random(60000, rng=rng)
+        hist, edges = np.histogram(draws, bins=50, range=(0, 1), density=True)
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        pdf = np.asarray(t(centers))
+        assert np.max(np.abs(hist - pdf)) < 0.12 * np.max(pdf)
