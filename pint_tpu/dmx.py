@@ -12,10 +12,10 @@ import numpy as np
 
 from pint_tpu.logging import log
 
-__all__ = ["DMXRange", "dmx_ranges", "dmxparse", "xxxselections",
-           "dmxselections", "dmxstats", "get_prefix_timerange",
-           "get_prefix_timeranges", "find_prefix_bytime", "merge_dmx",
-           "split_dmx", "split_swx"]
+__all__ = ["DMXRange", "dmx_ranges", "dmx_setup", "dmxparse",
+           "xxxselections", "dmxselections", "dmxstats",
+           "get_prefix_timerange", "get_prefix_timeranges",
+           "find_prefix_bytime", "merge_dmx", "split_dmx", "split_swx"]
 
 
 class DMXRange:
@@ -90,6 +90,46 @@ def dmx_ranges(toas, divide_freq: float = 1000.0, binwidth: float = 15.0,
     log.info(f"dmx_ranges: {len(ranges)} bins cover {mask.sum()}/{len(mjds)} "
              f"TOAs")
     return mask, comp
+
+
+def dmx_setup(toas, minwidth_d: float = 10.0, mintoas: int = 1):
+    """Minimal DMX binning: bins at least ``minwidth_d`` days wide, each
+    holding at least ``mintoas`` TOAs, no frequency-coverage requirement
+    (reference ``utils.py:893``).  Accepts a TOAs object or an MJD array.
+    Returns (R1, R2, N) arrays of bin starts, ends, and TOA counts."""
+    mjds = np.sort(np.asarray(
+        toas.get_mjds() if hasattr(toas, "get_mjds") else toas,
+        dtype=np.float64))
+    R1: List[float] = []
+    R2: List[float] = []
+    i = 0
+    while i < len(mjds) - 1:
+        R1.append(mjds[i] if not R2 else R2[-1])
+        R2.append(R1[-1] + float(minwidth_d))
+        i = int(np.where(mjds <= R2[-1])[0].max())
+        # widen until the bin holds enough TOAs
+        while ((mjds >= R1[-1]) & (mjds < R2[-1])).sum() < mintoas:
+            i += 1
+            if i < len(mjds):
+                R2[-1] = mjds[i] + 1.0
+            else:
+                R2[-1] = mjds[i - 1] + 1.0
+                break
+    if R2 and (R2[-1] - R1[-1] < minwidth_d
+               or ((mjds >= R1[-1]) & (mjds < R2[-1])).sum() < mintoas):
+        # fold a too-short trailing bin into its neighbor
+        if len(R2) > 1:
+            R2[-2] = R2[-1]
+            R1.pop()
+            R2.pop()
+    if R2 and mjds[-1] >= R2[-1]:
+        # half-open bins would orphan a final TOA sitting exactly on the
+        # last boundary; widen the last bin so every TOA is covered
+        R2[-1] = mjds[-1] + 1e-6
+    R1a, R2a = np.asarray(R1), np.asarray(R2)
+    N = np.array([((mjds >= a) & (mjds < b)).sum() for a, b in zip(R1a, R2a)],
+                 dtype=int)
+    return R1a, R2a, N
 
 
 def xxxselections(model, toas, prefix: str = "DM") -> Dict[str, np.ndarray]:

@@ -23,9 +23,59 @@ import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 import numpy as np
 
-__all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived", "tuple_chisq"]
+__all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived",
+           "tuple_chisq", "tuple_chisq_derived", "WrappedFitter", "doonefit",
+           "hostinfo", "set_log"]
 
 _warned_executor = False
+
+
+def hostinfo() -> str:
+    """Host identification string for grid-run provenance (reference
+    ``gridutils.py:26``)."""
+    import platform
+
+    return " ".join(platform.uname())
+
+
+def set_log(logger_) -> None:
+    """Swap the module logger (reference ``gridutils.py:30``, used by the
+    reference to quiet pool workers; here a no-op hook kept for API
+    parity — there are no worker processes to reconfigure)."""
+
+
+class WrappedFitter:
+    """Fitter wrapper that freezes chosen parameters at given values before
+    fitting (reference ``gridutils.py:35``).  The on-device grid path
+    (:func:`grid_chisq`) supersedes this for bulk grids; the wrapper remains
+    for one-off frozen fits and API familiarity."""
+
+    def __init__(self, ftr, **fitargs):
+        self.ftr = ftr
+        self.fitargs = fitargs
+
+    def doonefit(self, parnames: Sequence[str], parvalues: Sequence[float],
+                 extraparnames: Sequence[str] = ()) -> Tuple[float, list]:
+        """Fit with ``parnames`` frozen at ``parvalues``; returns
+        (chi2, extra parameter values)."""
+        import copy
+
+        model = copy.deepcopy(self.ftr.model)
+        for name, value in zip(parnames, parvalues):
+            getattr(model, name).value = float(value)
+            getattr(model, name).frozen = True
+        f = type(self.ftr)(self.ftr.toas, model)
+        chi2 = float(f.fit_toas(**self.fitargs))
+        extras = [getattr(f.model, n).value for n in extraparnames]
+        return chi2, extras
+
+
+def doonefit(ftr, parnames: Sequence[str], parvalues: Sequence[float],
+             extraparnames: Sequence[str] = (),
+             **fitargs) -> Tuple[float, list]:
+    """One frozen-parameter fit (reference ``gridutils.py:112``)."""
+    return WrappedFitter(ftr, **fitargs).doonefit(parnames, parvalues,
+                                                  extraparnames)
 
 
 def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
@@ -116,7 +166,8 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
 
 def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                            fit_params: Optional[Sequence[str]] = None,
-                           niter: int = 4, chunk: int = 32):
+                           niter: int = 4, chunk: int = 32,
+                           grid_spans: Optional[Sequence[float]] = None):
     """GLS counterpart of :func:`build_grid_chi2_fn` for correlated-noise
     models (reference benchmark ``profiling/bench_chisq_grid.py`` semantics:
     a ``GLSFitter`` refit per grid point).
@@ -153,8 +204,60 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
     int0 = ph0.int_
 
-    grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk)
+    # --- hoist everything constant across grid points out of the trace ----
+    # (1) Linear-parameter Jacobian columns.  Most fit parameters (DMX bins,
+    #     jumps, FD, DM Taylor terms) enter the phase linearly, so their
+    #     design-matrix columns are CONSTANT; only genuinely nonlinear
+    #     parameters (spin, astrometry, binary) need re-deriving per
+    #     iteration.  Classify numerically: perturb every parameter (and the
+    #     grid values) and keep columns that move.  The final chi2 is exact
+    #     either way — the split only shapes the Gauss-Newton trajectory,
+    #     and nonlinear columns are still recomputed exactly.
+    J0_full = np.asarray(jac_fn(free_init, const_pv, batch, ctx))
+    J0 = J0_full[:, :nfit]
+    # perturbation scale: the step that moves the phase by ~1e-3 cycles RMS
+    # per parameter (a Gauss-Newton-step-like scale) — NOT max(|v|,1), which
+    # is catastrophically large for tiny-magnitude parameters like F1
+    col_rms = np.linalg.norm(J0_full, axis=0) / np.sqrt(J0_full.shape[0])
+    dp = 1e-3 / np.maximum(col_rms, 1e-300)
+    dp[col_rms == 0] = 0.0
+    # grid parameters sweep their full range, not a GN step: probe columns
+    # at the far end of the grid so cross-couplings (e.g. Shapiro M2/SINI
+    # into binary columns) are detected; a non-positive span (single-valued
+    # axis) falls back to the 10%-of-value heuristic
+    for gi in range(len(grid_params)):
+        gv = float(np.asarray(free_init)[nfit + gi])
+        span = 0.0
+        if grid_spans is not None and gi < len(grid_spans):
+            span = float(grid_spans[gi])
+        if span <= 0.0:
+            span = max(abs(gv) * 0.1, dp[nfit + gi])
+        dp[nfit + gi] = span
+    v_pert = np.asarray(free_init) + dp
+    J1 = np.asarray(jac_fn(jnp.asarray(v_pert), const_pv, batch,
+                           ctx))[:, :nfit]
+    dcol = np.linalg.norm(J1 - J0, axis=0)
+    ncol = np.linalg.norm(J0, axis=0)
+    nl_fit = np.nonzero(dcol > 1e-7 * (ncol + 1e-300))[0]
+    Jbase = jnp.asarray(J0)  # linear columns live here permanently
+    nl_all = nl_fit  # positions within the full value vector == fit positions
+    # (2) Noise-basis blocks of the normal equations and the Woodbury
+    #     Cholesky for the final chi2: U, phi, and the weights never change,
+    #     so U^T W U and chol(diag(1/phi) + U^T N^-1 U) are per-grid
+    #     constants (reference recomputes both per point,
+    #     ``fitter.py:2712``, ``utils.py:3069``).
+    UtWU = np.asarray(U).T @ (np.asarray(w)[:, None] * np.asarray(U))
+    unorms = np.sqrt(np.maximum(np.diag(UtWU), 1e-300))
+    Sigma = np.diag(1.0 / np.asarray(phi)) + np.asarray(U).T @ (
+        np.asarray(U) * np.asarray(w)[:, None])
+    cf_w = jnp.asarray(np.linalg.cholesky(Sigma))
+    UtWU = jnp.asarray(UtWU)
+    unorms = jnp.asarray(unorms)
+
+    grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk,
+                tuple(nl_fit))
     if grid_key not in model._cache:
+        nl_idx = jnp.asarray(nl_all, dtype=jnp.int32)
 
         def resid_seconds(values, const_pv, batch, ctx, int0, w, F0):
             ph, _ = eval_fn(values, const_pv, batch, ctx)
@@ -163,34 +266,48 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             return r / F0
 
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w,
-                       U, phi, F0):
-            from pint_tpu.utils import woodbury_dot
-
+                       U, phi, F0, Jbase, UtWU, unorms, cf_w):
             v = jnp.concatenate([free_init[:nfit], gvals])
             ones = jnp.ones((U.shape[0], 1))
+            phiinv_u = 1.0 / phi
             for _ in range(niter):
                 r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
-                J = jac_fn(v, const_pv, batch, ctx)[:, :nfit]
+                if len(nl_all):
+                    def frac_of(sub):
+                        ph, _ = eval_fn(v.at[nl_idx].set(sub), const_pv,
+                                        batch, ctx)
+                        return ph.frac
+                    Jnl = jax.jacfwd(frac_of)(v[nl_idx])
+                    J = Jbase.at[:, nl_idx].set(Jnl)
+                else:
+                    J = Jbase
                 M = -J / F0
-                A = jnp.concatenate([ones, M, U], axis=1)
-                norms = jnp.linalg.norm(A, axis=0)
-                norms = jnp.where(norms == 0, 1.0, norms)
-                A = A / norms
+                B = jnp.concatenate([ones, M], axis=1)  # timing block
+                WB = w[:, None] * B
+                BtWB = B.T @ WB
+                BtWU = WB.T @ U
+                bnorms = jnp.sqrt(jnp.maximum(jnp.diag(BtWB), 1e-300))
+                norms = jnp.concatenate([bnorms, unorms])
+                mtcm = jnp.block([[BtWB, BtWU], [BtWU.T, UtWU]]) \
+                    / jnp.outer(norms, norms)
                 phiinv = jnp.concatenate(
-                    [jnp.full(1 + nfit, 1e-40), 1.0 / phi]) / norms**2
-                mtcm = A.T @ (w[:, None] * A) + jnp.diag(phiinv)
-                mtcy = A.T @ (w * r)
+                    [jnp.full(1 + nfit, 1e-40), phiinv_u]) / norms**2
+                mtcm = mtcm + jnp.diag(phiinv)
+                wr = w * r
+                mtcy = jnp.concatenate([B.T @ wr, U.T @ wr]) / norms
                 L = jnp.linalg.cholesky(mtcm)
                 x = jsl.cho_solve((L, True), mtcy)
                 v = v.at[:nfit].add(x[1:1 + nfit] / norms[1:1 + nfit])
             r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
-            dot, _ = woodbury_dot(1.0 / w, U, phi, r, r)
-            return dot
+            # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
+            wr = w * r
+            z = jsl.solve_triangular(cf_w, U.T @ wr, lower=True)
+            return jnp.sum(r * wr) - z @ z
 
         model._cache[grid_key] = jax.jit(jax.vmap(
             chi2_point,
             in_axes=(0, None, None, None, None, None, None, None, None,
-                     None)))
+                     None, None, None, None, None)))
     vfn = model._cache[grid_key]
 
     def fn(points, sharding=None):
@@ -210,7 +327,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             if sharding is not None:
                 blk = jax.device_put(blk, sharding)
             c2 = vfn(blk, free_init, const_pv, batch, ctx, int0, w, U,
-                     phi, F0)
+                     phi, F0, Jbase, UtWU, unorms, cf_w)
             out.append(c2[:blk_size - pad] if pad else c2)
         return jnp.concatenate(out)
 
@@ -241,7 +358,17 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
-    fn, _ = build_grid_chi2_fn(model, toas, parnames, niter=niter)
+    if gls:
+        # span = farthest grid value from the model's current value, so a
+        # single distant point still probes the cross-coupling
+        spans = []
+        for p, g in zip(parnames, grids):
+            cur = float(getattr(model, p).value or 0.0)
+            spans.append(float(np.max(np.abs(g - cur))) if len(g) else 0.0)
+        fn, _ = build_grid_gls_chi2_fn(model, toas, parnames, niter=niter,
+                                       grid_spans=spans)
+    else:
+        fn, _ = build_grid_chi2_fn(model, toas, parnames, niter=niter)
     pts = jnp.asarray(mesh_pts)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -290,3 +417,18 @@ def tuple_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     pts = jnp.asarray(np.asarray(parvalues, dtype=np.float64))
     fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter)
     return np.asarray(fn(pts)), {}
+
+
+def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
+                        parvalues: Sequence, niter: int = 4,
+                        **kw) -> Tuple[np.ndarray, list, dict]:
+    """Chi2 at explicit tuples of *derived* quantities: model parameter i is
+    ``parfuncs[i](*point)`` (reference ``gridutils.py:771``)."""
+    model, toas = ftr.model, ftr.toas
+    raw = np.asarray(parvalues, dtype=np.float64)
+    pts = np.stack(
+        [np.asarray([f(*vals) for vals in raw], dtype=np.float64)
+         for f in parfuncs], axis=-1)
+    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter)
+    out_values = [raw[:, i] for i in range(raw.shape[1])]
+    return np.asarray(fn(jnp.asarray(pts))), out_values, {}

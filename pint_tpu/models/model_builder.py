@@ -120,9 +120,10 @@ class ModelBuilder:
         if has("SIFUNC") and "IFunc" in self.templates:
             chosen.append("IFunc")
         if has("CORRECT_TROPOSPHERE") and "TroposphereDelay" in self.templates:
-            ln = entries["CORRECT_TROPOSPHERE"][0]
-            if str(ln.value).upper().startswith(("Y", "T", "1")):
-                chosen.append("TroposphereDelay")
+            # always attach the component; its CORRECT_TROPOSPHERE bool gates
+            # the delay, so "N" parses cleanly instead of warning
+            # (reference model_builder semantics)
+            chosen.append("TroposphereDelay")
         # noise components
         if has("EFAC", "T2EFAC", "EQUAD", "T2EQUAD", "TNEQ") and "ScaleToaError" in self.templates:
             chosen.append("ScaleToaError")

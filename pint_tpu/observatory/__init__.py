@@ -29,7 +29,9 @@ from pint_tpu.utils import PosVel
 
 __all__ = ["Observatory", "TopoObs", "BarycenterObs", "GeocenterObs",
            "T2SpacecraftObs",
-           "get_observatory", "list_observatories"]
+           "get_observatory", "list_observatories",
+           "update_clock_files", "export_all_clock_files",
+           "load_observatories", "load_observatories_from_usual_locations"]
 
 _registry: Dict[str, "Observatory"] = {}
 _alias_map: Dict[str, str] = {}
@@ -57,6 +59,13 @@ class Observatory:
         if key in _alias_map:
             return _registry[_alias_map[key]]
         raise KeyError(f"Unknown observatory {name!r}")
+
+    @classmethod
+    def clear_registry(cls):
+        """Empty the registry (reference ``Observatory.clear_registry``);
+        the builtins reload on the next lookup."""
+        _registry.clear()
+        _alias_map.clear()
 
     # -- clock chain -------------------------------------------------------
     def _site_clock_files(self, limits: str = "warn") -> List[ClockFile]:
@@ -261,14 +270,18 @@ class BarycenterObs(Observatory):
 
 
 def _ensure_builtin():
+    import os
+
     if "gbt" in _registry:
         return
-    GeocenterObs()
-    BarycenterObs()
-    T2SpacecraftObs()
-    for name, (x, y, z, tc, ic, aliases, clk, fmt) in SITES.items():
-        TopoObs(name, (x, y, z), tempo_code=tc, itoa_code=ic, aliases=aliases,
-                clock_files=clk, clock_fmt=fmt)
+    _ensure_builtin_sites_only()
+    if os.environ.get("PINT_OBS_OVERRIDE"):
+        try:
+            load_observatories(os.environ["PINT_OBS_OVERRIDE"],
+                               overwrite=True)
+        except Exception as e:
+            log.warning(f"Failed to load $PINT_OBS_OVERRIDE "
+                        f"({os.environ['PINT_OBS_OVERRIDE']}): {e}")
 
 
 def get_observatory(name: str, include_gps=None, include_bipm=None,
@@ -293,3 +306,164 @@ def get_observatory(name: str, include_gps=None, include_bipm=None,
 def list_observatories() -> List[str]:
     _ensure_builtin()
     return sorted(_registry)
+
+
+def load_observatories(filename, overwrite: bool = False) -> List[str]:
+    """Register :class:`TopoObs` sites from a JSON definition file using the
+    reference's ``observatories.json`` schema (reference ``topo_obs.py:457``):
+    per-site ``itrf_xyz`` (meters) plus optional ``tempo_code`` /
+    ``itoa_code`` / ``aliases`` / ``clock_file``(s) / ``clock_fmt`` /
+    ``apply_gps2utc`` / ``bipm_version`` / ``fullname`` / ``origin``.
+
+    With ``overwrite=False`` redefining an existing site raises ValueError
+    (unless the entry itself carries ``"overwrite": true``).  Returns the
+    registered names.
+    """
+    import json
+
+    from pint_tpu.utils import open_or_use
+
+    with open_or_use(filename, "r") as f:
+        defs = json.load(f)
+    _ensure_builtin_sites_only()
+    added = []
+    for name, d in defs.items():
+        key = name.lower()
+        allow = overwrite or bool(d.get("overwrite", False))
+        if key in _registry and not allow:
+            raise ValueError(
+                f"Observatory {name!r} already present; pass overwrite=True "
+                "to replace it")
+        if key in _registry:
+            old = _registry.pop(key)
+            for a, tgt in list(_alias_map.items()):
+                if tgt == key:
+                    _alias_map.pop(a)
+        if "itrf_xyz" not in d:
+            raise ValueError(f"Observatory {name!r} has no itrf_xyz")
+        clk = d.get("clock_file", d.get("clock_files", ()))
+        if isinstance(clk, str):
+            clk = [clk]
+        kw = {}
+        if "apply_gps2utc" in d:
+            kw["include_gps"] = bool(d["apply_gps2utc"])
+        if "bipm_version" in d:
+            kw["bipm_version"] = d["bipm_version"]
+        obs = TopoObs(name, d["itrf_xyz"],
+                      tempo_code=d.get("tempo_code", ""),
+                      itoa_code=d.get("itoa_code", ""),
+                      aliases=d.get("aliases", ()),
+                      clock_files=list(clk),
+                      clock_fmt=d.get("clock_fmt", "tempo"), **kw)
+        obs.fullname = d.get("fullname", name)
+        origin = d.get("origin", "")
+        obs.origin = "\n".join(origin) if isinstance(origin, list) else origin
+        added.append(obs.name)
+    return added
+
+
+def _ensure_builtin_sites_only():
+    """_ensure_builtin minus the $PINT_OBS_OVERRIDE hook (which would
+    recurse through load_observatories)."""
+    if "gbt" in _registry:
+        return
+    GeocenterObs()
+    BarycenterObs()
+    T2SpacecraftObs()
+    for name, (x, y, z, tc, ic, aliases, clk, fmt) in SITES.items():
+        TopoObs(name, (x, y, z), tempo_code=tc, itoa_code=ic, aliases=aliases,
+                clock_files=clk, clock_fmt=fmt)
+
+
+def load_observatories_from_usual_locations(clear: bool = False) -> List[str]:
+    """Builtins + ``$PINT_OBS_OVERRIDE`` (reference ``topo_obs.py:491``);
+    ``clear=True`` resets the registry first."""
+    import os
+
+    if clear:
+        Observatory.clear_registry()
+    _ensure_builtin_sites_only()
+    if os.environ.get("PINT_OBS_OVERRIDE"):
+        return load_observatories(os.environ["PINT_OBS_OVERRIDE"],
+                                  overwrite=True)
+    return []
+
+
+def update_clock_files(bipm_versions: Optional[List[str]] = None) -> List[str]:
+    """Refresh every clock file the registered observatories use from the
+    global repository cache (reference ``observatory/__init__.py:802``).
+
+    Covers each site's own clock files plus ``gps2utc.clk`` and the
+    ``tai2tt_<version>.clk`` files for in-use (and any extra requested) BIPM
+    versions.  Files the repository cannot provide are skipped with a
+    warning.  Returns the refreshed names.
+    """
+    from pint_tpu.observatory import clock_file as _cf
+    from pint_tpu.observatory import global_clock_corrections as _gcc
+
+    _ensure_builtin()
+    names: Dict[str, None] = {}
+    versions = set(v.lower() for v in (bipm_versions or []))
+    for obs in _registry.values():
+        for n in getattr(obs, "clock_file_names", []):
+            names[n] = None
+        if obs.include_gps:
+            names["gps2utc.clk"] = None
+        if obs.include_bipm:
+            versions.add(obs.bipm_version.lower())
+    for v in versions:
+        names[f"tai2tt_{v}.clk"] = None
+    done = []
+    index = _gcc.Index() if _gcc._repo_dir(None) is not None else None
+    for n in names:
+        try:
+            if index is not None:
+                details = index.files[n]
+                path = _gcc.get_file(
+                    details.file,
+                    update_interval_days=details.update_interval_days,
+                    download_policy="if_expired",
+                    invalid_if_older_than=details.invalid_if_older_than)
+            else:
+                path = _gcc.get_clock_correction_file(
+                    n, download_policy="if_expired")
+        except KeyError:
+            log.warning(f"update_clock_files: {n} not in the repository index")
+            continue
+        except FileNotFoundError:
+            log.warning(f"update_clock_files: {n} listed in the index but "
+                        "not available from the repository; skipped")
+            continue
+        if path is not None:
+            done.append(n)
+    # refreshed copies must win over memoized parses of the old ones
+    _cf._cache.clear()
+    return done
+
+
+def export_all_clock_files(directory) -> List[str]:
+    """Write every clock file loaded in this session to *directory*
+    (reference ``topo_obs.py:425``): point $PINT_CLOCK_OVERRIDE at the
+    result to pin exactly these versions.  Returns the written paths."""
+    import os
+
+    from pint_tpu.observatory import clock_file as _cf
+
+    os.makedirs(directory, exist_ok=True)
+    out = []
+    for (name, fmt, _vbe), cf in _cf._cache.items():
+        if cf is None:
+            continue
+        dest = os.path.join(directory, os.path.basename(name))
+        if dest in out:
+            log.warning(
+                f"export_all_clock_files: {os.path.basename(name)} is "
+                f"loaded more than once (different format options); only "
+                "the first parse was exported")
+            continue
+        if fmt == "tempo2":
+            cf.write_tempo2_clock_file(dest)
+        else:
+            cf.write_tempo_clock_file(dest)
+        out.append(dest)
+    return out

@@ -156,6 +156,15 @@ def _clock_search_paths() -> List[str]:
     for env in ("TEMPO", "TEMPO2"):
         if os.environ.get(env):
             paths.append(os.path.join(os.environ[env], "clock"))
+    # the global-repository cache (populated by update_clock_files /
+    # get_clock_correction_file / update_all) participates in the live
+    # chain whenever it exists — explicit url_base= calls populate it
+    # without either env var being set
+    cache = os.environ.get(
+        "PINT_CLOCK_CACHE",
+        os.path.join(os.path.expanduser("~"), ".pint_tpu", "clock_cache"))
+    if os.path.isdir(cache):
+        paths.append(cache)
     paths.append(os.path.join(os.path.dirname(__file__), "..", "data", "clock"))
     return [p for p in paths if os.path.isdir(p)]
 

@@ -161,3 +161,46 @@ class TestLookupAndUpdateAll:
         monkeypatch.delenv("PINT_CLOCK_DIR", raising=False)
         # network repos are refused in zero-egress; falls back to None
         assert get_clock_correction_file("gps2utc.clk") is None
+
+
+class TestObservatoryIntegration:
+    def test_update_and_export_clock_files(self, repo, tmp_path, monkeypatch):
+        """Repository -> cache -> live clock chain -> export round trip
+        (reference observatory/__init__.py:802, topo_obs.py:425)."""
+        import numpy as np
+
+        from pint_tpu.observatory import (export_all_clock_files,
+                                          get_observatory,
+                                          update_clock_files)
+        from pint_tpu.observatory import clock_file as _cf
+
+        r, cache = repo
+        # a GBT site file the repo provides in tempo format
+        (r / "time_gbt.dat").write_text(
+            "   50000.00000 0.00\n   51000.00000 2.00\n")
+        _cf._cache.clear()
+        done = update_clock_files(bipm_versions=["BIPM2019"])
+        assert "time_gbt.dat" in done and "gps2utc.clk" in done
+        # the chain now finds the cached copies: nonzero corrections
+        gbt = get_observatory("gbt")
+        corr = gbt.clock_corrections(np.array([50500.0]), include_bipm=False)
+        # site file contributes 1 us at the midpoint + gps2utc 1.5e-6ish
+        assert corr[0] != 0.0
+        out = export_all_clock_files(tmp_path / "exported")
+        assert any(p.endswith("time_gbt.dat") for p in out)
+        assert any(p.endswith("gps2utc.clk") for p in out)
+        _cf._cache.clear()
+
+    def test_update_skips_files_missing_from_repo(self, repo):
+        """Regression: a file listed in index.txt but absent from the
+        repository is skipped with a warning, not a crash."""
+        from pint_tpu.observatory import update_clock_files
+        from pint_tpu.observatory import clock_file as _cf
+
+        r, _ = repo
+        (r / "gps2utc.clk").unlink()  # listed in the index, now absent
+        _cf._cache.clear()
+        done = update_clock_files()
+        assert "gps2utc.clk" not in done
+        assert "time_gbt.dat" in done
+        _cf._cache.clear()

@@ -207,3 +207,61 @@ class TestTCBConversion:
         convert_tcb_tdb(m, backwards=True)
         assert float(m.F0.value) == pytest.approx(f0_tcb, rel=1e-14)
         assert float(m.PEPOCH.value) == pytest.approx(pepoch_tcb, abs=1e-9)
+
+
+class TestLoadObservatories:
+    def test_json_loader_and_override(self, tmp_path, monkeypatch):
+        """Custom observatory JSON + $PINT_OBS_OVERRIDE (reference
+        topo_obs.py:457,491 schema)."""
+        import json
+
+        import numpy as np
+
+        from pint_tpu.observatory import (Observatory, get_observatory,
+                                          load_observatories,
+                                          load_observatories_from_usual_locations)
+
+        defs = {
+            "mytelescope": {
+                "itrf_xyz": [882589.289, -4924872.368, 3943729.418],
+                "tempo_code": "z",
+                "aliases": ["myt"],
+                "clock_file": "time_myt.dat",
+                "apply_gps2utc": False,
+                "fullname": "My Telescope",
+                "origin": ["line one", "line two"],
+            }
+        }
+        p = tmp_path / "obs.json"
+        p.write_text(json.dumps(defs))
+        added = load_observatories(str(p))
+        assert added == ["mytelescope"]
+        o = get_observatory("myt")
+        assert o.name == "mytelescope"
+        assert o.include_gps is False
+        assert o.origin == "line one\nline two"
+        assert np.allclose(o.itrf_xyz, defs["mytelescope"]["itrf_xyz"])
+        # redefinition without overwrite raises; with overwrite succeeds
+        import pytest as _pt
+
+        with _pt.raises(ValueError):
+            load_observatories(str(p))
+        defs["mytelescope"]["itrf_xyz"][0] += 1.0
+        p.write_text(json.dumps(defs))
+        load_observatories(str(p), overwrite=True)
+        assert get_observatory("mytelescope").itrf_xyz[0] == \
+            882589.289 + 1.0
+        # override an existing builtin via the env var
+        gbt_xyz = list(get_observatory("gbt").itrf_xyz)
+        defs2 = {"gbt": {"itrf_xyz": [gbt_xyz[0] + 0.5, gbt_xyz[1],
+                                      gbt_xyz[2]],
+                         "clock_file": "time_gbt.dat"}}
+        p2 = tmp_path / "override.json"
+        p2.write_text(json.dumps(defs2))
+        monkeypatch.setenv("PINT_OBS_OVERRIDE", str(p2))
+        load_observatories_from_usual_locations(clear=True)
+        assert get_observatory("gbt").itrf_xyz[0] == gbt_xyz[0] + 0.5
+        # restore pristine registry for other tests
+        monkeypatch.delenv("PINT_OBS_OVERRIDE")
+        Observatory.clear_registry()
+        assert np.allclose(get_observatory("gbt").itrf_xyz, gbt_xyz)

@@ -79,10 +79,13 @@ class TestIO:
 class TestModelBuild:
     def test_components(self, model):
         # SOLARN0 0.00 in the par selects SolarWindDispersion (as in the
-        # reference, where SOLARN0 is an NE_SW alias)
+        # reference, where SOLARN0 is an NE_SW alias); CORRECT_TROPOSPHERE N
+        # attaches TroposphereDelay with the correction disabled
         assert set(model.components) == {
             "AstrometryEquatorial", "Spindown", "SolarSystemShapiro",
-            "DispersionDM", "AbsPhase", "SolarWindDispersion"}
+            "DispersionDM", "AbsPhase", "SolarWindDispersion",
+            "TroposphereDelay"}
+        assert bool(model.CORRECT_TROPOSPHERE.value) is False
 
     def test_free_params(self, model):
         assert set(model.free_params) == {"RAJ", "DECJ", "F0", "F1", "DM"}

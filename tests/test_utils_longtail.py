@@ -400,3 +400,37 @@ class TestUtilsLazyReexports:
             assert callable(getattr(u, name)), name
         with pytest.raises(AttributeError):
             u.no_such_helper
+
+
+class TestDmxSetup:
+    def test_minimal_binning(self):
+        from pint_tpu.dmx import dmx_setup
+
+        rng = np.random.default_rng(23)
+        mjds = np.sort(np.concatenate(
+            [55000 + 30 * k + rng.random(3) * 2 for k in range(8)]))
+        R1, R2, N = dmx_setup(mjds, minwidth_d=10.0, mintoas=1)
+        assert len(R1) == len(R2) == len(N)
+        assert (R2 - R1 >= 10.0 - 1e-9).all()
+        assert (N >= 1).all()
+        assert N.sum() == len(mjds)  # every TOA covered, incl. the last
+        # bins are contiguous
+        assert np.allclose(R1[1:], R2[:-1])
+
+    def test_regular_cadence_covers_final_toa(self):
+        """Regression: a TOA exactly on the last half-open boundary must
+        not be orphaned."""
+        from pint_tpu.dmx import dmx_setup
+
+        mjds = 55000.0 + np.arange(21.0)
+        R1, R2, N = dmx_setup(mjds, minwidth_d=10.0, mintoas=1)
+        assert N.sum() == 21
+        assert R2[-1] > mjds[-1]
+
+    def test_mintoas_widens_bins(self):
+        from pint_tpu.dmx import dmx_setup
+
+        mjds = np.array([55000.0, 55001.0, 55050.0, 55051.0, 55100.0,
+                         55101.0])
+        R1, R2, N = dmx_setup(mjds, minwidth_d=10.0, mintoas=2)
+        assert (N >= 2).all()
