@@ -102,6 +102,9 @@ def dmx_setup(toas, minwidth_d: float = 10.0, mintoas: int = 1):
         dtype=np.float64))
     R1: List[float] = []
     R2: List[float] = []
+    if len(mjds) == 1:
+        # the loop below never runs for a single TOA; seed its bin directly
+        R1, R2 = [mjds[0]], [mjds[0] + float(minwidth_d)]
     i = 0
     while i < len(mjds) - 1:
         R1.append(mjds[i] if not R2 else R2[-1])

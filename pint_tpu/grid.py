@@ -78,9 +78,43 @@ def doonefit(ftr, parnames: Sequence[str], parvalues: Sequence[float],
                                                   extraparnames)
 
 
+def _classify_linear_columns(jac_fn, free_init, const_pv, batch, ctx,
+                             nfit: int, ngrid: int,
+                             grid_spans: Optional[Sequence[float]] = None):
+    """Split fit-parameter design columns into (J0, nonlinear indices).
+
+    Columns that stay put (rel < 1e-7) when every parameter moves by a
+    ~1e-3-cycle phase step — and the grid axes sweep their span — are
+    constant and can be hoisted out of the per-point trace.  The final chi2
+    is exact regardless; only the Gauss-Newton trajectory is shaped by the
+    split.
+    """
+    J0_full = np.asarray(jac_fn(free_init, const_pv, batch, ctx))
+    J0 = J0_full[:, :nfit]
+    col_rms = np.linalg.norm(J0_full, axis=0) / np.sqrt(J0_full.shape[0])
+    dp = 1e-3 / np.maximum(col_rms, 1e-300)
+    dp[col_rms == 0] = 0.0
+    for gi in range(ngrid):
+        gv = float(np.asarray(free_init)[nfit + gi])
+        span = 0.0
+        if grid_spans is not None and gi < len(grid_spans):
+            span = float(grid_spans[gi])
+        if span <= 0.0:
+            span = max(abs(gv) * 0.1, dp[nfit + gi])
+        dp[nfit + gi] = span
+    v_pert = np.asarray(free_init) + dp
+    J1 = np.asarray(jac_fn(jnp.asarray(v_pert), const_pv, batch,
+                           ctx))[:, :nfit]
+    dcol = np.linalg.norm(J1 - J0, axis=0)
+    ncol = np.linalg.norm(J0, axis=0)
+    nl_fit = np.nonzero(dcol > 1e-7 * (ncol + 1e-300))[0]
+    return J0, nl_fit
+
+
 def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                        fit_params: Optional[Sequence[str]] = None,
-                       niter: int = 4):
+                       niter: int = 4,
+                       grid_spans: Optional[Sequence[float]] = None):
     """Return (fn, free_init) where fn(points (P, G)) -> chi2 (P,).
 
     ``fn`` refits ``fit_params`` at each grid point with ``niter`` Gauss-
@@ -94,7 +128,8 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     """
     if model.noise_basis_by_component(toas)[0]:
         return build_grid_gls_chi2_fn(model, toas, grid_params,
-                                      fit_params=fit_params, niter=niter)
+                                      fit_params=fit_params, niter=niter,
+                                      grid_spans=grid_spans)
     grid_params = tuple(grid_params)
     if fit_params is None:
         fit_params = tuple(p for p in model.free_params if p not in grid_params)
@@ -116,23 +151,40 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
     int0 = ph0.int_
 
+    # constant design columns hoisted out of the trace (same machinery as
+    # the GLS path; see _classify_linear_columns)
+    J0, nl_fit = _classify_linear_columns(
+        jac_fn, free_init, const_pv, batch, ctx, nfit, len(grid_params),
+        grid_spans)
+    Jbase = jnp.asarray(J0)
+
     # the jitted point-batch solver is cached on the model: all varying data
     # (parameter values, weights, batch, ctx) are traced ARGUMENTS, so
     # repeated grid_chisq calls — and the bench warmup — reuse one executable
-    grid_key = ("grid_fn", all_names, nfit, niter, len(toas))
+    grid_key = ("grid_fn", all_names, nfit, niter, len(toas), tuple(nl_fit))
     if grid_key not in model._cache:
+        nl_idx = jnp.asarray(nl_fit, dtype=jnp.int32)
 
         def resid_cycles(values, const_pv, batch, ctx, int0, w):
             ph, _ = eval_fn(values, const_pv, batch, ctx)
             r = (ph.int_ - int0) + ph.frac
             return r - jnp.sum(r * w) / jnp.sum(w)  # Offset subtraction
 
-        def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w, F0):
+        def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w, F0,
+                       Jbase):
             v = jnp.concatenate([free_init[:nfit], gvals])
             ones = jnp.ones((len(w), 1))
             for _ in range(niter):
                 r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
-                J = jac_fn(v, const_pv, batch, ctx)[:, :nfit]  # dfrac/dp
+                if len(nl_fit):
+                    def frac_of(sub):
+                        ph, _ = eval_fn(v.at[nl_idx].set(sub), const_pv,
+                                        batch, ctx)
+                        return ph.frac
+                    Jnl = jax.jacfwd(frac_of)(v[nl_idx])
+                    J = Jbase.at[:, nl_idx].set(Jnl)
+                else:
+                    J = Jbase
                 M = -J / F0  # design matrix, seconds per unit param
                 # explicit offset column: without it the step converges to a
                 # stationary point of the UNPROFILED objective, not the joint
@@ -155,11 +207,13 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
         # and the fused executable is what delivers the batched-fit
         # throughput, so the tradeoff goes the other way here.
         model._cache[grid_key] = jax.jit(jax.vmap(
-            chi2_point, in_axes=(0, None, None, None, None, None, None, None)))
+            chi2_point,
+            in_axes=(0, None, None, None, None, None, None, None, None)))
     vfn = model._cache[grid_key]
 
     def fn(points):
-        return vfn(points, free_init, const_pv, batch, ctx, int0, w, F0)
+        return vfn(points, free_init, const_pv, batch, ctx, int0, w, F0,
+                   Jbase)
 
     return fn, free_init
 
@@ -213,32 +267,9 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     #     grid values) and keep columns that move.  The final chi2 is exact
     #     either way — the split only shapes the Gauss-Newton trajectory,
     #     and nonlinear columns are still recomputed exactly.
-    J0_full = np.asarray(jac_fn(free_init, const_pv, batch, ctx))
-    J0 = J0_full[:, :nfit]
-    # perturbation scale: the step that moves the phase by ~1e-3 cycles RMS
-    # per parameter (a Gauss-Newton-step-like scale) — NOT max(|v|,1), which
-    # is catastrophically large for tiny-magnitude parameters like F1
-    col_rms = np.linalg.norm(J0_full, axis=0) / np.sqrt(J0_full.shape[0])
-    dp = 1e-3 / np.maximum(col_rms, 1e-300)
-    dp[col_rms == 0] = 0.0
-    # grid parameters sweep their full range, not a GN step: probe columns
-    # at the far end of the grid so cross-couplings (e.g. Shapiro M2/SINI
-    # into binary columns) are detected; a non-positive span (single-valued
-    # axis) falls back to the 10%-of-value heuristic
-    for gi in range(len(grid_params)):
-        gv = float(np.asarray(free_init)[nfit + gi])
-        span = 0.0
-        if grid_spans is not None and gi < len(grid_spans):
-            span = float(grid_spans[gi])
-        if span <= 0.0:
-            span = max(abs(gv) * 0.1, dp[nfit + gi])
-        dp[nfit + gi] = span
-    v_pert = np.asarray(free_init) + dp
-    J1 = np.asarray(jac_fn(jnp.asarray(v_pert), const_pv, batch,
-                           ctx))[:, :nfit]
-    dcol = np.linalg.norm(J1 - J0, axis=0)
-    ncol = np.linalg.norm(J0, axis=0)
-    nl_fit = np.nonzero(dcol > 1e-7 * (ncol + 1e-300))[0]
+    J0, nl_fit = _classify_linear_columns(
+        jac_fn, free_init, const_pv, batch, ctx, nfit, len(grid_params),
+        grid_spans)
     Jbase = jnp.asarray(J0)  # linear columns live here permanently
     nl_all = nl_fit  # positions within the full value vector == fit positions
     # (2) Noise-basis blocks of the normal equations and the Woodbury
@@ -248,8 +279,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     #     ``fitter.py:2712``, ``utils.py:3069``).
     UtWU = np.asarray(U).T @ (np.asarray(w)[:, None] * np.asarray(U))
     unorms = np.sqrt(np.maximum(np.diag(UtWU), 1e-300))
-    Sigma = np.diag(1.0 / np.asarray(phi)) + np.asarray(U).T @ (
-        np.asarray(U) * np.asarray(w)[:, None])
+    Sigma = np.diag(1.0 / np.asarray(phi)) + UtWU
     cf_w = jnp.asarray(np.linalg.cholesky(Sigma))
     UtWU = jnp.asarray(UtWU)
     unorms = jnp.asarray(unorms)
@@ -358,17 +388,14 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
-    if gls:
-        # span = farthest grid value from the model's current value, so a
-        # single distant point still probes the cross-coupling
-        spans = []
-        for p, g in zip(parnames, grids):
-            cur = float(getattr(model, p).value or 0.0)
-            spans.append(float(np.max(np.abs(g - cur))) if len(g) else 0.0)
-        fn, _ = build_grid_gls_chi2_fn(model, toas, parnames, niter=niter,
-                                       grid_spans=spans)
-    else:
-        fn, _ = build_grid_chi2_fn(model, toas, parnames, niter=niter)
+    # span = farthest grid value from the model's current value, so a
+    # single distant point still probes the cross-coupling
+    spans = []
+    for p, g in zip(parnames, grids):
+        cur = float(getattr(model, p).value or 0.0)
+        spans.append(float(np.max(np.abs(g - cur))) if len(g) else 0.0)
+    fn, _ = build_grid_chi2_fn(model, toas, parnames, niter=niter,
+                               grid_spans=spans)
     pts = jnp.asarray(mesh_pts)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

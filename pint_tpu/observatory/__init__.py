@@ -326,7 +326,8 @@ def load_observatories(filename, overwrite: bool = False) -> List[str]:
     with open_or_use(filename, "r") as f:
         defs = json.load(f)
     _ensure_builtin_sites_only()
-    added = []
+    # validate EVERY entry before touching the registry, so a malformed
+    # file can never leave sites deleted or a partial load behind
     for name, d in defs.items():
         key = name.lower()
         allow = overwrite or bool(d.get("overwrite", False))
@@ -334,13 +335,20 @@ def load_observatories(filename, overwrite: bool = False) -> List[str]:
             raise ValueError(
                 f"Observatory {name!r} already present; pass overwrite=True "
                 "to replace it")
+        if "itrf_xyz" not in d:
+            raise ValueError(f"Observatory {name!r} has no itrf_xyz")
+        if len(np.atleast_1d(np.asarray(d["itrf_xyz"],
+                                        dtype=np.float64))) != 3:
+            raise ValueError(f"Observatory {name!r} itrf_xyz must be "
+                             "3 numbers (meters)")
+    added = []
+    for name, d in defs.items():
+        key = name.lower()
         if key in _registry:
-            old = _registry.pop(key)
+            _registry.pop(key)
             for a, tgt in list(_alias_map.items()):
                 if tgt == key:
                     _alias_map.pop(a)
-        if "itrf_xyz" not in d:
-            raise ValueError(f"Observatory {name!r} has no itrf_xyz")
         clk = d.get("clock_file", d.get("clock_files", ()))
         if isinstance(clk, str):
             clk = [clk]

@@ -265,3 +265,27 @@ class TestLoadObservatories:
         monkeypatch.delenv("PINT_OBS_OVERRIDE")
         Observatory.clear_registry()
         assert np.allclose(get_observatory("gbt").itrf_xyz, gbt_xyz)
+
+    def test_malformed_override_leaves_registry_intact(self, tmp_path):
+        """Regression: a bad entry must not delete the builtin site."""
+        import json
+
+        import pytest as _pt
+
+        from pint_tpu.observatory import get_observatory, load_observatories
+
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"gbt": {"overwrite": True}}))  # no itrf_xyz
+        before = list(get_observatory("gbt").itrf_xyz)
+        with _pt.raises(ValueError):
+            load_observatories(str(p))
+        assert list(get_observatory("gbt").itrf_xyz) == before
+        # partial load: first entry valid, second invalid -> nothing applied
+        p.write_text(json.dumps({
+            "newsite": {"itrf_xyz": [1.0, 2.0, 3.0]},
+            "badsite": {"itrf_xyz": [1.0]},
+        }))
+        with _pt.raises(ValueError):
+            load_observatories(str(p))
+        with _pt.raises(KeyError):
+            get_observatory("newsite")

@@ -434,3 +434,10 @@ class TestDmxSetup:
                          55101.0])
         R1, R2, N = dmx_setup(mjds, minwidth_d=10.0, mintoas=2)
         assert (N >= 2).all()
+
+    def test_single_toa(self):
+        from pint_tpu.dmx import dmx_setup
+
+        R1, R2, N = dmx_setup(np.array([55000.0]), minwidth_d=10.0)
+        assert len(R1) == 1 and N.tolist() == [1]
+        assert R1[0] <= 55000.0 < R2[0]
