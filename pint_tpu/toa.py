@@ -31,7 +31,8 @@ from pint_tpu.io.tim import RawTOA, format_toa_line, read_tim_file
 from pint_tpu.logging import log
 from pint_tpu.observatory import get_observatory
 
-__all__ = ["TOAs", "TOABatch", "get_TOAs", "merge_TOAs", "make_single_toa"]
+__all__ = ["TOA", "TOAs", "TOABatch", "get_TOAs", "get_TOAs_list",
+           "get_TOAs_array", "merge_TOAs", "make_single_toa"]
 
 C_KM_S = C_M_S / 1e3
 DAY_S = 86400.0
@@ -172,6 +173,25 @@ class TOAs:
     def ntoas(self) -> int:
         return len(self)
 
+    def get_clusters(self, gap_limit_hr: float = 2.0,
+                     add_column: bool = False) -> np.ndarray:
+        """Cluster TOAs into observing epochs separated by gaps longer than
+        ``gap_limit_hr`` hours (reference ``toa.py get_clusters`` /
+        ``_cluster_by_gaps``).  Returns the per-TOA cluster index (clusters
+        numbered in time order); with ``add_column`` the index is also
+        stamped as a ``-cluster`` flag."""
+        mjds = np.asarray(self.get_mjds(), dtype=np.float64)
+        order = np.argsort(mjds, kind="stable")
+        gaps = np.diff(mjds[order]) > gap_limit_hr / 24.0
+        cluster_sorted = np.concatenate([[0], np.cumsum(gaps)])
+        clusters = np.empty(len(mjds), dtype=np.int64)
+        clusters[order] = cluster_sorted
+        if add_column:
+            for i, c in enumerate(clusters):
+                self.flags[i]["cluster"] = str(int(c))
+            self._version += 1
+        return clusters
+
     def __getitem__(self, index) -> "TOAs":
         idx = np.atleast_1d(np.arange(len(self))[index])
         new = replace(
@@ -180,7 +200,9 @@ class TOAs:
             error_us=self.error_us[idx],
             freq_mhz=self.freq_mhz[idx],
             obs=self.obs[idx],
-            flags=[self.flags[i] for i in idx],
+            # per-TOA dicts are copied: flag edits on a slice (get_clusters
+            # add_column, gui jumps) must not leak into the parent
+            flags=[dict(self.flags[i]) for i in idx],
         )
         for name in ("clock_corr_s", "tdb", "utc_mjd_lo", "tdb_lo",
                      "ssb_obs_pos_km", "ssb_obs_vel_kms",
@@ -493,12 +515,10 @@ def parse_clock_bipm(clock_value):
     return None, None
 
 
-def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
-             include_gps: bool = True, include_bipm: Optional[bool] = None,
-             bipm_version: str = "BIPM2021", model=None, limits: str = "warn",
-             usepickle: bool = False) -> TOAs:
-    """Load a tim file and run the full ingestion pipeline (reference
-    ``toa.py:109``)."""
+def _resolve_pipeline_options(model, ephem, planets, include_bipm,
+                              bipm_version):
+    """Fill ephem/planets/BIPM settings from the model the way get_TOAs
+    does (single source of truth for every TOAs constructor)."""
     if model is not None:
         if ephem is None and getattr(model, "EPHEM", None) is not None:
             ephem = str(model.EPHEM.value)
@@ -510,6 +530,28 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
             planets = bool(model.PLANET_SHAPIRO.value)
     if include_bipm is None:
         include_bipm = True
+    return ephem, planets, include_bipm, bipm_version
+
+
+def _finalize_toas(t: TOAs, ephem, planets, include_gps, include_bipm,
+                   bipm_version, limits) -> TOAs:
+    """Run the post-parse ingestion pipeline (clock chain, TDB, posvels)."""
+    t.apply_clock_corrections(include_gps=include_gps,
+                              include_bipm=include_bipm,
+                              bipm_version=bipm_version, limits=limits)
+    t.compute_TDBs(ephem=ephem or "DE440")
+    t.compute_posvels(ephem=ephem or "DE440", planets=planets)
+    return t
+
+
+def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
+             include_gps: bool = True, include_bipm: Optional[bool] = None,
+             bipm_version: str = "BIPM2021", model=None, limits: str = "warn",
+             usepickle: bool = False) -> TOAs:
+    """Load a tim file and run the full ingestion pipeline (reference
+    ``toa.py:109``)."""
+    ephem, planets, include_bipm, bipm_version = _resolve_pipeline_options(
+        model, ephem, planets, include_bipm, bipm_version)
     pickle_key = (ephem, planets, include_gps, include_bipm, bipm_version,
                   limits)
     if usepickle:
@@ -521,15 +563,178 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
     if not raw:
         raise ValueError(f"No TOAs found in {timfile}")
     t = TOAs.from_raw(raw, commands, filename=timfile)
-    t.apply_clock_corrections(include_gps=include_gps, include_bipm=include_bipm,
-                              bipm_version=bipm_version, limits=limits)
-    t.compute_TDBs(ephem=ephem or "DE440")
-    t.compute_posvels(ephem=ephem or "DE440", planets=planets)
+    _finalize_toas(t, ephem, planets, include_gps, include_bipm,
+                   bipm_version, limits)
     log.info(f"Loaded {len(t)} TOAs from {timfile} "
              f"(ephem={t.ephem}, planets={planets}, bipm={include_bipm})")
     if usepickle:
         _save_toa_pickle(timfile, pickle_key, t)
     return t
+
+
+class TOA:
+    """A single time of arrival (reference ``toa.py TOA``): programmatic
+    construction unit for :func:`get_TOAs_list`.
+
+    ``mjd`` may be a float MJD, an ``(int_part, frac_part)`` pair of floats
+    carried at full combined precision, or an ``"58000.0000123..."``
+    string.  Remaining attributes mirror the tim columns.
+    """
+
+    def __init__(self, mjd, error: float = 0.0, obs: str = "bary",
+                 freq: float = float("inf"), scale=None, flags=None,
+                 name: str = "unk", **kwargs):
+        self.mjd = mjd
+        self.error = float(error)
+        self.obs = obs
+        self.freq = float(freq)
+        if scale not in (None, "utc"):
+            # silently reinterpreting e.g. tdb input as site-UTC would shift
+            # the time by ~69 s through the clock chain; refuse loudly
+            raise NotImplementedError(
+                f"TOA scale={scale!r} is not supported: times are site-UTC "
+                "(the tim-file convention). Convert to UTC first.")
+        self.scale = scale
+        self.flags = dict(flags or {})
+        for k, v in kwargs.items():  # reference accepts flags as kwargs
+            self.flags.setdefault(k.lstrip("-"), str(v))
+        self.name = name
+
+    def __str__(self):
+        return (f"{self.mjd}: {self.error} us error at '{self.obs}' at "
+                f"{self.freq} MHz")
+
+    def as_line(self) -> str:
+        """This TOA as a tempo2-format tim line."""
+        hi, lo = _split_mjd_value(self.mjd)
+        total = hi + np.longdouble(lo or 0.0)
+        mjd_i = int(np.floor(total))
+        frac = float(total - np.longdouble(mjd_i))  # in [0, 1)
+        frac_str = f"{frac:.16f}"
+        if frac_str.startswith("1"):  # rounded up to the next day
+            mjd_i += 1
+            frac_str = "0.0000000000000000"
+        return format_toa_line(mjd_i, frac_str.split(".")[1],
+                               self.error, self.freq, self.obs,
+                               flags=self.flags, name=self.name)
+
+
+def _pair_split(a, b):
+    """(mjd1, mjd2) arrays/scalars -> (longdouble hi, float64 lo) with the
+    low-order word preserved on degraded-longdouble platforms.  Single
+    implementation shared by the scalar and array construction paths."""
+    hi = np.asarray(a, dtype=np.longdouble) + np.asarray(b, dtype=np.longdouble)
+    if np.finfo(np.longdouble).eps > 2e-19:
+        a64 = np.asarray(a, dtype=np.float64)
+        b64 = np.asarray(b, dtype=np.float64)
+        s = np.asarray(hi, dtype=np.float64)
+        lo = (a64 - s) + b64
+    else:
+        lo = np.zeros_like(np.asarray(hi, dtype=np.float64))
+    return hi, lo
+
+
+def _split_mjd_value(mjd):
+    """float | (i, f) pair | str -> (longdouble hi, float64 lo)."""
+    if isinstance(mjd, (tuple, list)) and len(mjd) == 2:
+        hi, lo = _pair_split(mjd[0], mjd[1])
+        return np.longdouble(hi), float(lo)
+    if isinstance(mjd, str):
+        i, _, f = mjd.partition(".")
+        r = RawTOA(mjd_int=int(i), mjd_frac_str=f or "0", error_us=0.0,
+                   freq_mhz=0.0, obs="bary")
+        if np.finfo(np.longdouble).eps > 2e-19:
+            # degraded longdouble: the native dd parser preserves the
+            # sub-double part, same as the tim-file path (_mjds_from_raw)
+            from pint_tpu import native
+
+            if native.available():
+                hi_, lo_ = native.str2dd_batch([f"{r.mjd_int}."
+                                                f"{r.mjd_frac_str}"])
+                return np.longdouble(hi_[0]), float(lo_[0])
+        return r.mjd_longdouble(), 0.0
+    return np.longdouble(mjd), 0.0
+
+
+def get_TOAs_list(toa_list, ephem: Optional[str] = None,
+                  planets: bool = False, include_gps: bool = True,
+                  include_bipm: Optional[bool] = None,
+                  bipm_version: str = "BIPM2021", model=None,
+                  limits: str = "warn", commands=None) -> TOAs:
+    """Build and prepare a TOAs object from :class:`TOA` objects (reference
+    ``toa.py get_TOAs_list``): same pipeline as :func:`get_TOAs` without a
+    tim file."""
+    ephem, planets, include_bipm, bipm_version = _resolve_pipeline_options(
+        model, ephem, planets, include_bipm, bipm_version)
+    n = len(toa_list)
+    if n == 0:
+        raise ValueError("get_TOAs_list: empty TOA list")
+    utc = np.empty(n, dtype=np.longdouble)
+    lo = np.zeros(n, dtype=np.float64)
+    err = np.empty(n, dtype=np.float64)
+    freq = np.empty(n, dtype=np.float64)
+    obs = np.empty(n, dtype=object)
+    flags = []
+    for i, tt in enumerate(toa_list):
+        utc[i], lo[i] = _split_mjd_value(tt.mjd)
+        err[i] = tt.error
+        freq[i] = tt.freq if tt.freq > 0 else np.inf
+        obs[i] = get_observatory(tt.obs).name
+        fl = dict(tt.flags)
+        if tt.name and tt.name != "unk":
+            fl.setdefault("name", tt.name)
+        flags.append(fl)
+    t = TOAs(utc, err, freq, obs, flags, list(commands or []), None)
+    if np.any(lo):
+        t.utc_mjd_lo = lo
+    return _finalize_toas(t, ephem, planets, include_gps, include_bipm,
+                          bipm_version, limits)
+
+
+def get_TOAs_array(times, obs: str, errors=1.0, freqs=np.inf, flags=None,
+                   ephem: Optional[str] = None, planets: bool = False,
+                   include_gps: bool = True,
+                   include_bipm: Optional[bool] = None,
+                   bipm_version: str = "BIPM2021", model=None,
+                   limits: str = "warn", **kwargs) -> TOAs:
+    """Build and prepare TOAs from arrays at a single observatory
+    (reference ``toa.py:2729``).  ``times`` is an MJD array or an
+    ``(mjd1, mjd2)`` pair of arrays summing to full precision; scalar
+    ``errors``/``freqs`` broadcast; ``flags`` is one dict for all TOAs or a
+    list of per-TOA dicts.  Remaining kwargs become shared flags."""
+    ephem, planets, include_bipm, bipm_version = _resolve_pipeline_options(
+        model, ephem, planets, include_bipm, bipm_version)
+    if isinstance(times, tuple) and len(times) == 2:
+        # (mjd1, mjd2) pair — scalar pairs are one TOA, array pairs are
+        # elementwise (a 2-element *list* is two independent TOAs)
+        hi, lo = _pair_split(times[0], times[1])
+        utc = np.atleast_1d(hi)
+        lo = np.atleast_1d(lo)
+    else:
+        utc = np.atleast_1d(np.asarray(times, dtype=np.longdouble))
+        lo = None
+    n = len(utc)
+    err = np.broadcast_to(np.asarray(errors, dtype=np.float64), (n,)).copy()
+    freq = np.broadcast_to(np.asarray(freqs, dtype=np.float64), (n,)).copy()
+    freq[freq <= 0] = np.inf
+    site = get_observatory(obs).name
+    obs_arr = np.full(n, site, dtype=object)
+    if flags is None:
+        flag_list = [dict() for _ in range(n)]
+    elif isinstance(flags, dict):
+        flag_list = [dict(flags) for _ in range(n)]
+    else:
+        if len(flags) != n:
+            raise ValueError("flags list length must match times")
+        flag_list = [dict(f) for f in flags]
+    for k, v in kwargs.items():
+        for f in flag_list:
+            f.setdefault(k.lstrip("-"), str(v))
+    t = TOAs(utc, err, freq, obs_arr, flag_list, [], None)
+    if lo is not None and np.any(lo):
+        t.utc_mjd_lo = np.asarray(lo, dtype=np.float64)
+    return _finalize_toas(t, ephem, planets, include_gps, include_bipm,
+                          bipm_version, limits)
 
 
 PICKLE_SUFFIX = ".pint_tpu_toas.pickle"

@@ -1,0 +1,129 @@
+"""Programmatic TOA construction (reference ``toa.py``: TOA objects,
+get_TOAs_list, get_TOAs_array, get_clusters)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def model():
+    from pint_tpu.models import get_model
+
+    return get_model(["PSR CONTEST\n", "RAJ 04:00:00\n", "DECJ 10:00:00\n",
+                      "F0 200.0 1\n", "PEPOCH 55100\n", "DM 20\n",
+                      "UNITS TDB\n"])
+
+
+class TestTOAObjects:
+    def test_single_toa_forms(self):
+        from pint_tpu.toa import TOA
+
+        t = TOA(55000.5, error=1.5, obs="gbt", freq=1400.0, fe="Rcvr1_2")
+        assert t.error == 1.5 and t.flags["fe"] == "Rcvr1_2"
+        assert "55000.5" in str(t)
+        line = t.as_line()
+        assert "gbt" in line and "-fe Rcvr1_2" in line
+
+    def test_mjd_pair_precision(self):
+        from pint_tpu.toa import _split_mjd_value
+
+        hi, lo = _split_mjd_value((55000, 0.123456789012345678))
+        total = float(hi) + lo
+        assert total == pytest.approx(55000.123456789012, abs=1e-9)
+        hi2, _ = _split_mjd_value("55000.12345678901234567890")
+        assert float(hi2) == pytest.approx(55000.1234567890123, rel=1e-15)
+
+
+class TestGetTOAsList:
+    def test_pipeline_matches_array(self, model):
+        from pint_tpu.toa import TOA, get_TOAs_array, get_TOAs_list
+
+        mjds = np.linspace(55000.0, 55200.0, 7)
+        lst = [TOA(m, error=1.0, obs="gbt", freq=1400.0) for m in mjds]
+        t1 = get_TOAs_list(lst, model=model)
+        t2 = get_TOAs_array(mjds, "gbt", errors=1.0, freqs=1400.0,
+                            model=model)
+        assert len(t1) == len(t2) == 7
+        np.testing.assert_allclose(
+            np.asarray(t1.tdb, dtype=np.float64),
+            np.asarray(t2.tdb, dtype=np.float64), rtol=0, atol=1e-12)
+        np.testing.assert_allclose(t1.ssb_obs_pos_km, t2.ssb_obs_pos_km)
+        # residuals computable through the standard stack
+        from pint_tpu.residuals import Residuals
+
+        r = Residuals(t2, model)
+        assert np.all(np.isfinite(np.asarray(r.time_resids)))
+
+    def test_flags_and_broadcast(self, model):
+        from pint_tpu.toa import get_TOAs_array
+
+        t = get_TOAs_array(np.array([55000.0, 55001.0]), "ao",
+                           errors=np.array([1.0, 2.0]), freqs=430.0,
+                           flags={"be": "puppi"}, model=model, fe="430")
+        assert t.error_us.tolist() == [1.0, 2.0]
+        assert all(f["be"] == "puppi" and f["fe"] == "430" for f in t.flags)
+        with pytest.raises(ValueError):
+            get_TOAs_array(np.array([55000.0]), "ao",
+                           flags=[{}, {}], model=model)
+
+    def test_mjd_pair_array(self, model):
+        from pint_tpu.toa import get_TOAs_array
+
+        hi = np.array([55000.0, 55001.0])
+        lo = np.array([0.25, 0.75])
+        t = get_TOAs_array((hi, lo), "gbt", model=model)
+        np.testing.assert_allclose(
+            np.asarray(t.utc_mjd, dtype=np.float64), hi + lo)
+
+
+class TestClusters:
+    def test_gap_clustering(self, model):
+        from pint_tpu.toa import get_TOAs_array
+
+        mjds = np.array([55000.0, 55000.01, 55000.02,  # epoch 1
+                         55005.0, 55005.03,            # epoch 2
+                         55020.0])                     # epoch 3
+        t = get_TOAs_array(mjds, "gbt", model=model)
+        c = t.get_clusters(gap_limit_hr=2.0)
+        assert c.tolist() == [0, 0, 0, 1, 1, 2]
+        t.get_clusters(gap_limit_hr=2.0, add_column=True)
+        assert t.flags[3]["cluster"] == "1"
+        # unsorted input clusters correctly too
+        t2 = get_TOAs_array(mjds[::-1].copy(), "gbt", model=model)
+        assert t2.get_clusters(gap_limit_hr=2.0).tolist() == [2, 1, 1, 0, 0, 0]
+
+
+class TestReviewRegressions:
+    def test_scale_refused(self):
+        from pint_tpu.toa import TOA
+
+        with pytest.raises(NotImplementedError):
+            TOA(55000.0, scale="tdb")
+        TOA(55000.0, scale="utc")  # fine
+
+    def test_scalar_pair_is_one_toa(self, model):
+        from pint_tpu.toa import get_TOAs_array
+
+        t = get_TOAs_array((58000.0, 0.25), "gbt", model=model)
+        assert len(t) == 1
+        assert float(t.utc_mjd[0]) == pytest.approx(58000.25)
+
+    def test_as_line_day_boundary(self):
+        from pint_tpu.toa import TOA
+
+        line = TOA("55000.99999999999999995", obs="gbt",
+                   freq=1400.0).as_line()
+        assert " 55001.0000000000000000 " in line
+        # negative fractional part of a pair keeps its sign via the floor
+        line2 = TOA((55001, -0.5), obs="gbt", freq=1400.0).as_line()
+        assert " 55000.5000000000000000 " in line2
+
+    def test_slice_flags_isolated(self, model):
+        from pint_tpu.toa import get_TOAs_array
+
+        t = get_TOAs_array(np.array([55000.0, 55005.0, 55020.0]), "gbt",
+                           model=model)
+        sub = t[0:2]
+        sub.get_clusters(gap_limit_hr=2.0, add_column=True)
+        assert "cluster" in sub.flags[0]
+        assert "cluster" not in t.flags[0]  # parent untouched
