@@ -145,7 +145,11 @@ class _PhotonMCMCFitter(Fitter):
                          for p in self.fitkeys])
 
     def fit_toas(self, maxiter: int = 200, pos=None, seed=None,
-                 burn_frac: float = 0.25, resume: bool = False, **kw) -> float:
+                 burn_frac: float = 0.25, resume: bool = False,
+                 autocorr: bool = False, **kw) -> float:
+        """With ``autocorr=True`` the chain runs until the autocorrelation
+        convergence criteria hold (reference ``event_optimize.py:239
+        run_sampler_autocorr``) instead of a fixed length."""
         self.sampler.initialize_batched(self.lnposterior_batch,
                                         self.n_fit_params)
         if resume:
@@ -159,13 +163,23 @@ class _PhotonMCMCFitter(Fitter):
                 self.errfact, seed=seed)
             lp = self.lnposterior_batch(pos)
             pos[~np.isfinite(lp)] = self.get_fitvals()
+        discard = None
         if maxiter > 0:
-            self.sampler.run_mcmc(pos, maxiter)
-        maxiter = len(self.sampler._chain)
-        chain = self.sampler.get_chain(flat=True,
-                                       discard=int(maxiter * burn_frac))
-        lnp = self.sampler.get_log_prob(flat=True,
-                                        discard=int(maxiter * burn_frac))
+            if autocorr:
+                from pint_tpu.sampler import run_sampler_autocorr
+
+                burnin = int(maxiter * burn_frac)
+                self.autocorr = run_sampler_autocorr(
+                    self.sampler, pos, maxiter, burnin)
+                # the chain may stop early on convergence, but the requested
+                # burn-in is absolute — never re-fraction a shortened chain
+                discard = min(burnin, len(self.sampler._chain) - 1)
+            else:
+                self.sampler.run_mcmc(pos, maxiter)
+        if discard is None:
+            discard = int(len(self.sampler._chain) * burn_frac)
+        chain = self.sampler.get_chain(flat=True, discard=discard)
+        lnp = self.sampler.get_log_prob(flat=True, discard=discard)
         imax = int(np.argmax(lnp))
         self.maxpost = float(lnp[imax])
         self.maxpost_fitvals = chain[imax]

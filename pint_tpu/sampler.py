@@ -18,7 +18,98 @@ import numpy as np
 
 from pint_tpu.logging import log
 
-__all__ = ["MCMCSampler", "EnsembleSampler", "EmceeSampler", "NpzBackend"]
+__all__ = ["MCMCSampler", "EnsembleSampler", "EmceeSampler", "NpzBackend",
+           "integrated_autocorr_time", "run_sampler_autocorr"]
+
+
+def _next_pow_two(n: int) -> int:
+    i = 1
+    while i < n:
+        i <<= 1
+    return i
+
+
+def _acf_1d(x: np.ndarray) -> np.ndarray:
+    """Normalized autocorrelation of a 1-D series via FFT (the emcee
+    ``function_1d`` algorithm)."""
+    x = np.asarray(x, dtype=np.float64)
+    n = _next_pow_two(len(x))
+    f = np.fft.fft(x - np.mean(x), n=2 * n)
+    acf = np.fft.ifft(f * np.conjugate(f))[: len(x)].real
+    if acf[0] == 0:
+        return np.ones_like(acf)
+    return acf / acf[0]
+
+
+def integrated_autocorr_time(chain: np.ndarray, c: float = 5.0) -> np.ndarray:
+    """Per-parameter integrated autocorrelation time of an ensemble chain
+    (emcee's Sokal-windowed estimator, the algorithm behind the reference's
+    ``sampler.get_autocorr_time(tol=0)`` calls in
+    ``scripts/event_optimize.py:239``).
+
+    ``chain`` is (nsteps, nwalkers, ndim); the ACF is averaged over walkers
+    per parameter and summed up to the automatic window
+    ``min { m : m >= c * tau(m) }``.
+    """
+    chain = np.asarray(chain, dtype=np.float64)
+    if chain.ndim != 3:
+        raise ValueError("chain must be (nsteps, nwalkers, ndim)")
+    nsteps, nwalkers, ndim = chain.shape
+    taus = np.empty(ndim)
+    for k in range(ndim):
+        f = np.zeros(nsteps)
+        for w in range(nwalkers):
+            f += _acf_1d(chain[:, w, k])
+        f /= nwalkers
+        tau_m = 2.0 * np.cumsum(f) - 1.0
+        m = np.arange(nsteps)
+        window = np.argmax(m >= c * tau_m) if np.any(m >= c * tau_m) \
+            else nsteps - 1
+        taus[k] = tau_m[window]
+    return taus
+
+
+def run_sampler_autocorr(sampler, pos, nsteps: int, burnin: int,
+                         csteps: int = 100, crit1: int = 10):
+    """Run *sampler* until the autocorrelation-time convergence criteria
+    hold (reference ``scripts/event_optimize.py:239``): first the chain must
+    exceed ``crit1`` autocorrelation times with tau stable to 10% (checked
+    every ``csteps``), then stable to 1% (checked every ``csteps/4``), with
+    at least 1000 post-burnin steps.  Returns the list of mean-tau
+    estimates."""
+    autocorr = []
+    old_tau = np.inf
+    converged1 = converged2 = False
+    converge_step = None
+    for _ in sampler.sample(pos, iterations=nsteps):
+        it = sampler.iteration
+        if not converged1:
+            if it >= burnin and it % csteps == 0:
+                tau = sampler.get_autocorr_time(tol=0, quiet=True)
+                if np.any(np.isnan(tau)):
+                    continue
+                autocorr.append(float(np.mean(tau)))
+                converged1 = bool(np.all(tau * crit1 < it)
+                                  and np.all(np.abs(old_tau - tau) / tau < 0.1))
+                old_tau = tau
+                if converged1:
+                    log.info(f"10% convergence reached with a mean estimated "
+                             f"integrated step: {autocorr[-1]}")
+            continue
+        if not converged2:
+            if it % max(int(csteps / 4), 1) == 0:
+                tau = sampler.get_autocorr_time(tol=0, quiet=True)
+                if np.any(np.isnan(tau)):
+                    continue
+                autocorr.append(float(np.mean(tau)))
+                converged2 = bool(np.all(tau * crit1 < it)
+                                  and np.all(np.abs(old_tau - tau) / tau < 0.01))
+                old_tau = tau
+                converge_step = it
+        if converged2 and (it - burnin) >= 1000:
+            log.info(f"Convergence reached at {converge_step}")
+            break
+    return autocorr
 
 
 class NpzBackend:
@@ -157,45 +248,86 @@ class EnsembleSampler(MCMCSampler):
         self.ndim = ndim
         self._lnpost_batch = lnpost_batch
 
+    def _one_step(self, x: np.ndarray, lp: np.ndarray, step: int):
+        """One full ensemble update (both half-ensembles), in place."""
+        n, ndim = x.shape
+        half = n // 2
+        for first in (True, False):
+            s = slice(0, half) if first else slice(half, n)
+            o = slice(half, n) if first else slice(0, half)
+            xs, xo = x[s], x[o]
+            # z ~ g(z) propto 1/sqrt(z) on [1/a, a]
+            u = self.rng.random(half)
+            z = ((self.a - 1.0) * u + 1.0) ** 2 / self.a
+            partners = self.rng.integers(0, half, size=half)
+            prop = xo[partners] + z[:, None] * (xs - xo[partners])
+            lp_prop = np.array(self._lnpost_batch(prop), dtype=np.float64)
+            lnratio = (ndim - 1) * np.log(z) + lp_prop - lp[s]
+            accept = np.log(self.rng.random(half)) < lnratio
+            x[s] = np.where(accept[:, None], prop, xs)
+            lp_s = lp[s]
+            lp_s[accept] = lp_prop[accept]
+            lp[s] = lp_s
+            self.naccepted += int(accept.sum())
+            self.ntotal += half
+        self._chain.append(x.copy())
+        self._lnprob.append(lp.copy())
+        if (self.backend is not None
+                and (step + 1) % self.checkpoint_every == 0):
+            self.backend.save(self)
+            # each save rewrites the whole chain; grow the interval so
+            # cumulative checkpoint I/O stays ~linear in chain length
+            if len(self._chain) >= 20 * self.checkpoint_every:
+                self.checkpoint_every *= 2
+
     def run_mcmc(self, pos, nsteps: int, progress: bool = False) -> np.ndarray:
         """Advance the ensemble *nsteps*; returns the final position."""
         x = np.array(pos, dtype=np.float64)
-        n, ndim = x.shape
-        if n != self.nwalkers:
-            raise ValueError(f"pos has {n} walkers, expected {self.nwalkers}")
-        lp = np.array(self._lnpost_batch(x), dtype=np.float64)
-        half = n // 2
-        for step in range(nsteps):
-            for first in (True, False):
-                s = slice(0, half) if first else slice(half, n)
-                o = slice(half, n) if first else slice(0, half)
-                xs, xo = x[s], x[o]
-                # z ~ g(z) propto 1/sqrt(z) on [1/a, a]
-                u = self.rng.random(half)
-                z = ((self.a - 1.0) * u + 1.0) ** 2 / self.a
-                partners = self.rng.integers(0, half, size=half)
-                prop = xo[partners] + z[:, None] * (xs - xo[partners])
-                lp_prop = np.array(self._lnpost_batch(prop), dtype=np.float64)
-                lnratio = (ndim - 1) * np.log(z) + lp_prop - lp[s]
-                accept = np.log(self.rng.random(half)) < lnratio
-                x[s] = np.where(accept[:, None], prop, xs)
-                lp_s = lp[s]
-                lp_s[accept] = lp_prop[accept]
-                lp[s] = lp_s
-                self.naccepted += int(accept.sum())
-                self.ntotal += half
-            self._chain.append(x.copy())
-            self._lnprob.append(lp.copy())
-            if (self.backend is not None
-                    and (step + 1) % self.checkpoint_every == 0):
-                self.backend.save(self)
-                # each save rewrites the whole chain; grow the interval so
-                # cumulative checkpoint I/O stays ~linear in chain length
-                if len(self._chain) >= 20 * self.checkpoint_every:
-                    self.checkpoint_every *= 2
-        if self.backend is not None:
-            self.backend.save(self)
+        for x in self.sample(pos, nsteps):
+            pass
         return x
+
+    def sample(self, pos, iterations: int, progress: bool = False):
+        """Generator yielding the current position after every step
+        (emcee-compatible incremental API; consumed by
+        :func:`run_sampler_autocorr`).  The final backend checkpoint runs
+        even when the consumer breaks out early (convergence), so a resume
+        always continues the exact chain that was reported."""
+        x = np.array(pos, dtype=np.float64)
+        if x.shape[0] != self.nwalkers:
+            raise ValueError(
+                f"pos has {x.shape[0]} walkers, expected {self.nwalkers}")
+        lp = np.array(self._lnpost_batch(x), dtype=np.float64)
+        try:
+            for step in range(iterations):
+                self._one_step(x, lp, step)
+                yield x
+        finally:
+            if self.backend is not None:
+                self.backend.save(self)
+
+    @property
+    def iteration(self) -> int:
+        """Number of steps accumulated in the chain (emcee-compatible)."""
+        return len(self._chain)
+
+    def get_autocorr_time(self, tol: float = 50.0, quiet: bool = False,
+                          discard: int = 0, c: float = 5.0) -> np.ndarray:
+        """Per-parameter integrated autocorrelation time (emcee-compatible
+        semantics: with ``tol>0`` a chain shorter than ``tol*tau`` raises,
+        or warns with ``quiet=True``)."""
+        chain = self.get_chain(discard=discard)
+        if len(chain) < 2:
+            return np.full(self.ndim or 1, np.nan)
+        tau = integrated_autocorr_time(chain, c=c)
+        if tol > 0 and np.any(tau * tol > len(chain)):
+            msg = (f"The chain is shorter than {tol} times the integrated "
+                   f"autocorrelation time for {int(np.sum(tau * tol > len(chain)))} "
+                   f"parameter(s); tau estimates are unreliable")
+            if not quiet:
+                raise RuntimeError(msg)
+            log.warning(msg)
+        return tau
 
     @property
     def acceptance_fraction(self) -> float:

@@ -66,6 +66,9 @@ def main(argv: Optional[list] = None):
                     "(reference --backend HDF5 chains)")
     ap.add_argument("--resume", action="store_true",
                     help="continue the chain from --backend")
+    ap.add_argument("--autocorr", action="store_true",
+                    help="run until autocorrelation-time convergence "
+                         "instead of a fixed chain length")
     ap.add_argument("--no-fitstart", dest="fitstart", action="store_false",
                     help="skip the FFTFIT template start-phase alignment")
     args = ap.parse_args(argv)
@@ -114,7 +117,8 @@ def main(argv: Optional[list] = None):
         print(f"FFTFIT start phase: rotated template by {shift:.4f} "
               f"+/- {eshift:.4f} cycles")
     f.fit_toas(maxiter=args.nsteps, seed=args.seed, resume=args.resume,
-               burn_frac=args.burnin / max(args.nsteps, 1))
+               burn_frac=args.burnin / max(args.nsteps, 1),
+               autocorr=args.autocorr)
     print(f"Max posterior: {f.maxpost:.2f}  acceptance "
           f"{f.sampler.acceptance_fraction:.2f}")
     for k in f.fitkeys:

@@ -462,27 +462,14 @@ class TOAs:
             if format.lower() in ("tempo2", "1"):
                 f.write("FORMAT 1\n")
             for i in range(len(self)):
-                mjd = self.utc_mjd[i]
-                ii = int(np.floor(mjd))
-                if self.utc_mjd_lo is not None:
-                    # pair path: emit the full (hi, lo) value so a write/read
-                    # round trip through the native dd parser is lossless
-                    fr = (Fraction(float(mjd)) - ii
-                          + Fraction(float(self.utc_mjd_lo[i])))
-                    if fr < 0:  # lo may push just below the floor of hi
-                        ii -= 1
-                        fr += 1
-                    digits = 25
-                    q = round(fr * 10**digits)
-                    frac = f"{q:0{digits}d}".rstrip("0")
-                else:
-                    ff = np.format_float_positional(mjd - ii, precision=16,
-                                                    trim="-")
-                    frac = ff.split(".")[1] if "." in ff else "0"
+                ii, frac = _mjd_line_parts(
+                    self.utc_mjd[i],
+                    self.utc_mjd_lo[i] if self.utc_mjd_lo is not None
+                    else None)
                 fl = dict(self.flags[i])
                 nm = fl.pop("name", name)
                 f.write(format_toa_line(
-                    ii, frac or "0", self.error_us[i], self.freq_mhz[i],
+                    ii, frac, self.error_us[i], self.freq_mhz[i],
                     self.obs[i], name=nm, flags=fl, fmt=format))
 
     def save_pickle(self, path):
@@ -605,18 +592,36 @@ class TOA:
                 f"{self.freq} MHz")
 
     def as_line(self) -> str:
-        """This TOA as a tempo2-format tim line."""
+        """This TOA as a tempo2-format tim line (same lossless emitter as
+        ``TOAs.write_TOA_file``)."""
         hi, lo = _split_mjd_value(self.mjd)
-        total = hi + np.longdouble(lo or 0.0)
-        mjd_i = int(np.floor(total))
-        frac = float(total - np.longdouble(mjd_i))  # in [0, 1)
-        frac_str = f"{frac:.16f}"
-        if frac_str.startswith("1"):  # rounded up to the next day
-            mjd_i += 1
-            frac_str = "0.0000000000000000"
-        return format_toa_line(mjd_i, frac_str.split(".")[1],
-                               self.error, self.freq, self.obs,
+        mjd_i, frac = _mjd_line_parts(hi, lo if lo else None)
+        return format_toa_line(mjd_i, frac, self.error, self.freq, self.obs,
                                flags=self.flags, name=self.name)
+
+
+def _mjd_line_parts(mjd, lo=None):
+    """(longdouble hi, optional float64 lo) MJD -> (int day, fraction
+    digits) for tim-line formatting.  With a lo word (degraded-longdouble
+    platforms) the Fraction path emits the full (hi, lo) value so a
+    write/read round trip through the native dd parser is lossless;
+    otherwise the longdouble fraction is printed to 16 digits.  Shared by
+    ``TOAs.write_TOA_file`` and ``TOA.as_line``."""
+    ii = int(np.floor(mjd))
+    if lo:
+        fr = Fraction(float(mjd)) - ii + Fraction(float(lo))
+        if fr < 0:  # lo may push just below the floor of hi
+            ii -= 1
+            fr += 1
+        digits = 25
+        q = round(fr * 10**digits)
+        frac = f"{q:0{digits}d}".rstrip("0")
+    else:
+        ff = np.format_float_positional(mjd - ii, precision=16, trim="-")
+        if ff.startswith("1"):  # fraction rounded up to the next day
+            return ii + 1, "0"
+        frac = ff.split(".")[1] if "." in ff else "0"
+    return ii, frac or "0"
 
 
 def _pair_split(a, b):
@@ -625,10 +630,9 @@ def _pair_split(a, b):
     implementation shared by the scalar and array construction paths."""
     hi = np.asarray(a, dtype=np.longdouble) + np.asarray(b, dtype=np.longdouble)
     if np.finfo(np.longdouble).eps > 2e-19:
-        a64 = np.asarray(a, dtype=np.float64)
-        b64 = np.asarray(b, dtype=np.float64)
-        s = np.asarray(hi, dtype=np.float64)
-        lo = (a64 - s) + b64
+        # error-free transform via the shared audited primitive
+        s, lo = _two_sum_np(np.asarray(a, dtype=np.float64),
+                            np.asarray(b, dtype=np.float64))
     else:
         lo = np.zeros_like(np.asarray(hi, dtype=np.float64))
     return hi, lo

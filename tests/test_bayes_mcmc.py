@@ -186,3 +186,77 @@ class TestBatchScalarParityWithEFAC:
         pts = x0[None, :] * (1 + 1e-12)
         np.testing.assert_allclose(bt.lnposterior_batch(pts)[0],
                                    bt.lnposterior(pts[0]), rtol=1e-9, atol=1e-6)
+
+
+class TestAutocorr:
+    def test_integrated_time_on_ar1(self):
+        """tau of an AR(1) process matches the analytic (1+rho)/(1-rho)."""
+        from pint_tpu.sampler import integrated_autocorr_time
+
+        rng = np.random.default_rng(7)
+        rho = 0.9
+        nsteps, nwalkers = 20000, 8
+        x = np.zeros((nsteps, nwalkers, 1))
+        for i in range(1, nsteps):
+            x[i] = rho * x[i - 1] + rng.standard_normal((nwalkers, 1))
+        tau = integrated_autocorr_time(x)
+        expect = (1 + rho) / (1 - rho)  # = 19
+        assert tau[0] == pytest.approx(expect, rel=0.25)
+        # white noise -> tau ~ 1
+        w = rng.standard_normal((5000, 8, 1))
+        assert integrated_autocorr_time(w)[0] == pytest.approx(1.0, abs=0.3)
+
+    def test_run_sampler_autocorr_converges_on_gaussian(self):
+        from pint_tpu.sampler import EnsembleSampler, run_sampler_autocorr
+
+        def lnpost(pts):
+            pts = np.atleast_2d(pts)
+            return -0.5 * np.sum(pts**2, axis=1)
+
+        lnpost.batched = True
+        s = EnsembleSampler(nwalkers=20, seed=5)
+        s.initialize_batched(lnpost, ndim=2)
+        pos = np.random.default_rng(1).standard_normal((20, 2)) * 0.1
+        autocorr = run_sampler_autocorr(s, pos, nsteps=2500, burnin=100,
+                                        csteps=100, crit1=10)
+        assert len(autocorr) >= 1
+        assert s.iteration <= 2500
+        # a unit gaussian with the stretch move has tau ~ few-10s of steps
+        tau = s.get_autocorr_time(tol=0, quiet=True)
+        assert np.all(tau < 120)
+
+    def test_get_autocorr_time_tol_guard(self):
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(pts):
+            return -0.5 * np.sum(np.atleast_2d(pts)**2, axis=1)
+
+        lnpost.batched = True
+        s = EnsembleSampler(nwalkers=10, seed=2)
+        s.initialize_batched(lnpost, ndim=1)
+        s.run_mcmc(np.random.default_rng(0).standard_normal((10, 1)), 40)
+        with pytest.raises(RuntimeError):
+            s.get_autocorr_time(tol=50.0, quiet=False)
+        assert np.isfinite(s.get_autocorr_time(tol=50.0, quiet=True)).all()
+
+    def test_backend_saved_on_early_break(self, tmp_path):
+        """Regression: breaking out of sample() (autocorr convergence) must
+        still checkpoint the full chain + RNG state."""
+        from pint_tpu.sampler import EnsembleSampler, NpzBackend
+
+        def lnpost(pts):
+            return -0.5 * np.sum(np.atleast_2d(pts)**2, axis=1)
+
+        lnpost.batched = True
+        path = str(tmp_path / "chain")
+        s = EnsembleSampler(nwalkers=10, seed=3, backend=path,
+                            checkpoint_every=1000)  # never mid-run
+        s.initialize_batched(lnpost, ndim=1)
+        pos = np.random.default_rng(0).standard_normal((10, 1))
+        for i, _ in enumerate(s.sample(pos, iterations=500)):
+            if i == 122:
+                break  # consumer stops early, like run_sampler_autocorr
+        s2 = EnsembleSampler(nwalkers=10, backend=path)
+        s2.initialize_batched(lnpost, ndim=1)
+        s2.resume()
+        assert len(s2._chain) == 123

@@ -148,6 +148,20 @@ class TestPhotonCLIs:
         assert (tmp_path / "eo.par").exists()
         assert (tmp_path / "eo_chain.npy").exists()
 
+    def test_event_optimize_autocorr(self, eventfile, tmp_path):
+        """--autocorr runs the convergence-checked sampling path
+        (reference event_optimize.py run_sampler_autocorr)."""
+        from pint_tpu.scripts import event_optimize
+
+        os.chdir(tmp_path)
+        assert event_optimize.main(
+            [str(eventfile / "events.fits"), str(eventfile / "phot.par"),
+             str(eventfile / "template.gauss"),
+             "--nwalkers", "8", "--nsteps", "12", "--burnin", "4",
+             "--seed", "3", "--autocorr",
+             "--outbase", str(tmp_path / "eoa")]) == 0
+        assert (tmp_path / "eoa.par").exists()
+
     def test_read_gaussfitfile(self, eventfile):
         from pint_tpu.scripts.event_optimize import read_gaussfitfile
 

@@ -113,10 +113,10 @@ class TestReviewRegressions:
 
         line = TOA("55000.99999999999999995", obs="gbt",
                    freq=1400.0).as_line()
-        assert " 55001.0000000000000000 " in line
+        assert " 55001.0 " in line  # carried to the next day, not a day early
         # negative fractional part of a pair keeps its sign via the floor
         line2 = TOA((55001, -0.5), obs="gbt", freq=1400.0).as_line()
-        assert " 55000.5000000000000000 " in line2
+        assert " 55000.5 " in line2
 
     def test_slice_flags_isolated(self, model):
         from pint_tpu.toa import get_TOAs_array
