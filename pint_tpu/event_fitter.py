@@ -152,6 +152,8 @@ class _PhotonMCMCFitter(Fitter):
         run_sampler_autocorr``) instead of a fixed length."""
         self.sampler.initialize_batched(self.lnposterior_batch,
                                         self.n_fit_params)
+        requested_steps = maxiter  # burn-in is a fraction of the REQUEST,
+        # unaffected by the resume subtraction below
         if resume:
             # continue the chain from the backend checkpoint (bit-identical
             # to an uninterrupted run; reference event_optimize --backend)
@@ -168,7 +170,7 @@ class _PhotonMCMCFitter(Fitter):
             if autocorr:
                 from pint_tpu.sampler import run_sampler_autocorr
 
-                burnin = int(maxiter * burn_frac)
+                burnin = int(requested_steps * burn_frac)
                 self.autocorr = run_sampler_autocorr(
                     self.sampler, pos, maxiter, burnin)
                 # the chain may stop early on convergence, but the requested

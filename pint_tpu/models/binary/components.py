@@ -203,6 +203,112 @@ class PulsarBinary(DelayComponent):
     def delay_func(self, pv, batch, ctx, acc_delay):
         return self.binary_delay(pv, self._tt0(pv, batch, acc_delay))
 
+    # -- orbital kinematics (reference ``timing_model.py:859-1080``) -------
+    def _host_tt0(self, barytimes) -> np.ndarray:
+        """Barycentric MJD(TDB) times -> seconds since the binary epoch."""
+        bts = np.atleast_1d(np.asarray(
+            getattr(barytimes, "mjd", barytimes), dtype=np.float64))
+        pv = self._parent._const_pv()
+        epoch = pv[self.epoch_param]
+        e0 = float(epoch.hi + epoch.lo) if hasattr(epoch, "hi") else float(epoch)
+        return (bts - e0) * 86400.0, pv
+
+    def orbital_phase(self, barytimes, anom: str = "mean",
+                      radians: bool = True) -> np.ndarray:
+        """Mean / eccentric / true anomaly at barycentric MJD(TDB) times
+        (reference ``timing_model.py:859``); radians by default, cycles in
+        [0, 1) with ``radians=False``."""
+        tt0, pv = self._host_tt0(barytimes)
+        orbits, _pbprime = self._orbits_fn()(pv, tt0)
+        M = np.asarray(eng.mean_anomaly(np.asarray(orbits)))
+        if anom.lower() == "mean":
+            out = M
+        else:
+            ecc = np.asarray(eng.ecc_at(pv, tt0))
+            E = np.asarray(eng.solve_kepler(M, ecc))
+            if anom.lower().startswith("ecc"):
+                out = E
+            elif anom.lower() == "true":
+                out = 2.0 * np.arctan2(np.sqrt(1 + ecc) * np.sin(E / 2),
+                                       np.sqrt(1 - ecc) * np.cos(E / 2))
+            else:
+                raise ValueError(
+                    f"anom={anom!r} is not a recognized type of anomaly")
+        out = np.remainder(out, 2 * np.pi)
+        return out if radians else out / (2 * np.pi)
+
+    def pulsar_radial_velocity(self, barytimes) -> np.ndarray:
+        """Line-of-sight velocity of the pulsar about the system barycenter
+        [m/s] (reference ``timing_model.py:933``; Lorimer & Kramer 2008 Eqn
+        8.24 — the reference returns cgs)."""
+        from pint_tpu import c as C_M_S
+
+        tt0, pv = self._host_tt0(barytimes)
+        nu = self.orbital_phase(barytimes, anom="true")
+        ecc = np.asarray(eng.ecc_at(pv, tt0))
+        a1_s = np.asarray(eng.a1_at(pv, tt0))  # light-seconds
+        omega = np.asarray(eng.omega_bt(pv, tt0))
+        if pv.get("PB", 0.0):
+            pb_s = pv["PB"] * 86400.0
+        else:
+            pb_s = 1.0 / pv["FB0"]
+        psi = nu + omega
+        return (2 * np.pi * a1_s / (pb_s * np.sqrt(1 - ecc**2))
+                * (np.cos(psi) + ecc * np.cos(omega)) * C_M_S)
+
+    def companion_radial_velocity(self, barytimes,
+                                  massratio: float) -> np.ndarray:
+        """Companion line-of-sight velocity [m/s]; ``massratio`` is
+        m_pulsar/m_companion (reference ``timing_model.py:981``)."""
+        return -self.pulsar_radial_velocity(barytimes) * massratio
+
+    def conjunction(self, baryMJD):
+        """Barycentric MJD(TDB) of the first superior conjunction (true
+        anomaly + omega = pi/2) after each input time (reference
+        ``timing_model.py:1021``)."""
+        from scipy.optimize import brentq
+
+        bts = np.atleast_1d(np.asarray(
+            getattr(baryMJD, "mjd", baryMJD), dtype=np.float64))
+        pv = self._parent._const_pv()
+        if pv.get("PB", 0.0):
+            pb_d = float(pv["PB"])
+        else:
+            pb_d = 1.0 / float(pv["FB0"]) / 86400.0
+
+        def funct(t):
+            # wrap (psi - pi/2) into (-pi, pi]: the root is a continuous
+            # upward crossing and the 2*pi discontinuity sits half an orbit
+            # away from it, so brentq never straddles the jump
+            tt0, _ = self._host_tt0(t)
+            nu = self.orbital_phase(t, anom="true")
+            om = np.asarray(eng.omega_bt(pv, tt0))
+            d = np.remainder(nu + om - np.pi / 2 + np.pi, 2 * np.pi) - np.pi
+            return float(d[0]) if np.ndim(d) and len(d) == 1 else d
+
+        out = []
+        # dense scan: near periastron of an eccentric orbit nu sweeps
+        # rapidly, so PB/10 sampling can hop over the crossing entirely
+        ngrid = 257
+        for bt in bts:
+            ts = np.linspace(bt, bt + pb_d, ngrid)
+            tt0s, _ = self._host_tt0(ts)
+            nus = self.orbital_phase(ts, anom="true")
+            oms = np.asarray(eng.omega_bt(pv, tt0s))
+            x = np.remainder(nus + oms - np.pi / 2 + np.pi, 2 * np.pi) - np.pi
+            for lb in range(len(x) - 1):
+                # upward crossing; a root exactly on a grid point counts
+                if x[lb] < 0 <= x[lb + 1] or x[lb] == 0:
+                    break
+            else:
+                raise ValueError(
+                    f"No superior conjunction found in [{bt}, {bt + pb_d}]")
+            if x[lb] == 0:
+                out.append(ts[lb])
+            else:
+                out.append(brentq(funct, ts[lb], ts[lb + 1]))
+        return out[0] if len(out) == 1 else np.asarray(out)
+
 
 class BinaryBT(PulsarBinary):
     """Blandford & Teukolsky model (reference ``binary_bt.py:17``)."""

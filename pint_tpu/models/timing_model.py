@@ -362,6 +362,125 @@ class TimingModel:
             i += 1
         return out
 
+    @property
+    def is_binary(self) -> bool:
+        """Does the model describe a binary pulsar? (reference
+        ``timing_model.py:853``)"""
+        return any(type(c).__name__.startswith("Binary")
+                   for c in self.components.values())
+
+    @property
+    def params_ordered(self) -> List[str]:
+        """Alias of :attr:`params` (reference keeps both; ours is already
+        in component order)."""
+        return self.params
+
+    def keys(self) -> List[str]:
+        return self.params
+
+    def items(self):
+        return [(p, getattr(self, p)) for p in self.params]
+
+    def get_params_dict(self, which: str = "free",
+                        kind: str = "value") -> Dict[str, object]:
+        """{name: value|uncertainty|parameter} for free or all parameters
+        (reference ``timing_model.py get_params_dict``)."""
+        names = {"free": self.free_params, "all": [
+            p for p in self.params if p not in self.top_level_params
+        ]}[which]
+        out = {}
+        for p in names:
+            par = getattr(self, p)
+            if kind == "value":
+                out[p] = par.value
+            elif kind == "uncertainty":
+                out[p] = par.uncertainty
+            elif kind in ("quantity", "parameter"):
+                out[p] = par
+            else:
+                raise ValueError(f"Unknown kind {kind!r}")
+        return out
+
+    def get_params_mapping(self) -> Dict[str, str]:
+        """{parameter: component name} (reference ``get_params_mapping``)."""
+        out = {p: "TimingModel" for p in self.top_level_params}
+        for name, comp in self.components.items():
+            for p in comp.params:
+                out[p] = name
+        return out
+
+    def set_param_values(self, values: Dict[str, float]) -> None:
+        """Bulk-assign parameter values (reference ``set_param_values``)."""
+        for p, v in values.items():
+            getattr(self, p).value = v
+        self._cache.clear()
+
+    def set_param_uncertainties(self, values: Dict[str, float]) -> None:
+        for p, v in values.items():
+            getattr(self, p).uncertainty = v
+
+    def find_empty_masks(self, toas, freeze: bool = False) -> List[str]:
+        """Mask parameters selecting zero TOAs (reference
+        ``find_empty_masks``): these make the fit singular; with
+        ``freeze=True`` they are frozen on the spot."""
+        out = []
+        for p in self.params:
+            par = getattr(self, p)
+            if isinstance(par, maskParameter) and not par.frozen:
+                if len(par.select_toa_mask(toas)) == 0:
+                    out.append(p)
+                    if freeze:
+                        log.info(f"'{p}' has no TOAs so freezing")
+                        par.frozen = True
+        return out
+
+    def delete_jump_and_flags(self, toas, jump_num: int) -> None:
+        """Remove JUMP<jump_num> and its -gui_jump flags (reference
+        ``delete_jump_and_flags``; pintk jump workflow).  Pass the TOAs
+        whose flags were stamped by ``add_jump``/``jump_params_to_flags``,
+        or None to edit the model only."""
+        comp = self.components.get("PhaseJump")
+        name = f"JUMP{jump_num}"
+        if comp is None or name not in comp._params_dict:
+            raise ValueError(f"No {name} in the model")
+        comp.remove_param(name)
+        comp.setup()
+        if not comp.jumps:
+            self.remove_component("PhaseJump")
+        if toas is not None:
+            for fl in toas.flags:
+                # both flag conventions: -gui_jump (pintk add_jump) and
+                # -jump (jump_params_to_flags)
+                if fl.get("gui_jump") == str(jump_num):
+                    del fl["gui_jump"]
+                if fl.get("jump") == str(jump_num):
+                    del fl["jump"]
+            toas._version += 1
+        self._cache.clear()
+
+    def add_tzr_toa(self, toas) -> None:
+        """Attach an AbsPhase component with the TZR anchored on the first
+        TOA when none exists (reference ``add_tzr_toa``)."""
+        from pint_tpu.models.absolute_phase import AbsPhase
+
+        if "AbsPhase" in self.components:
+            return
+        comp = AbsPhase()
+        self.add_component(comp, validate=False)
+        mjd = float(np.asarray(toas.get_mjds())[0])
+        self.TZRMJD.value = mjd
+        self.TZRSITE.value = str(toas.obs[0])
+        f = float(np.asarray(toas.freq_mhz)[0])
+        self.TZRFRQ.value = f if np.isfinite(f) else 0.0
+        self.setup()
+
+    def total_dispersion_slope(self, toas) -> np.ndarray:
+        """Total DM converted to dispersion slope [s MHz^2] (reference
+        ``total_dispersion_slope``)."""
+        from pint_tpu import DMconst
+
+        return np.asarray(self.total_dm(toas)) * DMconst
+
     def get_prefix_mapping(self, prefix: str) -> Dict[int, str]:
         """{index: name} over all components for ``PREFIX<idx>`` parameters
         (reference ``timing_model.py get_prefix_mapping``); raises ValueError

@@ -1,0 +1,223 @@
+"""TimingModel user-API long tail: orbital kinematics, parameter dicts,
+mask hygiene, jump deletion (reference ``timing_model.py:853-1100`` and
+dict/mask helpers)."""
+
+import io
+
+import numpy as np
+import pytest
+
+BINARY_PAR = """
+PSR  J9999+9999
+RAJ  09:00:00
+DECJ 09:00:00
+POSEPOCH 55000
+F0   300.0 1
+PEPOCH 55000
+DM   10.0
+BINARY DD
+PB   10.0 1
+A1   20.0 1
+T0   55000.0 1
+ECC  0.3
+OM   90.0
+UNITS TDB
+"""
+
+
+@pytest.fixture(scope="module")
+def bmodel():
+    from pint_tpu.models import get_model
+
+    return get_model(io.StringIO(BINARY_PAR))
+
+
+class TestOrbitalKinematics:
+    def test_is_binary(self, bmodel):
+        from pint_tpu.models import get_model
+
+        assert bmodel.is_binary is True
+        m = get_model(["PSR X\n", "RAJ 1:00:00\n", "DECJ 2:00:00\n",
+                       "F0 1.0\n", "PEPOCH 55000\n", "UNITS TDB\n"])
+        assert m.is_binary is False
+
+    def test_orbital_phase_anomalies(self, bmodel):
+        # at T0 (periastron) every anomaly is zero
+        for anom in ("mean", "ecc", "true"):
+            assert bmodel.orbital_phase(55000.0, anom=anom)[0] == \
+                pytest.approx(0.0, abs=1e-8)
+        # half a period later the mean anomaly is pi
+        assert bmodel.orbital_phase(55005.0, anom="mean")[0] == \
+            pytest.approx(np.pi, rel=1e-10)
+        # eccentric orbit: at M=pi, E=pi and nu=pi exactly
+        assert bmodel.orbital_phase(55005.0, anom="true")[0] == \
+            pytest.approx(np.pi, rel=1e-8)
+        # quarter period: E and nu differ from M in the expected direction
+        M = bmodel.orbital_phase(55002.5, anom="mean")[0]
+        E = bmodel.orbital_phase(55002.5, anom="ecc")[0]
+        nu = bmodel.orbital_phase(55002.5, anom="true")[0]
+        assert M == pytest.approx(np.pi / 2, rel=1e-10)
+        assert E > M and nu > E  # ecc=0.3 pushes later anomalies ahead
+        # Kepler's equation holds
+        assert E - 0.3 * np.sin(E) == pytest.approx(M, abs=1e-10)
+        # cycles form
+        assert bmodel.orbital_phase(55005.0, anom="mean", radians=False)[0] \
+            == pytest.approx(0.5)
+        with pytest.raises(ValueError):
+            bmodel.orbital_phase(55000.0, anom="weird")
+
+    def test_radial_velocity(self, bmodel):
+        from pint_tpu import c as C
+
+        # amplitude K = 2 pi a1 / (pb sqrt(1-e^2)) in light-s/s times c
+        K = 2 * np.pi * 20.0 / (10 * 86400 * np.sqrt(1 - 0.09)) * C
+        ts = 55000.0 + np.linspace(0, 10, 400)
+        v = bmodel.pulsar_radial_velocity(ts)
+        assert np.max(np.abs(v)) <= K * (1 + 0.3) * 1.001
+        assert np.max(np.abs(v)) > K * 0.9
+        vc = bmodel.companion_radial_velocity(ts, massratio=0.5)
+        np.testing.assert_allclose(vc, -0.5 * v)
+
+    def test_conjunction(self, bmodel):
+        # OM=90 deg puts superior conjunction (nu + omega = pi/2) exactly at
+        # periastron, so from T0+0.5 d the next one is T0+PB
+        tc = bmodel.conjunction(55000.5)
+        assert tc == pytest.approx(55010.0, abs=1e-6)
+        nu = bmodel.orbital_phase(tc, anom="true")[0]
+        om = np.deg2rad(90.0)
+        assert np.remainder(nu + om + 1e-12, 2 * np.pi) == pytest.approx(
+            np.pi / 2, abs=1e-5)
+        # vector input
+        tcs = bmodel.conjunction(np.array([55000.5, 55012.0]))
+        assert len(tcs) == 2
+        assert tcs[1] == pytest.approx(55020.0, abs=1e-6)
+
+
+class TestParamDicts:
+    def test_get_params_dict_and_mapping(self, bmodel):
+        d = bmodel.get_params_dict("free", "value")
+        assert set(d) == set(bmodel.free_params)
+        u = bmodel.get_params_dict("all", "uncertainty")
+        assert "ECC" in u
+        m = bmodel.get_params_mapping()
+        assert m["F0"] == "Spindown" and m["PB"] == "BinaryDD"
+        with pytest.raises(ValueError):
+            bmodel.get_params_dict("free", "nope")
+
+    def test_set_values_and_uncertainties(self, bmodel):
+        import copy
+
+        m = copy.deepcopy(bmodel)
+        m.set_param_values({"F0": 300.5, "ECC": 0.25})
+        assert m.F0.value == 300.5 and m.ECC.value == 0.25
+        m.set_param_uncertainties({"F0": 1e-9})
+        assert m.F0.uncertainty == 1e-9
+
+    def test_keys_items_ordered(self, bmodel):
+        assert bmodel.params_ordered == bmodel.params
+        assert "F0" in bmodel.keys()
+        items = dict(bmodel.items())
+        assert items["F0"].value == bmodel.F0.value
+
+
+class TestMaskAndJumpHygiene:
+    def test_find_empty_masks(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR M\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n",
+               "JUMP MJD 60000 60010 0.0 1\n"]  # range with no TOAs
+        m = get_model(par)
+        t = make_fake_toas_uniform(55000, 55200, 20, m, error_us=1.0)
+        empty = m.find_empty_masks(t)
+        assert empty == ["JUMP1"]
+        assert not m.JUMP1.frozen
+        m.find_empty_masks(t, freeze=True)
+        assert m.JUMP1.frozen
+
+    def test_delete_jump_and_flags(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.pintk.pulsar import Pulsar
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR D\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        t = make_fake_toas_uniform(55000, 55200, 10, m, error_us=1.0)
+        # stamp a gui jump the pintk way: flags + JUMP2 param
+        from pint_tpu.models.jump import PhaseJump
+        from pint_tpu.models.parameter import maskParameter
+
+        m.add_component(PhaseJump(), validate=False)
+        comp = m.components["PhaseJump"]
+        for i in range(5):
+            t.flags[i]["gui_jump"] = "2"
+        comp.add_param(maskParameter("JUMP", index=2, key="-gui_jump",
+                                     key_value=["2"], units="s", value=0.0,
+                                     frozen=False), setup=True)
+        m.setup()
+        m.delete_jump_and_flags(t, 2)
+        assert "JUMP2" not in m.params
+        assert all("gui_jump" not in fl for fl in t.flags)
+        with pytest.raises(ValueError):
+            m.delete_jump_and_flags(t, 9)
+
+    def test_add_tzr_toa_and_dispersion_slope(self):
+        from pint_tpu import DMconst
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR T\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        t = make_fake_toas_uniform(55000, 55200, 5, m, error_us=1.0)
+        assert "AbsPhase" not in m.components
+        m.add_tzr_toa(t)
+        assert "AbsPhase" in m.components
+        assert float(m.TZRMJD.value) == pytest.approx(
+            float(np.asarray(t.get_mjds())[0]), abs=1e-6)
+        slope = m.total_dispersion_slope(t)
+        np.testing.assert_allclose(slope, 10.0 * DMconst)
+
+    def test_conjunction_eccentric_fast_sweep(self):
+        """Regression: high-eccentricity orbit whose conjunction sits in the
+        rapid periastron sweep must still be found, and the root must
+        satisfy the defining equation (no wrap-discontinuity root)."""
+        from pint_tpu.models import get_model
+
+        par = ["PSR E\n", "RAJ 09:00:00\n", "DECJ 09:00:00\n",
+               "POSEPOCH 55000\n", "F0 300.0\n", "PEPOCH 55000\n",
+               "DM 10.0\n", "BINARY DD\n", "PB 10.0\n", "A1 20.0\n",
+               "T0 55000.0\n", "ECC 0.85\n", "OM 250.0\n", "UNITS TDB\n"]
+        m = get_model(par)
+        for start in (55000.3, 55004.0, 55009.9):
+            tc = m.conjunction(start)
+            assert start < tc <= start + 10.0 + 1e-6
+            nu = m.orbital_phase(tc, anom="true")[0]
+            om = np.deg2rad(250.0)
+            d = np.remainder(nu + om - np.pi / 2 + np.pi, 2 * np.pi) - np.pi
+            assert abs(d) < 1e-6
+
+    def test_delete_jump_strips_both_flag_conventions(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.models.jump import PhaseJump
+        from pint_tpu.models.parameter import maskParameter
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR D2\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        t = make_fake_toas_uniform(55000, 55200, 10, m, error_us=1.0)
+        m.add_component(PhaseJump(), validate=False)
+        comp = m.components["PhaseJump"]
+        comp.add_param(maskParameter("JUMP", index=2, key="-gui_jump",
+                                     key_value=["2"], units="s", value=0.0,
+                                     frozen=False), setup=True)
+        for i in range(4):
+            t.flags[i]["gui_jump"] = "2"
+            t.flags[i]["jump"] = "2"  # jump_params_to_flags convention
+        m.setup()
+        m.delete_jump_and_flags(t, 2)
+        assert all("gui_jump" not in fl and "jump" not in fl
+                   for fl in t.flags)
