@@ -165,20 +165,21 @@ class _PhotonMCMCFitter(Fitter):
                 self.errfact, seed=seed)
             lp = self.lnposterior_batch(pos)
             pos[~np.isfinite(lp)] = self.get_fitvals()
-        discard = None
-        if maxiter > 0:
-            if autocorr:
-                from pint_tpu.sampler import run_sampler_autocorr
+        if maxiter > 0 and autocorr:
+            from pint_tpu.sampler import run_sampler_autocorr
 
-                burnin = int(requested_steps * burn_frac)
-                self.autocorr = run_sampler_autocorr(
-                    self.sampler, pos, maxiter, burnin)
-                # the chain may stop early on convergence, but the requested
-                # burn-in is absolute — never re-fraction a shortened chain
-                discard = min(burnin, len(self.sampler._chain) - 1)
-            else:
-                self.sampler.run_mcmc(pos, maxiter)
-        if discard is None:
+            self.autocorr = run_sampler_autocorr(
+                self.sampler, pos, maxiter,
+                int(requested_steps * burn_frac))
+        elif maxiter > 0:
+            self.sampler.run_mcmc(pos, maxiter)
+        if autocorr:
+            # the chain may stop early on convergence (or the resume may
+            # already satisfy the request), but the requested burn-in is
+            # absolute — never re-fraction a shortened chain
+            discard = min(int(requested_steps * burn_frac),
+                          len(self.sampler._chain) - 1)
+        else:
             discard = int(len(self.sampler._chain) * burn_frac)
         chain = self.sampler.get_chain(flat=True, discard=discard)
         lnp = self.sampler.get_log_prob(flat=True, discard=discard)

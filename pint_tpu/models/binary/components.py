@@ -204,14 +204,36 @@ class PulsarBinary(DelayComponent):
         return self.binary_delay(pv, self._tt0(pv, batch, acc_delay))
 
     # -- orbital kinematics (reference ``timing_model.py:859-1080``) -------
-    def _host_tt0(self, barytimes) -> np.ndarray:
-        """Barycentric MJD(TDB) times -> seconds since the binary epoch."""
+    def _epoch_mjd(self, pv) -> float:
+        epoch = pv[self.epoch_param]
+        return float(epoch.hi + epoch.lo) if hasattr(epoch, "hi") \
+            else float(epoch)
+
+    def _host_tt0(self, barytimes, pv=None):
+        """Barycentric MJD(TDB) times -> (seconds since the binary epoch,
+        parameter dict).  Pass a prebuilt ``pv`` to skip rebuilding the
+        parameter pytree in loops."""
         bts = np.atleast_1d(np.asarray(
             getattr(barytimes, "mjd", barytimes), dtype=np.float64))
-        pv = self._parent._const_pv()
-        epoch = pv[self.epoch_param]
-        e0 = float(epoch.hi + epoch.lo) if hasattr(epoch, "hi") else float(epoch)
-        return (bts - e0) * 86400.0, pv
+        if pv is None:
+            pv = self._parent._const_pv()
+        return (bts - self._epoch_mjd(pv)) * 86400.0, pv
+
+    def _mean_anomaly(self, pv, tt0) -> np.ndarray:
+        orbits, _pbprime = self._orbits_fn()(pv, tt0)
+        return np.asarray(eng.mean_anomaly(np.asarray(orbits)))
+
+    def _true_anomaly(self, pv, tt0) -> np.ndarray:
+        M = self._mean_anomaly(pv, tt0)
+        ecc = np.asarray(eng.ecc_at(pv, tt0))
+        E = np.asarray(eng.solve_kepler(M, ecc))
+        return 2.0 * np.arctan2(np.sqrt(1 + ecc) * np.sin(E / 2),
+                                np.sqrt(1 - ecc) * np.cos(E / 2))
+
+    def _pb_days(self, pv) -> float:
+        if pv.get("PB", 0.0):
+            return float(pv["PB"])
+        return 1.0 / float(pv["FB0"]) / 86400.0
 
     def orbital_phase(self, barytimes, anom: str = "mean",
                       radians: bool = True) -> np.ndarray:
@@ -219,21 +241,16 @@ class PulsarBinary(DelayComponent):
         (reference ``timing_model.py:859``); radians by default, cycles in
         [0, 1) with ``radians=False``."""
         tt0, pv = self._host_tt0(barytimes)
-        orbits, _pbprime = self._orbits_fn()(pv, tt0)
-        M = np.asarray(eng.mean_anomaly(np.asarray(orbits)))
         if anom.lower() == "mean":
-            out = M
+            out = self._mean_anomaly(pv, tt0)
+        elif anom.lower().startswith("ecc"):
+            M = self._mean_anomaly(pv, tt0)
+            out = np.asarray(eng.solve_kepler(M, eng.ecc_at(pv, tt0)))
+        elif anom.lower() == "true":
+            out = self._true_anomaly(pv, tt0)
         else:
-            ecc = np.asarray(eng.ecc_at(pv, tt0))
-            E = np.asarray(eng.solve_kepler(M, ecc))
-            if anom.lower().startswith("ecc"):
-                out = E
-            elif anom.lower() == "true":
-                out = 2.0 * np.arctan2(np.sqrt(1 + ecc) * np.sin(E / 2),
-                                       np.sqrt(1 - ecc) * np.cos(E / 2))
-            else:
-                raise ValueError(
-                    f"anom={anom!r} is not a recognized type of anomaly")
+            raise ValueError(
+                f"anom={anom!r} is not a recognized type of anomaly")
         out = np.remainder(out, 2 * np.pi)
         return out if radians else out / (2 * np.pi)
 
@@ -244,14 +261,11 @@ class PulsarBinary(DelayComponent):
         from pint_tpu import c as C_M_S
 
         tt0, pv = self._host_tt0(barytimes)
-        nu = self.orbital_phase(barytimes, anom="true")
+        nu = self._true_anomaly(pv, tt0)
         ecc = np.asarray(eng.ecc_at(pv, tt0))
         a1_s = np.asarray(eng.a1_at(pv, tt0))  # light-seconds
         omega = np.asarray(eng.omega_bt(pv, tt0))
-        if pv.get("PB", 0.0):
-            pb_s = pv["PB"] * 86400.0
-        else:
-            pb_s = 1.0 / pv["FB0"]
+        pb_s = self._pb_days(pv) * 86400.0
         psi = nu + omega
         return (2 * np.pi * a1_s / (pb_s * np.sqrt(1 - ecc**2))
                 * (np.cos(psi) + ecc * np.cos(omega)) * C_M_S)
@@ -262,6 +276,14 @@ class PulsarBinary(DelayComponent):
         m_pulsar/m_companion (reference ``timing_model.py:981``)."""
         return -self.pulsar_radial_velocity(barytimes) * massratio
 
+    def _psi_minus_quarter(self, pv, ts) -> np.ndarray:
+        """wrap(nu + omega - pi/2) into (-pi, pi]: zero at superior
+        conjunction, continuous there (the 2*pi jump sits half an orbit
+        away).  Single definition shared by the scan and the root find."""
+        tt0, _ = self._host_tt0(ts, pv)
+        psi = self._true_anomaly(pv, tt0) + np.asarray(eng.omega_bt(pv, tt0))
+        return np.remainder(psi - np.pi / 2 + np.pi, 2 * np.pi) - np.pi
+
     def conjunction(self, baryMJD):
         """Barycentric MJD(TDB) of the first superior conjunction (true
         anomaly + omega = pi/2) after each input time (reference
@@ -271,31 +293,18 @@ class PulsarBinary(DelayComponent):
         bts = np.atleast_1d(np.asarray(
             getattr(baryMJD, "mjd", baryMJD), dtype=np.float64))
         pv = self._parent._const_pv()
-        if pv.get("PB", 0.0):
-            pb_d = float(pv["PB"])
-        else:
-            pb_d = 1.0 / float(pv["FB0"]) / 86400.0
+        pb_d = self._pb_days(pv)
 
         def funct(t):
-            # wrap (psi - pi/2) into (-pi, pi]: the root is a continuous
-            # upward crossing and the 2*pi discontinuity sits half an orbit
-            # away from it, so brentq never straddles the jump
-            tt0, _ = self._host_tt0(t)
-            nu = self.orbital_phase(t, anom="true")
-            om = np.asarray(eng.omega_bt(pv, tt0))
-            d = np.remainder(nu + om - np.pi / 2 + np.pi, 2 * np.pi) - np.pi
-            return float(d[0]) if np.ndim(d) and len(d) == 1 else d
+            return float(self._psi_minus_quarter(pv, t)[0])
 
         out = []
         # dense scan: near periastron of an eccentric orbit nu sweeps
-        # rapidly, so PB/10 sampling can hop over the crossing entirely
+        # rapidly, so coarse sampling can hop over the crossing entirely
         ngrid = 257
         for bt in bts:
             ts = np.linspace(bt, bt + pb_d, ngrid)
-            tt0s, _ = self._host_tt0(ts)
-            nus = self.orbital_phase(ts, anom="true")
-            oms = np.asarray(eng.omega_bt(pv, tt0s))
-            x = np.remainder(nus + oms - np.pi / 2 + np.pi, 2 * np.pi) - np.pi
+            x = self._psi_minus_quarter(pv, ts)
             for lb in range(len(x) - 1):
                 # upward crossing; a root exactly on a grid point counts
                 if x[lb] < 0 <= x[lb + 1] or x[lb] == 0:
@@ -495,6 +504,41 @@ class BinaryELL1(PulsarBinary):
     def ell1_om_deg(self) -> float:
         return float(np.degrees(np.arctan2(self.EPS1.value or 0.0,
                                            self.EPS2.value or 0.0)) % 360.0)
+
+    # -- orbital kinematics, ELL1 parameterization -------------------------
+    # ELL1 has no periastron: the epoch is TASC and eccentricity lives in
+    # EPS1/EPS2, so periastron-referenced anomalies are undefined (the
+    # generic PulsarBinary math would silently use ECC=OM=0).
+    def orbital_phase(self, barytimes, anom: str = "mean",
+                      radians: bool = True) -> np.ndarray:
+        """Orbital phase from the ascending node.  Only ``anom="mean"`` is
+        defined for the ELL1 parameterization (reference raises for
+        eccentric/true anomaly on ELL1 models)."""
+        if anom.lower() != "mean":
+            raise ValueError(
+                f"anom={anom!r} is undefined for the ELL1 parameterization "
+                "(EPS1/EPS2, no periastron); only 'mean' (phase from the "
+                "ascending node) is available")
+        return super().orbital_phase(barytimes, anom="mean", radians=radians)
+
+    def pulsar_radial_velocity(self, barytimes) -> np.ndarray:
+        """Line-of-sight velocity [m/s] in the small-eccentricity limit:
+        v = K cos(Phi) with Phi the phase from the ascending node and
+        K = 2 pi a1 / PB; the O(e) EPS1/EPS2 harmonic corrections
+        (e ~ 1e-3 for ELL1-applicable orbits) are dropped."""
+        from pint_tpu import c as C_M_S
+
+        tt0, pv = self._host_tt0(barytimes)
+        Phi = self._mean_anomaly(pv, tt0)
+        a1_s = np.asarray(eng.a1_at(pv, tt0))
+        pb_s = self._pb_days(pv) * 86400.0
+        return 2 * np.pi * a1_s / pb_s * np.cos(Phi) * C_M_S
+
+    def _psi_minus_quarter(self, pv, ts) -> np.ndarray:
+        # superior conjunction at Phi = pi/2 from the ascending node
+        tt0, _ = self._host_tt0(ts, pv)
+        Phi = self._mean_anomaly(pv, tt0)
+        return np.remainder(Phi - np.pi / 2 + np.pi, 2 * np.pi) - np.pi
 
 
 class BinaryELL1H(BinaryELL1):

@@ -15,7 +15,8 @@ import numpy as np
 
 from pint_tpu import DMconst
 from pint_tpu.exceptions import MissingParameter
-from pint_tpu.models.parameter import MJDParameter, maskParameter, prefixParameter
+from pint_tpu.models.parameter import (MJDParameter, floatParameter,
+                                       maskParameter, prefixParameter)
 from pint_tpu.models.timing_model import DelayComponent, check_contiguous_indices
 
 __all__ = ["Dispersion", "DispersionDM", "DispersionDMX", "DispersionJump",
@@ -108,6 +109,10 @@ class DispersionDMX(Dispersion):
 
     def __init__(self):
         super().__init__()
+        # bare DMX: the nominal bin width [d] (reference
+        # ``dispersion_model.py DMX`` parameter; informational)
+        self.add_param(floatParameter("DMX", units="d", frozen=True,
+                                      description="Nominal DMX bin width"))
         self.add_param(prefixParameter("DMX_0001", units="pc/cm3", value=0.0,
                                        description="DM offset in range"))
         self.add_param(prefixParameter("DMXR1_0001", units="MJD",

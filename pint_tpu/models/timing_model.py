@@ -385,9 +385,13 @@ class TimingModel:
                         kind: str = "value") -> Dict[str, object]:
         """{name: value|uncertainty|parameter} for free or all parameters
         (reference ``timing_model.py get_params_dict``)."""
-        names = {"free": self.free_params, "all": [
-            p for p in self.params if p not in self.top_level_params
-        ]}[which]
+        if which == "free":
+            names = self.free_params
+        elif which == "all":
+            names = [p for p in self.params
+                     if p not in self.top_level_params]
+        else:
+            raise ValueError(f"Unknown which {which!r}")
         out = {}
         for p in names:
             par = getattr(self, p)

@@ -119,6 +119,11 @@ MORE_PULSARS = [
     ("B1953+29_NANOGrav_dfg+12_TAI_FB90.par", "B1953+29_NANOGrav_dfg+12.tim"),
     ("J0023+0923_NANOGrav_11yv0.gls.par", "J0023+0923_NANOGrav_11yv0.tim"),
     ("J0613-0200_NANOGrav_9yv1.gls.par", "J0613-0200_NANOGrav_9yv1.tim"),
+    # DDK (Kopeikin annual/secular parallax terms) on real data
+    ("J1713+0747_NANOGrav_11yv0_short.gls.par",
+     "J1713+0747_NANOGrav_11yv0_short.tim"),
+    # ELL1H (orthometric H3 Shapiro) on real data
+    ("J1853+1303_NANOGrav_11yv0.gls.par", "J1853+1303_NANOGrav_11yv0.tim"),
 ]
 
 

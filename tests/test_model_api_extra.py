@@ -221,3 +221,30 @@ class TestMaskAndJumpHygiene:
         m.delete_jump_and_flags(t, 2)
         assert all("gui_jump" not in fl and "jump" not in fl
                    for fl in t.flags)
+
+    def test_ell1_kinematics_semantics(self):
+        """ELL1 has no periastron: ecc/true anomalies raise; mean phase is
+        from TASC; conjunction is at Phi = pi/2; RV is the circular limit."""
+        from pint_tpu import c as C
+        from pint_tpu.models import get_model
+
+        par = ["PSR L\n", "RAJ 09:00:00\n", "DECJ 09:00:00\n",
+               "POSEPOCH 55000\n", "F0 300.0\n", "PEPOCH 55000\n",
+               "DM 10.0\n", "BINARY ELL1\n", "PB 1.5\n", "A1 5.0\n",
+               "TASC 55000.0\n", "EPS1 1e-3\n", "EPS2 2e-3\n",
+               "UNITS TDB\n"]
+        m = get_model(par)
+        with pytest.raises(ValueError):
+            m.orbital_phase(55000.5, anom="true")
+        with pytest.raises(ValueError):
+            m.orbital_phase(55000.5, anom="ecc")
+        assert m.orbital_phase(55000.75, anom="mean", radians=False)[0] == \
+            pytest.approx(0.5)
+        assert m.conjunction(55000.1) == pytest.approx(55000.375, abs=1e-9)
+        v = m.pulsar_radial_velocity(55000.0 + np.linspace(0, 1.5, 300))
+        K = 2 * np.pi * 5.0 / (1.5 * 86400) * C
+        assert np.max(np.abs(v)) == pytest.approx(K, rel=1e-3)
+
+    def test_get_params_dict_bad_which(self, bmodel):
+        with pytest.raises(ValueError):
+            bmodel.get_params_dict("typo", "value")
