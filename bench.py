@@ -176,14 +176,27 @@ def main():
                           "error": "B1855 datafiles unavailable"}))
         return
 
+    # the axon TPU tunnel is intermittently unavailable (see BENCH_NOTES.md);
+    # a CPU-fallback number beats recording nothing for the round
+    try:
+        platform = jax.devices()[0].platform
+    except Exception as e:
+        print(f"# TPU backend unavailable ({type(e).__name__}: {e}); "
+              "falling back to CPU for this run", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        platform = jax.devices()[0].platform
+    print(f"# platform: {platform}", file=sys.stderr)
+
     r = bench_b1855_gls()
     fits_per_sec = r["fits_per_sec"]
-    print(json.dumps({
+    out = {
         "metric": "gls_chisq_grid_evals_per_sec",
         "value": round(fits_per_sec, 3),
         "unit": "fits/s",
         "vs_baseline": round(fits_per_sec / BASELINE_FITS_PER_SEC, 1),
-    }))
+    }
+    out["platform"] = platform  # cpu here flags a fallback measurement
+    print(json.dumps(out))
     print(r["stages"].table("B1855+09 9yv1 GLS (4005 TOAs)"), file=sys.stderr)
     print(
         f"# 256 GLS grid fits in {r['elapsed']:.3f}s on "
