@@ -32,6 +32,7 @@ class Pulsar:
                  fitter: str = "auto"):
         self.parfile = parfile
         self.timfile = timfile
+        self.ephem = ephem
         self.model_init = get_model(parfile)
         self.model = copy.deepcopy(self.model_init)
         self.all_toas = get_TOAs(timfile, model=self.model, ephem=ephem)
@@ -53,6 +54,91 @@ class Pulsar:
 
     def __contains__(self, key) -> bool:
         return key in self.model.params
+
+    # -- plot-axis helpers (reference ``pintk/pulsar.py:256-286``) ----------
+    def orbitalphase(self) -> np.ndarray:
+        """Orbital phase of every TOA in cycles [0, 1); zeros for a
+        non-binary pulsar (reference ``pintk/pulsar.py:256``)."""
+        if not self.model.is_binary:
+            log.warning("This is not a binary pulsar")
+            return np.zeros(len(self.all_toas))
+        mjds = np.asarray(self.all_toas.get_mjds(), dtype=np.float64)
+        return self.model.orbital_phase(mjds, anom="mean", radians=False)
+
+    def year(self) -> np.ndarray:
+        """Decimal year of every TOA (reference ``pintk/pulsar.py:280``)."""
+        mjds = np.asarray(self.all_toas.get_mjds(), dtype=np.float64)
+        # MJD 51544.5 = 2000.0; Julian year = 365.25 d
+        return 2000.0 + (mjds - 51544.5) / 365.25
+
+    def dayofyear(self) -> np.ndarray:
+        """Days since the start of each TOA's (Julian) year (reference
+        ``pintk/pulsar.py:272``)."""
+        mjds = np.asarray(self.all_toas.get_mjds(), dtype=np.float64)
+        yr = np.floor(self.year())
+        year_start_mjd = 51544.5 + (yr - 2000.0) * 365.25
+        return mjds - year_start_mjd
+
+    def add_model_params(self) -> None:
+        """Expose the next unfit spin / orbital-frequency derivative so the
+        GUI can offer it (reference ``pintk/pulsar.py:287``): when F<n-1>
+        (or FB<n-1>) is free and F<n> absent, add it frozen at zero."""
+        m = self.model
+        if "Spindown" in m.components:
+            c = m.components["Spindown"]
+            fs = sorted(int(p[1:]) for p in c.params
+                        if p.startswith("F") and p[1:].isdigit())
+            n = max(fs) + 1
+            if f"F{n - 1}" in m.free_params:
+                c.add_param(c._params_dict["F1"].new_param(n, value=0.0),
+                            setup=True)
+                getattr(m, f"F{n}").units = f"Hz/s^{n}"
+        for comp in m.components.values():
+            if not type(comp).__name__.startswith("Binary"):
+                continue
+            fbs = sorted(int(p[2:]) for p in comp.params
+                         if p.startswith("FB") and p[2:].isdigit()
+                         and comp._params_dict[p].value is not None)
+            if fbs:
+                n = max(fbs) + 1
+                if f"FB{n - 1}" in m.free_params \
+                        and f"FB{n}" not in comp._params_dict:
+                    comp.add_param(
+                        comp._params_dict["FB0"].new_param(n, value=0.0),
+                        setup=True)
+        m.setup()
+
+    def resetAll(self) -> None:
+        """Reload the model and TOAs from disk (reference
+        ``pintk/pulsar.py:177``)."""
+        self.model_init = get_model(self.parfile)
+        self.model = copy.deepcopy(self.model_init)
+        self.fitted = False
+        self.fitter = None
+        self.postfit_resids = None
+        # reset_TOAs re-ingests and rebuilds residuals once; going through
+        # reset_model first would build them twice against stale TOAs
+        self.reset_TOAs()
+
+    def print_chi2(self, selected=None) -> str:
+        """Chi2 summary for the selection (reference
+        ``pintk/pulsar.py:498``); returns and prints the text.  ``selected``
+        is a boolean mask or index array; an empty/None selection means
+        all TOAs."""
+        if selected is None:
+            toas = self.all_toas
+        else:
+            selected = np.asarray(selected)
+            if selected.dtype == bool:
+                use_all = not selected.any()
+            else:
+                use_all = selected.size == 0  # index arrays may contain 0
+            toas = self.all_toas if use_all else self.all_toas[selected]
+        r = Residuals(toas, self.model)
+        text = (f"Chisq = {r.chi2:.6f} for {r.dof} d.o.f. "
+                f"-> reduced chisq = {r.chi2 / max(r.dof, 1):.6f}")
+        print(text)
+        return text
 
     # -- residuals -----------------------------------------------------------
     def resids(self, selected: bool = False) -> Residuals:
@@ -161,7 +247,8 @@ class Pulsar:
         self.update_resids()
 
     def reset_TOAs(self):
-        self.all_toas = get_TOAs(self.timfile, model=self.model)
+        self.all_toas = get_TOAs(self.timfile, model=self.model,
+                                 ephem=self.ephem)
         self.reset_selection()
         self.update_resids()
 

@@ -186,3 +186,49 @@ class TestColorModes:
 
         with _pt.raises(ValueError):
             get_color_mode("nope")
+
+
+class TestPulsarHelpers:
+    def test_axes_helpers(self, psr):
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        yr = psr.year()
+        assert len(yr) == len(psr.all_toas)
+        assert np.all((yr > 1990) & (yr < 2030))
+        doy = psr.dayofyear()
+        assert np.all((doy >= 0) & (doy < 366.0))
+        # NGC6440E is isolated: orbital phase warns and returns zeros
+        assert np.all(psr.orbitalphase() == 0.0)
+
+    def test_print_chi2_and_reset(self, psr):
+        text = psr.print_chi2()
+        assert "Chisq" in text and "d.o.f" in text
+        sel = np.zeros(len(psr.all_toas), dtype=bool)
+        sel[:10] = True
+        assert "d.o.f" in psr.print_chi2(sel)
+        psr.fit()
+        assert psr.fitted
+        psr.resetAll()
+        assert not psr.fitted
+        assert float(psr.model.F0.value) == float(psr.model_init.F0.value)
+
+    def test_add_model_params_extends_spindown(self, psr):
+        before = [p for p in psr.model.params if p.startswith("F")
+                  and p[1:].isdigit()]
+        psr.add_model_params()
+        after = [p for p in psr.model.params if p.startswith("F")
+                 and p[1:].isdigit()]
+        # F0/F1 free in NGC6440E -> F2 appears, frozen at zero
+        assert len(after) == len(before) + 1
+        newp = sorted(after, key=lambda p: int(p[1:]))[-1]
+        assert getattr(psr.model, newp).frozen
+        assert float(getattr(psr.model, newp).value) == 0.0
+        psr.resetAll()
+
+    def test_print_chi2_index_array_with_zero(self, psr):
+        """Regression: an index array containing 0 is a real selection,
+        not 'select nothing'."""
+        full = psr.print_chi2()
+        one = psr.print_chi2(np.array([0]))
+        assert one != full
+        assert "for -1 d.o.f" in one or "d.o.f" in one
