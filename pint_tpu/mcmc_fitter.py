@@ -25,7 +25,73 @@ from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
 from pint_tpu.sampler import EnsembleSampler, MCMCSampler
 
-__all__ = ["MCMCFitter"]
+__all__ = ["MCMCFitter", "MCMCFitterBinnedTemplate",
+           "MCMCFitterAnalyticTemplate", "set_priors_basic",
+           "lnprior_basic", "lnlikelihood_chi2", "concat_toas"]
+
+
+def __getattr__(name):
+    # the photon-template fitters live with the template machinery; keep the
+    # reference's import location working (reference ``mcmc_fitter.py:441``)
+    if name in ("MCMCFitterBinnedTemplate", "MCMCFitterAnalyticTemplate"):
+        import pint_tpu.event_fitter as ef
+
+        return getattr(ef, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def lnprior_basic(ftr, theta) -> float:
+    """Sum of parameter log-priors at ``theta`` (reference
+    ``mcmc_fitter.py lnprior_basic``).  Works for both the residual-chi2
+    fitter (via its BayesianTiming) and the photon-template fitters (via
+    the parameters' prior objects directly)."""
+    theta = np.asarray(theta, dtype=np.float64)
+    if hasattr(ftr, "bt"):
+        return float(ftr.bt.lnprior(theta))
+    return float(sum(getattr(ftr.model, p).prior.logpdf(v)
+                     for p, v in zip(ftr.fitkeys, theta)))
+
+
+def lnlikelihood_chi2(ftr, theta) -> float:
+    """Residual-based log-likelihood at ``theta`` (reference
+    ``mcmc_fitter.py lnlikelihood_chi2``).  Only defined for residual
+    fitters; the photon-template fitters have no chi2 likelihood."""
+    if not hasattr(ftr, "bt"):
+        raise TypeError(
+            f"{type(ftr).__name__} has no residual chi2 likelihood; use "
+            "its lnposterior (photon-template) instead")
+    return float(ftr.bt.lnlikelihood(np.asarray(theta, dtype=np.float64)))
+
+
+def set_priors_basic(ftr, priorerrfact: float = 10.0):
+    """Uniform priors at +/- priorerrfact * uncertainty around the current
+    values (reference ``mcmc_fitter.py set_priors_basic``); raises for a
+    free parameter with no uncertainty (the reference does too — a silent
+    skip would leave an improper prior that only fails much later)."""
+    from pint_tpu.bayesian import apply_prior_info
+
+    info = {}
+    for p in ftr.fitkeys:
+        par = getattr(ftr.model, p)
+        if not par.uncertainty:
+            raise ValueError(
+                f"Parameter {p} has no uncertainty; cannot build its "
+                "basic uniform prior")
+        half = priorerrfact * float(par.uncertainty)
+        v = float(par.value or 0.0)
+        info[p] = {"distr": "uniform", "pmin": v - half, "pmax": v + half}
+    apply_prior_info(ftr.model, info)
+    if hasattr(ftr, "_bt"):
+        ftr._bt = None  # cached BayesianTiming must see the new priors
+    return info
+
+
+def concat_toas(toas_list):
+    """Concatenate TOAs objects (reference ``mcmc_fitter.py concat_toas``;
+    alias of :func:`pint_tpu.toa.merge_TOAs`)."""
+    from pint_tpu.toa import merge_TOAs
+
+    return merge_TOAs(list(toas_list))
 
 
 class MCMCFitter(Fitter):
@@ -45,13 +111,26 @@ class MCMCFitter(Fitter):
         self.method = "MCMC"
         self.sampler = sampler or EnsembleSampler(nwalkers)
         self.errfact = errfact
-        self.bt = BayesianTiming(self.model, toas,
-                                 use_pulse_numbers=use_pulse_numbers,
-                                 prior_info=prior_info)
-        self.fitkeys = self.bt.param_labels
+        # BayesianTiming validates priors at construction; defer it so the
+        # reference flow (construct fitter, THEN set_priors_basic) works
+        self._bt: Optional[BayesianTiming] = None
+        self._bt_args = dict(use_pulse_numbers=use_pulse_numbers,
+                             prior_info=prior_info)
+        self.fitkeys = list(self.model.free_params)
         self.n_fit_params = len(self.fitkeys)
         self.maxpost = -np.inf
         self.maxpost_fitvals = None
+
+    @property
+    def bt(self) -> BayesianTiming:
+        if self._bt is not None \
+                and self._bt.param_labels != self.model.free_params:
+            self._bt = None  # free-parameter set changed since first build
+        if self._bt is None:
+            self._bt = BayesianTiming(self.model, self.toas, **self._bt_args)
+            self.fitkeys = list(self._bt.param_labels)
+            self.n_fit_params = len(self.fitkeys)
+        return self._bt
 
     def get_fitvals(self) -> np.ndarray:
         return np.array([float(getattr(self.model, p).value or 0.0)

@@ -86,13 +86,20 @@ class Pulsar:
         m = self.model
         if "Spindown" in m.components:
             c = m.components["Spindown"]
+            # count only params with a value: F1 exists by construction but
+            # may be unset when the par file stops at F0
             fs = sorted(int(p[1:]) for p in c.params
-                        if p.startswith("F") and p[1:].isdigit())
+                        if p.startswith("F") and p[1:].isdigit()
+                        and c._params_dict[p].value is not None)
             n = max(fs) + 1
             if f"F{n - 1}" in m.free_params:
-                c.add_param(c._params_dict["F1"].new_param(n, value=0.0),
-                            setup=True)
-                getattr(m, f"F{n}").units = f"Hz/s^{n}"
+                if f"F{n}" in c._params_dict:
+                    c._params_dict[f"F{n}"].value = 0.0
+                    c._params_dict[f"F{n}"].frozen = True
+                else:
+                    c.add_param(c._params_dict["F1"].new_param(n, value=0.0),
+                                setup=True)
+                    getattr(m, f"F{n}").units = f"Hz/s^{n}"
         for comp in m.components.values():
             if not type(comp).__name__.startswith("Binary"):
                 continue

@@ -260,3 +260,81 @@ class TestAutocorr:
         s2.initialize_batched(lnpost, ndim=1)
         s2.resume()
         assert len(s2._chain) == 123
+
+
+class TestMCMCModuleSurface:
+    def test_reference_import_locations(self):
+        from pint_tpu.mcmc_fitter import (MCMCFitterAnalyticTemplate,
+                                          MCMCFitterBinnedTemplate,
+                                          concat_toas)
+
+        assert callable(MCMCFitterBinnedTemplate)
+        assert callable(MCMCFitterAnalyticTemplate)
+        assert callable(concat_toas)
+        with pytest.raises(AttributeError):
+            from pint_tpu import mcmc_fitter
+            mcmc_fitter.no_such_thing
+
+    def test_priors_and_likelihood_helpers(self):
+        from pint_tpu.mcmc_fitter import (MCMCFitter, lnlikelihood_chi2,
+                                          lnprior_basic, set_priors_basic)
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR P\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "F1 -1e-14 1\n", "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        m.F0.uncertainty = 1e-9
+        m.F1.uncertainty = 1e-16
+        t = make_fake_toas_uniform(55000, 55200, 30, m, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(4))
+        f = MCMCFitter(t, m, nwalkers=10)
+        info = set_priors_basic(f, priorerrfact=10.0)
+        assert set(info) == {"F0", "F1"}
+        theta = f.get_fitvals()
+        lp = lnprior_basic(f, theta)
+        assert np.isfinite(lp)
+        # outside the uniform box the prior is -inf
+        theta_bad = theta.copy()
+        theta_bad[0] += 1e-7  # 100x the 10-sigma half width
+        assert lnprior_basic(f, theta_bad) == -np.inf
+        ll = lnlikelihood_chi2(f, theta)
+        assert np.isfinite(ll)
+        # moving off the fitted values must reduce the likelihood
+        theta_off = theta.copy()
+        theta_off[0] += 5e-9
+        assert lnlikelihood_chi2(f, theta_off) < ll
+
+    def test_set_priors_invalidates_cached_bt(self):
+        """Regression: tightening priors after a fit must take effect."""
+        from pint_tpu.mcmc_fitter import MCMCFitter, lnprior_basic, set_priors_basic
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR P2\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        m.F0.uncertainty = 1e-9
+        t = make_fake_toas_uniform(55000, 55200, 20, m, error_us=1.0)
+        f = MCMCFitter(t, m, nwalkers=10)
+        set_priors_basic(f, priorerrfact=10.0)
+        theta = f.get_fitvals()
+        theta_edge = theta.copy()
+        theta_edge[0] += 5e-9  # inside 10-sigma, outside 2-sigma
+        assert np.isfinite(lnprior_basic(f, theta_edge))
+        set_priors_basic(f, priorerrfact=2.0)
+        assert lnprior_basic(f, theta_edge) == -np.inf
+
+    def test_set_priors_requires_uncertainty(self):
+        from pint_tpu.mcmc_fitter import MCMCFitter, set_priors_basic
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR P3\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)  # F0 free but no uncertainty
+        t = make_fake_toas_uniform(55000, 55200, 10, m, error_us=1.0)
+        f = MCMCFitter(t, m, nwalkers=10)
+        with pytest.raises(ValueError, match="F0"):
+            set_priors_basic(f)

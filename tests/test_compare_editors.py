@@ -232,3 +232,23 @@ class TestPulsarHelpers:
         one = psr.print_chi2(np.array([0]))
         assert one != full
         assert "for -1 d.o.f" in one or "d.o.f" in one
+
+    def test_add_model_params_par_with_only_f0(self, tmp_path):
+        """Regression: a par stopping at F0 offers F1 (value-None F1 exists
+        structurally but must not block the offer)."""
+        from pint_tpu.pintk.pulsar import Pulsar
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = tmp_path / "f0only.par"
+        par.write_text("PSR F0ONLY\nRAJ 03:00:00\nDECJ 3:00:00\n"
+                       "F0 99.0 1\nPEPOCH 55100\nDM 10\nUNITS TDB\n")
+        m = get_model(str(par))
+        t = make_fake_toas_uniform(55000, 55100, 8, m, error_us=1.0)
+        tim = tmp_path / "f0only.tim"
+        t.write_TOA_file(str(tim))
+        psr = Pulsar(str(par), str(tim))
+        assert psr.model.F1.value is None
+        psr.add_model_params()
+        assert float(psr.model.F1.value) == 0.0
+        assert psr.model.F1.frozen
