@@ -46,7 +46,7 @@ def lnprior_basic(ftr, theta) -> float:
     fitter (via its BayesianTiming) and the photon-template fitters (via
     the parameters' prior objects directly)."""
     theta = np.asarray(theta, dtype=np.float64)
-    if hasattr(ftr, "bt"):
+    if isinstance(ftr, MCMCFitter):
         return float(ftr.bt.lnprior(theta))
     return float(sum(getattr(ftr.model, p).prior.logpdf(v)
                      for p, v in zip(ftr.fitkeys, theta)))
@@ -56,7 +56,7 @@ def lnlikelihood_chi2(ftr, theta) -> float:
     """Residual-based log-likelihood at ``theta`` (reference
     ``mcmc_fitter.py lnlikelihood_chi2``).  Only defined for residual
     fitters; the photon-template fitters have no chi2 likelihood."""
-    if not hasattr(ftr, "bt"):
+    if not isinstance(ftr, MCMCFitter):
         raise TypeError(
             f"{type(ftr).__name__} has no residual chi2 likelihood; use "
             "its lnposterior (photon-template) instead")
@@ -83,6 +83,8 @@ def set_priors_basic(ftr, priorerrfact: float = 10.0):
     apply_prior_info(ftr.model, info)
     if hasattr(ftr, "_bt"):
         ftr._bt = None  # cached BayesianTiming must see the new priors
+    if hasattr(ftr, "_batch_fn"):
+        ftr._batch_fn = None  # photon fitters bake prior specs in at build
     return info
 
 
@@ -128,8 +130,19 @@ class MCMCFitter(Fitter):
             self._bt = None  # free-parameter set changed since first build
         if self._bt is None:
             self._bt = BayesianTiming(self.model, self.toas, **self._bt_args)
-            self.fitkeys = list(self._bt.param_labels)
-            self.n_fit_params = len(self.fitkeys)
+            # the constructor's prior_info applies exactly once: a rebuild
+            # (after set_priors_basic or a free-param change) must keep the
+            # model's CURRENT priors, not resurrect the originals
+            self._bt_args["prior_info"] = None
+            if self.fitkeys != list(self._bt.param_labels):
+                if self.sampler.ntotal:
+                    log.warning(
+                        "Free-parameter set changed after sampling started; "
+                        "resetting the chain (old samples would mislabel "
+                        "columns)")
+                    self.sampler.reset()
+                self.fitkeys = list(self._bt.param_labels)
+                self.n_fit_params = len(self.fitkeys)
         return self._bt
 
     def get_fitvals(self) -> np.ndarray:

@@ -28,7 +28,21 @@ from pint_tpu.models.parameter import (
 )
 from pint_tpu.models.timing_model import Component, TimingModel
 
-__all__ = ["ModelBuilder", "get_model", "get_model_and_toas", "parse_parfile"]
+__all__ = ["ModelBuilder", "get_model", "get_model_and_toas",
+           "parse_parfile", "guess_binary_model"]
+
+
+def guess_binary_model(parfile_dict) -> list:
+    """Priority-ordered binary-model guesses for a parsed par-file dict
+    (reference ``model_builder.py:969``); the first entry is the best
+    guess.  Accepts the :func:`parse_parfile` output (or any mapping whose
+    keys are parameter names)."""
+    keys = {str(k).upper() for k in parfile_dict}
+    best = ModelBuilder.guess_t2_model(keys)
+    order = ["BinaryELL1H", "BinaryELL1k", "BinaryELL1", "BinaryDDK",
+             "BinaryDDS", "BinaryDDGR", "BinaryDDH", "BinaryDD", "BinaryBT"]
+    ranked = [best] + [m for m in order if m != best]
+    return [m[len("Binary"):] for m in ranked]
 
 #: par keys silently ignored (reference ``timing_model.py:96 ignore_params``)
 IGNORE_PARAMS = {
@@ -171,7 +185,8 @@ class ModelBuilder:
             f"BINARY {binary_name} is not supported (available: {available})"
         )
 
-    def guess_t2_model(self, keys) -> str:
+    @staticmethod
+    def guess_t2_model(keys) -> str:
         """Map a tempo2 'T2' binary to the closest implemented model from
         the parameters present (reference ``model_builder.py:969
         guess_binary_model``)."""

@@ -338,3 +338,23 @@ class TestMCMCModuleSurface:
         f = MCMCFitter(t, m, nwalkers=10)
         with pytest.raises(ValueError, match="F0"):
             set_priors_basic(f)
+
+    def test_ctor_priors_not_resurrected_after_set_priors(self):
+        """Regression: a bt rebuild must keep the model's current priors,
+        not re-apply the constructor's prior_info."""
+        from pint_tpu.mcmc_fitter import MCMCFitter, lnprior_basic, set_priors_basic
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR P4\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        m.F0.uncertainty = 1e-9
+        t = make_fake_toas_uniform(55000, 55200, 10, m, error_us=1.0)
+        wide = {"F0": {"distr": "uniform", "pmin": 98.0, "pmax": 100.0}}
+        f = MCMCFitter(t, m, nwalkers=10, prior_info=wide)
+        _ = f.bt  # build with the wide ctor priors
+        set_priors_basic(f, priorerrfact=2.0)  # ~2e-9 half-width
+        theta = f.get_fitvals()
+        theta[0] += 1e-4  # far outside basic priors, inside the wide ones
+        assert lnprior_basic(f, theta) == -np.inf

@@ -287,3 +287,17 @@ class TestComponents:
         m = get_model(parse_parfile(text + "\nXDOT 1.3\n"))
         # tempo convention: bare XDOT > 1e-7 is in units of 1e-12
         assert m.A1DOT.value == pytest.approx(1.3e-12)
+
+
+class TestGuessBinaryModel:
+    def test_priority_list_from_parfile_dict(self):
+        from pint_tpu.io.par import parse_parfile
+        from pint_tpu.models.model_builder import guess_binary_model
+
+        d = parse_parfile(["PSR X\n", "BINARY T2\n", "PB 1.0\n", "A1 2.0\n",
+                           "TASC 55000\n", "EPS1 1e-5\n", "H3 1e-7\n"])
+        guesses = guess_binary_model(d)
+        assert guesses[0] == "ELL1H"
+        assert "BT" in guesses and len(set(guesses)) == len(guesses)
+        d2 = parse_parfile(["PSR Y\n", "KIN 70\n", "KOM 90\n", "PB 1\n"])
+        assert guess_binary_model(d2)[0] == "DDK"
