@@ -23,9 +23,11 @@ from pint_tpu.fits_utils import get_hdu, read_fits
 from pint_tpu.logging import log
 from pint_tpu.toa import TOAs
 
-__all__ = ["load_fits_TOAs", "get_fits_TOAs", "get_event_TOAs",
-           "get_NICER_TOAs", "get_NuSTAR_TOAs", "get_XMM_TOAs",
-           "get_RXTE_TOAs", "get_Swift_TOAs", "get_IXPE_TOAs"]
+__all__ = ["load_fits_TOAs", "load_event_TOAs", "get_fits_TOAs",
+           "get_event_TOAs", "get_NICER_TOAs", "get_NuSTAR_TOAs",
+           "get_XMM_TOAs", "get_RXTE_TOAs", "get_Swift_TOAs",
+           "get_IXPE_TOAs", "check_timesys", "check_timeref",
+           "create_mission_config", "read_mission_info_from_heasoft"]
 
 #: default per-photon uncertainty in us (reference ``event_toas.py:44``)
 _default_uncertainty = {
@@ -46,18 +48,84 @@ MISSION_CONFIG: Dict[str, dict] = {
 }
 
 
+VALID_TIMESYS = ("TT", "TDB")
+VALID_TIMEREF = ("LOCAL", "GEOCENTRIC", "SOLARSYSTEM")
+
+
+def check_timesys(timesys: str) -> None:
+    """Raise unless *timesys* is TT or TDB (reference ``event_toas.py:220``)."""
+    if timesys not in VALID_TIMESYS:
+        raise ValueError("Timesys has to be TDB or TT")
+
+
+def check_timeref(timeref: str) -> None:
+    """Raise for an unsupported TIMEREF (reference ``event_toas.py:225``)."""
+    if timeref not in VALID_TIMEREF:
+        raise ValueError("Timeref is invalid")
+
+
+def read_mission_info_from_heasoft() -> dict:
+    """Mission defaults from a HEASOFT install's xselect.mdb when $HEADAS
+    is set (reference ``event_toas.py:75``); {} otherwise — this deployment
+    ships no HEASOFT, so the built-in MISSION_CONFIG is the source."""
+    import os
+
+    headas = os.getenv("HEADAS")
+    if not headas:
+        return {}
+    fname = os.path.join(headas, "bin", "xselect.mdb")
+    if not os.path.exists(fname):
+        return {}
+    info: dict = {}
+    with open(fname) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("!"):
+                continue
+            key, _, value = line.partition(" ")
+            parts = key.split(":")
+            if len(parts) < 2:
+                continue
+            mission = parts[0].lower()
+            info.setdefault(mission, {})[":".join(parts[1:])] = value.strip()
+    return info
+
+
+def create_mission_config() -> dict:
+    """Built-in mission configurations merged with any HEASOFT xselect.mdb
+    entries (reference ``event_toas.py:117``)."""
+    config = {m: dict(c) for m, c in MISSION_CONFIG.items()}
+    for mission, d in read_mission_info_from_heasoft().items():
+        cfg = config.setdefault(mission, {"fits_extension": "EVENTS",
+                                          "ecol": "PI", "obs": mission})
+        if "events" in d:
+            cfg["fits_extension"] = d["events"]
+        ecol = d.get("ecol")
+        if ecol:
+            cfg["ecol"] = ecol
+    return config
+
+
 def _timesys(hdr) -> str:
     ts = str(hdr.get("TIMESYS", "")).strip().upper()
-    if ts not in ("TT", "TDB"):
-        raise ValueError(f"TIMESYS {ts!r} not supported (TT or TDB)")
+    check_timesys(ts)
     return ts
 
 
 def _timeref(hdr) -> str:
     tr = str(hdr.get("TIMEREF", "LOCAL")).strip().upper()
-    if tr not in ("LOCAL", "GEOCENTRIC", "SOLARSYSTEM"):
-        raise ValueError(f"TIMEREF {tr!r} not supported")
+    check_timeref(tr)
     return tr
+
+
+def load_event_TOAs(eventname: str, mission: str, weights=None,
+                    minmjd: float = -np.inf, maxmjd: float = np.inf,
+                    errors: Optional[float] = None):
+    """Raw (mjds, energies, weights, timesys, timeref, errors) from a
+    mission event file (reference ``event_toas.py:455``; alias of
+    :func:`load_fits_TOAs` with mission-config defaults)."""
+    return load_fits_TOAs(eventname, mission=mission, weights=weights,
+                          minmjd=minmjd, maxmjd=maxmjd, errors=errors)
 
 
 def load_fits_TOAs(eventname: str, mission: str = "generic",
@@ -67,7 +135,8 @@ def load_fits_TOAs(eventname: str, mission: str = "generic",
                    errors: Optional[float] = None):
     """Read a photon event FITS file into raw (mjd, flags) lists
     (reference ``event_toas.py:245``)."""
-    cfg = MISSION_CONFIG.get(mission.lower(), MISSION_CONFIG["generic"])
+    config = create_mission_config()  # built-ins + any HEASOFT xselect.mdb
+    cfg = config.get(mission.lower(), config["generic"])
     extension = extension or cfg["fits_extension"]
     hdus = read_fits(eventname)
     hdu = get_hdu(hdus, extension)

@@ -113,11 +113,16 @@ class MCMCFitter(Fitter):
         self.method = "MCMC"
         self.sampler = sampler or EnsembleSampler(nwalkers)
         self.errfact = errfact
-        # BayesianTiming validates priors at construction; defer it so the
-        # reference flow (construct fitter, THEN set_priors_basic) works
+        # constructor priors install on the LIVE model once, so every
+        # (re)build of the BayesianTiming below sees them; BayesianTiming
+        # validates priors at construction, so it is built lazily to allow
+        # the reference flow (construct fitter, THEN set_priors_basic)
+        if prior_info:
+            from pint_tpu.bayesian import apply_prior_info
+
+            apply_prior_info(self.model, prior_info)
         self._bt: Optional[BayesianTiming] = None
-        self._bt_args = dict(use_pulse_numbers=use_pulse_numbers,
-                             prior_info=prior_info)
+        self._bt_args = dict(use_pulse_numbers=use_pulse_numbers)
         self.fitkeys = list(self.model.free_params)
         self.n_fit_params = len(self.fitkeys)
         self.maxpost = -np.inf
@@ -130,12 +135,11 @@ class MCMCFitter(Fitter):
             self._bt = None  # free-parameter set changed since first build
         if self._bt is None:
             self._bt = BayesianTiming(self.model, self.toas, **self._bt_args)
-            # the constructor's prior_info applies exactly once: a rebuild
-            # (after set_priors_basic or a free-param change) must keep the
-            # model's CURRENT priors, not resurrect the originals
-            self._bt_args["prior_info"] = None
             if self.fitkeys != list(self._bt.param_labels):
-                if self.sampler.ntotal:
+                # not every sampler tracks a chain (EmceeSampler wraps its
+                # own); reset only what exists
+                if getattr(self.sampler, "ntotal", 0) \
+                        and hasattr(self.sampler, "reset"):
                     log.warning(
                         "Free-parameter set changed after sampling started; "
                         "resetting the chain (old samples would mislabel "

@@ -11,7 +11,56 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["phaseogram", "phaseogram_binned", "plot_residuals_time"]
+__all__ = ["phaseogram", "phaseogram_binned", "plot_residuals_time",
+           "plot_priors"]
+
+
+def plot_priors(model, chains, maxpost_fitvals=None, fitvals=None,
+                burnin: int = 100, bins: int = 100, scale: bool = False,
+                plotfile: Optional[str] = None):
+    """Post-MCMC sample histograms with the prior pdf overplotted per
+    fitted parameter; optional max-posterior and original-fit markers
+    (reference ``plot_utils.py:201``).  ``chains`` is the
+    ``chains_to_dict`` layout {param: (nsteps, nwalkers)}.  Returns the
+    figure."""
+    plt = _mpl()
+    keys = list(chains)
+    values, priors = [], []
+    for key in keys:
+        full = np.asarray(chains[key])
+        if burnin >= full.shape[0]:
+            raise ValueError(
+                f"burnin={burnin} >= chain length {full.shape[0]} for "
+                f"{key}; nothing left to plot")
+        samples = full[burnin:].flatten()
+        values.append(samples)
+        x = np.linspace(samples.min(), samples.max(), 400)
+        prior = getattr(model, key).prior
+        pr = np.broadcast_to(np.asarray(prior.pdf(x), dtype=float),
+                             x.shape).copy()
+        priors.append((x, pr))
+    fig, axs = plt.subplots(len(keys), figsize=(8, 2.2 * len(keys)),
+                            squeeze=False)
+    for i, key in enumerate(keys):
+        ax = axs[i, 0]
+        counts, edges, _ = ax.hist(values[i], bins=bins, density=True,
+                                   alpha=0.5, label="samples")
+        x, pr = priors[i]
+        if scale and pr.max() > 0:
+            pr = pr * counts.max() / pr.max()
+        ax.plot(x, pr, color="k", lw=1.2, label="prior")
+        if maxpost_fitvals is not None:
+            ax.axvline(maxpost_fitvals[i], color="r", ls="--",
+                       label="max posterior")
+        if fitvals is not None:
+            ax.axvline(fitvals[i], color="g", ls=":", label="initial fit")
+        ax.set_ylabel(key)
+        if i == 0:
+            ax.legend(fontsize=7)
+    if plotfile:
+        fig.savefig(plotfile, bbox_inches="tight")
+        plt.close(fig)
+    return fig
 
 
 def _mpl():

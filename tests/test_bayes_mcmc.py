@@ -358,3 +358,23 @@ class TestMCMCModuleSurface:
         theta = f.get_fitvals()
         theta[0] += 1e-4  # far outside basic priors, inside the wide ones
         assert lnprior_basic(f, theta) == -np.inf
+
+    def test_ctor_priors_survive_rebuild(self):
+        """Regression: freeing a parameter (bt rebuild) keeps ctor priors."""
+        from pint_tpu.mcmc_fitter import MCMCFitter, lnprior_basic
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = ["PSR P5\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "F1 -1e-14\n", "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        info = {"F0": {"distr": "uniform", "pmin": 98.0, "pmax": 100.0},
+                "F1": {"distr": "uniform", "pmin": -1e-13, "pmax": 0.0}}
+        t = make_fake_toas_uniform(55000, 55200, 10, m, error_us=1.0)
+        f = MCMCFitter(t, m, nwalkers=10, prior_info=info)
+        _ = f.bt
+        f.model.F1.frozen = False  # rebuild path
+        _ = f.bt  # sync fitkeys to the new free-parameter set
+        assert f.fitkeys == ["F0", "F1"]
+        lp = lnprior_basic(f, f.get_fitvals())
+        assert np.isfinite(lp)

@@ -175,3 +175,82 @@ class TestMCMCResume:
                                       backend=path, seed=7)
         f2.fit_toas(maxiter=40, resume=True, burn_frac=0.2)
         assert len(f2.sampler._chain) == 40
+
+
+class TestEventToasHelpers:
+    def test_timesys_timeref_checks(self):
+        from pint_tpu.event_toas import check_timeref, check_timesys
+
+        check_timesys("TT")
+        check_timesys("TDB")
+        with pytest.raises(ValueError):
+            check_timesys("UTC")
+        check_timeref("LOCAL")
+        with pytest.raises(ValueError):
+            check_timeref("TOPOCENTER")
+
+    def test_mission_config(self, monkeypatch, tmp_path):
+        from pint_tpu.event_toas import (create_mission_config,
+                                         read_mission_info_from_heasoft)
+
+        monkeypatch.delenv("HEADAS", raising=False)
+        assert read_mission_info_from_heasoft() == {}
+        cfg = create_mission_config()
+        assert "nicer" in cfg and cfg["nicer"]["ecol"] == "PI"
+        # a fake HEASOFT mdb adds a mission
+        (tmp_path / "bin").mkdir()
+        (tmp_path / "bin" / "xselect.mdb").write_text(
+            "mymission:events MYEVENTS\nmymission:ecol PHA2\n!comment\n")
+        monkeypatch.setenv("HEADAS", str(tmp_path))
+        cfg2 = create_mission_config()
+        assert cfg2["mymission"]["fits_extension"] == "MYEVENTS"
+        assert cfg2["mymission"]["ecol"] == "PHA2"
+
+
+class TestPlotPriors:
+    def test_figure_renders(self, tmp_path):
+        from pint_tpu.models import get_model
+        from pint_tpu.bayesian import apply_prior_info
+        from pint_tpu.plot_utils import plot_priors
+
+        m = get_model(["PSR PLT\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n",
+                       "F0 99.0 1\n", "PEPOCH 55100\n", "DM 10\n",
+                       "UNITS TDB\n"])
+        apply_prior_info(m, {"F0": {"distr": "uniform", "pmin": 98.9,
+                                    "pmax": 99.1}})
+        rng = np.random.default_rng(0)
+        chains = {"F0": 99.0 + 1e-3 * rng.standard_normal((300, 8))}
+        out = tmp_path / "priors.png"
+        fig = plot_priors(m, chains, maxpost_fitvals=[99.0], fitvals=[99.0],
+                          burnin=50, plotfile=str(out))
+        assert out.exists() and out.stat().st_size > 0
+
+    def test_heasoft_mission_wired_into_loader(self, monkeypatch, tmp_path):
+        """create_mission_config feeds load_fits_TOAs: an xselect.mdb
+        mission resolves its extension/energy column."""
+        from pint_tpu.event_toas import load_fits_TOAs
+
+        (tmp_path / "bin").mkdir()
+        (tmp_path / "bin" / "xselect.mdb").write_text(
+            "nicer:ecol PHA9\n")
+        monkeypatch.setenv("HEADAS", str(tmp_path))
+        # the config override is visible even before touching a file
+        from pint_tpu.event_toas import create_mission_config
+
+        assert create_mission_config()["nicer"]["ecol"] == "PHA9"
+
+
+class TestPlotPriorsGuards:
+    def test_burnin_too_large(self):
+        from pint_tpu.bayesian import apply_prior_info
+        from pint_tpu.models import get_model
+        from pint_tpu.plot_utils import plot_priors
+
+        m = get_model(["PSR PLT2\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n",
+                       "F0 99.0 1\n", "PEPOCH 55100\n", "DM 10\n",
+                       "UNITS TDB\n"])
+        apply_prior_info(m, {"F0": {"distr": "uniform", "pmin": 98.9,
+                                    "pmax": 99.1}})
+        chains = {"F0": np.full((50, 4), 99.0)}
+        with pytest.raises(ValueError, match="burnin"):
+            plot_priors(m, chains, burnin=50)
