@@ -32,7 +32,8 @@ from pint_tpu.logging import log
 from pint_tpu.observatory import get_observatory
 
 __all__ = ["TOA", "TOAs", "TOABatch", "get_TOAs", "get_TOAs_list",
-           "get_TOAs_array", "merge_TOAs", "make_single_toa"]
+           "get_TOAs_array", "merge_TOAs", "make_single_toa",
+           "load_pickle", "save_pickle", "read_toa_file"]
 
 C_KM_S = C_M_S / 1e3
 DAY_S = 86400.0
@@ -741,6 +742,55 @@ def get_TOAs_array(times, obs: str, errors=1.0, freqs=np.inf, flags=None,
                           bipm_version, limits)
 
 
+def load_pickle(toafilename: str,
+                picklefilename: Optional[str] = None) -> "TOAs":
+    """Load pickled TOAs, un-gzipping if necessary (reference
+    ``toa.py:333``): tries ``<name>.pickle.gz``, ``<name>.pickle``, and
+    the bare name unless an explicit pickle path is given.  Content is
+    sniffed (gzip magic), so a gzipped pickle under any name loads; an
+    unreadable candidate falls through to the next."""
+    import gzip
+
+    candidates = ([picklefilename] if picklefilename is not None else
+                  [toafilename + ".pickle.gz", toafilename + ".pickle",
+                   toafilename])
+    for cand in candidates:
+        if not os.path.exists(cand):
+            continue
+        try:
+            with open(cand, "rb") as f:
+                gzipped = f.read(2) == b"\x1f\x8b"
+            opener = gzip.open if gzipped else open
+            with opener(cand, "rb") as f:
+                return pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError, ValueError):
+            continue  # e.g. a truncated .gz next to a valid .pickle
+    raise IOError(f"No readable pickle found for {toafilename}")
+
+
+def save_pickle(toas: "TOAs", picklefilename: Optional[str] = None) -> None:
+    """Write TOAs to a ``.pickle.gz`` (reference ``toa.py:373``); the
+    default name derives from the TOAs' source tim file.  Merged TOAs
+    (no single source file) require an explicit name."""
+    import gzip
+
+    if picklefilename is None:
+        if not toas.filename:
+            raise ValueError(
+                "TOAs have no (single) source filename; please provide "
+                "picklefilename")
+        picklefilename = str(toas.filename) + ".pickle.gz"
+    opener = gzip.open if str(picklefilename).endswith(".gz") else open
+    with opener(picklefilename, "wb") as f:
+        pickle.dump(toas, f)
+
+
+def read_toa_file(filename):
+    """(raw TOAs, commands) from a tim file — reference ``toa.py:701``
+    naming for :func:`pint_tpu.io.tim.read_tim_file`."""
+    return read_tim_file(filename)
+
+
 PICKLE_SUFFIX = ".pint_tpu_toas.pickle"
 
 
@@ -851,6 +901,10 @@ def merge_TOAs(toas_list: List[TOAs]) -> TOAs:
     if all(t.planet_pos_km.keys() == first.planet_pos_km.keys() for t in toas_list):
         for k in first.planet_pos_km:
             out.planet_pos_km[k] = np.concatenate([t.planet_pos_km[k] for t in toas_list])
+    if len(toas_list) > 1:
+        # no single source file: save_pickle must demand an explicit name
+        # rather than silently writing under the first input's name
+        out.filename = None
     return out
 
 

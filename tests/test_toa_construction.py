@@ -127,3 +127,62 @@ class TestReviewRegressions:
         sub.get_clusters(gap_limit_hr=2.0, add_column=True)
         assert "cluster" in sub.flags[0]
         assert "cluster" not in t.flags[0]  # parent untouched
+
+
+class TestModulePickle:
+    def test_gz_roundtrip_and_search(self, model, tmp_path):
+        from pint_tpu.toa import get_TOAs_array, load_pickle, save_pickle
+
+        t = get_TOAs_array(np.array([55000.0, 55001.0]), "gbt", model=model)
+        t.filename = str(tmp_path / "x.tim")
+        save_pickle(t)  # default: <tim>.pickle.gz
+        assert (tmp_path / "x.tim.pickle.gz").exists()
+        t2 = load_pickle(str(tmp_path / "x.tim"))
+        assert len(t2) == 2
+        np.testing.assert_allclose(
+            np.asarray(t2.tdb, dtype=np.float64),
+            np.asarray(t.tdb, dtype=np.float64))
+        with pytest.raises(IOError):
+            load_pickle(str(tmp_path / "missing.tim"))
+
+    def test_read_toa_file_alias(self):
+        from pint_tpu.toa import read_toa_file
+
+        raw, commands = read_toa_file(
+            "/root/reference/src/pint/data/examples/NGC6440E.tim")
+        assert len(raw) == 62
+
+    def test_load_pickle_robustness(self, model, tmp_path):
+        """Gzip sniffing by content, fall-through past corrupt candidates,
+        bare-name candidate."""
+        import gzip
+        import pickle as pkl
+
+        from pint_tpu.toa import get_TOAs_array, load_pickle
+
+        t = get_TOAs_array(np.array([55000.0]), "gbt", model=model)
+        # gzipped content under a non-.gz name still loads
+        odd = tmp_path / "cache.pickle"
+        with gzip.open(odd, "wb") as f:
+            pkl.dump(t, f)
+        assert len(load_pickle("x", picklefilename=str(odd))) == 1
+        # corrupt .gz next to a valid .pickle: falls through
+        base = tmp_path / "y.tim"
+        (tmp_path / "y.tim.pickle.gz").write_bytes(b"\x1f\x8b garbage")
+        with open(tmp_path / "y.tim.pickle", "wb") as f:
+            pkl.dump(t, f)
+        assert len(load_pickle(str(base))) == 1
+        # bare-name candidate: the pickle path itself
+        assert len(load_pickle(str(tmp_path / "y.tim.pickle"))) == 1
+
+    def test_save_pickle_refuses_merged(self, model, tmp_path):
+        from pint_tpu.toa import get_TOAs_array, merge_TOAs, save_pickle
+
+        a = get_TOAs_array(np.array([55000.0]), "gbt", model=model)
+        b = get_TOAs_array(np.array([55001.0]), "gbt", model=model)
+        a.filename = str(tmp_path / "a.tim")
+        merged = merge_TOAs([a, b])
+        assert merged.filename is None
+        with pytest.raises(ValueError, match="picklefilename"):
+            save_pickle(merged)
+        save_pickle(merged, str(tmp_path / "m.pickle.gz"))  # explicit OK
