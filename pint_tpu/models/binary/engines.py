@@ -38,10 +38,17 @@ TWO_PI = 2.0 * math.pi
 def solve_kepler(M, e, niter: int = 15):
     """E - e sin E = M by Newton iteration (fixed count: trace-friendly;
     15 iterations converge to <1e-15 for e <= 0.95; reference
-    ``binary_generic.py:335`` iterates to 5e-15)."""
+    ``binary_generic.py:335`` iterates to 5e-15).
+
+    Steps are clamped to |dE| <= 1: near e -> 1 with small M the derivative
+    1 - e cos E vanishes at the start point and raw Newton overshoots by
+    ~1/(1-e) and never recovers; the clamp turns that into steady progress
+    while leaving converged iterates (tiny steps) untouched.
+    """
     E = M + e * jnp.sin(M)
     for _ in range(niter):
-        E = E - (E - e * jnp.sin(E) - M) / (1.0 - e * jnp.cos(E))
+        dE = (E - e * jnp.sin(E) - M) / (1.0 - e * jnp.cos(E))
+        E = E - jnp.clip(dE, -1.0, 1.0)
     return E
 
 

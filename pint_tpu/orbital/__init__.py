@@ -1,0 +1,3 @@
+"""Standalone Keplerian-orbit utilities (reference ``pint/orbital/``)."""
+
+from pint_tpu.orbital import kepler  # noqa: F401

@@ -1,0 +1,56 @@
+"""Random-model draws for residual-plot overlays (reference
+``random_models.py:15``).
+
+``random_models`` extends :func:`pint_tpu.simulation.calculate_random_models`
+with the reference's plotting conveniences: evenly spaced fake TOAs
+stretched beyond the fitted span (edge multipliers), and per-draw residual
+objects offset to the data's mean residual for overplotting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["random_models"]
+
+
+def random_models(fitter, rs_mean: float, ledge_multiplier: float = 4.0,
+                  redge_multiplier: float = 4.0, iter: int = 1,
+                  npoints: int = 100, rng=None):
+    """(fake TOAs, list of per-draw residual arrays [s]) for overlay plots
+    (reference ``random_models.py:15``): draws models from the post-fit
+    covariance and evaluates them on ``npoints`` evenly spaced fake TOAs
+    spanning the fitted TOAs stretched ``ledge/redge_multiplier`` spans to
+    either side.  ``rs_mean`` (seconds) recenters the curves on the data's
+    mean residual."""
+    from pint_tpu.simulation import calculate_random_models, make_fake_toas_fromMJDs
+
+    toas = fitter.toas
+    mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+    span = mjds.max() - mjds.min()
+    left = mjds.min() - ledge_multiplier * span
+    right = mjds.max() + redge_multiplier * span
+    fake_mjds = np.linspace(left, right, int(npoints))
+    freqs = np.asarray(toas.freq_mhz, dtype=np.float64)
+    f_plot = float(np.median(freqs[np.isfinite(freqs)])) \
+        if np.any(np.isfinite(freqs)) else 1400.0
+    fake = make_fake_toas_fromMJDs(fake_mjds, fitter.model, freq=f_plot,
+                                   obs=str(toas.obs[0]), error_us=1.0)
+    # draw ONCE (keep the models) so the fake-span curves and the
+    # fitted-span recentering use the same parameter draws
+    dphase_fake, models = calculate_random_models(
+        fitter, fake, Nmodels=int(iter), keep_models=True, rng=rng)
+    F0 = float(fitter.model.F0.value)
+    base = fitter.model.phase(toas)
+    base_val = np.asarray(base.int_) + np.asarray(base.frac)
+    rss = []
+    for k, m in enumerate(models):
+        # each curve is recentered by ITS OWN mean offset over the fitted
+        # TOAs (reference random_models.py subtracts rs2.frac.mean()), so
+        # draws dominated by a constant phase shift still pass through the
+        # data rather than plotting as displaced lines
+        ph = m.phase(toas)
+        mean_data = float(np.mean((np.asarray(ph.int_)
+                                   + np.asarray(ph.frac)) - base_val))
+        rss.append((dphase_fake[k] - mean_data) / F0 + float(rs_mean))
+    return fake, rss
