@@ -76,7 +76,9 @@ class Fitter:
         return {p: getattr(self.model, p).value for p in self.model.free_params}
 
     def get_designmatrix(self):
-        return self.model.designmatrix(self.toas)
+        # iterative fits recompute M every step; constant (linear) columns
+        # come from the model's cache (timing_model._jac_frac_linear_cached)
+        return self.model.designmatrix(self.toas, reuse_linear=True)
 
     def get_parameter_correlation_matrix(self):
         cov = self.parameter_covariance_matrix

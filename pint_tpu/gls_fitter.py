@@ -69,7 +69,7 @@ def build_augmented_system(model, toas, wideband: bool = False):
     stacked [toa; dm] blocks, noise basis padded with zero DM rows), plus
     (params, norm, phiinv, Nvec, noise_dims).  Single source of truth for
     the 1e40 timing-prior weighting and basis padding."""
-    M_tm, params, units = model.designmatrix(toas)
+    M_tm, params, units = model.designmatrix(toas, reuse_linear=True)
     if wideband:
         M_dm, _, _ = model.dm_designmatrix(toas)
         M_q = np.vstack([M_tm, M_dm])

@@ -89,11 +89,12 @@ def _classify_linear_columns(jac_fn, free_init, const_pv, batch, ctx,
     is exact regardless; only the Gauss-Newton trajectory is shaped by the
     split.
     """
+    from pint_tpu.utils import classify_linear_columns, linearity_probe_steps
+
     J0_full = np.asarray(jac_fn(free_init, const_pv, batch, ctx))
     J0 = J0_full[:, :nfit]
-    col_rms = np.linalg.norm(J0_full, axis=0) / np.sqrt(J0_full.shape[0])
-    dp = 1e-3 / np.maximum(col_rms, 1e-300)
-    dp[col_rms == 0] = 0.0
+    dp = linearity_probe_steps(J0_full)
+    dp[~np.isfinite(dp)] = 0.0  # zero columns: no point perturbing
     for gi in range(ngrid):
         gv = float(np.asarray(free_init)[nfit + gi])
         span = 0.0
@@ -105,9 +106,7 @@ def _classify_linear_columns(jac_fn, free_init, const_pv, batch, ctx,
     v_pert = np.asarray(free_init) + dp
     J1 = np.asarray(jac_fn(jnp.asarray(v_pert), const_pv, batch,
                            ctx))[:, :nfit]
-    dcol = np.linalg.norm(J1 - J0, axis=0)
-    ncol = np.linalg.norm(J0, axis=0)
-    nl_fit = np.nonzero(dcol > 1e-7 * (ncol + 1e-300))[0]
+    nl_fit = classify_linear_columns(J0, J1)
     return J0, nl_fit
 
 
@@ -388,14 +387,9 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
-    # span = farthest grid value from the model's current value, so a
-    # single distant point still probes the cross-coupling
-    spans = []
-    for p, g in zip(parnames, grids):
-        cur = float(getattr(model, p).value or 0.0)
-        spans.append(float(np.max(np.abs(g - cur))) if len(g) else 0.0)
     fn, _ = build_grid_chi2_fn(model, toas, parnames, niter=niter,
-                               grid_spans=spans)
+                               grid_spans=_point_spans(model, parnames,
+                                                       mesh_pts))
     pts = jnp.asarray(mesh_pts)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -417,6 +411,19 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     return chi2.reshape(shape), {}
 
 
+def _point_spans(model, parnames, pts) -> list:
+    """Classification spans from an explicit point list: the farthest each
+    parameter's points sit from the model's current value.  Shared by every
+    grid entry point so identical points always classify — and therefore
+    evaluate — identically."""
+    spans = []
+    for j, p in enumerate(parnames):
+        cur = float(getattr(model, p).value or 0.0)
+        col = np.asarray(pts)[:, j]
+        spans.append(float(np.max(np.abs(col - cur))) if len(col) else 0.0)
+    return spans
+
+
 def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
                        gridvalues: Sequence, niter: int = 4,
                        **kw) -> Tuple[np.ndarray, list, dict]:
@@ -430,7 +437,8 @@ def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
     pts = np.stack(
         [np.asarray([f(*vals) for vals in zip(*flat)], dtype=np.float64)
          for f in parfuncs], axis=-1)
-    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter)
+    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter,
+                               grid_spans=_point_spans(model, parnames, pts))
     chi2 = np.asarray(fn(jnp.asarray(pts)))
     out_grids = [g.reshape(shape) for g in mesh_arrays]
     return chi2.reshape(shape), out_grids, {}
@@ -441,9 +449,10 @@ def tuple_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     """Chi2 at an explicit list of parameter tuples (reference
     ``gridutils.py:586``)."""
     model, toas = ftr.model, ftr.toas
-    pts = jnp.asarray(np.asarray(parvalues, dtype=np.float64))
-    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter)
-    return np.asarray(fn(pts)), {}
+    pts = np.asarray(parvalues, dtype=np.float64)
+    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter,
+                               grid_spans=_point_spans(model, parnames, pts))
+    return np.asarray(fn(jnp.asarray(pts))), {}
 
 
 def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
@@ -456,6 +465,7 @@ def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
     pts = np.stack(
         [np.asarray([f(*vals) for vals in raw], dtype=np.float64)
          for f in parfuncs], axis=-1)
-    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter)
+    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter,
+                               grid_spans=_point_spans(model, parnames, pts))
     out_values = [raw[:, i] for i in range(raw.shape[1])]
     return np.asarray(fn(jnp.asarray(pts))), out_values, {}

@@ -641,16 +641,124 @@ class TimingModel:
         c = self._get_compiled(toas, tuple(self.free_params))
         return c["eval"](self._free_values(c["free_names"]))
 
-    def designmatrix(self, toas, incfrozen: bool = False, incoffset: bool = True):
+    def _frozen_fingerprint(self, free) -> tuple:
+        """Values of the non-free continuous parameters: the linear-column
+        cache must reseed when any of them is edited directly (a column
+        linear in the FREE params can still be a function of frozen ones)."""
+        free_set = set(free)
+        out = []
+        for comp in self.components.values():
+            for p in comp.params:
+                if p in free_set:
+                    continue
+                v = comp._params_dict[p].value
+                if isinstance(v, (int, float)):
+                    out.append((p, float(v)))
+        return tuple(out)
+
+    def _jac_frac_linear_cached(self, toas, free, c) -> np.ndarray:
+        """d frac/d params with constant (linear-parameter) columns cached.
+
+        Most NANOGrav-scale columns (DMX bins, jumps, FD) are exactly
+        constant in the parameter values, and the reference profile shows
+        the design matrix as the benchmark's dominant cost (SURVEY §6:
+        68%).  Classification is LAZY: the first call costs exactly one
+        Jacobian (one-shot fits pay nothing extra); the second call runs
+        the ~1e-3-cycle probe to split columns, after which only the
+        nonlinear subset is re-derived per call.
+
+        Entries live in a WeakKeyDictionary keyed by the TOAs object (same
+        anti-aliasing rationale as ``_get_compiled``'s data cache) and
+        reseed when the TOAs version, the frozen-parameter values, or a
+        free-parameter step beyond the probed envelope invalidates them.
+        """
+        import weakref
+
+        values = np.asarray(self._free_values(free))
+        store = self._cache.setdefault("lincols",
+                                       weakref.WeakKeyDictionary())
+        per_toas = store.get(toas)
+        if per_toas is None:
+            per_toas = {}
+            store[toas] = per_toas
+        ver = getattr(toas, "_version", 0)
+        frozen = self._frozen_fingerprint(free)
+        entry = per_toas.get(free)
+        if entry is not None and (entry["ver"] != ver
+                                  or entry["frozen"] != frozen):
+            entry = None
+        if entry is not None and entry["dp"] is not None and np.any(
+                np.abs(values - entry["values0"]) > entry["dp"]):
+            # the classification was only probed over a ~1e-3-cycle
+            # envelope; a step that leaves it could expose curvature in a
+            # "linear" column (converging fits leave it at most once)
+            entry = None
+        if entry is None:
+            # lazy seed: one exact Jacobian, no probe yet
+            J0 = np.asarray(c["jac_frac"](values))
+            per_toas[free] = {"ver": ver, "frozen": frozen, "J0": J0,
+                              "values0": values, "dp": None, "nl": None,
+                              "sub_jac": None}
+            return J0
+        if entry["nl"] is None:
+            # second call: classify now (the fit is iterating, so the
+            # probe's cost amortizes from here on)
+            from pint_tpu.utils import (classify_linear_columns,
+                                        linearity_probe_steps)
+
+            dp = linearity_probe_steps(entry["J0"])
+            if np.any(np.abs(values - entry["values0"]) > dp):
+                # first step already left the envelope: reseed at the new
+                # values and stay lazy
+                J0 = np.asarray(c["jac_frac"](values))
+                per_toas[free] = {"ver": ver, "frozen": frozen, "J0": J0,
+                                  "values0": values, "dp": None, "nl": None,
+                                  "sub_jac": None}
+                return J0
+            J1 = np.asarray(c["jac_frac"](jnp.asarray(
+                entry["values0"] + np.where(np.isfinite(dp), dp, 0.0))))
+            nl = classify_linear_columns(entry["J0"], J1)
+            entry["dp"] = dp
+            entry["nl"] = nl
+            if len(nl):
+                fns = self._cache["fns"][(free, len(toas))]
+                eval_fn = fns["eval"]
+                nl_idx = jnp.asarray(nl, dtype=jnp.int32)
+
+                def sub_jac(vals, const_pv, batch, ctx):
+                    def f(sub):
+                        ph, _ = eval_fn(vals.at[nl_idx].set(sub), const_pv,
+                                        batch, ctx)
+                        return ph.frac
+                    return jax.jacfwd(f)(vals[nl_idx])
+
+                entry["sub_jac"] = jax.jit(sub_jac)
+        J = entry["J0"].copy()
+        if entry["sub_jac"] is not None:
+            const_pv = self._const_pv()
+            data_entry = self._cache["data"][toas]
+            batch, ctx = data_entry[1], data_entry[2]
+            J[:, entry["nl"]] = np.asarray(
+                entry["sub_jac"](jnp.asarray(values), const_pv, batch, ctx))
+        return J
+
+    def designmatrix(self, toas, incfrozen: bool = False,
+                     incoffset: bool = True, reuse_linear: bool = False):
         """(M, names, units): M columns are -d_phase_d_param/F0 [+ offset].
 
         Derivatives come from jax.jacfwd through the full (dd-precision)
         phase function — covering every continuous parameter with no
         hand-registered partials (reference ``timing_model.py:2174``).
+        With ``reuse_linear=True`` (iterative fitters) constant columns are
+        served from cache and only genuinely nonlinear ones recomputed —
+        see :meth:`_jac_frac_linear_cached`.
         """
         free = self.design_param_names(incfrozen=incfrozen)
         c = self._get_compiled(toas, free)
-        J = np.asarray(c["jac_frac"](self._free_values(free)))  # (N, nfree)
+        if reuse_linear:
+            J = self._jac_frac_linear_cached(toas, free, c)
+        else:
+            J = np.asarray(c["jac_frac"](self._free_values(free)))  # (N, nfree)
         F0 = float(self.F0.value)
         incoffset = incoffset and "PhaseOffset" not in self.components
         names = (["Offset"] if incoffset else []) + list(free)

@@ -147,6 +147,26 @@ def weighted_mean(arr, weights, axis=None):
     return mean, err
 
 
+def linearity_probe_steps(J0: "np.ndarray") -> "np.ndarray":
+    """Per-parameter probe steps moving the phase ~1e-3 cycles RMS — the
+    scale on which design-matrix columns are tested for constancy (shared
+    by the grid kernels and the fitter design-matrix cache).  Zero columns
+    get an infinite envelope (any step is fine for them)."""
+    col_rms = np.linalg.norm(J0, axis=0) / np.sqrt(max(J0.shape[0], 1))
+    dp = 1e-3 / np.maximum(col_rms, 1e-300)
+    dp[col_rms == 0] = np.inf
+    return dp
+
+
+def classify_linear_columns(J0: "np.ndarray", J1: "np.ndarray") -> "np.ndarray":
+    """Indices of columns that MOVED between the two Jacobian evaluations
+    (relative change > 1e-7): the nonlinear set; everything else is served
+    as a constant."""
+    dcol = np.linalg.norm(J1 - J0, axis=0)
+    ncol = np.linalg.norm(J0, axis=0)
+    return np.nonzero(dcol > 1e-7 * (ncol + 1e-300))[0]
+
+
 def normalize_designmatrix(M, params=None):
     """Scale each design-matrix column to unit L2 norm (reference ``utils.py:2872``).
 
