@@ -12,8 +12,8 @@ import numpy as np
 
 from pint_tpu.logging import log
 
-__all__ = ["DMXRange", "dmx_ranges", "dmx_setup", "dmxparse",
-           "xxxselections", "dmxselections", "dmxstats",
+__all__ = ["DMXRange", "dmx_ranges", "dmx_ranges_old", "dmx_setup",
+           "dmxparse", "xxxselections", "dmxselections", "dmxstats",
            "get_prefix_timerange", "get_prefix_timeranges",
            "find_prefix_bytime", "merge_dmx", "split_dmx", "split_swx"]
 
@@ -34,6 +34,35 @@ class DMXRange:
                 f"{len(self.los)} low-freq TOAs, {len(self.his)} high-freq TOAs")
 
 
+def _ranges_to_component(ranges: List["DMXRange"], mjds: np.ndarray,
+                         verbose: bool):
+    """(TOA mask, populated DispersionDMX component) from DMXRange bins —
+    the shared tail of every range-construction strategy."""
+    from pint_tpu.models.dispersion_model import DispersionDMX
+    from pint_tpu.models.parameter import prefixParameter
+
+    mask = np.zeros(len(mjds), dtype=bool)
+    comp = DispersionDMX()
+    for i, rng in enumerate(ranges, start=1):
+        mask |= (mjds >= rng.min) & (mjds <= rng.max)
+        if i > 1:
+            comp.add_param(prefixParameter(f"DMX_{i:04d}", units="pc/cm3",
+                                           value=0.0,
+                                           description="DM offset in range"))
+            comp.add_param(prefixParameter(f"DMXR1_{i:04d}", units="MJD",
+                                           description="Range start MJD"))
+            comp.add_param(prefixParameter(f"DMXR2_{i:04d}", units="MJD",
+                                           description="Range end MJD"))
+        getattr(comp, f"DMX_{i:04d}").value = 0.0
+        getattr(comp, f"DMX_{i:04d}").frozen = False
+        getattr(comp, f"DMXR1_{i:04d}").value = rng.min
+        getattr(comp, f"DMXR2_{i:04d}").value = rng.max
+        if verbose:
+            log.info(rng.sum_print())
+    comp.setup()
+    return mask, comp
+
+
 def dmx_ranges(toas, divide_freq: float = 1000.0, binwidth: float = 15.0,
                verbose: bool = False):
     """Compute initial DMX ranges for a set of TOAs (reference
@@ -44,9 +73,6 @@ def dmx_ranges(toas, divide_freq: float = 1000.0, binwidth: float = 15.0,
     Returns ``(mask, component)``: a bool array marking TOAs assigned to a
     bin, and a :class:`DispersionDMX` component populated with the ranges.
     """
-    from pint_tpu.models.dispersion_model import DispersionDMX
-    from pint_tpu.models.parameter import prefixParameter
-
     mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
     freqs = np.asarray(toas.freq_mhz, dtype=np.float64)
 
@@ -68,28 +94,66 @@ def dmx_ranges(toas, divide_freq: float = 1000.0, binwidth: float = 15.0,
         raise ValueError(
             f"dmx_ranges: no bin has TOAs on both sides of "
             f"{divide_freq} MHz within {binwidth} d - cannot build DMX")
-    mask = np.zeros(len(mjds), dtype=bool)
-    comp = DispersionDMX()
-    for i, rng in enumerate(ranges, start=1):
-        mask |= (mjds >= rng.min) & (mjds <= rng.max)
-        if i > 1:
-            comp.add_param(prefixParameter(f"DMX_{i:04d}", units="pc/cm3",
-                                           value=0.0,
-                                           description="DM offset in range"))
-            comp.add_param(prefixParameter(f"DMXR1_{i:04d}", units="MJD",
-                                           description="Range start MJD"))
-            comp.add_param(prefixParameter(f"DMXR2_{i:04d}", units="MJD",
-                                           description="Range end MJD"))
-        getattr(comp, f"DMX_{i:04d}").value = 0.0
-        getattr(comp, f"DMX_{i:04d}").frozen = False
-        getattr(comp, f"DMXR1_{i:04d}").value = rng.min
-        getattr(comp, f"DMXR2_{i:04d}").value = rng.max
-        if verbose:
-            log.info(rng.sum_print())
-    comp.setup()
+    mask, comp = _ranges_to_component(ranges, mjds, verbose)
     log.info(f"dmx_ranges: {len(ranges)} bins cover {mask.sum()}/{len(mjds)} "
              f"TOAs")
     return mask, comp
+
+
+def dmx_ranges_old(toas, divide_freq: float = 1000.0, offset: float = 0.01,
+                   max_diff: float = 15.0, verbose: bool = False):
+    """Legacy DMX binning (reference ``utils.py:604``, after TEMPO's
+    DMX_ranges2): each low-frequency epoch anchors a bin holding the
+    high-frequency epochs that sit closer to it than to its neighbors,
+    within ``max_diff`` days; unmatched low epochs fold into the nearest
+    existing bin when possible.  Returns (mask, DispersionDMX component)
+    like :func:`dmx_ranges`."""
+    from pint_tpu.models.dispersion_model import DispersionDMX
+    from pint_tpu.models.parameter import prefixParameter
+
+    mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+    freqs = np.asarray(toas.freq_mhz, dtype=np.float64)
+    lo = np.unique(np.round(mjds[freqs < divide_freq], 1))
+    # >= so boundary-frequency TOAs count as high band, consistent with
+    # dmx_ranges above (the TEMPO original used a strict >)
+    hi = np.unique(np.round(mjds[freqs >= divide_freq], 1))
+    # epochs are rounded to 0.1 d, so the bin buffer must cover at least
+    # the 0.05 d rounding quantum or a TOA can sit outside the very bin
+    # its rounded epoch anchors
+    buffer_d = max(float(offset), 0.051)
+
+    ranges: List[DMXRange] = []
+    bad_los = []
+    for ii, lm in enumerate(lo):
+        close = hi[np.abs(hi - lm) < max_diff]
+        if ii > 0:
+            close = close[np.abs(close - lm) < np.abs(close - lo[ii - 1])]
+        if ii < len(lo) - 1:
+            close = close[np.abs(close - lm) < np.abs(close - lo[ii + 1])]
+        if len(close):
+            ranges.append(DMXRange([lm], list(close), buffer_d=buffer_d))
+        else:
+            bad_los.append(lm)
+    # fold orphan low epochs into the nearest bin, requiring BOTH edges
+    # within max_diff and ranking by the nearest edge (TEMPO semantics)
+    for bl in bad_los:
+        best, bestdiff = None, 2 * max_diff
+        for rng in ranges:
+            if abs(bl - rng.min) < max_diff and abs(bl - rng.max) < max_diff:
+                diff = min(abs(bl - rng.min), abs(bl - rng.max))
+                if diff < bestdiff:
+                    best, bestdiff = rng, diff
+        if best is not None:
+            best.los.append(bl)
+            best.los.sort()
+            best.min = min(best.min, bl - buffer_d)
+            best.max = max(best.max, bl + buffer_d)
+    if not ranges:
+        raise ValueError(
+            f"dmx_ranges_old: no low/high frequency pairs within "
+            f"{max_diff} d around {divide_freq} MHz")
+    ranges.sort(key=lambda r: r.min)
+    return _ranges_to_component(ranges, mjds, verbose)
 
 
 def dmx_setup(toas, minwidth_d: float = 10.0, mintoas: int = 1):

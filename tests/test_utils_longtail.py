@@ -441,3 +441,82 @@ class TestDmxSetup:
         R1, R2, N = dmx_setup(np.array([55000.0]), minwidth_d=10.0)
         assert len(R1) == 1 and N.tolist() == [1]
         assert R1[0] <= 55000.0 < R2[0]
+
+
+class TestDmxRangesOld:
+    def test_legacy_binning(self):
+        from pint_tpu.dmx import dmx_ranges_old
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        par = ["PSR OLD\n", "RAJ 02:00:00\n", "DECJ 20:00:00\n",
+               "F0 150.0 1\n", "PEPOCH 55200\n", "DM 15\n", "UNITS TDB\n"]
+        m = get_model(par)
+        # three epochs with both bands + one orphan low-frequency epoch
+        mjds = np.array([55000.0, 55000.3, 55100.0, 55100.2, 55200.0,
+                         55200.4, 55205.0])
+        freqs = np.array([430.0, 1410.0, 430.0, 1410.0, 430.0, 1410.0,
+                          430.0])
+        t = make_fake_toas_fromMJDs(mjds, m, freq=freqs, error_us=1.0)
+        mask, comp = dmx_ranges_old(t, divide_freq=1000.0, max_diff=15.0)
+        assert comp.dmx_indices == [1, 2, 3]
+        # the orphan at 55205 folded into the third bin
+        assert mask.all()
+        r2 = float(getattr(comp, "DMXR2_0003").value)
+        assert r2 >= 55205.0
+        # ranges don't regress in time
+        r1s = [float(getattr(comp, f"DMXR1_{i:04d}").value)
+               for i in comp.dmx_indices]
+        assert r1s == sorted(r1s)
+
+    def test_no_pairs_raises(self):
+        from pint_tpu.dmx import dmx_ranges_old
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        par = ["PSR OLD2\n", "RAJ 02:00:00\n", "DECJ 20:00:00\n",
+               "F0 150.0 1\n", "PEPOCH 55200\n", "DM 15\n", "UNITS TDB\n"]
+        m = get_model(par)
+        t = make_fake_toas_fromMJDs(np.array([55000.0, 55100.0]), m,
+                                    freq=1400.0, error_us=1.0)
+        with pytest.raises(ValueError):
+            dmx_ranges_old(t)
+
+    def test_orphan_folding_gate(self):
+        """TEMPO semantics: an orphan folds only when BOTH bin edges are
+        within max_diff (ranking by the nearest edge); beyond that it is
+        dropped from the mask."""
+        from pint_tpu.dmx import dmx_ranges_old
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        par = ["PSR OLD3\n", "RAJ 02:00:00\n", "DECJ 20:00:00\n",
+               "F0 150.0 1\n", "PEPOCH 55200\n", "DM 15\n", "UNITS TDB\n"]
+        m = get_model(par)
+        # orphan at 55012: both edges within 15 d -> folds
+        mjds = np.array([55000.0, 55000.1, 55007.0, 55012.0])
+        freqs = np.array([430.0, 1410.0, 1410.0, 430.0])
+        t = make_fake_toas_fromMJDs(mjds, m, freq=freqs, error_us=1.0)
+        mask, _ = dmx_ranges_old(t, max_diff=15.0)
+        assert mask.all()
+        # orphan at 55016: far edge 16 d away -> dropped (reference gate)
+        mjds2 = np.array([55000.0, 55000.1, 55007.0, 55016.0])
+        t2 = make_fake_toas_fromMJDs(mjds2, m, freq=freqs, error_us=1.0)
+        mask2, _ = dmx_ranges_old(t2, max_diff=15.0)
+        assert mask2.tolist() == [True, True, True, False]
+
+    def test_rounded_epoch_toa_stays_in_bin(self):
+        """Regression: a TOA up to 0.05 d from its rounded epoch is still
+        covered by the bin the epoch anchors."""
+        from pint_tpu.dmx import dmx_ranges_old
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        par = ["PSR OLD4\n", "RAJ 02:00:00\n", "DECJ 20:00:00\n",
+               "F0 150.0 1\n", "PEPOCH 55200\n", "DM 15\n", "UNITS TDB\n"]
+        m = get_model(par)
+        mjds = np.array([55000.34, 55000.0])  # low rounds to 55000.3
+        freqs = np.array([430.0, 1410.0])
+        t = make_fake_toas_fromMJDs(mjds, m, freq=freqs, error_us=1.0)
+        mask, comp = dmx_ranges_old(t)
+        assert mask.all()
