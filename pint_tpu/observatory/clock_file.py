@@ -20,7 +20,104 @@ import numpy as np
 from pint_tpu.exceptions import ClockCorrectionOutOfRange, NoClockCorrections
 from pint_tpu.logging import log
 
-__all__ = ["ClockFile", "read_tempo_clock_file", "read_tempo2_clock_file", "find_clock_file"]
+__all__ = ["ClockFile", "GlobalClockFile", "read_tempo_clock_file",
+           "read_tempo2_clock_file", "find_clock_file"]
+
+
+class GlobalClockFile:
+    """A clock file served from the global repository, refreshed on demand
+    (reference ``clock_file.py:781``): evaluating past the end of the
+    loaded data triggers an update check against the repository (the
+    local-mirror transport of
+    :mod:`pint_tpu.observatory.global_clock_corrections`).
+
+    Delegates everything else to the freshly parsed :class:`ClockFile`.
+    """
+
+    def __init__(self, filename: str, fmt: str = "tempo",
+                 url_base=None, valid_beyond_ends: bool = False):
+        self.filename = filename
+        self.fmt = fmt
+        self.url_base = url_base
+        self.valid_beyond_ends = valid_beyond_ends
+        path = self._fetch("if_missing")
+        self._load(path)
+
+    def _fetch(self, policy: str):
+        from pint_tpu.observatory.global_clock_corrections import (
+            get_clock_correction_file)
+
+        try:
+            path = get_clock_correction_file(self.filename,
+                                             download_policy=policy,
+                                             url_base=self.url_base)
+        except (KeyError, FileNotFoundError) as e:
+            raise NoClockCorrections(
+                f"Clock file {self.filename} not available: {e}") from e
+        if path is None:
+            raise NoClockCorrections(
+                f"Clock file {self.filename} not available from the "
+                "repository or local search directories")
+        return path
+
+    @staticmethod
+    def _stat_sig(path):
+        st = os.stat(path)
+        return (str(path), st.st_mtime, st.st_size)
+
+    def _load(self, path, file_hash=None):
+        from pint_tpu.utils import compute_hash
+
+        self._path = path
+        self._sig = self._stat_sig(path)
+        self._hash = file_hash if file_hash is not None \
+            else compute_hash(path)
+        self.clock_file = ClockFile.read(
+            path, fmt=self.fmt, valid_beyond_ends=self.valid_beyond_ends)
+
+    def update(self) -> bool:
+        """Refresh from the repository per its index policy; returns True
+        when new data actually arrived (reference ``clock_file.py:828``)."""
+        from pint_tpu.utils import compute_hash
+
+        path = self._fetch("if_expired")
+        if self._stat_sig(path) == self._sig:
+            return False  # same file, untouched: skip the content hash
+        h = compute_hash(path)
+        if h != self._hash:
+            self._load(path, file_hash=h)
+            return True
+        self._sig = self._stat_sig(path)  # touched but identical content
+        return False
+
+    @property
+    def mjd(self):
+        return self.clock_file.mjd
+
+    @property
+    def clock_us(self):
+        return self.clock_file.clock_us
+
+    def last_correction_mjd(self) -> float:
+        return self.clock_file.last_correction_mjd()
+
+    def evaluate(self, mjd, limits: str = "warn"):
+        """Clock correction [s] at the given MJDs; requests past the end of
+        the loaded data (or with no data loaded at all) first try to
+        refresh from the repository.  A failed refresh falls back to the
+        already-loaded data, which then applies its own out-of-range
+        ``limits`` policy."""
+        mjd_arr = np.atleast_1d(np.asarray(mjd, dtype=np.float64))
+        needs_more = mjd_arr.size and (
+            len(self.clock_file.mjd) == 0
+            or mjd_arr.max() > self.clock_file.mjd[-1])
+        if needs_more:
+            try:
+                self.update()
+            except NoClockCorrections as e:
+                log.warning(f"Clock file {self.filename} could not be "
+                            f"refreshed ({e}); using the loaded data")
+        return self.clock_file.evaluate(mjd_arr, limits=limits)
 
 
 class ClockFile:

@@ -204,3 +204,63 @@ class TestObservatoryIntegration:
         assert "gps2utc.clk" not in done
         assert "time_gbt.dat" in done
         _cf._cache.clear()
+
+
+class TestGlobalClockFile:
+    def test_auto_refresh_past_end(self, repo):
+        """Evaluating beyond the loaded span re-checks the repository and
+        picks up extended data (reference clock_file.py:781 behavior)."""
+        import numpy as np
+
+        from pint_tpu.observatory.clock_file import GlobalClockFile
+
+        r, cache = repo
+        (r / "gps2utc.clk").write_text(
+            "# UTC(GPS) UTC\n50000.00000 1.0e-6\n51000.00000 1.0e-6\n")
+        gcf = GlobalClockFile("gps2utc.clk", fmt="tempo2")
+        assert gcf.last_correction_mjd() == 51000.0
+        assert gcf.evaluate(np.array([50500.0]))[0] == pytest.approx(1e-6)
+        # repository gains newer data; age the cache copy past its interval
+        (r / "gps2utc.clk").write_text(
+            "# UTC(GPS) UTC\n50000.00000 1.0e-6\n52000.00000 3.0e-6\n")
+        old = time.time() - 8 * 86400
+        os.utime(gcf._path, (old, old))
+        val = gcf.evaluate(np.array([51500.0]))[0]
+        assert gcf.last_correction_mjd() == 52000.0
+        assert val == pytest.approx(2.5e-6)
+
+    def test_update_reports_changes(self, repo):
+        from pint_tpu.observatory.clock_file import GlobalClockFile
+
+        r, _ = repo
+        gcf = GlobalClockFile("time_gbt.dat", fmt="tempo")
+        assert gcf.update() is False  # fresh copy, nothing new
+        (r / "time_gbt.dat").write_text("   50000.00 1.00\n")
+        old = time.time() - 86400
+        os.utime(gcf._path, (old, old))
+        assert gcf.update() is True
+
+    def test_missing_raises_no_clock_corrections(self, repo, monkeypatch):
+        from pint_tpu.exceptions import NoClockCorrections
+        from pint_tpu.observatory.clock_file import GlobalClockFile
+
+        with pytest.raises(NoClockCorrections):
+            GlobalClockFile("nope.clk")
+
+    def test_empty_eval_and_failed_refresh(self, repo):
+        """Empty MJD arrays pass through; a failed refresh warns and serves
+        the loaded data instead of raising."""
+        import numpy as np
+
+        from pint_tpu.observatory.clock_file import GlobalClockFile
+
+        r, _ = repo
+        gcf = GlobalClockFile("gps2utc.clk", fmt="tempo2")
+        assert gcf.evaluate(np.array([])).size == 0
+        # repository disappears; evaluation past the end must still work
+        (r / "gps2utc.clk").unlink()
+        (r / "index.txt").write_text("time_gbt.dat 0.5 ---\n")
+        old = time.time() - 8 * 86400
+        os.utime(gcf._path, (old, old))
+        vals = gcf.evaluate(np.array([60000.0]))  # past end, refresh fails
+        assert np.isfinite(vals).all()
