@@ -381,6 +381,20 @@ class EmceeSampler(MCMCSampler):
     def run_mcmc(self, pos, nsteps):
         return self.sampler.run_mcmc(pos, nsteps)
 
+    def sample(self, pos, iterations, progress: bool = False):
+        """Incremental sampling passthrough so
+        :func:`run_sampler_autocorr` drives emcee the same way it drives
+        the jax-native ensemble."""
+        return self.sampler.sample(pos, iterations=iterations,
+                                   progress=progress)
+
+    @property
+    def iteration(self) -> int:
+        return self.sampler.iteration
+
+    def get_autocorr_time(self, **kw):
+        return self.sampler.get_autocorr_time(**kw)
+
     def get_chain(self, **kw):
         return self.sampler.get_chain(**kw)
 
