@@ -173,12 +173,16 @@ class _PhotonMCMCFitter(Fitter):
                 int(requested_steps * burn_frac))
         elif maxiter > 0:
             self.sampler.run_mcmc(pos, maxiter)
+        if not len(self.sampler._chain):
+            raise ValueError(
+                "fit_toas produced an empty chain (maxiter=0 with no resumed "
+                "steps); request at least one step or resume a backend")
         if autocorr:
             # the chain may stop early on convergence (or the resume may
             # already satisfy the request), but the requested burn-in is
             # absolute — never re-fraction a shortened chain
-            discard = min(int(requested_steps * burn_frac),
-                          len(self.sampler._chain) - 1)
+            discard = max(0, min(int(requested_steps * burn_frac),
+                                 len(self.sampler._chain) - 1))
         else:
             discard = int(len(self.sampler._chain) * burn_frac)
         chain = self.sampler.get_chain(flat=True, discard=discard)

@@ -341,32 +341,44 @@ def load_observatories(filename, overwrite: bool = False) -> List[str]:
                                         dtype=np.float64))) != 3:
             raise ValueError(f"Observatory {name!r} itrf_xyz must be "
                              "3 numbers (meters)")
+    # snapshot so a constructor failure mid-loop (alias clash, bad
+    # clock_fmt, ...) rolls the registry back instead of leaving earlier
+    # sites replaced and later ones untouched
+    reg_snapshot = dict(_registry)
+    alias_snapshot = dict(_alias_map)
     added = []
-    for name, d in defs.items():
-        key = name.lower()
-        if key in _registry:
-            _registry.pop(key)
-            for a, tgt in list(_alias_map.items()):
-                if tgt == key:
-                    _alias_map.pop(a)
-        clk = d.get("clock_file", d.get("clock_files", ()))
-        if isinstance(clk, str):
-            clk = [clk]
-        kw = {}
-        if "apply_gps2utc" in d:
-            kw["include_gps"] = bool(d["apply_gps2utc"])
-        if "bipm_version" in d:
-            kw["bipm_version"] = d["bipm_version"]
-        obs = TopoObs(name, d["itrf_xyz"],
-                      tempo_code=d.get("tempo_code", ""),
-                      itoa_code=d.get("itoa_code", ""),
-                      aliases=d.get("aliases", ()),
-                      clock_files=list(clk),
-                      clock_fmt=d.get("clock_fmt", "tempo"), **kw)
-        obs.fullname = d.get("fullname", name)
-        origin = d.get("origin", "")
-        obs.origin = "\n".join(origin) if isinstance(origin, list) else origin
-        added.append(obs.name)
+    try:
+        for name, d in defs.items():
+            key = name.lower()
+            if key in _registry:
+                _registry.pop(key)
+                for a, tgt in list(_alias_map.items()):
+                    if tgt == key:
+                        _alias_map.pop(a)
+            clk = d.get("clock_file", d.get("clock_files", ()))
+            if isinstance(clk, str):
+                clk = [clk]
+            kw = {}
+            if "apply_gps2utc" in d:
+                kw["include_gps"] = bool(d["apply_gps2utc"])
+            if "bipm_version" in d:
+                kw["bipm_version"] = d["bipm_version"]
+            obs = TopoObs(name, d["itrf_xyz"],
+                          tempo_code=d.get("tempo_code", ""),
+                          itoa_code=d.get("itoa_code", ""),
+                          aliases=d.get("aliases", ()),
+                          clock_files=list(clk),
+                          clock_fmt=d.get("clock_fmt", "tempo"), **kw)
+            obs.fullname = d.get("fullname", name)
+            origin = d.get("origin", "")
+            obs.origin = "\n".join(origin) if isinstance(origin, list) else origin
+            added.append(obs.name)
+    except Exception:
+        _registry.clear()
+        _registry.update(reg_snapshot)
+        _alias_map.clear()
+        _alias_map.update(alias_snapshot)
+        raise
     return added
 
 

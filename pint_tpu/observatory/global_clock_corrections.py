@@ -133,6 +133,13 @@ def get_file(name: str, update_interval_days: float = 7.0,
                 break
     if src is None:
         if local is not None:
+            if download_policy == "always":
+                # 'always' promises a guaranteed refresh (the reference
+                # raises here); silently serving a stale copy breaks it
+                raise FileNotFoundError(
+                    f"Clock file {name}: download_policy='always' but no "
+                    "repository copy is available to refresh from (stale "
+                    f"cache copy exists at {local})")
             log.warning(f"Clock file {name} is due for refresh but no "
                         "repository copy is available; using the stale "
                         f"cache copy {local}")

@@ -161,10 +161,14 @@ def linearity_probe_steps(J0: "np.ndarray") -> "np.ndarray":
 def classify_linear_columns(J0: "np.ndarray", J1: "np.ndarray") -> "np.ndarray":
     """Indices of columns that MOVED between the two Jacobian evaluations
     (relative change > 1e-7): the nonlinear set; everything else is served
-    as a constant."""
+    as a constant.  A non-finite probe column (probe point outside the
+    parameter's valid domain) counts as moved — NaN must fail toward
+    'recompute per point', never toward 'hoist as constant'."""
     dcol = np.linalg.norm(J1 - J0, axis=0)
     ncol = np.linalg.norm(J0, axis=0)
-    return np.nonzero(dcol > 1e-7 * (ncol + 1e-300))[0]
+    moved = dcol > 1e-7 * (ncol + 1e-300)
+    moved |= ~np.isfinite(dcol)
+    return np.nonzero(moved)[0]
 
 
 def normalize_designmatrix(M, params=None):

@@ -121,6 +121,27 @@ class TestPolicies:
         # due for refresh but repo copy is gone: stale cache returned
         assert get_file("time_gbt.dat", update_interval_days=0.5).exists()
 
+    def test_always_raises_without_repo_source(self, repo):
+        """policy='always' promises a refresh; a stale cache copy must not
+        silently satisfy it when the repository copy is gone."""
+        from pint_tpu.observatory.global_clock_corrections import get_file
+
+        r, _ = repo
+        get_file("time_gbt.dat")
+        (r / "time_gbt.dat").unlink()
+        with pytest.raises(FileNotFoundError):
+            get_file("time_gbt.dat", download_policy="always")
+        # non-'always' policies still fall back to the stale copy, even when
+        # the copy is due for refresh (exercises the src-is-None branch)
+        import os as _os
+        import time as _time
+
+        p = get_file("time_gbt.dat", download_policy="never")
+        old = _time.time() - 86400
+        _os.utime(p, (old, old))
+        assert get_file("time_gbt.dat", download_policy="if_expired",
+                        update_interval_days=0.5).exists()
+
 
 class TestLookupAndUpdateAll:
     def test_lookup_via_index(self, repo):

@@ -289,3 +289,26 @@ class TestLoadObservatories:
             load_observatories(str(p))
         with _pt.raises(KeyError):
             get_observatory("newsite")
+
+    def test_constructor_failure_rolls_back_registry(self, tmp_path):
+        """A failure DURING the mutation loop (past pre-validation) must
+        restore the registry snapshot, not leave earlier sites replaced."""
+        import json
+
+        import pytest as _pt
+
+        from pint_tpu.observatory import get_observatory, load_observatories
+
+        before = list(get_observatory("gbt").itrf_xyz)
+        p = tmp_path / "bad2.json"
+        # entry 1 passes pre-validation and would replace gbt; entry 2
+        # passes pre-validation but its constructor raises (aliases not
+        # iterable)
+        p.write_text(json.dumps({
+            "gbt": {"itrf_xyz": [before[0] + 9.0, before[1], before[2]],
+                    "overwrite": True},
+            "badsite": {"itrf_xyz": [1.0, 2.0, 3.0], "aliases": 42},
+        }))
+        with _pt.raises(TypeError):
+            load_observatories(str(p))
+        assert list(get_observatory("gbt").itrf_xyz) == before

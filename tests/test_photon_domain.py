@@ -291,6 +291,21 @@ class TestPhotonMCMC:
         assert abs(float(f.model.F0.value) - truth) < 2e-8
         assert f.sampler.acceptance_fraction > 0.1
 
+    def test_empty_chain_raises_clear_error(self, photon_setup):
+        """maxiter=0 with no resumed chain must raise a clear ValueError,
+        not an opaque argmax/slice failure (advisor r3)."""
+        import pytest as _pt
+
+        from pint_tpu.event_fitter import MCMCFitterBinnedTemplate
+
+        m, t, template = photon_setup
+        f = MCMCFitterBinnedTemplate(t, __import__("copy").deepcopy(m),
+                                     template, nwalkers=16)
+        with _pt.raises(ValueError, match="empty chain"):
+            f.fit_toas(maxiter=0, seed=1)
+        with _pt.raises(ValueError, match="empty chain"):
+            f.fit_toas(maxiter=0, seed=1, autocorr=True)
+
     def test_analytic_template_matches_binned(self, photon_setup):
         from pint_tpu.event_fitter import (MCMCFitterAnalyticTemplate,
                                            MCMCFitterBinnedTemplate)

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Retry loop for the headline TPU bench: the axon tunnel drops for hours at a
+# time (BENCH_NOTES.md), so probe repeatedly from round start until one run
+# lands on a real TPU. One TPU process at a time; SIGTERM only (kill -9
+# wedges the tunnel).
+OUT=${BENCH_RETRY_DIR:-/tmp/bench_r04}
+mkdir -p "$OUT"
+cd /root/repo || exit 1
+for i in $(seq 1 ${BENCH_RETRY_MAX:-200}); do
+  echo "$(date -u +%FT%TZ) attempt $i probe" >> "$OUT/log"
+  # a dead tunnel HANGS jax.devices() rather than raising; probe cheaply
+  # (4 min) before committing to a full 50-min bench window
+  if ! timeout 240 python -c \
+      "import jax; assert jax.devices()[0].platform in ('tpu','axon')" \
+      >> "$OUT/log" 2>&1; then
+    echo "$(date -u +%FT%TZ) probe $i: no live TPU" >> "$OUT/log"
+    sleep ${BENCH_RETRY_SLEEP:-120}
+    continue
+  fi
+  echo "$(date -u +%FT%TZ) attempt $i bench (TPU live)" >> "$OUT/log"
+  BENCH_REQUIRE_TPU=1 BENCH_SKIP_SECONDARY=1 timeout 3000 \
+    python bench.py > "$OUT/attempt_$i.out" 2> "$OUT/attempt_$i.err"
+  line=$(grep -h '"metric"' "$OUT/attempt_$i.out" | tail -1)
+  if [ -n "$line" ] && ! echo "$line" | grep -q '"error"' \
+      && ! echo "$line" | grep -q '"value": 0.0,' \
+      && echo "$line" | grep -Eq '"platform": "(tpu|axon)"'; then
+    echo "$line" > "$OUT/SUCCESS.json"
+    echo "$(date -u +%FT%TZ) SUCCESS on attempt $i: $line" >> "$OUT/log"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) attempt $i failed: ${line:-no JSON}" >> "$OUT/log"
+  sleep ${BENCH_RETRY_SLEEP:-120}
+done
+echo "$(date -u +%FT%TZ) exhausted retries" >> "$OUT/log"
+exit 1
