@@ -72,6 +72,46 @@ class Fitter:
         self.resids = Residuals(self.toas, self.model, track_mode=self.track_mode)
         return self.resids
 
+    # -- maximum-likelihood noise fitting -----------------------------------
+    def _get_free_noise_params(self) -> List[str]:
+        """Unfrozen noise parameters (reference ``fitter.py:1160``)."""
+        from pint_tpu.noisefit import free_noise_params
+
+        return free_noise_params(self.model)
+
+    def _update_noise_params(self, names, values, errors=None):
+        """Write ML noise estimates back to the model (reference
+        ``fitter.py:1166``)."""
+        for i, p in enumerate(names):
+            par = getattr(self.model, p)
+            # sign-degenerate parameters enter the likelihood squared;
+            # report the physical (non-negative) branch
+            v = float(values[i])
+            if p.startswith(("EFAC", "EQUAD", "ECORR")):
+                v = abs(v)
+            par.value = v
+            if errors is not None:
+                err = float(errors[i])
+                par.uncertainty = err
+                self.errors[p] = err
+
+    def fit_noise(self, uncertainty: bool = False,
+                  noisefit_method: str = "L-BFGS-B"):
+        """One ML noise-parameter fit at the current timing solution
+        (reference ``fitter.py:1179 _fit_noise``, autodiff gradients for
+        every parameter class instead of hand gradients / Nelder-Mead).
+
+        Returns a :class:`pint_tpu.noisefit.NoiseFitResult` (None when no
+        noise parameter is free).  Does NOT write back to the model — the
+        alternating loop in ``DownhillFitter.fit_toas`` does that via
+        :meth:`_update_noise_params`.
+        """
+        from pint_tpu.noisefit import fit_noise_ml
+
+        return fit_noise_ml(self.model, self.toas,
+                            np.asarray(self.resids.time_resids),
+                            method=noisefit_method, uncertainty=uncertainty)
+
     def get_fitparams(self) -> dict:
         return {p: getattr(self.model, p).value for p in self.model.free_params}
 
@@ -218,7 +258,39 @@ class DownhillFitter(Fitter):
 
     def fit_toas(self, maxiter: int = 20, required_chi2_decrease: float = 1e-2,
                  max_chi2_increase: float = 1e-2, min_lambda: float = 1e-3,
-                 debug: bool = False) -> float:
+                 debug: bool = False, noise_fit_niter: int = 2,
+                 noisefit_method: str = "L-BFGS-B",
+                 compute_noise_uncertainties: bool = True) -> float:
+        """Downhill timing fit; when any noise parameter is unfrozen the
+        timing fit alternates with ML noise fits (reference
+        ``fitter.py:1086-1150``): ``noise_fit_niter`` rounds of
+        (timing fit, noise fit), uncertainty Hessian on the last noise fit,
+        then one final timing fit at the updated noise values."""
+        if self._get_free_noise_params():
+            kw = dict(maxiter=maxiter,
+                      required_chi2_decrease=required_chi2_decrease,
+                      max_chi2_increase=max_chi2_increase,
+                      min_lambda=min_lambda, debug=debug)
+            for ii in range(noise_fit_niter):
+                self._fit_toas_timing(**kw)
+                last = ii == noise_fit_niter - 1
+                res = self.fit_noise(
+                    uncertainty=last and compute_noise_uncertainties,
+                    noisefit_method=noisefit_method)
+                log.info(f"noise fit round {ii + 1}/{noise_fit_niter}: {res}")
+                self._update_noise_params(res.names, res.values, res.errors)
+                self.update_resids()
+            return self._fit_toas_timing(**kw)
+        return self._fit_toas_timing(
+            maxiter=maxiter, required_chi2_decrease=required_chi2_decrease,
+            max_chi2_increase=max_chi2_increase, min_lambda=min_lambda,
+            debug=debug)
+
+    def _fit_toas_timing(self, maxiter: int = 20,
+                         required_chi2_decrease: float = 1e-2,
+                         max_chi2_increase: float = 1e-2,
+                         min_lambda: float = 1e-3,
+                         debug: bool = False) -> float:
         best_chi2 = self.resids.chi2
         self.converged = False
         for it in range(maxiter):

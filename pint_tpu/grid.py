@@ -326,8 +326,15 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     #     ``fitter.py:2712``, ``utils.py:3069``).
     UtWU = np.asarray(U).T @ (np.asarray(w)[:, None] * np.asarray(U))
     unorms = np.sqrt(np.maximum(np.diag(UtWU), 1e-300))
-    Sigma = np.diag(1.0 / np.asarray(phi)) + UtWU
-    cf_w = jnp.asarray(np.linalg.cholesky(Sigma))
+    # final-chi2 basis: offset marginalized exactly as Residuals.calc_chi2
+    # — the grid's chi2 must be definitionally identical to the fitter's
+    U_chi, phi_chi = model.augment_basis_for_offset(np.asarray(U),
+                                                    np.asarray(phi),
+                                                    n=len(toas))
+    Sigma_chi = np.diag(1.0 / phi_chi) + U_chi.T @ (np.asarray(w)[:, None]
+                                                    * U_chi)
+    cf_chi = jnp.asarray(np.linalg.cholesky(Sigma_chi))
+    U_chi = jnp.asarray(U_chi)
     UtWU = jnp.asarray(UtWU)
     unorms = jnp.asarray(unorms)
 
@@ -343,7 +350,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             return r / F0
 
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w,
-                       U, phi, F0, Jbase, UtWU, unorms, cf_w):
+                       U, phi, F0, Jbase, UtWU, unorms, U_chi, cf_chi):
             v = jnp.concatenate([free_init[:nfit], gvals])
             ones = jnp.ones((U.shape[0], 1))
             phiinv_u = 1.0 / phi
@@ -378,13 +385,13 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
             # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
             wr = w * r
-            z = jsl.solve_triangular(cf_w, U.T @ wr, lower=True)
+            z = jsl.solve_triangular(cf_chi, U_chi.T @ wr, lower=True)
             return jnp.sum(r * wr) - z @ z
 
         model._cache[grid_key] = jax.jit(jax.vmap(
             chi2_point,
             in_axes=(0, None, None, None, None, None, None, None, None,
-                     None, None, None, None, None)))
+                     None, None, None, None, None, None)))
     vfn = model._cache[grid_key]
 
     def fn(points, sharding=None):
@@ -404,7 +411,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             if sharding is not None:
                 blk = jax.device_put(blk, sharding)
             c2 = vfn(blk, free_init, const_pv, batch, ctx, int0, w, U,
-                     phi, F0, Jbase, UtWU, unorms, cf_w)
+                     phi, F0, Jbase, UtWU, unorms, U_chi, cf_chi)
             out.append(c2[:blk_size - pad] if pad else c2)
         return jnp.concatenate(out)
 

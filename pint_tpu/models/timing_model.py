@@ -922,6 +922,19 @@ class TimingModel:
             return None, None
         return np.hstack(Us), np.concatenate(ws)
 
+    def augment_basis_for_offset(self, U, w, n: Optional[int] = None):
+        """Marginalize the overall phase offset: append a ones column with
+        an uninformative 1e40 prior when no explicit PhaseOffset parameter
+        is fitted (reference ``residuals.py:600-604``).  Single source of
+        truth for every correlated chi2/likelihood evaluation — the grid
+        kernel, ``Residuals``, and the noise likelihood must stay
+        definitionally identical."""
+        if "PhaseOffset" in self.components:
+            return np.asarray(U), np.asarray(w)
+        n = len(U) if n is None else n
+        return (np.hstack([np.asarray(U), np.ones((n, 1))]),
+                np.concatenate([np.asarray(w), [1e40]]))
+
     def full_designmatrix(self, toas):
         """[timing M | noise basis] (reference ``timing_model.py:1752``)."""
         M, names, units = self.designmatrix(toas)

@@ -98,25 +98,37 @@ class Residuals:
             return self.model.scaled_toa_uncertainty(self.toas)
         return np.asarray(self.toas.get_errors()) * 1e-6
 
+    def _corr_basis_weight(self):
+        """(U, w) for the correlated chi2/likelihood with the overall phase
+        offset marginalized (reference ``residuals.py:600-604``).  Without
+        it the weighted-mean subtraction removes low-frequency power the
+        phi prior still predicts."""
+        U, w = self.model.noise_model_basis_weight(self.toas)
+        return self.model.augment_basis_for_offset(U, w, n=len(self.toas))
+
     def calc_chi2(self) -> float:
         """chi2 with the same dispatch as the reference (``residuals.py:686``):
-        diagonal WLS, Sherman-Morrison for ECORR-only, Woodbury otherwise."""
+        diagonal WLS; Sherman-Morrison for ECORR-only with an explicit
+        PhaseOffset (reference ``_calc_ecorr_chi2`` precondition,
+        ``residuals.py:613``); Woodbury with offset marginalization
+        otherwise."""
         r = self.time_resids
         sigma = self.get_data_error()
         if np.any(sigma == 0):
             return np.inf
         if not self.model.has_correlated_errors:
             return float(np.sum((r / sigma) ** 2))
-        U, w = self.model.noise_model_basis_weight(self.toas)
         ecorr_only = all(
             getattr(c, "is_ecorr", False)
             for c in self.model.noise_components
             if getattr(c, "introduces_correlated_errors", False)
         )
-        if ecorr_only:
+        if ecorr_only and "PhaseOffset" in self.model.components:
+            U, w = self.model.noise_model_basis_weight(self.toas)
             dot, _ = sherman_morrison_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
         else:
-            dot, _ = woodbury_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
+            U, w = self._corr_basis_weight()
+            dot, _ = woodbury_dot(sigma**2, U, w, r, r)
         return float(dot)
 
     @property
@@ -159,8 +171,8 @@ class Residuals:
             chi2 = np.sum((r / sigma) ** 2)
             logdet = np.sum(np.log(sigma**2))
             return float(-0.5 * (chi2 + logdet + len(r) * np.log(2 * np.pi)))
-        U, w = self.model.noise_model_basis_weight(self.toas)
-        dot, logdet = woodbury_dot(sigma**2, np.asarray(U), np.asarray(w), r, r)
+        U, w = self._corr_basis_weight()
+        dot, logdet = woodbury_dot(sigma**2, U, w, r, r)
         return float(-0.5 * (dot + logdet + len(r) * np.log(2 * np.pi)))
 
     def noise_resids(self) -> dict:

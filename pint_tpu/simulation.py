@@ -89,9 +89,16 @@ def update_fake_dms(model, ts: TOAs, dm_error: float = 1e-4,
 
 
 def make_fake_toas(ts: TOAs, model, add_noise: bool = False,
+                   add_correlated_noise: bool = False,
                    wideband: bool = False, wideband_dm_error: float = 1e-4,
                    rng: Optional[np.random.Generator] = None) -> TOAs:
     """Zero the residuals of *ts* under *model* (+ optional Gaussian noise).
+
+    ``add_noise`` draws white noise at the EFAC/EQUAD-scaled uncertainties;
+    ``add_correlated_noise`` additionally draws one realization of every
+    correlated component (ECORR epochs, power-law Fourier GPs) from its
+    basis/weight pair — reference ``simulation.py:75``
+    (``add_correlated_noise`` flag) draws from the same N(0, U phi U^T).
 
     With ``wideband=True`` each TOA also gets -pp_dm/-pp_dme flags set to the
     model-predicted DM (+ noise), mirroring reference ``simulation.py:126``
@@ -104,26 +111,37 @@ def make_fake_toas(ts: TOAs, model, add_noise: bool = False,
         if add_noise:
             dm = dm + rng.standard_normal(len(ts)) * dme
         ts.update_dms(dm, dme)
+    dt = np.zeros(len(ts))
     if add_noise:
         err_s = model.scaled_toa_uncertainty(ts)
-        ts.adjust_TOAs(rng.standard_normal(len(ts)) * err_s)
+        dt = dt + rng.standard_normal(len(ts)) * err_s
+    if add_correlated_noise:
+        Us, ws, _ = model.noise_basis_by_component(ts)
+        for U, w in zip(Us, ws):
+            a = rng.standard_normal(U.shape[1]) * np.sqrt(np.asarray(w))
+            dt = dt + np.asarray(U) @ a
+    if add_noise or add_correlated_noise:
+        ts.adjust_TOAs(dt)
     return ts
 
 
 def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int, model,
                            freq: float = 1400.0, obs: str = "gbt",
                            error_us: float = 1.0, add_noise: bool = False,
+                           add_correlated_noise: bool = False,
                            wideband: bool = False, name: str = "fake",
                            rng=None) -> TOAs:
     """Evenly spaced synthetic TOAs (reference ``simulation.py:234``)."""
     mjds = np.linspace(startMJD, endMJD, ntoas)
     return make_fake_toas_fromMJDs(mjds, model, freq=freq, obs=obs,
                                    error_us=error_us, add_noise=add_noise,
+                                   add_correlated_noise=add_correlated_noise,
                                    wideband=wideband, name=name, rng=rng)
 
 
 def make_fake_toas_fromMJDs(mjds, model, freq: float = 1400.0, obs: str = "gbt",
                             error_us: float = 1.0, add_noise: bool = False,
+                            add_correlated_noise: bool = False,
                             wideband: bool = False,
                             name: str = "fake", rng=None) -> TOAs:
     """Synthetic TOAs at the given MJDs (reference ``simulation.py:371``)."""
@@ -154,18 +172,21 @@ def make_fake_toas_fromMJDs(mjds, model, freq: float = 1400.0, obs: str = "gbt",
     ts.apply_clock_corrections(include_bipm=include_bipm)
     ts.compute_TDBs(ephem=ephem)
     ts.compute_posvels(ephem=ephem, planets=planets)
-    return make_fake_toas(ts, model, add_noise=add_noise, wideband=wideband,
-                          rng=rng)
+    return make_fake_toas(ts, model, add_noise=add_noise,
+                          add_correlated_noise=add_correlated_noise,
+                          wideband=wideband, rng=rng)
 
 
 def make_fake_toas_fromtim(timfile: str, model, add_noise: bool = False,
+                           add_correlated_noise: bool = False,
                            rng=None) -> TOAs:
     """Synthetic TOAs matching an existing tim file's epochs/errors/frequencies
     (reference ``simulation.py:501``)."""
     from pint_tpu.toa import get_TOAs
 
     ts = get_TOAs(timfile, model=model)
-    return make_fake_toas(ts, model, add_noise=add_noise, rng=rng)
+    return make_fake_toas(ts, model, add_noise=add_noise,
+                          add_correlated_noise=add_correlated_noise, rng=rng)
 
 
 def calculate_random_models(fitter, toas, Nmodels: int = 100,

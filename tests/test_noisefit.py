@@ -1,0 +1,261 @@
+"""ML noise-parameter fitting (reference ``fitter.py:1179 _fit_noise``).
+
+Test strategy (SURVEY §4 simulation-as-fixture): inject known noise
+parameters into simulated TOAs, recover them by maximizing the autodiff
+lnlikelihood, and check the recovered values against the injected truth
+within the Hessian-derived uncertainties.  Plus exactness pillars: the
+jitted lnlikelihood must equal ``Residuals.lnlikelihood`` at the current
+values, and its gradient must match central finite differences.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+
+
+def _model_with_lines(extra_lines):
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models import get_model
+
+    with open(NGC_PAR) as f:
+        text = f.read()
+    return get_model(parse_parfile(text + "\n" + "\n".join(extra_lines) + "\n"))
+
+
+def _clustered_mjds(nepoch=60, perepoch=4, start=53005.0, end=54795.0):
+    """Epochs of several TOAs within <1 s so ECORR groups form."""
+    epochs = np.linspace(start, end, nepoch)
+    return (epochs[:, None] + np.arange(perepoch)[None, :] * 0.4 / 86400.0).ravel()
+
+
+def _sim(model, mjds, error_us=2.0, seed=1, corr=False):
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    return make_fake_toas_fromMJDs(
+        np.asarray(mjds), model, error_us=error_us, add_noise=True,
+        add_correlated_noise=corr, rng=np.random.default_rng(seed))
+
+
+class TestLnlikeExactness:
+    def test_matches_residuals_lnlikelihood_white(self):
+        from pint_tpu.noisefit import build_noise_lnlikelihood
+        from pint_tpu.residuals import Residuals
+
+        m = _model_with_lines(["EFAC mjd 52000 53900 1.3 1",
+                               "EQUAD mjd 53900 60000 2.5 1"])
+        t = _sim(m, np.linspace(53005, 54795, 80), seed=2)
+        res = Residuals(t, m)
+        lnl, x0, names = build_noise_lnlikelihood(m, t)
+        assert set(names) == {"EFAC1", "EQUAD1"}
+        got = float(lnl(x0, np.asarray(res.time_resids)))
+        assert got == pytest.approx(res.lnlikelihood(), rel=1e-10)
+
+    def test_matches_residuals_lnlikelihood_correlated(self):
+        from pint_tpu.noisefit import build_noise_lnlikelihood
+        from pint_tpu.residuals import Residuals
+
+        m = _model_with_lines(["EFAC mjd 52000 60000 1.2 1",
+                               "ECORR mjd 52000 60000 1.5 1",
+                               "TNREDAMP -12.8 1", "TNREDGAM 3.0 1",
+                               "TNREDC 5"])
+        t = _sim(m, _clustered_mjds(30, 3), seed=3, corr=True)
+        res = Residuals(t, m)
+        lnl, x0, names = build_noise_lnlikelihood(m, t)
+        assert set(names) == {"EFAC1", "ECORR1", "TNREDAMP", "TNREDGAM"}
+        got = float(lnl(x0, np.asarray(res.time_resids)))
+        assert got == pytest.approx(res.lnlikelihood(), rel=1e-9)
+
+    def test_gradient_matches_finite_differences(self):
+        import jax
+
+        from pint_tpu.noisefit import build_noise_lnlikelihood
+        from pint_tpu.residuals import Residuals
+
+        m = _model_with_lines(["EFAC mjd 52000 60000 1.2 1",
+                               "ECORR mjd 52000 60000 1.5 1",
+                               "TNREDAMP -12.8 1", "TNREDGAM 3.0 1",
+                               "TNREDC 4"])
+        t = _sim(m, _clustered_mjds(25, 3), seed=4, corr=True)
+        r = np.asarray(Residuals(t, m).time_resids)
+        lnl, x0, names = build_noise_lnlikelihood(m, t)
+        g = np.asarray(jax.grad(lnl)(x0, r))
+        for i in range(len(x0)):
+            h = 1e-6 * max(abs(x0[i]), 1.0)
+            xp, xm = x0.copy(), x0.copy()
+            xp[i] += h
+            xm[i] -= h
+            fd = (float(lnl(xp, r)) - float(lnl(xm, r))) / (2 * h)
+            assert g[i] == pytest.approx(fd, rel=2e-5, abs=1e-7), names[i]
+
+    def test_wideband_and_tneq_params_excluded(self):
+        """DM-noise and TNEQ free params are excluded (with a warning)
+        rather than crashing the fit path: a wideband par with a free
+        DMEFAC must still fit its timing parameters."""
+        from pint_tpu.noisefit import free_noise_params
+
+        m = _model_with_lines(["DMEFAC mjd 52000 60000 1.3 1",
+                               "EFAC mjd 52000 60000 1.2 1"])
+        assert free_noise_params(m) == ["EFAC1"]
+        m2 = _model_with_lines(["TNEQ mjd 52000 60000 -5.5 1"])
+        assert free_noise_params(m2) == []
+
+
+class TestRecovery:
+    def test_efac_equad_recovery(self):
+        from pint_tpu.noisefit import fit_noise_ml
+        from pint_tpu.residuals import Residuals
+
+        truth = _model_with_lines(["EFAC mjd 52000 53900 1.5 1",
+                                   "EQUAD mjd 53900 60000 4.0 1"])
+        t = _sim(truth, np.linspace(53005, 54795, 500), error_us=2.0, seed=6)
+        start = _model_with_lines(["EFAC mjd 52000 53900 1.0 1",
+                                   "EQUAD mjd 53900 60000 1.0 1"])
+        r = np.asarray(Residuals(t, start).time_resids)
+        res = fit_noise_ml(start, t, r, uncertainty=True)
+        vals = dict(zip(res.names, res.values))
+        errs = dict(zip(res.names, res.errors))
+        assert abs(vals["EFAC1"] - 1.5) < 3 * errs["EFAC1"]
+        assert abs(abs(vals["EQUAD1"]) - 4.0) < 3 * errs["EQUAD1"]
+        # sanity on the scale of the uncertainties themselves
+        assert 0.01 < errs["EFAC1"] < 0.3
+        assert 0.05 < errs["EQUAD1"] < 2.0
+
+    def test_ecorr_recovery(self):
+        from pint_tpu.noisefit import fit_noise_ml
+        from pint_tpu.residuals import Residuals
+
+        truth = _model_with_lines(["ECORR mjd 52000 60000 5.0 1"])
+        t = _sim(truth, _clustered_mjds(80, 4), error_us=2.0, seed=7,
+                 corr=True)
+        start = _model_with_lines(["ECORR mjd 52000 60000 1.0 1"])
+        r = np.asarray(Residuals(t, start).time_resids)
+        res = fit_noise_ml(start, t, r, uncertainty=True)
+        vals = dict(zip(res.names, res.values))
+        errs = dict(zip(res.names, res.errors))
+        assert abs(abs(vals["ECORR1"]) - 5.0) < 3 * errs["ECORR1"]
+        assert res.lnlike > float(Residuals(t, start).lnlikelihood())
+
+    def test_rednoise_amplitude_recovery(self):
+        from pint_tpu.noisefit import fit_noise_ml
+        from pint_tpu.residuals import Residuals
+
+        truth = _model_with_lines(["TNREDAMP -12.3 1", "TNREDGAM 3.5 1",
+                                   "TNREDC 10"])
+        t = _sim(truth, np.linspace(53005, 54795, 300), error_us=1.0, seed=8,
+                 corr=True)
+        start = _model_with_lines(["TNREDAMP -13.0 1", "TNREDGAM 2.0 1",
+                                   "TNREDC 10"])
+        r = np.asarray(Residuals(t, start).time_resids)
+        res = fit_noise_ml(start, t, r, uncertainty=True)
+        vals = dict(zip(res.names, res.values))
+        errs = dict(zip(res.names, res.errors))
+        # one GP realization constrains log10-amplitude to a few tenths
+        assert abs(vals["TNREDAMP"] - (-12.3)) < 3 * max(errs["TNREDAMP"], 0.1)
+        assert abs(vals["TNREDGAM"] - 3.5) < 3 * max(errs["TNREDGAM"], 0.5)
+        assert res.lnlike > float(Residuals(t, start).lnlikelihood())
+
+
+class TestB1855Shaped:
+    """VERDICT-r3 acceptance shape: recovery on the real B1855+09 9-yr
+    structure — 4005 TOAs at the real epochs/flags, per-backend
+    EFAC/EQUAD/ECORR masks, 90-mode power-law red noise (RNAMP tempo1
+    convention)."""
+
+    B_PAR = "/root/reference/tests/datafile/B1855+09_NANOGrav_9yv1.gls.par"
+    B_TIM = "/root/reference/tests/datafile/B1855+09_NANOGrav_9yv1.tim"
+
+    def test_b1855_noise_recovery(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.noisefit import fit_noise_ml, free_noise_params
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.simulation import make_fake_toas_fromtim
+
+        truth = get_model(self.B_PAR)
+        # the catalogue red noise (TNRedAmp -14.23; the par carries both
+        # conventions and TNREDAMP takes precedence) is too weak to
+        # constrain from one realization; amplify so recovery is a real test
+        truth.TNREDAMP.value = float(truth.TNREDAMP.value) + np.log10(20.0)
+        t = make_fake_toas_fromtim(self.B_TIM, truth, add_noise=True,
+                                   add_correlated_noise=True,
+                                   rng=np.random.default_rng(77))
+        tv = {"EFAC1": float(truth.EFAC1.value),
+              "EQUAD2": float(truth.EQUAD2.value),
+              "ECORR2": float(truth.ECORR2.value),
+              "TNREDAMP": float(truth.TNREDAMP.value)}
+        start = copy.deepcopy(truth)
+        start.EFAC1.frozen = False
+        start.EFAC1.value = 1.0
+        start.EQUAD2.frozen = False
+        start.EQUAD2.value = 1.0
+        start.ECORR2.frozen = False
+        start.ECORR2.value = 1.0
+        start.TNREDAMP.frozen = False
+        start.TNREDAMP.value = tv["TNREDAMP"] - 0.5
+        assert set(free_noise_params(start)) == set(tv)
+        r = np.asarray(Residuals(t, start).time_resids)
+        res = fit_noise_ml(start, t, r, uncertainty=True)
+        # us-scale white params enter the likelihood squared: fold the
+        # sign-degenerate branch; log10 amplitudes keep their sign
+        vals = {n: (abs(v) if n.startswith(("EFAC", "EQUAD", "ECORR")) else v)
+                for n, v in zip(res.names, res.values)}
+        errs = dict(zip(res.names, res.errors))
+        for p in tv:
+            # 3-sigma with a small absolute floor against a lucky-seed
+            # over-tight Hessian
+            floor = 0.02 * abs(tv[p])
+            assert abs(vals[p] - tv[p]) < 3 * max(errs[p], floor), \
+                (p, vals[p], errs[p], tv[p])
+        lnl_start = float(Residuals(t, start).lnlikelihood())
+        assert res.lnlike > lnl_start
+
+
+class TestFitterIntegration:
+    def test_downhill_gls_alternating_noisefit(self):
+        from pint_tpu.gls_fitter import DownhillGLSFitter
+        from pint_tpu.residuals import Residuals
+
+        truth = _model_with_lines(["EFAC mjd 52000 60000 1.4 1",
+                                   "ECORR mjd 52000 60000 4.0 1"])
+        t = _sim(truth, _clustered_mjds(60, 4), error_us=2.0, seed=9,
+                 corr=True)
+        start = _model_with_lines(["EFAC mjd 52000 60000 1.0 1",
+                                   "ECORR mjd 52000 60000 1.0 1"])
+        # timing params slightly off so the timing fit has real work
+        start.F0.value = float(start.F0.value) + 2e-10
+        f = DownhillGLSFitter(t, start)
+        lnl_before = float(Residuals(t, start).lnlikelihood())
+        f.fit_toas(maxiter=6, noise_fit_niter=2)
+        efac = float(f.model.EFAC1.value)
+        ecorr = float(f.model.ECORR1.value)
+        assert abs(efac - 1.4) < 0.35
+        assert abs(ecorr - 4.0) < 2.0
+        assert f.model.EFAC1.uncertainty is not None
+        assert "EFAC1" in f.errors and f.errors["EFAC1"] > 0
+        assert float(f.resids.lnlikelihood()) > lnl_before
+
+    def test_downhill_wls_white_noisefit(self):
+        from pint_tpu.fitter import DownhillWLSFitter
+
+        truth = _model_with_lines(["EFAC mjd 52000 60000 1.6 1"])
+        t = _sim(truth, np.linspace(53005, 54795, 300), error_us=2.0, seed=10)
+        start = _model_with_lines(["EFAC mjd 52000 60000 1.0 1"])
+        f = DownhillWLSFitter(t, start)
+        f.fit_toas(maxiter=6, noise_fit_niter=1)
+        assert abs(float(f.model.EFAC1.value) - 1.6) < 0.25
+
+    def test_no_free_noise_params_unchanged_path(self):
+        """Without free noise params fit_toas must take the plain timing
+        path (fit_noise returns None, no alternation)."""
+        from pint_tpu.fitter import DownhillWLSFitter
+        from pint_tpu.models import get_model
+
+        m = get_model(NGC_PAR)
+        t = _sim(m, np.linspace(53005, 54795, 60), seed=11)
+        f = DownhillWLSFitter(t, copy.deepcopy(m))
+        assert f._get_free_noise_params() == []
+        assert f.fit_noise() is None
+        chi2 = f.fit_toas(maxiter=4)
+        assert np.isfinite(chi2)
