@@ -162,11 +162,14 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                        fit_params: Optional[Sequence[str]] = None,
                        niter: int = 4,
                        grid_spans: Optional[Sequence[float]] = None):
-    """Return (fn, free_init) where fn(points (P, G)) -> chi2 (P,).
+    """Return (fn, free_init, fit_params) where
+    ``fn(points (P, G)) -> (chi2 (P,), vfit (P, nfit))``.
 
     ``fn`` refits ``fit_params`` at each grid point with ``niter`` Gauss-
     Newton steps (linearized WLS, mirroring one-shot-WLS-per-point semantics
-    of the reference benchmark) and returns the resulting chi2 values.
+    of the reference benchmark) and returns the resulting chi2 values plus
+    the converged fit-parameter values (column i = ``fit_params[i]``, for
+    ``extraparnames``).
 
     If the model carries correlated-noise components (ECORR / PL red noise)
     the per-point solve and chi2 switch to the GLS/Woodbury form
@@ -245,7 +248,9 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                 dpar, *_ = jnp.linalg.lstsq(Aw / norms, rw)
                 v = v.at[:nfit].add(dpar[1:] / norms[1:])
             r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
-            return jnp.sum(w * r * r)
+            # the refit parameter values ride along for extraparnames
+            # (reference gridutils.py:116-160 extraout)
+            return jnp.sum(w * r * r), v[:nfit]
 
         # NOTE: the outer jit inlines the inner jitted eval/jac and lets XLA
         # re-optimize across the graph, which relaxes the dd error-free
@@ -262,7 +267,7 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
         return vfn(points, free_init, const_pv, batch, ctx, int0, w, F0,
                    Jbase)
 
-    return fn, free_init
+    return fn, free_init, fit_params
 
 
 def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
@@ -386,7 +391,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
             wr = w * r
             z = jsl.solve_triangular(cf_chi, U_chi.T @ wr, lower=True)
-            return jnp.sum(r * wr) - z @ z
+            return jnp.sum(r * wr) - z @ z, v[:nfit]
 
         model._cache[grid_key] = jax.jit(jax.vmap(
             chi2_point,
@@ -402,7 +407,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             # the fixed chunk must tile evenly onto the mesh axis
             ndev = sharding.mesh.devices.size
             blk_size = max(chunk, ndev) // ndev * ndev
-        out = []
+        out, out_v = [], []
         for i in range(0, npts, blk_size):
             blk = points[i:i + blk_size]
             pad = blk_size - blk.shape[0]
@@ -410,16 +415,42 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                 blk = jnp.concatenate([blk, jnp.tile(blk[-1:], (pad, 1))])
             if sharding is not None:
                 blk = jax.device_put(blk, sharding)
-            c2 = vfn(blk, free_init, const_pv, batch, ctx, int0, w, U,
-                     phi, F0, Jbase, UtWU, unorms, U_chi, cf_chi)
-            out.append(c2[:blk_size - pad] if pad else c2)
-        return jnp.concatenate(out)
+            c2, vf = vfn(blk, free_init, const_pv, batch, ctx, int0, w, U,
+                         phi, F0, Jbase, UtWU, unorms, U_chi, cf_chi)
+            keep = blk_size - pad if pad else blk_size
+            out.append(c2[:keep])
+            out_v.append(vf[:keep])
+        return jnp.concatenate(out), jnp.concatenate(out_v)
 
-    return fn, free_init
+    return fn, free_init, fit_params
+
+
+def _extraout(extraparnames, fit_params, grid_params, vfit, pts, model,
+              shape=None):
+    """Per-point refit parameter values (reference ``gridutils.py:116-160``
+    ``extraout``): refit params come from the converged Gauss-Newton state,
+    grid params from the grid point itself, anything else is the model's
+    (constant) current value."""
+    out = {}
+    if not extraparnames:
+        return out
+    vf = np.asarray(vfit)  # one device->host gather for all names
+    pts = np.asarray(pts)
+    fit_params, grid_params = list(fit_params), list(grid_params)
+    for name in extraparnames:
+        if name in fit_params:
+            col = vf[:, fit_params.index(name)]
+        elif name in grid_params:
+            col = pts[:, grid_params.index(name)]
+        else:
+            col = np.full(len(vf), float(getattr(model, name).value or 0.0))
+        out[name] = col.reshape(shape) if shape is not None else col
+    return out
 
 
 def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
+               extraparnames: Sequence[str] = (),
                niter: int = 4, mesh=None, **fitargs) -> Tuple[np.ndarray, dict]:
     """Chi2 over an outer-product grid (reference ``gridutils.py:164`` API).
 
@@ -427,6 +458,8 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     are no-ops — points are batched on-device, which replaces the reference's
     process pool (warned once at runtime).  Pass ``mesh`` (a
     ``jax.sharding.Mesh`` with a 'grid' axis) to shard points across devices.
+    ``extraparnames`` returns the per-point refit values of those parameters
+    in the second return slot, shaped like the grid.
     """
     global _warned_executor
     if (executor is not None or ncpu not in (None, 1)) and not _warned_executor:
@@ -442,9 +475,9 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
-    fn, _ = build_grid_chi2_fn(model, toas, parnames, niter=niter,
-                               grid_spans=_point_spans(model, parnames,
-                                                       mesh_pts))
+    fn, _, fit_params = build_grid_chi2_fn(
+        model, toas, parnames, niter=niter,
+        grid_spans=_point_spans(model, parnames, mesh_pts))
     pts = jnp.asarray(mesh_pts)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -452,7 +485,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
         if gls:
             # chunked path: each fixed-size chunk is sharded on entry
-            chi2 = np.asarray(fn(pts, sharding=sharding))
+            chi2, vfit = fn(pts, sharding=sharding)
         else:
             npts = pts.shape[0]
             ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
@@ -460,10 +493,13 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
             if pad:
                 pts = jnp.concatenate([pts, jnp.tile(pts[-1:], (pad, 1))])
             pts = jax.device_put(pts, sharding)
-            chi2 = np.asarray(fn(pts))[:npts]
+            chi2, vfit = fn(pts)
+            chi2, vfit = chi2[:npts], vfit[:npts]
     else:
-        chi2 = np.asarray(fn(pts))
-    return chi2.reshape(shape), {}
+        chi2, vfit = fn(pts)
+    extraout = _extraout(extraparnames, fit_params, parnames, vfit, mesh_pts,
+                         model, shape=shape)
+    return np.asarray(chi2).reshape(shape), extraout
 
 
 def _point_spans(model, parnames, pts) -> list:
@@ -481,6 +517,7 @@ def _point_spans(model, parnames, pts) -> list:
 
 def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
                        gridvalues: Sequence, niter: int = 4,
+                       extraparnames: Sequence[str] = (),
                        **kw) -> Tuple[np.ndarray, list, dict]:
     """Grid over derived quantities: each model parameter in ``parnames`` is
     computed as ``parfuncs[i](*gridpoint)`` (reference ``gridutils.py:390``)."""
@@ -492,26 +529,35 @@ def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
     pts = np.stack(
         [np.asarray([f(*vals) for vals in zip(*flat)], dtype=np.float64)
          for f in parfuncs], axis=-1)
-    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter,
-                               grid_spans=_point_spans(model, parnames, pts))
-    chi2 = np.asarray(fn(jnp.asarray(pts)))
+    fn, _, fit_params = build_grid_chi2_fn(
+        model, toas, tuple(parnames), niter=niter,
+        grid_spans=_point_spans(model, parnames, pts))
+    chi2, vfit = fn(jnp.asarray(pts))
     out_grids = [g.reshape(shape) for g in mesh_arrays]
-    return chi2.reshape(shape), out_grids, {}
+    extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
+                         pts, model, shape=shape)
+    return np.asarray(chi2).reshape(shape), out_grids, extraout
 
 
 def tuple_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
-                niter: int = 4, **kw) -> Tuple[np.ndarray, dict]:
+                niter: int = 4, extraparnames: Sequence[str] = (),
+                **kw) -> Tuple[np.ndarray, dict]:
     """Chi2 at an explicit list of parameter tuples (reference
     ``gridutils.py:586``)."""
     model, toas = ftr.model, ftr.toas
     pts = np.asarray(parvalues, dtype=np.float64)
-    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter,
-                               grid_spans=_point_spans(model, parnames, pts))
-    return np.asarray(fn(jnp.asarray(pts))), {}
+    fn, _, fit_params = build_grid_chi2_fn(
+        model, toas, tuple(parnames), niter=niter,
+        grid_spans=_point_spans(model, parnames, pts))
+    chi2, vfit = fn(jnp.asarray(pts))
+    extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
+                         pts, model)
+    return np.asarray(chi2), extraout
 
 
 def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
                         parvalues: Sequence, niter: int = 4,
+                        extraparnames: Sequence[str] = (),
                         **kw) -> Tuple[np.ndarray, list, dict]:
     """Chi2 at explicit tuples of *derived* quantities: model parameter i is
     ``parfuncs[i](*point)`` (reference ``gridutils.py:771``)."""
@@ -520,7 +566,11 @@ def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
     pts = np.stack(
         [np.asarray([f(*vals) for vals in raw], dtype=np.float64)
          for f in parfuncs], axis=-1)
-    fn, _ = build_grid_chi2_fn(model, toas, tuple(parnames), niter=niter,
-                               grid_spans=_point_spans(model, parnames, pts))
+    fn, _, fit_params = build_grid_chi2_fn(
+        model, toas, tuple(parnames), niter=niter,
+        grid_spans=_point_spans(model, parnames, pts))
+    chi2, vfit = fn(jnp.asarray(pts))
     out_values = [raw[:, i] for i in range(raw.shape[1])]
-    return np.asarray(fn(jnp.asarray(pts))), out_values, {}
+    extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
+                         pts, model)
+    return np.asarray(chi2), out_values, extraout

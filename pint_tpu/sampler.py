@@ -205,7 +205,7 @@ class EnsembleSampler(MCMCSampler):
 
     def __init__(self, nwalkers: int, a: float = 2.0,
                  seed: Optional[int] = None, backend=None,
-                 checkpoint_every: int = 50):
+                 checkpoint_every: int = 50, mesh=None):
         super().__init__()
         if nwalkers % 2:
             raise ValueError("nwalkers must be even (half-ensemble updates)")
@@ -222,6 +222,30 @@ class EnsembleSampler(MCMCSampler):
         self.backend = (NpzBackend(backend) if isinstance(backend, str)
                         else backend)
         self.checkpoint_every = checkpoint_every
+        # mesh: shard the walker axis of every batched lnposterior call
+        # over the first mesh axis — the TPU replacement for the reference's
+        # process/MPI walker pools (scripts/event_optimize.py:804-905).
+        # Proposal/acceptance bookkeeping stays on host (tiny); each
+        # walker's posterior is evaluated whole on one device, so sharded
+        # chains are bit-identical to unsharded ones at the same seed.
+        self.mesh = mesh
+
+    def _eval_lnpost(self, pts: np.ndarray) -> np.ndarray:
+        """Batched lnposterior, optionally walker-sharded over the mesh."""
+        if self.mesh is None:
+            return np.array(self._lnpost_batch(pts), dtype=np.float64)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = pts.shape[0]
+        ndev = int(self.mesh.devices.size)
+        pad = (-n) % ndev
+        if pad:
+            pts = np.concatenate([pts, np.tile(pts[-1:], (pad, 1))])
+        sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        dev_pts = jax.device_put(pts, sharding)
+        lp = np.array(self._lnpost_batch(dev_pts), dtype=np.float64)
+        return lp[:n] if pad else lp
 
     def resume(self) -> np.ndarray:
         """Restore chain + RNG state from the backend; returns the walker
@@ -261,7 +285,7 @@ class EnsembleSampler(MCMCSampler):
             z = ((self.a - 1.0) * u + 1.0) ** 2 / self.a
             partners = self.rng.integers(0, half, size=half)
             prop = xo[partners] + z[:, None] * (xs - xo[partners])
-            lp_prop = np.array(self._lnpost_batch(prop), dtype=np.float64)
+            lp_prop = self._eval_lnpost(prop)
             lnratio = (ndim - 1) * np.log(z) + lp_prop - lp[s]
             accept = np.log(self.rng.random(half)) < lnratio
             x[s] = np.where(accept[:, None], prop, xs)
@@ -297,7 +321,7 @@ class EnsembleSampler(MCMCSampler):
         if x.shape[0] != self.nwalkers:
             raise ValueError(
                 f"pos has {x.shape[0]} walkers, expected {self.nwalkers}")
-        lp = np.array(self._lnpost_batch(x), dtype=np.float64)
+        lp = self._eval_lnpost(x)
         try:
             for step in range(iterations):
                 self._one_step(x, lp, step)

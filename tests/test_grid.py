@@ -242,6 +242,48 @@ class TestGridUtilsParity:
         assert chi2_one == pytest.approx(float(chi2_t[0]), rel=1e-4)
         assert np.isfinite(extras[0])
 
+    def test_batched_extraparnames_match_doonefit(self, ngc_fit):
+        """VERDICT r3 #5: the batched grid returns per-point refit values
+        (reference gridutils.py:116-160 extraout), matching the scalar
+        doonefit path."""
+        from pint_tpu.grid import doonefit, grid_chisq
+
+        f = ngc_fit
+        F0 = float(f.model.F0.value)
+        g0 = np.array([F0 - 3e-12, F0, F0 + 3e-12])
+        chi2, extra = grid_chisq(f, ("F0",), (g0,), niter=8,
+                                 extraparnames=("F1", "DM", "F0"))
+        assert set(extra) == {"F1", "DM", "F0"}
+        assert extra["F1"].shape == chi2.shape == (3,)
+        # the grid parameter's "extra" is the grid value itself
+        np.testing.assert_allclose(extra["F0"], g0, rtol=0)
+        for i, v0 in enumerate(g0):
+            _, extras = doonefit(f, ("F0",), (v0,), maxiter=8,
+                                 extraparnames=("F1", "DM"))
+            assert extra["F1"][i] == pytest.approx(extras[0], rel=1e-6), i
+            assert extra["DM"][i] == pytest.approx(extras[1], rel=1e-6), i
+
+    def test_gls_batched_extraparnames(self, gls_fit):
+        """Extras ride through the chunked GLS path too."""
+        from pint_tpu.grid import grid_chisq
+
+        f = gls_fit
+        F0 = float(f.model.F0.value)
+        g0 = np.linspace(F0 - 3e-12, F0 + 3e-12, 3)
+        from pint_tpu.grid import doonefit
+
+        chi2, extra = grid_chisq(f, ("F0",), (g0,), niter=8,
+                                 extraparnames=("F1", "DM"))
+        assert extra["DM"].shape == chi2.shape == (3,)
+        # per-point parity with the scalar doonefit path (the refit DM
+        # legitimately swings point-to-point: single-frequency TOAs leave
+        # DM strongly covariant with F0 — both paths must agree on it)
+        for i, v in enumerate(g0):
+            _, ex = doonefit(f, ("F0",), (v,), maxiter=8,
+                             extraparnames=("F1", "DM"))
+            assert extra["F1"][i] == pytest.approx(ex[0], rel=1e-5), i
+            assert extra["DM"][i] == pytest.approx(ex[1], rel=1e-4), i
+
     def test_tuple_chisq_derived(self, ngc_fit):
         from pint_tpu.grid import tuple_chisq, tuple_chisq_derived
 
