@@ -77,7 +77,8 @@ class Fitter:
         """Unfrozen noise parameters (reference ``fitter.py:1160``)."""
         from pint_tpu.noisefit import free_noise_params
 
-        return free_noise_params(self.model)
+        return free_noise_params(self.model,
+                                 wideband=getattr(self, "is_wideband", False))
 
     def _update_noise_params(self, names, values, errors=None):
         """Write ML noise estimates back to the model (reference
@@ -87,7 +88,7 @@ class Fitter:
             # sign-degenerate parameters enter the likelihood squared;
             # report the physical (non-negative) branch
             v = float(values[i])
-            if p.startswith(("EFAC", "EQUAD", "ECORR")):
+            if p.startswith(("EFAC", "EQUAD", "ECORR", "DMEFAC", "DMEQUAD")):
                 v = abs(v)
             par.value = v
             if errors is not None:
@@ -104,12 +105,17 @@ class Fitter:
         Returns a :class:`pint_tpu.noisefit.NoiseFitResult` (None when no
         noise parameter is free).  Does NOT write back to the model — the
         alternating loop in ``DownhillFitter.fit_toas`` does that via
-        :meth:`_update_noise_params`.
+        :meth:`_update_noise_params`.  Wideband fitters fit the joint
+        TOA+DM likelihood (DMEFAC/DMEQUAD included).
         """
         from pint_tpu.noisefit import fit_noise_ml
 
+        dm_resids = None
+        if getattr(self, "is_wideband", False):
+            dm_resids = np.asarray(self.resids.dm.resids)
         return fit_noise_ml(self.model, self.toas,
                             np.asarray(self.resids.time_resids),
+                            dm_resids=dm_resids,
                             method=noisefit_method, uncertainty=uncertainty)
 
     def get_fitparams(self) -> dict:

@@ -449,8 +449,8 @@ def _extraout(extraparnames, fit_params, grid_params, vfit, pts, model,
 
 
 def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
-               executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
                extraparnames: Sequence[str] = (),
+               executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
                niter: int = 4, mesh=None, **fitargs) -> Tuple[np.ndarray, dict]:
     """Chi2 over an outer-product grid (reference ``gridutils.py:164`` API).
 
@@ -516,8 +516,9 @@ def _point_spans(model, parnames, pts) -> list:
 
 
 def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
-                       gridvalues: Sequence, niter: int = 4,
+                       gridvalues: Sequence,
                        extraparnames: Sequence[str] = (),
+                       niter: int = 4,
                        **kw) -> Tuple[np.ndarray, list, dict]:
     """Grid over derived quantities: each model parameter in ``parnames`` is
     computed as ``parfuncs[i](*gridpoint)`` (reference ``gridutils.py:390``)."""
@@ -540,7 +541,7 @@ def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
 
 
 def tuple_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
-                niter: int = 4, extraparnames: Sequence[str] = (),
+                extraparnames: Sequence[str] = (), niter: int = 4,
                 **kw) -> Tuple[np.ndarray, dict]:
     """Chi2 at an explicit list of parameter tuples (reference
     ``gridutils.py:586``)."""
@@ -556,8 +557,8 @@ def tuple_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
 
 
 def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
-                        parvalues: Sequence, niter: int = 4,
-                        extraparnames: Sequence[str] = (),
+                        parvalues: Sequence,
+                        extraparnames: Sequence[str] = (), niter: int = 4,
                         **kw) -> Tuple[np.ndarray, list, dict]:
     """Chi2 at explicit tuples of *derived* quantities: model parameter i is
     ``parfuncs[i](*point)`` (reference ``gridutils.py:771``)."""

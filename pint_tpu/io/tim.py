@@ -125,6 +125,47 @@ def _parse_princeton(line: str) -> RawTOA:
     return toa
 
 
+def _parse_itoa(line: str) -> RawTOA:
+    """ITOA format (tempo convention; layout confirmed against the
+    reference test file ``tests/datafile/NGC6440E.itoa``):
+
+    .. code-block:: text
+
+        columns  item
+        1-9      source name
+        10-28    TOA (decimal point in column 15)
+        30-35    TOA uncertainty (us)
+        36-45    observing frequency (MHz)
+        46-55    DM correction (pc cm^-3)
+        58-59    observatory (two-character ITOA code)
+
+    The reference *detects* these lines but raises "not implemented yet"
+    (``toa.py:557``, ``tests/test_toa_reader.py:648``); parsing them here
+    closes that documented input-format gap.
+    """
+    name = line[:9].strip()
+    mjd_field = line[9:28].strip()
+    if "." not in mjd_field or len(line) < 59:
+        raise PintFileError(f"Malformed ITOA TOA line: {line!r}")
+    try:
+        ii, ff = _split_mjd(mjd_field)
+        # fixed columns, like _parse_princeton/_parse_parkes: adjacent
+        # full-width fields carry no separating whitespace
+        error_us = float(line[29:35])
+        freq_mhz = float(line[35:45])
+        ddm = float(line[45:55])
+        obs = line[57:59].strip().upper()
+    except ValueError as e:
+        raise PintFileError(f"Malformed ITOA TOA line: {line!r}") from e
+    if not obs:
+        raise PintFileError(f"ITOA TOA line has no observatory: {line!r}")
+    toa = RawTOA(mjd_int=ii, mjd_frac_str=ff, error_us=error_us,
+                 freq_mhz=freq_mhz, obs=obs, name=name)
+    if ddm != 0.0:
+        toa.flags["ddm"] = str(ddm)
+    return toa
+
+
 def _parse_parkes(line: str) -> RawTOA:
     ii = int(line[34:41])
     ff = line[42:55].strip()
@@ -196,14 +237,12 @@ def read_tim_file(path: str, process_includes: bool = True,
             continue
         if cd["SKIP"] or cd["END"] or kind == "Unknown":
             continue
-        if kind == "ITOA":
-            # explicit refusal, matching the reference (``toa.py:557-558``)
-            raise PintFileError(
-                f"ITOA-format TOA lines are not implemented: {line.strip()!r}")
         if kind == "Tempo2":
             toa = _parse_tempo2(line)
         elif kind == "Princeton":
             toa = _parse_princeton(line)
+        elif kind == "ITOA":
+            toa = _parse_itoa(line)
         else:
             toa = _parse_parkes(line)
         if not (cd["EMIN"] <= toa.error_us <= cd["EMAX"]):

@@ -36,14 +36,14 @@ __all__ = ["free_noise_params", "build_noise_lnlikelihood", "NoiseFitResult",
 _TWO_PI = 2.0 * np.pi
 
 
-def free_noise_params(model) -> List[str]:
-    """Unfrozen noise-component parameters the TOA likelihood can actually
-    fit (reference ``fitter.py:1160 _get_free_noise_params``).
+def free_noise_params(model, wideband: bool = False) -> List[str]:
+    """Unfrozen noise-component parameters the likelihood can actually fit
+    (reference ``fitter.py:1160 _get_free_noise_params``).
 
     Excluded with a warning: TNEQ (inert after setup converts it to an
-    EQUAD equivalent — fitting it would be a flat direction) and the
-    wideband DM-noise parameters (DMEFAC/DMEQUAD/DMJUMP — the TOA-only
-    likelihood has no DM term yet)."""
+    EQUAD equivalent — fitting it would be a flat direction) and, for
+    narrowband data only, the wideband DM-noise parameters
+    (DMEFAC/DMEQUAD — the TOA-only likelihood has no DM term)."""
     out = []
     for c in model.noise_components:
         for p in c.params:
@@ -55,10 +55,10 @@ def free_noise_params(model) -> List[str]:
                             "equivalent at setup; excluding it from the "
                             "noise fit (free the EQUAD instead)")
                 continue
-            if p.startswith(("DMEFAC", "DMEQUAD", "DMJUMP")):
-                log.warning(f"{p} is free but ML fitting of wideband "
-                            "DM-noise parameters is not implemented; "
-                            "excluding it from the noise fit")
+            if p.startswith(("DMEFAC", "DMEQUAD")) and not wideband:
+                log.warning(f"{p} is free but the data are narrowband (no "
+                            "wideband DM measurements); excluding it from "
+                            "the noise fit")
                 continue
             out.append(p)
     return out
@@ -78,17 +78,16 @@ def _value_getter(model, free_names: List[str]) -> Callable:
     return getv
 
 
-def _white_ops(model, toas):
+def _white_ops(model, toas, category: str = "scale_toa_error",
+               prefixes=("EQUAD", "EFAC")):
     """(kind, idx, param_name) ops reproducing scale_toa_sigma's order:
-    per ScaleToaError component, all EQUADs (quadrature) then all EFACs
-    (multiplier).  ``noise_model.py:204``."""
+    per scaling component, all quadrature adds then all multipliers
+    (``noise_model.py:204 scale_toa_sigma`` / ``:242 scale_dm_sigma``)."""
     ops = []
     for c in model.noise_components:
-        if not hasattr(c, "scale_toa_sigma") or not hasattr(c, "_masks_of"):
+        if c.category != category or not hasattr(c, "_masks_of"):
             continue
-        if c.category != "scale_toa_error":
-            continue
-        for prefix in ("EQUAD", "EFAC"):
+        for prefix in prefixes:
             for p in c._masks_of(prefix):
                 par = c._params_dict[p]
                 if par.value is None:
@@ -163,7 +162,7 @@ def _corr_weight_builders(model, toas):
     return builders
 
 
-def build_noise_lnlikelihood(model, toas):
+def build_noise_lnlikelihood(model, toas, wideband: bool = False):
     """(lnlike, x0, free_names): ``lnlike(x, r)`` is the Gaussian
     log-likelihood of time residuals ``r`` [s] as a jit-compatible,
     autodiff-able function of the free noise parameter values ``x``.
@@ -172,8 +171,14 @@ def build_noise_lnlikelihood(model, toas):
     ``residuals.py:730``): ``-(chi2/2 + logdet(C)/2 + n/2 log 2pi)`` with
     ``C = diag(Nvec) + U phi U^T`` evaluated through the Woodbury identity
     (reference ``utils.py:3069 woodbury_dot``).
+
+    With ``wideband=True`` the returned function is ``lnlike(x, r, r_dm)``
+    — the joint likelihood adds the diagonal DM term with
+    DMEFAC/DMEQUAD-scaled variances (the stacked system separates; the
+    noise basis spans only the TOA rows, reference ``residuals.py:1240``)
+    and DMEFAC/DMEQUAD join the fit vector.
     """
-    free = free_noise_params(model)
+    free = free_noise_params(model, wideband=wideband)
     if any(p in ("RNAMP", "RNIDX") for p in free):
         c = model.components.get("PLRedNoise")
         if c is not None and c._params_dict["TNREDAMP"].value is not None:
@@ -217,13 +222,13 @@ def build_noise_lnlikelihood(model, toas):
         return var
 
     if U is None:
-        def lnlike(x, r):
+        def lnlike_toa(x, r):
             var = white_var(x)
             chi2 = jnp.sum(r * r / var)
             logdet = jnp.sum(jnp.log(var))
             return -0.5 * (chi2 + logdet + n * jnp.log(_TWO_PI))
     else:
-        def lnlike(x, r):
+        def lnlike_toa(x, r):
             var = white_var(x)
             segs = [b(x, getv) for b in builders]
             if offset_phi is not None:
@@ -240,7 +245,34 @@ def build_noise_lnlikelihood(model, toas):
             return -0.5 * (chi2 + logdet + n * jnp.log(_TWO_PI))
 
     x0 = np.array([float(getattr(model, p).value) for p in free])
-    return lnlike, x0, free
+    if not wideband:
+        return lnlike_toa, x0, free
+
+    dm_err = toas.get_dm_errors()
+    if dm_err is None:
+        raise ValueError("wideband noise fit requested but the TOAs carry "
+                         "no wideband DM measurements (-pp_dm flags)")
+    dm_sig0_sq = jnp.asarray(np.asarray(dm_err, dtype=np.float64) ** 2)
+    dm_ops = _white_ops(model, toas, category="scale_dm_error",
+                        prefixes=("DMEQUAD", "DMEFAC"))
+
+    def dm_var(x):
+        var = dm_sig0_sq
+        for kind, idx, p in dm_ops:
+            v = getv(x, p)
+            if kind == "DMEQUAD":  # pc/cm3, no unit conversion
+                var = var.at[idx].add(v * v, unique_indices=True)
+            else:  # DMEFAC
+                var = var.at[idx].mul(v * v, unique_indices=True)
+        return var
+
+    def lnlike_wb(x, r, r_dm):
+        var_dm = dm_var(x)
+        lnl_dm = -0.5 * (jnp.sum(r_dm * r_dm / var_dm)
+                         + jnp.sum(jnp.log(var_dm)) + n * jnp.log(_TWO_PI))
+        return lnlike_toa(x, r) + lnl_dm
+
+    return lnlike_wb, x0, free
 
 
 class NoiseFitResult:
@@ -267,7 +299,10 @@ def _scales_for(names: List[str], x0: np.ndarray) -> np.ndarray:
         if nm.startswith("RNAMP"):
             # tempo1 linear amplitude, typically 1e-3..1e-1
             s[i] = max(0.5 * abs(x0[i]), 1e-4)
-        elif nm.startswith(("EFAC", "EQUAD", "ECORR")):
+        elif nm.startswith("DMEQUAD"):
+            # pc/cm3; wideband DM errors are typically ~1e-4..1e-3
+            s[i] = max(0.25 * abs(x0[i]), 1e-5)
+        elif nm.startswith(("EFAC", "EQUAD", "ECORR", "DMEFAC")):
             s[i] = max(0.25 * abs(x0[i]), 0.05)
         else:  # log10 amplitudes, spectral indices
             s[i] = 0.25
@@ -275,6 +310,7 @@ def _scales_for(names: List[str], x0: np.ndarray) -> np.ndarray:
 
 
 def fit_noise_ml(model, toas, resids_s: np.ndarray,
+                 dm_resids=None,
                  method: str = "L-BFGS-B",
                  uncertainty: bool = False,
                  maxiter: int = 200) -> Optional[NoiseFitResult]:
@@ -284,11 +320,13 @@ def fit_noise_ml(model, toas, resids_s: np.ndarray,
     gradients (white-only) or Nelder-Mead (correlated); here one scipy
     L-BFGS-B outer loop drives the jitted autodiff value-and-gradient for
     all parameter classes.  Returns None when the model has no free noise
-    parameters.
+    parameters.  Pass ``dm_resids`` (pc/cm3) to fit the joint wideband
+    likelihood including DMEFAC/DMEQUAD.
     """
     import scipy.optimize as opt
 
-    free = tuple(free_noise_params(model))
+    wideband = dm_resids is not None
+    free = tuple(free_noise_params(model, wideband=wideband))
     if not free:
         return None
     # cache the jitted value-and-grad / Hessian across alternation rounds:
@@ -299,18 +337,21 @@ def fit_noise_ml(model, toas, resids_s: np.ndarray,
         (p, str(c._params_dict[p].value))
         for c in model.noise_components for p in c.params if p not in free)
     key = ("noisefit_fns", free, toas, getattr(toas, "_version", 0),
-           frozen_vals)
+           frozen_vals, wideband)
     cached = model._cache.get(key)
     if cached is None:
-        lnlike, _, names = build_noise_lnlikelihood(model, toas)
-        vg_fn = jax.jit(jax.value_and_grad(
-            lambda x, r: -lnlike(x, r)))
-        hess_fn = jax.jit(jax.hessian(lambda x, r: -lnlike(x, r)))
+        lnlike, _, names = build_noise_lnlikelihood(model, toas,
+                                                    wideband=wideband)
+        neg = (lambda x, *r: -lnlike(x, *r))
+        vg_fn = jax.jit(jax.value_and_grad(neg))
+        hess_fn = jax.jit(jax.hessian(neg))
         model._cache[key] = (lnlike, vg_fn, hess_fn, names)
     lnlike, vg_fn, hess_fn, names = model._cache[key]
     x0 = np.array([float(getattr(model, p).value) for p in names])
-    r = jnp.asarray(np.asarray(resids_s))
-    vg = lambda x: vg_fn(x, r)
+    rs = [jnp.asarray(np.asarray(resids_s))]
+    if wideband:
+        rs.append(jnp.asarray(np.asarray(dm_resids, dtype=np.float64)))
+    vg = lambda x: vg_fn(x, *rs)
     scale = _scales_for(names, x0)
 
     def fun(y):
@@ -326,6 +367,6 @@ def fit_noise_ml(model, toas, resids_s: np.ndarray,
     x = x0 + res.x * scale
     errs = None
     if uncertainty:
-        H = np.asarray(hess_fn(jnp.asarray(x), r))
+        H = np.asarray(hess_fn(jnp.asarray(x), *rs))
         errs = np.sqrt(np.abs(np.diag(np.linalg.pinv(H))))
     return NoiseFitResult(names, x, errs, -res.fun, res.success, res.message)

@@ -1,0 +1,32 @@
+"""Execute the example walkthroughs (reference doc-as-test pillar,
+SURVEY §4: the reference runs its 28 ``docs/examples`` scripts as tests via
+the notebooks tox environment)."""
+
+import os
+import runpy
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+class TestExamples:
+    def test_fit_b1855_walkthrough(self, capsys):
+        """The full B1855 GLS walkthrough (quick CI size) runs green and
+        prints a sane summary."""
+        script = os.path.join(EXAMPLES, "fit_b1855.py")
+        argv_save = sys.argv
+        sys.argv = [script, "--quick"]
+        try:
+            with pytest.raises(SystemExit) as e:
+                runpy.run_path(script, run_name="__main__")
+            assert e.value.code == 0
+        finally:
+            sys.argv = argv_save
+        out = capsys.readouterr().out
+        assert "GLS fit: chi2" in out
+        assert "ML noise fit" in out
+        assert "M2 x SINI grid" in out
+        assert "done" in out

@@ -263,6 +263,18 @@ class TestGridUtilsParity:
             assert extra["F1"][i] == pytest.approx(extras[0], rel=1e-6), i
             assert extra["DM"][i] == pytest.approx(extras[1], rel=1e-6), i
 
+    def test_extraparnames_positional_reference_order(self, ngc_fit):
+        """Reference gridutils.py:164 takes extraparnames as the 4th
+        positional parameter; reference-style positional calls must bind
+        it there, not to executor."""
+        from pint_tpu.grid import grid_chisq
+
+        f = ngc_fit
+        F0 = float(f.model.F0.value)
+        g0 = np.array([F0, F0 + 3e-12])
+        chi2, extra = grid_chisq(f, ("F0",), (g0,), ("F1",))
+        assert set(extra) == {"F1"} and extra["F1"].shape == (2,)
+
     def test_gls_batched_extraparnames(self, gls_fit):
         """Extras ride through the chunked GLS path too."""
         from pint_tpu.grid import grid_chisq

@@ -44,6 +44,37 @@ class TestIO:
         assert toas[0].mjd_int == 53478
         assert toas[0].mjd_frac_str == "2858714192189"
 
+    def test_tim_read_itoa(self, tmp_path):
+        """ITOA format (reference detects but refuses, toa.py:557; here it
+        parses — layout confirmed against the reference's NGC6440E.itoa)."""
+        from pint_tpu.io.tim import read_tim_file
+
+        toas, _ = read_tim_file("/root/reference/tests/datafile/NGC6440E.itoa")
+        assert len(toas) == 62
+        assert toas[0].name == "1748-2021"
+        assert toas[0].mjd_int == 53478
+        assert toas[0].mjd_frac_str == "2858714192289"
+        assert toas[0].error_us == 21.71
+        assert toas[0].freq_mhz == 1949.609
+        assert toas[0].obs == "GB"
+        # fabricated round trip, including a nonzero DM correction
+        p = tmp_path / "fab.itoa"
+        p.write_text(
+            "J0123+45654321.1234567890123 12.34  1400.5000  0.012345  GB\n"
+            "J0123+45654322.9876543210987  3.21   430.0000  0.000000  AO\n")
+        t2, _ = read_tim_file(str(p))
+        assert [r.mjd_int for r in t2] == [54321, 54322]
+        assert t2[0].mjd_frac_str == "1234567890123"
+        assert t2[0].flags["ddm"] == "0.012345"
+        assert "ddm" not in t2[1].flags
+        assert t2[1].obs == "AO" and t2[1].error_us == 3.21
+        # full pipeline: get_TOAs resolves the two-char ITOA codes
+        from pint_tpu.toa import get_TOAs
+
+        t3 = get_TOAs("/root/reference/tests/datafile/NGC6440E.itoa")
+        assert len(t3) == 62
+        assert set(t3.obs) == {"gbt"}
+
     def test_tim_read_tempo2_flags(self):
         from pint_tpu.io.tim import read_tim_file
 
