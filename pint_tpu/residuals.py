@@ -160,7 +160,15 @@ class Residuals:
         return float(np.sqrt(np.sum(w * (self.time_resids - float(mean)) ** 2) / np.sum(w)))
 
     def calc_whitened_resids(self) -> np.ndarray:
-        return self.time_resids / self.get_data_error()
+        """(r - correlated-noise realization) / scaled sigma (reference
+        ``residuals.py:552-582``: the noise realization from a post-fit
+        ``noise_ampls`` is subtracted before normalizing; without stored
+        amplitudes this reduces to r / sigma)."""
+        r = self.time_resids
+        nr = self.noise_resids()
+        if nr:
+            r = r - sum(nr.values())
+        return r / self.get_data_error()
 
     def lnlikelihood(self) -> float:
         """Gaussian log-likelihood including the noise log-determinant

@@ -12,21 +12,40 @@ EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
 
 
+def _run(script, *args, capsys=None):
+    path = os.path.join(EXAMPLES, script)
+    argv_save = sys.argv
+    sys.argv = [path, *args]
+    try:
+        with pytest.raises(SystemExit) as e:
+            runpy.run_path(path, run_name="__main__")
+        assert e.value.code == 0
+    finally:
+        sys.argv = argv_save
+    return capsys.readouterr().out if capsys else ""
+
+
 class TestExamples:
     def test_fit_b1855_walkthrough(self, capsys):
         """The full B1855 GLS walkthrough (quick CI size) runs green and
         prints a sane summary."""
-        script = os.path.join(EXAMPLES, "fit_b1855.py")
-        argv_save = sys.argv
-        sys.argv = [script, "--quick"]
-        try:
-            with pytest.raises(SystemExit) as e:
-                runpy.run_path(script, run_name="__main__")
-            assert e.value.code == 0
-        finally:
-            sys.argv = argv_save
-        out = capsys.readouterr().out
+        out = _run("fit_b1855.py", "--quick", capsys=capsys)
         assert "GLS fit: chi2" in out
         assert "ML noise fit" in out
         assert "M2 x SINI grid" in out
         assert "done" in out
+
+    def test_quickstart_walkthrough(self, capsys):
+        out = _run("quickstart_ngc6440e.py", capsys=capsys)
+        assert "prefit" in out and "postfit" in out
+        assert "round-trips losslessly" in out
+
+    def test_bayesian_mcmc_walkthrough(self, capsys):
+        out = _run("bayesian_mcmc.py", "--quick", capsys=capsys)
+        assert "acceptance fraction" in out
+        assert "posterior consistent" in out
+
+    def test_noise_analysis_walkthrough(self, capsys):
+        out = _run("noise_analysis.py", "--quick", capsys=capsys)
+        assert "EFAC1" in out and "ECORR1" in out
+        assert "whitened residual std" in out
