@@ -322,31 +322,56 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     J0, nl_fit = _classified_columns_cached(
         model, toas, jac_fn, free_init, const_pv, batch, ctx, nfit,
         len(grid_params), grid_spans, all_names)
-    Jbase = jnp.asarray(J0)  # linear columns live here permanently
     nl_all = nl_fit  # positions within the full value vector == fit positions
     # (2) Noise-basis blocks of the normal equations and the Woodbury
     #     Cholesky for the final chi2: U, phi, and the weights never change,
     #     so U^T W U and chol(diag(1/phi) + U^T N^-1 U) are per-grid
     #     constants (reference recomputes both per point,
     #     ``fitter.py:2712``, ``utils.py:3069``).
-    UtWU = np.asarray(U).T @ (np.asarray(w)[:, None] * np.asarray(U))
-    unorms = np.sqrt(np.maximum(np.diag(UtWU), 1e-300))
+    W_np = np.asarray(w)
+    U_np = np.asarray(U)
+    UtWU_np = U_np.T @ (W_np[:, None] * U_np)
     # final-chi2 basis: offset marginalized exactly as Residuals.calc_chi2
     # — the grid's chi2 must be definitionally identical to the fitter's
-    U_chi, phi_chi = model.augment_basis_for_offset(np.asarray(U),
-                                                    np.asarray(phi),
+    U_chi, phi_chi = model.augment_basis_for_offset(U_np, np.asarray(phi),
                                                     n=len(toas))
-    Sigma_chi = np.diag(1.0 / phi_chi) + U_chi.T @ (np.asarray(w)[:, None]
-                                                    * U_chi)
+    Sigma_chi = np.diag(1.0 / phi_chi) + U_chi.T @ (W_np[:, None] * U_chi)
     cf_chi = jnp.asarray(np.linalg.cholesky(Sigma_chi))
     U_chi = jnp.asarray(U_chi)
-    UtWU = jnp.asarray(UtWU)
-    unorms = jnp.asarray(unorms)
+
+    # --- Schur-complement solve constants -------------------------------
+    # The augmented normal matrix is [[A, C], [C^T, D]] with a timing block
+    # A (1+nfit)^2, coupling C, and noise block D = diag(1/phi) + U^T W U.
+    # D is GRID-CONSTANT: prefactor L_D once, and per point solve only the
+    # marginalized timing system (A - C D^-1 C^T) x_t = b_t - C D^-1 b_u.
+    # Only the ~|nl| nonlinear design columns of B change per iteration, so
+    # B/A/C/Y = L_D^-1 C^T are hoisted with just those rows/cols refreshed
+    # — the per-fit cost drops from an O((nt+nu)^3) dense Cholesky plus
+    # full O(n*nt*nu) Gram matmuls to nonlinear-row matmuls, a k-column
+    # triangular solve, and an O(nt^3) Cholesky.  The Gauss-Newton step is
+    # algebraically identical; the final chi2 (below) is computed
+    # independently either way.
+    M0 = -np.asarray(J0) / F0
+    B_base_np = np.hstack([np.ones((len(toas), 1)), M0])
+    U_w_np = W_np[:, None] * U_np
+    A_base_np = B_base_np.T @ (W_np[:, None] * B_base_np)
+    C_base_np = B_base_np.T @ U_w_np
+    L_D_np = np.linalg.cholesky(np.diag(1.0 / np.asarray(phi)) + UtWU_np)
+    import scipy.linalg as _sl
+
+    Y_base_np = _sl.solve_triangular(L_D_np, C_base_np.T, lower=True)
+    B_base = jnp.asarray(B_base_np)
+    A_base = jnp.asarray(A_base_np)
+    Y_base = jnp.asarray(Y_base_np)
+    U_w = jnp.asarray(U_w_np)
+    L_D = jnp.asarray(L_D_np)
 
     grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk,
                 tuple(nl_fit))
     if grid_key not in model._cache:
         nl_idx = jnp.asarray(nl_all, dtype=jnp.int32)
+        # positions of the nonlinear columns within B (offset col 0 shifts)
+        nlp_idx = jnp.asarray([1 + i for i in nl_all], dtype=jnp.int32)
 
         def resid_seconds(values, const_pv, batch, ctx, int0, w, F0):
             ph, _ = eval_fn(values, const_pv, batch, ctx)
@@ -355,38 +380,44 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             return r / F0
 
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w,
-                       U, phi, F0, Jbase, UtWU, unorms, U_chi, cf_chi):
+                       F0, B_base, A_base, Y_base, U_w, L_D,
+                       U_chi, cf_chi):
             v = jnp.concatenate([free_init[:nfit], gvals])
-            ones = jnp.ones((U.shape[0], 1))
-            phiinv_u = 1.0 / phi
+            nt = 1 + nfit
             for _ in range(niter):
                 r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
+                wr = w * r
                 if len(nl_all):
                     def frac_of(sub):
                         ph, _ = eval_fn(v.at[nl_idx].set(sub), const_pv,
                                         batch, ctx)
                         return ph.frac
                     Jnl = jax.jacfwd(frac_of)(v[nl_idx])
-                    J = Jbase.at[:, nl_idx].set(Jnl)
+                    M_nl = -Jnl / F0  # (n, k)
+                    B = B_base.at[:, nlp_idx].set(M_nl)
+                    # refresh the nl rows/cols of the Gram blocks: the
+                    # (nl, nl) sub-block is written consistently twice
+                    A_cols = B.T @ (w[:, None] * M_nl)  # (nt, k)
+                    A = A_base.at[:, nlp_idx].set(A_cols)
+                    A = A.at[nlp_idx, :].set(A_cols.T)
+                    C_rows = M_nl.T @ U_w  # (k, nu)
+                    Y_cols = jsl.solve_triangular(L_D, C_rows.T, lower=True)
+                    Y = Y_base.at[:, nlp_idx].set(Y_cols)
                 else:
-                    J = Jbase
-                M = -J / F0
-                B = jnp.concatenate([ones, M], axis=1)  # timing block
-                WB = w[:, None] * B
-                BtWB = B.T @ WB
-                BtWU = WB.T @ U
-                bnorms = jnp.sqrt(jnp.maximum(jnp.diag(BtWB), 1e-300))
-                norms = jnp.concatenate([bnorms, unorms])
-                mtcm = jnp.block([[BtWB, BtWU], [BtWU.T, UtWU]]) \
-                    / jnp.outer(norms, norms)
-                phiinv = jnp.concatenate(
-                    [jnp.full(1 + nfit, 1e-40), phiinv_u]) / norms**2
-                mtcm = mtcm + jnp.diag(phiinv)
-                wr = w * r
-                mtcy = jnp.concatenate([B.T @ wr, U.T @ wr]) / norms
-                L = jnp.linalg.cholesky(mtcm)
-                x = jsl.cho_solve((L, True), mtcy)
-                v = v.at[:nfit].add(x[1:1 + nfit] / norms[1:1 + nfit])
+                    B, A, Y = B_base, A_base, Y_base
+                b_t = B.T @ wr
+                b_u = U_w.T @ r
+                z_u = jsl.solve_triangular(L_D, b_u, lower=True)
+                Ar = A - Y.T @ Y
+                rhs = b_t - Y.T @ z_u
+                # diagonal normalization for conditioning + a 1e-12
+                # relative ridge (the step need not be exact — the final
+                # chi2 below is computed independently)
+                an = jnp.sqrt(jnp.maximum(jnp.diag(Ar), 1e-300))
+                Arn = Ar / jnp.outer(an, an) + 1e-12 * jnp.eye(nt)
+                L = jnp.linalg.cholesky(Arn)
+                x = jsl.cho_solve((L, True), rhs / an) / an
+                v = v.at[:nfit].add(x[1:nt])
             r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
             # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
             wr = w * r
@@ -415,8 +446,9 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                 blk = jnp.concatenate([blk, jnp.tile(blk[-1:], (pad, 1))])
             if sharding is not None:
                 blk = jax.device_put(blk, sharding)
-            c2, vf = vfn(blk, free_init, const_pv, batch, ctx, int0, w, U,
-                         phi, F0, Jbase, UtWU, unorms, U_chi, cf_chi)
+            c2, vf = vfn(blk, free_init, const_pv, batch, ctx, int0, w,
+                         F0, B_base, A_base, Y_base, U_w, L_D,
+                         U_chi, cf_chi)
             keep = blk_size - pad if pad else blk_size
             out.append(c2[:keep])
             out_v.append(vf[:keep])
