@@ -1,0 +1,92 @@
+"""Photon-domain walkthrough: event TOAs, template fitting, pulse tests.
+
+The TPU-native analogue of the reference's photon/event walkthroughs
+(``docs/examples/fermi-FT1-example``, ``event_optimize`` docs): fabricate
+photon arrival times from a pulse-profile template, phase-fold them with
+the timing model, score significance with H-test/Z^2, and recover a spin
+offset with the template-likelihood MCMC fitter (the reference fans its
+walkers over an emcee process pool; here the whole half-ensemble is one
+vectorized device call).
+
+Run:  python examples/photon_events.py [--quick]
+"""
+
+import io
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PAR = """
+PSR  J0030+0451
+RAJ  00:30:27.43
+DECJ 04:51:39.7
+POSEPOCH 55000
+F0   205.53069927493
+F1   -4.2977e-16
+PEPOCH 55000
+DM   4.333
+EPHEM DE440
+UNITS TDB
+"""
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    quick = "--quick" in args
+    if "--cpu" in args:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from pint_tpu.event_fitter import MCMCFitterBinnedTemplate
+    from pint_tpu.eventstats import hm, z2m
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.templates.lcprimitives import LCGaussian
+    from pint_tpu.templates.lctemplate import LCTemplate
+
+    model = get_model(io.StringIO(PAR))
+    nphot = 400 if quick else 1500
+    toas = make_fake_toas_uniform(54990, 55010, nphot, model, error_us=1.0,
+                                  obs="barycenter", freq=np.inf,
+                                  rng=np.random.default_rng(30))
+
+    # two-peak profile template; redistribute the photons to draw from it
+    template = LCTemplate([LCGaussian([0.03, 0.30]), LCGaussian([0.06, 0.75])],
+                          [0.35, 0.30])
+    ph_now = np.asarray(model.phase(toas).frac) % 1.0
+    ph_want = template.random(len(toas), rng=np.random.default_rng(31))
+    dt = ((ph_want - ph_now + 0.5) % 1.0 - 0.5) / float(model.F0.value)
+    toas.adjust_TOAs(dt)
+    phases = np.asarray(model.phase(toas).frac) % 1.0
+
+    h = hm(phases)
+    z = z2m(phases, m=2)[-1]
+    print(f"{nphot} photons: H-test = {h:.1f}, Z^2_2 = {z:.1f} "
+          "(chance ~ a few for unpulsed data)")
+    assert h > 50
+
+    # perturb F0 and recover it from the photon phases alone
+    truth = float(model.F0.value)
+    start = get_model(io.StringIO(PAR))
+    start.F0.value = truth + 2e-8
+    start.F0.uncertainty = 1e-8
+    start.F0.frozen = False
+    f = MCMCFitterBinnedTemplate(
+        toas, start, template, nwalkers=16,
+        prior_info={"F0": {"distr": "uniform", "pmin": truth - 2e-7,
+                           "pmax": truth + 2e-7}})
+    f.fit_toas(maxiter=100 if quick else 400, seed=32)
+    err = abs(float(f.model.F0.value) - truth)
+    print(f"template-likelihood MCMC: F0 recovered to {err:.2e} Hz "
+          f"(started 2e-08 off; posterior sigma {f.errors['F0']:.1e})")
+    assert err < 1.5e-8
+    print(f"acceptance fraction {f.sampler.acceptance_fraction:.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,15 +113,25 @@ def _classify_linear_columns(jac_fn, free_init, const_pv, batch, ctx,
     nbits = max(1, int(np.ceil(np.log2(max(n, 2)))))
     idx = np.arange(n)
     nl: set = set()
+    probed = np.zeros(ngrid)  # per-grid-axis span actually validated
     for k in range(nbits + 1):
         s = np.where((idx >> k) & 1, -1.0, 1.0) if k < nbits \
             else np.ones(n)
-        v_pert = np.asarray(free_init) + dp * s
-        J1 = np.asarray(jac_fn(jnp.asarray(v_pert), const_pv, batch,
-                               ctx))[:, :nfit]
+        # domain-aware probe: shrink a step that NaNs the Jacobian (e.g.
+        # SINI pushed past 1) instead of letting non-finite columns force
+        # everything nonlinear
+        dp_eff = dp * s
+        for _ in range(4):
+            v_pert = np.asarray(free_init) + dp_eff
+            J1 = np.asarray(jac_fn(jnp.asarray(v_pert), const_pv, batch,
+                                   ctx))[:, :nfit]
+            if np.all(np.isfinite(J1)):
+                break
+            dp_eff = dp_eff / 8.0
+        probed = np.maximum(probed, np.abs(dp_eff[nfit:nfit + ngrid]))
         nl |= set(classify_linear_columns(J0, J1))
     nl_fit = sorted(nl)
-    return J0, nl_fit
+    return J0, nl_fit, probed
 
 
 def _classified_columns_cached(model, toas, jac_fn, free_init, const_pv,
@@ -151,10 +161,12 @@ def _classified_columns_cached(model, toas, jac_fn, free_init, const_pv,
             return J0, nl_fit
         if len(c_spans) == len(spans):
             spans = tuple(max(s, cs) for s, cs in zip(spans, c_spans))
-    J0, nl_fit = _classify_linear_columns(
+    J0, nl_fit, probed = _classify_linear_columns(
         jac_fn, free_init, const_pv, batch, ctx, nfit, ngrid,
         spans if spans else None)
-    model._cache[key] = (spans, fi, J0, nl_fit)
+    # cache the span each axis was ACTUALLY validated over — a
+    # domain-shrunk probe must not be credited with the requested span
+    model._cache[key] = (tuple(float(p) for p in probed), fi, J0, nl_fit)
     return J0, nl_fit
 
 

@@ -715,10 +715,23 @@ class TimingModel:
                                   "values0": values, "dp": None, "nl": None,
                                   "sub_jac": None}
                 return J0
-            J1 = np.asarray(c["jac_frac"](jnp.asarray(
-                entry["values0"] + np.where(np.isfinite(dp), dp, 0.0))))
+            # domain-aware probe: a combined step can leave a parameter's
+            # physical domain (e.g. SINI past 1) and NaN the whole probe
+            # Jacobian, which would classify EVERY column nonlinear.
+            # Shrink until finite; columns still non-finite at the
+            # smallest step stay conservatively nonlinear.
+            dp_eff = np.where(np.isfinite(dp), dp, 0.0)
+            for _ in range(4):
+                J1 = np.asarray(c["jac_frac"](jnp.asarray(
+                    entry["values0"] + dp_eff)))
+                if np.all(np.isfinite(J1)):
+                    break
+                dp_eff = dp_eff / 8.0
             nl = classify_linear_columns(entry["J0"], J1)
-            entry["dp"] = dp
+            # the reuse envelope is what was ACTUALLY probed: a shrunk
+            # probe validated flatness only over dp_eff, so steps beyond
+            # it must reseed
+            entry["dp"] = np.where(dp_eff > 0, dp_eff, dp)
             entry["nl"] = nl
             if len(nl):
                 fns = self._cache["fns"][(free, len(toas))]

@@ -49,3 +49,8 @@ class TestExamples:
         out = _run("noise_analysis.py", "--quick", capsys=capsys)
         assert "EFAC1" in out and "ECORR1" in out
         assert "whitened residual std" in out
+
+    def test_photon_events_walkthrough(self, capsys):
+        out = _run("photon_events.py", "--quick", capsys=capsys)
+        assert "H-test" in out
+        assert "F0 recovered" in out
