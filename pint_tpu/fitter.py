@@ -72,6 +72,33 @@ class Fitter:
         self.resids = Residuals(self.toas, self.model, track_mode=self.track_mode)
         return self.resids
 
+    def update_model(self, chi2: Optional[float] = None):
+        """Stamp fit products and TOA properties into the model (reference
+        ``fitter.py:470``): START/FINISH/NTOA/EPHEM/DMDATA always, plus
+        CHI2/CHI2R/TRES (and DMRES for wideband) after a fit."""
+        m = self.model
+        mjds = np.asarray(self.toas.get_mjds(), dtype=np.float64)
+        if len(mjds):
+            m.START.value = float(mjds.min())
+            m.FINISH.value = float(mjds.max())
+        m.NTOA.value = len(self.toas)
+        if getattr(self.toas, "ephem", None):
+            m.EPHEM.value = self.toas.ephem
+        wideband = getattr(self, "is_wideband", False)
+        m.DMDATA.value = "Y" if wideband else None
+        if chi2 is not None:
+            m.CHI2.value = chi2
+            dof = self.resids.dof
+            # never leave a stale CHI2R (e.g. from the input par) next to
+            # a fresh CHI2
+            m.CHI2R.value = chi2 / dof if dof > 0 else None
+            if wideband:
+                rms = self.resids.rms_weighted()
+                m.TRES.value = rms["toa"] * 1e6
+                m.DMRES.value = rms["dm"]
+            else:
+                m.TRES.value = self.resids.rms_weighted() * 1e6
+
     # -- maximum-likelihood noise fitting -----------------------------------
     def _get_free_noise_params(self) -> List[str]:
         """Unfrozen noise parameters (reference ``fitter.py:1160``)."""
@@ -243,7 +270,7 @@ class WLSFitter(Fitter):
                 self.errors[p] = err
                 getattr(self.model, p).uncertainty = err
         self.converged = True
-        self.model.CHI2.value = chi2
+        self.update_model(chi2)
         return chi2
 
 
@@ -340,7 +367,7 @@ class DownhillFitter(Fitter):
                 break
         else:
             log.warning(f"Downhill fit hit maxiter={maxiter}")
-        self.model.CHI2.value = best_chi2
+        self.update_model(best_chi2)
         return best_chi2
 
 
@@ -445,7 +472,7 @@ class LMFitter(Fitter):
             if p != "Offset":
                 self.errors[p] = float(errs[i])
                 getattr(self.model, p).uncertainty = float(errs[i])
-        self.model.CHI2.value = chi2
+        self.update_model(chi2)
         return chi2
 
 
@@ -478,5 +505,5 @@ class PowellFitter(Fitter):
         self.fitted_params = params
         self.converged = bool(res.success)
         chi2 = self.resids.chi2
-        self.model.CHI2.value = chi2
+        self.update_model(chi2)
         return chi2

@@ -173,6 +173,26 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     return xvar_t, np.concatenate([x_t, x_u])
 
 
+def _try_schur_path(fitter, M, r, Nvec, phiinv, ntm, norm):
+    """Shared Schur fast-path assembly for GLSFitter and the wideband
+    fitters: returns (dpars, errs, covmat) or None when the Cholesky
+    fails (caller falls back to the dense/SVD path).  The fitter carries
+    the cross-iteration cache."""
+    if not hasattr(fitter, "_gls_cache"):
+        fitter._gls_cache = {}
+    try:
+        xvar_t, xhat = _schur_gls_solve(M, r, Nvec, phiinv, ntm,
+                                        fitter._gls_cache)
+    except np.linalg.LinAlgError:
+        return None
+    dpars = xhat / norm
+    errs = np.concatenate([
+        np.sqrt(np.maximum(np.diag(xvar_t), 0.0)) / norm[:ntm],
+        np.zeros(len(norm) - ntm)])  # noise-column errs are never consumed
+    covmat = (xvar_t / norm[:ntm]).T / norm[:ntm]
+    return dpars, errs, covmat
+
+
 class GLSFitter(Fitter):
     """One-shot GLS fitter (reference ``fitter.py:1939``)."""
 
@@ -204,20 +224,9 @@ class GLSFitter(Fitter):
                 # Schur-complement fast path: the noise block is constant
                 # across a fit's iterations (cached factor); only the
                 # timing system is solved per step
-                try:
-                    if not hasattr(self, "_gls_cache"):
-                        self._gls_cache = {}
-                    xvar_t, xhat = _schur_gls_solve(
-                        M, r, Nvec, phiinv, ntm, self._gls_cache)
-                    dpars = xhat / norm
-                    errs = np.concatenate([
-                        np.sqrt(np.maximum(np.diag(xvar_t), 0.0))
-                        / norm[:ntm],
-                        np.zeros(len(norm) - ntm)])  # noise-col errs unused
-                    covmat = (xvar_t / norm[:ntm]).T / norm[:ntm]
-                    return dpars, errs, covmat, params
-                except np.linalg.LinAlgError:
-                    pass  # dense SVD fallback below
+                out = _try_schur_path(self, M, r, Nvec, phiinv, ntm, norm)
+                if out is not None:
+                    return (*out, params)
             mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         if threshold <= 0:
             try:
@@ -267,7 +276,7 @@ class GLSFitter(Fitter):
                 self._store_noise_ampls(dpars, len(params))
         chi2 = self.resids.calc_chi2()
         self.converged = True
-        self.model.CHI2.value = chi2
+        self.update_model(chi2)
         return chi2
 
 

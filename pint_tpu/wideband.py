@@ -105,7 +105,10 @@ class WidebandDMResiduals:
     def rms_weighted(self) -> float:
         err = self.get_data_error()
         if np.any(err == 0):
-            raise ValueError("Zero DM errors: cannot compute weighted RMS")
+            # same fallback as the narrowband Residuals: a zero DM error
+            # already poisons chi2 (inf); the RMS must not crash post-fit
+            # bookkeeping (update_model)
+            return float(np.sqrt(np.mean(self.resids**2)))
         w = 1.0 / err**2
         mean, _ = weighted_mean(self.resids, w)
         return float(np.sqrt(np.sum(w * (self.resids - float(mean)) ** 2) / np.sum(w)))
@@ -254,6 +257,16 @@ class WidebandTOAFitter(Fitter):
             M, params, norm, phiinv, Nvec, dims = build_augmented_system(
                 self.model, self.toas, wideband=True)
             self._noise_dims = dims
+            ntm = len(params)
+            if threshold <= 0 and M.shape[1] > ntm:
+                # Schur fast path, shared with GLSFitter._gls_step: the
+                # noise block of the stacked system is constant across a fit
+                from pint_tpu.gls_fitter import _try_schur_path
+
+                out = _try_schur_path(self, M, np.asarray(r), Nvec, phiinv,
+                                      ntm, norm)
+                if out is not None:
+                    return (*out, params)
             mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         if threshold <= 0:
             try:
@@ -299,7 +312,7 @@ class WidebandTOAFitter(Fitter):
                 self._store_noise_ampls(dpars, len(params))
         chi2 = self.resids.calc_chi2()
         self.converged = True
-        self.model.CHI2.value = chi2
+        self.update_model(chi2)
         return chi2
 
 

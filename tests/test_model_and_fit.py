@@ -96,6 +96,27 @@ class TestIO:
         dt = (t2.utc_mjd - fake_toas.utc_mjd) * np.longdouble(86400)
         assert float(np.max(np.abs(dt))) < 1e-9
 
+    def test_update_model_stamps_fit_products(self, model, fake_toas):
+        """fit_toas stamps START/FINISH/NTOA/CHI2/CHI2R/TRES into the model
+        (reference fitter.py:470 update_model)."""
+        import copy
+
+        from pint_tpu.fitter import DownhillWLSFitter
+
+        f = DownhillWLSFitter(fake_toas, copy.deepcopy(model))
+        chi2 = f.fit_toas()
+        m = f.model
+        mjds = np.asarray(fake_toas.get_mjds(), dtype=float)
+        assert m.START.value == pytest.approx(float(mjds.min()))
+        assert m.FINISH.value == pytest.approx(float(mjds.max()))
+        assert m.NTOA.value == len(fake_toas)
+        assert m.CHI2.value == pytest.approx(chi2)
+        assert m.CHI2R.value == pytest.approx(chi2 / f.resids.dof)
+        assert m.TRES.value == pytest.approx(f.resids.rms_weighted() * 1e6)
+        # and they survive the par round trip
+        text = m.as_parfile()
+        assert "CHI2R" in text and "TRES" in text and "NTOA" in text
+
     def test_par_roundtrip(self, model):
         from pint_tpu.models import get_model
 
