@@ -124,7 +124,14 @@ class Polycos:
         tmids = []
         for s in range(nseg):
             t0 = mjdStart + s * span_d
-            tmid = t0 + span_d / 2
+            # quantize tmid to the TEMPO text format's %.11f precision UP
+            # FRONT so the coefficients are fit against the exact value the
+            # file will carry — otherwise the write/read round trip shifts
+            # the evaluation epoch by up to 0.5e-11 d (~0.4 us) and the
+            # prediction degrades by f0*dt (~3e-5 cycles at 60 Hz).
+            # (find_entry's EDGE_TOL absorbs the ~1e-11 d coverage shifts
+            # the rounding introduces at segment boundaries.)
+            tmid = round(t0 + span_d / 2, 11)
             tmids.append(tmid)
             all_mjds.append(tmid + cheb * span_d / 2)
         mjds = np.concatenate(all_mjds)
@@ -177,10 +184,23 @@ class Polycos:
         return cls(entries)
 
     # -- dispatch ------------------------------------------------------------
+    #: boundary tolerance [days]: segment edges derive from tmid values
+    #: quantized to the file format's 1e-11-day precision, which can open
+    #: ~1e-11-day gaps at the span boundaries; the polynomial is perfectly
+    #: valid that far outside its nominal window
+    EDGE_TOL = 1e-9
+
     def find_entry(self, t_mjd: float) -> PolycoEntry:
         for e in self.entries:
             if e.tstart <= t_mjd < e.tstop:
                 return e
+        best, dist = None, np.inf
+        for e in self.entries:
+            d = max(e.tstart - t_mjd, t_mjd - e.tstop, 0.0)
+            if d < dist:
+                best, dist = e, d
+        if best is not None and dist <= self.EDGE_TOL:
+            return best
         raise ValueError(f"No polyco entry covers MJD {t_mjd}")
 
     def eval_abs_phase(self, t_mjd) -> Phase:

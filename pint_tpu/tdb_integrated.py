@@ -64,11 +64,28 @@ def _rate(eph, mjd: np.ndarray) -> np.ndarray:
 
 
 class IntegratedTDB:
-    """Windowed cumulative integral of the TDB-TT rate for one ephemeris."""
+    """Cumulative integral of the TDB-TT rate for one ephemeris.
+
+    DETERMINISM CONTRACT: the value served for a given epoch depends only
+    on (ephemeris, epoch) — never on the process's query history.  The
+    sample grid is aligned to absolute multiples of ``STEP`` from
+    ``ANCHOR_EPOCH``, the window always includes the fixed anchor range,
+    and the offset+rate anchor against the analytic series is fit over
+    that same fixed range — so rebuilding a wider window reproduces every
+    previously served value exactly (same samples, same anchor), and two
+    different processes computing the same epochs agree bit-for-bit.
+    Without this, absolute products (polycos, TZR phases, pulse numbers)
+    written by one process disagree with another at the tens-of-us level.
+    The anchor fixes only the constant and linear pieces, which pulse-
+    phase fitting cannot see (absorbed by the phase offset and F0).
+    """
 
     #: margin around the requested span [days]
     PAD = 40.0
     STEP = 0.125  # days
+    #: fixed anchor range (J2000 + two Julian years): the series datum
+    ANCHOR_EPOCH = 51544.5
+    ANCHOR_SPAN = 730.5
 
     def __init__(self, ephem: Optional[str] = None):
         self.ephem = ephem
@@ -82,6 +99,14 @@ class IntegratedTDB:
         from pint_tpu.timescales import tdb_minus_tt_series
 
         eph = load_ephemeris(self.ephem or "DE440")
+        # the anchor range is a deterministic function of the KERNEL alone:
+        # the fixed J2000 range when covered, else the first ANCHOR_SPAN
+        # days of the kernel's coverage — query history can never influence
+        # the anchor (even for exotic kernels not covering J2000)
+        a_lo, a_hi = self._anchor_range(eph)
+        # the window always covers the anchor range
+        lo = min(lo, a_lo)
+        hi = max(hi, a_hi)
         # never sample outside a kernel's coverage: the padding is a
         # convenience, not worth losing the kernel path at the span edges
         lo, hi = self._clamp(lo, hi)
@@ -91,31 +116,47 @@ class IntegratedTDB:
             raise EphemCoverageError(
                 f"requested TDB-TT window lies outside the kernel coverage "
                 f"of {self.ephem or 'DE440'}")
-        grid = np.arange(lo, hi + self.STEP, self.STEP)
+        # absolute grid alignment: sample points are exact multiples of
+        # STEP from ANCHOR_EPOCH regardless of the window
+        k_lo = int(np.floor((lo - self.ANCHOR_EPOCH) / self.STEP))
+        k_hi = int(np.ceil((hi - self.ANCHOR_EPOCH) / self.STEP))
+        grid = self.ANCHOR_EPOCH + np.arange(k_lo, k_hi + 1) * self.STEP
         rate = _rate(eph, grid)
+        # accumulate OUTWARD from the anchor origin in both directions, so
+        # each P[i] is a fixed partial sum independent of how far the
+        # window happens to extend — bit-exact under any rebuild
+        k0 = int(np.round((a_lo - self.ANCHOR_EPOCH) / self.STEP))
+        i0 = min(max(k0 - k_lo, 0), len(grid) - 1)
+        traps = (rate[1:] + rate[:-1]) * 0.5 * self.STEP * DAY_S
         P = np.zeros(len(grid))
-        P[1:] = np.cumsum((rate[1:] + rate[:-1]) * 0.5 * self.STEP * DAY_S)
-        if self._spline is None:
-            # anchor offset+rate to the analytic series: constant and linear
-            # pieces are unobservable in timing — this only sets the IAU datum
-            d = P - tdb_minus_tt_series(grid)
-            A = np.stack([np.ones_like(grid), grid - grid.mean()], axis=1)
-            c, *_ = np.linalg.lstsq(A, d, rcond=None)
-            P = P - A @ c
-        else:
-            # rebuild for a wider window: align to the EXISTING values over
-            # the old range so results served earlier stay consistent (a
-            # re-anchored offset would act like a spurious inter-site JUMP)
-            old_lo, old_hi = self._range
-            m = (grid >= old_lo) & (grid <= old_hi)
-            d = P[m] - self._spline(grid[m])
-            A = np.stack([np.ones(m.sum()), grid[m] - grid[m].mean()], axis=1)
-            c, *_ = np.linalg.lstsq(A, d, rcond=None)
-            P = P - (c[0] + c[1] * (grid - grid[m].mean()))
+        P[i0 + 1:] = np.cumsum(traps[i0:])
+        if i0 > 0:
+            P[:i0] = -np.cumsum(traps[:i0][::-1])[::-1]
+        # anchor offset+rate to the analytic series over the fixed range
+        m = (grid >= a_lo) & (grid <= a_hi)
+        d = P[m] - tdb_minus_tt_series(grid[m])
+        A = np.stack([np.ones(int(m.sum())), grid[m] - a_lo], axis=1)
+        c, *_ = np.linalg.lstsq(A, d, rcond=None)
+        P = P - (c[0] + c[1] * (grid - a_lo))
         self._spline = CubicSpline(grid, P)
-        self._range = (float(lo), float(hi))
-        log.info(f"Integrated TDB-TT over MJD {lo:.1f}..{hi:.1f} "
+        self._range = (float(grid[0]), float(grid[-1]))
+        log.info(f"Integrated TDB-TT over MJD {grid[0]:.1f}..{grid[-1]:.1f} "
                  f"({len(grid)} samples, ephem={self.ephem or 'DE440'})")
+
+    def _anchor_range(self, eph) -> Tuple[float, float]:
+        """Deterministic per-kernel anchor range, snapped to the absolute
+        STEP grid: J2000+ANCHOR_SPAN when covered, else the first
+        ANCHOR_SPAN days of the kernel coverage."""
+        a_lo, a_hi = self.ANCHOR_EPOCH, self.ANCHOR_EPOCH + self.ANCHOR_SPAN
+        cov = getattr(eph, "coverage_mjd", None)
+        if cov is not None:
+            clo, chi = cov()
+            if a_lo < clo + self.STEP or a_hi > chi - self.STEP:
+                k = int(np.ceil((clo + self.STEP - self.ANCHOR_EPOCH)
+                                / self.STEP))
+                a_lo = self.ANCHOR_EPOCH + k * self.STEP
+                a_hi = min(a_lo + self.ANCHOR_SPAN, chi - self.STEP)
+        return a_lo, a_hi
 
     def __call__(self, tt_mjd) -> np.ndarray:
         from pint_tpu.exceptions import EphemCoverageError
@@ -125,13 +166,14 @@ class IntegratedTDB:
         if self._range is None:
             self._build(lo, hi)
         elif lo < self._range[0] or hi > self._range[1]:
-            # skip the rebuild when the built window is already pinned at the
-            # kernel's coverage edge (rebuilding would re-integrate the whole
-            # grid on every call and change nothing)
+            # skip the rebuild when the built window already covers the
+            # clamped want range (e.g. pinned at a kernel coverage edge
+            # that is not STEP-aligned — rebuilding would re-integrate the
+            # whole grid on every call and change nothing)
             want_lo = min(lo, self._range[0])
             want_hi = max(hi, self._range[1])
             want_lo, want_hi = self._clamp(want_lo, want_hi)
-            if (want_lo, want_hi) != self._range:
+            if want_lo < self._range[0] or want_hi > self._range[1]:
                 self._build(want_lo, want_hi)
         # never silently cubic-extrapolate beyond the integration grid: the
         # requested epochs are outside the kernel's coverage

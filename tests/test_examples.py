@@ -54,3 +54,13 @@ class TestExamples:
         out = _run("photon_events.py", "--quick", capsys=capsys)
         assert "H-test" in out
         assert "F0 recovered" in out
+
+    def test_polycos_walkthrough(self, capsys):
+        out = _run("polycos_prediction.py", capsys=capsys)
+        assert "prediction wobble" in out
+        assert "predicted spin frequency" in out
+
+    def test_simulate_zima_walkthrough(self, capsys):
+        out = _run("simulate_zima.py", capsys=capsys)
+        assert "zima wrote" in out
+        assert "random-model phase spread" in out

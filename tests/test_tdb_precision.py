@@ -213,10 +213,13 @@ class TestIntegratedTDB:
         got = integ(tt)
         d = got - tdb_minus_tt_series(tt)
         assert np.max(np.abs(d)) < 3e-5  # series truncation scale
-        # anchoring removed offset and rate
-        assert abs(np.mean(d)) < 1e-6
+        # the offset+rate anchor is fit at the FIXED J2000 anchor range
+        # (determinism contract); a remote window sees the residual
+        # ephemeris-vs-series rate bias accumulating from there —
+        # unobservable in timing (absorbed by F0), bounded loosely here
+        assert abs(np.mean(d)) < 1e-5
         slope = np.polyfit(tt - tt.mean(), d, 1)[0]
-        assert abs(slope * 2000) < 1e-6  # linear drift across the window
+        assert abs(slope * 2000) < 5e-6  # linear drift across the window
 
     def test_quadrature_converged(self):
         """Halving the integration step changes nothing at the ns level."""
@@ -246,6 +249,37 @@ class TestIntegratedTDB:
         resid = d - np.polyval(np.polyfit(wide - wide.mean(), d, 1),
                                wide - wide.mean())
         assert np.max(np.abs(resid)) < 2e-9  # equal modulo offset+rate
+
+    def test_history_independence_bit_exact(self):
+        """DETERMINISM CONTRACT: the value served for an epoch depends only
+        on (ephemeris, epoch), never on the process's query history.  The
+        fixed absolute anchor range + absolutely-aligned sample grid make
+        extension rebuilds reproduce prior values exactly — without this,
+        polycos/TZR phases written by one process disagreed with another
+        by tens of us (caught by the polyco walkthrough, r4)."""
+        from pint_tpu.tdb_integrated import IntegratedTDB
+
+        t = np.linspace(53800.0, 53801.0, 11)
+        # fresh build straight at the target epochs
+        direct = IntegratedTDB()(t)
+        # build far away first, then extend down to the target epochs
+        b = IntegratedTDB()
+        b(np.linspace(55000.0, 55001.0, 5))
+        via_extension = b(t)
+        np.testing.assert_array_equal(direct, via_extension)
+        # and a third ordering: target first, then far, then target again
+        c = IntegratedTDB()
+        first = c(t)
+        c(np.linspace(55000.0, 55001.0, 5))
+        np.testing.assert_array_equal(c(t), first)
+        # epochs BELOW the J2000 anchor: downward extensions must also
+        # reproduce prior values bit-for-bit (outward accumulation)
+        t_lo = np.linspace(48000.0, 48001.0, 11)
+        d1 = IntegratedTDB()(t_lo)
+        e = IntegratedTDB()
+        e(t_lo)
+        e(np.linspace(45000.0, 45001.0, 5))  # extend further down
+        np.testing.assert_array_equal(e(t_lo), d1)
 
     def test_default_chain_uses_integrator(self):
         from pint_tpu.timescales import tdb_minus_tt, tdb_minus_tt_series
