@@ -124,6 +124,12 @@ MORE_PULSARS = [
      "J1713+0747_NANOGrav_11yv0_short.tim"),
     # ELL1H (orthometric H3 Shapiro) on real data
     ("J1853+1303_NANOGrav_11yv0.gls.par", "J1853+1303_NANOGrav_11yv0.tim"),
+    # DD + solar wind on real data
+    ("J1643-1224_NANOGrav_9yv1.gls.par", "J1643-1224_NANOGrav_9yv1.tim"),
+    # ELL1 narrowband from the 12.5-yr release (modern tim conventions)
+    ("J1909-3744.NB.par", "J1909-3744.NB.tim"),
+    # isolated MSP observed with CHIME (exercises the CHIME site entry)
+    ("B1937+21.basic.par", "B1937+21.CHIME.CHIME.NG.N.tim"),
 ]
 
 
@@ -132,7 +138,7 @@ class TestMorePulsarsSmoke:
     data: parse, evaluate, residual bounds, finite design matrix."""
 
     @pytest.mark.parametrize("par,tim", MORE_PULSARS,
-                             ids=[p.split("_")[0] for p, _ in MORE_PULSARS])
+                             ids=[p.split("_")[0].split(".")[0] for p, _ in MORE_PULSARS])
     def test_pipeline_smoke(self, par, tim):
         from pint_tpu.models import get_model_and_toas
         from pint_tpu.residuals import Residuals
