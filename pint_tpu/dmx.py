@@ -12,7 +12,8 @@ import numpy as np
 
 from pint_tpu.logging import log
 
-__all__ = ["DMXRange", "dmx_ranges", "dmx_ranges_old", "dmx_setup",
+__all__ = [
+    "dmxrange", "DMXRange", "dmx_ranges", "dmx_ranges_old", "dmx_setup",
            "dmxparse", "xxxselections", "dmxselections", "dmxstats",
            "get_prefix_timerange", "get_prefix_timeranges",
            "find_prefix_bytime", "merge_dmx", "split_dmx", "split_swx"]
@@ -32,6 +33,10 @@ class DMXRange:
     def sum_print(self) -> str:
         return (f"DMXR1: {self.min:.4f} DMXR2: {self.max:.4f} "
                 f"{len(self.los)} low-freq TOAs, {len(self.his)} high-freq TOAs")
+
+
+#: reference-spelled alias (``utils.py:582 dmxrange``)
+dmxrange = DMXRange
 
 
 def _ranges_to_component(ranges: List["DMXRange"], mjds: np.ndarray,

@@ -11,7 +11,8 @@ from __future__ import annotations
 import numpy as np
 from scipy.stats import chi2, norm
 
-__all__ = ["z2m", "z2mw", "sf_z2m", "cosm", "best_m", "em_four", "em_lc",
+__all__ = ["vec", "to_array", "from_array",
+           "z2m", "z2mw", "sf_z2m", "cosm", "best_m", "em_four", "em_lc",
            "hm", "hmw", "sf_hm", "sf_h20_dj1989", "sf_h20_dj2010",
            "sig2h20", "sigma_trials", "h2sig", "sig2sigma", "sigma2sig",
            "sf_stackedh"]
@@ -170,3 +171,22 @@ def sigma_trials(sigma: float, trials: float) -> float:
         return float((sigma**2 - 2 * np.log(trials)) ** 0.5)
     p = sigma2sig(sigma) * trials
     return 0.0 if p >= 1 else sig2sigma(p)
+
+
+def vec(func):
+    """Vectorize a scalar statistic, preserving its docstring (reference
+    ``eventstats.py:35``)."""
+    return np.vectorize(func, doc=func.__doc__)
+
+
+def to_array(x, dtype=None):
+    """Promote a scalar to a 1-element array; pass arrays through
+    (reference ``eventstats.py:41``)."""
+    x = np.asarray(x, dtype=dtype)
+    return np.asarray([x]) if x.ndim == 0 else x
+
+
+def from_array(x):
+    """Inverse of :func:`to_array`: unwrap 1-element arrays (reference
+    ``eventstats.py:46``)."""
+    return x[0] if (x.ndim == 1) and (x.shape[0] == 1) else x

@@ -27,7 +27,7 @@ from pint_tpu.sampler import EnsembleSampler, MCMCSampler
 
 __all__ = ["MCMCFitter", "MCMCFitterBinnedTemplate",
            "MCMCFitterAnalyticTemplate", "set_priors_basic",
-           "lnprior_basic", "lnlikelihood_chi2", "concat_toas"]
+           "lnprior_basic", "lnlikelihood_basic", "lnlikelihood_chi2", "concat_toas"]
 
 
 def __getattr__(name):
@@ -215,3 +215,23 @@ class MCMCFitter(Fitter):
             lines.append(f"{p:<12} {med[i]:>20.12g} {std[i]:>12.3g} "
                          f"{self.maxpost_fitvals[i]:>20.12g}")
         return "\n".join(lines)
+
+
+def lnlikelihood_basic(ftr, theta):
+    """Photon-template log-likelihood at ``theta`` (reference
+    ``mcmc_fitter.py:59``): template density at the wrapped event phases,
+    weight-mixed when photon weights are present.  Densities are clamped
+    at 1e-300 exactly like the fitter's own batched posterior
+    (``event_fitter.py _build_batch``), so this helper decomposes it."""
+    if not hasattr(ftr, "_template_density"):
+        raise TypeError(
+            f"{type(ftr).__name__} has no photon template; "
+            "lnlikelihood_basic is for the template MCMC fitters "
+            "(use lnlikelihood_chi2 for residual fitters)")
+    for p, v in zip(ftr.fitkeys, np.atleast_1d(np.asarray(theta, float))):
+        getattr(ftr.model, p).value = float(v)
+    ph = np.asarray(ftr.model.phase(ftr.toas).frac) % 1.0
+    probs = np.maximum(np.asarray(ftr._template_density(ph)), 1e-300)
+    if getattr(ftr, "weights", None) is None:
+        return float(np.sum(np.log(probs)))
+    return float(np.sum(np.log(ftr.weights * probs + 1.0 - ftr.weights)))

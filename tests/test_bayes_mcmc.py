@@ -329,6 +329,50 @@ class TestMCMCModuleSurface:
             from pint_tpu import mcmc_fitter
             mcmc_fitter.no_such_thing
 
+    def test_surface_long_tail_helpers(self):
+        """Reference-spelled helpers: eventstats vec/to_array/from_array,
+        dmx.dmxrange alias, mcmc_fitter.lnlikelihood_basic."""
+        from pint_tpu.dmx import DMXRange, dmxrange
+        from pint_tpu.eventstats import from_array, to_array, vec
+        from pint_tpu.mcmc_fitter import lnlikelihood_basic
+
+        assert dmxrange is DMXRange
+        r = dmxrange([55000.0, 55001.0], [55000.5])
+        assert r.min < 55000.0 < 55001.0 < r.max
+        a = to_array(3.0)
+        assert a.shape == (1,) and from_array(a) == 3.0
+        sq = vec(lambda x: x * x)
+        np.testing.assert_array_equal(sq([1.0, 2.0]), [1.0, 4.0])
+
+        # lnlikelihood_basic against the photon fitter's own posterior math
+        from pint_tpu.event_fitter import MCMCFitterBinnedTemplate
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.templates.lcprimitives import LCGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        par = ["PSR Q\n", "RAJ 03:00:00\n", "DECJ 3:00:00\n", "F0 99.0 1\n",
+               "PEPOCH 55100\n", "DM 10\n", "UNITS TDB\n"]
+        m = get_model(par)
+        t = make_fake_toas_uniform(55090, 55110, 60, m, error_us=1.0,
+                                   obs="barycenter", freq=np.inf,
+                                   rng=np.random.default_rng(3))
+        tpl = LCTemplate([LCGaussian([0.05, 0.5])], [0.5])
+        f = MCMCFitterBinnedTemplate(t, m, tpl, nwalkers=16)
+        theta = np.array([float(m.F0.value)])
+        lnl = lnlikelihood_basic(f, theta)
+        assert np.isfinite(lnl)
+        # with no prior_info the priors contribute 0: the fitter's
+        # posterior must equal this likelihood (decomposition check)
+        lnp = f.lnposterior(theta)
+        assert np.isclose(lnp, lnl, rtol=1e-9), (lnp, lnl)
+        # wrong fitter class: clear TypeError, model untouched
+        from pint_tpu.fitter import WLSFitter
+
+        wf = WLSFitter(t, __import__("copy").deepcopy(m))
+        with pytest.raises(TypeError, match="template"):
+            lnlikelihood_basic(wf, theta)
+
     def test_priors_and_likelihood_helpers(self):
         from pint_tpu.mcmc_fitter import (MCMCFitter, lnlikelihood_chi2,
                                           lnprior_basic, set_priors_basic)
