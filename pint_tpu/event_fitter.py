@@ -86,6 +86,7 @@ class _PhotonMCMCFitter(Fitter):
         self.maxpost = -np.inf
         self.maxpost_fitvals = None
         self._batch_fn = None
+        self._batch_fn_jit = None
 
     # -- template density in-trace (subclasses provide) ----------------------
     def _template_density(self, phifrac):
@@ -128,6 +129,19 @@ class _PhotonMCMCFitter(Fitter):
         return jax.vmap(lnpost_one)
 
     def lnposterior_batch(self, pts):
+        import jax
+
+        if isinstance(pts, jax.Array):
+            # mesh path: the sampler placed the walker axis over devices
+            # (NamedSharding); np.asarray here would gather it straight
+            # back to host and silently serialize the whole batch on one
+            # device.  jit propagates the input sharding through the
+            # vmapped graph (SPMD), which is the entire point — at the
+            # documented ~1e-7-cycle fused-jit dd relaxation (measured 0
+            # on CPU, tests/test_fused_relaxation.py)
+            if self._batch_fn_jit is None:
+                self._batch_fn_jit = jax.jit(self._build_batch())
+            return np.asarray(self._batch_fn_jit(pts))
         if self._batch_fn is None:
             self._batch_fn = self._build_batch()
         return np.asarray(self._batch_fn(np.atleast_2d(
@@ -235,6 +249,7 @@ class MCMCFitterBinnedTemplate(_PhotonMCMCFitter):
             tb = np.asarray(template, dtype=np.float64)
             self.template_bins = tb / tb.mean()
         self._batch_fn = None
+        self._batch_fn_jit = None
 
     def _template_density(self, phifrac):
         import jax.numpy as jnp

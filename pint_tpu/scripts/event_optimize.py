@@ -71,6 +71,10 @@ def main(argv: Optional[list] = None):
                          "instead of a fixed chain length")
     ap.add_argument("--no-fitstart", dest="fitstart", action="store_false",
                     help="skip the FFTFIT template start-phase alignment")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="shard the walker axis over N devices (the TPU "
+                         "replacement for the reference's --multicore / "
+                         "--ncores process pool; 0 = single device)")
     args = ap.parse_args(argv)
 
     from pint_tpu.event_fitter import MCMCFitterBinnedTemplate
@@ -95,11 +99,30 @@ def main(argv: Optional[list] = None):
         if p.uncertainty:
             prior_info[k] = {"distr": "normal", "mu": float(p.value),
                              "sigma": args.priorerrfact * float(p.uncertainty)}
+    sampler = None
+    if args.mesh < 0:
+        raise SystemExit(f"--mesh must be a positive device count, got {args.mesh}")
+    if args.mesh:
+        import jax
+        from jax.sharding import Mesh
+
+        from pint_tpu.sampler import EnsembleSampler
+
+        devs = jax.devices()
+        if len(devs) < args.mesh:
+            raise SystemExit(
+                f"--mesh {args.mesh} requested but only {len(devs)} devices "
+                "are available (set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=N for "
+                "virtual CPU devices)")
+        sampler = EnsembleSampler(
+            args.nwalkers, seed=args.seed, backend=args.backend,
+            mesh=Mesh(np.array(devs[:args.mesh]), ("walkers",)))
     f = MCMCFitterBinnedTemplate(
         ts, model, template, nbins=args.nbins, nwalkers=args.nwalkers,
         prior_info=prior_info or None, errfact=args.errfact,
         minMJD=args.minMJD, maxMJD=args.maxMJD, backend=args.backend,
-        seed=args.seed)
+        sampler=sampler, seed=args.seed)
     if args.fitstart and not args.resume:
         # FFTFIT start phase: align the template with the folded profile
         # (replaces the reference's PRESTO fftfit import,
