@@ -197,8 +197,12 @@ class BayesianTiming:
             # input sharding (SPMD) — the documented ~1e-7-cycle fused-jit
             # dd relaxation applies (measured 0 on CPU,
             # tests/test_fused_relaxation.py)
+            if self._batch_fn is None:
+                self._batch_fn = self._build_batch_fn()
             if self._batch_fn_jit is None:
-                self._batch_fn_jit = jax.jit(self._build_batch_fn())
+                # jit the SAME built graph the host path uses (one source
+                # of truth; event_fitter.lnposterior_batch mirrors this)
+                self._batch_fn_jit = jax.jit(self._batch_fn)
             return np.asarray(self._batch_fn_jit(points))
         points = np.atleast_2d(np.asarray(points, dtype=np.float64))
         if self._batch_fn is None:

@@ -139,8 +139,12 @@ class _PhotonMCMCFitter(Fitter):
             # vmapped graph (SPMD), which is the entire point — at the
             # documented ~1e-7-cycle fused-jit dd relaxation (measured 0
             # on CPU, tests/test_fused_relaxation.py)
+            if self._batch_fn is None:
+                self._batch_fn = self._build_batch()
             if self._batch_fn_jit is None:
-                self._batch_fn_jit = jax.jit(self._build_batch())
+                # jit the SAME built graph the host path uses (one source
+                # of truth; bayesian.lnposterior_batch mirrors this)
+                self._batch_fn_jit = jax.jit(self._batch_fn)
             return np.asarray(self._batch_fn_jit(pts))
         if self._batch_fn is None:
             self._batch_fn = self._build_batch()

@@ -76,6 +76,9 @@ def main(argv: Optional[list] = None):
                          "replacement for the reference's --multicore / "
                          "--ncores process pool; 0 = single device)")
     args = ap.parse_args(argv)
+    if args.mesh < 0:
+        raise SystemExit(
+            f"--mesh must be a non-negative device count, got {args.mesh}")
 
     from pint_tpu.event_fitter import MCMCFitterBinnedTemplate
     from pint_tpu.models import get_model
@@ -100,8 +103,6 @@ def main(argv: Optional[list] = None):
             prior_info[k] = {"distr": "normal", "mu": float(p.value),
                              "sigma": args.priorerrfact * float(p.uncertainty)}
     sampler = None
-    if args.mesh < 0:
-        raise SystemExit(f"--mesh must be a positive device count, got {args.mesh}")
     if args.mesh:
         import jax
         from jax.sharding import Mesh
