@@ -64,3 +64,9 @@ class TestExamples:
         out = _run("simulate_zima.py", capsys=capsys)
         assert "zima wrote" in out
         assert "random-model phase spread" in out
+
+    def test_wideband_walkthrough(self, capsys):
+        out = _run("wideband_fit.py", "--quick", capsys=capsys)
+        assert "stacked fit" in out
+        assert "ML DM-noise fit" in out
+        assert "done" in out
