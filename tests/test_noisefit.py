@@ -103,6 +103,51 @@ class TestLnlikeExactness:
         assert free_noise_params(m2) == []
 
 
+class TestLnlikePropertySweep:
+    """Hypothesis sweep: for RANDOM noise parameter values the jitted
+    likelihood must track ``Residuals.lnlikelihood`` evaluated on a model
+    carrying those same values — the traced weight/variance builders are
+    exact reparameterizations, not approximations."""
+
+    @pytest.fixture(scope="class")
+    def setup(self):
+        m = _model_with_lines(["EFAC mjd 52000 53900 1.2 1",
+                               "EQUAD mjd 53900 60000 2.0 1",
+                               "ECORR mjd 52000 60000 1.5 1",
+                               "TNREDAMP -12.8 1", "TNREDGAM 3.0 1",
+                               "TNREDC 4"])
+        t = _sim(m, _clustered_mjds(20, 3), seed=21, corr=True)
+        from pint_tpu.noisefit import build_noise_lnlikelihood
+        from pint_tpu.residuals import Residuals
+
+        r = np.asarray(Residuals(t, m).time_resids)
+        lnl, x0, names = build_noise_lnlikelihood(m, t)
+        return m, t, r, lnl, names
+
+    def test_random_values_match_residuals(self, setup):
+        from hypothesis import given, settings, strategies as st
+
+        m, t, r, lnl, names = setup
+        from pint_tpu.residuals import Residuals
+
+        @settings(max_examples=25, deadline=None)
+        @given(efac=st.floats(0.3, 3.0), equad=st.floats(0.1, 10.0),
+               ecorr=st.floats(0.1, 8.0), amp=st.floats(-14.5, -11.5),
+               gam=st.floats(0.5, 6.0))
+        def sweep(efac, equad, ecorr, amp, gam):
+            vals = {"EFAC1": efac, "EQUAD1": equad, "ECORR1": ecorr,
+                    "TNREDAMP": amp, "TNREDGAM": gam}
+            x = np.array([vals[n] for n in names])
+            got = float(lnl(x, r))
+            m2 = copy.deepcopy(m)
+            for n, v in vals.items():
+                getattr(m2, n).value = v
+            want = Residuals(t, m2).lnlikelihood()
+            assert got == pytest.approx(want, rel=1e-9, abs=1e-6), vals
+
+        sweep()
+
+
 class TestRecovery:
     def test_efac_equad_recovery(self):
         from pint_tpu.noisefit import fit_noise_ml
