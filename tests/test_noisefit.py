@@ -31,11 +31,11 @@ def _clustered_mjds(nepoch=60, perepoch=4, start=53005.0, end=54795.0):
     return (epochs[:, None] + np.arange(perepoch)[None, :] * 0.4 / 86400.0).ravel()
 
 
-def _sim(model, mjds, error_us=2.0, seed=1, corr=False):
+def _sim(model, mjds, error_us=2.0, seed=1, corr=False, freq=1400.0):
     from pint_tpu.simulation import make_fake_toas_fromMJDs
 
     return make_fake_toas_fromMJDs(
-        np.asarray(mjds), model, error_us=error_us, add_noise=True,
+        np.asarray(mjds), model, freq=freq, error_us=error_us, add_noise=True,
         add_correlated_noise=corr, rng=np.random.default_rng(seed))
 
 
@@ -200,6 +200,30 @@ class TestRecovery:
         # one GP realization constrains log10-amplitude to a few tenths
         assert abs(vals["TNREDAMP"] - (-12.3)) < 3 * max(errs["TNREDAMP"], 0.1)
         assert abs(vals["TNREDGAM"] - 3.5) < 3 * max(errs["TNREDGAM"], 0.5)
+        assert res.lnlike > float(Residuals(t, start).lnlikelihood())
+
+
+class TestChromaticPLNoiseFit:
+    def test_pldm_amplitude_recovery(self):
+        """The chromatic PL classes ride the same traced weight builder:
+        a free TNDMAMP (DM-noise amplitude, 1/f^2-scaled Fourier basis)
+        is recovered from two-band data."""
+        from pint_tpu.noisefit import fit_noise_ml
+        from pint_tpu.residuals import Residuals
+
+        truth = _model_with_lines(["TNDMAMP -12.4 1", "TNDMGAM 3.0",
+                                   "TNDMC 8"])
+        mjds = np.repeat(np.linspace(53005, 54795, 150), 2)
+        freqs = np.tile([430.0, 1400.0], 150)
+        t = _sim(truth, mjds, error_us=1.0, seed=14, corr=True, freq=freqs)
+        start = _model_with_lines(["TNDMAMP -13.2 1", "TNDMGAM 3.0",
+                                   "TNDMC 8"])
+        r = np.asarray(Residuals(t, start).time_resids)
+        res = fit_noise_ml(start, t, r, uncertainty=True)
+        vals = dict(zip(res.names, res.values))
+        errs = dict(zip(res.names, res.errors))
+        assert set(vals) == {"TNDMAMP"}
+        assert abs(vals["TNDMAMP"] - (-12.4)) < 3 * max(errs["TNDMAMP"], 0.15)
         assert res.lnlike > float(Residuals(t, start).lnlikelihood())
 
 
