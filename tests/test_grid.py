@@ -198,10 +198,15 @@ class TestGLSGrid:
         dF0 = 3 * f.errors.get("F0", 1e-10)
         g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 4)
         g1 = np.linspace(f.model.F1.value - 1e-16, f.model.F1.value + 1e-16, 4)
-        chi2_plain, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        chi2_plain, ex_plain = grid_chisq(f, ("F0", "F1"), (g0, g1),
+                                          extraparnames=("DM",))
         mesh = Mesh(np.array(eight_devices), ("grid",))
-        chi2_mesh, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), mesh=mesh)
+        chi2_mesh, ex_mesh = grid_chisq(f, ("F0", "F1"), (g0, g1),
+                                        extraparnames=("DM",), mesh=mesh)
         assert np.allclose(chi2_mesh, chi2_plain, rtol=1e-10, atol=1e-8)
+        # per-point refit extras survive the sharded chunked path too
+        assert ex_mesh["DM"].shape == chi2_mesh.shape
+        assert np.allclose(ex_mesh["DM"], ex_plain["DM"], rtol=1e-10)
 
 
 class TestLinearColumnClassification:
