@@ -36,6 +36,11 @@ __all__ = [
     "rednoise_freqs",
     "ecorr_epochs",
     "ecorr_quantization_matrix",
+    "create_ecorr_quantization_matrix",
+    "create_fourier_design_matrix",
+    "get_ecorr_epochs",
+    "get_ecorr_nweights",
+    "get_rednoise_freqs",
 ]
 
 DAY_S = 86400.0
@@ -484,3 +489,17 @@ class PLSWNoise(_PLNoiseBase):
             sw.solar_wind_geometry(model._const_pv(), toas.to_batch()))
         freq = _bary_freq_mhz(model, toas)
         return geometry * DMconst / freq**2
+
+
+# -- reference-spelled aliases (``noise_model.py:1180-1345``) -------------
+create_ecorr_quantization_matrix = ecorr_quantization_matrix
+create_fourier_design_matrix = fourier_design_matrix
+get_ecorr_epochs = ecorr_epochs
+get_rednoise_freqs = rednoise_freqs
+
+
+def get_ecorr_nweights(t_s, dt: float = 1.0, nmin: int = 2) -> int:
+    """Number of ECORR epochs the quantization basis will carry (reference
+    ``noise_model.py get_ecorr_nweights``)."""
+    return len(ecorr_epochs(np.asarray(t_s, dtype=np.float64), dt=dt,
+                            nmin=nmin))

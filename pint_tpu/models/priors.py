@@ -18,6 +18,8 @@ __all__ = [
     "UniformUnboundedRV",
     "UniformBoundedRV",
     "GaussianBoundedRV",
+    "GaussianRV_gen",
+    "RandomInclinationPrior",
 ]
 
 
@@ -99,3 +101,37 @@ class Prior:
 
     def __repr__(self):
         return f"Prior({self._rv!r})"
+
+
+#: reference-spelled alias (``priors.py:119 GaussianRV_gen``)
+GaussianRV_gen = GaussianBoundedRV
+
+
+class RandomInclinationPrior:
+    """pdf of sin(i) under an isotropic (uniform-in-cos-i) inclination
+    prior: p(x) = x / sqrt(1 - x^2) on [0, 1) (reference ``priors.py:73``).
+    Wrap in :class:`Prior` and attach to SINI."""
+
+    a, b = 0.0, 1.0
+
+    def pdf(self, v):
+        v = np.asarray(v, dtype=np.float64)
+        ok = (v >= 0) & (v < 1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.where(ok, v / np.sqrt(1.0 - np.where(ok, v, 0.0) ** 2),
+                            0.0)
+
+    def logpdf(self, v):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return np.log(self.pdf(v))
+
+    def ppf(self, q):
+        # CDF = 1 - sqrt(1 - v^2)  =>  v = sqrt(1 - (1-q)^2)
+        q = np.asarray(q, dtype=np.float64)
+        return np.sqrt(1.0 - (1.0 - q) ** 2)
+
+    def rvs(self, size=None, random_state=None):
+        if isinstance(random_state, np.random.RandomState):
+            # legacy-RandomState parity with the scipy-frozen priors
+            return self.ppf(random_state.random_sample(size))
+        return self.ppf(np.random.default_rng(random_state).random(size))

@@ -65,6 +65,21 @@ class TestPriors:
         assert g.jax_spec() is None  # truncnorm: host path
         assert g.pdf(0.0) > g.pdf(1.9)
 
+    def test_random_inclination_prior(self):
+        """Isotropic-inclination prior on sin(i) (reference priors.py:73):
+        pdf x/sqrt(1-x^2), exact ppf inverse, draws with mean pi/4."""
+        from pint_tpu.models.priors import (GaussianBoundedRV, GaussianRV_gen,
+                                            Prior, RandomInclinationPrior)
+
+        assert GaussianRV_gen is GaussianBoundedRV
+        p = Prior(RandomInclinationPrior())
+        assert not p.is_unbounded
+        assert p.jax_spec() is None  # host path
+        assert p.pdf(0.9) == pytest.approx(0.9 / np.sqrt(1 - 0.81))
+        assert p.ppf(0.5) == pytest.approx(np.sqrt(0.75))
+        x = p.rvs(size=20000, random_state=2)
+        assert np.mean(x) == pytest.approx(np.pi / 4, abs=5e-3)
+
     def test_unbounded_rejected(self, data):
         from pint_tpu.bayesian import BayesianTiming
 
