@@ -171,10 +171,15 @@ class Fitter:
     def get_summary(self, nodmx: bool = True) -> str:
         """Human-readable fit report (reference ``fitter.py:295,442``)."""
         r = self.resids
+
+        def _toa_rms(resids):
+            rms = resids.rms_weighted()
+            return rms["toa"] if isinstance(rms, dict) else rms  # wideband
+
         lines = [
             f"Fitted model using {self.method} with {len(self.model.free_params)} free parameters to {len(self.toas)} TOAs",
-            f"Prefit residuals Wrms = {self.resids_init.rms_weighted() * 1e6:.4f} us, "
-            f"Postfit residuals Wrms = {r.rms_weighted() * 1e6:.4f} us",
+            f"Prefit residuals Wrms = {_toa_rms(self.resids_init) * 1e6:.4f} us, "
+            f"Postfit residuals Wrms = {_toa_rms(r) * 1e6:.4f} us",
             f"Chisq = {r.chi2:.3f} for {r.dof} d.o.f. for reduced Chisq of {r.reduced_chi2:.3f}",
             "",
             f"{'PAR':<12} {'Prefit':>20} {'Postfit':>20} {'Uncertainty':>14} {'Units':>10}",
@@ -190,7 +195,17 @@ class Fitter:
                 f"{(f'{unc:.3g}' if unc is not None else '-'):>14} "
                 f"{getattr(self.model, p).units:>10}"
             )
-        return "\n".join(lines)
+        return "\n".join(lines) + "\n\n" + self.get_derived_params()
+
+    def get_derived_params(self, returndict: bool = False):
+        """Derived quantities from the fitted model, feeding the post-fit
+        residual rms into the ELL1 validity check (reference
+        ``fitter.py:414``)."""
+        rms = self.resids.rms_weighted()
+        if isinstance(rms, dict):  # wideband: use the TOA-residual rms
+            rms = rms["toa"]
+        return self.model.get_derived_params(
+            rms=rms * 1e6, ntoas=len(self.toas), returndict=returndict)
 
     def fit_toas(self, maxiter: int = 1, **kw) -> float:
         raise NotImplementedError

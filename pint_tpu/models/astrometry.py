@@ -115,6 +115,21 @@ class AstrometryEquatorial(Astrometry):
     def coords_as_ICRS(self):
         return float(self.RAJ.value), float(self.DECJ.value)
 
+    def change_posepoch(self, new_epoch):
+        """Move POSEPOCH, advancing RAJ/DECJ along the same proper-motion
+        linearization the delay model evaluates (reference
+        ``astrometry.py:629``)."""
+        if self.POSEPOCH.value is None:
+            raise ValueError("POSEPOCH is not currently set")
+        dt_day = float(np.longdouble(new_epoch)
+                       - np.longdouble(self.POSEPOCH.value))
+        dec0 = float(self.DECJ.value)
+        self.DECJ.value = dec0 + float(self.PMDEC.value or 0.0) \
+            * _MASYR_TO_RADDAY * dt_day
+        self.RAJ.value = float(self.RAJ.value) + float(self.PMRA.value or 0.0) \
+            * _MASYR_TO_RADDAY * dt_day / np.cos(dec0)
+        self.POSEPOCH.value = np.longdouble(new_epoch)
+
     def sun_angle(self, pv, batch):
         """Pulsar-Sun elongation angle at each TOA (rad)."""
         L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
@@ -180,6 +195,21 @@ class AstrometryEcliptic(Astrometry):
         y = _COS_OBL * y_e - _SIN_OBL * z_e
         z = _SIN_OBL * y_e + _COS_OBL * z_e
         return jnp.stack([x_e, y, z], axis=-1)
+
+    def change_posepoch(self, new_epoch):
+        """Move POSEPOCH, advancing ELONG/ELAT along the proper-motion
+        linearization (reference ``astrometry.py:1181``)."""
+        if self.POSEPOCH.value is None:
+            raise ValueError("POSEPOCH is not currently set")
+        dt_day = float(np.longdouble(new_epoch)
+                       - np.longdouble(self.POSEPOCH.value))
+        lat0 = float(self.ELAT.value)
+        self.ELAT.value = lat0 + float(self.PMELAT.value or 0.0) \
+            * _MASYR_TO_RADDAY * dt_day
+        self.ELONG.value = float(self.ELONG.value) \
+            + float(self.PMELONG.value or 0.0) * _MASYR_TO_RADDAY * dt_day \
+            / np.cos(lat0)
+        self.POSEPOCH.value = np.longdouble(new_epoch)
 
     def coords_as_ICRS(self):
         v = np.asarray(self.ssb_to_psb_xyz(

@@ -24,6 +24,7 @@ import numpy as np
 
 from pint_tpu.dd import dd_mul, dd_sub
 from pint_tpu.exceptions import MissingParameter, TimingModelError
+from pint_tpu.logging import log
 from pint_tpu.models.binary import engines as eng
 from pint_tpu.models.parameter import (
     MJDParameter,
@@ -202,6 +203,61 @@ class PulsarBinary(DelayComponent):
 
     def delay_func(self, pv, batch, ctx, acc_delay):
         return self.binary_delay(pv, self._tt0(pv, batch, acc_delay))
+
+    #: (parameter, rate parameter, rate time unit) rows applied when the
+    #: epoch moves by an integer number of orbits; TASC models override.
+    _secular_rows = (("ECC", "EDOT", "s"), ("OM", "OMDOT", "yr"),
+                     ("A1", "A1DOT", "s"))
+
+    def change_binary_epoch(self, new_epoch):
+        """Move the binary epoch (T0 or TASC) to the orbit boundary closest
+        to ``new_epoch`` [MJD TDB], advancing PB (or the FB ladder) along
+        PBDOT and the secular parameters (ECC/OM/A1, or EPS1/EPS2/A1 for
+        TASC models) along their rates (reference ``pulsar_binary.py:598``,
+        ``binary_ell1.py:228``).  FB2+ are ignored in choosing the integer
+        orbit count, as in the reference."""
+        from pint_tpu.utils import taylor_horner_deriv
+
+        ep = self._params_dict[self.epoch_param]
+        uses_fb = self._nfb > 0
+        if not uses_fb:
+            pb_d = float(self.PB.value)
+            pbdot = float(self.PBDOT.value or 0.0)
+        else:
+            fb0 = float(self.FB0.value)
+            fb1 = float(getattr(self, "FB1").value or 0.0) \
+                if "FB1" in self._params_dict else 0.0
+            pb_d = 1.0 / fb0 / DAY_S
+            pbdot = -fb1 / fb0**2
+        dt_d = float(np.longdouble(new_epoch) - np.longdouble(ep.value))
+        d_orbits = dt_d / pb_d - pbdot * dt_d**2 / (2.0 * pb_d**2)
+        n_orbits = float(np.round(d_orbits))
+        if n_orbits == 0:
+            return
+        # epoch shift for exactly n integer orbits, to first order in PBDOT
+        dt_io_d = pb_d * n_orbits + pb_d * pbdot * n_orbits**2 / 2.0
+        ep.value = np.longdouble(ep.value) + np.longdouble(dt_io_d)
+        if uses_fb and self._nfb > 2 \
+                and getattr(self, "FB2").value is not None:
+            log.warning("Ignoring orbital frequency derivatives higher than "
+                        "FB1 in computing the new epoch; a model fit should "
+                        "resolve this")
+        if not uses_fb:
+            self.PB.value = pb_d + pbdot * dt_io_d
+        else:
+            fbterms = [0.0] + [float(self._params_dict[f"FB{i}"].value or 0.0)
+                               for i in range(self._nfb)]
+            dt_io_s = dt_io_d * DAY_S
+            for n in range(self._nfb):
+                self._params_dict[f"FB{n}"].value = float(
+                    taylor_horner_deriv(dt_io_s, fbterms, deriv_order=n + 1))
+        for name, rate, unit in self._secular_rows:
+            r = self._params_dict.get(rate)
+            if r is None or r.value is None:
+                continue
+            dt_u = dt_io_d * DAY_S if unit == "s" else dt_io_d / 365.25
+            p = self._params_dict[name]
+            p.value = float(p.value or 0.0) + float(r.value) * dt_u
 
     # -- orbital kinematics (reference ``timing_model.py:859-1080``) -------
     def _epoch_mjd(self, pv) -> float:
@@ -478,6 +534,9 @@ class BinaryELL1(PulsarBinary):
                                       description="EPS1 derivative"))
         self.add_param(floatParameter("EPS2DOT", units="1/s", unit_scale=True,
                                       description="EPS2 derivative"))
+
+    _secular_rows = (("EPS1", "EPS1DOT", "s"), ("EPS2", "EPS2DOT", "s"),
+                     ("A1", "A1DOT", "s"))
 
     def validate(self):
         if self.TASC.value is None:

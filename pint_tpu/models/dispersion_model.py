@@ -100,6 +100,28 @@ class DispersionDM(Dispersion):
         freq = self.barycentric_freq(pv, batch)
         return self.dispersion_time_delay(self.base_dm(pv, batch), freq)
 
+    def change_dmepoch(self, new_epoch):
+        """Shift DMEPOCH, adjusting the DM Taylor terms so the DM(t) curve is
+        unchanged (reference ``dispersion_model.py:274``)."""
+        from pint_tpu.utils import taylor_horner_deriv
+
+        terms = [float(self._params_dict["DM"].value or 0.0)] + [
+            float(self._params_dict[f"DM{i}"].value or 0.0)
+            for i in range(1, self.num_dm_terms)]
+        if self.DMEPOCH.value is None:
+            if any(t != 0.0 for t in terms[1:]):
+                raise ValueError(
+                    "DMEPOCH is not set but DM derivatives are nonzero")
+            self.DMEPOCH.value = np.longdouble(new_epoch)
+            return
+        dt_yr = float((np.longdouble(new_epoch)
+                       - np.longdouble(self.DMEPOCH.value)) / _DAY_PER_YEAR)
+        for i in range(len(terms)):
+            name = "DM" if i == 0 else f"DM{i}"
+            self._params_dict[name].value = float(
+                taylor_horner_deriv(dt_yr, terms, deriv_order=i))
+        self.DMEPOCH.value = np.longdouble(new_epoch)
+
 
 class DispersionDMX(Dispersion):
     """Piecewise-epoch DM offsets (reference ``dispersion_model.py:307``)."""

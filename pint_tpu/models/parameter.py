@@ -451,14 +451,56 @@ class pairParameter(floatParameter):
 
 
 class funcParameter(floatParameter):
-    """Read-only parameter derived from others (reference ``parameter.py``)."""
+    """Read-only parameter computed live from other model parameters
+    (reference ``parameter.py:2372``).
 
-    def __init__(self, name: str, func: Callable = None, params: List[str] = (), **kw):
-        super().__init__(name, **kw)
+    ``params`` are resolved through the host component's parent model at
+    read time, so ``.value``/``.quantity`` always reflect the current
+    state; the value is ``None`` while unattached or while any source is
+    unset.  With ``inpar=False`` (the default) the par-file line is
+    written commented out.
+    """
+
+    def __init__(self, name: str, func: Callable = None, params=(),
+                 inpar: bool = False, **kw):
         self.func = func
-        self.source_params = list(params)
+        self.source_params = [p if isinstance(p, str) else p[0]
+                              for p in params]
+        self.inpar = inpar
+        super().__init__(name, **kw)
         self.frozen = True
 
+    def _host_model(self):
+        comp = getattr(self, "_component", None)
+        return getattr(comp, "_parent", None) if comp is not None else None
+
+    @property
+    def value(self):
+        model = self._host_model()
+        if model is None or self.func is None:
+            return None
+        try:
+            vals = [getattr(model, p).value for p in self.source_params]
+        except AttributeError:
+            return None
+        if any(v is None for v in vals):
+            return None
+        return self.func(*(float(v) for v in vals))
+
+    @value.setter
+    def value(self, v):
+        if v is not None:
+            raise ValueError(
+                f"funcParameter {self.name} is read-only (computed from "
+                f"{self.source_params})")
+
+    def as_parfile_line(self) -> str:
+        line = super().as_parfile_line()
+        if line and not self.inpar:
+            line = "# " + line
+        return line
+
     def evaluate(self, model):
+        """Explicit evaluation against a given model (no attachment needed)."""
         vals = [getattr(model, p).value for p in self.source_params]
         return self.func(*vals) if self.func else None

@@ -900,6 +900,177 @@ class TimingModel:
             np.asarray(phases[0].frac) - np.asarray(phases[1].frac))
         return d / (2 * h)
 
+    def get_derived_params(self, rms: Optional[float] = None,
+                           ntoas: Optional[int] = None,
+                           returndict: bool = False):
+        """Human-readable block of derived quantities with 1-sigma
+        uncertainties (reference ``timing_model.py:3171``).
+
+        ``rms`` [us] and ``ntoas`` enable the ELL1 validity check.  Instead
+        of the reference's ``uncertainties`` package, errors propagate
+        through each formula by jax autodiff of the closed-form expression
+        (linear propagation, independent errors).  Returns the string, or
+        ``(string, dict)`` with ``returndict=True``; dict values are
+        ``(value, sigma)`` pairs.
+        """
+        import jax
+
+        from pint_tpu import derived_quantities as dq
+
+        def up(fn, names):
+            """(value, sigma) of fn(*param_values) via jax.grad."""
+            vals = np.array([float(getattr(self, n).value) for n in names])
+            errs = np.array([float(getattr(self, n).uncertainty or 0.0)
+                             for n in names])
+            v = float(fn(*vals))
+            if not np.any(errs):
+                return v, 0.0
+            g = np.asarray(jax.grad(lambda xs: fn(*xs))(jnp.asarray(vals)))
+            # a singular gradient (e.g. arctan2 at the origin) contributes
+            # nothing where the corresponding uncertainty is zero
+            terms = np.where(errs == 0.0, 0.0, g * errs)
+            return v, float(np.sqrt(np.sum(terms**2)))
+
+        def fmt(v, e, unit=""):
+            u = f" {unit}" if unit else ""
+            return f"{v:.12g} +/- {e:.3g}{u}" if e else f"{v:.12g}{u}"
+
+        out = {}
+        s = "Derived Parameters:\n"
+        if "F0" in self and self.F0.value is not None:
+            p, pe = up(lambda f0: 1.0 / f0, ["F0"])
+            out["P (s)"] = (p, pe)
+            s += f"Period = {fmt(p, pe, 's')}\n"
+            if "F1" in self and self.F1.value is not None:
+                pd, pde = up(lambda f0, f1: -f1 / f0**2, ["F0", "F1"])
+                out["Pdot (s/s)"] = (pd, pde)
+                s += f"Pdot = {fmt(pd, pde)}\n"
+                f0v, f1v = float(self.F0.value), float(self.F1.value)
+                if f1v < 0.0:
+                    out["age"] = (dq.pulsar_age(f0v, f1v), 0.0)
+                    out["B"] = (dq.pulsar_B(f0v, f1v), 0.0)
+                    out["Blc"] = (dq.pulsar_B_lightcyl(f0v, f1v), 0.0)
+                    out["Edot"] = (dq.pulsar_edot(f0v, f1v), 0.0)
+                    s += (f"Characteristic age = {out['age'][0]:.4g} yr "
+                          "(braking index = 3)\n")
+                    s += f"Surface magnetic field = {out['B'][0]:.3g} G\n"
+                    s += ("Magnetic field at light cylinder = "
+                          f"{out['Blc'][0]:.4g} G\n")
+                    s += (f"Spindown Edot = {out['Edot'][0]:.4g} erg/s "
+                          "(I=1e45 g cm^2)\n")
+                else:
+                    s += "Not computing Age, B, or Edot since F1 > 0.0\n"
+        if "PX" in self and self.PX.value and not self.PX.frozen:
+            # PX in mas -> distance in pc
+            d, de = up(lambda px: 1000.0 / px, ["PX"])
+            out["Dist (pc)"] = (d, de)
+            s += f"\nParallax distance = {fmt(d, de, 'pc')}\n"
+        if self.is_binary:
+            binary = next(n for n in self.components if n.startswith("Binary"))
+            out["Binary"] = binary
+            s += f"\nBinary model {binary}\n"
+            if "FB0" in self and self.FB0.value:
+                pb, pbe = up(lambda fb0: 1.0 / fb0 / 86400.0, ["FB0"])
+            else:
+                pb, pbe = up(lambda x: x, ["PB"])
+            out["PB (d)"] = (pb, pbe)
+            s += f"Orbital Period  (PB) = {fmt(pb, pbe, 'd')}\n"
+            pbdot = None
+            if "FB1" in self and self.FB1.value:
+                pbdot = up(lambda f0, f1: -f1 / f0**2, ["FB0", "FB1"])
+            elif "PBDOT" in self and self.PBDOT.value:
+                pbdot = up(lambda x: x, ["PBDOT"])
+            if pbdot is not None:
+                out["PBDOT (s/s)"] = pbdot
+                s += f"Orbital Pdot (PBDOT) = {fmt(*pbdot)}\n"
+            ell1 = binary.startswith("BinaryELL1")
+            if ell1:
+                s += "Conversion from ELL1 parameters:\n"
+                ecc = up(lambda e1, e2: jnp.hypot(e1, e2), ["EPS1", "EPS2"])
+                om = up(lambda e1, e2: jnp.rad2deg(jnp.arctan2(e1, e2))
+                        % 360.0, ["EPS1", "EPS2"])
+                out["ECC"], out["OM (deg)"] = ecc, om
+                s += f"ECC = {fmt(*ecc)}\nOM  = {fmt(*om, 'deg')}\n"
+                t0v = float(self.TASC.value) + pb * om[0] / 360.0
+                t0e = float(np.hypot(float(self.TASC.uncertainty or 0.0),
+                                     pb * om[1] / 360.0))
+                out["T0"] = (t0v, t0e)
+                s += f"T0  = {fmt(t0v, t0e)}\n"
+                if rms is not None and ntoas is not None:
+                    from pint_tpu.utils import ELL1_check
+                    s += ELL1_check(float(self.A1.value), ecc[0], rms, ntoas,
+                                    outstring=True)
+                s += "\n"
+            eccv = out["ECC"][0] if ell1 else float(self.ECC.value or 0.0)
+            tsun = dq.TSUN_S
+            if self.A1.value is not None and not self.A1.frozen:
+                fm = up(lambda a1: 4.0 * jnp.pi**2 * a1**3
+                        / (tsun * (pb * 86400.0) ** 2), ["A1"])
+                out["Mass Function (Msun)"] = fm
+                s += f"Mass function = {fmt(*fm, 'Msun')}\n"
+                mcmed = dq.companion_mass(pb, float(self.A1.value), i_deg=60.0)
+                mcmin = dq.companion_mass(pb, float(self.A1.value), i_deg=90.0)
+                out["Mc,med (Msun)"] = mcmed
+                out["Mc,min (Msun)"] = mcmin
+                s += ("Min / Median Companion mass (assuming Mpsr = 1.4 Msun)"
+                      f" = {mcmin:.4f} / {mcmed:.4f} Msun\n")
+            if "OMDOT" in self and self.OMDOT.value:
+                mt = up(lambda od: (od * jnp.pi / 180.0 / 86400.0 / 365.25
+                                    / (3.0 * tsun ** (2.0 / 3.0)
+                                       * (pb * 86400.0 / (2 * jnp.pi))
+                                       ** (-5.0 / 3.0)
+                                       / (1.0 - eccv**2))) ** 1.5, ["OMDOT"])
+                out["Mtot (Msun)"] = mt
+                s += f"Total mass, assuming GR, from OMDOT is {fmt(*mt, 'Msun')}\n"
+            if "SINI" in self and self.SINI.value is not None \
+                    and 0.0 <= float(self.SINI.value) < 1.0 \
+                    and self.M2.value is not None:
+                if not self.SINI.frozen:
+                    cosi = up(lambda si: jnp.sqrt(1.0 - si**2), ["SINI"])
+                    inc = up(lambda si: jnp.rad2deg(jnp.arcsin(si)), ["SINI"])
+                    s += "From SINI in model:\n"
+                    s += f"    cos(i) = {fmt(*cosi)}\n"
+                    s += f"    i = {fmt(*inc, 'deg')}\n"
+                mp = dq.pulsar_mass(pb, float(self.A1.value),
+                                    float(self.M2.value),
+                                    float(np.degrees(np.arcsin(
+                                        float(self.SINI.value)))))
+                out["Mp (Msun)"] = mp
+                s += f"Pulsar mass (Shapiro Delay) = {mp:.4f} Msun"
+        return (s, out) if returndict else s
+
+    def d_phase_d_toa(self, toas, sample_step: Optional[float] = None
+                      ) -> np.ndarray:
+        """Topocentric spin frequency [Hz]: central-difference derivative of
+        phase with respect to arrival time (reference ``timing_model.py:1962``).
+
+        ``sample_step`` is the half-step in seconds; the default is two spin
+        periods, matching the reference, so the O(h^2) truncation error is
+        ~F2-sized.  The shifted evaluations re-derive the observatory state
+        at the displaced epochs so the Roemer-rate (Doppler, ~1e-4
+        fractional) term enters the derivative; the int and frac phase parts
+        are differenced separately to dodge float64 cancellation at ~1e9
+        absolute cycles.
+        """
+        import copy as _copy
+
+        h = (2.0 / float(self.F0.value) if sample_step is None
+             else float(sample_step))
+        phases = []
+        for sgn in (-1.0, 1.0):
+            t = _copy.deepcopy(toas)
+            t.adjust_TOAs(np.full(t.ntoas, sgn * h))
+            if t.ssb_obs_pos_km is not None:
+                # adjust_TOAs shifts utc+tdb in lockstep (dTDB/dUTC deviates
+                # from 1 by ~1e-8, i.e. ~1e-11 s over a 2-period step —
+                # far below the h^2 truncation term); only the ephemeris
+                # state needs re-deriving at the displaced epochs
+                t.compute_posvels(ephem=t.ephem, planets=t.planets)
+            phases.append(self.phase(t, abs_phase=False))
+        dp_int = np.asarray(phases[1].int_) - np.asarray(phases[0].int_)
+        dp_frac = np.asarray(phases[1].frac) - np.asarray(phases[0].frac)
+        return (dp_int + dp_frac) / (2.0 * h)
+
     # ------------------------------------------------------------------
     # convenience physics accessors
     # ------------------------------------------------------------------

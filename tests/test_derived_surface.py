@@ -1,0 +1,154 @@
+"""funcParameter live evaluation and the derived-parameters report
+(reference ``tests/test_funcpar.py`` and ``timing_model.py:3171``)."""
+
+import io
+
+import numpy as np
+import pytest
+
+BASE_PAR = """
+PSR J1234+5678
+ELAT 0
+ELONG 10
+F0 1
+DM 10
+PEPOCH 57000
+UNITS TDB
+"""
+
+ELL1_PAR = """
+PSR  J1234+5678
+RAJ  12:34:00
+DECJ 56:47:00
+POSEPOCH 55000
+PX 1.2
+F0   218.8 1
+F1   -4.0e-16 1
+PEPOCH 55000
+DM   10.5
+BINARY ELL1
+PB   12.327 1
+PBDOT 2.0e-12
+A1   9.2 1
+TASC 55000.1 1
+EPS1 1.0e-5 1
+EPS2 -2.0e-5 1
+SINI 0.97 1
+M2   0.25 1
+OMDOT 0.01
+UNITS TDB
+"""
+
+
+def _get(par):
+    from pint_tpu.models import get_model
+
+    return get_model(io.StringIO(par))
+
+
+def _age_yr(f0, f1):
+    return -f0 / 2 / f1 / (365.25 * 86400.0)
+
+
+class TestFuncParameter:
+    def _age_param(self):
+        from pint_tpu.models.parameter import funcParameter
+
+        return funcParameter(name="AGE", description="Spindown age",
+                             params=("F0", "F1"), func=_age_yr, units="yr")
+
+    def test_unattached_is_none(self):
+        assert self._age_param().value is None
+
+    def test_attached_with_unset_source_is_none(self):
+        m = _get(BASE_PAR)
+        m.components["Spindown"].add_param(self._age_param())
+        assert m.AGE.value is None  # F1 unset
+
+    def test_attached_computes_live(self):
+        m = _get(BASE_PAR)
+        m.components["Spindown"].add_param(self._age_param())
+        m.F1.value = -3e-10
+        expect = 1.0 / 2 / 3e-10 / (365.25 * 86400.0)
+        assert np.isclose(m.AGE.value, expect)
+        assert np.isclose(m.AGE.quantity, expect)
+        # live: follows subsequent source edits
+        m.F1.value = -6e-10
+        assert np.isclose(m.AGE.value, expect / 2)
+
+    def test_read_only(self):
+        m = _get(BASE_PAR)
+        m.components["Spindown"].add_param(self._age_param())
+        with pytest.raises(ValueError):
+            m.AGE.value = 3.0
+
+    def test_always_frozen_never_fittable(self):
+        m = _get(BASE_PAR)
+        m.components["Spindown"].add_param(self._age_param())
+        assert m.AGE.frozen
+        assert "AGE" not in m.free_params
+
+    def test_commented_in_parfile_by_default(self):
+        m = _get(BASE_PAR)
+        m.components["Spindown"].add_param(self._age_param())
+        m.F1.value = -3e-10
+        age_lines = [ln for ln in m.as_parfile().splitlines() if "AGE" in ln]
+        assert age_lines and all(ln.startswith("#") for ln in age_lines)
+
+    def test_inpar_written_plainly(self):
+        from pint_tpu.models.parameter import funcParameter
+
+        m = _get(BASE_PAR)
+        p = funcParameter(name="AGE", params=("F0", "F1"), func=_age_yr,
+                          units="yr", inpar=True)
+        m.components["Spindown"].add_param(p)
+        m.F1.value = -3e-10
+        age_lines = [ln for ln in m.as_parfile().splitlines() if "AGE" in ln]
+        assert age_lines and not age_lines[0].startswith("#")
+
+
+class TestGetDerivedParams:
+    @pytest.fixture(scope="class")
+    def model(self):
+        m = _get(ELL1_PAR)
+        m.PX.frozen = False
+        m.PX.uncertainty = 0.1
+        m.F0.uncertainty = 1e-10
+        m.EPS1.uncertainty = 1e-7
+        m.EPS2.uncertainty = 1e-7
+        return m
+
+    def test_string_sections(self, model):
+        s = model.get_derived_params()
+        for needle in ("Period =", "Pdot =", "Characteristic age",
+                       "Parallax distance", "Binary model BinaryELL1",
+                       "ECC =", "Mass function", "Total mass",
+                       "Pulsar mass (Shapiro Delay)"):
+            assert needle in s, needle
+
+    def test_dict_values(self, model):
+        s, d = model.get_derived_params(returndict=True)
+        p, pe = d["P (s)"]
+        assert p == pytest.approx(1.0 / 218.8, rel=1e-12)
+        # sigma_P = sigma_F0 / F0^2, propagated through jax.grad
+        assert pe == pytest.approx(1e-10 / 218.8**2, rel=1e-6)
+        assert d["Pdot (s/s)"][0] == pytest.approx(4.0e-16 / 218.8**2,
+                                                   rel=1e-9)
+        ecc, ecce = d["ECC"]
+        assert ecc == pytest.approx(np.hypot(1e-5, 2e-5), rel=1e-12)
+        assert ecce == pytest.approx(1e-7, rel=1e-3)  # near-isotropic
+        assert d["Dist (pc)"][0] == pytest.approx(1000.0 / 1.2, rel=1e-12)
+        # d(1000/px) = 1000/px^2 * sigma
+        assert d["Dist (pc)"][1] == pytest.approx(1000.0 / 1.2**2 * 0.1,
+                                                  rel=1e-6)
+        assert 0.0 < d["Mp (Msun)"] < 3.0
+        assert d["Mc,min (Msun)"] < d["Mc,med (Msun)"]
+
+    def test_ell1_check_included_via_fitter_args(self, model):
+        s = model.get_derived_params(rms=1.5, ntoas=100)
+        assert "applicability of ELL1" in s
+
+    def test_isolated_pulsar_has_no_binary_block(self):
+        s = _get(BASE_PAR).get_derived_params()
+        assert "Binary model" not in s
+        assert "Period =" in s

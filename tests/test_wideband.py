@@ -132,6 +132,9 @@ class TestWidebandFitter:
         # DMJUMP is constrained by the DM data block
         assert abs(f.model.DMJUMP1.value - 0.002) < 5 * f.errors["DMJUMP1"]
         assert 0.5 < chi2 / f.resids.dof < 2.0
+        # the derived-params report handles the wideband rms dict
+        s = f.get_summary()
+        assert "Derived Parameters" in s and "nan" not in s.lower()
 
     def test_downhill_matches_oneshot(self, wb_toas):
         from pint_tpu.wideband import WidebandDownhillFitter, WidebandTOAFitter
