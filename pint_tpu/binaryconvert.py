@@ -103,9 +103,17 @@ def _shapmax_to_sini(shapmax):
 
 # -- driver ------------------------------------------------------------------
 
-def convert_binary(model, output: str, **kw):
+def convert_binary(model, output: str, NHARMS: int = 7,
+                   useSTIGMA: bool = True, KOM: float = 0.0, **kw):
     """Return a new TimingModel with the binary component converted to
-    *output* (reference ``binaryconvert.py convert_binary``)."""
+    *output* (reference ``binaryconvert.py convert_binary``).
+
+    ``NHARMS``/``useSTIGMA`` steer the ELL1H orthometric parameterization
+    (reference defaults to H3/H4; here STIGMA is the default since the
+    exact Freire & Wex H3/STIGMA form needs no harmonic truncation);
+    ``KOM`` [deg] seeds the ascending-node longitude when converting to
+    DDK, where KIN is derived from SINI and the sign is the user's to
+    check (reference ``binaryconvert.py:1050``)."""
     from pint_tpu.models.binary.components import PulsarBinary
     from pint_tpu.models.timing_model import Component
 
@@ -190,19 +198,56 @@ def convert_binary(model, output: str, **kw):
             new_comp.SINI.uncertainty = float(sg) or None
             new_comp.SINI.frozen = model.SHAPMAX.frozen
 
+    # DDK source: KIN -> SINI (reference ``binaryconvert.py:967``); the
+    # DDK-*target* block runs after the orthometric one below, so DDS/DDH/
+    # ELL1H sources have their derived SINI on new_comp by then
+    if current == "DDK" and output != "DDK":
+        kin = _getv(model, "KIN")
+        if kin and "SINI" in new_comp.params:
+            (v,), (sg,) = _propagate(
+                lambda x: [np.sin(np.radians(x[0]))],
+                [kin], [_gete(model, "KIN")])
+            new_comp.SINI.value = float(v)
+            new_comp.SINI.uncertainty = float(sg) or None
+            new_comp.SINI.frozen = model.KIN.frozen
+
     ortho_out = output in ("DDH", "ELL1H")
     ortho_cur = current in ("DDH", "ELL1H")
     if ortho_out and not ortho_cur:
-        m2, s = _getv(model, "M2"), _getv(model, "SINI")
+        # read M2/SINI from the NEW component: for DDS/DDK sources the
+        # source model has no SINI value (it lives in SHAPMAX/KIN) — the
+        # blocks above already derived it, with uncertainty, onto new_comp
+        def _newv(nm):
+            if nm not in new_comp.params:
+                return 0.0, 0.0
+            p = new_comp._params_dict[nm]
+            return float(p.value or 0.0), float(p.uncertainty or 0.0)
+
+        m2, m2_e = _newv("M2")
+        s, s_e = _newv("SINI")
         if m2 and s:
             stig_name = "STIGMA" if "STIGMA" in new_comp.params else "STIG"
             vals, errs = _propagate(
                 lambda x: _m2sini_to_h3stig(x[0], x[1]),
-                [m2, s], [_gete(model, "M2"), _gete(model, "SINI")])
+                [m2, s], [m2_e, s_e])
             new_comp._params_dict["H3"].value = float(vals[0])
             new_comp._params_dict["H3"].uncertainty = float(errs[0]) or None
-            new_comp._params_dict[stig_name].value = float(vals[1])
-            new_comp._params_dict[stig_name].uncertainty = float(errs[1]) or None
+            if useSTIGMA or stig_name == "STIG" \
+                    or "H4" not in new_comp.params:
+                new_comp._params_dict[stig_name].value = float(vals[1])
+                new_comp._params_dict[stig_name].uncertainty = \
+                    float(errs[1]) or None
+            else:
+                # H3/H4 truncated-harmonic form: H4 = H3 * stigma
+                vals4, errs4 = _propagate(
+                    lambda x: [_m2sini_to_h3stig(x[0], x[1])[0]
+                               * _m2sini_to_h3stig(x[0], x[1])[1]],
+                    [m2, s], [m2_e, s_e])
+                new_comp._params_dict["H4"].value = float(vals4[0])
+                new_comp._params_dict["H4"].uncertainty = \
+                    float(errs4[0]) or None
+            if "NHARMS" in new_comp.params:
+                new_comp._params_dict["NHARMS"].value = int(NHARMS)
             for nm in ("M2", "SINI"):
                 if nm in new_comp.params:
                     new_comp._params_dict[nm].value = None
@@ -217,6 +262,29 @@ def convert_binary(model, output: str, **kw):
             new_comp.M2.uncertainty = float(errs[0]) or None
             new_comp.SINI.value = float(vals[1])
             new_comp.SINI.uncertainty = float(errs[1]) or None
+
+    # DDK target: SINI -> KIN, seed KOM (reference ``binaryconvert.py:1050``).
+    # Runs after every SINI-producing block so DDS/DDH/ELL1H sources work.
+    if output == "DDK" and current != "DDK":
+        s = _getv(model, "SINI") or \
+            (float(new_comp.SINI.value or 0.0)
+             if "SINI" in new_comp.params else 0.0)
+        s_e = _gete(model, "SINI") or \
+            (float(new_comp.SINI.uncertainty or 0.0)
+             if "SINI" in new_comp.params else 0.0)
+        if s:
+            (v,), (sg,) = _propagate(
+                lambda x: [np.degrees(np.arcsin(x[0]))], [s], [s_e])
+            new_comp.KIN.value = float(v)
+            new_comp.KIN.uncertainty = float(sg) or None
+            src_sini = getattr(model, "SINI", None)
+            if src_sini is not None and src_sini.value is not None:
+                new_comp.KIN.frozen = src_sini.frozen
+            log.warning(f"Setting KIN={new_comp.KIN.value} deg from SINI: "
+                        "check that the sign is correct")
+        new_comp.KOM.value = float(KOM)
+        if "SINI" in new_comp.params:
+            new_comp.SINI.value = None  # DDK derives SINI from KIN
 
     # ELL1k: OMDOT/LNEDOT <-> EPS1DOT/EPS2DOT
     if output == "ELL1k" and current in ("ELL1", "ELL1H"):

@@ -365,6 +365,17 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     # independently either way.
     M0 = -np.asarray(J0) / F0
     B_base_np = np.hstack([np.ones((len(toas), 1)), M0])
+    # unit-W-norm column scaling (the fitter's normalize_designmatrix move,
+    # reference ``fitter.py:2712``): raw Gram entries reach ~1e42 (F1^T W F1
+    # at 4005 TOAs), beyond the TPU's emulated-f64 dynamic range — an f64 is
+    # stored as a float32 pair, so anything past ~3.4e38 lands on the device
+    # as inf and NaN-poisons every grid point (r04 all-NaN grid).  With the
+    # scales hoisted here (f64 host arithmetic), every device-side matrix
+    # stays O(1); the solve is algebraically unchanged and steps are
+    # de-scaled on the way out.
+    s_col_np = np.sqrt((W_np[:, None] * B_base_np**2).sum(axis=0))
+    s_col_np = np.where(s_col_np > 0, s_col_np, 1.0)
+    B_base_np = B_base_np / s_col_np
     U_w_np = W_np[:, None] * U_np
     A_base_np = B_base_np.T @ (W_np[:, None] * B_base_np)
     C_base_np = B_base_np.T @ U_w_np
@@ -377,6 +388,22 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     Y_base = jnp.asarray(Y_base_np)
     U_w = jnp.asarray(U_w_np)
     L_D = jnp.asarray(L_D_np)
+    s_col = jnp.asarray(s_col_np)
+
+    # Solve recipe for the marginalized (Schur) timing system, fixed at
+    # trace time per backend.  CPU: normalize by diag(A - Y^T Y) with a
+    # 1e-12 ridge — keeps degenerate-direction refit values in lockstep
+    # with the scalar doonefit path (test_grid extraparnames parity).
+    # TPU: the emulated ~49-bit f64 can cancel noise-absorbed Schur pivots
+    # negative (r04 bench: 1/an^2 of a 1e-300-clamped pivot overflowed and
+    # the Cholesky went NaN), so normalize by the UNmarginalized diag(A),
+    # which is positive by construction; the matmul error is then bounded
+    # at ~sqrt(n)*2^-49 ~ 1e-13 of the normalized scale and a 1e-9 ridge
+    # guarantees positive definiteness.  Absorbed directions get
+    # Levenberg-damped toward the initial values — the final chi2 is
+    # computed independently of step quality either way.
+    _TPU = jax.default_backend() == "tpu"
+    _RIDGE = 1e-9 if _TPU else 1e-12
 
     grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk,
                 tuple(nl_fit))
@@ -405,7 +432,8 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                                         batch, ctx)
                         return ph.frac
                     Jnl = jax.jacfwd(frac_of)(v[nl_idx])
-                    M_nl = -Jnl / F0  # (n, k)
+                    # same unit-W-norm column scale as the hoisted bases
+                    M_nl = (-Jnl / F0) / s_col[nlp_idx]  # (n, k)
                     B = B_base.at[:, nlp_idx].set(M_nl)
                     # refresh the nl rows/cols of the Gram blocks: the
                     # (nl, nl) sub-block is written consistently twice
@@ -422,14 +450,15 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                 z_u = jsl.solve_triangular(L_D, b_u, lower=True)
                 Ar = A - Y.T @ Y
                 rhs = b_t - Y.T @ z_u
-                # diagonal normalization for conditioning + a 1e-12
-                # relative ridge (the step need not be exact — the final
-                # chi2 below is computed independently)
-                an = jnp.sqrt(jnp.maximum(jnp.diag(Ar), 1e-300))
-                Arn = Ar / jnp.outer(an, an) + 1e-12 * jnp.eye(nt)
+                if _TPU:
+                    dA = jnp.diag(A)
+                    an = jnp.sqrt(jnp.maximum(dA, 1e-30 * jnp.max(dA)))
+                else:
+                    an = jnp.sqrt(jnp.maximum(jnp.diag(Ar), 1e-300))
+                Arn = Ar / jnp.outer(an, an) + _RIDGE * jnp.eye(nt)
                 L = jnp.linalg.cholesky(Arn)
                 x = jsl.cho_solve((L, True), rhs / an) / an
-                v = v.at[:nfit].add(x[1:nt])
+                v = v.at[:nfit].add((x / s_col)[1:nt])
             r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
             # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
             wr = w * r

@@ -259,6 +259,75 @@ class PulsarBinary(DelayComponent):
             p = self._params_dict[name]
             p.value = float(p.value or 0.0) + float(r.value) * dt_u
 
+    def pb(self, t=None):
+        """Orbital period and 1-sigma uncertainty at MJD time(s) ``t``
+        (reference ``pulsar_binary.py:672``), from PB/PBDOT(+XPBDOT) or the
+        FB frequency ladder.
+
+        Unlike the reference (which returns days on the PB path but seconds
+        on the FB path), both paths return **days**; the uncertainty is
+        ``None`` when no source parameter carries one.
+        """
+        ep = self._params_dict[self.epoch_param]
+        t_mjd = float(ep.value) if t is None else t
+        dt_d = np.asarray(t_mjd, dtype=np.float64) - float(ep.value)
+        if self.PB.value is not None:
+            pb_d = float(self.PB.value)
+            err2 = (float(self.PB.uncertainty) ** 2
+                    if self.PB.uncertainty is not None else 0.0)
+            pbdot = 0.0
+            for name in ("PBDOT", "XPBDOT"):
+                p = self._params_dict.get(name)
+                if p is not None and p.value is not None:
+                    pbdot += float(p.value)
+                    if p.uncertainty is not None:
+                        err2 += (float(p.uncertainty) * dt_d) ** 2
+            val = pb_d + pbdot * dt_d
+            err = np.sqrt(err2) if np.any(err2) else None
+            return val, err
+        if self._nfb:
+            from pint_tpu.utils import taylor_horner
+
+            dt_s = dt_d * DAY_S
+            coeffs = [float(self._params_dict[f"FB{i}"].value or 0.0)
+                      for i in range(self._nfb)]
+            f = np.asarray(taylor_horner(dt_s, coeffs), dtype=np.float64)
+            val = 1.0 / f / DAY_S
+            # d(1/f)/dFB_i = -(dt^i / i!) / f^2
+            import math
+
+            err2 = np.zeros_like(np.asarray(dt_s, dtype=np.float64))
+            any_err = False
+            for i in range(self._nfb):
+                u_i = self._params_dict[f"FB{i}"].uncertainty
+                if u_i is not None:
+                    any_err = True
+                    err2 = err2 + (dt_s**i / math.factorial(i) / f**2
+                                   * float(u_i)) ** 2
+            err = np.sqrt(err2) / DAY_S if any_err else None
+            return val, err
+        raise AttributeError(
+            "Neither PB nor FB0 is present in the timing model")
+
+    def pbdot_pair(self):
+        """(PBDOT, sigma) from FB1/FB0 when the FB ladder drives the orbit,
+        else from PBDOT itself; ``None`` when neither is set.  Single home
+        for the -FB1/FB0^2 derivation (also used by the derived-parameter
+        report)."""
+        fb1 = self._params_dict.get("FB1")
+        if fb1 is not None and fb1.value:
+            fb0 = self._params_dict["FB0"]
+            f0v, f1v = float(fb0.value), float(fb1.value)
+            val = -f1v / f0v**2
+            err = float(np.hypot((fb1.uncertainty or 0.0) / f0v**2,
+                                 2.0 * f1v * (fb0.uncertainty or 0.0)
+                                 / f0v**3))
+            return val, err
+        p = self._params_dict.get("PBDOT")
+        if p is not None and p.value:
+            return float(p.value), float(p.uncertainty or 0.0)
+        return None
+
     # -- orbital kinematics (reference ``timing_model.py:859-1080``) -------
     def _epoch_mjd(self, pv) -> float:
         epoch = pv[self.epoch_param]

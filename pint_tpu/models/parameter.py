@@ -148,16 +148,38 @@ class Parameter:
                 except ValueError:
                     pass
 
-    def as_parfile_line(self) -> str:
+    #: spelling swaps for tempo/tempo2 output (reference ``parameter.py:471``)
+    _FORMAT_RENAME = {"A1DOT": "XDOT", "STIGMA": "VARSIGMA"}
+    #: PINT-only parameters dropped from tempo/tempo2 output
+    _PINT_ONLY = {"DMRES", "SWM", "SWP"}
+
+    def as_parfile_line(self, format: str = "pint") -> str:
+        fmt = format.lower()
+        if fmt not in ("pint", "tempo", "tempo2"):
+            raise ValueError(f"parfile format must be pint/tempo/tempo2, "
+                             f"not {format!r}")
         if self.value is None:
             return ""
-        line = f"{self.name:<15} {self.value2str(self.value):>25}"
+        name, value = self.name, self.value
+        if fmt != "pint":
+            if name in self._PINT_ONLY:
+                return ""
+            name = self._FORMAT_RENAME.get(name, name)
+        if fmt == "tempo" and self.name in ("KIN", "KOM"):
+            # DT92 -> IAU convention (reference ``parameter.py:497-505``)
+            value = (180.0 if self.name == "KIN" else 90.0) - value
+        if fmt == "tempo2" and self.name == "ECL" and value != "IERS2003":
+            # tempo2 only implements the IERS2003 ecliptic
+            value = "IERS2003"
+        line = f"{name:<15} {self.value2str(value):>25}"
         if not self.frozen:
             line += " 1"
         if self.uncertainty is not None:
             if self.frozen:
                 line += " 0"
             line += f" {self.value2str(self.uncertainty)}"
+        if fmt == "tempo2" and self.name == "T2CMETHOD":
+            line = "#" + line
         return line + "\n"
 
     @property
@@ -361,7 +383,7 @@ class maskParameter(floatParameter):
             except ValueError:
                 pass
 
-    def as_parfile_line(self) -> str:
+    def as_parfile_line(self, format: str = "pint") -> str:
         if self.value is None:
             return ""
         if self.key is None:
@@ -494,8 +516,8 @@ class funcParameter(floatParameter):
                 f"funcParameter {self.name} is read-only (computed from "
                 f"{self.source_params})")
 
-    def as_parfile_line(self) -> str:
-        line = super().as_parfile_line()
+    def as_parfile_line(self, format: str = "pint") -> str:
+        line = super().as_parfile_line(format)
         if line and not self.inpar:
             line = "# " + line
         return line

@@ -911,7 +911,8 @@ class TimingModel:
         through each formula by jax autodiff of the closed-form expression
         (linear propagation, independent errors).  Returns the string, or
         ``(string, dict)`` with ``returndict=True``; dict values are
-        ``(value, sigma)`` pairs.
+        ``(value, sigma)`` pairs (sigma 0.0 where no propagation is
+        defined), except ``"Binary"`` which is the component name string.
         """
         import jax
 
@@ -969,17 +970,12 @@ class TimingModel:
             binary = next(n for n in self.components if n.startswith("Binary"))
             out["Binary"] = binary
             s += f"\nBinary model {binary}\n"
-            if "FB0" in self and self.FB0.value:
-                pb, pbe = up(lambda fb0: 1.0 / fb0 / 86400.0, ["FB0"])
-            else:
-                pb, pbe = up(lambda x: x, ["PB"])
+            bcomp = self.components[binary]
+            pb, pbe = bcomp.pb()
+            pbe = float(pbe or 0.0)
             out["PB (d)"] = (pb, pbe)
             s += f"Orbital Period  (PB) = {fmt(pb, pbe, 'd')}\n"
-            pbdot = None
-            if "FB1" in self and self.FB1.value:
-                pbdot = up(lambda f0, f1: -f1 / f0**2, ["FB0", "FB1"])
-            elif "PBDOT" in self and self.PBDOT.value:
-                pbdot = up(lambda x: x, ["PBDOT"])
+            pbdot = bcomp.pbdot_pair()
             if pbdot is not None:
                 out["PBDOT (s/s)"] = pbdot
                 s += f"Orbital Pdot (PBDOT) = {fmt(*pbdot)}\n"
@@ -1010,8 +1006,8 @@ class TimingModel:
                 s += f"Mass function = {fmt(*fm, 'Msun')}\n"
                 mcmed = dq.companion_mass(pb, float(self.A1.value), i_deg=60.0)
                 mcmin = dq.companion_mass(pb, float(self.A1.value), i_deg=90.0)
-                out["Mc,med (Msun)"] = mcmed
-                out["Mc,min (Msun)"] = mcmin
+                out["Mc,med (Msun)"] = (mcmed, 0.0)
+                out["Mc,min (Msun)"] = (mcmin, 0.0)
                 s += ("Min / Median Companion mass (assuming Mpsr = 1.4 Msun)"
                       f" = {mcmin:.4f} / {mcmed:.4f} Msun\n")
             if "OMDOT" in self and self.OMDOT.value:
@@ -1035,7 +1031,7 @@ class TimingModel:
                                     float(self.M2.value),
                                     float(np.degrees(np.arcsin(
                                         float(self.SINI.value)))))
-                out["Mp (Msun)"] = mp
+                out["Mp (Msun)"] = (mp, 0.0)
                 s += f"Pulsar mass (Shapiro Delay) = {mp:.4f} Msun"
         return (s, out) if returndict else s
 
@@ -1190,22 +1186,31 @@ class TimingModel:
     # ------------------------------------------------------------------
     # par-file round trip
     # ------------------------------------------------------------------
-    def as_parfile(self, comment: Optional[str] = None) -> str:
+    def as_parfile(self, comment: Optional[str] = None,
+                   format: str = "pint") -> str:
+        """Par-file text; ``format`` in ``pint``/``tempo``/``tempo2``
+        applies the reference's output-dialect tweaks (A1DOT->XDOT,
+        STIGMA->VARSIGMA, KIN/KOM DT92->IAU for tempo, ECL pinned to
+        IERS2003 and T2CMETHOD commented for tempo2; reference
+        ``timing_model.py:2862``, ``parameter.py:471``)."""
         lines = [f"# Created by pint_tpu\n" if comment is None else f"# {comment}\n"]
+        if format.lower() != "pint":
+            lines.append(f"# Format: {format.lower()}\n")
         for p in self.top_level_params:
             par = self._top_params_dict[p]
             if par.value is not None and par.value != "" and par.value is not False:
-                lines.append(par.as_parfile_line())
+                lines.append(par.as_parfile_line(format))
         for comp in self.components.values():
             for p in comp.params:
-                ln = comp._params_dict[p].as_parfile_line()
+                ln = comp._params_dict[p].as_parfile_line(format)
                 if ln:
                     lines.append(ln)
         return "".join(lines)
 
-    def write_parfile(self, path: str, comment: Optional[str] = None):
+    def write_parfile(self, path: str, comment: Optional[str] = None,
+                      format: str = "pint"):
         with open(path, "w") as f:
-            f.write(self.as_parfile(comment))
+            f.write(self.as_parfile(comment, format=format))
 
     def compare(self, other: "TimingModel", nodmx: bool = False,
                 threshold_sigma: float = 3.0, verbosity: str = "max") -> str:

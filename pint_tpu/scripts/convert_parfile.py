@@ -15,8 +15,21 @@ def main(argv: Optional[list] = None):
     ap.add_argument("input")
     ap.add_argument("-o", "--out", default=None,
                     help="output par file (default stdout)")
-    ap.add_argument("--binary", default=None,
+    ap.add_argument("-f", "--format", default="pint",
+                    choices=["pint", "tempo", "tempo2"],
+                    help="output par dialect")
+    ap.add_argument("-b", "--binary", default=None,
                     help="convert to this binary model (e.g. DD, ELL1)")
+    ap.add_argument("--nharms", type=int, default=7,
+                    help="Shapiro harmonics (ELL1H output; tempo2 default 4)")
+    ap.add_argument("--usestigma", action="store_true", default=True,
+                    help="H3/STIGMA parameterization (ELL1H output; the "
+                         "default here, matching convert_binary)")
+    ap.add_argument("--useh4", dest="usestigma", action="store_false",
+                    help="H3/H4 truncated-harmonic form instead of "
+                         "H3/STIGMA (ELL1H output)")
+    ap.add_argument("--kom", type=float, default=0.0,
+                    help="ascending-node longitude KOM [deg] (DDK output)")
     ap.add_argument("--units", default=None, choices=["TDB", "TCB"],
                     help="convert timescale units")
     ap.add_argument("--allow-tcb", action="store_true")
@@ -33,8 +46,9 @@ def main(argv: Optional[list] = None):
     if args.binary:
         from pint_tpu.binaryconvert import convert_binary
 
-        model = convert_binary(model, args.binary)
-    text = model.as_parfile()
+        model = convert_binary(model, args.binary, NHARMS=args.nharms,
+                               useSTIGMA=args.usestigma, KOM=args.kom)
+    text = model.as_parfile(format=args.format)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text)

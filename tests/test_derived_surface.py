@@ -107,6 +107,68 @@ class TestFuncParameter:
         assert age_lines and not age_lines[0].startswith("#")
 
 
+DDK_PAR = """
+PSR  J1713+0747
+RAJ  17:13:49
+DECJ 07:47:37
+PX   0.85
+F0   218.8 1
+PEPOCH 55000
+DM   15.9
+BINARY DDK
+PB   67.8 1
+A1   32.3 1
+A1DOT 1.0e-14
+T0   55000.1 1
+ECC  7.5e-5
+OM   176.0
+KIN  71.7
+KOM  90.0
+M2   0.29
+UNITS TDB
+"""
+
+
+class TestParfileFormats:
+    def test_tempo_dialect(self):
+        m = _get(DDK_PAR)
+        out = m.as_parfile(format="tempo")
+        assert "# Format: tempo" in out
+        # A1DOT -> XDOT; KIN/KOM flip from DT92 to IAU convention
+        assert "XDOT" in out and "A1DOT" not in out
+        kin = [ln for ln in out.splitlines() if ln.startswith("KIN")][0]
+        assert float(kin.split()[1]) == pytest.approx(180.0 - 71.7)
+        kom = [ln for ln in out.splitlines() if ln.startswith("KOM")][0]
+        assert float(kom.split()[1]) == pytest.approx(90.0 - 90.0)
+
+    def test_tempo2_dialect_ecl_and_stigma(self):
+        m = _get("PSR X\nELONG 10\nELAT 5\nECL IERS2010\nF0 3\nPEPOCH 55000\n"
+                 "DM 10\nBINARY ELL1H\nPB 1.0\nA1 1.0\nTASC 55000\n"
+                 "EPS1 1e-6\nEPS2 1e-6\nH3 1e-7\nSTIGMA 0.3\nUNITS TDB\n")
+        out = m.as_parfile(format="tempo2")
+        assert "VARSIGMA" in out and "\nSTIGMA" not in out
+        ecl = [ln for ln in out.splitlines() if ln.startswith("ECL")][0]
+        assert "IERS2003" in ecl
+
+    def test_pint_dialect_unchanged_and_roundtrips(self):
+        m = _get(DDK_PAR)
+        out = m.as_parfile()
+        assert "A1DOT" in out and "# Format" not in out
+        m2 = _get(out)
+        assert float(m2.KIN.value) == pytest.approx(71.7)
+
+    def test_swm_dropped_for_tempo(self):
+        m = _get("PSR X\nRAJ 1:00:00\nDECJ 2:00:00\nF0 3\nPEPOCH 55000\n"
+                 "DM 10\nNE_SW 8.0\nSWM 0\nUNITS TDB\n")
+        assert "SWM" in m.as_parfile()
+        assert "SWM" not in m.as_parfile(format="tempo")
+
+    def test_bad_format_raises(self):
+        m = _get(BASE_PAR)
+        with pytest.raises(ValueError):
+            m.as_parfile(format="tempo3")
+
+
 class TestGetDerivedParams:
     @pytest.fixture(scope="class")
     def model(self):
@@ -141,8 +203,10 @@ class TestGetDerivedParams:
         # d(1000/px) = 1000/px^2 * sigma
         assert d["Dist (pc)"][1] == pytest.approx(1000.0 / 1.2**2 * 0.1,
                                                   rel=1e-6)
-        assert 0.0 < d["Mp (Msun)"] < 3.0
-        assert d["Mc,min (Msun)"] < d["Mc,med (Msun)"]
+        # every value except 'Binary' is a (value, sigma) pair
+        assert all(len(v) == 2 for k, v in d.items() if k != "Binary")
+        assert 0.0 < d["Mp (Msun)"][0] < 3.0
+        assert d["Mc,min (Msun)"][0] < d["Mc,med (Msun)"][0]
 
     def test_ell1_check_included_via_fitter_args(self, model):
         s = model.get_derived_params(rms=1.5, ntoas=100)

@@ -224,6 +224,38 @@ class TestChangeBinaryEpoch:
         assert np.max(np.abs(d1 - d0)) < 1e-7
 
 
+class TestPb:
+    def test_pb_path_with_pbdot(self, dd_model):
+        import copy
+
+        m = copy.deepcopy(dd_model)
+        m.PB.uncertainty = 1e-6
+        m.PBDOT.uncertainty = 1e-13
+        v, e = m.pb()
+        assert v == pytest.approx(12.327, rel=1e-12)
+        assert e == pytest.approx(1e-6, rel=1e-9)  # dt=0: only sigma_PB
+        dt = 1000.0
+        v2, e2 = m.pb(55000.1 + dt)
+        assert v2 == pytest.approx(12.327 + 2.0e-12 * dt, rel=1e-12)
+        assert e2 == pytest.approx(np.hypot(1e-6, 1e-13 * dt), rel=1e-9)
+
+    def test_fb_path(self):
+        m = _get(ELL1_FB_PAR)
+        v, e = m.pb()
+        assert v == pytest.approx(1.0 / 2.1e-5 / 86400.0, rel=1e-12)
+        assert e is None
+        dt_d = 500.0
+        v2, _ = m.pb(float(m.TASC.value) + dt_d)
+        f = 2.1e-5 + (-3.0e-19) * dt_d * 86400.0
+        assert v2 == pytest.approx(1.0 / f / 86400.0, rel=1e-12)
+
+    def test_vector_times(self, dd_model):
+        t = np.array([55000.1, 55100.1, 55200.1])
+        v, _ = dd_model.pb(t)
+        assert v.shape == (3,)
+        assert np.all(np.diff(v) > 0)  # PBDOT > 0
+
+
 class TestDPhaseDToa:
     def test_matches_f0_scale(self, dd_model, fake_toas):
         f = dd_model.d_phase_d_toa(fake_toas)

@@ -191,6 +191,54 @@ class TestBinaryConvert:
         back = convert_binary(mdds, "DD")
         assert float(back.SINI.value) == pytest.approx(0.95, rel=1e-10)
 
+    def test_ell1h_h4_form(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = _model(BPAR)
+        mh = convert_binary(m, "ELL1H", useSTIGMA=False, NHARMS=4)
+        assert mh.STIGMA.value is None
+        assert int(mh.NHARMS.value) == 4
+        # H4 = H3 * stigma (Freire & Wex orthometric ratio)
+        stig = 0.95 / (1 + np.sqrt(1 - 0.95**2))
+        assert float(mh.H4.value) == pytest.approx(
+            float(mh.H3.value) * stig, rel=1e-9)
+
+    def test_ddk_kin_kom(self):
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = _model(BPAR)
+        mdd = convert_binary(m, "DD")
+        mddk = convert_binary(mdd, "DDK", KOM=42.0)
+        assert mddk.BINARY.value == "DDK"
+        assert float(mddk.KIN.value) == pytest.approx(
+            np.degrees(np.arcsin(0.95)), rel=1e-10)
+        assert float(mddk.KOM.value) == 42.0
+        assert mddk.SINI.value is None
+        back = convert_binary(mddk, "DD")
+        assert float(back.SINI.value) == pytest.approx(0.95, rel=1e-10)
+
+    def test_ddk_to_orthometric_keeps_companion(self):
+        # regression: DDS/DDK sources carry inclination in SHAPMAX/KIN, so
+        # the orthometric block must read the derived SINI, not the source's
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = _model(BPAR)
+        mddk = convert_binary(convert_binary(m, "DD"), "DDK", KOM=10.0)
+        mh = convert_binary(mddk, "ELL1H")
+        assert mh.H3.value is not None and mh.H3.value > 0
+        stig = 0.95 / (1 + np.sqrt(1 - 0.95**2))
+        assert float(mh.STIGMA.value) == pytest.approx(stig, rel=1e-9)
+        mdds = convert_binary(convert_binary(m, "DD"), "DDS")
+        mh2 = convert_binary(mdds, "DDH")
+        assert float(mh2.H3.value) == pytest.approx(float(mh.H3.value),
+                                                    rel=1e-9)
+        # ...and into DDK from SINI-less sources (SHAPMAX / orthometric)
+        for src in (mdds, mh2):
+            mk = convert_binary(src, "DDK", KOM=5.0)
+            assert float(mk.KIN.value) == pytest.approx(
+                np.degrees(np.arcsin(0.95)), rel=1e-8)
+            assert mk.SINI.value is None
+
     def test_ell1h_orthometric(self):
         from pint_tpu.binaryconvert import convert_binary
         from pint_tpu.derived_quantities import TSUN_S

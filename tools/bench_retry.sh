@@ -23,6 +23,7 @@ for i in $(seq 1 ${BENCH_RETRY_MAX:-200}); do
   line=$(grep -h '"metric"' "$OUT/attempt_$i.out" | tail -1)
   if [ -n "$line" ] && ! echo "$line" | grep -q '"error"' \
       && ! echo "$line" | grep -q '"value": 0.0,' \
+      && ! echo "$line" | grep -q '"sanity_ok": false' \
       && echo "$line" | grep -Eq '"platform": "(tpu|axon)"'; then
     echo "$line" > "$OUT/SUCCESS.json"
     echo "$(date -u +%FT%TZ) SUCCESS on attempt $i: $line" >> "$OUT/log"
