@@ -179,17 +179,10 @@ def convert_binary(model, output: str, NHARMS: int = 7,
             par.uncertainty = float(s) or None
             par.frozen = getattr(model, "ECC").frozen
 
-    # Shapiro parameterizations
-    if output == "DDS" and current != "DDS":
-        s = _getv(model, "SINI")
-        if s:
-            (v,), (sg,) = _propagate(lambda x: [_sini_to_shapmax(x[0])],
-                                     [s], [_gete(model, "SINI")])
-            new_comp.SHAPMAX.value = float(v)
-            new_comp.SHAPMAX.uncertainty = float(sg) or None
-            new_comp.SHAPMAX.frozen = model.SINI.frozen
-            new_comp.SINI.value = None
-    elif current == "DDS" and output != "DDS":
+    # Shapiro parameterizations.  The DDS-*target* block runs after the
+    # DDK/orthometric source blocks below (mirroring the DDK-target block)
+    # so KIN/H3-source models have their derived SINI on new_comp first.
+    if current == "DDS" and output != "DDS":
         sh = _getv(model, "SHAPMAX")
         if sh and "SINI" in new_comp.params:
             (v,), (sg,) = _propagate(lambda x: [_shapmax_to_sini(x[0])],
@@ -227,9 +220,12 @@ def convert_binary(model, output: str, NHARMS: int = 7,
         s, s_e = _newv("SINI")
         if m2 and s:
             stig_name = "STIGMA" if "STIGMA" in new_comp.params else "STIG"
-            vals, errs = _propagate(
-                lambda x: _m2sini_to_h3stig(x[0], x[1]),
-                [m2, s], [m2_e, s_e])
+
+            def _h3_stig_h4(x):
+                h3_, stig_ = _m2sini_to_h3stig(x[0], x[1])
+                return [h3_, stig_, h3_ * stig_]
+
+            vals, errs = _propagate(_h3_stig_h4, [m2, s], [m2_e, s_e])
             new_comp._params_dict["H3"].value = float(vals[0])
             new_comp._params_dict["H3"].uncertainty = float(errs[0]) or None
             if useSTIGMA or stig_name == "STIG" \
@@ -239,13 +235,9 @@ def convert_binary(model, output: str, NHARMS: int = 7,
                     float(errs[1]) or None
             else:
                 # H3/H4 truncated-harmonic form: H4 = H3 * stigma
-                vals4, errs4 = _propagate(
-                    lambda x: [_m2sini_to_h3stig(x[0], x[1])[0]
-                               * _m2sini_to_h3stig(x[0], x[1])[1]],
-                    [m2, s], [m2_e, s_e])
-                new_comp._params_dict["H4"].value = float(vals4[0])
+                new_comp._params_dict["H4"].value = float(vals[2])
                 new_comp._params_dict["H4"].uncertainty = \
-                    float(errs4[0]) or None
+                    float(errs[2]) or None
             if "NHARMS" in new_comp.params:
                 new_comp._params_dict["NHARMS"].value = int(NHARMS)
             for nm in ("M2", "SINI"):
@@ -260,8 +252,32 @@ def convert_binary(model, output: str, NHARMS: int = 7,
                 [h3, stig], [_gete(model, "H3"), _gete(model, stig_name)])
             new_comp.M2.value = float(vals[0])
             new_comp.M2.uncertainty = float(errs[0]) or None
+            new_comp.M2.frozen = model.H3.frozen
             new_comp.SINI.value = float(vals[1])
             new_comp.SINI.uncertainty = float(errs[1]) or None
+            new_comp.SINI.frozen = getattr(model, stig_name).frozen
+
+    # DDS target: SINI -> SHAPMAX.  Runs after every SINI-producing block
+    # so DDK/DDH/ELL1H sources (whose SINI was derived onto new_comp above)
+    # keep their Shapiro shape instead of silently dropping it.
+    if output == "DDS" and current != "DDS":
+        has_src = getattr(model, "SINI", None) is not None \
+            and model.SINI.value is not None
+        s = _getv(model, "SINI") or \
+            (float(new_comp.SINI.value or 0.0)
+             if "SINI" in new_comp.params else 0.0)
+        s_e = _gete(model, "SINI") or \
+            (float(new_comp.SINI.uncertainty or 0.0)
+             if "SINI" in new_comp.params else 0.0)
+        if s:
+            (v,), (sg,) = _propagate(lambda x: [_sini_to_shapmax(x[0])],
+                                     [s], [s_e])
+            new_comp.SHAPMAX.value = float(v)
+            new_comp.SHAPMAX.uncertainty = float(sg) or None
+            new_comp.SHAPMAX.frozen = model.SINI.frozen if has_src \
+                else new_comp.SINI.frozen
+        if "SINI" in new_comp.params:
+            new_comp.SINI.value = None  # DDS derives SINI from SHAPMAX
 
     # DDK target: SINI -> KIN, seed KOM (reference ``binaryconvert.py:1050``).
     # Runs after every SINI-producing block so DDS/DDH/ELL1H sources work.
@@ -280,6 +296,10 @@ def convert_binary(model, output: str, NHARMS: int = 7,
             src_sini = getattr(model, "SINI", None)
             if src_sini is not None and src_sini.value is not None:
                 new_comp.KIN.frozen = src_sini.frozen
+            elif "SINI" in new_comp.params:
+                # SINI was derived onto new_comp (DDS/DDH/ELL1H source):
+                # a free source inclination must stay free as KIN
+                new_comp.KIN.frozen = new_comp.SINI.frozen
             log.warning(f"Setting KIN={new_comp.KIN.value} deg from SINI: "
                         "check that the sign is correct")
         new_comp.KOM.value = float(KOM)

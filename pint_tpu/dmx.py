@@ -398,7 +398,7 @@ def dmxparse(fitter, save=False) -> Dict[str, np.ndarray]:
     fit_keys = [k for k in keys if k in fitted]
     if cov is not None and fit_keys:
         idx = [fitted.index(k) for k in fit_keys]
-        cc = np.asarray(cov)[np.ix_(idx, idx)]
+        cc = np.asarray(getattr(cov, "matrix", cov))[np.ix_(idx, idx)]
         n = len(fit_keys)
         mean_dmx = float(np.mean(vals[~frozen])) if np.any(~frozen) \
             else float(np.mean(vals))

@@ -153,12 +153,25 @@ class Fitter:
         # come from the model's cache (timing_model._jac_frac_linear_cached)
         return self.model.designmatrix(self.toas, reuse_linear=True)
 
-    def get_parameter_correlation_matrix(self):
+    def _set_covariance(self, cov, params):
+        """Store the post-fit parameter covariance as a labeled
+        :class:`~pint_tpu.pint_matrix.CovarianceMatrix` (reference
+        ``fitter.py`` exposes ``parameter_covariance_matrix`` with labeled
+        axes, built by ``pint_matrix.py:660``)."""
+        from pint_tpu.pint_matrix import CovarianceMatrix
+
+        labels = {p: (i, i + 1, "") for i, p in enumerate(params)}
+        self.parameter_covariance_matrix = CovarianceMatrix(
+            np.asarray(cov), [labels, labels])
+
+    def get_parameter_correlation_matrix(self, pretty_print: bool = False):
         cov = self.parameter_covariance_matrix
         if cov is None:
             return None
-        d = np.sqrt(np.diag(cov))
-        return cov / np.outer(d, d)
+        corr = cov.to_correlation_matrix()
+        if pretty_print:
+            print(corr.prettyprint())
+        return corr
 
     def ftest(self, other_chi2: float, other_dof: int):
         from pint_tpu.utils import FTest
@@ -276,7 +289,7 @@ class WLSFitter(Fitter):
                 par.value = float(par.value or 0.0) + float(dp)
             self.update_resids()
             chi2 = self.resids.chi2
-            self.parameter_covariance_matrix = cov
+            self._set_covariance(cov, params)
             self.fitted_params = params
             for i, p in enumerate(params):
                 if p == "Offset":
@@ -369,7 +382,7 @@ class DownhillFitter(Fitter):
                 break
             decrease = best_chi2 - chi2
             best_chi2 = chi2
-            self.parameter_covariance_matrix = cov
+            self._set_covariance(cov, params)
             self.fitted_params = params
             for i, p in enumerate(params):
                 if p == "Offset":
@@ -481,7 +494,7 @@ class LMFitter(Fitter):
         errs = np.sqrt(np.diag(xvar)) / norm
         covmat = (xvar / norm).T / norm
         ntm = len(params)
-        self.parameter_covariance_matrix = covmat[:ntm, :ntm]
+        self._set_covariance(covmat[:ntm, :ntm], params)
         self.fitted_params = params
         for i, p in enumerate(params):
             if p != "Offset":

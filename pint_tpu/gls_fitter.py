@@ -249,7 +249,7 @@ class GLSFitter(Fitter):
             par.uncertainty = float(errs[i])
             self.errors[p] = float(errs[i])
         ntm = len(params)
-        self.parameter_covariance_matrix = covmat[:ntm, :ntm]
+        self._set_covariance(covmat[:ntm, :ntm], params)
         self.fitted_params = params
 
     def _store_noise_ampls(self, dpars, ntm):

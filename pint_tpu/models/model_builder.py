@@ -246,7 +246,15 @@ class ModelBuilder:
             tm.name = tm.PSR.value
         for comp in tm.components.values():
             comp.setup()
-        tm.validate(allow_tcb=allow_tcb)
+        # reference semantics (model_builder.py:139,168): True converts the
+        # model to TDB, "raw" loads the TCB model untouched, False raises
+        if allow_tcb not in (True, False, "raw"):
+            raise ValueError("allow_tcb must be True, False, or 'raw'")
+        tm.validate(allow_tcb=allow_tcb in (True, "raw"))
+        if allow_tcb is True and (tm.UNITS.value or "").upper() == "TCB":
+            from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+            convert_tcb_tdb(tm)
         return tm
 
     def _assign(self, tm: TimingModel, key: str, rows: List[ParLine]) -> bool:

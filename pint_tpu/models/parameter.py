@@ -186,6 +186,16 @@ class Parameter:
     def quantity(self):
         return self.value
 
+    @property
+    def uncertainty_value(self):
+        """Bare-float uncertainty (reference ``parameter.py`` exposes both a
+        Quantity ``uncertainty`` and this float view; here both are floats)."""
+        return self.uncertainty
+
+    @uncertainty_value.setter
+    def uncertainty_value(self, v):
+        self.uncertainty = v
+
     def __repr__(self):
         fit = "" if self.frozen else " fit"
         return f"{type(self).__name__}({self.name}={self.value}{fit})"
@@ -215,7 +225,9 @@ class floatParameter(Parameter):
         return v
 
     def value2str(self, v):
-        return f"{v:.15g}"
+        # shortest string that round-trips the float64 exactly (%.15g can
+        # drop the 16th digit: an F0 ulp is ~2e-5 cycles over a decade span)
+        return repr(float(v))
 
 
 class strParameter(Parameter):
@@ -249,6 +261,16 @@ class MJDParameter(Parameter):
         kw.setdefault("units", "MJD")
         super().__init__(*a, **kw)
 
+    @property
+    def value(self):
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        # reference parity: ``model.PEPOCH.value = "54500.0001"`` parses at
+        # full longdouble precision
+        self._value = self.str2value(v) if isinstance(v, str) else v
+
     def str2value(self, s):
         return np.longdouble(s.translate(str.maketrans("Dd", "Ee")))
 
@@ -267,6 +289,15 @@ class AngleParameter(Parameter):
         self.angle_type = angle_type  # 'hms' (RA), 'dms' (DEC), 'deg', 'rad'
         kw.setdefault("units", {"hms": "hourangle", "dms": "deg"}.get(angle_type, angle_type))
         super().__init__(*a, **kw)
+
+    @property
+    def value(self):
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        # reference parity: ``model.RAJ.value = "04:37:15.9"`` parses
+        self._value = self.str2value(v) if isinstance(v, str) else v
 
     def str2value(self, s):
         if self.angle_type == "hms":

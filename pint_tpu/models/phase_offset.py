@@ -24,5 +24,15 @@ class PhaseOffset(PhaseComponent):
         self.add_param(floatParameter("PHOFF", value=0.0, units="",
                                       description="Overall phase offset"))
 
+    def build_context(self, toas):
+        # PHOFF is the offset between physical TOAs and the TZR TOA: it
+        # must NOT apply to the TZR TOA itself or it cancels out of the
+        # absolute phase (reference ``phase_offset.py:37`` zero for
+        # ``toas.tzr``; our TZR TOAs carry a "tzr" flag)
+        import numpy as np
+
+        mask = np.array([0.0 if "tzr" in fl else 1.0 for fl in toas.flags])
+        return {"apply": jnp.asarray(mask)}
+
     def phase_func(self, pv, batch, ctx, delay):
-        return Phase.from_float(-pv.get("PHOFF", 0.0) * jnp.ones(batch.ntoas))
+        return Phase.from_float(-pv.get("PHOFF", 0.0) * ctx["apply"])

@@ -220,9 +220,9 @@ class TimingModel:
         ]:
             self._top_params_dict[p.name] = p
             self.top_level_params.append(p.name)
+        self._cache: Dict[tuple, dict] = {}
         for c in components or []:
             self.add_component(c, validate=False)
-        self._cache: Dict[tuple, dict] = {}
 
     # ------------------------------------------------------------------
     # component management

@@ -15,12 +15,15 @@ def main(argv: Optional[list] = None):
     ap.add_argument("parfile2")
     ap.add_argument("--verbosity", default="max",
                     choices=["max", "med", "min"])
+    ap.add_argument("--allow_tcb", "--allow-tcb", action="store_true",
+                    help="convert TCB par files to TDB on load (reference "
+                    "compare_parfiles.py:87)")
     args = ap.parse_args(argv)
 
     from pint_tpu.models import get_model
 
-    m1 = get_model(args.parfile1, allow_tcb=True)
-    m2 = get_model(args.parfile2, allow_tcb=True)
+    m1 = get_model(args.parfile1, allow_tcb=args.allow_tcb)
+    m2 = get_model(args.parfile2, allow_tcb=args.allow_tcb)
     print(m1.compare(m2, verbosity=args.verbosity))
     return 0
 

@@ -38,7 +38,8 @@ def main(argv: Optional[list] = None):
 
     from pint_tpu.models import get_model
 
-    model = get_model(args.input, allow_tcb=True, allow_T2=args.allow_T2)
+    model = get_model(args.input, allow_tcb=args.allow_tcb,
+                      allow_T2=args.allow_T2)
     if args.units and model.UNITS.value != args.units:
         from pint_tpu.models.tcb_conversion import convert_tcb_tdb
 

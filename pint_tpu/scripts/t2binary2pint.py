@@ -15,12 +15,15 @@ def main(argv: Optional[list] = None):
         "the closest supported model (ELL1/DD/DDK guessing)")
     ap.add_argument("input")
     ap.add_argument("output")
+    ap.add_argument("--allow_tcb", "--allow-tcb", action="store_true",
+                    help="convert TCB par files to TDB on load (reference "
+                    "t2binary2pint.py:49)")
     args = ap.parse_args(argv)
 
     from pint_tpu.models import get_model
 
     # guess_binary_model runs inside the builder under allow_T2
-    model = get_model(args.input, allow_tcb=True, allow_T2=True)
+    model = get_model(args.input, allow_tcb=args.allow_tcb, allow_T2=True)
     model.write_parfile(args.output)
     print(f"Converted par file written to {args.output} "
           f"(BINARY {model.BINARY.value})")

@@ -198,6 +198,7 @@ def calculate_random_models(fitter, toas, Nmodels: int = 100,
     cov = fitter.parameter_covariance_matrix
     if cov is None:
         raise ValueError("Run fitter.fit_toas() first")
+    cov = np.asarray(getattr(cov, "matrix", cov))
     names = [p for p in fitter.fitted_params if p != "Offset"]
     # strip the Offset row/col when present
     if "Offset" in fitter.fitted_params:

@@ -322,3 +322,38 @@ class TestReviewRegressions:
         text = m.as_parfile()
         assert "FD2JUMP" not in text
         assert "FD1JUMP" in text
+
+
+class TestPhaseOffset:
+    """PHOFF semantics (reference ``phase_offset.py:37``): applies to
+    physical TOAs, zero at the TZR TOA — so it survives into the absolute
+    phase instead of cancelling against the TZR reference."""
+
+    def test_phoff_shifts_absolute_phase(self, toas):
+        m0 = _model("")
+        m = _model("PHOFF 0.2\n")
+        assert "PhaseOffset" in m.components
+        d = np.asarray(m.phase(toas, abs_phase=True).frac) \
+            - np.asarray(m0.phase(toas, abs_phase=True).frac)
+        np.testing.assert_allclose(d, -0.2, atol=1e-9)
+
+    def test_phoff_disables_mean_subtraction_and_is_fittable(self, toas):
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.residuals import Residuals
+
+        m = _model("PHOFF 0.01 1\n")
+        r = Residuals(toas, m)
+        assert not r.subtract_mean
+        f = WLSFitter(toas, m)
+        f.fit_toas()
+        # the data were simulated with PHOFF=0 -> the fit must pull it back
+        assert abs(f.model.PHOFF.value) < 4 * f.model.PHOFF.uncertainty + 1e-4
+        assert "PHOFF" in f.fitted_params
+
+    def test_phoff_derivative_column(self, toas):
+        m = _model("PHOFF 0.0 1\n")
+        M, names, units = m.designmatrix(toas)
+        j = names.index("PHOFF")
+        col = np.asarray(M)[:, j]
+        # d resid_seconds / d PHOFF = +1/F0 on every physical TOA
+        np.testing.assert_allclose(col, 1.0 / float(m.F0.value), rtol=1e-9)

@@ -70,3 +70,40 @@ class TestExamples:
         assert "stacked fit" in out
         assert "ML DM-noise fit" in out
         assert "done" in out
+
+    def test_understanding_timing_models_walkthrough(self, capsys):
+        out = _run("understanding_timing_models.py", capsys=capsys)
+        assert "delay pipeline" in out
+        assert "design matrix" in out
+        assert "par-file round trip OK" in out
+
+    def test_build_model_from_scratch_walkthrough(self, capsys):
+        out = _run("build_model_from_scratch.py", capsys=capsys)
+        assert "recovered to" in out
+        assert "par-line construction matches" in out
+
+    def test_mass_mass_walkthrough(self, capsys):
+        out = _run("mass_mass_grid.py", "--quick", capsys=capsys)
+        assert "grid minimum at M2" in out
+        assert "masses consistent" in out
+
+    def test_pulse_numbers_walkthrough(self, capsys):
+        out = _run("pulse_numbers.py", capsys=capsys)
+        assert "tracked fit recovers F0" in out
+        assert "delta_pulse_number wrap" in out
+
+    def test_understanding_fitters_walkthrough(self, capsys):
+        out = _run("understanding_fitters.py", capsys=capsys)
+        assert "Fitter.auto" in out
+        assert "corr(F0, F1)" in out
+        assert "reproduces F0 uncertainty" in out
+
+    def test_dmx_analysis_walkthrough(self, capsys):
+        out = _run("dmx_analysis.py", capsys=capsys)
+        assert "dmx_ranges built" in out
+        assert "dmxparse" in out and "dmxstats" in out
+
+    def test_flags_and_phase_offset_walkthrough(self, capsys):
+        out = _run("flags_and_phase_offset.py", capsys=capsys)
+        assert "recovered JUMP1" in out
+        assert "fitted PHOFF" in out

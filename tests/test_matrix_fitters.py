@@ -107,9 +107,11 @@ class TestPintMatrix:
 
         f = WLSFitter(t, m)
         f.fit_toas()
-        names = f.fitted_params
-        labels = {n: (i, i + 1, "") for i, n in enumerate(names)}
-        cm = CovarianceMatrix(f.parameter_covariance_matrix, [labels, labels])
+        # fitters now hand back the labeled matrix directly (reference
+        # fitter.py parameter_covariance_matrix)
+        cm = f.parameter_covariance_matrix
+        assert isinstance(cm, CovarianceMatrix)
+        assert "F0" in cm.get_label_names(axis=0)
         s = cm.prettyprint()
         assert "F0" in s and "Offset" not in s
 

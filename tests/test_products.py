@@ -191,6 +191,39 @@ class TestBinaryConvert:
         back = convert_binary(mdds, "DD")
         assert float(back.SINI.value) == pytest.approx(0.95, rel=1e-10)
 
+    def test_ddk_to_dds_keeps_shapiro(self):
+        """Regression (r4 review): DDK/DDH/ELL1H -> DDS previously dropped
+        the Shapiro shape because the DDS-target block ran before the
+        KIN/H3 -> SINI derivations."""
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = _model(BPAR)
+        mddk = convert_binary(convert_binary(m, "DD"), "DDK", KOM=90.0)
+        assert mddk.SINI.value is None  # DDK carries KIN, not SINI
+        mdds = convert_binary(mddk, "DDS")
+        assert mdds.SHAPMAX.value is not None
+        assert float(mdds.SHAPMAX.value) == pytest.approx(
+            -np.log(1 - 0.95), rel=1e-6)
+        # DDH source too
+        mddh = convert_binary(convert_binary(m, "DD"), "DDH")
+        mdds2 = convert_binary(mddh, "DDS")
+        assert float(mdds2.SHAPMAX.value) == pytest.approx(
+            -np.log(1 - 0.95), rel=1e-6)
+
+    def test_dds_to_ddk_keeps_frozen_state(self):
+        """Regression (r4 review): a free SHAPMAX must convert to a free
+        KIN even though the DDS source model has no SINI value."""
+        from pint_tpu.binaryconvert import convert_binary
+
+        m = _model(BPAR)
+        mdds = convert_binary(convert_binary(m, "DD"), "DDS")
+        mdds.SHAPMAX.frozen = False
+        mddk = convert_binary(mdds, "DDK", KOM=90.0)
+        assert not mddk.KIN.frozen
+        mdds.SHAPMAX.frozen = True
+        mddk2 = convert_binary(mdds, "DDK", KOM=90.0)
+        assert mddk2.KIN.frozen
+
     def test_ell1h_h4_form(self):
         from pint_tpu.binaryconvert import convert_binary
 
