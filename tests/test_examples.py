@@ -107,3 +107,14 @@ class TestExamples:
         out = _run("flags_and_phase_offset.py", capsys=capsys)
         assert "recovered JUMP1" in out
         assert "fitted PHOFF" in out
+
+    def test_rednoise_wavex_walkthrough(self, capsys):
+        out = _run("rednoise_wavex.py", "--quick", capsys=capsys)
+        assert "WaveX expansion" in out
+        assert "power-law recovery consistent" in out
+
+    def test_observatories_walkthrough(self, capsys):
+        out = _run("observatories_and_clocks.py", capsys=capsys)
+        assert "registered observatories" in out
+        assert "site velocity" in out
+        assert "registry round trip OK" in out
