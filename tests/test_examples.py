@@ -108,6 +108,11 @@ class TestExamples:
         assert "recovered JUMP1" in out
         assert "fitted PHOFF" in out
 
+    def test_custom_component_walkthrough(self, capsys):
+        out = _run("custom_component.py", capsys=capsys)
+        assert "no hand derivatives written" in out
+        assert "round-trips through as_parfile" in out
+
     def test_rednoise_wavex_walkthrough(self, capsys):
         out = _run("rednoise_wavex.py", "--quick", capsys=capsys)
         assert "WaveX expansion" in out

@@ -190,7 +190,9 @@ class TestTCBConversion:
 
         par = ("PSR J0\nRAJ 10:00:00\nDECJ 10:00:00\nPOSEPOCH 55000\n"
                "F0 100.0 1\nF1 -1e-14\nPEPOCH 55000\nDM 10.0\nUNITS TCB\n")
-        m = get_model(io.StringIO(par), allow_tcb=True)
+        # "raw" keeps the TCB model untouched (allow_tcb=True now converts
+        # on load, reference model_builder.py:139 semantics)
+        m = get_model(io.StringIO(par), allow_tcb="raw")
         f0_tcb = float(m.F0.value)
         pepoch_tcb = float(m.PEPOCH.value)
         convert_tcb_tdb(m)
