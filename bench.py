@@ -75,6 +75,25 @@ class Stages:
         return "\n".join(lines)
 
 
+#: cache dir whose enabling is deferred until the CPU-pinned simulation is
+#: done (TPU-backend runs only; see main())
+_PENDING_CACHE_DIR = []
+
+
+def _enable_persistent_cache():
+    if not _PENDING_CACHE_DIR:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", _PENDING_CACHE_DIR[0])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+    del _PENDING_CACHE_DIR[:]
+
+
 def bench_b1855_gls():
     """Headline: GLS chisq grid on the 4k-TOA correlated-noise workload."""
     from pint_tpu.gls_fitter import GLSFitter
@@ -106,6 +125,9 @@ def bench_b1855_gls():
         toas = make_fake_toas_fromtim(B1855_TIM, model, add_noise=True,
                                       rng=rng)
     st.mark("ingest tim + simulate TOAs")
+    # the simulation above compiled CPU executables (host-pinned); only now
+    # is it safe to turn on the un-hostnamed TPU cache dir (see main())
+    _enable_persistent_cache()
 
     f = GLSFitter(toas, model)
     chi2_fit = f.fit_toas(maxiter=2)
@@ -243,18 +265,30 @@ def main():
             return
     print(f"# platform: {backend}", file=sys.stderr)
 
-    # persistent XLA compilation cache, keyed by backend + machine so AOT
-    # artifacts compiled on the TPU-tunnel host are never replayed on a
-    # different local CPU microarchitecture (SIGILL hazard seen in r03)
-    machine = f"{backend}-{_platform_mod.machine()}-{_platform_mod.node()}"
+    # persistent XLA compilation cache.  CPU entries are additionally keyed
+    # by hostname: AOT artifacts compiled on another host's CPU
+    # microarchitecture must never replay locally (SIGILL hazard seen in
+    # r03).  TPU entries are NOT host-keyed — they are compiled for (and
+    # by) the accelerator behind the tunnel, and a per-container hostname
+    # key would cold-start every session (~7-10 min recompile, seen r04).
+    machine = f"{backend}-{_platform_mod.machine()}"
+    if backend not in ("tpu", "axon"):
+        machine += f"-{_platform_mod.node()}"
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache", machine)
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:
-        pass
+    if backend in ("tpu", "axon"):
+        # DEFER enabling: the TOA simulation pins to the host CPU device,
+        # and its CPU artifacts must not land in the un-hostnamed TPU dir
+        # (cross-host CPU AOT replay = the r03 SIGILL hazard).
+        # bench_b1855_gls() enables the cache once the simulation is done.
+        _PENDING_CACHE_DIR.append(cache_dir)
+    else:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            pass
 
     r = bench_b1855_gls()
     fits_per_sec = r["fits_per_sec"]

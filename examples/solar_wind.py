@@ -1,0 +1,70 @@
+"""Solar-wind dispersion: the annual DM signature and fitting NE_SW.
+
+The TPU-native analogue of the reference's ``docs/examples/solar_wind.py``:
+the solar wind adds a dispersion measure that peaks each year when the
+line of sight passes near the Sun.  This walkthrough shows the annual
+pattern for a low-ecliptic-latitude pulsar, its strong dependence on
+solar elongation, and recovery of an injected electron density NE_SW
+(Edwards et al. 2006 spherical model, SWM=0; the power-law SWM=1 and
+piecewise SWX variants live in the same component).
+
+Run:  python examples/solar_wind.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    if "--cpu" in args:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from pint_tpu.fitter import DownhillWLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    # a pulsar nearly in the ecliptic plane: strong solar-wind signature
+    base = ["PSR J0030+0451\n", "ELONG 8.91\n", "ELAT 1.45\n",
+            "POSEPOCH 55000\n", "F0 205.53069 1\n", "F1 -4.3e-16 1\n",
+            "PEPOCH 55000\n", "DM 4.33 1\n", "UNITS TDB\n"]
+    truth = 8.0  # NE_SW electron density at 1 AU [cm^-3]
+    sim = get_model(base + [f"NE_SW {truth}\n"])
+    clean = get_model(base + ["NE_SW 0.0\n"])
+
+    toas = make_fake_toas_uniform(54500, 55500, 200, clean, error_us=0.5,
+                                  freq=(800.0, 1400.0))
+    # the solar-wind DM delay = difference between the two models
+    d = np.asarray(sim.delay(toas)) - np.asarray(clean.delay(toas))
+    mjds = np.asarray(toas.get_mjds(), dtype=np.float64)
+    peak = mjds[np.argmax(d)]
+    print(f"solar-wind delay at 800-1400 MHz: min {d.min() * 1e6:.2f} us, "
+          f"max {d.max() * 1e6:.2f} us (peak at MJD {peak:.0f})")
+    # two annual conjunctions inside the 1000-d span -> two delay maxima
+    assert d.max() > 5 * d.min() > 0  # sharply peaked, always positive
+
+    # --- recover the injected density --------------------------------------
+    toas = make_fake_toas_uniform(54500, 55500, 200, sim, error_us=0.5,
+                                  freq=(800.0, 1400.0), add_noise=True,
+                                  rng=np.random.default_rng(30))
+    fit = get_model(base + ["NE_SW 0.0 1\n"])
+    f = DownhillWLSFitter(toas, fit)
+    f.fit_toas()
+    ne = f.model.NE_SW
+    pull = (ne.value - truth) / ne.uncertainty
+    print(f"recovered NE_SW = {ne.value:.3f} +- {ne.uncertainty:.3f} cm^-3 "
+          f"({pull:+.2f} sigma from injected {truth})")
+    assert abs(pull) < 4
+    assert f.resids.reduced_chi2 < 1.5
+    print("solar-wind density recovered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -234,9 +234,13 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
 
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w, F0,
                        Jbase):
-            v = jnp.concatenate([free_init[:nfit], gvals])
+            v0 = jnp.concatenate([free_init[:nfit], gvals])
             ones = jnp.ones((len(w), 1))
-            for _ in range(niter):
+
+            # one Gauss-Newton iteration; rolled into a lax.scan so the
+            # (large) phase-evaluation graph is compiled ONCE, not niter
+            # times — same math, ~niter-times-smaller executable
+            def gn_step(v, _):
                 r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
                 if len(nl_fit):
                     def frac_of(sub):
@@ -258,7 +262,9 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                 norms = jnp.linalg.norm(Aw, axis=0)
                 norms = jnp.where(norms == 0, 1.0, norms)
                 dpar, *_ = jnp.linalg.lstsq(Aw / norms, rw)
-                v = v.at[:nfit].add(dpar[1:] / norms[1:])
+                return v.at[:nfit].add(dpar[1:] / norms[1:]), None
+
+            v, _ = jax.lax.scan(gn_step, v0, None, length=niter)
             r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
             # the refit parameter values ride along for extraparnames
             # (reference gridutils.py:116-160 extraout)
@@ -418,12 +424,20 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             r = r - jnp.sum(r * w) / jnp.sum(w)
             return r / F0
 
+        # s_col is a traced argument, NOT a closure constant: the cached
+        # executable is reused across grid_chisq calls (the key ignores
+        # parameter values), so every weight-dependent hoisted array must
+        # flow in as data or a rebuilt fn would de-scale with a stale copy
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w,
                        F0, B_base, A_base, Y_base, U_w, L_D,
-                       U_chi, cf_chi):
-            v = jnp.concatenate([free_init[:nfit], gvals])
+                       U_chi, cf_chi, s_col):
+            v0 = jnp.concatenate([free_init[:nfit], gvals])
             nt = 1 + nfit
-            for _ in range(niter):
+
+            # one Gauss-Newton iteration; rolled into a lax.scan so the
+            # phase-evaluation + jacfwd graph (which dwarfs everything
+            # else) is compiled ONCE, not niter times
+            def gn_step(v, _):
                 r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
                 wr = w * r
                 if len(nl_all):
@@ -458,7 +472,9 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                 Arn = Ar / jnp.outer(an, an) + _RIDGE * jnp.eye(nt)
                 L = jnp.linalg.cholesky(Arn)
                 x = jsl.cho_solve((L, True), rhs / an) / an
-                v = v.at[:nfit].add((x / s_col)[1:nt])
+                return v.at[:nfit].add((x / s_col)[1:nt]), None
+
+            v, _ = jax.lax.scan(gn_step, v0, None, length=niter)
             r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
             # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
             wr = w * r
@@ -468,7 +484,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         model._cache[grid_key] = jax.jit(jax.vmap(
             chi2_point,
             in_axes=(0, None, None, None, None, None, None, None, None,
-                     None, None, None, None, None, None)))
+                     None, None, None, None, None, None, None)))
     vfn = model._cache[grid_key]
 
     def fn(points, sharding=None):
@@ -489,7 +505,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                 blk = jax.device_put(blk, sharding)
             c2, vf = vfn(blk, free_init, const_pv, batch, ctx, int0, w,
                          F0, B_base, A_base, Y_base, U_w, L_D,
-                         U_chi, cf_chi)
+                         U_chi, cf_chi, s_col)
             keep = blk_size - pad if pad else blk_size
             out.append(c2[:keep])
             out_v.append(vf[:keep])

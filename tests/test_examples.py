@@ -108,6 +108,11 @@ class TestExamples:
         assert "recovered JUMP1" in out
         assert "fitted PHOFF" in out
 
+    def test_solar_wind_walkthrough(self, capsys):
+        out = _run("solar_wind.py", capsys=capsys)
+        assert "solar-wind delay" in out
+        assert "solar-wind density recovered" in out
+
     def test_custom_component_walkthrough(self, capsys):
         out = _run("custom_component.py", capsys=capsys)
         assert "no hand derivatives written" in out

@@ -209,6 +209,41 @@ class TestGLSGrid:
         assert np.allclose(ex_mesh["DM"], ex_plain["DM"], rtol=1e-10)
 
 
+class TestGridExecutableReuse:
+    def test_noise_change_uses_fresh_scaling(self, gls_fit):
+        """Regression (r4 review): the cached grid executable is reused
+        across grid_chisq calls, so every weight-dependent hoisted array
+        (including the s_col column scaling) must be a traced argument.
+        Changing EFAC between calls must still match per-point fits."""
+        import copy
+
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.grid import grid_chisq
+
+        f = gls_fit
+        dF0 = 3 * f.errors.get("F0", 1e-10)
+        g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 3)
+        g1 = np.array([f.model.F1.value])
+        grid_chisq(f, ("F0", "F1"), (g0, g1), niter=8)  # seed the cache
+
+        f.model.EFAC1.value = 1.7  # rescales w and therefore s_col
+        chi2_grid, ex = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=8,
+                                   extraparnames=("DM",))
+        for i, v0 in enumerate(g0):
+            m = copy.deepcopy(f.model)
+            m.F0.value = float(v0)
+            m.F0.frozen = True
+            m.F1.frozen = True
+            ff = GLSFitter(f.toas, m)
+            chi2_pt = ff.fit_toas(maxiter=8)
+            assert chi2_grid[i, 0] == pytest.approx(chi2_pt, rel=1e-4)
+            # DM is the sloppy direction here (single-frequency TOAs):
+            # both paths converge toward it from different trajectories, so
+            # allow 1e-3 — a stale s_col would miss by the ~1.7x rescale
+            assert ex["DM"][i, 0] == pytest.approx(
+                float(ff.model.DM.value), rel=1e-3)
+
+
 class TestLinearColumnClassification:
     def test_probe_scale_keeps_linear_columns_linear(self, gls_fit):
         """Regression: the linearity probe perturbs each parameter by a
