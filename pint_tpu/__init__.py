@@ -82,3 +82,11 @@ OBL_IERS2010_RAD = OBL_IERS2010_ARCSEC * (1.0 / 3600.0) * 3.141592653589793 / 18
 parsec = 3.0856775814913673e16
 
 from pint_tpu import logging as logging  # noqa: E402  (lightweight)
+
+
+def print_info():
+    """Print versions/platform/runtime state (reference
+    ``__init__.py print_info`` -> ``utils.info_string(detailed=True)``)."""
+    from pint_tpu.utils import info_string
+
+    print(info_string())

@@ -732,3 +732,60 @@ def sun_ecliptic_longitude_deg(mjd, precision: str = "low"):
     # geocentric Sun = -heliocentric Earth
     lam = np.arctan2(-pos[..., 1], -pos[..., 0])
     return np.asarray(np.rad2deg(lam) % 360.0)[()]
+
+
+# ---------------------------------------------------------------------------
+# reference-spelled entry points (solar_system_ephemerides.py:123,201,240,289)
+# ---------------------------------------------------------------------------
+
+def load_kernel(ephem: str, path: "str | None" = None, link: str = None):
+    """Reference ``solar_system_ephemerides.py:123``: load the named kernel
+    (or an explicit ``path``); ``link`` (a download URL) is accepted for
+    signature parity but unusable in a zero-egress deployment."""
+    if link:
+        log.warning("load_kernel: remote links are not supported in this "
+                    "zero-egress build; using local search paths")
+    if path:
+        # an explicit path must load THAT kernel or fail loudly — the
+        # name-based analytic fallback would silently degrade accuracy
+        key = str(path).lower()
+        if key not in _loaded:
+            if not os.path.exists(str(path)):
+                raise FileNotFoundError(f"Ephemeris kernel not found: {path}")
+            _loaded[key] = SPKEphemeris(str(path))
+        return _loaded[key]
+    return load_ephemeris(ephem)
+
+
+def clear_loaded_ephem() -> None:
+    """Drop every cached kernel (reference
+    ``solar_system_ephemerides.py clear_loaded_ephem``)."""
+    _loaded.clear()
+
+
+def objPosVel(obj1: str, obj2: str, t, ephem: str = "DE440",
+              path=None, link=None):
+    """Position/velocity of ``obj2`` relative to ``obj1`` (reference
+    ``solar_system_ephemerides.py:240``); ``t`` is TDB MJD."""
+    # an explicit path IS the kernel to use — name-based lookup would
+    # silently fall back to the analytic ephemeris when the named kernel
+    # is not on the search path
+    key = str(path) if path else ephem
+    if link:
+        load_kernel(ephem, path=path, link=link)
+    pv1 = objPosVel_wrt_SSB(obj1, t, key)
+    pv2 = objPosVel_wrt_SSB(obj2, t, key)
+    return pv2 - pv1
+
+
+def get_tdb_tt_ephem_geocenter(tt_mjd, ephem: str = "DE440",
+                               path=None, link=None) -> np.ndarray:
+    """Geocentric TDB-TT [s] read from a 't' kernel's time-ephemeris
+    segment (reference ``solar_system_ephemerides.py:289``); raises when the
+    loaded kernel carries none (e.g. the analytic fallback)."""
+    eph = load_kernel(ephem, path=path, link=link)
+    if not getattr(eph, "has_tdb_tt", lambda: False)():
+        raise ValueError(
+            f"Ephemeris {ephem!r} has no TDB-TT time-ephemeris segment "
+            "(use a 't' kernel such as DE440t)")
+    return eph.tdb_minus_tt(tt_mjd)

@@ -73,6 +73,26 @@ class UnknownBinaryModel(ModelError):
         self.suggestion = suggestion
 
 
+class ComponentConflict(ModelError, ValueError):
+    """Multiple components could be selected with no way to choose
+    (reference ``exceptions.py:157``)."""
+
+
+class MissingBinaryError(TimingModelError):
+    """BINARY parameter missing where a binary model is required
+    (reference ``exceptions.py:136``)."""
+
+
+class PINTPrecisionError(PintError, RuntimeError):
+    """Platform/numerics cannot deliver the required time precision
+    (reference ``exceptions.py:143``)."""
+
+
+class PropertyAttributeError(PintError, ValueError):
+    """A property raised AttributeError internally (reference
+    ``exceptions.py:73``; raised by ``timing_model.property_exists``)."""
+
+
 class PrefixError(ModelError):
     """Malformed prefix parameter name (e.g. F0003x)."""
 

@@ -75,14 +75,28 @@ def setup(level: str = "INFO", usecolors: bool = True, dedup: bool = True) -> in
     return id(handler)
 
 
+def showwarning(message, category, filename, lineno, file=None, line=None):
+    """``warnings.showwarning`` replacement routing through this logger
+    (reference ``logging.py:85``); installed by :func:`capture_warnings`."""
+    name = category.__name__ if category else "Warning"
+    log.warning(f"{name}: {message} ({filename}:{lineno})")
+
+
 def capture_warnings(enable: bool = True) -> None:
     """Route Python warnings through the pint_tpu logger."""
     if enable:
-        def _showwarning(message, category, filename, lineno, file=None, line=None):
-            log.warning(f"{category.__name__}: {message} ({filename}:{lineno})")
-        warnings.showwarning = _showwarning
+        warnings.showwarning = showwarning
     else:
         warnings.showwarning = warnings._showwarning_orig  # type: ignore[attr-defined]
 
 
 setup("WARNING")
+
+
+def get_level(starting_level_name: str, verbosity: int, quietness: int) -> str:
+    """Map a base level and -v/-q counts to a level name (reference
+    ``logging.py:336``; used by CLI scripts)."""
+    start = levels.index(starting_level_name) \
+        if starting_level_name in levels else levels.index("INFO")
+    return levels[min(max(start - verbosity + quietness, 0), len(levels) - 1)]
+

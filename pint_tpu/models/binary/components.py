@@ -784,3 +784,7 @@ class BinaryBT_piecewise(BinaryBT):
         pv2 = dict(pv)
         pv2["A1"] = a1
         return self.binary_delay(pv2, tt0)
+
+
+#: reference class name (``binary_bt.py:85``)
+BinaryBTPiecewise = BinaryBT_piecewise

@@ -327,3 +327,62 @@ def get_model_and_toas(parfile, timfile, ephem=None, planets=None,
         include_bipm=include_bipm, **kw,
     )
     return model, toas
+
+
+def convert_binary_params_dict(parfile_dict, convert_komkin: bool = True,
+                               drop_ddk_sini: bool = True,
+                               force_binary_model: "str | None" = None):
+    """Rewrite a parsed par-file dict's BINARY line to the best-guess
+    supported model (reference ``model_builder.py:1024``): T2 (or any
+    unsupported) binary models are replaced by the highest-priority guess
+    from :func:`guess_binary_model`; for a DDK result the KIN/KOM angles are
+    converted between the IAU and DT92 conventions and SINI is dropped
+    (DDK derives it from KIN).
+
+    Accepts either this module's ``parse_parfile`` output (lists of
+    ``ParLine``) or a plain {KEY: [value-string]} mapping; the input mapping
+    is edited in place and returned.
+    """
+    from pint_tpu.io.par import ParLine
+
+    def _get(key):
+        rows = parfile_dict.get(key)
+        if not rows:
+            return None
+        row = rows[0]
+        return " ".join(row.fields) if isinstance(row, ParLine) else str(row)
+
+    def _set(key, value_str: str):
+        rows = parfile_dict.get(key)
+        if rows and isinstance(rows[0], ParLine):
+            parfile_dict[key] = [ParLine(key, value_str.split())]
+        else:
+            parfile_dict[key] = [value_str]
+
+    binary = _get("BINARY")
+    if not binary:
+        return parfile_dict
+    binary = binary.split()[0]
+    if not force_binary_model and f"Binary{binary}" in \
+            Component.component_types:
+        return parfile_dict  # already a supported model: leave it alone
+    if force_binary_model:
+        guesses = [force_binary_model]
+    else:
+        guesses = guess_binary_model(parfile_dict)
+        log.info(f"Compatible binary models: {', '.join(guesses)}; "
+                 f"using {guesses[0]}")
+    _set("BINARY", guesses[0])
+    if convert_komkin:
+        # IAU <-> DT92: KIN' = 180 - KIN, KOM' = 90 - KOM (reference
+        # parameter.py:497-505 conventions)
+        for key, zero in (("KIN", 180.0), ("KOM", 90.0)):
+            val = _get(key)
+            if val is not None:
+                fields = val.split()
+                fields[0] = repr(zero - float(fields[0]))
+                _set(key, " ".join(fields))
+    if drop_ddk_sini and guesses[0] == "DDK":
+        if parfile_dict.pop("SINI", None) is not None:
+            log.info("Dropped SINI from the DDK model (derived from KIN)")
+    return parfile_dict

@@ -494,8 +494,29 @@ class PLSWNoise(_PLNoiseBase):
 # -- reference-spelled aliases (``noise_model.py:1180-1345``) -------------
 create_ecorr_quantization_matrix = ecorr_quantization_matrix
 create_fourier_design_matrix = fourier_design_matrix
+#: reference spellings (``noise_model.py:1160,1201``)
 get_ecorr_epochs = ecorr_epochs
-get_rednoise_freqs = rednoise_freqs
+
+
+def get_rednoise_freqs(t, nmodes, Tspan=None, logmode=None, f_min=None,
+                       nlog=None):
+    """Red-noise Fourier frequencies over the data span (reference
+    ``noise_model.py:1201``): ``nmodes`` linear modes k/T, optionally
+    preceded by ``nlog`` log-spaced modes below 1/T.  ``t`` is TOA times in
+    seconds (any units cancel against Tspan)."""
+    import numpy as _np
+
+    T = float(Tspan) if Tspan is not None else float(_np.max(t) - _np.min(t))
+    if logmode is not None and not (nlog and f_min):
+        raise ValueError(
+            "logmode requires nlog and f_min (reference noise_model.py:1201 "
+            "log-spaced parameters must all be provided)")
+    if nlog and nlog > 0:
+        ratio = f_min * T if f_min else 1.0
+        return rednoise_freqs(T, int(nmodes), n_log=int(nlog),
+                              f_min_ratio=ratio)
+    return rednoise_freqs(T, int(nmodes))
+
 
 
 def get_ecorr_nweights(t_s, dt: float = 1.0, nmin: int = 2) -> int:

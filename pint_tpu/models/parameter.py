@@ -186,6 +186,20 @@ class Parameter:
     def quantity(self):
         return self.value
 
+    def as_latex(self):
+        """(label, value) LaTeX fragments for publication tables (reference
+        ``parameter.py as_latex``; consumed by ``output.publish``)."""
+        from pint_tpu.output.publish import _fmt_uncertainty
+
+        name = self.name.replace("_", r"\_")
+        unit = str(self.units).replace("^", r"\^{}") if self.units else ""
+        label = f"{name} ({unit})" if unit else name
+        if isinstance(self.value, (int, float, np.floating, np.integer)):
+            val = _fmt_uncertainty(float(self.value), self.uncertainty)
+        else:
+            val = str(self.value)
+        return label, val
+
     @property
     def uncertainty_value(self):
         """Bare-float uncertainty (reference ``parameter.py`` exposes both a

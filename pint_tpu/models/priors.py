@@ -59,6 +59,15 @@ def GaussianBoundedRV(loc: float = 0.0, scale: float = 1.0,
     return truncnorm(a, b, loc=loc, scale=scale)
 
 
+def GaussianRV_gen(loc: float = 0.0, scale: float = 1.0):
+    """Frozen scipy normal under the reference's spelling
+    (``priors.py:119 GaussianRV_gen``); the bounded variant is
+    :func:`GaussianBoundedRV`."""
+    from scipy.stats import norm
+
+    return norm(loc=loc, scale=scale)
+
+
 class Prior:
     """Prior distribution attached to a Parameter (reference ``priors.py:14``).
 

@@ -117,3 +117,21 @@ def convert_tcb_tdb(model, backwards: bool = False):
     model.UNITS.value = target
     model.validate(allow_tcb=backwards)
     return model
+
+
+def compute_effective_dimensionality(param_name: str) -> int:
+    """Effective time-dimensionality n of a parameter for TCB<->TDB scaling
+    (x_tdb = x_tcb * IFTE_K**n).
+
+    The reference computes n from the astropy unit of
+    ``quantity * scaling_factor`` (``parameter.py:2600``); this build keys
+    the same information by parameter name (the tables this module's
+    converter uses).  Raises ValueError for a parameter with no defined
+    scaling.
+    """
+    dim = _effective_dim(str(param_name).upper())
+    if dim is None:
+        raise ValueError(
+            f"No TCB<->TDB effective dimensionality defined for "
+            f"{param_name!r}")
+    return int(dim)

@@ -1293,3 +1293,104 @@ class TimingModel:
         for c in new.components.values():
             c._parent = new
         return new
+
+
+# ---------------------------------------------------------------------------
+# component-pool introspection (reference ``timing_model.py:3798
+# AllComponents``) and the property_exists decorator
+# (``timing_model.py:132``)
+# ---------------------------------------------------------------------------
+
+class ModelMeta(type):
+    """Accepted for reference-style declarations
+    (``class X(Component, metaclass=ModelMeta)``, reference
+    ``timing_model.py:3385``).  Registration itself is performed by
+    ``Component.__init_subclass__`` — this metaclass only validates that a
+    ``register = True`` class really is a Component (a non-Component in the
+    registry would crash AllComponents/ModelBuilder instantiation)."""
+
+    def __init__(cls, name, bases, dct):
+        super().__init__(name, bases, dct)
+        if dct.get("register", False) and not issubclass(cls, Component):
+            raise TypeError(
+                f"{name}: register=True requires subclassing Component")
+
+
+def property_exists(f):
+    """``@property`` that re-raises an internal AttributeError as TypeError.
+
+    A plain property swallowing an accidental AttributeError makes
+    ``__getattr__``-based delegation report "no such attribute" instead of
+    the real bug (reference ``timing_model.py:132``)."""
+    import functools
+
+    from pint_tpu.exceptions import PropertyAttributeError
+
+    @functools.wraps(f)
+    def wrapper(self):
+        try:
+            return f(self)
+        except AttributeError as e:
+            raise PropertyAttributeError(
+                f"property {f.__name__} raised AttributeError internally: {e}"
+            ) from e
+
+    return property(wrapper)
+
+
+class AllComponents:
+    """Pool of one (valueless) instance of every registered component, for
+    model building and parameter searching (reference
+    ``timing_model.py:3798``)."""
+
+    def __init__(self):
+        self.components: Dict[str, Component] = {
+            k: v() for k, v in Component.component_types.items()}
+
+    @property
+    def param_component_map(self) -> Dict[str, List[str]]:
+        """{parameter name: [component names]} (aliases excluded;
+        reference ``timing_model.py:3825``)."""
+        out: Dict[str, List[str]] = {}
+        for cname, comp in self.components.items():
+            for p in comp.params:
+                out.setdefault(p, []).append(cname)
+        return out
+
+    def search_binary_components(self, system_name: str) -> Component:
+        """The binary component implementing ``system_name`` (e.g. 'ELL1');
+        raises UnknownBinaryModel otherwise (reference
+        ``timing_model.py:3998``)."""
+        from pint_tpu.exceptions import UnknownBinaryModel
+
+        key = f"Binary{system_name}"
+        if key in self.components:
+            return self.components[key]
+        raise UnknownBinaryModel(f"Unknown binary model {system_name!r}")
+
+    def alias_to_pint_param(self, alias: str) -> Tuple[str, str]:
+        """(canonical parameter name, matched component parameter) for an
+        alias, resolving prefix/mask indices (e.g. ``T2EFAC2`` -> EFAC2;
+        reference ``timing_model.py:4046``)."""
+        from pint_tpu.exceptions import PrefixError
+        from pint_tpu.models.parameter import split_prefixed_name
+
+        for comp in self.components.values():
+            hit = comp.match_param_alias(alias)
+            if hit is not None:
+                return hit, alias
+        # indexed family: match the prefix against each component's
+        # exemplar aliases, then re-attach the index
+        try:
+            prefix, index = split_prefixed_name(alias)
+        except (ValueError, PrefixError):
+            raise ValueError(f"{alias!r} is not a parameter or alias")
+        if index >= 0:
+            for comp in self.components.values():
+                hit = comp.match_param_alias(prefix) \
+                    or comp.match_param_alias(prefix + "1")
+                if hit is not None:
+                    base, _ = split_prefixed_name(hit) \
+                        if hit[-1].isdigit() else (hit, -1)
+                    return f"{base}{index}", alias
+        raise ValueError(f"{alias!r} is not a parameter or alias")

@@ -14,6 +14,7 @@ Native counterpart of reference ``src/pint/observatory/`` (registry +
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -487,3 +488,201 @@ def export_all_clock_files(directory) -> List[str]:
             cf.write_tempo_clock_file(dest)
         out.append(dest)
     return out
+
+
+# ---------------------------------------------------------------------------
+# maintenance/reporting helpers (reference observatory/__init__.py:74,549,
+# 556,647,771)
+# ---------------------------------------------------------------------------
+
+def earth_location_distance(loc1, loc2) -> float:
+    """Distance [m] between two geocentric locations given as (x, y, z)
+    triples in meters (reference ``observatory/__init__.py:549``, minus the
+    astropy Quantity wrapper)."""
+    a = np.asarray(loc1, dtype=np.float64)
+    b = np.asarray(loc2, dtype=np.float64)
+    return float(np.sqrt(np.sum((a - b) ** 2)))
+
+
+def find_latest_bipm(bipm_default: str = "BIPM2021") -> int:
+    """Most recent TT(BIPMYYYY) realization available LOCALLY.
+
+    The reference polls the BIPM FTP server for successive years
+    (``observatory/__init__.py:74``); this zero-egress build scans the local
+    clock search paths for ``tai2tt_bipmYYYY.clk`` files instead and returns
+    the latest year found (falling back to the default version's year).
+    """
+    import re
+
+    from pint_tpu.observatory.clock_file import _clock_search_paths
+
+    years = []
+    for d in _clock_search_paths():
+        try:
+            for fn in os.listdir(d):
+                m = re.fullmatch(r"tai2tt_bipm(\d{4})\.clk", fn.lower())
+                if m:
+                    years.append(int(m.group(1)))
+        except OSError:
+            continue
+    if not years:
+        log.warning("No local tai2tt_bipmYYYY.clk files found; reporting the "
+                    f"default {bipm_default}")
+        return int(bipm_default[4:])
+    return max(years)
+
+
+def list_last_correction_mjds(file=None) -> None:
+    """Print, per observatory, each clock file and its last valid MJD
+    (reference ``observatory/__init__.py:771``).  Sites whose clock files
+    cannot be found locally print MISSING."""
+    import sys
+
+    out = file or sys.stdout
+    _ensure_builtin()
+    for name in sorted(_registry):
+        site = _registry[name]
+        files = [cf for cf in site._site_clock_files(limits="warn")
+                 if cf is not None]
+        if not getattr(site, "clock_file_names", None) and not files:
+            continue
+        last = min((cf.last_correction_mjd() for cf in files),
+                   default=-np.inf)
+        if np.isfinite(last):
+            print(f"{name:<20} {last:.1f}", file=out)
+        else:
+            print(f"{name:<20} MISSING", file=out)
+        for cf in files:
+            lm = cf.last_correction_mjd()
+            tag = f"{lm:.1f}" if np.isfinite(lm) else "MISSING"
+            print(f"  {getattr(cf, 'filename', '?'):<20} {tag}", file=out)
+
+
+def _geodetic_to_itrf_m(lat_deg: float, lon_deg: float, height_m: float):
+    """WGS84 geodetic -> geocentric ITRF XYZ [m] (closed form)."""
+    a = 6378137.0
+    f = 1.0 / 298.257223563
+    e2 = f * (2.0 - f)
+    lat = np.deg2rad(lat_deg)
+    lon = np.deg2rad(lon_deg)
+    N = a / np.sqrt(1.0 - e2 * np.sin(lat) ** 2)
+    x = (N + height_m) * np.cos(lat) * np.cos(lon)
+    y = (N + height_m) * np.cos(lat) * np.sin(lon)
+    z = (N * (1.0 - e2) + height_m) * np.sin(lat)
+    return float(x), float(y), float(z)
+
+
+def _topo_obs_entry(name: str, x: float, y: float, z: float,
+                    aliases=()) -> str:
+    import json as _json
+
+    entry = {"itrf_xyz": [x, y, z]}
+    if aliases:
+        entry["aliases"] = list(aliases)
+    return _json.dumps({name: entry}, indent=4)[1:-1].strip()
+
+
+def compare_t2_observatories_dat(t2dir: "str | None" = None) -> dict:
+    """Compare a tempo2 ``observatory/observatories.dat`` against the
+    registry (reference ``observatory/__init__.py:556``).  Returns
+    ``{"different": [...], "missing": [...]}`` where each entry carries a
+    ready-to-paste observatories.json snippet."""
+    t2dir = t2dir or os.getenv("TEMPO2")
+    if t2dir is None:
+        raise ValueError("TEMPO2 directory not provided and TEMPO2 "
+                         "environment variable not set")
+    path = os.path.join(t2dir, "observatory", "observatories.dat")
+    report: dict = {"different": [], "missing": []}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                x, y, z, full_name, short_name = line.split()
+                x, y, z = float(x), float(y), float(z)
+            except ValueError as e:
+                raise ValueError(f"unrecognized line {line!r}") from e
+            full_name, short_name = full_name.lower(), short_name.lower()
+            entry = _topo_obs_entry(full_name, x, y, z, [short_name])
+            try:
+                obs = get_observatory(full_name)
+            except KeyError:
+                try:
+                    obs = get_observatory(short_name)
+                except KeyError:
+                    report["missing"].append(
+                        dict(name=full_name, topo_obs_entry=entry))
+                    continue
+            oloc = obs.earth_location_itrf()
+            d = earth_location_distance((x, y, z), oloc)
+            if d > 1.0:
+                report["different"].append(dict(
+                    name=full_name, t2_short_name=short_name,
+                    t2=(x, y, z), pint=tuple(oloc), position_difference=d,
+                    pint_name=obs.name, pint_aliases=obs.aliases,
+                    topo_obs_entry=entry))
+    return report
+
+
+def compare_tempo_obsys_dat(tempodir: "str | None" = None) -> dict:
+    """Compare a tempo ``obsys.dat`` against the registry (reference
+    ``observatory/__init__.py:647``); geodetic entries (icoord=0, ddmmss.s
+    lat / +west-longitude convention) are converted to ITRF."""
+    tempodir = tempodir or os.getenv("TEMPO")
+    if tempodir is None:
+        raise ValueError("TEMPO directory not provided and TEMPO "
+                         "environment variable not set")
+    path = os.path.join(tempodir, "obsys.dat")
+
+    def dms(v: float) -> float:
+        s = np.sign(v)
+        v = abs(v)
+        return float(s * (v // 10000 + (v % 10000) // 100 / 60.0
+                          + (v % 100) / 3600.0))
+
+    report: dict = {"different": [], "missing": []}
+    with open(path) as f:
+        for line in f:
+            if not line.strip() or line.strip().startswith("#"):
+                continue
+            try:
+                x = float(line[0:15])
+                y = float(line[15:30])
+                z = float(line[30:45])
+                icoord = line[47:48].strip()
+                icoord = int(icoord) if icoord else 0
+                obsnam = line[51:71].strip().lower()
+                tempo_code = line[71:72].strip("-")
+                itoa_code = line[74:76].strip()
+            except (ValueError, IndexError) as e:
+                raise ValueError(f"unrecognized line {line!r}") from e
+            if not icoord:
+                # geodetic: x = lat ddmmss.s, y = WEST longitude ddmmss.s
+                x, y, z = _geodetic_to_itrf_m(dms(x), -dms(y), z)
+            name = obsnam.replace(" ", "_")
+            entry = _topo_obs_entry(
+                name, x, y, z,
+                [a for a in (itoa_code.lower(),) if a])
+            obs = None
+            for key in (name, itoa_code.lower(), tempo_code.lower()):
+                if not key:
+                    continue
+                try:
+                    obs = get_observatory(key)
+                    break
+                except KeyError:
+                    continue
+            if obs is None:
+                report["missing"].append(
+                    dict(name=name, itoa_code=itoa_code,
+                         tempo_code=tempo_code, topo_obs_entry=entry))
+                continue
+            d = earth_location_distance((x, y, z), obs.earth_location_itrf())
+            if d > 1.0:
+                report["different"].append(dict(
+                    name=name, itoa_code=itoa_code, tempo_code=tempo_code,
+                    tempo=(x, y, z), pint=tuple(obs.earth_location_itrf()),
+                    position_difference=d, pint_name=obs.name,
+                    topo_obs_entry=entry))
+    return report

@@ -22,7 +22,8 @@ from pint_tpu.logging import log
 from pint_tpu.observatory import Observatory, _registry
 from pint_tpu.utils import PosVel
 
-__all__ = ["SatelliteObs", "load_FT2", "load_FPorbit", "load_nustar_orbit",
+__all__ = ["SatelliteObs", "load_FT2", "load_Fermi_FT2", "load_orbit",
+           "load_FPorbit", "load_nustar_orbit",
            "get_satellite_observatory"]
 
 
@@ -139,3 +140,38 @@ def get_satellite_observatory(name: str, ft2name: str, fmt: str = "FT2",
         return _registry[key]
     obs = SatelliteObs(name, ft2name, fmt=fmt, **kw)
     return obs
+
+
+#: reference spelling (``satellite_obs.py:18``)
+load_Fermi_FT2 = load_FT2
+
+
+def load_orbit(obs_name: str, orb_filename) -> Tuple[np.ndarray, np.ndarray]:
+    """Load one or more orbit files for the named mission (reference
+    ``satellite_obs.py:242``): Fermi uses FT2, NuSTAR its own format,
+    NICER/RXTE/others FPorbit.  ``orb_filename`` may be a list, an
+    ``@listfile`` (one path per line), or a single path; multiple files are
+    concatenated in time order."""
+    if isinstance(orb_filename, (list, tuple)):
+        paths = list(orb_filename)
+    elif str(orb_filename).startswith("@"):
+        with open(str(orb_filename)[1:]) as f:
+            paths = [ln.strip() for ln in f if ln.strip()]
+    else:
+        paths = [str(orb_filename)]
+    name = obs_name.lower()
+    if "fermi" in name:
+        loader = load_FT2
+    elif "nustar" in name:
+        loader = load_nustar_orbit
+    else:
+        loader = load_FPorbit
+    mjds_all, pos_all = [], []
+    for p in paths:
+        m, x = loader(p)
+        mjds_all.append(np.asarray(m))
+        pos_all.append(np.asarray(x))
+    mjds = np.concatenate(mjds_all)
+    pos = np.concatenate(pos_all, axis=0)
+    order = np.argsort(mjds)
+    return mjds[order], pos[order]

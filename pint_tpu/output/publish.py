@@ -28,6 +28,13 @@ def _fmt_uncertainty(value: float, err: Optional[float]) -> str:
     return f"{value:.{digits}f}({scaled_err})"
 
 
+def publish_param(param) -> str:
+    """One LaTeX table row for a parameter (reference
+    ``output/publish.py:25``)."""
+    label, value = param.as_latex()
+    return f"{label}\\dotfill &  {value} \\\\ \n"
+
+
 def publish(model, toas=None, fitter=None, include_dmx: bool = False,
             include_noise: bool = True) -> str:
     """Return a LaTeX table summarizing the timing solution

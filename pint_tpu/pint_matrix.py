@@ -22,6 +22,9 @@ __all__ = [
     "CovarianceMatrix",
     "CorrelationMatrix",
     "DesignMatrixMaker",
+    "PhaseDesignMatrixMaker",
+    "TOADesignMatrixMaker",
+    "NoiseDesignMatrixMaker",
     "CovarianceMatrixMaker",
     "combine_design_matrices_by_quantity",
     "combine_design_matrices_by_param",
@@ -230,6 +233,30 @@ class DesignMatrixMaker:
             return DesignMatrix(Mn, [{q: (0, Mn.shape[0], self.quantity_unit)},
                                      labels])
         raise ValueError(f"Unknown derivative quantity {q!r}")
+
+
+class PhaseDesignMatrixMaker(DesignMatrixMaker):
+    """Phase-quantity maker (reference ``pint_matrix.py:423``)."""
+
+    def __init__(self, derivative_quantity: str = "phase",
+                 quantity_unit: str = ""):
+        super().__init__(derivative_quantity, quantity_unit)
+
+
+class TOADesignMatrixMaker(DesignMatrixMaker):
+    """TOA-quantity maker (reference ``pint_matrix.py:482``)."""
+
+    def __init__(self, derivative_quantity: str = "toa",
+                 quantity_unit: str = "s"):
+        super().__init__(derivative_quantity, quantity_unit)
+
+
+class NoiseDesignMatrixMaker(DesignMatrixMaker):
+    """GP noise-basis maker (reference ``pint_matrix.py:504``)."""
+
+    def __init__(self, derivative_quantity: str = "toa_noise",
+                 quantity_unit: str = "s"):
+        super().__init__(derivative_quantity, quantity_unit)
 
 
 class CovarianceMatrixMaker:

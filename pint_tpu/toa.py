@@ -39,6 +39,76 @@ C_KM_S = C_M_S / 1e3
 DAY_S = 86400.0
 
 
+import re as _re
+from collections.abc import MutableMapping
+
+
+class FlagDict(MutableMapping):
+    """Validated per-TOA flag mapping (reference ``toa.py:932``): string
+    keys (stored lowercase, no leading ``-``), single-token string values;
+    setting an empty value deletes the flag.  Plain dicts remain accepted
+    everywhere flags flow — this class is the validating container for
+    user-constructed TOAs."""
+
+    _key_re = _re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+
+    def __init__(self, *args, **kwargs):
+        self.store = {}
+        self.update(dict(*args, **kwargs))
+
+    @staticmethod
+    def from_dict(d: dict) -> "FlagDict":
+        r = FlagDict()
+        r.update(d)
+        return r
+
+    @staticmethod
+    def check_allowed_key(k) -> None:
+        if not isinstance(k, str):
+            raise ValueError(f"flag {k!r} must be a string")
+        if k.startswith("-"):
+            raise ValueError("flags should be stored without their leading -")
+        if not FlagDict._key_re.match(k):
+            raise ValueError(f"flag {k!r} is not a valid flag name")
+
+    @staticmethod
+    def check_allowed_value(k, v) -> None:
+        if not isinstance(v, str):
+            raise ValueError(f"value {v!r} for flag {k} must be a string")
+        if v and len(v.split()) != 1:
+            raise ValueError(
+                f"value {v!r} for flag {k} cannot contain whitespace")
+
+    def __setitem__(self, key, val):
+        self.check_allowed_key(key)
+        self.check_allowed_value(key, val)
+        if val:
+            self.store[key.lower()] = val
+        else:
+            self.store.pop(key.lower(), None)
+
+    def __delitem__(self, key):
+        del self.store[key.lower()]
+
+    def __getitem__(self, key):
+        return self.store[key.lower()]
+
+    def __iter__(self):
+        return iter(self.store)
+
+    def __len__(self):
+        return len(self.store)
+
+    def __repr__(self):
+        return f"FlagDict({self.store!r})"
+
+    def __str__(self):
+        return str(self.store)
+
+    def copy(self) -> "FlagDict":
+        return FlagDict.from_dict(self.store)
+
+
 class TOABatch(NamedTuple):
     """Frozen device-side TOA data (a JAX pytree of arrays).
 

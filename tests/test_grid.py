@@ -226,6 +226,7 @@ class TestGridExecutableReuse:
         g1 = np.array([f.model.F1.value])
         grid_chisq(f, ("F0", "F1"), (g0, g1), niter=8)  # seed the cache
 
+        efac_save = f.model.EFAC1.value
         f.model.EFAC1.value = 1.7  # rescales w and therefore s_col
         chi2_grid, ex = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=8,
                                    extraparnames=("DM",))
@@ -242,6 +243,8 @@ class TestGridExecutableReuse:
             # allow 1e-3 — a stale s_col would miss by the ~1.7x rescale
             assert ex["DM"][i, 0] == pytest.approx(
                 float(ff.model.DM.value), rel=1e-3)
+        # the fixture is module-scoped: restore the mutated noise param
+        f.model.EFAC1.value = efac_save
 
 
 class TestLinearColumnClassification:

@@ -1,0 +1,122 @@
+"""pulsar_mjd compat module: exact string/MJD splits, day_frac, the
+leap-second day convention (reference ``pulsar_mjd.py`` and its
+``tests/test_precision.py`` round-trip strategy)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from pint_tpu.pulsar_mjd import (DJM0, data2longdouble, day_frac,
+                                 jds_to_mjds, jds_to_mjds_pulsar,
+                                 longdouble2str, mjds_to_jds,
+                                 mjds_to_jds_pulsar, mjds_to_str,
+                                 safe_kind_conversion, split, str2longdouble,
+                                 str_to_mjds, time_from_mjd_string,
+                                 time_to_mjd_string, two_product, two_sum)
+
+
+class TestErrorFreeTransforms:
+    @given(st.floats(-1e15, 1e15), st.floats(-1e15, 1e15))
+    @settings(max_examples=200)
+    def test_two_sum_exact(self, a, b):
+        s, e = two_sum(a, b)
+        # the pair reproduces the exact sum at extended precision
+        assert np.longdouble(s) + np.longdouble(e) == \
+            np.longdouble(a) + np.longdouble(b)
+
+    @given(st.floats(-1e8, 1e8), st.floats(-1e8, 1e8))
+    @settings(max_examples=200)
+    def test_two_product_exact(self, a, b):
+        p, e = two_product(a, b)
+        assert np.longdouble(p) + np.longdouble(e) == pytest.approx(
+            np.longdouble(a) * np.longdouble(b), rel=1e-30, abs=1e-30)
+
+    def test_split_reassembles(self):
+        hi, lo = split(0.1)
+        assert hi + lo == 0.1
+
+
+class TestDayFrac:
+    @given(st.integers(40000, 70000), st.floats(0, 1, exclude_max=True))
+    @settings(max_examples=200)
+    def test_day_frac_splits(self, i, f):
+        day, frac = day_frac(float(i), f)
+        assert day == np.round(day)
+        assert abs(frac) <= 0.5
+        assert day + frac == pytest.approx(i + f, abs=1e-9)
+
+    def test_day_frac_divisor(self):
+        day, frac = day_frac(86400.0 * 3 + 43200.0, 0.0, divisor=86400.0)
+        assert (day, frac) in ((3.0, 0.5), (4.0, -0.5))
+
+
+class TestStrMjds:
+    @given(st.integers(40000, 70000), st.integers(0, 10**16 - 1))
+    @settings(max_examples=200)
+    def test_str_round_trip_exact(self, i, fdigits):
+        s = f"{i}.{fdigits:016d}"
+        imjd, fmjd = str_to_mjds(s)
+        assert imjd == i
+        # parse -> print -> parse is a fixed point (a float64 frac holds
+        # ~15.9 digits, so the PRINTED 16th digit may round — the same
+        # fidelity as the reference's float64 fmjd)
+        s2 = mjds_to_str(imjd, fmjd)
+        assert str_to_mjds(s2) == (imjd, fmjd)
+        assert abs(float(s2) - float(s)) < 1e-15 * i
+
+    def test_str_to_mjds_array(self):
+        i, f = str_to_mjds(np.array(["55000.5", "56000.25"]))
+        np.testing.assert_array_equal(i, [55000, 56000])
+        np.testing.assert_allclose(f, [0.5, 0.25], rtol=0)
+
+    def test_fortran_exponent(self):
+        assert str2longdouble("1.5d2") == np.longdouble(150.0)
+        assert data2longdouble("1.5D2") == np.longdouble(150.0)
+        assert data2longdouble(1.5) == np.longdouble(1.5)
+        assert "1.5" in longdouble2str(np.longdouble(1.5))
+
+    def test_time_string_interop(self):
+        jd1, jd2 = time_from_mjd_string("55000.1875")
+        assert jd1 == 55000.0 + DJM0
+
+        class T:
+            pass
+
+        t = T()
+        t.jd1, t.jd2 = jd1, jd2
+        assert time_to_mjd_string(t) == "55000.1875000000000000"
+
+
+class TestJdMjd:
+    def test_plain_round_trip(self):
+        j1, j2 = mjds_to_jds(55000.0, 0.25)
+        m1, m2 = jds_to_mjds(j1, j2)
+        assert m1 + m2 == pytest.approx(55000.25, abs=1e-12)
+
+    def test_pulsar_convention_normal_day(self):
+        # no leap second at MJD 55000: conventions agree
+        j1, j2 = mjds_to_jds_pulsar(55000.0, 0.25)
+        assert (j1, j2) == (55000.0 + DJM0, 0.25)
+        d, f = jds_to_mjds_pulsar(j1, j2)
+        assert (d, f) == (55000.0, 0.25)
+
+    def test_pulsar_convention_leap_day(self):
+        # 2008-12-31 = MJD 54831 ended with a leap second (TAI-UTC 33->34)
+        leap_mjd = 54831.0
+        j1, j2 = mjds_to_jds_pulsar(leap_mjd, 0.5)
+        # half a pulsar day = 43200 s of an 86401-s real day
+        assert j2 == pytest.approx(43200.0 / 86401.0, rel=1e-15)
+        d, f = jds_to_mjds_pulsar(j1, j2)
+        assert d == leap_mjd
+        assert f == pytest.approx(0.5, rel=1e-12)
+
+    def test_leap_second_instant_raises(self):
+        # 86400.5 s into the real (86401 s) day = inside the leap second
+        with pytest.raises(ValueError):
+            jds_to_mjds_pulsar(54831.0 + DJM0, 86400.5 / 86401.0)
+
+    def test_safe_kind_conversion(self):
+        out = safe_kind_conversion([1, 2, 3], np.float64)
+        assert out.dtype == np.float64
+        assert safe_kind_conversion(5, np.float64) == 5.0
