@@ -16,7 +16,8 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["main", "read_gaussfitfile", "marginalize_over_phase",
-           "get_fit_keyvals"]
+           "get_fit_keyvals", "gaussian_profile", "measure_phase",
+           "profile_likelihood", "neg_prof_like", "load_events_weights"]
 
 from pint_tpu.event_fitter import marginalize_over_phase  # re-export parity
 
@@ -159,3 +160,100 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(main())
+
+
+# ---------------------------------------------------------------------------
+# reference helper surface (scripts/event_optimize.py:81,119,137,152,314)
+# ---------------------------------------------------------------------------
+
+def gaussian_profile(N: int, phase: float, fwhm: float) -> np.ndarray:
+    """N-bin wrapped-gaussian pulse profile with unit integrated flux
+    (reference ``event_optimize.py:81``)."""
+    sigma = fwhm / 2.35482
+    mean = phase % 1.0
+    phss = np.arange(N, dtype=np.float64) / N - mean
+    # wrap into [-0.5, 0.5) so the pulse is continuous across phase 0
+    phss += np.where(phss < -0.5, 1.0, 0.0)
+    phss -= np.where(phss > 0.5, 1.0, 0.0)
+    zs = np.abs(phss) / sigma
+    okzinds = zs < 20.0
+    template = np.zeros(N, dtype=np.float64)
+    template[okzinds] = np.exp(-0.5 * zs[okzinds] ** 2)
+    return template / template.sum()
+
+
+def measure_phase(profile, template, rotate_prof: bool = True):
+    """FFTFIT the profile against the template (reference
+    ``event_optimize.py:119``, which calls PRESTO's Fortran fftfit; here
+    the jnp.fft reimplementation in :mod:`pint_tpu.fftfit`).
+
+    Returns (shift, eshift, snr, esnr, b, errb, ngood) in the PRESTO
+    convention: shift in BINS of the profile.
+    """
+    from pint_tpu.fftfit import fftfit_full
+
+    profile = np.asarray(profile, dtype=np.float64)
+    template = np.asarray(template, dtype=np.float64)
+    shift_phase, eshift_phase, b, errb = fftfit_full(template, profile)
+    n = len(profile)
+    shift = shift_phase * n
+    if rotate_prof and shift > n / 2:
+        shift -= n
+    snr = b / errb if errb > 0 else np.inf
+    return (shift, eshift_phase * n, snr, 0.0, b, errb, n)
+
+
+def profile_likelihood(phs, *otherargs):
+    """ln-likelihood of a constant phase offset against a binned template
+    (Pletsch & Clark 2015 eq 2; reference ``event_optimize.py:137``)."""
+    xvals, phases, template, weights = otherargs
+    phss = (np.asarray(phases, dtype=np.float64)
+            + np.float64(phs)) % 1.0
+    probs = np.interp(phss, xvals, template, right=template[0])
+    if weights is None:
+        return float(np.log(probs).sum())
+    return float(np.log(weights * probs + 1.0 - weights).sum())
+
+
+def neg_prof_like(phs, *otherargs):
+    return -profile_likelihood(phs, *otherargs)
+
+
+def load_events_weights(eventfile, model, weightcol, wgtexp, minMJD, maxMJD,
+                        minWeight):
+    """Photon events file -> (TOAs, weights array) (reference
+    ``event_optimize.py:314``): FITS events via get_Fermi_TOAs (weights
+    from ``weightcol``, or 'CALC' to compute them from the model position),
+    or a TOA pickle.  Computed weights are rescaled by ``wgtexp`` as the
+    reference does."""
+    from pint_tpu import toa as toa_mod
+    from pint_tpu.fermi_toas import get_Fermi_TOAs
+
+    ts = None
+    if str(eventfile).endswith(("pickle", "pickle.gz")):
+        try:
+            ts = toa_mod.load_pickle(eventfile)
+            mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+            ts = ts[(mjds >= minMJD) & (mjds <= maxMJD)]
+        except IOError:
+            ts = None
+    if ts is None:
+        target = None
+        if weightcol == "CALC":
+            # the photon-weight estimator needs the source direction; our
+            # loader takes (ra_rad, dec_rad) from the model
+            target = (float(model.RAJ.value), float(model.DECJ.value)) \
+                if "AstrometryEquatorial" in model.components else None
+        ts = get_Fermi_TOAs(eventfile, weightcolumn=weightcol,
+                            targetcoord=target, minweight=minWeight,
+                            minmjd=minMJD, maxmjd=maxMJD,
+                            ephem=model.EPHEM.value,
+                            planets=bool(model.PLANET_SHAPIRO.value))
+    vals, valid = ts.get_flag_value("weight", as_type=float)
+    if len(valid) == len(ts):
+        weights = np.asarray(vals, dtype=np.float64)
+    else:
+        weights = np.ones(len(ts))
+    if weightcol == "CALC" and wgtexp > 0.0:
+        weights = weights ** wgtexp
+    return ts, weights
