@@ -108,6 +108,11 @@ class TestExamples:
         assert "recovered JUMP1" in out
         assert "fitted PHOFF" in out
 
+    def test_bayesian_wideband_walkthrough(self, capsys):
+        out = _run("bayesian_wideband.py", "--quick", capsys=capsys)
+        assert "wb_wls" in out
+        assert "wideband posterior consistent" in out
+
     def test_solar_wind_walkthrough(self, capsys):
         out = _run("solar_wind.py", capsys=capsys)
         assert "solar-wind delay" in out
