@@ -173,6 +173,95 @@ class Fitter:
             print(corr.prettyprint())
         return corr
 
+    # -- reference accessor long tail (fitter.py user API) -------------------
+    def get_allparams(self) -> dict:
+        """{name: value} for every parameter, free or frozen (reference
+        ``fitter.py get_allparams``)."""
+        return {p: getattr(self.model, p).value for p in self.model.params}
+
+    def get_fitparams_num(self) -> dict:
+        """{name: float value} for the free parameters (reference
+        ``fitter.py get_fitparams_num``)."""
+        return {p: float(getattr(self.model, p).value or 0.0)
+                for p in self.model.free_params}
+
+    def get_fitparams_uncertainty(self) -> dict:
+        """{name: uncertainty} for the free parameters (reference
+        ``fitter.py get_fitparams_uncertainty``)."""
+        return {p: getattr(self.model, p).uncertainty
+                for p in self.model.free_params}
+
+    def get_params_dict(self, which: str = "free",
+                        kind: str = "quantity") -> dict:
+        """Parameter mapping (reference ``fitter.py get_params_dict``):
+        ``which`` in free/all, ``kind`` in quantity/value/uncertainty."""
+        names = self.model.free_params if which == "free" else self.model.params
+        if kind in ("quantity", "value"):
+            return {p: getattr(self.model, p).value for p in names}
+        if kind == "uncertainty":
+            return {p: getattr(self.model, p).uncertainty for p in names}
+        raise ValueError(f"Unknown kind {kind!r}")
+
+    def set_params(self, fitp: dict) -> None:
+        """Set parameter values from a {name: value} mapping (reference
+        ``fitter.py set_params``)."""
+        for p, v in fitp.items():
+            getattr(self.model, p).value = v
+
+    set_fitparams = set_params
+
+    def set_param_uncertainties(self, fitp: dict) -> None:
+        """Set parameter uncertainties from a mapping (reference
+        ``fitter.py set_param_uncertainties``)."""
+        for p, v in fitp.items():
+            getattr(self.model, p).uncertainty = float(v)
+
+    @property
+    def covariance_matrix(self):
+        """The labeled post-fit parameter covariance (reference exposes
+        both spellings)."""
+        return self.parameter_covariance_matrix
+
+    def get_parameter_covariance_matrix(self, with_phase: bool = False):
+        """The labeled covariance, optionally including the Offset row
+        (reference ``fitter.py get_parameter_covariance_matrix``)."""
+        cov = self.parameter_covariance_matrix
+        if cov is None or with_phase:
+            return cov
+        names = [n for n in cov.get_label_names(axis=0) if n != "Offset"]
+        return cov.get_label_matrix(names)
+
+    def make_resids(self, model) -> Residuals:
+        """Residuals of THIS fitter's TOAs under an arbitrary model
+        (reference ``fitter.py make_resids``)."""
+        return Residuals(self.toas, model, track_mode=self.track_mode)
+
+    def reset_model(self) -> None:
+        """Forget the fit: restore the initial model and residuals
+        (reference ``fitter.py reset_model``)."""
+        self.model = copy.deepcopy(self.model_init)
+        self.converged = False
+        self.parameter_covariance_matrix = None
+        self.errors = {}
+        self.update_resids()
+
+    def plot(self):
+        """Plot residuals vs MJD with error bars (reference
+        ``fitter.py plot``; requires matplotlib)."""
+        import matplotlib.pyplot as plt
+
+        mjds = np.asarray(self.toas.get_mjds(), dtype=np.float64)
+        r = np.asarray(self.resids.time_resids) * 1e6
+        err = np.asarray(self.resids.get_data_error()) * 1e6
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        ax.errorbar(mjds, r, yerr=err, fmt="+")
+        ax.set_xlabel("MJD")
+        ax.set_ylabel("Residual (us)")
+        ax.set_title(getattr(self.model.PSR, "value", "") or "")
+        ax.grid(True)
+        plt.show()
+        return fig
+
     def ftest(self, other_chi2: float, other_dof: int):
         from pint_tpu.utils import FTest
 

@@ -100,6 +100,7 @@ class Parameter:
         self.uncertainty = uncertainty
         self.continuous = continuous
         self.value = value
+        self.use_alias = None  # output name override (use_aliases)
         self._component = None  # set by Component.add_param
         self._prior = None  # lazily defaults to the unbounded uniform
 
@@ -160,7 +161,7 @@ class Parameter:
                              f"not {format!r}")
         if self.value is None:
             return ""
-        name, value = self.name, self.value
+        name, value = self.use_alias or self.name, self.value
         if fmt != "pint":
             if name in self._PINT_ONLY:
                 return ""
@@ -209,6 +210,47 @@ class Parameter:
     @uncertainty_value.setter
     def uncertainty_value(self, v):
         self.uncertainty = v
+
+    #: can this parameter appear multiple times in a par file?
+    #: (mask/prefix subclasses override; reference ``parameter.py repeatable``)
+    repeatable = False
+
+    def add_alias(self, alias: str) -> None:
+        """Register an extra input alias (reference
+        ``parameter.py add_alias``)."""
+        if alias not in self.aliases:
+            self.aliases.append(alias)
+
+    def from_parfile_line(self, line: str) -> bool:
+        """Parse one par-file line into this parameter; returns False when
+        the key does not match (reference ``parameter.py
+        from_parfile_line``)."""
+        fields = line.split()
+        if not fields or not self.name_matches(fields[0]):
+            return False
+        self.from_parfile_fields(fields[1:])
+        return True
+
+    def set(self, value) -> None:
+        """Set the value from a string or number (reference
+        ``parameter.py Parameter.set``)."""
+        self.value = self.str2value(value) if isinstance(value, str) \
+            else value
+
+    def str_quantity(self, quantity) -> str:
+        """Reference spelling for :meth:`value2str`."""
+        return self.value2str(quantity)
+
+    def help_line(self) -> str:
+        """One-line help (reference ``parameter.py help_line``)."""
+        out = f"{self.name:<15} {self.description or ''}"
+        if self.units:
+            out += f" ({self.units})"
+        return out
+
+    def value_as_latex(self) -> str:
+        """The value half of :meth:`as_latex`."""
+        return self.as_latex()[1]
 
     def __repr__(self):
         fit = "" if self.frozen else " fit"
@@ -385,6 +427,8 @@ class maskParameter(floatParameter):
     ``select_toa_mask(toas)`` resolves to integer indices on the host; the
     jitted evaluator consumes the baked boolean array.
     """
+
+    repeatable = True
 
     def __init__(self, name: str, index: int = 1, key: Optional[str] = None,
                  key_value: Optional[list] = None, **kw):

@@ -154,6 +154,92 @@ class Component:
                 out[int(name[len(prefix):])] = name
         return dict(sorted(out.items()))
 
+    # -- reference user-API long tail (timing_model.py Component) -----------
+    @property
+    def aliases_map(self) -> Dict[str, str]:
+        """{alias or name: parameter name} for this component (reference
+        ``timing_model.py aliases_map``)."""
+        out: Dict[str, str] = {}
+        for name, p in self._params_dict.items():
+            out[name] = name
+            for a in p.aliases:
+                out[a] = name
+        return out
+
+    def match_param_aliases(self, alias: str) -> str:
+        """Resolve an alias to this component's parameter name; raises
+        UnknownParameter when nothing matches (reference
+        ``timing_model.py match_param_aliases``; the lenient
+        None-returning form is :meth:`match_param_alias`)."""
+        hit = self.match_param_alias(alias)
+        if hit is None:
+            raise UnknownParameter(
+                f"{alias!r} is not a parameter or alias of "
+                f"{type(self).__name__}")
+        return hit
+
+    def get_params_of_type(self, param_type: str) -> List[str]:
+        """Parameter names whose class matches ``param_type`` (e.g.
+        'floatParameter', 'maskParameter'; reference
+        ``timing_model.py get_params_of_type``)."""
+        want = param_type.lower()
+        return [n for n, p in self._params_dict.items()
+                if type(p).__name__.lower() == want]
+
+    @property
+    def param_prefixs(self) -> Dict[str, List[str]]:
+        """{prefix: [parameter names]} for prefixed families (reference
+        spelling ``param_prefixs``)."""
+        out: Dict[str, List[str]] = {}
+        for n, p in self._params_dict.items():
+            pre = getattr(p, "prefix", None)
+            if pre:
+                out.setdefault(pre, []).append(n)
+        return out
+
+    def is_in_parfile(self, parfile_dict) -> bool:
+        """True when the parsed par-file keys select this component
+        (reference ``timing_model.py is_in_parfile``)."""
+        keys = {str(k).upper() for k in parfile_dict}
+        amap = {a.upper() for a in self.aliases_map}
+        return bool(keys & amap)
+
+    def param_help(self) -> str:
+        """Help text for this component's parameters."""
+        lines = [f"Component {type(self).__name__}:"]
+        for n in self.params:
+            p = self._params_dict[n]
+            lines.append(f"  {n:<15} {p.units or '':<12} "
+                         f"{p.description or ''}")
+        return "\n".join(lines) + "\n"
+
+    def print_par(self, format: str = "pint") -> str:
+        """Par-file lines for this component's set parameters (reference
+        ``timing_model.py print_par``)."""
+        return "".join(self._params_dict[n].as_parfile_line(format=format)
+                       for n in self.params)
+
+    def register_deriv_funcs(self, func, param: str) -> None:
+        """Accepted for reference compatibility and intentionally inert:
+        design-matrix columns come from jax.jacfwd of the phase/delay
+        functions, so a hand-registered derivative is superseded by
+        autodiff of the same quantity (reference
+        ``timing_model.py register_deriv_funcs``)."""
+        log.debug(f"register_deriv_funcs({param}): ignored — derivatives "
+                  "come from autodiff in this framework")
+
+    def set_special_params(self, spec_params: List) -> None:
+        """Add dynamically-created parameters (mask/prefix family members)
+        to this component (reference ``timing_model.py set_special_params``)."""
+        for p in spec_params:
+            if p.name not in self.params:
+                self.add_param(p)
+
+    def validate_toas(self, toas) -> None:
+        """Hook: raise when the TOAs lack data this component needs
+        (reference ``timing_model.py validate_toas``); default is no
+        requirement."""
+
     # -- host-side evaluation context ---------------------------------------
     def build_context(self, toas) -> dict:
         """Precompute static per-TOAs data (masks, selections) for the trace."""
@@ -1293,6 +1379,275 @@ class TimingModel:
         for c in new.components.values():
             c._parent = new
         return new
+
+    # ------------------------------------------------------------------
+    # reference user-API long tail (timing_model.py:1276-2860)
+    # ------------------------------------------------------------------
+    def map_component(self, component) -> Tuple[Component, int, list, str]:
+        """(component, order index, host list, kind) for a component name or
+        instance (reference ``timing_model.py:1276``)."""
+        comp = self.components[component] if isinstance(component, str) \
+            else component
+        if comp not in self.components.values():
+            raise AttributeError(f"{comp} is not in the model")
+        kind = getattr(comp, "kind", "")
+        if kind == "delay":
+            host = self.delay_components
+        elif kind == "phase":
+            host = self.phase_components
+        elif kind == "noise":
+            host = self.noise_components
+        else:
+            host = [c for c in self.components.values()
+                    if getattr(c, "kind", "") == kind]
+        return comp, host.index(comp), host, kind
+
+    def get_component_type(self, component_type: str) -> list:
+        """Components of the named kind ('DelayComponent'/'PhaseComponent'/
+        'NoiseComponent', reference ``timing_model.py get_component_type``)."""
+        kind = {"delaycomponent": "delay", "phasecomponent": "phase",
+                "noisecomponent": "noise"}.get(
+                    component_type.lower().replace("_", ""),
+                    component_type.lower())
+        return [c for c in self.components.values()
+                if getattr(c, "kind", "") == kind]
+
+    def get_components_by_category(self) -> Dict[str, list]:
+        """{category: [components]} (reference
+        ``timing_model.py get_components_by_category``)."""
+        out: Dict[str, list] = {}
+        for c in self.components.values():
+            out.setdefault(c.category, []).append(c)
+        return out
+
+    def get_params_of_component_type(self, component_type: str) -> List[str]:
+        """All parameter names on components of the given kind (reference
+        ``timing_model.py get_params_of_component_type``)."""
+        out: List[str] = []
+        for c in self.get_component_type(component_type):
+            out += c.params
+        return out
+
+    def search_cmp_attr(self, name: str):
+        """First component carrying attribute ``name`` (reference
+        ``timing_model.py search_cmp_attr``); None when absent."""
+        for c in self.components.values():
+            try:
+                getattr(c, name)
+                return c
+            except AttributeError:
+                continue
+        return None
+
+    @property
+    def has_time_correlated_errors(self) -> bool:
+        """True when a basis-noise (ECORR / red / DM / chromatic GP)
+        component is present (reference ``timing_model.py:345``)."""
+        return any(hasattr(c, "basis_weight_pair") or
+                   hasattr(c, "ecorr_basis_weight_pair") or
+                   hasattr(c, "pl_basis_weight_pair") or
+                   getattr(c, "is_basis_noise", False)
+                   for c in self.noise_components) \
+            or self.has_correlated_errors
+
+    def add_param_from_top(self, param, target_component: str,
+                           setup: bool = False):
+        """Add a parameter to the named component ('' = top level;
+        reference ``timing_model.py add_param_from_top``)."""
+        if target_component == "":
+            self._top_params_dict[param.name] = param
+            self.top_level_params.append(param.name)
+            return param
+        if target_component not in self.components:
+            raise AttributeError(
+                f"Cannot find component {target_component!r} in the model")
+        return self.components[target_component].add_param(param, setup=setup)
+
+    def remove_param(self, param: str) -> None:
+        """Remove a parameter from whichever component hosts it (reference
+        ``timing_model.py remove_param``)."""
+        if param in self._top_params_dict:
+            del self._top_params_dict[param]
+            self.top_level_params.remove(param)
+            return
+        for c in self.components.values():
+            if param in c.params:
+                c.remove_param(param)
+                self._cache.clear()
+                return
+        raise AttributeError(f"Parameter {param!r} is not in the model")
+
+    def validate_component_types(self) -> None:
+        """Sanity-check the component graph: every component has a known
+        kind and a registered category slot (reference
+        ``timing_model.py validate_component_types``)."""
+        for name, c in self.components.items():
+            kind = getattr(c, "kind", None)
+            if kind not in ("delay", "phase", "noise", "tzr"):
+                raise TimingModelError(
+                    f"Component {name} has unknown kind {kind!r}")
+            if not isinstance(c.category, str) or not c.category:
+                raise TimingModelError(
+                    f"Component {name} has no category")
+
+    def param_help(self) -> str:
+        """Description of every parameter (reference
+        ``timing_model.py param_help``)."""
+        lines = []
+        for p in self.params:
+            par = getattr(self, p)
+            lines.append(f"{p:<15} {par.units or '':<12} "
+                         f"{par.description or ''}")
+        return "\n".join(lines) + "\n"
+
+    def use_aliases(self, reset_to_default: bool = True,
+                    alias_translation: Optional[Dict[str, str]] = None):
+        """Control the name each parameter is written under (reference
+        ``timing_model.py:2833``): reset to canonical names and/or install
+        an output-name translation (e.g. tempo2 spellings)."""
+        for p in self.params:
+            par = getattr(self, p)
+            if reset_to_default:
+                par.use_alias = None
+            if alias_translation is not None and p in alias_translation:
+                par.use_alias = alias_translation[p]
+
+    def as_ICRS(self, epoch=None) -> "TimingModel":
+        """Equatorial-astrometry version of this model (reference
+        ``timing_model.py as_ICRS``)."""
+        from pint_tpu.modelutils import model_ecliptic_to_equatorial
+
+        import copy as _copy
+
+        m = _copy.deepcopy(self)
+        if epoch is not None:
+            m.change_posepoch(float(epoch))
+        if "AstrometryEcliptic" in m.components:
+            m = model_ecliptic_to_equatorial(m)
+        return m
+
+    def as_ECL(self, epoch=None, ecl: str = "IERS2010") -> "TimingModel":
+        """Ecliptic-astrometry version of this model (reference
+        ``timing_model.py as_ECL``)."""
+        from pint_tpu.modelutils import model_equatorial_to_ecliptic
+
+        import copy as _copy
+
+        m = _copy.deepcopy(self)
+        if epoch is not None:
+            m.change_posepoch(float(epoch))
+        if "AstrometryEquatorial" in m.components:
+            m = model_equatorial_to_ecliptic(m)
+        if m.ECL.value is None:
+            m.ECL.value = ecl
+        return m
+
+    def d_delay_d_param(self, toas, param: str, acc_delay=None) -> np.ndarray:
+        """d(total delay)/d(param) [s/unit] by autodiff of the delay
+        accumulation (reference ``timing_model.py d_delay_d_param`` — hand
+        partials there, jacfwd here)."""
+        self._get_compiled(toas, tuple(self.free_params))
+        entry = self._cache["data"][toas]
+        batch, ctx = entry[1], entry[2]
+        const_pv = self._const_pv()
+        comps = self.delay_components
+        names = [type(c).__name__ for c in comps]
+        v0 = float(getattr(self, param).value or 0.0)
+
+        def total_delay(v):
+            pv = dict(const_pv)
+            pv[param] = v
+            acc = jnp.zeros(batch.ntoas)
+            for nm, comp in zip(names, comps):
+                acc = acc + comp.delay_func(pv, batch, ctx[nm], acc)
+            return acc
+
+        return np.asarray(jax.jacfwd(total_delay)(jnp.float64(v0)))
+
+    def d_delay_d_param_num(self, toas, param: str,
+                            step: float = 1e-2) -> np.ndarray:
+        """Finite-difference delay derivative (reference
+        ``timing_model.py:2111``)."""
+        par = getattr(self, param)
+        v0 = float(par.value or 0.0)
+        h = abs(v0) * step if v0 != 0 else step
+        out = []
+        # parameter values flow into the compiled functions as arguments
+        # (_const_pv / free vector), so no cache invalidation is needed for
+        # a pure value perturbation
+        for v in (v0 + h, v0 - h):
+            par.value = v
+            out.append(self.delay(toas))
+        par.value = v0
+        return (out[0] - out[1]) / (2 * h)
+
+    def d_toasigma_d_param(self, toas, param: str) -> np.ndarray:
+        """d(scaled TOA sigma)/d(param) for noise parameters (reference
+        ``timing_model.py d_toasigma_d_param``), by central difference on
+        the host-side sigma scaling."""
+        par = getattr(self, param)
+        v0 = float(par.value or 0.0)
+        h = max(abs(v0) * 1e-6, 1e-9)
+        out = []
+        for v in (v0 + h, v0 - h):
+            par.value = v
+            out.append(self.scaled_toa_uncertainty(toas))
+        par.value = v0
+        return (out[0] - out[1]) / (2 * h)
+
+    def dm_covariance_matrix(self, toas) -> np.ndarray:
+        """Wideband DM-data covariance (diagonal of scaled DM errors
+        squared; reference ``timing_model.py dm_covariance_matrix``)."""
+        sigma = self.scaled_dm_uncertainty(toas)
+        return np.diag(np.asarray(sigma) ** 2)
+
+    def jump_flags_to_params(self, toas) -> None:
+        """Convert -jump/-gui_jump flags on the TOAs into JUMP parameters
+        (reference ``timing_model.py jump_flags_to_params``, the inverse of
+        ``delete_jump_and_flags``)."""
+        from pint_tpu.models.jump import PhaseJump
+        from pint_tpu.models.parameter import maskParameter
+
+        idxs = set()
+        for fl in toas.flags:
+            for key in ("jump", "gui_jump"):
+                if key in fl:
+                    idxs.add(int(float(fl[key])))
+        if not idxs:
+            return
+        if "PhaseJump" not in self.components:
+            self.add_component(PhaseJump(), validate=False)
+        comp = self.components["PhaseJump"]
+        for i in sorted(idxs):
+            # normalize flags FIRST (also for pre-existing JUMP<i> params,
+            # and for float-spelled flags like '3.0'), so the -jump mask
+            # matches every TOA in the group
+            for fl in toas.flags:
+                for key in ("jump", "gui_jump"):
+                    if key in fl and int(float(fl[key])) == i:
+                        fl["jump"] = str(i)
+            # an equivalent jump may already exist under ANY index (par
+            # files number JUMPs independently of the flag value): match by
+            # mask, not by name, or a degenerate duplicate gets created
+            existing = any(
+                getattr(self._top_or_comp_param(p), "key", "").lstrip("-")
+                == "jump"
+                and getattr(self._top_or_comp_param(p), "key_value", None)
+                == [str(i)]
+                for p in self.params if p.startswith("JUMP"))
+            if existing:
+                continue
+            comp.add_param(maskParameter("JUMP", index=i, key="-jump",
+                                         key_value=[str(i)], units="s",
+                                         value=0.0, frozen=False),
+                           setup=True)
+        self.setup()
+
+    def _top_or_comp_param(self, name: str):
+        try:
+            return getattr(self, name)
+        except AttributeError:
+            return None
 
 
 # ---------------------------------------------------------------------------

@@ -238,6 +238,42 @@ class Polycos:
     #: reference-parity alias (``polycos.py:549``)
     read = read_polyco_file
 
+    #: registered file formats: {name: {"read": fn, "write": fn}}
+    polycoFormats: dict = {"tempo": {"read": None, "write": None}}
+
+    @classmethod
+    def add_polyco_file_format(cls, formatName: str, methodMood: str,
+                               readMethod=None, writeMethod=None) -> None:
+        """Register a custom polyco file format (reference
+        ``polycos.py:567``): ``methodMood`` in 'r'/'w'/'rw'; the read
+        method takes a filename and returns a list of PolycoEntry, the
+        write method takes (entries, filename)."""
+        if methodMood not in ("r", "w", "rw"):
+            raise ValueError("methodMood must be 'r', 'w', or 'rw'")
+        if "r" in methodMood and readMethod is None:
+            raise ValueError(f"format {formatName!r}: mood {methodMood!r} "
+                             "needs a readMethod")
+        if "w" in methodMood and writeMethod is None:
+            raise ValueError(f"format {formatName!r}: mood {methodMood!r} "
+                             "needs a writeMethod")
+        entry = cls.polycoFormats.setdefault(
+            formatName, {"read": None, "write": None})
+        if readMethod is not None:
+            entry["read"] = readMethod
+        if writeMethod is not None:
+            entry["write"] = writeMethod
+
+    @classmethod
+    def read_polyco_file_format(cls, filename: str,
+                                format: str = "tempo") -> "Polycos":
+        """Read using a registered format (defaults to TEMPO)."""
+        if format == "tempo":
+            return cls.read_polyco_file(filename)
+        fmt = cls.polycoFormats.get(format)
+        if fmt is None or fmt["read"] is None:
+            raise ValueError(f"No registered reader for format {format!r}")
+        return cls(fmt["read"](filename))
+
 
 def tempo_polyco_table_writer(entries: List[PolycoEntry], filename: str):
     """TEMPO polyco.dat format (reference ``polycos.py:360``)."""

@@ -170,6 +170,98 @@ class Residuals:
             r = r - sum(nr.values())
         return r / self.get_data_error()
 
+    # -- reference user-API long tail ---------------------------------------
+    def calc_phase_mean(self, weighted: bool = True) -> float:
+        """Mean residual phase in cycles, optionally weighted (reference
+        ``residuals.py:468``)."""
+        r = self.phase_resids
+        if not weighted:
+            return float(np.mean(r))
+        err = self.toas.get_errors()
+        if np.any(err == 0):
+            return float(np.mean(r))
+        w = 1.0 / (err * err)
+        mean, _ = weighted_mean(r, w)
+        return float(mean)
+
+    def calc_time_mean(self, calctype: str = "taylor",
+                       weighted: bool = True) -> float:
+        """Mean residual time [s] (reference ``residuals.py:481``)."""
+        r = self.phase_resids / self.get_PSR_freq(calctype)
+        if not weighted:
+            return float(np.mean(r))
+        err = self.toas.get_errors()
+        if np.any(err == 0):
+            return float(np.mean(r))
+        w = 1.0 / (err * err)
+        mean, _ = weighted_mean(r, w)
+        return float(mean)
+
+    def get_PSR_freq(self, calctype: str = "modelF0") -> np.ndarray:
+        """Spin frequency [Hz]: the model F0 ('modelF0') or the spindown
+        Taylor series evaluated at each TOA ('taylor'/'numerical';
+        reference ``residuals.py:283``)."""
+        calctype = calctype.lower()
+        if calctype not in ("modelf0", "taylor", "numerical"):
+            raise ValueError(f"Unknown calctype {calctype!r}")
+        F0 = float(self.model.F0.value)
+        if calctype == "modelf0":
+            return F0
+        # Taylor series around PEPOCH at the barycentered emission times
+        sd = self.model.components.get("Spindown")
+        if sd is None:
+            return F0
+        terms = [float(getattr(self.model, f"F{i}").value or 0.0)
+                 for i in range(sd.num_spin_terms)]
+        tdb = np.asarray(self.toas.tdb, dtype=np.float64)
+        dt = (tdb - float(self.model.PEPOCH.value)) * 86400.0 \
+            - np.asarray(self.model.delay(self.toas))
+        freq = np.zeros_like(dt)
+        # d(phase)/dt = sum F_i dt^i / i!
+        fact = 1.0
+        for i, f in enumerate(terms):
+            if i > 0:
+                fact *= i
+            freq = freq + f * dt**i / fact
+        return freq
+
+    @property
+    def resids_value(self) -> np.ndarray:
+        """Time residuals as a bare float array [s] (reference
+        ``resids_value``)."""
+        return np.asarray(self.time_resids, dtype=np.float64)
+
+    def d_lnlikelihood_d_param(self, param: str,
+                               step: Optional[float] = None) -> float:
+        """d(lnlikelihood)/d(param) by central difference (reference
+        computes analytic gradients for noise parameters,
+        ``residuals.py:735-826``; the ML noise fitter in
+        ``pint_tpu.noisefit`` uses jax autodiff for the same thing — this
+        scalar hook exists for API parity and spot checks).
+
+        The step defaults to 1e-3 of the parameter's uncertainty when one
+        is set — timing parameters like F0 have |value|/sigma ~ 1e14, so
+        any value-scaled step would leave the likelihood's linear
+        regime."""
+        par = getattr(self.model, param)
+        v0 = float(par.value or 0.0)
+        if step is None:
+            sig = float(par.uncertainty or 0.0)
+            h = 1e-3 * sig if sig > 0 else max(abs(v0) * 1e-6, 1e-6)
+        else:
+            h = max(abs(v0) * step, step)
+        # a step below one float64 ulp of the value perturbs nothing
+        h = max(h, 8.0 * np.spacing(abs(v0)))
+        vals = []
+        # values flow into the compiled evaluators as arguments; no cache
+        # invalidation needed for a pure value perturbation
+        for v in (v0 + h, v0 - h):
+            par.value = v
+            r = Residuals(self.toas, self.model, track_mode=self.track_mode)
+            vals.append(r.lnlikelihood())
+        par.value = v0
+        return (vals[0] - vals[1]) / (2 * h)
+
     def lnlikelihood(self) -> float:
         """Gaussian log-likelihood including the noise log-determinant
         (reference ``residuals.py:730``)."""

@@ -484,6 +484,206 @@ class TOAs:
         return float(np.max(self.get_mjds()))
 
     # ------------------------------------------------------------------
+    # reference user-API long tail (toa.py:1856-2100)
+    # ------------------------------------------------------------------
+    @property
+    def observatories(self) -> set:
+        """Set of observatory names present (reference ``toa.py
+        observatories``)."""
+        return set(str(o) for o in self.obs)
+
+    def get_Tspan(self) -> float:
+        """Total span of the TOAs in days (reference ``get_Tspan``)."""
+        m = np.asarray(self.get_mjds(), dtype=np.float64)
+        return float(m.max() - m.min()) if len(m) else 0.0
+
+    def get_all_flags(self) -> list:
+        """Sorted list of every flag name used (reference
+        ``get_all_flags``)."""
+        names: set = set()
+        for fl in self.flags:
+            names |= set(fl)
+        return sorted(names)
+
+    def get_flags(self) -> list:
+        """The per-TOA flag dictionaries (reference ``get_flags`` returns
+        the flags column)."""
+        return self.flags
+
+    def get_obs_groups(self):
+        """Iterate (observatory name, index array) groups (reference
+        ``get_obs_groups``)."""
+        obs = np.asarray([str(o) for o in self.obs])
+        for name in sorted(set(obs)):
+            yield name, np.nonzero(obs == name)[0]
+
+    def get_highest_density_range(self, ndays: float = 7.0):
+        """(start, end) MJD of the ``ndays``-wide window holding the most
+        TOAs (reference ``get_highest_density_range``)."""
+        m = np.sort(np.asarray(self.get_mjds(), dtype=np.float64))
+        if not len(m):
+            raise ValueError("no TOAs")
+        counts = np.searchsorted(m, m + float(ndays), side="right") \
+            - np.arange(len(m))
+        i = int(np.argmax(counts))
+        return m[i], m[i] + float(ndays)
+
+    def is_wideband(self) -> bool:
+        """True when every TOA carries wideband DM info (reference
+        ``is_wideband``; also available as the ``wideband`` property)."""
+        return self.wideband
+
+    def get_summary(self) -> str:
+        """Short ASCII summary (reference ``toa.py:1931``)."""
+        s = f"Number of TOAs:  {len(self)}\n"
+        s += f"Number of commands:  {len(self.commands)}\n"
+        s += (f"Number of observatories: {len(self.observatories)} "
+              f"{sorted(self.observatories)}\n")
+        if len(self):
+            s += (f"MJD span:  {self.first_MJD():.3f} to "
+                  f"{self.last_MJD():.3f}\n")
+        err = np.asarray(self.error_us, dtype=np.float64)
+        freq = np.asarray(self.freq_mhz, dtype=np.float64)
+        for obs, grp in self.get_obs_groups():
+            s += f"{obs} TOAs ({len(grp)}):\n"
+            s += f"  Min freq:      {np.min(freq[grp]):.3f} MHz\n"
+            s += f"  Max freq:      {np.max(freq[grp]):.3f} MHz\n"
+            s += f"  Min error:     {np.min(err[grp]):.3g} us\n"
+            s += f"  Max error:     {np.max(err[grp]):.3g} us\n"
+            s += f"  Median error:  {np.median(err[grp]):.3g} us\n"
+        return s
+
+    def print_summary(self) -> None:
+        """Print :meth:`get_summary` (reference ``toa.py:1954``)."""
+        print(self.get_summary())
+
+    def phase_columns_from_flags(self) -> None:
+        """Populate pulse_number/delta_pulse_number from -pn/-padd flags
+        (reference ``toa.py:1959``); raises when no TOA carries -pn."""
+        pn, valid = self.get_flag_value("pn", as_type=float)
+        if not valid:
+            raise ValueError("No pulse number flags (-pn) found in the TOAs")
+        col = np.full(len(self), np.nan)
+        for i in valid:
+            col[i] = pn[i]
+        self.pulse_number = col
+        for fl in self.flags:
+            fl.pop("pn", None)
+        padd, pvalid = self.get_flag_value("padd", as_type=float)
+        if pvalid:
+            d = np.zeros(len(self))
+            for i in pvalid:
+                d[i] = padd[i]
+            self.delta_pulse_number = d
+        self._version = getattr(self, "_version", 0) + 1
+
+    def remove_pulse_numbers(self) -> None:
+        """Drop the pulse-number columns (reference
+        ``remove_pulse_numbers``)."""
+        self.pulse_number = None
+        self.delta_pulse_number = None
+        self._version = getattr(self, "_version", 0) + 1
+
+    def select(self, selectarray) -> None:
+        """In-place boolean selection, undoable with :meth:`unselect`
+        (reference ``toa.py:1895``; prefer ``toas[mask]``)."""
+        import copy as _copy
+        import warnings as _warnings
+
+        _warnings.warn("Please use boolean indexing on the object instead: "
+                       "toas[selectarray].", DeprecationWarning)
+        if not hasattr(self, "_select_stack"):
+            self._select_stack = []
+        stack, self._select_stack = self._select_stack, []
+        try:
+            snapshot = _copy.deepcopy(self)  # stack excluded: O(N) memory
+        finally:
+            self._select_stack = stack
+        self._select_stack.append(snapshot)
+        new = self[np.asarray(selectarray)]
+        for k, v in new.__dict__.items():
+            if k != "_select_stack":
+                self.__dict__[k] = v
+        self._version = getattr(self, "_version", 0) + 1
+
+    def unselect(self) -> None:
+        """Undo the last :meth:`select` (reference ``toa.py:1920``)."""
+        import warnings as _warnings
+
+        _warnings.warn("Please use boolean indexing on the object instead.",
+                       DeprecationWarning)
+        try:
+            old = self._select_stack.pop()
+        except (AttributeError, IndexError):
+            from pint_tpu.logging import log as _log
+
+            _log.error("No previous TOA table found.  No changes made.")
+            return
+        stack = getattr(self, "_select_stack", [])
+        self.__dict__.update(old.__dict__)
+        self._select_stack = stack
+        self._version = getattr(self, "_version", 0) + 1
+
+    def merge(self, *others) -> "TOAs":
+        """Merge other TOAs objects into a new one (reference instance
+        method over :func:`merge_TOAs`)."""
+        return merge_TOAs([self, *others])
+
+    def to_TOA_list(self) -> list:
+        """List of single :class:`TOA` objects (reference
+        ``to_TOA_list``)."""
+        out = []
+        mjds = np.asarray(self.utc_mjd)
+        for i in range(len(self)):
+            out.append(TOA((float(np.floor(mjds[i])),
+                            float(mjds[i] - np.floor(mjds[i]))),
+                           error=float(self.error_us[i]),
+                           obs=str(self.obs[i]),
+                           freq=float(self.freq_mhz[i]),
+                           flags=dict(self.flags[i])))
+        return out
+
+    def update_all_times(self, ephem=None, planets=None) -> None:
+        """Recompute clock corrections, TDBs, and position/velocity columns
+        (reference ``update_all_times``); use after editing arrival times
+        or site data."""
+        self.clock_corr_s = None
+        self.apply_clock_corrections(include_gps=self.include_gps,
+                                     include_bipm=self.include_bipm,
+                                     bipm_version=self.bipm_version)
+        self.compute_TDBs(ephem=ephem or self.ephem)
+        self.compute_posvels(ephem=ephem or self.ephem or "DE440",
+                             planets=self.planets if planets is None
+                             else planets)
+
+    def update_mjd_float(self) -> None:
+        """Refresh cached float-MJD views (reference ``update_mjd_float``);
+        float views are computed on demand here, so only the version
+        counter is bumped."""
+        self._version = getattr(self, "_version", 0) + 1
+
+    def check_hashes(self, timfile: Optional[str] = None) -> bool:
+        """True when the source tim files are unchanged since this object
+        was built (reference ``toa.py:1856``; the pickle cache uses the
+        same hashes)."""
+        src = timfile or self.filename
+        if not src:
+            return True
+        try:
+            current = _tim_hashes(src)
+        except OSError:
+            return False
+        stored = getattr(self, "_hashes", None)
+        if stored is None:
+            # nothing recorded at load (e.g. object built programmatically):
+            # edits since load are undetectable — say so instead of
+            # pretending to verify
+            raise ValueError(
+                "No source hashes were recorded when this TOAs object was "
+                "built; cannot verify against the tim file")
+        return stored == current
+
+    # ------------------------------------------------------------------
     def to_batch(self, tdb0: Optional[float] = None) -> TOABatch:
         """Freeze into a device pytree (light-second units, dd times)."""
         if self.tdb is None:
@@ -621,6 +821,11 @@ def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
     if not raw:
         raise ValueError(f"No TOAs found in {timfile}")
     t = TOAs.from_raw(raw, commands, filename=timfile)
+    # record source hashes at LOAD time so check_hashes can detect edits
+    try:
+        t._hashes = _tim_hashes(timfile)
+    except OSError:
+        pass
     _finalize_toas(t, ephem, planets, include_gps, include_bipm,
                    bipm_version, limits)
     log.info(f"Loaded {len(t)} TOAs from {timfile} "
