@@ -28,7 +28,8 @@ from pint_tpu.observatory.sites import SITES
 from pint_tpu.timescales import utc_to_tdb_mjd, utc_to_tt_mjd
 from pint_tpu.utils import PosVel
 
-__all__ = ["Observatory", "TopoObs", "BarycenterObs", "GeocenterObs",
+__all__ = ["Observatory", "TopoObs", "SpecialLocation",
+           "load_special_locations", "BarycenterObs", "GeocenterObs",
            "T2SpacecraftObs",
            "get_observatory", "list_observatories",
            "update_clock_files", "export_all_clock_files",
@@ -187,7 +188,14 @@ class TopoObs(Observatory):
         return np.asarray(out).reshape(np.shape(utc_mjd))
 
 
-class GeocenterObs(Observatory):
+class SpecialLocation(Observatory):
+    """Marker base for non-observatory TOA locations (barycenter,
+    geocenter, spacecraft; reference ``special_locations.py:33``).  Site
+    clock corrections are zero via the base-class default (no site clock
+    files)."""
+
+
+class GeocenterObs(SpecialLocation):
     """Earth geocenter pseudo-observatory (reference ``special_locations.py:117``)."""
 
     def __init__(self):
@@ -204,7 +212,7 @@ class GeocenterObs(Observatory):
         return PosVel(epos, evel, obj=self.name, origin="ssb")
 
 
-class T2SpacecraftObs(Observatory):
+class T2SpacecraftObs(SpecialLocation):
     """Spacecraft whose GCRS position rides in per-TOA tim-file flags
     (tempo2 -telx/-tely/-telz [km], -vx/-vy/-vz [km/s]; reference
     ``special_locations.py:161``).  GPS clock corrections are not applied —
@@ -245,7 +253,7 @@ class T2SpacecraftObs(Observatory):
             "(compute_posvels routes here automatically)")
 
 
-class BarycenterObs(Observatory):
+class BarycenterObs(SpecialLocation):
     """SSB pseudo-observatory: TOAs already barycentred (reference
     ``special_locations.py:71``)."""
 
@@ -686,3 +694,14 @@ def compare_tempo_obsys_dat(tempodir: "str | None" = None) -> dict:
                     position_difference=d, pint_name=obs.name,
                     topo_obs_entry=entry))
     return report
+
+
+def load_special_locations() -> None:
+    """Ensure the barycenter/geocenter/spacecraft pseudo-observatories are
+    registered (reference ``special_locations.py:270``; the builtin loader
+    calls this implicitly)."""
+    for name, cls in (("barycenter", BarycenterObs),
+                      ("geocenter", GeocenterObs),
+                      ("stl_geo", T2SpacecraftObs)):
+        if name not in _registry:
+            cls()

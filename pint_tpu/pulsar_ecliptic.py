@@ -1,0 +1,157 @@
+"""The pulsar ecliptic frame: obliquity registry and ICRS conversions.
+
+Counterpart of reference ``pulsar_ecliptic.py:20 PulsarEcliptic`` (an
+astropy frame there; plain rotation functions + a small frame object
+here — no astropy in this stack).  The obliquity registry carries the
+same named IAU/IERS values as the reference's
+``data/runtime/ecliptic.dat`` (a physical-constants table: the values
+have one correct spelling), and ``load_obliquity_file`` parses that
+format for user-supplied tables.
+
+The model components (``models/astrometry.py AstrometryEcliptic``)
+evaluate with the IERS2010 obliquity; this module is the user-facing
+coordinate-conversion surface (reference ``PulsarEcliptic`` users convert
+sky positions between frames directly).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu import OBL_IERS2010_RAD
+
+__all__ = ["OBL", "PulsarEcliptic", "load_obliquity_file",
+           "icrs_to_pulsarecliptic", "pulsarecliptic_to_icrs",
+           "pulsarecliptic_to_pulsarecliptic"]
+
+ARCSEC_RAD = np.pi / (180.0 * 3600.0)
+
+#: named obliquity values [rad] (reference ``data/runtime/ecliptic.dat``);
+#: the IERS2010/IAU2005/DEFAULT entries are the package constant the model
+#: components evaluate with — one source of truth
+OBL: Dict[str, float] = {
+    "IAU1976": 84381.448 * ARCSEC_RAD,
+    "IERS1992": 84381.412 * ARCSEC_RAD,
+    "DE403": 84381.412 * ARCSEC_RAD,
+    "IERS2003": 84381.4059 * ARCSEC_RAD,
+    "IERS2010": OBL_IERS2010_RAD,
+    "IAU2005": OBL_IERS2010_RAD,
+    "DEFAULT": OBL_IERS2010_RAD,
+}
+
+
+def load_obliquity_file(path: str) -> Dict[str, float]:
+    """Parse an ``ecliptic.dat``-format table (``NAME arcsec`` lines,
+    ``#`` comments) into {name: obliquity rad} (reference
+    ``pulsar_ecliptic.py:18``)."""
+    out: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) == 2:
+                try:
+                    out[parts[0]] = float(parts[1]) * ARCSEC_RAD
+                except ValueError:
+                    continue
+    return out
+
+
+def _obl_rad(ecl: str, obliquity: Optional[float] = None) -> float:
+    if obliquity is not None:
+        return float(obliquity)
+    key = (ecl or "DEFAULT").upper()
+    if key not in OBL:
+        raise ValueError(
+            f"Unknown ecliptic convention {ecl!r}; known: {sorted(OBL)} "
+            "(register custom tables into OBL, or pass obliquity=)")
+    return OBL[key]
+
+
+def _unit(lon, lat):
+    return np.array([np.cos(lat) * np.cos(lon),
+                     np.cos(lat) * np.sin(lon),
+                     np.sin(lat)])
+
+
+def _angles(v) -> Tuple[float, float]:
+    lon = float(np.arctan2(v[1], v[0])) % (2 * np.pi)
+    lat = float(np.arcsin(np.clip(v[2], -1.0, 1.0)))
+    return lon, lat
+
+
+def icrs_to_pulsarecliptic(ra_rad: float, dec_rad: float,
+                           ecl: str = "IERS2010",
+                           obliquity: Optional[float] = None
+                           ) -> Tuple[float, float]:
+    """(RA, DEC) [rad] -> ecliptic (ELONG, ELAT) [rad] under the named
+    obliquity — or an explicit ``obliquity`` [rad], which wins (reference
+    ``pulsar_ecliptic.py icrs_to_pulsarecliptic``)."""
+    o = _obl_rad(ecl, obliquity)
+    x, y, z = _unit(ra_rad, dec_rad)
+    # rotate equatorial -> ecliptic about x by +obliquity
+    ye = np.cos(o) * y + np.sin(o) * z
+    ze = -np.sin(o) * y + np.cos(o) * z
+    return _angles((x, ye, ze))
+
+
+def pulsarecliptic_to_icrs(elong_rad: float, elat_rad: float,
+                           ecl: str = "IERS2010",
+                           obliquity: Optional[float] = None
+                           ) -> Tuple[float, float]:
+    """Ecliptic (ELONG, ELAT) [rad] -> (RA, DEC) [rad] (reference
+    ``pulsar_ecliptic.py pulsarecliptic_to_icrs``)."""
+    o = _obl_rad(ecl, obliquity)
+    xe, ye, ze = _unit(elong_rad, elat_rad)
+    y = np.cos(o) * ye - np.sin(o) * ze
+    z = np.sin(o) * ye + np.cos(o) * ze
+    return _angles((xe, y, z))
+
+
+def pulsarecliptic_to_pulsarecliptic(elong_rad: float, elat_rad: float,
+                                     ecl_from: str,
+                                     ecl_to: str) -> Tuple[float, float]:
+    """Convert between two obliquity conventions (reference
+    ``pulsar_ecliptic.py pulsarecliptic_to_pulsarecliptic``)."""
+    ra, dec = pulsarecliptic_to_icrs(elong_rad, elat_rad, ecl_from)
+    return icrs_to_pulsarecliptic(ra, dec, ecl_to)
+
+
+class PulsarEcliptic:
+    """Minimal frame object: an (elong, elat) pair bound to a named
+    obliquity, with ICRS conversion (reference ``pulsar_ecliptic.py:20``,
+    minus the astropy frame machinery)."""
+
+    name = "pulsarecliptic"
+
+    def __init__(self, elong_rad: float = 0.0, elat_rad: float = 0.0,
+                 ecl: str = "IERS2010",
+                 obliquity: Optional[float] = None):
+        self.elong = float(elong_rad)
+        self.elat = float(elat_rad)
+        self.ecl = ecl
+        self.obliquity = obliquity if obliquity is not None \
+            else _obl_rad(ecl)
+
+    @classmethod
+    def from_icrs(cls, ra_rad: float, dec_rad: float,
+                  ecl: str = "IERS2010") -> "PulsarEcliptic":
+        lon, lat = icrs_to_pulsarecliptic(ra_rad, dec_rad, ecl)
+        return cls(lon, lat, ecl)
+
+    def to_icrs(self) -> Tuple[float, float]:
+        return pulsarecliptic_to_icrs(self.elong, self.elat, self.ecl,
+                                      obliquity=self.obliquity)
+
+    def transform_to(self, ecl: str) -> "PulsarEcliptic":
+        ra, dec = self.to_icrs()
+        lon, lat = icrs_to_pulsarecliptic(ra, dec, ecl)
+        return PulsarEcliptic(lon, lat, ecl)
+
+    def __repr__(self):
+        return (f"PulsarEcliptic(elong={np.degrees(self.elong):.6f} deg, "
+                f"elat={np.degrees(self.elat):.6f} deg, ecl={self.ecl!r})")

@@ -6,7 +6,8 @@ from __future__ import annotations
 import argparse
 from typing import Optional
 
-__all__ = ["main"]
+__all__ = ["main", "get_toas", "load_eventfiles", "lnlikelihood_prob",
+           "lnlikelihood_resid"]
 
 
 def main(argv: Optional[list] = None):
@@ -43,3 +44,84 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(main())
+
+
+# ---------------------------------------------------------------------------
+# reference helper surface (event_optimize_multiple.py:42-150)
+# ---------------------------------------------------------------------------
+
+def get_toas(evtfile, flags, tcoords=None, minweight=0, minMJD=0,
+             maxMJD=100000):
+    """Load TOAs from a tim file or an event FITS file, pruning the MJD
+    range (reference ``event_optimize_multiple.py:42``).  ``flags`` is the
+    per-dataset option dict from :func:`load_eventfiles` (weightcol,
+    usepickle, ...)."""
+    import numpy as np
+
+    from pint_tpu import toa as toa_mod
+
+    if str(evtfile).endswith(".tim"):
+        up = flags.get("usepickle", False)
+        # flag values arrive as strings: 'False'/'0'/'no' must stay falsy
+        usepickle = up if isinstance(up, bool) \
+            else str(up).lower() in ("1", "true", "yes", "y")
+        ts = toa_mod.get_TOAs(evtfile, usepickle=usepickle)
+        mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+        return ts[(mjds >= minMJD) & (mjds <= maxMJD)]
+    from pint_tpu.fermi_toas import get_Fermi_TOAs
+
+    weightcol = flags.get("weightcol")
+    return get_Fermi_TOAs(evtfile, weightcolumn=weightcol,
+                          targetcoord=tcoords, minweight=minweight,
+                          minmjd=minMJD, maxmjd=maxMJD)
+
+
+def load_eventfiles(infile, tcoords=None, minweight=0, minMJD=0,
+                    maxMJD=100000):
+    """Parse a dataset-list file: ``<eventfile> <lnlike-name> <template>
+    [flags]`` per line (reference ``event_optimize_multiple.py:72``).
+    Returns (toas_list, lnlike_names, templates, weightcols, setweights)."""
+    toas_list, lnlikes, templates, weightcols, setweights = [], [], [], [], []
+    with open(infile) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            evtfile, lnlike, template = parts[0], parts[1], parts[2]
+            flags = {}
+            for tok in parts[3:]:
+                k, _, v = tok.partition("=")
+                flags[k.lstrip("-")] = v if v else True
+            toas_list.append(get_toas(evtfile, flags, tcoords=tcoords,
+                                      minweight=minweight, minMJD=minMJD,
+                                      maxMJD=maxMJD))
+            lnlikes.append(lnlike)
+            templates.append(template)
+            weightcols.append(flags.get("weightcol"))
+            setweights.append(float(flags.get("setweights", 1.0)))
+    return toas_list, lnlikes, templates, weightcols, setweights
+
+
+def lnlikelihood_prob(ftr, theta, index):
+    """Photon-template ln-likelihood for dataset ``index`` at parameters
+    ``theta`` (last entry = phase offset; reference
+    ``event_optimize_multiple.py:137``)."""
+    import numpy as np
+
+    phases = ftr.get_event_phases(index)
+    phss = (np.asarray(phases, dtype=np.float64)
+            + np.float64(theta[-1])) % 1.0
+    probs = ftr.get_template_vals(phss, index)
+    w = ftr.weights[index]
+    if w is None:
+        return float(np.log(probs).sum())
+    return float(np.log(w * probs + 1.0 - w).sum())
+
+
+def lnlikelihood_resid(ftr, theta, index):
+    """Residual-chi2 ln-likelihood for dataset ``index`` (reference
+    ``event_optimize_multiple.py:148``)."""
+    from pint_tpu.residuals import Residuals
+
+    return -Residuals(ftr.toas_list[index], ftr.model).chi2

@@ -41,3 +41,23 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(main())
+
+
+class PINTk:
+    """Reference main-window class name (``scripts/pintk.py:28``): holds
+    the :class:`~pint_tpu.pintk.pulsar.Pulsar` state and launches the Tk
+    GUI on demand (construction itself stays headless-safe)."""
+
+    def __init__(self, master=None, parfile=None, timfile=None,
+                 fitter: str = "auto", ephem=None, **kwargs):
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        self.master = master
+        self.psr = Pulsar(parfile, timfile, ephem=ephem, fitter=fitter)
+
+    def launch(self):
+        from pint_tpu.pintk.plk import launch_gui
+
+        launch_gui(self.psr)
+
+    mainloop = launch

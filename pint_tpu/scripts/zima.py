@@ -51,3 +51,22 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(main())
+
+
+def plot_simulated_toas(ts, m):
+    """Plot the simulated residuals (should be flat noise around zero;
+    reference ``zima.py:175``).  Requires matplotlib."""
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    from pint_tpu.residuals import Residuals
+
+    r = Residuals(ts, m)
+    mjds = np.asarray(ts.get_mjds(), dtype=np.float64)
+    plt.errorbar(mjds, np.asarray(r.time_resids) * 1e6,
+                 yerr=np.asarray(ts.get_errors()), fmt=".")
+    plt.xlabel("MJD")
+    plt.ylabel("Residual (us)")
+    plt.title("Simulated TOAs")
+    plt.grid(True)
+    plt.show()

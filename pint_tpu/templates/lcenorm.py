@@ -57,3 +57,7 @@ class ENormAngles(NormAngles):
     def __repr__(self):
         return (f"ENormAngles(norms={self._angles_to_norms(self.p[:self.dim])!r}, "
                 f"slopes={self.p[self.dim:]!r})")
+
+
+#: reference re-export (each template module offers isvector)
+from pint_tpu.templates.lcnorm import isvector  # noqa: E402,F401

@@ -90,3 +90,7 @@ class LCELorentzian(LCEPrimitive):
 class LCEVonMises(LCEPrimitive):
     base_cls = LCVonMises
     name = "EVonMises"
+
+
+#: reference re-export (each template module offers isvector)
+from pint_tpu.templates.lcnorm import isvector  # noqa: E402,F401

@@ -133,3 +133,7 @@ class LCFitter:
         ll = self.ll_best if self.ll_best is not None else self.loglikelihood()
         return f"LCFitter: {len(self.phases)} photons, logL = {ll:.2f}\n" \
             + repr(self.template)
+
+
+#: reference re-export (each template module offers isvector)
+from pint_tpu.templates.lcnorm import isvector  # noqa: E402,F401

@@ -17,6 +17,15 @@ import numpy as np
 __all__ = ["NormAngles"]
 
 
+def isvector(x):
+    """True when x has at least one array dimension (reference
+    ``templates/lcnorm.py:16``; re-exported across the template modules
+    there)."""
+    import numpy as _np
+
+    return len(_np.asarray(x).shape) > 0
+
+
 class NormAngles:
     def __init__(self, norms):
         norms = np.asarray(norms, dtype=np.float64)

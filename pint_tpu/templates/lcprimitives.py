@@ -539,3 +539,7 @@ def check_gradient(prim: LCPrimitive, n: int = 100, seed: int = 0,
         jnp.asarray(prim.p))
     ana = np.asarray(ana).T
     return np.allclose(ana, num, atol=atol, rtol=rtol)
+
+
+#: reference re-export (each template module offers isvector)
+from pint_tpu.templates.lcnorm import isvector  # noqa: E402,F401

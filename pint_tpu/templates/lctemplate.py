@@ -200,3 +200,7 @@ def make_twoside_gaussian(center: float, width1: float, width2: float,
     g1 = LCGaussian([width1, center])
     g2 = LCGaussian([width2, center])
     return LCTemplate([g1, g2], [norm / 2, norm / 2])
+
+
+#: reference re-export (each template module offers isvector)
+from pint_tpu.templates.lcnorm import isvector  # noqa: E402,F401

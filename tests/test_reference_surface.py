@@ -695,3 +695,124 @@ class TestLongTailReviewRegressions:
         with pytest.warns(DeprecationWarning):
             t.unselect()
         assert len(t) == 16
+
+
+class TestFrameAndScriptSurface:
+    """pulsar_ecliptic frame module, special locations, script helpers."""
+
+    def test_obliquity_registry_and_file(self, tmp_path):
+        from pint_tpu.pulsar_ecliptic import OBL, load_obliquity_file
+
+        assert OBL["DEFAULT"] == OBL["IERS2010"] == OBL["IAU2005"]
+        p = tmp_path / "ecl.dat"
+        p.write_text("# comment\nMYECL 84381.0\n")
+        d = load_obliquity_file(str(p))
+        assert d["MYECL"] == pytest.approx(84381.0 * np.pi / 648000.0)
+
+    def test_ecliptic_round_trip_and_model_consistency(self):
+        from pint_tpu.models import get_model
+        from pint_tpu.pulsar_ecliptic import (PulsarEcliptic,
+                                              icrs_to_pulsarecliptic,
+                                              pulsarecliptic_to_icrs)
+
+        ra, dec = 1.234, -0.3
+        lon, lat = icrs_to_pulsarecliptic(ra, dec)
+        ra2, dec2 = pulsarecliptic_to_icrs(lon, lat)
+        assert ra2 == pytest.approx(ra, abs=1e-14)
+        assert dec2 == pytest.approx(dec, abs=1e-14)
+        # must agree with the model's own ECL<->ICRS conversion
+        m = get_model(["PSR X\n", "RAJ 04:37:00\n", "DECJ -47:15:00\n",
+                       "POSEPOCH 55000\n", "F0 100.0\n", "PEPOCH 55000\n",
+                       "DM 10\n", "UNITS TDB\n"])
+        ecl = m.as_ECL()
+        lon_m, lat_m = float(ecl.ELONG.value), float(ecl.ELAT.value)
+        lon_f, lat_f = icrs_to_pulsarecliptic(float(m.RAJ.value),
+                                              float(m.DECJ.value))
+        assert lon_f == pytest.approx(lon_m, abs=1e-12)
+        assert lat_f == pytest.approx(lat_m, abs=1e-12)
+        # frame object API
+        fr = PulsarEcliptic.from_icrs(ra, dec)
+        assert fr.to_icrs() == (pytest.approx(ra), pytest.approx(dec))
+        fr2 = fr.transform_to("IERS2003")
+        assert fr2.ecl == "IERS2003"
+        assert fr2.elong != fr.elong  # different obliquity moves the frame
+
+    def test_special_locations(self):
+        from pint_tpu.observatory import (BarycenterObs, GeocenterObs,
+                                          Observatory, SpecialLocation,
+                                          get_observatory,
+                                          load_special_locations)
+
+        load_special_locations()
+        bary = get_observatory("@")
+        assert isinstance(bary, BarycenterObs)
+        assert isinstance(bary, SpecialLocation)
+        assert isinstance(get_observatory("geocenter"), GeocenterObs)
+        assert issubclass(SpecialLocation, Observatory)
+
+    def test_event_optimize_multiple_helpers(self, tmp_path):
+        from pint_tpu.scripts.event_optimize_multiple import (
+            lnlikelihood_prob, lnlikelihood_resid, load_eventfiles)
+
+        class FakeFtr:
+            weights = [None, np.full(10, 0.5)]
+
+            def get_event_phases(self, i):
+                return np.linspace(0, 0.9, 10)
+
+            def get_template_vals(self, phss, i):
+                return np.full(len(phss), 2.0)
+
+        f = FakeFtr()
+        ll = lnlikelihood_prob(f, np.array([0.1]), 0)
+        assert ll == pytest.approx(10 * np.log(2.0))
+        llw = lnlikelihood_prob(f, np.array([0.1]), 1)
+        assert llw == pytest.approx(10 * np.log(0.5 * 2.0 + 0.5))
+        # dataset list parsing (tim branch exercised via a real tim file)
+        tim = tmp_path / "a.tim"
+        tim.write_text("FORMAT 1\nx 1400 55000.0 1.0 gbt\n"
+                       "y 1400 55500.0 1.0 gbt\n")
+        lst = tmp_path / "sets.txt"
+        lst.write_text(f"{tim} lnlikelihood_resid tmpl.gauss "
+                       "setweights=2.0\n")
+        toas_list, lnlikes, templates, wcols, setw = load_eventfiles(
+            str(lst), minMJD=54900, maxMJD=55100)
+        assert len(toas_list) == 1 and len(toas_list[0]) == 1
+        assert lnlikes == ["lnlikelihood_resid"]
+        assert setw == [2.0]
+
+    def test_pintk_class_and_isvector(self):
+        from pint_tpu.scripts.pintk import PINTk
+        from pint_tpu.templates.lcprimitives import isvector
+
+        assert callable(getattr(PINTk, "launch"))
+        assert isvector([1, 2]) and not isvector(3.0)
+
+
+class TestFrameReviewRegressions:
+    def test_custom_obliquity_honored(self):
+        from pint_tpu.pulsar_ecliptic import (PulsarEcliptic,
+                                              icrs_to_pulsarecliptic,
+                                              pulsarecliptic_to_icrs)
+
+        custom = 0.40
+        lon, lat = icrs_to_pulsarecliptic(1.0, 0.2, obliquity=custom)
+        ra, dec = pulsarecliptic_to_icrs(lon, lat, obliquity=custom)
+        assert (ra, dec) == (pytest.approx(1.0), pytest.approx(0.2))
+        # the frame object must convert with ITS obliquity, not the name's
+        fr = PulsarEcliptic(lon, lat, obliquity=custom)
+        ra2, dec2 = fr.to_icrs()
+        assert (ra2, dec2) == (pytest.approx(1.0), pytest.approx(0.2))
+        # and the default-name path gives a DIFFERENT answer (sanity)
+        fr_default = PulsarEcliptic(lon, lat)
+        assert fr_default.to_icrs()[0] != pytest.approx(1.0, abs=1e-6)
+
+    def test_usepickle_string_false(self, tmp_path):
+        from pint_tpu.scripts.event_optimize_multiple import get_toas
+
+        tim = tmp_path / "b.tim"
+        tim.write_text("FORMAT 1\nx 1400 55000.0 1.0 gbt\n")
+        t = get_toas(str(tim), {"usepickle": "False"})
+        assert len(t) == 1
+        # no pickle cache file must have been created
+        assert not list(tmp_path.glob("*.pickle*"))
