@@ -63,6 +63,72 @@ class Observatory:
         raise KeyError(f"Unknown observatory {name!r}")
 
     @classmethod
+    def names(cls):
+        """All registered observatory names (reference
+        ``observatory/__init__.py:260``)."""
+        _ensure_builtin()
+        return _registry.keys()
+
+    @classmethod
+    def names_and_aliases(cls) -> Dict[str, List[str]]:
+        """{name: aliases} for every registered observatory (reference
+        ``observatory/__init__.py:269``)."""
+        _ensure_builtin()
+        return {name: obs.aliases for name, obs in _registry.items()}
+
+    @property
+    def timescale(self) -> str:
+        """Timescale of clock-corrected TOAs from this site (reference
+        ``observatory/__init__.py:380``); BarycenterObs overrides with
+        'tdb'."""
+        return "utc"
+
+    @staticmethod
+    def gps_correction(t, limits: str = "warn") -> np.ndarray:
+        """GPS->UTC clock correction [s] at UTC MJDs ``t`` (reference
+        ``observatory/__init__.py:221``)."""
+        gps = find_clock_file("gps2utc.clk", fmt="tempo2", limits=limits)
+        t = np.atleast_1d(np.asarray(t, dtype=np.float64))
+        return gps.evaluate(t, limits=limits) if gps is not None \
+            else np.zeros_like(t)
+
+    @staticmethod
+    def bipm_correction(t, bipm_version: str = "BIPM2021",
+                        limits: str = "warn") -> np.ndarray:
+        """TT(TAI)->TT(BIPM) correction [s] (~27 us; reference
+        ``observatory/__init__.py:235``)."""
+        f = find_clock_file(f"tai2tt_{bipm_version.lower()}.clk",
+                            fmt="tempo2", limits=limits)
+        t = np.atleast_1d(np.asarray(t, dtype=np.float64))
+        return f.evaluate(t, limits=limits) - 32.184 if f is not None \
+            else np.zeros_like(t)
+
+    def last_clock_correction_mjd(self, limits: str = "warn") -> float:
+        """Last MJD every clock file in this site's chain covers
+        (reference ``observatory/__init__.py last_clock_correction_mjd``);
+        -inf when a needed file is missing."""
+        last = np.inf
+        files = [cf for cf in self._site_clock_files(limits=limits)
+                 if cf is not None]
+        wanted = len(getattr(self, "clock_file_names", ()) or ())
+        if wanted and len(files) < wanted:
+            # ANY missing link breaks the chain: coverage is -inf, not the
+            # coverage of whichever files happened to resolve
+            return -np.inf
+        for cf in files:
+            last = min(last, cf.last_correction_mjd())
+        if self.include_gps:
+            gps = find_clock_file("gps2utc.clk", fmt="tempo2", limits=limits)
+            last = min(last, gps.last_correction_mjd()
+                       if gps is not None else -np.inf)
+        if self.include_bipm:
+            b = find_clock_file(f"tai2tt_{self.bipm_version.lower()}.clk",
+                                fmt="tempo2", limits=limits)
+            last = min(last, b.last_correction_mjd()
+                       if b is not None else -np.inf)
+        return float(last)
+
+    @classmethod
     def clear_registry(cls):
         """Empty the registry (reference ``Observatory.clear_registry``);
         the builtins reload on the next lookup."""
@@ -260,6 +326,10 @@ class BarycenterObs(SpecialLocation):
     def __init__(self):
         super().__init__("barycenter", aliases=["@", "bat", "ssb", "bary"],
                          include_gps=False, include_bipm=False)
+
+    @property
+    def timescale(self) -> str:
+        return "tdb"  # barycentred TOAs arrive in TDB already
 
     def clock_corrections(self, utc_mjd, **kw):
         return np.zeros_like(np.atleast_1d(np.asarray(utc_mjd, dtype=np.float64)))

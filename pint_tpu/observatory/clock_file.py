@@ -157,6 +157,58 @@ class ClockFile:
     def last_correction_mjd(self) -> float:
         return float(self.mjd[-1]) if len(self.mjd) else -np.inf
 
+    @property
+    def time(self) -> np.ndarray:
+        """Sample epochs, MJD (reference ``clock_file.py time``)."""
+        return self.mjd
+
+    @property
+    def clock(self) -> np.ndarray:
+        """Corrections [us] at the sample epochs (reference
+        ``clock_file.py clock``)."""
+        return self.clock_us
+
+    @staticmethod
+    def merge(clocks, trim: bool = True) -> "ClockFile":
+        """Sum a chain of clock files into one (reference
+        ``clock_file.py:195``): the merged corrections are the sum of the
+        inputs evaluated on the union of their sample epochs; with
+        ``trim`` the result covers only the overlap of all inputs."""
+        clocks = list(clocks)
+        if not clocks:
+            raise ValueError("need at least one clock file")
+        if any(len(c.mjd) == 0 for c in clocks):
+            raise ValueError(
+                "cannot merge: a clock file in the chain has no samples "
+                f"({[c.filename for c in clocks if len(c.mjd) == 0]})")
+        mjds = np.unique(np.concatenate([c.mjd for c in clocks]))
+        if trim:
+            lo = max(c.mjd[0] for c in clocks)
+            hi = min(c.mjd[-1] for c in clocks)
+            mjds = mjds[(mjds >= lo) & (mjds <= hi)]
+        total_us = np.zeros_like(mjds)
+        for c in clocks:
+            total_us += c.evaluate(mjds, limits="warn") * 1e6
+        return ClockFile(mjds, total_us,
+                         filename="+".join(c.filename for c in clocks),
+                         hdrline="# merged chain")
+
+    def export(self, filename: str) -> None:
+        """Write this clock file out (reference ``clock_file.py:411``):
+        byte-for-byte from the backing file when its full path is known,
+        else re-serialized in tempo2 format (``filename`` alone is a
+        basename and must NOT be resolved against the cwd — it could name
+        an unrelated file)."""
+        import shutil
+
+        src = getattr(self, "source_path", None)
+        if src and os.path.exists(src):
+            shutil.copyfile(src, filename)
+            return
+        log.info(f"export: no backing file for {self.filename!r}; "
+                 "writing tempo2 format")
+        self.write_tempo2_clock_file(filename)
+
     def __add__(self, other: "ClockFile") -> "ClockFile":
         """Merge two clock files by summing corrections on the union grid."""
         mjds = np.union1d(self.mjd, other.mjd)
@@ -208,7 +260,9 @@ def read_tempo_clock_file(path: str, obscode: Optional[str] = None, **kw) -> Clo
                 continue
             mjds.append(mjd)
             corr.append(c2 - c1)
-    return ClockFile(mjds, corr, filename=os.path.basename(path), **kw)
+    cf = ClockFile(mjds, corr, filename=os.path.basename(path), **kw)
+    cf.source_path = os.path.abspath(path)
+    return cf
 
 
 def read_tempo2_clock_file(path: str, **kw) -> ClockFile:
@@ -238,7 +292,10 @@ def read_tempo2_clock_file(path: str, **kw) -> ClockFile:
                 continue  # bare-text header or malformed line
             mjds.append(m_)
             corr.append(c_ * 1e6)  # seconds -> us
-    return ClockFile(mjds, corr, filename=os.path.basename(path), hdrline=hdrline, **kw)
+    cf = ClockFile(mjds, corr, filename=os.path.basename(path),
+                   hdrline=hdrline, **kw)
+    cf.source_path = os.path.abspath(path)
+    return cf
 
 
 _warned: set = set()

@@ -86,18 +86,7 @@ class LCFitter:
             p.set_location(p.get_location() % 1.0)
         self.ll_best = -res.fun
         if estimate_errors:
-            try:
-                H = hessian(nll, res.x)
-                cov = np.linalg.inv(H)
-                self.errors = np.sqrt(np.maximum(np.diag(cov), 0.0))
-            except np.linalg.LinAlgError:
-                log.warning("Hessian not invertible; no template errors")
-                self.errors = np.zeros_like(res.x)
-            # nll() mutated the template while probing the Hessian: restore
-            # the optimizer solution
-            self.template.set_parameters(res.x)
-            for p in self.template.primitives:
-                p.set_location(p.get_location() % 1.0)
+            self.errors = self._hessian_errors(nll, res.x)
         if not quiet:
             log.info(f"LCFitter: logL = {self.ll_best:.2f}, "
                      f"success = {res.success}")
@@ -125,6 +114,141 @@ class LCFitter:
         for p, b in zip(self.template.primitives, base):
             p.set_location((b + shift) % 1.0)
         return shift, float(err)
+
+    # -- reference fit-method family and stats (lcfitters.py) ---------------
+    def fit_fmin(self, **kw):
+        """Nelder-Mead fit (reference ``lcfitters.py fit_fmin``)."""
+        return self.fit(method="Nelder-Mead", **kw)
+
+    def fit_bfgs(self, **kw):
+        """BFGS fit (reference ``lcfitters.py fit_bfgs``)."""
+        return self.fit(method="BFGS", **kw)
+
+    def fit_cg(self, **kw):
+        """Conjugate-gradient fit (reference ``lcfitters.py fit_cg``)."""
+        return self.fit(method="CG", **kw)
+
+    def fit_l_bfgs_b(self, **kw):
+        """L-BFGS-B fit (reference ``lcfitters.py fit_l_bfgs_b``)."""
+        return self.fit(method="L-BFGS-B", **kw)
+
+    def fit_tnc(self, **kw):
+        """Truncated-Newton fit (reference ``lcfitters.py fit_tnc``)."""
+        return self.fit(method="TNC", **kw)
+
+    def aic(self) -> float:
+        """Akaike information criterion at the current parameters
+        (reference ``lcfitters.py aic``)."""
+        k = self.template.num_parameters()
+        return 2.0 * k - 2.0 * self.loglikelihood()
+
+    def bic(self) -> float:
+        """Bayesian information criterion (reference
+        ``lcfitters.py bic``)."""
+        k = self.template.num_parameters()
+        return k * np.log(len(self.phases)) - 2.0 * self.loglikelihood()
+
+    def chi(self, bins: int = 50):
+        """(chi2, dof) of the binned profile against the template
+        (reference ``lcfitters.py chi``)."""
+        edges = np.linspace(0.0, 1.0, bins + 1)
+        centers = 0.5 * (edges[1:] + edges[:-1])
+        if self.weights is None:
+            counts, _ = np.histogram(self.phases, bins=edges)
+            ntot = len(self.phases)
+        else:
+            counts, _ = np.histogram(self.phases, bins=edges,
+                                     weights=self.weights)
+            ntot = float(self.weights.sum())
+        expect = np.asarray(self.template(centers)) / bins * ntot
+        var = np.maximum(expect, 1e-12)
+        chi2 = float(np.sum((counts - expect) ** 2 / var))
+        return chi2, bins - self.template.num_parameters()
+
+    def _hessian_errors(self, nll, x0) -> np.ndarray:
+        """sqrt(diag(H^-1)) of the negative log-likelihood at ``x0``,
+        restoring the template (the probe mutates it) — the ONE
+        implementation behind both fit() and hess_errors()."""
+        try:
+            H = hessian(nll, x0)
+            cov = np.linalg.inv(H)
+            errs = np.sqrt(np.maximum(np.diag(cov), 0.0))
+        except np.linalg.LinAlgError:
+            log.warning("Hessian not invertible; no template errors")
+            errs = np.zeros(len(x0))
+        self.template.set_parameters(x0)
+        for p in self.template.primitives:
+            p.set_location(p.get_location() % 1.0)
+        return errs
+
+    def hess_errors(self) -> np.ndarray:
+        """Parameter errors from the likelihood Hessian at the current
+        parameters (reference ``lcfitters.py hess_errors``)."""
+        x0 = self.template.get_parameters().copy()
+        self.errors = self._hessian_errors(lambda p: self(p), x0)
+        return self.errors
+
+    def bootstrap_errors(self, nsamp: int = 20, fit_kwargs=None,
+                         rng=None) -> np.ndarray:
+        """Parameter errors by refitting phase resamples (reference
+        ``lcfitters.py bootstrap_errors``)."""
+        import copy as _copy
+
+        rng = rng or np.random.default_rng()
+        fit_kwargs = dict(fit_kwargs or {})
+        fit_kwargs.setdefault("estimate_errors", False)
+        x0 = self.template.get_parameters().copy()
+        samples = []
+        for _ in range(nsamp):
+            idx = rng.integers(0, len(self.phases), len(self.phases))
+            sub = LCFitter(_copy.deepcopy(self.template), self.phases[idx],
+                           weights=None if self.weights is None
+                           else self.weights[idx])
+            sub.template.set_parameters(x0.copy())
+            sub.fit(**fit_kwargs)
+            samples.append(sub.template.get_parameters().copy())
+        self.template.set_parameters(x0)
+        errs = np.std(np.asarray(samples), axis=0)
+        self.errors = errs
+        return errs
+
+    def binned_loglikelihood(self, p=None, bins: int = None) -> float:
+        """log-likelihood on a binned profile (Poisson factor dropped;
+        reference ``lcfitters.py binned_loglikelihood``)."""
+        bins = bins or self.binned_bins
+        if p is not None:
+            self.template.set_parameters(p)
+        edges = np.linspace(0.0, 1.0, bins + 1)
+        centers = 0.5 * (edges[1:] + edges[:-1])
+        f = np.asarray(self.template(centers))
+        counts, _ = np.histogram(self.phases, bins=edges)  # raw photons/bin
+        if self.weights is None:
+            vals = f
+        else:
+            wsum, _ = np.histogram(self.phases, bins=edges,
+                                   weights=self.weights)
+            wbar = np.divide(wsum, np.maximum(counts, 1))
+            vals = wbar * f + (1.0 - wbar)
+        if np.any(vals[counts > 0] <= 0):
+            return -np.inf
+        return float(np.sum(counts * np.log(np.maximum(vals, 1e-300))))
+
+    def binned_gradient(self, p=None, bins: int = None,
+                        eps: float = 1e-6) -> np.ndarray:
+        """Finite-difference gradient of :meth:`binned_loglikelihood`
+        (reference ``lcfitters.py binned_gradient``)."""
+        x0 = self.template.get_parameters().copy() if p is None \
+            else np.asarray(p, dtype=np.float64)
+        g = np.empty(len(x0))
+        for i in range(len(x0)):
+            xp = x0.copy()
+            xp[i] += eps
+            lp = self.binned_loglikelihood(xp, bins=bins)
+            xp[i] -= 2 * eps
+            lm = self.binned_loglikelihood(xp, bins=bins)
+            g[i] = (lp - lm) / (2 * eps)
+        self.template.set_parameters(x0)
+        return g
 
     def remap_errors(self):  # parity no-op
         pass

@@ -38,6 +38,8 @@ class LCTemplate:
         norms drift their parameters; reference ``lceprimitives.py`` /
         ``lcenorm.py`` semantics)."""
         if log10_ens is None:
+            log10_ens = getattr(self, "_fixed_log10_en", None)
+        if log10_ens is None:
             norms = self.norms()
             bg = 1.0 - norms.sum()
             out = bg if not suppress_bg else 0.0
@@ -145,6 +147,135 @@ class LCTemplate:
     def rotate(self, dphi: float):
         for p in self.primitives:
             p.set_location((p.get_location() + dphi) % 1.0)
+
+    # -- reference user-API long tail (templates/lctemplate.py) ------------
+    def copy(self) -> "LCTemplate":
+        """Deep copy (reference ``lctemplate.py copy``)."""
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+    def add_primitive(self, prim, norm: float = 0.1) -> None:
+        """Append a pulse component with amplitude ``norm``, scaling the
+        existing amplitudes by (1 - norm) so the total stays normalized
+        (reference ``lctemplate.py add_primitive``)."""
+        amps = self.get_amplitudes()
+        new = np.concatenate([amps * (1.0 - norm), [norm]])
+        self.primitives.append(prim)
+        self.norms = NormAngles(new)
+
+    def delete_primitive(self, index: int = -1) -> None:
+        """Remove a pulse component, redistributing its amplitude over the
+        rest (reference ``lctemplate.py delete_primitive``)."""
+        if len(self.primitives) == 1:
+            raise ValueError("Template must retain at least one component")
+        amps = self.get_amplitudes()
+        keep = np.delete(amps, index)
+        total = keep.sum()
+        if total > 0:
+            keep = keep * amps.sum() / total
+        self.primitives.pop(index)
+        self.norms = NormAngles(keep)
+
+    def cdf(self, x, log10_ens=None) -> np.ndarray:
+        """Cumulative profile on [0, 1] (reference ``lctemplate.py
+        cdf``), by dense trapezoid integration of the pdf."""
+        grid = np.linspace(0.0, 1.0, 2049)
+        pdf = np.asarray(self(grid, log10_ens=log10_ens))
+        c = np.concatenate([[0.0], np.cumsum((pdf[1:] + pdf[:-1]) * 0.5
+                                             * np.diff(grid))])
+        c /= c[-1]
+        # clip, not mod: cdf(1.0) must be 1, not wrap to cdf(0)
+        return np.interp(np.clip(np.asarray(x, dtype=np.float64), 0.0, 1.0),
+                         grid, c)
+
+    def norm(self) -> float:
+        """Total pulsed fraction (sum of component amplitudes; reference
+        ``lctemplate.py norm``)."""
+        return float(np.sum(self.get_amplitudes()))
+
+    def delta(self, index=None) -> float:
+        """Radio-lag-convention peak position Delta (reference
+        ``lctemplate.py delta``): location of the highest-amplitude (or
+        ``index``-th) component.  Delegates to :meth:`get_location` so
+        "peak" has exactly one definition."""
+        if index is None:
+            return float(self.get_location())
+        return float(self.primitives[int(index)].get_location())
+
+    #: reference spelling
+    Delta = delta
+
+    def get_fixed_energy_version(self, log10_en: float = 3.0) -> "LCTemplate":
+        """Snapshot pinned at ``log10_en`` (reference ``lctemplate.py
+        get_fixed_energy_version``): the copy evaluates energy-dependent
+        primitives/norms at that energy whenever no per-photon energies are
+        given; energy-independent templates copy unchanged."""
+        out = self.copy()
+        if self.is_energy_dependent():
+            out._fixed_log10_en = np.atleast_1d(np.float64(log10_en))
+        return out
+
+    def closest_to_peak(self, phases) -> float:
+        """Smallest |phase - peak| over the given phases (reference
+        ``lctemplate.py closest_to_peak``)."""
+        d = np.abs((np.asarray(phases, dtype=np.float64)
+                    - self.delta() + 0.5) % 1.0 - 0.5)
+        return float(np.min(d))
+
+    def mean_value(self, phases, log10_ens=None) -> float:
+        """Mean template value over the given phases."""
+        return float(np.mean(np.asarray(self(phases,
+                                             log10_ens=log10_ens))))
+
+    def max_value(self) -> float:
+        """Maximum of the profile on a dense grid."""
+        grid = np.linspace(0.0, 1.0, 2048, endpoint=False)
+        return float(np.max(np.asarray(self(grid))))
+
+    def check_bounds(self) -> bool:
+        """True when every free parameter is inside its domain (reference
+        ``lctemplate.py check_bounds``)."""
+        try:
+            p = self.get_parameters()
+            return bool(np.all(np.isfinite(p)))
+        except Exception:
+            return False
+
+    def approx_gradient(self, phases, log10_ens=None,
+                        eps: float = 1e-6) -> np.ndarray:
+        """(nparam, nphase) finite-difference gradient of the pdf wrt the
+        free parameters (reference ``lctemplate.py approx_gradient``)."""
+        p0 = self.get_parameters().copy()
+        out = np.empty((len(p0), len(np.atleast_1d(phases))))
+        for i in range(len(p0)):
+            for s, sign in ((eps, +1.0), (-2 * eps, -1.0)):
+                p0[i] += s
+                self.set_parameters(p0)
+                v = np.asarray(self(phases, log10_ens=log10_ens))
+                if sign > 0:
+                    hi = v
+                else:
+                    lo = v
+            p0[i] += eps
+            self.set_parameters(p0)
+            out[i] = (hi - lo) / (2 * eps)
+        return out
+
+    #: reference offers both spellings
+    approx_derivative = approx_gradient
+
+    def check_gradient(self, phases=None, quiet: bool = True) -> bool:
+        """Self-consistency of the finite-difference gradient at two eps
+        scales (reference ``lctemplate.py check_gradient``)."""
+        if phases is None:
+            phases = np.linspace(0.05, 0.95, 19)
+        g1 = self.approx_gradient(phases, eps=1e-5)
+        g2 = self.approx_gradient(phases, eps=1e-6)
+        ok = np.allclose(g1, g2, rtol=1e-2, atol=1e-6)
+        if not quiet and not ok:
+            print("check_gradient: eps-scales disagree")
+        return bool(ok)
 
     def __repr__(self):
         lines = [f"LCTemplate: norms={self.norms()}, bg={1 - self.norms().sum():.4f}"]

@@ -816,3 +816,131 @@ class TestFrameReviewRegressions:
         assert len(t) == 1
         # no pickle cache file must have been created
         assert not list(tmp_path.glob("*.pickle*"))
+
+
+class TestTemplateAndClockSurface:
+    """LCTemplate/LCFitter method families, Observatory/ClockFile extras."""
+
+    @pytest.fixture(scope="class")
+    def template(self):
+        from pint_tpu.templates.lcprimitives import LCGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        return LCTemplate([LCGaussian(p=[0.04, 0.4])], [0.7])
+
+    def test_template_component_editing(self, template):
+        from pint_tpu.templates.lcprimitives import LCGaussian
+
+        t = template.copy()
+        t.add_primitive(LCGaussian(p=[0.05, 0.8]), norm=0.2)
+        a = t.get_amplitudes()
+        assert a == pytest.approx([0.7 * 0.8, 0.2])
+        t.delete_primitive(-1)
+        assert len(t.primitives) == 1
+        assert t.norm() == pytest.approx(0.76)  # amplitude redistributed
+        with pytest.raises(ValueError):
+            t.delete_primitive()
+
+    def test_template_cdf_delta_peak(self, template):
+        t = template
+        c = t.cdf([0.0, 1.0])
+        assert c[0] == 0.0 and c[1] == pytest.approx(1.0)
+        assert np.all(np.diff(t.cdf(np.linspace(0, 1, 50))) >= 0)
+        assert t.delta() == pytest.approx(0.4) == t.Delta()
+        assert t.closest_to_peak([0.42, 0.9]) == pytest.approx(0.02)
+        assert t.check_bounds() and t.check_gradient()
+
+    def test_fitter_stats_and_methods(self, template):
+        from pint_tpu.templates.lcfitters import LCFitter
+
+        rng = np.random.default_rng(5)
+        t = template.copy()
+        ph = t.random(1500, rng=rng)
+        f = LCFitter(t, ph)
+        assert f.fit_l_bfgs_b(maxiter=300) or f.fit_fmin(maxiter=500)
+        ll = f.loglikelihood()
+        assert f.aic() == pytest.approx(2 * t.num_parameters() - 2 * ll)
+        assert f.bic() > f.aic()
+        chi2, dof = f.chi()
+        assert 0.2 < chi2 / dof < 3.0
+        errs = f.hess_errors()
+        assert np.all(np.isfinite(errs))
+        assert np.isfinite(f.binned_loglikelihood())
+        assert f.binned_gradient().shape == (t.num_parameters(),)
+
+    def test_observatory_registry_helpers(self):
+        from pint_tpu.observatory import Observatory, get_observatory
+
+        assert "gbt" in Observatory.names()
+        na = Observatory.names_and_aliases()
+        assert "1" in na["gbt"]
+        assert get_observatory("gbt").timescale == "utc"
+        # clock data absent in this image -> zero corrections / -inf last
+        assert np.all(Observatory.gps_correction([55000.0]) == 0.0)
+        assert get_observatory("gbt").last_clock_correction_mjd() == -np.inf
+
+    def test_clock_file_merge_and_export(self, tmp_path):
+        from pint_tpu.observatory.clock_file import ClockFile
+
+        c1 = ClockFile(np.array([50000.0, 60000.0]), np.array([0.0, 2.0]),
+                       filename="a")
+        c2 = ClockFile(np.array([51000.0, 59000.0]), np.array([1.0, 1.0]),
+                       filename="b")
+        np.testing.assert_array_equal(c1.time, c1.mjd)
+        np.testing.assert_array_equal(c1.clock, c1.clock_us)
+        m = ClockFile.merge([c1, c2])
+        assert (m.mjd[0], m.mjd[-1]) == (51000.0, 59000.0)  # overlap trim
+        at = np.array([55000.0])
+        assert m.evaluate(at)[0] == pytest.approx(
+            c1.evaluate(at)[0] + c2.evaluate(at)[0])
+        out = tmp_path / "merged.clk"
+        m.export(str(out))
+        r = ClockFile.read(str(out), fmt="tempo2")
+        assert r.evaluate(at)[0] == pytest.approx(m.evaluate(at)[0])
+
+
+class TestTemplateReviewRegressions:
+    def test_fixed_energy_version_pins_energy(self):
+        from pint_tpu.templates.lceprimitives import LCEGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        t = LCTemplate([LCEGaussian(p=[0.03, 0.25], slopes=[0.0, 0.2])],
+                       [0.8])
+        assert t.is_energy_dependent()
+        ph = np.linspace(0.05, 0.95, 30)
+        for en in (2.0, 4.0):
+            fixed = t.get_fixed_energy_version(en)
+            # no-energy call on the snapshot == explicit-energy call on the
+            # original
+            np.testing.assert_allclose(
+                np.asarray(fixed(ph)).ravel(),
+                np.asarray(t(ph, log10_ens=np.full(len(ph), en))).ravel(),
+                rtol=1e-12)
+        # the two energies genuinely differ (slope moves the peak)
+        a = np.asarray(t.get_fixed_energy_version(2.0)(ph)).ravel()
+        b = np.asarray(t.get_fixed_energy_version(4.0)(ph)).ravel()
+        assert np.max(np.abs(a - b)) > 1e-3
+
+    def test_weighted_binned_loglike_matches_unbinned(self):
+        from pint_tpu.templates.lcfitters import LCFitter
+        from pint_tpu.templates.lcprimitives import LCGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        t = LCTemplate([LCGaussian(p=[0.04, 0.4])], [0.7])
+        ph = t.random(3000, rng=np.random.default_rng(2))
+        f = LCFitter(t, ph, weights=np.full(len(ph), 0.5))
+        ub = f.loglikelihood()
+        b = f.binned_loglikelihood(bins=200)
+        assert abs(b - ub) / abs(ub) < 0.02
+
+    def test_last_clock_correction_partial_chain(self, tmp_path):
+        import numpy as np
+
+        from pint_tpu.observatory import TopoObs, get_observatory
+
+        # a site whose chain names a file that cannot be found anywhere
+        site = TopoObs("parity_test_site", [1.0, 2.0, 3.0],
+                       clock_files=["definitely_missing_a.clk",
+                                    "definitely_missing_b.clk"],
+                       include_gps=False, include_bipm=False)
+        assert site.last_clock_correction_mjd() == -np.inf
