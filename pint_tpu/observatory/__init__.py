@@ -64,10 +64,11 @@ class Observatory:
 
     @classmethod
     def names(cls):
-        """All registered observatory names (reference
+        """All registered observatory names (an independent snapshot, so
+        callers can register/clear while iterating; reference
         ``observatory/__init__.py:260``)."""
         _ensure_builtin()
-        return _registry.keys()
+        return list(_registry.keys())
 
     @classmethod
     def names_and_aliases(cls) -> Dict[str, List[str]]:

@@ -185,6 +185,10 @@ class ClockFile:
         if trim:
             lo = max(c.mjd[0] for c in clocks)
             hi = min(c.mjd[-1] for c in clocks)
+            if lo > hi:
+                raise ValueError(
+                    "cannot merge: clock files do not overlap in time "
+                    f"({[c.filename for c in clocks]})")
             mjds = mjds[(mjds >= lo) & (mjds <= hi)]
         total_us = np.zeros_like(mjds)
         for c in clocks:

@@ -185,7 +185,18 @@ class LCFitter:
         """Parameter errors from the likelihood Hessian at the current
         parameters (reference ``lcfitters.py hess_errors``)."""
         x0 = self.template.get_parameters().copy()
-        self.errors = self._hessian_errors(lambda p: self(p), x0)
+
+        def nll(p):
+            # same guard as fit(): a probe stepping into zero density must
+            # register as a huge nll, not inf/exception (inv(H with inf)
+            # silently yields NaN)
+            try:
+                v = self(p)
+            except (ValueError, FloatingPointError):
+                return 1e30
+            return v if np.isfinite(v) else 1e30
+
+        self.errors = self._hessian_errors(nll, x0)
         return self.errors
 
     def bootstrap_errors(self, nsamp: int = 20, fit_kwargs=None,

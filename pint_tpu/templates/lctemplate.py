@@ -155,27 +155,43 @@ class LCTemplate:
 
         return _copy.deepcopy(self)
 
+    def _norms_energy_dependent(self) -> bool:
+        return getattr(self.norms, "is_energy_dependent", lambda: False)()
+
+    def _require_plain_norms(self, what: str) -> None:
+        if self._norms_energy_dependent():
+            raise NotImplementedError(
+                f"{what} on an energy-dependent template would silently "
+                "discard the norm slopes; take get_fixed_energy_version() "
+                "first or edit the ENormAngles directly")
+
     def add_primitive(self, prim, norm: float = 0.1) -> None:
         """Append a pulse component with amplitude ``norm``, scaling the
         existing amplitudes by (1 - norm) so the total stays normalized
         (reference ``lctemplate.py add_primitive``)."""
+        self._require_plain_norms("add_primitive")
         amps = self.get_amplitudes()
         new = np.concatenate([amps * (1.0 - norm), [norm]])
+        old_free = np.asarray(self.norms.free, dtype=bool)
         self.primitives.append(prim)
         self.norms = NormAngles(new)
+        self.norms.free[:len(old_free)] = old_free
 
     def delete_primitive(self, index: int = -1) -> None:
         """Remove a pulse component, redistributing its amplitude over the
         rest (reference ``lctemplate.py delete_primitive``)."""
         if len(self.primitives) == 1:
             raise ValueError("Template must retain at least one component")
+        self._require_plain_norms("delete_primitive")
         amps = self.get_amplitudes()
         keep = np.delete(amps, index)
         total = keep.sum()
         if total > 0:
             keep = keep * amps.sum() / total
+        old_free = np.delete(np.asarray(self.norms.free, dtype=bool), index)
         self.primitives.pop(index)
         self.norms = NormAngles(keep)
+        self.norms.free[:] = old_free
 
     def cdf(self, x, log10_ens=None) -> np.ndarray:
         """Cumulative profile on [0, 1] (reference ``lctemplate.py
@@ -228,9 +244,9 @@ class LCTemplate:
         return float(np.mean(np.asarray(self(phases,
                                              log10_ens=log10_ens))))
 
-    def max_value(self) -> float:
+    def max_value(self, resolution: int = 2048) -> float:
         """Maximum of the profile on a dense grid."""
-        grid = np.linspace(0.0, 1.0, 2048, endpoint=False)
+        grid = np.linspace(0.0, 1.0, int(resolution), endpoint=False)
         return float(np.max(np.asarray(self(grid))))
 
     def check_bounds(self) -> bool:
@@ -243,22 +259,23 @@ class LCTemplate:
             return False
 
     def approx_gradient(self, phases, log10_ens=None,
-                        eps: float = 1e-6) -> np.ndarray:
+                        eps: float = 1e-6, free: bool = True) -> np.ndarray:
         """(nparam, nphase) finite-difference gradient of the pdf wrt the
-        free parameters (reference ``lctemplate.py approx_gradient``)."""
-        p0 = self.get_parameters().copy()
+        free (or, with ``free=False``, all) parameters (reference
+        ``lctemplate.py approx_gradient``)."""
+        p0 = self.get_parameters(free=free).copy()
         out = np.empty((len(p0), len(np.atleast_1d(phases))))
         for i in range(len(p0)):
             for s, sign in ((eps, +1.0), (-2 * eps, -1.0)):
                 p0[i] += s
-                self.set_parameters(p0)
+                self.set_parameters(p0, free=free)
                 v = np.asarray(self(phases, log10_ens=log10_ens))
                 if sign > 0:
                     hi = v
                 else:
                     lo = v
             p0[i] += eps
-            self.set_parameters(p0)
+            self.set_parameters(p0, free=free)
             out[i] = (hi - lo) / (2 * eps)
         return out
 
@@ -276,6 +293,203 @@ class LCTemplate:
         if not quiet and not ok:
             print("check_gradient: eps-scales disagree")
         return bool(ok)
+
+    def set_overall_phase(self, ph: float) -> None:
+        """Move the FIRST component's peak to phase ``ph``, shifting every
+        component rigidly (reference ``lctemplate.py:313``; delegates to
+        :meth:`rotate`)."""
+        self.rotate(float(ph) - self.primitives[0].get_location())
+
+    def norm_ok(self) -> bool:
+        """Total amplitude within [0, 1] (reference
+        ``lctemplate.py:339``)."""
+        return self.norm() <= 1.0
+
+    def has_bridge(self) -> bool:
+        """Reference ``lctemplate.py:86``: bridge components are modeled
+        as ordinary wide primitives here."""
+        return False
+
+    def max(self, resolution: int = 2048) -> float:
+        """Maximum of the profile (reference spelling of
+        :meth:`max_value`)."""
+        return self.max_value(resolution=resolution)
+
+    def get_parameter_names(self, free: bool = True) -> list:
+        """Flat parameter-name list, primitives then norms (reference
+        ``lctemplate.py get_parameter_names``)."""
+        out = []
+        for i, prim in enumerate(self.primitives):
+            n = prim.num_parameters(free=free)
+            base = getattr(prim, "name", type(prim).__name__)
+            out += [f"P{i}_{base}_p{j}" for j in range(n)]
+        out += [f"Norm_a{j}" for j in
+                range(len(self.norms.get_parameters(free=free)))]
+        return out
+
+    def get_free_mask(self) -> np.ndarray:
+        """Boolean mask of free entries over the full parameter vector
+        (reference ``lctemplate.py get_free_mask``)."""
+        masks = [np.asarray(p.free, dtype=bool) for p in self.primitives]
+        masks.append(np.asarray(self.norms.free, dtype=bool))
+        return np.concatenate(masks)
+
+    def free_parameters(self) -> None:
+        """Unfreeze everything (reference ``lctemplate.py
+        free_parameters``)."""
+        for p in self.primitives:
+            p.free[:] = True
+        self.norms.free[:] = True
+
+    def freeze_parameters(self) -> None:
+        """Freeze everything (reference ``lctemplate.py
+        freeze_parameters``)."""
+        for p in self.primitives:
+            p.free[:] = False
+        self.norms.free[:] = False
+
+    def set_errors(self, errs) -> None:
+        """Distribute a flat error vector onto the components (reference
+        ``lctemplate.py set_errors``); stored as ``errors`` attributes."""
+        errs = np.asarray(errs, dtype=np.float64)
+        i = 0
+        for p in self.primitives:
+            n = p.num_parameters()
+            p.errors = errs[i:i + n]
+            i += n
+        self.norms.errors = errs[i:]
+
+    def derivative(self, phases, log10_ens=None,
+                   eps: float = 1e-6) -> np.ndarray:
+        """d(pdf)/d(phase) by central difference (reference
+        ``lctemplate.py derivative``); one implementation shared with
+        :meth:`gradient_phases`."""
+        if log10_ens is None:
+            return self.gradient_phases(phases, eps=eps)
+        ph = np.asarray(phases, dtype=np.float64)
+        hi = np.asarray(self((ph + eps) % 1.0, log10_ens=log10_ens))
+        lo = np.asarray(self((ph - eps) % 1.0, log10_ens=log10_ens))
+        return (hi - lo) / (2 * eps)
+
+    def gradient(self, phases, log10_ens=None, free: bool = True):
+        """Gradient of the pdf wrt the (free or all) parameters — the
+        finite-difference implementation (reference has hand-coded
+        gradients; autodiff/FD replaces them here)."""
+        return self.approx_gradient(phases, log10_ens=log10_ens, free=free)
+
+    def approx_hessian(self, phases, log10_ens=None,
+                       eps: float = 1e-4) -> np.ndarray:
+        """(nparam, nparam, nphase) finite-difference Hessian of the pdf
+        (reference ``lctemplate.py approx_hessian``)."""
+        p0 = self.get_parameters().copy()
+        n = len(p0)
+        ph = np.atleast_1d(np.asarray(phases, dtype=np.float64))
+
+        def f(p):
+            self.set_parameters(p)
+            return np.asarray(self(ph, log10_ens=log10_ens))
+
+        H = np.empty((n, n, len(ph)))
+        for i in range(n):
+            for j in range(i, n):
+                pp = p0.copy(); pp[i] += eps; pp[j] += eps; fpp = f(pp)
+                pm = p0.copy(); pm[i] += eps; pm[j] -= eps; fpm = f(pm)
+                mp = p0.copy(); mp[i] -= eps; mp[j] += eps; fmp = f(mp)
+                mm = p0.copy(); mm[i] -= eps; mm[j] -= eps; fmm = f(mm)
+                H[i, j] = H[j, i] = (fpp - fpm - fmp + fmm) / (4 * eps**2)
+        self.set_parameters(p0)
+        return H
+
+    hessian = approx_hessian
+
+    def check_derivative(self, phases=None, eps: float = 1e-6,
+                         quiet: bool = True) -> bool:
+        """Phase-derivative self-consistency at two eps scales (reference
+        ``lctemplate.py check_derivative``)."""
+        if phases is None:
+            phases = np.linspace(0.05, 0.95, 19)
+        d1 = self.derivative(phases, eps=eps)
+        d2 = self.derivative(phases, eps=eps * 10)
+        return bool(np.allclose(d1, d2, rtol=1e-2, atol=1e-4))
+
+    def single_component(self, index: int) -> "LCTemplate":
+        """Template of one component alone at unit amplitude (reference
+        ``lctemplate.py single_component``)."""
+        import copy as _copy
+
+        return LCTemplate([_copy.deepcopy(self.primitives[index])], [1.0])
+
+    def mean_single_component(self, index: int, phases,
+                              log10_ens=None) -> float:
+        """Mean pdf of one component over the given phases."""
+        return float(np.mean(np.asarray(
+            self.single_component(index)(phases, log10_ens=log10_ens))))
+
+    def _permute_norms(self, order) -> None:
+        """Reorder norm components in place, preserving the norms object
+        TYPE (ENormAngles keeps its slopes) and free mask."""
+        if self._norms_energy_dependent():
+            amps = self.norms._angles_to_norms(self.norms.p[:self.norms.dim])
+            angles = self.norms._norms_to_angles(amps[order])
+            self.norms.p[:self.norms.dim] = angles
+            self.norms.p[self.norms.dim:] = self.norms.p[self.norms.dim:][order]
+            f = self.norms.free
+            f[:self.norms.dim] = f[:self.norms.dim][order]
+            f[self.norms.dim:] = f[self.norms.dim:][order]
+        else:
+            amps = self.get_amplitudes()
+            free = np.asarray(self.norms.free, dtype=bool)[order]
+            self.norms.p[:] = self.norms._norms_to_angles(amps[order])
+            self.norms.free[:] = free
+
+    def order_primitives(self) -> None:
+        """Sort components by peak location (reference
+        ``lctemplate.py order_primitives``)."""
+        order = np.argsort([p.get_location() for p in self.primitives])
+        self.primitives = [self.primitives[i] for i in order]
+        self._permute_norms(order)
+
+    def swap_primitive(self, i: int, j: int = None) -> None:
+        """Swap two components (reference ``lctemplate.py
+        swap_primitive``); default swaps ``i`` with ``i+1``."""
+        j = i + 1 if j is None else j
+        self.primitives[i], self.primitives[j] = \
+            self.primitives[j], self.primitives[i]
+        order = np.arange(len(self.primitives))
+        order[i], order[j] = order[j], order[i]
+        self._permute_norms(order)
+
+    def get_gaussian_prior(self) -> "GaussianPrior":
+        """Default gaussian prior over the free parameters: weak width
+        priors on each primitive's parameters, none on the norms
+        (reference ``lctemplate.py:288``)."""
+        locs, widths, mods = [], [], []
+        for prim in self.primitives:
+            p = prim.get_parameters(free=False)
+            locs += list(p)
+            # generous widths: half the parameter scale, min 0.1
+            widths += [max(0.1, abs(v) * 0.5) for v in p]
+            # ONLY the actual location parameter lives on the circle:
+            # energy-dependent primitives append slopes after the base
+            # vector, so "last entry" would wrap a slope instead
+            loc_idx = getattr(prim, "nb", len(p)) - 1
+            mods += [k == loc_idx for k in range(len(p))]
+        t = self.norms.get_parameters(free=False)
+        locs += list(t)
+        widths += [10.0] * len(t)  # effectively unconstrained
+        mods += [False] * len(t)
+        return GaussianPrior(locs, widths, mods, mask=self.get_free_mask())
+
+    def prof_string(self, outputfile=None) -> str:
+        """Tempo-style .prof text block (reference ``lctemplate.py
+        prof_string``)."""
+        lines = [f"# {type(p).__name__} loc={p.get_location():.6f}"
+                 for p in self.primitives]
+        s = "\n".join(lines) + "\n"
+        if outputfile:
+            with open(outputfile, "w") as f:
+                f.write(s)
+        return s
 
     def __repr__(self):
         lines = [f"LCTemplate: norms={self.norms()}, bg={1 - self.norms().sum():.4f}"]
@@ -335,3 +549,106 @@ def make_twoside_gaussian(center: float, width1: float, width2: float,
 
 #: reference re-export (each template module offers isvector)
 from pint_tpu.templates.lcnorm import isvector  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# template factory helpers (reference lctemplate.py:892-948,975)
+# ---------------------------------------------------------------------------
+
+def get_gauss1(pulse_frac=1, x1=0.5, width1=0.01) -> LCTemplate:
+    """One-gaussian template (reference ``lctemplate.py:923``)."""
+    return LCTemplate([LCGaussian(p=[width1, x1])], [pulse_frac])
+
+
+def get_gauss2(pulse_frac=1, x1=0.1, x2=0.55, ratio=1.5,
+               width1=0.01, width2=0.02, lorentzian=False,
+               bridge_frac=0, skew=False) -> LCTemplate:
+    """Two-peak template, optionally Lorentzian/skewed/bridged (reference
+    ``lctemplate.py:892``)."""
+    from pint_tpu.templates.lcprimitives import (LCGaussian2, LCLorentzian,
+                                                 LCLorentzian2)
+
+    n1, n2 = (np.asarray([ratio, 1.0]) * (1 - bridge_frac)
+              * (pulse_frac / (1.0 + ratio)))
+    if skew:
+        prim = LCLorentzian2 if lorentzian else LCGaussian2
+        p1 = [width1, width1 * (1 + skew), x1]
+        p2 = [width2 * (1 + skew), width2, x2]
+    else:
+        if lorentzian:
+            # NO 2*pi conversion: this port's LCLorentzian takes gamma in
+            # phase units (the reference's engine works in radians)
+            prim = LCLorentzian
+        else:
+            prim = LCGaussian
+        p1, p2 = [width1, x1], [width2, x2]
+    if bridge_frac > 0:
+        nb = bridge_frac * pulse_frac
+        b = LCGaussian(p=[0.1, (x2 + x1) / 2])
+        return LCTemplate([prim(p=p1), b, prim(p=p2)], [n1, nb, n2])
+    return LCTemplate([prim(p=p1), prim(p=p2)], [n1, n2])
+
+
+def get_2pb(pulse_frac=0.9, lorentzian=False) -> LCTemplate:
+    """Two peaks + gaussian bridge (reference ``lctemplate.py:928``)."""
+    from pint_tpu.templates.lcprimitives import LCLorentzian
+
+    prim = LCLorentzian if lorentzian else LCGaussian
+    p1 = prim(p=[0.03, 0.1])
+    b = LCGaussian(p=[0.15, 0.3])
+    p2 = prim(p=[0.03, 0.55])
+    return LCTemplate([p1, b, p2], [0.3 * pulse_frac, 0.4 * pulse_frac,
+                                    0.3 * pulse_frac])
+
+
+def adaptive_samples(func, npt: int, log10_ens=3, nres: int = 200):
+    """Phase sample points concentrated where ``func`` varies fastest
+    (reference ``lctemplate.py:950``): inverse-CDF placement on the
+    |df/dphi|-weighted measure."""
+    grid = np.linspace(0.0, 1.0, nres + 1)
+    try:
+        vals = np.asarray(func(grid, log10_ens))
+    except TypeError:
+        vals = np.asarray(func(grid))
+    dens = np.abs(np.gradient(vals)) + 1e-9
+    cdf = np.concatenate([[0.0], np.cumsum(0.5 * (dens[1:] + dens[:-1]))])
+    cdf /= cdf[-1]
+    return np.interp(np.linspace(0.0, 1.0, npt), cdf, grid)
+
+
+class GaussianPrior:
+    """Quadratic (gaussian) penalty on selected template parameters
+    (reference ``lctemplate.py:975``; used by the template MCMC)."""
+
+    def __init__(self, locations, widths, mod, mask=None):
+        locations = np.asarray(locations, dtype=np.float64)
+        self.mod = np.asarray(mod, dtype=bool)
+        self.x0 = np.where(self.mod, np.mod(locations, 1), locations)
+        self.s0 = np.asarray(widths, dtype=np.float64) * 2**0.5
+        if mask is None:
+            self.mask = np.ones(len(locations), dtype=bool)
+        else:
+            self.mask = np.asarray(mask, dtype=bool)
+            self.x0 = self.x0[self.mask]
+            self.s0 = self.s0[self.mask]
+            self.mod = self.mod[self.mask]
+
+    def __len__(self) -> int:
+        return int(self.mask.sum())
+
+    def __call__(self, parameters) -> float:
+        if not np.any(self.mask):
+            return 0.0
+        p = np.asarray(parameters, dtype=np.float64)[self.mask]
+        p = np.where(self.mod, np.mod(p, 1), p)
+        return float(np.sum(((p - self.x0) / self.s0) ** 2))
+
+    def gradient(self, parameters) -> np.ndarray:
+        parameters = np.asarray(parameters, dtype=np.float64)
+        out = np.zeros(len(self.mask))
+        if not np.any(self.mask):
+            return out
+        p = parameters[self.mask]
+        p = np.where(self.mod, np.mod(p, 1), p)
+        out[self.mask] = 2.0 * (p - self.x0) / self.s0**2
+        return out

@@ -944,3 +944,116 @@ class TestTemplateReviewRegressions:
                                     "definitely_missing_b.clk"],
                        include_gps=False, include_bipm=False)
         assert site.last_clock_correction_mjd() == -np.inf
+
+
+class TestTemplateFactoriesAndLongTail:
+    def test_factories(self):
+        from pint_tpu.templates.lctemplate import (get_2pb, get_gauss1,
+                                                   get_gauss2)
+
+        t1 = get_gauss1()
+        assert len(t1.primitives) == 1 and t1.norm() == pytest.approx(1.0)
+        t2 = get_gauss2(pulse_frac=0.8, bridge_frac=0.1)
+        assert len(t2.primitives) == 3 and t2.norm() == pytest.approx(0.8)
+        assert len(get_gauss2(lorentzian=True, skew=0.2).primitives) == 2
+        tb = get_2pb()
+        assert len(tb.primitives) == 3 and tb.norm() == pytest.approx(0.9)
+
+    def test_adaptive_samples_concentrate(self):
+        from pint_tpu.templates.lctemplate import (adaptive_samples,
+                                                   get_gauss1)
+
+        t = get_gauss1(width1=0.02)
+        s = adaptive_samples(t, 60)
+        assert s[0] == 0.0 and s[-1] == pytest.approx(1.0)
+        assert np.mean(np.abs(s - 0.5) < 0.1) > 0.3  # clustered at the peak
+
+    def test_gaussian_prior(self):
+        from pint_tpu.templates.lctemplate import GaussianPrior
+
+        gp = GaussianPrior([0.5, 0.1], [0.01, 0.02], [True, True],
+                           mask=[True, False])
+        assert len(gp) == 1
+        assert gp(np.array([0.5, 99.0])) == 0.0
+        assert gp(np.array([0.51, 99.0])) > 0
+        g = gp.gradient(np.array([0.51, 99.0]))
+        assert g[1] == 0 and g[0] > 0
+
+    def test_template_phase_and_parameter_management(self):
+        from pint_tpu.templates.lctemplate import get_gauss2
+
+        t = get_gauss2()
+        t.set_overall_phase(0.3)
+        assert t.primitives[0].get_location() == pytest.approx(0.3)
+        assert t.norm_ok()
+        n = t.num_parameters()
+        t.freeze_parameters()
+        assert t.num_parameters() == 0
+        t.free_parameters()
+        assert t.num_parameters() == n
+        assert len(t.get_parameter_names()) == n
+        assert t.get_free_mask().sum() == n
+        assert t.check_derivative()
+        assert t.gradient([0.25]).shape[0] == n
+        assert t.approx_hessian(np.array([0.3])).shape == (n, n, 1)
+        t.order_primitives()
+        locs = [p.get_location() for p in t.primitives]
+        assert locs == sorted(locs)
+        assert t.single_component(0).norm() == pytest.approx(1.0)
+        assert len(t.get_gaussian_prior()) == n
+
+
+class TestTemplateFactoryReviewRegressions:
+    def test_lorentzian_width_in_phase_units(self):
+        from pint_tpu.templates.lctemplate import get_gauss2
+
+        t = get_gauss2(lorentzian=True, width1=0.01, width2=0.01,
+                       x1=0.3, x2=0.7)
+        near = np.linspace(0.2, 0.4, 2001)
+        v = np.asarray(t(near))
+        base = np.asarray(t(np.array([0.5])))[0]
+        half = near[v >= (v.max() + base) / 2]
+        hwhm = (half.max() - half.min()) / 2
+        assert 0.005 < hwhm < 0.02  # ~width1, not 2*pi*width1
+
+    def test_energy_dependent_norms_survive_reorder(self):
+        from pint_tpu.templates.lcenorm import ENormAngles
+        from pint_tpu.templates.lceprimitives import LCEGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        t = LCTemplate(
+            [LCEGaussian(p=[0.03, 0.75], slopes=[0.0, 0.2]),
+             LCEGaussian(p=[0.04, 0.25], slopes=[0.0, -0.1])],
+            ENormAngles([0.5, 0.3], slopes=[0.1, -0.2]))
+        slopes_by_amp = {0.5: 0.1, 0.3: -0.2}
+        t.order_primitives()
+        assert t.norms.is_energy_dependent()
+        amps = t.get_amplitudes()
+        # the (amplitude, slope) pairing is preserved through the permute
+        assert amps[0] == pytest.approx(0.3)
+        np.testing.assert_allclose(
+            t.norms.p[t.norms.dim:],
+            [slopes_by_amp[round(a, 6)] for a in amps])
+        with pytest.raises(NotImplementedError):
+            t.add_primitive(LCEGaussian(p=[0.05, 0.5]))
+
+    def test_prior_wraps_only_true_location(self):
+        from pint_tpu.templates.lceprimitives import LCEGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        t = LCTemplate([LCEGaussian(p=[0.03, 0.25], slopes=[0.0, -0.2])],
+                       [0.8])
+        gp = t.get_gaussian_prior()
+        assert list(gp.mod[:4]) == [False, True, False, False]
+
+    def test_disjoint_clock_merge_raises(self):
+        from pint_tpu.observatory.clock_file import ClockFile
+
+        a = ClockFile(np.array([50000.0, 50010.0]), np.zeros(2),
+                      filename="a")
+        b = ClockFile(np.array([60000.0, 60010.0]), np.zeros(2),
+                      filename="b")
+        with pytest.raises(ValueError):
+            ClockFile.merge([a, b])
+        m = ClockFile.merge([a, b], trim=False)  # union mode still works
+        assert len(m.mjd) == 4
