@@ -54,5 +54,21 @@ class AbsPhase(Component):
         )
         return self._tzr_toas
 
+    #: reference spelling (``absolute_phase.py:80``)
+    get_TZR_toa = get_TZR_toas
+
+    def make_TZR_toa(self, toas):
+        """Fill TZRMJD/TZRSITE/TZRFRQ from the given TOAs when unset
+        (reference ``absolute_phase.py:130``)."""
+        import numpy as np
+
+        if self.TZRMJD.value is None:
+            self.TZRMJD.value = float(np.asarray(toas.get_mjds())[0])
+        if not self.TZRSITE.value:
+            self.TZRSITE.value = str(toas.obs[0])
+        if self.TZRFRQ.value is None:
+            self.TZRFRQ.value = float(toas.freq_mhz[0])
+        self._tzr_toas = None
+
     def clear_cache(self):
         self._tzr_toas = None

@@ -148,6 +148,84 @@ class DispersionDMX(Dispersion):
             int(name[4:]) for name in self.params if name.startswith("DMX_")
         )
 
+    # -- reference range-management API (dispersion_model.py:343-470) -------
+    def get_indices(self):
+        """Indices of the DMX ranges in use (reference
+        ``dispersion_model.py get_indices``)."""
+        import numpy as _np
+
+        return _np.array(self.dmx_indices)
+
+    def add_DMX_range(self, mjd_start, mjd_end, index=None, dmx=0.0,
+                      frozen=True):
+        """Add one DMX range (reference ``dispersion_model.py:343``);
+        returns the assigned index."""
+        if index is None:
+            index = max(self.dmx_indices, default=0) + 1
+        index = int(index)
+        if float(mjd_end) < float(mjd_start):
+            raise ValueError("mjd_end must come after mjd_start")
+        nm = f"DMX_{index:04d}"
+        if nm in self._params_dict and self._params_dict[nm].value not in (None,):
+            if index in self.dmx_indices and \
+                    self._params_dict.get(f"DMXR1_{index:04d}") is not None \
+                    and self._params_dict[f"DMXR1_{index:04d}"].value is not None:
+                raise ValueError(f"DMX index {index} already in use")
+        for pre, val, fr in (("DMX_", float(dmx), bool(frozen)),
+                             ("DMXR1_", float(mjd_start), True),
+                             ("DMXR2_", float(mjd_end), True)):
+            pnm = f"{pre}{index:04d}"
+            if pnm in self._params_dict:
+                self._params_dict[pnm].value = val
+                if pre == "DMX_":
+                    self._params_dict[pnm].frozen = fr
+            else:
+                exemplar = next(self._params_dict[q] for q in self.params
+                                if q.startswith(pre))
+                p = exemplar.new_param(index, value=val)
+                if pre == "DMX_":
+                    p.frozen = fr
+                self.add_param(p)
+        self.setup()
+        if self._parent is not None:
+            self._parent._cache.clear()
+        return index
+
+    def add_DMX_ranges(self, mjd_starts, mjd_ends, indices=None, dmxs=0.0,
+                       frozens=True):
+        """Add several DMX ranges (reference ``dispersion_model.py
+        add_DMX_ranges``)."""
+        import numpy as _np
+
+        mjd_starts = _np.atleast_1d(mjd_starts)
+        mjd_ends = _np.atleast_1d(mjd_ends)
+        n = len(mjd_starts)
+        if len(mjd_ends) != n:
+            raise ValueError("mjd_starts and mjd_ends must match in length")
+        if indices is None:
+            start = max(self.dmx_indices, default=0)
+            indices = list(range(start + 1, start + 1 + n))
+        dmxs = _np.broadcast_to(_np.atleast_1d(dmxs), (n,))
+        frozens = _np.broadcast_to(_np.atleast_1d(frozens), (n,))
+        if len(set(int(i) for i in indices)) != n:
+            raise ValueError("Duplicate indices in add_DMX_ranges")
+        return [self.add_DMX_range(s0, e0, index=int(i), dmx=d, frozen=bool(f))
+                for s0, e0, i, d, f in zip(mjd_starts, mjd_ends, indices,
+                                           dmxs, frozens)]
+
+    def remove_DMX_range(self, index):
+        """Remove DMX range(s) by index (reference ``dispersion_model.py
+        remove_DMX_range``)."""
+        import numpy as _np
+
+        for idx in _np.atleast_1d(index):
+            idx = int(idx)
+            for pre in ("DMX_", "DMXR1_", "DMXR2_"):
+                self.remove_param(f"{pre}{idx:04d}")
+        self.setup()
+        if self._parent is not None:
+            self._parent._cache.clear()
+
     def validate(self):
         for i in self.dmx_indices:
             for pre in ("DMXR1_", "DMXR2_"):

@@ -46,6 +46,65 @@ class PhaseJump(PhaseComponent):
             jphase = jphase + pv.get(j, 0.0) * F0 * ctx["masks"][j]
         return Phase.from_float(jphase)
 
+    # -- reference pintk helper API (jump.py:156-290) -----------------------
+    def get_jump_param_objects(self):
+        """The maskParameter objects of this component's jumps (reference
+        ``jump.py:156``)."""
+        return [self._params_dict[j] for j in self.jumps]
+
+    def add_jump_and_flags(self, toa_flags, value: float = 0.0,
+                           frozen: bool = False) -> str:
+        """Create a new gui-style jump over the given per-TOA flag dicts
+        (reference ``jump.py:196``: pintk passes the selected rows of the
+        flags column); stamps ``-gui_jump`` and returns the new parameter
+        name."""
+        used = []
+        for j in self.jumps:
+            p = self._params_dict[j]
+            if getattr(p, "key", None) == "-gui_jump":
+                used += [int(v) for v in p.key_value]
+        ind = max(used, default=0) + 1
+        toa_flags = list(toa_flags)
+        # validate EVERYTHING before mutating anything: a raise must not
+        # leave orphan flags pointing at a jump that was never created
+        for fl in toa_flags:
+            if fl.get("gui_jump"):
+                raise ValueError(
+                    "A selected TOA is already jumped by a gui jump; "
+                    "unjump it first")
+        for fl in toa_flags:
+            fl["gui_jump"] = str(ind)
+        # reuse JUMP1 when it is the unset ctor exemplar
+        exemplar = self._params_dict.get("JUMP1")
+        if len(self.jumps) == 1 and exemplar is not None \
+                and getattr(exemplar, "key", None) is None:
+            exemplar.key = "-gui_jump"
+            exemplar.key_value = [str(ind)]
+            exemplar.value = float(value)
+            exemplar.frozen = frozen
+            name = "JUMP1"
+        else:
+            idx = max(int(j[4:]) for j in self.jumps) + 1
+            self.add_param(maskParameter("JUMP", index=idx, key="-gui_jump",
+                                         key_value=[str(ind)], units="s",
+                                         value=float(value), frozen=frozen),
+                           setup=True)
+            name = f"JUMP{idx}"
+        self.setup()
+        if self._parent is not None:
+            self._parent._cache.clear()
+            self._parent.setup()
+        return name
+
+    def delete_not_all_jump_toas(self, toa_flags, jump_num: int) -> None:
+        """Remove the gui-jump flag from a SUBSET of a jump's TOAs
+        (reference ``jump.py:256``); the jump parameter itself stays."""
+        for fl in (toa_flags or []):
+            if fl.get("gui_jump") == str(int(jump_num)):
+                del fl["gui_jump"]
+        if self._parent is not None:
+            self._parent._cache.clear()
+
     def get_number_of_jumps(self) -> int:
         return len(self.jumps)
 

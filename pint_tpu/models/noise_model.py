@@ -230,6 +230,13 @@ class ScaleToaError(NoiseComponent):
         return out
 
 
+    def sigma_scaled_cov_matrix(self, toas) -> np.ndarray:
+        """diag(scaled sigma^2) (reference ``noise_model.py
+        sigma_scaled_cov_matrix``)."""
+        sigma = self._parent.scaled_toa_uncertainty(toas)
+        return np.diag(np.asarray(sigma) ** 2)
+
+
 class ScaleDmError(NoiseComponent):
     """DMEFAC/DMEQUAD scaling of wideband DM uncertainties (reference
     ``noise_model.py:223``)."""
@@ -259,6 +266,12 @@ class ScaleDmError(NoiseComponent):
             idx = par.select_toa_mask(toas)
             out[idx] *= par.value
         return out
+
+    def dm_sigma_scaled_cov_matrix(self, toas) -> np.ndarray:
+        """diag(scaled DM sigma^2) (reference ``noise_model.py
+        dm_sigma_scaled_cov_matrix``)."""
+        sigma = self._parent.scaled_dm_uncertainty(toas)
+        return np.diag(np.asarray(sigma) ** 2)
 
 
 class EcorrNoise(NoiseComponent):
@@ -316,12 +329,63 @@ class EcorrNoise(NoiseComponent):
         U, w = self.basis_weight_pair(model, toas)
         return (U * w) @ U.T
 
+    # -- reference-named surface (noise_model.py:327-440) -------------------
+    def get_ecorrs(self) -> list:
+        """The ECORR maskParameters in use (reference
+        ``noise_model.py:389``)."""
+        return [self._params_dict[p] for p in self._masks_of("ECORR")
+                if self._params_dict[p].value is not None]
+
+    def get_noise_basis(self, toas) -> np.ndarray:
+        """The quantization matrix U (reference ``noise_model.py:392``)."""
+        return self.basis_weight_pair(self._parent, toas)[0]
+
+    def get_noise_weights(self, toas) -> np.ndarray:
+        """Per-epoch weights ECORR^2 [s^2] (reference
+        ``noise_model.py get_noise_weights``)."""
+        return self.basis_weight_pair(self._parent, toas)[1]
+
+    def ecorr_basis_weight_pair(self, toas):
+        """Reference spelling (``noise_model.py
+        ecorr_basis_weight_pair``)."""
+        return self.basis_weight_pair(self._parent, toas)
+
+    def ecorr_cov_matrix(self, toas) -> np.ndarray:
+        """Reference spelling (``noise_model.py ecorr_cov_matrix``)."""
+        return self.cov_matrix(self._parent, toas)
+
 
 class _PLNoiseBase(NoiseComponent):
-    """Shared machinery of the power-law Fourier GP components."""
+    """Shared machinery of the power-law Fourier GP components.
+
+    Each subclass sets ``_pl_prefix`` (rn/dm/chrom/sw) and gets the
+    reference-spelled ``pl_<prefix>_basis_weight_pair`` /
+    ``pl_<prefix>_cov_matrix`` methods generated in ``__init_subclass__``
+    — defined here, discoverably, instead of module-tail monkey-patching.
+    """
 
     introduces_correlated_errors = True
     is_time_correlated = True
+    #: reference naming infix: pl_<infix>_basis_weight_pair
+    _pl_prefix = ""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.__dict__.get("_pl_prefix"):
+            pre = cls._pl_prefix
+
+            def pair(self, toas):
+                return self.basis_weight_pair(self._parent, toas)
+
+            def cov(self, toas):
+                return self.cov_matrix(self._parent, toas)
+
+            pair.__doc__ = (f"(basis, weights) (reference ``noise_model.py "
+                            f"pl_{pre}_basis_weight_pair``).")
+            cov.__doc__ = (f"Covariance contribution (reference "
+                           f"``noise_model.py pl_{pre}_cov_matrix``).")
+            setattr(cls, f"pl_{pre}_basis_weight_pair", pair)
+            setattr(cls, f"pl_{pre}_cov_matrix", cov)
 
     #: subclass config: (amp par, gam par, nmode par, nlog par, logfac par,
     #: tspan par or None, default number of linear modes)
@@ -386,6 +450,7 @@ class PLRedNoise(_PLNoiseBase):
 
     register = True
     category = "pl_red_noise"
+    _pl_prefix = "rn"
     _plc = ("TNREDAMP", "TNREDGAM", "TNREDC", "TNREDFLOG",
             "TNREDFLOG_FACTOR", "TNREDTSPAN", 30)
 
@@ -421,6 +486,7 @@ class PLDMNoise(_PLNoiseBase):
 
     register = True
     category = "pl_DM_noise"
+    _pl_prefix = "dm"
     introduces_dm_errors = True
     _plc = ("TNDMAMP", "TNDMGAM", "TNDMC", "TNDMFLOG",
             "TNDMFLOG_FACTOR", "TNDMTSPAN", 30)
@@ -444,6 +510,7 @@ class PLChromNoise(_PLNoiseBase):
 
     register = True
     category = "pl_chrom_noise"
+    _pl_prefix = "chrom"
     _plc = ("TNCHROMAMP", "TNCHROMGAM", "TNCHROMC", "TNCHROMFLOG",
             "TNCHROMFLOG_FACTOR", "TNCHROMTSPAN", 30)
 
@@ -470,6 +537,7 @@ class PLSWNoise(_PLNoiseBase):
 
     register = True
     category = "pl_sw_noise"
+    _pl_prefix = "sw"
     _plc = ("TNSWAMP", "TNSWGAM", "TNSWC", "TNSWFLOG",
             "TNSWFLOG_FACTOR", None, 100)
 
@@ -524,3 +592,4 @@ def get_ecorr_nweights(t_s, dt: float = 1.0, nmin: int = 2) -> int:
     ``noise_model.py get_ecorr_nweights``)."""
     return len(ecorr_epochs(np.asarray(t_s, dtype=np.float64), dt=dt,
                             nmin=nmin))
+

@@ -524,6 +524,18 @@ class maskParameter(floatParameter):
             return True
         return key in (a.upper() for a in self.aliases)
 
+    def compare_key_value(self, other_param) -> bool:
+        """True when this mask selects the same TOAs as ``other_param``
+        (same key and key values, order-insensitive; reference
+        ``parameter.py:2170``)."""
+        if getattr(other_param, "key", None) is None and self.key is None:
+            return True
+        if (self.key or "").lstrip("-") != \
+                (getattr(other_param, "key", "") or "").lstrip("-"):
+            return False
+        return sorted(map(str, self.key_value)) == \
+            sorted(map(str, getattr(other_param, "key_value", [])))
+
     def new_param(self, index: int, **overrides) -> "maskParameter":
         kw = dict(units=self.units, description=self.description, frozen=True,
                   aliases=list(self.aliases))

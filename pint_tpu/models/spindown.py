@@ -49,6 +49,12 @@ class Spindown(PhaseComponent):
         if self.F0.value is None:
             raise MissingParameter("Spindown", "F0")
 
+    @property
+    def F_terms(self):
+        """The F0..Fn Parameter objects in order (reference
+        ``spindown.py F_terms``)."""
+        return [self._params_dict[f"F{i}"] for i in range(self.num_spin_terms)]
+
     def get_spin_terms(self, pv):
         return [pv.get(f"F{i}", 0.0) for i in range(self.num_spin_terms)]
 

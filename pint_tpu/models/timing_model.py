@@ -1712,6 +1712,63 @@ class AllComponents:
                 out.setdefault(p, []).append(cname)
         return out
 
+    @property
+    def component_category_map(self) -> Dict[str, str]:
+        """{component name: category} (reference
+        ``timing_model.py component_category_map``)."""
+        return {k: c.category for k, c in self.components.items()}
+
+    @property
+    def category_component_map(self) -> Dict[str, List[str]]:
+        """{category: [component names]} (reference
+        ``timing_model.py category_component_map``)."""
+        out: Dict[str, List[str]] = {}
+        for k, c in self.components.items():
+            out.setdefault(c.category, []).append(k)
+        return out
+
+    @property
+    def component_unique_params(self) -> Dict[str, List[str]]:
+        """{component: params hosted by no other component} (reference
+        ``timing_model.py component_unique_params``)."""
+        p2c = self.param_component_map
+        out: Dict[str, List[str]] = {}
+        for k, c in self.components.items():
+            out[k] = [p for p in c.params if len(p2c[p]) == 1]
+        return out
+
+    def param_to_unit(self, name: str) -> str:
+        """Unit string of a parameter or alias (reference
+        ``timing_model.py param_to_unit``)."""
+        for comp in self.components.values():
+            hit = comp.match_param_alias(name)
+            if hit is not None:
+                return comp._params_dict[hit].units
+        pint_name, _ = self.alias_to_pint_param(name)
+        from pint_tpu.models.parameter import split_prefixed_name
+
+        prefix, _i = split_prefixed_name(pint_name)
+        for comp in self.components.values():
+            for p in comp.params:
+                if p.startswith(prefix):
+                    return comp._params_dict[p].units
+        raise ValueError(f"Unknown parameter {name!r}")
+
+    def repeatable_param(self) -> set:
+        """Names (and aliases) of repeatable parameters (reference
+        ``timing_model.py repeatable_param``)."""
+        out = set()
+        for comp in self.components.values():
+            for p in comp.params:
+                par = comp._params_dict[p]
+                if getattr(par, "repeatable", False):
+                    # the repeatable KEY is the family prefix (JUMP, EFAC),
+                    # not the indexed instance name (JUMP1)
+                    out.add(getattr(par, "prefix", par.name))
+                    out.update(a.rstrip("0123456789") if a[-1:].isdigit()
+                               else a for a in par.aliases)
+        return out
+
     def search_binary_components(self, system_name: str) -> Component:
         """The binary component implementing ``system_name`` (e.g. 'ELL1');
         raises UnknownBinaryModel otherwise (reference

@@ -29,6 +29,14 @@ class _WaveXBase(DelayComponent):
     prefixes = ("WXFREQ_", "WXSIN_", "WXCOS_")
     epoch_name = "WXEPOCH"
 
+    def _exemplar(self, pre):
+        """Any existing member of the ``pre`` family (NOT hardcoded 0001:
+        index 1 may have been removed)."""
+        for p in self.params:
+            if p.startswith(pre):
+                return self._params_dict[p]
+        raise KeyError(f"No {pre} parameter left to use as an exemplar")
+
     def setup(self):
         pf = self.prefixes[0]
         self.indices = sorted(int(p[len(pf):]) for p in self.params
@@ -38,7 +46,7 @@ class _WaveXBase(DelayComponent):
             for pre in self.prefixes[1:]:
                 nm = f"{pre}{i:04d}"
                 if nm not in self._params_dict:
-                    self.add_param(self._params_dict[f"{pre}0001"].new_param(i, value=0.0))
+                    self.add_param(self._exemplar(pre).new_param(i, value=0.0))
 
     def validate(self):
         if getattr(self, self.epoch_name).value is None:
@@ -50,6 +58,69 @@ class _WaveXBase(DelayComponent):
         for i in self.indices:
             if self._params_dict[f"{pf}{i:04d}"].value in (None, 0.0):
                 raise MissingParameter(type(self).__name__, f"{pf}{i:04d}")
+
+    # -- reference component-management API (wavex.py:72-260) ---------------
+    def get_indices(self) -> "np.ndarray":
+        """Indices of the components in use (reference
+        ``wavex.py get_indices``)."""
+        return np.array(self.indices)
+
+    def _add_component(self, freq, index=None, sin=0.0, cos=0.0,
+                       frozen=True):
+        fpre, spre, cpre = self.prefixes
+        if index is None:
+            index = max(self.indices, default=0) + 1
+        index = int(index)
+        if f"{fpre}{index:04d}" in self._params_dict \
+                and self._params_dict[f"{fpre}{index:04d}"].value is not None:
+            raise ValueError(f"Index {index} already in use ({fpre})")
+        for pre, val, fr in ((fpre, float(freq), True),
+                             (spre, float(sin), frozen),
+                             (cpre, float(cos), frozen)):
+            nm = f"{pre}{index:04d}"
+            if nm in self._params_dict:
+                self._params_dict[nm].value = val
+                self._params_dict[nm].frozen = bool(fr) if pre != fpre \
+                    else self._params_dict[nm].frozen
+            else:
+                self.add_param(self._exemplar(pre).new_param(
+                    index, value=val, frozen=bool(fr)))
+        self.setup()
+        if self._parent is not None:
+            self._parent._cache.clear()
+        return index
+
+    def _remove_component(self, index) -> None:
+        idxs = {int(i) for i in np.atleast_1d(index)}
+        if idxs >= set(self.indices):
+            # refuse BEFORE mutating: a raise must leave the model intact
+            raise ValueError(
+                "Removing the last component would leave the model unable "
+                "to evaluate; delete the component instead")
+        for idx in idxs:
+            for pre in self.prefixes:
+                self.remove_param(f"{pre}{idx:04d}")
+        self.setup()
+        if self._parent is not None:
+            self._parent._cache.clear()
+
+    def _add_components(self, freqs, indices=None, sins=0.0, coses=0.0,
+                        frozens=True):
+        freqs = np.atleast_1d(freqs)
+        n = len(freqs)
+        if indices is None:
+            start = max(self.indices, default=0)
+            indices = list(range(start + 1, start + 1 + n))
+        sins = np.broadcast_to(np.atleast_1d(sins), (n,))
+        coses = np.broadcast_to(np.atleast_1d(coses), (n,))
+        frozens = np.broadcast_to(np.atleast_1d(frozens), (n,))
+        if len(set(int(i) for i in indices)) != n:
+            raise ValueError("Duplicate indices in add_components")
+        out = []
+        for f, i, si, c, fr in zip(freqs, indices, sins, coses, frozens):
+            out.append(self._add_component(f, index=int(i), sin=si, cos=c,
+                                           frozen=bool(fr)))
+        return out
 
     def series(self, pv, batch, acc_delay):
         """sum_i [ SIN_i sin(2 pi f_i dt) + COS_i cos(2 pi f_i dt) ]."""
@@ -87,6 +158,24 @@ class WaveX(_WaveXBase):
     def delay_func(self, pv, batch, ctx, acc_delay):
         return self.series(pv, batch, acc_delay)
 
+    def add_wavex_component(self, wxfreq, index=None, wxsin=0, wxcos=0,
+                            frozen=True):
+        """Add one WaveX component (reference ``wavex.py:72``); returns
+        its index."""
+        return self._add_component(wxfreq, index=index, sin=wxsin,
+                                   cos=wxcos, frozen=frozen)
+
+    def add_wavex_components(self, wxfreqs, indices=None, wxsins=0,
+                             wxcoses=0, frozens=True):
+        """Add several WaveX components (reference ``wavex.py:150``)."""
+        return self._add_components(wxfreqs, indices=indices, sins=wxsins,
+                                    coses=wxcoses, frozens=frozens)
+
+    def remove_wavex_component(self, index):
+        """Remove component(s) by index (reference ``wavex.py
+        remove_wavex_component``)."""
+        self._remove_component(index)
+
 
 class DMWaveX(_WaveXBase):
     """Fourier DM-noise: the series is a DM in pc/cm^3
@@ -117,6 +206,22 @@ class DMWaveX(_WaveXBase):
         return dm * DMconst / freq**2
 
 
+    def add_dmwavex_component(self, dmwxfreq, index=None, dmwxsin=0,
+                              dmwxcos=0, frozen=True):
+        """Add one DMWaveX component (reference ``dmwavex.py``)."""
+        return self._add_component(dmwxfreq, index=index, sin=dmwxsin,
+                                   cos=dmwxcos, frozen=frozen)
+
+    def add_dmwavex_components(self, dmwxfreqs, indices=None, dmwxsins=0,
+                               dmwxcoses=0, frozens=True):
+        return self._add_components(dmwxfreqs, indices=indices,
+                                    sins=dmwxsins, coses=dmwxcoses,
+                                    frozens=frozens)
+
+    def remove_dmwavex_component(self, index):
+        self._remove_component(index)
+
+
 class CMWaveX(_WaveXBase):
     """Fourier chromatic-noise; needs TNCHROMIDX (from ChromaticCM)
     (reference ``cmwavex.py:15``)."""
@@ -142,3 +247,18 @@ class CMWaveX(_WaveXBase):
         freq = self.barycentric_freq(pv, batch)
         alpha = pv.get("TNCHROMIDX", 4.0)
         return cm * DMconst * jnp.power(freq, -alpha)
+
+    def add_cmwavex_component(self, cmwxfreq, index=None, cmwxsin=0,
+                              cmwxcos=0, frozen=True):
+        """Add one CMWaveX component (reference ``cmwavex.py``)."""
+        return self._add_component(cmwxfreq, index=index, sin=cmwxsin,
+                                   cos=cmwxcos, frozen=frozen)
+
+    def add_cmwavex_components(self, cmwxfreqs, indices=None, cmwxsins=0,
+                               cmwxcoses=0, frozens=True):
+        return self._add_components(cmwxfreqs, indices=indices,
+                                    sins=cmwxsins, coses=cmwxcoses,
+                                    frozens=frozens)
+
+    def remove_cmwavex_component(self, index):
+        self._remove_component(index)

@@ -208,6 +208,41 @@ class TopoObs(Observatory):
     def earth_location_itrf(self):
         return self.itrf_xyz
 
+    def get_dict(self) -> dict:
+        """Site definition as an ``observatories.json``-style dict
+        (reference ``topo_obs.py:242``)."""
+        out = {"itrf_xyz": [float(v) for v in self.itrf_xyz],
+               "aliases": list(self.aliases)}
+        if self.tempo_code:
+            out["tempo_code"] = self.tempo_code
+        if self.itoa_code:
+            out["itoa_code"] = self.itoa_code
+        if self.clock_file_names:
+            out["clock_file"] = list(self.clock_file_names)
+            out["clock_fmt"] = self.clock_fmt
+        return {self.name: out}
+
+    def get_json(self) -> str:
+        """Site definition as JSON (reference ``topo_obs.py:257``)."""
+        import json as _json
+
+        return _json.dumps(self.get_dict())
+
+    def separation(self, other, method: str = "cartesian") -> float:
+        """Distance [m] to another ground site (reference
+        ``topo_obs.py:261``): straight-line ('cartesian') or
+        great-circle at the mean radius ('geodesic')."""
+        a = np.asarray(self.itrf_xyz, dtype=np.float64)
+        b = np.asarray(other.itrf_xyz, dtype=np.float64)
+        if method == "cartesian":
+            return float(np.linalg.norm(a - b))
+        if method == "geodesic":
+            ra, rb = np.linalg.norm(a), np.linalg.norm(b)
+            cosang = np.clip(np.dot(a, b) / (ra * rb), -1.0, 1.0)
+            return float(0.5 * (ra + rb) * np.arccos(cosang))
+        raise ValueError("method must be 'cartesian' or 'geodesic'")
+
+
     def _site_clock_files(self, limits: str = "warn"):
         return [
             find_clock_file(n, fmt=self.clock_fmt, limits=limits)

@@ -45,6 +45,12 @@ class Phase(NamedTuple):
         k, f = _split(jnp.asarray(frac, dtype=jnp.float64))
         return cls(int_ + k, f)
 
+    @property
+    def value(self) -> jnp.ndarray:
+        """Collapsed float phase ``int_ + frac`` (reference ``phase.py
+        value``; loses the split precision — for display/rough use)."""
+        return self.int_ + self.frac
+
     def __add__(self, other: "Phase") -> "Phase":
         if not isinstance(other, Phase):
             other = Phase.from_float(other)

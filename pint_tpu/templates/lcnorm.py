@@ -58,6 +58,83 @@ class NormAngles:
     def __call__(self) -> np.ndarray:
         return self._angles_to_norms(self.p)
 
+    def copy(self) -> "NormAngles":
+        import copy as _copy
+
+        return _copy.deepcopy(self)
+
+    def get_total(self) -> float:
+        """Sum of the amplitudes (reference ``lcnorm.py get_total``)."""
+        return float(self().sum())
+
+    def set_total(self, total: float) -> None:
+        """Rescale the amplitudes to the given sum (reference
+        ``lcnorm.py set_total``)."""
+        if not 0.0 <= total <= 1.0:
+            # same domain the constructor enforces; silently clamping
+            # would destroy the amplitude ratios
+            raise ValueError(f"total must be within [0, 1], got {total}")
+        cur = self.get_total()
+        if cur <= 0:
+            raise ValueError("cannot rescale zero-amplitude norms")
+        self.p[:self.dim] = self._norms_to_angles(
+            self._angles_to_norms(self.p[:self.dim]) * (total / cur))
+
+    def get_free_mask(self) -> np.ndarray:
+        return np.asarray(self.free, dtype=bool)
+
+    def get_parameter_names(self, free: bool = True) -> list:
+        idx = np.nonzero(self.free)[0] if free else range(len(self.p))
+        return [f"Ang{i + 1}" for i in idx]
+
+    def get_bounds(self) -> list:
+        """[(lo, hi)] per free angle (angles live in [0, pi/2])."""
+        return [(0.0, np.pi / 2)] * int(np.sum(self.free))
+
+    def get_errors(self, free: bool = True) -> np.ndarray:
+        e = getattr(self, "errors", np.zeros_like(self.p))
+        return e[self.free] if free else e
+
+    def set_errors(self, errs, free: bool = True) -> None:
+        """Store parameter errors; a free-length vector scatters into the
+        full-length store so :meth:`get_errors` masks consistently."""
+        errs = np.asarray(errs, dtype=np.float64)
+        if free and len(errs) != len(self.p):
+            full = np.zeros_like(self.p)
+            full[self.free] = errs
+            errs = full
+        self.errors = errs
+
+    def is_energy_dependent(self) -> bool:
+        return False
+
+    def gradient(self, log10_ens=None, free: bool = True,
+                 eps: float = 1e-7) -> np.ndarray:
+        """(n_norm, n_param) finite-difference d(amplitudes)/d(angles)
+        (reference ``lcnorm.py gradient`` is analytic; FD here).  With
+        per-photon energies the energy-averaged gradient is returned."""
+        p0 = self.get_parameters(free=free).copy()
+
+        def amps():
+            v = np.asarray(self() if log10_ens is None else self(log10_ens))
+            return v if v.ndim == 1 else v.mean(axis=0)
+
+        out = np.empty((self.dim, len(p0)))
+        for i in range(len(p0)):
+            pp = p0.copy()
+            pp[i] += eps
+            self.set_parameters(pp, free=free)
+            hi = amps()
+            pp[i] -= 2 * eps
+            self.set_parameters(pp, free=free)
+            lo = amps()
+            out[:, i] = (hi - lo) / (2 * eps)
+            self.set_parameters(p0, free=free)
+        return out
+
+    def sanity_checks(self) -> bool:
+        return bool(np.all(np.isfinite(self.p)))
+
     def get_parameters(self, free: bool = True) -> np.ndarray:
         return self.p[self.free] if free else self.p.copy()
 

@@ -99,7 +99,18 @@ class LCTemplate:
         return True
 
     def get_errors(self, free: bool = True) -> np.ndarray:
-        return np.zeros(self.num_parameters(free))
+        """Stored parameter errors (set by :meth:`set_errors` / the
+        fitters), free-masked by default; zeros when never set."""
+        out = []
+        for p in self.primitives:
+            e = np.asarray(getattr(p, "errors", np.zeros_like(
+                np.asarray(p.p, dtype=np.float64))), dtype=np.float64)
+            out.append(e[np.asarray(p.free, dtype=bool)] if free else e)
+        ne = self.norms.get_errors(free=free) \
+            if hasattr(self.norms, "get_errors") \
+            else np.zeros(len(self.norms.get_parameters(free=free)))
+        out.append(np.asarray(ne, dtype=np.float64))
+        return np.concatenate(out)
 
     def get_location(self) -> float:
         """Location of the highest-amplitude peak."""
@@ -348,16 +359,23 @@ class LCTemplate:
             p.free[:] = False
         self.norms.free[:] = False
 
-    def set_errors(self, errs) -> None:
-        """Distribute a flat error vector onto the components (reference
-        ``lctemplate.py set_errors``); stored as ``errors`` attributes."""
+    def set_errors(self, errs, free: bool = True) -> None:
+        """Distribute a flat (free-length by default) error vector onto the
+        components (reference ``lctemplate.py set_errors``); each component
+        stores a FULL-length vector so its free mask indexes it."""
         errs = np.asarray(errs, dtype=np.float64)
         i = 0
         for p in self.primitives:
-            n = p.num_parameters()
-            p.errors = errs[i:i + n]
+            n = p.num_parameters(free=free)
+            sub = errs[i:i + n]
+            if free:
+                full = np.zeros_like(np.asarray(p.p, dtype=np.float64))
+                full[np.asarray(p.free, dtype=bool)] = sub
+                p.errors = full
+            else:
+                p.errors = sub.copy()
             i += n
-        self.norms.errors = errs[i:]
+        self.norms.set_errors(errs[i:], free=free)
 
     def derivative(self, phases, log10_ens=None,
                    eps: float = 1e-6) -> np.ndarray:

@@ -24,6 +24,37 @@ class TOASelect:
         self.select_result: Dict[str, np.ndarray] = {}
         self.hash_dict: Dict[str, str] = {}
 
+    def check_condition(self, new_cond: dict):
+        """Split a new condition dict into (changed, unchanged) vs the last
+        call, updating the stored condition (reference
+        ``toa_select.py:38``)."""
+        if not hasattr(self, "condition"):
+            self.condition = dict(new_cond)
+            return dict(new_cond), {}
+        old = set(self.condition.items())
+        new = set(new_cond.items())
+        chg = dict(new - old)
+        unchg = dict(new & old)
+        self.condition = dict(new_cond)
+        return chg, unchg
+
+    def check_table_column(self, new_column) -> bool:
+        """True when the named data column is unchanged since last seen
+        (hash comparison; reference ``toa_select.py:67``).  ``new_column``
+        must expose ``.name`` and be array-like."""
+        if not self.use_hash:
+            # without hashing there is nothing to compare against; skip
+            # the (large-column) hash work entirely
+            return False
+        import hashlib as _hashlib
+
+        name = getattr(new_column, "name", "col")
+        h = _hashlib.sha1(
+            np.ascontiguousarray(np.asarray(new_column))).hexdigest()
+        same = self.hash_dict.get(name) == h
+        self.hash_dict[name] = h
+        return same
+
     # -- hashing -------------------------------------------------------------
     def get_has_key(self, key, key_value) -> str:
         return f"{key}{key_value}"

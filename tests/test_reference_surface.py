@@ -1057,3 +1057,131 @@ class TestTemplateFactoryReviewRegressions:
             ClockFile.merge([a, b])
         m = ClockFile.merge([a, b], trim=False)  # union mode still works
         assert len(m.mjd) == 4
+
+
+class TestComponentManagementSurface:
+    """Component-level add/remove families, noise introspection, and the
+    remaining small reference surfaces (round-4 final sweep)."""
+
+    def _model(self, extra=""):
+        from pint_tpu.models import get_model
+
+        base = ("PSR X\nRAJ 1:0:0\nDECJ 1:0:0\nF0 100.0 1\nPEPOCH 55000\n"
+                "DM 10\nUNITS TDB\n")
+        return get_model((base + extra).splitlines(keepends=True))
+
+    def test_wavex_component_management(self):
+        m = self._model("WXEPOCH 55000\nWXFREQ_0001 0.005\n"
+                        "WXSIN_0001 1e-6 1\nWXCOS_0001 1e-6 1\n")
+        wx = m.components["WaveX"]
+        assert list(wx.get_indices()) == [1]
+        i = wx.add_wavex_component(0.01, wxsin=2e-6, frozen=False)
+        assert i == 2 and m.WXSIN_0002.value == 2e-6
+        assert not m.WXSIN_0002.frozen
+        assert wx.add_wavex_components([0.02, 0.03]) == [3, 4]
+        wx.remove_wavex_component([3, 4])
+        assert list(wx.get_indices()) == [1, 2]
+
+    def test_dmx_range_management(self):
+        m = self._model("DMX 15\nDMX_0001 1e-3 1\nDMXR1_0001 54000\n"
+                        "DMXR2_0001 54015\n")
+        dx = m.components["DispersionDMX"]
+        assert list(dx.get_indices()) == [1]
+        i = dx.add_DMX_range(54100, 54115, dmx=2e-3, frozen=False)
+        assert i == 2 and m.DMX_0002.value == 2e-3
+        assert dx.add_DMX_ranges([54200, 54300], [54215, 54315]) == [3, 4]
+        dx.remove_DMX_range([3, 4])
+        assert list(dx.get_indices()) == [1, 2]
+        with pytest.raises(ValueError):
+            dx.add_DMX_range(54400, 54300)
+
+    def test_jump_gui_tooling(self):
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = self._model("JUMP mjd 54000 54100 1e-5 1\n")
+        t = make_fake_toas_uniform(53900, 54500, 20, m)
+        pj = m.components["PhaseJump"]
+        assert pj.get_jump_param_objects()[0].name == "JUMP1"
+        name = pj.add_jump_and_flags(t.flags[5:10], value=1e-5)
+        assert name == "JUMP2"
+        assert len(m.JUMP2.select_toa_mask(t)) == 5
+        with pytest.raises(ValueError):
+            pj.add_jump_and_flags(t.flags[5:10])
+        pj.delete_not_all_jump_toas(t.flags[5:7], 1)
+        assert len(m.JUMP2.select_toa_mask(t)) == 3
+        assert m.JUMP1.compare_key_value(m.JUMP1)
+        assert not m.JUMP1.compare_key_value(m.JUMP2)
+
+    def test_noise_introspection(self):
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = self._model("TNREDAMP -13\nTNREDGAM 3\nTNREDC 5\n"
+                        "EFAC mjd 50000 60000 1.2\n"
+                        "ECORR mjd 50000 60000 0.5\n")
+        t = make_fake_toas_uniform(54990, 55010, 12, m)
+        ec = m.components["EcorrNoise"]
+        U, w = ec.ecorr_basis_weight_pair(t)
+        np.testing.assert_array_equal(U, ec.get_noise_basis(t))
+        np.testing.assert_array_equal(w, ec.get_noise_weights(t))
+        assert ec.ecorr_cov_matrix(t).shape == (12, 12)
+        assert [p.name for p in ec.get_ecorrs()] == ["ECORR1"]
+        rn = m.components["PLRedNoise"]
+        F, phi = rn.pl_rn_basis_weight_pair(t)
+        assert F.shape == (12, 10) and len(phi) == 10
+        assert rn.pl_rn_cov_matrix(t).shape == (12, 12)
+        st = m.components["ScaleToaError"]
+        cov = st.sigma_scaled_cov_matrix(t)
+        np.testing.assert_allclose(
+            np.sqrt(np.diag(cov)), m.scaled_toa_uncertainty(t))
+
+    def test_small_surfaces(self):
+        from pint_tpu.observatory import get_observatory
+        from pint_tpu.phase import Phase
+        from pint_tpu.toa_select import TOASelect
+
+        assert float(Phase.from_float(123.25).value) == 123.25
+        ts = TOASelect(is_range=True)
+        chg, unchg = ts.check_condition({"J": (54000, 54100)})
+        assert chg and not unchg
+        chg, unchg = ts.check_condition({"J": (54000, 54100)})
+        assert unchg and not chg
+        gbt, ao = get_observatory("gbt"), get_observatory("arecibo")
+        d = gbt.get_dict()
+        assert len(d["gbt"]["itrf_xyz"]) == 3
+        assert gbt.separation(ao) < gbt.separation(ao, method="geodesic")
+        m = self._model("F1 -1e-14\n")
+        assert [p.name for p in m.components["Spindown"].F_terms] \
+            == ["F0", "F1"]
+
+    def test_allcomponents_extras_and_norm_management(self):
+        from pint_tpu.models.timing_model import AllComponents
+        from pint_tpu.templates.lcnorm import NormAngles
+
+        ac = AllComponents()
+        assert ac.component_category_map["Spindown"] == "spindown"
+        assert "Spindown" in ac.category_component_map["spindown"]
+        assert "F0" in ac.component_unique_params["Spindown"]
+        assert ac.param_to_unit("F0") == "Hz"
+        rep = ac.repeatable_param()
+        assert {"JUMP", "EFAC", "ECORR"} <= rep and "F0" not in rep
+        n = NormAngles([0.5, 0.3])
+        assert n.get_total() == pytest.approx(0.8)
+        n2 = n.copy()
+        n2.set_total(0.4)
+        np.testing.assert_allclose(n2(), np.asarray(n()) * 0.5, rtol=1e-10)
+        g = n.gradient()
+        a1 = n.p[0]
+        assert g[0, 0] == pytest.approx(np.sin(2 * a1), abs=1e-5)
+
+    def test_make_tzr_toa(self):
+        from pint_tpu.models.absolute_phase import AbsPhase
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = self._model()
+        t = make_fake_toas_uniform(54000, 54100, 5, m)
+        ap = AbsPhase()
+        m.add_component(ap, validate=False)
+        ap.make_TZR_toa(t)
+        assert ap.TZRMJD.value is not None
+        assert ap.TZRSITE.value == "gbt"
+        assert len(ap.get_TZR_toa(m)) == 1
