@@ -262,10 +262,61 @@ class Fitter:
         plt.show()
         return fig
 
-    def ftest(self, other_chi2: float, other_dof: int):
+    def ftest(self, parameter, component=None, remove: bool = False,
+              full_output: bool = False, maxiter: int = 1):
+        """Significance of adding/removing parameters (reference
+        ``fitter.py:565``): builds the modified model, refits it, and
+        returns {"ft": p-value} (plus residual RMS / chi2 / dof with
+        ``full_output``).  ``parameter`` is a Parameter (or list);
+        ``component`` the hosting component name(s) when adding.
+
+        The low-level two-number form ``ftest(chi2_other, dof_other)`` is
+        also accepted and compares directly against this fitter's fit.
+        """
         from pint_tpu.utils import FTest
 
-        return FTest(other_chi2, other_dof, self.resids.chi2, self.resids.dof)
+        if isinstance(parameter, (int, float, np.integer, np.floating)) \
+                and isinstance(component,
+                               (int, float, np.integer, np.floating)):
+            return FTest(float(parameter), int(component),
+                         self.resids.chi2, self.resids.dof)
+
+        params = parameter if isinstance(parameter, (list, tuple)) \
+            else [parameter]
+        comps = component if isinstance(component, (list, tuple)) \
+            else [component] * len(params)
+        if not remove and len(comps) != len(params):
+            raise ValueError("one component per parameter required")
+        m = copy.deepcopy(self.model)
+        if remove:
+            for p in params:
+                m.remove_param(p.name)
+        else:
+            for p, cname in zip(params, comps):
+                if cname not in m.components:
+                    raise ValueError(f"component {cname!r} not in model")
+                par = copy.deepcopy(p)
+                par.frozen = False
+                m.components[cname].add_param(par, setup=True)
+        m.setup()
+        f2 = type(self)(self.toas, m, track_mode=self.track_mode)
+        f2.fit_toas(maxiter=max(1, maxiter))
+        chi2_base, dof_base = self.resids.chi2, self.resids.dof
+        chi2_new, dof_new = f2.resids.chi2, f2.resids.dof
+        if remove:
+            # the NEW model is the simpler one
+            ft = FTest(chi2_new, dof_new, chi2_base, dof_base)
+        else:
+            ft = FTest(chi2_base, dof_base, chi2_new, dof_new)
+        out = {"ft": ft}
+        if full_output:
+            rms = f2.resids.rms_weighted()
+            if isinstance(rms, dict):  # wideband: report the TOA axis
+                rms = rms["toa"]
+            out["resid_rms_test"] = rms * 1e6
+            out["chi2_test"] = chi2_new
+            out["dof_test"] = dof_new
+        return out
 
     def print_summary(self):
         print(self.get_summary())

@@ -180,8 +180,13 @@ class DispersionDMX(Dispersion):
                 if pre == "DMX_":
                     self._params_dict[pnm].frozen = fr
             else:
-                exemplar = next(self._params_dict[q] for q in self.params
-                                if q.startswith(pre))
+                try:
+                    exemplar = next(self._params_dict[q]
+                                    for q in self.params
+                                    if q.startswith(pre))
+                except StopIteration:
+                    raise KeyError(
+                        f"No {pre} parameter left to use as an exemplar")
                 p = exemplar.new_param(index, value=val)
                 if pre == "DMX_":
                     p.frozen = fr

@@ -84,7 +84,7 @@ class PhaseJump(PhaseComponent):
             exemplar.frozen = frozen
             name = "JUMP1"
         else:
-            idx = max(int(j[4:]) for j in self.jumps) + 1
+            idx = max((int(j[4:]) for j in self.jumps), default=0) + 1
             self.add_param(maskParameter("JUMP", index=idx, key="-gui_jump",
                                          key_value=[str(ind)], units="s",
                                          value=float(value), frozen=frozen),

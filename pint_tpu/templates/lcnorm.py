@@ -116,7 +116,13 @@ class NormAngles:
         p0 = self.get_parameters(free=free).copy()
 
         def amps():
-            v = np.asarray(self() if log10_ens is None else self(log10_ens))
+            if log10_ens is None:
+                return np.asarray(self())
+            if not self.is_energy_dependent():
+                raise TypeError(
+                    "log10_ens given but these norms are not "
+                    "energy-dependent (use ENormAngles)")
+            v = np.asarray(self(log10_ens))
             return v if v.ndim == 1 else v.mean(axis=0)
 
         out = np.empty((self.dim, len(p0)))

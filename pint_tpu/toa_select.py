@@ -31,10 +31,14 @@ class TOASelect:
         if not hasattr(self, "condition"):
             self.condition = dict(new_cond)
             return dict(new_cond), {}
-        old = set(self.condition.items())
-        new = set(new_cond.items())
-        chg = dict(new - old)
-        unchg = dict(new & old)
+        # values may be lists (flag selections) — compare by equality, not
+        # set membership, so unhashable values work
+        chg, unchg = {}, {}
+        for k, v in new_cond.items():
+            if k in self.condition and self.condition[k] == v:
+                unchg[k] = v
+            else:
+                chg[k] = v
         self.condition = dict(new_cond)
         return chg, unchg
 

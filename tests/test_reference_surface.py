@@ -1185,3 +1185,38 @@ class TestComponentManagementSurface:
         assert ap.TZRMJD.value is not None
         assert ap.TZRSITE.value == "gbt"
         assert len(ap.get_TZR_toa(m)) == 1
+
+
+class TestFtestWorkflow:
+    def test_ftest_add_and_remove(self):
+        import warnings
+
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models import get_model
+        from pint_tpu.models.parameter import prefixParameter
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        warnings.simplefilter("ignore")
+        base = ("PSR X\nRAJ 1:0:0\nDECJ 1:0:0\nF0 100.0 1\nF1 -1e-14 1\n"
+                "PEPOCH 55000\nDM 10 1\nUNITS TDB\n")
+        # simulate WITH a small F2 (no phase wraps over the span)
+        sim = get_model((base + "F2 3e-25\n").splitlines(keepends=True))
+        t = make_fake_toas_uniform(53500, 56500, 80, sim, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(8))
+        f = WLSFitter(t, get_model(base.splitlines(keepends=True)))
+        f.fit_toas()
+        p = prefixParameter("F2", units="Hz/s^2", value=0.0)
+        res = f.ftest(p, "Spindown", full_output=True, maxiter=3)
+        assert res["ft"] < 1e-3  # the data really contain F2
+        assert res["dof_test"] == f.resids.dof - 1
+        assert res["chi2_test"] < f.resids.chi2
+        # removing F2 (which the data DO contain) must be significant:
+        # the simpler model is a real degradation
+        sim2 = get_model((base + "F2 3e-25 1\n").splitlines(keepends=True))
+        f2 = WLSFitter(t, sim2)
+        f2.fit_toas(maxiter=3)
+        res2 = f2.ftest(sim2.F2, None, remove=True)
+        assert res2["ft"] < 1e-3
+        # legacy numeric form still works
+        assert 0 <= f.ftest(f.resids.chi2 + 50, f.resids.dof + 1) <= 1
