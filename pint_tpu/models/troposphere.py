@@ -178,5 +178,68 @@ class TroposphereDelay(DelayComponent):
             delay[m] = d
         return delay
 
+    # -- reference-named evaluation surface (troposphere_delay.py:16+) -----
+    def troposphere_delay(self, toas, acc_delay=None) -> np.ndarray:
+        """Total tropospheric delay [s] at the TOAs (reference
+        ``troposphere_delay.py troposphere_delay``): zero when
+        CORRECT_TROPOSPHERE is off or the site has no ground location —
+        exactly what the model applies."""
+        if not bool(self.CORRECT_TROPOSPHERE.value):
+            return np.zeros(len(toas))
+        try:
+            return self._compute_host_delay(toas)
+        except ValueError:
+            # barycentric/space TOAs: no troposphere (matches build_context)
+            return np.zeros(len(toas))
+
+    def pressure_from_altitude(self, h_m: float) -> float:
+        """Surface pressure [kPa] from altitude (reference
+        ``troposphere_delay.py pressure_from_altitude``)."""
+        return pressure_from_altitude_kpa(h_m)
+
+    def zenith_delay(self, lat_rad: float, h_m: float) -> float:
+        """Hydrostatic zenith delay [s] (reference
+        ``troposphere_delay.py zenith_delay``)."""
+        return zenith_delay_s(lat_rad, h_m)
+
+    def wet_zenith_delay(self) -> float:
+        """Wet zenith delay [s]: zero, the tempo2 default without weather
+        data (reference ``troposphere_delay.py:250``)."""
+        return 0.0
+
+    def mapping_function(self, alt_rad, lat_rad, h_m: float,
+                         year_frac=0.0) -> np.ndarray:
+        """Niell hydrostatic mapping function incl. height correction
+        (reference ``troposphere_delay.py mapping_function``); ``alt_rad``
+        and ``year_frac`` broadcast per TOA.  Southern sites get the same
+        half-year seasonal shift the model's own delay path applies."""
+        alt = np.atleast_1d(np.asarray(alt_rad, dtype=np.float64))
+        lat = float(lat_rad)
+        yf = np.broadcast_to(
+            np.asarray(year_frac, dtype=np.float64), alt.shape).copy()
+        if lat < 0:
+            yf = (yf + 0.5) % 1.0
+        abs_lat = abs(np.degrees(lat))
+        a = _interp_coeff(abs_lat, _A_AVG, _A_AMP, yf)
+        b = _interp_coeff(abs_lat, _B_AVG, _B_AMP, yf)
+        c = _interp_coeff(abs_lat, _C_AVG, _C_AMP, yf)
+        base = _herring_map(alt, a, b, c)
+        fcorr = _herring_map(alt, _A_HT, _B_HT, _C_HT)
+        out = base + (1.0 / np.sin(alt) - fcorr) * (float(h_m) / 1e3)
+        return out.reshape(np.shape(alt_rad)) if np.shape(alt_rad) else out[0]
+
+    def wet_map(self, alt_rad, lat_rad) -> np.ndarray:
+        """Niell wet mapping function (reference
+        ``troposphere_delay.py wet_map``)."""
+        alt = np.asarray(alt_rad, dtype=np.float64)
+        abs_lat = abs(np.degrees(float(lat_rad)))
+        aw = np.interp(abs_lat, _LAT, _AW)
+        bw = np.interp(abs_lat, _LAT, _BW)
+        cw = np.interp(abs_lat, _LAT, _CW)
+        return _herring_map(alt, aw, bw, cw)
+
+    #: reference name for the full delay model
+    delay_model = troposphere_delay
+
     def delay_func(self, pv, batch, ctx, acc_delay):
         return ctx["delay"]

@@ -224,6 +224,36 @@ class WidebandTOAFitter(Fitter):
         self.parameter_covariance_matrix = None
         self.errors: Dict[str, float] = {}
 
+    def make_combined_residuals(self) -> WidebandTOAResiduals:
+        """Fresh combined TOA+DM residuals under the current model
+        (reference ``fitter.py make_combined_residuals``)."""
+        return self._make_resids()
+
+    def get_data_uncertainty(self, scaled: bool = True) -> np.ndarray:
+        """Stacked [TOA sigma; DM sigma] vector (reference
+        ``fitter.py get_data_uncertainty``); the scaled default reuses the
+        combined-residuals stacking so the two stay in lockstep."""
+        if scaled:
+            return np.asarray(self.resids._combined_data_error)
+        return np.concatenate([
+            self.resids.toa.get_data_error(scaled=False),
+            self.resids.dm.get_data_error(scaled=False)])
+
+    scaled_all_sigma = get_data_uncertainty
+
+    def get_noise_covariancematrix(self) -> np.ndarray:
+        """Block-diagonal stacked data covariance (reference
+        ``fitter.py get_noise_covariancematrix``): TOA block incl.
+        correlated noise, DM block diagonal.  The ONE implementation —
+        the full_cov solve path uses it too."""
+        toa_cov = self.model.toa_covariance_matrix(self.toas)
+        dm_sig = np.asarray(self.model.scaled_dm_uncertainty(self.toas))
+        n, m = toa_cov.shape[0], len(dm_sig)
+        out = np.zeros((n + m, n + m))
+        out[:n, :n] = toa_cov
+        out[n:, n:] = np.diag(dm_sig**2)
+        return out
+
     def _make_resids(self) -> WidebandTOAResiduals:
         return WidebandTOAResiduals(
             self.toas, self.model,
@@ -248,10 +278,7 @@ class WidebandTOAFitter(Fitter):
             n_toa = M_toa.shape[0]
             M, norm = normalize_designmatrix(M, params)
             M, norm = np.asarray(M), np.asarray(norm)
-            cov = np.zeros((M.shape[0], M.shape[0]))
-            cov[:n_toa, :n_toa] = self.model.toa_covariance_matrix(self.toas)
-            dm_sig = self.model.scaled_dm_uncertainty(self.toas)
-            cov[n_toa:, n_toa:] = np.diag(dm_sig**2)
+            cov = self.get_noise_covariancematrix()
             mtcm, mtcy = gls_normal_equations(M, r, cov=cov)
         else:
             M, params, norm, phiinv, Nvec, dims = build_augmented_system(

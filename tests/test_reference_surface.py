@@ -1220,3 +1220,49 @@ class TestFtestWorkflow:
         assert res2["ft"] < 1e-3
         # legacy numeric form still works
         assert 0 <= f.ftest(f.resids.chi2 + 50, f.resids.dof + 1) <= 1
+
+
+class TestTroposphereAndWidebandSurface:
+    def test_troposphere_evaluation_methods(self):
+        from pint_tpu.models.troposphere import TroposphereDelay
+
+        td = TroposphereDelay()
+        assert td.pressure_from_altitude(0.0) == pytest.approx(101.325)
+        zd = td.zenith_delay(np.radians(38.4), 800.0)
+        assert 6e-9 < zd < 9e-9  # ~2.1-2.3 m of path / c
+        assert td.wet_zenith_delay() == 0.0
+        mf = td.mapping_function(np.radians([30.0, 90.0]),
+                                 np.radians(38.4), 800.0)
+        assert mf[1] == pytest.approx(1.0, abs=0.01) and mf[0] > mf[1]
+        wm = td.wet_map(np.radians([30.0, 90.0]), np.radians(38.4))
+        assert wm[0] > wm[1]
+
+    def test_wideband_fitter_accessors(self):
+        import warnings
+
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.wideband import WidebandTOAFitter
+
+        warnings.simplefilter("ignore")
+        m = get_model(["PSR X\n", "RAJ 1:0:0\n", "DECJ 1:0:0\n",
+                       "F0 100.0 1\n", "PEPOCH 55000\n", "DM 10 1\n",
+                       "UNITS TDB\n"])
+        t = make_fake_toas_uniform(54000, 55000, 20, m, error_us=2.0,
+                                   wideband=True, add_noise=True,
+                                   rng=np.random.default_rng(3))
+        f = WidebandTOAFitter(t, m)
+        f.fit_toas()
+        assert f.make_combined_residuals().chi2 == pytest.approx(
+            f.resids.chi2)
+        u = f.get_data_uncertainty()
+        assert len(u) == 40
+        np.testing.assert_array_equal(f.scaled_all_sigma(), u)
+        C = f.get_noise_covariancematrix()
+        np.testing.assert_allclose(np.sqrt(np.diag(C)), u, rtol=1e-10)
+        # ftest full_output handles the wideband rms dict
+        from pint_tpu.models.parameter import prefixParameter
+
+        res = f.ftest(prefixParameter("F1", units="Hz/s", value=0.0),
+                      "Spindown", full_output=True)
+        assert np.isfinite(res["resid_rms_test"])
