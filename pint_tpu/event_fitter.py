@@ -220,6 +220,75 @@ class _PhotonMCMCFitter(Fitter):
     def update_resids(self):  # photon data has no time residuals
         return None
 
+    # -- reference MCMCFitter accessor surface (mcmc_fitter.py:109+) --------
+    def get_event_phases(self) -> np.ndarray:
+        """Fractional pulse phase of every photon under the current model
+        (reference ``mcmc_fitter.py get_event_phases``)."""
+        return self.phaseogram_phases()
+
+    def get_weights(self) -> np.ndarray:
+        """Per-photon weights (ones when unweighted; reference
+        ``mcmc_fitter.py get_weights``)."""
+        return self.weights if self.weights is not None \
+            else np.ones(len(self.toas))
+
+    def get_template_vals(self, phases) -> np.ndarray:
+        """Template density at the given phases (reference
+        ``mcmc_fitter.py get_template_vals``)."""
+        return np.asarray(self._template_density(
+            np.asarray(phases, dtype=np.float64) % 1.0))
+
+    def get_parameters(self) -> np.ndarray:
+        """Current sampled-parameter values (reference
+        ``mcmc_fitter.py get_parameters``)."""
+        return np.asarray(self.get_fitvals(), dtype=np.float64)
+
+    def set_parameters(self, theta) -> None:
+        """Write sampled-parameter values into the model (reference
+        ``mcmc_fitter.py set_parameters``)."""
+        for p, v in zip(self.fitkeys, np.asarray(theta, dtype=np.float64)):
+            getattr(self.model, p).value = float(v)
+
+    def get_parameter_names(self) -> list:
+        """Names of the sampled parameters (reference
+        ``mcmc_fitter.py get_parameter_names``)."""
+        return list(self.fitkeys)
+
+    def get_model_parameters(self) -> dict:
+        """{name: value} of the sampled timing parameters (reference
+        ``mcmc_fitter.py get_model_parameters``)."""
+        return dict(zip(self.fitkeys, self.get_parameters()))
+
+    def get_template_parameters(self):
+        """Template parameters when an LCTemplate is attached (reference
+        ``mcmc_fitter.py get_template_parameters``); None for binned
+        array templates."""
+        if isinstance(self.template, LCTemplate):
+            return self.template.get_parameters()
+        return None
+
+    def clip_template_params(self, pos):
+        """Hook clipping template-parameter walkers into bounds (reference
+        ``mcmc_fitter.py clip_template_params``); timing-only sampling
+        here, so positions pass through."""
+        return pos
+
+    def get_errors(self) -> np.ndarray:
+        """Current per-parameter errors (reference
+        ``mcmc_fitter.py get_errors``)."""
+        return np.asarray(self.get_fiterrs(), dtype=np.float64)
+
+    def phaseogram(self, bins: int = 64, rotate: float = 0.0, file=None):
+        """Phaseogram (phase vs time, summed profile on top) via
+        :func:`pint_tpu.plot_utils.phaseogram`; requires matplotlib
+        (reference ``mcmc_fitter.py phaseogram``)."""
+        from pint_tpu.plot_utils import phaseogram as _phaseogram
+
+        mjds = np.asarray(self.toas.get_mjds(), dtype=np.float64)
+        return _phaseogram(mjds, self.get_event_phases(),
+                           weights=self.weights, bins=bins, rotate=rotate,
+                           plotfile=file)
+
     def phaseogram_phases(self) -> np.ndarray:
         ph = self.model.phase(self.toas)
         return np.asarray(ph.frac) % 1.0

@@ -257,3 +257,50 @@ def load_events_weights(eventfile, model, weightcol, wgtexp, minMJD, maxMJD,
     if weightcol == "CALC" and wgtexp > 0.0:
         weights = weights ** wgtexp
     return ts, weights
+
+
+class emcee_fitter:
+    """Reference class name (``event_optimize.py:401``): a thin adapter
+    over :class:`pint_tpu.event_fitter.MCMCFitterBinnedTemplate` taking
+    the reference's (toas, model, binned-template-array, weights, phs,
+    phserr) construction."""
+
+    def __init__(self, toas=None, model=None, template=None, weights=None,
+                 phs: float = 0.5, phserr: float = 0.03, **kw):
+        from pint_tpu.event_fitter import MCMCFitterBinnedTemplate
+
+        # phs/phserr are accepted for signature parity; the absolute phase
+        # rides in the template alignment (--fitstart FFTFIT) / PHOFF here
+        # rather than as an extra sampled walker dimension
+        self.fitter = MCMCFitterBinnedTemplate(
+            toas, model, template, weights=weights, **kw)
+        # the inner fitter may have FILTERED toas/weights (minMJD/maxMJD,
+        # -weight flags) — mirror ITS view, not the raw ctor args
+        self.toas = self.fitter.toas
+        self.model = self.fitter.model
+        self.template = template
+        self.weights = self.fitter.weights
+        self.fitkeys = self.fitter.fitkeys
+        self.n_fit_params = len(self.fitkeys)
+
+    @property
+    def fitvals(self):
+        """Current parameter values (live view: stays fresh after
+        fit_toas updates the model)."""
+        return self.fitter.get_fitvals()
+
+    @property
+    def fiterrs(self):
+        return self.fitter.get_fiterrs()
+
+    def get_event_phases(self):
+        return self.fitter.get_event_phases()
+
+    def lnposterior(self, theta):
+        return self.fitter.lnposterior(theta)
+
+    def fit_toas(self, maxiter: int = 200, **kw):
+        return self.fitter.fit_toas(maxiter=maxiter, **kw)
+
+    def phaseogram(self, **kw):
+        return self.fitter.phaseogram(**kw)

@@ -13,10 +13,12 @@ from typing import Optional
 
 import numpy as np
 
-from pint_tpu.templates.lcprimitives import (LCGaussian, LCLorentzian,
+from pint_tpu.templates.lcprimitives import (LCGaussian, LCGaussian2,
+                                             LCLorentzian, LCLorentzian2,
                                              LCPrimitive, LCVonMises)
 
-__all__ = ["LCEPrimitive", "LCEGaussian", "LCELorentzian", "LCEVonMises"]
+__all__ = ["LCEPrimitive", "LCEGaussian", "LCEGaussian2", "LCELorentzian",
+           "LCELorentzian2", "LCEVonMises"]
 
 
 class LCEPrimitive(LCPrimitive):
@@ -44,6 +46,20 @@ class LCEPrimitive(LCPrimitive):
 
     def is_energy_dependent(self) -> bool:
         return True
+
+    def _base_at_current(self):
+        """A base-class primitive carrying this primitive's CURRENT base
+        parameters — shape queries (hwhm, two-sidedness) must come from
+        the base shape, not LCPrimitive defaults."""
+        b = self.base_cls()
+        b.p = np.asarray(self.p[:self.nb], dtype=np.float64).copy()
+        return b
+
+    def is_two_sided(self) -> bool:
+        return self._base_at_current().is_two_sided()
+
+    def hwhm(self, right: bool = False) -> float:
+        return self._base_at_current().hwhm(right=right)
 
     def get_location(self) -> float:
         return float(self.p[self.nb - 1])
@@ -94,3 +110,17 @@ class LCEVonMises(LCEPrimitive):
 
 #: reference re-export (each template module offers isvector)
 from pint_tpu.templates.lcnorm import isvector  # noqa: E402,F401
+
+
+class LCEGaussian2(LCEPrimitive):
+    """Energy-dependent two-sided Gaussian (reference LCEGaussian2)."""
+
+    base_cls = LCGaussian2
+    name = "EGaussian2"
+
+
+class LCELorentzian2(LCEPrimitive):
+    """Energy-dependent two-sided Lorentzian (reference LCELorentzian2)."""
+
+    base_cls = LCLorentzian2
+    name = "ELorentzian2"

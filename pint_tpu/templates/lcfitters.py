@@ -34,6 +34,56 @@ def hessian(func, x0, eps: float = 1e-5) -> np.ndarray:
     return H
 
 
+def shifted(m, delta: float = 0.5):
+    """Binned profile circularly shifted in phase by ``delta`` via the FFT
+    shift theorem (reference ``lcfitters.py:30``)."""
+    m = np.asarray(m, dtype=np.float64)
+    f = np.fft.fft(m, axis=-1)
+    n = f.shape[-1]
+    arg = np.fft.fftfreq(n) * (n * np.pi * 2.0j * delta)
+    return np.real(np.fft.ifft(np.exp(arg) * f, axis=-1))
+
+
+def weighted_light_curve(nbins: int, phases, weights, normed: bool = False,
+                         phase_shift: float = 0.0):
+    """(bin edges, weighted counts, errors) of a weighted folded profile
+    (reference ``lcfitters.py:38``)."""
+    phases = np.asarray(phases, dtype=np.float64)
+    weights = np.asarray(weights, dtype=np.float64)
+    bins = np.linspace(0 + phase_shift, 1 + phase_shift, nbins + 1)
+    counts = np.histogram(phases, bins=bins)[0]
+    w1 = np.histogram(phases, bins=bins, weights=weights)[0].astype(float)
+    w2 = np.histogram(phases, bins=bins,
+                      weights=weights**2)[0].astype(float)
+    errors = np.where(counts > 1, w2**0.5, counts)
+    norm = w1.sum() / nbins if normed else 1.0
+    return bins, w1 / norm, errors / norm
+
+
+def hess_from_grad(grad_fn, x0, eps: float = 1e-5) -> np.ndarray:
+    """Hessian by finite-differencing a gradient function (reference
+    ``lcfitters.py hess_from_grad``)."""
+    x0 = np.asarray(x0, dtype=np.float64)
+    n = len(x0)
+    H = np.empty((n, n))
+    for i in range(n):
+        xp = x0.copy()
+        xp[i] += eps
+        gp = np.asarray(grad_fn(xp))
+        xp[i] -= 2 * eps
+        gm = np.asarray(grad_fn(xp))
+        H[i] = (gp - gm) / (2 * eps)
+    return 0.5 * (H + H.T)
+
+
+def calc_step_size(fit_values, errors, minstep: float = 1e-5) -> np.ndarray:
+    """Per-parameter optimizer step sizes from current errors (reference
+    ``lcfitters.py calc_step_size``)."""
+    errors = np.asarray(errors, dtype=np.float64)
+    vals = np.abs(np.asarray(fit_values, dtype=np.float64))
+    return np.maximum(np.where(errors > 0, errors, 0.1 * vals), minstep)
+
+
 class LCFitter:
     def __init__(self, template: LCTemplate, phases, weights=None,
                  binned_bins: int = 100):

@@ -162,3 +162,27 @@ class NormAngles:
 
     def __repr__(self):
         return f"NormAngles(norms={self()!r})"
+
+
+def numerical_gradient(fn, x0, eps: float = 1e-6) -> np.ndarray:
+    """Central-difference gradient of a scalar/vector function (reference
+    ``lcnorm.py numerical_gradient``)."""
+    x0 = np.asarray(x0, dtype=np.float64)
+    cols = []
+    for i in range(len(x0)):
+        xp = x0.copy()
+        xp[i] += eps
+        hi = np.asarray(fn(xp))
+        xp[i] -= 2 * eps
+        lo = np.asarray(fn(xp))
+        cols.append((hi - lo) / (2 * eps))
+    return np.array(cols)
+
+
+def numerical_hessian(fn, x0, eps: float = 1e-4):
+    """Central-difference Hessian of a scalar function (reference
+    ``lcnorm.py numerical_hessian``) — thin wrapper over the package's
+    one implementation in :func:`pint_tpu.templates.lcfitters.hessian`."""
+    from pint_tpu.templates.lcfitters import hessian
+
+    return hessian(fn, x0, eps=eps)

@@ -1266,3 +1266,75 @@ class TestTroposphereAndWidebandSurface:
         res = f.ftest(prefixParameter("F1", units="Hz/s", value=0.0),
                       "Spindown", full_output=True)
         assert np.isfinite(res["resid_rms_test"])
+
+
+class TestTemplateUtilityFunctions:
+    def test_shifted_and_weighted_light_curve(self):
+        from pint_tpu.templates.lcfitters import (shifted,
+                                                  weighted_light_curve)
+
+        prof = np.zeros(64)
+        prof[10] = 1.0
+        sh = shifted(prof, 0.25)
+        # reference FFT-shift convention: +delta moves the profile to
+        # EARLIER phase bins ((10 - 16) % 64 = 58)
+        assert abs(int(np.argmax(sh)) - 58) <= 1
+        sh2 = shifted(prof, 0.5)
+        assert abs(int(np.argmax(sh2)) - 42) <= 1
+        rng = np.random.default_rng(0)
+        ph = rng.random(500)
+        w = np.full(500, 0.7)
+        bins, vals, errs = weighted_light_curve(20, ph, w)
+        assert len(vals) == 20
+        assert vals.sum() == pytest.approx(w.sum())
+        assert np.all(errs >= 0)
+
+    def test_numeric_helpers(self):
+        from pint_tpu.templates.lcfitters import (calc_step_size,
+                                                  hess_from_grad)
+        from pint_tpu.templates.lcnorm import (numerical_gradient,
+                                               numerical_hessian)
+
+        H = hess_from_grad(lambda x: 2 * x, np.array([1.0, 2.0]))
+        np.testing.assert_allclose(H, 2 * np.eye(2), atol=1e-6)
+        np.testing.assert_allclose(
+            calc_step_size([1.0, 2.0], [0.1, 0.0]), [0.1, 0.2])
+        g = numerical_gradient(lambda x: x[0]**2 + 3 * x[1],
+                               np.array([2.0, 1.0]))
+        np.testing.assert_allclose(g, [4.0, 3.0], atol=1e-5)
+        H2 = numerical_hessian(lambda x: x[0]**2 * x[1],
+                               np.array([1.0, 2.0]))
+        np.testing.assert_allclose(H2, [[4.0, 2.0], [2.0, 0.0]], atol=1e-3)
+
+    def test_energy_dependent_two_sided_primitives(self):
+        from pint_tpu.templates.lceprimitives import (LCEGaussian2,
+                                                      LCELorentzian2)
+
+        g = LCEGaussian2(p=[0.02, 0.03, 0.4], slopes=[0.0, 0.0, 0.1])
+        v = np.asarray(g(np.array([0.3, 0.4, 0.5])))
+        assert np.isfinite(v).all() and v[1] == v.max()
+        assert g.is_energy_dependent()
+        l2 = LCELorentzian2(p=[0.02, 0.03, 0.6])
+        assert np.isfinite(np.asarray(l2(np.array([0.55, 0.6])))).all()
+
+    def test_emcee_fitter_adapter(self):
+        import warnings
+
+        from pint_tpu.models import get_model
+        from pint_tpu.scripts.event_optimize import emcee_fitter
+        from pint_tpu.simulation import make_fake_toas_uniform
+        from pint_tpu.templates.lctemplate import get_gauss1
+
+        warnings.simplefilter("ignore")
+        m = get_model(["PSR X\n", "RAJ 1:0:0\n", "DECJ 1:0:0\n",
+                       "F0 100.0 1\n", "PEPOCH 55000\n", "DM 10\n",
+                       "UNITS TDB\n"])
+        t = make_fake_toas_uniform(54990, 55010, 50, m, error_us=5.0)
+        grid = (np.arange(64) + 0.5) / 64
+        template = np.asarray(get_gauss1(width1=0.05)(grid))
+        f = emcee_fitter(t, m, template)
+        assert f.n_fit_params >= 1
+        ph = f.get_event_phases()
+        assert len(ph) == 50 and np.all((0 <= ph) & (ph < 1))
+        lp = f.lnposterior(np.asarray(f.fitvals))
+        assert np.isfinite(lp)
