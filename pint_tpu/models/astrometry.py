@@ -57,6 +57,77 @@ class Astrometry(DelayComponent):
         return self._geometric_delay(pv, batch, L_hat, pv.get("PX", 0.0))
 
 
+    # -- reference user functions (astrometry.py:114,469) -------------------
+    def _pv_now(self) -> dict:
+        pv = dict(self._parent._const_pv()) if self._parent is not None \
+            else {}
+        for p in self.params:
+            v = self._params_dict[p].value
+            if v is not None and isinstance(v, (int, float, np.floating)):
+                pv[p] = float(v)
+        return pv
+
+    def ssb_to_psb_xyz_ICRS(self, epoch=None) -> np.ndarray:
+        """Unit vector(s) SSB -> pulsar in ICRS at the given MJD epoch(s),
+        proper motion applied (reference ``astrometry.py:469``)."""
+        if epoch is None:
+            epoch = self._posepoch_mjd_host()
+        ep = jnp.asarray(np.atleast_1d(np.asarray(epoch, dtype=np.float64)))
+        # both frames' ssb_to_psb_xyz return EQUATORIAL unit vectors (the
+        # ecliptic variant rotates internally)
+        xyz = np.asarray(self.ssb_to_psb_xyz(self._pv_now(), ep))
+        return xyz.reshape(np.shape(epoch) + (3,)) if np.shape(epoch) \
+            else xyz[0]
+
+    def ssb_to_psb_xyz_ECL(self, epoch=None) -> np.ndarray:
+        """Unit vector(s) SSB -> pulsar in the IERS2010 ecliptic frame:
+        one vectorized inverse of the obliquity rotation the ecliptic
+        component applies (``_COS_OBL``/``_SIN_OBL``)."""
+        xyz = np.atleast_2d(self.ssb_to_psb_xyz_ICRS(epoch))
+        out = np.empty_like(xyz)
+        out[:, 0] = xyz[:, 0]
+        out[:, 1] = _COS_OBL * xyz[:, 1] + _SIN_OBL * xyz[:, 2]
+        out[:, 2] = -_SIN_OBL * xyz[:, 1] + _COS_OBL * xyz[:, 2]
+        return out.reshape(np.shape(epoch) + (3,)) if np.shape(epoch) \
+            else out[0]
+
+    def _posepoch_mjd_host(self) -> float:
+        pe = self.POSEPOCH.value
+        if pe is None and self._parent is not None:
+            pep = getattr(self._parent, "PEPOCH", None)
+            pe = pep.value if pep is not None else None
+        if pe is None:
+            raise ValueError("No POSEPOCH/PEPOCH to evaluate the position at")
+        return float(pe)
+
+    def get_psr_coords(self, epoch=None):
+        """(RA, DEC) [rad] at the epoch(s), proper motion applied
+        (reference ``astrometry.py get_psr_coords``); array epochs return
+        array coordinates."""
+        v = np.atleast_2d(self.ssb_to_psb_xyz_ICRS(epoch))
+        ra = np.arctan2(v[:, 1], v[:, 0]) % (2 * np.pi)
+        dec = np.arcsin(np.clip(v[:, 2], -1.0, 1.0))
+        if np.shape(epoch):
+            return ra, dec
+        return float(ra[0]), float(dec[0])
+
+    def sun_angle(self, toas, heliocenter: bool = True,
+                  also_distance: bool = False):
+        """Pulsar-observatory-Sun angle [rad] per TOA (reference
+        ``astrometry.py:114``)."""
+        if heliocenter:
+            osv = np.asarray(toas.obs_sun_pos_km, dtype=np.float64)
+        else:
+            # barycenter-referenced: obs -> SSB
+            osv = -np.asarray(toas.ssb_obs_pos_km, dtype=np.float64)
+        r = np.sqrt(np.sum(osv**2, axis=1))
+        tdb = np.asarray(toas.tdb, dtype=np.float64)
+        psr = np.atleast_2d(self.ssb_to_psb_xyz_ICRS(tdb))
+        cos_a = np.sum(osv * psr, axis=1) / r
+        angle = np.arccos(np.clip(cos_a, -1.0, 1.0))
+        return (angle, r) if also_distance else angle
+
+
 class AstrometryEquatorial(Astrometry):
     """Reference ``astrometry.py:272``."""
 
@@ -130,7 +201,7 @@ class AstrometryEquatorial(Astrometry):
             * _MASYR_TO_RADDAY * dt_day / np.cos(dec0)
         self.POSEPOCH.value = np.longdouble(new_epoch)
 
-    def sun_angle(self, pv, batch):
+    def sun_angle_traced(self, pv, batch):
         """Pulsar-Sun elongation angle at each TOA (rad)."""
         L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
         sun = batch.obs_sun_pos
@@ -220,7 +291,7 @@ class AstrometryEcliptic(Astrometry):
         dec = float(np.arcsin(v[2]))
         return ra, dec
 
-    def sun_angle(self, pv, batch):
+    def sun_angle_traced(self, pv, batch):
         L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
         sun = batch.obs_sun_pos
         sun_hat = sun / jnp.linalg.norm(sun, axis=1, keepdims=True)

@@ -75,14 +75,14 @@ def solar_wind_geometry_spherical(r_ls, elongation):
 class _SolarWindBase(DelayComponent):
     def _astrometry(self):
         for comp in self._parent.components.values():
-            if hasattr(comp, "sun_angle"):
+            if hasattr(comp, "sun_angle_traced"):
                 return comp
         raise MissingParameter(type(self).__name__, "RAJ/ELONG",
                                "solar wind needs an astrometry component")
 
     def _theta_r(self, pv, batch):
         astro = self._astrometry()
-        theta = astro.sun_angle(pv, batch)
+        theta = astro.sun_angle_traced(pv, batch)
         r = jnp.linalg.norm(batch.obs_sun_pos, axis=1)
         return theta, r
 

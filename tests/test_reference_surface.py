@@ -1338,3 +1338,51 @@ class TestTemplateUtilityFunctions:
         assert len(ph) == 50 and np.all((0 <= ph) & (ph < 1))
         lp = f.lnposterior(np.asarray(f.fitvals))
         assert np.isfinite(lp)
+
+
+class TestAstrometryUserFunctions:
+    def test_coords_pm_and_frames(self):
+        import warnings
+
+        from pint_tpu.models import get_model
+
+        warnings.simplefilter("ignore")
+        m = get_model(["PSR X\n", "RAJ 6:0:0\n", "DECJ 20:0:0\n",
+                       "PMRA 10\n", "PMDEC -5\n", "POSEPOCH 55000\n",
+                       "F0 100.0\n", "PEPOCH 55000\n", "DM 10\n",
+                       "UNITS TDB\n"])
+        a = m.components["AstrometryEquatorial"]
+        v = a.ssb_to_psb_xyz_ICRS(55000.0)
+        assert np.linalg.norm(v) == pytest.approx(1.0)
+        ra, dec = a.get_psr_coords(55000.0)
+        assert ra == pytest.approx(np.pi / 2)
+        assert dec == pytest.approx(np.radians(20))
+        ra2, dec2 = a.get_psr_coords(58650.0)  # ~10 yr of PM
+        assert dec2 < dec and ra2 != ra
+        # frames agree through the ecliptic conversion
+        ecl = m.as_ECL()
+        v_e = ecl.components["AstrometryEcliptic"].ssb_to_psb_xyz_ICRS(
+            55000.0)
+        np.testing.assert_allclose(v_e, v, atol=1e-10)
+        assert np.linalg.norm(a.ssb_to_psb_xyz_ECL(55000.0)) == \
+            pytest.approx(1.0)
+
+    def test_sun_angle(self):
+        import warnings
+
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        warnings.simplefilter("ignore")
+        m = get_model(["PSR X\n", "RAJ 6:0:0\n", "DECJ 20:0:0\n",
+                       "POSEPOCH 55000\n", "F0 100.0\n", "PEPOCH 55000\n",
+                       "DM 10\n", "UNITS TDB\n"])
+        a = m.components["AstrometryEquatorial"]
+        t = make_fake_toas_uniform(54800, 55200, 40, m)
+        ang = a.sun_angle(t)
+        assert ang.shape == (40,)
+        assert np.all((0 <= ang) & (ang <= np.pi))
+        assert ang.max() - ang.min() > 1.0  # annual sweep
+        ang2, dist = a.sun_angle(t, also_distance=True)
+        np.testing.assert_array_equal(ang, ang2)
+        assert np.all((1.3e8 < dist) & (dist < 1.7e8))  # ~1 AU in km
