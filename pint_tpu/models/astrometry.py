@@ -34,6 +34,16 @@ class Astrometry(DelayComponent):
         """Unit vector(s) to the pulsar in ICRS at given float64 MJD(s)."""
         raise NotImplementedError
 
+    def sun_angle_traced(self, pv, batch):
+        """Pulsar-Sun elongation angle at each TOA (rad) — the ONE traced
+        implementation, shared by both astrometry frames (the solar-wind
+        component consumes it)."""
+        L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
+        sun = batch.obs_sun_pos
+        sun_hat = sun / jnp.linalg.norm(sun, axis=1, keepdims=True)
+        return jnp.arccos(jnp.clip(jnp.sum(sun_hat * L_hat, axis=1),
+                                   -1.0, 1.0))
+
     def barycentric_radio_freq(self, pv, batch):
         """Observed frequency corrected for observatory motion (MHz)."""
         L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
@@ -82,8 +92,9 @@ class Astrometry(DelayComponent):
     def ssb_to_psb_xyz_ECL(self, epoch=None) -> np.ndarray:
         """Unit vector(s) SSB -> pulsar in the IERS2010 ecliptic frame:
         one vectorized inverse of the obliquity rotation the ecliptic
-        component applies (``_COS_OBL``/``_SIN_OBL``)."""
-        xyz = np.atleast_2d(self.ssb_to_psb_xyz_ICRS(epoch))
+        component applies (``_COS_OBL``/``_SIN_OBL``).  Any epoch shape is
+        accepted (flattened for the rotation, reshaped on return)."""
+        xyz = np.asarray(self.ssb_to_psb_xyz_ICRS(epoch)).reshape(-1, 3)
         out = np.empty_like(xyz)
         out[:, 0] = xyz[:, 0]
         out[:, 1] = _COS_OBL * xyz[:, 1] + _SIN_OBL * xyz[:, 2]
@@ -101,15 +112,20 @@ class Astrometry(DelayComponent):
         return float(pe)
 
     def get_psr_coords(self, epoch=None):
-        """(RA, DEC) [rad] at the epoch(s), proper motion applied
-        (reference ``astrometry.py get_psr_coords``); array epochs return
-        array coordinates."""
-        v = np.atleast_2d(self.ssb_to_psb_xyz_ICRS(epoch))
-        ra = np.arctan2(v[:, 1], v[:, 0]) % (2 * np.pi)
-        dec = np.arcsin(np.clip(v[:, 2], -1.0, 1.0))
+        """Sky coordinates [rad] at the epoch(s), proper motion applied,
+        IN THIS COMPONENT'S FRAME — (RA, DEC) for equatorial models,
+        (ELONG, ELAT) for ecliptic ones, like the reference
+        (``astrometry.py get_psr_coords``).  Array epochs return arrays."""
+        if isinstance(self, AstrometryEcliptic):
+            v = np.asarray(self.ssb_to_psb_xyz_ECL(epoch)).reshape(-1, 3)
+        else:
+            v = np.asarray(self.ssb_to_psb_xyz_ICRS(epoch)).reshape(-1, 3)
+        lon = np.arctan2(v[:, 1], v[:, 0]) % (2 * np.pi)
+        lat = np.arcsin(np.clip(v[:, 2], -1.0, 1.0))
         if np.shape(epoch):
-            return ra, dec
-        return float(ra[0]), float(dec[0])
+            return (lon.reshape(np.shape(epoch)),
+                    lat.reshape(np.shape(epoch)))
+        return float(lon[0]), float(lat[0])
 
     def sun_angle(self, toas, heliocenter: bool = True,
                   also_distance: bool = False):
@@ -201,12 +217,6 @@ class AstrometryEquatorial(Astrometry):
             * _MASYR_TO_RADDAY * dt_day / np.cos(dec0)
         self.POSEPOCH.value = np.longdouble(new_epoch)
 
-    def sun_angle_traced(self, pv, batch):
-        """Pulsar-Sun elongation angle at each TOA (rad)."""
-        L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
-        sun = batch.obs_sun_pos
-        sun_hat = sun / jnp.linalg.norm(sun, axis=1, keepdims=True)
-        return jnp.arccos(jnp.clip(jnp.sum(sun_hat * L_hat, axis=1), -1.0, 1.0))
 
 
 # rotation: ecliptic (IERS2010) -> equatorial
@@ -291,8 +301,3 @@ class AstrometryEcliptic(Astrometry):
         dec = float(np.arcsin(v[2]))
         return ra, dec
 
-    def sun_angle_traced(self, pv, batch):
-        L_hat = self.ssb_to_psb_xyz(pv, batch.tdb.hi)
-        sun = batch.obs_sun_pos
-        sun_hat = sun / jnp.linalg.norm(sun, axis=1, keepdims=True)
-        return jnp.arccos(jnp.clip(jnp.sum(sun_hat * L_hat, axis=1), -1.0, 1.0))

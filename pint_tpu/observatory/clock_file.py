@@ -101,6 +101,36 @@ class GlobalClockFile:
     def last_correction_mjd(self) -> float:
         return self.clock_file.last_correction_mjd()
 
+    @property
+    def time(self):
+        """Sample epochs of the loaded data (reference
+        ``clock_file.py time``)."""
+        return self.clock_file.mjd
+
+    @property
+    def clock(self):
+        """Corrections [us] of the loaded data (reference
+        ``clock_file.py clock``)."""
+        return self.clock_file.clock_us
+
+    @property
+    def leading_comment(self) -> str:
+        """Header line of the underlying file (reference
+        ``clock_file.py leading_comment``)."""
+        return getattr(self.clock_file, "hdrline", "")
+
+    @property
+    def comments(self) -> list:
+        """Per-sample comments; the parsers here keep only the header, so
+        this is empty placeholders (reference ``clock_file.py
+        comments``)."""
+        return [""] * len(self.clock_file.mjd)
+
+    def export(self, filename: str) -> None:
+        """Write the underlying clock file out (reference
+        ``clock_file.py:903``)."""
+        self.clock_file.export(filename)
+
     def evaluate(self, mjd, limits: str = "warn"):
         """Clock correction [s] at the given MJDs; requests past the end of
         the loaded data (or with no data loaded at all) first try to

@@ -83,6 +83,17 @@ class WidebandDMResiduals:
             return self.model.scaled_dm_uncertainty(self.toas)
         return self.dm_error
 
+    def get_dm_data(self):
+        """(DM values, DM errors) — the cached arrays the residuals are
+        computed from (reference ``residuals.py:1052``)."""
+        return self.dm_data, self.dm_error
+
+    def update_model(self, new_model) -> None:
+        """Point these residuals at a new model (reference
+        ``residuals.py:1081``)."""
+        self.model = new_model
+        self.update()
+
     def calc_chi2(self) -> float:
         err = self.get_data_error()
         if np.any(err == 0.0):
@@ -135,6 +146,26 @@ class CombinedResiduals:
     def _combined_data_error(self) -> np.ndarray:
         return np.hstack([np.asarray(r.get_data_error())
                           for r in self.residual_objs.values()])
+
+    @property
+    def data_error(self):
+        """Stacked per-point uncertainties (reference
+        ``residuals.py CombinedResiduals.data_error``)."""
+        return self._combined_data_error
+
+    @property
+    def model(self):
+        """The models of the member residuals (reference
+        ``residuals.py CombinedResiduals.model``); one object when all
+        members share it."""
+        models = [r.model for r in self.residual_objs.values()]
+        return models[0] if len(set(map(id, models))) == 1 else models
+
+    @property
+    def unit(self) -> dict:
+        """{member: unit string}, read from each member (reference
+        ``residuals.py CombinedResiduals.unit``)."""
+        return {name: r.unit for name, r in self.residual_objs.items()}
 
     @property
     def chi2(self) -> float:

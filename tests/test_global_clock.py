@@ -285,3 +285,26 @@ class TestGlobalClockFile:
         os.utime(gcf._path, (old, old))
         vals = gcf.evaluate(np.array([60000.0]))  # past end, refresh fails
         assert np.isfinite(vals).all()
+
+    def test_reference_views_and_export(self, repo, tmp_path):
+        """time/clock/leading_comment/comments/export on the repository
+        wrapper (reference ``clock_file.py:903`` surface)."""
+        import numpy as np
+
+        from pint_tpu.observatory.clock_file import (ClockFile,
+                                                     GlobalClockFile)
+
+        r, cache = repo
+        (r / "gps2utc.clk").write_text(
+            "# UTC(GPS) UTC\n50000.00000 1.0e-6\n51000.00000 2.0e-6\n")
+        gcf = GlobalClockFile("gps2utc.clk", fmt="tempo2")
+        np.testing.assert_array_equal(gcf.time, [50000.0, 51000.0])
+        np.testing.assert_allclose(gcf.clock, [1.0, 2.0])  # microseconds
+        assert "UTC(GPS)" in gcf.leading_comment
+        assert len(gcf.comments) == 2
+        out = tmp_path / "exported.clk"
+        gcf.export(str(out))
+        re_read = ClockFile.read(str(out), fmt="tempo2")
+        np.testing.assert_allclose(
+            re_read.evaluate(np.array([50500.0]))[0],
+            gcf.evaluate(np.array([50500.0]))[0])
