@@ -40,7 +40,10 @@ _PC_LS = 3.0856775814913673e16 / C_M_S  # parsec in light-seconds
 _DAY_PER_YEAR = 365.25
 
 # 64-point Gauss-Legendre nodes/weights on [-1, 1] (baked as trace constants)
-_GL_X, _GL_W = (jnp.asarray(a) for a in np.polynomial.legendre.leggauss(64))
+# host numpy at module scope: a jnp.asarray here would initialize the jax
+# BACKEND at import time (observed hanging every `import pint_tpu.models`
+# while the TPU tunnel was wedged); trace-time ops convert these on demand
+_GL_X, _GL_W = np.polynomial.legendre.leggauss(64)
 
 
 def _sw_I_inf(p):

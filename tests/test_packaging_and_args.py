@@ -103,3 +103,28 @@ class TestDofAccounting:
         nfree = len(m.free_params)
         assert r_mean.dof == len(t) - nfree - 1
         assert r_nomean.dof == len(t) - nfree
+
+
+class TestImportNeverTouchesDevices:
+    def test_model_build_without_backend(self):
+        """Importing the package and building a model must not initialize
+        a jax backend: a module-scope jnp.asarray once hung every import
+        while the TPU tunnel was wedged (r4 regression).  Run in a
+        subprocess with backend init poisoned."""
+        import subprocess
+        import sys
+
+        code = (
+            "import jax._src.xla_bridge as xb\n"
+            "def _boom(*a, **k):\n"
+            "    raise SystemExit('backend init during import/model build')\n"
+            "xb.backends = _boom\n"
+            "import pint_tpu\n"
+            "from pint_tpu.models import get_model\n"
+            "m = get_model(['PSR X\\n','RAJ 1:0:0\\n','DECJ 1:0:0\\n',"
+            "'F0 100.0\\n','PEPOCH 55000\\n','DM 10\\n','UNITS TDB\\n'])\n"
+            "print('no backend touched')\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=300)
+        assert "no backend touched" in out.stdout, (out.stdout, out.stderr)
