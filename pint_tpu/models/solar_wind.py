@@ -34,7 +34,8 @@ from pint_tpu.models.parameter import (
 )
 from pint_tpu.models.timing_model import DelayComponent, check_contiguous_indices
 
-__all__ = ["SolarWindDispersion", "SolarWindDispersionX"]
+__all__ = ["SolarWindDispersion", "SolarWindDispersionX",
+           "SolarWindDispersionBase"]
 
 _PC_LS = 3.0856775814913673e16 / C_M_S  # parsec in light-seconds
 _DAY_PER_YEAR = 365.25
@@ -75,7 +76,10 @@ def solar_wind_geometry_spherical(r_ls, elongation):
     return (AU_LS**2) * rho / (r_ls * jnp.sin(rho)) / _PC_LS
 
 
-class _SolarWindBase(DelayComponent):
+class SolarWindDispersionBase(DelayComponent):
+    """Shared geometry/astrometry plumbing for solar-wind components
+    (reference ``solar_wind_dispersion.py:266`` base-class spelling)."""
+
     def _astrometry(self):
         for comp in self._parent.components.values():
             if hasattr(comp, "sun_angle_traced"):
@@ -104,7 +108,7 @@ class _SolarWindBase(DelayComponent):
         return max(beta, 1e-3)
 
 
-class SolarWindDispersion(_SolarWindBase):
+class SolarWindDispersion(SolarWindDispersionBase):
     """Reference ``solar_wind_dispersion.py:272``."""
 
     register = True
@@ -174,7 +178,7 @@ class SolarWindDispersion(_SolarWindBase):
         return self.solar_wind_dm(pv, batch) * DMconst / freq**2
 
 
-class SolarWindDispersionX(_SolarWindBase):
+class SolarWindDispersionX(SolarWindDispersionBase):
     """Piecewise solar-wind DM (reference ``solar_wind_dispersion.py:608``)."""
 
     register = True

@@ -17,10 +17,16 @@ from pint_tpu.models.parameter import MJDParameter, prefixParameter
 from pint_tpu.models.timing_model import DAY_S, PhaseComponent
 from pint_tpu.phase import Phase
 
-__all__ = ["Spindown"]
+__all__ = ["Spindown", "SpindownBase"]
 
 
-class Spindown(PhaseComponent):
+class SpindownBase(PhaseComponent):
+    """Marker base for spindown-like phase components (reference
+    ``spindown.py:15``): lets callers test ``isinstance(c, SpindownBase)``
+    without naming every concrete spindown family."""
+
+
+class Spindown(SpindownBase):
     """Reference: ``spindown.py:21``; phase at ``spindown.py:142``."""
 
     register = True

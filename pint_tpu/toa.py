@@ -32,7 +32,7 @@ from pint_tpu.logging import log
 from pint_tpu.observatory import get_observatory
 
 __all__ = ["TOA", "TOAs", "TOABatch", "get_TOAs", "get_TOAs_list",
-           "get_TOAs_array", "merge_TOAs", "make_single_toa",
+           "get_TOAs_array", "merge_TOAs", "make_single_toa", "build_table",
            "load_pickle", "save_pickle", "read_toa_file"]
 
 C_KM_S = C_M_S / 1e3
@@ -946,9 +946,21 @@ def get_TOAs_list(toa_list, ephem: Optional[str] = None,
     tim file."""
     ephem, planets, include_bipm, bipm_version = _resolve_pipeline_options(
         model, ephem, planets, include_bipm, bipm_version)
+    t = build_table(toa_list, commands=commands)
+    return _finalize_toas(t, ephem, planets, include_gps, include_bipm,
+                          bipm_version, limits)
+
+
+def build_table(toa_list, filename: Optional[str] = None,
+                commands=None) -> TOAs:
+    """Columnar :class:`TOAs` store from :class:`TOA` objects (reference
+    ``toa.py:859 build_table``).  The reference returns the astropy Table
+    backing a TOAs object; here the columnar store *is* the TOAs object, so
+    this returns an un-finalized ``TOAs`` (no clock/ephemeris pipeline run —
+    pass it through :func:`get_TOAs_list` or ``_finalize_toas`` for that)."""
     n = len(toa_list)
     if n == 0:
-        raise ValueError("get_TOAs_list: empty TOA list")
+        raise ValueError("build_table: empty TOA list")
     utc = np.empty(n, dtype=np.longdouble)
     lo = np.zeros(n, dtype=np.float64)
     err = np.empty(n, dtype=np.float64)
@@ -964,11 +976,10 @@ def get_TOAs_list(toa_list, ephem: Optional[str] = None,
         if tt.name and tt.name != "unk":
             fl.setdefault("name", tt.name)
         flags.append(fl)
-    t = TOAs(utc, err, freq, obs, flags, list(commands or []), None)
+    t = TOAs(utc, err, freq, obs, flags, list(commands or []), filename)
     if np.any(lo):
         t.utc_mjd_lo = lo
-    return _finalize_toas(t, ephem, planets, include_gps, include_bipm,
-                          bipm_version, limits)
+    return t
 
 
 def get_TOAs_array(times, obs: str, errors=1.0, freqs=np.inf, flags=None,

@@ -38,6 +38,8 @@ __all__ = [
     "has_astropy_unit",
     "split_prefixed_name",
     "pmtot",
+    "propagate_pm",
+    "psr_coords_at_epoch",
     "ELL1_check",
     "numeric_partial",
     "numeric_partials",
@@ -56,6 +58,7 @@ __all__ = [
 # names served lazily from sibling modules so ``pint_tpu.utils`` carries the
 # reference's full utils surface without import cycles (PEP 562)
 _LAZY = {
+    "dmxrange": "pint_tpu.dmx", "DMXRange": "pint_tpu.dmx",
     "dmx_ranges": "pint_tpu.dmx", "dmxparse": "pint_tpu.dmx",
     "dmxstats": "pint_tpu.dmx", "dmxselections": "pint_tpu.dmx",
     "xxxselections": "pint_tpu.dmx", "get_prefix_timerange": "pint_tpu.dmx",
@@ -380,6 +383,47 @@ def pmtot(model) -> float:
     if "AstrometryEquatorial" in comps:
         return float(np.hypot(model.PMRA.value or 0.0,
                               model.PMDEC.value or 0.0))
+    raise AttributeError("No Astrometry component found")
+
+
+def propagate_pm(ra_rad: float, dec_rad: float, pmra_masyr: float,
+                 pmdec_masyr: float, posepoch_mjd: float,
+                 epoch_mjd: float):
+    """Proper-motion-propagated (ra, dec) [rad] at ``epoch_mjd``.
+
+    Design note: the reference reaches this via astropy's
+    ``SkyCoord.apply_space_motion``, which refuses to run without a
+    distance, so it wraps the call in ``add_dummy_distance`` /
+    ``remove_dummy_distance`` (reference ``utils.py:2163,2239``).  There is
+    no SkyCoord here — positions are plain angles and proper motion is
+    applied linearly in angle space (the same approximation the timing
+    model itself uses, ``models/astrometry.py ssb_to_psb_xyz``) — so no
+    dummy-distance round trip exists or is needed; this helper is the
+    direct equivalent.  PMRA carries the cos(dec) factor by pulsar-timing
+    convention.
+    """
+    if abs(np.cos(dec_rad)) < 1e-6:
+        raise ValueError(
+            "propagate_pm is linear in angle and breaks down at the pole "
+            f"(|dec| = {abs(dec_rad):.8f} rad); use the astrometry "
+            "component's unit-vector path (get_psr_coords) instead")
+    masyr_to_radday = (np.pi / 180.0 / 3_600_000.0) / 365.25
+    dt_day = float(epoch_mjd) - float(posepoch_mjd)
+    ra = ra_rad + pmra_masyr * masyr_to_radday * dt_day / np.cos(dec_rad)
+    dec = dec_rad + pmdec_masyr * masyr_to_radday * dt_day
+    return float(ra), float(dec)
+
+
+def psr_coords_at_epoch(model, epoch_mjd: float):
+    """(lon, lat) [rad] of the model's pulsar at ``epoch_mjd`` IN THE
+    ASTROMETRY COMPONENT'S FRAME — (RA, DEC) for equatorial models,
+    (ELONG, ELAT) for ecliptic ones — proper motion applied from POSEPOCH.
+    This is what the reference's dummy-distance SkyCoord dance computes
+    (``utils.py:2163``); delegates to ``get_psr_coords``.  For guaranteed
+    ICRS use ``model.as_ICRS()`` first."""
+    for comp in model.components.values():
+        if hasattr(comp, "get_psr_coords"):
+            return comp.get_psr_coords(epoch=epoch_mjd)
     raise AttributeError("No Astrometry component found")
 
 
