@@ -1,10 +1,14 @@
 """Tk plotting widget for interactive timing (reference ``pintk/plk.py``).
 
-A compact Tk+matplotlib residual editor over :class:`pint_tpu.pintk.pulsar
-.Pulsar`: residual plot with error bars, rectangle TOA selection, fit
-button, parameter freeze/thaw checkboxes, phase-wrap and jump actions.
-Imports of tkinter/matplotlib happen at call time so headless deployments
-(and the --test CI path) never touch them.
+A thin Tk+matplotlib binding over :class:`pint_tpu.pintk.plkstate.PlkState`
+— selection, per-point delete, phase wraps, jumps, x/y-axis choice,
+fit-parameter checkboxes, random-model overlay and log-level all live in
+the GUI-independent state object (headlessly tested); this module only
+wires widgets and events to it.  Imports of tkinter/matplotlib happen at
+call time so headless deployments (and the --test CI path) never touch
+them.  Reference interactions: ``pintk/plk.py:760+`` helpstring (left
+click select, right click delete, f fit, d delete, t stash, u unselect,
+j jump, r reset).
 """
 
 from __future__ import annotations
@@ -25,98 +29,113 @@ def launch_gui(psr):
     from matplotlib.figure import Figure
     from matplotlib.widgets import RectangleSelector
 
+    from pint_tpu.pintk.colormodes import COLOR_MODES, get_color_mode
+    from pint_tpu.pintk.plkstate import XIDS, YIDS, PlkState, plotlabels
+
+    st = PlkState(psr)
+    overlay_cache = {}
+
     root = tk.Tk()
     root.title(f"pintk: {psr.name}")
     fig = Figure(figsize=(9, 5.5))
     ax = fig.add_subplot(111)
     canvas = FigureCanvasTkAgg(fig, master=root)
     canvas.get_tk_widget().pack(side=tk.TOP, fill=tk.BOTH, expand=1)
-    state = {"selected": np.zeros(len(psr.all_toas), dtype=bool),
-             "random_overlay": False, "colormode": "default"}
 
     def redraw():
         ax.clear()
-        r = psr.resids()
-        mjds = np.asarray(psr.all_toas.get_mjds(), dtype=float)
-        res_us = np.asarray(r.time_resids) * 1e6
-        errs = np.asarray(psr.all_toas.get_errors())
-        if len(state["selected"]) != len(psr.all_toas):
-            # tim edits change the TOA count; a stale mask kills every redraw
-            state["selected"] = np.zeros(len(psr.all_toas), dtype=bool)
-            state.pop("overlay_cache", None)
-        sel = state["selected"]
-        from pint_tpu.pintk.colormodes import get_color_mode
-
-        groups = get_color_mode(state["colormode"]).get_groups(psr, sel)
+        st._check_mask()
+        x = st.xvals()
+        y, yerr = st.yvals()
+        sel = st.selected
+        groups = get_color_mode(st.colormode).get_groups(psr, sel)
         for lbl, col, m in groups:
-            ax.errorbar(mjds[m], res_us[m], yerr=errs[m], fmt=".",
-                        color=col, ecolor="0.8", label=lbl)
+            ax.errorbar(x[m], y[m], yerr=yerr[m], fmt=".", color=col,
+                        ecolor="0.8", label=lbl)
         if len(groups) > 1:
             ax.legend(loc="upper right", fontsize=7)
-        if state["random_overlay"] and psr.fitted:
-            # random-model overlay (reference pintk random models): draws
-            # from the post-fit covariance shown as residual-delta curves.
-            # Cached per fit: recomputing re-jits 12 model copies per click.
+        if st.random_overlay and psr.fitted and st.xid == "mjd" \
+                and st.yid in ("pre-fit", "post-fit"):
+            # random-model overlay (us-unit deltas: only meaningful on the
+            # residual-in-us views), cached per fit: recomputing re-jits 12
+            # model copies per click.  TOA edits invalidate the cache (the
+            # draws are per-TOA and would broadcast-error after a delete).
             try:
-                if state.get("overlay_cache") is None:
-                    state["overlay_cache"] = psr.random_models(
+                if overlay_cache.get("n") != len(psr.all_toas):
+                    overlay_cache.clear()
+                if overlay_cache.get("draws") is None:
+                    overlay_cache["draws"] = psr.random_models(
                         nmodels=12, keep_models=False)
-                dphase = state["overlay_cache"]
-                order = np.argsort(mjds)
+                    overlay_cache["n"] = len(psr.all_toas)
+                dphase = overlay_cache["draws"]
+                order = np.argsort(x)
                 F0 = float(psr.model.F0.value)
                 for k in range(dphase.shape[0]):
-                    ax.plot(mjds[order], (res_us + dphase[k] / F0 * 1e6)[order],
+                    ax.plot(x[order], (y + dphase[k] / F0 * 1e6)[order],
                             color="#f0a030", alpha=0.35, lw=0.7, zorder=0)
             except Exception as e:
                 from pint_tpu.logging import log
 
                 log.warning(f"random-model overlay unavailable: {e}")
         ax.axhline(0, color="0.5", lw=0.8)
-        ax.set_xlabel("MJD")
-        ax.set_ylabel("Residual (us)")
+        ax.set_xlabel(plotlabels[st.xid])
+        ax.set_ylabel(plotlabels[st.yid])
+        r = st.last_resids  # the residuals yvals() just built
         ax.set_title(f"{psr.name}  chi2={r.chi2:.2f}/{r.dof}")
         canvas.draw()
 
     def on_select(eclick, erelease):
-        mjds = np.asarray(psr.all_toas.get_mjds(), dtype=float)
-        res_us = np.asarray(psr.resids().time_resids) * 1e6
-        x1, x2 = sorted([eclick.xdata, erelease.xdata])
-        y1, y2 = sorted([eclick.ydata, erelease.ydata])
-        state["selected"] |= ((mjds >= x1) & (mjds <= x2)
-                              & (res_us >= y1) & (res_us <= y2))
+        st.select_rect(eclick.xdata, erelease.xdata,
+                       eclick.ydata, erelease.ydata)
         redraw()
 
     selector = RectangleSelector(ax, on_select, useblit=True, button=[1])
+
+    def on_click(event):
+        if event.inaxes != ax or event.xdata is None:
+            return
+        if event.button == 3:  # right click: delete nearest point
+            if st.delete_point(event.xdata, event.ydata) is not None:
+                redraw()
+
+    def on_key(event):
+        if event.key == "f":
+            do_fit()
+        elif event.key == "d":
+            if st.delete_selected():
+                redraw()
+        elif event.key == "t":
+            st.stash_selected()
+            redraw()
+        elif event.key == "u":
+            st.unselect_all()
+            redraw()
+        elif event.key == "j":
+            if st.jump_selected():
+                redraw()
+        elif event.key == "r":
+            st.reset()
+            overlay_cache.clear()
+            redraw()
+
+    canvas.mpl_connect("button_press_event", on_click)
+    canvas.mpl_connect("key_press_event", on_key)
 
     bar = ttk.Frame(root)
     bar.pack(side=tk.BOTTOM, fill=tk.X)
 
     def do_fit():
-        psr.fit()
-        state.pop("overlay_cache", None)  # new covariance -> new draws
+        st.fit()
+        overlay_cache.clear()  # new covariance -> new draws
         redraw()
 
     def do_reset():
         psr.reset_model()
-        state["selected"][:] = False
+        st.unselect_all()
         redraw()
-
-    def do_clear_sel():
-        state["selected"][:] = False
-        redraw()
-
-    def do_jump():
-        if state["selected"].any():
-            psr.add_jump(state["selected"])
-            redraw()
-
-    def do_wrap(sign):
-        if state["selected"].any():
-            psr.add_phase_wrap(state["selected"], sign)
-            redraw()
 
     def do_random():
-        state["random_overlay"] = not state["random_overlay"]
+        st.random_overlay = not st.random_overlay
         redraw()
 
     def do_paredit():
@@ -129,38 +148,47 @@ def launch_gui(psr):
 
         TimChoiceWidget(root, psr, updates_cb=redraw)
 
-    # color-mode selector (reference pintk colormodes)
-    from pint_tpu.pintk.colormodes import COLOR_MODES
+    # color-mode / axis / log-level selectors
+    def combo(parent, label, values, init, cb, width=9):
+        ttk.Label(parent, text=label).pack(side=tk.RIGHT)
+        var = tk.StringVar(value=init)
 
-    ttk.Label(bar, text="Color:").pack(side=tk.RIGHT)
-    mode_var = tk.StringVar(value="default")
+        def on_change(_ev=None):
+            cb(var.get())
+            redraw()
 
-    def on_mode(_ev=None):
-        state["colormode"] = mode_var.get()
-        redraw()
+        c = ttk.Combobox(parent, textvariable=var, width=width,
+                         values=list(values), state="readonly")
+        c.bind("<<ComboboxSelected>>", on_change)
+        c.pack(side=tk.RIGHT)
+        return var
 
-    combo = ttk.Combobox(bar, textvariable=mode_var, width=8,
-                         values=sorted(COLOR_MODES), state="readonly")
-    combo.bind("<<ComboboxSelected>>", on_mode)
-    combo.pack(side=tk.RIGHT)
+    combo(bar, "Color:", sorted(COLOR_MODES), "default",
+          lambda v: setattr(st, "colormode", v))
+    combo(bar, "Y:", YIDS, st.yid, lambda v: st.set_choice(yid=v))
+    combo(bar, "X:", XIDS, st.xid, lambda v: st.set_choice(xid=v), width=12)
+    combo(bar, "Log:", ("DEBUG", "INFO", "WARNING", "ERROR"), "INFO",
+          lambda v: st.set_loglevel(v), width=8)
 
     for label, cmd in [("Fit", do_fit), ("Reset", do_reset),
-                       ("Clear sel", do_clear_sel), ("Jump sel", do_jump),
-                       ("Wrap +1", lambda: do_wrap(1)),
-                       ("Wrap -1", lambda: do_wrap(-1)),
+                       ("Clear sel", lambda: (st.unselect_all(), redraw())),
+                       ("Delete sel", lambda: (st.delete_selected(), redraw())),
+                       ("Jump sel", lambda: (st.jump_selected(), redraw())),
+                       ("Wrap +1", lambda: (st.phase_wrap(1), redraw())),
+                       ("Wrap -1", lambda: (st.phase_wrap(-1), redraw())),
                        ("Random models", do_random),
                        ("Edit par...", do_paredit),
                        ("Edit tim...", do_timedit)]:
         ttk.Button(bar, text=label, command=cmd).pack(side=tk.LEFT)
 
-    # parameter fit checkboxes
+    # parameter fit checkboxes (state functions; first 14 fit on one row)
     parbar = ttk.Frame(root)
     parbar.pack(side=tk.BOTTOM, fill=tk.X)
-    for p in psr.model.fittable_params[:14]:
-        var = tk.BooleanVar(value=not getattr(psr.model, p).frozen)
+    for p, isfit in st.fit_checkboxes()[:14]:
+        var = tk.BooleanVar(value=isfit)
 
         def mk(pn, v):
-            return lambda: psr.set_fit_state(pn, v.get())
+            return lambda: st.set_fit(pn, v.get())
 
         ttk.Checkbutton(parbar, text=p, variable=var,
                         command=mk(p, var)).pack(side=tk.LEFT)

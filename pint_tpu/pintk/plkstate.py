@@ -1,0 +1,244 @@
+"""GUI-independent pintk interaction state (reference ``pintk/plk.py``).
+
+Everything the reference's PlkWidget does in Tk callbacks — axis choice,
+per-point select/delete, stash, phase wraps, jumps, fit-parameter
+checkboxes, log-level — lives here as plain state functions over a
+:class:`~pint_tpu.pintk.pulsar.Pulsar`, so the whole interaction surface is
+headlessly testable (select -> delete -> refit without a display) and the
+Tk layer in ``plk.py`` stays a thin binding.  Reference behaviors:
+axis ids and labels ``plk.py:39 plotlabels``, ``plk.py:581 setChoice``;
+click select / delete / stash keys ``plk.py:760+`` helpstring.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.logging import log
+
+__all__ = ["PlkState", "XIDS", "YIDS", "plotlabels"]
+
+#: x-axis choice ids (reference ``plk.py plotlabels`` keys)
+XIDS = ("mjd", "year", "day of year", "serial", "orbital phase",
+        "frequency", "TOA error", "rounded MJD", "elongation")
+#: y-axis choice ids
+YIDS = ("pre-fit", "post-fit", "white-res")
+
+plotlabels = {
+    "mjd": "MJD", "year": "Year", "day of year": "Day of the year",
+    "serial": "TOA number", "orbital phase": "Orbital Phase",
+    "frequency": "Observing Frequency (MHz)",
+    "TOA error": "TOA uncertainty (us)", "rounded MJD": "MJD",
+    "elongation": "Solar Elongation (deg)",
+    "pre-fit": "Pre-fit residual (us)", "post-fit": "Post-fit residual (us)",
+    "white-res": "Whitened residual",
+}
+
+
+class PlkState:
+    """Interaction state over a Pulsar: selection mask, axis ids, stash."""
+
+    def __init__(self, psr):
+        self.psr = psr
+        self.xid = "mjd"
+        self.yid = "pre-fit"
+        self.selected = np.zeros(len(psr.all_toas), dtype=bool)
+        self.random_overlay = False
+        self.colormode = "default"
+        self._stash = None  # (stashed TOAs object) when 't' stashed
+        self.last_resids = None  # set by yvals(); reused for the title
+
+    # -- axis data -----------------------------------------------------------
+    def set_choice(self, xid: Optional[str] = None,
+                   yid: Optional[str] = None) -> None:
+        """Pick the plotted quantities (reference ``plk.py:581``)."""
+        if xid is not None:
+            if xid not in XIDS:
+                raise ValueError(f"unknown x-axis id {xid!r}; one of {XIDS}")
+            self.xid = xid
+        if yid is not None:
+            if yid not in YIDS:
+                raise ValueError(f"unknown y-axis id {yid!r}; one of {YIDS}")
+            self.yid = yid
+
+    def xvals(self) -> np.ndarray:
+        psr, xid = self.psr, self.xid
+        mjds = np.asarray(psr.all_toas.get_mjds(), dtype=np.float64)
+        if xid == "mjd":
+            return mjds
+        if xid == "rounded MJD":
+            return np.floor(mjds + 0.5)
+        if xid == "year":
+            return psr.year()
+        if xid == "day of year":
+            return psr.dayofyear()
+        if xid == "serial":
+            return np.arange(len(mjds), dtype=np.float64)
+        if xid == "orbital phase":
+            return psr.orbitalphase()
+        if xid == "frequency":
+            f = np.asarray(psr.all_toas.get_freqs(), dtype=np.float64)
+            return np.where(np.isfinite(f), f, 0.0)
+        if xid == "TOA error":
+            return np.asarray(psr.all_toas.get_errors(), dtype=np.float64)
+        if xid == "elongation":
+            for comp in psr.model.components.values():
+                if hasattr(comp, "sun_angle"):
+                    return np.degrees(np.asarray(
+                        comp.sun_angle(psr.all_toas)))
+            log.warning("no astrometry component: elongation = 0")
+            return np.zeros(len(mjds))
+        raise ValueError(xid)
+
+    def yvals(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(values, errors) in the y quantity's units (us for residuals).
+
+        'pre-fit' is measured against the INITIAL model (``prefit_resids``,
+        kept vs model_init) so it stays distinct from 'post-fit' after a
+        fit.  The residuals object actually used is left in
+        ``self.last_resids`` so a caller (the plot title) need not rebuild
+        it."""
+        psr = self.psr
+        errs = np.asarray(psr.all_toas.get_errors(), dtype=np.float64)
+        if psr.prefit_resids is None or \
+                len(np.asarray(psr.prefit_resids.resids)) != len(errs):
+            psr.update_resids()  # TOA edits leave cached residuals stale
+        if self.yid == "pre-fit":
+            r = psr.prefit_resids
+        elif psr.fitted:
+            r = psr.postfit_resids
+        else:
+            if self.yid == "post-fit":
+                log.warning("not fitted yet: post-fit shows pre-fit")
+            r = psr.prefit_resids
+        self.last_resids = r
+        if self.yid == "white-res":
+            return np.asarray(r.calc_whitened_resids()), np.ones_like(errs)
+        return np.asarray(r.time_resids) * 1e6, errs
+
+    # -- selection -----------------------------------------------------------
+    def _check_mask(self) -> None:
+        if len(self.selected) != len(self.psr.all_toas):
+            self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+
+    def select_rect(self, x1: float, x2: float, y1: float, y2: float,
+                    append: bool = True) -> int:
+        """Add (or replace) the rectangle's points; returns selected count."""
+        self._check_mask()
+        x, (y, _) = self.xvals(), self.yvals()
+        m = (x >= min(x1, x2)) & (x <= max(x1, x2)) \
+            & (y >= min(y1, y2)) & (y <= max(y1, y2))
+        self.selected = (self.selected | m) if append else m
+        return int(self.selected.sum())
+
+    def nearest_point(self, x: float, y: float,
+                      max_dist: float = 0.05) -> Optional[int]:
+        """Index of the closest point in axis-normalized distance, or None
+        (the reference's click tolerance, ``plk.py closest point``)."""
+        self._check_mask()
+        xv, (yv, _) = self.xvals(), self.yvals()
+        xs = np.ptp(xv) or 1.0
+        ys = np.ptp(yv) or 1.0
+        d = np.hypot((xv - x) / xs, (yv - y) / ys)
+        i = int(np.argmin(d))
+        return i if d[i] <= max_dist else None
+
+    def toggle_point(self, x: float, y: float) -> Optional[int]:
+        """Left click: toggle the nearest point's selection."""
+        i = self.nearest_point(x, y)
+        if i is not None:
+            self.selected[i] = ~self.selected[i]
+        return i
+
+    def unselect_all(self) -> None:  # 'u'
+        self._check_mask()
+        self.selected[:] = False
+
+    # -- deletion / stash ----------------------------------------------------
+    def delete_point(self, x: float, y: float) -> Optional[int]:
+        """Right click: permanently delete the nearest point."""
+        i = self.nearest_point(x, y)
+        if i is not None:
+            self.psr.delete_TOAs([i])
+            self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+        return i
+
+    def delete_selected(self) -> int:  # 'd'
+        self._check_mask()
+        n = int(self.selected.sum())
+        if n:
+            self.psr.delete_TOAs(np.nonzero(self.selected)[0])
+            self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+        return n
+
+    def stash_selected(self) -> int:
+        """'t': temporarily remove the selected TOAs (or un-stash when the
+        selection is empty and a stash exists, like the reference)."""
+        self._check_mask()
+        if not self.selected.any():
+            return -self.unstash()
+        self._stash = self.psr.all_toas
+        self.psr.all_toas = self.psr.all_toas[~self.selected]
+        self.psr.reset_selection()
+        self.psr.update_resids()
+        n = int(self.selected.sum())
+        self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+        return n
+
+    def unstash(self) -> int:
+        if self._stash is None:
+            return 0
+        restored = len(self._stash) - len(self.psr.all_toas)
+        self.psr.all_toas = self._stash
+        self._stash = None
+        self.psr.reset_selection()
+        self.psr.update_resids()
+        self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+        return restored
+
+    # -- model interactions --------------------------------------------------
+    def phase_wrap(self, n: int) -> None:
+        self._check_mask()
+        if self.selected.any():
+            self.psr.add_phase_wrap(self.selected, n)
+
+    def jump_selected(self) -> Optional[str]:  # 'j'
+        self._check_mask()
+        if self.selected.any():
+            return self.psr.add_jump(self.selected)
+        return None
+
+    def fit(self, iters: int = 4) -> float:
+        """'f': fit the selected TOAs, or all when none selected."""
+        self._check_mask()
+        if self.selected.any():
+            self.psr.select_toas(self.selected)
+            chi2 = self.psr.fit(selected=True, iters=iters)
+        else:
+            chi2 = self.psr.fit(iters=iters)
+        return chi2
+
+    def reset(self) -> None:  # 'r'
+        self.psr.resetAll()
+        self._stash = None
+        self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+
+    # -- fit-parameter checkboxes -------------------------------------------
+    def fit_checkboxes(self) -> list:
+        """[(param, is_fit)] over the model's fittable parameters."""
+        return [(p, not getattr(self.psr.model, p).frozen)
+                for p in self.psr.model.fittable_params]
+
+    def set_fit(self, param: str, fit: bool) -> None:
+        self.psr.set_fit_state(param, fit)
+
+    def get_fit(self, param: str) -> bool:
+        return not getattr(self.psr.model, param).frozen
+
+    # -- log level (reference log-level dropdown) ---------------------------
+    def set_loglevel(self, level: str) -> None:
+        import logging as _pylog
+
+        log.setLevel(getattr(_pylog, level.upper()))

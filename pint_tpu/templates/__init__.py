@@ -1,14 +1,18 @@
 """Pulse-profile template machinery for photon-domain likelihoods
 (counterpart of reference ``templates/``; SURVEY §2 "templates (photon)")."""
 
-from pint_tpu.templates.lcfitters import LCFitter
+from pint_tpu.templates.lcfitters import (LCFitter, get_errors,
+                                          make_err_plot)
 from pint_tpu.templates.lcnorm import NormAngles
 from pint_tpu.templates.lcprimitives import (
     LCGaussian,
     LCLorentzian,
     LCPrimitive,
+    LCSkewGaussian,
     LCTopHat,
     LCVonMises,
+    LCWrappedFunction,
+    two_comp_mc,
 )
 from pint_tpu.templates.lctemplate import (
     LCTemplate,
@@ -19,6 +23,7 @@ from pint_tpu.templates.lctemplate import (
 
 __all__ = [
     "LCFitter", "NormAngles", "LCGaussian", "LCLorentzian", "LCPrimitive",
-    "LCTopHat", "LCVonMises", "LCTemplate", "gauss_template_from_file",
-    "make_twoside_gaussian", "prim_io",
+    "LCSkewGaussian", "LCWrappedFunction", "two_comp_mc", "get_errors",
+    "make_err_plot", "LCTopHat", "LCVonMises", "LCTemplate",
+    "gauss_template_from_file", "make_twoside_gaussian", "prim_io",
 ]

@@ -13,11 +13,12 @@ from typing import Optional
 
 import numpy as np
 
-from pint_tpu.templates.lcprimitives import (LCGaussian, LCGaussian2,
+from pint_tpu.templates.lcprimitives import (LCGaussian, LCGaussian2, LCSkewGaussian,
                                              LCLorentzian, LCLorentzian2,
                                              LCPrimitive, LCVonMises)
 
-__all__ = ["LCEPrimitive", "LCEGaussian", "LCEGaussian2", "LCELorentzian",
+__all__ = ["LCEPrimitive", "LCEGaussian", "LCEGaussian2", "LCESkewGaussian",
+           "LCELorentzian",
            "LCELorentzian2", "LCEVonMises"]
 
 
@@ -67,14 +68,22 @@ class LCEPrimitive(LCPrimitive):
     def set_location(self, loc: float):
         self.p[self.nb - 1] = loc % 1.0
 
+    #: base-parameter columns clamped positive along the energy track;
+    #: None means every column but the trailing location (width-like
+    #: shapes).  Subclasses with sign-free shape parameters narrow this.
+    clamp_cols = None
+
     def parameters_at(self, log10_ens) -> np.ndarray:
         """(..., nb) effective base parameters at the given energies."""
         le = np.asarray(log10_ens, dtype=np.float64)
         dle = le - np.log10(self.e0)
         base, slopes = self.p[:self.nb], self.p[self.nb:]
         out = base[None, :] + np.atleast_1d(dle)[:, None] * slopes[None, :]
-        # widths (all but the trailing location) must stay positive
-        out[:, :-1] = np.maximum(out[:, :-1], 1e-4)
+        # width-like columns must stay positive at every energy
+        cols = range(self.nb - 1) if self.clamp_cols is None \
+            else self.clamp_cols
+        for c in cols:
+            out[:, c] = np.maximum(out[:, c], 1e-4)
         return out
 
     def __call__(self, phases, log10_ens=None):
@@ -124,3 +133,17 @@ class LCELorentzian2(LCEPrimitive):
 
     base_cls = LCLorentzian2
     name = "ELorentzian2"
+
+
+class LCESkewGaussian(LCEPrimitive):
+    """Energy-dependent wrapped skew-normal (reference
+    ``lceprimitives.py LCESkewGaussian``): [width, shape, location] base
+    parameters plus one log-energy slope each.  The wrapped-function hooks
+    are borrowed from the base shape so ``base_cls._pdf`` (which calls
+    ``self.base_func``/``self.base_int``) resolves on this class too."""
+
+    base_cls = LCSkewGaussian
+    name = "ESkewGaussian"
+    base_func = LCSkewGaussian.base_func
+    base_int = LCSkewGaussian.base_int
+    clamp_cols = (0,)  # width only: Shape is legitimately signed

@@ -18,10 +18,11 @@ import math
 
 import numpy as np
 
-__all__ = ["LCPrimitive", "LCGaussian", "LCGaussian2", "LCLorentzian",
-           "LCLorentzian2", "LCVonMises", "LCTopHat", "LCKing", "LCHarmonic",
+__all__ = ["LCPrimitive", "LCWrappedFunction", "LCGaussian", "LCGaussian2",
+           "LCLorentzian", "LCLorentzian2", "LCVonMises", "LCTopHat",
+           "LCKing", "LCHarmonic", "LCSkewGaussian",
            "LCEmpiricalFourier", "LCKernelDensity", "convert_primitive",
-           "approx_gradient", "check_gradient"]
+           "approx_gradient", "check_gradient", "two_comp_mc"]
 
 _NWRAP = 6  # image terms each side; adequate for width > ~0.005
 
@@ -478,6 +479,115 @@ class LCKernelDensity(LCPrimitive):
         frac = idx - xp.floor(idx)
         vals = xp.asarray(self.vals)
         return vals[i0] * (1 - frac) + vals[i1] * frac
+
+
+class LCWrappedFunction(LCPrimitive):
+    """Base for profiles defined by wrapping an infinite-support density
+    (reference ``lcprimitives.py:559 LCWrappedFunction``).
+
+    Subclasses provide ``base_func(phases, p, index)`` — the unwrapped
+    density evaluated at ``phases + index`` — and optionally
+    ``base_int(x1, x2, p)``, its exact integral.  ``_pdf`` sums image terms
+    over a fixed +-``_NWRAP`` window (trace-static, jit-friendly — the
+    reference instead iterates to convergence, which is data-dependent
+    control flow) and, when ``base_int`` is available and the evaluation is
+    host-side, adds the truncated tail back as a uniform component so the
+    wrapped density still integrates to exactly 1 (the reference's
+    normalization adjustment).
+    """
+
+    def base_func(self, phases, p, index=0):
+        raise NotImplementedError
+
+    def base_int(self, x1, x2, p):
+        return None
+
+    def _pdf(self, phases, p):
+        xp = _np_or_jnp(phases)
+        z = xp.asarray(phases) % 1.0
+        out = 0.0
+        for k in range(-_NWRAP, _NWRAP + 1):
+            out = out + self.base_func(z, p, index=k)
+        if xp is np:
+            covered = self.base_int(-_NWRAP, _NWRAP + 1, p)
+            if covered is not None:
+                out = out + (1.0 - covered)  # uniform remainder
+        return out
+
+
+class LCSkewGaussian(LCWrappedFunction):
+    """Wrapped skew-normal peak: p = [width, shape, location] (reference
+    ``lcprimitives.py:858 LCSkewGaussian``).  ``shape`` > 0 skews right;
+    shape = 0 reduces exactly to :class:`LCGaussian`.  ``location`` is the
+    location parameter of the skew-normal (not its mode)."""
+
+    name = "SkewGaussian"
+    pnames = ["Width", "Shape", "Location"]
+    p0 = [0.03, 0.0, 0.5]
+
+    def base_func(self, phases, p, index=0):
+        xp = _np_or_jnp(phases)
+        if xp is np:
+            from scipy.special import erf
+        else:
+            from jax.scipy.special import erf
+        width, shape, x0 = p[0], p[1], p[2]
+        z = (xp.asarray(phases) + index - x0) / width
+        return (1.0 / (width * math.sqrt(2 * math.pi))) \
+            * xp.exp(-0.5 * z * z) * (1.0 + erf(shape * z / math.sqrt(2.0)))
+
+    def base_int(self, x1, x2, p):
+        from scipy.stats import skewnorm
+
+        width, shape, x0 = p[0], p[1], p[2]  # scalars, or per-photon columns
+        return np.asarray(skewnorm.cdf(x2, shape, loc=x0, scale=width)
+                          - skewnorm.cdf(x1, shape, loc=x0, scale=width))
+
+    def get_location(self) -> float:
+        return float(self.p[2])
+
+    def set_location(self, loc: float):
+        self.p[2] = loc % 1.0
+
+    def hwhm(self, right: bool = False) -> float:
+        """Numeric HWHM about the mode (no closed form for skew normal)."""
+        g = np.linspace(0, 1, 4096, endpoint=False)
+        y = np.asarray(self(g))
+        imax = int(np.argmax(y))
+        half = y[imax] / 2.0
+        d = (g - g[imax] + 0.5) % 1.0 - 0.5
+        sel = (d > 0) if right else (d < 0)
+        below = sel & (y < half)
+        if not np.any(below):
+            return 0.25
+        return float(np.min(np.abs(d[below])))
+
+    def random(self, n: int, rng=None) -> np.ndarray:
+        """Exact skew-normal sampling: z = delta|u| + sqrt(1-delta^2) v with
+        (u, v) iid standard normal, delta = shape/sqrt(1+shape^2)."""
+        rng = rng or np.random.default_rng()
+        width, shape, x0 = self.p
+        delta = shape / math.sqrt(1.0 + shape * shape)
+        u = np.abs(rng.standard_normal(n))
+        v = rng.standard_normal(n)
+        z = delta * u + math.sqrt(1.0 - delta * delta) * v
+        return (x0 + width * z) % 1.0
+
+
+def two_comp_mc(n, w1, w2, loc, func, rng=None):
+    """Monte-Carlo photon phases from a two-sided peak (reference
+    ``lcprimitives.py:45 two_comp_mc``): draw from ``func`` (a scipy-style
+    ``rvs(loc=, scale=, size=)``) with left scale ``w1`` / right scale
+    ``w2``, folding each draw onto its side of ``loc``; side membership is
+    Bernoulli in w1/(w1+w2) so the composite density is continuous."""
+    rng = rng or np.random.default_rng()
+    w1, w2 = float(w1), float(w2)
+    n1 = int(np.sum(rng.random(n) < w1 / (w1 + w2)))
+    left = np.asarray(func(loc=0.0, scale=w1, size=n1))
+    left = loc - np.abs(left)
+    right = np.asarray(func(loc=0.0, scale=w2, size=n - n1))
+    right = loc + np.abs(right)
+    return np.concatenate([left, right]) % 1.0
 
 
 def convert_primitive(p1: LCPrimitive, ptype=LCLorentzian) -> LCPrimitive:

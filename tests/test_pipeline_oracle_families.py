@@ -1,0 +1,628 @@
+"""40-digit pipeline-oracle rows for the delay/phase families the original
+harness did not cover (VERDICT r4 missing #1 / next-round item 4): ELL1H,
+DDK, DDGR, glitch recoveries, troposphere (Niell mapping), chromatic CM/CMX,
+wave, ifunc, piecewise spindown, SWX.
+
+Same philosophy as ``test_pipeline_oracle.py``: both sides get IDENTICAL
+fabricated TDB times and observer/sun vectors; the framework computes
+residuals through its full jitted stack, while the oracle recomputes every
+delay/phase term from the published formulas in 40-digit mpmath — with the
+binary delays supplied by the *reference's own engines* executed in-process
+through the r2 unit shim (use-as-oracle, not copying) — and the residual
+vectors must agree at the nanosecond level.
+
+Reference formulas: ``glitch.py:12``, ``troposphere_delay.py:16`` (Davis
+1985 zenith + Niell 1996 mapping), ``chromatic_model.py:118,313``,
+``wave.py:11,148``, ``ifunc.py:128``, ``piecewise.py:12``,
+``solar_wind_dispersion.py:608`` (Hazboun et al. 2022 eq. 11 geometry);
+engine oracles ``ELL1H_model.py``, ``DDK_model.py``, ``DDGR_model.py``.
+"""
+
+import math
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _refshim  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_refshim.REF), reason="reference tree not present")
+
+mp = pytest.importorskip("mpmath")
+mp.mp.dps = 40
+
+N = 32
+SECPERDAY = 86400.0
+C_KM_S = 299792.458
+DMK = 1.0 / 2.41e-4
+AU_KM = 149597870.7
+AU_LS = AU_KM / C_KM_S
+PC_LS = 3.0856775814913673e16 / 299792458.0
+KPC_LS = 3.0856775814913673e19 / 299792458.0
+T_SUN = 4.925490947641267e-06
+
+BASE_ECL = """\
+PSR FABFAM
+LAMBDA 123.456
+BETA 17.3
+POSEPOCH 55300
+F0 218.8118437960826 1
+F1 -4.08D-16 1
+PEPOCH 55300
+DM 11.5 1
+UNITS TDB
+"""
+
+BASE_EQ = """\
+PSR FABK
+RAJ 17:48:52.75
+DECJ -20:21:29.0
+PMRA 3.1
+PMDEC -2.4
+PX 0.9
+POSEPOCH 55300
+F0 218.8118437960826 1
+F1 -4.08D-16 1
+PEPOCH 55300
+DM 11.5 1
+UNITS TDB
+"""
+
+
+def _fab(par_text, n=N, obs="bat", seed=11, mjd_lo=54200.0, mjd_hi=56400.0):
+    """Model + TOAs with fabricated, smooth, reproducible tdb/posvel inputs."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import get_TOAs
+
+    rng = np.random.default_rng(seed)
+    model = get_model([ln + "\n" for ln in par_text.splitlines()])
+    mjds = np.sort(rng.uniform(mjd_lo, mjd_hi, n))
+    freqs = np.where(rng.random(n) < 0.5, 430.0, 1410.0) + rng.uniform(0, 40, n)
+    lines = ["FORMAT 1\n"]
+    for i in range(n):
+        lines.append(f"f{i} {freqs[i]:.4f} {mjds[i]:.13f} "
+                     f"{1.0 + rng.random():.3f} {obs}\n")
+    with tempfile.NamedTemporaryFile("w", suffix=".tim", delete=False) as f:
+        f.write("".join(lines))
+        timf = f.name
+    t = get_TOAs(timf, include_gps=False, include_bipm=False)
+    os.unlink(timf)
+
+    ph = 2 * np.pi * (mjds - 54000.0) / 365.25
+    obs_v = np.stack([AU_KM * np.cos(ph), AU_KM * 0.9 * np.sin(ph),
+                      AU_KM * 0.39 * np.sin(ph)], axis=1)
+    vel = np.stack([-30.0 * np.sin(ph), 27.0 * np.cos(ph),
+                    11.7 * np.cos(ph)], axis=1)
+    sun = -obs_v * (1.0 + 0.01 * np.sin(3 * ph))[:, None]
+    t.ssb_obs_pos_km = obs_v
+    t.ssb_obs_vel_kms = vel
+    t.obs_sun_pos_km = sun
+    t._version += 1
+    return model, t
+
+
+# ---------------------------------------------------------------------------
+# oracle building blocks (mpmath)
+# ---------------------------------------------------------------------------
+
+def _mp_tdb(t):
+    hi64 = np.asarray(t.tdb, np.float64)
+    if t.tdb_lo is not None:
+        lo64 = np.asarray(t.tdb_lo, np.float64)
+    else:
+        lo64 = np.asarray(t.tdb - hi64.astype(np.longdouble), np.float64)
+    return [mp.mpf(float(h)) + mp.mpf(float(l)) for h, l in zip(hi64, lo64)]
+
+
+def _lhats(model, tdb):
+    """Equatorial unit vectors per TOA, PM applied linearly in angle (the
+    same approximation the timing model uses, ``astrometry.py:181-196``)."""
+    from pint_tpu import OBL_IERS2010_RAD
+
+    masyr = mp.pi / 180 / 3600 / 1000 / mp.mpf("365.25")
+    pe = mp.mpf(repr(float(model.POSEPOCH.value)))
+    out = []
+    if "AstrometryEcliptic" in model.components:
+        lam0 = mp.mpf(repr(float(model.ELONG.value)))
+        bet0 = mp.mpf(repr(float(model.ELAT.value)))
+        pml = mp.mpf(repr(float(model.PMELONG.value or 0.0)))
+        pmb = mp.mpf(repr(float(model.PMELAT.value or 0.0)))
+        cob = mp.cos(mp.mpf(float(OBL_IERS2010_RAD)))
+        sob = mp.sin(mp.mpf(float(OBL_IERS2010_RAD)))
+        for ti in tdb:
+            dt = ti - pe
+            lat = bet0 + pmb * masyr * dt
+            lon = lam0 + pml * masyr * dt / mp.cos(bet0)
+            cb = mp.cos(lat)
+            xe, ye, ze = cb * mp.cos(lon), cb * mp.sin(lon), mp.sin(lat)
+            out.append((xe, cob * ye - sob * ze, sob * ye + cob * ze))
+    else:
+        ra0 = mp.mpf(repr(float(model.RAJ.value)))
+        dec0 = mp.mpf(repr(float(model.DECJ.value)))
+        pmra = mp.mpf(repr(float(model.PMRA.value or 0.0)))
+        pmdec = mp.mpf(repr(float(model.PMDEC.value or 0.0)))
+        for ti in tdb:
+            dt = ti - pe
+            dec = dec0 + pmdec * masyr * dt
+            ra = ra0 + pmra * masyr * dt / mp.cos(dec0)
+            cd = mp.cos(dec)
+            out.append((cd * mp.cos(ra), cd * mp.sin(ra), mp.sin(dec)))
+    return out
+
+
+def _base_delays(model, t, tdb, Lhats):
+    """Roemer (+PX) + sun Shapiro + DM/f^2 dispersion, and the barycentric
+    frequencies (doppler) shared by every chromatic term."""
+    obs_ls = np.asarray(t.ssb_obs_pos_km) / C_KM_S
+    sun_ls = np.asarray(t.obs_sun_pos_km) / C_KM_S
+    vel_ls = np.asarray(t.ssb_obs_vel_kms) / C_KM_S
+    px = mp.mpf(repr(float(model.PX.value))) if (
+        "PX" in model and model.PX.value) else None
+    dmv = mp.mpf(repr(float(model.DM.value)))
+    pepoch = mp.mpf(repr(float(model.PEPOCH.value)))
+    parsed_freq = np.asarray(t.freq_mhz)
+    delays, bfreq = [], []
+    AU_LS_f = mp.mpf(repr(AU_LS))
+    for i in range(len(t)):
+        L = Lhats[i]
+        r = [mp.mpf(float(v)) for v in obs_ls[i]]
+        rdL = sum(a * b for a, b in zip(r, L))
+        r2 = sum(a * a for a in r)
+        d = -rdL
+        if px is not None:
+            d += mp.mpf("0.5") * r2 * (px / mp.mpf(repr(KPC_LS))) \
+                * (1 - rdL**2 / r2)
+        s = [mp.mpf(float(v)) for v in sun_ls[i]]
+        smag = mp.sqrt(sum(a * a for a in s))
+        rdn = sum(a * b for a, b in zip(s, L))
+        d += -2 * mp.mpf(repr(T_SUN)) * mp.log((smag - rdn) / AU_LS_f)
+        v = [mp.mpf(float(x)) for x in vel_ls[i]]
+        vdL = sum(a * b for a, b in zip(v, L))
+        bf = mp.mpf(repr(float(parsed_freq[i]))) * (1 - vdL)
+        bfreq.append(bf)
+        d += dmv * mp.mpf(repr(DMK)) / bf**2
+        delays.append(d)
+    return delays, bfreq, pepoch
+
+
+def _resids(model, t, delays, tdb, pepoch, phase_extra=None):
+    """frac phase (spindown + optional extra phase terms) -> time residuals,
+    weighted-mean subtracted with the RAW TOA errors, / F0."""
+    F0 = mp.mpf(repr(float(model.F0.value)))
+    F1 = mp.mpf(repr(float(model.F1.value)))
+    fracs = []
+    for i in range(len(t)):
+        dt = (tdb[i] - pepoch) * SECPERDAY - delays[i]
+        phase = F0 * dt + F1 * dt * dt / 2
+        if phase_extra is not None:
+            phase += phase_extra(i, dt, delays[i])
+        fracs.append(phase - mp.nint(phase))
+    err = np.asarray(t.get_errors()) * 1e-6
+    w = 1.0 / err**2
+    fr = np.array([float(f) for f in fracs])
+    fr -= np.sum(fr * w) / np.sum(w)
+    return fr / float(F0)
+
+
+def _assert_parity(model, t, theirs, tol=2e-9, label=""):
+    from pint_tpu.residuals import Residuals
+
+    r = Residuals(t, model, track_mode="nearest")
+    mine = np.asarray(r.time_resids)
+    ph = model.phase(t)
+    assert np.all(np.abs(np.abs(np.asarray(ph.frac)) - 0.5) > 1e-3), \
+        f"{label}: fabricated phase near wrap boundary, re-seed"
+    err = np.abs(mine - theirs)
+    assert err.max() < tol, (
+        f"{label} pipeline parity: max |delta| = {err.max():.3e} s "
+        f"at i={int(err.argmax())}")
+
+
+@pytest.fixture(scope="module")
+def ref():
+    return _refshim.install_and_import()
+
+
+# ---------------------------------------------------------------------------
+# phase-family rows (glitch / wave / ifunc / piecewise)
+# ---------------------------------------------------------------------------
+
+class TestPhaseFamilies:
+    def test_glitch(self):
+        """Two glitches, one with an exponential recovery (ref glitch.py:12):
+        dphi = GLPH + GLF0*dt + GLF1*dt^2/2 + GLF0D*tau*(1-exp(-dt/tau))."""
+        model, t = _fab(BASE_ECL + (
+            "GLEP_1 55100\nGLPH_1 0.3\nGLF0_1 2e-8 1\nGLF1_1 -1e-17\n"
+            "GLF0D_1 1.5e-8\nGLTD_1 80\nGLEP_2 55900\nGLF0_2 -1e-8\n"))
+        tdb = _mp_tdb(t)
+        L = _lhats(model, tdb)
+        delays, _, pepoch = _base_delays(model, t, tdb, L)
+
+        g = []
+        for i in (1, 2):
+            g.append({k: mp.mpf(repr(float(
+                getattr(model, f"{k}_{i}").value or 0.0)))
+                for k in ("GLEP", "GLPH", "GLF0", "GLF1", "GLF0D", "GLTD")})
+
+        def extra(i, dt, delay):
+            ph = mp.mpf(0)
+            for gl in g:
+                dtg = (tdb[i] - gl["GLEP"]) * SECPERDAY - delay
+                if dtg > 0:
+                    ph += gl["GLPH"] + dtg * (gl["GLF0"] + dtg * gl["GLF1"] / 2)
+                    if gl["GLTD"] > 0:
+                        tau = gl["GLTD"] * SECPERDAY
+                        ph += gl["GLF0D"] * tau * (1 - mp.exp(-dtg / tau))
+            return ph
+
+        _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch, extra),
+                       label="glitch")
+
+    def test_wave(self):
+        """Tempo WAVE sinusoids (ref wave.py:148): phase = F0 * sum_k
+        a_k sin(k om dt) + b_k cos(k om dt), dt days from WAVEEPOCH."""
+        model, t = _fab(BASE_ECL + (
+            "WAVEEPOCH 55300\nWAVE_OM 0.004\nWAVE1 0.01 -0.02\n"
+            "WAVE2 -0.004 0.003\nWAVE3 0.001 0.002\n"))
+        tdb = _mp_tdb(t)
+        L = _lhats(model, tdb)
+        delays, _, pepoch = _base_delays(model, t, tdb, L)
+        om = mp.mpf(repr(float(model.WAVE_OM.value)))
+        wep = mp.mpf(repr(float(model.WAVEEPOCH.value)))
+        F0 = mp.mpf(repr(float(model.F0.value)))
+        ab = [tuple(mp.mpf(repr(float(x)))
+                    for x in getattr(model, f"WAVE{k}").value)
+              for k in (1, 2, 3)]
+
+        def extra(i, dt, delay):
+            dt_day = tdb[i] - wep - delay / SECPERDAY
+            base = om * dt_day
+            s = mp.mpf(0)
+            for k, (a, b) in enumerate(ab, start=1):
+                s += a * mp.sin(k * base) + b * mp.cos(k * base)
+            return s * F0
+
+        _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch, extra),
+                       label="wave")
+
+    def test_ifunc_linear(self):
+        """SIFUNC 2 linear interpolation with flat extrapolation (ref
+        ifunc.py:128): phase += F0 * interp(t_bary)."""
+        model, t = _fab(BASE_ECL + (
+            "SIFUNC 2 0\nIFUNC1 54400 1e-4 0\nIFUNC2 55300 3e-4 0\n"
+            "IFUNC3 56200 -2e-4 0\n"))
+        tdb = _mp_tdb(t)
+        L = _lhats(model, tdb)
+        delays, _, pepoch = _base_delays(model, t, tdb, L)
+        F0 = mp.mpf(repr(float(model.F0.value)))
+        xs = [mp.mpf("54400"), mp.mpf("55300"), mp.mpf("56200")]
+        ys = [mp.mpf("1e-4"), mp.mpf("3e-4"), mp.mpf("-2e-4")]
+
+        def extra(i, dt, delay):
+            ts = tdb[i] - delay / SECPERDAY
+            if ts <= xs[0]:
+                y = ys[0]
+            elif ts >= xs[-1]:
+                y = ys[-1]
+            else:
+                j = max(k for k in range(len(xs)) if xs[k] <= ts)
+                frac = (ts - xs[j]) / (xs[j + 1] - xs[j])
+                y = ys[j] + frac * (ys[j + 1] - ys[j])
+            return y * F0
+
+        _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch, extra),
+                       label="ifunc")
+
+    def test_piecewise_spindown(self):
+        """PWF0/PWF1 range solution (ref piecewise.py:12): in
+        [PWSTART, PWSTOP], phase += PWPH + dt*(PWF0 + dt*PWF1/2)."""
+        model, t = _fab(BASE_ECL + (
+            "PWEP_1 55300\nPWSTART_1 55000\nPWSTOP_1 55600\nPWPH_1 0.1\n"
+            "PWF0_1 1e-8 1\nPWF1_1 -2e-18\n"))
+        tdb = _mp_tdb(t)
+        L = _lhats(model, tdb)
+        delays, _, pepoch = _base_delays(model, t, tdb, L)
+        ep = mp.mpf("55300")
+        pwph, pwf0, pwf1 = (mp.mpf("0.1"), mp.mpf("1e-8"), mp.mpf("-2e-18"))
+
+        def extra(i, dt, delay):
+            t_mjd = tdb[i] - delay / SECPERDAY
+            if not (mp.mpf("55000") <= t_mjd <= mp.mpf("55600")):
+                return mp.mpf(0)
+            dtp = (tdb[i] - ep) * SECPERDAY - delay
+            return pwph + dtp * (pwf0 + dtp * pwf1 / 2)
+
+        _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch, extra),
+                       label="piecewise")
+
+
+# ---------------------------------------------------------------------------
+# chromatic / solar-wind delay rows
+# ---------------------------------------------------------------------------
+
+class TestChromaticAndSolarWind:
+    def test_chromatic_cm_cmx(self):
+        """CM Taylor series + CMX window offsets at nu^-TNCHROMIDX (ref
+        chromatic_model.py:118,313), on the doppler-shifted frequency."""
+        model, t = _fab(BASE_ECL + (
+            "CM 0.02 1\nCM1 0.003\nCMEPOCH 55300\nTNCHROMIDX 4\n"
+            "CMX_0001 0.01 1\nCMXR1_0001 54800\nCMXR2_0001 55500\n"))
+        tdb = _mp_tdb(t)
+        L = _lhats(model, tdb)
+        delays, bfreq, pepoch = _base_delays(model, t, tdb, L)
+        mjd_utc = np.asarray(t.get_mjds(), np.float64)
+        cm0, cm1 = mp.mpf("0.02"), mp.mpf("0.003")
+        cmx = mp.mpf("0.01")
+        dmk = mp.mpf(repr(DMK))
+        hi64 = np.asarray(t.tdb, np.float64)
+        for i in range(len(t)):
+            # CM Taylor is evaluated at tdb (float64 hi), not t_bary
+            # (chromatic.py:78 dt_yr uses batch.tdb.hi)
+            dt_yr = mp.mpf(repr(float(hi64[i]))) - mp.mpf("55300")
+            dt_yr = dt_yr / mp.mpf("365.25")
+            cm = cm0 + cm1 * dt_yr
+            if 54800.0 <= mjd_utc[i] <= 55500.0:
+                cm += cmx
+            delays[i] += cm * dmk / bfreq[i]**4
+        _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch),
+                       label="chromatic")
+
+    def test_swx(self):
+        """SWX window solar wind (ref solar_wind_dispersion.py:608): DM =
+        SWXDM * (geom - geom_opp)/(geom_conj - geom_opp), geometry from
+        Hazboun et al. (2022) eq. 11 with the exact integral computed by
+        mp.quad (the framework uses 64-pt Gauss-Legendre)."""
+        model, t = _fab(BASE_ECL + (
+            "SWXDM_0001 5e-4 1\nSWXP_0001 2.0\nSWXR1_0001 54500\n"
+            "SWXR2_0001 55500\nSWXDM_0002 3e-4\nSWXP_0002 2.5\n"
+            "SWXR1_0002 55500.001\nSWXR2_0002 56500\n"))
+        tdb = _mp_tdb(t)
+        L = _lhats(model, tdb)
+        delays, bfreq, pepoch = _base_delays(model, t, tdb, L)
+        sun_ls = np.asarray(t.obs_sun_pos_km) / C_KM_S
+        mjd_utc = np.asarray(t.get_mjds(), np.float64)
+
+        def geom(r, theta, p):
+            b = r * mp.sin(theta)
+            z = r * mp.cos(theta)
+            I_inf = mp.sqrt(mp.pi) / 2 * mp.gamma((p - 1) / 2) / mp.gamma(p / 2)
+            I_u = mp.quad(lambda ph: mp.cos(ph)**(p - 2),
+                          [0, mp.atan(z / b)])
+            return (mp.mpf(repr(AU_LS)) / b)**p * (b / mp.mpf(repr(PC_LS))) \
+                * (I_inf + I_u)
+
+        # theta0: minimum elongation from the ecliptic latitude
+        # (solar_wind.py:96 _theta0, the reference's 'simplified model')
+        beta = mp.mpf(repr(float(model.ELAT.value)))
+        theta0 = abs(beta)
+        r0 = mp.mpf(repr(AU_LS))
+        wins = [(mp.mpf("5e-4"), mp.mpf(2), 54500.0, 55500.0),
+                (mp.mpf("3e-4"), mp.mpf("2.5"), 55500.001, 56500.0)]
+        dmk = mp.mpf(repr(DMK))
+        for i in range(len(t)):
+            s = [mp.mpf(float(v)) for v in sun_ls[i]]
+            smag = mp.sqrt(sum(a * a for a in s))
+            cost = sum(a * b for a, b in zip(s, L[i])) / smag
+            theta = mp.acos(cost)
+            dm = mp.mpf(0)
+            for swxdm, p, r1, r2 in wins:
+                if r1 <= mjd_utc[i] <= r2:
+                    g = geom(smag, theta, p)
+                    g_conj = geom(r0, theta0, p)
+                    g_opp = geom(r0, mp.pi - theta0, p)
+                    dm += swxdm * (g - g_opp) / (g_conj - g_opp)
+            delays[i] += dm * dmk / bfreq[i]**2
+        _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch),
+                       label="SWX")
+
+
+# ---------------------------------------------------------------------------
+# troposphere row (real gbt site; Niell tables are published data)
+# ---------------------------------------------------------------------------
+
+# Niell (1996) hydrostatic table + height correction and Davis (1985) zenith
+# delay constants, as published (same data the implementation bakes in)
+_LATS = [0.0, 15.0, 30.0, 45.0, 60.0, 75.0, 90.0]
+_NA = [0.0, 1.2769934e-3, 1.2683230e-3, 1.2465397e-3, 1.2196049e-3,
+       1.2045996e-3, 0.0]
+_NB = [0.0, 2.9153695e-3, 2.9152299e-3, 2.9288445e-3, 2.9022565e-3,
+       2.9024912e-3, 0.0]
+_NC = [0.0, 62.610505e-3, 62.837393e-3, 63.721774e-3, 63.824265e-3,
+       64.258455e-3, 0.0]
+_NA_AMP = [0.0, 0.0, 1.2709626e-5, 2.6523662e-5, 3.4000452e-5, 4.1202191e-5,
+           0.0]
+_NB_AMP = [0.0, 0.0, 2.1414979e-5, 3.0160779e-5, 7.2562722e-5, 11.723375e-5,
+           0.0]
+_NC_AMP = [0.0, 0.0, 9.0128400e-5, 4.3497037e-5, 84.795348e-5, 170.37206e-5,
+           0.0]
+
+
+def _interp1(x, xs, ys):
+    if x <= xs[0]:
+        return mp.mpf(repr(ys[0]))
+    if x >= xs[-1]:
+        return mp.mpf(repr(ys[-1]))
+    j = max(k for k in range(len(xs)) if xs[k] <= x)
+    f = (mp.mpf(repr(x)) - mp.mpf(repr(xs[j]))) / (
+        mp.mpf(repr(xs[j + 1])) - mp.mpf(repr(xs[j])))
+    return mp.mpf(repr(ys[j])) + f * (mp.mpf(repr(ys[j + 1]))
+                                      - mp.mpf(repr(ys[j])))
+
+
+def _herring(alt, a, b, c):
+    se = mp.sin(alt)
+    top = 1 + a / (1 + b / (1 + c))
+    bot = se + a / (se + b / (se + c))
+    return top / bot
+
+
+class TestTroposphere:
+    def test_troposphere_niell(self):
+        """Davis zenith delay x Niell hydrostatic mapping at a real ground
+        site (ref troposphere_delay.py:16).  The source altitude (Earth
+        orientation) is a shared input — the oracle independently recomputes
+        everything downstream of it: geodetic lat/height (WGS84), US-std
+        pressure, zenith delay, annual Niell coefficients, Herring continued
+        fraction, and the height correction."""
+        model, t = _fab(BASE_ECL + "CORRECT_TROPOSPHERE Y\n", obs="gbt")
+        tdb = _mp_tdb(t)
+        L = _lhats(model, tdb)
+        delays, _, pepoch = _base_delays(model, t, tdb, L)
+
+        from pint_tpu.earth import itrf_to_gcrs_matrix
+        from pint_tpu.observatory import get_observatory
+
+        xyz = np.asarray(get_observatory("gbt").itrf_xyz, np.float64)
+        # -- geodetic lat/height: closed Bowring iteration in mpmath -------
+        a_e, f_e = mp.mpf("6378137.0"), 1 / mp.mpf("298.257223563")
+        e2 = f_e * (2 - f_e)
+        x, y, z = (mp.mpf(repr(float(v))) for v in xyz)
+        p = mp.sqrt(x * x + y * y)
+        lat = mp.atan2(z, p * (1 - e2))
+        for _ in range(8):
+            Nn = a_e / mp.sqrt(1 - e2 * mp.sin(lat)**2)
+            h = p / mp.cos(lat) - Nn
+            lat = mp.atan2(z, p * (1 - e2 * Nn / (Nn + h)))
+        Nn = a_e / mp.sqrt(1 - e2 * mp.sin(lat)**2)
+        h = p / mp.cos(lat) - Nn
+        lon = mp.atan2(y, x)
+        up = np.array([float(mp.cos(lat) * mp.cos(lon)),
+                       float(mp.cos(lat) * mp.sin(lon)), float(mp.sin(lat))])
+
+        # -- altitude: shared input (framework Earth rotation) -------------
+        utc = np.asarray(t.get_mjds(), np.float64)
+        R = itrf_to_gcrs_matrix(utc)
+        zen = np.einsum("nij,j->ni", R, up)
+        astro = model.components["AstrometryEcliptic"]
+        ra, dec = astro.coords_as_ICRS()
+        psr = np.array([np.cos(dec) * np.cos(ra), np.cos(dec) * np.sin(ra),
+                        np.sin(dec)])
+        alt = np.pi / 2 - np.arccos(np.clip(zen @ psr, -1.0, 1.0))
+
+        # -- US standard atmosphere pressure -> Davis zenith delay ---------
+        h_km = h / 1000
+        gph = mp.mpf("6356.766") * h_km / (mp.mpf("6356.766") + h_km)
+        T = mp.mpf("288.15") - mp.mpf("0.0065") * gph * 1000
+        p_kpa = mp.mpf("101.325") * (mp.mpf("288.15") / T) ** mp.mpf("-5.25575")
+        c_light = mp.mpf("299792458.0")
+        zd = (p_kpa / mp.mpf("43.921")) / (
+            c_light * (1 - mp.mpf("0.00266") * mp.cos(2 * lat)
+                       - mp.mpf("0.00028") * h_km))
+
+        abs_lat = abs(float(lat) * 180 / np.pi)
+        for i in range(len(t)):
+            if alt[i] < np.radians(5.0):
+                continue  # zeroed by the implementation too
+            yf = ((utc[i] - 28.0) % 365.25) / 365.25
+            if float(lat) < 0:
+                yf = (yf + 0.5) % 1.0
+            cosyf = mp.cos(2 * mp.pi * mp.mpf(repr(float(yf))))
+            a_c = _interp1(abs_lat, _LATS, _NA) + cosyf * _interp1(
+                abs_lat, _LATS, _NA_AMP)
+            b_c = _interp1(abs_lat, _LATS, _NB) + cosyf * _interp1(
+                abs_lat, _LATS, _NB_AMP)
+            c_c = _interp1(abs_lat, _LATS, _NC) + cosyf * _interp1(
+                abs_lat, _LATS, _NC_AMP)
+            altm = mp.mpf(repr(float(alt[i])))
+            base = _herring(altm, a_c, b_c, c_c)
+            fcorr = _herring(altm, mp.mpf("2.53e-5"), mp.mpf("5.49e-3"),
+                             mp.mpf("1.14e-3"))
+            hmap = base + (1 / mp.sin(altm) - fcorr) * (h_km)
+            delays[i] += zd * hmap
+        _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch),
+                       label="troposphere")
+
+
+# ---------------------------------------------------------------------------
+# binary rows: reference engines as oracles (ELL1H / DDGR / DDK)
+# ---------------------------------------------------------------------------
+
+def _engine_delay(ref, mod_cls, pars, bary, fit_params=None, psr_pos=None,
+                  obs_pos_km=None):
+    import warnings
+
+    mod_name, cls_name = mod_cls
+    cls = getattr(getattr(ref, mod_name), cls_name)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = cls()
+        m.update_input(barycentric_toa=bary, **pars)
+        if fit_params is not None:
+            m.fit_params = fit_params
+        if psr_pos is not None:
+            m.psr_pos = psr_pos
+        if obs_pos_km is not None:
+            m.obs_pos = _refshim.Quantity(obs_pos_km, _refshim.km)
+        return np.asarray(m.binary_delay().to("second").value,
+                          dtype=np.float64)
+
+
+def _binary_parity(ref, par_text, mod_cls, parnames, fit_params=None,
+                   ddk=False, label="", tol=2e-9):
+    model, t = _fab(par_text)
+    tdb = _mp_tdb(t)
+    L = _lhats(model, tdb)
+    delays, _, pepoch = _base_delays(model, t, tdb, L)
+    bary = np.array([float(tdb[i] - delays[i] / SECPERDAY)
+                     for i in range(len(t))])
+    pars = {k: float(getattr(model, k).value) for k in parnames}
+    kw = {}
+    if ddk:
+        pars["PMLONG_DDK"] = float(model.PMRA.value)
+        pars["PMLAT_DDK"] = float(model.PMDEC.value)
+        pars["PX"] = float(model.PX.value)
+        pars["K96"] = bool(model.K96.value)
+        # psr_pos exactly as the component feeds the engine: PM-propagated
+        # unit vector at tdb.hi (components.py:575)
+        kw["psr_pos"] = np.array([[float(c) for c in Li] for Li in L])
+        kw["obs_pos_km"] = np.asarray(t.ssb_obs_pos_km, np.float64)
+    bdel = _engine_delay(ref, mod_cls, pars, bary, fit_params=fit_params, **kw)
+    for i in range(len(t)):
+        delays[i] += mp.mpf(float(bdel[i]))
+    _assert_parity(model, t, _resids(model, t, delays, tdb, pepoch),
+                   label=label, tol=tol)
+
+
+class TestBinaryFamilies:
+    def test_ell1h(self, ref):
+        """ELL1H orthometric (H3/STIGMA) Shapiro harmonics through the full
+        pipeline; oracle = reference ELL1Hmodel engine."""
+        _binary_parity(
+            ref,
+            BASE_ECL + ("BINARY ELL1H\nPB 4.07\nA1 3.37\nTASC 55250.1\n"
+                        "EPS1 1.2e-5\nEPS2 -3.1e-5\nH3 2.8e-7\n"
+                        "STIGMA 0.31\nNHARMS 7\n"),
+            ("ELL1H_model", "ELL1Hmodel"),
+            ("PB", "A1", "TASC", "EPS1", "EPS2", "H3", "STIGMA", "NHARMS"),
+            fit_params=["H3", "STIGMA"], label="ELL1H")
+
+    def test_ddgr(self, ref):
+        """DDGR: PK parameters derived from (MTOT, M2) under GR; oracle =
+        reference DDGRmodel engine."""
+        _binary_parity(
+            ref,
+            BASE_ECL + ("BINARY DDGR\nPB 0.323\nA1 2.34\nECC 0.617\n"
+                        "OM 226.0\nT0 55245.4\nM2 1.39\nMTOT 2.83\n"),
+            ("DDGR_model", "DDGRmodel"),
+            ("PB", "A1", "ECC", "OM", "T0", "M2", "MTOT"), label="DDGR")
+
+    def test_ddk(self, ref):
+        """DDK Kopeikin annual/secular parallax + proper-motion terms
+        (K96), equatorial astrometry; oracle = reference DDKmodel engine
+        fed the same PM-propagated psr_pos and fabricated observatory
+        positions the component uses."""
+        _binary_parity(
+            ref,
+            BASE_EQ + ("BINARY DDK\nPB 8.634\nA1 11.7\nECC 0.249\n"
+                       "OM 110.8\nT0 55245.4\nM2 0.35\nKIN 71.3\n"
+                       "KOM 42.0\nK96 1\n"),
+            ("DDK_model", "DDKmodel"),
+            ("PB", "A1", "ECC", "OM", "T0", "M2", "KIN", "KOM"),
+            ddk=True, label="DDK")

@@ -1444,3 +1444,17 @@ class TestRound5NameShims:
         # to well below timing relevance over 10 yr of 27 mas/yr PM
         assert abs(ra_h - ra_m) < 5e-9
         assert abs(dec_h - dec_m) < 5e-9
+
+    def test_template_longtail_names(self):
+        from pint_tpu.templates import (LCSkewGaussian, LCWrappedFunction,
+                                        get_errors, make_err_plot,
+                                        two_comp_mc)
+        from pint_tpu.templates.lceprimitives import LCESkewGaussian
+        from pint_tpu.templates.lcprimitives import (LCSkewGaussian as _s,
+                                                     two_comp_mc as _m)
+
+        assert issubclass(LCSkewGaussian, LCWrappedFunction)
+        assert issubclass(LCESkewGaussian, object)
+        assert callable(two_comp_mc) and callable(get_errors)
+        assert callable(make_err_plot)
+        assert _s is LCSkewGaussian and _m is two_comp_mc
