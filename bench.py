@@ -75,6 +75,27 @@ class Stages:
         return "\n".join(lines)
 
 
+def cache_key(backend: str) -> str:
+    """Persistent-cache directory key.  CPU entries are keyed by the host's
+    actual CPU feature set: AOT artifacts compiled on another
+    microarchitecture must never replay locally (SIGILL hazard seen in r03
+    — and every container reports hostname 'vm', so the old hostname key
+    isolated nothing).  TPU entries are NOT host-keyed: they are compiled
+    for (and by) the accelerator behind the tunnel, and a per-container key
+    would cold-start every session (~7-10 min remote recompile, r04)."""
+    key = f"{backend}-{_platform_mod.machine()}"
+    if backend not in ("tpu", "axon"):
+        import hashlib
+
+        try:
+            with open("/proc/cpuinfo") as f:
+                flags = next(ln for ln in f if ln.startswith("flags"))
+            key += "-" + hashlib.sha1(flags.encode()).hexdigest()[:8]
+        except (OSError, StopIteration):
+            key += f"-{_platform_mod.node()}"
+    return key
+
+
 #: cache dir whose enabling is deferred until the CPU-pinned simulation is
 #: done (TPU-backend runs only; see main())
 _PENDING_CACHE_DIR = []
@@ -265,15 +286,7 @@ def main():
             return
     print(f"# platform: {backend}", file=sys.stderr)
 
-    # persistent XLA compilation cache.  CPU entries are additionally keyed
-    # by hostname: AOT artifacts compiled on another host's CPU
-    # microarchitecture must never replay locally (SIGILL hazard seen in
-    # r03).  TPU entries are NOT host-keyed — they are compiled for (and
-    # by) the accelerator behind the tunnel, and a per-container hostname
-    # key would cold-start every session (~7-10 min recompile, seen r04).
-    machine = f"{backend}-{_platform_mod.machine()}"
-    if backend not in ("tpu", "axon"):
-        machine += f"-{_platform_mod.node()}"
+    machine = cache_key(backend)
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache", machine)
     if backend in ("tpu", "axon"):

@@ -1,0 +1,92 @@
+"""DDK / Kopeikin binary analysis: annual-parallax orbital corrections.
+
+The reference's DDK workflow (``binary_ddk.py``, e.g. J0437-4715-style
+analyses): the DDK model corrects the DD orbit for the annual motion of
+the Earth across a nearby pulsar's orbit (Kopeikin 1995) and for secular
+proper-motion terms (Kopeikin 1996), turning PX/KIN/KOM into measurable
+quantities.  This walkthrough shows the Kopeikin delay signature (DDK vs
+plain DD) and then fits orbital parameters on simulated DDK data.
+
+Run:  python examples/ddk_kopeikin_fit.py [--quick] [--cpu]
+"""
+
+import io
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE = """\
+PSR KOPEIKIN
+RAJ 4:37:15.8
+DECJ -47:15:08.6
+PMRA 121.4
+PMDEC -71.5
+PX 6.4
+POSEPOCH 55500
+F0 173.6879 1
+F1 -1.7e-15 1
+PEPOCH 55500
+DM 2.64
+UNITS TDB
+"""
+ORBIT = "PB 5.741 1\nA1 3.3667 1\nECC 1.9e-5\nOM 1.0\nT0 55492.0\nM2 0.224\n"
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    quick = "--quick" in args
+    if "--cpu" in args:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    ddk = get_model(io.StringIO(
+        BASE + "BINARY DDK\n" + ORBIT + "KIN 137.6\nKOM 207.0\nK96 1\n"))
+    dd = get_model(io.StringIO(
+        BASE + "BINARY DD\n" + ORBIT + "SINI 0.674\n"))
+
+    n = 80 if quick else 200
+    rng = np.random.default_rng(21)
+    toas = make_fake_toas_uniform(55000, 56000, n, ddk, error_us=1.0,
+                                  add_noise=True, rng=rng)
+
+    # 1. the Kopeikin signature: DDK minus DD binary delay, annual + secular
+    d_ddk = np.asarray(ddk.delay(toas))
+    d_dd = np.asarray(dd.delay(toas))
+    sig_us = 1e6 * (d_ddk - d_dd)
+    sig_us -= sig_us.mean()
+    print(f"Kopeikin correction signature: peak-to-peak "
+          f"{sig_us.max() - sig_us.min():.2f} us over 1000 d "
+          f"(annual orbital parallax + PM secular terms)")
+    assert sig_us.max() - sig_us.min() > 0.5  # resolvable at 1 us TOAs
+
+    # 2. fit the orbit on the DDK data starting slightly off
+    import copy
+
+    start = copy.deepcopy(ddk)
+    start.A1.value = start.A1.value + 3e-6
+    start.PB.value = start.PB.value + 2e-8
+    f = WLSFitter(toas, start)
+    f.fit_toas(maxiter=4)
+    a1 = float(f.model.A1.value)
+    pb = float(f.model.PB.value)
+    print(f"fitted A1 = {a1:.8f} ls (true 3.3667), "
+          f"PB = {pb:.9f} d (true 5.741)")
+    assert abs(a1 - 3.3667) < 5e-6
+    assert abs(pb - 5.741) < 5e-7
+    chi2r = f.resids.chi2 / f.resids.dof
+    print(f"post-fit reduced chi2 = {chi2r:.2f}")
+    assert chi2r < 2.0
+    print("DDK Kopeikin fit done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""Noise-model selection with AIC/BIC: does this dataset need EFAC/EQUAD?
+
+The reference's noise-model comparison workflow ("compare noise models",
+``utils.akaike_information_criterion`` / ``bayesian_information_criterion``):
+simulate TOAs whose real scatter is errors scaled by 1.4 plus a 2 us floor,
+ML-fit the noise parameters (alternating timing/noise rounds, reference
+``fitter.py:1086``), and let the information criteria pick the white-noise
+model over the bare one — then verify they do NOT over-select on clean data.
+
+Run:  python examples/noise_model_comparison.py [--quick] [--cpu]
+"""
+
+import io
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PAR = """\
+PSR NOISY
+RAJ 9:00:00
+DECJ 5:00:00
+POSEPOCH 55500
+F0 215.0 1
+F1 -9e-16 1
+PEPOCH 55500
+DM 25.0
+UNITS TDB
+"""
+NOISE = "EFAC mjd 50000 60000 1.4\nEQUAD mjd 50000 60000 2.0\n"
+
+
+def _fit_and_ll(partext, toas, fit_noise):
+    from pint_tpu.fitter import DownhillWLSFitter, WLSFitter
+    from pint_tpu.models import get_model
+
+    m = get_model(io.StringIO(partext))
+    if fit_noise:
+        # unfreeze the white-noise parameters: DownhillFitter.fit_toas then
+        # alternates (timing fit, ML noise fit) rounds automatically
+        m.EFAC1.frozen = False
+        m.EQUAD1.frozen = False
+        f = DownhillWLSFitter(toas, m)
+        f.fit_toas(maxiter=6, noise_fit_niter=2)
+    else:
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=3)
+    return f, f.resids.lnlikelihood()
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    quick = "--quick" in args
+    if "--cpu" in args:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.utils import (akaike_information_criterion,
+                                bayesian_information_criterion)
+
+    n = 80 if quick else 200
+    rng = np.random.default_rng(7)
+    truth = get_model(io.StringIO(PAR + NOISE))  # EFAC 1.4, EQUAD 2 us
+    # VARIED TOA errors: with one common error value EFAC (multiplicative)
+    # and EQUAD (additive floor) are exactly degenerate and unfittable
+    errs = rng.uniform(1.5, 6.0, n)
+    toas = make_fake_toas_uniform(55000, 56000, n, truth, error_us=errs,
+                                  add_noise=True, rng=rng)
+
+    f_bare, ll_bare = _fit_and_ll(PAR, toas, fit_noise=False)
+    f_noise, ll_noise = _fit_and_ll(
+        PAR + "EFAC mjd 50000 60000 1.0\nEQUAD mjd 50000 60000 0.5\n",
+        toas, fit_noise=True)
+    efac = float(f_noise.model.EFAC1.value)
+    equad = float(f_noise.model.EQUAD1.value)
+    print(f"ML noise fit: EFAC = {efac:.2f} (true 1.4), "
+          f"EQUAD = {equad:.2f} us (true 2.0)")
+    assert 1.0 < efac < 1.9 and 0.8 < equad < 3.5
+
+    k_bare = len(f_bare.model.free_params)
+    k_noise = len(f_noise.model.free_params)  # EFAC1/EQUAD1 included (free)
+    assert k_noise == k_bare + 2
+    aic_bare = akaike_information_criterion(ll_bare, k_bare)
+    aic_noise = akaike_information_criterion(ll_noise, k_noise)
+    bic_bare = bayesian_information_criterion(ll_bare, k_bare, n)
+    bic_noise = bayesian_information_criterion(ll_noise, k_noise, n)
+    print(f"AIC: bare {aic_bare:.1f} vs noise {aic_noise:.1f} "
+          f"(delta {aic_bare - aic_noise:+.1f})")
+    print(f"BIC: bare {bic_bare:.1f} vs noise {bic_noise:.1f} "
+          f"(delta {bic_bare - bic_noise:+.1f})")
+    assert aic_noise < aic_bare and bic_noise < bic_bare
+    print("information criteria select the EFAC/EQUAD model on noisy data")
+
+    # control: clean data must NOT prefer the extra parameters strongly
+    rng2 = np.random.default_rng(8)
+    toas_clean = make_fake_toas_uniform(55000, 56000, n,
+                                        get_model(io.StringIO(PAR)),
+                                        error_us=rng2.uniform(1.5, 6.0, n),
+                                        add_noise=True, rng=rng2)
+    _, ll_b2 = _fit_and_ll(PAR, toas_clean, fit_noise=False)
+    _, ll_n2 = _fit_and_ll(
+        PAR + "EFAC mjd 50000 60000 1.0\nEQUAD mjd 50000 60000 0.5\n",
+        toas_clean, fit_noise=True)
+    d_bic = bayesian_information_criterion(ll_b2, k_bare, n) \
+        - bayesian_information_criterion(ll_n2, k_noise, n)
+    print(f"clean-data BIC delta (bare - noise) = {d_bic:+.1f} "
+          "(<~ the 2-parameter penalty: no over-selection)")
+    assert d_bic < 6.0
+    print("noise-model comparison done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

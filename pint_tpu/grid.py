@@ -173,7 +173,8 @@ def _classified_columns_cached(model, toas, jac_fn, free_init, const_pv,
 def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                        fit_params: Optional[Sequence[str]] = None,
                        niter: int = 4,
-                       grid_spans: Optional[Sequence[float]] = None):
+                       grid_spans: Optional[Sequence[float]] = None,
+                       chunk: Optional[int] = None):
     """Return (fn, free_init, fit_params) where
     ``fn(points (P, G)) -> (chi2 (P,), vfit (P, nfit))``.
 
@@ -189,9 +190,10 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     was handed; ours dispatches on the noise structure).
     """
     if model.noise_basis_by_component(toas)[0]:
+        kw = {} if chunk is None else {"chunk": int(chunk)}
         return build_grid_gls_chi2_fn(model, toas, grid_params,
                                       fit_params=fit_params, niter=niter,
-                                      grid_spans=grid_spans)
+                                      grid_spans=grid_spans, **kw)
     grid_params = tuple(grid_params)
     if fit_params is None:
         fit_params = tuple(p for p in model.free_params if p not in grid_params)
@@ -546,13 +548,16 @@ def _extraout(extraparnames, fit_params, grid_params, vfit, pts, model,
 def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                extraparnames: Sequence[str] = (),
                executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
-               niter: int = 4, mesh=None, **fitargs) -> Tuple[np.ndarray, dict]:
+               niter: int = 4, mesh=None, chunk=None,
+               **fitargs) -> Tuple[np.ndarray, dict]:
     """Chi2 over an outer-product grid (reference ``gridutils.py:164`` API).
 
     ``executor``/``ncpu``/``chunksize`` are accepted for signature parity but
     are no-ops — points are batched on-device, which replaces the reference's
     process pool (warned once at runtime).  Pass ``mesh`` (a
-    ``jax.sharding.Mesh`` with a 'grid' axis) to shard points across devices.
+    ``jax.sharding.Mesh`` with a 'grid' axis) to shard points across devices;
+    ``chunk`` overrides the GLS path's fixed executable batch size (default
+    128; the tools/tpu_sweep.py knob).
     ``extraparnames`` returns the per-point refit values of those parameters
     in the second return slot, shaped like the grid.
     """
@@ -572,7 +577,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     gls = bool(model.noise_basis_by_component(toas)[0])
     fn, _, fit_params = build_grid_chi2_fn(
         model, toas, parnames, niter=niter,
-        grid_spans=_point_spans(model, parnames, mesh_pts))
+        grid_spans=_point_spans(model, parnames, mesh_pts), chunk=chunk)
     pts = jnp.asarray(mesh_pts)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -85,8 +85,17 @@ def launch_gui(psr):
         canvas.draw()
 
     def on_select(eclick, erelease):
-        st.select_rect(eclick.xdata, erelease.xdata,
-                       eclick.ydata, erelease.ydata)
+        # a zero-drag left click is a single-point toggle (reference 'left
+        # click select'); a real drag is a rectangle selection
+        dx = abs(erelease.xdata - eclick.xdata)
+        dy = abs(erelease.ydata - eclick.ydata)
+        x = st.xvals()
+        y, _ = st.yvals()
+        if dx < 1e-3 * (np.ptp(x) or 1.0) and dy < 1e-3 * (np.ptp(y) or 1.0):
+            st.toggle_point(eclick.xdata, eclick.ydata)
+        else:
+            st.select_rect(eclick.xdata, erelease.xdata,
+                           eclick.ydata, erelease.ydata)
         redraw()
 
     selector = RectangleSelector(ax, on_select, useblit=True, button=[1])

@@ -138,6 +138,8 @@ class PlkState:
         """Index of the closest point in axis-normalized distance, or None
         (the reference's click tolerance, ``plk.py closest point``)."""
         self._check_mask()
+        if len(self.psr.all_toas) == 0:
+            return None
         xv, (yv, _) = self.xvals(), self.yvals()
         xs = np.ptp(xv) or 1.0
         ys = np.ptp(yv) or 1.0
@@ -158,16 +160,24 @@ class PlkState:
 
     # -- deletion / stash ----------------------------------------------------
     def delete_point(self, x: float, y: float) -> Optional[int]:
-        """Right click: permanently delete the nearest point."""
+        """Right click: permanently delete the nearest point.  The existing
+        selection survives (shifted past the removed index)."""
+        if len(self.psr.all_toas) <= 1:
+            log.warning("refusing to delete the last TOA")
+            return None
         i = self.nearest_point(x, y)
         if i is not None:
+            self._check_mask()
             self.psr.delete_TOAs([i])
-            self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)
+            self.selected = np.delete(self.selected, i)
         return i
 
     def delete_selected(self) -> int:  # 'd'
         self._check_mask()
         n = int(self.selected.sum())
+        if n >= len(self.psr.all_toas):
+            log.warning("refusing to delete every TOA")
+            return 0
         if n:
             self.psr.delete_TOAs(np.nonzero(self.selected)[0])
             self.selected = np.zeros(len(self.psr.all_toas), dtype=bool)

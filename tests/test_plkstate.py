@@ -100,6 +100,27 @@ class TestSelectDeleteRefit:
         st.set_fit("F0", True)
         assert list(st.psr.model.free_params) == free_before
 
+    def test_point_delete_preserves_selection_and_last_toa_guard(self):
+        from pint_tpu.pintk.plkstate import PlkState
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        st = PlkState(Pulsar(NGC_PAR, NGC_TIM))
+        n0 = len(st.psr.all_toas)
+        x = st.xvals()
+        y, _ = st.yvals()
+        # select the 5 highest-x points, then right-click-delete the lowest
+        order = np.argsort(x)
+        st.selected[order[-5:]] = True
+        i = st.delete_point(x[order[0]], y[order[0]])
+        assert i is not None and len(st.psr.all_toas) == n0 - 1
+        # the selection survives, shifted past the removed index
+        assert int(st.selected.sum()) == 5
+        assert st.delete_selected() == 5
+        # refuse to delete every TOA
+        st.selected[:] = True
+        assert st.delete_selected() == 0
+        assert len(st.psr.all_toas) == n0 - 6
+
     def test_stash_round_trip(self):
         from pint_tpu.pintk.plkstate import PlkState
         from pint_tpu.pintk.pulsar import Pulsar

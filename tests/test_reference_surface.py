@@ -1453,8 +1453,10 @@ class TestRound5NameShims:
         from pint_tpu.templates.lcprimitives import (LCSkewGaussian as _s,
                                                      two_comp_mc as _m)
 
+        from pint_tpu.templates.lceprimitives import LCEPrimitive
+
         assert issubclass(LCSkewGaussian, LCWrappedFunction)
-        assert issubclass(LCESkewGaussian, object)
+        assert issubclass(LCESkewGaussian, LCEPrimitive)
         assert callable(two_comp_mc) and callable(get_errors)
         assert callable(make_err_plot)
         assert _s is LCSkewGaussian and _m is two_comp_mc

@@ -203,10 +203,12 @@ def main():
                           "error": f"TPU required, backend is {backend!r}"}))
         return 1
     if not args.cpu:
-        # replay-friendly persistent cache, same keying as bench.py:274
+        # replay-friendly persistent cache, same keying as bench.cache_key
+        import bench as _B
+
         cache = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), ".jax_cache",
-            f"{backend}-{os.uname().machine}")
+            _B.cache_key(backend))
         try:
             jax.config.update("jax_compilation_cache_dir", cache)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

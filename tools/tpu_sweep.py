@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""TPU throughput sweep + device trace for the headline GLS grid.
+
+VERDICT r4 item 2: profile where the v5e time goes and validate the
+chunk-size default ON THE TPU (it was chosen from a noisy CPU sweep).
+Runs the bench.py B1855 workload (4005 simulated TOAs, 90+ free params,
+correlated noise) over ``--chunks`` x ``--grids`` configurations, prints
+one JSON line per configuration, and optionally captures a JAX device
+trace of one configuration (``--trace DIR``; inspect with Perfetto).
+
+Also measures the NGC6440E WLS grid (BASELINE.json's literal metric) so
+the small-workload path gets a TPU datapoint (VERDICT item 9).
+
+NEVER run while another TPU process holds the tunnel lease (bench_retry,
+precision check): concurrent clients wedge it.
+
+Usage:
+  timeout 3000 python tools/tpu_sweep.py --quick          # 64/128 x 256
+  timeout 5400 python tools/tpu_sweep.py                  # full sweep
+  timeout 3000 python tools/tpu_sweep.py --trace /tmp/tr  # + device trace
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chunks", default="64,128,256,512")
+    ap.add_argument("--grids", default="256,1024")
+    ap.add_argument("--quick", action="store_true",
+                    help="chunks 64,128 x grid 256 only")
+    ap.add_argument("--trace", default=None,
+                    help="capture a device trace of the LAST config here")
+    ap.add_argument("--cpu", action="store_true",
+                    help="CPU validation run (off the TPU lease)")
+    ap.add_argument("--skip-ngc", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.devices()[0].platform
+    print(f"# backend: {backend}", file=sys.stderr)
+    if not args.cpu and backend not in ("tpu", "axon"):
+        print(json.dumps({"error": f"TPU required, backend {backend!r}"}))
+        return 1
+
+    import bench as B
+
+    chunks = [64, 128] if args.quick else [int(c) for c in
+                                           args.chunks.split(",")]
+    grids = [256] if args.quick else [int(g) for g in args.grids.split(",")]
+
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.grid import grid_chisq
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromtim
+
+    model = get_model(B.B1855_PAR)
+    rng = np.random.default_rng(20260729)
+    import copy as _copy
+
+    try:
+        _cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        _cpu = None
+    if _cpu is not None and jax.default_backend() != "cpu":
+        with jax.default_device(_cpu):
+            toas = make_fake_toas_fromtim(B.B1855_TIM, _copy.deepcopy(model),
+                                          add_noise=True, rng=rng)
+    else:
+        toas = make_fake_toas_fromtim(B.B1855_TIM, model, add_noise=True,
+                                      rng=rng)
+    # persistent cache AFTER the CPU-pinned simulation (bench.py rules)
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache", B.cache_key(backend))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+    f = GLSFitter(toas, model)
+    chi2_fit = f.fit_toas(maxiter=2)
+    print(f"# initial GLS fit chi2 = {chi2_fit:.1f}", file=sys.stderr)
+
+    dm2 = 3 * (float(model.M2.uncertainty or 0.011))
+    dsini = 3 * (float(model.SINI.uncertainty or 1.8e-4))
+    results = []
+    configs = [(c, g) for g in grids for c in chunks]
+    for idx, (chunk, npts_total) in enumerate(configs):
+        npts = int(round(npts_total ** 0.5))
+        g_m2 = np.linspace(model.M2.value - dm2, model.M2.value + dm2, npts)
+        g_sini = np.linspace(model.SINI.value - dsini,
+                             min(0.999999, model.SINI.value + dsini), npts)
+        warm = (g_m2[[0, -1]], g_sini[[0, -1]])
+        t0 = time.time()
+        grid_chisq(f, ("M2", "SINI"), warm, niter=2, chunk=chunk)
+        t_compile = time.time() - t0
+        last = idx == len(configs) - 1
+        ctx = None
+        if args.trace and last:
+            from pint_tpu.profiling import device_trace
+
+            ctx = device_trace(args.trace)
+            ctx.__enter__()
+        t0 = time.time()
+        chi2, _ = grid_chisq(f, ("M2", "SINI"), (g_m2, g_sini), niter=2,
+                             chunk=chunk)
+        chi2 = np.asarray(chi2)
+        dt = time.time() - t0
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+            print(f"# device trace written to {args.trace}", file=sys.stderr)
+        row = {"metric": "gls_grid_sweep", "platform": backend,
+               "chunk": chunk, "grid_points": int(chi2.size),
+               "fits_per_sec": round(chi2.size / dt, 2),
+               "elapsed_s": round(dt, 2), "compile_s": round(t_compile, 1),
+               "sanity_ok": bool(np.isfinite(chi2).all()
+                                 and abs(chi2.min() - chi2_fit)
+                                 < 0.05 * chi2_fit)}
+        results.append(row)
+        print(json.dumps(row))
+        sys.stdout.flush()
+
+    if not args.skip_ngc:
+        try:
+            n = B.bench_ngc6440e_wls()
+            print(json.dumps({"metric": "ngc6440e_wls_grid",
+                              "platform": backend,
+                              "fits_per_sec": round(n["fits_per_sec"], 1),
+                              "ntoas": n["ntoas"]}))
+        except Exception as e:
+            print(f"# NGC6440E secondary failed: {e}", file=sys.stderr)
+    best = max(results, key=lambda r: r["fits_per_sec"])
+    print(f"# best: chunk={best['chunk']} grid={best['grid_points']} "
+          f"-> {best['fits_per_sec']} fits/s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
