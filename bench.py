@@ -89,7 +89,9 @@ def cache_key(backend: str) -> str:
 
         try:
             with open("/proc/cpuinfo") as f:
-                flags = next(ln for ln in f if ln.startswith("flags"))
+                # x86 spells the ISA line 'flags'; aarch64 'Features'
+                flags = next(ln for ln in f
+                             if ln.startswith(("flags", "Features")))
             key += "-" + hashlib.sha1(flags.encode()).hexdigest()[:8]
         except (OSError, StopIteration):
             key += f"-{_platform_mod.node()}"

@@ -30,7 +30,9 @@ from pint_tpu.residuals import Residuals
 from pint_tpu.utils import normalize_designmatrix
 
 __all__ = ["Fitter", "WLSFitter", "DownhillFitter", "DownhillWLSFitter",
-           "LMFitter", "PowellFitter"]
+           "LMFitter", "PowellFitter", "ModelState", "WLSState", "GLSState",
+           "WidebandState", "fit_wls_svd", "apply_Sdiag_threshold",
+           "get_gls_mtcm_mtcy", "get_gls_mtcm_mtcy_fullcov"]
 
 
 class Fitter:
@@ -375,33 +377,13 @@ def _wls_step(M: np.ndarray, params: List[str], r: np.ndarray, sigma: np.ndarray
               threshold: Optional[float] = None):
     """One whitened, normalized SVD least-squares solve.
 
-    Returns (dpars, cov, singular_values).  Mirrors reference
-    ``fitter.py:2645 fit_wls_svd`` incl. the singular-value threshold
-    (``fitter.py:2621 apply_Sdiag_threshold``).
-    """
-    Mw = M / sigma[:, None]
-    rw = r / sigma
-    Mn, norms = normalize_designmatrix(Mw)
-    U, S, Vt = np.linalg.svd(np.asarray(Mn), full_matrices=False)
+    Returns (dpars, cov, singular_values).  Thin wrapper over the public
+    :func:`fit_wls_svd` (single source for the SVD/degeneracy numerics)
+    with the default near-machine-precision threshold."""
     if threshold is None:
-        threshold = np.finfo(np.float64).eps * max(M.shape)
-    Smax = S.max() if len(S) else 1.0
-    bad = S <= threshold * Smax
-    if np.any(bad):
-        import warnings
-
-        badp = [params[i] for i in np.argsort(np.abs(Vt[bad]).max(0))[::-1][:3]]
-        warnings.warn(
-            f"Degenerate parameter directions found (involving e.g. {badp}); "
-            "their singular values were zeroed",
-            DegeneracyWarning,
-        )
-    Sinv = np.where(bad, 0.0, 1.0 / np.where(S == 0, 1.0, S))
-    dpars = (Vt.T * Sinv) @ (U.T @ rw)
-    cov = (Vt.T * Sinv**2) @ Vt
-    norms = np.asarray(norms)
-    dpars = dpars / norms
-    cov = cov / np.outer(norms, norms)
+        threshold = np.finfo(np.float64).eps * max(np.asarray(M).shape)
+    dpars, cov, _, (_, S, _) = fit_wls_svd(r, sigma, M, list(params),
+                                           threshold)
     return dpars, cov, S
 
 
@@ -675,3 +657,188 @@ class PowellFitter(Fitter):
         chi2 = self.resids.chi2
         self.update_model(chi2)
         return chi2
+
+
+# ---------------------------------------------------------------------------
+# public linear-algebra helpers (reference fitter.py:2621-2726 free functions)
+# ---------------------------------------------------------------------------
+
+def apply_Sdiag_threshold(Sdiag, VT, threshold, params):
+    """Replace singular values <= ``threshold * Sdiag.max()`` with inf and
+    warn, naming the degenerate parameter combination (reference
+    ``fitter.py:2621``).  Dividing by inf then zeroes those directions —
+    i.e. the pseudo-inverse restricted to the non-singular subspace."""
+    import warnings
+
+    Sdiag = np.asarray(Sdiag, dtype=np.float64).copy()
+    smax = Sdiag.max() if Sdiag.size else 1.0
+    for c in np.nonzero(Sdiag <= threshold * smax)[0]:
+        v = np.asarray(VT)[c]
+        v = v / max(np.abs(v).max(), 1e-300)
+        combo = " + ".join(f"{co:.3g}*{p}" for co, p in
+                           sorted(zip(v, params), key=lambda t: -abs(t[0]))
+                           if abs(co) > threshold)
+        warnings.warn("Parameter degeneracy; the following linear "
+                      f"combination yields almost no change: {combo}",
+                      DegeneracyWarning)
+        Sdiag[c] = np.inf
+    return Sdiag
+
+
+def fit_wls_svd(r, sigma, M, params, threshold):
+    """One whitened, column-normalized SVD WLS solve (reference
+    ``fitter.py:2645``): returns ``(dpars, Sigma, Adiag, (U, S, VT))`` with
+    ``Sigma`` the parameter covariance and ``Adiag`` the column norms used
+    for conditioning.  Degenerate directions are dropped via
+    :func:`apply_Sdiag_threshold`."""
+    r = np.asarray(r, dtype=np.float64)
+    sigma = np.asarray(sigma, dtype=np.float64)
+    Mw = np.asarray(M, dtype=np.float64) / sigma[:, None]
+    rw = r / sigma
+    Mn, Adiag = normalize_designmatrix(Mw)
+    Mn, Adiag = np.asarray(Mn), np.asarray(Adiag)
+    U, S, VT = np.linalg.svd(Mn, full_matrices=False)
+    S = apply_Sdiag_threshold(S, VT, threshold, list(params))
+    dpars = (VT.T @ ((U.T @ rw) / S)) / Adiag
+    Sigma = ((VT.T / S**2) @ VT) / np.outer(Adiag, Adiag)
+    return dpars, Sigma, Adiag, (U, S, VT)
+
+
+def get_gls_mtcm_mtcy(phiinv, Nvec, M, residuals):
+    """``(M^T N^-1 M + diag(phiinv), M^T N^-1 y)`` for the basis-augmented
+    GLS normal equations (reference ``fitter.py:2712``): ``M`` holds the
+    timing design matrix plus correlated-noise basis columns, ``Nvec`` the
+    white variances, ``phiinv`` the basis weights (zeros for the timing
+    columns)."""
+    Ninv = 1.0 / np.asarray(Nvec, dtype=np.float64)
+    M = np.asarray(M, dtype=np.float64)
+    mtcm = M.T @ (Ninv[:, None] * M) + np.diag(np.asarray(phiinv))
+    mtcy = M.T @ (Ninv * np.asarray(residuals, dtype=np.float64))
+    return mtcm, mtcy
+
+
+def get_gls_mtcm_mtcy_fullcov(cov, M, residuals):
+    """``(M^T C^-1 M, M^T C^-1 y)`` with the FULL data covariance ``C``
+    (reference ``fitter.py:2696``; the ``full_cov=True`` GLS path)."""
+    import scipy.linalg as sl
+
+    M = np.asarray(M, dtype=np.float64)
+    cf = sl.cho_factor(np.asarray(cov, dtype=np.float64))
+    cm = sl.cho_solve(cf, M)
+    return M.T @ cm, cm.T @ np.asarray(residuals, dtype=np.float64)
+
+
+# ---------------------------------------------------------------------------
+# lazily-evaluated model states (reference fitter.py:843 ModelState family)
+# ---------------------------------------------------------------------------
+
+class ModelState:
+    """A (model, fit products) snapshot during a downhill fit: residuals,
+    chi2, the linearized step and its covariance, all computed lazily and
+    cached (reference ``fitter.py:843``).  Immutable by convention; taking
+    a step yields a NEW state.  The heavy lifting delegates to the matching
+    downhill fitter's ``_solve_step`` so the numerics are exactly the ones
+    the fit itself uses."""
+
+    def __init__(self, fitter, model=None):
+        self.fitter = fitter
+        self.model = model if model is not None else fitter.model
+        self._cache = {}
+
+    def _fitter_cls(self):
+        return DownhillWLSFitter
+
+    def _work(self):
+        if "work" not in self._cache:
+            self._cache["work"] = self._fitter_cls()(
+                self.fitter.toas, self.model,
+                track_mode=getattr(self.fitter, "track_mode", None))
+        return self._cache["work"]
+
+    @property
+    def params(self):
+        return list(self.model.free_params)
+
+    @property
+    def resids(self):
+        return self._work().resids
+
+    @property
+    def chi2(self):
+        if "chi2" not in self._cache:
+            self._cache["chi2"] = float(self.resids.chi2)
+        return self._cache["chi2"]
+
+    def _solve(self):
+        if "step" not in self._cache:
+            dpars, params, cov = self._work()._solve_step()
+            self._cache["step"] = (np.asarray(dpars), list(params),
+                                   np.asarray(cov))
+        return self._cache["step"]
+
+    @property
+    def step(self):
+        return self._solve()[0]
+
+    @property
+    def parameter_covariance_matrix(self):
+        return self._solve()[2]
+
+    def predicted_chi2(self, step=None, lambda_=1.0):
+        """Quadratic-model chi2 prediction after ``lambda_ * step`` (the
+        quantity the downhill line search compares against).
+
+        For a Gauss-Newton step ``s = Sigma b`` the linearized decrease is
+        ``(2 lambda - lambda^2) s^T Sigma^-1 s`` — stated purely in the
+        solver's own metric (covariance), so it is consistent with
+        ``.chi2`` for EVERY state flavor, including the correlated-noise
+        GLS and wideband forms (a whitened-residual formula here would be
+        a different metric for those)."""
+        dpars, _, cov = self._solve()
+        s = np.asarray(dpars if step is None else step, dtype=np.float64)
+        sn, *_ = np.linalg.lstsq(cov, s, rcond=None)
+        dec = float(s @ sn)
+        return self.chi2 - (2 * lambda_ - lambda_**2) * dec
+
+    def take_step_model(self, step, lambda_=1.0):
+        """A new model displaced by ``lambda_ * step`` along the solver's
+        parameter list.  The leading 'Offset' column (the weighted-mean
+        phase absorbed by the designmatrix) has no model parameter and is
+        skipped."""
+        import copy as _copy
+
+        _, params, _ = self._solve()
+        new = _copy.deepcopy(self.model)
+        for p, s in zip(params, np.asarray(step) * lambda_):
+            if p not in new.params:
+                continue
+            par = getattr(new, p)
+            par.value = float(par.value or 0.0) + float(s)
+        return new
+
+    def take_step(self, step=None, lambda_=1.0):
+        if step is None:
+            step = self.step
+        return type(self)(self.fitter, self.take_step_model(step, lambda_))
+
+
+class WLSState(ModelState):
+    """Uncorrelated-noise state (reference ``fitter.py:1225``)."""
+
+
+class GLSState(ModelState):
+    """Correlated-noise (Woodbury GLS) state (reference ``fitter.py:1332``)."""
+
+    def _fitter_cls(self):
+        from pint_tpu.gls_fitter import DownhillGLSFitter
+
+        return DownhillGLSFitter
+
+
+class WidebandState(ModelState):
+    """Wideband (TOA + DM) state (reference ``fitter.py:1494``)."""
+
+    def _fitter_cls(self):
+        from pint_tpu.wideband import WidebandDownhillFitter
+
+        return WidebandDownhillFitter

@@ -30,8 +30,9 @@ import numpy as np
 from pint_tpu.models.binary import engines as E
 
 __all__ = [
-    "PSR_BINARY", "BTmodel", "DDmodel", "DDSmodel", "DDHmodel", "DDGRmodel",
-    "DDKmodel", "ELL1BaseModel", "ELL1model", "ELL1Hmodel", "ELL1kmodel",
+    "PSR_BINARY", "BTmodel", "BTpiecewise", "DDmodel", "DDSmodel",
+    "DDHmodel", "DDGRmodel", "DDKmodel", "ELL1BaseModel", "ELL1model",
+    "ELL1Hmodel", "ELL1kmodel",
     "Orbit", "OrbitPB", "OrbitFBX", "OrbitWaves", "OrbitWavesFBX",
 ]
 
@@ -117,6 +118,41 @@ class BTmodel(PSR_BINARY):
     """Blandford-Teukolsky (reference ``BT_model.py:141``)."""
 
     _delay_fn = staticmethod(E.bt_delay)
+
+
+class BTpiecewise(PSR_BINARY):
+    """Stand-alone BT with piecewise T0X/A1X overrides in [XR1, XR2) MJD
+    windows (reference ``BT_piecewise.py BTpiecewise``): pass
+    ``T0X_0001/A1X_0001/XR1_0001/XR2_0001``-style values through
+    ``update_input`` alongside the global BT parameters; per-TOA A1 and
+    tt0 shifts are applied exactly like the par-facing component
+    (``components.py BinaryBT_piecewise``)."""
+
+    _delay_fn = staticmethod(E.bt_delay)
+
+    def binary_delay(self) -> np.ndarray:
+        tt0, pv = self._tt0_and_pv()
+        idxs = sorted(k[4:] for k in pv if k.startswith("T0X_"))
+        if not idxs:
+            out = E.bt_delay(pv, tt0)
+            return np.asarray(jax.device_get(out), dtype=np.float64)
+        mjds = jnp.asarray(self.barycentric_toa)
+        t0 = self.pars[self.t0_key]
+        a1 = pv.get("A1", 0.0) * jnp.ones_like(tt0)
+        for ix in idxs:
+            r1 = pv.get(f"XR1_{ix}")
+            r2 = pv.get(f"XR2_{ix}")
+            if r1 is None or r2 is None:
+                raise ValueError(f"piece {ix}: XR1_{ix}/XR2_{ix} required")
+            m = ((mjds >= r1) & (mjds < r2)).astype(tt0.dtype)
+            tt0 = tt0 + m * (t0 - pv.get(f"T0X_{ix}", t0)) * DAY_S
+            a1 = a1 + m * (pv.get(f"A1X_{ix}", pv.get("A1", 0.0))
+                           - pv.get("A1", 0.0))
+        pv2 = {k: v for k, v in pv.items()
+               if not k.startswith(("T0X_", "A1X_", "XR1_", "XR2_"))}
+        pv2["A1"] = a1
+        out = E.bt_delay(pv2, tt0)
+        return np.asarray(jax.device_get(out), dtype=np.float64)
 
 
 class DDmodel(PSR_BINARY):

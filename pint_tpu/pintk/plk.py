@@ -86,12 +86,10 @@ def launch_gui(psr):
 
     def on_select(eclick, erelease):
         # a zero-drag left click is a single-point toggle (reference 'left
-        # click select'); a real drag is a rectangle selection
-        dx = abs(erelease.xdata - eclick.xdata)
-        dy = abs(erelease.ydata - eclick.ydata)
-        x = st.xvals()
-        y, _ = st.yvals()
-        if dx < 1e-3 * (np.ptp(x) or 1.0) and dy < 1e-3 * (np.ptp(y) or 1.0):
+        # click select'); a real drag is a rectangle selection.  PIXEL
+        # distance discriminates: a data-space threshold would misread a
+        # few-day drag on a decade-long axis as a click.
+        if abs(erelease.x - eclick.x) < 3 and abs(erelease.y - eclick.y) < 3:
             st.toggle_point(eclick.xdata, eclick.ydata)
         else:
             st.select_rect(eclick.xdata, erelease.xdata,

@@ -28,6 +28,8 @@ __all__ = [
     "quantity2longdouble_withunit", "safe_kind_conversion",
     "time_to_longdouble", "time_from_longdouble",
     "time_to_mjd_string", "time_from_mjd_string",
+    "TimeFormatMJD", "PulsarMJD", "MJDLong", "PulsarMJDLong",
+    "MJDString", "PulsarMJDString",
 ]
 
 DJM0 = 2400000.5  # JD of MJD epoch (erfa.DJM0)
@@ -279,3 +281,101 @@ def time_from_mjd_string(s, scale="utc", format="pulsar_mjd"):
     """Decimal MJD string -> exact (jd1, jd2) pair."""
     i, f = str_to_mjds(s)
     return np.float64(i) + DJM0, np.float64(f)
+
+
+# ---------------------------------------------------------------------------
+# time-format classes (reference pulsar_mjd.py TimeFormat subclasses).
+# There is no astropy Time here — the formats are plain conversion
+# namespaces between the user-facing value (float / longdouble / string
+# MJD) and the internal (jd1, jd2) pair, which is exactly the computation
+# the reference's astropy formats perform.  ``pulsar_mjd`` variants apply
+# the leap-second-smearing UTC convention (mjds_to_jds_pulsar).
+# ---------------------------------------------------------------------------
+
+class TimeFormatMJD:
+    """Base: float-MJD <-> (jd1, jd2).  Reference ``pulsar_mjd.py:150``
+    family; scale handling is the caller's concern (like ``Time(...,
+    scale=)`` in the reference)."""
+
+    name = "mjd"
+    _to_jds = staticmethod(mjds_to_jds)
+    _from_jds = staticmethod(jds_to_mjds)
+
+    @classmethod
+    def set_jds(cls, val1, val2=0.0):
+        """User value pair -> (jd1, jd2)."""
+        return cls._to_jds(*day_frac(val1, val2))
+
+    @classmethod
+    def to_value(cls, jd1, jd2):
+        """(jd1, jd2) -> float MJD (lossy by design, like the reference's
+        plain ``.mjd``)."""
+        m1, m2 = cls._from_jds(jd1, jd2)
+        out = np.asarray(m1) + np.asarray(m2)
+        return out.reshape(())[()] if out.size == 1 else out
+
+
+class PulsarMJD(TimeFormatMJD):
+    """Pulsar-convention UTC MJD: each day has exactly 86400 equal-length
+    seconds, leap seconds smeared (reference ``pulsar_mjd.py:68``)."""
+
+    name = "pulsar_mjd"
+    _to_jds = staticmethod(mjds_to_jds_pulsar)
+    _from_jds = staticmethod(jds_to_mjds_pulsar)
+
+
+class MJDLong(TimeFormatMJD):
+    """MJD carried as numpy longdouble (reference ``pulsar_mjd.py:150``):
+    full 80-bit precision in and out."""
+
+    name = "mjd_long"
+
+    @classmethod
+    def set_jds(cls, val1, val2=0.0):
+        v = np.asarray(val1, dtype=np.longdouble) \
+            + np.asarray(val2, dtype=np.longdouble)
+        hi = np.asarray(v, dtype=np.float64)
+        lo = np.asarray(v - hi.astype(np.longdouble), dtype=np.float64)
+        return cls._to_jds(*day_frac(hi, lo))
+
+    @classmethod
+    def to_value(cls, jd1, jd2):
+        m1, m2 = cls._from_jds(jd1, jd2)
+        out = np.asarray(m1, dtype=np.longdouble) \
+            + np.asarray(m2, dtype=np.longdouble)
+        return out.reshape(())[()] if out.size == 1 else out
+
+
+class PulsarMJDLong(MJDLong):
+    """Longdouble MJD under the pulsar-UTC convention (reference
+    ``pulsar_mjd.py:231``)."""
+
+    name = "pulsar_mjd_long"
+    _to_jds = staticmethod(mjds_to_jds_pulsar)
+    _from_jds = staticmethod(jds_to_mjds_pulsar)
+
+
+class MJDString(TimeFormatMJD):
+    """MJD as exact decimal strings (reference ``pulsar_mjd.py:288``)."""
+
+    name = "mjd_string"
+
+    @classmethod
+    def set_jds(cls, val1, val2=None):
+        return cls._to_jds(*str_to_mjds(val1))
+
+    @classmethod
+    def to_value(cls, jd1, jd2):
+        m1, m2 = (np.asarray(v) for v in cls._from_jds(jd1, jd2))
+        if m1.size == 1:  # scalar in -> plain str out
+            return mjds_to_str(m1.reshape(()), m2.reshape(()))
+        return mjds_to_str(m1, m2)
+
+
+class PulsarMJDString(MJDString):
+    """String MJD under the pulsar-UTC convention (reference
+    ``pulsar_mjd.py:330``)."""
+
+    name = "pulsar_mjd_string"
+    _to_jds = staticmethod(mjds_to_jds_pulsar)
+    _from_jds = staticmethod(jds_to_mjds_pulsar)

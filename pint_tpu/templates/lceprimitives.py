@@ -18,6 +18,7 @@ from pint_tpu.templates.lcprimitives import (LCGaussian, LCGaussian2, LCSkewGaus
                                              LCPrimitive, LCVonMises)
 
 __all__ = ["LCEPrimitive", "LCEGaussian", "LCEGaussian2", "LCESkewGaussian",
+           "LCEWrappedFunction", "edep_gradient",
            "LCELorentzian",
            "LCELorentzian2", "LCEVonMises"]
 
@@ -135,15 +136,54 @@ class LCELorentzian2(LCEPrimitive):
     name = "ELorentzian2"
 
 
-class LCESkewGaussian(LCEPrimitive):
+def edep_gradient(prim, phases, log10_ens=None, eps: float = 1e-6):
+    """Numeric d(pdf)/d(params) for an energy-dependent primitive over its
+    FULL parameter vector [base..., slopes...] (reference
+    ``lceprimitives.py:8 edep_gradient``; this is a linear model, so the
+    slope rows are the base rows weighted by dlog10(E) — computed here by
+    differencing the same evaluation path the likelihood uses, which also
+    respects the positivity clamp's saturated-gradient zeroing)."""
+    phases = np.asarray(phases, dtype=np.float64)
+    out = []
+    for i in range(len(prim.p)):
+        hi, lo = prim.p.copy(), prim.p.copy()
+        hi[i] += eps / 2
+        lo[i] -= eps / 2
+        save = prim.p
+        try:
+            prim.p = hi
+            vp = np.asarray(prim(phases, log10_ens))
+            prim.p = lo
+            vm = np.asarray(prim(phases, log10_ens))
+        finally:
+            prim.p = save
+        out.append((vp - vm) / eps)
+    return np.asarray(out)
+
+
+class LCEWrappedFunction(LCEPrimitive):
+    """Energy-dependent base for wrapped-function shapes (reference
+    ``lceprimitives.py:150 LCEWrappedFunction``): subclasses set
+    ``base_cls`` to an :class:`~pint_tpu.templates.lcprimitives
+    .LCWrappedFunction` shape, whose ``base_func``/``base_int`` hooks are
+    pulled onto this class so the wrapped ``_pdf`` resolves here too."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if hasattr(cls.base_cls, "base_func"):
+            cls.base_func = cls.base_cls.base_func
+            cls.base_int = cls.base_cls.base_int
+
+    def gradient(self, phases, log10_ens=None, free: bool = False):
+        g = edep_gradient(self, phases, log10_ens)
+        return g[self.free] if free else g
+
+
+class LCESkewGaussian(LCEWrappedFunction):
     """Energy-dependent wrapped skew-normal (reference
     ``lceprimitives.py LCESkewGaussian``): [width, shape, location] base
-    parameters plus one log-energy slope each.  The wrapped-function hooks
-    are borrowed from the base shape so ``base_cls._pdf`` (which calls
-    ``self.base_func``/``self.base_int``) resolves on this class too."""
+    parameters plus one log-energy slope each."""
 
     base_cls = LCSkewGaussian
     name = "ESkewGaussian"
-    base_func = LCSkewGaussian.base_func
-    base_int = LCSkewGaussian.base_int
     clamp_cols = (0,)  # width only: Shape is legitimately signed

@@ -20,7 +20,7 @@ import numpy as np
 
 __all__ = ["LCPrimitive", "LCWrappedFunction", "LCGaussian", "LCGaussian2",
            "LCLorentzian", "LCLorentzian2", "LCVonMises", "LCTopHat",
-           "LCKing", "LCHarmonic", "LCSkewGaussian",
+           "LCKing", "LCHarmonic", "LCSkewGaussian", "FastBessel",
            "LCEmpiricalFourier", "LCKernelDensity", "convert_primitive",
            "approx_gradient", "check_gradient", "two_comp_mc"]
 
@@ -572,6 +572,62 @@ class LCSkewGaussian(LCWrappedFunction):
         v = rng.standard_normal(n)
         z = delta * u + math.sqrt(1.0 - delta * delta) * v
         return (x0 + width * z) % 1.0
+
+
+class FastBessel:
+    """Fast modified Bessel function I_nu via log-log interpolation with
+    the exact asymptotic tail (reference ``lcprimitives.py:1675``): the
+    von-Mises normalization 1/(2 pi I0(kappa)) is evaluated millions of
+    times in photon likelihoods, and scipy's i0 overflows past x ~ 700
+    where log I_nu(x) ~ x - log(sqrt(2 pi x)) + log(1 + (4 nu^2 - 1)/8x)
+    is already exact to float precision."""
+
+    def __init__(self, order: int = 0):
+        if order not in (0, 1):
+            raise NotImplementedError("orders 0 and 1 only")
+        from scipy.special import i0, i1
+
+        self.order = order
+        x = np.logspace(-1, 3.5, 20001)
+        safe = x < 700
+        logy = np.empty_like(x)
+        logy[safe] = np.log((i0 if order == 0 else i1)(x[safe]))
+        xt = x[~safe]
+        logy[~safe] = xt - 0.5 * np.log(2 * np.pi * xt) \
+            + np.log1p((4 * order**2 - 1) / (8 * xt))
+        self._logx = np.log(x)
+        self._logy = logy
+
+    def __call__(self, x):
+        return np.exp(self.log(x))
+
+    def log(self, x):
+        """log I_nu(x): stays finite far beyond the float overflow of
+        I_nu itself (x > ~709), which is the form likelihoods want.
+        Outside the table the exact limits take over — the asymptotic
+        expansion above, the small-x series below (np.interp would
+        otherwise CLAMP to the edge values, wildly wrong for large x)."""
+        x = np.asarray(x, dtype=np.float64)
+        out = np.interp(np.log(np.maximum(x, 1e-300)), self._logx,
+                        self._logy)
+        lo, hi = np.exp(self._logx[0]), np.exp(self._logx[-1])
+        nu = self.order
+        big = x > hi
+        if np.any(big):
+            xb = x[big] if x.ndim else x
+            asym = xb - 0.5 * np.log(2 * np.pi * xb) \
+                + np.log1p((4 * nu**2 - 1) / (8 * xb))
+            out = np.where(np.asarray(big), asym, out) if x.ndim \
+                else float(asym)
+        small = x < lo
+        if np.any(small):
+            xs = x[small] if x.ndim else x
+            # I0 ~ 1 + x^2/4, I1 ~ x/2 (1 + x^2/8)
+            ser = np.log1p(xs * xs / 4) if nu == 0 \
+                else np.log(xs / 2) + np.log1p(xs * xs / 8)
+            out = np.where(np.asarray(small), ser, out) if x.ndim \
+                else float(ser)
+        return out
 
 
 def two_comp_mc(n, w1, w2, loc, func, rng=None):

@@ -17,7 +17,8 @@ import numpy as np
 from pint_tpu.templates.lcnorm import NormAngles
 from pint_tpu.templates.lcprimitives import LCGaussian, LCPrimitive
 
-__all__ = ["LCTemplate", "prim_io", "make_twoside_gaussian"]
+__all__ = ["LCTemplate", "prim_io", "make_twoside_gaussian",
+           "gradient_derivative", "check_gradient_derivative"]
 
 
 class LCTemplate:
@@ -670,3 +671,30 @@ class GaussianPrior:
         p = np.where(self.mod, np.mod(p, 1), p)
         out[self.mask] = 2.0 * (p - self.x0) / self.s0**2
         return out
+
+
+def gradient_derivative(templ, phases, eps: float = 1e-5) -> np.ndarray:
+    """d/dphi of the parameter gradient, (nparam, nphase) — the mixed
+    second derivative used by TOA-uncertainty propagation (reference
+    ``lctemplate.py gradient_derivative``); central difference in phase of
+    the same gradient the fit uses."""
+    ph = np.asarray(phases, dtype=np.float64)
+    gp = np.asarray(templ.gradient((ph + eps) % 1.0, free=False))
+    gm = np.asarray(templ.gradient((ph - eps) % 1.0, free=False))
+    return (gp - gm) / (2 * eps)
+
+
+def check_gradient_derivative(templ, n: int = 10001, quiet: bool = True):
+    """Validate :func:`gradient_derivative` against coarse differencing of
+    the gradient over a phase grid (reference ``lctemplate.py:1065``).
+    Returns ``(pcs, gd, ngd)`` — bin centers, analytic-path values, and the
+    numeric reference."""
+    dom = np.linspace(0, 1, n)
+    pcs = 0.5 * (dom[:-1] + dom[1:])
+    g = np.asarray(templ.gradient(dom, free=False))
+    ngd = (g[:, 1:] - g[:, :-1]) / (dom[1] - dom[0])
+    gd = gradient_derivative(templ, pcs)
+    if not quiet:
+        for i in range(gd.shape[0]):
+            print(f"param {i}: max |delta| = {np.max(np.abs(gd[i] - ngd[i])):.3g}")
+    return pcs, gd, ngd

@@ -1460,3 +1460,210 @@ class TestRound5NameShims:
         assert callable(two_comp_mc) and callable(get_errors)
         assert callable(make_err_plot)
         assert _s is LCSkewGaussian and _m is two_comp_mc
+
+
+class TestRound5FitterHelpers:
+    """Public LA helpers + ModelState family (reference fitter.py:843,2621+)."""
+
+    def test_fit_wls_svd_matches_lstsq(self):
+        from pint_tpu.fitter import fit_wls_svd
+
+        rng = np.random.default_rng(0)
+        M = rng.standard_normal((40, 3))
+        sigma = rng.uniform(0.5, 2.0, 40)
+        x_true = np.array([1.0, -2.0, 0.5])
+        r = M @ x_true
+        dpars, Sigma, Adiag, (U, S, VT) = fit_wls_svd(
+            r, sigma, M, ["a", "b", "c"], 1e-12)
+        np.testing.assert_allclose(dpars, x_true, rtol=1e-10)
+        assert Sigma.shape == (3, 3) and np.all(np.diag(Sigma) > 0)
+        assert Adiag.shape == (3,) and U.shape[1] == S.shape[0] == 3
+
+    def test_fit_wls_svd_degeneracy_warns(self):
+        import warnings
+
+        from pint_tpu.exceptions import DegeneracyWarning
+        from pint_tpu.fitter import fit_wls_svd
+
+        rng = np.random.default_rng(1)
+        M = rng.standard_normal((30, 3))
+        M[:, 2] = 2.0 * M[:, 0]  # exact degeneracy
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            dpars, Sigma, _, _ = fit_wls_svd(
+                M @ np.ones(3), np.ones(30), M, ["a", "b", "c"], 1e-10)
+        assert any(issubclass(x.category, DegeneracyWarning) for x in w)
+        assert np.all(np.isfinite(dpars)) and np.all(np.isfinite(Sigma))
+
+    def test_get_gls_mtcm_mtcy(self):
+        from pint_tpu.fitter import get_gls_mtcm_mtcy, get_gls_mtcm_mtcy_fullcov
+
+        rng = np.random.default_rng(2)
+        M = rng.standard_normal((20, 4))
+        Nvec = rng.uniform(0.5, 2.0, 20)
+        phiinv = np.array([0.0, 0.0, 3.0, 5.0])
+        y = rng.standard_normal(20)
+        mtcm, mtcy = get_gls_mtcm_mtcy(phiinv, Nvec, M, y)
+        np.testing.assert_allclose(
+            mtcm, M.T @ np.diag(1 / Nvec) @ M + np.diag(phiinv), rtol=1e-12)
+        np.testing.assert_allclose(mtcy, M.T @ (y / Nvec), rtol=1e-12)
+        # full covariance route agrees when C = diag(Nvec), phiinv = 0
+        mtcm2, mtcy2 = get_gls_mtcm_mtcy_fullcov(np.diag(Nvec), M, y)
+        np.testing.assert_allclose(mtcm2, mtcm - np.diag(phiinv), rtol=1e-10)
+        np.testing.assert_allclose(mtcy2, mtcy, rtol=1e-10)
+
+    def test_model_state_family(self):
+        from pint_tpu.fitter import WLSState
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models import get_model_and_toas
+
+        par = "/root/reference/src/pint/data/examples/NGC6440E.par"
+        tim = "/root/reference/src/pint/data/examples/NGC6440E.tim"
+        if not os.path.exists(par):
+            pytest.skip("NGC6440E unavailable")
+        model, toas = get_model_and_toas(par, tim)
+        f = WLSFitter(toas, model)
+        s0 = WLSState(f)
+        assert s0.params == list(model.free_params)
+        assert np.isfinite(s0.chi2)
+        step = s0.step
+        # the solver's parameter list carries the leading Offset column
+        assert step.shape in ((len(s0.params),), (len(s0.params) + 1,))
+        s1 = s0.take_step()
+        assert s1.chi2 < s0.chi2  # one linearized step improves the fit
+        assert s1 is not s0 and s1.model is not s0.model
+        # linear prediction at the full step is below the current chi2
+        assert s0.predicted_chi2() < s0.chi2
+        cov = s0.parameter_covariance_matrix
+        n = step.shape[0]  # solver dimension (params + Offset column)
+        assert cov.shape == (n, n)
+
+    def test_gls_state(self):
+        import io
+
+        from pint_tpu.fitter import GLSState
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(io.StringIO(
+            "PSR S\nRAJ 6:00:00\nDECJ 10:00:00\nPOSEPOCH 55000\nF0 99.0 1\n"
+            "F1 -1e-15 1\nPEPOCH 55000\nDM 12\nECORR mjd 50000 60000 1.2\n"
+            "UNITS TDB\n"))
+        t = make_fake_toas_uniform(54800, 55200, 30, m, error_us=5.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(5))
+        f = GLSFitter(t, m)
+        s = GLSState(f)
+        assert np.isfinite(s.chi2)
+        assert s.take_step().chi2 <= s.chi2 + 1e-6
+
+
+class TestRound5TemplateHelpers:
+    def test_fast_bessel(self):
+        from scipy.special import i0, i1
+
+        from pint_tpu.templates.lcprimitives import FastBessel
+
+        fb0, fb1 = FastBessel(0), FastBessel(1)
+        x = np.array([0.2, 1.0, 10.0, 100.0, 600.0])
+        # lookup-table design: ~2e-5 relative at the large-x end (the
+        # interpolation error of log I0, quadratic in the grid spacing)
+        np.testing.assert_allclose(fb0(x), i0(x), rtol=5e-5)
+        np.testing.assert_allclose(fb1(x), i1(x), rtol=5e-5)
+        # and on a dense random grid (interpolation error everywhere)
+        xr = np.exp(np.random.default_rng(4).uniform(np.log(0.15),
+                                                     np.log(650), 200))
+        np.testing.assert_allclose(fb0(xr), i0(xr), rtol=5e-5)
+        # past the float overflow of I0 itself, the log form stays finite
+        big = fb0.log(np.array([1000.0, 2000.0]))
+        assert np.all(np.isfinite(big)) and big[1] > big[0] > 900
+        with pytest.raises(NotImplementedError):
+            FastBessel(2)
+
+    def test_edep_gradient_and_wrapped_base(self):
+        from pint_tpu.templates.lceprimitives import (LCESkewGaussian,
+                                                      LCEWrappedFunction,
+                                                      edep_gradient)
+
+        assert issubclass(LCESkewGaussian, LCEWrappedFunction)
+        es = LCESkewGaussian([0.04, 2.0, 0.5], slopes=[0.01, -0.3, 0.0])
+        ph = np.linspace(0.1, 0.9, 7)
+        en = np.full(7, 3.2)
+        g = edep_gradient(es, ph, en)
+        assert g.shape == (6, 7) and np.all(np.isfinite(g))
+        # linear model: slope rows = base rows * dlog10E (clamp unsaturated)
+        dle = 3.2 - 3.0
+        np.testing.assert_allclose(g[3:], g[:3] * dle, rtol=1e-4, atol=1e-6)
+        assert es.gradient(ph, en).shape == (6, 7)
+
+    def test_gradient_derivative_check(self):
+        from pint_tpu.templates.lcprimitives import LCGaussian
+        from pint_tpu.templates.lctemplate import (LCTemplate,
+                                                   check_gradient_derivative,
+                                                   gradient_derivative)
+
+        t = LCTemplate([LCGaussian([0.05, 0.4])], [0.8])
+        pcs, gd, ngd = check_gradient_derivative(t, n=2001)
+        assert gd.shape == ngd.shape
+        scale = np.abs(ngd).max()
+        assert np.max(np.abs(gd - ngd)) < 0.01 * scale
+        assert gradient_derivative(t, np.array([0.4])).shape[1] == 1
+
+    def test_bt_piecewise_standalone(self):
+        from pint_tpu.models.binary.standalone import BTmodel, BTpiecewise
+
+        t = np.linspace(55000.0, 55040.0, 60)
+        base = dict(PB=3.0, A1=8.0, ECC=0.1, OM=45.0, T0=55005.0, GAMMA=0.0)
+        bt = BTmodel()
+        bt.update_input(barycentric_toa=t, **base)
+        plain = bt.binary_delay()
+        # no pieces -> identical to BT
+        p0 = BTpiecewise()
+        p0.update_input(barycentric_toa=t, **base)
+        np.testing.assert_allclose(p0.binary_delay(), plain, atol=1e-12)
+        # one piece overriding A1/T0 inside [55010, 55020)
+        p1 = BTpiecewise()
+        p1.update_input(barycentric_toa=t, **base, T0X_0001=55005.0002,
+                        A1X_0001=8.003, XR1_0001=55010.0, XR2_0001=55020.0)
+        d = p1.binary_delay()
+        inside = (t >= 55010.0) & (t < 55020.0)
+        np.testing.assert_allclose(d[~inside], plain[~inside], atol=1e-12)
+        assert np.max(np.abs(d[inside] - plain[inside])) > 1e-4
+        # the in-range values equal BT evaluated with the override values
+        bt2 = BTmodel()
+        bt2.update_input(barycentric_toa=t[inside],
+                         **{**base, "A1": 8.003, "T0": 55005.0002})
+        np.testing.assert_allclose(d[inside], bt2.binary_delay(), atol=1e-10)
+
+
+class TestRound5TimeFormats:
+    def test_mjd_string_round_trip(self):
+        from pint_tpu.pulsar_mjd import MJDString, PulsarMJDString
+
+        s = "58123.4567891234567891"
+        for cls in (MJDString, PulsarMJDString):
+            jd1, jd2 = cls.set_jds(s)
+            back = str(cls.to_value(jd1, jd2))
+            assert abs(float(back) - float(s)) < 1e-15
+            # sub-ns round trip as a decimal, not a float
+            from fractions import Fraction
+
+            assert abs(Fraction(back) - Fraction(s)) < Fraction(1, 10**13)
+
+    def test_mjd_long_round_trip_precision(self):
+        from pint_tpu.pulsar_mjd import MJDLong, PulsarMJDLong
+
+        v = np.longdouble("56000.123456789012345")
+        for cls in (MJDLong, PulsarMJDLong):
+            jd1, jd2 = cls.set_jds(v)
+            back = cls.to_value(jd1, jd2)
+            assert abs(float((back - v) * 86400.0)) < 1e-9  # sub-ns seconds
+
+    def test_pulsar_vs_plain_mjd_agree_off_leap_days(self):
+        from pint_tpu.pulsar_mjd import PulsarMJD, TimeFormatMJD
+
+        jd1, jd2 = TimeFormatMJD.set_jds(58123.25)
+        pj1, pj2 = PulsarMJD.set_jds(58123.25)
+        assert (jd1 + jd2) == pytest.approx(pj1 + pj2, abs=1e-12)
+        assert float(PulsarMJD.to_value(pj1, pj2)) == pytest.approx(58123.25)
