@@ -132,3 +132,90 @@ class TestClockFileProperties:
         got = cf.evaluate(probe)
         assert got.min() >= corr_us.min() * 1e-6 - 1e-18
         assert got.max() <= corr_us.max() * 1e-6 + 1e-18
+
+
+class TestRound5Properties:
+    """Property sweeps over the round-5 numerics."""
+
+    @settings(max_examples=25, deadline=None)
+    @given(st.integers(5, 40), st.integers(1, 4),
+           st.floats(0.3, 3.0), st.integers(0, 2**31 - 1))
+    def test_fit_wls_svd_matches_lstsq_property(self, n, k, scale, seed):
+        """Well-conditioned random systems: fit_wls_svd == whitened lstsq."""
+        from pint_tpu.fitter import fit_wls_svd
+
+        rng = np.random.default_rng(seed)
+        k = min(k, n - 1)
+        M = rng.standard_normal((n, k)) * scale
+        sigma = rng.uniform(0.5, 2.0, n)
+        y = rng.standard_normal(n)
+        dpars, Sigma, _, _ = fit_wls_svd(y, sigma, M, list("abcd"[:k]),
+                                         1e-12)
+        ref, *_ = np.linalg.lstsq(M / sigma[:, None], y / sigma, rcond=None)
+        cond = np.linalg.cond(M / sigma[:, None])
+        if cond < 1e8:  # property only meaningful away from degeneracy
+            np.testing.assert_allclose(dpars, ref, rtol=1e-6, atol=1e-9)
+            # covariance symmetric positive semidefinite
+            np.testing.assert_allclose(Sigma, Sigma.T, rtol=1e-10)
+            assert np.all(np.linalg.eigvalsh(Sigma) > -1e-12)
+
+    @settings(max_examples=20, deadline=None)
+    @given(st.floats(0.01, 0.6), st.floats(-8.0, 8.0), st.floats(0.0, 1.0))
+    def test_skew_gaussian_normalized_property(self, width, shape, loc):
+        """LCSkewGaussian integrates to 1 across its parameter space
+        (wrapped sum + truncation remainder)."""
+        from pint_tpu.templates.lcprimitives import LCSkewGaussian
+
+        s = LCSkewGaussian([width, shape, loc])
+        assert s.integrate(0, 1, simps=2048) == pytest.approx(1.0, abs=5e-3)
+
+    @settings(max_examples=10, deadline=None)
+    @given(st.floats(55006.0, 55030.0), st.floats(-0.001, 0.001),
+           st.integers(0, 2**31 - 1))
+    def test_bt_piecewise_boundary_consistency(self, r1, da1, seed):
+        """Outside every piece the BTpiecewise delay equals plain BT,
+        regardless of where the piece boundaries sit."""
+        from pint_tpu.models.binary.standalone import BTmodel, BTpiecewise
+
+        rng = np.random.default_rng(seed)
+        t = np.sort(rng.uniform(55000.0, 55040.0, 30))
+        base = dict(PB=3.0, A1=8.0, ECC=0.1, OM=45.0, T0=55005.0, GAMMA=0.0)
+        r2 = min(r1 + 5.0, 55039.0)
+        p = BTpiecewise()
+        p.update_input(barycentric_toa=t, **base, T0X_0001=55005.0 + da1,
+                       A1X_0001=8.0 + da1, XR1_0001=r1, XR2_0001=r2)
+        b = BTmodel()
+        b.update_input(barycentric_toa=t, **base)
+        outside = (t < r1) | (t >= r2)
+        np.testing.assert_allclose(p.binary_delay()[outside],
+                                   b.binary_delay()[outside], atol=1e-12)
+
+    @settings(max_examples=20, deadline=None)
+    @given(st.floats(0.11, 3100.0))
+    def test_fast_bessel_monotone_and_accurate(self, x):
+        from scipy.special import i0
+
+        from pint_tpu.templates.lcprimitives import FastBessel
+
+        fb = FastBessel(0)
+        if x < 700:
+            assert fb(x) == pytest.approx(float(i0(x)), rel=1e-4)
+        # log form monotone increasing
+        assert fb.log(x * 1.01) > fb.log(x)
+
+    @settings(max_examples=15, deadline=None)
+    @given(st.integers(54000, 59000),
+           st.fractions(0, 1).map(lambda f: float(f)))
+    def test_time_format_round_trips(self, imjd, frac):
+        """String and longdouble formats round-trip arbitrary MJDs."""
+        from pint_tpu.pulsar_mjd import MJDLong, MJDString
+
+        v = np.longdouble(imjd) + np.longdouble(frac)
+        jd1, jd2 = MJDLong.set_jds(v)
+        back = MJDLong.to_value(jd1, jd2)
+        assert abs(float((back - v) * 86400.0)) < 1e-8  # sub-10ns seconds
+        digits = min(int(frac * 1e12), 10**12 - 1)  # 12 decimal places
+        s = f"{imjd}.{digits:012d}"
+        jd1, jd2 = MJDString.set_jds(s)
+        assert abs(float(str(MJDString.to_value(jd1, jd2))) - float(s)) \
+            < 1e-14

@@ -1,0 +1,82 @@
+#!/bin/bash
+# Single-client TPU window runner: probe until the axon tunnel is live,
+# then execute the full round-5 TPU workplan SEQUENTIALLY in one window:
+#   1. official headline bench      -> $OUT/SUCCESS.json   (VERDICT item 1)
+#   2. on-device precision check    -> $OUT/PRECISION.json (VERDICT item 3)
+#   3. chunk/grid sweep + NGC       -> $OUT/SWEEP.jsonl    (VERDICT items 2+9)
+# One TPU process at a time, SIGTERM only via `timeout` (kill -9 wedges the
+# tunnel; BENCH_NOTES.md).  Each step tolerates failure of the previous.
+OUT=${BENCH_RETRY_DIR:-/tmp/bench_r05}
+mkdir -p "$OUT"
+cd /root/repo || exit 1
+for i in $(seq 1 ${BENCH_RETRY_MAX:-300}); do
+  echo "$(date -u +%FT%TZ) attempt $i probe" >> "$OUT/log"
+  if ! timeout 240 python -c \
+      "import jax; assert jax.devices()[0].platform in ('tpu','axon')" \
+      >> "$OUT/log" 2>&1; then
+    echo "$(date -u +%FT%TZ) probe $i: no live TPU" >> "$OUT/log"
+    sleep ${BENCH_RETRY_SLEEP:-120}
+    continue
+  fi
+  echo "$(date -u +%FT%TZ) attempt $i: TPU live, running workplan" >> "$OUT/log"
+
+  # -- 1. official bench (the driver-shaped artifact) ---------------------
+  if [ ! -f "$OUT/SUCCESS.json" ]; then
+    BENCH_REQUIRE_TPU=1 BENCH_SKIP_SECONDARY=1 timeout 3000 \
+      python bench.py > "$OUT/bench_$i.out" 2> "$OUT/bench_$i.err"
+    line=$(grep -h '"metric"' "$OUT/bench_$i.out" | tail -1)
+    # acceptance rules kept identical to tools/bench_retry.sh
+    if [ -n "$line" ] && ! echo "$line" | grep -q '"error"' \
+        && ! echo "$line" | grep -q '"value": 0.0,' \
+        && ! echo "$line" | grep -q '"sanity_ok": false' \
+        && echo "$line" | grep -Eq '"platform": "(tpu|axon)"'; then
+      echo "$line" > "$OUT/SUCCESS.json"
+      echo "$(date -u +%FT%TZ) bench SUCCESS: $line" >> "$OUT/log"
+    else
+      echo "$(date -u +%FT%TZ) bench failed: ${line:-no JSON}" >> "$OUT/log"
+      sleep ${BENCH_RETRY_SLEEP:-120}
+      continue  # tunnel flaked mid-bench: go back to probing
+    fi
+  fi
+
+  # -- 2. precision regression bounds ------------------------------------
+  if [ ! -f "$OUT/PRECISION.json" ]; then
+    timeout 3000 python tools/tpu_precision_check.py --auto \
+      > "$OUT/precision_$i.out" 2> "$OUT/precision_$i.err"
+    pline=$(grep -h '"tpu_precision"' "$OUT/precision_$i.out" | tail -1)
+    # persist genuine on-device comparisons (ok true OR a real bounds
+    # failure) but NOT tool errors like "TPU required" — those retry
+    if [ -n "$pline" ] && ! echo "$pline" | grep -q '"error"' \
+        && echo "$pline" | grep -Eq '"platform": "(tpu|axon)"'; then
+      echo "$pline" > "$OUT/PRECISION.json"
+      echo "$(date -u +%FT%TZ) precision: $pline" >> "$OUT/log"
+    else
+      echo "$(date -u +%FT%TZ) precision check failed: ${pline:-no JSON}" >> "$OUT/log"
+    fi
+  fi
+
+  # -- 3. chunk/grid sweep + NGC6440E TPU datapoint -----------------------
+  if [ ! -f "$OUT/SWEEP.jsonl" ]; then
+    timeout 5000 python tools/tpu_sweep.py --chunks 64,128,256,512 \
+      --grids 256,1024 > "$OUT/sweep_$i.out" 2> "$OUT/sweep_$i.err"
+    rc=$?
+    nrows=$(grep -c '"gls_grid_sweep"' "$OUT/sweep_$i.out")
+    # complete = clean exit AND all 8 (chunk x grid) rows; a partial
+    # sweep (tunnel wedge mid-run) is logged and retried next window
+    if [ "$rc" -eq 0 ] && [ "$nrows" -ge 8 ]; then
+      grep '"metric"' "$OUT/sweep_$i.out" > "$OUT/SWEEP.jsonl"
+      echo "$(date -u +%FT%TZ) sweep done ($nrows rows)" >> "$OUT/log"
+    else
+      echo "$(date -u +%FT%TZ) sweep incomplete (rc=$rc, $nrows/8 rows)" >> "$OUT/log"
+    fi
+  fi
+
+  if [ -f "$OUT/SUCCESS.json" ] && [ -f "$OUT/PRECISION.json" ] \
+      && [ -f "$OUT/SWEEP.jsonl" ]; then
+    echo "$(date -u +%FT%TZ) workplan complete" >> "$OUT/log"
+    exit 0
+  fi
+  sleep ${BENCH_RETRY_SLEEP:-120}
+done
+echo "$(date -u +%FT%TZ) exhausted retries" >> "$OUT/log"
+exit 1
