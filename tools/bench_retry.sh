@@ -3,7 +3,9 @@
 # time (BENCH_NOTES.md), so probe repeatedly from round start until one run
 # lands on a real TPU. One TPU process at a time; SIGTERM only (kill -9
 # wedges the tunnel).
-OUT=${BENCH_RETRY_DIR:-/tmp/bench_r04}
+OUT=${BENCH_RETRY_DIR:-/tmp/bench_r05}
+# NOTE: tools/tpu_window.sh supersedes this loop (bench + precision +
+# sweep in one tunnel window); this stays for a bench-only retry.
 mkdir -p "$OUT"
 cd /root/repo || exit 1
 for i in $(seq 1 ${BENCH_RETRY_MAX:-200}); do
