@@ -234,6 +234,51 @@ def emit(out):
     sys.stdout.flush()
 
 
+def _probe_tpu(timeout_s: int = 240):
+    """Default backend platform probed in a subprocess, or None.
+
+    A wedged axon tunnel hangs ``jax.devices()`` in make_c_api_client
+    rather than raising; the subprocess bounds that hang so the parent
+    never inherits it.  The child holds the (exclusive) lease only for
+    the probe's duration.  Shutdown discipline matters: a timed-out
+    child is sent SIGTERM to its whole session (kill -9 of a lease
+    holder wedges the tunnel — the same rule the shell probes follow
+    with ``timeout``), with SIGKILL only as a last resort; output goes
+    to a tempfile, not pipes, so a surviving grandchild can never block
+    the parent on pipe EOF."""
+    import signal
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out:
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            stdout=out, stderr=subprocess.DEVNULL, start_new_session=True)
+        try:
+            rc = p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                p.terminate()
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                try:  # truly stuck: reap it rather than leak a zombie
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    p.kill()
+                p.wait()
+            return None
+        if rc != 0:
+            return None
+        out.seek(0)
+        lines = out.read().strip().splitlines()
+        # last line only: plugin/log chatter may precede the platform
+        return lines[-1].strip() if lines else None
+
+
 def main():
     t_all = time.time()
     import jax
@@ -256,8 +301,29 @@ def main():
         # to keep a validation run off the (exclusive, flaky) TPU lease
         jax.config.update("jax_platforms", "cpu")
 
-    # the axon TPU tunnel is intermittently unavailable (see BENCH_NOTES.md);
-    # a CPU-fallback number beats recording nothing for the round
+    # the axon TPU tunnel is intermittently unavailable (see BENCH_NOTES.md)
+    # and a WEDGED tunnel HANGS jax.devices() for ~25 min instead of
+    # raising — probe in a short-lived subprocess first so this process
+    # can still fall back to CPU (or fail fast under BENCH_REQUIRE_TPU)
+    # rather than hanging past the caller's patience.
+    if not os.environ.get("BENCH_FORCE_CPU") \
+            and not os.environ.get("BENCH_SKIP_PROBE"):
+        try:
+            probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+        except ValueError:  # the one-JSON-line contract survives bad env
+            probe_timeout = 240
+        probed = _probe_tpu(probe_timeout)
+        if probed not in ("tpu", "axon"):
+            if os.environ.get("BENCH_REQUIRE_TPU"):
+                emit({"metric": "gls_chisq_grid_evals_per_sec", "value": 0.0,
+                      "unit": "fits/s", "vs_baseline": 0.0,
+                      "error": "TPU probe found no live tunnel "
+                               f"(got {probed!r})"})
+                return
+            print(f"# TPU probe found no live tunnel (got {probed!r}); "
+                  "running on CPU", file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+
     try:
         backend = jax.devices()[0].platform
         if os.environ.get("BENCH_REQUIRE_TPU") and backend not in ("tpu", "axon"):

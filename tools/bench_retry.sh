@@ -20,7 +20,7 @@ for i in $(seq 1 ${BENCH_RETRY_MAX:-200}); do
     continue
   fi
   echo "$(date -u +%FT%TZ) attempt $i bench (TPU live)" >> "$OUT/log"
-  BENCH_REQUIRE_TPU=1 BENCH_SKIP_SECONDARY=1 timeout 3000 \
+  BENCH_REQUIRE_TPU=1 BENCH_SKIP_SECONDARY=1 BENCH_SKIP_PROBE=1 timeout 3000 \
     python bench.py > "$OUT/attempt_$i.out" 2> "$OUT/attempt_$i.err"
   line=$(grep -h '"metric"' "$OUT/attempt_$i.out" | tail -1)
   if [ -n "$line" ] && ! echo "$line" | grep -q '"error"' \

@@ -22,7 +22,7 @@ for i in $(seq 1 ${BENCH_RETRY_MAX:-300}); do
 
   # -- 1. official bench (the driver-shaped artifact) ---------------------
   if [ ! -f "$OUT/SUCCESS.json" ]; then
-    BENCH_REQUIRE_TPU=1 BENCH_SKIP_SECONDARY=1 timeout 3000 \
+    BENCH_REQUIRE_TPU=1 BENCH_SKIP_SECONDARY=1 BENCH_SKIP_PROBE=1 timeout 3000 \
       python bench.py > "$OUT/bench_$i.out" 2> "$OUT/bench_$i.err"
     line=$(grep -h '"metric"' "$OUT/bench_$i.out" | tail -1)
     # acceptance rules kept identical to tools/bench_retry.sh
