@@ -57,7 +57,7 @@ for i in $(seq 1 ${BENCH_RETRY_MAX:-300}); do
 
   # -- 3. chunk/grid sweep + NGC6440E TPU datapoint -----------------------
   if [ ! -f "$OUT/SWEEP.jsonl" ]; then
-    timeout 5000 python tools/tpu_sweep.py --chunks 64,128,256,512 \
+    timeout 5000 python tools/tpu_sweep.py --chunks 128,64,256,512 \
       --grids 256,1024 > "$OUT/sweep_$i.out" 2> "$OUT/sweep_$i.err"
     rc=$?
     nrows=$(grep -c '"gls_grid_sweep"' "$OUT/sweep_$i.out")
