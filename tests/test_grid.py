@@ -477,3 +477,22 @@ class TestDesignmatrixLinearCache:
             err = float(getattr(fa.model, p).uncertainty or 0.0)
             tol = max(1e-8 * abs(vb), 1e-4 * err, 1e-20)
             assert abs(va - vb) < tol, p
+
+
+class TestChunkSizes:
+    def test_gls_grid_chunk_sizes_agree(self, gls_fit):
+        """chunk= (the tools/tpu_sweep.py knob) changes only the executable
+        batch shape: chi2 must agree across chunk sizes, including sizes
+        larger than, equal to, and smaller than the point count."""
+        from pint_tpu.grid import grid_chisq
+
+        f = gls_fit
+        g0 = np.linspace(f.model.F0.value - 2e-10, f.model.F0.value + 2e-10, 3)
+        g1 = np.linspace(f.model.F1.value - 2e-17, f.model.F1.value + 2e-17, 3)
+        ref, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=2)
+        for chunk in (4, 9, 32):
+            chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=2,
+                                 chunk=chunk)
+            np.testing.assert_allclose(np.asarray(chi2), np.asarray(ref),
+                                       rtol=1e-9, atol=1e-9,
+                                       err_msg=f"chunk={chunk}")
