@@ -68,7 +68,12 @@ def build_augmented_system(model, toas, wideband: bool = False):
     normalized ``[M_timing | noise basis]`` (wideband: timing rows are the
     stacked [toa; dm] blocks, noise basis padded with zero DM rows), plus
     (params, norm, phiinv, Nvec, noise_dims).  Single source of truth for
-    the 1e40 timing-prior weighting and basis padding."""
+    the timing-prior weighting (1e40, enterprise convention) and basis
+    padding.  HOST-ONLY NUMBERS: these weights enter as ``phiinv`` = 1e-40
+    added to host-factored normal equations; never move them into a jitted
+    graph — TPU f64 emulation has float32 RANGE and 1e40-scale weights
+    overflow there (that is why the on-device offset prior is the separate
+    ``timing_model.OFFSET_PRIOR_WEIGHT`` = 1e10)."""
     M_tm, params, units = model.designmatrix(toas, reuse_linear=True)
     if wideband:
         M_dm, _, _ = model.dm_designmatrix(toas)

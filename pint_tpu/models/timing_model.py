@@ -44,7 +44,20 @@ from pint_tpu.models.parameter import (
 )
 from pint_tpu.phase import Phase
 
-__all__ = ["Component", "DelayComponent", "PhaseComponent", "TimingModel", "DEFAULT_ORDER"]
+__all__ = ["Component", "DelayComponent", "PhaseComponent", "TimingModel",
+           "DEFAULT_ORDER", "OFFSET_PRIOR_WEIGHT"]
+
+#: Variance [s^2] of the uninformative prior on the marginalized overall
+#: phase offset (``augment_basis_for_offset``).  1e10 s^2, not the
+#: reference/enterprise 1e40: the weight flows into jitted Woodbury graphs,
+#: and TPU f64 emulation has float32 RANGE, so sqrt(1e40)-scaled basis
+#: columns overflow to inf on device (measured round 5,
+#: tools/tpu_chi2_isolate.py).  Still uninformative by ~26 orders for a
+#: 4e15 s^-2 information content; note logdet/lnlikelihood carry the
+#: (arbitrary) additive constant log(weight)/2 of this improper prior, so
+#: absolute lnlikelihood values differ from enterprise's by a constant that
+#: cancels in every likelihood ratio.
+OFFSET_PRIOR_WEIGHT = 1e10
 
 #: Delay/phase component evaluation order (matches the reference semantics)
 DEFAULT_ORDER = [
@@ -1190,16 +1203,24 @@ class TimingModel:
 
     def augment_basis_for_offset(self, U, w, n: Optional[int] = None):
         """Marginalize the overall phase offset: append a ones column with
-        an uninformative 1e40 prior when no explicit PhaseOffset parameter
+        an uninformative prior when no explicit PhaseOffset parameter
         is fitted (reference ``residuals.py:600-604``).  Single source of
         truth for every correlated chi2/likelihood evaluation — the grid
         kernel, ``Residuals``, and the noise likelihood must stay
-        definitionally identical."""
+        definitionally identical.
+
+        The prior weight is 1e10 s^2, not the reference/enterprise 1e40:
+        this weight flows into jitted Woodbury graphs, and on TPU f64 is
+        emulated with float32-RANGE arithmetic, so sqrt(1e40)-scaled basis
+        columns overflow to inf mid-graph (measured round 5,
+        tools/tpu_chi2_isolate.py).  1e10 s^2 is still uninformative by
+        ~26 orders: the marginalized offset shrinks by 1/(w * sum(1/sigma^2))
+        ~ 2.5e-26 for the B1855 workload, far below f64 resolution."""
         if "PhaseOffset" in self.components:
             return np.asarray(U), np.asarray(w)
         n = len(U) if n is None else n
         return (np.hstack([np.asarray(U), np.ones((n, 1))]),
-                np.concatenate([np.asarray(w), [1e40]]))
+                np.concatenate([np.asarray(w), [OFFSET_PRIOR_WEIGHT]]))
 
     def full_designmatrix(self, toas):
         """[timing M | noise basis] (reference ``timing_model.py:1752``)."""
@@ -1212,7 +1233,10 @@ class TimingModel:
     def full_basis_weight(self, toas) -> np.ndarray:
         """Weights for the full design matrix: 1e40 (uninformative, matching
         enterprise) for timing columns, GP weights for noise columns
-        (reference ``timing_model.py:1777``)."""
+        (reference ``timing_model.py:1777``).  HOST-ONLY: 1e40-scale weights
+        overflow TPU f64 emulation's float32 range inside jitted graphs —
+        use ``OFFSET_PRIOR_WEIGHT`` semantics (see its docstring) for
+        anything that flows on-device."""
         phi_tm = np.full(self.ntmpar, 1e40)
         _, w = self.noise_model_basis_weight(toas)
         return phi_tm if w is None else np.concatenate([phi_tm, w])

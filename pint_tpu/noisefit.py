@@ -204,7 +204,9 @@ def build_noise_lnlikelihood(model, toas, wideband: bool = False):
         U_aug, _ = model.augment_basis_for_offset(U0, np.zeros(U0.shape[1]),
                                                   n=n)
         if U_aug.shape[1] > U0.shape[1]:
-            offset_phi = jnp.asarray([1e40])
+            from pint_tpu.models.timing_model import OFFSET_PRIOR_WEIGHT
+
+            offset_phi = jnp.asarray([OFFSET_PRIOR_WEIGHT])
         U = jnp.asarray(U_aug)
     builders = _corr_weight_builders(model, toas)
 
@@ -229,18 +231,25 @@ def build_noise_lnlikelihood(model, toas, wideband: bool = False):
             return -0.5 * (chi2 + logdet + n * jnp.log(_TWO_PI))
     else:
         def lnlike_toa(x, r):
+            # scaled-basis Woodbury (same form as utils.woodbury_dot):
+            # V = U sqrt(phi), Sigma = I + V^T N^-1 V — neither 1/phi nor
+            # log(phi) is evaluated, which keeps every intermediate inside
+            # TPU f64 emulation's float32 RANGE and conditions Sigma
+            # (eigenvalues >= 1); logdet via the determinant lemma
             var = white_var(x)
             segs = [b(x, getv) for b in builders]
             if offset_phi is not None:
                 segs.append(offset_phi)
             phi = jnp.concatenate(segs)
+            V = U * jnp.sqrt(phi)[None, :]
             Ninv_r = r / var
-            UT_Ninv_r = U.T @ Ninv_r
-            Sigma = jnp.diag(1.0 / phi) + U.T @ (U / var[:, None])
+            VT_Ninv_r = V.T @ Ninv_r
+            Sigma = jnp.eye(V.shape[1], dtype=V.dtype) \
+                + V.T @ (V / var[:, None])
             L = jnp.linalg.cholesky(Sigma)
-            z = jax.scipy.linalg.cho_solve((L, True), UT_Ninv_r)
-            chi2 = jnp.sum(r * Ninv_r) - UT_Ninv_r @ z
-            logdet = (jnp.sum(jnp.log(var)) + jnp.sum(jnp.log(phi))
+            z = jax.scipy.linalg.cho_solve((L, True), VT_Ninv_r)
+            chi2 = jnp.sum(r * Ninv_r) - VT_Ninv_r @ z
+            logdet = (jnp.sum(jnp.log(var))
                       + 2.0 * jnp.sum(jnp.log(jnp.diag(L))))
             return -0.5 * (chi2 + logdet + n * jnp.log(_TWO_PI))
 

@@ -193,24 +193,33 @@ def woodbury_dot(Ndiag, U, Phidiag, x, y):
     Reference ``utils.py:3069``: the GLS chi2/likelihood kernel.  Uses the
     Woodbury identity so only an (nbasis x nbasis) Cholesky is needed.
     Returns (dot, logdet).
+
+    Scaled-basis form: with V = U sqrt(Phi) the capacitance matrix is
+    Sigma = I + V^T N^-1 V and the determinant lemma gives
+    logdet(C) = sum(log N) + 2 sum(log diag(chol(Sigma))).  Algebraically
+    identical to the textbook diag(1/Phi) + U^T N^-1 U form, but neither
+    1/Phi nor log(Phi) is ever evaluated — this matters on TPU, where f64
+    is emulated with float32-range arithmetic: the 1e40 uninformative
+    offset prior (timing_model.augment_basis_for_offset) overflows f32
+    range and made logdet NaN on device (measured round 5,
+    tools/tpu_chi2_isolate.py), while sqrt(Phi) keeps every intermediate
+    in range for Phi in [1e-76, 1e76].  Conditioning also improves:
+    Sigma's eigenvalues are >= 1.
     """
     Ndiag = jnp.asarray(Ndiag)
+    V = U * jnp.sqrt(Phidiag)[None, :]
     Ninv_x = x / Ndiag
     Ninv_y = y / Ndiag
-    Ut_Ninv_x = U.T @ Ninv_x
-    Ut_Ninv_y = U.T @ Ninv_y
-    Sigma = jnp.diag(1.0 / Phidiag) + U.T @ (U / Ndiag[:, None])
+    Vt_Ninv_x = V.T @ Ninv_x
+    Vt_Ninv_y = V.T @ Ninv_y
+    Sigma = jnp.eye(V.shape[1], dtype=V.dtype) + V.T @ (V / Ndiag[:, None])
     cf = jnp.linalg.cholesky(Sigma)
     # triangular solves, not jnp.linalg.solve: XLA's LU decomposition has no
     # f64 TPU lowering, while Cholesky + solve_triangular do
-    z = jsl.solve_triangular(cf, Ut_Ninv_y, lower=True)
-    zx = jsl.solve_triangular(cf, Ut_Ninv_x, lower=True)
+    z = jsl.solve_triangular(cf, Vt_Ninv_y, lower=True)
+    zx = jsl.solve_triangular(cf, Vt_Ninv_x, lower=True)
     dot = x @ Ninv_y - zx @ z
-    logdet = (
-        jnp.sum(jnp.log(Ndiag))
-        + jnp.sum(jnp.log(Phidiag))
-        + 2.0 * jnp.sum(jnp.log(jnp.diag(cf)))
-    )
+    logdet = jnp.sum(jnp.log(Ndiag)) + 2.0 * jnp.sum(jnp.log(jnp.diag(cf)))
     return dot, logdet
 
 

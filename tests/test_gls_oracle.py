@@ -5,7 +5,7 @@ VERDICT r3 #4: the Woodbury chi2 + logdet path (reference
 validated only self-consistently (grid-vs-fitter).  Here a clean-room
 oracle builds the DENSE TOA covariance
 
-    C = diag(Nvec) + U_ecorr W U_ecorr^T + F phi F^T + 1e40 * 1 1^T
+    C = diag(Nvec) + U_ecorr W U_ecorr^T + F phi F^T + w_off * 1 1^T
 
 entirely from published formulas in 40-digit mpmath — white-noise scaling
 (sigma' = EFAC * sqrt(sigma^2 + EQUAD^2)), ECORR epoch grouping (TOAs
@@ -24,7 +24,7 @@ import numpy as np
 import pytest
 
 mp = pytest.importorskip("mpmath")
-# C spans ~52 decades (1e40 offset block against ~1e-12 s^2 white noise);
+# C spans ~22 decades (1e10 offset block against ~1e-12 s^2 white noise);
 # 70 digits keeps the dense LU comfortably nonsingular.  mp.mp.dps is a
 # GLOBAL other test modules also set at import time (test_pipeline_oracle
 # uses 40), so the precision is scoped per-call with mp.workdps instead.
@@ -137,8 +137,13 @@ def _oracle_cov_inner(model, toas):
             for j in range(n):
                 C[i, j] += ci * c[j]
 
-    # marginalized overall offset
-    big = mp.mpf("1e40")
+    # marginalized overall offset: the oracle must add the SAME improper
+    # prior variance the framework marginalizes with — the lnlikelihood
+    # carries an additive log(weight)/2 normalization constant, so the
+    # value is part of the definition being checked, not a free choice
+    from pint_tpu.models.timing_model import OFFSET_PRIOR_WEIGHT
+
+    big = mp.mpf(repr(OFFSET_PRIOR_WEIGHT))
     for i in range(n):
         for j in range(n):
             C[i, j] += big

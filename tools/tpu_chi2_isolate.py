@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Isolate the B1855 TPU chi2 deviation: phase propagation vs linear algebra.
+
+tools/tpu_precision_check.py measures chi2 end-to-end, where TPU and CPU
+each compute their own residuals — so the documented dd-phase floor
+(|dphase| <= 1e-4 cycles) propagates into r and is amplified by 1/sigma^2
+weighting into a chi2 difference that says nothing about the Woodbury
+kernel itself.  The microprobe (tools/tpu_numeric_microprobe.py) showed TPU
+f64 dots/reductions are exact to ~1e-14 while cholesky/solve_triangular run
+at ~f32 backward error; this tool closes the loop by evaluating the REAL
+B1855 Woodbury chi2 on BOTH backends from bit-identical inputs.
+
+Pass 1 (subprocess, CPU backend): build the B1855 model/TOAs, dump
+    r, sigma, U, w and the CPU chi2/lnlike to an .npz.
+Pass 2 (this process, TPU): load the arrays, run pint_tpu.utils.woodbury_dot
+    jitted on device, compare.  Any difference here IS linear algebra.
+
+Usage:  timeout 1200 python tools/tpu_chi2_isolate.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DUMP = "/tmp/chi2_isolate_inputs.npz"
+
+DATADIR = "/root/reference/tests/datafile"
+B1855_PAR = f"{DATADIR}/B1855+09_NANOGrav_9yv1.gls.par"
+B1855_TIM = f"{DATADIR}/B1855+09_NANOGrav_9yv1.tim"
+
+
+def cpu_pass():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from pint_tpu.models import get_model_and_toas
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.utils import woodbury_dot
+
+    model, toas = get_model_and_toas(B1855_PAR, B1855_TIM)
+    res = Residuals(toas, model)
+    r = np.asarray(res.time_resids)
+    sigma = np.asarray(res.get_data_error())
+    U, w = res._corr_basis_weight()
+    U, w = np.asarray(U), np.asarray(w)
+    dot, logdet = woodbury_dot(sigma**2, U, w, r, r)
+    np.savez(DUMP, r=r, sigma=sigma, U=U, w=w,
+             chi2=np.array([float(dot)]), logdet=np.array([float(logdet)]))
+    print(f"# CPU chi2 = {float(dot):.6f}", file=sys.stderr)
+
+
+def main():
+    if "--cpu-pass" in sys.argv:
+        cpu_pass()
+        return 0
+
+    subprocess.run([sys.executable, os.path.abspath(__file__), "--cpu-pass"],
+                   check=True,
+                   cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    backend = jax.devices()[0].platform
+    print(f"# compare backend: {backend}", file=sys.stderr)
+    d = np.load(DUMP)
+    from pint_tpu.utils import woodbury_dot
+
+    jf = jax.jit(lambda N, U, w, r: woodbury_dot(N, U, w, r, r))
+    dot, logdet = jf(jnp.asarray(d["sigma"] ** 2), jnp.asarray(d["U"]),
+                     jnp.asarray(d["w"]), jnp.asarray(d["r"]))
+    dot, logdet = float(dot), float(logdet)
+    ref_dot, ref_logdet = float(d["chi2"][0]), float(d["logdet"][0])
+    out = {"metric": "chi2_isolate", "platform": backend,
+           "chi2_tpu": dot, "chi2_cpu": ref_dot,
+           "chi2_rel": abs(dot - ref_dot) / max(abs(ref_dot), 1.0),
+           "logdet_tpu": logdet, "logdet_cpu": ref_logdet,
+           "logdet_rel": abs(logdet - ref_logdet) / max(abs(ref_logdet), 1.0)}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

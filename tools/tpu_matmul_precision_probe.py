@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Diagnose the TPU chi2/GLS-step deviation: XLA matmul precision sweep.
+
+The round-5 on-device precision check (tools/tpu_precision_check.py) showed
+the core arithmetic bounds passing (fractional phase 5.2e-5 cycles, delays
+9.1e-10 s, pulse integers exact) while every chi2/solve-level comparison
+failed by 1e-5..1.7e-2 relative.  That error signature — elementwise paths
+exact, large contractions wrong by ~bf16 epsilon — points at XLA:TPU's
+default dot/matmul precision, which runs reduced-precision MXU passes unless
+``jax.default_matmul_precision`` (or per-op ``precision=``) asks for more.
+
+This probe quantifies it on-device: for each precision setting it rebuilds
+the failing quantities from tools/tpu_precision_check.py on FRESH model
+objects (the jit cache keys include the precision config, but per-model
+caches must not leak between configs) and reports
+
+  * b_chi2_rel   — B1855 Woodbury chi2 vs the CPU reference dump
+  * b_gls_step_rel — linearized GLS step vector vs the dump
+  * ngc_grid_chi2_rel / b_grid_chi2_rel — grid-kernel chi2 vs the dump
+  * wall time per quantity, so the accuracy/throughput trade is measured,
+    not guessed
+
+Usage (tunnel lease rules apply — single TPU client):
+  timeout 3000 python tools/tpu_matmul_precision_probe.py \
+      --ref /tmp/tpu_precision_ref.npz --precisions default,highest
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_config(precision, ref):
+    """Compute the chi2-level quantities under one matmul-precision setting.
+
+    Returns {name: {"value": rel_err, "seconds": wall}} per quantity.
+    """
+    import jax
+
+    from tools.tpu_precision_check import compute, compare
+
+    ctx = jax.default_matmul_precision(precision) if precision != "default" \
+        else None
+    t0 = time.time()
+    if ctx is not None:
+        with ctx:
+            got = compute(preset=ref)
+    else:
+        got = compute(preset=ref)
+    wall = time.time() - t0
+    res = compare(got, ref)
+    rows = {}
+    for name, chk in res["checks"].items():
+        if name.endswith("_rel"):
+            rows[name] = chk["value"]
+    return {"precision": precision, "wall_s": round(wall, 1), "rel": rows}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/tmp/tpu_precision_ref.npz")
+    ap.add_argument("--precisions", default="default,highest")
+    ap.add_argument("--cpu", action="store_true",
+                    help="debug run on the host CPU backend")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    backend = jax.devices()[0].platform
+    print(f"# backend: {backend}", file=sys.stderr)
+    if not args.cpu and backend not in ("tpu", "axon"):
+        print(json.dumps({"metric": "matmul_precision_probe",
+                          "error": f"TPU required, backend {backend!r}"}))
+        return 1
+    if not os.path.exists(args.ref):
+        print(json.dumps({"metric": "matmul_precision_probe",
+                          "error": f"reference dump missing: {args.ref}"}))
+        return 1
+    # persistent cache, same keying as bench.cache_key (replay-friendly)
+    import bench as _B
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache", _B.cache_key(backend))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+    ref = dict(np.load(args.ref, allow_pickle=False))
+    out = {"metric": "matmul_precision_probe", "platform": backend,
+           "configs": []}
+    for p in args.precisions.split(","):
+        p = p.strip()
+        print(f"# --- precision={p} ---", file=sys.stderr)
+        try:
+            row = run_config(p, ref)
+        except Exception as e:  # one bad config must not lose the others
+            row = {"precision": p, "error": f"{type(e).__name__}: {e}"}
+        out["configs"].append(row)
+        print(json.dumps(row), file=sys.stderr)
+        sys.stderr.flush()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Per-primitive TPU f64 accuracy probe for the GLS chi2/solve path.
+
+The round-5 matmul-precision probe showed the B1855 chi2/step deviations are
+BIT-IDENTICAL under jax.default_matmul_precision default/high/highest — the
+loss is not the bf16-pass knob; some primitive in the chain executes f64 at
+a fixed lower effective precision.  This probe isolates each primitive on
+synthetic data shaped/scaled like the real workload (4005 TOAs, ~160 noise
+basis columns, red-noise prior spanning ~10 decades) and reports max
+relative error vs the host-CPU f64 result, alongside a CPU-f32 replay of
+the same op so the effective precision is readable ("matches f32" vs
+"matches bf16").
+
+Also measures candidate fixes:
+  * dot with ``preferred_element_type=float64``
+  * K-blocked dot with f64 partial-sum accumulation
+  * Dekker-split (hi/lo) compensated dot built from exact f32 products
+so the repair strategy is chosen from measured error AND measured wall.
+
+Usage:  timeout 1200 python tools/tpu_numeric_microprobe.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_TOA = 4005
+N_BASIS = 160
+
+
+def make_data(rng):
+    """Synthetic arrays with the real workload's scales."""
+    U = rng.standard_normal((N_TOA, N_BASIS))
+    # Fourier-basis columns are O(1); ECORR columns 0/1 — keep O(1)
+    r = rng.standard_normal(N_TOA) * 1e-6          # residuals ~ microseconds
+    sigma2 = (rng.uniform(0.1, 10.0, N_TOA) * 1e-6) ** 2
+    # red-noise prior: power law over ~10 decades like PLRedNoise phi
+    phi = 10.0 ** rng.uniform(-18, -8, N_BASIS)
+    return U, r, sigma2, phi
+
+
+def rel(a, b):
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    scale = max(float(np.max(np.abs(b))), 1e-300)
+    return float(np.max(np.abs(a - b)) / scale)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import jax.scipy.linalg as jsl
+
+    backend = jax.devices()[0].platform
+    print(f"# backend: {backend}", file=sys.stderr)
+    cpu = jax.devices("cpu")[0] if backend != "cpu" else None
+
+    rng = np.random.default_rng(7)
+    U, r, sigma2, phi = make_data(rng)
+    W = 1.0 / sigma2
+
+    # ---- reference values on host CPU f64 -------------------------------
+    ref = {}
+    ref["utr"] = U.T @ (W * r)
+    ref["utwu"] = U.T @ (W[:, None] * U)
+    ref["sumsq"] = float(np.sum(W * r * r))
+    Sigma = np.diag(1.0 / phi) + ref["utwu"]
+    ref["chol"] = np.linalg.cholesky(Sigma)
+    import scipy.linalg as sl
+
+    ref["tri"] = sl.solve_triangular(ref["chol"], ref["utr"], lower=True)
+    ref["woodchi2"] = ref["sumsq"] - float(ref["tri"] @ ref["tri"])
+
+    # f32 replay on host (interpretive baseline: "is TPU ~ f32?")
+    f32 = {}
+    U32, W32, r32 = (x.astype(np.float32) for x in (U, W, r))
+    f32["utr"] = U32.T @ (W32 * r32)
+    f32["utwu"] = U32.T @ ((W32[:, None]) * U32)
+    f32["sumsq"] = float(np.sum(W32 * r32 * r32))
+    Sigma32 = (np.diag(1.0 / phi) + ref["utwu"]).astype(np.float32)
+    ch32 = np.linalg.cholesky(Sigma32)
+    f32["chol"] = ch32
+    f32["tri"] = sl.solve_triangular(ch32, f32["utr"], lower=True)
+
+    rows = []
+
+    def probe(name, fn, ref_val, note=""):
+        jf = jax.jit(fn)
+        args_dev = ()
+        t0 = time.time()
+        out = np.asarray(jf())
+        wall1 = time.time() - t0
+        t0 = time.time()
+        out = np.asarray(jf())
+        wall2 = time.time() - t0
+        row = {"op": name, "rel_err": rel(out, ref_val),
+               "f32_rel_err": rel(f32[name.split(":")[0]], ref_val)
+               if name.split(":")[0] in f32 else None,
+               "first_s": round(wall1, 3), "repeat_s": round(wall2, 4)}
+        if note:
+            row["note"] = note
+        rows.append(row)
+        print(json.dumps(row))
+        sys.stdout.flush()
+
+    jU, jW, jr = jnp.asarray(U), jnp.asarray(W), jnp.asarray(r)
+    jphi = jnp.asarray(phi)
+    jSigma = jnp.asarray(Sigma)
+    jchol = jnp.asarray(ref["chol"])
+    jutr = jnp.asarray(ref["utr"])
+
+    # -- plain primitives --------------------------------------------------
+    probe("utr", lambda: jU.T @ (jW * jr), ref["utr"])
+    probe("utwu", lambda: jU.T @ (jW[:, None] * jU), ref["utwu"])
+    probe("sumsq", lambda: jnp.sum(jW * jr * jr), ref["sumsq"])
+    probe("chol", lambda: jnp.linalg.cholesky(jSigma), ref["chol"])
+    probe("tri", lambda: jsl.solve_triangular(jchol, jutr, lower=True),
+          ref["tri"])
+
+    # -- candidate fixes on the worst dot ---------------------------------
+    # 1. preferred_element_type=f64 accumulation request
+    from jax import lax
+
+    def dot_pref():
+        return lax.dot_general(
+            jU.T, (jW * jr)[:, None], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float64)[:, 0]
+
+    probe("utr:pref_f64", dot_pref, ref["utr"],
+          note="lax.dot_general preferred_element_type=f64")
+
+    # 2. K-blocked dot, f64 accumulation of f64-dot partials
+    def dot_blocked(block=512):
+        acc = jnp.zeros(N_BASIS, dtype=jnp.float64)
+        x = jW * jr
+        for k0 in range(0, N_TOA, block):
+            acc = acc + jU[k0:k0 + block].T @ x[k0:k0 + block]
+        return acc
+
+    probe("utr:blocked512", dot_blocked, ref["utr"],
+          note="K-blocked, f64 partial accumulation")
+
+    # 3. Dekker hi/lo split: exact f32 products, f64 accumulation.
+    #    x = hi + lo with hi = f32(x); products hi*hi, hi*lo, lo*hi in f32
+    #    matmuls with f32->f64 upcast before combination.
+    def dot_split():
+        x = jW * jr
+        Uhi = jU.astype(jnp.float32)
+        Ulo = (jU - Uhi.astype(jnp.float64)).astype(jnp.float32)
+        xhi = x.astype(jnp.float32)
+        xlo = (x - xhi.astype(jnp.float64)).astype(jnp.float32)
+        hh = jnp.matmul(Uhi.T, xhi[:, None],
+                        preferred_element_type=jnp.float64,
+                        precision=lax.Precision.HIGHEST)[:, 0]
+        hl = jnp.matmul(Uhi.T, xlo[:, None],
+                        preferred_element_type=jnp.float64,
+                        precision=lax.Precision.HIGHEST)[:, 0]
+        lh = jnp.matmul(Ulo.T, xhi[:, None],
+                        preferred_element_type=jnp.float64,
+                        precision=lax.Precision.HIGHEST)[:, 0]
+        return hh + (hl + lh)
+
+    probe("utr:split", dot_split, ref["utr"],
+          note="Dekker hi/lo split, f32 products, f64 combine")
+
+    # 4. full Woodbury chi2 scalar end-to-end (the artifact-level check)
+    def woodchi2():
+        utwu = jU.T @ (jW[:, None] * jU)
+        Sg = jnp.diag(1.0 / jphi) + utwu
+        L = jnp.linalg.cholesky(Sg)
+        z = jsl.solve_triangular(L, jU.T @ (jW * jr), lower=True)
+        return jnp.sum(jW * jr * jr) - z @ z
+
+    probe("woodchi2", woodchi2, ref["woodchi2"])
+
+    print(json.dumps({"metric": "tpu_numeric_microprobe",
+                      "platform": backend, "rows": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

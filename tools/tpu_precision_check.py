@@ -16,20 +16,36 @@ container's axon-at-startup sitecustomize):
   3. ``--auto``                 do both: spawn pass 1 as a subprocess, then
      run pass 2 in-process.  Prints ONE JSON line with measured bounds.
 
-Bounds asserted (tightened to ~10x the r4 measured values, loose enough to
-not flake on a different chip stepping):
+Bounds asserted — two tiers, calibrated by the round-5 on-device
+measurements (tools/tpu_numeric_microprobe.py, tools/tpu_chi2_isolate.py):
 
+Tier 1, direct bounds (the dd-arithmetic floor):
   * integer pulse numbers identical (exactness of the mul_mod1 fold)
   * fractional phase |TPU - CPU|   <= 1e-4 cycles  (measured ~5e-5)
   * total delay |TPU - CPU|        <= 1e-9 s
-  * WLS grid chi2 relative diff    <= 1e-6  (NGC6440E 4x4)
-  * correlated-noise chi2 relative diff <= 1e-6  (B1855 Woodbury)
-  * GLS linearized STEP vector relative diff <= 1e-6 (designmatrix +
-    Woodbury normal-equation solve; the step itself, because evaluating
-    chi2 AT the stepped point goes NaN on real TOAs — the step drives
-    SINI nonphysical under the analytic ephemeris, bench.py docstring)
-  * headline chunked GLS grid executable chi2 relative diff <= 1e-6
-    (2x2 M2 x SINI patch around the physical par-file values)
+  * LINEAR-ALGEBRA-ISOLATED Woodbury chi2 + logdet <= 1e-9 relative:
+    woodbury_dot evaluated on device from the CPU pass's bit-identical
+    (r, sigma, U, w) inputs.  TPU f64 dots/reductions measured exact to
+    ~1e-14; this is the check that caught the f32-RANGE overflow of the
+    1e40 offset prior (logdet NaN on device, fixed round 5).
+
+Tier 2, explained-deviation ratios (bound 1.0): chi2-level quantities
+differ across backends because the dd-phase floor propagates into the
+residual vector and is amplified by 1/sigma^2 weighting — a flat 1e-6
+chi2 bound is mathematically unachievable while the 1e-4-cycle phase
+bound holds (r4's bounds conflated the two; measured round 5:
+1.7e-2 B1855 chi2 deviation fully explained by 5.2e-5-cycle phase dev,
+LA exact to 7.7e-14 on identical inputs).  With q = ||(r_dev - r_ref) /
+sigma_ref||_2, Cauchy-Schwarz gives |dchi2| <= 2 sqrt(chi2) q + q^2 for
+a fixed covariance; each check asserts
+
+    measured deviation <= 4 * rigorous-envelope + 1e-9 * scale
+
+(margin 4 covers the second-order covariance/designmatrix dependence on
+the residuals).  Applied to: end-to-end B1855 Woodbury chi2, NGC 4x4 WLS
+grid chi2, headline 2x2 GLS grid chi2, and the GLS step vector (envelope:
+the normal-equation solve of the REF system against dr, i.e. the
+first-order step perturbation).
 
 Workloads: NGC6440E (isolated pulsar, real par/tim, WLS grid) and B1855+09
 9yv1 (DD binary + DMX + red noise, 4005 real TOAs).
@@ -57,7 +73,9 @@ NGC_TIM = "/root/reference/src/pint/data/examples/NGC6440E.tim"
 
 BOUND_FRAC_CYCLES = 1e-4
 BOUND_DELAY_S = 1e-9
-BOUND_CHI2_REL = 1e-6
+#: LA-isolated checks and tier-2 floor slack: measured device
+#: floor ~7.7e-14 on bit-identical inputs (tools/tpu_chi2_isolate.py)
+BOUND_LA_REL = 1e-9
 
 
 def compute(skip_b1855=False, preset=None):
@@ -100,6 +118,13 @@ def compute(skip_b1855=False, preset=None):
     out["ngc_g0"], out["ngc_g1"] = np.asarray(g0), np.asarray(g1)
     chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
     out["ngc_grid_chi2"] = np.asarray(chi2)
+    # residuals/sigma at the grid's start state, for the explained-deviation
+    # envelope (the grid start is the fitted model, not the par file)
+    from pint_tpu.residuals import Residuals as _Residuals
+
+    res_ngc = _Residuals(toas, f.model)
+    out["ngc_r"] = np.asarray(res_ngc.time_resids)
+    out["ngc_sigma"] = np.asarray(res_ngc.get_data_error())
 
     if not skip_b1855 and os.path.exists(B1855_PAR):
         from pint_tpu.gls_fitter import GLSFitter
@@ -112,15 +137,58 @@ def compute(skip_b1855=False, preset=None):
         out["b_delay"] = np.asarray(model.delay(toas))
         r = Residuals(toas, model)
         out["b_chi2"] = np.array([r.calc_chi2()])
+        # Woodbury inputs + logdet for the LA-isolated tier-1 check: the
+        # compare pass re-evaluates woodbury_dot on device from the
+        # REFERENCE arrays, so any deviation there is pure linear algebra
+        from pint_tpu.utils import woodbury_dot as _wd
+
+        out["b_r"] = np.asarray(r.time_resids)
+        out["b_sigma"] = np.asarray(r.get_data_error())
+        U_corr, w_corr = r._corr_basis_weight()
+        out["b_U"] = np.asarray(U_corr)
+        out["b_w"] = np.asarray(w_corr)
+        _, logdet = _wd(out["b_sigma"] ** 2, out["b_U"], out["b_w"],
+                        out["b_r"], out["b_r"])
+        out["b_logdet"] = np.array([float(logdet)])
+        if preset is not None and "b_sigma" in preset:
+            import jax as _jax
+            import jax.numpy as _jnp
+
+            la_dot, la_logdet = _jax.jit(_wd)(
+                _jnp.asarray(preset["b_sigma"] ** 2),
+                _jnp.asarray(preset["b_U"]), _jnp.asarray(preset["b_w"]),
+                _jnp.asarray(preset["b_r"]), _jnp.asarray(preset["b_r"]))
+            out["b_la_chi2"] = np.array([float(la_dot)])
+            out["b_la_logdet"] = np.array([float(la_logdet)])
+        elif preset is not None:
+            # stale (pre-round-5) reference without the Woodbury-input
+            # dumps: skip the LA row and let compare()'s key-set check
+            # report the mismatch instead of crashing with no JSON
+            pass
+        else:
+            # self-referential on the reference pass: deviations are zero
+            out["b_la_chi2"] = np.array([float(out["b_chi2"][0])])
+            out["b_la_logdet"] = np.array([float(out["b_logdet"][0])])
         # one GLS linearized SOLVE (designmatrix + Woodbury normal
         # equations), compared as the step vector: evaluating chi2 AT the
         # stepped point is NaN on real TOAs (the step drives SINI
         # nonphysical under the analytic ephemeris), but the solve itself
         # is finite and deterministic
         from pint_tpu.fitter import GLSState
+        from pint_tpu.gls_fitter import build_augmented_system
 
         f = GLSFitter(toas, model)
         out["b_gls_step"] = np.asarray(GLSState(f).step)
+        # the REF system (dumped by the CPU pass) lets compare() turn a
+        # residual-vector deviation into a first-order step envelope by
+        # re-solving the same normal equations against dr
+        M_aug, params_aug, norm_aug, phiinv_aug, Nvec_aug, _ = \
+            build_augmented_system(model, toas)
+        out["b_sys_M"] = np.asarray(M_aug)
+        out["b_sys_norm"] = np.asarray(norm_aug)
+        out["b_sys_phiinv"] = np.asarray(phiinv_aug)
+        out["b_sys_Nvec"] = np.asarray(Nvec_aug)
+        out["b_sys_ntm"] = np.array([len(params_aug)])
         # the HEADLINE chunked grid executable itself, on a 2x2 M2 x SINI
         # patch (same kernel/cache entry the bench uses: cheap in-window).
         # Grid around the PAR-FILE values on a PRISTINE model: a real-TOA
@@ -152,6 +220,18 @@ def compute(skip_b1855=False, preset=None):
     return out
 
 
+#: margin multiplying the rigorous first-order envelopes: covers the
+#: second-order dependence of sigma / designmatrix / covariance on the
+#: deviating residuals
+ENVELOPE_MARGIN = 4.0
+
+
+def _q_norm(got, ref, tag):
+    """q = ||(r_got - r_ref)/sigma_ref||_2, the whitened residual deviation."""
+    dr = np.asarray(got[f"{tag}_r"]) - np.asarray(ref[f"{tag}_r"])
+    return float(np.linalg.norm(dr / np.asarray(ref[f"{tag}_sigma"])))
+
+
 def compare(got, ref):
     """Measured deviations + pass/fail per DESIGN.md bound.
 
@@ -161,12 +241,16 @@ def compare(got, ref):
     """
     res = {"checks": {}, "ok": True}
 
-    def add(name, value, bound):
-        ok = bool(value <= bound)
-        res["checks"][name] = {"value": float(value), "bound": bound, "ok": ok}
+    def add(name, value, bound, **extra):
+        ok = bool(np.isfinite(value)) and bool(value <= bound)
+        row = {"value": float(value), "bound": bound, "ok": ok}
+        row.update(extra)
+        res["checks"][name] = row
         res["ok"] = res["ok"] and ok
 
     if set(got) != set(ref):
+        # record the mismatch as a failure but keep comparing whatever
+        # keys both sides carry (a partial report beats none)
         res["ok"] = False
         res["checks"]["key_mismatch"] = {
             "only_got": sorted(set(got) - set(ref)),
@@ -182,20 +266,58 @@ def compare(got, ref):
         add(f"{tag}_delay_s",
             float(np.max(np.abs(got[f"{tag}_delay"] - ref[f"{tag}_delay"]))),
             BOUND_DELAY_S)
-    for gk in ("ngc_grid_chi2", "b_grid_chi2"):
-        if gk in got and gk in ref:
-            rel = np.max(np.abs(got[gk] - ref[gk])
-                         / np.maximum(np.abs(ref[gk]), 1.0))
-            add(f"{gk}_rel", float(rel), BOUND_CHI2_REL)
-    if "b_chi2" in got and "b_chi2" in ref:
-        rel = abs(got["b_chi2"][0] - ref["b_chi2"][0]) \
-            / max(abs(ref["b_chi2"][0]), 1.0)
-        add("b_chi2_rel", float(rel), BOUND_CHI2_REL)
-    if "b_gls_step" in got and "b_gls_step" in ref:
+
+    # -- tier 1: LA-isolated Woodbury kernel (identical inputs) ------------
+    if "b_la_chi2" in got and "b_la_chi2" in ref:
+        add("b_la_chi2_rel",
+            abs(got["b_la_chi2"][0] - ref["b_chi2"][0])
+            / max(abs(ref["b_chi2"][0]), 1.0), BOUND_LA_REL)
+        add("b_la_logdet_rel",
+            abs(got["b_la_logdet"][0] - ref["b_logdet"][0])
+            / max(abs(ref["b_logdet"][0]), 1.0), BOUND_LA_REL)
+
+    # -- tier 2: explained-deviation ratios (bound 1.0) --------------------
+    # |dchi2| <= 2 sqrt(chi2) q + q^2 (Cauchy-Schwarz, fixed covariance);
+    # value = measured / (MARGIN * envelope + 1e-9 * scale) must be <= 1
+    for tag, gk in (("ngc", "ngc_grid_chi2"), ("b", "b_grid_chi2")):
+        if gk not in ref or gk not in got \
+                or f"{tag}_r" not in ref or f"{tag}_r" not in got:
+            continue
+        q = _q_norm(got, ref, tag)
+        cg, cr = np.asarray(got[gk]), np.asarray(ref[gk])
+        envelope = 2.0 * np.sqrt(np.maximum(cr, 0.0)) * q + q * q
+        denom = ENVELOPE_MARGIN * envelope + BOUND_LA_REL * np.abs(cr) + 1e-30
+        ratio = float(np.max(np.abs(cg - cr) / denom))
+        add(f"{gk}_explained", ratio, 1.0, q=q,
+            raw_rel=float(np.max(np.abs(cg - cr)
+                                 / np.maximum(np.abs(cr), 1.0))))
+    if all(k in d for d in (got, ref) for k in ("b_chi2", "b_r")):
+        q = _q_norm(got, ref, "b")
+        c = abs(float(ref["b_chi2"][0]))
+        envelope = 2.0 * np.sqrt(c) * q + q * q
+        d = abs(float(got["b_chi2"][0]) - float(ref["b_chi2"][0]))
+        add("b_chi2_explained",
+            d / (ENVELOPE_MARGIN * envelope + BOUND_LA_REL * c + 1e-30),
+            1.0, q=q, raw_rel=d / max(c, 1.0))
+    if all(k in d for d in (got, ref)
+           for k in ("b_gls_step",)) and "b_sys_M" in ref:
+        # first-order step perturbation from the REF normal equations:
+        # dstep = (M^T C^-1 M + phiinv)^-1 M^T C^-1 dr, timing block only
+        M = np.asarray(ref["b_sys_M"])
+        cinv = 1.0 / np.asarray(ref["b_sys_Nvec"])
+        phiinv = np.asarray(ref["b_sys_phiinv"])
+        norm = np.asarray(ref["b_sys_norm"])
+        ntm = int(ref["b_sys_ntm"][0])
+        dr = np.asarray(got["b_r"]) - np.asarray(ref["b_r"])
+        mtcm = M.T @ (cinv[:, None] * M) + np.diag(phiinv)
+        dstep = np.linalg.solve(mtcm, M.T @ (cinv * dr)) / norm
         scale = max(float(np.max(np.abs(ref["b_gls_step"]))), 1e-300)
-        rel = float(np.max(np.abs(got["b_gls_step"] - ref["b_gls_step"]))
-                    / scale)
-        add("b_gls_step_rel", rel, BOUND_CHI2_REL)
+        meas = float(np.max(np.abs(got["b_gls_step"] - ref["b_gls_step"])))
+        envelope = float(np.max(np.abs(dstep[:ntm])))
+        add("b_gls_step_explained",
+            meas / (ENVELOPE_MARGIN * envelope + BOUND_LA_REL * scale
+                    + 1e-30),
+            1.0, raw_rel=meas / scale)
     return res
 
 
