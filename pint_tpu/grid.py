@@ -290,9 +290,28 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     return fn, free_init, fit_params
 
 
+def default_gls_chunk() -> int:
+    """Backend-aware default batch size for the chunked GLS grid executable.
+
+    Measured round 5 on a real v5e (tools/tpu_sweep.py, B1855 256-point
+    grid): chunk 64 -> 90.0-93.2 fits/s vs chunk 128 -> 86.0-88.1, and
+    chunk >= 256 does not compile at all (XLA scoped-vmem OOM, 23.5 MB >
+    16 MB in the kernel's vmapped scatter).  On CPU the r4/r5 sweeps put
+    64 and 128 within load noise of each other, with 128 favored when
+    isolated — so: 64 on TPU, 128 elsewhere.
+    """
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "cpu"
+    return 64 if platform in ("tpu", "axon") else 128
+
+
 def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                            fit_params: Optional[Sequence[str]] = None,
-                           niter: int = 4, chunk: int = 128,
+                           niter: int = 4, chunk: Optional[int] = None,
                            grid_spans: Optional[Sequence[float]] = None):
     """GLS counterpart of :func:`build_grid_chi2_fn` for correlated-noise
     models (reference benchmark ``profiling/bench_chisq_grid.py`` semantics:
@@ -304,14 +323,12 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     Cholesky, then the final chi2 is ``r^T C^-1 r`` with
     ``C = diag(N) + U phi U^T`` (reference ``residuals.py:584`` →
     ``utils.py:3069``).  Points are processed in fixed-size chunks so one
-    compiled executable covers any grid size with bounded memory.  The
-    default 128 was chosen from an r4 sweep (32/64/128/256): on an
-    otherwise-idle CPU, full-bench numbers at 32 and 128 agree within
-    machine-load noise (~18.5 fits/s) while the isolated sweep favored
-    128 (~1.7x); bigger batches amortize per-chunk dispatch and can only
-    help more on the TPU, and smaller grids just pad — a fixed cost the
-    one-executable design accepts.
+    compiled executable covers any grid size with bounded memory; the
+    default is backend-aware (:func:`default_gls_chunk`: 64 on TPU, 128
+    on CPU, from the round-4/round-5 measured sweeps).
     """
+    if chunk is None:
+        chunk = default_gls_chunk()
     grid_params = tuple(grid_params)
     if fit_params is None:
         fit_params = tuple(p for p in model.free_params if p not in grid_params)
@@ -416,7 +433,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     # guarantees positive definiteness.  Absorbed directions get
     # Levenberg-damped toward the initial values — the final chi2 is
     # computed independently of step quality either way.
-    _TPU = jax.default_backend() == "tpu"
+    _TPU = jax.default_backend() in ("tpu", "axon")
     _RIDGE = 1e-9 if _TPU else 1e-12
 
     grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk,
@@ -557,7 +574,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     process pool (warned once at runtime).  Pass ``mesh`` (a
     ``jax.sharding.Mesh`` with a 'grid' axis) to shard points across devices;
     ``chunk`` overrides the GLS path's fixed executable batch size (default
-    128; the tools/tpu_sweep.py knob).
+    backend-aware, :func:`default_gls_chunk`; the tools/tpu_sweep.py knob).
     ``extraparnames`` returns the per-point refit values of those parameters
     in the second return slot, shaped like the grid.
     """

@@ -59,6 +59,11 @@ def run_config(precision, ref):
     for name, chk in res["checks"].items():
         if name.endswith("_rel"):
             rows[name] = chk["value"]
+        elif name.endswith("_explained") and "raw_rel" in chk:
+            # the chi2/grid/step checks carry the raw measured relative
+            # deviation as metadata — that raw number (not the envelope
+            # ratio) is what a matmul-precision change would move
+            rows[name.replace("_explained", "_raw_rel")] = chk["raw_rel"]
     return {"precision": precision, "wall_s": round(wall, 1), "rel": rows}
 
 

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""On-TPU smoke for the sampling/noise-fitting stack the logdet NaN broke.
+
+Before the round-5 scaled-basis Woodbury fix, `lnlikelihood` (and with it
+ML noise fitting and any correlated-noise sampling) returned NaN on device
+because the 1e40 offset prior overflowed the float32-RANGE f64 emulation
+through log(phi).  This tool demonstrates the repaired path end-to-end on
+the real chip:
+
+  1. B1855 correlated-noise ML likelihood: jitted value + jax.grad at the
+     par-file noise parameters — both must be finite, and the value must
+     match the CPU evaluation to the phase-floor envelope.
+  2. A short jax-native EnsembleSampler run (NGC6440E, F0/F1, 16 walkers
+     x 25 steps) with the batched lnposterior evaluated on the TPU —
+     chain finite, acceptance in (0, 1).
+
+Prints ONE JSON line.  Tunnel lease rules apply (single TPU client).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DATADIR = "/root/reference/tests/datafile"
+B1855_PAR = f"{DATADIR}/B1855+09_NANOGrav_9yv1.gls.par"
+B1855_TIM = f"{DATADIR}/B1855+09_NANOGrav_9yv1.tim"
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+NGC_TIM = "/root/reference/src/pint/data/examples/NGC6440E.tim"
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    backend = jax.devices()[0].platform
+    print(f"# backend: {backend}", file=sys.stderr)
+    if backend not in ("tpu", "axon"):
+        print(json.dumps({"metric": "tpu_mcmc_smoke",
+                          "error": f"TPU required, backend {backend!r}"}))
+        return 1
+    import bench as _B
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache", _B.cache_key(backend))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+    import copy
+
+    import jax.numpy as jnp
+
+    from pint_tpu.models import get_model_and_toas
+    from pint_tpu.noisefit import build_noise_lnlikelihood
+    from pint_tpu.residuals import Residuals
+
+    out = {"metric": "tpu_mcmc_smoke", "platform": backend}
+
+    # -- 1. correlated-noise ML likelihood + gradient on device ------------
+    t0 = time.time()
+    model, toas = get_model_and_toas(B1855_PAR, B1855_TIM)
+    m2 = copy.deepcopy(model)
+    freed = []
+    for p in ("TNREDAMP", "TNREDGAM"):
+        if getattr(m2, p, None) is not None and getattr(m2, p).value is not None:
+            getattr(m2, p).frozen = False
+            freed.append(p)
+    lnlike, x0, free = build_noise_lnlikelihood(m2, toas)
+    r = np.asarray(Residuals(toas, model).time_resids)
+    v = float(jax.jit(lnlike)(jnp.asarray(x0), jnp.asarray(r)))
+    g = np.asarray(jax.grad(lnlike)(jnp.asarray(x0), jnp.asarray(r)))
+    out["noise_lnlike"] = v
+    out["noise_grad_norm"] = float(np.linalg.norm(g))
+    out["noise_free"] = list(free)
+    out["noise_ok"] = bool(np.isfinite(v) and np.isfinite(g).all()
+                           and len(free) > 0)
+    out["noise_s"] = round(time.time() - t0, 1)
+    print(f"# noise lnlike={v:.6g} |grad|={out['noise_grad_norm']:.3g} "
+          f"({out['noise_s']}s)", file=sys.stderr)
+
+    # -- 2. short ensemble-sampler run, batched lnposterior on device ------
+    t0 = time.time()
+    from pint_tpu.bayesian import BayesianTiming
+    from pint_tpu.sampler import EnsembleSampler
+
+    m, t = get_model_and_toas(NGC_PAR, NGC_TIM)
+    for p in m.free_params:
+        getattr(m, p).frozen = p not in ("F0", "F1")
+    from pint_tpu.models.priors import Prior, UniformBoundedRV
+
+    for p, width in (("F0", 1e-7), ("F1", 1e-15)):
+        par = getattr(m, p)
+        par.prior = Prior(UniformBoundedRV(par.value - width,
+                                           par.value + width))
+    bt = BayesianTiming(m, t)
+    rng = np.random.default_rng(42)
+    nwalkers, nsteps = 16, 25
+    x0v = np.array([m.F0.value, m.F1.value])
+    scatter = np.array([1e-9, 1e-17])
+    pos = x0v + scatter * rng.standard_normal((nwalkers, 2))
+    sampler = EnsembleSampler(nwalkers, seed=42)
+    sampler.initialize_batched(bt.lnposterior_batch, ndim=2)
+    sampler.run_mcmc(pos, nsteps)
+    chain = np.asarray(sampler.get_chain())
+    acc = float(np.mean(sampler.acceptance_fraction))
+    out["mcmc_chain_finite"] = bool(np.isfinite(chain).all())
+    out["mcmc_acceptance"] = round(acc, 3)
+    out["mcmc_ok"] = bool(out["mcmc_chain_finite"] and 0.0 < acc < 1.0)
+    out["mcmc_s"] = round(time.time() - t0, 1)
+    print(f"# mcmc acceptance={acc:.3f} ({out['mcmc_s']}s)", file=sys.stderr)
+
+    out["ok"] = bool(out["noise_ok"] and out["mcmc_ok"])
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,11 @@ for i in $(seq 1 ${BENCH_RETRY_MAX:-300}); do
     timeout 3000 python tools/tpu_chi2_isolate.py \
       > "$OUT/isolate_$i.out" 2> "$OUT/isolate_$i.err"
     iline=$(grep -h '"chi2_isolate"' "$OUT/isolate_$i.out" | tail -1)
-    if [ -n "$iline" ] && echo "$iline" | grep -Eq '"platform": "(tpu|axon)"'; then
+    # reject NaN/Infinity outright: a non-finite logdet/chi2 is exactly
+    # the failure this step exists to verify is gone (it would also be
+    # non-standard JSON), so it must NOT bank as a completed step
+    if [ -n "$iline" ] && ! echo "$iline" | grep -Eq 'NaN|Infinity' \
+        && echo "$iline" | grep -Eq '"platform": "(tpu|axon)"'; then
       echo "$iline" > "$OUT/ISOLATE.json"
       echo "$(date -u +%FT%TZ) isolate: $iline" >> "$OUT/log"
     else
@@ -84,8 +88,23 @@ for i in $(seq 1 ${BENCH_RETRY_MAX:-300}); do
     fi
   fi
 
+  # -- 5. MCMC / noise-ML smoke (the stack the logdet NaN broke) ----------
+  if [ ! -f "$OUT/MCMC.json" ]; then
+    timeout 3000 python tools/tpu_mcmc_smoke.py \
+      > "$OUT/mcmc_$i.out" 2> "$OUT/mcmc_$i.err"
+    mline=$(grep -h '"tpu_mcmc_smoke"' "$OUT/mcmc_$i.out" | tail -1)
+    if [ -n "$mline" ] && ! echo "$mline" | grep -q '"error"' \
+        && echo "$mline" | grep -Eq '"platform": "(tpu|axon)"'; then
+      echo "$mline" > "$OUT/MCMC.json"
+      echo "$(date -u +%FT%TZ) mcmc smoke: $mline" >> "$OUT/log"
+    else
+      echo "$(date -u +%FT%TZ) mcmc smoke failed: ${mline:-no JSON}" >> "$OUT/log"
+    fi
+  fi
+
   if [ -f "$OUT/ISOLATE.json" ] && [ -f "$OUT/PRECISION2.json" ] \
-      && [ -f "$OUT/BENCH2.json" ] && [ -f "$OUT/SWEEP.jsonl" ]; then
+      && [ -f "$OUT/BENCH2.json" ] && [ -f "$OUT/SWEEP.jsonl" ] \
+      && [ -f "$OUT/MCMC.json" ]; then
     echo "$(date -u +%FT%TZ) workplan complete" >> "$OUT/log"
     exit 0
   fi
