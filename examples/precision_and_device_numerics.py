@@ -1,0 +1,142 @@
+"""Precision and device numerics: how timing stays exact on emulated f64.
+
+Pulsar timing needs ~1e-15 relative precision on pulse phase (nanoseconds
+over decades).  The reference framework gets it from numpy's 80-bit
+``np.longdouble``; there is no longdouble on an accelerator, so this
+framework carries time as **double-double pairs** (``pint_tpu.dd``) and
+pulse phase as an explicit (integer, fractional) pair
+(``pint_tpu.phase.Phase``).  This walkthrough demonstrates the numerical
+model a user should have in mind, on whatever backend it runs:
+
+1. why a single f64 cannot hold an MJD epoch to timing precision,
+2. dd arithmetic recovering the lost bits,
+3. the exact-by-construction phase fold (``mul_mod1``) that stays correct
+   even on TPUs, where f64 is *emulated* with float32-range arithmetic
+   and classic double-double silently degrades (DESIGN.md),
+4. the float32-RANGE rule for on-device graphs: why the correlated-noise
+   likelihood uses the scaled-basis Woodbury form (no ``1/phi``, no
+   ``log phi``) and a 1e10 offset prior instead of enterprise's 1e40,
+5. the measured device bounds a TPU user can rely on (and how to
+   re-assert them with ``tools/tpu_precision_check.py``).
+
+Run:  python examples/precision_and_device_numerics.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    if "--cpu" in args or True:  # CPU is the precision reference; always pin
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    # -- 1. the f64 problem -------------------------------------------------
+    # An MJD like 53750.000001 carries ~5e10 seconds since MJD 0; one f64
+    # ulp at that scale is ~7.6e-6 s — four orders of magnitude too coarse
+    # for 1-ns timing.
+    mjd = 53750.000001
+    t_sec = mjd * 86400.0
+    ulp = np.spacing(t_sec)
+    print(f"epoch as one f64: {t_sec:.6f} s, ulp = {ulp:.2e} s "
+          f"(need ~1e-9 s)")
+    assert ulp > 1e-7
+
+    # -- 2. dd pairs recover the bits ---------------------------------------
+    from pint_tpu.dd import dd_from_longdouble, day2sec_exact
+
+    mjd_ld = np.longdouble("53750.000001")
+    hi, lo = dd_from_longdouble(mjd_ld * np.longdouble(86400.0))
+    err_vs_ld = float(abs((np.longdouble(hi) + np.longdouble(lo))
+                          - mjd_ld * np.longdouble(86400.0)))
+    print(f"dd pair: hi={hi!r}, lo={lo!r}; |dd - longdouble| = "
+          f"{err_vs_ld:.2e} s")
+    assert err_vs_ld < 1e-9
+    # day->second conversion as an unevaluated 2-term sum: no bits are
+    # rounded away (dd.day2sec_exact)
+    e1, e2 = day2sec_exact(jnp.asarray([53750.000001]))
+    print(f"day2sec_exact: e1={float(e1[0])!r} e2={float(e2[0])!r}")
+
+    # -- 3. the exact phase fold --------------------------------------------
+    # phase = F0 * t mod 1 is THE precision-critical product: F0 ~ 1e2 Hz,
+    # t ~ 1e9 s -> phase ~ 1e11 cycles, of which only the fractional part
+    # matters.  mul_mod1 folds each exact time component against F0
+    # separately with power-of-two splits whose dominant partial products
+    # are exactly representable, so the result does not depend on IEEE
+    # rounding semantics — the property that survives TPU's
+    # excess-precision emulated f64, where textbook two_sum compensation
+    # collapses (DESIGN.md, measured).  Only phases are combined (integer
+    # parts exact, fractions small).
+    from pint_tpu.dd import mul_mod1
+
+    F0 = 61.4854765456
+    k1, f1 = mul_mod1(F0, e1)
+    k2, f2 = mul_mod1(F0, e2)
+    f = float(f1[0] + f2[0])
+    f -= round(f)
+    # 40-digit reference via mpmath
+    import mpmath as mp
+
+    with mp.workdps(40):
+        ph = (mp.mpf(float(e1[0])) + mp.mpf(float(e2[0]))) * mp.mpf(F0)
+        frac_ref = float(ph - mp.nint(ph))
+    err_cycles = abs(f - frac_ref)
+    err_cycles = min(err_cycles, abs(1.0 - err_cycles))  # wrap distance
+    print(f"mul_mod1 fractional phase vs 40-digit mpmath: "
+          f"|d| = {err_cycles:.2e} cycles")
+    # documented fold bound ~2^-31 cycles (dd.py); TPU storage floor ~5e-5
+    assert err_cycles < 1e-8
+
+    # -- 4. the float32-RANGE rule for device graphs ------------------------
+    # TPU emulates f64 with float32-range arithmetic: values outside
+    # ~[1e-38, 3e38] flush or overflow INSIDE jitted graphs even though
+    # the same f64 computation is fine on CPU.  The correlated-noise
+    # likelihood is the canonical trap: the marginalized-offset prior is
+    # conventionally 1e40, and both log(phi) and sqrt(phi)-scaled basis
+    # columns blow past f32 range.  The framework's woodbury_dot therefore
+    # uses Sigma = I + V^T N^-1 V with V = U sqrt(phi) and the determinant
+    # lemma for logdet — no 1/phi, no log(phi) — and the offset prior is
+    # OFFSET_PRIOR_WEIGHT = 1e10 s^2 (uninformative by ~26 orders).
+    from pint_tpu.models.timing_model import OFFSET_PRIOR_WEIGHT
+    from pint_tpu.utils import woodbury_dot
+
+    rng = np.random.default_rng(0)
+    n, m = 50, 5
+    U = np.hstack([rng.standard_normal((n, m - 1)), np.ones((n, 1))])
+    sigma2 = rng.uniform(0.5, 2.0, n) * 1e-12
+    r = rng.standard_normal(n) * 1e-6
+    phi = np.array([1e-18, 1e-16, 1e-14, 1e-12, OFFSET_PRIOR_WEIGHT])
+    dot, logdet = jax.jit(woodbury_dot)(
+        jnp.asarray(sigma2), jnp.asarray(U), jnp.asarray(phi),
+        jnp.asarray(r), jnp.asarray(r))
+    print(f"woodbury chi2 = {float(dot):.3f}, logdet = {float(logdet):.3f} "
+          f"(offset prior {OFFSET_PRIOR_WEIGHT:.0e}, finite by design)")
+    assert np.isfinite(float(dot)) and np.isfinite(float(logdet))
+
+    # -- 5. what a TPU user can rely on -------------------------------------
+    print("""
+measured device bounds (v5e, re-assertable with
+  PINT_TPU_TESTS=1 pytest tests/test_tpu_precision.py
+or tools/tpu_precision_check.py --auto on a live TPU):
+  pulse integers          identical to CPU
+  fractional phase        <= 1e-4 cycles   (measured ~5e-5)
+  delay components        <= 1e-9 s
+  Woodbury chi2+logdet    <= 1e-9 relative on identical inputs
+                          (measured 7.7e-14; dots/reductions ~1e-14)
+  chi2-level quantities   deviate only by the phase floor propagated
+                          through 1/sigma^2 (explained-deviation bounds)
+""")
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,11 @@ __all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived",
 
 _warned_executor = False
 
+#: platform strings that mean "the TPU behind the tunnel" — the axon relay
+#: reports 'axon' in some environments and 'tpu' in others; chunk-size and
+#: ridge/normalization choices must agree for the same device
+_TPU_PLATFORMS = ("tpu", "axon")
+
 
 def hostinfo() -> str:
     """Host identification string for grid-run provenance (reference
@@ -295,18 +300,12 @@ def default_gls_chunk() -> int:
 
     Measured round 5 on a real v5e (tools/tpu_sweep.py, B1855 256-point
     grid): chunk 64 -> 90.0-93.2 fits/s vs chunk 128 -> 86.0-88.1, and
-    chunk >= 256 does not compile at all (XLA scoped-vmem OOM, 23.5 MB >
-    16 MB in the kernel's vmapped scatter).  On CPU the r4/r5 sweeps put
-    64 and 128 within load noise of each other, with 128 favored when
-    isolated — so: 64 on TPU, 128 elsewhere.
+    chunk >= 256 did not compile at all before the no-materialized-B
+    rewrite (XLA scoped-vmem OOM in the kernel's vmapped scatter).  On
+    CPU the r4/r5 sweeps put 64 and 128 within load noise of each other,
+    with 128 favored when isolated — so: 64 on TPU, 128 elsewhere.
     """
-    import jax
-
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:
-        platform = "cpu"
-    return 64 if platform in ("tpu", "axon") else 128
+    return 64 if jax.default_backend() in _TPU_PLATFORMS else 128
 
 
 def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
@@ -433,7 +432,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     # guarantees positive definiteness.  Absorbed directions get
     # Levenberg-damped toward the initial values — the final chi2 is
     # computed independently of step quality either way.
-    _TPU = jax.default_backend() in ("tpu", "axon")
+    _TPU = jax.default_backend() in _TPU_PLATFORMS
     _RIDGE = 1e-9 if _TPU else 1e-12
 
     grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk,
@@ -473,18 +472,28 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                     Jnl = jax.jacfwd(frac_of)(v[nl_idx])
                     # same unit-W-norm column scale as the hoisted bases
                     M_nl = (-Jnl / F0) / s_col[nlp_idx]  # (n, k)
-                    B = B_base.at[:, nlp_idx].set(M_nl)
+                    # The per-point design matrix B = B_base with columns
+                    # nlp_idx <- M_nl is NEVER materialized: under vmap
+                    # that .set was a (chunk, n, nt) scatter — the kernel's
+                    # scoped-vmem ceiling on v5e (chunk >= 256 OOMed) and a
+                    # full per-point copy of the mostly-constant basis.  B
+                    # only ever appears as B^T @ x, which equals
+                    # B_base^T @ x with the k rows at nlp_idx replaced by
+                    # M_nl^T @ x — an O(nt*k) fix-up, and B_base stays a
+                    # broadcast constant the batched matmul can share.
+                    wM = w[:, None] * M_nl
+                    A_cols = (B_base.T @ wM).at[nlp_idx, :].set(M_nl.T @ wM)
                     # refresh the nl rows/cols of the Gram blocks: the
                     # (nl, nl) sub-block is written consistently twice
-                    A_cols = B.T @ (w[:, None] * M_nl)  # (nt, k)
                     A = A_base.at[:, nlp_idx].set(A_cols)
                     A = A.at[nlp_idx, :].set(A_cols.T)
                     C_rows = M_nl.T @ U_w  # (k, nu)
                     Y_cols = jsl.solve_triangular(L_D, C_rows.T, lower=True)
                     Y = Y_base.at[:, nlp_idx].set(Y_cols)
+                    b_t = (B_base.T @ wr).at[nlp_idx].set(M_nl.T @ wr)
                 else:
-                    B, A, Y = B_base, A_base, Y_base
-                b_t = B.T @ wr
+                    A, Y = A_base, Y_base
+                    b_t = B_base.T @ wr
                 b_u = U_w.T @ r
                 z_u = jsl.solve_triangular(L_D, b_u, lower=True)
                 Ar = A - Y.T @ Y

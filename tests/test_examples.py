@@ -174,3 +174,9 @@ class TestExamples:
         out = _run("frames_and_proper_motion.py", capsys=capsys)
         assert "equatorial vs ecliptic residual agreement" in out
         assert "change_posepoch" in out
+
+    def test_precision_numerics_walkthrough(self, capsys):
+        out = _run("precision_and_device_numerics.py", capsys=capsys)
+        assert "mul_mod1 fractional phase vs 40-digit mpmath" in out
+        assert "finite by design" in out
+        assert "done" in out

