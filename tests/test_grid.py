@@ -500,3 +500,72 @@ class TestChunkSizes:
             np.testing.assert_allclose(np.asarray(chi2), np.asarray(ref),
                                        rtol=1e-8, atol=1e-7,
                                        err_msg=f"chunk={chunk}")
+
+
+class TestKernelMemoryShape:
+    def test_no_per_point_design_matrix_scatter(self, tmp_path):
+        """The GLS grid kernel must never materialize the per-point design
+        matrix: under vmap that is a (chunk, n_toa, n_cols) scatter, which
+        was the v5e scoped-vmem compile ceiling (round 5; DESIGN.md
+        'no-materialized-B').  Lower the kernel via an XLA dump in a
+        subprocess and assert no scatter shape carries the TOA dimension
+        (the remaining fix-up scatters are nt x chunk x k — TOA-free)."""
+        import os
+        import re
+        import subprocess
+        import sys
+
+        if not os.path.exists(NGC_PAR):
+            pytest.skip("reference example par unavailable")
+        REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ntoa = 53  # prime and distinctive: no other kernel dim equals it
+        script = f"""
+import sys; sys.path.insert(0, {repr(REPO)})
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from pint_tpu.models import get_model
+from pint_tpu.io.par import parse_parfile
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.gls_fitter import GLSFitter
+from pint_tpu.grid import grid_chisq
+text = open({repr(NGC_PAR)}).read()
+m = get_model(parse_parfile(text + "\\nBINARY BT\\nPB 10.0 1\\nA1 5.0 1\\n"
+    "T0 53500.0 1\\nECC 0.01 1\\nOM 10.0 1\\nEFAC mjd 52000 60000 1.2 1\\n"
+    "ECORR mjd 52000 60000 2.0 1\\nTNREDAMP -13\\nTNREDGAM 3.0\\nTNREDC 5\\n"))
+t = make_fake_toas_uniform(53000, 54800, {ntoa}, m, error_us=2.0,
+                           add_noise=True, rng=np.random.default_rng(5))
+f = GLSFitter(t, m)
+f.fit_toas(maxiter=1)
+g0 = np.linspace(m.PB.value - 1e-6, m.PB.value + 1e-6, 2)
+g1 = np.linspace(m.ECC.value * 0.99, m.ECC.value * 1.01, 2)
+grid_chisq(f, ("PB", "ECC"), (g0, g1), niter=2, chunk=4)
+"""
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_dump_to={tmp_path}"
+        env.pop("JAX_PLATFORMS", None)
+        subprocess.run([sys.executable, "-c", script], check=True, env=env,
+                       cwd=REPO, timeout=500)
+        dumps = [p for p in os.listdir(tmp_path)
+                 if "chi2_point" in p and p.endswith("after_optimizations.txt")]
+        assert dumps, f"no chi2_point HLO dump in {tmp_path}"
+        bad, n_scatter_shapes = [], 0
+        for p in dumps:
+            with open(os.path.join(tmp_path, p)) as fh:
+                for line in fh:
+                    # scatter result lines: "%name = <shapes> scatter(...)".
+                    # Shapes may be variadic tuples and any dtype, so
+                    # collect EVERY bracketed dims list on the line.
+                    if "scatter(" not in line:
+                        continue
+                    for shape in re.findall(r"\[([0-9,]+)\]", line):
+                        dims = [int(d) for d in shape.split(",")]
+                        n_scatter_shapes += 1
+                        if ntoa in dims:
+                            bad.append((p, line.strip()[:160]))
+        # positive control: the kernel's legitimate TOA-free fix-up
+        # scatters (A/Y/b_t row-column refreshes) must be visible — zero
+        # matches means the scan regex or dump naming broke, and the
+        # assertion below would pass vacuously
+        assert n_scatter_shapes > 0, \
+            "no scatter shapes matched; the HLO scan is no longer seeing ops"
+        assert not bad, f"TOA-dimension scatter reappeared: {bad[:3]}"
