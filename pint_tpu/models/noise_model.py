@@ -104,10 +104,22 @@ def fourier_design_matrix(t_s: np.ndarray, f: np.ndarray) -> np.ndarray:
     return F
 
 
+def _powerlaw_psd(f, A, gamma):
+    """Factored power-law PSD, dtype-generic: ``f``/``A``/``gamma`` may be
+    numpy values or jax tracers.  The ``fyr^-3 (f/fyr)^-gamma`` form is
+    algebraically identical to ``fyr^(gamma-3) f^-gamma`` but has no
+    ~1e44 ``f**-gamma`` intermediate, so it survives float32-RANGE
+    arithmetic (TPU f64 emulation); the single source of truth shared by
+    the host path below and the traced builder in ``noisefit.py``
+    (regression: TestPowerlawRangeSafety evaluates it at true f32)."""
+    x = f / FYR
+    return A**2 / 12.0 / np.pi**2 * FYR ** (-3.0) * x ** (-gamma)
+
+
 def powerlaw(f: np.ndarray, A: float, gamma: float) -> np.ndarray:
     """Power-law PSD in the enterprise/GW convention (reference
     ``noise_model.py:1330``): P(f) = A^2/(12 pi^2) fyr^(gamma-3) f^-gamma."""
-    return A**2 / 12.0 / np.pi**2 * FYR ** (gamma - 3) * np.asarray(f, float) ** (-gamma)
+    return _powerlaw_psd(np.asarray(f, float), A, gamma)
 
 
 def _tdb_seconds(toas) -> np.ndarray:

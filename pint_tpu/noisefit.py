@@ -150,9 +150,13 @@ def _corr_weight_builders(model, toas):
                 else:
                     amp = 10.0 ** getv(x, amp_p)
                     gam = getv(x, gam_p)
-                psd = (amp**2 / 12.0 / np.pi**2 * FYR ** (gam - 3.0)
-                       * f_rep ** (-gam))
-                return psd * df_rep
+                # _powerlaw_psd's factored form, NOT FYR^(gam-3) f^-gam:
+                # f^-gam alone is ~1e44 at f ~ 1/span and gam ~ 5, past the
+                # float32 RANGE of TPU f64 emulation (~3.4e38) — it landed
+                # as inf and NaNed the on-device ML noise fit
+                from pint_tpu.models.noise_model import _powerlaw_psd
+
+                return _powerlaw_psd(f_rep, amp, gam) * df_rep
 
             builders.append(w_pl)
         else:  # pragma: no cover - future correlated components

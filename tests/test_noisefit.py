@@ -148,6 +148,64 @@ class TestLnlikePropertySweep:
         sweep()
 
 
+class TestPowerlawRangeSafety:
+    """The traced power-law phi builder must keep every intermediate
+    within float32 RANGE: TPU f64 emulation stores a float64 as a
+    float32 pair, so anything past ~3.4e38 lands on device as inf.
+    Measured on a v5e (round 5): the naive ``FYR**(gam-3) * f**(-gam)``
+    form hits ~1e44 at f ~ 1/span, gam ~ 5 — inf — and NaN-poisoned the
+    on-device ML noise fit and its gradient.  The builder therefore
+    factors the law as ``FYR**-3 * (f/FYR)**-gam`` (algebraically
+    identical, intermediates <= ~1e23).  Evaluating the builder in TRUE
+    float32 distinguishes the forms on CPU: the naive one overflows,
+    the factored one must not."""
+
+    def _builder_and_x(self):
+        from pint_tpu.noisefit import _corr_weight_builders, _value_getter
+
+        m = _model_with_lines(["TNREDAMP -13.5 1", "TNREDGAM 4.9 1",
+                               "TNREDC 30"])
+        t = _sim(m, np.linspace(53005, 54795, 80), seed=31)
+        builders = _corr_weight_builders(m, t)
+        assert len(builders) == 1
+        getv = _value_getter(m, ["TNREDAMP", "TNREDGAM"])
+        return m, t, builders[0], getv, np.array([-13.5, 4.9])
+
+    def test_naive_form_would_overflow_f32(self):
+        """Guard that the scenario is actually discriminating: at this
+        span and gamma the un-factored power overflows float32."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.models.noise_model import _PLNoiseBase
+
+        m, t, _, _, _ = self._builder_and_x()
+        c = next(c for c in m.noise_components
+                 if isinstance(c, _PLNoiseBase))
+        _, f = c.get_time_frequencies(t)
+        with jax.enable_x64(False):
+            naive = jnp.asarray(f) ** jnp.float32(-4.9)
+        assert not np.all(np.isfinite(np.asarray(naive)))
+
+    def test_phi_builder_finite_in_f32(self):
+        import jax
+        import jax.numpy as jnp
+
+        m, t, w_pl, getv, x = self._builder_and_x()
+        phi64 = np.asarray(w_pl(jnp.asarray(x), getv))
+        assert np.all(np.isfinite(phi64)) and np.all(phi64 > 0)
+        with jax.enable_x64(False):
+            # closures rebuilt under f32 so every array and op in the
+            # builder runs at float32 range, as on the TPU
+            from pint_tpu.noisefit import _corr_weight_builders
+
+            w32 = _corr_weight_builders(m, t)[0]
+            phi32 = np.asarray(w32(jnp.asarray(x, dtype=jnp.float32), getv))
+        assert phi32.dtype == np.float32
+        assert np.all(np.isfinite(phi32))
+        np.testing.assert_allclose(phi32, phi64, rtol=2e-3)
+
+
 class TestRecovery:
     def test_efac_equad_recovery(self):
         from pint_tpu.noisefit import fit_noise_ml
