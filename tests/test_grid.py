@@ -246,6 +246,61 @@ class TestGridExecutableReuse:
         # the fixture is module-scoped: restore the mutated noise param
         f.model.EFAC1.value = efac_save
 
+    def test_hoisted_bundle_cache_hit_and_invalidation(self, gls_fit):
+        """The hoisted per-grid constants (Gram blocks, Woodbury Cholesky,
+        column scales) are cached by parameter values + TOAs version so
+        repeated calls skip the host rebuild and device transfers; an
+        identical call must reuse the bundle verbatim, and a TOAs-version
+        bump must rebuild it."""
+        from pint_tpu.grid import grid_chisq
+
+        f = gls_fit
+        dF0 = 3 * f.errors.get("F0", 1e-10)
+        g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 3)
+        g1 = np.array([f.model.F1.value])
+        c1, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=4)
+        slot1 = f.model._cache["grid_gls_bundle"]
+        c2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=4)
+        assert f.model._cache["grid_gls_bundle"] is slot1  # bundle reused
+        np.testing.assert_array_equal(c1, c2)
+        nclass0 = sum(1 for k in f.model._cache
+                      if isinstance(k, tuple) and k[0] == "grid_classify")
+        f.toas._version += 1  # any in-place TOA mutation bumps this
+        c3, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=4)
+        assert f.model._cache["grid_gls_bundle"] is not slot1  # rebuilt
+        # the Jacobian probe must also rerun on the post-mutation TOAs
+        # (the classify cache keys on _version, not just the object)
+        nclass1 = sum(1 for k in f.model._cache
+                      if isinstance(k, tuple) and k[0] == "grid_classify")
+        assert nclass1 == nclass0 + 1
+        np.testing.assert_array_equal(c1, c3)
+        f.toas._version -= 1  # module-scoped fixture: restore
+
+    def test_bundle_not_shared_across_toas_objects(self, gls_fit):
+        """Two TOAs objects of equal length and version are different
+        data: a model used against both (two fitters sharing the model)
+        must not serve the first object's hoisted bundle — weights, noise
+        bases, and the phase zero-point all belong to the object."""
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        f = gls_fit
+        base = np.linspace(55000, 56000, 25)
+        mjds = np.sort(np.concatenate([base, base + 0.5 / 86400.0]))
+        toas_b = make_fake_toas_fromMJDs(
+            mjds, f.model, error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(99))  # different noise draw
+        fb = GLSFitter(toas_b, f.model)
+        dF0 = 3 * f.errors.get("F0", 1e-10)
+        g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 3)
+        g1 = np.array([f.model.F1.value])
+        grid_chisq(f, ("F0", "F1"), (g0, g1), niter=4)  # seeds A's bundle
+        cb, _ = grid_chisq(fb, ("F0", "F1"), (g0, g1), niter=4)
+        del f.model._cache["grid_gls_bundle"]
+        cb_fresh, _ = grid_chisq(fb, ("F0", "F1"), (g0, g1), niter=4)
+        np.testing.assert_array_equal(cb, cb_fresh)
+
 
 class TestLinearColumnClassification:
     def test_probe_scale_keeps_linear_columns_linear(self, gls_fit):
