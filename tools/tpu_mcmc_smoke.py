@@ -10,9 +10,14 @@ the real chip:
   1. B1855 correlated-noise ML likelihood: jitted value + jax.grad at the
      par-file noise parameters — both must be finite, and the value must
      match the CPU evaluation to the phase-floor envelope.
+  1b. Wideband joint (time + DM) likelihood on the real 12.5-yr wb
+     dataset with DMEFAC + RNAMP/RNIDX free (the tempo1-convention
+     branch of the traced power law) — value + gradient finite.
   2. A short jax-native EnsembleSampler run (NGC6440E, F0/F1, 16 walkers
      x 25 steps) with the batched lnposterior evaluated on the TPU —
      chain finite, acceptance in (0, 1).
+
+``ok`` (and the exit status) requires all three legs.
 
 Prints ONE JSON line.  Tunnel lease rules apply (single TPU client).
 """
@@ -86,6 +91,49 @@ def main():
     print(f"# noise lnlike={v:.6g} |grad|={out['noise_grad_norm']:.3g} "
           f"({out['noise_s']}s)", file=sys.stderr)
 
+    # -- 1b. wideband joint likelihood (time + DM) on device ---------------
+    # the last likelihood variant without hardware evidence: the real
+    # 12.5-yr wideband dataset through build_noise_lnlikelihood(wideband)
+    t0 = time.time()
+    try:
+        from pint_tpu.wideband import WidebandTOAResiduals
+
+        mw, tw = get_model_and_toas(
+            f"{DATADIR}/B1855+09_NANOGrav_12yv3.wb.gls.par",
+            f"{DATADIR}/B1855+09_NANOGrav_12yv3.wb.tim")
+        mw2 = copy.deepcopy(mw)
+        # the 12yv3 par spells red noise in the tempo1 RNAMP/RNIDX
+        # convention — freeing those drives w_pl's use_rn branch of the
+        # traced power law on device
+        for p in ("TNREDAMP", "TNREDGAM", "RNAMP", "RNIDX"):
+            if getattr(mw2, p, None) is not None \
+                    and getattr(mw2, p).value is not None:
+                getattr(mw2, p).frozen = False
+        for p in mw2.params:
+            if p.startswith("DMEFAC") and getattr(mw2, p).value is not None:
+                getattr(mw2, p).frozen = False
+                break
+        lnl_wb, xw0, wfree = build_noise_lnlikelihood(mw2, tw, wideband=True)
+        res = WidebandTOAResiduals(tw, mw)
+        rt = np.asarray(res.toa.time_resids)
+        rdm = np.asarray(res.dm.resids)
+        vw = float(jax.jit(lnl_wb)(jnp.asarray(xw0), jnp.asarray(rt),
+                                   jnp.asarray(rdm)))
+        gw = np.asarray(jax.grad(lnl_wb)(jnp.asarray(xw0),
+                                         jnp.asarray(rt),
+                                         jnp.asarray(rdm)))
+        out["wb_lnlike"] = vw
+        out["wb_grad_norm"] = float(np.linalg.norm(gw))
+        out["wb_free"] = wfree
+        out["wb_ok"] = bool(np.isfinite(vw) and np.isfinite(gw).all()
+                            and len(wfree) > 0)
+    except Exception as e:  # never let the wb leg mask the core smoke
+        out["wb_ok"] = False
+        out["wb_error"] = f"{type(e).__name__}: {e}"
+    out["wb_s"] = round(time.time() - t0, 1)
+    print(f"# wideband lnlike={out.get('wb_lnlike')} "
+          f"({out['wb_s']}s)", file=sys.stderr)
+
     # -- 2. short ensemble-sampler run, batched lnposterior on device ------
     t0 = time.time()
     from pint_tpu.bayesian import BayesianTiming
@@ -117,7 +165,8 @@ def main():
     out["mcmc_s"] = round(time.time() - t0, 1)
     print(f"# mcmc acceptance={acc:.3f} ({out['mcmc_s']}s)", file=sys.stderr)
 
-    out["ok"] = bool(out["noise_ok"] and out["mcmc_ok"])
+    out["ok"] = bool(out["noise_ok"] and out["mcmc_ok"]
+                     and out.get("wb_ok", False))
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 
