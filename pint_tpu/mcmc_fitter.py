@@ -108,11 +108,33 @@ class MCMCFitter(Fitter):
     def __init__(self, toas, model, sampler: Optional[MCMCSampler] = None,
                  prior_info: Optional[dict] = None,
                  use_pulse_numbers: bool = False, nwalkers: int = 32,
-                 errfact: float = 0.1, **kw):
+                 errfact: float = 0.1, resids: bool = True,
+                 lnprior=None, lnlike=None, setpriors=None,
+                 weights=None, phs=None, phserr=None,
+                 minMJD: float = 40000.0, maxMJD: float = 60000.0, **kw):
+        if not resids:
+            raise TypeError(
+                "resids=False selects the reference's photon-template mode; "
+                "use MCMCFitterBinnedTemplate / MCMCFitterAnalyticTemplate "
+                "(pint_tpu.event_fitter) for that")
         super().__init__(toas, model, **kw)
         self.method = "MCMC"
         self.sampler = sampler or EnsembleSampler(nwalkers)
         self.errfact = errfact
+        # reference kwarg surface (mcmc_fitter.py:139-158): custom
+        # lnprior/lnlike callables with signature (fitter, theta) switch
+        # sampling onto a scalar python path exactly like the reference's;
+        # with the defaults the fast batched BayesianTiming posterior runs
+        self.use_resids = True
+        self._custom_post = lnprior is not None or lnlike is not None
+        self.lnprior = lnprior if lnprior is not None else lnprior_basic
+        self.lnlikelihood = (lnlike if lnlike is not None
+                             else lnlikelihood_chi2)
+        self.set_priors = setpriors if setpriors is not None \
+            else set_priors_basic
+        self.weights = weights
+        self.phs, self.phserr = phs, phserr
+        self.minMJD, self.maxMJD = minMJD, maxMJD
         # constructor priors install on the LIVE model once, so every
         # (re)build of the BayesianTiming below sees them; BayesianTiming
         # validates priors at construction, so it is built lazily to allow
@@ -158,23 +180,47 @@ class MCMCFitter(Fitter):
                          for p in self.fitkeys])
 
     def lnposterior(self, theta) -> float:
+        if self._custom_post:
+            lp = self.lnprior(self, theta)
+            if not np.isfinite(lp):
+                return -np.inf
+            return lp + self.lnlikelihood(self, theta)
         return self.bt.lnposterior(theta)
 
     def fit_toas(self, maxiter: int = 100, pos=None, seed: Optional[int] = None,
                  burn_frac: float = 0.25, **kw) -> float:
         """Run the ensemble for *maxiter* steps; model is set to the
         maximum-posterior sample and chi2 at that point is returned."""
-        self.sampler.initialize_batched(self.bt.lnposterior_batch,
-                                        self.n_fit_params) \
-            if isinstance(self.sampler, EnsembleSampler) else \
-            self.sampler.initialize_sampler(self.bt.lnposterior,
-                                            self.n_fit_params)
+        if self._custom_post:
+            # the bt property resyncs fitkeys/n_fit_params when the free
+            # set changed since construction; the default branch touches
+            # it via lnposterior_batch, this one must do so explicitly
+            _ = self.bt
+            # reference-style scalar posterior around the user callables
+            # (single definition: lnposterior carries the custom branch)
+            def post_batch(thetas):
+                return np.array([self.lnposterior(t)
+                                 for t in np.asarray(thetas)])
+
+            if isinstance(self.sampler, EnsembleSampler):
+                self.sampler.initialize_batched(post_batch,
+                                                self.n_fit_params)
+            else:
+                self.sampler.initialize_sampler(self.lnposterior,
+                                                self.n_fit_params)
+        else:
+            post_batch = self.bt.lnposterior_batch
+            self.sampler.initialize_batched(post_batch,
+                                            self.n_fit_params) \
+                if isinstance(self.sampler, EnsembleSampler) else \
+                self.sampler.initialize_sampler(self.bt.lnposterior,
+                                                self.n_fit_params)
         if pos is None:
             pos = self.sampler.get_initial_pos(
                 self.fitkeys, self.get_fitvals(), self.get_fiterrs(),
                 self.errfact, seed=seed)
             # clip the initial ball inside the prior support
-            lp = self.bt.lnposterior_batch(pos)
+            lp = post_batch(pos)
             bad = ~np.isfinite(lp)
             if bad.any():
                 pos[bad] = self.get_fitvals()

@@ -247,6 +247,65 @@ class TestMCMCFitter:
         assert "F0" in f.get_fit_summary()
 
 
+class TestReferenceKwargSurface:
+    """The reference's profiling/bench_MCMC.py constructs
+    ``MCMCFitter(t, m, sampler, resids=True, phs=0.5, phserr=0.01,
+    lnlike=lnlikelihood_chi2)`` — that exact signature must work, with
+    custom (fitter, theta) callables sampled through the reference-style
+    scalar path."""
+
+    def test_reference_constructor_and_custom_lnlike(self, data):
+        from pint_tpu import mcmc_fitter
+        from pint_tpu.sampler import EnsembleSampler
+
+        m, t = data
+        import copy
+
+        m2 = copy.deepcopy(m)
+        f = mcmc_fitter.MCMCFitter(
+            t, m2, EnsembleSampler(8), resids=True, phs=0.50, phserr=0.01,
+            prior_info=_prior_info(m2),
+            lnlike=mcmc_fitter.lnlikelihood_chi2)
+        assert f.phs == 0.50 and f.use_resids
+        chi2 = f.fit_toas(8, seed=2)
+        assert np.isfinite(chi2) and np.isfinite(f.maxpost)
+        # the custom scalar posterior must agree with lnprior + lnlike
+        th = f.get_fitvals()
+        want = (mcmc_fitter.lnprior_basic(f, th)
+                + mcmc_fitter.lnlikelihood_chi2(f, th))
+        assert f.lnposterior(th) == pytest.approx(want, rel=1e-12)
+
+    def test_custom_path_resyncs_after_freeing_param(self, data):
+        """Changing the free-parameter set between construction and
+        fit_toas must resync fitkeys/n_fit_params on the custom-callable
+        path too (the default path resyncs via the bt property)."""
+        from pint_tpu import mcmc_fitter
+        from pint_tpu.sampler import EnsembleSampler
+
+        m, t = data
+        import copy
+
+        m2 = copy.deepcopy(m)
+        f = mcmc_fitter.MCMCFitter(
+            t, m2, EnsembleSampler(8), prior_info=_prior_info(m2),
+            lnlike=mcmc_fitter.lnlikelihood_chi2)
+        n0 = f.n_fit_params
+        # the fitter deep-copies the model: mutate ITS copy
+        f.model.DM.frozen = True  # shrink the free set after construction
+        chi2 = f.fit_toas(6, seed=3)
+        assert np.isfinite(chi2)
+        assert f.n_fit_params == n0 - 1
+        assert f.sampler.get_chain().shape[-1] == n0 - 1
+
+    def test_resids_false_routes_to_photon_fitters(self, data):
+        from pint_tpu.mcmc_fitter import MCMCFitter
+        from pint_tpu.sampler import EnsembleSampler
+
+        m, t = data
+        with pytest.raises(TypeError, match="photon-template"):
+            MCMCFitter(t, m, EnsembleSampler(8), resids=False)
+
+
 class TestBatchScalarParityWithEFAC:
     def test_nonuniform_efac(self, data):
         """Regression: lnposterior_batch must match the scalar path when
