@@ -1,0 +1,147 @@
+"""Performance benchmarking: measure fitting throughput the right way.
+
+The TPU-native analogue of the reference's ``profiling/`` workflow
+(``profiling/bench_chisq_grid.py``, ``bench_MCMC.py``,
+``high_level_benchmark.py``): time a chi2 grid and an MCMC fit, with the
+three rules that make the numbers meaningful on a jit/XLA stack:
+
+1. **Warm before you time.**  The first call traces + compiles (seconds
+   to minutes on a remote TPU); repeats replay from cache.  Warm with a
+   2-corner-point grid spanning the FULL grid range so the compiled
+   executable, the linear-column classification, and the hoisted
+   per-grid constants are all reused verbatim inside the timed region.
+2. **Match the chunk to the workload (or keep the default).**  GLS grid
+   points run through a fixed-size chunked executable
+   (``grid.default_gls_chunk`` = 128, from the round-5 on-TPU sweep).
+   A grid that is exactly one chunk (e.g. ``chunk=256`` for a 16x16
+   grid, as bench.py pins) avoids per-chunk dispatch; the chunk must be
+   the SAME in the warm and timed calls — it keys the executable.
+3. **Sanity-check the physics, not just the clock.**  A throughput
+   number only counts if the grid minimum equals the fitter's chi2 at
+   the same argmin (the bench's ``sanity_ok`` contract).
+
+The repo-root ``bench.py`` is the production version of this flow
+(B1855+09, 4005 TOAs, 90 free parameters; measurement history in
+BENCH_NOTES.md).  This walkthrough runs the same shape at CI size.
+
+Run:  python examples/performance_benchmarking.py [--cpu] [--quick]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    if "--cpu" in args:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    quick = "--quick" in args
+    # odd per-axis counts put the fitted optimum ON the grid, so the
+    # sanity check (grid min == fit chi2) is exact, not discretized
+    npts = 5 if quick else 17
+
+    from pint_tpu.gls_fitter import DownhillGLSFitter
+    from pint_tpu.grid import grid_chisq
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    # -- a small correlated-noise workload (same shape as bench.py) -------
+    par = """
+PSR BENCHDEMO
+RAJ 05:00:00 1
+DECJ 15:00:00 1
+F0 99.123456789 1
+F1 -1.1e-14 1
+PEPOCH 55500
+DM 12.5 1
+EFAC mjd 53000 58000 1.1
+ECORR mjd 53000 58000 0.8
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 10
+UNITS TDB
+"""
+    model = get_model(parse_parfile(par))
+    base = np.linspace(55000, 56000, 40 if quick else 100)
+    mjds = np.sort(np.concatenate([base, base + 0.5 / 86400.0]))
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(7))
+    f = DownhillGLSFitter(toas, model)
+    chi2_fit = f.fit_toas()
+    print(f"initial GLS fit: chi2 {chi2_fit:.1f} on {len(toas)} TOAs")
+
+    # -- rule 1+2: warm with full-span corners, matched chunk -------------
+    dF0 = 3 * f.errors.get("F0", 1e-10)
+    dF1 = 3 * f.errors.get("F1", 1e-18)
+    g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, npts)
+    g1 = np.linspace(f.model.F1.value - dF1, f.model.F1.value + dF1, npts)
+    chunk = npts * npts  # one-chunk executable for this grid
+    t0 = time.time()
+    grid_chisq(f, ("F0", "F1"), (g0[[0, -1]], g1[[0, -1]]), chunk=chunk)
+    print(f"compile+warm: {time.time() - t0:.2f} s (excluded from timing)")
+
+    t0 = time.time()
+    chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), chunk=chunk)
+    dt = time.time() - t0
+    rate = chi2.size / dt
+    print(f"grid {npts}x{npts}: {chi2.size} GLS refits in {dt:.3f} s "
+          f"= {rate:.1f} fits/s")
+
+    # -- rule 3: the throughput only counts if the physics agrees ---------
+    # two-sided, like bench.py's sanity_ok: a too-LOW minimum is just as
+    # broken as a too-high one, and the argmin must be the grid center
+    # (the odd point counts put the fitted optimum exactly there)
+    imin = np.unravel_index(np.argmin(chi2), chi2.shape)
+    sane = (np.isfinite(chi2).all()
+            and abs(float(chi2.min()) - chi2_fit) < 0.05 * chi2_fit
+            and imin == (npts // 2, npts // 2))
+    print(f"sanity: grid min {chi2.min():.1f} at {imin} vs fit chi2 "
+          f"{chi2_fit:.1f} -> {'OK' if sane else 'FAILED'}")
+    if not sane:
+        return 1
+
+    # -- the reference's bench_MCMC flow, reference constructor spelling --
+    # (white-noise model: the chi2-likelihood MCMC path carries the same
+    # no-correlated-noise restriction as the reference's, and the
+    # reference benchmark's NGC6440E model is white-noise too)
+    from pint_tpu import mcmc_fitter
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.sampler import EnsembleSampler
+
+    white = get_model(parse_parfile(
+        "\n".join(l for l in par.splitlines()
+                  if not l.startswith(("ECORR", "TNRed")))))
+    toas_w = make_fake_toas_fromMJDs(mjds, white, error_us=1.0,
+                                     add_noise=True,
+                                     rng=np.random.default_rng(8))
+    fw = WLSFitter(toas_w, white)
+    fw.fit_toas(maxiter=2)
+    # NOTE: passing lnlike= explicitly selects the reference-style
+    # SCALAR posterior (a python loop per walker) for spelling parity;
+    # omit the kwarg to get the same chi2 likelihood on the batched jax
+    # path, which is what bench-quality MCMC timing should use
+    t0 = time.time()
+    fm = mcmc_fitter.MCMCFitter(
+        toas_w, fw.model, EnsembleSampler(26), resids=True,
+        lnlike=mcmc_fitter.lnlikelihood_chi2)
+    mcmc_fitter.set_priors_basic(fm)
+    fm.fit_toas(6 if quick else 20, seed=1)
+    print(f"MCMC (26 walkers, reference bench shape): "
+          f"{time.time() - t0:.2f} s, acceptance "
+          f"{fm.sampler.acceptance_fraction:.2f}")
+    print("see bench.py + BENCH_NOTES.md for the production B1855 numbers")
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,3 +180,10 @@ class TestExamples:
         assert "mul_mod1 fractional phase vs 40-digit mpmath" in out
         assert "finite by design" in out
         assert "done" in out
+
+    def test_performance_benchmarking_walkthrough(self, capsys):
+        out = _run("performance_benchmarking.py", "--quick", capsys=capsys)
+        assert "fits/s" in out
+        assert "-> OK" in out
+        assert "MCMC (26 walkers" in out
+        assert "done" in out
