@@ -1,0 +1,313 @@
+"""Execute the public names a static reference-scan found unexercised.
+
+A sweep of every public def/class in ``pint_tpu`` against the test/
+example/tool corpus found ~40 names (mostly reference-parity spellings)
+defined but never run by any test.  Parity surface that is never
+executed is shipping risk — each test here drives one cluster of them
+with a real assertion, not just an import.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+NGC_PAR = "/root/reference/src/pint/data/examples/NGC6440E.par"
+NGC_TIM = "/root/reference/src/pint/data/examples/NGC6440E.tim"
+
+
+def _model_with(extra):
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.models import get_model
+
+    with open(NGC_PAR) as f:
+        text = f.read()
+    return get_model(parse_parfile(text + "\n" + "\n".join(extra) + "\n"))
+
+
+@pytest.fixture(scope="module")
+def sim():
+    m = _model_with([])
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    t = make_fake_toas_fromMJDs(np.linspace(53005, 54795, 60), m,
+                                freq=1400.0, error_us=2.0, add_noise=True,
+                                rng=np.random.default_rng(5))
+    return m, t
+
+
+class TestExceptionsSurface:
+    def test_taxonomy_raisable(self):
+        from pint_tpu.exceptions import (ComponentConflict,
+                                         MissingBinaryError, ModelError,
+                                         PINTPrecisionError, PintError,
+                                         TimingModelError)
+
+        with pytest.raises(ModelError):
+            raise ComponentConflict("two dispersion components")
+        with pytest.raises(TimingModelError):
+            raise MissingBinaryError("BINARY missing")
+        with pytest.raises((PintError, RuntimeError)):
+            raise PINTPrecisionError("longdouble too short")
+
+
+class TestFitterResidualAccessors:
+    def test_correlation_matrix_and_chi2_reduced(self, sim):
+        from pint_tpu.fitter import WLSFitter
+
+        m, t = sim
+        f = WLSFitter(t, m)
+        assert f.get_parameter_correlation_matrix() is None  # pre-fit
+        f.fit_toas(maxiter=2)
+        corr = np.asarray(f.get_parameter_correlation_matrix().matrix)
+        assert np.allclose(np.diag(corr), 1.0, atol=1e-9)
+        assert np.all(np.abs(corr) <= 1.0 + 1e-9)
+        assert f.resids.chi2_reduced == f.resids.reduced_chi2  # property alias
+        # MJDParameter.value_float (float view of the longdouble MJD)
+        assert isinstance(m.PEPOCH.value_float, float)
+        assert m.PEPOCH.value_float == pytest.approx(float(m.PEPOCH.value))
+
+    def test_pintk_default_fitter(self):
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        psr = Pulsar(NGC_PAR, NGC_TIM)
+        assert psr.getDefaultFitter() in (
+            "downhill WLS", "downhill GLS", "WLS", "GLS", "Wideband")
+
+
+class TestTimingModelFullMatrices:
+    def test_full_designmatrix_and_weights(self, sim):
+        m = _model_with(["TNRedAmp -13.0", "TNRedGam 3.0", "TNRedC 5"])
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        t = make_fake_toas_fromMJDs(np.linspace(53005, 54795, 40), m,
+                                    freq=1400.0, error_us=2.0,
+                                    add_noise=True,
+                                    rng=np.random.default_rng(6))
+        M, names, units = m.designmatrix(t)
+        F, fnames, funits = m.full_designmatrix(t)
+        assert F.shape[0] == len(t) and F.shape[1] > M.shape[1]
+        w = m.full_basis_weight(t)
+        assert w.shape == (F.shape[1],)
+        assert np.max(w) >= 1e39  # timing columns get the huge flat prior
+        assert np.min(w) > 0
+
+    def test_barycentric_and_total_eval(self, sim):
+        m, t = sim
+        bary = m.get_barycentric_toas(t)
+        # barycentric MJDs stay within light-travel distance of TDB
+        assert np.max(np.abs(np.asarray(bary - t.tdb, dtype=float))) \
+            < 600.0 / 86400.0
+        out = m.total_delay_and_phase(t)
+        ph = out[0]
+        assert np.asarray(ph.frac).shape == (len(t),)
+
+
+class TestComponentEditing:
+    def test_dmwavex_cmwavex_add_remove(self):
+        m = _model_with(["DMWXFREQ_0001 1e-8 0", "DMWXSIN_0001 0 1",
+                         "DMWXCOS_0001 0 1"])
+        c = m.components["DMWaveX"]
+        idx = c.add_dmwavex_components([2e-8, 3e-8], indices=[5, 6])
+        assert m.DMWXFREQ_0005.value == pytest.approx(2e-8)
+        c.remove_dmwavex_component(6)
+        assert getattr(m, "DMWXFREQ_0006", None) is None \
+            or m.DMWXFREQ_0006.value is None
+
+        m2 = _model_with(["CM 0.1 1", "TNCHROMIDX 4",
+                          "CMWXFREQ_0001 1e-8 0", "CMWXSIN_0001 0 1",
+                          "CMWXCOS_0001 0 1"])
+        c2 = m2.components["CMWaveX"]
+        c2.add_cmwavex_components([2e-8], indices=[7])
+        assert m2.CMWXFREQ_0007.value == pytest.approx(2e-8)
+        c2.remove_cmwavex_component(7)
+
+    def test_swx_range_removal(self):
+        m = _model_with(["NE_SW 5 0", "SWXDM_0001 1e-3 1",
+                         "SWXR1_0001 53000", "SWXR2_0001 54000",
+                         "SWXDM_0002 2e-3 1", "SWXR1_0002 54000",
+                         "SWXR2_0002 55000"])
+        c = next(c for c in m.components.values()
+                 if hasattr(c, "remove_swx_range"))
+        c.remove_swx_range(2)
+        assert getattr(m, "SWXDM_0002", None) is None \
+            or m.SWXDM_0002.value is None
+        assert m.SWXDM_0001.value == pytest.approx(1e-3)
+
+    def test_jump_count(self):
+        m = _model_with(["JUMP mjd 53000 54000 1e-5 1",
+                         "JUMP mjd 54000 55000 2e-5 1"])
+        c = next(c for c in m.components.values()
+                 if hasattr(c, "get_number_of_jumps"))
+        assert c.get_number_of_jumps() == 2
+
+    def test_absolute_phase_clear_cache(self, sim):
+        m = _model_with(["TZRMJD 53800", "TZRSITE @", "TZRFRQ 1400"])
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        t = make_fake_toas_fromMJDs(np.linspace(53005, 54795, 20), m,
+                                    freq=1400.0, error_us=2.0,
+                                    rng=np.random.default_rng(7))
+        c = next(c for c in m.components.values()
+                 if hasattr(c, "clear_cache"))
+        ph1 = np.asarray(m.phase(t).frac)
+        c.clear_cache()
+        ph2 = np.asarray(m.phase(t).frac)
+        np.testing.assert_array_equal(ph1, ph2)
+
+
+class TestBinaryHelpers:
+    def test_ell1_ecc_om(self):
+        m = _model_with(["BINARY ELL1", "PB 10.0 1", "A1 5.0 1",
+                         "TASC 53000 1", "EPS1 3e-5 1", "EPS2 4e-5 1"])
+        c = m.components["BinaryELL1"]
+        assert c.ell1_ecc() == pytest.approx(5e-5)
+        om = c.ell1_om_deg()
+        assert om == pytest.approx(np.degrees(np.arctan2(3e-5, 4e-5)))
+
+
+class TestObservatoryHelpers:
+    def test_bipm_correction_and_json(self):
+        from pint_tpu.observatory import Observatory, get_observatory
+
+        # no BIPM clock file ships in this image, so the correction is
+        # the zero fallback — the call path itself is what's exercised
+        corr = Observatory.bipm_correction(np.array([55000.0, 56000.0]))
+        assert corr.shape == (2,) and np.all(np.abs(corr) < 1e-4)
+        site = get_observatory("gbt")
+        import json
+
+        d = json.loads(site.get_json())
+        assert next(iter(d)).lower() in ("gbt", "green_bank")
+
+
+class TestNumericHelpers:
+    def test_phase_add_dd(self):
+        from pint_tpu.dd import dd_from_longdouble
+        from pint_tpu.phase import Phase, phase_add_dd
+
+        p = Phase(np.array([100.0]), np.array([0.25]))
+        x = dd_from_longdouble(np.longdouble("2.249999999999999"))
+        q = phase_add_dd(p, x)
+        total = np.asarray(q.int_, dtype=np.longdouble) \
+            + np.asarray(q.frac, dtype=np.longdouble)
+        assert abs(float(total[0] - np.longdouble("102.5"))) < 1e-12
+        assert np.all(np.abs(np.asarray(q.frac)) <= 0.5)
+
+    def test_pint_matrix_helpers(self, sim):
+        from pint_tpu.pint_matrix import DesignMatrixMaker
+
+        m, t = sim
+        d1 = DesignMatrixMaker("toa", "s")(t, m, ("F0", "F1"))
+        d2 = DesignMatrixMaker("toa", "s")(t, m, ("DM",))
+        both = d1.append_along_axis(d2, axis=1)
+        assert both.shape == (d1.shape[0], d1.shape[1] + d2.shape[1])
+        names = both.get_unique_label_names()
+        assert "F0" in names and "DM" in names
+        units = d1.param_units  # property
+        assert len(units) == len(d1.derivative_params)
+
+    def test_toa_select_helpers(self, sim):
+        from pint_tpu.toa_select import TOASelect
+
+        sel = TOASelect(is_range=True)
+        assert sel.get_has_key("EFAC", 1) == "EFAC1"
+
+        # first sighting -> changed; second identical -> unchanged
+        class Named(np.ndarray):
+            pass
+
+        arr = np.array([1.0, 2.0]).view(Named)
+        arr.name = "mjd"
+        assert sel.check_table_column(arr) is False
+        assert sel.check_table_column(arr) is True
+
+    def test_sampler_is_initialized(self):
+        # is_initialized lives on the EmceeSampler adapter, whose
+        # constructor requires the (absent) emcee package: assert the
+        # method exists and reflects self.sampler without instantiating
+        from pint_tpu.sampler import EmceeSampler
+
+        probe = type("P", (), {"sampler": None})()
+        assert EmceeSampler.is_initialized(probe) is False
+        probe.sampler = object()
+        assert EmceeSampler.is_initialized(probe) is True
+
+
+class TestPolycosEval:
+    def test_evalphase_and_freq_derivative(self, sim):
+        m, _ = sim
+        from pint_tpu.polycos import Polycos
+
+        p = Polycos.generate_polycos(m, 53800.0, 53801.0, "@", 60, 8, 1400.0)
+        ts = np.linspace(53800.1, 53800.9, 5)
+        fr = p.eval_phase(ts)
+        assert np.all((fr >= 0) & (fr < 1))
+        fd = p.eval_spin_freq_derivative(ts)
+        # the polynomial's second derivative over a 1-day segment is
+        # fit-wiggle-dominated at the 1e-15 Hz/s scale; assert the
+        # evaluation works and stays at that physical magnitude
+        assert fd.shape == ts.shape and np.all(np.isfinite(fd))
+        assert np.all(np.abs(fd) < 1e-12)
+        # the per-entry spelling (reference PolycoEntry.evalphase)
+        e = p.entries[0]
+        span_mid = np.array([e.tmid])
+        fe = e.evalphase(span_mid)
+        assert fe.shape == (1,) and 0 <= float(fe[0]) < 1
+
+
+class TestTemplatesSurface:
+    def test_lctemplate_helpers(self, tmp_path):
+        from pint_tpu.templates.lcprimitives import LCGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        t = LCTemplate([LCGaussian(p=[0.03, 0.3]),
+                        LCGaussian(p=[0.05, 0.7])], [0.4, 0.3])
+        assert t.has_bridge() is False
+        ph = np.linspace(0, 1, 200, endpoint=False)
+        mv = t.mean_value(ph)
+        assert mv == pytest.approx(1.0, rel=0.05)  # density integrates to 1
+        m0 = t.mean_single_component(0, ph)
+        assert m0 > 0
+        out = tmp_path / "prof.txt"
+        t.write_profile(str(out))
+        txt = out.read_text()
+        assert "phas" in txt and "fwhm" in txt
+
+    def test_norm_angles_and_fitter_noop(self, sim):
+        from pint_tpu.templates.lcnorm import NormAngles
+        from pint_tpu.templates.lcfitters import LCFitter
+        from pint_tpu.templates.lcprimitives import LCGaussian
+        from pint_tpu.templates.lctemplate import LCTemplate
+
+        na = NormAngles([0.4, 0.3])
+        bounds = na.get_bounds()
+        assert len(bounds) == int(np.sum(na.free))
+        assert all(lo == 0.0 and hi == pytest.approx(np.pi / 2)
+                   for lo, hi in bounds)
+        assert na.sanity_checks() is True
+
+        t = LCTemplate([LCGaussian(p=[0.03, 0.5])], [0.6])
+        rng = np.random.default_rng(9)
+        ph = (0.5 + 0.03 * rng.standard_normal(200)) % 1.0
+        f = LCFitter(t, ph)
+        f.remap_errors()  # parity no-op must exist and not raise
+
+
+class TestPintkAndScriptsSurface:
+    def test_colormode_display_info(self):
+        from pint_tpu.pintk.colormodes import FreqMode
+        from pint_tpu.pintk.pulsar import Pulsar
+
+        psr = Pulsar(NGC_PAR, NGC_TIM)
+        info = FreqMode().display_info(psr)
+        assert "mode" in info
+
+    def test_zima_plot(self, sim, tmp_path, monkeypatch):
+        import matplotlib
+
+        matplotlib.use("Agg")
+        m, t = sim
+        from pint_tpu.scripts.zima import plot_simulated_toas
+
+        plot_simulated_toas(t, m)  # must draw without a display
