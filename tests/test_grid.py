@@ -276,6 +276,47 @@ class TestGridExecutableReuse:
         np.testing.assert_array_equal(c1, c3)
         f.toas._version -= 1  # module-scoped fixture: restore
 
+    def test_bundle_key_completeness_under_random_edits(self, gls_fit):
+        """Fuzz the bundle-cache key: after ANY parameter edit (timing,
+        white noise incl. ECORR, red noise), the cached path must equal
+        a rebuild with the value-dependent caches (bundle + classify)
+        cleared — a missing key ingredient would serve stale
+        weights/bases and diverge.  (The compiled executables are
+        value-INdependent by design — values flow in as traced
+        arguments — so they are deliberately not cleared.)"""
+        from pint_tpu.grid import grid_chisq
+
+        f = gls_fit
+        dF0 = 3 * f.errors.get("F0", 1e-10)
+        g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 3)
+        g1 = np.array([f.model.F1.value])
+        rng = np.random.default_rng(17)
+        edits = [("EFAC1", lambda v: v * (1 + 0.3 * rng.random())),
+                 ("EQUAD1", lambda v: v + 0.2 * rng.random()),
+                 ("ECORR1", lambda v: v * (1 + 0.4 * rng.random())),
+                 ("TNREDAMP", lambda v: v + 0.4 * rng.random()),
+                 ("TNREDGAM", lambda v: v + 0.5 * rng.random()),
+                 ("DM", lambda v: v + 1e-4 * rng.random())]
+        saved = {p: getattr(f.model, p).value for p, _ in edits}
+        try:
+            grid_chisq(f, ("F0", "F1"), (g0, g1), niter=4)  # seed
+            for p, fn in edits:
+                getattr(f.model, p).value = fn(getattr(f.model, p).value)
+                c_cached, _ = grid_chisq(f, ("F0", "F1"), (g0, g1),
+                                         niter=4)
+                f.model._cache.pop("grid_gls_bundle", None)
+                for k in [k for k in f.model._cache
+                          if isinstance(k, tuple)
+                          and k[0] == "grid_classify"]:
+                    del f.model._cache[k]
+                c_fresh, _ = grid_chisq(f, ("F0", "F1"), (g0, g1),
+                                        niter=4)
+                np.testing.assert_array_equal(c_cached, c_fresh,
+                                              err_msg=f"stale after {p}")
+        finally:
+            for p, v in saved.items():
+                getattr(f.model, p).value = v
+
     def test_bundle_not_shared_across_toas_objects(self, gls_fit):
         """Two TOAs objects of equal length and version are different
         data: a model used against both (two fitters sharing the model)
