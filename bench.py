@@ -163,9 +163,18 @@ def bench_b1855_gls():
     g_sini = np.linspace(model.SINI.value - dsini,
                          min(0.999999, model.SINI.value + dsini), npts)
 
-    # niter=2 Gauss-Newton per point; the reference's per-point GLSFitter
-    # does one linearized solve (fit_toas() maxiter=1), so each of our grid
-    # fits does >= the reference's per-point designmatrix+solve work
+    # niter=1 Gauss-Newton per point == the reference benchmark's per-point
+    # work exactly (its per-point GLSFitter does one linearized solve,
+    # profiling/bench_chisq_grid.py).  One solve is also CONVERGED here:
+    # every fit column classifies linear on this workload, so the GN step
+    # is the exact linear-system solution — measured on the v5e, niter=1
+    # and niter=2 give the same argmin and grid-min chi2 to 2e-5 relative
+    # (3965.978 / 3965.994 vs converged fit 3965.962).  The linearity
+    # assumption is NOT trusted blindly: the sanity check below uses a
+    # convergence-grade ~5-chi2-unit tolerance that an under-converged
+    # surface (tens of units) cannot pass.  (Runs before 2026-08-01 used
+    # niter=2; the r05 progression up to 195.3 fits/s is on that basis.)
+    niter = 1
     # chunk 256 = one executable invocation for the whole 16x16 grid: the
     # round-5 on-TPU sweep measured 106.9 fits/s vs 101.5 (128) / 96.3 (64)
     # at exactly this workload; must match between the warm and timed calls
@@ -176,18 +185,26 @@ def bench_b1855_gls():
     # span) are reused verbatim inside the timed region
     warm = (g_m2[[0, -1]], g_sini[[0, -1]])
     t_c = time.time()
-    grid_chisq(f, ("M2", "SINI"), warm, niter=2, chunk=chunk)
+    grid_chisq(f, ("M2", "SINI"), warm, niter=niter, chunk=chunk)
     compile_s = time.time() - t_c
     st.mark("compile (chunked grid fn)")
 
     t0 = time.time()
-    chi2, _ = grid_chisq(f, ("M2", "SINI"), (g_m2, g_sini), niter=2, chunk=chunk)
+    chi2, _ = grid_chisq(f, ("M2", "SINI"), (g_m2, g_sini), niter=niter,
+                         chunk=chunk)
     chi2 = np.asarray(chi2)
     elapsed = time.time() - t0
     st.mark("grid 16x16 (256 GLS fits)")
 
     imin = np.unravel_index(np.argmin(chi2), chi2.shape)
-    ok = bool(np.isfinite(chi2).all()) and abs(chi2.min() - chi2_fit) < 0.05 * chi2_fit
+    # convergence-grade sanity, not just order-of-magnitude: the measured
+    # grid-min-vs-fit gap is ~0.02 chi2 units (pure grid discretization);
+    # an under-converged niter=1 surface (a fit column going nonlinear)
+    # would miss by tens of units, so the tolerance is an absolute ~5
+    # units (1e-3 relative floor for scale changes), 250x the measured
+    # gap and far below any under-convergence signature
+    tol = max(5.0, 1e-3 * chi2_fit)
+    ok = bool(np.isfinite(chi2).all()) and abs(chi2.min() - chi2_fit) < tol
     return {
         "fits_per_sec": chi2.size / elapsed,
         "elapsed": elapsed,
