@@ -376,6 +376,30 @@ def main():
             return
     print(f"# platform: {backend}", file=sys.stderr)
 
+    # device-health preflight (runtime guardrail): probe the platform that
+    # actually executes jitted arrays and its f64 regime.  This permanently
+    # closes the r03/r04 artifact hole — a silent CPU fallback can no
+    # longer produce a JSON that claims a TPU measurement.
+    from pint_tpu.runtime.preflight import device_profile, platform_matches
+
+    requested = "cpu" if os.environ.get("BENCH_FORCE_CPU") else (
+        "tpu" if os.environ.get("BENCH_REQUIRE_TPU") else backend)
+    prof = device_profile(refresh=True)
+    platform_ok = platform_matches(prof.platform, requested)
+    if not platform_ok and os.environ.get("BENCH_REQUIRE_TPU"):
+        # refuse outright: a require-TPU artifact from another device is
+        # exactly the r03/r04 failure mode
+        emit({"metric": "gls_chisq_grid_evals_per_sec", "value": 0.0,
+              "unit": "fits/s", "vs_baseline": 0.0, "sanity_ok": False,
+              "error": f"preflight: traces execute on {prof.platform!r} "
+                       f"but {requested!r} was required",
+              "device_profile": prof.to_dict()})
+        return
+    if not platform_ok:
+        print(f"# PREFLIGHT MISMATCH: requested {requested!r}, executing "
+              f"on {prof.platform!r} — sanity_ok will be stamped false",
+              file=sys.stderr)
+
     machine = cache_key(backend)
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache", machine)
@@ -409,11 +433,16 @@ def main():
         "nfree": r["nfree"],
         "grid_points": r["grid_points"],
         "compile_s": round(r["compile_s"], 1),
-        # finite grid + min within 5% of the fitter's chi2: a throughput
-        # number with a broken grid must be visibly broken in the artifact
+        # finite grid + min within 5% of the fitter's chi2 + the preflight
+        # confirming the requested platform actually executed: a broken or
+        # misattributed number must be visibly broken in the artifact
         # (plain bool: np.bool_ is not JSON-serializable)
-        "sanity_ok": bool(r["ok"]),
+        "sanity_ok": bool(r["ok"]) and platform_ok,
+        "requested_platform": requested,
+        "device_profile": prof.to_dict(),
     }
+    if not platform_ok:
+        out["platform_mismatch"] = True
     emit(out)
     print(r["stages"].table("B1855+09 9yv1 GLS (4005 TOAs)"), file=sys.stderr)
     print(

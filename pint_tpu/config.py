@@ -1,10 +1,37 @@
-"""Runtime/example data-path accessors (reference ``config.py``)."""
+"""Runtime/example data-path accessors (reference ``config.py``) plus the
+device-policy knob consumed by :mod:`pint_tpu.runtime.preflight`."""
 
 from __future__ import annotations
 
 import os
 
-__all__ = ["datadir", "examplefile", "runtimefile"]
+__all__ = ["datadir", "examplefile", "runtimefile",
+           "device_policy", "set_device_policy", "DEVICE_POLICIES"]
+
+#: what to do when the preflight probe finds the executing platform differs
+#: from the requested one (``PINT_TPU_REQUIRE_PLATFORM``):
+#: ``strict`` raises :class:`~pint_tpu.exceptions.DeviceMismatchError`,
+#: ``warn`` logs once per process, ``allow`` stays silent (the profile is
+#: still attached to results either way).
+DEVICE_POLICIES = ("strict", "warn", "allow")
+
+_device_policy = os.environ.get("PINT_TPU_DEVICE_POLICY", "warn")
+if _device_policy not in DEVICE_POLICIES:
+    _device_policy = "warn"
+
+
+def device_policy() -> str:
+    """Current device-mismatch policy: strict | warn | allow."""
+    return _device_policy
+
+
+def set_device_policy(policy: str) -> None:
+    """Set the device-mismatch policy for this process."""
+    global _device_policy
+    if policy not in DEVICE_POLICIES:
+        raise ValueError(
+            f"device policy must be one of {DEVICE_POLICIES}, got {policy!r}")
+    _device_policy = policy
 
 
 def datadir() -> str:

@@ -467,7 +467,15 @@ class SPKEphemeris(Ephemeris):
         self.name = os.path.splitext(os.path.basename(path))[0]
         with open(path, "rb") as f:
             self._data = f.read()
-        self._parse()
+        try:
+            self._parse()
+        except (struct.error, ValueError, IndexError) as e:
+            # a half-downloaded kernel must fail as a typed file error,
+            # not an opaque struct/buffer exception deep in the parser
+            from pint_tpu.exceptions import PintFileError
+
+            raise PintFileError(
+                f"{path}: truncated or corrupt SPK kernel ({e})") from e
 
     def _parse(self):
         d = self._data
@@ -512,8 +520,18 @@ class SPKEphemeris(Ephemeris):
         if s._coeffs is None:
             endian = "<f8" if self._le else ">f8"
             nwords = s.rsize * s.n
-            arr = np.frombuffer(self._data, dtype=endian,
-                                count=nwords, offset=(s.start - 1) * 8)
+            try:
+                arr = np.frombuffer(self._data, dtype=endian,
+                                    count=nwords, offset=(s.start - 1) * 8)
+            except ValueError as e:
+                # the summary chain parsed but the coefficient block is
+                # missing: a kernel cut mid-file
+                from pint_tpu.exceptions import PintFileError
+
+                raise PintFileError(
+                    f"{self.path}: truncated SPK kernel — segment "
+                    f"{s.target}/{s.center} coefficients extend past end "
+                    f"of file ({e})") from e
             s._coeffs = arr.reshape(s.n, s.rsize).astype(np.float64)
         return s._coeffs
 

@@ -17,8 +17,15 @@ __all__ = [
     "ConvergenceFailure",
     "MaxiterReached",
     "StepProblem",
+    "SingularMatrixError",
+    "NonFiniteSystemError",
     "CorrelatedErrors",
     "DegeneracyWarning",
+    "DeviceError",
+    "DeviceMismatchError",
+    "DeviceLostError",
+    "CheckpointError",
+    "SweepChunkFailure",
     "ClockCorrectionError",
     "ClockCorrectionOutOfRange",
     "NoClockCorrections",
@@ -119,6 +126,38 @@ class MaxiterReached(ConvergenceFailure):
 
 class StepProblem(ConvergenceFailure):
     """A fitter step failed to decrease chi2 even after lambda-halving."""
+
+
+class SingularMatrixError(ConvergenceFailure):
+    """Every rung of the hardened solve ladder (Cholesky, escalating
+    diagonal loading) failed on a normal-equation system."""
+
+
+class NonFiniteSystemError(ConvergenceFailure):
+    """Residuals or normal equations contain NaN/inf — the solve would
+    silently propagate garbage, so it refuses instead."""
+
+
+class DeviceError(PintError):
+    """Problem with the accelerator device executing the computation."""
+
+
+class DeviceMismatchError(DeviceError):
+    """The platform actually executing traces differs from the one
+    requested (e.g. a silent CPU fallback when a TPU was required)."""
+
+
+class DeviceLostError(DeviceError):
+    """A device disappeared or failed mid-computation."""
+
+
+class CheckpointError(PintError):
+    """A sweep checkpoint is unusable: fingerprint mismatch, corrupt
+    chunk file, or incompatible layout."""
+
+
+class SweepChunkFailure(PintError):
+    """A sweep chunk kept failing after every retry/backoff attempt."""
 
 
 class CorrelatedErrors(PintError):

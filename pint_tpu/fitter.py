@@ -23,6 +23,7 @@ from pint_tpu.exceptions import (
     CorrelatedErrors,
     DegeneracyWarning,
     MaxiterReached,
+    NonFiniteSystemError,
     StepProblem,
 )
 from pint_tpu.logging import log
@@ -40,6 +41,8 @@ class Fitter:
 
     def __init__(self, toas, model, residuals: Optional[Residuals] = None,
                  track_mode: Optional[str] = None):
+        from pint_tpu.runtime.preflight import check_device
+
         self.toas = toas
         self.model_init = model
         self.model = copy.deepcopy(model)
@@ -50,6 +53,11 @@ class Fitter:
         self.converged = False
         self.parameter_covariance_matrix = None
         self.errors = {}
+        # device-health preflight: the profile of the platform that will
+        # execute this fit rides along with the results; a mismatch with a
+        # required platform fails loudly per the config policy
+        self.device_profile = check_device()
+        self.solve_diagnostics = None
 
     # -- reference-parity constructor dispatch ------------------------------
     @staticmethod
@@ -443,17 +451,22 @@ class DownhillFitter(Fitter):
                  max_chi2_increase: float = 1e-2, min_lambda: float = 1e-3,
                  debug: bool = False, noise_fit_niter: int = 2,
                  noisefit_method: str = "L-BFGS-B",
-                 compute_noise_uncertainties: bool = True) -> float:
+                 compute_noise_uncertainties: bool = True,
+                 raise_on_maxiter: bool = False) -> float:
         """Downhill timing fit; when any noise parameter is unfrozen the
         timing fit alternates with ML noise fits (reference
         ``fitter.py:1086-1150``): ``noise_fit_niter`` rounds of
         (timing fit, noise fit), uncertainty Hessian on the last noise fit,
-        then one final timing fit at the updated noise values."""
+        then one final timing fit at the updated noise values.
+
+        ``raise_on_maxiter=True`` turns the exhausted-iteration warning
+        into a typed :class:`~pint_tpu.exceptions.MaxiterReached`."""
         if self._get_free_noise_params():
             kw = dict(maxiter=maxiter,
                       required_chi2_decrease=required_chi2_decrease,
                       max_chi2_increase=max_chi2_increase,
-                      min_lambda=min_lambda, debug=debug)
+                      min_lambda=min_lambda, debug=debug,
+                      raise_on_maxiter=raise_on_maxiter)
             for ii in range(noise_fit_niter):
                 self._fit_toas_timing(**kw)
                 last = ii == noise_fit_niter - 1
@@ -467,13 +480,14 @@ class DownhillFitter(Fitter):
         return self._fit_toas_timing(
             maxiter=maxiter, required_chi2_decrease=required_chi2_decrease,
             max_chi2_increase=max_chi2_increase, min_lambda=min_lambda,
-            debug=debug)
+            debug=debug, raise_on_maxiter=raise_on_maxiter)
 
     def _fit_toas_timing(self, maxiter: int = 20,
                          required_chi2_decrease: float = 1e-2,
                          max_chi2_increase: float = 1e-2,
                          min_lambda: float = 1e-3,
-                         debug: bool = False) -> float:
+                         debug: bool = False,
+                         raise_on_maxiter: bool = False) -> float:
         best_chi2 = self.resids.chi2
         self.converged = False
         for it in range(maxiter):
@@ -516,6 +530,10 @@ class DownhillFitter(Fitter):
                 self.converged = True
                 break
         else:
+            if raise_on_maxiter:
+                raise MaxiterReached(
+                    f"Downhill fit hit maxiter={maxiter} without meeting "
+                    f"tolerance (chi2 {best_chi2:.3f})")
             log.warning(f"Downhill fit hit maxiter={maxiter}")
         self.update_model(best_chi2)
         return best_chi2
@@ -579,7 +597,8 @@ class LMFitter(Fitter):
             mtcm = mtcm_plain + np.diag(phiinv)
             lf = lam if lam > min_lambda else 0.0
             A = mtcm + lf * np.diag(np.diag(mtcm_plain))
-            xvar, xhat = _solve_svd(A, mtcy, threshold, params)
+            xvar, xhat, self.solve_diagnostics = _solve_svd(
+                A, mtcy, threshold, params)
             step = xhat / norm
             base = {p: float(getattr(self.model, p).value or 0.0)
                     for p in params if p != "Offset"}
@@ -611,8 +630,8 @@ class LMFitter(Fitter):
         # parameters — inv(mtcm + lambda*diag) would be biased low by the
         # damping state at exit
         mtcm_plain, phiinv, mtcy, norm, params = self._normal_system()
-        xvar, _ = _solve_svd(mtcm_plain + np.diag(phiinv), mtcy, threshold,
-                             params)
+        xvar, _, _ = _solve_svd(mtcm_plain + np.diag(phiinv), mtcy,
+                                threshold, params)
         errs = np.sqrt(np.diag(xvar)) / norm
         covmat = (xvar / norm).T / norm
         ntm = len(params)
@@ -693,6 +712,12 @@ def fit_wls_svd(r, sigma, M, params, threshold):
     :func:`apply_Sdiag_threshold`."""
     r = np.asarray(r, dtype=np.float64)
     sigma = np.asarray(sigma, dtype=np.float64)
+    if not (np.all(np.isfinite(r)) and np.all(np.isfinite(M))
+            and np.all(np.isfinite(sigma))):
+        raise NonFiniteSystemError(
+            "WLS residuals/design matrix/uncertainties contain NaN/inf; "
+            "refusing the solve (the SVD would emit silent garbage or "
+            "fail untyped)")
     Mw = np.asarray(M, dtype=np.float64) / sigma[:, None]
     rw = r / sigma
     Mn, Adiag = normalize_designmatrix(Mw)

@@ -22,30 +22,46 @@ import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 import numpy as np
 
-from pint_tpu.exceptions import CorrelatedErrors, DegeneracyWarning
+from pint_tpu.exceptions import (
+    CorrelatedErrors,
+    DegeneracyWarning,
+    NonFiniteSystemError,
+    SingularMatrixError,
+)
 from pint_tpu.fitter import DownhillFitter, Fitter
 from pint_tpu.logging import log
+from pint_tpu.runtime.solve import (
+    SolveDiagnostics,
+    hardened_cholesky,
+    solve_normal_cholesky,
+)
 from pint_tpu.utils import normalize_designmatrix
 
 __all__ = ["GLSFitter", "DownhillGLSFitter"]
 
+#: exceptions that send a fitter from the Cholesky ladder to its SVD path
+_CHOLESKY_FAILURES = (np.linalg.LinAlgError, SingularMatrixError)
+
 
 def _solve_cholesky(mtcm: np.ndarray, mtcy: np.ndarray):
-    """xvar, xhat from M^T C^-1 M via device Cholesky (reference
-    ``fitter.py:2759``).  Raises on a non-positive-definite system."""
-    L = np.asarray(jsl.cholesky(jnp.asarray(mtcm), lower=True))
-    if not np.all(np.isfinite(L)):
-        raise np.linalg.LinAlgError("Cholesky factorization failed")
-    xhat = np.asarray(jsl.cho_solve((jnp.asarray(L), True), jnp.asarray(mtcy)))
-    xvar = np.asarray(jsl.cho_solve((jnp.asarray(L), True),
-                                    jnp.eye(len(mtcy))))
-    return xvar, xhat
+    """xvar, xhat, diagnostics from M^T C^-1 M via the hardened ladder
+    (reference ``fitter.py:2759`` + runtime guardrail): plain Cholesky is
+    bit-identical to the old solve; a near-singular system escalates
+    through jittered rungs before the caller's SVD path.  Raises
+    :class:`SingularMatrixError` when the ladder is exhausted and
+    :class:`NonFiniteSystemError` on NaN/inf input (never retried into
+    silent garbage)."""
+    return solve_normal_cholesky(mtcm, mtcy, name="GLS normal equations")
 
 
 def _solve_svd(mtcm: np.ndarray, mtcy: np.ndarray, threshold: float,
                params: List[str]):
     """SVD solve with degenerate directions removed (reference
-    ``fitter.py:2729`` + ``apply_Sdiag_threshold`` ``fitter.py:2621``)."""
+    ``fitter.py:2729`` + ``apply_Sdiag_threshold`` ``fitter.py:2621``).
+    Returns (xvar, xhat, diagnostics)."""
+    if not (np.all(np.isfinite(mtcm)) and np.all(np.isfinite(mtcy))):
+        raise NonFiniteSystemError(
+            "GLS normal equations contain NaN/inf; refusing the SVD solve")
     U, s, Vt = (np.asarray(x) for x in jnp.linalg.svd(jnp.asarray(mtcm),
                                                       full_matrices=False))
     if threshold > 0:
@@ -60,7 +76,10 @@ def _solve_svd(mtcm: np.ndarray, mtcy: np.ndarray, threshold: float,
         s = np.where(bad, np.inf, s)
     xvar = (Vt.T / s) @ Vt
     xhat = Vt.T @ ((U.T @ mtcy) / s)
-    return xvar, xhat
+    sf = s[np.isfinite(s)]
+    cond = float(sf.max() / max(sf.min(), 1e-300)) if sf.size else np.inf
+    return xvar, xhat, SolveDiagnostics(method="svd", jitter=0.0,
+                                        attempts=1, condition=cond)
 
 
 def build_augmented_system(model, toas, wideband: bool = False):
@@ -108,7 +127,7 @@ def gls_normal_equations(M: np.ndarray, r: np.ndarray,
                          cov: Optional[np.ndarray] = None):
     """mtcm, mtcy for either GLS path (reference ``fitter.py:2696,2712``)."""
     if cov is not None:
-        cf = np.asarray(jsl.cholesky(jnp.asarray(cov), lower=True))
+        cf, _, _ = hardened_cholesky(cov, name="TOA covariance")
         cm = np.asarray(jsl.cho_solve((jnp.asarray(cf), True), jnp.asarray(M)))
         mtcm = M.T @ cm
         mtcy = cm.T @ r
@@ -131,12 +150,17 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     parameters are fixed while timing parameters move), so its Gram matrix
     and Cholesky are cached across iterations — removing the dominant
     O(n*nu^2) matmul and the O((ntm+nu)^3) dense factorization per step.
-    Returns (xvar_t, xhat) with xvar_t the (ntm, ntm) marginal timing
-    covariance ``(A - C D^-1 C^T)^-1`` (exactly what the full-system
-    inverse's timing block is) and xhat the full solution vector.
-    Falls back by raising LinAlgError for the caller's SVD path when a
-    Cholesky fails.
+    Returns (xvar_t, xhat, diagnostics) with xvar_t the (ntm, ntm)
+    marginal timing covariance ``(A - C D^-1 C^T)^-1`` (exactly what the
+    full-system inverse's timing block is) and xhat the full solution
+    vector.  Both factorizations run through the hardened jitter ladder;
+    ladder exhaustion raises :class:`SingularMatrixError` for the
+    caller's SVD path, non-finite inputs raise
+    :class:`NonFiniteSystemError` outright.
     """
+    if not np.all(np.isfinite(r)):
+        raise NonFiniteSystemError(
+            "GLS residual vector contains NaN/inf; refusing the solve")
     W = 1.0 / Nvec
     M_t, M_u = M[:, :ntm], M[:, ntm:]
     pu = phiinv[ntm:]
@@ -148,14 +172,12 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     if (hit is not None and hit[0] == M.shape and hit[1] == ntm
             and np.array_equal(hit[2], pu) and np.array_equal(hit[3], Nvec)
             and np.array_equal(hit[4], M_u)):
-        L_D = hit[5]
+        L_D, jit_D = hit[5], hit[6]
     else:
         D = M_u.T @ WM_u + np.diag(pu)
-        L_D = np.asarray(jsl.cholesky(jnp.asarray(D), lower=True))
-        if not np.all(np.isfinite(L_D)):
-            raise np.linalg.LinAlgError("noise-block Cholesky failed")
+        L_D, jit_D, _ = hardened_cholesky(D, name="GLS noise block")
         cache["schur"] = (M.shape, ntm, pu.copy(), Nvec.copy(), M_u.copy(),
-                          L_D)
+                          L_D, jit_D)
     A = M_t.T @ (W[:, None] * M_t) + np.diag(phiinv[:ntm])
     C = M_t.T @ WM_u
     b_t = M_t.T @ (W * r)
@@ -165,9 +187,7 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     z_u = np.asarray(jsl.solve_triangular(jnp.asarray(L_D),
                                           jnp.asarray(b_u), lower=True))
     S = A - Y.T @ Y
-    L_S = np.asarray(jsl.cholesky(jnp.asarray(S), lower=True))
-    if not np.all(np.isfinite(L_S)):
-        raise np.linalg.LinAlgError("Schur-complement Cholesky failed")
+    L_S, jit_S, attempts = hardened_cholesky(S, name="GLS Schur complement")
     x_t = np.asarray(jsl.cho_solve((jnp.asarray(L_S), True),
                                    jnp.asarray(b_t - Y.T @ z_u)))
     xvar_t = np.asarray(jsl.cho_solve((jnp.asarray(L_S), True),
@@ -175,7 +195,13 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     # noise amplitudes: back-substitute x_u = D^-1 (b_u - C^T x_t)
     x_u = np.asarray(jsl.cho_solve((jnp.asarray(L_D), True),
                                    jnp.asarray(b_u - C.T @ x_t)))
-    return xvar_t, np.concatenate([x_t, x_u])
+    dS = np.diag(L_S)
+    jitter = max(jit_D, jit_S)
+    diag = SolveDiagnostics(
+        method="cholesky" if jitter == 0.0 else "cholesky-jitter",
+        jitter=float(jitter), attempts=attempts,
+        condition=float((dS.max() / max(dS.min(), 1e-300)) ** 2))
+    return xvar_t, np.concatenate([x_t, x_u]), diag
 
 
 def _try_schur_path(fitter, M, r, Nvec, phiinv, ntm, norm):
@@ -186,10 +212,13 @@ def _try_schur_path(fitter, M, r, Nvec, phiinv, ntm, norm):
     if not hasattr(fitter, "_gls_cache"):
         fitter._gls_cache = {}
     try:
-        xvar_t, xhat = _schur_gls_solve(M, r, Nvec, phiinv, ntm,
-                                        fitter._gls_cache)
-    except np.linalg.LinAlgError:
+        xvar_t, xhat, diag = _schur_gls_solve(M, r, Nvec, phiinv, ntm,
+                                              fitter._gls_cache)
+    except _CHOLESKY_FAILURES:
+        # ladder exhausted: the dense path's own ladder/SVD takes over
+        # (NonFiniteSystemError propagates — retrying cannot fix NaNs)
         return None
+    fitter.solve_diagnostics = diag
     dpars = xhat / norm
     errs = np.concatenate([
         np.sqrt(np.maximum(np.diag(xvar_t), 0.0)) / norm[:ntm],
@@ -235,11 +264,12 @@ class GLSFitter(Fitter):
             mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         if threshold <= 0:
             try:
-                xvar, xhat = _solve_cholesky(mtcm, mtcy)
-            except np.linalg.LinAlgError:
-                xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+                xvar, xhat, diag = _solve_cholesky(mtcm, mtcy)
+            except _CHOLESKY_FAILURES:
+                xvar, xhat, diag = _solve_svd(mtcm, mtcy, threshold, params)
         else:
-            xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+            xvar, xhat, diag = _solve_svd(mtcm, mtcy, threshold, params)
+        self.solve_diagnostics = diag
         dpars = xhat / norm
         errs = np.sqrt(np.diag(xvar)) / norm
         covmat = (xvar / norm).T / norm
@@ -280,6 +310,11 @@ class GLSFitter(Fitter):
             if not full_cov:
                 self._store_noise_ampls(dpars, len(params))
         chi2 = self.resids.calc_chi2()
+        if np.isnan(chi2):
+            # a one-shot fit must not hand back a silently poisoned chi2
+            raise NonFiniteSystemError(
+                "GLS fit produced NaN chi2 (non-finite residuals or a "
+                "poisoned solve)")
         self.converged = True
         self.update_model(chi2)
         return chi2

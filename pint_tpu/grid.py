@@ -23,16 +23,36 @@ import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 import numpy as np
 
+from pint_tpu.runtime.solve import SVD_RUNG, hardened_cholesky
+
 __all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived",
            "tuple_chisq", "tuple_chisq_derived", "WrappedFitter", "doonefit",
            "hostinfo", "set_log"]
 
 _warned_executor = False
 
-#: platform strings that mean "the TPU behind the tunnel" — the axon relay
-#: reports 'axon' in some environments and 'tpu' in others; chunk-size and
-#: ridge/normalization choices must agree for the same device
-_TPU_PLATFORMS = ("tpu", "axon")
+# platform strings that mean "the TPU behind the tunnel" — the axon relay
+# reports 'axon' in some environments and 'tpu' in others; chunk-size and
+# ridge/normalization choices must agree for the same device, and the ONE
+# definition lives with the preflight so its platform_matches verdict can
+# never disagree with the grid's ridge selection
+from pint_tpu.runtime.preflight import TPU_PLATFORMS as _TPU_PLATFORMS
+
+
+def _model_param_sig(model) -> tuple:
+    """Value signature of EVERY model parameter, mask selectors included:
+    the invalidation key shared by the GLS bundle cache and the sweep
+    checkpoint fingerprint.  Mask parameters (EFAC/ECORR/JUMP selectors)
+    contribute their key/key_value because editing a selector's MJD range
+    changes weights and noise bases at an unchanged parameter VALUE."""
+    def sig(par, name):
+        s = (name, str(par.value))
+        if hasattr(par, "key"):
+            s += (str(par.key), tuple(str(v) for v in par.key_value))
+        return s
+
+    return tuple(sig(c._params_dict[p], p)
+                 for c in model.components.values() for p in c.params)
 
 
 def hostinfo() -> str:
@@ -153,18 +173,21 @@ def _classified_columns_cached(model, toas, jac_fn, free_init, const_pv,
     classified at (beyond that a column that looked constant may go
     nonlinear, so reclassify at the larger span).
     """
-    # _version is part of the key: in-place TOA mutation at unchanged
-    # length (pintk edits) must force a fresh probe, since J0 was
-    # evaluated on the pre-mutation data
-    key = ("grid_classify", all_names, nfit, toas,
-           getattr(toas, "_version", 0))
+    # _version is NOT part of the key: in-place TOA mutation at unchanged
+    # length (pintk edits) must force a fresh probe (J0 was evaluated on
+    # the pre-mutation data), but keying on the version would grow a new
+    # ~MB-scale Jacobian entry per edit.  The version lives in the cached
+    # VALUE and is compared alongside the expansion point, so edits
+    # overwrite the single entry instead of leaking (ADVICE.md round 5).
+    key = ("grid_classify", all_names, nfit, toas)
+    version = getattr(toas, "_version", 0)
     spans = tuple(float(s) for s in (grid_spans if grid_spans is not None
                                      else ()))
     fi = np.asarray(free_init)
     cached = model._cache.get(key)
     if cached is not None:
-        c_spans, c_fi, J0, nl_fit = cached
-        if (np.array_equal(c_fi, fi)
+        c_spans, c_fi, J0, nl_fit, c_version = cached
+        if (c_version == version and np.array_equal(c_fi, fi)
                 and len(c_spans) == len(spans)
                 and all(s <= 2.0 * cs for s, cs in zip(spans, c_spans))):
             return J0, nl_fit
@@ -175,7 +198,8 @@ def _classified_columns_cached(model, toas, jac_fn, free_init, const_pv,
         spans if spans else None)
     # cache the span each axis was ACTUALLY validated over — a
     # domain-shrunk probe must not be credited with the requested span
-    model._cache[key] = (tuple(float(p) for p in probed), fi, J0, nl_fit)
+    model._cache[key] = (tuple(float(p) for p in probed), fi, J0, nl_fit,
+                         version)
     return J0, nl_fit
 
 
@@ -269,17 +293,27 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                 A = jnp.concatenate([ones, M], axis=1)
                 Aw = A * jnp.sqrt(w)[:, None]
                 rw = r * jnp.sqrt(w)
-                # normalized least squares for conditioning
+                # normalized least squares for conditioning; lstsq is
+                # SVD-based, i.e. already the ladder's final rung — its
+                # singular values feed the per-point diagnostics for free
                 norms = jnp.linalg.norm(Aw, axis=0)
                 norms = jnp.where(norms == 0, 1.0, norms)
-                dpar, *_ = jnp.linalg.lstsq(Aw / norms, rw)
-                return v.at[:nfit].add(dpar[1:] / norms[1:]), None
+                dpar, _, _, sv = jnp.linalg.lstsq(Aw / norms, rw)
+                ok = jnp.all(jnp.isfinite(sv))
+                cond = jnp.max(sv) / jnp.maximum(jnp.min(sv), 1e-300)
+                lvl = jnp.where(ok, jnp.int32(SVD_RUNG), jnp.int32(-1))
+                cond = jnp.where(ok, cond, jnp.nan)
+                return v.at[:nfit].add(dpar[1:] / norms[1:]), (lvl, cond)
 
-            v, _ = jax.lax.scan(gn_step, v0, None, length=niter)
+            v, (lvls, conds) = jax.lax.scan(gn_step, v0, None, length=niter)
             r = resid_cycles(v, const_pv, batch, ctx, int0, w) / F0
+            lvl_worst = jnp.where(jnp.any(lvls < 0), jnp.int32(-1),
+                                  jnp.max(lvls))
+            diag = jnp.stack([lvl_worst.astype(jnp.float64),
+                              jnp.zeros(()), jnp.max(conds)])
             # the refit parameter values ride along for extraparnames
             # (reference gridutils.py:116-160 extraout)
-            return jnp.sum(w * r * r), v[:nfit]
+            return jnp.sum(w * r * r), v[:nfit], diag
 
         # NOTE: the outer jit inlines the inner jitted eval/jac and lets XLA
         # re-optimize across the graph, which relaxes the dd error-free
@@ -293,6 +327,8 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     vfn = model._cache[grid_key]
 
     def fn(points):
+        """(chi2 (P,), vfit (P, nfit), diag (P, 3)) — diag columns are
+        (ladder rung, ridge applied, condition estimate) per point."""
         return vfn(points, free_init, const_pv, batch, ctx, int0, w, F0,
                    Jbase)
 
@@ -366,9 +402,14 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     # and every other cache here (data entries, classification, noise
     # bases) is keyed per-object too.  niter is deliberately absent —
     # nothing in the bundle depends on it (it only keys the executable).
-    vkey = (tuple((p, str(c._params_dict[p].value))
-                  for c in model.components.values() for p in c.params),
-            getattr(toas, "_version", 0), all_names, len(toas),
+    # Parameter values AND mask selectors key the bundle (_model_param_sig):
+    # editing an EFAC/ECORR selector's MJD range changes the noise bases
+    # and weights at an unchanged parameter VALUE and must invalidate the
+    # cached Gram/Cholesky.  nfit pins the fit/grid split: two calls with
+    # coinciding all_names but different partitions hoist different J0
+    # columns and must not collide.
+    vkey = (_model_param_sig(model),
+            getattr(toas, "_version", 0), all_names, nfit, len(toas),
             None if grid_spans is None else tuple(grid_spans))
     slot = model._cache.get("grid_gls_bundle")
     if slot is not None and slot[0] == vkey and slot[1]() is toas:
@@ -412,7 +453,12 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                                                            n=len(toas))
         Sigma_chi = np.diag(1.0 / phi_chi) \
             + U_chi_np.T @ (W_np[:, None] * U_chi_np)
-        cf_chi = jnp.asarray(np.linalg.cholesky(Sigma_chi))
+        # hardened: a near-singular noise Gram (Coles et al. correlated-
+        # noise regime) gets escalating diagonal loading instead of an
+        # opaque LinAlgError; total failure raises typed errors
+        cf_chi_np, jit_chi, _ = hardened_cholesky(
+            Sigma_chi, name="grid Woodbury chi2 Gram")
+        cf_chi = jnp.asarray(cf_chi_np)
         U_chi = jnp.asarray(U_chi_np)
 
         # --- Schur-complement solve constants ----------------------------
@@ -444,7 +490,15 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         U_w_np = W_np[:, None] * U_np
         A_base_np = B_base_np.T @ (W_np[:, None] * B_base_np)
         C_base_np = B_base_np.T @ U_w_np
-        L_D_np = np.linalg.cholesky(np.diag(1.0 / phi_np) + UtWU_np)
+        L_D_np, jit_D, _ = hardened_cholesky(
+            np.diag(1.0 / phi_np) + UtWU_np, name="grid noise block")
+        if max(jit_chi, jit_D) > 0:
+            from pint_tpu.logging import log
+
+            log.warning(
+                f"grid GLS bundle: noise Gram needed diagonal loading "
+                f"(chi2 {jit_chi:.2e}, solve {jit_D:.2e}) — near-singular "
+                "correlated-noise model")
         import scipy.linalg as _sl
 
         Y_base_np = _sl.solve_triangular(L_D_np, C_base_np.T, lower=True)
@@ -493,7 +547,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         # flow in as data or a rebuilt fn would de-scale with a stale copy
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w,
                        F0, B_base, A_base, Y_base, U_w, L_D,
-                       U_chi, cf_chi, s_col):
+                       U_chi, cf_chi, s_col, ridge_scale):
             v0 = jnp.concatenate([free_init[:nfit], gvals])
             nt = 1 + nfit
 
@@ -542,25 +596,67 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                     an = jnp.sqrt(jnp.maximum(dA, 1e-30 * jnp.max(dA)))
                 else:
                     an = jnp.sqrt(jnp.maximum(jnp.diag(Ar), 1e-300))
-                Arn = Ar / jnp.outer(an, an) + _RIDGE * jnp.eye(nt)
+                # hardened solve, escalation-pass variant: ONE Cholesky
+                # at _RIDGE * ridge_scale — at scale 1 this is exactly
+                # the pre-guardrail solve (bit-identical, zero overhead;
+                # a fully on-trace multi-rung ladder measured ~8x the
+                # batch solve cost, far past the 10%-of-throughput
+                # budget).  A failed point is POISONED (NaN step -> NaN
+                # chi2, never fabricated) and flagged; the chunk driver
+                # below re-runs only affected chunks at escalated scales
+                # — host decisions happen at chunk granularity, never
+                # inside this vmapped body.
+                Arn = Ar / jnp.outer(an, an) \
+                    + (_RIDGE * ridge_scale) * jnp.eye(nt)
                 L = jnp.linalg.cholesky(Arn)
                 x = jsl.cho_solve((L, True), rhs / an) / an
-                return v.at[:nfit].add((x / s_col)[1:nt]), None
+                ok = jnp.all(jnp.isfinite(x))
+                x = jnp.where(ok, x, jnp.nan)
+                dL = jnp.diagonal(L)
+                # condition proxy from the factor (exact cond needs an
+                # eigensolve, which is what blew the budget)
+                cond = (jnp.max(dL) / jnp.maximum(jnp.min(dL),
+                                                  1e-300)) ** 2
+                return v.at[:nfit].add((x / s_col)[1:nt]), (ok, cond)
 
-            v, _ = jax.lax.scan(gn_step, v0, None, length=niter)
+            v, (oks, conds) = jax.lax.scan(gn_step, v0, None,
+                                           length=niter)
             r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
             # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
             wr = w * r
             z = jsl.solve_triangular(cf_chi, U_chi.T @ wr, lower=True)
-            return jnp.sum(r * wr) - z @ z, v[:nfit]
+            # per-point diagnostics for THIS pass: solved flag (every GN
+            # iteration factored) and worst condition proxy
+            diag = jnp.stack([jnp.where(jnp.all(oks), 1.0, 0.0),
+                              jnp.max(conds)])
+            return jnp.sum(r * wr) - z @ z, v[:nfit], diag
 
         model._cache[grid_key] = jax.jit(jax.vmap(
             chi2_point,
             in_axes=(0, None, None, None, None, None, None, None, None,
-                     None, None, None, None, None, None, None)))
+                     None, None, None, None, None, None, None, None)))
     vfn = model._cache[grid_key]
 
+    #: ridge multipliers for the chunk-level escalation ladder (rung i
+    #: solves at _RIDGE * _ESCALATION[i])
+    _ESCALATION = (1.0, 1e3, 1e6)
+
+    def _eval_chunk(blk, scale):
+        return vfn(blk, free_init, const_pv, batch, ctx, int0, w, F0,
+                   B_base, A_base, Y_base, U_w, L_D, U_chi, cf_chi,
+                   s_col, jnp.float64(scale))
+
     def fn(points, sharding=None):
+        """(chi2 (P,), vfit (P, nfit), diag (P, 3)) — diag columns are
+        (ladder rung, ridge applied, condition estimate) per point.
+
+        Escalation runs at CHUNK granularity: pass 0 dispatches every
+        chunk at the base ridge before any host sync (async pipelining
+        preserved); only chunks reporting an unsolved point re-run at
+        escalated ridges, and only the failed points take the escalated
+        values.  Healthy sweeps therefore cost exactly the pre-guardrail
+        solve.  Points no rung solves keep NaN chi2 with rung -1 — loud,
+        never fabricated."""
         points = jnp.asarray(points)
         npts = points.shape[0]
         blk_size = chunk
@@ -568,7 +664,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             # the fixed chunk must tile evenly onto the mesh axis
             ndev = sharding.mesh.devices.size
             blk_size = max(chunk, ndev) // ndev * ndev
-        out, out_v = [], []
+        blks, keeps = [], []
         for i in range(0, npts, blk_size):
             blk = points[i:i + blk_size]
             pad = blk_size - blk.shape[0]
@@ -576,13 +672,45 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                 blk = jnp.concatenate([blk, jnp.tile(blk[-1:], (pad, 1))])
             if sharding is not None:
                 blk = jax.device_put(blk, sharding)
-            c2, vf = vfn(blk, free_init, const_pv, batch, ctx, int0, w,
-                         F0, B_base, A_base, Y_base, U_w, L_D,
-                         U_chi, cf_chi, s_col)
-            keep = blk_size - pad if pad else blk_size
-            out.append(c2[:keep])
-            out_v.append(vf[:keep])
-        return jnp.concatenate(out), jnp.concatenate(out_v)
+            blks.append(blk)
+            keeps.append(blk_size - pad)
+        first = [_eval_chunk(b, 1.0) for b in blks]
+        out, out_v, out_d = [], [], []
+        for blk, keep, (c2, vf, dg) in zip(blks, keeps, first):
+            c2 = np.array(np.asarray(c2)[:keep])
+            vf = np.array(np.asarray(vf)[:keep])
+            dg = np.asarray(dg)[:keep]
+            solved = dg[:, 0] > 0.5
+            cond = np.array(dg[:, 1])
+            rung = np.where(solved, 0, -1)
+            for ri in range(1, len(_ESCALATION)):
+                if solved.all():
+                    break
+                c2e, vfe, dge = (np.asarray(a)[:keep] for a in
+                                 _eval_chunk(blk, _ESCALATION[ri]))
+                newly = ~solved & (dge[:, 0] > 0.5)
+                c2[newly] = c2e[newly]
+                vf[newly] = vfe[newly]
+                cond[newly] = dge[newly, 1]
+                rung[newly] = ri
+                solved |= newly
+            if not solved.all():
+                from pint_tpu.logging import log
+
+                log.warning(
+                    f"grid GLS solve: {int((~solved).sum())} point(s) "
+                    "unsolved at every escalation ridge — their chi2 is "
+                    "NaN (rung -1), not fabricated")
+            ridge = np.where(
+                rung >= 0,
+                _RIDGE * np.take(np.asarray(_ESCALATION),
+                                 np.maximum(rung, 0)), np.nan)
+            out.append(c2)
+            out_v.append(vf)
+            out_d.append(np.stack([rung.astype(np.float64), ridge, cond],
+                                  axis=1))
+        return (np.concatenate(out), np.concatenate(out_v),
+                np.concatenate(out_d))
 
     return fn, free_init, fit_params
 
@@ -610,10 +738,28 @@ def _extraout(extraparnames, fit_params, grid_params, vfit, pts, model,
     return out
 
 
+def _attach_grid_diagnostics(ftr, diag, shape=None):
+    """Stash the per-point solve diagnostics (and the device profile) on
+    the fitter: ``ftr.last_grid_diagnostics`` maps ``ladder_rung`` /
+    ``ridge`` / ``condition`` to grid-shaped arrays.  Rung -1 flags a
+    poisoned (non-finite) point; rung ``SVD_RUNG`` the pseudo-inverse."""
+    from pint_tpu.runtime.preflight import device_profile
+
+    d = np.asarray(diag)
+    out = {"ladder_rung": d[:, 0].astype(int), "ridge": d[:, 1],
+           "condition": d[:, 2]}
+    if shape is not None:
+        out = {k: v.reshape(shape) for k, v in out.items()}
+    out["device_profile"] = device_profile()
+    ftr.last_grid_diagnostics = out
+    return out
+
+
 def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                extraparnames: Sequence[str] = (),
                executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
                niter: int = 4, mesh=None, chunk=None,
+               checkpoint: Optional[str] = None, retry=None,
                **fitargs) -> Tuple[np.ndarray, dict]:
     """Chi2 over an outer-product grid (reference ``gridutils.py:164`` API).
 
@@ -625,6 +771,13 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     128, :func:`default_gls_chunk`; the tools/tpu_sweep.py knob).
     ``extraparnames`` returns the per-point refit values of those parameters
     in the second return slot, shaped like the grid.
+
+    ``checkpoint`` names a directory: the sweep then runs through the
+    chunked executor (:mod:`pint_tpu.runtime.checkpoint`) — completed
+    chunks persist to disk, failed chunks retry with exponential backoff
+    (``retry``, a :class:`~pint_tpu.runtime.checkpoint.RetryPolicy`), and
+    a crashed sweep resumes from the last completed chunk.  Per-point
+    solve diagnostics land on ``ftr.last_grid_diagnostics`` either way.
     """
     global _warned_executor
     if (executor is not None or ncpu not in (None, 1)) and not _warned_executor:
@@ -634,37 +787,79 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
         log.warning("grid_chisq: executor/ncpu are no-ops here - grid points "
                     "are batched on-device (pass mesh= to use multiple "
                     "devices)")
+    from pint_tpu.runtime.preflight import check_device
+
+    check_device()
     model, toas = ftr.model, ftr.toas
     parnames = tuple(parnames)
     grids = [np.asarray(v, dtype=np.float64) for v in parvalues]
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
-    fn, _, fit_params = build_grid_chi2_fn(
+    fn, free_init, fit_params = build_grid_chi2_fn(
         model, toas, parnames, niter=niter,
         grid_spans=_point_spans(model, parnames, mesh_pts), chunk=chunk)
-    pts = jnp.asarray(mesh_pts)
-    if mesh is not None:
+    if checkpoint is not None:
+        if mesh is not None:
+            raise ValueError("checkpoint= and mesh= cannot be combined; "
+                             "run the checkpointed sweep per host")
+        # the fingerprint must cover everything the chi2 surface depends
+        # on — grid definition, EVERY parameter value/selector, and the
+        # TOA data version — or a resume would silently stitch chunks
+        # from different data into one surface
+        chi2, vfit, diag = _checkpointed_grid(
+            fn, mesh_pts, checkpoint, retry,
+            fingerprint=dict(parnames=parnames, pts=mesh_pts, niter=niter,
+                             ntoas=len(toas), gls=gls,
+                             toas_version=getattr(toas, "_version", 0),
+                             params=_model_param_sig(model),
+                             free_init=np.asarray(free_init)),
+            chunk=chunk if chunk else (default_gls_chunk() if gls else 256))
+    elif mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
         if gls:
             # chunked path: each fixed-size chunk is sharded on entry
-            chi2, vfit = fn(pts, sharding=sharding)
+            chi2, vfit, diag = fn(jnp.asarray(mesh_pts), sharding=sharding)
         else:
+            pts = jnp.asarray(mesh_pts)
             npts = pts.shape[0]
             ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
             pad = (-npts) % ndev
             if pad:
                 pts = jnp.concatenate([pts, jnp.tile(pts[-1:], (pad, 1))])
             pts = jax.device_put(pts, sharding)
-            chi2, vfit = fn(pts)
-            chi2, vfit = chi2[:npts], vfit[:npts]
+            chi2, vfit, diag = fn(pts)
+            chi2, vfit, diag = chi2[:npts], vfit[:npts], diag[:npts]
     else:
-        chi2, vfit = fn(pts)
+        chi2, vfit, diag = fn(jnp.asarray(mesh_pts))
+    _attach_grid_diagnostics(ftr, diag, shape=shape)
     extraout = _extraout(extraparnames, fit_params, parnames, vfit, mesh_pts,
                          model, shape=shape)
     return np.asarray(chi2).reshape(shape), extraout
+
+
+def _checkpointed_grid(fn, mesh_pts: np.ndarray, checkpoint: str, retry,
+                       fingerprint: dict, chunk: int):
+    """Run the grid through the chunked checkpointed executor; chunks are
+    contiguous point blocks so a resumed sweep re-evaluates the same
+    blocks through the same compiled executable (chi2 surface identical
+    to an uninterrupted run)."""
+    from pint_tpu.runtime.checkpoint import checkpointed_map
+
+    blocks = [mesh_pts[i:i + chunk] for i in range(0, len(mesh_pts), chunk)]
+
+    def chunk_fn(blk):
+        c2, vf, dg = fn(jnp.asarray(blk))
+        return {"chi2": np.asarray(c2), "vfit": np.asarray(vf),
+                "diag": np.asarray(dg)}
+
+    outs = checkpointed_map(chunk_fn, blocks, checkpoint=checkpoint,
+                            fingerprint=fingerprint, retry=retry)
+    return (np.concatenate([o["chi2"] for o in outs]),
+            np.concatenate([o["vfit"] for o in outs]),
+            np.concatenate([o["diag"] for o in outs]))
 
 
 def _point_spans(model, parnames, pts) -> list:
@@ -698,7 +893,8 @@ def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
     fn, _, fit_params = build_grid_chi2_fn(
         model, toas, tuple(parnames), niter=niter,
         grid_spans=_point_spans(model, parnames, pts))
-    chi2, vfit = fn(jnp.asarray(pts))
+    chi2, vfit, diag = fn(jnp.asarray(pts))
+    _attach_grid_diagnostics(ftr, diag, shape=shape)
     out_grids = [g.reshape(shape) for g in mesh_arrays]
     extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
                          pts, model, shape=shape)
@@ -715,7 +911,8 @@ def tuple_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     fn, _, fit_params = build_grid_chi2_fn(
         model, toas, tuple(parnames), niter=niter,
         grid_spans=_point_spans(model, parnames, pts))
-    chi2, vfit = fn(jnp.asarray(pts))
+    chi2, vfit, diag = fn(jnp.asarray(pts))
+    _attach_grid_diagnostics(ftr, diag)
     extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
                          pts, model)
     return np.asarray(chi2), extraout
@@ -735,7 +932,8 @@ def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
     fn, _, fit_params = build_grid_chi2_fn(
         model, toas, tuple(parnames), niter=niter,
         grid_spans=_point_spans(model, parnames, pts))
-    chi2, vfit = fn(jnp.asarray(pts))
+    chi2, vfit, diag = fn(jnp.asarray(pts))
+    _attach_grid_diagnostics(ftr, diag)
     out_values = [raw[:, i] for i in range(raw.shape[1])]
     extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
                          pts, model)

@@ -188,9 +188,43 @@ class MCMCFitter(Fitter):
         return self.bt.lnposterior(theta)
 
     def fit_toas(self, maxiter: int = 100, pos=None, seed: Optional[int] = None,
-                 burn_frac: float = 0.25, **kw) -> float:
+                 burn_frac: float = 0.25, checkpoint: Optional[str] = None,
+                 **kw) -> float:
         """Run the ensemble for *maxiter* steps; model is set to the
-        maximum-posterior sample and chi2 at that point is returned."""
+        maximum-posterior sample and chi2 at that point is returned.
+
+        ``checkpoint`` names an npz file: the chain (and exact RNG state)
+        is persisted through :class:`pint_tpu.sampler.NpzBackend`, and a
+        crashed run resumes from it — only the remaining steps are
+        sampled, continuing the Markov chain bit-identically to an
+        uninterrupted run."""
+        if checkpoint is not None:
+            from pint_tpu.grid import _model_param_sig
+            from pint_tpu.runtime.checkpoint import fingerprint_of
+            from pint_tpu.sampler import EnsembleSampler as _ES, NpzBackend
+
+            if not isinstance(self.sampler, _ES):
+                raise TypeError(
+                    "checkpoint= requires the jax-native EnsembleSampler")
+            if self.sampler.backend is None \
+                    or getattr(self.sampler.backend, "path", None) \
+                    not in (checkpoint, checkpoint + ".npz"):
+                self.sampler.backend = NpzBackend(checkpoint)
+            # run identity: a checkpoint from a different model/TOAs must
+            # refuse to resume (CheckpointError), mirroring the grid
+            # sweep's fingerprint guard.  The FREE parameter values are
+            # deliberately excluded — they are the sampled quantities and
+            # move when a chain is extended on the same fitter; the
+            # posterior's identity is the fit keys, the data, and the
+            # frozen parameters
+            self.sampler.fingerprint = fingerprint_of(
+                fitkeys=tuple(self.fitkeys), ntoas=len(self.toas),
+                toas_version=getattr(self.toas, "_version", 0),
+                frozen=tuple(s for s in _model_param_sig(self.model)
+                             if s[0] not in self.fitkeys))
+            if self.sampler.backend.exists() and pos is None:
+                pos = self.sampler.resume()
+                maxiter = max(0, maxiter - self.sampler.iteration)
         if self._custom_post:
             # the bt property resyncs fitkeys/n_fit_params when the free
             # set changed since construction; the default branch touches
@@ -225,10 +259,15 @@ class MCMCFitter(Fitter):
             if bad.any():
                 pos[bad] = self.get_fitvals()
         self.sampler.run_mcmc(pos, maxiter)
+        # burn-in from the TOTAL accumulated chain, not this call's step
+        # count: after a checkpoint resume maxiter holds only the
+        # remaining steps, and discarding from it would leave resumed
+        # runs inequivalent to uninterrupted ones
+        nsteps = self.sampler.get_chain().shape[0]
         chain = self.sampler.get_chain(flat=True,
-                                       discard=int(maxiter * burn_frac))
+                                       discard=int(nsteps * burn_frac))
         lnp = self.sampler.get_log_prob(flat=True,
-                                        discard=int(maxiter * burn_frac))
+                                        discard=int(nsteps * burn_frac))
         imax = int(np.argmax(lnp))
         self.maxpost = float(lnp[imax])
         self.maxpost_fitvals = chain[imax]

@@ -271,6 +271,10 @@ def read_tempo_clock_file(path: str, obscode: Optional[str] = None, **kw) -> Clo
     """
     mjds: List[float] = []
     corr: List[float] = []
+    # truncation signature: a line whose MJD parses but whose offset
+    # columns do not, with no well-formed data line after it — a file cut
+    # mid-line.  Legacy special lines mid-file still skip silently.
+    bad_tail = False
     with open(path) as f:
         for ln in f:
             s = ln.strip()
@@ -288,12 +292,19 @@ def read_tempo_clock_file(path: str, obscode: Optional[str] = None, **kw) -> Clo
                 c1 = float(fields[1])
                 c2 = float(fields[2]) if len(fields) > 2 else 0.0
             except (ValueError, IndexError):
+                bad_tail = True
                 continue
+            bad_tail = False
             code = fields[3] if len(fields) > 3 else None
             if obscode is not None and code is not None and code.lower() != obscode.lower():
                 continue
             mjds.append(mjd)
             corr.append(c2 - c1)
+    if bad_tail:
+        from pint_tpu.exceptions import PintFileError
+
+        raise PintFileError(
+            f"{path}: truncated clock file — final data line is malformed")
     cf = ClockFile(mjds, corr, filename=os.path.basename(path), **kw)
     cf.source_path = os.path.abspath(path)
     return cf
@@ -310,6 +321,7 @@ def read_tempo2_clock_file(path: str, **kw) -> ClockFile:
     mjds: List[float] = []
     corr: List[float] = []
     hdrline = ""
+    bad_tail = False  # see read_tempo_clock_file: cut-mid-line signature
     with open(path) as f:
         for ln in f:
             s = ln.strip()
@@ -323,9 +335,21 @@ def read_tempo2_clock_file(path: str, **kw) -> ClockFile:
             try:
                 m_, c_ = float(fields[0]), float(fields[1])
             except (ValueError, IndexError):
-                continue  # bare-text header or malformed line
+                # bare-text header lines fall through safely, but a line
+                # whose MJD parses and offset does not is data corruption
+                try:
+                    bad_tail = 15000 < float(fields[0]) < 100000
+                except ValueError:
+                    pass
+                continue
+            bad_tail = False
             mjds.append(m_)
             corr.append(c_ * 1e6)  # seconds -> us
+    if bad_tail:
+        from pint_tpu.exceptions import PintFileError
+
+        raise PintFileError(
+            f"{path}: truncated clock file — final data line is malformed")
     cf = ClockFile(mjds, corr, filename=os.path.basename(path),
                    hdrline=hdrline, **kw)
     cf.source_path = os.path.abspath(path)

@@ -1,0 +1,46 @@
+"""Runtime guardrail layer: device-health preflight, hardened solve
+ladders, checkpointed sweeps, and fault injection.
+
+Every fitting entry point in pint_tpu routes through one of three
+guardrails so that bad inputs or flaky devices **fail loudly, degrade
+gracefully, or recover** — never silently emit wrong numbers:
+
+* :mod:`pint_tpu.runtime.preflight` — probe the platform that actually
+  executes traces (and its f64-emulation regime, DESIGN.md) and attach a
+  :class:`~pint_tpu.runtime.preflight.DeviceProfile` to fit results; a
+  ``strict``/``warn``/``allow`` policy knob lives in
+  :mod:`pint_tpu.config`.
+* :mod:`pint_tpu.runtime.solve` — Cholesky -> jittered-Cholesky -> SVD
+  escalation for every normal-equation solve, host-side (fitters) and
+  on-trace (vmapped grid bodies), with per-solve diagnostics.
+* :mod:`pint_tpu.runtime.checkpoint` — chunked sweep executor with
+  per-chunk persistence, retry/backoff, timeout, and crash resume.
+* :mod:`pint_tpu.runtime.faultinject` — deterministic fault injection
+  (NaN residuals, singular Grams, truncated files, device loss) used by
+  ``tests/test_fault_injection.py`` to prove each guardrail fires.
+"""
+
+from pint_tpu.runtime.preflight import (  # noqa: F401
+    DeviceProfile,
+    check_device,
+    device_profile,
+)
+from pint_tpu.runtime.solve import (  # noqa: F401
+    SolveDiagnostics,
+    hardened_cholesky,
+    ladder_cholesky_solve,
+    solve_normal_cholesky,
+)
+from pint_tpu.runtime.checkpoint import (  # noqa: F401
+    RetryPolicy,
+    SweepCheckpoint,
+    checkpointed_map,
+    with_retries,
+)
+
+__all__ = [
+    "DeviceProfile", "device_profile", "check_device",
+    "SolveDiagnostics", "hardened_cholesky", "solve_normal_cholesky",
+    "ladder_cholesky_solve",
+    "RetryPolicy", "SweepCheckpoint", "checkpointed_map", "with_retries",
+]

@@ -1,0 +1,196 @@
+"""Deterministic fault injection for the runtime guardrails.
+
+Each context manager here injects exactly one failure mode at a seam the
+production code actually crosses, so ``tests/test_fault_injection.py``
+can prove that every guardrail *fires* — the injected fault is either
+recovered (solve ladder, chunk retry) or surfaces as a typed
+:mod:`pint_tpu.exceptions` error, never as a silently wrong chi2.
+
+Faults:
+
+* :func:`nan_residuals` — poison chosen time-residual entries with NaN
+  (a corrupt TOA / broken delay component);
+* :func:`singular_gram` — make the correlated-noise Gram block exactly
+  singular (duplicated basis column with zero prior), the Coles et al.
+  near-degenerate regime taken to its limit;
+* :func:`truncated_copy` — a prefix of a binary/text data file (a
+  half-downloaded SPK kernel or clock file);
+* :func:`device_loss` — the first *n* sweep-chunk invocations raise
+  :class:`SimulatedDeviceLoss` (a flaky accelerator tunnel);
+* :func:`crash_after_chunks` — the process "dies" (``SimulatedCrash``)
+  after *n* completed chunks, for kill-and-resume tests;
+* :func:`flaky` — wrap any callable to fail its first *n* calls.
+
+Everything is plain attribute patching with restore-on-exit; no fault
+leaks past its ``with`` block.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from pint_tpu.exceptions import DeviceLostError
+
+__all__ = ["SimulatedDeviceLoss", "SimulatedCrash", "nan_residuals",
+           "singular_gram", "truncated_copy", "device_loss",
+           "crash_after_chunks", "flaky"]
+
+
+class SimulatedDeviceLoss(DeviceLostError):
+    """Injected device failure (retryable by the chunk executor)."""
+
+
+class SimulatedCrash(RuntimeError):
+    """Injected host death mid-sweep (NOT retryable: the process is gone;
+    recovery is a fresh process resuming from the checkpoint)."""
+
+
+@contextlib.contextmanager
+def nan_residuals(indices: Iterable[int] = (0,)):
+    """Poison ``time_resids`` entries with NaN for every Residuals object
+    built inside the context (fitters rebuild residuals per step, so the
+    fault persists across iterations like a genuinely corrupt TOA)."""
+    from pint_tpu.residuals import Residuals
+
+    idx = np.asarray(list(indices), dtype=int)
+    orig = Residuals.calc_time_resids
+
+    def poisoned(self):
+        r = orig(self)
+        r = np.asarray(r, dtype=np.float64).copy()
+        r[idx[idx < len(r)]] = np.nan
+        self._time_resids = r
+        return r
+
+    Residuals.calc_time_resids = poisoned
+    try:
+        yield
+    finally:
+        Residuals.calc_time_resids = orig
+
+
+@contextlib.contextmanager
+def singular_gram():
+    """Make the noise block of every augmented GLS system built inside
+    the context numerically non-positive-definite: the last noise-basis
+    column is duplicated over its neighbour with zeroed priors (exact
+    rank deficiency), and the duplicate's diagonal is depressed by ~1e-9
+    relative so the Cholesky pivot is deterministically negative —
+    rounding cannot rescue it, and the solve ladder must escalate."""
+    import pint_tpu.gls_fitter as gf
+
+    orig = gf.build_augmented_system
+
+    def degenerate(model, toas, wideband=False):
+        M, params, norm, phiinv, Nvec, dims = orig(model, toas,
+                                                  wideband=wideband)
+        ntm = len(params)
+        if M.shape[1] >= ntm + 2:
+            M = M.copy()
+            phiinv = phiinv.copy()
+            M[:, -2] = M[:, -1]
+            d_last = float(np.sum((1.0 / Nvec[: M.shape[0]])
+                                  * M[:, -1] ** 2))
+            phiinv[-2:] = 0.0
+            phiinv[-1] = -1e-9 * d_last
+        return M, params, norm, phiinv, Nvec, dims
+
+    gf.build_augmented_system = degenerate
+    try:
+        yield
+    finally:
+        gf.build_augmented_system = orig
+
+
+@contextlib.contextmanager
+def truncated_copy(src: str, fraction: float = 0.6,
+                   dst: Optional[str] = None):
+    """Yield the path of a copy of ``src`` cut to the leading
+    ``fraction`` of its bytes (a partially transferred data file)."""
+    import tempfile
+
+    tmpdir = None
+    if dst is None:
+        tmpdir = tempfile.mkdtemp(prefix="pint_tpu_faultinject_")
+        dst = os.path.join(tmpdir, os.path.basename(src))
+    with open(src, "rb") as f:
+        data = f.read()
+    with open(dst, "wb") as f:
+        f.write(data[: max(1, int(len(data) * fraction))])
+    try:
+        yield dst
+    finally:
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def flaky(fn: Callable, fail_times: int,
+          exc_factory: Callable[[], BaseException] = None) -> Callable:
+    """Wrap ``fn`` so its first ``fail_times`` calls raise (default:
+    :class:`SimulatedDeviceLoss`); later calls pass through."""
+    state = {"calls": 0}
+    make = exc_factory or (lambda: SimulatedDeviceLoss(
+        "injected: device lost mid-evaluation"))
+
+    def wrapped(*a, **kw):
+        state["calls"] += 1
+        if state["calls"] <= fail_times:
+            raise make()
+        return fn(*a, **kw)
+
+    wrapped.calls = state
+    return wrapped
+
+
+@contextlib.contextmanager
+def device_loss(fail_times: int = 2):
+    """The first ``fail_times`` sweep-chunk invocations (counting
+    retries) raise :class:`SimulatedDeviceLoss`; the executor's
+    retry/backoff must absorb them."""
+    from pint_tpu.runtime import checkpoint as cp
+
+    orig = cp._invoke
+    state = {"calls": 0}
+
+    def failing(fn, chunk, index):
+        state["calls"] += 1
+        if state["calls"] <= fail_times:
+            raise SimulatedDeviceLoss(
+                f"injected: device lost during chunk {index}")
+        return orig(fn, chunk, index)
+
+    cp._invoke = failing
+    try:
+        yield state
+    finally:
+        cp._invoke = orig
+
+
+@contextlib.contextmanager
+def crash_after_chunks(n: int):
+    """Let ``n`` chunk invocations complete, then raise
+    :class:`SimulatedCrash` on every later one — the in-process stand-in
+    for kill -9 mid-sweep (completed chunks are already on disk; a rerun
+    resumes from them)."""
+    from pint_tpu.runtime import checkpoint as cp
+
+    orig = cp._invoke
+    state = {"calls": 0}
+
+    def crashing(fn, chunk, index):
+        if state["calls"] >= n:
+            raise SimulatedCrash(
+                f"injected: host died before chunk {index}")
+        state["calls"] += 1
+        return orig(fn, chunk, index)
+
+    cp._invoke = crashing
+    try:
+        yield state
+    finally:
+        cp._invoke = orig

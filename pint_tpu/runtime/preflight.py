@@ -1,0 +1,173 @@
+"""Device-health preflight: who is actually executing the traces?
+
+Rounds r03/r04 shipped a benchmark artifact caused by a *silent* CPU
+fallback, and DESIGN.md's f64-emulation probe shows numerical correctness
+depends on which device executes (TPU f64 is float32-pair emulation with
+~49-bit storage and float32 RANGE).  This module probes the live backend
+once per process:
+
+* **platform** — ``jax.devices()[0].platform`` of the default backend,
+  i.e. where jitted computations actually land (not what was requested);
+* **two_sum error word** (DESIGN.md round-3 probe) — on native f64 the
+  error-free transform recovers the exact rounding error of ``a + b``; on
+  the TPU's excess-precision emulation it collapses to garbage, so the
+  recovered word is a fingerprint of the arithmetic;
+* **effective mantissa bits** — largest ``k`` with ``(1 + 2^-k) - 1 > 0``
+  evaluated on device.
+
+The resulting :class:`DeviceProfile` is attached to fitters
+(``Fitter.device_profile``), grid runs, and bench artifacts so a silent
+fallback or degraded-precision device is visible in every result.
+:func:`check_device` enforces the ``strict``/``warn``/``allow`` policy
+from :mod:`pint_tpu.config` against a requested platform
+(``PINT_TPU_REQUIRE_PLATFORM`` or an explicit argument).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import asdict, dataclass
+from typing import Optional
+
+from pint_tpu import config
+from pint_tpu.exceptions import DeviceMismatchError
+from pint_tpu.logging import log
+
+__all__ = ["DeviceProfile", "device_profile", "check_device",
+           "platform_matches"]
+
+#: platform strings that name "the TPU behind the tunnel" — the single
+#: definition; grid.py imports it so ridge/normalization selection can
+#: never disagree with the preflight's platform_matches verdict
+TPU_PLATFORMS = ("tpu", "axon")
+
+#: the probe pair: fl(1 + b) rounds b = 2^-53 + 2^-78 up to 2^-52, so the
+#: exact two_sum error word is b - 2^-52 (negative, ~ -2^-53)
+_PROBE_B = 2.0 ** -53 + 2.0 ** -78
+_PROBE_ERR_EXPECTED = _PROBE_B - 2.0 ** -52
+
+
+@dataclass(frozen=True)
+class DeviceProfile:
+    """Measured health/precision profile of the default JAX backend."""
+
+    platform: str          #: executing platform ("cpu", "tpu", "axon", ...)
+    device_kind: str       #: device self-description (e.g. "TPU v5e")
+    num_devices: int
+    f64_native: bool       #: two_sum error word recovered exactly
+    mantissa_bits: int     #: effective f64 mantissa bits measured on device
+    two_sum_error: float   #: |recovered - expected| error-word defect
+    jax_version: str
+
+    @property
+    def degraded_precision(self) -> bool:
+        """True when f64 arithmetic is emulated / below IEEE-754 double
+        (the DESIGN.md ~49-bit TPU regime)."""
+        return not self.f64_native or self.mantissa_bits < 52
+
+    @property
+    def precision(self) -> str:
+        return ("native-f64" if not self.degraded_precision
+                else f"emulated-f64(~{self.mantissa_bits}bit)")
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["precision"] = self.precision
+        return d
+
+
+_profile: Optional[DeviceProfile] = None
+_warned_mismatch: set = set()
+
+
+def _probe() -> DeviceProfile:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+
+    @jax.jit
+    def two_sum_err(a, b):
+        s = a + b
+        bb = s - a
+        return (a - (s - bb)) + (b - bb)
+
+    err = float(two_sum_err(jnp.float64(1.0), jnp.float64(_PROBE_B)))
+    defect = abs(err - _PROBE_ERR_EXPECTED)
+    # native f64 recovers the word exactly; the emulated path returns
+    # ~2^-91 garbage, a defect of order 2^-53
+    f64_native = defect < 2.0 ** -70
+
+    @jax.jit
+    def frac_alive(ks):
+        one = jnp.float64(1.0)
+        # the barrier stops XLA from reassociating (1 + eps) - 1 -> eps;
+        # it does NOT mask genuine excess-precision arithmetic (DESIGN.md)
+        s = jax.lax.optimization_barrier(one + jnp.power(2.0, -ks))
+        return (s - one) > 0
+
+    ks = jnp.arange(20, 80, dtype=jnp.float64)
+    alive = np.asarray(frac_alive(ks))
+    mantissa_bits = int(np.asarray(ks)[alive].max()) if alive.any() else 0
+
+    return DeviceProfile(
+        platform=str(dev.platform),
+        device_kind=str(getattr(dev, "device_kind", dev.platform)),
+        num_devices=len(jax.devices()),
+        f64_native=bool(f64_native),
+        mantissa_bits=mantissa_bits,
+        two_sum_error=float(defect),
+        jax_version=str(jax.__version__),
+    )
+
+
+def device_profile(refresh: bool = False) -> DeviceProfile:
+    """The cached :class:`DeviceProfile` of the default backend (probed
+    once per process; ``refresh=True`` re-probes)."""
+    global _profile
+    if _profile is None or refresh:
+        _profile = _probe()
+        if _profile.degraded_precision:
+            log.warning(
+                f"Device preflight: {_profile.platform} f64 is "
+                f"{_profile.precision} (two_sum defect "
+                f"{_profile.two_sum_error:.2e}); time-critical paths use "
+                "the exact-by-construction decomposition (DESIGN.md)")
+    return _profile
+
+
+def platform_matches(actual: str, requested: str) -> bool:
+    """Platform equality up to the tpu/axon aliasing (the axon relay
+    reports either name for the same accelerator)."""
+    if actual == requested:
+        return True
+    return actual in TPU_PLATFORMS and requested in TPU_PLATFORMS
+
+
+def check_device(requested: Optional[str] = None,
+                 policy: Optional[str] = None) -> DeviceProfile:
+    """Preflight gate for fitting entry points.
+
+    ``requested`` defaults to ``PINT_TPU_REQUIRE_PLATFORM`` (unset means
+    "no requirement" — the profile is still probed and returned).  On a
+    mismatch the policy (default :func:`pint_tpu.config.device_policy`)
+    decides: ``strict`` raises :class:`DeviceMismatchError`, ``warn``
+    logs once per (actual, requested) pair, ``allow`` is silent.
+    """
+    prof = device_profile()
+    if requested is None:
+        requested = os.environ.get("PINT_TPU_REQUIRE_PLATFORM") or None
+    if requested is None or platform_matches(prof.platform, requested):
+        return prof
+    policy = policy or config.device_policy()
+    msg = (f"Device preflight: computations execute on "
+           f"{prof.platform!r} ({prof.precision}) but {requested!r} was "
+           "required — a silent fallback would produce numbers from the "
+           "wrong device")
+    if policy == "strict":
+        raise DeviceMismatchError(msg)
+    if policy == "warn" and (prof.platform, requested) not in _warned_mismatch:
+        _warned_mismatch.add((prof.platform, requested))
+        log.warning(msg)
+    return prof

@@ -134,10 +134,14 @@ class NpzBackend:
         return os.path.exists(self.path)
 
     def save(self, sampler: "EnsembleSampler") -> None:
+        import os
         import pickle
 
+        # atomic write (tmp + rename), same discipline as the grid sweep
+        # chunks: a crash mid-save must not corrupt the only checkpoint
+        tmp = self.path + ".tmp.npz"
         np.savez(
-            self.path,
+            tmp,
             chain=np.asarray(sampler._chain),
             lnprob=np.asarray(sampler._lnprob),
             naccepted=sampler.naccepted,
@@ -145,9 +149,11 @@ class NpzBackend:
             nwalkers=sampler.nwalkers,
             a=sampler.a,
             ndim=sampler.ndim if sampler.ndim is not None else -1,
+            fingerprint=np.array(sampler.fingerprint or ""),
             rng_state=np.frombuffer(
                 pickle.dumps(sampler.rng.bit_generator.state), dtype=np.uint8),
         )
+        os.replace(tmp, self.path)
 
     def load_into(self, sampler: "EnsembleSampler") -> np.ndarray:
         """Restore state; returns the last walker positions to resume from."""
@@ -158,6 +164,16 @@ class NpzBackend:
                 raise ValueError(
                     f"backend has {int(d['nwalkers'])} walkers, sampler has "
                     f"{sampler.nwalkers}")
+            stored_fp = str(d["fingerprint"]) if "fingerprint" in d else ""
+            if sampler.fingerprint and stored_fp \
+                    and stored_fp != sampler.fingerprint:
+                from pint_tpu.exceptions import CheckpointError
+
+                raise CheckpointError(
+                    f"{self.path}: checkpoint belongs to a different run "
+                    "(model/TOAs fingerprint mismatch); refusing to "
+                    "continue the wrong chain — delete the file to start "
+                    "over")
             sampler._chain = list(d["chain"])
             sampler._lnprob = list(d["lnprob"])
             sampler.naccepted = int(d["naccepted"])
@@ -205,11 +221,19 @@ class EnsembleSampler(MCMCSampler):
 
     def __init__(self, nwalkers: int, a: float = 2.0,
                  seed: Optional[int] = None, backend=None,
-                 checkpoint_every: int = 50, mesh=None):
+                 checkpoint_every: int = 50, mesh=None,
+                 retries: int = 2, retry_backoff: float = 0.5):
         super().__init__()
         if nwalkers % 2:
             raise ValueError("nwalkers must be even (half-ensemble updates)")
         self.nwalkers = nwalkers
+        # transient device loss during a batched lnposterior evaluation is
+        # retried with exponential backoff (runtime guardrail); anything
+        # non-device-shaped propagates immediately
+        from pint_tpu.runtime.checkpoint import RetryPolicy
+
+        self.retry_policy = RetryPolicy(max_retries=retries,
+                                        backoff_base=retry_backoff)
         self.a = a
         self.rng = np.random.default_rng(seed)
         self.method = "stretch"
@@ -222,6 +246,11 @@ class EnsembleSampler(MCMCSampler):
         self.backend = (NpzBackend(backend) if isinstance(backend, str)
                         else backend)
         self.checkpoint_every = checkpoint_every
+        #: optional run-identity string (see runtime.checkpoint
+        #: fingerprint_of); when set, saved into checkpoints and verified
+        #: on resume so a checkpoint from a different model/TOAs cannot
+        #: silently continue the wrong chain
+        self.fingerprint: Optional[str] = None
         # mesh: shard the walker axis of every batched lnposterior call
         # over the first mesh axis — the TPU replacement for the reference's
         # process/MPI walker pools (scripts/event_optimize.py:804-905).
@@ -234,7 +263,14 @@ class EnsembleSampler(MCMCSampler):
         self.mesh = mesh
 
     def _eval_lnpost(self, pts: np.ndarray) -> np.ndarray:
-        """Batched lnposterior, optionally walker-sharded over the mesh."""
+        """Batched lnposterior with device-loss retry, optionally
+        walker-sharded over the mesh."""
+        from pint_tpu.runtime.checkpoint import with_retries
+
+        return with_retries(lambda: self._eval_lnpost_once(pts),
+                            self.retry_policy, what="lnposterior batch")
+
+    def _eval_lnpost_once(self, pts: np.ndarray) -> np.ndarray:
         if self.mesh is None:
             return np.array(self._lnpost_batch(pts), dtype=np.float64)
         import jax

@@ -21,8 +21,14 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from pint_tpu.exceptions import NonFiniteSystemError
 from pint_tpu.fitter import DownhillFitter, Fitter, LMFitter
-from pint_tpu.gls_fitter import _solve_cholesky, _solve_svd, gls_normal_equations
+from pint_tpu.gls_fitter import (
+    _CHOLESKY_FAILURES,
+    _solve_cholesky,
+    _solve_svd,
+    gls_normal_equations,
+)
 from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
 from pint_tpu.utils import normalize_designmatrix, weighted_mean
@@ -254,6 +260,10 @@ class WidebandTOAFitter(Fitter):
         self.converged = False
         self.parameter_covariance_matrix = None
         self.errors: Dict[str, float] = {}
+        from pint_tpu.runtime.preflight import check_device
+
+        self.device_profile = check_device()
+        self.solve_diagnostics = None
 
     def make_combined_residuals(self) -> WidebandTOAResiduals:
         """Fresh combined TOA+DM residuals under the current model
@@ -328,11 +338,12 @@ class WidebandTOAFitter(Fitter):
             mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         if threshold <= 0:
             try:
-                xvar, xhat = _solve_cholesky(mtcm, mtcy)
-            except np.linalg.LinAlgError:
-                xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+                xvar, xhat, diag = _solve_cholesky(mtcm, mtcy)
+            except _CHOLESKY_FAILURES:
+                xvar, xhat, diag = _solve_svd(mtcm, mtcy, threshold, params)
         else:
-            xvar, xhat = _solve_svd(mtcm, mtcy, threshold, params)
+            xvar, xhat, diag = _solve_svd(mtcm, mtcy, threshold, params)
+        self.solve_diagnostics = diag
         dpars = xhat / norm
         errs = np.sqrt(np.diag(xvar)) / norm
         covmat = (xvar / norm).T / norm
@@ -369,6 +380,12 @@ class WidebandTOAFitter(Fitter):
             if not full_cov:
                 self._store_noise_ampls(dpars, len(params))
         chi2 = self.resids.calc_chi2()
+        if np.isnan(chi2):
+            # inf is a legitimate sentinel (zero DM errors); NaN is a
+            # poisoned solve and must not pass silently
+            raise NonFiniteSystemError(
+                "wideband fit produced NaN chi2 (non-finite residuals or "
+                "a poisoned solve)")
         self.converged = True
         self.update_model(chi2)
         return chi2

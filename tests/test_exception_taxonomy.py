@@ -1,0 +1,198 @@
+"""The exception taxonomy under fire: each typed failure is actually
+raised (or warned) by the fitters on crafted degenerate inputs — not just
+importable from :mod:`pint_tpu.exceptions`.
+"""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+PAR = """
+PSR  J0000+0000
+RAJ  04:37:00.0
+DECJ -47:15:00.0
+POSEPOCH 55000
+F0   173.6879489990983 1
+F1   -1.728e-15 1
+PEPOCH 55000
+DM   2.64476 1
+EPHEM DE440
+UNITS TDB
+"""
+
+#: two JUMPs selecting the same MJD range: exactly duplicate design
+#: columns, the canonical degenerate direction
+DUP_JUMPS = "JUMP mjd 54000 54700 0 1\nJUMP mjd 54000 54700 0 1\n"
+
+RED_NOISE = "TNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 5\n"
+
+
+def _model(extra=""):
+    from pint_tpu.models import get_model
+
+    return get_model(io.StringIO(PAR + extra))
+
+
+def _toas(m, n=40, seed=3):
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    return make_fake_toas_uniform(54000, 55500, n, m, error_us=1.0,
+                                  add_noise=True,
+                                  rng=np.random.default_rng(seed))
+
+
+class TestHierarchy:
+    def test_subclass_relations(self):
+        from pint_tpu import exceptions as e
+
+        assert issubclass(e.MaxiterReached, e.ConvergenceFailure)
+        assert issubclass(e.StepProblem, e.ConvergenceFailure)
+        assert issubclass(e.SingularMatrixError, e.ConvergenceFailure)
+        assert issubclass(e.NonFiniteSystemError, e.ConvergenceFailure)
+        assert issubclass(e.ConvergenceFailure, e.PintError)
+        assert issubclass(e.DeviceMismatchError, e.DeviceError)
+        assert issubclass(e.DeviceLostError, e.DeviceError)
+        assert issubclass(e.DegeneracyWarning, UserWarning)
+
+
+class TestStepProblem:
+    def test_wls_raised_at_converged_point(self):
+        """At an already-converged point with a negative chi2-increase
+        tolerance, the first step cannot 'improve' — the state machine
+        must raise StepProblem, not loop or return a stale chi2."""
+        from pint_tpu.exceptions import StepProblem
+        from pint_tpu.fitter import DownhillWLSFitter
+
+        m = _model()
+        t = _toas(m)
+        f = DownhillWLSFitter(t, m)
+        f.fit_toas(maxiter=10)
+        f2 = DownhillWLSFitter(t, f.model)
+        with pytest.raises(StepProblem):
+            f2.fit_toas(maxiter=5, max_chi2_increase=-1.0)
+
+    def test_gls_raised_at_converged_point(self):
+        from pint_tpu.exceptions import ConvergenceFailure, StepProblem
+        from pint_tpu.gls_fitter import DownhillGLSFitter
+
+        m = _model(RED_NOISE)
+        t = _toas(m)
+        f = DownhillGLSFitter(t, m)
+        f.fit_toas(maxiter=10)
+        f2 = DownhillGLSFitter(t, f.model)
+        with pytest.raises(StepProblem) as exc:
+            f2.fit_toas(maxiter=5, max_chi2_increase=-1.0)
+        # StepProblem IS a ConvergenceFailure: callers catching the base
+        # class see every flavor of non-convergence
+        assert isinstance(exc.value, ConvergenceFailure)
+
+
+class TestMaxiterReached:
+    def _perturbed(self):
+        from pint_tpu.fitter import DownhillWLSFitter
+
+        m = _model()
+        t = _toas(m)
+        f = DownhillWLSFitter(t, m)
+        f.fit_toas(maxiter=10)
+        err = f.errors.get("F0", 1e-10)
+        f2 = DownhillWLSFitter(t, f.model)
+        f2.model.F0.value = f.model.F0.value + 50 * err
+        f2.update_resids()
+        return f2
+
+    def test_raised_when_requested(self):
+        from pint_tpu.exceptions import MaxiterReached
+
+        f = self._perturbed()
+        with pytest.raises(MaxiterReached):
+            f.fit_toas(maxiter=1, raise_on_maxiter=True)
+
+    def test_warned_by_default(self):
+        """Default behavior stays a log warning (non-fatal): the fit
+        returns its best chi2 with converged=False."""
+        f = self._perturbed()
+        chi2 = f.fit_toas(maxiter=1)
+        assert np.isfinite(chi2)
+        assert not f.converged
+
+
+class TestDegeneracyWarning:
+    def test_wls_duplicate_jumps_warn(self):
+        from pint_tpu.exceptions import DegeneracyWarning
+        from pint_tpu.fitter import WLSFitter
+
+        m = _model(DUP_JUMPS)
+        t = _toas(m)
+        f = WLSFitter(t, m)
+        with pytest.warns(DegeneracyWarning):
+            f.fit_toas(maxiter=1)
+
+    def test_downhill_wls_duplicate_jumps_warn(self):
+        from pint_tpu.exceptions import DegeneracyWarning
+        from pint_tpu.fitter import DownhillWLSFitter
+
+        m = _model(DUP_JUMPS)
+        t = _toas(m)
+        f = DownhillWLSFitter(t, m)
+        with pytest.warns(DegeneracyWarning):
+            f.fit_toas(maxiter=3)
+
+    def test_gls_threshold_svd_warns(self):
+        """The GLS SVD path (threshold > 0) names the degenerate
+        direction instead of silently zeroing it."""
+        from pint_tpu.exceptions import DegeneracyWarning
+        from pint_tpu.gls_fitter import DownhillGLSFitter
+
+        m = _model(DUP_JUMPS + RED_NOISE)
+        t = _toas(m)
+        f = DownhillGLSFitter(t, m)
+        with pytest.warns(DegeneracyWarning):
+            f.fit_toas(maxiter=2, threshold=1e-12)
+
+    def test_gls_cholesky_path_survives_duplicates(self):
+        """The default (threshold=0) hardened ladder survives the same
+        degeneracy — finite chi2 plus recorded diagnostics, the
+        'degrade gracefully' leg of the guardrail contract."""
+        from pint_tpu.gls_fitter import DownhillGLSFitter
+
+        m = _model(DUP_JUMPS + RED_NOISE)
+        t = _toas(m)
+        f = DownhillGLSFitter(t, m)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # degeneracy may also warn
+            chi2 = f.fit_toas(maxiter=2)
+        assert np.isfinite(chi2)
+        assert f.solve_diagnostics is not None
+
+
+class TestTypedSolveFailures:
+    def test_hardened_cholesky_nonfinite_typed(self):
+        from pint_tpu.exceptions import NonFiniteSystemError
+        from pint_tpu.runtime.solve import hardened_cholesky
+
+        A = np.eye(3)
+        A[1, 1] = np.nan
+        with pytest.raises(NonFiniteSystemError):
+            hardened_cholesky(A)
+
+    def test_hardened_cholesky_indefinite_typed(self):
+        """A matrix no jitter rung can rescue raises the typed ladder
+        exhaustion, signalling the caller to escalate to SVD."""
+        from pint_tpu.exceptions import SingularMatrixError
+        from pint_tpu.runtime.solve import hardened_cholesky
+
+        A = -np.eye(3)  # negative definite: every rung fails
+        with pytest.raises(SingularMatrixError):
+            hardened_cholesky(A)
+
+    def test_correlated_errors_typed(self):
+        from pint_tpu.exceptions import CorrelatedErrors
+        from pint_tpu.fitter import WLSFitter
+
+        m = _model(RED_NOISE)
+        t = _toas(m)
+        with pytest.raises(CorrelatedErrors):
+            WLSFitter(t, m)

@@ -1,0 +1,436 @@
+"""Fault-injection suite: prove each runtime guardrail fires.
+
+Every injected fault (NaN residuals, singular noise Gram, truncated
+SPK/clock file, device loss mid-sweep, host crash mid-sweep) must be
+either *recovered* (solve ladder, chunk retry, checkpoint resume) or
+*raised as a typed pint_tpu.exceptions error* — never a silently wrong
+chi2.  Faults come from :mod:`pint_tpu.runtime.faultinject`; each test
+runs under a signal.alarm timeout so a wedged guardrail cannot stall the
+tier-1 suite.
+"""
+
+import io
+import os
+import signal
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.faultinject
+
+PAR = """
+PSR  J0000+0000
+RAJ  04:37:00.0
+DECJ -47:15:00.0
+POSEPOCH 55000
+F0   173.6879489990983 1
+F1   -1.728e-15 1
+PEPOCH 55000
+DM   2.64476 1
+EPHEM DE440
+UNITS TDB
+"""
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout():
+    """Per-test wall-clock limit (pytest-timeout is not in the image; the
+    POSIX alarm is enough for a CPU-only tier-1 run in the main thread)."""
+
+    def _fire(signum, frame):
+        raise TimeoutError("fault-injection test exceeded 120 s")
+
+    old = signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(120)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _model(extra=""):
+    from pint_tpu.models import get_model
+
+    return get_model(io.StringIO(PAR + extra))
+
+
+@pytest.fixture(scope="module")
+def wls_sim():
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = _model()
+    t = make_fake_toas_uniform(54000, 55500, 40, m, error_us=1.0,
+                               add_noise=True, rng=np.random.default_rng(3))
+    return m, t
+
+
+@pytest.fixture(scope="module")
+def gls_sim():
+    """Correlated-noise model with a guaranteed non-empty basis: power-law
+    red noise always contributes Fourier columns (uniform fake TOAs share
+    no epochs, so an ECORR basis would be empty)."""
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = _model("TNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 5\n")
+    t = make_fake_toas_uniform(54000, 55500, 40, m, error_us=1.0,
+                               add_noise=True, rng=np.random.default_rng(3))
+    return m, t
+
+
+class TestNaNResiduals:
+    def test_wls_fit_raises_typed(self, wls_sim):
+        from pint_tpu.exceptions import ConvergenceFailure
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.runtime import faultinject as fi
+
+        m, t = wls_sim
+        with fi.nan_residuals(indices=(0, 3)):
+            f = WLSFitter(t, m)
+            with pytest.raises(ConvergenceFailure):
+                f.fit_toas(maxiter=2)
+
+    def test_gls_fit_raises_typed(self, gls_sim):
+        from pint_tpu.exceptions import ConvergenceFailure
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.runtime import faultinject as fi
+
+        m, t = gls_sim
+        with fi.nan_residuals(indices=(1,)):
+            f = GLSFitter(t, m)
+            with pytest.raises(ConvergenceFailure):
+                f.fit_toas(maxiter=1)
+
+    def test_downhill_gls_raises_typed(self, gls_sim):
+        from pint_tpu.exceptions import ConvergenceFailure
+        from pint_tpu.gls_fitter import DownhillGLSFitter
+        from pint_tpu.runtime import faultinject as fi
+
+        m, t = gls_sim
+        with fi.nan_residuals(indices=(2,)):
+            f = DownhillGLSFitter(t, m)
+            with pytest.raises(ConvergenceFailure):
+                f.fit_toas(maxiter=3)
+
+    def test_on_trace_ladder_poisons_not_fabricates(self):
+        """Non-finite input to the on-trace ladder must yield NaN (rung
+        -1), never a plausible-looking solution."""
+        import jax.numpy as jnp
+
+        from pint_tpu.runtime.solve import ladder_cholesky_solve
+
+        A = jnp.full((4, 4), jnp.nan)
+        b = jnp.ones(4)
+        x, lvl, ridge, cond = ladder_cholesky_solve(A, b, 1e-12)
+        assert int(lvl) == -1
+        assert np.isnan(np.asarray(x)).all()
+        assert np.isnan(float(cond))
+
+
+class TestSingularGram:
+    def test_gls_fit_recovered_by_ladder(self, gls_sim):
+        """An exactly singular noise Gram is rescued by the jitter ladder
+        (or SVD escalation) — finite chi2, non-silent diagnostics."""
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.runtime import faultinject as fi
+
+        m, t = gls_sim
+        with fi.singular_gram():
+            f = GLSFitter(t, m)
+            chi2 = f.fit_toas(maxiter=1)
+        assert np.isfinite(chi2)
+        d = f.solve_diagnostics
+        assert d is not None
+        # the guardrail must report HOW it solved the degenerate system
+        assert d.method in ("cholesky-jitter", "svd") or d.jitter > 0
+
+    def test_singular_gram_never_silent_nan(self, gls_sim):
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.runtime import faultinject as fi
+
+        m, t = gls_sim
+        with fi.singular_gram():
+            f = GLSFitter(t, m)
+            chi2 = f.fit_toas(maxiter=1)
+        assert not np.isnan(chi2)
+
+
+class TestTruncatedFiles:
+    def test_truncated_spk_typed_error(self, tmp_path):
+        """A synthetic SPK kernel cut mid-file raises PintFileError, not
+        an opaque struct/buffer exception."""
+        import test_synthetic_spk as spk_helper
+
+        from pint_tpu.ephemeris import SPKEphemeris
+        from pint_tpu.exceptions import PintFileError
+        from pint_tpu.runtime import faultinject as fi
+
+        rng = np.random.default_rng(42)
+        init = (54000.0 - 51544.5) * 86400.0
+        recs = spk_helper._cheb_records(rng, n_rec=8, ncoef=6, init=init,
+                                        intlen=16 * 86400.0)
+        path = str(tmp_path / "synthetic.bsp")
+        spk_helper._write_spk(path, [dict(target=3, center=0, dtype=2,
+                                          records=recs, init=init,
+                                          intlen=16 * 86400.0)])
+        SPKEphemeris(path)  # intact kernel parses
+        with fi.truncated_copy(path, fraction=0.4) as cut:
+            with pytest.raises(PintFileError):
+                eph = SPKEphemeris(cut)
+                # header/summaries may survive the cut; evaluation of the
+                # missing coefficient block must then raise instead
+                eph.posvel_ssb("emb", np.array([54050.0]))
+
+    def test_truncated_spk_header_typed_error(self, tmp_path):
+        from pint_tpu.ephemeris import SPKEphemeris
+        from pint_tpu.exceptions import PintFileError
+
+        path = str(tmp_path / "stub.bsp")
+        with open(path, "wb") as f:
+            f.write(b"DAF/SPK " + b"\x00" * 40)  # cut inside the file record
+        with pytest.raises(PintFileError):
+            SPKEphemeris(path)
+
+    def test_truncated_clock_typed_error(self, tmp_path):
+        from pint_tpu.exceptions import PintFileError
+        from pint_tpu.observatory.clock_file import ClockFile
+        from pint_tpu.runtime import faultinject as fi
+
+        path = str(tmp_path / "fake.clk")
+        with open(path, "w") as f:
+            f.write("# UTC(obs) UTC\n")
+            for i in range(50):
+                f.write(f"{50000 + i:.5f} {1e-6 * i:.12e}\n")
+        ClockFile.read(path, fmt="tempo2")  # intact file parses
+        with fi.truncated_copy(path, fraction=0.63) as cut:
+            with pytest.raises(PintFileError):
+                ClockFile.read(cut, fmt="tempo2")
+
+
+@pytest.fixture(scope="module")
+def wls_grid_fit(wls_sim):
+    from pint_tpu.fitter import WLSFitter
+
+    m, t = wls_sim
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=3)
+    dF0 = 4 * f.errors.get("F0", 1e-10)
+    dF1 = 4 * f.errors.get("F1", 1e-18)
+    g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 4)
+    g1 = np.linspace(f.model.F1.value - dF1, f.model.F1.value + dF1, 4)
+    return f, (g0, g1)
+
+
+class TestCheckpointedSweep:
+    def test_device_loss_recovered_by_retry(self, wls_grid_fit, tmp_path):
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.checkpoint import RetryPolicy
+
+        f, (g0, g1) = wls_grid_fit
+        ref, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        retry = RetryPolicy(max_retries=3, backoff_base=0.0)
+        with fi.device_loss(fail_times=2) as state:
+            chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1),
+                                 checkpoint=str(tmp_path / "ck"),
+                                 chunk=4, retry=retry)
+        assert state["calls"] > 2  # the fault actually fired
+        np.testing.assert_array_equal(chi2, ref)
+
+    def test_device_loss_exhausted_is_typed(self, wls_grid_fit, tmp_path):
+        from pint_tpu.exceptions import SweepChunkFailure
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.checkpoint import RetryPolicy
+
+        f, (g0, g1) = wls_grid_fit
+        retry = RetryPolicy(max_retries=1, backoff_base=0.0)
+        with fi.device_loss(fail_times=100):
+            with pytest.raises(SweepChunkFailure):
+                grid_chisq(f, ("F0", "F1"), (g0, g1),
+                           checkpoint=str(tmp_path / "ck2"),
+                           chunk=4, retry=retry)
+
+    def test_killed_sweep_resumes_identically(self, wls_grid_fit, tmp_path):
+        """Kill the sweep after 2 chunks; a rerun against the same
+        checkpoint must reproduce the uninterrupted chi2 surface to
+        <= 1e-7 (acceptance criterion; in practice bit-identical)."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+
+        f, (g0, g1) = wls_grid_fit
+        ref, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        ck = str(tmp_path / "ck3")
+        with fi.crash_after_chunks(2):
+            with pytest.raises(fi.SimulatedCrash):
+                grid_chisq(f, ("F0", "F1"), (g0, g1), checkpoint=ck,
+                           chunk=4)
+        # two chunks made it to disk before the "crash"
+        assert len([p for p in os.listdir(ck) if p.startswith("chunk_")]) == 2
+        chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), checkpoint=ck,
+                             chunk=4)
+        np.testing.assert_allclose(chi2, ref, rtol=0, atol=1e-7)
+
+    def test_chunk_timeout_retried_then_typed(self):
+        """A wedged chunk (never returns) hits the per-attempt timeout,
+        retries, and surfaces as the typed SweepChunkFailure — on py3.10
+        concurrent.futures.TimeoutError is NOT builtin TimeoutError, so
+        this pins that both spellings count as retryable."""
+        import time
+
+        from pint_tpu.exceptions import SweepChunkFailure
+        from pint_tpu.runtime.checkpoint import RetryPolicy, with_retries
+
+        calls = {"n": 0}
+
+        def wedged():
+            calls["n"] += 1
+            time.sleep(0.5)
+
+        with pytest.raises(SweepChunkFailure):
+            with_retries(wedged, RetryPolicy(max_retries=1,
+                                             backoff_base=0.0,
+                                             timeout=0.05))
+        assert calls["n"] == 2  # original attempt + one retry
+
+    def test_fingerprint_mismatch_refused(self, wls_grid_fit, tmp_path):
+        from pint_tpu.exceptions import CheckpointError
+        from pint_tpu.grid import grid_chisq
+
+        f, (g0, g1) = wls_grid_fit
+        ck = str(tmp_path / "ck4")
+        grid_chisq(f, ("F0", "F1"), (g0, g1), checkpoint=ck, chunk=4)
+        with pytest.raises(CheckpointError):
+            grid_chisq(f, ("F0", "F1"), (g0 + 1e-9, g1), checkpoint=ck,
+                       chunk=4)
+
+
+class TestMCMCDeviceLoss:
+    def _pos(self, n, ndim, seed=7):
+        return np.random.default_rng(seed).standard_normal((n, ndim))
+
+    def test_transient_loss_retried(self):
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(pts):
+            return -np.sum(np.asarray(pts) ** 2, axis=1)
+
+        s = EnsembleSampler(8, seed=1, retries=3, retry_backoff=0.0)
+        s.initialize_batched(fi.flaky(lnpost, fail_times=2), 2)
+        s.run_mcmc(self._pos(8, 2), 5)
+        assert s.get_chain().shape == (5, 8, 2)
+
+    def test_persistent_loss_is_typed(self):
+        from pint_tpu.exceptions import PintError
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(pts):
+            return -np.sum(np.asarray(pts) ** 2, axis=1)
+
+        s = EnsembleSampler(8, seed=1, retries=1, retry_backoff=0.0)
+        s.initialize_batched(fi.flaky(lnpost, fail_times=50), 2)
+        with pytest.raises(PintError):
+            s.run_mcmc(self._pos(8, 2), 3)
+
+    def test_mcmc_checkpoint_wrong_run_refused(self, wls_sim, tmp_path):
+        """An MCMC checkpoint from a different dataset must refuse to
+        resume (run-identity fingerprint), mirroring the grid sweep."""
+        from pint_tpu.exceptions import CheckpointError
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.mcmc_fitter import MCMCFitter, set_priors_basic
+        from pint_tpu.sampler import EnsembleSampler
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m, t = wls_sim
+        f = WLSFitter(t, m)
+        f.fit_toas(maxiter=3)
+        path = str(tmp_path / "chain.npz")
+
+        def fitter(toas):
+            fm = MCMCFitter(toas, f.model,
+                            sampler=EnsembleSampler(8, seed=2))
+            set_priors_basic(fm, priorerrfact=10.0)
+            return fm
+
+        fitter(t).fit_toas(maxiter=4, seed=2, checkpoint=path)
+        t2 = make_fake_toas_uniform(54000, 55500, 30, m, error_us=1.0,
+                                    add_noise=True,
+                                    rng=np.random.default_rng(8))
+        with pytest.raises(CheckpointError):
+            fitter(t2).fit_toas(maxiter=4, seed=2, checkpoint=path)
+
+    def test_mcmc_checkpoint_resume_continues_chain(self, tmp_path):
+        """A killed-and-resumed MCMC continues the chain bit-identically
+        (NpzBackend persists the exact RNG state)."""
+        from pint_tpu.sampler import EnsembleSampler
+
+        def lnpost(pts):
+            return -np.sum(np.asarray(pts) ** 2, axis=1)
+
+        pos = self._pos(8, 2)
+        ref = EnsembleSampler(8, seed=5)
+        ref.initialize_batched(lnpost, 2)
+        ref.run_mcmc(pos, 10)
+
+        path = str(tmp_path / "chain.npz")
+        s1 = EnsembleSampler(8, seed=5, backend=path, checkpoint_every=5)
+        s1.initialize_batched(lnpost, 2)
+        s1.run_mcmc(pos, 6)  # "crash" after 6 steps (checkpoint on exit)
+        s2 = EnsembleSampler(8, seed=999, backend=path)  # seed overwritten
+        s2.initialize_batched(lnpost, 2)
+        resume_pos = s2.resume()
+        s2.run_mcmc(resume_pos, 4)
+        np.testing.assert_array_equal(s2.get_chain(), ref.get_chain())
+
+
+class TestDevicePreflight:
+    def test_profile_attached_to_fitters(self, wls_sim):
+        from pint_tpu.fitter import WLSFitter
+
+        m, t = wls_sim
+        f = WLSFitter(t, m)
+        assert f.device_profile.platform == "cpu"
+        assert f.device_profile.f64_native
+        assert f.device_profile.mantissa_bits >= 52
+
+    def test_strict_policy_raises_on_mismatch(self, wls_sim, monkeypatch):
+        from pint_tpu import config
+        from pint_tpu.exceptions import DeviceMismatchError
+        from pint_tpu.fitter import WLSFitter
+
+        m, t = wls_sim
+        monkeypatch.setenv("PINT_TPU_REQUIRE_PLATFORM", "tpu")
+        old = config.device_policy()
+        config.set_device_policy("strict")
+        try:
+            with pytest.raises(DeviceMismatchError):
+                WLSFitter(t, m)
+        finally:
+            config.set_device_policy(old)
+
+    def test_allow_policy_is_silent(self, wls_sim, monkeypatch):
+        from pint_tpu import config
+        from pint_tpu.fitter import WLSFitter
+
+        m, t = wls_sim
+        monkeypatch.setenv("PINT_TPU_REQUIRE_PLATFORM", "tpu")
+        old = config.device_policy()
+        config.set_device_policy("allow")
+        try:
+            f = WLSFitter(t, m)
+            assert f.device_profile.platform == "cpu"
+        finally:
+            config.set_device_policy(old)
+
+    def test_grid_diagnostics_attached(self, wls_grid_fit):
+        from pint_tpu.grid import grid_chisq
+
+        f, (g0, g1) = wls_grid_fit
+        chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1))
+        d = f.last_grid_diagnostics
+        assert d["ladder_rung"].shape == chi2.shape
+        assert (d["ladder_rung"] >= 0).all()  # no poisoned points
+        assert np.isfinite(d["condition"]).all()
+        assert d["device_profile"].platform == "cpu"

@@ -268,11 +268,16 @@ class TestGridExecutableReuse:
         f.toas._version += 1  # any in-place TOA mutation bumps this
         c3, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=4)
         assert f.model._cache["grid_gls_bundle"] is not slot1  # rebuilt
-        # the Jacobian probe must also rerun on the post-mutation TOAs
-        # (the classify cache keys on _version, not just the object)
+        # the Jacobian probe must also rerun on the post-mutation TOAs,
+        # but by OVERWRITING the single classify entry (version lives in
+        # the cached value, not the key) — keying on _version leaked a
+        # ~MB-scale Jacobian per in-place edit (ADVICE.md round 5)
         nclass1 = sum(1 for k in f.model._cache
                       if isinstance(k, tuple) and k[0] == "grid_classify")
-        assert nclass1 == nclass0 + 1
+        assert nclass1 == nclass0
+        ckey = next(k for k in f.model._cache
+                    if isinstance(k, tuple) and k[0] == "grid_classify")
+        assert f.model._cache[ckey][-1] == f.toas._version  # re-probed
         np.testing.assert_array_equal(c1, c3)
         f.toas._version -= 1  # module-scoped fixture: restore
 
@@ -665,3 +670,76 @@ grid_chisq(f, ("PB", "ECC"), (g0, g1), niter=2, chunk=4)
         assert n_scatter_shapes > 0, \
             "no scatter shapes matched; the HLO scan is no longer seeing ops"
         assert not bad, f"TOA-dimension scatter reappeared: {bad[:3]}"
+
+
+class TestBundleKeySatellites:
+    """Self-contained (no reference datafiles): the two bundle-vkey
+    satellite fixes — nfit in the key, and mask-parameter selector ranges
+    in the key."""
+
+    PAR = """
+PSR  J0000+0000
+RAJ  04:37:00.0
+DECJ -47:15:00.0
+POSEPOCH 55000
+F0   173.6879489990983 1
+F1   -1.728e-15 1
+PEPOCH 55000
+DM   2.64476 1
+EPHEM DE440
+UNITS TDB
+TNREDAMP -13.0
+TNREDGAM 3.0
+TNREDC 5
+EFAC mjd 54000 55500 1.3
+"""
+
+    @pytest.fixture(scope="class")
+    def sim(self):
+        import io
+
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = get_model(io.StringIO(self.PAR))
+        t = make_fake_toas_uniform(54000, 55500, 40, m, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(11))
+        return m, t
+
+    def test_vkey_includes_nfit(self, sim):
+        """Two builds with coinciding all_names but different fit/grid
+        partitions must not collide into one bundle (the hoisted basis
+        has 1+nfit columns; a collision is a trace-time shape error)."""
+        from pint_tpu.grid import build_grid_gls_chi2_fn
+
+        m, t = sim
+        fn1, _, _ = build_grid_gls_chi2_fn(m, t, ("F0",),
+                                           fit_params=("F1",), niter=1)
+        v0 = float(m.F0.value)
+        fn1(np.array([[v0]]))
+        # same all_names tuple ("F1", "F0"), nfit 0 instead of 1
+        fn2, _, _ = build_grid_gls_chi2_fn(m, t, ("F1", "F0"),
+                                           fit_params=(), niter=1)
+        chi2, _, _ = fn2(np.array([[float(m.F1.value), v0]]))
+        assert np.isfinite(np.asarray(chi2)).all()
+
+    def test_vkey_includes_mask_selector(self, sim):
+        """Editing an EFAC selector's MJD range at an unchanged VALUE
+        changes the weights; the cached Gram/Cholesky bundle must
+        invalidate (stale weights would silently skew every chi2)."""
+        from pint_tpu.grid import build_grid_gls_chi2_fn
+
+        m, t = sim
+        build_grid_gls_chi2_fn(m, t, ("F0", "F1"), niter=1)
+        slot1 = m._cache["grid_gls_bundle"]
+        build_grid_gls_chi2_fn(m, t, ("F0", "F1"), niter=1)
+        assert m._cache["grid_gls_bundle"] is slot1  # stable when unchanged
+        efac = m.components["ScaleToaError"]._params_dict["EFAC1"]
+        old = list(efac.key_value)
+        efac.key_value = ["54000", "54700"]  # same value, new selection
+        try:
+            build_grid_gls_chi2_fn(m, t, ("F0", "F1"), niter=1)
+            assert m._cache["grid_gls_bundle"] is not slot1  # rebuilt
+        finally:
+            efac.key_value = old
