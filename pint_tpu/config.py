@@ -6,7 +6,8 @@ from __future__ import annotations
 import os
 
 __all__ = ["datadir", "examplefile", "runtimefile",
-           "device_policy", "set_device_policy", "DEVICE_POLICIES"]
+           "device_policy", "set_device_policy", "DEVICE_POLICIES",
+           "ingestion_policy", "set_ingestion_policy", "INGESTION_POLICIES"]
 
 #: what to do when the preflight probe finds the executing platform differs
 #: from the requested one (``PINT_TPU_REQUIRE_PLATFORM``):
@@ -32,6 +33,36 @@ def set_device_policy(policy: str) -> None:
         raise ValueError(
             f"device policy must be one of {DEVICE_POLICIES}, got {policy!r}")
     _device_policy = policy
+
+
+#: what ingestion (par/tim parsing + TOA validation) does with suspect input
+#: (``PINT_TPU_INGESTION_POLICY``): ``strict`` raises a typed
+#: :class:`~pint_tpu.exceptions.FileSyntaxError` /
+#: :class:`~pint_tpu.exceptions.TOAIntegrityError` on the first problem,
+#: ``lenient`` records a :class:`~pint_tpu.integrity.Diagnostics` entry
+#: (with a log warning), skips/quarantines the offender, and keeps the good
+#: rows, ``collect`` does the same silently so callers can inspect the full
+#: report in one pass.
+INGESTION_POLICIES = ("strict", "lenient", "collect")
+
+_ingestion_policy = os.environ.get("PINT_TPU_INGESTION_POLICY", "strict")
+if _ingestion_policy not in INGESTION_POLICIES:
+    _ingestion_policy = "strict"
+
+
+def ingestion_policy() -> str:
+    """Current ingestion policy: strict | lenient | collect."""
+    return _ingestion_policy
+
+
+def set_ingestion_policy(policy: str) -> None:
+    """Set the ingestion policy for this process."""
+    global _ingestion_policy
+    if policy not in INGESTION_POLICIES:
+        raise ValueError(
+            f"ingestion policy must be one of {INGESTION_POLICIES}, "
+            f"got {policy!r}")
+    _ingestion_policy = policy
 
 
 def datadir() -> str:

@@ -30,6 +30,12 @@ __all__ = [
     "ClockCorrectionOutOfRange",
     "NoClockCorrections",
     "PintFileError",
+    "ParSyntaxError",
+    "TimSyntaxError",
+    "PintPickleError",
+    "TOAIntegrityError",
+    "InvalidTOAError",
+    "UsageError",
     "PrecisionError",
 ]
 
@@ -189,6 +195,63 @@ class NoClockCorrections(ClockCorrectionError):
 
 class PintFileError(PintError):
     """Malformed par/tim/clock/ephemeris file."""
+
+
+class FileSyntaxError(PintFileError, ValueError):
+    """A parse failure pinned to a file location.
+
+    Carries ``file``/``line``/``column`` (1-based, None when unknown) and
+    the offending ``token``, so ingestion errors are actionable instead of
+    bare messages.  Subclasses ``ValueError`` because these sites
+    historically raised ``ValueError``/``PintFileError`` and callers may
+    catch either.
+    """
+
+    def __init__(self, msg: str, file: str | None = None,
+                 line: int | None = None, column: int | None = None,
+                 token: str | None = None):
+        self.file, self.line, self.column, self.token = file, line, column, token
+        where = ""
+        if file is not None:
+            where = f"{file}:"
+        if line is not None:
+            where += f"{line}:"
+        if column is not None:
+            where += f"{column}:"
+        if token is not None and token not in msg:
+            msg = f"{msg} (offending token {token!r})"
+        super().__init__(f"{where} {msg}" if where else msg)
+
+
+class ParSyntaxError(FileSyntaxError):
+    """Malformed par-file content (bad key, unparseable value/exponent)."""
+
+
+class TimSyntaxError(FileSyntaxError):
+    """Malformed tim-file content (bad TOA line, flag, or directive)."""
+
+
+class PintPickleError(PintFileError, IOError):
+    """No readable TOA pickle could be found/loaded."""
+
+
+class InvalidTOAError(PintError, ValueError):
+    """Invalid TOA construction or flag value (programmatic input, not a
+    file-parse problem)."""
+
+
+class TOAIntegrityError(PintError, ValueError):
+    """``TOAs.validate()`` found quarantine-class rows under the strict
+    ingestion policy.  The full :class:`pint_tpu.integrity.QuarantineReport`
+    rides on ``.report``."""
+
+    def __init__(self, msg: str, report=None):
+        self.report = report
+        super().__init__(msg)
+
+
+class UsageError(PintError, ValueError):
+    """Invalid argument or argument combination passed to a public API."""
 
 
 class PrecisionError(PintError):

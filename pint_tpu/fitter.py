@@ -25,6 +25,7 @@ from pint_tpu.exceptions import (
     MaxiterReached,
     NonFiniteSystemError,
     StepProblem,
+    UsageError,
 )
 from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
@@ -39,10 +40,17 @@ __all__ = ["Fitter", "WLSFitter", "DownhillFitter", "DownhillWLSFitter",
 class Fitter:
     """Base fitter: holds a model copy, TOAs, residuals, and fit products."""
 
+    #: class-level defaults so subclasses with bespoke __init__ (wideband,
+    #: MCMC) still carry the robust/quarantine state slots
+    robust_weights = None
+    robust_iterations = 0
+    toas_full = None
+
     def __init__(self, toas, model, residuals: Optional[Residuals] = None,
                  track_mode: Optional[str] = None):
         from pint_tpu.runtime.preflight import check_device
 
+        toas = self._consume_quarantine(toas)
         self.toas = toas
         self.model_init = model
         self.model = copy.deepcopy(model)
@@ -58,6 +66,10 @@ class Fitter:
         # required platform fails loudly per the config policy
         self.device_profile = check_device()
         self.solve_diagnostics = None
+        #: per-TOA IRLS weights after a fit_toas(robust=...); None for a
+        #: plain (non-robust) fit
+        self.robust_weights = None
+        self.robust_iterations = 0
 
     # -- reference-parity constructor dispatch ------------------------------
     @staticmethod
@@ -78,9 +90,101 @@ class Fitter:
         return (DownhillWLSFitter if downhill else WLSFitter)(toas, model, **kw)
 
     # -- helpers ------------------------------------------------------------
+    def _consume_quarantine(self, toas):
+        """Quarantined rows (TOAs.validate) never reach a fit: returns the
+        certified complement, keeping the full container reachable as
+        ``self.toas_full`` for the doctor audit.  Every fitter __init__ —
+        including the wideband family's bespoke ones — routes its TOAs
+        through here."""
+        qm = getattr(toas, "quarantine_mask", None)
+        if qm is not None and np.any(qm):
+            self.toas_full = toas
+            toas = toas.certified()
+            log.info(f"{type(self).__name__}: {int(np.sum(qm))} quarantined "
+                     f"TOA(s) excluded; fitting {len(toas)} certified rows")
+        return toas
+
     def update_resids(self):
         self.resids = Residuals(self.toas, self.model, track_mode=self.track_mode)
         return self.resids
+
+    def _data_sigma(self) -> np.ndarray:
+        """Scaled TOA uncertainties the linear solves consume; under an
+        active robust (IRLS) fit the current Huber weights enter as
+        sigma/sqrt(w), so a healthy fit (weights None) pays nothing."""
+        sigma = np.asarray(self.resids.get_data_error())
+        if self.robust_weights is not None:
+            w = np.asarray(self.robust_weights, dtype=np.float64)
+            sigma = sigma / np.sqrt(np.maximum(w, 1e-12))
+        return sigma
+
+    def _robust_update_weights(self, huber_k: float) -> np.ndarray:
+        """Recompute Huber weights from the CURRENT whitened residuals,
+        centered on their median: the phase-mean subtraction inside
+        Residuals is itself non-robust (outliers drag it), and the
+        constant shift is absorbed by the design matrix's Offset column
+        anyway — without the recentering every row would look displaced
+        and the weights would stop naming the actual outliers."""
+        from pint_tpu.integrity.robust import huber_weights
+
+        z = np.asarray(self.resids.time_resids) \
+            / np.asarray(self.resids.get_data_error())
+        finite = np.isfinite(z)
+        if finite.any():
+            z = z - np.median(z[finite])
+        return huber_weights(z, k=huber_k)
+
+    @staticmethod
+    def _check_robust_arg(robust):
+        if robust not in (None, False, "huber"):
+            raise UsageError(
+                f"robust must be None or 'huber', got {robust!r}")
+        return bool(robust)
+
+    def _run_irls(self, inner_fit, huber_k: Optional[float],
+                  robust_maxiter: int, robust_tol: float,
+                  tolerate_step_problem: bool = False) -> float:
+        """The one IRLS harness both robust entry points share: weights
+        from the current residuals, ``inner_fit()`` with weights held
+        fixed, reweight, repeat until the weights settle.  With
+        ``tolerate_step_problem`` an inner fit that can no longer decrease
+        its (reweighted) objective after the first round falls through to
+        the convergence check instead of raising.  Reports the PLAIN
+        (unweighted) chi2, the same statistic as a non-robust fit."""
+        from pint_tpu.integrity.robust import HUBER_K, irls_converged
+
+        k = huber_k if huber_k is not None else HUBER_K
+        self.update_resids()
+        self.robust_weights = self._robust_update_weights(k)
+        for it in range(max(1, robust_maxiter)):
+            self.robust_iterations = it + 1
+            try:
+                inner_fit()
+            except StepProblem:
+                if not tolerate_step_problem or it == 0:
+                    raise
+                # the reweighted objective is already at its minimum for
+                # these weights; fall through to the convergence check
+            w_new = self._robust_update_weights(k)
+            done = irls_converged(self.robust_weights, w_new, robust_tol)
+            self.robust_weights = w_new
+            if done:
+                break
+        else:
+            log.warning(f"Huber IRLS hit robust_maxiter={robust_maxiter} "
+                        "without the weights settling")
+        chi2 = self.resids.chi2
+        self.update_model(chi2)
+        return chi2
+
+    def doctor(self, designmatrix: bool = True) -> str:
+        """Human-readable audit of this fit's inputs and state: device
+        profile, TOA quarantine report, model/TOA compatibility findings
+        (mask params selecting nothing, degenerate free-parameter pairs),
+        and robust downweighting (:mod:`pint_tpu.integrity.doctor`)."""
+        from pint_tpu.integrity.doctor import render_doctor_report
+
+        return render_doctor_report(self, designmatrix=designmatrix)
 
     def update_model(self, chi2: Optional[float] = None):
         """Stamp fit products and TOA properties into the model (reference
@@ -210,7 +314,7 @@ class Fitter:
             return {p: getattr(self.model, p).value for p in names}
         if kind == "uncertainty":
             return {p: getattr(self.model, p).uncertainty for p in names}
-        raise ValueError(f"Unknown kind {kind!r}")
+        raise UsageError(f"Unknown kind {kind!r}")
 
     def set_params(self, fitp: dict) -> None:
         """Set parameter values from a {name: value} mapping (reference
@@ -296,7 +400,7 @@ class Fitter:
         comps = component if isinstance(component, (list, tuple)) \
             else [component] * len(params)
         if not remove and len(comps) != len(params):
-            raise ValueError("one component per parameter required")
+            raise UsageError("one component per parameter required")
         m = copy.deepcopy(self.model)
         if remove:
             for p in params:
@@ -304,7 +408,7 @@ class Fitter:
         else:
             for p, cname in zip(params, comps):
                 if cname not in m.components:
-                    raise ValueError(f"component {cname!r} not in model")
+                    raise UsageError(f"component {cname!r} not in model")
                 par = copy.deepcopy(p)
                 par.frozen = False
                 m.components[cname].add_param(par, setup=True)
@@ -405,11 +509,37 @@ class WLSFitter(Fitter):
         self.method = "weighted_least_square"
 
     def fit_toas(self, maxiter: int = 1, threshold: Optional[float] = None,
-                 debug: bool = False) -> float:
+                 debug: bool = False, robust=None,
+                 huber_k: Optional[float] = None, robust_maxiter: int = 30,
+                 robust_tol: float = 1e-3) -> float:
+        """One-shot WLS fit; ``robust="huber"`` wraps the solve in a
+        host-side IRLS loop that Huber-downweights outlier TOAs (weights
+        exposed as ``self.robust_weights`` and in :meth:`doctor`)."""
+        if self._check_robust_arg(robust):
+            return self._fit_toas_robust(maxiter=maxiter, threshold=threshold,
+                                         huber_k=huber_k,
+                                         robust_maxiter=robust_maxiter,
+                                         robust_tol=robust_tol)
+        # a plain fit must never inherit weights from an earlier robust
+        # fit on this same fitter — _data_sigma would keep applying them
+        self.robust_weights = None
+        self.robust_iterations = 0
+        return self._fit_wls(maxiter=maxiter, threshold=threshold)
+
+    def _fit_toas_robust(self, maxiter: int, threshold: Optional[float],
+                         huber_k: Optional[float], robust_maxiter: int,
+                         robust_tol: float) -> float:
+        return self._run_irls(
+            lambda: self._fit_wls(maxiter=maxiter, threshold=threshold),
+            huber_k=huber_k, robust_maxiter=robust_maxiter,
+            robust_tol=robust_tol)
+
+    def _fit_wls(self, maxiter: int = 1,
+                 threshold: Optional[float] = None) -> float:
         chi2 = self.resids.chi2
         for _ in range(max(1, maxiter)):
             r = self.resids.time_resids
-            sigma = self.resids.get_data_error()
+            sigma = self._data_sigma()
             M, params, units = self.get_designmatrix()
             dpars, cov, S = _wls_step(M, params, r, sigma, threshold)
             for dp, p in zip(dpars, params):
@@ -442,17 +572,29 @@ class DownhillFitter(Fitter):
 
     def _solve_step(self):
         r = self.resids.time_resids
-        sigma = self.resids.get_data_error()
+        sigma = self._data_sigma()
         M, params, units = self.get_designmatrix()
         dpars, cov, S = _wls_step(M, params, r, sigma)
         return dpars, params, cov
+
+    def _fit_metric(self) -> float:
+        """The scalar the downhill line search minimizes: plain chi2, or
+        the Huber-weighted chi2 while an IRLS pass holds weights fixed
+        (so a robust step that shrugs off an outlier is still accepted)."""
+        if self.robust_weights is None:
+            return self.resids.chi2
+        r = np.asarray(self.resids.time_resids)
+        s = np.asarray(self.resids.get_data_error())
+        return float(np.sum(self.robust_weights * (r / s) ** 2))
 
     def fit_toas(self, maxiter: int = 20, required_chi2_decrease: float = 1e-2,
                  max_chi2_increase: float = 1e-2, min_lambda: float = 1e-3,
                  debug: bool = False, noise_fit_niter: int = 2,
                  noisefit_method: str = "L-BFGS-B",
                  compute_noise_uncertainties: bool = True,
-                 raise_on_maxiter: bool = False) -> float:
+                 raise_on_maxiter: bool = False, robust=None,
+                 huber_k: Optional[float] = None, robust_maxiter: int = 30,
+                 robust_tol: float = 1e-3) -> float:
         """Downhill timing fit; when any noise parameter is unfrozen the
         timing fit alternates with ML noise fits (reference
         ``fitter.py:1086-1150``): ``noise_fit_niter`` rounds of
@@ -460,7 +602,30 @@ class DownhillFitter(Fitter):
         then one final timing fit at the updated noise values.
 
         ``raise_on_maxiter=True`` turns the exhausted-iteration warning
-        into a typed :class:`~pint_tpu.exceptions.MaxiterReached`."""
+        into a typed :class:`~pint_tpu.exceptions.MaxiterReached`.
+        ``robust="huber"`` wraps the downhill fit in a host-side IRLS
+        loop (WLS-family fitters only)."""
+        if self._check_robust_arg(robust):
+            if not isinstance(self, DownhillWLSFitter) \
+                    and type(self) is not DownhillFitter:
+                raise UsageError(
+                    "robust fitting is available on the WLS-family fitters "
+                    "only (Huber IRLS assumes uncorrelated errors)")
+            if self._get_free_noise_params():
+                raise UsageError(
+                    "robust fitting cannot be combined with free noise "
+                    "parameters; freeze them or fit noise separately")
+            return self._fit_toas_robust_downhill(
+                maxiter=maxiter,
+                required_chi2_decrease=required_chi2_decrease,
+                max_chi2_increase=max_chi2_increase, min_lambda=min_lambda,
+                debug=debug, raise_on_maxiter=raise_on_maxiter,
+                huber_k=huber_k, robust_maxiter=robust_maxiter,
+                robust_tol=robust_tol)
+        # a plain fit must never inherit weights from an earlier robust
+        # fit on this same fitter (_solve_step/_fit_metric consume them)
+        self.robust_weights = None
+        self.robust_iterations = 0
         if self._get_free_noise_params():
             kw = dict(maxiter=maxiter,
                       required_chi2_decrease=required_chi2_decrease,
@@ -482,13 +647,21 @@ class DownhillFitter(Fitter):
             max_chi2_increase=max_chi2_increase, min_lambda=min_lambda,
             debug=debug, raise_on_maxiter=raise_on_maxiter)
 
+    def _fit_toas_robust_downhill(self, huber_k: Optional[float],
+                                  robust_maxiter: int, robust_tol: float,
+                                  **timing_kw) -> float:
+        return self._run_irls(
+            lambda: self._fit_toas_timing(**timing_kw),
+            huber_k=huber_k, robust_maxiter=robust_maxiter,
+            robust_tol=robust_tol, tolerate_step_problem=True)
+
     def _fit_toas_timing(self, maxiter: int = 20,
                          required_chi2_decrease: float = 1e-2,
                          max_chi2_increase: float = 1e-2,
                          min_lambda: float = 1e-3,
                          debug: bool = False,
                          raise_on_maxiter: bool = False) -> float:
-        best_chi2 = self.resids.chi2
+        best_chi2 = self._fit_metric()
         self.converged = False
         for it in range(maxiter):
             dpars, params, cov = self._solve_step()
@@ -502,7 +675,7 @@ class DownhillFitter(Fitter):
                         continue
                     getattr(self.model, p).value = base_vals[p] + lam * float(dp)
                 self.update_resids()
-                chi2 = self.resids.chi2
+                chi2 = self._fit_metric()
                 if chi2 < best_chi2 + max_chi2_increase:
                     improved = True
                     break

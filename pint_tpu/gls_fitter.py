@@ -27,6 +27,7 @@ from pint_tpu.exceptions import (
     DegeneracyWarning,
     NonFiniteSystemError,
     SingularMatrixError,
+    UsageError,
 )
 from pint_tpu.fitter import DownhillFitter, Fitter
 from pint_tpu.logging import log
@@ -298,7 +299,15 @@ class GLSFitter(Fitter):
         }
 
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
-                 full_cov: bool = False, debug: bool = False) -> float:
+                 full_cov: bool = False, debug: bool = False,
+                 robust=None) -> float:
+        if self._check_robust_arg(robust):
+            # typed and actionable, instead of a TypeError on the kwarg:
+            # Huber IRLS reweights a *diagonal* whitener, which a
+            # correlated-noise covariance does not have
+            raise UsageError(
+                "robust fitting is available on the WLS-family fitters "
+                "only (Huber IRLS assumes uncorrelated errors)")
         self.model.validate()
         self.model.validate_toas(self.toas)
         self.update_resids()

@@ -1,0 +1,36 @@
+"""Input-integrity layer: validating ingestion, TOA quarantine, robust
+reweighting, and the fit doctor.
+
+Three pillars (the data-side twin of ``pint_tpu/runtime``'s guardrails):
+
+1. **Validating ingestion** — ``io/par.py`` / ``io/tim.py`` run under the
+   strict/lenient/collect ingestion policy (:mod:`pint_tpu.config`),
+   raising typed :class:`~pint_tpu.exceptions.ParSyntaxError` /
+   :class:`~pint_tpu.exceptions.TimSyntaxError` with file:line:column
+   context, or accumulating a :class:`Diagnostics` report.
+2. **TOA quarantine** — ``TOAs.validate()`` (:mod:`.quarantine`) masks
+   rows no fit should see; fitters consume ``TOAs.certified()``.
+3. **Outlier-robust fitting** — Huber IRLS weights (:mod:`.robust`) for
+   ``fit_toas(robust="huber")``, audited by ``fitter.doctor()``
+   (:mod:`.doctor`).
+"""
+
+from pint_tpu.integrity.diagnostics import Diagnostic, Diagnostics  # noqa: F401
+from pint_tpu.integrity.quarantine import (  # noqa: F401
+    ABSURD_ERROR_US,
+    QuarantineFinding,
+    QuarantineReport,
+    run_toa_checks,
+)
+from pint_tpu.integrity.robust import HUBER_K, huber_weights  # noqa: F401
+from pint_tpu.integrity.doctor import (  # noqa: F401
+    model_toa_findings,
+    render_doctor_report,
+)
+
+__all__ = [
+    "Diagnostic", "Diagnostics",
+    "QuarantineFinding", "QuarantineReport", "run_toa_checks",
+    "ABSURD_ERROR_US", "HUBER_K", "huber_weights",
+    "model_toa_findings", "render_doctor_report",
+]

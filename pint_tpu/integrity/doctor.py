@@ -1,0 +1,140 @@
+"""``fitter.doctor()``: a human-readable audit of everything the
+input-integrity layer knows about one fit.
+
+Sections
+--------
+* **Device** — the preflight :class:`DeviceProfile` (platform, f64 health).
+* **TOAs** — counts, span, and the quarantine audit (quarantined rows +
+  reasons), recomputed cheaply when the container has never been
+  validated.
+* **Model/TOA compatibility** — checks that need both sides: mask
+  parameters selecting no TOAs, a JUMP covering every TOA (degenerate
+  with the overall phase offset), and free-parameter *pairs* whose
+  design-matrix columns are nearly collinear (the classic
+  freeze-one-of-them degeneracies).
+* **Robust weights** — after a ``fit_toas(robust="huber")``, the TOAs the
+  IRLS loop downweighted.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+__all__ = ["render_doctor_report", "model_toa_findings"]
+
+#: |correlation| of two normalized design-matrix columns above which the
+#: pair is reported as degenerate (freeze one of them)
+DEGENERATE_CORR = 0.9999
+
+
+def model_toa_findings(model, toas, designmatrix: bool = True) -> List[str]:
+    """Compatibility problems between a timing model and a TOA set, as
+    human-readable strings (empty list = clean)."""
+    findings: List[str] = []
+    # component-declared requirements (MissingTOAs and friends)
+    try:
+        model.validate_toas(toas)
+    except Exception as e:
+        findings.append(f"model.validate_toas: {e}")
+    # a JUMP (or any mask parameter) selecting every TOA is degenerate
+    # with the overall phase offset; one selecting none fits nothing
+    from pint_tpu.models.parameter import maskParameter
+
+    n = len(toas)
+    for pname in model.params:
+        par = getattr(model, pname)
+        if not isinstance(par, maskParameter) or par.frozen:
+            continue
+        try:
+            sel = np.asarray(par.select_toa_mask(toas))
+        except Exception:
+            continue
+        nsel = int(sel.sum()) if sel.dtype == bool else len(sel)
+        if nsel == 0:
+            findings.append(f"free mask parameter {pname} selects no TOAs")
+        elif nsel == n and pname.startswith("JUMP"):
+            findings.append(
+                f"free {pname} selects every TOA — fully degenerate with "
+                f"the overall phase offset; freeze it or narrow its mask")
+    # near-collinear free-parameter pairs in the design matrix
+    if designmatrix and len(model.free_params) >= 2 and n > 2:
+        try:
+            M, params, _ = model.designmatrix(toas)
+            M = np.asarray(M, dtype=np.float64)
+            norms = np.linalg.norm(M, axis=0)
+            norms[norms == 0] = 1.0
+            Mn = M / norms
+            corr = Mn.T @ Mn
+            for i in range(len(params)):
+                for j in range(i + 1, len(params)):
+                    if abs(corr[i, j]) > DEGENERATE_CORR:
+                        findings.append(
+                            f"free parameters {params[i]} and {params[j]} "
+                            f"are degenerate (|column corr| = "
+                            f"{abs(corr[i, j]):.6f}); freeze one of them")
+        except Exception as e:  # a broken model must not break the audit
+            findings.append(f"design-matrix degeneracy check failed: {e}")
+    return findings
+
+
+def _toa_section(fitter) -> List[str]:
+    toas = getattr(fitter, "toas_full", None) or fitter.toas
+    lines = [f"TOAs: {len(toas)} read"]
+    if len(toas):
+        lines[0] += (f", span MJD {toas.first_MJD():.1f}-"
+                     f"{toas.last_MJD():.1f}, "
+                     f"{len(toas.observatories)} observatory(ies)")
+    report = getattr(toas, "last_validation", None)
+    if report is None:
+        # never validated: run the structural checks (no coverage I/O)
+        from pint_tpu.integrity.quarantine import run_toa_checks
+
+        report = run_toa_checks(toas, check_coverage=False)
+    for ln in report.render().splitlines():
+        lines.append(ln)
+    if getattr(fitter, "toas_full", None) is not None:
+        lines.append(f"fit uses {len(fitter.toas)} certified TOA(s)")
+    return lines
+
+
+def _robust_section(fitter) -> List[str]:
+    w = getattr(fitter, "robust_weights", None)
+    if w is None:
+        return []
+    w = np.asarray(w)
+    down = np.nonzero(w < 0.999)[0]
+    lines = [f"Robust fit: Huber IRLS converged in "
+             f"{getattr(fitter, 'robust_iterations', '?')} iteration(s), "
+             f"{len(down)}/{len(w)} TOA(s) downweighted"]
+    order = down[np.argsort(w[down])][:15]
+    mjds = np.asarray(fitter.toas.get_mjds(), dtype=np.float64)
+    for i in order:
+        lines.append(f"  row {int(i)} (MJD {mjds[i]:.4f}): weight "
+                     f"{w[i]:.4f}")
+    if len(down) > 15:
+        lines.append(f"  ... and {len(down) - 15} more")
+    return lines
+
+
+def render_doctor_report(fitter, designmatrix: bool = True) -> str:
+    """The full audit for one fitter, as a printable string."""
+    out: List[str] = ["== pint_tpu fit doctor =="]
+    prof = getattr(fitter, "device_profile", None)
+    if prof is not None:
+        out.append(
+            f"Device: {getattr(prof, 'platform', '?')} "
+            f"({getattr(prof, 'device_kind', '?')}), "
+            f"f64_native={getattr(prof, 'f64_native', '?')}")
+    out.extend(_toa_section(fitter))
+    compat = model_toa_findings(fitter.model, fitter.toas,
+                                designmatrix=designmatrix)
+    out.append(f"Model/TOA compatibility: "
+               f"{'clean' if not compat else f'{len(compat)} finding(s)'}")
+    out.extend("  " + f for f in compat)
+    out.extend(_robust_section(fitter))
+    diags = getattr(fitter, "solve_diagnostics", None)
+    if diags is not None:
+        out.append(f"Last solve: {diags}")
+    return "\n".join(out)

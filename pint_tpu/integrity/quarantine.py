@@ -1,0 +1,230 @@
+"""TOA quarantine: detect rows that must not reach a fit.
+
+``TOAs.validate()`` delegates here.  Each check yields ``(index, code,
+message)`` findings; offenders are moved into a boolean quarantine mask
+(True = quarantined) that rides on the TOAs object and is carried through
+slicing, merging, and pickling.  Fitters consume only the certified
+complement (``TOAs.certified()``), following the correlated-noise
+literature's warning that a few contaminated TOAs can bias the whole GLS
+solution (Coles et al. 2011) and the tempo2 read-time rejection
+discipline.
+
+Checks
+------
+* ``toa-nonfinite-mjd`` — NaN/inf arrival times;
+* ``toa-bad-error`` — non-positive, non-finite, or absurd (> ``max_error_us``)
+  uncertainties (a zero error makes chi2 infinite; an absurd one silently
+  deweights the row to nothing);
+* ``toa-nonfinite-freq`` — NaN observing frequency (+inf is the legal
+  "infinite frequency" sentinel);
+* ``toa-duplicate`` — repeated (MJD, observatory, frequency) rows: every
+  occurrence after the first is quarantined;
+* ``toa-clock-coverage`` — epochs past the end of the observatory's clock
+  chain (the correction would be an extrapolation);
+* ``toa-ephem-coverage`` — epochs outside the loaded SPK kernel's span.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["QuarantineFinding", "QuarantineReport", "run_toa_checks"]
+
+#: anything beyond this TOA uncertainty is a corrupt column, not a
+#: measurement (1e9 us = ~17 min)
+ABSURD_ERROR_US = 1e9
+
+
+@dataclass(frozen=True)
+class QuarantineFinding:
+    index: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"  row {self.index}: {self.message} ({self.code})"
+
+
+@dataclass
+class QuarantineReport:
+    """Outcome of one ``TOAs.validate()`` pass."""
+
+    n_toas: int
+    findings: List[QuarantineFinding] = field(default_factory=list)
+
+    @property
+    def mask(self) -> np.ndarray:
+        """Boolean quarantine mask (True = quarantined)."""
+        m = np.zeros(self.n_toas, dtype=bool)
+        for f in self.findings:
+            m[f.index] = True
+        return m
+
+    @property
+    def n_quarantined(self) -> int:
+        return int(self.mask.sum())
+
+    def codes(self) -> List[str]:
+        return sorted({f.code for f in self.findings})
+
+    def reasons_by_row(self) -> List[List[str]]:
+        out: List[List[str]] = [[] for _ in range(self.n_toas)]
+        for f in self.findings:
+            out[f.index].append(f.message)
+        return out
+
+    def __bool__(self) -> bool:
+        return bool(self.findings)
+
+    def render(self, limit: int = 20) -> str:
+        head = (f"TOA quarantine: {self.n_quarantined}/{self.n_toas} row(s) "
+                f"quarantined ({', '.join(self.codes()) or 'clean'})")
+        body = [f.render() for f in self.findings[:limit]]
+        if len(self.findings) > limit:
+            body.append(f"  ... and {len(self.findings) - limit} more")
+        return "\n".join([head] + body)
+
+
+def _check_mjds(mjd64: np.ndarray) -> List[QuarantineFinding]:
+    bad = ~np.isfinite(mjd64)
+    return [QuarantineFinding(int(i), "toa-nonfinite-mjd",
+                              f"non-finite MJD {mjd64[i]!r}")
+            for i in np.nonzero(bad)[0]]
+
+
+def _check_errors(err_us: np.ndarray,
+                  max_error_us: float) -> List[QuarantineFinding]:
+    out = []
+    for i in np.nonzero(~np.isfinite(err_us) | (err_us <= 0)
+                        | (err_us > max_error_us))[0]:
+        e = err_us[i]
+        if not np.isfinite(e):
+            msg = f"non-finite uncertainty {e!r}"
+        elif e <= 0:
+            msg = f"non-positive uncertainty {e} us"
+        else:
+            msg = f"absurd uncertainty {e:g} us (> {max_error_us:g})"
+        out.append(QuarantineFinding(int(i), "toa-bad-error", msg))
+    return out
+
+
+def _check_freqs(freq_mhz: np.ndarray) -> List[QuarantineFinding]:
+    # +inf is the legal infinite-frequency sentinel; NaN and -inf are not
+    bad = np.isnan(freq_mhz) | (freq_mhz == -np.inf)
+    return [QuarantineFinding(int(i), "toa-nonfinite-freq",
+                              f"non-finite frequency {freq_mhz[i]!r}")
+            for i in np.nonzero(bad)[0]]
+
+
+def _check_duplicates(mjd64: np.ndarray, mjd_lo: np.ndarray,
+                      obs: np.ndarray,
+                      freq_mhz: np.ndarray) -> List[QuarantineFinding]:
+    """Every occurrence after the first of an identical (MJD, obs, freq)
+    row.  Keys on the FULL-precision (hi, lo) arrival time — float64
+    alone quantizes MJDs at ~0.6 us, which would falsely merge genuinely
+    distinct sub-microsecond-separated TOAs.  Vectorized (lexsort +
+    adjacent compare): this runs on every get_TOAs load, so a per-row
+    Python loop would tax serving-scale ingestion."""
+    out: List[QuarantineFinding] = []
+    idx = np.nonzero(np.isfinite(mjd64))[0]  # NaNs: the MJD check's job
+    if len(idx) < 2:
+        return out
+    obs_inv = np.unique(obs.astype(str)[idx], return_inverse=True)[1]
+    # primary key mjd64, then lo, freq, obs; original index last so the
+    # head of every equal run is the FIRST occurrence
+    order = np.lexsort((idx, obs_inv, freq_mhz[idx], mjd_lo[idx],
+                        mjd64[idx]))
+    s = idx[order]
+    same = ((mjd64[s][1:] == mjd64[s][:-1])
+            & (mjd_lo[s][1:] == mjd_lo[s][:-1])
+            & (freq_mhz[s][1:] == freq_mhz[s][:-1])
+            & (obs_inv[order][1:] == obs_inv[order][:-1]))
+    if not same.any():
+        return out
+    # run head for each sorted position: latest position that starts a run
+    head_pos = np.maximum.accumulate(
+        np.where(np.concatenate([[True], ~same]), np.arange(len(s)), -1))
+    for j in np.nonzero(same)[0] + 1:
+        i, first = int(s[j]), int(s[head_pos[j]])
+        out.append(QuarantineFinding(
+            i, "toa-duplicate",
+            f"duplicate of row {first} (MJD {mjd64[i]:.10f}, {obs[i]}, "
+            f"{freq_mhz[i]:g} MHz)"))
+    return out
+
+
+def _check_clock_coverage(mjd64: np.ndarray,
+                          obs: np.ndarray) -> List[QuarantineFinding]:
+    from pint_tpu.observatory import get_observatory
+
+    out = []
+    for site in np.unique(obs.astype(str)):
+        try:
+            ob = get_observatory(site)
+            last = float(ob.last_clock_correction_mjd(limits="allow"))
+        except Exception:
+            continue  # no clock chain for this site: nothing to cover
+        if not np.isfinite(last):
+            continue
+        m = (obs.astype(str) == site) & np.isfinite(mjd64) & (mjd64 > last)
+        for i in np.nonzero(m)[0]:
+            out.append(QuarantineFinding(
+                int(i), "toa-clock-coverage",
+                f"MJD {mjd64[i]:.3f} is past the end of the {site} clock "
+                f"chain (last correction at MJD {last:.3f})"))
+    return out
+
+
+def _check_ephem_coverage(mjd64: np.ndarray,
+                          ephem: str) -> List[QuarantineFinding]:
+    from pint_tpu.ephemeris import load_ephemeris
+
+    try:
+        eph = load_ephemeris(ephem)
+        lo, hi = eph.coverage_mjd()
+    except Exception:
+        return []  # analytic/unavailable ephemeris: no span to enforce
+    out = []
+    bad = np.isfinite(mjd64) & ((mjd64 < lo) | (mjd64 > hi))
+    for i in np.nonzero(bad)[0]:
+        out.append(QuarantineFinding(
+            int(i), "toa-ephem-coverage",
+            f"MJD {mjd64[i]:.3f} outside ephemeris {ephem} coverage "
+            f"[{lo:.1f}, {hi:.1f}]"))
+    return out
+
+
+def run_toa_checks(toas, check_coverage: bool = True,
+                   max_error_us: float = ABSURD_ERROR_US,
+                   ephem: Optional[str] = None) -> QuarantineReport:
+    """Run every quarantine check over a TOAs container; returns the
+    report (the caller decides what the policy does with it)."""
+    mjd64 = np.asarray(toas.utc_mjd, dtype=np.float64)
+    # sub-double part of the arrival time (x87 longdouble residual plus
+    # the explicit lo column on degraded-longdouble platforms)
+    with np.errstate(invalid="ignore"):
+        mjd_lo = np.asarray(
+            np.asarray(toas.utc_mjd) - mjd64.astype(np.longdouble),
+            dtype=np.float64)
+    mjd_lo = np.where(np.isfinite(mjd_lo), mjd_lo, 0.0)
+    extra_lo = getattr(toas, "utc_mjd_lo", None)
+    if extra_lo is not None:
+        mjd_lo = mjd_lo + np.asarray(extra_lo, dtype=np.float64)
+    err_us = np.asarray(toas.error_us, dtype=np.float64)
+    freq = np.asarray(toas.freq_mhz, dtype=np.float64)
+    obs = np.asarray(toas.obs)
+    findings: List[QuarantineFinding] = []
+    findings += _check_mjds(mjd64)
+    findings += _check_errors(err_us, max_error_us)
+    findings += _check_freqs(freq)
+    findings += _check_duplicates(mjd64, mjd_lo, obs, freq)
+    if check_coverage:
+        findings += _check_clock_coverage(mjd64, obs)
+        eph = ephem or getattr(toas, "ephem", None)
+        if eph:
+            findings += _check_ephem_coverage(mjd64, str(eph))
+    findings.sort(key=lambda f: (f.index, f.code))
+    return QuarantineReport(n_toas=len(mjd64), findings=findings)

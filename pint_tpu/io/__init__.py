@@ -1,4 +1,16 @@
-"""File-format IO: par files, tim files (tempo/tempo2/Princeton/Parkes)."""
+"""File-format IO: par files, tim files (tempo/tempo2/Princeton/Parkes).
 
-from pint_tpu.io.par import parse_parfile, format_parfile  # noqa: F401
+Both parsers run under the strict/lenient/collect ingestion policy
+(:func:`pint_tpu.config.set_ingestion_policy`) and report problems as
+typed :class:`~pint_tpu.exceptions.ParSyntaxError` /
+:class:`~pint_tpu.exceptions.TimSyntaxError` or accumulated
+:class:`~pint_tpu.integrity.Diagnostics`.
+"""
+
+from pint_tpu.io.par import (  # noqa: F401
+    ParFileDict,
+    format_parfile,
+    fortran_float,
+    parse_parfile,
+)
 from pint_tpu.io.tim import read_tim_file, format_toa_line  # noqa: F401

@@ -241,6 +241,14 @@ class ModelBuilder:
                 used.add(key)
             else:
                 log.warning(f"Unrecognized parfile line: {key} {rows[0].fields}")
+                # unknown params land in the ingestion Diagnostics report
+                # when the entries came through parse_parfile
+                diags = getattr(entries, "diagnostics", None)
+                if diags is not None:
+                    diags.warning(
+                        "par-unknown-param",
+                        f"unknown parameter {key} {rows[0].fields}",
+                        line=getattr(rows[0], "line", None), quiet=True)
         # name
         if tm.PSR.value:
             tm.name = tm.PSR.value

@@ -12,7 +12,7 @@ from typing import Optional
 
 import numpy as np
 
-from pint_tpu.exceptions import CorrelatedErrors
+from pint_tpu.exceptions import CorrelatedErrors, UsageError
 from pint_tpu.logging import log
 from pint_tpu.utils import sherman_morrison_dot, weighted_mean, woodbury_dot
 
@@ -46,7 +46,8 @@ class Residuals:
         if self.track_mode == "use_pulse_numbers":
             pn = self.toas.get_pulse_numbers()
             if pn is None:
-                raise ValueError("track_mode=use_pulse_numbers but no pulse numbers")
+                raise UsageError(
+                    "track_mode=use_pulse_numbers but no pulse numbers")
             dpn = (self.toas.delta_pulse_number
                    if self.toas.delta_pulse_number is not None else 0.0)
             resids = (int_ - pn + dpn) + frac
@@ -203,7 +204,7 @@ class Residuals:
         reference ``residuals.py:283``)."""
         calctype = calctype.lower()
         if calctype not in ("modelf0", "taylor", "numerical"):
-            raise ValueError(f"Unknown calctype {calctype!r}")
+            raise UsageError(f"Unknown calctype {calctype!r}")
         F0 = float(self.model.F0.value)
         if calctype == "modelf0":
             return F0
@@ -301,7 +302,7 @@ class Residuals:
         ecorrs = [c for c in self.model.noise_components
                   if getattr(c, "is_ecorr", False)]
         if not ecorrs:
-            raise ValueError("ECORR not present in noise model")
+            raise UsageError("ECORR not present in noise model")
         U, ecorr_err2 = ecorrs[0].basis_weight_pair(self.model, self.toas)
         U = np.asarray(U)
         ecorr_err2 = np.asarray(ecorr_err2)

@@ -15,6 +15,9 @@ Faults:
   near-degenerate regime taken to its limit;
 * :func:`truncated_copy` — a prefix of a binary/text data file (a
   half-downloaded SPK kernel or clock file);
+* :func:`garbled_copy` — a text file with chosen lines deterministically
+  corrupted (bit-rotted columns, editor accidents) — the corrupt-corpus
+  generator behind ``tests/test_input_integrity.py``;
 * :func:`device_loss` — the first *n* sweep-chunk invocations raise
   :class:`SimulatedDeviceLoss` (a flaky accelerator tunnel);
 * :func:`crash_after_chunks` — the process "dies" (``SimulatedCrash``)
@@ -37,7 +40,7 @@ import numpy as np
 from pint_tpu.exceptions import DeviceLostError
 
 __all__ = ["SimulatedDeviceLoss", "SimulatedCrash", "nan_residuals",
-           "singular_gram", "truncated_copy", "device_loss",
+           "singular_gram", "truncated_copy", "garbled_copy", "device_loss",
            "crash_after_chunks", "flaky"]
 
 
@@ -122,6 +125,57 @@ def truncated_copy(src: str, fraction: float = 0.6,
         data = f.read()
     with open(dst, "wb") as f:
         f.write(data[: max(1, int(len(data) * fraction))])
+    try:
+        yield dst
+    finally:
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _default_garble(line: str, rng) -> str:
+    """Deterministic in-line corruption: a run of characters is replaced
+    with shell-ish junk that no par/tim field parser accepts."""
+    s = line.rstrip("\n")
+    if not s.strip():
+        return line
+    start = int(rng.integers(0, max(1, len(s) - 4)))
+    width = int(rng.integers(3, 9))
+    # no '#'/'%' in the junk: those would COMMENT the rest of a par line
+    # away, leaving a shorter-but-valid line instead of garbage
+    junk = "".join(rng.choice(list("@~!?$&")) for _ in range(width))
+    return s[:start] + junk + s[start + width:] + "\n"
+
+
+@contextlib.contextmanager
+def garbled_copy(src: str, lines: Optional[Iterable[int]] = None,
+                 every: int = 5, seed: int = 0,
+                 mutate: Optional[Callable[[str], str]] = None,
+                 dst: Optional[str] = None):
+    """Yield the path of a copy of ``src`` with chosen lines corrupted.
+
+    ``lines`` names the 0-based line numbers to garble; when None, every
+    ``every``-th non-blank line is hit.  Corruption is deterministic in
+    ``seed`` (same fixture every run).  ``mutate`` overrides the default
+    junk-splice mutator with any ``line -> line`` function (e.g. one that
+    zeroes an error column)."""
+    import tempfile
+
+    rng = np.random.default_rng(seed)
+    tmpdir = None
+    if dst is None:
+        tmpdir = tempfile.mkdtemp(prefix="pint_tpu_faultinject_")
+        dst = os.path.join(tmpdir, os.path.basename(src))
+    with open(src) as f:
+        text = f.readlines()
+    if lines is None:
+        targets = {i for i in range(len(text))
+                   if text[i].strip() and i % max(1, every) == 0}
+    else:
+        targets = set(int(i) for i in lines)
+    mut = mutate or (lambda ln: _default_garble(ln, rng))
+    with open(dst, "w") as f:
+        for i, ln in enumerate(text):
+            f.write(mut(ln) if i in targets else ln)
     try:
         yield dst
     finally:

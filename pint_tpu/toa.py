@@ -27,6 +27,13 @@ import numpy as np
 
 from pint_tpu import c as C_M_S
 from pint_tpu.dd import DD, two_prod_np as _two_prod_np, two_sum_np as _two_sum_np
+from pint_tpu.exceptions import (
+    InvalidTOAError,
+    PintPickleError,
+    TimSyntaxError,
+    TOAIntegrityError,
+    UsageError,
+)
 from pint_tpu.io.tim import RawTOA, format_toa_line, read_tim_file
 from pint_tpu.logging import log
 from pint_tpu.observatory import get_observatory
@@ -65,18 +72,19 @@ class FlagDict(MutableMapping):
     @staticmethod
     def check_allowed_key(k) -> None:
         if not isinstance(k, str):
-            raise ValueError(f"flag {k!r} must be a string")
+            raise InvalidTOAError(f"flag {k!r} must be a string")
         if k.startswith("-"):
-            raise ValueError("flags should be stored without their leading -")
+            raise InvalidTOAError(
+                "flags should be stored without their leading -")
         if not FlagDict._key_re.match(k):
-            raise ValueError(f"flag {k!r} is not a valid flag name")
+            raise InvalidTOAError(f"flag {k!r} is not a valid flag name")
 
     @staticmethod
     def check_allowed_value(k, v) -> None:
         if not isinstance(v, str):
-            raise ValueError(f"value {v!r} for flag {k} must be a string")
+            raise InvalidTOAError(f"value {v!r} for flag {k} must be a string")
         if v and len(v.split()) != 1:
-            raise ValueError(
+            raise InvalidTOAError(
                 f"value {v!r} for flag {k} cannot contain whitespace")
 
     def __setitem__(self, key, val):
@@ -172,6 +180,11 @@ class TOAs:
     planets: bool = False
     pulse_number: Optional[np.ndarray] = None
     delta_pulse_number: Optional[np.ndarray] = None
+    #: quarantine state from :meth:`validate` (True = quarantined); carried
+    #: through slicing, merging, adjust_TOAs, and pickling
+    quarantine_mask: Optional[np.ndarray] = None
+    #: per-TOA list of quarantine reasons (parallel to the rows)
+    quarantine_reasons: Optional[List[List[str]]] = None
     #: bumped on every in-place mutation; model caches key on it
     _version: int = 0
 
@@ -250,8 +263,15 @@ class TOAs:
         ``gap_limit_hr`` hours (reference ``toa.py get_clusters`` /
         ``_cluster_by_gaps``).  Returns the per-TOA cluster index (clusters
         numbered in time order); with ``add_column`` the index is also
-        stamped as a ``-cluster`` flag."""
+        stamped as a ``-cluster`` flag.  Unsorted MJDs are handled (the
+        clustering sorts defensively); empty and single-TOA datasets get
+        the trivial answer instead of a shape error."""
+        if gap_limit_hr <= 0:
+            raise UsageError(f"gap_limit_hr must be positive, "
+                             f"got {gap_limit_hr}")
         mjds = np.asarray(self.get_mjds(), dtype=np.float64)
+        if len(mjds) == 0:
+            return np.empty(0, dtype=np.int64)
         order = np.argsort(mjds, kind="stable")
         gaps = np.diff(mjds[order]) > gap_limit_hr / 24.0
         cluster_sorted = np.concatenate([[0], np.cumsum(gaps)])
@@ -277,12 +297,83 @@ class TOAs:
         )
         for name in ("clock_corr_s", "tdb", "utc_mjd_lo", "tdb_lo",
                      "ssb_obs_pos_km", "ssb_obs_vel_kms",
-                     "obs_sun_pos_km", "pulse_number", "delta_pulse_number"):
+                     "obs_sun_pos_km", "pulse_number", "delta_pulse_number",
+                     "quarantine_mask"):
             v = getattr(self, name)
             if v is not None:
                 setattr(new, name, v[idx])
+        if self.quarantine_reasons is not None:
+            new.quarantine_reasons = [list(self.quarantine_reasons[i])
+                                      for i in idx]
         new.planet_pos_km = {k: v[idx] for k, v in self.planet_pos_km.items()}
         return new
+
+    # ------------------------------------------------------------------
+    # input integrity: validation + quarantine
+    # ------------------------------------------------------------------
+    def validate(self, policy: Optional[str] = None,
+                 check_coverage: bool = True,
+                 max_error_us: Optional[float] = None,
+                 ephem: Optional[str] = None):
+        """Run the TOA integrity checks (:mod:`pint_tpu.integrity`):
+        NaN/inf MJDs, non-positive/absurd/non-finite uncertainties,
+        duplicate (MJD, obs, freq) rows, and (``check_coverage``) epochs
+        outside clock-chain or ephemeris coverage.
+
+        ``strict`` (default ingestion policy) raises
+        :class:`~pint_tpu.exceptions.TOAIntegrityError` when anything is
+        found; ``lenient`` moves offenders into the quarantine mask with a
+        logged summary; ``collect`` quarantines silently.  Returns the
+        :class:`~pint_tpu.integrity.QuarantineReport`; the report also
+        rides on ``self.last_validation``.
+        """
+        from pint_tpu.config import ingestion_policy
+        from pint_tpu.integrity.quarantine import (
+            ABSURD_ERROR_US,
+            run_toa_checks,
+        )
+
+        policy = policy or ingestion_policy()
+        report = run_toa_checks(
+            self, check_coverage=check_coverage,
+            max_error_us=ABSURD_ERROR_US if max_error_us is None
+            else max_error_us,
+            ephem=ephem)
+        self.last_validation = report
+        if report and policy == "strict":
+            raise TOAIntegrityError(
+                f"TOA validation failed under the strict ingestion "
+                f"policy:\n{report.render()}", report=report)
+        # the mask always mirrors the LATEST validation: a clean re-run
+        # releases rows a previous pass quarantined (repaired data must
+        # not stay silently excluded)
+        self.quarantine_mask = report.mask if report else None
+        self.quarantine_reasons = report.reasons_by_row() if report else None
+        self._version += 1
+        if report and policy == "lenient":
+            log.warning(report.render())
+        return report
+
+    @property
+    def n_quarantined(self) -> int:
+        m = self.quarantine_mask
+        return int(np.sum(m)) if m is not None else 0
+
+    def certified(self) -> "TOAs":
+        """The rows :meth:`validate` did not quarantine — the only rows a
+        fitter or grid sweep should consume.  Without quarantined rows
+        this is ``self`` (no copy)."""
+        m = self.quarantine_mask
+        if m is None or not np.any(m):
+            return self
+        return self[~np.asarray(m, dtype=bool)]
+
+    def quarantined(self) -> "TOAs":
+        """The quarantined rows (for inspection/repair)."""
+        m = self.quarantine_mask
+        if m is None:
+            return self[np.zeros(len(self), dtype=bool)]
+        return self[np.asarray(m, dtype=bool)]
 
     # ------------------------------------------------------------------
     # pipeline
@@ -522,7 +613,7 @@ class TOAs:
         TOAs (reference ``get_highest_density_range``)."""
         m = np.sort(np.asarray(self.get_mjds(), dtype=np.float64))
         if not len(m):
-            raise ValueError("no TOAs")
+            raise UsageError("no TOAs")
         counts = np.searchsorted(m, m + float(ndays), side="right") \
             - np.arange(len(m))
         i = int(np.argmax(counts))
@@ -562,7 +653,8 @@ class TOAs:
         (reference ``toa.py:1959``); raises when no TOA carries -pn."""
         pn, valid = self.get_flag_value("pn", as_type=float)
         if not valid:
-            raise ValueError("No pulse number flags (-pn) found in the TOAs")
+            raise InvalidTOAError(
+                "No pulse number flags (-pn) found in the TOAs")
         col = np.full(len(self), np.nan)
         for i in valid:
             col[i] = pn[i]
@@ -678,7 +770,7 @@ class TOAs:
             # nothing recorded at load (e.g. object built programmatically):
             # edits since load are undetectable — say so instead of
             # pretending to verify
-            raise ValueError(
+            raise UsageError(
                 "No source hashes were recorded when this TOAs object was "
                 "built; cannot verify against the tim file")
         return stored == current
@@ -687,9 +779,10 @@ class TOAs:
     def to_batch(self, tdb0: Optional[float] = None) -> TOABatch:
         """Freeze into a device pytree (light-second units, dd times)."""
         if self.tdb is None:
-            raise ValueError("Run compute_TDBs/compute_posvels before to_batch()")
+            raise UsageError(
+                "Run compute_TDBs/compute_posvels before to_batch()")
         if self.ssb_obs_pos_km is None:
-            raise ValueError("Run compute_posvels before to_batch()")
+            raise UsageError("Run compute_posvels before to_batch()")
         if tdb0 is None:
             tdb0 = float(np.round(np.mean(np.asarray(self.tdb, dtype=np.float64))))
         planet = {
@@ -805,27 +898,49 @@ def _finalize_toas(t: TOAs, ephem, planets, include_gps, include_bipm,
 def get_TOAs(timfile: str, ephem: Optional[str] = None, planets: bool = False,
              include_gps: bool = True, include_bipm: Optional[bool] = None,
              bipm_version: str = "BIPM2021", model=None, limits: str = "warn",
-             usepickle: bool = False) -> TOAs:
+             usepickle: bool = False, policy: Optional[str] = None,
+             validate: bool = True) -> TOAs:
     """Load a tim file and run the full ingestion pipeline (reference
-    ``toa.py:109``)."""
+    ``toa.py:109``).
+
+    ``policy`` overrides the process-wide ingestion policy for both the
+    tim parse and the post-parse :meth:`TOAs.validate` structural checks
+    (NaN/zero-error/duplicate rows quarantined in lenient/collect mode,
+    typed errors in strict mode).  The parse's
+    :class:`~pint_tpu.integrity.Diagnostics` report rides on the result
+    as ``.ingest_diagnostics``.  ``validate=False`` skips the integrity
+    pass (the parse policy still applies).
+    """
+    from pint_tpu.config import ingestion_policy
+    from pint_tpu.integrity.diagnostics import Diagnostics
+
     ephem, planets, include_bipm, bipm_version = _resolve_pipeline_options(
         model, ephem, planets, include_bipm, bipm_version)
+    # resolve the policy HERE so the pickle cache keys on the policy that
+    # actually applied (a later set_ingestion_policy must miss the cache)
+    policy = policy or ingestion_policy()
     pickle_key = (ephem, planets, include_gps, include_bipm, bipm_version,
-                  limits)
+                  limits, policy, validate)
     if usepickle:
         t = _load_toa_pickle(timfile, pickle_key)
         if t is not None:
             log.info(f"Loaded {len(t)} TOAs from pickle cache for {timfile}")
             return t
-    raw, commands = read_tim_file(timfile)
+    diags = Diagnostics(timfile)
+    raw, commands = read_tim_file(timfile, policy=policy, diagnostics=diags)
     if not raw:
-        raise ValueError(f"No TOAs found in {timfile}")
+        raise TimSyntaxError("no TOAs found in file", file=timfile)
     t = TOAs.from_raw(raw, commands, filename=timfile)
+    t.ingest_diagnostics = diags
     # record source hashes at LOAD time so check_hashes can detect edits
     try:
         t._hashes = _tim_hashes(timfile)
     except OSError:
         pass
+    if validate:
+        # structural checks only: coverage checks need the clock/ephemeris
+        # machinery and stay opt-in via an explicit t.validate() call
+        t.validate(policy=policy, check_coverage=False)
     _finalize_toas(t, ephem, planets, include_gps, include_bipm,
                    bipm_version, limits)
     log.info(f"Loaded {len(t)} TOAs from {timfile} "
@@ -960,7 +1075,7 @@ def build_table(toa_list, filename: Optional[str] = None,
     pass it through :func:`get_TOAs_list` or ``_finalize_toas`` for that)."""
     n = len(toa_list)
     if n == 0:
-        raise ValueError("build_table: empty TOA list")
+        raise InvalidTOAError("build_table: empty TOA list")
     utc = np.empty(n, dtype=np.longdouble)
     lo = np.zeros(n, dtype=np.float64)
     err = np.empty(n, dtype=np.float64)
@@ -1016,7 +1131,7 @@ def get_TOAs_array(times, obs: str, errors=1.0, freqs=np.inf, flags=None,
         flag_list = [dict(flags) for _ in range(n)]
     else:
         if len(flags) != n:
-            raise ValueError("flags list length must match times")
+            raise InvalidTOAError("flags list length must match times")
         flag_list = [dict(f) for f in flags]
     for k, v in kwargs.items():
         for f in flag_list:
@@ -1051,7 +1166,7 @@ def load_pickle(toafilename: str,
                 return pickle.load(f)
         except (OSError, EOFError, pickle.UnpicklingError, ValueError):
             continue  # e.g. a truncated .gz next to a valid .pickle
-    raise IOError(f"No readable pickle found for {toafilename}")
+    raise PintPickleError(f"No readable pickle found for {toafilename}")
 
 
 def save_pickle(toas: "TOAs", picklefilename: Optional[str] = None) -> None:
@@ -1062,7 +1177,7 @@ def save_pickle(toas: "TOAs", picklefilename: Optional[str] = None) -> None:
 
     if picklefilename is None:
         if not toas.filename:
-            raise ValueError(
+            raise UsageError(
                 "TOAs have no (single) source filename; please provide "
                 "picklefilename")
         picklefilename = str(toas.filename) + ".pickle.gz"
@@ -1187,6 +1302,21 @@ def merge_TOAs(toas_list: List[TOAs]) -> TOAs:
     if all(t.planet_pos_km.keys() == first.planet_pos_km.keys() for t in toas_list):
         for k in first.planet_pos_km:
             out.planet_pos_km[k] = np.concatenate([t.planet_pos_km[k] for t in toas_list])
+    # quarantine state is carried: inputs without a mask contribute
+    # all-certified rows
+    if any(t.quarantine_mask is not None for t in toas_list):
+        out.quarantine_mask = np.concatenate([
+            t.quarantine_mask if t.quarantine_mask is not None
+            else np.zeros(len(t), dtype=bool) for t in toas_list])
+        out.quarantine_reasons = []
+        for t in toas_list:
+            out.quarantine_reasons.extend(
+                [list(r) for r in t.quarantine_reasons]
+                if t.quarantine_reasons is not None
+                else [[] for _ in range(len(t))])
+    else:
+        out.quarantine_mask = None
+        out.quarantine_reasons = None
     if len(toas_list) > 1:
         # no single source file: save_pickle must demand an explicit name
         # rather than silently writing under the first input's name

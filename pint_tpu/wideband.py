@@ -246,7 +246,8 @@ class WidebandTOAFitter(Fitter):
 
     def __init__(self, toas, model, track_mode: Optional[str] = None,
                  additional_args: Optional[dict] = None):
-        self.toas = toas
+        self.toas = self._consume_quarantine(toas)
+        toas = self.toas
         self.model_init = model
         self.model = copy.deepcopy(model)
         self.track_mode = track_mode

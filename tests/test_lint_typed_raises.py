@@ -1,0 +1,48 @@
+"""CI wiring for the typed-raises AST lint (tools/check_typed_raises.py):
+the ingestion/fitting core must raise only PintError subclasses — a bare
+``raise ValueError`` regression in io/toa/fitter/gls_fitter/residuals
+fails the suite, not just a style check."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_linter():
+    spec = importlib.util.spec_from_file_location(
+        "check_typed_raises",
+        os.path.join(REPO, "tools", "check_typed_raises.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTypedRaisesLint:
+    def test_core_modules_raise_only_typed(self):
+        linter = _load_linter()
+        violations = linter.run()
+        assert violations == [], "\n".join(violations)
+
+    def test_lint_actually_fires(self, tmp_path):
+        """The lint is not vacuous: a planted bare ValueError is caught,
+        and a typed raise plus a re-raise are not."""
+        linter = _load_linter()
+        bad = tmp_path / "planted.py"
+        bad.write_text(
+            "def f():\n"
+            "    raise ValueError('bare')\n"
+            "def g():\n"
+            "    raise RuntimeError('also bare')\n"
+            "def h():\n"
+            "    from pint_tpu.exceptions import PintFileError\n"
+            "    try:\n"
+            "        raise PintFileError('typed')\n"
+            "    except PintFileError as e:\n"
+            "        raise e\n")
+        allowed = linter._pint_exception_names()
+        findings = linter.check_file(str(bad), allowed)
+        msgs = [m for _, m in findings]
+        assert len(findings) == 2
+        assert any("ValueError" in m for m in msgs)
+        assert any("RuntimeError" in m for m in msgs)

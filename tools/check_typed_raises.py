@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""AST lint: the ingestion/fitting core raises only typed exceptions.
+
+Walks ``pint_tpu/{io/par,io/tim,toa,fitter,gls_fitter,residuals}.py`` and
+flags every ``raise`` of a disallowed bare builtin (``ValueError``,
+``RuntimeError``, ``Exception``, ``IOError``/``OSError``, ...).  Allowed:
+
+* anything defined in :mod:`pint_tpu.exceptions` that subclasses
+  ``PintError`` (multi-inheriting ``ValueError`` etc. is fine — that is
+  how back-compat is kept);
+* ``NotImplementedError`` / ``TypeError`` / ``KeyError`` / ``IndexError``
+  / ``AttributeError`` / ``StopIteration`` (programming-contract errors,
+  not data errors);
+* bare re-raises (``raise``) and re-raises of a caught variable.
+
+Run directly (exit 1 on violations) or through
+``tests/test_lint_typed_raises.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the modules the input-integrity contract covers
+TARGETS = [
+    "pint_tpu/io/par.py",
+    "pint_tpu/io/tim.py",
+    "pint_tpu/toa.py",
+    "pint_tpu/fitter.py",
+    "pint_tpu/gls_fitter.py",
+    "pint_tpu/residuals.py",
+]
+
+DISALLOWED = {
+    "ValueError", "RuntimeError", "Exception", "BaseException",
+    "IOError", "OSError", "EnvironmentError", "ArithmeticError",
+    "FloatingPointError", "ZeroDivisionError", "SystemError",
+}
+
+ALLOWED_BUILTINS = {
+    "NotImplementedError", "TypeError", "KeyError", "IndexError",
+    "AttributeError", "StopIteration", "FileNotFoundError",
+}
+
+
+def _pint_exception_names() -> set:
+    """Names importable from pint_tpu.exceptions that subclass PintError
+    (or are warning categories, which are never raised as errors)."""
+    import pint_tpu.exceptions as exc
+
+    names = set()
+    for name in dir(exc):
+        obj = getattr(exc, name)
+        if isinstance(obj, type) and (issubclass(obj, exc.PintError)
+                                      or issubclass(obj, Warning)):
+            names.add(name)
+    return names
+
+
+def _raised_name(node: ast.Raise):
+    """The exception *name* a raise statement uses, or None for a bare
+    re-raise."""
+    exc = node.exc
+    if exc is None:
+        return None  # bare `raise` inside an except block
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    return "<dynamic>"
+
+
+def check_file(path: str, allowed: set) -> List[Tuple[int, str]]:
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    # names bound by `except ... as e` are re-raise variables
+    handler_vars = {n.name for n in ast.walk(tree)
+                    if isinstance(n, ast.ExceptHandler) and n.name}
+    bad = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise):
+            continue
+        name = _raised_name(node)
+        if name is None or name in handler_vars:
+            continue  # re-raise
+        if name == "<dynamic>":
+            continue  # computed exception object; out of AST-lint scope
+        if name in DISALLOWED:
+            bad.append((node.lineno,
+                        f"raise of bare {name} (use a typed "
+                        f"pint_tpu.exceptions class)"))
+        elif name not in allowed and name not in ALLOWED_BUILTINS:
+            bad.append((node.lineno,
+                        f"raise of unknown exception {name} (not a "
+                        f"PintError subclass)"))
+    return bad
+
+
+def run(targets=None) -> List[str]:
+    """Lint the target files; returns violation strings (empty = clean)."""
+    sys.path.insert(0, REPO)
+    try:
+        allowed = _pint_exception_names()
+    finally:
+        sys.path.pop(0)
+    out = []
+    for rel in targets or TARGETS:
+        path = os.path.join(REPO, rel)
+        for lineno, msg in check_file(path, allowed):
+            out.append(f"{rel}:{lineno}: {msg}")
+    return out
+
+
+def main() -> int:
+    violations = run()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} typed-raise violation(s)")
+        return 1
+    print(f"OK: {len(TARGETS)} file(s) raise only typed exceptions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
