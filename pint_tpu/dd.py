@@ -45,7 +45,9 @@ __all__ = [
     "taylor_horner_dd",
 ]
 
-_SPLITTER = 134217729.0  # 2**27 + 1, Dekker/Veltkamp splitter for float64
+# 2**27 + 1, the Dekker/Veltkamp splitter for float64: exact by definition
+# only at f64 — the x64-required contract this module states up top
+_SPLITTER = 134217729.0  # jaxlint: disable=f32-unsafe-literal
 
 
 def _opaque(x):

@@ -107,10 +107,12 @@ def build_augmented_system(model, toas, wideband: bool = False):
         if n_rows > n_toa:
             U = np.vstack([U, np.zeros((n_rows - n_toa, U.shape[1]))])
         M = np.hstack([M_q, U])
-        weights = np.concatenate([np.full(len(params), 1e40)] + ws)
+        # host-only enterprise prior weight (docstring above): never traced
+        weights = np.concatenate(
+            [np.full(len(params), 1e40)] + ws)  # jaxlint: disable=f32-unsafe-literal
     else:
         M = M_q
-        weights = np.full(len(params), 1e40)
+        weights = np.full(len(params), 1e40)  # jaxlint: disable=f32-unsafe-literal -- host-only prior weight, see docstring
     M, norm = normalize_designmatrix(M, params)
     M, norm = np.asarray(M), np.asarray(norm)
     phiinv = 1.0 / weights / norm**2
@@ -192,7 +194,7 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     x_t = np.asarray(jsl.cho_solve((jnp.asarray(L_S), True),
                                    jnp.asarray(b_t - Y.T @ z_u)))
     xvar_t = np.asarray(jsl.cho_solve((jnp.asarray(L_S), True),
-                                      jnp.eye(ntm)))
+                                      jnp.eye(ntm, dtype=jnp.float64)))
     # noise amplitudes: back-substitute x_u = D^-1 (b_u - C^T x_t)
     x_u = np.asarray(jsl.cho_solve((jnp.asarray(L_D), True),
                                    jnp.asarray(b_u - C.T @ x_t)))

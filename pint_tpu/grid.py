@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import jax.scipy.linalg as jsl
 import numpy as np
 
+from pint_tpu.exceptions import UsageError
 from pint_tpu.runtime.solve import SVD_RUNG, hardened_cholesky
 
 __all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived",
@@ -242,7 +243,8 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     F0 = float(model.F0.value)
     sigma = np.asarray(model.scaled_toa_uncertainty(toas))
     w = jnp.asarray(1.0 / sigma**2)
-    free_init = jnp.array([float(getattr(model, p).value or 0.0) for p in all_names])
+    free_init = jnp.array([float(getattr(model, p).value or 0.0)
+                           for p in all_names], dtype=jnp.float64)
 
     # reference pulse numbers at the initial parameters (phase tracking)
     ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
@@ -270,7 +272,7 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
         def chi2_point(gvals, free_init, const_pv, batch, ctx, int0, w, F0,
                        Jbase):
             v0 = jnp.concatenate([free_init[:nfit], gvals])
-            ones = jnp.ones((len(w), 1))
+            ones = jnp.ones((len(w), 1), dtype=jnp.float64)
 
             # one Gauss-Newton iteration; rolled into a lax.scan so the
             # (large) phase-evaluation graph is compiled ONCE, not niter
@@ -310,7 +312,8 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
             lvl_worst = jnp.where(jnp.any(lvls < 0), jnp.int32(-1),
                                   jnp.max(lvls))
             diag = jnp.stack([lvl_worst.astype(jnp.float64),
-                              jnp.zeros(()), jnp.max(conds)])
+                              jnp.zeros((), dtype=jnp.float64),
+                              jnp.max(conds)])
             # the refit parameter values ride along for extraparnames
             # (reference gridutils.py:116-160 extraout)
             return jnp.sum(w * r * r), v[:nfit], diag
@@ -423,7 +426,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         U_np = np.hstack(Us)
         phi_np = np.concatenate(ws)
         free_init = jnp.array([float(getattr(model, p).value or 0.0)
-                               for p in all_names])
+                               for p in all_names], dtype=jnp.float64)
 
         ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
         int0 = ph0.int_
@@ -607,7 +610,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                 # — host decisions happen at chunk granularity, never
                 # inside this vmapped body.
                 Arn = Ar / jnp.outer(an, an) \
-                    + (_RIDGE * ridge_scale) * jnp.eye(nt)
+                    + (_RIDGE * ridge_scale) * jnp.eye(nt, dtype=jnp.float64)
                 L = jnp.linalg.cholesky(Arn)
                 x = jsl.cho_solve((L, True), rhs / an) / an
                 ok = jnp.all(jnp.isfinite(x))
@@ -801,7 +804,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
         grid_spans=_point_spans(model, parnames, mesh_pts), chunk=chunk)
     if checkpoint is not None:
         if mesh is not None:
-            raise ValueError("checkpoint= and mesh= cannot be combined; "
+            raise UsageError("checkpoint= and mesh= cannot be combined; "
                              "run the checkpointed sweep per host")
         # the fingerprint must cover everything the chi2 surface depends
         # on — grid definition, EVERY parameter value/selector, and the

@@ -15,6 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional
 
+from pint_tpu.exceptions import UsageError
 from pint_tpu.logging import log
 
 __all__ = ["Diagnostic", "Diagnostics"]
@@ -57,7 +58,7 @@ class Diagnostics:
             file: Optional[str] = None, line: Optional[int] = None,
             column: Optional[int] = None, quiet: bool = False) -> Diagnostic:
         if severity not in SEVERITIES:
-            raise ValueError(f"severity must be one of {SEVERITIES}")
+            raise UsageError(f"severity must be one of {SEVERITIES}")
         d = Diagnostic(severity, code, message, file or self.source, line,
                        column)
         self.records.append(d)

@@ -644,10 +644,11 @@ class TimingModel:
                 pv = dict(const_pv)
                 for i, nm in enumerate(free_names):
                     pv[nm] = values[i]
-                acc = jnp.zeros(batch.ntoas)
+                acc = jnp.zeros(batch.ntoas, dtype=jnp.float64)
                 for comp in delay_comps:
                     acc = acc + comp.delay_func(pv, batch, ctx[comp_names[id(comp)]], acc)
-                phase = Phase(jnp.zeros(batch.ntoas), jnp.zeros(batch.ntoas))
+                phase = Phase(jnp.zeros(batch.ntoas, dtype=jnp.float64),
+                              jnp.zeros(batch.ntoas, dtype=jnp.float64))
                 for comp in phase_comps:
                     phase = phase + comp.phase_func(pv, batch, ctx[comp_names[id(comp)]], acc)
                 return phase, acc
@@ -692,7 +693,8 @@ class TimingModel:
         return out
 
     def _free_values(self, free_names) -> jnp.ndarray:
-        return jnp.array([float(getattr(self, p).value or 0.0) for p in free_names])
+        return jnp.array([float(getattr(self, p).value or 0.0)
+                          for p in free_names], dtype=jnp.float64)
 
     # -- public evaluation API ---------------------------------------------
     def delay(self, toas, cutoff_component: str = "", include_last: bool = True):
@@ -720,7 +722,7 @@ class TimingModel:
         pv = dict(self._const_pv())
         for nm in self.free_params:
             pv[nm] = float(getattr(self, nm).value or 0.0)
-        acc = jnp.zeros(batch.ntoas)
+        acc = jnp.zeros(batch.ntoas, dtype=jnp.float64)
         for name, comp in list(zip(names, comps))[:stop]:
             acc = acc + comp.delay_func(pv, batch, ctx[name], acc)
         return np.asarray(acc)
@@ -918,7 +920,7 @@ class TimingModel:
                 pv = dict(const_pv)
                 for i, nm in enumerate(free_names):
                     pv[nm] = values[i]
-                dm = jnp.zeros(batch.ntoas)
+                dm = jnp.zeros(batch.ntoas, dtype=jnp.float64)
                 for comp in dm_comps:
                     dm = dm + comp.dm_func(pv, batch, ctx[comp_names[id(comp)]])
                 return dm
@@ -1237,7 +1239,7 @@ class TimingModel:
         overflow TPU f64 emulation's float32 range inside jitted graphs —
         use ``OFFSET_PRIOR_WEIGHT`` semantics (see its docstring) for
         anything that flows on-device."""
-        phi_tm = np.full(self.ntmpar, 1e40)
+        phi_tm = np.full(self.ntmpar, 1e40)  # jaxlint: disable=f32-unsafe-literal -- HOST-ONLY by contract (docstring)
         _, w = self.noise_model_basis_weight(toas)
         return phi_tm if w is None else np.concatenate([phi_tm, w])
 
@@ -1581,7 +1583,7 @@ class TimingModel:
         def total_delay(v):
             pv = dict(const_pv)
             pv[param] = v
-            acc = jnp.zeros(batch.ntoas)
+            acc = jnp.zeros(batch.ntoas, dtype=jnp.float64)
             for nm, comp in zip(names, comps):
                 acc = acc + comp.delay_func(pv, batch, ctx[nm], acc)
             return acc

@@ -50,7 +50,9 @@ def two_sum(a, b):
     return s, e
 
 
-_SPLITTER = 134217729.0  # 2**27 + 1
+# 2**27 + 1, Dekker splitter: host-side numpy float64 always (this module
+# never runs on device)
+_SPLITTER = 134217729.0  # jaxlint: disable=f32-unsafe-literal
 
 
 def split(a):

@@ -194,7 +194,7 @@ def flaky(fn: Callable, fail_times: int,
     def wrapped(*a, **kw):
         state["calls"] += 1
         if state["calls"] <= fail_times:
-            raise make()
+            raise make()  # jaxlint: disable=typed-raise -- factory parameter; default makes a typed SimulatedDeviceLoss
         return fn(*a, **kw)
 
     wrapped.calls = state
@@ -238,7 +238,10 @@ def crash_after_chunks(n: int):
 
     def crashing(fn, chunk, index):
         if state["calls"] >= n:
-            raise SimulatedCrash(
+            # deliberately NOT a PintError: a simulated process death
+            # must evade the executor's typed-retry handling, exactly like
+            # a real crash would
+            raise SimulatedCrash(  # jaxlint: disable=typed-raise
                 f"injected: host died before chunk {index}")
         state["calls"] += 1
         return orig(fn, chunk, index)

@@ -1,0 +1,575 @@
+"""CI wiring + fixture tests for tools/jaxlint.
+
+Each rule is exercised on a minimal bad snippet and its good twin, then
+the pragma and baseline layers round-trip, and finally the whole of
+``pint_tpu/`` must lint clean against the committed
+``jaxlint_baseline.txt`` — a trace-safety regression in the hot path
+fails the suite, not just a style check.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.jaxlint.engine import (  # noqa: E402
+    ConfigError,
+    Engine,
+    load_baseline,
+    parse_file,
+    write_baseline,
+)
+from tools.jaxlint.rules import RULES, default_rules  # noqa: E402
+from tools.jaxlint.rules.dtype_literals import (  # noqa: E402
+    F32UnsafeLiteralRule,
+    ImplicitDtypeRule,
+)
+from tools.jaxlint.rules.host_jit import HostCallInJitRule  # noqa: E402
+from tools.jaxlint.rules.static_args import StaticArgsRule  # noqa: E402
+from tools.jaxlint.rules.traced_branch import TracedBranchRule  # noqa: E402
+from tools.jaxlint.rules.typed_raises import TypedRaiseRule  # noqa: E402
+
+
+def lint_snippet(tmp_path, source, rules):
+    p = tmp_path / "snippet.py"
+    p.write_text(source)
+    return Engine(rules=rules, repo=str(tmp_path)).lint_file(str(p))
+
+
+def rule_names(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# traced-function discovery (the engine core the rules stand on)
+# ---------------------------------------------------------------------------
+
+class TestTracedDiscovery:
+    def test_decorator_wrap_scan_and_nested(self, tmp_path):
+        p = tmp_path / "t.py"
+        p.write_text(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def decorated(x):\n"
+            "    return x\n"
+            "def wrapped(x):\n"
+            "    def nested(y):\n"
+            "        return y\n"
+            "    return nested(x)\n"
+            "fn = jax.jit(jax.vmap(wrapped))\n"
+            "def scan_body(c, x):\n"
+            "    return c, x\n"
+            "def host(x):\n"
+            "    return jax.lax.scan(scan_body, 0.0, x)\n")
+        info = parse_file(str(p), repo=str(tmp_path))
+        names = {getattr(td.node, "name", "<lambda>")
+                 for td in info.traced_defs}
+        assert names == {"decorated", "wrapped", "nested", "scan_body"}
+
+    def test_lax_data_operands_not_marked(self, tmp_path):
+        """Only function *positions* of lax combinators mark defs: a
+        cond predicate or scan carry sharing a def's name must not."""
+        p = tmp_path / "t.py"
+        p.write_text(
+            "import jax\n"
+            "import numpy as np\n"
+            "def pred(a):\n"
+            "    return np.sum(a) > 0\n"   # host-only helper
+            "def tfn(o):\n"
+            "    return o\n"
+            "def ffn(o):\n"
+            "    return o\n"
+            "def host(x):\n"
+            "    return jax.lax.cond(pred, tfn, ffn, x)\n")
+        info = parse_file(str(p), repo=str(tmp_path))
+        names = {getattr(td.node, "name", "<lambda>")
+                 for td in info.traced_defs}
+        assert names == {"tfn", "ffn"}
+        assert lint_snippet(tmp_path, p.read_text(),
+                            [HostCallInJitRule()]) == []
+
+    def test_non_jax_jit_attribute_not_marked(self, tmp_path):
+        p = tmp_path / "t.py"
+        p.write_text(
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    return np.sum(x)\n"
+            "class C:\n"
+            "    def jit(self, fn):\n"
+            "        return fn\n"
+            "c = C()\n"
+            "g = c.jit(f)\n")   # not jax.jit: f stays a host function
+        info = parse_file(str(p), repo=str(tmp_path))
+        assert info.traced_defs == []
+
+    def test_dotted_jax_numpy_import_covered(self, tmp_path):
+        src = (
+            "import jax.numpy\n"
+            "a = jax.numpy.zeros(3)\n"
+            "b = jax.numpy.array([1.0])\n"
+        )
+        findings = lint_snippet(tmp_path, src,
+                                [ImplicitDtypeRule(files=None)])
+        assert rule_names(findings) == ["implicit-dtype"] * 2
+
+    def test_partial_jit_static_argnums(self, tmp_path):
+        p = tmp_path / "t.py"
+        p.write_text(
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnums=(1,))\n"
+            "def f(x, n):\n"
+            "    return x\n")
+        info = parse_file(str(p), repo=str(tmp_path))
+        (td,) = info.traced_defs
+        assert td.static_params == {"n"}
+
+    def test_aliased_from_import_still_entry(self, tmp_path):
+        p = tmp_path / "t.py"
+        p.write_text(
+            "from jax import jit as jjit\n"
+            "import numpy as np\n"
+            "@jjit\n"
+            "def f(x):\n"
+            "    return np.sin(x)\n")
+        info = parse_file(str(p), repo=str(tmp_path))
+        assert {td.node.name for td in info.traced_defs} == {"f"}
+        findings = lint_snippet(tmp_path, p.read_text(),
+                                [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"]
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: each fires on the bad snippet, stays silent on the twin
+# ---------------------------------------------------------------------------
+
+class TestHostCallInJit:
+    BAD = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = np.sum(x)\n"
+        "    print(y)\n"
+        "    z = float(x)\n"
+        "    return y + x.item()\n"
+    )
+    GOOD = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    y = jnp.sum(x)\n"
+        "    z = float(2.0)\n"   # literal coercion: trace-time constant
+        "    return y + z\n"
+        "def host(x):\n"
+        "    print(np.sum(x))\n"  # host code may use numpy freely
+        "    return float(x)\n"
+    )
+
+    def test_fires_on_bad(self, tmp_path):
+        findings = lint_snippet(tmp_path, self.BAD, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 4
+        msgs = " ".join(f.message for f in findings)
+        assert "np.sum" in msgs and "print" in msgs
+        assert "float" in msgs and ".item()" in msgs
+
+    def test_silent_on_good(self, tmp_path):
+        assert lint_snippet(tmp_path, self.GOOD, [HostCallInJitRule()]) == []
+
+    def test_static_shape_coercions_not_flagged(self, tmp_path):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    n = int(x.shape[0])\n"    # static at trace time
+            "    m = int(len(x) * 2)\n"    # ditto
+            "    return x * n * m\n"
+        )
+        assert lint_snippet(tmp_path, src, [HostCallInJitRule()]) == []
+
+
+class TestImplicitDtype:
+    BAD = (
+        "import jax.numpy as jnp\n"
+        "a = jnp.array([1.0, 2.0])\n"
+        "b = jnp.zeros(3)\n"
+        "c = jnp.asarray(1.5)\n"
+    )
+    GOOD = (
+        "import jax.numpy as jnp\n"
+        "a = jnp.array([1.0, 2.0], dtype=jnp.float64)\n"
+        "b = jnp.zeros(3, dtype=jnp.float64)\n"
+        "def convert(x):\n"
+        "    return jnp.asarray(x)\n"  # pass-through keeps x's dtype
+    )
+
+    def test_fires_on_bad(self, tmp_path):
+        findings = lint_snippet(tmp_path, self.BAD,
+                                [ImplicitDtypeRule(files=None)])
+        assert rule_names(findings) == ["implicit-dtype"] * 3
+
+    def test_silent_on_good(self, tmp_path):
+        assert lint_snippet(tmp_path, self.GOOD,
+                            [ImplicitDtypeRule(files=None)]) == []
+
+    def test_scoped_to_precision_core_by_default(self, tmp_path):
+        findings = lint_snippet(tmp_path, self.BAD,
+                                [ImplicitDtypeRule(files=...)])
+        assert findings == []  # snippet.py is not a precision-core file
+
+
+class TestF32UnsafeLiteral:
+    BAD = (
+        "SPLIT = 134217729.0\n"     # 2**27+1: loses integer exactness
+        "PRIOR = 1e40\n"            # overflows f32
+        "TINY = 1e-300\n"           # flushes to zero
+    )
+    GOOD = (
+        "HALF = 0.5\n"
+        "DAY = 86400.0\n"
+        "POW2 = 33554432.0\n"       # 2**25: exact in f32
+        "EPS = 1e-3\n"              # a few ulps of drift is not value-class change
+    )
+
+    def test_fires_on_bad(self, tmp_path):
+        findings = lint_snippet(tmp_path, self.BAD,
+                                [F32UnsafeLiteralRule(files=None)])
+        assert rule_names(findings) == ["f32-unsafe-literal"] * 3
+
+    def test_silent_on_good(self, tmp_path):
+        assert lint_snippet(tmp_path, self.GOOD,
+                            [F32UnsafeLiteralRule(files=None)]) == []
+
+
+class TestTracedBranch:
+    BAD = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, lo):\n"
+        "    y = x * 2\n"
+        "    if y > lo:\n"          # traced-derived local in an `if`
+        "        return y\n"
+        "    while x > 0:\n"        # traced parameter in a `while`
+        "        x = x - 1\n"
+        "    return x\n"
+    )
+    GOOD = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from functools import partial\n"
+        "LIMIT = 3\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if len(x) > 2:\n"          # shape: static under tracing
+        "        x = x + 1\n"
+        "    if x.shape[0] > 1:\n"      # ditto\n"
+        "        x = x * 2\n"
+        "    if LIMIT > 2:\n"           # closure constant
+        "        x = x - 1\n"
+        "    return jnp.where(x > 0, x, -x)\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def g(x, n):\n"
+        "    if n > 0:\n"               # static argument: host branch is fine
+        "        return x\n"
+        "    return -x\n"
+    )
+
+    def test_fires_on_bad(self, tmp_path):
+        findings = lint_snippet(tmp_path, self.BAD, [TracedBranchRule()])
+        assert rule_names(findings) == ["traced-branch"] * 2
+        assert "`if`" in findings[0].message
+        assert "`while`" in findings[1].message
+
+    def test_silent_on_good(self, tmp_path):
+        assert lint_snippet(tmp_path, self.GOOD, [TracedBranchRule()]) == []
+
+
+class TestStaticArgs:
+    BAD = (
+        "import jax\n"
+        "def f(x, opts=[]):\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnums=(1,))\n"
+        "def key_of(d):\n"
+        "    return tuple(d.items())\n"
+    )
+    GOOD = (
+        "import jax\n"
+        "def f(x, opts=()):\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnums=(1,))\n"
+        "def key_of(d):\n"
+        "    return tuple(sorted(d.items()))\n"
+    )
+
+    def test_fires_on_bad(self, tmp_path):
+        findings = lint_snippet(tmp_path, self.BAD, [StaticArgsRule()])
+        assert rule_names(findings) == ["static-args"] * 2
+        msgs = " ".join(f.message for f in findings)
+        assert "mutable" in msgs and "insertion order" in msgs
+
+    def test_silent_on_good(self, tmp_path):
+        assert lint_snippet(tmp_path, self.GOOD, [StaticArgsRule()]) == []
+
+    def test_bare_dict_name_is_function_scoped(self, tmp_path):
+        src = (
+            "def a():\n"
+            "    d = {}\n"
+            "    return tuple(sorted(d.items()))\n"
+            "def b():\n"
+            "    d = []\n"          # same name, different type: no finding
+            "    return tuple(d)\n"
+        )
+        assert lint_snippet(tmp_path, src, [StaticArgsRule()]) == []
+
+
+class TestTypedRaise:
+    def test_fires_on_bad_and_allows_typed(self, tmp_path):
+        src = (
+            "class MyError(Exception):\n"
+            "    pass\n"
+            "def f():\n"
+            "    raise ValueError('bare')\n"
+            "def g():\n"
+            "    raise AllowedError('typed')\n"
+            "def h():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception as e:\n"
+            "        raise e\n"
+        )
+        rule = TypedRaiseRule(files=None, allowed={"AllowedError"})
+        findings = lint_snippet(tmp_path, src, [rule])
+        # ValueError flagged; MyError(Exception) is a local class NOT
+        # rooted in an allowed name... but it is never raised, so only
+        # the bare ValueError fires
+        assert rule_names(findings) == ["typed-raise"]
+        assert "ValueError" in findings[0].message
+
+    def test_local_subclass_of_allowed_is_allowed(self, tmp_path):
+        src = (
+            "class Derived(AllowedError):\n"
+            "    pass\n"
+            "def f():\n"
+            "    raise Derived('ok')\n"
+            "def g():\n"
+            "    raise Rogue('not ok')\n"
+        )
+        rule = TypedRaiseRule(files=None, allowed={"AllowedError"})
+        findings = lint_snippet(tmp_path, src, [rule])
+        assert rule_names(findings) == ["typed-raise"]
+        assert "Rogue" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# pragma + baseline round trips
+# ---------------------------------------------------------------------------
+
+class TestPragmaAndBaseline:
+    SRC = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    a = np.sum(x)  # jaxlint: disable=host-call-in-jit -- fixture\n"
+        "    b = np.mean(x)  # jaxlint: disable=all\n"
+        "    return a + b + np.max(x)\n"
+    )
+
+    def test_pragma_suppresses_by_rule_and_all(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(self.SRC)
+        result = Engine(rules=[HostCallInJitRule()],
+                        repo=str(tmp_path)).run([str(p)])
+        assert len(result.findings) == 1          # only the np.max line
+        assert result.findings[0].lineno == 7
+        assert result.suppressed == 2
+
+    def test_unknown_pragma_rule_is_config_error(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(
+            "import jax\nimport numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.sum(x)  # jaxlint: disable=no-such-rule\n")
+        with pytest.raises(ConfigError):
+            Engine(rules=[HostCallInJitRule()],
+                   repo=str(tmp_path)).run([str(p)])
+
+    def test_baseline_round_trip(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(self.SRC)
+        engine = Engine(rules=[HostCallInJitRule()], repo=str(tmp_path))
+        findings = engine.collect([str(p)])
+        assert len(findings) == 1
+        bl_path = tmp_path / "baseline.txt"
+        write_baseline(str(bl_path), findings)
+        baseline = load_baseline(str(bl_path))
+        result = engine.run([str(p)], baseline=baseline)
+        assert result.findings == []
+        assert result.baselined == 1
+        assert result.stale_baseline == []
+
+    def test_baseline_survives_line_drift_but_not_edits(self, tmp_path):
+        p = tmp_path / "s.py"
+        p.write_text(self.SRC)
+        engine = Engine(rules=[HostCallInJitRule()], repo=str(tmp_path))
+        bl_path = tmp_path / "baseline.txt"
+        write_baseline(str(bl_path), engine.collect([str(p)]))
+        # unrelated lines added above: same entry still matches
+        p.write_text("# a new leading comment\n" + self.SRC)
+        result = engine.run([str(p)], baseline=load_baseline(str(bl_path)))
+        assert result.findings == [] and result.baselined == 1
+        # the flagged line itself changes: entry goes stale, finding is new
+        p.write_text(self.SRC.replace("np.max(x)", "np.max(x) + 0"))
+        result = engine.run([str(p)], baseline=load_baseline(str(bl_path)))
+        assert len(result.findings) == 1
+        assert len(result.stale_baseline) == 1
+
+    def test_stale_is_scoped_to_linted_paths(self, tmp_path):
+        """A partial-path run must not report other files' baseline
+        entries as stale — they were simply not linted."""
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        for p in (a, b):
+            p.write_text(
+                "import jax\nimport numpy as np\n"
+                "@jax.jit\n"
+                "def f(x):\n"
+                "    return np.sum(x)\n")
+        engine = Engine(rules=[HostCallInJitRule()], repo=str(tmp_path))
+        bl_path = tmp_path / "baseline.txt"
+        write_baseline(str(bl_path), engine.collect([str(a), str(b)]))
+        result = engine.run([str(a)], baseline=load_baseline(str(bl_path)))
+        assert result.findings == []
+        assert result.stale_baseline == []  # b.py's entry is NOT stale
+
+    def test_update_baseline_preserves_justifications_and_scope(
+            self, tmp_path, capsys):
+        """--update-baseline keeps hand-written justifications of
+        unchanged entries and retains entries for files outside the
+        linted path set."""
+        from tools.jaxlint.cli import main
+
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        for p in (a, b):
+            p.write_text(
+                "import jax\nimport numpy as np\n"
+                "@jax.jit\n"
+                "def f(x):\n"
+                "    return np.sum(x)\n")
+        bl = tmp_path / "bl.txt"
+        assert main([str(a), str(b), "--baseline", str(bl),
+                     "--update-baseline"]) == 0
+        # hand-edit the justifications
+        text = bl.read_text()
+        assert "TODO: justify" in text
+        bl.write_text(text.replace("# TODO: justify",
+                                   "# REVIEWED: fixture rationale", 1))
+        # partial-path regeneration: a.py relinted, b.py out of scope
+        assert main([str(a), "--baseline", str(bl),
+                     "--update-baseline"]) == 0
+        text = bl.read_text()
+        assert "b.py" in text                      # out-of-scope retained
+        assert "REVIEWED: fixture rationale" in text  # justification kept
+        assert main([str(a), str(b), "--baseline", str(bl)]) == 0
+        capsys.readouterr()
+
+    def test_malformed_baseline_is_config_error(self, tmp_path):
+        bl = tmp_path / "b.txt"
+        bl.write_text("not a valid entry line\n")
+        with pytest.raises(ConfigError):
+            load_baseline(str(bl))
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_exit_codes(self, tmp_path, capsys):
+        from tools.jaxlint.cli import main
+
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\nimport numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.sum(x)\n")
+        assert main([str(clean), "--no-baseline"]) == 0
+        assert main([str(bad), "--no-baseline"]) == 1
+        assert main([str(bad), "--select", "no-such-rule"]) == 2
+        assert main([str(tmp_path / "missing.py")]) == 2
+        # unwritable baseline destination is a config error, not a crash
+        assert main([str(bad), "--baseline",
+                     str(tmp_path / "no-such-dir" / "bl.txt"),
+                     "--update-baseline"]) == 2
+        capsys.readouterr()
+
+    def test_update_baseline_then_clean(self, tmp_path, capsys):
+        from tools.jaxlint.cli import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import jax\nimport numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.sum(x)\n")
+        bl = tmp_path / "bl.txt"
+        assert main([str(bad), "--baseline", str(bl),
+                     "--update-baseline"]) == 0
+        assert main([str(bad), "--baseline", str(bl)]) == 0
+        # a rule-subset rewrite would drop other rules' entries: refused
+        assert main([str(bad), "--baseline", str(bl), "--update-baseline",
+                     "--select", "host-call-in-jit"]) == 2
+        capsys.readouterr()
+
+    def test_list_rules(self, capsys):
+        from tools.jaxlint.cli import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for name in RULES:
+            assert name in out
+
+
+# ---------------------------------------------------------------------------
+# the contract: pint_tpu lints clean against the committed baseline
+# ---------------------------------------------------------------------------
+
+class TestRepoContract:
+    def test_pint_tpu_clean_against_committed_baseline(self):
+        baseline = load_baseline(os.path.join(REPO, "jaxlint_baseline.txt"))
+        result = Engine(rules=default_rules(),
+                        repo=REPO).run(["pint_tpu"], baseline=baseline)
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+
+    def test_committed_baseline_has_no_stale_entries(self):
+        baseline = load_baseline(os.path.join(REPO, "jaxlint_baseline.txt"))
+        result = Engine(rules=default_rules(),
+                        repo=REPO).run(["pint_tpu"], baseline=baseline)
+        assert result.stale_baseline == []
+
+    def test_every_baseline_entry_is_justified(self):
+        """The baseline grandfathers, it does not hide: each entry must
+        carry a justification comment line directly above it."""
+        path = os.path.join(REPO, "jaxlint_baseline.txt")
+        with open(path) as f:
+            lines = [ln.rstrip() for ln in f]
+        prev = ""
+        for ln in lines:
+            if ln and not ln.startswith("#"):
+                assert prev.startswith("#") and len(prev) > 2, (
+                    f"baseline entry lacks a justification comment: {ln!r}")
+            prev = ln

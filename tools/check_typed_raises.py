@@ -1,20 +1,17 @@
 #!/usr/bin/env python
 """AST lint: the ingestion/fitting core raises only typed exceptions.
 
-Walks ``pint_tpu/{io/par,io/tim,toa,fitter,gls_fitter,residuals}.py`` and
-flags every ``raise`` of a disallowed bare builtin (``ValueError``,
-``RuntimeError``, ``Exception``, ``IOError``/``OSError``, ...).  Allowed:
+Thin compatibility shim over the jaxlint ``typed-raise`` rule
+(:mod:`tools.jaxlint.rules.typed_raises`), which is where the logic now
+lives — run ``python -m tools.jaxlint`` for the full trace-safety rule
+set.  This CLI and its ``run()`` / ``check_file()`` /
+``_pint_exception_names()`` API are kept so PR 2's wiring
+(``tests/test_lint_typed_raises.py``) and any scripts keep working.
 
-* anything defined in :mod:`pint_tpu.exceptions` that subclasses
-  ``PintError`` (multi-inheriting ``ValueError`` etc. is fine — that is
-  how back-compat is kept);
-* ``NotImplementedError`` / ``TypeError`` / ``KeyError`` / ``IndexError``
-  / ``AttributeError`` / ``StopIteration`` (programming-contract errors,
-  not data errors);
-* bare re-raises (``raise``) and re-raises of a caught variable.
-
-Run directly (exit 1 on violations) or through
-``tests/test_lint_typed_raises.py``.
+Coverage (``TARGETS``) now extends the original six modules with
+``pint_tpu/io/__init__.py``, ``pint_tpu/integrity/`` and
+``pint_tpu/runtime/``.  ``# jaxlint: disable=typed-raise`` pragmas are
+honored by :func:`run` (not by the low-level :func:`check_file`).
 """
 
 from __future__ import annotations
@@ -25,34 +22,30 @@ import sys
 from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-#: the modules the input-integrity contract covers
-TARGETS = [
-    "pint_tpu/io/par.py",
-    "pint_tpu/io/tim.py",
-    "pint_tpu/toa.py",
-    "pint_tpu/fitter.py",
-    "pint_tpu/gls_fitter.py",
-    "pint_tpu/residuals.py",
-]
+from tools.jaxlint.engine import Engine  # noqa: E402
+from tools.jaxlint.rules.typed_raises import (  # noqa: E402
+    ALLOWED_BUILTINS,
+    DEFAULT_TARGETS,
+    DISALLOWED,
+    TypedRaiseRule,
+    check_tree,
+)
 
-DISALLOWED = {
-    "ValueError", "RuntimeError", "Exception", "BaseException",
-    "IOError", "OSError", "EnvironmentError", "ArithmeticError",
-    "FloatingPointError", "ZeroDivisionError", "SystemError",
-}
-
-ALLOWED_BUILTINS = {
-    "NotImplementedError", "TypeError", "KeyError", "IndexError",
-    "AttributeError", "StopIteration", "FileNotFoundError",
-}
+#: the modules the typed-raise contract covers (files and directories)
+TARGETS = list(DEFAULT_TARGETS)
 
 
 def _pint_exception_names() -> set:
     """Names importable from pint_tpu.exceptions that subclass PintError
     (or are warning categories, which are never raised as errors)."""
-    import pint_tpu.exceptions as exc
-
+    sys.path.insert(0, REPO)
+    try:
+        import pint_tpu.exceptions as exc
+    finally:
+        sys.path.pop(0)
     names = set()
     for name in dir(exc):
         obj = getattr(exc, name)
@@ -62,60 +55,20 @@ def _pint_exception_names() -> set:
     return names
 
 
-def _raised_name(node: ast.Raise):
-    """The exception *name* a raise statement uses, or None for a bare
-    re-raise."""
-    exc = node.exc
-    if exc is None:
-        return None  # bare `raise` inside an except block
-    if isinstance(exc, ast.Call):
-        exc = exc.func
-    if isinstance(exc, ast.Name):
-        return exc.id
-    if isinstance(exc, ast.Attribute):
-        return exc.attr
-    return "<dynamic>"
-
-
 def check_file(path: str, allowed: set) -> List[Tuple[int, str]]:
+    """(lineno, message) findings for one file (no pragma filtering)."""
     with open(path) as f:
         tree = ast.parse(f.read(), filename=path)
-    # names bound by `except ... as e` are re-raise variables
-    handler_vars = {n.name for n in ast.walk(tree)
-                    if isinstance(n, ast.ExceptHandler) and n.name}
-    bad = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Raise):
-            continue
-        name = _raised_name(node)
-        if name is None or name in handler_vars:
-            continue  # re-raise
-        if name == "<dynamic>":
-            continue  # computed exception object; out of AST-lint scope
-        if name in DISALLOWED:
-            bad.append((node.lineno,
-                        f"raise of bare {name} (use a typed "
-                        f"pint_tpu.exceptions class)"))
-        elif name not in allowed and name not in ALLOWED_BUILTINS:
-            bad.append((node.lineno,
-                        f"raise of unknown exception {name} (not a "
-                        f"PintError subclass)"))
-    return bad
+    return check_tree(tree, allowed)
 
 
 def run(targets=None) -> List[str]:
-    """Lint the target files; returns violation strings (empty = clean)."""
-    sys.path.insert(0, REPO)
-    try:
-        allowed = _pint_exception_names()
-    finally:
-        sys.path.pop(0)
-    out = []
-    for rel in targets or TARGETS:
-        path = os.path.join(REPO, rel)
-        for lineno, msg in check_file(path, allowed):
-            out.append(f"{rel}:{lineno}: {msg}")
-    return out
+    """Lint the target files; returns violation strings (empty = clean).
+    Pragma-suppressed raises (``# jaxlint: disable=typed-raise``) do not
+    count as violations."""
+    engine = Engine(rules=[TypedRaiseRule(files=None)], repo=REPO)
+    result = engine.run(list(targets or TARGETS))
+    return [f"{f.path}:{f.lineno}: {f.message}" for f in result.findings]
 
 
 def main() -> int:
@@ -125,7 +78,7 @@ def main() -> int:
     if violations:
         print(f"{len(violations)} typed-raise violation(s)")
         return 1
-    print(f"OK: {len(TARGETS)} file(s) raise only typed exceptions")
+    print(f"OK: {len(TARGETS)} target(s) raise only typed exceptions")
     return 0
 
 

@@ -1,0 +1,18 @@
+"""jaxlint: JAX trace-safety & precision static analysis for the TPU hot
+path.
+
+Usage: ``python -m tools.jaxlint [paths...]`` (see :mod:`tools.jaxlint.cli`
+for flags and exit codes) or the pytest wiring in ``tests/test_jaxlint.py``.
+Rule catalogue and pragma/baseline syntax: DESIGN.md, "Static analysis &
+trace-safety contract".
+"""
+
+from tools.jaxlint.engine import (  # noqa: F401
+    ConfigError,
+    Engine,
+    Finding,
+    LintResult,
+    load_baseline,
+    parse_file,
+    write_baseline,
+)

@@ -1,0 +1,12 @@
+import os
+import sys
+
+# `python -m tools.jaxlint` from anywhere: the engine imports itself as
+# `tools.jaxlint.*`, which needs the repo root on sys.path
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.jaxlint.cli import main  # noqa: E402
+
+sys.exit(main())

@@ -1,0 +1,120 @@
+"""jaxlint CLI: ``python -m tools.jaxlint [paths...]``.
+
+Exit codes (stable, for CI and pre-commit):
+
+* ``0`` — clean (every finding pragma-suppressed or baselined)
+* ``1`` — violations
+* ``2`` — configuration error (unknown rule, bad pragma, unreadable
+  path/baseline, unparsable target file)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from tools.jaxlint.engine import (
+    REPO,
+    ConfigError,
+    Engine,
+    iter_python_files,
+    load_baseline,
+    read_baseline_entries,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(REPO, "jaxlint_baseline.txt")
+
+
+def _build_engine(select: Optional[str]) -> Engine:
+    from tools.jaxlint.rules import RULES, default_rules
+
+    if not select:
+        return Engine()
+    names = [n.strip() for n in select.split(",") if n.strip()]
+    unknown = [n for n in names if n not in RULES]
+    if unknown:
+        raise ConfigError(f"--select names unknown rule(s) {unknown}; "
+                          f"known: {sorted(RULES)}")
+    rules = [r for r in default_rules() if r.name in names]
+    return Engine(rules=rules)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description="JAX trace-safety & precision static analysis for the "
+                    "TPU hot path")
+    ap.add_argument("paths", nargs="*", default=["pint_tpu"],
+                    help="files/directories to lint (default: pint_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings "
+                         "(default: jaxlint_baseline.txt at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings and "
+                         "exit 0")
+    ap.add_argument("--select", default=None, metavar="RULES",
+                    help="comma-separated rule names to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        if args.list_rules:
+            from tools.jaxlint.rules import RULES
+
+            for name in sorted(RULES):
+                print(f"{name:<22} {RULES[name].description}")
+            return 0
+
+        engine = _build_engine(args.select)
+        paths = args.paths or ["pint_tpu"]
+
+        if args.update_baseline:
+            if args.select:
+                raise ConfigError(
+                    "--update-baseline cannot be combined with --select: "
+                    "rewriting the shared baseline from a rule subset "
+                    "would drop every other rule's entries (and their "
+                    "justifications)")
+            previous = read_baseline_entries(args.baseline) \
+                if os.path.exists(args.baseline) else []
+            # entries for files outside this run's path set are kept
+            # verbatim — a partial-path update must never drop another
+            # file's grandfathered findings or their justifications
+            linted = {os.path.relpath(p, REPO).replace(os.sep, "/")
+                      for p in iter_python_files(paths, REPO)}
+            retained = [(c, k) for c, k in previous if k[0] not in linted]
+            findings = engine.collect(paths)
+            write_baseline(args.baseline, findings, previous=previous,
+                           retained=retained)
+            print(f"wrote {len(findings)} finding(s) "
+                  f"(+{len(retained)} out-of-scope retained) to "
+                  f"{args.baseline}")
+            return 0
+
+        baseline = None
+        if not args.no_baseline and os.path.exists(args.baseline):
+            baseline = load_baseline(args.baseline)
+        result = engine.run(paths, baseline=baseline)
+    except ConfigError as e:
+        print(f"jaxlint: configuration error: {e}", file=sys.stderr)
+        return 2
+
+    for f in result.findings:
+        print(f.render())
+    for key in result.stale_baseline:
+        print(f"jaxlint: note: stale baseline entry {key[0]} :: {key[1]} :: "
+              f"{key[2]!r} no longer matches any finding", file=sys.stderr)
+    if result.findings:
+        print(f"{len(result.findings)} violation(s) "
+              f"({result.baselined} baselined, "
+              f"{result.suppressed} pragma-suppressed)")
+        return 1
+    print(f"OK ({result.baselined} baselined, "
+          f"{result.suppressed} pragma-suppressed)")
+    return 0

@@ -1,0 +1,532 @@
+"""jaxlint engine: AST walk with trace-scope tracking, pragmas, baseline.
+
+The engine parses each target file once into a :class:`FileInfo` — source
+lines, import aliases (``np``/``jnp``/``jax``), and the set of *traced*
+functions (functions whose bodies execute under ``jax.jit`` / ``vmap`` /
+``pmap`` / ``lax.scan``-family tracing, found by decorator tracking AND by
+resolving ``jax.jit(f)``-style wrap calls back to their ``def``) — then
+hands it to every registered rule (:mod:`tools.jaxlint.rules`).
+
+Suppression layers, in order:
+
+* ``# jaxlint: disable=rule[,rule2]`` (or ``disable=all``) on the finding's
+  line silences it with an in-code justification;
+* a committed baseline file (:func:`load_baseline`) grandfathers findings
+  keyed by ``(path, rule, normalized source line)`` — line-number drift
+  does not invalidate entries, editing the flagged line does.
+
+Exit-code contract (the CLI in :mod:`tools.jaxlint.cli`): 0 clean,
+1 violations, 2 configuration/parse errors.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: transforms that make their function argument's body traced
+TRACE_ENTRY = {"jit", "vmap", "pmap", "shard_map", "filter_jit"}
+#: transforms that pass a function through to an enclosing trace entry
+TRACE_PASSTHROUGH = {"grad", "value_and_grad", "jacfwd", "jacrev", "hessian",
+                     "checkpoint", "remat", "custom_jvp", "custom_vjp"}
+#: jax.lax combinators whose function arguments are traced when executed
+LAX_BODY = {"scan", "cond", "while_loop", "fori_loop", "switch", "map",
+            "associative_scan"}
+
+#: ``# jaxlint: disable=rule[,rule2] -- free-text justification``; the
+#: capture stops after the comma-separated name list, so the justification
+#: that follows is never mistaken for a rule name
+_PRAGMA_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+class ConfigError(Exception):
+    """Bad lint configuration (unknown rule, unreadable path/baseline,
+    unparsable target file).  The CLI maps this to exit code 2."""
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str      #: repo-relative path
+    lineno: int
+    col: int
+    message: str
+    line_text: str = ""   #: stripped source of the flagged line
+
+    def render(self) -> str:
+        return f"{self.path}:{self.lineno}:{self.col}: {self.rule}: {self.message}"
+
+    def baseline_key(self) -> Tuple[str, str, str]:
+        return (self.path, self.rule, self.line_text)
+
+
+@dataclass
+class TracedDef:
+    """A function whose body is traced, plus the parameter names jit marks
+    static (excluded from traced-value taint)."""
+
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef / Lambda
+    static_params: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class FileInfo:
+    """Everything a rule needs to know about one parsed file."""
+
+    path: str                    #: repo-relative (posix separators)
+    abspath: str
+    tree: ast.Module
+    lines: List[str]
+    np_aliases: Set[str] = field(default_factory=set)
+    jnp_aliases: Set[str] = field(default_factory=set)
+    jax_aliases: Set[str] = field(default_factory=set)
+    #: bare names bound to trace transforms, mapped to their ORIGINAL
+    #: name (``from jax import jit as jjit`` -> {"jjit": "jit"}) so
+    #: aliased imports still classify as entry vs passthrough
+    trace_names: Dict[str, str] = field(default_factory=dict)
+    traced_defs: List[TracedDef] = field(default_factory=list)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        return Finding(rule=rule, path=self.path, lineno=lineno,
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message, line_text=self.source_line(lineno))
+
+    def pragmas_for(self, lineno: int) -> Set[str]:
+        """Rule names disabled on ``lineno`` (``{"all"}`` disables every
+        rule).  Raises :class:`ConfigError` on an unknown rule name so
+        pragma typos fail loudly instead of silently not suppressing."""
+        from tools.jaxlint.rules import RULES
+
+        m = _PRAGMA_RE.search(self.lines[lineno - 1]) \
+            if 1 <= lineno <= len(self.lines) else None
+        if not m:
+            return set()
+        names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+        unknown = names - set(RULES) - {"all"}
+        if unknown:
+            raise ConfigError(
+                f"{self.path}:{lineno}: pragma names unknown rule(s) "
+                f"{sorted(unknown)}; known: {sorted(RULES)} or 'all'")
+        return names
+
+
+def walk_own(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body, *excluding* nested function subtrees (each
+    nested def is visited in its own iteration)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# file parsing: imports, traced-function discovery
+# ---------------------------------------------------------------------------
+
+def _record_imports(info: FileInfo) -> None:
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                bound = a.asname or a.name.split(".")[0]
+                if a.name == "numpy":
+                    info.np_aliases.add(bound)
+                elif a.name == "jax.numpy":
+                    if a.asname:
+                        info.jnp_aliases.add(a.asname)
+                    else:
+                        # plain `import jax.numpy` binds `jax`; dotted
+                        # `jax.numpy.X` calls match via is_jnp_root
+                        info.jax_aliases.add("jax")
+                elif a.name == "jax" or a.name.startswith("jax."):
+                    info.jax_aliases.add(bound)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        info.jnp_aliases.add(a.asname or "numpy")
+                    elif a.name in TRACE_ENTRY | TRACE_PASSTHROUGH:
+                        info.trace_names[a.asname or a.name] = a.name
+            elif node.module in ("jax.numpy",):
+                pass  # from jax.numpy import X: X is a jnp function, not alias
+            elif node.module == "numpy":
+                pass
+
+
+def _attr_root(node: ast.AST) -> Optional[str]:
+    """Leftmost name of a dotted expression (``jax.lax.scan`` -> ``jax``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def is_jnp_root(node: ast.AST, info: FileInfo) -> bool:
+    """True when ``node`` denotes the jax.numpy module: a bound alias
+    (``jnp``) or the dotted ``jax.numpy`` form."""
+    if isinstance(node, ast.Name):
+        return node.id in info.jnp_aliases
+    return (isinstance(node, ast.Attribute) and node.attr == "numpy"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in (info.jax_aliases | {"jax"}))
+
+
+def _transform_kind(func: ast.AST, info: FileInfo) -> Optional[str]:
+    """Classify a call target: 'entry' (jit/vmap/pmap), 'passthrough'
+    (grad family), 'lax' (scan/cond/...), or None."""
+    if isinstance(func, ast.Name):
+        orig = info.trace_names.get(func.id)
+        if orig is not None:
+            return "entry" if orig in TRACE_ENTRY else "passthrough"
+        return None
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        root = _attr_root(func)
+        jax_roots = info.jax_aliases | {"jax"}
+        if attr in TRACE_ENTRY and root in jax_roots:
+            return "entry"
+        if attr in TRACE_PASSTHROUGH and root in jax_roots:
+            return "passthrough"
+        if attr in LAX_BODY:
+            # require a lax-ish root: jax.lax.scan / lax.scan
+            parent = func.value
+            if (isinstance(parent, ast.Attribute) and parent.attr == "lax") \
+                    or (isinstance(parent, ast.Name) and parent.id == "lax"):
+                return "lax"
+    return None
+
+
+#: positional indices that hold *functions* in each lax combinator (other
+#: operands — predicates, carries, xs — are data and must not mark defs)
+_LAX_FN_POSITIONS = {
+    "scan": (0,), "map": (0,), "associative_scan": (0,),
+    "cond": (1, 2), "switch": (1,),
+    "while_loop": (0, 1), "fori_loop": (2,),
+}
+#: keyword names that carry functions across jit/lax APIs
+_FN_KEYWORDS = {"fun", "f", "body_fun", "cond_fun", "true_fun", "false_fun"}
+
+
+def _collect_fn_args(call: ast.Call, info: FileInfo,
+                     out_names: Set[str]) -> None:
+    """Function-valued argument names reachable from a trace-transform
+    call: ``jit(f)``, ``jit(vmap(f))``, ``jit(partial(f, x))``,
+    ``lax.scan(step, ...)`` contribute the underlying name.  Only
+    function *positions* are considered — a ``lax.cond`` predicate or a
+    ``scan`` carry that happens to share a module-level def's name must
+    not mark that def as traced."""
+    kind = _transform_kind(call.func, info)
+    if kind == "lax":
+        attr = call.func.attr if isinstance(call.func, ast.Attribute) else ""
+        positions = _LAX_FN_POSITIONS.get(attr, (0,))
+    else:
+        # jit/vmap/pmap/grad-family and partial: the wrapped callable is
+        # the first positional argument
+        positions = (0,)
+    args = [a for i, a in enumerate(call.args) if i in positions]
+    args += [kw.value for kw in call.keywords if kw.arg in _FN_KEYWORDS]
+    for a in args:
+        if isinstance(a, ast.Name):
+            out_names.add(a.id)
+        elif isinstance(a, (ast.Tuple, ast.List)):  # lax.switch branches
+            out_names.update(e.id for e in a.elts if isinstance(e, ast.Name))
+        elif isinstance(a, ast.Call):
+            inner = _transform_kind(a.func, info)
+            is_partial = (isinstance(a.func, ast.Name)
+                          and a.func.id == "partial") or (
+                isinstance(a.func, ast.Attribute) and a.func.attr == "partial")
+            if inner is not None or is_partial:
+                _collect_fn_args(a, info, out_names)
+
+
+def _static_params_from_decorator(dec: ast.AST, fn: ast.AST) -> Set[str]:
+    """Parameter names a ``@partial(jax.jit, static_argnums=...)`` /
+    ``@jax.jit`` decorator marks static (literal ints/strings only)."""
+    if not isinstance(dec, ast.Call):
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args] \
+        if not isinstance(fn, ast.Lambda) else []
+    out: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg == "static_argnums":
+            idxs = []
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    idxs.append(e.value)
+            out |= {params[i] for i in idxs if 0 <= i < len(params)}
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+    return out
+
+
+def _find_traced_defs(info: FileInfo) -> None:
+    """Populate ``info.traced_defs``: decorator-marked defs, defs resolved
+    from wrap calls, and everything nested inside either."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(info.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    marked: Dict[int, TracedDef] = {}
+
+    def mark(node: ast.AST, static: Set[str] = frozenset()) -> None:
+        td = marked.get(id(node))
+        if td is None:
+            marked[id(node)] = TracedDef(node, set(static))
+        else:
+            td.static_params |= static
+
+    # 1) decorators
+    for name, nodes in defs_by_name.items():
+        for fn in nodes:
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                kind = _transform_kind(target, info)
+                is_partial = isinstance(dec, ast.Call) and (
+                    (isinstance(dec.func, ast.Name) and dec.func.id == "partial")
+                    or (isinstance(dec.func, ast.Attribute)
+                        and dec.func.attr == "partial"))
+                if is_partial and dec.args:
+                    kind = _transform_kind(dec.args[0], info) or kind
+                if kind == "entry":
+                    mark(fn, _static_params_from_decorator(dec, fn))
+
+    # 2) wrap calls anywhere in the module: jit(f), jit(vmap(g)), lax.scan(h)
+    wrapped_names: Set[str] = set()
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Call) and _transform_kind(node.func, info) in (
+                "entry", "lax"):
+            _collect_fn_args(node, info, wrapped_names)
+    for name in wrapped_names:
+        for fn in defs_by_name.get(name, []):
+            mark(fn)
+
+    # 3) nested defs/lambdas inside any traced def are traced too
+    frontier = [td.node for td in marked.values()]
+    while frontier:
+        node = frontier.pop()
+        for child in ast.walk(node):
+            if child is node:
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)) and id(child) not in marked:
+                mark(child)
+                frontier.append(child)
+
+    info.traced_defs = sorted(marked.values(), key=lambda t: t.node.lineno)
+
+
+def parse_file(abspath: str, repo: str = REPO) -> FileInfo:
+    rel = os.path.relpath(abspath, repo).replace(os.sep, "/")
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=abspath)
+    except (OSError, SyntaxError) as e:
+        raise ConfigError(f"cannot lint {rel}: {e}") from e
+    info = FileInfo(path=rel, abspath=abspath, tree=tree,
+                    lines=source.splitlines())
+    _record_imports(info)
+    _find_traced_defs(info)
+    return info
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_SEP = " :: "
+
+
+#: one baseline entry as stored on disk: its justification comment block
+#: (lines above it, ``#``-prefixed) and its (path, rule, line_text) key
+BaselineEntry = Tuple[List[str], Tuple[str, str, str]]
+
+_BASELINE_HEADER = [
+    "# jaxlint baseline: grandfathered findings, matched by",
+    "# (path, rule, source line) so entries survive line-number drift.",
+    "# Keep a one-line justification comment above every entry.",
+]
+
+
+def read_baseline_entries(path: str) -> List[BaselineEntry]:
+    """Baseline file -> ordered (comment block, key) entries.  The comment
+    block is the contiguous run of ``#`` lines directly above the entry
+    (the justification); the file header is not attributed to any entry."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+    except OSError as e:
+        raise ConfigError(f"cannot read baseline {path}: {e}") from e
+    entries: List[BaselineEntry] = []
+    comments: List[str] = []
+    for n, line in enumerate(raw.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            comments = []  # a blank line ends a justification block
+            continue
+        if line.startswith("#"):
+            comments.append(line)
+            continue
+        parts = line.split(BASELINE_SEP, 2)
+        if len(parts) != 3:
+            raise ConfigError(
+                f"{path}:{n}: malformed baseline entry (expected "
+                f"'path{BASELINE_SEP}rule{BASELINE_SEP}source line')")
+        entries.append((comments, (parts[0], parts[1], parts[2])))
+        comments = []
+    return entries
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline file -> multiset of (path, rule, line_text) keys."""
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for _, key in read_baseline_entries(path):
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   previous: Optional[Sequence[BaselineEntry]] = None,
+                   retained: Optional[Sequence[BaselineEntry]] = None) -> None:
+    """Write the baseline for ``findings``, carrying over the hand-written
+    justification of every entry whose key is unchanged in ``previous``,
+    and keeping ``retained`` entries verbatim (entries for files outside
+    the linted path set, so a partial-path --update-baseline never drops
+    another file's grandfathered findings)."""
+    prev_comments: Dict[Tuple[str, str, str], List[str]] = {}
+    for comments, key in (previous or []):
+        prev_comments.setdefault(key, comments)
+    out: List[BaselineEntry] = list(retained or [])
+    seen = {key for _, key in out}
+    for f in sorted(findings, key=lambda f: (f.path, f.lineno, f.rule)):
+        key = f.baseline_key()
+        if key in seen:
+            continue
+        comments = prev_comments.get(key) or [
+            "# TODO: justify (from --update-baseline; "
+            f"was {f.path}:{f.lineno})"]
+        out.append((comments, key))
+    lines = list(_BASELINE_HEADER)
+    for comments, key in sorted(out, key=lambda e: e[1]):
+        lines.append("")
+        lines.extend(comments)
+        lines.append(BASELINE_SEP.join(key))
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines) + "\n")
+    except OSError as e:
+        raise ConfigError(f"cannot write baseline {path}: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LintResult:
+    findings: List[Finding]          #: violations after pragma + baseline
+    suppressed: int = 0              #: pragma-suppressed count
+    baselined: int = 0               #: baseline-matched count
+    stale_baseline: List[Tuple[str, str, str]] = field(default_factory=list)
+
+
+def iter_python_files(paths: Sequence[str], repo: str = REPO) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(repo, p)
+        if os.path.isfile(ap):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+        else:
+            raise ConfigError(f"no such file or directory: {p}")
+    return sorted(set(out))
+
+
+class Engine:
+    """Applies a rule set over files, then pragma and baseline filters."""
+
+    def __init__(self, rules: Optional[Sequence] = None, repo: str = REPO):
+        from tools.jaxlint.rules import default_rules
+
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.repo = repo
+
+    def lint_file(self, abspath: str) -> List[Finding]:
+        return self._lint_file(parse_file(abspath, self.repo))
+
+    def _lint_file(self, info: FileInfo) -> List[Finding]:
+        raw: List[Finding] = []
+        for rule in self.rules:
+            if not rule.applies(info.path):
+                continue
+            raw.extend(rule.check(info))
+        # dedupe (nested traced defs can be reachable twice) and apply
+        # line pragmas
+        out, seen = [], set()
+        for f in sorted(raw, key=lambda f: (f.lineno, f.col, f.rule)):
+            key = (f.rule, f.lineno, f.col, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(f)
+        return out
+
+    def run(self, paths: Sequence[str],
+            baseline: Optional[Dict[Tuple[str, str, str], int]] = None
+            ) -> LintResult:
+        baseline = dict(baseline or {})
+        findings: List[Finding] = []
+        suppressed = baselined = 0
+        linted_paths: Set[str] = set()
+        for abspath in iter_python_files(paths, self.repo):
+            info = parse_file(abspath, self.repo)
+            linted_paths.add(info.path)
+            for f in self._lint_file(info):
+                disabled = info.pragmas_for(f.lineno)
+                if "all" in disabled or f.rule in disabled:
+                    suppressed += 1
+                    continue
+                key = f.baseline_key()
+                if baseline.get(key, 0) > 0:
+                    baseline[key] -= 1
+                    baselined += 1
+                    continue
+                findings.append(f)
+        # an entry is stale only if its file was actually linted this run;
+        # a partial-path run must not claim other files' entries are dead
+        stale = [k for k, n in baseline.items()
+                 if n > 0 and k[0] in linted_paths]
+        return LintResult(findings=findings, suppressed=suppressed,
+                          baselined=baselined, stale_baseline=stale)
+
+    def collect(self, paths: Sequence[str]) -> List[Finding]:
+        """All pragma-filtered findings (no baseline) — what
+        ``--update-baseline`` snapshots."""
+        return self.run(paths, baseline=None).findings

@@ -1,0 +1,72 @@
+"""jaxlint rule registry.
+
+A rule is a class with ``name``, ``description``, ``applies(relpath)`` and
+``check(info) -> Iterable[Finding]``.  Register new rules with
+:func:`register`; :func:`default_rules` instantiates the registry with the
+repo's default scoping (see DESIGN.md "Static analysis & trace-safety
+contract" for the catalogue and how to add one).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+RULES: Dict[str, Type] = {}
+
+
+def register(cls):
+    """Class decorator: add a rule to the registry under ``cls.name``."""
+    if not getattr(cls, "name", None):
+        raise ValueError(f"rule {cls!r} has no name")
+    RULES[cls.name] = cls
+    return cls
+
+
+class Rule:
+    """Base rule: applies everywhere, finds nothing."""
+
+    name = ""
+    description = ""
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, info):
+        return []
+
+
+class ScopedRule(Rule):
+    """Rule restricted to an explicit file/directory set.  ``files=None``
+    applies everywhere (the fixture-test mode); directories match by
+    prefix."""
+
+    #: repo-relative files or directory prefixes this rule covers
+    default_files: tuple = ()
+
+    def __init__(self, files=None):
+        self.files = self.default_files if files is ... else files
+
+    def applies(self, relpath: str) -> bool:
+        if self.files is None:
+            return True
+        return any(relpath == f or relpath.startswith(f.rstrip("/") + "/")
+                   for f in self.files)
+
+
+# import order defines reporting order for equal-position findings
+from tools.jaxlint.rules import host_jit          # noqa: E402,F401
+from tools.jaxlint.rules import dtype_literals    # noqa: E402,F401
+from tools.jaxlint.rules import traced_branch     # noqa: E402,F401
+from tools.jaxlint.rules import static_args       # noqa: E402,F401
+from tools.jaxlint.rules import typed_raises      # noqa: E402,F401
+
+
+def default_rules() -> List[Rule]:
+    """One instance of every registered rule at its default scope."""
+    out = []
+    for cls in RULES.values():
+        try:
+            out.append(cls(files=...))
+        except TypeError:
+            out.append(cls())
+    return out

@@ -1,0 +1,126 @@
+"""Precision-core dtype discipline: implicit-dtype and f32-unsafe-literal.
+
+The sub-ns timing arithmetic stores an f64 as a hi/lo float32-backed pair
+on TPU and depends on every buffer being float64 *by construction*.  Two
+checks over the precision-core file set:
+
+* ``implicit-dtype`` — ``jnp.array``/``jnp.asarray`` building a fresh
+  buffer from Python values (list/tuple/scalar/comprehension) and the
+  fresh-buffer creators (``zeros``/``ones``/``full``/``empty``/``eye``/
+  ``identity``/``arange``/``linspace``) without an explicit ``dtype=``:
+  with ``jax_enable_x64`` off these silently materialize float32 and
+  corrupt the hi/lo split.  ``jnp.asarray(existing_f64_array)`` passes
+  through its input dtype and is not flagged.
+* ``f32-unsafe-literal`` — float literals that do not survive float32
+  narrowing: |x| >= 2**24 (beyond f32 integer-exactness, e.g. the Dekker
+  splitter 2**27+1), |x| > f32 max (overflows to inf), or
+  0 < |x| < f32 min normal (flushes to zero, e.g. 1e-300 clamps).  Under
+  default-f32 promotion these constants don't lose a few ulps — they
+  change value class and poison the arithmetic.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+
+from tools.jaxlint.engine import FileInfo, is_jnp_root
+from tools.jaxlint.rules import ScopedRule, register
+
+#: files whose arithmetic carries the sub-ns precision contract
+PRECISION_CORE = (
+    "pint_tpu/dd.py",
+    "pint_tpu/pulsar_mjd.py",
+    "pint_tpu/residuals.py",
+    "pint_tpu/gls_fitter.py",
+    "pint_tpu/grid.py",
+    "pint_tpu/models/timing_model.py",
+)
+
+_FRESH_CREATORS = {"zeros", "ones", "full", "empty", "eye", "identity",
+                   "arange", "linspace"}
+_FROM_PYTHON = {"array", "asarray"}
+
+_F32_MAX = 3.4028235e38
+_F32_MIN_NORMAL = 1.1754944e-38
+_F32_INT_EXACT = float(2 ** 24)
+
+
+def _builds_from_python(node: ast.Call) -> bool:
+    if not node.args:
+        return False
+    a = node.args[0]
+    return isinstance(a, (ast.List, ast.Tuple, ast.ListComp,
+                          ast.GeneratorExp)) or (
+        isinstance(a, ast.Constant) and isinstance(a.value, (int, float,
+                                                             complex)))
+
+
+def _has_dtype(node: ast.Call) -> bool:
+    return any(kw.arg == "dtype" for kw in node.keywords)
+
+
+@register
+class ImplicitDtypeRule(ScopedRule):
+    name = "implicit-dtype"
+    description = ("jnp array construction without explicit dtype= in the "
+                   "precision core")
+    default_files = PRECISION_CORE
+
+    def check(self, info: FileInfo):
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            root = node.func.value
+            if not is_jnp_root(root, info):
+                continue
+            attr = node.func.attr
+            if _has_dtype(node):
+                continue
+            rootname = root.id if isinstance(root, ast.Name) else "jax.numpy"
+            if attr in _FRESH_CREATORS:
+                yield info.finding(
+                    self.name, node,
+                    f"`{rootname}.{attr}(...)` without dtype= in the "
+                    "precision core: materializes float32 when x64 is off; "
+                    "pass dtype=jnp.float64 explicitly")
+            elif attr in _FROM_PYTHON and _builds_from_python(node):
+                yield info.finding(
+                    self.name, node,
+                    f"`{rootname}.{attr}(...)` builds a buffer from Python "
+                    "values without dtype= in the precision core; pass "
+                    "dtype=jnp.float64 explicitly")
+
+
+@register
+class F32UnsafeLiteralRule(ScopedRule):
+    name = "f32-unsafe-literal"
+    description = ("float literals that overflow/flush/lose integer "
+                   "exactness under float32 narrowing, in the precision "
+                   "core")
+    default_files = PRECISION_CORE
+
+    def check(self, info: FileInfo):
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, float)):
+                continue
+            x = abs(node.value)
+            if x == 0.0:
+                continue
+            roundtrips = struct.unpack("f", struct.pack("f", x))[0] == x
+            if x > _F32_MAX:
+                why = "overflows to inf under float32 narrowing"
+            elif x < _F32_MIN_NORMAL:
+                why = "flushes toward zero under float32 narrowing"
+            elif x >= _F32_INT_EXACT and not roundtrips:
+                why = ("exceeds the float32 integer-exact range (2**24) "
+                       "and does not survive narrowing")
+            else:
+                continue
+            yield info.finding(
+                self.name, node,
+                f"float literal {node.value!r} {why}; bind it through an "
+                "explicit float64 (np.float64/jnp.float64) or justify "
+                "with a pragma")

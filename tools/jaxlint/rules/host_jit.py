@@ -1,0 +1,98 @@
+"""host-call-in-jit: no host/device sync inside traced code.
+
+Inside a jit/vmap/pmap-decorated (or jit-wrapped, or lax.scan-body)
+function, flag:
+
+* ``np.*`` calls — numpy executes on host; on a traced array it forces a
+  device->host transfer per call (or a ConcretizationTypeError), and on
+  constants it silently bakes a host value into the executable;
+* ``float()`` / ``int()`` / ``bool()`` / ``complex()`` coercions of
+  non-literal values, and ``.item()`` / ``.tolist()`` /
+  ``.block_until_ready()`` methods — all synchronous host pulls;
+* ``print()`` / ``open()`` / ``input()`` / ``breakpoint()`` — host I/O
+  that either traces once (misleading) or fails under jit.
+
+Use ``jnp.*`` / ``jax.debug.print`` / ``jax.debug.callback`` instead, or
+hoist the host work out of the traced function.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.jaxlint.engine import FileInfo, walk_own
+from tools.jaxlint.rules import Rule, register
+
+_COERCIONS = {"float", "int", "bool", "complex"}
+_HOST_IO = {"print", "open", "input", "breakpoint"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+_STATIC_CALLS = {"len", "isinstance"}
+
+
+def _is_trace_static(node: ast.AST) -> bool:
+    """Expressions that are plain Python values at trace time: literals,
+    shape-like attribute reads (``x.shape[0]``, ``x.ndim``), and
+    ``len(...)``/``isinstance(...)`` — coercing those never concretizes a
+    traced array."""
+    try:
+        ast.literal_eval(node)
+        return True
+    except (ValueError, TypeError, SyntaxError, MemoryError):
+        pass
+    if isinstance(node, ast.Subscript):
+        return _is_trace_static(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _STATIC_CALLS
+    if isinstance(node, ast.BinOp):
+        return _is_trace_static(node.left) and _is_trace_static(node.right)
+    return False
+
+
+@register
+class HostCallInJitRule(Rule):
+    name = "host-call-in-jit"
+    description = ("np.* calls, float()/.item() coercions, print and host "
+                   "I/O inside jit/vmap/pmap-traced functions")
+
+    def check(self, info: FileInfo):
+        for td in info.traced_defs:
+            fn = td.node
+            for node in walk_own(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    root = func.value
+                    if isinstance(root, ast.Name) and root.id in info.np_aliases:
+                        yield info.finding(
+                            self.name, node,
+                            f"numpy call `{root.id}.{func.attr}(...)` inside "
+                            "traced code: host execution forces a device "
+                            "sync (or bakes a constant); use jnp/lax, or "
+                            "hoist to the host caller")
+                    elif func.attr in _SYNC_METHODS and not node.args:
+                        yield info.finding(
+                            self.name, node,
+                            f"`.{func.attr}()` inside traced code is a "
+                            "synchronous device->host pull; return the "
+                            "array and coerce outside the trace")
+                elif isinstance(func, ast.Name):
+                    if func.id in _HOST_IO:
+                        yield info.finding(
+                            self.name, node,
+                            f"`{func.id}(...)` inside traced code: host I/O "
+                            "runs once at trace time (or fails under jit); "
+                            "use jax.debug.print/callback if intentional")
+                    elif func.id in _COERCIONS and node.args and not all(
+                            _is_trace_static(a) for a in node.args):
+                        yield info.finding(
+                            self.name, node,
+                            f"`{func.id}(...)` coercion inside traced code "
+                            "concretizes a traced value (host sync / "
+                            "ConcretizationTypeError); keep it an array or "
+                            "coerce outside the trace")

@@ -1,0 +1,157 @@
+"""static-args: hashability of jit static arguments and cache-key order.
+
+Two checks for the recompilation/cache-correctness bug class (the PR 1
+grid-cache leak family):
+
+* a parameter marked ``static_argnums``/``static_argnames`` whose default
+  is a mutable literal (list/dict/set/comprehension): static args are
+  hashed by jit, so the default raises ``TypeError: unhashable`` the
+  first time it is used — and a mutable default is shared state besides;
+* cache keys built from dict iteration order — ``tuple(d.keys())`` /
+  ``tuple(d.values())`` / ``tuple(d.items())`` (and bare ``tuple(d)``
+  where ``d`` provably came from a dict display): two logically-equal
+  dicts with different insertion histories produce different keys, which
+  silently churns jit caches and grid-bundle caches.  Wrap the iteration
+  in ``sorted(...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from tools.jaxlint.engine import FileInfo, _transform_kind
+from tools.jaxlint.rules import Rule, register
+
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp)
+_DICT_ITERS = {"keys", "values", "items"}
+
+
+def _param_defaults(fn: ast.FunctionDef) -> Dict[str, Optional[ast.AST]]:
+    """name -> default node (None when the parameter has no default)."""
+    out: Dict[str, Optional[ast.AST]] = {}
+    pos = fn.args.posonlyargs + fn.args.args
+    defaults: List[Optional[ast.AST]] = (
+        [None] * (len(pos) - len(fn.args.defaults)) + list(fn.args.defaults))
+    for a, d in zip(pos, defaults):
+        out[a.arg] = d
+    for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        out[a.arg] = d
+    return out
+
+
+def _static_param_names(call: ast.Call, fn: ast.FunctionDef) -> List[str]:
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    names: List[str] = []
+    for kw in call.keywords:
+        v = kw.value
+        vals = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        if kw.arg == "static_argnums":
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                        and 0 <= e.value < len(params):
+                    names.append(params[e.value])
+        elif kw.arg == "static_argnames":
+            for e in vals:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.append(e.value)
+    return names
+
+
+@register
+class StaticArgsRule(Rule):
+    name = "static-args"
+    description = ("unhashable/mutable static_argnums defaults and dict-"
+                   "iteration-ordered cache keys")
+
+    def check(self, info: FileInfo):
+        yield from self._check_static_defaults(info)
+        yield from self._check_dict_order_keys(info)
+
+    # -- (a) static params with mutable defaults ---------------------------
+    def _check_static_defaults(self, info: FileInfo):
+        defs_by_name = {}
+        for node in ast.walk(info.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        def check_pair(call: ast.Call, fn: ast.FunctionDef):
+            defaults = _param_defaults(fn)
+            for pname in _static_param_names(call, fn):
+                d = defaults.get(pname)
+                if d is not None and isinstance(d, _MUTABLE):
+                    yield info.finding(
+                        self.name, d,
+                        f"static argument `{pname}` of `{fn.name}` has a "
+                        "mutable (unhashable) default: jit hashes static "
+                        "args, so this raises TypeError at call time — use "
+                        "a tuple/frozenset/None sentinel")
+
+        for node in ast.walk(info.tree):
+            # decorator form: @partial(jax.jit, static_argnums=...) / @jax.jit(...)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    target = dec.func
+                    is_partial = (isinstance(target, ast.Name)
+                                  and target.id == "partial") or (
+                        isinstance(target, ast.Attribute)
+                        and target.attr == "partial")
+                    if is_partial and dec.args:
+                        target = dec.args[0]
+                    if _transform_kind(target, info) == "entry":
+                        yield from check_pair(dec, node)
+            # wrap form: jax.jit(f, static_argnums=...)
+            elif isinstance(node, ast.Call) \
+                    and _transform_kind(node.func, info) == "entry" \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                for fn in defs_by_name.get(node.args[0].id, []):
+                    yield from check_pair(node, fn)
+
+    # -- (b) dict-iteration-ordered keys -----------------------------------
+    @staticmethod
+    def _tuple_call_arg(node: ast.AST):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "tuple" and len(node.args) == 1:
+            return node.args[0]
+        return None
+
+    def _check_dict_order_keys(self, info: FileInfo):
+        from tools.jaxlint.engine import walk_own
+
+        # dotted form (tuple(x.keys()) etc.): one pass over the whole tree
+        for node in ast.walk(info.tree):
+            a = self._tuple_call_arg(node)
+            if isinstance(a, ast.Call) and isinstance(a.func, ast.Attribute) \
+                    and a.func.attr in _DICT_ITERS and not a.args:
+                yield info.finding(
+                    self.name, node,
+                    f"`tuple(....{a.func.attr}())` depends on dict "
+                    "insertion order: logically-equal dicts produce "
+                    "different cache keys (recompilation churn / stale-"
+                    "bundle reuse); wrap in sorted(...)")
+        # bare tuple(d) form: function-scoped, so a name bound to a dict
+        # display in one function never taints an unrelated local of the
+        # same name elsewhere (module-level bare names are alias-prone
+        # and deliberately out of scope)
+        for scope in ast.walk(info.tree):
+            if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            nodes = list(walk_own(scope))
+            dict_names = {t.id for node in nodes
+                          if isinstance(node, ast.Assign)
+                          and isinstance(node.value, (ast.Dict, ast.DictComp))
+                          for t in node.targets if isinstance(t, ast.Name)}
+            if not dict_names:
+                continue
+            for node in nodes:
+                a = self._tuple_call_arg(node)
+                if isinstance(a, ast.Name) and a.id in dict_names:
+                    yield info.finding(
+                        self.name, node,
+                        f"`tuple({a.id})` iterates a dict in insertion "
+                        "order; as a cache key this churns on re-ordered "
+                        "construction — use tuple(sorted(...)) or "
+                        "frozenset(...items())")

@@ -1,0 +1,112 @@
+"""traced-branch: no Python `if`/`while` on traced values inside jit.
+
+Python control flow evaluates at trace time: branching on a traced array
+raises ``ConcretizationTypeError`` under jit, and in the best case bakes
+one branch into the executable (silently wrong for other inputs).  Inside
+each traced function, the rule taints the function's (non-static)
+parameters and anything assigned from a tainted expression, then flags
+``if``/``while`` whose test touches a tainted name.
+
+Shape-like accesses launder taint — ``len(x)``, ``x.shape``, ``x.ndim``,
+``x.dtype``, ``x.size``, ``isinstance(x, ...)`` are static under tracing
+and are fine to branch on.  Use ``jnp.where`` for element selection and
+``lax.cond`` / ``lax.while_loop`` for genuinely value-dependent control
+flow.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from tools.jaxlint.engine import FileInfo, TracedDef, walk_own
+from tools.jaxlint.rules import Rule, register
+
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr", "range",
+                 "enumerate"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _tainted_names(expr: ast.AST, tainted: Set[str]) -> Set[str]:
+    """Tainted names the expression's *value* depends on, with shape-like
+    laundering applied."""
+    hits: Set[str] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _STATIC_CALLS:
+                return  # len(x) etc.: static under tracing
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return  # x.shape and friends are static
+            visit(node.value)
+        elif isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load) and node.id in tainted:
+                hits.add(node.id)
+        else:
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+    visit(expr)
+    return hits
+
+
+def _assign_targets(node: ast.AST):
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            yield from _flatten_target(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) and node.value:
+        yield from _flatten_target(node.target)
+
+
+def _flatten_target(t: ast.AST):
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _flatten_target(e)
+
+
+@register
+class TracedBranchRule(Rule):
+    name = "traced-branch"
+    description = ("Python if/while on traced values inside jitted code "
+                   "(use jnp.where / lax.cond)")
+
+    def check(self, info: FileInfo):
+        for td in info.traced_defs:
+            yield from self._check_def(info, td)
+
+    def _check_def(self, info: FileInfo, td: TracedDef):
+        fn = td.node
+        if isinstance(fn, ast.Lambda):
+            return
+        params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)]
+        if fn.args.vararg:
+            params.append(fn.args.vararg.arg)
+        tainted = {p for p in params
+                   if p not in td.static_params and p != "self"}
+        # straight-line taint propagation: a local assigned from a tainted
+        # expression is tainted (two passes handle use-before-def ordering
+        # in simple loops)
+        for _ in range(2):
+            for node in walk_own(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    value = getattr(node, "value", None)
+                    if value is not None and _tainted_names(value, tainted):
+                        tainted.update(_assign_targets(node))
+        for node in walk_own(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                hits = _tainted_names(node.test, tainted)
+                if hits:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield info.finding(
+                        self.name, node,
+                        f"`{kind}` on traced value(s) {sorted(hits)} inside "
+                        "a jitted function: trace-time branching "
+                        "concretizes (ConcretizationTypeError) or bakes one "
+                        "branch; use jnp.where or lax.cond/lax.while_loop")

@@ -1,0 +1,166 @@
+"""typed-raise: the ingestion/fitting/runtime core raises only typed
+(PintError-family) exceptions.
+
+Ported from PR 2's ``tools/check_typed_raises.py`` into the jaxlint
+registry (the old CLI remains as a thin shim).  Coverage extends the
+original six modules with ``pint_tpu/io/__init__.py``,
+``pint_tpu/integrity/`` and ``pint_tpu/runtime/``.
+
+Allowed raises:
+
+* anything defined in ``pint_tpu/exceptions.py`` (PintError subclasses and
+  warning categories) — resolved *statically* from that module's AST, so
+  linting needs no project import;
+* classes defined in the linted file itself whose base-name chain reaches
+  an allowed name (e.g. ``SimulatedDeviceLoss(DeviceLostError)`` in
+  faultinject.py);
+* programming-contract builtins (``TypeError``, ``KeyError``, ...) plus
+  ``TimeoutError`` — the checkpoint retry executor classifies attempt
+  timeouts by the stdlib type so its own raises and ``fn``-raised
+  ``socket.timeout`` unify;
+* bare re-raises and re-raises of a caught ``except ... as e`` variable.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.jaxlint.engine import REPO, FileInfo
+from tools.jaxlint.rules import ScopedRule, register
+
+#: the modules the typed-raise contract covers (files or directories)
+DEFAULT_TARGETS = (
+    "pint_tpu/io/par.py",
+    "pint_tpu/io/tim.py",
+    "pint_tpu/io/__init__.py",
+    "pint_tpu/toa.py",
+    "pint_tpu/fitter.py",
+    "pint_tpu/gls_fitter.py",
+    "pint_tpu/residuals.py",
+    "pint_tpu/grid.py",
+    "pint_tpu/integrity/",
+    "pint_tpu/runtime/",
+)
+
+DISALLOWED = {
+    "ValueError", "RuntimeError", "Exception", "BaseException",
+    "IOError", "OSError", "EnvironmentError", "ArithmeticError",
+    "FloatingPointError", "ZeroDivisionError", "SystemError",
+}
+
+ALLOWED_BUILTINS = {
+    "NotImplementedError", "TypeError", "KeyError", "IndexError",
+    "AttributeError", "StopIteration", "FileNotFoundError", "TimeoutError",
+}
+
+_WARNING_BASES = {"Warning", "UserWarning", "DeprecationWarning",
+                  "RuntimeWarning", "FutureWarning"}
+
+
+def _base_names(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def _closure_allowed(classes: Dict[str, List[str]], seed: Set[str]) -> Set[str]:
+    """Names from ``classes`` whose base chain reaches ``seed``."""
+    allowed = set(seed)
+    changed = True
+    while changed:
+        changed = False
+        for name, bases in classes.items():
+            if name not in allowed and any(b in allowed for b in bases):
+                allowed.add(name)
+                changed = True
+    return allowed
+
+
+def exception_module_names(repo: str = REPO) -> Set[str]:
+    """Class names in ``pint_tpu/exceptions.py`` rooted in PintError or a
+    warning category, read from the AST (no project import)."""
+    path = os.path.join(repo, "pint_tpu", "exceptions.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return set()
+    classes = {n.name: _base_names(n) for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef)}
+    closure = _closure_allowed(classes, {"PintError"} | _WARNING_BASES)
+    return {n for n in closure if n in classes}
+
+
+def raised_name(node: ast.Raise) -> Optional[str]:
+    """The exception *name* a raise uses; None for a bare re-raise,
+    ``<dynamic>`` for computed exception objects."""
+    exc = node.exc
+    if exc is None:
+        return None
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    return "<dynamic>"
+
+
+def check_tree(tree: ast.Module, allowed: Set[str]) -> List[Tuple[int, str]]:
+    """(lineno, message) for every disallowed raise in a parsed module.
+    Locally-defined subclasses of allowed exceptions are allowed too."""
+    local = {n.name: _base_names(n) for n in ast.walk(tree)
+             if isinstance(n, ast.ClassDef)}
+    allowed = _closure_allowed(
+        local, set(allowed) | ALLOWED_BUILTINS | _WARNING_BASES)
+    handler_vars = {n.name for n in ast.walk(tree)
+                    if isinstance(n, ast.ExceptHandler) and n.name}
+    bad: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Raise):
+            continue
+        name = raised_name(node)
+        if name is None or name in handler_vars or name == "<dynamic>":
+            continue
+        if name in DISALLOWED:
+            bad.append((node.lineno,
+                        f"raise of bare {name} (use a typed "
+                        f"pint_tpu.exceptions class)"))
+        elif name not in allowed:
+            bad.append((node.lineno,
+                        f"raise of unknown exception {name} (not a "
+                        f"PintError subclass)"))
+    return bad
+
+
+@register
+class TypedRaiseRule(ScopedRule):
+    name = "typed-raise"
+    description = ("core modules raise only PintError-family exceptions "
+                   "(plus programming-contract builtins)")
+    default_files = DEFAULT_TARGETS
+
+    def __init__(self, files=None, allowed: Optional[Set[str]] = None,
+                 repo: str = REPO):
+        super().__init__(files=files)
+        self._allowed = allowed
+        self._repo = repo
+
+    @property
+    def allowed(self) -> Set[str]:
+        if self._allowed is None:
+            self._allowed = exception_module_names(self._repo)
+        return self._allowed
+
+    def check(self, info: FileInfo):
+        for lineno, msg in check_tree(info.tree, self.allowed):
+            # anchor the finding to the raise line
+            anchor = ast.Pass()
+            anchor.lineno, anchor.col_offset = lineno, 0
+            yield info.finding(self.name, anchor, msg)
