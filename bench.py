@@ -58,21 +58,29 @@ TZRSITE                  1
 """
 
 
-class Stages:
-    def __init__(self):
-        self.rows = []
-        self._t = time.time()
+#: lazily-built Stages class (bench keeps ALL pint_tpu/jax imports out of
+#: module scope so the fast error-emit paths never pay the package import)
+_STAGES_CLS = None
 
-    def mark(self, name):
-        now = time.time()
-        self.rows.append((name, now - self._t))
-        self._t = now
 
-    def table(self, title):
-        lines = [f"# --- {title} stage timings ---"]
-        for name, dt in self.rows:
-            lines.append(f"#   {name:<28s} {dt:8.2f} s")
-        return "\n".join(lines)
+def Stages():
+    """Bench stage table: telemetry-backed StageTimer (one shared
+    mark/stage clock, rows mirrored into the span tree when telemetry is
+    on) with the bench's historical table format kept byte-identical so
+    BENCH_NOTES.md comparisons still line up."""
+    global _STAGES_CLS
+    if _STAGES_CLS is None:
+        from pint_tpu.profiling import StageTimer
+
+        class _Stages(StageTimer):
+            def table(self, title):
+                lines = [f"# --- {title} stage timings ---"]
+                for name, dt in self.rows:
+                    lines.append(f"#   {name:<28s} {dt:8.2f} s")
+                return "\n".join(lines)
+
+        _STAGES_CLS = _Stages
+    return _STAGES_CLS()
 
 
 def cache_key(backend: str) -> str:
@@ -256,6 +264,33 @@ def emit(out):
     sys.stdout.flush()
 
 
+def telemetry_summary(stages=None):
+    """The ``telemetry`` block stamped into the bench artifact: JAX
+    accounting (compiles / cache hits / transfers), a name->seconds span
+    table, and the live-buffer / HBM watermark.  The bench self-activates
+    ``basic`` collection in main() when the env left telemetry off, so
+    the block is always present and populated."""
+    from pint_tpu import telemetry
+    from pint_tpu.telemetry import jaxevents, spans
+
+    table = {}
+    for root in spans.finished_roots():
+        stack = [root]
+        while stack:
+            sp = stack.pop()
+            table[sp.name] = round(table.get(sp.name, 0.0) + sp.duration, 3)
+            stack.extend(sp.children)
+    if stages is not None:
+        for name, dt in stages.rows:
+            table.setdefault(f"stage.{name}", round(dt, 3))
+    return {
+        "mode": telemetry.mode(),
+        "jax": jaxevents.counts().to_dict(),
+        "spans": dict(sorted(table.items())),
+        "memory": jaxevents.memory_snapshot(),
+    }
+
+
 def _probe_tpu(timeout_s: int = 240):
     """Default backend platform probed in a subprocess, or None.
 
@@ -400,6 +435,20 @@ def main():
               f"on {prof.platform!r} — sanity_ok will be stamped false",
               file=sys.stderr)
 
+    # observability: the bench always collects at least basic telemetry
+    # (compile counts prove warm-cache claims; span table attributes the
+    # wall time); an explicit VALID PINT_TPU_TELEMETRY choice wins — an
+    # invalid spelling (which config coerces to off) must not silently
+    # produce an empty telemetry block, so it falls back to basic too.
+    # Activated only now, AFTER every early error-emit return above: the
+    # fast error paths keep paying only `import jax`.
+    from pint_tpu import config as _ptconfig
+    from pint_tpu import telemetry
+
+    _env_mode = os.environ.get("PINT_TPU_TELEMETRY")
+    telemetry.activate(None if _env_mode in _ptconfig.TELEMETRY_MODES
+                       else "basic")
+
     machine = cache_key(backend)
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache", machine)
@@ -440,6 +489,7 @@ def main():
         "sanity_ok": bool(r["ok"]) and platform_ok,
         "requested_platform": requested,
         "device_profile": prof.to_dict(),
+        "telemetry": telemetry_summary(stages=r["stages"]),
     }
     if not platform_ok:
         out["platform_mismatch"] = True

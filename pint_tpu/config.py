@@ -7,7 +7,8 @@ import os
 
 __all__ = ["datadir", "examplefile", "runtimefile",
            "device_policy", "set_device_policy", "DEVICE_POLICIES",
-           "ingestion_policy", "set_ingestion_policy", "INGESTION_POLICIES"]
+           "ingestion_policy", "set_ingestion_policy", "INGESTION_POLICIES",
+           "telemetry_mode", "set_telemetry_mode", "TELEMETRY_MODES"]
 
 #: what to do when the preflight probe finds the executing platform differs
 #: from the requested one (``PINT_TPU_REQUIRE_PLATFORM``):
@@ -63,6 +64,34 @@ def set_ingestion_policy(policy: str) -> None:
             f"ingestion policy must be one of {INGESTION_POLICIES}, "
             f"got {policy!r}")
     _ingestion_policy = policy
+
+
+#: how much observability the telemetry subsystem collects
+#: (``PINT_TPU_TELEMETRY``): ``off`` keeps every instrumented path on a
+#: no-op fast branch (one module-attribute compare, no allocation),
+#: ``basic`` records spans/metrics/JAX compile counts in memory, ``full``
+#: additionally starts a run manifest + JSONL event stream on disk
+#: (:mod:`pint_tpu.telemetry.runlog`) and samples live-buffer watermarks.
+TELEMETRY_MODES = ("off", "basic", "full")
+
+_telemetry_mode = os.environ.get("PINT_TPU_TELEMETRY", "off")
+if _telemetry_mode not in TELEMETRY_MODES:
+    _telemetry_mode = "off"
+
+
+def telemetry_mode() -> str:
+    """Current telemetry mode: off | basic | full."""
+    return _telemetry_mode
+
+
+def set_telemetry_mode(mode: str) -> None:
+    """Set the telemetry mode for this process.  Instrumented paths read
+    the module attribute directly, so the change is immediate."""
+    global _telemetry_mode
+    if mode not in TELEMETRY_MODES:
+        raise ValueError(
+            f"telemetry mode must be one of {TELEMETRY_MODES}, got {mode!r}")
+    _telemetry_mode = mode
 
 
 def datadir() -> str:

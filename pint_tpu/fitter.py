@@ -29,6 +29,8 @@ from pint_tpu.exceptions import (
 )
 from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
+from pint_tpu.telemetry import jaxevents as _jaxevents
+from pint_tpu.telemetry import span as _span
 from pint_tpu.utils import normalize_designmatrix
 
 __all__ = ["Fitter", "WLSFitter", "DownhillFitter", "DownhillWLSFitter",
@@ -536,30 +538,35 @@ class WLSFitter(Fitter):
 
     def _fit_wls(self, maxiter: int = 1,
                  threshold: Optional[float] = None) -> float:
-        chi2 = self.resids.chi2
-        for _ in range(max(1, maxiter)):
-            r = self.resids.time_resids
-            sigma = self._data_sigma()
-            M, params, units = self.get_designmatrix()
-            dpars, cov, S = _wls_step(M, params, r, sigma, threshold)
-            for dp, p in zip(dpars, params):
-                if p == "Offset":
-                    continue
-                par = getattr(self.model, p)
-                par.value = float(par.value or 0.0) + float(dp)
-            self.update_resids()
+        with _span("wls.fit_toas", ntoas=len(self.toas),
+                   nfree=len(self.model.free_params),
+                   maxiter=maxiter) as sp, _jaxevents.watch(sp):
             chi2 = self.resids.chi2
-            self._set_covariance(cov, params)
-            self.fitted_params = params
-            for i, p in enumerate(params):
-                if p == "Offset":
-                    continue
-                err = float(np.sqrt(cov[i, i]))
-                self.errors[p] = err
-                getattr(self.model, p).uncertainty = err
-        self.converged = True
-        self.update_model(chi2)
-        return chi2
+            for it in range(max(1, maxiter)):
+                with _span("wls.step", iteration=it):
+                    r = self.resids.time_resids
+                    sigma = self._data_sigma()
+                    M, params, units = self.get_designmatrix()
+                    dpars, cov, S = _wls_step(M, params, r, sigma, threshold)
+                    for dp, p in zip(dpars, params):
+                        if p == "Offset":
+                            continue
+                        par = getattr(self.model, p)
+                        par.value = float(par.value or 0.0) + float(dp)
+                    self.update_resids()
+                    chi2 = self.resids.chi2
+                self._set_covariance(cov, params)
+                self.fitted_params = params
+                for i, p in enumerate(params):
+                    if p == "Offset":
+                        continue
+                    err = float(np.sqrt(cov[i, i]))
+                    self.errors[p] = err
+                    getattr(self.model, p).uncertainty = err
+            sp.attrs["chi2"] = float(chi2)
+            self.converged = True
+            self.update_model(chi2)
+            return chi2
 
 
 class DownhillFitter(Fitter):
@@ -661,6 +668,16 @@ class DownhillFitter(Fitter):
                          min_lambda: float = 1e-3,
                          debug: bool = False,
                          raise_on_maxiter: bool = False) -> float:
+        with _span(f"{self.method}.fit_toas", ntoas=len(self.toas),
+                   nfree=len(self.model.free_params),
+                   maxiter=maxiter) as sp, _jaxevents.watch(sp):
+            return self._fit_toas_timing_inner(
+                sp, maxiter, required_chi2_decrease, max_chi2_increase,
+                min_lambda, debug, raise_on_maxiter)
+
+    def _fit_toas_timing_inner(self, sp, maxiter, required_chi2_decrease,
+                               max_chi2_increase, min_lambda, debug,
+                               raise_on_maxiter) -> float:
         best_chi2 = self._fit_metric()
         self.converged = False
         for it in range(maxiter):
@@ -691,6 +708,8 @@ class DownhillFitter(Fitter):
                 break
             decrease = best_chi2 - chi2
             best_chi2 = chi2
+            sp.add_event("downhill.step", iteration=it, chi2=float(chi2),
+                         lambda_=lam)
             self._set_covariance(cov, params)
             self.fitted_params = params
             for i, p in enumerate(params):
@@ -708,6 +727,8 @@ class DownhillFitter(Fitter):
                     f"Downhill fit hit maxiter={maxiter} without meeting "
                     f"tolerance (chi2 {best_chi2:.3f})")
             log.warning(f"Downhill fit hit maxiter={maxiter}")
+        sp.attrs["chi2"] = float(best_chi2)
+        sp.attrs["converged"] = self.converged
         self.update_model(best_chi2)
         return best_chi2
 

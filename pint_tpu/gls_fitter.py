@@ -36,6 +36,9 @@ from pint_tpu.runtime.solve import (
     hardened_cholesky,
     solve_normal_cholesky,
 )
+from pint_tpu.telemetry import event as _tevent
+from pint_tpu.telemetry import jaxevents as _jaxevents
+from pint_tpu.telemetry import span as _span
 from pint_tpu.utils import normalize_designmatrix
 
 __all__ = ["GLSFitter", "DownhillGLSFitter"]
@@ -310,25 +313,33 @@ class GLSFitter(Fitter):
             raise UsageError(
                 "robust fitting is available on the WLS-family fitters "
                 "only (Huber IRLS assumes uncorrelated errors)")
-        self.model.validate()
-        self.model.validate_toas(self.toas)
-        self.update_resids()
-        for _ in range(max(1, maxiter)):
-            dpars, errs, covmat, params = self._gls_step(
-                threshold=threshold, full_cov=full_cov)
-            self._apply_step(dpars, errs, covmat, params)
+        with _span("gls.fit_toas", ntoas=len(self.toas),
+                   nfree=len(self.model.free_params), maxiter=maxiter,
+                   full_cov=full_cov) as sp, _jaxevents.watch(sp):
+            self.model.validate()
+            self.model.validate_toas(self.toas)
             self.update_resids()
-            if not full_cov:
-                self._store_noise_ampls(dpars, len(params))
-        chi2 = self.resids.calc_chi2()
-        if np.isnan(chi2):
-            # a one-shot fit must not hand back a silently poisoned chi2
-            raise NonFiniteSystemError(
-                "GLS fit produced NaN chi2 (non-finite residuals or a "
-                "poisoned solve)")
-        self.converged = True
-        self.update_model(chi2)
-        return chi2
+            for it in range(max(1, maxiter)):
+                with _span("gls.step", iteration=it):
+                    dpars, errs, covmat, params = self._gls_step(
+                        threshold=threshold, full_cov=full_cov)
+                    self._apply_step(dpars, errs, covmat, params)
+                    self.update_resids()
+                if self.solve_diagnostics is not None:
+                    _tevent("gls.solve", iteration=it,
+                            **self.solve_diagnostics.to_dict())
+                if not full_cov:
+                    self._store_noise_ampls(dpars, len(params))
+            chi2 = self.resids.calc_chi2()
+            if np.isnan(chi2):
+                # a one-shot fit must not hand back a silently poisoned chi2
+                raise NonFiniteSystemError(
+                    "GLS fit produced NaN chi2 (non-finite residuals or a "
+                    "poisoned solve)")
+            sp.attrs["chi2"] = float(chi2)
+            self.converged = True
+            self.update_model(chi2)
+            return chi2
 
 
 class DownhillGLSFitter(DownhillFitter):

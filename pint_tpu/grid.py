@@ -25,6 +25,8 @@ import numpy as np
 
 from pint_tpu.exceptions import UsageError
 from pint_tpu.runtime.solve import SVD_RUNG, hardened_cholesky
+from pint_tpu.telemetry import jaxevents as _jaxevents
+from pint_tpu.telemetry import span as _tspan
 
 __all__ = ["build_grid_chi2_fn", "grid_chisq", "grid_chisq_derived",
            "tuple_chisq", "tuple_chisq_derived", "WrappedFitter", "doonefit",
@@ -745,7 +747,11 @@ def _attach_grid_diagnostics(ftr, diag, shape=None):
     """Stash the per-point solve diagnostics (and the device profile) on
     the fitter: ``ftr.last_grid_diagnostics`` maps ``ladder_rung`` /
     ``ridge`` / ``condition`` to grid-shaped arrays.  Rung -1 flags a
-    poisoned (non-finite) point; rung ``SVD_RUNG`` the pseudo-inverse."""
+    poisoned (non-finite) point; rung ``SVD_RUNG`` the pseudo-inverse.
+
+    With telemetry on, the per-point diagnostics are summarized onto the
+    current span as a ``grid.solve`` event (rung histogram, worst
+    condition) — the structured-run-log form of the same information."""
     from pint_tpu.runtime.preflight import device_profile
 
     d = np.asarray(diag)
@@ -755,6 +761,22 @@ def _attach_grid_diagnostics(ftr, diag, shape=None):
         out = {k: v.reshape(shape) for k, v in out.items()}
     out["device_profile"] = device_profile()
     ftr.last_grid_diagnostics = out
+    from pint_tpu import config as _config
+
+    if _config._telemetry_mode != "off" and d.size:
+        from pint_tpu.telemetry import event as _tevent
+
+        rungs = d[:, 0].astype(int)
+        cond = d[:, 2]
+        finite = np.isfinite(cond)
+        _tevent("grid.solve", points=int(len(rungs)),
+                unsolved=int(np.sum(rungs < 0)),
+                escalated=int(np.sum(rungs > 0)),
+                worst_condition=float(cond[finite].max()) if finite.any()
+                else None,
+                rung_histogram=str({int(r): int(n) for r, n in
+                                    zip(*np.unique(rungs,
+                                                   return_counts=True))}))
     return out
 
 
@@ -799,48 +821,70 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
-    fn, free_init, fit_params = build_grid_chi2_fn(
-        model, toas, parnames, niter=niter,
-        grid_spans=_point_spans(model, parnames, mesh_pts), chunk=chunk)
-    if checkpoint is not None:
-        if mesh is not None:
-            raise UsageError("checkpoint= and mesh= cannot be combined; "
-                             "run the checkpointed sweep per host")
-        # the fingerprint must cover everything the chi2 surface depends
-        # on — grid definition, EVERY parameter value/selector, and the
-        # TOA data version — or a resume would silently stitch chunks
-        # from different data into one surface
-        chi2, vfit, diag = _checkpointed_grid(
-            fn, mesh_pts, checkpoint, retry,
-            fingerprint=dict(parnames=parnames, pts=mesh_pts, niter=niter,
-                             ntoas=len(toas), gls=gls,
-                             toas_version=getattr(toas, "_version", 0),
-                             params=_model_param_sig(model),
-                             free_init=np.asarray(free_init)),
-            chunk=chunk if chunk else (default_gls_chunk() if gls else 256))
-    elif mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    with _tspan("grid_chisq", npts=int(mesh_pts.shape[0]), gls=gls,
+                niter=niter, params=",".join(parnames),
+                checkpointed=checkpoint is not None) as sp, \
+            _jaxevents.watch(sp):
+        with _tspan("grid.build_fn"):
+            fn, free_init, fit_params = build_grid_chi2_fn(
+                model, toas, parnames, niter=niter,
+                grid_spans=_point_spans(model, parnames, mesh_pts),
+                chunk=chunk)
+        if checkpoint is not None:
+            if mesh is not None:
+                raise UsageError("checkpoint= and mesh= cannot be combined; "
+                                 "run the checkpointed sweep per host")
+            # the fingerprint must cover everything the chi2 surface depends
+            # on — grid definition, EVERY parameter value/selector, and the
+            # TOA data version — or a resume would silently stitch chunks
+            # from different data into one surface
+            chi2, vfit, diag = _checkpointed_grid(
+                fn, mesh_pts, checkpoint, retry,
+                fingerprint=dict(parnames=parnames, pts=mesh_pts,
+                                 niter=niter, ntoas=len(toas), gls=gls,
+                                 toas_version=getattr(toas, "_version", 0),
+                                 params=_model_param_sig(model),
+                                 free_init=np.asarray(free_init)),
+                chunk=chunk if chunk else (default_gls_chunk() if gls
+                                           else 256))
+        elif mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
-        if gls:
-            # chunked path: each fixed-size chunk is sharded on entry
-            chi2, vfit, diag = fn(jnp.asarray(mesh_pts), sharding=sharding)
+            sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+            if gls:
+                # chunked path: each fixed-size chunk is sharded on entry
+                chi2, vfit, diag = fn(jnp.asarray(mesh_pts),
+                                      sharding=sharding)
+            else:
+                pts = jnp.asarray(mesh_pts)
+                npts = pts.shape[0]
+                ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+                pad = (-npts) % ndev
+                if pad:
+                    pts = jnp.concatenate([pts, jnp.tile(pts[-1:],
+                                                         (pad, 1))])
+                pts = jax.device_put(pts, sharding)
+                chi2, vfit, diag = fn(pts)
+                chi2, vfit, diag = chi2[:npts], vfit[:npts], diag[:npts]
         else:
-            pts = jnp.asarray(mesh_pts)
-            npts = pts.shape[0]
-            ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-            pad = (-npts) % ndev
-            if pad:
-                pts = jnp.concatenate([pts, jnp.tile(pts[-1:], (pad, 1))])
-            pts = jax.device_put(pts, sharding)
-            chi2, vfit, diag = fn(pts)
-            chi2, vfit, diag = chi2[:npts], vfit[:npts], diag[:npts]
-    else:
-        chi2, vfit, diag = fn(jnp.asarray(mesh_pts))
-    _attach_grid_diagnostics(ftr, diag, shape=shape)
-    extraout = _extraout(extraparnames, fit_params, parnames, vfit, mesh_pts,
-                         model, shape=shape)
-    return np.asarray(chi2).reshape(shape), extraout
+            with _tspan("grid.evaluate") as esp:
+                chi2, vfit, diag = esp.sync(fn(jnp.asarray(mesh_pts)))
+        chi2, vfit, diag = (np.asarray(chi2), np.asarray(vfit),
+                            np.asarray(diag))
+        from pint_tpu import config as _config
+
+        if _config._telemetry_mode != "off":
+            # account the device->host result gather (np.asarray has no
+            # central hook — see telemetry.jaxevents); full mode also
+            # samples the live-buffer watermark at the sweep's peak
+            _jaxevents.record_transfer(
+                "d2h", chi2.nbytes + vfit.nbytes + diag.nbytes, count=1)
+            if _config._telemetry_mode == "full":
+                _jaxevents.memory_snapshot()
+        _attach_grid_diagnostics(ftr, diag, shape=shape)
+        extraout = _extraout(extraparnames, fit_params, parnames, vfit,
+                             mesh_pts, model, shape=shape)
+        return chi2.reshape(shape), extraout
 
 
 def _checkpointed_grid(fn, mesh_pts: np.ndarray, checkpoint: str, retry,

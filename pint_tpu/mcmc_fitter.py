@@ -24,6 +24,8 @@ from pint_tpu.fitter import Fitter
 from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
 from pint_tpu.sampler import EnsembleSampler, MCMCSampler
+from pint_tpu.telemetry import jaxevents as _jaxevents
+from pint_tpu.telemetry import span as _tspan
 
 __all__ = ["MCMCFitter", "MCMCFitterBinnedTemplate",
            "MCMCFitterAnalyticTemplate", "set_priors_basic",
@@ -198,6 +200,15 @@ class MCMCFitter(Fitter):
         crashed run resumes from it — only the remaining steps are
         sampled, continuing the Markov chain bit-identically to an
         uninterrupted run."""
+        with _tspan("mcmc.fit_toas", ntoas=len(self.toas),
+                    nwalkers=self.sampler.nwalkers, maxiter=maxiter,
+                    checkpointed=checkpoint is not None) as sp, \
+                _jaxevents.watch(sp):
+            return self._fit_toas_mcmc(sp, maxiter, pos, seed, burn_frac,
+                                       checkpoint, **kw)
+
+    def _fit_toas_mcmc(self, sp, maxiter, pos, seed, burn_frac,
+                       checkpoint, **kw) -> float:
         if checkpoint is not None:
             from pint_tpu.grid import _model_param_sig
             from pint_tpu.runtime.checkpoint import fingerprint_of
@@ -224,6 +235,8 @@ class MCMCFitter(Fitter):
                              if s[0] not in self.fitkeys))
             if self.sampler.backend.exists() and pos is None:
                 pos = self.sampler.resume()
+                sp.add_event("mcmc.resume",
+                             resumed_steps=self.sampler.iteration)
                 maxiter = max(0, maxiter - self.sampler.iteration)
         if self._custom_post:
             # the bt property resyncs fitkeys/n_fit_params when the free
@@ -281,6 +294,10 @@ class MCMCFitter(Fitter):
         chi2 = self.resids.chi2
         self.model.CHI2.value = chi2
         self.converged = True
+        sp.attrs["chi2"] = float(chi2)
+        sp.attrs["steps"] = int(nsteps)
+        sp.attrs["acceptance"] = float(self.sampler.acceptance_fraction)
+        sp.attrs["maxpost"] = float(self.maxpost)
         return chi2
 
     def get_posterior_samples(self, burn_frac: float = 0.25) -> np.ndarray:

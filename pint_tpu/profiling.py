@@ -6,6 +6,18 @@ benchmark scripts under cProfile and prints a per-function table
 here is (a) a lightweight stage timer whose table the bench prints, and
 (b) a hook into the JAX profiler for full device traces viewable in
 TensorBoard/Perfetto.
+
+:class:`StageTimer` is now a shim over :mod:`pint_tpu.telemetry.spans`:
+every completed row is also recorded as a telemetry span (child of the
+caller's current span, or a root) when telemetry is on, so ad-hoc stage
+tables and the structured run log tell the same story.  The table format
+is unchanged.
+
+Clock contract (regression-tested): ``mark()`` and ``stage()`` share ONE
+running clock.  A ``mark()`` issued after a ``with stage(...)`` block
+measures exactly from the block's exit — the pre-telemetry implementation
+read ``perf_counter()`` twice on stage exit (once for the row, once for
+the clock), so the window between the two reads landed in no row.
 """
 
 from __future__ import annotations
@@ -18,18 +30,44 @@ __all__ = ["StageTimer", "device_trace", "profile_fit"]
 
 
 class StageTimer:
-    """Accumulates named wall-time stages; prints an aligned table."""
+    """Accumulates named wall-time stages; prints an aligned table.
+
+    ``mark(name)`` closes the stage running since the last clock point;
+    ``with stage(name):`` times an explicit block.  Both advance the same
+    clock (``self._t``), so interleaving them never loses or double-counts
+    a window between a block exit and the next mark.
+    """
 
     def __init__(self):
         self.rows: List[Tuple[str, float]] = []
         self._t = time.perf_counter()
 
+    def _record(self, name: str, t0: float, now: float) -> None:
+        """Append a row and advance the shared clock to ``now`` — the ONE
+        place rows are written, so mark/stage cannot disagree.  Mirrors
+        the row into the telemetry span tree when telemetry is on."""
+        self.rows.append((name, now - t0))
+        self._t = now
+        from pint_tpu import config
+
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import spans as _spans
+
+            sp = _spans.Span(name=f"stage.{name}")
+            parent = _spans.current_span()
+            sp.t0, sp.t1 = t0, now
+            if parent is not None:
+                sp.parent_id = parent.span_id
+                parent.children.append(sp)
+            else:
+                sp.t_wall = time.time() - (time.perf_counter() - t0)
+                _spans._finish_root(sp)
+
     def mark(self, name: str) -> float:
         """Close the current stage under *name*; returns its duration."""
         now = time.perf_counter()
         dt = now - self._t
-        self.rows.append((name, dt))
-        self._t = now
+        self._record(name, self._t, now)
         return dt
 
     @contextlib.contextmanager
@@ -38,8 +76,9 @@ class StageTimer:
         try:
             yield
         finally:
-            self.rows.append((name, time.perf_counter() - t0))
-            self._t = time.perf_counter()
+            # one clock read serves both the row and the shared clock, so
+            # the next mark() measures exactly from this block's exit
+            self._record(name, t0, time.perf_counter())
 
     @property
     def total(self) -> float:

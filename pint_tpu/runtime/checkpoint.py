@@ -107,6 +107,25 @@ def _call_with_timeout(fn: Callable, timeout: Optional[float]):
     return result["value"]
 
 
+def _telemetry_retry(what: str, attempt: int, delay: float,
+                     exc: Optional[BaseException]) -> None:
+    """Retry/backoff attempts become telemetry events + a counter (the
+    retry path is already warn+sleep slow, so the accounting is free)."""
+    from pint_tpu import config
+
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.event("retry", what=what, attempt=attempt,
+                    delay_s=round(delay, 3),
+                    error=type(exc).__name__ if exc is not None else None)
+    telemetry.metrics.counter(
+        "pint_tpu_retries_total",
+        "retried attempts in the checkpointed executor").inc(
+        labels={"what": what.split()[0]})
+
+
 def with_retries(fn: Callable, policy: Optional[RetryPolicy] = None,
                  what: str = "chunk"):
     """Run ``fn()`` under the retry policy; returns its result.
@@ -124,6 +143,7 @@ def with_retries(fn: Callable, policy: Optional[RetryPolicy] = None,
             log.warning(f"{what}: attempt {attempt} failed "
                         f"({type(last).__name__}: {last}); retrying in "
                         f"{delay:.2f}s")
+            _telemetry_retry(what, attempt, delay, last)
             if delay > 0:
                 time.sleep(delay)
         try:
@@ -238,15 +258,26 @@ def checkpointed_map(fn: Callable, chunks: Sequence,
         if done:
             log.info(f"sweep checkpoint {checkpoint}: resuming with "
                      f"{len(done)}/{len(chunks)} chunks already complete")
+    from pint_tpu import config as _config
+    from pint_tpu import telemetry as _telemetry
+
     out: List[dict] = []
     for i, chunk in enumerate(chunks):
         if ckpt is not None and ckpt.has(i):
             out.append(ckpt.load(i))
+            if _config._telemetry_mode != "off":
+                _telemetry.event("sweep.chunk_resumed", index=i)
             continue
         res = with_retries(lambda: _invoke(fn, chunk, i), retry,
                            what=f"sweep chunk {i}/{len(chunks)}")
         res = {k: np.asarray(v) for k, v in res.items()}
         if ckpt is not None:
             ckpt.save(i, **res)
+        if _config._telemetry_mode != "off":
+            _telemetry.event("sweep.chunk_done", index=i,
+                             total=len(chunks), persisted=ckpt is not None)
+            _telemetry.metrics.counter(
+                "pint_tpu_sweep_chunks_total",
+                "completed checkpointed sweep chunks").inc()
         out.append(res)
     return out

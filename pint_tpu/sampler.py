@@ -338,6 +338,16 @@ class EnsembleSampler(MCMCSampler):
         if (self.backend is not None
                 and (step + 1) % self.checkpoint_every == 0):
             self.backend.save(self)
+            from pint_tpu import config as _config
+
+            if _config._telemetry_mode != "off":
+                from pint_tpu import telemetry as _tel
+
+                _tel.event("mcmc.checkpoint_save",
+                           steps=len(self._chain), path=self.backend.path)
+                _tel.metrics.counter(
+                    "pint_tpu_mcmc_checkpoint_saves_total",
+                    "MCMC chain checkpoint writes").inc()
             # each save rewrites the whole chain; grow the interval so
             # cumulative checkpoint I/O stays ~linear in chain length
             if len(self._chain) >= 20 * self.checkpoint_every:
@@ -361,13 +371,23 @@ class EnsembleSampler(MCMCSampler):
             raise ValueError(
                 f"pos has {x.shape[0]} walkers, expected {self.nwalkers}")
         lp = self._eval_lnpost(x)
+        steps_done = 0
         try:
             for step in range(iterations):
                 self._one_step(x, lp, step)
+                steps_done += 1
                 yield x
         finally:
             if self.backend is not None:
                 self.backend.save(self)
+            from pint_tpu import config as _config
+
+            if _config._telemetry_mode != "off" and steps_done:
+                from pint_tpu.telemetry import metrics as _metrics
+
+                _metrics.counter("pint_tpu_mcmc_steps_total",
+                                 "ensemble MCMC steps advanced").inc(
+                    steps_done)
 
     @property
     def iteration(self) -> int:

@@ -1,0 +1,90 @@
+"""Structured observability for the TPU hot path.
+
+Four parts (DESIGN.md "Observability & telemetry"):
+
+* :mod:`~pint_tpu.telemetry.spans` — contextvar-nested span tracer
+  (subsumes :class:`pint_tpu.profiling.StageTimer`, which is now a shim
+  over it);
+* :mod:`~pint_tpu.telemetry.metrics` — process-wide counter/gauge/
+  histogram registry with Prometheus-text and JSON exporters;
+* :mod:`~pint_tpu.telemetry.jaxevents` — JAX compile/cache-hit,
+  transfer and live-buffer accounting;
+* :mod:`~pint_tpu.telemetry.runlog` — per-run manifest + JSONL event
+  stream, rendered by ``python -m tools.telemetry_report``.
+
+Gating: :func:`pint_tpu.config.telemetry_mode` (``PINT_TPU_TELEMETRY`` =
+``off`` | ``basic`` | ``full``).  ``off`` keeps every instrumented call
+on a no-op fast path; ``basic`` collects spans/metrics/compile counts in
+memory; ``full`` additionally streams to a run log on disk and samples
+live-buffer watermarks.  :func:`activate` applies the side-effectful
+parts of a mode switch (jaxevents listeners, the runlog span sink) and
+is called automatically on import for processes launched with the env
+var already set.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pint_tpu import config
+from pint_tpu.telemetry import jaxevents, metrics, runlog, spans
+from pint_tpu.telemetry.spans import (
+    current_span,
+    event,
+    set_attr,
+    span,
+)
+
+__all__ = ["span", "event", "set_attr", "current_span", "mode", "enabled",
+           "activate", "deactivate", "spans", "metrics", "jaxevents",
+           "runlog"]
+
+
+def mode() -> str:
+    """Current telemetry mode (off | basic | full)."""
+    return config.telemetry_mode()
+
+
+def enabled() -> bool:
+    return config.telemetry_mode() != "off"
+
+
+def _runlog_sink(sp) -> None:
+    """Full mode streams every finished root span into the (lazily
+    started) run log."""
+    if config.telemetry_mode() == "full":
+        runlog.ensure_run().record_span(sp)
+
+
+_sink_registered = False
+
+
+def activate(new_mode: Optional[str] = None) -> str:
+    """Switch telemetry on (optionally setting ``new_mode`` first) and
+    wire the mode's side effects: jaxevents accounting for basic/full,
+    the runlog span sink for full.  Returns the active mode."""
+    global _sink_registered
+    if new_mode is not None:
+        config.set_telemetry_mode(new_mode)
+    m = config.telemetry_mode()
+    if m != "off":
+        jaxevents.install()
+        if not _sink_registered:
+            spans.add_span_sink(_runlog_sink)
+            _sink_registered = True
+    return m
+
+
+def deactivate(close_run: bool = True) -> None:
+    """Set mode off, deafen the jaxevents accounting, and (by default)
+    close the current run log."""
+    config.set_telemetry_mode("off")
+    jaxevents.uninstall()
+    if close_run:
+        runlog.end_run()
+
+
+# processes launched with PINT_TPU_TELEMETRY already set get the side
+# effects without an explicit activate() call
+if config.telemetry_mode() != "off":
+    activate()

@@ -1,0 +1,322 @@
+"""JAX instrumentation: compile vs cache-hit counts, transfers, buffers.
+
+The answers this module exists for: *"how many recompiles did this fit
+trigger?"*, *"what did this sweep transfer host<->device?"*, and *"what
+was the live-buffer watermark?"* — per process and per span.
+
+Mechanism, in preference order:
+
+* ``jax.monitoring`` listeners (present on this jax 0.4.x line):
+  ``/jax/core/compile/backend_compile_duration`` fires once per fresh
+  XLA compilation and carries its duration;
+  ``/jax/core/compile/jaxpr_trace_duration`` fires once per *tracing*
+  (cache-miss at the jaxpr level).  A dispatch served by the C++
+  executable cache fires neither.  We therefore report
+  ``compiles`` (backend compilations), ``traces``, and
+  ``cache_hits = traces - compiles`` (retraces satisfied without a
+  backend compile — the persistent compilation cache's hits);
+* :func:`jitted_cache_size` reads a specific jitted callable's
+  ``_cache_size()`` — the fallback/diagnostic when monitoring listeners
+  are unavailable (:data:`MONITORING_AVAILABLE` False) and the primitive
+  tests assert against;
+* host->device transfers are counted by wrapping ``jax.device_put``
+  while installed (bytes from the pytree's ``nbytes`` leaves);
+  device->host gathers cannot be intercepted centrally (``__array__``
+  lives on the C++ Array type), so hot paths report them explicitly via
+  :func:`record_transfer`;
+* live-buffer accounting sums ``jax.live_arrays()`` bytes; on devices
+  exposing ``memory_stats()`` (real TPUs) the HBM peak rides along.
+
+Everything lands in the process metrics registry
+(:mod:`pint_tpu.telemetry.metrics`, ``pint_tpu_jax_*`` names) and — via
+:func:`span_snapshot` deltas — on spans.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from pint_tpu.telemetry import metrics
+
+__all__ = ["install", "uninstall", "installed", "counts", "JaxEventCounts",
+           "watch", "CompileWatch", "record_transfer", "jitted_cache_size",
+           "live_buffer_bytes", "memory_snapshot", "MONITORING_AVAILABLE"]
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+try:
+    from jax import monitoring as _monitoring
+
+    MONITORING_AVAILABLE = hasattr(_monitoring,
+                                   "register_event_duration_secs_listener")
+except ImportError:  # pragma: no cover - jax is a hard dep of the package
+    _monitoring = None
+    MONITORING_AVAILABLE = False
+
+_lock = threading.Lock()
+_installed = False
+#: the listener closure reads this flag so uninstall() deafens it (jax
+#: exposes no public unregister API on every version — the listener is
+#: registered ONCE per process and gated here, never re-registered)
+_active = False
+_listener_registered = False
+_orig_device_put = None
+
+
+def _on_duration(event: str, duration: float, **kw) -> None:
+    from pint_tpu import config
+
+    # both gates: uninstall() deafens via _active, and a plain
+    # config.set_telemetry_mode("off") must also stop accounting
+    # immediately (the documented off contract) without an uninstall
+    if not _active or config._telemetry_mode == "off":
+        return
+    if event == _COMPILE_EVENT:
+        metrics.counter("pint_tpu_jax_compiles_total",
+                        "fresh XLA backend compilations").inc()
+        metrics.counter("pint_tpu_jax_compile_seconds_total",
+                        "wall seconds spent in XLA backend_compile").inc(
+            float(duration))
+    elif event == _TRACE_EVENT:
+        metrics.counter("pint_tpu_jax_traces_total",
+                        "jaxpr tracings (jit cache misses at trace level)"
+                        ).inc()
+
+
+def _counting_device_put(x, *args, **kw):
+    from pint_tpu import config
+
+    if _active and config._telemetry_mode != "off":
+        record_transfer("h2d", _tree_nbytes(x))
+    return _orig_device_put(x, *args, **kw)
+
+
+def _tree_nbytes(x) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(x):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def record_transfer(direction: str, nbytes: int, count: int = 1) -> None:
+    """Count a host<->device transfer (``direction`` in h2d/d2h).  Hot
+    paths that gather device results through ``np.asarray`` report their
+    d2h traffic here — there is no central hook for ``__array__``."""
+    labels = {"direction": direction}
+    metrics.counter("pint_tpu_jax_transfers_total",
+                    "host<->device transfers").inc(count, labels=labels)
+    if nbytes:
+        metrics.counter("pint_tpu_jax_transfer_bytes_total",
+                        "host<->device bytes moved").inc(int(nbytes),
+                                                         labels=labels)
+
+
+def install() -> bool:
+    """Register the monitoring listeners and the ``device_put`` counter;
+    idempotent.  Returns True when the monitoring listeners are live
+    (False means only the fallback accounting is available)."""
+    global _installed, _active, _listener_registered, _orig_device_put
+    import jax
+
+    with _lock:
+        if _installed:
+            _active = True
+            return MONITORING_AVAILABLE
+        if MONITORING_AVAILABLE and not _listener_registered:
+            # once per process: jax has no reliably-public unregister, so
+            # re-registering after an uninstall would double-count every
+            # compile; the _active flag does the turning on and off
+            _monitoring.register_event_duration_secs_listener(_on_duration)
+            _listener_registered = True
+        _orig_device_put = jax.device_put
+        jax.device_put = _counting_device_put
+        _installed = True
+        _active = True
+    return MONITORING_AVAILABLE
+
+
+def uninstall() -> None:
+    """Deactivate the accounting: the ``device_put`` wrapper is removed
+    and the monitoring listener goes deaf (``_active`` False).  The
+    listener itself stays registered — jax exposes no reliably-public
+    unregister hook, and unregister+re-register cycles would otherwise
+    risk double registration (every compile then counted twice); one
+    deaf listener costs a flag check per compile."""
+    global _installed, _active, _orig_device_put
+    import jax
+
+    with _lock:
+        _active = False
+        if not _installed:
+            return
+        if _orig_device_put is not None:
+            jax.device_put = _orig_device_put
+            _orig_device_put = None
+        _installed = False
+
+
+def installed() -> bool:
+    return _installed and _active
+
+
+@dataclass(frozen=True)
+class JaxEventCounts:
+    """Snapshot of the process-wide JAX accounting counters."""
+
+    compiles: int
+    traces: int
+    compile_seconds: float
+    transfers_h2d: int
+    transfers_d2h: int
+    transfer_bytes_h2d: int
+    transfer_bytes_d2h: int
+
+    @property
+    def cache_hits(self) -> int:
+        """Retraces that did not need a fresh backend compile (e.g. the
+        persistent compilation cache served them)."""
+        return max(0, self.traces - self.compiles)
+
+    def __sub__(self, other: "JaxEventCounts") -> "JaxEventCounts":
+        return JaxEventCounts(
+            compiles=self.compiles - other.compiles,
+            traces=self.traces - other.traces,
+            compile_seconds=self.compile_seconds - other.compile_seconds,
+            transfers_h2d=self.transfers_h2d - other.transfers_h2d,
+            transfers_d2h=self.transfers_d2h - other.transfers_d2h,
+            transfer_bytes_h2d=self.transfer_bytes_h2d
+            - other.transfer_bytes_h2d,
+            transfer_bytes_d2h=self.transfer_bytes_d2h
+            - other.transfer_bytes_d2h)
+
+    def to_dict(self) -> dict:
+        return {"compiles": self.compiles, "traces": self.traces,
+                "cache_hits": self.cache_hits,
+                "compile_seconds": round(self.compile_seconds, 6),
+                "transfers_h2d": self.transfers_h2d,
+                "transfers_d2h": self.transfers_d2h,
+                "transfer_bytes_h2d": self.transfer_bytes_h2d,
+                "transfer_bytes_d2h": self.transfer_bytes_d2h}
+
+
+def counts() -> JaxEventCounts:
+    """Current process-wide totals (zeros until :func:`install`)."""
+    c = metrics.registry().counter
+    return JaxEventCounts(
+        compiles=int(c("pint_tpu_jax_compiles_total").value()),
+        traces=int(c("pint_tpu_jax_traces_total").value()),
+        compile_seconds=c("pint_tpu_jax_compile_seconds_total").value(),
+        transfers_h2d=int(c("pint_tpu_jax_transfers_total").value(
+            {"direction": "h2d"})),
+        transfers_d2h=int(c("pint_tpu_jax_transfers_total").value(
+            {"direction": "d2h"})),
+        transfer_bytes_h2d=int(c("pint_tpu_jax_transfer_bytes_total").value(
+            {"direction": "h2d"})),
+        transfer_bytes_d2h=int(c("pint_tpu_jax_transfer_bytes_total").value(
+            {"direction": "d2h"})))
+
+
+class CompileWatch:
+    """``with CompileWatch() as w:`` ... ``w.delta`` — the JAX accounting
+    delta across the block (what the recompile-regression test asserts
+    on, and what spans stamp into their attrs)."""
+
+    def __init__(self, span=None):
+        self._span = span
+        self.start: Optional[JaxEventCounts] = None
+        self.delta: Optional[JaxEventCounts] = None
+
+    def __enter__(self) -> "CompileWatch":
+        install()
+        self.start = counts()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.delta = counts() - self.start
+        if self._span is not None:
+            # stamped even when all-zero: "compiles=0" on a repeat-fit
+            # span is the observable warm-cache signal — an absent event
+            # would be indistinguishable from accounting never running
+            self._span.add_event("jax", **self.delta.to_dict())
+        return False
+
+
+class _NullWatch:
+    """Inert watch returned while telemetry is off: no install, no
+    counter reads; ``delta`` stays None."""
+
+    __slots__ = ()
+    start = None
+    delta = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_WATCH = _NullWatch()
+
+
+def watch(span=None) -> "CompileWatch":
+    """Sugar for :class:`CompileWatch` (optionally bound to a span);
+    returns a shared no-op watch when telemetry is off so instrumented
+    hot paths pay one mode compare."""
+    from pint_tpu import config
+
+    if config._telemetry_mode == "off":
+        return _NULL_WATCH
+    return CompileWatch(span=span)
+
+
+def jitted_cache_size(fn) -> Optional[int]:
+    """``fn._cache_size()`` of a jitted callable, or None — the fallback
+    compile-accounting primitive when monitoring is unavailable (a
+    second same-shape call leaving the size unchanged == cache hit)."""
+    size = getattr(fn, "_cache_size", None)
+    if size is None:
+        return None
+    try:
+        return int(size())
+    except (TypeError, RuntimeError):
+        return None
+
+
+def live_buffer_bytes() -> int:
+    """Total bytes of live jax arrays on all devices (walks
+    ``jax.live_arrays()`` — O(number of arrays), full-mode sampling
+    only)."""
+    import jax
+
+    return sum(int(getattr(a, "nbytes", 0) or 0) for a in jax.live_arrays())
+
+
+def memory_snapshot() -> dict:
+    """Live-buffer bytes plus, where the backend exposes
+    ``memory_stats()`` (real TPUs), the device's bytes-in-use/peak.
+    Updates the ``pint_tpu_jax_live_buffer_bytes`` gauge and its
+    ``..._peak`` high watermark."""
+    import jax
+
+    out = {"live_buffer_bytes": live_buffer_bytes()}
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except (RuntimeError, AttributeError):
+        stats = None
+    if stats:
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if k in stats:
+                out[k] = int(stats[k])
+    g = metrics.gauge("pint_tpu_jax_live_buffer_bytes",
+                      "live jax array bytes at last sample")
+    g.set(out["live_buffer_bytes"])
+    metrics.gauge("pint_tpu_jax_live_buffer_bytes_peak",
+                  "high watermark of sampled live jax array bytes").max(
+        max(out["live_buffer_bytes"], out.get("peak_bytes_in_use", 0)))
+    return out

@@ -1,0 +1,249 @@
+"""Process-wide metrics registry: counters, gauges, histograms.
+
+A deliberately small, dependency-free subset of the Prometheus data
+model: named instruments with optional label sets, a process-wide
+default :class:`MetricsRegistry`, and two exporters — Prometheus text
+exposition (``to_prometheus_text``) and a JSON snapshot (``to_json``,
+what bench.py stamps into its artifact).  Instrument updates are
+lock-protected (the checkpoint executor and sampler touch metrics from
+worker threads) and cheap enough for per-fit counters; per-TOA-scale
+loops should aggregate first.
+
+When telemetry is off the fitters never reach this module (the span
+fast path returns before any metric call); the registry itself has no
+mode check so tests and the report CLI can always read it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+           "counter", "gauge", "histogram", "reset_registry"]
+
+#: default histogram buckets: wall-time seconds over the ms..minutes
+#: range the fit/grid/MCMC paths actually span
+DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5, 10.0, 60.0, 300.0)
+
+
+def _label_key(labels: Optional[dict]) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    body = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + body + "}"
+
+
+class _Instrument:
+    """Shared name/help/label bookkeeping; one value cell per label set."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._cells: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        with self._lock:
+            return self._cells.get(_label_key(labels), 0.0)
+
+    def samples(self) -> List[Tuple[str, Tuple[Tuple[str, str], ...], float]]:
+        """(suffix, label key, value) rows for the exporters."""
+        with self._lock:
+            return [("", k, v) for k, v in sorted(self._cells.items())]
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            if list(self._cells) == [()]:
+                return {"value": self._cells[()]}
+            return {"values": {_fmt_labels(k) or "{}": v
+                               for k, v in sorted(self._cells.items())}}
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count (fits run, compiles seen, retries)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, labels: Optional[dict] = None) -> None:
+        if amount < 0:
+            raise UsageError(f"counter {self.name}: negative increment "
+                             f"{amount} (use a Gauge for ups and downs)")
+        key = _label_key(labels)
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0.0) + amount
+
+
+class Gauge(_Instrument):
+    """Point-in-time level (live buffer bytes, chain length)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, labels: Optional[dict] = None) -> None:
+        with self._lock:
+            self._cells[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, labels: Optional[dict] = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, labels: Optional[dict] = None) -> None:
+        self.inc(-amount, labels)
+
+    def max(self, value: float, labels: Optional[dict] = None) -> None:
+        """High-watermark update: keep the larger of current and value."""
+        key = _label_key(labels)
+        with self._lock:
+            self._cells[key] = max(self._cells.get(key, 0.0), float(value))
+
+
+class Histogram(_Instrument):
+    """Cumulative-bucket histogram of observations (span durations)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise UsageError(f"histogram {self.name}: needs >= 1 bucket")
+        #: per-label-set (bucket counts, total count, value sum)
+        self._h: Dict[Tuple[Tuple[str, str], ...],
+                      Tuple[List[int], int, float]] = {}
+
+    def observe(self, value: float, labels: Optional[dict] = None) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts, n, s = self._h.get(key) or ([0] * len(self.buckets), 0, 0.0)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._h[key] = (counts, n + 1, s + float(value))
+
+    def value(self, labels: Optional[dict] = None) -> float:
+        """Observation count (the headline scalar for a histogram)."""
+        with self._lock:
+            got = self._h.get(_label_key(labels))
+            return float(got[1]) if got else 0.0
+
+    def samples(self):
+        rows = []
+        with self._lock:
+            for key, (counts, n, s) in sorted(self._h.items()):
+                # bucket counts are stored cumulative (Prometheus `le`)
+                for b, c in zip(self.buckets, counts):
+                    rows.append(("_bucket", key + (("le", repr(b)),),
+                                 float(c)))
+                rows.append(("_bucket", key + (("le", "+Inf"),), float(n)))
+                rows.append(("_count", key, float(n)))
+                rows.append(("_sum", key, s))
+        return rows
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            out = {}
+            for key, (counts, n, s) in sorted(self._h.items()):
+                out[_fmt_labels(key) or "{}"] = {
+                    "count": n, "sum": s,
+                    "buckets": {repr(b): c
+                                for b, c in zip(self.buckets, counts)}}
+            return {"histogram": out}
+
+
+class MetricsRegistry:
+    """Name -> instrument map with get-or-create accessors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def _get(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help, **kw)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise UsageError(
+                    f"metric {name!r} already registered as {inst.kind}, "
+                    f"requested {cls.kind}")
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def instruments(self) -> Dict[str, _Instrument]:
+        with self._lock:
+            return dict(self._instruments)
+
+    def to_prometheus_text(self) -> str:
+        """Prometheus text exposition format, one HELP/TYPE block per
+        instrument."""
+        lines: List[str] = []
+        for name, inst in sorted(self.instruments().items()):
+            if inst.help:
+                lines.append(f"# HELP {name} {inst.help}")
+            lines.append(f"# TYPE {name} {inst.kind}")
+            for suffix, key, value in inst.samples():
+                v = repr(value) if value != int(value) else str(int(value))
+                lines.append(f"{name}{suffix}{_fmt_labels(key)} {v}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self) -> dict:
+        """{name: {kind, help, value|values|histogram}} snapshot."""
+        return {name: {"kind": inst.kind, "help": inst.help,
+                       **inst.to_dict()}
+                for name, inst in sorted(self.instruments().items())}
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), sort_keys=True)
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry."""
+    return _registry
+
+
+def reset_registry() -> MetricsRegistry:
+    """Swap in a fresh default registry (tests; returns the new one).
+    Instruments held from the old registry keep working but no longer
+    export — re-fetch by name after a reset."""
+    global _registry
+    _registry = MetricsRegistry()
+    return _registry
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return _registry.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return _registry.gauge(name, help)
+
+
+def histogram(name: str, help: str = "",
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    return _registry.histogram(name, help, buckets=buckets)

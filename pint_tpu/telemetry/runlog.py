@@ -1,0 +1,256 @@
+"""Per-run manifest + append-only JSONL event stream.
+
+A *run* is one process's (or one workload's) telemetry output on disk::
+
+    <run_dir>/manifest.json    identity: config snapshot, DeviceProfile,
+                               package versions, git sha, argv
+    <run_dir>/events.jsonl     append-only stream: finished span trees,
+                               loose events, metrics snapshots
+
+Every JSONL line is one object with ``schema`` (:data:`EVENT_SCHEMA`),
+``t`` (epoch seconds) and ``type`` in :data:`EVENT_TYPES`; the record
+body sits under the type's key (``span``/``event``/``metrics``/``run``).
+``python -m tools.telemetry_report`` renders a run and ``--check``
+validates the schema (wired into pre-commit so a drift in this module
+fails fast).
+
+With ``PINT_TPU_TELEMETRY=full`` a run starts lazily on the first
+finished root span (:func:`ensure_run`; directory from
+``PINT_TPU_TELEMETRY_DIR`` or ``.pint_tpu_telemetry/``); explicit
+:func:`start_run` wins when callers (bench, tests) want a known path.
+Writes are append+flush so a crashed process keeps everything up to its
+last complete line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.logging import log
+from pint_tpu.telemetry import metrics
+from pint_tpu.telemetry.spans import Span
+
+__all__ = ["RunLog", "start_run", "current_run", "ensure_run", "end_run",
+           "MANIFEST_SCHEMA", "EVENT_SCHEMA", "EVENT_TYPES",
+           "default_run_dir"]
+
+MANIFEST_SCHEMA = "pint_tpu.telemetry.manifest/1"
+EVENT_SCHEMA = "pint_tpu.telemetry.event/1"
+#: event type -> required body key (None: no body beyond type/t)
+EVENT_TYPES = {"span": "span", "event": "event", "metrics": "metrics",
+               "run_start": "run", "run_end": "run"}
+
+#: environment knobs worth snapshotting into the manifest
+_ENV_KEYS = ("PINT_TPU_TELEMETRY", "PINT_TPU_DEVICE_POLICY",
+             "PINT_TPU_INGESTION_POLICY", "PINT_TPU_REQUIRE_PLATFORM",
+             "JAX_PLATFORMS", "JAX_ENABLE_X64")
+
+_current: Optional["RunLog"] = None
+
+
+def _sanitize(obj):
+    """Replace non-finite floats with their string forms anywhere in a
+    record so every events.jsonl line stays strict JSON."""
+    import math
+
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else str(obj)
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def default_run_dir() -> str:
+    """``$PINT_TPU_TELEMETRY_DIR`` or ``./.pint_tpu_telemetry``, with a
+    unique ``run_<utc>_<pid>[_<n>]`` leaf.  The timestamp is
+    second-resolution, so an existing directory gets a counter suffix —
+    two quick runs in one process must never interleave into one
+    events.jsonl or clobber each other's manifest."""
+    base = os.environ.get("PINT_TPU_TELEMETRY_DIR") \
+        or os.path.join(os.getcwd(), ".pint_tpu_telemetry")
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+    leaf = os.path.join(base, f"run_{stamp}_{os.getpid()}")
+    n, path = 0, leaf
+    while os.path.exists(path):
+        n += 1
+        path = f"{leaf}_{n}"
+    return path
+
+
+def _git_sha() -> Optional[str]:
+    """HEAD commit of the working tree, resolved by file reads (no git
+    subprocess — runs may start in hermetic/test environments)."""
+    d = os.path.dirname(os.path.abspath(__file__))
+    while d != os.path.dirname(d):
+        head = os.path.join(d, ".git", "HEAD")
+        if os.path.exists(head):
+            try:
+                with open(head) as f:
+                    ref = f.read().strip()
+                if not ref.startswith("ref:"):
+                    return ref[:40] or None
+                ref_path = os.path.join(d, ".git", ref.split(None, 1)[1])
+                with open(ref_path) as f:
+                    return f.read().strip()[:40] or None
+            except OSError:
+                return None
+        d = os.path.dirname(d)
+    return None
+
+
+def _package_versions() -> dict:
+    out = {}
+    for mod in ("jax", "jaxlib", "numpy", "scipy"):
+        try:
+            out[mod] = str(__import__(mod).__version__)
+        except Exception:
+            out[mod] = None
+    return out
+
+
+def _device_profile_dict() -> Optional[dict]:
+    """The preflight DeviceProfile, or None when probing fails (a run log
+    must never be the thing that makes a backend problem fatal)."""
+    try:
+        from pint_tpu.runtime.preflight import device_profile
+
+        return device_profile().to_dict()
+    except Exception as e:
+        log.warning(f"telemetry manifest: device profile unavailable "
+                    f"({type(e).__name__}: {e})")
+        return None
+
+
+class RunLog:
+    """One run's manifest + event stream.  Construct via
+    :func:`start_run` / :func:`ensure_run` (they manage the process-wide
+    current run and the span sink)."""
+
+    def __init__(self, path: str, name: str = "run",
+                 extra_manifest: Optional[dict] = None,
+                 probe_device: bool = True):
+        self.path = path
+        self.name = name
+        self.closed = False
+        os.makedirs(path, exist_ok=True)
+        self.manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "name": name,
+            "created_unix": time.time(),
+            "argv": list(sys.argv),
+            "python": sys.version.split()[0],
+            "platform": sys.platform,
+            "packages": _package_versions(),
+            "git_sha": _git_sha(),
+            "config": {
+                "telemetry_mode": config.telemetry_mode(),
+                "device_policy": config.device_policy(),
+                "ingestion_policy": config.ingestion_policy(),
+            },
+            "env": {k: os.environ.get(k) for k in _ENV_KEYS
+                    if os.environ.get(k) is not None},
+            "device_profile": _device_profile_dict() if probe_device
+            else None,
+        }
+        if extra_manifest:
+            self.manifest.update(extra_manifest)
+        self.manifest_path = os.path.join(path, "manifest.json")
+        with open(self.manifest_path, "w", encoding="utf-8") as f:
+            json.dump(self.manifest, f, indent=2, sort_keys=True,
+                      default=str)
+            f.write("\n")
+        self.events_path = os.path.join(path, "events.jsonl")
+        self._fh = open(self.events_path, "a", encoding="utf-8")
+        self._write("run_start", run={"name": name})
+
+    def _write(self, type_: str, **body) -> None:
+        if self.closed:
+            return
+        rec = {"schema": EVENT_SCHEMA, "t": time.time(), "type": type_,
+               **body}
+        try:
+            # allow_nan=False keeps every line STRICT JSON (bare
+            # Infinity/NaN tokens break jq and non-Python ingesters);
+            # producers sanitize non-finite floats to strings, and
+            # _sanitize is the belt-and-suspenders for loose events
+            self._fh.write(json.dumps(_sanitize(rec), sort_keys=True,
+                                      default=str, allow_nan=False)
+                           + "\n")
+            self._fh.flush()
+        except (OSError, ValueError) as e:
+            # ValueError: write to a closed file; either way telemetry
+            # must degrade, not take the fit down with it
+            log.warning(f"telemetry run log write failed: {e}")
+            self.closed = True
+
+    def record_span(self, sp: Span) -> None:
+        """Append one finished root span tree."""
+        self._write("span", span=sp.to_dict())
+
+    def record_event(self, name: str, **attrs) -> None:
+        """Append a loose (span-less) event."""
+        self._write("event", event={"name": name, "attrs": attrs})
+
+    def record_metrics(self) -> None:
+        """Append a snapshot of the process metrics registry."""
+        self._write("metrics", metrics=metrics.registry().to_json())
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.record_metrics()
+        self._write("run_end", run={"name": self.name})
+        self.closed = True
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+
+def start_run(path: Optional[str] = None, name: str = "run",
+              extra_manifest: Optional[dict] = None,
+              probe_device: bool = True) -> RunLog:
+    """Open a run log at ``path`` (default :func:`default_run_dir`) and
+    make it the process-wide current run (closing any previous one)."""
+    global _current
+    if config.telemetry_mode() == "off":
+        raise UsageError(
+            "telemetry is off; set PINT_TPU_TELEMETRY=basic|full (or "
+            "config.set_telemetry_mode) before starting a run log")
+    if _current is not None and not _current.closed:
+        _current.close()
+    _current = RunLog(path or default_run_dir(), name=name,
+                      extra_manifest=extra_manifest,
+                      probe_device=probe_device)
+    return _current
+
+
+def current_run() -> Optional[RunLog]:
+    return _current if (_current is not None and not _current.closed) \
+        else None
+
+
+def ensure_run() -> RunLog:
+    """The current run, started lazily if none is open (full mode's
+    first-finished-span trigger)."""
+    run = current_run()
+    if run is None:
+        run = start_run()
+        log.info(f"telemetry: run log started at {run.path}")
+    return run
+
+
+def end_run() -> None:
+    """Close the current run (final metrics snapshot + run_end marker)."""
+    global _current
+    if _current is not None:
+        _current.close()
+        _current = None

@@ -1,0 +1,285 @@
+"""Nested span tracer: contextvar-scoped wall-time regions with events.
+
+The observability core (DESIGN.md "Observability & telemetry").  A *span*
+is a named wall-clock region; spans nest through a :mod:`contextvars`
+stack (async/thread safe), carry ``key=value`` attributes and point-in-
+time *events*, and can mark explicit ``block_until_ready`` device-sync
+points so a span's duration means "work finished on device", not "XLA
+dispatch returned".
+
+Design constraints, in priority order:
+
+* **off is free** — with :func:`pint_tpu.config.telemetry_mode` at
+  ``off``, :func:`span` returns one preallocated no-op context manager
+  (``_NULL_CM``) and :func:`event`/:func:`set_attr` return after a single
+  module-attribute compare.  No allocation, no clock read.  The no-op
+  fast path is asserted structurally in tests/test_telemetry.py.
+* finished root spans are handed to registered *sinks* (the run log's
+  JSONL stream, the metrics registry's span-duration histograms) — the
+  tracer itself never touches the filesystem;
+* one clock: ``time.perf_counter`` for durations, ``time.time`` stamped
+  once per root span for correlation with external logs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from pint_tpu import config
+
+__all__ = ["Span", "span", "event", "set_attr", "current_span",
+           "add_span_sink", "remove_span_sink", "finished_roots",
+           "clear_finished"]
+
+_ids = itertools.count(1)
+
+#: the active span of the calling context (None at top level)
+_current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "pint_tpu_telemetry_span", default=None)
+
+#: callables invoked with each finished ROOT span (its tree complete)
+_sinks: List[Callable[["Span"], None]] = []
+
+#: ring buffer of recently finished root spans (basic mode keeps them in
+#: memory for inspection/bench stamping even with no sink registered)
+_FINISHED_MAX = 256
+_finished: List["Span"] = []
+
+
+@dataclass
+class Span:
+    """One named region: timing, attributes, events, children."""
+
+    name: str
+    span_id: int = field(default_factory=lambda: next(_ids))
+    parent_id: Optional[int] = None
+    t_wall: float = 0.0          #: epoch seconds at start (root correlation)
+    t0: float = 0.0              #: perf_counter at start
+    t1: Optional[float] = None   #: perf_counter at end (None while open)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    events: List[dict] = field(default_factory=list)
+    children: List["Span"] = field(default_factory=list)
+
+    @property
+    def duration(self) -> float:
+        """Seconds; the running duration while the span is still open."""
+        return (self.t1 if self.t1 is not None else time.perf_counter()) \
+            - self.t0
+
+    def add_event(self, name: str, **attrs) -> None:
+        self.events.append({"name": name, "t": time.perf_counter() - self.t0,
+                            **attrs})
+
+    def sync(self, value, label: str = "device_sync"):
+        """Block until ``value`` (a jax array / pytree) is ready on device,
+        recording the sync wait as an event; returns ``value``.  Without
+        this, a span around a jitted call measures dispatch, not compute
+        (XLA execution is async).  No-op passthrough when telemetry is
+        off (callers may route results through unconditionally)."""
+        if config._telemetry_mode == "off":
+            return value
+        import jax
+
+        t = time.perf_counter()
+        jax.block_until_ready(value)
+        self.add_event(label, wait_s=round(time.perf_counter() - t, 9))
+        return value
+
+    def to_dict(self) -> dict:
+        """JSON-serializable tree (the JSONL ``span`` record body)."""
+        d = {"name": self.name, "span_id": self.span_id,
+             "duration_s": round(self.duration, 9)}
+        if self.parent_id is not None:
+            d["parent_id"] = self.parent_id
+        else:
+            d["t_wall"] = self.t_wall
+        if self.attrs:
+            d["attrs"] = {k: _jsonable(v) for k, v in self.attrs.items()}
+        if self.events:
+            d["events"] = [
+                {k: _jsonable(v) for k, v in e.items()} for e in self.events]
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    def render(self, indent: int = 0) -> str:
+        """Aligned one-line-per-span tree (the report CLI's span table)."""
+        pad = "  " * indent
+        extras = ""
+        if self.attrs:
+            extras = "  " + " ".join(f"{k}={v}" for k, v in
+                                     sorted(self.attrs.items()))
+        lines = [f"{pad}{self.name:<{max(1, 40 - 2 * indent)}s} "
+                 f"{self.duration:9.3f} s{extras}"]
+        for c in self.children:
+            lines.append(c.render(indent + 1))
+        return "\n".join(lines)
+
+
+def _jsonable(v):
+    """Attributes/events must survive STRICT json.dumps: numpy scalars
+    and other exotica are stringified rather than crashing the export,
+    and non-finite floats become strings ("inf"/"nan") — bare
+    Infinity/NaN tokens are not JSON and would break non-Python
+    consumers of events.jsonl."""
+    import math
+
+    if isinstance(v, float):
+        return v if math.isfinite(v) else str(v)
+    if isinstance(v, (str, int, bool)) or v is None:
+        return v
+    try:
+        f = float(v)
+        return f if math.isfinite(f) else str(f)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class _NullSpan:
+    """Inert span: every method is a no-op so instrumented code can call
+    ``sp.add_event(...)``, ``sp.sync(x)`` or write ``sp.attrs[...]``
+    without mode checks.  ``attrs``/``events``/``children`` are fresh
+    throwaway containers per access — writes land nowhere and cannot
+    accumulate shared state."""
+
+    __slots__ = ()
+    name = ""
+    duration = 0.0
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        return {}
+
+    @property
+    def events(self) -> List[dict]:
+        return []
+
+    @property
+    def children(self) -> List["Span"]:
+        return []
+
+    def add_event(self, name: str, **attrs) -> None:
+        pass
+
+    def sync(self, value, label: str = "device_sync"):
+        return value
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _NullCM:
+    """The preallocated no-op context manager :func:`span` returns when
+    telemetry is off — entering yields the shared inert span."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return _NULL_SPAN
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CM = _NullCM()
+
+
+@contextlib.contextmanager
+def _live_span(name: str, attrs: dict):
+    parent = _current.get()
+    sp = Span(name=name,
+              parent_id=parent.span_id if parent is not None else None,
+              attrs=attrs)
+    sp.t_wall = time.time() if parent is None else 0.0
+    sp.t0 = time.perf_counter()
+    token = _current.set(sp)
+    try:
+        yield sp
+    except BaseException as e:
+        sp.attrs.setdefault("error", type(e).__name__)
+        raise
+    finally:
+        sp.t1 = time.perf_counter()
+        _current.reset(token)
+        if parent is not None:
+            parent.children.append(sp)
+        else:
+            _finish_root(sp)
+
+
+def _finish_root(sp: Span) -> None:
+    _finished.append(sp)
+    if len(_finished) > _FINISHED_MAX:
+        del _finished[: len(_finished) - _FINISHED_MAX]
+    for sink in list(_sinks):
+        try:
+            sink(sp)
+        except Exception as e:  # a broken sink must not fail the hot path
+            from pint_tpu.logging import log
+
+            log.warning(f"telemetry span sink {sink!r} failed: "
+                        f"{type(e).__name__}: {e}")
+
+
+def span(name: str, **attrs):
+    """Context manager opening a nested span named ``name``.
+
+    ``with span("gls.fit", ntoas=n) as sp:`` — ``sp`` supports
+    ``add_event``, ``sync`` and attribute writes via ``sp.attrs``.  When
+    telemetry is off this returns a shared no-op context manager without
+    allocating (the asserted fast path)."""
+    if config._telemetry_mode == "off":
+        return _NULL_CM
+    return _live_span(name, attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Record a point-in-time event on the current span (dropped when no
+    span is open or telemetry is off)."""
+    if config._telemetry_mode == "off":
+        return
+    sp = _current.get()
+    if sp is not None:
+        sp.add_event(name, **attrs)
+
+
+def set_attr(key: str, value) -> None:
+    """Set an attribute on the current span (no-op when off/unspanned)."""
+    if config._telemetry_mode == "off":
+        return
+    sp = _current.get()
+    if sp is not None:
+        sp.attrs[key] = value
+
+
+def current_span() -> Optional[Span]:
+    """The innermost open span of this context, or None."""
+    return _current.get()
+
+
+def add_span_sink(sink: Callable[[Span], None]) -> Callable[[Span], None]:
+    """Register ``sink`` to receive every finished root span; returns it
+    (for later :func:`remove_span_sink`)."""
+    _sinks.append(sink)
+    return sink
+
+
+def remove_span_sink(sink: Callable[[Span], None]) -> None:
+    try:
+        _sinks.remove(sink)
+    except ValueError:
+        pass
+
+
+def finished_roots() -> List[Span]:
+    """Recently finished root spans, oldest first (in-memory ring)."""
+    return list(_finished)
+
+
+def clear_finished() -> None:
+    del _finished[:]

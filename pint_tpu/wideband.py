@@ -31,6 +31,9 @@ from pint_tpu.gls_fitter import (
 )
 from pint_tpu.logging import log
 from pint_tpu.residuals import Residuals
+from pint_tpu.telemetry import event as _tevent
+from pint_tpu.telemetry import jaxevents as _jaxevents
+from pint_tpu.telemetry import span as _tspan
 from pint_tpu.utils import normalize_designmatrix, weighted_mean
 
 __all__ = [
@@ -370,26 +373,34 @@ class WidebandTOAFitter(Fitter):
 
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
                  full_cov: bool = False, debug: bool = False) -> float:
-        self.model.validate()
-        self.model.validate_toas(self.toas)
-        self.update_resids()
-        for _ in range(max(1, maxiter)):
-            dpars, errs, covmat, params = self._wideband_step(
-                threshold=threshold, full_cov=full_cov)
-            self._apply_step(dpars, errs, covmat, params)
+        with _tspan("wideband.fit_toas", ntoas=len(self.toas),
+                    nfree=len(self.model.free_params), maxiter=maxiter,
+                    full_cov=full_cov) as sp, _jaxevents.watch(sp):
+            self.model.validate()
+            self.model.validate_toas(self.toas)
             self.update_resids()
-            if not full_cov:
-                self._store_noise_ampls(dpars, len(params))
-        chi2 = self.resids.calc_chi2()
-        if np.isnan(chi2):
-            # inf is a legitimate sentinel (zero DM errors); NaN is a
-            # poisoned solve and must not pass silently
-            raise NonFiniteSystemError(
-                "wideband fit produced NaN chi2 (non-finite residuals or "
-                "a poisoned solve)")
-        self.converged = True
-        self.update_model(chi2)
-        return chi2
+            for it in range(max(1, maxiter)):
+                with _tspan("wideband.step", iteration=it):
+                    dpars, errs, covmat, params = self._wideband_step(
+                        threshold=threshold, full_cov=full_cov)
+                    self._apply_step(dpars, errs, covmat, params)
+                    self.update_resids()
+                if self.solve_diagnostics is not None:
+                    _tevent("wideband.solve", iteration=it,
+                            **self.solve_diagnostics.to_dict())
+                if not full_cov:
+                    self._store_noise_ampls(dpars, len(params))
+            chi2 = self.resids.calc_chi2()
+            if np.isnan(chi2):
+                # inf is a legitimate sentinel (zero DM errors); NaN is a
+                # poisoned solve and must not pass silently
+                raise NonFiniteSystemError(
+                    "wideband fit produced NaN chi2 (non-finite residuals "
+                    "or a poisoned solve)")
+            sp.attrs["chi2"] = float(chi2)
+            self.converged = True
+            self.update_model(chi2)
+            return chi2
 
 
 class WidebandDownhillFitter(DownhillFitter):

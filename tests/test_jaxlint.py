@@ -183,6 +183,47 @@ class TestHostCallInJit:
     def test_silent_on_good(self, tmp_path):
         assert lint_snippet(tmp_path, self.GOOD, [HostCallInJitRule()]) == []
 
+    def test_telemetry_call_in_jit_flagged(self, tmp_path):
+        """A span/metric/event call accidentally placed inside a traced
+        function is a host-side contextvar/lock/file operation that fires
+        once per TRACE — the rule must flag every telemetry spelling."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu import telemetry\n"
+            "from pint_tpu.telemetry import span, event as _tevent\n"
+            "from pint_tpu.telemetry import metrics as _metrics\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    with span('inner'):\n"             # bare imported name
+            "        _tevent('tick', n=1)\n"        # aliased import
+            "    telemetry.event('tock')\n"         # package alias
+            "    _metrics.counter('c').inc()\n"     # submodule alias
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 4
+        msgs = " ".join(f.message for f in findings)
+        assert "telemetry call" in msgs and "once per TRACE" in msgs
+
+    def test_telemetry_call_on_host_not_flagged(self, tmp_path):
+        """The good twin: the same telemetry calls AROUND the jitted
+        function (the documented pattern) are host code and stay silent."""
+        good = (
+            "import jax\n"
+            "from pint_tpu import telemetry\n"
+            "from pint_tpu.telemetry import span, event\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "def host(x):\n"
+            "    with span('fit', n=3) as sp:\n"
+            "        y = sp.sync(f(x))\n"
+            "        event('done')\n"
+            "        telemetry.metrics.counter('fits').inc()\n"
+            "    return y\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

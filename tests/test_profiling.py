@@ -24,6 +24,59 @@ class TestStageTimer:
         assert st.total >= 0.01
         assert len(st.rows) == 2
 
+    def test_mark_after_stage_shares_one_clock(self, monkeypatch):
+        """Regression: mark() after a `with stage(...)` block measures
+        exactly from the block's exit.  The old implementation read
+        perf_counter twice on stage exit (row end, then clock restart),
+        so the window between the two reads belonged to neither row.
+        With a fake clock advancing 1.0 per read, the old code performed
+        4 reads by the end of the stage block (init, t0, row-end, clock
+        restart) and the lost window was a full unit; the fixed code
+        performs 3 reads and mark() measures precisely row-exit -> now."""
+        import pint_tpu.profiling as prof
+        from pint_tpu import config
+
+        # pin mode off: the telemetry mirror path takes extra clock
+        # reads of its own, which would shift the counts under test
+        monkeypatch.setattr(config, "_telemetry_mode", "off")
+        reads = []
+
+        def fake_clock():
+            reads.append(None)
+            return float(len(reads))
+
+        monkeypatch.setattr(prof.time, "perf_counter", fake_clock)
+        st = prof.StageTimer()          # read 1: clock = 1
+        with st.stage("a"):             # read 2: t0 = 2
+            pass                        # read 3: exit = 3 (ONE read)
+        assert len(reads) == 3, (
+            "stage exit must read the clock once — a second read re-opens "
+            "the lost-window bug between the row and the shared clock")
+        assert st._t == 3.0             # shared clock == the row's end
+        dt = st.mark("b")               # read 4: now = 4
+        assert dt == 1.0                # exactly block-exit -> mark
+        assert st.rows == [("a", 1.0), ("b", 1.0)]
+
+    def test_mark_stage_interleaving_conserves_time(self):
+        """mark / stage / mark with real sleeps: the mark after the block
+        must cover at least the post-block sleep, and the stage row at
+        least the in-block sleep (no window double-counted or lost
+        between the two APIs)."""
+        import time
+
+        from pint_tpu.profiling import StageTimer
+
+        st = StageTimer()
+        st.mark("head")
+        with st.stage("work"):
+            time.sleep(0.02)
+        time.sleep(0.03)
+        dt_tail = st.mark("tail")
+        rows = dict(st.rows)
+        assert rows["work"] >= 0.02
+        assert 0.03 <= dt_tail < 0.03 + rows["work"] + 0.05
+        assert len(st.rows) == 3
+
     def test_profile_fit(self):
         if not os.path.exists(NGC_PAR):
             pytest.skip("reference data unavailable")

@@ -1,0 +1,614 @@
+"""Telemetry subsystem under test (DESIGN.md "Observability & telemetry").
+
+Covers the four parts — span tracer, metrics registry, JAX accounting,
+run log — plus the contracts the rest of the repo leans on:
+
+* the ``off`` fast path is structurally a no-op (shared null context
+  manager, no state accumulation) so instrumented hot paths cost one
+  module-attribute compare;
+* a second ``fit_toas()`` on a fitter reports ZERO new jit compilations
+  (the recompile-regression guard for the PR 1 cache-key fixes);
+* full mode: a WLS fit, a GLS fit and a small grid_chisq each produce a
+  run manifest + JSONL event stream that ``tools.telemetry_report``
+  validates and renders (spans nested correctly).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+@pytest.fixture
+def fresh_telemetry():
+    """Clean telemetry state before and after: mode off, fresh metrics
+    registry, no finished spans, no open run."""
+    from pint_tpu import telemetry
+    from pint_tpu.telemetry import metrics, runlog, spans
+
+    telemetry.deactivate()
+    metrics.reset_registry()
+    spans.clear_finished()
+    yield telemetry
+    runlog.end_run()
+    telemetry.deactivate()
+    metrics.reset_registry()
+    spans.clear_finished()
+
+
+def _tiny_wls_fitter(seed=1, ntoas=25):
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = ["PSR TSTTEL\n", "RAJ 17:48:52.75 1\n", "DECJ -20:21:29.0 1\n",
+           "F0 61.485476554 1\n", "F1 -1.181e-15 1\n", "PEPOCH 53750\n",
+           "DM 223.9\n", "UNITS TDB\n"]
+    m = get_model(par)
+    t = make_fake_toas_uniform(53400, 54200, ntoas, m, error_us=5.0,
+                               add_noise=True,
+                               rng=np.random.default_rng(seed))
+    return WLSFitter(t, m)
+
+
+def _tiny_gls_fitter(seed=3):
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ["PSR TSTGLSTEL\n", "RAJ 05:00:00 1\n", "DECJ 15:00:00 1\n",
+           "F0 99.123456789 1\n", "F1 -1.1e-14 1\n", "PEPOCH 55500\n",
+           "DM 12.5 1\n",
+           "EFAC mjd 53000 58000 1.1\n",
+           "EQUAD mjd 53000 58000 0.5\n",
+           "ECORR mjd 53000 58000 0.8\n",
+           "TNRedAmp -13.5\n", "TNRedGam 3.5\n", "TNRedC 10\n",
+           "UNITS TDB\n"]
+    model = get_model(par)
+    rng = np.random.default_rng(seed)
+    base = np.linspace(55000, 56000, 20)
+    mjds = np.sort(np.concatenate([base, base + 0.5 / 86400.0]))
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=1.0,
+                                   add_noise=True, rng=rng)
+    return GLSFitter(toas, model)
+
+
+# ---------------------------------------------------------------------------
+# mode gating + the off fast path
+# ---------------------------------------------------------------------------
+
+class TestModeGating:
+    def test_default_off_and_validation(self, fresh_telemetry):
+        from pint_tpu import config
+
+        assert config.telemetry_mode() == "off"
+        with pytest.raises(ValueError):
+            config.set_telemetry_mode("verbose")
+        config.set_telemetry_mode("basic")
+        assert fresh_telemetry.enabled()
+        config.set_telemetry_mode("off")
+        assert not fresh_telemetry.enabled()
+
+    def test_off_span_is_shared_noop(self, fresh_telemetry):
+        """The asserted no-op fast path: off-mode span() returns ONE
+        preallocated context manager (no allocation), event() drops, and
+        null-span attribute writes land nowhere."""
+        from pint_tpu.telemetry import spans
+
+        assert fresh_telemetry.span("x") is spans._NULL_CM
+        assert fresh_telemetry.span("y", k=1) is spans._NULL_CM
+        with fresh_telemetry.span("x") as sp:
+            assert sp is spans._NULL_SPAN
+            sp.attrs["chi2"] = 1.0      # swallowed, not shared
+            sp.add_event("e", a=2)
+            assert sp.sync(42) == 42
+        assert sp.attrs == {} and sp.events == []
+        fresh_telemetry.event("dropped", n=1)
+        fresh_telemetry.set_attr("k", "v")
+        assert spans.finished_roots() == []
+
+    def test_off_watch_is_shared_noop(self, fresh_telemetry):
+        from pint_tpu.telemetry import jaxevents
+
+        w = jaxevents.watch()
+        assert w is jaxevents._NULL_WATCH
+        with w:
+            pass
+        assert w.delta is None
+
+    def test_off_instrumented_fit_records_nothing(self, fresh_telemetry):
+        """A WLS fit with telemetry off must leave zero telemetry state:
+        no spans, no metrics instruments."""
+        from pint_tpu.telemetry import metrics, spans
+
+        f = _tiny_wls_fitter()
+        f.fit_toas(maxiter=1)
+        assert spans.finished_roots() == []
+        assert metrics.registry().instruments() == {}
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class TestSpans:
+    def test_nesting_attrs_events_sink(self, fresh_telemetry):
+        from pint_tpu.telemetry import spans
+
+        fresh_telemetry.activate("basic")
+        seen = []
+        sink = spans.add_span_sink(seen.append)
+        try:
+            with fresh_telemetry.span("outer", a=1) as outer:
+                fresh_telemetry.set_attr("b", 2)
+                with fresh_telemetry.span("inner") as inner:
+                    fresh_telemetry.event("tick", n=3)
+                    assert spans.current_span() is inner
+                assert spans.current_span() is outer
+        finally:
+            spans.remove_span_sink(sink)
+        assert spans.current_span() is None
+        assert len(seen) == 1
+        root = seen[0]
+        assert root.name == "outer"
+        assert root.attrs == {"a": 1, "b": 2}
+        assert [c.name for c in root.children] == ["inner"]
+        child = root.children[0]
+        assert child.parent_id == root.span_id
+        assert child.events[0]["name"] == "tick"
+        assert child.events[0]["n"] == 3
+        assert root.duration >= child.duration >= 0
+        d = root.to_dict()
+        json.dumps(d)  # must round-trip
+        assert d["children"][0]["parent_id"] == d["span_id"]
+        assert "outer" in root.render() and "inner" in root.render()
+
+    def test_exception_marks_span(self, fresh_telemetry):
+        from pint_tpu.telemetry import spans
+
+        fresh_telemetry.activate("basic")
+        with pytest.raises(RuntimeError):
+            with fresh_telemetry.span("boom"):
+                raise RuntimeError("x")
+        root = spans.finished_roots()[-1]
+        assert root.attrs["error"] == "RuntimeError"
+        assert root.t1 is not None
+
+    def test_broken_sink_does_not_break_spans(self, fresh_telemetry):
+        from pint_tpu.telemetry import spans
+
+        fresh_telemetry.activate("basic")
+
+        def bad_sink(sp):
+            raise RuntimeError("sink down")
+
+        spans.add_span_sink(bad_sink)
+        try:
+            with fresh_telemetry.span("survives"):
+                pass
+        finally:
+            spans.remove_span_sink(bad_sink)
+        assert spans.finished_roots()[-1].name == "survives"
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + exporters
+# ---------------------------------------------------------------------------
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self, fresh_telemetry):
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.telemetry import metrics
+
+        c = metrics.counter("t_total", "help text")
+        c.inc()
+        c.inc(2, labels={"kind": "a"})
+        assert c.value() == 1
+        assert c.value({"kind": "a"}) == 2
+        with pytest.raises(UsageError):
+            c.inc(-1)
+        g = metrics.gauge("t_level")
+        g.set(5)
+        g.max(3)
+        assert g.value() == 5
+        g.max(9)
+        assert g.value() == 9
+        h = metrics.histogram("t_hist", buckets=(0.1, 1.0, 10.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        assert h.value() == 2
+        # same name, different kind: typed refusal
+        with pytest.raises(UsageError):
+            metrics.gauge("t_total")
+
+    def test_exporters(self, fresh_telemetry):
+        from pint_tpu.telemetry import metrics
+
+        metrics.counter("exp_total", "things").inc(3, labels={"x": "1"})
+        metrics.gauge("exp_gauge").set(2.5)
+        metrics.histogram("exp_hist", buckets=(1.0,)).observe(0.5)
+        text = metrics.registry().to_prometheus_text()
+        assert "# TYPE exp_total counter" in text
+        assert 'exp_total{x="1"} 3' in text
+        assert "# TYPE exp_gauge gauge" in text
+        assert "exp_hist_count" in text and "exp_hist_sum" in text
+        j = metrics.registry().to_json()
+        assert j["exp_gauge"]["value"] == 2.5
+        json.dumps(j)  # serializable
+        # registry reset isolates tests
+        metrics.reset_registry()
+        assert metrics.registry().instruments() == {}
+
+
+# ---------------------------------------------------------------------------
+# JAX accounting
+# ---------------------------------------------------------------------------
+
+class TestJaxEvents:
+    def test_compile_watch_and_cache(self, fresh_telemetry):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.telemetry import jaxevents
+
+        fresh_telemetry.activate("basic")
+
+        def f(x):
+            return x * 2 + 1
+
+        jf = jax.jit(f)
+        with jaxevents.watch() as w1:
+            jf(jnp.arange(7.0))
+        assert w1.delta.compiles >= 1
+        with jaxevents.watch() as w2:
+            jf(jnp.arange(7.0))  # same shape, same function: cached
+        assert w2.delta.compiles == 0
+        # _cache_size fallback primitive
+        assert jaxevents.jitted_cache_size(jf) == 1
+        jf(jnp.arange(9.0))  # new shape: new entry
+        assert jaxevents.jitted_cache_size(jf) == 2
+        assert jaxevents.jitted_cache_size(f) is None  # not jitted
+
+    def test_transfer_accounting(self, fresh_telemetry):
+        import jax
+
+        from pint_tpu.telemetry import jaxevents
+
+        fresh_telemetry.activate("basic")
+        before = jaxevents.counts()
+        jax.device_put(np.ones(128))
+        jaxevents.record_transfer("d2h", 512)
+        d = jaxevents.counts() - before
+        assert d.transfers_h2d >= 1
+        assert d.transfer_bytes_h2d >= 128 * 8
+        assert d.transfers_d2h == 1 and d.transfer_bytes_d2h == 512
+        # deactivate restores the un-wrapped device_put
+        fresh_telemetry.deactivate()
+        assert not jaxevents.installed()
+        mid = jaxevents.counts()
+        jax.device_put(np.ones(16))
+        assert (jaxevents.counts() - mid).transfers_h2d == 0
+
+    def test_reinstall_does_not_double_count(self, fresh_telemetry):
+        """Regression: the monitoring listener is registered once per
+        process — an activate/deactivate/activate cycle must not leave
+        a second listener behind (every compile would then count 2x)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.telemetry import jaxevents
+
+        fresh_telemetry.activate("basic")
+        fresh_telemetry.deactivate()
+        fresh_telemetry.activate("basic")
+        if jaxevents.MONITORING_AVAILABLE:
+            from jax._src import monitoring as _mi
+
+            listeners = _mi.get_event_duration_listeners()
+            assert listeners.count(jaxevents._on_duration) == 1
+        with jaxevents.watch() as w:
+            jax.jit(lambda x: x + 2)(jnp.arange(3.0))
+        assert w.delta.compiles in (1, 2)  # fn (+ possible iota helper)
+
+    def test_set_mode_off_quiesces_accounting(self, fresh_telemetry):
+        """config.set_telemetry_mode('off') alone (no deactivate) must
+        stop the compile/transfer counters immediately — the documented
+        'immediate' off contract."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu import config
+        from pint_tpu.telemetry import jaxevents
+
+        fresh_telemetry.activate("basic")
+        config.set_telemetry_mode("off")
+        before = jaxevents.counts()
+        jax.jit(lambda x: x * 7)(jnp.arange(5.0))  # compiles, uncounted
+        jax.device_put(np.ones(32))                # transfers, uncounted
+        d = jaxevents.counts() - before
+        assert d.compiles == 0 and d.traces == 0
+        assert d.transfers_h2d == 0
+
+    def test_memory_snapshot(self, fresh_telemetry):
+        from pint_tpu.telemetry import jaxevents, metrics
+
+        fresh_telemetry.activate("full")
+        snap = jaxevents.memory_snapshot()
+        assert snap["live_buffer_bytes"] >= 0
+        peak = metrics.registry().gauge(
+            "pint_tpu_jax_live_buffer_bytes_peak").value()
+        assert peak >= snap["live_buffer_bytes"] or peak >= 0
+
+    def test_second_fit_compiles_nothing(self, fresh_telemetry):
+        """Recompile-regression guard (PR 1 cache-key fixes): a repeat
+        fit_toas() on a fitter — same-shape TOAs by construction — must
+        report ZERO new jit compilations through telemetry.jaxevents."""
+        from pint_tpu.telemetry import jaxevents
+
+        fresh_telemetry.activate("basic")
+        f = _tiny_wls_fitter()
+        with jaxevents.watch() as w1:
+            f.fit_toas(maxiter=2)
+        assert w1.delta.compiles > 0  # first fit really compiled
+        with jaxevents.watch() as w2:
+            f.fit_toas(maxiter=2)
+        assert w2.delta.compiles == 0, (
+            f"repeat fit recompiled {w2.delta.compiles} executables — a "
+            "cache key regressed (PR 1 guarantees executable reuse)")
+
+    @pytest.mark.slow
+    def test_gls_refit_reaches_zero_compiles(self, fresh_telemetry):
+        """GLS repeats reach a zero-compile fixed point: the second fit
+        may legitimately recompile a small sub-Jacobian (the expansion
+        point moved, so the linear/nonlinear column split is re-probed),
+        but once the parameters stop moving a further fit must compile
+        NOTHING.  A cache-key regression shows up as fresh compiles on
+        every repeat — the fixed point is never reached."""
+        from pint_tpu.telemetry import jaxevents
+
+        fresh_telemetry.activate("basic")
+        f = _tiny_gls_fitter()
+        deltas = []
+        for _ in range(4):
+            with jaxevents.watch() as w:
+                f.fit_toas(maxiter=2)
+            deltas.append(w.delta.compiles)
+        assert deltas[0] > 0          # first fit really compiled
+        assert deltas[-1] == 0, (
+            f"repeat GLS fits never stop compiling (deltas={deltas}) — "
+            "an executable cache key regressed")
+
+
+# ---------------------------------------------------------------------------
+# StageTimer shim over spans
+# ---------------------------------------------------------------------------
+
+class TestStageTimerShim:
+    def test_stage_rows_become_spans(self, fresh_telemetry):
+        from pint_tpu.profiling import StageTimer
+        from pint_tpu.telemetry import spans
+
+        fresh_telemetry.activate("basic")
+        st = StageTimer()
+        with fresh_telemetry.span("bench") as sp:
+            with st.stage("simulate"):
+                pass
+            st.mark("fit")
+        assert [c.name for c in sp.children] == ["stage.simulate",
+                                                 "stage.fit"]
+        # outside any span the rows land as roots
+        st2 = StageTimer()
+        st2.mark("solo")
+        assert spans.finished_roots()[-1].name == "stage.solo"
+
+    def test_table_format_unchanged(self, fresh_telemetry):
+        from pint_tpu.profiling import StageTimer
+
+        st = StageTimer()
+        st.rows = [("alpha", 1.0), ("beta", 3.0)]
+        out = st.table("unit")
+        assert out.splitlines()[0] == "--- unit ---"
+        assert out.splitlines()[1] == \
+            f"  {'alpha':<32s} {1.0:9.3f} s  {25.0:5.1f}%"
+        assert out.splitlines()[-1] == f"  {'TOTAL':<32s} {4.0:9.3f} s"
+
+
+# ---------------------------------------------------------------------------
+# run log + report CLI (the full-mode acceptance path)
+# ---------------------------------------------------------------------------
+
+class TestRunLogEndToEnd:
+    def _find(self, spans_list, name):
+        return [s for s in spans_list if s["name"] == name]
+
+    def test_wls_gls_grid_full_run(self, fresh_telemetry, tmp_path):
+        """Full mode: WLS fit + GLS fit + small grid_chisq produce a
+        valid manifest + JSONL stream; spans nest; the first fit's span
+        shows compiles > 0 and the repeat fit's shows 0; the report CLI
+        validates and renders it."""
+        from tools.telemetry_report import main as report_main
+
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.telemetry import runlog
+
+        fresh_telemetry.activate("full")
+        run_dir = str(tmp_path / "run")
+        runlog.start_run(run_dir, name="acceptance", probe_device=False)
+
+        fw = _tiny_wls_fitter()
+        fw.fit_toas(maxiter=2)
+        fw.fit_toas(maxiter=2)  # repeat: must compile nothing
+        fg = _tiny_gls_fitter()
+        fg.fit_toas(maxiter=2)
+        g0 = np.linspace(fg.model.F0.value - 1e-9,
+                         fg.model.F0.value + 1e-9, 3)
+        g1 = np.linspace(fg.model.F1.value - 1e-17,
+                         fg.model.F1.value + 1e-17, 3)
+        chi2, _ = grid_chisq(fg, ("F0", "F1"), (g0, g1), niter=1)
+        assert np.all(np.isfinite(chi2))
+        runlog.end_run()
+
+        # manifest identity
+        with open(os.path.join(run_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["schema"].startswith("pint_tpu.telemetry.manifest")
+        assert manifest["config"]["telemetry_mode"] == "full"
+        assert "jax" in manifest["packages"]
+
+        # event stream structure
+        records = [json.loads(ln) for ln in
+                   open(os.path.join(run_dir, "events.jsonl"))]
+        types = [r["type"] for r in records]
+        assert types[0] == "run_start" and types[-1] == "run_end"
+        assert "metrics" in types
+        span_bodies = [r["span"] for r in records if r["type"] == "span"]
+
+        wls = self._find(span_bodies, "wls.fit_toas")
+        assert len(wls) == 2
+        for body in wls:  # nested correctly: steps are children
+            steps = self._find(body.get("children", []), "wls.step")
+            assert len(steps) == 2
+            for st in steps:
+                assert st["parent_id"] == body["span_id"]
+        jax_ev = {e["name"]: e for e in wls[0].get("events", [])}
+        assert jax_ev["jax"]["compiles"] > 0  # first fit compiled
+        # repeat fit: the jax event is ALWAYS stamped so compiles=0 is
+        # an observable warm-cache signal, not an absent record
+        repeat_ev = [e for e in wls[1].get("events", [])
+                     if e["name"] == "jax"]
+        assert repeat_ev and repeat_ev[0]["compiles"] == 0
+
+        gls = self._find(span_bodies, "gls.fit_toas")
+        assert gls and self._find(gls[0]["children"], "gls.step")
+        assert any(e["name"] == "gls.solve" for e in gls[0]["events"])
+
+        grid = self._find(span_bodies, "grid_chisq")
+        assert grid
+        child_names = {c["name"] for c in grid[0].get("children", [])}
+        assert {"grid.build_fn", "grid.evaluate"} <= child_names
+        assert any(e["name"] == "grid.solve" for e in grid[0]["events"])
+
+        # the CLI validates and renders the same artifacts
+        assert report_main(["--check", run_dir]) == 0
+        assert report_main([run_dir]) == 0
+
+    def test_check_rejects_malformed_stream(self, fresh_telemetry,
+                                            tmp_path, capsys):
+        from tools.telemetry_report import main as report_main
+
+        from pint_tpu.telemetry import runlog
+
+        fresh_telemetry.activate("full")
+        run_dir = str(tmp_path / "bad")
+        run = runlog.start_run(run_dir, name="bad", probe_device=False)
+        with fresh_telemetry.span("ok"):
+            pass
+        runlog.end_run()
+        assert report_main(["--check", run_dir]) == 0
+        with open(os.path.join(run_dir, "events.jsonl"), "a") as f:
+            f.write('{"type": "span", "no_schema": true}\n')
+            f.write("not json at all\n")
+        assert report_main(["--check", run_dir]) == 1
+        err = capsys.readouterr().err
+        assert "not JSON" in err
+        assert run.path == run_dir
+
+    def test_non_finite_values_stay_strict_json(self, fresh_telemetry,
+                                                tmp_path):
+        """A solve event carrying condition=inf (singular system) must
+        not leak a bare Infinity token into events.jsonl — the stream is
+        strict JSON for non-Python consumers, and --check enforces it."""
+        from tools.telemetry_report import main as report_main
+
+        from pint_tpu.telemetry import runlog
+
+        fresh_telemetry.activate("full")
+        run_dir = str(tmp_path / "inf")
+        run = runlog.start_run(run_dir, name="inf", probe_device=False)
+        with fresh_telemetry.span("solve", cond=float("inf")) as sp:
+            sp.add_event("gls.solve", condition=float("inf"),
+                         resid=float("nan"))
+        run.record_event("loose", worst=float("-inf"))
+        runlog.end_run()
+        raw = open(os.path.join(run_dir, "events.jsonl")).read()
+        assert "Infinity" not in raw and "NaN" not in raw
+        assert report_main(["--check", run_dir]) == 0
+        # and the validator rejects a stream that DOES carry the tokens
+        with open(os.path.join(run_dir, "events.jsonl"), "a") as f:
+            f.write('{"schema": "pint_tpu.telemetry.event/1", "t": 1.0, '
+                    '"type": "event", '
+                    '"event": {"name": "bad", "v": Infinity}}\n')
+        assert report_main(["--check", run_dir]) == 1
+
+    def test_check_selftest_mode(self, fresh_telemetry):
+        """`--check` with no paths: the producer/schema self-test wired
+        into pre-commit."""
+        from tools.telemetry_report import main as report_main
+
+        assert report_main(["--check"]) == 0
+
+    def test_lazy_run_start_in_full_mode(self, fresh_telemetry, tmp_path,
+                                         monkeypatch):
+        """PINT_TPU_TELEMETRY=full with no explicit start_run: the first
+        finished root span starts a run under PINT_TPU_TELEMETRY_DIR."""
+        from pint_tpu.telemetry import runlog
+
+        monkeypatch.setenv("PINT_TPU_TELEMETRY_DIR", str(tmp_path))
+        fresh_telemetry.activate("full")
+        assert runlog.current_run() is None
+        with fresh_telemetry.span("auto"):
+            pass
+        run = runlog.current_run()
+        assert run is not None
+        assert run.path.startswith(str(tmp_path))
+        runlog.end_run()
+        records = [json.loads(ln) for ln in open(run.events_path)]
+        assert any(r["type"] == "span"
+                   and r["span"]["name"] == "auto" for r in records)
+
+    def test_start_run_requires_telemetry_on(self, fresh_telemetry,
+                                             tmp_path):
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.telemetry import runlog
+
+        with pytest.raises(UsageError):
+            runlog.start_run(str(tmp_path / "x"))
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff events from the checkpointed executor
+# ---------------------------------------------------------------------------
+
+class TestRetryEvents:
+    def test_retry_attempts_become_events(self, fresh_telemetry):
+        from pint_tpu.exceptions import DeviceLostError
+        from pint_tpu.runtime.checkpoint import RetryPolicy, with_retries
+        from pint_tpu.telemetry import metrics
+
+        fresh_telemetry.activate("basic")
+        calls = []
+
+        def flaky():
+            calls.append(None)
+            if len(calls) < 3:
+                raise DeviceLostError("synthetic loss")
+            return 42
+
+        with fresh_telemetry.span("sweep") as sp:
+            out = with_retries(flaky, RetryPolicy(max_retries=3,
+                                                  backoff_base=0.0),
+                               what="unit chunk")
+        assert out == 42
+        retries = [e for e in sp.events if e["name"] == "retry"]
+        assert len(retries) == 2
+        assert retries[0]["error"] == "DeviceLostError"
+        assert metrics.registry().counter(
+            "pint_tpu_retries_total").value({"what": "unit"}) == 2

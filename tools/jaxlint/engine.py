@@ -92,6 +92,14 @@ class FileInfo:
     #: name (``from jax import jit as jjit`` -> {"jjit": "jit"}) so
     #: aliased imports still classify as entry vs passthrough
     trace_names: Dict[str, str] = field(default_factory=dict)
+    #: bare names bound to pint_tpu.telemetry FUNCTIONS
+    #: (``from pint_tpu.telemetry import span as _span`` -> {"_span"}) —
+    #: host-side observability calls the host-call-in-jit rule must flag
+    #: inside traced code
+    telemetry_names: Set[str] = field(default_factory=set)
+    #: names bound to the telemetry package or its submodules
+    #: (``from pint_tpu import telemetry``, ``... import metrics as _m``)
+    telemetry_aliases: Set[str] = field(default_factory=set)
     traced_defs: List[TracedDef] = field(default_factory=list)
 
     def source_line(self, lineno: int) -> str:
@@ -140,6 +148,11 @@ def walk_own(fn_node: ast.AST) -> Iterable[ast.AST]:
 # file parsing: imports, traced-function discovery
 # ---------------------------------------------------------------------------
 
+#: pint_tpu.telemetry submodules whose import binds a module alias, not a
+#: function name (``from pint_tpu.telemetry import metrics``)
+_TELEMETRY_SUBMODULES = {"spans", "metrics", "jaxevents", "runlog"}
+
+
 def _record_imports(info: FileInfo) -> None:
     for node in ast.walk(info.tree):
         if isinstance(node, ast.Import):
@@ -147,6 +160,11 @@ def _record_imports(info: FileInfo) -> None:
                 bound = a.asname or a.name.split(".")[0]
                 if a.name == "numpy":
                     info.np_aliases.add(bound)
+                elif a.name.startswith("pint_tpu.telemetry") and a.asname:
+                    # `import pint_tpu.telemetry` without asname binds
+                    # `pint_tpu`; dotted calls through it are rare enough
+                    # to leave to the alias-less case
+                    info.telemetry_aliases.add(a.asname)
                 elif a.name == "jax.numpy":
                     if a.asname:
                         info.jnp_aliases.add(a.asname)
@@ -157,7 +175,20 @@ def _record_imports(info: FileInfo) -> None:
                 elif a.name == "jax" or a.name.startswith("jax."):
                     info.jax_aliases.add(bound)
         elif isinstance(node, ast.ImportFrom):
-            if node.module == "jax":
+            if node.module == "pint_tpu":
+                for a in node.names:
+                    if a.name == "telemetry":
+                        info.telemetry_aliases.add(a.asname or a.name)
+            elif node.module is not None \
+                    and node.module.startswith("pint_tpu.telemetry"):
+                for a in node.names:
+                    bound = a.asname or a.name
+                    if node.module == "pint_tpu.telemetry" \
+                            and a.name in _TELEMETRY_SUBMODULES:
+                        info.telemetry_aliases.add(bound)
+                    else:
+                        info.telemetry_names.add(bound)
+            elif node.module == "jax":
                 for a in node.names:
                     if a.name == "numpy":
                         info.jnp_aliases.add(a.asname or "numpy")

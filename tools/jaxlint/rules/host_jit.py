@@ -10,7 +10,12 @@ function, flag:
   non-literal values, and ``.item()`` / ``.tolist()`` /
   ``.block_until_ready()`` methods — all synchronous host pulls;
 * ``print()`` / ``open()`` / ``input()`` / ``breakpoint()`` — host I/O
-  that either traces once (misleading) or fails under jit.
+  that either traces once (misleading) or fails under jit;
+* ``pint_tpu.telemetry`` span/metric/event calls — the tracer, metrics
+  registry and run log are host-side (contextvars, locks, file I/O): a
+  span opened inside a jitted body times the TRACE, not the execution,
+  and fires once per compilation instead of once per call.  Instrument
+  the host caller around the jitted function instead.
 
 Use ``jnp.*`` / ``jax.debug.print`` / ``jax.debug.callback`` instead, or
 hoist the host work out of the traced function.
@@ -68,7 +73,18 @@ class HostCallInJitRule(Rule):
                 func = node.func
                 if isinstance(func, ast.Attribute):
                     root = func.value
-                    if isinstance(root, ast.Name) and root.id in info.np_aliases:
+                    leftmost = root
+                    while isinstance(leftmost, ast.Attribute):
+                        leftmost = leftmost.value
+                    if isinstance(leftmost, ast.Name) \
+                            and leftmost.id in info.telemetry_aliases:
+                        yield info.finding(
+                            self.name, node,
+                            f"telemetry call `{leftmost.id}...{func.attr}"
+                            "(...)` inside traced code: spans/metrics are "
+                            "host-side and fire once per TRACE, not per "
+                            "call; instrument the host caller instead")
+                    elif isinstance(root, ast.Name) and root.id in info.np_aliases:
                         yield info.finding(
                             self.name, node,
                             f"numpy call `{root.id}.{func.attr}(...)` inside "
@@ -82,7 +98,14 @@ class HostCallInJitRule(Rule):
                             "synchronous device->host pull; return the "
                             "array and coerce outside the trace")
                 elif isinstance(func, ast.Name):
-                    if func.id in _HOST_IO:
+                    if func.id in info.telemetry_names:
+                        yield info.finding(
+                            self.name, node,
+                            f"telemetry call `{func.id}(...)` inside "
+                            "traced code: spans/metrics are host-side and "
+                            "fire once per TRACE, not per call; instrument "
+                            "the host caller instead")
+                    elif func.id in _HOST_IO:
                         yield info.finding(
                             self.name, node,
                             f"`{func.id}(...)` inside traced code: host I/O "

@@ -4,7 +4,8 @@
 Ported from PR 2's ``tools/check_typed_raises.py`` into the jaxlint
 registry (the old CLI remains as a thin shim).  Coverage extends the
 original six modules with ``pint_tpu/io/__init__.py``,
-``pint_tpu/integrity/`` and ``pint_tpu/runtime/``.
+``pint_tpu/integrity/``, ``pint_tpu/runtime/`` and
+``pint_tpu/telemetry/``.
 
 Allowed raises:
 
@@ -42,6 +43,7 @@ DEFAULT_TARGETS = (
     "pint_tpu/grid.py",
     "pint_tpu/integrity/",
     "pint_tpu/runtime/",
+    "pint_tpu/telemetry/",
 )
 
 DISALLOWED = {

@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""Render / validate pint_tpu telemetry run logs.
+
+Usage::
+
+    python -m tools.telemetry_report RUN_DIR [RUN_DIR ...]
+    python -m tools.telemetry_report --check [RUN_DIR ...]
+
+Rendering prints, per run: the manifest summary (who/where/what), the
+span tree with durations, loose events, and the final metrics snapshot.
+
+``--check`` validates the on-disk schema (manifest.json +
+events.jsonl): every line must be one JSON object carrying the event
+schema tag, a known ``type``, its body key, and structurally sound span
+trees (child ``parent_id`` wired to the parent, non-negative
+durations).  With no paths, ``--check`` synthesizes a run through the
+live telemetry API into a temp dir and validates that — the pre-commit
+self-test that fails fast when the producers and this schema drift
+apart.
+
+Exit codes: 0 valid, 1 malformed/validation failure, 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/telemetry_report.py` spelling
+    sys.path.insert(0, REPO)
+
+from pint_tpu.telemetry.runlog import (  # noqa: E402
+    EVENT_SCHEMA,
+    EVENT_TYPES,
+    MANIFEST_SCHEMA,
+)
+
+REQUIRED_MANIFEST_KEYS = ("schema", "name", "created_unix", "packages",
+                          "config")
+
+
+def _err(errors: List[str], where: str, msg: str) -> None:
+    errors.append(f"{where}: {msg}")
+
+
+def _reject_constant(name: str):
+    raise ValueError(f"non-strict JSON constant {name!r} in event stream")
+
+
+def validate_span_dict(sp, where: str, errors: List[str],
+                       parent_id: Optional[int] = None) -> None:
+    if not isinstance(sp, dict):
+        _err(errors, where, f"span body is {type(sp).__name__}, not object")
+        return
+    if not isinstance(sp.get("name"), str) or not sp.get("name"):
+        _err(errors, where, "span missing non-empty 'name'")
+    if not isinstance(sp.get("span_id"), int):
+        _err(errors, where, "span missing integer 'span_id'")
+    dur = sp.get("duration_s")
+    if not isinstance(dur, (int, float)) or dur < 0:
+        _err(errors, where, f"span 'duration_s' invalid: {dur!r}")
+    if parent_id is None:
+        if "parent_id" in sp:
+            _err(errors, where, "root span must not carry 'parent_id'")
+    elif sp.get("parent_id") != parent_id:
+        _err(errors, where,
+             f"child parent_id {sp.get('parent_id')!r} != parent span_id "
+             f"{parent_id!r} (nesting broken)")
+    for ev in sp.get("events", []):
+        if not isinstance(ev, dict) or not isinstance(ev.get("name"), str):
+            _err(errors, where, f"span event malformed: {ev!r}")
+    for child in sp.get("children", []):
+        validate_span_dict(child, where, errors,
+                           parent_id=sp.get("span_id"))
+
+
+def validate_events_file(path: str, errors: List[str]) -> int:
+    """Validate one events.jsonl; returns the number of records read."""
+    n = 0
+    try:
+        fh = open(path, encoding="utf-8")
+    except OSError as e:
+        _err(errors, path, f"unreadable: {e}")
+        return 0
+    with fh:
+        for lineno, line in enumerate(fh, 1):
+            where = f"{path}:{lineno}"
+            line = line.strip()
+            if not line:
+                _err(errors, where, "blank line in append-only stream")
+                continue
+            try:
+                # reject the non-strict Infinity/NaN tokens Python's
+                # loads would otherwise accept: the stream contract is
+                # strict JSON (other-language ingesters choke on them)
+                rec = json.loads(line, parse_constant=_reject_constant)
+            except json.JSONDecodeError as e:
+                _err(errors, where, f"not JSON: {e}")
+                continue
+            except ValueError as e:
+                _err(errors, where, f"not strict JSON: {e}")
+                continue
+            n += 1
+            if not isinstance(rec, dict):
+                _err(errors, where, "record is not an object")
+                continue
+            if rec.get("schema") != EVENT_SCHEMA:
+                _err(errors, where,
+                     f"schema {rec.get('schema')!r} != {EVENT_SCHEMA!r}")
+            if not isinstance(rec.get("t"), (int, float)):
+                _err(errors, where, "missing numeric 't'")
+            type_ = rec.get("type")
+            if type_ not in EVENT_TYPES:
+                _err(errors, where, f"unknown type {type_!r} "
+                                    f"(known: {sorted(EVENT_TYPES)})")
+                continue
+            body_key = EVENT_TYPES[type_]
+            if body_key and body_key not in rec:
+                _err(errors, where, f"type {type_!r} missing body key "
+                                    f"{body_key!r}")
+                continue
+            if type_ == "span":
+                validate_span_dict(rec["span"], where, errors)
+            elif type_ == "event":
+                ev = rec["event"]
+                if not isinstance(ev, dict) \
+                        or not isinstance(ev.get("name"), str):
+                    _err(errors, where, f"event body malformed: {ev!r}")
+            elif type_ == "metrics":
+                if not isinstance(rec["metrics"], dict):
+                    _err(errors, where, "metrics body is not an object")
+    return n
+
+
+def validate_run_dir(path: str, errors: List[str]) -> int:
+    manifest_path = os.path.join(path, "manifest.json")
+    events_path = os.path.join(path, "events.jsonl")
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _err(errors, manifest_path, f"unreadable/invalid: {e}")
+        manifest = None
+    if manifest is not None:
+        if manifest.get("schema") != MANIFEST_SCHEMA:
+            _err(errors, manifest_path,
+                 f"schema {manifest.get('schema')!r} != {MANIFEST_SCHEMA!r}")
+        for k in REQUIRED_MANIFEST_KEYS:
+            if k not in manifest:
+                _err(errors, manifest_path, f"missing key {k!r}")
+    if not os.path.exists(events_path):
+        _err(errors, events_path, "missing")
+        return 0
+    return validate_events_file(events_path, errors)
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _render_span(sp: dict, indent: int = 0) -> List[str]:
+    pad = "  " * indent
+    attrs = sp.get("attrs") or {}
+    extras = ("  " + " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+              if attrs else "")
+    lines = [f"{pad}{sp.get('name', '?'):<{max(1, 40 - 2 * indent)}s} "
+             f"{sp.get('duration_s', 0.0):9.3f} s{extras}"]
+    for ev in sp.get("events", []):
+        kv = " ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                      if k not in ("name", "t"))
+        lines.append(f"{pad}  * {ev.get('name', '?')} @{ev.get('t', 0):.3f}s"
+                     f"{(' ' + kv) if kv else ''}")
+    for child in sp.get("children", []):
+        lines.extend(_render_span(child, indent + 1))
+    return lines
+
+
+def render_run(path: str, out=sys.stdout) -> None:
+    manifest_path = os.path.join(path, "manifest.json")
+    events_path = os.path.join(path, "events.jsonl")
+    with open(manifest_path, encoding="utf-8") as f:
+        m = json.load(f)
+    dev = m.get("device_profile") or {}
+    print(f"=== run {m.get('name')} @ {path} ===", file=out)
+    print(f"  created : {m.get('created_unix')}", file=out)
+    print(f"  git sha : {m.get('git_sha')}", file=out)
+    pkgs = ", ".join(f"{k}={v}" for k, v in (m.get("packages") or {}).items())
+    print(f"  packages: {pkgs}", file=out)
+    print(f"  config  : {m.get('config')}", file=out)
+    if dev:
+        print(f"  device  : {dev.get('platform')} ({dev.get('device_kind')}"
+              f", {dev.get('precision')})", file=out)
+    spans, events, metrics = [], [], None
+    with open(events_path, encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec["type"] == "span":
+                spans.append(rec["span"])
+            elif rec["type"] == "event":
+                events.append(rec["event"])
+            elif rec["type"] == "metrics":
+                metrics = rec["metrics"]  # last snapshot wins
+    if spans:
+        print("  --- spans ---", file=out)
+        for sp in spans:
+            for ln in _render_span(sp, indent=1):
+                print(ln, file=out)
+    if events:
+        print("  --- events ---", file=out)
+        for ev in events:
+            print(f"    {ev.get('name')}: {ev.get('attrs')}", file=out)
+    if metrics:
+        print("  --- metrics ---", file=out)
+        for name, body in sorted(metrics.items()):
+            if "value" in body:
+                print(f"    {name:<44s} {body['value']}", file=out)
+            elif "values" in body:
+                for lk, v in sorted(body["values"].items()):
+                    print(f"    {name}{lk:<20s} {v}", file=out)
+            else:
+                for lk, h in sorted(body.get("histogram", {}).items()):
+                    print(f"    {name}{lk} count={h['count']} "
+                          f"sum={h['sum']:.3f}", file=out)
+
+
+# ---------------------------------------------------------------------------
+# --check self-test
+# ---------------------------------------------------------------------------
+
+def self_test(errors: List[str]) -> int:
+    """Produce a run through the live API into a temp dir and validate it
+    — any producer/schema drift shows up here, before a real run does.
+
+    Deliberately side-effect-free on caller state: the RunLog is built
+    directly (never via ``start_run``, which would close a caller-owned
+    run), the root span is captured with a local sink, and only the mode
+    is toggled (to ``basic``, never through ``activate``/``deactivate``,
+    so the caller's jaxevents installation is untouched)."""
+    import tempfile
+
+    from pint_tpu import config, telemetry
+    from pint_tpu.telemetry import spans
+    from pint_tpu.telemetry.runlog import RunLog
+
+    prev_mode = config.telemetry_mode()
+    captured: List = []
+    sink = None
+    with tempfile.TemporaryDirectory(prefix="pint_tpu_telemetry_check_") \
+            as tmp:
+        try:
+            # 'basic' for the span block regardless of prev mode: under
+            # 'full' the global runlog sink would also copy the selftest
+            # span into a caller-owned run
+            config.set_telemetry_mode("basic")
+            sink = spans.add_span_sink(captured.append)
+            with telemetry.span("outer", kind="selftest") as sp:
+                sp.add_event("checkpoint", n=1)
+                with telemetry.span("inner"):
+                    telemetry.event("nested-event", ok=True)
+        finally:
+            if sink is not None:
+                spans.remove_span_sink(sink)
+            config.set_telemetry_mode(prev_mode)
+        run_dir = os.path.join(tmp, "selftest")
+        run = RunLog(run_dir, name="schema-selftest", probe_device=False)
+        for root in captured:
+            run.record_span(root)
+        run.record_event("loose", detail="outside any span")
+        run.close()
+        if not captured:
+            _err(errors, "selftest", "span tracer produced no root span")
+        n = validate_run_dir(run_dir, errors)
+        if n < 4:  # run_start, span, event, metrics, run_end
+            _err(errors, "selftest", f"expected >= 4 records, got {n}")
+        return n
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.telemetry_report",
+        description="Render or --check pint_tpu telemetry run directories")
+    ap.add_argument("runs", nargs="*", help="run directories "
+                    "(manifest.json + events.jsonl)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate schema instead of rendering; with no "
+                         "paths, runs the producer/schema self-test")
+    args = ap.parse_args(argv)
+
+    errors: List[str] = []
+    if args.check:
+        if args.runs:
+            for p in args.runs:
+                validate_run_dir(p, errors)
+        else:
+            self_test(errors)
+        if errors:
+            for e in errors:
+                print(f"telemetry-check: {e}", file=sys.stderr)
+            return 1
+        print("telemetry-check: OK")
+        return 0
+    if not args.runs:
+        ap.print_usage(sys.stderr)
+        print("telemetry_report: give at least one run directory "
+              "(or --check)", file=sys.stderr)
+        return 2
+    for p in args.runs:
+        validate_run_dir(p, errors)
+        if errors:
+            for e in errors:
+                print(f"telemetry-report: {e}", file=sys.stderr)
+            return 1
+        render_run(p)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
