@@ -204,6 +204,18 @@ def bench_b1855_gls():
     elapsed = time.time() - t0
     st.mark("grid 16x16 (256 GLS fits)")
 
+    # AOT cost attribution for the grid executable just measured.  The
+    # analysis lower/compile does NOT hit jit's dispatch cache — only
+    # the persistent compilation cache (enabled above for every backend,
+    # min_compile_time 1 s) keeps this from being a second full grid
+    # compile; it runs AFTER the timed region either way, with the
+    # jaxevents accounting paused so the telemetry block's compile
+    # counters describe the workload, not the analysis.  The result
+    # degrades to explicit nulls where the backend reports nothing.
+    from pint_tpu.telemetry import costs as _costs
+
+    cost = _costs.profile_grid(f).to_dict()
+
     imin = np.unravel_index(np.argmin(chi2), chi2.shape)
     # convergence-grade sanity, not just order-of-magnitude: the measured
     # grid-min-vs-fit gap is ~0.02 chi2 units (pure grid discretization);
@@ -225,6 +237,7 @@ def bench_b1855_gls():
         "imin": tuple(int(i) for i in imin),
         "ok": ok,
         "stages": st,
+        "cost": cost,
     }
 
 
@@ -490,10 +503,13 @@ def main():
         "requested_platform": requested,
         "device_profile": prof.to_dict(),
         "telemetry": telemetry_summary(stages=r["stages"]),
+        # normalized XLA cost/memory analysis of the grid executable
+        # (FLOPs, bytes accessed, HBM footprint; explicit nulls where the
+        # backend reports nothing) — what tools/perfwatch trends
+        "cost": r["cost"],
     }
     if not platform_ok:
         out["platform_mismatch"] = True
-    emit(out)
     print(r["stages"].table("B1855+09 9yv1 GLS (4005 TOAs)"), file=sys.stderr)
     print(
         f"# 256 GLS grid fits in {r['elapsed']:.3f}s on "
@@ -510,8 +526,10 @@ def main():
         except Exception as e:  # secondary metric must not kill the headline
             print(f"# secondary NGC6440E bench failed: {e}", file=sys.stderr)
     print(f"# total bench wall time {time.time() - t_all:.1f}s", file=sys.stderr)
-    # re-emit the headline as the FINAL stdout line: the driver tails output,
-    # and r03's number scrolled away behind secondary-bench/XLA chatter
+    # the headline is emitted EXACTLY ONCE, as the FINAL stdout line: the
+    # driver tails output (r03's number once scrolled away behind chatter),
+    # and a duplicate mid-run emit made every artifact's tail carry the
+    # line twice — one JSON line per run is the bench contract
     emit(out)
 
 

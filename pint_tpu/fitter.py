@@ -179,6 +179,22 @@ class Fitter:
         self.update_model(chi2)
         return chi2
 
+    def fit_step_executables(self) -> dict:
+        """``{name: (jitted fn, example args)}`` for the fit-step
+        executables at this fitter's current state — the model's compiled
+        phase evaluation (``fit.eval``) and its fit-parameter Jacobian
+        (``fit.jac``).  The AOT cost-attribution hook consumed by
+        :mod:`pint_tpu.telemetry.costs`: lowering at these args reuses
+        the executables the fit itself runs (same shapes, same cache)."""
+        model, toas = self.model, self.toas
+        free = tuple(model.free_params)
+        c = model._get_compiled(toas, free)
+        fns = model._cache["fns"][(free, len(toas))]
+        args = (model._free_values(free), model._const_pv(), c["batch"],
+                c["ctx"])
+        return {"fit.eval": (fns["eval"], args),
+                "fit.jac": (fns["jac_frac"], args)}
+
     def doctor(self, designmatrix: bool = True) -> str:
         """Human-readable audit of this fit's inputs and state: device
         profile, TOA quarantine report, model/TOA compatibility findings

@@ -233,6 +233,22 @@ def _try_schur_path(fitter, M, r, Nvec, phiinv, ntm, norm):
     return dpars, errs, covmat
 
 
+def _make_gls_cholesky_solve():
+    import jax
+
+    def solve(mtcm, mtcy):
+        L = jnp.linalg.cholesky(mtcm)
+        return jsl.cho_solve((L, True), mtcy)
+
+    return jax.jit(solve)
+
+
+#: ONE jitted Cholesky solve for cost attribution — per-call jit objects
+#: would recompile on every profile_gls_solve instead of hitting the
+#: executable cache
+_gls_cholesky_solve = _make_gls_cholesky_solve()
+
+
 class GLSFitter(Fitter):
     """One-shot GLS fitter (reference ``fitter.py:1939``)."""
 
@@ -302,6 +318,23 @@ class GLSFitter(Fitter):
             comp: dpars[ntm + off:ntm + off + size]
             for comp, (off, size) in self._noise_dims.items()
         }
+
+    def gls_solve_executable(self):
+        """(jitted solve fn, (mtcm, mtcy)) — the GLS normal-equation
+        Cholesky solve at this fitter's current system shapes, as one
+        jittable executable for AOT cost attribution
+        (:func:`pint_tpu.telemetry.costs.profile_gls_solve`).  This is
+        the device-side core of the solve ladder's first rung (plain
+        Cholesky + cho_solve); the hardened escalation around it is host
+        control flow and carries no analyzable executable of its own.
+        The jitted fn is the module-level :func:`_gls_cholesky_solve`
+        (shapes are traced arguments), so repeat profiling retraces into
+        the warm executable cache instead of compiling fresh."""
+        r = np.asarray(self.resids.time_resids)
+        M, params, norm, phiinv, Nvec, _ = build_augmented_system(
+            self.model, self.toas)
+        mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
+        return _gls_cholesky_solve, (jnp.asarray(mtcm), jnp.asarray(mtcy))
 
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
                  full_cov: bool = False, debug: bool = False,

@@ -331,12 +331,25 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
             in_axes=(0, None, None, None, None, None, None, None, None)))
     vfn = model._cache[grid_key]
 
+    _last_pts: list = []
+
     def fn(points):
         """(chi2 (P,), vfit (P, nfit), diag (P, 3)) — diag columns are
         (ladder rung, ridge applied, condition estimate) per point."""
+        _last_pts[:] = [points]
         return vfn(points, free_init, const_pv, batch, ctx, int0, w, F0,
                    Jbase)
 
+    def analysis_handle():
+        """(jitted fn, example args) of the executable the last call ran
+        — the AOT cost-attribution hook (telemetry.costs); None before
+        any evaluation."""
+        if not _last_pts:
+            return None
+        return vfn, (_last_pts[0], free_init, const_pv, batch, ctx, int0,
+                     w, F0, Jbase)
+
+    fn.analysis_handle = analysis_handle
     return fn, free_init, fit_params
 
 
@@ -646,7 +659,10 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     #: solves at _RIDGE * _ESCALATION[i])
     _ESCALATION = (1.0, 1e3, 1e6)
 
+    _last_blk: list = []
+
     def _eval_chunk(blk, scale):
+        _last_blk[:] = [blk]
         return vfn(blk, free_init, const_pv, batch, ctx, int0, w, F0,
                    B_base, A_base, Y_base, U_w, L_D, U_chi, cf_chi,
                    s_col, jnp.float64(scale))
@@ -717,6 +733,18 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         return (np.concatenate(out), np.concatenate(out_v),
                 np.concatenate(out_d))
 
+    def analysis_handle():
+        """(jitted fn, example args) of the chunk executable the last
+        call dispatched — sharded blocks keep their sharding, so cost
+        analysis sees the same per-device program the sweep ran; None
+        before any evaluation."""
+        if not _last_blk:
+            return None
+        return vfn, (_last_blk[0], free_init, const_pv, batch, ctx, int0,
+                     w, F0, B_base, A_base, Y_base, U_w, L_D, U_chi,
+                     cf_chi, s_col, jnp.float64(1.0))
+
+    fn.analysis_handle = analysis_handle
     return fn, free_init, fit_params
 
 
@@ -741,6 +769,58 @@ def _extraout(extraparnames, fit_params, grid_params, vfit, pts, model,
             col = np.full(len(vf), float(getattr(model, name).value or 0.0))
         out[name] = col.reshape(shape) if shape is not None else col
     return out
+
+
+def _attach_grid_executable(ftr, fn, model=None) -> None:
+    """Record the evaluated grid executable on the fitter
+    (``ftr.last_grid_executable`` = (jitted fn, example args)) for AOT
+    cost attribution, and — in full telemetry mode — analyze it once per
+    executable and stream the profile as span attrs + a ``cost_profile``
+    runlog record.  The analysis result is cached per executable on the
+    model so repeat sweeps (and the escalation ladder's re-runs) never
+    pay a second lower/compile.
+
+    The FIRST analysis is a real XLA compile (AOT ``lower().compile()``
+    does not consult jit's dispatch cache) with the jaxevents accounting
+    paused; on a TPU backend that costs ~the grid compile itself (~28 s
+    on the B1855 workload) unless a persistent compilation cache can
+    serve it, so the automatic full-mode analysis is SKIPPED on TPU
+    platforms without one — explicit ``costs.profile_grid(ftr)`` calls
+    (bench.py, which configures the cache) remain available."""
+    handle = getattr(fn, "analysis_handle", None)
+    got = handle() if handle is not None else None
+    if got is None:
+        return
+    ftr.last_grid_executable = got
+    from pint_tpu import config as _config
+
+    if _config._telemetry_mode != "full":
+        return
+    if jax.default_backend() in _TPU_PLATFORMS and not getattr(
+            jax.config, "jax_compilation_cache_dir", None):
+        from pint_tpu.logging import log
+
+        log.info("grid cost attribution skipped: TPU backend without a "
+                 "persistent compilation cache — the analysis compile "
+                 "would cost ~a full grid compile (call "
+                 "telemetry.costs.profile_grid(ftr) explicitly to pay it)")
+        return
+    try:
+        from pint_tpu.telemetry import costs as _costs
+
+        vfn = got[0]
+        cache = model._cache.setdefault("grid_cost_profiles", {}) \
+            if model is not None else {}
+        prof = cache.get(id(vfn))
+        if prof is None:
+            prof = _costs.analyze_jitted(vfn, *got[1], name="grid.chunk")
+            cache[id(vfn)] = prof
+        _costs.record_cost_profile(prof)
+    except Exception as e:  # attribution must never take the sweep down
+        from pint_tpu.logging import log
+
+        log.warning(f"grid cost attribution failed "
+                    f"({type(e).__name__}: {e}); sweep results unaffected")
 
 
 def _attach_grid_diagnostics(ftr, diag, shape=None):
@@ -881,6 +961,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                 "d2h", chi2.nbytes + vfit.nbytes + diag.nbytes, count=1)
             if _config._telemetry_mode == "full":
                 _jaxevents.memory_snapshot()
+        _attach_grid_executable(ftr, fn, model=model)
         _attach_grid_diagnostics(ftr, diag, shape=shape)
         extraout = _extraout(extraparnames, fit_params, parnames, vfit,
                              mesh_pts, model, shape=shape)
@@ -941,6 +1022,7 @@ def grid_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
         model, toas, tuple(parnames), niter=niter,
         grid_spans=_point_spans(model, parnames, pts))
     chi2, vfit, diag = fn(jnp.asarray(pts))
+    _attach_grid_executable(ftr, fn, model=model)
     _attach_grid_diagnostics(ftr, diag, shape=shape)
     out_grids = [g.reshape(shape) for g in mesh_arrays]
     extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
@@ -959,6 +1041,7 @@ def tuple_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
         model, toas, tuple(parnames), niter=niter,
         grid_spans=_point_spans(model, parnames, pts))
     chi2, vfit, diag = fn(jnp.asarray(pts))
+    _attach_grid_executable(ftr, fn, model=model)
     _attach_grid_diagnostics(ftr, diag)
     extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,
                          pts, model)
@@ -980,6 +1063,7 @@ def tuple_chisq_derived(ftr, parnames: Sequence[str], parfuncs: Sequence,
         model, toas, tuple(parnames), niter=niter,
         grid_spans=_point_spans(model, parnames, pts))
     chi2, vfit, diag = fn(jnp.asarray(pts))
+    _attach_grid_executable(ftr, fn, model=model)
     _attach_grid_diagnostics(ftr, diag)
     out_values = [raw[:, i] for i in range(raw.shape[1])]
     extraout = _extraout(extraparnames, fit_params, tuple(parnames), vfit,

@@ -145,8 +145,9 @@ class GlobalClockFile:
             try:
                 self.update()
             except NoClockCorrections as e:
-                log.warning(f"Clock file {self.filename} could not be "
-                            f"refreshed ({e}); using the loaded data")
+                _warn_once(self.filename, "refresh-failed",
+                           f"Clock file {self.filename} could not be "
+                           f"refreshed ({e}); using the loaded data")
         return self.clock_file.evaluate(mjd_arr, limits=limits)
 
 
@@ -181,7 +182,16 @@ class ClockFile:
             )
             if limits == "error":
                 raise ClockCorrectionOutOfRange(msg)
-            log.warning(msg)
+            if self.filename:
+                _warn_once(self.filename, "out-of-range", msg)
+            elif not getattr(self, "_warned_out_of_range", False):
+                # filename-less (programmatic) clock files dedup on a
+                # per-INSTANCE flag: a shared "<unnamed>" key would let
+                # the first such file swallow every other one's distinct
+                # diagnostic, and an id(self)-based key could be
+                # recycled onto a new instance after garbage collection
+                self._warned_out_of_range = True
+                log.warning(msg)
         return np.interp(mjd, self.mjd, self.clock_us) * 1e-6
 
     def last_correction_mjd(self) -> float:
@@ -360,6 +370,18 @@ _warned: set = set()
 _cache: dict = {}
 
 
+def _warn_once(filename: str, kind: str, message: str) -> None:
+    """One warning per (filename, kind) per process: clock diagnostics
+    repeat per TOA batch with VARYING text (different MJD ranges), so the
+    logging layer's exact-message dedup can't catch them and a bench tail
+    fills with the same missing-file story, drowning real diagnostics.
+    The first occurrence carries the detail; repeats are dropped here."""
+    key = (filename, kind)
+    if key not in _warned:
+        _warned.add(key)
+        log.warning(message)
+
+
 def _clock_search_paths() -> List[str]:
     paths = []
     for env in ("PINT_CLOCK_OVERRIDE", "PINT_CLOCK_DIR"):
@@ -405,7 +427,6 @@ def find_clock_file(name: str, fmt: str = "tempo", limits: str = "warn",
     _cache[key] = None
     if limits == "error":
         raise NoClockCorrections(f"Clock file {name} not found")
-    if name not in _warned:
-        _warned.add(name)
-        log.warning(f"Clock file {name} not found; assuming zero correction")
+    _warn_once(name, "missing",
+               f"Clock file {name} not found; assuming zero correction")
     return None

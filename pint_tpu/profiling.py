@@ -23,10 +23,13 @@ the clock), so the window between the two reads landed in no row.
 from __future__ import annotations
 
 import contextlib
+import glob
+import os
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["StageTimer", "device_trace", "profile_fit"]
+__all__ = ["StageTimer", "device_trace", "profile_fit", "TraceReport",
+           "summarize_trace"]
 
 
 class StageTimer:
@@ -93,29 +96,169 @@ class StageTimer:
         return "\n".join(lines)
 
 
+class TraceReport:
+    """Summary of a captured xplane trace directory: per-op self-time.
+
+    ``ops`` maps op/function name -> accumulated self-time seconds
+    (time inside the event minus time inside its nested children, so a
+    fused kernel's cost is attributed to the kernel, not double-counted
+    into its callers).  ``error`` carries why summarization degraded
+    (no parser available, no trace files) — the report never raises;
+    ``files`` always lists the captured ``.xplane.pb`` paths so the
+    TensorBoard/Perfetto pointer survives a failed parse."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self.files: List[str] = []
+        self.ops: Dict[str, float] = {}
+        self.planes: List[str] = []
+        self.error: Optional[str] = None
+
+    def collect(self) -> "TraceReport":
+        self.files = sorted(glob.glob(
+            os.path.join(self.logdir, "**", "*.xplane.pb"), recursive=True))
+        if not self.files:
+            self.error = f"no .xplane.pb files under {self.logdir}"
+            return self
+        try:
+            xplane_pb2 = _xplane_proto()
+        except ImportError as e:
+            self.error = (f"xplane parser unavailable ({e}); inspect "
+                          f"{self.logdir} with TensorBoard's profile plugin")
+            return self
+        for path in self.files:
+            try:
+                space = xplane_pb2.XSpace()
+                with open(path, "rb") as f:
+                    space.ParseFromString(f.read())
+            except Exception as e:
+                self.error = f"{path}: unparseable ({type(e).__name__}: {e})"
+                continue
+            device_planes = [p for p in space.planes
+                             if p.name.startswith("/device:")]
+            for plane in device_planes or space.planes:
+                if not plane.lines:
+                    continue
+                self.planes.append(plane.name)
+                for line in plane.lines:
+                    # the host plane's "python" line is the caller stack
+                    # trace, not op execution — megabytes of frames that
+                    # would drown the XLA module/op lines it sits beside
+                    if not device_planes and line.name == "python":
+                        continue
+                    self._accumulate_line(plane, line)
+        return self
+
+    def _accumulate_line(self, plane, line) -> None:
+        """Self-time per op within one timeline: events nest, so each
+        event's self-time is its duration minus its direct children's.
+        Sort key (start, -end): a child sharing its parent's start must
+        still process AFTER the (longer, enclosing) parent, or the
+        nesting inverts and self-times go negative."""
+        meta = plane.event_metadata
+        evs = sorted(((ev.offset_ps, -(ev.offset_ps + ev.duration_ps),
+                       ev.metadata_id) for ev in line.events))
+        evs = [(start, -neg_end, mid) for start, neg_end, mid in evs]
+        stack: List[list] = []  # [end_ps, metadata_id, self_ps]
+
+        def pop(upto_ps: Optional[int]) -> None:
+            while stack and (upto_ps is None or stack[-1][0] <= upto_ps):
+                end, mid, self_ps = stack.pop()
+                name = meta[mid].name if mid in meta else f"<op {mid}>"
+                self.ops[name] = self.ops.get(name, 0.0) + self_ps * 1e-12
+
+        for start, end, mid in evs:
+            pop(start)
+            if stack:
+                stack[-1][2] -= (end - start)  # child time is not self time
+            stack.append([end, mid, end - start])
+        pop(None)
+
+    def top(self, n: int = 10) -> List[Tuple[str, float]]:
+        return sorted(self.ops.items(), key=lambda t: -t[1])[:n]
+
+    def table(self, n: int = 10, title: str = "trace op self-time") -> str:
+        lines = [f"--- {title} ({self.logdir}) ---"]
+        if self.error:
+            lines.append(f"  [{self.error}]")
+        total = sum(self.ops.values()) or 1.0
+        for name, secs in self.top(n):
+            lines.append(f"  {name[:56]:<56s} {secs:9.6f} s "
+                         f"{100 * secs / total:5.1f}%")
+        return "\n".join(lines)
+
+    def to_dict(self, n: int = 10) -> dict:
+        """JSON-ready summary (the ``trace_summary`` telemetry event)."""
+        return {"logdir": self.logdir, "files": len(self.files),
+                "planes": self.planes, "error": self.error,
+                "top_ops": [{"op": name, "self_s": round(secs, 9)}
+                            for name, secs in self.top(n)]}
+
+
+def _xplane_proto():
+    """The xplane protobuf module, wherever this environment ships it
+    (tensorflow vendors tsl; standalone tsl and the profile plugin are
+    other known homes).  Raises ImportError when none resolve."""
+    errors = []
+    for mod in ("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                "tsl.profiler.protobuf.xplane_pb2",
+                "tensorboard_plugin_profile.protobuf.xplane_pb2"):
+        try:
+            import importlib
+
+            return importlib.import_module(mod)
+        except Exception as e:  # tf import errors are not only ImportError
+            errors.append(f"{mod}: {type(e).__name__}")
+    raise ImportError("; ".join(errors))
+
+
+def summarize_trace(logdir: str) -> TraceReport:
+    """Summarize an already-captured trace directory (top ops by
+    self-time); degrades to a file listing when no parser is available."""
+    return TraceReport(logdir).collect()
+
+
 @contextlib.contextmanager
-def device_trace(logdir: str):
-    """Capture a JAX device trace (XLA ops, HBM, fusion) under *logdir*;
-    inspect with TensorBoard's profile plugin or Perfetto."""
+def device_trace(logdir: str, summarize: bool = True):
+    """Capture a JAX device trace (XLA ops, HBM, fusion) under *logdir*.
+
+    Yields a :class:`TraceReport` that is populated after the block
+    exits (``report.ops`` / ``report.table()``); pass
+    ``summarize=False`` to keep the old point-at-the-directory behavior
+    (the report then only knows its logdir).  Full traces remain
+    inspectable with TensorBoard's profile plugin or Perfetto."""
     import jax
 
+    report = TraceReport(logdir)
     jax.profiler.start_trace(logdir)
     try:
-        yield
+        yield report
     finally:
         jax.profiler.stop_trace()
+        if summarize:
+            report.collect()
+            from pint_tpu import config
+
+            if config._telemetry_mode != "off":
+                from pint_tpu.telemetry import event as _tevent
+
+                _tevent("trace_summary", **{
+                    k: str(v) if isinstance(v, (list, dict)) else v
+                    for k, v in report.to_dict().items()})
 
 
 def profile_fit(fitter, maxiter: int = 2, trace_dir: Optional[str] = None):
     """Time the canonical fit phases (the reference harness' named stages:
     designmatrix / update resids / solve; ``profiling/README.txt:46-54``).
 
-    Returns (chi2, StageTimer).  With ``trace_dir`` the whole fit also runs
-    under the JAX profiler.
+    Returns (chi2, StageTimer).  With ``trace_dir`` the whole fit also
+    runs under the JAX profiler and the captured trace's top-op summary
+    lands on the timer as ``st.trace_report`` (a :class:`TraceReport`)
+    instead of just a directory pointer.
     """
     st = StageTimer()
     ctx = device_trace(trace_dir) if trace_dir else contextlib.nullcontext()
-    with ctx:
+    with ctx as report:
         with st.stage("validate"):
             fitter.model.validate()
         with st.stage("designmatrix (incl. compile)"):
@@ -124,4 +267,5 @@ def profile_fit(fitter, maxiter: int = 2, trace_dir: Optional[str] = None):
             fitter.update_resids()
         with st.stage(f"fit_toas(maxiter={maxiter})"):
             chi2 = fitter.fit_toas(maxiter=maxiter)
+    st.trace_report = report  # None without trace_dir
     return chi2, st

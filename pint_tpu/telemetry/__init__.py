@@ -10,7 +10,11 @@ Four parts (DESIGN.md "Observability & telemetry"):
 * :mod:`~pint_tpu.telemetry.jaxevents` — JAX compile/cache-hit,
   transfer and live-buffer accounting;
 * :mod:`~pint_tpu.telemetry.runlog` — per-run manifest + JSONL event
-  stream, rendered by ``python -m tools.telemetry_report``.
+  stream, rendered by ``python -m tools.telemetry_report``;
+* :mod:`~pint_tpu.telemetry.costs` — AOT cost attribution
+  (``cost_analysis``/``memory_analysis`` of the hot-path executables,
+  normalized per backend and per device; consumed by bench.py's
+  ``cost{...}`` block and ``python -m tools.perfwatch``).
 
 Gating: :func:`pint_tpu.config.telemetry_mode` (``PINT_TPU_TELEMETRY`` =
 ``off`` | ``basic`` | ``full``).  ``off`` keeps every instrumented call
@@ -27,7 +31,7 @@ from __future__ import annotations
 from typing import Optional
 
 from pint_tpu import config
-from pint_tpu.telemetry import jaxevents, metrics, runlog, spans
+from pint_tpu.telemetry import costs, jaxevents, metrics, runlog, spans
 from pint_tpu.telemetry.spans import (
     current_span,
     event,
@@ -37,7 +41,7 @@ from pint_tpu.telemetry.spans import (
 
 __all__ = ["span", "event", "set_attr", "current_span", "mode", "enabled",
            "activate", "deactivate", "spans", "metrics", "jaxevents",
-           "runlog"]
+           "runlog", "costs"]
 
 
 def mode() -> str:

@@ -1,0 +1,385 @@
+"""AOT cost attribution: XLA cost_analysis / memory_analysis, normalized.
+
+The telemetry layer (PR 4) counts *how many* compiles a workload paid
+and *how long* they took; this module answers *what the compiled
+executable costs to run*: FLOPs, bytes accessed, and the executable's
+HBM footprint (argument/output/temp/generated-code bytes), per device.
+The mechanism is JAX's ahead-of-time analysis chain::
+
+    jax.jit(f).lower(*args).compile().cost_analysis()   # XLA HLO cost model
+                                     .memory_analysis() # buffer assignment
+
+Backends disagree about what they report (CPU returns a one-element list
+of op-level dicts, TPU a flat dict, some backends ``None``), so
+:func:`normalize_cost_analysis` / :func:`normalize_memory_analysis` fold
+every shape into one :class:`CostProfile` whose fields are floats **or
+``None``** — an absent number stays an explicit null all the way into
+the bench artifact, never a fabricated zero.  Nothing here may raise
+into the fit path: every entry point degrades to an empty-but-schema-
+valid profile carrying the error string (tests/test_costs.py pins this).
+
+SPMD note: on a sharded executable XLA reports the cost of the
+*per-device program* (every device runs the same partitioned program on
+its shard), so ``per_device`` maps each participating device id to that
+program cost and the headline numbers stay per-program.  The multichip
+dryrun and ``MULTICHIP_*.json`` consume exactly this shape.
+
+Everything in this module is HOST-side analysis of already-built
+executables — calling it inside a traced function is flagged by
+jaxlint's host-call-in-jit rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["CostProfile", "COST_PROFILE_SCHEMA", "normalize_cost_analysis",
+           "normalize_memory_analysis", "analyze_compiled", "analyze_jitted",
+           "record_cost_profile", "profile_grid", "profile_fit_step",
+           "profile_gls_solve", "profile_workload"]
+
+COST_PROFILE_SCHEMA = "pint_tpu.telemetry.cost_profile/1"
+
+#: XLA cost-analysis keys -> CostProfile field names.  Suffixed per-operand
+#: keys ("bytes accessed0{}", "utilization1{}") are backend noise and are
+#: deliberately dropped — only whole-program numbers survive normalization.
+_COST_KEYS = {
+    "flops": "flops",
+    "transcendentals": "transcendentals",
+    "bytes accessed": "bytes_accessed",
+    "optimal_seconds": "optimal_seconds",
+}
+
+#: CompiledMemoryStats attributes -> CostProfile field names (bytes).
+_MEMORY_KEYS = {
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "temp_size_in_bytes": "temp_bytes",
+    "alias_size_in_bytes": "alias_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+    "host_temp_size_in_bytes": "host_temp_bytes",
+}
+
+#: the flat numeric fields a serialized profile always carries (None when
+#: the backend reported nothing) — the schema tests/test_costs.py pins
+NUMERIC_FIELDS = tuple(_COST_KEYS.values()) + tuple(_MEMORY_KEYS.values())  # jaxlint: disable=static-args -- module-literal dicts: insertion order is source order, not a cache key
+
+
+@dataclass
+class CostProfile:
+    """Normalized per-executable cost numbers; ``None`` = not reported."""
+
+    name: str
+    backend: Optional[str] = None
+    flops: Optional[float] = None
+    transcendentals: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    optimal_seconds: Optional[float] = None
+    argument_bytes: Optional[int] = None
+    output_bytes: Optional[int] = None
+    temp_bytes: Optional[int] = None
+    alias_bytes: Optional[int] = None
+    generated_code_bytes: Optional[int] = None
+    host_temp_bytes: Optional[int] = None
+    num_devices: int = 1
+    #: device id -> per-device-program cost dict (SPMD: one program per
+    #: device; empty when the device set is unknown)
+    per_device: Dict[str, dict] = field(default_factory=dict)
+    #: why analysis came back empty (the degrade-don't-raise contract)
+    error: Optional[str] = None
+
+    @property
+    def peak_bytes(self) -> Optional[int]:
+        """Executable HBM footprint proxy: arguments + outputs + temps
+        (what buffer assignment pins while the program runs)."""
+        parts = [self.argument_bytes, self.output_bytes, self.temp_bytes]
+        if all(p is None for p in parts):
+            return None
+        return sum(int(p) for p in parts if p is not None)
+
+    def to_dict(self) -> dict:
+        """JSON-ready body of a ``cost_profile`` runlog event (and the
+        bench artifact's ``cost`` block): every NUMERIC_FIELDS key is
+        present, explicitly null when unreported."""
+        d: Dict[str, Any] = {"schema": COST_PROFILE_SCHEMA,
+                             "name": self.name, "backend": self.backend,
+                             "num_devices": self.num_devices}
+        for f in NUMERIC_FIELDS:
+            d[f] = getattr(self, f)
+        d["peak_bytes"] = self.peak_bytes
+        if self.per_device:
+            d["per_device"] = self.per_device
+        if self.error:
+            d["error"] = self.error
+        return d
+
+    def span_attrs(self) -> dict:
+        """The compact form stamped onto a span (``cost.<field>``)."""
+        out = {}
+        for f in ("flops", "bytes_accessed", "temp_bytes"):
+            v = getattr(self, f)
+            if v is not None:
+                out[f"cost.{f}"] = v
+        if self.peak_bytes is not None:
+            out["cost.peak_bytes"] = self.peak_bytes
+        return out
+
+
+def normalize_cost_analysis(raw) -> dict:
+    """Fold any backend's ``cost_analysis()`` return into
+    ``{field: float|None}`` over the cost half of NUMERIC_FIELDS.
+
+    Accepts ``None`` (backend reports nothing), a flat dict, or a list of
+    dicts (CPU wraps in a one-element list; some older jax versions
+    return one dict per device, which are summed — the per-device split
+    is preserved separately by :func:`analyze_compiled`)."""
+    out: Dict[str, Optional[float]] = {v: None for v in _COST_KEYS.values()}
+    if raw is None:
+        return out
+    dicts = raw if isinstance(raw, (list, tuple)) else [raw]
+    for d in dicts:
+        if not isinstance(d, dict):
+            continue
+        for key, fieldname in _COST_KEYS.items():
+            v = d.get(key)
+            if v is None:
+                continue
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            if v < 0:
+                # backend sentinel (CPU reports optimal_seconds=-4):
+                # costs are nonnegative by definition, so a negative
+                # value means "not reported", not a number to propagate
+                continue
+            out[fieldname] = v if out[fieldname] is None \
+                else out[fieldname] + v
+    return out
+
+
+def normalize_memory_analysis(raw) -> dict:
+    """Fold ``memory_analysis()`` (a ``CompiledMemoryStats`` object, a
+    per-device list of them, or ``None``) into ``{field: int|None}``."""
+    out: Dict[str, Optional[int]] = {v: None for v in _MEMORY_KEYS.values()}
+    if raw is None:
+        return out
+    stats = raw if isinstance(raw, (list, tuple)) else [raw]
+    for st in stats:
+        for attr, fieldname in _MEMORY_KEYS.items():
+            v = getattr(st, attr, None)
+            if v is None:
+                continue
+            try:
+                v = int(v)
+            except (TypeError, ValueError):
+                continue
+            out[fieldname] = v if out[fieldname] is None \
+                else out[fieldname] + v
+    return out
+
+
+def _device_list(compiled) -> list:
+    """Devices the executable is loaded on (best effort, [] unknown)."""
+    try:
+        return list(compiled.runtime_executable().local_devices())
+    except Exception:
+        return []
+
+
+def analyze_compiled(compiled, name: str) -> CostProfile:
+    """CostProfile of an already-compiled ``jax.stages.Compiled``.
+
+    Never raises: any backend refusal lands in ``profile.error`` with
+    every numeric field left null."""
+    prof = CostProfile(name=name)
+    try:
+        raw_cost = compiled.cost_analysis()
+    except Exception as e:
+        raw_cost = None
+        prof.error = f"cost_analysis: {type(e).__name__}: {e}"
+    try:
+        raw_mem = compiled.memory_analysis()
+    except Exception as e:
+        raw_mem = None
+        err = f"memory_analysis: {type(e).__name__}: {e}"
+        prof.error = f"{prof.error}; {err}" if prof.error else err
+    for k, v in normalize_cost_analysis(raw_cost).items():
+        setattr(prof, k, v)
+    for k, v in normalize_memory_analysis(raw_mem).items():
+        setattr(prof, k, v)
+    devices = _device_list(compiled)
+    if devices:
+        prof.num_devices = len(devices)
+        prof.backend = getattr(devices[0], "platform", None)
+        if len(devices) > 1:
+            if isinstance(raw_cost, (list, tuple)) \
+                    and len(raw_cost) == len(devices):
+                # genuinely per-device analysis entries (older jax):
+                # zip them with the devices; the headline fields above
+                # are then the device SUM, not per-program
+                prof.per_device = {
+                    str(d.id): normalize_cost_analysis(entry)
+                    for d, entry in zip(devices, raw_cost)}
+            else:
+                # SPMD single-program analysis: every device runs the
+                # same partitioned program, so the reported cost IS each
+                # device's cost — stamp it per participating device
+                # without fabricating a split
+                per_prog = {k: getattr(prof, k) for k in NUMERIC_FIELDS}
+                prof.per_device = {str(d.id): dict(per_prog)
+                                   for d in devices}
+    if prof.backend is None:
+        try:
+            import jax
+
+            prof.backend = jax.default_backend()
+        except Exception:
+            pass
+    return prof
+
+
+#: memoized analyses keyed by (fn identity, arg shapes/dtypes/shardings).
+#: AOT ``.lower().compile()`` does NOT consult jit's dispatch cache, so
+#: without this a repeat analysis would recompile the executable from
+#: scratch (28 s for the TPU grid chunk).  Values keep a strong ref to
+#: fn so an id() cannot be recycled while its entry lives; bounded FIFO.
+_ANALYSIS_CACHE: Dict[tuple, Tuple[Any, CostProfile]] = {}
+_ANALYSIS_CACHE_MAX = 64
+
+
+def _analysis_key(fn, args, kwargs) -> Optional[tuple]:
+    try:
+        import jax
+
+        def leaf_sig(leaf):
+            return (getattr(leaf, "shape", None),
+                    str(getattr(leaf, "dtype", type(leaf).__name__)),
+                    str(getattr(leaf, "sharding", None)))
+
+        # kwargs participate by VALUE leaves too — keying on names alone
+        # would alias calls that differ only in a kwarg's shape
+        return (id(fn),
+                tuple(leaf_sig(x) for x in
+                      jax.tree_util.tree_leaves((args, kwargs))))
+    except Exception:
+        return None
+
+
+def analyze_jitted(fn, *args, name: str = "jitted", **kwargs) -> CostProfile:
+    """Lower + compile ``fn`` (a ``jax.jit`` callable) at ``args`` and
+    analyze the executable.  Results are memoized per (fn, arg
+    shapes/dtypes/shardings): the AOT ``.lower().compile()`` path does
+    NOT consult jit's dispatch cache (measured: a warm jit still fires a
+    fresh backend_compile), so a repeat analysis would otherwise pay a
+    full recompile; only a configured persistent compilation cache can
+    serve the first one.  The deliberate analysis compile runs with the
+    jaxevents accounting paused so it never skews the workload compile
+    counters it exists to contextualize.  Degrades to an empty profile
+    carrying the error string — never raises."""
+    import dataclasses
+
+    key = _analysis_key(fn, args, kwargs)
+    if key is not None and key in _ANALYSIS_CACHE:
+        # re-stamp the caller's label: the cached payload may have been
+        # produced under a different name for the same executable
+        return dataclasses.replace(_ANALYSIS_CACHE[key][1], name=name)
+    from pint_tpu.telemetry import jaxevents
+
+    try:
+        with jaxevents.accounting_paused():
+            compiled = fn.lower(*args, **kwargs).compile()
+    except Exception as e:
+        return CostProfile(name=name,
+                           error=f"lower/compile: {type(e).__name__}: {e}")
+    prof = analyze_compiled(compiled, name)
+    if key is not None:
+        while len(_ANALYSIS_CACHE) >= _ANALYSIS_CACHE_MAX:
+            _ANALYSIS_CACHE.pop(next(iter(_ANALYSIS_CACHE)))
+        _ANALYSIS_CACHE[key] = (fn, prof)
+    return prof
+
+
+def record_cost_profile(prof: CostProfile) -> CostProfile:
+    """Land a profile in the telemetry streams: span attrs + a
+    ``cost_profile`` event on the current span, and (full mode, run
+    open) a ``cost_profile`` record in the run log.  No-op when
+    telemetry is off; returns the profile either way."""
+    from pint_tpu import config
+
+    if config._telemetry_mode == "off":
+        return prof
+    from pint_tpu.telemetry import runlog, spans
+
+    sp = spans.current_span()
+    if sp is not None:
+        sp.attrs.update(prof.span_attrs())
+        # "name" would collide with the event's own name slot
+        sp.add_event("cost_profile", **{
+            ("executable" if k == "name" else k): v
+            for k, v in prof.to_dict().items()
+            if k not in ("per_device", "schema")})
+    run = runlog.current_run()
+    if run is not None:
+        run.record_cost_profile(prof.to_dict())
+    return prof
+
+
+# ---------------------------------------------------------------------------
+# workload-level conveniences (the executables the ROADMAP hot path runs)
+# ---------------------------------------------------------------------------
+
+def profile_grid(ftr) -> CostProfile:
+    """Cost profile of the most recent grid executable evaluated through
+    ``ftr`` (``grid_chisq`` records the handle).  Empty profile with an
+    error string when no grid ran yet."""
+    handle = getattr(ftr, "last_grid_executable", None)
+    if handle is None:
+        return CostProfile(name="grid.chunk",
+                           error="no grid executable recorded on this "
+                                 "fitter (run grid_chisq first)")
+    vfn, args = handle
+    return analyze_jitted(vfn, *args, name="grid.chunk")
+
+
+def profile_fit_step(ftr) -> Dict[str, CostProfile]:
+    """Cost profiles of the fit-step executables (the model's compiled
+    phase evaluation and its fit-parameter Jacobian) at the fitter's
+    current state.  Keys: ``fit.eval``, ``fit.jac``."""
+    try:
+        handles = ftr.fit_step_executables()
+    except Exception as e:
+        err = f"fit-step executables unavailable: {type(e).__name__}: {e}"
+        return {"fit.eval": CostProfile(name="fit.eval", error=err),
+                "fit.jac": CostProfile(name="fit.jac", error=err)}
+    return {name: analyze_jitted(fn, *args, name=name)
+            for name, (fn, args) in handles.items()}
+
+
+def profile_gls_solve(ftr) -> CostProfile:
+    """Cost profile of a jitted GLS normal-equation solve at this
+    fitter's system shapes (the Woodbury-form Cholesky solve the grid
+    kernel and the host solve ladder both execute)."""
+    try:
+        fn, args = ftr.gls_solve_executable()
+    except Exception as e:
+        return CostProfile(
+            name="gls.solve",
+            error=f"gls solve executable unavailable: "
+                  f"{type(e).__name__}: {e}")
+    return analyze_jitted(fn, *args, name="gls.solve")
+
+
+def profile_workload(ftr) -> Dict[str, dict]:
+    """One serialized profile per hot-path executable this fitter can
+    expose (fit step, GLS solve, last grid chunk) — each value a
+    :meth:`CostProfile.to_dict`, schema-valid even when everything
+    degraded."""
+    out: Dict[str, dict] = {}
+    for name, prof in profile_fit_step(ftr).items():
+        out[name] = prof.to_dict()
+    if hasattr(ftr, "gls_solve_executable"):
+        out["gls.solve"] = profile_gls_solve(ftr).to_dict()
+    out["grid.chunk"] = profile_grid(ftr).to_dict()
+    return out

@@ -42,7 +42,8 @@ from pint_tpu.telemetry import metrics
 
 __all__ = ["install", "uninstall", "installed", "counts", "JaxEventCounts",
            "watch", "CompileWatch", "record_transfer", "jitted_cache_size",
-           "live_buffer_bytes", "memory_snapshot", "MONITORING_AVAILABLE"]
+           "live_buffer_bytes", "memory_snapshot", "MONITORING_AVAILABLE",
+           "accounting_paused"]
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
@@ -162,6 +163,25 @@ def uninstall() -> None:
 
 def installed() -> bool:
     return _installed and _active
+
+
+class accounting_paused:
+    """``with accounting_paused():`` — temporarily deafen the compile/
+    transfer accounting without uninstalling.  Used by the AOT cost
+    attribution (:mod:`pint_tpu.telemetry.costs`): its deliberate
+    lower/compile must not skew the workload compile counters it exists
+    to contextualize.  Restores the previous active state on exit."""
+
+    def __enter__(self):
+        global _active
+        self._was_active = _active
+        _active = False
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        _active = self._was_active
+        return False
 
 
 @dataclass(frozen=True)

@@ -44,6 +44,7 @@ MANIFEST_SCHEMA = "pint_tpu.telemetry.manifest/1"
 EVENT_SCHEMA = "pint_tpu.telemetry.event/1"
 #: event type -> required body key (None: no body beyond type/t)
 EVENT_TYPES = {"span": "span", "event": "event", "metrics": "metrics",
+               "cost_profile": "cost_profile",
                "run_start": "run", "run_end": "run"}
 
 #: environment knobs worth snapshotting into the manifest
@@ -198,6 +199,11 @@ class RunLog:
     def record_event(self, name: str, **attrs) -> None:
         """Append a loose (span-less) event."""
         self._write("event", event={"name": name, "attrs": attrs})
+
+    def record_cost_profile(self, profile: dict) -> None:
+        """Append one AOT cost-attribution record
+        (:meth:`pint_tpu.telemetry.costs.CostProfile.to_dict`)."""
+        self._write("cost_profile", cost_profile=profile)
 
     def record_metrics(self) -> None:
         """Append a snapshot of the process metrics registry."""

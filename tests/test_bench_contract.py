@@ -1,0 +1,104 @@
+"""bench.py output contract (PR 5 acceptance pin).
+
+The bench must print EXACTLY ONE JSON line on stdout (the driver tails
+output; a duplicate mid-run emit doubled every artifact's tail), with
+human-readable stage chatter on stderr only, and the headline must
+carry a ``cost`` block with FLOPs/bytes fields (or explicit nulls) for
+the grid executable.  The real B1855 datafiles are not present in the
+test image, so the headline workload is pointed at a synthetic
+DD-binary + correlated-noise stand-in with the same structure (M2/SINI
+grid over a GLS model).
+"""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.perfwatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+TINY_GLS_PAR = """\
+PSR BENCHTINY
+RAJ 04:37:15.0
+DECJ -47:15:09.0
+F0 173.6879 1
+F1 -1.7e-15 1
+PEPOCH 55000
+DM 2.64 1
+BINARY DD
+PB 5.7410
+A1 3.3667
+T0 55000.0
+OM 1.35
+ECC 1.9e-5
+M2 0.3 1
+SINI 0.95 1
+EFAC mjd 50000 60000 1.1
+ECORR mjd 50000 60000 0.5
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 5
+UNITS TDB
+"""
+
+
+@pytest.fixture
+def tiny_headline_files(tmp_path):
+    par = tmp_path / "tiny.par"
+    par.write_text(TINY_GLS_PAR)
+    mjds = np.linspace(54000, 56000, 40)
+    lines = ["FORMAT 1\n"]
+    # two frequencies so DM is constrained; 0.1 us errors so the
+    # Shapiro-range M2/SINI pair is measurable at this TOA count
+    for i, m in enumerate(mjds):
+        lines.append(f"fakeA{i} 1400.0 {m:.13f} 0.1 gbt\n")
+        lines.append(f"fakeB{i} 2300.0 {m + 0.01:.13f} 0.1 gbt\n")
+    tim = tmp_path / "tiny.tim"
+    tim.write_text("".join(lines))
+    return str(par), str(tim)
+
+
+def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
+                                    capsys):
+    import bench
+
+    par, tim = tiny_headline_files
+    monkeypatch.setattr(bench, "B1855_PAR", par)
+    monkeypatch.setattr(bench, "B1855_TIM", tim)
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    monkeypatch.setenv("BENCH_SKIP_SECONDARY", "1")
+    monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
+    monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
+    try:
+        bench.main()
+    finally:
+        from pint_tpu import telemetry
+
+        telemetry.deactivate()
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    # EXACTLY one stdout line, and it is the headline JSON
+    assert len(lines) == 1, f"stdout must be one JSON line, got: {lines}"
+    headline = json.loads(lines[0])
+    assert headline["metric"] == "gls_chisq_grid_evals_per_sec"
+    assert headline["value"] > 0
+    # the cost block: FLOPs/bytes fields present (numbers or explicit
+    # nulls — never absent) for the grid executable
+    cost = headline["cost"]
+    assert cost["name"] == "grid.chunk"
+    for key in ("flops", "bytes_accessed", "temp_bytes", "peak_bytes",
+                "argument_bytes", "output_bytes"):
+        assert key in cost
+        assert cost[key] is None or isinstance(cost[key], (int, float))
+    # on the CPU backend the analysis genuinely reports numbers
+    assert cost["flops"] and cost["bytes_accessed"]
+    # the telemetry block rode along as before
+    assert headline["telemetry"]["jax"]["compiles"] > 0
+    json.dumps(headline)

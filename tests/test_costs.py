@@ -1,0 +1,434 @@
+"""AOT cost attribution under test (pint_tpu/telemetry/costs.py).
+
+The contract tier-1 (CPU) pins: whatever a backend reports — op-level
+dict lists, flat dicts, ``None``, exceptions — the cost module produces
+a schema-valid profile whose absent numbers are explicit nulls, and it
+NEVER raises into the fit path.  Plus the end-to-end wiring: grid_chisq
+records the executable handle, full mode streams ``cost_profile``
+records the report CLI validates, and the profiling trace summary
+degrades gracefully.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.perfwatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+@pytest.fixture
+def fresh_telemetry():
+    from pint_tpu import telemetry
+    from pint_tpu.telemetry import metrics, runlog, spans
+
+    telemetry.deactivate()
+    metrics.reset_registry()
+    spans.clear_finished()
+    yield telemetry
+    runlog.end_run()
+    telemetry.deactivate()
+    metrics.reset_registry()
+    spans.clear_finished()
+
+
+def _tiny_gls_fitter(seed=3):
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ["PSR TSTCOST\n", "RAJ 05:00:00 1\n", "DECJ 15:00:00 1\n",
+           "F0 99.123456789 1\n", "F1 -1.1e-14 1\n", "PEPOCH 55500\n",
+           "DM 12.5 1\n",
+           "EFAC mjd 53000 58000 1.1\n",
+           "EQUAD mjd 53000 58000 0.5\n",
+           "ECORR mjd 53000 58000 0.8\n",
+           "TNRedAmp -13.5\n", "TNRedGam 3.5\n", "TNRedC 10\n",
+           "UNITS TDB\n"]
+    model = get_model(par)
+    rng = np.random.default_rng(seed)
+    base = np.linspace(55000, 56000, 20)
+    mjds = np.sort(np.concatenate([base, base + 0.5 / 86400.0]))
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=1.0,
+                                   add_noise=True, rng=rng)
+    return GLSFitter(toas, model)
+
+
+# ---------------------------------------------------------------------------
+# normalization: every backend shape folds into the one schema
+# ---------------------------------------------------------------------------
+
+class TestNormalization:
+    def test_none_is_all_nulls(self):
+        from pint_tpu.telemetry.costs import (normalize_cost_analysis,
+                                              normalize_memory_analysis)
+
+        c = normalize_cost_analysis(None)
+        assert c["flops"] is None and c["bytes_accessed"] is None
+        m = normalize_memory_analysis(None)
+        assert m["temp_bytes"] is None and m["argument_bytes"] is None
+
+    def test_dict_and_list_shapes(self):
+        from pint_tpu.telemetry.costs import normalize_cost_analysis
+
+        flat = normalize_cost_analysis({"flops": 10.0, "bytes accessed": 4})
+        assert flat["flops"] == 10.0 and flat["bytes_accessed"] == 4.0
+        # CPU wraps in a list; multiple entries sum
+        listed = normalize_cost_analysis([{"flops": 10.0}, {"flops": 5.0}])
+        assert listed["flops"] == 15.0
+        # per-operand suffixed keys are backend noise, dropped
+        noisy = normalize_cost_analysis({"flops": 1.0,
+                                         "bytes accessed0{}": 99.0,
+                                         "utilization1{}": 3.0})
+        assert noisy["flops"] == 1.0 and noisy["bytes_accessed"] is None
+
+    def test_negative_sentinels_are_nulls(self):
+        """CPU reports optimal_seconds=-4: costs are nonnegative by
+        definition, so sentinels normalize to null, never propagate."""
+        from pint_tpu.telemetry.costs import normalize_cost_analysis
+
+        c = normalize_cost_analysis({"optimal_seconds": -4.0, "flops": 2.0})
+        assert c["optimal_seconds"] is None and c["flops"] == 2.0
+
+    def test_garbage_values_skipped(self):
+        from pint_tpu.telemetry.costs import normalize_cost_analysis
+
+        c = normalize_cost_analysis([{"flops": "not-a-number"}, 42, None])
+        assert c["flops"] is None
+
+    def test_profile_schema_always_complete(self):
+        """to_dict() carries EVERY numeric field — the schema the runlog
+        validator and the bench cost{} block rely on — even for a fully
+        degraded profile."""
+        from pint_tpu.telemetry.costs import NUMERIC_FIELDS, CostProfile
+
+        d = CostProfile(name="empty", error="synthetic").to_dict()
+        for f in NUMERIC_FIELDS:
+            assert f in d and d[f] is None
+        assert d["peak_bytes"] is None
+        json.dumps(d)  # strict-JSON serializable
+
+    def test_peak_bytes_partial_sum(self):
+        from pint_tpu.telemetry.costs import CostProfile
+
+        p = CostProfile(name="x", argument_bytes=10, temp_bytes=5)
+        assert p.peak_bytes == 15  # output_bytes None: summed as absent
+
+
+# ---------------------------------------------------------------------------
+# analysis entry points degrade, never raise
+# ---------------------------------------------------------------------------
+
+class TestAnalyze:
+    def test_analyze_jitted_on_cpu(self):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.telemetry import costs
+
+        f = jax.jit(lambda x, y: (x @ y).sum())
+        x = jnp.ones((16, 16))
+        prof = costs.analyze_jitted(f, x, x, name="matmul")
+        d = prof.to_dict()
+        assert d["name"] == "matmul"
+        assert prof.error is None
+        # CPU reports flops/bytes; memory analysis reports buffer sizes
+        assert d["flops"] > 0 and d["bytes_accessed"] > 0
+        assert d["argument_bytes"] == 2 * 16 * 16 * 8
+
+    def test_analyze_unjitted_degrades(self):
+        from pint_tpu.telemetry import costs
+
+        prof = costs.analyze_jitted(lambda z: z, 1.0, name="plain")
+        assert prof.error is not None
+        assert prof.to_dict()["flops"] is None
+
+    def test_analyze_compiled_refusals_degrade(self):
+        """A backend whose cost_analysis/memory_analysis RAISE still
+        yields a schema-valid profile carrying the error string."""
+        from pint_tpu.telemetry import costs
+
+        class Hostile:
+            def cost_analysis(self):
+                raise RuntimeError("backend says no")
+
+            def memory_analysis(self):
+                raise NotImplementedError("nor this")
+
+        prof = costs.analyze_compiled(Hostile(), name="hostile")
+        assert "backend says no" in prof.error
+        assert "nor this" in prof.error
+        d = prof.to_dict()
+        assert d["flops"] is None and d["temp_bytes"] is None
+        json.dumps(d)
+
+    def test_profile_grid_before_any_grid(self):
+        from pint_tpu.telemetry import costs
+
+        class Bare:
+            pass
+
+        prof = costs.profile_grid(Bare())
+        assert prof.error and "grid_chisq" in prof.error
+
+    def test_analysis_compile_not_counted(self, fresh_telemetry):
+        """The analysis' own deliberate lower/compile must not skew the
+        workload compile counters it exists to contextualize — AOT
+        compile runs with the jaxevents accounting paused."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.telemetry import costs, jaxevents
+
+        fresh_telemetry.activate("basic")
+        f = jax.jit(lambda x: jnp.cos(x).sum() * 3)
+        x = jnp.arange(33.0)
+        with jaxevents.watch() as w:
+            prof = costs.analyze_jitted(f, x, name="uncounted")
+        assert prof.error is None and prof.flops
+        assert w.delta.compiles == 0, (
+            "the AOT analysis compile leaked into the workload counters")
+        # and the accounting itself is restored afterwards
+        with jaxevents.watch() as w2:
+            jax.jit(lambda x: x - 5)(x)
+        assert w2.delta.compiles >= 1
+
+    def test_cache_hit_restamps_name(self, fresh_telemetry):
+        """A memoized analysis returned under a different caller label
+        must carry THAT label (the MULTICHIP artifact's
+        grid.chunk.sharded line, not the first caller's name)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.telemetry import costs
+
+        f = jax.jit(lambda x: x * 2 + 1)
+        x = jnp.arange(17.0)
+        p1 = costs.analyze_jitted(f, x, name="first")
+        p2 = costs.analyze_jitted(f, x, name="second")
+        assert p1.name == "first" and p2.name == "second"
+        assert p2.flops == p1.flops
+
+    def test_record_off_mode_is_noop(self, fresh_telemetry):
+        from pint_tpu.telemetry import costs, spans
+
+        prof = costs.CostProfile(name="off", flops=1.0)
+        assert costs.record_cost_profile(prof) is prof
+        assert spans.finished_roots() == []
+
+
+# ---------------------------------------------------------------------------
+# end to end: fit/grid executables on the CPU tier-1 backend
+# ---------------------------------------------------------------------------
+
+class TestWorkloadProfiles:
+    def test_grid_fit_gls_profiles(self, fresh_telemetry, tmp_path):
+        """The full path: grid_chisq records the executable, the three
+        workload profilers produce schema-valid profiles, full mode
+        streams a validated cost_profile record with cost.* span attrs."""
+        from tools.telemetry_report import main as report_main
+
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.telemetry import costs, runlog
+
+        f = _tiny_gls_fitter()
+        fresh_telemetry.activate("full")
+        run_dir = str(tmp_path / "run")
+        runlog.start_run(run_dir, name="cost-e2e", probe_device=False)
+        f.fit_toas(maxiter=1)
+        g0 = np.linspace(f.model.F0.value - 1e-9, f.model.F0.value + 1e-9, 3)
+        g1 = np.linspace(f.model.F1.value - 1e-17,
+                         f.model.F1.value + 1e-17, 3)
+        chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=1)
+        assert np.all(np.isfinite(chi2))
+
+        assert hasattr(f, "last_grid_executable")
+        workload = costs.profile_workload(f)
+        assert set(workload) == {"fit.eval", "fit.jac", "gls.solve",
+                                 "grid.chunk"}
+        for name, d in workload.items():
+            assert d["name"] == name
+            json.dumps(d)
+        # on the CPU backend these must be real numbers, not nulls
+        assert workload["grid.chunk"]["flops"] > 0
+        assert workload["gls.solve"]["flops"] > 0
+        assert workload["fit.eval"]["bytes_accessed"] > 0
+
+        runlog.end_run()
+        records = [json.loads(ln) for ln in
+                   open(os.path.join(run_dir, "events.jsonl"))]
+        cps = [r["cost_profile"] for r in records
+               if r["type"] == "cost_profile"]
+        assert any(c["name"] == "grid.chunk" and c["flops"] for c in cps)
+        grid_spans = [r["span"] for r in records if r["type"] == "span"
+                      and r["span"]["name"] == "grid_chisq"]
+        assert grid_spans and any(k.startswith("cost.")
+                                  for k in grid_spans[0].get("attrs", {}))
+        assert report_main(["--check", run_dir]) == 0
+
+    def test_check_rejects_malformed_cost_profile(self, fresh_telemetry,
+                                                  tmp_path, capsys):
+        """The report CLI's --check enforces the cost_profile schema:
+        a record missing the numeric fields (producer drift) fails."""
+        from tools.telemetry_report import main as report_main
+
+        from pint_tpu.telemetry import runlog
+
+        fresh_telemetry.activate("full")
+        run_dir = str(tmp_path / "bad")
+        run = runlog.start_run(run_dir, name="bad", probe_device=False)
+        run.record_cost_profile({"name": "drifted"})  # no schema, no fields
+        runlog.end_run()
+        assert report_main(["--check", run_dir]) == 1
+        err = capsys.readouterr().err
+        assert "cost_profile" in err and "missing field" in err
+
+    def test_repeat_grid_reuses_cached_profile(self, fresh_telemetry):
+        """Full-mode cost analysis runs ONCE per executable: a repeat
+        sweep must reuse the model-cached profile, not re-lower."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.telemetry import costs
+
+        f = _tiny_gls_fitter()
+        fresh_telemetry.activate("full")
+        f.fit_toas(maxiter=1)
+        g0 = np.linspace(f.model.F0.value - 1e-9, f.model.F0.value + 1e-9, 3)
+        g1 = np.linspace(f.model.F1.value - 1e-17,
+                         f.model.F1.value + 1e-17, 3)
+        calls = []
+        orig = costs.analyze_jitted
+
+        def counting(*a, **kw):
+            calls.append(kw.get("name"))
+            return orig(*a, **kw)
+
+        costs.analyze_jitted = counting
+        try:
+            grid_chisq(f, ("F0", "F1"), (g0, g1), niter=1)
+            grid_chisq(f, ("F0", "F1"), (g0, g1), niter=1)
+        finally:
+            costs.analyze_jitted = orig
+        assert calls.count("grid.chunk") == 1
+
+    def test_cost_never_blocks_fit_path(self, fresh_telemetry,
+                                        monkeypatch):
+        """A hostile analysis path must not take grid_chisq down: the
+        full-mode attachment swallows even an unexpectedly-raising
+        analyze and the sweep's chi2 surface is unaffected."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.telemetry import costs
+
+        f = _tiny_gls_fitter()
+        fresh_telemetry.activate("full")
+        f.fit_toas(maxiter=1)
+
+        def explode(*a, **kw):
+            raise RuntimeError("analysis backend down")
+
+        monkeypatch.setattr(costs, "analyze_jitted", explode)
+        g0 = np.linspace(f.model.F0.value - 1e-9, f.model.F0.value + 1e-9, 2)
+        g1 = np.linspace(f.model.F1.value - 1e-17,
+                         f.model.F1.value + 1e-17, 2)
+        chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=1)
+        assert np.all(np.isfinite(np.asarray(chi2)))
+        # and analyze_jitted's own contract: lower/compile failures are
+        # swallowed into an errored profile, never raised
+        monkeypatch.undo()
+        prof = costs.analyze_jitted(object(), name="junk")
+        assert prof.error is not None
+
+
+# ---------------------------------------------------------------------------
+# trace summary (profiling.py)
+# ---------------------------------------------------------------------------
+
+class TestTraceSummary:
+    def test_summarize_missing_dir_degrades(self, tmp_path):
+        from pint_tpu.profiling import summarize_trace
+
+        rep = summarize_trace(str(tmp_path / "nowhere"))
+        assert rep.error and "no .xplane.pb" in rep.error
+        assert rep.ops == {}
+        assert "nowhere" in rep.table()
+
+    @pytest.mark.slow
+    def test_device_trace_summarizes_ops(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.profiling import device_trace
+
+        with device_trace(str(tmp_path)) as rep:
+            g = jax.jit(lambda x: jnp.sin(x @ x).sum())
+            g(jnp.ones((64, 64))).block_until_ready()
+        if rep.error:  # parser genuinely unavailable: listing fallback
+            assert rep.files or "no .xplane.pb" in rep.error
+        else:
+            assert rep.ops
+            top = rep.top(5)
+            assert top and top[0][1] >= top[-1][1]
+            d = rep.to_dict()
+            json.dumps(d)
+            assert d["top_ops"]
+
+    def test_self_time_nesting(self):
+        """A parent event's self-time excludes its nested child."""
+        from pint_tpu.profiling import TraceReport
+
+        class Meta:
+            def __init__(self, name):
+                self.name = name
+
+        class Ev:
+            def __init__(self, off, dur, mid):
+                self.offset_ps = off
+                self.duration_ps = dur
+                self.metadata_id = mid
+
+        class Line:
+            name = "ops"
+            events = [Ev(0, 100, 1), Ev(10, 40, 2)]
+
+        class Plane:
+            event_metadata = {1: Meta("parent"), 2: Meta("child")}
+
+        rep = TraceReport("unused")
+        rep._accumulate_line(Plane(), Line())
+        assert rep.ops["parent"] == pytest.approx(60e-12)
+        assert rep.ops["child"] == pytest.approx(40e-12)
+
+    def test_self_time_child_shares_parent_start(self):
+        """A child starting at the SAME ps as its parent (a region event
+        and its first sub-event) must still nest under it — a plain
+        (start, end) sort would process the shorter child first and
+        drive its self-time negative."""
+        from pint_tpu.profiling import TraceReport
+
+        class Meta:
+            def __init__(self, name):
+                self.name = name
+
+        class Ev:
+            def __init__(self, off, dur, mid):
+                self.offset_ps = off
+                self.duration_ps = dur
+                self.metadata_id = mid
+
+        class Line:
+            name = "ops"
+            events = [Ev(0, 5, 2), Ev(0, 10, 1)]  # child listed first
+
+        class Plane:
+            event_metadata = {1: Meta("parent"), 2: Meta("child")}
+
+        rep = TraceReport("unused")
+        rep._accumulate_line(Plane(), Line())
+        assert rep.ops["parent"] == pytest.approx(5e-12)
+        assert rep.ops["child"] == pytest.approx(5e-12)
+        assert all(v >= 0 for v in rep.ops.values())

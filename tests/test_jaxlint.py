@@ -224,6 +224,38 @@ class TestHostCallInJit:
         )
         assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
 
+    def test_costs_call_in_jit_flagged(self, tmp_path):
+        """telemetry.costs AOT analysis (lower/compile) inside a traced
+        function would re-enter tracing once per TRACE — the rule's
+        target set must cover the costs submodule like every other
+        telemetry spelling."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.telemetry import costs as _costs\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    _costs.record_cost_profile(_costs.analyze_jitted(f, x))\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+        assert "telemetry call" in findings[0].message
+
+    def test_costs_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: cost attribution of a jitted fn FROM host code is
+        exactly the documented pattern and stays silent."""
+        good = (
+            "import jax\n"
+            "from pint_tpu.telemetry import costs\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "def host(x):\n"
+            "    prof = costs.analyze_jitted(f, x, name='f')\n"
+            "    return costs.record_cost_profile(prof)\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

@@ -168,3 +168,87 @@ class TestT2SpacecraftObs:
         finally:
             cfmod._cache.clear()
             cfmod._cache.update(saved)
+
+
+class TestClockWarnDedup:
+    """Clock diagnostics are deduplicated to once per (filename, kind)
+    per process: out-of-range text varies per TOA batch (different MJD
+    ranges), so without module-level dedup a bench tail fills with the
+    same missing-file story and drowns real diagnostics."""
+
+    @pytest.fixture
+    def warn_counter(self):
+        import logging
+
+        from pint_tpu.logging import log
+
+        records = []
+
+        class Grab(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        h = Grab(level=logging.WARNING)
+        log.addHandler(h)
+        yield records
+        log.removeHandler(h)
+
+    @pytest.fixture(autouse=True)
+    def fresh_warned(self):
+        from pint_tpu.observatory import clock_file as cfmod
+
+        saved_cache = dict(cfmod._cache)
+        saved_warned = set(cfmod._warned)
+        cfmod._cache.clear()
+        cfmod._warned.clear()
+        yield
+        cfmod._cache.clear()
+        cfmod._cache.update(saved_cache)
+        cfmod._warned.clear()
+        cfmod._warned.update(saved_warned)
+
+    def test_missing_file_warns_once(self, warn_counter):
+        from pint_tpu.observatory.clock_file import find_clock_file
+
+        for _ in range(4):
+            assert find_clock_file("definitely_absent_dedup.clk",
+                                   fmt="tempo2") is None
+        hits = [m for m in warn_counter if "definitely_absent_dedup" in m]
+        assert len(hits) == 1
+        assert "assuming zero correction" in hits[0]
+
+    def test_out_of_range_warns_once_despite_varying_text(self,
+                                                          warn_counter):
+        """Each evaluate() call covers a DIFFERENT out-of-range window,
+        so the logging layer's exact-message dedup can never catch it —
+        the per-filename dedup must."""
+        from pint_tpu.observatory.clock_file import ClockFile
+
+        cf = ClockFile([50000.0, 50010.0], [1.0, 2.0],
+                       filename="dedup_probe.clk")
+        cf.evaluate([50020.0])
+        cf.evaluate([50035.0])   # different MJD -> different message
+        cf.evaluate([49990.0])
+        hits = [m for m in warn_counter if "dedup_probe" in m]
+        assert len(hits) == 1
+
+    def test_distinct_files_each_warn(self, warn_counter):
+        from pint_tpu.observatory.clock_file import find_clock_file
+
+        assert find_clock_file("dedup_a.clk") is None
+        assert find_clock_file("dedup_b.clk") is None
+        assert len([m for m in warn_counter if "dedup_a" in m]) == 1
+        assert len([m for m in warn_counter if "dedup_b" in m]) == 1
+
+    def test_error_policy_still_raises_every_time(self, warn_counter):
+        """Dedup silences REPEAT warnings only — the limits='error'
+        escalation path must keep raising on every call."""
+        from pint_tpu.exceptions import ClockCorrectionOutOfRange
+        from pint_tpu.observatory.clock_file import ClockFile
+
+        cf = ClockFile([50000.0, 50010.0], [1.0, 2.0],
+                       filename="dedup_err.clk")
+        for _ in range(2):
+            with pytest.raises(ClockCorrectionOutOfRange):
+                cf.evaluate([50020.0], limits="error")
+        assert [m for m in warn_counter if "dedup_err" in m] == []

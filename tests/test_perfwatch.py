@@ -1,0 +1,252 @@
+"""Regression observatory under test (tools/perfwatch.py).
+
+Pins the acceptance contract: ingestion of every historical artifact
+shape, ``--check`` exiting 0 over the committed history and 1 when a
+synthetic run drops fits/s by >30%, sanity_ok=false exclusion, the
+per-(metric, platform) series split, and the MAD noise floor.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.perfwatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.perfwatch import (  # noqa: E402
+    HISTORY_SCHEMA,
+    build_history,
+    collect,
+    ingest_file,
+    main,
+)
+
+
+def _bench(path, round_, value, platform="cpu", sanity=True, wrap=True,
+           compile_s=10.0, error=None, extra=None):
+    headline = {"metric": "gls_chisq_grid_evals_per_sec", "value": value,
+                "unit": "fits/s", "platform": platform,
+                "sanity_ok": sanity, "compile_s": compile_s}
+    if error is not None:
+        headline["error"] = error
+    if extra:
+        headline.update(extra)
+    doc = {"n": 1, "rc": 0, "parsed": headline,
+           "tail": "# chatter\n" + json.dumps(headline) + "\n"} \
+        if wrap else headline
+    fn = os.path.join(path, f"BENCH_r{round_:02d}.json")
+    with open(fn, "w") as f:
+        json.dump(doc, f)
+    return fn
+
+
+class TestIngestion:
+    def test_wrapper_and_bare_shapes(self, tmp_path):
+        errors = []
+        f1 = _bench(str(tmp_path), 1, 100.0, wrap=True)
+        f2 = _bench(str(tmp_path), 2, 105.0, wrap=False)
+        r1 = ingest_file(f1, errors)
+        r2 = ingest_file(f2, errors)
+        assert not errors
+        assert r1.round == 1 and r1.value == 100.0 and r1.platform == "cpu"
+        assert r2.round == 2 and r2.value == 105.0
+        assert r1.usable and r2.usable
+
+    def test_tail_headline_recovers_null_parsed(self, tmp_path):
+        """Rounds whose driver 'parsed' is null (r03) recover the
+        headline from the JSON line in the captured tail; the FINAL tail
+        line wins (the bench's exactly-once emit contract)."""
+        doc = {"n": 1, "rc": 0, "parsed": None,
+               "tail": 'noise\n{"metric": "m", "value": 50.0, '
+                       '"platform": "tpu"}\n'}
+        fn = tmp_path / "BENCH_r03.json"
+        fn.write_text(json.dumps(doc))
+        errors = []
+        r = ingest_file(str(fn), errors)
+        assert not errors
+        assert r.value == 50.0 and r.platform == "tpu"
+
+    def test_headline_less_wrapper_excluded_not_fatal(self, tmp_path):
+        doc = {"n": 1, "rc": 1, "parsed": None, "tail": "SIGILL noise\n"}
+        fn = tmp_path / "BENCH_r03.json"
+        fn.write_text(json.dumps(doc))
+        errors = []
+        r = ingest_file(str(fn), errors)
+        assert not errors
+        assert not r.usable and r.error
+
+    def test_unreadable_is_fatal(self, tmp_path):
+        fn = tmp_path / "BENCH_r01.json"
+        fn.write_text("{not json")
+        errors = []
+        assert ingest_file(str(fn), errors) is None
+        assert errors
+
+    def test_telemetry_and_cost_blocks(self, tmp_path):
+        fn = _bench(str(tmp_path), 6, 300.0, extra={
+            "telemetry": {"jax": {"compiles": 12, "compile_seconds": 30.5},
+                          "memory": {"peak_bytes_in_use": 2 ** 30}},
+            "cost": {"name": "grid.chunk", "flops": 1e9,
+                     "bytes_accessed": 2e9}})
+        r = ingest_file(fn, [])
+        assert r.compiles == 12 and r.compile_seconds == 30.5
+        assert r.hbm_peak_bytes == 2 ** 30
+        assert r.cost["flops"] == 1e9
+
+    def test_multichip_with_cost_line(self, tmp_path):
+        cost = {"name": "grid.chunk.sharded", "flops": 5.0,
+                "num_devices": 4,
+                "per_device": {"0": {"flops": 5.0}, "1": {"flops": 5.0}}}
+        doc = {"n_devices": 4, "rc": 0, "ok": True, "skipped": False,
+               "tail": "dryrun OK\n"
+                       + json.dumps({"multichip_cost": cost}) + "\n"}
+        fn = tmp_path / "MULTICHIP_r06.json"
+        fn.write_text(json.dumps(doc))
+        r = ingest_file(str(fn), [])
+        assert r.kind == "multichip" and r.n_devices == 4
+        assert r.multichip_cost["per_device"]["1"]["flops"] == 5.0
+
+    def test_history_schema(self, tmp_path):
+        _bench(str(tmp_path), 1, 100.0)
+        _bench(str(tmp_path), 2, 101.0)
+        recs = collect([], str(tmp_path), [])
+        h = build_history(recs)
+        assert h["schema"] == HISTORY_SCHEMA
+        assert [r["round"] for r in h["runs"]] == [1, 2]
+        json.dumps(h)
+
+
+class TestCheckGating:
+    def test_committed_history_passes(self, capsys):
+        """The acceptance pin: --check over the repo's own committed
+        artifact history exits 0 on the current tree."""
+        assert main(["--check", "--dir", REPO]) == 0
+        assert "no meaningful regression" in capsys.readouterr().out
+
+    def test_thirty_percent_drop_fails(self, tmp_path, capsys):
+        """The acceptance pin: a synthetic run with a >30% fits/s drop
+        against the same (metric, platform) series exits 1."""
+        d = str(tmp_path)
+        for i, v in enumerate([100.0, 102.0, 98.0], start=1):
+            _bench(d, i, v)
+        _bench(d, 4, 60.0)  # 40% below the 100.0 median
+        assert main(["--check", "--dir", d]) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_small_drop_passes(self, tmp_path):
+        d = str(tmp_path)
+        for i, v in enumerate([100.0, 102.0, 98.0], start=1):
+            _bench(d, i, v)
+        _bench(d, 4, 95.0)  # 5% drop: under the 30% bar
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_sanity_false_runs_excluded(self, tmp_path):
+        """A sanity_ok=false run neither fails the gate as the latest
+        run nor poisons the baseline."""
+        d = str(tmp_path)
+        _bench(d, 1, 100.0)
+        _bench(d, 2, 101.0)
+        _bench(d, 3, 10.0, sanity=False)  # broken measurement, excluded
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_zero_run_excluded(self, tmp_path):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0)
+        _bench(d, 2, 0.0, error="TPU unavailable")
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_platform_split_is_not_a_regression(self, tmp_path):
+        """A CPU round after TPU rounds is a hardware change: the series
+        split by platform must keep the 20x drop out of the gate."""
+        d = str(tmp_path)
+        _bench(d, 1, 360.0, platform="tpu")
+        _bench(d, 2, 365.0, platform="tpu")
+        _bench(d, 3, 18.0, platform="cpu")
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_noise_floor_raises_the_bar(self, tmp_path):
+        """A series whose own scatter exceeds the threshold only fails
+        beyond its noise floor (MAD-scaled)."""
+        d = str(tmp_path)
+        # scatter ~40% around median 100: MAD = 40 -> floor ~178%
+        for i, v in enumerate([60.0, 100.0, 140.0, 58.0, 142.0], start=1):
+            _bench(d, i, v)
+        _bench(d, 6, 55.0)  # 45% drop: over threshold, under noise floor
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_compile_time_rise_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, compile_s=10.0)
+        _bench(d, 4, 100.0, compile_s=20.0)  # 2x compile rise
+        assert main(["--check", "--dir", d]) == 1
+        assert "compile_s" in capsys.readouterr().out
+
+    def test_threshold_configurable(self, tmp_path):
+        d = str(tmp_path)
+        for i, v in enumerate([100.0, 100.0, 100.0], start=1):
+            _bench(d, i, v)
+        _bench(d, 4, 90.0)  # 10% drop
+        assert main(["--check", "--dir", d]) == 0
+        assert main(["--check", "--threshold", "0.05", "--dir", d]) == 1
+
+    def test_newest_run_missing_quantity_not_regated(self, tmp_path,
+                                                     capsys):
+        """When the newest run lacks compile_s, that quantity is simply
+        not gated — an older run must NOT be re-gated and presented as
+        the latest verdict (which would mask the newest round)."""
+        d = str(tmp_path)
+        _bench(d, 1, 100.0, compile_s=10.0)
+        _bench(d, 2, 100.0, compile_s=30.0)  # would fail if (re)gated
+        fn = os.path.join(d, "BENCH_r03.json")
+        headline = {"metric": "gls_chisq_grid_evals_per_sec",
+                    "value": 100.0, "platform": "cpu", "sanity_ok": True}
+        with open(fn, "w") as f:
+            json.dump({"n": 1, "rc": 0, "parsed": headline, "tail": ""}, f)
+        assert main(["--check", "--dir", d]) == 0
+        assert "compile_s" not in capsys.readouterr().out
+
+    def test_single_run_series_passes(self, tmp_path):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0)
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_empty_dir(self, tmp_path):
+        assert main(["--check", "--dir", str(tmp_path)]) == 0
+        assert main(["--dir", str(tmp_path)]) == 2
+
+
+class TestReportAndJson:
+    def test_report_renders_series_and_multichip(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0)
+        _bench(d, 2, 120.0, extra={
+            "cost": {"name": "grid.chunk", "flops": 1e9,
+                     "bytes_accessed": 2e9, "peak_bytes": 3e6,
+                     "num_devices": 1}})
+        (tmp_path / "MULTICHIP_r02.json").write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+             "tail": ""}))
+        assert main(["--dir", d]) == 0
+        out = capsys.readouterr().out
+        assert "gls_chisq_grid_evals_per_sec @ cpu" in out
+        assert "+20.0" in out          # round-over-round delta
+        assert "flops=1000000000" in out or "flops=1e+09" in out
+        assert "multichip" in out and "8 devices" in out
+
+    def test_json_history(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0)
+        assert main(["--json", "--dir", d]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["schema"] == HISTORY_SCHEMA
+        assert doc["runs"][0]["value"] == 100.0
+
+    def test_bad_args(self):
+        with pytest.raises(SystemExit):
+            main(["--check", "--threshold", "0"])

@@ -1,0 +1,417 @@
+#!/usr/bin/env python
+"""Performance-regression observatory over the bench artifact history.
+
+The repo accumulates one ``BENCH_r<N>.json`` (and ``MULTICHIP_r<N>.json``)
+per round, but until now nothing read them back: a PR that halved fits/s
+would land silently.  This CLI ingests the whole artifact family into a
+schema'd history and either renders a trend report or gates on it::
+
+    python -m tools.perfwatch                 # trend report (default dir: repo root)
+    python -m tools.perfwatch --check         # exit 1 on a meaningful regression
+    python -m tools.perfwatch --json          # machine-readable history
+    python -m tools.perfwatch --dir D f.json  # explicit dir and/or files
+
+Ingestion understands every historical artifact shape: driver wrappers
+(``{"parsed": {...}, "tail": "..."}``), bare headline dicts
+(``BENCH_TPU_r05.json``), headline JSON lines embedded in a wrapper's
+``tail`` (rounds whose ``parsed`` is null), multichip wrappers
+(``n_devices``/``ok`` + ``{"multichip_cost": ...}`` tail lines), and the
+round-5+ ``telemetry{...}``/``cost{...}`` blocks (compile counts, HBM
+peak, FLOPs/bytes).
+
+Gating (``--check``) is per series — runs sharing (metric, platform),
+because a TPU round following a CPU round is a hardware change, not a
+regression.  Within a series, ``sanity_ok=false``/errored runs are
+excluded, the newest run is compared against the MEDIAN of its
+predecessors, and the failure bar is
+``max(--threshold, --noise-mult * scatter)`` where scatter is the
+predecessors' MAD-estimated relative spread — a noisy series must
+regress beyond its own noise floor to fail, a quiet one fails at the
+configured relative drop (default 30%).  fits/s gates on drops,
+compile_s on rises.  Exit codes: 0 clean, 1 regression/parse failure,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+HISTORY_SCHEMA = "pint_tpu.perfwatch.history/1"
+
+#: artifact filename families swept from --dir, in ingestion order
+_PATTERNS = ("BENCH_r*.json", "BENCH_*_r*.json", "MULTICHIP_r*.json")
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+@dataclass
+class RunRecord:
+    """One benchmark run, normalized from any artifact shape."""
+
+    source: str
+    kind: str = "bench"                 #: bench | multichip
+    round: Optional[int] = None
+    metric: Optional[str] = None
+    value: Optional[float] = None       #: fits/s (the headline)
+    unit: Optional[str] = None
+    platform: str = "unknown"
+    sanity_ok: Optional[bool] = None
+    error: Optional[str] = None
+    compile_s: Optional[float] = None
+    grid_points: Optional[int] = None
+    ntoas: Optional[int] = None
+    #: from the telemetry{...} block (round 4+)
+    compiles: Optional[int] = None
+    compile_seconds: Optional[float] = None
+    hbm_peak_bytes: Optional[int] = None
+    #: from the cost{...} block (round 6+)
+    cost: Optional[dict] = None
+    #: multichip extras
+    n_devices: Optional[int] = None
+    multichip_ok: Optional[bool] = None
+    multichip_cost: Optional[dict] = None
+
+    @property
+    def usable(self) -> bool:
+        """Eligible for gating: a real number from a sane run."""
+        return (self.value is not None and self.error is None
+                and self.sanity_ok is not False)
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+
+def _round_of(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _tail_json_lines(tail: str) -> List[dict]:
+    """Every parseable one-line JSON object embedded in a captured tail."""
+    out = []
+    for line in tail.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            out.append(obj)
+    return out
+
+
+def _apply_headline(rec: RunRecord, h: dict) -> None:
+    """Fold one headline dict (the bench's single JSON line) into rec."""
+    rec.metric = h.get("metric", rec.metric)
+    v = h.get("value")
+    rec.value = float(v) if isinstance(v, (int, float)) else rec.value
+    rec.unit = h.get("unit", rec.unit)
+    rec.platform = h.get("platform") or rec.platform
+    if "sanity_ok" in h:
+        rec.sanity_ok = bool(h["sanity_ok"])
+    rec.error = h.get("error", rec.error)
+    if isinstance(h.get("compile_s"), (int, float)):
+        rec.compile_s = float(h["compile_s"])
+    if isinstance(h.get("grid_points"), int):
+        rec.grid_points = h["grid_points"]
+    if isinstance(h.get("ntoas"), int):
+        rec.ntoas = h["ntoas"]
+    if isinstance(h.get("cost"), dict):
+        rec.cost = h["cost"]
+    tel = h.get("telemetry")
+    if isinstance(tel, dict):
+        jaxc = tel.get("jax") or {}
+        if isinstance(jaxc.get("compiles"), (int, float)):
+            rec.compiles = int(jaxc["compiles"])
+        if isinstance(jaxc.get("compile_seconds"), (int, float)):
+            rec.compile_seconds = float(jaxc["compile_seconds"])
+        mem = tel.get("memory") or {}
+        peak = mem.get("peak_bytes_in_use", mem.get("live_buffer_bytes"))
+        if isinstance(peak, (int, float)):
+            rec.hbm_peak_bytes = int(peak)
+    # a zero-valued errored run (the bench's error-emit contract) is a
+    # failed measurement, not a 100% regression
+    if rec.error is not None and not rec.value:
+        rec.value = None
+
+
+def ingest_file(path: str, errors: List[str]) -> Optional[RunRecord]:
+    """Parse one artifact into a RunRecord (None: unreadable)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        errors.append(f"{path}: unreadable/invalid JSON: {e}")
+        return None
+    if not isinstance(doc, dict):
+        errors.append(f"{path}: artifact is {type(doc).__name__}, not object")
+        return None
+    rec = RunRecord(source=os.path.basename(path), round=_round_of(path))
+    if "n_devices" in doc:                       # multichip wrapper
+        rec.kind = "multichip"
+        rec.n_devices = doc.get("n_devices")
+        rec.multichip_ok = doc.get("ok")
+        for obj in _tail_json_lines(doc.get("tail", "")):
+            if isinstance(obj.get("multichip_cost"), dict):
+                rec.multichip_cost = obj["multichip_cost"]
+        return rec
+    headline = None
+    if isinstance(doc.get("parsed"), dict):      # driver wrapper
+        headline = doc["parsed"]
+    elif "metric" in doc:                        # bare headline dict
+        headline = doc
+    # tail headline lines supersede parsed (the final emit is canonical)
+    # and recover rounds whose parsed is null
+    for obj in _tail_json_lines(doc.get("tail", "")):
+        if "metric" in obj:
+            headline = obj
+    if headline is None:
+        # a round that crashed before its one JSON line (r03's SIGILL
+        # tail) is a failed measurement to EXCLUDE, not a reason to fail
+        # the whole sweep — only unreadable files are hard errors
+        rec.error = "no headline metric recovered (parsed null, no JSON " \
+                    "line in tail)"
+        return rec
+    _apply_headline(rec, headline)
+    return rec
+
+
+def collect(paths: List[str], directory: Optional[str],
+            errors: List[str]) -> List[RunRecord]:
+    files = list(paths)
+    if directory:
+        for pat in _PATTERNS:
+            files.extend(sorted(glob.glob(os.path.join(directory, pat))))
+    seen, ordered = set(), []
+    for f in files:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            ordered.append(f)
+    recs = [ingest_file(f, errors) for f in ordered]
+    return [r for r in recs if r is not None]
+
+
+def build_history(records: List[RunRecord]) -> dict:
+    """The schema'd history document (--json output; what tests pin)."""
+    key = lambda r: (r.round if r.round is not None else -1, r.source)
+    return {"schema": HISTORY_SCHEMA,
+            "runs": [r.to_dict() for r in sorted(records, key=key)]}
+
+
+# ---------------------------------------------------------------------------
+# gating
+# ---------------------------------------------------------------------------
+
+def _median(xs: List[float]) -> float:
+    from statistics import median
+
+    return float(median(xs))
+
+
+def _series(records: List[RunRecord]) -> Dict[Tuple[str, str],
+                                              List[RunRecord]]:
+    """Usable bench runs grouped by (metric, platform), round order."""
+    out: Dict[Tuple[str, str], List[RunRecord]] = {}
+    for r in records:
+        if r.kind != "bench" or not r.usable:
+            continue
+        out.setdefault((r.metric or "?", r.platform), []).append(r)
+    for runs in out.values():
+        runs.sort(key=lambda r: (r.round if r.round is not None else 1 << 30,
+                                 r.source))
+    return out
+
+
+@dataclass
+class Verdict:
+    series: Tuple[str, str]
+    quantity: str           #: fits_per_sec | compile_s
+    baseline: float
+    latest: float
+    rel_change: float       #: positive = regression (drop or rise)
+    bar: float              #: the threshold actually applied
+    failed: bool
+    detail: str = ""
+
+
+def check_series(runs: List[RunRecord], threshold: float,
+                 noise_mult: float) -> List[Verdict]:
+    """Gate the newest run of one series against its predecessors."""
+    verdicts = []
+    quantities = (("fits_per_sec", lambda r: r.value, +1),
+                  ("compile_s", lambda r: r.compile_s, -1))
+    for name, get, sign in quantities:
+        # gate the series' NEWEST run only: when it lacks this quantity
+        # there is nothing to compare — re-gating an older run and
+        # reporting it as latest would mask the newest round entirely
+        latest_rec = runs[-1]
+        latest = get(latest_rec)
+        if latest is None:
+            continue
+        prev = [get(r) for r in runs[:-1] if get(r) is not None]
+        if not prev:
+            continue
+        baseline = _median(prev)
+        if baseline <= 0:
+            continue
+        # sign +1: lower-is-worse (fits/s); -1: higher-is-worse (compile)
+        rel = sign * (baseline - latest) / baseline
+        scatter = 1.4826 * _median([abs(v - baseline) for v in prev]) \
+            / baseline
+        bar = max(threshold, noise_mult * scatter)
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity=name, baseline=baseline, latest=latest,
+            rel_change=rel, bar=bar, failed=rel > bar,
+            detail=f"{latest_rec.source}: {latest:g} vs median {baseline:g} "
+                   f"of {len(prev)} prior run(s); "
+                   f"change {100 * rel:+.1f}% (bar {100 * bar:.1f}%, "
+                   f"noise floor {100 * noise_mult * scatter:.1f}%)"))
+    return verdicts
+
+
+def run_check(records: List[RunRecord], threshold: float, noise_mult: float,
+              out=None) -> int:
+    out = out or sys.stdout  # late-bound so pytest capture sees it
+    rc = 0
+    for key, runs in sorted(_series(records).items()):
+        for v in check_series(runs, threshold, noise_mult):
+            status = "REGRESSION" if v.failed else "ok"
+            print(f"perfwatch: [{status}] {v.series[0]} @{v.series[1]} "
+                  f"{v.quantity}: {v.detail}", file=out)
+            if v.failed:
+                rc = 1
+    if rc == 0:
+        print("perfwatch: no meaningful regression", file=out)
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def render_report(records: List[RunRecord], out=None) -> None:
+    out = out or sys.stdout  # late-bound so pytest capture sees it
+    for (metric, platform), runs in sorted(_series(records).items()):
+        print(f"=== {metric} @ {platform} ===", file=out)
+        print(f"  {'round':<6s}{'source':<22s}{'fits/s':>10s}{'Δ%':>8s}"
+              f"{'compile_s':>10s}{'compiles':>9s}{'HBM peak':>12s}"
+              f"{'sane':>6s}", file=out)
+        prev = None
+        for r in runs:
+            delta = "-" if prev in (None, 0) or r.value is None \
+                else f"{100 * (r.value - prev) / prev:+.1f}"
+            print(f"  {str(r.round) if r.round is not None else '?':<6s}"
+                  f"{r.source:<22s}"
+                  f"{r.value:>10.1f}{delta:>8s}"
+                  f"{r.compile_s if r.compile_s is not None else float('nan'):>10.1f}"
+                  f"{str(r.compiles) if r.compiles is not None else '-':>9s}"
+                  f"{_fmt_bytes(r.hbm_peak_bytes):>12s}"
+                  f"{'' if r.sanity_ok is None else str(bool(r.sanity_ok)):>6s}",
+                  file=out)
+            prev = r.value
+        latest = runs[-1]
+        if latest.cost:
+            c = latest.cost
+            print(f"  cost[{c.get('name', '?')}]: "
+                  f"flops={c.get('flops')} "
+                  f"bytes_accessed={c.get('bytes_accessed')} "
+                  f"peak_bytes={c.get('peak_bytes')} "
+                  f"devices={c.get('num_devices')}", file=out)
+    skipped = [r for r in records if r.kind == "bench" and not r.usable]
+    if skipped:
+        print("--- excluded (errored / sanity_ok=false / no value) ---",
+              file=out)
+        for r in skipped:
+            why = r.error or ("sanity_ok=false" if r.sanity_ok is False
+                              else "no headline value")
+            print(f"  {r.source}: {why}", file=out)
+    multichip = [r for r in records if r.kind == "multichip"]
+    if multichip:
+        print("--- multichip ---", file=out)
+        for r in sorted(multichip, key=lambda r: (r.round or 0, r.source)):
+            line = (f"  r{r.round} {r.source}: {r.n_devices} devices, "
+                    f"ok={r.multichip_ok}")
+            if r.multichip_cost:
+                per_dev = r.multichip_cost.get("per_device") or {}
+                line += (f", cost per-device program: "
+                         f"flops={r.multichip_cost.get('flops')} over "
+                         f"{len(per_dev) or r.multichip_cost.get('num_devices')}"
+                         f" device(s)")
+            print(line, file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.perfwatch",
+        description="Trend / gate the BENCH_r*/MULTICHIP_r* history")
+    ap.add_argument("paths", nargs="*",
+                    help="explicit artifact files (added to --dir sweep)")
+    ap.add_argument("--dir", default=None,
+                    help="directory to sweep for BENCH_r*/MULTICHIP_r* "
+                         "(default: repo root; pass '' to disable)")
+    ap.add_argument("--check", action="store_true",
+                    help="gate: exit 1 on a meaningful regression")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the schema'd history as JSON")
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="relative drop (fits/s) / rise (compile_s) that "
+                         "fails --check (default 0.30)")
+    ap.add_argument("--noise-mult", type=float, default=3.0,
+                    help="noise-floor multiplier on the series' MAD "
+                         "scatter (default 3.0)")
+    args = ap.parse_args(argv)
+    if args.threshold <= 0 or args.noise_mult < 0:
+        ap.error("--threshold must be > 0 and --noise-mult >= 0")
+
+    directory = args.dir
+    if directory is None:
+        directory = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    errors: List[str] = []
+    records = collect(args.paths, directory or None, errors)
+    for e in errors:
+        print(f"perfwatch: {e}", file=sys.stderr)
+    if errors:
+        return 1
+    if not records:
+        print("perfwatch: no artifacts found", file=sys.stderr)
+        # an empty history is clean for --check (fresh repo), a usage
+        # problem for a report request
+        return 0 if args.check else 2
+    if args.json:
+        json.dump(build_history(records), sys.stdout, indent=2,
+                  sort_keys=True)
+        print()
+        if not args.check:
+            return 0
+        # stdout stays pure JSON: verdict lines go to stderr
+        return run_check(records, args.threshold, args.noise_mult,
+                         out=sys.stderr)
+    if args.check:
+        return run_check(records, args.threshold, args.noise_mult)
+    render_report(records)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

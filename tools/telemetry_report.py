@@ -33,6 +33,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # `python tools/telemetry_report.py` spelling
     sys.path.insert(0, REPO)
 
+from pint_tpu.telemetry.costs import (  # noqa: E402
+    COST_PROFILE_SCHEMA,
+    NUMERIC_FIELDS,
+)
 from pint_tpu.telemetry.runlog import (  # noqa: E402
     EVENT_SCHEMA,
     EVENT_TYPES,
@@ -76,6 +80,40 @@ def validate_span_dict(sp, where: str, errors: List[str],
     for child in sp.get("children", []):
         validate_span_dict(child, where, errors,
                            parent_id=sp.get("span_id"))
+
+
+def validate_cost_profile(cp, where: str, errors: List[str]) -> None:
+    """A cost_profile body must be schema-tagged, named, and carry EVERY
+    normalized numeric field — as a number or an explicit null.  Absent
+    keys mean the producer and the costs module drifted apart."""
+    if not isinstance(cp, dict):
+        _err(errors, where,
+             f"cost_profile body is {type(cp).__name__}, not object")
+        return
+    if cp.get("schema") != COST_PROFILE_SCHEMA:
+        _err(errors, where, f"cost_profile schema {cp.get('schema')!r} != "
+                            f"{COST_PROFILE_SCHEMA!r}")
+    if not isinstance(cp.get("name"), str) or not cp.get("name"):
+        _err(errors, where, "cost_profile missing non-empty 'name'")
+    for fieldname in NUMERIC_FIELDS:
+        if fieldname not in cp:
+            _err(errors, where,
+                 f"cost_profile missing field {fieldname!r} "
+                 "(must be a number or explicit null)")
+        elif cp[fieldname] is not None \
+                and not isinstance(cp[fieldname], (int, float)):
+            _err(errors, where, f"cost_profile field {fieldname!r} is "
+                                f"{cp[fieldname]!r}, not number/null")
+    nd = cp.get("num_devices")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        _err(errors, where, f"cost_profile 'num_devices' is {nd!r}, "
+                            "not a positive integer")
+    per_device = cp.get("per_device")
+    if per_device is not None and not (
+            isinstance(per_device, dict)
+            and all(isinstance(v, dict) for v in per_device.values())):
+        _err(errors, where, "cost_profile 'per_device' must map device "
+                            "ids to objects")
 
 
 def validate_events_file(path: str, errors: List[str]) -> int:
@@ -133,6 +171,8 @@ def validate_events_file(path: str, errors: List[str]) -> int:
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
+            elif type_ == "cost_profile":
+                validate_cost_profile(rec["cost_profile"], where, errors)
     return n
 
 
@@ -194,7 +234,7 @@ def render_run(path: str, out=sys.stdout) -> None:
     if dev:
         print(f"  device  : {dev.get('platform')} ({dev.get('device_kind')}"
               f", {dev.get('precision')})", file=out)
-    spans, events, metrics = [], [], None
+    spans, events, costs, metrics = [], [], [], None
     with open(events_path, encoding="utf-8") as f:
         for line in f:
             rec = json.loads(line)
@@ -202,6 +242,8 @@ def render_run(path: str, out=sys.stdout) -> None:
                 spans.append(rec["span"])
             elif rec["type"] == "event":
                 events.append(rec["event"])
+            elif rec["type"] == "cost_profile":
+                costs.append(rec["cost_profile"])
             elif rec["type"] == "metrics":
                 metrics = rec["metrics"]  # last snapshot wins
     if spans:
@@ -213,6 +255,23 @@ def render_run(path: str, out=sys.stdout) -> None:
         print("  --- events ---", file=out)
         for ev in events:
             print(f"    {ev.get('name')}: {ev.get('attrs')}", file=out)
+    if costs:
+        print("  --- cost profiles (AOT) ---", file=out)
+        print(f"    {'executable':<16s}{'backend':>8s}{'flops':>14s}"
+              f"{'bytes':>14s}{'temp':>12s}{'peak':>12s}{'dev':>4s}",
+              file=out)
+        for cp in costs:
+            def _n(v):
+                return "-" if v is None else f"{v:g}"
+            print(f"    {str(cp.get('name', '?')):<16s}"
+                  f"{str(cp.get('backend') or '-'):>8s}"
+                  f"{_n(cp.get('flops')):>14s}"
+                  f"{_n(cp.get('bytes_accessed')):>14s}"
+                  f"{_n(cp.get('temp_bytes')):>12s}"
+                  f"{_n(cp.get('peak_bytes')):>12s}"
+                  f"{str(cp.get('num_devices', 1)):>4s}", file=out)
+            if cp.get("error"):
+                print(f"      [degraded: {cp['error']}]", file=out)
     if metrics:
         print("  --- metrics ---", file=out)
         for name, body in sorted(metrics.items()):
@@ -270,12 +329,22 @@ def self_test(errors: List[str]) -> int:
         for root in captured:
             run.record_span(root)
         run.record_event("loose", detail="outside any span")
+        # cost_profile producer drift check: a synthetic profile (no
+        # lower/compile — the selftest must stay fast and jax-free)
+        # exercises exactly the serialization path grid_chisq and bench
+        # use, including the all-nulls degradation shape
+        from pint_tpu.telemetry.costs import CostProfile
+
+        run.record_cost_profile(CostProfile(
+            name="selftest", backend="cpu", flops=1.0).to_dict())
+        run.record_cost_profile(CostProfile(
+            name="selftest-degraded", error="synthetic").to_dict())
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
-        if n < 4:  # run_start, span, event, metrics, run_end
-            _err(errors, "selftest", f"expected >= 4 records, got {n}")
+        if n < 7:  # run_start, span, event, 2x cost_profile, metrics, run_end
+            _err(errors, "selftest", f"expected >= 7 records, got {n}")
         return n
 
 
