@@ -249,6 +249,25 @@ def _make_gls_cholesky_solve():
 _gls_cholesky_solve = _make_gls_cholesky_solve()
 
 
+def _make_gls_normal_equations():
+    import jax
+
+    def normal_eq(M, r, Nvec, phiinv):
+        cinv = 1.0 / Nvec
+        mtcm = M.T @ (cinv[:, None] * M) + jnp.diag(phiinv)
+        mtcy = M.T @ (cinv * r)
+        return mtcm, mtcy
+
+    return jax.jit(normal_eq)
+
+
+#: ONE jitted Woodbury-form normal-equation build, for the same reason
+#: as _gls_cholesky_solve — and the distributed observatory's collective
+#: accounting target: with the TOA axis sharded, the M^T C^-1 M / M^T
+#: C^-1 r contractions become cross-device all-reduces
+_gls_normal_equations = _make_gls_normal_equations()
+
+
 class GLSFitter(Fitter):
     """One-shot GLS fitter (reference ``fitter.py:1939``)."""
 
@@ -335,6 +354,43 @@ class GLSFitter(Fitter):
             self.model, self.toas)
         mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         return _gls_cholesky_solve, (jnp.asarray(mtcm), jnp.asarray(mtcy))
+
+    def gls_normal_equations_executable(self, mesh=None):
+        """(jitted fn, (M, r, Nvec, phiinv)) — the Woodbury-form GLS
+        normal-equation build (``M^T C^-1 M + diag(phiinv)``, ``M^T C^-1
+        r``) at this fitter's augmented-system shapes, as one jittable
+        executable for AOT analysis.
+
+        With a ``mesh`` the TOA-indexed operands (augmented design
+        matrix rows, residuals, white-noise variances) are placed
+        sharded over the mesh's FIRST axis, so the contractions over the
+        TOA axis compile into cross-device all-reduces — the reduction
+        :mod:`pint_tpu.telemetry.distview` accounts bytes for.  The TOA
+        count is trimmed to a multiple of the shard count (the ragged
+        remainder is < n_devices rows; analysis shapes, not fit
+        results).  The jitted fn is module-level for the same
+        warm-cache reason as :func:`_gls_cholesky_solve`."""
+        r = np.asarray(self.resids.time_resids)
+        M, params, norm, phiinv, Nvec, _ = build_augmented_system(
+            self.model, self.toas)
+        args = [jnp.asarray(M), jnp.asarray(r), jnp.asarray(Nvec),
+                jnp.asarray(phiinv)]
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            axis = mesh.axis_names[0]
+            shards = int(mesh.shape[axis])
+            keep = (len(r) // shards) * shards
+            if keep == 0:
+                raise UsageError(
+                    f"cannot shard {len(r)} TOAs over {shards} devices")
+            specs = [P(axis, None), P(axis), P(axis), P()]
+            args = [args[0][:keep], args[1][:keep], args[2][:keep], args[3]]
+            args = [jax.device_put(a, NamedSharding(mesh, s))
+                    for a, s in zip(args, specs)]
+        return _gls_normal_equations, tuple(args)
 
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
                  full_cov: bool = False, debug: bool = False,

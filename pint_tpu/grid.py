@@ -807,15 +807,32 @@ def _attach_grid_executable(ftr, fn, model=None) -> None:
         return
     try:
         from pint_tpu.telemetry import costs as _costs
+        from pint_tpu.telemetry import distview as _distview
 
         vfn = got[0]
         cache = model._cache.setdefault("grid_cost_profiles", {}) \
             if model is not None else {}
-        prof = cache.get(id(vfn))
-        if prof is None:
-            prof = _costs.analyze_jitted(vfn, *got[1], name="grid.chunk")
-            cache[id(vfn)] = prof
+        cached = cache.get(id(vfn))
+        if cached is None:
+            # ONE AOT compile serves all three analyses (shared
+            # compiled-executable cache in telemetry.costs).  vfn
+            # itself is stored in the value so the id() key cannot be
+            # recycled by a later executable while the entry lives —
+            # a freed address re-used by a NEW chunk fn would
+            # otherwise serve the OLD executable's documents
+            cached = (
+                vfn,
+                _costs.analyze_jitted(vfn, *got[1], name="grid.chunk"),
+                _distview.analyze_jitted_collectives(
+                    vfn, *got[1], name="grid.chunk"),
+                _distview.sharding_plan_of_jitted(
+                    vfn, *got[1], name="grid.chunk"),
+            )
+            cache[id(vfn)] = cached
+        _, prof, coll, plan = cached
         _costs.record_cost_profile(prof)
+        _distview.record_collective_profile(coll)
+        _distview.record_sharding_plan(plan)
     except Exception as e:  # attribution must never take the sweep down
         from pint_tpu.logging import log
 

@@ -96,21 +96,60 @@ class StageTimer:
         return "\n".join(lines)
 
 
-class TraceReport:
-    """Summary of a captured xplane trace directory: per-op self-time.
+#: host-plane line-name prefix of XLA:CPU's per-device executor threads
+#: (TfrtCpuClient runs one executor per virtual device) — the closest
+#: thing a CPU trace has to device timelines
+_CPU_EXECUTOR_LINE_PREFIX = "tf_XLATfrtCpuClient/"
 
-    ``ops`` maps op/function name -> accumulated self-time seconds
+
+def _merge_intervals(intervals: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """Union of (start, end) picosecond intervals."""
+    merged: List[Tuple[int, int]] = []
+    for start, end in sorted(intervals):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+class TraceReport:
+    """Summary of a captured xplane trace directory: per-op self-time,
+    kept PER PLANE, plus per-device timelines.
+
+    ``ops_by_plane`` maps plane name -> {op name -> self-time seconds}
     (time inside the event minus time inside its nested children, so a
     fused kernel's cost is attributed to the kernel, not double-counted
-    into its callers).  ``error`` carries why summarization degraded
-    (no parser available, no trace files) — the report never raises;
-    ``files`` always lists the captured ``.xplane.pb`` paths so the
-    TensorBoard/Perfetto pointer survives a failed parse."""
+    into its callers); XLA:CPU executor-thread lines get their own
+    entry keyed by the lane name, so the per-plane semantics below hold
+    for virtual CPU devices too.  The merged ``ops`` view takes each op's MAX
+    across planes — under SPMD every device plane runs the same
+    partitioned program concurrently, so the wall-clock attribution of
+    an op appearing on N device planes is the slowest plane's self-time,
+    not N times it (the pre-distview merge summed the planes and
+    overcounted exactly that way; tests/test_profiling.py pins the fix).
+
+    ``timelines`` maps device-lane name -> ``{"busy_s", "busy_fraction",
+    "events"}``: one lane per ``/device:*`` plane when the backend emits
+    them (TPU/GPU), else one lane per XLA:CPU executor thread line
+    (``tf_XLATfrtCpuClient/*`` — TfrtCpuClient runs one executor per
+    virtual device, so on a forced-host-device CPU mesh these approximate
+    the per-device view).  ``busy_s`` is the union length of the lane's
+    top-level event intervals; ``busy_fraction`` divides by the whole
+    trace's span so lanes are comparable; :attr:`straggler_skew_s` is
+    max−min busy seconds across lanes (None below 2 lanes).
+
+    ``error`` carries why summarization degraded (no parser available,
+    no trace files) — the report never raises; ``files`` always lists
+    the captured ``.xplane.pb`` paths so the TensorBoard/Perfetto
+    pointer survives a failed parse."""
 
     def __init__(self, logdir: str):
         self.logdir = logdir
         self.files: List[str] = []
         self.ops: Dict[str, float] = {}
+        self.ops_by_plane: Dict[str, Dict[str, float]] = {}
+        self.timelines: Dict[str, dict] = {}
         self.planes: List[str] = []
         self.error: Optional[str] = None
 
@@ -126,6 +165,7 @@ class TraceReport:
             self.error = (f"xplane parser unavailable ({e}); inspect "
                           f"{self.logdir} with TensorBoard's profile plugin")
             return self
+        lane_intervals: Dict[str, List[Tuple[int, int]]] = {}
         for path in self.files:
             try:
                 space = xplane_pb2.XSpace()
@@ -146,11 +186,33 @@ class TraceReport:
                     # would drown the XLA module/op lines it sits beside
                     if not device_planes and line.name == "python":
                         continue
-                    self._accumulate_line(plane, line)
+                    # executor-thread lines are per-device lanes, so
+                    # their ops get their own ops_by_plane entry too:
+                    # summing all N lanes into the host plane would
+                    # re-create the N-plane overcount the per-plane MAX
+                    # merge exists to fix
+                    if (not device_planes
+                            and line.name.startswith(
+                                _CPU_EXECUTOR_LINE_PREFIX)):
+                        ops = self.ops_by_plane.setdefault(line.name, {})
+                    else:
+                        ops = self.ops_by_plane.setdefault(plane.name, {})
+                    top = self._accumulate_line(plane, line, ops)
+                    if device_planes:
+                        # one lane per device plane (lines are streams)
+                        lane_intervals.setdefault(plane.name, []).extend(top)
+                    elif line.name.startswith(_CPU_EXECUTOR_LINE_PREFIX):
+                        # CPU fallback: one lane per executor thread
+                        lane_intervals.setdefault(line.name, []).extend(top)
+        self._merge_ops()
+        self._build_timelines(lane_intervals)
         return self
 
-    def _accumulate_line(self, plane, line) -> None:
-        """Self-time per op within one timeline: events nest, so each
+    def _accumulate_line(self, plane, line,
+                         ops: Dict[str, float]) -> List[Tuple[int, int]]:
+        """Self-time per op within one timeline, accumulated into *ops*
+        (the owning plane's dict); returns the line's TOP-LEVEL event
+        intervals (ps) for busy accounting.  Events nest, so each
         event's self-time is its duration minus its direct children's.
         Sort key (start, -end): a child sharing its parent's start must
         still process AFTER the (longer, enclosing) parent, or the
@@ -160,19 +222,67 @@ class TraceReport:
                        ev.metadata_id) for ev in line.events))
         evs = [(start, -neg_end, mid) for start, neg_end, mid in evs]
         stack: List[list] = []  # [end_ps, metadata_id, self_ps]
+        # event offsets are line-relative: anchor the busy intervals at
+        # the line's start timestamp so lanes from different lines (CPU
+        # executor threads) land on one comparable clock
+        base_ps = int(getattr(line, "timestamp_ns", 0)) * 1000
+        top_level: List[Tuple[int, int]] = []
 
         def pop(upto_ps: Optional[int]) -> None:
             while stack and (upto_ps is None or stack[-1][0] <= upto_ps):
                 end, mid, self_ps = stack.pop()
                 name = meta[mid].name if mid in meta else f"<op {mid}>"
-                self.ops[name] = self.ops.get(name, 0.0) + self_ps * 1e-12
+                ops[name] = ops.get(name, 0.0) + self_ps * 1e-12
 
         for start, end, mid in evs:
             pop(start)
             if stack:
                 stack[-1][2] -= (end - start)  # child time is not self time
+            else:
+                top_level.append((base_ps + start, base_ps + end))
             stack.append([end, mid, end - start])
         pop(None)
+        return top_level
+
+    def _merge_ops(self) -> None:
+        """The merged per-op view: MAX across planes (wall-clock under
+        SPMD), never the plane sum."""
+        self.ops = {}
+        for plane_ops in self.ops_by_plane.values():
+            for name, secs in plane_ops.items():
+                if secs > self.ops.get(name, 0.0):
+                    self.ops[name] = secs
+
+    def _build_timelines(self, lane_intervals: Dict[str, list]) -> None:
+        spans = {lane: _merge_intervals(iv)
+                 for lane, iv in lane_intervals.items() if iv}
+        if not spans:
+            return
+        t0 = min(iv[0][0] for iv in spans.values())
+        t1 = max(iv[-1][1] for iv in spans.values())
+        trace_span = max(t1 - t0, 1)
+        for lane, merged in sorted(spans.items()):
+            busy_ps = sum(end - start for start, end in merged)
+            self.timelines[lane] = {
+                "busy_s": busy_ps * 1e-12,
+                "busy_fraction": busy_ps / trace_span,
+                "events": len(lane_intervals[lane]),
+            }
+
+    @property
+    def straggler_skew_s(self) -> Optional[float]:
+        """max−min busy seconds across device lanes: how long the
+        slowest device worked past the fastest.  None below 2 lanes
+        (nothing to skew)."""
+        if len(self.timelines) < 2:
+            return None
+        busy = [tl["busy_s"] for tl in self.timelines.values()]
+        return max(busy) - min(busy)
+
+    def device_busy_fractions(self) -> Dict[str, float]:
+        """Lane name -> busy fraction of the trace span."""
+        return {lane: tl["busy_fraction"]
+                for lane, tl in self.timelines.items()}
 
     def top(self, n: int = 10) -> List[Tuple[str, float]]:
         return sorted(self.ops.items(), key=lambda t: -t[1])[:n]
@@ -185,6 +295,16 @@ class TraceReport:
         for name, secs in self.top(n):
             lines.append(f"  {name[:56]:<56s} {secs:9.6f} s "
                          f"{100 * secs / total:5.1f}%")
+        if self.timelines:
+            lines.append(f"  --- device timelines ({len(self.timelines)} "
+                         f"lane(s)) ---")
+            for lane, tl in self.timelines.items():
+                lines.append(f"  {lane[:44]:<44s} busy {tl['busy_s']:9.6f} s "
+                             f"({100 * tl['busy_fraction']:5.1f}%)")
+            skew = self.straggler_skew_s
+            if skew is not None:
+                lines.append(f"  {'straggler skew (max-min busy)':<44s} "
+                             f"     {skew:9.6f} s")
         return "\n".join(lines)
 
     def to_dict(self, n: int = 10) -> dict:
@@ -192,7 +312,12 @@ class TraceReport:
         return {"logdir": self.logdir, "files": len(self.files),
                 "planes": self.planes, "error": self.error,
                 "top_ops": [{"op": name, "self_s": round(secs, 9)}
-                            for name, secs in self.top(n)]}
+                            for name, secs in self.top(n)],
+                "per_device": {
+                    lane: {"busy_s": round(tl["busy_s"], 9),
+                           "busy_fraction": round(tl["busy_fraction"], 6)}
+                    for lane, tl in self.timelines.items()},
+                "straggler_skew_s": self.straggler_skew_s}
 
 
 def _xplane_proto():

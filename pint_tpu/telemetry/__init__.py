@@ -14,7 +14,13 @@ Four parts (DESIGN.md "Observability & telemetry"):
 * :mod:`~pint_tpu.telemetry.costs` — AOT cost attribution
   (``cost_analysis``/``memory_analysis`` of the hot-path executables,
   normalized per backend and per device; consumed by bench.py's
-  ``cost{...}`` block and ``python -m tools.perfwatch``).
+  ``cost{...}`` block and ``python -m tools.perfwatch``);
+* :mod:`~pint_tpu.telemetry.distview` — distributed-execution
+  observatory: collective-comms accounting scraped from compiled HLO
+  (``CollectiveProfile``: all-reduce/all-gather/... counts, bytes,
+  comm/compute ratio) and sharding-plan introspection recorded into the
+  run manifest + ``sharding_plan`` events; consumed by the multichip
+  dryrun tail and ``python -m tools.scalewatch``.
 
 Gating: :func:`pint_tpu.config.telemetry_mode` (``PINT_TPU_TELEMETRY`` =
 ``off`` | ``basic`` | ``full``).  ``off`` keeps every instrumented call
@@ -31,7 +37,8 @@ from __future__ import annotations
 from typing import Optional
 
 from pint_tpu import config
-from pint_tpu.telemetry import costs, jaxevents, metrics, runlog, spans
+from pint_tpu.telemetry import costs, distview, jaxevents, metrics, runlog, \
+    spans
 from pint_tpu.telemetry.spans import (
     current_span,
     event,
@@ -41,7 +48,7 @@ from pint_tpu.telemetry.spans import (
 
 __all__ = ["span", "event", "set_attr", "current_span", "mode", "enabled",
            "activate", "deactivate", "spans", "metrics", "jaxevents",
-           "runlog", "costs"]
+           "runlog", "costs", "distview"]
 
 
 def mode() -> str:

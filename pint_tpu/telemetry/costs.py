@@ -36,8 +36,8 @@ from typing import Any, Dict, Optional, Tuple
 
 __all__ = ["CostProfile", "COST_PROFILE_SCHEMA", "normalize_cost_analysis",
            "normalize_memory_analysis", "analyze_compiled", "analyze_jitted",
-           "record_cost_profile", "profile_grid", "profile_fit_step",
-           "profile_gls_solve", "profile_workload"]
+           "compiled_for", "record_cost_profile", "profile_grid",
+           "profile_fit_step", "profile_gls_solve", "profile_workload"]
 
 COST_PROFILE_SCHEMA = "pint_tpu.telemetry.cost_profile/1"
 
@@ -267,6 +267,36 @@ def _analysis_key(fn, args, kwargs) -> Optional[tuple]:
         return None
 
 
+#: memoized COMPILED EXECUTABLES keyed like _ANALYSIS_CACHE; shared by
+#: this module and telemetry.distview so cost + collective + sharding
+#: analysis of one executable pays ONE AOT compile.  Values keep a
+#: strong ref to fn (id() stability) and the compiled object; smaller
+#: bound than the profile cache — executables hold real programs.
+_COMPILED_CACHE: Dict[tuple, Tuple[Any, Any]] = {}
+_COMPILED_CACHE_MAX = 16
+
+
+def compiled_for(fn, *args, **kwargs):
+    """The ``jax.stages.Compiled`` executable of ``fn`` at ``args``,
+    memoized per (fn, arg shapes/dtypes/shardings).  The deliberate AOT
+    compile runs with the jaxevents accounting paused so it never skews
+    the workload compile counters the analyses exist to contextualize.
+    Raises on lower/compile failure — callers (analyze_jitted, the
+    distview analyzers) degrade it into their profile's error slot."""
+    key = _analysis_key(fn, args, kwargs)
+    if key is not None and key in _COMPILED_CACHE:
+        return _COMPILED_CACHE[key][1]
+    from pint_tpu.telemetry import jaxevents
+
+    with jaxevents.accounting_paused():
+        compiled = fn.lower(*args, **kwargs).compile()
+    if key is not None:
+        while len(_COMPILED_CACHE) >= _COMPILED_CACHE_MAX:
+            _COMPILED_CACHE.pop(next(iter(_COMPILED_CACHE)))
+        _COMPILED_CACHE[key] = (fn, compiled)
+    return compiled
+
+
 def analyze_jitted(fn, *args, name: str = "jitted", **kwargs) -> CostProfile:
     """Lower + compile ``fn`` (a ``jax.jit`` callable) at ``args`` and
     analyze the executable.  Results are memoized per (fn, arg
@@ -274,10 +304,10 @@ def analyze_jitted(fn, *args, name: str = "jitted", **kwargs) -> CostProfile:
     NOT consult jit's dispatch cache (measured: a warm jit still fires a
     fresh backend_compile), so a repeat analysis would otherwise pay a
     full recompile; only a configured persistent compilation cache can
-    serve the first one.  The deliberate analysis compile runs with the
-    jaxevents accounting paused so it never skews the workload compile
-    counters it exists to contextualize.  Degrades to an empty profile
-    carrying the error string — never raises."""
+    serve the first one.  The compile itself goes through
+    :func:`compiled_for` (accounting paused, executable memoized for the
+    distview analyzers).  Degrades to an empty profile carrying the
+    error string — never raises."""
     import dataclasses
 
     key = _analysis_key(fn, args, kwargs)
@@ -285,11 +315,8 @@ def analyze_jitted(fn, *args, name: str = "jitted", **kwargs) -> CostProfile:
         # re-stamp the caller's label: the cached payload may have been
         # produced under a different name for the same executable
         return dataclasses.replace(_ANALYSIS_CACHE[key][1], name=name)
-    from pint_tpu.telemetry import jaxevents
-
     try:
-        with jaxevents.accounting_paused():
-            compiled = fn.lower(*args, **kwargs).compile()
+        compiled = compiled_for(fn, *args, **kwargs)
     except Exception as e:
         return CostProfile(name=name,
                            error=f"lower/compile: {type(e).__name__}: {e}")

@@ -1,0 +1,463 @@
+"""Distributed-execution observatory: collective accounting + sharding plans.
+
+PR 5's :mod:`~pint_tpu.telemetry.costs` answers "what does the compiled
+executable cost to run" (FLOPs, bytes, HBM footprint) but is SPMD-blind:
+on a sharded executable it reports the per-device program cost and stops
+there.  This module answers the two questions the mesh promotion
+(ROADMAP item 1) needs before any partition plan can be judged:
+
+* **How much moved between devices?**  :func:`analyze_compiled_collectives`
+  scrapes the compiled HLO (``compiled.as_text()``) for the collective
+  ops XLA's SPMD partitioner inserted — ``all-reduce`` / ``all-gather`` /
+  ``reduce-scatter`` / ``collective-permute`` / ``all-to-all`` — into a
+  :class:`CollectiveProfile`: per-kind op counts and bytes, the
+  comm/compute byte ratio against the cost model's ``bytes accessed``,
+  replica-group sizes and the mesh axes involved.  Like
+  :class:`~pint_tpu.telemetry.costs.CostProfile` it NEVER raises into
+  the fit path: every failure degrades to an empty-but-schema-valid
+  profile carrying the error string.
+
+* **How was the work placed?**  :func:`sharding_plan_of` records the
+  executable's input/output ``NamedSharding``s (spec strings) and mesh
+  shape into a ``sharding_plan`` document; :func:`record_sharding_plan`
+  lands it as a runlog event AND into the run manifest, so every
+  analyzed executable's placement is auditable after the fact
+  (``python -m tools.telemetry_report`` renders both).
+
+Byte counts are the HLO *result-shape* bytes of each collective — the
+payload a device contributes to / receives from the primitive — summed
+per kind.  That is the partitioner-visible traffic, not a wire-level
+measurement (on-chip reduction trees and ICI topology halve or multiply
+actual link bytes); the number is comparable across plans, which is what
+the scaling gate (``tools/scalewatch.py``) needs.
+
+Everything here is HOST-side analysis of already-built executables —
+calling it inside a traced function is flagged by jaxlint's
+host-call-in-jit rule (the ``distview`` submodule is in its telemetry
+target set).  The deliberate AOT compile is shared with
+:func:`pint_tpu.telemetry.costs.compiled_for`, so observing cost +
+collectives + sharding of one executable pays ONE lower/compile.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["CollectiveProfile", "COLLECTIVE_PROFILE_SCHEMA",
+           "SHARDING_PLAN_SCHEMA", "MULTICHIP_SCHEMA", "COLLECTIVE_KINDS",
+           "parse_hlo_collectives", "analyze_compiled_collectives",
+           "analyze_jitted_collectives", "sharding_plan_of",
+           "sharding_plan_of_jitted", "record_collective_profile",
+           "record_sharding_plan", "observe_jitted", "observe_grid",
+           "multichip_record"]
+
+COLLECTIVE_PROFILE_SCHEMA = "pint_tpu.telemetry.collective_profile/1"
+SHARDING_PLAN_SCHEMA = "pint_tpu.telemetry.sharding_plan/1"
+#: one schema-tagged JSON line in the ``dryrun_multichip`` tail (and the
+#: ``MULTICHIP_r*.json`` artifacts that capture it); ``record`` selects
+#: the body: correctness | cost | collective | sharding_plan | scaling |
+#: measurement
+MULTICHIP_SCHEMA = "pint_tpu.telemetry.multichip/1"
+
+#: the SPMD partitioner's cross-device primitives, as they appear in
+#: optimized HLO text (async ``-start`` forms are folded into the base
+#: kind; ``-done`` halves carry no payload of their own and are skipped)
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+#: HLO element type -> bytes per element
+_DTYPE_BYTES = {"pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+                "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+                "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+_SHAPE_RE = re.compile(r"\b(pred|bf16|c64|c128|[suf]\d+)\[([\d,]*)\]")
+#: `%name = <result shape(s)> <kind>(...)` — the shape sits between the
+#: `=` and the op invocation; tuple results (async starts) keep every
+#: member shape in the captured span
+_COLL_RE = re.compile(
+    r"=\s*(?P<shape>[^=]*?)\s*"
+    r"(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<start>-start)?\(")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{\{([0-9, ]*)\}")
+
+
+def _shape_token_bytes(shape_text: str) -> List[float]:
+    """Bytes of each ``dtype[dims]`` token in *shape_text* (a single
+    shape, or a tuple's joined member list)."""
+    out: List[float] = []
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        out.append(float(n * _DTYPE_BYTES.get(dtype, 4)))
+    return out
+
+
+def _shape_bytes(shape_text: str) -> float:
+    """Total bytes of every shape token in *shape_text*."""
+    return float(sum(_shape_token_bytes(shape_text)))
+
+
+def parse_hlo_collectives(hlo_text: str) -> List[Tuple[str, float, int]]:
+    """Every collective op in optimized HLO text, as
+    ``(kind, result_bytes, group_size)`` tuples.
+
+    ``group_size`` is the number of participating devices per replica
+    group (0 when the HLO line carries no parseable ``replica_groups``
+    — an empty group set means "all devices", which the caller knows
+    and this parser does not)."""
+    out: List[Tuple[str, float, int]] = []
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None:
+            continue
+        group = 0
+        gi = _GROUPS_IOTA_RE.search(line)
+        if gi is not None:
+            group = int(gi.group(2))
+        else:
+            gl = _GROUPS_LIST_RE.search(line)
+            if gl is not None:
+                ids = [s for s in gl.group(1).split(",") if s.strip()]
+                group = len(ids)
+        tokens = _shape_token_bytes(m.group("shape"))
+        kind = m.group("kind")
+        # async `-start` results are tuples that alias the OPERAND next
+        # to the result (plus u32 context buffers for permutes) — the
+        # payload is the member matching the SYNC spelling's result, or
+        # the async spelling of the same collective would report
+        # different bytes and break cross-plan comparability.  For
+        # every kind but reduce-scatter the result is the largest
+        # member (all-gather grows, the rest are same-size); reduce-
+        # scatter's result is 1/N of the operand, so there max() would
+        # pick the operand and report N x the sync number
+        if not tokens:
+            nbytes = 0.0
+        elif m.group("start"):
+            nbytes = min(tokens) if kind == "reduce-scatter" \
+                else max(tokens)
+        else:
+            nbytes = sum(tokens)
+        out.append((kind, nbytes, group))
+    return out
+
+
+@dataclass
+class CollectiveProfile:
+    """Cross-device communication of one compiled executable.
+
+    ``ops`` maps collective kind -> ``{"count": int, "bytes": float}``;
+    an executable with no collectives has an empty ``ops`` and a
+    comm/compute ratio of exactly 0.0 (when compute bytes are known) —
+    that is a *measurement* ("this plan moves nothing"), not a
+    degradation.  ``error`` alone marks degradation."""
+
+    name: str
+    backend: Optional[str] = None
+    num_devices: int = 1
+    #: mesh axis name -> size, from the executable's NamedShardings
+    mesh_axes: Dict[str, int] = field(default_factory=dict)
+    ops: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    #: distinct replica-group sizes seen (0 = "all devices" spelling)
+    group_sizes: List[int] = field(default_factory=list)
+    #: per-device-program compute bytes (cost model's "bytes accessed")
+    compute_bytes: Optional[float] = None
+    flops: Optional[float] = None
+    #: why the scrape came back empty (degrade-never-raise contract)
+    error: Optional[str] = None
+
+    @property
+    def collective_count(self) -> int:
+        return int(sum(v["count"] for v in self.ops.values()))
+
+    @property
+    def collective_bytes(self) -> float:
+        return float(sum(v["bytes"] for v in self.ops.values()))
+
+    @property
+    def comm_compute_ratio(self) -> Optional[float]:
+        """Collective bytes per compute byte of the per-device program;
+        None when compute bytes are unknown (never a fabricated 0)."""
+        if self.compute_bytes is None or self.compute_bytes <= 0:
+            return None
+        return self.collective_bytes / self.compute_bytes
+
+    def add(self, kind: str, nbytes: float, group: int) -> None:
+        slot = self.ops.setdefault(kind, {"count": 0, "bytes": 0.0})
+        slot["count"] += 1
+        slot["bytes"] += float(nbytes)
+        if group not in self.group_sizes:
+            self.group_sizes.append(group)
+
+    def to_dict(self) -> dict:
+        """JSON-ready body of a ``collective_profile`` runlog event:
+        every headline key present, explicitly null when unknown."""
+        d: Dict[str, Any] = {
+            "schema": COLLECTIVE_PROFILE_SCHEMA, "name": self.name,
+            "backend": self.backend, "num_devices": self.num_devices,
+            "mesh_axes": dict(self.mesh_axes),
+            "ops": {k: dict(v) for k, v in sorted(self.ops.items())},
+            "group_sizes": sorted(self.group_sizes),
+            "collective_count": self.collective_count,
+            "collective_bytes": self.collective_bytes,
+            "compute_bytes": self.compute_bytes,
+            "flops": self.flops,
+            "comm_compute_ratio": self.comm_compute_ratio,
+        }
+        if self.error:
+            d["error"] = self.error
+        return d
+
+    def span_attrs(self) -> dict:
+        out = {"collective.count": self.collective_count,
+               "collective.bytes": self.collective_bytes}
+        if self.comm_compute_ratio is not None:
+            out["collective.comm_compute_ratio"] = self.comm_compute_ratio
+        return out
+
+
+def _sharding_leaves(compiled) -> Tuple[list, list]:
+    """(input shardings, output shardings) as flat leaf lists; best
+    effort — missing properties yield empty lists, never a raise."""
+    import jax
+
+    ins: list = []
+    outs: list = []
+    try:
+        in_sh = compiled.input_shardings  # (args tuple, kwargs dict)
+        ins = list(jax.tree_util.tree_leaves(in_sh))
+    except Exception:
+        pass
+    try:
+        outs = list(jax.tree_util.tree_leaves(compiled.output_shardings))
+    except Exception:
+        pass
+    return ins, outs
+
+
+def _mesh_axes_of(shardings) -> Dict[str, int]:
+    """Axis name -> size of the first NamedSharding mesh found."""
+    for s in shardings:
+        mesh = getattr(s, "mesh", None)
+        if mesh is not None and getattr(mesh, "shape", None):
+            try:
+                return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+            except Exception:
+                continue
+    return {}
+
+
+def analyze_compiled_collectives(compiled, name: str) -> CollectiveProfile:
+    """CollectiveProfile of an already-compiled ``jax.stages.Compiled``.
+
+    Never raises: an ``as_text()`` refusal (some backends gate HLO dumps)
+    lands in ``profile.error`` with ``ops`` left empty."""
+    from pint_tpu.telemetry import costs as _costs
+
+    prof = CollectiveProfile(name=name)
+    try:
+        hlo = compiled.as_text()
+    except Exception as e:
+        prof.error = f"as_text: {type(e).__name__}: {e}"
+        hlo = None
+    if hlo is not None:
+        try:
+            for kind, nbytes, group in parse_hlo_collectives(hlo):
+                prof.add(kind, nbytes, group)
+        except Exception as e:  # regex engine limits on hostile text
+            prof.error = f"hlo parse: {type(e).__name__}: {e}"
+    try:
+        cost = _costs.normalize_cost_analysis(compiled.cost_analysis())
+        prof.compute_bytes = cost.get("bytes_accessed")
+        prof.flops = cost.get("flops")
+    except Exception:
+        pass  # comm bytes stand alone; ratio stays null
+    devices = _costs._device_list(compiled)
+    if devices:
+        prof.num_devices = len(devices)
+        prof.backend = getattr(devices[0], "platform", None)
+    ins, outs = _sharding_leaves(compiled)
+    prof.mesh_axes = _mesh_axes_of(ins + outs)
+    if prof.backend is None:
+        try:
+            import jax
+
+            prof.backend = jax.default_backend()
+        except Exception:
+            pass
+    return prof
+
+
+def analyze_jitted_collectives(fn, *args, name: str = "jitted",
+                               **kwargs) -> CollectiveProfile:
+    """Lower + compile ``fn`` at ``args`` (through the shared
+    :func:`~pint_tpu.telemetry.costs.compiled_for` cache, so a cost
+    analysis of the same executable pays no second compile) and scrape
+    its collectives.  Degrades to an error-carrying profile — never
+    raises."""
+    from pint_tpu.telemetry import costs as _costs
+
+    try:
+        compiled = _costs.compiled_for(fn, *args, **kwargs)
+    except Exception as e:
+        return CollectiveProfile(
+            name=name, error=f"lower/compile: {type(e).__name__}: {e}")
+    return analyze_compiled_collectives(compiled, name)
+
+
+# ---------------------------------------------------------------------------
+# sharding-plan introspection
+# ---------------------------------------------------------------------------
+
+def _render_sharding(s) -> str:
+    """One sharding leaf as a stable string: the PartitionSpec for
+    NamedShardings, the repr for anything else."""
+    spec = getattr(s, "spec", None)
+    if spec is not None:
+        return str(spec)
+    return type(s).__name__ if s is not None else "None"
+
+
+def _empty_sharding_plan(name: str, error: Optional[str] = None) -> dict:
+    """The schema-valid baseline plan every producer starts from (and
+    every degraded path returns) — ONE literal, so a schema change
+    cannot leave one code path emitting a stale shape."""
+    return {"schema": SHARDING_PLAN_SCHEMA, "name": name, "mesh": None,
+            "num_devices": 1, "backend": None, "inputs": [], "outputs": [],
+            "error": error}
+
+
+def sharding_plan_of(compiled, name: str) -> dict:
+    """The executable's placement as a ``sharding_plan`` document:
+    mesh shape, input/output PartitionSpec strings, device count.
+    Never raises; an unreadable executable yields a schema-valid plan
+    carrying ``error``."""
+    from pint_tpu.telemetry import costs as _costs
+
+    plan = _empty_sharding_plan(name)
+    try:
+        ins, outs = _sharding_leaves(compiled)
+        plan["inputs"] = [_render_sharding(s) for s in ins]
+        plan["outputs"] = [_render_sharding(s) for s in outs]
+        axes = _mesh_axes_of(ins + outs)
+        plan["mesh"] = axes or None
+        devices = _costs._device_list(compiled)
+        if devices:
+            plan["num_devices"] = len(devices)
+            plan["backend"] = getattr(devices[0], "platform", None)
+    except Exception as e:
+        plan["error"] = f"{type(e).__name__}: {e}"
+    return plan
+
+
+def sharding_plan_of_jitted(fn, *args, name: str = "jitted",
+                            **kwargs) -> dict:
+    """:func:`sharding_plan_of` through the shared compile cache."""
+    from pint_tpu.telemetry import costs as _costs
+
+    try:
+        compiled = _costs.compiled_for(fn, *args, **kwargs)
+    except Exception as e:
+        return _empty_sharding_plan(
+            name, error=f"lower/compile: {type(e).__name__}: {e}")
+    return sharding_plan_of(compiled, name)
+
+
+# ---------------------------------------------------------------------------
+# telemetry-stream recording
+# ---------------------------------------------------------------------------
+
+def record_collective_profile(prof: CollectiveProfile) -> CollectiveProfile:
+    """Land a collective profile in the telemetry streams: span attrs +
+    a ``collective_profile`` event on the current span, and (run open)
+    a ``collective_profile`` record in the run log.  No-op when
+    telemetry is off; returns the profile either way."""
+    from pint_tpu import config
+
+    if config._telemetry_mode == "off":
+        return prof
+    from pint_tpu.telemetry import runlog, spans
+
+    sp = spans.current_span()
+    if sp is not None:
+        sp.attrs.update(prof.span_attrs())
+        sp.add_event("collective_profile", executable=prof.name,
+                     count=prof.collective_count,
+                     bytes=prof.collective_bytes,
+                     comm_compute_ratio=prof.comm_compute_ratio)
+    run = runlog.current_run()
+    if run is not None:
+        run.record_collective_profile(prof.to_dict())
+    return prof
+
+
+def record_sharding_plan(plan: dict) -> dict:
+    """Land a sharding plan as a ``sharding_plan`` runlog event AND into
+    the run manifest (``manifest["sharding_plans"][name]``), so the
+    placement of every analyzed executable survives with the run
+    identity.  No-op when telemetry is off or no run is open."""
+    from pint_tpu import config
+
+    if config._telemetry_mode == "off":
+        return plan
+    from pint_tpu.telemetry import runlog
+
+    run = runlog.current_run()
+    if run is not None:
+        run.record_sharding_plan(plan)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# workload-level conveniences
+# ---------------------------------------------------------------------------
+
+def observe_jitted(fn, *args, name: str = "jitted", record: bool = False,
+                   **kwargs) -> Dict[str, dict]:
+    """The full observatory view of one executable at one set of args:
+    ``{"cost": ..., "collectives": ..., "sharding_plan": ...}`` (each a
+    schema-valid dict), paying ONE lower/compile via the shared cache.
+    With ``record=True`` the three documents also land in the telemetry
+    streams.  Never raises — each part degrades independently."""
+    from pint_tpu.telemetry import costs as _costs
+
+    cost = _costs.analyze_jitted(fn, *args, name=name, **kwargs)
+    coll = analyze_jitted_collectives(fn, *args, name=name, **kwargs)
+    plan = sharding_plan_of_jitted(fn, *args, name=name, **kwargs)
+    if record:
+        _costs.record_cost_profile(cost)
+        record_collective_profile(coll)
+        record_sharding_plan(plan)
+    return {"cost": cost.to_dict(), "collectives": coll.to_dict(),
+            "sharding_plan": plan}
+
+
+def observe_grid(ftr, record: bool = False) -> Dict[str, dict]:
+    """Observatory view of the most recent grid executable evaluated
+    through ``ftr`` (``grid_chisq`` records the handle); degraded
+    documents with an error string when no grid ran yet."""
+    handle = getattr(ftr, "last_grid_executable", None)
+    if handle is None:
+        err = ("no grid executable recorded on this fitter "
+               "(run grid_chisq first)")
+        from pint_tpu.telemetry.costs import CostProfile
+
+        return {"cost": CostProfile(name="grid.chunk", error=err).to_dict(),
+                "collectives": CollectiveProfile(name="grid.chunk",
+                                                 error=err).to_dict(),
+                "sharding_plan": _empty_sharding_plan("grid.chunk",
+                                                      error=err)}
+    vfn, args = handle
+    return observe_jitted(vfn, *args, name="grid.chunk", record=record)
+
+
+def multichip_record(record: str, **body) -> dict:
+    """One schema-tagged multichip JSON-line body (the
+    ``dryrun_multichip`` tail contract ``tools/telemetry_report --check``
+    validates and ``tools/perfwatch`` / ``tools/scalewatch`` ingest)."""
+    return {"schema": MULTICHIP_SCHEMA, "record": record, **body}

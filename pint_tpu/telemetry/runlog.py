@@ -45,6 +45,8 @@ EVENT_SCHEMA = "pint_tpu.telemetry.event/1"
 #: event type -> required body key (None: no body beyond type/t)
 EVENT_TYPES = {"span": "span", "event": "event", "metrics": "metrics",
                "cost_profile": "cost_profile",
+               "collective_profile": "collective_profile",
+               "sharding_plan": "sharding_plan",
                "run_start": "run", "run_end": "run"}
 
 #: environment knobs worth snapshotting into the manifest
@@ -204,6 +206,34 @@ class RunLog:
         """Append one AOT cost-attribution record
         (:meth:`pint_tpu.telemetry.costs.CostProfile.to_dict`)."""
         self._write("cost_profile", cost_profile=profile)
+
+    def record_collective_profile(self, profile: dict) -> None:
+        """Append one collective-comms accounting record
+        (:meth:`pint_tpu.telemetry.distview.CollectiveProfile.to_dict`)."""
+        self._write("collective_profile", collective_profile=profile)
+
+    def record_sharding_plan(self, plan: dict) -> None:
+        """Append one ``sharding_plan`` record
+        (:func:`pint_tpu.telemetry.distview.sharding_plan_of`) AND fold
+        it into the manifest's ``sharding_plans`` map, keyed by
+        executable name, so a run's placement decisions live with its
+        identity document (latest plan per name wins)."""
+        self._write("sharding_plan", sharding_plan=plan)
+        name = plan.get("name") if isinstance(plan, dict) else None
+        if name:
+            self.manifest.setdefault("sharding_plans", {})[name] = plan
+            self._rewrite_manifest()
+
+    def _rewrite_manifest(self) -> None:
+        """Persist the (annotated) manifest; a failed rewrite degrades
+        to a warning — the original manifest from __init__ survives."""
+        try:
+            with open(self.manifest_path, "w", encoding="utf-8") as f:
+                json.dump(_sanitize(self.manifest), f, indent=2,
+                          sort_keys=True, default=str)
+                f.write("\n")
+        except (OSError, ValueError) as e:
+            log.warning(f"telemetry manifest rewrite failed: {e}")
 
     def record_metrics(self) -> None:
         """Append a snapshot of the process metrics registry."""

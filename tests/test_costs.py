@@ -399,9 +399,10 @@ class TestTraceSummary:
             event_metadata = {1: Meta("parent"), 2: Meta("child")}
 
         rep = TraceReport("unused")
-        rep._accumulate_line(Plane(), Line())
-        assert rep.ops["parent"] == pytest.approx(60e-12)
-        assert rep.ops["child"] == pytest.approx(40e-12)
+        ops = {}
+        rep._accumulate_line(Plane(), Line(), ops)
+        assert ops["parent"] == pytest.approx(60e-12)
+        assert ops["child"] == pytest.approx(40e-12)
 
     def test_self_time_child_shares_parent_start(self):
         """A child starting at the SAME ps as its parent (a region event
@@ -428,7 +429,8 @@ class TestTraceSummary:
             event_metadata = {1: Meta("parent"), 2: Meta("child")}
 
         rep = TraceReport("unused")
-        rep._accumulate_line(Plane(), Line())
-        assert rep.ops["parent"] == pytest.approx(5e-12)
-        assert rep.ops["child"] == pytest.approx(5e-12)
-        assert all(v >= 0 for v in rep.ops.values())
+        ops = {}
+        rep._accumulate_line(Plane(), Line(), ops)
+        assert ops["parent"] == pytest.approx(5e-12)
+        assert ops["child"] == pytest.approx(5e-12)
+        assert all(v >= 0 for v in ops.values())

@@ -1,0 +1,394 @@
+"""Distributed-execution observatory under test (telemetry/distview.py).
+
+The contract tier-1 (8 virtual CPU devices) pins: the HLO collective
+scrape turns a sharded executable into a schema-valid CollectiveProfile
+(all-reduce count/bytes, comm/compute ratio, mesh axes) and NEVER raises
+into the fit path; a deliberately unsharded executable yields an EMPTY
+profile (ratio exactly 0.0 — a measurement, not a degradation); sharding
+plans land in the runlog event stream AND the run manifest; and the grid
+attach path records all three observatory documents in full mode.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.distview, pytest.mark.perfwatch]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tests.test_costs import _tiny_gls_fitter, fresh_telemetry  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing (no backend required)
+# ---------------------------------------------------------------------------
+
+class TestHLOParse:
+    def test_basic_all_reduce(self):
+        from pint_tpu.telemetry.distview import parse_hlo_collectives
+
+        hlo = ('ROOT %all-reduce = f32[5,5]{1,0} all-reduce(f32[5,5]{1,0} '
+               '%dot), channel_id=1, replica_groups=[1,8]<=[8], '
+               'use_global_device_ids=true, to_apply=%add.clone')
+        out = parse_hlo_collectives(hlo)
+        assert out == [("all-reduce", 100.0, 8)]
+
+    def test_f64_and_explicit_groups(self):
+        from pint_tpu.telemetry.distview import parse_hlo_collectives
+
+        hlo = ('%ag = f64[16,3]{1,0} all-gather(f64[4,3]{1,0} %p), '
+               'replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}')
+        (kind, nbytes, group), = parse_hlo_collectives(hlo)
+        assert kind == "all-gather"
+        assert nbytes == 16 * 3 * 8
+        assert group == 4
+
+    def test_tuple_shape_and_async_start(self):
+        """Async `-start` halves carry the payload; their tuple result
+        repeats the OPERAND next to the result, so the payload is the
+        largest member — the async spelling must report the SAME bytes
+        as the sync spelling of the collective (the scaling gate
+        compares the number across plans/backends).  `-done` halves
+        carry none and are skipped."""
+        from pint_tpu.telemetry.distview import parse_hlo_collectives
+
+        hlo = ("%ars = (f32[4]{0}, f32[4]{0}) all-reduce-start(f32[4]{0} "
+               "%x), replica_groups=[2,4]<=[8], to_apply=%add\n"
+               "%ard = f32[4]{0} all-reduce-done((f32[4]{0}, f32[4]{0}) "
+               "%ars)")
+        out = parse_hlo_collectives(hlo)
+        assert len(out) == 1
+        kind, nbytes, group = out[0]
+        assert kind == "all-reduce" and nbytes == 4 * 4 and group == 4
+        sync = parse_hlo_collectives(
+            "%ar = f32[4]{0} all-reduce(f32[4]{0} %x), "
+            "replica_groups=[2,4]<=[8], to_apply=%add")
+        assert sync[0][1] == nbytes  # async == sync bytes
+
+    def test_async_all_gather_start_counts_result_not_operand(self):
+        """all-gather-start's tuple is (operand, result): bytes must be
+        the gathered RESULT, with collective-permute-start's u32 context
+        members ignored too."""
+        from pint_tpu.telemetry.distview import parse_hlo_collectives
+
+        hlo = ("%ags = (f32[4,3]{1,0}, f32[32,3]{1,0}) all-gather-start("
+               "f32[4,3]{1,0} %p), replica_groups=[1,8]<=[8], "
+               "dimensions={0}\n"
+               "%cps = (f32[8]{0}, f32[8]{0}, u32[], u32[]) "
+               "collective-permute-start(f32[8]{0} %q), "
+               "source_target_pairs={{0,1},{1,0}}")
+        out = parse_hlo_collectives(hlo)
+        assert out[0] == ("all-gather", 32 * 3 * 4, 8)
+        assert out[1][0] == "collective-permute" and out[1][1] == 8 * 4
+
+    def test_async_reduce_scatter_start_counts_scattered_result(self):
+        """reduce-scatter-start's tuple is (operand, result) with the
+        result 1/N of the operand: bytes must be the scattered RESULT
+        (matching the sync spelling), not the max() tuple member — or a
+        backend flipping sync<->async emission would shift the comm-
+        ratio gate by ~N x with no real plan change."""
+        from pint_tpu.telemetry.distview import parse_hlo_collectives
+
+        hlo = ("%rss = (f32[1024]{0}, f32[128]{0}) reduce-scatter-start("
+               "f32[1024]{0} %x), replica_groups=[1,8]<=[8], "
+               "dimensions={0}, to_apply=%add")
+        out = parse_hlo_collectives(hlo)
+        assert out == [("reduce-scatter", 128 * 4, 8)]
+        sync = parse_hlo_collectives(
+            "%rs = f32[128]{0} reduce-scatter(f32[1024]{0} %x), "
+            "replica_groups=[1,8]<=[8], dimensions={0}, to_apply=%add")
+        assert sync[0][1] == out[0][1]  # async == sync bytes
+
+    def test_every_kind_and_no_collectives(self):
+        from pint_tpu.telemetry.distview import (COLLECTIVE_KINDS,
+                                                 parse_hlo_collectives)
+
+        lines = [f"%c{i} = f32[2]{{0}} {kind}(f32[2]{{0}} %x)"
+                 for i, kind in enumerate(COLLECTIVE_KINDS)]
+        out = parse_hlo_collectives("\n".join(lines))
+        assert [k for k, _, _ in out] == list(COLLECTIVE_KINDS)
+        assert parse_hlo_collectives(
+            "%fusion = f32[8]{0} fusion(f32[8]{0} %p), kind=kLoop") == []
+
+    def test_metadata_mentions_do_not_match(self):
+        """op_name metadata strings mentioning reductions must not be
+        scraped as collectives."""
+        from pint_tpu.telemetry.distview import parse_hlo_collectives
+
+        hlo = ('%f = f32[8]{0} fusion(f32[8]{0} %p), metadata='
+               '{op_name="jit(f)/all_reduce_sum" source_file="x.py"}')
+        assert parse_hlo_collectives(hlo) == []
+
+
+class TestCollectiveProfileSchema:
+    def test_to_dict_complete_and_json(self):
+        from pint_tpu.telemetry.distview import (COLLECTIVE_PROFILE_SCHEMA,
+                                                 CollectiveProfile)
+
+        p = CollectiveProfile(name="x", num_devices=8,
+                              mesh_axes={"toa": 8}, compute_bytes=100.0)
+        p.add("all-reduce", 25.0, 8)
+        p.add("all-reduce", 25.0, 8)
+        d = p.to_dict()
+        assert d["schema"] == COLLECTIVE_PROFILE_SCHEMA
+        assert d["ops"]["all-reduce"] == {"count": 2, "bytes": 50.0}
+        assert d["collective_count"] == 2
+        assert d["collective_bytes"] == 50.0
+        assert d["comm_compute_ratio"] == 0.5
+        assert d["group_sizes"] == [8]
+        json.dumps(d)
+
+    def test_degraded_profile_schema_valid(self):
+        from pint_tpu.telemetry.distview import CollectiveProfile
+
+        d = CollectiveProfile(name="broken", error="synthetic").to_dict()
+        assert d["error"] == "synthetic"
+        assert d["ops"] == {} and d["collective_bytes"] == 0.0
+        assert d["comm_compute_ratio"] is None  # compute unknown: no 0
+        json.dumps(d)
+
+    def test_ratio_null_without_compute_bytes(self):
+        from pint_tpu.telemetry.distview import CollectiveProfile
+
+        p = CollectiveProfile(name="x")
+        p.add("all-gather", 10.0, 2)
+        assert p.comm_compute_ratio is None
+
+
+# ---------------------------------------------------------------------------
+# analysis entry points on the 8-virtual-device CPU backend
+# ---------------------------------------------------------------------------
+
+class TestAnalyze:
+    def test_sharded_normal_equations_show_all_reduce(self, eight_devices):
+        """The GLS normal-equation reduction over a TOA-sharded mesh
+        must show >= 1 all-reduce with non-zero bytes — the number the
+        sharding plan is judged by (ISSUE 6 acceptance)."""
+        import jax
+        from jax.sharding import Mesh
+
+        from pint_tpu.telemetry import distview
+
+        f = _tiny_gls_fitter()
+        f.fit_toas(maxiter=1)
+        mesh = Mesh(np.array(eight_devices), ("toa",))
+        fn, args = f.gls_normal_equations_executable(mesh=mesh)
+        prof = distview.analyze_jitted_collectives(
+            fn, *args, name="gls.normal_eq")
+        assert prof.error is None
+        ar = prof.ops.get("all-reduce")
+        assert ar is not None and ar["count"] >= 1 and ar["bytes"] > 0
+        assert prof.mesh_axes == {"toa": 8}
+        assert prof.num_devices == 8
+        assert prof.comm_compute_ratio is not None \
+            and prof.comm_compute_ratio > 0
+        # and the executable actually runs to a finite system
+        mtcm, mtcy = fn(*args)
+        assert np.all(np.isfinite(np.asarray(mtcm)))
+        assert np.all(np.isfinite(np.asarray(mtcy)))
+
+    def test_unsharded_executable_empty_profile(self):
+        """Degrade-never-raise twin: an unsharded executable yields an
+        EMPTY CollectiveProfile (ratio exactly 0.0, no error)."""
+        from pint_tpu.telemetry import distview
+
+        f = _tiny_gls_fitter()
+        f.fit_toas(maxiter=1)
+        fn, args = f.gls_normal_equations_executable()
+        prof = distview.analyze_jitted_collectives(fn, *args, name="plain")
+        assert prof.error is None
+        assert prof.ops == {}
+        assert prof.collective_bytes == 0.0
+        assert prof.comm_compute_ratio == 0.0
+
+    def test_uncompilable_degrades_never_raises(self):
+        from pint_tpu.telemetry import distview
+
+        prof = distview.analyze_jitted_collectives(
+            lambda z: z, 1.0, name="notjitted")
+        assert prof.error is not None and "lower/compile" in prof.error
+        json.dumps(prof.to_dict())
+
+    def test_hostile_compiled_degrades(self):
+        """A backend whose as_text/cost_analysis RAISE still yields a
+        schema-valid profile carrying the error string."""
+        from pint_tpu.telemetry import distview
+
+        class Hostile:
+            def as_text(self):
+                raise RuntimeError("no HLO for you")
+
+            def cost_analysis(self):
+                raise NotImplementedError
+
+        prof = distview.analyze_compiled_collectives(Hostile(), "hostile")
+        assert "no HLO for you" in prof.error
+        assert prof.ops == {}
+        json.dumps(prof.to_dict())
+
+    def test_shared_compile_cache_with_costs(self, eight_devices):
+        """Cost + collective + plan analysis of one executable pays ONE
+        AOT compile (the shared compiled_for cache) and the deliberate
+        compile stays out of the workload counters."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.telemetry import distview
+
+        class CountingJit:
+            """Duck-typed jitted fn: counts lower() calls."""
+
+            def __init__(self, fn):
+                self.fn = fn
+                self.lowers = 0
+
+            def lower(self, *a, **k):
+                self.lowers += 1
+                return self.fn.lower(*a, **k)
+
+        f = CountingJit(jax.jit(lambda x: (x * 3).sum()))
+        x = jnp.arange(23.0)
+        obs = distview.observe_jitted(f, x, name="shared")
+        assert f.lowers == 1, "observe_jitted must compile exactly once"
+        assert obs["cost"]["flops"] is not None
+        assert obs["collectives"]["ops"] == {}
+        assert obs["sharding_plan"]["name"] == "shared"
+
+
+class TestShardingPlan:
+    def test_plan_of_sharded_executable(self, eight_devices):
+        from jax.sharding import Mesh
+
+        from pint_tpu.telemetry import distview
+        from pint_tpu.telemetry.distview import SHARDING_PLAN_SCHEMA
+
+        f = _tiny_gls_fitter()
+        f.fit_toas(maxiter=1)
+        mesh = Mesh(np.array(eight_devices), ("toa",))
+        fn, args = f.gls_normal_equations_executable(mesh=mesh)
+        plan = distview.sharding_plan_of_jitted(fn, *args, name="ne")
+        assert plan["schema"] == SHARDING_PLAN_SCHEMA
+        assert plan["mesh"] == {"toa": 8}
+        assert plan["num_devices"] == 8
+        assert any("toa" in s for s in plan["inputs"])
+        assert plan["error"] is None
+        json.dumps(plan)
+
+    def test_plan_degrades_on_garbage(self):
+        from pint_tpu.telemetry import distview
+
+        plan = distview.sharding_plan_of(object(), "junk")
+        assert plan["mesh"] is None and plan["inputs"] == []
+        json.dumps(plan)
+
+
+# ---------------------------------------------------------------------------
+# recording: runlog events + manifest fold-in, end to end through grid
+# ---------------------------------------------------------------------------
+
+def _read_events(run_dir):
+    out = []
+    with open(os.path.join(run_dir, "events.jsonl"), encoding="utf-8") as f:
+        for line in f:
+            out.append(json.loads(line))
+    return out
+
+
+class TestRecording:
+    def test_records_land_in_runlog_and_manifest(self, fresh_telemetry,
+                                                 tmp_path):
+        from pint_tpu.telemetry import distview, runlog
+        from pint_tpu.telemetry.distview import CollectiveProfile
+
+        fresh_telemetry.activate("full")
+        run_dir = str(tmp_path / "run")
+        runlog.start_run(run_dir, name="distview-e2e", probe_device=False)
+        prof = CollectiveProfile(name="synthetic", num_devices=4,
+                                 mesh_axes={"grid": 4},
+                                 compute_bytes=10.0)
+        prof.add("all-reduce", 5.0, 4)
+        distview.record_collective_profile(prof)
+        distview.record_sharding_plan(
+            {"schema": distview.SHARDING_PLAN_SCHEMA, "name": "synthetic",
+             "mesh": {"grid": 4}, "num_devices": 4, "backend": "cpu",
+             "inputs": ["PartitionSpec('grid',)"], "outputs": [],
+             "error": None})
+        runlog.end_run()
+        events = _read_events(run_dir)
+        types = [e["type"] for e in events]
+        assert "collective_profile" in types
+        assert "sharding_plan" in types
+        with open(os.path.join(run_dir, "manifest.json"),
+                  encoding="utf-8") as f:
+            manifest = json.load(f)
+        assert "synthetic" in manifest["sharding_plans"]
+        # and the report CLI accepts the whole run
+        from tools.telemetry_report import main as report_main
+
+        assert report_main(["--check", run_dir]) == 0
+
+    def test_record_off_mode_is_noop(self, fresh_telemetry):
+        from pint_tpu.telemetry import distview
+        from pint_tpu.telemetry.distview import CollectiveProfile
+
+        prof = CollectiveProfile(name="off")
+        assert distview.record_collective_profile(prof) is prof
+        plan = {"name": "off"}
+        assert distview.record_sharding_plan(plan) is plan
+
+    def test_grid_full_mode_streams_all_three(self, fresh_telemetry,
+                                              tmp_path, eight_devices):
+        """grid_chisq on a mesh, under full telemetry: the runlog gains
+        cost_profile + collective_profile + sharding_plan records for
+        the sharded chunk executable, and the manifest knows the mesh."""
+        from jax.sharding import Mesh
+
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.telemetry import runlog
+
+        f = _tiny_gls_fitter()
+        fresh_telemetry.activate("full")
+        run_dir = str(tmp_path / "run")
+        runlog.start_run(run_dir, name="grid-dist", probe_device=False)
+        f.fit_toas(maxiter=1)
+        g0 = np.linspace(f.model.F0.value - 1e-9, f.model.F0.value + 1e-9, 4)
+        g1 = np.linspace(f.model.F1.value - 1e-17,
+                         f.model.F1.value + 1e-17, 4)
+        mesh = Mesh(np.array(eight_devices), ("grid",))
+        chi2, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=1, mesh=mesh)
+        assert np.all(np.isfinite(chi2))
+        runlog.end_run()
+        events = _read_events(run_dir)
+        by_type = {}
+        for e in events:
+            by_type.setdefault(e["type"], []).append(e)
+        assert "cost_profile" in by_type
+        colls = [e["collective_profile"]
+                 for e in by_type.get("collective_profile", [])]
+        assert any(c["name"] == "grid.chunk" for c in colls)
+        plans = [e["sharding_plan"]
+                 for e in by_type.get("sharding_plan", [])]
+        grid_plans = [p for p in plans if p["name"] == "grid.chunk"]
+        assert grid_plans and grid_plans[0]["mesh"] == {"grid": 8}
+        with open(os.path.join(run_dir, "manifest.json"),
+                  encoding="utf-8") as f_:
+            manifest = json.load(f_)
+        assert manifest["sharding_plans"]["grid.chunk"]["mesh"] == \
+            {"grid": 8}
+
+    def test_observe_grid_before_any_grid_degrades(self):
+        from pint_tpu.telemetry import distview
+
+        class Bare:
+            pass
+
+        obs = distview.observe_grid(Bare())
+        assert "grid_chisq" in obs["collectives"]["error"]
+        assert "grid_chisq" in obs["sharding_plan"]["error"]
+        json.dumps(obs)

@@ -256,6 +256,40 @@ class TestHostCallInJit:
         )
         assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
 
+    def test_distview_call_in_jit_flagged(self, tmp_path):
+        """telemetry.distview's HLO scrape is AOT lower/compile + host
+        parsing — inside a traced function it would re-enter tracing per
+        TRACE; the rule's target set must cover the distview submodule
+        like costs and every other telemetry spelling."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.telemetry import distview\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    distview.analyze_jitted_collectives(f, x, name='f')\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"]
+        assert "telemetry call" in findings[0].message
+
+    def test_distview_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — observe the executable
+        from host code around the jitted function — stays silent."""
+        good = (
+            "import jax\n"
+            "from pint_tpu.telemetry import distview as _dv\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "def host(x):\n"
+            "    prof = _dv.analyze_jitted_collectives(f, x, name='f')\n"
+            "    _dv.record_sharding_plan(_dv.sharding_plan_of_jitted(\n"
+            "        f, x, name='f'))\n"
+            "    return _dv.record_collective_profile(prof)\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

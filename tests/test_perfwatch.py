@@ -24,6 +24,7 @@ from tools.perfwatch import (  # noqa: E402
     collect,
     ingest_file,
     main,
+    render_report,
 )
 
 
@@ -111,6 +112,61 @@ class TestIngestion:
         assert r.kind == "multichip" and r.n_devices == 4
         assert r.multichip_cost["per_device"]["1"]["flops"] == 5.0
 
+    def test_multichip_schema_tagged_tail_records(self, tmp_path):
+        """Round-6+ dryrun tails carry pint_tpu.telemetry.multichip/1
+        records: mesh shape, collective profile, scaling ratio and
+        sharding plans all land on the RunRecord (and render)."""
+        schema = "pint_tpu.telemetry.multichip/1"
+        coll = {"schema": "pint_tpu.telemetry.collective_profile/1",
+                "name": "grid.chunk.sharded", "collective_count": 6,
+                "collective_bytes": 111616.0, "comm_compute_ratio": 0.1,
+                "compute_bytes": 1113983.0, "flops": 1.0,
+                "mesh_axes": {"grid": 8}, "num_devices": 8,
+                "group_sizes": [8],
+                "ops": {"all-gather": {"count": 6, "bytes": 111616.0}}}
+        plan = {"schema": "pint_tpu.telemetry.sharding_plan/1",
+                "name": "grid.chunk.sharded", "mesh": {"grid": 8},
+                "num_devices": 8, "backend": "cpu",
+                "inputs": ["PartitionSpec('grid',)"], "outputs": [],
+                "error": None}
+        cost = {"schema": "pint_tpu.telemetry.cost_profile/1",
+                "name": "multichip.fit_step", "flops": 9.0,
+                "num_devices": 8}
+        tail = "\n".join([
+            "dryrun_multichip OK: mesh stuff",
+            json.dumps({"schema": schema, "record": "correctness",
+                        "n_devices": 8, "mesh": {"grid": 2, "toa": 4},
+                        "chi2_spread": 5e-6}),
+            json.dumps({"schema": schema, "record": "cost",
+                        "cost": cost}),
+            json.dumps({"schema": schema, "record": "collective",
+                        "collective": coll}),
+            json.dumps({"schema": schema, "record": "sharding_plan",
+                        "sharding_plan": plan}),
+            json.dumps({"schema": schema, "record": "scaling",
+                        "n_devices": 8, "speedup": 0.9,
+                        "efficiency": 0.1125}),
+        ]) + "\n"
+        doc = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+               "tail": tail}
+        fn = tmp_path / "MULTICHIP_r06.json"
+        fn.write_text(json.dumps(doc))
+        r = ingest_file(str(fn), [])
+        assert r.mesh_shape == {"grid": 2, "toa": 4}
+        assert r.multichip_cost["flops"] == 9.0  # cost record filled it
+        assert r.multichip_collective["collective_bytes"] == 111616.0
+        assert r.multichip_scaling["speedup"] == 0.9
+        assert r.sharding_plans[0]["mesh"] == {"grid": 8}
+        # and the report renders the enrichment
+        import io
+
+        out = io.StringIO()
+        render_report([r], out=out)
+        text = out.getvalue()
+        assert "mesh={'grid': 2, 'toa': 4}" in text
+        assert "collectives[grid.chunk.sharded]" in text
+        assert "scaling: speedup 0.9" in text
+
     def test_history_schema(self, tmp_path):
         _bench(str(tmp_path), 1, 100.0)
         _bench(str(tmp_path), 2, 101.0)
@@ -186,6 +242,17 @@ class TestCheckGating:
         _bench(d, 4, 100.0, compile_s=20.0)  # 2x compile rise
         assert main(["--check", "--dir", d]) == 1
         assert "compile_s" in capsys.readouterr().out
+
+    def test_zero_compile_baseline_is_skipped_not_infinite(self, tmp_path):
+        """A compile_s history of 0.0 (warm persistent-compile-cache
+        rounds) must not make the first cold-cache run an ungateable
+        infinite regression — zero_baseline_fails stays off for
+        compile_s; only ratio-like quantities opt in."""
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, compile_s=0.0)
+        _bench(d, 4, 100.0, compile_s=25.0)  # first cold compile
+        assert main(["--check", "--dir", d]) == 0
 
     def test_threshold_configurable(self, tmp_path):
         d = str(tmp_path)

@@ -1,0 +1,199 @@
+"""Scaling-efficiency gate under test (tools/scalewatch.py).
+
+Unit level: artifact ingestion/validation and the MAD-floored gate
+(ISSUE 6 acceptance: --check exits 1 on a synthetic >30% efficiency
+drop, 0 on the committed history).  Integration level: one in-process
+worker measurement on the virtual CPU mesh produces the schema-tagged
+record set (measurement with per-device busy fractions, the GLS
+normal-equation all-reduce, a sharding plan) that the sweep assembles
+into the SCALING artifact.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.distview, pytest.mark.perfwatch]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.scalewatch import (  # noqa: E402
+    SCALING_SCHEMA,
+    _records_from_output,
+    check_history,
+    collect_history,
+    ingest_artifact,
+    main,
+)
+
+
+def _artifact(round_n, efficiency, ratio=0.1, tmp_path=None, **extra):
+    doc = {"schema": SCALING_SCHEMA, "created_unix": 0.0,
+           "platform": "cpu", "workload": "synthetic_gls_grid",
+           "device_counts": [1, 8], "max_devices": 8,
+           "efficiency_at_max": efficiency,
+           "comm_compute_ratio_at_max": ratio,
+           "series": [{"n_devices": 1, "wall_s": 1.0, "fits_per_sec": 64.0,
+                       "speedup": 1.0, "efficiency": 1.0,
+                       "comm_compute_ratio": 0.0, "busy_fractions": {}},
+                      {"n_devices": 8, "wall_s": 1.0, "fits_per_sec": 64.0,
+                       "speedup": 8 * efficiency,
+                       "efficiency": efficiency,
+                       "comm_compute_ratio": ratio,
+                       "busy_fractions": {}}]}
+    doc.update(extra)
+    path = tmp_path / f"SCALING_r{round_n:02d}.json"
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+class TestIngest:
+    def test_valid_artifact_round_trips(self, tmp_path):
+        p = _artifact(1, 0.8, tmp_path=tmp_path)
+        errors = []
+        doc = ingest_artifact(p, errors)
+        assert errors == []
+        assert doc["_round"] == 1
+        assert doc["efficiency_at_max"] == 0.8
+
+    def test_malformed_artifacts_error(self, tmp_path):
+        bad1 = tmp_path / "SCALING_r01.json"
+        bad1.write_text("{not json")
+        bad2 = tmp_path / "SCALING_r02.json"
+        bad2.write_text(json.dumps({"schema": "wrong/1"}))
+        bad3 = tmp_path / "SCALING_r03.json"
+        bad3.write_text(json.dumps({"schema": SCALING_SCHEMA,
+                                    "series": []}))
+        errors = []
+        for p in (bad1, bad2, bad3):
+            assert ingest_artifact(str(p), errors) is None
+        assert len(errors) == 3
+
+    def test_collect_orders_by_round(self, tmp_path):
+        _artifact(3, 0.5, tmp_path=tmp_path)
+        _artifact(1, 0.9, tmp_path=tmp_path)
+        errors = []
+        docs = collect_history([], str(tmp_path), errors)
+        assert [d["_round"] for d in docs] == [1, 3]
+
+    def test_records_from_output(self):
+        text = ("prose line\n"
+                '{"schema": "pint_tpu.telemetry.multichip/1", '
+                '"record": "measurement", "n_devices": 2, "wall_s": 1.0, '
+                '"fits_per_sec": 8.0}\n'
+                '{"untagged": true}\n')
+        recs = _records_from_output(text)
+        assert len(recs) == 1 and recs[0]["record"] == "measurement"
+
+
+class TestGate:
+    def test_synthetic_efficiency_drop_fails(self, tmp_path, capsys):
+        """The ISSUE 6 acceptance pin: a >30% efficiency drop between
+        the newest artifact and its history exits 1."""
+        _artifact(1, 0.80, tmp_path=tmp_path)
+        _artifact(2, 0.50, tmp_path=tmp_path)  # -37.5%
+        assert main(["--check", "--dir", str(tmp_path)]) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_flat_history_passes(self, tmp_path, capsys):
+        _artifact(1, 0.80, tmp_path=tmp_path)
+        _artifact(2, 0.78, tmp_path=tmp_path)
+        assert main(["--check", "--dir", str(tmp_path)]) == 0
+        assert "no meaningful scaling regression" in \
+            capsys.readouterr().out
+
+    def test_comm_ratio_rise_fails(self, tmp_path, capsys):
+        _artifact(1, 0.80, ratio=0.10, tmp_path=tmp_path)
+        _artifact(2, 0.80, ratio=0.20, tmp_path=tmp_path)  # +100%
+        assert main(["--check", "--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "comm_compute_ratio_at_max" in out
+
+    def test_zero_ratio_baseline_still_gates_new_comms(self, tmp_path):
+        """An all-zero comm-ratio history is a measurement ("this plan
+        moves nothing"): a newly nonzero ratio is an infinite relative
+        rise and must fail, zero baseline or not."""
+        _artifact(1, 0.80, ratio=0.0, tmp_path=tmp_path)
+        _artifact(2, 0.80, ratio=0.0, tmp_path=tmp_path)
+        _artifact(3, 0.80, ratio=0.05, tmp_path=tmp_path)
+        assert main(["--check", "--dir", str(tmp_path)]) == 1
+
+    def test_single_artifact_passes(self, tmp_path, capsys):
+        _artifact(1, 0.80, tmp_path=tmp_path)
+        assert main(["--check", "--dir", str(tmp_path)]) == 0
+        assert "no history to gate" in capsys.readouterr().out
+
+    def test_noisy_history_raises_the_bar(self, capsys):
+        """A drop inside the history's own MAD noise floor passes."""
+        history = []
+        for i, eff in enumerate((1.0, 0.5, 1.5, 0.55)):
+            history.append({"schema": SCALING_SCHEMA,
+                            "efficiency_at_max": eff,
+                            "comm_compute_ratio_at_max": 0.1,
+                            "max_devices": 8, "series": [{}],
+                            "_source": f"r{i}", "_round": i})
+        assert check_history(history, threshold=0.30, noise_mult=3.0) == 0
+
+    def test_malformed_history_fails_check(self, tmp_path):
+        (tmp_path / "SCALING_r01.json").write_text("{broken")
+        assert main(["--check", "--dir", str(tmp_path)]) == 1
+
+    def test_committed_history_passes(self, capsys):
+        """The repo's own committed SCALING_r* history gates clean —
+        exactly what the pre-commit hook runs."""
+        assert main(["--check"]) == 0
+
+
+class TestWorkerIntegration:
+    def test_worker_emits_full_record_set(self, eight_devices, capsys):
+        """One in-process worker measurement at 2 devices: the stdout
+        record set carries a measurement with per-device busy fractions,
+        a non-empty GLS normal-equation CollectiveProfile (all-reduce
+        bytes > 0), and a sharding plan — every record schema-valid per
+        the telemetry_report validators."""
+        from tools.scalewatch import run_worker
+        from tools.telemetry_report import validate_multichip_record
+
+        assert run_worker(2) == 0
+        recs = _records_from_output(capsys.readouterr().out)
+        errors = []
+        for rec in recs:
+            validate_multichip_record(rec, "worker", errors)
+        assert errors == []
+        by_kind = {}
+        for rec in recs:
+            by_kind.setdefault(rec["record"], []).append(rec)
+        meas = by_kind["measurement"][0]
+        assert meas["n_devices"] == 2
+        assert meas["fits_per_sec"] > 0
+        assert len(meas["busy_fractions"]) >= 1
+        colls = {c["collective"]["name"]: c["collective"]
+                 for c in by_kind["collective"]}
+        ne = colls["gls.normal_eq"]
+        assert ne["ops"]["all-reduce"]["bytes"] > 0
+        assert ne["comm_compute_ratio"] > 0
+        assert by_kind["sharding_plan"]
+
+    @pytest.mark.slow
+    def test_sweep_subprocess_end_to_end(self, tmp_path):
+        """The full parent path: subprocess workers at 1 and 2 devices,
+        artifact assembly, --emit, and the emitted artifact re-ingests
+        cleanly."""
+        from tools.scalewatch import run_sweep
+
+        errors = []
+        doc = run_sweep([1, 2], errors, timeout_s=600.0)
+        assert errors == []
+        assert doc is not None and doc["schema"] == SCALING_SCHEMA
+        assert [s["n_devices"] for s in doc["series"]] == [1, 2]
+        assert doc["series"][0]["efficiency"] == 1.0
+        assert doc["efficiency_at_max"] is not None
+        assert doc["comm_compute_ratio_at_max"] > 0
+        out = tmp_path / "SCALING_r99.json"
+        out.write_text(json.dumps(doc))
+        errs = []
+        assert ingest_artifact(str(out), errs) is not None and errs == []

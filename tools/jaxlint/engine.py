@@ -149,11 +149,12 @@ def walk_own(fn_node: ast.AST) -> Iterable[ast.AST]:
 # ---------------------------------------------------------------------------
 
 #: pint_tpu.telemetry submodules whose import binds a module alias, not a
-#: function name (``from pint_tpu.telemetry import metrics``).  costs is
-#: here because its AOT lower/compile analysis is pure host work — called
-#: inside a traced function it would re-enter tracing per TRACE, not per
-#: call (and hang under jit)
-_TELEMETRY_SUBMODULES = {"spans", "metrics", "jaxevents", "runlog", "costs"}
+#: function name (``from pint_tpu.telemetry import metrics``).  costs and
+#: distview are here because their AOT lower/compile analyses are pure
+#: host work — called inside a traced function they would re-enter
+#: tracing per TRACE, not per call (and hang under jit)
+_TELEMETRY_SUBMODULES = {"spans", "metrics", "jaxevents", "runlog", "costs",
+                         "distview"}
 
 
 def _record_imports(info: FileInfo) -> None:

@@ -43,6 +43,10 @@ import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/perfwatch.py` spelling
+    sys.path.insert(0, REPO)
+
 HISTORY_SCHEMA = "pint_tpu.perfwatch.history/1"
 
 #: artifact filename families swept from --dir, in ingestion order
@@ -77,6 +81,12 @@ class RunRecord:
     n_devices: Optional[int] = None
     multichip_ok: Optional[bool] = None
     multichip_cost: Optional[dict] = None
+    #: from the round-6+ schema-tagged tail records
+    #: (pint_tpu.telemetry.multichip/1)
+    mesh_shape: Optional[dict] = None
+    multichip_collective: Optional[dict] = None
+    multichip_scaling: Optional[dict] = None
+    sharding_plans: Optional[List[dict]] = None
 
     @property
     def usable(self) -> bool:
@@ -94,19 +104,14 @@ def _round_of(path: str) -> Optional[int]:
 
 
 def _tail_json_lines(tail: str) -> List[dict]:
-    """Every parseable one-line JSON object embedded in a captured tail."""
-    out = []
-    for line in tail.splitlines():
-        line = line.strip()
-        if not (line.startswith("{") and line.endswith("}")):
-            continue
-        try:
-            obj = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(obj, dict):
-            out.append(obj)
-    return out
+    """Every parseable one-line JSON object embedded in a captured tail
+    (the canonical scanner in :mod:`tools.tailscan`, shared with the
+    multichip-tail-check hook so ingestion and validation cannot drift
+    — and stdlib-only, keeping this pre-commit gate free of the
+    pint_tpu/jax import)."""
+    from tools.tailscan import tail_json_lines
+
+    return tail_json_lines(tail)
 
 
 def _apply_headline(rec: RunRecord, h: dict) -> None:
@@ -163,6 +168,28 @@ def ingest_file(path: str, errors: List[str]) -> Optional[RunRecord]:
         for obj in _tail_json_lines(doc.get("tail", "")):
             if isinstance(obj.get("multichip_cost"), dict):
                 rec.multichip_cost = obj["multichip_cost"]
+            # round-6+ schema-tagged records (the distview tail
+            # contract); the LAST record of each kind wins — the tail
+            # prints the headline-scale stage after the toy stages
+            if obj.get("schema") == "pint_tpu.telemetry.multichip/1":
+                record = obj.get("record")
+                if record == "correctness" \
+                        and isinstance(obj.get("mesh"), dict):
+                    rec.mesh_shape = obj["mesh"]
+                elif record == "cost" and isinstance(obj.get("cost"), dict):
+                    rec.multichip_cost = obj["cost"]
+                elif record == "collective" \
+                        and isinstance(obj.get("collective"), dict):
+                    rec.multichip_collective = obj["collective"]
+                elif record == "scaling":
+                    rec.multichip_scaling = {
+                        k: v for k, v in obj.items()
+                        if k not in ("schema", "record")}
+                elif record == "sharding_plan" \
+                        and isinstance(obj.get("sharding_plan"), dict):
+                    if rec.sharding_plans is None:
+                        rec.sharding_plans = []
+                    rec.sharding_plans.append(obj["sharding_plan"])
         return rec
     headline = None
     if isinstance(doc.get("parsed"), dict):      # driver wrapper
@@ -244,6 +271,46 @@ class Verdict:
     detail: str = ""
 
 
+def mad_gate(latest: float, prev: List[float], sign: int, threshold: float,
+             noise_mult: float, zero_baseline_fails: bool = False
+             ) -> Optional[Tuple[float, float, float, float, bool]]:
+    """The one statistical gate every observatory tool applies: newest
+    value vs the MEDIAN of its predecessors, failure bar
+    ``max(threshold, noise_mult x 1.4826*MAD scatter)``.
+
+    ``sign`` +1 means lower-is-worse (fits/s, efficiency), -1 means
+    higher-is-worse (compile seconds, comm/compute ratio).  Returns
+    ``(baseline, rel_change, noise_scatter, bar, failed)`` with
+    rel_change > 0 spelling "regressed", or None when the baseline
+    makes a relative comparison meaningless (negative, zero for a
+    lower-is-worse quantity, or zero for a higher-is-worse quantity
+    unless the caller opts in below).
+
+    ``zero_baseline_fails`` opts a higher-is-worse quantity into
+    treating a zero baseline as a real measurement: a comm/compute-
+    ratio history of exactly 0.0 ("this plan moves nothing") must
+    still gate a newly introduced nonzero ratio — reported as an
+    infinite relative rise, failing any finite bar.  It stays False
+    for quantities where zero is a lucky environment, not a contract:
+    a compile_s history of 0.0 (warm persistent-compile-cache rounds)
+    must NOT make the first cold-cache run an ungateable infinite
+    regression.  Shared with ``tools/scalewatch.py`` so the two gates
+    cannot drift apart."""
+    baseline = _median(prev)
+    if baseline < 0 or (baseline == 0 and sign > 0):
+        return None
+    if baseline == 0:
+        if not zero_baseline_fails:
+            return None
+        if latest <= 0:
+            return 0.0, 0.0, 0.0, threshold, False
+        return 0.0, float("inf"), 0.0, threshold, True
+    rel = sign * (baseline - latest) / baseline
+    scatter = 1.4826 * _median([abs(v - baseline) for v in prev]) / baseline
+    bar = max(threshold, noise_mult * scatter)
+    return baseline, rel, scatter, bar, rel > bar
+
+
 def check_series(runs: List[RunRecord], threshold: float,
                  noise_mult: float) -> List[Verdict]:
     """Gate the newest run of one series against its predecessors."""
@@ -261,18 +328,15 @@ def check_series(runs: List[RunRecord], threshold: float,
         prev = [get(r) for r in runs[:-1] if get(r) is not None]
         if not prev:
             continue
-        baseline = _median(prev)
-        if baseline <= 0:
-            continue
         # sign +1: lower-is-worse (fits/s); -1: higher-is-worse (compile)
-        rel = sign * (baseline - latest) / baseline
-        scatter = 1.4826 * _median([abs(v - baseline) for v in prev]) \
-            / baseline
-        bar = max(threshold, noise_mult * scatter)
+        gated = mad_gate(latest, prev, sign, threshold, noise_mult)
+        if gated is None:
+            continue
+        baseline, rel, scatter, bar, failed = gated
         verdicts.append(Verdict(
             series=(runs[0].metric or "?", runs[0].platform),
             quantity=name, baseline=baseline, latest=latest,
-            rel_change=rel, bar=bar, failed=rel > bar,
+            rel_change=rel, bar=bar, failed=failed,
             detail=f"{latest_rec.source}: {latest:g} vs median {baseline:g} "
                    f"of {len(prev)} prior run(s); "
                    f"change {100 * rel:+.1f}% (bar {100 * bar:.1f}%, "
@@ -352,6 +416,8 @@ def render_report(records: List[RunRecord], out=None) -> None:
         for r in sorted(multichip, key=lambda r: (r.round or 0, r.source)):
             line = (f"  r{r.round} {r.source}: {r.n_devices} devices, "
                     f"ok={r.multichip_ok}")
+            if r.mesh_shape:
+                line += f", mesh={r.mesh_shape}"
             if r.multichip_cost:
                 per_dev = r.multichip_cost.get("per_device") or {}
                 line += (f", cost per-device program: "
@@ -359,6 +425,18 @@ def render_report(records: List[RunRecord], out=None) -> None:
                          f"{len(per_dev) or r.multichip_cost.get('num_devices')}"
                          f" device(s)")
             print(line, file=out)
+            if r.multichip_collective:
+                c = r.multichip_collective
+                print(f"    collectives[{c.get('name', '?')}]: "
+                      f"{c.get('collective_count')} op(s), "
+                      f"{c.get('collective_bytes')} B, comm/compute "
+                      f"{c.get('comm_compute_ratio')}", file=out)
+            if r.multichip_scaling:
+                s = r.multichip_scaling
+                print(f"    scaling: speedup {s.get('speedup')} on "
+                      f"{s.get('n_devices')} device(s), efficiency "
+                      f"{s.get('efficiency')} (virtual CPU devices share "
+                      f"host cores; gate via tools/scalewatch)", file=out)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,533 @@
+#!/usr/bin/env python
+"""Scaling-efficiency observatory: sweep device counts, gate regressions.
+
+ROADMAP item 1 promotes the multichip dryrun to the default execution
+plan and demands a scaling series that gates commits.  This CLI is that
+gate's instrument: it sweeps the sharded GLS grid workload over
+1/2/4/8 virtual CPU devices (each count in its OWN subprocess — the
+XLA host-platform device count is fixed before the backend
+initializes), collects per-count measurements through the distributed
+observatory (:mod:`pint_tpu.telemetry.distview`: collective-comms
+bytes, comm/compute ratio, sharding plan; :class:`TraceReport`
+per-device busy fractions), and folds them into one schema'd artifact::
+
+    python -m tools.scalewatch                   # sweep + report
+    python -m tools.scalewatch --devices 1,2     # custom counts
+    python -m tools.scalewatch --emit SCALING_r07.json
+    python -m tools.scalewatch --check           # gate the history
+    python -m tools.scalewatch --worker 8        # internal: one count
+
+Artifact schema ``pint_tpu.telemetry.scaling/1``: a ``series`` entry
+per device count (wall seconds, fits/s, speedup and parallel efficiency
+vs the smallest count, collective bytes and comm/compute ratio of the
+TOA-sharded GLS normal-equation reduction, per-device busy fractions)
+plus the headline ``efficiency_at_max`` / ``comm_compute_ratio_at_max``
+the gate trends.  Worker stdout speaks the same schema-tagged JSON-line
+contract as ``dryrun_multichip``'s tail (``pint_tpu.telemetry.
+multichip/1``), validated record-by-record with the
+``tools.telemetry_report`` validators on ingestion.
+
+Gating (``--check``) mirrors ``tools/perfwatch``: the newest committed
+``SCALING_r*.json`` is compared against the MEDIAN of its predecessors;
+the failure bar is ``max(--threshold, --noise-mult * MAD scatter)``.
+``efficiency_at_max`` gates on drops, ``comm_compute_ratio_at_max`` on
+rises.  On virtual CPU devices the absolute efficiency is meaningless
+(all "devices" share one host's cores) — the HISTORY of the number on
+the same environment is the signal, exactly like the perfwatch series.
+Exit codes: 0 clean, 1 regression/parse failure, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/scalewatch.py` spelling
+    sys.path.insert(0, REPO)
+
+SCALING_SCHEMA = "pint_tpu.telemetry.scaling/1"
+MULTICHIP_SCHEMA = "pint_tpu.telemetry.multichip/1"
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+#: B1855 NANOGrav 9yv1 files (the bench.py headline model); the sweep
+#: degrades to the synthetic correlated-noise workload when absent
+_B1855_PAR = "/root/reference/tests/datafile/B1855+09_NANOGrav_9yv1.gls.par"
+_B1855_TIM = "/root/reference/tests/datafile/B1855+09_NANOGrav_9yv1.tim"
+
+#: synthetic fallback: the bench fallback spin/astrometry model plus the
+#: full correlated-noise surface (EFAC/EQUAD/ECORR + power-law red
+#: noise) so the GLS grid exercises the Woodbury path either way
+_NOISE_LINES = ("EFAC mjd 50000 60000 1.1\n"
+                "EQUAD mjd 50000 60000 0.5\n"
+                "ECORR mjd 50000 60000 0.8\n"
+                "TNREDAMP -13.0\nTNREDGAM 3.1\nTNREDC 8\n")
+
+
+# ---------------------------------------------------------------------------
+# worker: one device count, one process
+# ---------------------------------------------------------------------------
+
+def _emit(record: str, **body) -> None:
+    from pint_tpu.telemetry.distview import multichip_record
+
+    print(json.dumps(multichip_record(record, **body), sort_keys=True,
+                     default=str))
+    sys.stdout.flush()
+
+
+def _build_workload():
+    """(fitter, grid_params, grid_axes, workload_name).  The workload is
+    IDENTICAL at every swept device count — that is what makes the
+    speedup series meaningful — so TOA and grid-point counts are fixed
+    at multiples of 8 (the largest default sweep count) rather than
+    sized per worker."""
+    import numpy as np
+
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+
+    if os.path.exists(_B1855_PAR) and os.path.exists(_B1855_TIM):
+        import tempfile
+
+        from pint_tpu.simulation import make_fake_toas_fromtim
+
+        headlines, toalines = [], []
+        for ln in open(_B1855_TIM).read().splitlines(True):
+            s = ln.split()
+            if s and s[0] not in ("FORMAT", "MODE", "C") \
+                    and not s[0].startswith("#"):
+                toalines.append(ln)
+            else:
+                headlines.append(ln)
+        sub = toalines[::8]
+        sub = sub[:(len(sub) // 8) * 8]          # shardable TOA count
+        with tempfile.NamedTemporaryFile("w", suffix=".tim",
+                                         delete=False) as fh:
+            fh.writelines(headlines + sub)
+            subtim = fh.name
+        try:
+            model = get_model(_B1855_PAR)
+            toas = make_fake_toas_fromtim(
+                subtim, model, add_noise=True,
+                rng=np.random.default_rng(11))
+        finally:
+            os.unlink(subtim)
+        f = GLSFitter(toas, model)
+        dm2 = 3 * (float(model.M2.uncertainty or 0.011))
+        dsini = 3 * (float(model.SINI.uncertainty or 1.8e-4))
+        g0 = np.linspace(model.M2.value - dm2, model.M2.value + dm2, 8)
+        g1 = np.linspace(model.SINI.value - dsini,
+                         min(0.999999, model.SINI.value + dsini), 8)
+        return f, ("M2", "SINI"), (g0, g1), "b1855_gls_grid"
+
+    from bench import FALLBACK_PAR
+
+    from pint_tpu.io.par import parse_parfile
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    model = get_model(parse_parfile(FALLBACK_PAR + _NOISE_LINES))
+    epochs = np.linspace(53400, 54800, 64)
+    mjds = (epochs[:, None]
+            + np.arange(2)[None, :] * 0.5 / 86400.0).ravel()  # 128 TOAs
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=5.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(11))
+    f = GLSFitter(toas, model)
+    dF0, dF1 = 3e-11, 3e-18
+    g0 = np.linspace(model.F0.value - dF0, model.F0.value + dF0, 8)
+    g1 = np.linspace(model.F1.value - dF1, model.F1.value + dF1, 8)
+    return f, ("F0", "F1"), (g0, g1), "synthetic_gls_grid"
+
+
+def run_worker(n_devices: int) -> int:
+    """One measurement at one device count; schema-tagged JSON lines on
+    stdout (measurement + collective + cost + sharding_plan records)."""
+    import jax
+
+    # the parent (or operator) fixes the virtual device count via
+    # XLA_FLAGS before the backend exists; config.update re-applies the
+    # platform in case a sitecustomize forced something else
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < n_devices:
+        print(f"scalewatch worker: need {n_devices} devices, have "
+              f"{len(devs)} (set XLA_FLAGS="
+              f"--xla_force_host_platform_device_count={n_devices})",
+              file=sys.stderr)
+        return 2
+    devs = np.array(devs[:n_devices])
+    from pint_tpu import profiling
+    from pint_tpu.grid import grid_chisq
+    from pint_tpu.telemetry import distview
+
+    f, params, axes, workload = _build_workload()
+    f.fit_toas(maxiter=1)
+    mesh = Mesh(devs, ("grid",)) if n_devices > 1 else None
+    warm = (axes[0][[0, -1]], axes[1][[0, -1]])
+    grid_chisq(f, params, warm, niter=2, mesh=mesh)      # compile
+    t0 = time.perf_counter()
+    chi2, _ = grid_chisq(f, params, axes, niter=2, mesh=mesh)
+    wall = time.perf_counter() - t0
+    npts = int(np.asarray(chi2).size)
+    if not np.all(np.isfinite(np.asarray(chi2))):
+        print(f"scalewatch worker: non-finite chi2 at {n_devices} "
+              f"device(s)", file=sys.stderr)
+        return 1
+    # per-device busy fractions from a traced re-run (after the clean
+    # timing): device planes on real chips, XLA:CPU executor-thread
+    # lanes on the virtual mesh
+    import tempfile
+
+    busy: Dict[str, float] = {}
+    skew = None
+    try:
+        with tempfile.TemporaryDirectory(prefix="scalewatch_trace_") as td:
+            with profiling.device_trace(td) as rep:
+                grid_chisq(f, params, axes, niter=2, mesh=mesh)
+            busy = rep.device_busy_fractions()
+            skew = rep.straggler_skew_s
+    except Exception as e:  # tracing is best-effort on exotic backends
+        print(f"scalewatch worker: trace skipped "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+
+    obs = distview.observe_grid(f)
+    # the TOA-sharded GLS normal-equation reduction: the all-reduce
+    # whose bytes decide the sharding plan (comm/compute headline)
+    toa_mesh = Mesh(devs, ("toa",)) if n_devices > 1 else None
+    ne_fn, ne_args = f.gls_normal_equations_executable(mesh=toa_mesh)
+    ne_coll = distview.analyze_jitted_collectives(
+        ne_fn, *ne_args, name="gls.normal_eq")
+
+    _emit("measurement", n_devices=n_devices, wall_s=wall,
+          fits_per_sec=npts / max(wall, 1e-9), grid_points=npts,
+          ntoas=len(f.toas), nfree=len(f.model.free_params),
+          platform=str(jax.default_backend()), workload=workload,
+          busy_fractions=busy, straggler_skew_s=skew)
+    _emit("cost", cost=obs["cost"])
+    _emit("collective", collective=obs["collectives"])
+    _emit("collective", collective=ne_coll.to_dict())
+    _emit("sharding_plan", sharding_plan=obs["sharding_plan"])
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# sweep: subprocess per device count
+# ---------------------------------------------------------------------------
+
+def _records_from_output(text: str) -> List[dict]:
+    """Every schema-tagged multichip record in a worker's stdout (the
+    canonical tail scanner, filtered to the multichip schema — one
+    parser for the tail-line format)."""
+    from tools.tailscan import tail_json_lines
+
+    return [obj for obj in tail_json_lines(text)
+            if obj.get("schema") == MULTICHIP_SCHEMA]
+
+
+def _worker_env(n_devices: int) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def run_sweep(device_counts: List[int], errors: List[str],
+              timeout_s: float = 900.0) -> Optional[dict]:
+    """Run one worker per device count; fold the records into the
+    scaling artifact (None when any worker failed)."""
+    from tools.telemetry_report import validate_multichip_record
+
+    per_count: Dict[int, Dict[str, dict]] = {}
+    for n in device_counts:
+        print(f"scalewatch: measuring {n} device(s)...", file=sys.stderr)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "tools.scalewatch",
+                 "--worker", str(n)],
+                cwd=REPO, env=_worker_env(n), capture_output=True,
+                text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            errors.append(f"worker {n}: timed out after {timeout_s:.0f}s")
+            continue
+        if proc.returncode != 0:
+            tail = "\n".join(proc.stderr.strip().splitlines()[-3:])
+            errors.append(f"worker {n}: exit {proc.returncode}: {tail}")
+            continue
+        recs = _records_from_output(proc.stdout)
+        for rec in recs:
+            validate_multichip_record(rec, f"worker {n}", errors)
+        slot: Dict[str, dict] = {}
+        for rec in recs:
+            if rec.get("record") == "collective":
+                body = rec.get("collective") or {}
+                slot[f"collective:{body.get('name')}"] = body
+            else:
+                slot[rec["record"]] = rec
+        if "measurement" not in slot:
+            errors.append(f"worker {n}: no measurement record in stdout")
+            continue
+        per_count[n] = slot
+    if errors or not per_count:
+        return None
+    counts = sorted(per_count)
+    base = per_count[counts[0]]["measurement"]
+    series = []
+    for n in counts:
+        m = per_count[n]["measurement"]
+        ne = per_count[n].get("collective:gls.normal_eq", {})
+        grid_coll = per_count[n].get("collective:grid.chunk", {})
+        speedup = (m["fits_per_sec"] / base["fits_per_sec"]) \
+            if base["fits_per_sec"] else None
+        rel_devices = n / counts[0]
+        series.append({
+            "n_devices": n,
+            "wall_s": m["wall_s"],
+            "fits_per_sec": m["fits_per_sec"],
+            "grid_points": m.get("grid_points"),
+            "speedup": speedup,
+            "efficiency": (speedup / rel_devices
+                           if speedup is not None else None),
+            "comm_compute_ratio": ne.get("comm_compute_ratio"),
+            "collective_bytes": ne.get("collective_bytes"),
+            "grid_comm_compute_ratio": grid_coll.get("comm_compute_ratio"),
+            "busy_fractions": m.get("busy_fractions") or {},
+            "straggler_skew_s": m.get("straggler_skew_s"),
+            "mesh": (per_count[n].get("sharding_plan", {})
+                     .get("sharding_plan", {}).get("mesh")),
+        })
+    last = series[-1]
+    return {
+        "schema": SCALING_SCHEMA,
+        "created_unix": time.time(),
+        "platform": base.get("platform", "cpu"),
+        "workload": base.get("workload", "?"),
+        "device_counts": counts,
+        "series": series,
+        "max_devices": counts[-1],
+        "efficiency_at_max": last["efficiency"],
+        "comm_compute_ratio_at_max": last["comm_compute_ratio"],
+    }
+
+
+def render_artifact(doc: dict, out=None) -> None:
+    out = out or sys.stdout  # late-bound so pytest capture sees it
+    print(f"=== scaling series: {doc.get('workload')} "
+          f"@ {doc.get('platform')} ===", file=out)
+    print(f"  {'devices':>8s}{'wall_s':>9s}{'fits/s':>9s}{'speedup':>9s}"
+          f"{'effic.':>8s}{'comm/comp':>11s}{'lanes':>7s}", file=out)
+    for s in doc.get("series", []):
+        def _n(v, fmt=".3g"):
+            return "-" if v is None else format(v, fmt)
+        print(f"  {s.get('n_devices'):>8d}{_n(s.get('wall_s')):>9s}"
+              f"{_n(s.get('fits_per_sec')):>9s}{_n(s.get('speedup')):>9s}"
+              f"{_n(s.get('efficiency')):>8s}"
+              f"{_n(s.get('comm_compute_ratio'), '.4g'):>11s}"
+              f"{len(s.get('busy_fractions') or {}):>7d}", file=out)
+    last = (doc.get("series") or [{}])[-1]
+    busy = last.get("busy_fractions") or {}
+    if busy:
+        print(f"  per-device busy fractions at {last.get('n_devices')} "
+              f"device(s):", file=out)
+        for lane, frac in sorted(busy.items()):
+            print(f"    {lane[:52]:<52s} {100 * float(frac):5.1f}%",
+                  file=out)
+
+
+# ---------------------------------------------------------------------------
+# gating
+# ---------------------------------------------------------------------------
+
+def _round_of(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def ingest_artifact(path: str, errors: List[str]) -> Optional[dict]:
+    """One SCALING_r*.json, schema-validated (None: unreadable/invalid)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        errors.append(f"{path}: unreadable/invalid JSON: {e}")
+        return None
+    where = os.path.basename(path)
+    if not isinstance(doc, dict) or doc.get("schema") != SCALING_SCHEMA:
+        errors.append(f"{where}: not a {SCALING_SCHEMA} artifact")
+        return None
+    series = doc.get("series")
+    if not isinstance(series, list) or not series:
+        errors.append(f"{where}: empty/malformed 'series'")
+        return None
+    for key in ("efficiency_at_max", "max_devices"):
+        if not isinstance(doc.get(key), (int, float)):
+            errors.append(f"{where}: {key!r} is {doc.get(key)!r}, "
+                          "not a number")
+            return None
+    doc["_source"] = where
+    doc["_round"] = _round_of(path)
+    return doc
+
+
+def collect_history(paths: List[str], directory: Optional[str],
+                    errors: List[str]) -> List[dict]:
+    files = list(paths)
+    if directory:
+        files.extend(sorted(glob.glob(
+            os.path.join(directory, "SCALING_r*.json"))))
+    seen, ordered = set(), []
+    for f in files:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            ordered.append(f)
+    docs = [ingest_artifact(f, errors) for f in ordered]
+    docs = [d for d in docs if d is not None]
+    docs.sort(key=lambda d: (d["_round"] if d["_round"] is not None
+                             else 1 << 30, d["_source"]))
+    return docs
+
+
+def check_history(history: List[dict], threshold: float,
+                  noise_mult: float, out=None) -> int:
+    """Gate the newest artifact against the median of its predecessors
+    via perfwatch's shared :func:`~tools.perfwatch.mad_gate` (same
+    environment assumption as the perfwatch series: the history trends
+    ONE benchmark environment)."""
+    from tools.perfwatch import mad_gate
+
+    out = out or sys.stdout
+    if len(history) < 2:
+        print(f"scalewatch: {len(history)} artifact(s) — no history to "
+              f"gate", file=out)
+        return 0
+    latest, prior = history[-1], history[:-1]
+    rc = 0
+    quantities = (("efficiency_at_max", +1),   # lower is worse
+                  ("comm_compute_ratio_at_max", -1))  # higher is worse
+    for key, sign in quantities:
+        latest_v = latest.get(key)
+        prev = [d.get(key) for d in prior
+                if isinstance(d.get(key), (int, float))]
+        if not isinstance(latest_v, (int, float)) or not prev:
+            continue
+        # zero_baseline_fails: a committed all-zero comm-ratio history
+        # means "this plan moves nothing" — a newly nonzero ratio must
+        # still gate (efficiency, sign +1, is unaffected by the flag)
+        gated = mad_gate(latest_v, prev, sign, threshold, noise_mult,
+                         zero_baseline_fails=True)
+        if gated is None:
+            continue
+        baseline, rel, scatter, bar, failed = gated
+        status = "REGRESSION" if failed else "ok"
+        print(f"scalewatch: [{status}] {key}: "
+              f"{latest['_source']}: {latest_v:g} vs median {baseline:g} "
+              f"of {len(prev)} prior run(s); change {100 * rel:+.1f}% "
+              f"(bar {100 * bar:.1f}%, noise floor "
+              f"{100 * noise_mult * scatter:.1f}%)", file=out)
+        if failed:
+            rc = 1
+    if rc == 0:
+        print("scalewatch: no meaningful scaling regression", file=out)
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.scalewatch",
+        description="Sweep the sharded GLS grid over virtual device "
+                    "counts; gate the SCALING_r* history")
+    ap.add_argument("paths", nargs="*",
+                    help="explicit SCALING_r*.json files for --check "
+                         "(added to the --dir sweep)")
+    ap.add_argument("--devices", default="1,2,4,8",
+                    help="comma-separated device counts to sweep "
+                         "(default 1,2,4,8)")
+    ap.add_argument("--dir", default=None,
+                    help="directory holding SCALING_r*.json history "
+                         "(default: repo root; pass '' to disable)")
+    ap.add_argument("--emit", metavar="PATH", default=None,
+                    help="write the sweep's scaling artifact to PATH")
+    ap.add_argument("--check", action="store_true",
+                    help="gate: exit 1 when the newest committed "
+                         "artifact regresses (no sweep is run)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the sweep artifact as JSON instead of "
+                         "the table")
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="relative efficiency drop / comm-ratio rise "
+                         "that fails --check (default 0.30)")
+    ap.add_argument("--noise-mult", type=float, default=3.0,
+                    help="noise-floor multiplier on the history's MAD "
+                         "scatter (default 3.0)")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-worker timeout in seconds (default 900)")
+    ap.add_argument("--worker", type=int, metavar="N", default=None,
+                    help=argparse.SUPPRESS)  # internal: one measurement
+    args = ap.parse_args(argv)
+    if args.threshold <= 0 or args.noise_mult < 0:
+        ap.error("--threshold must be > 0 and --noise-mult >= 0")
+
+    if args.worker is not None:
+        return run_worker(args.worker)
+
+    directory = args.dir
+    if directory is None:
+        directory = REPO
+    errors: List[str] = []
+
+    if args.check:
+        history = collect_history(args.paths, directory or None, errors)
+        for e in errors:
+            print(f"scalewatch: {e}", file=sys.stderr)
+        if errors:
+            return 1
+        return check_history(history, args.threshold, args.noise_mult)
+
+    try:
+        counts = sorted({int(c) for c in args.devices.split(",") if c})
+    except ValueError:
+        ap.error(f"--devices must be comma-separated integers, got "
+                 f"{args.devices!r}")
+    if not counts or counts[0] < 1:
+        ap.error("--devices needs at least one positive count")
+    doc = run_sweep(counts, errors, timeout_s=args.timeout)
+    for e in errors:
+        print(f"scalewatch: {e}", file=sys.stderr)
+    if doc is None:
+        return 1
+    if args.emit:
+        with open(args.emit, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"scalewatch: wrote {args.emit}", file=sys.stderr)
+    if args.json:
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        render_artifact(doc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

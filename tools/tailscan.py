@@ -1,0 +1,35 @@
+"""Stdlib-only scanner for JSON lines embedded in captured tail text.
+
+THE canonical scanner for the tail-line contract, shared by
+``tools/telemetry_report`` (the pre-commit validator),
+``tools/perfwatch`` and ``tools/scalewatch`` (the history ingesters) so
+the three parse identically.  It lives in its own module, with no
+pint_tpu import, on purpose: perfwatch's pre-commit gate is stdlib-only
+and must stay that way — routing the scanner through telemetry_report
+would drag ``import pint_tpu`` -> ``import jax`` (and this container's
+sitecustomize forces an axon TPU backend) into every commit.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+__all__ = ["tail_json_lines"]
+
+
+def tail_json_lines(tail: str) -> List[dict]:
+    """Every parseable one-line JSON object embedded in captured tail
+    text (prose that happens to brace-wrap is skipped, not an error)."""
+    out: List[dict] = []
+    for line in str(tail).splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            out.append(obj)
+    return out

@@ -4,19 +4,24 @@
 Usage::
 
     python -m tools.telemetry_report RUN_DIR [RUN_DIR ...]
-    python -m tools.telemetry_report --check [RUN_DIR ...]
+    python -m tools.telemetry_report --check [RUN_DIR | ARTIFACT.json ...]
 
 Rendering prints, per run: the manifest summary (who/where/what), the
-span tree with durations, loose events, and the final metrics snapshot.
+span tree with durations, loose events, sharding plans, collective and
+cost profiles, and the final metrics snapshot.
 
 ``--check`` validates the on-disk schema (manifest.json +
 events.jsonl): every line must be one JSON object carrying the event
 schema tag, a known ``type``, its body key, and structurally sound span
 trees (child ``parent_id`` wired to the parent, non-negative
-durations).  With no paths, ``--check`` synthesizes a run through the
-live telemetry API into a temp dir and validates that — the pre-commit
-self-test that fails fast when the producers and this schema drift
-apart.
+durations).  A ``.json`` FILE path is validated as a multichip artifact
+instead (``MULTICHIP_r*.json``: driver wrapper whose captured tail may
+carry ``pint_tpu.telemetry.multichip/1`` schema-tagged JSON lines —
+every tagged line must validate; untagged tails from pre-distview
+rounds stay valid).  With no paths, ``--check`` synthesizes a run
+through the live telemetry API into a temp dir and validates that — the
+pre-commit self-test that fails fast when the producers and this schema
+drift apart.
 
 Exit codes: 0 valid, 1 malformed/validation failure, 2 usage errors.
 """
@@ -37,11 +42,27 @@ from pint_tpu.telemetry.costs import (  # noqa: E402
     COST_PROFILE_SCHEMA,
     NUMERIC_FIELDS,
 )
+from pint_tpu.telemetry.distview import (  # noqa: E402
+    COLLECTIVE_PROFILE_SCHEMA,
+    MULTICHIP_SCHEMA,
+    SHARDING_PLAN_SCHEMA,
+)
 from pint_tpu.telemetry.runlog import (  # noqa: E402
     EVENT_SCHEMA,
     EVENT_TYPES,
     MANIFEST_SCHEMA,
 )
+# the canonical tail scanner lives dependency-free in tools/tailscan.py
+# (perfwatch's stdlib-only gate shares it); re-exported here so the
+# validator-side name stays importable
+from tools.tailscan import tail_json_lines  # noqa: E402
+
+#: multichip tail record kind -> body key holding a sub-document (None:
+#: the record's own top-level numbers are the body)
+MULTICHIP_RECORDS = {"correctness": None, "cost": "cost",
+                     "collective": "collective",
+                     "sharding_plan": "sharding_plan", "scaling": None,
+                     "measurement": None}
 
 REQUIRED_MANIFEST_KEYS = ("schema", "name", "created_unix", "packages",
                           "config")
@@ -116,6 +137,147 @@ def validate_cost_profile(cp, where: str, errors: List[str]) -> None:
                             "ids to objects")
 
 
+def validate_collective_profile(cp, where: str, errors: List[str]) -> None:
+    """A collective_profile body must be schema-tagged, named, carry the
+    per-kind ops map and every headline number (explicit null where the
+    backend reported nothing)."""
+    if not isinstance(cp, dict):
+        _err(errors, where,
+             f"collective_profile body is {type(cp).__name__}, not object")
+        return
+    if cp.get("schema") != COLLECTIVE_PROFILE_SCHEMA:
+        _err(errors, where,
+             f"collective_profile schema {cp.get('schema')!r} != "
+             f"{COLLECTIVE_PROFILE_SCHEMA!r}")
+    if not isinstance(cp.get("name"), str) or not cp.get("name"):
+        _err(errors, where, "collective_profile missing non-empty 'name'")
+    ops = cp.get("ops")
+    if not isinstance(ops, dict):
+        _err(errors, where, f"collective_profile 'ops' is "
+                            f"{type(ops).__name__}, not object")
+    else:
+        for kind, body in ops.items():
+            if not (isinstance(body, dict)
+                    and isinstance(body.get("count"), (int, float))
+                    and isinstance(body.get("bytes"), (int, float))):
+                _err(errors, where, f"collective op {kind!r} malformed: "
+                                    f"{body!r} (needs count + bytes)")
+    for key in ("collective_count", "collective_bytes"):
+        if not isinstance(cp.get(key), (int, float)):
+            _err(errors, where,
+                 f"collective_profile {key!r} is {cp.get(key)!r}, "
+                 "not a number")
+    for key in ("compute_bytes", "flops", "comm_compute_ratio"):
+        if key not in cp:
+            _err(errors, where, f"collective_profile missing {key!r} "
+                                "(must be a number or explicit null)")
+        elif cp[key] is not None and not isinstance(cp[key], (int, float)):
+            _err(errors, where, f"collective_profile {key!r} is "
+                                f"{cp[key]!r}, not number/null")
+    axes = cp.get("mesh_axes")
+    if not isinstance(axes, dict) or not all(
+            isinstance(v, int) for v in axes.values()):
+        _err(errors, where, "collective_profile 'mesh_axes' must map "
+                            "axis names to integer sizes")
+    nd = cp.get("num_devices")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        _err(errors, where, f"collective_profile 'num_devices' is {nd!r}, "
+                            "not a positive integer")
+
+
+def validate_sharding_plan(plan, where: str, errors: List[str]) -> None:
+    """A sharding_plan body: schema tag, name, mesh (axis->size object
+    or explicit null for unsharded), input/output spec strings."""
+    if not isinstance(plan, dict):
+        _err(errors, where,
+             f"sharding_plan body is {type(plan).__name__}, not object")
+        return
+    if plan.get("schema") != SHARDING_PLAN_SCHEMA:
+        _err(errors, where, f"sharding_plan schema {plan.get('schema')!r} "
+                            f"!= {SHARDING_PLAN_SCHEMA!r}")
+    if not isinstance(plan.get("name"), str) or not plan.get("name"):
+        _err(errors, where, "sharding_plan missing non-empty 'name'")
+    mesh = plan.get("mesh")
+    if mesh is not None and not (
+            isinstance(mesh, dict)
+            and all(isinstance(v, int) for v in mesh.values())):
+        _err(errors, where, f"sharding_plan 'mesh' is {mesh!r}, not an "
+                            "axis->size object or null")
+    for key in ("inputs", "outputs"):
+        v = plan.get(key)
+        if not isinstance(v, list) or not all(
+                isinstance(s, str) for s in v):
+            _err(errors, where,
+                 f"sharding_plan {key!r} must be a list of spec strings")
+    nd = plan.get("num_devices")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        _err(errors, where, f"sharding_plan 'num_devices' is {nd!r}, "
+                            "not a positive integer")
+
+
+def validate_multichip_record(obj, where: str, errors: List[str]) -> None:
+    """One ``pint_tpu.telemetry.multichip/1`` schema-tagged tail line
+    (the dryrun_multichip / scalewatch-worker contract)."""
+    if not isinstance(obj, dict):
+        _err(errors, where, "multichip record is not an object")
+        return
+    if obj.get("schema") != MULTICHIP_SCHEMA:
+        _err(errors, where, f"multichip schema {obj.get('schema')!r} != "
+                            f"{MULTICHIP_SCHEMA!r}")
+    record = obj.get("record")
+    if record not in MULTICHIP_RECORDS:
+        _err(errors, where, f"unknown multichip record {record!r} "
+                            f"(known: {sorted(MULTICHIP_RECORDS)})")
+        return
+    body_key = MULTICHIP_RECORDS[record]
+    if body_key is not None:
+        if body_key not in obj:
+            _err(errors, where,
+                 f"multichip {record!r} missing body key {body_key!r}")
+        elif record == "cost":
+            validate_cost_profile(obj["cost"], where, errors)
+        elif record == "collective":
+            validate_collective_profile(obj["collective"], where, errors)
+        elif record == "sharding_plan":
+            validate_sharding_plan(obj["sharding_plan"], where, errors)
+        return
+    nd = obj.get("n_devices")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        _err(errors, where, f"multichip {record!r} 'n_devices' is {nd!r}, "
+                            "not a positive integer")
+    numeric_keys = {"correctness": ("chi2_spread",),
+                    "scaling": ("speedup", "efficiency"),
+                    "measurement": ("wall_s", "fits_per_sec")}[record]
+    for key in numeric_keys:
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            _err(errors, where,
+                 f"multichip {record!r} {key!r} is {v!r}, not a number")
+
+
+def validate_multichip_file(path: str, errors: List[str]) -> int:
+    """Validate one MULTICHIP_r*.json driver artifact: every
+    schema-tagged JSON line in its captured tail must validate; an
+    untagged tail (pre-distview rounds) is 0 records and valid.
+    Returns the number of tagged records checked."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _err(errors, path, f"unreadable/invalid JSON: {e}")
+        return 0
+    if not isinstance(doc, dict):
+        _err(errors, path, f"artifact is {type(doc).__name__}, not object")
+        return 0
+    n = 0
+    for obj in tail_json_lines(doc.get("tail", "")):
+        if obj.get("schema") == MULTICHIP_SCHEMA:
+            n += 1
+            validate_multichip_record(obj, f"{path} tail record {n}",
+                                      errors)
+    return n
+
+
 def validate_events_file(path: str, errors: List[str]) -> int:
     """Validate one events.jsonl; returns the number of records read."""
     n = 0
@@ -173,6 +335,11 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     _err(errors, where, "metrics body is not an object")
             elif type_ == "cost_profile":
                 validate_cost_profile(rec["cost_profile"], where, errors)
+            elif type_ == "collective_profile":
+                validate_collective_profile(rec["collective_profile"],
+                                            where, errors)
+            elif type_ == "sharding_plan":
+                validate_sharding_plan(rec["sharding_plan"], where, errors)
     return n
 
 
@@ -235,6 +402,7 @@ def render_run(path: str, out=sys.stdout) -> None:
         print(f"  device  : {dev.get('platform')} ({dev.get('device_kind')}"
               f", {dev.get('precision')})", file=out)
     spans, events, costs, metrics = [], [], [], None
+    collectives, plans = [], []
     with open(events_path, encoding="utf-8") as f:
         for line in f:
             rec = json.loads(line)
@@ -244,6 +412,10 @@ def render_run(path: str, out=sys.stdout) -> None:
                 events.append(rec["event"])
             elif rec["type"] == "cost_profile":
                 costs.append(rec["cost_profile"])
+            elif rec["type"] == "collective_profile":
+                collectives.append(rec["collective_profile"])
+            elif rec["type"] == "sharding_plan":
+                plans.append(rec["sharding_plan"])
             elif rec["type"] == "metrics":
                 metrics = rec["metrics"]  # last snapshot wins
     if spans:
@@ -272,6 +444,31 @@ def render_run(path: str, out=sys.stdout) -> None:
                   f"{str(cp.get('num_devices', 1)):>4s}", file=out)
             if cp.get("error"):
                 print(f"      [degraded: {cp['error']}]", file=out)
+    if collectives:
+        print("  --- collective profiles (SPMD comms) ---", file=out)
+        for cp in collectives:
+            ops = ", ".join(
+                f"{k} x{v.get('count')} "
+                f"({'-' if v.get('bytes') is None else format(v['bytes'], 'g')}"
+                f"B)"
+                for k, v in (cp.get("ops") or {}).items()) or "none"
+            ratio = cp.get("comm_compute_ratio")
+            print(f"    {cp.get('name', '?')}: {ops}; "
+                  f"comm/compute "
+                  f"{'-' if ratio is None else format(ratio, '.4g')}; "
+                  f"mesh {cp.get('mesh_axes') or '-'} over "
+                  f"{cp.get('num_devices')} device(s)", file=out)
+            if cp.get("error"):
+                print(f"      [degraded: {cp['error']}]", file=out)
+    if plans:
+        print("  --- sharding plans ---", file=out)
+        for pl in plans:
+            print(f"    {pl.get('name', '?')}: mesh {pl.get('mesh') or '-'} "
+                  f"({pl.get('num_devices')} device(s))", file=out)
+            for way in ("inputs", "outputs"):
+                specs = pl.get(way) or []
+                if specs:
+                    print(f"      {way}: {', '.join(specs)}", file=out)
     if metrics:
         print("  --- metrics ---", file=out)
         for name, body in sorted(metrics.items()):
@@ -339,12 +536,46 @@ def self_test(errors: List[str]) -> int:
             name="selftest", backend="cpu", flops=1.0).to_dict())
         run.record_cost_profile(CostProfile(
             name="selftest-degraded", error="synthetic").to_dict())
+        # distview producer drift check: a synthetic collective profile
+        # (sharded + degraded twins) and a sharding plan, exercising the
+        # serialization the multichip dryrun and scalewatch use — plus
+        # the manifest fold-in record_sharding_plan performs
+        from pint_tpu.telemetry.distview import (CollectiveProfile,
+                                                 sharding_plan_of)
+
+        coll = CollectiveProfile(name="selftest", backend="cpu",
+                                 num_devices=8, mesh_axes={"toa": 8},
+                                 compute_bytes=1000.0)
+        coll.add("all-reduce", 64.0, 8)
+        run.record_collective_profile(coll.to_dict())
+        run.record_collective_profile(CollectiveProfile(
+            name="selftest-degraded", error="synthetic").to_dict())
+        run.record_sharding_plan(sharding_plan_of(object(), "selftest"))
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
-        if n < 7:  # run_start, span, event, 2x cost_profile, metrics, run_end
-            _err(errors, "selftest", f"expected >= 7 records, got {n}")
+        # run_start, span, event, 2x cost_profile, 2x collective_profile,
+        # sharding_plan, metrics, run_end
+        if n < 10:
+            _err(errors, "selftest", f"expected >= 10 records, got {n}")
+        with open(os.path.join(run_dir, "manifest.json"),
+                  encoding="utf-8") as f:
+            manifest = json.load(f)
+        if "selftest" not in (manifest.get("sharding_plans") or {}):
+            _err(errors, "selftest",
+                 "record_sharding_plan did not fold the plan into the "
+                 "manifest's sharding_plans map")
+        # multichip tail-record validators agree with the producer
+        from pint_tpu.telemetry.distview import multichip_record
+
+        validate_multichip_record(
+            multichip_record("collective", n_devices=8,
+                             collective=coll.to_dict()),
+            "selftest multichip", errors)
+        validate_multichip_record(
+            multichip_record("scaling", n_devices=8, speedup=4.0,
+                             efficiency=0.5), "selftest multichip", errors)
         return n
 
 
@@ -363,7 +594,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.check:
         if args.runs:
             for p in args.runs:
-                validate_run_dir(p, errors)
+                if os.path.isfile(p):
+                    validate_multichip_file(p, errors)
+                else:
+                    validate_run_dir(p, errors)
         else:
             self_test(errors)
         if errors:
