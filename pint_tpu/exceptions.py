@@ -24,6 +24,8 @@ __all__ = [
     "DeviceError",
     "DeviceMismatchError",
     "DeviceLostError",
+    "CanaryMismatchError",
+    "MeshExhaustedError",
     "CheckpointError",
     "SweepChunkFailure",
     "ClockCorrectionError",
@@ -154,7 +156,31 @@ class DeviceMismatchError(DeviceError):
 
 
 class DeviceLostError(DeviceError):
-    """A device disappeared or failed mid-computation."""
+    """A device disappeared or failed mid-computation.
+
+    ``device_id`` (when known) names the lost device so the elastic
+    supervisor can evict it from the mesh instead of degrading blindly.
+    """
+
+    def __init__(self, msg: str = "device lost", device_id: int | None = None):
+        self.device_id = device_id
+        super().__init__(msg)
+
+
+class CanaryMismatchError(DeviceError):
+    """The cross-replica canary (one replicated grid point evaluated on
+    every shard) disagreed across devices — silent shard corruption.
+    ``device_ids`` lists the devices whose canary value diverged from
+    the ensemble (NaN or off-median)."""
+
+    def __init__(self, msg: str, device_ids=()):
+        self.device_ids = list(device_ids)
+        super().__init__(msg)
+
+
+class MeshExhaustedError(DeviceError):
+    """The elastic degradation ladder ran out of rungs: no healthy
+    device subset remains that can execute the plan."""
 
 
 class CheckpointError(PintError):

@@ -268,12 +268,48 @@ def _make_gls_normal_equations():
 _gls_normal_equations = _make_gls_normal_equations()
 
 
+def _sharded_normal_equations(M: np.ndarray, r: np.ndarray,
+                              Nvec: np.ndarray, phiinv: np.ndarray, plan):
+    """The Woodbury normal-equation build executed on ``plan``'s mesh:
+    TOA-indexed operands sharded over the plan's first axis, so the
+    ``M^T C^-1 M`` / ``M^T C^-1 r`` contractions compile into real
+    cross-device all-reduces.  Rows are zero-padded to a shard multiple
+    (``Nvec`` pads with 1.0), which contributes exactly zero to every
+    sum — results are identical to the host build, not trimmed."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = plan.mesh
+    axis = mesh.axis_names[0]
+    shards = int(mesh.devices.size)
+    pad = (-len(r)) % shards
+    if pad:
+        M = np.vstack([M, np.zeros((pad, M.shape[1]))])
+        r = np.concatenate([r, np.zeros(pad)])
+        Nvec = np.concatenate([Nvec, np.ones(pad)])
+    specs = (P(axis, None), P(axis), P(axis), P())
+    args = [jax.device_put(jnp.asarray(a), NamedSharding(mesh, s))
+            for a, s in zip((M, r, Nvec, phiinv), specs)]
+    mtcm, mtcy = _gls_normal_equations(*args)
+    return np.asarray(mtcm), np.asarray(mtcy)
+
+
 class GLSFitter(Fitter):
-    """One-shot GLS fitter (reference ``fitter.py:1939``)."""
+    """One-shot GLS fitter (reference ``fitter.py:1939``).
+
+    ``fit_toas(plan=...)`` routes the normal-equation build through the
+    execution-plan layer: the TOA axis is sharded over the plan's mesh
+    and the Gram contractions become cross-device all-reduces, under
+    elastic supervision (device loss during the sharded build degrades
+    the plan one rung and re-runs instead of failing the fit).
+    """
 
     def __init__(self, toas, model, residuals=None, track_mode=None):
         super().__init__(toas, model, residuals=residuals, track_mode=track_mode)
         self.method = "generalized_least_square"
+        #: active ExecutionPlan for the sharded normal-equation build
+        #: (None: host build + Schur fast path, the single-device route)
+        self.plan = None
 
     def _gls_step(self, threshold: float = 0.0, full_cov: bool = False):
         """One linearized GLS solve; returns (dpars, errs, cov, params).
@@ -295,14 +331,35 @@ class GLSFitter(Fitter):
                 self.model, self.toas)
             self._noise_dims = dims
             ntm = len(params)
-            if threshold <= 0 and M.shape[1] > ntm:
-                # Schur-complement fast path: the noise block is constant
-                # across a fit's iterations (cached factor); only the
-                # timing system is solved per step
-                out = _try_schur_path(self, M, r, Nvec, phiinv, ntm, norm)
-                if out is not None:
-                    return (*out, params)
-            mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
+            plan = getattr(self, "plan", None)
+            if plan is not None and plan.mesh is not None:
+                # routed multichip path: TOA-sharded Woodbury build on
+                # the plan's mesh, elastic-supervised (a device loss
+                # mid-build degrades the plan and re-runs); the host
+                # Cholesky/SVD ladder below consumes the result
+                # unchanged
+                from pint_tpu.runtime.elastic import run_with_degradation
+
+                (mtcm, mtcy), self.plan, self.last_elastic_report = \
+                    run_with_degradation(
+                        plan,
+                        lambda p: _sharded_normal_equations(
+                            M, r, Nvec, phiinv, p)
+                        if p.mesh is not None
+                        else gls_normal_equations(M, r, Nvec=Nvec,
+                                                  phiinv=phiinv),
+                        what="GLS sharded normal equations")
+            else:
+                if threshold <= 0 and M.shape[1] > ntm:
+                    # Schur-complement fast path: the noise block is
+                    # constant across a fit's iterations (cached factor);
+                    # only the timing system is solved per step
+                    out = _try_schur_path(self, M, r, Nvec, phiinv, ntm,
+                                          norm)
+                    if out is not None:
+                        return (*out, params)
+                mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec,
+                                                  phiinv=phiinv)
         if threshold <= 0:
             try:
                 xvar, xhat, diag = _solve_cholesky(mtcm, mtcy)
@@ -355,13 +412,16 @@ class GLSFitter(Fitter):
         mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         return _gls_cholesky_solve, (jnp.asarray(mtcm), jnp.asarray(mtcy))
 
-    def gls_normal_equations_executable(self, mesh=None):
+    def gls_normal_equations_executable(self, mesh=None, plan=None):
         """(jitted fn, (M, r, Nvec, phiinv)) — the Woodbury-form GLS
         normal-equation build (``M^T C^-1 M + diag(phiinv)``, ``M^T C^-1
         r``) at this fitter's augmented-system shapes, as one jittable
         executable for AOT analysis.
 
-        With a ``mesh`` the TOA-indexed operands (augmented design
+        ``plan`` (an :class:`~pint_tpu.runtime.plan.ExecutionPlan` over
+        the 'toa' axis) supplies the mesh the production fit path uses,
+        so the scalewatch/dryrun observatory measures the routed
+        executable.  With a ``mesh`` the TOA-indexed operands (augmented design
         matrix rows, residuals, white-noise variances) are placed
         sharded over the mesh's FIRST axis, so the contractions over the
         TOA axis compile into cross-device all-reduces — the reduction
@@ -370,6 +430,11 @@ class GLSFitter(Fitter):
         remainder is < n_devices rows; analysis shapes, not fit
         results).  The jitted fn is module-level for the same
         warm-cache reason as :func:`_gls_cholesky_solve`."""
+        if plan is not None:
+            if mesh is not None:
+                raise UsageError("plan= and mesh= cannot be combined; the "
+                                 "plan carries its own mesh")
+            mesh = plan.mesh
         r = np.asarray(self.resids.time_resids)
         M, params, norm, phiinv, Nvec, _ = build_augmented_system(
             self.model, self.toas)
@@ -394,7 +459,24 @@ class GLSFitter(Fitter):
 
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
                  full_cov: bool = False, debug: bool = False,
-                 robust=None) -> float:
+                 robust=None, plan=None) -> float:
+        """``plan`` routes the normal-equation build through the
+        execution-plan layer (``"auto"`` selects from the
+        preflight-certified device set over the 'toa' axis; or pass an
+        :class:`~pint_tpu.runtime.plan.ExecutionPlan`).  The elastic-
+        supervised sharded build replaces the host Schur fast path; on
+        device failure the plan degrades one rung and the fit
+        continues.  The surviving plan stays on ``self.plan``."""
+        if plan is not None:
+            if isinstance(plan, str):
+                from pint_tpu.runtime.plan import select_plan
+
+                if plan != "auto":
+                    raise UsageError(f"plan={plan!r}: pass 'auto' or an "
+                                     "ExecutionPlan")
+                plan = select_plan("gls_normal_eq",
+                                   n_items=len(self.toas))
+            self.plan = plan
         if self._check_robust_arg(robust):
             # typed and actionable, instead of a TypeError on the kwarg:
             # Huber IRLS reweights a *diagonal* whitener, which a

@@ -206,6 +206,16 @@ def _classified_columns_cached(model, toas, jac_fn, free_init, const_pv,
     return J0, nl_fit
 
 
+def _free_init_of(model, all_names) -> np.ndarray:
+    """Initial free-parameter vector in builder name order.  The single
+    spelling shared by both grid builders and the elastic fingerprint
+    primer — the checkpoint fingerprint hashes this array, so a drift
+    between a builder's copy and the primer's would break cross-rung
+    resume with a spurious CheckpointError."""
+    return np.array([float(getattr(model, p).value or 0.0)
+                     for p in all_names], dtype=np.float64)
+
+
 def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
                        fit_params: Optional[Sequence[str]] = None,
                        niter: int = 4,
@@ -245,8 +255,7 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     F0 = float(model.F0.value)
     sigma = np.asarray(model.scaled_toa_uncertainty(toas))
     w = jnp.asarray(1.0 / sigma**2)
-    free_init = jnp.array([float(getattr(model, p).value or 0.0)
-                           for p in all_names], dtype=jnp.float64)
+    free_init = jnp.asarray(_free_init_of(model, all_names))
 
     # reference pulse numbers at the initial parameters (phase tracking)
     ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
@@ -440,8 +449,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         Us, ws, _ = model.noise_basis_by_component(toas)
         U_np = np.hstack(Us)
         phi_np = np.concatenate(ws)
-        free_init = jnp.array([float(getattr(model, p).value or 0.0)
-                               for p in all_names], dtype=jnp.float64)
+        free_init = jnp.asarray(_free_init_of(model, all_names))
 
         ph0, _ = eval_fn(free_init, const_pv, batch, ctx)
         int0 = ph0.int_
@@ -882,6 +890,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
                niter: int = 4, mesh=None, chunk=None,
                checkpoint: Optional[str] = None, retry=None,
+               plan=None,
                **fitargs) -> Tuple[np.ndarray, dict]:
     """Chi2 over an outer-product grid (reference ``gridutils.py:164`` API).
 
@@ -894,12 +903,24 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     ``extraparnames`` returns the per-point refit values of those parameters
     in the second return slot, shaped like the grid.
 
-    ``checkpoint`` names a directory: the sweep then runs through the
-    chunked executor (:mod:`pint_tpu.runtime.checkpoint`) — completed
-    chunks persist to disk, failed chunks retry with exponential backoff
-    (``retry``, a :class:`~pint_tpu.runtime.checkpoint.RetryPolicy`), and
-    a crashed sweep resumes from the last completed chunk.  Per-point
-    solve diagnostics land on ``ftr.last_grid_diagnostics`` either way.
+    ``plan`` routes the sweep through the execution-plan layer:
+    ``"auto"`` selects a plan from the preflight-certified device set
+    (:func:`pint_tpu.runtime.plan.select_plan`), or pass an
+    :class:`~pint_tpu.runtime.plan.ExecutionPlan` directly.  Combined
+    with ``checkpoint``, the sweep runs under the **elastic supervisor**
+    (:mod:`pint_tpu.runtime.elastic`): per-chunk persistence, a
+    cross-replica canary on every block, and — on device loss, canary
+    mismatch, or collective failure — eviction of the bad device, mesh
+    degradation down the 8→4→2→1 ladder, and resume from the last
+    checkpoint.  The elastic report lands on ``ftr.last_elastic_report``.
+
+    ``checkpoint`` (without a plan) names a directory: the sweep runs
+    through the chunked executor (:mod:`pint_tpu.runtime.checkpoint`) —
+    completed chunks persist to disk, failed chunks retry with
+    exponential backoff (``retry``, a
+    :class:`~pint_tpu.runtime.checkpoint.RetryPolicy`), and a crashed
+    sweep resumes from the last completed chunk.  Per-point solve
+    diagnostics land on ``ftr.last_grid_diagnostics`` either way.
     """
     global _warned_executor
     if (executor is not None or ncpu not in (None, 1)) and not _warned_executor:
@@ -918,10 +939,32 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
+    if plan is not None:
+        if mesh is not None:
+            raise UsageError("plan= and mesh= cannot be combined; the plan "
+                             "carries its own mesh")
+        if isinstance(plan, str):
+            from pint_tpu.runtime.plan import select_plan
+
+            if plan != "auto":
+                raise UsageError(f"plan={plan!r}: pass 'auto' or an "
+                                 "ExecutionPlan")
+            plan = select_plan("grid", n_items=int(mesh_pts.shape[0]))
     with _tspan("grid_chisq", npts=int(mesh_pts.shape[0]), gls=gls,
                 niter=niter, params=",".join(parnames),
                 checkpointed=checkpoint is not None) as sp, \
             _jaxevents.watch(sp):
+        if checkpoint is not None and plan is not None:
+            # elastic path: logical chunking + canary + degradation;
+            # builds its own per-rung executables (the chunk size folds
+            # in the canary rows), so the shared build below is skipped
+            chi2, vfit, diag, fit_params = _elastic_grid(
+                ftr, model, toas, parnames, mesh_pts, niter, gls,
+                chunk, checkpoint, retry, plan)
+            _attach_grid_diagnostics(ftr, diag, shape=shape)
+            extraout = _extraout(extraparnames, fit_params, parnames,
+                                 vfit, mesh_pts, model, shape=shape)
+            return np.asarray(chi2).reshape(shape), extraout
         with _tspan("grid.build_fn"):
             fn, free_init, fit_params = build_grid_chi2_fn(
                 model, toas, parnames, niter=niter,
@@ -930,21 +973,28 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
         if checkpoint is not None:
             if mesh is not None:
                 raise UsageError("checkpoint= and mesh= cannot be combined; "
-                                 "run the checkpointed sweep per host")
+                                 "pass plan= for elastic checkpointed "
+                                 "multi-device execution")
+            from pint_tpu.runtime.preflight import device_profile
+
             # the fingerprint must cover everything the chi2 surface depends
             # on — grid definition, EVERY parameter value/selector, and the
             # TOA data version — or a resume would silently stitch chunks
-            # from different data into one surface
+            # from different data into one surface.  Mesh/device identity
+            # is deliberately NOT hashed: it rides in the sidecar, so the
+            # same sweep resumes across device counts.
             chi2, vfit, diag = _checkpointed_grid(
                 fn, mesh_pts, checkpoint, retry,
-                fingerprint=dict(parnames=parnames, pts=mesh_pts,
-                                 niter=niter, ntoas=len(toas), gls=gls,
-                                 toas_version=getattr(toas, "_version", 0),
-                                 params=_model_param_sig(model),
-                                 free_init=np.asarray(free_init)),
+                fingerprint=_grid_fingerprint(parnames, mesh_pts, niter,
+                                              toas, gls, model, free_init),
                 chunk=chunk if chunk else (default_gls_chunk() if gls
-                                           else 256))
-        elif mesh is not None:
+                                           else 256),
+                sidecar={"platform": device_profile().platform,
+                         "num_devices": device_profile().num_devices})
+        elif mesh is not None or (plan is not None
+                                  and plan.mesh is not None):
+            if mesh is None:
+                mesh = plan.mesh
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
@@ -985,8 +1035,22 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
         return chi2.reshape(shape), extraout
 
 
+def _grid_fingerprint(parnames, mesh_pts, niter, toas, gls, model,
+                      free_init) -> dict:
+    """The sweep-identity fingerprint shared by the plain-checkpointed
+    and elastic grid paths.  Everything the chi2 surface depends on is
+    here; mesh/device identity deliberately is NOT (it lives in the
+    checkpoint sidecar), so a sweep checkpointed on 8 devices resumes
+    on 4 with the same fingerprint."""
+    return dict(parnames=parnames, pts=mesh_pts, niter=niter,
+                ntoas=len(toas), gls=gls,
+                toas_version=getattr(toas, "_version", 0),
+                params=_model_param_sig(model),
+                free_init=np.asarray(free_init))
+
+
 def _checkpointed_grid(fn, mesh_pts: np.ndarray, checkpoint: str, retry,
-                       fingerprint: dict, chunk: int):
+                       fingerprint: dict, chunk: int, sidecar=None):
     """Run the grid through the chunked checkpointed executor; chunks are
     contiguous point blocks so a resumed sweep re-evaluates the same
     blocks through the same compiled executable (chi2 surface identical
@@ -1001,10 +1065,67 @@ def _checkpointed_grid(fn, mesh_pts: np.ndarray, checkpoint: str, retry,
                 "diag": np.asarray(dg)}
 
     outs = checkpointed_map(chunk_fn, blocks, checkpoint=checkpoint,
-                            fingerprint=fingerprint, retry=retry)
+                            fingerprint=fingerprint, retry=retry,
+                            sidecar=sidecar)
     return (np.concatenate([o["chi2"] for o in outs]),
             np.concatenate([o["vfit"] for o in outs]),
             np.concatenate([o["diag"] for o in outs]))
+
+
+def _elastic_grid(ftr, model, toas, parnames, mesh_pts, niter, gls,
+                  chunk, checkpoint, retry, plan):
+    """Route the grid sweep through the elastic supervisor: logical
+    (device-count-independent) chunks, a cross-replica canary per block,
+    device eviction + mesh degradation on failure, resume from the
+    checkpoint.  Returns (chi2, vfit, diag, fit_params)."""
+    from pint_tpu.runtime import elastic as _elastic
+
+    logical = int(chunk) if chunk else (default_gls_chunk() if gls else 256)
+    spans_ = _point_spans(model, parnames, mesh_pts)
+    built: dict = {}
+
+    def make_eval(block_size, p):
+        # the GLS chunk executable is sized to the rung's block (canary
+        # rows included) so fn never re-pads; the WLS path vmaps any
+        # batch size through one executable per shape
+        fn, free_init, fit_params = build_grid_chi2_fn(
+            model, toas, parnames, niter=niter, grid_spans=spans_,
+            chunk=block_size if gls else None)
+        built["fn"], built["free_init"] = fn, free_init
+        built["fit_params"] = fit_params
+        sharding = p.batch_sharding()
+
+        if gls:
+            def ev(block):
+                c2, vf, dg = fn(jnp.asarray(block), sharding=sharding)
+                return {"chi2": np.asarray(c2), "vfit": np.asarray(vf),
+                        "diag": np.asarray(dg)}
+        else:
+            def ev(block):
+                b = jnp.asarray(block)
+                if sharding is not None:
+                    b = jax.device_put(b, sharding)
+                c2, vf, dg = fn(b)
+                return {"chi2": np.asarray(c2), "vfit": np.asarray(vf),
+                        "diag": np.asarray(dg)}
+        return ev
+
+    # prime the fingerprint's free_init without paying a build: it is a
+    # pure function of the model's current values and the name order
+    all_names = tuple(parnames)
+    fit_params0 = tuple(p for p in model.free_params if p not in all_names)
+    free_init = _free_init_of(model, fit_params0 + all_names)
+    out, report = _elastic.elastic_map(
+        make_eval, mesh_pts, plan=plan, chunk=logical,
+        checkpoint=checkpoint, retry=retry,
+        fingerprint=_grid_fingerprint(tuple(parnames), mesh_pts, niter,
+                                      toas, gls, model, free_init),
+        what="elastic grid sweep")
+    ftr.last_elastic_report = report
+    if built.get("fn") is not None:
+        _attach_grid_executable(ftr, built["fn"], model=model)
+    fit_params = built.get("fit_params", fit_params0)
+    return out["chi2"], out["vfit"], out["diag"], fit_params
 
 
 def _point_spans(model, parnames, pts) -> list:

@@ -191,7 +191,7 @@ class MCMCFitter(Fitter):
 
     def fit_toas(self, maxiter: int = 100, pos=None, seed: Optional[int] = None,
                  burn_frac: float = 0.25, checkpoint: Optional[str] = None,
-                 **kw) -> float:
+                 plan=None, **kw) -> float:
         """Run the ensemble for *maxiter* steps; model is set to the
         maximum-posterior sample and chi2 at that point is returned.
 
@@ -199,7 +199,22 @@ class MCMCFitter(Fitter):
         is persisted through :class:`pint_tpu.sampler.NpzBackend`, and a
         crashed run resumes from it — only the remaining steps are
         sampled, continuing the Markov chain bit-identically to an
-        uninterrupted run."""
+        uninterrupted run.
+
+        ``plan`` routes the walker axis through the execution-plan layer
+        (``"auto"`` selects a walker-axis shard_map plan from the
+        preflight-certified devices; or pass an
+        :class:`~pint_tpu.runtime.plan.ExecutionPlan`) — each device
+        evaluates its walker slice, and a device lost mid-chain is
+        evicted with the plan degraded one rung instead of killing the
+        run."""
+        if plan is not None:
+            if not isinstance(self.sampler, EnsembleSampler):
+                from pint_tpu.exceptions import UsageError
+
+                raise UsageError(
+                    "plan= requires the jax-native EnsembleSampler")
+            self.sampler.plan = plan
         with _tspan("mcmc.fit_toas", ntoas=len(self.toas),
                     nwalkers=self.sampler.nwalkers, maxiter=maxiter,
                     checkpointed=checkpoint is not None) as sp, \

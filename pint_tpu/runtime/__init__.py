@@ -14,16 +14,37 @@ gracefully, or recover** — never silently emit wrong numbers:
   escalation for every normal-equation solve, host-side (fitters) and
   on-trace (vmapped grid bodies), with per-solve diagnostics.
 * :mod:`pint_tpu.runtime.checkpoint` — chunked sweep executor with
-  per-chunk persistence, retry/backoff, timeout, and crash resume.
+  per-chunk persistence, retry/backoff, timeout, and crash resume
+  (mesh identity in the sidecar, never in the fingerprint — checkpoints
+  are portable across device counts).
+* :mod:`pint_tpu.runtime.plan` — execution-plan layer: mesh membership
+  from the per-device preflight probes, pjit/shard_map/single mechanism
+  selection per workload axis (grid/toa/walker).
+* :mod:`pint_tpu.runtime.elastic` — elastic supervisor: cross-replica
+  canary, device eviction, 8→4→2→1 mesh degradation, resume from the
+  last checkpoint.
 * :mod:`pint_tpu.runtime.faultinject` — deterministic fault injection
-  (NaN residuals, singular Grams, truncated files, device loss) used by
-  ``tests/test_fault_injection.py`` to prove each guardrail fires.
+  (NaN residuals, singular Grams, truncated files, device loss,
+  shard-level faults) used by ``tests/test_fault_injection.py`` and
+  ``tests/test_elastic.py`` to prove each guardrail fires.
 """
 
 from pint_tpu.runtime.preflight import (  # noqa: F401
+    DeviceHealth,
     DeviceProfile,
     check_device,
+    device_health,
     device_profile,
+    healthy_devices,
+)
+from pint_tpu.runtime.plan import (  # noqa: F401
+    ExecutionPlan,
+    ladder,
+    select_plan,
+)
+from pint_tpu.runtime.elastic import (  # noqa: F401
+    ElasticReport,
+    elastic_map,
 )
 from pint_tpu.runtime.solve import (  # noqa: F401
     SolveDiagnostics,
@@ -39,8 +60,11 @@ from pint_tpu.runtime.checkpoint import (  # noqa: F401
 )
 
 __all__ = [
-    "DeviceProfile", "device_profile", "check_device",
+    "DeviceProfile", "DeviceHealth", "device_profile", "device_health",
+    "healthy_devices", "check_device",
     "SolveDiagnostics", "hardened_cholesky", "solve_normal_cholesky",
     "ladder_cholesky_solve",
     "RetryPolicy", "SweepCheckpoint", "checkpointed_map", "with_retries",
+    "ExecutionPlan", "select_plan", "ladder",
+    "ElasticReport", "elastic_map",
 ]

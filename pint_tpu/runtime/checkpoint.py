@@ -11,15 +11,22 @@ is identical to an uninterrupted run.
 
 Checkpoint layout (``<path>/`` is a directory)::
 
-    meta.json          {"version": 1, "nchunks": N, "fingerprint": sha1}
+    meta.json          {"version": 2, "nchunks": N, "fingerprint": sha1,
+                        "sidecar": {...}}
     chunk_00000.npz    one npz of named arrays per completed chunk
     chunk_00001.npz    ...
 
 The fingerprint hashes the sweep definition (grid points, parameter
 names, model state, ...); resuming against a different sweep raises
 :class:`~pint_tpu.exceptions.CheckpointError` instead of silently mixing
-surfaces.  Chunk writes are atomic (tmp file + rename) so a crash during
-a write can only lose the in-flight chunk.
+surfaces.  **Mesh identity is deliberately NOT part of the
+fingerprint**: the device count / mesh shape a sweep happened to run on
+does not change its results, so it lives in the informational
+``sidecar`` field (updated in place as the elastic supervisor degrades
+the mesh, with prior values kept in ``sidecar_history``) — a sweep
+checkpointed on 8 devices resumes on 4.  Chunk writes are atomic (tmp
+file + rename) so a crash during a write can only lose the in-flight
+chunk.
 """
 
 from __future__ import annotations
@@ -184,27 +191,50 @@ def fingerprint_of(**kw) -> str:
 class SweepCheckpoint:
     """One sweep's on-disk chunk store (see module docstring for layout)."""
 
-    def __init__(self, path: str, fingerprint: str, nchunks: int):
+    def __init__(self, path: str, fingerprint: str, nchunks: int,
+                 sidecar: Optional[dict] = None):
         self.path = path
         self.fingerprint = fingerprint
         self.nchunks = int(nchunks)
         os.makedirs(path, exist_ok=True)
-        meta_path = os.path.join(path, "meta.json")
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
+        self._meta_path = os.path.join(path, "meta.json")
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
                 meta = json.load(f)
+            # the sidecar (mesh identity, plan) is informational and
+            # NEVER compared: resuming on a different device count must
+            # succeed — only the sweep definition gates
             if meta.get("fingerprint") != fingerprint \
                     or meta.get("nchunks") != self.nchunks:
                 raise CheckpointError(
                     f"{path}: existing checkpoint belongs to a different "
                     "sweep (fingerprint/chunk-count mismatch); refusing to "
                     "mix surfaces — delete the directory to start over")
+            self.meta = meta
+            if sidecar is not None and meta.get("sidecar") != sidecar:
+                self.update_sidecar(sidecar)
         else:
-            tmp = meta_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"version": 1, "nchunks": self.nchunks,
-                           "fingerprint": fingerprint}, f)
-            os.replace(tmp, meta_path)
+            self.meta = {"version": 2, "nchunks": self.nchunks,
+                         "fingerprint": fingerprint,
+                         "sidecar": sidecar or {}}
+            self._write_meta()
+
+    def _write_meta(self) -> None:
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.meta, f, default=str)
+        os.replace(tmp, self._meta_path)
+
+    def update_sidecar(self, sidecar: dict) -> None:
+        """Replace the informational sidecar (mesh identity / execution
+        plan), archiving the previous value in ``sidecar_history`` — a
+        resumed-on-fewer-devices sweep keeps a full provenance trail."""
+        prev = self.meta.get("sidecar")
+        if prev:
+            self.meta.setdefault("sidecar_history", []).append(prev)
+        self.meta["sidecar"] = sidecar
+        self.meta["version"] = 2
+        self._write_meta()
 
     def _chunk_path(self, i: int) -> str:
         return os.path.join(self.path, f"chunk_{i:05d}.npz")
@@ -240,20 +270,23 @@ def _invoke(fn: Callable, chunk, index: int):
 def checkpointed_map(fn: Callable, chunks: Sequence,
                      checkpoint: Optional[str] = None,
                      fingerprint: Optional[dict] = None,
-                     retry: Optional[RetryPolicy] = None) -> List[dict]:
+                     retry: Optional[RetryPolicy] = None,
+                     sidecar: Optional[dict] = None) -> List[dict]:
     """Map ``fn`` (chunk -> dict of numpy arrays) over ``chunks`` with
     per-chunk persistence, retry/backoff, and resume.
 
     With ``checkpoint`` set, completed chunks are loaded from disk instead
     of recomputed, so a crashed sweep resumes from the last completed
     chunk; ``fingerprint`` (kwargs for :func:`fingerprint_of`) guards
-    against resuming a different sweep.  Without ``checkpoint`` the
-    executor still applies the retry policy.
+    against resuming a different sweep (``sidecar`` carries the
+    informational mesh/device identity, which deliberately does NOT
+    gate resume).  Without ``checkpoint`` the executor still applies
+    the retry policy.
     """
     ckpt = None
     if checkpoint is not None:
         fp = fingerprint_of(**(fingerprint or {}))
-        ckpt = SweepCheckpoint(checkpoint, fp, len(chunks))
+        ckpt = SweepCheckpoint(checkpoint, fp, len(chunks), sidecar=sidecar)
         done = ckpt.completed()
         if done:
             log.info(f"sweep checkpoint {checkpoint}: resuming with "

@@ -1,0 +1,426 @@
+"""Elastic supervisor: fault-tolerant sharded sweeps that degrade, not die.
+
+The paper's sub-nanosecond phase contract makes *silent shard
+corruption worse than a crash*: a sick chip that keeps computing poisons
+every surface it touches.  This module wraps the execution-plan layer
+(:mod:`pint_tpu.runtime.plan`) in a supervisor that, on a collective
+timeout, device loss, or per-attempt failure mid-sweep:
+
+1. classifies the failure (:func:`classify_failure`) and, when a device
+   is identified, **evicts** it from mesh membership;
+2. rebuilds the mesh one rung down the 8→4→2→1 ladder
+   (:meth:`ExecutionPlan.degraded`) and re-dispatches;
+3. resumes from the last checkpoint — chunk boundaries are *logical*
+   (device-count-independent), so a sweep checkpointed on 8 devices
+   resumes on 4 with identical results; the mesh identity lives in the
+   checkpoint's **sidecar** (:class:`~pint_tpu.runtime.checkpoint
+   .SweepCheckpoint`), never in its fingerprint.
+
+Silent corruption is caught by the **cross-replica canary**: every
+dispatched block carries one replicated grid point at the head of each
+device's shard.  Healthy devices run the same program on the same value
+and must agree to fp noise; a NaN or off-median canary convicts its
+shard (:class:`~pint_tpu.exceptions.CanaryMismatchError`) and the
+device is evicted.
+
+Telemetry: ``plan_selected`` (plan layer), ``device_evicted``,
+``mesh_degraded``, and a final ``elastic.sweep_done`` carrying the
+recompile accounting — one recompile per rung change is expected and
+counted; *steady-state* recompiles after degradation settles must be
+zero (the executable is keyed by block shape, which is constant per
+rung).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import (
+    CanaryMismatchError,
+    DeviceLostError,
+    MeshExhaustedError,
+    SweepChunkFailure,
+    UsageError,
+)
+from pint_tpu.logging import log
+from pint_tpu.runtime import checkpoint as _cp
+from pint_tpu.runtime.plan import ExecutionPlan, _emit_event, select_plan
+
+__all__ = ["elastic_map", "ElasticReport", "classify_failure",
+           "check_canary", "run_with_degradation"]
+
+#: substrings that mark a runtime error as a failed/timed-out collective
+#: (the XLA client's wording across backends)
+_COLLECTIVE_MARKERS = ("collective", "all-reduce", "allreduce",
+                       "all-gather", "reduce-scatter", "all-to-all",
+                       "deadline", "timed out", "timeout")
+
+
+def classify_failure(exc: BaseException) -> Optional[dict]:
+    """``{"kind": ..., "devices": [ids]}`` for elastic-recoverable
+    failures, None for everything else (which must propagate: a typed
+    solve failure re-run on fewer devices would fail identically)."""
+    if isinstance(exc, SweepChunkFailure):
+        # retry-exhaustion wrapper (checkpoint.with_retries): classify
+        # the underlying failure, so a wrapped device loss degrades and
+        # a wrapped unclassifiable failure still propagates
+        return classify_failure(exc.__cause__) if exc.__cause__ is not None \
+            else None
+    if isinstance(exc, CanaryMismatchError):
+        return {"kind": "canary_mismatch",
+                "devices": [d for d in exc.device_ids if d is not None]}
+    if isinstance(exc, DeviceLostError):
+        did = getattr(exc, "device_id", None)
+        return {"kind": "device_loss",
+                "devices": [did] if did is not None else []}
+    if isinstance(exc, _cp._TIMEOUT_ERRORS):
+        return {"kind": "collective_timeout", "devices": []}
+    if type(exc).__name__ == "XlaRuntimeError" \
+            or isinstance(exc, RuntimeError):
+        msg = str(exc).lower()
+        if any(m in msg for m in _COLLECTIVE_MARKERS):
+            return {"kind": "collective_failure", "devices": []}
+        if "device" in msg:
+            return {"kind": "device_loss", "devices": []}
+    return None
+
+
+def check_canary(values, plan: ExecutionPlan, rtol: float = 1e-9,
+                 where: str = "") -> None:
+    """Cross-replica agreement check: ``values[d]`` is the canary result
+    computed by device ``d`` of the plan's mesh.  All shards ran the
+    same program on the same point, so healthy devices agree to fp
+    noise; NaN or off-median values convict their device."""
+    vals = np.asarray(values, dtype=np.float64)
+    finite = np.isfinite(vals)
+    if not finite.any():
+        # every shard returned the same non-finite verdict: a NaN chi2
+        # is a legitimate grid outcome (unsolvable point), and unanimous
+        # agreement on it is agreement, not per-device corruption
+        return
+    ref = float(np.median(vals[finite]))
+    bad = ~finite | (np.abs(vals - ref) > rtol * max(abs(ref), 1.0))
+    if bad.any():
+        ids = [int(plan.devices[i].id) for i in np.nonzero(bad)[0]
+               if i < len(plan.devices)]
+        raise CanaryMismatchError(
+            f"cross-replica canary mismatch{' in ' + where if where else ''}"
+            f": device(s) {ids} disagree (values {vals.tolist()}, "
+            f"reference {ref!r}) — silent shard corruption",
+            device_ids=ids)
+
+
+@dataclass
+class ElasticReport:
+    """What the supervisor did: rungs visited, devices evicted, and the
+    recompile accounting the acceptance gate asserts on."""
+
+    rungs: List[int] = field(default_factory=list)
+    evicted: List[int] = field(default_factory=list)
+    degradations: int = 0
+    chunks_resumed: int = 0
+    chunks_computed: int = 0
+    canary_checks: int = 0
+    #: compiles observed on the FIRST dispatch at each rung (expected:
+    #: one executable per rung change)
+    recompiles_by_rung: Dict[int, int] = field(default_factory=dict)
+    #: compiles observed on any LATER dispatch at an already-warm rung —
+    #: must stay 0 once degradation settles
+    steady_state_recompiles: int = 0
+    final_plan: Optional[dict] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "rungs": list(self.rungs),
+            "evicted": list(self.evicted),
+            "degradations": int(self.degradations),
+            "chunks_resumed": int(self.chunks_resumed),
+            "chunks_computed": int(self.chunks_computed),
+            "canary_checks": int(self.canary_checks),
+            "recompiles_by_rung": {str(k): int(v) for k, v in
+                                   self.recompiles_by_rung.items()},
+            "steady_state_recompiles": int(self.steady_state_recompiles),
+            "final_plan": self.final_plan,
+        }
+
+
+#: indirection for the block dispatch so the fault-injection harness can
+#: interpose shard-level faults (device loss at chunk k, NaN on one
+#: shard, straggler delay, failed collective) without touching the
+#: supervisor logic — the same seam discipline as checkpoint._invoke
+def _invoke_block(eval_fn: Callable, block: np.ndarray, index: int,
+                  plan: ExecutionPlan):
+    return eval_fn(block)
+
+
+def _block_layout(chunk: int, plan: ExecutionPlan,
+                  canary: bool) -> Tuple[int, np.ndarray, np.ndarray]:
+    """(block_size, canary_row_indices, real_row_indices) for one
+    logical chunk of ``chunk`` points dispatched on ``plan``.
+
+    Multi-device blocks interleave one canary row at the head of each
+    device's shard: rung D, q = ceil(chunk/D) real rows per device,
+    block = D*(q+1) rows.  Row layout per device d:
+    ``[canary, pt[d*q], ..., pt[d*q+q-1]]`` — so the canary costs D rows
+    out of the block, not a second dispatch."""
+    D = plan.rung
+    if D <= 1 or not canary:
+        return chunk, np.empty(0, dtype=int), np.arange(chunk)
+    q = -(-chunk // D)
+    per = q + 1
+    canary_rows = np.arange(D) * per
+    real_rows = np.concatenate(
+        [d * per + 1 + np.arange(q) for d in range(D)])[:chunk]
+    return D * per, canary_rows, real_rows
+
+
+def _degrade(plan: ExecutionPlan, info: dict, chunk_index: int,
+             report: ElasticReport) -> ExecutionPlan:
+    """Evict identified devices, drop one rung, emit the telemetry."""
+    for did in info["devices"]:
+        report.evicted.append(int(did))
+        _emit_event("device_evicted", device_id=int(did),
+                    reason=info["kind"], chunk=int(chunk_index))
+        log.warning(f"elastic: evicting device {did} "
+                    f"({info['kind']} at chunk {chunk_index})")
+    new = plan.degraded(evict_ids=info["devices"])
+    report.degradations += 1
+    report.rungs.append(new.rung)
+    _emit_event("mesh_degraded", from_rung=int(plan.rung),
+                to_rung=int(new.rung), reason=info["kind"],
+                chunk=int(chunk_index),
+                n_remaining=len(new.devices))
+    log.warning(f"elastic: mesh degraded {plan.rung} -> {new.rung} "
+                f"device(s) ({info['kind']} at chunk {chunk_index}); "
+                "resuming from last checkpoint")
+    return new
+
+
+def _compile_delta(before) -> int:
+    """Backend compiles since ``before`` (None when accounting is off)."""
+    if before is None:
+        return 0
+    from pint_tpu.telemetry import jaxevents
+
+    return (jaxevents.counts() - before).compiles
+
+
+def _compile_mark():
+    if config._telemetry_mode == "off":
+        return None
+    from pint_tpu.telemetry import jaxevents
+
+    jaxevents.install()
+    return jaxevents.counts()
+
+
+def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
+                points: np.ndarray,
+                *,
+                plan: Optional[ExecutionPlan] = None,
+                chunk: int = 128,
+                checkpoint: Optional[str] = None,
+                fingerprint: Optional[dict] = None,
+                retry: Optional[_cp.RetryPolicy] = None,
+                canary: bool = True,
+                canary_key: str = "chi2",
+                canary_rtol: float = 1e-9,
+                what: str = "elastic sweep"
+                ) -> Tuple[Dict[str, np.ndarray], ElasticReport]:
+    """Map a sharded evaluator over ``points`` with eviction/degradation.
+
+    ``make_eval(block_size, plan)`` builds the evaluator for one rung:
+    a callable ``(block (B, G) ndarray) -> {name: (B, ...) ndarray}``
+    that dispatches the block through the plan's mesh.  It is invoked
+    once per rung (the per-rung executable — exactly one recompile per
+    rung change).
+
+    Chunk boundaries are **logical**: ``chunk`` points per chunk
+    regardless of device count, every chunk padded to full size (the
+    pad repeats the last point), so (a) checkpoints written at one rung
+    resume at any other, and (b) block shapes are constant per rung and
+    the steady state never recompiles.  With ``checkpoint`` set,
+    completed chunks persist via :class:`SweepCheckpoint` with the
+    current plan in the sidecar; ``fingerprint`` must therefore never
+    include mesh identity.
+    """
+    policy = retry or _cp.RetryPolicy()
+    points = np.asarray(points)
+    npts = points.shape[0]
+    if npts == 0:
+        return {}, ElasticReport()
+    if plan is None:
+        plan = select_plan("grid", n_items=npts)
+    if len(plan.axes) != 1:
+        # the canary layout and check_canary's row->device attribution
+        # assume one batch shard per mesh device; a multi-axis plan
+        # replicates the batch over the trailing axes, so a conviction
+        # would name devices that never computed the offending rows
+        raise UsageError(
+            f"elastic_map requires a single-axis plan (got axes "
+            f"{plan.axes}); build one with select_plan(workload)")
+    nchunks = -(-npts // chunk)
+    report = ElasticReport(rungs=[plan.rung])
+
+    ckpt = None
+    if checkpoint is not None:
+        fp = _cp.fingerprint_of(**(fingerprint or {}))
+        ckpt = _cp.SweepCheckpoint(checkpoint, fp, nchunks,
+                                   sidecar={"plan": plan.to_dict()})
+        done = ckpt.completed()
+        if done:
+            log.info(f"{what}: resuming with {len(done)}/{nchunks} "
+                     "chunks already complete")
+
+    evals: Dict[int, Callable] = {}      # rung -> evaluator
+    layouts: Dict[int, tuple] = {}       # rung -> (B, canary_rows, real_rows)
+    warm_rungs: set = set()              # rungs whose first dispatch ran
+    canary_pt = points[0]
+
+    def _get_eval(p: ExecutionPlan) -> Tuple[Callable, tuple]:
+        if p.rung not in evals:
+            layouts[p.rung] = _block_layout(chunk, p, canary)
+            evals[p.rung] = make_eval(layouts[p.rung][0], p)
+        return evals[p.rung], layouts[p.rung]
+
+    def _assemble(chunk_pts: np.ndarray, layout) -> np.ndarray:
+        B, canary_rows, real_rows = layout
+        padded = chunk_pts
+        if len(padded) < chunk:
+            padded = np.concatenate(
+                [padded, np.tile(padded[-1:], (chunk - len(padded), 1))])
+        block = np.repeat(padded[-1:], B, axis=0)
+        if len(canary_rows):
+            block[canary_rows] = canary_pt
+        block[real_rows] = padded
+        return block
+
+    out_chunks: List[Optional[dict]] = [None] * nchunks
+    for i in range(nchunks):
+        lo, hi = i * chunk, min((i + 1) * chunk, npts)
+        chunk_pts = points[lo:hi]
+        if ckpt is not None and ckpt.has(i):
+            out_chunks[i] = ckpt.load(i)
+            report.chunks_resumed += 1
+            if config._telemetry_mode != "off":
+                from pint_tpu import telemetry as _tel
+
+                _tel.event("sweep.chunk_resumed", index=i)
+            continue
+
+        attempt = 0
+        # ONE same-rung retry for unattributed transients; after that a
+        # repeat failure costs a rung (some chip is sick — keep sweeping)
+        transient_left = 1
+        while True:
+            eval_fn, layout = _get_eval(plan)
+            block = _assemble(chunk_pts, layout)
+            mark = _compile_mark()
+            try:
+                out = _cp._call_with_timeout(
+                    lambda: _invoke_block(eval_fn, block, i, plan),
+                    policy.timeout)
+                B, canary_rows, real_rows = layout
+                if len(canary_rows):
+                    report.canary_checks += 1
+                    check_canary(np.asarray(out[canary_key])[canary_rows],
+                                 plan, rtol=canary_rtol,
+                                 where=f"{what} chunk {i}")
+                compiles = _compile_delta(mark)
+                if plan.rung in warm_rungs:
+                    report.steady_state_recompiles += compiles
+                else:
+                    report.recompiles_by_rung[plan.rung] = compiles
+                    warm_rungs.add(plan.rung)
+                res = {k: np.asarray(v)[real_rows][: hi - lo]
+                       for k, v in out.items()}
+                break
+            except Exception as e:  # noqa: BLE001 — classified below
+                info = classify_failure(e)
+                if info is None:
+                    raise
+                attempt += 1
+                log.warning(f"{what} chunk {i}: {info['kind']} "
+                            f"({type(e).__name__}: {e})")
+                if not info["devices"] and transient_left > 0 \
+                        and info["kind"] in ("collective_timeout",
+                                             "collective_failure"):
+                    # no device identified: one same-rung retry first —
+                    # a transient tunnel hiccup shouldn't cost a rung
+                    transient_left -= 1
+                    delay = policy.backoff_base \
+                        * policy.backoff_factor ** (attempt - 1)
+                    if delay > 0:
+                        time.sleep(delay)
+                    continue
+                try:
+                    plan = _degrade(plan, info, i, report)
+                except MeshExhaustedError as exhausted:
+                    raise SweepChunkFailure(
+                        f"{what} chunk {i}: degradation ladder exhausted "
+                        f"after {attempt} attempt(s) "
+                        f"(last: {type(e).__name__}: {e})") from exhausted
+                if ckpt is not None:
+                    ckpt.update_sidecar({"plan": plan.to_dict()})
+
+        report.chunks_computed += 1
+        if ckpt is not None:
+            ckpt.save(i, **res)
+        if config._telemetry_mode != "off":
+            from pint_tpu import telemetry as _tel
+
+            _tel.event("sweep.chunk_done", index=i, total=nchunks,
+                       persisted=ckpt is not None)
+        out_chunks[i] = res
+
+    report.final_plan = plan.to_dict()
+    _emit_event("elastic.sweep_done", chunks=nchunks,
+                rungs=[int(r) for r in report.rungs],
+                evicted=[int(d) for d in report.evicted],
+                degradations=int(report.degradations),
+                steady_state_recompiles=int(report.steady_state_recompiles),
+                recompiles_by_rung={str(k): int(v) for k, v in
+                                    report.recompiles_by_rung.items()})
+    keys = out_chunks[0].keys()
+    merged = {k: np.concatenate([c[k] for c in out_chunks]) for k in keys}
+    return merged, report
+
+
+def run_with_degradation(plan: ExecutionPlan, fn: Callable,
+                         what: str = "sharded evaluation",
+                         max_transient: int = 1):
+    """Run ``fn(plan)`` under elastic supervision: classified failures
+    evict/degrade and re-run on the next rung; everything else
+    propagates.  Returns ``(result, final_plan, report)`` — callers
+    that hold a plan (sampler, GLS fitter) adopt the survivor and keep
+    the eviction/degradation accounting.  The lightweight sibling of
+    :func:`elastic_map` for non-chunked evaluations."""
+    report = ElasticReport(rungs=[plan.rung])
+    transient_left = max_transient
+    while True:
+        try:
+            result = fn(plan)
+            report.final_plan = plan.to_dict()
+            return result, plan, report
+        except Exception as e:  # noqa: BLE001 — classified below
+            info = classify_failure(e)
+            if info is None:
+                raise
+            log.warning(f"{what}: {info['kind']} "
+                        f"({type(e).__name__}: {e})")
+            if not info["devices"] and transient_left > 0 \
+                    and info["kind"] in ("collective_timeout",
+                                         "collective_failure"):
+                transient_left -= 1
+                continue
+            try:
+                plan = _degrade(plan, info, -1, report)
+            except MeshExhaustedError as exhausted:
+                raise SweepChunkFailure(
+                    f"{what}: degradation ladder exhausted "
+                    f"(last: {type(e).__name__}: {e})") from exhausted

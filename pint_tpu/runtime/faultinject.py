@@ -24,6 +24,22 @@ Faults:
   after *n* completed chunks, for kill-and-resume tests;
 * :func:`flaky` — wrap any callable to fail its first *n* calls.
 
+Shard-level faults at the elastic supervisor's dispatch seam
+(:func:`pint_tpu.runtime.elastic._invoke_block`):
+
+* :func:`shard_device_loss` — a chosen device "dies" while evaluating a
+  chosen chunk (:class:`SimulatedDeviceLoss` carrying ``device_id``, so
+  the supervisor must evict it and degrade the mesh);
+* :func:`shard_nan` — one device's shard of a block's outputs is
+  silently NaN-poisoned (a corrupting chip the cross-replica canary
+  must catch);
+* :func:`straggler` — one block dispatch stalls for a chosen delay (a
+  wedged chip; the per-attempt timeout must classify it);
+* :func:`failed_collective` — a block dispatch dies with an XLA-shaped
+  collective failure (no device attributable: degrade, don't evict);
+* :func:`sick_device` — the per-device preflight probe reports a chosen
+  device unhealthy, so plan selection must exclude it from the mesh.
+
 Everything is plain attribute patching with restore-on-exit; no fault
 leaks past its ``with`` block.
 """
@@ -41,11 +57,14 @@ from pint_tpu.exceptions import DeviceLostError
 
 __all__ = ["SimulatedDeviceLoss", "SimulatedCrash", "nan_residuals",
            "singular_gram", "truncated_copy", "garbled_copy", "device_loss",
-           "crash_after_chunks", "flaky"]
+           "crash_after_chunks", "flaky", "shard_device_loss", "shard_nan",
+           "straggler", "failed_collective", "shard_crash_after_chunks",
+           "sick_device"]
 
 
 class SimulatedDeviceLoss(DeviceLostError):
-    """Injected device failure (retryable by the chunk executor)."""
+    """Injected device failure (retryable by the chunk executor; when
+    ``device_id`` is set the elastic supervisor evicts that device)."""
 
 
 class SimulatedCrash(RuntimeError):
@@ -223,6 +242,167 @@ def device_loss(fail_times: int = 2):
         yield state
     finally:
         cp._invoke = orig
+
+
+@contextlib.contextmanager
+def _patched_invoke_block(wrapper):
+    """Install ``wrapper(orig, eval_fn, block, index, plan) -> result``
+    at the elastic supervisor's block-dispatch seam, restore on exit."""
+    from pint_tpu.runtime import elastic as el
+
+    orig = el._invoke_block
+
+    def patched(eval_fn, block, index, plan):
+        return wrapper(orig, eval_fn, block, index, plan)
+
+    el._invoke_block = patched
+    try:
+        yield
+    finally:
+        el._invoke_block = orig
+
+
+@contextlib.contextmanager
+def shard_device_loss(at_chunk: int = 0, device_index: int = 0,
+                      times: int = 1):
+    """Device ``device_index`` (position in the plan's mesh) "dies"
+    while evaluating chunk ``at_chunk``: the first ``times`` dispatches
+    of that chunk raise :class:`SimulatedDeviceLoss` carrying the
+    device's id — the supervisor must evict it, degrade the mesh one
+    rung, and re-dispatch the chunk."""
+    state = {"calls": 0}
+
+    def wrapper(orig, eval_fn, block, index, plan):
+        if index == at_chunk and state["calls"] < times:
+            state["calls"] += 1
+            did = int(plan.devices[min(device_index,
+                                       plan.rung - 1)].id)
+            raise SimulatedDeviceLoss(
+                f"injected: device {did} lost during chunk {index}",
+                device_id=did)
+        return orig(eval_fn, block, index, plan)
+
+    with _patched_invoke_block(wrapper):
+        yield state
+
+
+@contextlib.contextmanager
+def shard_nan(device_index: int = 0, at_chunk: int = 0, times: int = 1):
+    """Silently NaN-poison device ``device_index``'s shard of the block
+    outputs for chunk ``at_chunk`` (the first ``times`` dispatches) —
+    the corrupting-chip failure mode the cross-replica canary exists to
+    catch.  Rows are poisoned in the device's contiguous slice of the
+    batch axis, canary row included (a sick chip corrupts everything it
+    computes)."""
+    state = {"calls": 0}
+
+    def wrapper(orig, eval_fn, block, index, plan):
+        out = orig(eval_fn, block, index, plan)
+        if index == at_chunk and state["calls"] < times and plan.rung > 1:
+            state["calls"] += 1
+            d = min(device_index, plan.rung - 1)
+            per = len(block) // plan.rung
+            rows = slice(d * per, (d + 1) * per)
+            out = {k: np.array(v, dtype=np.float64, copy=True)
+                   if np.issubdtype(np.asarray(v).dtype, np.floating)
+                   else v for k, v in out.items()}
+            for v in out.values():
+                if isinstance(v, np.ndarray) \
+                        and np.issubdtype(v.dtype, np.floating):
+                    v[rows] = np.nan
+        return out
+
+    with _patched_invoke_block(wrapper):
+        yield state
+
+
+@contextlib.contextmanager
+def straggler(delay_s: float, at_chunk: int = 0, times: int = 1):
+    """Chunk ``at_chunk``'s first ``times`` dispatches stall for
+    ``delay_s`` before returning (a wedged chip / stuck collective);
+    with a per-attempt timeout below the delay, the supervisor
+    classifies the timeout and degrades the mesh."""
+    import time as _time
+
+    state = {"calls": 0}
+
+    def wrapper(orig, eval_fn, block, index, plan):
+        if index == at_chunk and state["calls"] < times:
+            state["calls"] += 1
+            _time.sleep(delay_s)
+        return orig(eval_fn, block, index, plan)
+
+    with _patched_invoke_block(wrapper):
+        yield state
+
+
+@contextlib.contextmanager
+def failed_collective(at_chunk: int = 0, times: int = 1):
+    """Chunk ``at_chunk``'s first ``times`` dispatches die with an
+    XLA-shaped collective failure.  No device is attributable, so the
+    supervisor must degrade the whole mesh one rung without evicting."""
+    state = {"calls": 0}
+
+    def wrapper(orig, eval_fn, block, index, plan):
+        if index == at_chunk and state["calls"] < times:
+            state["calls"] += 1
+            # deliberately NOT a PintError: a real collective failure
+            # arrives as the XLA client's RuntimeError, and the
+            # supervisor's classifier must recognize it by wording
+            raise RuntimeError(  # jaxlint: disable=typed-raise
+                f"injected: all-reduce collective failed on chunk {index}")
+        return orig(eval_fn, block, index, plan)
+
+    with _patched_invoke_block(wrapper):
+        yield state
+
+
+@contextlib.contextmanager
+def shard_crash_after_chunks(n: int):
+    """Elastic twin of :func:`crash_after_chunks`: ``n`` block dispatches
+    complete, then every later one raises :class:`SimulatedCrash` (NOT a
+    classified elastic failure — the supervisor must let it propagate,
+    exactly like a real host death; recovery is a fresh process resuming
+    from the checkpoint, possibly on a different device count)."""
+    state = {"calls": 0}
+
+    def wrapper(orig, eval_fn, block, index, plan):
+        if state["calls"] >= n:
+            raise SimulatedCrash(  # jaxlint: disable=typed-raise
+                f"injected: host died before chunk {index}")
+        state["calls"] += 1
+        return orig(eval_fn, block, index, plan)
+
+    with _patched_invoke_block(wrapper):
+        yield state
+
+
+@contextlib.contextmanager
+def sick_device(device_index: int):
+    """The per-device preflight probe reports device ``device_index``
+    unhealthy (NaN two_sum error word) for the duration of the context;
+    the health cache is refreshed on entry and exit, so plan selection
+    inside the context must exclude the device."""
+    from pint_tpu.runtime import preflight as pf
+
+    orig = pf._probe_one
+
+    def sick(dev):
+        h = orig(dev)
+        if int(getattr(dev, "id", -1)) == device_index:
+            h = pf.DeviceHealth(device_id=h.device_id,
+                                platform=h.platform, healthy=False,
+                                two_sum_error=float("nan"),
+                                error="injected: sick device")
+        return h
+
+    pf._probe_one = sick
+    try:
+        pf.device_health(refresh=True)
+        yield
+    finally:
+        pf._probe_one = orig
+        pf.device_health(refresh=True)
 
 
 @contextlib.contextmanager

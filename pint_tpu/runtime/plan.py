@@ -1,0 +1,251 @@
+"""Execution-plan layer: route work onto the preflight-certified mesh.
+
+ROADMAP item 1 — nothing in the library *routed* real work through a
+mesh until this module.  An :class:`ExecutionPlan` is the single object
+that answers "which devices, in what mesh shape, through which JAX
+partitioning mechanism" for the three parallel axes of the framework:
+
+* ``grid``   — the batch of grid points / parameter vectors (the
+  reference's process-pool axis);
+* ``toa``    — the data axis the GLS normal-equation contractions
+  reduce over (cross-device all-reduces);
+* ``walker`` — the MCMC ensemble axis.
+
+Plan selection (:func:`select_plan`) starts from the per-device
+preflight probes (:func:`pint_tpu.runtime.preflight.healthy_devices` —
+a chip that fails the two_sum f64 probe never joins a mesh) and picks
+the mechanism per workload:
+
+* ``pjit``      — ``jax.jit`` + ``NamedSharding``/``PartitionSpec``
+  when operand shardings are known (grid chunks, TOA-sharded normal
+  equations); reductions become XLA SPMD collectives;
+* ``shard_map`` — the pure data-parallel fallback (MCMC walkers): each
+  device runs the batched function on its slice, with no cross-item
+  reduction and therefore no accidental resharding collectives;
+* ``single``    — the last rung of the ladder: one device, no mesh.
+
+The device count is always a rung of the :func:`ladder` (descending
+powers of two, 8→4→2→1) so the elastic supervisor
+(:mod:`pint_tpu.runtime.elastic`) can degrade a plan one rung at a time
+after evicting a sick device.  Every selection emits a ``plan_selected``
+telemetry event; eviction/degradation events are the supervisor's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import MeshExhaustedError, UsageError
+from pint_tpu.logging import log
+
+__all__ = ["ExecutionPlan", "select_plan", "ladder", "MESH_AXES"]
+
+#: the framework's parallel axes (DESIGN.md "Parallelism")
+MESH_AXES = ("grid", "toa", "walker")
+
+#: workload -> (primary batch axis, multi-device mechanism)
+_WORKLOAD_AXIS = {
+    "grid": ("grid", "pjit"),
+    "gls_normal_eq": ("toa", "pjit"),
+    "walker": ("walker", "shard_map"),
+}
+
+
+def ladder(n: int) -> Tuple[int, ...]:
+    """Degradation rungs available with ``n`` devices: descending powers
+    of two ≤ n, ending at 1 (``ladder(8) == (8, 4, 2, 1)``; a 7-device
+    survivor set yields ``(4, 2, 1)`` — mesh shapes stay power-of-two so
+    chunk tiling and collective replica groups stay regular)."""
+    if n < 1:
+        raise MeshExhaustedError(
+            f"no devices left to build a mesh from (n={n})")
+    rungs = []
+    r = 1 << (int(n).bit_length() - 1)
+    while r >= 1:
+        rungs.append(r)
+        r //= 2
+    return tuple(rungs)
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Elastic-lifecycle telemetry: attach to the current span AND — in
+    full mode — write a loose event into the run's events.jsonl, so
+    plan/eviction/degradation decisions are observable even when no
+    span is open (e.g. a supervisor retry loop between sweeps)."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.event(name, **attrs)
+    if config.telemetry_mode() == "full":
+        from pint_tpu.telemetry import runlog
+
+        runlog.ensure_run().record_event(name, **attrs)
+
+
+@dataclass(frozen=True)
+class ExecutionPlan:
+    """One routed execution recipe: devices + mesh shape + mechanism.
+
+    Frozen: the elastic supervisor never mutates a plan, it derives the
+    next rung via :meth:`degraded` (so telemetry events can reference
+    both the old and the new plan unambiguously)."""
+
+    workload: str               #: "grid" | "gls_normal_eq" | "walker" | ...
+    kind: str                   #: "pjit" | "shard_map" | "single"
+    axes: Tuple[str, ...]       #: mesh axis names; axes[0] = batch axis
+    devices: Tuple              #: healthy member devices (superset of mesh)
+    rung: int                   #: devices actually meshed (a ladder rung)
+    evicted: Tuple[int, ...] = ()   #: device ids removed by the supervisor
+    _cache: dict = field(default_factory=dict, repr=False, compare=False)
+
+    @property
+    def mesh(self):
+        """The ``jax.sharding.Mesh`` of this rung (None for single).
+        Two-axis plans split the leading axis by 2 when the rung is even
+        (the multichip dryrun's ``(grid, toa)`` layout)."""
+        if self.rung <= 1:
+            return None
+        if "mesh" not in self._cache:
+            from jax.sharding import Mesh
+
+            devs = np.array(self.devices[: self.rung])
+            if len(self.axes) == 1:
+                self._cache["mesh"] = Mesh(devs, self.axes)
+            else:
+                lead = 2 if self.rung % 2 == 0 else 1
+                self._cache["mesh"] = Mesh(
+                    devs.reshape(lead, self.rung // lead), self.axes)
+        return self._cache["mesh"]
+
+    def batch_sharding(self):
+        """``NamedSharding`` partitioning the batch (first) axis over
+        ``axes[0]``, or None for a single-device plan."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self.axes[0]))
+
+    def shard_map_batch(self, fn, out_axis0: bool = True):
+        """Wrap a batched jax-traceable ``fn(batch) -> per-item out`` for
+        pure data-parallel execution: each device runs ``fn`` on its
+        batch slice (no collectives can appear — the shard_map contract).
+        The batch length must be a multiple of the rung.  The wrapper's
+        input buffer is donated: the batch is iteration state rebuilt
+        every call (walker proposals), so XLA may reuse it in place."""
+        if self.mesh is None:
+            return fn
+        key = ("shard_map", id(fn))
+        if key not in self._cache:
+            import jax
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            axis = self.axes[0]
+            inner = shard_map(fn, mesh=self.mesh,
+                              in_specs=P(axis),
+                              out_specs=P(axis) if out_axis0 else P(),
+                              check_rep=False)
+            self._cache[key] = jax.jit(inner, donate_argnums=(0,))
+        return self._cache[key]
+
+    def degraded(self, evict_ids: Sequence[int] = ()) -> "ExecutionPlan":
+        """The next rung down, with ``evict_ids`` removed from
+        membership.  Strictly descends the ladder even when no device
+        was identified (collective timeout: SOME chip is sick, we just
+        don't know which).  Raises :class:`MeshExhaustedError` below
+        rung 1."""
+        evict = set(int(i) for i in evict_ids)
+        remaining = tuple(d for d in self.devices if d.id not in evict)
+        if not remaining:
+            raise MeshExhaustedError(
+                "every device has been evicted; no rung remains")
+        rungs = ladder(len(remaining))
+        down = [r for r in rungs if r < self.rung]
+        if not down:
+            raise MeshExhaustedError(
+                f"cannot degrade below rung {self.rung} "
+                f"({len(remaining)} device(s) remain)")
+        new_rung = down[0]
+        return replace(
+            self, devices=remaining, rung=new_rung,
+            kind=self.kind if new_rung > 1 else "single",
+            evicted=self.evicted + tuple(sorted(evict)),
+            _cache={})
+
+    @property
+    def device_ids(self) -> Tuple[int, ...]:
+        return tuple(int(d.id) for d in self.devices[: self.rung])
+
+    def to_dict(self) -> dict:
+        return {
+            "workload": self.workload,
+            "kind": self.kind,
+            "axes": list(self.axes),
+            "rung": int(self.rung),
+            "n_devices": len(self.devices),
+            "device_ids": list(self.device_ids),
+            "evicted": list(self.evicted),
+            "platform": str(self.devices[0].platform) if self.devices
+            else None,
+        }
+
+
+def select_plan(workload: str = "grid",
+                devices: Optional[Sequence] = None,
+                n_items: Optional[int] = None,
+                max_devices: Optional[int] = None,
+                axes: Optional[Sequence[str]] = None,
+                kind: Optional[str] = None) -> ExecutionPlan:
+    """Auto-select the execution plan for ``workload`` from the
+    preflight-certified device set.
+
+    ``devices`` defaults to :func:`preflight.healthy_devices` — a chip
+    that fails its per-device two_sum probe never joins the mesh.
+    ``n_items`` caps the rung at the batch size (meshing 8 devices for
+    3 points buys nothing), ``max_devices`` caps it absolutely, and
+    ``kind`` forces the mechanism (tests / explicit shard_map opt-in).
+    Emits a ``plan_selected`` telemetry event.
+    """
+    from pint_tpu.runtime.preflight import healthy_devices
+
+    if devices is None:
+        devices = healthy_devices()
+    devices = tuple(devices)
+    if not devices:
+        raise MeshExhaustedError(
+            "no healthy devices: every per-device preflight probe failed")
+    if workload not in _WORKLOAD_AXIS:
+        raise UsageError(f"unknown workload {workload!r}; the routed "
+                         f"workloads are {tuple(_WORKLOAD_AXIS)}")
+    axis, default_kind = _WORKLOAD_AXIS[workload]
+    axes = tuple(axes) if axes else (axis,)
+    for a in axes:
+        if a not in MESH_AXES:
+            raise UsageError(f"unknown mesh axis {a!r}; the framework's "
+                             f"axes are {MESH_AXES}")
+    n = len(devices)
+    if max_devices is not None:
+        n = min(n, int(max_devices))
+    if n_items is not None:
+        n = min(n, max(1, int(n_items)))
+    rung = ladder(n)[0]
+    resolved = kind or default_kind
+    if rung == 1:
+        resolved = "single"
+    elif resolved not in ("pjit", "shard_map"):
+        raise UsageError(f"unknown plan kind {resolved!r} "
+                         "(pjit | shard_map | single)")
+    plan = ExecutionPlan(workload=workload, kind=resolved, axes=axes,
+                         devices=devices, rung=rung)
+    log.info(f"execution plan: {workload} -> {resolved} on rung {rung} "
+             f"({len(devices)} healthy device(s), axes {axes})")
+    _emit_event("plan_selected", workload=workload, kind=resolved,
+                rung=int(rung), n_devices=len(devices),
+                axes=",".join(axes), device_ids=list(plan.device_ids))
+    return plan

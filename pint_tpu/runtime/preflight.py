@@ -33,7 +33,8 @@ from pint_tpu import config
 from pint_tpu.exceptions import DeviceMismatchError
 from pint_tpu.logging import log
 
-__all__ = ["DeviceProfile", "device_profile", "check_device",
+__all__ = ["DeviceProfile", "DeviceHealth", "device_profile",
+           "device_health", "healthy_devices", "check_device",
            "platform_matches"]
 
 #: platform strings that name "the TPU behind the tunnel" — the single
@@ -135,6 +136,107 @@ def device_profile(refresh: bool = False) -> DeviceProfile:
                 f"{_profile.two_sum_error:.2e}); time-critical paths use "
                 "the exact-by-construction decomposition (DESIGN.md)")
     return _profile
+
+
+@dataclass(frozen=True)
+class DeviceHealth:
+    """Per-device health verdict from the two_sum f64 probe.
+
+    Unlike :class:`DeviceProfile` (one probe of the default backend,
+    i.e. whichever device jit dispatches to first), this is measured on
+    EACH device: mesh membership must be decided per chip, because a
+    single sick chip mid-mesh corrupts every shard it touches."""
+
+    device_id: int
+    platform: str
+    healthy: bool
+    two_sum_error: float   #: |recovered - expected| error-word defect
+    error: Optional[str] = None  #: probe exception, when one fired
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+#: a healthy device recovers the two_sum error word to at worst the
+#: emulated-f64 regime's ~2^-53 defect (DESIGN.md); anything larger —
+#: or non-finite, or a probe that raises — marks the device sick
+_HEALTH_DEFECT_BAR = 2.0 ** -50
+
+_device_health: Optional[tuple] = None
+
+
+def _probe_one(dev) -> DeviceHealth:
+    """two_sum f64 probe executed ON ``dev`` (jit follows operand
+    placement).  Module-level so the fault-injection harness can
+    interpose a sick device deterministically."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def two_sum_err(a, b):
+        s = a + b
+        bb = s - a
+        return (a - (s - bb)) + (b - bb)
+
+    try:
+        a = jax.device_put(jnp.float64(1.0), dev)
+        b = jax.device_put(jnp.float64(_PROBE_B), dev)
+        err = float(two_sum_err(a, b))
+        defect = abs(err - _PROBE_ERR_EXPECTED)
+        healthy = math.isfinite(err) and defect < _HEALTH_DEFECT_BAR
+        return DeviceHealth(device_id=int(dev.id),
+                            platform=str(dev.platform),
+                            healthy=bool(healthy),
+                            two_sum_error=float(defect))
+    except Exception as e:  # a probe that cannot run IS the verdict
+        return DeviceHealth(device_id=int(getattr(dev, "id", -1)),
+                            platform=str(getattr(dev, "platform", "?")),
+                            healthy=False, two_sum_error=float("inf"),
+                            error=f"{type(e).__name__}: {e}")
+
+
+def device_health(refresh: bool = False) -> tuple:
+    """Per-device :class:`DeviceHealth` for every visible device (probed
+    once per process; ``refresh=True`` re-probes).  The single
+    mesh-membership source of truth: :func:`healthy_devices` filters on
+    it, and the plan layer / scalewatch build meshes only from that."""
+    global _device_health
+    if _device_health is None or refresh:
+        import jax
+
+        def safe(d):
+            # _probe_one converts its own failures into a verdict; this
+            # belt catches a probe IMPLEMENTATION that throws (a moved
+            # backend API must degrade to "sick", not crash preflight)
+            try:
+                return _probe_one(d)
+            except Exception as e:
+                return DeviceHealth(
+                    device_id=int(getattr(d, "id", -1)),
+                    platform=str(getattr(d, "platform", "?")),
+                    healthy=False, two_sum_error=float("inf"),
+                    error=f"{type(e).__name__}: {e}")
+
+        _device_health = tuple(safe(d) for d in jax.devices())
+        sick = [h for h in _device_health if not h.healthy]
+        if sick:
+            log.warning(
+                f"Device preflight: {len(sick)}/{len(_device_health)} "
+                f"device(s) failed the per-device two_sum probe "
+                f"(ids {[h.device_id for h in sick]}); they are excluded "
+                "from mesh membership")
+    return _device_health
+
+
+def healthy_devices(refresh: bool = False) -> list:
+    """The devices that passed the per-device probe, in device order —
+    what :func:`pint_tpu.runtime.plan.select_plan` builds meshes from."""
+    import jax
+
+    ok = {h.device_id for h in device_health(refresh=refresh) if h.healthy}
+    return [d for d in jax.devices() if d.id in ok]
 
 
 def platform_matches(actual: str, requested: str) -> bool:

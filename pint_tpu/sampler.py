@@ -221,7 +221,7 @@ class EnsembleSampler(MCMCSampler):
 
     def __init__(self, nwalkers: int, a: float = 2.0,
                  seed: Optional[int] = None, backend=None,
-                 checkpoint_every: int = 50, mesh=None,
+                 checkpoint_every: int = 50, mesh=None, plan=None,
                  retries: int = 2, retry_backoff: float = 0.5):
         super().__init__()
         if nwalkers % 2:
@@ -261,27 +261,96 @@ class EnsembleSampler(MCMCSampler):
         # fused-jit envelope measured in tests/test_fused_relaxation.py),
         # and the sharded path itself is deterministic for a given seed.
         self.mesh = mesh
+        # plan: the execution-plan layer's routed alternative to a raw
+        # mesh ("auto" selects a walker-axis plan from the preflight-
+        # certified devices).  A shard_map plan runs the batch fn pure-
+        # data-parallel (each device evaluates its walker slice, with
+        # the walker buffer donated — it is iteration state rebuilt
+        # every proposal); on device loss the elastic supervisor evicts
+        # the chip and degrades the plan one rung instead of failing
+        # the chain.
+        self.plan = plan
+        self._shard_map_ok: Optional[bool] = None
+
+    def _resolve_plan(self):
+        if isinstance(self.plan, str):
+            from pint_tpu.exceptions import UsageError
+            from pint_tpu.runtime.plan import select_plan
+
+            if self.plan != "auto":
+                raise UsageError(f"plan={self.plan!r}: pass 'auto' or an "
+                                 "ExecutionPlan")
+            # half-ensemble updates dispatch nwalkers/2 at a time
+            self.plan = select_plan("walker",
+                                    n_items=max(1, self.nwalkers // 2))
+        return self.plan
 
     def _eval_lnpost(self, pts: np.ndarray) -> np.ndarray:
         """Batched lnposterior with device-loss retry, optionally
-        walker-sharded over the mesh."""
+        walker-sharded over the mesh/plan.  Under a plan, a classified
+        failure that exhausts its retries degrades the mesh one rung
+        (elastic supervision) instead of killing the chain; anything
+        unclassifiable propagates — re-running it on fewer devices
+        would fail identically or worse."""
         from pint_tpu.runtime.checkpoint import with_retries
 
-        return with_retries(lambda: self._eval_lnpost_once(pts),
-                            self.retry_policy, what="lnposterior batch")
+        def once():
+            return with_retries(lambda: self._eval_lnpost_once(pts),
+                                self.retry_policy,
+                                what="lnposterior batch")
+
+        plan = self._resolve_plan()
+        if plan is None or plan.mesh is None:
+            return once()
+        from pint_tpu.runtime import elastic as _elastic
+
+        def attempt(p):
+            if p is not self.plan:
+                self.plan = p
+                self._shard_map_ok = None  # re-wrap on the new mesh
+            return once()
+
+        result, final, self.last_elastic_report = \
+            _elastic.run_with_degradation(
+                plan, attempt, what="lnposterior batch")
+        self.plan = final
+        return result
 
     def _eval_lnpost_once(self, pts: np.ndarray) -> np.ndarray:
-        if self.mesh is None:
+        plan = self._resolve_plan()
+        mesh = self.mesh if plan is None else plan.mesh
+        if mesh is None:
             return np.array(self._lnpost_batch(pts), dtype=np.float64)
         import jax
+        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         n = pts.shape[0]
-        ndev = int(self.mesh.devices.size)
+        ndev = int(mesh.devices.size)
         pad = (-n) % ndev
         if pad:
             pts = np.concatenate([pts, np.tile(pts[-1:], (pad, 1))])
-        sharding = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        if plan is not None and plan.kind == "shard_map" \
+                and self._shard_map_ok is not False:
+            # pure data-parallel: each device evaluates its walker
+            # slice; no collective can appear.  Non-traceable batch
+            # callables (custom Python posteriors) fall back to the
+            # sharded-device_put path below, once, remembered.
+            try:
+                wrapped = plan.shard_map_batch(self._lnpost_batch)
+                lp = np.array(wrapped(jnp.asarray(pts)),
+                              dtype=np.float64)
+                self._shard_map_ok = True
+                return lp[:n] if pad else lp
+            except (TypeError, ValueError) as e:
+                if self._shard_map_ok is None:
+                    log.info(f"walker plan: shard_map fallback to sharded "
+                             f"dispatch ({type(e).__name__}: {e}); the "
+                             "batch callable is not jax-traceable")
+                    self._shard_map_ok = False
+                else:
+                    raise
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
         dev_pts = jax.device_put(pts, sharding)
         lp = np.array(self._lnpost_batch(dev_pts), dtype=np.float64)
         return lp[:n] if pad else lp

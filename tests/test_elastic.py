@@ -1,0 +1,760 @@
+"""Elastic multichip execution: plan selection, canary, degradation, resume.
+
+Runs on the conftest's virtual 8-CPU-device mesh.  The contract under
+test (ISSUE 7 / DESIGN.md "Elastic multichip execution"):
+
+* plan selection starts from the per-device preflight probes — a sick
+  chip never joins a mesh;
+* a fault mid-sweep (device loss, NaN-on-one-shard, straggler, failed
+  collective) evicts the offender, degrades the mesh down the 8→4→2→1
+  ladder, resumes from the last checkpoint, and the final surface
+  matches the unfaulted single-plan run to 1e-7;
+* checkpoints are mesh-portable: fingerprints never bind the device
+  count (mesh identity lives in the sidecar), so a sweep checkpointed
+  on 8 devices resumes on 4;
+* the lifecycle events (plan_selected / device_evicted / mesh_degraded)
+  land in events.jsonl and satisfy ``telemetry_report --check``;
+* zero steady-state recompiles once degradation settles (one recompile
+  per rung change is allowed and counted).
+"""
+
+import io
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.elastic
+
+PAR = """
+PSR  J0000+0000
+RAJ  04:37:00.0
+DECJ -47:15:00.0
+POSEPOCH 55000
+F0   173.6879489990983 1
+F1   -1.728e-15 1
+PEPOCH 55000
+DM   2.64476 1
+EPHEM DE440
+UNITS TDB
+"""
+
+NOISE = "TNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 5\n"
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout():
+    """POSIX-alarm wall-clock limit, same discipline as the
+    fault-injection suite (a wedged supervisor must not stall tier-1)."""
+
+    def _fire(signum, frame):
+        raise TimeoutError("elastic test exceeded 300 s")
+
+    old = signal.signal(signal.SIGALRM, _fire)
+    signal.alarm(300)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _model(extra=""):
+    from pint_tpu.models import get_model
+
+    return get_model(io.StringIO(PAR + extra))
+
+
+@pytest.fixture(scope="module")
+def gls_fit(eight_devices):
+    """Correlated-noise B1855-shaped stand-in: GLS fitter + a 64-point
+    F0xF1 grid, fitted once (module scope keeps compile cost paid once)."""
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = _model(NOISE)
+    t = make_fake_toas_uniform(54000, 55500, 40, m, error_us=1.0,
+                               add_noise=True,
+                               rng=np.random.default_rng(3))
+    f = GLSFitter(t, m)
+    f.fit_toas(maxiter=1)
+    dF0, dF1 = 3e-11, 3e-18
+    g0 = np.linspace(m.F0.value - dF0, m.F0.value + dF0, 8)
+    g1 = np.linspace(m.F1.value - dF1, m.F1.value + dF1, 8)
+    return f, ("F0", "F1"), (g0, g1)
+
+
+@pytest.fixture(scope="module")
+def wls_fit(eight_devices):
+    """White-noise twin (exercises the vmapped non-GLS grid builder)."""
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = _model()
+    t = make_fake_toas_uniform(54000, 55500, 40, m, error_us=1.0,
+                               add_noise=True,
+                               rng=np.random.default_rng(3))
+    f = WLSFitter(t, m)
+    f.fit_toas()
+    dF0, dF1 = 3e-11, 3e-18
+    g0 = np.linspace(f.model.F0.value - dF0, f.model.F0.value + dF0, 8)
+    g1 = np.linspace(f.model.F1.value - dF1, f.model.F1.value + dF1, 8)
+    return f, ("F0", "F1"), (g0, g1)
+
+
+@pytest.fixture()
+def telemetry_run(tmp_path):
+    """Full telemetry into a known run dir; deactivated afterwards."""
+    from pint_tpu import telemetry
+    from pint_tpu.telemetry import runlog
+
+    telemetry.activate("full")
+    run = runlog.start_run(str(tmp_path / "run"), name="elastic-test")
+    yield run
+    telemetry.deactivate()
+
+
+def _events(run_dir):
+    out = []
+    with open(os.path.join(run_dir, "events.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("type") == "event":
+                out.append(rec["event"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# plan layer
+# ---------------------------------------------------------------------------
+
+class TestPlanSelection:
+    def test_ladder_rungs(self):
+        from pint_tpu.exceptions import MeshExhaustedError
+        from pint_tpu.runtime.plan import ladder
+
+        assert ladder(8) == (8, 4, 2, 1)
+        assert ladder(7) == (4, 2, 1)
+        assert ladder(1) == (1,)
+        with pytest.raises(MeshExhaustedError):
+            ladder(0)
+
+    def test_select_plan_kinds_and_rungs(self, eight_devices):
+        from pint_tpu.runtime.plan import select_plan
+
+        grid = select_plan("grid")
+        assert grid.kind == "pjit" and grid.rung == 8
+        assert grid.mesh.axis_names == ("grid",)
+        walker = select_plan("walker")
+        assert walker.kind == "shard_map"
+        assert walker.axes == ("walker",)
+        ne = select_plan("gls_normal_eq")
+        assert ne.axes == ("toa",)
+        single = select_plan("grid", devices=eight_devices[:1])
+        assert single.kind == "single" and single.mesh is None
+        # n_items caps the rung: 3 points never mesh 8 devices
+        small = select_plan("grid", n_items=3)
+        assert small.rung == 2
+
+    def test_two_axis_mesh(self, eight_devices):
+        from pint_tpu.runtime.plan import select_plan
+
+        p = select_plan("grid", axes=("grid", "toa"))
+        assert dict(zip(p.mesh.axis_names, p.mesh.devices.shape)) \
+            == {"grid": 2, "toa": 4}
+
+    def test_degraded_descends_and_exhausts(self, eight_devices):
+        from pint_tpu.exceptions import MeshExhaustedError
+        from pint_tpu.runtime.plan import select_plan
+
+        p = select_plan("grid")
+        p4 = p.degraded(evict_ids=[eight_devices[3].id])
+        assert p4.rung == 4
+        assert eight_devices[3].id not in [d.id for d in p4.devices]
+        assert p4.evicted == (eight_devices[3].id,)
+        p2 = p4.degraded()
+        p1 = p2.degraded()
+        assert (p2.rung, p1.rung) == (2, 1) and p1.kind == "single"
+        with pytest.raises(MeshExhaustedError):
+            p1.degraded()
+
+    def test_unknown_axis_rejected(self):
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.runtime.plan import select_plan
+
+        with pytest.raises(UsageError):
+            select_plan("grid", axes=("chip",))
+
+    def test_sick_device_excluded_from_mesh(self, eight_devices):
+        """The per-device probe gates membership: a sick chip drops the
+        plan a rung and never appears in device_ids."""
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.runtime.preflight import healthy_devices
+
+        with fi.sick_device(eight_devices[5].id):
+            assert len(healthy_devices()) == 7
+            p = select_plan("grid")
+            assert p.rung == 4
+            assert eight_devices[5].id not in p.device_ids
+        assert len(healthy_devices()) == 8
+        assert select_plan("grid").rung == 8
+
+    def test_plan_selected_event_validates(self, telemetry_run):
+        from pint_tpu.runtime.plan import select_plan
+        from tools.telemetry_report import validate_events_file
+
+        select_plan("grid")
+        evs = [e for e in _events(telemetry_run.path)
+               if e["name"] == "plan_selected"]
+        assert evs and evs[0]["attrs"]["kind"] == "pjit"
+        assert evs[0]["attrs"]["rung"] == 8
+        errors = []
+        validate_events_file(
+            os.path.join(telemetry_run.path, "events.jsonl"), errors)
+        assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# preflight per-device probes
+# ---------------------------------------------------------------------------
+
+class TestDeviceHealth:
+    def test_all_virtual_devices_probe_healthy(self, eight_devices):
+        from pint_tpu.runtime.preflight import device_health
+
+        hs = device_health(refresh=True)
+        assert len(hs) == 8
+        assert all(h.healthy for h in hs)
+        assert {h.device_id for h in hs} == {d.id for d in eight_devices}
+
+    def test_probe_failure_marks_unhealthy(self, eight_devices):
+        """A probe that raises IS the verdict — the device is out."""
+        from pint_tpu.runtime import preflight as pf
+
+        orig = pf._probe_one
+
+        def exploding(dev):
+            if dev.id == eight_devices[2].id:
+                raise RuntimeError("injected: probe cannot reach device")
+            return orig(dev)
+
+        pf._probe_one = exploding
+        try:
+            hs = pf.device_health(refresh=True)
+            bad = [h for h in hs if not h.healthy]
+            assert [h.device_id for h in bad] == [eight_devices[2].id]
+            assert "probe cannot reach" in bad[0].error
+        finally:
+            pf._probe_one = orig
+            pf.device_health(refresh=True)
+
+
+# ---------------------------------------------------------------------------
+# elastic supervisor: the degradation ladder end to end
+# ---------------------------------------------------------------------------
+
+class TestElasticGrid:
+    def test_device_loss_mid_sweep_degrades_resumes_and_matches(
+            self, gls_fit, tmp_path, telemetry_run):
+        """THE acceptance scenario: a GLS grid sweep on the 8-device
+        mesh loses a device at chunk 1, degrades to 4 devices, resumes
+        from the checkpoint, and the chi2 surface matches the unfaulted
+        run to 1e-7 — with the lifecycle events in events.jsonl and
+        zero steady-state recompiles after degradation settles."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.plan import select_plan
+        from tools.telemetry_report import validate_run_dir
+
+        f, params, axes = gls_fit
+        chi2_ref, _ = grid_chisq(f, params, axes, niter=2)
+        plan = select_plan("grid", n_items=64)
+        with fi.shard_device_loss(at_chunk=1, device_index=3) as st:
+            chi2_el, _ = grid_chisq(f, params, axes, niter=2, plan=plan,
+                                    checkpoint=str(tmp_path / "ck"),
+                                    chunk=16)
+        assert st["calls"] == 1
+        rep = f.last_elastic_report
+        assert rep.rungs == [8, 4]
+        assert len(rep.evicted) == 1
+        rel = np.max(np.abs(np.asarray(chi2_el) - np.asarray(chi2_ref))
+                     / np.maximum(np.abs(np.asarray(chi2_ref)), 1.0))
+        assert rel < 1e-7, f"degraded sweep diverged: rel {rel:.3g}"
+        # one recompile budget per rung, zero at steady state
+        assert rep.steady_state_recompiles == 0
+        assert set(rep.recompiles_by_rung) == {8, 4}
+        # lifecycle events present and schema-valid
+        names = [e["name"] for e in _events(telemetry_run.path)]
+        assert "plan_selected" in names
+        assert "device_evicted" in names
+        assert "mesh_degraded" in names
+        assert "elastic.sweep_done" in names
+        errors = []
+        validate_run_dir(telemetry_run.path, errors)
+        assert errors == []
+        # the checkpoint sidecar recorded the degradation trail
+        meta = json.load(open(tmp_path / "ck" / "meta.json"))
+        assert meta["sidecar"]["plan"]["rung"] == 4
+        assert [s["plan"]["rung"] for s in meta["sidecar_history"]] == [8]
+
+    def test_canary_catches_nan_shard(self, wls_fit, tmp_path,
+                                      telemetry_run):
+        """Silent corruption: one shard's outputs are NaN with no
+        exception raised — only the cross-replica canary can notice.
+        The offender is evicted and the surface stays correct."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.plan import select_plan
+
+        f, params, axes = wls_fit
+        chi2_ref, _ = grid_chisq(f, params, axes, niter=2)
+        plan = select_plan("grid", n_items=64)
+        with fi.shard_nan(device_index=2, at_chunk=0):
+            chi2_el, _ = grid_chisq(f, params, axes, niter=2, plan=plan,
+                                    checkpoint=str(tmp_path / "ck"),
+                                    chunk=16)
+        rep = f.last_elastic_report
+        assert rep.rungs == [8, 4]
+        assert len(rep.evicted) == 1
+        assert np.all(np.isfinite(np.asarray(chi2_el)))
+        rel = np.max(np.abs(np.asarray(chi2_el) - np.asarray(chi2_ref))
+                     / np.maximum(np.abs(np.asarray(chi2_ref)), 1.0))
+        assert rel < 1e-7
+        evicted = [e for e in _events(telemetry_run.path)
+                   if e["name"] == "device_evicted"]
+        assert evicted and evicted[-1]["attrs"]["reason"] \
+            == "canary_mismatch"
+
+    def test_straggler_times_out_and_degrades(self, wls_fit, tmp_path):
+        """A wedged chip stalls a dispatch past the per-attempt timeout:
+        one same-rung retry, then a rung down (no device identified, so
+        nothing is evicted)."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.checkpoint import RetryPolicy
+        from pint_tpu.runtime.plan import select_plan
+
+        f, params, axes = wls_fit
+        chi2_ref, _ = grid_chisq(f, params, axes, niter=2)
+        plan = select_plan("grid", n_items=64)
+        with fi.straggler(delay_s=8.0, at_chunk=0, times=2):
+            chi2_el, _ = grid_chisq(
+                f, params, axes, niter=2, plan=plan,
+                checkpoint=str(tmp_path / "ck"), chunk=16,
+                retry=RetryPolicy(timeout=2.0, backoff_base=0.0))
+        rep = f.last_elastic_report
+        assert rep.rungs == [8, 4]
+        assert rep.evicted == []
+        rel = np.max(np.abs(np.asarray(chi2_el) - np.asarray(chi2_ref))
+                     / np.maximum(np.abs(np.asarray(chi2_ref)), 1.0))
+        assert rel < 1e-7
+
+    def test_failed_collective_degrades_without_eviction(
+            self, wls_fit, tmp_path):
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.plan import select_plan
+
+        f, params, axes = wls_fit
+        chi2_ref, _ = grid_chisq(f, params, axes, niter=2)
+        plan = select_plan("grid", n_items=64)
+        with fi.failed_collective(at_chunk=0, times=2):
+            chi2_el, _ = grid_chisq(f, params, axes, niter=2, plan=plan,
+                                    checkpoint=str(tmp_path / "ck"),
+                                    chunk=16)
+        rep = f.last_elastic_report
+        assert rep.rungs == [8, 4] and rep.evicted == []
+        rel = np.max(np.abs(np.asarray(chi2_el) - np.asarray(chi2_ref))
+                     / np.maximum(np.abs(np.asarray(chi2_ref)), 1.0))
+        assert rel < 1e-7
+
+    def test_ladder_exhaustion_raises_typed(self, eight_devices):
+        """Every rung failing ends in SweepChunkFailure, never a silent
+        partial surface."""
+        from pint_tpu.exceptions import SweepChunkFailure
+        from pint_tpu.runtime import elastic, faultinject as fi
+        from pint_tpu.runtime.plan import select_plan
+
+        def make_eval(block_size, p):
+            def ev(block):
+                return {"chi2": np.sum(np.asarray(block) ** 2, axis=1)}
+            return ev
+
+        pts = np.arange(32.0).reshape(16, 2)
+        plan = select_plan("grid", devices=eight_devices[:2])
+        with fi.shard_device_loss(at_chunk=0, device_index=0, times=99):
+            with pytest.raises(SweepChunkFailure):
+                elastic.elastic_map(make_eval, pts, plan=plan, chunk=8)
+
+    def test_canary_all_nan_is_agreement(self, eight_devices):
+        """A NaN chi2 is a legitimate grid outcome (unsolvable point);
+        when EVERY shard returns NaN for the canary they agree, and
+        nobody may be convicted — only a divergent shard is corrupt."""
+        from pint_tpu.exceptions import CanaryMismatchError
+        from pint_tpu.runtime.elastic import check_canary
+        from pint_tpu.runtime.plan import select_plan
+
+        plan = select_plan("grid", devices=eight_devices[:4])
+        check_canary(np.full(4, np.nan), plan)  # unanimous: no eviction
+        with pytest.raises(CanaryMismatchError):  # divergent: convicted
+            check_canary(np.array([1.0, 1.0, np.nan, 1.0]), plan)
+
+    def test_unclassified_failure_propagates(self, eight_devices):
+        """A typed solve failure must NOT be retried down the ladder —
+        it would fail identically on every rung."""
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.runtime import elastic
+        from pint_tpu.runtime.plan import select_plan
+
+        def make_eval(block_size, p):
+            def ev(block):
+                raise UsageError("not an elastic failure")
+            return ev
+
+        pts = np.arange(32.0).reshape(16, 2)
+        plan = select_plan("grid", devices=eight_devices[:4])
+        with pytest.raises(UsageError):
+            elastic.elastic_map(make_eval, pts, plan=plan, chunk=8)
+
+
+# ---------------------------------------------------------------------------
+# mesh-portable checkpoints (satellite: fingerprint must not bind mesh)
+# ---------------------------------------------------------------------------
+
+class TestMeshPortableResume:
+    def test_sidecar_not_part_of_fingerprint(self, tmp_path):
+        from pint_tpu.exceptions import CheckpointError
+        from pint_tpu.runtime.checkpoint import SweepCheckpoint
+
+        path = str(tmp_path / "ck")
+        a = SweepCheckpoint(path, "fp", 4, sidecar={"plan": {"rung": 8}})
+        a.save(0, x=np.arange(3.0))
+        # same fingerprint, different mesh: opens fine, sidecar updates
+        b = SweepCheckpoint(path, "fp", 4, sidecar={"plan": {"rung": 4}})
+        assert b.has(0)
+        assert b.meta["sidecar"]["plan"]["rung"] == 4
+        assert b.meta["sidecar_history"][0]["plan"]["rung"] == 8
+        # a different SWEEP still refuses
+        with pytest.raises(CheckpointError):
+            SweepCheckpoint(path, "other-fp", 4)
+
+    def test_crash_on_8_resumes_on_4(self, gls_fit, tmp_path):
+        """The cross-device-count resume regression: sweep crashes after
+        2 chunks on an 8-device plan; a fresh run on a 4-device plan
+        resumes the SAME checkpoint (fingerprint is mesh-free), reuses
+        the completed chunks, and matches the unfaulted surface."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import faultinject as fi
+        from pint_tpu.runtime.faultinject import SimulatedCrash
+        from pint_tpu.runtime.plan import select_plan
+
+        f, params, axes = gls_fit
+        chi2_ref, _ = grid_chisq(f, params, axes, niter=2)
+        ck = str(tmp_path / "ck")
+        plan8 = select_plan("grid", n_items=64)
+        assert plan8.rung == 8
+        with fi.shard_crash_after_chunks(2):
+            with pytest.raises(SimulatedCrash):
+                grid_chisq(f, params, axes, niter=2, plan=plan8,
+                           checkpoint=ck, chunk=16)
+        meta = json.load(open(os.path.join(ck, "meta.json")))
+        assert meta["sidecar"]["plan"]["rung"] == 8
+        # "new process", half the devices
+        import jax
+
+        plan4 = select_plan("grid", devices=jax.devices()[:4])
+        assert plan4.rung == 4
+        chi2_el, _ = grid_chisq(f, params, axes, niter=2, plan=plan4,
+                                checkpoint=ck, chunk=16)
+        rep = f.last_elastic_report
+        assert rep.chunks_resumed == 2
+        assert rep.chunks_computed == 2
+        rel = np.max(np.abs(np.asarray(chi2_el) - np.asarray(chi2_ref))
+                     / np.maximum(np.abs(np.asarray(chi2_ref)), 1.0))
+        assert rel < 1e-7
+        meta = json.load(open(os.path.join(ck, "meta.json")))
+        assert meta["sidecar"]["plan"]["rung"] == 4
+
+    def test_mesh_plus_checkpoint_still_guided_to_plan(self, gls_fit,
+                                                       tmp_path):
+        from jax.sharding import Mesh
+        import jax
+
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime.plan import select_plan
+
+        f, params, axes = gls_fit
+        mesh = Mesh(np.array(jax.devices()[:2]), ("grid",))
+        with pytest.raises(UsageError, match="plan="):
+            grid_chisq(f, params, axes, checkpoint=str(tmp_path / "x"),
+                       mesh=mesh)
+        with pytest.raises(UsageError, match="cannot be combined"):
+            grid_chisq(f, params, axes, mesh=mesh,
+                       plan=select_plan("grid"))
+
+
+# ---------------------------------------------------------------------------
+# routed GLS normal equations + sampler walkers
+# ---------------------------------------------------------------------------
+
+class TestRoutedSolvesAndWalkers:
+    def test_gls_fit_with_plan_matches_host(self, eight_devices):
+        """The TOA-sharded normal-equation build is algebraically the
+        host build (zero-padded rows contribute nothing): chi2 and
+        parameter steps agree to fp noise, and the plan survives on the
+        fitter."""
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m1, m2 = _model(NOISE), _model(NOISE)
+        t = make_fake_toas_uniform(54000, 55500, 40, m1, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(7))
+        f_host = GLSFitter(t, m1)
+        chi2_host = f_host.fit_toas(maxiter=1)
+        f_plan = GLSFitter(t, m2)
+        chi2_plan = f_plan.fit_toas(
+            maxiter=1, plan=select_plan("gls_normal_eq",
+                                        devices=eight_devices))
+        assert abs(chi2_plan - chi2_host) <= 1e-7 * max(chi2_host, 1.0)
+        assert f_plan.plan is not None and f_plan.plan.rung == 8
+        # host path solves via the Schur fast path, plan path via the
+        # sharded dense build: same system, different factorization
+        # order — agreement to solver precision, not bit equality
+        for p in ("F0", "F1", "DM"):
+            a = float(getattr(f_host.model, p).value)
+            b = float(getattr(f_plan.model, p).value)
+            assert np.isclose(a, b, rtol=1e-6, atol=0), (p, a, b)
+
+    def test_gls_plan_degrades_on_device_loss(self, eight_devices):
+        """A device lost during the sharded build degrades the plan and
+        the fit completes on the smaller mesh."""
+        import pint_tpu.gls_fitter as gf
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.runtime.faultinject import SimulatedDeviceLoss
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        m = _model(NOISE)
+        t = make_fake_toas_uniform(54000, 55500, 40, m, error_us=1.0,
+                                   add_noise=True,
+                                   rng=np.random.default_rng(7))
+        f = GLSFitter(t, m)
+        orig = gf._sharded_normal_equations
+        state = {"calls": 0}
+
+        def failing(M, r, Nvec, phiinv, plan):
+            state["calls"] += 1
+            if state["calls"] == 1:
+                raise SimulatedDeviceLoss(
+                    "injected: device lost in normal-eq build",
+                    device_id=int(plan.devices[1].id))
+            return orig(M, r, Nvec, phiinv, plan)
+
+        gf._sharded_normal_equations = failing
+        try:
+            chi2 = f.fit_toas(maxiter=1,
+                              plan=select_plan("gls_normal_eq",
+                                               devices=eight_devices))
+        finally:
+            gf._sharded_normal_equations = orig
+        assert np.isfinite(chi2)
+        assert f.plan.rung == 4
+        assert len(f.plan.evicted) == 1
+
+    def test_sampler_walker_plan_matches_unsharded(self, eight_devices):
+        """shard_map walker routing is bit-compatible with the plain
+        path: same seed, same chain (per-walker math has no cross-item
+        reduction)."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.sampler import EnsembleSampler
+
+        lnp = jax.jit(lambda pts: -0.5 * jnp.sum(pts ** 2, axis=-1))
+
+        def run(plan):
+            s = EnsembleSampler(16, seed=11, plan=plan)
+            s.initialize_batched(lnp, 3)
+            pos = np.random.default_rng(5).standard_normal((16, 3))
+            s.run_mcmc(pos, 8)
+            return s
+
+        s_plan = run(select_plan("walker", devices=eight_devices))
+        s_plain = run(None)
+        assert s_plan._shard_map_ok is True
+        np.testing.assert_allclose(s_plan.get_chain(), s_plain.get_chain(),
+                                   rtol=1e-12, atol=0)
+
+    def test_sampler_plan_degrades_on_device_loss(self, eight_devices):
+        """Retry exhaustion on the walker batch degrades the plan
+        instead of killing the chain."""
+        import jax
+        import jax.numpy as jnp
+
+        from pint_tpu.runtime.faultinject import SimulatedDeviceLoss
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.sampler import EnsembleSampler
+
+        base = jax.jit(lambda pts: -0.5 * jnp.sum(pts ** 2, axis=-1))
+        state = {"calls": 0}
+
+        def flaky_lnp(pts):
+            state["calls"] += 1
+            if state["calls"] <= 2:
+                raise SimulatedDeviceLoss("injected: walker batch lost",
+                                          device_id=1)
+            return base(pts)
+
+        s = EnsembleSampler(16, seed=11,
+                            plan=select_plan("walker",
+                                             devices=eight_devices),
+                            retries=0)
+        s.initialize_batched(flaky_lnp, 3)
+        pos = np.random.default_rng(5).standard_normal((16, 3))
+        s.run_mcmc(pos, 4)
+        assert s.plan.rung <= 4
+        assert 1 in s.plan.evicted
+        assert np.all(np.isfinite(s.get_log_prob()))
+
+    def test_sampler_unclassified_failure_propagates(self, eight_devices):
+        """The sampler's elastic supervision obeys the same contract as
+        elastic_map: a typed non-device failure must NOT burn rungs —
+        it would fail identically on every device count."""
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.sampler import EnsembleSampler
+
+        def bad_lnp(pts):
+            raise UsageError("not an elastic failure")
+
+        plan = select_plan("walker", devices=eight_devices)
+        s = EnsembleSampler(16, seed=11, plan=plan, retries=0)
+        s.initialize_batched(bad_lnp, 3)
+        pos = np.random.default_rng(5).standard_normal((16, 3))
+        with pytest.raises(UsageError):
+            s.run_mcmc(pos, 2)
+        assert s.plan.rung == plan.rung  # no rung was burned
+
+    def test_custom_posterior_falls_back_from_shard_map(
+            self, eight_devices):
+        """A non-traceable Python batch posterior cannot shard_map; the
+        sampler falls back to the sharded-dispatch path once and
+        remembers."""
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.sampler import EnsembleSampler
+
+        def py_lnp(pts):
+            return np.array([-0.5 * float(np.sum(np.asarray(p) ** 2))
+                             for p in np.asarray(pts)])
+
+        s = EnsembleSampler(16, seed=11,
+                            plan=select_plan("walker",
+                                             devices=eight_devices))
+        s.initialize_batched(py_lnp, 3)
+        pos = np.random.default_rng(5).standard_normal((16, 3))
+        s.run_mcmc(pos, 2)
+        assert s._shard_map_ok is False
+        assert np.all(np.isfinite(s.get_log_prob()))
+
+
+# ---------------------------------------------------------------------------
+# telemetry contract
+# ---------------------------------------------------------------------------
+
+class TestElasticEventContract:
+    def test_malformed_elastic_events_rejected(self, tmp_path):
+        """--check refuses drifted lifecycle events (missing attrs, an
+        'ascending' degradation, an unknown plan kind)."""
+        import time as _time
+
+        from pint_tpu.telemetry.runlog import EVENT_SCHEMA
+        from tools.telemetry_report import validate_events_file
+
+        def line(name, attrs):
+            return json.dumps({"schema": EVENT_SCHEMA, "t": _time.time(),
+                               "type": "event",
+                               "event": {"name": name, "attrs": attrs}})
+
+        p = tmp_path / "events.jsonl"
+        p.write_text("\n".join([
+            line("mesh_degraded", {"from_rung": 4, "to_rung": 8,
+                                   "reason": "x"}),
+            line("device_evicted", {"reason": "canary_mismatch"}),
+            line("plan_selected", {"workload": "grid", "kind": "mpi",
+                                   "rung": 8, "n_devices": 8}),
+        ]) + "\n")
+        errors = []
+        validate_events_file(str(p), errors)
+        assert len(errors) == 3
+        assert any("strictly descend" in e for e in errors)
+        assert any("device_id" in e for e in errors)
+        assert any("not in" in e for e in errors)
+
+    def test_wellformed_elastic_events_pass(self, tmp_path):
+        import time as _time
+
+        from pint_tpu.telemetry.runlog import EVENT_SCHEMA
+        from tools.telemetry_report import validate_events_file
+
+        recs = [
+            {"name": "plan_selected",
+             "attrs": {"workload": "grid", "kind": "shard_map", "rung": 4,
+                       "n_devices": 8}},
+            {"name": "device_evicted",
+             "attrs": {"device_id": 3, "reason": "device_loss"}},
+            {"name": "mesh_degraded",
+             "attrs": {"from_rung": 8, "to_rung": 4,
+                       "reason": "collective_timeout"}},
+        ]
+        p = tmp_path / "events.jsonl"
+        p.write_text("\n".join(
+            json.dumps({"schema": EVENT_SCHEMA, "t": _time.time(),
+                        "type": "event", "event": r}) for r in recs) + "\n")
+        errors = []
+        validate_events_file(str(p), errors)
+        assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance-scale sweep (slow: full 256-point grid)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_full_scale_sweep_with_device_loss(eight_devices, tmp_path):
+    """256-point GLS grid (synthetic B1855-shaped correlated-noise
+    workload), device lost mid-sweep on the 8-device mesh: degrade to
+    4, resume, match the unfaulted single-plan run to 1e-7."""
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.grid import grid_chisq
+    from pint_tpu.runtime import faultinject as fi
+    from pint_tpu.runtime.plan import select_plan
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = _model(NOISE)
+    t = make_fake_toas_uniform(54000, 55500, 64, m, error_us=1.0,
+                               add_noise=True,
+                               rng=np.random.default_rng(3))
+    f = GLSFitter(t, m)
+    f.fit_toas(maxiter=1)
+    dF0, dF1 = 3e-11, 3e-18
+    g0 = np.linspace(m.F0.value - dF0, m.F0.value + dF0, 16)
+    g1 = np.linspace(m.F1.value - dF1, m.F1.value + dF1, 16)
+    chi2_ref, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=2)
+    plan = select_plan("grid", n_items=256)
+    with fi.shard_device_loss(at_chunk=2, device_index=5):
+        chi2_el, _ = grid_chisq(f, ("F0", "F1"), (g0, g1), niter=2,
+                                plan=plan,
+                                checkpoint=str(tmp_path / "ck"), chunk=64)
+    rep = f.last_elastic_report
+    assert rep.rungs == [8, 4]
+    assert rep.steady_state_recompiles == 0
+    rel = np.max(np.abs(np.asarray(chi2_el) - np.asarray(chi2_ref))
+                 / np.maximum(np.abs(np.asarray(chi2_ref)), 1.0))
+    assert rel < 1e-7

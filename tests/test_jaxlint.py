@@ -290,6 +290,65 @@ class TestHostCallInJit:
         )
         assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
 
+    def test_elastic_event_in_shard_map_flagged(self, tmp_path):
+        """The elastic supervisor's lifecycle events (plan_selected /
+        device_evicted / mesh_degraded) are host-side runlog writes; a
+        shard_map-traced body that emits one (or canary-checks through
+        numpy) would fire once per TRACE per device — the runtime/plan +
+        runtime/elastic idiom the rule must police."""
+        bad = (
+            "import jax\n"
+            "import numpy as np\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "from pint_tpu import telemetry\n"
+            "def block_body(pts):\n"
+            "    telemetry.event('device_evicted', device_id=0)\n"
+            "    return np.sum(pts ** 2, axis=-1)\n"
+            "def dispatch(mesh, spec, pts):\n"
+            "    return jax.jit(shard_map(block_body, mesh=mesh,\n"
+            "                             in_specs=spec,\n"
+            "                             out_specs=spec))(pts)\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+        msgs = " ".join(f.message for f in findings)
+        assert "telemetry call" in msgs and "np.sum" in msgs
+
+    def test_elastic_supervisor_host_emit_not_flagged(self, tmp_path):
+        """Good twin: the shipped pattern — the supervisor emits events
+        and runs the numpy canary check AROUND the sharded dispatch
+        (host code), the traced body stays pure jnp."""
+        good = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "import numpy as np\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "from pint_tpu import telemetry\n"
+            "def block_body(pts):\n"
+            "    return jnp.sum(pts ** 2, axis=-1)\n"
+            "def supervise(mesh, spec, pts, canary_rows):\n"
+            "    telemetry.event('plan_selected', kind='shard_map',\n"
+            "                    rung=mesh.devices.size)\n"
+            "    out = jax.jit(shard_map(block_body, mesh=mesh,\n"
+            "                            in_specs=spec,\n"
+            "                            out_specs=spec))(pts)\n"
+            "    vals = np.asarray(out)[canary_rows]\n"
+            "    if not np.all(np.isfinite(vals)):\n"
+            "        telemetry.event('device_evicted', device_id=0)\n"
+            "    return out\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+    def test_runtime_plan_and_elastic_are_clean_targets(self):
+        """runtime/plan.py + runtime/elastic.py are lint targets of the
+        host-call rule (they orchestrate traced dispatches from host
+        code) and must stay clean without pragmas or baseline entries."""
+        eng = Engine(rules=[HostCallInJitRule()], repo=REPO)
+        for rel in ("pint_tpu/runtime/plan.py",
+                    "pint_tpu/runtime/elastic.py"):
+            findings = eng.lint_file(os.path.join(REPO, rel))
+            assert findings == [], "\n".join(f.render() for f in findings)
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

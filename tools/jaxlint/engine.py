@@ -30,7 +30,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 #: transforms that make their function argument's body traced
-TRACE_ENTRY = {"jit", "vmap", "pmap", "shard_map", "filter_jit"}
+TRACE_ENTRY = {"jit", "vmap", "pmap", "shard_map", "pjit", "filter_jit"}
 #: transforms that pass a function through to an enclosing trace entry
 TRACE_PASSTHROUGH = {"grad", "value_and_grad", "jacfwd", "jacrev", "hessian",
                      "checkpoint", "remat", "custom_jvp", "custom_vjp"}
@@ -200,6 +200,13 @@ def _record_imports(info: FileInfo) -> None:
                         info.trace_names[a.asname or a.name] = a.name
             elif node.module in ("jax.numpy",):
                 pass  # from jax.numpy import X: X is a jnp function, not alias
+            elif node.module is not None and node.module.startswith("jax."):
+                # deep-module transform imports: the execution-plan layer's
+                # `from jax.experimental.shard_map import shard_map` (and
+                # the pjit spelling) bind trace entries as bare names too
+                for a in node.names:
+                    if a.name in TRACE_ENTRY | TRACE_PASSTHROUGH:
+                        info.trace_names[a.asname or a.name] = a.name
             elif node.module == "numpy":
                 pass
 

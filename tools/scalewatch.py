@@ -159,27 +159,32 @@ def run_worker(n_devices: int) -> int:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
     import numpy as np
-    from jax.sharding import Mesh
 
-    devs = jax.devices()
+    # mesh membership comes from the per-device preflight probes — the
+    # same source of truth plan.py uses in production, so the scaling
+    # series measures exactly the routed path
+    from pint_tpu.runtime.preflight import healthy_devices
+    from pint_tpu.runtime.plan import select_plan
+
+    devs = healthy_devices()
     if len(devs) < n_devices:
-        print(f"scalewatch worker: need {n_devices} devices, have "
+        print(f"scalewatch worker: need {n_devices} healthy devices, have "
               f"{len(devs)} (set XLA_FLAGS="
               f"--xla_force_host_platform_device_count={n_devices})",
               file=sys.stderr)
         return 2
-    devs = np.array(devs[:n_devices])
+    devs = list(devs[:n_devices])
     from pint_tpu import profiling
     from pint_tpu.grid import grid_chisq
     from pint_tpu.telemetry import distview
 
     f, params, axes, workload = _build_workload()
     f.fit_toas(maxiter=1)
-    mesh = Mesh(devs, ("grid",)) if n_devices > 1 else None
+    plan = select_plan("grid", devices=devs)
     warm = (axes[0][[0, -1]], axes[1][[0, -1]])
-    grid_chisq(f, params, warm, niter=2, mesh=mesh)      # compile
+    grid_chisq(f, params, warm, niter=2, plan=plan)      # compile
     t0 = time.perf_counter()
-    chi2, _ = grid_chisq(f, params, axes, niter=2, mesh=mesh)
+    chi2, _ = grid_chisq(f, params, axes, niter=2, plan=plan)
     wall = time.perf_counter() - t0
     npts = int(np.asarray(chi2).size)
     if not np.all(np.isfinite(np.asarray(chi2))):
@@ -196,7 +201,7 @@ def run_worker(n_devices: int) -> int:
     try:
         with tempfile.TemporaryDirectory(prefix="scalewatch_trace_") as td:
             with profiling.device_trace(td) as rep:
-                grid_chisq(f, params, axes, niter=2, mesh=mesh)
+                grid_chisq(f, params, axes, niter=2, plan=plan)
             busy = rep.device_busy_fractions()
             skew = rep.straggler_skew_s
     except Exception as e:  # tracing is best-effort on exotic backends
@@ -205,9 +210,10 @@ def run_worker(n_devices: int) -> int:
 
     obs = distview.observe_grid(f)
     # the TOA-sharded GLS normal-equation reduction: the all-reduce
-    # whose bytes decide the sharding plan (comm/compute headline)
-    toa_mesh = Mesh(devs, ("toa",)) if n_devices > 1 else None
-    ne_fn, ne_args = f.gls_normal_equations_executable(mesh=toa_mesh)
+    # whose bytes decide the sharding plan (comm/compute headline) —
+    # routed through its own 'toa'-axis plan, same membership source
+    ne_plan = select_plan("gls_normal_eq", devices=devs)
+    ne_fn, ne_args = f.gls_normal_equations_executable(plan=ne_plan)
     ne_coll = distview.analyze_jitted_collectives(
         ne_fn, *ne_args, name="gls.normal_eq")
 
@@ -215,7 +221,8 @@ def run_worker(n_devices: int) -> int:
           fits_per_sec=npts / max(wall, 1e-9), grid_points=npts,
           ntoas=len(f.toas), nfree=len(f.model.free_params),
           platform=str(jax.default_backend()), workload=workload,
-          busy_fractions=busy, straggler_skew_s=skew)
+          busy_fractions=busy, straggler_skew_s=skew,
+          plan=plan.to_dict())
     _emit("cost", cost=obs["cost"])
     _emit("collective", collective=obs["collectives"])
     _emit("collective", collective=ne_coll.to_dict())

@@ -76,6 +76,50 @@ def _reject_constant(name: str):
     raise ValueError(f"non-strict JSON constant {name!r} in event stream")
 
 
+#: elastic-execution lifecycle events carry a structured contract the
+#: observatory depends on: attr name -> required type(s).  Any loose
+#: event with one of these names must satisfy it (a drift in the
+#: plan/elastic producers fails --check before it corrupts a series).
+ELASTIC_EVENT_ATTRS = {
+    "plan_selected": {"workload": str, "kind": str, "rung": int,
+                      "n_devices": int},
+    "device_evicted": {"device_id": int, "reason": str},
+    "mesh_degraded": {"from_rung": int, "to_rung": int, "reason": str},
+}
+
+_PLAN_KINDS = ("pjit", "shard_map", "single")
+
+
+def validate_elastic_event(ev: dict, where: str,
+                           errors: List[str]) -> None:
+    """Attr contract for plan_selected / device_evicted / mesh_degraded."""
+    name = ev.get("name")
+    required = ELASTIC_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected {typ.__name__}")
+    if name == "plan_selected" \
+            and attrs.get("kind") not in _PLAN_KINDS:
+        _err(errors, where, f"plan_selected kind {attrs.get('kind')!r} "
+                            f"not in {_PLAN_KINDS}")
+    if name == "mesh_degraded" \
+            and isinstance(attrs.get("from_rung"), int) \
+            and isinstance(attrs.get("to_rung"), int) \
+            and not attrs["to_rung"] < attrs["from_rung"]:
+        _err(errors, where,
+             f"mesh_degraded must strictly descend the ladder "
+             f"(from_rung {attrs['from_rung']} -> to_rung "
+             f"{attrs['to_rung']})")
+
+
 def validate_span_dict(sp, where: str, errors: List[str],
                        parent_id: Optional[int] = None) -> None:
     if not isinstance(sp, dict):
@@ -330,6 +374,8 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                 if not isinstance(ev, dict) \
                         or not isinstance(ev.get("name"), str):
                     _err(errors, where, f"event body malformed: {ev!r}")
+                else:
+                    validate_elastic_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -551,14 +597,24 @@ def self_test(errors: List[str]) -> int:
         run.record_collective_profile(CollectiveProfile(
             name="selftest-degraded", error="synthetic").to_dict())
         run.record_sharding_plan(sharding_plan_of(object(), "selftest"))
+        # elastic-lifecycle producer drift check: the plan/supervisor
+        # event contract (ELASTIC_EVENT_ATTRS) exercised through the
+        # loose-event path the real emitters use
+        run.record_event("plan_selected", workload="grid", kind="pjit",
+                         rung=8, n_devices=8, axes="grid",
+                         device_ids=list(range(8)))
+        run.record_event("device_evicted", device_id=3,
+                         reason="canary_mismatch", chunk=2)
+        run.record_event("mesh_degraded", from_rung=8, to_rung=4,
+                         reason="device_loss", chunk=2, n_remaining=7)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
-        # sharding_plan, metrics, run_end
-        if n < 10:
-            _err(errors, "selftest", f"expected >= 10 records, got {n}")
+        # sharding_plan, 3x elastic events, metrics, run_end
+        if n < 13:
+            _err(errors, "selftest", f"expected >= 13 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
