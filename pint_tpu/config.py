@@ -8,7 +8,8 @@ import os
 __all__ = ["datadir", "examplefile", "runtimefile",
            "device_policy", "set_device_policy", "DEVICE_POLICIES",
            "ingestion_policy", "set_ingestion_policy", "INGESTION_POLICIES",
-           "telemetry_mode", "set_telemetry_mode", "TELEMETRY_MODES"]
+           "telemetry_mode", "set_telemetry_mode", "TELEMETRY_MODES",
+           "aot_cache_dir", "set_aot_cache_dir"]
 
 #: what to do when the preflight probe finds the executing platform differs
 #: from the requested one (``PINT_TPU_REQUIRE_PLATFORM``):
@@ -92,6 +93,52 @@ def set_telemetry_mode(mode: str) -> None:
         raise ValueError(
             f"telemetry mode must be one of {TELEMETRY_MODES}, got {mode!r}")
     _telemetry_mode = mode
+
+
+#: where the warm-serving layer persists AOT artifacts across processes
+#: (``PINT_TPU_AOT_CACHE_DIR``): serialized ``jax.export`` executables
+#: under ``exports/`` and the XLA persistent compilation cache under
+#: ``xla/<device-fingerprint>/`` (:mod:`pint_tpu.serving.aotcache`).
+#: ``None`` (the default) disables persistence entirely — the serving
+#: layer still works, it just compiles fresh every process.
+_aot_cache_dir = os.environ.get("PINT_TPU_AOT_CACHE_DIR") or None
+
+
+def aot_cache_dir():
+    """AOT-cache root directory, or ``None`` when persistence is off.
+
+    The env value is NOT validated at import (a bad env var must not
+    break ``import pint_tpu``); :class:`pint_tpu.serving.aotcache.AOTCache`
+    raises the typed error on first use, and :func:`set_aot_cache_dir`
+    validates eagerly."""
+    return _aot_cache_dir
+
+
+def set_aot_cache_dir(path) -> None:
+    """Set (or, with ``None``/empty, disable) the AOT-cache directory
+    for this process.  The directory is created if absent; an
+    uncreatable or unwritable target raises a typed
+    :class:`~pint_tpu.exceptions.UsageError` immediately — a serving
+    deployment must learn at configuration time, not at the first cache
+    store mid-request."""
+    global _aot_cache_dir
+    if not path:
+        _aot_cache_dir = None
+        return
+    from pint_tpu.exceptions import UsageError
+
+    path = os.path.abspath(str(path))
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        raise UsageError(
+            f"AOT cache dir {path!r} cannot be created: {e}") from e
+    if not os.access(path, os.W_OK):
+        raise UsageError(
+            f"AOT cache dir {path!r} is not writable; executable "
+            "persistence needs a writable directory "
+            "(PINT_TPU_AOT_CACHE_DIR / set_aot_cache_dir)")
+    _aot_cache_dir = path
 
 
 def datadir() -> str:

@@ -72,19 +72,14 @@ def ladder(n: int) -> Tuple[int, ...]:
 
 
 def _emit_event(name: str, **attrs) -> None:
-    """Elastic-lifecycle telemetry: attach to the current span AND — in
-    full mode — write a loose event into the run's events.jsonl, so
-    plan/eviction/degradation decisions are observable even when no
-    span is open (e.g. a supervisor retry loop between sweeps)."""
+    """Elastic-lifecycle telemetry (also imported by runtime/elastic):
+    the shared :func:`pint_tpu.telemetry.lifecycle_event` emitter —
+    span event + full-mode runlog record."""
     if config._telemetry_mode == "off":
         return
     from pint_tpu import telemetry
 
-    telemetry.event(name, **attrs)
-    if config.telemetry_mode() == "full":
-        from pint_tpu.telemetry import runlog
-
-        runlog.ensure_run().record_event(name, **attrs)
+    telemetry.lifecycle_event(name, **attrs)
 
 
 @dataclass(frozen=True)

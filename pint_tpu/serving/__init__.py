@@ -1,0 +1,41 @@
+"""Warm-serving layer: kill the cold start, serve fits in milliseconds.
+
+ROADMAP item 2.  Three cooperating parts (DESIGN.md "Warm serving &
+AOT persistence"):
+
+* :mod:`~pint_tpu.serving.aotcache` — compiled-executable persistence
+  across processes: verified ``jax.export`` blobs keyed by executable
+  name + version key + abstract arg signature + device fingerprint,
+  plus the XLA persistent-compilation-cache wiring
+  (``PINT_TPU_AOT_CACHE_DIR`` / :func:`pint_tpu.config.
+  set_aot_cache_dir`);
+* :mod:`~pint_tpu.serving.warmup` — :class:`~pint_tpu.serving.warmup.
+  WarmPool` of held ``jax.stages.Compiled`` handles built at service
+  start (cache-load or fresh compile + store), so steady-state
+  dispatches never enter the compile path at all (``compiles=0`` in
+  the JAX accounting);
+* :mod:`~pint_tpu.serving.batcher` / :mod:`~pint_tpu.serving.service`
+  — shape-bucketed request batching behind an async front door:
+  requests pad onto a small bucket grid of executables (padding is
+  exact by construction — zero-weight rows, block-diagonal pad
+  columns), coalesce within a latency window, and report p50/p99 /
+  queue depth / compile counters through the metrics registry and
+  ``serve_request`` telemetry events.
+"""
+
+from pint_tpu.serving import aotcache, batcher, service, warmup
+from pint_tpu.serving.aotcache import AOTCache, cache, device_fingerprint
+from pint_tpu.serving.batcher import FitRequest, FitResult, ShapeBatcher
+from pint_tpu.serving.service import ServeConfig, TimingService
+from pint_tpu.serving.warmup import (
+    WarmPool,
+    WarmupReport,
+    warm_buckets,
+    warm_fitter,
+)
+
+__all__ = ["aotcache", "warmup", "batcher", "service",
+           "AOTCache", "cache", "device_fingerprint",
+           "FitRequest", "FitResult", "ShapeBatcher",
+           "ServeConfig", "TimingService",
+           "WarmPool", "WarmupReport", "warm_buckets", "warm_fitter"]

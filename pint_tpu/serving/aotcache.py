@@ -1,0 +1,416 @@
+"""AOT executable persistence: compiled programs that survive the process.
+
+ROADMAP item 2 — the headline bench pays ~28 s of grid compile and ~44 s
+of initial fit before doing 0.7 s of useful work; at serving traffic the
+amortizable parts must be amortized *across processes*, not just across
+calls.  This module persists executables two complementary ways:
+
+* **Export blobs** (``<dir>/exports/<digest>.stablehlo`` + ``.json``):
+  :func:`jax.export.export` of a jitted callable at concrete args,
+  serialized with a sidecar identity document.  The cache key is the
+  sha256 of canonical key material — executable name, the caller's
+  version key (the grid bundle ``vkey`` / model parameter signature),
+  the abstract argument signature (shape/dtype/sharding per leaf), the
+  :func:`device_fingerprint`, and the jax version — so an entry can only
+  ever replay for the computation it was built from.  Loads re-derive
+  the key material and compare it FIELD BY FIELD against the sidecar,
+  then check the deserialized module's ``in_avals`` against the live
+  arguments: any mismatch, unreadable blob, or deserialize failure
+  degrades to a fresh compile (``aot_cache`` telemetry event, action
+  ``degrade``) — never a wrong executable.
+* **XLA persistent compilation cache** (``<dir>/xla/<fingerprint>``):
+  :func:`enable_xla_cache` points ``jax_compilation_cache_dir`` here so
+  every ordinary ``jit`` dispatch and AOT ``lower().compile()`` in the
+  process is served from disk when warm.  Note the jax-0.4.x accounting
+  caveat: a persistent-cache *hit* still fires the
+  ``backend_compile_duration`` event (the event wraps
+  ``compile_or_get_cached``), so the ``compiles=0`` steady-state proof
+  comes from the warm pool's held executables
+  (:mod:`pint_tpu.serving.warmup`), not from this cache alone.
+
+The fingerprint hazard this design closes: an AOT artifact compiled on
+another CPU microarchitecture must never replay locally (the r03 SIGILL
+artifact), and a TPU artifact must never replay on a CPU fallback — so
+CPU fingerprints include the host ISA feature set and every fingerprint
+includes platform/device kind/device count/precision regime.
+
+Everything here is HOST-side; calling into this module from traced code
+is flagged by jaxlint's host-call-in-jit rule.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.logging import log
+
+__all__ = ["AOT_CACHE_SCHEMA", "device_fingerprint", "arg_signature",
+           "AOTCache", "cache", "reset_cache_singleton", "enabled"]
+
+AOT_CACHE_SCHEMA = "pint_tpu.serving.aot_cache/1"
+
+#: serving-layer metric names (registered lazily, telemetry-gated)
+_EVENTS_METRIC = "pint_tpu_aot_cache_events_total"
+
+
+def device_fingerprint() -> dict:
+    """Identity of the hardware an executable is compiled FOR.
+
+    Built from the preflight :class:`~pint_tpu.runtime.preflight.
+    DeviceProfile` (platform, device kind, device count, measured f64
+    regime) plus — on CPU backends only — the host machine arch and a
+    hash of its ISA feature flags: CPU AOT artifacts replayed across
+    microarchitectures are the r03 SIGILL hazard, while TPU artifacts
+    are compiled for the accelerator itself and host identity must NOT
+    key them (a per-host key would cold-start every container)."""
+    from pint_tpu.runtime.preflight import TPU_PLATFORMS, device_profile
+
+    prof = device_profile()
+    fp = {
+        "platform": prof.platform,
+        "device_kind": prof.device_kind,
+        "num_devices": prof.num_devices,
+        "precision": prof.precision,
+        "jax_version": prof.jax_version,
+    }
+    if prof.platform not in TPU_PLATFORMS:
+        import platform as _platform_mod
+
+        fp["machine"] = _platform_mod.machine()
+        try:
+            with open("/proc/cpuinfo") as f:
+                # x86 spells the ISA line 'flags'; aarch64 'Features'
+                flags = next(ln for ln in f
+                             if ln.startswith(("flags", "Features")))
+            fp["cpu_flags"] = hashlib.sha1(
+                flags.encode()).hexdigest()[:12]
+        except (OSError, StopIteration):
+            fp["cpu_flags"] = _platform_mod.node()
+    return fp
+
+
+def arg_signature(args: tuple, kwargs: Optional[dict] = None) -> list:
+    """Per-leaf ``[shape, dtype, sharding]`` signature of a call's
+    arguments — the abstract half of a cache key (values are keyed by
+    the caller's ``vkey``, not here)."""
+    import jax
+
+    def leaf_sig(leaf):
+        return [list(getattr(leaf, "shape", ()) or ()),
+                str(getattr(leaf, "dtype", type(leaf).__name__)),
+                str(getattr(leaf, "sharding", None))]
+
+    return [leaf_sig(x) for x in
+            jax.tree_util.tree_leaves((args, kwargs or {}))]
+
+
+def _key_material(name: str, args: tuple, kwargs: Optional[dict],
+                  vkey: Any) -> dict:
+    """The canonical identity document an entry is keyed and verified
+    by.  ``vkey`` is stringified via ``repr`` — callers pass
+    process-stable values (parameter signatures, TOA versions), and repr
+    of plain tuples/floats/strings is stable across processes."""
+    return {
+        "schema": AOT_CACHE_SCHEMA,
+        "name": str(name),
+        "vkey": repr(vkey),
+        "args": arg_signature(args, kwargs),
+        "fingerprint": device_fingerprint(),
+    }
+
+
+def _digest(material: dict) -> str:
+    blob = json.dumps(material, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _emit_event(_event: str, **attrs) -> None:
+    """Cache-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter plus a labeled
+    action counter.  First arg is positional-only in spirit: the
+    executable name travels as the ``executable`` attr (the spans event
+    API reserves ``name``)."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+    from pint_tpu.telemetry import metrics
+
+    telemetry.lifecycle_event(_event, **attrs)
+    action = attrs.get("action")
+    if action:
+        metrics.counter(_EVENTS_METRIC,
+                        "AOT-cache lifecycle events").inc(
+            labels={"action": str(action)})
+
+
+#: the package's traced-pytree NamedTuples (phase pairs, TOA batches,
+#: binary-model state, position/velocity words) must be registered with
+#: jax.export before their PyTreeDefs can serialize; once per process
+_serialization_registered = False
+
+
+def _ensure_serialization_registered() -> None:
+    """Register the framework's NamedTuple pytrees for export
+    serialization (put) and deserialization (get) — both sides run this,
+    so a process that can store an entry can always load it."""
+    global _serialization_registered
+    if _serialization_registered:
+        return
+    from jax import export as jax_export
+
+    from pint_tpu.dd import DD
+    from pint_tpu.phase import Phase
+    from pint_tpu.toa import TOABatch
+    from pint_tpu.utils import PosVel
+
+    for cls, tag in ((DD, "pint_tpu.dd.DD"),
+                     (Phase, "pint_tpu.phase.Phase"),
+                     (TOABatch, "pint_tpu.toa.TOABatch"),
+                     (PosVel, "pint_tpu.utils.PosVel")):
+        try:
+            jax_export.register_namedtuple_serialization(
+                cls, serialized_name=tag)
+        except ValueError:
+            pass  # already registered (another AOTCache instance)
+    _serialization_registered = True
+
+
+def _avals_match(exported, args: tuple, kwargs: Optional[dict]) -> bool:
+    """Deserialized module input avals vs the live call's leaves.  The
+    sidecar comparison already pins the signature the entry was STORED
+    under; this pins the blob itself (a swapped or truncated-but-
+    parseable module must not execute on mismatched operands)."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves((args, kwargs or {}))
+    avals = list(exported.in_avals)
+    if len(avals) != len(leaves):
+        return False
+    for aval, leaf in zip(avals, leaves):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = np.asarray(leaf).dtype if not hasattr(leaf, "dtype") \
+            else leaf.dtype
+        if tuple(aval.shape) != shape or str(aval.dtype) != str(dtype):
+            return False
+    return True
+
+
+@dataclass
+class CacheStats:
+    """Process-lifetime counters for one :class:`AOTCache`."""
+
+    hits: int = 0
+    misses: int = 0
+    stores: int = 0
+    degrades: int = 0
+
+    def to_dict(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "stores": self.stores, "degrades": self.degrades}
+
+
+class AOTCache:
+    """Filesystem-backed store of exported executables + the process's
+    XLA persistent-cache wiring.  Construction validates writability
+    with a typed :class:`~pint_tpu.exceptions.UsageError` (the
+    configuration-time contract of ``set_aot_cache_dir``, re-enforced
+    here for env-var-configured processes)."""
+
+    def __init__(self, path: str):
+        path = os.path.abspath(str(path))
+        try:
+            os.makedirs(os.path.join(path, "exports"), exist_ok=True)
+        except OSError as e:
+            raise UsageError(
+                f"AOT cache dir {path!r} cannot be created: {e}") from e
+        if not os.access(path, os.W_OK):
+            raise UsageError(
+                f"AOT cache dir {path!r} is not writable "
+                "(PINT_TPU_AOT_CACHE_DIR / set_aot_cache_dir)")
+        self.path = path
+        self.stats = CacheStats()
+
+    # -- entry layout -------------------------------------------------------
+
+    def _entry_paths(self, digest: str) -> Tuple[str, str]:
+        base = os.path.join(self.path, "exports", digest)
+        return base + ".stablehlo", base + ".json"
+
+    # -- store --------------------------------------------------------------
+
+    def put(self, name: str, fn, args: tuple, vkey: Any = None,
+            kwargs: Optional[dict] = None) -> Optional[str]:
+        """Export ``fn`` (a jit-wrapped callable) at ``args`` and persist
+        it under the derived key.  Returns the entry digest, or ``None``
+        when export/serialize/write failed — persistence degrades, it
+        never takes the serving path down (``aot_cache`` event with
+        action ``degrade`` carries the reason)."""
+        t0 = time.perf_counter()
+        material = _key_material(name, args, kwargs, vkey)
+        digest = _digest(material)
+        blob_path, meta_path = self._entry_paths(digest)
+        try:
+            from jax import export as jax_export
+
+            _ensure_serialization_registered()
+            exported = jax_export.export(fn)(*args, **(kwargs or {}))
+            blob = exported.serialize()
+            # atomic pair: blob first, sidecar last — a crash between the
+            # two leaves a blob without identity, which get() treats as
+            # absent (the sidecar is the commit record)
+            tmp = blob_path + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, blob_path)
+            meta = dict(material)
+            meta["created_unix"] = time.time()
+            meta["blob_bytes"] = len(blob)
+            tmp = meta_path + f".tmp{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(meta, f, indent=2, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, meta_path)
+        except Exception as e:
+            self.stats.degrades += 1
+            reason = f"store: {type(e).__name__}: {e}"
+            log.warning(f"AOT cache {name!r}: {reason}")
+            _emit_event("aot_cache", action="degrade", executable=str(name),
+                        key=digest[:12], reason=reason,
+                        elapsed_ms=1e3 * (time.perf_counter() - t0))
+            return None
+        self.stats.stores += 1
+        _emit_event("aot_cache", action="store", executable=str(name),
+                    key=digest[:12], bytes=len(blob),
+                    elapsed_ms=1e3 * (time.perf_counter() - t0))
+        return digest
+
+    # -- load ---------------------------------------------------------------
+
+    def get(self, name: str, args: tuple, vkey: Any = None,
+            kwargs: Optional[dict] = None):
+        """The deserialized :class:`jax.export.Exported` for ``name`` at
+        these args, or ``None`` (miss, or verified-then-degraded).
+
+        Verification order: sidecar key material equals the freshly
+        derived material field-by-field (so a digest collision or a
+        hand-renamed file cannot alias), then the blob deserializes,
+        then its ``in_avals`` match the live operands.  Every failure
+        past the plain miss emits a ``degrade`` event with the reason
+        and falls back to ``None`` — the caller compiles fresh."""
+        t0 = time.perf_counter()
+        material = _key_material(name, args, kwargs, vkey)
+        digest = _digest(material)
+        blob_path, meta_path = self._entry_paths(digest)
+        if not (os.path.exists(meta_path) and os.path.exists(blob_path)):
+            self.stats.misses += 1
+            _emit_event("aot_cache", action="miss", executable=str(name),
+                        key=digest[:12],
+                        elapsed_ms=1e3 * (time.perf_counter() - t0))
+            return None
+        try:
+            with open(meta_path, encoding="utf-8") as f:
+                meta = json.load(f)
+            stored = {k: meta.get(k) for k in material}
+            if stored != material:
+                drift = [k for k in material if stored.get(k) != material[k]]
+                raise UsageError(
+                    f"sidecar key material mismatch on {drift} "
+                    "(stale entry for a different computation/device)")
+            from jax import export as jax_export
+
+            _ensure_serialization_registered()
+            with open(blob_path, "rb") as f:
+                blob = f.read()
+            exported = jax_export.deserialize(bytearray(blob))
+            if not _avals_match(exported, args, kwargs):
+                raise UsageError(
+                    "deserialized in_avals do not match the live "
+                    "operands (blob/sidecar disagree)")
+        except Exception as e:
+            self.stats.degrades += 1
+            reason = f"load: {type(e).__name__}: {e}"
+            log.warning(f"AOT cache {name!r}: degraded to fresh compile "
+                        f"({reason})")
+            _emit_event("aot_cache", action="degrade", executable=str(name),
+                        key=digest[:12], reason=reason,
+                        elapsed_ms=1e3 * (time.perf_counter() - t0))
+            return None
+        self.stats.hits += 1
+        _emit_event("aot_cache", action="hit", executable=str(name),
+                    key=digest[:12],
+                    elapsed_ms=1e3 * (time.perf_counter() - t0))
+        return exported
+
+    # -- XLA persistent compilation cache -----------------------------------
+
+    def xla_cache_dir(self) -> str:
+        """Per-device-fingerprint XLA persistent-cache directory under
+        this cache root.  Fingerprint-keyed so artifacts from another
+        microarchitecture or platform can never replay here."""
+        fp = device_fingerprint()
+        leaf = "-".join(str(fp[k]) for k in ("platform", "num_devices")
+                        if k in fp)
+        extra = fp.get("cpu_flags")
+        if extra:
+            leaf += f"-{fp.get('machine', '')}-{extra}"
+        return os.path.join(self.path, "xla", leaf)
+
+    def enable_xla_cache(self) -> bool:
+        """Point jax's persistent compilation cache at
+        :meth:`xla_cache_dir` so jit dispatches and AOT compiles in this
+        process are disk-served when warm.  Returns False (with a
+        warning) when the jax config rejects it — cache wiring degrades,
+        it never raises into a serving start-up."""
+        try:
+            import jax
+
+            d = self.xla_cache_dir()
+            os.makedirs(d, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            return True
+        except Exception as e:
+            log.warning(f"AOT cache: persistent compilation cache not "
+                        f"enabled ({type(e).__name__}: {e})")
+            return False
+
+
+#: module singleton keyed by the configured dir (a config change mid-
+#: process gets a fresh instance; stats are per-instance)
+_cache_singleton: Optional[Tuple[str, AOTCache]] = None
+
+
+def cache() -> Optional[AOTCache]:
+    """The process's :class:`AOTCache` for the configured dir, or
+    ``None`` when persistence is off (:func:`pint_tpu.config.
+    aot_cache_dir`).  Raises the typed :class:`UsageError` when the
+    configured directory is unusable — an explicitly requested cache
+    that cannot work must be loud, not silently absent."""
+    global _cache_singleton
+    d = config.aot_cache_dir()
+    if d is None:
+        return None
+    if _cache_singleton is None or _cache_singleton[0] != d:
+        _cache_singleton = (d, AOTCache(d))
+    return _cache_singleton[1]
+
+
+def reset_cache_singleton() -> None:
+    """Drop the memoized instance (tests; config-dir churn)."""
+    global _cache_singleton
+    _cache_singleton = None
+
+
+def enabled() -> bool:
+    return config.aot_cache_dir() is not None

@@ -1,0 +1,314 @@
+"""Shape-bucketed request batching: many fits, a handful of executables.
+
+A serving deployment cannot afford one XLA compile per (n_toas, n_free)
+pair in the catalog — the whole point of the warm layer is that a small
+bucket grid of padded shapes serves every request with ``compiles=0``
+steady state.  This module provides:
+
+* **buckets** — :func:`bucket_of` rounds a dimension up its ladder
+  (doubling past the top, so an oversized request costs one fresh
+  compile, never a failure);
+* **requests** — :class:`FitRequest` carries one linearized GLS/WLS
+  fit: the normalized augmented design matrix (timing + noise-basis
+  columns), residuals, white-noise weights, and prior ``phiinv`` —
+  exactly the per-point system of the reference benchmark's
+  grid refits (:func:`FitRequest.from_fitter` builds it from any
+  fitter via :func:`pint_tpu.gls_fitter.build_augmented_system`);
+* **padding** — :func:`pad_request` embeds a request into a bucket
+  shape EXACTLY: padded TOA rows get weight 0 (they cannot enter the
+  normal equations or the chi2), padded parameter columns are zero
+  with a unit pad-diagonal added to the Gram, which makes the padded
+  system block-diagonal ``[[A_real, 0], [0, I]]`` — the Cholesky
+  factors blockwise, so the real block's solve is the dedicated-shape
+  solve (tests pin padded == dedicated to 1e-9 including the
+  masked-TOA chi2);
+* **the serve kernel** — a module-level jitted, vmapped linearized
+  Gauss-Newton step + chi2 (one executable per bucket shape, shared
+  process-wide through jit's dispatch cache and the warm pool's AOT
+  handles);
+* **the batcher** — :class:`ShapeBatcher` groups compatible requests
+  per bucket, pads the batch axis to its own ladder, dispatches one
+  batched executable per group (preferring a warm
+  :class:`~pint_tpu.serving.warmup.WarmPool` handle), and unpads the
+  per-request results.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["DEFAULT_NTOA_BUCKETS", "DEFAULT_NFREE_BUCKETS",
+           "DEFAULT_BATCH_BUCKETS", "bucket_of", "FitRequest", "FitResult",
+           "pad_request", "serve_kernel", "serve_batched", "ShapeBatcher"]
+
+#: default shape ladders: a handful of shapes serve the whole catalog
+#: (B1855-class workloads land in the 4096/256 bucket)
+DEFAULT_NTOA_BUCKETS = (64, 256, 1024, 4096, 16384)
+DEFAULT_NFREE_BUCKETS = (8, 32, 128, 512)
+DEFAULT_BATCH_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def bucket_of(n: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder rung >= ``n``; past the top the ladder continues
+    by doubling (an oversized request pays a fresh compile at a still-
+    bounded shape family, it never errors)."""
+    if n < 1:
+        raise UsageError(f"bucket dimension must be >= 1, got {n}")
+    for rung in sorted(ladder):
+        if n <= rung:
+            return int(rung)
+    top = int(max(ladder))
+    while top < n:
+        top *= 2
+    return top
+
+
+@dataclass
+class FitRequest:
+    """One linearized fit: solve the (prior-augmented) normal equations
+    at the caller's current state and report the step, errors, and
+    post-step chi2.  Arrays are host numpy; the batcher owns padding
+    and device placement."""
+
+    M: np.ndarray                 #: (n_toas, n_free) normalized design
+    r: np.ndarray                 #: (n_toas,) residuals (seconds)
+    w: np.ndarray                 #: (n_toas,) white-noise weights 1/Nvec
+    phiinv: np.ndarray            #: (n_free,) prior weights (0 = flat)
+    params: Tuple[str, ...] = ()  #: names of the leading timing columns
+    norm: Optional[np.ndarray] = None   #: column normalization to undo
+    request_id: Optional[str] = None
+
+    def __post_init__(self):
+        self.M = np.asarray(self.M, dtype=np.float64)
+        self.r = np.asarray(self.r, dtype=np.float64)
+        self.w = np.asarray(self.w, dtype=np.float64)
+        self.phiinv = np.asarray(self.phiinv, dtype=np.float64)
+        if self.M.ndim != 2:
+            raise UsageError(
+                f"design matrix must be 2-D, got shape {self.M.shape}")
+        n, k = self.M.shape
+        for name, arr, length in (("r", self.r, n), ("w", self.w, n),
+                                  ("phiinv", self.phiinv, k)):
+            if arr.shape != (length,):
+                raise UsageError(
+                    f"FitRequest.{name} shape {arr.shape} does not match "
+                    f"design matrix {self.M.shape}")
+
+    @property
+    def n_toas(self) -> int:
+        return int(self.M.shape[0])
+
+    @property
+    def n_free(self) -> int:
+        return int(self.M.shape[1])
+
+    @classmethod
+    def from_fitter(cls, ftr, request_id: Optional[str] = None
+                    ) -> "FitRequest":
+        """The fitter's current linearized system as one request: the
+        Woodbury-form augmented design ``[M_timing | U_noise]`` with the
+        enterprise prior weights, the same construction every GLS-family
+        fit step solves (:func:`~pint_tpu.gls_fitter.
+        build_augmented_system`; for a white-noise model the noise block
+        is simply absent)."""
+        from pint_tpu.gls_fitter import build_augmented_system
+
+        M, params, norm, phiinv, Nvec, _ = build_augmented_system(
+            ftr.model, ftr.toas)
+        r = np.asarray(ftr.resids.time_resids, dtype=np.float64)
+        return cls(M=M, r=r, w=1.0 / np.asarray(Nvec, dtype=np.float64),
+                   phiinv=phiinv, params=tuple(params),
+                   norm=np.asarray(norm, dtype=np.float64),
+                   request_id=request_id)
+
+
+@dataclass
+class FitResult:
+    """Unpadded outcome of one served request."""
+
+    dx: np.ndarray                #: (n_free,) normalized-parameter step
+    errors: np.ndarray            #: (n_free,) normalized 1-sigma errors
+    chi2: float                   #: post-step (linearized) chi2
+    chi2_initial: float           #: chi2 of the residuals as submitted
+    bucket: Tuple[int, int]       #: (bucket_ntoas, bucket_nfree) served on
+    batch: int = 1                #: coalesced batch size dispatched
+    #: fresh XLA compiles attributed to THIS request: the dispatch's
+    #: whole delta lands on the first member of a coalesced batch (0 on
+    #: the rest), so summing over requests — the serve metrics/events do
+    #: — counts each real compile exactly once
+    compiles: int = 0
+    latency_ms: Optional[float] = None
+    request_id: Optional[str] = None
+
+    def dpars(self, req: FitRequest) -> Dict[str, float]:
+        """Physical parameter steps for the request's named timing
+        columns (undoing the design-matrix column normalization)."""
+        norm = req.norm if req.norm is not None \
+            else np.ones(req.n_free)
+        return {p: float(self.dx[i] / norm[i])
+                for i, p in enumerate(req.params)}
+
+
+def pad_request(req: FitRequest, bucket_ntoas: int, bucket_nfree: int
+                ) -> Tuple[np.ndarray, ...]:
+    """Embed ``req`` into the bucket shape: ``(M, r, w, phiinv,
+    pad_free)`` with zero-weight pad rows, zero pad columns, and
+    ``pad_free`` marking the unit diagonal the kernel adds so the
+    padded Gram stays positive definite and block-diagonal."""
+    n, k = req.M.shape
+    if bucket_ntoas < n or bucket_nfree < k:
+        raise UsageError(
+            f"bucket ({bucket_ntoas}, {bucket_nfree}) cannot hold a "
+            f"({n}, {k}) request")
+    M = np.zeros((bucket_ntoas, bucket_nfree))
+    M[:n, :k] = req.M
+    r = np.zeros(bucket_ntoas)
+    r[:n] = req.r
+    w = np.zeros(bucket_ntoas)
+    w[:n] = req.w
+    phiinv = np.zeros(bucket_nfree)
+    phiinv[:k] = req.phiinv
+    pad_free = np.zeros(bucket_nfree)
+    pad_free[k:] = 1.0
+    return M, r, w, phiinv, pad_free
+
+
+def serve_kernel(M, r, w, phiinv, pad_free):
+    """One linearized (Gauss-Newton) fit on a padded system — the
+    jax-traceable core every bucket executable compiles.
+
+    The internal unit-W-norm column scaling is the fitter family's
+    conditioning move (raw Grams reach ~1e42 at 4005 TOAs); padded
+    columns scale to 1 and pick up only their pad-diagonal, so the
+    factorization is exactly block-diagonal and the real block's solve
+    matches the dedicated-shape kernel column for column."""
+    import jax
+    import jax.numpy as jnp
+
+    wM = w[:, None] * M
+    s = jnp.sqrt(jnp.sum(wM * M, axis=0) + phiinv)
+    s = jnp.where(s > 0, s, 1.0)
+    Ms = M / s
+    A = Ms.T @ (w[:, None] * Ms) + jnp.diag(phiinv / s**2) \
+        + jnp.diag(pad_free)
+    b = Ms.T @ (w * r)
+    cf = jax.scipy.linalg.cho_factor(A, lower=True)
+    dx_s = jax.scipy.linalg.cho_solve(cf, b)
+    dx = dx_s / s
+    Ainv = jax.scipy.linalg.cho_solve(cf, jnp.eye(A.shape[0],
+                                                  dtype=A.dtype))
+    err = jnp.sqrt(jnp.clip(jnp.diag(Ainv), 0.0)) / s
+    r_post = r - M @ dx
+    chi2 = jnp.sum(w * r_post * r_post)
+    chi2_initial = jnp.sum(w * r * r)
+    return dx, err, chi2, chi2_initial
+
+
+#: the batched executable: one compile per (batch, bucket_ntoas,
+#: bucket_nfree) shape triple, shared process-wide via jit's dispatch
+#: cache; module-level so repeat batchers retrace into the warm cache
+_serve_batched_jit = None
+
+
+def serve_batched():
+    """The module's jitted ``vmap(serve_kernel)`` (lazy: importing the
+    batcher must not import jax)."""
+    global _serve_batched_jit
+    if _serve_batched_jit is None:
+        import jax
+
+        _serve_batched_jit = jax.jit(jax.vmap(serve_kernel))
+    return _serve_batched_jit
+
+
+class ShapeBatcher:
+    """Group → pad → dispatch → unpad.
+
+    ``pool`` (a :class:`~pint_tpu.serving.warmup.WarmPool`) supplies
+    pre-compiled AOT handles per bucket shape; a bucket without a warm
+    handle dispatches through the module-level jit (compiling once per
+    process per shape).  The batcher is synchronous and stateless per
+    call — the async front door (:mod:`pint_tpu.serving.service`) owns
+    queueing and coalescing windows."""
+
+    def __init__(self,
+                 ntoa_buckets: Sequence[int] = DEFAULT_NTOA_BUCKETS,
+                 nfree_buckets: Sequence[int] = DEFAULT_NFREE_BUCKETS,
+                 batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+                 pool=None):
+        self.ntoa_buckets = tuple(sorted(int(b) for b in ntoa_buckets))
+        self.nfree_buckets = tuple(sorted(int(b) for b in nfree_buckets))
+        self.batch_buckets = tuple(sorted(int(b) for b in batch_buckets))
+        if not (self.ntoa_buckets and self.nfree_buckets
+                and self.batch_buckets):
+            raise UsageError("every bucket ladder needs at least one rung")
+        self.pool = pool
+
+    def bucket_for(self, req: FitRequest) -> Tuple[int, int]:
+        return (bucket_of(req.n_toas, self.ntoa_buckets),
+                bucket_of(req.n_free, self.nfree_buckets))
+
+    def _dispatch(self, bucket: Tuple[int, int],
+                  group: List[FitRequest]) -> List[FitResult]:
+        """Pad one bucket group onto its batch rung and execute."""
+        from pint_tpu.telemetry import jaxevents
+
+        bn, bk = bucket
+        batch = bucket_of(len(group), self.batch_buckets)
+        padded = [pad_request(q, bn, bk) for q in group]
+        # batch padding repeats the first request's operands; the
+        # repeated lanes are discarded on unpad (deterministic, and —
+        # unlike zero lanes — trivially nonsingular)
+        while len(padded) < batch:
+            padded.append(padded[0])
+        operands = tuple(np.stack([p[i] for p in padded])
+                         for i in range(5))
+        name = f"serve.fit[{batch}x{bn}x{bk}]"
+        handle = None
+        if self.pool is not None:
+            handle = self.pool.lookup(name, operands)
+        t0 = time.perf_counter()
+        before = jaxevents.counts()
+        if handle is not None:
+            out = handle(*operands)
+        else:
+            out = serve_batched()(*operands)
+        out = [np.asarray(o) for o in out]
+        compiles = jaxevents.counts().compiles - before.compiles
+        wall_ms = 1e3 * (time.perf_counter() - t0)
+        results = []
+        for i, q in enumerate(group):
+            k = q.n_free
+            results.append(FitResult(
+                dx=out[0][i, :k].copy(), errors=out[1][i, :k].copy(),
+                chi2=float(out[2][i]), chi2_initial=float(out[3][i]),
+                bucket=bucket, batch=batch,
+                # whole dispatch delta on the first member only: sums
+                # across requests equal real compiles (no N-x overcount)
+                compiles=int(compiles) if i == 0 else 0,
+                latency_ms=wall_ms, request_id=q.request_id))
+        return results
+
+    def run(self, requests: Sequence[FitRequest]) -> List[FitResult]:
+        """Serve ``requests``: coalesce compatible shapes per bucket,
+        dispatch one batched executable per group, return results in
+        request order."""
+        groups: Dict[Tuple[int, int], List[int]] = {}
+        for i, q in enumerate(requests):
+            groups.setdefault(self.bucket_for(q), []).append(i)
+        out: List[Optional[FitResult]] = [None] * len(requests)
+        for bucket, idxs in groups.items():
+            # oversize coalitions split at the batch ladder's top rung
+            top = self.batch_buckets[-1]
+            for lo in range(0, len(idxs), top):
+                chunk = idxs[lo:lo + top]
+                for j, res in zip(chunk, self._dispatch(
+                        bucket, [requests[i] for i in chunk])):
+                    out[j] = res
+        return out  # type: ignore[return-value]

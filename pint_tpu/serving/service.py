@@ -1,0 +1,226 @@
+"""The serving front door: async submission, coalescing, latency SLOs.
+
+:class:`TimingService` is the piece a deployment actually talks to.  It
+owns a :class:`~pint_tpu.serving.batcher.ShapeBatcher` and a
+:class:`~pint_tpu.serving.warmup.WarmPool`, exposes
+
+* ``serve(requests)`` — the synchronous batch door (bench, tests,
+  offline sweeps): one coalescing pass over the given requests;
+* ``await submit(request)`` — the asyncio door: requests arriving
+  within ``window_ms`` of each other coalesce onto one padded batched
+  executable (same bucket) before dispatch;
+* ``warm(buckets)`` — pre-compile/cache-load the configured bucket
+  set at service start (:func:`~pint_tpu.serving.warmup.warm_buckets`);
+
+and reports itself through the existing observability stack: request /
+latency / queue-depth / compile counters in the process metrics
+registry (``pint_tpu_serve_*``), per-request ``serve_request``
+telemetry events (bucket shape, coalesced batch size, latency, fresh
+compiles — the runlog schema ``tools/telemetry_report --check``
+validates), and :meth:`latency_summary` p50/p99 for the bench's
+``warm{}`` block.
+
+The batch dispatch itself is synchronous inside the event loop (XLA
+execution holds the dispatch thread either way); the coalescing window
+is where the async door earns its keep.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.serving.batcher import (
+    DEFAULT_BATCH_BUCKETS,
+    DEFAULT_NFREE_BUCKETS,
+    DEFAULT_NTOA_BUCKETS,
+    FitRequest,
+    FitResult,
+    ShapeBatcher,
+)
+from pint_tpu.serving.warmup import WarmPool, WarmupReport, warm_buckets
+
+__all__ = ["ServeConfig", "TimingService"]
+
+#: bounded latency ring: enough for honest p99 without unbounded growth
+_LATENCY_RING = 4096
+
+
+@dataclass
+class ServeConfig:
+    """Service shape/latency policy."""
+
+    ntoa_buckets: Tuple[int, ...] = DEFAULT_NTOA_BUCKETS
+    nfree_buckets: Tuple[int, ...] = DEFAULT_NFREE_BUCKETS
+    batch_buckets: Tuple[int, ...] = DEFAULT_BATCH_BUCKETS
+    #: how long the async door holds a request hoping for bucket-mates
+    window_ms: float = 2.0
+    max_queue: int = 1024
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Request-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+class TimingService:
+    """Shape-bucketed warm-serving front door for linearized fits."""
+
+    def __init__(self, cfg: Optional[ServeConfig] = None,
+                 pool: Optional[WarmPool] = None):
+        self.cfg = cfg or ServeConfig()
+        if self.cfg.window_ms < 0 or self.cfg.max_queue < 1:
+            raise UsageError(
+                f"ServeConfig window_ms must be >= 0 and max_queue >= 1 "
+                f"(got {self.cfg.window_ms}, {self.cfg.max_queue})")
+        self.pool = pool or WarmPool()
+        self.batcher = ShapeBatcher(
+            ntoa_buckets=self.cfg.ntoa_buckets,
+            nfree_buckets=self.cfg.nfree_buckets,
+            batch_buckets=self.cfg.batch_buckets,
+            pool=self.pool)
+        self._latencies_ms: List[float] = []
+        self._served = 0
+        self._pending: List[tuple] = []
+        self._flush_task = None
+
+    # -- warm-up ------------------------------------------------------------
+
+    def warm(self, buckets: Sequence[Tuple[int, int, int]]
+             ) -> WarmupReport:
+        """Pre-warm the serve executables for ``(batch, n_toas,
+        n_free)`` triples (cache-load or fresh compile + cache store)."""
+        _, report = warm_buckets(buckets, pool=self.pool)
+        return report
+
+    # -- accounting ---------------------------------------------------------
+
+    def _record(self, req: FitRequest, res: FitResult,
+                latency_ms: float) -> None:
+        from pint_tpu.telemetry import metrics
+
+        res.latency_ms = latency_ms
+        self._served += 1
+        self._latencies_ms.append(latency_ms)
+        if len(self._latencies_ms) > _LATENCY_RING:
+            del self._latencies_ms[:len(self._latencies_ms)
+                                   - _LATENCY_RING]
+        if config._telemetry_mode != "off":
+            metrics.counter("pint_tpu_serve_requests_total",
+                            "fit requests served").inc()
+            metrics.histogram("pint_tpu_serve_latency_ms",
+                              "request latency (ms)").observe(latency_ms)
+            if res.compiles:
+                metrics.counter("pint_tpu_serve_compiles_total",
+                                "fresh XLA compiles paid by serve "
+                                "dispatches").inc(res.compiles)
+        _emit_event("serve_request",
+                    bucket_ntoas=int(res.bucket[0]),
+                    bucket_nfree=int(res.bucket[1]),
+                    batch=int(res.batch),
+                    latency_ms=float(latency_ms),
+                    compiles=int(res.compiles),
+                    n_toas=int(req.n_toas), n_free=int(req.n_free))
+
+    def latency_summary(self) -> dict:
+        """``{n, p50_ms, p99_ms}`` over the (bounded) latency ring."""
+        vals = sorted(self._latencies_ms)
+        return {"n": len(vals),
+                "p50_ms": _percentile(vals, 0.50),
+                "p99_ms": _percentile(vals, 0.99)}
+
+    @property
+    def served(self) -> int:
+        return self._served
+
+    # -- synchronous door ---------------------------------------------------
+
+    def serve(self, requests: Sequence[FitRequest]) -> List[FitResult]:
+        """One coalescing pass: bucket, pad, dispatch, unpad.  Latency
+        recorded per request is the wall time of this call's share (the
+        whole pass for every member — the honest number under
+        coalescing: a request waits for its batch)."""
+        t0 = time.perf_counter()
+        results = self.batcher.run(requests)
+        wall_ms = 1e3 * (time.perf_counter() - t0)
+        for req, res in zip(requests, results):
+            self._record(req, res, wall_ms)
+        return results
+
+    # -- async door ---------------------------------------------------------
+
+    async def submit(self, request: FitRequest) -> FitResult:
+        """Enqueue one request; requests landing within the coalescing
+        window share a batched executable.  Returns this request's
+        unpadded result (exceptions from a failed batch propagate to
+        every member's awaiter)."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        if len(self._pending) >= self.cfg.max_queue:
+            raise UsageError(
+                f"serve queue full ({self.cfg.max_queue}); shed load or "
+                "raise ServeConfig.max_queue")
+        fut = loop.create_future()
+        self._pending.append((request, fut, time.perf_counter()))
+        self._gauge_queue_depth()
+        if self._flush_task is None:
+            self._flush_task = loop.create_task(self._flush_after())
+        return await fut
+
+    def _gauge_queue_depth(self) -> None:
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.gauge("pint_tpu_serve_queue_depth",
+                          "requests waiting in the coalescing window"
+                          ).set(len(self._pending))
+
+    async def _flush_after(self) -> None:
+        import asyncio
+
+        await asyncio.sleep(self.cfg.window_ms / 1e3)
+        pending, self._pending = self._pending, []
+        self._flush_task = None
+        self._gauge_queue_depth()
+        if not pending:
+            return
+        try:
+            results = self.batcher.run([p[0] for p in pending])
+        except Exception as e:
+            for _, fut, _ in pending:
+                if not fut.done():
+                    fut.set_exception(e)
+            return
+        now = time.perf_counter()
+        for (req, fut, t0), res in zip(pending, results):
+            # deliver BEFORE accounting: a telemetry/metrics failure in
+            # _record must degrade to a warning, never strand awaiters
+            # on futures that no one will ever resolve
+            res.latency_ms = 1e3 * (now - t0)
+            if not fut.done():
+                fut.set_result(res)
+            try:
+                self._record(req, res, res.latency_ms)
+            except Exception as e:
+                from pint_tpu.logging import log
+
+                log.warning(f"serve accounting failed "
+                            f"({type(e).__name__}: {e}); result delivered")

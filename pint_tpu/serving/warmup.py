@@ -1,0 +1,236 @@
+"""Pre-warm pools: hold the compiled executables the service will run.
+
+The jax-0.4.x accounting reality (measured; see aotcache module doc) is
+that every *dispatch-path* compile — even one served from the XLA
+persistent cache — fires the ``backend_compile_duration`` event the
+telemetry layer counts.  The only way a steady-state request shows
+``compiles=0`` in the JAX accounting is to never enter the compile path
+at all: hold ``jax.stages.Compiled`` handles, built once at service
+start, and execute those.  That is what a :class:`WarmPool` is.
+
+Warm sources, in preference order:
+
+* **AOT-cache hit** — :meth:`WarmPool.warm` asks the
+  :class:`~pint_tpu.serving.aotcache.AOTCache` for a serialized export
+  of this executable (key: name + vkey + arg signature + device
+  fingerprint); on a verified hit the deserialized module is AOT-
+  compiled into a handle WITHOUT re-tracing the original Python (the
+  expensive half of a cold start on big workloads);
+* **fresh compile** — on a miss the live function is AOT-compiled via
+  :func:`pint_tpu.telemetry.costs.compiled_for` (shared executable
+  cache, accounting paused — warm-up compiles are reported on the
+  :class:`WarmupReport`, not smeared into the workload counters) and
+  the export is stored back into the cache for the next process.
+
+:func:`warm_fitter` warms the production executables the routed fit
+path runs — the model's compiled phase evaluation + Jacobian
+(``fit.eval``/``fit.jac``), the GLS Woodbury solve (``gls.solve``),
+and, when a grid has recorded its handle, the chunked grid executable
+(``grid.chunk``) — using the same (fn, args) handles the cost
+observatory analyzes, so what is warmed IS what production dispatches.
+:func:`warm_buckets` pre-warms the serve-kernel executables for a
+configured bucket set.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.logging import log
+from pint_tpu.serving import aotcache
+
+__all__ = ["WarmEntry", "WarmupReport", "WarmPool", "warm_fitter",
+           "warm_buckets", "fitter_vkey"]
+
+
+@dataclass
+class WarmEntry:
+    """One warmed executable: a compiled handle plus its provenance."""
+
+    name: str
+    compiled: Any                #: jax.stages.Compiled (call it directly)
+    source: str                  #: "aot-cache" | "fresh-compile"
+    load_s: float
+    key: Optional[str] = None    #: cache digest prefix, when cached
+
+    def __call__(self, *args, **kwargs):
+        return self.compiled(*args, **kwargs)
+
+
+@dataclass
+class WarmupReport:
+    """What a warm-up pass paid, per executable — the service-start
+    ledger the bench's ``warm{}`` block summarizes."""
+
+    entries: List[WarmEntry] = field(default_factory=list)
+
+    @property
+    def cache_hits(self) -> int:
+        return sum(1 for e in self.entries if e.source == "aot-cache")
+
+    @property
+    def cold_compiles(self) -> int:
+        return sum(1 for e in self.entries if e.source == "fresh-compile")
+
+    def to_dict(self) -> dict:
+        return {
+            "cache_hits": self.cache_hits,
+            "cold_compiles": self.cold_compiles,
+            "executables": {e.name: {"source": e.source,
+                                     "load_s": round(e.load_s, 3)}
+                            for e in self.entries},
+        }
+
+
+def _arg_key(name: str, args: tuple) -> tuple:
+    """Pool lookup key: executable name + abstract operand signature
+    (the same leaf signature the AOT cache keys on, flattened to a
+    hashable string)."""
+    import json
+
+    return (name, json.dumps(aotcache.arg_signature(args)))
+
+
+class WarmPool:
+    """Named, shape-keyed store of AOT-compiled executable handles."""
+
+    def __init__(self, cache: Optional[aotcache.AOTCache] = None):
+        #: None = use the configured module cache (which may be None)
+        self._explicit_cache = cache
+        self._entries: Dict[tuple, WarmEntry] = {}
+
+    @property
+    def cache(self) -> Optional[aotcache.AOTCache]:
+        return self._explicit_cache if self._explicit_cache is not None \
+            else aotcache.cache()
+
+    def lookup(self, name: str, args: tuple) -> Optional[WarmEntry]:
+        """The warm handle for ``name`` at these operand shapes, or
+        ``None`` — the batcher's zero-compile fast path."""
+        return self._entries.get(_arg_key(name, args))
+
+    def entries(self) -> List[WarmEntry]:
+        return list(self._entries.values())
+
+    def warm(self, name: str, fn, args: tuple, vkey: Any = None
+             ) -> WarmEntry:
+        """Ensure a compiled handle for ``fn`` at ``args`` exists in the
+        pool: AOT-cache load when possible, fresh AOT compile (then
+        cache store) otherwise.  Both paths run the deliberate compile
+        under :func:`~pint_tpu.telemetry.costs.compiled_for`'s paused
+        accounting — the pool's job is to make *later* dispatches
+        compile-free, and the report carries what warm-up itself paid."""
+        import jax
+
+        from pint_tpu.telemetry import costs
+
+        key = _arg_key(name, args)
+        if key in self._entries:
+            return self._entries[key]
+        t0 = time.perf_counter()
+        cache = self.cache
+        exported = cache.get(name, args, vkey=vkey) \
+            if cache is not None else None
+        if exported is not None:
+            # compile the deserialized module directly (accounting
+            # paused, like every deliberate warm-up compile) — routing a
+            # throwaway jit(exported.call) through compiled_for would
+            # always miss its id(fn)-keyed memo AND churn dead entries
+            # into the bounded executable cache the cost/distview
+            # observatory shares; the pool's own _entries map is the
+            # memo for warmed handles
+            from pint_tpu.telemetry import jaxevents
+
+            with jaxevents.accounting_paused():
+                compiled = jax.jit(exported.call).lower(*args).compile()
+            entry = WarmEntry(name=name, compiled=compiled,
+                              source="aot-cache",
+                              load_s=time.perf_counter() - t0)
+        else:
+            compiled = costs.compiled_for(fn, *args)
+            digest = cache.put(name, fn, args, vkey=vkey) \
+                if cache is not None else None
+            entry = WarmEntry(name=name, compiled=compiled,
+                              source="fresh-compile",
+                              load_s=time.perf_counter() - t0,
+                              key=digest[:12] if digest else None)
+        self._entries[key] = entry
+        log.info(f"warm pool: {name} ready via {entry.source} in "
+                 f"{entry.load_s:.2f}s")
+        return entry
+
+
+def fitter_vkey(ftr) -> tuple:
+    """Process-stable version key for a fitter's executables: the model
+    parameter/mask signature the grid bundle is keyed by, plus the TOA
+    version and count — the same invalidation discipline as
+    ``grid.py``'s bundle vkey (an edited EFAC selector or re-validated
+    TOA set must never replay a stale executable)."""
+    from pint_tpu.grid import _model_param_sig
+
+    return (_model_param_sig(ftr.model),
+            getattr(ftr.toas, "_version", 0), len(ftr.toas))
+
+
+def warm_fitter(ftr, pool: Optional[WarmPool] = None,
+                include_grid: bool = True) -> Tuple[WarmPool, WarmupReport]:
+    """Warm the routed production executables for ``ftr``:
+    ``fit.eval``/``fit.jac`` (compiled phase evaluation + Jacobian),
+    ``gls.solve`` (Woodbury Cholesky solve) when the fitter has one,
+    and ``grid.chunk`` when a grid run has recorded its handle on the
+    fitter.  Returns the pool and the per-executable ledger."""
+    pool = pool or WarmPool()
+    report = WarmupReport()
+    vkey = fitter_vkey(ftr)
+    handles: List[Tuple[str, Any, tuple]] = []
+    try:
+        for name, (fn, args) in ftr.fit_step_executables().items():
+            handles.append((name, fn, args))
+    except Exception as e:
+        log.warning(f"warm pool: fit-step executables unavailable "
+                    f"({type(e).__name__}: {e})")
+    if hasattr(ftr, "gls_solve_executable"):
+        try:
+            fn, args = ftr.gls_solve_executable()
+            handles.append(("gls.solve", fn, args))
+        except Exception as e:
+            log.warning(f"warm pool: gls solve executable unavailable "
+                        f"({type(e).__name__}: {e})")
+    grid_handle = getattr(ftr, "last_grid_executable", None)
+    if include_grid and grid_handle is not None:
+        fn, args = grid_handle
+        handles.append(("grid.chunk", fn, args))
+    for name, fn, args in handles:
+        report.entries.append(pool.warm(name, fn, args, vkey=vkey))
+    return pool, report
+
+
+def warm_buckets(buckets: Sequence[Tuple[int, int, int]],
+                 pool: Optional[WarmPool] = None
+                 ) -> Tuple[WarmPool, WarmupReport]:
+    """Pre-warm the serve-kernel executables for ``(batch, n_toas,
+    n_free)`` bucket triples — service start-up's guarantee that the
+    first real request of each configured shape is already
+    compile-free.  Operand VALUES are irrelevant to the executable
+    (shapes key it), so zero/identity dummies are used; the vkey pins
+    the kernel's own schema."""
+    from pint_tpu.serving import batcher
+
+    pool = pool or WarmPool()
+    report = WarmupReport()
+    for batch, bn, bk in buckets:
+        shape_name = f"serve.fit[{batch}x{bn}x{bk}]"
+        M = np.zeros((batch, bn, bk))
+        r = np.zeros((batch, bn))
+        w = np.zeros((batch, bn))
+        phiinv = np.zeros((batch, bk))
+        pad_free = np.ones((batch, bk))
+        report.entries.append(pool.warm(
+            shape_name, batcher.serve_batched(),
+            (M, r, w, phiinv, pad_free),
+            vkey=("serve_kernel", 1)))
+    return pool, report

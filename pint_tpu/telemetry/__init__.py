@@ -47,8 +47,8 @@ from pint_tpu.telemetry.spans import (
 )
 
 __all__ = ["span", "event", "set_attr", "current_span", "mode", "enabled",
-           "activate", "deactivate", "spans", "metrics", "jaxevents",
-           "runlog", "costs", "distview"]
+           "activate", "deactivate", "lifecycle_event", "spans", "metrics",
+           "jaxevents", "runlog", "costs", "distview"]
 
 
 def mode() -> str:
@@ -84,6 +84,20 @@ def activate(new_mode: Optional[str] = None) -> str:
             spans.add_span_sink(_runlog_sink)
             _sink_registered = True
     return m
+
+
+def lifecycle_event(name: str, **attrs) -> None:
+    """The one emitter for host-side lifecycle decisions (plan
+    selection, device eviction, AOT-cache actions, served requests):
+    attach the event to the current span AND — in full mode — write a
+    loose record into the run's events.jsonl, so the decision is
+    observable even when no span is open (a supervisor retry loop, a
+    cache consult between requests).  No-op when telemetry is off."""
+    if config._telemetry_mode == "off":
+        return
+    event(name, **attrs)
+    if config.telemetry_mode() == "full":
+        runlog.ensure_run().record_event(name, **attrs)
 
 
 def deactivate(close_run: bool = True) -> None:

@@ -101,4 +101,61 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert cost["flops"] and cost["bytes_accessed"]
     # the telemetry block rode along as before
     assert headline["telemetry"]["jax"]["compiles"] > 0
+    # the warm-serving block (PR 8): AOT-cache provenance counters plus
+    # steady-state throughput/latency — every key present, and with no
+    # AOT cache configured everything was a fresh compile
+    warm = headline["warm"]
+    for key in ("cache_hits", "cold_compiles", "warm_fits_per_s",
+                "p50_ms", "p99_ms"):
+        assert key in warm, f"warm block missing {key!r}"
+    assert "error" not in warm, f"warm measurement degraded: {warm}"
+    assert warm["cache_hits"] == 0
+    assert warm["cold_compiles"] >= 1
+    assert warm["warm_fits_per_s"] > 0
+    assert warm["p50_ms"] > 0 and warm["p99_ms"] >= warm["p50_ms"]
+    # the ROADMAP's steady-state proof: the timed serving pass paid no
+    # fresh XLA compiles (the bucket executable was pre-warmed)
+    assert warm["steady_state_compiles"] == 0
     json.dumps(headline)
+
+
+def test_warm_block_hits_cache_on_second_run(tiny_headline_files,
+                                             monkeypatch, capsys,
+                                             tmp_path):
+    """With PINT_TPU_AOT_CACHE_DIR set, a second bench run (same
+    process here; the cache is keyed for cross-process reuse) loads the
+    warmed executables from the AOT cache instead of compiling."""
+    import bench
+    from pint_tpu import config
+    from pint_tpu.serving import aotcache
+
+    par, tim = tiny_headline_files
+    monkeypatch.setattr(bench, "B1855_PAR", par)
+    monkeypatch.setattr(bench, "B1855_TIM", tim)
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    monkeypatch.setenv("BENCH_SKIP_SECONDARY", "1")
+    monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
+    monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
+    cache_dir = str(tmp_path / "aot")
+    config.set_aot_cache_dir(cache_dir)
+    try:
+        bench.main()
+        first = json.loads(capsys.readouterr().out.strip())
+        import jax
+
+        jax.clear_caches()
+        bench.main()
+        second = json.loads(capsys.readouterr().out.strip())
+    finally:
+        from pint_tpu import telemetry
+
+        telemetry.deactivate()
+        config.set_aot_cache_dir(None)
+        aotcache.reset_cache_singleton()
+    assert first["warm"]["cache_hits"] == 0
+    assert first["warm"]["cold_compiles"] >= 1
+    # every executable the first run stored now loads: zero cold
+    # compiles, and the serving pass still pays no steady-state compile
+    assert second["warm"]["cold_compiles"] == 0
+    assert second["warm"]["cache_hits"] >= first["warm"]["cold_compiles"]
+    assert second["warm"]["steady_state_compiles"] == 0

@@ -339,6 +339,76 @@ class TestHostCallInJit:
         )
         assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
 
+    def test_serving_call_in_jit_flagged(self, tmp_path):
+        """The warm-serving layer is pure host machinery (filesystem
+        cache I/O, export serialization, asyncio, metrics) — an
+        aotcache get/put or a pool warm inside a traced function would
+        run per TRACE and hang the compile on cache I/O; the serving
+        submodules are policed like the telemetry ones."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.serving import aotcache\n"
+            "from pint_tpu.serving.warmup import WarmPool\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    aotcache.cache().get('grid.chunk', (x,))\n"
+            "    WarmPool().warm('f', f, (x,))\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+
+    def test_serving_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — warm the pool and consult
+        the cache from host code AROUND the jitted function."""
+        good = (
+            "import jax\n"
+            "from pint_tpu.serving import aotcache, warmup\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "def host(x):\n"
+            "    pool = warmup.WarmPool()\n"
+            "    entry = pool.warm('f', f, (x,))\n"
+            "    aotcache.cache()\n"
+            "    return entry(x)\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+    def test_serving_is_clean_target(self):
+        """pint_tpu/serving/ itself lints clean under the host-call rule
+        (its one traced function — the serve kernel — touches only
+        jax/jnp) without pragmas or baseline entries."""
+        eng = Engine(rules=[HostCallInJitRule()], repo=REPO)
+        for rel in ("pint_tpu/serving/aotcache.py",
+                    "pint_tpu/serving/warmup.py",
+                    "pint_tpu/serving/batcher.py",
+                    "pint_tpu/serving/service.py"):
+            findings = eng.lint_file(os.path.join(REPO, rel))
+            assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_serving_in_typed_raise_targets(self, tmp_path):
+        """pint_tpu/serving/ is a typed-raise target: a planted bare
+        ValueError in a serving module fires, its UsageError twin does
+        not."""
+        from tools.jaxlint.rules.typed_raises import (
+            DEFAULT_TARGETS,
+            TypedRaiseRule,
+        )
+
+        assert "pint_tpu/serving/" in DEFAULT_TARGETS
+        d = tmp_path / "pint_tpu" / "serving"
+        d.mkdir(parents=True)
+        bad = d / "bad.py"
+        bad.write_text("def f():\n    raise ValueError('bare')\n")
+        good = d / "good.py"
+        good.write_text(
+            "from pint_tpu.exceptions import UsageError\n"
+            "def f():\n    raise UsageError('typed')\n")
+        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
+        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
+        assert eng.lint_file(str(good)) == []
+
     def test_runtime_plan_and_elastic_are_clean_targets(self):
         """runtime/plan.py + runtime/elastic.py are lint targets of the
         host-call rule (they orchestrate traced dispatches from host

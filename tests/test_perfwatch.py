@@ -317,3 +317,111 @@ class TestReportAndJson:
     def test_bad_args(self):
         with pytest.raises(SystemExit):
             main(["--check", "--threshold", "0"])
+
+
+def _warm(hits=2, cold=0, fps=900.0, p50=4.0, p99=6.0):
+    return {"warm": {"cache_hits": hits, "cold_compiles": cold,
+                     "warm_fits_per_s": fps, "p50_ms": p50,
+                     "p99_ms": p99, "steady_state_compiles": 0}}
+
+
+class TestWarmSeries:
+    """The round-8 warm{} block: ingestion + gating of the warm-serving
+    series (warm_fits_per_s gates drops, p99_ms gates rises) under the
+    same max(30%, 3xMAD) bar as the headline."""
+
+    def test_warm_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 8, 100.0,
+                    extra=_warm(hits=3, cold=1, fps=850.5))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.warm_fits_per_s == 850.5
+        assert r.warm_p50_ms == 4.0 and r.warm_p99_ms == 6.0
+        assert r.warm_cache_hits == 3 and r.warm_cold_compiles == 1
+        # and it survives the history document round trip
+        doc = build_history([r])
+        assert doc["runs"][0]["warm_fits_per_s"] == 850.5
+
+    def test_runs_without_warm_block_stay_valid(self, tmp_path):
+        """Pre-round-8 artifacts have no warm{}: ingestion leaves the
+        fields None and the gate skips the series (nothing to compare)."""
+        errors = []
+        r = ingest_file(_bench(str(tmp_path), 5, 100.0), errors)
+        assert not errors and r.usable
+        assert r.warm_fits_per_s is None and r.warm_p99_ms is None
+        d = str(tmp_path)
+        _bench(d, 6, 100.0, extra=_warm())
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_warm_fits_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([900.0, 920.0, 880.0], start=1):
+            _bench(d, i, 100.0, extra=_warm(fps=v))
+        _bench(d, 4, 100.0, extra=_warm(fps=500.0))  # 44% below median
+        assert main(["--check", "--dir", d]) == 1
+        assert "warm_fits_per_s" in capsys.readouterr().out
+
+    def test_warm_p99_rise_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_warm(p99=5.0))
+        _bench(d, 4, 100.0, extra=_warm(p99=12.0))  # 2.4x tail latency
+        assert main(["--check", "--dir", d]) == 1
+        assert "warm_p99_ms" in capsys.readouterr().out
+
+    def test_small_warm_changes_pass(self, tmp_path):
+        d = str(tmp_path)
+        for i, (v, p) in enumerate([(900.0, 5.0), (920.0, 5.2),
+                                    (880.0, 4.9)], start=1):
+            _bench(d, i, 100.0, extra=_warm(fps=v, p99=p))
+        _bench(d, 4, 100.0, extra=_warm(fps=860.0, p99=5.5))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_warm_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0, extra=_warm(fps=850.0))
+        assert main(["--dir", d]) == 0
+        out = capsys.readouterr().out
+        assert "warm: 850.0 fits/s" in out
+        assert "cache_hits=2" in out
+
+    def test_malformed_warm_block_ignored(self, tmp_path):
+        """A warm block with garbage types must not crash ingestion or
+        fabricate a gated number."""
+        errors = []
+        fn = _bench(str(tmp_path), 9, 100.0,
+                    extra={"warm": {"cache_hits": "many",
+                                    "warm_fits_per_s": True,
+                                    "p99_ms": None}})
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.warm_fits_per_s is None
+        assert r.warm_cache_hits is None and r.warm_p99_ms is None
+
+    def test_errored_warm_block_fails_when_history_had_warm(
+            self, tmp_path, capsys):
+        """A degraded warm{} (present but errored) on the newest run is
+        a total warm-serving regression when prior runs measured warm
+        serving — the missing-quantity skip must not swallow it."""
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_warm())
+        _bench(d, 3, 100.0, extra={"warm": {
+            "cache_hits": 0, "cold_compiles": 0, "warm_fits_per_s": None,
+            "p50_ms": None, "p99_ms": None, "steady_state_compiles": None,
+            "bucket": None, "chi2": None, "aot_cache": None,
+            "error": "ImportError: serving broken"}})
+        assert main(["--check", "--dir", d]) == 1
+        assert "warm block degraded" in capsys.readouterr().out
+
+    def test_errored_warm_block_clean_without_warm_history(self,
+                                                           tmp_path):
+        """Same degraded block with NO warm history (pre-round-8
+        series) stays clean — there was nothing to regress from."""
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0, extra={"warm": {
+            "warm_fits_per_s": None, "error": "ImportError: broken"}})
+        assert main(["--check", "--dir", d]) == 0

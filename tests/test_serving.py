@@ -1,0 +1,637 @@
+"""Warm-serving layer tests (PR 8).
+
+Pins the three load-bearing contracts of ``pint_tpu/serving``:
+
+* **padding exactness** — a fit served through the shape-bucketed
+  batcher on a padded (n_toas, n_free) bucket matches the
+  dedicated-shape fit to 1e-9 on CPU, including the masked-TOA chi2
+  (padding is exact by construction: zero-weight rows, block-diagonal
+  pad columns);
+* **AOT cache round trip** — export → cache-clear (process-equivalent)
+  → import → identical results, with ``compiles=0`` in the JAX
+  accounting on the warm path (the acceptance criterion);
+* **verified loads** — key mismatch, sidecar tamper, or blob corruption
+  degrades to a fresh compile with an ``aot_cache`` degrade event,
+  never a wrong executable.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serving
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from pint_tpu import config  # noqa: E402
+from pint_tpu.exceptions import UsageError  # noqa: E402
+from pint_tpu.serving import aotcache, batcher, service, warmup  # noqa: E402
+from pint_tpu.serving.batcher import (  # noqa: E402
+    FitRequest,
+    ShapeBatcher,
+    bucket_of,
+    pad_request,
+)
+
+TINY_GLS_PAR = """\
+PSR SERVETEST
+RAJ 04:37:15.0
+DECJ -47:15:09.0
+F0 173.6879 1
+F1 -1.7e-15 1
+PEPOCH 55000
+DM 2.64 1
+EFAC mjd 50000 60000 1.1
+ECORR mjd 50000 60000 0.5
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 3
+UNITS TDB
+"""
+
+
+@pytest.fixture
+def aot_dir(tmp_path):
+    """An enabled AOT cache rooted in tmp, torn down afterwards."""
+    d = str(tmp_path / "aot")
+    config.set_aot_cache_dir(d)
+    yield d
+    config.set_aot_cache_dir(None)
+    aotcache.reset_cache_singleton()
+
+
+@pytest.fixture
+def basic_telemetry():
+    from pint_tpu import telemetry
+
+    telemetry.activate("basic")
+    yield telemetry
+    telemetry.deactivate()
+
+
+@pytest.fixture(scope="module")
+def gls_fitter():
+    """A tiny correlated-noise fitter (red noise + ECORR) with a grid
+    executable recorded — the production executables warm_fitter warms."""
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.grid import grid_chisq
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    model = get_model([ln + "\n" for ln in TINY_GLS_PAR.splitlines()])
+    rng = np.random.default_rng(42)
+    toas = make_fake_toas_uniform(53400, 54800, 30, model, error_us=1.0,
+                                  add_noise=True, rng=rng)
+    f = GLSFitter(toas, model)
+    f.fit_toas(maxiter=1)
+    g0 = np.linspace(model.F0.value - 1e-9, model.F0.value + 1e-9, 2)
+    g1 = np.linspace(model.F1.value - 1e-17, model.F1.value + 1e-17, 2)
+    grid_chisq(f, ("F0", "F1"), (g0, g1), niter=1, chunk=4)
+    assert getattr(f, "last_grid_executable", None) is not None
+    return f
+
+
+def _random_request(rng, n=37, k=5, phiinv=None):
+    return FitRequest(
+        M=rng.normal(size=(n, k)), r=rng.normal(size=n),
+        w=np.full(n, 4.0),
+        phiinv=np.zeros(k) if phiinv is None else phiinv)
+
+
+# ---------------------------------------------------------------------------
+# config knob
+# ---------------------------------------------------------------------------
+
+class TestConfigKnob:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.setattr(config, "_aot_cache_dir", None)
+        assert config.aot_cache_dir() is None
+        assert not aotcache.enabled()
+
+    def test_round_trip_and_disable(self, tmp_path):
+        d = str(tmp_path / "cache")
+        config.set_aot_cache_dir(d)
+        try:
+            assert config.aot_cache_dir() == d
+            assert os.path.isdir(d)
+            assert aotcache.enabled()
+        finally:
+            config.set_aot_cache_dir(None)
+            aotcache.reset_cache_singleton()
+        assert config.aot_cache_dir() is None
+
+    def test_uncreatable_dir_is_typed_usage_error(self, tmp_path):
+        blocker = tmp_path / "file"
+        blocker.write_text("not a directory")
+        with pytest.raises(UsageError):
+            config.set_aot_cache_dir(str(blocker / "sub"))
+        assert config.aot_cache_dir() is None
+
+    def test_env_configured_bad_dir_raises_at_first_use(self, tmp_path,
+                                                        monkeypatch):
+        blocker = tmp_path / "file2"
+        blocker.write_text("x")
+        # simulate the env-var path: config holds the (unvalidated)
+        # string; the cache constructor raises the typed error
+        monkeypatch.setattr(config, "_aot_cache_dir",
+                            str(blocker / "sub"))
+        aotcache.reset_cache_singleton()
+        with pytest.raises(UsageError):
+            aotcache.cache()
+        aotcache.reset_cache_singleton()
+
+
+# ---------------------------------------------------------------------------
+# buckets + padding
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_rounds_up_the_ladder(self):
+        assert bucket_of(1, (64, 256)) == 64
+        assert bucket_of(64, (64, 256)) == 64
+        assert bucket_of(65, (64, 256)) == 256
+
+    def test_doubles_past_the_top(self):
+        assert bucket_of(257, (64, 256)) == 512
+        assert bucket_of(1025, (64, 256)) == 2048
+
+    def test_rejects_nonpositive(self):
+        with pytest.raises(UsageError):
+            bucket_of(0, (64,))
+
+    def test_request_shape_validation(self):
+        rng = np.random.default_rng(0)
+        with pytest.raises(UsageError):
+            FitRequest(M=rng.normal(size=(10, 3)), r=np.zeros(9),
+                       w=np.ones(10), phiinv=np.zeros(3))
+        with pytest.raises(UsageError):
+            pad_request(_random_request(rng, n=100, k=5), 64, 8)
+
+
+class TestPaddingExactness:
+    def test_padded_matches_dedicated_to_1e9(self):
+        """The pinned contract: same request through a padded bucket vs
+        its dedicated shape — steps, errors, AND the masked-TOA chi2
+        agree to 1e-9."""
+        rng = np.random.default_rng(7)
+        req = _random_request(rng, n=37, k=5,
+                              phiinv=np.full(5, 1e-3))
+        dedicated = ShapeBatcher(ntoa_buckets=(37,), nfree_buckets=(5,))
+        padded = ShapeBatcher(ntoa_buckets=(64,), nfree_buckets=(8,))
+        rd = dedicated.run([req])[0]
+        rp = padded.run([req])[0]
+        assert rd.bucket == (37, 5) and rp.bucket == (64, 8)
+        np.testing.assert_allclose(rp.dx, rd.dx, rtol=0, atol=1e-9)
+        np.testing.assert_allclose(rp.errors, rd.errors, rtol=0,
+                                   atol=1e-9)
+        assert abs(rp.chi2 - rd.chi2) < 1e-9
+        assert abs(rp.chi2_initial - rd.chi2_initial) < 1e-9
+
+    def test_solution_matches_numpy_oracle(self):
+        rng = np.random.default_rng(11)
+        req = _random_request(rng, n=50, k=4)
+        res = ShapeBatcher(ntoa_buckets=(64,),
+                           nfree_buckets=(8,)).run([req])[0]
+        W = np.diag(req.w)
+        A = req.M.T @ W @ req.M
+        dx0 = np.linalg.solve(A, req.M.T @ (req.w * req.r))
+        np.testing.assert_allclose(res.dx, dx0, rtol=1e-9)
+        err0 = np.sqrt(np.diag(np.linalg.inv(A)))
+        np.testing.assert_allclose(res.errors, err0, rtol=1e-8)
+        r_post = req.r - req.M @ dx0
+        assert abs(res.chi2 - float(req.w @ r_post**2)) < 1e-9
+
+    def test_masked_rows_cannot_leak_into_chi2(self):
+        """A padded bucket's extra TOA rows are weight-zero: serving the
+        same system at two different bucket heights gives the same
+        chi2 — the masked rows contribute exactly nothing."""
+        rng = np.random.default_rng(13)
+        req = _random_request(rng, n=20, k=3)
+        small = ShapeBatcher(ntoa_buckets=(32,),
+                             nfree_buckets=(4,)).run([req])[0]
+        big = ShapeBatcher(ntoa_buckets=(256,),
+                           nfree_buckets=(16,)).run([req])[0]
+        assert abs(small.chi2 - big.chi2) < 1e-9
+        np.testing.assert_allclose(small.dx, big.dx, rtol=0, atol=1e-9)
+
+    def test_real_fitter_request_padded_vs_dedicated(self, gls_fitter):
+        """A REAL correlated-noise fitter served through the batcher:
+        padded bucket == dedicated shape to 1e-9, and the step solves
+        the same augmented normal equations the GLS fitter does."""
+        from pint_tpu.gls_fitter import gls_normal_equations
+
+        req = FitRequest.from_fitter(gls_fitter)
+        n, k = req.n_toas, req.n_free
+        dedicated = ShapeBatcher(ntoa_buckets=(n,), nfree_buckets=(k,))
+        padded = ShapeBatcher(ntoa_buckets=(2 * n,),
+                              nfree_buckets=(2 * k,))
+        rd = dedicated.run([req])[0]
+        rp = padded.run([req])[0]
+        scale = np.maximum(np.abs(rd.dx), 1.0)
+        np.testing.assert_allclose(rp.dx / scale, rd.dx / scale,
+                                   rtol=0, atol=1e-9)
+        assert abs(rp.chi2 - rd.chi2) <= 1e-9 * max(1.0, abs(rd.chi2))
+        # oracle: the kernel solves (M^T C^-1 M + diag(phiinv)) x = b,
+        # i.e. exactly the fitter family's augmented normal equations
+        mtcm, mtcy = gls_normal_equations(req.M, req.r, Nvec=1.0 / req.w,
+                                          phiinv=req.phiinv)
+        x0 = np.linalg.solve(np.asarray(mtcm), np.asarray(mtcy))
+        np.testing.assert_allclose(rd.dx, x0, rtol=1e-7, atol=1e-12)
+
+
+class TestCoalescing:
+    def test_same_bucket_requests_share_one_batch(self):
+        rng = np.random.default_rng(3)
+        reqs = [_random_request(rng, n=30 + i, k=4) for i in range(3)]
+        b = ShapeBatcher(ntoa_buckets=(64,), nfree_buckets=(8,),
+                         batch_buckets=(1, 2, 4))
+        out = b.run(reqs)
+        assert [o.batch for o in out] == [4, 4, 4]
+        # order preserved and per-request answers correct
+        for req, res in zip(reqs, out):
+            A = req.M.T @ (req.w[:, None] * req.M)
+            dx0 = np.linalg.solve(A, req.M.T @ (req.w * req.r))
+            np.testing.assert_allclose(res.dx, dx0, rtol=1e-9)
+
+    def test_mixed_buckets_split_and_oversize_chunks(self):
+        rng = np.random.default_rng(5)
+        small = [_random_request(rng, n=20, k=3) for _ in range(5)]
+        big = [_random_request(rng, n=200, k=3)]
+        b = ShapeBatcher(ntoa_buckets=(32, 256), nfree_buckets=(4,),
+                         batch_buckets=(1, 2, 4))
+        out = b.run(small + big)
+        assert [o.bucket[0] for o in out] == [32] * 5 + [256]
+        # 5 small requests at a top rung of 4 split into 4 + 1
+        assert sorted(o.batch for o in out[:5]) == [1, 4, 4, 4, 4]
+
+    def test_request_id_round_trip(self):
+        rng = np.random.default_rng(9)
+        reqs = [_random_request(rng) for _ in range(2)]
+        reqs[0].request_id, reqs[1].request_id = "a", "b"
+        out = ShapeBatcher(ntoa_buckets=(64,),
+                           nfree_buckets=(8,)).run(reqs)
+        assert [o.request_id for o in out] == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# AOT cache
+# ---------------------------------------------------------------------------
+
+def _jitted_probe():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def probe(x, y):
+        return jnp.sin(x) @ y + 1.0
+
+    return probe
+
+
+class TestAOTCache:
+    def test_put_get_round_trip_identical(self, aot_dir):
+        import jax
+
+        probe = _jitted_probe()
+        x = np.asarray(np.random.default_rng(0).normal(size=(16, 16)))
+        y = np.ones(16)
+        cold = np.asarray(probe(x, y))
+        c = aotcache.cache()
+        assert c.put("probe", probe, (x, y), vkey=("v", 1)) is not None
+        loaded = c.get("probe", (x, y), vkey=("v", 1))
+        assert loaded is not None
+        np.testing.assert_array_equal(np.asarray(loaded.call(x, y)), cold)
+        assert c.stats.hits == 1 and c.stats.stores == 1
+
+    def test_vkey_mismatch_is_a_miss(self, aot_dir):
+        probe = _jitted_probe()
+        x, y = np.ones((4, 4)), np.ones(4)
+        c = aotcache.cache()
+        c.put("probe", probe, (x, y), vkey=("params", 1.0))
+        assert c.get("probe", (x, y), vkey=("params", 2.0)) is None
+        assert c.stats.misses == 1 and c.stats.degrades == 0
+
+    def test_shape_change_is_a_miss(self, aot_dir):
+        probe = _jitted_probe()
+        c = aotcache.cache()
+        c.put("probe", probe, (np.ones((4, 4)), np.ones(4)))
+        assert c.get("probe", (np.ones((8, 8)), np.ones(8))) is None
+
+    def test_corrupt_blob_degrades_never_serves(self, aot_dir):
+        probe = _jitted_probe()
+        x, y = np.ones((4, 4)), np.ones(4)
+        c = aotcache.cache()
+        c.put("probe", probe, (x, y))
+        blob = glob.glob(os.path.join(aot_dir, "exports",
+                                      "*.stablehlo"))[0]
+        with open(blob, "wb") as f:
+            f.write(b"not stablehlo")
+        assert c.get("probe", (x, y)) is None
+        assert c.stats.degrades == 1
+
+    def test_tampered_sidecar_degrades(self, aot_dir):
+        probe = _jitted_probe()
+        x, y = np.ones((4, 4)), np.ones(4)
+        c = aotcache.cache()
+        c.put("probe", probe, (x, y), vkey="k")
+        meta_path = glob.glob(os.path.join(aot_dir, "exports",
+                                           "*.json"))[0]
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+        meta["vkey"] = "'tampered'"
+        with open(meta_path, "w", encoding="utf-8") as f:
+            json.dump(meta, f)
+        assert c.get("probe", (x, y), vkey="k") is None
+        assert c.stats.degrades == 1
+
+    def test_fingerprint_keys_the_entry(self, aot_dir, monkeypatch):
+        """An entry stored under another device fingerprint must not
+        load here (the r03 cross-microarchitecture replay hazard)."""
+        probe = _jitted_probe()
+        x, y = np.ones((4, 4)), np.ones(4)
+        c = aotcache.cache()
+        real_fp = aotcache.device_fingerprint()
+        other = dict(real_fp, device_kind="TPU v5e", platform="tpu")
+        monkeypatch.setattr(aotcache, "device_fingerprint", lambda: other)
+        c.put("probe", probe, (x, y))
+        monkeypatch.setattr(aotcache, "device_fingerprint",
+                            lambda: real_fp)
+        assert c.get("probe", (x, y)) is None
+        assert c.stats.misses == 1
+
+    def test_degrade_emits_reasoned_event(self, aot_dir,
+                                          basic_telemetry):
+        from pint_tpu.telemetry import spans
+
+        probe = _jitted_probe()
+        x, y = np.ones((4, 4)), np.ones(4)
+        c = aotcache.cache()
+        c.put("probe", probe, (x, y))
+        blob = glob.glob(os.path.join(aot_dir, "exports",
+                                      "*.stablehlo"))[0]
+        with open(blob, "wb") as f:
+            f.write(b"junk")
+        captured = []
+        with basic_telemetry.span("t"):
+            sp = spans.current_span()
+            c.get("probe", (x, y))
+            captured = [e for e in sp.events
+                        if e["name"] == "aot_cache"]
+        assert captured, "degrade must emit an aot_cache event"
+        ev = captured[-1]
+        assert ev["action"] == "degrade"
+        assert ev["executable"] == "probe"
+        assert "reason" in ev and ev["reason"]
+
+
+# ---------------------------------------------------------------------------
+# warm pool + the acceptance pin
+# ---------------------------------------------------------------------------
+
+def _run_entries(pool):
+    """Execute every warmed handle at its stored args and collect the
+    flat output leaves per executable name."""
+    import jax
+
+    out = {}
+    for entry in pool.entries():
+        args = pool._entry_args[entry.name]
+        res = entry(*args)
+        out[entry.name] = [np.asarray(x)
+                           for x in jax.tree_util.tree_leaves(res)]
+    return out
+
+
+class TestWarmPathAcceptance:
+    def test_cache_round_trip_compiles_zero_identical(self, gls_fitter,
+                                                      aot_dir,
+                                                      basic_telemetry):
+        """The PR's acceptance criterion: populate the AOT cache with
+        the fit-step + GLS-solve + grid-chunk executables, simulate a
+        new process (jax cache clear + a fresh pool), re-warm from the
+        cache, and demonstrate compiles=0 in the JAX accounting with
+        results identical to the cold run."""
+        import jax
+
+        from pint_tpu.telemetry import jaxevents
+
+        c = aotcache.cache()
+        pool, report = warmup.warm_fitter(gls_fitter)
+        names = {e.name for e in pool.entries()}
+        assert {"fit.eval", "fit.jac", "gls.solve",
+                "grid.chunk"} <= names
+        assert report.cold_compiles == len(report.entries)
+        assert c.stats.stores >= 4
+
+        # keep the dispatch args for replay (the pool keys by shape;
+        # stash per-name args on the pool for the comparison below)
+        handles = dict(
+            list(gls_fitter.fit_step_executables().items())
+            + [("gls.solve", gls_fitter.gls_solve_executable()),
+               ("grid.chunk", gls_fitter.last_grid_executable)])
+        pool._entry_args = {name: args
+                            for name, (fn, args) in handles.items()}
+        cold = _run_entries(pool)
+
+        # --- process-equivalent warm start -----------------------------
+        jax.clear_caches()
+        pool2, report2 = warmup.warm_fitter(gls_fitter)
+        assert report2.cache_hits == len(report2.entries), \
+            f"expected all-hit warm start, got {report2.to_dict()}"
+        assert report2.cold_compiles == 0
+        pool2._entry_args = pool._entry_args
+
+        before = jaxevents.counts()
+        warm = _run_entries(pool2)
+        delta = jaxevents.counts() - before
+        assert delta.compiles == 0, \
+            "steady-state execution must pay zero fresh XLA compiles"
+        for name, cold_leaves in cold.items():
+            for a, b in zip(cold_leaves, warm[name]):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"{name} warm != cold")
+
+    def test_miss_then_hit_provenance(self, aot_dir):
+        pool, rep = warmup.warm_buckets([(2, 32, 4)])
+        assert rep.to_dict()["cold_compiles"] == 1
+        pool2, rep2 = warmup.warm_buckets([(2, 32, 4)])
+        assert rep2.to_dict()["cache_hits"] == 1
+        assert rep2.to_dict()["cold_compiles"] == 0
+
+    def test_pool_without_cache_still_warms(self):
+        pool = warmup.WarmPool(cache=None)
+        assert pool.cache is None  # aot dir not configured
+        _, rep = warmup.warm_buckets([(1, 32, 4)], pool=pool)
+        assert rep.cold_compiles == 1
+        name = "serve.fit[1x32x4]"
+        args = (np.zeros((1, 32, 4)), np.zeros((1, 32)),
+                np.zeros((1, 32)), np.zeros((1, 4)), np.ones((1, 4)))
+        assert pool.lookup(name, args) is not None
+
+
+# ---------------------------------------------------------------------------
+# service front door
+# ---------------------------------------------------------------------------
+
+class TestService:
+    def _cfg(self):
+        return service.ServeConfig(ntoa_buckets=(64,), nfree_buckets=(8,),
+                                   batch_buckets=(1, 2, 4))
+
+    def test_sync_serve_records_latency_and_zero_steady_compiles(
+            self, basic_telemetry):
+        from pint_tpu.telemetry import jaxevents
+
+        rng = np.random.default_rng(1)
+        reqs = [_random_request(rng) for _ in range(3)]
+        svc = service.TimingService(self._cfg())
+        svc.warm([(4, 64, 8)])
+        before = jaxevents.counts()
+        out = svc.serve(reqs)
+        delta = jaxevents.counts() - before
+        assert delta.compiles == 0
+        assert all(o.compiles == 0 for o in out)
+        summary = svc.latency_summary()
+        assert summary["n"] == 3
+        assert summary["p99_ms"] >= summary["p50_ms"] > 0
+        assert svc.served == 3
+
+    def test_serve_request_events_validate_against_the_schema(
+            self, tmp_path):
+        """Full-mode serving writes serve_request/aot_cache records the
+        telemetry_report validator accepts."""
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import runlog
+        from tools.telemetry_report import validate_run_dir
+
+        rng = np.random.default_rng(2)
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            runlog.start_run(run_dir, name="serving-test",
+                             probe_device=False)
+            svc = service.TimingService(self._cfg())
+            svc.serve([_random_request(rng) for _ in range(2)])
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+        errors = []
+        n = validate_run_dir(run_dir, errors)
+        assert not errors, errors
+        recs = [json.loads(ln) for ln in
+                open(os.path.join(run_dir, "events.jsonl"))]
+        served = [r for r in recs if r.get("type") == "event"
+                  and r["event"]["name"] == "serve_request"]
+        assert len(served) == 2
+        attrs = served[0]["event"]["attrs"]
+        assert attrs["bucket_ntoas"] == 64
+        assert attrs["bucket_nfree"] == 8
+        assert attrs["batch"] == 2
+        assert attrs["latency_ms"] >= 0
+
+    def test_async_door_coalesces(self):
+        import asyncio
+
+        rng = np.random.default_rng(4)
+        reqs = [_random_request(rng) for _ in range(3)]
+        svc = service.TimingService(self._cfg())
+        svc.warm([(4, 64, 8)])
+
+        async def go():
+            return await asyncio.gather(*[svc.submit(q) for q in reqs])
+
+        out = asyncio.run(go())
+        assert [o.batch for o in out] == [4, 4, 4]
+        assert svc.served == 3
+        for req, res in zip(reqs, out):
+            A = req.M.T @ (req.w[:, None] * req.M)
+            dx0 = np.linalg.solve(A, req.M.T @ (req.w * req.r))
+            np.testing.assert_allclose(res.dx, dx0, rtol=1e-9)
+
+    def test_async_queue_bound(self):
+        import asyncio
+
+        rng = np.random.default_rng(6)
+        cfg = service.ServeConfig(ntoa_buckets=(64,), nfree_buckets=(8,),
+                                  batch_buckets=(1,), max_queue=1)
+        svc = service.TimingService(cfg)
+
+        async def go():
+            t1 = asyncio.ensure_future(svc.submit(_random_request(rng)))
+            await asyncio.sleep(0)  # let the first request enqueue
+            with pytest.raises(UsageError):
+                await svc.submit(_random_request(rng))
+            return await t1
+
+        res = asyncio.run(go())
+        assert res.chi2 >= 0
+
+    def test_config_validation(self):
+        with pytest.raises(UsageError):
+            service.TimingService(service.ServeConfig(window_ms=-1))
+        with pytest.raises(UsageError):
+            service.TimingService(service.ServeConfig(max_queue=0))
+
+
+# ---------------------------------------------------------------------------
+# event-schema rejection (the --check contract)
+# ---------------------------------------------------------------------------
+
+class TestServingEventValidation:
+    def _validate(self, tmp_path, **attrs):
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import runlog
+        from tools.telemetry_report import validate_run_dir
+
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            run = runlog.start_run(run_dir, name="bad-events",
+                                   probe_device=False)
+            run.record_event(attrs.pop("_name"), **attrs)
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+        errors = []
+        validate_run_dir(run_dir, errors)
+        return errors
+
+    def test_valid_aot_cache_event_passes(self, tmp_path):
+        assert not self._validate(
+            tmp_path, _name="aot_cache", action="hit",
+            executable="fit.eval", key="abc", elapsed_ms=0.5)
+
+    def test_unknown_action_rejected(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="aot_cache", action="explode",
+            executable="fit.eval", key="abc")
+        assert any("action" in e for e in errors)
+
+    def test_degrade_without_reason_rejected(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="aot_cache", action="degrade",
+            executable="fit.eval", key="abc")
+        assert any("reason" in e for e in errors)
+
+    def test_missing_attr_rejected(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="aot_cache", action="hit", key="abc")
+        assert any("executable" in e for e in errors)
+
+    def test_negative_latency_rejected(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="serve_request", bucket_ntoas=64,
+            bucket_nfree=8, batch=2, latency_ms=-1.0, compiles=0)
+        assert any("latency_ms" in e for e in errors)
+
+    def test_zero_batch_rejected(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="serve_request", bucket_ntoas=64,
+            bucket_nfree=8, batch=0, latency_ms=1.0, compiles=0)
+        assert any("batch" in e for e in errors)

@@ -156,6 +156,18 @@ def walk_own(fn_node: ast.AST) -> Iterable[ast.AST]:
 _TELEMETRY_SUBMODULES = {"spans", "metrics", "jaxevents", "runlog", "costs",
                          "distview"}
 
+#: pint_tpu.serving submodules are host-side the same way (filesystem
+#: cache I/O, export serialization, asyncio, metrics): an aotcache
+#: get/put or a pool warm inside a traced function would run per TRACE
+#: (and hang the compile on cache I/O), so their calls are policed by
+#: the same host-call-in-jit machinery as the telemetry modules
+_SERVING_SUBMODULES = {"aotcache", "warmup", "batcher", "service"}
+
+#: one table drives the ImportFrom tracking for every host-side
+#: package (the next PR's package is one row, not a copied branch)
+_HOST_PACKAGES = (("pint_tpu.telemetry", _TELEMETRY_SUBMODULES),
+                  ("pint_tpu.serving", _SERVING_SUBMODULES))
+
 
 def _record_imports(info: FileInfo) -> None:
     for node in ast.walk(info.tree):
@@ -164,7 +176,8 @@ def _record_imports(info: FileInfo) -> None:
                 bound = a.asname or a.name.split(".")[0]
                 if a.name == "numpy":
                     info.np_aliases.add(bound)
-                elif a.name.startswith("pint_tpu.telemetry") and a.asname:
+                elif a.name.startswith(tuple(
+                        pkg for pkg, _ in _HOST_PACKAGES)) and a.asname:
                     # `import pint_tpu.telemetry` without asname binds
                     # `pint_tpu`; dotted calls through it are rare enough
                     # to leave to the alias-less case
@@ -181,14 +194,16 @@ def _record_imports(info: FileInfo) -> None:
         elif isinstance(node, ast.ImportFrom):
             if node.module == "pint_tpu":
                 for a in node.names:
-                    if a.name == "telemetry":
+                    if a.name in {pkg.rsplit(".", 1)[1]
+                                  for pkg, _ in _HOST_PACKAGES}:
                         info.telemetry_aliases.add(a.asname or a.name)
-            elif node.module is not None \
-                    and node.module.startswith("pint_tpu.telemetry"):
+            elif node.module is not None and any(
+                    node.module.startswith(pkg)
+                    for pkg, _ in _HOST_PACKAGES):
                 for a in node.names:
                     bound = a.asname or a.name
-                    if node.module == "pint_tpu.telemetry" \
-                            and a.name in _TELEMETRY_SUBMODULES:
+                    if any(node.module == pkg and a.name in subs
+                           for pkg, subs in _HOST_PACKAGES):
                         info.telemetry_aliases.add(bound)
                     else:
                         info.telemetry_names.add(bound)

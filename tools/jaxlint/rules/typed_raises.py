@@ -44,6 +44,7 @@ DEFAULT_TARGETS = (
     "pint_tpu/integrity/",
     "pint_tpu/runtime/",
     "pint_tpu/telemetry/",
+    "pint_tpu/serving/",
 )
 
 DISALLOWED = {

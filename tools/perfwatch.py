@@ -77,6 +77,16 @@ class RunRecord:
     hbm_peak_bytes: Optional[int] = None
     #: from the cost{...} block (round 6+)
     cost: Optional[dict] = None
+    #: from the warm{...} block (round 8+: warm-serving layer)
+    warm_fits_per_s: Optional[float] = None
+    warm_p50_ms: Optional[float] = None
+    warm_p99_ms: Optional[float] = None
+    warm_cache_hits: Optional[int] = None
+    warm_cold_compiles: Optional[int] = None
+    #: the bench's warm block degraded (present but errored): the run
+    #: carries no warm numbers to trend, but a history that HAD them
+    #: must treat this as a regression, not a silent skip
+    warm_error: Optional[str] = None
     #: multichip extras
     n_devices: Optional[int] = None
     multichip_ok: Optional[bool] = None
@@ -143,6 +153,21 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
         peak = mem.get("peak_bytes_in_use", mem.get("live_buffer_bytes"))
         if isinstance(peak, (int, float)):
             rec.hbm_peak_bytes = int(peak)
+    warm = h.get("warm")
+    if isinstance(warm, dict):
+        for src, dst in (("warm_fits_per_s", "warm_fits_per_s"),
+                         ("p50_ms", "warm_p50_ms"),
+                         ("p99_ms", "warm_p99_ms")):
+            if isinstance(warm.get(src), (int, float)) \
+                    and not isinstance(warm.get(src), bool):
+                setattr(rec, dst, float(warm[src]))
+        for src, dst in (("cache_hits", "warm_cache_hits"),
+                         ("cold_compiles", "warm_cold_compiles")):
+            if isinstance(warm.get(src), int) \
+                    and not isinstance(warm.get(src), bool):
+                setattr(rec, dst, int(warm[src]))
+        if isinstance(warm.get("error"), str) and warm["error"]:
+            rec.warm_error = warm["error"]
     # a zero-valued errored run (the bench's error-emit contract) is a
     # failed measurement, not a 100% regression
     if rec.error is not None and not rec.value:
@@ -315,8 +340,14 @@ def check_series(runs: List[RunRecord], threshold: float,
                  noise_mult: float) -> List[Verdict]:
     """Gate the newest run of one series against its predecessors."""
     verdicts = []
+    # sign +1: lower-is-worse (throughputs); -1: higher-is-worse
+    # (compile time, tail latency).  The warm-serving series gate the
+    # same way the headline does: a PR cannot silently halve warm-start
+    # fits/s or double the p99.
     quantities = (("fits_per_sec", lambda r: r.value, +1),
-                  ("compile_s", lambda r: r.compile_s, -1))
+                  ("compile_s", lambda r: r.compile_s, -1),
+                  ("warm_fits_per_s", lambda r: r.warm_fits_per_s, +1),
+                  ("warm_p99_ms", lambda r: r.warm_p99_ms, -1))
     for name, get, sign in quantities:
         # gate the series' NEWEST run only: when it lacks this quantity
         # there is nothing to compare — re-gating an older run and
@@ -341,6 +372,21 @@ def check_series(runs: List[RunRecord], threshold: float,
                    f"of {len(prev)} prior run(s); "
                    f"change {100 * rel:+.1f}% (bar {100 * bar:.1f}%, "
                    f"noise floor {100 * noise_mult * scatter:.1f}%)"))
+    # an ERRORED warm block on the newest run is a total warm-serving
+    # regression when the series used to carry warm numbers — the
+    # missing-quantity skip above must not swallow it (an artifact
+    # without a warm key at all is a pre-round-8 round and stays clean)
+    latest_rec = runs[-1]
+    if latest_rec.warm_error is not None \
+            and any(r.warm_fits_per_s is not None for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="warm_serving", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: warm block degraded "
+                   f"({latest_rec.warm_error}) where prior runs "
+                   "measured warm serving"))
     return verdicts
 
 
@@ -395,6 +441,13 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   file=out)
             prev = r.value
         latest = runs[-1]
+        if latest.warm_fits_per_s is not None \
+                or latest.warm_p99_ms is not None:
+            print(f"  warm: {latest.warm_fits_per_s} fits/s, "
+                  f"p50 {latest.warm_p50_ms} ms, "
+                  f"p99 {latest.warm_p99_ms} ms, "
+                  f"cache_hits={latest.warm_cache_hits} "
+                  f"cold_compiles={latest.warm_cold_compiles}", file=out)
         if latest.cost:
             c = latest.cost
             print(f"  cost[{c.get('name', '?')}]: "

@@ -89,6 +89,64 @@ ELASTIC_EVENT_ATTRS = {
 
 _PLAN_KINDS = ("pjit", "shard_map", "single")
 
+#: warm-serving lifecycle events (pint_tpu/serving): attr name ->
+#: required type(s).  Same contract style as the elastic events — a
+#: drift in the aotcache/service producers fails --check before it
+#: corrupts the serving series perfwatch trends.
+SERVING_EVENT_ATTRS = {
+    "aot_cache": {"action": str, "executable": str, "key": str},
+    "serve_request": {"bucket_ntoas": int, "bucket_nfree": int,
+                      "batch": int, "latency_ms": (int, float),
+                      "compiles": int},
+}
+
+_AOT_ACTIONS = ("hit", "miss", "store", "degrade")
+
+
+def validate_serving_event(ev: dict, where: str,
+                           errors: List[str]) -> None:
+    """Attr contract for aot_cache / serve_request records: required
+    attrs typed, action in the hit/miss/store/degrade enum, a degrade
+    carries its reason, latency fields are non-negative numbers."""
+    name = ev.get("name")
+    required = SERVING_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    if name == "aot_cache":
+        if attrs.get("action") not in _AOT_ACTIONS:
+            _err(errors, where, f"aot_cache action {attrs.get('action')!r} "
+                                f"not in {_AOT_ACTIONS}")
+        if attrs.get("action") == "degrade" and not (
+                isinstance(attrs.get("reason"), str) and attrs["reason"]):
+            _err(errors, where,
+                 "aot_cache degrade must carry a non-empty 'reason'")
+        ms = attrs.get("elapsed_ms")
+        if ms is not None and (not isinstance(ms, (int, float))
+                               or isinstance(ms, bool) or ms < 0):
+            _err(errors, where,
+                 f"aot_cache 'elapsed_ms' is {ms!r}, not a non-negative "
+                 "number")
+    elif name == "serve_request":
+        lat = attrs.get("latency_ms")
+        if isinstance(lat, (int, float)) and not isinstance(lat, bool) \
+                and lat < 0:
+            _err(errors, where,
+                 f"serve_request 'latency_ms' is negative ({lat!r})")
+        b = attrs.get("batch")
+        if isinstance(b, int) and not isinstance(b, bool) and b < 1:
+            _err(errors, where,
+                 f"serve_request 'batch' is {b!r}, must be >= 1")
+
 
 def validate_elastic_event(ev: dict, where: str,
                            errors: List[str]) -> None:
@@ -376,6 +434,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     _err(errors, where, f"event body malformed: {ev!r}")
                 else:
                     validate_elastic_event(ev, where, errors)
+                    validate_serving_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -607,14 +666,27 @@ def self_test(errors: List[str]) -> int:
                          reason="canary_mismatch", chunk=2)
         run.record_event("mesh_degraded", from_rung=8, to_rung=4,
                          reason="device_loss", chunk=2, n_remaining=7)
+        # warm-serving producer drift check: the aotcache/service event
+        # contract (SERVING_EVENT_ATTRS) through the loose-event path —
+        # hit, the mandatory-reason degrade, and one served request
+        run.record_event("aot_cache", action="hit", executable="fit.eval",
+                         key="abc123def456", elapsed_ms=1.25)
+        run.record_event("aot_cache", action="degrade",
+                         executable="grid.chunk", key="abc123def456",
+                         reason="load: deserialize failed",
+                         elapsed_ms=0.5)
+        run.record_event("serve_request", bucket_ntoas=4096,
+                         bucket_nfree=128, batch=4, latency_ms=3.2,
+                         compiles=0, n_toas=4005, n_free=91)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
-        # sharding_plan, 3x elastic events, metrics, run_end
-        if n < 13:
-            _err(errors, "selftest", f"expected >= 13 records, got {n}")
+        # sharding_plan, 3x elastic events, 3x serving events, metrics,
+        # run_end
+        if n < 16:
+            _err(errors, "selftest", f"expected >= 16 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
