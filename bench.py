@@ -234,6 +234,23 @@ def bench_b1855_gls():
                 "error": f"{type(e).__name__}: {e}"}
     st.mark("warm-serving measurement")
 
+    # cost-model autotune measurement (ROADMAP item 5): rank a small
+    # chunk-candidate set by AOT cost analysis, measure-confirm the
+    # winner AND the headline's static chunk on this same grid, and
+    # stamp tuned fits/s + the tuned/static ratio perfwatch gates.
+    # Never fatal: a broken tuner degrades to an errored-but-present
+    # tuned block (the warm{} discipline).
+    try:
+        tuned = tuned_block(f, g_m2, g_sini, niter=niter,
+                            static_chunk=chunk)
+    except Exception as e:
+        tuned = {"chunk": None, "static_chunk": chunk,
+                 "tuned_fits_per_s": None, "static_fits_per_s": None,
+                 "tuned_vs_static": None, "basis": None,
+                 "decisions": None,
+                 "error": f"{type(e).__name__}: {e}"}
+    st.mark("autotune measurement")
+
     imin = np.unravel_index(np.argmin(chi2), chi2.shape)
     # convergence-grade sanity, not just order-of-magnitude: the measured
     # grid-min-vs-fit gap is ~0.02 chi2 units (pure grid discretization);
@@ -257,6 +274,7 @@ def bench_b1855_gls():
         "stages": st,
         "cost": cost,
         "warm": warm,
+        "tuned": tuned,
     }
 
 
@@ -335,6 +353,68 @@ def warm_serving_block(f):
         "bucket": [WARM_SERVE_REQUESTS, bn, bk],
         "chi2": round(float(results[0].chi2), 3),
         "aot_cache": cache.stats.to_dict() if cache is not None else None,
+    }
+
+
+#: chunk candidates the bench's tuned block cost-ranks: the static
+#: per-backend default and the headline's hand-picked chunk always
+#: participate; one extra rung below keeps the ranking honest without
+#: paying a long ladder of AOT analysis compiles inside the bench
+TUNED_EXTRA_CHUNKS = (64,)
+
+
+def tuned_block(f, g_m2, g_sini, niter, static_chunk):
+    """The headline's ``tuned{}`` block: run the cost-model autotuner's
+    chunk search on the measured grid (cost-rank a small candidate set,
+    measure-confirm the top candidate and the static chunk) and report
+    tuned fits/s next to the static number.
+
+    ``tuned_vs_static`` >= 1.0 is structural: the static chunk is
+    always in the measured-confirmation set and the winner is the
+    measured argmax, so the tuned configuration can tie the static one
+    but never lose to it — perfwatch still gates the ratio so a broken
+    search cannot ship a slower decision silently.  The decision
+    persists into the tuning manifest when ``PINT_TPU_TUNE_DIR`` is
+    configured (the cross-process ``chunk="auto"`` source)."""
+    from pint_tpu import autotune
+    from pint_tpu.grid import default_gls_chunk
+
+    pts = np.stack([g.ravel() for g in
+                    np.meshgrid(g_m2, g_sini, indexing="ij")], axis=-1)
+    chunks = sorted({default_gls_chunk(), int(static_chunk),
+                     *TUNED_EXTRA_CHUNKS})
+    manifest = autotune.manifest()  # None when tuning is unconfigured
+    dec = autotune.tune_grid_chunk(
+        f, ("M2", "SINI"), pts, chunks=chunks, niter=niter, top_k=1,
+        static=int(static_chunk), tuning_manifest=manifest)
+    measured = {int(k): v for k, v in dec.measured.items()
+                if isinstance(v, (int, float))}
+    tuned_fps = measured.get(int(dec.value))
+    static_fps = measured.get(int(static_chunk))
+    if tuned_fps is None or not static_fps:
+        # the never-slower gate needs BOTH numbers: a block without
+        # the ratio must be a loud degraded block (perfwatch fails it
+        # when prior rounds measured tuning), never a silent skip
+        raise RuntimeError(
+            f"measured confirmation incomplete: tuned chunk "
+            f"{dec.value} -> {tuned_fps}, static chunk {static_chunk} "
+            f"-> {static_fps} (confirmed: {sorted(measured)})")
+    ratio = tuned_fps / static_fps
+    decisions = manifest.digest() if manifest is not None else None
+    if decisions is None:
+        import hashlib
+
+        decisions = hashlib.sha256(json.dumps(
+            dec.to_dict(), sort_keys=True, default=str
+        ).encode()).hexdigest()[:12]
+    return {
+        "chunk": int(dec.value),
+        "static_chunk": int(static_chunk),
+        "tuned_fits_per_s": round(tuned_fps, 3),
+        "static_fits_per_s": round(static_fps, 3),
+        "tuned_vs_static": round(ratio, 4),
+        "basis": dec.basis,
+        "decisions": decisions,
     }
 
 
@@ -624,6 +704,11 @@ def main():
         # throughput/latency of the shape-bucketed batcher (perfwatch
         # gates warm_fits_per_s drops and p99_ms rises)
         "warm": r["warm"],
+        # cost-model autotuner: tuned chunk, tuned fits/s, tuned/static
+        # ratio and the decisions fingerprint (perfwatch gates the
+        # ratio — a tuned configuration may tie the static default but
+        # never ship slower)
+        "tuned": r["tuned"],
     }
     if not platform_ok:
         out["platform_mismatch"] = True

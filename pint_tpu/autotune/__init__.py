@@ -1,0 +1,254 @@
+"""Cost-model-driven autotuning of the hot path (ROADMAP item 5).
+
+Three layers:
+
+* :mod:`~pint_tpu.autotune.search` — enumerate candidate
+  configurations (GLS grid chunk, solve-ladder entry rung, mesh axis
+  order, serving bucket ladders, dd-split-guarded reduced-precision
+  segments), rank them by AOT :class:`~pint_tpu.telemetry.costs.
+  CostProfile` analysis (paused accounting, no execution), confirm the
+  top-k with short measured runs or an ingested ``tools/tpu_sweep.py``
+  artifact;
+* :mod:`~pint_tpu.autotune.manifest` — persist winning decisions
+  keyed by workload vkey + device fingerprint (the AOT cache's
+  scheme), so tuned values survive the process;
+* **this module** — the resolve layer the consumers call:
+  ``grid_chisq(chunk="auto")``, :class:`~pint_tpu.gls_fitter.
+  GLSFitter`, :func:`~pint_tpu.runtime.plan.select_plan`, and
+  :class:`~pint_tpu.serving.service.TimingService` ask
+  :func:`resolve` for their tuned value and get the static default —
+  with a reasoned ``tune_fallback`` telemetry event — on any
+  cache/fingerprint miss.  A verified hit emits ``tune_applied``.
+
+Everything here is host-side decision plumbing — calling it from
+traced code is flagged by jaxlint's host-call-in-jit rule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from pint_tpu import config
+from pint_tpu.autotune.manifest import (
+    TuningDecision,
+    TuningManifest,
+    decision_key,
+    manifest,
+    reset_manifest_singleton,
+)
+from pint_tpu.autotune.records import (
+    AUTOTUNE_SCHEMA,
+    TUNE_MANIFEST_SCHEMA,
+    decision_record,
+    sweep_record,
+)
+from pint_tpu.autotune.search import (
+    Candidate,
+    autotune_workload,
+    chunk_ladder,
+    confirm_measured,
+    measured_from_sweep,
+    rank_grid_chunks,
+    tune_bucket_ladders,
+    tune_grid_chunk,
+    tune_plan_axes,
+    tune_precision,
+    tune_solve_rung,
+)
+
+__all__ = ["AUTOTUNE_SCHEMA", "TUNE_MANIFEST_SCHEMA", "Candidate",
+           "TuningDecision", "TuningManifest", "manifest",
+           "reset_manifest_singleton", "sweep_record", "decision_record",
+           "chunk_ladder", "rank_grid_chunks", "confirm_measured",
+           "measured_from_sweep", "tune_grid_chunk", "tune_solve_rung",
+           "tune_plan_axes", "tune_bucket_ladders", "tune_precision",
+           "autotune_workload", "resolve", "resolve_grid_chunk",
+           "resolve_solve_ladder", "resolve_plan_axes",
+           "resolve_serve_buckets", "resolve_correction_dtype",
+           "grid_chunk_vkey", "solve_rung_vkey", "plan_axes_vkey",
+           "serve_buckets_vkey", "correction_dtype_vkey"]
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Tuning-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter (span event +
+    full-mode runlog record; schema validated by
+    ``tools/telemetry_report --check``)."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# workload version keys (process-stable; repr'd into the manifest key)
+# ---------------------------------------------------------------------------
+
+def grid_chunk_vkey(model, toas) -> tuple:
+    """The chunk optimum is a property of the executable's SHAPE (TOA
+    count, free-parameter count, noise structure), not of parameter
+    values — a refit must not invalidate it, a re-ingested dataset at a
+    different TOA count must."""
+    gls = bool(model.noise_basis_by_component(toas)[0])
+    return ("grid.chunk", len(toas), len(model.free_params), int(gls))
+
+
+def solve_rung_vkey(ftr) -> tuple:
+    """The entry rung skips rungs *measured to fail*, which depends on
+    the actual Gram — so the key carries the full parameter/mask
+    signature (the grid bundle's invalidation discipline): any
+    parameter edit falls back to the full ladder."""
+    from pint_tpu.grid import _model_param_sig
+
+    return ("gls.solve_rung", _model_param_sig(ftr.model),
+            getattr(ftr.toas, "_version", 0), len(ftr.toas))
+
+
+def plan_axes_vkey(workload: str) -> tuple:
+    return ("plan.axes", str(workload))
+
+
+def serve_buckets_vkey() -> tuple:
+    #: the serve kernel's own schema version — bucket ladders describe
+    #: the deployment's request population, not one fitter
+    return ("serve.buckets", 1)
+
+
+def correction_dtype_vkey(model, toas) -> tuple:
+    """Like the solve rung, the precision margin depends on the actual
+    noise Gram and residual scale: full signature, conservative."""
+    from pint_tpu.grid import _model_param_sig
+
+    return ("grid.correction_dtype", _model_param_sig(model),
+            getattr(toas, "_version", 0), len(toas))
+
+
+# ---------------------------------------------------------------------------
+# the resolve layer
+# ---------------------------------------------------------------------------
+
+def resolve(name: str, vkey: Any, default: Any,
+            requested: bool = True) -> Tuple[Any, str]:
+    """(value, source) for one tunable: the manifest's verified tuned
+    value (source ``"tuned"``, ``tune_applied`` event) or the static
+    ``default`` (source ``"static"``).
+
+    ``requested=True`` (an explicit ``chunk="auto"`` — the caller asked
+    for tuning) emits a reasoned ``tune_fallback`` event on every
+    degrade path, including "no manifest configured".
+    ``requested=False`` (an implicit consult on a path that merely
+    *supports* tuning) stays silent when tuning is simply off — only a
+    configured-but-missed lookup is worth an event."""
+    m = None
+    if config.tune_dir() is not None:
+        try:
+            m = manifest()
+        except Exception as e:
+            # a configured-but-unusable manifest is always event-worthy
+            _emit_event("tune_fallback", decision=str(name),
+                        reason=f"manifest unusable: "
+                               f"{type(e).__name__}: {e}",
+                        static=repr(default))
+            return default, "static"
+    if m is None:
+        if requested:
+            _emit_event("tune_fallback", decision=str(name),
+                        reason="no tuning manifest configured "
+                               "(PINT_TPU_TUNE_DIR / set_tune_dir)",
+                        static=repr(default))
+        return default, "static"
+    try:
+        body, reason = m.lookup(name, vkey)
+        if body is None:
+            _emit_event("tune_fallback", decision=str(name),
+                        reason=str(reason), static=repr(default))
+            return default, "static"
+        _, digest = decision_key(name, vkey, m.fingerprint())
+    except Exception as e:  # resolution sits ON the fit path: degrade
+        _emit_event("tune_fallback", decision=str(name),
+                    reason=f"lookup failed: {type(e).__name__}: {e}",
+                    static=repr(default))
+        return default, "static"
+    _emit_event("tune_applied", decision=str(name),
+                value=repr(body["value"]), key=digest[:12],
+                basis=str(body.get("basis", "?")))
+    return body["value"], "tuned"
+
+
+def resolve_grid_chunk(model, toas) -> int:
+    """The tuned GLS grid chunk for this workload shape, or the static
+    backend default (``grid_chisq(chunk="auto")``'s resolution)."""
+    from pint_tpu.exceptions import UsageError
+    from pint_tpu.grid import default_gls_chunk
+
+    value, source = resolve("grid.chunk", grid_chunk_vkey(model, toas),
+                            default_gls_chunk(), requested=True)
+    if source == "tuned" and (not isinstance(value, int)
+                              or isinstance(value, bool) or value <= 0):
+        raise UsageError(
+            f"tuned grid chunk is {value!r}, not a positive integer — "
+            "the manifest entry is corrupt (re-run the autotuner)")
+    return int(value)
+
+
+def resolve_solve_ladder(ftr):
+    """The tuned jitter-ladder slice for this fitter's GLS solve, or
+    ``None`` (full ladder).  A tuned entry rung of 0 — the healthy-
+    system outcome — is also ``None``: the static path IS the tuned
+    path there, and no event noise is worth emitting per solve."""
+    if config.tune_dir() is None:
+        return None
+    from pint_tpu.runtime.solve import JITTER_LADDER
+
+    value, source = resolve("gls.solve_rung", solve_rung_vkey(ftr), 0,
+                            requested=False)
+    if source != "tuned":
+        return None
+    rung = int(value)
+    if rung <= 0 or rung >= len(JITTER_LADDER):
+        return None
+    return JITTER_LADDER[rung:]
+
+
+def resolve_plan_axes(workload: str) -> Optional[Tuple[str, ...]]:
+    """Tuned mesh axis order for ``workload``, or ``None`` (the
+    workload's static axis)."""
+    if config.tune_dir() is None:
+        return None
+    value, source = resolve(f"plan.axes/{workload}",
+                            plan_axes_vkey(workload), None,
+                            requested=False)
+    if source != "tuned" or not value:
+        return None
+    return tuple(str(a) for a in value)
+
+
+def resolve_serve_buckets() -> Optional[dict]:
+    """Tuned serving bucket ladders (``{"ntoa": [...], "nfree":
+    [...]}``), or ``None`` (the static defaults)."""
+    if config.tune_dir() is None:
+        return None
+    value, source = resolve("serve.buckets", serve_buckets_vkey(), None,
+                            requested=False)
+    if source != "tuned" or not isinstance(value, dict):
+        return None
+    ntoa, nfree = value.get("ntoa"), value.get("nfree")
+    if not (isinstance(ntoa, (list, tuple)) and ntoa
+            and isinstance(nfree, (list, tuple)) and nfree):
+        return None
+    return {"ntoa": tuple(int(b) for b in ntoa),
+            "nfree": tuple(int(b) for b in nfree)}
+
+
+def resolve_correction_dtype(model, toas) -> str:
+    """Tuned dtype of the grid kernel's Woodbury chi2-correction
+    segment: ``"float32"`` only when the dd-split probe recorded it
+    safe for exactly this system; ``"float64"`` otherwise."""
+    if config.tune_dir() is None:
+        return "float64"
+    value, source = resolve("grid.correction_dtype",
+                            correction_dtype_vkey(model, toas),
+                            "float64", requested=False)
+    return "float32" if (source == "tuned" and value == "float32") \
+        else "float64"

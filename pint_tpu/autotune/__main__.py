@@ -1,0 +1,130 @@
+"""Run the autotuner on a GLS grid workload and persist the manifest.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python -m pint_tpu.autotune \\
+        --par model.par --tim toas.tim [--grid-points 256] \\
+        [--chunks 64,128,256] [--sweep TPU_SWEEP.jsonl] \\
+        [--out TUNE.json]
+
+Defaults target the bench's B1855 headline workload when its datafiles
+exist.  TOAs are simulated at the tim file's epochs (the bench's
+convention — per-fit cost does not depend on residual values).  The
+tuned decisions land in the configured tune dir
+(``PINT_TPU_TUNE_DIR``) and/or the ``--out`` manifest file (the
+committed ``TUNE_r*.json`` artifact shape, validated by
+``tools/telemetry_report --check``), and each decision is echoed as a
+schema-tagged ``pint_tpu.telemetry.autotune/1`` JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pint_tpu.autotune",
+        description="Cost-model-driven autotune of the GLS grid workload")
+    ap.add_argument("--par", default=None, help="par file (default: the "
+                    "bench B1855 workload when present)")
+    ap.add_argument("--tim", default=None)
+    ap.add_argument("--grid-params", default="M2,SINI")
+    ap.add_argument("--grid-points", type=int, default=256,
+                    help="representative grid size (default 256)")
+    ap.add_argument("--chunks", default=None,
+                    help="explicit chunk candidates, comma-separated "
+                         "(default: the power-of-two ladder)")
+    ap.add_argument("--niter", type=int, default=1)
+    ap.add_argument("--top-k", type=int, default=2,
+                    help="cost-ranked candidates to measure-confirm")
+    ap.add_argument("--sweep", default=None,
+                    help="tpu_sweep artifact to ingest as the "
+                         "measured-confirmation source")
+    ap.add_argument("--out", default=None,
+                    help="also write the manifest document here "
+                         "(TUNE_*.json artifact)")
+    ap.add_argument("--workload-note", default=None,
+                    help="free-text provenance stamped into --out")
+    args = ap.parse_args(argv)
+
+    par = args.par
+    tim = args.tim
+    if par is None or tim is None:
+        try:
+            import bench as B  # repo-root module (run from the repo root)
+        except ImportError:
+            ap.error("--par/--tim are required outside the repo root "
+                     "(the B1855 default needs the repo's bench.py)")
+        par = par or B.B1855_PAR
+        tim = tim or B.B1855_TIM
+    from pint_tpu import autotune, config
+    from pint_tpu.autotune.manifest import TuningManifest
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromtim
+
+    model = get_model(par)
+    rng = np.random.default_rng(20260729)
+    toas = make_fake_toas_fromtim(tim, model, add_noise=True, rng=rng)
+    ftr = GLSFitter(toas, model)
+    ftr.fit_toas(maxiter=2)
+
+    grid_params = tuple(p for p in args.grid_params.split(",") if p)
+    npts = max(4, int(round(args.grid_points ** 0.5)))
+    grids = []
+    for p in grid_params:
+        par_obj = getattr(model, p)
+        c = float(par_obj.value or 0.0)
+        d = 3 * float(par_obj.uncertainty or max(abs(c) * 1e-3, 1e-6))
+        grids.append(np.linspace(c - d, c + d, npts))
+    pts = np.stack([g.ravel() for g in
+                    np.meshgrid(*grids, indexing="ij")], axis=-1)
+
+    sweep = None
+    if args.sweep:
+        import jax
+
+        sweep = autotune.measured_from_sweep(
+            args.sweep, platform=jax.default_backend(),
+            grid_points=int(pts.shape[0]))
+        print(f"# sweep source: {len(sweep)} measured chunk(s) from "
+              f"{args.sweep}", file=sys.stderr)
+
+    manifests = []
+    if config.tune_dir() is not None:
+        manifests.append(autotune.manifest())
+    if args.out:
+        manifests.append(TuningManifest(args.out))
+    if not manifests:
+        manifests.append(None)  # decisions still computed and printed
+
+    chunks = None
+    if args.chunks:
+        chunks = [int(c) for c in args.chunks.split(",")]
+    decisions = autotune.autotune_workload(
+        ftr, grid_params, pts, chunks=chunks, niter=args.niter,
+        top_k=args.top_k, sweep=sweep, tuning_manifest=manifests[0])
+    for m in manifests[1:]:
+        for d in decisions.values():
+            m.record(d)
+    if args.out and args.workload_note:
+        with open(args.out, encoding="utf-8") as f:
+            doc = json.load(f)
+        doc["workload_note"] = args.workload_note
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+    for _, d in sorted(decisions.items()):
+        print(json.dumps(autotune.decision_record(d)))
+    print(f"# {len(decisions)} decision(s) recorded", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Schema-tagged autotune records: the sweep/decision wire format.
+
+Two document families share the ``pint_tpu.telemetry.autotune/1``
+schema tag (validated by ``python -m tools.telemetry_report --check``,
+which self-tests real + degraded twins of each — the same
+producer/validator discipline as the multichip and serve_request
+records):
+
+* **sweep records** — one JSON line per measured configuration, what
+  ``tools/tpu_sweep.py`` emits and what the autotuner ingests as a
+  measured-confirmation source (:func:`pint_tpu.autotune.search.
+  measured_from_sweep`).  A failed configuration is a *degraded twin*:
+  same schema, ``error`` + ``failed_in`` instead of ``fits_per_sec``
+  — an infeasible chunk (the v5e scoped-vmem OOM) is data the search
+  must see, not a dropped row.
+* **decision records** — one tuned decision as a standalone line (the
+  tuning manifest embeds the same body per decision;
+  ``TUNE_*.json`` artifacts carry the full manifest under
+  ``pint_tpu.autotune.manifest/1``).
+
+Everything here is host-side plain-dict construction — no jax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["AUTOTUNE_SCHEMA", "TUNE_MANIFEST_SCHEMA", "sweep_record",
+           "decision_record"]
+
+AUTOTUNE_SCHEMA = "pint_tpu.telemetry.autotune/1"
+TUNE_MANIFEST_SCHEMA = "pint_tpu.autotune.manifest/1"
+
+
+def sweep_record(platform: str, chunk: int, grid_points: int,
+                 fits_per_sec: Optional[float] = None,
+                 elapsed_s: Optional[float] = None,
+                 compile_s: Optional[float] = None,
+                 sanity_ok: Optional[bool] = None,
+                 error: Optional[str] = None,
+                 failed_in: Optional[str] = None,
+                 error_detail: Optional[str] = None) -> dict:
+    """One sweep-row document.  A successful row carries
+    ``fits_per_sec``; a degraded row carries ``error`` + ``failed_in``
+    (``warmup_compile`` | ``measured_run``) instead — exactly one of
+    the two shapes, which the validator enforces."""
+    rec = {
+        "schema": AUTOTUNE_SCHEMA,
+        "record": "sweep",
+        "metric": "gls_grid_sweep",
+        "platform": str(platform),
+        "chunk": int(chunk),
+        "grid_points": int(grid_points),
+    }
+    if error is not None:
+        rec["error"] = str(error)
+        rec["failed_in"] = str(failed_in or "unknown")
+        if error_detail is not None:
+            rec["error_detail"] = str(error_detail)
+    else:
+        rec["fits_per_sec"] = float(fits_per_sec)
+    if elapsed_s is not None:
+        rec["elapsed_s"] = round(float(elapsed_s), 3)
+    if compile_s is not None:
+        rec["compile_s"] = round(float(compile_s), 2)
+    if sanity_ok is not None:
+        rec["sanity_ok"] = bool(sanity_ok)
+    return rec
+
+
+def decision_record(decision) -> dict:
+    """A tuned decision as a standalone schema-tagged line (``decision``
+    is a :class:`pint_tpu.autotune.manifest.TuningDecision` or its
+    ``to_dict()``)."""
+    body = decision if isinstance(decision, dict) else decision.to_dict()
+    return {"schema": AUTOTUNE_SCHEMA, "record": "decision",
+            "decision": body}

@@ -1,0 +1,854 @@
+"""Cost-model-driven configuration search for the hot path.
+
+The pre-autotuner repo picked its hot-path configurations by hand: the
+GLS grid chunk was 128 from a CPU sweep whose own notes admit ~35%
+noise, the solve ladder always entered at rung 0, the mesh axis order
+and the serving bucket ladders were static guesses.  This module closes
+ROADMAP item 5: enumerate candidate configurations, rank them by the
+XLA cost model (**AOT analysis, no execution** — one deliberate
+paused-accounting compile per candidate through
+:func:`pint_tpu.telemetry.costs.compiled_for`), and confirm only the
+top-k survivors with short measured runs (or rows ingested from a
+``tools/tpu_sweep.py`` artifact), instead of sweeping every
+configuration on the wall clock.
+
+Ranking contract (tests/test_autotune.py pins it):
+
+* a candidate whose :class:`~pint_tpu.telemetry.costs.CostProfile`
+  came back degraded/errored is **excluded with a reason**, never a
+  crash and never a fabricated score;
+* the static default is always in the measured-confirmation set, so
+  the winner's measured throughput is >= the static default's **by
+  construction** — the tuned configuration can tie the static one but
+  never lose to it ("never slower" is structural, not asserted);
+* cost ranking must agree with measurement on the endpoints (best !=
+  worst) for the ranking to be worth consulting — the CPU rank-
+  agreement test pins this on the B1855 stand-in workload.
+
+Decisions are :class:`~pint_tpu.autotune.manifest.TuningDecision`
+objects; :func:`autotune_workload` runs every tuner for a fitter and
+records them into the configured manifest.
+
+Everything here is host-side orchestration of AOT analyses and timed
+dispatches — calling it from traced code is flagged by jaxlint's
+host-call-in-jit rule.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.autotune.manifest import TuningDecision, TuningManifest
+from pint_tpu.autotune.records import AUTOTUNE_SCHEMA
+from pint_tpu.exceptions import (
+    NonFiniteSystemError,
+    SingularMatrixError,
+    UsageError,
+)
+from pint_tpu.logging import log
+
+__all__ = ["Candidate", "predicted_seconds", "chunk_ladder",
+           "rank_grid_chunks", "confirm_measured", "measured_from_sweep",
+           "tune_grid_chunk", "tune_solve_rung", "tune_plan_axes",
+           "tune_bucket_ladders", "tune_precision", "autotune_workload",
+           "BUCKET_LADDERS"]
+
+#: nominal roofline constants per backend family: (peak f64-equivalent
+#: FLOP/s, peak memory bandwidth B/s).  Used ONLY when the backend does
+#: not report ``optimal_seconds`` (CPU returns the -4 sentinel, which
+#: normalization nulls); ranking needs monotonicity across candidates
+#: on ONE backend, not absolute accuracy, so coarse constants are fine.
+_ROOFLINE = {
+    "cpu": (5.0e10, 2.0e10),
+    "tpu": (2.0e13, 8.0e11),
+    "axon": (2.0e13, 8.0e11),
+}
+_ROOFLINE_DEFAULT = (1.0e11, 5.0e10)
+
+
+@dataclass
+class Candidate:
+    """One enumerated configuration with its cost evidence."""
+
+    value: Any
+    profile: Any = None               #: CostProfile (None before analysis)
+    predicted_s: Optional[float] = None   #: predicted seconds per work item
+    excluded: Optional[str] = None        #: why the search dropped it
+    measured_fits_per_s: Optional[float] = None
+    measured_source: Optional[str] = None  #: "run" | "sweep"
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"value": self.value, "predicted_s": self.predicted_s,
+             "excluded": self.excluded,
+             "measured_fits_per_s": self.measured_fits_per_s,
+             "measured_source": self.measured_source}
+        p = self.profile
+        if p is not None:
+            d["cost"] = {"flops": p.flops,
+                         "bytes_accessed": p.bytes_accessed,
+                         "optimal_seconds": p.optimal_seconds,
+                         "peak_bytes": p.peak_bytes,
+                         "error": p.error}
+        d.update(self.extra)
+        return d
+
+
+def predicted_seconds(profile) -> Optional[float]:
+    """One executable invocation's predicted runtime from its
+    CostProfile: the backend's own ``optimal_seconds`` when reported,
+    else a roofline bound ``max(flops/peak_flops, bytes/peak_bw)``.
+    ``None`` when the profile carries nothing to rank on."""
+    if profile is None or profile.error:
+        return None
+    if profile.optimal_seconds is not None and profile.optimal_seconds > 0:
+        return float(profile.optimal_seconds)
+    flops_rate, bw = _ROOFLINE.get(profile.backend or "", _ROOFLINE_DEFAULT)
+    terms = []
+    if profile.flops is not None:
+        terms.append(float(profile.flops) / flops_rate)
+    if profile.bytes_accessed is not None:
+        terms.append(float(profile.bytes_accessed) / bw)
+    return max(terms) if terms else None
+
+
+# ---------------------------------------------------------------------------
+# grid chunk
+# ---------------------------------------------------------------------------
+
+def chunk_ladder(n_points: int, static: int,
+                 lo: int = 32, hi: int = 512) -> Tuple[int, ...]:
+    """Power-of-two chunk candidates for an ``n_points`` grid: rungs in
+    ``[lo, hi]`` clipped to at most one doubling past the grid size (a
+    chunk twice the grid only adds padding), plus the static default."""
+    if n_points < 1:
+        raise UsageError(f"grid must have >= 1 point, got {n_points}")
+    cap = 1 << max(0, int(math.ceil(math.log2(max(n_points, 1)))))
+    rungs = set()
+    r = lo
+    while r <= min(hi, max(cap, lo)):
+        rungs.add(r)
+        r *= 2
+    rungs.add(int(static))
+    return tuple(sorted(rungs))
+
+
+def _grid_cost_candidate(ftr, grid_params, points, chunk: int,
+                         niter: int, memory_budget: Optional[int],
+                         sharding=None) -> Candidate:
+    """Analyze ONE chunk configuration ahead of time (no execution)."""
+    from pint_tpu.grid import _point_spans, build_grid_gls_chi2_fn
+    from pint_tpu.telemetry import costs as _costs
+
+    cand = Candidate(value=int(chunk))
+    npts = int(points.shape[0])
+    try:
+        fn, _, _ = build_grid_gls_chi2_fn(
+            ftr.model, ftr.toas, tuple(grid_params), niter=niter,
+            grid_spans=_point_spans(ftr.model, grid_params, points),
+            chunk=int(chunk))
+        vfn, args = fn.cost_handle(points, sharding=sharding)
+    except Exception as e:
+        cand.excluded = f"build failed: {type(e).__name__}: {e}"
+        return cand
+    prof = _costs.analyze_jitted(vfn, *args,
+                                 name=f"grid.chunk[{int(chunk)}]")
+    cand.profile = prof
+    if prof.error:
+        cand.excluded = f"cost analysis degraded: {prof.error}"
+        return cand
+    if memory_budget is not None and prof.peak_bytes is not None \
+            and prof.peak_bytes > memory_budget:
+        cand.excluded = (f"peak_bytes {prof.peak_bytes} exceeds the "
+                         f"memory budget {memory_budget}")
+        return cand
+    per_chunk = predicted_seconds(prof)
+    if per_chunk is None:
+        cand.excluded = "cost model reported nothing to rank on"
+        return cand
+    # total predicted time for THIS grid: ceil(P/chunk) executions of
+    # the chunk executable — padding waste is charged honestly (a chunk
+    # double the grid costs ~2x per useful point, the r05 512-on-256
+    # halving)
+    n_blocks = math.ceil(npts / int(chunk))
+    cand.predicted_s = per_chunk * n_blocks / npts
+    return cand
+
+
+def rank_grid_chunks(ftr, grid_params: Sequence[str], points,
+                     chunks: Optional[Sequence[int]] = None,
+                     niter: int = 1,
+                     memory_budget: Optional[int] = None,
+                     sharding=None) -> List[Candidate]:
+    """Cost-rank chunk candidates for the GLS grid executable over
+    ``points``; returns every candidate (excluded ones carry their
+    reason), viable ones sorted first by ascending predicted
+    seconds-per-point."""
+    model, toas = ftr.model, ftr.toas
+    if not model.noise_basis_by_component(toas)[0]:
+        raise UsageError(
+            "chunk tuning applies to the chunked GLS grid executable; "
+            "this model has no correlated-noise basis (the WLS grid "
+            "vmaps the whole batch through one executable)")
+    points = np.asarray(points, dtype=np.float64)
+    if chunks is None:
+        from pint_tpu.grid import default_gls_chunk
+
+        chunks = chunk_ladder(points.shape[0], default_gls_chunk())
+    cands = [_grid_cost_candidate(ftr, tuple(grid_params), points,
+                                  int(c), niter, memory_budget,
+                                  sharding=sharding)
+             for c in dict.fromkeys(int(c) for c in chunks)]
+    viable = [c for c in cands if c.excluded is None]
+    dropped = [c for c in cands if c.excluded is not None]
+    for c in dropped:
+        log.info(f"autotune: chunk {c.value} excluded ({c.excluded})")
+    viable.sort(key=lambda c: (c.predicted_s, c.value))
+    return viable + dropped
+
+
+def _measured_grid_run(ftr, grid_params, points, chunk: int,
+                       niter: int) -> float:
+    """Short measured confirmation: one warm pass (compile +
+    classification) then one timed pass of the full point set through
+    the chunked executable; returns fits/s."""
+    import jax.numpy as jnp
+
+    from pint_tpu.grid import _point_spans, build_grid_gls_chi2_fn
+
+    fn, _, _ = build_grid_gls_chi2_fn(
+        ftr.model, ftr.toas, tuple(grid_params), niter=niter,
+        grid_spans=_point_spans(ftr.model, grid_params, points),
+        chunk=int(chunk))
+    pts = jnp.asarray(points)
+    fn(pts)  # warm: compile + linear-column classification
+    t0 = time.perf_counter()
+    chi2, _, _ = fn(pts)
+    dt = time.perf_counter() - t0
+    np.asarray(chi2)
+    return float(points.shape[0] / max(dt, 1e-9))
+
+
+def _norm_platform(p: Optional[str]) -> Optional[str]:
+    """The axon relay reports 'axon' in some environments and 'tpu' in
+    others for the same hardware family (grid.py's TPU_PLATFORMS note):
+    platform comparisons must not split on that spelling."""
+    if p is None:
+        return None
+    from pint_tpu.runtime.preflight import TPU_PLATFORMS
+
+    return "tpu" if p in TPU_PLATFORMS else p
+
+
+def measured_from_sweep(path: str, platform: Optional[str] = None,
+                        grid_points: Optional[int] = None
+                        ) -> Dict[int, float]:
+    """Measured fits/s per chunk from a ``tools/tpu_sweep.py`` artifact
+    (one JSON object per line).  Schema-tagged
+    ``pint_tpu.telemetry.autotune/1`` sweep records are preferred;
+    legacy untagged ``gls_grid_sweep`` rows (the pre-PR-10
+    ``TPU_SWEEP_r05.jsonl``) still ingest.  Errored rows are skipped —
+    an infeasible configuration has no throughput to confirm with.
+    ``platform`` filtering normalizes the tpu/axon spelling drift (a
+    sweep captured as 'tpu' still matches an 'axon' session).  When
+    ``grid_points`` is given, rows at exactly that grid size win over
+    other sizes for the same chunk."""
+    best: Dict[int, Tuple[int, float]] = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError as e:
+        raise UsageError(f"sweep file {path!r} unreadable: {e}") from e
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(row, dict):
+            continue
+        tagged = row.get("schema") == AUTOTUNE_SCHEMA \
+            and row.get("record") == "sweep"
+        legacy = "schema" not in row \
+            and row.get("metric") == "gls_grid_sweep"
+        if not (tagged or legacy):
+            continue
+        if row.get("error") is not None:
+            continue
+        fps = row.get("fits_per_sec")
+        chunk = row.get("chunk")
+        if not isinstance(fps, (int, float)) or not isinstance(chunk, int):
+            continue
+        if platform is not None and _norm_platform(row.get("platform")) \
+                != _norm_platform(platform):
+            continue
+        gp = row.get("grid_points")
+        rank = 1 if (grid_points is not None and gp == grid_points) else 0
+        prev = best.get(chunk)
+        if prev is None or rank > prev[0]:
+            best[chunk] = (rank, float(fps))
+    return {c: fps for c, (_, fps) in best.items()}
+
+
+def confirm_measured(ftr, grid_params, points, candidates: List[Candidate],
+                     static: int, top_k: int = 2, niter: int = 1,
+                     sweep: Optional[Dict[int, float]] = None
+                     ) -> List[Candidate]:
+    """Measured confirmation of the cost ranking's survivors: the top-k
+    viable candidates PLUS the static default (always — the "never
+    slower" gate needs its number).  ``sweep`` supplies pre-measured
+    fits/s (a tpu_sweep artifact via :func:`measured_from_sweep`);
+    anything not covered runs a short live measurement.  Returns the
+    confirmed candidates, best measured first."""
+    viable = [c for c in candidates if c.excluded is None]
+    chosen = list(viable[:max(1, top_k)])
+    if static not in [c.value for c in chosen]:
+        static_cand = next((c for c in candidates if c.value == static),
+                           None)
+        if static_cand is None:
+            # never analyzed (caller's ladder omitted it): confirm it
+            # unranked so the never-slower comparison still has its
+            # baseline number
+            static_cand = Candidate(value=int(static))
+            static_cand.extra["note"] = \
+                "static default entered confirmation unranked"
+            chosen.append(static_cand)
+        elif static_cand.excluded is None:
+            chosen.append(static_cand)
+        # an EXCLUDED static (over the memory budget, failed build) is
+        # never resurrected for a live run — measuring it would
+        # dispatch exactly the configuration the exclusion exists to
+        # keep off the device; the never-slower gate is vacuous
+        # against an infeasible baseline
+    for cand in chosen:
+        if sweep is not None and cand.value in sweep:
+            cand.measured_fits_per_s = float(sweep[cand.value])
+            cand.measured_source = "sweep"
+            continue
+        try:
+            cand.measured_fits_per_s = _measured_grid_run(
+                ftr, grid_params, points, cand.value, niter)
+            cand.measured_source = "run"
+        except Exception as e:
+            cand.excluded = (f"measured confirmation failed: "
+                             f"{type(e).__name__}: {e}")
+    confirmed = [c for c in chosen if c.measured_fits_per_s is not None]
+    confirmed.sort(key=lambda c: -c.measured_fits_per_s)
+    return confirmed
+
+
+def tune_grid_chunk(ftr, grid_params: Sequence[str], points,
+                    chunks: Optional[Sequence[int]] = None,
+                    niter: int = 1, top_k: int = 2,
+                    memory_budget: Optional[int] = None,
+                    sweep: Optional[Dict[int, float]] = None,
+                    static: Optional[int] = None,
+                    tuning_manifest: Optional[TuningManifest] = None
+                    ) -> TuningDecision:
+    """The full chunk search: cost-rank the ladder, measure-confirm the
+    survivors + the static default, record the winner.
+
+    ``static`` overrides the comparison baseline (default
+    :func:`~pint_tpu.grid.default_gls_chunk`; the bench passes its
+    hand-picked headline chunk so ``tuned{}`` compares against what
+    actually shipped).  The decision degrades to the static default
+    (with the reason in ``decision.reason``) when nothing survives — a
+    broken cost model can cost a search, never a sweep."""
+    from pint_tpu.autotune import grid_chunk_vkey
+    from pint_tpu.grid import default_gls_chunk
+
+    points = np.asarray(points, dtype=np.float64)
+    if static is None:
+        static = default_gls_chunk()
+    static = int(static)
+    if chunks is None:
+        chunks = chunk_ladder(points.shape[0], static)
+    else:
+        chunks = tuple(dict.fromkeys(list(int(c) for c in chunks)
+                                     + [static]))
+    cands = rank_grid_chunks(ftr, grid_params, points, chunks=chunks,
+                             niter=niter, memory_budget=memory_budget)
+    # infeasibility is a RANK-time verdict (over the memory budget,
+    # failed build), captured BEFORE confirmation — a confirm-time
+    # measurement flake also lands in Candidate.excluded but must NOT
+    # count as infeasible (an unmeasured baseline is not a vacuous one)
+    static_rank = next((c for c in cands if c.value == static), None)
+    static_infeasible = static_rank is not None \
+        and static_rank.excluded is not None
+    static_reason = static_rank.excluded if static_infeasible else None
+    confirmed = confirm_measured(ftr, grid_params, points, cands,
+                                 static=static, top_k=top_k, niter=niter,
+                                 sweep=sweep)
+    static_confirmed = any(c.value == static for c in confirmed)
+    if confirmed and (static_confirmed or confirmed[0].value == static
+                      or static_infeasible):
+        winner = confirmed[0]
+        value, basis = int(winner.value), "cost+measured"
+        reason = (f"best measured of {len(confirmed)} confirmed "
+                  f"candidate(s) from a {len(cands)}-candidate cost "
+                  "ranking ("
+                  + (f"static default infeasible: {static_reason}"
+                     if static_infeasible and not static_confirmed
+                     else "static default confirmed alongside") + ")")
+    elif confirmed:
+        # the winner measured fine but the static baseline's own
+        # confirmation failed: never-slower CANNOT be established, so
+        # the static default is retained — a tuned value must not ship
+        # on a comparison that never happened
+        value, basis = int(static), "static"
+        reason = ("static default's measured confirmation failed; "
+                  "never-slower cannot be established against an "
+                  "unmeasured baseline — static retained")
+    else:
+        viable = [c for c in cands if c.excluded is None]
+        if viable:
+            value, basis = int(viable[0].value), "cost"
+            reason = ("measured confirmation unavailable; best "
+                      "cost-ranked candidate")
+        else:
+            value, basis = int(static), "static"
+            reason = ("every candidate excluded "
+                      f"({'; '.join(c.excluded for c in cands[:3])}); "
+                      "static default retained")
+    # evidence trail covers every candidate that took part — including
+    # a synthetic unranked static the confirmation injected (a measured
+    # number must never appear without a matching evidence entry)
+    evidence = cands + [c for c in confirmed
+                        if all(c is not x for x in cands)]
+    decision = TuningDecision(
+        name="grid.chunk", value=value, static_default=int(static),
+        vkey=grid_chunk_vkey(ftr.model, ftr.toas), basis=basis,
+        candidates=[c.to_dict() for c in evidence],
+        measured={str(c.value): c.measured_fits_per_s
+                  for c in confirmed},
+        reason=reason)
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
+# ---------------------------------------------------------------------------
+# solve-ladder entry rung
+# ---------------------------------------------------------------------------
+
+def tune_solve_rung(ftr,
+                    tuning_manifest: Optional[TuningManifest] = None
+                    ) -> TuningDecision:
+    """Measure which jitter rung the fitter's GLS solve actually needs
+    and record it as the ladder entry rung.
+
+    The hardened ladder (:data:`pint_tpu.runtime.solve.JITTER_LADDER`)
+    tries rung 0 (no loading) first; a workload whose Gram provably
+    fails the early rungs pays a wasted device factorization per rung
+    per solve.  The sliced ladder is applied to EVERY factorization of
+    the Schur fast path (the noise block AND the Schur complement), so
+    the recorded entry rung is the MINIMUM of the rungs the two
+    factors measured to need — a rung is skipped only when BOTH
+    factors fail it, which keeps the applied jitter, and therefore the
+    solution, IDENTICAL to the static path's.  A system where either
+    factor is clean at rung 0 records rung 0 (no change).  The
+    decision is keyed on the full fitter vkey (parameter signature +
+    TOA version): any parameter edit invalidates it, and the consumer
+    falls back to the full ladder."""
+    import jax.numpy as jnp
+    import jax.scipy.linalg as jsl
+
+    from pint_tpu.autotune import solve_rung_vkey
+    from pint_tpu.gls_fitter import (
+        build_augmented_system,
+        gls_normal_equations,
+    )
+    from pint_tpu.runtime.solve import JITTER_LADDER, hardened_cholesky
+
+    model, toas = ftr.model, ftr.toas
+    r = np.asarray(ftr.resids.time_resids)
+    M, params, norm, phiinv, Nvec, _ = build_augmented_system(model, toas)
+    ntm = len(params)
+    rung, reason = 0, "solve succeeded at rung 0 (no loading needed)"
+    try:
+        if M.shape[1] > ntm:
+            # probe BOTH Schur-path factorizations (the Schur solver
+            # only reports the complement's attempts; the consumer's
+            # sliced ladder reaches the noise block too)
+            W = 1.0 / Nvec
+            M_t, M_u = M[:, :ntm], M[:, ntm:]
+            WM_u = W[:, None] * M_u
+            D = M_u.T @ WM_u + np.diag(phiinv[ntm:])
+            L_D, _, att_D = hardened_cholesky(D, name="autotune probe "
+                                                      "noise block")
+            C = M_t.T @ WM_u
+            Y = np.asarray(jsl.solve_triangular(
+                jnp.asarray(L_D), jnp.asarray(C.T), lower=True))
+            S = M_t.T @ (W[:, None] * M_t) + np.diag(phiinv[:ntm]) \
+                - Y.T @ Y
+            _, _, att_S = hardened_cholesky(S, name="autotune probe "
+                                                    "Schur complement")
+            attempts = min(att_D, att_S)
+        else:
+            mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec,
+                                              phiinv=phiinv)
+            _, _, attempts = hardened_cholesky(mtcm,
+                                               name="autotune probe")
+        if attempts > 1:
+            rung = attempts - 1
+            reason = (f"rungs 0..{rung - 1} measured to fail on EVERY "
+                      "ladder-consuming factorization of this system; "
+                      "entering at the first rung either factor needs "
+                      "(identical loading, identical solution, "
+                      f"{rung} fewer failed factorization(s) per solve)")
+    except (SingularMatrixError, NonFiniteSystemError) as e:
+        rung = 0
+        reason = (f"ladder probe escalated past Cholesky "
+                  f"({type(e).__name__}); entry-rung tuning does not "
+                  "apply — full ladder retained")
+    decision = TuningDecision(
+        name="gls.solve_rung", value=int(rung), static_default=0,
+        vkey=solve_rung_vkey(ftr), basis="measured",
+        measured={"attempts_rung": rung,
+                  "ladder": list(JITTER_LADDER)},
+        reason=reason)
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
+# ---------------------------------------------------------------------------
+# mesh axis order
+# ---------------------------------------------------------------------------
+
+#: candidate mesh-axis assignments per routed workload (axes[0] is the
+#: batch axis the plan shards; two-axis grid plans split grid x toa)
+_AXIS_CANDIDATES = {
+    "grid": (("grid",), ("grid", "toa")),
+    "gls_normal_eq": (("toa",),),
+    "walker": (("walker",),),
+}
+
+
+def tune_plan_axes(ftr, workload: str = "grid",
+                   points=None, niter: int = 1,
+                   tuning_manifest: Optional[TuningManifest] = None
+                   ) -> TuningDecision:
+    """Rank mesh axis orders for ``workload`` by the collective bytes
+    the sharded executable would move (distview HLO accounting), cost
+    bytes as the tie-break.  With fewer than two healthy devices the
+    choice is degenerate and the default single-axis plan is recorded
+    with that reason (no fabricated ranking)."""
+    from pint_tpu.autotune import plan_axes_vkey
+    from pint_tpu.runtime.plan import _WORKLOAD_AXIS, ExecutionPlan, ladder
+    from pint_tpu.runtime.preflight import healthy_devices
+
+    if workload not in _AXIS_CANDIDATES:
+        raise UsageError(f"unknown workload {workload!r}; tunable "
+                         f"workloads are {tuple(_AXIS_CANDIDATES)}")
+    default_axes = (_WORKLOAD_AXIS[workload][0],)
+    devices = tuple(healthy_devices())
+    cands: List[Candidate] = []
+    if len(devices) < 2:
+        decision = TuningDecision(
+            name=f"plan.axes/{workload}", value=list(default_axes),
+            static_default=list(default_axes),
+            vkey=plan_axes_vkey(workload), basis="degenerate",
+            reason=f"{len(devices)} healthy device(s): every axis "
+                   "order builds the same single-device plan")
+        if tuning_manifest is not None:
+            tuning_manifest.record(decision)
+        return decision
+    from pint_tpu.telemetry import distview as _distview
+
+    rung = ladder(len(devices))[0]
+    for axes in _AXIS_CANDIDATES[workload]:
+        cand = Candidate(value=list(axes))
+        try:
+            plan = ExecutionPlan(workload=workload, kind="pjit",
+                                 axes=tuple(axes), devices=devices,
+                                 rung=rung)
+            if workload == "grid":
+                if points is None:
+                    raise UsageError("grid axis tuning needs points")
+                coll, prof = _sharded_grid_profiles(
+                    ftr, points, plan, niter)
+            else:
+                fn, args = ftr.gls_normal_equations_executable(
+                    plan=plan)
+                coll = _distview.analyze_jitted_collectives(
+                    fn, *args, name=f"plan.axes[{'x'.join(axes)}]")
+                prof = None
+            if coll.error:
+                cand.excluded = f"collective analysis degraded: " \
+                                f"{coll.error}"
+            else:
+                cand.extra["collective_bytes"] = coll.collective_bytes
+                cand.predicted_s = float(coll.collective_bytes)
+                if prof is not None:
+                    cand.profile = prof
+        except Exception as e:
+            cand.excluded = f"{type(e).__name__}: {e}"
+        cands.append(cand)
+    viable = [c for c in cands if c.excluded is None]
+    if viable:
+        viable.sort(key=lambda c: c.predicted_s)
+        value = viable[0].value
+        basis = "cost"
+        reason = ("least collective bytes moved among "
+                  f"{len(viable)} viable axis order(s)")
+    else:
+        value, basis = list(default_axes), "static"
+        reason = ("every axis candidate excluded "
+                  f"({'; '.join(c.excluded for c in cands[:2])}); "
+                  "default axis retained")
+    decision = TuningDecision(
+        name=f"plan.axes/{workload}", value=value,
+        static_default=list(default_axes),
+        vkey=plan_axes_vkey(workload), basis=basis,
+        candidates=[c.to_dict() for c in cands], reason=reason)
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
+def _sharded_grid_profiles(ftr, points, plan, niter):
+    """(CollectiveProfile, CostProfile) of the grid chunk executable
+    under ``plan``'s sharding."""
+    from pint_tpu.grid import _point_spans, build_grid_gls_chi2_fn
+    from pint_tpu.telemetry import costs as _costs
+    from pint_tpu.telemetry import distview as _distview
+
+    points = np.asarray(points, dtype=np.float64)
+    grid_params = ("M2", "SINI")  # representative: the headline pair
+    sharding = plan.batch_sharding()
+    fn, _, _ = build_grid_gls_chi2_fn(
+        ftr.model, ftr.toas, grid_params, niter=niter,
+        grid_spans=_point_spans(ftr.model, grid_params, points),
+        chunk=max(plan.rung, 8))
+    vfn, args = fn.cost_handle(points, sharding=sharding)
+    name = f"plan.axes[{'x'.join(plan.axes)}]"
+    return (_distview.analyze_jitted_collectives(vfn, *args, name=name),
+            _costs.analyze_jitted(vfn, *args, name=name))
+
+
+# ---------------------------------------------------------------------------
+# serving bucket ladders
+# ---------------------------------------------------------------------------
+
+#: named candidate ladders: (ntoa rungs, nfree rungs).  "default" is
+#: the serving layer's static choice; "fine" halves the padding waste
+#: at ~2x the distinct-executable count; "coarse" the reverse.
+BUCKET_LADDERS = {
+    "default": ((64, 256, 1024, 4096, 16384), (8, 32, 128, 512)),
+    "fine": ((64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384),
+             (8, 16, 32, 64, 128, 256, 512)),
+    "coarse": ((256, 4096, 16384), (32, 512)),
+}
+
+
+def tune_bucket_ladders(shapes: Sequence[Tuple[int, int]],
+                        ladders: Optional[Dict[str, tuple]] = None,
+                        tuning_manifest: Optional[TuningManifest] = None
+                        ) -> TuningDecision:
+    """Pick the serving bucket-ladder granularity for a representative
+    request-shape population: per candidate ladder, every shape is
+    bucketed and the serve kernel's CostProfile at that bucket predicts
+    the per-request cost; the ladder minimizing the population's total
+    predicted seconds wins, with the distinct-bucket count (compiles to
+    pre-warm) as the tie-break.  A ladder whose any bucket analysis
+    degrades is excluded, not scored on partial evidence."""
+    from pint_tpu.autotune import serve_buckets_vkey
+    from pint_tpu.serving import batcher as _batcher
+    from pint_tpu.telemetry import costs as _costs
+
+    shapes = [(int(n), int(k)) for n, k in shapes]
+    if not shapes:
+        raise UsageError("bucket tuning needs at least one request shape")
+    ladders = dict(BUCKET_LADDERS if ladders is None else ladders)
+    cands: List[Candidate] = []
+    for name, (ntoa_ladder, nfree_ladder) in ladders.items():
+        cand = Candidate(value=name)
+        cand.extra["ntoa"] = list(ntoa_ladder)
+        cand.extra["nfree"] = list(nfree_ladder)
+        try:
+            buckets = {}
+            for n, k in shapes:
+                b = (_batcher.bucket_of(n, ntoa_ladder),
+                     _batcher.bucket_of(k, nfree_ladder))
+                buckets.setdefault(b, 0)
+                buckets[b] += 1
+            total = 0.0
+            for (bn, bk), count in sorted(buckets.items()):
+                operands = (np.zeros((1, bn, bk)), np.zeros((1, bn)),
+                            np.zeros((1, bn)), np.zeros((1, bk)),
+                            np.ones((1, bk)))
+                prof = _costs.analyze_jitted(
+                    _batcher.serve_batched(), *operands,
+                    name=f"serve.fit[1x{bn}x{bk}]")
+                sec = predicted_seconds(prof)
+                if sec is None:
+                    raise UsageError(
+                        f"bucket ({bn}, {bk}) cost analysis degraded"
+                        + (f": {prof.error}" if prof.error else ""))
+                total += sec * count
+            cand.predicted_s = total
+            cand.extra["n_buckets"] = len(buckets)
+        except Exception as e:
+            cand.excluded = f"{type(e).__name__}: {e}"
+        cands.append(cand)
+    viable = [c for c in cands if c.excluded is None]
+    if viable:
+        viable.sort(key=lambda c: (c.predicted_s, c.extra["n_buckets"]))
+        winner = viable[0]
+        value = {"ladder": winner.value, "ntoa": winner.extra["ntoa"],
+                 "nfree": winner.extra["nfree"]}
+        basis = "cost"
+        reason = (f"least total predicted serve seconds over "
+                  f"{len(shapes)} representative shape(s); "
+                  f"{winner.extra['n_buckets']} distinct bucket(s)")
+    else:
+        value = {"ladder": "default",
+                 "ntoa": list(BUCKET_LADDERS["default"][0]),
+                 "nfree": list(BUCKET_LADDERS["default"][1])}
+        basis = "static"
+        reason = ("every ladder candidate excluded "
+                  f"({'; '.join(c.excluded for c in cands[:2])}); "
+                  "default ladders retained")
+    decision = TuningDecision(
+        name="serve.buckets", value=value,
+        static_default={"ladder": "default",
+                        "ntoa": list(BUCKET_LADDERS["default"][0]),
+                        "nfree": list(BUCKET_LADDERS["default"][1])},
+        vkey=serve_buckets_vkey(), basis=basis,
+        candidates=[c.to_dict() for c in cands], reason=reason)
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
+# ---------------------------------------------------------------------------
+# reduced-precision segments
+# ---------------------------------------------------------------------------
+
+#: the f32 segment may only be chosen when the probe's error — relative
+#: to the final chi2 — sits below the grid's own parity tolerance with
+#: two orders of margin
+_PRECISION_SAFE_REL = 1e-12
+
+
+def tune_precision(ftr,
+                   tuning_manifest: Optional[TuningManifest] = None
+                   ) -> TuningDecision:
+    """dd-split-guarded reduced precision for the grid kernel's
+    Woodbury chi2-correction segment.
+
+    The segment computes ``z = L^-1 (U_chi^T W r)`` and subtracts
+    ``z.z`` from the whitened chi2.  A float32 segment would halve its
+    bytes (the TPU's native regime); it is only SAFE when the
+    correction's f32-vs-f64 disagreement, measured on the fitter's
+    actual system, is below :data:`_PRECISION_SAFE_REL` of the final
+    chi2 — the probe computes both on the host (the dd-split's f64
+    reference arithmetic) and records the measured margin either way.
+    On every realistic correlated-noise workload this records
+    ``float64`` (f32 rounding sits ~1e-7 relative, five orders above
+    the bar); the decision exists so a backend/workload where the
+    margin genuinely closes can flip without a code change."""
+    import scipy.linalg as _sl
+
+    from pint_tpu.autotune import correction_dtype_vkey
+    from pint_tpu.runtime.solve import hardened_cholesky
+
+    model, toas = ftr.model, ftr.toas
+    Us, ws, _ = model.noise_basis_by_component(toas)
+    vkey = correction_dtype_vkey(model, toas)
+    if not Us:
+        decision = TuningDecision(
+            name="grid.correction_dtype", value="float64",
+            static_default="float64", vkey=vkey, basis="degenerate",
+            reason="no correlated-noise basis: the WLS grid has no "
+                   "Woodbury correction segment")
+        if tuning_manifest is not None:
+            tuning_manifest.record(decision)
+        return decision
+    sigma = np.asarray(model.scaled_toa_uncertainty(toas))
+    W = 1.0 / sigma**2
+    U = np.hstack(Us)
+    phi = np.concatenate(ws)
+    U_chi, phi_chi = model.augment_basis_for_offset(U, phi, n=len(toas))
+    Sigma = np.diag(1.0 / phi_chi) + U_chi.T @ (W[:, None] * U_chi)
+    cf, _, _ = hardened_cholesky(Sigma, name="autotune precision probe")
+    r = np.asarray(ftr.resids.time_resids)
+    wr = W * r
+    z64 = _sl.solve_triangular(cf, U_chi.T @ wr, lower=True)
+    corr64 = float(z64 @ z64)
+    z32 = _sl.solve_triangular(cf.astype(np.float32),
+                               (U_chi.astype(np.float32).T
+                                @ wr.astype(np.float32)), lower=True)
+    corr32 = float(z32.astype(np.float64) @ z32.astype(np.float64))
+    chi2 = float(r @ wr - corr64)
+    rel = abs(corr32 - corr64) / max(abs(chi2), 1e-300)
+    safe = rel < _PRECISION_SAFE_REL
+    decision = TuningDecision(
+        name="grid.correction_dtype",
+        value="float32" if safe else "float64",
+        static_default="float64", vkey=vkey, basis="probe",
+        measured={"rel_error_vs_chi2": rel,
+                  "safe_below": _PRECISION_SAFE_REL},
+        reason=(f"f32 correction disagrees with the f64 (dd-split "
+                f"reference) by {rel:.3e} of chi2 — "
+                + ("below" if safe else "above")
+                + f" the {_PRECISION_SAFE_REL:g} safety bar"))
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def autotune_workload(ftr, grid_params: Sequence[str], points,
+                      chunks: Optional[Sequence[int]] = None,
+                      niter: int = 1, top_k: int = 2,
+                      sweep: Optional[Dict[int, float]] = None,
+                      serve_shapes: Optional[Sequence[Tuple[int, int]]]
+                      = None,
+                      tuning_manifest: Optional[TuningManifest] = None
+                      ) -> Dict[str, TuningDecision]:
+    """Run every tuner for one fitter's workload and record the
+    decisions (into the configured manifest when none is passed).
+    Individual tuners degrade independently: a failed search records
+    nothing for that decision and the others still land."""
+    from pint_tpu.autotune.manifest import manifest as _configured
+
+    if tuning_manifest is None:
+        tuning_manifest = _configured()
+    out: Dict[str, TuningDecision] = {}
+    tuners = [
+        ("grid.chunk", lambda: tune_grid_chunk(
+            ftr, grid_params, points, chunks=chunks, niter=niter,
+            top_k=top_k, sweep=sweep, tuning_manifest=tuning_manifest)),
+        ("gls.solve_rung", lambda: tune_solve_rung(
+            ftr, tuning_manifest=tuning_manifest)),
+        ("plan.axes/grid", lambda: tune_plan_axes(
+            ftr, "grid", points=points, niter=niter,
+            tuning_manifest=tuning_manifest)),
+        ("grid.correction_dtype", lambda: tune_precision(
+            ftr, tuning_manifest=tuning_manifest)),
+    ]
+    if serve_shapes is None:
+        serve_shapes = [(len(ftr.toas), len(ftr.model.free_params))]
+    tuners.append(("serve.buckets", lambda: tune_bucket_ladders(
+        serve_shapes, tuning_manifest=tuning_manifest)))
+    for name, run in tuners:
+        try:
+            out[name] = run()
+        except Exception as e:
+            log.warning(f"autotune: {name} search failed "
+                        f"({type(e).__name__}: {e}); static default "
+                        "stays in effect")
+    return out

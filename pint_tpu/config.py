@@ -9,7 +9,9 @@ __all__ = ["datadir", "examplefile", "runtimefile",
            "device_policy", "set_device_policy", "DEVICE_POLICIES",
            "ingestion_policy", "set_ingestion_policy", "INGESTION_POLICIES",
            "telemetry_mode", "set_telemetry_mode", "TELEMETRY_MODES",
-           "aot_cache_dir", "set_aot_cache_dir"]
+           "aot_cache_dir", "set_aot_cache_dir",
+           "grid_chunk", "set_grid_chunk",
+           "tune_dir", "set_tune_dir"]
 
 #: what to do when the preflight probe finds the executing platform differs
 #: from the requested one (``PINT_TPU_REQUIRE_PLATFORM``):
@@ -139,6 +141,105 @@ def set_aot_cache_dir(path) -> None:
             "persistence needs a writable directory "
             "(PINT_TPU_AOT_CACHE_DIR / set_aot_cache_dir)")
     _aot_cache_dir = path
+
+
+#: process-wide override of the GLS grid chunk size
+#: (``PINT_TPU_GRID_CHUNK`` / :func:`set_grid_chunk`).  ``None`` (the
+#: default) lets :func:`pint_tpu.grid.default_gls_chunk` pick the
+#: backend's static default — which the autotuner's tuned decisions in
+#: turn supersede when ``grid_chisq(chunk="auto")`` finds a manifest.
+#: The env value is validated lazily at first :func:`grid_chunk` read
+#: (a bad env var must not break ``import pint_tpu``).
+_grid_chunk = None
+_grid_chunk_env_checked = False
+
+
+def _coerce_chunk(value, source: str) -> int:
+    """Typed validation shared by the setter and the env read: the
+    chunk is an executable batch size, so it must be a positive
+    integer (a float like 128.5 cannot shape an array axis).  Any
+    integral type is accepted (``operator.index`` — numpy integers
+    from a parsed sweep row included), matching the grid builder's own
+    ``(int, np.integer)`` acceptance."""
+    import operator
+
+    from pint_tpu.exceptions import UsageError
+
+    if isinstance(value, bool):
+        raise UsageError(
+            f"grid chunk from {source} must be a positive integer, "
+            f"got {value!r}")
+    try:
+        chunk = int(value, 10) if isinstance(value, str) \
+            else operator.index(value)
+    except (TypeError, ValueError):
+        raise UsageError(
+            f"grid chunk from {source} must be a positive integer, "
+            f"got {value!r}") from None
+    if chunk <= 0:
+        raise UsageError(
+            f"grid chunk from {source} must be positive, got {chunk}")
+    return chunk
+
+
+def grid_chunk():
+    """The configured GLS grid chunk override, or ``None`` when unset.
+    Raises :class:`~pint_tpu.exceptions.UsageError` on a malformed
+    ``PINT_TPU_GRID_CHUNK`` value (at read time, not import time)."""
+    global _grid_chunk, _grid_chunk_env_checked
+    if _grid_chunk is None and not _grid_chunk_env_checked:
+        _grid_chunk_env_checked = True
+        env = os.environ.get("PINT_TPU_GRID_CHUNK")
+        if env:
+            _grid_chunk = _coerce_chunk(env, "PINT_TPU_GRID_CHUNK")
+    return _grid_chunk
+
+
+def set_grid_chunk(chunk) -> None:
+    """Set (or, with ``None``, clear) the process-wide GLS grid chunk
+    override.  Typed :class:`~pint_tpu.exceptions.UsageError` on
+    non-positive or non-integer values."""
+    global _grid_chunk, _grid_chunk_env_checked
+    _grid_chunk_env_checked = True  # an explicit choice wins over env
+    if chunk is None:
+        _grid_chunk = None
+        return
+    _grid_chunk = _coerce_chunk(chunk, "set_grid_chunk")
+
+
+#: where the autotuner persists its tuning manifest across processes
+#: (``PINT_TPU_TUNE_DIR`` / :func:`set_tune_dir`): decisions keyed by
+#: workload vkey + device fingerprint (:mod:`pint_tpu.autotune`).
+#: ``None`` (the default) disables persistence — tunable call sites
+#: fall back to the static defaults.
+_tune_dir = os.environ.get("PINT_TPU_TUNE_DIR") or None
+
+
+def tune_dir():
+    """Tuning-manifest directory, or ``None`` when autotuning
+    persistence is off.  Like :func:`aot_cache_dir`, the env value is
+    not validated at import; :class:`pint_tpu.autotune.TuningManifest`
+    raises the typed error on first use."""
+    return _tune_dir
+
+
+def set_tune_dir(path) -> None:
+    """Set (or, with ``None``/empty, disable) the tuning-manifest
+    directory for this process.  Created if absent; an uncreatable or
+    unwritable target raises a typed
+    :class:`~pint_tpu.exceptions.UsageError` immediately — through the
+    ONE validation :class:`pint_tpu.autotune.manifest.TuningManifest`
+    itself performs, so the eager check here and the lazy first-use
+    check cannot drift apart."""
+    global _tune_dir
+    if not path:
+        _tune_dir = None
+        return
+    path = os.path.abspath(str(path))
+    from pint_tpu.autotune.manifest import TuningManifest
+
+    TuningManifest(path)  # typed UsageError on uncreatable/unwritable
+    _tune_dir = path
 
 
 def datadir() -> str:

@@ -54,7 +54,9 @@ def _solve_cholesky(mtcm: np.ndarray, mtcy: np.ndarray):
     through jittered rungs before the caller's SVD path.  Raises
     :class:`SingularMatrixError` when the ladder is exhausted and
     :class:`NonFiniteSystemError` on NaN/inf input (never retried into
-    silent garbage)."""
+    silent garbage).  Always the FULL ladder: the autotuner's tuned
+    entry rung is measured on the Schur path's factorizations and is
+    consumed only there (:func:`_schur_gls_solve`)."""
     return solve_normal_cholesky(mtcm, mtcy, name="GLS normal equations")
 
 
@@ -146,7 +148,8 @@ def gls_normal_equations(M: np.ndarray, r: np.ndarray,
 
 
 def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
-                     phiinv: np.ndarray, ntm: int, cache: dict):
+                     phiinv: np.ndarray, ntm: int, cache: dict,
+                     ladder=None):
     """Solve the augmented system via a Schur complement on the noise
     block.
 
@@ -159,11 +162,15 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     Returns (xvar_t, xhat, diagnostics) with xvar_t the (ntm, ntm)
     marginal timing covariance ``(A - C D^-1 C^T)^-1`` (exactly what the
     full-system inverse's timing block is) and xhat the full solution
-    vector.  Both factorizations run through the hardened jitter ladder;
+    vector.  Both factorizations run through the hardened jitter ladder
+    (``ladder``: the autotuner's tuned entry-rung suffix, default full);
     ladder exhaustion raises :class:`SingularMatrixError` for the
     caller's SVD path, non-finite inputs raise
     :class:`NonFiniteSystemError` outright.
     """
+    from pint_tpu.runtime.solve import JITTER_LADDER
+
+    ladder = ladder or JITTER_LADDER
     if not np.all(np.isfinite(r)):
         raise NonFiniteSystemError(
             "GLS residual vector contains NaN/inf; refusing the solve")
@@ -181,7 +188,8 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
         L_D, jit_D = hit[5], hit[6]
     else:
         D = M_u.T @ WM_u + np.diag(pu)
-        L_D, jit_D, _ = hardened_cholesky(D, name="GLS noise block")
+        L_D, jit_D, _ = hardened_cholesky(D, name="GLS noise block",
+                                          ladder=ladder)
         cache["schur"] = (M.shape, ntm, pu.copy(), Nvec.copy(), M_u.copy(),
                           L_D, jit_D)
     A = M_t.T @ (W[:, None] * M_t) + np.diag(phiinv[:ntm])
@@ -193,7 +201,8 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     z_u = np.asarray(jsl.solve_triangular(jnp.asarray(L_D),
                                           jnp.asarray(b_u), lower=True))
     S = A - Y.T @ Y
-    L_S, jit_S, attempts = hardened_cholesky(S, name="GLS Schur complement")
+    L_S, jit_S, attempts = hardened_cholesky(S, name="GLS Schur complement",
+                                             ladder=ladder)
     x_t = np.asarray(jsl.cho_solve((jnp.asarray(L_S), True),
                                    jnp.asarray(b_t - Y.T @ z_u)))
     xvar_t = np.asarray(jsl.cho_solve((jnp.asarray(L_S), True),
@@ -214,12 +223,14 @@ def _try_schur_path(fitter, M, r, Nvec, phiinv, ntm, norm):
     """Shared Schur fast-path assembly for GLSFitter and the wideband
     fitters: returns (dpars, errs, covmat) or None when the Cholesky
     fails (caller falls back to the dense/SVD path).  The fitter carries
-    the cross-iteration cache."""
+    the cross-iteration cache (and, when tuned, the autotuner's ladder
+    entry rung on ``_solve_ladder``)."""
     if not hasattr(fitter, "_gls_cache"):
         fitter._gls_cache = {}
     try:
-        xvar_t, xhat, diag = _schur_gls_solve(M, r, Nvec, phiinv, ntm,
-                                              fitter._gls_cache)
+        xvar_t, xhat, diag = _schur_gls_solve(
+            M, r, Nvec, phiinv, ntm, fitter._gls_cache,
+            ladder=getattr(fitter, "_solve_ladder", None))
     except _CHOLESKY_FAILURES:
         # ladder exhausted: the dense path's own ladder/SVD takes over
         # (NonFiniteSystemError propagates — retrying cannot fix NaNs)
@@ -362,6 +373,10 @@ class GLSFitter(Fitter):
                                                   phiinv=phiinv)
         if threshold <= 0:
             try:
+                # the tuned entry rung (_solve_ladder) deliberately
+                # does NOT apply here: it was measured on the Schur
+                # path's factorizations; this dense mtcm is a
+                # different matrix and gets the full ladder
                 xvar, xhat, diag = _solve_cholesky(mtcm, mtcy)
             except _CHOLESKY_FAILURES:
                 xvar, xhat, diag = _solve_svd(mtcm, mtcy, threshold, params)
@@ -477,6 +492,16 @@ class GLSFitter(Fitter):
                 plan = select_plan("gls_normal_eq",
                                    n_items=len(self.toas))
             self.plan = plan
+        # tuned solve-ladder entry rung (pint_tpu.autotune): resolved
+        # once per fit against the manifest's vkey (full parameter
+        # signature — any edit falls back to the full ladder).  None is
+        # both "tuning off" and the healthy rung-0 outcome; a tuned
+        # rung skips only loadings measured to FAIL on this system, so
+        # the applied jitter — and the solution — is identical to the
+        # static path's.
+        from pint_tpu import autotune as _autotune
+
+        self._solve_ladder = _autotune.resolve_solve_ladder(self)
         if self._check_robust_arg(robust):
             # typed and actionable, instead of a TypeError on the kwarg:
             # Huber IRLS reweights a *diagonal* whitener, which a

@@ -236,7 +236,7 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     was handed; ours dispatches on the noise structure).
     """
     if model.noise_basis_by_component(toas)[0]:
-        kw = {} if chunk is None else {"chunk": int(chunk)}
+        kw = {} if chunk is None else {"chunk": chunk}
         return build_grid_gls_chi2_fn(model, toas, grid_params,
                                       fit_params=fit_params, niter=niter,
                                       grid_spans=grid_spans, **kw)
@@ -362,27 +362,73 @@ def build_grid_chi2_fn(model, toas, grid_params: Sequence[str],
     return fn, free_init, fit_params
 
 
-def default_gls_chunk() -> int:
-    """Default batch size for the chunked GLS grid executable.
+#: static per-backend chunk defaults — the floor the autotuner must
+#: beat.  TPU: measured round 5 on a real v5e (tools/tpu_sweep.py,
+#: B1855 grid; fits/s): at 256 points chunk 64/128/256/512 gave
+#: 96.3/101.5/106.9/49.6, at 1024 points 167.4/172.2/160.4/143.7 — 128
+#: is at or near the top at both scales, while 256 wins only when the
+#: grid is exactly one chunk and 512 halves the 256-point rate by
+#: padding (before the no-materialized-B kernel, chunk >= 256 did not
+#: compile at all: scoped-vmem OOM).  CPU: the r4/r5 sweeps favor 128
+#: when isolated — same value, independently measured, kept as its own
+#: row so a backend whose sweep disagrees changes one entry.  Unknown
+#: backends take the CPU row (the conservative host-style default).
+_STATIC_CHUNK = {"tpu": 128, "cpu": 128}
 
-    Measured round 5 on a real v5e with the no-materialized-B kernel
-    (tools/tpu_sweep.py, B1855 grid; fits/s): at 256 points chunk
-    64/128/256/512 gave 96.3/101.5/106.9/49.6, at 1024 points
-    167.4/172.2/160.4/143.7 — 128 is at or near the top at both scales,
-    while 256 wins only when the grid is exactly one chunk and 512 halves
-    the 256-point rate by padding.  (Before that kernel rewrite, chunk
-    >= 256 did not compile at all: XLA scoped-vmem OOM in the vmapped
-    scatter.)  On CPU the r4/r5 sweeps favor 128 when isolated.  So: 128
-    everywhere; callers with a fixed, known grid size can pass ``chunk=``
-    to match it (as bench.py does with 256 for its 256-point headline).
+
+def default_gls_chunk(backend=None) -> int:
+    """Static batch size for the chunked GLS grid executable on
+    ``backend`` (default: the executing backend).
+
+    Resolution order: the process override
+    (:func:`pint_tpu.config.set_grid_chunk` / ``PINT_TPU_GRID_CHUNK``;
+    typed :class:`~pint_tpu.exceptions.UsageError` on non-positive or
+    non-integer values) wins, else the measured per-backend default
+    (:data:`_STATIC_CHUNK`).  This is the *static fallback* the
+    autotuner's tuned decisions must beat — ``grid_chisq(chunk="auto")``
+    consults :func:`pint_tpu.autotune.resolve_grid_chunk`, which
+    degrades here on any manifest/fingerprint miss.  Callers with a
+    fixed, known grid size can pass ``chunk=`` to match it (as bench.py
+    does with 256 for its 256-point headline).
     """
-    return 128
+    from pint_tpu import config as _config
+
+    override = _config.grid_chunk()
+    if override is not None:
+        return int(override)
+    if backend is None:
+        backend = jax.default_backend()
+    if backend in _TPU_PLATFORMS:
+        backend = "tpu"
+    return _STATIC_CHUNK.get(backend, _STATIC_CHUNK["cpu"])
+
+
+def _resolve_auto_chunk(model, toas, chunk, gls: bool = True):
+    """The ONE spelling of the ``chunk`` string contract shared by
+    ``grid_chisq`` and ``build_grid_gls_chi2_fn``: ``"auto"`` resolves
+    the autotuner's tuned decision (static default + reasoned
+    ``tune_fallback`` event on any manifest miss), any other string is
+    a typed error, and non-strings pass through untouched.  On a
+    non-GLS workload ``"auto"`` resolves to ``None`` — there is no
+    chunked executable to tune."""
+    if not isinstance(chunk, str):
+        return chunk
+    if chunk != "auto":
+        raise UsageError(
+            f"chunk={chunk!r}: pass a positive integer, 'auto', or "
+            "None for the static default")
+    if not gls:
+        return None
+    from pint_tpu import autotune as _autotune
+
+    return _autotune.resolve_grid_chunk(model, toas)
 
 
 def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                            fit_params: Optional[Sequence[str]] = None,
-                           niter: int = 4, chunk: Optional[int] = None,
-                           grid_spans: Optional[Sequence[float]] = None):
+                           niter: int = 4, chunk=None,
+                           grid_spans: Optional[Sequence[float]] = None,
+                           correction_dtype: Optional[str] = None):
     """GLS counterpart of :func:`build_grid_chi2_fn` for correlated-noise
     models (reference benchmark ``profiling/bench_chisq_grid.py`` semantics:
     a ``GLSFitter`` refit per grid point).
@@ -394,11 +440,33 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     ``C = diag(N) + U phi U^T`` (reference ``residuals.py:584`` →
     ``utils.py:3069``).  Points are processed in fixed-size chunks so one
     compiled executable covers any grid size with bounded memory; the
-    default chunk is 128 (:func:`default_gls_chunk`, from the round-5
-    on-TPU sweep), overridable per call for a known grid size.
+    default chunk is the backend's measured static value
+    (:func:`default_gls_chunk`), overridable per call for a known grid
+    size; ``chunk="auto"`` asks the autotuner for the tuned decision
+    (:func:`pint_tpu.autotune.resolve_grid_chunk` — manifest miss
+    degrades to the static default with a reasoned telemetry event).
+
+    ``correction_dtype`` selects the precision of the Woodbury
+    chi2-correction segment (``"float64"`` | ``"float32"``); ``None``
+    consults the autotuner's dd-split-guarded probe decision, which
+    keeps float64 unless measured safe for exactly this system.
     """
+    chunk = _resolve_auto_chunk(model, toas, chunk)
     if chunk is None:
         chunk = default_gls_chunk()
+    if isinstance(chunk, bool) or not isinstance(chunk, (int, np.integer)) \
+            or int(chunk) <= 0:
+        raise UsageError(
+            f"chunk must be a positive integer or 'auto', got {chunk!r}")
+    chunk = int(chunk)
+    if correction_dtype is None:
+        from pint_tpu import autotune as _autotune
+
+        correction_dtype = _autotune.resolve_correction_dtype(model, toas)
+    if correction_dtype not in ("float64", "float32"):
+        raise UsageError(
+            f"correction_dtype must be 'float64' or 'float32', got "
+            f"{correction_dtype!r}")
     grid_params = tuple(grid_params)
     if fit_params is None:
         fit_params = tuple(p for p in model.free_params if p not in grid_params)
@@ -539,6 +607,19 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             s_col, U_chi, cf_chi))
     nl_all = nl_fit  # positions within the full value vector == fit positions
 
+    # reduced-precision segment (autotune decision grid.correction_dtype,
+    # dd-split-guarded): the Woodbury chi2-correction operands are cast
+    # ONCE here — the cached bundle stays f64, so flipping the decision
+    # never poisons the full-precision path — and the kernel computes
+    # the z = L^-1 (U^T W r) correction in that dtype, casting the
+    # scalar back to f64 for the subtraction.  float64 (the default,
+    # and the probe's outcome on every realistic workload) is the
+    # bit-identical pre-autotune path.
+    _f32_corr = correction_dtype == "float32"
+    if _f32_corr:
+        U_chi = U_chi.astype(jnp.float32)
+        cf_chi = cf_chi.astype(jnp.float32)
+
     # Solve recipe for the marginalized (Schur) timing system, fixed at
     # trace time per backend.  CPU: normalize by diag(A - Y^T Y) with a
     # 1e-12 ridge — keeps degenerate-direction refit values in lockstep
@@ -554,8 +635,10 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     _TPU = jax.default_backend() in _TPU_PLATFORMS
     _RIDGE = 1e-9 if _TPU else 1e-12
 
+    # correction_dtype sits BEFORE the nl tuple: the classification
+    # result stays the key's last element (tests introspect it there)
     grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk,
-                tuple(nl_fit))
+                correction_dtype, tuple(nl_fit))
     if grid_key not in model._cache:
         nl_idx = jnp.asarray(nl_all, dtype=jnp.int32)
         # positions of the nonlinear columns within B (offset col 0 shifts)
@@ -648,14 +731,21 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             v, (oks, conds) = jax.lax.scan(gn_step, v0, None,
                                            length=niter)
             r = resid_seconds(v, const_pv, batch, ctx, int0, w, F0)
-            # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma
+            # chi2 = r^T C^-1 r via Woodbury with the prefactored Sigma;
+            # the correction segment runs in the tuned dtype (operands
+            # pre-cast above) and its scalar is cast back to f64
             wr = w * r
-            z = jsl.solve_triangular(cf_chi, U_chi.T @ wr, lower=True)
+            if _f32_corr:
+                z = jsl.solve_triangular(
+                    cf_chi, U_chi.T @ wr.astype(jnp.float32), lower=True)
+            else:
+                z = jsl.solve_triangular(cf_chi, U_chi.T @ wr, lower=True)
             # per-point diagnostics for THIS pass: solved flag (every GN
             # iteration factored) and worst condition proxy
             diag = jnp.stack([jnp.where(jnp.all(oks), 1.0, 0.0),
                               jnp.max(conds)])
-            return jnp.sum(r * wr) - z @ z, v[:nfit], diag
+            corr = (z @ z).astype(jnp.float64)
+            return jnp.sum(r * wr) - corr, v[:nfit], diag
 
         model._cache[grid_key] = jax.jit(jax.vmap(
             chi2_point,
@@ -752,7 +842,29 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                      w, F0, B_base, A_base, Y_base, U_w, L_D, U_chi,
                      cf_chi, s_col, jnp.float64(1.0))
 
+    def cost_handle(points, sharding=None):
+        """(jitted fn, example args) for the chunk executable at these
+        points WITHOUT dispatching anything — the autotuner's AOT
+        analysis hook.  The first chunk-shaped block is built exactly
+        as :func:`fn` would (same padding, same sharding placement), so
+        the analyzed executable IS the one a sweep would run."""
+        points = jnp.asarray(points)
+        blk_size = chunk
+        if sharding is not None:
+            ndev = sharding.mesh.devices.size
+            blk_size = max(chunk, ndev) // ndev * ndev
+        blk = points[:blk_size]
+        pad = blk_size - blk.shape[0]
+        if pad:
+            blk = jnp.concatenate([blk, jnp.tile(blk[-1:], (pad, 1))])
+        if sharding is not None:
+            blk = jax.device_put(blk, sharding)
+        return vfn, (blk, free_init, const_pv, batch, ctx, int0, w, F0,
+                     B_base, A_base, Y_base, U_w, L_D, U_chi, cf_chi,
+                     s_col, jnp.float64(1.0))
+
     fn.analysis_handle = analysis_handle
+    fn.cost_handle = cost_handle
     return fn, free_init, fit_params
 
 
@@ -898,8 +1010,13 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     are no-ops — points are batched on-device, which replaces the reference's
     process pool (warned once at runtime).  Pass ``mesh`` (a
     ``jax.sharding.Mesh`` with a 'grid' axis) to shard points across devices;
-    ``chunk`` overrides the GLS path's fixed executable batch size (default
-    128, :func:`default_gls_chunk`; the tools/tpu_sweep.py knob).
+    ``chunk`` overrides the GLS path's fixed executable batch size
+    (default: the backend's static value, :func:`default_gls_chunk`,
+    itself overridable via ``PINT_TPU_GRID_CHUNK``; the
+    tools/tpu_sweep.py knob).  ``chunk="auto"`` loads the autotuner's
+    tuned decision for this workload shape + device fingerprint
+    (:mod:`pint_tpu.autotune`) and degrades to the static default — with
+    a reasoned ``tune_fallback`` telemetry event — on any manifest miss.
     ``extraparnames`` returns the per-point refit values of those parameters
     in the second return slot, shaped like the grid.
 
@@ -939,6 +1056,9 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     shape = tuple(len(g) for g in grids)
     mesh_pts = np.stack([g.ravel() for g in np.meshgrid(*grids, indexing="ij")], axis=-1)
     gls = bool(model.noise_basis_by_component(toas)[0])
+    # resolve "auto" ONCE up front (the chunk also sizes checkpoint
+    # blocks and elastic logical chunks below)
+    chunk = _resolve_auto_chunk(model, toas, chunk, gls=gls)
     if plan is not None:
         if mesh is not None:
             raise UsageError("plan= and mesh= cannot be combined; the plan "

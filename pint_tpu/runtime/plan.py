@@ -205,7 +205,11 @@ def select_plan(workload: str = "grid",
     ``n_items`` caps the rung at the batch size (meshing 8 devices for
     3 points buys nothing), ``max_devices`` caps it absolutely, and
     ``kind`` forces the mechanism (tests / explicit shard_map opt-in).
-    Emits a ``plan_selected`` telemetry event.
+    With ``axes`` unspecified, the autotuner's tuned axis order for
+    this workload is consulted (:func:`pint_tpu.autotune.
+    resolve_plan_axes` — ranked by collective bytes moved; silent
+    static default when no manifest is configured).  Emits a
+    ``plan_selected`` telemetry event.
     """
     from pint_tpu.runtime.preflight import healthy_devices
 
@@ -219,6 +223,10 @@ def select_plan(workload: str = "grid",
         raise UsageError(f"unknown workload {workload!r}; the routed "
                          f"workloads are {tuple(_WORKLOAD_AXIS)}")
     axis, default_kind = _WORKLOAD_AXIS[workload]
+    if not axes:
+        from pint_tpu import autotune as _autotune
+
+        axes = _autotune.resolve_plan_axes(workload)
     axes = tuple(axes) if axes else (axis,)
     for a in axes:
         if a not in MESH_AXES:

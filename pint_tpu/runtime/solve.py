@@ -102,15 +102,19 @@ def hardened_cholesky(A: np.ndarray, name: str = "normal matrix",
 
 
 def solve_normal_cholesky(mtcm: np.ndarray, mtcy: np.ndarray,
-                          name: str = "normal equations"):
+                          name: str = "normal equations",
+                          ladder=JITTER_LADDER):
     """``(xvar, xhat, diagnostics)`` for ``mtcm x = mtcy`` via the
     hardened ladder (host fitter path; reference ``fitter.py:2759``
-    semantics with loud failure modes)."""
+    semantics with loud failure modes).  ``ladder`` lets the autotuner's
+    tuned entry rung skip loadings measured to fail (a suffix of
+    :data:`JITTER_LADDER` — same escalation, same final loading,
+    fewer wasted factorizations)."""
     import jax.numpy as jnp
     import jax.scipy.linalg as jsl
 
     _require_finite(name, mtcy)
-    L, jitter, attempts = hardened_cholesky(mtcm, name=name)
+    L, jitter, attempts = hardened_cholesky(mtcm, name=name, ladder=ladder)
     Lj = jnp.asarray(L)
     xhat = np.asarray(jsl.cho_solve((Lj, True), jnp.asarray(mtcy)))
     xvar = np.asarray(jsl.cho_solve((Lj, True), np.eye(len(mtcy))))

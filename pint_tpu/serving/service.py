@@ -85,7 +85,21 @@ class TimingService:
 
     def __init__(self, cfg: Optional[ServeConfig] = None,
                  pool: Optional[WarmPool] = None):
-        self.cfg = cfg or ServeConfig()
+        if cfg is None:
+            cfg = ServeConfig()
+            # tuned bucket-ladder granularity (pint_tpu.autotune): with
+            # no explicit ServeConfig, a verified "serve.buckets"
+            # manifest decision replaces the static ladders (silent
+            # static default when tuning is unconfigured — an explicit
+            # cfg always wins, so a deployment's hand choice cannot be
+            # overridden by a stale manifest)
+            from pint_tpu import autotune as _autotune
+
+            tuned = _autotune.resolve_serve_buckets()
+            if tuned is not None:
+                cfg = ServeConfig(ntoa_buckets=tuned["ntoa"],
+                                  nfree_buckets=tuned["nfree"])
+        self.cfg = cfg
         if self.cfg.window_ms < 0 or self.cfg.max_queue < 1:
             raise UsageError(
                 f"ServeConfig window_ms must be >= 0 and max_queue >= 1 "

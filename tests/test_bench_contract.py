@@ -116,6 +116,20 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     # the ROADMAP's steady-state proof: the timed serving pass paid no
     # fresh XLA compiles (the bucket executable was pre-warmed)
     assert warm["steady_state_compiles"] == 0
+    # the tuned block (PR 10): the cost-model autotuner's chunk search
+    # ran next to the headline — every key present, never degraded on
+    # CPU, and the never-slower contract holds structurally
+    tuned = headline["tuned"]
+    for key in ("chunk", "static_chunk", "tuned_fits_per_s",
+                "static_fits_per_s", "tuned_vs_static", "basis",
+                "decisions"):
+        assert key in tuned, f"tuned block missing {key!r}"
+    assert "error" not in tuned, f"tuned measurement degraded: {tuned}"
+    assert tuned["static_chunk"] == 256
+    assert tuned["tuned_fits_per_s"] > 0
+    assert tuned["tuned_vs_static"] >= 1.0
+    assert tuned["basis"] == "cost+measured"
+    assert isinstance(tuned["decisions"], str) and tuned["decisions"]
     json.dumps(headline)
 
 

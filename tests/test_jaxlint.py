@@ -419,6 +419,72 @@ class TestHostCallInJit:
             findings = eng.lint_file(os.path.join(REPO, rel))
             assert findings == [], "\n".join(f.render() for f in findings)
 
+    def test_autotune_call_in_jit_flagged(self, tmp_path):
+        """The autotune layer is pure host machinery (manifest
+        filesystem I/O, AOT lower/compile analyses, timed runs) — a
+        resolve or search call inside a traced function would run per
+        TRACE and recursively re-enter tracing through its own AOT
+        analyses; the autotune submodules are policed like the
+        telemetry/serving ones."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu import autotune\n"
+            "from pint_tpu.autotune import search\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    autotune.resolve_grid_chunk(None, None)\n"
+            "    search.tune_solve_rung(None)\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+
+    def test_autotune_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — resolve the tuned value
+        on the host, close over the result in traced code."""
+        good = (
+            "import jax\n"
+            "from pint_tpu import autotune\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "def host(model, toas, x):\n"
+            "    chunk = autotune.resolve_grid_chunk(model, toas)\n"
+            "    return f(x[:chunk])\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+    def test_autotune_is_clean_target(self):
+        """pint_tpu/autotune/ itself lints clean under the host-call
+        rule (it defines no traced functions) without pragmas or
+        baseline entries."""
+        eng = Engine(rules=[HostCallInJitRule()], repo=REPO)
+        for rel in ("pint_tpu/autotune/__init__.py",
+                    "pint_tpu/autotune/search.py",
+                    "pint_tpu/autotune/manifest.py",
+                    "pint_tpu/autotune/records.py"):
+            findings = eng.lint_file(os.path.join(REPO, rel))
+            assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_autotune_in_typed_raise_targets(self, tmp_path):
+        """pint_tpu/autotune/ is a typed-raise target: a planted bare
+        ValueError in an autotune module fires, its UsageError twin
+        does not."""
+        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
+
+        assert "pint_tpu/autotune/" in DEFAULT_TARGETS
+        d = tmp_path / "pint_tpu" / "autotune"
+        d.mkdir(parents=True)
+        bad = d / "bad.py"
+        bad.write_text("def f():\n    raise ValueError('bare')\n")
+        good = d / "good.py"
+        good.write_text(
+            "from pint_tpu.exceptions import UsageError\n"
+            "def f():\n    raise UsageError('typed')\n")
+        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
+        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
+        assert eng.lint_file(str(good)) == []
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

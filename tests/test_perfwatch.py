@@ -425,3 +425,95 @@ class TestWarmSeries:
         _bench(d, 3, 100.0, extra={"warm": {
             "warm_fits_per_s": None, "error": "ImportError: broken"}})
         assert main(["--check", "--dir", d]) == 0
+
+
+def _tuned(fps=350.0, static=350.0, chunk=256, error=None):
+    block = {"chunk": chunk, "static_chunk": 256,
+             "tuned_fits_per_s": fps, "static_fits_per_s": static,
+             "tuned_vs_static": (round(fps / static, 4)
+                                 if fps is not None and static else None),
+             "basis": "cost+measured", "decisions": "abc123def456"}
+    if error is not None:
+        block.update({"tuned_fits_per_s": None, "static_fits_per_s": None,
+                      "tuned_vs_static": None, "chunk": None,
+                      "basis": None, "decisions": None, "error": error})
+    return {"tuned": block}
+
+
+class TestTunedSeries:
+    """The round-10 tuned{} block: ingestion + gating of the autotuner
+    series.  tuned_fits_per_s gates drops like the headline; the
+    tuned/static ratio gates DIRECTLY (within the newest run) because
+    the autotuner's contract is "never slower than static"."""
+
+    def test_tuned_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 10, 100.0,
+                    extra=_tuned(fps=360.5, static=350.0, chunk=128))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.tuned_fits_per_s == 360.5
+        assert r.tuned_vs_static == round(360.5 / 350.0, 4)
+        assert r.tuned_chunk == 128
+        assert r.tuned_decisions == "abc123def456"
+        doc = build_history([r])
+        assert doc["runs"][0]["tuned_fits_per_s"] == 360.5
+
+    def test_tuned_at_parity_passes(self, tmp_path):
+        d = str(tmp_path)
+        _bench(d, 9, 100.0)
+        _bench(d, 10, 100.0, extra=_tuned(fps=350.0, static=350.0))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_tuned_below_static_fails(self, tmp_path, capsys):
+        """A tuned configuration measurably slower than the static
+        default fails even with NO tuned history — the ratio gate is
+        within-run."""
+        d = str(tmp_path)
+        _bench(d, 10, 100.0, extra=_tuned(fps=200.0, static=350.0))
+        assert main(["--check", "--dir", d]) == 1
+        assert "tuned_vs_static" in capsys.readouterr().out
+
+    def test_tuned_fits_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([350.0, 360.0, 345.0], start=1):
+            _bench(d, i, 100.0, extra=_tuned(fps=v, static=v))
+        _bench(d, 4, 100.0, extra=_tuned(fps=200.0, static=200.0))
+        assert main(["--check", "--dir", d]) == 1
+        assert "tuned_fits_per_s" in capsys.readouterr().out
+
+    def test_errored_tuned_block_fails_when_history_had_tuned(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_tuned())
+        _bench(d, 3, 100.0, extra=_tuned(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 1
+        assert "tuned block degraded" in capsys.readouterr().out
+
+    def test_errored_tuned_block_clean_without_tuned_history(
+            self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0, extra=_tuned(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_malformed_tuned_block_ignored(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 11, 100.0,
+                    extra={"tuned": {"tuned_fits_per_s": "fast",
+                                     "tuned_vs_static": True,
+                                     "chunk": "auto"}})
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.tuned_fits_per_s is None
+        assert r.tuned_vs_static is None and r.tuned_chunk is None
+
+    def test_tuned_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0, extra=_tuned(fps=360.0, static=350.0,
+                                         chunk=128))
+        assert main(["--dir", d]) == 0
+        out = capsys.readouterr().out
+        assert "tuned: 360.0 fits/s (chunk 128)" in out

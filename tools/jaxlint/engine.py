@@ -163,10 +163,18 @@ _TELEMETRY_SUBMODULES = {"spans", "metrics", "jaxevents", "runlog", "costs",
 #: the same host-call-in-jit machinery as the telemetry modules
 _SERVING_SUBMODULES = {"aotcache", "warmup", "batcher", "service"}
 
+#: pint_tpu.autotune submodules are host-side the same way (manifest
+#: filesystem I/O, AOT lower/compile analyses, timed measured runs): a
+#: resolve/search call inside a traced function would run per TRACE,
+#: hang the compile on manifest I/O, and recursively re-enter tracing
+#: through its own AOT analyses
+_AUTOTUNE_SUBMODULES = {"search", "manifest", "records"}
+
 #: one table drives the ImportFrom tracking for every host-side
 #: package (the next PR's package is one row, not a copied branch)
 _HOST_PACKAGES = (("pint_tpu.telemetry", _TELEMETRY_SUBMODULES),
-                  ("pint_tpu.serving", _SERVING_SUBMODULES))
+                  ("pint_tpu.serving", _SERVING_SUBMODULES),
+                  ("pint_tpu.autotune", _AUTOTUNE_SUBMODULES))
 
 
 def _record_imports(info: FileInfo) -> None:

@@ -45,6 +45,7 @@ DEFAULT_TARGETS = (
     "pint_tpu/runtime/",
     "pint_tpu/telemetry/",
     "pint_tpu/serving/",
+    "pint_tpu/autotune/",
 )
 
 DISALLOWED = {

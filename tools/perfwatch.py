@@ -15,9 +15,12 @@ Ingestion understands every historical artifact shape: driver wrappers
 (``{"parsed": {...}, "tail": "..."}``), bare headline dicts
 (``BENCH_TPU_r05.json``), headline JSON lines embedded in a wrapper's
 ``tail`` (rounds whose ``parsed`` is null), multichip wrappers
-(``n_devices``/``ok`` + ``{"multichip_cost": ...}`` tail lines), and the
+(``n_devices``/``ok`` + ``{"multichip_cost": ...}`` tail lines), the
 round-5+ ``telemetry{...}``/``cost{...}`` blocks (compile counts, HBM
-peak, FLOPs/bytes).
+peak, FLOPs/bytes), and the round-10+ ``tuned{...}`` block (autotuned
+fits/s, tuned-vs-static ratio, decisions fingerprint — the
+tuned/static ratio gates directly: a tuned configuration may tie the
+static default but never ship slower than it).
 
 Gating (``--check``) is per series — runs sharing (metric, platform),
 because a TPU round following a CPU round is a hardware change, not a
@@ -87,6 +90,12 @@ class RunRecord:
     #: carries no warm numbers to trend, but a history that HAD them
     #: must treat this as a regression, not a silent skip
     warm_error: Optional[str] = None
+    #: from the tuned{...} block (round 10+: cost-model autotuner)
+    tuned_fits_per_s: Optional[float] = None
+    tuned_vs_static: Optional[float] = None    #: tuned / static fits-per-s
+    tuned_chunk: Optional[int] = None
+    tuned_decisions: Optional[str] = None      #: manifest digest stamp
+    tuned_error: Optional[str] = None          #: degraded tuned block
     #: multichip extras
     n_devices: Optional[int] = None
     multichip_ok: Optional[bool] = None
@@ -168,6 +177,20 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
                 setattr(rec, dst, int(warm[src]))
         if isinstance(warm.get("error"), str) and warm["error"]:
             rec.warm_error = warm["error"]
+    tuned = h.get("tuned")
+    if isinstance(tuned, dict):
+        for src, dst in (("tuned_fits_per_s", "tuned_fits_per_s"),
+                         ("tuned_vs_static", "tuned_vs_static")):
+            if isinstance(tuned.get(src), (int, float)) \
+                    and not isinstance(tuned.get(src), bool):
+                setattr(rec, dst, float(tuned[src]))
+        if isinstance(tuned.get("chunk"), int) \
+                and not isinstance(tuned.get("chunk"), bool):
+            rec.tuned_chunk = tuned["chunk"]
+        if isinstance(tuned.get("decisions"), str):
+            rec.tuned_decisions = tuned["decisions"]
+        if isinstance(tuned.get("error"), str) and tuned["error"]:
+            rec.tuned_error = tuned["error"]
     # a zero-valued errored run (the bench's error-emit contract) is a
     # failed measurement, not a 100% regression
     if rec.error is not None and not rec.value:
@@ -347,7 +370,8 @@ def check_series(runs: List[RunRecord], threshold: float,
     quantities = (("fits_per_sec", lambda r: r.value, +1),
                   ("compile_s", lambda r: r.compile_s, -1),
                   ("warm_fits_per_s", lambda r: r.warm_fits_per_s, +1),
-                  ("warm_p99_ms", lambda r: r.warm_p99_ms, -1))
+                  ("warm_p99_ms", lambda r: r.warm_p99_ms, -1),
+                  ("tuned_fits_per_s", lambda r: r.tuned_fits_per_s, +1))
     for name, get, sign in quantities:
         # gate the series' NEWEST run only: when it lacks this quantity
         # there is nothing to compare — re-gating an older run and
@@ -387,6 +411,45 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: warm block degraded "
                    f"({latest_rec.warm_error}) where prior runs "
                    "measured warm serving"))
+    # the autotuner's contract is "never slower than static": the
+    # newest run's tuned/static ratio gates DIRECTLY (within-run, so a
+    # first tuned round is covered too) — a drop below 1.0 beyond
+    # max(threshold, noise_mult x MAD of the prior rounds' ratios)
+    # means a tuned configuration shipped slower than the static
+    # default it exists to beat
+    ratio = latest_rec.tuned_vs_static
+    if ratio is not None:
+        prev_ratios = [r.tuned_vs_static for r in runs[:-1]
+                       if r.tuned_vs_static is not None]
+        scatter = 0.0
+        if prev_ratios:
+            base = _median(prev_ratios)
+            if base > 0:
+                scatter = 1.4826 * _median(
+                    [abs(v - base) for v in prev_ratios]) / base
+        bar = max(threshold, noise_mult * scatter)
+        drop = 1.0 - ratio
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="tuned_vs_static", baseline=1.0, latest=ratio,
+            rel_change=drop, bar=bar, failed=drop > bar,
+            detail=f"{latest_rec.source}: tuned/static ratio {ratio:g} "
+                   f"(chunk {latest_rec.tuned_chunk}, decisions "
+                   f"{latest_rec.tuned_decisions}); drop "
+                   f"{100 * drop:+.1f}% vs static (bar {100 * bar:.1f}%, "
+                   f"noise floor {100 * noise_mult * scatter:.1f}%)"))
+    # a degraded tuned block where prior rounds measured tuning is a
+    # regression, not a silent skip (the warm_error discipline)
+    if latest_rec.tuned_error is not None \
+            and any(r.tuned_fits_per_s is not None for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="tuned", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: tuned block degraded "
+                   f"({latest_rec.tuned_error}) where prior runs "
+                   "measured tuned throughput"))
     return verdicts
 
 
@@ -448,6 +511,12 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f"p99 {latest.warm_p99_ms} ms, "
                   f"cache_hits={latest.warm_cache_hits} "
                   f"cold_compiles={latest.warm_cold_compiles}", file=out)
+        if latest.tuned_fits_per_s is not None \
+                or latest.tuned_vs_static is not None:
+            print(f"  tuned: {latest.tuned_fits_per_s} fits/s "
+                  f"(chunk {latest.tuned_chunk}), "
+                  f"{latest.tuned_vs_static}x static, "
+                  f"decisions={latest.tuned_decisions}", file=out)
         if latest.cost:
             c = latest.cost
             print(f"  cost[{c.get('name', '?')}]: "

@@ -14,8 +14,12 @@ cost profiles, and the final metrics snapshot.
 events.jsonl): every line must be one JSON object carrying the event
 schema tag, a known ``type``, its body key, and structurally sound span
 trees (child ``parent_id`` wired to the parent, non-negative
-durations).  A ``.json`` FILE path is validated as a multichip artifact
-instead (``MULTICHIP_r*.json``: driver wrapper whose captured tail may
+durations).  A FILE path dispatches on shape: ``TUNE_*.json`` /
+``tuning.json`` validate as ``pint_tpu.autotune.manifest/1`` tuning
+manifests, ``.jsonl`` files as sweep artifacts (every schema-tagged
+``pint_tpu.telemetry.autotune/1`` line must validate; untagged legacy
+lines are 0 records and valid), and any other ``.json`` as a multichip
+artifact (``MULTICHIP_r*.json``: driver wrapper whose captured tail may
 carry ``pint_tpu.telemetry.multichip/1`` schema-tagged JSON lines —
 every tagged line must validate; untagged tails from pre-distview
 rounds stay valid).  With no paths, ``--check`` synthesizes a run
@@ -38,6 +42,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # `python tools/telemetry_report.py` spelling
     sys.path.insert(0, REPO)
 
+from pint_tpu.autotune.records import (  # noqa: E402
+    AUTOTUNE_SCHEMA,
+    TUNE_MANIFEST_SCHEMA,
+)
 from pint_tpu.telemetry.costs import (  # noqa: E402
     COST_PROFILE_SCHEMA,
     NUMERIC_FIELDS,
@@ -101,6 +109,200 @@ SERVING_EVENT_ATTRS = {
 }
 
 _AOT_ACTIONS = ("hit", "miss", "store", "degrade")
+
+#: autotune lifecycle events (pint_tpu/autotune): a verified manifest
+#: hit (tune_applied) or a reasoned degrade to the static default
+#: (tune_fallback).  Same contract style as the elastic/serving events.
+AUTOTUNE_EVENT_ATTRS = {
+    "tune_applied": {"decision": str, "value": str, "key": str},
+    "tune_fallback": {"decision": str, "reason": str},
+}
+
+
+def validate_autotune_event(ev: dict, where: str,
+                            errors: List[str]) -> None:
+    """Attr contract for tune_applied / tune_fallback records: required
+    attrs typed, a fallback's reason non-empty (the reasoned-degrade
+    contract — a silent fallback is exactly what the event exists to
+    prevent)."""
+    name = ev.get("name")
+    required = AUTOTUNE_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected {typ.__name__}")
+    if name == "tune_fallback" and not attrs.get("reason"):
+        _err(errors, where,
+             "tune_fallback must carry a non-empty 'reason'")
+
+
+def validate_autotune_record(obj, where: str, errors: List[str]) -> None:
+    """One ``pint_tpu.telemetry.autotune/1`` schema-tagged line (the
+    tpu_sweep / autotune-CLI contract).  A ``sweep`` record carries
+    EITHER a non-negative ``fits_per_sec`` OR the degraded twin's
+    ``error`` + ``failed_in`` — exactly one of the two shapes."""
+    if not isinstance(obj, dict):
+        _err(errors, where, "autotune record is not an object")
+        return
+    if obj.get("schema") != AUTOTUNE_SCHEMA:
+        _err(errors, where, f"autotune schema {obj.get('schema')!r} != "
+                            f"{AUTOTUNE_SCHEMA!r}")
+    record = obj.get("record")
+    if record == "sweep":
+        if not isinstance(obj.get("platform"), str):
+            _err(errors, where, f"sweep 'platform' is "
+                                f"{obj.get('platform')!r}, not a string")
+        for key in ("chunk", "grid_points"):
+            v = obj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                _err(errors, where, f"sweep {key!r} is {v!r}, not a "
+                                    "positive integer")
+        if obj.get("error") is not None:
+            if not (isinstance(obj.get("error"), str) and obj["error"]):
+                _err(errors, where, "degraded sweep row needs a "
+                                    "non-empty 'error' string")
+            if not isinstance(obj.get("failed_in"), str):
+                _err(errors, where, "degraded sweep row missing "
+                                    "'failed_in'")
+            if "fits_per_sec" in obj:
+                _err(errors, where, "degraded sweep row must not carry "
+                                    "'fits_per_sec'")
+        else:
+            fps = obj.get("fits_per_sec")
+            if not isinstance(fps, (int, float)) or isinstance(fps, bool) \
+                    or fps < 0:
+                _err(errors, where, f"sweep 'fits_per_sec' is {fps!r}, "
+                                    "not a non-negative number")
+    elif record == "decision":
+        _validate_decision_body(obj.get("decision"), where, errors)
+    else:
+        _err(errors, where, f"unknown autotune record {record!r} "
+                            "(known: sweep, decision)")
+
+
+def _validate_decision_body(body, where: str, errors: List[str]) -> None:
+    """One tuned-decision body (manifest entry or decision record)."""
+    if not isinstance(body, dict):
+        _err(errors, where,
+             f"decision body is {type(body).__name__}, not object")
+        return
+    for key in ("name", "vkey", "basis"):
+        if not isinstance(body.get(key), str) or not body.get(key):
+            _err(errors, where,
+                 f"decision {key!r} is {body.get(key)!r}, not a "
+                 "non-empty string")
+    if "value" not in body:
+        _err(errors, where, "decision missing 'value'")
+    if "static_default" not in body:
+        _err(errors, where, "decision missing 'static_default'")
+    cands = body.get("candidates")
+    if cands is not None:
+        if not isinstance(cands, list) or not all(
+                isinstance(c, dict) for c in cands):
+            _err(errors, where,
+                 "decision 'candidates' must be a list of objects")
+        else:
+            for i, c in enumerate(cands):
+                if "value" not in c:
+                    _err(errors, where,
+                         f"candidate {i} missing 'value'")
+                # evidence contract: a candidate either scored or was
+                # excluded with a reason — never silently neither
+                if c.get("predicted_s") is None \
+                        and c.get("measured_fits_per_s") is None \
+                        and not c.get("excluded"):
+                    _err(errors, where,
+                         f"candidate {i} ({c.get('value')!r}) carries "
+                         "neither a score nor an exclusion reason")
+
+
+def validate_tuning_manifest_doc(doc, where: str,
+                                 errors: List[str]) -> int:
+    """A ``pint_tpu.autotune.manifest/1`` document (the committed
+    ``TUNE_*.json`` artifacts and ``<tune_dir>/tuning.json``): schema
+    tag, device fingerprint, and per-entry key material + decision
+    bodies.  Returns the number of decisions checked."""
+    if not isinstance(doc, dict):
+        _err(errors, where, f"manifest is {type(doc).__name__}, not object")
+        return 0
+    if doc.get("schema") != TUNE_MANIFEST_SCHEMA:
+        _err(errors, where, f"manifest schema {doc.get('schema')!r} != "
+                            f"{TUNE_MANIFEST_SCHEMA!r}")
+    fp = doc.get("fingerprint")
+    if not isinstance(fp, dict) or not isinstance(fp.get("platform"), str):
+        _err(errors, where, "manifest 'fingerprint' must be an object "
+                            "with a 'platform' string")
+    decisions = doc.get("decisions")
+    if not isinstance(decisions, dict):
+        _err(errors, where, "manifest 'decisions' must be an object")
+        return 0
+    n = 0
+    for digest, entry in decisions.items():
+        n += 1
+        ewhere = f"{where} decision {digest[:12]}"
+        if not isinstance(entry, dict):
+            _err(errors, ewhere, "entry is not an object")
+            continue
+        if entry.get("schema") != TUNE_MANIFEST_SCHEMA:
+            _err(errors, ewhere, "entry missing the manifest schema tag "
+                                 "(key-material verification would "
+                                 "always miss)")
+        for key in ("name", "vkey"):
+            if not isinstance(entry.get(key), str):
+                _err(errors, ewhere, f"entry {key!r} is "
+                                     f"{entry.get(key)!r}, not a string")
+        if not isinstance(entry.get("fingerprint"), dict):
+            _err(errors, ewhere, "entry missing 'fingerprint' object")
+        _validate_decision_body(entry.get("decision"), ewhere, errors)
+        body = entry.get("decision")
+        if isinstance(body, dict) and isinstance(entry.get("name"), str) \
+                and body.get("name") != entry["name"]:
+            _err(errors, ewhere,
+                 f"entry name {entry['name']!r} != decision body name "
+                 f"{body.get('name')!r} (key material and body drifted)")
+    return n
+
+
+def validate_tuning_manifest_file(path: str, errors: List[str]) -> int:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _err(errors, path, f"unreadable/invalid JSON: {e}")
+        return 0
+    return validate_tuning_manifest_doc(doc, path, errors)
+
+
+def validate_sweep_file(path: str, errors: List[str]) -> int:
+    """A ``.jsonl`` sweep artifact: every schema-tagged autotune line
+    must validate; untagged lines (legacy pre-PR-10 sweeps) are 0
+    records and valid."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError as e:
+        _err(errors, path, f"unreadable: {e}")
+        return 0
+    n = 0
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and obj.get("schema") == AUTOTUNE_SCHEMA:
+            n += 1
+            validate_autotune_record(obj, f"{path}:{lineno}", errors)
+    return n
 
 
 def validate_serving_event(ev: dict, where: str,
@@ -435,6 +637,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                 else:
                     validate_elastic_event(ev, where, errors)
                     validate_serving_event(ev, where, errors)
+                    validate_autotune_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -678,15 +881,25 @@ def self_test(errors: List[str]) -> int:
         run.record_event("serve_request", bucket_ntoas=4096,
                          bucket_nfree=128, batch=4, latency_ms=3.2,
                          compiles=0, n_toas=4005, n_free=91)
+        # autotune producer drift check: the tune_applied/tune_fallback
+        # event contract (AUTOTUNE_EVENT_ATTRS) — a verified manifest
+        # hit and the mandatory-reason fallback
+        run.record_event("tune_applied", decision="grid.chunk",
+                         value="256", key="abc123def456",
+                         basis="cost+measured")
+        run.record_event("tune_fallback", decision="grid.chunk",
+                         reason="no tuned decision at this "
+                                "vkey/device fingerprint",
+                         static="128")
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
-        # sharding_plan, 3x elastic events, 3x serving events, metrics,
-        # run_end
-        if n < 16:
-            _err(errors, "selftest", f"expected >= 16 records, got {n}")
+        # sharding_plan, 3x elastic events, 3x serving events, 2x
+        # autotune events, metrics, run_end
+        if n < 18:
+            _err(errors, "selftest", f"expected >= 18 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
@@ -704,6 +917,42 @@ def self_test(errors: List[str]) -> int:
         validate_multichip_record(
             multichip_record("scaling", n_devices=8, speedup=4.0,
                              efficiency=0.5), "selftest multichip", errors)
+        # autotune sweep-record validators agree with the producer:
+        # real + degraded twins straight from sweep_record (the
+        # tpu_sweep emitter), plus a synthetic tuning-manifest document
+        # through the real decision_key material scheme — all jax-free
+        from pint_tpu.autotune.manifest import TuningDecision, decision_key
+        from pint_tpu.autotune.records import sweep_record
+
+        validate_autotune_record(
+            sweep_record("tpu", 128, 256, fits_per_sec=101.5,
+                         elapsed_s=2.52, compile_s=28.0, sanity_ok=True),
+            "selftest sweep", errors)
+        validate_autotune_record(
+            sweep_record("tpu", 512, 256, error="vmem_oom",
+                         failed_in="warmup_compile",
+                         error_detail="scoped vmem 23.5M > 16M"),
+            "selftest sweep degraded", errors)
+        fp = {"platform": "cpu", "device_kind": "selftest",
+              "num_devices": 1, "precision": "native-f64",
+              "jax_version": "0"}
+        material, digest = decision_key(
+            "grid.chunk", ("grid.chunk", 4005, 91, 1), fp)
+        entry = dict(material)
+        entry["decision"] = TuningDecision(
+            name="grid.chunk", value=256, static_default=128,
+            vkey=("grid.chunk", 4005, 91, 1), basis="cost+measured",
+            candidates=[{"value": 256, "predicted_s": 1.2e-3},
+                        {"value": 512, "excluded": "vmem budget"}],
+            measured={"256": 350.0, "128": 344.0},
+            reason="selftest").to_dict()
+        doc = {"schema": TUNE_MANIFEST_SCHEMA, "created_unix": 0.0,
+               "fingerprint": fp, "decisions": {digest: entry}}
+        if validate_tuning_manifest_doc(doc, "selftest manifest",
+                                        errors) != 1:
+            _err(errors, "selftest",
+                 "tuning-manifest round trip did not yield exactly one "
+                 "decision")
         return n
 
 
@@ -723,7 +972,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.runs:
             for p in args.runs:
                 if os.path.isfile(p):
-                    validate_multichip_file(p, errors)
+                    base = os.path.basename(p)
+                    if p.endswith(".jsonl"):
+                        validate_sweep_file(p, errors)
+                    elif base.startswith("TUNE_") \
+                            or base == "tuning.json":
+                        validate_tuning_manifest_file(p, errors)
+                    else:
+                        validate_multichip_file(p, errors)
                 else:
                     validate_run_dir(p, errors)
         else:

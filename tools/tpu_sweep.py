@@ -14,6 +14,17 @@ the small-workload path gets a TPU datapoint (VERDICT item 9).
 NEVER run while another TPU process holds the tunnel lease (bench_retry,
 precision check): concurrent clients wedge it.
 
+Every sweep row is a schema-tagged ``pint_tpu.telemetry.autotune/1``
+JSON line (``pint_tpu.autotune.records.sweep_record``; validated by
+``tools/telemetry_report --check``'s self-test), so the autotuner can
+ingest a captured sweep as its measured-confirmation source::
+
+    python -m pint_tpu.autotune --sweep TPU_SWEEP_rN.jsonl
+
+A failed configuration is the schema's *degraded twin* (``error`` +
+``failed_in`` instead of ``fits_per_sec``) — an infeasible chunk is
+data the search must see, not a dropped row.
+
 Usage:
   timeout 3000 python tools/tpu_sweep.py --quick          # 64/128 x 256
   timeout 5400 python tools/tpu_sweep.py                  # full sweep
@@ -121,30 +132,34 @@ def main():
             # flake independently of the warm-up (tunnel drop).  Either
             # way, record the failure as a sweep row so the artifact
             # documents it and the remaining configs still run.
+            from pint_tpu.autotune.records import sweep_record
+
             msg = str(e)
-            row = {"metric": "gls_grid_sweep", "platform": backend,
-                   "chunk": chunk, "grid_points": npts * npts,
-                   "error": ("vmem_oom" if "vmem" in msg else
-                             f"{type(e).__name__}"),
-                   "error_detail": msg[:300],
-                   # a compile_s with failed_in="measured_run" means the
-                   # executable built fine (distinguishes a flake from a
-                   # vmem_oom-style infeasible config)
-                   "failed_in": ("warmup_compile" if t_compile is None
-                                 else "measured_run"),
-                   "compile_s": round(t_compile if t_compile is not None
-                                      else time.time() - t0, 1)}
+            # a compile_s with failed_in="measured_run" means the
+            # executable built fine (distinguishes a flake from a
+            # vmem_oom-style infeasible config)
+            row = sweep_record(
+                backend, chunk, npts * npts,
+                error=("vmem_oom" if "vmem" in msg
+                       else f"{type(e).__name__}"),
+                error_detail=msg[:300],
+                failed_in=("warmup_compile" if t_compile is None
+                           else "measured_run"),
+                compile_s=(t_compile if t_compile is not None
+                           else time.time() - t0))
             results.append(row)
             print(json.dumps(row))
             sys.stdout.flush()
             continue
-        row = {"metric": "gls_grid_sweep", "platform": backend,
-               "chunk": chunk, "grid_points": int(chi2.size),
-               "fits_per_sec": round(chi2.size / dt, 2),
-               "elapsed_s": round(dt, 2), "compile_s": round(t_compile, 1),
-               "sanity_ok": bool(np.isfinite(chi2).all()
-                                 and abs(chi2.min() - chi2_fit)
-                                 < 0.05 * chi2_fit)}
+        from pint_tpu.autotune.records import sweep_record
+
+        row = sweep_record(
+            backend, chunk, int(chi2.size),
+            fits_per_sec=round(chi2.size / dt, 2),
+            elapsed_s=dt, compile_s=t_compile,
+            sanity_ok=bool(np.isfinite(chi2).all()
+                           and abs(chi2.min() - chi2_fit)
+                           < 0.05 * chi2_fit))
         results.append(row)
         row["_axes"] = (g_m2, g_sini)  # for the post-loop trace re-run
         print(json.dumps({k: v for k, v in row.items() if k != "_axes"}))
