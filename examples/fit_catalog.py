@@ -1,0 +1,115 @@
+"""Fit a pulsar-timing ARRAY: the PTA catalog engine end to end.
+
+Single-pulsar timing fits one par/tim pair; the real PTA workload is a
+catalog of 10^2-10^3 pulsars whose noise is correlated BETWEEN pulsars
+(the Hellings-Downs signature of a gravitational-wave background,
+arxiv 1107.5366).  This walkthrough runs the whole pipeline at CI
+size:
+
+1. **Ingest** a ragged synthetic catalog through the integrity gate —
+   corrupt rows quarantine, they never reach a fit;
+2. **Bucket** the ragged ``(n_toas, n_free)`` shapes onto ladders
+   learned from the catalog's own distribution (compile budget vs
+   padding waste);
+3. **Fit** every pulsar as ONE vmapped batched GLS program per bucket
+   (padding exact by construction — parameters match dedicated
+   per-pulsar fits), with warm per-bucket executables so repeat fits
+   pay zero compiles;
+4. **Joint likelihood**: the cross-pulsar Hellings-Downs layer — a
+   block-Woodbury lnlikelihood over the common red-noise amplitude and
+   spectral index, jitted and consumable by the MCMC sampler.
+
+Run:  python examples/fit_catalog.py [--cpu] [--pulsars N]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--cpu", action="store_true",
+                help="force the CPU backend")
+ap.add_argument("--pulsars", type=int, default=8,
+                help="catalog size (default 8)")
+args = ap.parse_args()
+if args.cpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+
+from pint_tpu.catalog import (  # noqa: E402
+    CatalogFitter,
+    JointLikelihood,
+    hd_curve,
+    ingest_catalog,
+    make_synthetic_catalog,
+)
+from pint_tpu.gls_fitter import GLSFitter  # noqa: E402
+from pint_tpu.serving import warm_catalog  # noqa: E402
+
+# -- 1. ingest: the quarantine gate is the front door -----------------------
+# two members carry one corrupt TOA each (zero uncertainty); the gate
+# quarantines the rows and the fit never sees them
+pairs = make_synthetic_catalog(n_pulsars=args.pulsars, seed=42,
+                               ntoa_range=(24, 56),
+                               bad_rows_in=[1, args.pulsars - 1])
+report = ingest_catalog(pairs)
+print(report.render())
+
+# -- 2. + 3. bucket and fit the whole catalog as batched programs -----------
+cf = CatalogFitter(report)
+print(f"\nlearned ladders: ntoa={cf.bucket_plan.ntoa_ladder} "
+      f"nfree={cf.bucket_plan.nfree_ladder} "
+      f"-> {cf.bucket_plan.n_buckets} bucket(s), "
+      f"pad waste {100 * cf.bucket_plan.pad_waste_frac:.1f}%")
+warm_catalog(cf)                     # per-bucket executables, compiled once
+res = cf.fit(maxiter=1)
+print(f"batched fit: {res.n_pulsars} pulsars in {res.n_buckets} "
+      f"program(s), {res.wall_s:.2f}s, total chi2 {res.chi2_total:.1f}")
+res2 = cf.fit(maxiter=1)
+print(f"repeat fit: {res2.wall_s:.2f}s, fresh compiles {res2.compiles} "
+      "(warm buckets)")
+
+# the batched result IS the dedicated result: check one member
+p = report.pulsars[0]
+dedicated = GLSFitter(p.toas, p.model)      # p.model stayed pristine
+dedicated.fit_toas(maxiter=1)
+for name in p.model.free_params:
+    a = float(getattr(dedicated.model, name).value)
+    b = float(getattr(p.fitted_model, name).value)
+    assert abs(a - b) <= 1e-9 * max(abs(a), 1e-30), (name, a, b)
+print(f"{p.name}: batched == dedicated GLSFitter on "
+      f"{list(p.model.free_params)}")
+
+# -- 4. the cross-pulsar Hellings-Downs likelihood --------------------------
+print(f"\nHellings-Downs curve: hd(0+)={hd_curve(1e-6):+.3f} "
+      f"hd(pi/2)={hd_curve(np.pi / 2):+.3f} hd(pi)={hd_curve(np.pi):+.3f}")
+jl = JointLikelihood(cf, n_modes=3)
+l0 = jl.lnlike_nocommon()
+parts = jl.per_pulsar_lnlike()
+assert abs(l0 - parts.sum()) <= 1e-9 * abs(parts.sum())
+print(f"zero-amplitude joint lnlike {l0:.3f} == sum of per-pulsar "
+      f"lnlikes {parts.sum():.3f} (factorization)")
+for log10_A in (-15.0, -14.0, -13.5):
+    print(f"  lnlike(log10_A={log10_A}, gamma=13/3) = "
+          f"{jl.lnlike(log10_A, 13.0 / 3.0):.3f}")
+
+# sampler consumption: the jitted batch callable drives the ensemble
+from pint_tpu.sampler import EnsembleSampler  # noqa: E402
+
+sampler = EnsembleSampler(nwalkers=8, seed=7)
+sampler.initialize_batched(jl.lnlike_batch, 2)
+rng = np.random.default_rng(7)
+pos = np.column_stack([-14.0 + 0.3 * rng.standard_normal(8),
+                       13.0 / 3.0 + 0.2 * rng.standard_normal(8)])
+sampler.run_mcmc(pos, 5)
+lnp = np.asarray(sampler._lnprob)
+print(f"\nMCMC over (log10_A, gamma): 5 steps x 8 walkers, "
+      f"acceptance {sampler.naccepted / max(sampler.ntotal, 1):.2f}, "
+      f"lnpost finite: {bool(np.all(np.isfinite(lnp)))}")
+print("\ncatalog walkthrough complete")
+sys.exit(0)

@@ -50,6 +50,7 @@ from pint_tpu.autotune.search import (
     measured_from_sweep,
     rank_grid_chunks,
     tune_bucket_ladders,
+    tune_catalog_ladders,
     tune_grid_chunk,
     tune_plan_axes,
     tune_precision,
@@ -61,12 +62,15 @@ __all__ = ["AUTOTUNE_SCHEMA", "TUNE_MANIFEST_SCHEMA", "Candidate",
            "reset_manifest_singleton", "sweep_record", "decision_record",
            "chunk_ladder", "rank_grid_chunks", "confirm_measured",
            "measured_from_sweep", "tune_grid_chunk", "tune_solve_rung",
-           "tune_plan_axes", "tune_bucket_ladders", "tune_precision",
+           "tune_plan_axes", "tune_bucket_ladders",
+           "tune_catalog_ladders", "tune_precision",
            "autotune_workload", "resolve", "resolve_grid_chunk",
            "resolve_solve_ladder", "resolve_plan_axes",
-           "resolve_serve_buckets", "resolve_correction_dtype",
+           "resolve_serve_buckets", "resolve_catalog_ladders",
+           "resolve_correction_dtype",
            "grid_chunk_vkey", "solve_rung_vkey", "plan_axes_vkey",
-           "serve_buckets_vkey", "correction_dtype_vkey"]
+           "serve_buckets_vkey", "catalog_buckets_vkey",
+           "correction_dtype_vkey"]
 
 
 def _emit_event(name: str, **attrs) -> None:
@@ -113,6 +117,15 @@ def serve_buckets_vkey() -> tuple:
     #: the serve kernel's own schema version — bucket ladders describe
     #: the deployment's request population, not one fitter
     return ("serve.buckets", 1)
+
+
+def catalog_buckets_vkey(shapes) -> tuple:
+    """Catalog bucket ladders describe one catalog's ``(n_toas,
+    n_free)`` shape distribution: the key carries the sorted multiset
+    of shapes, so an ingested pulsar (or a TOA-count change anywhere)
+    re-learns rather than replaying a stale ladder."""
+    return ("catalog.buckets",
+            tuple(sorted((int(n), int(k)) for n, k in shapes)))
 
 
 def correction_dtype_vkey(model, toas) -> tuple:
@@ -230,6 +243,25 @@ def resolve_serve_buckets() -> Optional[dict]:
     if config.tune_dir() is None:
         return None
     value, source = resolve("serve.buckets", serve_buckets_vkey(), None,
+                            requested=False)
+    if source != "tuned" or not isinstance(value, dict):
+        return None
+    ntoa, nfree = value.get("ntoa"), value.get("nfree")
+    if not (isinstance(ntoa, (list, tuple)) and ntoa
+            and isinstance(nfree, (list, tuple)) and nfree):
+        return None
+    return {"ntoa": tuple(int(b) for b in ntoa),
+            "nfree": tuple(int(b) for b in nfree)}
+
+
+def resolve_catalog_ladders(shapes) -> Optional[dict]:
+    """Tuned catalog bucket ladders (``{"ntoa": (...), "nfree":
+    (...)}``) for this shape distribution, or ``None`` (learn from the
+    catalog: :func:`pint_tpu.catalog.buckets.learn_ladders`)."""
+    if config.tune_dir() is None:
+        return None
+    value, source = resolve("catalog.buckets",
+                            catalog_buckets_vkey(shapes), None,
                             requested=False)
     if source != "tuned" or not isinstance(value, dict):
         return None

@@ -56,8 +56,8 @@ from pint_tpu.logging import log
 __all__ = ["Candidate", "predicted_seconds", "chunk_ladder",
            "rank_grid_chunks", "confirm_measured", "measured_from_sweep",
            "tune_grid_chunk", "tune_solve_rung", "tune_plan_axes",
-           "tune_bucket_ladders", "tune_precision", "autotune_workload",
-           "BUCKET_LADDERS"]
+           "tune_bucket_ladders", "tune_catalog_ladders",
+           "tune_precision", "autotune_workload", "BUCKET_LADDERS"]
 
 #: nominal roofline constants per backend family: (peak f64-equivalent
 #: FLOP/s, peak memory bandwidth B/s).  Used ONLY when the backend does
@@ -724,6 +724,93 @@ def tune_bucket_ladders(shapes: Sequence[Tuple[int, int]],
                         "ntoa": list(BUCKET_LADDERS["default"][0]),
                         "nfree": list(BUCKET_LADDERS["default"][1])},
         vkey=serve_buckets_vkey(), basis=basis,
+        candidates=[c.to_dict() for c in cands], reason=reason)
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
+def tune_catalog_ladders(shapes: Sequence[Tuple[int, int]],
+                         tuning_manifest: Optional[TuningManifest] = None
+                         ) -> TuningDecision:
+    """Pick the catalog bucket ladders for one catalog's ``(n_toas,
+    n_free)`` shape distribution: the ladders *learned* from the
+    distribution (:func:`pint_tpu.catalog.buckets.learn_ladders` — the
+    static default) compete against the serving layer's named ladders,
+    scored by the batched catalog kernel's CostProfile at each padded
+    bucket (``jit(vmap(serve_kernel))`` at batch = bucket population,
+    so padding waste AND batch fill are both priced), total predicted
+    seconds minimized, distinct-bucket count (compiles to pre-warm) as
+    the tie-break.  A candidate whose any bucket analysis degrades is
+    excluded with the reason, never scored on partial evidence."""
+    from pint_tpu.autotune import catalog_buckets_vkey
+    from pint_tpu.catalog import buckets as _cbuckets
+    from pint_tpu.catalog.batchfit import (
+        DEFAULT_CATALOG_BATCH_BUCKETS,
+        catalog_batched,
+    )
+    from pint_tpu.serving import batcher as _batcher
+    from pint_tpu.telemetry import costs as _costs
+
+    shapes = [(int(n), int(k)) for n, k in shapes]
+    if not shapes:
+        raise UsageError("catalog ladder tuning needs at least one shape")
+    learned = _cbuckets.learn_ladders(shapes)
+    ladders = {"learned": learned}
+    ladders.update(BUCKET_LADDERS)
+    static_value = {"ladder": "learned", "ntoa": list(learned[0]),
+                    "nfree": list(learned[1])}
+    cands: List[Candidate] = []
+    for name, (ntoa_ladder, nfree_ladder) in ladders.items():
+        cand = Candidate(value=name)
+        cand.extra["ntoa"] = list(ntoa_ladder)
+        cand.extra["nfree"] = list(nfree_ladder)
+        try:
+            plan = _cbuckets.assign_buckets(shapes, ntoa_ladder,
+                                            nfree_ladder, emit=False)
+            total = 0.0
+            for (bn, bk), idx in sorted(plan.buckets.items()):
+                # the fitter's own batch ladder: the cost model prices
+                # exactly the shapes CatalogFitter dispatches
+                batch = _batcher.bucket_of(len(idx),
+                                           DEFAULT_CATALOG_BATCH_BUCKETS)
+                operands = (np.zeros((batch, bn, bk)),
+                            np.zeros((batch, bn)), np.zeros((batch, bn)),
+                            np.zeros((batch, bk)), np.ones((batch, bk)))
+                prof = _costs.analyze_jitted(
+                    catalog_batched(), *operands,
+                    name=f"catalog.fit[{batch}x{bn}x{bk}]")
+                sec = predicted_seconds(prof)
+                if sec is None:
+                    raise UsageError(
+                        f"bucket ({bn}, {bk}) cost analysis degraded"
+                        + (f": {prof.error}" if prof.error else ""))
+                total += sec
+            cand.predicted_s = total
+            cand.extra["n_buckets"] = plan.n_buckets
+            cand.extra["pad_waste_frac"] = plan.pad_waste_frac
+        except Exception as e:
+            cand.excluded = f"{type(e).__name__}: {e}"
+        cands.append(cand)
+    viable = [c for c in cands if c.excluded is None]
+    if viable:
+        viable.sort(key=lambda c: (c.predicted_s, c.extra["n_buckets"]))
+        winner = viable[0]
+        value = {"ladder": winner.value, "ntoa": winner.extra["ntoa"],
+                 "nfree": winner.extra["nfree"]}
+        basis = "cost"
+        reason = (f"least total predicted batched-fit seconds over "
+                  f"{len(shapes)} catalog shape(s); "
+                  f"{winner.extra['n_buckets']} distinct bucket(s)")
+    else:
+        value, basis = dict(static_value), "static"
+        reason = ("every ladder candidate excluded "
+                  f"({'; '.join(c.excluded for c in cands[:2])}); "
+                  "learned ladders retained")
+    decision = TuningDecision(
+        name="catalog.buckets", value=value,
+        static_default=dict(static_value),
+        vkey=catalog_buckets_vkey(shapes), basis=basis,
         candidates=[c.to_dict() for c in cands], reason=reason)
     if tuning_manifest is not None:
         tuning_manifest.record(decision)

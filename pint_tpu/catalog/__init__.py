@@ -1,0 +1,60 @@
+"""PTA catalog engine: batched multi-pulsar fitting + cross-pulsar
+correlated-noise likelihood (ROADMAP item 1).
+
+The real pulsar-timing workload is an *array* of 10^2-10^3 pulsars
+with Hellings-Downs-correlated inter-pulsar noise (arxiv 1107.5366).
+This package turns the repo's single-pulsar machinery into that
+engine:
+
+* :mod:`~pint_tpu.catalog.ingest` — many par/tim pairs through the
+  one validate/quarantine gate (certified rows only; under-constrained
+  pulsars excluded with a reason);
+* :mod:`~pint_tpu.catalog.buckets` — ragged ``(n_toas, n_free)``
+  shapes onto padded shape ladders *learned* from the catalog's own
+  distribution (compile budget vs padding waste);
+* :mod:`~pint_tpu.catalog.batchfit` — one vmapped batched GLS
+  executable per bucket (padding exact by construction; per-pulsar
+  parameters match dedicated :class:`~pint_tpu.gls_fitter.GLSFitter`
+  fits to 1e-9), data-parallel over the ``pulsar`` mesh axis;
+* :mod:`~pint_tpu.catalog.crosscorr` — Hellings-Downs overlap
+  geometry (host, once per catalog);
+* :mod:`~pint_tpu.catalog.likelihood` — the block-structured joint
+  lnlikelihood (per-pulsar Woodbury blocks + low-rank HD cross term),
+  jitted, sampler-consumable, ``(pulsar, walker)``-shardable.
+
+Orchestration here is host-side (file I/O, telemetry, padding);
+calling catalog functions from traced code is a jaxlint
+host-call-in-jit finding, exactly like the serving/autotune packages.
+"""
+
+from pint_tpu.catalog.batchfit import (
+    CatalogFitResult,
+    CatalogFitter,
+    PulsarFit,
+    catalog_batched,
+)
+from pint_tpu.catalog.buckets import BucketPlan, assign_buckets, learn_ladders
+from pint_tpu.catalog.crosscorr import (
+    angular_separations,
+    hd_cholesky,
+    hd_curve,
+    hd_matrix,
+    pulsar_directions,
+)
+from pint_tpu.catalog.ingest import (
+    CatalogIngestReport,
+    CatalogPulsar,
+    ingest_catalog,
+    make_synthetic_catalog,
+)
+from pint_tpu.catalog.likelihood import JointLikelihood
+
+__all__ = [
+    "CatalogFitResult", "CatalogFitter", "PulsarFit", "catalog_batched",
+    "BucketPlan", "assign_buckets", "learn_ladders",
+    "angular_separations", "hd_cholesky", "hd_curve", "hd_matrix",
+    "pulsar_directions",
+    "CatalogIngestReport", "CatalogPulsar", "ingest_catalog",
+    "make_synthetic_catalog",
+    "JointLikelihood",
+]

@@ -1,0 +1,376 @@
+"""Batched multi-pulsar GLS fitting: the whole catalog in one program.
+
+PR 8's serving batcher proved the kernel shape in miniature: padded
+``(batch, n_toas, n_free)`` buckets whose block-diagonal Cholesky makes
+the padded solve EXACTLY the dedicated solve (zero-weight pad rows,
+zero pad columns, unit pad-diagonal).  This module promotes that from
+"batch identical requests" to "fit the whole catalog": every pulsar's
+linearized Woodbury system (:func:`pint_tpu.gls_fitter.
+linearized_system` via :class:`~pint_tpu.serving.batcher.FitRequest`)
+is padded into its learned bucket
+(:mod:`pint_tpu.catalog.buckets`) and each bucket dispatches ONE
+vmapped batched Gauss-Newton executable — the serving layer's
+:func:`~pint_tpu.serving.batcher.serve_kernel` under ``jax.vmap``, so
+the per-pulsar parameters match dedicated :class:`~pint_tpu.
+gls_fitter.GLSFitter` fits to 1e-9 by the same block-diagonal
+construction the serving tests pin.
+
+The pulsar axis is embarrassingly parallel, so a ``catalog``
+:class:`~pint_tpu.runtime.plan.ExecutionPlan` shards the batch axis
+over the mesh's ``pulsar`` axis (data-parallel pjit — no cross-device
+reduction exists to pay for), which is the honest multichip scaling
+route ROADMAP item 2 asks ``tools/scalewatch.py --workload catalog``
+to measure.  Warm pools (:func:`pint_tpu.serving.warmup.warm_catalog`)
+hold the per-bucket executables so steady-state catalog refits run
+with ``compiles=0``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import NonFiniteSystemError, UsageError
+from pint_tpu.logging import log
+
+__all__ = ["CatalogFitter", "CatalogFitResult", "PulsarFit",
+           "catalog_batched", "DEFAULT_CATALOG_BATCH_BUCKETS"]
+
+#: batch-axis ladder for bucket groups (powers of two so an elastic
+#: mesh rung always divides the batch)
+DEFAULT_CATALOG_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _emit_event(name: str, **attrs) -> None:
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+#: the batched catalog executable: jit(vmap(serve_kernel)) — one
+#: compile per (batch, bucket_ntoas, bucket_nfree, sharding) signature,
+#: shared process-wide through jit's dispatch cache (module-level so
+#: repeat CatalogFitters retrace into the warm cache, the serving
+#: discipline)
+_catalog_batched_jit = None
+
+
+def catalog_batched():
+    """The module's jitted ``vmap(serve_kernel)`` (lazy: importing the
+    catalog package must not import jax)."""
+    global _catalog_batched_jit
+    if _catalog_batched_jit is None:
+        import jax
+
+        from pint_tpu.serving.batcher import serve_kernel
+
+        _catalog_batched_jit = jax.jit(jax.vmap(serve_kernel))
+    return _catalog_batched_jit
+
+
+@dataclass
+class PulsarFit:
+    """One array member's unpadded fit outcome."""
+
+    name: str
+    chi2: float                      #: post-fit residual chi2
+    chi2_initial: float              #: linearized chi2 as submitted
+    dpars: Dict[str, float]          #: last iteration's physical steps
+    errors: Dict[str, float]         #: physical 1-sigma errors
+    bucket: Tuple[int, int]
+    n_toas: int
+    n_quarantined: int = 0
+
+
+@dataclass
+class CatalogFitResult:
+    """Outcome of one :meth:`CatalogFitter.fit` pass."""
+
+    fits: List[PulsarFit] = field(default_factory=list)
+    n_buckets: int = 0
+    pad_waste_frac: float = 0.0
+    compiles: int = 0                #: fresh XLA compiles this pass paid
+    wall_s: float = 0.0
+    maxiter: int = 1
+
+    @property
+    def n_pulsars(self) -> int:
+        return len(self.fits)
+
+    @property
+    def chi2_total(self) -> float:
+        return float(sum(f.chi2 for f in self.fits))
+
+    def by_name(self) -> Dict[str, PulsarFit]:
+        return {f.name: f for f in self.fits}
+
+    def to_dict(self) -> dict:
+        return {
+            "n_pulsars": self.n_pulsars,
+            "n_buckets": self.n_buckets,
+            "pad_waste_frac": self.pad_waste_frac,
+            "compiles": self.compiles,
+            "wall_s": self.wall_s,
+            "chi2_total": self.chi2_total,
+        }
+
+
+class CatalogFitter:
+    """Fit a certified catalog as one batched program per bucket.
+
+    ``catalog`` is a :class:`~pint_tpu.catalog.ingest.
+    CatalogIngestReport` (or a plain sequence of
+    :class:`~pint_tpu.catalog.ingest.CatalogPulsar`).  Ladders default
+    to the autotuner's tuned catalog ladders when a manifest is
+    configured (:func:`pint_tpu.autotune.resolve_catalog_ladders`),
+    else to ladders learned from this catalog's own shape distribution
+    (:func:`~pint_tpu.catalog.buckets.learn_ladders`).
+
+    ``plan`` routes every bucket dispatch through the execution-plan
+    layer (``"auto"`` selects a ``catalog`` plan over the ``pulsar``
+    axis from the preflight-certified devices); ``pool`` supplies warm
+    AOT handles per bucket executable
+    (:meth:`warm` / :func:`~pint_tpu.serving.warmup.warm_catalog`).
+    """
+
+    def __init__(self, catalog, ntoa_ladder: Optional[Sequence[int]] = None,
+                 nfree_ladder: Optional[Sequence[int]] = None,
+                 batch_ladder: Sequence[int] = DEFAULT_CATALOG_BATCH_BUCKETS,
+                 plan=None, pool=None):
+        from pint_tpu.catalog.buckets import assign_buckets, learn_ladders
+
+        pulsars = list(getattr(catalog, "pulsars", catalog))
+        if not pulsars:
+            raise UsageError("CatalogFitter needs at least one pulsar")
+        self.pulsars = pulsars
+        self.batch_ladder = tuple(sorted(int(b) for b in batch_ladder))
+        if not self.batch_ladder or self.batch_ladder[0] < 1:
+            raise UsageError("batch_ladder needs positive rungs")
+        self.pool = pool
+        self.plan = self._resolve_plan(plan)
+        #: the padded-bucket shape of each pulsar's linearized system —
+        #: derived from ONE request build (each pulsar's linearization
+        #: is the expensive part), which is then memoized for the first
+        #: fit/warm pass (the state cannot have changed in between;
+        #: anything after a fit iteration rebuilds)
+        self._request_memo = self._build_requests()
+        self.shapes = [(q.n_toas, q.n_free) for q in self._request_memo]
+        if ntoa_ladder is None and nfree_ladder is None:
+            from pint_tpu import autotune as _autotune
+
+            tuned = _autotune.resolve_catalog_ladders(self.shapes)
+            if tuned is not None:
+                ntoa_ladder, nfree_ladder = tuned["ntoa"], tuned["nfree"]
+        if ntoa_ladder is None or nfree_ladder is None:
+            learned_n, learned_k = learn_ladders(self.shapes)
+            ntoa_ladder = ntoa_ladder or learned_n
+            nfree_ladder = nfree_ladder or learned_k
+        self.bucket_plan = assign_buckets(self.shapes, ntoa_ladder,
+                                          nfree_ladder)
+        self.last_result: Optional[CatalogFitResult] = None
+
+    def _resolve_plan(self, plan):
+        if plan is None:
+            return None
+        if isinstance(plan, str):
+            from pint_tpu.runtime.plan import select_plan
+
+            if plan != "auto":
+                raise UsageError(f"plan={plan!r}: pass 'auto' or an "
+                                 "ExecutionPlan")
+            plan = select_plan("catalog", n_items=len(self.pulsars))
+        if plan.axes[0] != "pulsar":
+            raise UsageError(
+                f"catalog plans shard the batch axis over 'pulsar'; got "
+                f"axes {plan.axes} (select_plan('catalog') builds one)")
+        return plan
+
+    # -- operands ----------------------------------------------------------
+
+    def _build_requests(self):
+        from pint_tpu.serving.batcher import FitRequest
+
+        return [FitRequest.from_fitter(p.fitter, request_id=p.name)
+                for p in self.pulsars]
+
+    def _requests(self):
+        """The per-pulsar linearized systems at the current state; the
+        constructor's build is served once (first warm or fit pass),
+        then every call re-linearizes."""
+        if self._request_memo is not None:
+            reqs, self._request_memo = self._request_memo, None
+            return reqs
+        return self._build_requests()
+
+    def _group_operands(self, bucket: Tuple[int, int],
+                        reqs: List) -> tuple:
+        """Stack one bucket group's padded operands; batch axis padded
+        to its ladder rung (repeating the first member — deterministic
+        and trivially nonsingular, the serving discipline) and to a
+        multiple of the plan's pulsar-axis shard count."""
+        from pint_tpu.serving.batcher import bucket_of, pad_request
+
+        bn, bk = bucket
+        batch = bucket_of(len(reqs), self.batch_ladder)
+        if self.plan is not None and self.plan.mesh is not None:
+            shards = int(self.plan.mesh.shape[self.plan.axes[0]])
+            batch = max(batch, shards)  # both powers of two: divisible
+        padded = [pad_request(q, bn, bk) for q in reqs]
+        while len(padded) < batch:
+            padded.append(padded[0])
+        operands = tuple(np.stack([p[i] for p in padded])
+                         for i in range(5))
+        if self.plan is not None and self.plan.mesh is not None:
+            import jax
+
+            sharding = self.plan.batch_sharding()
+            operands = tuple(jax.device_put(a, sharding)
+                             for a in operands)
+        return operands
+
+    @staticmethod
+    def _bucket_name(batch: int, bucket: Tuple[int, int]) -> str:
+        """The ONE spelling of a bucket executable's name — warm-pool
+        entries key on it, so the warm path and the fit path must never
+        drift (a mismatch would silently fall through to a fresh jit)."""
+        return f"catalog.fit[{batch}x{bucket[0]}x{bucket[1]}]"
+
+    def bucket_executables(self) -> Dict[str, tuple]:
+        """``name -> (jitted fn, operands)`` per bucket at the CURRENT
+        linearized state — the handles the warm pool compiles and the
+        cost/distview observatory analyzes (what is warmed/analyzed IS
+        what :meth:`fit` dispatches)."""
+        reqs = self._requests()
+        out: Dict[str, tuple] = {}
+        for bucket, idx in sorted(self.bucket_plan.buckets.items()):
+            group = [reqs[i] for i in idx]
+            operands = self._group_operands(bucket, group)
+            name = self._bucket_name(operands[0].shape[0], bucket)
+            out[name] = (catalog_batched(), operands)
+        return out
+
+    # -- warm-up -----------------------------------------------------------
+
+    def warm(self, pool=None):
+        """Compile every bucket executable once, ahead of the fit.
+
+        With a :class:`~pint_tpu.serving.warmup.WarmPool` the handles
+        are AOT-compiled (and persisted through the AOT cache when one
+        is configured); without one the module jit is primed so later
+        passes hit the dispatch cache.  Either way subsequent
+        :meth:`fit` passes run with zero fresh compiles across buckets
+        — the steady state the acceptance pin measures.  Returns a
+        :class:`~pint_tpu.serving.warmup.WarmupReport` (empty entries
+        on the pool-less path)."""
+        from pint_tpu.serving.warmup import WarmupReport
+
+        if pool is not None:
+            self.pool = pool
+        report = WarmupReport()
+        for name, (fn, operands) in self.bucket_executables().items():
+            if self.pool is not None:
+                report.entries.append(self.pool.warm(
+                    name, fn, operands, vkey=("catalog_kernel", 1)))
+            else:
+                fn(*operands)  # prime jit's dispatch cache
+        return report
+
+    # -- the fit -----------------------------------------------------------
+
+    def fit(self, maxiter: int = 1) -> CatalogFitResult:
+        """Fit every pulsar: per iteration, rebuild each pulsar's
+        linearized system at its current state, dispatch one batched
+        executable per bucket, and apply the unpadded steps to the
+        per-pulsar models (mirroring the dedicated
+        :class:`~pint_tpu.gls_fitter.GLSFitter` application, so
+        parameters match dedicated fits to 1e-9).  Raises
+        :class:`~pint_tpu.exceptions.NonFiniteSystemError` when any
+        pulsar's post-fit chi2 is non-finite (a poisoned member must
+        not hide in an aggregate)."""
+        from pint_tpu.telemetry import jaxevents as _jaxevents
+        from pint_tpu.telemetry import span as _span
+
+        maxiter = max(1, int(maxiter))
+        t0 = time.perf_counter()
+        before = _jaxevents.counts()
+        kernel_out: Dict[int, tuple] = {}
+        reqs: List = []
+        with _span("catalog.fit", n_pulsars=len(self.pulsars),
+                   n_buckets=self.bucket_plan.n_buckets,
+                   maxiter=maxiter) as sp, _jaxevents.watch(sp):
+            for it in range(maxiter):
+                reqs = self._requests()
+                for bucket, idx in sorted(self.bucket_plan.buckets.items()):
+                    group = [reqs[i] for i in idx]
+                    operands = self._group_operands(bucket, group)
+                    name = self._bucket_name(operands[0].shape[0],
+                                             bucket)
+                    handle = None
+                    if self.pool is not None:
+                        handle = self.pool.lookup(name, operands)
+                    fn = handle if handle is not None else catalog_batched()
+                    out = [np.asarray(o) for o in fn(*operands)]
+                    for j, i in enumerate(idx):
+                        kernel_out[i] = (out[0][j], out[1][j],
+                                         float(out[2][j]),
+                                         float(out[3][j]), bucket)
+                self._apply(reqs, kernel_out)
+            result = CatalogFitResult(
+                n_buckets=self.bucket_plan.n_buckets,
+                pad_waste_frac=float(self.bucket_plan.pad_waste_frac),
+                compiles=int((_jaxevents.counts() - before).compiles),
+                wall_s=time.perf_counter() - t0, maxiter=maxiter)
+            for i, p in enumerate(self.pulsars):
+                dx, err, _, chi2_init, bucket = kernel_out[i]
+                req = reqs[i]
+                chi2 = float(p.fitter.resids.calc_chi2())
+                if not np.isfinite(chi2):
+                    raise NonFiniteSystemError(
+                        f"catalog fit produced non-finite chi2 for "
+                        f"{p.name} (non-finite residuals or a poisoned "
+                        "solve)")
+                k = req.n_free
+                norm = req.norm if req.norm is not None else np.ones(k)
+                result.fits.append(PulsarFit(
+                    name=p.name, chi2=chi2, chi2_initial=chi2_init,
+                    dpars={par: float(dx[j] / norm[j])
+                           for j, par in enumerate(req.params)},
+                    errors={par: float(err[j] / norm[j])
+                            for j, par in enumerate(req.params)},
+                    bucket=bucket, n_toas=p.n_toas,
+                    n_quarantined=p.n_quarantined))
+            sp.attrs["chi2_total"] = result.chi2_total
+        self.last_result = result
+        log.info(f"catalog fit: {result.n_pulsars} pulsar(s) in "
+                 f"{result.n_buckets} bucket(s), "
+                 f"{result.compiles} compile(s), "
+                 f"{result.wall_s:.3f}s")
+        return result
+
+    def _apply(self, reqs, kernel_out) -> None:
+        """Apply one iteration's unpadded steps to the per-pulsar
+        FITTER models (dedicated :class:`~pint_tpu.gls_fitter.
+        GLSFitter` semantics: the fitter works on its own model copy,
+        the ingest model stays pristine): named timing parameters move
+        by the physical step, 'Offset' never materializes, and the
+        residual state refreshes for the next linearization."""
+        for i, p in enumerate(self.pulsars):
+            dx, err, _, _, _ = kernel_out[i]
+            req = reqs[i]
+            k = req.n_free
+            norm = req.norm if req.norm is not None else np.ones(k)
+            for j, par_name in enumerate(req.params):
+                if par_name == "Offset":
+                    continue
+                par = getattr(p.fitter.model, par_name)
+                par.value = float(par.value or 0.0) \
+                    + float(dx[j] / norm[j])
+                par.uncertainty = float(err[j] / norm[j])
+                p.fitter.errors[par_name] = float(err[j] / norm[j])
+            p.fitter.update_resids()

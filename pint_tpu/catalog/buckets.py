@@ -1,0 +1,153 @@
+"""Catalog shape buckets: ragged TOA counts onto a padded shape ladder.
+
+A 10^2-pulsar catalog has 10^2 distinct ``(n_toas, n_free)`` shapes;
+compiling one executable per shape is exactly the cost the serving
+layer's bucket grid was built to avoid.  This module *learns* the
+ladder from the catalog's own shape distribution instead of guessing:
+:func:`learn_ladders` walks each dimension's values largest-first and
+opens a new rung only when padding to the current rung would waste
+more than the budget, so a tight catalog gets few buckets and a wild
+one gets more — never more than ``max_rungs`` (the compile budget).
+
+Bucket membership reuses the serving layer's
+:func:`~pint_tpu.serving.batcher.bucket_of` rounding (one rounding
+rule everywhere), and the assignment emits a ``catalog_bucket``
+telemetry event (bucket count, ladder, padding waste) that
+``tools/telemetry_report --check`` validates and ``bench.py`` /
+``tools/perfwatch.py`` trend as ``pad_waste_frac``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["learn_ladders", "assign_buckets", "BucketPlan"]
+
+
+def _emit_event(name: str, **attrs) -> None:
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+def _learn_one(values: Sequence[int], pad_budget: float,
+               max_rungs: int) -> Tuple[int, ...]:
+    """Rungs for one dimension, largest-first greedy: a value opens a
+    new rung when padding it to the current rung would waste more than
+    ``pad_budget`` of the rung.  If that yields more than ``max_rungs``
+    rungs, the budget doubles until the compile budget is met (waste is
+    a cost, a compile explosion is a failure)."""
+    vals = sorted({int(v) for v in values}, reverse=True)
+    budget = float(pad_budget)
+    while True:
+        rungs = [vals[0]]
+        for v in vals[1:]:
+            if (rungs[-1] - v) / rungs[-1] > budget:
+                rungs.append(v)
+        if len(rungs) <= max_rungs:
+            return tuple(sorted(rungs))
+        budget *= 2.0
+
+
+def learn_ladders(shapes: Sequence[Tuple[int, int]],
+                  pad_budget: float = 0.25,
+                  max_rungs: int = 4) -> Tuple[Tuple[int, ...],
+                                               Tuple[int, ...]]:
+    """``(ntoa_ladder, nfree_ladder)`` learned from a catalog's
+    ``(n_toas, n_free)`` shape distribution.  Deterministic; every
+    catalog shape fits under its ladder top by construction (the
+    largest value is always a rung)."""
+    shapes = [(int(n), int(k)) for n, k in shapes]
+    if not shapes:
+        raise UsageError("learn_ladders needs at least one shape")
+    if any(n < 1 or k < 1 for n, k in shapes):
+        raise UsageError(f"shapes must be positive, got {shapes}")
+    if not (0.0 < pad_budget < 1.0):
+        raise UsageError(f"pad_budget must be in (0, 1), got {pad_budget}")
+    if max_rungs < 1:
+        raise UsageError(f"max_rungs must be >= 1, got {max_rungs}")
+    return (_learn_one([n for n, _ in shapes], pad_budget, max_rungs),
+            _learn_one([k for _, k in shapes], pad_budget, max_rungs))
+
+
+@dataclass
+class BucketPlan:
+    """One catalog's bucket assignment: which pulsar sits in which
+    padded shape, and what the padding costs."""
+
+    ntoa_ladder: Tuple[int, ...]
+    nfree_ladder: Tuple[int, ...]
+    shapes: List[Tuple[int, int]]
+    #: (bucket_ntoas, bucket_nfree) -> member indices into ``shapes``
+    buckets: Dict[Tuple[int, int], List[int]] = field(default_factory=dict)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def pad_waste_frac(self) -> float:
+        """Fraction of the padded cell count that is padding:
+        ``1 - sum(n_i * k_i) / sum(bn_i * bk_i)`` over members."""
+        real = sum(n * k for n, k in self.shapes)
+        padded = sum(bn * bk * len(idx)
+                     for (bn, bk), idx in self.buckets.items())
+        return 1.0 - real / padded if padded else 0.0
+
+    def bucket_of_index(self, i: int) -> Tuple[int, int]:
+        for b, idx in self.buckets.items():
+            if i in idx:
+                return b
+        raise KeyError(f"index {i} is in no bucket")
+
+    def to_dict(self) -> dict:
+        return {
+            "ntoa_ladder": list(self.ntoa_ladder),
+            "nfree_ladder": list(self.nfree_ladder),
+            "n_buckets": self.n_buckets,
+            "pad_waste_frac": self.pad_waste_frac,
+            "buckets": {f"{bn}x{bk}": len(idx)
+                        for (bn, bk), idx in sorted(self.buckets.items())},
+        }
+
+
+def assign_buckets(shapes: Sequence[Tuple[int, int]],
+                   ntoa_ladder: Sequence[int],
+                   nfree_ladder: Sequence[int],
+                   emit: bool = True) -> BucketPlan:
+    """Round every catalog shape up its ladders
+    (:func:`~pint_tpu.serving.batcher.bucket_of` — shapes past a
+    ladder top double, they never fail) and group members per padded
+    shape.  Emits the ``catalog_bucket`` telemetry event unless
+    ``emit=False`` (re-assignments inside a sweep)."""
+    from pint_tpu.serving.batcher import bucket_of
+
+    shapes = [(int(n), int(k)) for n, k in shapes]
+    if not shapes:
+        raise UsageError("assign_buckets needs at least one shape")
+    plan = BucketPlan(ntoa_ladder=tuple(sorted(int(b) for b in ntoa_ladder)),
+                      nfree_ladder=tuple(sorted(int(b)
+                                                for b in nfree_ladder)),
+                      shapes=shapes)
+    if not (plan.ntoa_ladder and plan.nfree_ladder):
+        raise UsageError("both ladders need at least one rung")
+    for i, (n, k) in enumerate(shapes):
+        b = (bucket_of(n, plan.ntoa_ladder),
+             bucket_of(k, plan.nfree_ladder))
+        plan.buckets.setdefault(b, []).append(i)
+    if emit:
+        _emit_event("catalog_bucket",
+                    n_pulsars=len(shapes),
+                    n_buckets=plan.n_buckets,
+                    pad_waste_frac=float(plan.pad_waste_frac),
+                    ntoa_ladder=",".join(str(b)
+                                         for b in plan.ntoa_ladder),
+                    nfree_ladder=",".join(str(b)
+                                          for b in plan.nfree_ladder))
+    return plan

@@ -1,0 +1,361 @@
+"""Joint cross-pulsar correlated-noise log-likelihood (Hellings-Downs).
+
+The array likelihood of arxiv 1107.5366: the stacked TOA covariance is
+
+    C = blockdiag(P_a) + F Phi F^T
+    P_a = N_a + U_a phi_a U_a^T          (per-pulsar white + basis noise)
+    Phi = HD (x) diag(phi_gw)            (common process, HD-correlated)
+
+where ``U_a`` is pulsar *a*'s augmented basis (timing columns under the
+enterprise 1e40 prior + its own noise bases — exactly the Woodbury
+system :func:`pint_tpu.gls_fitter.linearized_system` builds), ``F_a``
+a common Fourier basis, and ``phi_gw`` the power-law spectrum of the
+gravitational-wave background whose inter-pulsar correlation is the
+Hellings-Downs overlap matrix (:mod:`pint_tpu.catalog.crosscorr`).
+
+The evaluation is block-structured Woodbury over the per-pulsar blocks
+plus the low-rank cross term, never the dense ``C``:
+
+    r^T C^-1 r = sum_a r_a^T P_a^-1 r_a - v^T M^-1 v
+    ln det C   = sum_a ln det P_a + ln det M
+    M = I + S^T blockdiag(F_a^T P_a^-1 F_a) S,   v = S^T [F_a^T P_a^-1 r_a]
+    S = kron(L_HD, diag(sqrt(phi_gw)))           (HD Cholesky, host)
+
+Every per-pulsar piece is ONE vmapped computation over the padded
+pulsar axis (zero-weight pad rows, unit pad-diagonal — the same
+exact-by-construction padding the batched fitter uses), and the cross
+term is a small ``(n_pulsars * 2 n_modes)`` dense solve.  ``S`` is
+linear in the GW amplitude, so at ``amp == 0`` the correction is
+*identically* zero and the joint likelihood factorizes into the sum
+of per-pulsar likelihoods — the acceptance pin.
+
+The jitted form is consumable by the sampler
+(:meth:`JointLikelihood.lnlike_batch` maps ``(walkers, 2)`` points of
+``(log10_A, gamma)`` to lnlike values) and shards data-parallel under
+a ``catalog`` execution plan: padded per-pulsar operands over the
+``pulsar`` mesh axis, walker points over ``walker``.
+
+HOST-RANGE CAVEAT: the enterprise timing prior (1e40) enters as
+``phiinv ~ 1e-40`` data operands; on TPU f64-emulation backends these
+exceed float32 RANGE (DESIGN.md round 5) — the joint likelihood is a
+host/CPU-f64 and native-f64 code path until the precision arc
+(ROADMAP item 4) gives it a range-safe split.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["JointLikelihood", "FYR_HZ"]
+
+#: one inverse year in Hz — the PTA convention's spectrum reference
+FYR_HZ = 1.0 / (365.25 * 86400.0)
+
+_DAY_S = 86400.0
+
+
+def _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a, n2pi):
+    """One pulsar's marginalized Woodbury pieces — the traced block
+    shared by the joint kernel and :meth:`JointLikelihood.
+    per_pulsar_lnlike` (one copy: a formula fix cannot drift between
+    the two sides of the factorization pin).  Returns ``(lnl, Ms, cf,
+    xb)``: the per-pulsar lnlikelihood plus the scaled design, factored
+    basis-space matrix, and solved projection the cross term reuses.
+
+    Padding is exact here too: pad rows carry ``w == 0`` (excluded
+    from every sum and from the white-noise determinant), pad columns
+    carry ``phiinv == 0`` (excluded from the scaled prior determinant)
+    and a unit pad-diagonal (their Sigma block is the identity —
+    log-det 0)."""
+    import jax.numpy as jnp
+    import jax.scipy.linalg as jsl
+
+    # unit-W-norm column scaling: the fitter family's conditioning
+    # move; pad columns (phiinv 0, zero data) scale to 1 and pick up
+    # only their unit pad-diagonal, contributing exactly 0 below
+    wM = w_a[:, None] * M_a
+    s = jnp.sqrt(jnp.sum(wM * M_a, axis=0) + phiinv_a)
+    s = jnp.where(s > 0, s, 1.0)
+    Ms = M_a / s
+    Sigma = Ms.T @ (w_a[:, None] * Ms) + jnp.diag(phiinv_a / s**2) \
+        + jnp.diag(pad_a)
+    cf = jsl.cho_factor(Sigma, lower=True)
+    b = Ms.T @ (w_a * r_a)
+    xb = jsl.cho_solve(cf, b)
+    rNr = jnp.sum(w_a * r_a * r_a)
+    lndetN = -jnp.sum(jnp.where(w_a > 0, jnp.log(w_a), 0.0))
+    lndet_phi = jnp.sum(jnp.where(
+        phiinv_a > 0, jnp.log(s * s) - jnp.log(
+            jnp.where(phiinv_a > 0, phiinv_a, 1.0)), 0.0))
+    lndet_sigma = 2.0 * jnp.sum(jnp.log(jnp.diag(cf[0])))
+    n_real = jnp.sum(w_a > 0)
+    lnl = -0.5 * (rNr - jnp.dot(b, xb) + lndetN + lndet_phi
+                  + lndet_sigma + n_real * n2pi)
+    return lnl, Ms, cf, xb
+
+
+def _joint_kernel(amp, gamma, M, r, w, phiinv, pad_free, F, Lhd, freqs,
+                  Tspan, n2pi):
+    """The traced joint lnlike: per-pulsar Woodbury pieces vmapped over
+    the padded pulsar axis + the low-rank HD cross term.  ``amp`` is
+    the LINEAR GW amplitude (zero is exact: the cross term vanishes
+    identically, no branch needed)."""
+    import jax
+    import jax.numpy as jnp
+    import jax.scipy.linalg as jsl
+
+    def one(M_a, r_a, w_a, phiinv_a, pad_a, F_a):
+        lnl, Ms, cf, xb = _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a,
+                                        n2pi)
+        # cross-term projections: F^T P^-1 r and F^T P^-1 F via the
+        # same factored Sigma (Woodbury action, no dense P)
+        WF = w_a[:, None] * F_a
+        A_mf = Ms.T @ WF
+        y_a = F_a.T @ (w_a * r_a) - A_mf.T @ xb
+        X_a = F_a.T @ WF - A_mf.T @ jsl.cho_solve(cf, A_mf)
+        return lnl, y_a, X_a
+
+    lnl, ys, Xs = jax.vmap(one)(M, r, w, phiinv, pad_free, F)
+    # common power-law spectrum (enterprise convention): per-mode
+    # variance of the Fourier coefficients, both quadratures sharing it
+    phi_gw = (amp * amp / (12.0 * jnp.pi**2)
+              * FYR_HZ ** (gamma - 3.0) * freqs ** (-gamma) / Tspan)
+    sqp = jnp.sqrt(jnp.repeat(phi_gw, 2))          # (2m,), linear in amp
+    n_p, two_m = ys.shape
+    Xs_s = sqp[None, :, None] * Xs * sqp[None, None, :]
+    E = jnp.einsum("ca,cb,cij->aibj", Lhd, Lhd, Xs_s)
+    R = n_p * two_m
+    Minner = jnp.eye(R) + E.reshape(R, R)
+    v = jnp.einsum("ca,ci->ai", Lhd, sqp[None, :] * ys).reshape(R)
+    cfi = jsl.cho_factor(Minner, lower=True)
+    q = jsl.cho_solve(cfi, v)
+    lndetM = 2.0 * jnp.sum(jnp.log(jnp.diag(cfi[0])))
+    return jnp.sum(lnl) + 0.5 * jnp.dot(v, q) - 0.5 * lndetM
+
+
+class JointLikelihood:
+    """The catalog's joint lnlikelihood, jitted and sampler-ready.
+
+    Built from a :class:`~pint_tpu.catalog.batchfit.CatalogFitter` (or
+    a plain sequence of :class:`~pint_tpu.catalog.ingest.
+    CatalogPulsar`): each pulsar contributes its current linearized
+    Woodbury system, padded to ONE common ``(n_toa_pad, n_basis_pad)``
+    shape so the per-pulsar stage is a single vmapped program.
+
+    ``n_modes`` Fourier modes at ``j / T_span`` form the common basis;
+    the overlap matrix comes from the models' sky positions
+    (:func:`~pint_tpu.catalog.crosscorr.hd_cholesky`, host, once).
+    ``plan`` (a ``catalog`` :class:`~pint_tpu.runtime.plan.
+    ExecutionPlan`) places the padded pulsar axis over the mesh's
+    ``pulsar`` axis and — when the plan carries a ``walker`` axis —
+    walker points over ``walker``: the data-parallel ``(pulsar,
+    walker)`` sharding ROADMAP item 2 prescribes."""
+
+    def __init__(self, catalog, n_modes: int = 5, plan=None,
+                 pad_shape: Optional[Tuple[int, int]] = None):
+        from pint_tpu.catalog.crosscorr import hd_cholesky
+        from pint_tpu.serving.batcher import FitRequest, pad_request
+
+        pulsars = list(getattr(catalog, "pulsars", catalog))
+        if len(pulsars) < 2:
+            raise UsageError("the joint likelihood needs >= 2 pulsars "
+                             "(cross-correlations need pairs)")
+        if n_modes < 1:
+            raise UsageError(f"n_modes must be >= 1, got {n_modes}")
+        self.pulsars = pulsars
+        self.n_modes = int(n_modes)
+        self.plan = self._check_plan(plan)
+        reqs = [FitRequest.from_fitter(p.fitter, request_id=p.name)
+                for p in pulsars]
+        if pad_shape is None:
+            bucket = getattr(catalog, "bucket_plan", None)
+            if bucket is not None:
+                n_pad = max(b for b, _ in bucket.buckets)
+                k_pad = max(b for _, b in bucket.buckets)
+            else:
+                n_pad = max(q.n_toas for q in reqs)
+                k_pad = max(q.n_free for q in reqs)
+        else:
+            n_pad, k_pad = int(pad_shape[0]), int(pad_shape[1])
+        # common time span and Fourier frequencies (host, from the
+        # certified arrival times)
+        mjd = [np.asarray(p.toas.utc_mjd, dtype=np.float64)
+               for p in pulsars]
+        tmin = min(float(m.min()) for m in mjd)
+        tmax = max(float(m.max()) for m in mjd)
+        self.Tspan = max((tmax - tmin) * _DAY_S, _DAY_S)
+        self.freqs = np.arange(1, self.n_modes + 1) / self.Tspan
+        Ms, rs, ws, phis, pads, Fs = [], [], [], [], [], []
+        for p, q, t in zip(pulsars, reqs, mjd):
+            if q.n_toas > n_pad or q.n_free > k_pad:
+                raise UsageError(
+                    f"{p.name}: system ({q.n_toas}, {q.n_free}) exceeds "
+                    f"the pad shape ({n_pad}, {k_pad})")
+            M, r, w, phiinv, pad_free = pad_request(q, n_pad, k_pad)
+            tsec = (t - tmin) * _DAY_S
+            F = np.zeros((n_pad, 2 * self.n_modes))
+            arg = 2.0 * np.pi * tsec[:, None] * self.freqs[None, :]
+            F[: q.n_toas, 0::2] = np.sin(arg)
+            F[: q.n_toas, 1::2] = np.cos(arg)
+            Ms.append(M), rs.append(r), ws.append(w)
+            phis.append(phiinv), pads.append(pad_free), Fs.append(F)
+        self.Lhd = hd_cholesky(self._directions())
+        # pulsar-axis padding: under a plan whose mesh shards 'pulsar',
+        # the stacked axis must divide the shard count (device_put
+        # rejects uneven NamedShardings) — and the integrity gate makes
+        # non-round catalogs NORMAL (an excluded pulsar shrinks the
+        # array).  A pad pulsar is all-padding (w=0 rows, unit
+        # pad-diagonal columns): its block lnlike is exactly 0, and a
+        # zero row/column in L_HD keeps it out of the cross term.
+        n_p = len(pulsars)
+        if self.plan is not None and self.plan.mesh is not None:
+            shards = int(self.plan.mesh.shape["pulsar"])
+            n_tot = n_p + ((-n_p) % shards)
+        else:
+            n_tot = n_p
+        for _ in range(n_tot - n_p):
+            Ms.append(np.zeros((n_pad, k_pad)))
+            rs.append(np.zeros(n_pad)), ws.append(np.zeros(n_pad))
+            phis.append(np.zeros(k_pad)), pads.append(np.ones(k_pad))
+            Fs.append(np.zeros((n_pad, 2 * self.n_modes)))
+        if n_tot > n_p:
+            L = np.zeros((n_tot, n_tot))
+            L[:n_p, :n_p] = self.Lhd
+            self.Lhd = L
+        self._data = tuple(np.stack(a) for a in (Ms, rs, ws, phis, pads,
+                                                 Fs))
+        self._jit = None
+        self._placed = None
+        self.pad_shape = (n_pad, k_pad)
+
+    def _directions(self) -> np.ndarray:
+        from pint_tpu.catalog.crosscorr import pulsar_directions
+
+        return pulsar_directions([p.model for p in self.pulsars])
+
+    def _check_plan(self, plan):
+        if plan is not None and "pulsar" not in plan.axes:
+            raise UsageError(
+                f"joint-likelihood plans need a 'pulsar' axis; got "
+                f"{plan.axes} (select_plan('catalog', "
+                "axes=('pulsar', 'walker')) builds the 2-axis plan)")
+        return plan
+
+    # -- evaluation --------------------------------------------------------
+
+    @property
+    def n_pulsars(self) -> int:
+        return len(self.pulsars)
+
+    def _fn(self):
+        """The jitted batched kernel: ``(points (N, 2), *data) ->
+        lnlike (N,)`` — one executable reused by the scalar and
+        batched entry points (and the sampler)."""
+        if self._jit is None:
+            import jax
+            import jax.numpy as jnp
+
+            Lhd = np.asarray(self.Lhd)
+            freqs = np.asarray(self.freqs)
+            Tspan = float(self.Tspan)
+            n2pi = float(np.log(2.0 * np.pi))
+
+            def batched(points, M, r, w, phiinv, pad_free, F):
+                def one(pt):
+                    amp = 10.0 ** pt[0]
+                    return _joint_kernel(amp, pt[1], M, r, w, phiinv,
+                                         pad_free, F, jnp.asarray(Lhd),
+                                         jnp.asarray(freqs), Tspan, n2pi)
+
+                return jax.vmap(one)(points)
+
+            self._jit = jax.jit(batched)
+        return self._jit
+
+    def _data_args(self):
+        """Device-placed data operands (pulsar axis sharded under a
+        plan's mesh; host arrays otherwise), placed once."""
+        if self._placed is None:
+            if self.plan is not None and self.plan.mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                mesh = self.plan.mesh
+                sharding = NamedSharding(mesh, P("pulsar"))
+                self._placed = tuple(jax.device_put(a, sharding)
+                                     for a in self._data)
+            else:
+                self._placed = self._data
+        return self._placed
+
+    def lnlike(self, log10_A: float, gamma: float) -> float:
+        """Scalar joint lnlike at one ``(log10_A, gamma)`` point."""
+        pts = np.array([[float(log10_A), float(gamma)]])
+        return float(np.asarray(self._fn()(pts, *self._data_args()))[0])
+
+    def lnlike_nocommon(self) -> float:
+        """The joint lnlike with the common process off: the FULL
+        joint kernel (cross-term machinery included) at amplitude
+        exactly zero (``10 ** -inf == 0.0`` in IEEE, and ``S`` is
+        linear in the amplitude, so the correction is identically
+        zero — no branch).  Tests pin this against the independent
+        :meth:`per_pulsar_lnlike` sum: the factorization criterion."""
+        return self.lnlike(-np.inf, 4.33)
+
+    def per_pulsar_lnlike(self) -> np.ndarray:
+        """The ``(n_pulsars,)`` individual lnlikelihoods (no common
+        process) — what the joint must sum to at zero amplitude.  The
+        shared :func:`_pulsar_block` without any cross machinery (the
+        factorization pin checks the CROSS term vanishes; the block's
+        own formulas are pinned independently against the dense
+        oracle)."""
+        import jax
+
+        M, r, w, phiinv, pad_free, _ = self._data
+        n2pi = float(np.log(2.0 * np.pi))
+
+        def one(M_a, r_a, w_a, phiinv_a, pad_a):
+            return _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a,
+                                 n2pi)[0]
+
+        out = np.asarray(jax.vmap(one)(M, r, w, phiinv, pad_free))
+        return out[: len(self.pulsars)]
+
+    def lnlike_batch(self, points) -> np.ndarray:
+        """Batched joint lnlike over ``(N, 2)`` walker points of
+        ``(log10_A, gamma)`` — the sampler's batch callable
+        (:meth:`~pint_tpu.sampler.EnsembleSampler.initialize_batched`).
+        Under a 2-axis ``(pulsar, walker)`` plan the points shard over
+        the ``walker`` mesh axis and the data over ``pulsar``."""
+        import numpy as _np
+
+        pts = _np.atleast_2d(_np.asarray(points, dtype=_np.float64))
+        if pts.shape[1] != 2:
+            raise UsageError(
+                f"joint-likelihood points are (N, 2) (log10_A, gamma); "
+                f"got {pts.shape}")
+        n = pts.shape[0]
+        dev_pts = pts
+        if self.plan is not None and self.plan.mesh is not None \
+                and "walker" in self.plan.axes:
+            import jax
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            shards = int(self.plan.mesh.shape["walker"])
+            pad = (-n) % shards
+            if pad:
+                pts_in = _np.concatenate(
+                    [pts, _np.tile(pts[-1:], (pad, 1))])
+            else:
+                pts_in = pts
+            dev_pts = jax.device_put(
+                pts_in, NamedSharding(self.plan.mesh, P("walker")))
+            out = _np.asarray(self._fn()(dev_pts, *self._data_args()))
+            return out[:n] if pad else out
+        return _np.asarray(self._fn()(dev_pts, *self._data_args()))

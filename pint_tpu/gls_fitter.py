@@ -41,7 +41,7 @@ from pint_tpu.telemetry import jaxevents as _jaxevents
 from pint_tpu.telemetry import span as _span
 from pint_tpu.utils import normalize_designmatrix
 
-__all__ = ["GLSFitter", "DownhillGLSFitter"]
+__all__ = ["GLSFitter", "DownhillGLSFitter", "linearized_system"]
 
 #: exceptions that send a fitter from the Cholesky ladder to its SVD path
 _CHOLESKY_FAILURES = (np.linalg.LinAlgError, SingularMatrixError)
@@ -127,6 +127,26 @@ def build_augmented_system(model, toas, wideband: bool = False):
     else:
         Nvec = model.scaled_toa_uncertainty(toas) ** 2
     return M, params, norm, phiinv, Nvec, dims
+
+
+def linearized_system(model, toas, resids=None):
+    """``(M, r, w, phiinv, params, norm)`` — the normalized
+    Woodbury-form linearized GLS system at the model's current state,
+    as flat host arrays: the batch-axis entry point the serving
+    batcher (:meth:`pint_tpu.serving.batcher.FitRequest.from_fitter`)
+    and the PTA catalog engine (:mod:`pint_tpu.catalog`) stack per
+    pulsar into padded ``(pulsar, n_toas, n_free)`` buckets.  ``w`` is
+    the white-noise weight ``1/Nvec`` (a zero weight marks a padded
+    row downstream).  ``resids`` defaults to a fresh
+    :class:`~pint_tpu.residuals.Residuals` at the current state."""
+    if resids is None:
+        from pint_tpu.residuals import Residuals
+
+        resids = Residuals(toas, model)
+    M, params, norm, phiinv, Nvec, _ = build_augmented_system(model, toas)
+    r = np.asarray(resids.time_resids, dtype=np.float64)
+    return (M, r, 1.0 / np.asarray(Nvec, dtype=np.float64), phiinv,
+            tuple(params), np.asarray(norm, dtype=np.float64))
 
 
 def gls_normal_equations(M: np.ndarray, r: np.ndarray,

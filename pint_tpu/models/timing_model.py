@@ -1184,6 +1184,23 @@ class TimingModel:
                 err = c.scale_toa_sigma(self, toas, err)
         return err
 
+    def psr_direction(self) -> np.ndarray:
+        """Unit vector SSB -> pulsar (ICRS) at POSEPOCH/PEPOCH — the
+        catalog engine's sky entry point: Hellings-Downs angular
+        separations between array pulsars are arccos of these vectors'
+        pairwise dot products (:mod:`pint_tpu.catalog.crosscorr`).
+        Raises :class:`~pint_tpu.exceptions.MissingComponent` when the
+        model carries no astrometry component to take a position from."""
+        from pint_tpu.exceptions import MissingComponent
+        from pint_tpu.models.astrometry import Astrometry
+
+        for c in self.components.values():
+            if isinstance(c, Astrometry):
+                return np.asarray(c.ssb_to_psb_xyz_ICRS(), dtype=np.float64)
+        raise MissingComponent(
+            f"{getattr(self, 'name', '?')}: no astrometry component — "
+            "cross-pulsar correlations need a sky position")
+
     def toa_covariance_matrix(self, toas) -> np.ndarray:
         """Full N x N TOA covariance (diag sigma^2 + correlated terms)."""
         sigma = self.scaled_toa_uncertainty(toas)

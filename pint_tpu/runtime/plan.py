@@ -44,14 +44,20 @@ from pint_tpu.logging import log
 
 __all__ = ["ExecutionPlan", "select_plan", "ladder", "MESH_AXES"]
 
-#: the framework's parallel axes (DESIGN.md "Parallelism")
-MESH_AXES = ("grid", "toa", "walker")
+#: the framework's parallel axes (DESIGN.md "Parallelism"); ``pulsar``
+#: is the catalog engine's embarrassingly parallel batch axis — the
+#: honest multichip route (no cross-device reduction exists to pay for)
+MESH_AXES = ("grid", "toa", "walker", "pulsar")
 
 #: workload -> (primary batch axis, multi-device mechanism)
 _WORKLOAD_AXIS = {
     "grid": ("grid", "pjit"),
     "gls_normal_eq": ("toa", "pjit"),
     "walker": ("walker", "shard_map"),
+    # batched catalog fits + the joint lnlikelihood: the bucket batch
+    # axis shards over 'pulsar'; a 2-axis ('pulsar', 'walker') plan
+    # adds walker-data-parallel sampling on the same mesh
+    "catalog": ("pulsar", "pjit"),
 }
 
 

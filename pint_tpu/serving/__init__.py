@@ -31,6 +31,7 @@ from pint_tpu.serving.warmup import (
     WarmPool,
     WarmupReport,
     warm_buckets,
+    warm_catalog,
     warm_fitter,
 )
 
@@ -38,4 +39,5 @@ __all__ = ["aotcache", "warmup", "batcher", "service",
            "AOTCache", "cache", "device_fingerprint",
            "FitRequest", "FitResult", "ShapeBatcher",
            "ServeConfig", "TimingService",
-           "WarmPool", "WarmupReport", "warm_buckets", "warm_fitter"]
+           "WarmPool", "WarmupReport", "warm_buckets", "warm_catalog",
+           "warm_fitter"]

@@ -115,17 +115,14 @@ class FitRequest:
         Woodbury-form augmented design ``[M_timing | U_noise]`` with the
         enterprise prior weights, the same construction every GLS-family
         fit step solves (:func:`~pint_tpu.gls_fitter.
-        build_augmented_system`; for a white-noise model the noise block
+        linearized_system`; for a white-noise model the noise block
         is simply absent)."""
-        from pint_tpu.gls_fitter import build_augmented_system
+        from pint_tpu.gls_fitter import linearized_system
 
-        M, params, norm, phiinv, Nvec, _ = build_augmented_system(
-            ftr.model, ftr.toas)
-        r = np.asarray(ftr.resids.time_resids, dtype=np.float64)
-        return cls(M=M, r=r, w=1.0 / np.asarray(Nvec, dtype=np.float64),
-                   phiinv=phiinv, params=tuple(params),
-                   norm=np.asarray(norm, dtype=np.float64),
-                   request_id=request_id)
+        M, r, w, phiinv, params, norm = linearized_system(
+            ftr.model, ftr.toas, resids=ftr.resids)
+        return cls(M=M, r=r, w=w, phiinv=phiinv, params=params,
+                   norm=norm, request_id=request_id)
 
 
 @dataclass

@@ -44,7 +44,7 @@ from pint_tpu.logging import log
 from pint_tpu.serving import aotcache
 
 __all__ = ["WarmEntry", "WarmupReport", "WarmPool", "warm_fitter",
-           "warm_buckets", "fitter_vkey"]
+           "warm_buckets", "warm_catalog", "fitter_vkey"]
 
 
 @dataclass
@@ -206,6 +206,19 @@ def warm_fitter(ftr, pool: Optional[WarmPool] = None,
         handles.append(("grid.chunk", fn, args))
     for name, fn, args in handles:
         report.entries.append(pool.warm(name, fn, args, vkey=vkey))
+    return pool, report
+
+
+def warm_catalog(catalog_fitter, pool: Optional[WarmPool] = None
+                 ) -> Tuple[WarmPool, WarmupReport]:
+    """Pre-warm a :class:`~pint_tpu.catalog.batchfit.CatalogFitter`'s
+    per-bucket batched executables through a warm pool (AOT-cache
+    persistence included when one is configured), so steady-state
+    catalog refits dispatch with zero fresh compiles across buckets —
+    the serving discipline extended to the array workload.  Returns
+    the pool and the per-executable ledger."""
+    pool = pool or WarmPool()
+    report = catalog_fitter.warm(pool=pool)
     return pool, report
 
 

@@ -74,6 +74,8 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     monkeypatch.setattr(bench, "B1855_TIM", tim)
     monkeypatch.setenv("BENCH_FORCE_CPU", "1")
     monkeypatch.setenv("BENCH_SKIP_SECONDARY", "1")
+    # small catalog: the contract is the block's shape, not its scale
+    monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     try:
@@ -130,6 +132,23 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert tuned["tuned_vs_static"] >= 1.0
     assert tuned["basis"] == "cost+measured"
     assert isinstance(tuned["decisions"], str) and tuned["decisions"]
+    # the catalog block (PR 11): the PTA catalog engine's batched
+    # multi-pulsar fit + joint Hellings-Downs lnlikelihood ran next to
+    # the headline — every key present, never degraded on CPU, zero
+    # steady-state compiles across buckets
+    catalog = headline["catalog"]
+    for key in ("n_pulsars", "buckets", "pad_waste_frac",
+                "catalog_fits_per_s", "joint_lnlike_per_s",
+                "steady_state_compiles"):
+        assert key in catalog, f"catalog block missing {key!r}"
+    assert "error" not in catalog, \
+        f"catalog measurement degraded: {catalog}"
+    assert catalog["n_pulsars"] >= 4
+    assert catalog["buckets"] >= 1
+    assert 0.0 <= catalog["pad_waste_frac"] < 1.0
+    assert catalog["catalog_fits_per_s"] > 0
+    assert catalog["joint_lnlike_per_s"] > 0
+    assert catalog["steady_state_compiles"] == 0
     json.dumps(headline)
 
 
@@ -148,6 +167,7 @@ def test_warm_block_hits_cache_on_second_run(tiny_headline_files,
     monkeypatch.setattr(bench, "B1855_TIM", tim)
     monkeypatch.setenv("BENCH_FORCE_CPU", "1")
     monkeypatch.setenv("BENCH_SKIP_SECONDARY", "1")
+    monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     cache_dir = str(tmp_path / "aot")

@@ -187,3 +187,16 @@ class TestExamples:
         assert "-> OK" in out
         assert "MCMC (26 walkers" in out
         assert "done" in out
+
+    def test_fit_catalog_walkthrough(self, capsys):
+        """The PTA catalog-engine walkthrough: ingest + batched fit +
+        joint Hellings-Downs likelihood + sampler, at CI size."""
+        out = _run("fit_catalog.py", "--cpu", "--pulsars", "4",
+                   capsys=capsys)
+        assert "catalog ingest: 4 pulsar(s)" in out
+        assert "2 row(s) quarantined" in out
+        assert "fresh compiles 0" in out
+        assert "batched == dedicated GLSFitter" in out
+        assert "(factorization)" in out
+        assert "lnpost finite: True" in out
+        assert "catalog walkthrough complete" in out

@@ -485,6 +485,76 @@ class TestHostCallInJit:
         assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
         assert eng.lint_file(str(good)) == []
 
+    def test_catalog_call_in_jit_flagged(self, tmp_path):
+        """The catalog package is host orchestration (par/tim ingest +
+        quarantine I/O, padding bookkeeping, HD geometry built once per
+        catalog) — an ingest/fit/likelihood call inside a traced
+        function would re-run the whole catalog build per TRACE; the
+        catalog submodules are policed like the serving/autotune
+        ones."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.catalog import ingest\n"
+            "from pint_tpu.catalog.crosscorr import hd_matrix\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    ingest.ingest_catalog([])\n"
+            "    hd_matrix(x)\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+
+    def test_catalog_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — ingest, bucket, and
+        build the HD factor on the host; traced code touches only the
+        padded operands the host prepared."""
+        good = (
+            "import jax\n"
+            "from pint_tpu.catalog import batchfit, crosscorr\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    return x * 2\n"
+            "def host(pairs, dirs):\n"
+            "    L = crosscorr.hd_cholesky(dirs)\n"
+            "    fn = batchfit.catalog_batched()\n"
+            "    return fn, L\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+    def test_catalog_is_clean_target(self):
+        """pint_tpu/catalog/ itself lints clean under the host-call
+        rule (its traced kernels touch only jax/jnp) without pragmas
+        or baseline entries."""
+        eng = Engine(rules=[HostCallInJitRule()], repo=REPO)
+        for rel in ("pint_tpu/catalog/__init__.py",
+                    "pint_tpu/catalog/ingest.py",
+                    "pint_tpu/catalog/buckets.py",
+                    "pint_tpu/catalog/batchfit.py",
+                    "pint_tpu/catalog/crosscorr.py",
+                    "pint_tpu/catalog/likelihood.py"):
+            findings = eng.lint_file(os.path.join(REPO, rel))
+            assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_catalog_in_typed_raise_targets(self, tmp_path):
+        """pint_tpu/catalog/ is a typed-raise target: a planted bare
+        ValueError in a catalog module fires, its UsageError twin does
+        not."""
+        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
+
+        assert "pint_tpu/catalog/" in DEFAULT_TARGETS
+        d = tmp_path / "pint_tpu" / "catalog"
+        d.mkdir(parents=True)
+        bad = d / "bad.py"
+        bad.write_text("def f():\n    raise ValueError('bare')\n")
+        good = d / "good.py"
+        good.write_text(
+            "from pint_tpu.exceptions import UsageError\n"
+            "def f():\n    raise UsageError('typed')\n")
+        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
+        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
+        assert eng.lint_file(str(good)) == []
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

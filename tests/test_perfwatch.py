@@ -517,3 +517,98 @@ class TestTunedSeries:
         assert main(["--dir", d]) == 0
         out = capsys.readouterr().out
         assert "tuned: 360.0 fits/s (chunk 128)" in out
+
+
+def _catalog(fps=24.0, waste=0.05, lnl=900.0, n=16, error=None):
+    block = {"n_pulsars": n, "buckets": 3, "pad_waste_frac": waste,
+             "catalog_fits_per_s": fps, "joint_lnlike_per_s": lnl,
+             "steady_state_compiles": 0}
+    if error is not None:
+        block.update({"n_pulsars": None, "buckets": None,
+                      "pad_waste_frac": None, "catalog_fits_per_s": None,
+                      "joint_lnlike_per_s": None,
+                      "steady_state_compiles": None, "error": error})
+    return {"catalog": block}
+
+
+class TestCatalogSeries:
+    """The round-11 catalog{} block: ingestion + gating of the PTA
+    catalog-engine series (catalog_fits_per_s gates drops,
+    pad_waste_frac gates rises, joint_lnlike_per_s gates drops) under
+    the same max(30%, 3xMAD) bar as the headline."""
+
+    def test_catalog_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 11, 100.0,
+                    extra=_catalog(fps=25.5, waste=0.041, lnl=880.0))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.catalog_fits_per_s == 25.5
+        assert r.catalog_pad_waste_frac == 0.041
+        assert r.catalog_joint_lnlike_per_s == 880.0
+        assert r.catalog_n_pulsars == 16
+        # and it survives the history document round trip
+        doc = build_history([r])
+        assert doc["runs"][0]["catalog_fits_per_s"] == 25.5
+
+    def test_catalog_fits_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([24.0, 25.0, 23.5], start=1):
+            _bench(d, i, 100.0, extra=_catalog(fps=v))
+        _bench(d, 4, 100.0, extra=_catalog(fps=12.0))  # 50% below
+        assert main(["--check", "--dir", d]) == 1
+        assert "catalog_fits_per_s" in capsys.readouterr().out
+
+    def test_pad_waste_rise_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_catalog(waste=0.05))
+        _bench(d, 4, 100.0, extra=_catalog(waste=0.20))  # 4x padding
+        assert main(["--check", "--dir", d]) == 1
+        assert "catalog_pad_waste_frac" in capsys.readouterr().out
+
+    def test_small_catalog_changes_pass(self, tmp_path):
+        d = str(tmp_path)
+        for i, (v, pw) in enumerate([(24.0, 0.050), (25.0, 0.052),
+                                     (23.5, 0.048)], start=1):
+            _bench(d, i, 100.0, extra=_catalog(fps=v, waste=pw))
+        _bench(d, 4, 100.0, extra=_catalog(fps=22.8, waste=0.055))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_catalog_block_fails_when_history_had_catalog(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_catalog())
+        _bench(d, 3, 100.0,
+               extra=_catalog(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 1
+        assert "catalog block degraded" in capsys.readouterr().out
+
+    def test_errored_catalog_block_clean_without_catalog_history(
+            self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0,
+               extra=_catalog(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_malformed_catalog_block_ignored(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 11, 100.0,
+                    extra={"catalog": {"catalog_fits_per_s": "fast",
+                                       "pad_waste_frac": True,
+                                       "n_pulsars": "many"}})
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.catalog_fits_per_s is None
+        assert r.catalog_pad_waste_frac is None
+        assert r.catalog_n_pulsars is None
+
+    def test_catalog_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0, extra=_catalog(fps=25.5, waste=0.04))
+        assert main(["--dir", d]) == 0
+        out = capsys.readouterr().out
+        assert "catalog: 25.5 fits/s (16 pulsars)" in out

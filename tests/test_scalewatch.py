@@ -197,3 +197,80 @@ class TestWorkerIntegration:
         out.write_text(json.dumps(doc))
         errs = []
         assert ingest_artifact(str(out), errs) is not None and errs == []
+
+
+class TestCatalogWorkload:
+    """The --workload catalog mode (PR 11): the pulsar-data-parallel
+    batched catalog fit swept as its own scaling series, gated against
+    its own history."""
+
+    def test_catalog_worker_emits_full_record_set(self, eight_devices,
+                                                  capsys, monkeypatch):
+        """One in-process catalog worker at 2 devices: the measurement
+        carries the catalog workload tag and a pulsar-axis sharding
+        plan; the batched bucket executable's CollectiveProfile shows
+        the data-parallel story (no all-reduce contractions — any
+        collective bytes are resharding overhead, tiny next to
+        compute)."""
+        import tools.scalewatch as sw
+        from tools.telemetry_report import validate_multichip_record
+
+        monkeypatch.setattr(sw, "_CATALOG_PULSARS", 4)
+        monkeypatch.setattr(sw, "_CATALOG_TIMED_PASSES", 2)
+        assert sw.run_worker(2, workload="catalog") == 0
+        recs = _records_from_output(capsys.readouterr().out)
+        errors = []
+        for rec in recs:
+            validate_multichip_record(rec, "catalog worker", errors)
+        assert errors == []
+        by_kind = {}
+        for rec in recs:
+            by_kind.setdefault(rec["record"], []).append(rec)
+        meas = by_kind["measurement"][0]
+        assert meas["workload"] == "catalog_batched_fit"
+        assert meas["n_devices"] == 2
+        assert meas["fits_per_sec"] > 0
+        assert meas["n_pulsars"] == 4
+        assert meas["plan"]["axes"][0] == "pulsar"
+        plan = by_kind["sharding_plan"][0]["sharding_plan"]
+        assert plan["mesh"] == {"pulsar": 2}
+        coll = by_kind["collective"][0]["collective"]
+        assert "all-reduce" not in (coll.get("ops") or {})
+
+    def test_workloads_gate_against_their_own_series(self, tmp_path,
+                                                     capsys):
+        """A catalog artifact entering a grid history must not be
+        cross-gated: each workload trends its own series."""
+        _artifact(1, 0.80, tmp_path=tmp_path)
+        _artifact(2, 0.78, tmp_path=tmp_path)
+        # a first catalog artifact at a very different efficiency: with
+        # cross-gating this would be a fake regression of the grid
+        _artifact(3, 0.30, ratio=0.01, tmp_path=tmp_path,
+                  workload="catalog_batched_fit")
+        assert main(["--check", "--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "catalog_batched_fit: 1 artifact(s)" in out
+
+    def test_catalog_series_regression_fails(self, tmp_path, capsys):
+        _artifact(1, 0.80, tmp_path=tmp_path,
+                  workload="catalog_batched_fit")
+        _artifact(2, 0.40, tmp_path=tmp_path,
+                  workload="catalog_batched_fit")  # -50%
+        assert main(["--check", "--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "catalog_batched_fit/efficiency_at_max" in out
+
+    def test_mixed_series_gate_independently(self, tmp_path, capsys):
+        """Interleaved grid and catalog artifacts: a catalog regression
+        fails even when the grid series is flat (and names the right
+        series)."""
+        _artifact(1, 0.80, tmp_path=tmp_path)
+        _artifact(2, 0.80, tmp_path=tmp_path,
+                  workload="catalog_batched_fit")
+        _artifact(3, 0.79, tmp_path=tmp_path)
+        _artifact(4, 0.35, tmp_path=tmp_path,
+                  workload="catalog_batched_fit")  # -56%
+        assert main(["--check", "--dir", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "catalog_batched_fit/efficiency_at_max" in out
+        assert "[ok] synthetic_gls_grid/efficiency_at_max" in out

@@ -170,11 +170,21 @@ _SERVING_SUBMODULES = {"aotcache", "warmup", "batcher", "service"}
 #: through its own AOT analyses
 _AUTOTUNE_SUBMODULES = {"search", "manifest", "records"}
 
+#: pint_tpu.catalog submodules are host-side orchestration (par/tim
+#: ingestion + quarantine I/O, padding/bucket bookkeeping, telemetry,
+#: HD geometry built once per catalog): an ingest/fit/likelihood call
+#: inside a traced function would re-run the whole catalog build per
+#: TRACE (the traced kernels the package dispatches are plain inner
+#: functions, not its public API)
+_CATALOG_SUBMODULES = {"ingest", "buckets", "batchfit", "crosscorr",
+                       "likelihood"}
+
 #: one table drives the ImportFrom tracking for every host-side
 #: package (the next PR's package is one row, not a copied branch)
 _HOST_PACKAGES = (("pint_tpu.telemetry", _TELEMETRY_SUBMODULES),
                   ("pint_tpu.serving", _SERVING_SUBMODULES),
-                  ("pint_tpu.autotune", _AUTOTUNE_SUBMODULES))
+                  ("pint_tpu.autotune", _AUTOTUNE_SUBMODULES),
+                  ("pint_tpu.catalog", _CATALOG_SUBMODULES))
 
 
 def _record_imports(info: FileInfo) -> None:

@@ -46,6 +46,7 @@ DEFAULT_TARGETS = (
     "pint_tpu/telemetry/",
     "pint_tpu/serving/",
     "pint_tpu/autotune/",
+    "pint_tpu/catalog/",
 )
 
 DISALLOWED = {

@@ -96,6 +96,12 @@ class RunRecord:
     tuned_chunk: Optional[int] = None
     tuned_decisions: Optional[str] = None      #: manifest digest stamp
     tuned_error: Optional[str] = None          #: degraded tuned block
+    #: from the catalog{...} block (round 11+: PTA catalog engine)
+    catalog_fits_per_s: Optional[float] = None
+    catalog_pad_waste_frac: Optional[float] = None
+    catalog_joint_lnlike_per_s: Optional[float] = None
+    catalog_n_pulsars: Optional[int] = None
+    catalog_error: Optional[str] = None        #: degraded catalog block
     #: multichip extras
     n_devices: Optional[int] = None
     multichip_ok: Optional[bool] = None
@@ -191,6 +197,20 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.tuned_decisions = tuned["decisions"]
         if isinstance(tuned.get("error"), str) and tuned["error"]:
             rec.tuned_error = tuned["error"]
+    catalog = h.get("catalog")
+    if isinstance(catalog, dict):
+        for src, dst in (("catalog_fits_per_s", "catalog_fits_per_s"),
+                         ("pad_waste_frac", "catalog_pad_waste_frac"),
+                         ("joint_lnlike_per_s",
+                          "catalog_joint_lnlike_per_s")):
+            if isinstance(catalog.get(src), (int, float)) \
+                    and not isinstance(catalog.get(src), bool):
+                setattr(rec, dst, float(catalog[src]))
+        if isinstance(catalog.get("n_pulsars"), int) \
+                and not isinstance(catalog.get("n_pulsars"), bool):
+            rec.catalog_n_pulsars = catalog["n_pulsars"]
+        if isinstance(catalog.get("error"), str) and catalog["error"]:
+            rec.catalog_error = catalog["error"]
     # a zero-valued errored run (the bench's error-emit contract) is a
     # failed measurement, not a 100% regression
     if rec.error is not None and not rec.value:
@@ -371,7 +391,16 @@ def check_series(runs: List[RunRecord], threshold: float,
                   ("compile_s", lambda r: r.compile_s, -1),
                   ("warm_fits_per_s", lambda r: r.warm_fits_per_s, +1),
                   ("warm_p99_ms", lambda r: r.warm_p99_ms, -1),
-                  ("tuned_fits_per_s", lambda r: r.tuned_fits_per_s, +1))
+                  ("tuned_fits_per_s", lambda r: r.tuned_fits_per_s, +1),
+                  # catalog engine (round 11+): whole-pulsar batched-fit
+                  # throughput gates drops, bucket-ladder padding waste
+                  # gates rises, joint-lnlike throughput gates drops
+                  ("catalog_fits_per_s",
+                   lambda r: r.catalog_fits_per_s, +1),
+                  ("catalog_pad_waste_frac",
+                   lambda r: r.catalog_pad_waste_frac, -1),
+                  ("catalog_joint_lnlike_per_s",
+                   lambda r: r.catalog_joint_lnlike_per_s, +1))
     for name, get, sign in quantities:
         # gate the series' NEWEST run only: when it lacks this quantity
         # there is nothing to compare — re-gating an older run and
@@ -450,6 +479,18 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: tuned block degraded "
                    f"({latest_rec.tuned_error}) where prior runs "
                    "measured tuned throughput"))
+    # a degraded catalog block where prior rounds measured the catalog
+    # engine is a regression, not a silent skip (same discipline)
+    if latest_rec.catalog_error is not None \
+            and any(r.catalog_fits_per_s is not None for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="catalog", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: catalog block degraded "
+                   f"({latest_rec.catalog_error}) where prior runs "
+                   "measured the catalog engine"))
     return verdicts
 
 
@@ -517,6 +558,13 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f"(chunk {latest.tuned_chunk}), "
                   f"{latest.tuned_vs_static}x static, "
                   f"decisions={latest.tuned_decisions}", file=out)
+        if latest.catalog_fits_per_s is not None \
+                or latest.catalog_pad_waste_frac is not None:
+            print(f"  catalog: {latest.catalog_fits_per_s} fits/s "
+                  f"({latest.catalog_n_pulsars} pulsars), "
+                  f"pad_waste={latest.catalog_pad_waste_frac}, "
+                  f"joint_lnlike {latest.catalog_joint_lnlike_per_s}/s",
+                  file=out)
         if latest.cost:
             c = latest.cost
             print(f"  cost[{c.get('name', '?')}]: "

@@ -13,9 +13,17 @@ per-device busy fractions), and folds them into one schema'd artifact::
 
     python -m tools.scalewatch                   # sweep + report
     python -m tools.scalewatch --devices 1,2     # custom counts
+    python -m tools.scalewatch --workload catalog  # pulsar-parallel
     python -m tools.scalewatch --emit SCALING_r07.json
     python -m tools.scalewatch --check           # gate the history
     python -m tools.scalewatch --worker 8        # internal: one count
+
+The ``catalog`` workload sweeps the batched multi-pulsar GLS fit
+(:mod:`pint_tpu.catalog`) data-parallel over the ``pulsar`` mesh axis
+— the embarrassingly parallel axis ROADMAP item 2 names as the honest
+multichip route (the TOA-sharded grid measured 7% efficiency at 8
+devices; this series measures the axis that should scale).  ``--check``
+gates each workload's series against its OWN history.
 
 Artifact schema ``pint_tpu.telemetry.scaling/1``: a ``series`` entry
 per device count (wall seconds, fits/s, speedup and parallel efficiency
@@ -148,7 +156,99 @@ def _build_workload():
     return f, ("F0", "F1"), (g0, g1), "synthetic_gls_grid"
 
 
-def run_worker(n_devices: int) -> int:
+#: catalog-workload constants: FIXED across swept device counts (that
+#: is what makes the speedup series meaningful) — 16 pulsars covers the
+#: 8-device sweep top with 2 lanes per device
+_CATALOG_PULSARS = 16
+_CATALOG_SEED = 11
+_CATALOG_TIMED_PASSES = 8
+
+
+def _build_catalog_workload():
+    """A certified 16-pulsar ragged synthetic catalog (deterministic
+    seed) — the pulsar-data-parallel workload ROADMAP item 2 says
+    should scale, unlike the TOA-sharded GLS grid whose measured
+    8-device efficiency is 7%."""
+    from pint_tpu.catalog import CatalogFitter, ingest_catalog
+    from pint_tpu.catalog.ingest import make_synthetic_catalog
+
+    report = ingest_catalog(make_synthetic_catalog(
+        n_pulsars=_CATALOG_PULSARS, seed=_CATALOG_SEED,
+        ntoa_range=(24, 64)))
+    return report, CatalogFitter
+
+
+def run_catalog_worker(n_devices: int, devs) -> int:
+    """One catalog-workload measurement: the batched multi-pulsar GLS
+    solve, pulsar-axis data-parallel over the plan's mesh.  The timed
+    region is the per-bucket batched DISPATCHES at fixed operands (the
+    device work the pulsar axis parallelizes; the host linearization
+    rebuild is measured separately by the bench) — fits/s = pulsar
+    fits per second across the timed passes."""
+    import jax
+
+    from pint_tpu import profiling
+    from pint_tpu.runtime.plan import select_plan
+    from pint_tpu.telemetry import distview
+
+    report, CatalogFitter = _build_catalog_workload()
+    plan = select_plan("catalog", devices=devs,
+                       n_items=report.n_pulsars)
+    cf = CatalogFitter(report, plan=plan)
+    cf.fit(maxiter=1)                       # compile + settle the state
+    handles = cf.bucket_executables()       # sharded operands, fixed
+    for fn, ops in handles.values():
+        # warm every bucket AND await it: JAX dispatch is async, and an
+        # in-flight warm execution leaking into the timed region would
+        # add noise to exactly the number the scaling gate trends
+        jax.block_until_ready(fn(*ops))
+    t0 = time.perf_counter()
+    for _ in range(_CATALOG_TIMED_PASSES):
+        for fn, ops in handles.values():
+            out = fn(*ops)
+    jax.block_until_ready(out)
+    wall = time.perf_counter() - t0
+    fits = report.n_pulsars * _CATALOG_TIMED_PASSES
+
+    import tempfile
+
+    busy: Dict[str, float] = {}
+    skew = None
+    try:
+        with tempfile.TemporaryDirectory(prefix="scalewatch_trace_") as td:
+            with profiling.device_trace(td) as rep:
+                for _ in range(_CATALOG_TIMED_PASSES):
+                    for fn, ops in handles.values():
+                        out = fn(*ops)
+                jax.block_until_ready(out)
+            busy = rep.device_busy_fractions()
+            skew = rep.straggler_skew_s
+    except Exception as e:  # tracing is best-effort on exotic backends
+        print(f"scalewatch worker: trace skipped "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+
+    # the observatory view of the LARGEST bucket executable (cost,
+    # collectives — expected ~none: the pulsar axis is embarrassingly
+    # parallel — and the sharding plan)
+    big = max(handles, key=lambda k: handles[k][1][0].size)
+    obs = distview.observe_jitted(handles[big][0], *handles[big][1],
+                                  name=big)
+    nfree = sum(len(p.model.free_params) for p in report.pulsars)
+    _emit("measurement", n_devices=n_devices, wall_s=wall,
+          fits_per_sec=fits / max(wall, 1e-9), grid_points=fits,
+          ntoas=report.n_toas, nfree=nfree,
+          n_pulsars=report.n_pulsars,
+          platform=str(jax.default_backend()),
+          workload="catalog_batched_fit",
+          busy_fractions=busy, straggler_skew_s=skew,
+          plan=plan.to_dict())
+    _emit("cost", cost=obs["cost"])
+    _emit("collective", collective=obs["collectives"])
+    _emit("sharding_plan", sharding_plan=obs["sharding_plan"])
+    return 0
+
+
+def run_worker(n_devices: int, workload: str = "grid") -> int:
     """One measurement at one device count; schema-tagged JSON lines on
     stdout (measurement + collective + cost + sharding_plan records)."""
     import jax
@@ -174,6 +274,8 @@ def run_worker(n_devices: int) -> int:
               file=sys.stderr)
         return 2
     devs = list(devs[:n_devices])
+    if workload == "catalog":
+        return run_catalog_worker(n_devices, devs)
     from pint_tpu import profiling
     from pint_tpu.grid import grid_chisq
     from pint_tpu.telemetry import distview
@@ -256,18 +358,20 @@ def _worker_env(n_devices: int) -> dict:
 
 
 def run_sweep(device_counts: List[int], errors: List[str],
-              timeout_s: float = 900.0) -> Optional[dict]:
+              timeout_s: float = 900.0,
+              workload: str = "grid") -> Optional[dict]:
     """Run one worker per device count; fold the records into the
     scaling artifact (None when any worker failed)."""
     from tools.telemetry_report import validate_multichip_record
 
     per_count: Dict[int, Dict[str, dict]] = {}
     for n in device_counts:
-        print(f"scalewatch: measuring {n} device(s)...", file=sys.stderr)
+        print(f"scalewatch: measuring {n} device(s) "
+              f"[{workload}]...", file=sys.stderr)
         try:
             proc = subprocess.run(
                 [sys.executable, "-m", "tools.scalewatch",
-                 "--worker", str(n)],
+                 "--worker", str(n), "--workload", workload],
                 cwd=REPO, env=_worker_env(n), capture_output=True,
                 text=True, timeout=timeout_s)
         except subprocess.TimeoutExpired:
@@ -299,6 +403,12 @@ def run_sweep(device_counts: List[int], errors: List[str],
     for n in counts:
         m = per_count[n]["measurement"]
         ne = per_count[n].get("collective:gls.normal_eq", {})
+        if not ne:
+            # catalog workload: the (only) collective record is the
+            # batched bucket executable's — a data-parallel program
+            # whose comm ratio SHOULD sit near zero
+            ne = next((per_count[n][k] for k in sorted(per_count[n])
+                       if k.startswith("collective:")), {})
         grid_coll = per_count[n].get("collective:grid.chunk", {})
         speedup = (m["fits_per_sec"] / base["fits_per_sec"]) \
             if base["fits_per_sec"] else None
@@ -413,44 +523,58 @@ def collect_history(paths: List[str], directory: Optional[str],
 
 def check_history(history: List[dict], threshold: float,
                   noise_mult: float, out=None) -> int:
-    """Gate the newest artifact against the median of its predecessors
-    via perfwatch's shared :func:`~tools.perfwatch.mad_gate` (same
-    environment assumption as the perfwatch series: the history trends
-    ONE benchmark environment)."""
+    """Gate each workload's newest artifact against the median of its
+    own predecessors via perfwatch's shared
+    :func:`~tools.perfwatch.mad_gate` (same environment assumption as
+    the perfwatch series: the history trends ONE benchmark
+    environment).  Artifacts are grouped per ``workload`` — the
+    catalog batched-fit series and the TOA-sharded grid series have
+    different efficiency regimes by design, and cross-gating them
+    would turn the catalog's honest scaling into a fake regression of
+    the grid's (or mask a real one)."""
     from tools.perfwatch import mad_gate
 
     out = out or sys.stdout
-    if len(history) < 2:
-        print(f"scalewatch: {len(history)} artifact(s) — no history to "
-              f"gate", file=out)
-        return 0
-    latest, prior = history[-1], history[:-1]
+    by_workload: Dict[str, List[dict]] = {}
+    for doc in history:
+        by_workload.setdefault(str(doc.get("workload", "?")),
+                               []).append(doc)
     rc = 0
-    quantities = (("efficiency_at_max", +1),   # lower is worse
-                  ("comm_compute_ratio_at_max", -1))  # higher is worse
-    for key, sign in quantities:
-        latest_v = latest.get(key)
-        prev = [d.get(key) for d in prior
-                if isinstance(d.get(key), (int, float))]
-        if not isinstance(latest_v, (int, float)) or not prev:
+    gated_any = False
+    for workload in sorted(by_workload):
+        series = by_workload[workload]
+        if len(series) < 2:
+            print(f"scalewatch: {workload}: {len(series)} artifact(s) — "
+                  f"no history to gate", file=out)
             continue
-        # zero_baseline_fails: a committed all-zero comm-ratio history
-        # means "this plan moves nothing" — a newly nonzero ratio must
-        # still gate (efficiency, sign +1, is unaffected by the flag)
-        gated = mad_gate(latest_v, prev, sign, threshold, noise_mult,
-                         zero_baseline_fails=True)
-        if gated is None:
-            continue
-        baseline, rel, scatter, bar, failed = gated
-        status = "REGRESSION" if failed else "ok"
-        print(f"scalewatch: [{status}] {key}: "
-              f"{latest['_source']}: {latest_v:g} vs median {baseline:g} "
-              f"of {len(prev)} prior run(s); change {100 * rel:+.1f}% "
-              f"(bar {100 * bar:.1f}%, noise floor "
-              f"{100 * noise_mult * scatter:.1f}%)", file=out)
-        if failed:
-            rc = 1
-    if rc == 0:
+        latest, prior = series[-1], series[:-1]
+        quantities = (("efficiency_at_max", +1),   # lower is worse
+                      ("comm_compute_ratio_at_max", -1))  # higher worse
+        for key, sign in quantities:
+            latest_v = latest.get(key)
+            prev = [d.get(key) for d in prior
+                    if isinstance(d.get(key), (int, float))]
+            if not isinstance(latest_v, (int, float)) or not prev:
+                continue
+            # zero_baseline_fails: a committed all-zero comm-ratio
+            # history means "this plan moves nothing" — a newly nonzero
+            # ratio must still gate (efficiency, sign +1, is unaffected
+            # by the flag)
+            gated = mad_gate(latest_v, prev, sign, threshold, noise_mult,
+                             zero_baseline_fails=True)
+            if gated is None:
+                continue
+            gated_any = True
+            baseline, rel, scatter, bar, failed = gated
+            status = "REGRESSION" if failed else "ok"
+            print(f"scalewatch: [{status}] {workload}/{key}: "
+                  f"{latest['_source']}: {latest_v:g} vs median "
+                  f"{baseline:g} of {len(prev)} prior run(s); change "
+                  f"{100 * rel:+.1f}% (bar {100 * bar:.1f}%, noise floor "
+                  f"{100 * noise_mult * scatter:.1f}%)", file=out)
+            if failed:
+                rc = 1
+    if rc == 0 and gated_any:
         print("scalewatch: no meaningful scaling regression", file=out)
     return rc
 
@@ -470,6 +594,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--devices", default="1,2,4,8",
                     help="comma-separated device counts to sweep "
                          "(default 1,2,4,8)")
+    ap.add_argument("--workload", default="grid",
+                    choices=("grid", "catalog"),
+                    help="what to sweep: the TOA-sharded GLS grid "
+                         "(default) or the pulsar-data-parallel "
+                         "batched catalog fit")
     ap.add_argument("--dir", default=None,
                     help="directory holding SCALING_r*.json history "
                          "(default: repo root; pass '' to disable)")
@@ -496,7 +625,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("--threshold must be > 0 and --noise-mult >= 0")
 
     if args.worker is not None:
-        return run_worker(args.worker)
+        return run_worker(args.worker, workload=args.workload)
 
     directory = args.dir
     if directory is None:
@@ -518,7 +647,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                  f"{args.devices!r}")
     if not counts or counts[0] < 1:
         ap.error("--devices needs at least one positive count")
-    doc = run_sweep(counts, errors, timeout_s=args.timeout)
+    doc = run_sweep(counts, errors, timeout_s=args.timeout,
+                    workload=args.workload)
     for e in errors:
         print(f"scalewatch: {e}", file=sys.stderr)
     if doc is None:

@@ -118,6 +118,64 @@ AUTOTUNE_EVENT_ATTRS = {
     "tune_fallback": {"decision": str, "reason": str},
 }
 
+#: catalog-engine lifecycle events (pint_tpu/catalog): one ingest
+#: summary per catalog (quarantined-row and excluded-pulsar counts)
+#: and one bucket-assignment summary (ladder + padding waste).  Same
+#: contract style as the other event families — a drift in the
+#: ingest/bucket producers fails --check before it corrupts the
+#: catalog series bench/perfwatch trend.
+CATALOG_EVENT_ATTRS = {
+    "catalog_ingest": {"n_pulsars": int, "n_toas": int,
+                       "n_quarantined": int, "quarantined_pulsars": int},
+    "catalog_bucket": {"n_pulsars": int, "n_buckets": int,
+                       "pad_waste_frac": (int, float),
+                       "ntoa_ladder": str, "nfree_ladder": str},
+}
+
+
+def validate_catalog_event(ev: dict, where: str,
+                           errors: List[str]) -> None:
+    """Attr contract for catalog_ingest / catalog_bucket records:
+    required attrs typed, counts non-negative (an ingest cannot
+    quarantine more pulsars than it saw), padding waste a fraction in
+    [0, 1)."""
+    name = ev.get("name")
+    required = CATALOG_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    for key in required:
+        v = attrs.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and v < 0:
+            _err(errors, where, f"{name} attr {key!r} is negative ({v!r})")
+    if name == "catalog_ingest":
+        np_ = attrs.get("n_pulsars")
+        if isinstance(np_, int) and not isinstance(np_, bool) and np_ < 1:
+            _err(errors, where,
+                 f"catalog_ingest n_pulsars is {np_!r}; an ingest that "
+                 "kept zero pulsars raises, it never records")
+    elif name == "catalog_bucket":
+        pw = attrs.get("pad_waste_frac")
+        if isinstance(pw, (int, float)) and not isinstance(pw, bool) \
+                and not (0.0 <= pw < 1.0):
+            _err(errors, where,
+                 f"catalog_bucket pad_waste_frac is {pw!r}, not a "
+                 "fraction in [0, 1)")
+        nb = attrs.get("n_buckets")
+        if isinstance(nb, int) and not isinstance(nb, bool) and nb < 1:
+            _err(errors, where,
+                 f"catalog_bucket n_buckets is {nb!r}, must be >= 1")
+
 
 def validate_autotune_event(ev: dict, where: str,
                             errors: List[str]) -> None:
@@ -638,6 +696,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_elastic_event(ev, where, errors)
                     validate_serving_event(ev, where, errors)
                     validate_autotune_event(ev, where, errors)
+                    validate_catalog_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -891,15 +950,28 @@ def self_test(errors: List[str]) -> int:
                          reason="no tuned decision at this "
                                 "vkey/device fingerprint",
                          static="128")
+        # catalog-engine producer drift check: the ingest/bucket event
+        # contract (CATALOG_EVENT_ATTRS) — a clean ingest, its degraded
+        # twin (quarantined rows + an excluded pulsar, with the codes),
+        # and one bucket-assignment record
+        run.record_event("catalog_ingest", n_pulsars=16, n_toas=612,
+                         n_quarantined=0, quarantined_pulsars=0,
+                         codes="")
+        run.record_event("catalog_ingest", n_pulsars=15, n_toas=580,
+                         n_quarantined=3, quarantined_pulsars=1,
+                         codes="toa-bad-error,toa-nonfinite-mjd")
+        run.record_event("catalog_bucket", n_pulsars=16, n_buckets=3,
+                         pad_waste_frac=0.041,
+                         ntoa_ladder="24,40,64", nfree_ladder="10")
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
         # sharding_plan, 3x elastic events, 3x serving events, 2x
-        # autotune events, metrics, run_end
-        if n < 18:
-            _err(errors, "selftest", f"expected >= 18 records, got {n}")
+        # autotune events, 3x catalog events, metrics, run_end
+        if n < 21:
+            _err(errors, "selftest", f"expected >= 21 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
