@@ -251,6 +251,23 @@ def bench_b1855_gls():
                  "error": f"{type(e).__name__}: {e}"}
     st.mark("autotune measurement")
 
+    # mixed-precision measurement (ROADMAP item 4): resolve the active
+    # precision policy per segment, serve the same linearized-fit batch
+    # under a forced-f64 override and under the active policy, and
+    # stamp throughput for both + the measured mixed-vs-f64
+    # disagreement.  Default (no manifest) is bit-identical f64:
+    # reduced_count 0, max_rel_err 0.0.  Never fatal: a broken
+    # precision layer degrades to an errored-but-present block.
+    try:
+        prec = precision_block(f)
+    except Exception as e:
+        prec = {"segments": None, "reduced_count": None,
+                "f64_count": None, "mixed_fits_per_s": None,
+                "f64_fits_per_s": None, "mixed_vs_f64": None,
+                "max_rel_err": None,
+                "error": f"{type(e).__name__}: {e}"}
+    st.mark("precision measurement")
+
     # PTA catalog measurement (ROADMAP item 1): fit a ragged synthetic
     # multi-pulsar catalog as one batched program per bucket and
     # evaluate the joint Hellings-Downs lnlikelihood over a walker
@@ -290,6 +307,7 @@ def bench_b1855_gls():
         "cost": cost,
         "warm": warm,
         "tuned": tuned,
+        "precision": prec,
         "catalog": catalog,
     }
 
@@ -431,6 +449,78 @@ def tuned_block(f, g_m2, g_sini, niter, static_chunk):
         "tuned_vs_static": round(ratio, 4),
         "basis": dec.basis,
         "decisions": decisions,
+    }
+
+
+#: precision-block serve batch: same coalesced shape as the warm block,
+#: measured twice (forced f64 vs the active policy)
+PRECISION_SERVE_REQUESTS = 8
+
+
+def precision_block(f):
+    """The headline's ``precision{}`` block: resolve the active
+    mixed-precision policy per segment (:mod:`pint_tpu.precision`),
+    then serve one coalesced linearized-fit batch under a forced-f64
+    override and again under the active policy, stamping both
+    throughputs, their ratio, and the measured worst mixed-vs-f64
+    relative disagreement across the batch's chi2 and steps.
+
+    With no tuning manifest and no override the active policy IS f64 —
+    ``reduced_count`` 0, ``max_rel_err`` exactly 0.0 (bit-identical
+    executables) — and ``tools/perfwatch.py`` gates
+    ``mixed_fits_per_s`` drops and ``max_rel_err`` rises (zero-
+    baseline opt-in: the first nonzero disagreement in a bit-identical
+    history fails the gate rather than slipping in silently)."""
+    from pint_tpu import precision
+    from pint_tpu.serving.batcher import FitRequest, ShapeBatcher
+
+    segs = precision.describe_segments(f.model, f.toas)
+    reduced = {n: s["tag"] for n, s in segs.items()
+               if s["compute_dtype"] != "float64"}
+    base = FitRequest.from_fitter(f)
+
+    def _reqs():
+        return [FitRequest(M=base.M, r=base.r, w=base.w,
+                           phiinv=base.phiinv, params=base.params,
+                           norm=base.norm, request_id=f"prec-{i}")
+                for i in range(PRECISION_SERVE_REQUESTS)]
+
+    batcher = ShapeBatcher()
+
+    def _timed_pass():
+        batcher.run(_reqs())           # settle: compile out of the clock
+        t0 = time.time()
+        results = batcher.run(_reqs())
+        return results, time.time() - t0
+
+    with precision.use_policy(precision.PrecisionPolicy.f64()):
+        res64, f64_el = _timed_pass()
+    resmix, mix_el = _timed_pass()     # the active policy
+    if f64_el <= 0 or mix_el <= 0:
+        raise RuntimeError(
+            f"precision timing degenerate: f64 {f64_el}s, "
+            f"mixed {mix_el}s")
+    chi64 = np.array([r.chi2 for r in res64])
+    chimix = np.array([r.chi2 for r in resmix])
+    rel_chi = float(np.max(np.abs(chimix - chi64))
+                    / max(float(np.max(np.abs(chi64))), 1e-300))
+    dx64 = np.stack([r.dx for r in res64])
+    dxmix = np.stack([r.dx for r in resmix])
+    dx_scale = max(float(np.max(np.abs(dx64))), 1e-300)
+    rel_dx = float(np.max(np.abs(dxmix - dx64)) / dx_scale)
+    if not (np.all(np.isfinite(chimix)) and np.all(np.isfinite(dxmix))):
+        raise RuntimeError("mixed-precision pass produced non-finite "
+                           "results")
+    f64_fps = len(res64) / f64_el
+    mix_fps = len(resmix) / mix_el
+    return {
+        "segments": {n: s["tag"] for n, s in segs.items()},
+        "reduced_count": len(reduced),
+        "f64_count": len(segs) - len(reduced),
+        "mixed_fits_per_s": round(mix_fps, 3),
+        "f64_fits_per_s": round(f64_fps, 3),
+        "mixed_vs_f64": round(mix_fps / f64_fps, 4),
+        "max_rel_err": max(rel_chi, rel_dx),
     }
 
 
@@ -792,6 +882,11 @@ def main():
         # ratio — a tuned configuration may tie the static default but
         # never ship slower)
         "tuned": r["tuned"],
+        # mixed-precision layer: resolved per-segment policy, forced-f64
+        # vs active-policy serve throughput, and the measured
+        # disagreement (perfwatch gates mixed_fits_per_s drops and
+        # max_rel_err rises; default policy is bit-identical f64)
+        "precision": r["precision"],
         # PTA catalog engine: batched multi-pulsar fit throughput,
         # bucket-ladder padding waste, and joint Hellings-Downs
         # lnlikelihood throughput (perfwatch gates catalog_fits_per_s

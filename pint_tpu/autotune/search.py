@@ -833,6 +833,14 @@ def tune_precision(ftr,
     """dd-split-guarded reduced precision for the grid kernel's
     Woodbury chi2-correction segment.
 
+    This is the SEED probe the precision-tuning layer generalizes:
+    every other matmul segment (design/Gram products, the serve and
+    catalog kernels, the joint lnlikelihood) is probed per segment by
+    :func:`pint_tpu.precision.tune.tune_precision_segments` under the
+    same discipline, with decisions on ``precision.<segment>`` manifest
+    keys; this probe keeps its legacy ``grid.correction_dtype`` key
+    (consumer: ``build_grid_gls_chi2_fn(correction_dtype=)``).
+
     The segment computes ``z = L^-1 (U_chi^T W r)`` and subtracts
     ``z.z`` from the whitened chi2.  A float32 segment would halve its
     bytes (the TPU's native regime); it is only SAFE when the
@@ -898,6 +906,18 @@ def tune_precision(ftr,
 # orchestrator
 # ---------------------------------------------------------------------------
 
+def _tune_precision_segments(ftr, grid_params, points, tuning_manifest):
+    """The precision layer's per-segment probes, run under the PR 10
+    discipline (unforced: reduced ships only below each segment's
+    safe bar — on realistic workloads every decision records f64 with
+    its measured margin)."""
+    from pint_tpu.precision import tune_precision_segments
+
+    return tune_precision_segments(
+        ftr, grid_params=tuple(grid_params), points=points,
+        tuning_manifest=tuning_manifest)
+
+
 def autotune_workload(ftr, grid_params: Sequence[str], points,
                       chunks: Optional[Sequence[int]] = None,
                       niter: int = 1, top_k: int = 2,
@@ -926,6 +946,8 @@ def autotune_workload(ftr, grid_params: Sequence[str], points,
             tuning_manifest=tuning_manifest)),
         ("grid.correction_dtype", lambda: tune_precision(
             ftr, tuning_manifest=tuning_manifest)),
+        ("precision.segments", lambda: _tune_precision_segments(
+            ftr, grid_params, points, tuning_manifest)),
     ]
     if serve_shapes is None:
         serve_shapes = [(len(ftr.toas), len(ftr.model.free_params))]

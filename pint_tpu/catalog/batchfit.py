@@ -38,7 +38,8 @@ from pint_tpu.exceptions import NonFiniteSystemError, UsageError
 from pint_tpu.logging import log
 
 __all__ = ["CatalogFitter", "CatalogFitResult", "PulsarFit",
-           "catalog_batched", "DEFAULT_CATALOG_BATCH_BUCKETS"]
+           "catalog_batched", "resolve_catalog_fit_spec",
+           "DEFAULT_CATALOG_BATCH_BUCKETS"]
 
 #: batch-axis ladder for bucket groups (powers of two so an elastic
 #: mesh rung always divides the batch)
@@ -53,25 +54,32 @@ def _emit_event(name: str, **attrs) -> None:
     telemetry.lifecycle_event(name, **attrs)
 
 
-#: the batched catalog executable: jit(vmap(serve_kernel)) — one
-#: compile per (batch, bucket_ntoas, bucket_nfree, sharding) signature,
-#: shared process-wide through jit's dispatch cache (module-level so
-#: repeat CatalogFitters retrace into the warm cache, the serving
-#: discipline)
-_catalog_batched_jit = None
+def resolve_catalog_fit_spec():
+    """The active ``catalog.fit`` precision
+    :class:`~pint_tpu.precision.SegmentSpec` (override -> manifest ->
+    f64 default), resolved host-side at dispatch/warm time."""
+    from pint_tpu.precision import segment_spec
+
+    return segment_spec("catalog.fit")
 
 
-def catalog_batched():
-    """The module's jitted ``vmap(serve_kernel)`` (lazy: importing the
-    catalog package must not import jax)."""
-    global _catalog_batched_jit
-    if _catalog_batched_jit is None:
-        import jax
+def catalog_batched(spec=None):
+    """The batched catalog executable: the serving layer's jitted
+    ``vmap(serve_kernel)`` under the ``catalog.fit`` precision segment
+    (default: the resolved active spec; lazy — importing the catalog
+    package must not import jax).  Delegating to
+    :func:`~pint_tpu.serving.batcher.serve_batched`'s per-precision-key
+    jit registry keeps one executable per (batch, bucket_ntoas,
+    bucket_nfree, sharding) signature process-wide — repeat
+    CatalogFitters (and the serving layer itself, at coinciding
+    shapes) retrace into the same warm cache, and a policy flip keys a
+    fresh jit instead of replaying a wrong-precision compile.  An f64
+    spec is the exact pre-precision kernel."""
+    from pint_tpu.serving.batcher import serve_batched
 
-        from pint_tpu.serving.batcher import serve_kernel
-
-        _catalog_batched_jit = jax.jit(jax.vmap(serve_kernel))
-    return _catalog_batched_jit
+    if spec is None:
+        spec = resolve_catalog_fit_spec()
+    return serve_batched(spec)
 
 
 @dataclass
@@ -235,24 +243,31 @@ class CatalogFitter:
         return operands
 
     @staticmethod
-    def _bucket_name(batch: int, bucket: Tuple[int, int]) -> str:
+    def _bucket_name(batch: int, bucket: Tuple[int, int], spec) -> str:
         """The ONE spelling of a bucket executable's name — warm-pool
         entries key on it, so the warm path and the fit path must never
-        drift (a mismatch would silently fall through to a fresh jit)."""
-        return f"catalog.fit[{batch}x{bucket[0]}x{bucket[1]}]"
+        drift (a mismatch would silently fall through to a fresh jit).
+        A reduced ``catalog.fit`` precision spec suffixes the name: a
+        pool warmed at one precision never serves another."""
+        return f"catalog.fit[{batch}x{bucket[0]}x{bucket[1]}]" \
+            + spec.suffix()
 
-    def bucket_executables(self) -> Dict[str, tuple]:
+    def bucket_executables(self, spec=None) -> Dict[str, tuple]:
         """``name -> (jitted fn, operands)`` per bucket at the CURRENT
         linearized state — the handles the warm pool compiles and the
         cost/distview observatory analyzes (what is warmed/analyzed IS
-        what :meth:`fit` dispatches)."""
+        what :meth:`fit` dispatches).  ``spec`` lets one caller (the
+        warm pass) resolve the ``catalog.fit`` precision spec exactly
+        once for both the vkey and the executable names."""
         reqs = self._requests()
+        if spec is None:
+            spec = resolve_catalog_fit_spec()
         out: Dict[str, tuple] = {}
         for bucket, idx in sorted(self.bucket_plan.buckets.items()):
             group = [reqs[i] for i in idx]
             operands = self._group_operands(bucket, group)
-            name = self._bucket_name(operands[0].shape[0], bucket)
-            out[name] = (catalog_batched(), operands)
+            name = self._bucket_name(operands[0].shape[0], bucket, spec)
+            out[name] = (catalog_batched(spec), operands)
         return out
 
     # -- warm-up -----------------------------------------------------------
@@ -273,10 +288,18 @@ class CatalogFitter:
         if pool is not None:
             self.pool = pool
         report = WarmupReport()
-        for name, (fn, operands) in self.bucket_executables().items():
+        # ONE spec resolution for the whole warm pass: the vkey and the
+        # executable names must come from the same decision (a manifest
+        # flip between two resolutions would warm entries fit() can
+        # never look up)
+        spec = resolve_catalog_fit_spec()
+        vkey = ("catalog_kernel", 1) if not spec.reduced \
+            else ("catalog_kernel", 1, spec.key())
+        for name, (fn, operands) in \
+                self.bucket_executables(spec=spec).items():
             if self.pool is not None:
                 report.entries.append(self.pool.warm(
-                    name, fn, operands, vkey=("catalog_kernel", 1)))
+                    name, fn, operands, vkey=vkey))
             else:
                 fn(*operands)  # prime jit's dispatch cache
         return report
@@ -304,17 +327,19 @@ class CatalogFitter:
         with _span("catalog.fit", n_pulsars=len(self.pulsars),
                    n_buckets=self.bucket_plan.n_buckets,
                    maxiter=maxiter) as sp, _jaxevents.watch(sp):
+            spec = resolve_catalog_fit_spec()
             for it in range(maxiter):
                 reqs = self._requests()
                 for bucket, idx in sorted(self.bucket_plan.buckets.items()):
                     group = [reqs[i] for i in idx]
                     operands = self._group_operands(bucket, group)
                     name = self._bucket_name(operands[0].shape[0],
-                                             bucket)
+                                             bucket, spec)
                     handle = None
                     if self.pool is not None:
                         handle = self.pool.lookup(name, operands)
-                    fn = handle if handle is not None else catalog_batched()
+                    fn = handle if handle is not None \
+                        else catalog_batched(spec)
                     out = [np.asarray(o) for o in fn(*operands)]
                     for j, i in enumerate(idx):
                         kernel_out[i] = (out[0][j], out[1][j],

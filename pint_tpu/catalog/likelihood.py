@@ -38,8 +38,11 @@ a ``catalog`` execution plan: padded per-pulsar operands over the
 HOST-RANGE CAVEAT: the enterprise timing prior (1e40) enters as
 ``phiinv ~ 1e-40`` data operands; on TPU f64-emulation backends these
 exceed float32 RANGE (DESIGN.md round 5) — the joint likelihood is a
-host/CPU-f64 and native-f64 code path until the precision arc
-(ROADMAP item 4) gives it a range-safe split.
+host/CPU-f64 and native-f64 code path.  The precision layer's
+``catalog.lnlike`` segment (ROADMAP item 4) reduces only the
+O(1)-scaled Gram/projection MATMULS (unit-W-norm operands); the
+``phiinv`` diagonals, determinants, and factorizations stay f64, so
+the range hazard never meets a reduced dtype.
 """
 
 from __future__ import annotations
@@ -58,7 +61,7 @@ FYR_HZ = 1.0 / (365.25 * 86400.0)
 _DAY_S = 86400.0
 
 
-def _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a, n2pi):
+def _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a, n2pi, spec=None):
     """One pulsar's marginalized Woodbury pieces — the traced block
     shared by the joint kernel and :meth:`JointLikelihood.
     per_pulsar_lnlike` (one copy: a formula fix cannot drift between
@@ -70,9 +73,17 @@ def _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a, n2pi):
     from every sum and from the white-noise determinant), pad columns
     carry ``phiinv == 0`` (excluded from the scaled prior determinant)
     and a unit pad-diagonal (their Sigma block is the identity —
-    log-det 0)."""
+    log-det 0).
+
+    ``spec`` (trace-time static) is the ``catalog.lnlike`` precision
+    segment: the Gram/projection matmuls run at its compute dtype with
+    its accumulation back to f64; ``None``/f64 is bit-identical to the
+    pre-precision block, and the factorization, determinants, and
+    every reduction stay f64 regardless."""
     import jax.numpy as jnp
     import jax.scipy.linalg as jsl
+
+    from pint_tpu.precision import matmul as _pmatmul
 
     # unit-W-norm column scaling: the fitter family's conditioning
     # move; pad columns (phiinv 0, zero data) scale to 1 and pick up
@@ -81,10 +92,10 @@ def _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a, n2pi):
     s = jnp.sqrt(jnp.sum(wM * M_a, axis=0) + phiinv_a)
     s = jnp.where(s > 0, s, 1.0)
     Ms = M_a / s
-    Sigma = Ms.T @ (w_a[:, None] * Ms) + jnp.diag(phiinv_a / s**2) \
-        + jnp.diag(pad_a)
+    Sigma = _pmatmul(Ms.T, w_a[:, None] * Ms, spec) \
+        + jnp.diag(phiinv_a / s**2) + jnp.diag(pad_a)
     cf = jsl.cho_factor(Sigma, lower=True)
-    b = Ms.T @ (w_a * r_a)
+    b = _pmatmul(Ms.T, w_a * r_a, spec)
     xb = jsl.cho_solve(cf, b)
     rNr = jnp.sum(w_a * r_a * r_a)
     lndetN = -jnp.sum(jnp.where(w_a > 0, jnp.log(w_a), 0.0))
@@ -99,24 +110,29 @@ def _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a, n2pi):
 
 
 def _joint_kernel(amp, gamma, M, r, w, phiinv, pad_free, F, Lhd, freqs,
-                  Tspan, n2pi):
+                  Tspan, n2pi, spec=None):
     """The traced joint lnlike: per-pulsar Woodbury pieces vmapped over
     the padded pulsar axis + the low-rank HD cross term.  ``amp`` is
     the LINEAR GW amplitude (zero is exact: the cross term vanishes
-    identically, no branch needed)."""
+    identically, no branch needed).  ``spec`` is the ``catalog.lnlike``
+    precision segment shared with :func:`_pulsar_block` (both sides of
+    the factorization pin trace the same dtype)."""
     import jax
     import jax.numpy as jnp
     import jax.scipy.linalg as jsl
 
+    from pint_tpu.precision import matmul as _pmatmul
+
     def one(M_a, r_a, w_a, phiinv_a, pad_a, F_a):
         lnl, Ms, cf, xb = _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a,
-                                        n2pi)
+                                        n2pi, spec=spec)
         # cross-term projections: F^T P^-1 r and F^T P^-1 F via the
         # same factored Sigma (Woodbury action, no dense P)
         WF = w_a[:, None] * F_a
-        A_mf = Ms.T @ WF
-        y_a = F_a.T @ (w_a * r_a) - A_mf.T @ xb
-        X_a = F_a.T @ WF - A_mf.T @ jsl.cho_solve(cf, A_mf)
+        A_mf = _pmatmul(Ms.T, WF, spec)
+        y_a = _pmatmul(F_a.T, w_a * r_a, spec) - A_mf.T @ xb
+        X_a = _pmatmul(F_a.T, WF, spec) \
+            - _pmatmul(A_mf.T, jsl.cho_solve(cf, A_mf), spec)
         return lnl, y_a, X_a
 
     lnl, ys, Xs = jax.vmap(one)(M, r, w, phiinv, pad_free, F)
@@ -156,10 +172,25 @@ class JointLikelihood:
     walker)`` sharding ROADMAP item 2 prescribes."""
 
     def __init__(self, catalog, n_modes: int = 5, plan=None,
-                 pad_shape: Optional[Tuple[int, int]] = None):
+                 pad_shape: Optional[Tuple[int, int]] = None,
+                 precision=None):
         from pint_tpu.catalog.crosscorr import hd_cholesky
+        from pint_tpu.precision import SegmentSpec, segment_spec
         from pint_tpu.serving.batcher import FitRequest, pad_request
 
+        # catalog.lnlike precision segment: an explicit SegmentSpec
+        # wins; None resolves override -> manifest -> f64 default.
+        # Resolved ONCE here — the jitted kernel closes over it, and
+        # per_pulsar_lnlike shares it so both sides of the
+        # factorization pin trace the same dtype.
+        if precision is None:
+            self._pspec = segment_spec("catalog.lnlike")
+        elif isinstance(precision, SegmentSpec):
+            self._pspec = precision
+        else:
+            raise UsageError(
+                f"precision must be a SegmentSpec or None, got "
+                f"{type(precision).__name__}")
         pulsars = list(getattr(catalog, "pulsars", catalog))
         if len(pulsars) < 2:
             raise UsageError("the joint likelihood needs >= 2 pulsars "
@@ -263,13 +294,15 @@ class JointLikelihood:
             freqs = np.asarray(self.freqs)
             Tspan = float(self.Tspan)
             n2pi = float(np.log(2.0 * np.pi))
+            spec = self._pspec
 
             def batched(points, M, r, w, phiinv, pad_free, F):
                 def one(pt):
                     amp = 10.0 ** pt[0]
                     return _joint_kernel(amp, pt[1], M, r, w, phiinv,
                                          pad_free, F, jnp.asarray(Lhd),
-                                         jnp.asarray(freqs), Tspan, n2pi)
+                                         jnp.asarray(freqs), Tspan, n2pi,
+                                         spec=spec)
 
                 return jax.vmap(one)(points)
 
@@ -318,10 +351,11 @@ class JointLikelihood:
 
         M, r, w, phiinv, pad_free, _ = self._data
         n2pi = float(np.log(2.0 * np.pi))
+        spec = self._pspec
 
         def one(M_a, r_a, w_a, phiinv_a, pad_a):
             return _pulsar_block(M_a, r_a, w_a, phiinv_a, pad_a,
-                                 n2pi)[0]
+                                 n2pi, spec=spec)[0]
 
         out = np.asarray(jax.vmap(one)(M, r, w, phiinv, pad_free))
         return out[: len(self.pulsars)]

@@ -149,27 +149,45 @@ def linearized_system(model, toas, resids=None):
             tuple(params), np.asarray(norm, dtype=np.float64))
 
 
+def _design_spec(model, toas):
+    """The resolved ``gls.design`` precision segment for this workload
+    (override -> manifest ``precision.gls.design`` key -> bit-identical
+    f64 default).  Host-side; resolved once per step and closed over
+    the Gram products below."""
+    from pint_tpu.precision import segment_spec
+
+    return segment_spec("gls.design", model=model, toas=toas)
+
+
 def gls_normal_equations(M: np.ndarray, r: np.ndarray,
                          Nvec: Optional[np.ndarray] = None,
                          phiinv: Optional[np.ndarray] = None,
-                         cov: Optional[np.ndarray] = None):
-    """mtcm, mtcy for either GLS path (reference ``fitter.py:2696,2712``)."""
+                         cov: Optional[np.ndarray] = None,
+                         spec=None):
+    """mtcm, mtcy for either GLS path (reference ``fitter.py:2696,2712``).
+
+    ``spec`` (a :class:`pint_tpu.precision.SegmentSpec`) drives the
+    ``gls.design`` precision segment: the ``M^T C^-1 M`` / ``M^T C^-1
+    r`` contractions run at its compute dtype with its accumulation
+    back to f64.  ``None``/f64 is exactly the pre-precision build."""
+    from pint_tpu.precision import matmul as _pmatmul
+
     if cov is not None:
         cf, _, _ = hardened_cholesky(cov, name="TOA covariance")
         cm = np.asarray(jsl.cho_solve((jnp.asarray(cf), True), jnp.asarray(M)))
-        mtcm = M.T @ cm
-        mtcy = cm.T @ r
+        mtcm = _pmatmul(M.T, cm, spec)
+        mtcy = _pmatmul(cm.T, r, spec)
     else:
         cinv = 1.0 / Nvec
-        mtcm = M.T @ (cinv[:, None] * M)
-        mtcm += np.diag(phiinv)
-        mtcy = M.T @ (cinv * r)
+        mtcm = _pmatmul(M.T, cinv[:, None] * M, spec)
+        mtcm = mtcm + np.diag(phiinv)
+        mtcy = _pmatmul(M.T, cinv * r, spec)
     return mtcm, mtcy
 
 
 def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
                      phiinv: np.ndarray, ntm: int, cache: dict,
-                     ladder=None):
+                     ladder=None, spec=None):
     """Solve the augmented system via a Schur complement on the noise
     block.
 
@@ -188,6 +206,7 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     caller's SVD path, non-finite inputs raise
     :class:`NonFiniteSystemError` outright.
     """
+    from pint_tpu.precision import matmul as _pmatmul
     from pint_tpu.runtime.solve import JITTER_LADDER
 
     ladder = ladder or JITTER_LADDER
@@ -198,22 +217,25 @@ def _schur_gls_solve(M: np.ndarray, r: np.ndarray, Nvec: np.ndarray,
     M_t, M_u = M[:, :ntm], M[:, ntm:]
     pu = phiinv[ntm:]
     WM_u = W[:, None] * M_u
+    # gls.design precision segment key: a policy flip must invalidate
+    # the cached noise-block factor (same Gram, different arithmetic)
+    skey = None if spec is None else spec.key()
     hit = cache.get("schur")
     # exact invalidation: the factor is only reused while the noise block's
     # every input is bitwise unchanged (cheap O(n*nu) compares vs the
     # O(n*nu^2) Gram it saves)
     if (hit is not None and hit[0] == M.shape and hit[1] == ntm
             and np.array_equal(hit[2], pu) and np.array_equal(hit[3], Nvec)
-            and np.array_equal(hit[4], M_u)):
+            and np.array_equal(hit[4], M_u) and hit[7] == skey):
         L_D, jit_D = hit[5], hit[6]
     else:
-        D = M_u.T @ WM_u + np.diag(pu)
+        D = _pmatmul(M_u.T, WM_u, spec) + np.diag(pu)
         L_D, jit_D, _ = hardened_cholesky(D, name="GLS noise block",
                                           ladder=ladder)
         cache["schur"] = (M.shape, ntm, pu.copy(), Nvec.copy(), M_u.copy(),
-                          L_D, jit_D)
-    A = M_t.T @ (W[:, None] * M_t) + np.diag(phiinv[:ntm])
-    C = M_t.T @ WM_u
+                          L_D, jit_D, skey)
+    A = _pmatmul(M_t.T, W[:, None] * M_t, spec) + np.diag(phiinv[:ntm])
+    C = _pmatmul(M_t.T, WM_u, spec)
     b_t = M_t.T @ (W * r)
     b_u = WM_u.T @ r
     Y = np.asarray(jsl.solve_triangular(jnp.asarray(L_D), jnp.asarray(C.T),
@@ -250,7 +272,8 @@ def _try_schur_path(fitter, M, r, Nvec, phiinv, ntm, norm):
     try:
         xvar_t, xhat, diag = _schur_gls_solve(
             M, r, Nvec, phiinv, ntm, fitter._gls_cache,
-            ladder=getattr(fitter, "_solve_ladder", None))
+            ladder=getattr(fitter, "_solve_ladder", None),
+            spec=getattr(fitter, "_precision_spec", None))
     except _CHOLESKY_FAILURES:
         # ladder exhausted: the dense path's own ladder/SVD takes over
         # (NonFiniteSystemError propagates — retrying cannot fix NaNs)
@@ -280,27 +303,48 @@ def _make_gls_cholesky_solve():
 _gls_cholesky_solve = _make_gls_cholesky_solve()
 
 
-def _make_gls_normal_equations():
+def _make_gls_normal_equations(spec=None):
     import jax
+
+    from pint_tpu.precision import matmul as _pmatmul
 
     def normal_eq(M, r, Nvec, phiinv):
         cinv = 1.0 / Nvec
-        mtcm = M.T @ (cinv[:, None] * M) + jnp.diag(phiinv)
-        mtcy = M.T @ (cinv * r)
+        mtcm = _pmatmul(M.T, cinv[:, None] * M, spec) + jnp.diag(phiinv)
+        mtcy = _pmatmul(M.T, cinv * r, spec)
         return mtcm, mtcy
 
     return jax.jit(normal_eq)
 
 
-#: ONE jitted Woodbury-form normal-equation build, for the same reason
-#: as _gls_cholesky_solve — and the distributed observatory's collective
-#: accounting target: with the TOA axis sharded, the M^T C^-1 M / M^T
-#: C^-1 r contractions become cross-device all-reduces
+#: ONE jitted Woodbury-form normal-equation build per gls.design
+#: precision key, for the same warm-cache reason as _gls_cholesky_solve
+#: — and the distributed observatory's collective accounting target:
+#: with the TOA axis sharded, the M^T C^-1 M / M^T C^-1 r contractions
+#: become cross-device all-reduces.  The f64 instance keeps the
+#: historical module-level name.
 _gls_normal_equations = _make_gls_normal_equations()
+_gls_normal_equations_by_spec = {("float64", "native"):
+                                 _gls_normal_equations}
+
+
+def _gls_normal_equations_for(spec=None):
+    """The jitted normal-equation build traced under ``spec`` (module-
+    level per precision key, so repeat profiling/warming retraces into
+    the warm executable cache instead of compiling fresh)."""
+    if spec is None or not spec.reduced:
+        return _gls_normal_equations
+    key = spec.key()
+    fn = _gls_normal_equations_by_spec.get(key)
+    if fn is None:
+        fn = _make_gls_normal_equations(spec)
+        _gls_normal_equations_by_spec[key] = fn
+    return fn
 
 
 def _sharded_normal_equations(M: np.ndarray, r: np.ndarray,
-                              Nvec: np.ndarray, phiinv: np.ndarray, plan):
+                              Nvec: np.ndarray, phiinv: np.ndarray, plan,
+                              spec=None):
     """The Woodbury normal-equation build executed on ``plan``'s mesh:
     TOA-indexed operands sharded over the plan's first axis, so the
     ``M^T C^-1 M`` / ``M^T C^-1 r`` contractions compile into real
@@ -321,7 +365,7 @@ def _sharded_normal_equations(M: np.ndarray, r: np.ndarray,
     specs = (P(axis, None), P(axis), P(axis), P())
     args = [jax.device_put(jnp.asarray(a), NamedSharding(mesh, s))
             for a, s in zip((M, r, Nvec, phiinv), specs)]
-    mtcm, mtcy = _gls_normal_equations(*args)
+    mtcm, mtcy = _gls_normal_equations_for(spec)(*args)
     return np.asarray(mtcm), np.asarray(mtcy)
 
 
@@ -351,12 +395,17 @@ class GLSFitter(Fitter):
         """
         r = np.asarray(self.resids.time_resids)
         self._noise_dims = None
+        # gls.design precision segment: resolved once per step (manifest
+        # memoized; f64 default short-circuits) and threaded through the
+        # Gram builds below AND the Schur fast path via the fitter attr
+        self._precision_spec = _design_spec(self.model, self.toas)
+        spec = self._precision_spec
         if full_cov:
             M_tm, params, units = self.get_designmatrix()
             M, norm = normalize_designmatrix(M_tm, params)
             M, norm = np.asarray(M), np.asarray(norm)
             cov = self.model.toa_covariance_matrix(self.toas)
-            mtcm, mtcy = gls_normal_equations(M, r, cov=cov)
+            mtcm, mtcy = gls_normal_equations(M, r, cov=cov, spec=spec)
         else:
             M, params, norm, phiinv, Nvec, dims = build_augmented_system(
                 self.model, self.toas)
@@ -371,14 +420,19 @@ class GLSFitter(Fitter):
                 # unchanged
                 from pint_tpu.runtime.elastic import run_with_degradation
 
+                # the gls.design spec is forwarded only when reduced:
+                # the f64 default keeps the routed seam's historical
+                # 5-argument signature (fault-injection fakes included)
+                skw = {"spec": spec} if spec.reduced else {}
                 (mtcm, mtcy), self.plan, self.last_elastic_report = \
                     run_with_degradation(
                         plan,
                         lambda p: _sharded_normal_equations(
-                            M, r, Nvec, phiinv, p)
+                            M, r, Nvec, phiinv, p, **skw)
                         if p.mesh is not None
                         else gls_normal_equations(M, r, Nvec=Nvec,
-                                                  phiinv=phiinv),
+                                                  phiinv=phiinv,
+                                                  spec=spec),
                         what="GLS sharded normal equations")
             else:
                 if threshold <= 0 and M.shape[1] > ntm:
@@ -390,7 +444,8 @@ class GLSFitter(Fitter):
                     if out is not None:
                         return (*out, params)
                 mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec,
-                                                  phiinv=phiinv)
+                                                  phiinv=phiinv,
+                                                  spec=spec)
         if threshold <= 0:
             try:
                 # the tuned entry rung (_solve_ladder) deliberately
@@ -490,7 +545,8 @@ class GLSFitter(Fitter):
             args = [args[0][:keep], args[1][:keep], args[2][:keep], args[3]]
             args = [jax.device_put(a, NamedSharding(mesh, s))
                     for a, s in zip(args, specs)]
-        return _gls_normal_equations, tuple(args)
+        pspec = _design_spec(self.model, self.toas)
+        return _gls_normal_equations_for(pspec), tuple(args)
 
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
                  full_cov: bool = False, debug: bool = False,

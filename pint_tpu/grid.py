@@ -428,7 +428,8 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                            fit_params: Optional[Sequence[str]] = None,
                            niter: int = 4, chunk=None,
                            grid_spans: Optional[Sequence[float]] = None,
-                           correction_dtype: Optional[str] = None):
+                           correction_dtype: Optional[str] = None,
+                           precision=None):
     """GLS counterpart of :func:`build_grid_chi2_fn` for correlated-noise
     models (reference benchmark ``profiling/bench_chisq_grid.py`` semantics:
     a ``GLSFitter`` refit per grid point).
@@ -448,9 +449,21 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
 
     ``correction_dtype`` selects the precision of the Woodbury
     chi2-correction segment (``"float64"`` | ``"float32"``); ``None``
-    consults the autotuner's dd-split-guarded probe decision, which
-    keeps float64 unless measured safe for exactly this system.
+    consults the precision layer's override policy first (the
+    ``grid.correction`` segment), then the autotuner's dd-split-guarded
+    probe decision, which keeps float64 unless measured safe for
+    exactly this system.
+
+    ``precision`` is the ``grid.gram`` segment's
+    :class:`~pint_tpu.precision.SegmentSpec` — the per-point
+    design/Gram products inside the traced kernel run at its compute
+    dtype with its accumulation back to f64.  ``None`` resolves the
+    active policy (override -> manifest ``precision.grid.gram`` key ->
+    f64 default); an f64 spec is bit-identical to the pre-precision
+    kernel.
     """
+    from pint_tpu import precision as _precision
+
     chunk = _resolve_auto_chunk(model, toas, chunk)
     if chunk is None:
         chunk = default_gls_chunk()
@@ -460,13 +473,26 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             f"chunk must be a positive integer or 'auto', got {chunk!r}")
     chunk = int(chunk)
     if correction_dtype is None:
-        from pint_tpu import autotune as _autotune
+        corr_override = _precision.override_spec("grid.correction")
+        if corr_override is not None:
+            correction_dtype = "float32" if corr_override.reduced \
+                else "float64"
+        else:
+            from pint_tpu import autotune as _autotune
 
-        correction_dtype = _autotune.resolve_correction_dtype(model, toas)
+            correction_dtype = _autotune.resolve_correction_dtype(model,
+                                                                  toas)
     if correction_dtype not in ("float64", "float32"):
         raise UsageError(
             f"correction_dtype must be 'float64' or 'float32', got "
             f"{correction_dtype!r}")
+    if precision is None:
+        precision = _precision.segment_spec("grid.gram", model=model,
+                                            toas=toas)
+    elif not isinstance(precision, _precision.SegmentSpec):
+        raise UsageError(
+            f"precision must be a SegmentSpec or None, got "
+            f"{type(precision).__name__}")
     grid_params = tuple(grid_params)
     if fit_params is None:
         fit_params = tuple(p for p in model.free_params if p not in grid_params)
@@ -617,8 +643,8 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     # bit-identical pre-autotune path.
     _f32_corr = correction_dtype == "float32"
     if _f32_corr:
-        U_chi = U_chi.astype(jnp.float32)
-        cf_chi = cf_chi.astype(jnp.float32)
+        U_chi = _precision.downcast(U_chi, "float32")
+        cf_chi = _precision.downcast(cf_chi, "float32")
 
     # Solve recipe for the marginalized (Schur) timing system, fixed at
     # trace time per backend.  CPU: normalize by diag(A - Y^T Y) with a
@@ -635,10 +661,18 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     _TPU = jax.default_backend() in _TPU_PLATFORMS
     _RIDGE = 1e-9 if _TPU else 1e-12
 
-    # correction_dtype sits BEFORE the nl tuple: the classification
-    # result stays the key's last element (tests introspect it there)
+    # grid.gram precision segment: the spec is trace-time static —
+    # closed over the kernel and part of the executable key below.  The
+    # f64 default short-circuits _pm to the plain `a @ b` the
+    # pre-precision kernel ran (bit-identical).
+    _gram_spec = precision if precision.reduced else None
+    _pm = _precision.matmul
+
+    # correction_dtype + the gram-spec key sit BEFORE the nl tuple: the
+    # classification result stays the key's last element (tests
+    # introspect it there)
     grid_key = ("grid_gls_fn", all_names, nfit, niter, len(toas), chunk,
-                correction_dtype, tuple(nl_fit))
+                correction_dtype, precision.key(), tuple(nl_fit))
     if grid_key not in model._cache:
         nl_idx = jnp.asarray(nl_all, dtype=jnp.int32)
         # positions of the nonlinear columns within B (offset col 0 shifts)
@@ -684,19 +718,21 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
                     # M_nl^T @ x — an O(nt*k) fix-up, and B_base stays a
                     # broadcast constant the batched matmul can share.
                     wM = w[:, None] * M_nl
-                    A_cols = (B_base.T @ wM).at[nlp_idx, :].set(M_nl.T @ wM)
+                    A_cols = _pm(B_base.T, wM, _gram_spec) \
+                        .at[nlp_idx, :].set(_pm(M_nl.T, wM, _gram_spec))
                     # refresh the nl rows/cols of the Gram blocks: the
                     # (nl, nl) sub-block is written consistently twice
                     A = A_base.at[:, nlp_idx].set(A_cols)
                     A = A.at[nlp_idx, :].set(A_cols.T)
-                    C_rows = M_nl.T @ U_w  # (k, nu)
+                    C_rows = _pm(M_nl.T, U_w, _gram_spec)  # (k, nu)
                     Y_cols = jsl.solve_triangular(L_D, C_rows.T, lower=True)
                     Y = Y_base.at[:, nlp_idx].set(Y_cols)
-                    b_t = (B_base.T @ wr).at[nlp_idx].set(M_nl.T @ wr)
+                    b_t = _pm(B_base.T, wr, _gram_spec) \
+                        .at[nlp_idx].set(_pm(M_nl.T, wr, _gram_spec))
                 else:
                     A, Y = A_base, Y_base
-                    b_t = B_base.T @ wr
-                b_u = U_w.T @ r
+                    b_t = _pm(B_base.T, wr, _gram_spec)
+                b_u = _pm(U_w.T, r, _gram_spec)
                 z_u = jsl.solve_triangular(L_D, b_u, lower=True)
                 Ar = A - Y.T @ Y
                 rhs = b_t - Y.T @ z_u
@@ -737,7 +773,9 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
             wr = w * r
             if _f32_corr:
                 z = jsl.solve_triangular(
-                    cf_chi, U_chi.T @ wr.astype(jnp.float32), lower=True)
+                    cf_chi,
+                    U_chi.T @ _precision.downcast(wr, "float32"),
+                    lower=True)
             else:
                 z = jsl.solve_triangular(cf_chi, U_chi.T @ wr, lower=True)
             # per-point diagnostics for THIS pass: solved flag (every GN

@@ -1,0 +1,61 @@
+"""Precision-tuning layer: bf16/f32 matmul segments under the dd-split
+error budget (ROADMAP item 4).
+
+Three pieces:
+
+* :mod:`~pint_tpu.precision.policy` — :class:`SegmentSpec` descriptors
+  (segment name, compute dtype, accumulation mode, admitted error
+  budget) for the named hot-path segments, resolved override ->
+  tuning-manifest (``precision.<segment>`` keys) -> bit-identical f64
+  default;
+* :mod:`~pint_tpu.precision.compensated` — the traced primitives the
+  kernels call in place of bare ``a @ b`` / ``.astype``:
+  :func:`downcast` (the one sanctioned cast entry jaxlint's
+  ``unguarded-downcast`` rule points at) and :func:`matmul` with
+  ``native`` / ``f64`` / ``two_sum`` (dd error-free fold) accumulation
+  back to f64;
+* :mod:`~pint_tpu.precision.tune` — per-segment probes that run the
+  real consumer kernels f64-vs-reduced on the workload's actual
+  operands and persist ``precision.<segment>`` decisions only inside
+  each segment's stated budget.
+
+Consumers: the GLS fitter's normal-equation/Schur Grams
+(``gls.design``), the chunked GLS grid kernel (``grid.gram`` +
+PR 10's ``grid.correction``), the batched serve kernel
+(``serve.gram``), and the catalog batched-fit / joint-lnlikelihood
+kernels (``catalog.fit`` / ``catalog.lnlike``).
+"""
+
+from pint_tpu.precision.compensated import (
+    DEFAULT_SPLIT,
+    downcast,
+    matmul,
+    promote_f64,
+    two_sum_accumulate,
+)
+from pint_tpu.precision.policy import (
+    ACCUMULATIONS,
+    COMPUTE_DTYPES,
+    SEGMENTS,
+    PrecisionPolicy,
+    SegmentDef,
+    SegmentSpec,
+    active_policy,
+    describe_segments,
+    override_spec,
+    precision_vkey,
+    segment_spec,
+    set_policy,
+    spec_from_decision,
+    use_policy,
+)
+from pint_tpu.precision.tune import probe_segment, tune_precision_segments
+
+__all__ = [
+    "ACCUMULATIONS", "COMPUTE_DTYPES", "DEFAULT_SPLIT", "SEGMENTS",
+    "PrecisionPolicy", "SegmentDef", "SegmentSpec", "active_policy",
+    "describe_segments", "downcast", "matmul", "override_spec",
+    "precision_vkey", "probe_segment", "promote_f64", "segment_spec",
+    "set_policy", "spec_from_decision", "tune_precision_segments",
+    "two_sum_accumulate", "use_policy",
+]

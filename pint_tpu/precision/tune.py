@@ -1,0 +1,337 @@
+"""Per-segment precision probes: measure, decide, persist.
+
+The generalization of PR 10's single f32 Woodbury chi2-correction probe
+(:func:`pint_tpu.autotune.search.tune_precision`): every registered
+segment (:data:`pint_tpu.precision.policy.SEGMENTS`) gets a probe that
+runs the segment's ACTUAL consumer kernel twice — once at the f64
+default, once at the candidate reduced spec — on the workload's real
+operands, and measures the relative disagreement of the quantities the
+segment feeds (chi2, step vector, lnlikelihood).
+
+Decision discipline (the PR 10 contract, per segment):
+
+* **unforced** (``force=False``): the reduced spec ships only when the
+  measured disagreement sits below the segment's ``safe_rel`` bar
+  (chi2 rel < 1e-12 discipline) — on every realistic f64-native
+  workload this records the f64 default with the measured margin;
+* **forced** (``force=True``, the CPU demonstration / acceptance run):
+  the reduced spec records with the segment's ``forced_budget`` as its
+  admitted budget, and is REFUSED (f64 recorded, with the reason) when
+  the measured disagreement exceeds even that budget — a forced run
+  still cannot ship a broken segment;
+* either way the decision persists as a ``precision.<segment>`` key in
+  the tuning manifest (vkey + device-fingerprint scheme) and a
+  ``precision_probe`` telemetry event records segment, dtypes, measured
+  rel err, and the decision.
+
+Everything here is host-side orchestration (eager kernel evaluations,
+manifest I/O) — calling it from traced code is flagged by jaxlint's
+host-call-in-jit rule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.logging import log
+from pint_tpu.precision.policy import (
+    SEGMENTS,
+    SegmentSpec,
+    precision_vkey,
+)
+
+__all__ = ["probe_segment", "tune_precision_segments"]
+
+#: representative joint-lnlike point for the catalog.lnlike probe
+_LNLIKE_POINT = (-14.5, 13.0 / 3.0)
+
+
+#: finite stand-in for an outright-failed probe (rel = inf) in JSON
+#: artifacts and events: committed manifests and the strict-JSON event
+#: stream must never carry an Infinity token (the runlog would
+#: stringify it, failing the numeric attr contract; json.dump would
+#: write non-RFC JSON into tuning.json)
+_REL_FAILED_SENTINEL = 1e300
+
+
+def _finite_rel(rel: float) -> float:
+    import math
+
+    return float(rel) if math.isfinite(rel) else _REL_FAILED_SENTINEL
+
+
+def _emit_probe(segment: str, spec: SegmentSpec, rel: float,
+                budget: float, decision: str) -> None:
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(
+        "precision_probe", segment=segment,
+        dtype=spec.compute_dtype, accumulation=spec.accumulation,
+        rel_err=_finite_rel(rel), budget=float(budget),
+        decision=decision)
+
+
+def _rel(a: np.ndarray, b: np.ndarray, scale: Optional[float] = None
+         ) -> float:
+    """Relative disagreement of ``a`` vs reference ``b``: worst of the
+    elementwise deviations over ``scale`` (default: the reference's own
+    magnitude floor-clamped)."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if not np.all(np.isfinite(a)):
+        return float("inf")
+    s = scale if scale is not None else max(float(np.max(np.abs(b))),
+                                            1e-300)
+    return float(np.max(np.abs(a - b)) / s)
+
+
+def _serve_outputs(M, r, w, phiinv, pad_free, spec: Optional[SegmentSpec]):
+    """One eager serve-kernel evaluation under ``spec`` (the real
+    consumer kernel, not a model of it)."""
+    from pint_tpu.serving.batcher import serve_kernel
+
+    dx, err, chi2, chi2_init = serve_kernel(M, r, w, phiinv, pad_free,
+                                            spec=spec)
+    return (np.asarray(dx), np.asarray(err), float(chi2),
+            float(chi2_init))
+
+
+def _serve_system_rel(ftr, spec: SegmentSpec) -> float:
+    """f64-vs-``spec`` disagreement of the linearized-fit kernel on the
+    fitter's actual system: worst of chi2 (relative to chi2) and the
+    step vector (relative to the step's own scale)."""
+    from pint_tpu.serving.batcher import FitRequest, pad_request
+
+    q = FitRequest.from_fitter(ftr)
+    ops = pad_request(q, q.n_toas, q.n_free)
+    dx64, err64, chi2_64, _ = _serve_outputs(*ops, None)
+    dxr, _, chi2_r, _ = _serve_outputs(*ops, spec)
+    step_scale = max(float(np.linalg.norm(dx64)),
+                     float(np.linalg.norm(err64)), 1e-300)
+    return max(_rel(np.array([chi2_r]), np.array([chi2_64]),
+                    scale=max(abs(chi2_64), 1e-300)),
+               float(np.linalg.norm(dxr - dx64)) / step_scale)
+
+
+def _probe_gls_design(ftr, spec: SegmentSpec, **_) -> float:
+    """The GLS solve under the segment, on the PATH the fitter actually
+    dispatches (the PR 10 "scoped to what was probed" discipline): a
+    correlated-noise system probes the Schur fast path
+    (:func:`pint_tpu.gls_fitter._schur_gls_solve` — reduced noise-block
+    Gram, coupling, and timing Grams, fresh caches both sides), a
+    white/dense system the plain normal-equation build + hardened
+    solve.  The compared quantities are the full solution vector and
+    the post-step chi2; a reduced Gram whose Cholesky fails outright
+    measures as infinite disagreement — refused, never shipped."""
+    from pint_tpu.exceptions import NonFiniteSystemError, \
+        SingularMatrixError
+    from pint_tpu.gls_fitter import (
+        _schur_gls_solve,
+        gls_normal_equations,
+        linearized_system,
+    )
+    from pint_tpu.runtime.solve import solve_normal_cholesky
+
+    M, r, w, phiinv, params, _ = linearized_system(ftr.model, ftr.toas,
+                                                   resids=ftr.resids)
+    Nvec = 1.0 / w
+    ntm = len(params)
+    failures = (np.linalg.LinAlgError, SingularMatrixError,
+                NonFiniteSystemError)
+    if M.shape[1] > ntm:
+        # the Schur fast path the production correlated-noise fit takes
+        _, x64, _ = _schur_gls_solve(M, r, Nvec, phiinv, ntm, {})
+        try:
+            _, xr, _ = _schur_gls_solve(M, r, Nvec, phiinv, ntm, {},
+                                        spec=spec)
+        except failures:
+            return float("inf")
+    else:
+        mtcm64, mtcy64 = gls_normal_equations(M, r, Nvec=Nvec,
+                                              phiinv=phiinv)
+        mtcmr, mtcyr = gls_normal_equations(M, r, Nvec=Nvec,
+                                            phiinv=phiinv, spec=spec)
+        _, x64, _ = solve_normal_cholesky(mtcm64, mtcy64,
+                                          name="precision probe f64")
+        try:
+            _, xr, _ = solve_normal_cholesky(
+                mtcmr, mtcyr, name="precision probe reduced")
+        except failures:
+            return float("inf")
+    x64 = np.asarray(x64)
+    xr = np.asarray(xr)
+    step_scale = max(float(np.linalg.norm(x64)), 1e-300)
+    rel_x = float(np.linalg.norm(xr - x64)) / step_scale
+    chi2_64 = float(r @ (w * (r - M @ x64)))
+    chi2_r = float(r @ (w * (r - M @ xr)))
+    if not np.isfinite(chi2_r):
+        return float("inf")
+    return max(rel_x, abs(chi2_r - chi2_64) / max(abs(chi2_64), 1e-300))
+
+
+def _probe_grid_gram(ftr, spec: SegmentSpec,
+                     grid_params: Optional[Sequence[str]] = None,
+                     points=None, **_) -> float:
+    """The chunked GLS grid kernel under the segment: build the real
+    kernel twice (f64 vs ``spec``) over a small representative point
+    set and compare the chi2 surface + refit values."""
+    from pint_tpu.grid import build_grid_gls_chi2_fn
+
+    if grid_params is None or points is None:
+        raise UsageError("grid.gram probe needs grid_params + points")
+    import jax.numpy as jnp
+
+    points = np.asarray(points, dtype=np.float64)[:4]
+    chunk = int(points.shape[0])
+    fn64, _, _ = build_grid_gls_chi2_fn(
+        ftr.model, ftr.toas, tuple(grid_params), niter=1, chunk=chunk,
+        precision=SegmentSpec(segment="grid.gram"))
+    fnr, _, _ = build_grid_gls_chi2_fn(
+        ftr.model, ftr.toas, tuple(grid_params), niter=1, chunk=chunk,
+        precision=spec)
+    c64, v64, _ = fn64(jnp.asarray(points))
+    cr, vr, _ = fnr(jnp.asarray(points))
+    rel_c = _rel(cr, c64, scale=max(float(np.max(np.abs(c64))), 1e-300))
+    vscale = max(float(np.max(np.abs(v64))), 1e-300)
+    return max(rel_c, float(np.max(np.abs(np.asarray(vr)
+                                          - np.asarray(v64)))) / vscale)
+
+
+def _probe_serve_gram(ftr, spec: SegmentSpec, **_) -> float:
+    return _serve_system_rel(ftr, spec)
+
+
+def _probe_catalog_fit(ftr, spec: SegmentSpec, catalog=None, **_) -> float:
+    """The catalog batched-fit kernel shares the serve kernel; the
+    probe measures it per member system (worst member wins) — or, with
+    no catalog supplied, on the fitter's system as the representative
+    (the same kernel either way)."""
+    if catalog is None:
+        return _serve_system_rel(ftr, spec)
+    pulsars = list(getattr(catalog, "pulsars", catalog))
+    rels = [_serve_system_rel(p.fitter, spec) for p in pulsars[:4]]
+    return max(rels) if rels else float("inf")
+
+
+def _probe_catalog_lnlike(ftr, spec: SegmentSpec, catalog=None,
+                          **_) -> float:
+    """The joint HD lnlikelihood under the segment, at a representative
+    (log10_A, gamma) point; skipped (treated as unprobeable) without a
+    catalog of >= 2 pulsars."""
+    if catalog is None:
+        raise UsageError("catalog.lnlike probe needs a catalog")
+    from pint_tpu.catalog.likelihood import JointLikelihood
+
+    jl64 = JointLikelihood(catalog, n_modes=3,
+                           precision=SegmentSpec(segment="catalog.lnlike"))
+    jlr = JointLikelihood(catalog, n_modes=3, precision=spec)
+    l64 = jl64.lnlike(*_LNLIKE_POINT)
+    lr = jlr.lnlike(*_LNLIKE_POINT)
+    if not np.isfinite(lr):
+        return float("inf")
+    return abs(lr - l64) / max(abs(l64), 1.0)
+
+
+_PROBES = {
+    "gls.design": _probe_gls_design,
+    "grid.gram": _probe_grid_gram,
+    "serve.gram": _probe_serve_gram,
+    "catalog.fit": _probe_catalog_fit,
+    "catalog.lnlike": _probe_catalog_lnlike,
+    # grid.correction is owned by the PR 10 probe
+    # (autotune.tune_precision, manifest key grid.correction_dtype)
+}
+
+
+def probe_segment(segment: str, ftr, spec: SegmentSpec, **kw) -> float:
+    """Measured f64-vs-``spec`` relative disagreement of one segment's
+    consumer kernel on the workload's real operands (inf = the reduced
+    kernel failed outright)."""
+    fn = _PROBES.get(segment)
+    if fn is None:
+        raise UsageError(
+            f"no probe for segment {segment!r} (probeable: "
+            f"{sorted(_PROBES)})")
+    return float(fn(ftr, spec, **kw))
+
+
+def tune_precision_segments(ftr, segments: Optional[Sequence[str]] = None,
+                            compute_dtype: str = "float32",
+                            accumulation: str = "two_prod",
+                            force: bool = False,
+                            grid_params: Optional[Sequence[str]] = None,
+                            points=None, catalog=None,
+                            tuning_manifest=None) -> Dict[str, Any]:
+    """Probe every (or the named) probeable segment for ``ftr``'s
+    workload at the candidate ``(compute_dtype, accumulation)`` and
+    record one ``precision.<segment>`` decision each (see the module
+    docstring for the ship/refuse discipline).  Segments whose probe
+    prerequisites are missing (no catalog for ``catalog.lnlike``, no
+    grid axes for ``grid.gram``) are skipped with a log line, not
+    failed.  Returns ``{segment: TuningDecision}``."""
+    from pint_tpu.autotune.manifest import TuningDecision
+
+    if compute_dtype == "float64":
+        raise UsageError("probing float64 against itself is vacuous; "
+                         "pass a reduced compute_dtype")
+    names = list(segments) if segments is not None else sorted(_PROBES)
+    out: Dict[str, Any] = {}
+    for segment in names:
+        d = SEGMENTS.get(segment)
+        if d is None:
+            raise UsageError(f"unknown precision segment {segment!r}")
+        if segment not in _PROBES:
+            raise UsageError(f"segment {segment!r} has no probe (its "
+                             "decision is owned elsewhere — see SEGMENTS)")
+        budget = d.forced_budget if force else d.safe_rel
+        cand = SegmentSpec(segment=segment, compute_dtype=compute_dtype,
+                           accumulation=accumulation, budget=budget,
+                           source="forced" if force else "tuned")
+        try:
+            rel = probe_segment(segment, ftr, cand,
+                                grid_params=grid_params, points=points,
+                                catalog=catalog)
+        except UsageError as e:
+            log.info(f"precision: segment {segment} not probed ({e})")
+            continue
+        safe = rel < budget
+        # persisted numbers are always finite: an outright-failed probe
+        # (rel = inf) records the sentinel, never an Infinity token
+        rel_store = _finite_rel(rel)
+        if safe:
+            value_spec = SegmentSpec(
+                segment=segment, compute_dtype=compute_dtype,
+                accumulation=accumulation, budget=budget,
+                rel_err=rel_store, source="forced" if force else "tuned")
+            value = value_spec.to_value()
+            decision_word = compute_dtype
+        else:
+            value = SegmentSpec(segment=segment).to_value()
+            value["rel_err"] = rel_store
+            decision_word = "float64"
+        reason = (f"{compute_dtype}+{accumulation} disagrees with f64 by "
+                  f"{rel:.3e} — " + ("below" if safe else "above")
+                  + f" the {budget:g} "
+                  + ("forced" if force else "safety") + " budget"
+                  + ("" if safe else "; f64 retained"))
+        vkey = precision_vkey(segment, model=ftr.model, toas=ftr.toas) \
+            if d.model_bound else precision_vkey(segment)
+        dec = TuningDecision(
+            name=f"precision.{segment}", value=value,
+            static_default=SegmentSpec(segment=segment).to_value(),
+            vkey=vkey, basis="forced" if force else "probe",
+            measured={"rel_err": rel_store, "budget": budget,
+                      "safe_rel": d.safe_rel,
+                      "probe_failed": not np.isfinite(rel)},
+            reason=reason)
+        if tuning_manifest is not None:
+            tuning_manifest.record(dec)
+        _emit_probe(segment, cand, rel, budget, decision_word)
+        out[segment] = dec
+    return out

@@ -45,7 +45,8 @@ from pint_tpu.exceptions import UsageError
 
 __all__ = ["DEFAULT_NTOA_BUCKETS", "DEFAULT_NFREE_BUCKETS",
            "DEFAULT_BATCH_BUCKETS", "bucket_of", "FitRequest", "FitResult",
-           "pad_request", "serve_kernel", "serve_batched", "ShapeBatcher"]
+           "pad_request", "serve_kernel", "serve_batched",
+           "resolve_serve_spec", "ShapeBatcher"]
 
 #: default shape ladders: a handful of shapes serve the whole catalog
 #: (B1855-class workloads land in the 4096/256 bucket)
@@ -176,7 +177,7 @@ def pad_request(req: FitRequest, bucket_ntoas: int, bucket_nfree: int
     return M, r, w, phiinv, pad_free
 
 
-def serve_kernel(M, r, w, phiinv, pad_free):
+def serve_kernel(M, r, w, phiinv, pad_free, spec=None):
     """One linearized (Gauss-Newton) fit on a padded system — the
     jax-traceable core every bucket executable compiles.
 
@@ -184,44 +185,75 @@ def serve_kernel(M, r, w, phiinv, pad_free):
     conditioning move (raw Grams reach ~1e42 at 4005 TOAs); padded
     columns scale to 1 and pick up only their pad-diagonal, so the
     factorization is exactly block-diagonal and the real block's solve
-    matches the dedicated-shape kernel column for column."""
+    matches the dedicated-shape kernel column for column.
+
+    ``spec`` (a :class:`pint_tpu.precision.SegmentSpec`, trace-time
+    static) drives the ``serve.gram`` precision segment: the Gram,
+    projection, and post-step design products run at the spec's
+    compute dtype with its accumulation back to f64.  ``None`` / an
+    f64 spec is EXACTLY the pre-precision kernel (the policy
+    :func:`~pint_tpu.precision.matmul` short-circuits to ``a @ b``);
+    the scaling, the Cholesky factorization, and both chi2 reductions
+    always stay f64."""
     import jax
     import jax.numpy as jnp
+
+    from pint_tpu.precision import matmul as _pmatmul
 
     wM = w[:, None] * M
     s = jnp.sqrt(jnp.sum(wM * M, axis=0) + phiinv)
     s = jnp.where(s > 0, s, 1.0)
     Ms = M / s
-    A = Ms.T @ (w[:, None] * Ms) + jnp.diag(phiinv / s**2) \
+    A = _pmatmul(Ms.T, w[:, None] * Ms, spec) + jnp.diag(phiinv / s**2) \
         + jnp.diag(pad_free)
-    b = Ms.T @ (w * r)
+    b = _pmatmul(Ms.T, w * r, spec)
     cf = jax.scipy.linalg.cho_factor(A, lower=True)
     dx_s = jax.scipy.linalg.cho_solve(cf, b)
     dx = dx_s / s
     Ainv = jax.scipy.linalg.cho_solve(cf, jnp.eye(A.shape[0],
                                                   dtype=A.dtype))
     err = jnp.sqrt(jnp.clip(jnp.diag(Ainv), 0.0)) / s
-    r_post = r - M @ dx
+    r_post = r - _pmatmul(M, dx, spec)
     chi2 = jnp.sum(w * r_post * r_post)
     chi2_initial = jnp.sum(w * r * r)
     return dx, err, chi2, chi2_initial
 
 
-#: the batched executable: one compile per (batch, bucket_ntoas,
-#: bucket_nfree) shape triple, shared process-wide via jit's dispatch
-#: cache; module-level so repeat batchers retrace into the warm cache
-_serve_batched_jit = None
+def resolve_serve_spec():
+    """The active ``serve.gram`` :class:`~pint_tpu.precision.
+    SegmentSpec` (override -> manifest -> f64 default) — resolved
+    host-side at dispatch/warm time, closed over the traced kernel."""
+    from pint_tpu.precision import segment_spec
+
+    return segment_spec("serve.gram")
 
 
-def serve_batched():
-    """The module's jitted ``vmap(serve_kernel)`` (lazy: importing the
-    batcher must not import jax)."""
-    global _serve_batched_jit
-    if _serve_batched_jit is None:
+#: the batched executables: one jit per precision-spec key, one compile
+#: per (batch, bucket_ntoas, bucket_nfree) shape triple under it,
+#: shared process-wide via jit's dispatch cache; module-level so repeat
+#: batchers retrace into the warm cache
+_serve_batched_jit: Dict[tuple, object] = {}
+
+
+def serve_batched(spec=None):
+    """The module's jitted ``vmap(serve_kernel)`` for ``spec`` (default:
+    the resolved active ``serve.gram`` spec; lazy — importing the
+    batcher must not import jax).  Executables are keyed per
+    dtype/accumulation, so a policy flip can never replay a
+    wrong-precision compile."""
+    if spec is None:
+        spec = resolve_serve_spec()
+    key = spec.key()
+    fn = _serve_batched_jit.get(key)
+    if fn is None:
         import jax
 
-        _serve_batched_jit = jax.jit(jax.vmap(serve_kernel))
-    return _serve_batched_jit
+        def kernel(M, r, w, phiinv, pad_free):
+            return serve_kernel(M, r, w, phiinv, pad_free, spec=spec)
+
+        fn = jax.jit(jax.vmap(kernel))
+        _serve_batched_jit[key] = fn
+    return fn
 
 
 class ShapeBatcher:
@@ -266,7 +298,12 @@ class ShapeBatcher:
             padded.append(padded[0])
         operands = tuple(np.stack([p[i] for p in padded])
                          for i in range(5))
-        name = f"serve.fit[{batch}x{bn}x{bk}]"
+        # serve.gram precision segment: resolved host-side per dispatch
+        # (memoized manifest; f64 default costs a dict lookup).  A
+        # reduced spec suffixes the executable name so a pool warmed at
+        # one precision can never serve a dispatch at another.
+        spec = resolve_serve_spec()
+        name = f"serve.fit[{batch}x{bn}x{bk}]{spec.suffix()}"
         handle = None
         if self.pool is not None:
             handle = self.pool.lookup(name, operands)
@@ -275,7 +312,7 @@ class ShapeBatcher:
         if handle is not None:
             out = handle(*operands)
         else:
-            out = serve_batched()(*operands)
+            out = serve_batched(spec)(*operands)
         out = [np.asarray(o) for o in out]
         compiles = jaxevents.counts().compiles - before.compiles
         wall_ms = 1e3 * (time.perf_counter() - t0)

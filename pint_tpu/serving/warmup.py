@@ -235,15 +235,21 @@ def warm_buckets(buckets: Sequence[Tuple[int, int, int]],
 
     pool = pool or WarmPool()
     report = WarmupReport()
+    # serve.gram precision segment: warm EXACTLY the kernel the batcher
+    # will dispatch — same name suffix, and the spec joins the AOT-cache
+    # vkey so a reduced kernel can never replay an f64 export
+    spec = batcher.resolve_serve_spec()
+    vkey = ("serve_kernel", 1) if not spec.reduced \
+        else ("serve_kernel", 1, spec.key())
     for batch, bn, bk in buckets:
-        shape_name = f"serve.fit[{batch}x{bn}x{bk}]"
+        shape_name = f"serve.fit[{batch}x{bn}x{bk}]{spec.suffix()}"
         M = np.zeros((batch, bn, bk))
         r = np.zeros((batch, bn))
         w = np.zeros((batch, bn))
         phiinv = np.zeros((batch, bk))
         pad_free = np.ones((batch, bk))
         report.entries.append(pool.warm(
-            shape_name, batcher.serve_batched(),
+            shape_name, batcher.serve_batched(spec),
             (M, r, w, phiinv, pad_free),
-            vkey=("serve_kernel", 1)))
+            vkey=vkey))
     return pool, report

@@ -802,14 +802,23 @@ class TestOrchestrator:
             top_k=1)
         assert set(out) == {"grid.chunk", "gls.solve_rung",
                             "plan.axes/grid", "grid.correction_dtype",
-                            "serve.buckets"}
+                            "precision.segments", "serve.buckets"}
+        # the precision layer's per-segment probes ran under the
+        # UNFORCED discipline: four probeable segments (catalog.lnlike
+        # needs a catalog and is skipped), each recorded with its
+        # measured margin
+        segs = out["precision.segments"]
+        assert set(segs) == {"gls.design", "grid.gram", "serve.gram",
+                             "catalog.fit"}
+        for dec in segs.values():
+            assert dec.measured["rel_err"] >= 0.0
         # every decision landed in the configured manifest and
-        # round-trips through the validator
+        # round-trips through the validator (5 classic + 4 precision)
         from tools.telemetry_report import validate_tuning_manifest_file
 
         mpath = os.path.join(tune_dir, "tuning.json")
         errors = []
-        assert validate_tuning_manifest_file(mpath, errors) == 5
+        assert validate_tuning_manifest_file(mpath, errors) == 9
         assert errors == []
 
     def test_one_failed_tuner_does_not_take_down_the_rest(

@@ -132,6 +132,20 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert tuned["tuned_vs_static"] >= 1.0
     assert tuned["basis"] == "cost+measured"
     assert isinstance(tuned["decisions"], str) and tuned["decisions"]
+    # the precision block (PR 12): resolved per-segment policy and the
+    # forced-f64 vs active-policy serve comparison — with no manifest
+    # configured the active policy IS f64, so the comparison is
+    # bit-identical (max_rel_err exactly 0.0, zero reduced segments)
+    prec = headline["precision"]
+    for key in ("segments", "reduced_count", "f64_count",
+                "mixed_fits_per_s", "f64_fits_per_s", "mixed_vs_f64",
+                "max_rel_err"):
+        assert key in prec, f"precision block missing {key!r}"
+    assert "error" not in prec, f"precision measurement degraded: {prec}"
+    assert prec["reduced_count"] == 0
+    assert prec["f64_count"] == len(prec["segments"])
+    assert prec["mixed_fits_per_s"] > 0 and prec["f64_fits_per_s"] > 0
+    assert prec["max_rel_err"] == 0.0
     # the catalog block (PR 11): the PTA catalog engine's batched
     # multi-pulsar fit + joint Hellings-Downs lnlikelihood ran next to
     # the headline — every key present, never degraded on CPU, zero

@@ -597,6 +597,69 @@ class TestImplicitDtype:
         assert findings == []  # snippet.py is not a precision-core file
 
 
+class TestUnguardedDowncast:
+    BAD = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def f(x, U):\n"
+        "    a = x.astype(jnp.float32)\n"
+        "    b = U.astype(np.bfloat16)\n"
+        "    c = x.astype('float32')\n"
+        "    d = jnp.zeros(3, dtype=jnp.float32)\n"
+        "    return a, b, c, d\n"
+    )
+    GOOD = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from pint_tpu.precision import downcast, matmul\n"
+        "def f(x, U, spec):\n"
+        "    a = x.astype(jnp.float64)\n"          # upcasts are free
+        "    b = U.astype(np.float64)\n"
+        "    c = downcast(x, 'float32')\n"         # the sanctioned route
+        "    d = matmul(U, x, spec)\n"
+        "    e = jnp.zeros(3, dtype=jnp.float64)\n"
+        "    return a, b, c, d, e\n"
+    )
+
+    def test_fires_on_bad(self, tmp_path):
+        from tools.jaxlint.rules.downcast import UnguardedDowncastRule
+
+        findings = lint_snippet(tmp_path, self.BAD,
+                                [UnguardedDowncastRule(files=None)])
+        assert rule_names(findings) == ["unguarded-downcast"] * 4
+
+    def test_silent_on_good(self, tmp_path):
+        from tools.jaxlint.rules.downcast import UnguardedDowncastRule
+
+        assert lint_snippet(tmp_path, self.GOOD,
+                            [UnguardedDowncastRule(files=None)]) == []
+
+    def test_scoped_to_downcast_scope_by_default(self, tmp_path):
+        from tools.jaxlint.rules.downcast import UnguardedDowncastRule
+
+        findings = lint_snippet(tmp_path, self.BAD,
+                                [UnguardedDowncastRule(files=...)])
+        assert findings == []  # snippet.py is outside the scoped set
+
+    def test_precision_core_is_clean_target(self):
+        """The scoped file set lints clean TODAY with zero baseline
+        entries for this rule: every reduced cast in the core routes
+        through pint_tpu.precision (grid.py's PR 10 correction casts
+        included)."""
+        from tools.jaxlint.rules.downcast import (
+            DOWNCAST_SCOPE,
+            UnguardedDowncastRule,
+        )
+
+        targets = [p for p in DOWNCAST_SCOPE
+                   if os.path.exists(os.path.join(REPO, p))]
+        assert targets
+        result = Engine(rules=[UnguardedDowncastRule(files=...)],
+                        repo=REPO).run(targets)
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+
+
 class TestF32UnsafeLiteral:
     BAD = (
         "SPLIT = 134217729.0\n"     # 2**27+1: loses integer exactness

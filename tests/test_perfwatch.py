@@ -612,3 +612,189 @@ class TestCatalogSeries:
         assert main(["--dir", d]) == 0
         out = capsys.readouterr().out
         assert "catalog: 25.5 fits/s (16 pulsars)" in out
+
+
+def _precision(mixed=50.0, f64=50.0, rel=0.0, reduced=0, error=None):
+    block = {"segments": {"serve.gram": "f64"}, "reduced_count": reduced,
+             "f64_count": 6 - reduced, "mixed_fits_per_s": mixed,
+             "f64_fits_per_s": f64,
+             "mixed_vs_f64": (mixed / f64) if f64 else None,
+             "max_rel_err": rel}
+    if error is not None:
+        block = {"segments": None, "reduced_count": None,
+                 "f64_count": None, "mixed_fits_per_s": None,
+                 "f64_fits_per_s": None, "mixed_vs_f64": None,
+                 "max_rel_err": None, "error": error}
+    return {"precision": block}
+
+
+def _precision_artifact(path, round_, checks, platform="tpu",
+                        error=None):
+    doc = {"metric": "tpu_precision", "platform": platform,
+           "ok": all(c.get("ok", True) for c in checks.values()),
+           "checks": checks}
+    if error is not None:
+        doc = {"metric": "tpu_precision", "platform": platform,
+               "error": error}
+    fn = os.path.join(path, f"TPU_PRECISION_r{round_:02d}.json")
+    with open(fn, "w") as f:
+        json.dump(doc, f)
+    return fn
+
+
+class TestPrecisionSeries:
+    """The bench's precision{} block (round 12+): policy-path
+    throughput gates drops, and max_rel_err gates rises off a
+    zero baseline (the bit-identical default contract)."""
+
+    def test_precision_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 12, 100.0,
+                    extra=_precision(mixed=55.0, f64=50.0, rel=1.5e-10,
+                                     reduced=2))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.precision_mixed_fits_per_s == 55.0
+        assert r.precision_max_rel_err == 1.5e-10
+        assert r.precision_reduced_count == 2
+        assert r.precision_mixed_vs_f64 == 1.1
+        doc = build_history([r])
+        assert doc["runs"][0]["precision_mixed_fits_per_s"] == 55.0
+
+    def test_mixed_fits_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([50.0, 52.0, 49.0], start=1):
+            _bench(d, i, 100.0, extra=_precision(mixed=v))
+        _bench(d, 4, 100.0, extra=_precision(mixed=25.0))  # 50% drop
+        assert main(["--check", "--dir", d]) == 1
+        assert "precision_mixed_fits_per_s" in capsys.readouterr().out
+
+    def test_rel_err_rise_off_zero_baseline_fails(self, tmp_path,
+                                                  capsys):
+        """A bit-identical history (max_rel_err exactly 0.0) gates a
+        newly nonzero disagreement — the zero-baseline opt-in, so a
+        silently flipped segment cannot slip into a clean history."""
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_precision(rel=0.0))
+        _bench(d, 4, 100.0, extra=_precision(rel=2.0e-6))
+        assert main(["--check", "--dir", d]) == 1
+        assert "precision_max_rel_err" in capsys.readouterr().out
+
+    def test_steady_zero_rel_err_passes(self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2, 3, 4):
+            _bench(d, i, 100.0, extra=_precision(rel=0.0))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_precision_block_fails_when_history_had_it(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_precision())
+        _bench(d, 3, 100.0,
+               extra=_precision(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 1
+        assert "precision block degraded" in capsys.readouterr().out
+
+    def test_errored_precision_block_clean_without_history(
+            self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0,
+               extra=_precision(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_precision_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0,
+               extra=_precision(mixed=55.0, f64=50.0, reduced=2))
+        assert main(["--dir", d]) == 0
+        assert "precision: mixed 55.0 fits/s" in capsys.readouterr().out
+
+
+class TestPrecisionArtifacts:
+    """TPU_PRECISION_r* check-suite gating: each named check's value
+    against its committed bound, within the newest artifact."""
+
+    def test_in_bound_checks_pass(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _precision_artifact(d, 5, {
+            "b_frac_cycles": {"value": 5.2e-5, "bound": 1e-4,
+                              "ok": True},
+            "b_la_chi2_rel": {"value": 4.8e-14, "bound": 1e-9,
+                              "ok": True}})
+        assert main(["--check", "--dir", d]) == 0
+        out = capsys.readouterr().out
+        assert "tpu_precision" in out and "b_frac_cycles" in out
+
+    def test_over_bound_check_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _precision_artifact(d, 6, {
+            "b_frac_cycles": {"value": 3.0e-4, "bound": 1e-4,
+                              "ok": False}})
+        assert main(["--check", "--dir", d]) == 1
+        assert "b_frac_cycles" in capsys.readouterr().out
+
+    def test_only_newest_artifact_gates(self, tmp_path):
+        """An old over-bound artifact is history, not a verdict — the
+        newest round superseded it."""
+        d = str(tmp_path)
+        _precision_artifact(d, 5, {
+            "b_frac_cycles": {"value": 3.0e-4, "bound": 1e-4,
+                              "ok": False}})
+        _precision_artifact(d, 6, {
+            "b_frac_cycles": {"value": 5.0e-5, "bound": 1e-4,
+                              "ok": True}})
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_artifact_fails_after_measured_history(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        _precision_artifact(d, 5, {
+            "b_frac_cycles": {"value": 5.0e-5, "bound": 1e-4,
+                              "ok": True}})
+        _precision_artifact(d, 6, {}, error="tunnel wedged")
+        assert main(["--check", "--dir", d]) == 1
+        assert "errored/check-less" in capsys.readouterr().out
+
+    def test_errored_artifact_clean_without_history(self, tmp_path):
+        d = str(tmp_path)
+        _precision_artifact(d, 5, {}, error="tunnel wedged")
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_malformed_check_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _precision_artifact(d, 5, {
+            "b_frac_cycles": {"value": "tiny", "bound": 1e-4}})
+        assert main(["--check", "--dir", d]) == 1
+        assert "malformed" in capsys.readouterr().out
+
+    def test_artifact_never_joins_the_bench_series(self, tmp_path):
+        """The value-less precision artifact must not appear as an
+        errored bench run (it is its own kind)."""
+        errors = []
+        fn = _precision_artifact(str(tmp_path), 5, {
+            "b_frac_cycles": {"value": 5.0e-5, "bound": 1e-4,
+                              "ok": True}})
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.kind == "precision"
+        assert r.error is None
+        assert r.precision_checks is not None
+
+    def test_committed_r05_artifact_ingests_and_gates_clean(self):
+        """The repo's own TPU_PRECISION_r05.json: 12 named checks, all
+        within their committed bounds."""
+        errors = []
+        r = ingest_file(os.path.join(REPO, "TPU_PRECISION_r05.json"),
+                        errors)
+        assert not errors and r is not None
+        assert r.kind == "precision" and r.platform == "tpu"
+        assert len(r.precision_checks) == 12
+        from tools.perfwatch import check_precision_artifacts
+
+        verdicts = check_precision_artifacts([r], threshold=0.30)
+        assert len(verdicts) == 12
+        assert not any(v.failed for v in verdicts)

@@ -1,0 +1,740 @@
+"""Mixed-precision layer under test (pint_tpu/precision/).
+
+The contracts tier-1 (CPU) pins:
+
+* **default bit-identity** — no manifest + no override means EVERY
+  consumer (serve kernel, GLS step, grid kernel, catalog fit) runs the
+  exact pre-precision f64 path: the policy matmul short-circuits to
+  ``a @ b`` and outputs are bitwise identical;
+* **compensated primitives** — ``two_sum`` folds are error-free where
+  plain summation loses bits, and the ``two_prod`` dd-split matmul
+  recovers ~f64-grade accuracy (< 1e-12 rel) from f32 operand pairs
+  where a naive f32 matmul sits at ~1e-7;
+* **probe discipline** — a segment ships reduced only below its
+  budget: unforced probes refuse ill-conditioned f32 Grams, forced
+  probes record the measured rel err and still refuse past the forced
+  budget;
+* **manifest resolution** — ``precision.<segment>`` decisions
+  round-trip through the tuning manifest (vkey + fingerprint scheme),
+  malformed values and stale vkeys degrade to f64;
+* **the forced-f32 acceptance pin** — a forced-f32 CPU run of the
+  WLS/GLS fit, grid surface, and catalog batched fit agrees with the
+  f64-forced run within each segment's recorded budget, with the
+  measured per-segment rel err recorded in the manifest and asserted
+  within budget.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.precision
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# the B1855 stand-in of the autotune suite: DD binary (M2/SINI pair) +
+# EFAC/ECORR/PL red noise — a real correlated-noise GLS workload
+STANDIN_PAR = [
+    "PSR TSTPREC\n", "RAJ 04:37:15.0 1\n", "DECJ -47:15:09.0 1\n",
+    "F0 173.6879 1\n", "F1 -1.7e-15 1\n", "PEPOCH 55000\n",
+    "DM 2.64 1\n", "BINARY DD\n", "PB 5.7410\n", "A1 3.3667\n",
+    "T0 55000.0\n", "OM 1.35\n", "ECC 1.9e-5\n", "M2 0.3 1\n",
+    "SINI 0.95 1\n", "EFAC mjd 50000 60000 1.1\n",
+    "ECORR mjd 50000 60000 0.5\n", "TNRedAmp -13.5\n",
+    "TNRedGam 3.5\n", "TNRedC 5\n", "UNITS TDB\n",
+]
+
+
+def _make_fitter(seed=7):
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    model = get_model(list(STANDIN_PAR))
+    rng = np.random.default_rng(seed)
+    base = np.linspace(54000, 56000, 40)
+    mjds = np.sort(np.concatenate([base, base + 0.013]))
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=0.5,
+                                   add_noise=True, rng=rng)
+    f = GLSFitter(toas, model)
+    f.fit_toas(maxiter=2)
+    return f
+
+
+def _grid_axes(model, n=4):
+    m2, sini = float(model.M2.value), float(model.SINI.value)
+    return (np.linspace(m2 - 0.03, m2 + 0.03, n),
+            np.linspace(sini - 0.002, sini + 0.002, n))
+
+
+def _points(g1, g2):
+    return np.stack([g.ravel() for g in
+                     np.meshgrid(g1, g2, indexing="ij")], axis=-1)
+
+
+@pytest.fixture(scope="module")
+def ftr():
+    return _make_fitter(seed=7)
+
+
+@pytest.fixture
+def tune_dir(tmp_path):
+    from pint_tpu import config
+    from pint_tpu.autotune import reset_manifest_singleton
+
+    d = str(tmp_path / "tune")
+    config.set_tune_dir(d)
+    reset_manifest_singleton()
+    yield d
+    config.set_tune_dir(None)
+    reset_manifest_singleton()
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_policy():
+    """Every test starts and ends with no override policy installed."""
+    from pint_tpu import precision
+
+    precision.set_policy(None)
+    yield
+    precision.set_policy(None)
+
+
+# ---------------------------------------------------------------------------
+# compensated primitives
+# ---------------------------------------------------------------------------
+
+class TestPrimitives:
+    def test_f64_spec_is_the_same_op(self):
+        import jax.numpy as jnp
+
+        from pint_tpu import precision as P
+
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(40, 24))
+        b = rng.normal(size=(24, 16))
+        assert np.array_equal(P.matmul(a, b), a @ b)
+        assert np.array_equal(P.matmul(a, b, None), a @ b)
+        # same op on the same backend: the jnp path must match jnp's
+        # own `a @ b` bitwise (numpy and XLA may round dots differently)
+        s64 = P.SegmentSpec(segment="serve.gram")
+        aj, bj = jnp.asarray(a), jnp.asarray(b)
+        assert np.array_equal(np.asarray(P.matmul(aj, bj, s64)),
+                              np.asarray(aj @ bj))
+
+    @pytest.mark.parametrize("host", [True, False])
+    def test_two_prod_recovers_f64_grade(self, host):
+        """The dd-split matmul: ~ulp(f32)^2 relative accuracy, orders
+        beyond a naive f32 product — on BOTH the host-numpy and the
+        traced path (same semantics across the boundary)."""
+        from pint_tpu import precision as P
+
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(200, 120)) * np.exp(
+            rng.normal(size=(200, 120)) * 3)
+        b = rng.normal(size=(120, 80))
+        if not host:
+            import jax.numpy as jnp
+
+            a_in, b_in = jnp.asarray(a), jnp.asarray(b)
+        else:
+            a_in, b_in = a, b
+        ref = a @ b
+        scale = np.max(np.abs(ref))
+        sp_split = P.SegmentSpec(segment="serve.gram",
+                                 compute_dtype="float32",
+                                 accumulation="two_prod",
+                                 source="forced")
+        sp_native = P.SegmentSpec(segment="serve.gram",
+                                  compute_dtype="float32",
+                                  accumulation="native",
+                                  source="forced")
+        rel_split = np.max(np.abs(
+            np.asarray(P.matmul(a_in, b_in, sp_split)) - ref)) / scale
+        rel_native = np.max(np.abs(
+            np.asarray(P.matmul(a_in, b_in, sp_native)) - ref)) / scale
+        assert rel_split < 1e-12
+        assert rel_native > 1e-8        # the gap the split closes
+        assert rel_split < rel_native / 1e3
+
+    def test_two_sum_accumulate_is_error_free(self):
+        """Partials engineered so plain f64 summation annihilates the
+        small term; the two_sum fold keeps it."""
+        from pint_tpu.precision import two_sum_accumulate
+
+        parts = [np.array([1e16]), np.array([1.0]), np.array([-1e16])]
+        assert float(sum(parts)[0]) == 0.0   # plain summation loses 1.0
+        assert float(two_sum_accumulate(parts)[0]) == 1.0
+
+    def test_matvec_and_accumulation_modes(self):
+        import jax.numpy as jnp
+
+        from pint_tpu import precision as P
+
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(64, 48))
+        v = rng.normal(size=48)
+        for acc in P.ACCUMULATIONS:
+            sp = P.SegmentSpec(segment="serve.gram",
+                               compute_dtype="float32",
+                               accumulation=acc, source="forced")
+            out = np.asarray(P.matmul(jnp.asarray(a), jnp.asarray(v), sp))
+            assert out.shape == (64,)
+            assert np.allclose(out, a @ v, rtol=1e-4)
+
+    def test_downcast_is_the_sanctioned_cast(self):
+        import jax.numpy as jnp
+
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.precision import downcast
+
+        x = np.linspace(0.0, 1.0, 5)
+        assert downcast(x, "float64") is x               # identity
+        assert downcast(x, "float32").dtype == np.float32
+        xj = jnp.asarray(x)
+        assert downcast(xj, "float32").dtype == jnp.float32
+        with pytest.raises(UsageError):
+            downcast(x, "float16")
+
+
+# ---------------------------------------------------------------------------
+# policy + resolution
+# ---------------------------------------------------------------------------
+
+class TestPolicy:
+    def test_unknown_segment_and_bad_spec_raise_typed(self):
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.precision import SegmentSpec, segment_spec
+
+        with pytest.raises(UsageError):
+            segment_spec("no.such.segment")
+        with pytest.raises(UsageError):
+            SegmentSpec(segment="serve.gram", compute_dtype="float16")
+        with pytest.raises(UsageError):
+            SegmentSpec(segment="serve.gram", accumulation="kahan")
+
+    def test_forced_policy_and_scoped_install(self):
+        from pint_tpu import precision as P
+
+        pol = P.PrecisionPolicy.forced("float32", accumulation="two_prod")
+        assert P.active_policy() is None
+        with P.use_policy(pol):
+            sp = P.segment_spec("serve.gram")
+            assert sp.compute_dtype == "float32"
+            assert sp.accumulation == "two_prod"
+            assert sp.source == "forced"
+            assert sp.budget == P.SEGMENTS["serve.gram"].forced_budget
+        assert P.active_policy() is None
+        # the explicit-f64 override resolves f64 even over a manifest
+        with P.use_policy(P.PrecisionPolicy.f64()):
+            assert not P.segment_spec("serve.gram").reduced
+
+    def test_spec_from_decision_validation(self):
+        from pint_tpu.precision import spec_from_decision
+
+        good = {"compute_dtype": "float32", "accumulation": "two_prod",
+                "budget": 1e-3, "rel_err": 2e-10}
+        sp = spec_from_decision("serve.gram", good)
+        assert sp is not None and sp.reduced and sp.source == "tuned"
+        for bad in (
+            None, "float32", [],
+            {"compute_dtype": "float16", "accumulation": "f64",
+             "budget": 1e-3},
+            {"compute_dtype": "float32", "accumulation": "kahan",
+             "budget": 1e-3},
+            {"compute_dtype": "float32", "accumulation": "f64",
+             "budget": -1.0},
+            {"compute_dtype": "float32", "accumulation": "f64",
+             "budget": True},
+            {"compute_dtype": "float32", "accumulation": "f64",
+             "budget": 1e-3, "rel_err": -2.0},
+        ):
+            assert spec_from_decision("serve.gram", bad) is None
+
+    def test_default_resolution_is_f64(self, ftr):
+        """No manifest, no override: every segment resolves to the
+        bit-identical default."""
+        from pint_tpu import precision as P
+
+        for name in P.SEGMENTS:
+            spec = P.segment_spec(name, model=ftr.model, toas=ftr.toas)
+            assert not spec.reduced
+            assert spec.source == "default"
+
+    def test_suffix_and_vkeys(self, ftr):
+        from pint_tpu import precision as P
+
+        assert P.SegmentSpec(segment="serve.gram").suffix() == ""
+        sp = P.SegmentSpec(segment="serve.gram", compute_dtype="float32",
+                           accumulation="two_prod", source="forced")
+        assert sp.suffix() == "@f32+split"
+        # model-bound vkeys need the workload; generic ones do not
+        from pint_tpu.exceptions import UsageError
+
+        with pytest.raises(UsageError):
+            P.precision_vkey("gls.design")
+        assert P.precision_vkey("serve.gram") == \
+            ("precision", "serve.gram", 1)
+        vk = P.precision_vkey("gls.design", ftr.model, ftr.toas)
+        assert vk[0] == "precision" and vk[1] == "gls.design"
+
+
+# ---------------------------------------------------------------------------
+# default bit-identity of the consumers
+# ---------------------------------------------------------------------------
+
+class TestDefaultBitIdentity:
+    def test_serve_kernel_default_equals_explicit_f64(self, ftr):
+        from pint_tpu import precision as P
+        from pint_tpu.serving.batcher import FitRequest, pad_request, \
+            serve_kernel
+
+        q = FitRequest.from_fitter(ftr)
+        ops = pad_request(q, q.n_toas, q.n_free)
+        out_def = [np.asarray(o) for o in serve_kernel(*ops)]
+        out_f64 = [np.asarray(o) for o in serve_kernel(
+            *ops, spec=P.SegmentSpec(segment="serve.gram"))]
+        for a, b in zip(out_def, out_f64):
+            assert np.array_equal(a, b)
+
+    def test_batcher_under_f64_override_is_bitwise_default(self, ftr):
+        from pint_tpu import precision as P
+        from pint_tpu.serving.batcher import FitRequest, ShapeBatcher
+
+        reqs = [FitRequest.from_fitter(ftr, request_id=f"r{i}")
+                for i in range(3)]
+        batcher = ShapeBatcher()
+        base = batcher.run(reqs)
+        with P.use_policy(P.PrecisionPolicy.f64()):
+            forced = batcher.run(reqs)
+        for a, b in zip(base, forced):
+            assert np.array_equal(a.dx, b.dx)
+            assert a.chi2 == b.chi2
+
+    def test_grid_default_equals_explicit_f64_spec(self, ftr):
+        import jax.numpy as jnp
+
+        from pint_tpu import precision as P
+        from pint_tpu.grid import build_grid_gls_chi2_fn
+
+        g1, g2 = _grid_axes(ftr.model)
+        pts = _points(g1, g2)[:4]
+        fn_def, _, _ = build_grid_gls_chi2_fn(
+            ftr.model, ftr.toas, ("M2", "SINI"), niter=1, chunk=4)
+        fn_f64, _, _ = build_grid_gls_chi2_fn(
+            ftr.model, ftr.toas, ("M2", "SINI"), niter=1, chunk=4,
+            precision=P.SegmentSpec(segment="grid.gram"))
+        c_def = np.asarray(fn_def(jnp.asarray(pts))[0])
+        c_f64 = np.asarray(fn_f64(jnp.asarray(pts))[0])
+        assert np.array_equal(c_def, c_f64)
+
+
+# ---------------------------------------------------------------------------
+# probe discipline + manifest round trip
+# ---------------------------------------------------------------------------
+
+class TestProbesAndManifest:
+    def test_unforced_probe_refuses_above_the_safe_bar(self, ftr,
+                                                       tune_dir):
+        """gls.design at plain f32+f64-accumulation sits orders above
+        the 1e-12 safe bar on this ill-conditioned system: the probe
+        records f64 with the measured margin."""
+        from pint_tpu import autotune
+        from pint_tpu.precision import tune_precision_segments
+
+        out = tune_precision_segments(
+            ftr, segments=("gls.design",), compute_dtype="float32",
+            accumulation="f64", tuning_manifest=autotune.manifest())
+        dec = out["gls.design"]
+        assert dec.value["compute_dtype"] == "float64"
+        assert dec.measured["rel_err"] > dec.measured["budget"]
+
+    def test_forced_probe_records_within_budget_and_resolves(
+            self, ftr, tune_dir):
+        """The forced-f32 run: decisions record the measured rel err,
+        the rel err sits INSIDE each segment's forced budget, and the
+        resolve layer returns the reduced spec for exactly this
+        workload."""
+        from pint_tpu import autotune
+        from pint_tpu import precision as P
+        from pint_tpu.precision import tune_precision_segments
+
+        g1, g2 = _grid_axes(ftr.model)
+        out = tune_precision_segments(
+            ftr, compute_dtype="float32", accumulation="two_prod",
+            force=True, grid_params=("M2", "SINI"),
+            points=_points(g1, g2), tuning_manifest=autotune.manifest())
+        assert set(out) == {"gls.design", "grid.gram", "serve.gram",
+                            "catalog.fit"}
+        for segment, dec in out.items():
+            assert dec.value["compute_dtype"] == "float32", segment
+            assert dec.value["rel_err"] <= dec.value["budget"], segment
+            assert dec.basis == "forced"
+        sp = P.segment_spec("gls.design", model=ftr.model,
+                            toas=ftr.toas)
+        assert sp.reduced and sp.source == "tuned"
+        assert sp.rel_err <= sp.budget
+        assert P.segment_spec("serve.gram").reduced
+        # the manifest document itself validates (the pre-commit gate)
+        from tools.telemetry_report import validate_tuning_manifest_file
+
+        errors = []
+        n = validate_tuning_manifest_file(
+            os.path.join(tune_dir, "tuning.json"), errors)
+        assert n == 4 and errors == []
+        # END-TO-END: with NO override installed, the manifest alone
+        # drives the grid kernel reduced — the mixed surface differs
+        # from the forced-f64 build (the reduced path genuinely ran)
+        # yet stays inside the recorded grid.gram budget
+        import jax.numpy as jnp
+
+        from pint_tpu.grid import build_grid_gls_chi2_fn
+
+        pts = _points(g1, g2)[:4]
+        fnmix, _, _ = build_grid_gls_chi2_fn(
+            ftr.model, ftr.toas, ("M2", "SINI"), niter=1, chunk=4)
+        fn64, _, _ = build_grid_gls_chi2_fn(
+            ftr.model, ftr.toas, ("M2", "SINI"), niter=1, chunk=4,
+            precision=P.SegmentSpec(segment="grid.gram"))
+        cmix = np.asarray(fnmix(jnp.asarray(pts))[0])
+        c64 = np.asarray(fn64(jnp.asarray(pts))[0])
+        assert not np.array_equal(cmix, c64)
+        budget = out["grid.gram"].value["budget"]
+        assert float(np.max(np.abs(cmix - c64))) \
+            / max(float(np.max(np.abs(c64))), 1e-300) <= budget
+
+    def test_forced_probe_refuses_past_the_forced_budget(
+            self, ftr, tune_dir, monkeypatch):
+        """Even a forced run cannot ship a broken segment: a probe
+        measuring past the forced budget records f64 with the
+        reason."""
+        from pint_tpu import autotune
+        from pint_tpu.precision import tune as _tune
+
+        monkeypatch.setitem(_tune._PROBES, "serve.gram",
+                            lambda *a, **kw: float("inf"))
+        out = _tune.tune_precision_segments(
+            ftr, segments=("serve.gram",), compute_dtype="float32",
+            accumulation="two_prod", force=True,
+            tuning_manifest=autotune.manifest())
+        dec = out["serve.gram"]
+        assert dec.value["compute_dtype"] == "float64"
+        assert "f64 retained" in dec.reason
+
+    def test_stale_vkey_and_tampered_value_degrade_to_f64(
+            self, ftr, tune_dir):
+        from pint_tpu import autotune
+        from pint_tpu import precision as P
+        from pint_tpu.autotune.manifest import MANIFEST_BASENAME
+        from pint_tpu.precision import tune_precision_segments
+
+        tune_precision_segments(
+            ftr, segments=("gls.design", "serve.gram"),
+            compute_dtype="float32", accumulation="two_prod",
+            force=True, tuning_manifest=autotune.manifest())
+        assert P.segment_spec("gls.design", model=ftr.model,
+                              toas=ftr.toas).reduced
+        # any model-parameter edit invalidates the model-bound vkey
+        old = ftr.model.M2.value
+        ftr.model.M2.value = old + 1e-6
+        try:
+            assert not P.segment_spec("gls.design", model=ftr.model,
+                                      toas=ftr.toas).reduced
+        finally:
+            ftr.model.M2.value = old
+        # a tampered decision VALUE degrades to f64, never a bad dtype
+        mpath = os.path.join(tune_dir, MANIFEST_BASENAME)
+        with open(mpath, encoding="utf-8") as f:
+            doc = json.load(f)
+        for entry in doc["decisions"].values():
+            if entry["name"] == "precision.serve.gram":
+                entry["decision"]["value"]["compute_dtype"] = "float8"
+        with open(mpath, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        autotune.reset_manifest_singleton()
+        assert not P.segment_spec("serve.gram").reduced
+
+    def test_probe_events_match_the_validator(self, ftr, monkeypatch):
+        """Producer/validator agreement for precision_probe /
+        precision_applied, checked on the attrs the REAL emitters
+        produce (the runlog wire format is covered by the
+        telemetry_report self-test)."""
+        from pint_tpu import config, telemetry
+        from pint_tpu import precision as P
+        from pint_tpu.precision import tune_precision_segments
+        from tools.telemetry_report import validate_precision_event
+
+        captured = []
+        monkeypatch.setattr(
+            telemetry, "lifecycle_event",
+            lambda name, **attrs: captured.append(
+                {"name": name, "attrs": attrs}))
+        prev = config.telemetry_mode()
+        config.set_telemetry_mode("basic")
+        try:
+            tune_precision_segments(ftr, segments=("serve.gram",),
+                                    compute_dtype="float32",
+                                    accumulation="two_prod", force=True)
+            with P.use_policy(P.PrecisionPolicy.forced("float32")):
+                P.segment_spec("serve.gram")
+        finally:
+            config.set_telemetry_mode(prev)
+        names = [ev["name"] for ev in captured]
+        assert "precision_probe" in names
+        assert "precision_applied" in names
+        errors = []
+        for ev in captured:
+            validate_precision_event(ev, "captured", errors)
+        assert errors == []
+        # the validator rejects malformed twins
+        bad = [
+            {"name": "precision_probe", "attrs": {
+                "segment": "serve.gram", "dtype": "float64",
+                "accumulation": "f64", "rel_err": 1e-10,
+                "budget": 1e-3, "decision": "float32"}},
+            {"name": "precision_probe", "attrs": {
+                "segment": "serve.gram", "dtype": "float32",
+                "accumulation": "f64", "rel_err": -1.0,
+                "budget": 1e-3, "decision": "float64"}},
+            {"name": "precision_probe", "attrs": {
+                "segment": "serve.gram", "dtype": "float32",
+                "accumulation": "f64", "rel_err": 1e-10,
+                "budget": 0.0, "decision": "float64"}},
+            {"name": "precision_applied", "attrs": {
+                "segment": "serve.gram", "compute_dtype": "float32",
+                "accumulation": "f64", "source": "default"}},
+            {"name": "precision_applied", "attrs": {
+                "segment": "serve.gram", "compute_dtype": "float64",
+                "accumulation": "f64", "source": "tuned"}},
+        ]
+        for ev in bad:
+            errors = []
+            validate_precision_event(ev, "bad", errors)
+            assert errors, f"malformed event accepted: {ev}"
+
+
+# ---------------------------------------------------------------------------
+# the forced-f32 acceptance pins
+# ---------------------------------------------------------------------------
+
+class TestForcedF32Acceptance:
+    def test_gls_fit_within_budget_and_wls_bit_identical(self):
+        """f64-forced vs forced-f32 GLS fit: chi2 and fitted parameters
+        agree within the gls.design segment's recorded budget; the WLS
+        fit (no routed segment) is bit-identical under the same forced
+        policy."""
+        from pint_tpu import precision as P
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models import get_model
+        from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+        budget = P.SEGMENTS["gls.design"].forced_budget
+        pol = P.PrecisionPolicy.forced("float32",
+                                       accumulation="two_prod")
+        f64 = _make_fitter(seed=23)
+        with P.use_policy(pol):
+            fmix = _make_fitter(seed=23)
+        chi2_64 = float(f64.resids.calc_chi2())
+        chi2_mix = float(fmix.resids.calc_chi2())
+        assert abs(chi2_mix - chi2_64) / abs(chi2_64) <= budget
+        for p in f64.model.free_params:
+            v64 = float(getattr(f64.model, p).value)
+            vmix = float(getattr(fmix.model, p).value)
+            u = float(getattr(f64.model, p).uncertainty or 0.0)
+            scale = max(abs(v64), u, 1e-300)
+            assert abs(vmix - v64) <= budget * scale, p
+        # WLS: no noise basis, no routed segment — bitwise identical
+        par = [ln for ln in STANDIN_PAR
+               if not ln.startswith(("EFAC", "ECORR", "TNRed"))]
+        rng = np.random.default_rng(5)
+        mjds = np.linspace(54000, 56000, 50)
+
+        def _wls():
+            model = get_model(list(par))
+            toas = make_fake_toas_fromMJDs(
+                mjds, model, error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(5))
+            w = WLSFitter(toas, model)
+            return w.fit_toas(maxiter=2)
+
+        chi2_w64 = _wls()
+        with P.use_policy(pol):
+            chi2_wmix = _wls()
+        assert chi2_wmix == chi2_w64
+        _ = rng
+
+    def test_grid_surface_within_budget(self, ftr):
+        """f64-forced vs forced-f32 chunked grid surface: chi2 within
+        the grid.gram forced budget at every point (the correction
+        segment rides along under its own override)."""
+        import jax.numpy as jnp
+
+        from pint_tpu import precision as P
+        from pint_tpu.grid import build_grid_gls_chi2_fn
+
+        budget = P.SEGMENTS["grid.gram"].forced_budget
+        g1, g2 = _grid_axes(ftr.model)
+        pts = _points(g1, g2)
+        fn64, _, _ = build_grid_gls_chi2_fn(
+            ftr.model, ftr.toas, ("M2", "SINI"), niter=1, chunk=8,
+            correction_dtype="float64",
+            precision=P.SegmentSpec(segment="grid.gram"))
+        with P.use_policy(P.PrecisionPolicy.forced(
+                "float32", accumulation="two_prod")):
+            fnmix, _, _ = build_grid_gls_chi2_fn(
+                ftr.model, ftr.toas, ("M2", "SINI"), niter=1, chunk=8)
+        c64 = np.asarray(fn64(jnp.asarray(pts))[0])
+        cmix = np.asarray(fnmix(jnp.asarray(pts))[0])
+        assert np.all(np.isfinite(cmix))
+        scale = float(np.max(np.abs(c64)))
+        assert float(np.max(np.abs(cmix - c64))) / scale <= budget
+
+    def test_catalog_batched_fit_within_budget(self):
+        """f64-forced vs forced-f32 catalog batched fit: per-pulsar
+        chi2 and parameter steps within the catalog.fit forced
+        budget."""
+        from pint_tpu import precision as P
+        from pint_tpu.catalog import CatalogFitter
+        from pint_tpu.catalog.ingest import (
+            ingest_catalog,
+            make_synthetic_catalog,
+        )
+
+        budget = P.SEGMENTS["catalog.fit"].forced_budget
+
+        def _fit():
+            report = ingest_catalog(make_synthetic_catalog(
+                n_pulsars=4, seed=42, ntoa_range=(24, 40)))
+            cf = CatalogFitter(report)
+            return cf.fit(maxiter=1)
+
+        res64 = _fit()
+        with P.use_policy(P.PrecisionPolicy.forced(
+                "float32", accumulation="two_prod")):
+            resmix = _fit()
+        by64 = res64.by_name()
+        for fit in resmix.fits:
+            ref = by64[fit.name]
+            assert abs(fit.chi2 - ref.chi2) \
+                <= budget * max(abs(ref.chi2), 1.0)
+            for par, dv in fit.dpars.items():
+                scale = max(abs(ref.dpars[par]),
+                            abs(ref.errors.get(par, 0.0)), 1e-300)
+                assert abs(dv - ref.dpars[par]) <= budget * scale, par
+
+    def test_joint_lnlike_within_budget_and_factorization_holds(self):
+        """The joint HD lnlikelihood under forced f32: within the
+        catalog.lnlike budget of the f64 kernel, and the amp->0
+        factorization pin HOLDS AT REDUCED PRECISION (both sides trace
+        the same spec)."""
+        from pint_tpu import precision as P
+        from pint_tpu.catalog.ingest import (
+            ingest_catalog,
+            make_synthetic_catalog,
+        )
+        from pint_tpu.catalog.likelihood import JointLikelihood
+
+        budget = P.SEGMENTS["catalog.lnlike"].forced_budget
+        report = ingest_catalog(make_synthetic_catalog(
+            n_pulsars=4, seed=42, ntoa_range=(24, 40)))
+        spec = P.SegmentSpec(segment="catalog.lnlike",
+                             compute_dtype="float32",
+                             accumulation="two_prod", source="forced")
+        jl64 = JointLikelihood(report, n_modes=3)
+        jlmix = JointLikelihood(report, n_modes=3, precision=spec)
+        l64 = jl64.lnlike(-14.5, 13.0 / 3.0)
+        lmix = jlmix.lnlike(-14.5, 13.0 / 3.0)
+        assert abs(lmix - l64) / max(abs(l64), 1.0) <= budget
+        # factorization: joint at amp==0 == sum of per-pulsar blocks,
+        # both evaluated under the SAME reduced spec
+        assert np.isclose(jlmix.lnlike_nocommon(),
+                          float(np.sum(jlmix.per_pulsar_lnlike())),
+                          rtol=1e-9, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# the CPU stand-in check suite (mirrors the TPU_PRECISION check names)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestPrecisionCheckSuiteStandin:
+    def test_standin_suite_mirrors_tpu_precision_names(self, tmp_path):
+        """A CPU stand-in of the TPU_PRECISION_r* check suite: the
+        forced-f32 mixed path plays the role of the device, the forced-
+        f64 path the reference, and the named checks reuse the
+        artifact's spelling (``b_la_chi2_rel``-family) so the same
+        perfwatch gate reads both.  Every value must sit inside its
+        bound, and the resulting artifact must gate cleanly through
+        ``tools/perfwatch.py``."""
+        import jax.numpy as jnp
+
+        from pint_tpu import precision as P
+        from pint_tpu.grid import build_grid_gls_chi2_fn
+        from pint_tpu.serving.batcher import FitRequest, pad_request, \
+            serve_kernel
+        from tools.perfwatch import check_precision_artifacts, collect
+
+        f = _make_fitter(seed=31)
+        pol = P.PrecisionPolicy.forced("float32",
+                                       accumulation="two_prod")
+        checks = {}
+
+        # b_la_chi2_rel: the linearized-solve chi2, mixed vs f64
+        q = FitRequest.from_fitter(f)
+        ops = pad_request(q, q.n_toas, q.n_free)
+        out64 = [np.asarray(o) for o in serve_kernel(*ops)]
+        spec = P.SegmentSpec(segment="serve.gram",
+                             compute_dtype="float32",
+                             accumulation="two_prod", source="forced")
+        outmix = [np.asarray(o) for o in serve_kernel(*ops, spec=spec)]
+        checks["b_la_chi2_rel"] = {
+            "value": abs(float(outmix[2]) - float(out64[2]))
+            / max(abs(float(out64[2])), 1e-300),
+            "bound": P.SEGMENTS["serve.gram"].forced_budget}
+
+        # b_gls_step_explained: step deviation over the step scale
+        step_scale = max(float(np.linalg.norm(out64[0])), 1e-300)
+        checks["b_gls_step_explained"] = {
+            "value": float(np.linalg.norm(outmix[0] - out64[0]))
+            / step_scale,
+            "bound": P.SEGMENTS["gls.design"].forced_budget}
+
+        # b_grid_chi2_explained: the chunked grid surface, mixed vs f64
+        g1, g2 = _grid_axes(f.model)
+        pts = _points(g1, g2)[:4]
+        fn64, _, _ = build_grid_gls_chi2_fn(
+            f.model, f.toas, ("M2", "SINI"), niter=1, chunk=4,
+            correction_dtype="float64",
+            precision=P.SegmentSpec(segment="grid.gram"))
+        with P.use_policy(pol):
+            fnmix, _, _ = build_grid_gls_chi2_fn(
+                f.model, f.toas, ("M2", "SINI"), niter=1, chunk=4)
+        c64 = np.asarray(fn64(jnp.asarray(pts))[0])
+        cmix = np.asarray(fnmix(jnp.asarray(pts))[0])
+        checks["b_grid_chi2_explained"] = {
+            "value": float(np.max(np.abs(cmix - c64)))
+            / max(float(np.max(np.abs(c64))), 1e-300),
+            "bound": P.SEGMENTS["grid.gram"].forced_budget}
+
+        for name, c in checks.items():
+            c["ok"] = bool(c["value"] <= c["bound"])
+            assert c["ok"], f"{name}: {c['value']} > {c['bound']}"
+
+        # the artifact shape the TPU runner commits; perfwatch must
+        # ingest and gate it cleanly
+        artifact = {"metric": "tpu_precision", "platform": "cpu",
+                    "ok": all(c["ok"] for c in checks.values()),
+                    "checks": checks}
+        path = tmp_path / "TPU_PRECISION_r99.json"
+        path.write_text(json.dumps(artifact))
+        errors = []
+        records = collect([str(path)], None, errors)
+        assert errors == []
+        verdicts = check_precision_artifacts(records, threshold=0.30)
+        assert len(verdicts) == len(checks)
+        assert not any(v.failed for v in verdicts)

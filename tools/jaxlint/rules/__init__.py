@@ -56,6 +56,7 @@ class ScopedRule(Rule):
 # import order defines reporting order for equal-position findings
 from tools.jaxlint.rules import host_jit          # noqa: E402,F401
 from tools.jaxlint.rules import dtype_literals    # noqa: E402,F401
+from tools.jaxlint.rules import downcast          # noqa: E402,F401
 from tools.jaxlint.rules import traced_branch     # noqa: E402,F401
 from tools.jaxlint.rules import static_args       # noqa: E402,F401
 from tools.jaxlint.rules import typed_raises      # noqa: E402,F401

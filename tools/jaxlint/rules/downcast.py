@@ -1,0 +1,84 @@
+"""unguarded-downcast: reduced-precision casts must route through the
+precision layer.
+
+The precision core's sub-ns arithmetic is f64 by contract; the ONLY
+sanctioned way to drop a buffer to float32/bfloat16 in the core files
+is through :mod:`pint_tpu.precision` (``downcast`` for a bare cast,
+``matmul`` for a policy-driven product segment), whose decisions are
+probe-measured and budgeted.  A bare ``x.astype(jnp.float32)`` or a
+``dtype=jnp.bfloat16`` buffer build in the core bypasses the budget
+machinery entirely — the r05-era hazard this rule keeps out.
+
+Flagged in the scoped file set (the precision core + the catalog and
+serve kernels):
+
+* ``<expr>.astype(<reduced dtype>)`` — reduced dtype spelled as
+  ``jnp.float32`` / ``np.bfloat16`` / a ``"float32"``-style string;
+* any call carrying ``dtype=<reduced dtype>``.
+
+Fix by routing through ``pint_tpu.precision`` (its calls are not
+casts and the layer's own files are outside the scope), or justify an
+intentional site with ``# jaxlint: disable=unguarded-downcast -- why``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.jaxlint.engine import FileInfo
+from tools.jaxlint.rules import ScopedRule, register
+from tools.jaxlint.rules.dtype_literals import PRECISION_CORE
+
+#: the files whose downcasts must route through pint_tpu.precision:
+#: the precision core plus the batched serve/catalog kernel surfaces
+DOWNCAST_SCOPE = PRECISION_CORE + (
+    "pint_tpu/catalog/",
+    "pint_tpu/serving/batcher.py",
+)
+
+_REDUCED_NAMES = {"float32", "bfloat16", "float16", "half", "single"}
+_REDUCED_STRINGS = {"float32", "bfloat16", "float16", "f4", "<f4",
+                    "single"}
+
+
+def _is_reduced_dtype(node: ast.AST, info: FileInfo) -> bool:
+    """True when ``node`` denotes a reduced float dtype: a string
+    literal or a ``jnp.float32``-style attribute on a numpy/jax.numpy
+    alias (any module root is accepted — ``np.float32`` narrows the
+    same buffers ``jnp.float32`` does)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) \
+            and node.value in _REDUCED_STRINGS
+    if isinstance(node, ast.Attribute):
+        return node.attr in _REDUCED_NAMES
+    return False
+
+
+@register
+class UnguardedDowncastRule(ScopedRule):
+    name = "unguarded-downcast"
+    description = ("float32/bfloat16 downcast in the precision core not "
+                   "routed through pint_tpu.precision")
+    default_files = DOWNCAST_SCOPE
+
+    def check(self, info: FileInfo):
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" and node.args \
+                    and _is_reduced_dtype(node.args[0], info):
+                yield info.finding(
+                    self.name, node,
+                    "`.astype(<reduced dtype>)` in the precision core: "
+                    "route the cast through pint_tpu.precision "
+                    "(downcast / matmul segment) so it carries a "
+                    "measured budget, or justify with a pragma")
+                continue
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _is_reduced_dtype(kw.value, info):
+                    yield info.finding(
+                        self.name, node,
+                        "`dtype=<reduced dtype>` buffer build in the "
+                        "precision core: route through "
+                        "pint_tpu.precision or justify with a pragma")

@@ -47,6 +47,7 @@ DEFAULT_TARGETS = (
     "pint_tpu/serving/",
     "pint_tpu/autotune/",
     "pint_tpu/catalog/",
+    "pint_tpu/precision/",
 )
 
 DISALLOWED = {

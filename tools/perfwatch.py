@@ -53,7 +53,8 @@ if REPO not in sys.path:  # `python tools/perfwatch.py` spelling
 HISTORY_SCHEMA = "pint_tpu.perfwatch.history/1"
 
 #: artifact filename families swept from --dir, in ingestion order
-_PATTERNS = ("BENCH_r*.json", "BENCH_*_r*.json", "MULTICHIP_r*.json")
+_PATTERNS = ("BENCH_r*.json", "BENCH_*_r*.json", "MULTICHIP_r*.json",
+             "TPU_PRECISION_r*.json")
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
@@ -102,6 +103,15 @@ class RunRecord:
     catalog_joint_lnlike_per_s: Optional[float] = None
     catalog_n_pulsars: Optional[int] = None
     catalog_error: Optional[str] = None        #: degraded catalog block
+    #: from the precision{...} block (round 12+: mixed-precision layer)
+    precision_mixed_fits_per_s: Optional[float] = None
+    precision_max_rel_err: Optional[float] = None
+    precision_mixed_vs_f64: Optional[float] = None
+    precision_reduced_count: Optional[int] = None
+    precision_error: Optional[str] = None      #: degraded precision block
+    #: TPU_PRECISION_r* check-suite artifacts (kind == "precision"):
+    #: named check -> {"value": v, "bound": b, "ok": bool}
+    precision_checks: Optional[dict] = None
     #: multichip extras
     n_devices: Optional[int] = None
     multichip_ok: Optional[bool] = None
@@ -197,6 +207,19 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.tuned_decisions = tuned["decisions"]
         if isinstance(tuned.get("error"), str) and tuned["error"]:
             rec.tuned_error = tuned["error"]
+    prec = h.get("precision")
+    if isinstance(prec, dict):
+        for src, dst in (("mixed_fits_per_s", "precision_mixed_fits_per_s"),
+                         ("max_rel_err", "precision_max_rel_err"),
+                         ("mixed_vs_f64", "precision_mixed_vs_f64")):
+            if isinstance(prec.get(src), (int, float)) \
+                    and not isinstance(prec.get(src), bool):
+                setattr(rec, dst, float(prec[src]))
+        if isinstance(prec.get("reduced_count"), int) \
+                and not isinstance(prec.get("reduced_count"), bool):
+            rec.precision_reduced_count = prec["reduced_count"]
+        if isinstance(prec.get("error"), str) and prec["error"]:
+            rec.precision_error = prec["error"]
     catalog = h.get("catalog")
     if isinstance(catalog, dict):
         for src, dst in (("catalog_fits_per_s", "catalog_fits_per_s"),
@@ -269,6 +292,24 @@ def ingest_file(path: str, errors: List[str]) -> Optional[RunRecord]:
     for obj in _tail_json_lines(doc.get("tail", "")):
         if "metric" in obj:
             headline = obj
+    if isinstance(headline, dict) \
+            and headline.get("metric") == "tpu_precision":
+        # TPU_PRECISION_r* check-suite artifact: each named check's
+        # measured value gates against its committed bound (a value-
+        # less artifact — no headline fits/s — so never a bench series)
+        rec.kind = "precision"
+        rec.metric = "tpu_precision"
+        rec.platform = headline.get("platform") or rec.platform
+        if isinstance(headline.get("error"), str) and headline["error"]:
+            rec.error = headline["error"]
+        checks = headline.get("checks")
+        if isinstance(checks, dict):
+            rec.precision_checks = {
+                str(name): c for name, c in checks.items()
+                if isinstance(c, dict)}
+        elif rec.error is None:
+            rec.error = "tpu_precision artifact carries no checks object"
+        return rec
     if headline is None:
         # a round that crashed before its one JSON line (r03's SIGILL
         # tail) is a failed measurement to EXCLUDE, not a reason to fail
@@ -387,21 +428,32 @@ def check_series(runs: List[RunRecord], threshold: float,
     # (compile time, tail latency).  The warm-serving series gate the
     # same way the headline does: a PR cannot silently halve warm-start
     # fits/s or double the p99.
-    quantities = (("fits_per_sec", lambda r: r.value, +1),
-                  ("compile_s", lambda r: r.compile_s, -1),
-                  ("warm_fits_per_s", lambda r: r.warm_fits_per_s, +1),
-                  ("warm_p99_ms", lambda r: r.warm_p99_ms, -1),
-                  ("tuned_fits_per_s", lambda r: r.tuned_fits_per_s, +1),
+    quantities = (("fits_per_sec", lambda r: r.value, +1, False),
+                  ("compile_s", lambda r: r.compile_s, -1, False),
+                  ("warm_fits_per_s", lambda r: r.warm_fits_per_s, +1,
+                   False),
+                  ("warm_p99_ms", lambda r: r.warm_p99_ms, -1, False),
+                  ("tuned_fits_per_s", lambda r: r.tuned_fits_per_s, +1,
+                   False),
                   # catalog engine (round 11+): whole-pulsar batched-fit
                   # throughput gates drops, bucket-ladder padding waste
                   # gates rises, joint-lnlike throughput gates drops
                   ("catalog_fits_per_s",
-                   lambda r: r.catalog_fits_per_s, +1),
+                   lambda r: r.catalog_fits_per_s, +1, False),
                   ("catalog_pad_waste_frac",
-                   lambda r: r.catalog_pad_waste_frac, -1),
+                   lambda r: r.catalog_pad_waste_frac, -1, False),
                   ("catalog_joint_lnlike_per_s",
-                   lambda r: r.catalog_joint_lnlike_per_s, +1))
-    for name, get, sign in quantities:
+                   lambda r: r.catalog_joint_lnlike_per_s, +1, False),
+                  # mixed-precision layer (round 12+): policy-path
+                  # throughput gates drops; max_rel_err gates rises WITH
+                  # the zero-baseline opt-in — a bit-identical history
+                  # (0.0, the default-policy contract) must still gate a
+                  # newly nonzero mixed-vs-f64 disagreement
+                  ("precision_mixed_fits_per_s",
+                   lambda r: r.precision_mixed_fits_per_s, +1, False),
+                  ("precision_max_rel_err",
+                   lambda r: r.precision_max_rel_err, -1, True))
+    for name, get, sign, zero_fails in quantities:
         # gate the series' NEWEST run only: when it lacks this quantity
         # there is nothing to compare — re-gating an older run and
         # reporting it as latest would mask the newest round entirely
@@ -413,7 +465,8 @@ def check_series(runs: List[RunRecord], threshold: float,
         if not prev:
             continue
         # sign +1: lower-is-worse (fits/s); -1: higher-is-worse (compile)
-        gated = mad_gate(latest, prev, sign, threshold, noise_mult)
+        gated = mad_gate(latest, prev, sign, threshold, noise_mult,
+                         zero_baseline_fails=zero_fails)
         if gated is None:
             continue
         baseline, rel, scatter, bar, failed = gated
@@ -491,6 +544,72 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: catalog block degraded "
                    f"({latest_rec.catalog_error}) where prior runs "
                    "measured the catalog engine"))
+    # a degraded precision block where prior rounds measured the
+    # mixed-precision layer is a regression, not a silent skip
+    if latest_rec.precision_error is not None \
+            and any(r.precision_mixed_fits_per_s is not None
+                    for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="precision", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: precision block degraded "
+                   f"({latest_rec.precision_error}) where prior runs "
+                   "measured the mixed-precision layer"))
+    return verdicts
+
+
+def check_precision_artifacts(records: List[RunRecord],
+                              threshold: float) -> List[Verdict]:
+    """Gate the TPU_PRECISION_r* check-suite series: the NEWEST
+    artifact per platform gates each named check's measured ``value``
+    against its committed ``bound`` WITHIN the run (the
+    tuned_vs_static within-run discipline — a first artifact is
+    covered too), and an errored/check-less newest artifact where
+    prior rounds measured checks fails outright (the warm{}/catalog{}
+    errored-block discipline)."""
+    verdicts: List[Verdict] = []
+    by_platform: Dict[str, List[RunRecord]] = {}
+    for r in records:
+        if r.kind == "precision":
+            by_platform.setdefault(r.platform, []).append(r)
+    for platform, runs in sorted(by_platform.items()):
+        runs.sort(key=lambda r: (r.round if r.round is not None
+                                 else 1 << 30, r.source))
+        latest = runs[-1]
+        if latest.precision_checks is None:
+            if any(r.precision_checks for r in runs[:-1]):
+                verdicts.append(Verdict(
+                    series=("tpu_precision", platform),
+                    quantity="precision_checks", baseline=float("nan"),
+                    latest=float("nan"), rel_change=float("inf"),
+                    bar=threshold, failed=True,
+                    detail=f"{latest.source}: errored/check-less "
+                           f"({latest.error}) where prior artifacts "
+                           "measured the check suite"))
+            continue
+        for name, c in sorted(latest.precision_checks.items()):
+            value, bound = c.get("value"), c.get("bound")
+            if not isinstance(value, (int, float)) \
+                    or not isinstance(bound, (int, float)) \
+                    or isinstance(value, bool) or isinstance(bound, bool):
+                verdicts.append(Verdict(
+                    series=("tpu_precision", platform), quantity=name,
+                    baseline=float("nan"), latest=float("nan"),
+                    rel_change=float("inf"), bar=threshold, failed=True,
+                    detail=f"{latest.source}: check {name!r} malformed "
+                           f"(value {value!r}, bound {bound!r})"))
+                continue
+            failed = bool(value > bound)
+            over = (value - bound) / bound if bound else float("inf")
+            verdicts.append(Verdict(
+                series=("tpu_precision", platform), quantity=name,
+                baseline=float(bound), latest=float(value),
+                rel_change=float(over) if failed else 0.0,
+                bar=0.0, failed=failed,
+                detail=f"{latest.source}: {name} = {value:g} vs "
+                       f"committed bound {bound:g}"))
     return verdicts
 
 
@@ -505,6 +624,12 @@ def run_check(records: List[RunRecord], threshold: float, noise_mult: float,
                   f"{v.quantity}: {v.detail}", file=out)
             if v.failed:
                 rc = 1
+    for v in check_precision_artifacts(records, threshold):
+        status = "REGRESSION" if v.failed else "ok"
+        print(f"perfwatch: [{status}] {v.series[0]} @{v.series[1]} "
+              f"{v.quantity}: {v.detail}", file=out)
+        if v.failed:
+            rc = 1
     if rc == 0:
         print("perfwatch: no meaningful regression", file=out)
     return rc
@@ -565,6 +690,13 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f"pad_waste={latest.catalog_pad_waste_frac}, "
                   f"joint_lnlike {latest.catalog_joint_lnlike_per_s}/s",
                   file=out)
+        if latest.precision_mixed_fits_per_s is not None \
+                or latest.precision_max_rel_err is not None:
+            print(f"  precision: mixed {latest.precision_mixed_fits_per_s}"
+                  f" fits/s ({latest.precision_mixed_vs_f64}x f64, "
+                  f"{latest.precision_reduced_count} reduced segment(s)),"
+                  f" max_rel_err={latest.precision_max_rel_err}",
+                  file=out)
         if latest.cost:
             c = latest.cost
             print(f"  cost[{c.get('name', '?')}]: "
@@ -580,6 +712,30 @@ def render_report(records: List[RunRecord], out=None) -> None:
             why = r.error or ("sanity_ok=false" if r.sanity_ok is False
                               else "no headline value")
             print(f"  {r.source}: {why}", file=out)
+    precision = [r for r in records if r.kind == "precision"]
+    if precision:
+        print("--- precision check suites ---", file=out)
+        for r in sorted(precision, key=lambda r: (r.round or 0, r.source)):
+            if r.precision_checks is None:
+                print(f"  r{r.round} {r.source}: errored ({r.error})",
+                      file=out)
+                continue
+            # recompute value > bound — NOT the artifact's own 'ok'
+            # flag — so the report a human reads can never disagree
+            # with the --check verdict on the same file
+            bad = []
+            for n, c in r.precision_checks.items():
+                v, b = c.get("value"), c.get("bound")
+                numeric = (isinstance(v, (int, float))
+                           and isinstance(b, (int, float))
+                           and not isinstance(v, bool)
+                           and not isinstance(b, bool))
+                if not numeric or v > b:
+                    bad.append(n)
+            print(f"  r{r.round} {r.source} @{r.platform}: "
+                  f"{len(r.precision_checks)} check(s), "
+                  + ("all within bounds" if not bad
+                     else f"OVER BOUND: {sorted(bad)}"), file=out)
     multichip = [r for r in records if r.kind == "multichip"]
     if multichip:
         print("--- multichip ---", file=out)

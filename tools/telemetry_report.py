@@ -118,6 +118,77 @@ AUTOTUNE_EVENT_ATTRS = {
     "tune_fallback": {"decision": str, "reason": str},
 }
 
+#: precision-layer lifecycle events (pint_tpu/precision): one
+#: precision_probe per segment probe (measured f64-vs-reduced rel err,
+#: the budget it was judged against, and the decision) and one
+#: precision_applied whenever a REDUCED spec ships to a consumer
+#: kernel.  Same contract style as the other event families.
+PRECISION_EVENT_ATTRS = {
+    "precision_probe": {"segment": str, "dtype": str,
+                        "accumulation": str, "rel_err": (int, float),
+                        "budget": (int, float), "decision": str},
+    "precision_applied": {"segment": str, "compute_dtype": str,
+                          "accumulation": str, "source": str},
+}
+
+_PRECISION_DTYPES = ("float64", "float32", "bfloat16")
+_PRECISION_SOURCES = ("default", "tuned", "forced")
+
+
+def validate_precision_event(ev: dict, where: str,
+                             errors: List[str]) -> None:
+    """Attr contract for precision_probe / precision_applied records:
+    required attrs typed, dtypes in the layer's enum, a probe's rel_err
+    non-negative and its budget strictly positive (a zero-budget probe
+    could never admit anything — producer drift), an applied record's
+    source in the resolution enum and never 'default' (the default is
+    f64, which is not 'applied' reduced precision)."""
+    name = ev.get("name")
+    required = PRECISION_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    if name == "precision_probe":
+        if attrs.get("dtype") not in _PRECISION_DTYPES[1:]:
+            _err(errors, where,
+                 f"precision_probe dtype {attrs.get('dtype')!r} must be "
+                 f"a REDUCED dtype {_PRECISION_DTYPES[1:]}")
+        if attrs.get("decision") not in _PRECISION_DTYPES:
+            _err(errors, where,
+                 f"precision_probe decision {attrs.get('decision')!r} "
+                 f"not in {_PRECISION_DTYPES}")
+        rel = attrs.get("rel_err")
+        if isinstance(rel, (int, float)) and not isinstance(rel, bool) \
+                and rel < 0:
+            _err(errors, where,
+                 f"precision_probe rel_err is negative ({rel!r})")
+        budget = attrs.get("budget")
+        if isinstance(budget, (int, float)) \
+                and not isinstance(budget, bool) and budget <= 0:
+            _err(errors, where,
+                 f"precision_probe budget is {budget!r}, must be > 0")
+    elif name == "precision_applied":
+        if attrs.get("compute_dtype") not in _PRECISION_DTYPES[1:]:
+            _err(errors, where,
+                 f"precision_applied compute_dtype "
+                 f"{attrs.get('compute_dtype')!r} must be a REDUCED "
+                 f"dtype {_PRECISION_DTYPES[1:]} (f64 is the default, "
+                 "not an application)")
+        if attrs.get("source") not in _PRECISION_SOURCES[1:]:
+            _err(errors, where,
+                 f"precision_applied source {attrs.get('source')!r} "
+                 f"not in {_PRECISION_SOURCES[1:]}")
+
+
 #: catalog-engine lifecycle events (pint_tpu/catalog): one ingest
 #: summary per catalog (quarantined-row and excluded-pulsar counts)
 #: and one bucket-assignment summary (ladder + padding waste).  Same
@@ -697,6 +768,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_serving_event(ev, where, errors)
                     validate_autotune_event(ev, where, errors)
                     validate_catalog_event(ev, where, errors)
+                    validate_precision_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -963,15 +1035,32 @@ def self_test(errors: List[str]) -> int:
         run.record_event("catalog_bucket", n_pulsars=16, n_buckets=3,
                          pad_waste_frac=0.041,
                          ntoa_ladder="24,40,64", nfree_ladder="10")
+        # precision-layer producer drift check: the probe/applied event
+        # contract (PRECISION_EVENT_ATTRS) — a probe that admitted the
+        # reduced segment, its degraded twin (measured disagreement
+        # above the bar, f64 retained), and one applied record
+        run.record_event("precision_probe", segment="serve.gram",
+                         dtype="float32", accumulation="two_prod",
+                         rel_err=1.7e-10, budget=1e-3,
+                         decision="float32")
+        run.record_event("precision_probe", segment="gls.design",
+                         dtype="float32", accumulation="f64",
+                         rel_err=0.61, budget=1e-12,
+                         decision="float64")
+        run.record_event("precision_applied", segment="serve.gram",
+                         compute_dtype="float32",
+                         accumulation="two_prod", source="tuned",
+                         budget=1e-3, rel_err=1.7e-10)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
         # sharding_plan, 3x elastic events, 3x serving events, 2x
-        # autotune events, 3x catalog events, metrics, run_end
-        if n < 21:
-            _err(errors, "selftest", f"expected >= 21 records, got {n}")
+        # autotune events, 3x catalog events, 3x precision events,
+        # metrics, run_end
+        if n < 24:
+            _err(errors, "selftest", f"expected >= 24 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
