@@ -283,6 +283,21 @@ def bench_b1855_gls():
                    "error": f"{type(e).__name__}: {e}"}
     st.mark("catalog measurement")
 
+    # amortized-inference measurement (ROADMAP item 3): train a small
+    # normalizing flow against a vectorizable Bayesian timing
+    # posterior and serve draws + log-prob queries through the
+    # TimingService posterior door.  Never fatal: a broken amortized
+    # engine degrades to an errored-but-present posterior block.
+    try:
+        posterior = posterior_block()
+    except Exception as e:
+        posterior = {"train_steps": None, "elbo_final": None,
+                     "draws_per_s": None, "logprob_per_s": None,
+                     "p50_ms": None, "p99_ms": None,
+                     "steady_state_compiles": None,
+                     "error": f"{type(e).__name__}: {e}"}
+    st.mark("posterior measurement")
+
     imin = np.unravel_index(np.argmin(chi2), chi2.shape)
     # convergence-grade sanity, not just order-of-magnitude: the measured
     # grid-min-vs-fit gap is ~0.02 chi2 units (pure grid discretization);
@@ -309,6 +324,7 @@ def bench_b1855_gls():
         "tuned": tuned,
         "precision": prec,
         "catalog": catalog,
+        "posterior": posterior,
     }
 
 
@@ -591,20 +607,123 @@ def catalog_block():
     }
 
 
+def _ngc_or_fallback(rng):
+    """The NGC6440E workload when the reference data exists, else the
+    FALLBACK_PAR model with simulated TOAs at the same scale — ONE
+    loader shared by the secondary WLS grid and the posterior block."""
+    from pint_tpu.models import get_model, get_model_and_toas
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    if os.path.exists(NGC_PAR) and os.path.exists(NGC_TIM):
+        return get_model_and_toas(NGC_PAR, NGC_TIM)
+    model = get_model([ln + "\n" for ln in FALLBACK_PAR.splitlines()])
+    toas = make_fake_toas_uniform(53400, 54800, 62, model,
+                                  error_us=20.0, add_noise=True,
+                                  rng=rng)
+    return model, toas
+
+
+#: posterior-block knobs: flow training schedule (env-overridable so
+#: the contract test stays fast), draw-request fan, and per-request
+#: draw count for the coalesced throughput pass
+POSTERIOR_TRAIN_STEPS = 80
+POSTERIOR_MC_SAMPLES = 32
+POSTERIOR_DRAW_REQUESTS = 4
+POSTERIOR_DRAWS_PER_REQUEST = 256
+POSTERIOR_LATENCY_PROBES = 8
+
+
+def posterior_block():
+    """The headline's ``posterior{}`` block: train a normalizing flow
+    (:mod:`pint_tpu.amortized`) against a small vectorizable Bayesian
+    timing posterior — white-noise F0/F1/DM with basic uniform priors,
+    the MCMC-able surface (the full correlated-noise GLS likelihood is
+    outside ``BayesianTiming``'s vectorized families, exactly as it is
+    for ``MCMCFitter``) — then serve coalesced draw and log-prob
+    requests through the :class:`~pint_tpu.serving.service.
+    TimingService` posterior door and stamp training depth, final
+    ELBO, draw/log-prob throughput, latency percentiles, and the
+    steady-state compile proof.  ``tools/perfwatch.py`` gates
+    ``draws_per_s`` drops and ``p99_ms`` rises."""
+    from pint_tpu.amortized import (AmortizedPosterior, AmortizedVI,
+                                    TrainConfig, train_flow)
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.bayesian import BayesianTiming, apply_prior_info
+    from pint_tpu.serving import PosteriorRequest, ServeConfig, TimingService
+    from pint_tpu.telemetry import jaxevents
+
+    model, toas = _ngc_or_fallback(np.random.default_rng(20260804))
+    f = WLSFitter(toas, model)
+    f.fit_toas(maxiter=3)
+    # amortize the well-conditioned spin subspace: single-band fake
+    # TOAs leave DM (and the bench's astrometry) degenerate, and a
+    # box prior built on a junk uncertainty destabilizes a
+    # fixed-budget training run
+    f.model.free_params = ["F0", "F1"]
+    info = {}
+    for p in f.model.free_params:
+        par = getattr(f.model, p)
+        half = 10.0 * float(par.uncertainty or abs(par.value or 1.0) * 1e-8)
+        v = float(par.value or 0.0)
+        info[p] = {"distr": "uniform", "pmin": v - half, "pmax": v + half}
+    apply_prior_info(f.model, info)
+    bt = BayesianTiming(f.model, f.toas)
+    vi = AmortizedVI.from_bayesian(bt, n_layers=4, hidden=16, seed=1)
+    steps = int(os.environ.get("BENCH_POSTERIOR_STEPS",
+                               str(POSTERIOR_TRAIN_STEPS)))
+    res = train_flow(vi, TrainConfig(steps=max(1, steps),
+                                     n_samples=POSTERIOR_MC_SAMPLES,
+                                     lr=1e-2, seed=2))
+    if not np.isfinite(res.elbo_final):
+        raise RuntimeError(
+            f"flow training diverged: final ELBO {res.elbo_final}")
+    ap = AmortizedPosterior.from_training(vi, res)
+    svc = TimingService(ServeConfig(
+        draw_buckets=(POSTERIOR_DRAWS_PER_REQUEST,)))
+    svc.register_posterior(ap, seed=3)
+    n, k = POSTERIOR_DRAWS_PER_REQUEST, POSTERIOR_DRAW_REQUESTS
+    svc.warm_posterior([(k, n), (1, n)])
+
+    before = jaxevents.counts()
+    t0 = time.time()
+    out = svc.serve_posterior([PosteriorRequest(n_draws=n,
+                                                request_id=f"bench-{i}")
+                               for i in range(k)])
+    draw_elapsed = time.time() - t0
+    pts = np.concatenate([o.draws for o in out])[:n]
+    t0 = time.time()
+    lout = svc.serve_posterior([PosteriorRequest(points=pts,
+                                                 request_id="bench-lp")])
+    lp_elapsed = time.time() - t0
+    for i in range(POSTERIOR_LATENCY_PROBES):
+        svc.serve_posterior([PosteriorRequest(n_draws=n,
+                                              request_id=f"lat-{i}")])
+    steady = jaxevents.counts() - before
+    if draw_elapsed <= 0 or lp_elapsed <= 0:
+        raise RuntimeError(
+            f"posterior timing degenerate: draws {draw_elapsed}s, "
+            f"logprob {lp_elapsed}s")
+    if not np.all(np.isfinite(lout[0].log_probs)):
+        raise RuntimeError("posterior log-prob produced non-finite "
+                           "values on its own draws")
+    lat = svc.posterior_latency_summary()
+    return {
+        "train_steps": res.steps,
+        "elbo_final": round(res.elbo_final, 3),
+        "draws_per_s": round(n * k / draw_elapsed, 3),
+        "logprob_per_s": round(n / lp_elapsed, 3),
+        "p50_ms": round(lat["p50_ms"], 3),
+        "p99_ms": round(lat["p99_ms"], 3),
+        "steady_state_compiles": int(steady.compiles),
+    }
+
+
 def bench_ngc6440e_wls():
     """Secondary: the r01/r02 NGC6440E WLS grid (continuity metric)."""
     from pint_tpu.fitter import WLSFitter
     from pint_tpu.grid import grid_chisq
-    from pint_tpu.models import get_model, get_model_and_toas
-    from pint_tpu.simulation import make_fake_toas_uniform
 
-    rng = np.random.default_rng(12345)
-    if os.path.exists(NGC_PAR) and os.path.exists(NGC_TIM):
-        model, toas = get_model_and_toas(NGC_PAR, NGC_TIM)
-    else:
-        model = get_model([ln + "\n" for ln in FALLBACK_PAR.splitlines()])
-        toas = make_fake_toas_uniform(53400, 54800, 62, model, error_us=20.0,
-                                      add_noise=True, rng=rng)
+    model, toas = _ngc_or_fallback(np.random.default_rng(12345))
     f = WLSFitter(toas, model)
     f.fit_toas(maxiter=3)
     npts = 16
@@ -892,6 +1011,10 @@ def main():
         # lnlikelihood throughput (perfwatch gates catalog_fits_per_s
         # drops and pad_waste_frac rises)
         "catalog": r["catalog"],
+        # amortized inference engine: flow training depth/ELBO plus
+        # warm-served posterior draw/log-prob throughput and latency
+        # (perfwatch gates draws_per_s drops and p99_ms rises)
+        "posterior": r["posterior"],
     }
     if not platform_ok:
         out["platform_mismatch"] = True

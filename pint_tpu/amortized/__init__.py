@@ -1,0 +1,47 @@
+"""Amortized inference engine: VI + normalizing flows as a warm
+posterior endpoint (ROADMAP item 3; arXiv 2405.08857 is the method
+retrieval, Vela.jl / arXiv 2412.15858 the noise-model surface).
+
+Four pieces:
+
+* :mod:`~pint_tpu.amortized.flows` — affine-coupling (RealNVP) layers
+  with fixed seeded permutations in plain jnp, plus the
+  :class:`~pint_tpu.amortized.flows.PriorTransform` that aligns the
+  flow's base distribution with the prior families
+  ``bayesian.py`` vectorizes (uniform -> sigmoid map into the support,
+  normal -> affine), so every flow sample is in-support by
+  construction and the ELBO never sees a ``-inf``;
+* :mod:`~pint_tpu.amortized.elbo` — the reparameterized ELBO over any
+  jax-traceable batched lnposterior: the deduped
+  :meth:`~pint_tpu.bayesian.BayesianTiming.batched_posterior` entry
+  point or the catalog's
+  :class:`~pint_tpu.catalog.likelihood.JointLikelihood`;
+* :mod:`~pint_tpu.amortized.train` — a host-side Adam driver around
+  ONE jitted ``value_and_grad`` step, bitwise-deterministic for a
+  fixed seed, checkpoint/resumable through
+  :class:`~pint_tpu.runtime.checkpoint.SweepCheckpoint`, with the MC
+  sample axis shardable under a ``walker`` execution plan;
+* :mod:`~pint_tpu.amortized.posterior` — the trained flow as serve
+  kernels: batched draw and log-prob executables registered in
+  :class:`~pint_tpu.serving.warmup.WarmPool` /
+  :class:`~pint_tpu.serving.aotcache.AOTCache` under the established
+  vkey + device-fingerprint scheme, consumed by
+  :class:`~pint_tpu.serving.service.TimingService`'s
+  ``PosteriorRequest`` door.
+"""
+
+from pint_tpu.amortized.elbo import AmortizedVI
+from pint_tpu.amortized.flows import Flow, FlowConfig, PriorTransform
+from pint_tpu.amortized.posterior import AmortizedPosterior
+from pint_tpu.amortized.train import TrainConfig, TrainResult, train_flow
+
+__all__ = [
+    "AmortizedVI",
+    "AmortizedPosterior",
+    "Flow",
+    "FlowConfig",
+    "PriorTransform",
+    "TrainConfig",
+    "TrainResult",
+    "train_flow",
+]

@@ -1,0 +1,163 @@
+"""Reparameterized ELBO over the repo's jitted posteriors.
+
+:class:`AmortizedVI` bundles the three traced pieces one variational
+fit needs — a :class:`~pint_tpu.amortized.flows.Flow`, its
+:class:`~pint_tpu.amortized.flows.PriorTransform`, and a jax-traceable
+batched lnposterior — and builds the scalar ELBO the training driver
+differentiates:
+
+    z ~ N(0, I)                       (reparameterized base samples)
+    u, logdet = flow.forward(params, z)
+    x, logjac = transform.constrain(u)
+    log q(x)  = logN(z) - logdet - logjac
+    ELBO      = E_z[ lnposterior(x) - log q(x) ]
+
+The lnposterior comes from the ONE typed entry point the samplers
+share (:meth:`pint_tpu.bayesian.BayesianTiming.batched_posterior` —
+``value_and_grad`` flows through the compiled phase evaluation), or
+from the catalog's cross-pulsar
+:class:`~pint_tpu.catalog.likelihood.JointLikelihood` (the
+``(log10_A, gamma)`` GW-background surface).  Because the transform
+maps into the open prior support, every training sample has a finite
+lnposterior and a finite gradient — the ``-inf`` prior boundary never
+enters the expectation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.amortized.flows import Flow, FlowConfig, PriorTransform
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["AmortizedVI"]
+
+
+class AmortizedVI:
+    """One variational-inference problem: flow + prior transform +
+    traced batched lnposterior.
+
+    ``lnpost_batch`` must map a ``(N, ndim)`` jax array of parameter
+    points to ``(N,)`` log-posteriors inside a trace.  ``specs`` are
+    the per-parameter prior specs the transform aligns with
+    (:meth:`~pint_tpu.models.priors.Prior.jax_spec` tuples).  ``vkey``
+    is caller-supplied identity material for checkpoints and serve
+    executables (the fitter constructors fill it with the established
+    model-signature + TOA-version scheme)."""
+
+    def __init__(self, lnpost_batch: Callable, specs: Sequence[tuple],
+                 param_labels: Optional[Sequence[str]] = None,
+                 flow: Optional[Flow] = None,
+                 n_layers: int = 4, hidden: int = 32, seed: int = 0,
+                 vkey: tuple = ()):
+        if not callable(lnpost_batch):
+            raise UsageError("lnpost_batch must be callable "
+                             f"(got {type(lnpost_batch).__name__})")
+        self.transform = PriorTransform(specs)
+        ndim = self.transform.ndim
+        if param_labels is None:
+            param_labels = tuple(f"p{i}" for i in range(ndim))
+        if len(param_labels) != ndim:
+            raise UsageError(
+                f"{len(param_labels)} labels for {ndim} prior specs")
+        self.param_labels = tuple(str(p) for p in param_labels)
+        self.lnpost_batch = lnpost_batch
+        if flow is None:
+            flow = Flow(FlowConfig(ndim=ndim, n_layers=n_layers,
+                                   hidden=hidden, seed=seed))
+        if flow.cfg.ndim != ndim:
+            raise UsageError(
+                f"flow ndim {flow.cfg.ndim} != {ndim} prior specs")
+        self.flow = flow
+        self.vkey = tuple(vkey)
+
+    # -- constructors over the repo's posteriors ----------------------------
+
+    @classmethod
+    def from_bayesian(cls, bt, **flow_kw) -> "AmortizedVI":
+        """From a :class:`~pint_tpu.bayesian.BayesianTiming` — the
+        deduped :meth:`~pint_tpu.bayesian.BayesianTiming.
+        batched_posterior` entry point supplies the traced fn, labels,
+        and prior specs, and the vkey carries the model parameter/mask
+        signature + TOA version (the grid-bundle invalidation
+        discipline)."""
+        from pint_tpu.grid import _model_param_sig
+
+        bp = bt.batched_posterior()
+        vkey = (_model_param_sig(bt.model),
+                getattr(bt.toas, "_version", 0), len(bt.toas))
+        return cls(bp.fn, bp.prior_specs, param_labels=bp.param_labels,
+                   vkey=vkey, **flow_kw)
+
+    @classmethod
+    def from_fitter(cls, ftr, **flow_kw) -> "AmortizedVI":
+        """From an :class:`~pint_tpu.mcmc_fitter.MCMCFitter` (or any
+        fitter exposing ``batched_posterior`` through a BayesianTiming
+        ``bt``)."""
+        bt = getattr(ftr, "bt", None)
+        if bt is None:
+            raise UsageError(
+                f"{type(ftr).__name__} has no BayesianTiming surface; "
+                "build an MCMCFitter (or pass a BayesianTiming to "
+                "from_bayesian)")
+        return cls.from_bayesian(bt, **flow_kw)
+
+    @classmethod
+    def from_joint_likelihood(cls, jl,
+                              log10_A_bounds: Tuple[float, float]
+                              = (-18.0, -12.0),
+                              gamma_bounds: Tuple[float, float]
+                              = (0.0, 7.0),
+                              **flow_kw) -> "AmortizedVI":
+        """From the catalog's :class:`~pint_tpu.catalog.likelihood.
+        JointLikelihood`: the 2-d ``(log10_A, gamma)`` GW-background
+        posterior under uniform box priors.  The jitted joint kernel
+        is traced with the padded per-pulsar data closed over, so the
+        ELBO differentiates through exactly the executable the sampler
+        dispatches."""
+        specs = (("uniform", float(log10_A_bounds[0]),
+                  float(log10_A_bounds[1])),
+                 ("uniform", float(gamma_bounds[0]),
+                  float(gamma_bounds[1])))
+        fn = jl._fn()
+        data = jl._data_args()
+        widths = np.log(float(log10_A_bounds[1])
+                        - float(log10_A_bounds[0])) \
+            + np.log(float(gamma_bounds[1]) - float(gamma_bounds[0]))
+        lnprior = -float(widths)
+
+        def lnpost(points):
+            return fn(points, *data) + lnprior
+
+        return cls(lnpost, specs,
+                   param_labels=("log10_A", "gamma"),
+                   vkey=("joint_lnlike", jl.n_pulsars, jl.n_modes,
+                         jl.pad_shape), **flow_kw)
+
+    # -- the ELBO -----------------------------------------------------------
+
+    @property
+    def ndim(self) -> int:
+        return self.transform.ndim
+
+    def sample_and_logq(self, params, z):
+        """``z (N, ndim)`` base samples -> ``(x, log_q)``: the flow
+        samples in parameter space and their variational log-density
+        (traceable; shared by the ELBO and the serve kernels so the
+        two can never disagree on the density)."""
+        u, logdet = self.flow.forward(params, z)
+        x, logjac = self.transform.constrain(u)
+        return x, self.flow.base_logpdf(z) - logdet - logjac
+
+    def elbo_fn(self) -> Callable:
+        """The traced scalar ELBO: ``(params, z) -> mean(lnpost(x) -
+        log q(x))`` over the reparameterized base batch ``z``."""
+        def elbo(params, z):
+            import jax.numpy as jnp
+
+            x, logq = self.sample_and_logq(params, z)
+            return jnp.mean(self.lnpost_batch(x) - logq)
+
+        return elbo

@@ -1,0 +1,346 @@
+"""Normalizing-flow layers in plain jnp: affine couplings + fixed
+permutations, and the prior-aligned base transform.
+
+The flow maps a standard-normal base through ``n_layers`` RealNVP
+affine couplings (Dinh et al. 2017) into an *unconstrained* space
+``u``, and a fixed :class:`PriorTransform` — built from the same
+``("uniform", lo, hi)`` / ``("normal", mu, sigma)`` specs
+``bayesian.py`` vectorizes priors into — carries ``u`` into the
+parameter space: a sigmoid map into each uniform prior's support, an
+affine map for each normal prior.  Two consequences the ELBO relies
+on:
+
+* every flow sample is strictly inside the prior support, so the
+  lnposterior (and its gradient) is finite at every training sample —
+  no ``-inf`` rejection branch exists to poison Adam;
+* at the identity initialization (coupling nets zero-initialized) the
+  variational distribution IS the prior-transformed standard normal,
+  a sane starting point whatever the posterior.
+
+Each coupling layer conditions on a fixed seeded index subset
+(``perm[:d//2]``) and affinely transforms the complement — the fixed-
+permutation mixing that lets d-dimensional structure reach every
+coordinate after a few layers.  The coupling MLP matmuls route
+through :func:`pint_tpu.precision.matmul` under the ``flow.coupling``
+segment (f64 default; a reduced spec is the policy-driven bf16/f32
+training path), and the log-scale outputs are tanh-clamped so a wild
+training step cannot produce an overflowing ``exp``.
+
+Everything here is traceable plain jnp + host-side configuration;
+there is no framework dependency (no optax/flax — the container
+ships neither).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["FlowConfig", "PriorTransform", "Flow"]
+
+_LOG_2PI = 1.8378770664093453  # log(2*pi)
+
+
+@dataclass(frozen=True)
+class FlowConfig:
+    """Architecture of one flow: dimensionality, depth, width, and the
+    seed the fixed permutations and initialization derive from.  The
+    config (not the weights) is identity material:
+    :meth:`digest` keys warm-pool/AOT executables and the on-disk
+    manifest."""
+
+    ndim: int
+    n_layers: int = 4
+    hidden: int = 32
+    seed: int = 0
+    #: log-scale clamp: coupling s outputs pass through
+    #: ``s_cap * tanh(s / s_cap)`` so exp(s) stays bounded
+    s_cap: float = 4.0
+
+    def __post_init__(self):
+        if self.ndim < 1:
+            raise UsageError(f"FlowConfig.ndim must be >= 1, got "
+                             f"{self.ndim}")
+        if self.n_layers < 0:
+            raise UsageError(f"FlowConfig.n_layers must be >= 0, got "
+                             f"{self.n_layers}")
+        if self.hidden < 1:
+            raise UsageError(f"FlowConfig.hidden must be >= 1, got "
+                             f"{self.hidden}")
+        if self.s_cap <= 0:
+            raise UsageError(f"FlowConfig.s_cap must be > 0, got "
+                             f"{self.s_cap}")
+
+    def to_dict(self) -> dict:
+        return {"ndim": self.ndim, "n_layers": self.n_layers,
+                "hidden": self.hidden, "seed": self.seed,
+                "s_cap": self.s_cap}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FlowConfig":
+        try:
+            return cls(ndim=int(d["ndim"]), n_layers=int(d["n_layers"]),
+                       hidden=int(d["hidden"]), seed=int(d["seed"]),
+                       s_cap=float(d["s_cap"]))
+        except (KeyError, TypeError, ValueError) as e:
+            raise UsageError(f"malformed FlowConfig dict: {e}") from e
+
+    def digest(self) -> str:
+        """Process-stable identity of the architecture."""
+        return hashlib.sha256(json.dumps(
+            self.to_dict(), sort_keys=True).encode()).hexdigest()[:16]
+
+
+class PriorTransform:
+    """The fixed output map aligning the flow with the prior families
+    of :meth:`pint_tpu.models.priors.Prior.jax_spec`.
+
+    Built from a sequence of ``("uniform", lo, hi)`` / ``("normal",
+    mu, sigma)`` specs (one per parameter).  :meth:`constrain` maps an
+    unconstrained point into parameter space (sigmoid into each
+    uniform support, affine for normals) and returns the per-sample
+    log-Jacobian ``log |dx/du|``; :meth:`unconstrain` is the exact
+    inverse, returning ``log |du/dx|`` plus an in-support mask so a
+    log-prob query outside a uniform prior's box reports ``-inf``
+    instead of a clipped lie."""
+
+    def __init__(self, specs: Sequence[tuple]):
+        if not specs:
+            raise UsageError("PriorTransform needs at least one prior "
+                             "spec")
+        is_uniform, a, b = [], [], []
+        for i, spec in enumerate(specs):
+            if spec is None or len(spec) != 3:
+                raise UsageError(
+                    f"prior spec {i} is {spec!r}; expected ('uniform', "
+                    "lo, hi) or ('normal', mu, sigma) — only the "
+                    "vectorizable families bayesian.py jits are "
+                    "flow-compatible")
+            kind, p, q = spec
+            if kind == "uniform":
+                if not float(q) > float(p):
+                    raise UsageError(
+                        f"prior spec {i}: uniform needs hi > lo, got "
+                        f"({p}, {q})")
+                is_uniform.append(True)
+                a.append(float(p))
+                b.append(float(q) - float(p))
+            elif kind == "normal":
+                if not float(q) > 0:
+                    raise UsageError(
+                        f"prior spec {i}: normal needs sigma > 0, got "
+                        f"{q}")
+                is_uniform.append(False)
+                a.append(float(p))
+                b.append(float(q))
+            else:
+                raise UsageError(
+                    f"prior spec {i}: unknown family {kind!r} (known: "
+                    "uniform, normal)")
+        self.specs = tuple(tuple(s) for s in specs)
+        self._is_uniform = np.asarray(is_uniform, dtype=bool)
+        self._a = np.asarray(a, dtype=np.float64)
+        self._b = np.asarray(b, dtype=np.float64)
+        # clamp bounds in the ORIGINAL spec values: for a box narrow
+        # relative to its center, fl(lo + width * sigmoid(u)) can
+        # overshoot hi by an ulp — a clamp keeps the in-support-by-
+        # construction invariant exact (normal dims are unclamped)
+        self._lo = np.where(self._is_uniform, self._a, -np.inf)
+        self._hi = np.where(self._is_uniform,
+                            [float(s[2]) for s in self.specs], np.inf)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._a)
+
+    def digest(self) -> str:
+        """Process-stable identity of the transform: the traced
+        constrain/unconstrain maps bake these bounds in as constants,
+        so anything caching a compiled kernel must key on this."""
+        return hashlib.sha256(repr(self.specs).encode()).hexdigest()[:16]
+
+    def constrain(self, u):
+        """``u (..., ndim)`` -> ``(x, log_jac)`` with ``log_jac`` the
+        per-sample ``sum log |dx_i/du_i|`` (traceable)."""
+        import jax
+        import jax.numpy as jnp
+
+        uni = jnp.asarray(self._is_uniform)
+        a = jnp.asarray(self._a)
+        b = jnp.asarray(self._b)
+        su = jax.nn.sigmoid(u)
+        x = jnp.where(uni, a + b * su, a + b * u)
+        x = jnp.clip(x, jnp.asarray(self._lo), jnp.asarray(self._hi))
+        lj = jnp.where(uni,
+                       jnp.log(b) + jax.nn.log_sigmoid(u)
+                       + jax.nn.log_sigmoid(-u),
+                       jnp.log(b))
+        return x, jnp.sum(lj, axis=-1)
+
+    def unconstrain(self, x):
+        """``x (..., ndim)`` -> ``(u, log_jac_inv, in_support)``:
+        the inverse map, its per-sample ``sum log |du_i/dx_i|``, and a
+        per-sample bool that is False when any uniform coordinate
+        falls outside its support (where the density is exactly zero).
+        The support check is boundary-INCLUSIVE: a flow draw whose
+        sigmoid saturates in f64 lands exactly on the box edge, and
+        reporting the flow's own draw as zero-density would be a
+        rounding artifact, not a measurement — the edge evaluates at
+        the clamp's finite (large) density instead."""
+        import jax.numpy as jnp
+
+        uni = jnp.asarray(self._is_uniform)
+        a = jnp.asarray(self._a)
+        b = jnp.asarray(self._b)
+        p = (x - a) / b
+        inb = jnp.all(jnp.where(uni, (p >= 0.0) & (p <= 1.0), True),
+                      axis=-1)
+        tiny = jnp.finfo(jnp.float64).tiny
+        pc = jnp.clip(p, tiny, 1.0 - 1e-16)
+        u = jnp.where(uni, jnp.log(pc) - jnp.log1p(-pc), p)
+        lj = jnp.where(uni,
+                       -jnp.log(b) - jnp.log(pc) - jnp.log1p(-pc),
+                       -jnp.log(b))
+        return u, jnp.sum(lj, axis=-1), inb
+
+    def to_dict(self) -> dict:
+        return {"specs": [list(s) for s in self.specs]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PriorTransform":
+        try:
+            return cls([tuple(s) for s in d["specs"]])
+        except (KeyError, TypeError) as e:
+            raise UsageError(f"malformed PriorTransform dict: {e}") from e
+
+
+class Flow:
+    """A RealNVP flow: parameters are a plain dict pytree, the
+    forward/inverse maps are traceable methods closing over the static
+    architecture (masks, permutations, precision spec).
+
+    ``spec`` is the resolved ``flow.coupling``
+    :class:`~pint_tpu.precision.SegmentSpec` the coupling MLP matmuls
+    trace under; ``None`` resolves override -> manifest -> the
+    bit-identical f64 default at construction (host-side, once — the
+    traced closures never consult the policy)."""
+
+    def __init__(self, cfg: FlowConfig, spec=None):
+        self.cfg = cfg
+        if spec is None:
+            from pint_tpu.precision import segment_spec
+
+            spec = segment_spec("flow.coupling")
+        self.spec = spec
+        # fixed seeded permutations: layer i conditions on perm[:d//2]
+        # and transforms perm[d//2:].  ndim == 1 admits no coupling
+        # split; the flow is then the learned diagonal affine alone.
+        rng = np.random.default_rng(cfg.seed)
+        d = cfg.ndim
+        self._splits: List[Tuple[np.ndarray, np.ndarray]] = []
+        if d >= 2:
+            for _ in range(cfg.n_layers):
+                perm = rng.permutation(d)
+                self._splits.append((perm[: d // 2].copy(),
+                                     perm[d // 2:].copy()))
+        self._init_rng_state = rng.bit_generator.state
+
+    @property
+    def n_coupling_layers(self) -> int:
+        return len(self._splits)
+
+    @staticmethod
+    def base_logpdf(z):
+        """Standard-normal log-density of the base samples, per
+        sample (a method, not a module function: the traced ELBO and
+        serve kernels reach it through their Flow instance, keeping
+        the module's function surface host-only for the
+        host-call-in-jit lint)."""
+        import jax.numpy as jnp
+
+        return -0.5 * jnp.sum(z * z, axis=-1) \
+            - 0.5 * z.shape[-1] * _LOG_2PI
+
+    # -- parameters ---------------------------------------------------------
+
+    def init(self) -> Dict[str, Any]:
+        """Identity-initialized parameter pytree: the conditioner
+        hidden layer gets small seeded random weights (symmetry
+        breaking), the s/t output layers start at zero — so the
+        freshly built flow is exactly the base distribution."""
+        rng = np.random.default_rng()
+        rng.bit_generator.state = self._init_rng_state
+        cfg = self.cfg
+        layers = []
+        for idx_a, idx_b in self._splits:
+            d_in, d_out = len(idx_a), len(idx_b)
+            layers.append({
+                "W1": rng.normal(size=(d_in, cfg.hidden))
+                / np.sqrt(max(d_in, 1)),
+                "b1": np.zeros(cfg.hidden),
+                "Ws": np.zeros((cfg.hidden, d_out)),
+                "bs": np.zeros(d_out),
+                "Wt": np.zeros((cfg.hidden, d_out)),
+                "bt": np.zeros(d_out),
+            })
+        return {"layers": layers,
+                "loc": np.zeros(cfg.ndim),
+                "log_scale": np.zeros(cfg.ndim)}
+
+    # -- traced maps --------------------------------------------------------
+
+    def _net(self, layer, h_in):
+        """The coupling conditioner: one tanh hidden layer -> (s, t),
+        with s tanh-clamped at ``s_cap``.  Matmuls route through the
+        ``flow.coupling`` precision segment."""
+        import jax.numpy as jnp
+
+        from pint_tpu.precision import matmul as _pmatmul
+
+        h = jnp.tanh(_pmatmul(h_in, layer["W1"], self.spec)
+                     + layer["b1"])
+        s_raw = _pmatmul(h, layer["Ws"], self.spec) + layer["bs"]
+        t = _pmatmul(h, layer["Wt"], self.spec) + layer["bt"]
+        cap = self.cfg.s_cap
+        return cap * jnp.tanh(s_raw / cap), t
+
+    def forward(self, params, z):
+        """Base -> unconstrained: ``z (..., ndim)`` -> ``(u, logdet)``
+        with ``logdet = log |du/dz|`` per sample (traceable)."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(z)
+        logdet = jnp.zeros(x.shape[:-1])
+        for layer, (idx_a, idx_b) in zip(params["layers"], self._splits):
+            xa = x[..., idx_a]
+            s, t = self._net(layer, xa)
+            yb = x[..., idx_b] * jnp.exp(s) + t
+            x = x.at[..., idx_b].set(yb)
+            logdet = logdet + jnp.sum(s, axis=-1)
+        scale = jnp.exp(params["log_scale"])
+        u = params["loc"] + scale * x
+        return u, logdet + jnp.sum(params["log_scale"])
+
+    def inverse(self, params, u):
+        """Unconstrained -> base: ``u (..., ndim)`` -> ``(z,
+        logdet_inv)`` with ``logdet_inv = log |dz/du|`` (traceable;
+        exact inverse of :meth:`forward`)."""
+        import jax.numpy as jnp
+
+        x = (jnp.asarray(u) - params["loc"]) \
+            * jnp.exp(-params["log_scale"])
+        logdet = jnp.zeros(x.shape[:-1]) - jnp.sum(params["log_scale"])
+        for layer, (idx_a, idx_b) in zip(reversed(params["layers"]),
+                                         reversed(self._splits)):
+            xa = x[..., idx_a]
+            s, t = self._net(layer, xa)
+            xb = (x[..., idx_b] - t) * jnp.exp(-s)
+            x = x.at[..., idx_b].set(xb)
+            logdet = logdet - jnp.sum(s, axis=-1)
+        return x, logdet

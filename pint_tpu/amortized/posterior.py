@@ -1,0 +1,327 @@
+"""The trained flow as a posterior: draw + log-prob serve kernels.
+
+:class:`AmortizedPosterior` holds a trained flow (architecture +
+weights + prior transform + provenance) and exposes the two serve
+kernels the warm layer registers:
+
+* **draw** — ``(params, keys (batch, 2)) -> (batch, n, ndim)``: each
+  coalesced request samples from its OWN fold of the service key
+  (requests never share a PRNG key), the draw count ``n`` is static
+  per executable (bucketed by the service's draw ladder);
+* **log_prob** — ``(params, points (batch, n, ndim)) -> (batch, n)``:
+  the exact flow density via the analytic coupling inverse; points
+  outside a uniform prior's support report ``-inf`` (zero density),
+  and padded query rows are sliced away by the caller.
+
+Both kernels live in module-level jit registries keyed by
+``(flow digest, precision key, shape)`` — the serving discipline: one
+executable per shape family process-wide, warmable into a
+:class:`~pint_tpu.serving.warmup.WarmPool` and persistable through
+the :class:`~pint_tpu.serving.aotcache.AOTCache` under
+:meth:`AmortizedPosterior.serve_vkey` (flow config digest + precision
+key + the training posterior's vkey + the established
+device-fingerprint scheme downstream).
+
+:meth:`AmortizedPosterior.save` / :meth:`load` persist the trained
+flow with the aotcache manifest discipline: an npz of weight leaves
+next to a JSON sidecar of identity material, verified FIELD BY FIELD
+on load — any mismatch or corruption raises the typed
+:class:`~pint_tpu.exceptions.CheckpointError` rather than serving a
+wrong posterior.  **No saved flow and no registration means no new
+executables exist** — the default service path is byte-identical to
+the pre-amortized layer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pint_tpu.amortized.elbo import AmortizedVI
+from pint_tpu.amortized.flows import Flow, FlowConfig, PriorTransform
+from pint_tpu.exceptions import CheckpointError, UsageError
+
+__all__ = ["AmortizedPosterior", "FLOW_MANIFEST_SCHEMA"]
+
+FLOW_MANIFEST_SCHEMA = "pint_tpu.amortized.flow/1"
+
+#: module-level serve-kernel registries: one jitted executable per
+#: (flow digest, precision key, static shape) process-wide — repeat
+#: endpoints retrace into the warm dispatch cache, never a new program
+_DRAW_JIT: Dict[tuple, Any] = {}
+_LOGPROB_JIT: Dict[tuple, Any] = {}
+
+
+class AmortizedPosterior:
+    """A trained flow posterior: host conveniences + serve kernels."""
+
+    def __init__(self, flow: Flow, transform: PriorTransform, params,
+                 param_labels: Sequence[str], vkey: tuple = (),
+                 _vkey_repr: Optional[str] = None):
+        if flow.cfg.ndim != transform.ndim:
+            raise UsageError(
+                f"flow ndim {flow.cfg.ndim} != transform ndim "
+                f"{transform.ndim}")
+        if len(param_labels) != flow.cfg.ndim:
+            raise UsageError(
+                f"{len(param_labels)} labels for ndim {flow.cfg.ndim}")
+        self.flow = flow
+        self.transform = transform
+        self.params = params
+        self.param_labels = tuple(str(p) for p in param_labels)
+        self.vkey = tuple(vkey)
+        # identity string for serve_vkey: a LOADED posterior carries
+        # the sidecar's stored repr verbatim, so train-process and
+        # load-process executables share one AOT-cache identity
+        self._vkey_repr = _vkey_repr if _vkey_repr is not None \
+            else repr(self.vkey)
+
+    @classmethod
+    def from_training(cls, vi: AmortizedVI, result) -> "AmortizedPosterior":
+        """Bundle a finished :func:`~pint_tpu.amortized.train.
+        train_flow` run into a servable posterior."""
+        return cls(flow=vi.flow, transform=vi.transform,
+                   params=result.params, param_labels=vi.param_labels,
+                   vkey=vi.vkey)
+
+    @property
+    def ndim(self) -> int:
+        return self.flow.cfg.ndim
+
+    def serve_vkey(self) -> tuple:
+        """AOT-cache / warm-pool version key for this posterior's
+        executables: kernel schema + flow architecture digest + prior
+        transform digest + precision key + the training posterior's
+        identity — an edited model, re-validated TOA set, retrained
+        architecture, moved prior box, or precision flip can never
+        replay a stale export."""
+        return ("amortized_posterior", 1, self.flow.cfg.digest(),
+                self.transform.digest(), self.flow.spec.key(),
+                self._vkey_repr)
+
+    def ident(self) -> str:
+        """Short executable-name identity: everything the traced
+        kernels bake in as constants (architecture, prior transform,
+        precision, training-posterior vkey).  The serving door folds
+        this into executable names, so a pool/registry entry compiled
+        for one posterior can never be replayed for another that
+        merely shares shapes."""
+        return hashlib.sha256(repr(self.serve_vkey()).encode()
+                              ).hexdigest()[:12]
+
+    # -- serve kernels ------------------------------------------------------
+
+    def _registry_key(self, n: int) -> tuple:
+        # the kernels close over the flow architecture, the precision
+        # spec, AND the prior transform — all of it keys the cache
+        # (same-shape posteriors with different boxes must never share
+        # a compiled kernel)
+        return (self.flow.cfg.digest(), self.transform.digest(),
+                self.flow.spec.key(), int(n))
+
+    def draw_kernel(self, n: int):
+        """The batched draw executable for ``n`` static draws:
+        ``(params, keys (batch, 2) uint32) -> (batch, n, ndim)`` —
+        one flow sample stream per key row."""
+        if n < 1:
+            raise UsageError(f"draw count must be >= 1, got {n}")
+        key = self._registry_key(n)
+        fn = _DRAW_JIT.get(key)
+        if fn is None:
+            import jax
+
+            flow, transform, ndim = self.flow, self.transform, self.ndim
+
+            def one(params, k):
+                z = jax.random.normal(k, (n, ndim), dtype=np.float64)
+                u, _ = flow.forward(params, z)
+                x, _ = transform.constrain(u)
+                return x
+
+            fn = jax.jit(jax.vmap(one, in_axes=(None, 0)))
+            _DRAW_JIT[key] = fn
+        return fn
+
+    def logprob_kernel(self, n: int):
+        """The batched log-prob executable for ``n`` static query
+        points: ``(params, points (batch, n, ndim)) -> (batch, n)``."""
+        if n < 1:
+            raise UsageError(f"query count must be >= 1, got {n}")
+        key = self._registry_key(n)
+        fn = _LOGPROB_JIT.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            flow, transform = self.flow, self.transform
+
+            def one(params, pts):
+                u, lj_inv, inb = transform.unconstrain(pts)
+                z, ld_inv = flow.inverse(params, u)
+                logq = flow.base_logpdf(z) + ld_inv + lj_inv
+                return jnp.where(inb, logq, -jnp.inf)
+
+            fn = jax.jit(jax.vmap(one, in_axes=(None, 0)))
+            _LOGPROB_JIT[key] = fn
+        return fn
+
+    # -- host conveniences --------------------------------------------------
+
+    def draw(self, n: int, seed: int = 0) -> np.ndarray:
+        """``(n, ndim)`` posterior draws (host convenience around the
+        serve kernel; the service door owns key discipline for
+        coalesced requests)."""
+        import jax
+
+        keys = jax.random.PRNGKey(int(seed))[None, :]
+        return np.asarray(self.draw_kernel(int(n))(self.params,
+                                                   keys))[0]
+
+    def log_prob(self, points) -> np.ndarray:
+        """``(n,)`` flow log-densities at ``points (n, ndim)``."""
+        pts = np.atleast_2d(np.asarray(points, dtype=np.float64))
+        if pts.shape[-1] != self.ndim:
+            raise UsageError(
+                f"points are (n, {self.ndim}); got {pts.shape}")
+        return np.asarray(self.logprob_kernel(pts.shape[0])(
+            self.params, pts[None, ...]))[0]
+
+    # -- persistence (the aotcache manifest discipline) ---------------------
+
+    def _manifest(self, leaf_names: List[str],
+                  weights_sha256: str) -> dict:
+        return {
+            "schema": FLOW_MANIFEST_SCHEMA,
+            "config": self.flow.cfg.to_dict(),
+            "transform": self.transform.to_dict(),
+            "param_labels": list(self.param_labels),
+            "vkey": self._vkey_repr,
+            "spec_key": list(self.flow.spec.key()),
+            "leaves": leaf_names,
+            "weights_sha256": weights_sha256,
+        }
+
+    def save(self, path: str) -> str:
+        """Persist the trained flow: ``<path>.npz`` (weight leaves) +
+        ``<path>.json`` (identity sidecar).  Each file replaces
+        atomically, and the sidecar carries the weight file's sha256 —
+        a crash between the two replaces leaves a pair the load-time
+        digest check refuses, never a silently mismatched
+        weights/identity combination."""
+        import jax
+
+        leaves, _ = jax.tree_util.tree_flatten(self.params)
+        names = [f"leaf_{i:03d}" for i in range(len(leaves))]
+        arrays = {nm: np.asarray(lf) for nm, lf in zip(names, leaves)}
+        npz, sidecar = path + ".npz", path + ".json"
+        tmp = npz + ".tmp.npz"
+        np.savez(tmp, **arrays)
+        with open(tmp, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        os.replace(tmp, npz)
+        tmp = sidecar + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._manifest(names, digest), f, sort_keys=True)
+        os.replace(tmp, sidecar)
+        return npz
+
+    @classmethod
+    def load(cls, path: str, expect_vkey: Optional[tuple] = None
+             ) -> "AmortizedPosterior":
+        """Load a saved flow, verifying the sidecar FIELD BY FIELD
+        against the weights file; any mismatch, truncation, or — when
+        ``expect_vkey`` is given — identity drift raises the typed
+        :class:`~pint_tpu.exceptions.CheckpointError` (a wrong
+        posterior must never be served)."""
+        npz, sidecar = path + ".npz", path + ".json"
+        try:
+            with open(sidecar, encoding="utf-8") as f:
+                man = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointError(
+                f"{sidecar}: unreadable/invalid flow sidecar ({e})") \
+                from e
+        if man.get("schema") != FLOW_MANIFEST_SCHEMA:
+            raise CheckpointError(
+                f"{sidecar}: schema {man.get('schema')!r} != "
+                f"{FLOW_MANIFEST_SCHEMA!r}")
+        for key in ("config", "transform", "param_labels", "vkey",
+                    "spec_key", "leaves", "weights_sha256"):
+            if key not in man:
+                raise CheckpointError(f"{sidecar}: missing field "
+                                      f"{key!r}")
+        cfg = FlowConfig.from_dict(man["config"])
+        transform = PriorTransform.from_dict(man["transform"])
+        labels = [str(p) for p in man["param_labels"]]
+        if expect_vkey is not None and man["vkey"] != repr(
+                tuple(expect_vkey)):
+            raise CheckpointError(
+                f"{sidecar}: flow was trained for vkey {man['vkey']}, "
+                f"caller expects {tuple(expect_vkey)!r} — a stale or "
+                "foreign flow must not serve this workload")
+        # the npz/sidecar pair replaces in two steps: the digest check
+        # refuses a crash-window pairing of new weights with a stale
+        # sidecar whose leaf shapes happen to match
+        try:
+            with open(npz, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()
+        except OSError as e:
+            raise CheckpointError(
+                f"{npz}: unreadable flow weights ({e})") from e
+        if digest != man["weights_sha256"]:
+            raise CheckpointError(
+                f"{npz}: weight digest {digest[:12]} does not match "
+                f"the sidecar's {str(man['weights_sha256'])[:12]} — "
+                "torn save or foreign weights; refusing to serve a "
+                "mismatched posterior")
+        try:
+            with np.load(npz, allow_pickle=False) as d:
+                arrays = {k: d[k] for k in d.files}
+        except (OSError, ValueError) as e:
+            raise CheckpointError(
+                f"{npz}: unreadable flow weights ({e})") from e
+        if sorted(arrays) != sorted(man["leaves"]):
+            raise CheckpointError(
+                f"{npz}: weight leaves {sorted(arrays)} do not match "
+                f"the sidecar's {sorted(man['leaves'])}")
+        # rebuild the pytree from the architecture's own structure so
+        # a leaf-count drift (truncated npz, foreign architecture)
+        # fails loudly here, not at the first dispatch
+        import jax
+
+        from pint_tpu.precision import SegmentSpec
+
+        # ALWAYS pin the sidecar's stored spec (the f64 default
+        # included): spec=None would re-resolve the ambient
+        # policy/manifest, and a reduced resolution would serve a
+        # different-precision posterior than the one verified above
+        spec_key = tuple(man["spec_key"])
+        try:
+            spec = SegmentSpec(segment="flow.coupling",
+                               compute_dtype=str(spec_key[0]),
+                               accumulation=str(spec_key[1]))
+        except (IndexError, UsageError) as e:
+            raise CheckpointError(
+                f"{sidecar}: malformed spec_key {spec_key!r} ({e})") \
+                from e
+        flow = Flow(cfg, spec=spec)
+        template = flow.init()
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        if len(leaves) != len(man["leaves"]):
+            raise CheckpointError(
+                f"{npz}: {len(man['leaves'])} stored leaves for an "
+                f"architecture with {len(leaves)}")
+        loaded = [arrays[nm] for nm in man["leaves"]]
+        for tpl, got, nm in zip(leaves, loaded, man["leaves"]):
+            if np.shape(tpl) != np.shape(got):
+                raise CheckpointError(
+                    f"{npz}: leaf {nm} has shape {np.shape(got)}, "
+                    f"architecture expects {np.shape(tpl)}")
+        params = jax.tree_util.tree_unflatten(treedef, loaded)
+        return cls(flow=flow, transform=transform, params=params,
+                   param_labels=labels,
+                   _vkey_repr=str(man["vkey"]))

@@ -1,0 +1,272 @@
+"""Flow training: a host-side Adam driver around one jitted step.
+
+The whole optimization is ONE jitted ``value_and_grad`` step (loss +
+Adam moment update + parameter update fused into a single executable)
+driven by a host loop that owns the PRNG chain, telemetry, and
+checkpointing:
+
+* **determinism** — the base-sample key chain derives from
+  ``TrainConfig.seed`` alone (``jax.random.split`` per step), so a
+  fixed seed reproduces the ELBO trace bitwise on the same backend
+  (pinned by tests);
+* **checkpoint/resume** — steps are grouped into chunks persisted
+  through :class:`~pint_tpu.runtime.checkpoint.SweepCheckpoint`
+  (atomic writes, fingerprint-guarded): a crashed run resumes from
+  the last completed chunk and — because the PRNG state rides in the
+  chunk — continues bit-identically to an uninterrupted run;
+* **sharding** — the MC sample axis is walker-shaped data
+  parallelism: under a ``walker`` execution plan
+  (``plan="auto"`` routes through
+  :func:`~pint_tpu.runtime.plan.select_plan`) each step's base batch
+  is placed over the mesh's first axis and the jitted step runs SPMD;
+* **telemetry** — a ``flow_train`` event (step, elbo, lr) every
+  ``log_every`` steps, validated by ``tools/telemetry_report
+  --check``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+import numpy as np
+
+from pint_tpu.amortized.elbo import AmortizedVI
+from pint_tpu.exceptions import UsageError
+from pint_tpu.logging import log
+
+__all__ = ["TrainConfig", "TrainResult", "train_flow"]
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Adam schedule + sample budget for one training run."""
+
+    steps: int = 300
+    n_samples: int = 64        #: MC samples per ELBO estimate
+    lr: float = 1e-2
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    seed: int = 0
+    #: steps per persisted checkpoint chunk
+    checkpoint_chunk: int = 50
+    #: flow_train telemetry cadence (steps)
+    log_every: int = 25
+
+    def __post_init__(self):
+        if self.steps < 1:
+            raise UsageError(f"steps must be >= 1, got {self.steps}")
+        if self.n_samples < 1:
+            raise UsageError(
+                f"n_samples must be >= 1, got {self.n_samples}")
+        if self.lr <= 0:
+            raise UsageError(f"lr must be > 0, got {self.lr}")
+        if self.checkpoint_chunk < 1:
+            raise UsageError(f"checkpoint_chunk must be >= 1, got "
+                             f"{self.checkpoint_chunk}")
+
+    def to_dict(self) -> dict:
+        return {"steps": self.steps, "n_samples": self.n_samples,
+                "lr": self.lr, "beta1": self.beta1, "beta2": self.beta2,
+                "eps": self.eps, "seed": self.seed,
+                "checkpoint_chunk": self.checkpoint_chunk}
+
+
+@dataclass
+class TrainResult:
+    """Outcome of one (possibly resumed) training run."""
+
+    params: Any                      #: trained flow parameter pytree
+    elbo_trace: np.ndarray           #: (steps,) per-step ELBO estimates
+    steps: int
+    resumed_steps: int = 0           #: steps replayed from a checkpoint
+    config: Optional[TrainConfig] = None
+
+    @property
+    def elbo_final(self) -> float:
+        return float(self.elbo_trace[-1])
+
+
+def _adam_step_fn(vi: AmortizedVI, cfg: TrainConfig):
+    """Build the ONE jitted training step: ``(params, m, v, t, z) ->
+    (params, m, v, t, elbo)`` — loss, gradient, and the Adam update
+    fused into a single executable."""
+    import jax
+    import jax.numpy as jnp
+
+    elbo = vi.elbo_fn()
+    b1, b2, lr, eps = cfg.beta1, cfg.beta2, cfg.lr, cfg.eps
+
+    def step(params, m, v, t, z):
+        loss, g = jax.value_and_grad(
+            lambda p: -elbo(p, z))(params)
+        t = t + 1
+        m = jax.tree_util.tree_map(
+            lambda mi, gi: b1 * mi + (1.0 - b1) * gi, m, g)
+        v = jax.tree_util.tree_map(
+            lambda vi_, gi: b2 * vi_ + (1.0 - b2) * gi * gi, v, g)
+        c1 = 1.0 - b1 ** t
+        c2 = 1.0 - b2 ** t
+        params = jax.tree_util.tree_map(
+            lambda p, mi, vi_: p - lr * (mi / c1)
+            / (jnp.sqrt(vi_ / c2) + eps), params, m, v)
+        return params, m, v, t, -loss
+
+    return jax.jit(step)
+
+
+def _resolve_plan(plan):
+    if plan is None:
+        return None
+    if isinstance(plan, str):
+        if plan != "auto":
+            raise UsageError(f"plan={plan!r}: pass 'auto' or an "
+                             "ExecutionPlan")
+        from pint_tpu.runtime.plan import select_plan
+
+        return select_plan("walker")
+    return plan
+
+
+def _emit_train_event(step: int, elbo: float, lr: float) -> None:
+    from pint_tpu import config as _config
+
+    if _config._telemetry_mode == "off":
+        return
+    if not math.isfinite(elbo):
+        # the flow_train contract requires a finite numeric ELBO (the
+        # strict-JSON runlog would stringify a nan/inf and --check
+        # would then reject the record); divergence is already a loud
+        # host-side warning, not an event
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event("flow_train", step=int(step),
+                              elbo=float(elbo), lr=float(lr))
+
+
+def _state_arrays(params, m, v, t, key, elbos: List[float]) -> dict:
+    """Flatten the training state into the named numpy arrays one
+    checkpoint chunk persists (leaf order is the pytree flatten order,
+    stable for a fixed flow architecture)."""
+    import jax
+
+    out = {"t": np.asarray(int(t)), "key": np.asarray(key),
+           "elbos": np.asarray(elbos, dtype=np.float64)}
+    for tag, tree in (("p", params), ("m", m), ("v", v)):
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(tree)):
+            out[f"{tag}_{i:03d}"] = np.asarray(leaf)
+    return out
+
+
+def _state_from_arrays(d: dict, treedef) -> tuple:
+    import jax
+
+    def leaves(tag):
+        keys = sorted(k for k in d if k.startswith(f"{tag}_"))
+        return [d[k] for k in keys]
+
+    params = jax.tree_util.tree_unflatten(treedef, leaves("p"))
+    m = jax.tree_util.tree_unflatten(treedef, leaves("m"))
+    v = jax.tree_util.tree_unflatten(treedef, leaves("v"))
+    return params, m, v, int(d["t"]), d["key"], list(d["elbos"])
+
+
+def train_flow(vi: AmortizedVI, cfg: Optional[TrainConfig] = None,
+               checkpoint: Optional[str] = None,
+               plan=None) -> TrainResult:
+    """Train ``vi``'s flow by maximizing the reparameterized ELBO.
+
+    ``checkpoint`` names a directory: completed chunks
+    (``cfg.checkpoint_chunk`` steps each) persist there and a crashed
+    run resumes bit-identically (the chunk carries the PRNG state).
+    The checkpoint fingerprint binds the flow architecture, the
+    training schedule, and the posterior's vkey — resuming a different
+    problem raises :class:`~pint_tpu.exceptions.CheckpointError`
+    instead of silently mixing optimizations.
+
+    ``plan`` (``"auto"`` or a ``walker``
+    :class:`~pint_tpu.runtime.plan.ExecutionPlan`) shards each step's
+    base-sample batch over the mesh's first axis; the sample count is
+    padded up to a shard multiple once, at entry."""
+    import jax
+
+    cfg = cfg or TrainConfig()
+    plan = _resolve_plan(plan)
+    n = cfg.n_samples
+    sharding = None
+    if plan is not None and plan.mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # the MC sample axis is walker-shaped: under a 2-axis
+        # ('pulsar', 'walker') catalog plan the samples shard over
+        # 'walker' (the data side owns 'pulsar')
+        axis = "walker" if "walker" in plan.axes else plan.axes[0]
+        shards = int(plan.mesh.shape[axis])
+        n = n + ((-n) % shards)
+        sharding = NamedSharding(plan.mesh, P(axis))
+        if n != cfg.n_samples:
+            log.info(f"train_flow: n_samples {cfg.n_samples} padded to "
+                     f"{n} ({shards} shards)")
+
+    step_fn = _adam_step_fn(vi, cfg)
+    params = vi.flow.init()
+    treedef = jax.tree_util.tree_structure(params)
+    m = jax.tree_util.tree_map(np.zeros_like, params)
+    v = jax.tree_util.tree_map(np.zeros_like, params)
+    t = 0
+    key = jax.random.PRNGKey(cfg.seed)
+    elbos: List[float] = []
+
+    ckpt = None
+    nchunks = -(-cfg.steps // cfg.checkpoint_chunk)
+    if checkpoint is not None:
+        from pint_tpu.runtime.checkpoint import (SweepCheckpoint,
+                                                 fingerprint_of)
+
+        fp = fingerprint_of(flow=vi.flow.cfg.to_dict(),
+                            specs=repr(vi.transform.specs),
+                            labels=vi.param_labels,
+                            train=cfg.to_dict(), n_padded=n,
+                            vkey=repr(vi.vkey))
+        ckpt = SweepCheckpoint(checkpoint, fp, nchunks,
+                               sidecar={"what": "flow_train"})
+
+    resumed = 0
+    last_logged = -1
+    for i in range(nchunks):
+        lo = i * cfg.checkpoint_chunk
+        hi = min(cfg.steps, lo + cfg.checkpoint_chunk)
+        if ckpt is not None and ckpt.has(i):
+            params, m, v, t, key, chunk_elbos = _state_from_arrays(
+                ckpt.load(i), treedef)
+            elbos.extend(chunk_elbos)
+            resumed += hi - lo
+            continue
+        for step in range(lo, hi):
+            key, sub = jax.random.split(key)
+            z = jax.random.normal(sub, (n, vi.ndim), dtype=np.float64)
+            if sharding is not None:
+                z = jax.device_put(z, sharding)
+            params, m, v, t, elbo = step_fn(params, m, v, t, z)
+            elbos.append(float(elbo))
+            if cfg.log_every and (step + 1) % cfg.log_every == 0:
+                _emit_train_event(step + 1, elbos[-1], cfg.lr)
+                last_logged = step + 1
+        if ckpt is not None:
+            ckpt.save(i, **_state_arrays(
+                params, m, v, t, np.asarray(key),
+                elbos[lo:hi]))
+    if resumed:
+        log.info(f"train_flow: resumed {resumed}/{cfg.steps} steps from "
+                 f"{checkpoint}")
+    trace = np.asarray(elbos, dtype=np.float64)
+    if not np.isfinite(trace[-1]):
+        log.warning(f"train_flow: final ELBO is {trace[-1]} — the flow "
+                    "did not converge to a usable posterior")
+    if last_logged != cfg.steps:
+        _emit_train_event(cfg.steps, float(trace[-1]), cfg.lr)
+    return TrainResult(params=params, elbo_trace=trace, steps=cfg.steps,
+                       resumed_steps=resumed, config=cfg)

@@ -12,7 +12,7 @@ per walker -> vmapped ensemble on device).
 from __future__ import annotations
 
 import copy
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -20,7 +20,33 @@ from pint_tpu.logging import log
 from pint_tpu.models.priors import Prior
 from pint_tpu.residuals import Residuals
 
-__all__ = ["BayesianTiming", "apply_prior_info"]
+__all__ = ["BatchedPosterior", "BayesianTiming", "apply_prior_info"]
+
+
+class BatchedPosterior(NamedTuple):
+    """The ONE typed lnposterior entry point the vectorized consumers
+    share: the jit-able batched evaluation plus the identity material
+    (parameter labels, prior specs) a consumer needs to draw or
+    transform points.
+
+    ``fn`` maps a ``(N, ndim)`` array of parameter points to ``(N,)``
+    log-posteriors and is jax-traceable (vmapped over the compiled
+    phase evaluation; differentiable — the amortized ELBO takes
+    ``value_and_grad`` through it).  Built by
+    :meth:`BayesianTiming.batched_posterior`, consumed by
+    :meth:`BayesianTiming.lnposterior_batch` (and through it the MCMC
+    fitter's ensemble sampling) and by
+    :class:`pint_tpu.amortized.elbo.AmortizedVI` — one construction,
+    so prior/likelihood wrapping cannot drift between the samplers and
+    the flow head."""
+
+    fn: Callable                    #: (N, ndim) -> (N,) traceable
+    param_labels: Tuple[str, ...]   #: free-parameter names, in order
+    prior_specs: Tuple[tuple, ...]  #: per-param Prior.jax_spec() tuples
+
+    @property
+    def ndim(self) -> int:
+        return len(self.param_labels)
 
 
 def apply_prior_info(model, prior_info: Dict[str, dict]):
@@ -117,6 +143,26 @@ class BayesianTiming:
             return False
         return all(p.prior.jax_spec() is not None for p in self.params)
 
+    def batched_posterior(self) -> BatchedPosterior:
+        """The typed batched-lnposterior entry point (see
+        :class:`BatchedPosterior`); raises the typed
+        :class:`~pint_tpu.exceptions.UsageError` when this posterior
+        cannot be vectorized (free noise parameters, or a prior family
+        outside the uniform/normal pair the trace bakes in)."""
+        if not self._can_vectorize():
+            from pint_tpu.exceptions import UsageError
+
+            raise UsageError(
+                "this posterior cannot be vectorized: free noise "
+                "parameters or non-jax-spec priors present (the host "
+                "scalar lnposterior path still works)")
+        if self._batch_fn is None:
+            self._batch_fn = self._build_batch_fn()
+        return BatchedPosterior(
+            fn=self._batch_fn,
+            param_labels=tuple(self.param_labels),
+            prior_specs=tuple(p.prior.jax_spec() for p in self.params))
+
     def _build_batch_fn(self):
         import jax
         import jax.numpy as jnp
@@ -197,17 +243,16 @@ class BayesianTiming:
             # input sharding (SPMD) — the documented ~1e-7-cycle fused-jit
             # dd relaxation applies (measured 0 on CPU,
             # tests/test_fused_relaxation.py)
-            if self._batch_fn is None:
-                self._batch_fn = self._build_batch_fn()
             if self._batch_fn_jit is None:
                 # jit the SAME built graph the host path uses (one source
-                # of truth; event_fitter.lnposterior_batch mirrors this)
-                self._batch_fn_jit = jax.jit(self._batch_fn)
+                # of truth — batched_posterior(); event_fitter.
+                # lnposterior_batch mirrors this)
+                self._batch_fn_jit = jax.jit(self.batched_posterior().fn)
             return np.asarray(self._batch_fn_jit(points))
         points = np.atleast_2d(np.asarray(points, dtype=np.float64))
         if self._batch_fn is None:
             if self._can_vectorize():
-                self._batch_fn = self._build_batch_fn()
+                self._batch_fn = self.batched_posterior().fn
             else:
                 log.info("lnposterior_batch: free noise params or non-jax "
                          "priors present; falling back to the host loop")

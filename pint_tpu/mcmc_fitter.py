@@ -181,6 +181,15 @@ class MCMCFitter(Fitter):
         return np.array([float(getattr(self.model, p).uncertainty or 0.0)
                          for p in self.fitkeys])
 
+    def batched_posterior(self):
+        """The typed batched-lnposterior entry point
+        (:class:`pint_tpu.bayesian.BatchedPosterior`) — the SAME
+        construction the ensemble sampling below evaluates, exposed so
+        the amortized engine (:class:`pint_tpu.amortized.elbo.
+        AmortizedVI`) trains its flow against exactly the posterior
+        this fitter samples."""
+        return self.bt.batched_posterior()
+
     def lnposterior(self, theta) -> float:
         if self._custom_post:
             lp = self.lnprior(self, theta)

@@ -110,6 +110,13 @@ SEGMENTS: Dict[str, SegmentDef] = {s.name: s for s in (
                "joint Hellings-Downs lnlikelihood Gram/projection "
                "products (catalog/likelihood)",
                safe_rel=1e-9, forced_budget=1e-3),
+    SegmentDef("flow.coupling",
+               "the amortized-inference flow's coupling-MLP matmuls "
+               "(amortized/flows; ELBO training and the draw/log-prob "
+               "serve kernels trace the same segment — no per-workload "
+               "probe exists, the decision is owned by the training "
+               "run's policy/manifest)",
+               safe_rel=1e-9, forced_budget=1e-2),
 )}
 
 

@@ -26,7 +26,12 @@ AOT persistence"):
 from pint_tpu.serving import aotcache, batcher, service, warmup
 from pint_tpu.serving.aotcache import AOTCache, cache, device_fingerprint
 from pint_tpu.serving.batcher import FitRequest, FitResult, ShapeBatcher
-from pint_tpu.serving.service import ServeConfig, TimingService
+from pint_tpu.serving.service import (
+    PosteriorRequest,
+    PosteriorResult,
+    ServeConfig,
+    TimingService,
+)
 from pint_tpu.serving.warmup import (
     WarmPool,
     WarmupReport,
@@ -38,6 +43,7 @@ from pint_tpu.serving.warmup import (
 __all__ = ["aotcache", "warmup", "batcher", "service",
            "AOTCache", "cache", "device_fingerprint",
            "FitRequest", "FitResult", "ShapeBatcher",
+           "PosteriorRequest", "PosteriorResult",
            "ServeConfig", "TimingService",
            "WarmPool", "WarmupReport", "warm_buckets", "warm_catalog",
            "warm_fitter"]

@@ -29,7 +29,9 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from pint_tpu import config
 from pint_tpu.exceptions import UsageError
@@ -40,13 +42,19 @@ from pint_tpu.serving.batcher import (
     FitRequest,
     FitResult,
     ShapeBatcher,
+    bucket_of,
 )
 from pint_tpu.serving.warmup import WarmPool, WarmupReport, warm_buckets
 
-__all__ = ["ServeConfig", "TimingService"]
+__all__ = ["ServeConfig", "TimingService", "PosteriorRequest",
+           "PosteriorResult", "DEFAULT_DRAW_BUCKETS"]
 
 #: bounded latency ring: enough for honest p99 without unbounded growth
 _LATENCY_RING = 4096
+
+#: draw/query-count ladder for the posterior door (draws per request
+#: round up; B1855-class "give me a corner plot" requests land at 4096)
+DEFAULT_DRAW_BUCKETS = (64, 256, 1024, 4096)
 
 
 @dataclass
@@ -59,6 +67,62 @@ class ServeConfig:
     #: how long the async door holds a request hoping for bucket-mates
     window_ms: float = 2.0
     max_queue: int = 1024
+    #: posterior-door draw/query-count ladder (amortized engine)
+    draw_buckets: Tuple[int, ...] = DEFAULT_DRAW_BUCKETS
+
+
+@dataclass
+class PosteriorRequest:
+    """One posterior query for the amortized engine's door: EITHER
+    ``n_draws`` samples from the flow posterior OR the flow
+    log-density at ``points (n, ndim)`` — exactly one of the two."""
+
+    n_draws: int = 0
+    points: Optional[np.ndarray] = None
+    request_id: Optional[str] = None
+
+    def __post_init__(self):
+        if (self.n_draws > 0) == (self.points is not None):
+            raise UsageError(
+                "PosteriorRequest takes n_draws > 0 XOR points "
+                f"(got n_draws={self.n_draws}, points="
+                f"{'set' if self.points is not None else 'None'})")
+        if self.points is not None:
+            self.points = np.atleast_2d(
+                np.asarray(self.points, dtype=np.float64))
+
+    @property
+    def kind(self) -> str:
+        return "draw" if self.n_draws > 0 else "logprob"
+
+    @property
+    def n(self) -> int:
+        return int(self.n_draws) if self.n_draws > 0 \
+            else int(self.points.shape[0])
+
+
+@dataclass
+class PosteriorResult:
+    """Unpadded outcome of one posterior request."""
+
+    kind: str                         #: draw | logprob
+    draws: Optional[np.ndarray] = None       #: (n_draws, ndim)
+    log_probs: Optional[np.ndarray] = None   #: (n_points,)
+    bucket: int = 0                   #: draw/query bucket served on
+    batch: int = 1                    #: coalesced batch size dispatched
+    #: dispatch compile delta on the FIRST member only (the FitResult
+    #: discipline: summing over requests counts each compile once)
+    compiles: int = 0
+    latency_ms: Optional[float] = None
+    request_id: Optional[str] = None
+
+
+async def _sleep_then(delay_s: float, flush) -> None:
+    """One coalescing window: sleep, then run the door's flush."""
+    import asyncio
+
+    await asyncio.sleep(delay_s)
+    await flush()
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -114,6 +178,16 @@ class TimingService:
         self._served = 0
         self._pending: List[tuple] = []
         self._flush_task = None
+        # posterior door (amortized engine): nothing exists — and no
+        # executable is ever built — until register_posterior() is
+        # called with a trained flow
+        self._posterior = None
+        self._posterior_key = None
+        self._draw_counter = 0
+        self._post_latencies_ms: List[float] = []
+        self._post_served = 0
+        self._post_pending: List[tuple] = []
+        self._post_flush_task = None
 
     # -- warm-up ------------------------------------------------------------
 
@@ -185,19 +259,9 @@ class TimingService:
         window share a batched executable.  Returns this request's
         unpadded result (exceptions from a failed batch propagate to
         every member's awaiter)."""
-        import asyncio
-
-        loop = asyncio.get_running_loop()
-        if len(self._pending) >= self.cfg.max_queue:
-            raise UsageError(
-                f"serve queue full ({self.cfg.max_queue}); shed load or "
-                "raise ServeConfig.max_queue")
-        fut = loop.create_future()
-        self._pending.append((request, fut, time.perf_counter()))
-        self._gauge_queue_depth()
-        if self._flush_task is None:
-            self._flush_task = loop.create_task(self._flush_after())
-        return await fut
+        return await self._submit_door(
+            request, self._pending, "_flush_task", self._flush_after,
+            what="serve", gauge=self._gauge_queue_depth)
 
     def _gauge_queue_depth(self) -> None:
         if config._telemetry_mode != "off":
@@ -208,16 +272,47 @@ class TimingService:
                           ).set(len(self._pending))
 
     async def _flush_after(self) -> None:
-        import asyncio
-
-        await asyncio.sleep(self.cfg.window_ms / 1e3)
         pending, self._pending = self._pending, []
         self._flush_task = None
         self._gauge_queue_depth()
+        await self._flush_door(pending, self.batcher.run, self._record,
+                               what="serve")
+
+    # -- the shared coalescing core (both doors) ----------------------------
+
+    async def _submit_door(self, request, pending: List[tuple],
+                           task_attr: str, flush, what: str,
+                           gauge=None):
+        """Enqueue-and-await shared by the fit and posterior doors:
+        bounded queue, one flush task per window, the caller's gauge
+        updated on enqueue."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        if len(pending) >= self.cfg.max_queue:
+            raise UsageError(
+                f"{what} queue full ({self.cfg.max_queue}); shed load "
+                "or raise ServeConfig.max_queue")
+        fut = loop.create_future()
+        pending.append((request, fut, time.perf_counter()))
+        if gauge is not None:
+            gauge()
+        if getattr(self, task_attr) is None:
+            setattr(self, task_attr, loop.create_task(
+                _sleep_then(self.cfg.window_ms / 1e3, flush)))
+        return await fut
+
+    async def _flush_door(self, pending: List[tuple], run, record,
+                          what: str) -> None:
+        """Flush core shared by both doors: run the coalesced batch,
+        deliver BEFORE accounting (a telemetry/metrics failure in the
+        record hook must degrade to a warning, never strand awaiters
+        on futures that no one will ever resolve), and fail every
+        member's awaiter on a batch-level error."""
         if not pending:
             return
         try:
-            results = self.batcher.run([p[0] for p in pending])
+            results = run([p[0] for p in pending])
         except Exception as e:
             for _, fut, _ in pending:
                 if not fut.done():
@@ -225,16 +320,283 @@ class TimingService:
             return
         now = time.perf_counter()
         for (req, fut, t0), res in zip(pending, results):
-            # deliver BEFORE accounting: a telemetry/metrics failure in
-            # _record must degrade to a warning, never strand awaiters
-            # on futures that no one will ever resolve
             res.latency_ms = 1e3 * (now - t0)
             if not fut.done():
                 fut.set_result(res)
             try:
-                self._record(req, res, res.latency_ms)
+                record(req, res, res.latency_ms)
             except Exception as e:
                 from pint_tpu.logging import log
 
-                log.warning(f"serve accounting failed "
-                            f"({type(e).__name__}: {e}); result delivered")
+                log.warning(f"{what} accounting failed "
+                            f"({type(e).__name__}: {e}); result "
+                            "delivered")
+
+    # -- posterior door (amortized engine) ----------------------------------
+
+    def register_posterior(self, posterior, seed: int = 0) -> None:
+        """Attach a trained
+        :class:`~pint_tpu.amortized.posterior.AmortizedPosterior` to
+        the service; until this is called no posterior executable
+        exists and the posterior door raises the typed UsageError.
+        ``seed`` roots the service's draw-key chain — every coalesced
+        request draws from its OWN fold of this key (a request can
+        never share a sample stream with its batch-mates)."""
+        import jax
+
+        if not hasattr(posterior, "draw_kernel") \
+                or not hasattr(posterior, "logprob_kernel"):
+            raise UsageError(
+                f"register_posterior takes an AmortizedPosterior, got "
+                f"{type(posterior).__name__}")
+        self._posterior = posterior
+        self._posterior_key = np.asarray(jax.random.PRNGKey(int(seed)))
+        self._draw_counter = 0
+        # settle the key-derivation executable for the single-request
+        # shape now (warm_posterior settles the other batch rungs):
+        # the first serve must pay zero compiles, including the tiny
+        # vmapped threefry fold the per-request key discipline
+        # dispatches — counters are NOT consumed by settling
+        self._settle_fold(1)
+
+    def _settle_fold(self, count: int) -> None:
+        """Compile the vmapped fold_in executable for ``count`` lanes
+        without consuming the counter (values are discarded)."""
+        import jax
+
+        jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            self._posterior_key, np.arange(count))
+
+    @property
+    def posterior(self):
+        return self._posterior
+
+    def _require_posterior(self):
+        if self._posterior is None:
+            raise UsageError(
+                "no posterior registered on this service; train a "
+                "flow (pint_tpu.amortized) and call "
+                "register_posterior() first")
+        return self._posterior
+
+    def _validate_request(self, q) -> None:
+        if not isinstance(q, PosteriorRequest):
+            raise UsageError(
+                f"the posterior door takes PosteriorRequest, got "
+                f"{type(q).__name__}")
+        ndim = self._posterior.ndim
+        if q.points is not None and q.points.shape[1] != ndim:
+            raise UsageError(
+                f"request {q.request_id!r}: points are (n, {ndim}) "
+                f"for this posterior; got {q.points.shape}")
+
+    def _next_draw_keys(self, count: int) -> "np.ndarray":
+        """``(count, 2)`` uint32 keys, one per coalesced request (pad
+        lanes included) — folds of the service key at a monotonically
+        increasing counter, so no two requests ever share one.  One
+        vectorized dispatch (vmapped fold_in), not a per-lane loop:
+        this sits on the millisecond-latency serve path."""
+        import jax
+
+        counters = np.arange(self._draw_counter,
+                             self._draw_counter + count)
+        self._draw_counter += count
+        folded = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            self._posterior_key, counters)
+        return np.asarray(folded)
+
+    def warm_posterior(self, shapes: Sequence[Tuple[int, int]]
+                       ) -> WarmupReport:
+        """Pre-warm the posterior draw + log-prob executables for
+        ``(batch, n)`` shape pairs through the service's warm pool
+        (AOT-cache load or fresh compile + store, the
+        :func:`~pint_tpu.serving.warmup.warm_buckets` discipline)."""
+        ap = self._require_posterior()
+        report = WarmupReport()
+        d = ap.ndim
+        vkey = ap.serve_vkey()
+        # round through the SAME ladders the dispatch path buckets
+        # with: warming a non-rung shape would build a dead executable
+        # while the real dispatch shape stays cold.  The batch rung is
+        # CAPPED at the ladder's top — dispatch chunks oversize
+        # coalitions there, so bucket_of's doubling-past-the-top would
+        # warm a shape no dispatch ever reaches
+        top = max(self.cfg.batch_buckets)
+        rungs = sorted({(min(bucket_of(batch, self.cfg.batch_buckets),
+                             top),
+                         bucket_of(n, self.cfg.draw_buckets))
+                        for batch, n in shapes})
+        for batch, n in rungs:
+            self._settle_fold(batch)
+            keys = np.zeros((batch, 2), dtype=np.uint32)
+            report.entries.append(self.pool.warm(
+                self._posterior_name("draw", batch, n),
+                ap.draw_kernel(n), (ap.params, keys), vkey=vkey))
+            pts = np.zeros((batch, n, d))
+            report.entries.append(self.pool.warm(
+                self._posterior_name("logprob", batch, n),
+                ap.logprob_kernel(n), (ap.params, pts), vkey=vkey))
+        return report
+
+    def _posterior_name(self, kind: str, batch: int, n: int) -> str:
+        """Executable name for one posterior kernel shape: carries the
+        posterior's ident() (architecture + prior transform +
+        precision + training vkey) because the pool looks entries up
+        by NAME + operand shapes — without it, re-registering a
+        same-shaped posterior would replay the previous flow's
+        compiled handle."""
+        ap = self._posterior
+        return (f"posterior.{kind}[{batch}x{n}x{ap.ndim}"
+                f"@{ap.ident()}]{ap.flow.spec.suffix()}")
+
+    def _dispatch_posterior(self, kind: str, bucket: int,
+                            group: List[PosteriorRequest]
+                            ) -> List[PosteriorResult]:
+        """Pad one (kind, bucket) group onto its batch rung and
+        execute — the :class:`~pint_tpu.serving.batcher.ShapeBatcher`
+        discipline applied to the flow kernels."""
+        from pint_tpu.telemetry import jaxevents
+
+        ap = self._posterior
+        d = ap.ndim
+        batch = bucket_of(len(group), self.cfg.batch_buckets)
+        if kind == "draw":
+            fn = ap.draw_kernel(bucket)
+            # pad lanes draw from their own folded keys too: unlike
+            # repeating a member's key, a discarded pad lane can never
+            # alias a served request's sample stream
+            operands = (ap.params, self._next_draw_keys(batch))
+        else:
+            fn = ap.logprob_kernel(bucket)
+            pts = np.zeros((batch, bucket, d))
+            for i, q in enumerate(group):
+                pts[i, : q.n] = q.points
+            operands = (ap.params, pts)
+        name = self._posterior_name(kind, batch, bucket)
+        handle = self.pool.lookup(name, operands)
+        t0 = time.perf_counter()
+        before = jaxevents.counts()
+        out = np.asarray(handle(*operands) if handle is not None
+                         else fn(*operands))
+        compiles = jaxevents.counts().compiles - before.compiles
+        wall_ms = 1e3 * (time.perf_counter() - t0)
+        results = []
+        for i, q in enumerate(group):
+            results.append(PosteriorResult(
+                kind=kind,
+                draws=out[i, : q.n].copy() if kind == "draw" else None,
+                log_probs=out[i, : q.n].copy() if kind == "logprob"
+                else None,
+                bucket=bucket, batch=batch,
+                compiles=int(compiles) if i == 0 else 0,
+                latency_ms=wall_ms, request_id=q.request_id))
+        return results
+
+    def _run_posterior(self, requests: Sequence[PosteriorRequest]
+                       ) -> List[PosteriorResult]:
+        """One coalescing pass shared by both posterior doors: group
+        by (kind, draw bucket), chunk oversize coalitions at the batch
+        ladder's top rung, dispatch one batched executable per group,
+        return results in request order (no accounting here — each
+        door owns its latency semantics)."""
+        groups: Dict[Tuple[str, int], List[int]] = {}
+        for i, q in enumerate(requests):
+            self._validate_request(q)
+            bucket = bucket_of(q.n, self.cfg.draw_buckets)
+            groups.setdefault((q.kind, bucket), []).append(i)
+        out: List[Optional[PosteriorResult]] = [None] * len(requests)
+        for (kind, bucket), idxs in groups.items():
+            # max(), not [-1]: ShapeBatcher sorts its ladder at
+            # construction but this door consumes cfg's tuple directly
+            top = max(self.cfg.batch_buckets)
+            for lo in range(0, len(idxs), top):
+                chunk = idxs[lo:lo + top]
+                for j, res in zip(chunk, self._dispatch_posterior(
+                        kind, bucket, [requests[i] for i in chunk])):
+                    out[j] = res
+        return out  # type: ignore[return-value]
+
+    def serve_posterior(self, requests: Sequence[PosteriorRequest]
+                        ) -> List[PosteriorResult]:
+        """The synchronous posterior batch door: one coalescing pass,
+        latency recorded per request as the whole pass's wall (the
+        honest number under coalescing — the fit door's discipline)."""
+        self._require_posterior()
+        t0 = time.perf_counter()
+        out = self._run_posterior(requests)
+        wall_ms = 1e3 * (time.perf_counter() - t0)
+        for req, res in zip(requests, out):
+            self._record_posterior(req, res, wall_ms)
+        return out
+
+    async def submit_posterior(self, request: PosteriorRequest
+                               ) -> PosteriorResult:
+        """The posterior door's asyncio entry: requests landing within
+        the coalescing window share a batched executable (its OWN
+        door — posterior traffic never delays fit requests and vice
+        versa).  The request is validated HERE, before enqueue: a
+        malformed request must fail its own awaiter, never poison the
+        innocent batch-mates it would coalesce with."""
+        self._require_posterior()
+        self._validate_request(request)
+        return await self._submit_door(
+            request, self._post_pending, "_post_flush_task",
+            self._flush_posterior_after, what="posterior",
+            gauge=self._gauge_posterior_queue_depth)
+
+    def _gauge_posterior_queue_depth(self) -> None:
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.gauge("pint_tpu_posterior_queue_depth",
+                          "posterior requests waiting in the "
+                          "coalescing window"
+                          ).set(len(self._post_pending))
+
+    async def _flush_posterior_after(self) -> None:
+        pending, self._post_pending = self._post_pending, []
+        self._post_flush_task = None
+        self._gauge_posterior_queue_depth()
+        await self._flush_door(pending, self._run_posterior,
+                               self._record_posterior,
+                               what="posterior")
+
+    def _record_posterior(self, req: PosteriorRequest,
+                          res: PosteriorResult,
+                          latency_ms: float) -> None:
+        from pint_tpu.telemetry import metrics
+
+        res.latency_ms = latency_ms
+        self._post_served += 1
+        self._post_latencies_ms.append(latency_ms)
+        if len(self._post_latencies_ms) > _LATENCY_RING:
+            del self._post_latencies_ms[:len(self._post_latencies_ms)
+                                        - _LATENCY_RING]
+        if config._telemetry_mode != "off":
+            metrics.counter("pint_tpu_posterior_requests_total",
+                            "posterior requests served").inc()
+            metrics.histogram("pint_tpu_posterior_latency_ms",
+                              "posterior request latency (ms)"
+                              ).observe(latency_ms)
+            if res.compiles:
+                metrics.counter(
+                    "pint_tpu_posterior_compiles_total",
+                    "fresh XLA compiles paid by posterior "
+                    "dispatches").inc(res.compiles)
+        _emit_event("posterior_serve", kind=res.kind,
+                    batch=int(res.batch), n=int(req.n),
+                    bucket=int(res.bucket),
+                    latency_ms=float(latency_ms),
+                    compiles=int(res.compiles))
+
+    def posterior_latency_summary(self) -> dict:
+        """``{n, p50_ms, p99_ms}`` over the posterior door's own
+        (bounded) latency ring."""
+        vals = sorted(self._post_latencies_ms)
+        return {"n": len(vals),
+                "p50_ms": _percentile(vals, 0.50),
+                "p99_ms": _percentile(vals, 0.99)}
+
+    @property
+    def posterior_served(self) -> int:
+        return self._post_served

@@ -1,0 +1,891 @@
+"""Amortized inference engine under test (pint_tpu/amortized/).
+
+The contracts tier-1 (CPU) pins:
+
+* **flow primitives** — affine couplings invert exactly (forward o
+  inverse == id, log-determinants cancel), the identity init IS the
+  prior-transformed base, fixed permutations are seed-deterministic;
+* **prior alignment** — the :class:`PriorTransform` keeps every flow
+  sample strictly in-support, inverts analytically, and reports
+  out-of-support log-prob queries as exactly ``-inf``;
+* **the deduped entry point** — ``BayesianTiming.batched_posterior``
+  is the ONE lnposterior construction: its values pin against the
+  scalar path and against ``lnposterior_batch`` / ``MCMCFitter`` on
+  the B1855-shaped DD-binary stand-in;
+* **training discipline** — a fixed seed reproduces the ELBO trace
+  and trained weights bitwise; a crash mid-run resumes from the
+  SweepCheckpoint bit-identically; a foreign checkpoint refuses with
+  the typed CheckpointError;
+* **the posterior door** — coalesced requests never share a PRNG
+  key, results unpad in request order, ``posterior_serve`` events
+  validate, and the AOT round trip (populate -> clear_caches ->
+  fresh pool -> all-hit re-warm -> serve) reaches ``compiles=0``
+  with bit-identical draws;
+* **the slow acceptance pin** — the flow posterior matches
+  ``MCMCFitter`` marginals (KS + first two moments) on the stand-in
+  workload, with the amortized draw path >= 10x faster wall-clock
+  than the MCMC chain.
+"""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.amortized
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from pint_tpu.amortized import (  # noqa: E402
+    AmortizedPosterior,
+    AmortizedVI,
+    Flow,
+    FlowConfig,
+    PriorTransform,
+    TrainConfig,
+    train_flow,
+)
+from pint_tpu.exceptions import CheckpointError, UsageError  # noqa: E402
+from pint_tpu.serving import (  # noqa: E402
+    PosteriorRequest,
+    ServeConfig,
+    TimingService,
+    WarmPool,
+)
+
+# the B1855-shaped stand-in of the precision/autotune suites with the
+# correlated-noise components dropped: BayesianTiming's vectorized
+# likelihood (like MCMCFitter's) is the white-noise chi2 — the DD
+# binary + EFAC structure is what makes it B1855-shaped
+STANDIN_PAR = [
+    "PSR TSTAMORT\n", "RAJ 04:37:15.0\n", "DECJ -47:15:09.0\n",
+    "F0 173.6879 1\n", "F1 -1.7e-15 1\n", "PEPOCH 55000\n",
+    "DM 2.64\n", "BINARY DD\n", "PB 5.7410\n", "A1 3.3667\n",
+    "T0 55000.0\n", "OM 1.35\n", "ECC 1.9e-5\n", "M2 0.3\n",
+    "SINI 0.95\n", "EFAC mjd 50000 60000 1.1\n", "UNITS TDB\n",
+]
+
+
+def _gauss_lnpost(mu, sig):
+    """A synthetic Gaussian posterior for unit-level flow tests."""
+    mu = np.asarray(mu, dtype=np.float64)
+    sig = np.asarray(sig, dtype=np.float64)
+
+    def lnpost(x):
+        import jax.numpy as jnp
+
+        return -0.5 * jnp.sum(((x - mu) / sig) ** 2, axis=-1)
+
+    return lnpost
+
+
+@pytest.fixture(scope="module")
+def standin():
+    """WLS-fitted F0/F1 stand-in + its BayesianTiming with +-10 sigma
+    uniform priors (the MCMC-able posterior surface)."""
+    from pint_tpu.bayesian import BayesianTiming, apply_prior_info
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    model = get_model(list(STANDIN_PAR))
+    rng = np.random.default_rng(7)
+    mjds = np.linspace(54000, 56000, 60)
+    toas = make_fake_toas_fromMJDs(mjds, model, error_us=2.0,
+                                   add_noise=True, rng=rng)
+    f = WLSFitter(toas, model)
+    f.fit_toas(maxiter=3)
+    info = {}
+    for p in f.model.free_params:
+        par = getattr(f.model, p)
+        half = 10.0 * float(par.uncertainty)
+        info[p] = {"distr": "uniform",
+                   "pmin": float(par.value) - half,
+                   "pmax": float(par.value) + half}
+    apply_prior_info(f.model, info)
+    return f, BayesianTiming(f.model, toas)
+
+
+@pytest.fixture
+def aot_dir(tmp_path):
+    from pint_tpu import config
+    from pint_tpu.serving import aotcache
+
+    d = str(tmp_path / "aot")
+    config.set_aot_cache_dir(d)
+    yield d
+    config.set_aot_cache_dir(None)
+    aotcache.reset_cache_singleton()
+
+
+@pytest.fixture
+def basic_telemetry():
+    from pint_tpu import telemetry
+
+    telemetry.activate("basic")
+    yield telemetry
+    telemetry.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# flow primitives
+# ---------------------------------------------------------------------------
+
+class TestFlowPrimitives:
+    def test_config_validation(self):
+        with pytest.raises(UsageError):
+            FlowConfig(ndim=0)
+        with pytest.raises(UsageError):
+            FlowConfig(ndim=2, n_layers=-1)
+        with pytest.raises(UsageError):
+            FlowConfig(ndim=2, hidden=0)
+        assert FlowConfig(ndim=3).digest() != \
+            FlowConfig(ndim=3, seed=1).digest()
+
+    def test_identity_init_is_the_base(self):
+        import jax.numpy as jnp
+
+        flow = Flow(FlowConfig(ndim=3, n_layers=4, hidden=8, seed=2))
+        params = flow.init()
+        z = np.random.default_rng(0).normal(size=(11, 3))
+        u, logdet = flow.forward(params, jnp.asarray(z))
+        np.testing.assert_array_equal(np.asarray(u), z)
+        np.testing.assert_array_equal(np.asarray(logdet), np.zeros(11))
+
+    def test_forward_inverse_round_trip(self):
+        """After real training steps (non-trivial weights) the
+        coupling stack still inverts exactly and the log-dets
+        cancel."""
+        import jax.numpy as jnp
+
+        vi = AmortizedVI(_gauss_lnpost([0.2, -0.1, 0.4], [0.1] * 3),
+                         [("uniform", -2.0, 2.0)] * 3,
+                         n_layers=4, hidden=8, seed=3)
+        res = train_flow(vi, TrainConfig(steps=30, n_samples=16))
+        z = np.random.default_rng(1).normal(size=(17, 3))
+        u, ld_f = vi.flow.forward(res.params, jnp.asarray(z))
+        z2, ld_i = vi.flow.inverse(res.params, u)
+        np.testing.assert_allclose(np.asarray(z2), z, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(ld_f + ld_i),
+                                   np.zeros(17), atol=1e-12)
+
+    def test_ndim1_is_diagonal_affine_only(self):
+        flow = Flow(FlowConfig(ndim=1, n_layers=4, hidden=8))
+        assert flow.n_coupling_layers == 0
+        vi = AmortizedVI(_gauss_lnpost([0.5], [0.1]),
+                         [("uniform", -2.0, 2.0)], n_layers=4, seed=0)
+        res = train_flow(vi, TrainConfig(steps=120, n_samples=32,
+                                         lr=5e-2))
+        ap = AmortizedPosterior.from_training(vi, res)
+        d = ap.draw(2000, seed=4)
+        assert abs(float(d.mean()) - 0.5) < 0.05
+
+    def test_fixed_permutations_are_seed_deterministic(self):
+        cfg = FlowConfig(ndim=6, n_layers=3, seed=5)
+        a, b = Flow(cfg), Flow(cfg)
+        for (ia, ib), (ja, jb) in zip(a._splits, b._splits):
+            np.testing.assert_array_equal(ia, ja)
+            np.testing.assert_array_equal(ib, jb)
+        other = Flow(FlowConfig(ndim=6, n_layers=3, seed=6))
+        assert any(not np.array_equal(x[0], y[0])
+                   for x, y in zip(a._splits, other._splits))
+
+    def test_base_logpdf_is_standard_normal(self):
+        from scipy.stats import norm
+
+        z = np.random.default_rng(2).normal(size=(9, 4))
+        want = norm.logpdf(z).sum(axis=1)
+        got = np.asarray(Flow.base_logpdf(z))
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+
+class TestPriorTransform:
+    def test_round_trip_and_jacobians_cancel(self):
+        import jax.numpy as jnp
+
+        tr = PriorTransform([("uniform", -1.0, 3.0),
+                             ("normal", 2.0, 0.5)])
+        u = np.random.default_rng(3).normal(size=(13, 2))
+        x, lj = tr.constrain(jnp.asarray(u))
+        u2, lji, inb = tr.unconstrain(x)
+        np.testing.assert_allclose(np.asarray(u2), u, atol=1e-9)
+        np.testing.assert_allclose(np.asarray(lj + lji), np.zeros(13),
+                                   atol=1e-9)
+        assert bool(np.all(np.asarray(inb)))
+
+    def test_constrained_samples_stay_in_support(self):
+        import jax.numpy as jnp
+
+        tr = PriorTransform([("uniform", 10.0, 11.0)])
+        u = np.linspace(-50, 50, 101)[:, None]
+        x, _ = tr.constrain(jnp.asarray(u))
+        x = np.asarray(x)
+        assert np.all(x >= 10.0) and np.all(x <= 11.0)
+
+    def test_out_of_support_is_minus_inf(self):
+        vi = AmortizedVI(_gauss_lnpost([0.0], [0.5]),
+                         [("uniform", -1.0, 1.0)], n_layers=0)
+        ap = AmortizedPosterior.from_training(
+            vi, train_flow(vi, TrainConfig(steps=2, n_samples=8)))
+        lp = ap.log_prob(np.array([[0.0], [1.5], [-2.0]]))
+        assert np.isfinite(lp[0])
+        assert lp[1] == -np.inf and lp[2] == -np.inf
+
+    def test_narrow_box_never_overshoots_in_fp(self):
+        """A box narrow relative to its center (the F0-prior shape):
+        fl(lo + width*sigmoid(u)) could exceed hi by an ulp — the
+        clamp keeps every constrained sample inside the ORIGINAL
+        bounds, and the inverse reports it in-support."""
+        import jax.numpy as jnp
+
+        lo, hi = 61.485476554 - 1e-9, 61.485476554 + 1e-9
+        tr = PriorTransform([("uniform", lo, hi)])
+        u = np.linspace(-45.0, 45.0, 4001)[:, None]
+        x, _ = tr.constrain(jnp.asarray(u))
+        x = np.asarray(x)
+        assert np.all(x >= lo) and np.all(x <= hi)
+        _, _, inb = tr.unconstrain(jnp.asarray(x))
+        assert bool(np.all(np.asarray(inb)))
+
+    def test_malformed_specs_raise_typed(self):
+        with pytest.raises(UsageError):
+            PriorTransform([])
+        with pytest.raises(UsageError):
+            PriorTransform([None])
+        with pytest.raises(UsageError):
+            PriorTransform([("cauchy", 0.0, 1.0)])
+        with pytest.raises(UsageError):
+            PriorTransform([("uniform", 2.0, 1.0)])
+        with pytest.raises(UsageError):
+            PriorTransform([("normal", 0.0, 0.0)])
+
+
+# ---------------------------------------------------------------------------
+# ELBO + training
+# ---------------------------------------------------------------------------
+
+class TestTraining:
+    def test_elbo_improves_and_recovers_moments(self):
+        mu, sig = [0.3, -0.5], [0.1, 0.2]
+        vi = AmortizedVI(_gauss_lnpost(mu, sig),
+                         [("uniform", -2.0, 2.0)] * 2,
+                         n_layers=4, hidden=16, seed=1)
+        res = train_flow(vi, TrainConfig(steps=300, n_samples=64,
+                                         lr=2e-2, seed=3))
+        assert res.elbo_final > res.elbo_trace[0]
+        d = AmortizedPosterior.from_training(vi, res).draw(4000, seed=5)
+        np.testing.assert_allclose(d.mean(axis=0), mu, atol=0.08)
+        np.testing.assert_allclose(d.std(axis=0), sig, rtol=0.35)
+
+    def test_training_is_bitwise_deterministic(self):
+        """Satellite: a fixed jax.random seed reproduces the ELBO
+        trace (and the trained weights) bitwise on CPU."""
+        import jax
+
+        def run():
+            vi = AmortizedVI(_gauss_lnpost([0.1], [0.3]),
+                             [("uniform", -1.0, 1.0)],
+                             n_layers=2, hidden=8, seed=2)
+            return vi, train_flow(vi, TrainConfig(steps=20,
+                                                  n_samples=16, seed=9))
+
+        _, a = run()
+        _, b = run()
+        np.testing.assert_array_equal(a.elbo_trace, b.elbo_trace)
+        for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                          jax.tree_util.tree_leaves(b.params)):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+
+    def test_crash_resume_is_bitwise_identical(self, tmp_path,
+                                               monkeypatch):
+        """A run killed mid-chunk resumes from the SweepCheckpoint and
+        finishes bit-identically to an uninterrupted run."""
+        import jax
+
+        from pint_tpu.amortized import train as train_mod
+
+        cfg = TrainConfig(steps=40, n_samples=16, seed=4,
+                          checkpoint_chunk=10)
+
+        def make_vi():
+            return AmortizedVI(_gauss_lnpost([0.2, 0.1], [0.2, 0.3]),
+                               [("uniform", -1.0, 1.0)] * 2,
+                               n_layers=2, hidden=8, seed=1)
+
+        vi = make_vi()
+        unfaulted = train_flow(vi, cfg,
+                               checkpoint=str(tmp_path / "clean"))
+
+        # crash at step 25 (mid third chunk): chunks 0-1 persist
+        real_step_fn = train_mod._adam_step_fn
+        calls = {"n": 0}
+
+        def crashing(vi_, cfg_):
+            step = real_step_fn(vi_, cfg_)
+
+            def wrapped(*args):
+                calls["n"] += 1
+                if calls["n"] > 25:
+                    raise RuntimeError("injected crash")
+                return step(*args)
+
+            return wrapped
+
+        monkeypatch.setattr(train_mod, "_adam_step_fn", crashing)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            train_flow(make_vi(), cfg,
+                       checkpoint=str(tmp_path / "crashed"))
+        monkeypatch.setattr(train_mod, "_adam_step_fn", real_step_fn)
+        resumed = train_flow(make_vi(), cfg,
+                             checkpoint=str(tmp_path / "crashed"))
+        assert resumed.resumed_steps == 20
+        np.testing.assert_array_equal(resumed.elbo_trace,
+                                      unfaulted.elbo_trace)
+        for la, lb in zip(jax.tree_util.tree_leaves(resumed.params),
+                          jax.tree_util.tree_leaves(unfaulted.params)):
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+
+    def test_foreign_checkpoint_refuses(self, tmp_path):
+        vi = AmortizedVI(_gauss_lnpost([0.0], [0.5]),
+                         [("uniform", -1.0, 1.0)], n_layers=1,
+                         hidden=4)
+        d = str(tmp_path / "ck")
+        train_flow(vi, TrainConfig(steps=10, n_samples=8, seed=1,
+                                   checkpoint_chunk=5), checkpoint=d)
+        with pytest.raises(CheckpointError):
+            train_flow(vi, TrainConfig(steps=10, n_samples=8, seed=2,
+                                       checkpoint_chunk=5),
+                       checkpoint=d)
+
+    def test_walker_plan_shards_the_sample_axis(self, eight_devices):
+        from pint_tpu.runtime.plan import select_plan
+
+        plan = select_plan("walker", devices=eight_devices)
+        vi = AmortizedVI(_gauss_lnpost([0.1, 0.2], [0.2, 0.2]),
+                         [("uniform", -1.0, 1.0)] * 2,
+                         n_layers=2, hidden=8, seed=3)
+        res = train_flow(vi, TrainConfig(steps=15, n_samples=30),
+                         plan=plan)
+        # 30 samples pad to 32 (8 shards); training stays finite
+        assert np.all(np.isfinite(res.elbo_trace))
+
+    def test_flow_train_events_validate(self, tmp_path):
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import runlog
+        from tools.telemetry_report import validate_run_dir
+
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            runlog.start_run(run_dir, name="amortized-test",
+                             probe_device=False)
+            vi = AmortizedVI(_gauss_lnpost([0.0], [0.5]),
+                             [("uniform", -1.0, 1.0)], n_layers=1,
+                             hidden=4)
+            train_flow(vi, TrainConfig(steps=10, n_samples=8,
+                                       log_every=5))
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+        errors = []
+        validate_run_dir(run_dir, errors)
+        assert not errors, errors
+        recs = [json.loads(ln) for ln in
+                open(os.path.join(run_dir, "events.jsonl"))]
+        ticks = [r for r in recs if r.get("type") == "event"
+                 and r["event"]["name"] == "flow_train"]
+        assert len(ticks) >= 2
+        assert ticks[0]["event"]["attrs"]["lr"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the deduped lnposterior entry point (satellite)
+# ---------------------------------------------------------------------------
+
+class TestBatchedPosteriorEntryPoint:
+    def test_entry_point_pins_scalar_and_batch_paths(self, standin):
+        """The regression pin: one lnposterior construction — the
+        typed entry point, lnposterior_batch, and the scalar path
+        agree on the B1855-shaped stand-in."""
+        import jax.numpy as jnp
+
+        _, bt = standin
+        bp = bt.batched_posterior()
+        assert bp.param_labels == tuple(bt.param_labels)
+        assert bp.ndim == bt.nparams
+        assert all(s is not None for s in bp.prior_specs)
+        rng = np.random.default_rng(11)
+        vals = np.array([float(getattr(bt.model, p).value)
+                         for p in bp.param_labels])
+        errs = np.array([float(getattr(bt.model, p).uncertainty)
+                         for p in bp.param_labels])
+        pts = vals + errs * rng.standard_normal((6, bp.ndim))
+        via_entry = np.asarray(bp.fn(jnp.asarray(pts)))
+        via_batch = bt.lnposterior_batch(pts)
+        # the SAME built graph: identical, not merely close
+        np.testing.assert_array_equal(via_entry, via_batch)
+        scalar = np.array([bt.lnposterior(p) for p in pts])
+        np.testing.assert_allclose(via_entry, scalar, rtol=1e-9,
+                                   atol=1e-9)
+
+    def test_mcmc_fitter_shares_the_entry_point(self, standin):
+        from pint_tpu.mcmc_fitter import MCMCFitter
+
+        f, bt = standin
+        mf = MCMCFitter(bt.toas, bt.model, nwalkers=8)
+        bp = mf.batched_posterior()
+        assert bp.param_labels == tuple(bt.param_labels)
+        pts = np.array([[float(getattr(bt.model, p).value)
+                         for p in bp.param_labels]])
+        # the fitter deep-copies the model, so its compiled graph is a
+        # separate build: pinned to fp-envelope, not bitwise (the
+        # bitwise pin above covers the shared-construction contract)
+        np.testing.assert_allclose(
+            np.asarray(bp.fn(pts)), bt.lnposterior_batch(pts),
+            rtol=1e-12)
+
+    def test_unvectorizable_posterior_raises_typed(self):
+        from pint_tpu.bayesian import BayesianTiming
+        from pint_tpu.models import get_model
+        from pint_tpu.models.priors import GaussianBoundedRV, Prior
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = io.StringIO(
+            "PSR TST\nF0 10.0 1\nPEPOCH 55000\nRAJ 1:00:00\n"
+            "DECJ 1:00:00\nUNITS TDB\n")
+        m = get_model(par)
+        t = make_fake_toas_uniform(54000, 55000, 20, m, error_us=5.0)
+        # a truncnorm prior has no jax_spec: host path only
+        m.F0.prior = Prior(GaussianBoundedRV(10.0, 1e-6, 9.0, 11.0))
+        bt = BayesianTiming(m, t)
+        with pytest.raises(UsageError):
+            bt.batched_posterior()
+
+    def test_amortized_vi_builds_from_the_entry_point(self, standin):
+        _, bt = standin
+        vi = AmortizedVI.from_bayesian(bt, n_layers=2, hidden=8)
+        assert vi.param_labels == tuple(bt.param_labels)
+        assert vi.ndim == bt.nparams
+        assert vi.vkey  # model signature + TOA version rode along
+
+    def test_amortized_vi_over_the_joint_likelihood(self):
+        """The catalog surface: the ELBO differentiates through the
+        jitted cross-pulsar Hellings-Downs kernel and training stays
+        finite on the (log10_A, gamma) box."""
+        from pint_tpu.catalog import (CatalogFitter, JointLikelihood,
+                                      ingest_catalog,
+                                      make_synthetic_catalog)
+
+        report = ingest_catalog(make_synthetic_catalog(
+            n_pulsars=3, seed=5, ntoa_range=(20, 28)))
+        cf = CatalogFitter(report)
+        cf.fit(maxiter=1)
+        jl = JointLikelihood(cf, n_modes=2)
+        vi = AmortizedVI.from_joint_likelihood(
+            jl, log10_A_bounds=(-16.0, -12.0), gamma_bounds=(1.0, 6.0),
+            n_layers=2, hidden=8, seed=3)
+        assert vi.param_labels == ("log10_A", "gamma")
+        res = train_flow(vi, TrainConfig(steps=10, n_samples=8))
+        assert np.all(np.isfinite(res.elbo_trace))
+        d = AmortizedPosterior.from_training(vi, res).draw(100, seed=1)
+        assert np.all(d[:, 0] >= -16.0) and np.all(d[:, 0] <= -12.0)
+        assert np.all(d[:, 1] >= 1.0) and np.all(d[:, 1] <= 6.0)
+
+
+# ---------------------------------------------------------------------------
+# persistence
+# ---------------------------------------------------------------------------
+
+class TestPersistence:
+    def _trained(self, tmp_path):
+        vi = AmortizedVI(_gauss_lnpost([0.2, -0.3], [0.15, 0.1]),
+                         [("uniform", -1.0, 1.0)] * 2,
+                         n_layers=2, hidden=8, seed=1,
+                         vkey=("standin", 3))
+        res = train_flow(vi, TrainConfig(steps=25, n_samples=16))
+        return AmortizedPosterior.from_training(vi, res)
+
+    def test_save_load_round_trip_bitwise(self, tmp_path):
+        ap = self._trained(tmp_path)
+        path = str(tmp_path / "flow")
+        ap.save(path)
+        ap2 = AmortizedPosterior.load(path)
+        assert ap2.serve_vkey() == ap.serve_vkey()
+        assert ap2.param_labels == ap.param_labels
+        np.testing.assert_array_equal(ap.draw(50, seed=3),
+                                      ap2.draw(50, seed=3))
+
+    def test_vkey_verification(self, tmp_path):
+        ap = self._trained(tmp_path)
+        path = str(tmp_path / "flow")
+        ap.save(path)
+        AmortizedPosterior.load(path, expect_vkey=("standin", 3))
+        with pytest.raises(CheckpointError):
+            AmortizedPosterior.load(path, expect_vkey=("other", 9))
+
+    def test_load_pins_the_stored_precision_spec(self, tmp_path):
+        """A flow saved under the f64 default must load at f64 even
+        when the ambient policy has since flipped — the sidecar's
+        verified identity wins over re-resolution."""
+        from pint_tpu import precision
+
+        ap = self._trained(tmp_path)
+        assert not ap.flow.spec.reduced
+        path = str(tmp_path / "flow")
+        ap.save(path)
+        with precision.use_policy(
+                precision.PrecisionPolicy.forced("float32")):
+            ap2 = AmortizedPosterior.load(path)
+        assert not ap2.flow.spec.reduced
+        assert ap2.serve_vkey() == ap.serve_vkey()
+
+    def test_tampered_sidecar_refuses(self, tmp_path):
+        ap = self._trained(tmp_path)
+        path = str(tmp_path / "flow")
+        ap.save(path)
+        man = json.load(open(path + ".json"))
+        man["config"]["hidden"] = 999
+        json.dump(man, open(path + ".json", "w"))
+        with pytest.raises(CheckpointError):
+            AmortizedPosterior.load(path)
+
+    def test_missing_field_and_schema_refuse(self, tmp_path):
+        ap = self._trained(tmp_path)
+        path = str(tmp_path / "flow")
+        ap.save(path)
+        man = json.load(open(path + ".json"))
+        man["schema"] = "wrong/0"
+        json.dump(man, open(path + ".json", "w"))
+        with pytest.raises(CheckpointError):
+            AmortizedPosterior.load(path)
+        man = json.load(open(path + ".json"))
+        man["schema"] = "pint_tpu.amortized.flow/1"
+        del man["leaves"]
+        json.dump(man, open(path + ".json", "w"))
+        with pytest.raises(CheckpointError):
+            AmortizedPosterior.load(path)
+
+
+# ---------------------------------------------------------------------------
+# the posterior door
+# ---------------------------------------------------------------------------
+
+def _tiny_posterior(seed=1):
+    vi = AmortizedVI(_gauss_lnpost([0.3, -0.2], [0.1, 0.15]),
+                     [("uniform", -1.0, 1.0)] * 2,
+                     n_layers=2, hidden=8, seed=seed)
+    res = train_flow(vi, TrainConfig(steps=30, n_samples=16))
+    return AmortizedPosterior.from_training(vi, res)
+
+
+class TestPosteriorDoor:
+    def _svc(self, ap=None, **kw):
+        svc = TimingService(ServeConfig(draw_buckets=(64, 256),
+                                        batch_buckets=(1, 2, 4), **kw))
+        svc.register_posterior(ap or _tiny_posterior(), seed=5)
+        return svc
+
+    def test_unregistered_door_raises_typed(self):
+        svc = TimingService(ServeConfig())
+        with pytest.raises(UsageError):
+            svc.serve_posterior([PosteriorRequest(n_draws=8)])
+
+    def test_request_validation(self):
+        with pytest.raises(UsageError):
+            PosteriorRequest()
+        with pytest.raises(UsageError):
+            PosteriorRequest(n_draws=4, points=np.zeros((2, 2)))
+
+    def test_sync_serve_orders_and_unpads(self, basic_telemetry):
+        svc = self._svc()
+        reqs = [PosteriorRequest(n_draws=10, request_id="a"),
+                PosteriorRequest(points=np.zeros((3, 2)),
+                                 request_id="b"),
+                PosteriorRequest(n_draws=40, request_id="c")]
+        out = svc.serve_posterior(reqs)
+        assert [o.request_id for o in out] == ["a", "b", "c"]
+        assert out[0].draws.shape == (10, 2)
+        assert out[1].log_probs.shape == (3,)
+        assert out[2].draws.shape == (40, 2)
+        # both draw requests fit the 64-bucket and coalesced there
+        assert out[0].bucket == out[2].bucket == 64
+        assert out[0].batch == out[2].batch == 2
+        assert svc.posterior_served == 3
+        lat = svc.posterior_latency_summary()
+        assert lat["n"] == 3 and lat["p99_ms"] >= lat["p50_ms"] > 0
+        # the fit door's ring is untouched — separate SLO surfaces
+        assert svc.latency_summary()["n"] == 0
+
+    def test_coalesced_requests_never_share_a_key(self):
+        """Satellite: coalesced draw requests get distinct PRNG
+        folds — within a batch AND across passes."""
+        svc = self._svc()
+        out = svc.serve_posterior(
+            [PosteriorRequest(n_draws=30, request_id=f"r{i}")
+             for i in range(4)])
+        draws = [o.draws for o in out]
+        for i in range(4):
+            for j in range(i + 1, 4):
+                assert not np.array_equal(draws[i], draws[j])
+        again = svc.serve_posterior([PosteriorRequest(n_draws=30)])
+        for d in draws:
+            assert not np.array_equal(again[0].draws, d)
+
+    def test_same_seed_fresh_service_reproduces(self):
+        """The key chain is deterministic: a fresh service with the
+        same seed and request order reproduces draws bitwise (the
+        resumable-serving contract), while a different seed moves
+        them."""
+        ap = _tiny_posterior()
+        a = self._svc(ap).serve_posterior([PosteriorRequest(n_draws=16)])
+        b = self._svc(ap).serve_posterior([PosteriorRequest(n_draws=16)])
+        np.testing.assert_array_equal(a[0].draws, b[0].draws)
+        svc = TimingService(ServeConfig(draw_buckets=(64, 256),
+                                        batch_buckets=(1, 2, 4)))
+        svc.register_posterior(ap, seed=99)
+        c = svc.serve_posterior([PosteriorRequest(n_draws=16)])
+        assert not np.array_equal(a[0].draws, c[0].draws)
+
+    def test_warm_rounds_through_the_dispatch_ladders(self,
+                                                      basic_telemetry):
+        """Warming non-rung shapes must warm the executables the
+        dispatch path actually looks up (bucketed batch + draw
+        count) — the serve after a rounded warm pays zero compiles."""
+        from pint_tpu.telemetry import jaxevents
+
+        svc = self._svc()
+        rep = svc.warm_posterior([(3, 100)])  # rounds to (4, 256)
+        ident = svc.posterior.ident()
+        names = {e.name for e in rep.entries}
+        assert names == {f"posterior.draw[4x256x2@{ident}]",
+                         f"posterior.logprob[4x256x2@{ident}]"}
+        before = jaxevents.counts()
+        out = svc.serve_posterior(
+            [PosteriorRequest(n_draws=100) for _ in range(3)])
+        assert jaxevents.counts().compiles - before.compiles == 0
+        assert all(o.draws.shape == (100, 2) for o in out)
+
+    def test_same_shape_posteriors_never_share_a_kernel(self):
+        """Regression: the draw/log-prob kernels bake the prior
+        transform in as constants — two posteriors with identical
+        architecture but different boxes must not alias through the
+        module-jit registry OR the warm pool."""
+        def trained(lo, hi):
+            vi = AmortizedVI(_gauss_lnpost([0.5 * (lo + hi)] * 2,
+                                           [0.1 * (hi - lo)] * 2),
+                             [("uniform", lo, hi)] * 2,
+                             n_layers=2, hidden=8, seed=1)
+            res = train_flow(vi, TrainConfig(steps=5, n_samples=8))
+            return AmortizedPosterior.from_training(vi, res)
+
+        a = trained(0.0, 1.0)
+        b = trained(100.0, 200.0)
+        da = a.draw(50, seed=2)
+        db = b.draw(50, seed=2)
+        assert np.all(da >= 0.0) and np.all(da <= 1.0)
+        assert np.all(db >= 100.0) and np.all(db <= 200.0)
+        assert np.all(np.isfinite(b.log_prob(db[:10])))
+        # and through one service: re-registering a same-shaped
+        # posterior after warming must not replay the first's handle
+        svc = self._svc(a)
+        svc.warm_posterior([(1, 64)])
+        svc.register_posterior(b, seed=5)
+        out = svc.serve_posterior([PosteriorRequest(n_draws=16)])
+        assert np.all(out[0].draws >= 100.0)
+        assert np.all(out[0].draws <= 200.0)
+
+    def test_logprob_pads_exactly(self):
+        """Padded query rows are sliced away and do not perturb the
+        served rows (vmapped kernel: lanes are independent)."""
+        ap = _tiny_posterior()
+        svc = self._svc(ap)
+        pts = np.random.default_rng(3).uniform(-0.9, 0.9, size=(5, 2))
+        served = svc.serve_posterior(
+            [PosteriorRequest(points=pts)])[0].log_probs
+        direct = ap.log_prob(pts)
+        np.testing.assert_allclose(served, direct, rtol=1e-12)
+
+    def test_async_door_coalesces(self):
+        import asyncio
+
+        svc = self._svc()
+
+        async def run():
+            return await asyncio.gather(*[
+                svc.submit_posterior(PosteriorRequest(n_draws=20))
+                for _ in range(3)])
+
+        out = asyncio.run(run())
+        assert all(o.draws.shape == (20, 2) for o in out)
+        assert {o.batch for o in out} == {4}  # coalesced (3 -> rung 4)
+        assert svc.posterior_served == 3
+
+    def test_malformed_submit_fails_only_its_own_awaiter(self):
+        """A wrong-ndim request raises at submit time — its coalesced
+        batch-mates are served normally."""
+        import asyncio
+
+        svc = self._svc()
+
+        async def run():
+            ok = asyncio.ensure_future(
+                svc.submit_posterior(PosteriorRequest(n_draws=8)))
+            with pytest.raises(UsageError):
+                await svc.submit_posterior(
+                    PosteriorRequest(points=np.zeros((4, 5))))
+            return await ok
+
+        res = asyncio.run(run())
+        assert res.draws.shape == (8, 2)
+
+    def test_warm_caps_at_the_dispatch_top_rung(self,
+                                                basic_telemetry):
+        """A warm shape past the batch ladder's top warms the TOP rung
+        (dispatch chunks there — bucket_of's doubling would warm a
+        shape no dispatch ever reaches)."""
+        from pint_tpu.telemetry import jaxevents
+
+        svc = self._svc()   # batch ladder (1, 2, 4)
+        rep = svc.warm_posterior([(100, 64)])
+        ident = svc.posterior.ident()
+        assert {e.name for e in rep.entries} == {
+            f"posterior.draw[4x64x2@{ident}]",
+            f"posterior.logprob[4x64x2@{ident}]"}
+        before = jaxevents.counts()
+        out = svc.serve_posterior(
+            [PosteriorRequest(n_draws=10) for _ in range(8)])
+        assert jaxevents.counts().compiles - before.compiles == 0
+        assert {o.batch for o in out} == {4}
+
+    def test_posterior_serve_events_validate(self, tmp_path):
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import runlog
+        from tools.telemetry_report import validate_run_dir
+
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            runlog.start_run(run_dir, name="posterior-test",
+                             probe_device=False)
+            svc = self._svc()
+            svc.serve_posterior([PosteriorRequest(n_draws=8),
+                                 PosteriorRequest(
+                                     points=np.zeros((2, 2)))])
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+        errors = []
+        validate_run_dir(run_dir, errors)
+        assert not errors, errors
+        recs = [json.loads(ln) for ln in
+                open(os.path.join(run_dir, "events.jsonl"))]
+        served = [r["event"]["attrs"] for r in recs
+                  if r.get("type") == "event"
+                  and r["event"]["name"] == "posterior_serve"]
+        assert {a["kind"] for a in served} == {"draw", "logprob"}
+        assert all(a["latency_ms"] >= 0 and a["compiles"] >= 0
+                   for a in served)
+
+
+class TestWarmPathAcceptance:
+    def test_aot_round_trip_compiles_zero_identical(self, aot_dir,
+                                                    basic_telemetry):
+        """The PR acceptance pin: populate the AOT cache with the
+        posterior executables, simulate a new process (cache clear +
+        fresh pool), re-warm all-hit, and serve with compiles == 0
+        and bit-identical draws."""
+        import jax
+
+        from pint_tpu.telemetry import jaxevents
+
+        ap = _tiny_posterior()
+        cfg = ServeConfig(draw_buckets=(64,), batch_buckets=(1, 2, 4))
+        svc = TimingService(cfg)
+        svc.register_posterior(ap, seed=7)
+        rep = svc.warm_posterior([(4, 64), (1, 64)])
+        assert rep.cold_compiles == len(rep.entries) == 4
+        cold = svc.serve_posterior(
+            [PosteriorRequest(n_draws=20, request_id=f"r{i}")
+             for i in range(4)])
+
+        # --- process-equivalent warm start ---------------------------
+        jax.clear_caches()
+        svc2 = TimingService(cfg, pool=WarmPool())
+        svc2.register_posterior(ap, seed=7)
+        rep2 = svc2.warm_posterior([(4, 64), (1, 64)])
+        assert rep2.cache_hits == len(rep2.entries) == 4, \
+            f"expected all-hit re-warm, got {rep2.to_dict()}"
+        assert rep2.cold_compiles == 0
+        before = jaxevents.counts()
+        warm = svc2.serve_posterior(
+            [PosteriorRequest(n_draws=20, request_id=f"r{i}")
+             for i in range(4)])
+        delta = jaxevents.counts() - before
+        assert delta.compiles == 0, \
+            "steady-state posterior serving must pay zero compiles"
+        for a, b in zip(cold, warm):
+            np.testing.assert_array_equal(a.draws, b.draws)
+
+    def test_no_registration_builds_no_executables(self):
+        """The default-unchanged acceptance pin: a service without a
+        registered flow holds no posterior executables and its warm
+        pool stays exactly the fit-kernel surface."""
+        svc = TimingService(ServeConfig())
+        assert svc.posterior is None
+        assert svc.pool.entries() == []
+        assert svc.posterior_latency_summary()["n"] == 0
+
+
+# ---------------------------------------------------------------------------
+# slow acceptance: flow vs MCMC on the stand-in workload
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestMCMCAgreement:
+    def test_flow_matches_mcmc_marginals_and_is_10x_faster(
+            self, standin):
+        """The ISSUE's acceptance criterion, at the stand-in scale the
+        test image supports: the flow posterior's marginals match the
+        MCMCFitter chain (KS < 0.1, means within 0.2 pooled sigma,
+        stds within 30%) and the amortized draw path is >= 10x faster
+        wall-clock than the MCMC sampling it replaces."""
+        import time as _time
+
+        from scipy.stats import ks_2samp
+
+        from pint_tpu.mcmc_fitter import MCMCFitter
+
+        _, bt = standin
+        mf = MCMCFitter(bt.toas, bt.model, nwalkers=32)
+        t0 = _time.perf_counter()
+        mf.fit_toas(maxiter=400, seed=12)
+        mcmc_s = _time.perf_counter() - t0
+        chain = mf.get_posterior_samples(burn_frac=0.5)
+
+        vi = AmortizedVI.from_fitter(mf, n_layers=4, hidden=16, seed=2)
+        res = train_flow(vi, TrainConfig(steps=400, n_samples=64,
+                                         lr=1e-2, seed=6))
+        assert res.elbo_final > res.elbo_trace[0]
+        ap = AmortizedPosterior.from_training(vi, res)
+        ap.draw(len(chain), seed=8)          # settle the compile
+        t0 = _time.perf_counter()
+        draws = ap.draw(len(chain), seed=9)
+        flow_s = _time.perf_counter() - t0
+
+        for i, p in enumerate(vi.param_labels):
+            ks = ks_2samp(chain[:, i], draws[:, i]).statistic
+            sig = 0.5 * (chain[:, i].std() + draws[:, i].std())
+            dmean = abs(chain[:, i].mean() - draws[:, i].mean())
+            assert ks < 0.1, (p, ks)
+            assert dmean < 0.2 * sig, (p, dmean, sig)
+            ratio = draws[:, i].std() / chain[:, i].std()
+            assert 0.7 < ratio < 1.3, (p, ratio)
+        assert flow_s * 10 <= mcmc_s, \
+            f"amortized draw {flow_s:.3f}s vs MCMC {mcmc_s:.3f}s"

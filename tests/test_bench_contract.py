@@ -74,8 +74,9 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     monkeypatch.setattr(bench, "B1855_TIM", tim)
     monkeypatch.setenv("BENCH_FORCE_CPU", "1")
     monkeypatch.setenv("BENCH_SKIP_SECONDARY", "1")
-    # small catalog: the contract is the block's shape, not its scale
+    # small catalog/flow: the contract is the blocks' shape, not scale
     monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
+    monkeypatch.setenv("BENCH_POSTERIOR_STEPS", "8")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     try:
@@ -163,6 +164,22 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert catalog["catalog_fits_per_s"] > 0
     assert catalog["joint_lnlike_per_s"] > 0
     assert catalog["steady_state_compiles"] == 0
+    # the posterior block (PR 13): the amortized engine trained a flow
+    # and served draws + log-probs through the posterior door — every
+    # key present, never degraded on CPU, zero steady-state compiles
+    posterior = headline["posterior"]
+    for key in ("train_steps", "elbo_final", "draws_per_s",
+                "logprob_per_s", "p50_ms", "p99_ms",
+                "steady_state_compiles"):
+        assert key in posterior, f"posterior block missing {key!r}"
+    assert "error" not in posterior, \
+        f"posterior measurement degraded: {posterior}"
+    assert posterior["train_steps"] == 8
+    assert posterior["draws_per_s"] > 0
+    assert posterior["logprob_per_s"] > 0
+    assert posterior["p50_ms"] > 0
+    assert posterior["p99_ms"] >= posterior["p50_ms"]
+    assert posterior["steady_state_compiles"] == 0
     json.dumps(headline)
 
 
@@ -182,6 +199,7 @@ def test_warm_block_hits_cache_on_second_run(tiny_headline_files,
     monkeypatch.setenv("BENCH_FORCE_CPU", "1")
     monkeypatch.setenv("BENCH_SKIP_SECONDARY", "1")
     monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
+    monkeypatch.setenv("BENCH_POSTERIOR_STEPS", "8")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     cache_dir = str(tmp_path / "aot")

@@ -188,6 +188,17 @@ class TestExamples:
         assert "MCMC (26 walkers" in out
         assert "done" in out
 
+    def test_amortized_posterior_walkthrough(self, capsys):
+        """The amortized-inference walkthrough: flow training on the
+        deduped batched posterior + the warm posterior door, at CI
+        size."""
+        out = _run("amortized_posterior.py", "--quick", "--cpu",
+                   capsys=capsys)
+        assert "amortizing 3 parameters" in out
+        assert "trained 60 steps" in out
+        assert "flow posterior consistent" in out
+        assert "done" in out
+
     def test_fit_catalog_walkthrough(self, capsys):
         """The PTA catalog-engine walkthrough: ingest + batched fit +
         joint Hellings-Downs likelihood + sampler, at CI size."""

@@ -555,6 +555,84 @@ class TestHostCallInJit:
         assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
         assert eng.lint_file(str(good)) == []
 
+    def test_amortized_call_in_jit_flagged(self, tmp_path):
+        """The amortized package is host orchestration (flow
+        construction + training loops with checkpoint I/O, npz
+        persistence, pool warming) — a train/load call inside a traced
+        function would re-run the whole optimization per TRACE; the
+        amortized submodules are policed like the serving/catalog
+        ones."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.amortized import train\n"
+            "from pint_tpu.amortized.train import train_flow\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    train.train_flow(x)\n"
+            "    train_flow(x)\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+
+    def test_amortized_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — train/register on the
+        host; traced code touches only Flow-instance methods (the
+        traced maps are object attributes, not the modules' function
+        surface)."""
+        good = (
+            "import jax\n"
+            "from pint_tpu.amortized import elbo, train\n"
+            "@jax.jit\n"
+            "def kernel(flow, params, z):\n"
+            "    u, logdet = flow.forward(params, z)\n"
+            "    return u, logdet\n"
+            "def host(lnpost, specs):\n"
+            "    vi = elbo.AmortizedVI(lnpost, specs)\n"
+            "    return train.train_flow(vi)\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+    def test_amortized_is_clean_target(self):
+        """pint_tpu/amortized/ itself lints clean under the host-call
+        rule (its traced kernels touch only jax/jnp + the precision
+        matmul) without pragmas or baseline entries."""
+        eng = Engine(rules=[HostCallInJitRule()], repo=REPO)
+        for rel in ("pint_tpu/amortized/__init__.py",
+                    "pint_tpu/amortized/flows.py",
+                    "pint_tpu/amortized/elbo.py",
+                    "pint_tpu/amortized/train.py",
+                    "pint_tpu/amortized/posterior.py"):
+            findings = eng.lint_file(os.path.join(REPO, rel))
+            assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_amortized_in_typed_raise_targets(self, tmp_path):
+        """pint_tpu/amortized/ is a typed-raise target: a planted bare
+        ValueError in an amortized module fires, its UsageError twin
+        does not."""
+        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
+
+        assert "pint_tpu/amortized/" in DEFAULT_TARGETS
+        d = tmp_path / "pint_tpu" / "amortized"
+        d.mkdir(parents=True)
+        bad = d / "bad.py"
+        bad.write_text("def f():\n    raise ValueError('bare')\n")
+        good = d / "good.py"
+        good.write_text(
+            "from pint_tpu.exceptions import UsageError\n"
+            "def f():\n    raise UsageError('typed')\n")
+        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
+        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
+        assert eng.lint_file(str(good)) == []
+
+    def test_amortized_in_downcast_scope(self):
+        """The unguarded-downcast rule covers the flow layers: a bare
+        reduced cast in pint_tpu/amortized/ would bypass the
+        flow.coupling segment budget."""
+        from tools.jaxlint.rules.downcast import DOWNCAST_SCOPE
+
+        assert "pint_tpu/amortized/" in DOWNCAST_SCOPE
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

@@ -614,6 +614,97 @@ class TestCatalogSeries:
         assert "catalog: 25.5 fits/s (16 pulsars)" in out
 
 
+def _posterior(draws=9500.0, logprob=12000.0, p50=2.0, p99=4.0,
+               steps=80, error=None):
+    block = {"train_steps": steps, "elbo_final": -4.3,
+             "draws_per_s": draws, "logprob_per_s": logprob,
+             "p50_ms": p50, "p99_ms": p99, "steady_state_compiles": 0}
+    if error is not None:
+        block = {"train_steps": None, "elbo_final": None,
+                 "draws_per_s": None, "logprob_per_s": None,
+                 "p50_ms": None, "p99_ms": None,
+                 "steady_state_compiles": None, "error": error}
+    return {"posterior": block}
+
+
+class TestPosteriorSeries:
+    """The bench's posterior{} block (round 13+): amortized draw /
+    log-prob throughput gate drops, the posterior door's p99 gates
+    rises, and an errored block after measured rounds fails."""
+
+    def test_posterior_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 13, 100.0,
+                    extra=_posterior(draws=9500.0, logprob=12000.0,
+                                     p99=4.5, steps=80))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.posterior_draws_per_s == 9500.0
+        assert r.posterior_logprob_per_s == 12000.0
+        assert r.posterior_p99_ms == 4.5
+        assert r.posterior_train_steps == 80
+        doc = build_history([r])
+        assert doc["runs"][0]["posterior_draws_per_s"] == 9500.0
+
+    def test_draws_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([9500.0, 9800.0, 9300.0], start=1):
+            _bench(d, i, 100.0, extra=_posterior(draws=v))
+        _bench(d, 4, 100.0, extra=_posterior(draws=4000.0))  # ~58% drop
+        assert main(["--check", "--dir", d]) == 1
+        assert "posterior_draws_per_s" in capsys.readouterr().out
+
+    def test_logprob_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_posterior(logprob=12000.0))
+        _bench(d, 4, 100.0, extra=_posterior(logprob=5000.0))
+        assert main(["--check", "--dir", d]) == 1
+        assert "posterior_logprob_per_s" in capsys.readouterr().out
+
+    def test_p99_rise_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_posterior(p99=4.0))
+        _bench(d, 4, 100.0, extra=_posterior(p99=9.0))  # >2x the tail
+        assert main(["--check", "--dir", d]) == 1
+        assert "posterior_p99_ms" in capsys.readouterr().out
+
+    def test_small_posterior_changes_pass(self, tmp_path):
+        d = str(tmp_path)
+        for i, (v, p) in enumerate([(9500.0, 4.0), (9800.0, 4.2),
+                                    (9300.0, 3.9)], start=1):
+            _bench(d, i, 100.0, extra=_posterior(draws=v, p99=p))
+        _bench(d, 4, 100.0, extra=_posterior(draws=9100.0, p99=4.3))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_posterior_block_fails_when_history_had_it(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_posterior())
+        _bench(d, 3, 100.0,
+               extra=_posterior(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 1
+        assert "posterior block degraded" in capsys.readouterr().out
+
+    def test_errored_posterior_block_clean_without_history(
+            self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0,
+               extra=_posterior(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_posterior_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0,
+               extra=_posterior(draws=9500.0, logprob=12000.0))
+        assert main(["--dir", d]) == 0
+        assert "posterior: 9500.0 draws/s" in capsys.readouterr().out
+
+
 def _precision(mixed=50.0, f64=50.0, rel=0.0, reduced=0, error=None):
     block = {"segments": {"serve.gram": "f64"}, "reduced_count": reduced,
              "f64_count": 6 - reduced, "mixed_fits_per_s": mixed,

@@ -179,12 +179,22 @@ _AUTOTUNE_SUBMODULES = {"search", "manifest", "records"}
 _CATALOG_SUBMODULES = {"ingest", "buckets", "batchfit", "crosscorr",
                        "likelihood"}
 
+#: pint_tpu.amortized submodules are host-side orchestration the same
+#: way (flow construction + training loops with checkpoint I/O, npz
+#: persistence, pool warming, the service's posterior door): a
+#: train/save/warm call inside a traced function would re-run the
+#: whole optimization per TRACE and hang the compile on disk I/O (the
+#: traced flow maps are object methods on host-built Flow instances,
+#: not the modules' public function surface)
+_AMORTIZED_SUBMODULES = {"flows", "elbo", "train", "posterior"}
+
 #: one table drives the ImportFrom tracking for every host-side
 #: package (the next PR's package is one row, not a copied branch)
 _HOST_PACKAGES = (("pint_tpu.telemetry", _TELEMETRY_SUBMODULES),
                   ("pint_tpu.serving", _SERVING_SUBMODULES),
                   ("pint_tpu.autotune", _AUTOTUNE_SUBMODULES),
-                  ("pint_tpu.catalog", _CATALOG_SUBMODULES))
+                  ("pint_tpu.catalog", _CATALOG_SUBMODULES),
+                  ("pint_tpu.amortized", _AMORTIZED_SUBMODULES))
 
 
 def _record_imports(info: FileInfo) -> None:

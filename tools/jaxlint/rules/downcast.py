@@ -31,9 +31,12 @@ from tools.jaxlint.rules.dtype_literals import PRECISION_CORE
 
 #: the files whose downcasts must route through pint_tpu.precision:
 #: the precision core plus the batched serve/catalog kernel surfaces
+#: and the amortized flow layers (their coupling matmuls carry the
+#: flow.coupling segment budget — a bare cast would bypass it)
 DOWNCAST_SCOPE = PRECISION_CORE + (
     "pint_tpu/catalog/",
     "pint_tpu/serving/batcher.py",
+    "pint_tpu/amortized/",
 )
 
 _REDUCED_NAMES = {"float32", "bfloat16", "float16", "half", "single"}

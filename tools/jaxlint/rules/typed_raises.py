@@ -48,6 +48,7 @@ DEFAULT_TARGETS = (
     "pint_tpu/autotune/",
     "pint_tpu/catalog/",
     "pint_tpu/precision/",
+    "pint_tpu/amortized/",
 )
 
 DISALLOWED = {

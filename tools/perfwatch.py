@@ -103,6 +103,13 @@ class RunRecord:
     catalog_joint_lnlike_per_s: Optional[float] = None
     catalog_n_pulsars: Optional[int] = None
     catalog_error: Optional[str] = None        #: degraded catalog block
+    #: from the posterior{...} block (round 13+: amortized inference)
+    posterior_draws_per_s: Optional[float] = None
+    posterior_logprob_per_s: Optional[float] = None
+    posterior_p50_ms: Optional[float] = None
+    posterior_p99_ms: Optional[float] = None
+    posterior_train_steps: Optional[int] = None
+    posterior_error: Optional[str] = None      #: degraded posterior block
     #: from the precision{...} block (round 12+: mixed-precision layer)
     precision_mixed_fits_per_s: Optional[float] = None
     precision_max_rel_err: Optional[float] = None
@@ -234,6 +241,20 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.catalog_n_pulsars = catalog["n_pulsars"]
         if isinstance(catalog.get("error"), str) and catalog["error"]:
             rec.catalog_error = catalog["error"]
+    posterior = h.get("posterior")
+    if isinstance(posterior, dict):
+        for src, dst in (("draws_per_s", "posterior_draws_per_s"),
+                         ("logprob_per_s", "posterior_logprob_per_s"),
+                         ("p50_ms", "posterior_p50_ms"),
+                         ("p99_ms", "posterior_p99_ms")):
+            if isinstance(posterior.get(src), (int, float)) \
+                    and not isinstance(posterior.get(src), bool):
+                setattr(rec, dst, float(posterior[src]))
+        if isinstance(posterior.get("train_steps"), int) \
+                and not isinstance(posterior.get("train_steps"), bool):
+            rec.posterior_train_steps = posterior["train_steps"]
+        if isinstance(posterior.get("error"), str) and posterior["error"]:
+            rec.posterior_error = posterior["error"]
     # a zero-valued errored run (the bench's error-emit contract) is a
     # failed measurement, not a 100% regression
     if rec.error is not None and not rec.value:
@@ -444,6 +465,15 @@ def check_series(runs: List[RunRecord], threshold: float,
                    lambda r: r.catalog_pad_waste_frac, -1, False),
                   ("catalog_joint_lnlike_per_s",
                    lambda r: r.catalog_joint_lnlike_per_s, +1, False),
+                  # amortized inference (round 13+): posterior draw /
+                  # log-prob throughput gate drops, the posterior
+                  # door's tail latency gates rises
+                  ("posterior_draws_per_s",
+                   lambda r: r.posterior_draws_per_s, +1, False),
+                  ("posterior_logprob_per_s",
+                   lambda r: r.posterior_logprob_per_s, +1, False),
+                  ("posterior_p99_ms",
+                   lambda r: r.posterior_p99_ms, -1, False),
                   # mixed-precision layer (round 12+): policy-path
                   # throughput gates drops; max_rel_err gates rises WITH
                   # the zero-baseline opt-in — a bit-identical history
@@ -544,6 +574,19 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: catalog block degraded "
                    f"({latest_rec.catalog_error}) where prior runs "
                    "measured the catalog engine"))
+    # a degraded posterior block where prior rounds measured the
+    # amortized engine is a regression, not a silent skip
+    if latest_rec.posterior_error is not None \
+            and any(r.posterior_draws_per_s is not None
+                    for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="posterior", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: posterior block degraded "
+                   f"({latest_rec.posterior_error}) where prior runs "
+                   "measured the amortized engine"))
     # a degraded precision block where prior rounds measured the
     # mixed-precision layer is a regression, not a silent skip
     if latest_rec.precision_error is not None \
@@ -689,6 +732,14 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f"({latest.catalog_n_pulsars} pulsars), "
                   f"pad_waste={latest.catalog_pad_waste_frac}, "
                   f"joint_lnlike {latest.catalog_joint_lnlike_per_s}/s",
+                  file=out)
+        if latest.posterior_draws_per_s is not None \
+                or latest.posterior_p99_ms is not None:
+            print(f"  posterior: {latest.posterior_draws_per_s} draws/s,"
+                  f" logprob {latest.posterior_logprob_per_s}/s, "
+                  f"p50 {latest.posterior_p50_ms} ms, "
+                  f"p99 {latest.posterior_p99_ms} ms "
+                  f"({latest.posterior_train_steps} train steps)",
                   file=out)
         if latest.precision_mixed_fits_per_s is not None \
                 or latest.precision_max_rel_err is not None:

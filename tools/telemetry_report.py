@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 from typing import List, Optional
@@ -187,6 +188,77 @@ def validate_precision_event(ev: dict, where: str,
             _err(errors, where,
                  f"precision_applied source {attrs.get('source')!r} "
                  f"not in {_PRECISION_SOURCES[1:]}")
+
+
+#: amortized-inference lifecycle events (pint_tpu/amortized +
+#: the service's posterior door): one flow_train record per training
+#: log tick (step, ELBO estimate, learning rate) and one
+#: posterior_serve per served draw/log-prob request.  Same contract
+#: style as the other event families — a drift in the train/service
+#: producers fails --check before it corrupts the posterior series
+#: bench/perfwatch trend.
+AMORTIZED_EVENT_ATTRS = {
+    "flow_train": {"step": int, "elbo": (int, float),
+                   "lr": (int, float)},
+    "posterior_serve": {"kind": str, "batch": int, "n": int,
+                        "latency_ms": (int, float), "compiles": int},
+}
+
+_POSTERIOR_KINDS = ("draw", "logprob")
+
+
+def validate_amortized_event(ev: dict, where: str,
+                             errors: List[str]) -> None:
+    """Attr contract for flow_train / posterior_serve records:
+    required attrs typed; a training tick's step non-negative, its
+    ELBO finite (a NaN/inf ELBO is stringified by the strict-JSON
+    stream — a numeric non-finite here is producer drift), its lr
+    strictly positive; a served request's kind in the door's enum,
+    batch/n >= 1, latency and compiles non-negative."""
+    name = ev.get("name")
+    required = AMORTIZED_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    if name == "flow_train":
+        step = attrs.get("step")
+        if isinstance(step, int) and not isinstance(step, bool) \
+                and step < 0:
+            _err(errors, where, f"flow_train step is negative ({step!r})")
+        elbo = attrs.get("elbo")
+        if isinstance(elbo, (int, float)) and not isinstance(elbo, bool) \
+                and not math.isfinite(elbo):
+            _err(errors, where,
+                 f"flow_train elbo is non-finite ({elbo!r})")
+        lr = attrs.get("lr")
+        if isinstance(lr, (int, float)) and not isinstance(lr, bool) \
+                and lr <= 0:
+            _err(errors, where, f"flow_train lr is {lr!r}, must be > 0")
+    elif name == "posterior_serve":
+        if attrs.get("kind") not in _POSTERIOR_KINDS:
+            _err(errors, where,
+                 f"posterior_serve kind {attrs.get('kind')!r} not in "
+                 f"{_POSTERIOR_KINDS}")
+        for key in ("batch", "n"):
+            v = attrs.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 1:
+                _err(errors, where,
+                     f"posterior_serve {key!r} is {v!r}, must be >= 1")
+        for key in ("latency_ms", "compiles"):
+            v = attrs.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v < 0:
+                _err(errors, where,
+                     f"posterior_serve {key!r} is negative ({v!r})")
 
 
 #: catalog-engine lifecycle events (pint_tpu/catalog): one ingest
@@ -769,6 +841,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_autotune_event(ev, where, errors)
                     validate_catalog_event(ev, where, errors)
                     validate_precision_event(ev, where, errors)
+                    validate_amortized_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -1051,6 +1124,16 @@ def self_test(errors: List[str]) -> int:
                          compute_dtype="float32",
                          accumulation="two_prod", source="tuned",
                          budget=1e-3, rel_err=1.7e-10)
+        # amortized-engine producer drift check: the train/serve event
+        # contract (AMORTIZED_EVENT_ATTRS) — an early training tick,
+        # the converged final tick, and one served request per door
+        # kind (draw + log-prob)
+        run.record_event("flow_train", step=25, elbo=-341.7, lr=0.01)
+        run.record_event("flow_train", step=300, elbo=-4.27, lr=0.01)
+        run.record_event("posterior_serve", kind="draw", batch=4,
+                         n=256, bucket=256, latency_ms=2.1, compiles=0)
+        run.record_event("posterior_serve", kind="logprob", batch=1,
+                         n=256, bucket=256, latency_ms=1.4, compiles=0)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
@@ -1058,9 +1141,9 @@ def self_test(errors: List[str]) -> int:
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
         # sharding_plan, 3x elastic events, 3x serving events, 2x
         # autotune events, 3x catalog events, 3x precision events,
-        # metrics, run_end
-        if n < 24:
-            _err(errors, "selftest", f"expected >= 24 records, got {n}")
+        # 4x amortized events, metrics, run_end
+        if n < 28:
+            _err(errors, "selftest", f"expected >= 28 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
