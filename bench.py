@@ -298,6 +298,18 @@ def bench_b1855_gls():
                      "error": f"{type(e).__name__}: {e}"}
     st.mark("posterior measurement")
 
+    # work-per-byte scaling accounting (ROADMAP item 2): fused-dispatch
+    # rate measured live, efficiency/scatter bytes restamped from the
+    # newest committed scalewatch series.  Never fatal, same degraded-
+    # block discipline.
+    try:
+        scaling = scaling_block()
+    except Exception as e:
+        scaling = {"efficiency_at_max": None, "dispatch_per_s": None,
+                   "scatter_bytes": None,
+                   "error": f"{type(e).__name__}: {e}"}
+    st.mark("scaling measurement")
+
     imin = np.unravel_index(np.argmin(chi2), chi2.shape)
     # convergence-grade sanity, not just order-of-magnitude: the measured
     # grid-min-vs-fit gap is ~0.02 chi2 units (pure grid discretization);
@@ -325,6 +337,7 @@ def bench_b1855_gls():
         "precision": prec,
         "catalog": catalog,
         "posterior": posterior,
+        "scaling": scaling,
     }
 
 
@@ -604,6 +617,81 @@ def catalog_block():
             CATALOG_LNLIKE_WALKERS * CATALOG_LNLIKE_REPS / lnl_elapsed,
             3),
         "steady_state_compiles": int(res.compiles),
+    }
+
+
+#: scaling-block knobs: the live fused-dispatch probe's catalog size
+#: and scanned depth (small — the probe times dispatch rate, not
+#: compute; env-overridable so the contract test stays fast)
+SCALING_PROBE_PULSARS = 4
+SCALING_PROBE_STEPS = 8
+SCALING_PROBE_REPS = 8
+
+
+def scaling_block():
+    """The headline's ``scaling{}`` block — work-per-byte execution-plan
+    accounting ``tools/perfwatch.py`` gates:
+
+    * ``dispatch_per_s``: measured live — back-to-back dispatch rate of
+      the scan-fused catalog executable (one bucket, ``steps`` fused
+      fit steps per dispatch; a slower fused executable is a dispatch-
+      amortization regression);
+    * ``efficiency_at_max`` / ``scatter_bytes``: restamped from the
+      newest committed ``SCALING_r*.json`` series (catalog-workload
+      parallel efficiency at the top device count; the grid workload's
+      reduce-scatter payload bytes) — provenance from
+      ``tools/scalewatch.py``, so perfwatch trends the same numbers the
+      scalewatch gate protects and a PR that commits a worse series
+      trips BOTH gates."""
+    from pint_tpu.catalog import CatalogFitter, ingest_catalog
+    from pint_tpu.catalog.ingest import make_synthetic_catalog
+
+    import jax
+
+    n = int(os.environ.get("BENCH_SCALING_PULSARS",
+                           str(SCALING_PROBE_PULSARS)))
+    report = ingest_catalog(make_synthetic_catalog(
+        n_pulsars=max(2, n), seed=20260804, ntoa_range=(24, 64)))
+    cf = CatalogFitter(report)
+    handles = cf.fused_bucket_executables(steps=SCALING_PROBE_STEPS,
+                                          reweight="huber")
+    for fn, ops in handles.values():
+        jax.block_until_ready(fn(*ops))    # warm: compile outside timing
+    t0 = time.time()
+    out = None
+    for _ in range(SCALING_PROBE_REPS):
+        for fn, ops in handles.values():
+            out = fn(*ops)
+    jax.block_until_ready(out)
+    elapsed = time.time() - t0
+    dispatches = SCALING_PROBE_REPS * len(handles)
+    if elapsed <= 0:
+        raise RuntimeError(f"scaling probe degenerate: {elapsed}s")
+
+    # committed-series provenance: newest catalog-workload efficiency,
+    # newest grid-workload reduce-scatter bytes at the top device count
+    from tools.scalewatch import collect_history
+
+    errors: list = []
+    history = collect_history([], os.path.dirname(
+        os.path.abspath(__file__)), errors)
+    eff = None
+    scatter = None
+    for doc in history:
+        wl = str(doc.get("workload", ""))
+        if wl == "catalog_batched_fit":
+            eff = doc.get("efficiency_at_max")
+        else:
+            series = doc.get("series") or [{}]
+            scatter = series[-1].get("collective_bytes")
+    if errors:
+        raise RuntimeError("scaling history unreadable: "
+                           + "; ".join(errors[:2]))
+    return {
+        "efficiency_at_max": eff,
+        "dispatch_per_s": round(dispatches / elapsed, 3),
+        "scatter_bytes": scatter,
+        "fused_steps": SCALING_PROBE_STEPS,
     }
 
 
@@ -1015,6 +1103,11 @@ def main():
         # warm-served posterior draw/log-prob throughput and latency
         # (perfwatch gates draws_per_s drops and p99_ms rises)
         "posterior": r["posterior"],
+        # work-per-byte scaling: fused-dispatch rate (live) plus the
+        # committed scalewatch series' efficiency / scatter bytes
+        # (perfwatch gates efficiency/dispatch drops and scatter-byte
+        # rises)
+        "scaling": r["scaling"],
     }
     if not platform_ok:
         out["platform_mismatch"] = True

@@ -53,6 +53,7 @@ from pint_tpu.autotune.search import (
     tune_catalog_ladders,
     tune_grid_chunk,
     tune_plan_axes,
+    tune_plan_strategy,
     tune_precision,
     tune_solve_rung,
 )
@@ -62,15 +63,15 @@ __all__ = ["AUTOTUNE_SCHEMA", "TUNE_MANIFEST_SCHEMA", "Candidate",
            "reset_manifest_singleton", "sweep_record", "decision_record",
            "chunk_ladder", "rank_grid_chunks", "confirm_measured",
            "measured_from_sweep", "tune_grid_chunk", "tune_solve_rung",
-           "tune_plan_axes", "tune_bucket_ladders",
+           "tune_plan_axes", "tune_plan_strategy", "tune_bucket_ladders",
            "tune_catalog_ladders", "tune_precision",
            "autotune_workload", "resolve", "resolve_grid_chunk",
            "resolve_solve_ladder", "resolve_plan_axes",
-           "resolve_serve_buckets", "resolve_catalog_ladders",
-           "resolve_correction_dtype",
+           "resolve_plan_strategy", "resolve_serve_buckets",
+           "resolve_catalog_ladders", "resolve_correction_dtype",
            "grid_chunk_vkey", "solve_rung_vkey", "plan_axes_vkey",
-           "serve_buckets_vkey", "catalog_buckets_vkey",
-           "correction_dtype_vkey"]
+           "plan_strategy_vkey", "serve_buckets_vkey",
+           "catalog_buckets_vkey", "correction_dtype_vkey"]
 
 
 def _emit_event(name: str, **attrs) -> None:
@@ -111,6 +112,13 @@ def solve_rung_vkey(ftr) -> tuple:
 
 def plan_axes_vkey(workload: str) -> tuple:
     return ("plan.axes", str(workload))
+
+
+def plan_strategy_vkey(workload: str) -> tuple:
+    """The plan-strategy optimum (which axes, which mechanism) is a
+    property of the workload's communication structure, not of one
+    fitter's values — same keying rationale as the axis order."""
+    return ("plan.strategy", str(workload))
 
 
 def serve_buckets_vkey() -> tuple:
@@ -235,6 +243,35 @@ def resolve_plan_axes(workload: str) -> Optional[Tuple[str, ...]]:
     if source != "tuned" or not value:
         return None
     return tuple(str(a) for a in value)
+
+
+def resolve_plan_strategy(workload: str) -> Optional[dict]:
+    """Tuned plan strategy for ``workload`` — ``{"axes": (...), "kind":
+    "pjit"|"shard_map", "build": "scatter"|"allreduce"|"dataparallel"}``
+    — or ``None`` (the static selection rules).  The full-strategy
+    extension of :func:`resolve_plan_axes`: the tunable ranks whole
+    (axes, mechanism, collective form) candidates on real compiled
+    executables (:func:`~pint_tpu.autotune.search.tune_plan_strategy`).
+    Consumers: :func:`~pint_tpu.runtime.plan.select_plan` applies
+    axes/kind (batch-axis strategies only when the caller actually has
+    a batch), the GLS Gram builders route scatter-vs-allreduce on
+    ``build``."""
+    if config.tune_dir() is None:
+        return None
+    value, source = resolve(f"plan.strategy/{workload}",
+                            plan_strategy_vkey(workload), None,
+                            requested=False)
+    if source != "tuned" or not isinstance(value, dict):
+        return None
+    axes = value.get("axes")
+    kind = value.get("kind")
+    if not (isinstance(axes, (list, tuple)) and axes
+            and kind in ("pjit", "shard_map")):
+        return None
+    out = {"axes": tuple(str(a) for a in axes), "kind": str(kind)}
+    if value.get("build") in ("scatter", "allreduce", "dataparallel"):
+        out["build"] = str(value["build"])
+    return out
 
 
 def resolve_serve_buckets() -> Optional[dict]:

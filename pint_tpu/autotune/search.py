@@ -615,6 +615,156 @@ def tune_plan_axes(ftr, workload: str = "grid",
     return decision
 
 
+def tune_plan_strategy(ftr, workload: str = "gls_normal_eq",
+                       n_batch: int = 8, measure_reps: int = 3,
+                       tuning_manifest: Optional[TuningManifest] = None
+                       ) -> TuningDecision:
+    """Rank whole plan strategies — (mesh axes, mechanism, collective
+    form) — for ``workload``, the full-strategy extension of
+    :func:`tune_plan_axes` ROADMAP item 2 asks for.
+
+    Three candidates for the GLS normal-equation build, each analyzed
+    on a REAL compiled executable (distview collective bytes, the
+    cost-ranking signal), then the viable ones measure-confirmed with
+    ``measure_reps`` timed dispatches (best measured seconds wins;
+    collective bytes break ties):
+
+    * ``toa/scatter`` — TOA-sharded reduce-scatter Gram
+      (:mod:`pint_tpu.runtime.workperbyte`): K^2/D bytes per collective;
+    * ``toa/allreduce`` — the legacy full-Gram all-reduce build:
+      K^2 bytes to every device (the SCALING_r06 shape);
+    * ``pulsar/dataparallel`` — ``n_batch`` independent systems
+      batched on the ``pulsar`` axis: zero reduction collectives (any
+      bytes are resharding overhead), the honest route whenever the
+      caller HAS a batch.
+
+    With fewer than two healthy devices the choice is degenerate and
+    the static default is recorded with that reason."""
+    from pint_tpu.autotune import plan_strategy_vkey
+    from pint_tpu.runtime.plan import ExecutionPlan, ladder
+    from pint_tpu.runtime.preflight import healthy_devices
+
+    if workload != "gls_normal_eq":
+        raise UsageError(
+            f"plan-strategy tuning covers 'gls_normal_eq' (the workload "
+            f"with competing reduction/batch shardings), got {workload!r}")
+    default = {"axes": ["toa"], "kind": "pjit", "build": "scatter"}
+    devices = tuple(healthy_devices())
+    if len(devices) < 2:
+        decision = TuningDecision(
+            name=f"plan.strategy/{workload}", value=default,
+            static_default=default, vkey=plan_strategy_vkey(workload),
+            basis="degenerate",
+            reason=f"{len(devices)} healthy device(s): every strategy "
+                   "builds the same single-device plan")
+        if tuning_manifest is not None:
+            tuning_manifest.record(decision)
+        return decision
+    import time as _time
+
+    import jax
+
+    from pint_tpu.telemetry import distview as _distview
+
+    rung = ladder(len(devices))[0]
+
+    def _dataparallel_handle():
+        from pint_tpu.serving.batcher import (
+            FitRequest, bucket_of, pad_request, serve_batched,
+            DEFAULT_NFREE_BUCKETS, DEFAULT_NTOA_BUCKETS)
+
+        req = FitRequest.from_fitter(ftr)
+        bn = bucket_of(req.n_toas, DEFAULT_NTOA_BUCKETS)
+        bk = bucket_of(req.n_free, DEFAULT_NFREE_BUCKETS)
+        padded = pad_request(req, bn, bk)
+        lanes = max(int(n_batch), rung)
+        lanes = -(-lanes // rung) * rung      # tile onto the mesh
+        operands = tuple(np.stack([p] * lanes) for p in padded)
+        plan = ExecutionPlan(workload="catalog", kind="pjit",
+                             axes=("pulsar",), devices=devices,
+                             rung=rung)
+        sharding = plan.batch_sharding()
+        operands = tuple(jax.device_put(a, sharding) for a in operands)
+        # one dispatch of this executable retires `lanes` whole fits —
+        # the measured ranking must normalize per fit, or a dispatch
+        # doing 8 fits' work would be scored against one Gram build
+        return serve_batched(), operands, lanes
+
+    strategies = (
+        ({"axes": ["toa"], "kind": "pjit", "build": "scatter"},
+         lambda: ftr.gls_normal_equations_executable(
+             plan=ExecutionPlan(workload=workload, kind="pjit",
+                                axes=("toa",), devices=devices,
+                                rung=rung), scatter=True) + (1,)),
+        ({"axes": ["toa"], "kind": "pjit", "build": "allreduce"},
+         lambda: ftr.gls_normal_equations_executable(
+             plan=ExecutionPlan(workload=workload, kind="pjit",
+                                axes=("toa",), devices=devices,
+                                rung=rung), scatter=False) + (1,)),
+        ({"axes": ["pulsar"], "kind": "pjit", "build": "dataparallel"},
+         _dataparallel_handle),
+    )
+    cands: List[Candidate] = []
+    for value, build in strategies:
+        cand = Candidate(value=dict(value))
+        try:
+            fn, args, units = build()
+            name = f"plan.strategy[{value['build']}]"
+            coll = _distview.analyze_jitted_collectives(fn, *args,
+                                                        name=name)
+            if coll.error:
+                cand.excluded = f"collective analysis degraded: " \
+                                f"{coll.error}"
+            else:
+                cand.extra["collective_bytes"] = coll.collective_bytes
+                cand.extra["collective_ops"] = {
+                    k: int(v["count"]) for k, v in coll.ops.items()}
+                cand.predicted_s = float(coll.collective_bytes)
+                # measured confirmation: timed dispatches of the same
+                # executable (what the cost ranking predicts, measured)
+                jax.block_until_ready(fn(*args))
+                t0 = _time.perf_counter()
+                for _ in range(max(1, int(measure_reps))):
+                    out = fn(*args)
+                jax.block_until_ready(out)
+                wall = (_time.perf_counter() - t0) \
+                    / max(1, int(measure_reps))
+                # per-fit-equivalent normalization: `units` whole fits
+                # per dispatch for the batched candidate (its dispatch
+                # also pays the full solve, so this is conservative in
+                # the toa candidates' favor), one system build for the
+                # sharded ones
+                cand.extra["units_per_dispatch"] = int(units)
+                cand.measured_fits_per_s = units / max(wall, 1e-9)
+                cand.measured_source = "run"
+        except Exception as e:
+            cand.excluded = f"{type(e).__name__}: {e}"
+        cands.append(cand)
+    viable = [c for c in cands if c.excluded is None
+              and c.measured_fits_per_s is not None]
+    if viable:
+        viable.sort(key=lambda c: (-c.measured_fits_per_s,
+                                   c.predicted_s))
+        value = dict(viable[0].value)
+        basis = "measured"
+        reason = ("best measured per-fit rate among "
+                  f"{len(viable)} viable strateg(ies), collective bytes "
+                  "as tie-break")
+    else:
+        value, basis = dict(default), "static"
+        reason = ("every strategy candidate excluded "
+                  f"({'; '.join(c.excluded for c in cands[:2])}); "
+                  "static default retained")
+    decision = TuningDecision(
+        name=f"plan.strategy/{workload}", value=value,
+        static_default=default, vkey=plan_strategy_vkey(workload),
+        basis=basis, candidates=[c.to_dict() for c in cands],
+        reason=reason)
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
 def _sharded_grid_profiles(ftr, points, plan, niter):
     """(CollectiveProfile, CostProfile) of the grid chunk executable
     under ``plan``'s sharding."""

@@ -30,8 +30,10 @@ host-call-in-jit finding, exactly like the serving/autotune packages.
 from pint_tpu.catalog.batchfit import (
     CatalogFitResult,
     CatalogFitter,
+    CatalogRefineResult,
     PulsarFit,
     catalog_batched,
+    catalog_fused,
 )
 from pint_tpu.catalog.buckets import BucketPlan, assign_buckets, learn_ladders
 from pint_tpu.catalog.crosscorr import (
@@ -50,7 +52,8 @@ from pint_tpu.catalog.ingest import (
 from pint_tpu.catalog.likelihood import JointLikelihood
 
 __all__ = [
-    "CatalogFitResult", "CatalogFitter", "PulsarFit", "catalog_batched",
+    "CatalogFitResult", "CatalogFitter", "CatalogRefineResult",
+    "PulsarFit", "catalog_batched", "catalog_fused",
     "BucketPlan", "assign_buckets", "learn_ladders",
     "angular_separations", "hd_cholesky", "hd_curve", "hd_matrix",
     "pulsar_directions",

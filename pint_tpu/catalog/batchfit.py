@@ -37,9 +37,15 @@ from pint_tpu import config
 from pint_tpu.exceptions import NonFiniteSystemError, UsageError
 from pint_tpu.logging import log
 
-__all__ = ["CatalogFitter", "CatalogFitResult", "PulsarFit",
-           "catalog_batched", "resolve_catalog_fit_spec",
-           "DEFAULT_CATALOG_BATCH_BUCKETS"]
+__all__ = ["CatalogFitter", "CatalogFitResult", "CatalogRefineResult",
+           "PulsarFit", "catalog_batched", "catalog_fused",
+           "resolve_catalog_fit_spec", "DEFAULT_CATALOG_BATCH_BUCKETS",
+           "DEFAULT_REFINE_STEPS"]
+
+#: default fused refinement depth: enough scanned steps that one
+#: dispatch amortizes the per-dispatch floor the scaling series
+#: measured (SCALING_r11: ~5 ms walls were ALL dispatch overhead)
+DEFAULT_REFINE_STEPS = 8
 
 #: batch-axis ladder for bucket groups (powers of two so an elastic
 #: mesh rung always divides the batch)
@@ -61,6 +67,23 @@ def resolve_catalog_fit_spec():
     from pint_tpu.precision import segment_spec
 
     return segment_spec("catalog.fit")
+
+
+def catalog_fused(spec=None, steps: int = DEFAULT_REFINE_STEPS,
+                  reweight=None):
+    """The scan-fused batched catalog executable: ``steps`` linearized
+    fit steps per pulsar lane retired by ONE dispatch per bucket
+    (:func:`pint_tpu.serving.batcher.serve_fused` — the dispatch-floor
+    fix ROADMAP item 2 demands; per-dispatch overhead is paid once per
+    bucket instead of once per step).  ``reweight="huber"`` makes the
+    scanned steps re-accumulate Huber-IRLS-reweighted Grams on the
+    cache-resident design (robust refinement — legitimate on the
+    augmented Woodbury system, whose whitener is diagonal)."""
+    from pint_tpu.serving.batcher import serve_fused
+
+    if spec is None:
+        spec = resolve_catalog_fit_spec()
+    return serve_fused(spec, steps=steps, reweight=reweight)
 
 
 def catalog_batched(spec=None):
@@ -127,6 +150,36 @@ class CatalogFitResult:
             "wall_s": self.wall_s,
             "chi2_total": self.chi2_total,
         }
+
+
+@dataclass
+class CatalogRefineResult:
+    """Outcome of one :meth:`CatalogFitter.refine` fused pass."""
+
+    steps: int = 1
+    reweight: Optional[str] = None
+    n_buckets: int = 0
+    #: fused executables dispatched (== n_buckets: ONE per bucket for
+    #: the whole step ladder — the dispatch-amortization contract)
+    dispatches: int = 0
+    compiles: int = 0
+    wall_s: float = 0.0
+    #: per-pulsar chi2 trajectory over the scanned steps
+    chi2_steps: Dict[str, "np.ndarray"] = field(default_factory=dict)
+    #: per-pulsar physical steps at the FIRST scanned step (identical
+    #: to a dedicated single-step fit for reweight=None — the pin)
+    dpars_first: Dict[str, Dict[str, float]] = field(default_factory=dict)
+
+    @property
+    def chi2_final(self) -> float:
+        return float(sum(float(v[-1]) for v in self.chi2_steps.values()))
+
+    def to_dict(self) -> dict:
+        return {"steps": self.steps, "reweight": self.reweight,
+                "n_buckets": self.n_buckets,
+                "dispatches": self.dispatches,
+                "compiles": self.compiles, "wall_s": self.wall_s,
+                "chi2_final": self.chi2_final}
 
 
 class CatalogFitter:
@@ -269,6 +322,84 @@ class CatalogFitter:
             name = self._bucket_name(operands[0].shape[0], bucket, spec)
             out[name] = (catalog_batched(spec), operands)
         return out
+
+    def fused_bucket_executables(self, steps: int = DEFAULT_REFINE_STEPS,
+                                 reweight=None,
+                                 spec=None) -> Dict[str, tuple]:
+        """``name -> (scan-fused jitted fn, operands)`` per bucket at
+        the CURRENT linearized state — ONE dispatch per bucket retires
+        ``steps`` fit steps for every member (the work-per-byte
+        executable the scalewatch catalog series measures and
+        :meth:`refine` dispatches).  Operands are built by the same
+        :meth:`_group_operands` path as :meth:`bucket_executables`, so
+        plan sharding (pulsar-axis data-parallel) applies unchanged."""
+        reqs = self._requests()
+        if spec is None:
+            spec = resolve_catalog_fit_spec()
+        suffix = f"|scan{int(steps)}" + (f"+{reweight}" if reweight else "")
+        out: Dict[str, tuple] = {}
+        for bucket, idx in sorted(self.bucket_plan.buckets.items()):
+            group = [reqs[i] for i in idx]
+            operands = self._group_operands(bucket, group)
+            name = self._bucket_name(operands[0].shape[0], bucket,
+                                     spec) + suffix
+            out[name] = (catalog_fused(spec, steps=steps,
+                                       reweight=reweight), operands)
+        return out
+
+    def refine(self, steps: int = DEFAULT_REFINE_STEPS,
+               reweight=None) -> CatalogRefineResult:
+        """Run ``steps`` fused linearized fit steps per pulsar at the
+        current state: one scan-fused dispatch per bucket (dispatches
+        == buckets, not buckets x steps — the amortization the 0.024-
+        efficiency catalog series was missing).  The per-pulsar models
+        are NOT mutated — this is the evaluation/refinement pass (step
+        0 equals a dedicated single-step fit for ``reweight=None``;
+        ``"huber"`` runs robust IRLS refinement); :meth:`fit` remains
+        the exact host-relinearized path."""
+        from pint_tpu.telemetry import jaxevents as _jaxevents
+        from pint_tpu.telemetry import span as _span
+
+        t0 = time.perf_counter()
+        before = _jaxevents.counts()
+        result = CatalogRefineResult(steps=int(steps), reweight=reweight,
+                                     n_buckets=self.bucket_plan.n_buckets)
+        with _span("catalog.refine", n_pulsars=len(self.pulsars),
+                   steps=int(steps),
+                   reweight=str(reweight)) as sp, _jaxevents.watch(sp):
+            reqs = self._requests()
+            spec = resolve_catalog_fit_spec()
+            for bucket, idx in sorted(self.bucket_plan.buckets.items()):
+                group = [reqs[i] for i in idx]
+                operands = self._group_operands(bucket, group)
+                fn = catalog_fused(spec, steps=steps, reweight=reweight)
+                dxs, err, chi2s, chi2_init = (np.asarray(o) for o in
+                                              fn(*operands))
+                result.dispatches += 1
+                # vmapped outputs: dxs (batch, steps, k), chi2s
+                # (batch, steps) — lane j is pulsar idx[j]
+                for j, i in enumerate(idx):
+                    req = reqs[i]
+                    name = self.pulsars[i].name
+                    if not np.all(np.isfinite(chi2s[j])):
+                        raise NonFiniteSystemError(
+                            f"fused catalog refinement produced "
+                            f"non-finite chi2 for {name}")
+                    result.chi2_steps[name] = chi2s[j].copy()
+                    k = req.n_free
+                    norm = req.norm if req.norm is not None \
+                        else np.ones(k)
+                    result.dpars_first[name] = {
+                        par: float(dxs[j, 0, jj] / norm[jj])
+                        for jj, par in enumerate(req.params)}
+            result.compiles = int(
+                (_jaxevents.counts() - before).compiles)
+            result.wall_s = time.perf_counter() - t0
+            sp.attrs["chi2_final"] = result.chi2_final
+        log.info(f"catalog refine: {len(self.pulsars)} pulsar(s) x "
+                 f"{steps} step(s) in {result.dispatches} dispatch(es), "
+                 f"{result.compiles} compile(s), {result.wall_s:.3f}s")
+        return result
 
     # -- warm-up -----------------------------------------------------------
 

@@ -183,6 +183,17 @@ class MeshExhaustedError(DeviceError):
     device subset remains that can execute the plan."""
 
 
+class CollectiveContractError(DeviceError):
+    """A compiled executable's cross-device collectives violate the
+    execution plan's HLO contract (e.g. the scattered Gram build
+    compiled to a full-tensor all-reduce instead of a reduce-scatter).
+    ``violations`` lists the broken clauses."""
+
+    def __init__(self, msg: str, violations=()):
+        self.violations = list(violations)
+        super().__init__(msg)
+
+
 class CheckpointError(PintError):
     """A sweep checkpoint is unusable: fingerprint mismatch, corrupt
     chunk file, or incompatible layout."""

@@ -342,21 +342,34 @@ def _gls_normal_equations_for(spec=None):
     return fn
 
 
-def _sharded_normal_equations(M: np.ndarray, r: np.ndarray,
-                              Nvec: np.ndarray, phiinv: np.ndarray, plan,
-                              spec=None):
-    """The Woodbury normal-equation build executed on ``plan``'s mesh:
-    TOA-indexed operands sharded over the plan's first axis, so the
-    ``M^T C^-1 M`` / ``M^T C^-1 r`` contractions compile into real
-    cross-device all-reduces.  Rows are zero-padded to a shard multiple
-    (``Nvec`` pads with 1.0), which contributes exactly zero to every
-    sum — results are identical to the host build, not trimmed."""
+def _tuned_gram_build() -> str:
+    """The tuned collective form of the sharded Gram build —
+    ``"scatter"`` (static default: the reduce-scatter kernel) or
+    ``"allreduce"`` (the legacy build, when the plan-strategy tunable
+    measured it faster on this system).  The routing half of the
+    ``plan.strategy`` decision: a measured winner that nothing enacts
+    would be manifest fiction."""
+    from pint_tpu import autotune as _autotune
+
+    strategy = _autotune.resolve_plan_strategy("gls_normal_eq")
+    if strategy is not None and strategy.get("build") == "allreduce":
+        return "allreduce"
+    return "scatter"
+
+
+def _allreduce_normal_equations(M: np.ndarray, r: np.ndarray,
+                                Nvec: np.ndarray, phiinv: np.ndarray,
+                                mesh, spec=None):
+    """The legacy sharded build: jit over TOA-sharded operands, the
+    Gram contractions compiling into full all-reduces.  Zero-weight
+    row padding to the shard multiple (``Nvec`` pads 1.0) — exact, not
+    trimmed.  Kept as the plan-strategy tunable's comparison candidate
+    and its routed form when measured faster."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    mesh = plan.mesh
     axis = mesh.axis_names[0]
-    shards = int(mesh.devices.size)
+    shards = int(mesh.shape[axis])
     pad = (-len(r)) % shards
     if pad:
         M = np.vstack([M, np.zeros((pad, M.shape[1]))])
@@ -367,6 +380,29 @@ def _sharded_normal_equations(M: np.ndarray, r: np.ndarray,
             for a, s in zip((M, r, Nvec, phiinv), specs)]
     mtcm, mtcy = _gls_normal_equations_for(spec)(*args)
     return np.asarray(mtcm), np.asarray(mtcy)
+
+
+def _sharded_normal_equations(M: np.ndarray, r: np.ndarray,
+                              Nvec: np.ndarray, phiinv: np.ndarray, plan,
+                              spec=None):
+    """The Woodbury normal-equation build executed on ``plan``'s mesh —
+    by default the reduce-scatter kernel (:func:`pint_tpu.runtime.
+    workperbyte.scattered_normal_equations`): per-shard partial Grams
+    are ``psum_scatter``'d so each device materializes only its slice
+    of the normal matrix (K^2/D bytes per collective instead of the
+    old full-Gram all-reduce's K^2 per device), gathered once before
+    the host Cholesky.  A tuned ``plan.strategy`` decision whose
+    measured winner is the legacy all-reduce build routes there
+    instead (:func:`_tuned_gram_build`).  Either way rows are
+    zero-padded to a shard multiple (``Nvec`` pads with 1.0), which
+    contributes exactly zero to every sum — results are identical to
+    the host build, not trimmed."""
+    if _tuned_gram_build() == "allreduce":
+        return _allreduce_normal_equations(M, r, Nvec, phiinv,
+                                           plan.mesh, spec=spec)
+    from pint_tpu.runtime.workperbyte import scattered_normal_equations
+
+    return scattered_normal_equations(M, r, Nvec, phiinv, plan, spec=spec)
 
 
 class GLSFitter(Fitter):
@@ -502,7 +538,8 @@ class GLSFitter(Fitter):
         mtcm, mtcy = gls_normal_equations(M, r, Nvec=Nvec, phiinv=phiinv)
         return _gls_cholesky_solve, (jnp.asarray(mtcm), jnp.asarray(mtcy))
 
-    def gls_normal_equations_executable(self, mesh=None, plan=None):
+    def gls_normal_equations_executable(self, mesh=None, plan=None,
+                                        scatter: Optional[bool] = None):
         """(jitted fn, (M, r, Nvec, phiinv)) — the Woodbury-form GLS
         normal-equation build (``M^T C^-1 M + diag(phiinv)``, ``M^T C^-1
         r``) at this fitter's augmented-system shapes, as one jittable
@@ -511,15 +548,26 @@ class GLSFitter(Fitter):
         ``plan`` (an :class:`~pint_tpu.runtime.plan.ExecutionPlan` over
         the 'toa' axis) supplies the mesh the production fit path uses,
         so the scalewatch/dryrun observatory measures the routed
-        executable.  With a ``mesh`` the TOA-indexed operands (augmented design
-        matrix rows, residuals, white-noise variances) are placed
-        sharded over the mesh's FIRST axis, so the contractions over the
-        TOA axis compile into cross-device all-reduces — the reduction
-        :mod:`pint_tpu.telemetry.distview` accounts bytes for.  The TOA
-        count is trimmed to a multiple of the shard count (the ragged
-        remainder is < n_devices rows; analysis shapes, not fit
-        results).  The jitted fn is module-level for the same
-        warm-cache reason as :func:`_gls_cholesky_solve`."""
+        executable.  With a mesh the default (``scatter=None`` — the
+        tuned ``plan.strategy`` build, scatter when untuned: exactly
+        what :func:`_sharded_normal_equations` routes) is the
+        production reduce-scatter kernel (:mod:`pint_tpu.runtime.
+        workperbyte`): per-shard partial Grams ``psum_scatter``'d so
+        each device holds only its slice — the executable
+        :func:`~pint_tpu.runtime.workperbyte.verify_scatter_contract`
+        checks for a real ``reduce-scatter`` (and no full-Gram
+        ``all-reduce``) in the compiled HLO.  ``scatter=False`` keeps
+        the legacy jit-of-sharded-operands build whose contractions
+        compile into full all-reduces — the comparison candidate the
+        plan-strategy tunable ranks collective bytes against.
+
+        Either way the TOA count is zero-weight PADDED to the shard
+        multiple (``Nvec`` pads with 1.0 — the serving batcher's
+        construction, contributing exactly zero to every contraction),
+        never trimmed: the analyzed executable computes the same system
+        the unsharded build does, to 1e-9.  The jitted fns are
+        module-level for the same warm-cache reason as
+        :func:`_gls_cholesky_solve`."""
         if plan is not None:
             if mesh is not None:
                 raise UsageError("plan= and mesh= cannot be combined; the "
@@ -528,6 +576,23 @@ class GLSFitter(Fitter):
         r = np.asarray(self.resids.time_resids)
         M, params, norm, phiinv, Nvec, _ = build_augmented_system(
             self.model, self.toas)
+        pspec = _design_spec(self.model, self.toas)
+        if scatter is None:
+            scatter = _tuned_gram_build() == "scatter"
+        if mesh is not None and scatter:
+            from pint_tpu.runtime.workperbyte import (
+                SCATTER_ROW_CHUNKS,
+                scattered_gram_operands,
+                scattered_normal_equations_fn,
+            )
+
+            row_chunks = SCATTER_ROW_CHUNKS \
+                if len(r) >= 2 * SCATTER_ROW_CHUNKS * int(
+                    mesh.shape[mesh.axis_names[0]]) else 1
+            args, _ = scattered_gram_operands(M, r, Nvec, phiinv, mesh,
+                                              row_chunks=row_chunks)
+            return scattered_normal_equations_fn(
+                mesh, spec=pspec, row_chunks=row_chunks), tuple(args)
         args = [jnp.asarray(M), jnp.asarray(r), jnp.asarray(Nvec),
                 jnp.asarray(phiinv)]
         if mesh is not None:
@@ -537,15 +602,25 @@ class GLSFitter(Fitter):
 
             axis = mesh.axis_names[0]
             shards = int(mesh.shape[axis])
-            keep = (len(r) // shards) * shards
-            if keep == 0:
+            if len(r) < shards:
                 raise UsageError(
                     f"cannot shard {len(r)} TOAs over {shards} devices")
+            pad = (-len(r)) % shards
+            if pad:
+                # zero-weight pad rows instead of the old trim: the
+                # padded rows cannot enter the normal equations, so the
+                # analyzed system IS the fit's system (the trim silently
+                # dropped up to shards-1 TOAs from the solve)
+                args[0] = jnp.concatenate(
+                    [args[0], jnp.zeros((pad, M.shape[1]),
+                                        dtype=jnp.float64)])
+                args[1] = jnp.concatenate(
+                    [args[1], jnp.zeros(pad, dtype=jnp.float64)])
+                args[2] = jnp.concatenate(
+                    [args[2], jnp.ones(pad, dtype=jnp.float64)])
             specs = [P(axis, None), P(axis), P(axis), P()]
-            args = [args[0][:keep], args[1][:keep], args[2][:keep], args[3]]
             args = [jax.device_put(a, NamedSharding(mesh, s))
                     for a, s in zip(args, specs)]
-        pspec = _design_spec(self.model, self.toas)
         return _gls_normal_equations_for(pspec), tuple(args)
 
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,

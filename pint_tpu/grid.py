@@ -796,12 +796,46 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
     _ESCALATION = (1.0, 1e3, 1e6)
 
     _last_blk: list = []
+    #: dispatches of the last fn/fused call (the dispatch-amortization
+    #: counter tests/test_workperbyte asserts on)
+    _dispatches: list = [0]
 
     def _eval_chunk(blk, scale):
         _last_blk[:] = [blk]
+        _dispatches[0] += 1
         return vfn(blk, free_init, const_pv, batch, ctx, int0, w, F0,
                    B_base, A_base, Y_base, U_w, L_D, U_chi, cf_chi,
                    s_col, jnp.float64(scale))
+
+    def _fused_vfn(fuse: int):
+        """ONE jitted scan-over-chunks executable retiring ``fuse``
+        chunk blocks per dispatch (cached in the model cache next to
+        the chunk executable, so repeat sweeps and elastic rungs hit
+        the warm cache — zero steady-state recompiles)."""
+        fkey = grid_key + ("fused", int(fuse))
+        if fkey not in model._cache:
+            def scan_chunks(blocks, free_init, const_pv, batch, ctx,
+                            int0, w, F0, B_base, A_base, Y_base, U_w,
+                            L_D, U_chi, cf_chi, s_col, scale):
+                def step(_, blk):
+                    return (), vfn(blk, free_init, const_pv, batch,
+                                   ctx, int0, w, F0, B_base, A_base,
+                                   Y_base, U_w, L_D, U_chi, cf_chi,
+                                   s_col, scale)
+
+                _, ys = jax.lax.scan(step, (), blocks)
+                return ys
+
+            model._cache[fkey] = jax.jit(scan_chunks)
+        return model._cache[fkey]
+
+    def _eval_fused(blocks, scale, fuse):
+        """Dispatch ONE scan executable over ``blocks`` (fuse, B, G)."""
+        _dispatches[0] += 1
+        return _fused_vfn(fuse)(
+            blocks, free_init, const_pv, batch, ctx, int0, w, F0,
+            B_base, A_base, Y_base, U_w, L_D, U_chi, cf_chi, s_col,
+            jnp.float64(scale))
 
     def fn(points, sharding=None):
         """(chi2 (P,), vfit (P, nfit), diag (P, 3)) — diag columns are
@@ -814,6 +848,7 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         values.  Healthy sweeps therefore cost exactly the pre-guardrail
         solve.  Points no rung solves keep NaN chi2 with rung -1 — loud,
         never fabricated."""
+        _dispatches[0] = 0
         points = jnp.asarray(points)
         npts = points.shape[0]
         blk_size = chunk
@@ -834,40 +869,147 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
         first = [_eval_chunk(b, 1.0) for b in blks]
         out, out_v, out_d = [], [], []
         for blk, keep, (c2, vf, dg) in zip(blks, keeps, first):
-            c2 = np.array(np.asarray(c2)[:keep])
-            vf = np.array(np.asarray(vf)[:keep])
-            dg = np.asarray(dg)[:keep]
-            solved = dg[:, 0] > 0.5
-            cond = np.array(dg[:, 1])
-            rung = np.where(solved, 0, -1)
-            for ri in range(1, len(_ESCALATION)):
-                if solved.all():
-                    break
-                c2e, vfe, dge = (np.asarray(a)[:keep] for a in
-                                 _eval_chunk(blk, _ESCALATION[ri]))
-                newly = ~solved & (dge[:, 0] > 0.5)
-                c2[newly] = c2e[newly]
-                vf[newly] = vfe[newly]
-                cond[newly] = dge[newly, 1]
-                rung[newly] = ri
-                solved |= newly
-            if not solved.all():
-                from pint_tpu.logging import log
-
-                log.warning(
-                    f"grid GLS solve: {int((~solved).sum())} point(s) "
-                    "unsolved at every escalation ridge — their chi2 is "
-                    "NaN (rung -1), not fabricated")
-            ridge = np.where(
-                rung >= 0,
-                _RIDGE * np.take(np.asarray(_ESCALATION),
-                                 np.maximum(rung, 0)), np.nan)
+            c2, vf, dg = _escalate_chunk(blk, keep, c2, vf, dg)
             out.append(c2)
             out_v.append(vf)
-            out_d.append(np.stack([rung.astype(np.float64), ridge, cond],
-                                  axis=1))
+            out_d.append(dg)
         return (np.concatenate(out), np.concatenate(out_v),
                 np.concatenate(out_d))
+
+    def _escalate_chunk(blk, keep, c2, vf, dg):
+        """Shared chunk-level escalation tail: re-run ONLY chunks that
+        report unsolved points at escalated ridges; failed points take
+        escalated values, the rest keep the base pass.  ``blk`` may be
+        a zero-arg callable (lazy device placement — the healthy path
+        never pays the transfer).  Returns (chi2, vfit,
+        diag-with-rung-columns) for one chunk."""
+        c2 = np.array(np.asarray(c2)[:keep])
+        vf = np.array(np.asarray(vf)[:keep])
+        dg = np.asarray(dg)[:keep]
+        solved = dg[:, 0] > 0.5
+        cond = np.array(dg[:, 1])
+        rung = np.where(solved, 0, -1)
+        for ri in range(1, len(_ESCALATION)):
+            if solved.all():
+                break
+            if callable(blk):
+                blk = blk()
+            c2e, vfe, dge = (np.asarray(a)[:keep] for a in
+                             _eval_chunk(blk, _ESCALATION[ri]))
+            newly = ~solved & (dge[:, 0] > 0.5)
+            c2[newly] = c2e[newly]
+            vf[newly] = vfe[newly]
+            cond[newly] = dge[newly, 1]
+            rung[newly] = ri
+            solved |= newly
+        if not solved.all():
+            from pint_tpu.logging import log
+
+            log.warning(
+                f"grid GLS solve: {int((~solved).sum())} point(s) "
+                "unsolved at every escalation ridge — their chi2 is "
+                "NaN (rung -1), not fabricated")
+        ridge = np.where(
+            rung >= 0,
+            _RIDGE * np.take(np.asarray(_ESCALATION),
+                             np.maximum(rung, 0)), np.nan)
+        return c2, vf, np.stack([rung.astype(np.float64), ridge, cond],
+                                axis=1)
+
+    def fused(points, sharding=None, fuse: int = 8):
+        """Scan-fused sweep: ``fuse`` chunk blocks retired per dispatch
+        through ONE ``lax.scan``-over-chunks executable (same chunk
+        kernel, same results — the scanned body IS ``vfn``), so the
+        per-dispatch overhead that dominates small shards is paid
+        ``ceil(nchunks/fuse)`` times instead of ``nchunks``.  The last
+        group pads by repeating its final block (one executable shape
+        per (fuse, chunk) pair — no steady-state recompiles).
+        Escalation stays at chunk granularity on the rare failed
+        chunks, exactly like :func:`fn`."""
+        _dispatches[0] = 0
+        fuse = max(1, int(fuse))
+        points = jnp.asarray(points)
+        npts = points.shape[0]
+        blk_size = chunk
+        if sharding is not None:
+            ndev = sharding.mesh.devices.size
+            blk_size = max(chunk, ndev) // ndev * ndev
+        blks, keeps = [], []
+        for i in range(0, npts, blk_size):
+            blk = points[i:i + blk_size]
+            pad = blk_size - blk.shape[0]
+            if pad:
+                blk = jnp.concatenate([blk, jnp.tile(blk[-1:], (pad, 1))])
+            blks.append(blk)
+            keeps.append(blk_size - pad)
+        group_sharding = None
+        if sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            group_sharding = NamedSharding(
+                sharding.mesh, P(None, *sharding.spec))
+        out, out_v, out_d = [], [], []
+        for lo in range(0, len(blks), fuse):
+            group = blks[lo:lo + fuse]
+            gkeeps = keeps[lo:lo + fuse]
+            real = len(group)
+            while len(group) < fuse:          # constant executable shape
+                group.append(group[-1])
+            blocks = jnp.stack(group)
+            if group_sharding is not None:
+                blocks = jax.device_put(blocks, group_sharding)
+            c2g, vfg, dgg = _eval_fused(blocks, 1.0, fuse)
+            c2g, vfg, dgg = (np.asarray(a) for a in (c2g, vfg, dgg))
+            for f in range(real):
+                def _blk(i=lo + f):
+                    return blks[i] if sharding is None \
+                        else jax.device_put(blks[i], sharding)
+
+                c2, vf, dg = _escalate_chunk(_blk, gkeeps[f], c2g[f],
+                                             vfg[f], dgg[f])
+                out.append(c2)
+                out_v.append(vf)
+                out_d.append(dg)
+        return (np.concatenate(out), np.concatenate(out_v),
+                np.concatenate(out_d))
+
+    def fused_eval(fuse: int, sharding=None):
+        """Per-rung fused evaluator for the elastic supervisor: a host
+        callable taking stacked blocks ``(fuse, B, G)`` and returning
+        ``{"chi2": (fuse, B), "vfit": ..., "diag": ...}`` from ONE
+        scan dispatch, with the chunk-level escalation tail applied per
+        block (same 3-column rung/ridge/condition diagnostics as the
+        unfused elastic evaluator)."""
+        group_sharding = None
+        if sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            group_sharding = NamedSharding(
+                sharding.mesh, P(None, *sharding.spec))
+
+        def ev(blocks):
+            blocks = jnp.asarray(blocks)
+            if group_sharding is not None:
+                blocks = jax.device_put(blocks, group_sharding)
+            c2g, vfg, dgg = (np.asarray(a) for a in
+                             _eval_fused(blocks, 1.0, int(fuse)))
+            B = int(blocks.shape[1])
+            c2o, vfo, dgo = [], [], []
+            for f in range(int(blocks.shape[0])):
+                def _blk(i=f):
+                    b = blocks[i]
+                    return b if sharding is None \
+                        else jax.device_put(b, sharding)
+
+                c2, vf, dg = _escalate_chunk(_blk, B, c2g[f], vfg[f],
+                                             dgg[f])
+                c2o.append(c2)
+                vfo.append(vf)
+                dgo.append(dg)
+            return {"chi2": np.stack(c2o), "vfit": np.stack(vfo),
+                    "diag": np.stack(dgo)}
+
+        return ev
 
     def analysis_handle():
         """(jitted fn, example args) of the chunk executable the last
@@ -903,6 +1045,9 @@ def build_grid_gls_chi2_fn(model, toas, grid_params: Sequence[str],
 
     fn.analysis_handle = analysis_handle
     fn.cost_handle = cost_handle
+    fn.fused = fused
+    fn.fused_eval = fused_eval
+    fn.dispatch_count = lambda: _dispatches[0]
     return fn, free_init, fit_params
 
 
@@ -1040,7 +1185,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                executor=None, ncpu=None, chunksize=1, printprogress: bool = False,
                niter: int = 4, mesh=None, chunk=None,
                checkpoint: Optional[str] = None, retry=None,
-               plan=None,
+               plan=None, fuse: Optional[int] = None,
                **fitargs) -> Tuple[np.ndarray, dict]:
     """Chi2 over an outer-product grid (reference ``gridutils.py:164`` API).
 
@@ -1076,6 +1221,16 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
     :class:`~pint_tpu.runtime.checkpoint.RetryPolicy`), and a crashed
     sweep resumes from the last completed chunk.  Per-point solve
     diagnostics land on ``ftr.last_grid_diagnostics`` either way.
+
+    ``fuse`` (GLS path) batches that many chunk blocks into ONE
+    ``lax.scan``-over-chunks executable per dispatch — the
+    work-per-byte dispatch-amortization knob (ROADMAP item 2: the
+    scaling series' small shards were dispatch-floor-bound).  Results
+    are identical to the unfused path (the scanned body IS the chunk
+    kernel); dispatches drop ``fuse``-fold.  Composes with ``plan`` +
+    ``checkpoint``: the elastic supervisor dispatches fused groups
+    while checkpoint chunks stay logical, so degradation/resume
+    semantics are unchanged.
     """
     global _warned_executor
     if (executor is not None or ncpu not in (None, 1)) and not _warned_executor:
@@ -1118,7 +1273,7 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
             # in the canary rows), so the shared build below is skipped
             chi2, vfit, diag, fit_params = _elastic_grid(
                 ftr, model, toas, parnames, mesh_pts, niter, gls,
-                chunk, checkpoint, retry, plan)
+                chunk, checkpoint, retry, plan, fuse=fuse)
             _attach_grid_diagnostics(ftr, diag, shape=shape)
             extraout = _extraout(extraparnames, fit_params, parnames,
                                  vfit, mesh_pts, model, shape=shape)
@@ -1133,6 +1288,14 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                 raise UsageError("checkpoint= and mesh= cannot be combined; "
                                  "pass plan= for elastic checkpointed "
                                  "multi-device execution")
+            if fuse is not None and int(fuse) > 1:
+                # the plain chunked executor has no fused dispatch path;
+                # silently ignoring the knob would let a caller believe
+                # dispatches dropped fuse-fold when nothing changed
+                raise UsageError(
+                    "fuse= with checkpoint= needs plan= (the elastic "
+                    "supervisor owns fused checkpointed dispatch); drop "
+                    "fuse or add plan='auto'")
             from pint_tpu.runtime.preflight import device_profile
 
             # the fingerprint must cover everything the chi2 surface depends
@@ -1157,9 +1320,15 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
 
             sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
             if gls:
-                # chunked path: each fixed-size chunk is sharded on entry
-                chi2, vfit, diag = fn(jnp.asarray(mesh_pts),
-                                      sharding=sharding)
+                # chunked path: each fixed-size chunk is sharded on
+                # entry; fuse>1 retires that many chunks per dispatch
+                if fuse is not None and int(fuse) > 1:
+                    chi2, vfit, diag = fn.fused(jnp.asarray(mesh_pts),
+                                                sharding=sharding,
+                                                fuse=int(fuse))
+                else:
+                    chi2, vfit, diag = fn(jnp.asarray(mesh_pts),
+                                          sharding=sharding)
             else:
                 pts = jnp.asarray(mesh_pts)
                 npts = pts.shape[0]
@@ -1171,6 +1340,10 @@ def grid_chisq(ftr, parnames: Sequence[str], parvalues: Sequence,
                 pts = jax.device_put(pts, sharding)
                 chi2, vfit, diag = fn(pts)
                 chi2, vfit, diag = chi2[:npts], vfit[:npts], diag[:npts]
+        elif fuse is not None and int(fuse) > 1 and gls:
+            with _tspan("grid.evaluate") as esp:
+                chi2, vfit, diag = esp.sync(
+                    fn.fused(jnp.asarray(mesh_pts), fuse=int(fuse)))
         else:
             with _tspan("grid.evaluate") as esp:
                 chi2, vfit, diag = esp.sync(fn(jnp.asarray(mesh_pts)))
@@ -1231,11 +1404,14 @@ def _checkpointed_grid(fn, mesh_pts: np.ndarray, checkpoint: str, retry,
 
 
 def _elastic_grid(ftr, model, toas, parnames, mesh_pts, niter, gls,
-                  chunk, checkpoint, retry, plan):
+                  chunk, checkpoint, retry, plan, fuse=None):
     """Route the grid sweep through the elastic supervisor: logical
     (device-count-independent) chunks, a cross-replica canary per block,
     device eviction + mesh degradation on failure, resume from the
-    checkpoint.  Returns (chi2, vfit, diag, fit_params)."""
+    checkpoint.  ``fuse`` > 1 dispatches that many logical chunks per
+    scan-fused executable (checkpoint granularity stays logical — a
+    fused sweep resumes and degrades exactly like an unfused one).
+    Returns (chi2, vfit, diag, fit_params)."""
     from pint_tpu.runtime import elastic as _elastic
 
     logical = int(chunk) if chunk else (default_gls_chunk() if gls else 256)
@@ -1268,6 +1444,16 @@ def _elastic_grid(ftr, model, toas, parnames, mesh_pts, niter, gls,
                         "diag": np.asarray(dg)}
         return ev
 
+    make_fused_eval = None
+    if gls and fuse is not None and int(fuse) > 1:
+        def make_fused_eval(block_size, n_fuse, p):
+            fn, free_init, fit_params = build_grid_chi2_fn(
+                model, toas, parnames, niter=niter, grid_spans=spans_,
+                chunk=block_size)
+            built["fn"], built["free_init"] = fn, free_init
+            built["fit_params"] = fit_params
+            return fn.fused_eval(n_fuse, sharding=p.batch_sharding())
+
     # prime the fingerprint's free_init without paying a build: it is a
     # pure function of the model's current values and the name order
     all_names = tuple(parnames)
@@ -1278,7 +1464,9 @@ def _elastic_grid(ftr, model, toas, parnames, mesh_pts, niter, gls,
         checkpoint=checkpoint, retry=retry,
         fingerprint=_grid_fingerprint(tuple(parnames), mesh_pts, niter,
                                       toas, gls, model, free_init),
-        what="elastic grid sweep")
+        what="elastic grid sweep",
+        fuse=int(fuse) if fuse else 1,
+        make_fused_eval=make_fused_eval)
     ftr.last_elastic_report = report
     if built.get("fn") is not None:
         _attach_grid_executable(ftr, built["fn"], model=model)

@@ -158,6 +158,14 @@ def _invoke_block(eval_fn: Callable, block: np.ndarray, index: int,
     return eval_fn(block)
 
 
+#: the scan-fused sibling seam: ``blocks`` is the stacked (fuse, B, G)
+#: group, ``group`` the logical chunk indices it retires — same
+#: fault-injection discipline as _invoke_block
+def _invoke_fused(eval_fn: Callable, blocks: np.ndarray, group,
+                  plan: ExecutionPlan):
+    return eval_fn(blocks)
+
+
 def _block_layout(chunk: int, plan: ExecutionPlan,
                   canary: bool) -> Tuple[int, np.ndarray, np.ndarray]:
     """(block_size, canary_row_indices, real_row_indices) for one
@@ -230,7 +238,9 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
                 canary: bool = True,
                 canary_key: str = "chi2",
                 canary_rtol: float = 1e-9,
-                what: str = "elastic sweep"
+                what: str = "elastic sweep",
+                fuse: int = 1,
+                make_fused_eval: Optional[Callable] = None
                 ) -> Tuple[Dict[str, np.ndarray], ElasticReport]:
     """Map a sharded evaluator over ``points`` with eviction/degradation.
 
@@ -239,6 +249,16 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
     that dispatches the block through the plan's mesh.  It is invoked
     once per rung (the per-rung executable — exactly one recompile per
     rung change).
+
+    ``fuse`` > 1 (with ``make_fused_eval(block_size, fuse, plan)``
+    supplied — a callable returning ``(blocks (fuse, B, G)) -> {name:
+    (fuse, B, ...)}``) dispatches groups of up to ``fuse`` consecutive
+    logical chunks through ONE scan-fused executable per group (the
+    work-per-byte dispatch amortization).  Checkpoint granularity STAYS
+    logical: each chunk of a fused group persists individually, so a
+    fused sweep resumes — including across mesh rungs after
+    degradation — exactly like an unfused one; short groups pad by
+    repeating the last block (one executable shape per rung).
 
     Chunk boundaries are **logical**: ``chunk`` points per chunk
     regardless of device count, every chunk padded to full size (the
@@ -264,6 +284,10 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
         raise UsageError(
             f"elastic_map requires a single-axis plan (got axes "
             f"{plan.axes}); build one with select_plan(workload)")
+    fuse = max(1, int(fuse))
+    if fuse > 1 and make_fused_eval is None:
+        raise UsageError("fuse > 1 needs make_fused_eval (the scan-fused "
+                         "per-rung evaluator builder)")
     nchunks = -(-npts // chunk)
     report = ElasticReport(rungs=[plan.rung])
 
@@ -278,15 +302,27 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
                      "chunks already complete")
 
     evals: Dict[int, Callable] = {}      # rung -> evaluator
+    fused_evals: Dict[int, Callable] = {}  # rung -> scan-fused evaluator
     layouts: Dict[int, tuple] = {}       # rung -> (B, canary_rows, real_rows)
     warm_rungs: set = set()              # rungs whose first dispatch ran
     canary_pt = points[0]
 
-    def _get_eval(p: ExecutionPlan) -> Tuple[Callable, tuple]:
-        if p.rung not in evals:
+    def _get_layout(p: ExecutionPlan) -> tuple:
+        if p.rung not in layouts:
             layouts[p.rung] = _block_layout(chunk, p, canary)
-            evals[p.rung] = make_eval(layouts[p.rung][0], p)
-        return evals[p.rung], layouts[p.rung]
+        return layouts[p.rung]
+
+    def _get_eval(p: ExecutionPlan) -> Tuple[Callable, tuple]:
+        layout = _get_layout(p)
+        if fuse > 1:
+            # fused mode builds ONLY the scan-fused executable per rung
+            # (a parallel unfused executable would double the compiles)
+            if p.rung not in fused_evals:
+                fused_evals[p.rung] = make_fused_eval(layout[0], fuse, p)
+            return fused_evals[p.rung], layout
+        if p.rung not in evals:
+            evals[p.rung] = make_eval(layout[0], p)
+        return evals[p.rung], layout
 
     def _assemble(chunk_pts: np.ndarray, layout) -> np.ndarray:
         B, canary_rows, real_rows = layout
@@ -301,9 +337,8 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
         return block
 
     out_chunks: List[Optional[dict]] = [None] * nchunks
-    for i in range(nchunks):
-        lo, hi = i * chunk, min((i + 1) * chunk, npts)
-        chunk_pts = points[lo:hi]
+    i = 0
+    while i < nchunks:
         if ckpt is not None and ckpt.has(i):
             out_chunks[i] = ckpt.load(i)
             report.chunks_resumed += 1
@@ -311,7 +346,15 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
                 from pint_tpu import telemetry as _tel
 
                 _tel.event("sweep.chunk_resumed", index=i)
+            i += 1
             continue
+        # the dispatch group: up to ``fuse`` consecutive chunks with no
+        # checkpoint (a checkpointed chunk mid-run splits the group —
+        # resumed work is never recomputed just to fill a scan)
+        group = [i]
+        while len(group) < fuse and group[-1] + 1 < nchunks \
+                and not (ckpt is not None and ckpt.has(group[-1] + 1)):
+            group.append(group[-1] + 1)
 
         attempt = 0
         # ONE same-rung retry for unattributed transients; after that a
@@ -319,33 +362,59 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
         transient_left = 1
         while True:
             eval_fn, layout = _get_eval(plan)
-            block = _assemble(chunk_pts, layout)
+            blocks = [_assemble(points[g * chunk:min((g + 1) * chunk,
+                                                     npts)], layout)
+                      for g in group]
             mark = _compile_mark()
             try:
-                out = _cp._call_with_timeout(
-                    lambda: _invoke_block(eval_fn, block, i, plan),
-                    policy.timeout)
+                if fuse > 1:
+                    stacked = np.stack(
+                        blocks + [blocks[-1]] * (fuse - len(blocks)))
+                    # the retry policy's timeout is PER CHUNK; a fused
+                    # dispatch retires len(group) chunks of work, so a
+                    # budget sized for one chunk must scale or healthy
+                    # fused sweeps would time out into degradation
+                    group_timeout = None if policy.timeout is None \
+                        else policy.timeout * len(group)
+                    outs = _cp._call_with_timeout(
+                        lambda: _invoke_fused(eval_fn, stacked, group,
+                                              plan),
+                        group_timeout)
+                    per_chunk = [{k: np.asarray(v)[f]
+                                  for k, v in outs.items()}
+                                 for f in range(len(group))]
+                else:
+                    out = _cp._call_with_timeout(
+                        lambda: _invoke_block(eval_fn, blocks[0],
+                                              group[0], plan),
+                        policy.timeout)
+                    per_chunk = [out]
                 B, canary_rows, real_rows = layout
                 if len(canary_rows):
-                    report.canary_checks += 1
-                    check_canary(np.asarray(out[canary_key])[canary_rows],
-                                 plan, rtol=canary_rtol,
-                                 where=f"{what} chunk {i}")
+                    for gi, out in zip(group, per_chunk):
+                        report.canary_checks += 1
+                        check_canary(
+                            np.asarray(out[canary_key])[canary_rows],
+                            plan, rtol=canary_rtol,
+                            where=f"{what} chunk {gi}")
                 compiles = _compile_delta(mark)
                 if plan.rung in warm_rungs:
                     report.steady_state_recompiles += compiles
                 else:
                     report.recompiles_by_rung[plan.rung] = compiles
                     warm_rungs.add(plan.rung)
-                res = {k: np.asarray(v)[real_rows][: hi - lo]
-                       for k, v in out.items()}
+                results = []
+                for gi, out in zip(group, per_chunk):
+                    lo, hi = gi * chunk, min((gi + 1) * chunk, npts)
+                    results.append({k: np.asarray(v)[real_rows][: hi - lo]
+                                    for k, v in out.items()})
                 break
             except Exception as e:  # noqa: BLE001 — classified below
                 info = classify_failure(e)
                 if info is None:
                     raise
                 attempt += 1
-                log.warning(f"{what} chunk {i}: {info['kind']} "
+                log.warning(f"{what} chunk {group[0]}: {info['kind']} "
                             f"({type(e).__name__}: {e})")
                 if not info["devices"] and transient_left > 0 \
                         and info["kind"] in ("collective_timeout",
@@ -359,24 +428,26 @@ def elastic_map(make_eval: Callable[[int, ExecutionPlan], Callable],
                         time.sleep(delay)
                     continue
                 try:
-                    plan = _degrade(plan, info, i, report)
+                    plan = _degrade(plan, info, group[0], report)
                 except MeshExhaustedError as exhausted:
                     raise SweepChunkFailure(
-                        f"{what} chunk {i}: degradation ladder exhausted "
-                        f"after {attempt} attempt(s) "
+                        f"{what} chunk {group[0]}: degradation ladder "
+                        f"exhausted after {attempt} attempt(s) "
                         f"(last: {type(e).__name__}: {e})") from exhausted
                 if ckpt is not None:
                     ckpt.update_sidecar({"plan": plan.to_dict()})
 
-        report.chunks_computed += 1
-        if ckpt is not None:
-            ckpt.save(i, **res)
-        if config._telemetry_mode != "off":
-            from pint_tpu import telemetry as _tel
+        for gi, res in zip(group, results):
+            report.chunks_computed += 1
+            if ckpt is not None:
+                ckpt.save(gi, **res)
+            if config._telemetry_mode != "off":
+                from pint_tpu import telemetry as _tel
 
-            _tel.event("sweep.chunk_done", index=i, total=nchunks,
-                       persisted=ckpt is not None)
-        out_chunks[i] = res
+                _tel.event("sweep.chunk_done", index=gi, total=nchunks,
+                           persisted=ckpt is not None)
+            out_chunks[gi] = res
+        i = group[-1] + 1
 
     report.final_plan = plan.to_dict()
     _emit_event("elastic.sweep_done", chunks=nchunks,

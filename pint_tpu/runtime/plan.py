@@ -197,12 +197,19 @@ class ExecutionPlan:
         }
 
 
+#: batch (embarrassingly parallel) mesh axes: sharding one of these
+#: moves NOTHING between devices — the data-parallel-first ranking
+#: prefers them over reduction axes whenever the workload has a batch
+_BATCH_AXES = ("pulsar", "walker", "grid")
+
+
 def select_plan(workload: str = "grid",
                 devices: Optional[Sequence] = None,
                 n_items: Optional[int] = None,
                 max_devices: Optional[int] = None,
                 axes: Optional[Sequence[str]] = None,
-                kind: Optional[str] = None) -> ExecutionPlan:
+                kind: Optional[str] = None,
+                n_batch: Optional[int] = None) -> ExecutionPlan:
     """Auto-select the execution plan for ``workload`` from the
     preflight-certified device set.
 
@@ -211,11 +218,21 @@ def select_plan(workload: str = "grid",
     ``n_items`` caps the rung at the batch size (meshing 8 devices for
     3 points buys nothing), ``max_devices`` caps it absolutely, and
     ``kind`` forces the mechanism (tests / explicit shard_map opt-in).
-    With ``axes`` unspecified, the autotuner's tuned axis order for
-    this workload is consulted (:func:`pint_tpu.autotune.
-    resolve_plan_axes` — ranked by collective bytes moved; silent
-    static default when no manifest is configured).  Emits a
-    ``plan_selected`` telemetry event.
+    With ``axes`` unspecified the selection consults, in order: the
+    autotuner's plan-strategy tunable (:func:`pint_tpu.autotune.
+    resolve_plan_strategy` — cost-ranked by measured collective bytes,
+    measure-confirmed; may override axes AND kind), then the
+    data-parallel-first static rule below, then the tuned axis order
+    (:func:`pint_tpu.autotune.resolve_plan_axes`).
+
+    ``n_batch`` is the data-parallel-first hook (ROADMAP item 2): a
+    caller holding ``n_batch`` independent fit systems that would
+    otherwise TOA-shard each one (workload ``gls_normal_eq``) gets a
+    ``pulsar``-axis data-parallel plan instead — the per-item Gram
+    reduction moves K^2/D bytes per collective while the batch axis
+    moves zero, so a batch of even two items out-ranks the sharded
+    single fit.  Emits ``plan_strategy`` + ``plan_selected`` telemetry
+    events.
     """
     from pint_tpu.runtime.preflight import healthy_devices
 
@@ -232,7 +249,34 @@ def select_plan(workload: str = "grid",
     if not axes:
         from pint_tpu import autotune as _autotune
 
-        axes = _autotune.resolve_plan_axes(workload)
+        strategy = _autotune.resolve_plan_strategy(workload)
+        if strategy is not None:
+            tuned_axes = tuple(strategy.get("axes") or ())
+            # a batch-axis strategy (the dataparallel winner) only
+            # applies when the caller actually HAS a batch: a tuned
+            # 'pulsar' plan handed to a single-fit caller would just
+            # relabel its TOA sharding as data-parallelism
+            if tuned_axes and tuned_axes[0] in _BATCH_AXES \
+                    and axis not in _BATCH_AXES \
+                    and (n_batch is None or int(n_batch) < 2):
+                tuned_axes = ()
+            if tuned_axes:
+                axes = tuned_axes
+                kind = kind or strategy.get("kind")
+                _emit_event("plan_strategy", workload=workload,
+                            chosen=",".join(axes), source="tuned")
+        if not axes and n_batch is not None and int(n_batch) >= 2 \
+                and axis not in _BATCH_AXES:
+            # static data-parallel-first ranking: the batch axis moves
+            # nothing; the reduction axis moves the Gram every solve
+            axes = (_BATCH_AXES[0],)
+            if n_items is None:
+                n_items = int(n_batch)
+            _emit_event("plan_strategy", workload=workload,
+                        chosen=axes[0], source="static",
+                        n_batch=int(n_batch))
+        if not axes:
+            axes = _autotune.resolve_plan_axes(workload)
     axes = tuple(axes) if axes else (axis,)
     for a in axes:
         if a not in MESH_AXES:

@@ -1,0 +1,239 @@
+"""Work-per-byte execution plans: reduce-scatter Grams, contract checks.
+
+ROADMAP item 2's indictment was that the committed scaling baselines ran
+eight devices *slower* than one (``SCALING_r06.json`` efficiency 0.073):
+the TOA-sharded normal-equation build all-reduced the FULL ``M^T C^-1 M``
+Gram to every device (every device receives K^2 numbers it immediately
+throws seven-eighths of away), and every small dispatch paid the fixed
+per-dispatch overhead.  This module is the communication half of the fix
+(the dispatch half is the scan-fused kernels in
+:mod:`pint_tpu.serving.batcher` / :mod:`pint_tpu.grid`):
+
+* :func:`scattered_normal_equations` — the Woodbury-form GLS
+  normal-equation build as a ``shard_map`` kernel that accumulates
+  per-shard partial Grams and ``psum_scatter``\\ s the result: each
+  device materializes only its ``K/D`` row slice of the normal matrix
+  (and adds its slice of the ``diag(phiinv)`` prior locally), gathered
+  exactly once on the host before the Cholesky.  Payload per collective
+  drops from ``K^2`` (all-reduce, per device) to ``K^2/D`` — the
+  work-per-byte ratio improves by the device count.
+
+* ``row_chunks > 1`` splits each shard's rows into a ``lax.scan`` of
+  partial-Gram + ``psum_scatter`` steps, so the collective for chunk
+  ``i`` is independent of chunk ``i+1``'s matmul and XLA's async
+  scheduler can bracket it in ``reduce-scatter-start``/``-done`` pairs
+  overlapping the next chunk's compute (the async forms
+  :mod:`pint_tpu.telemetry.distview` parses; synchronous backends fold
+  them back into the plain spelling).
+
+* :func:`verify_scatter_contract` — the distview-based HLO contract
+  check: the compiled executable must actually contain a
+  ``reduce-scatter`` and NO full-Gram ``all-reduce`` (XLA is free to
+  rewrite collectives; the contract is on the *compiled* HLO, not the
+  traced one).  Violations raise the typed
+  :class:`~pint_tpu.exceptions.CollectiveContractError` under
+  ``strict=True``; observatory callers take the profile + violation
+  list and record them.
+
+Everything here is host-side orchestration around the one traced kernel
+— calling this module's API inside a jitted function is a jaxlint
+host-call-in-jit finding, and a ``psum_scatter`` outside a shard_map
+axis context is its own jaxlint rule (``collective-axis-context``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import CollectiveContractError, UsageError
+
+__all__ = ["SCATTER_ROW_CHUNKS", "scattered_normal_equations",
+           "scattered_gram_operands", "scattered_normal_equations_fn",
+           "verify_scatter_contract"]
+
+#: default row-chunking of the scattered Gram accumulation: enough scan
+#: steps that the async scheduler has collectives to overlap, few enough
+#: that each partial Gram still amortizes its scatter
+SCATTER_ROW_CHUNKS = 4
+
+#: jitted scattered-build executables, one per (axis, shard count,
+#: row_chunks, precision key) — module-level so repeat fits/analyses
+#: retrace into the warm cache instead of compiling fresh
+_scatter_fns: Dict[tuple, object] = {}
+
+
+def scattered_normal_equations_fn(mesh, spec=None, row_chunks: int = 1):
+    """The jitted shard_map scattered Gram build for ``mesh``'s leading
+    axis (cached per mesh shape / chunking / ``gls.design`` precision
+    key).  Operand contract: ``(M, r, Nvec, phiinv)`` placed by
+    :func:`scattered_gram_operands` — TOA-sharded rows, replicated
+    (column-padded) ``phiinv``.  Output: the normal matrix and RHS as
+    row-sharded arrays (each device holds only its slice)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from pint_tpu.precision import matmul as _pmatmul
+
+    axis = mesh.axis_names[0]
+    shards = int(mesh.shape[axis])
+    row_chunks = max(1, int(row_chunks))
+    pspec = spec if (spec is not None and spec.reduced) else None
+    # the key carries the mesh's DEVICE IDENTITY, not just its shape:
+    # shard_map closes over the mesh, so two 4-device plans with
+    # different survivor sets (elastic eviction) must not share an
+    # executable bound to the stale — possibly dead — device set
+    device_ids = tuple(int(d.id) for d in np.asarray(mesh.devices).flat)
+    key = (str(axis), shards, device_ids, row_chunks,
+           None if pspec is None else pspec.key())
+    fn = _scatter_fns.get(key)
+    if fn is not None:
+        return fn
+
+    def scattered(M, r, Nvec, phiinv):
+        # per-device shard: (n_local, kp) rows of the augmented design;
+        # kp is padded to a shard multiple so every device's scattered
+        # slice is the same (kp // shards, kp) block
+        cinv = 1.0 / Nvec
+        kp = M.shape[1]
+        rows = kp // shards
+
+        def scatter_partial(Mc, rc, cc):
+            pm = _pmatmul(Mc.T, cc[:, None] * Mc, pspec)
+            py = _pmatmul(Mc.T, cc * rc, pspec)
+            sm = jax.lax.psum_scatter(pm, axis, scatter_dimension=0,
+                                      tiled=True)
+            sy = jax.lax.psum_scatter(py, axis, scatter_dimension=0,
+                                      tiled=True)
+            return sm, sy
+
+        if row_chunks > 1:
+            csz = M.shape[0] // row_chunks
+
+            def step(carry, xs):
+                sm, sy = scatter_partial(*xs)
+                return (carry[0] + sm, carry[1] + sy), ()
+
+            init = (jnp.zeros((rows, kp), dtype=M.dtype),
+                    jnp.zeros((rows,), dtype=M.dtype))
+            xs = (M.reshape(row_chunks, csz, kp),
+                  r.reshape(row_chunks, csz),
+                  cinv.reshape(row_chunks, csz))
+            (sm, sy), _ = jax.lax.scan(step, init, xs)
+        else:
+            sm, sy = scatter_partial(M, r, cinv)
+        # this device's diagonal slice of the prior: global row i0+j of
+        # the normal matrix gets phiinv[i0+j] on its diagonal (column
+        # i0+j), so the gathered matrix needs no host-side diag add
+        i0 = jax.lax.axis_index(axis) * rows
+        pslice = jax.lax.dynamic_slice(phiinv, (i0,), (rows,))
+        j = jnp.arange(rows)
+        sm = sm.at[j, i0 + j].add(pslice)
+        return sm, sy
+
+    inner = shard_map(scattered, mesh=mesh,
+                      in_specs=(P(axis, None), P(axis), P(axis), P()),
+                      out_specs=(P(axis, None), P(axis)),
+                      check_rep=False)
+    fn = jax.jit(inner)
+    _scatter_fns[key] = fn
+    return fn
+
+
+def scattered_gram_operands(M, r, Nvec, phiinv, mesh,
+                            row_chunks: int = 1) -> Tuple[tuple, int]:
+    """Pad + place the scattered build's operands: TOA rows zero-padded
+    to a ``shards * row_chunks`` multiple (``Nvec`` pads with 1.0 — a
+    zero-weight row contributes exactly zero to every sum, the serving
+    batcher's discipline, so results are identical to the host build,
+    never trimmed), Gram columns zero-padded to a shard multiple so the
+    scattered slices tile evenly.  Returns ``(args, k)`` with ``k`` the
+    un-padded column count the caller trims the gathered system to."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    shards = int(mesh.shape[axis])
+    M = np.asarray(M, dtype=np.float64)
+    r = np.asarray(r, dtype=np.float64)
+    Nvec = np.asarray(Nvec, dtype=np.float64)
+    phiinv = np.asarray(phiinv, dtype=np.float64)
+    n, k = M.shape
+    row_mult = shards * max(1, int(row_chunks))
+    if n < shards:
+        raise UsageError(
+            f"cannot shard {n} TOAs over {shards} devices")
+    pad = (-n) % row_mult
+    if pad:
+        M = np.vstack([M, np.zeros((pad, k))])
+        r = np.concatenate([r, np.zeros(pad)])
+        Nvec = np.concatenate([Nvec, np.ones(pad)])
+    cpad = (-k) % shards
+    if cpad:
+        M = np.hstack([M, np.zeros((M.shape[0], cpad))])
+        phiinv = np.concatenate([phiinv, np.zeros(cpad)])
+    specs = (P(axis, None), P(axis), P(axis), P())
+    args = tuple(jax.device_put(jnp.asarray(a), NamedSharding(mesh, s))
+                 for a, s in zip((M, r, Nvec, phiinv), specs))
+    return args, k
+
+
+def scattered_normal_equations(M, r, Nvec, phiinv, plan, spec=None,
+                               row_chunks: int = SCATTER_ROW_CHUNKS):
+    """``(mtcm, mtcy)`` — the Woodbury normal equations built on
+    ``plan``'s mesh via the reduce-scatter kernel, gathered to host
+    exactly once (the single all-gather the plan pays, before the
+    Cholesky) and trimmed to the un-padded column count.  Results match
+    the host :func:`~pint_tpu.gls_fitter.gls_normal_equations` build to
+    summation-order fp noise."""
+    mesh = plan.mesh
+    if mesh is None:
+        raise UsageError("scattered_normal_equations needs a multi-device "
+                         "plan (plan.mesh is None); call "
+                         "gls_normal_equations for the host build")
+    fn = scattered_normal_equations_fn(mesh, spec=spec,
+                                       row_chunks=row_chunks)
+    args, k = scattered_gram_operands(M, r, Nvec, phiinv, mesh,
+                                      row_chunks=row_chunks)
+    mtcm, mtcy = fn(*args)
+    return np.asarray(mtcm)[:k, :k], np.asarray(mtcy)[:k]
+
+
+def verify_scatter_contract(fn, *args, name: str = "gls.scattered_gram",
+                            strict: bool = False):
+    """The HLO collective contract of a scattered-Gram executable:
+    compiled HLO must contain >= 1 ``reduce-scatter`` (sync or async
+    ``-start`` spelling — distview folds them) and ZERO ``all-reduce``
+    ops (a full-Gram all-reduce is exactly the pattern this kernel
+    exists to eliminate; XLA rewriting the scatter back into one would
+    silently re-pay D x the bytes).
+
+    Returns ``(CollectiveProfile, violations)``; with ``strict=True`` a
+    non-empty violation list raises
+    :class:`~pint_tpu.exceptions.CollectiveContractError` instead.  A
+    degraded profile (backend refuses HLO text) is a violation — an
+    unverifiable contract is not a verified one."""
+    from pint_tpu.telemetry import distview
+
+    prof = distview.analyze_jitted_collectives(fn, *args, name=name)
+    violations: List[str] = []
+    if prof.error:
+        violations.append(f"collective analysis degraded: {prof.error}")
+    else:
+        if "reduce-scatter" not in prof.ops:
+            violations.append("compiled HLO contains no reduce-scatter")
+        ar = prof.ops.get("all-reduce")
+        if ar is not None:
+            violations.append(
+                f"compiled HLO contains {int(ar['count'])} all-reduce "
+                f"op(s) ({ar['bytes']:.0f} bytes) — the scattered build "
+                "must not all-reduce the Gram")
+    if violations and strict:
+        raise CollectiveContractError(
+            f"{name}: scattered-Gram HLO contract violated: "
+            + "; ".join(violations), violations=violations)
+    return prof, violations

@@ -46,6 +46,7 @@ from pint_tpu.exceptions import UsageError
 __all__ = ["DEFAULT_NTOA_BUCKETS", "DEFAULT_NFREE_BUCKETS",
            "DEFAULT_BATCH_BUCKETS", "bucket_of", "FitRequest", "FitResult",
            "pad_request", "serve_kernel", "serve_batched",
+           "serve_kernel_steps", "serve_fused", "HUBER_STEP_K",
            "resolve_serve_spec", "ShapeBatcher"]
 
 #: default shape ladders: a handful of shapes serve the whole catalog
@@ -217,6 +218,126 @@ def serve_kernel(M, r, w, phiinv, pad_free, spec=None):
     chi2 = jnp.sum(w * r_post * r_post)
     chi2_initial = jnp.sum(w * r * r)
     return dx, err, chi2, chi2_initial
+
+
+#: Huber tuning constant of the fused refinement steps — the same
+#: 95%-efficiency value :mod:`pint_tpu.integrity.robust` uses for its
+#: host-side WLS IRLS (one constant, two spellings would drift)
+HUBER_STEP_K = 1.345
+
+
+def serve_kernel_steps(M, r, w, phiinv, pad_free, spec=None,
+                       steps: int = 1, reweight=None):
+    """``steps`` fused linearized fit steps on one padded system — the
+    scan-fused jax-traceable core (ROADMAP item 2's dispatch-floor fix:
+    one executable retires K steps that used to cost K dispatches).
+
+    The conditioning scale, Gram, Cholesky factor, and covariance
+    diagonal are hoisted out of the scan — factor once, iterate cheap
+    steps — and the scanned body is matmul-only (the batched
+    Cholesky/triangular custom calls serialize across devices on
+    CPU-class backends; keeping them out of the loop is what lets the
+    data-parallel batch axis actually scale).  The carry is the
+    residual vector, updated in place across steps (donated-carry
+    semantics: ``lax.scan`` reuses the buffer).
+
+    * ``reweight=None``: every step solves the SAME system against the
+      carried residuals — step 0 is exactly :func:`serve_kernel`'s
+      Gauss-Newton step (same Gram, same factorization; the solve goes
+      through the prefactored inverse plus one refinement correction,
+      agreeing with ``cho_solve`` to fp noise), later steps are
+      iterative refinement of the linear solution (``dx -> 0``).
+    * ``reweight="huber"``: each step re-accumulates the Gram under
+      Huber IRLS weights from the carried whitened residuals
+      (``min(1, k/|z|)``, the :mod:`pint_tpu.integrity.robust`
+      convention with the whitener the *augmented* Woodbury system
+      makes diagonal), solving via the clean-system factor as
+      preconditioner with one refinement correction.  This is the
+      work-per-byte shape: per-step FLOPs scale with ``N*K^2`` while
+      the bytes touched stay the cache-resident ``N*K`` design.
+
+    Returns ``(dx (steps, k), err (k,), chi2 (steps,), chi2_initial)``
+    — per-step results gathered at scan exit; ``err`` is the hoisted
+    clean-system covariance diagonal."""
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.precision import matmul as _pmatmul
+
+    wM = w[:, None] * M
+    s = jnp.sqrt(jnp.sum(wM * M, axis=0) + phiinv)
+    s = jnp.where(s > 0, s, 1.0)
+    Ms = M / s
+    prior = jnp.diag(phiinv / s**2) + jnp.diag(pad_free)
+    A = _pmatmul(Ms.T, w[:, None] * Ms, spec) + prior
+    cf = jax.scipy.linalg.cho_factor(A, lower=True)
+    Ainv = jax.scipy.linalg.cho_solve(cf, jnp.eye(A.shape[0],
+                                                  dtype=A.dtype))
+    err = jnp.sqrt(jnp.clip(jnp.diag(Ainv), 0.0)) / s
+    chi2_initial = jnp.sum(w * r * r)
+
+    def step(rc, _):
+        if reweight is None:
+            wt = w
+            At = A
+        else:
+            # whitened residuals of the carried state: the augmented
+            # system's whitener IS diagonal (that is what the Woodbury
+            # form buys), so Huber IRLS is exact here
+            z = jnp.abs(rc) * jnp.sqrt(w)
+            g = jnp.minimum(1.0, HUBER_STEP_K / jnp.maximum(z, 1e-300))
+            wt = w * g
+            At = _pmatmul(Ms.T, wt[:, None] * Ms, spec) + prior
+        bt = _pmatmul(Ms.T, wt * rc, spec)
+        x = Ainv @ bt
+        # one preconditioned refinement correction: matmul-only, and
+        # for reweight=None it lands the cho_solve answer to fp noise
+        x = x + Ainv @ (bt - At @ x)
+        dx = x / s
+        r_post = rc - _pmatmul(M, dx, spec)
+        chi2 = jnp.sum(wt * r_post * r_post)
+        return r_post, (dx, chi2)
+
+    # ``steps`` is trace-time static (serve_fused coerces it); no host
+    # coercion here — this body runs under jit
+    _, (dxs, chi2s) = jax.lax.scan(step, r, None, length=steps)
+    return dxs, err, chi2s, chi2_initial
+
+
+#: the fused multi-step executables: one jit per (precision key, steps,
+#: reweight) triple, one compile per batched shape under it — the same
+#: module-level discipline as _serve_batched_jit
+_serve_fused_jit: Dict[tuple, object] = {}
+
+
+def serve_fused(spec=None, steps: int = 1, reweight=None):
+    """The jitted ``vmap(serve_kernel_steps)`` for ``(spec, steps,
+    reweight)`` (default spec: the resolved active ``serve.gram`` spec).
+    One dispatch of the returned executable retires ``steps`` fit
+    steps per batch lane — the scan-fused path the catalog refinement
+    (:meth:`pint_tpu.catalog.batchfit.CatalogFitter.refine`) and the
+    scalewatch catalog workload measure."""
+    if steps < 1:
+        raise UsageError(f"serve_fused needs steps >= 1, got {steps}")
+    if reweight not in (None, "huber"):
+        raise UsageError(f"unknown reweight {reweight!r} "
+                         "(None | 'huber')")
+    if spec is None:
+        spec = resolve_serve_spec()
+    steps = int(steps)
+    key = (spec.key(), steps, reweight)
+    fn = _serve_fused_jit.get(key)
+    if fn is None:
+        import jax
+
+        def kernel(M, r, w, phiinv, pad_free):
+            return serve_kernel_steps(M, r, w, phiinv, pad_free,
+                                      spec=spec, steps=steps,
+                                      reweight=reweight)
+
+        fn = jax.jit(jax.vmap(kernel))
+        _serve_fused_jit[key] = fn
+    return fn
 
 
 def resolve_serve_spec():

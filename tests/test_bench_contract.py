@@ -77,6 +77,7 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     # small catalog/flow: the contract is the blocks' shape, not scale
     monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
     monkeypatch.setenv("BENCH_POSTERIOR_STEPS", "8")
+    monkeypatch.setenv("BENCH_SCALING_PULSARS", "3")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     try:
@@ -164,6 +165,17 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert catalog["catalog_fits_per_s"] > 0
     assert catalog["joint_lnlike_per_s"] > 0
     assert catalog["steady_state_compiles"] == 0
+    # the scaling block (PR 14): the work-per-byte plans' fused
+    # dispatch rate measured live, plus the committed scalewatch
+    # series' efficiency / scatter bytes restamped for perfwatch
+    scaling = headline["scaling"]
+    for key in ("efficiency_at_max", "dispatch_per_s", "scatter_bytes"):
+        assert key in scaling, f"scaling block missing {key!r}"
+    assert "error" not in scaling, \
+        f"scaling measurement degraded: {scaling}"
+    assert scaling["dispatch_per_s"] > 0
+    assert scaling["efficiency_at_max"] is None \
+        or scaling["efficiency_at_max"] > 0
     # the posterior block (PR 13): the amortized engine trained a flow
     # and served draws + log-probs through the posterior door — every
     # key present, never degraded on CPU, zero steady-state compiles
@@ -200,6 +212,7 @@ def test_warm_block_hits_cache_on_second_run(tiny_headline_files,
     monkeypatch.setenv("BENCH_SKIP_SECONDARY", "1")
     monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
     monkeypatch.setenv("BENCH_POSTERIOR_STEPS", "8")
+    monkeypatch.setenv("BENCH_SCALING_PULSARS", "3")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     cache_dir = str(tmp_path / "aot")

@@ -1056,6 +1056,138 @@ class TestCli:
             assert name in out
 
 
+class TestCollectiveAxisContext:
+    """ISSUE 14 satellite: psum_scatter outside a shard_map axis
+    context is a silent full-replication footgun under the SPMD
+    partitioner."""
+
+    BAD = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def gram(M, w):\n"
+        "    pm = M.T @ (w[:, None] * M)\n"
+        "    return jax.lax.psum_scatter(pm, 'toa',\n"
+        "                                scatter_dimension=0, tiled=True)\n"
+    )
+    GOOD = (
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "def build(mesh):\n"
+        "    def gram(M, w):\n"
+        "        pm = M.T @ (w[:, None] * M)\n"
+        "        return jax.lax.psum_scatter(pm, 'toa',\n"
+        "                                    scatter_dimension=0,\n"
+        "                                    tiled=True)\n"
+        "    return jax.jit(shard_map(gram, mesh=mesh,\n"
+        "                             in_specs=(P('toa', None), P('toa')),\n"
+        "                             out_specs=P('toa', None)))\n"
+    )
+
+    def _rule(self):
+        from tools.jaxlint.rules.collective_context import (
+            CollectiveAxisContextRule)
+
+        return CollectiveAxisContextRule()
+
+    def test_fires_on_bad(self, tmp_path):
+        findings = lint_snippet(tmp_path, self.BAD, [self._rule()])
+        assert rule_names(findings) == ["collective-axis-context"]
+        assert "shard_map" in findings[0].message
+        assert "replicat" in findings[0].message
+
+    def test_silent_on_good(self, tmp_path):
+        assert lint_snippet(tmp_path, self.GOOD, [self._rule()]) == []
+
+    def test_scan_inside_shard_map_body_not_flagged(self, tmp_path):
+        """The row-chunked production shape: psum_scatter inside a
+        lax.scan step that is NESTED in the shard_map body inherits the
+        axis context (exactly workperbyte's chunked accumulation)."""
+        good = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def build(mesh):\n"
+            "    def scattered(M, w):\n"
+            "        def step(carry, xs):\n"
+            "            Mc, wc = xs\n"
+            "            pm = Mc.T @ (wc[:, None] * Mc)\n"
+            "            sm = jax.lax.psum_scatter(pm, 'toa',\n"
+            "                                      scatter_dimension=0,\n"
+            "                                      tiled=True)\n"
+            "            return carry + sm, ()\n"
+            "        init = jnp.zeros((4, 8))\n"
+            "        out, _ = jax.lax.scan(step, init, (M, w))\n"
+            "        return out\n"
+            "    return jax.jit(shard_map(scattered, mesh=mesh,\n"
+            "                             in_specs=P('toa', None),\n"
+            "                             out_specs=P('toa', None)))\n"
+        )
+        assert lint_snippet(tmp_path, good, [self._rule()]) == []
+
+    def test_module_level_scatter_flagged(self, tmp_path):
+        bad = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "x = jax.lax.psum_scatter(jnp.ones((4, 4)), 'toa')\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [self._rule()])
+        assert rule_names(findings) == ["collective-axis-context"]
+
+    def test_registered_by_default(self):
+        assert "collective-axis-context" in RULES
+        assert any(type(r).name == "collective-axis-context"
+                   for r in default_rules())
+
+    def test_workperbyte_kernel_is_clean(self):
+        """The shipped scattered-Gram kernel passes its own rule (the
+        scatters live inside the shard_map body)."""
+        info = parse_file(os.path.join(
+            REPO, "pint_tpu", "runtime", "workperbyte.py"), repo=REPO)
+        assert list(self._rule().check(info)) == []
+
+
+class TestWorkperbyteHostTarget:
+    def test_workperbyte_call_in_jit_flagged(self, tmp_path):
+        """The scan-fused era's host-call targets (ISSUE 14 satellite):
+        workperbyte's scatter orchestration called inside a traced
+        function re-enters tracing per TRACE — the host-call-in-jit
+        target set must cover the runtime.workperbyte module."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.runtime import workperbyte as _wpb\n"
+            "from pint_tpu.runtime.workperbyte import "
+            "verify_scatter_contract\n"
+            "@jax.jit\n"
+            "def f(M, r, Nvec, phiinv, plan):\n"
+            "    m, y = _wpb.scattered_normal_equations(M, r, Nvec,\n"
+            "                                           phiinv, plan)\n"
+            "    verify_scatter_contract(f, M)\n"
+            "    return m\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+
+    def test_workperbyte_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — build/verify on host,
+        dispatch the jitted kernel."""
+        good = (
+            "import jax\n"
+            "from pint_tpu.runtime.workperbyte import (\n"
+            "    scattered_normal_equations, verify_scatter_contract)\n"
+            "@jax.jit\n"
+            "def solve(mtcm, mtcy):\n"
+            "    return mtcm @ mtcy\n"
+            "def host(M, r, Nvec, phiinv, plan):\n"
+            "    m, y = scattered_normal_equations(M, r, Nvec, phiinv,\n"
+            "                                      plan)\n"
+            "    return solve(m, y)\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+
 # ---------------------------------------------------------------------------
 # the contract: pint_tpu lints clean against the committed baseline
 # ---------------------------------------------------------------------------

@@ -149,15 +149,19 @@ class TestGate:
 
 
 class TestWorkerIntegration:
-    def test_worker_emits_full_record_set(self, eight_devices, capsys):
+    def test_worker_emits_full_record_set(self, eight_devices, capsys,
+                                          monkeypatch):
         """One in-process worker measurement at 2 devices: the stdout
-        record set carries a measurement with per-device busy fractions,
-        a non-empty GLS normal-equation CollectiveProfile (all-reduce
-        bytes > 0), and a sharding plan — every record schema-valid per
-        the telemetry_report validators."""
+        record set carries a measurement with per-device busy fractions
+        and the workload calibration stamp, a non-empty GLS
+        normal-equation CollectiveProfile (reduce-scatter bytes > 0, NO
+        full-Gram all-reduce — the ISSUE 14 contract), and a sharding
+        plan — every record schema-valid per the telemetry_report
+        validators."""
         from tools.scalewatch import run_worker
         from tools.telemetry_report import validate_multichip_record
 
+        monkeypatch.setattr("tools.scalewatch._CAL_FLOOR_S", 0.02)
         assert run_worker(2) == 0
         recs = _records_from_output(capsys.readouterr().out)
         errors = []
@@ -171,10 +175,15 @@ class TestWorkerIntegration:
         assert meas["n_devices"] == 2
         assert meas["fits_per_sec"] > 0
         assert len(meas["busy_fractions"]) >= 1
+        cal = meas["calibration"]
+        assert cal["repeats"] >= 1
+        assert meas["wall_s"] >= cal["floor_s"] * 0.5  # probe-based
+        assert meas["fused"]["dispatches"] >= 1
         colls = {c["collective"]["name"]: c["collective"]
                  for c in by_kind["collective"]}
         ne = colls["gls.normal_eq"]
-        assert ne["ops"]["all-reduce"]["bytes"] > 0
+        assert ne["ops"]["reduce-scatter"]["bytes"] > 0
+        assert "all-reduce" not in ne["ops"]
         assert ne["comm_compute_ratio"] > 0
         assert by_kind["sharding_plan"]
 
@@ -207,16 +216,20 @@ class TestCatalogWorkload:
     def test_catalog_worker_emits_full_record_set(self, eight_devices,
                                                   capsys, monkeypatch):
         """One in-process catalog worker at 2 devices: the measurement
-        carries the catalog workload tag and a pulsar-axis sharding
-        plan; the batched bucket executable's CollectiveProfile shows
-        the data-parallel story (no all-reduce contractions — any
+        carries the catalog workload tag, the calibration stamp, the
+        fused-dispatch accounting, and a pulsar-axis sharding plan; the
+        scan-fused bucket executable's CollectiveProfile shows the
+        data-parallel story (no all-reduce contractions — any
         collective bytes are resharding overhead, tiny next to
         compute)."""
         import tools.scalewatch as sw
         from tools.telemetry_report import validate_multichip_record
 
         monkeypatch.setattr(sw, "_CATALOG_PULSARS", 4)
-        monkeypatch.setattr(sw, "_CATALOG_TIMED_PASSES", 2)
+        monkeypatch.setattr(sw, "_CATALOG_NTOA_RANGE", (48, 96))
+        monkeypatch.setattr(sw, "_CATALOG_NTOA_LADDER", (96,))
+        monkeypatch.setattr(sw, "_CATALOG_STEPS", 4)
+        monkeypatch.setattr(sw, "_CAL_FLOOR_S", 0.02)
         assert sw.run_worker(2, workload="catalog") == 0
         recs = _records_from_output(capsys.readouterr().out)
         errors = []

@@ -1,0 +1,498 @@
+"""Work-per-byte execution plans under test (ISSUE 14).
+
+The three contracts of the scaling fix:
+
+* **scattered Gram** — the TOA-sharded normal-equation build compiles
+  to a real ``reduce-scatter`` (and ZERO full-Gram ``all-reduce``) and
+  matches the host build to 1e-9, zero-weight padding included;
+* **fused dispatch** — the scan-fused executables retire K chunks /
+  steps per dispatch (dispatch counters), reach zero steady-state
+  recompiles, and agree with their unfused siblings;
+* **elastic compatibility** — a fused sweep still degrades 8->4 and
+  resumes from :class:`SweepCheckpoint` with results matching an
+  unfaulted run to 1e-7.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.distview]
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+_NOISE_PAR = """\
+PSR WPB
+RAJ 05:00:00
+DECJ 20:00:00
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55000
+DM 10.0 1
+EFAC mjd 50000 60000 1.1
+ECORR mjd 50000 60000 0.5
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 3
+UNITS TDB
+"""
+
+
+def _gls_fitter(ntoas=46, seed=3):
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    m = get_model([ln + "\n" for ln in _NOISE_PAR.splitlines()])
+    t = make_fake_toas_uniform(53400, 54800, 2 * (ntoas // 2), m,
+                               freq=np.array([1400.0, 2300.0]),
+                               error_us=1.0, add_noise=True,
+                               rng=np.random.default_rng(seed))
+    f = GLSFitter(t, m)
+    f.update_resids()
+    return f
+
+
+# ---------------------------------------------------------------------------
+# scattered Gram: exactness + the HLO collective contract
+# ---------------------------------------------------------------------------
+
+class TestScatteredGram:
+    def test_scattered_matches_host_build(self, eight_devices):
+        """Zero-weight-padded scattered build == host build to 1e-9,
+        at a ragged TOA count (46 over 8 shards: padding exercised on
+        both the row and the Gram-column axis)."""
+        from pint_tpu.gls_fitter import (build_augmented_system,
+                                         gls_normal_equations)
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.runtime.workperbyte import (
+            scattered_normal_equations)
+
+        f = _gls_fitter()
+        r = np.asarray(f.resids.time_resids)
+        M, _, _, phiinv, Nvec, _ = build_augmented_system(f.model, f.toas)
+        host_m, host_y = gls_normal_equations(M, r, Nvec=Nvec,
+                                              phiinv=phiinv)
+        plan = select_plan("gls_normal_eq", devices=eight_devices,
+                           n_items=len(f.toas))
+        assert plan.rung == 8
+        for row_chunks in (1, 4):
+            mtcm, mtcy = scattered_normal_equations(
+                M, r, Nvec, phiinv, plan, row_chunks=row_chunks)
+            scale = max(1.0, np.abs(host_m).max())
+            assert np.abs(mtcm - host_m).max() / scale < 1e-9
+            assert np.abs(mtcy - host_y).max() \
+                / max(1.0, np.abs(host_y).max()) < 1e-9
+
+    def test_scatter_contract_reduce_scatter_no_allreduce(
+            self, eight_devices):
+        """ISSUE 14 acceptance (a): the compiled scattered-Gram HLO
+        contains reduce-scatter and ZERO full-Gram all-reduces."""
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.runtime.workperbyte import verify_scatter_contract
+
+        f = _gls_fitter()
+        plan = select_plan("gls_normal_eq", devices=eight_devices,
+                           n_items=len(f.toas))
+        fn, args = f.gls_normal_equations_executable(plan=plan)
+        prof, violations = verify_scatter_contract(fn, *args)
+        assert violations == []
+        rs = prof.ops.get("reduce-scatter")
+        assert rs is not None and rs["count"] >= 1 and rs["bytes"] > 0
+        assert "all-reduce" not in prof.ops
+        assert prof.mesh_axes == {"toa": 8}
+        # and the executable actually runs to a finite system
+        mtcm, mtcy = fn(*args)
+        assert np.all(np.isfinite(np.asarray(mtcm)))
+        assert np.all(np.isfinite(np.asarray(mtcy)))
+
+    def test_row_chunked_scatter_keeps_contract(self, eight_devices):
+        """The row-chunked (scan-of-scatters) form — the structure XLA
+        can bracket in async reduce-scatter-start/done pairs — still
+        satisfies the contract: distview folds async spellings into the
+        base kind, and no all-reduce appears."""
+        from pint_tpu.gls_fitter import build_augmented_system
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.runtime.workperbyte import (
+            scattered_gram_operands, scattered_normal_equations_fn,
+            verify_scatter_contract)
+
+        f = _gls_fitter(ntoas=64)
+        r = np.asarray(f.resids.time_resids)
+        M, _, _, phiinv, Nvec, _ = build_augmented_system(f.model, f.toas)
+        plan = select_plan("gls_normal_eq", devices=eight_devices,
+                           n_items=len(f.toas))
+        fn = scattered_normal_equations_fn(plan.mesh, row_chunks=4)
+        args, _ = scattered_gram_operands(M, r, Nvec, phiinv, plan.mesh,
+                                          row_chunks=4)
+        prof, violations = verify_scatter_contract(
+            fn, *args, name="gls.scattered_gram.chunked")
+        assert violations == []
+        assert prof.ops["reduce-scatter"]["count"] >= 1
+
+    def test_legacy_allreduce_build_violates_contract(
+            self, eight_devices):
+        """The contract check CONVICTS the legacy all-reduce build (the
+        SCALING_r06 shape) — strict mode raises the typed error."""
+        from pint_tpu.exceptions import CollectiveContractError
+        from pint_tpu.runtime.plan import select_plan
+        from pint_tpu.runtime.workperbyte import verify_scatter_contract
+
+        f = _gls_fitter()
+        plan = select_plan("gls_normal_eq", devices=eight_devices,
+                           n_items=len(f.toas))
+        fn, args = f.gls_normal_equations_executable(plan=plan,
+                                                     scatter=False)
+        prof, violations = verify_scatter_contract(fn, *args)
+        assert violations and "all-reduce" in " ".join(violations)
+        with pytest.raises(CollectiveContractError) as ei:
+            verify_scatter_contract(fn, *args, strict=True)
+        assert ei.value.violations
+
+    def test_executable_pads_instead_of_trims(self, eight_devices):
+        """ISSUE 14 satellite: the analyzed sharded executable computes
+        the SAME system as the unsharded build — zero-weight pad rows,
+        never a trim that silently drops TOAs from the solve.  Pinned
+        for both the scattered and the legacy form at a TOA count that
+        does NOT divide the shard count."""
+        import jax
+        from jax.sharding import Mesh
+
+        f = _gls_fitter(ntoas=46)       # 46 % 8 == 6: trim would drop 6
+        fn0, args0 = f.gls_normal_equations_executable()
+        ref_m, ref_y = (np.asarray(a) for a in fn0(*args0))
+        mesh = Mesh(np.array(eight_devices), ("toa",))
+        for scatter in (True, False):
+            fn, args = f.gls_normal_equations_executable(
+                mesh=mesh, scatter=scatter)
+            mtcm, mtcy = (np.asarray(a) for a in fn(*args))
+            k = ref_m.shape[0]
+            scale = max(1.0, np.abs(ref_m).max())
+            assert np.abs(mtcm[:k, :k] - ref_m).max() / scale < 1e-9, \
+                f"scatter={scatter} dropped TOAs from the solve"
+            assert np.abs(mtcy[:k] - ref_y).max() \
+                / max(1.0, np.abs(ref_y).max()) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# fused dispatch: one executable per K chunks / steps
+# ---------------------------------------------------------------------------
+
+class TestFusedDispatch:
+    def _padded_batch(self, lanes=2, n=64, k=8, seed=0):
+        from pint_tpu.serving.batcher import FitRequest, pad_request
+
+        rng = np.random.default_rng(seed)
+        ops = []
+        for i in range(lanes):
+            req = FitRequest(M=rng.normal(size=(48, 7)),
+                             r=rng.normal(size=48) * 1e-6,
+                             w=rng.uniform(0.5, 2.0, size=48) * 1e12,
+                             phiinv=np.zeros(7))
+            ops.append(pad_request(req, n, k))
+        return tuple(np.stack([o[i] for o in ops]) for i in range(5))
+
+    def test_fused_step0_matches_serve_kernel(self):
+        from pint_tpu.serving.batcher import serve_batched, serve_fused
+
+        operands = self._padded_batch()
+        base = [np.asarray(o) for o in serve_batched()(*operands)]
+        dxs, err, chi2s, chi2i = (np.asarray(o) for o in
+                                  serve_fused(steps=3)(*operands))
+        assert np.abs(dxs[:, 0, :] - base[0]).max() \
+            / max(np.abs(base[0]).max(), 1e-30) < 1e-9
+        assert np.allclose(err, base[1], rtol=1e-9)
+        assert np.allclose(chi2s[:, 0], base[2], rtol=1e-9)
+        assert np.allclose(chi2i, base[3], rtol=1e-12)
+
+    def test_fused_equals_sequential_refinement(self):
+        """K fused steps == K single-step dispatches carrying residuals
+        by hand (the dispatch-fusion exactness contract)."""
+        from pint_tpu.serving.batcher import serve_fused
+
+        operands = self._padded_batch()
+        K = 4
+        chi2s = np.asarray(serve_fused(steps=K)(*operands)[2])
+        M, r, w, phiinv, padf = (np.asarray(o) for o in operands)
+        single = serve_fused(steps=1)
+        rc, seq = r.copy(), []
+        for _ in range(K):
+            d1, _, c1, _ = (np.asarray(o) for o in
+                            single(M, rc, w, phiinv, padf))
+            seq.append(c1[:, 0])
+            rc = rc - np.einsum("bnk,bk->bn", M, d1[:, 0, :])
+        assert np.abs(np.stack(seq, axis=1) - chi2s).max() \
+            / max(chi2s.max(), 1e-30) < 1e-9
+
+    def test_huber_reweighted_steps_finite_and_weighted(self):
+        """The robust variant runs, stays finite, and actually
+        down-weights an outlier-poisoned lane (its robust chi2 falls
+        below the plain step-0 chi2)."""
+        from pint_tpu.serving.batcher import serve_fused
+
+        operands = list(self._padded_batch())
+        r = operands[1].copy()
+        r[0, 5] *= 1e3                          # one gross outlier
+        operands[1] = r
+        dxs, err, chi2s, chi2i = (
+            np.asarray(o) for o in
+            serve_fused(steps=4, reweight="huber")(*tuple(operands)))
+        assert np.all(np.isfinite(chi2s))
+        plain = np.asarray(serve_fused(steps=1)(*tuple(operands))[2])
+        assert chi2s[0, -1] < plain[0, 0]
+
+    def test_grid_fused_dispatches_once_per_k_chunks(self):
+        """ISSUE 14 acceptance (b): the fused-scan grid path reduces
+        dispatches >= K-fold at identical results, with zero
+        steady-state recompiles on the repeat call."""
+        from pint_tpu.grid import build_grid_chi2_fn
+        from pint_tpu.telemetry import jaxevents
+
+        f = _gls_fitter(ntoas=32)
+        f.fit_toas(maxiter=1)
+        g0 = np.linspace(f.model.F0.value - 3e-11,
+                         f.model.F0.value + 3e-11, 4)
+        g1 = np.linspace(f.model.F1.value - 3e-18,
+                         f.model.F1.value + 3e-18, 4)
+        pts = np.stack([g.ravel() for g in
+                        np.meshgrid(g0, g1, indexing="ij")], axis=-1)
+        fn, _, _ = build_grid_chi2_fn(f.model, f.toas, ("F0", "F1"),
+                                      niter=2, chunk=4)
+        c_plain, _, _ = fn(pts)
+        assert fn.dispatch_count() == 4          # 16 points / chunk 4
+        jaxevents.install()
+        c_fused, _, _ = fn.fused(pts, fuse=4)
+        assert fn.dispatch_count() == 1          # 4 chunks / fuse 4
+        before = jaxevents.counts()
+        c_fused2, _, _ = fn.fused(pts, fuse=4)
+        assert (jaxevents.counts() - before).compiles == 0
+        scale = max(1.0, np.abs(c_plain).max())
+        assert np.abs(c_plain - c_fused).max() / scale < 1e-7
+        assert np.abs(c_fused - c_fused2).max() == 0.0
+
+    def test_catalog_refine_dispatches_once_per_bucket(self):
+        from pint_tpu.catalog import CatalogFitter, ingest_catalog
+        from pint_tpu.catalog.ingest import make_synthetic_catalog
+        from pint_tpu.telemetry import jaxevents
+
+        report = ingest_catalog(make_synthetic_catalog(
+            n_pulsars=4, seed=7, ntoa_range=(24, 48)))
+        cf = CatalogFitter(report)
+        res = cf.refine(steps=5)
+        assert res.dispatches == res.n_buckets
+        assert res.steps == 5
+        assert len(res.chi2_steps) == 4
+        for traj in res.chi2_steps.values():
+            assert traj.shape == (5,) and np.all(np.isfinite(traj))
+        # steady state: a repeat refine pays zero fresh compiles
+        jaxevents.install()
+        before = jaxevents.counts()
+        res2 = cf.refine(steps=5)
+        assert (jaxevents.counts() - before).compiles == 0
+        assert res2.dispatches == res.n_buckets
+
+    def test_catalog_refine_step0_matches_fit_step(self):
+        """reweight=None step 0 IS the batched fit's linearized step:
+        the refine dpars agree with CatalogFitter.fit's dpars to 1e-9
+        (same state, same kernel, solve via the factored inverse)."""
+        from pint_tpu.catalog import CatalogFitter, ingest_catalog
+        from pint_tpu.catalog.ingest import make_synthetic_catalog
+
+        report = ingest_catalog(make_synthetic_catalog(
+            n_pulsars=3, seed=9, ntoa_range=(24, 48)))
+        ref = CatalogFitter(report).refine(steps=2)
+        report2 = ingest_catalog(make_synthetic_catalog(
+            n_pulsars=3, seed=9, ntoa_range=(24, 48)))
+        fit = CatalogFitter(report2).fit(maxiter=1)
+        for pf in fit.fits:
+            mine = ref.dpars_first[pf.name]
+            for par, step in pf.dpars.items():
+                assert abs(mine[par] - step) \
+                    <= 1e-9 * max(1.0, abs(step)), (pf.name, par)
+
+
+# ---------------------------------------------------------------------------
+# elastic compatibility: fused sweeps degrade and resume
+# ---------------------------------------------------------------------------
+
+class TestElasticFused:
+    def _grid_setup(self):
+        f = _gls_fitter(ntoas=32, seed=5)
+        f.fit_toas(maxiter=1)
+        g0 = np.linspace(f.model.F0.value - 3e-11,
+                         f.model.F0.value + 3e-11, 4)
+        g1 = np.linspace(f.model.F1.value - 3e-18,
+                         f.model.F1.value + 3e-18, 4)
+        return f, ("F0", "F1"), (g0, g1)
+
+    def test_fused_elastic_degrades_and_matches_unfaulted(
+            self, eight_devices, tmp_path, monkeypatch):
+        """ISSUE 14 acceptance (c): a device lost mid-fused-sweep
+        degrades 8->4 and the resumed scanned sweep matches the
+        unfaulted surface to 1e-7."""
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime import elastic
+        from pint_tpu.runtime.faultinject import SimulatedDeviceLoss
+        from pint_tpu.runtime.plan import select_plan
+
+        f, params, axes = self._grid_setup()
+        plan = select_plan("grid", devices=eight_devices)
+        clean, _ = grid_chisq(f, params, axes, niter=2, chunk=4,
+                              plan=plan,
+                              checkpoint=str(tmp_path / "clean"),
+                              fuse=2)
+        rep = f.last_elastic_report
+        assert rep.chunks_computed == 4
+        assert rep.steady_state_recompiles == 0
+
+        state = {"calls": 0}
+        orig = elastic._invoke_fused
+
+        def failing(eval_fn, blocks, group, plan_):
+            state["calls"] += 1
+            if state["calls"] == 1:
+                raise SimulatedDeviceLoss(
+                    "injected: device lost in fused dispatch",
+                    device_id=int(plan_.devices[1].id))
+            return orig(eval_fn, blocks, group, plan_)
+
+        monkeypatch.setattr(elastic, "_invoke_fused", failing)
+        plan2 = select_plan("grid", devices=eight_devices)
+        faulted, _ = grid_chisq(f, params, axes, niter=2, chunk=4,
+                                plan=plan2,
+                                checkpoint=str(tmp_path / "faulted"),
+                                fuse=2)
+        rep2 = f.last_elastic_report
+        assert rep2.degradations == 1
+        assert rep2.final_plan["rung"] == 4
+        assert len(rep2.evicted) == 1
+        scale = max(1.0, np.abs(clean).max())
+        assert np.abs(np.asarray(clean) - np.asarray(faulted)).max() \
+            / scale < 1e-7
+
+    def test_fused_sweep_resumes_from_checkpoint(self, eight_devices,
+                                                 tmp_path):
+        from pint_tpu.grid import grid_chisq
+        from pint_tpu.runtime.plan import select_plan
+
+        f, params, axes = self._grid_setup()
+        ck = str(tmp_path / "resume")
+        plan = select_plan("grid", devices=eight_devices)
+        first, _ = grid_chisq(f, params, axes, niter=2, chunk=4,
+                              plan=plan, checkpoint=ck, fuse=2)
+        plan2 = select_plan("grid", devices=eight_devices)
+        again, _ = grid_chisq(f, params, axes, niter=2, chunk=4,
+                              plan=plan2, checkpoint=ck, fuse=2)
+        rep = f.last_elastic_report
+        assert rep.chunks_resumed == 4 and rep.chunks_computed == 0
+        assert np.array_equal(np.asarray(first), np.asarray(again))
+
+
+    def test_scatter_fn_cache_keys_on_device_identity(self,
+                                                      eight_devices):
+        """Two 4-device meshes with DIFFERENT survivor sets must not
+        share a cached shard_map executable — it closes over the mesh,
+        and after an eviction the stale one names a dead device."""
+        from jax.sharding import Mesh
+
+        from pint_tpu.runtime.workperbyte import (
+            scattered_normal_equations_fn)
+
+        mesh_a = Mesh(np.array(eight_devices[:4]), ("toa",))
+        mesh_b = Mesh(np.array(eight_devices[4:8]), ("toa",))
+        fn_a = scattered_normal_equations_fn(mesh_a)
+        fn_b = scattered_normal_equations_fn(mesh_b)
+        assert fn_a is not fn_b
+        assert fn_a is scattered_normal_equations_fn(mesh_a)
+
+    def test_fuse_with_checkpoint_needs_plan(self, tmp_path):
+        """fuse= on the plain checkpointed path would be a silent
+        no-op; it must refuse loudly and name the fix."""
+        from pint_tpu.exceptions import UsageError
+        from pint_tpu.grid import grid_chisq
+
+        f = _gls_fitter(ntoas=32)
+        f.fit_toas(maxiter=1)
+        g0 = np.linspace(f.model.F0.value - 3e-11,
+                         f.model.F0.value + 3e-11, 4)
+        g1 = np.linspace(f.model.F1.value - 3e-18,
+                         f.model.F1.value + 3e-18, 4)
+        with pytest.raises(UsageError, match="plan"):
+            grid_chisq(f, ("F0", "F1"), (g0, g1), niter=2, chunk=4,
+                       checkpoint=str(tmp_path / "ck"), fuse=4)
+
+
+# ---------------------------------------------------------------------------
+# plan strategy: data-parallel-first + the tunable
+# ---------------------------------------------------------------------------
+
+class TestPlanStrategy:
+    def test_select_plan_data_parallel_first(self, eight_devices):
+        """A caller holding a batch routes data-parallel: n_batch >= 2
+        flips the TOA-reduction workload onto the pulsar axis; without
+        a batch the TOA sharding stands."""
+        from pint_tpu.runtime.plan import select_plan
+
+        single = select_plan("gls_normal_eq", devices=eight_devices,
+                             n_items=64)
+        assert single.axes[0] == "toa"
+        batched = select_plan("gls_normal_eq", devices=eight_devices,
+                              n_batch=16)
+        assert batched.axes[0] == "pulsar"
+        assert batched.kind == "pjit"
+        assert batched.rung == 8
+        # a 1-item "batch" is no batch at all
+        not_batched = select_plan("gls_normal_eq",
+                                  devices=eight_devices, n_batch=1,
+                                  n_items=64)
+        assert not_batched.axes[0] == "toa"
+
+    def test_tune_plan_strategy_ranks_real_executables(
+            self, eight_devices):
+        """The strategy tunable analyzes all three candidates on real
+        compiled executables: the scatter candidate carries
+        reduce-scatter ops, the all-reduce candidate carries more
+        collective bytes than the scatter one, and the decision value
+        is a (axes, kind) dict the resolve layer accepts."""
+        from pint_tpu.autotune import tune_plan_strategy
+
+        f = _gls_fitter(ntoas=48)
+        decision = tune_plan_strategy(f, measure_reps=1)
+        assert decision.basis in ("measured", "static")
+        assert isinstance(decision.value, dict)
+        assert decision.value.get("kind") in ("pjit", "shard_map")
+        assert decision.value.get("axes")
+        by_build = {c["value"]["build"]: c for c in decision.candidates}
+        assert set(by_build) == {"scatter", "allreduce", "dataparallel"}
+        sc = by_build["scatter"]
+        ar = by_build["allreduce"]
+        if sc["excluded"] is None and ar["excluded"] is None:
+            # predicted_s IS the measured collective bytes (the cost-
+            # ranking signal): the scattered build must move less
+            assert sc["predicted_s"] < ar["predicted_s"]
+            assert sc["measured_fits_per_s"] is not None
+
+
+# ---------------------------------------------------------------------------
+# scalewatch calibration
+# ---------------------------------------------------------------------------
+
+class TestCalibration:
+    def test_calibrated_repeats_respect_floor(self):
+        """ISSUE 14 satellite: repeats scale until the timed region
+        reaches the floor (r11 measured ~5 ms walls — pure dispatch
+        floor)."""
+        import time as _time
+
+        from tools.scalewatch import _calibrated_repeats
+
+        repeats, probe = _calibrated_repeats(
+            lambda: _time.sleep(0.002), floor_s=0.02)
+        assert probe >= 0.002
+        assert repeats * probe >= 0.02
+        # an already-slow workload needs no repeats
+        repeats2, _ = _calibrated_repeats(
+            lambda: _time.sleep(0.03), floor_s=0.02)
+        assert repeats2 == 1

@@ -188,13 +188,23 @@ _CATALOG_SUBMODULES = {"ingest", "buckets", "batchfit", "crosscorr",
 #: not the modules' public function surface)
 _AMORTIZED_SUBMODULES = {"flows", "elbo", "train", "posterior"}
 
+#: pint_tpu.runtime's work-per-byte module is host-side orchestration
+#: around its one traced scatter kernel (operand padding + device
+#: placement, AOT contract verification through distview's
+#: lower/compile): a scattered_normal_equations / verify_scatter_
+#: contract call inside a traced function would re-enter tracing per
+#: TRACE — the scan-fused kernels it feeds (serve_fused, the grid's
+#: fused scan) dispatch plain inner functions, not this API
+_RUNTIME_SUBMODULES = {"workperbyte"}
+
 #: one table drives the ImportFrom tracking for every host-side
 #: package (the next PR's package is one row, not a copied branch)
 _HOST_PACKAGES = (("pint_tpu.telemetry", _TELEMETRY_SUBMODULES),
                   ("pint_tpu.serving", _SERVING_SUBMODULES),
                   ("pint_tpu.autotune", _AUTOTUNE_SUBMODULES),
                   ("pint_tpu.catalog", _CATALOG_SUBMODULES),
-                  ("pint_tpu.amortized", _AMORTIZED_SUBMODULES))
+                  ("pint_tpu.amortized", _AMORTIZED_SUBMODULES),
+                  ("pint_tpu.runtime", _RUNTIME_SUBMODULES))
 
 
 def _record_imports(info: FileInfo) -> None:

@@ -60,6 +60,7 @@ from tools.jaxlint.rules import downcast          # noqa: E402,F401
 from tools.jaxlint.rules import traced_branch     # noqa: E402,F401
 from tools.jaxlint.rules import static_args       # noqa: E402,F401
 from tools.jaxlint.rules import typed_raises      # noqa: E402,F401
+from tools.jaxlint.rules import collective_context  # noqa: E402,F401
 
 
 def default_rules() -> List[Rule]:

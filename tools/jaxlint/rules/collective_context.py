@@ -1,0 +1,132 @@
+"""collective-axis-context: psum_scatter needs a shard_map axis.
+
+``jax.lax.psum_scatter`` (and its ``psum``/``all_gather`` siblings)
+reduce over a NAMED mesh axis.  Inside ``shard_map`` the axis name is
+bound and the collective compiles to a real ``reduce-scatter``.  Called
+from a plain ``jit``/``vmap``-traced function the axis is unbound — and
+on the implicit-sharding path XLA's SPMD partitioner is free to satisfy
+the program by fully replicating the operand first, which silently
+re-pays the all-to-every-device traffic the scatter was written to
+eliminate (the work-per-byte kernels in
+:mod:`pint_tpu.runtime.workperbyte` exist exactly to avoid that).
+
+Flag every ``psum_scatter`` call whose enclosing function is not
+(transitively) a shard_map-wrapped body.  The fix is to move the
+collective into the shard_map kernel, or drop the manual collective
+and let the partitioner place the reduction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from tools.jaxlint.engine import FileInfo, _attr_root
+from tools.jaxlint.rules import Rule, register
+
+#: the per-axis collectives whose semantics require a bound axis name;
+#: psum_scatter is the one with the silent full-replication footgun
+#: (the others fail loudly at trace time, so only it is flagged)
+_SCATTER_NAMES = {"psum_scatter"}
+
+
+def _is_scatter_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in _SCATTER_NAMES
+    if isinstance(func, ast.Attribute):
+        # jax.lax.psum_scatter / lax.psum_scatter
+        return func.attr in _SCATTER_NAMES \
+            and _attr_root(func) is not None
+    return False
+
+
+def _is_shard_map_call(node: ast.Call, info: FileInfo) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return info.trace_names.get(func.id) == "shard_map"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "shard_map"
+    return False
+
+
+def _shard_map_arg_names(call: ast.Call) -> Set[str]:
+    """Names passed as shard_map's wrapped function (first positional
+    or ``f=``/``fun=`` keyword)."""
+    out: Set[str] = set()
+    args = list(call.args[:1])
+    args += [kw.value for kw in call.keywords if kw.arg in ("f", "fun")]
+    for a in args:
+        if isinstance(a, ast.Name):
+            out.add(a.id)
+    return out
+
+
+@register
+class CollectiveAxisContextRule(Rule):
+    name = "collective-axis-context"
+    description = ("psum_scatter outside a shard_map axis context — "
+                   "silent full-replication under the SPMD partitioner")
+
+    def check(self, info: FileInfo):
+        # 1) collect every def that IS a shard_map body: named function
+        #    arguments of shard_map(...) calls, defs whose decorators
+        #    spell shard_map, and everything nested inside either
+        defs_by_name = {}
+        for node in ast.walk(info.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+        in_context: Set[int] = set()
+        wrapped: Set[str] = set()
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Call) \
+                    and _is_shard_map_call(node, info):
+                wrapped |= _shard_map_arg_names(node)
+        for name in wrapped:
+            for fn in defs_by_name.get(name, []):
+                in_context.add(id(fn))
+        for name, fns in defs_by_name.items():
+            for fn in fns:
+                for dec in fn.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if (isinstance(target, ast.Name)
+                        and info.trace_names.get(target.id)
+                            == "shard_map") \
+                            or (isinstance(target, ast.Attribute)
+                                and target.attr == "shard_map"):
+                        in_context.add(id(fn))
+        # nested defs inside a shard_map body inherit the axis context
+        frontier = [fn for fns in defs_by_name.values() for fn in fns
+                    if id(fn) in in_context]
+        while frontier:
+            node = frontier.pop()
+            for child in ast.walk(node):
+                if child is node:
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)) \
+                        and id(child) not in in_context:
+                    in_context.add(id(child))
+                    frontier.append(child)
+
+        # 2) flag scatter calls whose innermost enclosing def is not in
+        #    an axis context (module level counts as no context)
+        def walk_scope(node, contexted: bool):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    yield from walk_scope(child, contexted
+                                          or id(child) in in_context)
+                    continue
+                if isinstance(child, ast.Call) \
+                        and _is_scatter_call(child) and not contexted:
+                    yield info.finding(
+                        self.name, child,
+                        "psum_scatter outside a shard_map axis context: "
+                        "the axis name is unbound (trace error) or the "
+                        "SPMD partitioner fully replicates the operand "
+                        "first — move the collective into the shard_map "
+                        "kernel (see pint_tpu/runtime/workperbyte.py)")
+                yield from walk_scope(child, contexted)
+
+        yield from walk_scope(info.tree, False)

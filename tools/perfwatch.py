@@ -110,6 +110,11 @@ class RunRecord:
     posterior_p99_ms: Optional[float] = None
     posterior_train_steps: Optional[int] = None
     posterior_error: Optional[str] = None      #: degraded posterior block
+    #: from the scaling{...} block (round 14+: work-per-byte plans)
+    scaling_efficiency_at_max: Optional[float] = None
+    scaling_dispatch_per_s: Optional[float] = None
+    scaling_scatter_bytes: Optional[float] = None
+    scaling_error: Optional[str] = None        #: degraded scaling block
     #: from the precision{...} block (round 12+: mixed-precision layer)
     precision_mixed_fits_per_s: Optional[float] = None
     precision_max_rel_err: Optional[float] = None
@@ -241,6 +246,17 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.catalog_n_pulsars = catalog["n_pulsars"]
         if isinstance(catalog.get("error"), str) and catalog["error"]:
             rec.catalog_error = catalog["error"]
+    scaling = h.get("scaling")
+    if isinstance(scaling, dict):
+        for src, dst in (("efficiency_at_max",
+                          "scaling_efficiency_at_max"),
+                         ("dispatch_per_s", "scaling_dispatch_per_s"),
+                         ("scatter_bytes", "scaling_scatter_bytes")):
+            if isinstance(scaling.get(src), (int, float)) \
+                    and not isinstance(scaling.get(src), bool):
+                setattr(rec, dst, float(scaling[src]))
+        if isinstance(scaling.get("error"), str) and scaling["error"]:
+            rec.scaling_error = scaling["error"]
     posterior = h.get("posterior")
     if isinstance(posterior, dict):
         for src, dst in (("draws_per_s", "posterior_draws_per_s"),
@@ -474,6 +490,17 @@ def check_series(runs: List[RunRecord], threshold: float,
                    lambda r: r.posterior_logprob_per_s, +1, False),
                   ("posterior_p99_ms",
                    lambda r: r.posterior_p99_ms, -1, False),
+                  # work-per-byte plans (round 14+): committed-series
+                  # parallel efficiency and the live fused-dispatch
+                  # rate gate drops; the grid reduce-scatter payload
+                  # gates rises (more bytes moved per solve is a
+                  # communication regression)
+                  ("scaling_efficiency_at_max",
+                   lambda r: r.scaling_efficiency_at_max, +1, False),
+                  ("scaling_dispatch_per_s",
+                   lambda r: r.scaling_dispatch_per_s, +1, False),
+                  ("scaling_scatter_bytes",
+                   lambda r: r.scaling_scatter_bytes, -1, False),
                   # mixed-precision layer (round 12+): policy-path
                   # throughput gates drops; max_rel_err gates rises WITH
                   # the zero-baseline opt-in — a bit-identical history
@@ -587,6 +614,19 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: posterior block degraded "
                    f"({latest_rec.posterior_error}) where prior runs "
                    "measured the amortized engine"))
+    # a degraded scaling block where prior rounds measured the
+    # work-per-byte plans is a regression, not a silent skip
+    if latest_rec.scaling_error is not None \
+            and any(r.scaling_dispatch_per_s is not None
+                    for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="scaling", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: scaling block degraded "
+                   f"({latest_rec.scaling_error}) where prior runs "
+                   "measured the work-per-byte plans"))
     # a degraded precision block where prior rounds measured the
     # mixed-precision layer is a regression, not a silent skip
     if latest_rec.precision_error is not None \

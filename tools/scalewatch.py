@@ -18,12 +18,20 @@ per-device busy fractions), and folds them into one schema'd artifact::
     python -m tools.scalewatch --check           # gate the history
     python -m tools.scalewatch --worker 8        # internal: one count
 
-The ``catalog`` workload sweeps the batched multi-pulsar GLS fit
-(:mod:`pint_tpu.catalog`) data-parallel over the ``pulsar`` mesh axis
-— the embarrassingly parallel axis ROADMAP item 2 names as the honest
-multichip route (the TOA-sharded grid measured 7% efficiency at 8
-devices; this series measures the axis that should scale).  ``--check``
-gates each workload's series against its OWN history.
+The ``catalog`` workload sweeps the scan-fused batched multi-pulsar
+GLS refinement (:mod:`pint_tpu.catalog` — ONE dispatch retires a whole
+ladder of fit steps per bucket) data-parallel over the ``pulsar`` mesh
+axis — the embarrassingly parallel axis ROADMAP item 2 names as the
+honest multichip route.  The grid workload runs scan-fused too
+(``grid_chisq(fuse=...)``) and its normal-equation executable must
+pass the reduce-scatter HLO contract
+(:func:`pint_tpu.runtime.workperbyte.verify_scatter_contract`).  Both
+workloads auto-calibrate their repeat counts until each measured wall
+reaches a floor (default 0.25 s, ``SCALEWATCH_FLOOR_S``): r11's
+catalog series measured ~5 ms walls — pure dispatch floor — and the
+calibration is stamped into the artifact (``calibration{}`` per
+series entry) so series remain comparable.  ``--check`` gates each
+workload's series against its OWN history.
 
 Artifact schema ``pint_tpu.telemetry.scaling/1``: a ``series`` entry
 per device count (wall seconds, fits/s, speedup and parallel efficiency
@@ -132,9 +140,9 @@ def _build_workload():
         f = GLSFitter(toas, model)
         dm2 = 3 * (float(model.M2.uncertainty or 0.011))
         dsini = 3 * (float(model.SINI.uncertainty or 1.8e-4))
-        g0 = np.linspace(model.M2.value - dm2, model.M2.value + dm2, 8)
+        g0 = np.linspace(model.M2.value - dm2, model.M2.value + dm2, 16)
         g1 = np.linspace(model.SINI.value - dsini,
-                         min(0.999999, model.SINI.value + dsini), 8)
+                         min(0.999999, model.SINI.value + dsini), 16)
         return f, ("M2", "SINI"), (g0, g1), "b1855_gls_grid"
 
     from bench import FALLBACK_PAR
@@ -151,40 +159,72 @@ def _build_workload():
                                    rng=np.random.default_rng(11))
     f = GLSFitter(toas, model)
     dF0, dF1 = 3e-11, 3e-18
-    g0 = np.linspace(model.F0.value - dF0, model.F0.value + dF0, 8)
-    g1 = np.linspace(model.F1.value - dF1, model.F1.value + dF1, 8)
+    g0 = np.linspace(model.F0.value - dF0, model.F0.value + dF0, 16)
+    g1 = np.linspace(model.F1.value - dF1, model.F1.value + dF1, 16)
     return f, ("F0", "F1"), (g0, g1), "synthetic_gls_grid"
 
 
+#: workload-calibration floor: per-measurement wall must reach this
+#: many seconds or the series measures dispatch floor, not compute
+#: (SCALING_r11's single-device wall was ~5 ms — the whole "scaling"
+#: series was timing XLA dispatch overhead).  Repeats are auto-scaled
+#: until the floor holds and the calibration is stamped into the
+#: artifact so series remain comparable.
+_CAL_FLOOR_S = float(os.environ.get("SCALEWATCH_FLOOR_S", "0.25"))
+
 #: catalog-workload constants: FIXED across swept device counts (that
 #: is what makes the speedup series meaningful) — 16 pulsars covers the
-#: 8-device sweep top with 2 lanes per device
+#: 8-device sweep top with 2 lanes per device, TOA counts sized so the
+#: per-step reweighted-Gram compute dominates the scan-step overhead
 _CATALOG_PULSARS = 16
 _CATALOG_SEED = 11
-_CATALOG_TIMED_PASSES = 8
+_CATALOG_NTOA_RANGE = (600, 768)
+#: forced bucket ladders: ONE (768, 16) bucket so the whole catalog is
+#: one scan-fused executable (ragged-ladder learning is the bench's
+#: concern; the scaling series wants one fixed device program)
+_CATALOG_NTOA_LADDER = (768,)
+_CATALOG_NFREE_LADDER = (16,)
+#: fused refinement depth per dispatch (the scan-fused multi-step
+#: kernel: Huber-reweighted Gram re-accumulation per step — work-per-
+#: byte-dense, LAPACK-free in-loop)
+_CATALOG_STEPS = 32
+_CATALOG_REWEIGHT = "huber"
+
+
+def _calibrated_repeats(measure_once, floor_s: float = None):
+    """(repeats, probe_wall_s): run ``measure_once`` once (warm) and
+    size the repeat count so the timed region reaches the calibration
+    floor.  The probe runs AFTER warm-up, so it measures steady state."""
+    floor_s = _CAL_FLOOR_S if floor_s is None else floor_s
+    t0 = time.perf_counter()
+    measure_once()
+    probe = max(time.perf_counter() - t0, 1e-6)
+    return max(1, int(-(-floor_s // probe))), probe
 
 
 def _build_catalog_workload():
-    """A certified 16-pulsar ragged synthetic catalog (deterministic
-    seed) — the pulsar-data-parallel workload ROADMAP item 2 says
-    should scale, unlike the TOA-sharded GLS grid whose measured
-    8-device efficiency is 7%."""
+    """A certified ragged synthetic catalog (deterministic seed) — the
+    pulsar-data-parallel workload ROADMAP item 2 says should scale,
+    unlike the TOA-sharded GLS grid whose r06 8-device efficiency was
+    7%."""
     from pint_tpu.catalog import CatalogFitter, ingest_catalog
     from pint_tpu.catalog.ingest import make_synthetic_catalog
 
     report = ingest_catalog(make_synthetic_catalog(
         n_pulsars=_CATALOG_PULSARS, seed=_CATALOG_SEED,
-        ntoa_range=(24, 64)))
+        ntoa_range=_CATALOG_NTOA_RANGE))
     return report, CatalogFitter
 
 
 def run_catalog_worker(n_devices: int, devs) -> int:
-    """One catalog-workload measurement: the batched multi-pulsar GLS
-    solve, pulsar-axis data-parallel over the plan's mesh.  The timed
-    region is the per-bucket batched DISPATCHES at fixed operands (the
-    device work the pulsar axis parallelizes; the host linearization
-    rebuild is measured separately by the bench) — fits/s = pulsar
-    fits per second across the timed passes."""
+    """One catalog-workload measurement: the scan-fused batched
+    multi-step GLS refinement, pulsar-axis data-parallel over the
+    plan's mesh.  The timed region is the fused per-bucket DISPATCHES
+    at fixed operands — ONE dispatch retires ``_CATALOG_STEPS`` fit
+    steps per pulsar (the dispatch-floor fix; r11 measured pure
+    dispatch overhead at ~5 ms walls) — and repeats are calibrated so
+    the measured wall reaches the floor.  fits/s counts pulsar
+    fit-steps retired per second."""
     import jax
 
     from pint_tpu import profiling
@@ -194,21 +234,30 @@ def run_catalog_worker(n_devices: int, devs) -> int:
     report, CatalogFitter = _build_catalog_workload()
     plan = select_plan("catalog", devices=devs,
                        n_items=report.n_pulsars)
-    cf = CatalogFitter(report, plan=plan)
-    cf.fit(maxiter=1)                       # compile + settle the state
-    handles = cf.bucket_executables()       # sharded operands, fixed
+    cf = CatalogFitter(report, plan=plan,
+                       ntoa_ladder=_CATALOG_NTOA_LADDER,
+                       nfree_ladder=_CATALOG_NFREE_LADDER)
+    handles = cf.fused_bucket_executables(
+        steps=_CATALOG_STEPS, reweight=_CATALOG_REWEIGHT)
     for fn, ops in handles.values():
         # warm every bucket AND await it: JAX dispatch is async, and an
         # in-flight warm execution leaking into the timed region would
         # add noise to exactly the number the scaling gate trends
         jax.block_until_ready(fn(*ops))
-    t0 = time.perf_counter()
-    for _ in range(_CATALOG_TIMED_PASSES):
+
+    def one_pass():
+        out = None
         for fn, ops in handles.values():
             out = fn(*ops)
-    jax.block_until_ready(out)
+        jax.block_until_ready(out)
+
+    repeats, probe = _calibrated_repeats(one_pass)
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        one_pass()
     wall = time.perf_counter() - t0
-    fits = report.n_pulsars * _CATALOG_TIMED_PASSES
+    fits = report.n_pulsars * _CATALOG_STEPS * repeats
+    dispatches = len(handles) * repeats
 
     import tempfile
 
@@ -217,19 +266,16 @@ def run_catalog_worker(n_devices: int, devs) -> int:
     try:
         with tempfile.TemporaryDirectory(prefix="scalewatch_trace_") as td:
             with profiling.device_trace(td) as rep:
-                for _ in range(_CATALOG_TIMED_PASSES):
-                    for fn, ops in handles.values():
-                        out = fn(*ops)
-                jax.block_until_ready(out)
+                one_pass()
             busy = rep.device_busy_fractions()
             skew = rep.straggler_skew_s
     except Exception as e:  # tracing is best-effort on exotic backends
         print(f"scalewatch worker: trace skipped "
               f"({type(e).__name__}: {e})", file=sys.stderr)
 
-    # the observatory view of the LARGEST bucket executable (cost,
-    # collectives — expected ~none: the pulsar axis is embarrassingly
-    # parallel — and the sharding plan)
+    # the observatory view of the LARGEST fused bucket executable
+    # (cost, collectives — expected ~none: the pulsar axis is
+    # embarrassingly parallel — and the sharding plan)
     big = max(handles, key=lambda k: handles[k][1][0].size)
     obs = distview.observe_jitted(handles[big][0], *handles[big][1],
                                   name=big)
@@ -241,7 +287,12 @@ def run_catalog_worker(n_devices: int, devs) -> int:
           platform=str(jax.default_backend()),
           workload="catalog_batched_fit",
           busy_fractions=busy, straggler_skew_s=skew,
-          plan=plan.to_dict())
+          plan=plan.to_dict(),
+          calibration={"floor_s": _CAL_FLOOR_S, "repeats": repeats,
+                       "probe_wall_s": probe},
+          fused={"steps": _CATALOG_STEPS, "reweight": _CATALOG_REWEIGHT,
+                 "dispatches": dispatches,
+                 "dispatch_per_s": dispatches / max(wall, 1e-9)})
     _emit("cost", cost=obs["cost"])
     _emit("collective", collective=obs["collectives"])
     _emit("sharding_plan", sharding_plan=obs["sharding_plan"])
@@ -283,16 +334,32 @@ def run_worker(n_devices: int, workload: str = "grid") -> int:
     f, params, axes, workload = _build_workload()
     f.fit_toas(maxiter=1)
     plan = select_plan("grid", devices=devs)
+    # scan-fused sweep: 8 chunk blocks per dispatch (one fused
+    # executable retires the whole 256-point grid — the dispatch-floor
+    # amortization; chunk 32 tiles onto every swept rung)
+    chunk, fuse = 32, 8
+    kw = dict(niter=2, plan=plan, chunk=chunk, fuse=fuse)
     warm = (axes[0][[0, -1]], axes[1][[0, -1]])
-    grid_chisq(f, params, warm, niter=2, plan=plan)      # compile
+    grid_chisq(f, params, warm, **kw)                    # compile
+    grid_chisq(f, params, axes, **kw)                    # + full shape
+    holder: Dict[str, object] = {}
+
+    def one_pass():
+        holder["chi2"] = grid_chisq(f, params, axes, **kw)[0]
+
+    repeats, probe = _calibrated_repeats(one_pass)
     t0 = time.perf_counter()
-    chi2, _ = grid_chisq(f, params, axes, niter=2, plan=plan)
+    for _ in range(repeats):
+        one_pass()
     wall = time.perf_counter() - t0
+    chi2 = holder["chi2"]
     npts = int(np.asarray(chi2).size)
     if not np.all(np.isfinite(np.asarray(chi2))):
         print(f"scalewatch worker: non-finite chi2 at {n_devices} "
               f"device(s)", file=sys.stderr)
         return 1
+    nchunks = -(-npts // chunk)
+    dispatches = -(-nchunks // fuse) * repeats
     # per-device busy fractions from a traced re-run (after the clean
     # timing): device planes on real chips, XLA:CPU executor-thread
     # lanes on the virtual mesh
@@ -303,7 +370,7 @@ def run_worker(n_devices: int, workload: str = "grid") -> int:
     try:
         with tempfile.TemporaryDirectory(prefix="scalewatch_trace_") as td:
             with profiling.device_trace(td) as rep:
-                grid_chisq(f, params, axes, niter=2, plan=plan)
+                one_pass()
             busy = rep.device_busy_fractions()
             skew = rep.straggler_skew_s
     except Exception as e:  # tracing is best-effort on exotic backends
@@ -311,20 +378,34 @@ def run_worker(n_devices: int, workload: str = "grid") -> int:
               f"({type(e).__name__}: {e})", file=sys.stderr)
 
     obs = distview.observe_grid(f)
-    # the TOA-sharded GLS normal-equation reduction: the all-reduce
-    # whose bytes decide the sharding plan (comm/compute headline) —
-    # routed through its own 'toa'-axis plan, same membership source
+    # the TOA-sharded GLS normal-equation reduction, now the
+    # reduce-scatter kernel: the HLO contract (reduce-scatter present,
+    # NO full-Gram all-reduce) is verified on the compiled executable
+    # — a violated contract fails the worker, the series must not
+    # silently trend the wrong collective
+    from pint_tpu.runtime.workperbyte import verify_scatter_contract
+
     ne_plan = select_plan("gls_normal_eq", devices=devs)
     ne_fn, ne_args = f.gls_normal_equations_executable(plan=ne_plan)
-    ne_coll = distview.analyze_jitted_collectives(
+    ne_coll, violations = verify_scatter_contract(
         ne_fn, *ne_args, name="gls.normal_eq")
+    if ne_plan.mesh is not None and violations:
+        print("scalewatch worker: scattered-Gram HLO contract violated: "
+              + "; ".join(violations), file=sys.stderr)
+        return 1
 
     _emit("measurement", n_devices=n_devices, wall_s=wall,
-          fits_per_sec=npts / max(wall, 1e-9), grid_points=npts,
+          fits_per_sec=npts * repeats / max(wall, 1e-9),
+          grid_points=npts,
           ntoas=len(f.toas), nfree=len(f.model.free_params),
           platform=str(jax.default_backend()), workload=workload,
           busy_fractions=busy, straggler_skew_s=skew,
-          plan=plan.to_dict())
+          plan=plan.to_dict(),
+          calibration={"floor_s": _CAL_FLOOR_S, "repeats": repeats,
+                       "probe_wall_s": probe},
+          fused={"chunk": chunk, "fuse": fuse,
+                 "dispatches": dispatches,
+                 "dispatch_per_s": dispatches / max(wall, 1e-9)})
     _emit("cost", cost=obs["cost"])
     _emit("collective", collective=obs["collectives"])
     _emit("collective", collective=ne_coll.to_dict())
@@ -423,11 +504,18 @@ def run_sweep(device_counts: List[int], errors: List[str],
                            if speedup is not None else None),
             "comm_compute_ratio": ne.get("comm_compute_ratio"),
             "collective_bytes": ne.get("collective_bytes"),
+            "collective_ops": {k: int(v.get("count", 0)) for k, v in
+                               (ne.get("ops") or {}).items()},
             "grid_comm_compute_ratio": grid_coll.get("comm_compute_ratio"),
             "busy_fractions": m.get("busy_fractions") or {},
             "straggler_skew_s": m.get("straggler_skew_s"),
             "mesh": (per_count[n].get("sharding_plan", {})
                      .get("sharding_plan", {}).get("mesh")),
+            # workload-sizing calibration + fused-dispatch accounting
+            # (ISSUE 14: the series must prove it measures compute, not
+            # dispatch floor, and say how many dispatches it amortized)
+            "calibration": m.get("calibration"),
+            "fused": m.get("fused"),
         })
     last = series[-1]
     return {

@@ -92,6 +92,7 @@ def _reject_constant(name: str):
 ELASTIC_EVENT_ATTRS = {
     "plan_selected": {"workload": str, "kind": str, "rung": int,
                       "n_devices": int},
+    "plan_strategy": {"workload": str, "chosen": str, "source": str},
     "device_evicted": {"device_id": int, "reason": str},
     "mesh_degraded": {"from_rung": int, "to_rung": int, "reason": str},
 }
