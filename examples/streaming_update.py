@@ -1,0 +1,142 @@
+"""Live tracking of a pulsar under load: the streaming timing engine.
+
+Observatories emit TOAs continuously.  Refitting from scratch on every
+new observing epoch rebuilds a Woodbury system that is 99% unchanged;
+the streaming engine (``pint_tpu/streaming``) instead rewrites the
+existing normal-equation Cholesky factor with O(k * K^2) rank-k work
+per appended block and warm-starts Gauss-Newton from the previous
+solution.  This walkthrough runs the whole loop at CI size:
+
+1. **Baseline fit** — a GLS fit of the first observing campaign
+   (spin + span-pinned red noise over two bands);
+2. **Append** — new epoch blocks arrive through the integrity
+   validate/quarantine gate and land as rank-k factor UPDATES (bad
+   rows pen without touching the factor), each followed by 1-2 fused
+   warm steps; parameters match a from-scratch fit of the final
+   certified set to 1e-9 relative;
+3. **Quarantine → downdate** — rows flagged after the fact leave the
+   factor as a rank-k DOWNDATE; releasing the repaired rows is a
+   rank-k UPDATE, never a rebuild;
+4. **The update door** — the same operations served through
+   ``TimingService.serve_updates`` with pre-warmed kernels: zero
+   fresh compiles at steady state, milliseconds per update where the
+   warm full-refit path costs hundreds.
+
+Run:  python examples/streaming_update.py [--cpu]
+"""
+
+import argparse
+import copy
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--cpu", action="store_true",
+                help="force the CPU backend")
+args = ap.parse_args()
+if args.cpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+
+from pint_tpu import telemetry  # noqa: E402
+from pint_tpu.gls_fitter import GLSFitter  # noqa: E402
+from pint_tpu.models import get_model  # noqa: E402
+from pint_tpu.serving import TimingService  # noqa: E402
+from pint_tpu.simulation import make_fake_toas_uniform  # noqa: E402
+from pint_tpu.streaming import UpdateRequest  # noqa: E402
+from pint_tpu.telemetry import jaxevents  # noqa: E402
+
+PAR = """\
+PSR STREAMDEMO
+RAJ 04:37:15.0
+DECJ -47:15:09.0
+F0 173.6879 1
+F1 -1.7e-15 1
+PEPOCH 55000
+DM 2.64
+EFAC mjd 50000 60000 1.1
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 5
+TNREDTSPAN 6.0
+UNITS TDB
+"""
+
+# -- the data stream: a base campaign + five later epochs -------------------
+model = get_model([ln + "\n" for ln in PAR.splitlines()])
+rng = np.random.default_rng(20260804)
+toas = make_fake_toas_uniform(53400, 54800, 140, model,
+                              freq=np.array([800.0, 1400.0]),
+                              error_us=1.0, add_noise=True, rng=rng)
+base, blocks = toas[np.arange(100)], [
+    toas[np.arange(100 + 8 * i, 100 + 8 * (i + 1))] for i in range(5)]
+
+# -- 1. baseline fit --------------------------------------------------------
+f = GLSFitter(base, copy.deepcopy(model))
+chi2 = f.fit_toas(maxiter=3)
+print(f"baseline fit: {len(base)} TOAs, chi2 {chi2:.1f}")
+
+# -- 2-4. the update door: warm kernels, stream the epochs ------------------
+# basic telemetry ON: the jaxevents compile counter only counts while
+# telemetry is active — the compiles=0 claim below is measured, not
+# vacuous.  block_sizes covers BOTH the append shape (8) and the
+# 2-row quarantine/release ops, so every dispatched rung is warm.
+telemetry.activate("basic")
+svc = TimingService()
+svc.register_stream(f, block_sizes=[2, 8])
+svc.serve_updates([UpdateRequest(new_toas=blocks[0],
+                                 request_id="settle")])
+before = jaxevents.counts()
+for i, block in enumerate(blocks[1:4]):
+    res = svc.serve_updates([UpdateRequest(new_toas=block,
+                                           request_id=f"epoch-{i}")])[0]
+    print(f"append epoch-{i}: +{res.outcome.block} TOAs -> chi2 "
+          f"{res.chi2:.1f} in {res.latency_ms:.1f} ms "
+          f"(rank-k: {res.fallback is None})")
+# steady state = repeated shapes: the corrupt-block demo below
+# certifies 7 of 8 rows, a fresh per-shape ingestion build outside
+# the steady-state contract (the kernels stay warm either way)
+steady = jaxevents.counts().compiles - before.compiles
+print(f"steady-state compiles across the appends: {steady}")
+
+# a corrupted block: the ingestion gate pens the bad row, the factor
+# ingests only the certified ones — and nothing rebuilds
+bad = copy.deepcopy(blocks[4])
+bad.error_us[3] = -1.0
+res = svc.serve_updates([UpdateRequest(new_toas=bad,
+                                       request_id="corrupt")])[0]
+print(f"corrupt block: {res.quarantined} row(s) quarantined at the "
+      f"door, {res.outcome.block - res.quarantined} ingested")
+
+# quarantine -> rank-k downdate; release -> rank-k update (no rebuild)
+bid = res.outcome.block_id
+svc.serve_updates([UpdateRequest(kind="quarantine", block_id=bid,
+                                 rows=[0, 2])])
+rel = svc.serve_updates([UpdateRequest(kind="release", block_id=bid,
+                                       rows=[0, 2])])[0]
+print(f"quarantine/release cycle: rank-k both ways, "
+      f"rebuilds={svc.stream.rebuilds}")
+
+# -- the pin: the streamed solution IS the from-scratch answer --------------
+scratch = GLSFitter(svc.stream.cache.toas, copy.deepcopy(model))
+scratch.fit_toas(maxiter=4)
+worst = max(abs(getattr(f.model, p).value
+                - getattr(scratch.model, p).value)
+            / abs(getattr(scratch.model, p).value)
+            for p in ("F0", "F1"))
+print(f"streamed vs from-scratch fit: worst relative parameter "
+      f"difference {worst:.2e}")
+assert worst < 1e-9
+assert steady == 0
+assert svc.stream.rebuilds == 0
+lat = svc.update_latency_summary()
+print(f"update door: {svc.updates_served} requests, "
+      f"p50 {lat['p50_ms']:.1f} ms")
+telemetry.deactivate()
+print("done")
+sys.exit(0)

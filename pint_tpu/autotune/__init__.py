@@ -56,6 +56,7 @@ from pint_tpu.autotune.search import (
     tune_plan_strategy,
     tune_precision,
     tune_solve_rung,
+    tune_update_blocks,
 )
 
 __all__ = ["AUTOTUNE_SCHEMA", "TUNE_MANIFEST_SCHEMA", "Candidate",
@@ -69,9 +70,11 @@ __all__ = ["AUTOTUNE_SCHEMA", "TUNE_MANIFEST_SCHEMA", "Candidate",
            "resolve_solve_ladder", "resolve_plan_axes",
            "resolve_plan_strategy", "resolve_serve_buckets",
            "resolve_catalog_ladders", "resolve_correction_dtype",
+           "resolve_update_blocks", "tune_update_blocks",
            "grid_chunk_vkey", "solve_rung_vkey", "plan_axes_vkey",
            "plan_strategy_vkey", "serve_buckets_vkey",
-           "catalog_buckets_vkey", "correction_dtype_vkey"]
+           "catalog_buckets_vkey", "correction_dtype_vkey",
+           "update_blocks_vkey"]
 
 
 def _emit_event(name: str, **attrs) -> None:
@@ -134,6 +137,13 @@ def catalog_buckets_vkey(shapes) -> tuple:
     re-learns rather than replaying a stale ladder."""
     return ("catalog.buckets",
             tuple(sorted((int(n), int(k)) for n, k in shapes)))
+
+
+def update_blocks_vkey() -> tuple:
+    #: the stream kernels' own schema version — the append-block-size
+    #: ladder describes the deployment's arrival-size population (the
+    #: serve-buckets rationale), not one stream's frame
+    return ("update.blocks", 1)
 
 
 def correction_dtype_vkey(model, toas) -> tuple:
@@ -308,6 +318,26 @@ def resolve_catalog_ladders(shapes) -> Optional[dict]:
         return None
     return {"ntoa": tuple(int(b) for b in ntoa),
             "nfree": tuple(int(b) for b in nfree)}
+
+
+def resolve_update_blocks() -> Optional[Tuple[int, ...]]:
+    """Tuned append-block-size ladder for the streaming engine's
+    rank-k dispatch buckets, or ``None`` (the static
+    :data:`~pint_tpu.streaming.lowrank.DEFAULT_BLOCK_BUCKETS`)."""
+    if config.tune_dir() is None:
+        return None
+    value, source = resolve("update.blocks", update_blocks_vkey(), None,
+                            requested=False)
+    if source != "tuned" or not isinstance(value, (list, tuple)) \
+            or not value:
+        return None
+    try:
+        ladder = tuple(sorted(int(b) for b in value))
+    except (TypeError, ValueError):
+        return None
+    if ladder[0] < 1:
+        return None
+    return ladder
 
 
 def resolve_correction_dtype(model, toas) -> str:

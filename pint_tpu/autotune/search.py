@@ -880,6 +880,100 @@ def tune_bucket_ladders(shapes: Sequence[Tuple[int, int]],
     return decision
 
 
+def _update_block_ladders() -> Dict[str, tuple]:
+    """Named candidate append-block ladders for the streaming engine's
+    rank-k dispatch rungs: "default" IS the lowrank layer's static
+    choice (referenced, not restated — the tuner's default and the
+    engine's must not drift); "fine" halves the zero-row padding FLOPs
+    at ~2x the distinct-executable count; "coarse" the reverse."""
+    from pint_tpu.streaming.lowrank import DEFAULT_BLOCK_BUCKETS
+
+    return {
+        "default": tuple(DEFAULT_BLOCK_BUCKETS),
+        "fine": (2, 4, 8, 16, 32, 64, 128, 256),
+        "coarse": (16, 256),
+    }
+
+
+def tune_update_blocks(block_sizes: Sequence[int], n_free: int,
+                       ladders: Optional[Dict[str, tuple]] = None,
+                       tuning_manifest: Optional[TuningManifest] = None
+                       ) -> TuningDecision:
+    """Pick the streaming append-block-size ladder for a representative
+    arrival-size population at frame width ``n_free``: per candidate
+    ladder every block size is bucketed and the rank-k ingest kernel's
+    CostProfile at that rung predicts the per-append cost (zero-row
+    padding is exact but not free — its FLOPs are priced here); the
+    ladder minimizing the population's total predicted seconds wins,
+    distinct-rung count (compiles to pre-warm) as the tie-break.  The
+    :func:`tune_bucket_ladders` discipline applied to the streaming
+    door."""
+    from pint_tpu.autotune import update_blocks_vkey
+    from pint_tpu.serving.batcher import bucket_of
+    from pint_tpu.streaming.cache import ingest_kernel
+    from pint_tpu.telemetry import costs as _costs
+
+    sizes = [int(b) for b in block_sizes]
+    K = int(n_free)
+    if not sizes or min(sizes) < 1 or K < 1:
+        raise UsageError("update-block tuning needs positive block "
+                         "sizes and a positive frame width")
+    named = _update_block_ladders()
+    ladders = dict(named if ladders is None else ladders)
+    cands: List[Candidate] = []
+    for name, ladder in ladders.items():
+        cand = Candidate(value=name)
+        cand.extra["blocks"] = [int(b) for b in ladder]
+        try:
+            rungs: Dict[int, int] = {}
+            for b in sizes:
+                r = bucket_of(b, ladder)
+                rungs[r] = rungs.get(r, 0) + 1
+            total = 0.0
+            for rung, count in sorted(rungs.items()):
+                operands = (np.eye(K), np.zeros(K), np.float64(0.0),
+                            np.zeros((rung, K)), np.zeros(rung),
+                            np.zeros(rung), np.zeros(K))
+                prof = _costs.analyze_jitted(
+                    ingest_kernel(1.0), *operands,
+                    name=f"stream.ingest[+{rung}x{K}]")
+                sec = predicted_seconds(prof)
+                if sec is None:
+                    raise UsageError(
+                        f"rung {rung} cost analysis degraded"
+                        + (f": {prof.error}" if prof.error else ""))
+                total += sec * count
+            cand.predicted_s = total
+            cand.extra["n_rungs"] = len(rungs)
+        except Exception as e:
+            cand.excluded = f"{type(e).__name__}: {e}"
+        cands.append(cand)
+    viable = [c for c in cands if c.excluded is None]
+    if viable:
+        viable.sort(key=lambda c: (c.predicted_s, c.extra["n_rungs"]))
+        winner = viable[0]
+        value = {"ladder": winner.value, "blocks": winner.extra["blocks"]}
+        basis = "cost"
+        reason = (f"least total predicted ingest seconds over "
+                  f"{len(sizes)} representative block size(s); "
+                  f"{winner.extra['n_rungs']} distinct rung(s)")
+    else:
+        value = {"ladder": "default",
+                 "blocks": list(named["default"])}
+        basis = "static"
+        reason = ("every ladder candidate excluded "
+                  f"({'; '.join(c.excluded for c in cands[:2])}); "
+                  "default ladder retained")
+    decision = TuningDecision(
+        name="update.blocks", value=value["blocks"],
+        static_default=list(named["default"]),
+        vkey=update_blocks_vkey(), basis=basis,
+        candidates=[c.to_dict() for c in cands], reason=reason)
+    if tuning_manifest is not None:
+        tuning_manifest.record(decision)
+    return decision
+
+
 def tune_catalog_ladders(shapes: Sequence[Tuple[int, int]],
                          tuning_manifest: Optional[TuningManifest] = None
                          ) -> TuningDecision:

@@ -623,6 +623,47 @@ class GLSFitter(Fitter):
                     for a, s in zip(args, specs)]
         return _gls_normal_equations_for(pspec), tuple(args)
 
+    # -- streaming updates (pint_tpu.streaming) -------------------------
+
+    def streaming(self, **kw):
+        """The fitter's lazily constructed
+        :class:`~pint_tpu.streaming.update.StreamingGLS` engine (built
+        on first use from the CURRENT converged state; construction
+        options — block ladder, warm-step count, warm pool — are
+        accepted only then)."""
+        if getattr(self, "_stream", None) is None:
+            from pint_tpu.streaming.update import StreamingGLS
+
+            self._stream = StreamingGLS(self, **kw)
+        elif kw:
+            raise UsageError(
+                "this fitter's streaming engine already exists; "
+                "construction options must be passed on the first "
+                "streaming()/update_toas() call")
+        return self._stream
+
+    def update_toas(self, new_toas, steps=None, **engine_kw):
+        """Ingest newly arrived TOAs incrementally: validate/quarantine
+        gate, rank-k Cholesky update of the normal-equation factor for
+        the certified rows, warm-started Gauss-Newton from the previous
+        solution (``O(k K^2)`` instead of a full refit).  ``steps`` is
+        a per-call override; any other keyword is a CONSTRUCTION
+        option forwarded to :meth:`streaming` (honored only when this
+        call builds the engine).  Returns the
+        :class:`~pint_tpu.streaming.update.UpdateOutcome`."""
+        eng = self.streaming(**engine_kw)
+        return eng.update_toas(new_toas, steps=steps)
+
+    def quarantine_rows(self, block_id: int, rows):
+        """Quarantine previously certified rows of one stream block:
+        rank-k DOWNDATE of exactly those rows + warm refit."""
+        return self.streaming().quarantine_rows(block_id, rows)
+
+    def release_quarantined(self, block_id: int, rows):
+        """Release repaired rows back into the fit: rank-k UPDATE —
+        never a full rebuild (regression-pinned) — + warm refit."""
+        return self.streaming().release_quarantined(block_id, rows)
+
     def fit_toas(self, maxiter: int = 1, threshold: float = 0.0,
                  full_cov: bool = False, debug: bool = False,
                  robust=None, plan=None) -> float:

@@ -20,6 +20,8 @@ from pint_tpu.integrity.quarantine import (  # noqa: F401
     ABSURD_ERROR_US,
     QuarantineFinding,
     QuarantineReport,
+    RowDelta,
+    row_delta,
     run_toa_checks,
 )
 from pint_tpu.integrity.robust import HUBER_K, huber_weights  # noqa: F401
@@ -30,7 +32,8 @@ from pint_tpu.integrity.doctor import (  # noqa: F401
 
 __all__ = [
     "Diagnostic", "Diagnostics",
-    "QuarantineFinding", "QuarantineReport", "run_toa_checks",
+    "QuarantineFinding", "QuarantineReport", "RowDelta", "row_delta",
+    "run_toa_checks",
     "ABSURD_ERROR_US", "HUBER_K", "huber_weights",
     "model_toa_findings", "render_doctor_report",
 ]

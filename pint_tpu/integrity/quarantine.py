@@ -27,11 +27,12 @@ Checks
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["QuarantineFinding", "QuarantineReport", "run_toa_checks"]
+__all__ = ["QuarantineFinding", "QuarantineReport", "RowDelta",
+           "row_delta", "run_toa_checks"]
 
 #: anything beyond this TOA uncertainty is a corrupt column, not a
 #: measurement (1e9 us = ~17 min)
@@ -48,12 +49,64 @@ class QuarantineFinding:
         return f"  row {self.index}: {self.message} ({self.code})"
 
 
+@dataclass(frozen=True)
+class RowDelta:
+    """The typed changed-row delta of one re-validation pass: what a
+    consumer holding derived per-row state (the streaming cache's
+    factor, a serving-side index) must do — downdate the newly
+    ``quarantined`` rows, update the newly ``released`` ones, ingest
+    the ``added`` ones — instead of invalidating and rebuilding from
+    scratch.  Indices are into the validated TOAs container."""
+
+    #: rows validated for the first time AND certified by this pass —
+    #: directly ingestable (a new row this same pass quarantined is
+    #: deliberately in NEITHER list: it was never certified, so there
+    #: is nothing to ingest and nothing to downdate)
+    added: Tuple[int, ...]
+    quarantined: Tuple[int, ...]  #: rows newly quarantined by this pass
+    released: Tuple[int, ...]     #: rows newly released by this pass
+
+    @property
+    def empty(self) -> bool:
+        return not (self.added or self.quarantined or self.released)
+
+
+def row_delta(prev_mask: Optional[np.ndarray],
+              new_mask: np.ndarray) -> RowDelta:
+    """Delta between two quarantine masks.  ``prev_mask`` ``None``
+    means the container was never validated: every row the pass
+    certifies is ``added``.  A container that GREW since the previous
+    pass (merged-in rows) reports the certified part of the new tail
+    as ``added`` and diffs the overlap.  ``added`` never includes rows
+    the same pass quarantined — the documented consumer recipe is
+    "ingest the added ones", and handing it rows that just failed
+    validation would put bad rows in the fit (review regression)."""
+    new_mask = np.asarray(new_mask, dtype=bool)
+    n = len(new_mask)
+    if prev_mask is None:
+        return RowDelta(
+            added=tuple(int(i) for i in np.nonzero(~new_mask)[0]),
+            quarantined=(), released=())
+    prev_mask = np.asarray(prev_mask, dtype=bool)
+    o = min(len(prev_mask), n)
+    return RowDelta(
+        added=tuple(int(i) for i in range(o, n) if not new_mask[i]),
+        quarantined=tuple(
+            int(i) for i in np.nonzero(~prev_mask[:o] & new_mask[:o])[0]),
+        released=tuple(
+            int(i) for i in np.nonzero(prev_mask[:o] & ~new_mask[:o])[0]))
+
+
 @dataclass
 class QuarantineReport:
     """Outcome of one ``TOAs.validate()`` pass."""
 
     n_toas: int
     findings: List[QuarantineFinding] = field(default_factory=list)
+    #: typed changed-row delta vs the container's previous mask
+    #: (stamped by :meth:`~pint_tpu.toa.TOAs.validate`; None when the
+    #: checks were run standalone)
+    delta: Optional[RowDelta] = None
 
     @property
     def mask(self) -> np.ndarray:

@@ -188,6 +188,13 @@ class TimingService:
         self._post_served = 0
         self._post_pending: List[tuple] = []
         self._post_flush_task = None
+        # update door (streaming engine): nothing exists until
+        # register_stream() attaches a StreamingGLS engine
+        self._stream = None
+        self._upd_latencies_ms: List[float] = []
+        self._upd_served = 0
+        self._upd_pending: List[tuple] = []
+        self._upd_flush_task = None
 
     # -- warm-up ------------------------------------------------------------
 
@@ -200,16 +207,29 @@ class TimingService:
 
     # -- accounting ---------------------------------------------------------
 
+    @staticmethod
+    def _ring_push(ring: List[float], latency_ms: float) -> None:
+        """Bounded latency-ring append — ONE copy of the trim logic
+        for all three doors (fit, posterior, update)."""
+        ring.append(latency_ms)
+        if len(ring) > _LATENCY_RING:
+            del ring[:len(ring) - _LATENCY_RING]
+
+    @staticmethod
+    def _ring_summary(ring: List[float]) -> dict:
+        """``{n, p50_ms, p99_ms}`` over one door's latency ring."""
+        vals = sorted(ring)
+        return {"n": len(vals),
+                "p50_ms": _percentile(vals, 0.50),
+                "p99_ms": _percentile(vals, 0.99)}
+
     def _record(self, req: FitRequest, res: FitResult,
                 latency_ms: float) -> None:
         from pint_tpu.telemetry import metrics
 
         res.latency_ms = latency_ms
         self._served += 1
-        self._latencies_ms.append(latency_ms)
-        if len(self._latencies_ms) > _LATENCY_RING:
-            del self._latencies_ms[:len(self._latencies_ms)
-                                   - _LATENCY_RING]
+        self._ring_push(self._latencies_ms, latency_ms)
         if config._telemetry_mode != "off":
             metrics.counter("pint_tpu_serve_requests_total",
                             "fit requests served").inc()
@@ -229,10 +249,7 @@ class TimingService:
 
     def latency_summary(self) -> dict:
         """``{n, p50_ms, p99_ms}`` over the (bounded) latency ring."""
-        vals = sorted(self._latencies_ms)
-        return {"n": len(vals),
-                "p50_ms": _percentile(vals, 0.50),
-                "p99_ms": _percentile(vals, 0.99)}
+        return self._ring_summary(self._latencies_ms)
 
     @property
     def served(self) -> int:
@@ -568,10 +585,7 @@ class TimingService:
 
         res.latency_ms = latency_ms
         self._post_served += 1
-        self._post_latencies_ms.append(latency_ms)
-        if len(self._post_latencies_ms) > _LATENCY_RING:
-            del self._post_latencies_ms[:len(self._post_latencies_ms)
-                                        - _LATENCY_RING]
+        self._ring_push(self._post_latencies_ms, latency_ms)
         if config._telemetry_mode != "off":
             metrics.counter("pint_tpu_posterior_requests_total",
                             "posterior requests served").inc()
@@ -592,11 +606,144 @@ class TimingService:
     def posterior_latency_summary(self) -> dict:
         """``{n, p50_ms, p99_ms}`` over the posterior door's own
         (bounded) latency ring."""
-        vals = sorted(self._post_latencies_ms)
-        return {"n": len(vals),
-                "p50_ms": _percentile(vals, 0.50),
-                "p99_ms": _percentile(vals, 0.99)}
+        return self._ring_summary(self._post_latencies_ms)
 
     @property
     def posterior_served(self) -> int:
         return self._post_served
+
+    # -- update door (streaming engine) --------------------------------------
+
+    def register_stream(self, fitter_or_engine, warm: bool = True,
+                        block_sizes=None) -> None:
+        """Attach a streaming engine (a
+        :class:`~pint_tpu.streaming.update.StreamingGLS`, or a
+        :class:`~pint_tpu.gls_fitter.GLSFitter` whose engine is built
+        here) to the service's update door; until this is called the
+        door raises the typed UsageError.  ``warm`` registers the
+        rank-k ingest / warm-step / uncertainty kernels in the
+        service's warm pool, bucketed by the append-block-size ladder
+        (:func:`~pint_tpu.streaming.door.warm_stream`), so steady-state
+        updates serve at ``compiles=0``."""
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.streaming.door import warm_stream
+        from pint_tpu.streaming.update import StreamingGLS
+
+        if isinstance(fitter_or_engine, StreamingGLS):
+            engine = fitter_or_engine
+        elif isinstance(fitter_or_engine, GLSFitter):
+            # a fitter whose lazy engine already exists is reused
+            # (streaming(pool=...) would refuse construction options
+            # after the fact — and the option came from US, not the
+            # caller); the warm/else branches attach this pool below
+            engine = getattr(fitter_or_engine, "_stream", None)
+            if engine is None:
+                engine = fitter_or_engine.streaming(pool=self.pool)
+        else:
+            raise UsageError(
+                f"register_stream takes a StreamingGLS engine or a "
+                f"GLSFitter, got {type(fitter_or_engine).__name__}")
+        self._stream = engine
+        if warm:
+            warm_stream(engine, self.pool, block_sizes=block_sizes)
+        else:
+            engine.cache.pool = self.pool
+
+    @property
+    def stream(self):
+        return self._stream
+
+    def _require_stream(self):
+        if self._stream is None:
+            raise UsageError(
+                "no streaming engine registered on this service; "
+                "fit a GLSFitter and call register_stream() first")
+        return self._stream
+
+    def _run_updates(self, requests):
+        from pint_tpu.streaming.door import run_update_requests
+
+        return run_update_requests(self._require_stream(), requests)
+
+    def serve_updates(self, requests) -> list:
+        """The synchronous update batch door: one coalescing pass
+        (appends landing together merge into ONE rank-k dispatch),
+        latency recorded per request as the whole pass's wall (the
+        fit door's honest-under-coalescing discipline)."""
+        self._require_stream()
+        t0 = time.perf_counter()
+        out = self._run_updates(requests)
+        wall_ms = 1e3 * (time.perf_counter() - t0)
+        for req, res in zip(requests, out):
+            self._record_update(req, res, wall_ms)
+        return out
+
+    async def submit_update(self, request):
+        """The update door's asyncio entry: update requests landing
+        within the coalescing window share one rank-k dispatch (its
+        OWN door — update traffic never delays fit or posterior
+        requests and vice versa)."""
+        from pint_tpu.streaming.door import UpdateRequest
+
+        self._require_stream()
+        if not isinstance(request, UpdateRequest):
+            raise UsageError(
+                f"the update door takes UpdateRequest, got "
+                f"{type(request).__name__}")
+        return await self._submit_door(
+            request, self._upd_pending, "_upd_flush_task",
+            self._flush_updates_after, what="update",
+            gauge=self._gauge_update_queue_depth)
+
+    def _gauge_update_queue_depth(self) -> None:
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.gauge("pint_tpu_update_queue_depth",
+                          "update requests waiting in the coalescing "
+                          "window").set(len(self._upd_pending))
+
+    async def _flush_updates_after(self) -> None:
+        pending, self._upd_pending = self._upd_pending, []
+        self._upd_flush_task = None
+        self._gauge_update_queue_depth()
+        await self._flush_door(pending, self._run_updates,
+                               self._record_update, what="update")
+
+    def _record_update(self, req, res, latency_ms: float) -> None:
+        from pint_tpu.telemetry import metrics
+
+        res.latency_ms = latency_ms
+        self._upd_served += 1
+        self._ring_push(self._upd_latencies_ms, latency_ms)
+        if config._telemetry_mode != "off":
+            metrics.counter("pint_tpu_update_requests_total",
+                            "streaming update requests served").inc()
+            metrics.histogram("pint_tpu_update_latency_ms",
+                              "update request latency (ms)"
+                              ).observe(latency_ms)
+            if res.compiles:
+                metrics.counter(
+                    "pint_tpu_update_compiles_total",
+                    "fresh XLA compiles paid by update "
+                    "dispatches").inc(res.compiles)
+            if res.fallback is not None and res.first_in_batch:
+                # one engine fallback, one count — a coalesced batch
+                # shares the outcome but must not multiply it (the
+                # compiles discipline)
+                metrics.counter(
+                    "pint_tpu_update_fallbacks_total",
+                    "guarded rank-k updates that fell back to a "
+                    "full refactor").inc()
+        # the engine emits the stream_update/factor_fallback events
+        # itself (one per OPERATION, not per coalesced member) — the
+        # door's accounting is the request-level metrics above
+
+    def update_latency_summary(self) -> dict:
+        """``{n, p50_ms, p99_ms}`` over the update door's own
+        (bounded) latency ring."""
+        return self._ring_summary(self._upd_latencies_ms)
+
+    @property
+    def updates_served(self) -> int:
+        return self._upd_served

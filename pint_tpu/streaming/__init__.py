@@ -1,0 +1,58 @@
+"""Streaming timing: incremental updates as a first-class workload.
+
+Observatories emit TOAs continuously; refitting from scratch on every
+new observing epoch costs a full Woodbury build + Cholesky solve for a
+system that is 99% unchanged.  This package makes an appended block of
+``k`` TOAs cost ``O(k * K^2)`` rank-k factor work instead:
+
+* :mod:`~pint_tpu.streaming.lowrank` — jitted rank-k Cholesky
+  up/downdates of the GLS normal-equation factor (append = update,
+  quarantine = downdate), with a measured condition guard that falls
+  back to a full refactor (typed ``factor_fallback`` event, never a
+  silently wrong factor);
+* :mod:`~pint_tpu.streaming.cache` — the epoch-rolling stream state:
+  per-block design rows, the living factor, and the ``O(K^2)``
+  rhs/chi2 maintenance that keeps warm steps off the rows entirely;
+* :mod:`~pint_tpu.streaming.update` — :class:`StreamingGLS`
+  (``GLSFitter.update_toas`` / ``release_quarantined`` delegate here):
+  validate/quarantine ingestion gate, warm-started Gauss-Newton, and
+  :func:`stream_updates` checkpointed streams resumable bitwise via
+  :class:`~pint_tpu.runtime.checkpoint.SweepCheckpoint`;
+* :mod:`~pint_tpu.streaming.door` — the ``update`` request class the
+  :class:`~pint_tpu.serving.service.TimingService` door serves, with
+  warm-pool/AOT registration of the stream kernels bucketed by the
+  append-block-size ladder (``compiles=0`` steady state).
+"""
+
+from pint_tpu.streaming.cache import StreamBlock, StreamCache
+from pint_tpu.streaming.door import (
+    UpdateRequest,
+    UpdateResult,
+    run_update_requests,
+    stream_vkey,
+    warm_stream,
+)
+from pint_tpu.streaming.lowrank import (
+    CONDITION_LIMIT,
+    DEFAULT_BLOCK_BUCKETS,
+    FactorUpdate,
+    apply_rank_update,
+    chol_downdate,
+    chol_update,
+    factor_condition,
+)
+from pint_tpu.streaming.update import (
+    DEFAULT_WARM_STEPS,
+    StreamingGLS,
+    UpdateOutcome,
+    stream_updates,
+)
+
+__all__ = [
+    "CONDITION_LIMIT", "DEFAULT_BLOCK_BUCKETS", "DEFAULT_WARM_STEPS",
+    "FactorUpdate", "StreamBlock", "StreamCache", "StreamingGLS",
+    "UpdateOutcome", "UpdateRequest", "UpdateResult",
+    "apply_rank_update", "chol_downdate", "chol_update",
+    "factor_condition", "run_update_requests", "stream_updates",
+    "stream_vkey", "warm_stream",
+]

@@ -1,0 +1,675 @@
+"""Epoch-rolling stream state: the fitter's linearized system as a
+living factor.
+
+A :class:`StreamCache` freezes one *linearization frame* — the
+normalized Woodbury-form augmented system ``(params, norm, phiinv)`` of
+the certified TOA set at stream start — and then maintains, under
+appends and quarantine downdates, the four quantities a warm
+Gauss-Newton step needs:
+
+* ``L`` — Cholesky factor of ``A = M^T W M + diag(phiinv)``,
+  rewritten per block by the :mod:`~pint_tpu.streaming.lowrank`
+  rank-k kernels instead of refactored;
+* ``b`` — the normal-equation right-hand side ``M^T W r`` at the
+  CURRENT model state, maintained in ``O(K^2)`` per step via
+  ``b' = b - (A - diag(phiinv)) dx`` (residuals move by ``-M dx``
+  under a linear step, so the rhs never touches the rows again);
+* ``chi2`` — the augmented-system chi2 ``sum(w r^2)``, maintained the
+  same way (``chi2' = chi2 - 2 dx.b + dx.(A - D)dx``);
+* ``x`` — the cumulative frame solution offset (normalized columns),
+  whose physical image is the fitter's parameter state.
+
+Per-TOA state stays block-resident: each appended block keeps its
+normalized design rows, ingest-state residuals, and weights (the
+material a later quarantine downdate needs), keyed by the established
+vkey scheme (model param/mask signature + frame width).  An append
+touches only the new block's rows — built through the ONE
+:func:`pint_tpu.gls_fitter.linearized_system` entry (mean subtraction
+off: per-block means are NOT absorbed by the Offset column, a full-set
+mean is) — plus ``O(k K^2)`` factor work.
+
+**Frame guard.**  The frame is only valid while per-block rows are
+consistent with it: a span-derived red-noise basis (no ``TN*TSPAN``),
+an ECORR epoch column appearing, or a model-parameter move large
+enough to bend the linearization all invalidate it.  Every append
+re-derives a retained *sentinel row* alongside the block and compares
+it to the frame's stored copy; any drift — or a column-count change,
+or the rank-k condition guard refusing the updated factor — triggers a
+full refactor (counted on ``rebuilds``; the typed ``factor_fallback``
+event is emitted by the engine layer), never a silently wrong factor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+from pint_tpu.logging import log
+from pint_tpu.streaming.lowrank import (
+    CONDITION_LIMIT,
+    DEFAULT_BLOCK_BUCKETS,
+    factor_condition,
+    ingest_kernel,
+    refusal_reason,
+)
+
+__all__ = ["StreamBlock", "StreamCache", "FRAME_DRIFT_RTOL"]
+
+#: relative drift of the sentinel design row past which the frozen
+#: linearization frame is declared stale (a nonlinear column bending
+#: under accumulated parameter motion) and the cache refactors
+FRAME_DRIFT_RTOL = 1e-6
+
+
+def _block_rows(model, toas):
+    """``(M_raw, r, w, params, norm_block)`` for one TOA block through
+    the shared :func:`~pint_tpu.gls_fitter.linearized_system` entry,
+    with the block's own normalization UNDONE (the frame applies its
+    frozen one) and mean subtraction off (frame consistency: a
+    per-block mean is not in the Offset column's span)."""
+    from pint_tpu.gls_fitter import linearized_system
+    from pint_tpu.residuals import Residuals
+
+    resids = Residuals(toas, model, subtract_mean=False)
+    M, r, w, phiinv, params, norm = linearized_system(model, toas,
+                                                      resids=resids)
+    return np.asarray(M) * np.asarray(norm), r, w, params, norm
+
+
+@dataclass
+class StreamBlock:
+    """One ingested block's device-independent row state."""
+
+    block_id: int
+    M: np.ndarray            #: (k, K) FRAME-normalized design rows
+    r: np.ndarray            #: (k,) residuals at ingest model state [s]
+    w: np.ndarray            #: (k,) white-noise weights 1/Nvec
+    x_ingest: np.ndarray     #: (K,) frame solution offset at ingest
+    alive: np.ndarray        #: (k,) False = downdated (quarantined)
+    #: True where the VALIDATOR downdated the row (apply_validation):
+    #: only those rows auto-release when a later pass finds them clean
+    #: — a manual quarantine_rows() is a deliberate exclusion the
+    #: generic integrity checks know nothing about and must not undo
+    validator_downdated: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        if self.validator_downdated is None:
+            self.validator_downdated = np.zeros(len(self.r), dtype=bool)
+
+    @property
+    def n_alive(self) -> int:
+        return int(np.sum(self.alive))
+
+
+# ---------------------------------------------------------------------------
+# jitted stream kernels (module-level registries: one compile per shape,
+# shared process-wide; the door's warm pool holds AOT handles of these)
+# ---------------------------------------------------------------------------
+
+_step_kernels: Dict[tuple, object] = {}
+_err_kernels: Dict[tuple, object] = {}
+
+
+def step_kernel(steps: int):
+    """The jitted fused warm-step kernel: ``(L, b, chi2, phiinv, x) ->
+    (b', chi2', x', dx_norms (steps,))`` — ``steps`` Gauss-Newton
+    steps against the factor-resident state, one dispatch.  Everything
+    is ``O(K^2)``: the solve goes through the held factor, the rhs and
+    chi2 advance via ``(A - D) dx = L(L^T dx) - phiinv*dx`` instead of
+    ever touching the rows."""
+    steps = int(steps)
+    if steps < 1:
+        raise UsageError(f"step_kernel needs steps >= 1, got {steps}")
+    fn = _step_kernels.get((steps,))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        import jax.scipy.linalg as jsl
+
+        def kern(L, b, chi2, phiinv, x):
+            def body(carry, _):
+                b, chi2, x = carry
+                # the prior is centered at the FRAME REFERENCE (zero
+                # noise amplitude — the from-scratch solve's center),
+                # not at the previous iterate: solve A dx = b - D x.
+                # At the optimum b == D x and the step vanishes.
+                dx = jsl.cho_solve((L, True), b - phiinv * x)
+                bd = L @ (L.T @ dx) - phiinv * dx
+                chi22 = chi2 - 2.0 * jnp.dot(dx, b) + jnp.dot(dx, bd)
+                return (b - bd, chi22, x + dx), jnp.linalg.norm(dx)
+
+            (b2, chi22, x2), dxn = jax.lax.scan(body, (b, chi2, x),
+                                                None, length=steps)
+            return b2, chi22, x2, dxn
+
+        fn = jax.jit(kern)
+        _step_kernels[(steps,)] = fn
+    return fn
+
+
+def err_kernel():
+    """The jitted uncertainty kernel: ``(L, norm) -> sqrt(diag(A^-1)) /
+    norm`` — the frame's physical 1-sigma errors."""
+    fn = _err_kernels.get(())
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        import jax.scipy.linalg as jsl
+
+        def kern(L, norm):
+            Ainv = jsl.cho_solve((L, True),
+                                 jnp.eye(L.shape[0], dtype=L.dtype))
+            return jnp.sqrt(jnp.clip(jnp.diag(Ainv), 0.0)) / norm
+
+        fn = jax.jit(kern)
+        _err_kernels[()] = fn
+    return fn
+
+
+def bucket_rows(k: int, ladder: Sequence[int]) -> int:
+    """The block-size rung ``k`` rows dispatch at (the serving
+    :func:`~pint_tpu.serving.batcher.bucket_of` rounding — doubling
+    past the top, never an error)."""
+    from pint_tpu.serving.batcher import bucket_of
+
+    return bucket_of(k, ladder)
+
+
+class StreamCache:
+    """The living factor state of one streamed GLS fit (module
+    docstring).  ``pool`` (a :class:`~pint_tpu.serving.warmup.
+    WarmPool`) supplies AOT handles for the stream kernels; without
+    one the module-level jit registries serve (one compile per shape
+    per process)."""
+
+    def __init__(self, model, toas,
+                 block_buckets: Sequence[int] = DEFAULT_BLOCK_BUCKETS,
+                 cond_limit: float = CONDITION_LIMIT,
+                 pool=None):
+        self.model = model
+        self.block_buckets = tuple(sorted(int(b) for b in block_buckets))
+        if not self.block_buckets or self.block_buckets[0] < 1:
+            raise UsageError(
+                f"block ladder needs positive rungs, got {block_buckets}")
+        self.cond_limit = float(cond_limit)
+        self.pool = pool
+        #: full refactors paid (frame mismatch, condition guard, or an
+        #: explicit rebuild): THE counter the integrity regression test
+        #: pins — a quarantine release must not bump it
+        self.rebuilds = 0
+        #: guarded factor updates refused (each one also a rebuild)
+        self.fallbacks = 0
+        #: the condition proxy of the most recent REFUSED update (None
+        #: when the last operation's rank-k path succeeded, or when the
+        #: refusal was a frame-drift one that never reached the kernel)
+        #: — what the factor_fallback event reports, so a near-guard
+        #: stream's excursions are observable instead of being
+        #: overwritten by the healthy post-rebuild proxy
+        self.last_refused_condition: Optional[float] = None
+        self.updates = 0
+        self._next_block_id = 0
+        self._rebuild(toas)
+
+    # -- frame construction --------------------------------------------------
+
+    def _rebuild(self, toas) -> None:
+        """Full refactor: freeze a fresh linearization frame at the
+        model's CURRENT state over ``toas`` (the certified union)."""
+        from pint_tpu.grid import _model_param_sig
+        from pint_tpu.gls_fitter import build_augmented_system
+        from pint_tpu.residuals import Residuals
+        from pint_tpu.runtime.solve import hardened_cholesky
+
+        import copy as _copy
+
+        resids = Residuals(toas, self.model, subtract_mean=False)
+        M, params, norm, phiinv, Nvec, dims = build_augmented_system(
+            self.model, toas)
+        #: pristine frame-reference model: every later block evaluates
+        #: its rows/residuals HERE (not at the live, moving model) and
+        #: ingests with the FULL cumulative offset as dx_since — frame
+        #: consistency is then exact by construction instead of
+        #: resting on the evaluation being linear between states
+        self.ref_model = _copy.deepcopy(self.model)
+        M = np.asarray(M, dtype=np.float64)
+        r = np.asarray(resids.time_resids, dtype=np.float64)
+        w = 1.0 / np.asarray(Nvec, dtype=np.float64)
+        self.params = tuple(params)
+        norm = np.asarray(norm, dtype=np.float64)
+        phiinv = np.asarray(phiinv, dtype=np.float64)
+        self.noise_dims = dims
+        self.K = int(M.shape[1])
+        # Jacobi equilibration on top of the column normalization — the
+        # serve kernel's conditioning move: scale columns so the Gram
+        # has a unit diagonal.  Without it the F1-class columns carry
+        # ~1e-8-of-sigma fp sensitivity through the factor updates
+        # (measured); with it every coordinate is equilibrated and the
+        # stream matches a fresh solve at the 1e-12 level.
+        s = np.sqrt(np.einsum("ij,ij->j", M * w[:, None], M) + phiinv)
+        s = np.where(s > 0, s, 1.0)
+        M = M / s
+        self.norm = norm * s
+        self.phiinv = phiinv / s**2
+        #: frame reference: physical values the offsets are measured from
+        self.ref_values = {
+            p: float(getattr(self.model, p).value or 0.0)
+            for p in self.params if p != "Offset"}
+        self.vkey = (_model_param_sig(self.model), self.K)
+        A = (M.T * w) @ M + np.diag(self.phiinv)
+        L, _, _ = hardened_cholesky(A, name="stream frame Gram")
+        self.L = np.asarray(L, dtype=np.float64)
+        self.b = M.T @ (w * r)
+        self.chi2 = float(np.sum(w * r * r))
+        self.x = np.zeros(self.K)
+        self.blocks: List[StreamBlock] = [StreamBlock(
+            block_id=self._take_block_id(), M=M, r=r, w=w,
+            x_ingest=np.zeros(self.K),
+            alive=np.ones(len(r), dtype=bool))]
+        self._toas = toas
+        # sentinel: the frame row the drift guard re-derives per append,
+        # compared per column against the column's own rms magnitude
+        # (frame-normalized entries can sit at 1e-6 absolute, where a
+        # max(|row|, 1) scale would hide a 100% basis drift)
+        self._sentinel_toas = toas[np.array([0])]
+        self._sentinel_row = M[0].copy()
+        self._col_scale = np.maximum(
+            np.sqrt(np.mean(M * M, axis=0)), 1e-300)
+        self.last_condition = factor_condition(self.L)
+
+    def _take_block_id(self) -> int:
+        i = self._next_block_id
+        self._next_block_id += 1
+        return i
+
+    @property
+    def toas(self):
+        """The certified union this cache's factor describes."""
+        return self._toas
+
+    @property
+    def n_rows(self) -> int:
+        return sum(b.n_alive for b in self.blocks)
+
+    # -- per-block entry -----------------------------------------------------
+
+    def frame_rows(self, toas) -> Tuple[np.ndarray, np.ndarray,
+                                        np.ndarray, Optional[str]]:
+        """``(M, r, w, drift_reason)`` for a block of TOAs in the
+        FROZEN frame: rows built through ``linearized_system`` with the
+        sentinel riding along, re-normalized onto the frame's columns.
+        ``drift_reason`` is non-None when the rows are NOT
+        frame-consistent (column-count change, sentinel drift) — the
+        caller must refactor instead of updating."""
+        from pint_tpu.toa import merge_TOAs
+
+        union = merge_TOAs([self._sentinel_toas, toas])
+        M_raw, r, w, params, _ = _block_rows(self.ref_model, union)
+        if M_raw.shape[1] != self.K or tuple(params) != self.params:
+            return (M_raw, r, w,
+                    f"column layout changed ({M_raw.shape[1]} cols / "
+                    f"{len(params)} params vs frame {self.K} / "
+                    f"{len(self.params)})")
+        M = M_raw / self.norm
+        sent = M[0]
+        scale = np.maximum(np.abs(self._sentinel_row), self._col_scale)
+        drift = float(np.max(np.abs(sent - self._sentinel_row) / scale))
+        reason = None
+        if drift > FRAME_DRIFT_RTOL:
+            reason = (f"sentinel design row drifted {drift:.3e} "
+                      f"(> {FRAME_DRIFT_RTOL:g}) from the frozen frame")
+        return M[1:], r[1:], w[1:], reason
+
+    # -- kernel dispatch -----------------------------------------------------
+
+    def _dispatch(self, name: str, fn, operands: tuple):
+        """Warm-pool-first dispatch (the batcher discipline): a held
+        AOT handle when the door warmed one, the module jit otherwise."""
+        handle = None
+        if self.pool is not None:
+            handle = self.pool.lookup(name, operands)
+        return (handle or fn)(*operands)
+
+    def _ingest(self, M: np.ndarray, r: np.ndarray, w: np.ndarray,
+                downdate: bool, dx_since: np.ndarray) -> Tuple[bool, str]:
+        """One padded rank-k factor pass; returns ``(ok, reason)``.
+        State is NOT mutated when the guard refuses."""
+        k = len(r)
+        rung = bucket_rows(k, self.block_buckets)
+        pad = rung - k
+        if pad:
+            M = np.vstack([M, np.zeros((pad, self.K))])
+            r = np.concatenate([r, np.zeros(pad)])
+            w = np.concatenate([w, np.zeros(pad)])
+        sign = -1.0 if downdate else 1.0
+        name = f"stream.ingest[{'-' if downdate else '+'}{rung}x{self.K}]"
+        operands = (self.L, self.b, np.float64(self.chi2), M, r, w,
+                    dx_since)
+        L2, b2, chi22, ok, cond = self._dispatch(
+            name, ingest_kernel(sign), operands)
+        finite_ok = bool(ok)
+        cond = float(cond) if finite_ok else float("inf")
+        reason = refusal_reason(finite_ok, cond, self.cond_limit,
+                                downdate)
+        if reason is not None:
+            self.last_refused_condition = cond
+            return False, reason
+        self.L = np.asarray(L2)
+        self.b = np.asarray(b2)
+        self.chi2 = float(chi22)
+        self.last_condition = cond
+        self.updates += 1
+        return True, ""
+
+    # -- public stream operations -------------------------------------------
+
+    def append(self, toas) -> Tuple[StreamBlock, Optional[str]]:
+        """Ingest one certified TOA block: frame rows + rank-k factor
+        update; on frame drift or a guard refusal, full refactor of the
+        union instead.  Returns ``(block, fallback_reason)`` with
+        ``fallback_reason`` None on the incremental path."""
+        from pint_tpu.toa import merge_TOAs
+
+        if len(toas) < 1:
+            raise UsageError("append needs at least one TOA")
+        self.last_refused_condition = None
+        M, r, w, drift = self.frame_rows(toas)
+        union = merge_TOAs([self._toas, toas])
+        # rows/residuals are evaluated at the PRISTINE reference model,
+        # so the full cumulative offset advances them to the current
+        # frame state (x_ingest below records the full x); the measured
+        # alternative — evaluating at the live model and advancing by
+        # the unapplied part — leaks evaluation nonlinearity into the
+        # rhs at the 1e-3 sigma level on the DD stand-in
+        dx_since = self.x.copy()
+        if drift is None:
+            ok, reason = self._ingest(M, r, w, downdate=False,
+                                      dx_since=dx_since)
+        else:
+            ok, reason = False, drift
+        if not ok:
+            self.fallbacks += 1
+            self.rebuilds += 1
+            log.warning(f"stream cache: rank-k append refused ({reason});"
+                        " refactoring the full certified set")
+            # the rebuild must cover the certified SURVIVORS + the new
+            # block, never the raw tracked container: rows a downdate
+            # removed from the factor would otherwise silently re-enter
+            # the fit here (the container keeps them only so
+            # apply_validation's row indices stay stable) — a fallback
+            # compacts the stream to its alive rows
+            alive = np.concatenate([b.alive for b in self.blocks])
+            survivors = self._toas if bool(np.all(alive)) \
+                else self._toas[alive]
+            self._rebuild(merge_TOAs([survivors, toas]))
+            # the appended rows stay THEIR OWN block even on the
+            # rebuild path: the caller's UpdateOutcome.block_id + local
+            # row indices must keep addressing the rows it appended —
+            # returning the whole-union block would silently route a
+            # later quarantine_rows([0, 2]) at the BASE campaign's rows
+            self._split_tail_block(len(toas))
+            return self.blocks[-1], reason
+        block = StreamBlock(
+            block_id=self._take_block_id(), M=M, r=r - M @ dx_since, w=w,
+            x_ingest=self.x.copy(), alive=np.ones(len(r), dtype=bool))
+        self.blocks.append(block)
+        self._toas = union
+        return block, None
+
+    def _split_tail_block(self, k: int) -> None:
+        """Split the last ``k`` rows of the (single, post-rebuild)
+        block into their own :class:`StreamBlock` with a fresh id."""
+        whole = self.blocks[-1]
+        if k >= len(whole.r):
+            return
+        head = StreamBlock(
+            block_id=whole.block_id, M=whole.M[:-k], r=whole.r[:-k],
+            w=whole.w[:-k], x_ingest=whole.x_ingest,
+            alive=whole.alive[:-k],
+            validator_downdated=whole.validator_downdated[:-k])
+        tail = StreamBlock(
+            block_id=self._take_block_id(), M=whole.M[-k:],
+            r=whole.r[-k:], w=whole.w[-k:],
+            x_ingest=whole.x_ingest.copy(), alive=whole.alive[-k:],
+            validator_downdated=whole.validator_downdated[-k:])
+        self.blocks[-1:] = [head, tail]
+
+    def downdate_rows(self, block_id: int,
+                      rows: Sequence[int]) -> Optional[str]:
+        """Quarantine = downdate: remove ``rows`` of one block from the
+        factor (their residuals advanced to the current state
+        in-kernel).  Returns the fallback reason when the guard forced
+        a refactor, else None."""
+        block = self._block(block_id)
+        self.last_refused_condition = None
+        rows = np.asarray(sorted(set(int(i) for i in rows)))
+        if rows.size == 0:
+            return None
+        if rows.min() < 0 or rows.max() >= len(block.r):
+            raise UsageError(
+                f"rows {rows.tolist()} out of range for block "
+                f"{block_id} ({len(block.r)} rows)")
+        if not np.all(block.alive[rows]):
+            raise UsageError(
+                f"block {block_id}: some of rows {rows.tolist()} are "
+                "already downdated")
+        ok, reason = self._ingest(
+            block.M[rows], block.r[rows], block.w[rows], downdate=True,
+            dx_since=self.x - block.x_ingest)
+        block.alive[rows] = False
+        if ok:
+            return None
+        self.fallbacks += 1
+        self.rebuilds += 1
+        log.warning(f"stream cache: rank-k downdate refused ({reason}); "
+                    "refactoring the surviving rows")
+        self._refactor_from_blocks()
+        return reason
+
+    def release_rows(self, block_id: int,
+                     rows: Sequence[int]) -> Optional[str]:
+        """Release = update: re-admit previously downdated rows of one
+        block (their residuals advanced to the current state).  The
+        incremental twin of :meth:`downdate_rows` — a release never
+        pays a rebuild unless the condition guard refuses."""
+        block = self._block(block_id)
+        self.last_refused_condition = None
+        rows = np.asarray(sorted(set(int(i) for i in rows)))
+        if rows.size == 0:
+            return None
+        if rows.min() < 0 or rows.max() >= len(block.r):
+            raise UsageError(
+                f"rows {rows.tolist()} out of range for block "
+                f"{block_id} ({len(block.r)} rows)")
+        if np.any(block.alive[rows]):
+            raise UsageError(
+                f"block {block_id}: some of rows {rows.tolist()} are "
+                "not quarantined")
+        ok, reason = self._ingest(
+            block.M[rows], block.r[rows], block.w[rows], downdate=False,
+            dx_since=self.x - block.x_ingest)
+        block.alive[rows] = True
+        if ok:
+            return None
+        self.fallbacks += 1
+        self.rebuilds += 1
+        self._refactor_from_blocks()
+        return reason
+
+    def _block(self, block_id: int) -> StreamBlock:
+        for b in self.blocks:
+            if b.block_id == block_id:
+                return b
+        raise UsageError(f"no stream block with id {block_id}")
+
+    def sync_container_mask(self) -> None:
+        """Mirror the factor's alive state onto the tracked union's
+        quarantine mask, so any OTHER consumer of the container — a
+        fresh ``GLSFitter(cache.toas, ...)``, pickling, inspection —
+        certifies exactly the rows the factor holds.  Without this a
+        downdated row stayed in the container unmasked and a later
+        full fit silently re-included it."""
+        alive = np.concatenate([b.alive for b in self.blocks]) \
+            if self.blocks else np.zeros(0, dtype=bool)
+        dead = ~alive
+        if not dead.any():
+            self._toas.quarantine_mask = None
+            self._toas.quarantine_reasons = None
+        else:
+            self._toas.quarantine_mask = dead
+            self._toas.quarantine_reasons = [
+                ["downdated by the streaming engine"] if d else []
+                for d in dead]
+        self._toas._version += 1
+
+    def _refactor_from_blocks(self) -> None:
+        """Rebuild the factor from the retained block rows (alive rows
+        only, residuals advanced to the current state) WITHOUT
+        re-deriving the frame — the guard-refusal recovery path."""
+        from pint_tpu.runtime.solve import hardened_cholesky
+
+        A = np.diag(self.phiinv).astype(np.float64)
+        b = np.zeros(self.K)
+        chi2 = 0.0
+        for blk in self.blocks:
+            m = blk.alive
+            if not np.any(m):
+                continue
+            M, w = blk.M[m], blk.w[m]
+            r = blk.r[m] - M @ (self.x - blk.x_ingest)
+            A += (M.T * w) @ M
+            b += M.T @ (w * r)
+            chi2 += float(np.sum(w * r * r))
+        L, _, _ = hardened_cholesky(A, name="stream refactor Gram")
+        self.L = np.asarray(L, dtype=np.float64)
+        self.b = b
+        self.chi2 = chi2
+        self.last_condition = factor_condition(self.L)
+
+    def warm_steps(self, steps: int = 2) -> np.ndarray:
+        """``steps`` fused warm Gauss-Newton steps (one dispatch);
+        returns the per-step ``|dx|`` norms.  State (rhs, chi2,
+        cumulative offset) advances in place."""
+        name = f"stream.step[{self.K}x{int(steps)}]"
+        operands = (self.L, self.b, np.float64(self.chi2), self.phiinv,
+                    self.x)
+        b2, chi22, x2, dxn = self._dispatch(name, step_kernel(steps),
+                                            operands)
+        self.b = np.asarray(b2)
+        self.chi2 = float(chi22)
+        self.x = np.asarray(x2)
+        return np.asarray(dxn)
+
+    def errors(self) -> np.ndarray:
+        """Physical 1-sigma parameter errors at the current factor."""
+        name = f"stream.err[{self.K}]"
+        return np.asarray(self._dispatch(name, err_kernel(),
+                                         (self.L, self.norm)))
+
+    def solution(self) -> Dict[str, float]:
+        """Physical parameter values at the current stream state
+        (frame reference + cumulative offset; Offset excluded, the
+        fitter convention)."""
+        dx = self.x / self.norm
+        return {p: self.ref_values[p] + float(dx[i])
+                for i, p in enumerate(self.params) if p != "Offset"}
+
+    def noise_ampls(self) -> Dict[str, np.ndarray]:
+        """Maximum-likelihood GP amplitudes of the current state (the
+        :meth:`~pint_tpu.gls_fitter.GLSFitter._store_noise_ampls`
+        layout, sliced from the cumulative frame solution)."""
+        ntm = len(self.params)
+        dx = self.x / self.norm
+        return {comp: dx[ntm + off:ntm + off + size]
+                for comp, (off, size) in (self.noise_dims or {}).items()}
+
+    # -- checkpoint state ----------------------------------------------------
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """The resumable stream state as named f64 arrays (bitwise:
+        what :meth:`load_state` restores is exactly what was saved) —
+        the :class:`~pint_tpu.runtime.checkpoint.SweepCheckpoint`
+        chunk payload."""
+        out = {"L": self.L, "b": self.b,
+               "chi2": np.array([self.chi2]),
+               "x": self.x, "norm": self.norm, "phiinv": self.phiinv,
+               # frame identity: the sentinel row + reference values
+               # pin WHICH linearization frame the factor state is
+               # expressed in (a mid-stream fallback rebuild re-froze
+               # a new one; resuming that state onto a fresh engine's
+               # old frame would apply offsets against the wrong
+               # reference — load_state refuses instead)
+               "frame_sentinel": self._sentinel_row,
+               "frame_refs": np.array(
+                   [self.ref_values[p] for p in self.params
+                    if p != "Offset"]),
+               "counters": np.array([self.rebuilds, self.fallbacks,
+                                     self.updates, self._next_block_id],
+                                    dtype=np.int64),
+               "block_ids": np.array([b.block_id for b in self.blocks],
+                                     dtype=np.int64)}
+        for blk in self.blocks:
+            tag = f"block_{blk.block_id}"
+            out[f"{tag}_M"] = blk.M
+            out[f"{tag}_r"] = blk.r
+            out[f"{tag}_w"] = blk.w
+            out[f"{tag}_x"] = blk.x_ingest
+            out[f"{tag}_alive"] = blk.alive
+            out[f"{tag}_vdown"] = blk.validator_downdated
+        return out
+
+    def load_state(self, state: Dict[str, np.ndarray]) -> None:
+        """Restore a :meth:`state_dict` payload.  The saved FRAME
+        identity (width, sentinel design row, reference parameter
+        values) must match this cache's frame bitwise: a state saved
+        after a mid-stream fallback rebuild lives in a re-frozen frame
+        a fresh engine does not have, and restoring it would apply the
+        cumulative offset against the wrong reference — typed
+        :class:`~pint_tpu.exceptions.CheckpointError` instead (rebuild
+        the stream from source data)."""
+        from pint_tpu.exceptions import CheckpointError
+
+        L = np.asarray(state["L"], dtype=np.float64)
+        if L.shape != (self.K, self.K):
+            raise UsageError(
+                f"stream state factor is {L.shape}, frame is "
+                f"({self.K}, {self.K}) — not this stream's checkpoint")
+        sent = state.get("frame_sentinel")
+        refs = state.get("frame_refs")
+        own_refs = np.array([self.ref_values[p] for p in self.params
+                             if p != "Offset"])
+        if sent is None or refs is None \
+                or not np.array_equal(np.asarray(sent),
+                                      self._sentinel_row) \
+                or not np.array_equal(np.asarray(refs), own_refs):
+            raise CheckpointError(
+                "stream checkpoint was saved in a different "
+                "linearization frame (a mid-stream fallback rebuild "
+                "re-froze it, or this is another stream's state); "
+                "refusing to mix frames — replay the stream from "
+                "source data instead")
+        self.L = L
+        self.b = np.asarray(state["b"], dtype=np.float64)
+        self.chi2 = float(np.asarray(state["chi2"]).ravel()[0])
+        self.x = np.asarray(state["x"], dtype=np.float64)
+        self.norm = np.asarray(state["norm"], dtype=np.float64)
+        self.phiinv = np.asarray(state["phiinv"], dtype=np.float64)
+        counters = np.asarray(state["counters"], dtype=np.int64)
+        self.rebuilds, self.fallbacks = int(counters[0]), int(counters[1])
+        self.updates, self._next_block_id = (int(counters[2]),
+                                             int(counters[3]))
+        self.blocks = []
+        for bid in np.asarray(state["block_ids"], dtype=np.int64):
+            tag = f"block_{int(bid)}"
+            vdown = state.get(f"{tag}_vdown")
+            self.blocks.append(StreamBlock(
+                block_id=int(bid),
+                M=np.asarray(state[f"{tag}_M"], dtype=np.float64),
+                r=np.asarray(state[f"{tag}_r"], dtype=np.float64),
+                w=np.asarray(state[f"{tag}_w"], dtype=np.float64),
+                x_ingest=np.asarray(state[f"{tag}_x"], dtype=np.float64),
+                alive=np.asarray(state[f"{tag}_alive"], dtype=bool),
+                validator_downdated=np.asarray(vdown, dtype=bool)
+                if vdown is not None else None))

@@ -1,0 +1,227 @@
+"""The ``update`` request class: streaming appends as served traffic.
+
+:class:`UpdateRequest` / :class:`UpdateResult` are the wire shapes of
+the :class:`~pint_tpu.serving.service.TimingService` update door
+(``register_stream`` / ``serve_updates`` / ``submit_update``): one
+request is one append block (or a quarantine/release of tracked
+rows), served by the registered :class:`~pint_tpu.streaming.update.
+StreamingGLS` engine with its OWN coalescing window, bounded queue,
+p50/p99 latency ring, and ``pint_tpu_update_*`` metrics — update
+traffic never delays fit or posterior requests and vice versa.
+
+:func:`warm_stream` registers the engine's kernels in the service's
+:class:`~pint_tpu.serving.warmup.WarmPool` (AOT-cache persistence
+included when configured), bucketed by the append-block-size ladder:
+the rank-k ingest kernels at every rung, the fused warm-step kernel,
+and the uncertainty kernel — so a steady-state append serves at
+``compiles=0`` (measured by the bench's ``streaming{}`` block, pinned
+by the acceptance test).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+from pint_tpu.streaming.cache import bucket_rows, err_kernel, step_kernel
+from pint_tpu.streaming.lowrank import ingest_kernel
+from pint_tpu.streaming.update import StreamingGLS, UpdateOutcome
+
+__all__ = ["UpdateRequest", "UpdateResult", "warm_stream",
+           "stream_vkey"]
+
+_KINDS = ("append", "quarantine", "release")
+
+
+@dataclass
+class UpdateRequest:
+    """One streaming update: EITHER an appended TOA block
+    (``new_toas``) OR a quarantine/release of tracked rows
+    (``kind`` + ``block_id`` + ``rows``)."""
+
+    new_toas: Optional[object] = None     #: TOAs block to append
+    kind: str = "append"
+    block_id: Optional[int] = None        #: cache block (row ops)
+    rows: Optional[Sequence[int]] = None  #: local rows (row ops)
+    request_id: Optional[str] = None
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise UsageError(f"UpdateRequest kind {self.kind!r} not in "
+                             f"{_KINDS}")
+        if self.kind == "append":
+            if self.new_toas is None or len(self.new_toas) < 1:
+                raise UsageError(
+                    "append UpdateRequest needs a non-empty new_toas "
+                    "block")
+        else:
+            # len(), not truthiness: rows is naturally a numpy index
+            # array (np.nonzero output), whose bool() raises an
+            # UNTYPED ValueError instead of this contract's UsageError
+            if self.block_id is None or self.rows is None \
+                    or len(self.rows) == 0:
+                raise UsageError(
+                    f"{self.kind} UpdateRequest needs block_id and a "
+                    "non-empty rows list")
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.new_toas) if self.kind == "append" \
+            else len(self.rows)
+
+
+@dataclass
+class UpdateResult:
+    """Outcome of one served update request."""
+
+    kind: str
+    outcome: UpdateOutcome         #: the engine's full report
+    chi2: float
+    params: dict                   #: updated physical parameter values
+    quarantined: int = 0
+    fallback: Optional[str] = None
+    batch: int = 1                 #: coalesced batch size dispatched
+    #: True on the coalesced batch's first member only: per-OPERATION
+    #: accounting (compiles, the fallback counter) gates on this so
+    #: summing over requests counts each real event exactly once
+    first_in_batch: bool = True
+    #: dispatch compile delta on the FIRST member only (the FitResult
+    #: discipline: summing over requests counts each compile once)
+    compiles: int = 0
+    latency_ms: Optional[float] = None
+    request_id: Optional[str] = None
+
+
+def stream_vkey(engine: StreamingGLS) -> tuple:
+    """AOT-cache version key of one stream's kernels: the cache's
+    frame vkey (model param/mask signature + frame width) plus the
+    kernel schema version — the established invalidation discipline
+    (an edited selector or reshaped frame can never replay a stale
+    executable)."""
+    return ("stream_kernel", 1) + tuple(map(repr, engine.cache.vkey))
+
+
+def warm_stream(engine: StreamingGLS, pool,
+                block_sizes: Optional[Sequence[int]] = None,
+                steps: Optional[int] = None):
+    """Pre-warm the stream kernels through ``pool`` for the engine's
+    frame: one rank-k ingest executable (update + downdate) per
+    block-ladder rung covering ``block_sizes`` (default: every rung),
+    the fused warm-step kernel, and the uncertainty kernel.  Operand
+    VALUES are irrelevant (shapes key the executables); the warmed
+    names are exactly what :meth:`StreamCache._dispatch` looks up.
+    Returns the :class:`~pint_tpu.serving.warmup.WarmupReport`."""
+    from pint_tpu.serving.warmup import WarmupReport
+
+    cache = engine.cache
+    K = cache.K
+    vkey = stream_vkey(engine)
+    report = WarmupReport()
+    ladder = cache.block_buckets
+    rungs = sorted({bucket_rows(int(b), ladder)
+                    for b in (block_sizes or ladder)})
+    eye = np.eye(K)
+    b0 = np.zeros(K)
+    chi0 = np.float64(0.0)
+    for rung in rungs:
+        M = np.zeros((rung, K))
+        r = np.zeros(rung)
+        w = np.zeros(rung)
+        for sign, tag in ((1.0, "+"), (-1.0, "-")):
+            name = f"stream.ingest[{tag}{rung}x{K}]"
+            report.entries.append(pool.warm(
+                name, ingest_kernel(sign),
+                (eye, b0, chi0, M, r, w, b0), vkey=vkey))
+    nsteps = int(steps if steps is not None else engine.steps)
+    report.entries.append(pool.warm(
+        f"stream.step[{K}x{nsteps}]", step_kernel(nsteps),
+        (eye, b0, chi0, np.zeros(K), b0), vkey=vkey))
+    report.entries.append(pool.warm(
+        f"stream.err[{K}]", err_kernel(), (eye, np.ones(K)), vkey=vkey))
+    cache.pool = pool
+    return report
+
+
+def run_update_requests(engine: StreamingGLS,
+                        requests: Sequence[UpdateRequest]
+                        ) -> List[UpdateResult]:
+    """One coalescing pass over update requests (the service door's
+    run hook): append requests landing in the same pass merge into ONE
+    TOA block — one validate pass, one rank-k dispatch at the merged
+    rows' ladder rung, one warm refit — and row operations apply in
+    request order.  Results come back in request order; coalesced
+    members share the batch's outcome (chi2/params are post-batch
+    state, the honest number under coalescing) with the compile delta
+    attributed to the first member."""
+    from pint_tpu.toa import merge_TOAs
+
+    # validate the WHOLE batch before executing anything: an invalid
+    # member must fail the pass up front, not abort it halfway with
+    # earlier row operations already applied to the factor (the
+    # posterior door's validate-before-enqueue discipline).  Row ops
+    # are checked against a SIMULATED alive state in request order, so
+    # a stale block id, an out-of-range row, or two ops fighting over
+    # the same row within one batch all refuse before the first
+    # dispatch
+    planned: dict = {}
+    for q in requests:
+        if not isinstance(q, UpdateRequest):
+            raise UsageError(
+                f"the update door takes UpdateRequest, got "
+                f"{type(q).__name__}")
+        if q.kind == "append":
+            continue
+        blk = engine.cache._block(q.block_id)  # typed on unknown id
+        alive = planned.setdefault(q.block_id, blk.alive.copy())
+        rows = sorted(set(int(i) for i in q.rows))
+        if rows[0] < 0 or rows[-1] >= len(blk.r):
+            raise UsageError(
+                f"request {q.request_id!r}: rows {rows} out of range "
+                f"for block {q.block_id} ({len(blk.r)} rows)")
+        want_alive = q.kind == "quarantine"
+        for i in rows:
+            if alive[i] != want_alive:
+                raise UsageError(
+                    f"request {q.request_id!r}: block {q.block_id} "
+                    f"row {i} is {'already' if want_alive else 'not'} "
+                    f"{'downdated' if want_alive else 'quarantined'} "
+                    "once the batch's earlier operations apply")
+            alive[i] = not want_alive
+    out: List[Optional[UpdateResult]] = [None] * len(requests)
+    appends = [i for i, q in enumerate(requests) if q.kind == "append"]
+    # appends run FIRST: they are the operation that can still raise
+    # (merge/model evaluation over foreign TOA containers), and they
+    # raise BEFORE mutating the factor — so a failing batch aborts
+    # with no row operation half-applied.  The pre-validated row ops
+    # cannot fail on their own inputs; the one remaining corner is an
+    # append whose FALLBACK rebuild re-ids every block, which makes a
+    # same-batch row op's block_id stale — that raises the typed
+    # unknown-block error (a fallback always invalidates previously
+    # issued block ids; callers re-derive them from the outcome)
+    if appends:
+        block = requests[appends[0]].new_toas if len(appends) == 1 \
+            else merge_TOAs([requests[i].new_toas for i in appends])
+        o = engine.update_toas(block)
+        for j, i in enumerate(appends):
+            out[i] = UpdateResult(
+                kind="append", outcome=o, chi2=o.chi2, params=o.params,
+                quarantined=o.quarantined if j == 0 else 0,
+                fallback=o.fallback, batch=len(appends),
+                first_in_batch=j == 0,
+                compiles=o.compiles if j == 0 else 0,
+                latency_ms=o.latency_ms,
+                request_id=requests[i].request_id)
+    for i, q in enumerate(requests):
+        if q.kind == "append":
+            continue
+        o = (engine.quarantine_rows(q.block_id, q.rows)
+             if q.kind == "quarantine"
+             else engine.release_quarantined(q.block_id, q.rows))
+        out[i] = UpdateResult(
+            kind=q.kind, outcome=o, chi2=o.chi2, params=o.params,
+            fallback=o.fallback, compiles=o.compiles,
+            latency_ms=o.latency_ms, request_id=q.request_id)
+    return out  # type: ignore[return-value]

@@ -1,0 +1,220 @@
+"""Rank-k Cholesky up/downdates of the GLS normal-equation factor.
+
+A streaming append of ``k`` TOAs perturbs the Woodbury-form normal
+matrix by a rank-k term: ``A' = A ± V^T V`` with ``V`` the block's
+weighted design rows (``sqrt(w_i) * M_i``).  Refactoring ``A'`` from
+scratch costs the full ``O(n * K^2)`` Gram rebuild plus an ``O(K^3)``
+dense factorization; the classical rank-1 update chain here rewrites
+the existing factor in ``O(k * K^2)`` — the "don't recompute what
+didn't change" discipline the ISSUE's perf claim rests on.
+
+Algorithm (LINPACK ``dchud``/``dchdd`` family, lower-triangular): each
+row ``x`` of ``V`` sweeps the factor column by column with scaled
+(hyperbolic, for downdates) rotations.  The sweep is expressed as a
+``lax.scan`` over columns inside a scan over rows, so the whole rank-k
+pass compiles to ONE executable per ``(k, K)`` shape — and an all-zero
+row is an exact no-op (``r = L[j,j]``, rotation = identity), which is
+what makes zero-padding a block up to its ladder rung exact rather
+than approximate.
+
+Failure semantics: a downdate of rows that were never in the factor
+(or a near-singular update) drives a diagonal entry through zero; the
+``sqrt`` of the negative discriminant poisons the factor with NaN and
+the host guard (:func:`apply_rank_update`) reports it — together with
+a measured condition proxy against ``CONDITION_LIMIT`` — as
+``ok=False`` so the caller falls back to a full refactor (a typed
+``factor_fallback`` event upstream, never a silently wrong factor).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["DEFAULT_BLOCK_BUCKETS", "CONDITION_LIMIT", "FactorUpdate",
+           "rank_kernel", "ingest_kernel", "chol_update",
+           "chol_downdate", "apply_rank_update", "factor_condition",
+           "refusal_reason"]
+
+#: append-block-size ladder (rows per rank-k dispatch): small blocks are
+#: the steady-state observing cadence, the top rung one night's backlog;
+#: past the top the serving ladder's doubling rule applies
+DEFAULT_BLOCK_BUCKETS = (4, 16, 64, 256)
+
+#: measured condition proxy (Cholesky-diagonal ratio squared) above
+#: which an updated factor is not trusted: rank-1 rotation chains
+#: amplify rounding by ~cond(A), so past this bar the 1e-9 agreement
+#: contract with a fresh factorization is no longer defensible
+CONDITION_LIMIT = 1e13
+
+
+def _rank_pass(L, V, sign: float):
+    """The traced rank-k sweep: returns the updated factor.  ``sign``
+    is +1.0 (update) or -1.0 (downdate), trace-time static."""
+    import jax
+    import jax.numpy as jnp
+
+    K = L.shape[0]
+    idx = jnp.arange(K)
+
+    def one_row(Lc, x):
+        def one_col(carry, j):
+            Lc, x = carry
+            d = Lc[j, j]
+            xj = x[j]
+            r = jnp.sqrt(d * d + sign * xj * xj)
+            c = r / d
+            s = xj / d
+            col = Lc[:, j]
+            below = idx > j
+            newcol = jnp.where(below, (col + sign * s * x) / c, col)
+            newcol = jnp.where(idx == j, r, newcol)
+            x2 = jnp.where(below, c * x - s * newcol, x)
+            return (Lc.at[:, j].set(newcol), x2), None
+
+        (Lc, _), _ = jax.lax.scan(one_col, (Lc, x), idx)
+        return Lc, None
+
+    Lout, _ = jax.lax.scan(one_row, L, V)
+    return Lout
+
+
+#: one jitted rank-k kernel per sign; one compile per (k, K) shape under
+#: it via jit's dispatch cache — module-level so repeat streams (and the
+#: warm pool's AOT handles) retrace into the warm executable cache
+_rank_kernels: Dict[float, object] = {}
+
+
+def rank_kernel(sign: float):
+    """The jitted rank-k factor sweep for ``sign`` (+1 update, -1
+    downdate): ``(L (K,K), V (k,K)) -> L'``."""
+    if sign not in (1.0, -1.0):
+        raise UsageError(f"rank_kernel sign must be +1.0 or -1.0, "
+                         f"got {sign!r}")
+    fn = _rank_kernels.get(sign)
+    if fn is None:
+        import jax
+
+        def kern(L, V):
+            return _rank_pass(L, V, sign)
+
+        fn = jax.jit(kern)
+        _rank_kernels[sign] = fn
+    return fn
+
+
+#: the block-ingest kernels (factor sweep + rhs/chi2 fold in ONE
+#: dispatch): one jit per sign, one compile per (k, K) shape under it
+_ingest_kernels: Dict[float, object] = {}
+
+
+def ingest_kernel(sign: float):
+    """The jitted block-ingest kernel for ``sign`` (+1 append, -1
+    downdate): ``(L, b, chi2, M (k,K), r, w, dx_since (K,)) -> (L', b',
+    chi2', ok, cond)``.  Residuals are advanced to the current frame
+    state in-kernel (``r_now = r - M dx_since``), the factor sweep is
+    the rank-k pass above, and zero-weight pad rows are exact no-ops —
+    bucketing a block up the ladder costs nothing but FLOPs.  ``ok``
+    and the Cholesky-diagonal condition proxy come back as device
+    scalars so the host guard reads two numbers, not the factor."""
+    if sign not in (1.0, -1.0):
+        raise UsageError(f"ingest_kernel sign must be +1.0 or -1.0, "
+                         f"got {sign!r}")
+    fn = _ingest_kernels.get(sign)
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def kern(L, b, chi2, M, r, w, dx_since):
+            r_now = r - M @ dx_since
+            V = jnp.sqrt(w)[:, None] * M
+            L2 = _rank_pass(L, V, sign)
+            b2 = b + sign * (M.T @ (w * r_now))
+            chi22 = chi2 + sign * jnp.sum(w * r_now * r_now)
+            d = jnp.diag(L2)
+            ok = jnp.all(jnp.isfinite(L2)) & jnp.all(d > 0)
+            da = jnp.abs(d)
+            cond = (jnp.max(da) / jnp.maximum(jnp.min(da), 1e-300)) ** 2
+            return L2, b2, chi22, ok, cond
+
+        fn = jax.jit(kern)
+        _ingest_kernels[sign] = fn
+    return fn
+
+
+def chol_update(L: np.ndarray, V: np.ndarray) -> np.ndarray:
+    """Factor of ``L L^T + V^T V`` via the rank-k sweep (host entry;
+    dispatches the jitted kernel)."""
+    return np.asarray(rank_kernel(1.0)(np.asarray(L, dtype=np.float64),
+                                       np.atleast_2d(V)))
+
+
+def chol_downdate(L: np.ndarray, V: np.ndarray) -> np.ndarray:
+    """Factor of ``L L^T - V^T V`` — possibly NaN-poisoned when the
+    downdate leaves a non-PD system (the caller's guard decides)."""
+    return np.asarray(rank_kernel(-1.0)(np.asarray(L, dtype=np.float64),
+                                        np.atleast_2d(V)))
+
+
+def factor_condition(L: np.ndarray) -> float:
+    """Cholesky-diagonal condition proxy ``(dmax/dmin)^2`` — the same
+    estimate the hardened solve ladder reports."""
+    d = np.abs(np.diag(np.asarray(L)))
+    if d.size == 0 or not np.all(np.isfinite(d)):
+        return float("inf")
+    return float((d.max() / max(d.min(), 1e-300)) ** 2)
+
+
+def refusal_reason(finite_ok: bool, cond: float, cond_limit: float,
+                   downdate: bool) -> Optional[str]:
+    """The ONE guard-refusal classifier (None = the update stands):
+    shared by :func:`apply_rank_update` and the stream cache's live
+    ingest path, so the refusal semantics — and the reason strings the
+    ``factor_fallback`` telemetry carries — cannot drift between the
+    two."""
+    if not finite_ok:
+        return ("non-finite/non-PD updated factor "
+                + ("(downdate left a non-PD system)" if downdate
+                   else "(singular update)"))
+    if cond > cond_limit:
+        return (f"condition proxy {cond:.3e} past the "
+                f"{cond_limit:.0e} guard")
+    return None
+
+
+@dataclass(frozen=True)
+class FactorUpdate:
+    """Outcome of one guarded rank-k factor update."""
+
+    L: np.ndarray          #: the updated factor (valid only when ``ok``)
+    ok: bool               #: finite, positive-diagonal, under the bar
+    condition: float       #: measured condition proxy of the result
+    reason: str = ""       #: why the guard refused (empty when ``ok``)
+
+
+def apply_rank_update(L: np.ndarray, V: np.ndarray,
+                      downdate: bool = False,
+                      cond_limit: float = CONDITION_LIMIT) -> FactorUpdate:
+    """One guarded rank-k up/downdate: dispatch the jitted sweep, then
+    measure the result.  A non-finite or non-positive-diagonal factor
+    (the downdate-of-absent-rows signature) or a condition proxy past
+    ``cond_limit`` comes back ``ok=False`` with the reason — the caller
+    performs the full refactor and emits the typed ``factor_fallback``
+    event; this function never raises on a bad factor (NaN in, report
+    out)."""
+    V = np.atleast_2d(np.asarray(V, dtype=np.float64))
+    if V.shape[1] != np.asarray(L).shape[0]:
+        raise UsageError(
+            f"rank-k block has {V.shape[1]} columns for a "
+            f"{np.asarray(L).shape[0]}-column factor")
+    L2 = chol_downdate(L, V) if downdate else chol_update(L, V)
+    d = np.diag(L2)
+    finite_ok = bool(np.all(np.isfinite(L2)) and np.all(d > 0))
+    cond = factor_condition(L2) if finite_ok else float("inf")
+    reason = refusal_reason(finite_ok, cond, cond_limit, downdate)
+    return FactorUpdate(L=L2, ok=reason is None, condition=cond,
+                        reason=reason or "")

@@ -1,0 +1,453 @@
+"""Warm-started incremental refits: the streaming engine.
+
+:class:`StreamingGLS` wraps a converged :class:`~pint_tpu.gls_fitter.
+GLSFitter` and turns "new TOAs arrived" into ``O(k K^2)`` of work
+instead of a full refit:
+
+1. **ingestion door** — every appended block goes through the
+   integrity layer's validate/quarantine gate first
+   (:meth:`~pint_tpu.toa.TOAs.validate`, lenient): bad rows quarantine
+   into the stream's pen WITHOUT touching the factor (no refit, no
+   rebuild), certified rows proceed;
+2. **rank-k factor work** — the certified rows become one
+   :class:`~pint_tpu.streaming.cache.StreamCache` append (rank-k
+   Cholesky update, bucketed up the append-block-size ladder);
+3. **warm Gauss-Newton** — ``steps`` fused factor-resident steps from
+   the previous solution (steady-state appends converge in 1-2), the
+   updated parameters/uncertainties applied back to the fitter's
+   model.
+
+Quarantine flows both ways: :meth:`StreamingGLS.quarantine_rows`
+downdates previously certified rows out of the factor, and
+:meth:`StreamingGLS.release_quarantined` re-admits repaired rows as a
+rank-k UPDATE — never a rebuild (the regression-tested integrity
+contract); :meth:`StreamingGLS.apply_validation` consumes the typed
+changed-row delta a re-validation pass emits
+(:class:`~pint_tpu.integrity.quarantine.RowDelta`) so re-certification
+costs exactly the changed rows.
+
+:func:`stream_updates` runs a sequence of update batches with
+per-batch persistence through
+:class:`~pint_tpu.runtime.checkpoint.SweepCheckpoint`: a crash
+mid-stream resumes from the last completed batch with bitwise-
+identical state (the saved payload IS the factor state).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.logging import log
+from pint_tpu.streaming.cache import StreamCache
+from pint_tpu.streaming.lowrank import DEFAULT_BLOCK_BUCKETS
+
+__all__ = ["UpdateOutcome", "StreamingGLS", "stream_updates",
+           "DEFAULT_WARM_STEPS"]
+
+#: fused warm Gauss-Newton steps per update: 2 is convergence-grade on
+#: the (linear) steady-state regime the acceptance test pins — the
+#: second step is iterative refinement of the first
+DEFAULT_WARM_STEPS = 2
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Stream-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter (schema
+    validated by ``tools/telemetry_report --check``)."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+@dataclass
+class UpdateOutcome:
+    """What one stream operation did."""
+
+    kind: str                     #: append | downdate | release
+    block: int                    #: rows in the arriving/operated block
+    quarantined: int = 0          #: rows the ingestion gate penned
+    steps: int = 0                #: warm GN steps dispatched
+    chi2: float = float("nan")    #: augmented-system chi2 after
+    dx_final: float = float("nan")  #: |dx| of the last warm step
+    fallback: Optional[str] = None  #: refactor reason (None: rank-k)
+    compiles: int = 0             #: fresh XLA compiles this operation
+    latency_ms: Optional[float] = None
+    block_id: Optional[int] = None  #: cache block the rows landed in
+    params: Dict[str, float] = field(default_factory=dict)
+
+
+class StreamingGLS:
+    """The streaming engine for one GLS fit (module docstring)."""
+
+    def __init__(self, fitter,
+                 block_buckets: Optional[Sequence[int]] = None,
+                 steps: int = DEFAULT_WARM_STEPS,
+                 pool=None):
+        from pint_tpu.gls_fitter import GLSFitter
+
+        if not isinstance(fitter, GLSFitter):
+            raise UsageError(
+                f"StreamingGLS wraps a GLSFitter, got "
+                f"{type(fitter).__name__} (the rank-k paths rewrite the "
+                "Woodbury normal-equation factor, which only the "
+                "GLS family builds)")
+        if block_buckets is None:
+            # tuned append-block-size ladder (pint_tpu.autotune):
+            # verified manifest decision, silent static default
+            from pint_tpu import autotune as _autotune
+
+            tuned = _autotune.resolve_update_blocks()
+            block_buckets = tuned if tuned is not None \
+                else DEFAULT_BLOCK_BUCKETS
+        self.fitter = fitter
+        self.steps = int(steps)
+        if self.steps < 1:
+            raise UsageError(f"steps must be >= 1, got {steps}")
+        certified = fitter.toas.certified()
+        self.cache = StreamCache(fitter.model, certified,
+                                 block_buckets=block_buckets, pool=pool)
+        #: the quarantine pen: penned TOA blocks awaiting repair,
+        #: keyed by pen id -> (TOAs, reasons)
+        self.pen: Dict[int, tuple] = {}
+        self._next_pen_id = 0
+
+    # -- accounting ----------------------------------------------------------
+
+    @property
+    def rebuilds(self) -> int:
+        """Full refactors paid so far (the integrity regression pin)."""
+        return self.cache.rebuilds
+
+    def _finish(self, out: UpdateOutcome, before_counts, t0: float
+                ) -> UpdateOutcome:
+        from pint_tpu.telemetry import jaxevents
+
+        out.compiles = int(jaxevents.counts().compiles
+                           - before_counts.compiles)
+        out.latency_ms = 1e3 * (time.perf_counter() - t0)
+        _emit_event("stream_update", kind=out.kind, block=int(out.block),
+                    quarantined=int(out.quarantined),
+                    steps=int(out.steps), latency_ms=float(out.latency_ms),
+                    compiles=int(out.compiles),
+                    fallback=bool(out.fallback))
+        if out.fallback is not None:
+            # the REFUSED factor's condition when the guard measured
+            # one (the rebuild already overwrote last_condition with
+            # the healthy post-refactor proxy — reporting that would
+            # contradict the reason string and hide near-guard
+            # excursions from anyone trending this attr)
+            refused = self.cache.last_refused_condition
+            _emit_event("factor_fallback", reason=str(out.fallback),
+                        block=int(out.block),
+                        condition=float(
+                            refused if refused is not None
+                            else self.cache.last_condition))
+        return out
+
+    # -- the warm refit core -------------------------------------------------
+
+    def _warm_refit(self, out: UpdateOutcome,
+                    steps: Optional[int] = None) -> UpdateOutcome:
+        """``steps`` fused warm GN steps + parameter application."""
+        nsteps = self.steps if steps is None else int(steps)
+        dxn = self.cache.warm_steps(nsteps)
+        out.steps = nsteps
+        out.dx_final = float(dxn[-1])
+        out.chi2 = self.cache.chi2
+        sol = self.cache.solution()
+        errs = self.cache.errors()
+        model = self.fitter.model
+        for i, p in enumerate(self.cache.params):
+            if p == "Offset":
+                continue
+            par = getattr(model, p)
+            par.value = sol[p]
+            par.uncertainty = float(errs[i])
+            self.fitter.errors[p] = float(errs[i])
+        self.fitter.resids.noise_ampls = self.cache.noise_ampls()
+        out.params = sol
+        return out
+
+    # -- public operations ---------------------------------------------------
+
+    def update_toas(self, new_toas, steps: Optional[int] = None
+                    ) -> UpdateOutcome:
+        """Append one block of new TOAs: validate/quarantine gate,
+        rank-k factor update for the certified rows, warm-started
+        refit.  Bad rows land in the pen (no factor work, no refit
+        trigger); an empty certified block returns without touching
+        the factor."""
+        from pint_tpu.telemetry import jaxevents
+
+        t0 = time.perf_counter()
+        before = jaxevents.counts()
+        if len(new_toas) < 1:
+            raise UsageError("update_toas needs a non-empty TOA block")
+        report = new_toas.validate(policy="collect")
+        certified = new_toas.certified()
+        out = UpdateOutcome(kind="append", block=len(new_toas),
+                            quarantined=report.n_quarantined)
+        if report.n_quarantined:
+            penned = new_toas.quarantined()
+            self.pen[self._next_pen_id] = (
+                penned, [r for r, q in zip(report.reasons_by_row(),
+                                           report.mask) if q])
+            self._next_pen_id += 1
+        if len(certified) == 0:
+            out.chi2 = self.cache.chi2
+            return self._finish(out, before, t0)
+        block, fallback = self.cache.append(certified)
+        out.block_id = block.block_id
+        out.fallback = fallback
+        # steps is a PER-CALL override: mutating self.steps here would
+        # silently re-route every later update through an unwarmed
+        # step-kernel shape (the compiles=0 contract)
+        out = self._warm_refit(out, steps=steps)
+        self._sync_fitter_toas()
+        return self._finish(out, before, t0)
+
+    def _sync_fitter_toas(self) -> None:
+        """Keep the wrapped fitter's TOA views honest: ``toas_full``
+        is the tracked union (quarantine mask mirroring the factor's
+        alive state), ``toas`` its certified complement — so a later
+        FULL ``fit_toas()`` on this fitter fits exactly the rows the
+        stream holds, never a silently re-included downdated row."""
+        self.cache.sync_container_mask()
+        self.fitter.toas_full = self.cache.toas
+        self.fitter.toas = self.cache.toas.certified()
+
+    def quarantine_rows(self, block_id: int, rows: Sequence[int]
+                        ) -> UpdateOutcome:
+        """Quarantine previously certified rows: rank-k DOWNDATE of
+        exactly those rows, then a warm refit of the survivors."""
+        from pint_tpu.telemetry import jaxevents
+
+        t0 = time.perf_counter()
+        before = jaxevents.counts()
+        rows = list(rows)
+        if not rows:
+            # a typed refusal, not a block=0 no-op event the telemetry
+            # validator would (rightly) reject
+            raise UsageError("quarantine_rows needs at least one row")
+        out = UpdateOutcome(kind="downdate", block=len(rows),
+                            block_id=block_id)
+        out.fallback = self.cache.downdate_rows(block_id, rows)
+        out = self._warm_refit(out)
+        self._sync_fitter_toas()
+        return self._finish(out, before, t0)
+
+    def release_quarantined(self, block_id: int, rows: Sequence[int]
+                            ) -> UpdateOutcome:
+        """Release repaired rows back into the fit: rank-k UPDATE of
+        exactly those rows — never a rebuild (regression-pinned) —
+        then a warm refit."""
+        from pint_tpu.telemetry import jaxevents
+
+        t0 = time.perf_counter()
+        before = jaxevents.counts()
+        rows = list(rows)
+        if not rows:
+            raise UsageError(
+                "release_quarantined needs at least one row")
+        out = UpdateOutcome(kind="release", block=len(rows),
+                            block_id=block_id)
+        out.fallback = self.cache.release_rows(block_id, rows)
+        block = self.cache._block(block_id)
+        block.validator_downdated[list(map(int, rows))] = False
+        out = self._warm_refit(out)
+        self._sync_fitter_toas()
+        return self._finish(out, before, t0)
+
+    def apply_validation(self, toas=None) -> List[UpdateOutcome]:
+        """Consume a re-validation pass as a typed changed-row delta:
+        run :meth:`~pint_tpu.toa.TOAs.validate` (collect policy) over
+        the stream's tracked union and translate the row-state changes
+        into downdates (certified rows now failing) and updates
+        (penned rows now clean) — the cache never pays a full rebuild
+        for a row-state change.  The baseline is the ENGINE's own
+        alive-row view (every factor row is certified by
+        construction), so this is correct even when the merged union
+        container itself was never validated before."""
+        toas = toas if toas is not None else self.cache.toas
+        report = toas.validate(policy="collect")
+        mask = report.mask
+        alive = np.concatenate([b.alive for b in self.cache.blocks]) \
+            if self.cache.blocks else np.zeros(0, dtype=bool)
+        vdown = np.concatenate(
+            [b.validator_downdated for b in self.cache.blocks]) \
+            if self.cache.blocks else np.zeros(0, dtype=bool)
+        if len(mask) != len(alive):
+            raise UsageError(
+                f"validated container has {len(mask)} rows; the stream "
+                f"tracks {len(alive)} — apply_validation takes the "
+                "stream's own certified union")
+        outcomes: List[UpdateOutcome] = []
+        quarantined = np.nonzero(mask & alive)[0]
+        # auto-release ONLY rows this validator itself downdated: a
+        # manual quarantine_rows() is a deliberate exclusion for
+        # reasons the generic checks know nothing about — passing them
+        # must not silently undo it
+        released = np.nonzero(~mask & ~alive & vdown)[0]
+        for block_id, rows in self._rows_to_blocks(quarantined):
+            outcomes.append(self.quarantine_rows(block_id, rows))
+            self.cache._block(block_id).validator_downdated[rows] = True
+        for block_id, rows in self._rows_to_blocks(released):
+            outcomes.append(self.release_quarantined(block_id, rows))
+        # validate() rewrote the container mask from the checks alone;
+        # restore the factor's view (the engine's source of truth)
+        self._sync_fitter_toas()
+        return outcomes
+
+    def _rows_to_blocks(self, global_rows: Sequence[int]
+                        ) -> List[Tuple[int, List[int]]]:
+        """Map global certified-union row indices onto (block_id,
+        local rows) groups, in block order."""
+        out: Dict[int, List[int]] = {}
+        offsets = []
+        off = 0
+        for blk in self.cache.blocks:
+            offsets.append((off, off + len(blk.r), blk))
+            off += len(blk.r)
+        for g in sorted(set(int(i) for i in global_rows)):
+            for lo, hi, blk in offsets:
+                if lo <= g < hi:
+                    out.setdefault(blk.block_id, []).append(g - lo)
+                    break
+            else:
+                raise UsageError(
+                    f"row {g} is outside the stream's {off} tracked rows")
+        return sorted(out.items())
+
+
+# ---------------------------------------------------------------------------
+# checkpointed update streams
+# ---------------------------------------------------------------------------
+
+#: fault-injection seam: the per-batch apply call, interposable exactly
+#: like runtime.checkpoint._invoke
+def _invoke_stream(engine: StreamingGLS, batch, index: int):
+    return engine.update_toas(batch)
+
+
+#: per-block state keys that are IMMUTABLE after ingest (saved once, in
+#: the chunk where the block first appeared) vs per-chunk mutable ones
+_BLOCK_STATIC = ("M", "r", "w", "x")
+_BLOCK_MUTABLE = ("alive", "vdown")
+
+
+def _chunk_payload(engine: StreamingGLS, saved_ids: set) -> dict:
+    """One checkpoint chunk: the O(K^2) factor/meta state, every
+    block's (small) mutable row-state, and the FULL arrays of only the
+    blocks not yet persisted — a stream of B batches over n rows costs
+    O(n*K) checkpoint bytes TOTAL instead of O(B*n*K) (each chunk
+    re-saving every design matrix measured ~60x redundant)."""
+    full = engine.cache.state_dict()
+    out = {k: v for k, v in full.items()
+           if k == "block_ids" or not k.startswith("block_")}
+    for blk in engine.cache.blocks:
+        tag = f"block_{blk.block_id}"
+        for key in _BLOCK_MUTABLE:
+            out[f"{tag}_{key}"] = full[f"{tag}_{key}"]
+        if blk.block_id not in saved_ids:
+            for key in _BLOCK_STATIC:
+                out[f"{tag}_{key}"] = full[f"{tag}_{key}"]
+    out["model_values"] = np.array(
+        [engine.cache.solution()[p]
+         for p in engine.cache.params if p != "Offset"])
+    return out
+
+
+def stream_updates(engine: StreamingGLS, batches: Sequence,
+                   checkpoint: Optional[str] = None
+                   ) -> List[UpdateOutcome]:
+    """Apply a sequence of TOA batches to ``engine`` with per-batch
+    persistence and resume.
+
+    With ``checkpoint`` set, each completed batch saves the full
+    stream state (:meth:`StreamCache.state_dict`) as one
+    :class:`~pint_tpu.runtime.checkpoint.SweepCheckpoint` chunk; on
+    resume the LAST completed chunk's state is restored bitwise and
+    only the remaining batches run.  The fingerprint carries the
+    stream's vkey (model param/mask signature + frame width) and the
+    batch schedule, so a checkpoint from a different stream raises
+    :class:`~pint_tpu.exceptions.CheckpointError` instead of mixing
+    factors."""
+    from pint_tpu.runtime.checkpoint import SweepCheckpoint, fingerprint_of
+
+    outcomes: List[UpdateOutcome] = []
+    ckpt = None
+    start = 0
+    saved_ids: set = set()
+    if checkpoint is not None:
+        fp = fingerprint_of(
+            vkey=repr(engine.cache.vkey),
+            batches=[int(len(b)) for b in batches])
+        ckpt = SweepCheckpoint(checkpoint, fp, len(batches))
+        done = ckpt.completed()
+        # resume only from a contiguous completed prefix: the stream is
+        # stateful, chunk i depends on chunk i-1
+        while start < len(batches) and start in done:
+            start += 1
+        if start:
+            # chunks are INCREMENTAL: block arrays live in the chunk
+            # where the block first appeared, mutable row-state and
+            # the factor/meta in every chunk — accumulate ascending so
+            # the newest chunk's mutable state wins
+            state: dict = {}
+            for j in range(start):
+                state.update(ckpt.load(j))
+            saved_ids = {
+                int(k[len("block_"):-len("_M")])
+                for k in state if k.startswith("block_")
+                and k.endswith("_M")}
+            engine.cache.load_state(
+                {k: np.asarray(v) for k, v in state.items()
+                 if k != "model_values"})
+            # the model rides in the chunk too: parameter values are
+            # part of the warm-start state
+            vals = np.asarray(state["model_values"])
+            for p, v in zip([p for p in engine.cache.params
+                             if p != "Offset"], vals):
+                getattr(engine.fitter.model, p).value = float(v)
+            # re-derive the certified union through the same gate the
+            # original pass used, so a post-resume frame fallback
+            # refactors the REAL row set (the factor state alone does
+            # not carry the TOA containers) — and re-pen the rows the
+            # original pass quarantined, so the documented
+            # inspect/repair/release workflow survives the resume
+            from pint_tpu.toa import merge_TOAs
+
+            union = engine.cache.toas
+            for b in batches[:start]:
+                rep = b.validate(policy="collect")
+                cert = b.certified()
+                if len(cert):
+                    union = merge_TOAs([union, cert])
+                if rep.n_quarantined:
+                    engine.pen[engine._next_pen_id] = (
+                        b.quarantined(),
+                        [r for r, q in zip(rep.reasons_by_row(),
+                                           rep.mask) if q])
+                    engine._next_pen_id += 1
+            engine.cache._toas = union
+            # mirror the restored alive state onto the container mask
+            # and the fitter's views — a bare union assignment would
+            # hand a later full fit the downdated rows back
+            engine._sync_fitter_toas()
+            log.info(f"update stream {checkpoint}: resuming at batch "
+                     f"{start}/{len(batches)}")
+    for i in range(start, len(batches)):
+        outcomes.append(_invoke_stream(engine, batches[i], i))
+        if ckpt is not None:
+            payload = _chunk_payload(engine, saved_ids)
+            ckpt.save(i, **payload)
+            saved_ids.update(b.block_id for b in engine.cache.blocks)
+    return outcomes

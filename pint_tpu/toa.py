@@ -330,6 +330,7 @@ class TOAs:
         from pint_tpu.config import ingestion_policy
         from pint_tpu.integrity.quarantine import (
             ABSURD_ERROR_US,
+            row_delta,
             run_toa_checks,
         )
 
@@ -339,6 +340,24 @@ class TOAs:
             max_error_us=ABSURD_ERROR_US if max_error_us is None
             else max_error_us,
             ephem=ephem)
+        # typed changed-row delta vs the PREVIOUS APPLIED mask:
+        # consumers with derived per-row state (the streaming cache)
+        # downdate/update exactly the changed rows instead of
+        # invalidating — stamped before the strict raise so even a
+        # refused pass reports what changed.  A clean earlier pass
+        # stored mask=None, which is NOT "never validated":
+        # _applied_validation_n disambiguates — and ONLY passes whose
+        # mask was actually applied count (a strict-policy pass that
+        # raised never became anyone's baseline), so the first
+        # successful validation after a refusal still reports every
+        # row as added.
+        prev = self.quarantine_mask
+        applied_n = getattr(self, "_applied_validation_n", None)
+        if prev is None and applied_n is not None:
+            # rows beyond the previous pass's length (merged-in since)
+            # still report as added
+            prev = np.zeros(min(applied_n, len(self)), dtype=bool)
+        report.delta = row_delta(prev, report.mask)
         self.last_validation = report
         if report and policy == "strict":
             raise TOAIntegrityError(
@@ -349,6 +368,7 @@ class TOAs:
         # not stay silently excluded)
         self.quarantine_mask = report.mask if report else None
         self.quarantine_reasons = report.reasons_by_row() if report else None
+        self._applied_validation_n = len(self)
         self._version += 1
         if report and policy == "lenient":
             log.warning(report.render())

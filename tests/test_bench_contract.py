@@ -78,6 +78,10 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
     monkeypatch.setenv("BENCH_POSTERIOR_STEPS", "8")
     monkeypatch.setenv("BENCH_SCALING_PULSARS", "3")
+    monkeypatch.setenv("BENCH_STREAM_TOAS", "192")
+    monkeypatch.setenv("BENCH_STREAM_BLOCK", "8")
+    monkeypatch.setenv("BENCH_STREAM_APPENDS", "3")
+    monkeypatch.setenv("BENCH_STREAM_REFITS", "1")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     try:
@@ -192,6 +196,26 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert posterior["p50_ms"] > 0
     assert posterior["p99_ms"] >= posterior["p50_ms"]
     assert posterior["steady_state_compiles"] == 0
+    # the streaming block (PR 15): appended TOA blocks served through
+    # the update door as rank-k factor updates — every key present,
+    # never degraded on CPU, zero steady-state compiles, and the
+    # update path measurably faster than the warm full-refit path
+    # (the 10x acceptance bar applies to the production-scale
+    # workload; this contract-scale stand-in still must win)
+    streaming = headline["streaming"]
+    for key in ("appends", "update_p50_ms", "update_p99_ms",
+                "updates_per_s", "refit_p50_ms", "speedup_vs_refit",
+                "steady_state_compiles"):
+        assert key in streaming, f"streaming block missing {key!r}"
+    assert "error" not in streaming, \
+        f"streaming measurement degraded: {streaming}"
+    assert streaming["appends"] == 3
+    assert streaming["updates_per_s"] > 0
+    assert streaming["update_p50_ms"] > 0
+    assert streaming["update_p99_ms"] >= streaming["update_p50_ms"]
+    assert streaming["refit_p50_ms"] > 0
+    assert streaming["speedup_vs_refit"] > 1.0
+    assert streaming["steady_state_compiles"] == 0
     json.dumps(headline)
 
 
@@ -213,6 +237,10 @@ def test_warm_block_hits_cache_on_second_run(tiny_headline_files,
     monkeypatch.setenv("BENCH_CATALOG_PULSARS", "4")
     monkeypatch.setenv("BENCH_POSTERIOR_STEPS", "8")
     monkeypatch.setenv("BENCH_SCALING_PULSARS", "3")
+    monkeypatch.setenv("BENCH_STREAM_TOAS", "192")
+    monkeypatch.setenv("BENCH_STREAM_BLOCK", "8")
+    monkeypatch.setenv("BENCH_STREAM_APPENDS", "3")
+    monkeypatch.setenv("BENCH_STREAM_REFITS", "1")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     cache_dir = str(tmp_path / "aot")

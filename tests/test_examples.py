@@ -199,6 +199,18 @@ class TestExamples:
         assert "flow posterior consistent" in out
         assert "done" in out
 
+    def test_streaming_update_walkthrough(self, capsys):
+        """The streaming-engine walkthrough: rank-k appends through
+        the update door, a quarantine/downdate/release cycle, and the
+        from-scratch agreement pin, at CI size."""
+        out = _run("streaming_update.py", "--cpu", capsys=capsys)
+        assert "baseline fit" in out
+        assert "rank-k: True" in out
+        assert "1 row(s) quarantined at the door" in out
+        assert "rebuilds=0" in out
+        assert "steady-state compiles across the appends: 0" in out
+        assert "done" in out
+
     def test_fit_catalog_walkthrough(self, capsys):
         """The PTA catalog-engine walkthrough: ingest + batched fit +
         joint Hellings-Downs likelihood + sampler, at CI size."""

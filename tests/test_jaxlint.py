@@ -633,6 +633,85 @@ class TestHostCallInJit:
 
         assert "pint_tpu/amortized/" in DOWNCAST_SCOPE
 
+    def test_streaming_call_in_jit_flagged(self, tmp_path):
+        """The streaming package is host orchestration (factor-state
+        bookkeeping, TOA merging/validation, checkpoint I/O, warm-pool
+        registration) — an append/update call inside a traced function
+        would re-enter the whole ingestion pipeline per TRACE; the
+        streaming submodules are policed like the serving/catalog
+        ones."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.streaming import cache\n"
+            "from pint_tpu.streaming.lowrank import apply_rank_update\n"
+            "@jax.jit\n"
+            "def f(L, V):\n"
+            "    cache.StreamCache(None, None)\n"
+            "    apply_rank_update(L, V)\n"
+            "    return L\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+
+    def test_streaming_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — the engine appends and
+        warm-steps on the host; traced code touches only jnp math (the
+        rank-k/warm-step kernels are module-level jit objects the
+        cache dispatches, not the packages' function surface)."""
+        good = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from pint_tpu.streaming import update\n"
+            "@jax.jit\n"
+            "def kernel(L, b):\n"
+            "    return jax.scipy.linalg.cho_solve((L, True), b)\n"
+            "def host(ftr, blocks):\n"
+            "    eng = update.StreamingGLS(ftr)\n"
+            "    return [eng.update_toas(b) for b in blocks]\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+    def test_streaming_is_clean_target(self):
+        """pint_tpu/streaming/ itself lints clean under the host-call
+        rule (its traced kernels touch only jax/jnp; the one sanctioned
+        cross-module traced call — the lowrank kernel core — carries
+        its pragma)."""
+        eng = Engine(rules=[HostCallInJitRule()], repo=REPO)
+        for rel in ("pint_tpu/streaming/__init__.py",
+                    "pint_tpu/streaming/lowrank.py",
+                    "pint_tpu/streaming/cache.py",
+                    "pint_tpu/streaming/update.py",
+                    "pint_tpu/streaming/door.py"):
+            findings = eng.lint_file(os.path.join(REPO, rel))
+            assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_streaming_in_typed_raise_targets(self, tmp_path):
+        """pint_tpu/streaming/ is a typed-raise target: a planted bare
+        ValueError in a streaming module fires, its UsageError twin
+        does not."""
+        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
+
+        assert "pint_tpu/streaming/" in DEFAULT_TARGETS
+        d = tmp_path / "pint_tpu" / "streaming"
+        d.mkdir(parents=True)
+        bad = d / "bad.py"
+        bad.write_text("def f():\n    raise ValueError('bare')\n")
+        good = d / "good.py"
+        good.write_text(
+            "from pint_tpu.exceptions import UsageError\n"
+            "def f():\n    raise UsageError('typed')\n")
+        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
+        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
+        assert eng.lint_file(str(good)) == []
+
+    def test_streaming_in_downcast_scope(self):
+        """The unguarded-downcast rule covers the stream kernels: a
+        bare reduced cast in pint_tpu/streaming/ would silently drop
+        the factor state below the dd-split error budget."""
+        from tools.jaxlint.rules.downcast import DOWNCAST_SCOPE
+
+        assert "pint_tpu/streaming/" in DOWNCAST_SCOPE
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

@@ -705,6 +705,112 @@ class TestPosteriorSeries:
         assert "posterior: 9500.0 draws/s" in capsys.readouterr().out
 
 
+def _streaming(ups=180.0, p50=5.5, p99=6.5, speedup=45.0, error=None):
+    block = {"appends": 8, "update_p50_ms": p50, "update_p99_ms": p99,
+             "updates_per_s": ups, "refit_p50_ms": p50 * speedup,
+             "speedup_vs_refit": speedup, "steady_state_compiles": 0}
+    if error is not None:
+        block = {"appends": None, "update_p50_ms": None,
+                 "update_p99_ms": None, "updates_per_s": None,
+                 "refit_p50_ms": None, "speedup_vs_refit": None,
+                 "steady_state_compiles": None, "error": error}
+    return {"streaming": block}
+
+
+class TestStreamingSeries:
+    """The bench's streaming{} block (round 15+): update throughput
+    gates drops, the update door's p99 gates rises, the speedup over
+    the warm full-refit path gates drops, and an errored block after
+    measured rounds fails."""
+
+    def test_streaming_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 15, 100.0,
+                    extra=_streaming(ups=189.3, p50=5.2, p99=5.8,
+                                     speedup=47.8))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.streaming_updates_per_s == 189.3
+        assert r.streaming_update_p50_ms == 5.2
+        assert r.streaming_update_p99_ms == 5.8
+        assert r.streaming_speedup_vs_refit == 47.8
+        assert r.streaming_steady_compiles == 0
+        doc = build_history([r])
+        assert doc["runs"][0]["streaming_updates_per_s"] == 189.3
+
+    def test_updates_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([180.0, 195.0, 185.0], start=1):
+            _bench(d, i, 100.0, extra=_streaming(ups=v))
+        _bench(d, 4, 100.0, extra=_streaming(ups=70.0))  # ~62% drop
+        assert main(["--check", "--dir", d]) == 1
+        assert "streaming_updates_per_s" in capsys.readouterr().out
+
+    def test_p99_rise_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_streaming(p99=6.0))
+        _bench(d, 4, 100.0, extra=_streaming(p99=14.0))  # >2x the tail
+        assert main(["--check", "--dir", d]) == 1
+        assert "streaming_update_p99_ms" in capsys.readouterr().out
+
+    def test_speedup_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_streaming(speedup=45.0))
+        # the rank-k win eroding back toward refit cost is the
+        # structural regression this series exists to catch
+        _bench(d, 4, 100.0, extra=_streaming(speedup=8.0))
+        assert main(["--check", "--dir", d]) == 1
+        assert "streaming_speedup_vs_refit" in capsys.readouterr().out
+
+    def test_small_streaming_changes_pass(self, tmp_path):
+        d = str(tmp_path)
+        for i, (v, p) in enumerate([(180.0, 6.0), (195.0, 6.2),
+                                    (185.0, 5.9)], start=1):
+            _bench(d, i, 100.0, extra=_streaming(ups=v, p99=p))
+        _bench(d, 4, 100.0, extra=_streaming(ups=176.0, p99=6.4))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_streaming_block_fails_when_history_had_it(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_streaming())
+        _bench(d, 3, 100.0,
+               extra=_streaming(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 1
+        assert "streaming block degraded" in capsys.readouterr().out
+
+    def test_errored_streaming_block_clean_without_history(
+            self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0,
+               extra=_streaming(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_malformed_streaming_types_ignored(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 15, 100.0,
+                    extra={"streaming": {"updates_per_s": "fast",
+                                         "update_p99_ms": True,
+                                         "steady_state_compiles": "0"}})
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.streaming_updates_per_s is None
+        assert r.streaming_update_p99_ms is None
+        assert r.streaming_steady_compiles is None
+
+    def test_streaming_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0,
+               extra=_streaming(ups=189.3, p50=5.2, speedup=47.8))
+        assert main(["--dir", d]) == 0
+        assert "streaming: 189.3 updates/s" in capsys.readouterr().out
+
+
 def _precision(mixed=50.0, f64=50.0, rel=0.0, reduced=0, error=None):
     block = {"segments": {"serve.gram": "f64"}, "reduced_count": reduced,
              "f64_count": 6 - reduced, "mixed_fits_per_s": mixed,

@@ -1,0 +1,1039 @@
+"""Streaming timing engine tests (PR 15).
+
+Pins the load-bearing contracts of ``pint_tpu/streaming``:
+
+* **rank-k exactness** — the updated/downdated Cholesky factor matches
+  a fresh factorization of the full certified set (1e-9 bar; measured
+  ~1e-15 on well-conditioned systems), zero-padded rows are exact
+  no-ops, and the condition guard refuses rather than returning a
+  silently wrong factor;
+* **acceptance pin** — 5 appended epoch blocks + one
+  quarantine/release cycle on the B1855 stand-in: updated parameter
+  values/uncertainties match a from-scratch GLS fit of the final
+  certified set to 1e-9 (relative, the catalog-engine convention),
+  with ZERO steady-state compiles after warmup;
+* **integrity hookup** — ``TOAs.validate()`` emits a typed changed-row
+  delta, and a quarantine release is a rank-k UPDATE that never bumps
+  the full-rebuild counter;
+* **resume** — an injected crash mid-stream resumes bitwise via
+  ``SweepCheckpoint``.
+"""
+
+import copy
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.streaming
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from pint_tpu.exceptions import CheckpointError, UsageError  # noqa: E402
+from pint_tpu.streaming import (  # noqa: E402
+    StreamingGLS,
+    UpdateRequest,
+    apply_rank_update,
+    chol_downdate,
+    chol_update,
+    stream_updates,
+)
+from pint_tpu.streaming.update import _invoke_stream  # noqa: E402,F401
+
+#: the B1855 stand-in: spin + span-pinned red noise over two bands —
+#: every fit column exactly linear (TNREDTSPAN keeps the Fourier basis
+#: identical across appended blocks; DM deliberately frozen: its
+#: bilinear coupling with F0 through the delay chain is real
+#: Gauss-Newton curvature no frozen linearization can track, and the
+#: frame guard exists for exactly that regime)
+STREAM_PAR = """\
+PSR STREAMTEST
+RAJ 04:37:15.0
+DECJ -47:15:09.0
+F0 173.6879 1
+F1 -1.7e-15 1
+PEPOCH 55000
+DM 2.64
+EFAC mjd 50000 60000 1.1
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 5
+TNREDTSPAN 6.0
+UNITS TDB
+"""
+
+N_TOAS = 140
+N_BASE = 100
+BLOCK = 8
+N_BLOCKS = 5
+
+
+def _make_model():
+    from pint_tpu.models import get_model
+
+    return get_model([ln + "\n" for ln in STREAM_PAR.splitlines()])
+
+
+def _make_toas(model, n=N_TOAS, seed=7):
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    rng = np.random.default_rng(seed)
+    return make_fake_toas_uniform(
+        53400, 54800, n, model, freq=np.array([800.0, 1400.0]),
+        error_us=1.0, add_noise=True, rng=rng)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    """(model, full toas, base slice, append blocks) — read-only; tests
+    that mutate TOAs deep-copy what they touch."""
+    model = _make_model()
+    toas = _make_toas(model)
+    base = toas[np.arange(N_BASE)]
+    blocks = [toas[np.arange(N_BASE + BLOCK * i, N_BASE + BLOCK * (i + 1))]
+              for i in range(N_BLOCKS)]
+    return model, toas, base, blocks
+
+
+def _fit_base(workload, maxiter=3):
+    from pint_tpu.gls_fitter import GLSFitter
+
+    model, _, base, _ = workload
+    f = GLSFitter(base, copy.deepcopy(model))
+    f.fit_toas(maxiter=maxiter)
+    return f
+
+
+def _scratch_fit(model, toas, maxiter=4):
+    from pint_tpu.gls_fitter import GLSFitter
+
+    f = GLSFitter(toas, copy.deepcopy(model))
+    f.fit_toas(maxiter=maxiter)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# rank-k factor kernels
+# ---------------------------------------------------------------------------
+
+class TestLowRank:
+    def _system(self, K=12, n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        M = rng.normal(size=(n, K))
+        A = M.T @ M + np.eye(K)
+        return A, np.linalg.cholesky(A), rng
+
+    def test_update_matches_fresh_factorization(self):
+        A, L, rng = self._system()
+        V = rng.normal(size=(7, 12))
+        L2 = chol_update(L, V)
+        fresh = np.linalg.cholesky(A + V.T @ V)
+        assert np.max(np.abs(L2 - fresh)) <= 1e-9 * np.max(np.abs(fresh))
+
+    def test_downdate_inverts_update(self):
+        A, L, rng = self._system(seed=1)
+        V = rng.normal(size=(5, 12))
+        L3 = chol_downdate(chol_update(L, V), V)
+        assert np.max(np.abs(L3 - L)) <= 1e-9 * np.max(np.abs(L))
+
+    def test_zero_pad_rows_are_exact_noops(self):
+        """Bucketing a block up the ladder pads with zero rows; the
+        padded sweep must be BITWISE the unpadded one."""
+        A, L, rng = self._system(seed=2)
+        V = rng.normal(size=(3, 12))
+        Vp = np.vstack([V, np.zeros((13, 12))])
+        assert np.array_equal(chol_update(L, Vp), chol_update(L, V))
+
+    def test_downdate_of_absent_rows_refused(self):
+        """Removing rows that were never in the factor leaves a non-PD
+        system: the guard reports it instead of returning NaN."""
+        A, L, rng = self._system(seed=3)
+        out = apply_rank_update(L, 10.0 * rng.normal(size=(4, 12)),
+                                downdate=True)
+        assert not out.ok
+        assert "non-PD" in out.reason
+
+    def test_condition_guard_refuses(self):
+        A, L, rng = self._system(seed=4)
+        out = apply_rank_update(L, rng.normal(size=(2, 12)),
+                                cond_limit=1.0)
+        assert not out.ok
+        assert "condition proxy" in out.reason
+
+    def test_shape_and_sign_validation(self):
+        A, L, rng = self._system()
+        with pytest.raises(UsageError):
+            apply_rank_update(L, rng.normal(size=(2, 5)))
+        from pint_tpu.streaming.lowrank import ingest_kernel, rank_kernel
+
+        with pytest.raises(UsageError):
+            rank_kernel(2.0)
+        with pytest.raises(UsageError):
+            ingest_kernel(0.5)
+
+
+# ---------------------------------------------------------------------------
+# typed changed-row delta (integrity hookup)
+# ---------------------------------------------------------------------------
+
+class TestRowDelta:
+    def test_first_validation_adds_certified_rows_only(self):
+        """added is directly ingestable: a new row the same pass
+        quarantined appears in NEITHER list (review regression — it
+        was never certified, so there is nothing to ingest)."""
+        from pint_tpu.integrity import row_delta
+
+        d = row_delta(None, np.array([False, True, False]))
+        assert d.added == (0, 2)
+        assert d.quarantined == () and d.released == ()
+        assert not d.empty
+
+    def test_transitions_and_growth(self):
+        from pint_tpu.integrity import row_delta
+
+        prev = np.array([False, True, False])
+        new = np.array([True, False, False, False, True])
+        d = row_delta(prev, new)
+        assert d.quarantined == (0,)
+        assert d.released == (1,)
+        # the grown tail's QUARANTINED row (index 4) is not 'added'
+        assert d.added == (3,)
+
+    def test_empty_delta(self):
+        from pint_tpu.integrity import row_delta
+
+        m = np.array([False, True])
+        assert row_delta(m, m).empty
+
+    def test_strict_refused_pass_is_not_a_baseline(self, workload):
+        """A strict-policy pass that RAISED never applied its mask:
+        the first successful validation after the repair still reports
+        every row as added (review regression)."""
+        from pint_tpu.exceptions import TOAIntegrityError
+
+        _, _, base, _ = workload
+        toas = copy.deepcopy(base)
+        toas.error_us[2] = -1.0
+        with pytest.raises(TOAIntegrityError):
+            toas.validate(policy="strict")
+        toas.error_us[2] = 1.0  # repaired
+        rep = toas.validate(policy="collect")
+        assert rep.delta.added == tuple(range(len(toas)))
+        assert rep.delta.released == ()
+
+    def test_validate_stamps_delta(self, workload):
+        """A repair pass reports the released rows in the typed delta
+        instead of forcing consumers to diff masks themselves."""
+        model, _, base, _ = workload
+        toas = copy.deepcopy(base)
+        first = toas.validate(policy="collect")
+        assert first.delta is not None
+        assert first.delta.added == tuple(range(len(toas)))
+        bad = copy.deepcopy(toas)
+        bad.error_us[3] = -1.0
+        rep = bad.validate(policy="collect")
+        assert rep.delta.quarantined == (3,)
+        bad.error_us[3] = 1.0  # repaired
+        rep2 = bad.validate(policy="collect")
+        assert rep2.delta.released == (3,)
+        assert rep2.delta.quarantined == ()
+
+
+# ---------------------------------------------------------------------------
+# the streaming engine: acceptance pins
+# ---------------------------------------------------------------------------
+
+class TestStreamingEngine:
+    @pytest.fixture()
+    def streamed(self, workload):
+        """A base fit streamed through all five epoch blocks."""
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        outcomes = [eng.update_toas(copy.deepcopy(b)) for b in blocks]
+        return f, eng, outcomes
+
+    def test_acceptance_five_blocks_match_scratch(self, workload,
+                                                  streamed):
+        """THE pin: after five appended epoch blocks the streamed
+        parameters and uncertainties match a from-scratch GLS fit of
+        the final certified set to 1e-9 (relative — the PR-11 catalog
+        convention), every append on the rank-k path."""
+        model, toas, _, _ = workload
+        f, eng, outcomes = streamed
+        assert all(o.fallback is None for o in outcomes)
+        assert eng.rebuilds == 0
+        assert len(eng.cache.toas) == N_BASE + N_BLOCKS * BLOCK
+        scratch = _scratch_fit(model, toas)
+        for p in ("F0", "F1"):
+            v1 = getattr(f.model, p).value
+            v2 = getattr(scratch.model, p).value
+            e1 = getattr(f.model, p).uncertainty
+            e2 = getattr(scratch.model, p).uncertainty
+            assert abs(v1 - v2) <= 1e-9 * abs(v2), p
+            assert abs(e1 - e2) <= 1e-9 * e2, p
+
+    def test_factor_matches_fresh_factorization(self, streamed):
+        """The appended factor IS the fresh factorization of the full
+        certified set's frame Gram, to 1e-9 (ISSUE lowrank pin)."""
+        eng = streamed[1]
+        c = eng.cache
+        A = np.diag(c.phiinv).astype(np.float64)
+        for blk in c.blocks:
+            m = blk.alive
+            A += (blk.M[m].T * blk.w[m]) @ blk.M[m]
+        fresh = np.linalg.cholesky(A)
+        assert np.max(np.abs(c.L - fresh)) <= 1e-9 * np.max(np.abs(fresh))
+
+    def test_zero_steady_state_compiles(self, workload):
+        """After the first (warmup) append, further appends of the
+        same block shape pay ZERO fresh XLA compiles.  Telemetry MUST
+        be active for this pin: the jaxevents counter is dead in off
+        mode and the assertion would pass vacuously (review
+        regression — the vacuous form shipped once)."""
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import jaxevents
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        telemetry.activate("basic")
+        try:
+            eng.update_toas(copy.deepcopy(blocks[0]))  # warmup
+            before = jaxevents.counts()
+            for b in blocks[1:]:
+                o = eng.update_toas(copy.deepcopy(b))
+                assert o.fallback is None
+            delta = jaxevents.counts().compiles - before.compiles
+        finally:
+            telemetry.deactivate()
+        assert delta == 0
+
+    def test_quarantine_release_cycle(self, workload, streamed):
+        """Downdate two certified rows -> matches a from-scratch fit
+        WITHOUT them; release them -> matches the full fit again; and
+        the release never bumps the full-rebuild counter (the
+        integrity regression pin)."""
+        model, toas, _, _ = workload
+        f, eng, outcomes = streamed
+        bid = outcomes[-1].block_id
+        rebuilds_before = eng.rebuilds
+        out_q = eng.quarantine_rows(bid, [1, 4])
+        assert out_q.fallback is None
+        # from-scratch comparison set: final union minus those rows
+        keep = np.ones(N_TOAS, dtype=bool)
+        keep[N_BASE + (N_BLOCKS - 1) * BLOCK + 1] = False
+        keep[N_BASE + (N_BLOCKS - 1) * BLOCK + 4] = False
+        scratch_q = _scratch_fit(model, toas[keep])
+        for p in ("F0", "F1"):
+            v1, v2 = (getattr(f.model, p).value,
+                      getattr(scratch_q.model, p).value)
+            assert abs(v1 - v2) <= 1e-9 * abs(v2), p
+        out_r = eng.release_quarantined(bid, [1, 4])
+        assert out_r.fallback is None
+        assert eng.rebuilds == rebuilds_before, \
+            "a quarantine release must be a rank-k update, never a " \
+            "full rebuild"
+        scratch = _scratch_fit(model, toas)
+        for p in ("F0", "F1"):
+            v1, v2 = (getattr(f.model, p).value,
+                      getattr(scratch.model, p).value)
+            e2 = getattr(scratch.model, p).uncertainty
+            assert abs(v1 - v2) <= 1e-9 * abs(v2), p
+
+    def test_bad_rows_quarantine_without_refit(self, workload):
+        """The ingestion door: a block with poisoned rows pens them —
+        the factor sees only certified rows and nothing rebuilds."""
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        bad = copy.deepcopy(blocks[0])
+        bad.error_us[2] = -1.0  # non-positive uncertainty
+        out = eng.update_toas(bad)
+        assert out.quarantined == 1
+        assert out.block == BLOCK
+        assert out.fallback is None
+        assert eng.rebuilds == 0
+        assert len(eng.cache.toas) == N_BASE + BLOCK - 1
+        assert len(eng.pen) == 1
+
+    def test_all_bad_block_touches_nothing(self, workload):
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        L_before = eng.cache.L.copy()
+        bad = copy.deepcopy(blocks[0])
+        bad.error_us[:] = -1.0
+        out = eng.update_toas(bad)
+        assert out.quarantined == BLOCK
+        assert eng.rebuilds == 0
+        assert np.array_equal(eng.cache.L, L_before)
+
+    def test_apply_validation_consumes_delta(self, workload, streamed):
+        """A re-validation pass over the certified union routes its
+        typed delta into downdates — no full rebuild."""
+        f, eng, outcomes = streamed
+        rebuilds_before = eng.rebuilds
+        union = eng.cache.toas
+        union.error_us[5] = -2.0  # poison one certified row in place
+        outs = eng.apply_validation()
+        assert [o.kind for o in outs] == ["downdate"]
+        assert outs[0].fallback is None
+        assert eng.rebuilds == rebuilds_before
+
+    def test_frame_drift_falls_back_with_typed_event(self, workload):
+        """A span-derived red-noise basis (no TNREDTSPAN) makes every
+        append frame-inconsistent: the engine must refactor — counted,
+        reasoned — and still land on the from-scratch answer (the
+        fallback IS a fresh build), never a silently wrong factor."""
+        from pint_tpu.models import get_model
+
+        par = STREAM_PAR.replace("TNREDTSPAN 6.0\n", "")
+        model = get_model([ln + "\n" for ln in par.splitlines()])
+        toas = _make_toas(model)
+        base = toas[np.arange(N_BASE)]
+        block = toas[np.arange(N_BASE, N_BASE + BLOCK)]
+        from pint_tpu.gls_fitter import GLSFitter
+
+        f = GLSFitter(base, copy.deepcopy(model))
+        f.fit_toas(maxiter=3)
+        eng = StreamingGLS(f)
+        out = eng.update_toas(copy.deepcopy(block))
+        assert out.fallback is not None
+        assert eng.rebuilds == 1
+        scratch = _scratch_fit(model, toas[np.arange(N_BASE + BLOCK)])
+        for p in ("F0", "F1"):
+            v1, v2 = (getattr(f.model, p).value,
+                      getattr(scratch.model, p).value)
+            assert abs(v1 - v2) <= 1e-8 * abs(v2), p
+
+    def test_condition_guard_fallback_path(self, workload):
+        """An impossible condition bar forces the guard: the append
+        refactors (typed reason) and the answer is still right."""
+        model, toas, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        eng.cache.cond_limit = 1.0
+        out = eng.update_toas(copy.deepcopy(blocks[0]))
+        assert out.fallback is not None
+        assert "condition proxy" in out.fallback
+        assert eng.rebuilds == 1
+        scratch = _scratch_fit(
+            model, toas[np.arange(N_BASE + BLOCK)])
+        for p in ("F0", "F1"):
+            v1, v2 = (getattr(f.model, p).value,
+                      getattr(scratch.model, p).value)
+            assert abs(v1 - v2) <= 1e-8 * abs(v2), p
+
+    def test_fallback_rebuild_never_resurrects_downdated_rows(
+            self, workload):
+        """A fallback refactor covers the certified SURVIVORS + the
+        new block: rows a quarantine downdated must not silently
+        re-enter the fit through the rebuild (review regression)."""
+        model, toas, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        out0 = eng.update_toas(copy.deepcopy(blocks[0]))
+        eng.quarantine_rows(out0.block_id, [2])
+        n_before = eng.cache.n_rows
+        eng.cache.cond_limit = 1.0  # force the guard on the next append
+        out1 = eng.update_toas(copy.deepcopy(blocks[1]))
+        assert out1.fallback is not None
+        # the rebuilt factor holds survivors + the new block ONLY
+        assert eng.cache.n_rows == n_before + BLOCK
+        assert len(eng.cache.toas) == n_before + BLOCK
+        # and the parameters match a from-scratch fit WITHOUT that row
+        keep = np.ones(N_BASE + 2 * BLOCK, dtype=bool)
+        keep[N_BASE + 2] = False
+        scratch = _scratch_fit(
+            model, toas[np.arange(N_BASE + 2 * BLOCK)][keep])
+        for p in ("F0", "F1"):
+            v1, v2 = (getattr(f.model, p).value,
+                      getattr(scratch.model, p).value)
+            assert abs(v1 - v2) <= 1e-8 * abs(v2), p
+
+    def test_fallback_append_block_id_addresses_the_appended_rows(
+            self, workload):
+        """Even when an append falls back to a full rebuild, the
+        returned block_id + local row indices keep addressing the rows
+        the caller just appended — not the whole union (review
+        regression: quarantining rows=[0] must remove the appended
+        block's first row, never the base campaign's)."""
+        model, toas, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        eng.cache.cond_limit = 1.0  # force the fallback path
+        out = eng.update_toas(copy.deepcopy(blocks[0]))
+        assert out.fallback is not None
+        blk = eng.cache._block(out.block_id)
+        assert len(blk.r) == BLOCK  # the appended rows, not the union
+        eng.cache.cond_limit = 1.0
+        eng.quarantine_rows(out.block_id, [0])
+        # from-scratch comparison WITHOUT the appended block's row 0
+        keep = np.ones(N_BASE + BLOCK, dtype=bool)
+        keep[N_BASE] = False
+        scratch = _scratch_fit(model,
+                               toas[np.arange(N_BASE + BLOCK)][keep])
+        for p in ("F0", "F1"):
+            v1, v2 = (getattr(f.model, p).value,
+                      getattr(scratch.model, p).value)
+            assert abs(v1 - v2) <= 1e-8 * abs(v2), p
+
+    def test_downdates_masked_on_the_fitter_view(self, workload):
+        """After a stream downdate the fitter's TOA views stay honest:
+        toas_full carries the mask, toas is the certified complement —
+        a later FULL fit cannot silently re-include the row (review
+        regression)."""
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        out = eng.update_toas(copy.deepcopy(blocks[0]))
+        n = len(eng.cache.toas)
+        eng.quarantine_rows(out.block_id, [3])
+        assert eng.cache.toas.n_quarantined == 1
+        assert len(f.toas) == n - 1          # certified view
+        assert len(f.toas_full) == n         # tracked union
+        eng.release_quarantined(out.block_id, [3])
+        assert eng.cache.toas.n_quarantined == 0
+        assert len(f.toas) == n
+
+    def test_manual_quarantine_survives_apply_validation(self,
+                                                         workload):
+        """A deliberate quarantine_rows() exclusion is NOT undone by a
+        later apply_validation pass just because the row passes the
+        generic integrity checks (review regression)."""
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        out = eng.update_toas(copy.deepcopy(blocks[0]))
+        eng.quarantine_rows(out.block_id, [3])  # manual, row is clean
+        outs = eng.apply_validation()
+        assert outs == []  # nothing released, nothing quarantined
+        assert not eng.cache._block(out.block_id).alive[3]
+
+    def test_steps_override_is_per_call(self, workload):
+        """update_toas(steps=) must not re-route later updates through
+        an unwarmed step-kernel shape (review regression)."""
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        out = eng.update_toas(copy.deepcopy(blocks[0]), steps=3)
+        assert out.steps == 3
+        assert eng.steps == 2
+        out2 = eng.update_toas(copy.deepcopy(blocks[1]))
+        assert out2.steps == 2
+
+    def test_engine_requires_gls_fitter(self, workload):
+        from pint_tpu.fitter import WLSFitter
+        from pint_tpu.models import get_model
+
+        white = "".join(
+            ln + "\n" for ln in STREAM_PAR.splitlines()
+            if not ln.startswith(("TNRed", "TNREDTSPAN", "EFAC")))
+        model = get_model([ln + "\n" for ln in white.splitlines()])
+        toas = _make_toas(model, n=30)
+        w = WLSFitter(toas, model)
+        with pytest.raises(UsageError):
+            StreamingGLS(w)
+
+    def test_fitter_methods_delegate(self, workload):
+        """GLSFitter.update_toas / release_quarantined are the public
+        face; construction options bind on first use only — including
+        through update_toas itself (review regression: the first-call
+        kwargs the error message advertises must actually work)."""
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        out = f.update_toas(copy.deepcopy(blocks[0]),
+                            block_buckets=(BLOCK, 2 * BLOCK))
+        assert out.kind == "append"
+        assert f.streaming() is f._stream
+        assert f._stream.cache.block_buckets == (BLOCK, 2 * BLOCK)
+        with pytest.raises(UsageError):
+            f.streaming(steps=3)
+        with pytest.raises(UsageError):
+            f.update_toas(copy.deepcopy(blocks[1]), block_buckets=(4,))
+
+
+# ---------------------------------------------------------------------------
+# checkpointed update streams
+# ---------------------------------------------------------------------------
+
+class TestCheckpointedStream:
+    def _final_state(self, eng):
+        return (eng.cache.L.copy(), eng.cache.b.copy(),
+                eng.cache.x.copy(), float(eng.cache.chi2),
+                {p: getattr(eng.fitter.model, p).value
+                 for p in ("F0", "F1")})
+
+    def test_crash_resumes_bitwise(self, workload, tmp_path,
+                                   monkeypatch):
+        """Crash after two batches, resume on a fresh engine: the
+        stitched stream state is BITWISE the uninterrupted run's."""
+        from pint_tpu.runtime.faultinject import SimulatedCrash
+        from pint_tpu.streaming import update as up
+
+        _, _, _, blocks = workload
+        batches = [copy.deepcopy(b) for b in blocks]
+
+        # uninterrupted reference
+        eng_ref = StreamingGLS(_fit_base(workload))
+        stream_updates(eng_ref, [copy.deepcopy(b) for b in blocks])
+        ref = self._final_state(eng_ref)
+
+        ckpt = str(tmp_path / "stream")
+        orig = up._invoke_stream
+
+        def crashing(engine, batch, index):
+            if index == 2:
+                raise SimulatedCrash("power cut mid-stream")
+            return orig(engine, batch, index)
+
+        monkeypatch.setattr(up, "_invoke_stream", crashing)
+        eng1 = StreamingGLS(_fit_base(workload))
+        with pytest.raises(SimulatedCrash):
+            stream_updates(eng1, batches, checkpoint=ckpt)
+        monkeypatch.setattr(up, "_invoke_stream", orig)
+
+        eng2 = StreamingGLS(_fit_base(workload))
+        outs = stream_updates(eng2, batches, checkpoint=ckpt)
+        assert len(outs) == len(blocks) - 2  # only the remainder ran
+        resumed = self._final_state(eng2)
+        assert np.array_equal(resumed[0], ref[0])  # L bitwise
+        assert np.array_equal(resumed[1], ref[1])  # b bitwise
+        assert np.array_equal(resumed[2], ref[2])  # x bitwise
+        assert resumed[3] == ref[3]
+        assert resumed[4] == ref[4]
+
+    def test_resume_repopulates_the_quarantine_pen(self, workload,
+                                                   tmp_path):
+        """Rows the original pass penned survive a checkpoint resume
+        (the inspect/repair/release workflow; review regression)."""
+        _, _, _, blocks = workload
+        batches = [copy.deepcopy(b) for b in blocks[:3]]
+        batches[0].error_us[2] = -1.0  # one penned row in batch 0
+        ckpt = str(tmp_path / "stream")
+        eng1 = StreamingGLS(_fit_base(workload))
+        stream_updates(eng1, batches, checkpoint=ckpt)
+        assert len(eng1.pen) == 1
+        # resume from the completed checkpoint on a fresh engine
+        eng2 = StreamingGLS(_fit_base(workload))
+        outs = stream_updates(eng2, batches, checkpoint=ckpt)
+        assert outs == []  # everything was already complete
+        assert len(eng2.pen) == 1
+        penned, reasons = next(iter(eng2.pen.values()))
+        assert len(penned) == 1 and reasons
+
+    def test_state_from_a_refrozen_frame_refused(self, workload):
+        """A mid-stream fallback rebuild re-freezes the linearization
+        frame; restoring that state onto a fresh engine's old frame
+        would apply offsets against the wrong reference — typed
+        refusal instead (review regression)."""
+        _, _, _, blocks = workload
+        eng1 = StreamingGLS(_fit_base(workload))
+        eng1.cache.cond_limit = 1.0  # every append refactors
+        eng1.update_toas(copy.deepcopy(blocks[0]))
+        state = eng1.cache.state_dict()
+        eng2 = StreamingGLS(_fit_base(workload))
+        with pytest.raises(CheckpointError):
+            eng2.cache.load_state(state)
+
+    def test_foreign_checkpoint_refused(self, workload, tmp_path):
+        _, _, _, blocks = workload
+        ckpt = str(tmp_path / "stream")
+        eng = StreamingGLS(_fit_base(workload))
+        stream_updates(eng, [copy.deepcopy(blocks[0])], checkpoint=ckpt)
+        eng2 = StreamingGLS(_fit_base(workload))
+        with pytest.raises(CheckpointError):
+            stream_updates(eng2,
+                           [copy.deepcopy(b) for b in blocks[:3]],
+                           checkpoint=ckpt)
+
+
+# ---------------------------------------------------------------------------
+# the update door on TimingService
+# ---------------------------------------------------------------------------
+
+class TestUpdateDoor:
+    def test_request_validation(self, workload):
+        _, _, _, blocks = workload
+        with pytest.raises(UsageError):
+            UpdateRequest(kind="nonsense")
+        with pytest.raises(UsageError):
+            UpdateRequest()  # append without a block
+        with pytest.raises(UsageError):
+            UpdateRequest(kind="release", block_id=0, rows=[])
+        q = UpdateRequest(new_toas=blocks[0])
+        assert q.kind == "append" and q.n_rows == BLOCK
+        # numpy index arrays (np.nonzero's currency) construct cleanly
+        # instead of raising an untyped truthiness ValueError
+        qn = UpdateRequest(kind="quarantine", block_id=0,
+                           rows=np.array([0, 2]))
+        assert qn.n_rows == 2
+        with pytest.raises(UsageError):
+            UpdateRequest(kind="quarantine", block_id=0,
+                          rows=np.zeros(0, dtype=np.intp))
+
+    def test_door_requires_registration(self):
+        from pint_tpu.serving import TimingService
+
+        svc = TimingService()
+        with pytest.raises(UsageError):
+            svc.serve_updates([])
+        with pytest.raises(UsageError):
+            svc.register_stream(object())
+
+    def test_register_stream_reuses_existing_engine(self, workload):
+        """A fitter whose lazy engine already exists attaches cleanly
+        (register_stream must not refuse over an option IT supplied;
+        review regression)."""
+        from pint_tpu.serving import TimingService
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        f.update_toas(copy.deepcopy(blocks[0]))  # lazy engine exists
+        svc = TimingService()
+        svc.register_stream(f)
+        assert svc.stream is f._stream
+        assert svc.stream.cache.pool is svc.pool
+
+    def test_serve_updates_coalesces_appends(self, workload):
+        """Two appends in one pass merge into ONE rank-k dispatch:
+        both results carry batch=2 and the same post-batch state, the
+        compile delta on the first member only."""
+        from pint_tpu.serving import TimingService
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        svc = TimingService()
+        svc.register_stream(f, block_sizes=[BLOCK, 2 * BLOCK])
+        res = svc.serve_updates([
+            UpdateRequest(new_toas=copy.deepcopy(blocks[0]),
+                          request_id="a"),
+            UpdateRequest(new_toas=copy.deepcopy(blocks[1]),
+                          request_id="b")])
+        assert [r.request_id for r in res] == ["a", "b"]
+        assert all(r.batch == 2 for r in res)
+        assert res[0].chi2 == res[1].chi2
+        assert res[1].compiles == 0
+        assert svc.updates_served == 2
+        s = svc.update_latency_summary()
+        assert s["n"] == 2 and s["p50_ms"] > 0
+
+    def test_warm_registration_gives_zero_compile_appends(self,
+                                                          workload):
+        """register_stream pre-warms the rank-k/step/err kernels at
+        the block ladder; the first served append of a warmed shape
+        still pays only the per-shape ingestion (phase-eval) compiles,
+        and repeats pay none."""
+        from pint_tpu.serving import TimingService
+        from pint_tpu.telemetry import jaxevents
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        svc = TimingService()
+        svc.register_stream(f, block_sizes=[BLOCK])
+        from pint_tpu.serving.batcher import bucket_of
+
+        names = [e.name for e in svc.pool.entries()]
+        K = svc.stream.cache.K
+        rung = bucket_of(BLOCK, svc.stream.cache.block_buckets)
+        assert f"stream.ingest[+{rung}x{K}]" in names
+        assert f"stream.ingest[-{rung}x{K}]" in names
+        assert any(n.startswith("stream.step[") for n in names)
+        assert f"stream.err[{K}]" in names
+        from pint_tpu import telemetry
+
+        telemetry.activate("basic")  # the counter is dead in off mode
+        try:
+            svc.serve_updates([UpdateRequest(new_toas=copy.deepcopy(
+                blocks[0]), request_id="warmup")])
+            before = jaxevents.counts()
+            svc.serve_updates([UpdateRequest(new_toas=copy.deepcopy(
+                blocks[1]), request_id="steady")])
+            delta = jaxevents.counts().compiles - before.compiles
+        finally:
+            telemetry.deactivate()
+        assert delta == 0
+
+    def test_async_door_coalesces(self, workload):
+        import asyncio
+
+        from pint_tpu.serving import TimingService
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        svc = TimingService()
+        svc.register_stream(f, block_sizes=[BLOCK, 2 * BLOCK])
+
+        async def go():
+            return await asyncio.gather(
+                svc.submit_update(UpdateRequest(
+                    new_toas=copy.deepcopy(blocks[0]), request_id="x")),
+                svc.submit_update(UpdateRequest(
+                    new_toas=copy.deepcopy(blocks[1]), request_id="y")))
+
+        r1, r2 = asyncio.run(go())
+        assert r1.batch == r2.batch == 2
+        assert r1.latency_ms is not None
+        with pytest.raises(UsageError):
+            asyncio.run(svc.submit_update(object()))
+
+    def test_invalid_batch_member_fails_before_any_op_runs(self,
+                                                           workload):
+        """A malformed member must fail the pass UP FRONT — not after
+        earlier row operations already mutated the factor (review
+        regression)."""
+        from pint_tpu.serving import TimingService
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        svc = TimingService()
+        svc.register_stream(f, block_sizes=[BLOCK])
+        res = svc.serve_updates([UpdateRequest(
+            new_toas=copy.deepcopy(blocks[0]), request_id="a")])
+        bid = res[0].outcome.block_id
+        L_before = svc.stream.cache.L.copy()
+        with pytest.raises(UsageError):
+            svc.serve_updates([
+                UpdateRequest(kind="quarantine", block_id=bid,
+                              rows=[0]),
+                "not-a-request"])
+        assert np.array_equal(svc.stream.cache.L, L_before)
+
+    def test_conflicting_row_ops_refused_before_any_op_runs(
+            self, workload):
+        """Two ops fighting over one row (or a stale row state) refuse
+        the whole batch BEFORE the first dispatch — the pre-validation
+        simulates the batch's alive-state in request order (review
+        regression)."""
+        from pint_tpu.serving import TimingService
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        svc = TimingService()
+        svc.register_stream(f, block_sizes=[BLOCK])
+        res = svc.serve_updates([UpdateRequest(
+            new_toas=copy.deepcopy(blocks[0]), request_id="a")])
+        bid = res[0].outcome.block_id
+        L_before = svc.stream.cache.L.copy()
+        with pytest.raises(UsageError):
+            svc.serve_updates([
+                UpdateRequest(kind="quarantine", block_id=bid,
+                              rows=[0], request_id="q1"),
+                UpdateRequest(kind="quarantine", block_id=bid,
+                              rows=[0], request_id="q2")])
+        with pytest.raises(UsageError):
+            svc.serve_updates([UpdateRequest(
+                kind="quarantine", block_id=bid, rows=[999],
+                request_id="oob")])
+        assert np.array_equal(svc.stream.cache.L, L_before)
+
+    def test_empty_row_ops_refused_typed(self, workload):
+        """An empty row op is a typed usage error, never a block=0
+        no-op event the telemetry validator would reject (review
+        regression)."""
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        eng = StreamingGLS(f)
+        out = eng.update_toas(copy.deepcopy(blocks[0]))
+        with pytest.raises(UsageError):
+            eng.quarantine_rows(out.block_id, [])
+        with pytest.raises(UsageError):
+            eng.release_quarantined(out.block_id, [])
+        with pytest.raises(UsageError):
+            eng.update_toas(blocks[0][np.zeros(0, dtype=np.intp)])
+
+    def test_quarantine_and_release_through_door(self, workload):
+        from pint_tpu.serving import TimingService
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        svc = TimingService()
+        svc.register_stream(f, block_sizes=[BLOCK])
+        res = svc.serve_updates([UpdateRequest(
+            new_toas=copy.deepcopy(blocks[0]), request_id="a")])
+        bid = res[0].outcome.block_id
+        rq = svc.serve_updates([UpdateRequest(
+            kind="quarantine", block_id=bid, rows=[0, 2])])
+        rr = svc.serve_updates([UpdateRequest(
+            kind="release", block_id=bid, rows=[0, 2])])
+        assert rq[0].fallback is None and rr[0].fallback is None
+        assert svc.stream.rebuilds == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry events
+# ---------------------------------------------------------------------------
+
+class TestStreamEvents:
+    def test_stream_events_validate_against_the_schema(self, workload,
+                                                       tmp_path):
+        """Full-mode streaming writes stream_update / factor_fallback
+        records the telemetry_report validator accepts, with the
+        documented attr contract."""
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import runlog
+        from tools.telemetry_report import validate_run_dir
+
+        _, _, _, blocks = workload
+        f = _fit_base(workload)
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            runlog.start_run(run_dir, name="streaming-test",
+                             probe_device=False)
+            eng = StreamingGLS(f)
+            out = eng.update_toas(copy.deepcopy(blocks[0]))
+            bid = out.block_id
+            eng.quarantine_rows(bid, [1])
+            eng.release_quarantined(bid, [1])
+            # force the guard: a refactor with its mandatory reason
+            eng.cache.cond_limit = 1.0
+            eng.update_toas(copy.deepcopy(blocks[1]))
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+        errors = []
+        validate_run_dir(run_dir, errors)
+        assert not errors, errors
+        recs = [json.loads(ln) for ln in
+                open(os.path.join(run_dir, "events.jsonl"))]
+        ups = [r["event"]["attrs"] for r in recs
+               if r.get("type") == "event"
+               and r["event"]["name"] == "stream_update"]
+        falls = [r["event"]["attrs"] for r in recs
+                 if r.get("type") == "event"
+                 and r["event"]["name"] == "factor_fallback"]
+        assert [u["kind"] for u in ups] == ["append", "downdate",
+                                            "release", "append"]
+        assert ups[0]["block"] == BLOCK and ups[0]["fallback"] is False
+        assert ups[-1]["fallback"] is True
+        assert len(falls) == 1
+        assert "condition proxy" in falls[0]["reason"]
+        # the event reports the REFUSED factor's condition (> the
+        # forced 1.0 guard), not the healthy post-rebuild proxy of a
+        # fresh factorization that would contradict the reason
+        assert falls[0]["condition"] > 1.0
+
+    def test_malformed_stream_event_rejected(self):
+        from tools.telemetry_report import validate_streaming_event
+
+        errors = []
+        validate_streaming_event(
+            {"name": "stream_update",
+             "attrs": {"kind": "sideways", "block": 0,
+                       "quarantined": -1, "steps": 2,
+                       "latency_ms": -3.0, "compiles": 0,
+                       "fallback": False}},
+            "t", errors)
+        blob = "\n".join(errors)
+        assert "kind" in blob and "block" in blob
+        assert "latency_ms" in blob and "quarantined" in blob
+        errors = []
+        validate_streaming_event(
+            {"name": "factor_fallback",
+             "attrs": {"reason": "  ", "block": 4}}, "t", errors)
+        assert any("reason is empty" in e for e in errors)
+
+
+# ---------------------------------------------------------------------------
+# autotune: the block-size ladder tunable
+# ---------------------------------------------------------------------------
+
+class TestUpdateBlockTunable:
+    def test_tune_and_resolve_round_trip(self, workload, tmp_path,
+                                         monkeypatch):
+        """tune_update_blocks records a manifest decision the resolve
+        layer returns and a fresh engine consumes."""
+        from pint_tpu import autotune, config
+
+        config.set_tune_dir(str(tmp_path / "tune"))
+        try:
+            autotune.reset_manifest_singleton()
+            dec = autotune.tune_update_blocks(
+                [3, 5, 16, 16, 60], n_free=12,
+                tuning_manifest=autotune.manifest())
+            assert dec.name == "update.blocks"
+            assert isinstance(dec.value, list) and dec.value
+            assert dec.basis in ("cost", "static")
+            tuned = autotune.resolve_update_blocks()
+            assert tuned == tuple(sorted(int(b) for b in dec.value))
+            f = _fit_base(workload)
+            eng = StreamingGLS(f)
+            assert eng.cache.block_buckets == tuned
+        finally:
+            config.set_tune_dir(None)
+            autotune.reset_manifest_singleton()
+
+    def test_unconfigured_resolve_is_static(self):
+        from pint_tpu import autotune, config
+
+        assert config.tune_dir() is None
+        assert autotune.resolve_update_blocks() is None
+
+    def test_tuning_needs_positive_sizes(self):
+        from pint_tpu import autotune
+
+        with pytest.raises(UsageError):
+            autotune.tune_update_blocks([], n_free=10)
+        with pytest.raises(UsageError):
+            autotune.tune_update_blocks([0], n_free=10)
+
+
+# ---------------------------------------------------------------------------
+# the bench streaming{} block
+# ---------------------------------------------------------------------------
+
+class TestBenchStreamingBlock:
+    def test_contract_at_toy_scale(self, monkeypatch):
+        """The stamped block carries every key perfwatch ingests, with
+        zero steady-state compiles and a real (if toy-scale) win."""
+        import bench
+
+        from pint_tpu import telemetry
+
+        monkeypatch.setenv("BENCH_STREAM_TOAS", "192")
+        monkeypatch.setenv("BENCH_STREAM_BLOCK", "8")
+        monkeypatch.setenv("BENCH_STREAM_APPENDS", "3")
+        monkeypatch.setenv("BENCH_STREAM_REFITS", "1")
+        # bench.main() activates basic telemetry before the blocks run;
+        # standalone the counter would be dead and the compiles pin
+        # vacuous
+        telemetry.activate("basic")
+        try:
+            out = bench.streaming_block()
+        finally:
+            telemetry.deactivate()
+        for key in ("appends", "update_p50_ms", "update_p99_ms",
+                    "updates_per_s", "refit_p50_ms",
+                    "speedup_vs_refit", "steady_state_compiles"):
+            assert key in out, key
+        assert out["appends"] == 3
+        assert out["steady_state_compiles"] == 0
+        assert out["updates_per_s"] > 0
+        assert out["speedup_vs_refit"] > 1.0
+
+    @pytest.mark.slow
+    def test_speedup_meets_the_ten_x_bar(self, monkeypatch):
+        """The ISSUE's acceptance number at production-ish scale:
+        steady-state update latency >= 10x faster than the warm
+        full-refit path (measured ~48x at the default knobs)."""
+        import bench
+
+        from pint_tpu import telemetry
+
+        monkeypatch.delenv("BENCH_STREAM_TOAS", raising=False)
+        monkeypatch.delenv("BENCH_STREAM_BLOCK", raising=False)
+        monkeypatch.delenv("BENCH_STREAM_APPENDS", raising=False)
+        monkeypatch.delenv("BENCH_STREAM_REFITS", raising=False)
+        telemetry.activate("basic")
+        try:
+            out = bench.streaming_block()
+        finally:
+            telemetry.deactivate()
+        assert out["speedup_vs_refit"] >= 10.0
+        assert out["steady_state_compiles"] == 0

@@ -197,6 +197,15 @@ _AMORTIZED_SUBMODULES = {"flows", "elbo", "train", "posterior"}
 #: fused scan) dispatch plain inner functions, not this API
 _RUNTIME_SUBMODULES = {"workperbyte"}
 
+#: pint_tpu.streaming submodules are host-side orchestration around
+#: their module-internal jitted kernels (factor-state bookkeeping,
+#: TOA merging/validation, checkpoint I/O, warm-pool registration):
+#: an append/update call inside a traced function would re-enter the
+#: whole ingestion pipeline per TRACE — the rank-k/warm-step kernels
+#: the cache dispatches are module-level jit objects, not the
+#: packages' public function surface
+_STREAMING_SUBMODULES = {"lowrank", "cache", "update", "door"}
+
 #: one table drives the ImportFrom tracking for every host-side
 #: package (the next PR's package is one row, not a copied branch)
 _HOST_PACKAGES = (("pint_tpu.telemetry", _TELEMETRY_SUBMODULES),
@@ -204,7 +213,8 @@ _HOST_PACKAGES = (("pint_tpu.telemetry", _TELEMETRY_SUBMODULES),
                   ("pint_tpu.autotune", _AUTOTUNE_SUBMODULES),
                   ("pint_tpu.catalog", _CATALOG_SUBMODULES),
                   ("pint_tpu.amortized", _AMORTIZED_SUBMODULES),
-                  ("pint_tpu.runtime", _RUNTIME_SUBMODULES))
+                  ("pint_tpu.runtime", _RUNTIME_SUBMODULES),
+                  ("pint_tpu.streaming", _STREAMING_SUBMODULES))
 
 
 def _record_imports(info: FileInfo) -> None:

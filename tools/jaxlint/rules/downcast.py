@@ -37,6 +37,7 @@ DOWNCAST_SCOPE = PRECISION_CORE + (
     "pint_tpu/catalog/",
     "pint_tpu/serving/batcher.py",
     "pint_tpu/amortized/",
+    "pint_tpu/streaming/",
 )
 
 _REDUCED_NAMES = {"float32", "bfloat16", "float16", "half", "single"}

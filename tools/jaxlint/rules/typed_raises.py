@@ -49,6 +49,7 @@ DEFAULT_TARGETS = (
     "pint_tpu/catalog/",
     "pint_tpu/precision/",
     "pint_tpu/amortized/",
+    "pint_tpu/streaming/",
 )
 
 DISALLOWED = {

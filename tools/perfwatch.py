@@ -115,6 +115,13 @@ class RunRecord:
     scaling_dispatch_per_s: Optional[float] = None
     scaling_scatter_bytes: Optional[float] = None
     scaling_error: Optional[str] = None        #: degraded scaling block
+    #: from the streaming{...} block (round 15+: streaming updates)
+    streaming_updates_per_s: Optional[float] = None
+    streaming_update_p50_ms: Optional[float] = None
+    streaming_update_p99_ms: Optional[float] = None
+    streaming_speedup_vs_refit: Optional[float] = None
+    streaming_steady_compiles: Optional[int] = None
+    streaming_error: Optional[str] = None      #: degraded streaming block
     #: from the precision{...} block (round 12+: mixed-precision layer)
     precision_mixed_fits_per_s: Optional[float] = None
     precision_max_rel_err: Optional[float] = None
@@ -271,6 +278,23 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.posterior_train_steps = posterior["train_steps"]
         if isinstance(posterior.get("error"), str) and posterior["error"]:
             rec.posterior_error = posterior["error"]
+    streaming = h.get("streaming")
+    if isinstance(streaming, dict):
+        for src, dst in (("updates_per_s", "streaming_updates_per_s"),
+                         ("update_p50_ms", "streaming_update_p50_ms"),
+                         ("update_p99_ms", "streaming_update_p99_ms"),
+                         ("speedup_vs_refit",
+                          "streaming_speedup_vs_refit")):
+            if isinstance(streaming.get(src), (int, float)) \
+                    and not isinstance(streaming.get(src), bool):
+                setattr(rec, dst, float(streaming[src]))
+        if isinstance(streaming.get("steady_state_compiles"), int) \
+                and not isinstance(streaming.get("steady_state_compiles"),
+                                   bool):
+            rec.streaming_steady_compiles = \
+                streaming["steady_state_compiles"]
+        if isinstance(streaming.get("error"), str) and streaming["error"]:
+            rec.streaming_error = streaming["error"]
     # a zero-valued errored run (the bench's error-emit contract) is a
     # failed measurement, not a 100% regression
     if rec.error is not None and not rec.value:
@@ -501,6 +525,18 @@ def check_series(runs: List[RunRecord], threshold: float,
                    lambda r: r.scaling_dispatch_per_s, +1, False),
                   ("scaling_scatter_bytes",
                    lambda r: r.scaling_scatter_bytes, -1, False),
+                  # streaming updates (round 15+): update throughput
+                  # gates drops, the update door's tail latency gates
+                  # rises, and the headline speedup over the warm
+                  # full-refit path gates drops (a PR that erodes the
+                  # rank-k win back toward refit cost must not ship
+                  # silently)
+                  ("streaming_updates_per_s",
+                   lambda r: r.streaming_updates_per_s, +1, False),
+                  ("streaming_update_p99_ms",
+                   lambda r: r.streaming_update_p99_ms, -1, False),
+                  ("streaming_speedup_vs_refit",
+                   lambda r: r.streaming_speedup_vs_refit, +1, False),
                   # mixed-precision layer (round 12+): policy-path
                   # throughput gates drops; max_rel_err gates rises WITH
                   # the zero-baseline opt-in — a bit-identical history
@@ -627,6 +663,19 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: scaling block degraded "
                    f"({latest_rec.scaling_error}) where prior runs "
                    "measured the work-per-byte plans"))
+    # a degraded streaming block where prior rounds measured the
+    # streaming engine is a regression, not a silent skip
+    if latest_rec.streaming_error is not None \
+            and any(r.streaming_updates_per_s is not None
+                    for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="streaming", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: streaming block degraded "
+                   f"({latest_rec.streaming_error}) where prior runs "
+                   "measured the streaming engine"))
     # a degraded precision block where prior rounds measured the
     # mixed-precision layer is a regression, not a silent skip
     if latest_rec.precision_error is not None \
@@ -787,6 +836,14 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f" fits/s ({latest.precision_mixed_vs_f64}x f64, "
                   f"{latest.precision_reduced_count} reduced segment(s)),"
                   f" max_rel_err={latest.precision_max_rel_err}",
+                  file=out)
+        if latest.streaming_updates_per_s is not None \
+                or latest.streaming_update_p99_ms is not None:
+            print(f"  streaming: {latest.streaming_updates_per_s} "
+                  f"updates/s, p50 {latest.streaming_update_p50_ms} ms, "
+                  f"p99 {latest.streaming_update_p99_ms} ms, "
+                  f"{latest.streaming_speedup_vs_refit}x refit, "
+                  f"steady_compiles={latest.streaming_steady_compiles}",
                   file=out)
         if latest.cost:
             c = latest.cost

@@ -262,6 +262,73 @@ def validate_amortized_event(ev: dict, where: str,
                      f"posterior_serve {key!r} is negative ({v!r})")
 
 
+#: streaming-engine lifecycle events (pint_tpu/streaming + the
+#: service's update door): one stream_update per engine operation
+#: (append / quarantine downdate / release update) and one
+#: factor_fallback whenever the guarded rank-k path refused and paid a
+#: full refactor.  Same contract style as the other event families —
+#: a drift in the engine's emitters fails --check before it corrupts
+#: the streaming series bench/perfwatch trend.
+STREAMING_EVENT_ATTRS = {
+    "stream_update": {"kind": str, "block": int,
+                      "quarantined": int, "steps": int,
+                      "latency_ms": (int, float), "compiles": int,
+                      "fallback": bool},
+    "factor_fallback": {"reason": str, "block": int},
+}
+
+_STREAM_KINDS = ("append", "downdate", "release")
+
+
+def validate_streaming_event(ev: dict, where: str,
+                             errors: List[str]) -> None:
+    """Attr contract for stream_update / factor_fallback records:
+    required attrs typed; an update's kind in the engine's enum, its
+    block size >= 1, latency >= 0, quarantined/steps/compiles
+    non-negative; a fallback's reason non-empty (a refactor without a
+    stated cause is producer drift) and its block >= 1."""
+    name = ev.get("name")
+    required = STREAMING_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or (isinstance(v, bool)
+                                      and typ is not bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    block = attrs.get("block")
+    if isinstance(block, int) and not isinstance(block, bool) \
+            and block < 1:
+        _err(errors, where, f"{name} block is {block!r}, must be >= 1")
+    if name == "stream_update":
+        if attrs.get("kind") not in _STREAM_KINDS:
+            _err(errors, where,
+                 f"stream_update kind {attrs.get('kind')!r} not in "
+                 f"{_STREAM_KINDS}")
+        lat = attrs.get("latency_ms")
+        if isinstance(lat, (int, float)) and not isinstance(lat, bool) \
+                and lat < 0:
+            _err(errors, where,
+                 f"stream_update latency_ms is negative ({lat!r})")
+        for key in ("quarantined", "steps", "compiles"):
+            v = attrs.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                _err(errors, where,
+                     f"stream_update {key!r} is negative ({v!r})")
+    elif name == "factor_fallback":
+        reason = attrs.get("reason")
+        if isinstance(reason, str) and not reason.strip():
+            _err(errors, where,
+                 "factor_fallback reason is empty — a refactor must "
+                 "state its cause")
+
+
 #: catalog-engine lifecycle events (pint_tpu/catalog): one ingest
 #: summary per catalog (quarantined-row and excluded-pulsar counts)
 #: and one bucket-assignment summary (ladder + padding waste).  Same
@@ -843,6 +910,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_catalog_event(ev, where, errors)
                     validate_precision_event(ev, where, errors)
                     validate_amortized_event(ev, where, errors)
+                    validate_streaming_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -1135,6 +1203,21 @@ def self_test(errors: List[str]) -> int:
                          n=256, bucket=256, latency_ms=2.1, compiles=0)
         run.record_event("posterior_serve", kind="logprob", batch=1,
                          n=256, bucket=256, latency_ms=1.4, compiles=0)
+        # streaming-engine producer drift check: the update/fallback
+        # event contract (STREAMING_EVENT_ATTRS) — a steady-state
+        # rank-k append, the release (never-a-rebuild) twin, and the
+        # degraded twin: a condition-guard refusal paying a full
+        # refactor with its mandatory reason
+        run.record_event("stream_update", kind="append", block=16,
+                         quarantined=1, steps=2, latency_ms=5.4,
+                         compiles=0, fallback=False)
+        run.record_event("stream_update", kind="release", block=2,
+                         quarantined=0, steps=2, latency_ms=1.2,
+                         compiles=0, fallback=False)
+        run.record_event("factor_fallback",
+                         reason="condition proxy 2.1e+14 past the "
+                                "1e+13 guard",
+                         block=16, condition=2.1e14)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
@@ -1142,9 +1225,9 @@ def self_test(errors: List[str]) -> int:
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
         # sharding_plan, 3x elastic events, 3x serving events, 2x
         # autotune events, 3x catalog events, 3x precision events,
-        # 4x amortized events, metrics, run_end
-        if n < 28:
-            _err(errors, "selftest", f"expected >= 28 records, got {n}")
+        # 4x amortized events, 3x streaming events, metrics, run_end
+        if n < 31:
+            _err(errors, "selftest", f"expected >= 31 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
