@@ -20,12 +20,44 @@ AOT persistence"):
   exact by construction — zero-weight rows, block-diagonal pad
   columns), coalesce within a latency window, and report p50/p99 /
   queue depth / compile counters through the metrics registry and
-  ``serve_request`` telemetry events.
+  ``serve_request`` telemetry events;
+* :mod:`~pint_tpu.serving.admission` / :mod:`~pint_tpu.serving.
+  scheduler` / :mod:`~pint_tpu.serving.loadgen` — traffic engineering
+  (DESIGN.md "Traffic engineering & SLO-aware scheduling"): watermark
+  admission control returning typed :class:`~pint_tpu.serving.
+  admission.ShedResponse` sheds with hysteresis, priority / deadline /
+  weighted-fair arbitration across the three doors plus
+  reverse-ladder pressure escalation, and the seeded closed-loop load
+  harness that measures all of it under contention.
 """
 
-from pint_tpu.serving import aotcache, batcher, service, warmup
+from pint_tpu.serving import (
+    admission,
+    aotcache,
+    batcher,
+    loadgen,
+    scheduler,
+    service,
+    warmup,
+)
+from pint_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    ShedResponse,
+)
 from pint_tpu.serving.aotcache import AOTCache, cache, device_fingerprint
 from pint_tpu.serving.batcher import FitRequest, FitResult, ShapeBatcher
+from pint_tpu.serving.loadgen import (
+    LoadConfig,
+    LoadGenerator,
+    LoadReport,
+    ShapePopulation,
+)
+from pint_tpu.serving.scheduler import (
+    PressureEscalator,
+    Scheduler,
+    SchedulerConfig,
+)
 from pint_tpu.serving.service import (
     PosteriorRequest,
     PosteriorResult,
@@ -41,9 +73,14 @@ from pint_tpu.serving.warmup import (
 )
 
 __all__ = ["aotcache", "warmup", "batcher", "service",
+           "admission", "scheduler", "loadgen",
            "AOTCache", "cache", "device_fingerprint",
            "FitRequest", "FitResult", "ShapeBatcher",
            "PosteriorRequest", "PosteriorResult",
            "ServeConfig", "TimingService",
+           "ShedResponse", "AdmissionConfig", "AdmissionController",
+           "Scheduler", "SchedulerConfig", "PressureEscalator",
+           "LoadConfig", "LoadGenerator", "LoadReport",
+           "ShapePopulation",
            "WarmPool", "WarmupReport", "warm_buckets", "warm_catalog",
            "warm_fitter"]

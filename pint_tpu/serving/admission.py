@@ -1,0 +1,263 @@
+"""Admission control: shed load as data, not as exceptions.
+
+The three :class:`~pint_tpu.serving.service.TimingService` doors used
+to answer a full coalescing queue with a hard ``UsageError`` — which
+turned one hot second into an exception storm and, worse, gave the
+caller no machine-usable signal about *when* to come back.  This
+module replaces that cliff with a watermark state machine:
+
+* every request class (``fit`` | ``posterior`` | ``update``) carries a
+  **high watermark** (engage shedding) and a **low watermark**
+  (disengage), both fractions of ``ServeConfig.max_queue``, plus an
+  optional in-flight p99 latency watermark pair — a door can be
+  "full" by time as well as by depth;
+* between the watermarks the controller is **hysteretic**: once
+  shedding engages it stays engaged until occupancy drains below the
+  LOW watermark, so a queue oscillating around one threshold cannot
+  flap the service into and out of shedding every window;
+* a shed is a typed :class:`ShedResponse` — class, reason, a
+  ``retry_after_ms`` hint derived from the door's own latency ring —
+  delivered as the *result* of the caller's future, never as an
+  exception that could abort coalesced batch-mates.  The hard cap at
+  ``max_queue`` itself always sheds regardless of hysteresis state
+  (the bounded-queue contract survives).
+
+Every shed emits a ``request_shed`` telemetry event and increments the
+per-class ``pint_tpu_sched_shed_total`` counter; engage/disengage
+transitions are counted separately so a flapping controller is visible
+in the metrics, not just in a failing test.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["ShedResponse", "AdmissionConfig", "AdmissionController",
+           "REQUEST_CLASSES", "SHED_REASONS"]
+
+#: the service's request classes, in scheduler priority order
+#: (interactive posterior above streaming update above batch fit)
+REQUEST_CLASSES = ("posterior", "update", "fit")
+
+#: why a request was shed: coalescing-queue occupancy past the
+#: watermark, in-flight p99 past the latency watermark, or the
+#: bounded-queue hard cap itself
+SHED_REASONS = ("queue_depth", "latency", "queue_full")
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Admission-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+@dataclass
+class ShedResponse:
+    """A typed "not now" — the result a shed request's future resolves
+    with (NEVER an exception: an exception delivered through the
+    coalescing machinery could abort innocent batch-mates).
+
+    Callers branch on ``isinstance(res, ShedResponse)`` (or the
+    truthiness helper :meth:`shed`) and retry after ``retry_after_ms``.
+    """
+
+    request_class: str          #: fit | posterior | update
+    reason: str                 #: queue_depth | latency | queue_full
+    retry_after_ms: float       #: hint: the door's window + drain time
+    queue_depth: int = 0        #: occupancy at the shed decision
+    request_id: Optional[str] = None
+
+    def __post_init__(self):
+        if self.request_class not in REQUEST_CLASSES:
+            raise UsageError(
+                f"ShedResponse request_class {self.request_class!r} "
+                f"not in {REQUEST_CLASSES}")
+        if self.reason not in SHED_REASONS:
+            raise UsageError(
+                f"ShedResponse reason {self.reason!r} not in "
+                f"{SHED_REASONS}")
+
+    @property
+    def shed(self) -> bool:
+        """Always True — the positional twin of ``FitResult`` etc.
+        lacks the attribute, so ``getattr(res, 'shed', False)`` is a
+        branch-free check."""
+        return True
+
+
+@dataclass
+class AdmissionConfig:
+    """Watermark policy for one service (shared by every class).
+
+    The defaults reproduce the old bounded-queue threshold exactly
+    (shed only at ``max_queue``), so a service that never opts into
+    earlier watermarks behaves as before — minus the exception."""
+
+    #: engage shedding at ``high_watermark * max_queue`` occupancy
+    high_watermark: float = 1.0
+    #: disengage only below ``low_watermark * max_queue`` (hysteresis)
+    low_watermark: float = 0.5
+    #: optional in-flight latency watermarks: engage when the door's
+    #: ring p99 exceeds ``latency_high_ms``, disengage below
+    #: ``latency_low_ms`` (None disables the latency dimension)
+    latency_high_ms: Optional[float] = None
+    latency_low_ms: Optional[float] = None
+    #: floor for the retry-after hint (the hint itself also folds in
+    #: the door's measured p50 drain time)
+    retry_after_floor_ms: float = 1.0
+
+    def __post_init__(self):
+        if not (0.0 < self.high_watermark <= 1.0):
+            raise UsageError(
+                f"high_watermark must be in (0, 1], got "
+                f"{self.high_watermark}")
+        if not (0.0 <= self.low_watermark <= self.high_watermark):
+            raise UsageError(
+                f"low_watermark must be in [0, high_watermark], got "
+                f"{self.low_watermark} vs {self.high_watermark}")
+        if self.latency_high_ms is not None:
+            lo = self.latency_low_ms
+            if lo is None or lo > self.latency_high_ms or lo < 0:
+                raise UsageError(
+                    "latency watermarks need 0 <= latency_low_ms <= "
+                    f"latency_high_ms (got {lo} vs "
+                    f"{self.latency_high_ms})")
+
+
+@dataclass
+class _ClassState:
+    """Per-class hysteresis state + shed accounting."""
+
+    shedding: bool = False
+    sheds: int = 0
+    engages: int = 0
+    disengages: int = 0
+    since: float = 0.0          #: perf_counter at last engage
+
+
+class AdmissionController:
+    """The per-class watermark state machine in front of every door.
+
+    One controller per service; :meth:`check` is called by the async
+    submit path with the door's live occupancy and ring p99, and
+    returns a :class:`ShedResponse` to deliver (or None to admit)."""
+
+    def __init__(self, cfg: Optional[AdmissionConfig] = None,
+                 max_queue: int = 1024):
+        self.cfg = cfg or AdmissionConfig()
+        if max_queue < 1:
+            raise UsageError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self._state: Dict[str, _ClassState] = {
+            k: _ClassState() for k in REQUEST_CLASSES}
+
+    # -- the state machine --------------------------------------------------
+
+    def _thresholds(self):
+        high = max(1, int(self.cfg.high_watermark * self.max_queue))
+        low = self.cfg.low_watermark * self.max_queue
+        return high, low
+
+    def check(self, request_class: str, queue_depth: int,
+              p99_ms: Optional[float] = None,
+              p50_ms: Optional[float] = None,
+              window_ms: float = 0.0,
+              request_id: Optional[str] = None
+              ) -> Optional[ShedResponse]:
+        """Admit (None) or shed (a :class:`ShedResponse`) one request.
+
+        ``queue_depth`` is the door's occupancy BEFORE this request;
+        ``p99_ms``/``p50_ms`` the door's latency-ring summary (None
+        while the ring is empty); ``window_ms`` the coalescing window
+        folded into the retry-after hint."""
+        st = self._state.get(request_class)
+        if st is None:
+            raise UsageError(
+                f"unknown request class {request_class!r}; the service "
+                f"classes are {REQUEST_CLASSES}")
+        high, low = self._thresholds()
+        reason = None
+        # the bounded-queue hard cap sheds unconditionally: hysteresis
+        # widens the shedding REGION, it never unbounds the queue
+        if queue_depth >= self.max_queue:
+            reason = "queue_full"
+        lat_hot = (self.cfg.latency_high_ms is not None
+                   and p99_ms is not None
+                   and p99_ms > self.cfg.latency_high_ms)
+        lat_cool = (self.cfg.latency_high_ms is None
+                    or p99_ms is None
+                    or p99_ms <= (self.cfg.latency_low_ms or 0.0))
+        if st.shedding:
+            # disengage only below BOTH low watermarks — the hysteresis
+            # contract: no oscillation around a single threshold
+            if queue_depth <= low and lat_cool and reason is None:
+                st.shedding = False
+                st.disengages += 1
+            else:
+                reason = reason or ("latency" if lat_hot
+                                    else "queue_depth")
+        else:
+            if reason is None and queue_depth >= high:
+                reason = "queue_depth"
+            elif reason is None and lat_hot:
+                reason = "latency"
+            if reason is not None:
+                st.shedding = True
+                st.engages += 1
+                st.since = time.perf_counter()
+        if reason is None:
+            return None
+        st.sheds += 1
+        retry_ms = max(
+            self.cfg.retry_after_floor_ms,
+            float(window_ms) + (float(p50_ms) if p50_ms else 0.0)
+            * max(1.0, queue_depth / max(1, high)))
+        shed = ShedResponse(request_class=request_class, reason=reason,
+                            retry_after_ms=retry_ms,
+                            queue_depth=int(queue_depth),
+                            request_id=request_id)
+        self._account(shed)
+        return shed
+
+    def _account(self, shed: ShedResponse) -> None:
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.counter(
+                "pint_tpu_sched_shed_total",
+                "requests shed by admission control").inc(
+                    labels={"class": shed.request_class,
+                            "reason": shed.reason})
+        _emit_event("request_shed",
+                    request_class=shed.request_class,
+                    reason=shed.reason,
+                    retry_after_ms=float(shed.retry_after_ms),
+                    queue_depth=int(shed.queue_depth))
+
+    # -- introspection ------------------------------------------------------
+
+    def shedding(self, request_class: str) -> bool:
+        return self._state[request_class].shedding
+
+    def any_shedding(self) -> bool:
+        return any(s.shedding for s in self._state.values())
+
+    def transitions(self, request_class: str) -> int:
+        """Engage + disengage count — the flapping witness the
+        square-wave test pins."""
+        st = self._state[request_class]
+        return st.engages + st.disengages
+
+    def to_dict(self) -> dict:
+        return {k: {"shedding": s.shedding, "sheds": s.sheds,
+                    "engages": s.engages, "disengages": s.disengages}
+                for k, s in self._state.items()}

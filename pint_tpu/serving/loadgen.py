@@ -1,0 +1,480 @@
+"""Closed-loop load harness: drive a live TimingService to saturation.
+
+Every published number before this module was single-client — nothing
+measured what happens when the fit, posterior, and update doors
+*compete*.  The load generator closes that gap:
+
+* **arrival models** — ``open`` (Poisson: seeded exponential
+  inter-arrival gaps at a target RPS, submissions never wait for
+  completions, the model that actually saturates a service) and
+  ``closed`` (fixed concurrency: each of N workers keeps exactly one
+  request in flight — self-throttling, the model that measures
+  capacity without overload);
+* **request-class mixes** — weighted draws over fit / posterior /
+  update, so a 4:1 fit:posterior overload is one config line;
+* **ragged shape populations** — ``(n_toas, n_free)`` pairs drawn
+  from a synthetic distribution or from a real catalog's pulsars
+  (:class:`ShapePopulation`), with per-shape operands generated ONCE
+  and reused so the harness measures the service, not numpy;
+* **seeded determinism** — the full schedule (arrival offsets, class
+  sequence, shape sequence) is a pure function of the config seed,
+  pre-generated before the clock starts (:meth:`LoadGenerator.
+  schedule`), so a run is replayable byte-for-byte.
+
+A run emits one schema-tagged ``load_run`` telemetry event and returns
+a :class:`LoadReport` with per-class offered/completed/shed counts,
+sustained RPS, p50/p99 latency against the class's SLO budget, and a
+Jain fairness index over per-class goodput shares.
+
+``python -m pint_tpu.serving.loadgen --selftest`` is the CI hook: a
+small deterministic closed+open run against a live service on the CPU
+stand-in.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.serving.admission import REQUEST_CLASSES
+from pint_tpu.serving.scheduler import DEFAULT_DEADLINES_MS
+
+__all__ = ["ShapePopulation", "LoadConfig", "ClassStats", "LoadReport",
+           "LoadGenerator", "ARRIVAL_MODELS"]
+
+#: how requests arrive: Poisson open-loop or fixed-concurrency closed
+ARRIVAL_MODELS = ("open", "closed")
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Load-harness telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[idx])
+
+
+class ShapePopulation:
+    """A population of ``(n_toas, n_free)`` problem shapes the
+    generator draws from — the raggedness that exercises the bucket
+    ladders instead of hammering one padded executable."""
+
+    def __init__(self, shapes: Sequence[Tuple[int, int]]):
+        shapes = [(int(n), int(k)) for n, k in shapes]
+        if not shapes:
+            raise UsageError("ShapePopulation needs >= 1 shape")
+        for n, k in shapes:
+            if n < 1 or k < 1 or k > n:
+                raise UsageError(
+                    f"shape (n_toas={n}, n_free={k}) needs "
+                    "1 <= n_free <= n_toas")
+        self.shapes: List[Tuple[int, int]] = shapes
+
+    @classmethod
+    def synthetic(cls, n: int = 8, seed: int = 0,
+                  ntoa_range: Tuple[int, int] = (24, 64),
+                  nfree_range: Tuple[int, int] = (3, 8)
+                  ) -> "ShapePopulation":
+        """A seeded ragged population inside the default bucket
+        ladders (the same (24, 64) TOA range the synthetic catalog
+        uses)."""
+        rng = np.random.default_rng(seed)
+        shapes = []
+        for _ in range(int(n)):
+            nt = int(rng.integers(ntoa_range[0], ntoa_range[1] + 1))
+            nf = int(rng.integers(nfree_range[0],
+                                  min(nfree_range[1], nt) + 1))
+            shapes.append((nt, nf))
+        return cls(shapes)
+
+    @classmethod
+    def from_catalog(cls, pulsars: Sequence) -> "ShapePopulation":
+        """The shape distribution of a real (or synthetic) catalog:
+        one ``(n_toas, n_free)`` per
+        :class:`~pint_tpu.catalog.ingest.CatalogPulsar` — load tests
+        then stress exactly the raggedness the deployment serves."""
+        shapes = [(p.n_toas, p.n_free) for p in pulsars]
+        return cls(shapes)
+
+    def __len__(self) -> int:
+        return len(self.shapes)
+
+
+@dataclass
+class LoadConfig:
+    """One load run: arrival model, intensity, mix, and SLO budgets."""
+
+    #: ``open`` (Poisson at ``rps``) | ``closed`` (``concurrency``
+    #: workers, one request in flight each)
+    arrival: str = "closed"
+    #: open-loop target offered rate (requests/s)
+    rps: float = 100.0
+    #: closed-loop worker count
+    concurrency: int = 4
+    #: total requests the run offers (both models)
+    n_requests: int = 64
+    #: request-class mix weights over fit/posterior/update (need not
+    #: normalize; classes absent from the dict are never offered)
+    mix: Dict[str, float] = field(
+        default_factory=lambda: {"fit": 1.0})
+    #: schedule seed: arrivals, class draws, shape draws, operands
+    seed: int = 0
+    #: per-class p99 SLO budgets (ms) the report grades against;
+    #: defaults to the scheduler's deadline budgets
+    slo_ms: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_DEADLINES_MS))
+    #: samples per posterior draw request
+    posterior_draws: int = 32
+
+    def __post_init__(self):
+        if self.arrival not in ARRIVAL_MODELS:
+            raise UsageError(
+                f"arrival {self.arrival!r} not in {ARRIVAL_MODELS}")
+        if self.rps <= 0 or self.concurrency < 1 or self.n_requests < 1:
+            raise UsageError(
+                "LoadConfig needs rps > 0, concurrency >= 1, "
+                f"n_requests >= 1 (got {self.rps}, {self.concurrency}, "
+                f"{self.n_requests})")
+        if not self.mix:
+            raise UsageError("LoadConfig.mix must name >= 1 class")
+        for k, w in self.mix.items():
+            if k not in REQUEST_CLASSES:
+                raise UsageError(
+                    f"unknown request class {k!r} in mix; the service "
+                    f"classes are {REQUEST_CLASSES}")
+            if float(w) < 0:
+                raise UsageError(f"mix weight for {k!r} must be >= 0, "
+                                 f"got {w}")
+        if sum(float(w) for w in self.mix.values()) <= 0:
+            raise UsageError("LoadConfig.mix weights sum to zero")
+
+
+@dataclass
+class ClassStats:
+    """One request class's slice of a load run."""
+
+    offered: int = 0
+    completed: int = 0
+    shed: int = 0
+    latencies_ms: List[float] = field(default_factory=list)
+
+    def summary(self, duration_s: float,
+                slo_ms: Optional[float]) -> dict:
+        vals = sorted(self.latencies_ms)
+        p99 = _percentile(vals, 0.99)
+        return {
+            "offered": self.offered,
+            "completed": self.completed,
+            "shed": self.shed,
+            "rps": (self.completed / duration_s
+                    if duration_s > 0 else 0.0),
+            "p50_ms": _percentile(vals, 0.50),
+            "p99_ms": p99,
+            "slo_ms": slo_ms,
+            "slo_met": (bool(p99 <= slo_ms)
+                        if slo_ms is not None and vals else None),
+        }
+
+
+@dataclass
+class LoadReport:
+    """The outcome of one load run, per class and overall."""
+
+    arrival: str
+    duration_s: float
+    per_class: Dict[str, dict]
+
+    @property
+    def offered(self) -> int:
+        return sum(c["offered"] for c in self.per_class.values())
+
+    @property
+    def completed(self) -> int:
+        return sum(c["completed"] for c in self.per_class.values())
+
+    @property
+    def shed(self) -> int:
+        return sum(c["shed"] for c in self.per_class.values())
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / self.offered if self.offered else 0.0
+
+    @property
+    def fairness(self) -> float:
+        """Jain's index over per-class goodput shares
+        (completed/offered): 1.0 when every class gets the same
+        fraction of its offered load through, 1/n when one class
+        monopolizes — the starvation witness."""
+        shares = [c["completed"] / c["offered"]
+                  for c in self.per_class.values() if c["offered"]]
+        if not shares:
+            return 0.0
+        sq = sum(x * x for x in shares)
+        if sq == 0.0:
+            return 0.0
+        return (sum(shares) ** 2) / (len(shares) * sq)
+
+    def to_dict(self) -> dict:
+        return {"arrival": self.arrival,
+                "duration_s": self.duration_s,
+                "offered": self.offered,
+                "completed": self.completed,
+                "shed": self.shed,
+                "shed_rate": self.shed_rate,
+                "fairness": self.fairness,
+                "per_class": self.per_class}
+
+
+class LoadGenerator:
+    """Drive a live :class:`~pint_tpu.serving.service.TimingService`
+    with a seeded, replayable request schedule.
+
+    ``update_factory`` (when the mix includes ``update``) is a
+    zero-arg callable returning a fresh
+    :class:`~pint_tpu.streaming.door.UpdateRequest` — update operands
+    are engine-specific (real TOA blocks), so the harness does not
+    guess them."""
+
+    def __init__(self, service, cfg: Optional[LoadConfig] = None,
+                 shapes: Optional[ShapePopulation] = None,
+                 update_factory: Optional[Callable] = None):
+        self.service = service
+        self.cfg = cfg or LoadConfig()
+        self.shapes = shapes or ShapePopulation.synthetic(
+            seed=self.cfg.seed)
+        self.update_factory = update_factory
+        if "posterior" in self.cfg.mix and self.cfg.mix["posterior"] \
+                and service.posterior is None:
+            raise UsageError(
+                "mix includes 'posterior' but no posterior is "
+                "registered on the service (register_posterior first)")
+        if "update" in self.cfg.mix and self.cfg.mix["update"]:
+            if service.stream is None:
+                raise UsageError(
+                    "mix includes 'update' but no streaming engine is "
+                    "registered on the service (register_stream first)")
+            if update_factory is None:
+                raise UsageError(
+                    "mix includes 'update': pass update_factory (a "
+                    "zero-arg callable returning an UpdateRequest)")
+        self._operands = self._make_operands()
+
+    # -- the deterministic schedule -----------------------------------------
+
+    def schedule(self) -> List[Tuple[float, str, int]]:
+        """The full run plan — ``(arrival_offset_s, request_class,
+        shape_index)`` per request — a pure function of the config
+        seed (same seed, same schedule: the determinism contract the
+        selftest pins).  Closed-loop offsets are all 0.0: workers
+        issue on demand, only the class/shape sequence matters."""
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed)
+        classes = sorted(cfg.mix)          # stable draw order
+        weights = np.array([float(cfg.mix[c]) for c in classes])
+        weights = weights / weights.sum()
+        t = 0.0
+        plan = []
+        for _ in range(cfg.n_requests):
+            if cfg.arrival == "open":
+                t += float(rng.exponential(1.0 / cfg.rps))
+                offset = t
+            else:
+                offset = 0.0
+            klass = classes[int(rng.choice(len(classes), p=weights))]
+            shape_idx = int(rng.integers(len(self.shapes)))
+            plan.append((offset, klass, shape_idx))
+        return plan
+
+    def _make_operands(self) -> Dict[int, object]:
+        """One solvable :class:`~pint_tpu.serving.batcher.FitRequest`
+        operand set per DISTINCT shape, generated once and reused —
+        the harness measures the service, not numpy allocation."""
+        from pint_tpu.serving.batcher import FitRequest
+
+        rng = np.random.default_rng(self.cfg.seed + 1)
+        out: Dict[int, object] = {}
+        for i, (n, k) in enumerate(self.shapes.shapes):
+            M = rng.standard_normal((n, k))
+            r = 1e-6 * rng.standard_normal(n)
+            w = 1.0 / (1e-12 + 1e-13 * rng.random(n))
+            out[i] = FitRequest(M=M, r=r, w=w, phiinv=np.zeros(k),
+                                request_id=f"load-{i}")
+        return out
+
+    def _build_request(self, klass: str, shape_idx: int):
+        if klass == "fit":
+            return self._operands[shape_idx]
+        if klass == "posterior":
+            from pint_tpu.serving.service import PosteriorRequest
+
+            return PosteriorRequest(n_draws=self.cfg.posterior_draws)
+        return self.update_factory()
+
+    async def _issue(self, klass: str, shape_idx: int,
+                     stats: Dict[str, ClassStats]) -> None:
+        svc = self.service
+        req = self._build_request(klass, shape_idx)
+        st = stats[klass]
+        st.offered += 1
+        t0 = time.perf_counter()
+        if klass == "fit":
+            res = await svc.submit(req)
+        elif klass == "posterior":
+            res = await svc.submit_posterior(req)
+        else:
+            res = await svc.submit_update(req)
+        if getattr(res, "shed", False):
+            st.shed += 1
+            return
+        st.completed += 1
+        st.latencies_ms.append(1e3 * (time.perf_counter() - t0))
+
+    async def _run_open(self, plan, stats) -> None:
+        loop = asyncio.get_running_loop()
+        start = loop.time()
+        tasks = []
+        for offset, klass, shape_idx in plan:
+            delay = start + offset - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.ensure_future(
+                self._issue(klass, shape_idx, stats)))
+        await asyncio.gather(*tasks)
+
+    async def _run_closed(self, plan, stats) -> None:
+        it = iter(plan)
+
+        async def worker():
+            for _, klass, shape_idx in it:
+                await self._issue(klass, shape_idx, stats)
+
+        await asyncio.gather(*[worker()
+                               for _ in range(self.cfg.concurrency)])
+
+    async def run_async(self) -> LoadReport:
+        """Execute the schedule against the live service (for callers
+        already inside an event loop)."""
+        cfg = self.cfg
+        plan = self.schedule()
+        stats = {k: ClassStats() for k in sorted(cfg.mix)}
+        t0 = time.perf_counter()
+        if cfg.arrival == "open":
+            await self._run_open(plan, stats)
+        else:
+            await self._run_closed(plan, stats)
+        duration_s = time.perf_counter() - t0
+        per_class = {k: s.summary(duration_s, cfg.slo_ms.get(k))
+                     for k, s in stats.items()}
+        report = LoadReport(arrival=cfg.arrival, duration_s=duration_s,
+                            per_class=per_class)
+        def _num(k, key):
+            v = per_class.get(k, {}).get(key)
+            return float(v) if v is not None and v == v else 0.0
+        _emit_event("load_run",
+                    arrival=cfg.arrival,
+                    duration_s=float(duration_s),
+                    offered=int(report.offered),
+                    completed=int(report.completed),
+                    shed=int(report.shed),
+                    shed_rate=float(report.shed_rate),
+                    fairness=float(report.fairness),
+                    fit_rps=_num("fit", "rps"),
+                    posterior_rps=_num("posterior", "rps"),
+                    update_rps=_num("update", "rps"),
+                    fit_p99_ms=_num("fit", "p99_ms"),
+                    posterior_p99_ms=_num("posterior", "p99_ms"),
+                    update_p99_ms=_num("update", "p99_ms"))
+        return report
+
+    def run(self) -> LoadReport:
+        """Execute the schedule (owns the event loop)."""
+        return asyncio.run(self.run_async())
+
+
+# ---------------------------------------------------------------------------
+# the CI selftest: python -m pint_tpu.serving.loadgen --selftest
+# ---------------------------------------------------------------------------
+
+def _selftest() -> int:
+    """A small deterministic run against a live service on the CPU
+    stand-in: schedule determinism, closed- and open-loop accounting,
+    and the shed path under a deliberately tiny queue.  Returns a
+    process exit code."""
+    from pint_tpu.serving.service import ServeConfig, TimingService
+
+    shapes = ShapePopulation.synthetic(n=4, seed=7,
+                                       ntoa_range=(24, 64),
+                                       nfree_range=(3, 8))
+    svc = TimingService(ServeConfig(ntoa_buckets=(64,),
+                                    nfree_buckets=(8,),
+                                    batch_buckets=(1, 4),
+                                    window_ms=1.0, max_queue=64))
+
+    closed = LoadConfig(arrival="closed", concurrency=4, n_requests=32,
+                        mix={"fit": 1.0}, seed=3)
+    gen = LoadGenerator(svc, closed, shapes=shapes)
+    twin = LoadGenerator(svc, closed, shapes=shapes)
+    if gen.schedule() != twin.schedule():
+        print("loadgen selftest: FAIL (schedule not deterministic)")
+        return 1
+    rep = gen.run()
+    if rep.offered != 32 or rep.completed + rep.shed != rep.offered:
+        print(f"loadgen selftest: FAIL (closed accounting: "
+              f"{rep.to_dict()})")
+        return 1
+    if rep.completed < 1 or rep.per_class["fit"]["p99_ms"] != \
+            rep.per_class["fit"]["p99_ms"]:
+        print("loadgen selftest: FAIL (closed run served nothing)")
+        return 1
+
+    open_cfg = LoadConfig(arrival="open", rps=500.0, n_requests=32,
+                          mix={"fit": 1.0}, seed=5)
+    rep2 = LoadGenerator(svc, open_cfg, shapes=shapes).run()
+    if rep2.offered != 32 or rep2.completed + rep2.shed != rep2.offered:
+        print(f"loadgen selftest: FAIL (open accounting: "
+              f"{rep2.to_dict()})")
+        return 1
+
+    print(f"loadgen selftest: OK (closed {rep.completed}/{rep.offered} "
+          f"served, open {rep2.completed}/{rep2.offered} served, "
+          f"shed {rep2.shed})")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.serving.loadgen",
+        description="closed-loop load harness for the timing service")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the deterministic CI selftest")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,282 @@
+"""SLO-aware scheduling across the service's three request classes.
+
+The fit, posterior, and update doors each coalesce independently, so
+nothing used to arbitrate BETWEEN them: a fit flood whose coalesced
+batch dispatched for hundreds of milliseconds held the event loop —
+and every posterior waiter — hostage for the whole dispatch.  This
+module is the arbitration layer:
+
+* **priority classes** — interactive ``posterior`` above streaming
+  ``update`` above batch ``fit`` (:data:`~pint_tpu.serving.admission.
+  REQUEST_CLASSES`), expressed through per-class weights and deadline
+  budgets rather than a starvation-prone strict queue;
+* **deadline budgets** — each class carries a p99 latency budget; the
+  coalescing window is *shortened* when the budget minus the door's
+  measured p99 leaves less slack than the configured window, and an
+  already-at-risk oldest waiter flushes the window immediately
+  (deadline-aware coalescing: batching never spends latency the SLO
+  doesn't have);
+* **weighted-fair dispatch** — each flush drains at most one
+  *quantum* of requests (weight x base quantum) and reschedules the
+  remainder through the event loop, so a 1000-request fit backlog
+  becomes many short dispatches with posterior/update flushes
+  interleaved between them instead of one loop-hogging mega-batch;
+* **elastic pressure relief** — :class:`PressureEscalator` runs the
+  PR 7 degradation ladder in reverse: sustained shedding escalates
+  the execution plan one rung UP via
+  :func:`~pint_tpu.runtime.plan.select_plan`, capped by
+  :func:`~pint_tpu.runtime.preflight.healthy_devices`, emitting
+  ``mesh_escalated`` events.
+
+Per-class ``pint_tpu_sched_*`` metrics (dispatches, early flushes,
+served counts) make the arbitration observable next to the doors' own
+``pint_tpu_serve_*``/``pint_tpu_posterior_*``/``pint_tpu_update_*``
+families.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.serving.admission import REQUEST_CLASSES
+
+__all__ = ["SchedulerConfig", "Scheduler", "PressureEscalator",
+           "DEFAULT_WEIGHTS", "DEFAULT_DEADLINES_MS"]
+
+#: weighted-fair dispatch weights, priority-ordered: a posterior flush
+#: drains 4x the quantum a fit flush does, so under contention the
+#: interactive class gets the larger share of every loop pass
+DEFAULT_WEIGHTS = {"posterior": 4, "update": 2, "fit": 1}
+
+#: per-class p99 deadline budgets (ms).  Generous on the CPU stand-in;
+#: a deployment tightens them per class.  The posterior budget is the
+#: binding one — it is what the bench's load block holds under the 4:1
+#: fit:posterior overload mix.
+DEFAULT_DEADLINES_MS = {"posterior": 250.0, "update": 1000.0,
+                        "fit": 4000.0}
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Scheduler-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+@dataclass
+class SchedulerConfig:
+    """Arbitration policy across the three request classes."""
+
+    #: weighted-fair share per class (missing classes default to 1)
+    weights: Dict[str, int] = field(
+        default_factory=lambda: dict(DEFAULT_WEIGHTS))
+    #: per-class p99 deadline budget in ms (missing: no deadline —
+    #: the class coalesces at the full configured window)
+    deadlines_ms: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_DEADLINES_MS))
+    #: requests one weight unit drains per flush; the top batch-bucket
+    #: rung is the natural setting (one padded executable per quantum)
+    base_quantum: int = 16
+
+    def __post_init__(self):
+        for k, w in self.weights.items():
+            if k not in REQUEST_CLASSES:
+                raise UsageError(
+                    f"unknown request class {k!r} in weights; the "
+                    f"service classes are {REQUEST_CLASSES}")
+            if int(w) < 1:
+                raise UsageError(f"weight for {k!r} must be >= 1, "
+                                 f"got {w}")
+        for k, d in self.deadlines_ms.items():
+            if k not in REQUEST_CLASSES:
+                raise UsageError(
+                    f"unknown request class {k!r} in deadlines_ms; "
+                    f"the service classes are {REQUEST_CLASSES}")
+            if float(d) <= 0:
+                raise UsageError(
+                    f"deadline for {k!r} must be > 0 ms, got {d}")
+        if int(self.base_quantum) < 1:
+            raise UsageError(
+                f"base_quantum must be >= 1, got {self.base_quantum}")
+
+
+class Scheduler:
+    """Per-class quantum, window, and deadline decisions for the doors.
+
+    Host-side and allocation-free on the hot path: every method is a
+    handful of dict lookups, called once per enqueue or flush."""
+
+    def __init__(self, cfg: Optional[SchedulerConfig] = None):
+        self.cfg = cfg or SchedulerConfig()
+        self._dispatches: Dict[str, int] = {k: 0 for k in REQUEST_CLASSES}
+        self._served: Dict[str, int] = {k: 0 for k in REQUEST_CLASSES}
+        self._early_flushes: Dict[str, int] = {
+            k: 0 for k in REQUEST_CLASSES}
+
+    # -- policy -------------------------------------------------------------
+
+    def weight(self, request_class: str) -> int:
+        return int(self.cfg.weights.get(request_class, 1))
+
+    def deadline_ms(self, request_class: str) -> Optional[float]:
+        d = self.cfg.deadlines_ms.get(request_class)
+        return float(d) if d is not None else None
+
+    def quantum(self, request_class: str) -> int:
+        """Max requests one flush of this class drains before yielding
+        the event loop back (weighted-fair dispatch)."""
+        return self.weight(request_class) * int(self.cfg.base_quantum)
+
+    def window_s(self, request_class: str, window_ms: float,
+                 p99_ms: Optional[float]) -> float:
+        """The coalescing delay for a fresh window: the configured
+        window, shortened to the deadline slack when the class's p99
+        budget leaves less room (deadline-aware coalescing — never
+        negative, never longer than configured)."""
+        window = max(0.0, float(window_ms))
+        budget = self.deadline_ms(request_class)
+        if budget is not None and p99_ms is not None:
+            slack = budget - float(p99_ms)
+            window = min(window, max(0.0, slack))
+        return window / 1e3
+
+    def at_risk(self, request_class: str, oldest_wait_ms: float,
+                p99_ms: Optional[float]) -> bool:
+        """True when the OLDEST waiter's remaining budget no longer
+        covers the door's measured p99 — the window must flush now."""
+        budget = self.deadline_ms(request_class)
+        if budget is None:
+            return False
+        est = float(p99_ms) if p99_ms is not None else 0.0
+        return float(oldest_wait_ms) + est >= budget
+
+    # -- accounting ---------------------------------------------------------
+
+    def note_early_flush(self, request_class: str) -> None:
+        self._early_flushes[request_class] += 1
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.counter(
+                "pint_tpu_sched_early_flush_total",
+                "coalescing windows flushed early for a deadline "
+                "budget at risk").inc(
+                    labels={"class": request_class})
+
+    def note_dispatch(self, request_class: str, n: int) -> None:
+        self._dispatches[request_class] += 1
+        self._served[request_class] += int(n)
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.counter(
+                "pint_tpu_sched_dispatches_total",
+                "weighted-fair dispatch passes per class").inc(
+                    labels={"class": request_class})
+            metrics.counter(
+                "pint_tpu_sched_served_total",
+                "requests served through the scheduler per class"
+            ).inc(int(n), labels={"class": request_class})
+
+    def to_dict(self) -> dict:
+        return {k: {"dispatches": self._dispatches[k],
+                    "served": self._served[k],
+                    "early_flushes": self._early_flushes[k],
+                    "weight": self.weight(k),
+                    "deadline_ms": self.deadline_ms(k)}
+                for k in REQUEST_CLASSES}
+
+
+# ---------------------------------------------------------------------------
+# elastic pressure relief: the degradation ladder, in reverse
+# ---------------------------------------------------------------------------
+
+class PressureEscalator:
+    """Escalate the execution plan one mesh rung when shedding is
+    sustained — :meth:`~pint_tpu.runtime.plan.ExecutionPlan.degraded`
+    run backwards.
+
+    :meth:`observe` is fed one boolean per admission decision (is the
+    service shedding?); ``sustain`` consecutive True observations
+    trigger one rung escalation via
+    :func:`~pint_tpu.runtime.plan.select_plan`, capped at the largest
+    :func:`~pint_tpu.runtime.plan.ladder` rung the healthy device set
+    supports (a sick chip never joins an escalated mesh either).
+    Escalation emits a ``mesh_escalated`` event; hitting the cap is
+    logged once and never retried until pressure clears (the cap is a
+    hardware fact, not a transient)."""
+
+    def __init__(self, workload: str = "gls_normal_eq",
+                 devices: Optional[Sequence] = None,
+                 sustain: int = 3, start_rung: int = 1):
+        from pint_tpu.runtime.plan import ladder, select_plan
+
+        if sustain < 1:
+            raise UsageError(f"sustain must be >= 1, got {sustain}")
+        self.workload = workload
+        self.sustain = int(sustain)
+        self._devices = tuple(devices) if devices is not None else None
+        self._hot = 0
+        self._capped = False
+        self.plan = select_plan(workload, devices=self._devices,
+                                max_devices=max(1, int(start_rung)))
+        self._ladder = ladder  # resolved once; tests stub devices only
+
+    def _healthy(self) -> Tuple:
+        if self._devices is not None:
+            return self._devices
+        from pint_tpu.runtime.preflight import healthy_devices
+
+        return tuple(healthy_devices())
+
+    @property
+    def rung(self) -> int:
+        return int(self.plan.rung)
+
+    def observe(self, shedding: bool):
+        """One admission-decision sample.  Returns the NEW plan when
+        this observation triggered an escalation, else None."""
+        if not shedding:
+            self._hot = 0
+            self._capped = False
+            return None
+        self._hot += 1
+        if self._hot < self.sustain or self._capped:
+            return None
+        self._hot = 0
+        healthy = self._healthy()
+        cap = self._ladder(len(healthy))[0] if healthy else 1
+        if self.rung >= cap:
+            # the ladder's top rung: nothing left to escalate to
+            from pint_tpu.logging import log
+
+            log.warning(
+                f"pressure escalation capped at rung {self.rung} "
+                f"({len(healthy)} healthy device(s)); shedding "
+                "continues")
+            self._capped = True
+            return None
+        from pint_tpu.runtime.plan import select_plan
+
+        old = self.rung
+        new_rung = min(cap, old * 2)
+        self.plan = select_plan(self.workload, devices=healthy,
+                                max_devices=new_rung)
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.gauge("pint_tpu_sched_mesh_rung",
+                          "execution-plan rung after pressure "
+                          "escalation").set(self.rung)
+        _emit_event("mesh_escalated", from_rung=int(old),
+                    to_rung=int(self.rung),
+                    reason="sustained_shedding",
+                    workload=self.workload,
+                    n_healthy=len(healthy))
+        return self.plan

@@ -35,6 +35,11 @@ import numpy as np
 
 from pint_tpu import config
 from pint_tpu.exceptions import UsageError
+from pint_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    ShedResponse,
+)
 from pint_tpu.serving.batcher import (
     DEFAULT_BATCH_BUCKETS,
     DEFAULT_NFREE_BUCKETS,
@@ -44,10 +49,11 @@ from pint_tpu.serving.batcher import (
     ShapeBatcher,
     bucket_of,
 )
+from pint_tpu.serving.scheduler import Scheduler, SchedulerConfig
 from pint_tpu.serving.warmup import WarmPool, WarmupReport, warm_buckets
 
 __all__ = ["ServeConfig", "TimingService", "PosteriorRequest",
-           "PosteriorResult", "DEFAULT_DRAW_BUCKETS"]
+           "PosteriorResult", "DoorStats", "DEFAULT_DRAW_BUCKETS"]
 
 #: bounded latency ring: enough for honest p99 without unbounded growth
 _LATENCY_RING = 4096
@@ -69,6 +75,12 @@ class ServeConfig:
     max_queue: int = 1024
     #: posterior-door draw/query-count ladder (amortized engine)
     draw_buckets: Tuple[int, ...] = DEFAULT_DRAW_BUCKETS
+    #: admission-control watermarks (None: the default policy — shed
+    #: only at the max_queue hard cap, exactly the old bound)
+    admission: Optional[AdmissionConfig] = None
+    #: cross-class arbitration policy (None: the default priority
+    #: weights and deadline budgets)
+    sched: Optional[SchedulerConfig] = None
 
 
 @dataclass
@@ -144,6 +156,87 @@ def _emit_event(name: str, **attrs) -> None:
     telemetry.lifecycle_event(name, **attrs)
 
 
+class DoorStats:
+    """One door's shared accounting state: the bounded p50/p99 latency
+    ring, served count, coalescing queue + flush task, queue-depth
+    gauge, and the request/latency/compile metric family.
+
+    The fit, posterior, and update doors each hand-rolled this before;
+    one helper means the three cannot drift (and the fit door gets the
+    same queue-depth gauge coverage the other two always had).  Metric
+    names and help strings are byte-identical to the pre-refactor
+    per-door spellings."""
+
+    def __init__(self, klass: str, prefix: str, requests_help: str,
+                 latency_help: str, compiles_help: str, queue_help: str):
+        self.klass = klass              #: fit | posterior | update
+        self.prefix = prefix            #: e.g. "pint_tpu_serve"
+        self._requests_help = requests_help
+        self._latency_help = latency_help
+        self._compiles_help = compiles_help
+        self._queue_help = queue_help
+        self.latencies_ms: List[float] = []
+        self.served = 0
+        self.pending: List[tuple] = []
+        self.flush_task = None
+
+    # -- latency ring -------------------------------------------------------
+
+    def push(self, latency_ms: float) -> None:
+        """Bounded latency-ring append — ONE copy of the trim logic
+        for all three doors (fit, posterior, update)."""
+        self.latencies_ms.append(latency_ms)
+        if len(self.latencies_ms) > _LATENCY_RING:
+            del self.latencies_ms[:len(self.latencies_ms) - _LATENCY_RING]
+
+    def summary(self) -> dict:
+        """``{n, p50_ms, p99_ms}`` over this door's latency ring."""
+        vals = sorted(self.latencies_ms)
+        return {"n": len(vals),
+                "p50_ms": _percentile(vals, 0.50),
+                "p99_ms": _percentile(vals, 0.99)}
+
+    @property
+    def p50_ms(self) -> Optional[float]:
+        """Ring p50, or None while the ring is empty (the scheduler /
+        admission layers need "no data yet", not NaN)."""
+        if not self.latencies_ms:
+            return None
+        return _percentile(sorted(self.latencies_ms), 0.50)
+
+    @property
+    def p99_ms(self) -> Optional[float]:
+        if not self.latencies_ms:
+            return None
+        return _percentile(sorted(self.latencies_ms), 0.99)
+
+    # -- metrics ------------------------------------------------------------
+
+    def gauge_queue_depth(self) -> None:
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.gauge(f"{self.prefix}_queue_depth",
+                          self._queue_help).set(len(self.pending))
+
+    def record_metrics(self, latency_ms: float, compiles: int) -> None:
+        """The per-request counter/histogram updates every door's
+        record hook shares (door-specific extras — events, fallback
+        counters — stay with the door)."""
+        self.served += 1
+        self.push(latency_ms)
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.counter(f"{self.prefix}_requests_total",
+                            self._requests_help).inc()
+            metrics.histogram(f"{self.prefix}_latency_ms",
+                              self._latency_help).observe(latency_ms)
+            if compiles:
+                metrics.counter(f"{self.prefix}_compiles_total",
+                                self._compiles_help).inc(compiles)
+
+
 class TimingService:
     """Shape-bucketed warm-serving front door for linearized fits."""
 
@@ -174,27 +267,44 @@ class TimingService:
             nfree_buckets=self.cfg.nfree_buckets,
             batch_buckets=self.cfg.batch_buckets,
             pool=self.pool)
-        self._latencies_ms: List[float] = []
-        self._served = 0
-        self._pending: List[tuple] = []
-        self._flush_task = None
+        self._fit = DoorStats(
+            "fit", "pint_tpu_serve",
+            requests_help="fit requests served",
+            latency_help="request latency (ms)",
+            compiles_help="fresh XLA compiles paid by serve dispatches",
+            queue_help="requests waiting in the coalescing window")
         # posterior door (amortized engine): nothing exists — and no
         # executable is ever built — until register_posterior() is
         # called with a trained flow
         self._posterior = None
         self._posterior_key = None
         self._draw_counter = 0
-        self._post_latencies_ms: List[float] = []
-        self._post_served = 0
-        self._post_pending: List[tuple] = []
-        self._post_flush_task = None
+        self._post = DoorStats(
+            "posterior", "pint_tpu_posterior",
+            requests_help="posterior requests served",
+            latency_help="posterior request latency (ms)",
+            compiles_help="fresh XLA compiles paid by posterior "
+                          "dispatches",
+            queue_help="posterior requests waiting in the coalescing "
+                       "window")
         # update door (streaming engine): nothing exists until
         # register_stream() attaches a StreamingGLS engine
         self._stream = None
-        self._upd_latencies_ms: List[float] = []
-        self._upd_served = 0
-        self._upd_pending: List[tuple] = []
-        self._upd_flush_task = None
+        self._upd = DoorStats(
+            "update", "pint_tpu_update",
+            requests_help="streaming update requests served",
+            latency_help="update request latency (ms)",
+            compiles_help="fresh XLA compiles paid by update dispatches",
+            queue_help="update requests waiting in the coalescing "
+                       "window")
+        # traffic engineering: admission watermarks + the cross-class
+        # scheduler are always on (their defaults reproduce the old
+        # bounded-queue behavior, minus the exception); pressure
+        # escalation is opt-in via enable_escalation()
+        self._admission = AdmissionController(
+            self.cfg.admission, max_queue=self.cfg.max_queue)
+        self._sched = Scheduler(self.cfg.sched)
+        self._escalator = None
 
     # -- warm-up ------------------------------------------------------------
 
@@ -207,38 +317,10 @@ class TimingService:
 
     # -- accounting ---------------------------------------------------------
 
-    @staticmethod
-    def _ring_push(ring: List[float], latency_ms: float) -> None:
-        """Bounded latency-ring append — ONE copy of the trim logic
-        for all three doors (fit, posterior, update)."""
-        ring.append(latency_ms)
-        if len(ring) > _LATENCY_RING:
-            del ring[:len(ring) - _LATENCY_RING]
-
-    @staticmethod
-    def _ring_summary(ring: List[float]) -> dict:
-        """``{n, p50_ms, p99_ms}`` over one door's latency ring."""
-        vals = sorted(ring)
-        return {"n": len(vals),
-                "p50_ms": _percentile(vals, 0.50),
-                "p99_ms": _percentile(vals, 0.99)}
-
     def _record(self, req: FitRequest, res: FitResult,
                 latency_ms: float) -> None:
-        from pint_tpu.telemetry import metrics
-
         res.latency_ms = latency_ms
-        self._served += 1
-        self._ring_push(self._latencies_ms, latency_ms)
-        if config._telemetry_mode != "off":
-            metrics.counter("pint_tpu_serve_requests_total",
-                            "fit requests served").inc()
-            metrics.histogram("pint_tpu_serve_latency_ms",
-                              "request latency (ms)").observe(latency_ms)
-            if res.compiles:
-                metrics.counter("pint_tpu_serve_compiles_total",
-                                "fresh XLA compiles paid by serve "
-                                "dispatches").inc(res.compiles)
+        self._fit.record_metrics(latency_ms, int(res.compiles))
         _emit_event("serve_request",
                     bucket_ntoas=int(res.bucket[0]),
                     bucket_nfree=int(res.bucket[1]),
@@ -249,11 +331,38 @@ class TimingService:
 
     def latency_summary(self) -> dict:
         """``{n, p50_ms, p99_ms}`` over the (bounded) latency ring."""
-        return self._ring_summary(self._latencies_ms)
+        return self._fit.summary()
 
     @property
     def served(self) -> int:
-        return self._served
+        return self._fit.served
+
+    @property
+    def admission(self) -> AdmissionController:
+        return self._admission
+
+    @property
+    def scheduler(self) -> Scheduler:
+        return self._sched
+
+    @property
+    def escalator(self):
+        return self._escalator
+
+    def enable_escalation(self, workload: str = "gls_normal_eq",
+                          devices=None, sustain: int = 3,
+                          start_rung: int = 1):
+        """Opt into elastic pressure relief: sustained shedding runs
+        the PR 7 degradation ladder in reverse (one mesh rung up per
+        sustained-pressure episode, capped by the healthy device set).
+        Returns the :class:`~pint_tpu.serving.scheduler.
+        PressureEscalator` so the caller can read the live plan."""
+        from pint_tpu.serving.scheduler import PressureEscalator
+
+        self._escalator = PressureEscalator(
+            workload, devices=devices, sustain=sustain,
+            start_rung=start_rung)
+        return self._escalator
 
     # -- synchronous door ---------------------------------------------------
 
@@ -271,53 +380,94 @@ class TimingService:
 
     # -- async door ---------------------------------------------------------
 
-    async def submit(self, request: FitRequest) -> FitResult:
+    async def submit(self, request: FitRequest,
+                     strict: bool = False) -> FitResult:
         """Enqueue one request; requests landing within the coalescing
         window share a batched executable.  Returns this request's
         unpadded result (exceptions from a failed batch propagate to
-        every member's awaiter)."""
+        every member's awaiter).  When admission control sheds, the
+        return value is a :class:`~pint_tpu.serving.admission.
+        ShedResponse` instead — unless ``strict=True``, the escape
+        hatch raising the old typed queue-full error."""
         return await self._submit_door(
-            request, self._pending, "_flush_task", self._flush_after,
-            what="serve", gauge=self._gauge_queue_depth)
-
-    def _gauge_queue_depth(self) -> None:
-        if config._telemetry_mode != "off":
-            from pint_tpu.telemetry import metrics
-
-            metrics.gauge("pint_tpu_serve_queue_depth",
-                          "requests waiting in the coalescing window"
-                          ).set(len(self._pending))
+            request, self._fit, self._flush_after, what="serve",
+            strict=strict)
 
     async def _flush_after(self) -> None:
-        pending, self._pending = self._pending, []
-        self._flush_task = None
-        self._gauge_queue_depth()
-        await self._flush_door(pending, self.batcher.run, self._record,
-                               what="serve")
+        await self._drain_door(self._fit, self.batcher.run,
+                               self._record, what="serve",
+                               flush=self._flush_after)
 
-    # -- the shared coalescing core (both doors) ----------------------------
+    # -- the shared coalescing core (all three doors) ------------------------
 
-    async def _submit_door(self, request, pending: List[tuple],
-                           task_attr: str, flush, what: str,
-                           gauge=None):
-        """Enqueue-and-await shared by the fit and posterior doors:
-        bounded queue, one flush task per window, the caller's gauge
-        updated on enqueue."""
+    async def _submit_door(self, request, door: DoorStats, flush,
+                           what: str, strict: bool = False):
+        """Enqueue-and-await shared by the three doors: admission
+        check (watermarks + hysteresis + the max_queue hard cap), one
+        flush task per window shortened to the class's deadline slack,
+        an immediate flush when the oldest waiter's p99 budget is at
+        risk, and the door's gauge updated on enqueue.
+
+        A shed resolves THIS caller's future with the typed
+        :class:`~pint_tpu.serving.admission.ShedResponse` — never an
+        exception, which the coalescing machinery could otherwise
+        deliver to innocent batch-mates.  ``strict=True`` restores the
+        old typed ``UsageError`` for tests and callers that prefer the
+        exception contract."""
         import asyncio
 
         loop = asyncio.get_running_loop()
-        if len(pending) >= self.cfg.max_queue:
-            raise UsageError(
-                f"{what} queue full ({self.cfg.max_queue}); shed load "
-                "or raise ServeConfig.max_queue")
+        shed = self._admission.check(
+            door.klass, len(door.pending), p99_ms=door.p99_ms,
+            p50_ms=door.p50_ms, window_ms=self.cfg.window_ms,
+            request_id=getattr(request, "request_id", None))
+        if self._escalator is not None:
+            self._escalator.observe(shed is not None)
+        if shed is not None:
+            if strict:
+                raise UsageError(
+                    f"{what} queue full ({self.cfg.max_queue}); shed "
+                    "load or raise ServeConfig.max_queue")
+            return shed
         fut = loop.create_future()
-        pending.append((request, fut, time.perf_counter()))
-        if gauge is not None:
-            gauge()
-        if getattr(self, task_attr) is None:
-            setattr(self, task_attr, loop.create_task(
-                _sleep_then(self.cfg.window_ms / 1e3, flush)))
+        door.pending.append((request, fut, time.perf_counter()))
+        door.gauge_queue_depth()
+        if door.flush_task is None:
+            delay = self._sched.window_s(door.klass, self.cfg.window_ms,
+                                         door.p99_ms)
+            door.flush_task = loop.create_task(_sleep_then(delay, flush))
+        else:
+            oldest_ms = 1e3 * (time.perf_counter() - door.pending[0][2])
+            if self._sched.at_risk(door.klass, oldest_ms, door.p99_ms):
+                # deadline-aware coalescing: the window still has time
+                # on the clock but the oldest waiter's budget no
+                # longer covers the door's p99 — flush NOW
+                door.flush_task.cancel()
+                door.flush_task = loop.create_task(
+                    _sleep_then(0.0, flush))
+                self._sched.note_early_flush(door.klass)
         return await fut
+
+    async def _drain_door(self, door: DoorStats, run, record,
+                          what: str, flush) -> None:
+        """One weighted-fair dispatch pass: drain at most the class's
+        quantum, reschedule the remainder through the event loop (so
+        other doors' flushes interleave — a fit flood becomes many
+        short dispatches, not one loop-hogging mega-batch), then run
+        the coalesced batch."""
+        import asyncio
+
+        take = self._sched.quantum(door.klass)
+        batch, door.pending = door.pending[:take], door.pending[take:]
+        door.flush_task = None
+        if door.pending:
+            loop = asyncio.get_running_loop()
+            door.flush_task = loop.create_task(_sleep_then(0.0, flush))
+        door.gauge_queue_depth()
+        if not batch:
+            return
+        self._sched.note_dispatch(door.klass, len(batch))
+        await self._flush_door(batch, run, record, what=what)
 
     async def _flush_door(self, pending: List[tuple], run, record,
                           what: str) -> None:
@@ -546,57 +696,33 @@ class TimingService:
             self._record_posterior(req, res, wall_ms)
         return out
 
-    async def submit_posterior(self, request: PosteriorRequest
+    async def submit_posterior(self, request: PosteriorRequest,
+                               strict: bool = False
                                ) -> PosteriorResult:
         """The posterior door's asyncio entry: requests landing within
         the coalescing window share a batched executable (its OWN
         door — posterior traffic never delays fit requests and vice
         versa).  The request is validated HERE, before enqueue: a
         malformed request must fail its own awaiter, never poison the
-        innocent batch-mates it would coalesce with."""
+        innocent batch-mates it would coalesce with.  A shed resolves
+        with a :class:`~pint_tpu.serving.admission.ShedResponse`
+        (``strict=True``: the old typed error)."""
         self._require_posterior()
         self._validate_request(request)
         return await self._submit_door(
-            request, self._post_pending, "_post_flush_task",
-            self._flush_posterior_after, what="posterior",
-            gauge=self._gauge_posterior_queue_depth)
-
-    def _gauge_posterior_queue_depth(self) -> None:
-        if config._telemetry_mode != "off":
-            from pint_tpu.telemetry import metrics
-
-            metrics.gauge("pint_tpu_posterior_queue_depth",
-                          "posterior requests waiting in the "
-                          "coalescing window"
-                          ).set(len(self._post_pending))
+            request, self._post, self._flush_posterior_after,
+            what="posterior", strict=strict)
 
     async def _flush_posterior_after(self) -> None:
-        pending, self._post_pending = self._post_pending, []
-        self._post_flush_task = None
-        self._gauge_posterior_queue_depth()
-        await self._flush_door(pending, self._run_posterior,
-                               self._record_posterior,
-                               what="posterior")
+        await self._drain_door(self._post, self._run_posterior,
+                               self._record_posterior, what="posterior",
+                               flush=self._flush_posterior_after)
 
     def _record_posterior(self, req: PosteriorRequest,
                           res: PosteriorResult,
                           latency_ms: float) -> None:
-        from pint_tpu.telemetry import metrics
-
         res.latency_ms = latency_ms
-        self._post_served += 1
-        self._ring_push(self._post_latencies_ms, latency_ms)
-        if config._telemetry_mode != "off":
-            metrics.counter("pint_tpu_posterior_requests_total",
-                            "posterior requests served").inc()
-            metrics.histogram("pint_tpu_posterior_latency_ms",
-                              "posterior request latency (ms)"
-                              ).observe(latency_ms)
-            if res.compiles:
-                metrics.counter(
-                    "pint_tpu_posterior_compiles_total",
-                    "fresh XLA compiles paid by posterior "
-                    "dispatches").inc(res.compiles)
+        self._post.record_metrics(latency_ms, int(res.compiles))
         _emit_event("posterior_serve", kind=res.kind,
                     batch=int(res.batch), n=int(req.n),
                     bucket=int(res.bucket),
@@ -606,11 +732,11 @@ class TimingService:
     def posterior_latency_summary(self) -> dict:
         """``{n, p50_ms, p99_ms}`` over the posterior door's own
         (bounded) latency ring."""
-        return self._ring_summary(self._post_latencies_ms)
+        return self._post.summary()
 
     @property
     def posterior_served(self) -> int:
-        return self._post_served
+        return self._post.served
 
     # -- update door (streaming engine) --------------------------------------
 
@@ -678,11 +804,13 @@ class TimingService:
             self._record_update(req, res, wall_ms)
         return out
 
-    async def submit_update(self, request):
+    async def submit_update(self, request, strict: bool = False):
         """The update door's asyncio entry: update requests landing
         within the coalescing window share one rank-k dispatch (its
         OWN door — update traffic never delays fit or posterior
-        requests and vice versa)."""
+        requests and vice versa).  A shed resolves with a
+        :class:`~pint_tpu.serving.admission.ShedResponse`
+        (``strict=True``: the old typed error)."""
         from pint_tpu.streaming.door import UpdateRequest
 
         self._require_stream()
@@ -691,50 +819,28 @@ class TimingService:
                 f"the update door takes UpdateRequest, got "
                 f"{type(request).__name__}")
         return await self._submit_door(
-            request, self._upd_pending, "_upd_flush_task",
-            self._flush_updates_after, what="update",
-            gauge=self._gauge_update_queue_depth)
-
-    def _gauge_update_queue_depth(self) -> None:
-        if config._telemetry_mode != "off":
-            from pint_tpu.telemetry import metrics
-
-            metrics.gauge("pint_tpu_update_queue_depth",
-                          "update requests waiting in the coalescing "
-                          "window").set(len(self._upd_pending))
+            request, self._upd, self._flush_updates_after,
+            what="update", strict=strict)
 
     async def _flush_updates_after(self) -> None:
-        pending, self._upd_pending = self._upd_pending, []
-        self._upd_flush_task = None
-        self._gauge_update_queue_depth()
-        await self._flush_door(pending, self._run_updates,
-                               self._record_update, what="update")
+        await self._drain_door(self._upd, self._run_updates,
+                               self._record_update, what="update",
+                               flush=self._flush_updates_after)
 
     def _record_update(self, req, res, latency_ms: float) -> None:
-        from pint_tpu.telemetry import metrics
-
         res.latency_ms = latency_ms
-        self._upd_served += 1
-        self._ring_push(self._upd_latencies_ms, latency_ms)
-        if config._telemetry_mode != "off":
-            metrics.counter("pint_tpu_update_requests_total",
-                            "streaming update requests served").inc()
-            metrics.histogram("pint_tpu_update_latency_ms",
-                              "update request latency (ms)"
-                              ).observe(latency_ms)
-            if res.compiles:
-                metrics.counter(
-                    "pint_tpu_update_compiles_total",
-                    "fresh XLA compiles paid by update "
-                    "dispatches").inc(res.compiles)
-            if res.fallback is not None and res.first_in_batch:
-                # one engine fallback, one count — a coalesced batch
-                # shares the outcome but must not multiply it (the
-                # compiles discipline)
-                metrics.counter(
-                    "pint_tpu_update_fallbacks_total",
-                    "guarded rank-k updates that fell back to a "
-                    "full refactor").inc()
+        self._upd.record_metrics(latency_ms, int(res.compiles))
+        if (config._telemetry_mode != "off"
+                and res.fallback is not None and res.first_in_batch):
+            from pint_tpu.telemetry import metrics
+
+            # one engine fallback, one count — a coalesced batch
+            # shares the outcome but must not multiply it (the
+            # compiles discipline)
+            metrics.counter(
+                "pint_tpu_update_fallbacks_total",
+                "guarded rank-k updates that fell back to a "
+                "full refactor").inc()
         # the engine emits the stream_update/factor_fallback events
         # itself (one per OPERATION, not per coalesced member) — the
         # door's accounting is the request-level metrics above
@@ -742,8 +848,8 @@ class TimingService:
     def update_latency_summary(self) -> dict:
         """``{n, p50_ms, p99_ms}`` over the update door's own
         (bounded) latency ring."""
-        return self._ring_summary(self._upd_latencies_ms)
+        return self._upd.summary()
 
     @property
     def updates_served(self) -> int:
-        return self._upd_served
+        return self._upd.served

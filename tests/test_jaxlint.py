@@ -383,9 +383,48 @@ class TestHostCallInJit:
         for rel in ("pint_tpu/serving/aotcache.py",
                     "pint_tpu/serving/warmup.py",
                     "pint_tpu/serving/batcher.py",
-                    "pint_tpu/serving/service.py"):
+                    "pint_tpu/serving/service.py",
+                    "pint_tpu/serving/admission.py",
+                    "pint_tpu/serving/scheduler.py",
+                    "pint_tpu/serving/loadgen.py"):
             findings = eng.lint_file(os.path.join(REPO, rel))
             assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_traffic_submodules_tracked(self, tmp_path):
+        """The PR 16 traffic-engineering submodules (admission /
+        scheduler / loadgen) are host-side the same way the original
+        four are: a shed check or a scheduler quantum inside a traced
+        function would run per TRACE.  Bad twin fires per call, good
+        twin (host-side arbitration around the jit) is clean."""
+        from tools.jaxlint.engine import _SERVING_SUBMODULES
+
+        assert {"admission", "scheduler", "loadgen"} <= \
+            _SERVING_SUBMODULES
+        bad = (
+            "import jax\n"
+            "from pint_tpu.serving import admission\n"
+            "from pint_tpu.serving.scheduler import Scheduler\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    admission.AdmissionController().check('fit', 0)\n"
+            "    Scheduler().quantum('fit')\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+        good = (
+            "import jax\n"
+            "from pint_tpu.serving import admission\n"
+            "from pint_tpu.serving.scheduler import Scheduler\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * 2\n"
+            "def host(x):\n"
+            "    shed = admission.AdmissionController().check('fit', 0)\n"
+            "    Scheduler().note_dispatch('fit', 1)\n"
+            "    return f(x) if shed is None else shed\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
 
     def test_serving_in_typed_raise_targets(self, tmp_path):
         """pint_tpu/serving/ is a typed-raise target: a planted bare

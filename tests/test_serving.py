@@ -555,7 +555,13 @@ class TestService:
             np.testing.assert_allclose(res.dx, dx0, rtol=1e-9)
 
     def test_async_queue_bound(self):
+        """The bounded-queue contract after admission control: the
+        overflow request resolves with a typed ShedResponse (default)
+        or raises the old UsageError (strict=True) — and the admitted
+        batch-mate is never failed by the shed either way."""
         import asyncio
+
+        from pint_tpu.serving.admission import ShedResponse
 
         rng = np.random.default_rng(6)
         cfg = service.ServeConfig(ntoa_buckets=(64,), nfree_buckets=(8,),
@@ -565,12 +571,19 @@ class TestService:
         async def go():
             t1 = asyncio.ensure_future(svc.submit(_random_request(rng)))
             await asyncio.sleep(0)  # let the first request enqueue
+            shed = await svc.submit(_random_request(rng))
+            assert isinstance(shed, ShedResponse)
+            assert shed.request_class == "fit"
+            assert shed.reason == "queue_full"
+            assert shed.retry_after_ms > 0
+            # the strict escape hatch restores the exception contract
             with pytest.raises(UsageError):
-                await svc.submit(_random_request(rng))
+                await svc.submit(_random_request(rng), strict=True)
             return await t1
 
         res = asyncio.run(go())
         assert res.chi2 >= 0
+        assert svc.served == 1  # the shed never consumed a slot
 
     def test_config_validation(self):
         with pytest.raises(UsageError):

@@ -161,7 +161,8 @@ _TELEMETRY_SUBMODULES = {"spans", "metrics", "jaxevents", "runlog", "costs",
 #: get/put or a pool warm inside a traced function would run per TRACE
 #: (and hang the compile on cache I/O), so their calls are policed by
 #: the same host-call-in-jit machinery as the telemetry modules
-_SERVING_SUBMODULES = {"aotcache", "warmup", "batcher", "service"}
+_SERVING_SUBMODULES = {"aotcache", "warmup", "batcher", "service",
+                       "admission", "scheduler", "loadgen"}
 
 #: pint_tpu.autotune submodules are host-side the same way (manifest
 #: filesystem I/O, AOT lower/compile analyses, timed measured runs): a

@@ -122,6 +122,15 @@ class RunRecord:
     streaming_speedup_vs_refit: Optional[float] = None
     streaming_steady_compiles: Optional[int] = None
     streaming_error: Optional[str] = None      #: degraded streaming block
+    #: from the load{...} block (round 16+: traffic engineering)
+    load_fit_rps: Optional[float] = None
+    load_posterior_rps: Optional[float] = None
+    load_fit_p99_ms: Optional[float] = None
+    load_posterior_p99_ms: Optional[float] = None
+    load_shed_rate: Optional[float] = None
+    load_fairness: Optional[float] = None
+    load_steady_compiles: Optional[int] = None
+    load_error: Optional[str] = None           #: degraded load block
     #: from the precision{...} block (round 12+: mixed-precision layer)
     precision_mixed_fits_per_s: Optional[float] = None
     precision_max_rel_err: Optional[float] = None
@@ -295,6 +304,23 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
                 streaming["steady_state_compiles"]
         if isinstance(streaming.get("error"), str) and streaming["error"]:
             rec.streaming_error = streaming["error"]
+    load = h.get("load")
+    if isinstance(load, dict):
+        for src, dst in (("fit_rps", "load_fit_rps"),
+                         ("posterior_rps", "load_posterior_rps"),
+                         ("fit_p99_ms", "load_fit_p99_ms"),
+                         ("posterior_p99_ms", "load_posterior_p99_ms"),
+                         ("shed_rate", "load_shed_rate"),
+                         ("fairness", "load_fairness")):
+            if isinstance(load.get(src), (int, float)) \
+                    and not isinstance(load.get(src), bool):
+                setattr(rec, dst, float(load[src]))
+        if isinstance(load.get("steady_state_compiles"), int) \
+                and not isinstance(load.get("steady_state_compiles"),
+                                   bool):
+            rec.load_steady_compiles = load["steady_state_compiles"]
+        if isinstance(load.get("error"), str) and load["error"]:
+            rec.load_error = load["error"]
     # a zero-valued errored run (the bench's error-emit contract) is a
     # failed measurement, not a 100% regression
     if rec.error is not None and not rec.value:
@@ -537,6 +563,24 @@ def check_series(runs: List[RunRecord], threshold: float,
                    lambda r: r.streaming_update_p99_ms, -1, False),
                   ("streaming_speedup_vs_refit",
                    lambda r: r.streaming_speedup_vs_refit, +1, False),
+                  # traffic engineering (round 16+): per-class
+                  # sustained RPS under the overload mix gates drops,
+                  # per-class tail latency gates rises, the shed rate
+                  # gates rises WITH the zero-baseline opt-in (a
+                  # history that never shed must gate a newly shedding
+                  # service), and the Jain fairness index gates drops
+                  # (a fit flood newly starving posterior)
+                  ("load_fit_rps", lambda r: r.load_fit_rps, +1, False),
+                  ("load_posterior_rps",
+                   lambda r: r.load_posterior_rps, +1, False),
+                  ("load_fit_p99_ms",
+                   lambda r: r.load_fit_p99_ms, -1, False),
+                  ("load_posterior_p99_ms",
+                   lambda r: r.load_posterior_p99_ms, -1, False),
+                  ("load_shed_rate", lambda r: r.load_shed_rate, -1,
+                   True),
+                  ("load_fairness", lambda r: r.load_fairness, +1,
+                   False),
                   # mixed-precision layer (round 12+): policy-path
                   # throughput gates drops; max_rel_err gates rises WITH
                   # the zero-baseline opt-in — a bit-identical history
@@ -676,6 +720,18 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: streaming block degraded "
                    f"({latest_rec.streaming_error}) where prior runs "
                    "measured the streaming engine"))
+    # a degraded load block where prior rounds measured the service
+    # under contention is a regression, not a silent skip
+    if latest_rec.load_error is not None \
+            and any(r.load_fit_rps is not None for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="load", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: load block degraded "
+                   f"({latest_rec.load_error}) where prior runs "
+                   "measured the traffic-engineering harness"))
     # a degraded precision block where prior rounds measured the
     # mixed-precision layer is a regression, not a silent skip
     if latest_rec.precision_error is not None \
@@ -844,6 +900,16 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f"p99 {latest.streaming_update_p99_ms} ms, "
                   f"{latest.streaming_speedup_vs_refit}x refit, "
                   f"steady_compiles={latest.streaming_steady_compiles}",
+                  file=out)
+        if latest.load_fit_rps is not None \
+                or latest.load_posterior_rps is not None:
+            print(f"  load: fit {latest.load_fit_rps} rps "
+                  f"(p99 {latest.load_fit_p99_ms} ms), posterior "
+                  f"{latest.load_posterior_rps} rps "
+                  f"(p99 {latest.load_posterior_p99_ms} ms), "
+                  f"shed_rate={latest.load_shed_rate}, "
+                  f"fairness={latest.load_fairness}, "
+                  f"steady_compiles={latest.load_steady_compiles}",
                   file=out)
         if latest.cost:
             c = latest.cost

@@ -329,6 +329,129 @@ def validate_streaming_event(ev: dict, where: str,
                  "state its cause")
 
 
+#: traffic-engineering lifecycle events (pint_tpu/serving admission /
+#: scheduler / loadgen): one load_run per harness run, one
+#: request_shed per admission-control shed, one mesh_escalated per
+#: reverse-ladder rung escalation.  Same contract style as the other
+#: event families — a drift in the emitters fails --check before it
+#: corrupts the load series bench/perfwatch trend.
+LOAD_EVENT_ATTRS = {
+    "load_run": {"arrival": str, "duration_s": (int, float),
+                 "offered": int, "completed": int, "shed": int,
+                 "shed_rate": (int, float), "fairness": (int, float),
+                 "fit_rps": (int, float),
+                 "posterior_rps": (int, float),
+                 "update_rps": (int, float),
+                 "fit_p99_ms": (int, float),
+                 "posterior_p99_ms": (int, float),
+                 "update_p99_ms": (int, float)},
+    "request_shed": {"request_class": str, "reason": str,
+                     "retry_after_ms": (int, float),
+                     "queue_depth": int},
+    "mesh_escalated": {"from_rung": int, "to_rung": int,
+                       "reason": str, "workload": str,
+                       "n_healthy": int},
+}
+
+_LOAD_ARRIVALS = ("open", "closed")
+_SHED_CLASSES = ("posterior", "update", "fit")
+_SHED_REASONS = ("queue_depth", "latency", "queue_full")
+
+
+def validate_load_event(ev: dict, where: str,
+                        errors: List[str]) -> None:
+    """Attr contract for load_run / request_shed / mesh_escalated
+    records: required attrs typed; a load_run's arrival model in the
+    harness enum, its counts consistent (offered = completed + shed)
+    and non-negative, shed_rate and fairness in [0, 1]; a shed's class
+    and reason in the admission enums with a positive retry hint; an
+    escalation's rungs ordered (to > from >= 1) with a non-empty
+    reason."""
+    name = ev.get("name")
+    required = LOAD_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or (isinstance(v, bool)
+                                      and typ is not bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    def _num(key):
+        v = attrs.get(key)
+        return v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+    if name == "load_run":
+        if attrs.get("arrival") not in _LOAD_ARRIVALS:
+            _err(errors, where,
+                 f"load_run arrival {attrs.get('arrival')!r} not in "
+                 f"{_LOAD_ARRIVALS}")
+        for key in ("duration_s", "offered", "completed", "shed",
+                    "fit_rps", "posterior_rps", "update_rps",
+                    "fit_p99_ms", "posterior_p99_ms", "update_p99_ms"):
+            v = _num(key)
+            if v is not None and v < 0:
+                _err(errors, where,
+                     f"load_run {key!r} is negative ({v!r})")
+        offered, completed, shed = (_num("offered"), _num("completed"),
+                                    _num("shed"))
+        if None not in (offered, completed, shed) \
+                and offered != completed + shed:
+            _err(errors, where,
+                 f"load_run offered ({offered!r}) != completed "
+                 f"({completed!r}) + shed ({shed!r}) — a request "
+                 "must be served or shed, never lost")
+        for key in ("shed_rate", "fairness"):
+            v = _num(key)
+            if v is not None and not (0.0 <= v <= 1.0):
+                _err(errors, where,
+                     f"load_run {key!r} is {v!r}, must be in [0, 1]")
+    elif name == "request_shed":
+        if attrs.get("request_class") not in _SHED_CLASSES:
+            _err(errors, where,
+                 f"request_shed request_class "
+                 f"{attrs.get('request_class')!r} not in "
+                 f"{_SHED_CLASSES}")
+        if attrs.get("reason") not in _SHED_REASONS:
+            _err(errors, where,
+                 f"request_shed reason {attrs.get('reason')!r} not in "
+                 f"{_SHED_REASONS}")
+        retry = _num("retry_after_ms")
+        if retry is not None and retry <= 0:
+            _err(errors, where,
+                 f"request_shed retry_after_ms is {retry!r}, must be "
+                 "> 0 — a shed without a retry hint strands the "
+                 "caller")
+        depth = _num("queue_depth")
+        if depth is not None and depth < 0:
+            _err(errors, where,
+                 f"request_shed queue_depth is negative ({depth!r})")
+    elif name == "mesh_escalated":
+        frm, to = _num("from_rung"), _num("to_rung")
+        if frm is not None and frm < 1:
+            _err(errors, where,
+                 f"mesh_escalated from_rung is {frm!r}, must be >= 1")
+        if None not in (frm, to) and to <= frm:
+            _err(errors, where,
+                 f"mesh_escalated to_rung ({to!r}) must exceed "
+                 f"from_rung ({frm!r}) — an escalation goes UP the "
+                 "ladder")
+        reason = attrs.get("reason")
+        if isinstance(reason, str) and not reason.strip():
+            _err(errors, where,
+                 "mesh_escalated reason is empty — an escalation must "
+                 "state its cause")
+        nh = _num("n_healthy")
+        if nh is not None and nh < 1:
+            _err(errors, where,
+                 f"mesh_escalated n_healthy is {nh!r}, must be >= 1")
+
+
 #: catalog-engine lifecycle events (pint_tpu/catalog): one ingest
 #: summary per catalog (quarantined-row and excluded-pulsar counts)
 #: and one bucket-assignment summary (ladder + padding waste).  Same
@@ -911,6 +1034,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_precision_event(ev, where, errors)
                     validate_amortized_event(ev, where, errors)
                     validate_streaming_event(ev, where, errors)
+                    validate_load_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -1218,6 +1342,32 @@ def self_test(errors: List[str]) -> int:
                          reason="condition proxy 2.1e+14 past the "
                                 "1e+13 guard",
                          block=16, condition=2.1e14)
+        # traffic-engineering producer drift check: the load-harness
+        # event contract (LOAD_EVENT_ATTRS) — a healthy closed-loop
+        # run, its saturated open-loop twin (sheds > 0, balanced
+        # accounting), one shed per watermark reason, and a
+        # reverse-ladder escalation record
+        run.record_event("load_run", arrival="closed", duration_s=1.8,
+                         offered=64, completed=64, shed=0,
+                         shed_rate=0.0, fairness=1.0,
+                         fit_rps=28.4, posterior_rps=7.1,
+                         update_rps=0.0, fit_p99_ms=41.0,
+                         posterior_p99_ms=12.5, update_p99_ms=0.0)
+        run.record_event("load_run", arrival="open", duration_s=2.0,
+                         offered=256, completed=198, shed=58,
+                         shed_rate=58 / 256, fairness=0.92,
+                         fit_rps=70.0, posterior_rps=29.0,
+                         update_rps=0.0, fit_p99_ms=180.0,
+                         posterior_p99_ms=48.0, update_p99_ms=0.0)
+        run.record_event("request_shed", request_class="fit",
+                         reason="queue_depth", retry_after_ms=12.5,
+                         queue_depth=52)
+        run.record_event("request_shed", request_class="posterior",
+                         reason="queue_full", retry_after_ms=4.0,
+                         queue_depth=64)
+        run.record_event("mesh_escalated", from_rung=1, to_rung=2,
+                         reason="sustained_shedding",
+                         workload="gls_normal_eq", n_healthy=4)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
@@ -1225,9 +1375,10 @@ def self_test(errors: List[str]) -> int:
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
         # sharding_plan, 3x elastic events, 3x serving events, 2x
         # autotune events, 3x catalog events, 3x precision events,
-        # 4x amortized events, 3x streaming events, metrics, run_end
-        if n < 31:
-            _err(errors, "selftest", f"expected >= 31 records, got {n}")
+        # 4x amortized events, 3x streaming events, 5x load events,
+        # metrics, run_end
+        if n < 36:
+            _err(errors, "selftest", f"expected >= 36 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
