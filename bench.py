@@ -341,6 +341,23 @@ def bench_b1855_gls():
                 "error": f"{type(e).__name__}: {e}"}
     st.mark("load measurement")
 
+    # durability measurement (ROADMAP robustness item): crash
+    # mid-stream with the update journal live, recover a fresh
+    # service bitwise from the journal tail, then drill it under
+    # open-loop load with injected device loss.  Never fatal, same
+    # degraded-block discipline.
+    try:
+        recovery = recovery_block()
+    except Exception as e:
+        recovery = {"ops_journaled": None, "time_to_recover_s": None,
+                    "replay_ops_per_s": None, "bitwise_match": None,
+                    "rps_under_fault": None,
+                    "p99_under_fault_ms": None,
+                    "stranded_futures": None, "drill_recovery_s": None,
+                    "scenario": None,
+                    "error": f"{type(e).__name__}: {e}"}
+    st.mark("recovery measurement")
+
     imin = np.unravel_index(np.argmin(chi2), chi2.shape)
     # convergence-grade sanity, not just order-of-magnitude: the measured
     # grid-min-vs-fit gap is ~0.02 chi2 units (pure grid discretization);
@@ -371,6 +388,7 @@ def bench_b1855_gls():
         "scaling": scaling,
         "streaming": streaming,
         "load": load,
+        "recovery": recovery,
     }
 
 
@@ -860,6 +878,171 @@ def streaming_block():
         "block": bs,
         "ntoas_final": len(final),
     }
+
+
+#: durably journaled update ops before the simulated crash (appends
+#: interleaved with quarantine/release row ops — the replay must
+#: reconstruct the provenance, not just the factor)
+RECOVERY_BENCH_OPS = 8
+#: open-loop requests offered during the chaos drill under fault
+RECOVERY_BENCH_REQUESTS = 48
+#: offered rate during the drill: modest, so the run outlasts the
+#: breaker's open window (requests/rps must exceed the breaker's
+#: reset_s with real margin — at 48/100 the offered window is 0.48 s
+#: vs the 0.2 s reset, so completions resume UNDER fault even on a
+#: loaded machine instead of every request landing inside the open
+#: window and starving rps_under_fault)
+RECOVERY_BENCH_RPS = 100.0
+
+
+def recovery_block():
+    """The headline's ``recovery{}`` block: the durability measurement
+    — journal interleaved update ops (appends + quarantine/release)
+    through the :class:`~pint_tpu.serving.service.TimingService`
+    update door's write-ahead journal, crash mid-stream (the
+    ``crash_at_op`` seam tears the process between the factor apply
+    and the journal ack), then :meth:`~pint_tpu.serving.service.
+    TimingService.recover` a FRESH service from the journal and prove
+    the landing is **bitwise** (every ``state_dict`` array
+    ``array_equal`` against the pre-crash reference).  The recovered
+    service then takes a scripted chaos drill (``device_loss``) under
+    open-loop load with a drill-tuned circuit breaker: the block FAILS
+    (degraded twin) unless the replay landed bitwise, the drill
+    stranded zero futures, every shed was typed, and the service
+    returned to steady state.  ``tools/perfwatch.py`` gates
+    ``time_to_recover_s`` rises, ``replay_ops_per_s`` drops,
+    ``rps_under_fault`` drops, and nonzero ``stranded_futures``."""
+    import copy
+    import shutil
+    import tempfile
+
+    from pint_tpu.gls_fitter import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.runtime import chaos
+    from pint_tpu.runtime.faultinject import SimulatedCrash, crash_at_op
+    from pint_tpu.serving import ServeConfig, TimingService
+    from pint_tpu.serving.admission import BreakerConfig
+    from pint_tpu.serving.loadgen import ShapePopulation
+    from pint_tpu.streaming import UpdateRequest
+
+    n_ops = int(os.environ.get("BENCH_RECOVERY_OPS",
+                               str(RECOVERY_BENCH_OPS)))
+    n_requests = int(os.environ.get("BENCH_RECOVERY_REQUESTS",
+                                    str(RECOVERY_BENCH_REQUESTS)))
+    rps = float(os.environ.get("BENCH_RECOVERY_RPS",
+                               str(RECOVERY_BENCH_RPS)))
+    bs = 8
+    model = get_model([ln + "\n" for ln in STREAM_PAR.splitlines()])
+    rng = np.random.default_rng(20260806)
+    ntoa = 100 + (n_ops + 1) * bs
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    toas = make_fake_toas_uniform(
+        53400, 54800, ntoa, model, freq=np.array([800.0, 1400.0]),
+        error_us=1.0, add_noise=True, rng=rng)
+    base = toas[np.arange(100)]
+    blocks = [toas[np.arange(100 + bs * i, 100 + bs * (i + 1))]
+              for i in range(n_ops + 1)]
+
+    def fresh_service():
+        f = GLSFitter(base, copy.deepcopy(model))
+        f.fit_toas(maxiter=2)
+        svc = TimingService(ServeConfig(
+            ntoa_buckets=(64,), nfree_buckets=(8,),
+            batch_buckets=(1, 4, 16), draw_buckets=(32,),
+            window_ms=1.0,
+            breaker=BreakerConfig(failures=2, reset_s=0.2)))
+        svc.register_stream(f, block_sizes=[bs])
+        return svc
+
+    tmp = tempfile.mkdtemp(prefix="pint_tpu_recovery_bench_")
+    jdir = os.path.join(tmp, "journal")
+    try:
+        # phase 1: journal interleaved ops, then crash mid-stream —
+        # the LAST op's journal write dies between apply and ack, so
+        # the pre-crash reference is the state before it
+        svc = fresh_service()
+        svc.attach_journal(jdir)
+        for i in range(n_ops - 1):
+            reqs = [UpdateRequest(new_toas=copy.deepcopy(blocks[i]),
+                                  request_id=f"a{i}")]
+            if i == 1:
+                reqs.append(UpdateRequest(kind="quarantine",
+                                          block_id=0, rows=[0, 2],
+                                          request_id="q0"))
+            if i == 2:
+                reqs.append(UpdateRequest(kind="release", block_id=0,
+                                          rows=[2], request_id="r0"))
+            svc.serve_updates(reqs)
+        ref = {k: np.asarray(v)
+               for k, v in svc.stream.cache.state_dict().items()}
+        ops_journaled = int(svc.journal.ops_journaled)
+        try:
+            with crash_at_op(0):
+                svc.serve_updates([UpdateRequest(
+                    new_toas=copy.deepcopy(blocks[n_ops - 1]),
+                    request_id="crashed")])
+            raise RuntimeError("crash_at_op(0) never fired — the "
+                               "journal fault seam is dead")
+        except SimulatedCrash:
+            pass
+        svc.journal.close()
+
+        # phase 2: recover a FRESH service from the journal alone
+        # (full-tail replay — the honest replay_ops_per_s)
+        svc2 = fresh_service()
+        rep = svc2.recover(jdir)
+        got = {k: np.asarray(v)
+               for k, v in svc2.stream.cache.state_dict().items()}
+        bitwise = set(got) == set(ref) and all(
+            np.array_equal(ref[k], got[k], equal_nan=True)
+            for k in ref)
+        if not bitwise:
+            bad = [k for k in ref
+                   if k not in got
+                   or not np.array_equal(ref[k], got[k],
+                                         equal_nan=True)]
+            raise RuntimeError(
+                f"recovery landed off-bitwise on {bad[:4]} — the "
+                "journal replay is not crash-consistent")
+        t_rec = float(rep["time_to_recover_s"])
+        if t_rec <= 0 or rep["ops_replayed"] != ops_journaled:
+            raise RuntimeError(
+                f"recovery accounting degenerate: {rep} vs "
+                f"{ops_journaled} journaled ops")
+
+        # phase 3: the recovered service takes a chaos drill under
+        # open-loop load — the drill contract is the degraded-twin
+        # gate (zero stranded futures, typed sheds, steady state)
+        drill = chaos.run_drill(
+            svc2, "device_loss", rps=rps, n_requests=n_requests,
+            times=2, seed=14,
+            shapes=ShapePopulation.synthetic(n=4, seed=14),
+            recovery_timeout_s=20.0)
+        if not drill.contract_ok:
+            raise RuntimeError(
+                "chaos drill broke the contract: "
+                + "; ".join(drill.violations))
+        if drill.completed < 1:
+            raise RuntimeError(
+                "drill completed zero requests under fault — "
+                "rps_under_fault would be vacuous")
+        p99 = drill.per_class.get("fit", {}).get("p99_ms")
+        return {
+            "ops_journaled": ops_journaled,
+            "time_to_recover_s": round(t_rec, 4),
+            "replay_ops_per_s": round(rep["ops_replayed"] / t_rec, 3),
+            "bitwise_match": bool(bitwise),
+            "rps_under_fault": round(
+                drill.completed / drill.duration_s, 3),
+            "p99_under_fault_ms": round(float(p99), 3)
+                if p99 == p99 and p99 is not None else None,
+            "stranded_futures": int(drill.stranded),
+            "drill_recovery_s": round(float(drill.recovery_s), 4),
+            "scenario": "device_loss",
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 #: closed-loop calibration requests (fit:posterior 4:1) whose measured
@@ -1433,6 +1616,11 @@ def main():
         # gates per-class RPS drops, p99 rises, shed-rate rises, and
         # fairness drops)
         "load": r["load"],
+        # durability: crash mid-stream -> bitwise journal replay ->
+        # chaos drill under load (perfwatch gates time_to_recover_s
+        # rises, replay_ops_per_s / rps_under_fault drops, and nonzero
+        # stranded_futures)
+        "recovery": r["recovery"],
     }
     if not platform_ok:
         out["platform_mismatch"] = True

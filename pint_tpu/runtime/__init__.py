@@ -25,8 +25,14 @@ gracefully, or recover** — never silently emit wrong numbers:
   last checkpoint.
 * :mod:`pint_tpu.runtime.faultinject` — deterministic fault injection
   (NaN residuals, singular Grams, truncated files, device loss,
-  shard-level faults) used by ``tests/test_fault_injection.py`` and
-  ``tests/test_elastic.py`` to prove each guardrail fires.
+  shard-level faults, torn/corrupt journal records) used by
+  ``tests/test_fault_injection.py`` and ``tests/test_elastic.py`` to
+  prove each guardrail fires.
+* :mod:`pint_tpu.runtime.chaos` — seeded chaos drills: the scripted
+  fault scenarios injected into a live
+  :class:`~pint_tpu.serving.service.TimingService` under open-loop
+  load, asserting the drill contract (zero stranded futures, typed
+  sheds, bounded degradation, measured recovery to steady state).
 """
 
 from pint_tpu.runtime.preflight import (  # noqa: F401

@@ -1,0 +1,410 @@
+"""Seeded chaos drills: the fault taxonomy injected under live load.
+
+Every fault generator in :mod:`~pint_tpu.runtime.faultinject` has a
+guardrail test — but each one fires against a single call, never
+against a :class:`~pint_tpu.serving.service.TimingService` with
+coalescing windows, admission control, circuit breakers, and open-loop
+traffic all in flight at once.  This module is that drill: scripted
+scenarios injected at the service's dispatch seam while a seeded
+:class:`~pint_tpu.serving.loadgen.LoadGenerator` drives open-loop
+load, asserting the **drill contract**:
+
+1. every admitted request resolves — a result, a typed
+   :class:`~pint_tpu.serving.admission.ShedResponse`, or (for the
+   coalesced batch-mates of a fault-injected dispatch, before the
+   breaker opens) the dispatch's exception.  ZERO stranded futures;
+2. untyped failure stays bounded: once the door's circuit breaker
+   opens, submits resolve as ``ShedResponse(reason="circuit_open")``
+   data, so at most ``failures x quantum`` awaiters ever see the raw
+   exception;
+3. the service returns to steady state after the fault clears (the
+   breaker's half-open probe closes it), with the recovery time
+   measured;
+4. post-drill results still match a dedicated dense solve at 1e-9 —
+   the drill degraded availability, never correctness.
+
+Scenarios (:data:`SCENARIOS`) cover the taxonomy end-to-end: device
+loss mid-dispatch, a silently NaN-poisoning shard, a straggling
+dispatch, an XLA-shaped collective failure, a corrupted/cold AOT
+cache, a ``SimulatedCrash`` mid-coalesce, and a quarantine/release
+storm on the update door.  The torn-journal-tail and
+crash-at-every-op drills live with the recovery tests and the bench's
+``recovery{}`` block, composed from the same seams
+(:func:`~pint_tpu.runtime.faultinject.torn_tail` /
+``crash_at_op`` + :meth:`~pint_tpu.serving.service.TimingService.
+recover`).
+
+Each drill emits one schema-tagged ``chaos_drill`` telemetry event and
+returns a :class:`DrillReport`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.runtime.faultinject import (
+    SimulatedCrash,
+    SimulatedDeviceLoss,
+)
+
+__all__ = ["SCENARIOS", "DrillReport", "door_fault", "scenario_context",
+           "run_drill", "storm_update_factory", "dedicated_fit"]
+
+#: the scripted scenario registry: name -> what the fault models
+SCENARIOS = {
+    "device_loss": "the fit door's first k dispatches raise "
+                   "SimulatedDeviceLoss (a flaky accelerator tunnel)",
+    "nan_shard": "the first k dispatches return NaN-poisoned results "
+                 "(a silently corrupting chip)",
+    "straggler": "the first k dispatches stall (a wedged chip / "
+                 "stuck collective) so deadline budgets must fire",
+    "failed_collective": "the first k dispatches die with an "
+                         "XLA-shaped collective RuntimeError",
+    "corrupt_aot": "every warm-pool lookup misses (a corrupted AOT "
+                   "blob store falls back to fresh compiles)",
+    "crash_mid_coalesce": "the first k dispatches raise "
+                          "SimulatedCrash with coalesced batches in "
+                          "flight",
+    "quarantine_storm": "an update-heavy mix hammers the stream with "
+                        "alternating quarantine/release row ops",
+}
+
+#: post-drill correctness bar: served results vs a dedicated dense
+#: solve (the acceptance criterion's 1e-9)
+SPOT_CHECK_RTOL = 1e-9
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Drill-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# the dispatch-seam fault (the service-level twin of faultinject)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def door_fault(service, mode: str, times: int = 3,
+               delay_s: float = 0.0,
+               exc_factory: Optional[Callable] = None):
+    """Inject one failure mode into the fit door's dispatch for its
+    first ``times`` coalesced batches: ``raise`` (exc_factory()),
+    ``delay`` (sleep ``delay_s`` then dispatch), or ``nan`` (dispatch,
+    then NaN-poison every result).  Plain attribute patching with
+    restore-on-exit — the faultinject discipline at the
+    ``batcher.run`` seam every async fit flush crosses."""
+    if mode not in ("raise", "delay", "nan"):
+        raise UsageError(f"door_fault mode {mode!r} not in "
+                         "('raise', 'delay', 'nan')")
+    orig = service.batcher.run
+    state = {"calls": 0}
+    make = exc_factory or (lambda: SimulatedDeviceLoss(
+        "injected: device lost mid-dispatch"))
+
+    def faulted(requests):
+        if state["calls"] < times:
+            state["calls"] += 1
+            if mode == "raise":
+                raise make()  # jaxlint: disable=typed-raise -- factory parameter; defaults to a typed SimulatedDeviceLoss
+            if mode == "delay":
+                time.sleep(delay_s)
+                return orig(requests)
+            results = orig(requests)
+            for res in results:
+                res.dx = np.full_like(res.dx, np.nan)
+                res.errors = np.full_like(res.errors, np.nan)
+                res.chi2 = float("nan")
+            return results
+        return orig(requests)
+
+    service.batcher.run = faulted
+    try:
+        yield state
+    finally:
+        service.batcher.run = orig
+
+
+@contextlib.contextmanager
+def _cold_pool(service):
+    """Every warm-pool lookup misses for the duration — the observable
+    behavior of a corrupted AOT blob store (the loader drops a bad
+    blob and recompiles; correctness survives, compiles spike)."""
+    orig = service.pool.lookup
+
+    def miss(name, args):
+        return None
+
+    service.pool.lookup = miss
+    try:
+        yield
+    finally:
+        service.pool.lookup = orig
+
+
+def scenario_context(service, scenario: str, times: int = 3,
+                     delay_s: float = 0.3):
+    """The fault context manager for one named scenario (typed
+    refusal on an unknown name).  ``quarantine_storm`` is a traffic
+    shape, not a dispatch fault — its context is a no-op and the storm
+    rides in the drill's update-heavy mix."""
+    if scenario not in SCENARIOS:
+        raise UsageError(
+            f"unknown chaos scenario {scenario!r}; the registry has "
+            f"{sorted(SCENARIOS)}")
+    if scenario == "device_loss":
+        return door_fault(service, "raise", times=times)
+    if scenario == "nan_shard":
+        return door_fault(service, "nan", times=times)
+    if scenario == "straggler":
+        return door_fault(service, "delay", times=times,
+                          delay_s=delay_s)
+    if scenario == "failed_collective":
+        return door_fault(
+            service, "raise", times=times,
+            exc_factory=lambda: RuntimeError(  # jaxlint: disable=typed-raise -- XLA-shaped wording, the collective classifier's input
+                "injected: all-reduce collective failed mid-dispatch"))
+    if scenario == "crash_mid_coalesce":
+        return door_fault(
+            service, "raise", times=times,
+            exc_factory=lambda: SimulatedCrash(  # jaxlint: disable=typed-raise -- a simulated host death must evade typed handling
+                "injected: host died mid-coalesce"))
+    if scenario == "corrupt_aot":
+        return _cold_pool(service)
+    return contextlib.nullcontext({})
+
+
+def storm_update_factory(engine, block_id: Optional[int] = None,
+                         rows=(0,)) -> Callable:
+    """A zero-arg :class:`~pint_tpu.streaming.door.UpdateRequest`
+    factory alternating quarantine/release of the same rows — the
+    quarantine-storm traffic shape.  Alternation keeps every batch
+    valid under the door's simulated-alive pre-validation whatever the
+    coalescing cuts (a row is never quarantined twice without a
+    release between)."""
+    from pint_tpu.streaming.door import UpdateRequest
+
+    if block_id is None:
+        if not engine.cache.blocks:
+            raise UsageError(
+                "storm_update_factory needs a stream with >= 1 "
+                "ingested block (or an explicit block_id)")
+        block_id = int(engine.cache.blocks[0].block_id)
+    rows = [int(r) for r in rows]
+    state = {"n": 0}
+
+    def factory():
+        kind = "quarantine" if state["n"] % 2 == 0 else "release"
+        state["n"] += 1
+        return UpdateRequest(kind=kind, block_id=block_id, rows=rows,
+                             request_id=f"storm-{state['n'] - 1}")
+
+    return factory
+
+
+# ---------------------------------------------------------------------------
+# the drill
+# ---------------------------------------------------------------------------
+
+def dedicated_fit(req) -> np.ndarray:
+    """The dedicated reference for one fit request: a dense
+    prior-augmented normal-equation solve in plain numpy — no
+    batching, no padding, no warm pool — the independent answer the
+    drill contract's 1e-9 spot-check compares against."""
+    A = req.M.T @ (req.w[:, None] * req.M) + np.diag(req.phiinv)
+    b = req.M.T @ (req.w * req.r)
+    return np.linalg.solve(A, b)
+
+
+@dataclass
+class DrillReport:
+    """One chaos drill's outcome against the drill contract."""
+
+    scenario: str
+    offered: int = 0
+    completed: int = 0
+    shed: int = 0
+    errored: int = 0
+    stranded: int = 0
+    duration_s: float = 0.0
+    #: seconds from fault-clear to the first fully clean probe pass
+    #: (None: the service never returned to steady state)
+    recovery_s: Optional[float] = None
+    #: worst relative error of the post-drill spot-check
+    spot_check_rel_err: float = 0.0
+    #: per-door breaker state after the drill
+    breakers: Dict[str, dict] = field(default_factory=dict)
+    #: untyped-failure budget the drill graded ``errored`` against
+    errors_bound: int = 0
+    #: contract violations, empty when the drill passed
+    violations: List[str] = field(default_factory=list)
+    per_class: Dict[str, dict] = field(default_factory=dict)
+
+    @property
+    def contract_ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {"scenario": self.scenario, "offered": self.offered,
+                "completed": self.completed, "shed": self.shed,
+                "errored": self.errored, "stranded": self.stranded,
+                "duration_s": self.duration_s,
+                "recovery_s": self.recovery_s,
+                "spot_check_rel_err": self.spot_check_rel_err,
+                "errors_bound": self.errors_bound,
+                "breakers": self.breakers,
+                "contract_ok": self.contract_ok,
+                "violations": list(self.violations),
+                "per_class": self.per_class}
+
+
+def _steady_probe(service, shapes, mix, update_factory, seed: int,
+                  n: int = 4):
+    """One small closed-loop pass: fully clean (everything completed,
+    nothing shed or errored) means the service is back in steady
+    state."""
+    from pint_tpu.serving.loadgen import LoadConfig, LoadGenerator
+
+    cfg = LoadConfig(arrival="closed", concurrency=1, n_requests=n,
+                     mix=mix, seed=seed, tolerate_errors=True)
+    rep = LoadGenerator(service, cfg, shapes=shapes,
+                        update_factory=update_factory).run()
+    return rep.completed == rep.offered
+
+
+def run_drill(service, scenario: str, rps: float = 400.0,
+              n_requests: int = 64, times: int = 3,
+              delay_s: float = 0.3, seed: int = 0,
+              shapes=None, update_factory: Optional[Callable] = None,
+              spot_checks: int = 3,
+              recovery_timeout_s: float = 20.0,
+              drill_timeout_s: float = 120.0) -> DrillReport:
+    """Run one scripted chaos scenario against a LIVE service under
+    seeded open-loop load and grade the drill contract (module
+    docstring).  Returns the :class:`DrillReport`; the caller (test,
+    bench) asserts on ``contract_ok`` / ``violations``.
+
+    The service should be configured with a drill-friendly breaker
+    (small ``reset_s``) so recovery is measurable inside
+    ``recovery_timeout_s``."""
+    import asyncio
+
+    from pint_tpu.serving.loadgen import (
+        LoadConfig,
+        LoadGenerator,
+        ShapePopulation,
+    )
+
+    if scenario not in SCENARIOS:
+        raise UsageError(
+            f"unknown chaos scenario {scenario!r}; the registry has "
+            f"{sorted(SCENARIOS)}")
+    shapes = shapes or ShapePopulation.synthetic(n=4, seed=seed)
+    if scenario == "quarantine_storm":
+        if update_factory is None:
+            update_factory = storm_update_factory(
+                service._require_stream())
+        mix = {"update": 3.0, "fit": 1.0}
+    else:
+        mix = {"fit": 1.0}
+    cfg = LoadConfig(arrival="open", rps=rps, n_requests=n_requests,
+                     mix=mix, seed=seed, tolerate_errors=True)
+    gen = LoadGenerator(service, cfg, shapes=shapes,
+                        update_factory=update_factory)
+    report = DrillReport(scenario=scenario)
+    t0 = time.perf_counter()
+
+    async def _drive():
+        return await asyncio.wait_for(gen.run_async(),
+                                      timeout=drill_timeout_s)
+
+    timed_out = False
+    with scenario_context(service, scenario, times=times,
+                          delay_s=delay_s):
+        try:
+            load = asyncio.run(_drive())
+        except (TimeoutError, asyncio.TimeoutError):
+            # a hung drill IS the stranded-future failure mode the
+            # contract exists to catch
+            timed_out = True
+            load = None
+    t_clear = time.perf_counter()
+    report.duration_s = t_clear - t0
+    if timed_out:
+        report.stranded = -1
+        report.violations.append(
+            f"drill timed out after {drill_timeout_s}s — stranded "
+            "futures (awaiters never resolved)")
+    else:
+        report.offered = load.offered
+        report.completed = load.completed
+        report.shed = load.shed
+        report.errored = load.errored
+        report.stranded = load.stranded
+        report.per_class = load.per_class
+        if load.stranded != 0:
+            report.violations.append(
+                f"{load.stranded} stranded future(s): offered "
+                f"{load.offered} != completed {load.completed} + shed "
+                f"{load.shed} + errored {load.errored}")
+        # once the breaker opens, failure resolves as typed shed data;
+        # only the coalesced riders of the first `failures` sick
+        # dispatches (+ half-open probes) may see the raw exception
+        quantum = service.scheduler.quantum("fit")
+        brk = service._fit.breaker.cfg
+        report.errors_bound = (brk.failures + max(0, times)) * quantum
+        if report.errored > report.errors_bound:
+            report.violations.append(
+                f"untyped failure unbounded: {report.errored} errored "
+                f"awaiters > bound {report.errors_bound} (breaker "
+                "never contained the fault)")
+        # recovery: fault cleared — probe until one fully clean pass
+        while time.perf_counter() - t_clear < recovery_timeout_s:
+            if _steady_probe(service, shapes, mix, update_factory,
+                             seed=seed + 1):
+                report.recovery_s = time.perf_counter() - t_clear
+                break
+            time.sleep(0.02)
+        if report.recovery_s is None:
+            report.violations.append(
+                f"service did not return to steady state within "
+                f"{recovery_timeout_s}s of the fault clearing")
+        # post-drill correctness: served results vs the dedicated
+        # dense solve — the drill degraded availability, never answers
+        rel = 0.0
+        for i in range(int(spot_checks)):
+            req = gen._operands[i % len(shapes.shapes)]
+            res = service.serve([req])[0]
+            ref = dedicated_fit(req)
+            rel = max(rel, float(
+                np.max(np.abs(res.dx - ref)
+                       / np.maximum(np.abs(ref), 1e-300))))
+        report.spot_check_rel_err = rel
+        if not np.isfinite(rel) or rel > SPOT_CHECK_RTOL:
+            report.violations.append(
+                f"post-drill spot-check diverged: rel err {rel:.3e} "
+                f"> {SPOT_CHECK_RTOL:.0e} vs the dedicated solve")
+    report.breakers = service.breakers()
+    _emit_event("chaos_drill", scenario=scenario,
+                offered=int(report.offered),
+                completed=int(report.completed),
+                shed=int(report.shed),
+                errored=int(report.errored),
+                stranded=int(report.stranded),
+                duration_s=float(report.duration_s),
+                recovery_s=float(report.recovery_s
+                                 if report.recovery_s is not None
+                                 else -1.0),
+                contract_ok=bool(report.contract_ok))
+    return report

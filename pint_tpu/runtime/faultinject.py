@@ -40,6 +40,18 @@ Shard-level faults at the elastic supervisor's dispatch seam
 * :func:`sick_device` — the per-device preflight probe reports a chosen
   device unhealthy, so plan selection must exclude it from the mesh.
 
+Journal-write faults at the update journal's record seam
+(:func:`pint_tpu.serving.journal._write_record`):
+
+* :func:`torn_tail` — op-record writes inside the context land only a
+  byte prefix (a crash mid-``write(2)``), so recovery must drop the
+  torn trailing record with a typed ``journal_truncated`` event;
+* :func:`corrupt_record` — one byte of each op record's body is
+  flipped (bit rot / a bad sector), failing the crc frame;
+* :func:`crash_at_op` — the k-th op-record write inside the context
+  raises :class:`SimulatedCrash` BEFORE any byte lands, the
+  crash-at-every-op replay drill's seam.
+
 Everything is plain attribute patching with restore-on-exit; no fault
 leaks past its ``with`` block.
 """
@@ -59,7 +71,7 @@ __all__ = ["SimulatedDeviceLoss", "SimulatedCrash", "nan_residuals",
            "singular_gram", "truncated_copy", "garbled_copy", "device_loss",
            "crash_after_chunks", "flaky", "shard_device_loss", "shard_nan",
            "straggler", "failed_collective", "shard_crash_after_chunks",
-           "sick_device"]
+           "sick_device", "torn_tail", "corrupt_record", "crash_at_op"]
 
 
 class SimulatedDeviceLoss(DeviceLostError):
@@ -403,6 +415,98 @@ def sick_device(device_index: int):
     finally:
         pf._probe_one = orig
         pf.device_health(refresh=True)
+
+
+@contextlib.contextmanager
+def _patched_write_record(wrapper):
+    """Install ``wrapper(orig, fh, data) -> None`` at the update
+    journal's record-write seam, restore on exit."""
+    from pint_tpu.serving import journal as jn
+
+    orig = jn._write_record
+
+    def patched(fh, data):
+        return wrapper(orig, fh, data)
+
+    jn._write_record = patched
+    try:
+        yield
+    finally:
+        jn._write_record = orig
+
+
+def _is_header_record(data: bytes) -> bool:
+    """Journal header records are exempt from the op-record faults:
+    the drills target the ACK'd-op write path, and the compact
+    sort-keys JSON framing makes the header tag byte-stable."""
+    return b'"kind":"header"' in data
+
+
+@contextlib.contextmanager
+def torn_tail(fraction: float = 0.5):
+    """Every op-record write inside the context lands only its leading
+    ``fraction`` of bytes — the torn write a crash mid-``write(2)``
+    leaves.  Recovery must DROP the torn trailing record with a typed
+    ``journal_truncated`` event, never replay garbage.  Yields a state
+    dict counting torn writes."""
+    state = {"torn": 0}
+
+    def wrapper(orig, fh, data):
+        if _is_header_record(data):
+            return orig(fh, data)
+        state["torn"] += 1
+        return orig(fh, data[: max(1, int(len(data) * fraction))])
+
+    with _patched_write_record(wrapper):
+        yield state
+
+
+@contextlib.contextmanager
+def corrupt_record(flip_at: int = 12):
+    """Every op record written inside the context has one body byte
+    XOR-flipped (bit rot, a bad sector) — the newline survives, so the
+    frame LOOKS complete but fails its crc.  Yields a state dict
+    counting corrupted writes."""
+    state = {"corrupted": 0}
+
+    def wrapper(orig, fh, data):
+        if _is_header_record(data):
+            return orig(fh, data)
+        state["corrupted"] += 1
+        # flip inside the json body: past the "crc32-hex " prefix (9
+        # bytes) and before the trailing newline
+        i = min(9 + max(0, int(flip_at)), len(data) - 2)
+        return orig(fh, data[:i] + bytes([data[i] ^ 0x5A])
+                    + data[i + 1:])
+
+    with _patched_write_record(wrapper):
+        yield state
+
+
+@contextlib.contextmanager
+def crash_at_op(k: int):
+    """The ``k``-th op-record write inside the context (0-indexed)
+    raises :class:`SimulatedCrash` BEFORE any byte lands — the host
+    dies with ops ``0..k-1`` durable and op ``k`` never acknowledged.
+    Recovery from the journal must land bitwise on the uninterrupted
+    run's state after ``k`` ops.  Yields a state dict counting op
+    writes seen."""
+    state = {"ops": 0}
+
+    def wrapper(orig, fh, data):
+        if _is_header_record(data):
+            return orig(fh, data)
+        if state["ops"] >= k:
+            # deliberately NOT a PintError: a simulated process death
+            # must evade typed-error handling, exactly like a real
+            # crash would
+            raise SimulatedCrash(  # jaxlint: disable=typed-raise
+                f"injected: host died journaling op {state['ops']}")
+        state["ops"] += 1
+        return orig(fh, data)
+
+    with _patched_write_record(wrapper):
+        yield state
 
 
 @contextlib.contextmanager

@@ -28,13 +28,22 @@ AOT persistence"):
   admission.ShedResponse` sheds with hysteresis, priority / deadline /
   weighted-fair arbitration across the three doors plus
   reverse-ladder pressure escalation, and the seeded closed-loop load
-  harness that measures all of it under contention.
+  harness that measures all of it under contention;
+* :mod:`~pint_tpu.serving.journal` — durable service state (DESIGN.md
+  "Durability & chaos drills"): the update door's write-ahead journal
+  (checksummed schema-tagged records, segment rotation, torn-tail
+  detection) behind :meth:`~pint_tpu.serving.service.TimingService.
+  attach_journal` / ``snapshot`` / ``recover`` — crash-consistent,
+  bitwise recovery of the streaming factor state, with per-door
+  circuit breakers and request deadlines in
+  :mod:`~pint_tpu.serving.admission` / the service doors.
 """
 
 from pint_tpu.serving import (
     admission,
     aotcache,
     batcher,
+    journal,
     loadgen,
     scheduler,
     service,
@@ -43,8 +52,11 @@ from pint_tpu.serving import (
 from pint_tpu.serving.admission import (
     AdmissionConfig,
     AdmissionController,
+    BreakerConfig,
+    CircuitBreaker,
     ShedResponse,
 )
+from pint_tpu.serving.journal import UpdateJournal, scan_journal
 from pint_tpu.serving.aotcache import AOTCache, cache, device_fingerprint
 from pint_tpu.serving.batcher import FitRequest, FitResult, ShapeBatcher
 from pint_tpu.serving.loadgen import (
@@ -73,12 +85,14 @@ from pint_tpu.serving.warmup import (
 )
 
 __all__ = ["aotcache", "warmup", "batcher", "service",
-           "admission", "scheduler", "loadgen",
+           "admission", "scheduler", "loadgen", "journal",
            "AOTCache", "cache", "device_fingerprint",
            "FitRequest", "FitResult", "ShapeBatcher",
            "PosteriorRequest", "PosteriorResult",
            "ServeConfig", "TimingService",
+           "UpdateJournal", "scan_journal",
            "ShedResponse", "AdmissionConfig", "AdmissionController",
+           "BreakerConfig", "CircuitBreaker",
            "Scheduler", "SchedulerConfig", "PressureEscalator",
            "LoadConfig", "LoadGenerator", "LoadReport",
            "ShapePopulation",

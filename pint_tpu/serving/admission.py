@@ -38,16 +38,24 @@ from pint_tpu import config
 from pint_tpu.exceptions import UsageError
 
 __all__ = ["ShedResponse", "AdmissionConfig", "AdmissionController",
-           "REQUEST_CLASSES", "SHED_REASONS"]
+           "BreakerConfig", "CircuitBreaker", "REQUEST_CLASSES",
+           "SHED_REASONS", "BREAKER_STATES"]
 
 #: the service's request classes, in scheduler priority order
 #: (interactive posterior above streaming update above batch fit)
 REQUEST_CLASSES = ("posterior", "update", "fit")
 
 #: why a request was shed: coalescing-queue occupancy past the
-#: watermark, in-flight p99 past the latency watermark, or the
-#: bounded-queue hard cap itself
-SHED_REASONS = ("queue_depth", "latency", "queue_full")
+#: watermark, in-flight p99 past the latency watermark, the
+#: bounded-queue hard cap itself, an open per-door circuit breaker,
+#: or the request's class deadline budget expiring in the queue
+SHED_REASONS = ("queue_depth", "latency", "queue_full",
+                "circuit_open", "deadline")
+
+#: the circuit-breaker state machine: closed (healthy) -> open (N
+#: consecutive dispatch failures) -> half_open (reset window elapsed;
+#: one probe in flight) -> closed (probe succeeded) | open (failed)
+BREAKER_STATES = ("closed", "open", "half_open")
 
 
 def _emit_event(name: str, **attrs) -> None:
@@ -71,7 +79,7 @@ class ShedResponse:
     """
 
     request_class: str          #: fit | posterior | update
-    reason: str                 #: queue_depth | latency | queue_full
+    reason: str                 #: one of :data:`SHED_REASONS`
     retry_after_ms: float       #: hint: the door's window + drain time
     queue_depth: int = 0        #: occupancy at the shed decision
     request_id: Optional[str] = None
@@ -228,6 +236,27 @@ class AdmissionController:
         self._account(shed)
         return shed
 
+    def shed_now(self, request_class: str, reason: str,
+                 retry_after_ms: float, queue_depth: int = 0,
+                 request_id: Optional[str] = None) -> ShedResponse:
+        """Build, account, and return one shed decided OUTSIDE the
+        watermark machine (circuit breaker, deadline timeout) — the
+        same typed response, ``request_shed`` event, and per-class
+        counter, so every shed flows through one channel no matter
+        which guardrail decided it."""
+        st = self._state.get(request_class)
+        if st is None:
+            raise UsageError(
+                f"unknown request class {request_class!r}; the service "
+                f"classes are {REQUEST_CLASSES}")
+        st.sheds += 1
+        shed = ShedResponse(request_class=request_class, reason=reason,
+                            retry_after_ms=float(retry_after_ms),
+                            queue_depth=int(queue_depth),
+                            request_id=request_id)
+        self._account(shed)
+        return shed
+
     def _account(self, shed: ShedResponse) -> None:
         if config._telemetry_mode != "off":
             from pint_tpu.telemetry import metrics
@@ -261,3 +290,116 @@ class AdmissionController:
         return {k: {"shedding": s.shedding, "sheds": s.sheds,
                     "engages": s.engages, "disengages": s.disengages}
                 for k, s in self._state.items()}
+
+
+# ---------------------------------------------------------------------------
+# per-door circuit breakers
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BreakerConfig:
+    """One door's circuit-breaker policy.
+
+    ``failures`` consecutive dispatch failures open the breaker; while
+    open, submits resolve immediately as
+    ``ShedResponse(reason="circuit_open")`` — the admission channel,
+    never an exception through a coalescing window.  After ``reset_s``
+    the breaker goes half-open and admits ONE probe request; the
+    probe's outcome closes the breaker or re-opens it for another
+    ``reset_s``."""
+
+    #: consecutive dispatch failures that trip the breaker
+    failures: int = 5
+    #: seconds the breaker stays open before a half-open probe
+    reset_s: float = 5.0
+
+    def __post_init__(self):
+        if int(self.failures) < 1:
+            raise UsageError(
+                f"breaker failures must be >= 1, got {self.failures}")
+        if float(self.reset_s) <= 0:
+            raise UsageError(
+                f"breaker reset_s must be > 0, got {self.reset_s}")
+
+
+class CircuitBreaker:
+    """The closed -> open -> half_open state machine for one door.
+
+    :meth:`allow` is asked before every enqueue; :meth:`record_failure`
+    / :meth:`record_success` are fed one observation per DISPATCH (a
+    batch-level outcome, not per coalesced member — one sick dispatch
+    must count once however many requests rode it).  Every state
+    change emits a ``circuit_transition`` event and bumps the
+    per-door transition counter, so a flapping breaker is visible in
+    telemetry, not just in a failing drill."""
+
+    def __init__(self, klass: str, cfg: Optional[BreakerConfig] = None):
+        if klass not in REQUEST_CLASSES:
+            raise UsageError(
+                f"unknown request class {klass!r}; the service "
+                f"classes are {REQUEST_CLASSES}")
+        self.klass = klass
+        self.cfg = cfg or BreakerConfig()
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self.transitions = 0
+        self._opened_at = 0.0
+
+    def _transition(self, to_state: str) -> None:
+        from_state, self.state = self.state, to_state
+        self.transitions += 1
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.counter(
+                "pint_tpu_breaker_transitions_total",
+                "circuit-breaker state transitions per door").inc(
+                    labels={"class": self.klass, "to": to_state})
+        _emit_event("circuit_transition", door=self.klass,
+                    from_state=from_state, to_state=to_state,
+                    failures=int(self.consecutive_failures))
+
+    def allow(self) -> bool:
+        """May this request enqueue?  Closed: yes.  Open: no, until
+        ``reset_s`` elapses — then the breaker half-opens and admits
+        exactly ONE probe.  Half-open with the probe in flight: no."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if time.perf_counter() - self._opened_at >= self.cfg.reset_s:
+                self._transition("half_open")
+                return True
+            return False
+        # half_open: the single probe is already in flight
+        return False
+
+    def retry_after_ms(self) -> float:
+        """Hint for the shed response: the remaining open window."""
+        if self.state != "open":
+            return 1e3 * self.cfg.reset_s
+        remaining = self.cfg.reset_s - (time.perf_counter()
+                                        - self._opened_at)
+        return max(1.0, 1e3 * remaining)
+
+    def record_failure(self) -> None:
+        """One failed dispatch.  Trips the breaker at the threshold;
+        a failed half-open probe re-opens immediately (the service is
+        still sick — restart the reset clock)."""
+        self.consecutive_failures += 1
+        if self.state == "half_open" \
+                or (self.state == "closed"
+                    and self.consecutive_failures >= self.cfg.failures):
+            self._opened_at = time.perf_counter()
+            self._transition("open")
+
+    def record_success(self) -> None:
+        """One healthy dispatch: closes a half-open breaker, resets
+        the consecutive-failure count."""
+        self.consecutive_failures = 0
+        if self.state == "half_open":
+            self._transition("closed")
+
+    def to_dict(self) -> dict:
+        return {"state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "transitions": self.transitions}

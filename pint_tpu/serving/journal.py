@@ -1,0 +1,391 @@
+"""Write-ahead journal for the update door: durability before ack.
+
+The streaming engine (PR 15) acknowledges an appended TOA block the
+moment the rank-k update lands — in process memory.  A crash between
+:class:`~pint_tpu.runtime.checkpoint.SweepCheckpoint` snapshots
+silently loses every acknowledged update.  This module closes that
+window: every accepted ``append | quarantine | release`` operation is
+durably logged *before* the submit future resolves, so
+
+    acknowledged  =>  journaled  =>  recoverable.
+
+Layout (``<path>/`` is a directory)::
+
+    seg_000000.wal     checksummed JSON-line records, one per op
+    seg_000001.wal     ... (rotation at ``segment_bytes``)
+
+Every record is one line ``<crc32 hex8> <json body>\\n``; the body is
+schema-tagged (:data:`JOURNAL_SCHEMA`) and carries a monotonically
+increasing ``seq`` plus a ``gid`` (the first seq of its coalesced
+batch, so replay re-drives batches with the ORIGINAL coalescing — the
+append-merge discipline of :func:`~pint_tpu.streaming.door.
+run_update_requests` is part of the bitwise contract).  Each segment
+opens with a header record binding the journal to the stream's vkey
+(:func:`~pint_tpu.streaming.door.stream_vkey`): replaying a foreign
+journal into a different frame raises a typed
+:class:`~pint_tpu.exceptions.CheckpointError`, field by field.
+
+Torn tails are a crash artifact, not corruption: a truncated or
+checksum-failed FINAL record is dropped with a typed
+``journal_truncated`` telemetry event (the op was never acknowledged —
+its awaiter saw the crash, not a result), while a bad record anywhere
+ELSE raises :class:`~pint_tpu.exceptions.CheckpointError` — a garbage
+op is never replayed.
+
+The fsync policy is explicit: ``"always"`` (default) fsyncs once per
+commit — group commit, one fsync per coalesced batch, the durability
+the ack implies; ``"never"`` leaves flushing to the OS (a benchmark
+knob, not a production one).
+
+:func:`_write_record` is the fault-injection seam
+(:func:`~pint_tpu.runtime.faultinject.torn_tail` /
+``corrupt_record`` / ``crash_at_op`` patch it), mirroring
+``runtime.checkpoint._invoke``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import pickle
+import zlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from pint_tpu import config
+from pint_tpu.exceptions import CheckpointError, UsageError
+
+__all__ = ["UpdateJournal", "JournalScan", "scan_journal",
+           "JOURNAL_SCHEMA", "FSYNC_POLICIES"]
+
+#: schema tag every record body carries; bumping it invalidates every
+#: existing journal (the established vkey discipline, applied to disk)
+JOURNAL_SCHEMA = "pint-tpu-update-journal/1"
+
+#: when the journal fsyncs: once per commit (the durability the ack
+#: implies) or never (OS-buffered; a measurement knob only)
+FSYNC_POLICIES = ("always", "never")
+
+_SEGMENT_PREFIX = "seg_"
+_SEGMENT_SUFFIX = ".wal"
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Journal-lifecycle telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# record framing
+# ---------------------------------------------------------------------------
+
+def _encode_record(body: dict) -> bytes:
+    """One framed record: ``<crc32 hex8> <compact json>\\n`` — the crc
+    covers exactly the json bytes, so any bit flip in the body (or a
+    truncated write) fails the frame check on read."""
+    text = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    data = text.encode("utf-8")
+    return b"%08x " % zlib.crc32(data) + data + b"\n"
+
+
+def _decode_record(line: bytes) -> dict:
+    """Inverse of :func:`_encode_record`.  Raises ``CheckpointError``
+    on any frame violation (missing newline, bad crc, unparsable json,
+    wrong schema) — the CALLER decides whether the violation is a
+    droppable torn tail or fatal mid-journal corruption."""
+    if not line.endswith(b"\n"):
+        raise CheckpointError("record not newline-terminated "
+                              "(torn write)")
+    if len(line) < 10 or line[8:9] != b" ":
+        raise CheckpointError("record too short for a crc frame")
+    crc_hex, data = line[:8], line[9:-1]
+    try:
+        want = int(crc_hex, 16)
+    except ValueError as e:
+        raise CheckpointError(f"unparsable crc field {crc_hex!r}") from e
+    if zlib.crc32(data) != want:
+        raise CheckpointError(
+            f"crc mismatch (stored {crc_hex.decode()}, computed "
+            f"{zlib.crc32(data):08x})")
+    try:
+        body = json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CheckpointError(f"unparsable record body ({e})") from e
+    if body.get("schema") != JOURNAL_SCHEMA:
+        raise CheckpointError(
+            f"record schema {body.get('schema')!r} is not "
+            f"{JOURNAL_SCHEMA!r}")
+    return body
+
+
+#: fault-injection seam: every journal byte goes through here, so the
+#: harness can deterministically tear, garble, or crash a write
+#: without touching the journal logic (the checkpoint._invoke pattern)
+def _write_record(fh, data: bytes) -> None:
+    fh.write(data)
+
+
+def _encode_request(request) -> dict:
+    """The durable payload of one accepted op.  Appends carry the full
+    pickled TOA block (quarantine state and flags included — replay
+    re-drives the IDENTICAL container through the validate gate); row
+    ops carry block id + local rows."""
+    from pint_tpu.streaming.door import UpdateRequest
+
+    if not isinstance(request, UpdateRequest):
+        raise UsageError(
+            f"the update journal records UpdateRequest ops, got "
+            f"{type(request).__name__}")
+    body = {"kind": request.kind, "request_id": request.request_id}
+    if request.kind == "append":
+        body["toas"] = base64.b64encode(
+            pickle.dumps(request.new_toas)).decode("ascii")
+    else:
+        body["block_id"] = int(request.block_id)
+        body["rows"] = [int(i) for i in request.rows]
+    return body
+
+
+def decode_request(record: dict):
+    """Rebuild the :class:`~pint_tpu.streaming.door.UpdateRequest` one
+    journal record describes (the replay entry point)."""
+    from pint_tpu.streaming.door import UpdateRequest
+
+    if record["kind"] == "append":
+        return UpdateRequest(
+            new_toas=pickle.loads(
+                base64.b64decode(record["toas"].encode("ascii"))),
+            request_id=record.get("request_id"))
+    return UpdateRequest(kind=record["kind"],
+                         block_id=int(record["block_id"]),
+                         rows=[int(i) for i in record["rows"]],
+                         request_id=record.get("request_id"))
+
+
+# ---------------------------------------------------------------------------
+# scanning (recovery's read path)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class JournalScan:
+    """Everything recovery needs from one pass over a journal dir."""
+
+    #: the stream identity the header records carry (None: empty dir)
+    ident: Optional[List[str]] = None
+    #: decoded op records in seq order (headers excluded)
+    records: List[dict] = field(default_factory=list)
+    #: reason the trailing record was dropped (None: clean tail)
+    dropped: Optional[str] = None
+    #: segment files seen, in replay order
+    segments: List[str] = field(default_factory=list)
+
+    @property
+    def last_seq(self) -> int:
+        """Highest op seq on disk (-1 when the journal is empty)."""
+        return int(self.records[-1]["seq"]) if self.records else -1
+
+    def batches(self) -> List[List[dict]]:
+        """Op records grouped by ``gid`` — the original coalesced
+        batches, in order (replay re-drives each group through one
+        :func:`~pint_tpu.streaming.door.run_update_requests` pass)."""
+        out: List[List[dict]] = []
+        for rec in self.records:
+            if out and out[-1][0]["gid"] == rec["gid"]:
+                out[-1].append(rec)
+            else:
+                out.append([rec])
+        return out
+
+
+def _segment_files(path: str) -> List[str]:
+    names = [n for n in os.listdir(path)
+             if n.startswith(_SEGMENT_PREFIX)
+             and n.endswith(_SEGMENT_SUFFIX)]
+    return [os.path.join(path, n) for n in sorted(names)]
+
+
+def scan_journal(path: str) -> JournalScan:
+    """Read every record in ``path``, verifying frames, schema,
+    header identity, and seq contiguity.
+
+    A bad FINAL record (truncated write, failed crc — the signature a
+    crash mid-write leaves) is dropped with a typed
+    ``journal_truncated`` event; a bad record anywhere else raises
+    :class:`~pint_tpu.exceptions.CheckpointError` (that is corruption,
+    not a crash artifact, and a garbage op must never be replayed)."""
+    scan = JournalScan()
+    if not os.path.isdir(path):
+        return scan
+    scan.segments = _segment_files(path)
+    for si, seg in enumerate(scan.segments):
+        with open(seg, "rb") as f:
+            raw = f.read()
+        lines = raw.split(b"\n")
+        # split() leaves a trailing "" for a newline-terminated file;
+        # anything else is a torn final line
+        tail = lines.pop() if lines else b""
+        records = [ln + b"\n" for ln in lines]
+        if tail:
+            records.append(tail)
+        last_segment = si == len(scan.segments) - 1
+        for ri, line in enumerate(records):
+            last_record = last_segment and ri == len(records) - 1
+            try:
+                body = _decode_record(line)
+            except CheckpointError as e:
+                if last_record:
+                    scan.dropped = str(e)
+                    _emit_event("journal_truncated",
+                                segment=os.path.basename(seg),
+                                reason=str(e), dropped=1)
+                    break
+                raise CheckpointError(
+                    f"{seg}: record {ri} is corrupt mid-journal "
+                    f"({e}); a torn tail is recoverable, interior "
+                    "corruption is not — restore the journal from "
+                    "backup") from e
+            if body["kind"] == "header":
+                if ri != 0:
+                    raise CheckpointError(
+                        f"{seg}: header record at position {ri} "
+                        "(headers only open segments)")
+                ident = [str(x) for x in body["ident"]]
+                if scan.ident is None:
+                    scan.ident = ident
+                elif scan.ident != ident:
+                    raise CheckpointError(
+                        f"{seg}: segment identity {ident} does not "
+                        f"match the journal's {scan.ident} — segments "
+                        "from two streams are mixed in one directory")
+                continue
+            want = scan.records[-1]["seq"] + 1 if scan.records else 0
+            if int(body["seq"]) != want:
+                raise CheckpointError(
+                    f"{seg}: op seq {body['seq']} breaks contiguity "
+                    f"(expected {want}) — records are missing "
+                    "mid-journal")
+            scan.records.append(body)
+    return scan
+
+
+# ---------------------------------------------------------------------------
+# the journal itself (the write path)
+# ---------------------------------------------------------------------------
+
+class UpdateJournal:
+    """Append-only write-ahead journal for one stream (module
+    docstring).  Opening an existing directory scans it (torn tail
+    dropped, identity verified) and continues the seq chain in a FRESH
+    segment — a torn segment is never appended to."""
+
+    def __init__(self, path: str, ident: Sequence[str],
+                 fsync: str = "always",
+                 segment_bytes: int = 1 << 20):
+        if fsync not in FSYNC_POLICIES:
+            raise UsageError(
+                f"fsync policy {fsync!r} not in {FSYNC_POLICIES}")
+        if int(segment_bytes) < 256:
+            raise UsageError(
+                f"segment_bytes must be >= 256, got {segment_bytes}")
+        self.path = path
+        self.ident = [str(x) for x in ident]
+        if not self.ident:
+            raise UsageError("UpdateJournal needs a non-empty ident "
+                             "(the stream's vkey fields)")
+        self.fsync = fsync
+        self.segment_bytes = int(segment_bytes)
+        os.makedirs(path, exist_ok=True)
+        scan = scan_journal(path)
+        if scan.ident is not None and scan.ident != self.ident:
+            raise CheckpointError(
+                f"{path}: journal belongs to a different stream "
+                f"(identity {scan.ident} vs this stream's "
+                f"{self.ident}); refusing to append — recover or "
+                "delete it first")
+        self._next_seq = scan.last_seq + 1
+        self._segment_index = len(scan.segments)
+        self._fh = None
+        self._ops_journaled = 0
+
+    # -- segments -----------------------------------------------------------
+
+    def _segment_path(self, index: int) -> str:
+        return os.path.join(
+            self.path,
+            f"{_SEGMENT_PREFIX}{index:06d}{_SEGMENT_SUFFIX}")
+
+    def _open_segment(self) -> None:
+        seg = self._segment_path(self._segment_index)
+        self._segment_index += 1
+        self._fh = open(seg, "ab")
+        _write_record(self._fh, _encode_record(
+            {"schema": JOURNAL_SCHEMA, "kind": "header",
+             "ident": self.ident, "start_seq": self._next_seq}))
+
+    def _maybe_rotate(self) -> None:
+        if self._fh is None:
+            self._open_segment()
+        elif self._fh.tell() >= self.segment_bytes:
+            self._fh.close()
+            self._open_segment()
+
+    # -- the write path -----------------------------------------------------
+
+    def commit(self, requests: Sequence) -> Tuple[int, int]:
+        """Durably log one accepted coalesced batch: every op framed
+        and written, ONE flush/fsync for the whole group (group
+        commit), sharing a ``gid`` so replay reconstructs the batch.
+        Returns ``(first_seq, last_seq)``.  Must be called before the
+        batch's futures resolve — that ordering IS the WAL contract."""
+        if not requests:
+            raise UsageError("commit needs >= 1 accepted request")
+        self._maybe_rotate()
+        gid = self._next_seq
+        for req in requests:
+            body = _encode_request(req)
+            body.update(schema=JOURNAL_SCHEMA, seq=self._next_seq,
+                        gid=gid)
+            _write_record(self._fh, _encode_record(body))
+            self._next_seq += 1
+            self._ops_journaled += 1
+        self._fh.flush()
+        if self.fsync == "always":
+            os.fsync(self._fh.fileno())
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import metrics
+
+            metrics.counter(
+                "pint_tpu_journal_ops_total",
+                "update-door ops durably journaled").inc(len(requests))
+        return gid, self._next_seq - 1
+
+    @property
+    def next_seq(self) -> int:
+        """The seq the next journaled op will carry (also: ops on disk
+        when the journal was never torn)."""
+        return self._next_seq
+
+    @property
+    def ops_journaled(self) -> int:
+        """Ops THIS handle journaled (not the on-disk total)."""
+        return self._ops_journaled
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.flush()
+            if self.fsync == "always":
+                os.fsync(self._fh.fileno())
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "UpdateJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

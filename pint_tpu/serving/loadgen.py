@@ -142,6 +142,11 @@ class LoadConfig:
         default_factory=lambda: dict(DEFAULT_DEADLINES_MS))
     #: samples per posterior draw request
     posterior_draws: int = 32
+    #: count a request whose awaiter raises as ``errored`` instead of
+    #: aborting the run — the chaos-drill setting (a fault-injected
+    #: dispatch fails its coalesced batch; the drill contract needs
+    #: every OTHER request to keep flowing and the failure counted)
+    tolerate_errors: bool = False
 
     def __post_init__(self):
         if self.arrival not in ARRIVAL_MODELS:
@@ -173,6 +178,7 @@ class ClassStats:
     offered: int = 0
     completed: int = 0
     shed: int = 0
+    errored: int = 0
     latencies_ms: List[float] = field(default_factory=list)
 
     def summary(self, duration_s: float,
@@ -183,6 +189,7 @@ class ClassStats:
             "offered": self.offered,
             "completed": self.completed,
             "shed": self.shed,
+            "errored": self.errored,
             "rps": (self.completed / duration_s
                     if duration_s > 0 else 0.0),
             "p50_ms": _percentile(vals, 0.50),
@@ -214,6 +221,17 @@ class LoadReport:
         return sum(c["shed"] for c in self.per_class.values())
 
     @property
+    def errored(self) -> int:
+        return sum(c.get("errored", 0) for c in self.per_class.values())
+
+    @property
+    def stranded(self) -> int:
+        """Requests that neither completed, shed, nor errored — the
+        drill contract's witness (always 0 when every awaiter
+        resolved; nonzero means a future was stranded)."""
+        return self.offered - self.completed - self.shed - self.errored
+
+    @property
     def shed_rate(self) -> float:
         return self.shed / self.offered if self.offered else 0.0
 
@@ -238,6 +256,8 @@ class LoadReport:
                 "offered": self.offered,
                 "completed": self.completed,
                 "shed": self.shed,
+                "errored": self.errored,
+                "stranded": self.stranded,
                 "shed_rate": self.shed_rate,
                 "fairness": self.fairness,
                 "per_class": self.per_class}
@@ -335,12 +355,22 @@ class LoadGenerator:
         st = stats[klass]
         st.offered += 1
         t0 = time.perf_counter()
-        if klass == "fit":
-            res = await svc.submit(req)
-        elif klass == "posterior":
-            res = await svc.submit_posterior(req)
-        else:
-            res = await svc.submit_update(req)
+        try:
+            if klass == "fit":
+                res = await svc.submit(req)
+            elif klass == "posterior":
+                res = await svc.submit_posterior(req)
+            else:
+                res = await svc.submit_update(req)
+        except Exception:
+            # a fault-injected dispatch fails its whole coalesced
+            # batch; under tolerate_errors the harness counts the
+            # resolution (NOT a stranded future — the awaiter DID
+            # resolve) and keeps offering load
+            if not self.cfg.tolerate_errors:
+                raise
+            st.errored += 1
+            return
         if getattr(res, "shed", False):
             st.shed += 1
             return
@@ -394,6 +424,7 @@ class LoadGenerator:
                     offered=int(report.offered),
                     completed=int(report.completed),
                     shed=int(report.shed),
+                    errored=int(report.errored),
                     shed_rate=float(report.shed_rate),
                     fairness=float(report.fairness),
                     fit_rps=_num("fit", "rps"),

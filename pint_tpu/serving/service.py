@@ -27,6 +27,7 @@ is where the async door earns its keep.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -34,10 +35,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from pint_tpu import config
-from pint_tpu.exceptions import UsageError
+from pint_tpu.exceptions import CheckpointError, UsageError
 from pint_tpu.serving.admission import (
     AdmissionConfig,
     AdmissionController,
+    BreakerConfig,
+    CircuitBreaker,
     ShedResponse,
 )
 from pint_tpu.serving.batcher import (
@@ -81,6 +84,13 @@ class ServeConfig:
     #: cross-class arbitration policy (None: the default priority
     #: weights and deadline budgets)
     sched: Optional[SchedulerConfig] = None
+    #: per-door circuit-breaker policy (None: the defaults — 5
+    #: consecutive dispatch failures open, 5 s to half-open)
+    breaker: Optional[BreakerConfig] = None
+    #: resolve a request still unserved at its class deadline budget
+    #: as a typed ``ShedResponse(reason="deadline")`` instead of
+    #: leaving its awaiter hanging (False: the pre-durability behavior)
+    enforce_deadlines: bool = True
 
 
 @dataclass
@@ -179,6 +189,9 @@ class DoorStats:
         self.served = 0
         self.pending: List[tuple] = []
         self.flush_task = None
+        #: the door's circuit breaker (attached by the service — the
+        #: policy lives in ServeConfig, the state lives with the door)
+        self.breaker: Optional[CircuitBreaker] = None
 
     # -- latency ring -------------------------------------------------------
 
@@ -305,6 +318,13 @@ class TimingService:
             self.cfg.admission, max_queue=self.cfg.max_queue)
         self._sched = Scheduler(self.cfg.sched)
         self._escalator = None
+        # durability + robustness: per-door circuit breakers are
+        # always on (their default threshold only trips on sustained
+        # dispatch failure); the write-ahead journal is opt-in via
+        # attach_journal()
+        for door in (self._fit, self._post, self._upd):
+            door.breaker = CircuitBreaker(door.klass, self.cfg.breaker)
+        self._journal = None
 
     # -- warm-up ------------------------------------------------------------
 
@@ -417,10 +437,29 @@ class TimingService:
         import asyncio
 
         loop = asyncio.get_running_loop()
+        request_id = getattr(request, "request_id", None)
+        # an open breaker answers before the watermarks even look: the
+        # door's dispatch is known-sick, so the queue state is beside
+        # the point — resolve as the typed shed through the admission
+        # channel (never an exception through a coalescing window)
+        if not door.breaker.allow():
+            shed = self._admission.shed_now(
+                door.klass, "circuit_open",
+                retry_after_ms=door.breaker.retry_after_ms(),
+                queue_depth=len(door.pending), request_id=request_id)
+            if self._escalator is not None:
+                self._escalator.observe(True)
+            if strict:
+                raise UsageError(
+                    f"{what} circuit breaker is {door.breaker.state} "
+                    f"after {door.breaker.consecutive_failures} "
+                    "consecutive dispatch failures; retry after "
+                    f"{shed.retry_after_ms:.0f} ms")
+            return shed
         shed = self._admission.check(
             door.klass, len(door.pending), p99_ms=door.p99_ms,
             p50_ms=door.p50_ms, window_ms=self.cfg.window_ms,
-            request_id=getattr(request, "request_id", None))
+            request_id=request_id)
         if self._escalator is not None:
             self._escalator.observe(shed is not None)
         if shed is not None:
@@ -446,7 +485,30 @@ class TimingService:
                 door.flush_task = loop.create_task(
                     _sleep_then(0.0, flush))
                 self._sched.note_early_flush(door.klass)
-        return await fut
+        deadline_ms = self._sched.deadline_ms(door.klass) \
+            if self.cfg.enforce_deadlines else None
+        if deadline_ms is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut),
+                                          deadline_ms / 1e3)
+        except (TimeoutError, asyncio.TimeoutError):
+            # the class's deadline budget expired with the request
+            # still unserved: resolve THIS awaiter with the typed
+            # timeout shed instead of hanging it (py3.10 spells
+            # asyncio's timeout differently from the builtin — catch
+            # both).  Dequeue if still coalescing; cancel the future
+            # so an in-flight dispatch skips delivery and accounting
+            for i, entry in enumerate(door.pending):
+                if entry[1] is fut:
+                    del door.pending[i]
+                    door.gauge_queue_depth()
+                    break
+            if not fut.done():
+                fut.cancel()
+            return self._admission.shed_now(
+                door.klass, "deadline", retry_after_ms=deadline_ms,
+                queue_depth=len(door.pending), request_id=request_id)
 
     async def _drain_door(self, door: DoorStats, run, record,
                           what: str, flush) -> None:
@@ -467,29 +529,37 @@ class TimingService:
         if not batch:
             return
         self._sched.note_dispatch(door.klass, len(batch))
-        await self._flush_door(batch, run, record, what=what)
+        await self._flush_door(door, batch, run, record, what=what)
 
-    async def _flush_door(self, pending: List[tuple], run, record,
-                          what: str) -> None:
+    async def _flush_door(self, door: DoorStats, pending: List[tuple],
+                          run, record, what: str) -> None:
         """Flush core shared by both doors: run the coalesced batch,
         deliver BEFORE accounting (a telemetry/metrics failure in the
         record hook must degrade to a warning, never strand awaiters
         on futures that no one will ever resolve), and fail every
-        member's awaiter on a batch-level error."""
+        member's awaiter on a batch-level error.  The door's circuit
+        breaker is fed ONE outcome per dispatch — a sick batch counts
+        once however many requests rode it."""
         if not pending:
             return
         try:
             results = run([p[0] for p in pending])
         except Exception as e:
+            door.breaker.record_failure()
             for _, fut, _ in pending:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        door.breaker.record_success()
         now = time.perf_counter()
         for (req, fut, t0), res in zip(pending, results):
             res.latency_ms = 1e3 * (now - t0)
-            if not fut.done():
-                fut.set_result(res)
+            if fut.done():
+                # a deadline shed already resolved this awaiter — the
+                # request was accounted as shed, so delivering OR
+                # recording it here would double-count
+                continue
+            fut.set_result(res)
             try:
                 record(req, res, res.latency_ms)
             except Exception as e:
@@ -789,7 +859,16 @@ class TimingService:
     def _run_updates(self, requests):
         from pint_tpu.streaming.door import run_update_requests
 
-        return run_update_requests(self._require_stream(), requests)
+        out = run_update_requests(self._require_stream(), requests)
+        # the WAL ordering contract: the accepted batch is durably
+        # journaled BEFORE any member's future resolves (the flush
+        # core only delivers after this returns), so an acknowledged
+        # update is always recoverable.  A crash in the window between
+        # apply and journal loses only UNacknowledged ops — the
+        # awaiters saw the crash, not a result
+        if self._journal is not None:
+            self._journal.commit(requests)
+        return out
 
     def serve_updates(self, requests) -> list:
         """The synchronous update batch door: one coalescing pass
@@ -853,3 +932,187 @@ class TimingService:
     @property
     def updates_served(self) -> int:
         return self._upd.served
+
+    # -- durability: journal, snapshot, crash-consistent recovery ------------
+
+    @property
+    def journal(self):
+        return self._journal
+
+    def breakers(self) -> dict:
+        """Per-door circuit-breaker state (drill introspection)."""
+        return {d.klass: d.breaker.to_dict()
+                for d in (self._fit, self._post, self._upd)}
+
+    def attach_journal(self, path: str, fsync: str = "always",
+                       segment_bytes: int = 1 << 20):
+        """Open (or create) the write-ahead journal for the update
+        door at ``path``: from this call on, every accepted
+        ``append | quarantine | release`` op is durably logged before
+        its submit future resolves.  The journal is identity-bound to
+        the registered stream's vkey
+        (:func:`~pint_tpu.streaming.door.stream_vkey`) — opening a
+        different stream's journal raises the typed
+        :class:`~pint_tpu.exceptions.CheckpointError`.  Returns the
+        :class:`~pint_tpu.serving.journal.UpdateJournal`."""
+        from pint_tpu.serving.journal import UpdateJournal
+        from pint_tpu.streaming.door import stream_vkey
+
+        engine = self._require_stream()
+        self._journal = UpdateJournal(
+            path, [repr(x) for x in stream_vkey(engine)], fsync=fsync,
+            segment_bytes=segment_bytes)
+        return self._journal
+
+    def snapshot(self, path: str) -> int:
+        """Persist the stream's full factor/alive/provenance state as
+        a one-chunk :class:`~pint_tpu.runtime.checkpoint.
+        SweepCheckpoint` (the PR 15 payload discipline), with the
+        journal seq the snapshot covers in the informational sidecar —
+        recovery replays only the journal TAIL past it.  Returns that
+        seq (-1: nothing journaled yet)."""
+        from pint_tpu.runtime.checkpoint import (
+            SweepCheckpoint,
+            fingerprint_of,
+        )
+
+        engine = self._require_stream()
+        seq = self._journal.next_seq - 1 \
+            if self._journal is not None else -1
+        ckpt = SweepCheckpoint(
+            path, fingerprint_of(vkey=repr(engine.cache.vkey)), 1,
+            sidecar={"journal_seq": int(seq)})
+        payload = dict(engine.cache.state_dict())
+        payload["model_values"] = np.array(
+            [engine.cache.solution()[p]
+             for p in engine.cache.params if p != "Offset"])
+        ckpt.save(0, **payload)
+        return seq
+
+    def recover(self, journal_dir: str,
+                snapshot: Optional[str] = None,
+                fsync: str = "always") -> dict:
+        """Crash-consistent recovery: land bitwise on the pre-crash
+        factor/alive/provenance state from the snapshot plus the
+        journal tail, then reopen the journal for continued service.
+
+        The registered stream must be a FRESH engine rebuilt from the
+        same converged base fit the journal was attached to (its vkey
+        is how the journal recognizes it).  Recovery order:
+
+        1. scan the journal — a torn trailing record is dropped with a
+           typed ``journal_truncated`` event (that op was never
+           acknowledged); identity is verified against the stream's
+           vkey FIELD BY FIELD (foreign journal → typed
+           :class:`~pint_tpu.exceptions.CheckpointError`);
+        2. restore the snapshot (when given): factor state bitwise via
+           :meth:`~pint_tpu.streaming.cache.StreamCache.load_state`
+           (frame identity verified there), model parameter values,
+           and the TOA union + quarantine pen re-derived from the
+           journaled appends the snapshot covers — the
+           :func:`~pint_tpu.streaming.update.stream_updates` resume
+           discipline;
+        3. re-drive every journaled batch PAST the snapshot through
+           :func:`~pint_tpu.streaming.door.run_update_requests`, with
+           the original coalescing (the ``gid`` grouping) so the
+           append-merge order is identical.
+
+        Emits one ``journal_replay`` event and returns its report
+        dict (ops replayed, ops total, snapshot seq, time to
+        recover)."""
+        from pint_tpu.runtime.checkpoint import (
+            SweepCheckpoint,
+            fingerprint_of,
+        )
+        from pint_tpu.serving.journal import decode_request, scan_journal
+        from pint_tpu.streaming.door import (
+            run_update_requests,
+            stream_vkey,
+        )
+        from pint_tpu.toa import merge_TOAs
+
+        engine = self._require_stream()
+        t0 = time.perf_counter()
+        scan = scan_journal(journal_dir)
+        ident = [repr(x) for x in stream_vkey(engine)]
+        if scan.ident is not None and scan.ident != ident:
+            n = max(len(scan.ident), len(ident))
+            for i in range(n):
+                a = scan.ident[i] if i < len(scan.ident) else "<absent>"
+                b = ident[i] if i < len(ident) else "<absent>"
+                if a != b:
+                    raise CheckpointError(
+                        f"{journal_dir}: journal identity field {i} "
+                        f"is {a}; this stream's vkey field is {b} — "
+                        "the journal belongs to a different stream/"
+                        "frame; refusing to replay a foreign journal")
+        snap_seq = -1
+        if snapshot is not None and os.path.exists(
+                os.path.join(snapshot, "meta.json")):
+            # a foreign snapshot (different vkey) fails the
+            # fingerprint gate inside SweepCheckpoint — typed
+            ckpt = SweepCheckpoint(
+                snapshot,
+                fingerprint_of(vkey=repr(engine.cache.vkey)), 1)
+            if ckpt.has(0):
+                state = ckpt.load(0)
+                engine.cache.load_state(
+                    {k: np.asarray(v) for k, v in state.items()
+                     if k != "model_values"})
+                vals = np.asarray(state["model_values"])
+                for p, v in zip([p for p in engine.cache.params
+                                 if p != "Offset"], vals):
+                    getattr(engine.fitter.model, p).value = float(v)
+                snap_seq = int(ckpt.meta.get("sidecar", {})
+                               .get("journal_seq", -1))
+        batches = scan.batches()
+        if snap_seq >= 0:
+            # the factor state alone does not carry the TOA
+            # containers: re-derive the certified union and re-pen the
+            # quarantined rows from the journaled appends the snapshot
+            # covers, batch-merged exactly as the original coalescing
+            # merged them (one pen entry per batch, not per request)
+            union = engine.cache.toas
+            for batch in batches:
+                if batch[-1]["seq"] > snap_seq:
+                    continue
+                blocks = [decode_request(r).new_toas for r in batch
+                          if r["kind"] == "append"]
+                if not blocks:
+                    continue
+                block = blocks[0] if len(blocks) == 1 \
+                    else merge_TOAs(blocks)
+                rep = block.validate(policy="collect")
+                cert = block.certified()
+                if len(cert):
+                    union = merge_TOAs([union, cert])
+                if rep.n_quarantined:
+                    engine.pen[engine._next_pen_id] = (
+                        block.quarantined(),
+                        [r for r, q in zip(rep.reasons_by_row(),
+                                           rep.mask) if q])
+                    engine._next_pen_id += 1
+            engine.cache._toas = union
+            engine._sync_fitter_toas()
+        replayed = 0
+        for batch in batches:
+            if batch[0]["seq"] <= snap_seq:
+                continue
+            run_update_requests(
+                engine, [decode_request(r) for r in batch])
+            replayed += len(batch)
+        # reopen for continued service: the seq chain continues in a
+        # fresh segment (a torn segment is never appended to)
+        self.attach_journal(journal_dir, fsync=fsync)
+        dt = time.perf_counter() - t0
+        _emit_event("journal_replay",
+                    ops_replayed=int(replayed),
+                    ops_total=int(len(scan.records)),
+                    time_to_recover_s=float(dt),
+                    snapshot=bool(snap_seq >= 0),
+                    truncated=bool(scan.dropped is not None))
+        return {"ops_replayed": int(replayed),
+                "ops_total": int(len(scan.records)),
+                "snapshot_seq": int(snap_seq),
+                "time_to_recover_s": float(dt),
+                "truncated": scan.dropped}

@@ -216,6 +216,21 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert streaming["refit_p50_ms"] > 0
     assert streaming["speedup_vs_refit"] > 1.0
     assert streaming["steady_state_compiles"] == 0
+    # the recovery block (PR 17): journal -> crash -> recover ->
+    # drill-under-fault; never degraded on CPU, recovery must land
+    # bitwise and the drill must strand nothing
+    recovery = headline["recovery"]
+    for key in ("ops_journaled", "time_to_recover_s",
+                "replay_ops_per_s", "bitwise_match", "rps_under_fault",
+                "stranded_futures", "drill_recovery_s", "scenario"):
+        assert key in recovery, f"recovery block missing {key!r}"
+    assert "error" not in recovery, \
+        f"recovery measurement degraded: {recovery}"
+    assert recovery["ops_journaled"] > 0
+    assert recovery["time_to_recover_s"] > 0
+    assert recovery["replay_ops_per_s"] > 0
+    assert recovery["bitwise_match"] is True
+    assert recovery["stranded_futures"] == 0
     json.dumps(headline)
 
 

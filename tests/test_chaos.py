@@ -1,0 +1,680 @@
+"""Durability & chaos-drill tests (PR 17).
+
+Pins the load-bearing contracts of the durable-service layer:
+
+* **write-ahead journal** — every accepted ``append | quarantine |
+  release`` op is durably logged (checksummed, schema-tagged,
+  seq-contiguous records, identity-bound segments) before the submit
+  future resolves; a torn FINAL record is dropped with a typed
+  ``journal_truncated`` event, interior corruption / a foreign
+  journal refuse with the typed ``CheckpointError``;
+* **crash-consistent recovery** — ``SimulatedCrash`` at EVERY op
+  index: :meth:`~pint_tpu.serving.service.TimingService.recover`
+  lands **bitwise** (``array_equal`` on every ``state_dict`` array)
+  on the last-acknowledged pre-crash state, snapshot + tail-replay
+  reconstructs the quarantine pen, and a warm fit after recovery
+  matches the uncrashed run at 1e-9;
+* **circuit breakers & deadlines** — N consecutive dispatch failures
+  open the breaker (closed → open → half_open → closed, pinned
+  transition counts); submits resolve as typed
+  ``ShedResponse(reason="circuit_open")`` data, a request past its
+  class deadline budget resolves as ``reason="deadline"`` instead of
+  hanging its awaiter;
+* **the drill contract** — every scripted chaos scenario under
+  open-loop load resolves every admitted request (ZERO stranded
+  futures), bounds untyped failure, returns to steady state, and
+  leaves served results matching a dedicated dense solve at 1e-9;
+* **event contracts** — ``journal_replay`` / ``journal_truncated`` /
+  ``circuit_transition`` / ``chaos_drill`` records validate through
+  ``telemetry_report --check`` and malformed twins are rejected.
+"""
+
+import asyncio
+import copy
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from pint_tpu.exceptions import CheckpointError, UsageError  # noqa: E402
+from pint_tpu.runtime import chaos  # noqa: E402
+from pint_tpu.runtime.faultinject import (  # noqa: E402
+    SimulatedCrash,
+    corrupt_record,
+    crash_at_op,
+    torn_tail,
+)
+from pint_tpu.serving import service  # noqa: E402
+from pint_tpu.serving.admission import (  # noqa: E402
+    BreakerConfig,
+    CircuitBreaker,
+    SHED_REASONS,
+    ShedResponse,
+)
+from pint_tpu.serving.batcher import FitRequest  # noqa: E402
+from pint_tpu.serving.journal import (  # noqa: E402
+    UpdateJournal,
+    decode_request,
+    scan_journal,
+)
+from pint_tpu.serving.scheduler import SchedulerConfig  # noqa: E402
+from pint_tpu.streaming.door import UpdateRequest  # noqa: E402
+
+STREAM_PAR = """PSR J9999+9999
+RAJ 9:59:59.0
+DECJ 9:59:59.0
+F0 300.0 1 0.0
+F1 -1e-14 1 0.0
+PEPOCH 54000
+POSEPOCH 54000
+DM 2.64
+EFAC mjd 50000 60000 1.1
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 5
+TNREDTSPAN 6.0
+UNITS TDB
+"""
+
+N_TOAS = 140
+N_BASE = 100
+BLOCK = 8
+N_BLOCKS = 5
+
+
+@pytest.fixture(scope="module")
+def workload():
+    """(model, full toas, base slice, append blocks) — read-only;
+    tests that mutate TOAs deep-copy what they touch."""
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    model = get_model([ln + "\n" for ln in STREAM_PAR.splitlines()])
+    rng = np.random.default_rng(7)
+    toas = make_fake_toas_uniform(
+        53400, 54800, N_TOAS, model, freq=np.array([800.0, 1400.0]),
+        error_us=1.0, add_noise=True, rng=rng)
+    base = toas[np.arange(N_BASE)]
+    blocks = [toas[np.arange(N_BASE + BLOCK * i, N_BASE + BLOCK * (i + 1))]
+              for i in range(N_BLOCKS)]
+    return model, toas, base, blocks
+
+
+def _fresh_service(workload, **cfg):
+    """A TimingService over a FRESH engine from the same converged
+    base fit — the recovery precondition."""
+    from pint_tpu.gls_fitter import GLSFitter
+
+    model, _, base, _ = workload
+    f = GLSFitter(base, copy.deepcopy(model))
+    f.fit_toas(maxiter=2)
+    svc = service.TimingService(service.ServeConfig(**cfg)) \
+        if cfg else service.TimingService()
+    svc.register_stream(f, warm=False)
+    return svc
+
+
+#: the acceptance-pin op script: >= 5 epoch blocks interleaved with
+#: quarantine/release row ops, one op per journal record
+def _op_script(blocks):
+    return [
+        UpdateRequest(new_toas=copy.deepcopy(blocks[0]),
+                      request_id="a0"),
+        UpdateRequest(kind="quarantine", block_id=0, rows=[0, 2],
+                      request_id="q0"),
+        UpdateRequest(new_toas=copy.deepcopy(blocks[1]),
+                      request_id="a1"),
+        UpdateRequest(kind="release", block_id=0, rows=[2],
+                      request_id="r0"),
+        UpdateRequest(new_toas=copy.deepcopy(blocks[2]),
+                      request_id="a2"),
+        UpdateRequest(kind="quarantine", block_id=1, rows=[1],
+                      request_id="q1"),
+        UpdateRequest(new_toas=copy.deepcopy(blocks[3]),
+                      request_id="a3"),
+        UpdateRequest(new_toas=copy.deepcopy(blocks[4]),
+                      request_id="a4"),
+    ]
+
+
+def _state_of(svc):
+    return {k: np.asarray(v).copy()
+            for k, v in svc.stream.cache.state_dict().items()}
+
+
+def _assert_bitwise(ref, got, what=""):
+    assert set(ref) == set(got), (what, set(ref) ^ set(got))
+    for k in ref:
+        assert ref[k].dtype == got[k].dtype \
+            and ref[k].shape == got[k].shape \
+            and np.array_equal(ref[k], got[k], equal_nan=True), \
+            f"{what}: state array {k!r} differs"
+
+
+# ---------------------------------------------------------------------------
+# the load-harness stub service (drill + breaker/deadline tests)
+# ---------------------------------------------------------------------------
+
+def _fit_request(rng, n=48, k=6, request_id=None):
+    M = rng.standard_normal((n, k))
+    r = 1e-6 * rng.standard_normal(n)
+    w = 1.0 / (1e-12 + 1e-13 * rng.random(n))
+    return FitRequest(M=M, r=r, w=w, phiinv=np.zeros(k),
+                      request_id=request_id)
+
+
+def _stub_service(**over):
+    cfg = dict(ntoa_buckets=(64,), nfree_buckets=(8,),
+               batch_buckets=(1, 4, 16), draw_buckets=(32,),
+               window_ms=1.0, max_queue=256,
+               breaker=BreakerConfig(failures=2, reset_s=0.2))
+    cfg.update(over)
+    return service.TimingService(service.ServeConfig(**cfg))
+
+
+# ---------------------------------------------------------------------------
+# write-ahead journal
+# ---------------------------------------------------------------------------
+
+class TestJournal:
+    def _requests(self):
+        return [UpdateRequest(kind="quarantine", block_id=0, rows=[0],
+                              request_id="q"),
+                UpdateRequest(kind="release", block_id=0, rows=[0],
+                              request_id="r")]
+
+    def test_commit_scan_round_trip(self, tmp_path):
+        path = str(tmp_path / "j")
+        with UpdateJournal(path, ["vk0", "vk1"]) as j:
+            gid, last = j.commit(self._requests())
+            assert (gid, last) == (0, 1)
+            gid2, last2 = j.commit([self._requests()[0]])
+            assert (gid2, last2) == (2, 2)
+            assert j.ops_journaled == 3 and j.next_seq == 3
+        scan = scan_journal(path)
+        assert scan.ident == ["vk0", "vk1"]
+        assert scan.dropped is None and scan.last_seq == 2
+        batches = scan.batches()
+        assert [len(b) for b in batches] == [2, 1]
+        req = decode_request(batches[0][0])
+        assert req.kind == "quarantine" and req.rows == [0] \
+            and req.request_id == "q"
+
+    def test_reopen_continues_seq_in_fresh_segment(self, tmp_path):
+        path = str(tmp_path / "j")
+        with UpdateJournal(path, ["vk"]) as j:
+            j.commit([self._requests()[0]])
+        with UpdateJournal(path, ["vk"]) as j2:
+            assert j2.next_seq == 1
+            j2.commit([self._requests()[1]])
+        scan = scan_journal(path)
+        assert scan.last_seq == 1 and len(scan.segments) == 2
+
+    def test_torn_tail_dropped(self, tmp_path):
+        path = str(tmp_path / "j")
+        with UpdateJournal(path, ["vk"]) as j:
+            j.commit([self._requests()[0]])
+            with torn_tail() as state:
+                j.commit([self._requests()[1]])
+        assert state["torn"] == 1
+        scan = scan_journal(path)
+        # the torn FINAL record is dropped, never replayed as garbage
+        assert scan.dropped is not None
+        assert len(scan.records) == 1 and scan.last_seq == 0
+
+    def test_interior_corruption_refused(self, tmp_path):
+        path = str(tmp_path / "j")
+        with UpdateJournal(path, ["vk"]) as j:
+            with corrupt_record():
+                j.commit([self._requests()[0]])
+            j.commit([self._requests()[1]])
+        with pytest.raises(CheckpointError):
+            scan_journal(path)
+
+    def test_crash_at_op_leaves_clean_prefix(self, tmp_path):
+        path = str(tmp_path / "j")
+        j = UpdateJournal(path, ["vk"])
+        j.commit([self._requests()[0]])
+        with pytest.raises(SimulatedCrash):
+            with crash_at_op(1):
+                j.commit(self._requests())
+        # group commit: the crashed batch flushed NOTHING (its futures
+        # never resolved, so losing it whole is the WAL contract) and
+        # the durable prefix scans as a VALID journal
+        scan = scan_journal(path)
+        assert scan.dropped is None and scan.last_seq == 0
+        # a fresh handle (the restarted process) resumes cleanly
+        with UpdateJournal(path, ["vk"]) as j2:
+            assert j2.next_seq == 1
+            j2.commit([self._requests()[1]])
+        assert scan_journal(path).last_seq == 1
+
+    def test_foreign_ident_refused(self, tmp_path):
+        path = str(tmp_path / "j")
+        with UpdateJournal(path, ["vk-a"]) as j:
+            j.commit([self._requests()[0]])
+        with pytest.raises(CheckpointError):
+            UpdateJournal(path, ["vk-b"])
+
+    def test_config_validation_typed(self, tmp_path):
+        path = str(tmp_path / "j")
+        with pytest.raises(UsageError):
+            UpdateJournal(path, ["vk"], fsync="sometimes")
+        with pytest.raises(UsageError):
+            UpdateJournal(path, ["vk"], segment_bytes=16)
+        with pytest.raises(UsageError):
+            UpdateJournal(path, [])
+        with UpdateJournal(path, ["vk"]) as j:
+            with pytest.raises(UsageError):
+                j.commit(["not a request"])
+
+    def test_segment_rotation_preserves_scan(self, tmp_path):
+        path = str(tmp_path / "j")
+        with UpdateJournal(path, ["vk"], segment_bytes=256) as j:
+            for i in range(6):
+                j.commit([UpdateRequest(kind="quarantine", block_id=0,
+                                        rows=[i], request_id=f"q{i}")])
+        scan = scan_journal(path)
+        assert len(scan.segments) > 1
+        assert scan.last_seq == 5 and scan.dropped is None
+        assert [r["seq"] for r in scan.records] == list(range(6))
+
+
+# ---------------------------------------------------------------------------
+# circuit breakers & deadlines
+# ---------------------------------------------------------------------------
+
+class TestBreaker:
+    def test_state_machine_transition_counts(self):
+        b = CircuitBreaker("fit", BreakerConfig(failures=3,
+                                                reset_s=0.05))
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed"          # under threshold
+        b.record_failure()
+        assert b.state == "open" and b.transitions == 1
+        assert not b.allow()                # open refuses
+        time.sleep(0.06)
+        assert b.allow()                    # reset elapsed: half-open
+        assert b.state == "half_open" and b.transitions == 2
+        assert not b.allow()                # ONE probe only
+        b.record_success()
+        assert b.state == "closed" and b.transitions == 3
+        # a half-open probe failure re-opens instantly
+        for _ in range(3):
+            b.record_failure()
+        time.sleep(0.06)
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "open"
+
+    def test_config_validation_typed(self):
+        with pytest.raises(UsageError):
+            BreakerConfig(failures=0)
+        with pytest.raises(UsageError):
+            BreakerConfig(reset_s=0.0)
+        with pytest.raises(UsageError):
+            CircuitBreaker("grid")
+
+    def test_open_breaker_sheds_typed(self):
+        """After `failures` consecutive dispatch failures the door
+        answers with ShedResponse(reason='circuit_open') DATA — never
+        an exception through a coalescing window."""
+        rng = np.random.default_rng(0)
+        svc = _stub_service()
+
+        async def go():
+            out = []
+            with chaos.door_fault(svc, "raise", times=10):
+                for i in range(4):
+                    try:
+                        out.append(await svc.submit(
+                            _fit_request(rng, request_id=f"r{i}")))
+                    except Exception as exc:   # pre-trip: typed raise
+                        out.append(exc)
+            return out
+
+        results = asyncio.run(go())
+        raised = [r for r in results if isinstance(r, Exception)]
+        # the first `failures` submits raise the injected typed fault
+        assert 0 < len(raised) <= 2
+        sheds = [r for r in results if isinstance(r, ShedResponse)]
+        # breaker trips after 2 failures; later submits shed typed
+        assert sheds and all(s.reason == "circuit_open" for s in sheds)
+        assert all(s.retry_after_ms > 0 for s in sheds)
+        assert svc.breakers()["fit"]["state"] == "open"
+
+    def test_half_open_probe_recloses(self):
+        rng = np.random.default_rng(1)
+        svc = _stub_service()
+
+        async def go():
+            with chaos.door_fault(svc, "raise", times=2):
+                for i in range(2):
+                    try:
+                        await svc.submit(_fit_request(rng))
+                    except Exception:
+                        pass
+            assert svc.breakers()["fit"]["state"] == "open"
+            await asyncio.sleep(0.25)      # past reset_s
+            res = await svc.submit(_fit_request(rng))
+            return res
+
+        res = asyncio.run(go())
+        assert not isinstance(res, ShedResponse)
+        assert svc.breakers()["fit"]["state"] == "closed"
+        assert svc.breakers()["fit"]["transitions"] == 3
+
+    def test_deadline_timeout_sheds_typed(self):
+        """A request still coalescing past its class deadline budget
+        resolves as ShedResponse(reason='deadline') instead of hanging
+        its awaiter on the window."""
+        rng = np.random.default_rng(2)
+        svc = _stub_service(
+            window_ms=5000.0,
+            sched=SchedulerConfig(deadlines_ms={"fit": 40.0}))
+
+        async def go():
+            t0 = time.perf_counter()
+            res = await svc.submit(_fit_request(rng, request_id="late"))
+            return res, time.perf_counter() - t0
+
+        res, dt = asyncio.run(go())
+        assert isinstance(res, ShedResponse)
+        assert res.reason == "deadline" and res.request_id == "late"
+        assert dt < 2.0                    # never waited out the window
+        assert "deadline" in SHED_REASONS and "circuit_open" in SHED_REASONS
+
+    def test_deadlines_opt_out(self):
+        rng = np.random.default_rng(3)
+        svc = _stub_service(
+            window_ms=60.0, enforce_deadlines=False,
+            sched=SchedulerConfig(deadlines_ms={"fit": 1.0}))
+
+        async def go():
+            return await svc.submit(_fit_request(rng))
+
+        res = asyncio.run(go())
+        assert not isinstance(res, ShedResponse)
+
+
+# ---------------------------------------------------------------------------
+# crash-consistent recovery
+# ---------------------------------------------------------------------------
+
+class TestRecovery:
+    def _journal_full_run(self, workload, jdir):
+        """Apply + journal the full op script, capturing the reference
+        state after every op; returns (ref_states, svc)."""
+        svc = _fresh_service(workload)
+        svc.attach_journal(jdir)
+        refs = []
+        for op in _op_script(workload[3]):
+            svc.serve_updates([op])
+            refs.append(_state_of(svc))
+        return refs, svc
+
+    def _truncate_journal(self, src, dst, k):
+        """A copy of the single-segment journal holding only the first
+        k op records — the on-disk state after a crash at op index k."""
+        os.makedirs(dst, exist_ok=True)
+        seg = sorted(os.listdir(src))[0]
+        with open(os.path.join(src, seg), "rb") as fh:
+            lines = fh.readlines()
+        with open(os.path.join(dst, seg), "wb") as fh:
+            fh.writelines(lines[:1 + k])   # header + k ops
+
+    def test_crash_at_every_op_lands_bitwise(self, workload, tmp_path):
+        """The acceptance pin: for EVERY op index k, recovery from the
+        journal's first k ops lands bitwise on the state after op k-1
+        — and at the full prefix, a warm fit on the recovered engine
+        matches the uncrashed engine at 1e-9."""
+        jdir = str(tmp_path / "journal")
+        refs, svc_ref = self._journal_full_run(workload, jdir)
+        svc_ref.journal.close()
+        n_ops = len(refs)
+        for k in range(1, n_ops + 1):
+            jcut = str(tmp_path / f"cut{k}")
+            self._truncate_journal(jdir, jcut, k)
+            svc = _fresh_service(workload)
+            rep = svc.recover(jcut)
+            assert rep["ops_replayed"] == k and rep["truncated"] is None
+            _assert_bitwise(refs[k - 1], _state_of(svc), what=f"k={k}")
+        # warm-fit agreement on the full prefix (svc still recovered
+        # state == refs[-1]): one more identical append on both
+        _, toas, _, _ = workload
+        probe = toas[np.arange(0, 6)]
+        o_ref = svc_ref.stream.update_toas(copy.deepcopy(probe))
+        o_rec = svc.stream.update_toas(copy.deepcopy(probe))
+        for p in o_ref.params:
+            a, b = o_ref.params[p], o_rec.params[p]
+            assert abs(a - b) <= 1e-9 * max(abs(a), 1.0), (p, a, b)
+        assert abs(o_ref.chi2 - o_rec.chi2) <= 1e-9 * abs(o_ref.chi2)
+
+    def test_simulated_crash_mid_stream(self, workload, tmp_path):
+        """The real seam: SimulatedCrash between the factor apply and
+        the journal ack loses ONLY the unacknowledged op."""
+        jdir = str(tmp_path / "journal")
+        svc = _fresh_service(workload)
+        svc.attach_journal(jdir)
+        ops = _op_script(workload[3])
+        applied = 0
+        with pytest.raises(SimulatedCrash):
+            with crash_at_op(4):
+                for op in ops:
+                    svc.serve_updates([op])
+                    applied += 1
+        assert applied == 4                # op 4 applied but never acked
+        svc2 = _fresh_service(workload)
+        rep = svc2.recover(jdir)
+        assert rep["ops_replayed"] == 4
+        # re-driving the crashed-op replayable tail converges with the
+        # journaled prefix: the service continues from the recovery
+        out = svc2.serve_updates([ops[4]])
+        assert out[0].kind in ("append", "quarantine", "release")
+        assert svc2.journal.ops_journaled == 1   # fresh segment, acked
+
+    def test_snapshot_plus_tail_replay_rebuilds_pen(self, workload,
+                                                    tmp_path):
+        """Snapshot mid-stream + journal-tail replay: bitwise landing
+        AND the quarantine pen re-derived from the journaled appends
+        the snapshot covers (the inspect/repair/release workflow
+        survives a crash)."""
+        jdir = str(tmp_path / "journal")
+        snap = str(tmp_path / "snap")
+        _, _, _, blocks = workload
+        svc = _fresh_service(workload)
+        svc.attach_journal(jdir)
+        bad = copy.deepcopy(blocks[0])
+        bad.error_us[2] = -1.0             # one penned row
+        svc.serve_updates([UpdateRequest(new_toas=bad,
+                                         request_id="bad")])
+        assert len(svc.stream.pen) == 1
+        svc.snapshot(snap)
+        svc.serve_updates([UpdateRequest(
+            new_toas=copy.deepcopy(blocks[1]), request_id="b1")])
+        ref = _state_of(svc)
+        svc.journal.close()
+
+        svc2 = _fresh_service(workload)
+        rep = svc2.recover(jdir, snapshot=snap)
+        assert rep["snapshot_seq"] == 0 and rep["ops_replayed"] == 1
+        _assert_bitwise(ref, _state_of(svc2), what="snapshot+tail")
+        assert len(svc2.stream.pen) == 1
+        penned, reasons = next(iter(svc2.stream.pen.values()))
+        assert len(penned) == 1 and reasons
+
+    def test_torn_tail_recovery_flags_truncation(self, workload,
+                                                 tmp_path):
+        jdir = str(tmp_path / "journal")
+        _, _, _, blocks = workload
+        svc = _fresh_service(workload)
+        svc.attach_journal(jdir)
+        svc.serve_updates([UpdateRequest(
+            new_toas=copy.deepcopy(blocks[0]), request_id="a0")])
+        ref = _state_of(svc)
+        with torn_tail():
+            svc.serve_updates([UpdateRequest(
+                new_toas=copy.deepcopy(blocks[1]), request_id="a1")])
+        svc.journal.close()
+        svc2 = _fresh_service(workload)
+        rep = svc2.recover(jdir)
+        assert rep["truncated"] is not None   # typed truncation report
+        assert rep["ops_replayed"] == 1
+        _assert_bitwise(ref, _state_of(svc2), what="torn-tail")
+
+    def test_foreign_journal_refused(self, workload, tmp_path):
+        jdir = str(tmp_path / "journal")
+        with UpdateJournal(jdir, ["some-other-stream"]) as j:
+            j.commit([UpdateRequest(kind="quarantine", block_id=0,
+                                    rows=[0], request_id="q")])
+        svc = _fresh_service(workload)
+        with pytest.raises(CheckpointError):
+            svc.recover(jdir)
+
+    def test_recover_requires_stream(self, tmp_path):
+        svc = service.TimingService()
+        with pytest.raises(UsageError):
+            svc.recover(str(tmp_path / "journal"))
+
+
+# ---------------------------------------------------------------------------
+# chaos drills under live load — the drill contract
+# ---------------------------------------------------------------------------
+
+class TestDrills:
+    @pytest.mark.parametrize("scenario", [
+        "device_loss", "nan_shard", "straggler", "failed_collective",
+        "crash_mid_coalesce", "corrupt_aot"])
+    def test_drill_contract_per_fault_class(self, scenario):
+        """Every injected fault class: zero stranded futures, typed
+        sheds, bounded untyped failure, recovery to steady state, and
+        post-drill results at 1e-9 vs the dedicated solve."""
+        svc = _stub_service()
+        rep = chaos.run_drill(svc, scenario, rps=300.0, n_requests=16,
+                              times=2, delay_s=0.02, seed=5,
+                              recovery_timeout_s=15.0)
+        assert rep.contract_ok, rep.violations
+        assert rep.stranded == 0
+        assert rep.offered == rep.completed + rep.shed + rep.errored
+        assert rep.errored <= rep.errors_bound
+        assert rep.recovery_s is not None
+        assert rep.spot_check_rel_err <= chaos.SPOT_CHECK_RTOL
+        d = rep.to_dict()
+        assert d["scenario"] == scenario and d["contract_ok"] is True
+
+    def test_quarantine_storm_journals_under_load(self, workload):
+        import shutil
+        import tempfile
+
+        svc = _fresh_service(workload)
+        _, _, _, blocks = workload
+        svc.serve_updates([UpdateRequest(
+            new_toas=copy.deepcopy(blocks[0]), request_id="seed")])
+        tmp = tempfile.mkdtemp(prefix="pint_tpu_storm_")
+        try:
+            svc.attach_journal(os.path.join(tmp, "journal"))
+            rep = chaos.run_drill(svc, "quarantine_storm", rps=200.0,
+                                  n_requests=16, seed=6,
+                                  recovery_timeout_s=15.0)
+            assert rep.contract_ok, rep.violations
+            assert rep.stranded == 0
+            # the storm's accepted ops were all journaled before ack
+            assert svc.journal.ops_journaled > 0
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def test_unknown_scenario_typed(self):
+        svc = _stub_service()
+        with pytest.raises(UsageError):
+            chaos.run_drill(svc, "squirrels")
+        with pytest.raises(UsageError):
+            chaos.scenario_context(svc, "squirrels")
+        with pytest.raises(UsageError):
+            chaos.door_fault(svc, "maybe").__enter__()
+
+
+# ---------------------------------------------------------------------------
+# event contracts (telemetry_report --check)
+# ---------------------------------------------------------------------------
+
+class TestDurabilityEventValidation:
+    def _validate(self, tmp_path, **attrs):
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import runlog
+        from tools.telemetry_report import validate_run_dir
+
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            run = runlog.start_run(run_dir, name="durability-events",
+                                   probe_device=False)
+            run.record_event(attrs.pop("_name"), **attrs)
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+        errors = []
+        validate_run_dir(run_dir, errors)
+        return errors
+
+    def test_valid_journal_replay_passes(self, tmp_path):
+        assert not self._validate(
+            tmp_path, _name="journal_replay", ops_replayed=5,
+            ops_total=8, time_to_recover_s=0.4, snapshot=True,
+            truncated=False)
+
+    def test_replay_exceeding_total_rejected(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="journal_replay", ops_replayed=9,
+            ops_total=8, time_to_recover_s=0.4, snapshot=False,
+            truncated=False)
+        assert any("ops_total" in e for e in errors)
+
+    def test_truncation_requires_reason(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="journal_truncated",
+            segment="seg_000000.wal", reason="  ", dropped=1)
+        assert any("reason" in e for e in errors)
+
+    def test_truncation_drops_exactly_one(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="journal_truncated",
+            segment="seg_000000.wal", reason="crc mismatch", dropped=3)
+        assert any("dropped" in e for e in errors)
+
+    def test_transition_state_enum_enforced(self, tmp_path):
+        errors = self._validate(
+            tmp_path, _name="circuit_transition", door="fit",
+            from_state="closed", to_state="ajar", failures=2)
+        assert any("ajar" in e for e in errors)
+        errors = self._validate(
+            tmp_path, _name="circuit_transition", door="fit",
+            from_state="open", to_state="open", failures=2)
+        assert any("must change state" in e for e in errors)
+
+    def test_chaos_drill_counts_validated(self, tmp_path):
+        assert not self._validate(
+            tmp_path, _name="chaos_drill", scenario="device_loss",
+            offered=32, completed=20, shed=10, errored=2, stranded=0,
+            duration_s=1.1, recovery_s=0.2, contract_ok=True)
+        errors = self._validate(
+            tmp_path, _name="chaos_drill", scenario="device_loss",
+            offered=-1, completed=20, shed=10, errored=2, stranded=-2,
+            duration_s=1.1, recovery_s=0.2, contract_ok=False)
+        assert any("offered" in e for e in errors)
+        assert any("stranded" in e for e in errors)
+
+    def test_breaker_and_deadline_shed_reasons_accepted(self,
+                                                        tmp_path):
+        for reason in ("circuit_open", "deadline"):
+            assert not self._validate(
+                tmp_path, _name="request_shed", request_class="fit",
+                reason=reason, retry_after_ms=5.0, queue_depth=0)

@@ -751,6 +751,82 @@ class TestHostCallInJit:
 
         assert "pint_tpu/streaming/" in DOWNCAST_SCOPE
 
+    def test_durability_call_in_jit_flagged(self, tmp_path):
+        """The durability layer is host I/O and orchestration: a
+        journal commit (fsync!) or a chaos drill inside a traced
+        function would block the trace on disk/asyncio per TRACE; both
+        new submodules are policed like the rest of serving/runtime."""
+        bad = (
+            "import jax\n"
+            "from pint_tpu.serving import journal\n"
+            "from pint_tpu.runtime.chaos import run_drill\n"
+            "@jax.jit\n"
+            "def f(x, svc, reqs):\n"
+            "    journal.UpdateJournal('/tmp/j', ['vk']).commit(reqs)\n"
+            "    run_drill(svc, 'device_loss')\n"
+            "    return x\n"
+        )
+        findings = lint_snippet(tmp_path, bad, [HostCallInJitRule()])
+        assert rule_names(findings) == ["host-call-in-jit"] * 2
+
+    def test_durability_call_on_host_not_flagged(self, tmp_path):
+        """Good twin: the documented pattern — the service journals
+        and drills on the host; traced code touches only jnp math."""
+        good = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "from pint_tpu.serving.journal import UpdateJournal\n"
+            "from pint_tpu.runtime import chaos\n"
+            "@jax.jit\n"
+            "def kernel(M, r):\n"
+            "    return jnp.dot(M.T, r)\n"
+            "def host(svc, jdir, reqs):\n"
+            "    with UpdateJournal(jdir, ['vk']) as j:\n"
+            "        j.commit(reqs)\n"
+            "    return chaos.run_drill(svc, 'device_loss')\n"
+        )
+        assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+    def test_durability_modules_are_clean_targets(self):
+        """journal.py and chaos.py themselves lint clean under the
+        full default rule set (the injected-fault raise sites carry
+        their typed-raise pragmas)."""
+        from tools.jaxlint.engine import (
+            _RUNTIME_SUBMODULES,
+            _SERVING_SUBMODULES,
+        )
+
+        assert "journal" in _SERVING_SUBMODULES
+        assert "chaos" in _RUNTIME_SUBMODULES
+        eng = Engine(rules=default_rules(), repo=REPO)
+        for rel in ("pint_tpu/serving/journal.py",
+                    "pint_tpu/runtime/chaos.py"):
+            # run() applies the pragma layer (the chaos raise-factory
+            # site carries a justified typed-raise pragma)
+            res = eng.run([os.path.join(REPO, rel)])
+            assert res.findings == [], "\n".join(
+                f.render() for f in res.findings)
+
+    def test_durability_in_typed_raise_targets(self, tmp_path):
+        """Both new modules sit inside typed-raise target trees: a
+        planted bare ValueError fires, the typed twin does not."""
+        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
+
+        assert "pint_tpu/serving/" in DEFAULT_TARGETS
+        assert "pint_tpu/runtime/" in DEFAULT_TARGETS
+        for pkg in ("serving", "runtime"):
+            d = tmp_path / "pint_tpu" / pkg
+            d.mkdir(parents=True)
+            bad = d / "bad.py"
+            bad.write_text("def f():\n    raise ValueError('bare')\n")
+            good = d / "good.py"
+            good.write_text(
+                "from pint_tpu.exceptions import UsageError\n"
+                "def f():\n    raise UsageError('typed')\n")
+            eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
+            assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
+            assert eng.lint_file(str(good)) == []
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

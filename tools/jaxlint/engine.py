@@ -162,7 +162,7 @@ _TELEMETRY_SUBMODULES = {"spans", "metrics", "jaxevents", "runlog", "costs",
 #: (and hang the compile on cache I/O), so their calls are policed by
 #: the same host-call-in-jit machinery as the telemetry modules
 _SERVING_SUBMODULES = {"aotcache", "warmup", "batcher", "service",
-                       "admission", "scheduler", "loadgen"}
+                       "admission", "scheduler", "loadgen", "journal"}
 
 #: pint_tpu.autotune submodules are host-side the same way (manifest
 #: filesystem I/O, AOT lower/compile analyses, timed measured runs): a
@@ -195,8 +195,11 @@ _AMORTIZED_SUBMODULES = {"flows", "elbo", "train", "posterior"}
 #: lower/compile): a scattered_normal_equations / verify_scatter_
 #: contract call inside a traced function would re-enter tracing per
 #: TRACE — the scan-fused kernels it feeds (serve_fused, the grid's
-#: fused scan) dispatch plain inner functions, not this API
-_RUNTIME_SUBMODULES = {"workperbyte"}
+#: fused scan) dispatch plain inner functions, not this API.  The
+#: chaos-drill harness is host-side the same way (fault-seam patching,
+#: asyncio load generation, wall-clock recovery probes): a run_drill
+#: inside a traced function would drive the whole service per TRACE
+_RUNTIME_SUBMODULES = {"workperbyte", "chaos"}
 
 #: pint_tpu.streaming submodules are host-side orchestration around
 #: their module-internal jitted kernels (factor-state bookkeeping,

@@ -131,6 +131,14 @@ class RunRecord:
     load_fairness: Optional[float] = None
     load_steady_compiles: Optional[int] = None
     load_error: Optional[str] = None           #: degraded load block
+    #: from the recovery{...} block (round 17+: durability / chaos)
+    recovery_time_to_recover_s: Optional[float] = None
+    recovery_replay_ops_per_s: Optional[float] = None
+    recovery_rps_under_fault: Optional[float] = None
+    recovery_p99_under_fault_ms: Optional[float] = None
+    recovery_stranded_futures: Optional[float] = None
+    recovery_bitwise_match: Optional[bool] = None
+    recovery_error: Optional[str] = None       #: degraded recovery block
     #: from the precision{...} block (round 12+: mixed-precision layer)
     precision_mixed_fits_per_s: Optional[float] = None
     precision_max_rel_err: Optional[float] = None
@@ -321,6 +329,24 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.load_steady_compiles = load["steady_state_compiles"]
         if isinstance(load.get("error"), str) and load["error"]:
             rec.load_error = load["error"]
+    recovery = h.get("recovery")
+    if isinstance(recovery, dict):
+        for src, dst in (("time_to_recover_s",
+                          "recovery_time_to_recover_s"),
+                         ("replay_ops_per_s",
+                          "recovery_replay_ops_per_s"),
+                         ("rps_under_fault", "recovery_rps_under_fault"),
+                         ("p99_under_fault_ms",
+                          "recovery_p99_under_fault_ms"),
+                         ("stranded_futures",
+                          "recovery_stranded_futures")):
+            if isinstance(recovery.get(src), (int, float)) \
+                    and not isinstance(recovery.get(src), bool):
+                setattr(rec, dst, float(recovery[src]))
+        if isinstance(recovery.get("bitwise_match"), bool):
+            rec.recovery_bitwise_match = recovery["bitwise_match"]
+        if isinstance(recovery.get("error"), str) and recovery["error"]:
+            rec.recovery_error = recovery["error"]
     # a zero-valued errored run (the bench's error-emit contract) is a
     # failed measurement, not a 100% regression
     if rec.error is not None and not rec.value:
@@ -581,6 +607,23 @@ def check_series(runs: List[RunRecord], threshold: float,
                    True),
                   ("load_fairness", lambda r: r.load_fairness, +1,
                    False),
+                  # durability (round 17+): crash-recovery wall time
+                  # and the drill's tail latency gate rises, replay
+                  # throughput and completions-under-fault gate drops,
+                  # and stranded_futures gates rises WITH the
+                  # zero-baseline opt-in — the drill contract's
+                  # zero-stranded history must gate the FIRST stranded
+                  # awaiter
+                  ("recovery_time_to_recover_s",
+                   lambda r: r.recovery_time_to_recover_s, -1, False),
+                  ("recovery_replay_ops_per_s",
+                   lambda r: r.recovery_replay_ops_per_s, +1, False),
+                  ("recovery_rps_under_fault",
+                   lambda r: r.recovery_rps_under_fault, +1, False),
+                  ("recovery_p99_under_fault_ms",
+                   lambda r: r.recovery_p99_under_fault_ms, -1, False),
+                  ("recovery_stranded_futures",
+                   lambda r: r.recovery_stranded_futures, -1, True),
                   # mixed-precision layer (round 12+): policy-path
                   # throughput gates drops; max_rel_err gates rises WITH
                   # the zero-baseline opt-in — a bit-identical history
@@ -732,6 +775,30 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: load block degraded "
                    f"({latest_rec.load_error}) where prior runs "
                    "measured the traffic-engineering harness"))
+    # a degraded recovery block where prior rounds measured crash
+    # recovery is a regression, not a silent skip — and a recovered
+    # state that stopped landing bitwise is a correctness break even
+    # when every throughput number survived
+    if latest_rec.recovery_error is not None \
+            and any(r.recovery_replay_ops_per_s is not None
+                    for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="recovery", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: recovery block degraded "
+                   f"({latest_rec.recovery_error}) where prior runs "
+                   "measured crash recovery"))
+    if latest_rec.recovery_bitwise_match is False:
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="recovery_bitwise_match", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: journal replay landed "
+                   "OFF-bitwise — crash recovery no longer reproduces "
+                   "the pre-crash factor state"))
     # a degraded precision block where prior rounds measured the
     # mixed-precision layer is a regression, not a silent skip
     if latest_rec.precision_error is not None \

@@ -355,15 +355,20 @@ LOAD_EVENT_ATTRS = {
 
 _LOAD_ARRIVALS = ("open", "closed")
 _SHED_CLASSES = ("posterior", "update", "fit")
-_SHED_REASONS = ("queue_depth", "latency", "queue_full")
+# must track pint_tpu.serving.admission.SHED_REASONS in tandem: the
+# breaker and deadline sheds ride the same typed channel
+_SHED_REASONS = ("queue_depth", "latency", "queue_full",
+                 "circuit_open", "deadline")
 
 
 def validate_load_event(ev: dict, where: str,
                         errors: List[str]) -> None:
     """Attr contract for load_run / request_shed / mesh_escalated
     records: required attrs typed; a load_run's arrival model in the
-    harness enum, its counts consistent (offered = completed + shed)
-    and non-negative, shed_rate and fairness in [0, 1]; a shed's class
+    harness enum, its counts consistent (offered = completed + shed +
+    errored; ``errored`` is optional — pre-PR-17 records omit it, a
+    tolerate-errors chaos drill stamps it) and non-negative,
+    shed_rate and fairness in [0, 1]; a shed's class
     and reason in the admission enums with a positive retry hint; an
     escalation's rungs ordered (to > from >= 1) with a non-empty
     reason."""
@@ -400,12 +405,17 @@ def validate_load_event(ev: dict, where: str,
                      f"load_run {key!r} is negative ({v!r})")
         offered, completed, shed = (_num("offered"), _num("completed"),
                                     _num("shed"))
+        errored = _num("errored")
+        if errored is not None and errored < 0:
+            _err(errors, where,
+                 f"load_run 'errored' is negative ({errored!r})")
         if None not in (offered, completed, shed) \
-                and offered != completed + shed:
+                and offered != completed + shed + (errored or 0):
             _err(errors, where,
                  f"load_run offered ({offered!r}) != completed "
-                 f"({completed!r}) + shed ({shed!r}) — a request "
-                 "must be served or shed, never lost")
+                 f"({completed!r}) + shed ({shed!r}) + errored "
+                 f"({errored or 0!r}) — a request must be served, "
+                 "shed, or counted as a tolerated error, never lost")
         for key in ("shed_rate", "fairness"):
             v = _num(key)
             if v is not None and not (0.0 <= v <= 1.0):
@@ -450,6 +460,124 @@ def validate_load_event(ev: dict, where: str,
         if nh is not None and nh < 1:
             _err(errors, where,
                  f"mesh_escalated n_healthy is {nh!r}, must be >= 1")
+
+
+#: durability / chaos lifecycle events (pint_tpu/serving journal +
+#: service recovery, pint_tpu/serving admission breakers,
+#: pint_tpu/runtime chaos): one journal_replay per recovery, one
+#: journal_truncated per dropped torn tail, one circuit_transition per
+#: breaker state change, one chaos_drill per scripted drill.  Same
+#: contract style as the other event families — a drift in the
+#: emitters fails --check before it corrupts the recovery series
+#: bench/perfwatch trend.
+DURABILITY_EVENT_ATTRS = {
+    "journal_replay": {"ops_replayed": int, "ops_total": int,
+                       "time_to_recover_s": (int, float),
+                       "snapshot": bool, "truncated": bool},
+    "journal_truncated": {"segment": str, "reason": str,
+                          "dropped": int},
+    "circuit_transition": {"door": str, "from_state": str,
+                           "to_state": str, "failures": int},
+    "chaos_drill": {"scenario": str, "offered": int, "completed": int,
+                    "shed": int, "errored": int, "stranded": int,
+                    "duration_s": (int, float),
+                    "recovery_s": (int, float), "contract_ok": bool},
+}
+
+# must track pint_tpu.serving.admission.BREAKER_STATES in tandem
+_BREAKER_STATES = ("closed", "open", "half_open")
+
+
+def validate_durability_event(ev: dict, where: str,
+                              errors: List[str]) -> None:
+    """Attr contract for journal_replay / journal_truncated /
+    circuit_transition / chaos_drill records: required attrs typed; a
+    replay's op counts consistent (replayed <= total) and its latency
+    non-negative; a truncation carries a non-empty reason and drops
+    exactly one record (torn TAIL, never interior); a breaker
+    transition's states in the enum and actually distinct; a drill's
+    counts non-negative (stranded/recovery_s admit the -1 "drill timed
+    out" / "never recovered" sentinels) with its class in the shed
+    enum's world."""
+    name = ev.get("name")
+    required = DURABILITY_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or (isinstance(v, bool)
+                                      and typ is not bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    def _num(key):
+        v = attrs.get(key)
+        return v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+    if name == "journal_replay":
+        replayed, total = _num("ops_replayed"), _num("ops_total")
+        for key, v in (("ops_replayed", replayed),
+                       ("ops_total", total),
+                       ("time_to_recover_s", _num("time_to_recover_s"))):
+            if v is not None and v < 0:
+                _err(errors, where,
+                     f"journal_replay {key!r} is negative ({v!r})")
+        if None not in (replayed, total) and replayed > total:
+            _err(errors, where,
+                 f"journal_replay ops_replayed ({replayed!r}) exceeds "
+                 f"ops_total ({total!r}) — a replay cannot re-drive "
+                 "ops the journal never held")
+    elif name == "journal_truncated":
+        reason = attrs.get("reason")
+        if isinstance(reason, str) and not reason.strip():
+            _err(errors, where,
+                 "journal_truncated reason is empty — a dropped tail "
+                 "must state why it was unreadable")
+        dropped = _num("dropped")
+        if dropped is not None and dropped != 1:
+            _err(errors, where,
+                 f"journal_truncated dropped is {dropped!r}, must be "
+                 "1 — only the torn FINAL record is recoverable; "
+                 "interior corruption refuses instead")
+    elif name == "circuit_transition":
+        frm, to = attrs.get("from_state"), attrs.get("to_state")
+        for key, v in (("from_state", frm), ("to_state", to)):
+            if v not in _BREAKER_STATES:
+                _err(errors, where,
+                     f"circuit_transition {key} {v!r} not in "
+                     f"{_BREAKER_STATES}")
+        if frm in _BREAKER_STATES and to in _BREAKER_STATES \
+                and frm == to:
+            _err(errors, where,
+                 f"circuit_transition from_state == to_state "
+                 f"({frm!r}) — a transition must change state")
+        failures = _num("failures")
+        if failures is not None and failures < 0:
+            _err(errors, where,
+                 f"circuit_transition failures is negative "
+                 f"({failures!r})")
+    elif name == "chaos_drill":
+        scenario = attrs.get("scenario")
+        if isinstance(scenario, str) and not scenario.strip():
+            _err(errors, where,
+                 "chaos_drill scenario is empty — a drill must name "
+                 "its scripted scenario")
+        for key in ("offered", "completed", "shed", "errored",
+                    "duration_s"):
+            v = _num(key)
+            if v is not None and v < 0:
+                _err(errors, where,
+                     f"chaos_drill {key!r} is negative ({v!r})")
+        for key in ("stranded", "recovery_s"):
+            v = _num(key)
+            if v is not None and v < -1:
+                _err(errors, where,
+                     f"chaos_drill {key!r} is {v!r}, below the -1 "
+                     "timed-out/never-recovered sentinel")
 
 
 #: catalog-engine lifecycle events (pint_tpu/catalog): one ingest
@@ -1035,6 +1163,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_amortized_event(ev, where, errors)
                     validate_streaming_event(ev, where, errors)
                     validate_load_event(ev, where, errors)
+                    validate_durability_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -1359,6 +1488,15 @@ def self_test(errors: List[str]) -> int:
                          fit_rps=70.0, posterior_rps=29.0,
                          update_rps=0.0, fit_p99_ms=180.0,
                          posterior_p99_ms=48.0, update_p99_ms=0.0)
+        # a tolerate-errors chaos drill's load_run: errored requests
+        # join the accounting balance (offered = completed + shed +
+        # errored) instead of counting as lost
+        run.record_event("load_run", arrival="open", duration_s=0.6,
+                         offered=32, completed=7, shed=21, errored=4,
+                         shed_rate=21 / 32, fairness=1.0,
+                         fit_rps=11.0, posterior_rps=0.0,
+                         update_rps=0.0, fit_p99_ms=95.0,
+                         posterior_p99_ms=0.0, update_p99_ms=0.0)
         run.record_event("request_shed", request_class="fit",
                          reason="queue_depth", retry_after_ms=12.5,
                          queue_depth=52)
@@ -1368,6 +1506,29 @@ def self_test(errors: List[str]) -> int:
         run.record_event("mesh_escalated", from_rung=1, to_rung=2,
                          reason="sustained_shedding",
                          workload="gls_normal_eq", n_healthy=4)
+        # durability producer drift check: the journal/breaker/drill
+        # event contract (DURABILITY_EVENT_ATTRS) — a clean recovery,
+        # its truncated twin (torn tail dropped with the mandatory
+        # reason), a breaker trip, and a passed drill next to its
+        # timed-out degraded twin (the -1 sentinels)
+        run.record_event("journal_replay", ops_replayed=5, ops_total=8,
+                         time_to_recover_s=0.42, snapshot=True,
+                         truncated=False)
+        run.record_event("journal_truncated", segment="seg_000002.wal",
+                         reason="record 3: crc mismatch on a short "
+                                "final frame",
+                         dropped=1)
+        run.record_event("circuit_transition", door="fit",
+                         from_state="closed", to_state="open",
+                         failures=5)
+        run.record_event("chaos_drill", scenario="device_loss",
+                         offered=64, completed=41, shed=20, errored=3,
+                         stranded=0, duration_s=1.8, recovery_s=0.31,
+                         contract_ok=True)
+        run.record_event("chaos_drill", scenario="straggler",
+                         offered=64, completed=0, shed=0, errored=0,
+                         stranded=-1, duration_s=120.0,
+                         recovery_s=-1.0, contract_ok=False)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
@@ -1376,9 +1537,9 @@ def self_test(errors: List[str]) -> int:
         # sharding_plan, 3x elastic events, 3x serving events, 2x
         # autotune events, 3x catalog events, 3x precision events,
         # 4x amortized events, 3x streaming events, 5x load events,
-        # metrics, run_end
-        if n < 36:
-            _err(errors, "selftest", f"expected >= 36 records, got {n}")
+        # 5x durability events, metrics, run_end
+        if n < 42:
+            _err(errors, "selftest", f"expected >= 41 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
