@@ -522,13 +522,24 @@ class TimingService:
         take = self._sched.quantum(door.klass)
         batch, door.pending = door.pending[:take], door.pending[take:]
         door.flush_task = None
-        if door.pending:
-            loop = asyncio.get_running_loop()
-            door.flush_task = loop.create_task(_sleep_then(0.0, flush))
-        door.gauge_queue_depth()
-        if not batch:
+        try:
+            if door.pending:
+                loop = asyncio.get_running_loop()
+                door.flush_task = loop.create_task(
+                    _sleep_then(0.0, flush))
+            door.gauge_queue_depth()
+            if not batch:
+                return
+            self._sched.note_dispatch(door.klass, len(batch))
+        except Exception as e:
+            # bookkeeping between the pop and the dispatch (reschedule,
+            # gauge, scheduler accounting) must never strand the popped
+            # batch's awaiters: fail them with the bookkeeping error
+            # instead of leaving futures no one will ever resolve
+            for _, fut, _ in batch:
+                if not fut.done():
+                    fut.set_exception(e)
             return
-        self._sched.note_dispatch(door.klass, len(batch))
         await self._flush_door(door, batch, run, record, what=what)
 
     async def _flush_door(self, door: DoorStats, pending: List[tuple],

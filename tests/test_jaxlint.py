@@ -7,6 +7,7 @@ the pragma and baseline layers round-trip, and finally the whole of
 fails the suite, not just a style check.
 """
 
+import ast
 import os
 import sys
 
@@ -32,6 +33,12 @@ from tools.jaxlint.rules.host_jit import HostCallInJitRule  # noqa: E402
 from tools.jaxlint.rules.static_args import StaticArgsRule  # noqa: E402
 from tools.jaxlint.rules.traced_branch import TracedBranchRule  # noqa: E402
 from tools.jaxlint.rules.typed_raises import TypedRaiseRule  # noqa: E402
+from tools.jaxlint.rules.async_discipline import (  # noqa: E402
+    ASYNC_SCOPE,
+    AwaitUnderLockRule,
+    BlockingInCoroutineRule,
+    StrandedFutureRule,
+)
 
 
 def lint_snippet(tmp_path, source, rules):
@@ -42,6 +49,38 @@ def lint_snippet(tmp_path, source, rules):
 
 def rule_names(findings):
     return [f.rule for f in findings]
+
+
+def assert_twins(tmp_path, rules, bad, good, expected):
+    """The shared twin-runner: the rule set reports exactly
+    ``expected`` on the bad snippet and stays silent on the good twin.
+    Returns the bad-twin findings for message assertions."""
+    findings = lint_snippet(tmp_path, bad, rules)
+    assert rule_names(findings) == expected, "\n".join(
+        f.render() for f in findings)
+    clean = lint_snippet(tmp_path, good, rules)
+    assert clean == [], "\n".join(f.render() for f in clean)
+    return findings
+
+
+def assert_typed_raise_twins(tmp_path, pkg):
+    """Twin-runner for typed-raise target coverage: ``pint_tpu/<pkg>/``
+    must sit in DEFAULT_TARGETS, a planted bare ValueError there fires,
+    and its UsageError twin stays silent."""
+    from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
+
+    assert f"pint_tpu/{pkg}/" in DEFAULT_TARGETS
+    d = tmp_path / "pint_tpu" / pkg
+    d.mkdir(parents=True, exist_ok=True)
+    bad = d / "bad.py"
+    bad.write_text("def f():\n    raise ValueError('bare')\n")
+    good = d / "good.py"
+    good.write_text(
+        "from pint_tpu.exceptions import UsageError\n"
+        "def f():\n    raise UsageError('typed')\n")
+    eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
+    assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
+    assert eng.lint_file(str(good)) == []
 
 
 # ---------------------------------------------------------------------------
@@ -430,23 +469,7 @@ class TestHostCallInJit:
         """pint_tpu/serving/ is a typed-raise target: a planted bare
         ValueError in a serving module fires, its UsageError twin does
         not."""
-        from tools.jaxlint.rules.typed_raises import (
-            DEFAULT_TARGETS,
-            TypedRaiseRule,
-        )
-
-        assert "pint_tpu/serving/" in DEFAULT_TARGETS
-        d = tmp_path / "pint_tpu" / "serving"
-        d.mkdir(parents=True)
-        bad = d / "bad.py"
-        bad.write_text("def f():\n    raise ValueError('bare')\n")
-        good = d / "good.py"
-        good.write_text(
-            "from pint_tpu.exceptions import UsageError\n"
-            "def f():\n    raise UsageError('typed')\n")
-        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
-        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
-        assert eng.lint_file(str(good)) == []
+        assert_typed_raise_twins(tmp_path, "serving")
 
     def test_runtime_plan_and_elastic_are_clean_targets(self):
         """runtime/plan.py + runtime/elastic.py are lint targets of the
@@ -509,20 +532,7 @@ class TestHostCallInJit:
         """pint_tpu/autotune/ is a typed-raise target: a planted bare
         ValueError in an autotune module fires, its UsageError twin
         does not."""
-        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
-
-        assert "pint_tpu/autotune/" in DEFAULT_TARGETS
-        d = tmp_path / "pint_tpu" / "autotune"
-        d.mkdir(parents=True)
-        bad = d / "bad.py"
-        bad.write_text("def f():\n    raise ValueError('bare')\n")
-        good = d / "good.py"
-        good.write_text(
-            "from pint_tpu.exceptions import UsageError\n"
-            "def f():\n    raise UsageError('typed')\n")
-        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
-        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
-        assert eng.lint_file(str(good)) == []
+        assert_typed_raise_twins(tmp_path, "autotune")
 
     def test_catalog_call_in_jit_flagged(self, tmp_path):
         """The catalog package is host orchestration (par/tim ingest +
@@ -579,20 +589,7 @@ class TestHostCallInJit:
         """pint_tpu/catalog/ is a typed-raise target: a planted bare
         ValueError in a catalog module fires, its UsageError twin does
         not."""
-        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
-
-        assert "pint_tpu/catalog/" in DEFAULT_TARGETS
-        d = tmp_path / "pint_tpu" / "catalog"
-        d.mkdir(parents=True)
-        bad = d / "bad.py"
-        bad.write_text("def f():\n    raise ValueError('bare')\n")
-        good = d / "good.py"
-        good.write_text(
-            "from pint_tpu.exceptions import UsageError\n"
-            "def f():\n    raise UsageError('typed')\n")
-        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
-        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
-        assert eng.lint_file(str(good)) == []
+        assert_typed_raise_twins(tmp_path, "catalog")
 
     def test_amortized_call_in_jit_flagged(self, tmp_path):
         """The amortized package is host orchestration (flow
@@ -649,20 +646,7 @@ class TestHostCallInJit:
         """pint_tpu/amortized/ is a typed-raise target: a planted bare
         ValueError in an amortized module fires, its UsageError twin
         does not."""
-        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
-
-        assert "pint_tpu/amortized/" in DEFAULT_TARGETS
-        d = tmp_path / "pint_tpu" / "amortized"
-        d.mkdir(parents=True)
-        bad = d / "bad.py"
-        bad.write_text("def f():\n    raise ValueError('bare')\n")
-        good = d / "good.py"
-        good.write_text(
-            "from pint_tpu.exceptions import UsageError\n"
-            "def f():\n    raise UsageError('typed')\n")
-        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
-        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
-        assert eng.lint_file(str(good)) == []
+        assert_typed_raise_twins(tmp_path, "amortized")
 
     def test_amortized_in_downcast_scope(self):
         """The unguarded-downcast rule covers the flow layers: a bare
@@ -728,20 +712,7 @@ class TestHostCallInJit:
         """pint_tpu/streaming/ is a typed-raise target: a planted bare
         ValueError in a streaming module fires, its UsageError twin
         does not."""
-        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
-
-        assert "pint_tpu/streaming/" in DEFAULT_TARGETS
-        d = tmp_path / "pint_tpu" / "streaming"
-        d.mkdir(parents=True)
-        bad = d / "bad.py"
-        bad.write_text("def f():\n    raise ValueError('bare')\n")
-        good = d / "good.py"
-        good.write_text(
-            "from pint_tpu.exceptions import UsageError\n"
-            "def f():\n    raise UsageError('typed')\n")
-        eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
-        assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
-        assert eng.lint_file(str(good)) == []
+        assert_typed_raise_twins(tmp_path, "streaming")
 
     def test_streaming_in_downcast_scope(self):
         """The unguarded-downcast rule covers the stream kernels: a
@@ -810,22 +781,8 @@ class TestHostCallInJit:
     def test_durability_in_typed_raise_targets(self, tmp_path):
         """Both new modules sit inside typed-raise target trees: a
         planted bare ValueError fires, the typed twin does not."""
-        from tools.jaxlint.rules.typed_raises import DEFAULT_TARGETS
-
-        assert "pint_tpu/serving/" in DEFAULT_TARGETS
-        assert "pint_tpu/runtime/" in DEFAULT_TARGETS
         for pkg in ("serving", "runtime"):
-            d = tmp_path / "pint_tpu" / pkg
-            d.mkdir(parents=True)
-            bad = d / "bad.py"
-            bad.write_text("def f():\n    raise ValueError('bare')\n")
-            good = d / "good.py"
-            good.write_text(
-                "from pint_tpu.exceptions import UsageError\n"
-                "def f():\n    raise UsageError('typed')\n")
-            eng = Engine(rules=[TypedRaiseRule()], repo=str(tmp_path))
-            assert rule_names(eng.lint_file(str(bad))) == ["typed-raise"]
-            assert eng.lint_file(str(good)) == []
+            assert_typed_raise_twins(tmp_path, pkg)
 
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
@@ -1380,6 +1337,675 @@ class TestWorkperbyteHostTarget:
             "    return solve(m, y)\n"
         )
         assert lint_snippet(tmp_path, good, [HostCallInJitRule()]) == []
+
+
+# ---------------------------------------------------------------------------
+# the flow engine: CFG + exception edges + reaching defs + call summaries
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asynclint
+class TestFlowEngine:
+    """The flow-aware substrate under the async rules
+    (tools/jaxlint/flow.py): per-function CFGs with exception edges,
+    reaching definitions, and the module call-summary fixpoint."""
+
+    def test_no_raise_body_cannot_reach_raise_exit(self):
+        from tools.jaxlint import flow
+
+        fn = ast.parse(
+            "def f(xs):\n"
+            "    n = len(xs)\n"
+            "    xs.append(n)\n"
+            "    return n\n").body[0]
+        assert not flow.build_cfg(fn).raise_reachable()
+
+    def test_unsummarized_call_grows_exception_edge(self):
+        from tools.jaxlint import flow
+
+        fn = ast.parse(
+            "def f(x):\n"
+            "    y = frobnicate(x)\n"
+            "    return y\n").body[0]
+        assert flow.build_cfg(fn).raise_reachable()
+
+    def test_broad_handler_fences_narrow_does_not(self):
+        from tools.jaxlint import flow
+
+        fenced = ast.parse(
+            "def f(x):\n"
+            "    try:\n"
+            "        y = frobnicate(x)\n"
+            "    except Exception:\n"
+            "        y = None\n"
+            "    return y\n").body[0]
+        assert not flow.build_cfg(fenced).raise_reachable()
+        narrow = ast.parse(
+            "def f(x):\n"
+            "    try:\n"
+            "        y = frobnicate(x)\n"
+            "    except ValueError:\n"
+            "        y = None\n"
+            "    return y\n").body[0]
+        assert flow.build_cfg(narrow).raise_reachable()
+
+    def test_reaching_definitions_merge_at_join(self):
+        from tools.jaxlint import flow
+
+        fn = ast.parse(
+            "def f(c):\n"
+            "    x = 1\n"
+            "    if c:\n"
+            "        x = 2\n"
+            "    return x\n").body[0]
+        cfg = flow.build_cfg(fn)
+        defs = flow.reaching_definitions(cfg)[cfg.exit].get("x", set())
+        assert len(defs) == 2
+
+    def test_summary_resolution_and_fixpoint(self):
+        from tools.jaxlint import flow
+
+        tree = ast.parse(
+            "def fail_all(pending, exc):\n"
+            "    for _, fut in pending:\n"
+            "        if fut.done():\n"
+            "            continue\n"
+            "        fut.set_exception(exc)\n"
+            "def drain(pending, exc):\n"
+            "    fail_all(pending, exc)\n")
+        s = flow.module_summaries(tree)
+        assert s["fail_all"].resolves_params == frozenset({"pending"})
+        assert s["fail_all"].cannot_raise
+        # fixpoint: drain only calls the summarized no-raise helper
+        assert s["drain"].cannot_raise
+
+    def test_shipped_flush_door_summary(self):
+        """The real serving dispatch: the summary pass proves
+        _flush_door resolves its `pending` parameter and cannot raise
+        (the contract _drain_door's hand-off rests on)."""
+        from tools.jaxlint import flow
+
+        with open(os.path.join(REPO, "pint_tpu", "serving",
+                               "service.py")) as f:
+            s = flow.module_summaries(ast.parse(f.read()))
+        assert "pending" in s["_flush_door"].resolves_params
+        assert s["_flush_door"].cannot_raise
+
+
+# ---------------------------------------------------------------------------
+# async-discipline rules (stranded-future / await-under-lock / blocking)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asynclint
+class TestStrandedFuture:
+    """The static form of the chaos-drill zero-stranded-futures
+    contract, pinned by the seeded _flush_door mutant: the exception
+    branch returns without failing the popped batch."""
+
+    MUTANT = (
+        "import time\n"
+        "class Service:\n"
+        "    async def _flush_door(self, door, pending, run, record,\n"
+        "                          what):\n"
+        "        if not pending:\n"
+        "            return\n"
+        "        try:\n"
+        "            results = run([p[0] for p in pending])\n"
+        "        except Exception as e:\n"
+        "            door.breaker.record_failure()\n"
+        "            return\n"
+        "        door.breaker.record_success()\n"
+        "        now = time.perf_counter()\n"
+        "        for (req, fut, t0), res in zip(pending, results):\n"
+        "            res.latency_ms = 1e3 * (now - t0)\n"
+        "            if fut.done():\n"
+        "                continue\n"
+        "            fut.set_result(res)\n"
+        "            try:\n"
+        "                record(req, res, res.latency_ms)\n"
+        "            except Exception:\n"
+        "                pass\n"
+    )
+    FIXED = MUTANT.replace(
+        "            door.breaker.record_failure()\n"
+        "            return\n",
+        "            door.breaker.record_failure()\n"
+        "            for _, fut, _ in pending:\n"
+        "                if not fut.done():\n"
+        "                    fut.set_exception(e)\n"
+        "            return\n")
+
+    def test_seeded_flush_door_mutant_caught(self, tmp_path):
+        findings = assert_twins(
+            tmp_path, [StrandedFutureRule(files=None)],
+            self.MUTANT, self.FIXED, ["stranded-future"])
+        assert "'pending'" in findings[0].message
+        assert "_flush_door" in findings[0].message
+
+    def test_created_future_stranded_by_raising_bookkeeping(
+            self, tmp_path):
+        bad = (
+            "import asyncio\n"
+            "async def submit(door, req):\n"
+            "    loop = asyncio.get_running_loop()\n"
+            "    fut = loop.create_future()\n"
+            "    door.validate(req)\n"
+            "    door.pending.append((req, fut))\n"
+            "    return await fut\n")
+        good = (
+            "import asyncio\n"
+            "async def submit(door, req):\n"
+            "    loop = asyncio.get_running_loop()\n"
+            "    fut = loop.create_future()\n"
+            "    try:\n"
+            "        door.validate(req)\n"
+            "    except Exception as e:\n"
+            "        fut.set_exception(e)\n"
+            "        return await fut\n"
+            "    door.pending.append((req, fut))\n"
+            "    return await fut\n")
+        assert_twins(tmp_path, [StrandedFutureRule(files=None)],
+                     bad, good, ["stranded-future"])
+
+    def test_popped_batch_is_tainted(self, tmp_path):
+        bad = (
+            "async def drain(door):\n"
+            "    batch, door.pending = door.pending[:4], "
+            "door.pending[4:]\n"
+            "    door.gauge()\n"
+            "    for _, fut in batch:\n"
+            "        fut.set_result(None)\n")
+        good = (
+            "async def drain(door):\n"
+            "    batch, door.pending = door.pending[:4], "
+            "door.pending[4:]\n"
+            "    try:\n"
+            "        door.gauge()\n"
+            "    except Exception as e:\n"
+            "        for _, fut in batch:\n"
+            "            fut.set_exception(e)\n"
+            "        return\n"
+            "    for _, fut in batch:\n"
+            "        fut.set_result(None)\n")
+        findings = assert_twins(
+            tmp_path, [StrandedFutureRule(files=None)],
+            bad, good, ["stranded-future"])
+        assert "'batch'" in findings[0].message
+
+    def test_handoff_to_resolving_callee_kills(self, tmp_path):
+        """Interprocedural: passing the futures to a module-local
+        helper counts as resolution exactly when the helper's summary
+        resolves that parameter."""
+        bad = (
+            "def log_all(futs):\n"
+            "    for fut in futs:\n"
+            "        print(fut)\n"
+            "async def drain(pending):\n"
+            "    log_all(pending)\n")
+        good = (
+            "def cancel_all(futs):\n"
+            "    for fut in futs:\n"
+            "        fut.cancel()\n"
+            "async def drain(pending):\n"
+            "    cancel_all(pending)\n")
+        assert_twins(tmp_path, [StrandedFutureRule(files=None)],
+                     bad, good, ["stranded-future"])
+
+    def test_default_scope_is_the_async_layer(self, tmp_path):
+        assert "pint_tpu/serving/" in ASYNC_SCOPE
+        assert "pint_tpu/streaming/door.py" in ASYNC_SCOPE
+        # out of the scoped set, the default-scope instance is silent
+        assert lint_snippet(tmp_path, self.MUTANT,
+                            [StrandedFutureRule(files=...)]) == []
+
+    def test_shipped_serving_layer_is_clean(self):
+        """The acceptance contract: the live serving layer + the
+        streaming door pass all three async rules with no pragmas and
+        no baseline entries."""
+        rules = [StrandedFutureRule(files=...),
+                 AwaitUnderLockRule(files=...),
+                 BlockingInCoroutineRule(files=...)]
+        result = Engine(rules=rules, repo=REPO).run(
+            ["pint_tpu/serving", "pint_tpu/streaming/door.py"])
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+        assert result.suppressed == 0 and result.baselined == 0
+
+
+@pytest.mark.asynclint
+class TestAwaitUnderLock:
+    BAD_WITH = (
+        "import asyncio\n"
+        "class Door:\n"
+        "    async def flush(self):\n"
+        "        with self._lock:\n"
+        "            await asyncio.sleep(0)\n"
+    )
+    GOOD_WITH = (
+        "import asyncio\n"
+        "class Door:\n"
+        "    async def flush(self):\n"
+        "        async with self._lock:\n"
+        "            await asyncio.sleep(0)\n"
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return list(self._q)\n"
+    )
+
+    def test_plain_with_over_lock(self, tmp_path):
+        assert_twins(tmp_path, [AwaitUnderLockRule(files=None)],
+                     self.BAD_WITH, self.GOOD_WITH,
+                     ["await-under-lock"])
+
+    BAD_ACQ = (
+        "class Door:\n"
+        "    async def flush(self, batch):\n"
+        "        self._door_lock.acquire()\n"
+        "        await self.run(batch)\n"
+        "        self._door_lock.release()\n"
+    )
+    GOOD_ACQ = (
+        "class Door:\n"
+        "    async def flush(self, batch):\n"
+        "        self._door_lock.acquire()\n"
+        "        take = self.quantum()\n"
+        "        self._door_lock.release()\n"
+        "        await self.run(batch[:take])\n"
+    )
+
+    def test_bare_acquire_release_span(self, tmp_path):
+        findings = assert_twins(
+            tmp_path, [AwaitUnderLockRule(files=None)],
+            self.BAD_ACQ, self.GOOD_ACQ, ["await-under-lock"])
+        assert "acquire" in findings[0].message
+
+    def test_inline_threading_primitive(self, tmp_path):
+        bad = (
+            "import threading\n"
+            "async def f(x):\n"
+            "    with threading.Lock():\n"
+            "        await x\n")
+        findings = lint_snippet(tmp_path, bad,
+                                [AwaitUnderLockRule(files=None)])
+        assert rule_names(findings) == ["await-under-lock"]
+
+
+@pytest.mark.asynclint
+class TestBlockingInCoroutine:
+    BAD = (
+        "import os\n"
+        "import time\n"
+        "class Service:\n"
+        "    async def _dispatch(self, door, fh, x):\n"
+        "        os.fsync(fh)\n"
+        "        time.sleep(0.01)\n"
+        "        with open('audit.log', 'a') as f:\n"
+        "            f.write('x')\n"
+        "        self._journal.commit([x])\n"
+        "        x.block_until_ready()\n"
+        "        return x\n"
+    )
+    GOOD = (
+        "import asyncio\n"
+        "import os\n"
+        "class Service:\n"
+        "    def _run_sync(self, door, fh, x):\n"
+        "        os.fsync(fh)\n"
+        "        with open('audit.log', 'a') as f:\n"
+        "            f.write('x')\n"
+        "        self._journal.commit([x])\n"
+        "        return x.block_until_ready()\n"
+        "    async def _dispatch(self, door, fh, x):\n"
+        "        await asyncio.sleep(0.01)\n"
+        "        loop = asyncio.get_running_loop()\n"
+        "        return await loop.run_in_executor(\n"
+        "            None, self._run_sync, door, fh, x)\n"
+    )
+
+    def test_twins(self, tmp_path):
+        findings = assert_twins(
+            tmp_path, [BlockingInCoroutineRule(files=None)],
+            self.BAD, self.GOOD, ["blocking-in-coroutine"] * 5)
+        msgs = " ".join(f.message for f in findings)
+        assert "fsync" in msgs and "sleep" in msgs and "open" in msgs
+        assert "commit" in msgs and "block_until_ready" in msgs
+
+
+# ---------------------------------------------------------------------------
+# the telemetry event-schema cross-checker
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asynclint
+class TestEventContract:
+    """Producer/validator drift twins: emit sites are diffed against
+    the *_EVENT_ATTRS contract tables parsed from the repo's
+    tools/telemetry_report.py SOURCE (never imported)."""
+
+    CONTRACTS = (
+        "DOOR_EVENT_ATTRS = {\n"
+        "    'door.flush': {'klass': str, 'n': int,\n"
+        "                   'latency_ms': (int, float)},\n"
+        "    'door.shed': {'klass': str},\n"
+        "}\n"
+    )
+
+    def _repo(self, tmp_path, producer_src):
+        (tmp_path / "tools").mkdir()
+        (tmp_path / "tools" / "telemetry_report.py").write_text(
+            self.CONTRACTS)
+        pkg = tmp_path / "pint_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        tele = tmp_path / "pint_tpu" / "telemetry"
+        tele.mkdir()
+        (tele / "__init__.py").write_text("SEAM = 1\n")
+        p = pkg / "door.py"
+        p.write_text(producer_src)
+        return p
+
+    def _lint(self, tmp_path, path):
+        from tools.jaxlint.rules.event_contract import EventContractRule
+
+        eng = Engine(rules=[EventContractRule(files=...)],
+                     repo=str(tmp_path))
+        return eng.lint_file(str(path))
+
+    def test_conforming_producer_is_clean(self, tmp_path):
+        p = self._repo(
+            tmp_path,
+            "def flush(run, n, dt):\n"
+            "    run.record_event('door.flush', klass='fit', n=n,\n"
+            "                     latency_ms=dt)\n"
+            "def shed(run, **attrs):\n"
+            "    run.record_event('door.shed', **attrs)\n")
+        assert self._lint(tmp_path, p) == []
+
+    def test_unknown_event_name(self, tmp_path):
+        p = self._repo(
+            tmp_path,
+            "def flush(run):\n"
+            "    run.record_event('door.flsh', klass='fit')\n")
+        findings = self._lint(tmp_path, p)
+        assert rule_names(findings) == ["event-contract"]
+        assert "no validator contract" in findings[0].message
+
+    def test_missing_required_attr(self, tmp_path):
+        p = self._repo(
+            tmp_path,
+            "def flush(run):\n"
+            "    run.record_event('door.flush', klass='fit')\n")
+        findings = self._lint(tmp_path, p)
+        assert rule_names(findings) == ["event-contract"] * 2
+        msgs = " ".join(f.message for f in findings)
+        assert "'n'" in msgs and "'latency_ms'" in msgs
+
+    def test_rejected_attr_type_and_bool_exclusion(self, tmp_path):
+        p = self._repo(
+            tmp_path,
+            "def flush(run):\n"
+            "    run.record_event('door.flush', klass='fit', n=True,\n"
+            "                     latency_ms=3)\n")
+        findings = self._lint(tmp_path, p)
+        # n=True is bool (the validator rejects bools for int attrs);
+        # latency_ms=3 is accepted because the contract spells
+        # (int, float)
+        assert rule_names(findings) == ["event-contract"]
+        assert "bool" in findings[0].message
+
+    def test_dead_contract_anchored_on_telemetry_seam(self, tmp_path):
+        self._repo(
+            tmp_path,
+            "def flush(run, n, dt):\n"
+            "    run.record_event('door.flush', klass='fit', n=n,\n"
+            "                     latency_ms=dt)\n")
+        anchor = tmp_path / "pint_tpu" / "telemetry" / "__init__.py"
+        findings = self._lint(tmp_path, anchor)
+        assert rule_names(findings) == ["event-contract"]
+        assert "dead contract" in findings[0].message
+        assert "door.shed" in findings[0].message
+
+    def test_producer_validator_drift_twin(self, tmp_path):
+        """The drift twin: rename the emitted event and the checker
+        reports BOTH directions — unknown producer at the emit site,
+        dead contract at the telemetry seam."""
+        p = self._repo(
+            tmp_path,
+            "def shed(run):\n"
+            "    run.record_event('door.dropped', klass='fit')\n")
+        emit = self._lint(tmp_path, p)
+        assert rule_names(emit) == ["event-contract"]
+        assert "door.dropped" in emit[0].message
+        anchor = tmp_path / "pint_tpu" / "telemetry" / "__init__.py"
+        dead = self._lint(tmp_path, anchor)
+        assert rule_names(dead) == ["event-contract"] * 2
+
+    def test_repo_contracts_and_producers_agree(self):
+        """Acceptance pin: over the real repo the static extractor and
+        the validator tables cover exactly the same event set — zero
+        unknown producers, zero dead contracts."""
+        from tools.jaxlint.rules.event_contract import (
+            load_contract_table,
+            repo_producers,
+        )
+
+        table = load_contract_table(REPO)
+        produced = repo_producers(REPO)
+        assert table and produced
+        assert set(produced) - set(table) == set(), (
+            f"producers without contracts: "
+            f"{sorted(set(produced) - set(table))}")
+        dead = {n for n in table if produced.get(n, 0) == 0}
+        assert dead == set(), f"dead contracts: {sorted(dead)}"
+
+
+# ---------------------------------------------------------------------------
+# the auto-discovered target map
+# ---------------------------------------------------------------------------
+
+class TestTargetMapContract:
+    """Every discovered pint_tpu subpackage is analyzed or excluded
+    WITH a written justification, per rule family — a new package
+    cannot silently fall outside the lint surface."""
+
+    def test_discovery_finds_the_known_packages(self):
+        from tools.jaxlint.engine import pint_tpu_subpackages
+
+        pkgs = pint_tpu_subpackages(REPO)
+        assert {"serving", "streaming", "telemetry", "runtime",
+                "catalog", "amortized", "autotune"} <= set(pkgs)
+        assert "journal" in pkgs["serving"]
+        assert "door" in pkgs["streaming"]
+
+    def test_host_call_map_is_total(self):
+        from tools.jaxlint.engine import (
+            HOST_CALL_EXCLUSIONS,
+            _PKG_VIEW,
+            pint_tpu_subpackages,
+        )
+
+        for pkg, subs in pint_tpu_subpackages(REPO).items():
+            tracked = _PKG_VIEW.get(f"pint_tpu.{pkg}")
+            if pkg in HOST_CALL_EXCLUSIONS:
+                assert tracked is None
+                continue
+            assert tracked is not None, (
+                f"{pkg} neither host-tracked nor excluded")
+            for s in subs - tracked:
+                assert f"{pkg}.{s}" in HOST_CALL_EXCLUSIONS, (
+                    f"{pkg}.{s} dropped without a justification")
+
+    def test_typed_raise_map_is_total(self):
+        from tools.jaxlint.engine import pint_tpu_subpackages
+        from tools.jaxlint.rules.typed_raises import (
+            DEFAULT_TARGETS,
+            TYPED_RAISE_EXCLUSIONS,
+        )
+
+        for pkg in pint_tpu_subpackages(REPO):
+            covered = f"pint_tpu/{pkg}/" in DEFAULT_TARGETS
+            excluded = pkg in TYPED_RAISE_EXCLUSIONS
+            assert covered != excluded, (
+                f"{pkg} must be exactly one of covered/excluded")
+
+    def test_downcast_map_is_total(self):
+        from tools.jaxlint.engine import pint_tpu_subpackages
+        from tools.jaxlint.rules.downcast import (
+            DOWNCAST_EXCLUSIONS,
+            DOWNCAST_SCOPE,
+        )
+
+        for pkg in pint_tpu_subpackages(REPO):
+            covered = f"pint_tpu/{pkg}/" in DOWNCAST_SCOPE
+            excluded = pkg in DOWNCAST_EXCLUSIONS
+            assert covered != excluded, (
+                f"{pkg} must be exactly one of covered/excluded")
+
+    def test_every_exclusion_is_justified_and_real(self):
+        from tools.jaxlint.engine import (
+            HOST_CALL_EXCLUSIONS,
+            pint_tpu_subpackages,
+        )
+        from tools.jaxlint.rules.downcast import DOWNCAST_EXCLUSIONS
+        from tools.jaxlint.rules.typed_raises import (
+            TYPED_RAISE_EXCLUSIONS)
+
+        pkgs = pint_tpu_subpackages(REPO)
+        for table in (HOST_CALL_EXCLUSIONS, TYPED_RAISE_EXCLUSIONS,
+                      DOWNCAST_EXCLUSIONS):
+            for key, why in table.items():
+                assert isinstance(why, str) and len(why.split()) >= 3, (
+                    f"exclusion {key!r} lacks a written justification")
+                if "." in key:
+                    pkg, sub = key.split(".", 1)
+                    assert sub in pkgs.get(pkg, set()), (
+                        f"exclusion {key!r} names a module that no "
+                        "longer exists")
+                else:
+                    assert key in pkgs, (
+                        f"exclusion {key!r} names a package that no "
+                        "longer exists")
+
+    def test_async_and_contract_scopes_cover_the_issue_targets(self):
+        from tools.jaxlint.rules.event_contract import EventContractRule
+
+        assert "pint_tpu/serving/" in ASYNC_SCOPE
+        assert "pint_tpu/streaming/door.py" in ASYNC_SCOPE
+        assert EventContractRule.default_files == ("pint_tpu/",)
+
+
+# ---------------------------------------------------------------------------
+# normalized baseline keys + --format json
+# ---------------------------------------------------------------------------
+
+class TestNormalizedBaseline:
+    """Satellite: baseline keys are (path, rule, normalized snippet) —
+    reformatting and comment edits keep an entry matching; editing the
+    flagged code itself stales it."""
+
+    def test_normalize_snippet(self):
+        from tools.jaxlint.engine import normalize_snippet
+
+        assert normalize_snippet("  y =   np.sum(x)\t# note") \
+            == "y = np.sum(x)"
+        # a '#' inside a string literal is code, not a comment
+        assert normalize_snippet("x = 'a # b'  # trailing") \
+            == "x = 'a # b'"
+        assert normalize_snippet('m = "esc \\" # q"  # c') \
+            == 'm = "esc \\" # q"'
+
+    def test_baseline_survives_reformat_and_comment_edits(
+            self, tmp_path):
+        src = (
+            "import jax\nimport numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.sum(x)\n")
+        p = tmp_path / "s.py"
+        p.write_text(src)
+        engine = Engine(rules=[HostCallInJitRule()], repo=str(tmp_path))
+        bl = tmp_path / "bl.txt"
+        write_baseline(str(bl), engine.collect([str(p)]))
+        # the rename-survives case: a refactor pass re-spaces the
+        # flagged line and hangs a comment on it
+        p.write_text(src.replace(
+            "    return np.sum(x)\n",
+            "    return  np.sum(x)   # kept: host reduction\n"))
+        result = engine.run([str(p)], baseline=load_baseline(str(bl)))
+        assert result.findings == [] and result.baselined == 1
+        assert result.stale_baseline == []
+        # editing the code itself still stales the entry
+        p.write_text(src.replace("np.sum(x)", "np.sum(x * 2)"))
+        result = engine.run([str(p)], baseline=load_baseline(str(bl)))
+        assert len(result.findings) == 1
+        assert len(result.stale_baseline) == 1
+
+    def test_committed_baseline_is_normalized(self):
+        """Idempotence pin for the migrated entries: every committed
+        key equals its own normalization."""
+        from tools.jaxlint.engine import (
+            normalize_snippet,
+            read_baseline_entries,
+        )
+
+        entries = read_baseline_entries(
+            os.path.join(REPO, "jaxlint_baseline.txt"))
+        assert len(entries) >= 5
+        for _, key in entries:
+            assert key[2] == normalize_snippet(key[2])
+
+
+class TestJsonFormat:
+    """Satellite: `--format json` machine-readable findings on stdout;
+    text mode stays byte-identical."""
+
+    BAD = (
+        "import jax\nimport numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.sum(x)\n")
+
+    def test_json_records(self, tmp_path, capsys):
+        import json
+
+        from tools.jaxlint.cli import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        assert main([str(bad), "--no-baseline",
+                     "--format", "json"]) == 1
+        cap = capsys.readouterr()
+        records = json.loads(cap.out)   # stdout is pure JSON
+        assert len(records) == 1
+        r = records[0]
+        assert set(r) == {"file", "line", "col", "rule", "message",
+                          "severity"}
+        assert r["rule"] == "host-call-in-jit"
+        assert r["severity"] == "error"
+        assert r["line"] == 5 and r["file"].endswith("bad.py")
+        assert "violation" in cap.err    # summary moved to stderr
+
+    def test_json_clean_is_empty_array(self, tmp_path, capsys):
+        import json
+
+        from tools.jaxlint.cli import main
+
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert main([str(clean), "--no-baseline",
+                     "--format", "json"]) == 0
+        cap = capsys.readouterr()
+        assert json.loads(cap.out) == []
+        assert "OK" in cap.err
+
+    def test_text_output_unchanged(self, tmp_path, capsys):
+        from tools.jaxlint.cli import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        assert main([str(bad), "--no-baseline"]) == 1
+        cap = capsys.readouterr()
+        assert cap.err == ""
+        lines = cap.out.strip().splitlines()
+        assert "host-call-in-jit" in lines[0] and ":5:" in lines[0]
+        assert lines[-1].startswith("1 violation(s)")
 
 
 # ---------------------------------------------------------------------------

@@ -59,6 +59,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "exit 0")
     ap.add_argument("--select", default=None, metavar="RULES",
                     help="comma-separated rule names to run (default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    dest="fmt",
+                    help="finding output format: 'text' (default, the "
+                         "stable path:line:col lines) or 'json' (an "
+                         "array of file/line/col/rule/message/severity "
+                         "records on stdout; notes and the summary move "
+                         "to stderr)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
     args = ap.parse_args(argv)
@@ -105,16 +112,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"jaxlint: configuration error: {e}", file=sys.stderr)
         return 2
 
-    for f in result.findings:
-        print(f.render())
+    if args.fmt == "json":
+        import json
+
+        from tools.jaxlint.rules import RULES
+
+        records = [{"file": f.path, "line": f.lineno, "col": f.col,
+                    "rule": f.rule, "message": f.message,
+                    "severity": getattr(RULES.get(f.rule), "severity",
+                                        "error")}
+                   for f in result.findings]
+        print(json.dumps(records, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+    summary_stream = sys.stderr if args.fmt == "json" else sys.stdout
     for key in result.stale_baseline:
         print(f"jaxlint: note: stale baseline entry {key[0]} :: {key[1]} :: "
               f"{key[2]!r} no longer matches any finding", file=sys.stderr)
     if result.findings:
         print(f"{len(result.findings)} violation(s) "
               f"({result.baselined} baselined, "
-              f"{result.suppressed} pragma-suppressed)")
+              f"{result.suppressed} pragma-suppressed)",
+              file=summary_stream)
         return 1
     print(f"OK ({result.baselined} baselined, "
-          f"{result.suppressed} pragma-suppressed)")
+          f"{result.suppressed} pragma-suppressed)", file=summary_stream)
     return 0

@@ -1,0 +1,504 @@
+"""Flow-aware analysis substrate for jaxlint.
+
+Three pieces, layered under :mod:`tools.jaxlint.rules.async_discipline`
+(and available to any rule that needs more than a per-function walk):
+
+* :func:`build_cfg` — a per-function control-flow graph at statement
+  granularity with **exception edges**: every statement that may raise
+  (per :func:`may_raise`) gets an edge to the innermost enclosing
+  ``except`` handlers, or to the function's dedicated *raise exit* when
+  unhandled.  ``finally`` bodies are approximated as ordinary successor
+  statements (their re-raise subtleties are out of model).
+* :func:`reaching_definitions` — classic intraprocedural
+  reaching-definitions over local names, a forward may-dataflow to
+  fixpoint over the CFG.
+* :func:`module_summaries` — a lightweight call-summary pass over one
+  module: for every ``def`` (top-level or method, keyed by bare name)
+  which *parameters it resolves* (``set_result`` / ``set_exception`` /
+  ``cancel`` on the parameter or on names bound by iterating it) and
+  whether the function *cannot raise* (its CFG's raise exit is
+  unreachable).  Summaries feed back into :func:`may_raise`, so a
+  helper whose body is fully fenced by ``except Exception`` does not
+  spray exception edges over its callers.
+
+The may-raise model is deliberately coarse: any call not on the
+whitelist below (and not summarized ``cannot_raise``) may raise;
+attribute access, arithmetic, and subscripts never do.  ``await`` of a
+call inherits the callee's raise behavior; ``await`` of a bare future
+may raise (it re-raises the future's exception).  Task cancellation is
+explicitly out of model — ``CancelledError`` delivery mid-await is the
+chaos drill's job (DESIGN.md durability rounds), not static analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["Node", "CFG", "Summary", "build_cfg", "may_raise",
+           "module_summaries", "reaching_definitions", "assigned_names",
+           "terminal_attr", "iter_functions"]
+
+#: method names (terminal attribute of a call) that cannot raise in
+#: practice for the code under analysis.  Future resolution methods are
+#: here ON PURPOSE: a kill statement must not grow its own exception
+#: edge, or every correct resolve-then-return body would self-report.
+NO_RAISE_METHODS = frozenset({
+    # list/dict/set bookkeeping
+    "append", "extend", "insert", "appendleft", "popleft", "clear",
+    "get", "setdefault", "keys", "values", "items", "add", "discard",
+    "copy",
+    # future/breaker lifecycle (set_result on a done future raises
+    # InvalidStateError, but every call site guards with .done())
+    "set_result", "set_exception", "cancel", "cancelled", "done",
+    "record_success", "record_failure",
+    # clocks and logging
+    "perf_counter", "monotonic", "time", "process_time",
+    "debug", "info", "warning", "error", "exception",
+    # asyncio plumbing that only constructs
+    "create_future", "get_running_loop", "get_event_loop",
+})
+
+#: bare-name builtins that cannot raise on well-typed operands
+NO_RAISE_NAMES = frozenset({
+    "len", "isinstance", "issubclass", "repr", "str", "bool", "id",
+    "min", "max", "abs", "sorted", "list", "dict", "tuple", "set",
+    "zip", "enumerate", "range", "print", "getattr", "hasattr",
+    "callable", "type", "format",
+})
+
+
+@dataclass(frozen=True)
+class Summary:
+    """Call summary of one function, keyed by bare name in the module
+    summary table."""
+
+    #: parameter NAMES on which the body calls set_result /
+    #: set_exception / cancel (directly, or on names bound by
+    #: iterating the parameter / zip(parameter, ...))
+    resolves_params: FrozenSet[str] = frozenset()
+    cannot_raise: bool = False
+    #: positional order of the def's parameters (for call-site matching)
+    param_names: Tuple[str, ...] = ()
+
+
+@dataclass
+class Node:
+    """One CFG node: a simple statement, a compound-statement header
+    (``if``/``for``/``while``/``with``/handler), or a synthetic
+    entry/exit."""
+
+    id: int
+    kind: str                      #: "entry" | "exit" | "raise" | "stmt"
+    stmt: Optional[ast.AST] = None
+
+
+class CFG:
+    """Per-function control-flow graph with labeled edges
+    (``"normal"`` / ``"exception"``)."""
+
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        self._succ: Dict[int, List[Tuple[int, str]]] = {}
+        self.entry = self._new("entry")
+        self.exit = self._new("exit")
+        self.raise_exit = self._new("raise")
+
+    def _new(self, kind: str, stmt: Optional[ast.AST] = None) -> int:
+        nid = len(self.nodes)
+        self.nodes.append(Node(id=nid, kind=kind, stmt=stmt))
+        self._succ[nid] = []
+        return nid
+
+    def add_edge(self, a: int, b: int, kind: str = "normal") -> None:
+        if (b, kind) not in self._succ[a]:
+            self._succ[a].append((b, kind))
+
+    def succ(self, nid: int) -> List[Tuple[int, str]]:
+        return self._succ[nid]
+
+    def preds(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {n.id: [] for n in self.nodes}
+        for a, edges in self._succ.items():
+            for b, _ in edges:
+                out[b].append(a)
+        return out
+
+    def stmt_nodes(self) -> Iterable[Node]:
+        return (n for n in self.nodes if n.stmt is not None)
+
+    def raise_reachable(self) -> bool:
+        """True when some path from entry reaches the raise exit — i.e.
+        the function may raise under the model."""
+        seen = {self.entry}
+        work = [self.entry]
+        while work:
+            for b, _ in self.succ(work.pop()):
+                if b == self.raise_exit:
+                    return True
+                if b not in seen:
+                    seen.add(b)
+                    work.append(b)
+        return False
+
+
+def walk_own_body(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function's body, excluding nested function subtrees
+    (mirrors the engine's ``walk_own``)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def terminal_attr(expr: ast.AST) -> Optional[str]:
+    """``a.b.c`` -> ``"c"``; bare ``name`` -> ``"name"``; else None."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _call_may_raise(call: ast.Call,
+                    summaries: Dict[str, Summary]) -> bool:
+    name = terminal_attr(call.func)
+    if name is None:
+        return True
+    if isinstance(call.func, ast.Name) and name in NO_RAISE_NAMES:
+        return False
+    if isinstance(call.func, ast.Attribute) and name in NO_RAISE_METHODS:
+        return False
+    s = summaries.get(name)
+    if s is not None and s.cannot_raise:
+        return False
+    return True
+
+
+def _expr_may_raise(expr: ast.AST,
+                    summaries: Dict[str, Summary]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # nested bodies don't execute here
+        if isinstance(node, ast.Call) and _call_may_raise(node, summaries):
+            return True
+        if isinstance(node, ast.Await):
+            # await of a call inherits the callee; await of a bare
+            # future re-raises the future's exception
+            if not isinstance(node.value, ast.Call):
+                return True
+    return False
+
+
+def _header_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """The expressions a compound statement evaluates at its header
+    node (body statements get their own nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    return []
+
+
+def may_raise(stmt: ast.AST,
+              summaries: Optional[Dict[str, Summary]] = None) -> bool:
+    """May executing this statement's own expressions raise?  For
+    compound statements only the header expression counts."""
+    summaries = summaries or {}
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef, ast.Pass, ast.Break, ast.Continue,
+                         ast.Global, ast.Nonlocal, ast.Import,
+                         ast.ImportFrom)):
+        # ``import`` inside a function can raise ImportError, but for
+        # this codebase lazy imports are of own modules; treating them
+        # as raising would fence every telemetry gate in try/except
+        return False
+    hdr = _header_exprs(stmt)
+    if hdr:
+        return any(_expr_may_raise(e, summaries) for e in hdr)
+    if isinstance(stmt, (ast.Try,)):
+        return False  # its body statements carry their own edges
+    return any(_expr_may_raise(v, summaries)
+               for v in ast.iter_child_nodes(stmt)
+               if isinstance(v, ast.expr))
+
+
+_BROAD_HANDLER_NAMES = {"Exception", "BaseException"}
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    names = [h.type] if not isinstance(h.type, ast.Tuple) \
+        else list(h.type.elts)
+    return any(terminal_attr(t) in _BROAD_HANDLER_NAMES for t in names)
+
+
+class _Builder:
+    def __init__(self, cfg: CFG, summaries: Dict[str, Summary]) -> None:
+        self.cfg = cfg
+        self.summaries = summaries
+        #: innermost exception targets: list of node ids (handler
+        #: headers), plus a propagate target when no handler is broad
+        self.exc_targets: List[int] = [cfg.raise_exit]
+        self.loop_stack: List[Tuple[int, int]] = []  # (continue, break)
+
+    def _exc_edges(self, nid: int) -> None:
+        for t in self.exc_targets:
+            self.cfg.add_edge(nid, t, "exception")
+
+    def seq(self, stmts: List[ast.stmt], follow: int) -> int:
+        """Build ``stmts`` so the last falls through to ``follow``;
+        returns the entry node id of the sequence."""
+        entry = follow
+        for stmt in reversed(stmts):
+            entry = self.one(stmt, entry)
+        return entry
+
+    def one(self, stmt: ast.stmt, follow: int) -> int:
+        cfg = self.cfg
+        nid = cfg._new("stmt", stmt)
+        raises = may_raise(stmt, self.summaries)
+
+        if isinstance(stmt, ast.Return):
+            cfg.add_edge(nid, cfg.exit)
+            if raises:
+                self._exc_edges(nid)
+            return nid
+        if isinstance(stmt, ast.Raise):
+            self._exc_edges(nid)
+            return nid
+        if isinstance(stmt, ast.Break):
+            cfg.add_edge(nid, self.loop_stack[-1][1])
+            return nid
+        if isinstance(stmt, ast.Continue):
+            cfg.add_edge(nid, self.loop_stack[-1][0])
+            return nid
+        if isinstance(stmt, ast.If):
+            body = self.seq(stmt.body, follow)
+            orelse = self.seq(stmt.orelse, follow) if stmt.orelse else follow
+            cfg.add_edge(nid, body, "then")
+            cfg.add_edge(nid, orelse, "else")
+            if raises:
+                self._exc_edges(nid)
+            return nid
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            after = self.seq(stmt.orelse, follow) if stmt.orelse else follow
+            self.loop_stack.append((nid, follow))
+            body = self.seq(stmt.body, nid)
+            self.loop_stack.pop()
+            cfg.add_edge(nid, body)
+            cfg.add_edge(nid, after)
+            if raises:
+                self._exc_edges(nid)
+            return nid
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            body = self.seq(stmt.body, follow)
+            cfg.add_edge(nid, body)
+            if raises:
+                self._exc_edges(nid)
+            return nid
+        if isinstance(stmt, ast.Try):
+            # finally approximated as plain successor statements
+            after = self.seq(stmt.finalbody, follow) if stmt.finalbody \
+                else follow
+            handler_ids: List[int] = []
+            broad = False
+            for h in stmt.handlers:
+                hid = cfg._new("stmt", h)
+                hbody = self.seq(h.body, after)
+                cfg.add_edge(hid, hbody)
+                handler_ids.append(hid)
+                broad = broad or _handler_is_broad(h)
+            if not handler_ids:          # try/finally only: propagate
+                targets = list(self.exc_targets)
+            elif broad:
+                targets = handler_ids
+            else:                        # narrow handlers may not catch
+                targets = handler_ids + list(self.exc_targets)
+            saved = self.exc_targets
+            self.exc_targets = targets
+            orelse = self.seq(stmt.orelse, after) if stmt.orelse else after
+            body = self.seq(stmt.body, orelse)
+            self.exc_targets = saved
+            cfg.add_edge(nid, body)
+            return nid
+        # simple statement
+        cfg.add_edge(nid, follow)
+        if raises:
+            self._exc_edges(nid)
+        return nid
+
+
+def build_cfg(fn: ast.AST,
+              summaries: Optional[Dict[str, Summary]] = None) -> CFG:
+    """CFG of one ``def`` / ``async def`` body."""
+    cfg = CFG()
+    b = _Builder(cfg, summaries or {})
+    entry = b.seq(list(fn.body), cfg.exit)
+    cfg.add_edge(cfg.entry, entry)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# reaching definitions
+# ---------------------------------------------------------------------------
+
+def _target_names(t: ast.AST, out: Set[str]) -> None:
+    if isinstance(t, ast.Name):
+        out.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _target_names(e, out)
+    elif isinstance(t, ast.Starred):
+        _target_names(t.value, out)
+
+
+def assigned_names(stmt: ast.AST) -> Set[str]:
+    """Local names this statement (its header, for compounds) binds."""
+    out: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            _target_names(t, out)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        _target_names(stmt.target, out)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        _target_names(stmt.target, out)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for i in stmt.items:
+            if i.optional_vars is not None:
+                _target_names(i.optional_vars, out)
+    elif isinstance(stmt, ast.ExceptHandler):
+        if stmt.name:
+            out.add(stmt.name)
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        out.add(stmt.name)
+    elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+        for a in stmt.names:
+            out.add(a.asname or a.name.split(".")[0])
+    # walrus targets in any contained expression
+    for node in ast.walk(stmt) if not isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) else ():
+        if isinstance(node, ast.NamedExpr):
+            _target_names(node.target, out)
+    return out
+
+
+def reaching_definitions(cfg: CFG) -> Dict[int, Dict[str, Set[int]]]:
+    """IN sets of the classic reaching-definitions dataflow: for each
+    node id, a map of local name -> ids of the definition nodes that
+    may reach it."""
+    gen: Dict[int, Set[str]] = {}
+    for n in cfg.nodes:
+        gen[n.id] = assigned_names(n.stmt) if n.stmt is not None else set()
+    preds = cfg.preds()
+    IN: Dict[int, Dict[str, Set[int]]] = {n.id: {} for n in cfg.nodes}
+    OUT: Dict[int, Dict[str, Set[int]]] = {n.id: {} for n in cfg.nodes}
+    work = [n.id for n in cfg.nodes]
+    while work:
+        nid = work.pop()
+        new_in: Dict[str, Set[int]] = {}
+        for p in preds[nid]:
+            for name, defs in OUT[p].items():
+                new_in.setdefault(name, set()).update(defs)
+        IN[nid] = new_in
+        new_out = {name: set(defs) for name, defs in new_in.items()
+                   if name not in gen[nid]}
+        for name in gen[nid]:
+            new_out[name] = {nid}
+        if new_out != OUT[nid]:
+            OUT[nid] = new_out
+            for s, _ in cfg.succ(nid):
+                work.append(s)
+    return IN
+
+
+# ---------------------------------------------------------------------------
+# module call summaries
+# ---------------------------------------------------------------------------
+
+def iter_functions(tree: ast.AST) -> Iterable[ast.AST]:
+    """Every def/async def in the module, including methods."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+_RESOLUTION_METHODS = {"set_result", "set_exception", "cancel"}
+
+
+def _iteration_children(fn: ast.AST, param: str) -> Set[str]:
+    """Names bound by iterating ``param`` (or ``zip(param, ...)``):
+    ``for _, fut, _ in pending`` makes ``fut`` a child of ``pending``."""
+    kids: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+            continue
+        it = node.iter
+        sources = [it]
+        if isinstance(it, ast.Call) and terminal_attr(it.func) == "zip":
+            sources = list(it.args)
+        hit = any(isinstance(s, ast.Name) and s.id == param
+                  for s in sources)
+        if hit:
+            _target_names(node.target, kids)
+    return kids
+
+
+def resolves_param(fn: ast.AST, param: str) -> bool:
+    """Does ``fn``'s body resolve futures held in parameter ``param``
+    (set_result/set_exception/cancel on it or on a name bound by
+    iterating it)?"""
+    names = {param} | _iteration_children(fn, param)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _RESOLUTION_METHODS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in names:
+            return True
+    return False
+
+
+def module_summaries(tree: ast.AST,
+                     max_rounds: int = 4) -> Dict[str, Summary]:
+    """Per-module call summaries keyed by bare function/method name
+    (last def wins on name collisions).  ``cannot_raise`` is solved to
+    fixpoint so helpers that only call other summarized no-raise
+    helpers converge."""
+    fns: Dict[str, ast.AST] = {}
+    for fn in iter_functions(tree):
+        fns[fn.name] = fn
+    resolves: Dict[str, FrozenSet[str]] = {}
+    params: Dict[str, Tuple[str, ...]] = {}
+    for name, fn in fns.items():
+        pnames = tuple(a.arg for a in fn.args.args)
+        params[name] = pnames
+        resolves[name] = frozenset(p for p in pnames
+                                   if resolves_param(fn, p))
+    cannot: Dict[str, bool] = {name: False for name in fns}
+    for _ in range(max_rounds):
+        table = {name: Summary(resolves_params=resolves[name],
+                               cannot_raise=cannot[name],
+                               param_names=params[name])
+                 for name in fns}
+        new = {name: not build_cfg(fn, table).raise_reachable()
+               for name, fn in fns.items()}
+        if new == cannot:
+            break
+        cannot = new
+    return {name: Summary(resolves_params=resolves[name],
+                          cannot_raise=cannot[name],
+                          param_names=params[name])
+            for name in fns}

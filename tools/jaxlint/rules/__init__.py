@@ -27,6 +27,9 @@ class Rule:
 
     name = ""
     description = ""
+    #: reported in ``--format json`` records; every current rule gates
+    #: commit (exit 1), so "error" is the only severity in use
+    severity = "error"
 
     def applies(self, relpath: str) -> bool:
         return True
@@ -61,6 +64,8 @@ from tools.jaxlint.rules import traced_branch     # noqa: E402,F401
 from tools.jaxlint.rules import static_args       # noqa: E402,F401
 from tools.jaxlint.rules import typed_raises      # noqa: E402,F401
 from tools.jaxlint.rules import collective_context  # noqa: E402,F401
+from tools.jaxlint.rules import async_discipline  # noqa: E402,F401
+from tools.jaxlint.rules import event_contract    # noqa: E402,F401
 
 
 def default_rules() -> List[Rule]:

@@ -1,0 +1,432 @@
+"""Async-discipline rules over the serving layer (flow-aware).
+
+Three rules, all scoped to ``pint_tpu/serving/`` and
+``pint_tpu/streaming/door.py`` by default, all built on
+:mod:`tools.jaxlint.flow`:
+
+* ``stranded-future`` — the static form of the chaos-drill zero-
+  stranded-futures contract: a future *created* (``loop.create_future``
+  / ``asyncio.Future()``), *popped from a pending list*, or *received
+  as a ``pending`` parameter* must not be able to reach function exit —
+  including along an exception edge — without being resolved
+  (``set_result`` / ``set_exception`` / ``cancel``), re-enqueued, or
+  handed to a callee whose module summary resolves that parameter.
+* ``await-under-lock`` — an ``await`` while holding a synchronous
+  primitive: inside a plain ``with`` over a lock-like context manager,
+  or on a CFG path between a bare ``.acquire()`` and its ``.release()``.
+  (``async with`` over asyncio primitives is the sanctioned form and is
+  not flagged.)
+* ``blocking-in-coroutine`` — event-loop stalls in an ``async def``
+  dispatch path: ``os.fsync``, ``time.sleep``, builtin ``open``,
+  ``block_until_ready``, or a journal ``commit`` called directly from a
+  coroutine instead of through the sync ``run()`` dispatch seam.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.jaxlint import flow
+from tools.jaxlint.rules import ScopedRule, register
+
+ASYNC_SCOPE = ("pint_tpu/serving/", "pint_tpu/streaming/door.py")
+
+_RESOLUTION_METHODS = {"set_result", "set_exception", "cancel"}
+#: parameter names treated as carrying unresolved futures
+_PENDING_PARAMS = ("pending",)
+
+
+def _mentions(expr: ast.AST, needle: str) -> bool:
+    for node in ast.walk(expr):
+        name = node.attr if isinstance(node, ast.Attribute) \
+            else node.id if isinstance(node, ast.Name) else None
+        if name is not None and needle in name.lower():
+            return True
+    return False
+
+
+def _contains_name(expr: ast.AST, var: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == var
+               for n in ast.walk(expr))
+
+
+def _is_pending_param(name: str) -> bool:
+    return name in _PENDING_PARAMS or name.endswith("_pending")
+
+
+def _future_factory(value: ast.AST) -> bool:
+    """``loop.create_future()`` / ``asyncio.Future()`` / ``Future()``."""
+    if not isinstance(value, ast.Call):
+        return False
+    t = flow.terminal_attr(value.func)
+    return t in {"create_future", "Future"}
+
+
+def _single_name_test(test: ast.AST) -> Optional[Tuple[str, bool]]:
+    """``if v:`` -> (v, True); ``if not v:`` -> (v, False); else None.
+    Also matches ``len(v)`` truthiness forms."""
+    neg = False
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        neg, test = True, test.operand
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) \
+            and test.func.id == "len" and len(test.args) == 1:
+        test = test.args[0]
+    if isinstance(test, ast.Name):
+        return (test.id, not neg)
+    return None
+
+
+class _FnAnalysis:
+    """Shared per-function CFG + taint machinery."""
+
+    def __init__(self, fn: ast.AST, summaries: Dict[str, flow.Summary]):
+        self.fn = fn
+        self.summaries = summaries
+        self.cfg = flow.build_cfg(fn, summaries)
+        #: names bound by iterating a tainted var (children inherit
+        #: resolution-kill status): var -> children
+        self.children: Dict[str, Set[str]] = {}
+
+    def kids(self, var: str) -> Set[str]:
+        if var not in self.children:
+            self.children[var] = flow._iteration_children(self.fn, var)
+        return self.children[var]
+
+    # -- kill predicate ------------------------------------------------------
+
+    def _call_resolves_arg(self, call: ast.Call, var: str) -> bool:
+        """Is ``var`` passed to a summarized callee on a parameter the
+        callee resolves?"""
+        name = flow.terminal_attr(call.func)
+        s = self.summaries.get(name or "")
+        if s is None or not s.resolves_params:
+            return False
+        offset = 1 if isinstance(call.func, ast.Attribute) \
+            and s.param_names[:1] in (("self",), ("cls",)) else 0
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Name) and a.id == var:
+                j = i + offset
+                if j < len(s.param_names) \
+                        and s.param_names[j] in s.resolves_params:
+                    return True
+        for kw in call.keywords:
+            if kw.arg in s.resolves_params \
+                    and isinstance(kw.value, ast.Name) \
+                    and kw.value.id == var:
+                return True
+        return False
+
+    def kills(self, node: flow.Node, var: str) -> bool:
+        stmt = node.stmt
+        if stmt is None:
+            return False
+        names = {var} | self.kids(var)
+        # a loop that iterates the var (or zip(var, ...)) and resolves a
+        # bound element kills AT THE HEADER: the empty-iteration path is
+        # vacuously resolved (nothing to strand)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            sources = [stmt.iter]
+            if isinstance(stmt.iter, ast.Call) \
+                    and flow.terminal_attr(stmt.iter.func) == "zip":
+                sources = list(stmt.iter.args)
+            if any(isinstance(s, ast.Name) and s.id == var
+                   for s in sources):
+                bound: Set[str] = set()
+                flow._target_names(stmt.target, bound)
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr in _RESOLUTION_METHODS \
+                            and isinstance(sub.func.value, ast.Name) \
+                            and sub.func.value.id in bound:
+                        return True
+            return False
+        if isinstance(stmt, (ast.Return, ast.Expr)) \
+                and stmt.value is not None:
+            v = stmt.value
+            if isinstance(stmt, ast.Return) and _contains_name(v, var):
+                return True  # ownership handed to the caller
+        exprs = [stmt] if not flow._header_exprs(stmt) \
+            else flow._header_exprs(stmt)
+        for root in exprs:
+            for sub in ast.walk(root):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue
+                if isinstance(sub, ast.Await) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id in names:
+                    return True  # awaiting it consumes/propagates it
+                if isinstance(sub, ast.Yield) and sub.value is not None \
+                        and _contains_name(sub.value, var):
+                    return True
+                if not isinstance(sub, ast.Call):
+                    continue
+                t = flow.terminal_attr(sub.func)
+                if t in _RESOLUTION_METHODS \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and isinstance(sub.func.value, ast.Name) \
+                        and sub.func.value.id in names:
+                    return True
+                if t in {"append", "appendleft", "insert", "extend",
+                         "put_nowait", "put"} \
+                        and any(_contains_name(a, var) for a in sub.args):
+                    return True  # re-enqueued: the drain path owns it
+                if self._call_resolves_arg(sub, var):
+                    return True
+        return False
+
+    # -- the path query ------------------------------------------------------
+
+    def stranded_path(self, start: int, var: str) -> bool:
+        """Can ``var`` reach the exit or raise exit from ``start``'s
+        successors without hitting a kill?  Exception edges OUT of a
+        kill node still count (the exception may pre-empt the kill)."""
+        cfg = self.cfg
+        work = [start]
+        seen = {start}
+        while work:
+            nid = work.pop()
+            node = cfg.nodes[nid]
+            if nid in (cfg.exit, cfg.raise_exit):
+                return True
+            killed = self.kills(node, var)
+            # branch-emptiness refinement: ``if not v: return`` — the
+            # then-branch holds no futures to strand
+            branch_skip: Optional[str] = None
+            if node.stmt is not None and isinstance(node.stmt, ast.If):
+                t = _single_name_test(node.stmt.test)
+                if t is not None and (t[0] == var
+                                      or t[0] in self.kids(var)):
+                    branch_skip = "then" if not t[1] else "else"
+            for succ, kind in cfg.succ(nid):
+                if killed and kind != "exception":
+                    continue
+                if branch_skip is not None and kind == branch_skip:
+                    continue
+                if succ not in seen:
+                    seen.add(succ)
+                    work.append(succ)
+        return False
+
+
+@register
+class StrandedFutureRule(ScopedRule):
+    name = "stranded-future"
+    description = ("a future created/popped from a pending list can "
+                   "reach function exit (incl. along an exception edge) "
+                   "without set_result/set_exception/cancel/re-enqueue")
+    default_files = ASYNC_SCOPE
+
+    def check(self, info) -> Iterable:
+        summaries = flow.module_summaries(info.tree)
+        out: List = []
+        for fn in flow.iter_functions(info.tree):
+            an = _FnAnalysis(fn, summaries)
+            cfg = an.cfg
+            sources: List[Tuple[int, str, ast.AST]] = []
+            for p in fn.args.args:
+                if _is_pending_param(p.arg):
+                    sources.append((cfg.entry, p.arg, fn))
+            for node in cfg.stmt_nodes():
+                stmt = node.stmt
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+                if isinstance(tgt, ast.Name) \
+                        and _future_factory(stmt.value):
+                    sources.append((node.id, tgt.id, stmt))
+                elif isinstance(tgt, ast.Tuple) \
+                        and isinstance(stmt.value, ast.Tuple) \
+                        and len(tgt.elts) == len(stmt.value.elts):
+                    # ``batch, door.pending = door.pending[:k], ...`` —
+                    # names assigned a slice/pop of a pending list hold
+                    # unresolved futures
+                    for t, v in zip(tgt.elts, stmt.value.elts):
+                        if isinstance(t, ast.Name) \
+                                and _mentions(v, "pending"):
+                            sources.append((node.id, t.id, stmt))
+            for nid, var, anchor in sources:
+                if an.stranded_path(nid, var):
+                    out.append(info.finding(
+                        self.name, anchor,
+                        f"future(s) in {var!r} can reach "
+                        f"{fn.name}() exit unresolved — every path "
+                        "(including exception edges) must set_result/"
+                        "set_exception/cancel, re-enqueue, or hand off "
+                        "to a resolving callee"))
+        return out
+
+
+_LOCKY_CONSTRUCTORS = {"Lock", "RLock", "Condition", "Semaphore",
+                       "BoundedSemaphore"}
+
+
+def _locky_context(expr: ast.AST) -> bool:
+    """A sync-lock-like context manager: a name/attr containing "lock",
+    or an inline threading primitive constructor."""
+    if isinstance(expr, ast.Call):
+        t = flow.terminal_attr(expr.func)
+        if t in _LOCKY_CONSTRUCTORS:
+            return True
+        return False
+    name = flow.terminal_attr(expr)
+    return name is not None and "lock" in name.lower()
+
+
+def _dotted(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _dotted(expr.value)
+        return f"{base}.{expr.attr}" if base else None
+    return None
+
+
+def _stmt_has_await(node: flow.Node) -> bool:
+    stmt = node.stmt
+    if stmt is None:
+        return False
+    roots = flow._header_exprs(stmt) or [stmt]
+    for root in roots:
+        for sub in ast.walk(root):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                continue
+            if isinstance(sub, ast.Await):
+                return True
+    return False
+
+
+@register
+class AwaitUnderLockRule(ScopedRule):
+    name = "await-under-lock"
+    description = ("awaiting while holding a synchronous primitive "
+                   "(plain `with <lock>:` body, or between a bare "
+                   ".acquire() and its .release())")
+    default_files = ASYNC_SCOPE
+
+    def check(self, info) -> Iterable:
+        out: List = []
+        summaries = flow.module_summaries(info.tree)
+        for fn in flow.iter_functions(info.tree):
+            # form 1: plain `with` over a lock-like manager
+            for node in flow.walk_own_body(fn):
+                if isinstance(node, ast.With) and any(
+                        _locky_context(i.context_expr)
+                        for i in node.items):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef,
+                                            ast.Lambda)):
+                            continue
+                        if isinstance(sub, ast.Await):
+                            out.append(info.finding(
+                                self.name, sub,
+                                "await inside a plain `with` over a "
+                                "sync primitive blocks every other "
+                                "coroutine on the loop; use an "
+                                "asyncio primitive (`async with`) or "
+                                "release before awaiting"))
+            # form 2: bare .acquire() ... .release() span on the CFG
+            cfg = flow.build_cfg(fn, summaries)
+            for node in cfg.stmt_nodes():
+                stmt = node.stmt
+                call = None
+                if isinstance(stmt, ast.Expr) \
+                        and isinstance(stmt.value, ast.Call):
+                    call = stmt.value
+                elif isinstance(stmt, ast.Assign) \
+                        and isinstance(stmt.value, ast.Call):
+                    call = stmt.value
+                if call is None \
+                        or flow.terminal_attr(call.func) != "acquire" \
+                        or not isinstance(call.func, ast.Attribute):
+                    continue
+                holder = _dotted(call.func.value)
+                if holder is None or "lock" not in holder.lower():
+                    continue
+                # BFS from the acquire, stopping at matching release
+                work = [s for s, _ in cfg.succ(node.id)]
+                seen = set(work)
+                while work:
+                    nid = work.pop()
+                    n = cfg.nodes[nid]
+                    released = False
+                    if n.stmt is not None:
+                        for sub in ast.walk(n.stmt):
+                            if isinstance(sub, ast.Call) \
+                                    and flow.terminal_attr(sub.func) \
+                                    == "release" \
+                                    and isinstance(sub.func,
+                                                   ast.Attribute) \
+                                    and _dotted(sub.func.value) \
+                                    == holder:
+                                released = True
+                    if released:
+                        continue
+                    if _stmt_has_await(n):
+                        out.append(info.finding(
+                            self.name, n.stmt,
+                            f"await while holding {holder}.acquire() "
+                            "(no release on this path); blocking the "
+                            "loop under a sync lock deadlocks "
+                            "coalescing"))
+                        continue
+                    for s, _ in cfg.succ(nid):
+                        if s not in seen:
+                            seen.add(s)
+                            work.append(s)
+        return out
+
+
+#: (terminal attr, required base-name needle or None)
+_BLOCKING_METHODS = (
+    ("fsync", None),            # os.fsync anywhere in a coroutine
+    ("block_until_ready", None),
+    ("sleep", "time"),          # time.sleep (asyncio.sleep is fine)
+    ("commit", "journal"),      # journal group-commit belongs in run()
+)
+
+
+@register
+class BlockingInCoroutineRule(ScopedRule):
+    name = "blocking-in-coroutine"
+    description = ("fsync/time.sleep/open/block_until_ready/journal "
+                   "commit directly in an `async def` dispatch path "
+                   "instead of the sanctioned sync run() seam")
+    default_files = ASYNC_SCOPE
+
+    def check(self, info) -> Iterable:
+        out: List = []
+        for fn in flow.iter_functions(info.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in flow.walk_own_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id == "open":
+                    out.append(info.finding(
+                        self.name, node,
+                        "builtin open() in a coroutine blocks the "
+                        "event loop on file I/O; do it in the sync "
+                        "run()/record() seam"))
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                t = node.func.attr
+                for meth, needle in _BLOCKING_METHODS:
+                    if t != meth:
+                        continue
+                    if needle is not None and not _mentions(
+                            node.func.value, needle):
+                        continue
+                    out.append(info.finding(
+                        self.name, node,
+                        f"{t}() in a coroutine blocks the event loop "
+                        "(every door stalls); move it behind the sync "
+                        "dispatch seam or an executor"))
+                    break
+        return out

@@ -1,0 +1,274 @@
+"""Telemetry producer/validator contract cross-checker.
+
+``tools/telemetry_report.py`` holds nine ``*_EVENT_ATTRS`` tables — the
+validator contracts ``--check`` enforces at runtime over recorded
+series.  This rule parses those tables **from source** (never imports
+the module) and diffs them against every lifecycle-emit call site in
+``pint_tpu`` (``record_event`` / ``lifecycle_event`` / the per-module
+``_emit_event`` wrappers), so a producer/validator drift fails at
+commit time instead of the next full-mode run:
+
+* ``unknown event`` — an emitted literal event name no validator table
+  covers;
+* ``missing required attr`` — the contract requires an attr the call
+  site never passes (sites forwarding ``**attrs`` are exempt from this
+  check: their keys are dynamic);
+* ``rejected attr type`` — a literal/inferable attr value whose type
+  the validator's ``isinstance`` check (bools excluded unless the
+  contract says ``bool``) would reject;
+* ``dead contract`` — a contract event with **no remaining producer**
+  anywhere in ``pint_tpu`` (anchored on ``pint_tpu/telemetry/
+  __init__.py``, the package that owns the emit seam, so the pragma
+  and baseline layers have a stable line to hang on).
+
+The same extractor is imported by ``telemetry_report --check``'s
+self-test, which asserts the runtime tables round-trip through it: one
+source of truth, two consumers.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tools.jaxlint.rules import ScopedRule, register
+
+#: where the validator contract tables live, repo-relative
+CONTRACT_SOURCE = "tools/telemetry_report.py"
+#: module-level dict assignments matching this suffix are contracts
+TABLE_SUFFIX = "_EVENT_ATTRS"
+#: call names that emit one lifecycle event with a literal first arg
+EMIT_FUNCS = {"record_event", "lifecycle_event", "_emit_event"}
+#: repo-relative file dead-contract findings anchor on
+DEAD_CONTRACT_ANCHOR = "pint_tpu/telemetry/__init__.py"
+
+
+@dataclass
+class EmitSite:
+    """One statically-extracted lifecycle emission."""
+
+    name: str
+    lineno: int
+    col: int
+    #: attr -> inferred type name, or None when not statically known
+    attrs: Dict[str, Optional[str]] = field(default_factory=dict)
+    #: True when the call forwards ``**attrs`` (keys unknowable)
+    dynamic: bool = False
+    node: Optional[ast.AST] = None
+
+
+def _terminal(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _infer_type(expr: ast.AST) -> Optional[str]:
+    """Static type of an attr value, or None when unknowable.  Mirrors
+    what the validator's ``isinstance`` would see at runtime."""
+    if isinstance(expr, ast.Constant):
+        return type(expr.value).__name__
+    if isinstance(expr, ast.JoinedStr):
+        return "str"
+    if isinstance(expr, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(expr, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(expr, ast.Tuple):
+        return "tuple"
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in {"int", "float", "str", "bool", "len",
+                                 "list", "dict", "tuple", "sorted"}:
+        return {"len": "int", "sorted": "list"}.get(
+            expr.func.id, expr.func.id)
+    if isinstance(expr, ast.UnaryOp):
+        return _infer_type(expr.operand)
+    return None
+
+
+def extract_producers(tree: ast.AST) -> List[EmitSite]:
+    """Every emit call site with a literal event name in one module.
+    Wrapper *definitions* forward a name variable, not a literal, so
+    they are naturally skipped."""
+    out: List[EmitSite] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal(node.func) not in EMIT_FUNCS:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        site = EmitSite(name=node.args[0].value,
+                        lineno=node.lineno,
+                        col=node.col_offset + 1, node=node)
+        for kw in node.keywords:
+            if kw.arg is None:
+                site.dynamic = True
+            else:
+                site.attrs[kw.arg] = _infer_type(kw.value)
+        out.append(site)
+    return out
+
+
+ContractTable = Dict[str, Dict[str, Tuple[str, ...]]]
+
+_table_cache: Dict[str, Tuple[float, ContractTable]] = {}
+
+
+def load_contract_table(repo: str) -> Optional[ContractTable]:
+    """Parse every ``*_EVENT_ATTRS`` table from the contract source's
+    AST: event name -> {attr -> accepted type names}.  Returns None
+    when the repo has no contract source (fixture repos)."""
+    path = os.path.join(repo, CONTRACT_SOURCE)
+    if not os.path.isfile(path):
+        return None
+    mtime = os.path.getmtime(path)
+    cached = _table_cache.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    table: ContractTable = {}
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        if not isinstance(tgt, ast.Name) \
+                or not tgt.id.endswith(TABLE_SUFFIX) \
+                or not isinstance(stmt.value, ast.Dict):
+            continue
+        for k, v in zip(stmt.value.keys, stmt.value.values):
+            if not isinstance(k, ast.Constant) \
+                    or not isinstance(v, ast.Dict):
+                continue
+            attrs: Dict[str, Tuple[str, ...]] = {}
+            for ak, av in zip(v.keys, v.values):
+                if not isinstance(ak, ast.Constant):
+                    continue
+                if isinstance(av, ast.Tuple):
+                    names = tuple(t.id for t in av.elts
+                                  if isinstance(t, ast.Name))
+                elif isinstance(av, ast.Name):
+                    names = (av.id,)
+                else:
+                    names = ()
+                attrs[ak.value] = names
+            table[k.value] = attrs
+    _table_cache[path] = (mtime, table)
+    return table
+
+
+def _type_accepted(inferred: str, accepted: Tuple[str, ...]) -> bool:
+    """Mirror the validator: ``isinstance(v, typ)`` with bools rejected
+    unless the contract spells ``bool``."""
+    if not accepted:
+        return True  # contract leaves the attr untyped
+    if inferred == "bool":
+        return "bool" in accepted
+    if inferred in accepted:
+        return True
+    # isinstance(int_value, float) is False, but every float-typed
+    # contract spells (int, float); no other widening exists
+    return False
+
+
+_producer_cache: Dict[str, Tuple[float, Dict[str, int]]] = {}
+
+
+def repo_producers(repo: str) -> Dict[str, int]:
+    """Event name -> producer count over all of ``pint_tpu`` (cached on
+    the contract source's mtime as a cheap staleness proxy plus the
+    package file set)."""
+    pkg = os.path.join(repo, "pint_tpu")
+    if not os.path.isdir(pkg):
+        return {}
+    stamp = 0.0
+    paths: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".")]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                p = os.path.join(dirpath, fn)
+                paths.append(p)
+                stamp = max(stamp, os.path.getmtime(p))
+    cached = _producer_cache.get(pkg)
+    if cached is not None and cached[0] == stamp:
+        return cached[1]
+    counts: Dict[str, int] = {}
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=p)
+        except SyntaxError:
+            continue
+        for site in extract_producers(tree):
+            counts[site.name] = counts.get(site.name, 0) + 1
+    _producer_cache[pkg] = (stamp, counts)
+    return counts
+
+
+def _repo_of(info) -> str:
+    ap = info.abspath.replace(os.sep, "/")
+    if ap.endswith(info.path):
+        return ap[: -len(info.path)].rstrip("/") or "."
+    return "."
+
+
+@register
+class EventContractRule(ScopedRule):
+    name = "event-contract"
+    description = ("lifecycle emit sites must agree with the validator "
+                   "contracts in tools/telemetry_report.py: known event "
+                   "name, required attrs present, attr types the "
+                   "validator accepts, and no dead contracts")
+    default_files = ("pint_tpu/",)
+
+    def check(self, info):
+        table = load_contract_table(_repo_of(info))
+        if table is None:
+            return []
+        out = []
+        for site in extract_producers(info.tree):
+            contract = table.get(site.name)
+            if contract is None:
+                out.append(info.finding(
+                    self.name, site.node,
+                    f"event {site.name!r} has no validator contract in "
+                    f"{CONTRACT_SOURCE}; add a *{TABLE_SUFFIX} entry "
+                    "(or fix the name) so --check covers it"))
+                continue
+            if not site.dynamic:
+                for attr in contract:
+                    if attr not in site.attrs:
+                        out.append(info.finding(
+                            self.name, site.node,
+                            f"event {site.name!r} emitted without "
+                            f"required attr {attr!r}; the validator "
+                            "rejects the record"))
+            for attr, inferred in site.attrs.items():
+                accepted = contract.get(attr)
+                if accepted is None or inferred is None:
+                    continue  # extra attrs are allowed; unknown types skip
+                if not _type_accepted(inferred, accepted):
+                    out.append(info.finding(
+                        self.name, site.node,
+                        f"event {site.name!r} attr {attr!r} is "
+                        f"statically {inferred}, but the validator "
+                        f"requires {'/'.join(accepted)}"))
+        if info.path == DEAD_CONTRACT_ANCHOR:
+            produced = repo_producers(_repo_of(info))
+            for name in sorted(table):
+                if produced.get(name, 0) == 0:
+                    out.append(info.finding(
+                        self.name, info.tree.body[0] if info.tree.body
+                        else ast.Module(body=[], type_ignores=[]),
+                        f"dead contract: validator covers event "
+                        f"{name!r} but no pint_tpu producer emits it "
+                        "any more; delete the table entry or restore "
+                        "the producer"))
+        return out

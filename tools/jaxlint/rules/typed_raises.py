@@ -28,29 +28,49 @@ import ast
 import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.jaxlint.engine import REPO, FileInfo
+from tools.jaxlint.engine import REPO, FileInfo, pint_tpu_subpackages
 from tools.jaxlint.rules import ScopedRule, register
 
-#: the modules the typed-raise contract covers (files or directories)
-DEFAULT_TARGETS = (
-    "pint_tpu/io/par.py",
-    "pint_tpu/io/tim.py",
-    "pint_tpu/io/__init__.py",
+#: pint_tpu subpackages outside the typed-raise contract, each with a
+#: written justification (the target-map contract test asserts every
+#: discovered subpackage is covered or listed here).  All six are
+#: ported-reference surface: upstream PINT's API raises builtin
+#: ValueError/RuntimeError, and exception parity with the reference is
+#: tracked by the migration tables, not migrated wholesale by lint.
+TYPED_RAISE_EXCLUSIONS: Dict[str, str] = {
+    "models": "ported reference surface: component API exception parity "
+              "with upstream PINT is a migration-table concern",
+    "native": "double-double primitive shims: pure arithmetic, no raise "
+              "surface of its own beyond build-time checks",
+    "observatory": "ported reference surface (site/clock data loading) "
+                   "keeping upstream's builtin-exception API",
+    "orbital": "ported reference surface: binary models keep upstream "
+               "PINT's builtin-exception API",
+    "output": "ported reference surface (publishing/export helpers) "
+              "keeping upstream's builtin-exception API",
+    "pintk": "ported reference surface (plotting/gui glue) keeping "
+             "upstream's builtin-exception API",
+    "scripts": "CLI entry points: argparse/SystemExit territory, not "
+               "library raise surface",
+    "templates": "ported reference surface: template classes keep "
+                 "upstream's builtin-exception API",
+}
+
+#: top-level core modules (not subpackages) the contract also covers
+TYPED_RAISE_EXTRA_FILES = (
     "pint_tpu/toa.py",
     "pint_tpu/fitter.py",
     "pint_tpu/gls_fitter.py",
     "pint_tpu/residuals.py",
     "pint_tpu/grid.py",
-    "pint_tpu/integrity/",
-    "pint_tpu/runtime/",
-    "pint_tpu/telemetry/",
-    "pint_tpu/serving/",
-    "pint_tpu/autotune/",
-    "pint_tpu/catalog/",
-    "pint_tpu/precision/",
-    "pint_tpu/amortized/",
-    "pint_tpu/streaming/",
 )
+
+#: the modules the typed-raise contract covers: every discovered
+#: pint_tpu subpackage minus the justified exclusions, plus the
+#: top-level core files
+DEFAULT_TARGETS = tuple(
+    f"pint_tpu/{pkg}/" for pkg in pint_tpu_subpackages()
+    if pkg not in TYPED_RAISE_EXCLUSIONS) + TYPED_RAISE_EXTRA_FILES
 
 DISALLOWED = {
     "ValueError", "RuntimeError", "Exception", "BaseException",

@@ -95,6 +95,10 @@ ELASTIC_EVENT_ATTRS = {
     "plan_strategy": {"workload": str, "chosen": str, "source": str},
     "device_evicted": {"device_id": int, "reason": str},
     "mesh_degraded": {"from_rung": int, "to_rung": int, "reason": str},
+    "elastic.sweep_done": {"chunks": int, "rungs": list,
+                           "evicted": list, "degradations": int,
+                           "steady_state_recompiles": int,
+                           "recompiles_by_rung": dict},
 }
 
 _PLAN_KINDS = ("pjit", "shard_map", "single")
@@ -898,6 +902,16 @@ def validate_elastic_event(ev: dict, where: str,
              f"mesh_degraded must strictly descend the ladder "
              f"(from_rung {attrs['from_rung']} -> to_rung "
              f"{attrs['to_rung']})")
+    if name == "elastic.sweep_done":
+        c = attrs.get("chunks")
+        if isinstance(c, int) and not isinstance(c, bool) and c < 1:
+            _err(errors, where,
+                 f"elastic.sweep_done 'chunks' is {c!r}, must be >= 1")
+        for key in ("degradations", "steady_state_recompiles"):
+            v = attrs.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                _err(errors, where,
+                     f"elastic.sweep_done {key!r} is negative ({v!r})")
 
 
 def validate_span_dict(sp, where: str, errors: List[str],
@@ -1395,6 +1409,10 @@ def self_test(errors: List[str]) -> int:
                          reason="canary_mismatch", chunk=2)
         run.record_event("mesh_degraded", from_rung=8, to_rung=4,
                          reason="device_loss", chunk=2, n_remaining=7)
+        run.record_event("elastic.sweep_done", chunks=4, rungs=[8, 8, 4],
+                         evicted=[3], degradations=1,
+                         steady_state_recompiles=0,
+                         recompiles_by_rung={"8": 1, "4": 1})
         # warm-serving producer drift check: the aotcache/service event
         # contract (SERVING_EVENT_ATTRS) through the loose-event path —
         # hit, the mandatory-reason degrade, and one served request
@@ -1534,12 +1552,12 @@ def self_test(errors: List[str]) -> int:
             _err(errors, "selftest", "span tracer produced no root span")
         n = validate_run_dir(run_dir, errors)
         # run_start, span, event, 2x cost_profile, 2x collective_profile,
-        # sharding_plan, 3x elastic events, 3x serving events, 2x
+        # sharding_plan, 4x elastic events, 3x serving events, 2x
         # autotune events, 3x catalog events, 3x precision events,
         # 4x amortized events, 3x streaming events, 5x load events,
         # 5x durability events, metrics, run_end
-        if n < 42:
-            _err(errors, "selftest", f"expected >= 41 records, got {n}")
+        if n < 43:
+            _err(errors, "selftest", f"expected >= 42 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
@@ -1593,6 +1611,31 @@ def self_test(errors: List[str]) -> int:
             _err(errors, "selftest",
                  "tuning-manifest round trip did not yield exactly one "
                  "decision")
+        # one source of truth, two consumers: the jaxlint event-contract
+        # cross-checker parses THIS file's *_EVENT_ATTRS tables from
+        # source; assert the runtime tables round-trip through that
+        # static extractor, so the linter can never check a different
+        # contract than --check enforces
+        from tools.jaxlint.rules.event_contract import load_contract_table
+
+        static_table = load_contract_table(REPO) or {}
+        runtime_table = {}
+        for tname, tval in globals().items():
+            if tname.endswith("_EVENT_ATTRS") and isinstance(tval, dict):
+                for ev, attrs in tval.items():
+                    runtime_table[ev] = {
+                        k: tuple(t.__name__ for t in
+                                 (typ if isinstance(typ, tuple)
+                                  else (typ,)))
+                        for k, typ in attrs.items()}
+        if static_table != runtime_table:
+            drift = sorted(
+                set(static_table) ^ set(runtime_table)) or sorted(
+                ev for ev in runtime_table
+                if static_table.get(ev) != runtime_table[ev])
+            _err(errors, "selftest",
+                 "event-contract static extractor disagrees with the "
+                 f"runtime *_EVENT_ATTRS tables on: {drift}")
         return n
 
 
