@@ -298,6 +298,22 @@ def bench_b1855_gls():
                      "error": f"{type(e).__name__}: {e}"}
     st.mark("posterior measurement")
 
+    # phase-prediction measurement (ROADMAP serving item): a warmed
+    # PredictorCache served through the TimingService predict door —
+    # coalesced batches for throughput, single-request probes for the
+    # latency distribution, with the settle pass paying every lazy
+    # window generation outside the measured window.  Never fatal,
+    # same degraded-block discipline.
+    try:
+        predict = predict_block()
+    except Exception as e:
+        predict = {"windows": None, "predicts_per_s": None,
+                   "cache_hit_rate": None,
+                   "p50_ms": None, "p99_ms": None,
+                   "steady_state_compiles": None,
+                   "error": f"{type(e).__name__}: {e}"}
+    st.mark("predict measurement")
+
     # work-per-byte scaling accounting (ROADMAP item 2): fused-dispatch
     # rate measured live, efficiency/scatter bytes restamped from the
     # newest committed scalewatch series.  Never fatal, same degraded-
@@ -385,6 +401,7 @@ def bench_b1855_gls():
         "precision": prec,
         "catalog": catalog,
         "posterior": posterior,
+        "predict": predict,
         "scaling": scaling,
         "streaming": streaming,
         "load": load,
@@ -1304,6 +1321,96 @@ def posterior_block():
     }
 
 
+#: predict-block knobs: coverage span / polyco grid for the predictor
+#: cache, request fan + per-request epoch count for the coalesced
+#: throughput pass, and the single-request latency probes
+PREDICT_SPAN_DAYS = 2.0
+PREDICT_SEGLENGTH_MIN = 60.0
+PREDICT_NCOEFF = 12
+PREDICT_REQUESTS = 8
+PREDICT_TIMES_PER_REQUEST = 48
+PREDICT_LATENCY_PROBES = 12
+
+
+def predict_block():
+    """The headline's ``predict{}`` block: the phase-prediction read
+    path — a :class:`~pint_tpu.predict.cache.PredictorCache` over a
+    barycentric polyco grid, registered (and warmed) on a
+    :class:`~pint_tpu.serving.service.TimingService`, then coalesced
+    predict batches plus single-request latency probes served through
+    the predict door.  A settle pass pays every lazy window
+    generation outside the measured window, so the measured cache-hit
+    rate is the steady state and the JAX accounting delta proves zero
+    steady-state recompiles.  ``tools/perfwatch.py`` gates
+    ``predicts_per_s`` drops, ``p99_ms`` rises, and
+    ``cache_hit_rate`` drops."""
+    from pint_tpu.predict import PredictorCache, PredictRequest
+    from pint_tpu.serving import ServeConfig, TimingService
+    from pint_tpu.telemetry import jaxevents
+
+    model, _ = _ngc_or_fallback(np.random.default_rng(20260807))
+    mjd0 = float(model.PEPOCH.value)
+    cache = PredictorCache(model, mjd0, mjd0 + PREDICT_SPAN_DAYS,
+                           obs="@", segLength=PREDICT_SEGLENGTH_MIN,
+                           ncoeff=PREDICT_NCOEFF)
+    n, k = PREDICT_TIMES_PER_REQUEST, PREDICT_REQUESTS
+    svc = TimingService(ServeConfig(time_buckets=(n,),
+                                    batch_buckets=(1, k)))
+    svc.register_predictor(cache, warm=True)
+
+    lo, hi = cache.coverage()
+    rng = np.random.default_rng(20260808)
+
+    def batch(tag):
+        return [PredictRequest(
+            times_mjd=np.sort(rng.uniform(lo, hi, size=n)),
+            request_id=f"{tag}-{i}") for i in range(k)]
+
+    # settle: every lazy window regenerates through build() — outside
+    # the door, so the latency ring never sees generation walls — and
+    # one served batch absorbs any first-dispatch overhead before the
+    # measured window (the load block's calibration-pass discipline)
+    cache.build()
+    svc.serve_predicts(batch("settle"))
+    h0, m0 = cache.hits, cache.misses
+
+    before = jaxevents.counts()
+    t0 = time.time()
+    out = svc.serve_predicts(batch("bench"))
+    elapsed = time.time() - t0
+    for i in range(PREDICT_LATENCY_PROBES):
+        svc.serve_predicts([PredictRequest(
+            times_mjd=np.sort(rng.uniform(lo, hi, size=n)),
+            request_id=f"lat-{i}")])
+    steady = jaxevents.counts().compiles - before.compiles
+
+    if elapsed <= 0:
+        raise RuntimeError(f"predict timing degenerate: {elapsed}s")
+    for r in out:
+        if not (np.all(np.isfinite(r.phase_frac))
+                and np.all(np.isfinite(r.freq))):
+            raise RuntimeError("predict door produced non-finite "
+                               "phases/frequencies")
+    dh, dm = cache.hits - h0, cache.misses - m0
+    if dm:
+        raise RuntimeError(
+            f"{dm} predictor-cache miss(es) after the settle pass — "
+            "lazy generation leaked into the measured window")
+    if steady:
+        raise RuntimeError(
+            f"{steady} steady-state recompile(s) on the predict "
+            "path — the warmed ladder missed a dispatch shape")
+    lat = svc.predict_latency_summary()
+    return {
+        "windows": int(cache.n_windows),
+        "predicts_per_s": round(n * k / elapsed, 3),
+        "cache_hit_rate": round(dh / (dh + dm), 4) if (dh + dm) else 0.0,
+        "p50_ms": round(lat["p50_ms"], 3),
+        "p99_ms": round(lat["p99_ms"], 3),
+        "steady_state_compiles": int(steady),
+    }
+
+
 def bench_ngc6440e_wls():
     """Secondary: the r01/r02 NGC6440E WLS grid (continuity metric)."""
     from pint_tpu.fitter import WLSFitter
@@ -1601,6 +1708,12 @@ def main():
         # warm-served posterior draw/log-prob throughput and latency
         # (perfwatch gates draws_per_s drops and p99_ms rises)
         "posterior": r["posterior"],
+        # phase-prediction read path: predictor-cache window count,
+        # warm-served epoch throughput, steady-state cache-hit rate,
+        # and per-request latency through the predict door (perfwatch
+        # gates predicts_per_s drops, p99_ms rises, and cache_hit_rate
+        # drops)
+        "predict": r["predict"],
         # work-per-byte scaling: fused-dispatch rate (live) plus the
         # committed scalewatch series' efficiency / scatter bytes
         # (perfwatch gates efficiency/dispatch drops and scatter-byte

@@ -1,0 +1,52 @@
+"""Phase prediction: batched polyco generation, caches, and serving.
+
+The read path.  Every other request class writes (fit, posterior,
+update); this package serves the highest-fanout workload a timing
+deployment actually fields — "what is the pulse phase/period at time
+t?" — the TEMPO2 predictive mode round-tripped by
+:mod:`pint_tpu.polycos`, rebuilt as a device-resident subsystem:
+
+* :mod:`pint_tpu.predict.generate` — batched on-device predictor
+  generation: Chebyshev/polyco coefficient fits to the model's
+  absolute phase, one jitted least-squares kernel vmapped over
+  (pulsar, epoch-window) with window counts bucketed on a shape
+  ladder;
+* :mod:`pint_tpu.predict.cache` — :class:`~pint_tpu.predict.cache.
+  PredictorCache`: per-pulsar predictor state keyed by the
+  established vkey scheme (param/mask signature + TOA version +
+  window grid), invalidated *incrementally* by the streaming engine
+  (an accepted append regenerates only the windows whose validity
+  spans it), with ``predictor_cache`` hit/miss/invalidate/regenerate
+  telemetry;
+* :mod:`pint_tpu.predict.door` — :class:`~pint_tpu.predict.door.
+  PredictRequest` / :class:`~pint_tpu.predict.door.PredictResult`,
+  the batched phase/freq evaluation kernels, and the warm-pool
+  registration the :class:`~pint_tpu.serving.service.TimingService`
+  predict door dispatches through.
+"""
+
+from pint_tpu.predict.cache import PredictorCache
+from pint_tpu.predict.door import (
+    DEFAULT_TIME_BUCKETS,
+    PredictRequest,
+    PredictResult,
+    warm_predict,
+)
+from pint_tpu.predict.generate import (
+    DEFAULT_WINDOW_BUCKETS,
+    PredictorSet,
+    generate_predictor_sets,
+    generate_predictors,
+)
+
+__all__ = [
+    "PredictorCache",
+    "PredictRequest",
+    "PredictResult",
+    "PredictorSet",
+    "generate_predictors",
+    "generate_predictor_sets",
+    "warm_predict",
+    "DEFAULT_TIME_BUCKETS",
+    "DEFAULT_WINDOW_BUCKETS",
+]

@@ -1,0 +1,313 @@
+"""Incrementally-invalidated per-pulsar predictor caches.
+
+A :class:`PredictorCache` owns one pulsar's window grid over a fixed
+epoch range and regenerates coefficients *lazily, per window*: a
+window is built the first time a prediction needs it and rebuilt only
+after an invalidation marks it stale.  The streaming engine drives
+invalidation through :meth:`invalidate_span` — an accepted append
+that moves the timing solution touches only the windows whose
+validity spans the appended epochs; a quarantined-only batch never
+changes the model parameters, so nothing regenerates (both pinned by
+the acceptance tests).  Windows the span does NOT cover keep their
+previous coefficients: that is the polyco operating convention —
+predictors are regenerated on their validity cadence, and the
+per-window ``regen_count`` makes the staleness auditable.
+
+Identity follows the established vkey scheme
+(:func:`~pint_tpu.grid._model_param_sig` + TOA version + the window
+grid), and every cache decision emits a ``predictor_cache``
+telemetry event (``kind`` in hit | miss | invalidate | regenerate)
+that ``tools/telemetry_report --check`` validates.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+from pint_tpu.polycos import MIN_PER_DAY, Polycos
+from pint_tpu.predict.generate import (
+    DEFAULT_WINDOW_BUCKETS,
+    PredictorSet,
+    fit_windows,
+    node_targets,
+    window_tmids,
+)
+
+__all__ = ["PredictorCache"]
+
+#: boundary tolerance [days] — the Polycos dispatch discipline: tmid
+#: quantization can open ~1e-11-day gaps at window edges, and the
+#: polynomial is perfectly valid that far outside its nominal span
+EDGE_TOL = 1e-9
+
+
+def _emit_event(name: str, **attrs) -> None:
+    """Predictor-cache telemetry: the shared
+    :func:`pint_tpu.telemetry.lifecycle_event` emitter."""
+    if config._telemetry_mode == "off":
+        return
+    from pint_tpu import telemetry
+
+    telemetry.lifecycle_event(name, **attrs)
+
+
+class PredictorCache:
+    """One pulsar's predictor state over a fixed window grid.
+
+    ``model`` is the live :class:`~pint_tpu.models.timing_model.
+    TimingModel` (for streaming integration, the SAME object the
+    engine's warm refits mutate — regeneration then fits the moved
+    solution); ``toas`` optionally ties the vkey to a TOA container's
+    version counter (the :func:`~pint_tpu.serving.warmup.fitter_vkey`
+    discipline)."""
+
+    def __init__(self, model, mjd_start: float, mjd_end: float,
+                 obs: str = "@", segLength: float = 60.0,
+                 ncoeff: int = 12, obsFreq: float = 1400.0,
+                 toas=None, pool=None,
+                 window_buckets: Sequence[int] = DEFAULT_WINDOW_BUCKETS):
+        from pint_tpu.grid import _model_param_sig
+        from pint_tpu.observatory import get_observatory
+
+        if int(ncoeff) < 2:
+            raise UsageError(f"PredictorCache needs ncoeff >= 2, "
+                             f"got {ncoeff}")
+        self.model = model
+        self.mjd_start = float(mjd_start)
+        self.mjd_end = float(mjd_end)
+        self.obs = obs
+        self.obsname = get_observatory(obs).name
+        self.segLength = float(segLength)
+        self.ncoeff = int(ncoeff)
+        self.obsFreq = float(obsFreq)
+        self.window_buckets = tuple(window_buckets)
+        self._toas = toas
+        self.pool = pool
+        self._tmid = window_tmids(self.mjd_start, self.mjd_end,
+                                  self.segLength)
+        W = len(self._tmid)
+        half_d = self.segLength / (2 * MIN_PER_DAY)
+        self._tstart = self._tmid - half_d
+        self._tstop = self._tmid + half_d
+        self._rint = np.zeros(W)
+        self._rfrac = np.zeros(W)
+        self._coeffs = np.zeros((W, self.ncoeff))
+        self._rms = np.zeros(W)
+        self._fresh = np.zeros(W, dtype=bool)
+        #: per-window rebuild counter — the incremental-invalidation
+        #: pin's witness (an append regenerates ONLY its span)
+        self.regen_count = np.zeros(W, dtype=np.int64)
+        self.f0 = float(model.F0.value)
+        self._sig = _model_param_sig(model)
+        self.hits = 0
+        self.misses = 0
+        self.invalidated = 0
+        self.regenerated = 0
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def n_windows(self) -> int:
+        return len(self._tmid)
+
+    @property
+    def nnode(self) -> int:
+        return max(2 * self.ncoeff, self.ncoeff + 4)
+
+    @property
+    def grid_key(self) -> tuple:
+        return (round(self.mjd_start, 11), round(self.mjd_end, 11),
+                self.segLength, self.ncoeff, self.obsname, self.obsFreq)
+
+    @property
+    def vkey(self) -> tuple:
+        """Param/mask signature + TOA version + window grid — the
+        established invalidation key scheme (grid bundle /
+        checkpoint fingerprint discipline)."""
+        tv = (int(getattr(self._toas, "_version", 0)),
+              len(self._toas)) if self._toas is not None else (0, 0)
+        return (self._sig, tv, self.grid_key)
+
+    def coverage(self) -> Tuple[float, float]:
+        """The epoch range the grid can answer for, [start, stop)."""
+        return float(self._tstart[0]), float(self._tstop[-1])
+
+    # -- dispatch ------------------------------------------------------------
+
+    def window_of(self, t_mjd) -> np.ndarray:
+        """Window index per time — half-open spans with the Polycos
+        EDGE_TOL at the grid boundaries; outside coverage is a typed
+        refusal (the door validates with this before enqueue)."""
+        t = np.atleast_1d(np.asarray(t_mjd, dtype=np.float64))
+        idx = np.clip(np.searchsorted(self._tstart, t, side="right") - 1,
+                      0, self.n_windows - 1)
+        bad = (t < self._tstart[idx] - EDGE_TOL) \
+            | (t > self._tstop[idx] + EDGE_TOL)
+        if np.any(bad):
+            lo, hi = self.coverage()
+            raise UsageError(
+                f"prediction epoch(s) {t[bad][:3]} outside this "
+                f"predictor grid's coverage [{lo}, {hi})")
+        return idx
+
+    # -- invalidation --------------------------------------------------------
+
+    def _check_sig(self) -> None:
+        """Safety net for model mutation outside the streaming hook:
+        a moved param/mask signature stales the whole grid."""
+        from pint_tpu.grid import _model_param_sig
+
+        sig = _model_param_sig(self.model)
+        if sig != self._sig:
+            self._sig = sig
+            self.f0 = float(self.model.F0.value)
+            self._mark_stale(np.nonzero(self._fresh)[0])
+
+    def _mark_stale(self, idxs: np.ndarray) -> int:
+        idxs = np.asarray(idxs, dtype=int)
+        live = idxs[self._fresh[idxs]] if len(idxs) else idxs
+        if len(live):
+            self._fresh[live] = False
+            self.invalidated += len(live)
+            _emit_event("predictor_cache", kind="invalidate",
+                        windows=int(len(live)), latency_ms=0.0)
+        return int(len(live))
+
+    def invalidate_all(self) -> int:
+        """Stale every built window (conservative path: a row-only
+        update batch that moved the solution carries no epochs to
+        scope the span by).  Returns the count invalidated."""
+        from pint_tpu.grid import _model_param_sig
+
+        self._sig = _model_param_sig(self.model)
+        self.f0 = float(self.model.F0.value)
+        return self._mark_stale(np.nonzero(self._fresh)[0])
+
+    def invalidate_span(self, lo_mjd: float, hi_mjd: float) -> int:
+        """The streaming engine's incremental hook: stale only the
+        windows whose validity spans ``[lo_mjd, hi_mjd]`` (an
+        accepted append's epoch range), and adopt the model's moved
+        signature for the grid — untouched windows keep their
+        previous coefficients until their own regeneration cadence
+        (the documented polyco tradeoff).  Returns the count
+        invalidated."""
+        from pint_tpu.grid import _model_param_sig
+
+        self._sig = _model_param_sig(self.model)
+        self.f0 = float(self.model.F0.value)
+        hit = np.nonzero((self._tstart <= float(hi_mjd))
+                         & (self._tstop >= float(lo_mjd))
+                         & self._fresh)[0]
+        return self._mark_stale(hit)
+
+    # -- (re)generation ------------------------------------------------------
+
+    def ensure(self, idxs) -> int:
+        """Regenerate the stale/unbuilt windows among ``idxs`` in one
+        batched device fit (padded onto the window ladder).  Returns
+        the count regenerated."""
+        idxs = np.unique(np.asarray(idxs, dtype=int))
+        todo = idxs[~self._fresh[idxs]]
+        if not len(todo):
+            return 0
+        t0 = time.perf_counter()
+        host = node_targets(self.model, self._tmid[todo],
+                            self.segLength, self.ncoeff, self.obs,
+                            self.obsFreq)
+        coeffs, rms = fit_windows(
+            host["x"], host["y"], self.ncoeff, self.segLength / 2.0,
+            pool=self.pool, window_buckets=self.window_buckets)
+        self._rint[todo] = host["rint"]
+        self._rfrac[todo] = host["rfrac"]
+        self._coeffs[todo] = coeffs
+        self._rms[todo] = rms
+        self._fresh[todo] = True
+        self.regen_count[todo] += 1
+        self.regenerated += len(todo)
+        _emit_event("predictor_cache", kind="regenerate",
+                    windows=int(len(todo)),
+                    latency_ms=float(1e3 * (time.perf_counter() - t0)))
+        return int(len(todo))
+
+    def build(self) -> int:
+        """Regenerate every stale window now (service warm-up: a
+        prebuilt grid serves its first request all-hit)."""
+        return self.ensure(np.arange(self.n_windows))
+
+    # -- the gather seam the door dispatches through -------------------------
+
+    def gather(self, times_mjd) -> dict:
+        """Per-time predictor operands for the batched eval kernels:
+        freshness ensured (hit/miss accounted per WINDOW, the unit a
+        cache decision is made at), windows regenerated as needed,
+        and the per-time ``dt/rfrac/rint/f0/coeffs`` arrays gathered
+        window-major."""
+        t = np.atleast_1d(np.asarray(times_mjd, dtype=np.float64))
+        self._check_sig()
+        idx = self.window_of(t)
+        needed = np.unique(idx)
+        n_hit = int(np.count_nonzero(self._fresh[needed]))
+        n_miss = int(len(needed) - n_hit)
+        self.hits += n_hit
+        self.misses += n_miss
+        if n_hit:
+            _emit_event("predictor_cache", kind="hit",
+                        windows=n_hit, latency_ms=0.0)
+        if n_miss:
+            _emit_event("predictor_cache", kind="miss",
+                        windows=n_miss, latency_ms=0.0)
+            self.ensure(needed[~self._fresh[needed]])
+        return {"dt": (t - self._tmid[idx]) * MIN_PER_DAY,
+                "rfrac": self._rfrac[idx],
+                "rint": self._rint[idx],
+                "f0": np.full(len(t), self.f0),
+                "coeffs": self._coeffs[idx],
+                "windows": idx}
+
+    def predict(self, times_mjd) -> Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]:
+        """Host-side prediction (tests, examples, the bitwise
+        regeneration pin): ``(phase_int, phase_frac, freq)`` at each
+        time, evaluated with the same Horner recurrence the device
+        eval kernel runs."""
+        g = self.gather(times_mjd)
+        dt, coeffs = g["dt"], g["coeffs"]
+        poly = np.zeros_like(dt)
+        dpoly = np.zeros_like(dt)
+        for i in range(self.ncoeff - 1, 0, -1):
+            poly = poly * dt + coeffs[:, i]
+            dpoly = dpoly * dt + i * coeffs[:, i]
+        poly = poly * dt + coeffs[:, 0]
+        raw = g["rfrac"] + 60.0 * g["f0"] * dt + poly
+        ip = np.floor(raw)
+        return g["rint"] + ip, raw - ip, g["f0"] + dpoly / 60.0
+
+    # -- export --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {"windows": int(self.n_windows),
+                "hits": int(self.hits), "misses": int(self.misses),
+                "invalidated": int(self.invalidated),
+                "regenerated": int(self.regenerated),
+                "hit_rate": (self.hits / total) if total else 0.0}
+
+    def to_predictor_set(self) -> PredictorSet:
+        """The built grid as an immutable :class:`~pint_tpu.predict.
+        generate.PredictorSet` (every window regenerated first)."""
+        self.build()
+        return PredictorSet(
+            psrname=str(self.model.PSR.value or ""),
+            obsname=self.obsname, obsfreq=self.obsFreq,
+            segLength=self.segLength, ncoeff=self.ncoeff, f0=self.f0,
+            tmid=self._tmid.copy(), rphase_int=self._rint.copy(),
+            rphase_frac=self._rfrac.copy(),
+            coeffs=self._coeffs.copy(), fit_rms=self._rms.copy())
+
+    def to_polycos(self) -> Polycos:
+        return self.to_predictor_set().to_polycos()

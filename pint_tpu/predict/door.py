@@ -1,0 +1,258 @@
+"""PredictRequest/PredictResult, batched eval kernels, warm registration.
+
+The serving half of the predict subsystem: the request/result types
+the :class:`~pint_tpu.serving.service.TimingService` predict door
+coalesces, the module-jit registry of batched phase/frequency
+evaluation kernels (one executable per coefficient count — times and
+batch lanes retrace on the shape ladders like every other serving
+kernel), the grouped/padded dispatch over a
+:class:`~pint_tpu.predict.cache.PredictorCache`, and
+:func:`warm_predict` for WarmPool/AOTCache registration so the steady
+state serves with zero fresh compiles.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+from pint_tpu.serving.batcher import DEFAULT_BATCH_BUCKETS, bucket_of
+
+__all__ = [
+    "DEFAULT_TIME_BUCKETS",
+    "PredictRequest",
+    "PredictResult",
+    "eval_kernel",
+    "predict_vkey",
+    "run_predict_requests",
+    "update_epoch_span",
+    "warm_predict",
+]
+
+#: shape ladder for the per-request epoch count — predict batches are
+#: read traffic, typically tens to hundreds of epochs per request
+DEFAULT_TIME_BUCKETS: Tuple[int, ...] = (16, 64, 256, 1024)
+
+
+@dataclass
+class PredictRequest:
+    """One phase/frequency prediction request: epochs (MJD, UTC at the
+    cache's observatory) inside the registered predictor's coverage."""
+
+    times_mjd: np.ndarray
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+
+    def __post_init__(self):
+        t = np.atleast_1d(np.asarray(self.times_mjd, dtype=np.float64))
+        if t.ndim != 1 or t.size < 1:
+            raise UsageError(
+                f"PredictRequest needs a non-empty 1-D array of MJDs, "
+                f"got shape {np.asarray(self.times_mjd).shape}")
+        self.times_mjd = t
+
+    @property
+    def n(self) -> int:
+        return int(self.times_mjd.size)
+
+
+@dataclass
+class PredictResult:
+    """Predicted absolute phase (int + frac split, cycles) and apparent
+    spin frequency (Hz) at each requested epoch."""
+
+    phase_int: np.ndarray
+    phase_frac: np.ndarray
+    freq: np.ndarray
+    bucket: int
+    batch: int
+    windows: int = 0
+    compiles: int = 0
+    latency_ms: Optional[float] = None
+    request_id: Optional[str] = None
+
+
+#: module-level jit registry: one eval executable per coefficient
+#: count (times/batch dimensions retrace per padded shape, which the
+#: ladders bound)
+_eval_kernels: Dict[tuple, object] = {}
+
+
+def eval_kernel(ncoeff: int):
+    """The batched polyco evaluation kernel for ``ncoeff``
+    coefficients: TEMPO convention ``phase = rfrac + 60*f0*dt +
+    sum(c_i dt^i)`` and ``freq = f0 + (1/60) sum(i c_i dt^(i-1))``
+    with dt in minutes from the window midpoint, returned as
+    ``(floor, frac, freq)`` so the integer ramp can be recombined
+    host-side at full precision."""
+    import jax
+    import jax.numpy as jnp
+
+    nc = int(ncoeff)
+    key = (nc,)
+    if key in _eval_kernels:
+        return _eval_kernels[key]
+
+    def kern(dt, rfrac, f0, coeffs):
+        poly = jnp.zeros_like(dt)
+        dpoly = jnp.zeros_like(dt)
+        for i in range(nc - 1, 0, -1):
+            poly = poly * dt + coeffs[..., i]
+            dpoly = dpoly * dt + i * coeffs[..., i]
+        poly = poly * dt + coeffs[..., 0]
+        raw = rfrac + 60.0 * f0 * dt + poly
+        ip = jnp.floor(raw)
+        return ip, raw - ip, f0 + dpoly / 60.0
+
+    _eval_kernels[key] = jax.jit(kern)
+    return _eval_kernels[key]
+
+
+def predict_vkey() -> tuple:
+    """Version key for predict warm-pool/AOT entries.  The eval and
+    fit executables are parameter-independent (every model-dependent
+    quantity rides in as an operand), so the key is schema-only — a
+    cache populated for one pulsar re-warms all-hit for any other."""
+    return ("predict_kernel", 1)
+
+
+def _dispatch(cache, pool, bucket: int, group: List[PredictRequest],
+              batch_buckets: Sequence[int]) -> List[PredictResult]:
+    """Serve one shape-aligned group: pad the batch lane onto the
+    batch ladder, gather per-time predictor operands from the cache,
+    run the pooled eval kernel once, slice per request."""
+    from pint_tpu.telemetry import jaxevents
+
+    t0 = time.perf_counter()
+    B = bucket_of(len(group), batch_buckets)
+    ncoeff = cache.ncoeff
+    dt = np.zeros((B, bucket))
+    rf = np.zeros((B, bucket))
+    f0 = np.zeros((B, bucket))
+    cf = np.zeros((B, bucket, ncoeff))
+    rint = np.zeros((B, bucket))
+    nwin: List[int] = []
+    for i, q in enumerate(group):
+        g = cache.gather(q.times_mjd)
+        n = q.n
+        dt[i, :n] = g["dt"]
+        rf[i, :n] = g["rfrac"]
+        f0[i, :n] = g["f0"]
+        cf[i, :n] = g["coeffs"]
+        rint[i, :n] = g["rint"]
+        nwin.append(int(len(np.unique(g["windows"]))))
+    name = f"predict.eval[{B}x{bucket}x{ncoeff}]"
+    operands = (dt, rf, f0, cf)
+    before = jaxevents.counts()
+    handle = pool.lookup(name, operands) if pool is not None else None
+    fn = handle if handle is not None else eval_kernel(ncoeff)
+    ip, frac, freq = (np.asarray(a) for a in fn(*operands))
+    compiles = jaxevents.counts().compiles - before.compiles
+    wall_ms = 1e3 * (time.perf_counter() - t0)
+    out: List[PredictResult] = []
+    for i, q in enumerate(group):
+        n = q.n
+        out.append(PredictResult(
+            phase_int=rint[i, :n] + ip[i, :n],
+            phase_frac=frac[i, :n].copy(),
+            freq=freq[i, :n].copy(),
+            bucket=int(bucket), batch=len(group),
+            windows=nwin[i],
+            compiles=int(compiles) if i == 0 else 0,
+            latency_ms=wall_ms,
+            request_id=q.request_id))
+    return out
+
+
+def run_predict_requests(cache, pool, requests: Sequence[PredictRequest],
+                         time_buckets: Sequence[int] = DEFAULT_TIME_BUCKETS,
+                         batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS,
+                         ) -> List[PredictResult]:
+    """Serve a coalesced predict batch: group by the time-ladder rung,
+    chunk each group at the batch-ladder top, dispatch each chunk as
+    one padded kernel call.  Results come back in request order."""
+    for q in requests:
+        if not isinstance(q, PredictRequest):
+            raise UsageError(
+                f"run_predict_requests takes PredictRequest instances, "
+                f"got {type(q).__name__}")
+    top = max(batch_buckets)
+    order = {id(q): i for i, q in enumerate(requests)}
+    by_bucket: Dict[int, List[PredictRequest]] = {}
+    for q in requests:
+        by_bucket.setdefault(bucket_of(q.n, time_buckets), []).append(q)
+    paired: List[Tuple[PredictRequest, PredictResult]] = []
+    for bucket in sorted(by_bucket):
+        qs = by_bucket[bucket]
+        for lo in range(0, len(qs), top):
+            chunk = qs[lo:lo + top]
+            paired.extend(zip(chunk, _dispatch(cache, pool, bucket, chunk,
+                                               batch_buckets)))
+    paired.sort(key=lambda pr: order[id(pr[0])])
+    return [r for _, r in paired]
+
+
+def update_epoch_span(requests) -> Tuple[Optional[float], Optional[float]]:
+    """The epoch range an update batch's appends cover — the span the
+    streaming hook scopes incremental predictor invalidation by.
+    ``(None, None)`` when the batch holds no appends."""
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    for q in requests:
+        if getattr(q, "kind", "append") != "append":
+            continue
+        mjds = np.asarray(q.new_toas.utc_mjd, dtype=np.float64)
+        if not mjds.size:
+            continue
+        lo = float(mjds.min()) if lo is None else min(lo, float(mjds.min()))
+        hi = float(mjds.max()) if hi is None else max(hi, float(mjds.max()))
+    return lo, hi
+
+
+def warm_predict(cache, pool,
+                 time_buckets: Sequence[int] = DEFAULT_TIME_BUCKETS,
+                 batch_buckets: Sequence[int] = DEFAULT_BATCH_BUCKETS):
+    """Pre-register every predict executable the ladders can dispatch:
+    the eval kernel at each (batch, times) rung and the generation fit
+    kernel at each window rung the cache's grid can need.  Entries
+    land in ``pool`` (and its AOT cache) under the schema-only
+    :func:`predict_vkey`, so a clear-caches → fresh-pool re-warm is
+    all-hit.  Also adopts ``pool`` as the cache's fit-dispatch pool.
+    Returns a :class:`~pint_tpu.serving.warmup.WarmupReport`."""
+    from pint_tpu.predict.generate import (DEFAULT_WINDOW_BUCKETS,
+                                           fit_kernel)
+    from pint_tpu.serving.warmup import WarmupReport
+
+    report = WarmupReport()
+    ncoeff = cache.ncoeff
+    nnode = cache.nnode
+    cache.pool = pool
+    top = max(batch_buckets)
+    vkey = predict_vkey()
+    rungs = sorted({(min(bucket_of(b, batch_buckets), top),
+                     bucket_of(n, time_buckets))
+                    for b, n in itertools.product(batch_buckets,
+                                                  time_buckets)})
+    for B, n in rungs:
+        name = f"predict.eval[{B}x{n}x{ncoeff}]"
+        operands = (np.zeros((B, n)), np.zeros((B, n)),
+                    np.zeros((B, n)), np.zeros((B, n, ncoeff)))
+        report.entries.append(
+            pool.warm(name, eval_kernel(ncoeff), operands, vkey=vkey))
+    ladder = tuple(getattr(cache, "window_buckets",
+                           DEFAULT_WINDOW_BUCKETS))
+    cap = bucket_of(cache.n_windows, ladder)
+    for rung in sorted({r for r in ladder if r < cap} | {cap}):
+        name = f"predict.fit[{rung}x{nnode}x{ncoeff}]"
+        # replicated Chebyshev-like abscissae keep the padded
+        # Vandermonde factorizable during warm-up too
+        x = np.tile(np.linspace(-1.0, 1.0, nnode), (rung, 1))
+        operands = (x, np.zeros((rung, nnode)))
+        report.entries.append(
+            pool.warm(name, fit_kernel(ncoeff), operands, vkey=vkey))
+    return report

@@ -1,0 +1,277 @@
+"""Batched on-device polyco generation.
+
+The host half mirrors :meth:`pint_tpu.polycos.Polycos.
+generate_polycos` exactly — Chebyshev-spaced node epochs per window,
+one TOA pipeline pass (clock corrections, TDB, posvels) and one model
+phase evaluation over ALL windows of ALL pulsars at once, tmid
+quantized up front to the TEMPO text format's %.11f precision, the
+ramp-removed fit target ``y = (phase - rphase) - 60 f0 dt`` in the
+scaled variable ``x = dt / halfspan``.
+
+The device half replaces the per-segment ``np.linalg.lstsq`` loop
+with ONE jitted least-squares kernel vmapped over (pulsar,
+epoch-window) rows: a QR factorization of each row's scaled
+Vandermonde and a triangular solve, window counts padded onto the
+:data:`DEFAULT_WINDOW_BUCKETS` ladder so a 40-window grid and a
+41-window grid share an executable.  Coefficients come back in the
+TEMPO per-minute-powers convention (rescaled on the host, where the
+arithmetic is deterministic), so a :class:`PredictorSet` round-trips
+through :class:`~pint_tpu.polycos.PolycoEntry` bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from pint_tpu.exceptions import UsageError
+from pint_tpu.logging import log
+from pint_tpu.polycos import MIN_PER_DAY, PolycoEntry, Polycos
+from pint_tpu.serving.batcher import bucket_of
+
+__all__ = ["DEFAULT_WINDOW_BUCKETS", "PredictorSet", "fit_kernel",
+           "fit_windows", "node_targets", "window_tmids",
+           "generate_predictors", "generate_predictor_sets"]
+
+#: window-count ladder for the batched fit kernel: a predictor grid's
+#: (pulsar, epoch-window) rows pad up to the nearest rung so grids of
+#: nearby sizes share one executable (the ShapeBatcher discipline)
+DEFAULT_WINDOW_BUCKETS = (4, 16, 64, 256)
+
+#: the host generator's fit-quality bar (cycles rms over the nodes)
+FIT_RMS_WARN = 1e-8
+
+# -- the module-jit fit-kernel registry -------------------------------------
+
+_fit_kernels: Dict[tuple, object] = {}
+
+
+def fit_kernel(ncoeff: int):
+    """The jitted batched least-squares kernel for ``ncoeff``
+    coefficients, built once per degree and cached at module scope
+    (the :func:`~pint_tpu.streaming.cache.step_kernel` discipline —
+    jit retraces per operand shape, so one registry entry serves
+    every window-count rung).
+
+    One row of the vmap is one (pulsar, epoch-window): build the
+    scaled Vandermonde from that row's nodes, QR-factor it, solve the
+    triangular system, and report the fit rms in cycles."""
+    fn = _fit_kernels.get((ncoeff,))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def one_window(xw, yw):
+            V = xw[:, None] ** jnp.arange(ncoeff)
+            q, r = jnp.linalg.qr(V)
+            cx = jax.scipy.linalg.solve_triangular(
+                r, q.T @ yw, lower=False)
+            resid = V @ cx - yw
+            return cx, jnp.sqrt(jnp.mean(resid * resid))
+
+        fn = jax.jit(jax.vmap(one_window))
+        _fit_kernels[(ncoeff,)] = fn
+    return fn
+
+
+def fit_windows(x: np.ndarray, y: np.ndarray, ncoeff: int, half: float,
+                pool=None,
+                window_buckets: Sequence[int] = DEFAULT_WINDOW_BUCKETS
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Fit ``coeffs (W, ncoeff)`` (TEMPO per-minute-powers convention)
+    to ramp-removed targets ``y (W, nnode)`` at scaled nodes
+    ``x (W, nnode)`` in ONE padded device dispatch, pool-first when a
+    warm pool is given.  Returns ``(coeffs, rms_cycles)``."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if x.ndim != 2 or x.shape != y.shape:
+        raise UsageError(
+            f"fit_windows takes matching (W, nnode) node/target "
+            f"arrays, got {x.shape} and {y.shape}")
+    W, nnode = x.shape
+    Wb = bucket_of(W, tuple(window_buckets))
+    xp = np.zeros((Wb, nnode))
+    yp = np.zeros((Wb, nnode))
+    xp[:W], yp[:W] = x, y
+    if Wb > W:
+        # pad rows reuse the last window's (nonsingular) node grid
+        # against a zero target: their coefficients solve to zero and
+        # are sliced away below
+        xp[W:] = x[-1]
+    name = f"predict.fit[{Wb}x{nnode}x{ncoeff}]"
+    operands = (xp, yp)
+    handle = pool.lookup(name, operands) if pool is not None else None
+    fn = handle if handle is not None else fit_kernel(ncoeff)
+    cx, rms = fn(*operands)
+    cx = np.asarray(cx)[:W]
+    rms = np.asarray(rms)[:W]
+    # rescale scaled-x power series back to per-minute powers on the
+    # host: deterministic arithmetic, shared with the host generator
+    coeffs = cx / float(half) ** np.arange(ncoeff)
+    for s in np.nonzero(rms > FIT_RMS_WARN)[0]:
+        log.warning(f"predict window {int(s)}: fit rms "
+                    f"{float(rms[s]):.2e} cycles")
+    return coeffs, rms
+
+
+# -- the host half: node epochs and ramp-removed targets --------------------
+
+def window_tmids(mjd_start: float, mjd_end: float,
+                 segLength: float) -> np.ndarray:
+    """The window-center grid covering ``[mjd_start, mjd_end)``, each
+    tmid quantized to the TEMPO text format's %.11f precision up
+    front (the host generator's round-trip discipline)."""
+    if not mjd_end > mjd_start:
+        raise UsageError(
+            f"predictor grid needs mjd_end > mjd_start, got "
+            f"[{mjd_start}, {mjd_end})")
+    span_d = segLength / MIN_PER_DAY
+    nseg = max(1, int(np.ceil((mjd_end - mjd_start) / span_d - 1e-9)))
+    return np.array([round(mjd_start + s * span_d + span_d / 2, 11)
+                     for s in range(nseg)])
+
+
+def node_targets(model, tmids: np.ndarray, segLength: float,
+                 ncoeff: int, obs: str, obsFreq: float) -> dict:
+    """The host half of generation for one pulsar: evaluate the full
+    ``TimingModel`` absolute phase at every window's Chebyshev node
+    grid in one batch (the heavy step — clock corrections, TDB,
+    posvels, model phase), then form the ramp-removed fit targets.
+
+    Returns ``{x (W, nnode), y (W, nnode), rint (W,), rfrac (W,),
+    f0, psrname, obsname}`` — exactly the quantities the device fit
+    kernel and the :class:`PredictorSet` need."""
+    from pint_tpu.observatory import get_observatory
+    from pint_tpu.toa import TOAs
+
+    obsname = get_observatory(obs).name
+    tmids = np.asarray(tmids, dtype=np.float64)
+    W = len(tmids)
+    span_d = segLength / MIN_PER_DAY
+    nnode = max(2 * ncoeff, ncoeff + 4)
+    k = np.arange(nnode)
+    cheb = np.cos(np.pi * (k + 0.5) / nnode)[::-1]  # (-1, 1)
+    mjds = tmids[:, None] + cheb[None, :] * (span_d / 2)  # (W, nnode)
+    flat = mjds.ravel()
+    n = len(flat)
+    ts = TOAs(
+        utc_mjd=np.asarray(flat, dtype=np.longdouble),
+        error_us=np.ones(n), freq_mhz=np.full(n, obsFreq),
+        obs=np.array([obsname] * n, dtype=object),
+        flags=[{} for _ in range(n)],
+    )
+    include_bipm = str(model.CLOCK.value
+                       or "").upper().startswith("TT(BIPM")
+    if obsname != "barycenter":
+        ts.apply_clock_corrections(include_bipm=include_bipm)
+    else:
+        ts.clock_corr_s = np.zeros(n)
+    ts.compute_TDBs(ephem=model.EPHEM.value or "DE440")
+    ts.compute_posvels(ephem=model.EPHEM.value or "DE440",
+                       planets=bool(model.PLANET_SHAPIRO.value))
+    ph = model.phase(ts, abs_phase="AbsPhase" in model.components)
+    ph_int = np.asarray(ph.int_).reshape(W, nnode)
+    ph_frac = np.asarray(ph.frac).reshape(W, nnode)
+    f0 = float(model.F0.value)
+    dt_min = (mjds - tmids[:, None]) * MIN_PER_DAY
+    imid = np.argmin(np.abs(dt_min), axis=1)
+    rows = np.arange(W)
+    rint = ph_int[rows, imid]
+    rfrac = ph_frac[rows, imid]
+    y = (ph_int - rint[:, None]) + (ph_frac - rfrac[:, None]) \
+        - 60.0 * f0 * dt_min
+    return {"x": dt_min / (segLength / 2.0), "y": y,
+            "rint": rint, "rfrac": rfrac, "f0": f0,
+            "psrname": str(model.PSR.value or ""), "obsname": obsname}
+
+
+# -- the assembled predictor set --------------------------------------------
+
+@dataclass
+class PredictorSet:
+    """One pulsar's device-generated predictor grid: the arrays a
+    polyco file carries, window-major, ready for the batched eval
+    kernels (and convertible back to a host :class:`~pint_tpu.
+    polycos.Polycos` for parity checks and TEMPO-format IO)."""
+
+    psrname: str
+    obsname: str
+    obsfreq: float
+    segLength: float               #: window span, minutes
+    ncoeff: int
+    f0: float
+    tmid: np.ndarray               #: (W,) window centers, MJD
+    rphase_int: np.ndarray         #: (W,) reference phase, integer part
+    rphase_frac: np.ndarray        #: (W,) reference phase, frac part
+    coeffs: np.ndarray             #: (W, ncoeff) per-minute powers
+    fit_rms: np.ndarray = field(default_factory=lambda: np.zeros(0))
+
+    @property
+    def n_windows(self) -> int:
+        return len(self.tmid)
+
+    @property
+    def tstart(self) -> np.ndarray:
+        return self.tmid - self.segLength / (2 * MIN_PER_DAY)
+
+    @property
+    def tstop(self) -> np.ndarray:
+        return self.tmid + self.segLength / (2 * MIN_PER_DAY)
+
+    def to_polycos(self) -> Polycos:
+        """The equivalent host :class:`~pint_tpu.polycos.Polycos` —
+        same coefficients, same evaluation convention (the round-trip
+        parity surface the acceptance pin compares against)."""
+        return Polycos([
+            PolycoEntry(float(self.tmid[s]), self.segLength,
+                        int(self.rphase_int[s]),
+                        float(self.rphase_frac[s]), self.f0,
+                        self.ncoeff, self.coeffs[s], obs=self.obsname,
+                        obsfreq=self.obsfreq, psrname=self.psrname)
+            for s in range(self.n_windows)])
+
+
+def generate_predictor_sets(
+        models: Sequence, mjd_start: float, mjd_end: float, obs: str,
+        segLength: float = 60.0, ncoeff: int = 12,
+        obsFreq: float = 1400.0, pool=None,
+        window_buckets: Sequence[int] = DEFAULT_WINDOW_BUCKETS
+) -> List[PredictorSet]:
+    """Generate predictor grids for SEVERAL pulsars over one shared
+    epoch range: the host evaluates each model's phase at its node
+    grids, then ALL (pulsar, epoch-window) rows ride one vmapped
+    device least-squares dispatch (padded onto the window ladder) —
+    the batched-generation shape the bench and the service warm."""
+    if not models:
+        raise UsageError("generate_predictor_sets needs >= 1 model")
+    tmids = window_tmids(mjd_start, mjd_end, segLength)
+    host = [node_targets(m, tmids, segLength, ncoeff, obs, obsFreq)
+            for m in models]
+    x = np.concatenate([h["x"] for h in host])
+    y = np.concatenate([h["y"] for h in host])
+    coeffs, rms = fit_windows(x, y, ncoeff, segLength / 2.0, pool=pool,
+                              window_buckets=window_buckets)
+    W = len(tmids)
+    out = []
+    for i, h in enumerate(host):
+        sl = slice(i * W, (i + 1) * W)
+        out.append(PredictorSet(
+            psrname=h["psrname"], obsname=h["obsname"],
+            obsfreq=float(obsFreq), segLength=float(segLength),
+            ncoeff=int(ncoeff), f0=h["f0"], tmid=tmids.copy(),
+            rphase_int=h["rint"].copy(), rphase_frac=h["rfrac"].copy(),
+            coeffs=coeffs[sl].copy(), fit_rms=rms[sl].copy()))
+    return out
+
+
+def generate_predictors(model, mjd_start: float, mjd_end: float,
+                        obs: str, segLength: float = 60.0,
+                        ncoeff: int = 12, obsFreq: float = 1400.0,
+                        pool=None) -> PredictorSet:
+    """Single-pulsar convenience over
+    :func:`generate_predictor_sets`."""
+    return generate_predictor_sets(
+        [model], mjd_start, mjd_end, obs, segLength=segLength,
+        ncoeff=ncoeff, obsFreq=obsFreq, pool=pool)[0]

@@ -26,7 +26,7 @@ AOT persistence"):
   (DESIGN.md "Traffic engineering & SLO-aware scheduling"): watermark
   admission control returning typed :class:`~pint_tpu.serving.
   admission.ShedResponse` sheds with hysteresis, priority / deadline /
-  weighted-fair arbitration across the three doors plus
+  weighted-fair arbitration across the four doors plus
   reverse-ladder pressure escalation, and the seeded closed-loop load
   harness that measures all of it under contention;
 * :mod:`~pint_tpu.serving.journal` — durable service state (DESIGN.md

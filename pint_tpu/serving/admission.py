@@ -41,9 +41,10 @@ __all__ = ["ShedResponse", "AdmissionConfig", "AdmissionController",
            "BreakerConfig", "CircuitBreaker", "REQUEST_CLASSES",
            "SHED_REASONS", "BREAKER_STATES"]
 
-#: the service's request classes, in scheduler priority order
-#: (interactive posterior above streaming update above batch fit)
-REQUEST_CLASSES = ("posterior", "update", "fit")
+#: the service's request classes, in scheduler priority order (the
+#: read path — predict — above interactive posterior above streaming
+#: update above batch fit)
+REQUEST_CLASSES = ("predict", "posterior", "update", "fit")
 
 #: why a request was shed: coalescing-queue occupancy past the
 #: watermark, in-flight p99 past the latency watermark, the

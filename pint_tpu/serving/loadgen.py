@@ -1,8 +1,8 @@
 """Closed-loop load harness: drive a live TimingService to saturation.
 
 Every published number before this module was single-client — nothing
-measured what happens when the fit, posterior, and update doors
-*compete*.  The load generator closes that gap:
+measured what happens when the fit, posterior, update, and predict
+doors *compete*.  The load generator closes that gap:
 
 * **arrival models** — ``open`` (Poisson: seeded exponential
   inter-arrival gaps at a target RPS, submissions never wait for
@@ -11,11 +11,15 @@ measured what happens when the fit, posterior, and update doors
   request in flight — self-throttling, the model that measures
   capacity without overload);
 * **request-class mixes** — weighted draws over fit / posterior /
-  update, so a 4:1 fit:posterior overload is one config line;
+  update / predict, so a 4:1 fit:posterior overload or a read-heavy
+  predict-dominant shape is one config line;
 * **ragged shape populations** — ``(n_toas, n_free)`` pairs drawn
   from a synthetic distribution or from a real catalog's pulsars
   (:class:`ShapePopulation`), with per-shape operands generated ONCE
-  and reused so the harness measures the service, not numpy;
+  and reused so the harness measures the service, not numpy; the
+  ``predict`` class draws from epoch-window spans
+  (``predict_spans``) instead — fractional sub-ranges of the
+  registered predictor's coverage at a per-request epoch count;
 * **seeded determinism** — the full schedule (arrival offsets, class
   sequence, shape sequence) is a pure function of the config seed,
   pre-generated before the clock starts (:meth:`LoadGenerator.
@@ -74,9 +78,18 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> float:
 class ShapePopulation:
     """A population of ``(n_toas, n_free)`` problem shapes the
     generator draws from — the raggedness that exercises the bucket
-    ladders instead of hammering one padded executable."""
+    ladders instead of hammering one padded executable.
 
-    def __init__(self, shapes: Sequence[Tuple[int, int]]):
+    ``predict_spans`` is the READ-class analogue: each span is a
+    ``(lo_frac, hi_frac, n_times)`` triple — a fractional sub-range
+    of the registered predictor's epoch coverage plus a per-request
+    epoch count — so predict traffic exercises the window grid and
+    the time ladder the way fit traffic exercises the shape
+    ladders."""
+
+    def __init__(self, shapes: Sequence[Tuple[int, int]],
+                 predict_spans: Optional[
+                     Sequence[Tuple[float, float, int]]] = None):
         shapes = [(int(n), int(k)) for n, k in shapes]
         if not shapes:
             raise UsageError("ShapePopulation needs >= 1 shape")
@@ -86,15 +99,30 @@ class ShapePopulation:
                     f"shape (n_toas={n}, n_free={k}) needs "
                     "1 <= n_free <= n_toas")
         self.shapes: List[Tuple[int, int]] = shapes
+        spans = None
+        if predict_spans is not None:
+            spans = [(float(lo), float(hi), int(n))
+                     for lo, hi, n in predict_spans]
+            for lo, hi, n in spans:
+                if not (0.0 <= lo < hi <= 1.0) or n < 1:
+                    raise UsageError(
+                        f"predict span ({lo}, {hi}, {n}) needs "
+                        "0 <= lo_frac < hi_frac <= 1 and n_times >= 1")
+        self.predict_spans: Optional[
+            List[Tuple[float, float, int]]] = spans
 
     @classmethod
     def synthetic(cls, n: int = 8, seed: int = 0,
                   ntoa_range: Tuple[int, int] = (24, 64),
-                  nfree_range: Tuple[int, int] = (3, 8)
+                  nfree_range: Tuple[int, int] = (3, 8),
+                  n_predict: int = 0,
+                  times_range: Tuple[int, int] = (4, 48)
                   ) -> "ShapePopulation":
         """A seeded ragged population inside the default bucket
         ladders (the same (24, 64) TOA range the synthetic catalog
-        uses)."""
+        uses).  ``n_predict > 0`` also synthesizes that many predict
+        spans: random coverage sub-ranges at epoch counts drawn from
+        ``times_range``."""
         rng = np.random.default_rng(seed)
         shapes = []
         for _ in range(int(n)):
@@ -102,7 +130,17 @@ class ShapePopulation:
             nf = int(rng.integers(nfree_range[0],
                                   min(nfree_range[1], nt) + 1))
             shapes.append((nt, nf))
-        return cls(shapes)
+        spans = None
+        if int(n_predict) > 0:
+            spans = []
+            for _ in range(int(n_predict)):
+                lo, hi = sorted(rng.uniform(0.0, 1.0, 2))
+                if hi - lo < 1e-3:
+                    lo, hi = 0.0, 1.0
+                nt = int(rng.integers(times_range[0],
+                                      times_range[1] + 1))
+                spans.append((float(lo), float(hi), nt))
+        return cls(shapes, predict_spans=spans)
 
     @classmethod
     def from_catalog(cls, pulsars: Sequence) -> "ShapePopulation":
@@ -142,6 +180,9 @@ class LoadConfig:
         default_factory=lambda: dict(DEFAULT_DEADLINES_MS))
     #: samples per posterior draw request
     posterior_draws: int = 32
+    #: epochs per predict request when the shape population carries
+    #: no predict spans of its own (one full-coverage default span)
+    predict_times: int = 8
     #: count a request whose awaiter raises as ``errored`` instead of
     #: aborting the run — the chaos-drill setting (a fault-injected
     #: dispatch fails its coalesced batch; the drill contract needs
@@ -295,7 +336,13 @@ class LoadGenerator:
                 raise UsageError(
                     "mix includes 'update': pass update_factory (a "
                     "zero-arg callable returning an UpdateRequest)")
+        if "predict" in self.cfg.mix and self.cfg.mix["predict"] \
+                and service.predictor is None:
+            raise UsageError(
+                "mix includes 'predict' but no predictor is "
+                "registered on the service (register_predictor first)")
         self._operands = self._make_operands()
+        self._predict_operands = self._make_predict_operands()
 
     # -- the deterministic schedule -----------------------------------------
 
@@ -339,6 +386,28 @@ class LoadGenerator:
                                 request_id=f"load-{i}")
         return out
 
+    def _make_predict_operands(self) -> Dict[int, object]:
+        """One :class:`~pint_tpu.predict.door.PredictRequest` per
+        predict span, epochs sampled inside the registered
+        predictor's coverage once and reused (the fit-operand
+        discipline).  Empty when the mix never offers predicts."""
+        if not ("predict" in self.cfg.mix and self.cfg.mix["predict"]):
+            return {}
+        from pint_tpu.predict.door import PredictRequest
+
+        spans = self.shapes.predict_spans \
+            or [(0.0, 1.0, int(self.cfg.predict_times))]
+        lo_cov, hi_cov = self.service.predictor.coverage()
+        width = hi_cov - lo_cov
+        rng = np.random.default_rng(self.cfg.seed + 2)
+        out: Dict[int, object] = {}
+        for i, (lo, hi, n) in enumerate(spans):
+            t = np.sort(rng.uniform(lo_cov + lo * width,
+                                    lo_cov + hi * width, int(n)))
+            out[i] = PredictRequest(times_mjd=t,
+                                    request_id=f"load-predict-{i}")
+        return out
+
     def _build_request(self, klass: str, shape_idx: int):
         if klass == "fit":
             return self._operands[shape_idx]
@@ -346,6 +415,9 @@ class LoadGenerator:
             from pint_tpu.serving.service import PosteriorRequest
 
             return PosteriorRequest(n_draws=self.cfg.posterior_draws)
+        if klass == "predict":
+            return self._predict_operands[
+                shape_idx % len(self._predict_operands)]
         return self.update_factory()
 
     async def _issue(self, klass: str, shape_idx: int,
@@ -360,6 +432,8 @@ class LoadGenerator:
                 res = await svc.submit(req)
             elif klass == "posterior":
                 res = await svc.submit_posterior(req)
+            elif klass == "predict":
+                res = await svc.submit_predict(req)
             else:
                 res = await svc.submit_update(req)
         except Exception:
@@ -430,9 +504,11 @@ class LoadGenerator:
                     fit_rps=_num("fit", "rps"),
                     posterior_rps=_num("posterior", "rps"),
                     update_rps=_num("update", "rps"),
+                    predict_rps=_num("predict", "rps"),
                     fit_p99_ms=_num("fit", "p99_ms"),
                     posterior_p99_ms=_num("posterior", "p99_ms"),
-                    update_p99_ms=_num("update", "p99_ms"))
+                    update_p99_ms=_num("update", "p99_ms"),
+                    predict_p99_ms=_num("predict", "p99_ms"))
         return report
 
     def run(self) -> LoadReport:

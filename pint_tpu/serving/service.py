@@ -52,6 +52,7 @@ from pint_tpu.serving.batcher import (
     ShapeBatcher,
     bucket_of,
 )
+from pint_tpu.predict.door import DEFAULT_TIME_BUCKETS
 from pint_tpu.serving.scheduler import Scheduler, SchedulerConfig
 from pint_tpu.serving.warmup import WarmPool, WarmupReport, warm_buckets
 
@@ -78,6 +79,8 @@ class ServeConfig:
     max_queue: int = 1024
     #: posterior-door draw/query-count ladder (amortized engine)
     draw_buckets: Tuple[int, ...] = DEFAULT_DRAW_BUCKETS
+    #: predict-door per-request epoch-count ladder
+    time_buckets: Tuple[int, ...] = DEFAULT_TIME_BUCKETS
     #: admission-control watermarks (None: the default policy — shed
     #: only at the max_queue hard cap, exactly the old bound)
     admission: Optional[AdmissionConfig] = None
@@ -172,7 +175,7 @@ class DoorStats:
     gauge, and the request/latency/compile metric family.
 
     The fit, posterior, and update doors each hand-rolled this before;
-    one helper means the three cannot drift (and the fit door gets the
+    one helper means the doors cannot drift (and the fit door gets the
     same queue-depth gauge coverage the other two always had).  Metric
     names and help strings are byte-identical to the pre-refactor
     per-door spellings."""
@@ -197,7 +200,7 @@ class DoorStats:
 
     def push(self, latency_ms: float) -> None:
         """Bounded latency-ring append — ONE copy of the trim logic
-        for all three doors (fit, posterior, update)."""
+        for all four doors (fit, posterior, update, predict)."""
         self.latencies_ms.append(latency_ms)
         if len(self.latencies_ms) > _LATENCY_RING:
             del self.latencies_ms[:len(self.latencies_ms) - _LATENCY_RING]
@@ -310,6 +313,17 @@ class TimingService:
             compiles_help="fresh XLA compiles paid by update dispatches",
             queue_help="update requests waiting in the coalescing "
                        "window")
+        # predict door (phase-prediction read path): nothing exists
+        # until register_predictor() attaches a PredictorCache
+        self._predictor = None
+        self._pred = DoorStats(
+            "predict", "pint_tpu_predict",
+            requests_help="phase-prediction requests served",
+            latency_help="predict request latency (ms)",
+            compiles_help="fresh XLA compiles paid by predict "
+                          "dispatches",
+            queue_help="predict requests waiting in the coalescing "
+                       "window")
         # traffic engineering: admission watermarks + the cross-class
         # scheduler are always on (their defaults reproduce the old
         # bounded-queue behavior, minus the exception); pressure
@@ -322,7 +336,7 @@ class TimingService:
         # always on (their default threshold only trips on sustained
         # dispatch failure); the write-ahead journal is opt-in via
         # attach_journal()
-        for door in (self._fit, self._post, self._upd):
+        for door in (self._fit, self._post, self._upd, self._pred):
             door.breaker = CircuitBreaker(door.klass, self.cfg.breaker)
         self._journal = None
 
@@ -418,11 +432,11 @@ class TimingService:
                                self._record, what="serve",
                                flush=self._flush_after)
 
-    # -- the shared coalescing core (all three doors) ------------------------
+    # -- the shared coalescing core (all four doors) -------------------------
 
     async def _submit_door(self, request, door: DoorStats, flush,
                            what: str, strict: bool = False):
-        """Enqueue-and-await shared by the three doors: admission
+        """Enqueue-and-await shared by the four doors: admission
         check (watermarks + hysteresis + the max_queue hard cap), one
         flush task per window shortened to the class's deadline slack,
         an immediate flush when the oldest waiter's p99 budget is at
@@ -868,9 +882,28 @@ class TimingService:
         return self._stream
 
     def _run_updates(self, requests):
+        from pint_tpu.grid import _model_param_sig
+        from pint_tpu.predict.door import update_epoch_span
         from pint_tpu.streaming.door import run_update_requests
 
-        out = run_update_requests(self._require_stream(), requests)
+        engine = self._require_stream()
+        sig_before = _model_param_sig(engine.fitter.model) \
+            if self._predictor is not None else None
+        out = run_update_requests(engine, requests)
+        # incremental predictor invalidation: an accepted batch that
+        # MOVED the solution stales only the windows whose validity
+        # spans the appended epochs; row-only batches (quarantine/
+        # release carry no epochs) stale conservatively; a batch that
+        # left the solution untouched stales nothing
+        if self._predictor is not None \
+                and _model_param_sig(engine.fitter.model) != sig_before:
+            row_ops = any(getattr(q, "kind", "append") != "append"
+                          for q in requests)
+            lo, hi = update_epoch_span(requests)
+            if row_ops or lo is None:
+                self._predictor.invalidate_all()
+            else:
+                self._predictor.invalidate_span(lo, hi)
         # the WAL ordering contract: the accepted batch is durably
         # journaled BEFORE any member's future resolves (the flush
         # core only delivers after this returns), so an acknowledged
@@ -944,6 +977,114 @@ class TimingService:
     def updates_served(self) -> int:
         return self._upd.served
 
+    # -- predict door (phase-prediction read path) ----------------------------
+
+    def register_predictor(self, cache, warm: bool = True) -> None:
+        """Attach a :class:`~pint_tpu.predict.cache.PredictorCache` to
+        the service's predict door; until this is called the door
+        raises the typed UsageError.  ``warm`` registers the batched
+        eval kernels at every ladder rung and the generation fit
+        kernels at every window rung in the service's warm pool
+        (:func:`~pint_tpu.predict.door.warm_predict`), so steady-state
+        predictions serve at ``compiles=0``."""
+        from pint_tpu.predict.cache import PredictorCache
+
+        if not isinstance(cache, PredictorCache):
+            raise UsageError(
+                f"register_predictor takes a PredictorCache, got "
+                f"{type(cache).__name__}")
+        self._predictor = cache
+        if warm:
+            self.warm_predict()
+        else:
+            cache.pool = self.pool
+
+    @property
+    def predictor(self):
+        return self._predictor
+
+    def _require_predictor(self):
+        if self._predictor is None:
+            raise UsageError(
+                "no predictor registered on this service; build a "
+                "pint_tpu.predict.PredictorCache and call "
+                "register_predictor() first")
+        return self._predictor
+
+    def warm_predict(self) -> WarmupReport:
+        """Pre-warm the predict eval + fit executables through the
+        service's warm pool at the configured ladders."""
+        from pint_tpu.predict.door import warm_predict as _warm
+
+        return _warm(self._require_predictor(), self.pool,
+                     time_buckets=self.cfg.time_buckets,
+                     batch_buckets=self.cfg.batch_buckets)
+
+    def _run_predicts(self, requests):
+        from pint_tpu.predict.door import run_predict_requests
+
+        return run_predict_requests(
+            self._require_predictor(), self.pool, requests,
+            time_buckets=self.cfg.time_buckets,
+            batch_buckets=self.cfg.batch_buckets)
+
+    def serve_predicts(self, requests) -> list:
+        """The synchronous predict batch door: one coalescing pass,
+        latency recorded per request as the whole pass's wall (the
+        fit door's honest-under-coalescing discipline)."""
+        self._require_predictor()
+        t0 = time.perf_counter()
+        out = self._run_predicts(requests)
+        wall_ms = 1e3 * (time.perf_counter() - t0)
+        for req, res in zip(requests, out):
+            self._record_predict(req, res, wall_ms)
+        return out
+
+    async def submit_predict(self, request, strict: bool = False):
+        """The predict door's asyncio entry: prediction requests
+        landing within the coalescing window share one padded eval
+        dispatch (its OWN door — read traffic never delays fits,
+        updates, or posterior queries and vice versa).  The request is
+        validated HERE, before enqueue — type and epoch coverage — so
+        a malformed request fails its own awaiter, never the innocent
+        batch-mates it would coalesce with.  A shed resolves with a
+        :class:`~pint_tpu.serving.admission.ShedResponse`
+        (``strict=True``: the old typed error)."""
+        from pint_tpu.predict.door import PredictRequest
+
+        predictor = self._require_predictor()
+        if not isinstance(request, PredictRequest):
+            raise UsageError(
+                f"the predict door takes PredictRequest, got "
+                f"{type(request).__name__}")
+        predictor.window_of(request.times_mjd)
+        return await self._submit_door(
+            request, self._pred, self._flush_predicts_after,
+            what="predict", strict=strict)
+
+    async def _flush_predicts_after(self) -> None:
+        await self._drain_door(self._pred, self._run_predicts,
+                               self._record_predict, what="predict",
+                               flush=self._flush_predicts_after)
+
+    def _record_predict(self, req, res, latency_ms: float) -> None:
+        res.latency_ms = latency_ms
+        self._pred.record_metrics(latency_ms, int(res.compiles))
+        _emit_event("predict_serve",
+                    batch=int(res.batch), n=int(req.n),
+                    bucket=int(res.bucket), windows=int(res.windows),
+                    latency_ms=float(latency_ms),
+                    compiles=int(res.compiles))
+
+    def predict_latency_summary(self) -> dict:
+        """``{n, p50_ms, p99_ms}`` over the predict door's own
+        (bounded) latency ring."""
+        return self._pred.summary()
+
+    @property
+    def predicts_served(self) -> int:
+        return self._pred.served
+
     # -- durability: journal, snapshot, crash-consistent recovery ------------
 
     @property
@@ -953,7 +1094,7 @@ class TimingService:
     def breakers(self) -> dict:
         """Per-door circuit-breaker state (drill introspection)."""
         return {d.klass: d.breaker.to_dict()
-                for d in (self._fit, self._post, self._upd)}
+                for d in (self._fit, self._post, self._upd, self._pred)}
 
     def attach_journal(self, path: str, fsync: str = "always",
                        segment_bytes: int = 1 << 20):

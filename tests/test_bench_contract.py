@@ -231,6 +231,21 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     assert recovery["replay_ops_per_s"] > 0
     assert recovery["bitwise_match"] is True
     assert recovery["stranded_futures"] == 0
+    # the predict block (PR 19): the phase-prediction door served a
+    # warmed, fully-built predictor cache — never degraded on CPU,
+    # all-hit steady state, zero steady-state compiles
+    predict = headline["predict"]
+    for key in ("windows", "predicts_per_s", "cache_hit_rate",
+                "p50_ms", "p99_ms", "steady_state_compiles"):
+        assert key in predict, f"predict block missing {key!r}"
+    assert "error" not in predict, \
+        f"predict measurement degraded: {predict}"
+    assert predict["windows"] >= 1
+    assert predict["predicts_per_s"] > 0
+    assert predict["cache_hit_rate"] == 1.0
+    assert predict["p50_ms"] > 0
+    assert predict["p99_ms"] >= predict["p50_ms"]
+    assert predict["steady_state_compiles"] == 0
     json.dumps(headline)
 
 

@@ -60,6 +60,12 @@ class TestExamples:
         assert "prediction wobble" in out
         assert "predicted spin frequency" in out
 
+    def test_predict_phase_walkthrough(self, capsys):
+        out = _run("predict_phase.py", capsys=capsys)
+        assert "device predictor vs host Polycos" in out
+        assert "regenerated lazily" in out
+        assert "done" in out
+
     def test_simulate_zima_walkthrough(self, capsys):
         out = _run("simulate_zima.py", capsys=capsys)
         assert "zima wrote" in out

@@ -500,8 +500,9 @@ class TestLoadEventValidation:
         attrs = dict(_name="load_run", arrival="open", duration_s=2.0,
                      offered=100, completed=90, shed=10, shed_rate=0.1,
                      fairness=0.95, fit_rps=40.0, posterior_rps=10.0,
-                     update_rps=0.0, fit_p99_ms=80.0,
-                     posterior_p99_ms=30.0, update_p99_ms=0.0)
+                     update_rps=0.0, predict_rps=0.0, fit_p99_ms=80.0,
+                     posterior_p99_ms=30.0, update_p99_ms=0.0,
+                     predict_p99_ms=0.0)
         attrs.update(over)
         return attrs
 

@@ -705,6 +705,98 @@ class TestPosteriorSeries:
         assert "posterior: 9500.0 draws/s" in capsys.readouterr().out
 
 
+def _predict(pps=250000.0, hit=1.0, p50=1.2, p99=2.5, windows=48,
+             error=None):
+    block = {"windows": windows, "predicts_per_s": pps,
+             "cache_hit_rate": hit, "p50_ms": p50, "p99_ms": p99,
+             "steady_state_compiles": 0}
+    if error is not None:
+        block = {"windows": None, "predicts_per_s": None,
+                 "cache_hit_rate": None, "p50_ms": None, "p99_ms": None,
+                 "steady_state_compiles": None, "error": error}
+    return {"predict": block}
+
+
+class TestPredictSeries:
+    """The bench's predict{} block (round 19+): warm-served epoch
+    throughput gates drops, the predict door's p99 gates rises, the
+    steady-state cache-hit rate gates drops, and an errored block
+    after measured rounds fails."""
+
+    def test_predict_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 19, 100.0,
+                    extra=_predict(pps=250000.0, hit=1.0, p99=2.5,
+                                   windows=48))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.predict_predicts_per_s == 250000.0
+        assert r.predict_cache_hit_rate == 1.0
+        assert r.predict_p99_ms == 2.5
+        assert r.predict_windows == 48
+        assert r.predict_steady_compiles == 0
+        doc = build_history([r])
+        assert doc["runs"][0]["predict_predicts_per_s"] == 250000.0
+
+    def test_predicts_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([250000.0, 260000.0, 245000.0], start=1):
+            _bench(d, i, 100.0, extra=_predict(pps=v))
+        _bench(d, 4, 100.0, extra=_predict(pps=100000.0))  # ~60% drop
+        assert main(["--check", "--dir", d]) == 1
+        assert "predict_predicts_per_s" in capsys.readouterr().out
+
+    def test_p99_rise_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_predict(p99=2.5))
+        _bench(d, 4, 100.0, extra=_predict(p99=6.0))  # >2x the tail
+        assert main(["--check", "--dir", d]) == 1
+        assert "predict_p99_ms" in capsys.readouterr().out
+
+    def test_hit_rate_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_predict(hit=1.0))
+        _bench(d, 4, 100.0, extra=_predict(hit=0.6))  # cache went cold
+        assert main(["--check", "--dir", d]) == 1
+        assert "predict_cache_hit_rate" in capsys.readouterr().out
+
+    def test_small_predict_changes_pass(self, tmp_path):
+        d = str(tmp_path)
+        for i, (v, p) in enumerate([(250000.0, 2.5), (258000.0, 2.6),
+                                    (246000.0, 2.4)], start=1):
+            _bench(d, i, 100.0, extra=_predict(pps=v, p99=p))
+        _bench(d, 4, 100.0, extra=_predict(pps=242000.0, p99=2.7))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_predict_block_fails_when_history_had_it(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_predict())
+        _bench(d, 3, 100.0,
+               extra=_predict(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 1
+        assert "predict block degraded" in capsys.readouterr().out
+
+    def test_errored_predict_block_clean_without_history(
+            self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0,
+               extra=_predict(error="UsageError: broken"))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_predict_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0, extra=_predict(pps=250000.0, windows=48))
+        assert main(["--dir", d]) == 0
+        assert "predict: 250000.0 epochs/s (48 windows)" \
+            in capsys.readouterr().out
+
+
 def _streaming(ups=180.0, p50=5.5, p99=6.5, speedup=45.0, error=None):
     block = {"appends": 8, "update_p50_ms": p50, "update_p99_ms": p99,
              "updates_per_s": ups, "refit_p50_ms": p50 * speedup,

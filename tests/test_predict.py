@@ -1,0 +1,823 @@
+"""Phase-prediction subsystem tests (PR 19).
+
+Pins the load-bearing contracts of ``pint_tpu/predict``:
+
+* **generation parity** — batched on-device predictor phases match the
+  full ``TimingModel`` phase evaluation to < 1e-9 cycles across every
+  window of a multi-window, multi-pulsar grid, AND match host
+  ``Polycos`` evaluation on the same coefficients;
+* **polyco boundary exactness** — ``find_entry``/``valid`` at window
+  edges: a shared edge resolves to exactly one entry (no gap, no
+  double-cover), and the TEMPO write -> read round trip holds the
+  format's quantization precision;
+* **incremental invalidation** — an accepted streaming append
+  regenerates ONLY the windows whose validity spans the appended
+  epochs (``regen_count`` witness), a quarantine-only batch
+  regenerates zero, and post-invalidation predictions match a
+  from-scratch cache bitwise;
+* **warm path** — populate the AOT cache -> ``jax.clear_caches()`` ->
+  fresh pool -> all-hit re-warm -> a coalesced predict batch serves at
+  ``compiles == 0``;
+* **traffic** — the predict door sheds typed, validates before
+  enqueue (a bad request never fails its batch-mates), and a loadgen
+  mixed run including the ``predict`` class passes its SLO with
+  balanced shed accounting.
+"""
+
+import asyncio
+import copy
+import os
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.predict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from pint_tpu import config  # noqa: E402
+from pint_tpu.exceptions import UsageError  # noqa: E402
+from pint_tpu.polycos import (  # noqa: E402
+    MIN_PER_DAY,
+    PolycoEntry,
+    Polycos,
+)
+from pint_tpu.predict import (  # noqa: E402
+    PredictorCache,
+    PredictRequest,
+    generate_predictor_sets,
+)
+from pint_tpu.predict.door import (  # noqa: E402
+    run_predict_requests,
+    update_epoch_span,
+)
+from pint_tpu.predict.generate import fit_windows, window_tmids  # noqa: E402
+from pint_tpu.serving import aotcache, service  # noqa: E402
+from pint_tpu.serving.admission import ShedResponse  # noqa: E402
+
+#: isolated-pulsar stand-ins (barycentric so the pins need no clock
+#: chain): same scale as the NGC6440E walkthrough, two distinct
+#: solutions for the multi-pulsar generation pin
+PAR_A = """\
+PSR PREDTESTA
+RAJ 17:48:52.75
+DECJ -20:21:29.0
+F0 61.485476554
+F1 -1.181e-15
+PEPOCH 53750
+DM 223.9
+EPHEM DE421
+UNITS TDB
+"""
+
+PAR_B = """\
+PSR PREDTESTB
+RAJ 17:13:49.53
+DECJ +07:47:37.5
+F0 218.8118438
+F1 -4.08e-16
+PEPOCH 53750
+DM 15.99
+EPHEM DE421
+UNITS TDB
+"""
+
+T0 = 53750.0
+
+
+def _get_model(par):
+    from pint_tpu.models import get_model
+
+    return get_model([ln + "\n" for ln in par.splitlines()])
+
+
+@pytest.fixture(scope="module")
+def model_a():
+    return _get_model(PAR_A)
+
+
+@pytest.fixture(scope="module")
+def model_b():
+    return _get_model(PAR_B)
+
+
+@pytest.fixture
+def aot_dir(tmp_path):
+    """An enabled AOT cache rooted in tmp, torn down afterwards."""
+    d = str(tmp_path / "aot")
+    config.set_aot_cache_dir(d)
+    yield d
+    config.set_aot_cache_dir(None)
+    aotcache.reset_cache_singleton()
+
+
+def _model_phase(model, mjds):
+    """The full TimingModel absolute phase at barycentric UTC epochs —
+    the same host pipeline predictor generation fits against."""
+    from pint_tpu.toa import TOAs
+
+    mjds = np.asarray(mjds, dtype=np.float64)
+    n = len(mjds)
+    ts = TOAs(
+        utc_mjd=np.asarray(mjds, dtype=np.longdouble),
+        error_us=np.ones(n), freq_mhz=np.full(n, 1400.0),
+        obs=np.array(["barycenter"] * n, dtype=object),
+        flags=[{} for _ in range(n)],
+    )
+    ts.clock_corr_s = np.zeros(n)
+    ephem = model.EPHEM.value or "DE440"
+    ts.compute_TDBs(ephem=ephem)
+    ts.compute_posvels(ephem=ephem,
+                       planets=bool(model.PLANET_SHAPIRO.value))
+    ph = model.phase(ts, abs_phase="AbsPhase" in model.components)
+    return np.asarray(ph.int_), np.asarray(ph.frac)
+
+
+def _window_probes(pset):
+    """Interior sample epochs hitting EVERY window of a predictor
+    set's grid (4 per window, none on an edge)."""
+    offs = np.array([-0.9, -0.35, 0.4, 0.85])
+    half_d = pset.segLength / (2 * MIN_PER_DAY)
+    return (pset.tmid[:, None] + offs[None, :] * half_d).ravel()
+
+
+# ---------------------------------------------------------------------------
+# polyco boundary exactness + TEMPO round trip (satellite hardening)
+# ---------------------------------------------------------------------------
+
+class TestPolycoBoundaries:
+    #: 45 min = 0.03125 d = 2^-5: the span is exact in binary, so
+    #: handcrafted window edges align bitwise and the half-open
+    #: dispatch rule is tested at EXACT shared edges, not near them
+    SPAN_MIN = 45.0
+    NWIN = 4
+
+    def _grid(self, base=55000.0):
+        span_d = self.SPAN_MIN / MIN_PER_DAY
+        assert span_d == 0.03125  # exact binary, by construction
+        return Polycos([
+            PolycoEntry(base + (k + 0.5) * span_d, self.SPAN_MIN,
+                        0, 0.0, 100.0, 3, np.zeros(3),
+                        psrname="EDGETEST")
+            for k in range(self.NWIN)])
+
+    def test_edges_bitwise_aligned(self):
+        pol = self._grid()
+        for a, b in zip(pol.entries[:-1], pol.entries[1:]):
+            assert a.tstop == b.tstart  # bitwise: no gap, no overlap
+
+    def test_shared_edge_single_cover(self):
+        """t exactly ON an interior edge is valid for exactly ONE
+        entry (the half-open ``tstart <= t < tstop`` rule) and
+        find_entry returns that entry — no gap, no double-cover."""
+        pol = self._grid()
+        for k in range(self.NWIN - 1):
+            t = pol.entries[k].tstop
+            covering = [e for e in pol.entries if bool(e.valid(t))]
+            assert covering == [pol.entries[k + 1]]
+            assert pol.find_entry(t) is pol.entries[k + 1]
+
+    def test_grid_start_and_end(self):
+        """The opening edge belongs to the first entry; the closing
+        edge is outside every half-open span but dispatches to the
+        last entry through EDGE_TOL (distance exactly 0) — the grid
+        answers for its full advertised coverage."""
+        pol = self._grid()
+        t_start = pol.entries[0].tstart
+        assert bool(pol.entries[0].valid(t_start))
+        assert pol.find_entry(t_start) is pol.entries[0]
+        t_end = pol.entries[-1].tstop
+        assert not any(bool(e.valid(t_end)) for e in pol.entries)
+        assert pol.find_entry(t_end) is pol.entries[-1]
+
+    def test_interior_dispatch(self):
+        pol = self._grid()
+        for k, e in enumerate(pol.entries):
+            assert pol.find_entry(e.tmid) is e
+
+    def test_outside_coverage_raises(self):
+        pol = self._grid()
+        with pytest.raises(ValueError):
+            pol.find_entry(pol.entries[0].tstart - 1.0)
+        with pytest.raises(ValueError):
+            pol.find_entry(pol.entries[-1].tstop + 1.0)
+
+    def test_tempo_round_trip_precision(self, tmp_path, model_a):
+        """TEMPO write -> read: tmid and coefficients survive exactly
+        (%.11f pre-quantized; %25.17e covers float64), the reference
+        phase to its %.6f quantization — so round-tripped phases agree
+        to < 2e-6 cycles and frequencies to < 1e-9 Hz."""
+        pol = Polycos.generate_polycos(model_a, T0, T0 + 0.25, "@",
+                                       30, 12, 1400.0)
+        path = str(tmp_path / "polyco_rt.dat")
+        pol.write_polyco_file(path)
+        back = Polycos.read_polyco_file(path)
+        assert len(back.entries) == len(pol.entries)
+        for a, b in zip(pol.entries, back.entries):
+            assert b.tmid == a.tmid
+            assert b.mjdspan == a.mjdspan
+            assert np.array_equal(b.coeffs, a.coeffs)
+            assert abs(b.f0 - a.f0) <= 5e-13
+            da = a.rphase_int + a.rphase_frac
+            db = b.rphase_int + b.rphase_frac
+            assert abs(db - da) <= 5.1e-7  # %.6f quantization
+        rng = np.random.default_rng(3)
+        t = np.sort(rng.uniform(T0 + 1e-6, T0 + 0.25 - 1e-6, 64))
+        pa, pb = pol.eval_abs_phase(t), back.eval_abs_phase(t)
+        dphase = (np.asarray(pb.int_) - np.asarray(pa.int_)
+                  + np.asarray(pb.frac) - np.asarray(pa.frac))
+        assert np.max(np.abs(dphase)) < 2e-6
+        dfreq = back.eval_spin_freq(t) - pol.eval_spin_freq(t)
+        assert np.max(np.abs(dfreq)) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# batched on-device generation (the tentpole parity pin)
+# ---------------------------------------------------------------------------
+
+class TestBatchedGeneration:
+    def test_multi_pulsar_multi_window_parity(self, model_a, model_b):
+        """The acceptance pin: one vmapped device fit over BOTH
+        pulsars' windows; the resulting predictors match the full
+        TimingModel phase to < 1e-9 cycles at probes in EVERY window,
+        and match the host generator (``Polycos.generate_polycos``)
+        evaluated on its own coefficients to the same bar."""
+        sets = generate_predictor_sets([model_a, model_b], T0,
+                                       T0 + 0.5, "@", segLength=30.0,
+                                       ncoeff=12)
+        assert len(sets) == 2
+        for model, pset in zip((model_a, model_b), sets):
+            assert pset.n_windows == 24
+            assert np.all(pset.fit_rms < 1e-8)
+            t = _window_probes(pset)
+            dev = pset.to_polycos().eval_abs_phase(t)
+            mi, mf = _model_phase(model, t)
+            dphase = (np.asarray(dev.int_) - mi
+                      + np.asarray(dev.frac) - mf)
+            worst = float(np.max(np.abs(dphase)))
+            assert worst < 1e-9, \
+                f"{pset.psrname}: device vs model {worst:.2e} cycles"
+            host = Polycos.generate_polycos(model, T0, T0 + 0.5, "@",
+                                            30, 12, 1400.0)
+            hp = host.eval_abs_phase(t)
+            dhost = (np.asarray(dev.int_) - np.asarray(hp.int_)
+                     + np.asarray(dev.frac) - np.asarray(hp.frac))
+            assert float(np.max(np.abs(dhost))) < 1e-9
+
+    def test_device_eval_kernel_parity(self, model_a):
+        """The door's batched EVAL kernel (not just the host Horner)
+        against the full model phase and the host polyco frequency,
+        across every window of the grid."""
+        cache = PredictorCache(model_a, T0, T0 + 0.25, obs="@",
+                               segLength=30.0, ncoeff=12)
+        pset = cache.to_predictor_set()
+        t = _window_probes(pset)
+        out = run_predict_requests(cache, None, [PredictRequest(t)])
+        assert len(out) == 1 and out[0].windows == cache.n_windows
+        mi, mf = _model_phase(model_a, t)
+        dphase = (out[0].phase_int - mi + out[0].phase_frac - mf)
+        assert float(np.max(np.abs(dphase))) < 1e-9
+        fhost = pset.to_polycos().eval_spin_freq(t)
+        assert float(np.max(np.abs(out[0].freq - fhost))) < 1e-9
+
+    def test_window_bucket_shares_executable(self):
+        """Grids of nearby window counts pad onto the same ladder rung:
+        the second fit at a different W but the same rung pays zero
+        fresh compiles (the ShapeBatcher discipline)."""
+        from pint_tpu.telemetry import jaxevents
+
+        rng = np.random.default_rng(0)
+        ncoeff, nnode, half = 5, 14, 2.0
+        c_true = rng.normal(size=(1, ncoeff))
+
+        def fit(W):
+            x = np.tile(np.linspace(-1.0, 1.0, nnode), (W, 1))
+            dt = x * half
+            y = sum(c_true[0, j] * dt ** j for j in range(ncoeff))
+            return fit_windows(x, y, ncoeff, half)
+
+        coeffs, rms = fit(5)                       # may compile
+        assert coeffs.shape == (5, ncoeff)
+        assert np.allclose(coeffs, c_true, atol=1e-9)
+        assert np.all(rms < 1e-9)
+        before = jaxevents.counts()
+        coeffs2, _ = fit(9)                        # same rung (16)
+        assert (jaxevents.counts() - before).compiles == 0
+        assert coeffs2.shape == (9, ncoeff)
+        assert np.allclose(coeffs2, c_true, atol=1e-9)
+
+    def test_input_validation(self, model_a):
+        with pytest.raises(UsageError):
+            fit_windows(np.zeros((2, 8)), np.zeros((3, 8)), 4, 1.0)
+        with pytest.raises(UsageError):
+            window_tmids(55000.0, 55000.0, 60.0)
+        with pytest.raises(UsageError):
+            generate_predictor_sets([], 55000.0, 55001.0, "@")
+        with pytest.raises(UsageError):
+            PredictorCache(model_a, T0, T0 + 1.0, ncoeff=1)
+        with pytest.raises(UsageError):
+            PredictRequest(times_mjd=np.zeros((2, 2)))
+
+
+# ---------------------------------------------------------------------------
+# incremental invalidation (cache unit + streaming integration)
+# ---------------------------------------------------------------------------
+
+class TestCacheInvalidation:
+    def _mids(self, cache):
+        """Every window's midpoint, from the public coverage."""
+        lo, hi = cache.coverage()
+        W = cache.n_windows
+        return lo + (np.arange(W) + 0.5) * (hi - lo) / W
+
+    def test_lazy_build_and_hit_accounting(self, model_a):
+        cache = PredictorCache(model_a, T0, T0 + 0.25, obs="@",
+                               segLength=60.0, ncoeff=6)
+        assert cache.n_windows == 6
+        mids = self._mids(cache)
+        cache.predict(mids[:2])               # builds 2 windows
+        st = cache.stats()
+        assert st["misses"] == 2 and st["regenerated"] == 2
+        cache.predict(mids[:2])               # pure hits
+        st = cache.stats()
+        assert st["hits"] == 2 and st["misses"] == 2
+        assert np.array_equal(cache.regen_count,
+                              np.array([1, 1, 0, 0, 0, 0]))
+
+    def test_invalidate_all_and_span(self, model_a):
+        cache = PredictorCache(model_a, T0, T0 + 0.25, obs="@",
+                               segLength=60.0, ncoeff=6)
+        cache.build()
+        lo, hi = cache.coverage()
+        # a span over windows 2-3 only
+        n = cache.invalidate_span(lo + 0.105, lo + 0.14)
+        assert n == 2
+        cache.predict(self._mids(cache))
+        assert np.array_equal(cache.regen_count,
+                              np.array([1, 1, 2, 2, 1, 1]))
+        assert cache.invalidate_all() == cache.n_windows
+        # a second invalidation of already-stale windows is a no-op
+        assert cache.invalidate_span(lo, hi) == 0
+
+    def test_model_mutation_safety_net(self, model_a):
+        """A parameter moved OUTSIDE the streaming hook still stales
+        the grid: the vkey signature check on the gather path."""
+        model = _get_model(PAR_A)
+        cache = PredictorCache(model, T0, T0 + 0.25, obs="@",
+                               segLength=120.0, ncoeff=6)
+        t = self._mids(cache)[:1]
+        p0 = cache.predict(t)
+        rc = cache.regen_count.copy()
+        model.F0.value = model.F0.value + 1e-7
+        p1 = cache.predict(t)
+        assert cache.regen_count[0] == rc[0] + 1
+        assert cache.stats()["invalidated"] >= 1
+        d0 = p0[0] + p0[1]
+        d1 = p1[0] + p1[1]
+        assert not np.array_equal(d0, d1)  # the moved F0 shows up
+
+    def test_outside_coverage_refused(self, model_a):
+        cache = PredictorCache(model_a, T0, T0 + 0.25, obs="@",
+                               segLength=60.0, ncoeff=6)
+        with pytest.raises(UsageError):
+            cache.window_of(T0 + 2.0)
+        with pytest.raises(UsageError):
+            cache.predict(np.array([T0 - 1.0]))
+
+    def test_update_epoch_span(self):
+        from types import SimpleNamespace as NS
+
+        reqs = [
+            NS(kind="append",
+               new_toas=NS(utc_mjd=np.array([55010.0, 55012.5]))),
+            NS(kind="quarantine", new_toas=None),
+            NS(kind="append",
+               new_toas=NS(utc_mjd=np.array([55001.25]))),
+        ]
+        assert update_epoch_span(reqs) == (55001.25, 55012.5)
+        assert update_epoch_span(reqs[1:2]) == (None, None)
+        assert update_epoch_span([]) == (None, None)
+
+
+class TestStreamingInvalidation:
+    """The service-level incremental pin on a live streaming engine."""
+
+    #: the streaming test workload's B1855 stand-in (spin + red noise,
+    #: DM frozen — the rank-k engine's own acceptance configuration)
+    STREAM_PAR = """\
+PSR STREAMPRED
+RAJ 04:37:15.0
+DECJ -47:15:09.0
+F0 173.6879 1
+F1 -1.7e-15 1
+PEPOCH 55000
+DM 2.64
+EFAC mjd 50000 60000 1.1
+TNRedAmp -13.5
+TNRedGam 3.5
+TNRedC 5
+TNREDTSPAN 6.0
+UNITS TDB
+"""
+
+    @pytest.fixture(scope="class")
+    def workload(self):
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        model = _get_model(self.STREAM_PAR)
+        rng = np.random.default_rng(7)
+        toas = make_fake_toas_uniform(
+            53400, 54800, 116, model, freq=np.array([800.0, 1400.0]),
+            error_us=1.0, add_noise=True, rng=rng)
+        base = toas[np.arange(100)]
+        blocks = [toas[np.arange(100 + 8 * i, 100 + 8 * (i + 1))]
+                  for i in range(2)]
+        return model, base, blocks
+
+    def test_streaming_invalidation_scenario(self, workload):
+        """The full acceptance scenario on one engine: an accepted
+        append stales EXACTLY the windows spanning its epochs (and the
+        regen_count witness shows only those regenerate); a
+        quarantined-only batch regenerates zero; the post-invalidation
+        prediction matches a from-scratch cache bitwise."""
+        from pint_tpu.gls_fitter import GLSFitter
+        from pint_tpu.streaming import UpdateRequest
+
+        model, base, blocks = workload
+        f = GLSFitter(base, copy.deepcopy(model))
+        f.fit_toas(maxiter=3)
+        svc = service.TimingService(service.ServeConfig(
+            time_buckets=(16,), batch_buckets=(1, 4)))
+        svc.register_stream(f, warm=False)
+
+        block = blocks[0]
+        b_mjd = np.asarray(block.utc_mjd, dtype=np.float64)
+        lo_b, hi_b = float(b_mjd.min()), float(b_mjd.max())
+        cache = PredictorCache(f.model, lo_b - 6.0, hi_b + 6.0,
+                               obs="@", segLength=2880.0, ncoeff=6)
+        svc.register_predictor(cache, warm=False)
+        cache.build()
+        assert np.all(cache.regen_count == 1)
+        # the windows whose validity spans the appended epochs — a
+        # contiguous run, located through the public dispatch
+        w_lo = int(cache.window_of(np.array([lo_b]))[0])
+        w_hi = int(cache.window_of(np.array([hi_b]))[0])
+        spanned = np.arange(w_lo, w_hi + 1)
+        assert 0 < len(spanned) < cache.n_windows
+
+        # --- accepted append: the solution moves ----------------------
+        out = svc.serve_updates(
+            [UpdateRequest(new_toas=copy.deepcopy(block))])
+        assert len(out) == 1
+        assert cache.invalidated == len(spanned), \
+            "append must stale exactly the spanned windows"
+        lo_c, hi_c = cache.coverage()
+        W = cache.n_windows
+        mids = lo_c + (np.arange(W) + 0.5) * (hi_c - lo_c) / W
+        cache.predict(mids)                   # touch EVERY window
+        expect = np.ones(W, dtype=np.int64)
+        expect[spanned] += 1
+        assert np.array_equal(cache.regen_count, expect), \
+            "only the spanned windows may regenerate"
+
+        # --- quarantine-only batch: solution untouched ----------------
+        bad = copy.deepcopy(blocks[1])
+        bad.error_us[:] = -1.0                # every row quarantined
+        inv0, reg0 = cache.invalidated, cache.regenerated
+        svc.serve_updates([UpdateRequest(new_toas=bad)])
+        assert cache.invalidated == inv0
+        assert cache.regenerated == reg0
+        cache.predict(mids)
+        assert np.array_equal(cache.regen_count, expect), \
+            "a quarantine-only batch must regenerate nothing"
+
+        # --- bitwise from-scratch agreement ---------------------------
+        probes = mids[spanned]
+        p1 = cache.predict(probes)
+        scratch = PredictorCache(svc.stream.fitter.model,
+                                 lo_b - 6.0, hi_b + 6.0, obs="@",
+                                 segLength=2880.0, ncoeff=6)
+        p2 = scratch.predict(probes)
+        for a, b, what in zip(p1, p2, ("int", "frac", "freq")):
+            assert np.array_equal(a, b), \
+                f"post-invalidation {what} != from-scratch (bitwise)"
+
+
+# ---------------------------------------------------------------------------
+# the predict door
+# ---------------------------------------------------------------------------
+
+def _small_cache(model, span=0.25, seg=60.0, ncoeff=6):
+    return PredictorCache(model, T0, T0 + span, obs="@",
+                          segLength=seg, ncoeff=ncoeff)
+
+
+class TestPredictDoor:
+    def test_unregistered_door_refuses(self):
+        svc = service.TimingService(service.ServeConfig())
+        with pytest.raises(UsageError):
+            svc.serve_predicts([PredictRequest(np.array([T0]))])
+        with pytest.raises(UsageError):
+            svc.register_predictor(object())
+
+    def test_request_order_and_buckets(self, model_a):
+        """Mixed-size requests group by time-ladder rung and chunk at
+        the batch top, but results come back in REQUEST order and
+        match the cache's host evaluation."""
+        cache = _small_cache(model_a)
+        svc = service.TimingService(service.ServeConfig(
+            time_buckets=(8, 32), batch_buckets=(1, 2)))
+        svc.register_predictor(cache, warm=False)
+        lo, hi = cache.coverage()
+        rng = np.random.default_rng(5)
+        sizes = [20, 4, 25, 6]
+        reqs = [PredictRequest(
+            np.sort(rng.uniform(lo + 1e-6, hi - 1e-6, n)),
+            request_id=f"q{i}") for i, n in enumerate(sizes)]
+        out = svc.serve_predicts(reqs)
+        assert [r.request_id for r in out] == [q.request_id
+                                              for q in reqs]
+        assert [r.bucket for r in out] == [32, 8, 32, 8]
+        for q, r in zip(reqs, out):
+            assert len(r.phase_frac) == q.n
+            hi_, hf, hfreq = cache.predict(q.times_mjd)
+            d = (r.phase_int - hi_) + (r.phase_frac - hf)
+            assert float(np.max(np.abs(d))) < 1e-9
+            assert float(np.max(np.abs(r.freq - hfreq))) < 1e-9
+        assert svc.predicts_served == 4
+        assert svc.predict_latency_summary()["n"] == 4
+
+    def test_submit_validates_before_enqueue(self, model_a):
+        """A malformed or out-of-coverage request fails its OWN
+        awaiter immediately — the admitted batch-mate still serves."""
+        cache = _small_cache(model_a)
+        svc = service.TimingService(service.ServeConfig(
+            time_buckets=(16,), batch_buckets=(1, 2), window_ms=1.0))
+        svc.register_predictor(cache, warm=False)
+        lo, hi = cache.coverage()
+        good = PredictRequest(np.linspace(lo + 1e-4, hi - 1e-4, 8))
+
+        async def go():
+            mate = asyncio.ensure_future(svc.submit_predict(good))
+            await asyncio.sleep(0)
+            with pytest.raises(UsageError):
+                await svc.submit_predict(
+                    PredictRequest(np.array([hi + 5.0])))
+            with pytest.raises(UsageError):
+                await svc.submit_predict("phase please")
+            return await mate
+
+        res = asyncio.run(go())
+        assert not getattr(res, "shed", False)
+        assert np.all(np.isfinite(res.phase_frac))
+
+    def test_shed_is_typed_and_strict_raises(self, model_a):
+        cache = _small_cache(model_a)
+        svc = service.TimingService(service.ServeConfig(
+            time_buckets=(16,), batch_buckets=(1, 2), window_ms=1.0,
+            max_queue=1))
+        svc.register_predictor(cache, warm=False)
+        lo, hi = cache.coverage()
+
+        def req():
+            return PredictRequest(np.linspace(lo + 1e-4, hi - 1e-4, 8))
+
+        async def go():
+            t1 = asyncio.ensure_future(svc.submit_predict(req()))
+            await asyncio.sleep(0)
+            shed = await svc.submit_predict(req())
+            assert isinstance(shed, ShedResponse)
+            assert shed.request_class == "predict"
+            with pytest.raises(UsageError):
+                await svc.submit_predict(req(), strict=True)
+            return await t1
+
+        res = asyncio.run(go())
+        assert not getattr(res, "shed", False)
+
+    def test_coalesced_batch_compile_attribution(self, model_a):
+        """Batch-mates share one dispatch: every member reports the
+        shared batch size, and compiles land on the FIRST member only
+        (the fit door's accounting discipline)."""
+        cache = _small_cache(model_a)
+        svc = service.TimingService(service.ServeConfig(
+            time_buckets=(16,), batch_buckets=(1, 4), window_ms=5.0))
+        svc.register_predictor(cache, warm=False)
+        lo, hi = cache.coverage()
+
+        async def go():
+            ts = [asyncio.ensure_future(svc.submit_predict(
+                PredictRequest(
+                    np.linspace(lo + 1e-4, hi - 1e-4, 8),
+                    request_id=f"c{i}")))
+                for i in range(3)]
+            return await asyncio.gather(*ts)
+
+        out = asyncio.run(go())
+        assert [r.batch for r in out] == [3, 3, 3]
+        assert all(r.compiles == 0 for r in out[1:])
+
+    def test_predict_events_validate_live(self, tmp_path, model_a):
+        """End to end: the door's predict_serve emission AND the
+        cache's predictor_cache hit/miss/regenerate emissions pass the
+        telemetry_report --check contracts."""
+        from pint_tpu import telemetry
+        from pint_tpu.telemetry import runlog
+        from tools.telemetry_report import validate_run_dir
+
+        cache = _small_cache(model_a)
+        svc = service.TimingService(service.ServeConfig(
+            time_buckets=(16,), batch_buckets=(1, 2)))
+        svc.register_predictor(cache, warm=False)
+        lo, hi = cache.coverage()
+        t = np.linspace(lo + 1e-4, hi - 1e-4, 8)
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            runlog.start_run(run_dir, name="predict-events",
+                             probe_device=False)
+            svc.serve_predicts([PredictRequest(t)])   # miss+regen
+            svc.serve_predicts([PredictRequest(t)])   # hit
+            cache.invalidate_span(lo, hi)             # invalidate
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+        errors = []
+        validate_run_dir(run_dir, errors)
+        assert not errors, errors
+
+
+# ---------------------------------------------------------------------------
+# warm path: AOT cache -> clear_caches -> all-hit re-warm -> compiles == 0
+# ---------------------------------------------------------------------------
+
+class TestWarmPath:
+    def test_clear_caches_all_hit_rewarm_zero_compiles(self, aot_dir,
+                                                       model_a):
+        """The acceptance pin: the first service's warm populates the
+        AOT cache cold; after ``jax.clear_caches()`` a FRESH pool
+        re-warms all-hit, and a coalesced predict batch through the
+        re-warmed service pays zero fresh XLA compiles."""
+        import jax
+
+        from pint_tpu.telemetry import jaxevents
+
+        cfg = service.ServeConfig(time_buckets=(16,),
+                                  batch_buckets=(1, 2), window_ms=1.0)
+        svc1 = service.TimingService(cfg)
+        c1 = _small_cache(model_a)
+        svc1.register_predictor(c1, warm=False)
+        rep1 = svc1.warm_predict()
+        assert rep1.entries, "warm_predict must register executables"
+        assert rep1.cold_compiles == len(rep1.entries)
+        c1.build()
+        lo, hi = c1.coverage()
+        reqs = [PredictRequest(
+            np.linspace(lo + 1e-4, hi - 1e-4, 10),
+            request_id=f"w{i}") for i in range(4)]
+        out1 = svc1.serve_predicts(reqs)
+
+        jax.clear_caches()
+        svc2 = service.TimingService(cfg)     # fresh WarmPool
+        c2 = _small_cache(model_a)
+        svc2.register_predictor(c2, warm=False)
+        rep2 = svc2.warm_predict()
+        assert rep2.cache_hits == len(rep2.entries), \
+            f"expected all-hit re-warm, got {rep2.to_dict()}"
+        assert rep2.cold_compiles == 0
+        c2.build()                            # pooled fit dispatch
+        before = jaxevents.counts()
+        out2 = svc2.serve_predicts(reqs)
+        delta = jaxevents.counts() - before
+        assert delta.compiles == 0, \
+            "steady-state predict batch must pay zero fresh compiles"
+        assert all(r.compiles == 0 for r in out2)
+        for a, b in zip(out1, out2):
+            assert np.array_equal(a.phase_int, b.phase_int)
+            assert np.array_equal(a.phase_frac, b.phase_frac)
+            assert np.array_equal(a.freq, b.freq)
+
+    def test_schema_only_vkey_shared_across_pulsars(self, aot_dir,
+                                                    model_a, model_b):
+        """The predict executables are parameter-independent, so one
+        pulsar's AOT population re-warms ALL-HIT for a different
+        pulsar (the schema-only vkey discipline)."""
+        import jax
+
+        cfg = service.ServeConfig(time_buckets=(16,),
+                                  batch_buckets=(1, 2))
+        svc1 = service.TimingService(cfg)
+        svc1.register_predictor(_small_cache(model_a), warm=False)
+        rep1 = svc1.warm_predict()
+        assert rep1.cold_compiles == len(rep1.entries)
+        jax.clear_caches()
+        svc2 = service.TimingService(cfg)
+        svc2.register_predictor(_small_cache(model_b), warm=False)
+        rep2 = svc2.warm_predict()
+        assert rep2.cache_hits == len(rep2.entries)
+        assert rep2.cold_compiles == 0
+
+
+# ---------------------------------------------------------------------------
+# loadgen: the read class in a mixed traffic run
+# ---------------------------------------------------------------------------
+
+class _StubFlowSpec:
+    def suffix(self):
+        return ""
+
+
+class _StubFlow:
+    spec = _StubFlowSpec()
+
+
+class _StubPosterior:
+    """The minimal posterior-door surface (test_loadgen's stub): the
+    mixed run needs the door's scheduling, not a trained flow."""
+
+    ndim = 2
+    params = np.zeros(1)
+    flow = _StubFlow()
+
+    def ident(self):
+        return "stub"
+
+    def serve_vkey(self):
+        return ("stub",)
+
+    def draw_kernel(self, n):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(params, keys):
+            return jnp.zeros((keys.shape[0], n, self.ndim))
+
+        return fn
+
+    def logprob_kernel(self, n):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def fn(params, pts):
+            return jnp.zeros(pts.shape[:2])
+
+        return fn
+
+
+class TestLoadMixWithPredict:
+    def test_predict_mix_requires_registered_predictor(self):
+        from pint_tpu.serving.loadgen import LoadConfig, LoadGenerator
+
+        svc = service.TimingService(service.ServeConfig(
+            ntoa_buckets=(64,), nfree_buckets=(8,)))
+        with pytest.raises(UsageError):
+            LoadGenerator(svc, LoadConfig(mix={"predict": 1.0}))
+
+    def test_mixed_run_meets_predict_slo(self, model_a):
+        """The acceptance pin: a closed-loop mixed run including the
+        ``predict`` class completes with balanced per-class shed
+        accounting, zero stranded futures, and predict p99 inside the
+        scheduler's deadline budget."""
+        from pint_tpu.serving.loadgen import (
+            LoadConfig,
+            LoadGenerator,
+            ShapePopulation,
+        )
+
+        svc = service.TimingService(service.ServeConfig(
+            ntoa_buckets=(64,), nfree_buckets=(8,),
+            batch_buckets=(1, 4, 16), draw_buckets=(32,),
+            time_buckets=(16, 64), window_ms=1.0, max_queue=256))
+        svc.register_posterior(_StubPosterior(), seed=0)
+        cache = _small_cache(model_a)
+        svc.register_predictor(cache, warm=True)
+        cache.build()
+        # steady state: pre-compile the write-class buckets too, so
+        # predict p99 measures arbitration, not first-call compiles
+        # blocking the loop (the fairness test's discipline)
+        svc.warm([(b, 64, 8) for b in (1, 4, 16)])
+        svc.warm_posterior([(b, 32) for b in (1, 4, 16)])
+        shapes = ShapePopulation.synthetic(n=4, seed=2, n_predict=3)
+        cfg = LoadConfig(arrival="closed", concurrency=4,
+                         n_requests=48,
+                         mix={"fit": 2.0, "posterior": 1.0,
+                              "predict": 3.0},
+                         seed=11, posterior_draws=8)
+        rep = LoadGenerator(svc, cfg, shapes=shapes).run()
+        assert rep.offered == 48
+        assert rep.completed + rep.shed == rep.offered
+        assert rep.stranded == 0
+        for klass, c in rep.per_class.items():
+            assert c["completed"] + c["shed"] == c["offered"], \
+                f"{klass} accounting unbalanced: {c}"
+        pc = rep.per_class["predict"]
+        assert pc["offered"] > 0 and pc["completed"] > 0
+        budget = svc.scheduler.deadline_ms("predict")
+        p99 = svc.predict_latency_summary()["p99_ms"]
+        assert p99 < budget, \
+            f"predict p99 {p99:.1f} ms past the {budget} ms budget"

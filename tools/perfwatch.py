@@ -110,6 +110,14 @@ class RunRecord:
     posterior_p99_ms: Optional[float] = None
     posterior_train_steps: Optional[int] = None
     posterior_error: Optional[str] = None      #: degraded posterior block
+    #: from the predict{...} block (round 19+: phase-prediction door)
+    predict_predicts_per_s: Optional[float] = None
+    predict_cache_hit_rate: Optional[float] = None
+    predict_p50_ms: Optional[float] = None
+    predict_p99_ms: Optional[float] = None
+    predict_windows: Optional[int] = None
+    predict_steady_compiles: Optional[int] = None
+    predict_error: Optional[str] = None        #: degraded predict block
     #: from the scaling{...} block (round 14+: work-per-byte plans)
     scaling_efficiency_at_max: Optional[float] = None
     scaling_dispatch_per_s: Optional[float] = None
@@ -295,6 +303,23 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.posterior_train_steps = posterior["train_steps"]
         if isinstance(posterior.get("error"), str) and posterior["error"]:
             rec.posterior_error = posterior["error"]
+    predict = h.get("predict")
+    if isinstance(predict, dict):
+        for src, dst in (("predicts_per_s", "predict_predicts_per_s"),
+                         ("cache_hit_rate", "predict_cache_hit_rate"),
+                         ("p50_ms", "predict_p50_ms"),
+                         ("p99_ms", "predict_p99_ms")):
+            if isinstance(predict.get(src), (int, float)) \
+                    and not isinstance(predict.get(src), bool):
+                setattr(rec, dst, float(predict[src]))
+        for src, dst in (("windows", "predict_windows"),
+                         ("steady_state_compiles",
+                          "predict_steady_compiles")):
+            if isinstance(predict.get(src), int) \
+                    and not isinstance(predict.get(src), bool):
+                setattr(rec, dst, predict[src])
+        if isinstance(predict.get("error"), str) and predict["error"]:
+            rec.predict_error = predict["error"]
     streaming = h.get("streaming")
     if isinstance(streaming, dict):
         for src, dst in (("updates_per_s", "streaming_updates_per_s"),
@@ -566,6 +591,18 @@ def check_series(runs: List[RunRecord], threshold: float,
                    lambda r: r.posterior_logprob_per_s, +1, False),
                   ("posterior_p99_ms",
                    lambda r: r.posterior_p99_ms, -1, False),
+                  # phase prediction (round 19+): warm-served epoch
+                  # throughput gates drops, the predict door's tail
+                  # latency gates rises, and the steady-state
+                  # cache-hit rate gates drops (an all-hit history has
+                  # zero MAD scatter, so any miss past the base
+                  # threshold fails)
+                  ("predict_predicts_per_s",
+                   lambda r: r.predict_predicts_per_s, +1, False),
+                  ("predict_p99_ms",
+                   lambda r: r.predict_p99_ms, -1, False),
+                  ("predict_cache_hit_rate",
+                   lambda r: r.predict_cache_hit_rate, +1, False),
                   # work-per-byte plans (round 14+): committed-series
                   # parallel efficiency and the live fused-dispatch
                   # rate gate drops; the grid reduce-scatter payload
@@ -737,6 +774,19 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: posterior block degraded "
                    f"({latest_rec.posterior_error}) where prior runs "
                    "measured the amortized engine"))
+    # a degraded predict block where prior rounds measured the
+    # phase-prediction door is a regression, not a silent skip
+    if latest_rec.predict_error is not None \
+            and any(r.predict_predicts_per_s is not None
+                    for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="predict", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: predict block degraded "
+                   f"({latest_rec.predict_error}) where prior runs "
+                   "measured the phase-prediction door"))
     # a degraded scaling block where prior rounds measured the
     # work-per-byte plans is a regression, not a silent skip
     if latest_rec.scaling_error is not None \
@@ -952,6 +1002,15 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f"p50 {latest.posterior_p50_ms} ms, "
                   f"p99 {latest.posterior_p99_ms} ms "
                   f"({latest.posterior_train_steps} train steps)",
+                  file=out)
+        if latest.predict_predicts_per_s is not None \
+                or latest.predict_p99_ms is not None:
+            print(f"  predict: {latest.predict_predicts_per_s} "
+                  f"epochs/s ({latest.predict_windows} windows), "
+                  f"hit_rate={latest.predict_cache_hit_rate}, "
+                  f"p50 {latest.predict_p50_ms} ms, "
+                  f"p99 {latest.predict_p99_ms} ms, "
+                  f"steady_compiles={latest.predict_steady_compiles}",
                   file=out)
         if latest.precision_mixed_fits_per_s is not None \
                 or latest.precision_max_rel_err is not None:

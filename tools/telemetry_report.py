@@ -346,9 +346,11 @@ LOAD_EVENT_ATTRS = {
                  "fit_rps": (int, float),
                  "posterior_rps": (int, float),
                  "update_rps": (int, float),
+                 "predict_rps": (int, float),
                  "fit_p99_ms": (int, float),
                  "posterior_p99_ms": (int, float),
-                 "update_p99_ms": (int, float)},
+                 "update_p99_ms": (int, float),
+                 "predict_p99_ms": (int, float)},
     "request_shed": {"request_class": str, "reason": str,
                      "retry_after_ms": (int, float),
                      "queue_depth": int},
@@ -358,7 +360,7 @@ LOAD_EVENT_ATTRS = {
 }
 
 _LOAD_ARRIVALS = ("open", "closed")
-_SHED_CLASSES = ("posterior", "update", "fit")
+_SHED_CLASSES = ("predict", "posterior", "update", "fit")
 # must track pint_tpu.serving.admission.SHED_REASONS in tandem: the
 # breaker and deadline sheds ride the same typed channel
 _SHED_REASONS = ("queue_depth", "latency", "queue_full",
@@ -402,7 +404,8 @@ def validate_load_event(ev: dict, where: str,
                  f"{_LOAD_ARRIVALS}")
         for key in ("duration_s", "offered", "completed", "shed",
                     "fit_rps", "posterior_rps", "update_rps",
-                    "fit_p99_ms", "posterior_p99_ms", "update_p99_ms"):
+                    "predict_rps", "fit_p99_ms", "posterior_p99_ms",
+                    "update_p99_ms", "predict_p99_ms"):
             v = _num(key)
             if v is not None and v < 0:
                 _err(errors, where,
@@ -582,6 +585,78 @@ def validate_durability_event(ev: dict, where: str,
                 _err(errors, where,
                      f"chaos_drill {key!r} is {v!r}, below the -1 "
                      "timed-out/never-recovered sentinel")
+
+
+#: phase-prediction lifecycle events (pint_tpu/predict + the service's
+#: predict door): one predict_serve per coalesced prediction request
+#: and one predictor_cache per cache decision (per-window hit / miss /
+#: invalidate / regenerate accounting).  Same contract style as the
+#: other event families — a drift in the predict emitters fails
+#: --check before it corrupts the predict series bench/perfwatch
+#: trend.
+PREDICT_EVENT_ATTRS = {
+    "predict_serve": {"batch": int, "n": int, "bucket": int,
+                      "windows": int, "latency_ms": (int, float),
+                      "compiles": int},
+    "predictor_cache": {"kind": str, "windows": int,
+                        "latency_ms": (int, float)},
+}
+
+#: the cache-decision enum the PredictorCache emits
+_PREDICTOR_CACHE_KINDS = ("hit", "miss", "invalidate", "regenerate")
+
+
+def validate_predict_event(ev: dict, where: str,
+                           errors: List[str]) -> None:
+    """Attr contract for predict_serve / predictor_cache records:
+    required attrs typed; a serve's batch/n/bucket/windows >= 1 with
+    latency and compiles non-negative; a cache decision's kind in the
+    enum, its window count >= 1 (a zero-window decision is producer
+    noise, not accounting) and latency non-negative."""
+    name = ev.get("name")
+    required = PREDICT_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or (isinstance(v, bool)
+                                      and typ is not bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    def _num(key):
+        v = attrs.get(key)
+        return v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+    if name == "predict_serve":
+        for key in ("batch", "n", "bucket", "windows"):
+            v = _num(key)
+            if v is not None and v < 1:
+                _err(errors, where,
+                     f"predict_serve {key!r} is {v!r}, must be >= 1")
+        for key in ("latency_ms", "compiles"):
+            v = _num(key)
+            if v is not None and v < 0:
+                _err(errors, where,
+                     f"predict_serve {key!r} is negative ({v!r})")
+    elif name == "predictor_cache":
+        if attrs.get("kind") not in _PREDICTOR_CACHE_KINDS:
+            _err(errors, where,
+                 f"predictor_cache kind {attrs.get('kind')!r} not in "
+                 f"{_PREDICTOR_CACHE_KINDS}")
+        windows = _num("windows")
+        if windows is not None and windows < 1:
+            _err(errors, where,
+                 f"predictor_cache windows is {windows!r}, must be "
+                 ">= 1 — a zero-window decision is producer noise")
+        lat = _num("latency_ms")
+        if lat is not None and lat < 0:
+            _err(errors, where,
+                 f"predictor_cache latency_ms is negative ({lat!r})")
 
 
 #: catalog-engine lifecycle events (pint_tpu/catalog): one ingest
@@ -1178,6 +1253,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_streaming_event(ev, where, errors)
                     validate_load_event(ev, where, errors)
                     validate_durability_event(ev, where, errors)
+                    validate_predict_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -1498,14 +1574,16 @@ def self_test(errors: List[str]) -> int:
                          offered=64, completed=64, shed=0,
                          shed_rate=0.0, fairness=1.0,
                          fit_rps=28.4, posterior_rps=7.1,
-                         update_rps=0.0, fit_p99_ms=41.0,
-                         posterior_p99_ms=12.5, update_p99_ms=0.0)
+                         update_rps=0.0, predict_rps=44.0,
+                         fit_p99_ms=41.0, posterior_p99_ms=12.5,
+                         update_p99_ms=0.0, predict_p99_ms=6.2)
         run.record_event("load_run", arrival="open", duration_s=2.0,
                          offered=256, completed=198, shed=58,
                          shed_rate=58 / 256, fairness=0.92,
                          fit_rps=70.0, posterior_rps=29.0,
-                         update_rps=0.0, fit_p99_ms=180.0,
-                         posterior_p99_ms=48.0, update_p99_ms=0.0)
+                         update_rps=0.0, predict_rps=0.0,
+                         fit_p99_ms=180.0, posterior_p99_ms=48.0,
+                         update_p99_ms=0.0, predict_p99_ms=0.0)
         # a tolerate-errors chaos drill's load_run: errored requests
         # join the accounting balance (offered = completed + shed +
         # errored) instead of counting as lost
@@ -1513,8 +1591,9 @@ def self_test(errors: List[str]) -> int:
                          offered=32, completed=7, shed=21, errored=4,
                          shed_rate=21 / 32, fairness=1.0,
                          fit_rps=11.0, posterior_rps=0.0,
-                         update_rps=0.0, fit_p99_ms=95.0,
-                         posterior_p99_ms=0.0, update_p99_ms=0.0)
+                         update_rps=0.0, predict_rps=0.0,
+                         fit_p99_ms=95.0, posterior_p99_ms=0.0,
+                         update_p99_ms=0.0, predict_p99_ms=0.0)
         run.record_event("request_shed", request_class="fit",
                          reason="queue_depth", retry_after_ms=12.5,
                          queue_depth=52)
@@ -1547,6 +1626,22 @@ def self_test(errors: List[str]) -> int:
                          offered=64, completed=0, shed=0, errored=0,
                          stranded=-1, duration_s=120.0,
                          recovery_s=-1.0, contract_ok=False)
+        # phase-prediction producer drift check: the predict-door /
+        # predictor-cache event contract (PREDICT_EVENT_ATTRS) — a
+        # warm steady-state serve, its cold degraded twin (fresh
+        # compiles paid), and one cache decision per enum kind
+        run.record_event("predict_serve", batch=4, n=48, bucket=64,
+                         windows=3, latency_ms=1.9, compiles=0)
+        run.record_event("predict_serve", batch=1, n=12, bucket=16,
+                         windows=1, latency_ms=240.0, compiles=1)
+        run.record_event("predictor_cache", kind="hit", windows=3,
+                         latency_ms=0.0)
+        run.record_event("predictor_cache", kind="miss", windows=2,
+                         latency_ms=0.0)
+        run.record_event("predictor_cache", kind="invalidate",
+                         windows=5, latency_ms=0.0)
+        run.record_event("predictor_cache", kind="regenerate",
+                         windows=5, latency_ms=88.0)
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
@@ -1555,9 +1650,9 @@ def self_test(errors: List[str]) -> int:
         # sharding_plan, 4x elastic events, 3x serving events, 2x
         # autotune events, 3x catalog events, 3x precision events,
         # 4x amortized events, 3x streaming events, 5x load events,
-        # 5x durability events, metrics, run_end
-        if n < 43:
-            _err(errors, "selftest", f"expected >= 42 records, got {n}")
+        # 5x durability events, 6x predict events, metrics, run_end
+        if n < 49:
+            _err(errors, "selftest", f"expected >= 48 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
