@@ -357,6 +357,21 @@ def bench_b1855_gls():
                 "error": f"{type(e).__name__}: {e}"}
     st.mark("load measurement")
 
+    # request-lifecycle observatory measurement: trace overhead on the
+    # warm path, per-class SLO compliance + burn from the service's
+    # own health snapshot, and the breaker-open -> postmortem-bundle
+    # contract.  Never fatal, same degraded-block discipline.
+    try:
+        slo = slo_block()
+    except Exception as e:
+        slo = {"untraced_fits_per_s": None, "traced_fits_per_s": None,
+               "trace_overhead_frac": None, "fit_compliance": None,
+               "posterior_compliance": None, "worst_burn_rate": None,
+               "postmortems_emitted": None,
+               "steady_state_compiles": None,
+               "error": f"{type(e).__name__}: {e}"}
+    st.mark("slo measurement")
+
     # durability measurement (ROADMAP robustness item): crash
     # mid-stream with the update journal live, recover a fresh
     # service bitwise from the journal tail, then drill it under
@@ -405,6 +420,7 @@ def bench_b1855_gls():
         "scaling": scaling,
         "streaming": streaming,
         "load": load,
+        "slo": slo,
         "recovery": recovery,
     }
 
@@ -1210,6 +1226,186 @@ def load_block():
     }
 
 
+#: coalesced batch + repeat count for the trace-overhead measurement:
+#: enough dispatches that the traced/untraced ratio is a throughput
+#: signal, small enough to stay a minor slice of the bench wall
+SLO_SERVE_REQUESTS = 8
+SLO_OVERHEAD_REPEATS = 6
+
+
+def slo_block():
+    """The headline's ``slo{}`` block: the request-lifecycle
+    observatory measurement (DESIGN.md "Request-lifecycle
+    observability").
+
+    Three sub-measurements on one warmed fit+posterior service:
+
+    * **trace overhead** — warm coalesced fit throughput through the
+      async door with sampling disabled vs every request traced (both
+      in ``basic`` mode, so the ratio isolates the tracer itself);
+      ``trace_overhead_frac`` = 1 - traced/untraced, gated by
+      perfwatch so the observatory can never silently tax the hot
+      path.  Both passes must show zero steady-state compiles —
+      tracing lives entirely on the host.
+    * **SLO compliance** — a short closed-loop fit+posterior load pass,
+      then per-class deadline compliance and the worst multi-window
+      burn rate straight from ``TimingService.health()``.
+    * **flight recorder** — a ``door_fault`` raise storm trips the fit
+      breaker open, which must dump at least one validating
+      ``postmortem/1`` bundle (``postmortems_emitted``)."""
+    from pint_tpu import config as _config
+    from pint_tpu import telemetry as _telemetry
+    from pint_tpu.amortized import (AmortizedPosterior, AmortizedVI,
+                                    TrainConfig, train_flow)
+    from pint_tpu.bayesian import BayesianTiming, apply_prior_info
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.runtime.chaos import door_fault
+    from pint_tpu.serving import (BreakerConfig, FitRequest, LoadConfig,
+                                  LoadGenerator, ServeConfig,
+                                  ShapePopulation, TimingService)
+    from pint_tpu.telemetry import jaxevents
+    from pint_tpu.telemetry.flightrec import validate_bundle
+
+    # a tiny trained flow so the posterior door has real compliance to
+    # report (the block measures the observatory, not posterior
+    # quality)
+    model, toas = _ngc_or_fallback(np.random.default_rng(20260807))
+    pf = WLSFitter(toas, model)
+    pf.fit_toas(maxiter=3)
+    pf.model.free_params = ["F0", "F1"]
+    info = {}
+    for p in pf.model.free_params:
+        par = getattr(pf.model, p)
+        half = 10.0 * float(par.uncertainty
+                            or abs(par.value or 1.0) * 1e-8)
+        v = float(par.value or 0.0)
+        info[p] = {"distr": "uniform", "pmin": v - half, "pmax": v + half}
+    apply_prior_info(pf.model, info)
+    bt = BayesianTiming(pf.model, pf.toas)
+    vi = AmortizedVI.from_bayesian(bt, n_layers=2, hidden=8, seed=7)
+    steps = int(os.environ.get("BENCH_SLO_TRAIN_STEPS", "30"))
+    res = train_flow(vi, TrainConfig(steps=max(1, steps), n_samples=16,
+                                     lr=1e-2, seed=8))
+    ap = AmortizedPosterior.from_training(vi, res)
+
+    draws = 32
+    svc = TimingService(ServeConfig(
+        ntoa_buckets=(64,), nfree_buckets=(8,),
+        batch_buckets=(1, SLO_SERVE_REQUESTS), draw_buckets=(draws,),
+        max_queue=64, trace_sample=1,
+        breaker=BreakerConfig(failures=3, reset_s=60.0)))
+    svc.register_posterior(ap, seed=9)
+    svc.warm([(b, 64, 8) for b in (1, SLO_SERVE_REQUESTS)])
+    svc.warm_posterior([(b, draws) for b in (1, SLO_SERVE_REQUESTS)])
+
+    rng = np.random.default_rng(20260808)
+    base = FitRequest(M=rng.normal(size=(37, 5)),
+                      r=rng.normal(size=37), w=np.full(37, 4.0),
+                      phiinv=np.zeros(5))
+
+    def _req(i):
+        return FitRequest(M=base.M, r=base.r, w=base.w,
+                          phiinv=base.phiinv, request_id=f"slo-{i}")
+
+    def _submit_batch(reqs):
+        """Drive the ASYNC fit door (the traced/breaker-fed path —
+        the sync ``serve`` bypass sees neither)."""
+        import asyncio
+
+        async def _run():
+            return await asyncio.gather(*[svc.submit(q) for q in reqs])
+
+        return asyncio.run(_run())
+
+    def throughput():
+        """Settle one pass, then measure repeats of the coalesced
+        batch; returns (fits/s, steady-state compile delta)."""
+        _submit_batch([_req("settle")])
+        before = jaxevents.counts()
+        t0 = time.time()
+        for r in range(SLO_OVERHEAD_REPEATS):
+            _submit_batch([_req(f"{r}-{i}")
+                           for i in range(SLO_SERVE_REQUESTS)])
+        elapsed = time.time() - t0
+        steady = jaxevents.counts().compiles - before.compiles
+        n = SLO_OVERHEAD_REPEATS * SLO_SERVE_REQUESTS
+        return (n / elapsed if elapsed > 0 else float("nan"),
+                int(steady))
+
+    # both passes in BASIC mode so the comparison isolates the tracer
+    # (mark stamps, per-request allocation, the batch record) from the
+    # rest of telemetry: untraced = sampling effectively disabled,
+    # traced = every request sampled.  Same service, same executables.
+    prev_mode = _config.telemetry_mode()
+    try:
+        _telemetry.activate("basic")
+        svc.tracer.sample_every = 1 << 30
+        untraced_fps, steady_off = throughput()
+        svc.tracer.sample_every = 1
+        traced_fps, steady_full = throughput()
+    finally:
+        svc.tracer.sample_every = 1
+        _config.set_telemetry_mode(prev_mode)
+    overhead = 1.0 - traced_fps / untraced_fps
+    steady = steady_off + steady_full
+    if steady:
+        raise RuntimeError(
+            f"{steady} steady-state recompile(s) during the trace-"
+            "overhead passes — tracing must not perturb executables")
+
+    # compliance: a light closed-loop fit+posterior pass, then the
+    # service's own health snapshot (the same numbers the slo_status
+    # alerting consumes)
+    shapes = ShapePopulation.synthetic(n=4, seed=23,
+                                       ntoa_range=(24, 64),
+                                       nfree_range=(3, 8))
+    n_req = int(os.environ.get("BENCH_SLO_REQUESTS", "48"))
+    rep = LoadGenerator(svc, LoadConfig(
+        arrival="closed", concurrency=6, n_requests=n_req,
+        mix={"fit": 2.0, "posterior": 1.0}, seed=24),
+        shapes=shapes).run()
+    if rep.completed < 1:
+        raise RuntimeError("slo load pass completed zero requests")
+    health = svc.health()
+    classes = health["slo"]["classes"]
+
+    def _compliance(klass):
+        sli = classes.get(klass, {})
+        c = sli.get("compliance_fast")
+        return round(float(c), 4) if c is not None else None
+
+    # flight recorder: a raise storm trips the fit breaker -> the
+    # breaker-open hook must dump a validating postmortem bundle
+    dumps_before = svc.flight_recorder.dumps
+    with door_fault(svc, "raise", times=3):
+        for i in range(3):
+            try:
+                _submit_batch([_req(f"fault-{i}")])
+            except Exception:
+                pass
+    postmortems = svc.flight_recorder.dumps - dumps_before
+    if postmortems < 1:
+        raise RuntimeError(
+            "breaker opened without a postmortem dump — the flight "
+            "recorder missed its trigger")
+    bundle_errors = []
+    for b in svc.flight_recorder.bundles:
+        validate_bundle(b, errors=bundle_errors)
+    if bundle_errors:
+        raise RuntimeError(
+            f"postmortem bundle failed validation: {bundle_errors[:3]}")
+    return {
+        "untraced_fits_per_s": round(untraced_fps, 3),
+        "traced_fits_per_s": round(traced_fps, 3),
+        "trace_overhead_frac": round(overhead, 4),
+        "fit_compliance": _compliance("fit"),
+        "posterior_compliance": _compliance("posterior"),
+        "worst_burn_rate": round(float(health["slo"]["worst_burn"]), 4),
+        "postmortems_emitted": int(postmortems),
+        "steady_state_compiles": int(steady),
+    }
+
+
 def _ngc_or_fallback(rng):
     """The NGC6440E workload when the reference data exists, else the
     FALLBACK_PAR model with simulated TOAs at the same scale — ONE
@@ -1729,6 +1925,11 @@ def main():
         # gates per-class RPS drops, p99 rises, shed-rate rises, and
         # fairness drops)
         "load": r["load"],
+        # request-lifecycle observatory: traced-vs-untraced warm
+        # throughput, per-class deadline compliance + worst burn rate,
+        # and the breaker-open -> postmortem contract (perfwatch gates
+        # trace_overhead_frac rises and compliance drops)
+        "slo": r["slo"],
         # durability: crash mid-stream -> bitwise journal replay ->
         # chaos drill under load (perfwatch gates time_to_recover_s
         # rises, replay_ops_per_s / rps_under_fault drops, and nonzero

@@ -247,6 +247,11 @@ class DrillReport:
     breakers: Dict[str, dict] = field(default_factory=dict)
     #: untyped-failure budget the drill graded ``errored`` against
     errors_bound: int = 0
+    #: postmortem bundles the flight recorder dumped during the drill
+    postmortems: int = 0
+    #: every drill must leave >= 1 bundle and every bundle must pass
+    #: :func:`pint_tpu.telemetry.flightrec.validate_bundle`
+    postmortem_ok: bool = False
     #: contract violations, empty when the drill passed
     violations: List[str] = field(default_factory=list)
     per_class: Dict[str, dict] = field(default_factory=dict)
@@ -263,6 +268,8 @@ class DrillReport:
                 "recovery_s": self.recovery_s,
                 "spot_check_rel_err": self.spot_check_rel_err,
                 "errors_bound": self.errors_bound,
+                "postmortems": self.postmortems,
+                "postmortem_ok": self.postmortem_ok,
                 "breakers": self.breakers,
                 "contract_ok": self.contract_ok,
                 "violations": list(self.violations),
@@ -330,8 +337,13 @@ def run_drill(service, scenario: str, rps: float = 400.0,
                                       timeout=drill_timeout_s)
 
     timed_out = False
+    dumps_before = service.flight_recorder.dumps
     with scenario_context(service, scenario, times=times,
                           delay_s=delay_s):
+        # black-box capture at injection time: whatever the scenario
+        # does (some never open a breaker), every drill leaves a
+        # postmortem of the service state the fault landed on
+        service.dump_postmortem(f"chaos drill injected: {scenario}")
         try:
             load = asyncio.run(_drive())
         except (TimeoutError, asyncio.TimeoutError):
@@ -396,6 +408,25 @@ def run_drill(service, scenario: str, rps: float = 400.0,
                 f"post-drill spot-check diverged: rel err {rel:.3e} "
                 f"> {SPOT_CHECK_RTOL:.0e} vs the dedicated solve")
     report.breakers = service.breakers()
+    # postmortem contract: the drill must have produced >= 1 bundle
+    # (injection capture + any breaker-open / dispatch-failure dumps)
+    # and every retained bundle must validate against postmortem/1
+    from pint_tpu.telemetry.flightrec import validate_bundle
+
+    report.postmortems = service.flight_recorder.dumps - dumps_before
+    bundle_errors: List[str] = []
+    for bundle in service.flight_recorder.bundles:
+        validate_bundle(bundle, where=f"drill:{scenario}",
+                        errors=bundle_errors)
+    report.postmortem_ok = report.postmortems >= 1 and not bundle_errors
+    if report.postmortems < 1:
+        report.violations.append(
+            "drill produced no postmortem bundle (the flight recorder "
+            "never dumped)")
+    elif bundle_errors:
+        report.violations.append(
+            f"postmortem bundle(s) failed validation: "
+            f"{'; '.join(bundle_errors[:3])}")
     _emit_event("chaos_drill", scenario=scenario,
                 offered=int(report.offered),
                 completed=int(report.completed),
@@ -406,5 +437,7 @@ def run_drill(service, scenario: str, rps: float = 400.0,
                 recovery_s=float(report.recovery_s
                                  if report.recovery_s is not None
                                  else -1.0),
+                postmortems=int(report.postmortems),
+                postmortem_ok=bool(report.postmortem_ok),
                 contract_ok=bool(report.contract_ok))
     return report

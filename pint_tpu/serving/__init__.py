@@ -47,6 +47,7 @@ from pint_tpu.serving import (
     loadgen,
     scheduler,
     service,
+    slo,
     warmup,
 )
 from pint_tpu.serving.admission import (
@@ -70,6 +71,7 @@ from pint_tpu.serving.scheduler import (
     Scheduler,
     SchedulerConfig,
 )
+from pint_tpu.serving.slo import SLOConfig, SLOTracker
 from pint_tpu.serving.service import (
     PosteriorRequest,
     PosteriorResult,
@@ -85,7 +87,8 @@ from pint_tpu.serving.warmup import (
 )
 
 __all__ = ["aotcache", "warmup", "batcher", "service",
-           "admission", "scheduler", "loadgen", "journal",
+           "admission", "scheduler", "loadgen", "journal", "slo",
+           "SLOConfig", "SLOTracker",
            "AOTCache", "cache", "device_fingerprint",
            "FitRequest", "FitResult", "ShapeBatcher",
            "PosteriorRequest", "PosteriorResult",

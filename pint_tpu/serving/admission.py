@@ -334,7 +334,8 @@ class CircuitBreaker:
     per-door transition counter, so a flapping breaker is visible in
     telemetry, not just in a failing drill."""
 
-    def __init__(self, klass: str, cfg: Optional[BreakerConfig] = None):
+    def __init__(self, klass: str, cfg: Optional[BreakerConfig] = None,
+                 on_transition=None):
         if klass not in REQUEST_CLASSES:
             raise UsageError(
                 f"unknown request class {klass!r}; the service "
@@ -345,6 +346,11 @@ class CircuitBreaker:
         self.consecutive_failures = 0
         self.transitions = 0
         self._opened_at = 0.0
+        #: ``(klass, from_state, to_state)`` callback fired after every
+        #: transition — the service hooks the flight recorder here so a
+        #: breaker-open dumps a postmortem at the moment it trips.  A
+        #: broken hook must not take the admission path down with it.
+        self.on_transition = on_transition
 
     def _transition(self, to_state: str) -> None:
         from_state, self.state = self.state, to_state
@@ -359,6 +365,14 @@ class CircuitBreaker:
         _emit_event("circuit_transition", door=self.klass,
                     from_state=from_state, to_state=to_state,
                     failures=int(self.consecutive_failures))
+        if self.on_transition is not None:
+            try:
+                self.on_transition(self.klass, from_state, to_state)
+            except Exception as e:
+                from pint_tpu.logging import log
+
+                log.warning(f"breaker on_transition hook failed: "
+                            f"{type(e).__name__}: {e}")
 
     def allow(self) -> bool:
         """May this request enqueue?  Closed: yes.  Open: no, until

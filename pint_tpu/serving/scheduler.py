@@ -282,3 +282,15 @@ class PressureEscalator:
                     workload=self.workload,
                     n_healthy=len(healthy))
         return self.plan
+
+    def observe_burn(self, burn_rate: float, threshold: float = 2.0):
+        """The SLO observatory's second escalation signal: a hot
+        error-budget burn counts like one shedding observation.
+
+        Deliberately one-sided — a cool burn is NOT evidence pressure
+        cleared (admission may still be shedding), so it never feeds
+        ``observe(False)``, which would reset the shedding streak.
+        Returns the new plan when this sample triggered escalation."""
+        if burn_rate >= threshold:
+            return self.observe(True)
+        return None

@@ -54,7 +54,10 @@ from pint_tpu.serving.batcher import (
 )
 from pint_tpu.predict.door import DEFAULT_TIME_BUCKETS
 from pint_tpu.serving.scheduler import Scheduler, SchedulerConfig
+from pint_tpu.serving.slo import SLOConfig, SLOTracker
 from pint_tpu.serving.warmup import WarmPool, WarmupReport, warm_buckets
+from pint_tpu.telemetry.flightrec import FlightRecorder
+from pint_tpu.telemetry.reqtrace import Tracer, batch_record
 
 __all__ = ["ServeConfig", "TimingService", "PosteriorRequest",
            "PosteriorResult", "DoorStats", "DEFAULT_DRAW_BUCKETS"]
@@ -94,6 +97,13 @@ class ServeConfig:
     #: as a typed ``ShedResponse(reason="deadline")`` instead of
     #: leaving its awaiter hanging (False: the pre-durability behavior)
     enforce_deadlines: bool = True
+    #: SLO observatory targets/windows (None: the defaults — 0.99
+    #: goodput, 5m/1h burn windows; bench and tests shrink the windows)
+    slo: Optional[SLOConfig] = None
+    #: request-trace sampling override: trace 1-in-N admitted requests
+    #: in basic telemetry mode (None: ``PINT_TPU_TRACE_SAMPLE`` or the
+    #: 1-in-16 default; full mode always traces every request)
+    trace_sample: Optional[int] = None
 
 
 @dataclass
@@ -337,8 +347,18 @@ class TimingService:
         # dispatch failure); the write-ahead journal is opt-in via
         # attach_journal()
         for door in (self._fit, self._post, self._upd, self._pred):
-            door.breaker = CircuitBreaker(door.klass, self.cfg.breaker)
+            door.breaker = CircuitBreaker(door.klass, self.cfg.breaker,
+                                          on_transition=self
+                                          ._on_breaker_transition)
         self._journal = None
+        # request-lifecycle observability: the deterministic trace-id
+        # source + sampler, the SLO error-budget observatory, and the
+        # always-on black-box flight recorder (bounded rings; dumps a
+        # postmortem bundle on breaker-open / dispatch failure / drill
+        # injection)
+        self._tracer = Tracer(self.cfg.trace_sample)
+        self._slo = SLOTracker(self.cfg.slo, on_status=self._on_slo_status)
+        self._flightrec = FlightRecorder()
 
     # -- warm-up ------------------------------------------------------------
 
@@ -397,6 +417,80 @@ class TimingService:
             workload, devices=devices, sustain=sustain,
             start_rung=start_rung)
         return self._escalator
+
+    # -- request-lifecycle observability ------------------------------------
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer
+
+    @property
+    def slo(self) -> SLOTracker:
+        return self._slo
+
+    @property
+    def flight_recorder(self) -> FlightRecorder:
+        return self._flightrec
+
+    def _queue_depths(self) -> Dict[str, int]:
+        return {d.klass: len(d.pending)
+                for d in (self._fit, self._post, self._upd, self._pred)}
+
+    def _on_slo_status(self, klass: str, state: str, info: dict) -> None:
+        """SLOTracker state-transition hook: one ``slo_status`` event
+        per ok/warn/page edge (never one per request)."""
+        self._flightrec.note(klass, "health", state=state,
+                             burn_rate=info["burn_rate"])
+        _emit_event("slo_status", request_class=klass, state=state,
+                    previous=info["previous"],
+                    burn_rate=info["burn_rate"],
+                    burn_rate_slow=info["burn_rate_slow"],
+                    goodput=info["goodput"],
+                    shed_rate=info["shed_rate"])
+
+    def _on_breaker_transition(self, klass: str, from_state: str,
+                               to_state: str) -> None:
+        """Breaker hook: every transition lands in the flight ring;
+        closed/half_open -> open dumps a postmortem at the moment the
+        door goes sick (the black-box capture a drill report cannot
+        reconstruct after recovery)."""
+        self._flightrec.note(klass, "breaker", from_state=from_state,
+                             to_state=to_state)
+        if to_state == "open":
+            self.dump_postmortem(
+                f"circuit breaker opened for {klass} door")
+
+    def dump_postmortem(self, trigger: str) -> dict:
+        """Capture a ``postmortem/1`` bundle of the service's state
+        right now (rings, breakers, SLO burn, queue depths)."""
+        return self._flightrec.dump(
+            trigger, breakers=self.breakers(),
+            slo=self._slo.snapshot(),
+            queue_depths=self._queue_depths())
+
+    def health(self) -> dict:
+        """Live health snapshot: per-class SLIs + burn states from the
+        observatory, breaker states, queue depths, and the flight
+        recorder's counters.  ``healthy`` is the single-bit rollup
+        (every class "ok", every breaker closed) the escalator — or an
+        external load balancer — can key on."""
+        snap = self._slo.snapshot()
+        breakers = self.breakers()
+        healthy = (all(c["state"] == "ok"
+                       for c in snap["classes"].values())
+                   and all(b["state"] == "closed"
+                           for b in breakers.values()))
+        if config._telemetry_mode != "off":
+            self._slo.record_gauges(snap)
+        return {
+            "healthy": healthy,
+            "slo": snap,
+            "breakers": breakers,
+            "queue_depths": self._queue_depths(),
+            "trace_seq": self._tracer.seq,
+            "flight_recorder": {"dumps": self._flightrec.dumps,
+                                "dropped": self._flightrec.dropped},
+        }
 
     # -- synchronous door ---------------------------------------------------
 
@@ -463,6 +557,9 @@ class TimingService:
                 queue_depth=len(door.pending), request_id=request_id)
             if self._escalator is not None:
                 self._escalator.observe(True)
+            self._slo.record_shed(door.klass)
+            self._flightrec.note(door.klass, "shed", reason="circuit_open",
+                                 depth=len(door.pending))
             if strict:
                 raise UsageError(
                     f"{what} circuit breaker is {door.breaker.state} "
@@ -477,13 +574,33 @@ class TimingService:
         if self._escalator is not None:
             self._escalator.observe(shed is not None)
         if shed is not None:
+            self._slo.record_shed(door.klass)
+            self._flightrec.note(door.klass, "shed", reason=shed.reason,
+                                 depth=len(door.pending))
             if strict:
                 raise UsageError(
                     f"{what} queue full ({self.cfg.max_queue}); shed "
                     "load or raise ServeConfig.max_queue")
             return shed
+        # admitted: allocate the trace id (every admitted request
+        # advances the counter; only sampled ones carry marks) and
+        # capture the submitter's span — asyncio's create_task context
+        # copy cannot carry either across the flush-task hop, so both
+        # ride the pending tuple explicitly
+        trace = self._tracer.begin(door.klass, request_id)
+        ctx_span = None
+        if config._telemetry_mode != "off":
+            from pint_tpu.telemetry import spans
+
+            ctx_span = spans.current_span()
         fut = loop.create_future()
-        door.pending.append((request, fut, time.perf_counter()))
+        t_enq = time.perf_counter()
+        if trace is not None:
+            trace.mark("enqueue", t_enq)
+        door.pending.append((request, fut, t_enq, trace, ctx_span))
+        self._flightrec.note(door.klass, "enqueue",
+                             depth=len(door.pending),
+                             trace_id=trace.trace_id if trace else 0)
         door.gauge_queue_depth()
         if door.flush_task is None:
             delay = self._sched.window_s(door.klass, self.cfg.window_ms,
@@ -520,6 +637,9 @@ class TimingService:
                     break
             if not fut.done():
                 fut.cancel()
+            self._slo.record_shed(door.klass)
+            self._flightrec.note(door.klass, "shed", reason="deadline",
+                                 depth=len(door.pending))
             return self._admission.shed_now(
                 door.klass, "deadline", retry_after_ms=deadline_ms,
                 queue_depth=len(door.pending), request_id=request_id)
@@ -536,6 +656,13 @@ class TimingService:
         take = self._sched.quantum(door.klass)
         batch, door.pending = door.pending[:take], door.pending[take:]
         door.flush_task = None
+        traces = [entry[3] for entry in batch if entry[3] is not None]
+        if traces:
+            # one shared clock read: every member of this dispatch
+            # agrees on when the coalescing window closed
+            t_flush = time.perf_counter()
+            for tr in traces:
+                tr.mark("coalesce_flush", t_flush)
         try:
             if door.pending:
                 loop = asyncio.get_running_loop()
@@ -550,7 +677,7 @@ class TimingService:
             # gauge, scheduler accounting) must never strand the popped
             # batch's awaiters: fail them with the bookkeeping error
             # instead of leaving futures no one will ever resolve
-            for _, fut, _ in batch:
+            for _, fut, _, _, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)
             return
@@ -567,17 +694,58 @@ class TimingService:
         once however many requests rode it."""
         if not pending:
             return
+        traces = [p[3] for p in pending if p[3] is not None]
+        self._flightrec.note(door.klass, "dispatch", batch=len(pending))
+        if traces:
+            t_dispatch = time.perf_counter()
+            for tr in traces:
+                tr.mark("dispatch", t_dispatch)
+        # re-attach the oldest member's submit-time span: the flush
+        # task's own context is a copy of whichever request opened the
+        # coalescing window (or of a prior drain pass), so without the
+        # explicit attach the dispatch span parents to the wrong
+        # request — or to the root — for every other batch member
+        ctx_span = None
+        for p in pending:
+            if p[4] is not None:
+                ctx_span = p[4]
+                break
+        from pint_tpu.telemetry import spans
+
         try:
-            results = run([p[0] for p in pending])
+            with spans.attach(ctx_span), \
+                    spans.span(f"{what}.dispatch", batch=len(pending)):
+                results = run([p[0] for p in pending])
         except Exception as e:
-            door.breaker.record_failure()
-            for _, fut, _ in pending:
+            # awaiters first — the breaker/recorder/postmortem hooks
+            # below must never stand between a failed dispatch and the
+            # futures it owes an answer
+            for _, fut, _, _, _ in pending:
                 if not fut.done():
                     fut.set_exception(e)
+            door.breaker.record_failure()
+            self._flightrec.note(door.klass, "dispatch_error",
+                                 error=type(e).__name__,
+                                 batch=len(pending))
+            try:
+                self.dump_postmortem(
+                    f"unhandled {what} dispatch failure: "
+                    f"{type(e).__name__}: {e}")
+            except Exception as pe:
+                from pint_tpu.logging import log
+
+                log.warning(f"postmortem dump failed "
+                            f"({type(pe).__name__}: {pe}); dispatch "
+                            "error already delivered")
             return
         door.breaker.record_success()
+        if traces:
+            t_sync = time.perf_counter()
+            for tr in traces:
+                tr.mark("device_sync", t_sync)
         now = time.perf_counter()
-        for (req, fut, t0), res in zip(pending, results):
+        delivered = []
+        for (req, fut, t0, trace, _), res in zip(pending, results):
             res.latency_ms = 1e3 * (now - t0)
             if fut.done():
                 # a deadline shed already resolved this awaiter — the
@@ -585,7 +753,14 @@ class TimingService:
                 # recording it here would double-count
                 continue
             fut.set_result(res)
+            if trace is not None:
+                # same clock read as the latency accounting, so the
+                # enqueue -> deliver span EQUALS res.latency_ms and the
+                # segment decomposition telescopes to admit -> deliver
+                trace.mark("deliver", now)
+                delivered.append(trace)
             try:
+                self._slo.record(door.klass, res.latency_ms)
                 record(req, res, res.latency_ms)
             except Exception as e:
                 from pint_tpu.logging import log
@@ -593,6 +768,28 @@ class TimingService:
                 log.warning(f"{what} accounting failed "
                             f"({type(e).__name__}: {e}); result "
                             "delivered")
+        self._flightrec.note(door.klass, "deliver", batch=len(pending),
+                             n_traced=len(delivered))
+        try:
+            if delivered:
+                # ONE batch record per coalesced dispatch, linking
+                # every delivered member's trace id and decomposition
+                _emit_event("request_trace",
+                            **batch_record(delivered,
+                                           batch=len(pending)))
+            self._slo.evaluate(door.klass)
+            if self._escalator is not None:
+                # the observatory's second escalation signal: a hot
+                # fast-window burn counts like one sustained-shedding
+                # sample (once per dispatch, never per request)
+                self._escalator.observe_burn(
+                    self._slo.class_slis(door.klass)["burn_fast"])
+        except Exception as e:
+            from pint_tpu.logging import log
+
+            log.warning(f"{what} observatory accounting failed "
+                        f"({type(e).__name__}: {e}); results "
+                        "delivered")
 
     # -- posterior door (amortized engine) ----------------------------------
 

@@ -1,0 +1,241 @@
+"""SLO error-budget observatory for the four-door service.
+
+SLO compliance was a post-hoc number: loadgen computed per-class
+goodput after a run ended, and nothing watched the budget *while*
+the service served.  This module is the live side — a sliding-window
+per-class SLI tracker fed by the door core on every delivery/shed,
+with Google-SRE-style multi-window burn-rate alerting:
+
+* **SLIs** per request class (the :data:`~pint_tpu.serving.admission.
+  REQUEST_CLASSES` enum): *goodput* (delivered within the class's
+  deadline budget), *compliance* (same, over delivered requests only),
+  and *shed rate*, each over a fast and a slow sliding window;
+* **burn rate** = (1 - goodput) / (1 - target): 1.0 burns the error
+  budget exactly at the sustainable rate; the SRE playbook pages when
+  BOTH a fast window (catches sudden cliffs) and a slow window
+  (filters blips) burn hot.  Production uses 5m/1h; bench and tests
+  scale both via ``SLOConfig(fast_window_s=..., slow_window_s=...)``
+  because a bench run lives for seconds, not hours;
+* **outputs**: ``pint_tpu_slo_*`` gauges, ``slo_status`` events on
+  state *transitions* only (ok -> warn -> page and back — not one
+  event per request), a :meth:`SLOTracker.snapshot` consumed by
+  ``TimingService.health()`` and the flight recorder's postmortem
+  bundles, and a second escalation signal for
+  :meth:`~pint_tpu.serving.scheduler.PressureEscalator.observe_burn`.
+
+The tracker takes an injectable clock so tests drive window decay
+deterministically; it never reads wall time on the hot path beyond
+the one ``perf_counter`` the door core already took for latency.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+from pint_tpu.exceptions import UsageError
+from pint_tpu.serving.admission import REQUEST_CLASSES
+from pint_tpu.serving.scheduler import DEFAULT_DEADLINES_MS
+
+__all__ = ["SLO_STATES", "SLOConfig", "SLOTracker"]
+
+#: alert states in escalation order; transitions emit ``slo_status``
+SLO_STATES = ("ok", "warn", "page")
+
+#: per-window sample cap — a storm of cheap requests must not grow the
+#: deques unboundedly inside one window span
+_MAX_SAMPLES = 4096
+
+
+@dataclass(frozen=True)
+class SLOConfig:
+    """Targets and windows for the error-budget accounting.
+
+    ``target`` is the goodput objective (0.99 => 1% error budget).
+    Burn thresholds follow the SRE workbook's 2%-budget/1h-page
+    calibration: fast-window burn >= ``page_burn`` AND slow-window
+    burn >= ``slow_burn`` pages; fast burn >= ``warn_burn`` warns."""
+
+    target: float = 0.99
+    fast_window_s: float = 300.0   # 5m in production; tests shrink it
+    slow_window_s: float = 3600.0  # 1h
+    page_burn: float = 14.4
+    slow_burn: float = 6.0
+    warn_burn: float = 2.0
+    deadlines_ms: Dict[str, float] = field(
+        default_factory=lambda: dict(DEFAULT_DEADLINES_MS))
+
+    def __post_init__(self):
+        if not (0.0 < self.target < 1.0):
+            raise UsageError(
+                f"SLO target must be in (0, 1), got {self.target}")
+        if self.fast_window_s <= 0 or self.slow_window_s < self.fast_window_s:
+            raise UsageError(
+                "SLO windows must satisfy 0 < fast <= slow, got "
+                f"fast={self.fast_window_s} slow={self.slow_window_s}")
+        for k in self.deadlines_ms:
+            if k not in REQUEST_CLASSES:
+                raise UsageError(
+                    f"unknown request class {k!r} in SLO deadlines; "
+                    f"classes are {REQUEST_CLASSES}")
+
+
+class SLOTracker:
+    """Sliding-window SLIs + burn-rate state machine, one per service.
+
+    The door core calls :meth:`record` once per delivered request and
+    :meth:`record_shed` once per shed; everything else (windowed
+    aggregation, state transitions, gauges) happens lazily at
+    :meth:`snapshot` / :meth:`evaluate` time so the per-request cost
+    is one deque append."""
+
+    def __init__(self, cfg: Optional[SLOConfig] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 on_status: Optional[Callable[[str, str, dict], None]] = None):
+        self.cfg = cfg or SLOConfig()
+        self._clock = clock
+        # per class: deque of (t, ok: bool, shed: bool)
+        self._samples: Dict[str, collections.deque] = {
+            k: collections.deque(maxlen=_MAX_SAMPLES)
+            for k in REQUEST_CLASSES}
+        self._state: Dict[str, str] = {k: "ok" for k in REQUEST_CLASSES}
+        self._on_status = on_status
+        self.transitions = 0
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        import time
+
+        return time.perf_counter()
+
+    # ---- feeding ----------------------------------------------------
+
+    def record(self, klass: str, latency_ms: float,
+               now: Optional[float] = None) -> None:
+        """One delivered request: good iff it met its deadline budget."""
+        budget = self.cfg.deadlines_ms.get(klass)
+        ok = budget is None or latency_ms <= budget
+        t = self._now() if now is None else now
+        self._samples[klass].append((t, ok, False))
+
+    def record_shed(self, klass: str, now: Optional[float] = None) -> None:
+        """One shed request: burns budget and counts in the shed rate."""
+        t = self._now() if now is None else now
+        self._samples[klass].append((t, False, True))
+
+    # ---- aggregation ------------------------------------------------
+
+    def _window(self, klass: str, window_s: float,
+                now: float) -> Tuple[int, int, int]:
+        """(total, good, shed) over the trailing ``window_s``."""
+        cutoff = now - window_s
+        total = good = shed = 0
+        for t, ok, was_shed in self._samples[klass]:
+            if t < cutoff:
+                continue
+            total += 1
+            good += ok
+            shed += was_shed
+        return total, good, shed
+
+    def _burn(self, total: int, good: int) -> float:
+        """(1 - goodput) / (1 - target); 0.0 on an empty window (no
+        traffic burns no budget)."""
+        if total == 0:
+            return 0.0
+        bad_frac = 1.0 - good / total
+        return bad_frac / (1.0 - self.cfg.target)
+
+    def class_slis(self, klass: str,
+                   now: Optional[float] = None) -> dict:
+        """One class's SLI panel over both windows."""
+        t = self._now() if now is None else now
+        ft, fg, fs = self._window(klass, self.cfg.fast_window_s, t)
+        st_, sg, ss = self._window(klass, self.cfg.slow_window_s, t)
+        delivered = ft - fs
+        return {
+            "requests_fast": ft,
+            "goodput_fast": fg / ft if ft else 1.0,
+            "compliance_fast": fg / delivered if delivered else 1.0,
+            "shed_rate_fast": fs / ft if ft else 0.0,
+            "burn_fast": self._burn(ft, fg),
+            "requests_slow": st_,
+            "burn_slow": self._burn(st_, sg),
+        }
+
+    def evaluate(self, klass: str, now: Optional[float] = None) -> str:
+        """Advance the class's alert state machine; emit ``slo_status``
+        (via the ``on_status`` hook) only when the state changes."""
+        t = self._now() if now is None else now
+        slis = self.class_slis(klass, now=t)
+        bf, bs = slis["burn_fast"], slis["burn_slow"]
+        if bf >= self.cfg.page_burn and bs >= self.cfg.slow_burn:
+            state = "page"
+        elif bf >= self.cfg.warn_burn:
+            state = "warn"
+        else:
+            state = "ok"
+        prev = self._state[klass]
+        if state != prev:
+            self._state[klass] = state
+            self.transitions += 1
+            if self._on_status is not None:
+                self._on_status(klass, state, {
+                    "previous": prev,
+                    "burn_rate": round(bf, 6),
+                    "burn_rate_slow": round(bs, 6),
+                    "goodput": round(slis["goodput_fast"], 6),
+                    "shed_rate": round(slis["shed_rate_fast"], 6),
+                })
+        return state
+
+    def state(self, klass: str) -> str:
+        return self._state[klass]
+
+    def worst_burn(self, now: Optional[float] = None) -> float:
+        """Max fast-window burn across classes — the escalation signal
+        PressureEscalator.observe_burn consumes."""
+        t = self._now() if now is None else now
+        return max(self.class_slis(k, now=t)["burn_fast"]
+                   for k in REQUEST_CLASSES)
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """The full observatory panel: per-class SLIs + alert state.
+        Consumed by ``TimingService.health()`` and embedded in
+        postmortem bundles."""
+        t = self._now() if now is None else now
+        classes = {}
+        for k in REQUEST_CLASSES:
+            slis = self.class_slis(k, now=t)
+            classes[k] = dict(slis, state=self.evaluate(k, now=t))
+        return {
+            "target": self.cfg.target,
+            "fast_window_s": self.cfg.fast_window_s,
+            "slow_window_s": self.cfg.slow_window_s,
+            "worst_burn": max(c["burn_fast"] for c in classes.values()),
+            "transitions": self.transitions,
+            "classes": classes,
+        }
+
+    def record_gauges(self, snap: Optional[dict] = None) -> None:
+        """Publish ``pint_tpu_slo_*`` gauges (labelled by class)."""
+        from pint_tpu.telemetry import metrics
+
+        if snap is None:
+            snap = self.snapshot()
+        for k, slis in snap["classes"].items():
+            labels = {"request_class": k}
+            metrics.gauge("pint_tpu_slo_goodput",
+                          "Fast-window goodput fraction per class",
+                          ).set(slis["goodput_fast"], labels)
+            metrics.gauge("pint_tpu_slo_burn_rate_fast",
+                          "Fast-window error-budget burn rate per class",
+                          ).set(slis["burn_fast"], labels)
+            metrics.gauge("pint_tpu_slo_burn_rate_slow",
+                          "Slow-window error-budget burn rate per class",
+                          ).set(slis["burn_slow"], labels)
+            metrics.gauge("pint_tpu_slo_shed_rate",
+                          "Fast-window shed fraction per class",
+                          ).set(slis["shed_rate_fast"], labels)

@@ -37,18 +37,23 @@ from __future__ import annotations
 from typing import Optional
 
 from pint_tpu import config
-from pint_tpu.telemetry import costs, distview, jaxevents, metrics, runlog, \
-    spans
+from pint_tpu.telemetry import costs, distview, flightrec, jaxevents, \
+    metrics, reqtrace, runlog, spans
+from pint_tpu.telemetry.flightrec import FlightRecorder, validate_bundle
+from pint_tpu.telemetry.reqtrace import RequestTrace, Tracer, current_trace
 from pint_tpu.telemetry.spans import (
+    attach,
     current_span,
     event,
     set_attr,
     span,
 )
 
-__all__ = ["span", "event", "set_attr", "current_span", "mode", "enabled",
-           "activate", "deactivate", "lifecycle_event", "spans", "metrics",
-           "jaxevents", "runlog", "costs", "distview"]
+__all__ = ["span", "event", "set_attr", "current_span", "attach", "mode",
+           "enabled", "activate", "deactivate", "lifecycle_event", "spans",
+           "metrics", "jaxevents", "runlog", "costs", "distview",
+           "reqtrace", "flightrec", "RequestTrace", "Tracer",
+           "current_trace", "FlightRecorder", "validate_bundle"]
 
 
 def mode() -> str:

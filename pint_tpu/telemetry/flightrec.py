@@ -1,0 +1,240 @@
+"""Black-box flight recorder: bounded per-door event rings that dump
+schema-tagged postmortem bundles at the moment of failure.
+
+A chaos drill or breaker-open previously left no capture of what the
+service looked like when things went wrong — the runlog records the
+*drill report* after recovery, not the queue depths, breaker states,
+and recent lifecycle events at injection time.  The flight recorder is
+the aviation-style answer: always on (the rings are small and
+bounded; no telemetry-mode check on the note path), continuously
+overwriting, and dumped only on a trigger:
+
+* **rings** — one bounded deque per door of recent lifecycle / shed /
+  breaker / journal entries, capped by BOTH entry count and JSON byte
+  size (head eviction; the byte bound holds under a quarantine storm,
+  pinned in tests);
+* **triggers** — breaker closed->open (via the admission layer's
+  ``on_transition`` hook), unhandled dispatch failure in
+  ``_flush_door``, and chaos-drill injection
+  (:func:`~pint_tpu.runtime.chaos.run_drill` asserts every drill
+  produced a bundle that validates);
+* **bundle** — :data:`POSTMORTEM_SCHEMA` (``postmortem/1``): the ring
+  contents, breaker states, SLO burn snapshot, queue depths, and the
+  runlog manifest ref.  Bundles are kept in a bounded in-memory list
+  and written under ``<run_dir>/postmortem/`` in full telemetry mode;
+  a ``postmortem`` event records each dump.
+
+:func:`validate_bundle` is the runtime validator ``telemetry_report
+--check`` and the chaos contract call; ``tools/servewatch.py``
+carries a stdlib twin (tools gating pre-commit must not import
+pint_tpu -> jax) and a test pins that the two agree.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+from typing import Callable, Dict, List, Optional
+
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["POSTMORTEM_SCHEMA", "FlightRecorder", "validate_bundle"]
+
+#: bundle schema tag; bump on breaking shape changes
+POSTMORTEM_SCHEMA = "pint_tpu.telemetry.postmortem/1"
+
+#: entry kinds the rings accept (closed enum: the validator and
+#: servewatch's renderer both key off it)
+ENTRY_KINDS = ("enqueue", "shed", "dispatch", "dispatch_error", "deliver",
+               "breaker", "journal", "drill", "health")
+
+#: retained dumped bundles (in memory, newest last)
+_MAX_BUNDLES = 8
+
+
+class FlightRecorder:
+    """Bounded per-door rings + postmortem dumps for one service."""
+
+    def __init__(self, max_entries: int = 512, max_bytes: int = 256 * 1024,
+                 clock: Optional[Callable[[], float]] = None):
+        if max_entries < 1 or max_bytes < 1024:
+            raise UsageError(
+                "flight recorder bounds must satisfy max_entries >= 1 "
+                f"and max_bytes >= 1024, got {max_entries}/{max_bytes}")
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self._clock = clock
+        self._rings: Dict[str, collections.deque] = {}
+        self._ring_bytes: Dict[str, int] = {}
+        self.bundles: List[dict] = []
+        self.dumps = 0
+        self.dropped = 0  # entries evicted by the byte bound
+
+    def _now(self) -> float:
+        if self._clock is not None:
+            return self._clock()
+        import time
+
+        return time.perf_counter()
+
+    # ---- recording --------------------------------------------------
+
+    def note(self, door: str, kind: str, **data) -> None:
+        """Append one entry to ``door``'s ring, evicting from the head
+        until both the entry and byte bounds hold."""
+        if kind not in ENTRY_KINDS:
+            raise UsageError(f"unknown flight-recorder entry kind {kind!r}; "
+                             f"kinds are {ENTRY_KINDS}")
+        entry = {"t": round(self._now(), 6), "kind": kind}
+        entry.update(data)
+        # Size by the JSON encoding — the same cost accounting the
+        # bundle's byte bound is stated in.
+        try:
+            size = len(json.dumps(entry, default=str))
+        except (TypeError, ValueError):
+            entry = {"t": entry["t"], "kind": kind, "unserializable": True}
+            size = len(json.dumps(entry))
+        ring = self._rings.get(door)
+        if ring is None:
+            ring = self._rings[door] = collections.deque()
+            self._ring_bytes[door] = 0
+        ring.append((size, entry))
+        self._ring_bytes[door] += size
+        while ring and (len(ring) > self.max_entries
+                        or self._ring_bytes[door] > self.max_bytes):
+            old_size, _ = ring.popleft()
+            self._ring_bytes[door] -= old_size
+            self.dropped += 1
+
+    def ring_bytes(self, door: str) -> int:
+        return self._ring_bytes.get(door, 0)
+
+    def ring_len(self, door: str) -> int:
+        return len(self._rings.get(door, ()))
+
+    # ---- dumping ----------------------------------------------------
+
+    def dump(self, trigger: str,
+             breakers: Optional[dict] = None,
+             slo: Optional[dict] = None,
+             queue_depths: Optional[Dict[str, int]] = None,
+             extra: Optional[dict] = None) -> dict:
+        """Build (and retain, and — in full mode — persist) one
+        ``postmortem/1`` bundle.  ``trigger`` must be a non-empty
+        reason string; the validator rejects bundles without one."""
+        if not trigger or not str(trigger).strip():
+            raise UsageError("postmortem trigger reason must be non-empty")
+        bundle = {
+            "schema": POSTMORTEM_SCHEMA,
+            "trigger": str(trigger),
+            "t": round(self._now(), 6),
+            "rings": {door: [e for _, e in ring]
+                      for door, ring in self._rings.items()},
+            "ring_bytes": dict(self._ring_bytes),
+            "breakers": breakers or {},
+            "slo": slo or {},
+            "queue_depths": queue_depths or {},
+            "manifest_ref": None,
+        }
+        if extra:
+            bundle.update(extra)
+        path = self._persist(bundle)
+        self.dumps += 1
+        self.bundles.append(bundle)
+        del self.bundles[:-_MAX_BUNDLES]
+        self._emit_event(bundle, path)
+        return bundle
+
+    def _persist(self, bundle: dict) -> Optional[str]:
+        """Write the bundle under the active run dir (full mode only);
+        stamp the manifest ref either way when a run is active."""
+        import os
+
+        from pint_tpu import config
+        from pint_tpu.telemetry import runlog
+
+        run = runlog.current_run()
+        if run is None:
+            return None
+        bundle["manifest_ref"] = os.path.join(str(run.path),
+                                              "manifest.json")
+        if config._telemetry_mode != "full":
+            return None
+        try:
+            pm_dir = os.path.join(str(run.path), "postmortem")
+            os.makedirs(pm_dir, exist_ok=True)
+            path = os.path.join(pm_dir,
+                                f"postmortem-{self.dumps:04d}.json")
+            with open(path, "w") as f:
+                f.write(json.dumps(bundle, indent=2, default=str))
+            return path
+        except OSError:
+            return None
+
+    def _emit_event(self, bundle: dict, path: Optional[str]) -> None:
+        from pint_tpu import config
+        from pint_tpu import telemetry
+
+        if config._telemetry_mode == "off":
+            return
+        telemetry.lifecycle_event(
+            "postmortem",
+            trigger=bundle["trigger"],
+            n_doors=len(bundle["rings"]),
+            n_entries=sum(len(r) for r in bundle["rings"].values()),
+            ring_bytes=sum(bundle["ring_bytes"].values()),
+            path=path or "",
+        )
+
+
+def validate_bundle(doc: dict, where: str = "postmortem",
+                    errors: Optional[List[str]] = None) -> List[str]:
+    """Validate one ``postmortem/1`` bundle; returns the error list
+    (empty == valid).  Mirrored stdlib-side by ``tools/servewatch.py``
+    — keep the two in lockstep (a test diffs them on shared fixtures).
+    """
+    errs = errors if errors is not None else []
+
+    def bad(msg: str) -> None:
+        errs.append(f"{where}: {msg}")
+
+    if not isinstance(doc, dict):
+        bad(f"bundle must be an object, got {type(doc).__name__}")
+        return errs
+    if doc.get("schema") != POSTMORTEM_SCHEMA:
+        bad(f"schema must be {POSTMORTEM_SCHEMA!r}, got "
+            f"{doc.get('schema')!r}")
+    trigger = doc.get("trigger")
+    if not isinstance(trigger, str) or not trigger.strip():
+        bad("trigger must be a non-empty reason string")
+    rings = doc.get("rings")
+    if not isinstance(rings, dict):
+        bad("rings must be an object of door -> entry list")
+    else:
+        for door, entries in rings.items():
+            if not isinstance(entries, list):
+                bad(f"ring {door!r} must be a list")
+                continue
+            for i, e in enumerate(entries):
+                if not isinstance(e, dict) or "kind" not in e or "t" not in e:
+                    bad(f"ring {door!r} entry {i} must be an object with "
+                        "'kind' and 't'")
+                    break
+                if e["kind"] not in ENTRY_KINDS:
+                    bad(f"ring {door!r} entry {i}: unknown kind "
+                        f"{e['kind']!r}")
+                    break
+    for field in ("breakers", "slo", "queue_depths"):
+        if not isinstance(doc.get(field), dict):
+            bad(f"{field} must be an object")
+    ring_bytes = doc.get("ring_bytes")
+    if not isinstance(ring_bytes, dict) or any(
+            not isinstance(v, int) or v < 0 for v in ring_bytes.values()):
+        bad("ring_bytes must map door -> non-negative int")
+    mref = doc.get("manifest_ref")
+    if mref is not None and not isinstance(mref, str):
+        bad("manifest_ref must be a string or null")
+    t = doc.get("t")
+    if not isinstance(t, (int, float)) or isinstance(t, bool) or t < 0:
+        bad("t must be a non-negative number")
+    return errs

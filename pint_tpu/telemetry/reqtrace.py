@@ -1,0 +1,223 @@
+"""Request-lifecycle tracing for the four-door serving core.
+
+The telemetry stack observed *kernels*, not *requests*: a door's p99
+is one number from a latency ring with no decomposition into queue
+wait vs coalesce window vs device dispatch vs delivery.  This module
+is the per-request attribution layer the door core
+(:meth:`~pint_tpu.serving.service.TimingService._submit_door` /
+``_drain_door`` / ``_flush_door``) stamps:
+
+* **trace ids** — every admitted request gets a sequence number from
+  the service's own monotonic counter (:class:`Tracer`), so ids are
+  deterministic under a seeded load schedule — no wall-clock or PRNG
+  nondeterminism in tests;
+* **lifecycle marks** — the door core stamps ``admit`` -> ``enqueue``
+  -> ``coalesce_flush`` -> ``dispatch`` -> ``device_sync`` ->
+  ``deliver`` on the sampled :class:`RequestTrace`; consecutive marks
+  define the latency segments (:data:`SEGMENTS`), and because each
+  segment is the difference of adjacent clock reads the decomposition
+  telescopes: **segments sum to the end-to-end wall exactly** (the
+  accounting identity, pinned in tests on a fake clock);
+* **one record per coalesced batch** — a dispatch emits ONE
+  ``request_trace`` event linking its member trace ids (members share
+  the flush/dispatch/sync/deliver marks; only admit/enqueue differ),
+  validated by ``tools/telemetry_report --check`` and rendered by
+  ``tools/servewatch``;
+* **sampling** — tracing is 1-in-N (:data:`DEFAULT_SAMPLE_EVERY`,
+  ``PINT_TPU_TRACE_SAMPLE``) in ``basic`` mode, every request in
+  ``full`` mode, and completely off (no clock reads) when telemetry
+  is off.  The overhead is *measured*, not assumed: bench's ``slo{}``
+  block reports ``trace_overhead_frac`` (1 - traced/untraced warm
+  serve throughput) and perfwatch gates rises.
+
+Trace context crosses the door core's ``loop.create_task`` hops
+explicitly — the contextvar is a convenience for *reading* the active
+trace inside the submitting request's context, never the propagation
+mechanism (asyncio task contexts are copies; see
+:func:`pint_tpu.telemetry.spans.attach` for the span-side fix).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+from typing import Dict, List, Optional, Tuple
+
+from pint_tpu import config
+from pint_tpu.exceptions import UsageError
+
+__all__ = ["MARKS", "SEGMENTS", "DEFAULT_SAMPLE_EVERY", "RequestTrace",
+           "Tracer", "current_trace"]
+
+#: the lifecycle mark order the door core stamps, admission to delivery
+MARKS = ("admit", "enqueue", "coalesce_flush", "dispatch",
+         "device_sync", "deliver")
+
+#: segment name -> (from_mark, to_mark): the latency decomposition.
+#: Adjacent-mark differences telescope, so sum(segments) == deliver -
+#: admit exactly (one subtraction per segment, no double clock reads).
+SEGMENTS = (
+    ("admit_ms", "admit", "enqueue"),          # admission + bookkeeping
+    ("queue_ms", "enqueue", "coalesce_flush"),  # coalescing-window wait
+    ("schedule_ms", "coalesce_flush", "dispatch"),  # drain/quantum hop
+    ("device_ms", "dispatch", "device_sync"),  # batched kernel + sync
+    ("deliver_ms", "device_sync", "deliver"),  # unpack + future resolve
+)
+
+#: basic-mode sampling default: 1-in-N admitted requests carry a full
+#: mark set (``PINT_TPU_TRACE_SAMPLE`` overrides; full mode traces all)
+DEFAULT_SAMPLE_EVERY = 16
+
+#: the active trace of the calling context (read-only convenience —
+#: the door core hands traces through the pending tuple explicitly)
+_current_trace: contextvars.ContextVar[Optional["RequestTrace"]] = \
+    contextvars.ContextVar("pint_tpu_reqtrace", default=None)
+
+
+def current_trace() -> Optional["RequestTrace"]:
+    """The sampled trace of the calling (submit) context, or None."""
+    return _current_trace.get()
+
+
+class RequestTrace:
+    """One sampled request's lifecycle marks.
+
+    Marks are ``(name, t)`` pairs on one monotonic clock; the door
+    core passes a shared clock read to batch-wide marks so every
+    member of a coalesced dispatch agrees on when the dispatch
+    happened (and the accounting identity holds without re-reading
+    the clock per member)."""
+
+    __slots__ = ("trace_id", "klass", "request_id", "marks")
+
+    def __init__(self, trace_id: int, klass: str,
+                 request_id: Optional[str] = None):
+        self.trace_id = int(trace_id)
+        self.klass = klass
+        self.request_id = request_id
+        self.marks: List[Tuple[str, float]] = []
+
+    def mark(self, name: str, t: Optional[float] = None) -> None:
+        """Stamp one lifecycle mark (``t``: a shared clock read for
+        batch-wide marks; None reads the clock here)."""
+        if name not in MARKS:
+            raise UsageError(
+                f"unknown trace mark {name!r}; the lifecycle is {MARKS}")
+        if t is None:
+            import time
+
+            t = time.perf_counter()
+        self.marks.append((name, float(t)))
+
+    def _mark_map(self) -> Dict[str, float]:
+        return dict(self.marks)
+
+    @property
+    def complete(self) -> bool:
+        have = self._mark_map()
+        return all(m in have for m in MARKS)
+
+    def segments_ms(self) -> Dict[str, float]:
+        """The latency decomposition over the stamped marks: segment
+        name -> milliseconds.  Only segments whose BOTH marks exist
+        appear (a shed request stops at admit/enqueue)."""
+        have = self._mark_map()
+        out: Dict[str, float] = {}
+        for seg, a, b in SEGMENTS:
+            if a in have and b in have:
+                out[seg] = 1e3 * (have[b] - have[a])
+        return out
+
+    def total_ms(self) -> Optional[float]:
+        """End-to-end wall (admit -> deliver) in ms, or None while the
+        trace is incomplete.  Equal to ``sum(segments_ms().values())``
+        by construction — the accounting identity."""
+        have = self._mark_map()
+        if "admit" not in have or "deliver" not in have:
+            return None
+        return 1e3 * (have["deliver"] - have["admit"])
+
+    def to_dict(self) -> dict:
+        """The per-member body of the batch ``request_trace`` record."""
+        d = {"trace_id": self.trace_id,
+             "segments": {k: round(v, 6)
+                          for k, v in self.segments_ms().items()}}
+        total = self.total_ms()
+        if total is not None:
+            d["total_ms"] = round(total, 6)
+        if self.request_id is not None:
+            d["request_id"] = str(self.request_id)
+        return d
+
+
+def _sample_every() -> int:
+    raw = os.environ.get("PINT_TPU_TRACE_SAMPLE", "")
+    try:
+        n = int(raw)
+    except ValueError:
+        n = 0
+    return n if n >= 1 else DEFAULT_SAMPLE_EVERY
+
+
+class Tracer:
+    """Per-service trace-id source + sampling decision.
+
+    Every admitted request advances the counter (ids stay deterministic
+    and gap-free per service whatever the mode), but only sampled
+    requests allocate a :class:`RequestTrace`: all of them in ``full``
+    mode, 1-in-``sample_every`` in ``basic``, none when telemetry is
+    off (the off path is one module-attribute compare, no allocation,
+    no clock read — the same contract as :mod:`~pint_tpu.telemetry.
+    spans`)."""
+
+    def __init__(self, sample_every: Optional[int] = None):
+        if sample_every is not None and int(sample_every) < 1:
+            raise UsageError(
+                f"sample_every must be >= 1, got {sample_every}")
+        self.sample_every = int(sample_every) if sample_every is not None \
+            else _sample_every()
+        self._seq = 0
+
+    @property
+    def seq(self) -> int:
+        """Requests admitted so far (the id counter's position)."""
+        return self._seq
+
+    def begin(self, klass: str,
+              request_id: Optional[str] = None) -> Optional[RequestTrace]:
+        """One admitted request: advance the counter and — when this
+        request is sampled — return its :class:`RequestTrace` with the
+        ``admit`` mark stamped and the contextvar set."""
+        if config._telemetry_mode == "off":
+            return None
+        self._seq += 1
+        if config._telemetry_mode != "full" \
+                and self._seq % self.sample_every != 1 \
+                and self.sample_every != 1:
+            return None
+        trace = RequestTrace(self._seq, klass, request_id)
+        trace.mark("admit")
+        _current_trace.set(trace)
+        return trace
+
+
+def batch_record(traces: List[RequestTrace], batch: int) -> dict:
+    """The attrs of the ONE ``request_trace`` event a coalesced
+    dispatch emits: the lead (oldest) member's decomposition as the
+    headline segments, every member's in ``members`` (JSON — the
+    validator parses and re-checks the identity per member)."""
+    import json
+
+    lead = traces[0]
+    segs = lead.segments_ms()
+    attrs = {
+        "request_class": lead.klass,
+        "batch": int(batch),
+        "n_traced": len(traces),
+        "trace_ids": ",".join(str(t.trace_id) for t in traces),
+        "total_ms": round(lead.total_ms() or 0.0, 6),
+        "members": json.dumps([t.to_dict() for t in traces]),
+    }
+    for seg, _, _ in SEGMENTS:
+        attrs[seg] = round(segs.get(seg, 0.0), 6)
+    return attrs

@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from pint_tpu import config
 
-__all__ = ["Span", "span", "event", "set_attr", "current_span",
+__all__ = ["Span", "span", "event", "set_attr", "current_span", "attach",
            "add_span_sink", "remove_span_sink", "finished_roots",
            "clear_finished"]
 
@@ -260,6 +260,33 @@ def set_attr(key: str, value) -> None:
 def current_span() -> Optional[Span]:
     """The innermost open span of this context, or None."""
     return _current.get()
+
+
+def attach(sp: Optional[Span]):
+    """Re-parent the calling context onto a span captured elsewhere.
+
+    ``asyncio.create_task`` snapshots the submitter's contextvars at
+    *task creation*, so a coalescing flush task only ever inherits the
+    span of whichever request opened the batching window — every other
+    batch member's spans lose their door-internal children.  The door
+    core captures ``current_span()`` at submit time and re-attaches it
+    here inside the flush path, making propagation explicit instead of
+    relying on the task's context copy.
+
+    ``attach(None)`` and attach-when-off are shared no-op context
+    managers (nothing to re-parent / the off fast path)."""
+    if sp is None or config._telemetry_mode == "off":
+        return _NULL_CM
+    return _attach_cm(sp)
+
+
+@contextlib.contextmanager
+def _attach_cm(sp: Span):
+    token = _current.set(sp)
+    try:
+        yield sp
+    finally:
+        _current.reset(token)
 
 
 def add_span_sink(sink: Callable[[Span], None]) -> Callable[[Span], None]:

@@ -82,6 +82,8 @@ def test_single_json_line_with_cost(tiny_headline_files, monkeypatch,
     monkeypatch.setenv("BENCH_STREAM_BLOCK", "8")
     monkeypatch.setenv("BENCH_STREAM_APPENDS", "3")
     monkeypatch.setenv("BENCH_STREAM_REFITS", "1")
+    monkeypatch.setenv("BENCH_SLO_TRAIN_STEPS", "4")
+    monkeypatch.setenv("BENCH_SLO_REQUESTS", "12")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     try:
@@ -271,6 +273,8 @@ def test_warm_block_hits_cache_on_second_run(tiny_headline_files,
     monkeypatch.setenv("BENCH_STREAM_BLOCK", "8")
     monkeypatch.setenv("BENCH_STREAM_APPENDS", "3")
     monkeypatch.setenv("BENCH_STREAM_REFITS", "1")
+    monkeypatch.setenv("BENCH_SLO_TRAIN_STEPS", "4")
+    monkeypatch.setenv("BENCH_SLO_REQUESTS", "12")
     monkeypatch.delenv("BENCH_REQUIRE_TPU", raising=False)
     monkeypatch.delenv("PINT_TPU_TELEMETRY", raising=False)
     cache_dir = str(tmp_path / "aot")

@@ -568,6 +568,10 @@ class TestDrills:
         assert rep.errored <= rep.errors_bound
         assert rep.recovery_s is not None
         assert rep.spot_check_rel_err <= chaos.SPOT_CHECK_RTOL
+        # the flight-recorder side of the contract: every drill dumps
+        # at least one postmortem bundle and each bundle validates
+        assert rep.postmortems >= 1
+        assert rep.postmortem_ok
         d = rep.to_dict()
         assert d["scenario"] == scenario and d["contract_ok"] is True
 
@@ -664,13 +668,16 @@ class TestDurabilityEventValidation:
         assert not self._validate(
             tmp_path, _name="chaos_drill", scenario="device_loss",
             offered=32, completed=20, shed=10, errored=2, stranded=0,
-            duration_s=1.1, recovery_s=0.2, contract_ok=True)
+            duration_s=1.1, recovery_s=0.2, contract_ok=True,
+            postmortems=1, postmortem_ok=True)
         errors = self._validate(
             tmp_path, _name="chaos_drill", scenario="device_loss",
             offered=-1, completed=20, shed=10, errored=2, stranded=-2,
-            duration_s=1.1, recovery_s=0.2, contract_ok=False)
+            duration_s=1.1, recovery_s=0.2, contract_ok=False,
+            postmortems=-1, postmortem_ok=False)
         assert any("offered" in e for e in errors)
         assert any("stranded" in e for e in errors)
+        assert any("postmortems" in e for e in errors)
 
     def test_breaker_and_deadline_shed_reasons_accepted(self,
                                                         tmp_path):

@@ -784,6 +784,28 @@ class TestHostCallInJit:
         for pkg in ("serving", "runtime"):
             assert_typed_raise_twins(tmp_path, pkg)
 
+    def test_observatory_modules_are_clean_targets(self):
+        """The request-lifecycle-observatory modules (reqtrace /
+        flightrec in telemetry, slo in serving) are auto-tracked by the
+        package view and lint clean under the full default rule set —
+        a trace mark or a ring note inside a traced function would run
+        per TRACE like any other host call."""
+        from tools.jaxlint.engine import (
+            _SERVING_SUBMODULES,
+            _TELEMETRY_SUBMODULES,
+        )
+
+        assert "slo" in _SERVING_SUBMODULES
+        assert "reqtrace" in _TELEMETRY_SUBMODULES
+        assert "flightrec" in _TELEMETRY_SUBMODULES
+        eng = Engine(rules=default_rules(), repo=REPO)
+        for rel in ("pint_tpu/telemetry/reqtrace.py",
+                    "pint_tpu/telemetry/flightrec.py",
+                    "pint_tpu/serving/slo.py"):
+            res = eng.run([os.path.join(REPO, rel)])
+            assert res.findings == [], "\n".join(
+                f.render() for f in res.findings)
+
     def test_static_shape_coercions_not_flagged(self, tmp_path):
         src = (
             "import jax\n"

@@ -903,6 +903,118 @@ class TestStreamingSeries:
         assert "streaming: 189.3 updates/s" in capsys.readouterr().out
 
 
+def _slo(overhead=0.01, fitc=1.0, postc=1.0, burn=0.0, pm=1,
+         error=None):
+    block = {"untraced_fits_per_s": 1800.0,
+             "traced_fits_per_s": 1800.0 * (1.0 - overhead),
+             "trace_overhead_frac": overhead,
+             "fit_compliance": fitc, "posterior_compliance": postc,
+             "worst_burn_rate": burn, "postmortems_emitted": pm,
+             "steady_state_compiles": 0}
+    if error is not None:
+        block = {"untraced_fits_per_s": None, "traced_fits_per_s": None,
+                 "trace_overhead_frac": None, "fit_compliance": None,
+                 "posterior_compliance": None, "worst_burn_rate": None,
+                 "postmortems_emitted": None,
+                 "steady_state_compiles": None, "error": error}
+    return {"slo": block}
+
+
+class TestSLOSeries:
+    """The bench's slo{} block (round 20+): the tracer's throughput
+    tax gates rises (zero-baseline opt-in), per-class deadline
+    compliance gates drops, and an errored block after measured
+    rounds fails."""
+
+    def test_slo_block_ingested(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 20, 100.0,
+                    extra=_slo(overhead=0.012, fitc=0.99, postc=0.97,
+                               burn=0.4, pm=2))
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.slo_trace_overhead_frac == 0.012
+        assert r.slo_fit_compliance == 0.99
+        assert r.slo_posterior_compliance == 0.97
+        assert r.slo_worst_burn_rate == 0.4
+        assert r.slo_postmortems == 2
+        assert r.slo_steady_compiles == 0
+        doc = build_history([r])
+        assert doc["runs"][0]["slo_trace_overhead_frac"] == 0.012
+
+    def test_overhead_rise_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i, v in enumerate([0.010, 0.012, 0.011], start=1):
+            _bench(d, i, 100.0, extra=_slo(overhead=v))
+        _bench(d, 4, 100.0, extra=_slo(overhead=0.25))  # >20x the tax
+        assert main(["--check", "--dir", d]) == 1
+        assert "slo_trace_overhead_frac" in capsys.readouterr().out
+
+    def test_overhead_from_zero_baseline_fails(self, tmp_path, capsys):
+        # a free-tracing history (0.0) must gate the FIRST nonzero tax
+        # — the zero-baseline opt-in, same contract as load_shed_rate
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_slo(overhead=0.0))
+        _bench(d, 4, 100.0, extra=_slo(overhead=0.08))
+        assert main(["--check", "--dir", d]) == 1
+        assert "slo_trace_overhead_frac" in capsys.readouterr().out
+
+    def test_compliance_drop_fails(self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            _bench(d, i, 100.0, extra=_slo(fitc=1.0))
+        # an all-compliant history has zero MAD scatter: a 40% miss
+        # past the base threshold is the deadline contract breaking
+        _bench(d, 4, 100.0, extra=_slo(fitc=0.6))
+        assert main(["--check", "--dir", d]) == 1
+        assert "slo_fit_compliance" in capsys.readouterr().out
+
+    def test_small_slo_changes_pass(self, tmp_path):
+        d = str(tmp_path)
+        for i, (v, c) in enumerate([(0.010, 1.0), (0.013, 0.99),
+                                    (0.011, 1.0)], start=1):
+            _bench(d, i, 100.0, extra=_slo(overhead=v, fitc=c))
+        _bench(d, 4, 100.0, extra=_slo(overhead=0.012, fitc=0.98))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_errored_slo_block_fails_when_history_had_it(
+            self, tmp_path, capsys):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0, extra=_slo())
+        _bench(d, 3, 100.0, extra=_slo(error="RuntimeError: broken"))
+        assert main(["--check", "--dir", d]) == 1
+        assert "slo block degraded" in capsys.readouterr().out
+
+    def test_errored_slo_block_clean_without_history(self, tmp_path):
+        d = str(tmp_path)
+        for i in (1, 2):
+            _bench(d, i, 100.0)
+        _bench(d, 3, 100.0, extra=_slo(error="RuntimeError: broken"))
+        assert main(["--check", "--dir", d]) == 0
+
+    def test_malformed_slo_types_ignored(self, tmp_path):
+        errors = []
+        fn = _bench(str(tmp_path), 20, 100.0,
+                    extra={"slo": {"trace_overhead_frac": "cheap",
+                                   "fit_compliance": True,
+                                   "postmortems_emitted": "1",
+                                   "steady_state_compiles": None}})
+        r = ingest_file(fn, errors)
+        assert not errors
+        assert r.slo_trace_overhead_frac is None
+        assert r.slo_fit_compliance is None
+        assert r.slo_postmortems is None
+        assert r.slo_steady_compiles is None
+
+    def test_slo_line_rendered_in_report(self, tmp_path, capsys):
+        d = str(tmp_path)
+        _bench(d, 1, 100.0, extra=_slo(overhead=0.012, fitc=0.99))
+        assert main(["--dir", d]) == 0
+        assert "slo: trace_overhead=0.012" in capsys.readouterr().out
+
+
 def _precision(mixed=50.0, f64=50.0, rel=0.0, reduced=0, error=None):
     block = {"segments": {"serve.gram": "f64"}, "reduced_count": reduced,
              "f64_count": 6 - reduced, "mixed_fits_per_s": mixed,

@@ -1,0 +1,677 @@
+"""Request-lifecycle observatory tests: trace marks and the segment
+accounting identity (on a fake clock — no wall-time flakiness), trace-id
+determinism and sampling, the SLO tracker's window/burn-rate state
+machine under an injected clock, flight-recorder ring bounds and
+postmortem bundle validation, the servewatch stdlib twin pinned against
+the in-package validator on the committed fixtures, and the end-to-end
+acceptance pin: a warm service in full telemetry yields ``request_trace``
+records whose segments sum to the end-to-end latency, steady-state
+serving compiles nothing, and a device-loss drill dumps a postmortem
+bundle every validator accepts.
+"""
+
+import asyncio
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu import config as _config
+from pint_tpu import telemetry
+from pint_tpu.exceptions import UsageError
+from pint_tpu.serving import service
+from pint_tpu.serving.admission import REQUEST_CLASSES, BreakerConfig
+from pint_tpu.serving.service import FitRequest
+from pint_tpu.serving.slo import SLO_STATES, SLOConfig, SLOTracker
+from pint_tpu.telemetry import flightrec, reqtrace, runlog, spans
+from pint_tpu.telemetry.flightrec import FlightRecorder
+from pint_tpu.telemetry.reqtrace import (
+    MARKS,
+    SEGMENTS,
+    RequestTrace,
+    Tracer,
+    batch_record,
+    current_trace,
+)
+
+pytestmark = pytest.mark.reqtrace
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "servewatch")
+
+
+@pytest.fixture
+def basic_mode():
+    telemetry.activate("basic")
+    try:
+        yield
+    finally:
+        telemetry.deactivate()
+
+
+@pytest.fixture
+def full_mode():
+    telemetry.activate("full")
+    try:
+        yield
+    finally:
+        telemetry.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# the accounting identity (fake clock)
+# ---------------------------------------------------------------------------
+
+class TestAccountingIdentity:
+    #: power-of-two mark times: every difference and the x1000 scaling
+    #: are exact in binary floating point, so the identity is EXACT
+    FAKE_MARKS = ((1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+
+    def _traced(self, times=FAKE_MARKS):
+        tr = RequestTrace(7, "fit", request_id="r-7")
+        for name, t in zip(MARKS, times):
+            tr.mark(name, t)
+        return tr
+
+    def test_segments_telescope_to_total_exactly(self):
+        tr = self._traced()
+        segs = tr.segments_ms()
+        assert set(segs) == {s for s, _, _ in SEGMENTS}
+        assert segs["admit_ms"] == 1000.0
+        assert segs["queue_ms"] == 2000.0
+        assert segs["device_ms"] == 8000.0
+        assert tr.complete
+        # the identity, exact — no tolerance
+        assert sum(segs.values()) == tr.total_ms() == 31000.0
+
+    def test_identity_holds_on_messy_clock_reads(self):
+        # perf_counter-like irrational offsets: the telescoping sum
+        # cancels to admit -> deliver within float rounding
+        base = 98765.123456789
+        times = [base + 0.001 * i * np.pi for i in range(len(MARKS))]
+        tr = self._traced(times)
+        assert abs(sum(tr.segments_ms().values()) - tr.total_ms()) < 1e-6
+
+    def test_partial_trace_stops_at_stamped_marks(self):
+        tr = RequestTrace(3, "posterior")
+        tr.mark("admit", 1.0)
+        tr.mark("enqueue", 2.0)
+        assert not tr.complete
+        assert tr.segments_ms() == {"admit_ms": 1000.0}
+        assert tr.total_ms() is None
+        d = tr.to_dict()
+        assert "total_ms" not in d and d["trace_id"] == 3
+
+    def test_unknown_mark_typed(self):
+        tr = RequestTrace(1, "fit")
+        with pytest.raises(UsageError):
+            tr.mark("teleport", 1.0)
+
+    def test_batch_record_links_members(self):
+        a = self._traced()
+        b = RequestTrace(9, "fit")
+        for name, t in zip(MARKS, (1.5, 2.0, 4.0, 8.0, 16.0, 32.0)):
+            b.mark(name, t)
+        rec = batch_record([a, b], batch=4)
+        assert rec["request_class"] == "fit"
+        assert rec["batch"] == 4 and rec["n_traced"] == 2
+        assert rec["trace_ids"] == "7,9"
+        # headline segments are the lead member's
+        assert rec["admit_ms"] == 1000.0
+        members = json.loads(rec["members"])
+        assert [m["trace_id"] for m in members] == [7, 9]
+        for m in members:
+            assert abs(sum(m["segments"].values()) - m["total_ms"]) < 1e-3
+        assert members[0]["request_id"] == "r-7"
+
+
+# ---------------------------------------------------------------------------
+# trace-id allocation + sampling
+# ---------------------------------------------------------------------------
+
+class TestTracer:
+    def test_off_mode_allocates_nothing(self):
+        assert _config.telemetry_mode() == "off"
+        tr = Tracer(sample_every=1)
+        assert tr.begin("fit") is None
+        assert tr.seq == 0  # the counter does not even advance
+
+    def test_basic_mode_samples_one_in_n(self, basic_mode):
+        tr = Tracer(sample_every=3)
+        got = [tr.begin("fit") for _ in range(9)]
+        sampled = [i + 1 for i, t in enumerate(got) if t is not None]
+        assert sampled == [1, 4, 7]  # seq % 3 == 1
+        assert [t.trace_id for t in got if t is not None] == [1, 4, 7]
+        assert tr.seq == 9
+
+    def test_sample_every_one_traces_all(self, basic_mode):
+        tr = Tracer(sample_every=1)
+        got = [tr.begin("fit") for _ in range(4)]
+        assert all(t is not None for t in got)
+        assert [t.trace_id for t in got] == [1, 2, 3, 4]
+
+    def test_full_mode_ignores_sampling(self, full_mode):
+        tr = Tracer(sample_every=1000)
+        assert all(tr.begin("fit") is not None for _ in range(5))
+
+    def test_ids_deterministic_across_tracers(self, basic_mode):
+        a, b = Tracer(sample_every=4), Tracer(sample_every=4)
+        ids_a = [t.trace_id for t in (a.begin("fit") for _ in range(12))
+                 if t is not None]
+        ids_b = [t.trace_id for t in (b.begin("fit") for _ in range(12))
+                 if t is not None]
+        assert ids_a == ids_b == [1, 5, 9]
+
+    def test_begin_stamps_admit_and_contextvar(self, basic_mode):
+        tr = Tracer(sample_every=1)
+        t = tr.begin("posterior", request_id="rq")
+        assert t.marks[0][0] == "admit"
+        assert current_trace() is t
+        assert t.request_id == "rq"
+
+    def test_sample_every_validated(self):
+        with pytest.raises(UsageError):
+            Tracer(sample_every=0)
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("PINT_TPU_TRACE_SAMPLE", "5")
+        assert Tracer().sample_every == 5
+        monkeypatch.setenv("PINT_TPU_TRACE_SAMPLE", "not-a-number")
+        assert Tracer().sample_every == reqtrace.DEFAULT_SAMPLE_EVERY
+
+
+# ---------------------------------------------------------------------------
+# span re-attachment across the flush-task hop (the trace-context fix)
+# ---------------------------------------------------------------------------
+
+class TestSpanAttach:
+    def test_attach_reparents_dispatch_span(self, basic_mode):
+        """The regression the door core fixes: the flush task's context
+        is a copy of whichever request opened the window, so a batch
+        member's dispatch span must be re-parented explicitly."""
+        with spans.span("request_a") as sp_a:
+            with spans.span("request_b") as sp_b:
+                captured = spans.current_span()
+                assert captured is sp_b
+            # back in request_a's context — the state a flush task
+            # created from the window-opener sees
+            with spans.attach(captured):
+                assert spans.current_span() is sp_b
+                with spans.span("fit.dispatch") as sp_d:
+                    pass
+            assert spans.current_span() is sp_a
+        assert sp_d in sp_b.children
+        assert sp_d not in sp_a.children
+
+    def test_attach_none_and_off_are_noops(self, basic_mode):
+        with spans.span("root") as sp:
+            with spans.attach(None):
+                assert spans.current_span() is sp
+        telemetry.deactivate()
+        with spans.attach(sp):
+            assert spans.current_span() is None
+
+
+# ---------------------------------------------------------------------------
+# SLO windows, burn rates, and the alert state machine (injected clock)
+# ---------------------------------------------------------------------------
+
+def _tracker(now, target=0.99, fast=10.0, slow=100.0, on_status=None,
+             deadlines=None):
+    cfg = SLOConfig(target=target, fast_window_s=fast, slow_window_s=slow,
+                    deadlines_ms=deadlines or {"fit": 100.0})
+    return SLOTracker(cfg, clock=lambda: now[0], on_status=on_status)
+
+
+class TestSLOTracker:
+    def test_goodput_against_deadline_budget(self):
+        now = [0.0]
+        t = _tracker(now)
+        t.record("fit", 50.0)   # within the 100 ms budget
+        t.record("fit", 500.0)  # blown
+        slis = t.class_slis("fit")
+        assert slis["requests_fast"] == 2
+        assert slis["goodput_fast"] == 0.5
+        assert slis["burn_fast"] == pytest.approx(50.0)  # 0.5 / 0.01
+
+    def test_no_deadline_class_is_always_good(self):
+        now = [0.0]
+        t = _tracker(now, deadlines={"fit": 100.0})
+        t.record("posterior", 1e9)  # no budget configured -> good
+        assert t.class_slis("posterior")["goodput_fast"] == 1.0
+
+    def test_empty_window_burns_nothing(self):
+        now = [0.0]
+        t = _tracker(now)
+        assert t.class_slis("fit")["burn_fast"] == 0.0
+        assert t.evaluate("fit") == "ok"
+
+    def test_window_decay(self):
+        now = [0.0]
+        t = _tracker(now)
+        t.record("fit", 1e6)  # bad, at t=0
+        assert t.class_slis("fit")["burn_fast"] == pytest.approx(100.0)
+        now[0] = 1000.0  # past both windows
+        slis = t.class_slis("fit")
+        assert slis["requests_fast"] == 0 and slis["requests_slow"] == 0
+        assert slis["burn_fast"] == 0.0
+
+    def test_sheds_burn_budget_but_not_compliance(self):
+        now = [0.0]
+        t = _tracker(now)
+        t.record("fit", 10.0)
+        t.record_shed("fit")
+        slis = t.class_slis("fit")
+        assert slis["goodput_fast"] == 0.5
+        assert slis["shed_rate_fast"] == 0.5
+        # compliance is over DELIVERED requests only
+        assert slis["compliance_fast"] == 1.0
+
+    def test_transition_ladder_and_status_events(self):
+        now = [0.0]
+        events = []
+        t = _tracker(now, on_status=lambda k, s, a: events.append((k, s, a)))
+        # 9 good + 1 bad: burn 10 — past warn (2), short of page (14.4)
+        for _ in range(9):
+            t.record("fit", 10.0)
+        t.record("fit", 1e6)
+        assert t.evaluate("fit") == "warn"
+        # all bad now: burn 100 on BOTH windows -> page
+        for _ in range(30):
+            t.record("fit", 1e6)
+        assert t.evaluate("fit") == "page"
+        # budget stops burning once the windows age out -> back to ok
+        now[0] = 1000.0
+        assert t.evaluate("fit") == "ok"
+        assert [s for _, s, _ in events] == ["warn", "page", "ok"]
+        assert all(k == "fit" for k, _, _ in events)
+        assert events[1][2]["previous"] == "warn"
+        assert events[2][2]["previous"] == "page"
+        assert t.transitions == 3
+        # steady state emits nothing further
+        assert t.evaluate("fit") == "ok" and len(events) == 3
+
+    def test_slow_window_filters_blips(self):
+        """The SRE multi-window point: a fast-window cliff over a long
+        healthy history warns instead of paging."""
+        now = [0.0]
+        t = _tracker(now)
+        for _ in range(19):
+            t.record("fit", 10.0)  # healthy history at t=0
+        now[0] = 95.0  # fast window (10 s) left them behind; slow didn't
+        t.record("fit", 1e6)  # one bad blip
+        slis = t.class_slis("fit")
+        assert slis["burn_fast"] == pytest.approx(100.0)
+        assert slis["burn_slow"] == pytest.approx(5.0)  # 1/20 / 0.01
+        assert t.evaluate("fit") == "warn"  # page needs slow burn >= 6
+
+    def test_worst_burn_and_snapshot(self):
+        now = [0.0]
+        t = _tracker(now)
+        t.record("fit", 1e6)
+        assert t.worst_burn() == pytest.approx(100.0)
+        snap = t.snapshot()
+        assert snap["worst_burn"] == pytest.approx(100.0)
+        assert set(snap["classes"]) == set(REQUEST_CLASSES)
+        assert snap["classes"]["fit"]["state"] in SLO_STATES
+        assert snap["target"] == 0.99
+
+    def test_config_validated(self):
+        with pytest.raises(UsageError):
+            SLOConfig(target=1.0)
+        with pytest.raises(UsageError):
+            SLOConfig(fast_window_s=60.0, slow_window_s=10.0)
+        with pytest.raises(UsageError):
+            SLOConfig(deadlines_ms={"teleport": 1.0})
+
+    def test_observe_burn_is_one_sided(self):
+        """A hot burn escalates; a cool burn must NEVER feed
+        observe(False) — admission may still be shedding."""
+        from pint_tpu.serving.scheduler import PressureEscalator
+
+        esc = PressureEscalator(sustain=3)
+        calls = []
+        esc.observe = lambda shedding: calls.append(shedding)
+        esc.observe_burn(0.0)
+        esc.observe_burn(1.9)
+        assert calls == []
+        esc.observe_burn(14.4)
+        assert calls == [True]
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder rings + postmortem bundles
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_entry_bound_holds_under_storm(self):
+        fr = FlightRecorder(max_entries=8, max_bytes=1 << 20,
+                            clock=lambda: 0.0)
+        for i in range(100):
+            fr.note("fit", "enqueue", depth=i)
+        assert fr.ring_len("fit") == 8
+        assert fr.dropped == 92
+
+    def test_byte_bound_holds_under_storm(self):
+        fr = FlightRecorder(max_entries=512, max_bytes=2048,
+                            clock=lambda: 0.0)
+        for i in range(50):
+            fr.note("update", "journal", payload="x" * 200)
+        assert fr.ring_bytes("update") <= 2048
+        assert fr.ring_len("update") < 50
+        assert fr.dropped > 0
+
+    def test_oversize_entry_cannot_wedge_the_ring(self):
+        fr = FlightRecorder(max_entries=8, max_bytes=1024,
+                            clock=lambda: 0.0)
+        fr.note("fit", "shed", blob="y" * 4096)  # alone over the bound
+        assert fr.ring_bytes("fit") == 0
+        fr.note("fit", "shed", reason="ok")  # the ring still works
+        assert fr.ring_len("fit") == 1
+
+    def test_unserializable_payload_degrades(self):
+        fr = FlightRecorder(clock=lambda: 0.0)
+        cyclic = []
+        cyclic.append(cyclic)  # json.dumps raises even with default=str
+        fr.note("fit", "deliver", weird=cyclic)
+        bundle = fr.dump("unserializable-note rehearsal")
+        entry = bundle["rings"]["fit"][0]
+        assert entry["unserializable"] is True
+        assert flightrec.validate_bundle(bundle) == []
+
+    def test_unknown_kind_and_bounds_typed(self):
+        fr = FlightRecorder()
+        with pytest.raises(UsageError):
+            fr.note("fit", "teleport")
+        with pytest.raises(UsageError):
+            FlightRecorder(max_entries=0)
+        with pytest.raises(UsageError):
+            FlightRecorder(max_bytes=10)
+        with pytest.raises(UsageError):
+            fr.dump("   ")
+
+    def test_dump_retention_is_bounded(self):
+        fr = FlightRecorder(clock=lambda: 0.0)
+        fr.note("fit", "dispatch", batch=2)
+        for i in range(10):
+            fr.dump(f"rehearsal {i}")
+        assert fr.dumps == 10
+        assert len(fr.bundles) == 8  # newest-last retention cap
+        assert fr.bundles[-1]["trigger"] == "rehearsal 9"
+
+    def test_dump_validates_and_carries_panels(self):
+        fr = FlightRecorder(clock=lambda: 42.0)
+        fr.note("fit", "dispatch_error", error="FakeDeviceLoss", batch=3)
+        bundle = fr.dump("drill: device_loss",
+                         breakers={"fit": "open"},
+                         slo={"worst_burn": 9.0},
+                         queue_depths={"fit": 4})
+        assert flightrec.validate_bundle(bundle) == []
+        assert bundle["breakers"] == {"fit": "open"}
+        assert bundle["queue_depths"] == {"fit": 4}
+        assert bundle["rings"]["fit"][0]["kind"] == "dispatch_error"
+
+    @pytest.mark.parametrize("mutate, hint", [
+        (lambda d: d.pop("schema"), "schema"),
+        (lambda d: d.update(schema="bogus/9"), "schema"),
+        (lambda d: d.update(trigger="   "), "trigger"),
+        (lambda d: d.update(rings=[1, 2]), "rings"),
+        (lambda d: d["rings"].__setitem__(
+            "fit", [{"kind": "teleport", "t": 1.0}]), "kind"),
+        (lambda d: d["rings"].__setitem__("fit", [{"kind": "shed"}]), "'t'"),
+        (lambda d: d.update(ring_bytes={"fit": -5}), "ring_bytes"),
+        (lambda d: d.update(breakers=3), "breakers"),
+        (lambda d: d.update(t=-1.0), "t must"),
+        (lambda d: d.update(manifest_ref=7), "manifest_ref"),
+    ])
+    def test_validator_rejects_degraded_bundles(self, mutate, hint):
+        fr = FlightRecorder(clock=lambda: 0.0)
+        fr.note("fit", "shed", reason="r")
+        base = fr.dump("degradation rehearsal")
+        doc = copy.deepcopy(base)
+        mutate(doc)
+        errors = flightrec.validate_bundle(doc)
+        assert errors and any(hint in e for e in errors)
+
+    def test_non_dict_rejected(self):
+        assert flightrec.validate_bundle([1, 2])  # type: ignore[arg-type]
+
+
+# ---------------------------------------------------------------------------
+# the servewatch stdlib twin — lockstep with the in-package validator
+# ---------------------------------------------------------------------------
+
+class TestServewatchTwin:
+    def _fixture_bundle(self):
+        with open(os.path.join(FIXTURE_DIR, "postmortem.json")) as f:
+            return json.load(f)
+
+    def test_committed_fixture_passes_both(self):
+        from tools import servewatch
+
+        doc = self._fixture_bundle()
+        assert flightrec.validate_bundle(doc) == []
+        assert servewatch.validate_bundle(doc) == []
+
+    def test_twins_agree_on_degraded_bundles(self):
+        from tools import servewatch
+
+        base = self._fixture_bundle()
+        mutations = [
+            lambda d: d.pop("schema"),
+            lambda d: d.update(trigger=""),
+            lambda d: d.update(rings="not-a-dict"),
+            lambda d: d.update(ring_bytes={"fit": "NaN"}),
+            lambda d: d.update(breakers=None),
+            lambda d: d.update(t=True),
+        ]
+        for mutate in mutations:
+            doc = copy.deepcopy(base)
+            mutate(doc)
+            ours = flightrec.validate_bundle(doc)
+            theirs = servewatch.validate_bundle(doc)
+            assert ours and theirs
+            assert len(ours) == len(theirs)  # lockstep, not just non-empty
+
+    def test_twin_constants_in_lockstep(self):
+        from tools import servewatch
+
+        assert servewatch.POSTMORTEM_SCHEMA == flightrec.POSTMORTEM_SCHEMA
+        assert tuple(servewatch.ENTRY_KINDS) == tuple(flightrec.ENTRY_KINDS)
+        assert tuple(servewatch._REQUEST_CLASSES) == tuple(REQUEST_CLASSES)
+        assert tuple(servewatch._SLO_STATES) == tuple(SLO_STATES)
+        assert tuple(servewatch._SEGMENTS) == tuple(
+            s for s, _, _ in SEGMENTS)
+        assert servewatch.EVENT_SCHEMA == runlog.EVENT_SCHEMA
+
+    def test_committed_event_stream_validates(self):
+        from tools import servewatch
+
+        errors = []
+        servewatch.validate_events_file(
+            os.path.join(FIXTURE_DIR, "events.jsonl"), errors)
+        assert errors == []
+
+    def test_check_mode_over_fixture_dir(self, capsys):
+        from tools import servewatch
+
+        assert servewatch.main(["--check", FIXTURE_DIR]) == 0
+        out = capsys.readouterr().out
+        assert "servewatch-check: OK" in out
+
+    def test_check_mode_flags_corruption(self, tmp_path, capsys):
+        from tools import servewatch
+
+        doc = self._fixture_bundle()
+        doc["trigger"] = ""
+        bad = tmp_path / "postmortem-bad.json"
+        bad.write_text(json.dumps(doc))
+        assert servewatch.main(["--check", str(bad)]) == 1
+        assert "FAIL" in capsys.readouterr().err
+
+    def test_render_mode_summarizes(self, capsys):
+        from tools import servewatch
+
+        assert servewatch.main([os.path.join(FIXTURE_DIR,
+                                             "postmortem.json")]) == 0
+        out = capsys.readouterr().out
+        assert "postmortem" in out
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the acceptance pin
+# ---------------------------------------------------------------------------
+
+def _fit_request(rng, n=48, k=6, request_id=None):
+    M = rng.standard_normal((n, k))
+    r = 1e-6 * rng.standard_normal(n)
+    w = 1.0 / (1e-12 + 1e-13 * rng.random(n))
+    return FitRequest(M=M, r=r, w=w, phiinv=np.zeros(k),
+                      request_id=request_id)
+
+
+def _submit_all(svc, requests):
+    async def go():
+        return await asyncio.gather(*[svc.submit(q) for q in requests])
+
+    return asyncio.run(go())
+
+
+class TestEndToEnd:
+    def _service(self, **over):
+        cfg = dict(ntoa_buckets=(64,), nfree_buckets=(8,),
+                   batch_buckets=(1, 8), draw_buckets=(32,),
+                   window_ms=1.0, max_queue=256, trace_sample=1,
+                   breaker=BreakerConfig(failures=2, reset_s=0.2))
+        cfg.update(over)
+        return service.TimingService(service.ServeConfig(**cfg))
+
+    def test_full_telemetry_accounting_identity_pin(self, tmp_path):
+        """The PR's e2e pin: a warm service in full telemetry emits
+        request_trace records whose segments sum to the end-to-end
+        latency per member, steady-state serving compiles nothing, and
+        a device-loss drill dumps a postmortem bundle that the
+        flight-recorder validator, the servewatch stdlib twin, AND
+        telemetry_report --check all accept."""
+        from pint_tpu.runtime import chaos
+        from pint_tpu.telemetry import jaxevents
+        from tools import servewatch
+        from tools.telemetry_report import validate_postmortem_file
+
+        rng = np.random.default_rng(2026)
+        run_dir = str(tmp_path / "run")
+        telemetry.activate("full")
+        try:
+            runlog.start_run(run_dir, name="reqtrace-e2e",
+                             probe_device=False)
+            svc = self._service()
+            # warm both batch rungs through the sync bypass so the
+            # async passes below are pure steady state
+            svc.serve([_fit_request(rng)])
+            svc.serve([_fit_request(rng) for _ in range(8)])
+
+            before = jaxevents.counts()
+            results = _submit_all(
+                svc, [_fit_request(rng, request_id=f"e2e-{i}")
+                      for i in range(6)])
+            steady = jaxevents.counts() - before
+            assert int(steady.compiles) == 0, \
+                "steady-state traced serving must not compile"
+            assert all(not hasattr(res, "reason") for res in results)
+
+            # the drill injects device loss, trips the breaker, and the
+            # recorder dumps at the moment of failure
+            rep = chaos.run_drill(svc, "device_loss", rps=300.0,
+                                  n_requests=16, times=2, delay_s=0.02,
+                                  seed=5, recovery_timeout_s=15.0)
+            assert rep.contract_ok, rep.violations
+            assert rep.postmortems >= 1
+            assert rep.postmortem_ok
+            runlog.end_run()
+        finally:
+            telemetry.deactivate()
+
+        # -- request_trace records: per-member accounting identity ----
+        events = []
+        with open(os.path.join(run_dir, "events.jsonl")) as f:
+            for line in f:
+                doc = json.loads(line)
+                if doc.get("type") == "event" and \
+                        doc["event"]["name"] == "request_trace":
+                    events.append(doc["event"]["attrs"])
+        assert events, "full-mode serving must emit request_trace"
+        seen_ids = []
+        for attrs in events:
+            assert attrs["request_class"] in REQUEST_CLASSES
+            members = json.loads(attrs["members"])
+            assert len(members) == attrs["n_traced"]
+            for m in members:
+                segs = m["segments"]
+                assert set(segs) == {s for s, _, _ in SEGMENTS}, \
+                    "a delivered member must carry the full decomposition"
+                assert abs(sum(segs.values()) - m["total_ms"]) <= 1e-3
+                assert m["total_ms"] > 0.0
+                seen_ids.append(m["trace_id"])
+        # trace ids are unique across the run (one counter per service)
+        assert len(seen_ids) == len(set(seen_ids))
+
+        # -- the postmortem bundle, validated three independent ways --
+        bundle = svc.flight_recorder.bundles[-1]
+        assert flightrec.validate_bundle(bundle) == []
+        assert servewatch.validate_bundle(bundle) == []
+        pm_dir = os.path.join(run_dir, "postmortem")
+        persisted = sorted(os.listdir(pm_dir))
+        assert persisted, "full mode must persist postmortem bundles"
+        for name in persisted:
+            errors = []
+            validate_postmortem_file(os.path.join(pm_dir, name), errors)
+            assert errors == []
+        # and the black-box reader validates the WHOLE run directory
+        assert servewatch.main(["--check", run_dir]) == 0
+
+    def test_sampled_tracing_and_health_panel(self):
+        """Basic mode: 1-in-N sampling still yields valid traces, the
+        health() panel carries the SLO observatory, and the breaker
+        transition dumps a postmortem."""
+        from pint_tpu.runtime.chaos import door_fault
+
+        rng = np.random.default_rng(7)
+        telemetry.activate("basic")
+        try:
+            svc = self._service(trace_sample=2)
+            svc.serve([_fit_request(rng) for _ in range(8)])
+            _submit_all(svc, [_fit_request(rng) for _ in range(6)])
+            assert svc.tracer.seq >= 6
+
+            health = svc.health()
+            slo = health["slo"]
+            assert set(slo["classes"]) == set(REQUEST_CLASSES)
+            assert slo["classes"]["fit"]["requests_fast"] >= 1
+            assert slo["classes"]["fit"]["state"] in SLO_STATES
+            assert health["flight_recorder"]["dumps"] == 0
+
+            dumps_before = svc.flight_recorder.dumps
+            with door_fault(svc, "raise", times=3):
+                for _ in range(3):
+                    try:
+                        _submit_all(svc, [_fit_request(rng)])
+                    except Exception:
+                        pass
+            assert svc.flight_recorder.dumps > dumps_before
+            assert flightrec.validate_bundle(
+                svc.flight_recorder.bundles[-1]) == []
+        finally:
+            telemetry.deactivate()
+
+    def test_off_mode_serves_untraced(self):
+        """Telemetry off: the doors still serve, no traces allocate,
+        and no request_trace machinery runs."""
+        rng = np.random.default_rng(11)
+        assert _config.telemetry_mode() == "off"
+        svc = self._service()
+        svc.serve([_fit_request(rng) for _ in range(4)])
+        results = _submit_all(svc, [_fit_request(rng) for _ in range(4)])
+        assert len(results) == 4
+        assert svc.tracer.seq == 0

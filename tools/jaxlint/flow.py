@@ -53,6 +53,10 @@ NO_RAISE_METHODS = frozenset({
     # InvalidStateError, but every call site guards with .done())
     "set_result", "set_exception", "cancel", "cancelled", "done",
     "record_success", "record_failure",
+    # request-trace / flight-recorder lifecycle: mark()/note() raise
+    # only on a mark/kind name outside their closed enums, and every
+    # call site passes a literal member
+    "mark", "note",
     # clocks and logging
     "perf_counter", "monotonic", "time", "process_time",
     "debug", "info", "warning", "error", "exception",

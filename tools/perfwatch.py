@@ -139,6 +139,13 @@ class RunRecord:
     load_fairness: Optional[float] = None
     load_steady_compiles: Optional[int] = None
     load_error: Optional[str] = None           #: degraded load block
+    slo_trace_overhead_frac: Optional[float] = None
+    slo_fit_compliance: Optional[float] = None
+    slo_posterior_compliance: Optional[float] = None
+    slo_worst_burn_rate: Optional[float] = None
+    slo_postmortems: Optional[int] = None
+    slo_steady_compiles: Optional[int] = None
+    slo_error: Optional[str] = None            #: degraded slo block
     #: from the recovery{...} block (round 17+: durability / chaos)
     recovery_time_to_recover_s: Optional[float] = None
     recovery_replay_ops_per_s: Optional[float] = None
@@ -354,6 +361,25 @@ def _apply_headline(rec: RunRecord, h: dict) -> None:
             rec.load_steady_compiles = load["steady_state_compiles"]
         if isinstance(load.get("error"), str) and load["error"]:
             rec.load_error = load["error"]
+    slo = h.get("slo")
+    if isinstance(slo, dict):
+        for src, dst in (("trace_overhead_frac",
+                          "slo_trace_overhead_frac"),
+                         ("fit_compliance", "slo_fit_compliance"),
+                         ("posterior_compliance",
+                          "slo_posterior_compliance"),
+                         ("worst_burn_rate", "slo_worst_burn_rate")):
+            if isinstance(slo.get(src), (int, float)) \
+                    and not isinstance(slo.get(src), bool):
+                setattr(rec, dst, float(slo[src]))
+        for src, dst in (("postmortems_emitted", "slo_postmortems"),
+                         ("steady_state_compiles",
+                          "slo_steady_compiles")):
+            if isinstance(slo.get(src), int) \
+                    and not isinstance(slo.get(src), bool):
+                setattr(rec, dst, slo[src])
+        if isinstance(slo.get("error"), str) and slo["error"]:
+            rec.slo_error = slo["error"]
     recovery = h.get("recovery")
     if isinstance(recovery, dict):
         for src, dst in (("time_to_recover_s",
@@ -644,6 +670,19 @@ def check_series(runs: List[RunRecord], threshold: float,
                    True),
                   ("load_fairness", lambda r: r.load_fairness, +1,
                    False),
+                  # request-lifecycle observatory (round 20+): the
+                  # tracer's throughput tax gates rises WITH the
+                  # zero-baseline opt-in (a free-tracing history must
+                  # gate the first nonzero tax), and per-class
+                  # deadline compliance gates drops (an all-compliant
+                  # history has zero MAD scatter, so any miss past the
+                  # base threshold fails)
+                  ("slo_trace_overhead_frac",
+                   lambda r: r.slo_trace_overhead_frac, -1, True),
+                  ("slo_fit_compliance",
+                   lambda r: r.slo_fit_compliance, +1, False),
+                  ("slo_posterior_compliance",
+                   lambda r: r.slo_posterior_compliance, +1, False),
                   # durability (round 17+): crash-recovery wall time
                   # and the drill's tail latency gate rises, replay
                   # throughput and completions-under-fault gate drops,
@@ -825,6 +864,19 @@ def check_series(runs: List[RunRecord], threshold: float,
             detail=f"{latest_rec.source}: load block degraded "
                    f"({latest_rec.load_error}) where prior runs "
                    "measured the traffic-engineering harness"))
+    # a degraded slo block where prior rounds measured the request-
+    # lifecycle observatory is a regression, not a silent skip
+    if latest_rec.slo_error is not None \
+            and any(r.slo_trace_overhead_frac is not None
+                    for r in runs[:-1]):
+        verdicts.append(Verdict(
+            series=(runs[0].metric or "?", runs[0].platform),
+            quantity="slo", baseline=float("nan"),
+            latest=float("nan"), rel_change=float("inf"),
+            bar=threshold, failed=True,
+            detail=f"{latest_rec.source}: slo block degraded "
+                   f"({latest_rec.slo_error}) where prior runs "
+                   "measured the request-lifecycle observatory"))
     # a degraded recovery block where prior rounds measured crash
     # recovery is a regression, not a silent skip — and a recovered
     # state that stopped landing bitwise is a correctness break even
@@ -1036,6 +1088,13 @@ def render_report(records: List[RunRecord], out=None) -> None:
                   f"shed_rate={latest.load_shed_rate}, "
                   f"fairness={latest.load_fairness}, "
                   f"steady_compiles={latest.load_steady_compiles}",
+                  file=out)
+        if latest.slo_trace_overhead_frac is not None:
+            print(f"  slo: trace_overhead={latest.slo_trace_overhead_frac}"
+                  f" compliance fit={latest.slo_fit_compliance} "
+                  f"posterior={latest.slo_posterior_compliance}, "
+                  f"worst_burn={latest.slo_worst_burn_rate}, "
+                  f"postmortems={latest.slo_postmortems}",
                   file=out)
         if latest.cost:
             c = latest.cost

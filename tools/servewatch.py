@@ -1,0 +1,380 @@
+"""Stdlib-only viewer/validator for the four-door service's black box.
+
+``python -m tools.servewatch <path>`` renders service state from either
+a ``postmortem/1`` flight-recorder bundle (``postmortem-*.json``), a
+run's ``events.jsonl`` (the observatory's ``request_trace`` /
+``slo_status`` / ``postmortem`` lifecycle records), or a run directory
+holding both.  ``--check`` validates instead of rendering and exits
+non-zero on any violation — it is wired as a pre-commit hook over the
+committed fixtures under ``tests/fixtures/servewatch/``.
+
+Like ``tools/tailscan``, this module imports NOTHING from pint_tpu on
+purpose: the pre-commit gate must stay stdlib-only (``import pint_tpu``
+drags in jax, and this container's sitecustomize forces an axon TPU
+backend).  :func:`validate_bundle` is therefore a deliberate twin of
+:func:`pint_tpu.telemetry.flightrec.validate_bundle` — keep the two in
+lockstep; ``tests/test_reqtrace.py`` diffs them on shared fixtures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["POSTMORTEM_SCHEMA", "ENTRY_KINDS", "validate_bundle",
+           "validate_bundle_file", "validate_events_file", "render",
+           "main"]
+
+#: must match pint_tpu.telemetry.flightrec.POSTMORTEM_SCHEMA
+POSTMORTEM_SCHEMA = "pint_tpu.telemetry.postmortem/1"
+
+#: must match pint_tpu.telemetry.flightrec.ENTRY_KINDS
+ENTRY_KINDS = ("enqueue", "shed", "dispatch", "dispatch_error", "deliver",
+               "breaker", "journal", "drill", "health")
+
+#: must match pint_tpu.telemetry.runlog.EVENT_SCHEMA
+EVENT_SCHEMA = "pint_tpu.telemetry.event/1"
+
+_REQUEST_CLASSES = ("predict", "posterior", "update", "fit")
+_SLO_STATES = ("ok", "warn", "page")
+_SEGMENTS = ("admit_ms", "queue_ms", "schedule_ms", "device_ms",
+             "deliver_ms")
+#: clock slack for the segment-sum identity (matches telemetry_report)
+_SUM_SLACK_MS = 1e-3
+
+
+# ---------------------------------------------------------------------------
+# validation (stdlib twin of flightrec.validate_bundle)
+# ---------------------------------------------------------------------------
+
+def validate_bundle(doc: dict, where: str = "postmortem",
+                    errors: Optional[List[str]] = None) -> List[str]:
+    """Validate one ``postmortem/1`` bundle; returns the error list
+    (empty == valid).  Twin of
+    ``pint_tpu.telemetry.flightrec.validate_bundle``."""
+    errs = errors if errors is not None else []
+
+    def bad(msg: str) -> None:
+        errs.append(f"{where}: {msg}")
+
+    if not isinstance(doc, dict):
+        bad(f"bundle must be an object, got {type(doc).__name__}")
+        return errs
+    if doc.get("schema") != POSTMORTEM_SCHEMA:
+        bad(f"schema must be {POSTMORTEM_SCHEMA!r}, got "
+            f"{doc.get('schema')!r}")
+    trigger = doc.get("trigger")
+    if not isinstance(trigger, str) or not trigger.strip():
+        bad("trigger must be a non-empty reason string")
+    rings = doc.get("rings")
+    if not isinstance(rings, dict):
+        bad("rings must be an object of door -> entry list")
+    else:
+        for door, entries in rings.items():
+            if not isinstance(entries, list):
+                bad(f"ring {door!r} must be a list")
+                continue
+            for i, e in enumerate(entries):
+                if not isinstance(e, dict) or "kind" not in e or "t" not in e:
+                    bad(f"ring {door!r} entry {i} must be an object with "
+                        "'kind' and 't'")
+                    break
+                if e["kind"] not in ENTRY_KINDS:
+                    bad(f"ring {door!r} entry {i}: unknown kind "
+                        f"{e['kind']!r}")
+                    break
+    for field in ("breakers", "slo", "queue_depths"):
+        if not isinstance(doc.get(field), dict):
+            bad(f"{field} must be an object")
+    ring_bytes = doc.get("ring_bytes")
+    if not isinstance(ring_bytes, dict) or any(
+            not isinstance(v, int) or v < 0 for v in ring_bytes.values()):
+        bad("ring_bytes must map door -> non-negative int")
+    mref = doc.get("manifest_ref")
+    if mref is not None and not isinstance(mref, str):
+        bad("manifest_ref must be a string or null")
+    t = doc.get("t")
+    if not isinstance(t, (int, float)) or isinstance(t, bool) or t < 0:
+        bad("t must be a non-negative number")
+    return errs
+
+
+def validate_bundle_file(path: str,
+                         errors: Optional[List[str]] = None) -> List[str]:
+    errs = errors if errors is not None else []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        errs.append(f"{path}: unreadable bundle ({type(e).__name__}: {e})")
+        return errs
+    return validate_bundle(doc, where=path, errors=errs)
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_request_trace(attrs: dict, where: str, errs: List[str]) -> None:
+    if attrs.get("request_class") not in _REQUEST_CLASSES:
+        errs.append(f"{where}: request_trace request_class "
+                    f"{attrs.get('request_class')!r} not in "
+                    f"{_REQUEST_CLASSES}")
+    total = attrs.get("total_ms")
+    if not _num(total) or total < 0:
+        errs.append(f"{where}: request_trace total_ms must be a "
+                    "non-negative number")
+        return
+    seg_sum = 0.0
+    for seg in _SEGMENTS:
+        v = attrs.get(seg)
+        if not _num(v) or v < 0:
+            errs.append(f"{where}: request_trace {seg} must be a "
+                        "non-negative number")
+            return
+        seg_sum += v
+    if seg_sum > total + _SUM_SLACK_MS:
+        errs.append(f"{where}: request_trace segments sum {seg_sum:.6f} "
+                    f"exceeds total_ms {total:.6f}")
+
+
+def _check_slo_status(attrs: dict, where: str, errs: List[str]) -> None:
+    state, prev = attrs.get("state"), attrs.get("previous")
+    for k, v in (("state", state), ("previous", prev)):
+        if v not in _SLO_STATES:
+            errs.append(f"{where}: slo_status {k} {v!r} not in "
+                        f"{_SLO_STATES}")
+    if state == prev:
+        errs.append(f"{where}: slo_status must record a state CHANGE, "
+                    f"got {state!r} -> {prev!r}")
+    for k in ("burn_rate", "burn_rate_slow"):
+        v = attrs.get(k)
+        if not _num(v) or v < 0:
+            errs.append(f"{where}: slo_status {k} must be a non-negative "
+                        "number")
+
+
+def _check_postmortem(attrs: dict, where: str, errs: List[str]) -> None:
+    trig = attrs.get("trigger")
+    if not isinstance(trig, str) or not trig.strip():
+        errs.append(f"{where}: postmortem trigger must be a non-empty "
+                    "reason string")
+    for k in ("n_doors", "n_entries", "ring_bytes"):
+        v = attrs.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errs.append(f"{where}: postmortem {k} must be a non-negative "
+                        "int")
+
+
+_EVENT_CHECKS = {"request_trace": _check_request_trace,
+                 "slo_status": _check_slo_status,
+                 "postmortem": _check_postmortem}
+
+
+def validate_events_file(path: str,
+                         errors: Optional[List[str]] = None) -> List[str]:
+    """Line-validate a run's ``events.jsonl``: every line is strict
+    one-object JSON with the event schema tag, and the observatory
+    events (``request_trace`` / ``slo_status`` / ``postmortem``) honor
+    their semantic contracts."""
+    errs = errors if errors is not None else []
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        errs.append(f"{path}: unreadable ({type(e).__name__}: {e})")
+        return errs
+    for n, line in enumerate(lines, 1):
+        if not line.strip():
+            continue
+        where = f"{path}:{n}"
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            errs.append(f"{where}: not valid JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errs.append(f"{where}: line must be one JSON object")
+            continue
+        if rec.get("schema") != EVENT_SCHEMA:
+            errs.append(f"{where}: schema must be {EVENT_SCHEMA!r}, got "
+                        f"{rec.get('schema')!r}")
+            continue
+        if rec.get("type") != "event":
+            continue
+        ev = rec.get("event")
+        if not isinstance(ev, dict) or "name" not in ev:
+            errs.append(f"{where}: event lines need an object 'event' "
+                        "with 'name'")
+            continue
+        attrs = ev.get("attrs")
+        check = _EVENT_CHECKS.get(ev["name"])
+        if check is not None:
+            if not isinstance(attrs, dict):
+                errs.append(f"{where}: {ev['name']} needs an attrs object")
+            else:
+                check(attrs, where, errs)
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _render_bundle(doc: dict, out: List[str]) -> None:
+    out.append(f"postmortem @ t={doc.get('t')}")
+    out.append(f"  trigger: {doc.get('trigger')}")
+    if doc.get("manifest_ref"):
+        out.append(f"  run manifest: {doc['manifest_ref']}")
+    depths = doc.get("queue_depths") or {}
+    breakers = doc.get("breakers") or {}
+    rings = doc.get("rings") or {}
+    ring_bytes = doc.get("ring_bytes") or {}
+    doors = sorted(set(depths) | set(breakers) | set(rings))
+    out.append("  doors:")
+    for door in doors:
+        br = breakers.get(door, {})
+        state = br.get("state", "?") if isinstance(br, dict) else br
+        entries = rings.get(door, [])
+        out.append(f"    {door:<10} breaker={state:<9} "
+                   f"depth={depths.get(door, 0):<4} "
+                   f"ring={len(entries)} entries/"
+                   f"{ring_bytes.get(door, 0)} B")
+        for e in entries[-3:]:
+            extra = {k: v for k, v in e.items() if k not in ("t", "kind")}
+            out.append(f"      t={e.get('t')} {e.get('kind')} {extra}")
+    slo = doc.get("slo") or {}
+    if slo:
+        out.append(f"  slo: worst_burn={slo.get('worst_burn')} "
+                   f"transitions={slo.get('transitions')}")
+        for klass, sli in sorted((slo.get("classes") or {}).items()):
+            if isinstance(sli, dict):
+                out.append(f"    {klass:<10} state={sli.get('state', '?'):<5}"
+                           f" goodput={sli.get('goodput_fast')} "
+                           f"burn={sli.get('burn_fast')}")
+
+
+def _render_events(path: str, out: List[str]) -> None:
+    counts: dict = {}
+    last_slo: dict = {}
+    last_pm = None
+    traces = 0
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if not isinstance(rec, dict) or rec.get("type") != "event":
+                continue
+            ev = rec.get("event") or {}
+            name = ev.get("name")
+            counts[name] = counts.get(name, 0) + 1
+            attrs = ev.get("attrs") or {}
+            if name == "slo_status":
+                last_slo[attrs.get("request_class")] = attrs
+            elif name == "postmortem":
+                last_pm = attrs
+            elif name == "request_trace":
+                traces += attrs.get("n_traced", 1)
+    out.append(f"events: {path}")
+    for name in sorted(counts):
+        out.append(f"  {name:<24} x{counts[name]}")
+    if traces:
+        out.append(f"  traced requests: {traces}")
+    for klass, attrs in sorted(last_slo.items()):
+        out.append(f"  slo[{klass}]: {attrs.get('previous')} -> "
+                   f"{attrs.get('state')} burn={attrs.get('burn_rate')}")
+    if last_pm is not None:
+        out.append(f"  last postmortem: {last_pm.get('trigger')!r} "
+                   f"({last_pm.get('n_entries')} ring entries)")
+
+
+def _classify(path: str) -> str:
+    base = os.path.basename(path)
+    if base.endswith(".jsonl"):
+        return "events"
+    return "bundle"
+
+
+def _expand(paths: List[str]) -> List[str]:
+    """Run directories expand to their events.jsonl + postmortem/*.json."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            ev = os.path.join(p, "events.jsonl")
+            if os.path.exists(ev):
+                out.append(ev)
+            out.extend(os.path.join(p, b)
+                       for b in sorted(os.listdir(p))
+                       if b.startswith("postmortem") and
+                       b.endswith(".json"))
+            pm_dir = os.path.join(p, "postmortem")
+            if os.path.isdir(pm_dir):
+                out.extend(os.path.join(pm_dir, b)
+                           for b in sorted(os.listdir(pm_dir))
+                           if b.endswith(".json"))
+        else:
+            out.append(p)
+    return out
+
+
+def render(paths: List[str]) -> str:
+    out: List[str] = []
+    for p in _expand(paths):
+        if _classify(p) == "events":
+            _render_events(p, out)
+        else:
+            try:
+                with open(p) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                out.append(f"{p}: unreadable ({type(e).__name__}: {e})")
+                continue
+            _render_bundle(doc, out)
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.servewatch",
+        description="render or validate four-door service postmortems "
+                    "and observatory event streams")
+    ap.add_argument("paths", nargs="*",
+                    help="postmortem bundle .json, events.jsonl, or a "
+                         "run directory holding both")
+    ap.add_argument("--check", action="store_true",
+                    help="validate instead of render; non-zero exit on "
+                         "any violation")
+    args = ap.parse_args(argv)
+    paths = args.paths or (
+        [os.path.join("tests", "fixtures", "servewatch")]
+        if args.check else [])
+    if not paths:
+        ap.error("give at least one path (bundle, events.jsonl, run dir)")
+    if not args.check:
+        print(render(paths))
+        return 0
+    errors: List[str] = []
+    checked = 0
+    for p in _expand(paths):
+        checked += 1
+        if _classify(p) == "events":
+            validate_events_file(p, errors)
+        else:
+            validate_bundle_file(p, errors)
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        print(f"servewatch-check: FAIL ({len(errors)} error(s) across "
+              f"{checked} file(s))", file=sys.stderr)
+        return 1
+    print(f"servewatch-check: OK ({checked} file(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -488,7 +488,8 @@ DURABILITY_EVENT_ATTRS = {
     "chaos_drill": {"scenario": str, "offered": int, "completed": int,
                     "shed": int, "errored": int, "stranded": int,
                     "duration_s": (int, float),
-                    "recovery_s": (int, float), "contract_ok": bool},
+                    "recovery_s": (int, float), "postmortems": int,
+                    "postmortem_ok": bool, "contract_ok": bool},
 }
 
 # must track pint_tpu.serving.admission.BREAKER_STATES in tandem
@@ -574,7 +575,7 @@ def validate_durability_event(ev: dict, where: str,
                  "chaos_drill scenario is empty — a drill must name "
                  "its scripted scenario")
         for key in ("offered", "completed", "shed", "errored",
-                    "duration_s"):
+                    "duration_s", "postmortems"):
             v = _num(key)
             if v is not None and v < 0:
                 _err(errors, where,
@@ -716,6 +717,197 @@ def validate_catalog_event(ev: dict, where: str,
         if isinstance(nb, int) and not isinstance(nb, bool) and nb < 1:
             _err(errors, where,
                  f"catalog_bucket n_buckets is {nb!r}, must be >= 1")
+
+
+#: request-lifecycle observability events (pint_tpu/telemetry reqtrace
+#: + flightrec, pint_tpu/serving service + slo): ONE request_trace per
+#: coalesced dispatch linking its member trace ids with the latency
+#: decomposition, one slo_status per alert-state transition (never per
+#: request), one postmortem per flight-recorder dump.  Same contract
+#: style as the other event families — a drift in the door-core
+#: emitters fails --check before it corrupts the slo series
+#: bench/perfwatch trend.
+OBSERVATORY_EVENT_ATTRS = {
+    "request_trace": {"request_class": str, "batch": int,
+                      "n_traced": int, "trace_ids": str,
+                      "total_ms": (int, float),
+                      "admit_ms": (int, float),
+                      "queue_ms": (int, float),
+                      "schedule_ms": (int, float),
+                      "device_ms": (int, float),
+                      "deliver_ms": (int, float), "members": str},
+    "slo_status": {"request_class": str, "state": str, "previous": str,
+                   "burn_rate": (int, float),
+                   "burn_rate_slow": (int, float),
+                   "goodput": (int, float), "shed_rate": (int, float)},
+    "postmortem": {"trigger": str, "n_doors": int, "n_entries": int,
+                   "ring_bytes": int, "path": str},
+}
+
+#: must track pint_tpu.serving.admission.REQUEST_CLASSES in tandem
+_TRACE_CLASSES = ("predict", "posterior", "update", "fit")
+
+#: must track pint_tpu.serving.slo.SLO_STATES in tandem
+_SLO_STATES = ("ok", "warn", "page")
+
+#: per-member accounting-identity slack: segments are rounded to 1e-6
+#: ms before the record is written, so six-segment sums can drift a
+#: few 1e-6 from the rounded total — never more than this
+_TRACE_SUM_SLACK_MS = 1e-3
+
+#: trace-segment attrs, in lifecycle order (reqtrace.SEGMENTS keys)
+_TRACE_SEGMENTS = ("admit_ms", "queue_ms", "schedule_ms", "device_ms",
+                   "deliver_ms")
+
+
+def validate_observatory_event(ev: dict, where: str,
+                               errors: List[str]) -> None:
+    """Attr contract for request_trace / slo_status / postmortem
+    records: required attrs typed; a trace's class in the request
+    enum, every segment >= 0, segment sum <= total (and each JSON
+    member's own decomposition summing to its total within the
+    rounding slack — the accounting identity, re-checked offline);
+    a status transition's states in the enum and actually distinct
+    with burn >= 0 and goodput/shed fractions; a postmortem's trigger
+    reason non-empty with non-negative counts."""
+    name = ev.get("name")
+    required = OBSERVATORY_EVENT_ATTRS.get(name)
+    if required is None:
+        return
+    attrs = ev.get("attrs")
+    if not isinstance(attrs, dict):
+        _err(errors, where, f"{name} event has no attrs object")
+        return
+    for key, typ in required.items():
+        v = attrs.get(key)
+        if not isinstance(v, typ) or (isinstance(v, bool)
+                                      and typ is not bool):
+            _err(errors, where,
+                 f"{name} attr {key!r} is {v!r}, expected "
+                 f"{typ.__name__ if isinstance(typ, type) else 'number'}")
+    def _num(key):
+        v = attrs.get(key)
+        return v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+    if name == "request_trace":
+        if attrs.get("request_class") not in _TRACE_CLASSES:
+            _err(errors, where,
+                 f"request_trace request_class "
+                 f"{attrs.get('request_class')!r} not in "
+                 f"{_TRACE_CLASSES}")
+        batch, n_traced = _num("batch"), _num("n_traced")
+        if batch is not None and batch < 1:
+            _err(errors, where,
+                 f"request_trace batch is {batch!r}, must be >= 1")
+        if n_traced is not None:
+            if n_traced < 1:
+                _err(errors, where,
+                     f"request_trace n_traced is {n_traced!r}, must "
+                     "be >= 1 — an untraced dispatch emits nothing")
+            elif batch is not None and n_traced > batch:
+                _err(errors, where,
+                     f"request_trace n_traced ({n_traced!r}) exceeds "
+                     f"batch ({batch!r})")
+        ids = attrs.get("trace_ids")
+        if isinstance(ids, str):
+            if not ids.strip():
+                _err(errors, where, "request_trace trace_ids is empty")
+            elif n_traced is not None \
+                    and len(ids.split(",")) != n_traced:
+                _err(errors, where,
+                     f"request_trace trace_ids lists "
+                     f"{len(ids.split(','))} id(s) but n_traced is "
+                     f"{n_traced!r}")
+        total = _num("total_ms")
+        seg_sum = 0.0
+        for key in _TRACE_SEGMENTS:
+            v = _num(key)
+            if v is None:
+                continue
+            if v < 0:
+                _err(errors, where,
+                     f"request_trace segment {key!r} is negative "
+                     f"({v!r})")
+            seg_sum += max(v, 0.0)
+        if total is not None:
+            if total < 0:
+                _err(errors, where,
+                     f"request_trace total_ms is negative ({total!r})")
+            elif seg_sum > total + _TRACE_SUM_SLACK_MS:
+                _err(errors, where,
+                     f"request_trace segments sum to {seg_sum:.6f} ms "
+                     f"> total_ms {total!r} — the accounting identity "
+                     "is broken")
+        members = attrs.get("members")
+        if isinstance(members, str):
+            try:
+                parsed = json.loads(members)
+            except ValueError:
+                parsed = None
+            if not isinstance(parsed, list) or not parsed:
+                _err(errors, where,
+                     "request_trace members is not a non-empty JSON "
+                     "list")
+            else:
+                for i, m in enumerate(parsed):
+                    if not isinstance(m, dict) or "trace_id" not in m \
+                            or not isinstance(m.get("segments"), dict):
+                        _err(errors, where,
+                             f"request_trace member {i} lacks "
+                             "trace_id/segments")
+                        break
+                    m_total = m.get("total_ms")
+                    if isinstance(m_total, (int, float)) \
+                            and not isinstance(m_total, bool):
+                        m_sum = sum(
+                            v for v in m["segments"].values()
+                            if isinstance(v, (int, float))
+                            and not isinstance(v, bool))
+                        if abs(m_sum - m_total) > _TRACE_SUM_SLACK_MS:
+                            _err(errors, where,
+                                 f"request_trace member {i} segments "
+                                 f"sum to {m_sum:.6f} ms but total_ms "
+                                 f"is {m_total!r} — the accounting "
+                                 "identity is broken")
+                            break
+    elif name == "slo_status":
+        if attrs.get("request_class") not in _TRACE_CLASSES:
+            _err(errors, where,
+                 f"slo_status request_class "
+                 f"{attrs.get('request_class')!r} not in "
+                 f"{_TRACE_CLASSES}")
+        state, prev = attrs.get("state"), attrs.get("previous")
+        for key, v in (("state", state), ("previous", prev)):
+            if v not in _SLO_STATES:
+                _err(errors, where,
+                     f"slo_status {key} {v!r} not in {_SLO_STATES}")
+        if state in _SLO_STATES and prev in _SLO_STATES \
+                and state == prev:
+            _err(errors, where,
+                 f"slo_status state == previous ({state!r}) — a "
+                 "status record marks a transition, never a heartbeat")
+        for key in ("burn_rate", "burn_rate_slow"):
+            v = _num(key)
+            if v is not None and v < 0:
+                _err(errors, where,
+                     f"slo_status {key!r} is negative ({v!r})")
+        for key in ("goodput", "shed_rate"):
+            v = _num(key)
+            if v is not None and not (0.0 <= v <= 1.0):
+                _err(errors, where,
+                     f"slo_status {key!r} is {v!r}, not a fraction "
+                     "in [0, 1]")
+    elif name == "postmortem":
+        trigger = attrs.get("trigger")
+        if isinstance(trigger, str) and not trigger.strip():
+            _err(errors, where,
+                 "postmortem trigger is empty — a dump must state "
+                 "what tripped it")
+        for key in ("n_doors", "n_entries", "ring_bytes"):
+            v = _num(key)
+            if v is not None and v < 0:
+                _err(errors, where,
+                     f"postmortem {key!r} is negative ({v!r})")
 
 
 def validate_autotune_event(ev: dict, where: str,
@@ -1254,6 +1446,7 @@ def validate_events_file(path: str, errors: List[str]) -> int:
                     validate_load_event(ev, where, errors)
                     validate_durability_event(ev, where, errors)
                     validate_predict_event(ev, where, errors)
+                    validate_observatory_event(ev, where, errors)
             elif type_ == "metrics":
                 if not isinstance(rec["metrics"], dict):
                     _err(errors, where, "metrics body is not an object")
@@ -1621,11 +1814,13 @@ def self_test(errors: List[str]) -> int:
         run.record_event("chaos_drill", scenario="device_loss",
                          offered=64, completed=41, shed=20, errored=3,
                          stranded=0, duration_s=1.8, recovery_s=0.31,
+                         postmortems=2, postmortem_ok=True,
                          contract_ok=True)
         run.record_event("chaos_drill", scenario="straggler",
                          offered=64, completed=0, shed=0, errored=0,
                          stranded=-1, duration_s=120.0,
-                         recovery_s=-1.0, contract_ok=False)
+                         recovery_s=-1.0, postmortems=1,
+                         postmortem_ok=True, contract_ok=False)
         # phase-prediction producer drift check: the predict-door /
         # predictor-cache event contract (PREDICT_EVENT_ATTRS) — a
         # warm steady-state serve, its cold degraded twin (fresh
@@ -1642,6 +1837,53 @@ def self_test(errors: List[str]) -> int:
                          windows=5, latency_ms=0.0)
         run.record_event("predictor_cache", kind="regenerate",
                          windows=5, latency_ms=88.0)
+        # request-lifecycle observability drift check: the reqtrace /
+        # slo / flightrec event contract (OBSERVATORY_EVENT_ATTRS) — a
+        # fully-traced coalesced dispatch whose member decompositions
+        # satisfy the accounting identity, its sampled twin (one traced
+        # member riding a larger batch), both slo transitions of a
+        # burn excursion, and a persisted postmortem next to its
+        # in-memory-only twin (path="")
+        run.record_event(
+            "request_trace", request_class="fit", batch=2, n_traced=2,
+            trace_ids="7,8", total_ms=4.4, admit_ms=0.05, queue_ms=1.8,
+            schedule_ms=0.1, device_ms=2.4, deliver_ms=0.05,
+            members=json.dumps([
+                {"trace_id": 7, "total_ms": 4.4,
+                 "segments": {"admit_ms": 0.05, "queue_ms": 1.8,
+                              "schedule_ms": 0.1, "device_ms": 2.4,
+                              "deliver_ms": 0.05}},
+                {"trace_id": 8, "total_ms": 3.1,
+                 "segments": {"admit_ms": 0.05, "queue_ms": 0.5,
+                              "schedule_ms": 0.1, "device_ms": 2.4,
+                              "deliver_ms": 0.05}}]))
+        run.record_event(
+            "request_trace", request_class="posterior", batch=4,
+            n_traced=1, trace_ids="21", total_ms=2.0, admit_ms=0.02,
+            queue_ms=0.4, schedule_ms=0.08, device_ms=1.45,
+            deliver_ms=0.05,
+            members=json.dumps([
+                {"trace_id": 21, "total_ms": 2.0,
+                 "segments": {"admit_ms": 0.02, "queue_ms": 0.4,
+                              "schedule_ms": 0.08, "device_ms": 1.45,
+                              "deliver_ms": 0.05}}]))
+        run.record_event("slo_status", request_class="fit",
+                         state="warn", previous="ok", burn_rate=3.6,
+                         burn_rate_slow=1.1, goodput=0.964,
+                         shed_rate=0.02)
+        run.record_event("slo_status", request_class="fit",
+                         state="page", previous="warn", burn_rate=22.0,
+                         burn_rate_slow=8.4, goodput=0.78,
+                         shed_rate=0.31)
+        run.record_event("postmortem",
+                         trigger="circuit breaker opened for fit door",
+                         n_doors=4, n_entries=212, ring_bytes=48120,
+                         path="/tmp/run/postmortem/postmortem-0001"
+                              ".json")
+        run.record_event("postmortem",
+                         trigger="chaos drill injected: device_loss",
+                         n_doors=4, n_entries=64, ring_bytes=9240,
+                         path="")
         run.close()
         if not captured:
             _err(errors, "selftest", "span tracer produced no root span")
@@ -1650,9 +1892,10 @@ def self_test(errors: List[str]) -> int:
         # sharding_plan, 4x elastic events, 3x serving events, 2x
         # autotune events, 3x catalog events, 3x precision events,
         # 4x amortized events, 3x streaming events, 5x load events,
-        # 5x durability events, 6x predict events, metrics, run_end
-        if n < 49:
-            _err(errors, "selftest", f"expected >= 48 records, got {n}")
+        # 5x durability events, 6x predict events, 6x observatory
+        # events, metrics, run_end
+        if n < 55:
+            _err(errors, "selftest", f"expected >= 54 records, got {n}")
         with open(os.path.join(run_dir, "manifest.json"),
                   encoding="utf-8") as f:
             manifest = json.load(f)
@@ -1706,6 +1949,36 @@ def self_test(errors: List[str]) -> int:
             _err(errors, "selftest",
                  "tuning-manifest round trip did not yield exactly one "
                  "decision")
+        # flight-recorder postmortem round trip: a real bundle straight
+        # from the live producer (injected clock, no service needed)
+        # and its empty-rings degraded twin both validate; a bundle
+        # with no trigger reason must NOT
+        from pint_tpu.telemetry.flightrec import (POSTMORTEM_SCHEMA,
+                                                  FlightRecorder,
+                                                  validate_bundle)
+
+        rec = FlightRecorder(max_entries=8, max_bytes=4096,
+                             clock=lambda: 12.5)
+        rec.note("fit", "enqueue", depth=1, trace_id=7)
+        rec.note("fit", "dispatch", batch=2)
+        rec.note("fit", "breaker", from_state="closed", to_state="open")
+        validate_bundle(
+            rec.dump("selftest: synthetic breaker trip",
+                     breakers={"fit": {"state": "open"}},
+                     slo={"worst_burn": 3.2}, queue_depths={"fit": 0}),
+            "selftest postmortem", errors)
+        validate_bundle(
+            FlightRecorder(clock=lambda: 0.0).dump(
+                "selftest: empty-rings twin"),
+            "selftest postmortem degraded", errors)
+        bad_bundle = {"schema": POSTMORTEM_SCHEMA,
+                      "trigger": "  ", "t": 1.0, "rings": {},
+                      "ring_bytes": {}, "breakers": {}, "slo": {},
+                      "queue_depths": {}, "manifest_ref": None}
+        if not validate_bundle(bad_bundle, "selftest", errors=[]):
+            _err(errors, "selftest",
+                 "postmortem validator accepted an empty trigger "
+                 "reason — the non-empty-trigger contract is dead")
         # one source of truth, two consumers: the jaxlint event-contract
         # cross-checker parses THIS file's *_EVENT_ATTRS tables from
         # source; assert the runtime tables round-trip through that
@@ -1734,6 +2007,20 @@ def self_test(errors: List[str]) -> int:
         return n
 
 
+def validate_postmortem_file(path: str, errors: List[str]) -> None:
+    """One flight-recorder ``postmortem/1`` bundle file, checked with
+    the SAME validator the chaos drill contract applies in-process."""
+    from pint_tpu.telemetry.flightrec import validate_bundle
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        _err(errors, path, f"unreadable/invalid: {e}")
+        return
+    validate_bundle(doc, where=path, errors=errors)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tools.telemetry_report",
@@ -1756,6 +2043,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     elif base.startswith("TUNE_") \
                             or base == "tuning.json":
                         validate_tuning_manifest_file(p, errors)
+                    elif base.startswith("postmortem"):
+                        validate_postmortem_file(p, errors)
                     else:
                         validate_multichip_file(p, errors)
                 else:
